@@ -1,0 +1,14 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh before any jax import, so the
+multi-chip sharding paths (parallel/, __graft_entry__.dryrun_multichip)
+compile and execute without TPU hardware.  Must run before jax is imported
+anywhere in the test session.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,62 @@
+"""API type tests: EndpointGroupBinding round-trip + object model basics."""
+from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    split_meta_namespace_key,
+)
+
+
+def test_egb_dict_roundtrip():
+    egb = EndpointGroupBinding(
+        metadata=ObjectMeta(name="b", namespace="ns", generation=3),
+        spec=EndpointGroupBindingSpec(
+            endpoint_group_arn="arn:aws:globalaccelerator::123:accelerator/x",
+            client_ip_preservation=True,
+            weight=128,
+            service_ref=ServiceReference(name="svc"),
+        ),
+    )
+    d = egb.to_dict()
+    assert d["apiVersion"] == "operator.h3poteto.dev/v1alpha1"
+    assert d["spec"]["clientIPPreservation"] is True
+    assert d["spec"]["serviceRef"] == {"name": "svc"}
+    back = EndpointGroupBinding.from_dict(d)
+    assert back.spec.endpoint_group_arn == egb.spec.endpoint_group_arn
+    assert back.spec.weight == 128
+    assert back.metadata.generation == 3
+
+
+def test_egb_nullable_weight():
+    egb = EndpointGroupBinding.from_dict(
+        {"spec": {"endpointGroupArn": "arn"}, "metadata": {"name": "x"}})
+    assert egb.spec.weight is None
+    assert egb.spec.client_ip_preservation is False
+    assert "weight" not in egb.to_dict()["spec"]
+
+
+def test_deep_copy_isolation():
+    svc = Service(metadata=ObjectMeta(name="s", annotations={"a": "1"}),
+                  spec=ServiceSpec(type="LoadBalancer",
+                                   ports=[ServicePort(port=80)]))
+    cp = svc.deep_copy()
+    cp.metadata.annotations["a"] = "2"
+    cp.spec.ports[0].port = 81
+    assert svc.metadata.annotations["a"] == "1"
+    assert svc.spec.ports[0].port == 80
+
+
+def test_split_key():
+    assert split_meta_namespace_key("ns/name") == ("ns", "name")
+    assert split_meta_namespace_key("name") == ("", "name")
+    try:
+        split_meta_namespace_key("a/b/c")
+        assert False
+    except ValueError:
+        pass

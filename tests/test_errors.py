@@ -1,0 +1,29 @@
+"""NoRetryError wrapping tests (reference pkg/errors/errors_test.go:11-44)."""
+from aws_global_accelerator_controller_tpu.errors import (
+    NoRetryError,
+    is_no_retry,
+    new_no_retry_errorf,
+)
+
+
+def test_direct():
+    assert is_no_retry(new_no_retry_errorf("bad key: %s", "a/b"))
+
+
+def test_wrapped():
+    try:
+        try:
+            raise NoRetryError("inner")
+        except NoRetryError as inner:
+            raise RuntimeError("outer") from inner
+    except RuntimeError as outer:
+        assert is_no_retry(outer)
+
+
+def test_plain_error_is_retryable():
+    assert not is_no_retry(RuntimeError("transient"))
+
+
+def test_message_formatting():
+    err = new_no_retry_errorf("invalid resource key: %s", "x/y/z")
+    assert str(err) == "invalid resource key: x/y/z"

@@ -1,0 +1,49 @@
+"""LB hostname parsing tests.
+
+Ports the table from reference pkg/cloudprovider/aws/load_balancer_test.go:9-50
+plus error cases the reference leaves uncovered.
+"""
+import pytest
+
+from aws_global_accelerator_controller_tpu.cloudprovider.aws import (
+    get_lb_name_from_hostname,
+    get_region_from_arn,
+)
+
+CASES = [
+    ("public NLB",
+     "aa5849cde256f49faa7487bb433155b7-3f43353a6cb6f633.elb.ap-northeast-1.amazonaws.com",
+     "aa5849cde256f49faa7487bb433155b7", "ap-northeast-1"),
+    ("internal NLB",
+     "test-b6cdc5fbd1d6fa43.elb.ap-northeast-1.amazonaws.com",
+     "test", "ap-northeast-1"),
+    ("public ALB",
+     "k8s-default-h3poteto-f1f41628db-201899272.ap-northeast-1.elb.amazonaws.com",
+     "k8s-default-h3poteto-f1f41628db", "ap-northeast-1"),
+    ("internal ALB",
+     "internal-k8s-default-h3poteto-35ca57562f-777774719.ap-northeast-1.elb.amazonaws.com",
+     "k8s-default-h3poteto-35ca57562f", "ap-northeast-1"),
+]
+
+
+@pytest.mark.parametrize("title,hostname,name,region", CASES)
+def test_get_lb_name_from_hostname(title, hostname, name, region):
+    got_name, got_region = get_lb_name_from_hostname(hostname)
+    assert got_name == name
+    assert got_region == region
+
+
+def test_not_an_elb():
+    with pytest.raises(ValueError, match="not Elastic Load Balancer"):
+        get_lb_name_from_hostname("example.com")
+
+
+def test_unparseable_subdomain():
+    with pytest.raises(ValueError, match="Failed to parse"):
+        get_lb_name_from_hostname("x.ap-northeast-1.elb.amazonaws.com")
+
+
+def test_get_region_from_arn():
+    arn = ("arn:aws:elasticloadbalancing:us-east-1:123456789012:"
+           "loadbalancer/net/my-lb/50dc6c495c0c9188")
+    assert get_region_from_arn(arn) == "us-east-1"

@@ -1,0 +1,15 @@
+"""Provider detection tests (reference pkg/cloudprovider/provider_test.go:8-32)."""
+import pytest
+
+from aws_global_accelerator_controller_tpu.cloudprovider import detect_cloud_provider
+
+
+def test_detect_aws():
+    assert detect_cloud_provider(
+        "aa5849cde256f49faa7487bb433155b7-3f43353a6cb6f633.elb.ap-northeast-1.amazonaws.com"
+    ) == "aws"
+
+
+def test_detect_unknown():
+    with pytest.raises(ValueError, match="Unknown cloud provider"):
+        detect_cloud_provider("foo.example.org")
