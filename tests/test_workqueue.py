@@ -1,0 +1,94 @@
+"""Workqueue semantics tests (client-go invariants the controllers rely on)."""
+import threading
+import time
+
+from aws_global_accelerator_controller_tpu.kube.workqueue import (
+    BucketRateLimiter,
+    ItemExponentialFailureRateLimiter,
+    RateLimitingQueue,
+)
+
+
+def make_queue():
+    # fast limiter so tests don't sleep long
+    return RateLimitingQueue(
+        rate_limiter=ItemExponentialFailureRateLimiter(0.001, 0.05), name="t")
+
+
+def test_dedup_while_queued():
+    q = make_queue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert len(q) == 2
+
+
+def test_readd_while_processing_requeues_on_done():
+    q = make_queue()
+    q.add("a")
+    item, _ = q.get()
+    assert item == "a"
+    q.add("a")  # while processing -> deferred
+    assert len(q) == 0
+    q.done("a")
+    assert len(q) == 1
+    item2, _ = q.get()
+    assert item2 == "a"
+
+
+def test_add_after_delivers_later():
+    q = make_queue()
+    q.add_after("x", 0.05)
+    assert len(q) == 0
+    item, shutdown = q.get(timeout=1.0)
+    assert item == "x" and not shutdown
+
+
+def test_rate_limited_backoff_grows_and_forget_resets():
+    rl = ItemExponentialFailureRateLimiter(0.001, 10.0)
+    delays = [rl.when("k") for _ in range(4)]
+    assert delays == [0.001, 0.002, 0.004, 0.008]
+    assert rl.num_requeues("k") == 4
+    rl.forget("k")
+    assert rl.when("k") == 0.001
+
+
+def test_bucket_rate_limiter_burst():
+    b = BucketRateLimiter(qps=10.0, burst=2)
+    assert b.when("a") == 0.0
+    assert b.when("b") == 0.0
+    assert b.when("c") > 0.0  # out of burst
+
+
+def test_shutdown_unblocks_getters():
+    q = make_queue()
+    results = []
+
+    def worker():
+        item, shutdown = q.get()
+        results.append((item, shutdown))
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    q.shutdown()
+    t.join(timeout=2)
+    assert not t.is_alive()
+    assert results == [(None, True)]
+
+
+def test_get_timeout_returns_none():
+    q = make_queue()
+    item, shutdown = q.get(timeout=0.01)
+    assert item is None and not shutdown
+
+
+def test_drain_before_shutdown_signal():
+    q = make_queue()
+    q.add("a")
+    q.shutdown()
+    item, shutdown = q.get()
+    assert item == "a" and not shutdown
+    q.done("a")
+    item, shutdown = q.get()
+    assert shutdown
