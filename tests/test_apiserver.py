@@ -1,0 +1,110 @@
+"""Fake API server semantics: CRUD, optimistic concurrency, finalizers, watch."""
+import pytest
+
+from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+)
+from aws_global_accelerator_controller_tpu.errors import ConflictError, NotFoundError
+from aws_global_accelerator_controller_tpu.kube.apiserver import (
+    WATCH_ADDED,
+    WATCH_DELETED,
+    WATCH_MODIFIED,
+    FakeAPIServer,
+)
+from aws_global_accelerator_controller_tpu.kube.client import KubeClient, OperatorClient
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    ObjectMeta,
+    Service,
+    ServiceSpec,
+)
+
+
+def make_service(name="s", ns="default", **meta):
+    return Service(metadata=ObjectMeta(name=name, namespace=ns, **meta),
+                   spec=ServiceSpec(type="LoadBalancer"))
+
+
+def test_create_get_list_delete():
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    created = kube.services.create(make_service("a"))
+    assert created.metadata.uid
+    assert created.metadata.resource_version > 0
+    got = kube.services.get("default", "a")
+    assert got.metadata.name == "a"
+    assert len(kube.services.list()) == 1
+    kube.services.delete("default", "a")
+    with pytest.raises(NotFoundError):
+        kube.services.get("default", "a")
+
+
+def test_update_conflict_on_stale_rv():
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    created = kube.services.create(make_service("a"))
+    fresh = kube.services.get("default", "a")
+    fresh.metadata.annotations["x"] = "1"
+    kube.services.update(fresh)
+    stale = created  # old resourceVersion
+    stale.metadata.annotations["x"] = "2"
+    with pytest.raises(ConflictError):
+        kube.services.update(stale)
+
+
+def test_spec_update_bumps_generation_status_does_not():
+    api = FakeAPIServer()
+    op = OperatorClient(api)
+    egb = op.endpoint_group_bindings.create(EndpointGroupBinding(
+        metadata=ObjectMeta(name="b"),
+        spec=EndpointGroupBindingSpec(endpoint_group_arn="arn:x")))
+    assert egb.metadata.generation == 1
+    egb.spec.weight = 10
+    egb = op.endpoint_group_bindings.update(egb)
+    assert egb.metadata.generation == 2
+    egb.status.endpoint_ids = ["arn:lb"]
+    egb2 = op.endpoint_group_bindings.update_status(egb)
+    assert egb2.metadata.generation == 2
+    assert egb2.status.endpoint_ids == ["arn:lb"]
+
+
+def test_finalizer_gated_delete():
+    api = FakeAPIServer()
+    op = OperatorClient(api)
+    egb = op.endpoint_group_bindings.create(EndpointGroupBinding(
+        metadata=ObjectMeta(name="b", finalizers=["op/f"]),
+        spec=EndpointGroupBindingSpec(endpoint_group_arn="arn:x")))
+    op.endpoint_group_bindings.delete("default", "b")
+    # still present, with deletionTimestamp
+    got = op.endpoint_group_bindings.get("default", "b")
+    assert got.metadata.deletion_timestamp is not None
+    # clearing finalizers removes it
+    got.metadata.finalizers = []
+    op.endpoint_group_bindings.update(got)
+    with pytest.raises(NotFoundError):
+        op.endpoint_group_bindings.get("default", "b")
+
+
+def test_watch_stream_order():
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    q = kube.services.watch()
+    svc = kube.services.create(make_service("a"))
+    svc.metadata.annotations["k"] = "v"
+    kube.services.update(svc)
+    kube.services.delete("default", "a")
+    types = [q.get(timeout=1).type for _ in range(3)]
+    assert types == [WATCH_ADDED, WATCH_MODIFIED, WATCH_DELETED]
+
+
+def test_event_recorder():
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    svc = kube.services.create(make_service("a"))
+    rec = kube.event_recorder("test-controller")
+    rec.eventf(svc, "Normal", "Created", "created %s", "thing")
+    events = kube.list_events()
+    assert len(events) == 1
+    assert events[0].reason == "Created"
+    assert events[0].message == "created thing"
+    assert events[0].involved_object_key == "default/a"

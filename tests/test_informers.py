@@ -1,0 +1,104 @@
+"""Informer cache/handler/resync tests."""
+import threading
+import time
+
+from aws_global_accelerator_controller_tpu.kube.apiserver import FakeAPIServer
+from aws_global_accelerator_controller_tpu.kube.client import KubeClient
+from aws_global_accelerator_controller_tpu.kube.informers import (
+    SharedInformerFactory,
+    wait_for_cache_sync,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    ObjectMeta,
+    Service,
+    ServiceSpec,
+)
+
+
+def make_service(name, ns="default"):
+    return Service(metadata=ObjectMeta(name=name, namespace=ns),
+                   spec=ServiceSpec(type="LoadBalancer"))
+
+
+def wait_until(pred, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_initial_list_fires_adds_and_syncs():
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    kube.services.create(make_service("pre1"))
+    kube.services.create(make_service("pre2"))
+
+    factory = SharedInformerFactory(api, resync_period=30)
+    informer = factory.services()
+    adds = []
+    informer.add_event_handler(add=lambda o: adds.append(o.metadata.name))
+    stop = threading.Event()
+    factory.start(stop)
+    try:
+        assert wait_for_cache_sync(stop, informer)
+        assert sorted(adds) == ["pre1", "pre2"]
+        assert len(informer.lister.list()) == 2
+    finally:
+        stop.set()
+
+
+def test_watch_events_update_cache_and_handlers():
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    factory = SharedInformerFactory(api, resync_period=30)
+    informer = factory.services()
+    adds, updates, deletes = [], [], []
+    informer.add_event_handler(
+        add=lambda o: adds.append(o.metadata.name),
+        update=lambda old, new: updates.append(
+            (old.metadata.annotations.get("k"), new.metadata.annotations.get("k"))),
+        delete=lambda o: deletes.append(o.metadata.name),
+    )
+    stop = threading.Event()
+    factory.start(stop)
+    try:
+        assert wait_for_cache_sync(stop, informer)
+        svc = kube.services.create(make_service("live"))
+        assert wait_until(lambda: adds == ["live"])
+        svc.metadata.annotations["k"] = "v"
+        kube.services.update(svc)
+        assert wait_until(lambda: (None, "v") in updates)
+        got = informer.lister.get("default", "live")
+        assert got.metadata.annotations.get("k") == "v"
+        kube.services.delete("default", "live")
+        assert wait_until(lambda: deletes == ["live"])
+        assert informer.lister.list() == []
+    finally:
+        stop.set()
+
+
+def test_resync_redelivers_updates():
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    kube.services.create(make_service("r"))
+    factory = SharedInformerFactory(api, resync_period=0.1)
+    informer = factory.services()
+    updates = []
+    informer.add_event_handler(update=lambda old, new: updates.append(new.metadata.name))
+    stop = threading.Event()
+    factory.start(stop)
+    try:
+        assert wait_for_cache_sync(stop, informer)
+        assert wait_until(lambda: len(updates) >= 2, timeout=3.0), \
+            "resync should re-deliver cached objects as updates"
+    finally:
+        stop.set()
+
+
+def test_shared_informer_is_shared():
+    api = FakeAPIServer()
+    factory = SharedInformerFactory(api)
+    assert factory.services() is factory.services()
+    assert factory.ingresses() is not factory.services()
