@@ -1,0 +1,174 @@
+"""GA diff-helper tables (reference pkg/cloudprovider/aws/global_accelerator_test.go)."""
+import pytest
+
+from aws_global_accelerator_controller_tpu.apis import (
+    ALB_LISTEN_PORTS_ANNOTATION,
+    AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION,
+    AWS_GLOBAL_ACCELERATOR_TAGS_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.helpers import (
+    accelerator_name,
+    accelerator_owner_tag_value,
+    accelerator_tags_from_annotations,
+    endpoint_contains_lb,
+    listener_for_ingress,
+    listener_for_service,
+    listener_port_changed_from_service,
+    listener_protocol_changed_from_service,
+    tags_contains_all_values,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+    EndpointDescription,
+    EndpointGroup,
+    Listener,
+    LoadBalancer,
+    PortRange,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    HTTPIngressPath,
+    HTTPIngressRuleValue,
+    Ingress,
+    IngressBackend,
+    IngressRule,
+    IngressServiceBackend,
+    IngressServiceBackendPort,
+    IngressSpec,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+
+
+def make_service(ports, annotations=None):
+    return Service(
+        metadata=ObjectMeta(name="svc", namespace="ns",
+                            annotations=annotations or {}),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=p, protocol=proto)
+                                for p, proto in ports]))
+
+
+def make_listener(ports, protocol="TCP"):
+    return Listener(listener_arn="arn:l",
+                    port_ranges=[PortRange(p, p) for p in ports],
+                    protocol=protocol)
+
+
+# -- listener_for_service / port diff (global_accelerator_test.go:15-489) --
+
+def test_listener_for_service_tcp():
+    ports, protocol = listener_for_service(make_service([(80, "TCP"), (443, "TCP")]))
+    assert ports == [80, 443]
+    assert protocol == "TCP"
+
+
+def test_listener_for_service_udp_wins_when_last():
+    ports, protocol = listener_for_service(make_service([(53, "TCP"), (53, "UDP")]))
+    assert protocol == "UDP"
+
+
+@pytest.mark.parametrize("listener_ports,svc_ports,changed", [
+    ([80, 443], [80, 443], False),
+    ([80], [80, 443], True),
+    ([80, 443], [80], True),
+    ([80, 443], [80, 8443], True),
+    ([], [80], True),
+])
+def test_listener_port_changed_from_service(listener_ports, svc_ports, changed):
+    listener = make_listener(listener_ports)
+    svc = make_service([(p, "TCP") for p in svc_ports])
+    assert listener_port_changed_from_service(listener, svc) is changed
+
+
+def test_listener_protocol_changed_from_service():
+    svc = make_service([(53, "UDP")])
+    assert listener_protocol_changed_from_service(make_listener([53], "TCP"), svc)
+    assert not listener_protocol_changed_from_service(make_listener([53], "UDP"), svc)
+
+
+# -- listener_for_ingress ---------------------------------------------------
+
+def make_ingress(annotations=None, default_port=None, rule_ports=()):
+    default_backend = None
+    if default_port is not None:
+        default_backend = IngressBackend(service=IngressServiceBackend(
+            name="d", port=IngressServiceBackendPort(number=default_port)))
+    rules = []
+    if rule_ports:
+        rules = [IngressRule(http=HTTPIngressRuleValue(paths=[
+            HTTPIngressPath(backend=IngressBackend(
+                service=IngressServiceBackend(
+                    name="b", port=IngressServiceBackendPort(number=p))))
+            for p in rule_ports]))]
+    return Ingress(metadata=ObjectMeta(name="ing", namespace="ns",
+                                       annotations=annotations or {}),
+                   spec=IngressSpec(default_backend=default_backend,
+                                    rules=rules))
+
+
+def test_listener_for_ingress_listen_ports_annotation():
+    ing = make_ingress(annotations={
+        ALB_LISTEN_PORTS_ANNOTATION: '[{"HTTP": 80}, {"HTTPS": 443}]'})
+    ports, protocol = listener_for_ingress(ing)
+    assert ports == [80, 443]
+    assert protocol == "TCP"
+
+
+def test_listener_for_ingress_annotation_overrides_rules():
+    ing = make_ingress(annotations={
+        ALB_LISTEN_PORTS_ANNOTATION: '[{"HTTPS": 443}]'},
+        default_port=8080, rule_ports=(3000,))
+    ports, _ = listener_for_ingress(ing)
+    assert ports == [443]
+
+
+def test_listener_for_ingress_bad_annotation_json():
+    ing = make_ingress(annotations={ALB_LISTEN_PORTS_ANNOTATION: "not json"})
+    ports, _ = listener_for_ingress(ing)
+    assert ports == []
+
+
+def test_listener_for_ingress_backend_ports():
+    ing = make_ingress(default_port=8080, rule_ports=(3000, 3001))
+    ports, _ = listener_for_ingress(ing)
+    assert ports == [8080, 3000, 3001]
+
+
+# -- naming / tags ----------------------------------------------------------
+
+def test_accelerator_name_default_and_annotation():
+    svc = make_service([(80, "TCP")])
+    assert accelerator_name("service", svc) == "service-ns-svc"
+    svc2 = make_service([(80, "TCP")], annotations={
+        AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION: "custom"})
+    assert accelerator_name("service", svc2) == "custom"
+
+
+def test_accelerator_tags_parsing_skips_malformed():
+    svc = make_service([(80, "TCP")], annotations={
+        AWS_GLOBAL_ACCELERATOR_TAGS_ANNOTATION: "a=1,bad,b=2,=,c=3"})
+    assert accelerator_tags_from_annotations(svc) == {
+        "a": "1", "b": "2", "": "", "c": "3"}
+
+
+def test_owner_tag_value():
+    assert accelerator_owner_tag_value("service", "ns", "n") == "service/ns/n"
+
+
+def test_tags_contains_all_values():
+    tags = {"a": "1", "b": "2", "c": "3"}
+    assert tags_contains_all_values(tags, {"a": "1", "b": "2"})
+    assert not tags_contains_all_values(tags, {"a": "1", "x": "9"})
+    assert not tags_contains_all_values(tags, {"a": "wrong"})
+    assert tags_contains_all_values(tags, {})
+
+
+def test_endpoint_contains_lb():
+    lb = LoadBalancer(load_balancer_arn="arn:lb1", load_balancer_name="l",
+                      dns_name="d")
+    eg = EndpointGroup(endpoint_group_arn="arn:eg",
+                       endpoint_descriptions=[EndpointDescription("arn:lb1")])
+    assert endpoint_contains_lb(eg, lb)
+    eg2 = EndpointGroup(endpoint_group_arn="arn:eg")
+    assert not endpoint_contains_lb(eg2, lb)

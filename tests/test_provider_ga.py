@@ -1,0 +1,261 @@
+"""AWSProvider Global Accelerator state machine against the fake cloud.
+
+Coverage the reference never had (SURVEY.md §4: AWS-touching logic only
+covered by live-AWS e2e): ensure create/update/cleanup chains, ownership
+discovery, partial-failure rollback, LB-not-active retry, the
+disable->poll->delete dance.
+"""
+import pytest
+
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION,
+    AWS_GLOBAL_ACCELERATOR_TAGS_ANNOTATION,
+    CLIENT_IP_PRESERVATION_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
+    FakeCloudFactory,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.helpers import (
+    CLUSTER_TAG_KEY,
+    MANAGED_TAG_KEY,
+    OWNER_TAG_KEY,
+    TARGET_HOSTNAME_TAG_KEY,
+)
+from aws_global_accelerator_controller_tpu.errors import AWSAPIError
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+
+HOSTNAME = "mylb-0123456789abcdef.elb.ap-northeast-1.amazonaws.com"
+REGION = "ap-northeast-1"
+CLUSTER = "test-cluster"
+
+
+@pytest.fixture
+def factory():
+    return FakeCloudFactory(settle_seconds=0.0)
+
+
+@pytest.fixture
+def provider(factory):
+    return factory.provider_for(REGION)
+
+
+def register_lb(factory, name="mylb", dns=HOSTNAME, state="active"):
+    return factory.cloud.elb.register_load_balancer(
+        name, dns, REGION, state=state)
+
+
+def make_service(annotations=None, ports=((80, "TCP"), (443, "TCP"))):
+    return Service(
+        metadata=ObjectMeta(name="app", namespace="default",
+                            annotations=annotations or {}),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=p, protocol=proto)
+                                for p, proto in ports]),
+    )
+
+
+def lb_ingress():
+    return LoadBalancerIngress(hostname=HOSTNAME)
+
+
+def test_ensure_creates_full_chain(factory, provider):
+    lb = register_lb(factory)
+    svc = make_service()
+    arn, created, retry = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    assert created and retry == 0 and arn
+
+    tags = factory.cloud.ga.list_tags_for_resource(arn)
+    assert tags[MANAGED_TAG_KEY] == "true"
+    assert tags[OWNER_TAG_KEY] == "service/default/app"
+    assert tags[TARGET_HOSTNAME_TAG_KEY] == HOSTNAME
+    assert tags[CLUSTER_TAG_KEY] == CLUSTER
+
+    listener = provider.get_listener(arn)
+    assert sorted(p.from_port for p in listener.port_ranges) == [80, 443]
+    assert listener.protocol == "TCP"
+    eg = provider.get_endpoint_group(listener.listener_arn)
+    assert eg.endpoint_group_region == REGION
+    assert eg.endpoint_descriptions[0].endpoint_id == lb.load_balancer_arn
+
+    acc = factory.cloud.ga.describe_accelerator(arn)
+    assert acc.name == "service-default-app"
+
+
+def test_ensure_is_idempotent(factory, provider):
+    register_lb(factory)
+    svc = make_service()
+    arn1, created1, _ = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    arn2, created2, _ = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    assert created1 and not created2
+    assert arn1 == arn2
+    assert len(factory.cloud.ga.list_accelerators()) == 1
+
+
+def test_lb_not_active_returns_retry(factory, provider):
+    register_lb(factory, state="provisioning")
+    svc = make_service()
+    arn, created, retry = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    assert arn is None and not created and retry == 30.0
+    assert factory.cloud.ga.list_accelerators() == []
+
+
+def test_dns_mismatch_errors(factory, provider):
+    register_lb(factory, dns="other.elb.ap-northeast-1.amazonaws.com")
+    with pytest.raises(AWSAPIError, match="DNS name is not matched"):
+        provider.ensure_global_accelerator_for_service(
+            make_service(), lb_ingress(), CLUSTER, "mylb", REGION)
+
+
+def test_partial_create_failure_rolls_back(factory, provider):
+    register_lb(factory)
+    factory.cloud.faults.fail_on(
+        "create_endpoint_group", AWSAPIError("Internal", "boom"))
+    with pytest.raises(AWSAPIError, match="boom"):
+        provider.ensure_global_accelerator_for_service(
+            make_service(), lb_ingress(), CLUSTER, "mylb", REGION)
+    assert factory.cloud.ga.list_accelerators() == [], \
+        "partially created accelerator must be rolled back"
+
+
+def test_update_resyncs_ports(factory, provider):
+    register_lb(factory)
+    svc = make_service(ports=((80, "TCP"),))
+    arn, _, _ = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    svc2 = make_service(ports=((80, "TCP"), (8443, "TCP")))
+    provider.ensure_global_accelerator_for_service(
+        svc2, lb_ingress(), CLUSTER, "mylb", REGION)
+    listener = provider.get_listener(arn)
+    assert sorted(p.from_port for p in listener.port_ranges) == [80, 8443]
+
+
+def test_update_resyncs_name_and_tags(factory, provider):
+    register_lb(factory)
+    svc = make_service()
+    arn, _, _ = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    svc2 = make_service(annotations={
+        AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION: "renamed",
+        AWS_GLOBAL_ACCELERATOR_TAGS_ANNOTATION: "team=infra"})
+    provider.ensure_global_accelerator_for_service(
+        svc2, lb_ingress(), CLUSTER, "mylb", REGION)
+    acc = factory.cloud.ga.describe_accelerator(arn)
+    assert acc.name == "renamed"
+    tags = factory.cloud.ga.list_tags_for_resource(arn)
+    assert tags["team"] == "infra"
+    assert tags[CLUSTER_TAG_KEY] == CLUSTER, \
+        "cluster tag must survive update (TagResource merges)"
+
+
+def test_update_reenables_disabled_accelerator(factory, provider):
+    register_lb(factory)
+    svc = make_service()
+    arn, _, _ = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    factory.cloud.ga.update_accelerator(arn, enabled=False)
+    provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    assert factory.cloud.ga.describe_accelerator(arn).enabled
+
+
+def test_update_restores_endpoint_membership(factory, provider):
+    lb = register_lb(factory)
+    svc = make_service(annotations={CLIENT_IP_PRESERVATION_ANNOTATION: "true"})
+    arn, _, _ = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    listener = provider.get_listener(arn)
+    eg = provider.get_endpoint_group(listener.listener_arn)
+    factory.cloud.ga.remove_endpoints(
+        eg.endpoint_group_arn, [lb.load_balancer_arn])
+    provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    eg = provider.get_endpoint_group(listener.listener_arn)
+    assert [d.endpoint_id for d in eg.endpoint_descriptions] == [
+        lb.load_balancer_arn]
+    assert eg.endpoint_descriptions[0].client_ip_preservation_enabled
+
+
+def test_list_by_resource_and_hostname(factory, provider):
+    register_lb(factory)
+    svc = make_service()
+    arn, _, _ = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    by_res = provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", "app")
+    assert [a.accelerator_arn for a in by_res] == [arn]
+    by_host = provider.list_global_accelerator_by_hostname(HOSTNAME, CLUSTER)
+    assert [a.accelerator_arn for a in by_host] == [arn]
+    assert provider.list_global_accelerator_by_resource(
+        "other-cluster", "service", "default", "app") == []
+    assert provider.list_global_accelerator_by_hostname(
+        "other-host", CLUSTER) == []
+
+
+def test_cleanup_deletes_chain_with_disable_poll():
+    factory = FakeCloudFactory(settle_seconds=0.05)
+    provider = factory.provider_for(REGION)
+    register_lb(factory)
+    svc = make_service()
+    arn, _, _ = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    provider.cleanup_global_accelerator(arn)
+    assert factory.cloud.ga.list_accelerators() == []
+
+
+def test_cleanup_nonexistent_is_noop(factory, provider):
+    provider.cleanup_global_accelerator("arn:aws:globalaccelerator::1:accelerator/nope")
+
+
+def test_endpoint_membership_for_binding_controller(factory, provider):
+    lb = register_lb(factory)
+    svc = make_service()
+    arn, _, _ = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    listener = provider.get_listener(arn)
+    eg = provider.get_endpoint_group(listener.listener_arn)
+
+    lb2 = factory.cloud.elb.register_load_balancer(
+        "second", "second-0123456789abcdef.elb.us-east-1.amazonaws.com",
+        "us-east-1")
+    endpoint_id, retry = provider.add_lb_to_endpoint_group(
+        eg, "second", False, 64)
+    assert retry == 0 and endpoint_id == lb2.load_balancer_arn
+    eg = provider.describe_endpoint_group(eg.endpoint_group_arn)
+    weights = {d.endpoint_id: d.weight for d in eg.endpoint_descriptions}
+    assert weights[lb2.load_balancer_arn] == 64
+
+    provider.update_endpoint_weight(eg, endpoint_id, 12)
+    eg = provider.describe_endpoint_group(eg.endpoint_group_arn)
+    weights = {d.endpoint_id: d.weight for d in eg.endpoint_descriptions}
+    assert weights[endpoint_id] == 12
+    assert lb.load_balancer_arn in weights, \
+        "weight update must not clobber sibling endpoints"
+
+    provider.remove_lb_from_endpoint_group(eg, endpoint_id)
+    eg = provider.describe_endpoint_group(eg.endpoint_group_arn)
+    assert all(d.endpoint_id != endpoint_id
+               for d in eg.endpoint_descriptions)
+
+
+def test_add_lb_not_active_retries(factory, provider):
+    register_lb(factory)
+    svc = make_service()
+    arn, _, _ = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress(), CLUSTER, "mylb", REGION)
+    eg = provider.get_endpoint_group(provider.get_listener(arn).listener_arn)
+    factory.cloud.elb.register_load_balancer(
+        "slow", "slow-0123456789abcdef.elb.us-east-1.amazonaws.com",
+        "us-east-1", state="provisioning")
+    endpoint_id, retry = provider.add_lb_to_endpoint_group(
+        eg, "slow", False, None)
+    assert endpoint_id is None and retry == 30.0

@@ -1,0 +1,182 @@
+"""AWSProvider Route53 logic against the fake cloud."""
+import pytest
+
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
+    FakeCloudFactory,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.helpers import (
+    route53_owner_value,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+    GLOBAL_ACCELERATOR_HOSTED_ZONE_ID,
+)
+from aws_global_accelerator_controller_tpu.errors import AWSAPIError
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+
+HOSTNAME = "mylb-0123456789abcdef.elb.ap-northeast-1.amazonaws.com"
+REGION = "ap-northeast-1"
+CLUSTER = "test-cluster"
+
+
+@pytest.fixture
+def factory():
+    return FakeCloudFactory(settle_seconds=0.0)
+
+
+@pytest.fixture
+def provider(factory):
+    return factory.provider_for(REGION)
+
+
+def make_service():
+    return Service(metadata=ObjectMeta(name="app", namespace="default"),
+                   spec=ServiceSpec(type="LoadBalancer",
+                                    ports=[ServicePort(port=80)]))
+
+
+def setup_accelerator(factory, provider):
+    factory.cloud.elb.register_load_balancer("mylb", HOSTNAME, REGION)
+    arn, _, _ = provider.ensure_global_accelerator_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        CLUSTER, "mylb", REGION)
+    return arn
+
+
+def record_map(factory, zone_id):
+    return {(r.name, r.type): r
+            for r in factory.cloud.route53.list_resource_record_sets(zone_id)}
+
+
+def test_ensure_creates_alias_and_txt(factory, provider):
+    arn = setup_accelerator(factory, provider)
+    zone = factory.cloud.route53.create_hosted_zone("example.com")
+    created, retry = provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER)
+    assert created and retry == 0
+    records = record_map(factory, zone.id)
+    a = records[("www.example.com.", "A")]
+    assert a.alias_target.hosted_zone_id == GLOBAL_ACCELERATOR_HOSTED_ZONE_ID
+    acc = factory.cloud.ga.describe_accelerator(arn)
+    assert a.alias_target.dns_name == acc.dns_name
+    txt = records[("www.example.com.", "TXT")]
+    assert txt.ttl == 300
+    assert txt.resource_records[0].value == route53_owner_value(
+        CLUSTER, "service", "default", "app")
+
+
+def test_ensure_without_accelerator_retries_1m(factory, provider):
+    factory.cloud.route53.create_hosted_zone("example.com")
+    created, retry = provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER)
+    assert not created and retry == 60.0
+
+
+def test_ensure_multiple_hostnames_and_idempotency(factory, provider):
+    setup_accelerator(factory, provider)
+    zone = factory.cloud.route53.create_hosted_zone("example.com")
+    hostnames = ["a.example.com", "b.example.com"]
+    created, _ = provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        hostnames, CLUSTER)
+    assert created
+    created2, _ = provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        hostnames, CLUSTER)
+    assert not created2, "second ensure must be a no-op"
+    records = record_map(factory, zone.id)
+    assert ("a.example.com.", "A") in records
+    assert ("b.example.com.", "A") in records
+    assert len(records) == 4
+
+
+def test_ensure_repairs_alias_drift(factory, provider):
+    arn = setup_accelerator(factory, provider)
+    zone = factory.cloud.route53.create_hosted_zone("example.com")
+    provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER)
+    # drift the alias
+    records = record_map(factory, zone.id)
+    a = records[("www.example.com.", "A")]
+    a.alias_target.dns_name = "stale.awsglobalaccelerator.com"
+    factory.cloud.route53.change_resource_record_sets(zone.id, "UPSERT", a)
+    provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER)
+    acc = factory.cloud.ga.describe_accelerator(arn)
+    a = record_map(factory, zone.id)[("www.example.com.", "A")]
+    assert a.alias_target.dns_name == acc.dns_name
+
+
+def test_hosted_zone_parent_walk(factory, provider):
+    setup_accelerator(factory, provider)
+    zone = factory.cloud.route53.create_hosted_zone("example.com")
+    provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["deep.sub.example.com"], CLUSTER)
+    assert ("deep.sub.example.com.", "A") in record_map(factory, zone.id)
+
+
+def test_hosted_zone_prefers_most_specific(factory, provider):
+    setup_accelerator(factory, provider)
+    factory.cloud.route53.create_hosted_zone("example.com")
+    sub = factory.cloud.route53.create_hosted_zone("sub.example.com")
+    provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.sub.example.com"], CLUSTER)
+    assert ("www.sub.example.com.", "A") in record_map(factory, sub.id)
+
+
+def test_no_hosted_zone_errors(factory, provider):
+    setup_accelerator(factory, provider)
+    with pytest.raises(AWSAPIError, match="Could not find hosted zone"):
+        provider.ensure_route53_for_service(
+            make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+            ["www.nowhere.net"], CLUSTER)
+
+
+def test_wildcard_hostname_roundtrip(factory, provider):
+    setup_accelerator(factory, provider)
+    zone = factory.cloud.route53.create_hosted_zone("example.com")
+    provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["*.example.com"], CLUSTER)
+    records = record_map(factory, zone.id)
+    assert ("\\052.example.com.", "A") in records
+    # idempotent despite the octal escape
+    created2, _ = provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["*.example.com"], CLUSTER)
+    assert not created2
+
+
+def test_cleanup_removes_only_owned_records(factory, provider):
+    setup_accelerator(factory, provider)
+    zone = factory.cloud.route53.create_hosted_zone("example.com")
+    provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER)
+    # a foreign record that must survive
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+        AliasTarget,
+        ResourceRecordSet,
+    )
+    factory.cloud.route53.change_resource_record_sets(
+        zone.id, "CREATE",
+        ResourceRecordSet(name="other.example.com", type="A",
+                          alias_target=AliasTarget(
+                              dns_name="elsewhere.example.net",
+                              hosted_zone_id="Z1")))
+    provider.cleanup_record_set(CLUSTER, "service", "default", "app")
+    records = record_map(factory, zone.id)
+    assert ("www.example.com.", "A") not in records
+    assert ("www.example.com.", "TXT") not in records
+    assert ("other.example.com.", "A") in records

@@ -1,0 +1,64 @@
+"""Route53 helper tables (reference pkg/cloudprovider/aws/route53_test.go:12-183)."""
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.helpers import (
+    find_a_record,
+    need_records_update,
+    parent_domain,
+    replace_wildcards,
+    route53_owner_value,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+    Accelerator,
+    AliasTarget,
+    ResourceRecordSet,
+)
+
+
+def test_owner_value_format():
+    assert route53_owner_value("prod", "service", "ns", "name") == (
+        '"heritage=aws-global-accelerator-controller,cluster=prod,'
+        'service/ns/name"')
+
+
+def test_replace_wildcards():
+    assert replace_wildcards("\\052.example.com.") == "*.example.com."
+    assert replace_wildcards("www.example.com.") == "www.example.com."
+
+
+def test_parent_domain_walk():
+    assert parent_domain("a.b.example.com") == "b.example.com"
+    assert parent_domain("example.com") == "com"
+    assert parent_domain("com") == ""
+
+
+def a_record(name, alias_dns=None):
+    return ResourceRecordSet(
+        name=name, type="A",
+        alias_target=AliasTarget(dns_name=alias_dns, hosted_zone_id="Z")
+        if alias_dns else None)
+
+
+def test_find_a_record_exact():
+    records = [a_record("www.example.com.", "x.awsglobalaccelerator.com")]
+    assert find_a_record(records, "www.example.com") is records[0]
+    assert find_a_record(records, "other.example.com") is None
+
+
+def test_find_a_record_wildcard():
+    records = [a_record("\\052.example.com.", "x.awsglobalaccelerator.com")]
+    assert find_a_record(records, "*.example.com") is records[0]
+
+
+def test_find_a_record_ignores_txt():
+    txt = ResourceRecordSet(name="www.example.com.", type="TXT")
+    assert find_a_record([txt], "www.example.com") is None
+
+
+def test_need_records_update():
+    acc = Accelerator(accelerator_arn="arn",
+                      dns_name="abcd.awsglobalaccelerator.com")
+    match = a_record("w.example.com.", "abcd.awsglobalaccelerator.com.")
+    assert not need_records_update(match, acc)
+    drift = a_record("w.example.com.", "other.awsglobalaccelerator.com.")
+    assert need_records_update(drift, acc)
+    no_alias = a_record("w.example.com.")
+    assert need_records_update(no_alias, acc)
