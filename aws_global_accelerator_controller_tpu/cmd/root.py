@@ -1,0 +1,147 @@
+"""CLI: aws-global-accelerator-controller-tpu {controller|webhook|version}.
+
+Mirrors the reference's cobra command tree (cmd/root.go:13-30,
+cmd/controller/controller.go:24-98, cmd/webhook/webhook.go:17-41,
+cmd/version.go:15-26) with argparse.
+
+Because the ``kubernetes`` package is not available in this environment,
+``controller`` runs against the in-process fake API server (``--fake``,
+default) -- the real-cluster backend is the documented extension point.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from .. import BUILD, REVISION, VERSION
+from ..cloudprovider.aws.factory import BotoCloudFactory, FakeCloudFactory
+from ..controller.endpointgroupbinding import EndpointGroupBindingConfig
+from ..controller.globalaccelerator import GlobalAcceleratorConfig
+from ..controller.route53 import Route53Config
+from ..kube.apiserver import FakeAPIServer
+from ..kube.client import KubeClient, OperatorClient
+from ..leaderelection import LeaderElection
+from ..manager import ControllerConfig, Manager
+from ..signals import setup_signal_handler
+from ..webhook import WebhookServer
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="aws-global-accelerator-controller-tpu",
+        description=("Manage AWS Global Accelerator and Route53 from "
+                     "Kubernetes"))
+    parser.add_argument("-v", "--verbosity", type=int, default=1,
+                        help="Log verbosity (klog-style; >=4 is debug).")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    controller = sub.add_parser("controller", help="Start controller")
+    controller.add_argument("-w", "--workers", type=int, default=1,
+                            help="Concurrent workers number for controller.")
+    controller.add_argument("-c", "--cluster-name", default="default",
+                            help="Owner cluster name used in resource tags.")
+    controller.add_argument("--kubeconfig", default="",
+                            help="Path to a kubeconfig (out-of-cluster).")
+    controller.add_argument("--master", default="",
+                            help="Kubernetes API server address override.")
+    controller.add_argument("--fake", action="store_true", default=True,
+                            help="Run against the in-process fake API "
+                                 "server and fake AWS cloud (default: the "
+                                 "kubernetes package is unavailable here).")
+    controller.add_argument("--leader-elect", action="store_true",
+                            default=True,
+                            help="Run under Lease-based leader election.")
+
+    webhook = sub.add_parser("webhook", help="Start webhook server")
+    webhook.add_argument("--tls-cert-file", default="",
+                         help="x509 certificate for HTTPS.")
+    webhook.add_argument("--tls-private-key-file", default="",
+                         help="x509 private key for --tls-cert-file.")
+    webhook.add_argument("--port", type=int, default=8443,
+                         help="Webhook server port.")
+    ssl_group = webhook.add_mutually_exclusive_group()
+    ssl_group.add_argument("--ssl", dest="ssl", action="store_true",
+                           default=True, help="Serve over TLS (default).")
+    ssl_group.add_argument("--no-ssl", dest="ssl", action="store_false",
+                           help="Serve plain HTTP.")
+
+    sub.add_parser("version", help="Print the version number")
+    return parser
+
+
+def run_controller(args) -> int:
+    stop = setup_signal_handler()
+
+    if args.fake:
+        api = FakeAPIServer()
+        kube = KubeClient(api)
+        operator = OperatorClient(api)
+        cloud_factory = FakeCloudFactory()
+    else:  # pragma: no cover - needs the kubernetes package + a cluster
+        raise SystemExit(
+            "real-cluster mode requires the kubernetes package, which is "
+            "not available in this environment")
+
+    config = ControllerConfig(
+        global_accelerator=GlobalAcceleratorConfig(
+            workers=args.workers, cluster_name=args.cluster_name),
+        route53=Route53Config(
+            workers=args.workers, cluster_name=args.cluster_name),
+        endpoint_group_binding=EndpointGroupBindingConfig(
+            workers=args.workers),
+    )
+
+    namespace = os.environ.get("POD_NAMESPACE", "default")
+
+    def run_manager(leader_stop):
+        Manager().run(kube, operator, cloud_factory, config, leader_stop)
+
+    if args.leader_elect:
+        le = LeaderElection("aws-global-accelerator-controller", namespace,
+                            kube)
+        le.run(stop, on_started_leading=run_manager,
+               on_stopped_leading=lambda: os._exit(0))
+    else:
+        run_manager(stop)
+    return 0
+
+
+def run_webhook(args) -> int:
+    if args.ssl and (not args.tls_cert_file or not args.tls_private_key_file):
+        print("You must set --tls-cert-file and --tls-private-key-file "
+              "when you use SSL", file=sys.stderr)
+        return 2
+    server = WebhookServer(
+        port=args.port,
+        tls_cert_file=args.tls_cert_file if args.ssl else "",
+        tls_key_file=args.tls_private_key_file if args.ssl else "")
+    stop = setup_signal_handler()
+    server.start_background()
+    stop.wait()
+    server.shutdown()
+    return 0
+
+
+def run_version(args) -> int:
+    print(f"Version : {VERSION}")
+    print(f"Revision: {REVISION}")
+    print(f"Build   : {BUILD}")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbosity >= 4 else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    if args.command == "controller":
+        return run_controller(args)
+    if args.command == "webhook":
+        return run_webhook(args)
+    if args.command == "version":
+        return run_version(args)
+    return 2

@@ -1,0 +1,74 @@
+"""Shared e2e harness: full manager over fake API server + fake AWS.
+
+The re-target of the reference's live-AWS convergence assertions
+(local_e2e/e2e_test.go:257-385) at the fake provider, as SURVEY.md §7's
+minimum end-to-end slice prescribes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
+    FakeCloudFactory,
+)
+from aws_global_accelerator_controller_tpu.controller.endpointgroupbinding import (
+    EndpointGroupBindingConfig,
+)
+from aws_global_accelerator_controller_tpu.controller.globalaccelerator import (
+    GlobalAcceleratorConfig,
+)
+from aws_global_accelerator_controller_tpu.controller.route53 import Route53Config
+from aws_global_accelerator_controller_tpu.kube.apiserver import FakeAPIServer
+from aws_global_accelerator_controller_tpu.kube.client import (
+    KubeClient,
+    OperatorClient,
+)
+from aws_global_accelerator_controller_tpu.manager import (
+    ControllerConfig,
+    Manager,
+)
+
+CLUSTER = "e2e-cluster"
+
+
+class Cluster:
+    """A running control plane: 3 controllers + informers over fakes."""
+
+    def __init__(self, workers: int = 1, resync_period: float = 30.0,
+                 settle_seconds: float = 0.0):
+        self.api = FakeAPIServer()
+        self.kube = KubeClient(self.api)
+        self.operator = OperatorClient(self.api)
+        self.factory = FakeCloudFactory(settle_seconds=settle_seconds)
+        self.cloud = self.factory.cloud
+        self.stop = threading.Event()
+        self._manager = Manager(resync_period=resync_period)
+        self._config = ControllerConfig(
+            global_accelerator=GlobalAcceleratorConfig(
+                workers=workers, cluster_name=CLUSTER),
+            route53=Route53Config(workers=workers, cluster_name=CLUSTER),
+            endpoint_group_binding=EndpointGroupBindingConfig(
+                workers=workers),
+        )
+
+    def start(self):
+        self._manager.run(self.kube, self.operator, self.factory,
+                          self._config, self.stop, block=False)
+        return self
+
+    def shutdown(self):
+        self.stop.set()
+
+
+def wait_until(pred, timeout: float = 8.0, interval: float = 0.02,
+               message: str = "condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if pred():
+                return
+        except Exception:
+            pass
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")

@@ -1,0 +1,36 @@
+"""CLI tests (reference cmd/: root/controller/webhook/version commands)."""
+import subprocess
+import sys
+
+
+def run_cli(*args, timeout=30):
+    return subprocess.run(
+        [sys.executable, "-m", "aws_global_accelerator_controller_tpu",
+         *args],
+        capture_output=True, text=True, timeout=timeout)
+
+
+def test_version():
+    res = run_cli("version")
+    assert res.returncode == 0
+    assert "Version" in res.stdout
+    assert "Revision" in res.stdout
+    assert "Build" in res.stdout
+
+
+def test_help_lists_subcommands():
+    res = run_cli("--help")
+    assert res.returncode == 0
+    for sub in ("controller", "webhook", "version"):
+        assert sub in res.stdout
+
+
+def test_webhook_requires_tls_files_with_ssl():
+    res = run_cli("webhook", "--ssl")
+    assert res.returncode == 2
+    assert "tls-cert-file" in res.stderr
+
+
+def test_no_subcommand_errors():
+    res = run_cli()
+    assert res.returncode != 0

@@ -1,0 +1,219 @@
+"""End-to-end: EndpointGroupBinding controller finalizer lifecycle.
+
+Covers the reference flows of pkg/controller/endpointgroupbinding/
+reconcile.go end to end: finalizer add, endpoint add/remove diffs, weight
+sync, observedGeneration bookkeeping, and finalizer-gated deletion --
+including multi-endpoint drain, where the reference has the index-shifting
+bug SURVEY.md §7 says not to copy.
+"""
+import pytest
+
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    IngressReference,
+    ServiceReference,
+)
+from aws_global_accelerator_controller_tpu.controller.endpointgroupbinding import (
+    FINALIZER,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+
+from harness import Cluster, wait_until
+
+NLB1 = "one-0123456789abcdef.elb.ap-northeast-1.amazonaws.com"
+NLB2 = "two-0123456789abcdef.elb.us-east-1.amazonaws.com"
+REGION = "ap-northeast-1"
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster().start()
+    yield c
+    c.shutdown()
+
+
+def make_endpoint_group(cluster):
+    """Create an accelerator chain directly in the fake cloud (as if made
+    out-of-band, the binding controller's normal situation)."""
+    ga = cluster.cloud.ga
+    acc = ga.create_accelerator("ext", "IPV4", True, {})
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+        PortRange,
+    )
+    listener = ga.create_listener(acc.accelerator_arn, [PortRange(80, 80)],
+                                  "TCP", "NONE")
+    seed_lb = cluster.cloud.elb.register_load_balancer(
+        "seed", "seed-0123456789abcdef.elb.eu-west-1.amazonaws.com",
+        "eu-west-1")
+    eg = ga.create_endpoint_group(listener.listener_arn, "eu-west-1",
+                                  seed_lb.load_balancer_arn, False)
+    return eg
+
+
+def lb_service(name="app", hostnames=(NLB1,)):
+    return Service(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            annotations={AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external"}),
+        spec=ServiceSpec(type="LoadBalancer", ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=h) for h in hostnames])),
+    )
+
+
+def make_binding(eg, weight=None, service="app", ip_preserve=False):
+    return EndpointGroupBinding(
+        metadata=ObjectMeta(name="binding", namespace="default"),
+        spec=EndpointGroupBindingSpec(
+            endpoint_group_arn=eg.endpoint_group_arn,
+            client_ip_preservation=ip_preserve,
+            weight=weight,
+            service_ref=ServiceReference(name=service)))
+
+
+def get_binding(cluster):
+    return cluster.operator.endpoint_group_bindings.get("default", "binding")
+
+
+def eg_endpoints(cluster, eg):
+    got = cluster.cloud.ga.describe_endpoint_group(eg.endpoint_group_arn)
+    return {d.endpoint_id: d for d in got.endpoint_descriptions}
+
+
+def test_binding_lifecycle(cluster):
+    eg = make_endpoint_group(cluster)
+    lb1 = cluster.cloud.elb.register_load_balancer("one", NLB1, REGION)
+    cluster.kube.services.create(lb_service())
+    cluster.operator.endpoint_group_bindings.create(
+        make_binding(eg, weight=64))
+
+    wait_until(lambda: get_binding(cluster).metadata.finalizers == [FINALIZER],
+               message="finalizer added")
+    wait_until(lambda: lb1.load_balancer_arn in eg_endpoints(cluster, eg),
+               message="endpoint added")
+    wait_until(lambda: get_binding(cluster).status.endpoint_ids
+               == [lb1.load_balancer_arn], message="status.endpointIds")
+    assert eg_endpoints(cluster, eg)[lb1.load_balancer_arn].weight == 64
+    wait_until(lambda: get_binding(cluster).status.observed_generation
+               == get_binding(cluster).metadata.generation,
+               message="observedGeneration current")
+
+
+def test_weight_update_propagates(cluster):
+    eg = make_endpoint_group(cluster)
+    lb1 = cluster.cloud.elb.register_load_balancer("one", NLB1, REGION)
+    cluster.kube.services.create(lb_service())
+    cluster.operator.endpoint_group_bindings.create(
+        make_binding(eg, weight=64))
+    wait_until(lambda: lb1.load_balancer_arn in eg_endpoints(cluster, eg),
+               message="endpoint added")
+
+    binding = get_binding(cluster)
+    binding.spec.weight = 7
+    cluster.operator.endpoint_group_bindings.update(binding)
+    wait_until(lambda: eg_endpoints(cluster, eg)
+               [lb1.load_balancer_arn].weight == 7,
+               message="weight propagated")
+    # sibling endpoints survive the weight rewrite
+    assert len(eg_endpoints(cluster, eg)) == 2
+
+
+def test_delete_drains_endpoints_then_clears_finalizer(cluster):
+    eg = make_endpoint_group(cluster)
+    lb1 = cluster.cloud.elb.register_load_balancer("one", NLB1, REGION)
+    cluster.kube.services.create(lb_service())
+    cluster.operator.endpoint_group_bindings.create(make_binding(eg))
+    wait_until(lambda: lb1.load_balancer_arn in eg_endpoints(cluster, eg),
+               message="endpoint added")
+
+    cluster.operator.endpoint_group_bindings.delete("default", "binding")
+    wait_until(lambda: lb1.load_balancer_arn not in eg_endpoints(cluster, eg),
+               message="endpoint drained")
+
+    def gone():
+        try:
+            get_binding(cluster)
+            return False
+        except Exception:
+            return True
+
+    wait_until(gone, message="binding removed after finalizer clear")
+    # the out-of-band seed endpoint must survive
+    assert len(eg_endpoints(cluster, eg)) == 1
+
+
+def test_multi_endpoint_drain_removes_all(cluster):
+    """The reference's reconcileDelete loop has the index-shifting bug
+    (reconcile.go:71-85) that would leave every other endpoint behind;
+    the rebuild must drain all of them."""
+    eg = make_endpoint_group(cluster)
+    lb1 = cluster.cloud.elb.register_load_balancer("one", NLB1, REGION)
+    lb2 = cluster.cloud.elb.register_load_balancer("two", NLB2, "us-east-1")
+    cluster.kube.services.create(lb_service(hostnames=(NLB1, NLB2)))
+    cluster.operator.endpoint_group_bindings.create(make_binding(eg))
+    wait_until(lambda: {lb1.load_balancer_arn, lb2.load_balancer_arn}
+               <= set(eg_endpoints(cluster, eg)),
+               message="both endpoints added")
+
+    cluster.operator.endpoint_group_bindings.delete("default", "binding")
+    wait_until(lambda: {lb1.load_balancer_arn, lb2.load_balancer_arn}
+               .isdisjoint(eg_endpoints(cluster, eg)),
+               message="ALL endpoints drained")
+
+
+def test_delete_with_missing_endpoint_group_clears_finalizer(cluster):
+    eg = make_endpoint_group(cluster)
+    lb1 = cluster.cloud.elb.register_load_balancer("one", NLB1, REGION)
+    cluster.kube.services.create(lb_service())
+    cluster.operator.endpoint_group_bindings.create(make_binding(eg))
+    wait_until(lambda: lb1.load_balancer_arn in eg_endpoints(cluster, eg),
+               message="endpoint added")
+    # the endpoint group disappears out-of-band
+    cluster.cloud.ga.delete_endpoint_group(eg.endpoint_group_arn)
+    cluster.operator.endpoint_group_bindings.delete("default", "binding")
+
+    def gone():
+        try:
+            get_binding(cluster)
+            return False
+        except Exception:
+            return True
+
+    wait_until(gone, message="binding removed despite missing endpoint group")
+
+
+def test_service_lb_change_rediffs_endpoints(cluster):
+    eg = make_endpoint_group(cluster)
+    lb1 = cluster.cloud.elb.register_load_balancer("one", NLB1, REGION)
+    lb2 = cluster.cloud.elb.register_load_balancer("two", NLB2, "us-east-1")
+    cluster.kube.services.create(lb_service(hostnames=(NLB1,)))
+    cluster.operator.endpoint_group_bindings.create(make_binding(eg))
+    wait_until(lambda: lb1.load_balancer_arn in eg_endpoints(cluster, eg),
+               message="first endpoint added")
+
+    svc = cluster.kube.services.get("default", "app")
+    svc.status.load_balancer.ingress = [LoadBalancerIngress(hostname=NLB2)]
+    cluster.kube.services.update(svc)
+    # touch the binding to retrigger (spec change bumps generation)
+    binding = get_binding(cluster)
+    binding.spec.weight = 3
+    cluster.operator.endpoint_group_bindings.update(binding)
+
+    wait_until(lambda: lb2.load_balancer_arn in eg_endpoints(cluster, eg),
+               message="new endpoint added")
+    wait_until(lambda: lb1.load_balancer_arn not in eg_endpoints(cluster, eg),
+               message="old endpoint removed")
+    wait_until(lambda: get_binding(cluster).status.endpoint_ids
+               == [lb2.load_balancer_arn], message="status updated")

@@ -1,0 +1,193 @@
+"""End-to-end: GlobalAccelerator controller over the full stack.
+
+The minimum end-to-end slice (SURVEY.md §7): CLI-level manager ->
+controller -> reconcile -> provider, driven through the fake API server,
+with the convergence assertions of the reference's live-AWS e2e
+(local_e2e/e2e_test.go:257-303) against the fake cloud.
+"""
+import pytest
+
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    INGRESS_CLASS_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    Ingress,
+    IngressSpec,
+    IngressStatus,
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+
+from harness import CLUSTER, Cluster, wait_until
+
+NLB_HOSTNAME = "applb-0123456789abcdef.elb.ap-northeast-1.amazonaws.com"
+ALB_HOSTNAME = "k8s-default-web-f1f41628db-201899272.ap-northeast-1.elb.amazonaws.com"
+REGION = "ap-northeast-1"
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster().start()
+    yield c
+    c.shutdown()
+
+
+def nlb_service(annotations=None, with_status=True):
+    ann = {AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+           AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true"}
+    if annotations is not None:
+        ann = annotations
+    return Service(
+        metadata=ObjectMeta(name="app", namespace="default",
+                            annotations=ann),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80), ServicePort(port=443)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=NLB_HOSTNAME)]
+            if with_status else [])),
+    )
+
+
+def alb_ingress():
+    return Ingress(
+        metadata=ObjectMeta(
+            name="web", namespace="default",
+            annotations={
+                INGRESS_CLASS_ANNOTATION: "alb",
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                "alb.ingress.kubernetes.io/listen-ports":
+                    '[{"HTTP": 80}, {"HTTPS": 443}]',
+            }),
+        spec=IngressSpec(ingress_class_name="alb"),
+        status=IngressStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=ALB_HOSTNAME)])),
+    )
+
+
+def owned_accelerators(cluster, resource="service", ns="default", name="app"):
+    provider = cluster.factory.global_provider()
+    return provider.list_global_accelerator_by_resource(
+        CLUSTER, resource, ns, name)
+
+
+def test_service_create_converges_full_chain(cluster):
+    lb = cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME,
+                                                  REGION)
+    cluster.kube.services.create(nlb_service())
+    wait_until(lambda: len(owned_accelerators(cluster)) == 1,
+               message="accelerator created")
+    provider = cluster.factory.global_provider()
+    acc = owned_accelerators(cluster)[0]
+    listener = provider.get_listener(acc.accelerator_arn)
+    assert sorted(p.from_port for p in listener.port_ranges) == [80, 443]
+    eg = provider.get_endpoint_group(listener.listener_arn)
+    assert eg.endpoint_descriptions[0].endpoint_id == lb.load_balancer_arn
+    # a creation Event was emitted
+    wait_until(lambda: any(e.reason == "GlobalAcceleratorCreated"
+                           for e in cluster.kube.list_events()),
+               message="creation event")
+
+
+def test_service_without_lb_status_is_skipped(cluster):
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION)
+    cluster.kube.services.create(nlb_service(with_status=False))
+    import time
+    time.sleep(0.3)
+    assert cluster.cloud.ga.list_accelerators() == []
+
+
+def test_lb_not_active_retries_until_active(cluster):
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION,
+                                             state="provisioning")
+    cluster.kube.services.create(nlb_service())
+    import time
+    time.sleep(0.3)
+    assert cluster.cloud.ga.list_accelerators() == []
+    # NOTE: the production retry is 30s (BASELINE.md); rather than wait we
+    # re-trigger reconcile via an object update after the LB turns active.
+    cluster.cloud.elb.set_state("applb", "active")
+    svc = cluster.kube.services.get("default", "app")
+    svc.metadata.labels["touch"] = "1"
+    cluster.kube.services.update(svc)
+    wait_until(lambda: len(owned_accelerators(cluster)) == 1,
+               message="accelerator created after LB active")
+
+
+def test_annotation_removal_cleans_up(cluster):
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION)
+    cluster.kube.services.create(nlb_service())
+    wait_until(lambda: len(owned_accelerators(cluster)) == 1,
+               message="accelerator created")
+    svc = cluster.kube.services.get("default", "app")
+    del svc.metadata.annotations[AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION]
+    cluster.kube.services.update(svc)
+    wait_until(lambda: cluster.cloud.ga.list_accelerators() == [],
+               message="accelerator cleaned up after annotation removal")
+    wait_until(lambda: any(e.reason == "GlobalAcceleratorDeleted"
+                           for e in cluster.kube.list_events()),
+               message="deletion event")
+
+
+def test_service_delete_cleans_up(cluster):
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION)
+    cluster.kube.services.create(nlb_service())
+    wait_until(lambda: len(owned_accelerators(cluster)) == 1,
+               message="accelerator created")
+    cluster.kube.services.delete("default", "app")
+    wait_until(lambda: cluster.cloud.ga.list_accelerators() == [],
+               message="accelerator cleaned up after service delete")
+
+
+def test_port_change_resyncs_listener(cluster):
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION)
+    cluster.kube.services.create(nlb_service())
+    wait_until(lambda: len(owned_accelerators(cluster)) == 1,
+               message="accelerator created")
+    svc = cluster.kube.services.get("default", "app")
+    svc.spec.ports = [ServicePort(port=8080)]
+    cluster.kube.services.update(svc)
+    provider = cluster.factory.global_provider()
+
+    def ports_synced():
+        acc = owned_accelerators(cluster)[0]
+        listener = provider.get_listener(acc.accelerator_arn)
+        return [p.from_port for p in listener.port_ranges] == [8080]
+
+    wait_until(ports_synced, message="listener ports resynced")
+
+
+def test_unmanaged_service_is_ignored(cluster):
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION)
+    cluster.kube.services.create(nlb_service(annotations={
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external"}))
+    import time
+    time.sleep(0.3)
+    assert cluster.cloud.ga.list_accelerators() == []
+
+
+def test_ingress_create_and_delete_converges(cluster):
+    lb = cluster.cloud.elb.register_load_balancer(
+        "k8s-default-web-f1f41628db", ALB_HOSTNAME, REGION,
+        lb_type="application")
+    cluster.kube.ingresses.create(alb_ingress())
+    wait_until(lambda: len(owned_accelerators(
+                   cluster, "ingress", "default", "web")) == 1,
+               message="ingress accelerator created")
+    provider = cluster.factory.global_provider()
+    acc = owned_accelerators(cluster, "ingress", "default", "web")[0]
+    listener = provider.get_listener(acc.accelerator_arn)
+    assert sorted(p.from_port for p in listener.port_ranges) == [80, 443]
+    assert listener.protocol == "TCP"
+    eg = provider.get_endpoint_group(listener.listener_arn)
+    assert eg.endpoint_descriptions[0].endpoint_id == lb.load_balancer_arn
+
+    cluster.kube.ingresses.delete("default", "web")
+    wait_until(lambda: cluster.cloud.ga.list_accelerators() == [],
+               message="ingress accelerator cleaned up")

@@ -1,0 +1,106 @@
+"""End-to-end: Route53 controller, including cross-controller eventual
+consistency through AWS state (SURVEY.md §3.3: the Route53 controller
+discovers the accelerator the GA controller created via tags and retries
+until it appears)."""
+import pytest
+
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    ROUTE53_HOSTNAME_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+
+from harness import Cluster, wait_until
+
+NLB_HOSTNAME = "applb-0123456789abcdef.elb.ap-northeast-1.amazonaws.com"
+REGION = "ap-northeast-1"
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster().start()
+    yield c
+    c.shutdown()
+
+
+def dns_service(hostnames="www.example.com"):
+    return Service(
+        metadata=ObjectMeta(
+            name="app", namespace="default",
+            annotations={
+                AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                ROUTE53_HOSTNAME_ANNOTATION: hostnames,
+            }),
+        spec=ServiceSpec(type="LoadBalancer", ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=NLB_HOSTNAME)])),
+    )
+
+
+def records(cluster, zone_id):
+    return {(r.name, r.type)
+            for r in cluster.cloud.route53.list_resource_record_sets(zone_id)}
+
+
+def test_records_follow_accelerator(cluster):
+    """GA controller creates the accelerator; Route53 controller finds it
+    by tag and creates ALIAS-A + TXT."""
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION)
+    zone = cluster.cloud.route53.create_hosted_zone("example.com")
+    cluster.kube.services.create(dns_service())
+    wait_until(lambda: ("www.example.com.", "A") in records(cluster, zone.id),
+               message="A record created")
+    assert ("www.example.com.", "TXT") in records(cluster, zone.id)
+    wait_until(lambda: any(e.reason == "Route53RecordCreated"
+                           for e in cluster.kube.list_events()),
+               message="record event")
+
+
+def test_multi_hostname_annotation(cluster):
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION)
+    zone = cluster.cloud.route53.create_hosted_zone("example.com")
+    cluster.kube.services.create(
+        dns_service("a.example.com,b.example.com"))
+    wait_until(lambda: {("a.example.com.", "A"), ("b.example.com.", "A")}
+               <= records(cluster, zone.id),
+               message="both A records created")
+
+
+def test_annotation_removal_deletes_records(cluster):
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION)
+    zone = cluster.cloud.route53.create_hosted_zone("example.com")
+    cluster.kube.services.create(dns_service())
+    wait_until(lambda: ("www.example.com.", "A") in records(cluster, zone.id),
+               message="A record created")
+    svc = cluster.kube.services.get("default", "app")
+    del svc.metadata.annotations[ROUTE53_HOSTNAME_ANNOTATION]
+    cluster.kube.services.update(svc)
+    wait_until(lambda: ("www.example.com.", "A") not in records(cluster,
+                                                                zone.id),
+               message="A record deleted")
+    assert ("www.example.com.", "TXT") not in records(cluster, zone.id)
+
+
+def test_service_delete_cleans_all_zones(cluster):
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION)
+    zone1 = cluster.cloud.route53.create_hosted_zone("example.com")
+    zone2 = cluster.cloud.route53.create_hosted_zone("example.org")
+    cluster.kube.services.create(
+        dns_service("www.example.com,www.example.org"))
+    wait_until(lambda: ("www.example.com.", "A") in records(cluster, zone1.id)
+               and ("www.example.org.", "A") in records(cluster, zone2.id),
+               message="records in both zones")
+    cluster.kube.services.delete("default", "app")
+    wait_until(lambda: not records(cluster, zone1.id)
+               and not records(cluster, zone2.id),
+               message="all owned records deleted")

@@ -1,0 +1,93 @@
+"""Leader election tests -- multi-replica coordination the reference never
+tested (SURVEY.md §4: "multi-node behavior (leader election) is untested")."""
+import threading
+import time
+
+from aws_global_accelerator_controller_tpu.kube.apiserver import FakeAPIServer
+from aws_global_accelerator_controller_tpu.kube.client import KubeClient
+from aws_global_accelerator_controller_tpu.leaderelection import LeaderElection
+
+
+def make_candidate(kube, name, started, stopped=None, **kwargs):
+    kwargs.setdefault("lease_duration", 0.5)
+    kwargs.setdefault("renew_deadline", 0.3)
+    kwargs.setdefault("retry_period", 0.05)
+    le = LeaderElection("test-lock", "default", kube, identity=name, **kwargs)
+    stop = threading.Event()
+
+    def on_start(leader_stop):
+        started.append(name)
+        leader_stop.wait()
+
+    t = threading.Thread(
+        target=le.run, args=(stop, on_start),
+        kwargs={"on_stopped_leading": stopped or (lambda: None)},
+        daemon=True)
+    t.start()
+    return le, stop, t
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_single_candidate_acquires():
+    kube = KubeClient(FakeAPIServer())
+    started = []
+    le, stop, t = make_candidate(kube, "a", started)
+    assert wait_until(lambda: started == ["a"])
+    assert le.is_leader.is_set()
+    stop.set()
+    t.join(timeout=3)
+
+
+def test_exactly_one_of_two_leads():
+    kube = KubeClient(FakeAPIServer())
+    started = []
+    le1, stop1, t1 = make_candidate(kube, "a", started)
+    le2, stop2, t2 = make_candidate(kube, "b", started)
+    assert wait_until(lambda: len(started) == 1)
+    time.sleep(0.3)
+    assert len(started) == 1, "only one candidate may lead"
+    stop1.set()
+    stop2.set()
+    t1.join(timeout=3)
+    t2.join(timeout=3)
+
+
+def test_release_on_cancel_hands_over():
+    kube = KubeClient(FakeAPIServer())
+    started = []
+    le1, stop1, t1 = make_candidate(kube, "a", started)
+    assert wait_until(lambda: "a" in started)
+    le2, stop2, t2 = make_candidate(kube, "b", started)
+    time.sleep(0.2)
+    assert started == ["a"]
+    stop1.set()  # clean stop releases the lease
+    t1.join(timeout=3)
+    assert wait_until(lambda: "b" in started), \
+        "standby must acquire after release"
+    stop2.set()
+    t2.join(timeout=3)
+
+
+def test_expired_lease_is_taken_over():
+    kube = KubeClient(FakeAPIServer())
+    started = []
+    # leader that never releases (simulates a crash: thread killed via
+    # daemon, lease left behind)
+    le1 = LeaderElection("test-lock", "default", kube, identity="dead",
+                         lease_duration=0.3, renew_deadline=0.2,
+                         retry_period=0.05)
+    assert le1._try_acquire_or_renew()
+
+    le2, stop2, t2 = make_candidate(kube, "b", started)
+    assert wait_until(lambda: "b" in started, timeout=5), \
+        "candidate must take over an expired lease"
+    stop2.set()
+    t2.join(timeout=3)

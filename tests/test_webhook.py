@@ -1,0 +1,135 @@
+"""Webhook tests at the HTTP surface (reference pkg/webhoook/webhook_test.go:31-210
+via httptest), using the shared fixture builder (pkg/fixture)."""
+import json
+import http.client
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.fixture import endpoint_group_binding
+from aws_global_accelerator_controller_tpu.webhook import WebhookServer
+
+ARN = ("arn:aws:globalaccelerator::123456789012:accelerator/x/listener/y/"
+       "endpoint-group/z")
+ARN2 = ARN + "2"
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = WebhookServer(port=0)  # ephemeral port, plain HTTP
+    s.start_background()
+    yield s
+    s.shutdown()
+
+
+def post(server, path, body, content_type="application/json"):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+    headers = {"Content-Type": content_type} if content_type else {}
+    conn.request("POST", path, body=body, headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def get(server, path):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def review(operation, old, new, kind="EndpointGroupBinding", uid="uid-1"):
+    req = {
+        "uid": uid,
+        "kind": {"group": "operator.h3poteto.dev", "version": "v1alpha1",
+                 "kind": kind},
+        "operation": operation,
+        "object": new.to_dict() if new is not None else None,
+    }
+    if old is not None:
+        req["oldObject"] = old.to_dict()
+    return json.dumps({
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": req,
+    })
+
+
+def test_healthz(server):
+    status, _ = get(server, "/healthz")
+    assert status == 200
+
+
+def test_arn_change_rejected(server):
+    old = endpoint_group_binding(False, "svc", None, ARN)
+    new = endpoint_group_binding(False, "svc", None, ARN2)
+    status, data = post(server, "/validate-endpointgroupbinding",
+                        review("UPDATE", old, new))
+    assert status == 200
+    body = json.loads(data)
+    assert body["response"]["allowed"] is False
+    assert body["response"]["status"]["code"] == 403
+    assert "immutable" in body["response"]["status"]["message"]
+    assert body["response"]["uid"] == "uid-1"
+
+
+def test_weight_change_allowed(server):
+    old = endpoint_group_binding(False, "svc", 10, ARN)
+    new = endpoint_group_binding(False, "svc", 200, ARN)
+    status, data = post(server, "/validate-endpointgroupbinding",
+                        review("UPDATE", old, new))
+    body = json.loads(data)
+    assert body["response"]["allowed"] is True
+    assert body["response"]["status"]["message"] == "valid"
+
+
+def test_create_allowed_without_old_object(server):
+    new = endpoint_group_binding(False, "svc", None, ARN)
+    status, data = post(server, "/validate-endpointgroupbinding",
+                        review("CREATE", None, new))
+    body = json.loads(data)
+    assert body["response"]["allowed"] is True
+
+
+def test_wrong_kind_denied_400(server):
+    new = endpoint_group_binding(False, "svc", None, ARN)
+    status, data = post(server, "/validate-endpointgroupbinding",
+                        review("UPDATE", new, new, kind="Deployment"))
+    body = json.loads(data)
+    assert body["response"]["allowed"] is False
+    assert body["response"]["status"]["code"] == 400
+
+
+def test_bad_content_type_400(server):
+    status, data = post(server, "/validate-endpointgroupbinding",
+                        b"{}", content_type="text/plain")
+    assert status == 400
+    assert b"invalid Content-Type" in data
+
+
+def test_empty_body_400(server):
+    status, data = post(server, "/validate-endpointgroupbinding", b"")
+    assert status == 400
+    assert b"empty body" in data
+
+
+def test_garbage_json_400(server):
+    status, data = post(server, "/validate-endpointgroupbinding",
+                        b"not json at all")
+    assert status == 400
+    assert b"failed to unmarshal" in data
+
+
+def test_missing_request_field_400(server):
+    status, data = post(server, "/validate-endpointgroupbinding", b"{}")
+    assert status == 400
+    assert b"empty request" in data
+
+
+def test_unknown_path_404(server):
+    status, _ = post(server, "/other", b"{}")
+    assert status == 404
+    status, _ = get(server, "/other")
+    assert status == 404
