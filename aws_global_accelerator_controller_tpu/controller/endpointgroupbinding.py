@@ -30,7 +30,10 @@ from ..errors import AWSAPIError, ERR_ENDPOINT_GROUP_NOT_FOUND, NotFoundError
 from ..kube.client import KubeClient, OperatorClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
 from ..kube.objects import split_meta_namespace_key
-from ..kube.workqueue import RateLimitingQueue
+from ..kube.workqueue import (
+    RateLimitingQueue,
+    default_controller_rate_limiter,
+)
 from ..reconcile import Result
 from .base import WORKER_POLL
 
@@ -47,6 +50,8 @@ DELETE_REQUEUE = 1.0  # reconcile.go:96
 @dataclass
 class EndpointGroupBindingConfig:
     workers: int = 1
+    queue_qps: float = 10.0    # client-go default bucket
+    queue_burst: int = 100
 
 
 class EndpointGroupBindingController:
@@ -61,7 +66,10 @@ class EndpointGroupBindingController:
         self.cloud_factory = cloud_factory
         self.recorder = kube_client.event_recorder(CONTROLLER_AGENT_NAME)
 
-        self.queue = RateLimitingQueue(name="EndpointGroupBinding")
+        self.queue = RateLimitingQueue(
+            rate_limiter=default_controller_rate_limiter(
+                config.queue_qps, config.queue_burst),
+            name="EndpointGroupBinding")
 
         self.service_informer = informer_factory.services()
         self.ingress_informer = informer_factory.ingresses()

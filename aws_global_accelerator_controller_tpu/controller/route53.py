@@ -22,7 +22,10 @@ from ..errors import new_no_retry_errorf
 from ..kube.client import KubeClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
 from ..kube.objects import Ingress, Service, split_meta_namespace_key
-from ..kube.workqueue import RateLimitingQueue
+from ..kube.workqueue import (
+    RateLimitingQueue,
+    default_controller_rate_limiter,
+)
 from ..reconcile import Result
 from .base import (
     annotation_presence_changed,
@@ -40,6 +43,8 @@ CONTROLLER_AGENT_NAME = "route53-controller"
 class Route53Config:
     workers: int = 1
     cluster_name: str = "default"
+    queue_qps: float = 10.0    # client-go default bucket
+    queue_burst: int = 100
 
 
 class Route53Controller:
@@ -53,9 +58,13 @@ class Route53Controller:
         self.cloud_factory = cloud_factory
         self.recorder = kube_client.event_recorder(CONTROLLER_AGENT_NAME)
 
+        limiter = lambda: default_controller_rate_limiter(
+            config.queue_qps, config.queue_burst)
         self.service_queue = RateLimitingQueue(
+            rate_limiter=limiter(),
             name=f"{CONTROLLER_AGENT_NAME}-service")
         self.ingress_queue = RateLimitingQueue(
+            rate_limiter=limiter(),
             name=f"{CONTROLLER_AGENT_NAME}-ingress")
 
         self.service_informer = informer_factory.services()

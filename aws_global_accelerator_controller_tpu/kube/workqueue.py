@@ -96,10 +96,14 @@ class MaxOfRateLimiter:
         return max(l.num_requeues(item) for l in self.limiters)
 
 
-def default_controller_rate_limiter() -> MaxOfRateLimiter:
+def default_controller_rate_limiter(qps: float = 10.0,
+                                    burst: int = 100) -> MaxOfRateLimiter:
+    """client-go defaults (10 qps / 100 burst); tunable for large fleets
+    where the global bucket, not reconcile work, becomes the throughput
+    ceiling."""
     return MaxOfRateLimiter(
         ItemExponentialFailureRateLimiter(0.005, 1000.0),
-        BucketRateLimiter(10.0, 100),
+        BucketRateLimiter(qps, burst),
     )
 
 

@@ -1,0 +1,3 @@
+"""Models: the TPU-native traffic-policy track (no reference analogue --
+SURVEY.md §2 records the reference as 100% Go with zero ML components)."""
+from .traffic import TrafficPolicyModel  # noqa: F401

@@ -1,0 +1,10 @@
+"""Multi-chip parallelism: mesh construction + sharded planner/training.
+
+The reference has no distributed compute (SURVEY.md §2: DP/TP/PP/SP/EP all
+ABSENT; its only multi-replica story is leader election).  This package is
+the TPU-native scale-out path for the compute track: jax.sharding Meshes
+with data x model axes, NamedSharding-annotated pjit programs, and XLA
+collectives over ICI inserted by the compiler.
+"""
+from .mesh import make_mesh  # noqa: F401
+from .plan import ShardedTrafficPlanner  # noqa: F401

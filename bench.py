@@ -1,0 +1,132 @@
+"""Framework benchmark -- prints ONE JSON line on stdout.
+
+Primary metric: sustained reconcile convergence throughput of the full
+stack (fake API server -> informers -> workqueues -> controllers ->
+provider state machines), in converged Services per second.  This is the
+framework's hot loop (SURVEY.md §3.2); the reference publishes no
+benchmark numbers at all (BASELINE.md: "none published"), so
+``vs_baseline`` is reported as 1.0 by definition against an empty
+baseline.
+
+Secondary (stderr, informational): the TPU compute track -- batched
+endpoint-weight planning throughput on the available accelerator.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def bench_reconcile(n_services: int = 200, workers: int = 4) -> dict:
+    sys.path.insert(0, "tests")
+    from harness import Cluster, wait_until
+
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+
+    # lift the client-go default 10qps queue bucket so the bench measures
+    # framework reconcile work, not the (configurable) admission throttle
+    cluster = Cluster(workers=workers, queue_qps=10000.0,
+                      queue_burst=10000).start()
+    region = "ap-northeast-1"
+    try:
+        for i in range(n_services):
+            name = f"svc{i:04d}"
+            hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                        ".amazonaws.com")
+            cluster.cloud.elb.register_load_balancer(name, hostname, region)
+
+        start = time.perf_counter()
+        for i in range(n_services):
+            name = f"svc{i:04d}"
+            hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                        ".amazonaws.com")
+            cluster.kube.services.create(Service(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                    }),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                    ingress=[LoadBalancerIngress(hostname=hostname)])),
+            ))
+
+        wait_until(
+            lambda: len(cluster.cloud.ga.list_accelerators()) == n_services,
+            timeout=600.0, interval=0.05,
+            message=f"{n_services} accelerators converged")
+        elapsed = time.perf_counter() - start
+    finally:
+        cluster.shutdown()
+
+    return {"services": n_services, "elapsed_s": elapsed,
+            "throughput": n_services / elapsed}
+
+
+def bench_planner(groups: int = 4096, endpoints: int = 128,
+                  iters: int = 50) -> dict:
+    import jax
+
+    from aws_global_accelerator_controller_tpu.models.traffic import (
+        TrafficPolicyModel,
+        synthetic_batch,
+    )
+
+    model = TrafficPolicyModel()
+    params = model.init_params(jax.random.PRNGKey(0))
+    batch = synthetic_batch(jax.random.PRNGKey(1), groups=groups,
+                            endpoints=endpoints)
+    fwd = jax.jit(model.forward)
+    out = fwd(params, batch.features, batch.mask)
+    jax.block_until_ready(out)  # compile outside the timed loop
+
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fwd(params, batch.features, batch.mask)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - start
+    return {"backend": jax.default_backend(),
+            "groups_per_s": groups * iters / elapsed,
+            "elapsed_s": elapsed}
+
+
+def main() -> None:
+    reconcile = bench_reconcile()
+    print(f"reconcile: {reconcile['services']} services converged in "
+          f"{reconcile['elapsed_s']:.2f}s "
+          f"({reconcile['throughput']:.1f}/s)", file=sys.stderr)
+    try:
+        planner = bench_planner()
+        print(f"tpu planner [{planner['backend']}]: "
+              f"{planner['groups_per_s']:.0f} endpoint-groups/s planned",
+              file=sys.stderr)
+    except Exception as e:  # never let the info track break the metric
+        print(f"planner bench skipped: {e}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "reconcile_convergence_throughput",
+        "value": round(reconcile["throughput"], 2),
+        "unit": "services/sec",
+        # the reference publishes no benchmarks (BASELINE.md) -- parity
+        # against an empty baseline is reported as 1.0
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -36,7 +36,8 @@ class Cluster:
     """A running control plane: 3 controllers + informers over fakes."""
 
     def __init__(self, workers: int = 1, resync_period: float = 30.0,
-                 settle_seconds: float = 0.0):
+                 settle_seconds: float = 0.0, queue_qps: float = 10.0,
+                 queue_burst: int = 100):
         self.api = FakeAPIServer()
         self.kube = KubeClient(self.api)
         self.operator = OperatorClient(self.api)
@@ -46,10 +47,14 @@ class Cluster:
         self._manager = Manager(resync_period=resync_period)
         self._config = ControllerConfig(
             global_accelerator=GlobalAcceleratorConfig(
-                workers=workers, cluster_name=CLUSTER),
-            route53=Route53Config(workers=workers, cluster_name=CLUSTER),
+                workers=workers, cluster_name=CLUSTER,
+                queue_qps=queue_qps, queue_burst=queue_burst),
+            route53=Route53Config(workers=workers, cluster_name=CLUSTER,
+                                  queue_qps=queue_qps,
+                                  queue_burst=queue_burst),
             endpoint_group_binding=EndpointGroupBindingConfig(
-                workers=workers),
+                workers=workers, queue_qps=queue_qps,
+                queue_burst=queue_burst),
         )
 
     def start(self):

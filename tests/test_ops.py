@@ -1,0 +1,86 @@
+"""TPU ops tests: weight planner (jax + pallas-interpret) and membership diff."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from aws_global_accelerator_controller_tpu.ops import (
+    masked_softmax,
+    membership_diff,
+    plan_weights,
+)
+from aws_global_accelerator_controller_tpu.ops.diff import EMPTY, hash_ids
+from aws_global_accelerator_controller_tpu.ops.pallas_weights import (
+    plan_weights_pallas,
+)
+
+
+def test_masked_softmax_sums_to_one_over_valid():
+    scores = jnp.array([[1.0, 2.0, 3.0, 4.0]])
+    mask = jnp.array([[True, True, False, True]])
+    p = masked_softmax(scores, mask)
+    assert p[0, 2] == 0.0
+    np.testing.assert_allclose(float(p.sum()), 1.0, rtol=1e-5)
+
+
+def test_masked_softmax_all_masked_row_is_zero_not_nan():
+    p = masked_softmax(jnp.ones((2, 3)), jnp.zeros((2, 3), bool))
+    assert not np.any(np.isnan(np.asarray(p)))
+    assert np.all(np.asarray(p) == 0.0)
+
+
+def test_plan_weights_uniform():
+    scores = jnp.zeros((1, 4))
+    mask = jnp.ones((1, 4), bool)
+    w = plan_weights(scores, mask)
+    assert w.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(w), [[64, 64, 64, 64]])
+
+
+def test_plan_weights_respects_mask_and_bf16():
+    scores = jnp.asarray([[10.0, 0.0, 0.0]], dtype=jnp.bfloat16)
+    mask = jnp.array([[True, False, True]])
+    w = np.asarray(plan_weights(scores, mask))
+    assert w[0, 1] == 0
+    assert w[0, 0] > w[0, 2]
+    assert w.sum() in (254, 255, 256)  # rounding
+
+
+def test_pallas_matches_reference():
+    key = jax.random.PRNGKey(0)
+    scores = jax.random.normal(key, (13, 37))  # deliberately unaligned
+    mask = jax.random.bernoulli(jax.random.PRNGKey(1), 0.7, (13, 37))
+    ref = np.asarray(plan_weights(scores, mask))
+    pal = np.asarray(plan_weights_pallas(scores, mask))
+    np.testing.assert_array_equal(ref, pal)
+
+
+def test_membership_diff_matches_python_sets():
+    rng = np.random.default_rng(0)
+    G, E = 16, 24
+    desired = np.full((G, E), int(EMPTY), dtype=np.int32)
+    current = np.full((G, E), int(EMPTY), dtype=np.int32)
+    for g in range(G):
+        d = rng.choice(1000, size=rng.integers(0, E), replace=False)
+        c = rng.choice(1000, size=rng.integers(0, E), replace=False)
+        desired[g, :len(d)] = d
+        current[g, :len(c)] = c
+    to_add, to_remove = membership_diff(jnp.asarray(desired),
+                                        jnp.asarray(current))
+    to_add, to_remove = np.asarray(to_add), np.asarray(to_remove)
+    for g in range(G):
+        dset = set(desired[g][desired[g] != int(EMPTY)])
+        cset = set(current[g][current[g] != int(EMPTY)])
+        got_add = set(desired[g][to_add[g]])
+        got_rem = set(current[g][to_remove[g]])
+        assert got_add == dset - cset, f"group {g} add"
+        assert got_rem == cset - dset, f"group {g} remove"
+
+
+def test_hash_ids_stable_and_distinct():
+    arns = [f"arn:aws:elasticloadbalancing:us-east-1:1:loadbalancer/net/l{i}/x"
+            for i in range(100)]
+    h1 = hash_ids(arns)
+    h2 = hash_ids(arns)
+    np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
+    assert len(set(np.asarray(h1).tolist())) == 100
+    assert np.all(np.asarray(h1) >= 0)
