@@ -1,0 +1,15 @@
+# Controller image (analogue of the reference's distroless static Go image).
+FROM python:3.12-slim
+
+WORKDIR /app
+COPY aws_global_accelerator_controller_tpu/ aws_global_accelerator_controller_tpu/
+COPY config/ config/
+
+# Runtime deps beyond the stdlib: pyyaml for manifests; jax/optax only if
+# the TPU compute track is used in-cluster (not required for the
+# controllers themselves).
+RUN pip install --no-cache-dir pyyaml
+
+ENV PYTHONUNBUFFERED=1
+ENTRYPOINT ["python", "-m", "aws_global_accelerator_controller_tpu"]
+CMD ["controller"]

@@ -1,0 +1,21 @@
+# Developer entry points (analogue of the reference Makefile:16-24).
+
+.PHONY: test manifests check-manifests bench graft-dryrun lint
+
+test:
+	python -m pytest tests/ -x -q
+
+manifests:
+	python -m aws_global_accelerator_controller_tpu.codegen
+
+check-manifests: manifests
+	git diff --exit-code config/
+
+bench:
+	python bench.py
+
+graft-dryrun:
+	python __graft_entry__.py
+
+lint:
+	python -m compileall -q aws_global_accelerator_controller_tpu tests

@@ -1,0 +1,79 @@
+"""Generated-manifest drift check (the make-manifests CI gate,
+reference .github/workflows/manifests.yml:14-27) + schema sanity."""
+import os
+
+import yaml
+
+from aws_global_accelerator_controller_tpu import codegen
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = os.path.join(ROOT, "config")
+
+
+def test_committed_manifests_match_codegen():
+    for rel, fn in codegen.MANIFESTS.items():
+        path = os.path.join(CONFIG, rel)
+        assert os.path.exists(path), f"missing {rel}; run codegen"
+        with open(path) as f:
+            committed = f.read()
+        assert committed == codegen.render(fn()), (
+            f"{rel} drifted from the types; re-run "
+            "python -m aws_global_accelerator_controller_tpu.codegen")
+
+
+def test_crd_schema_accepts_sample():
+    crd = codegen.endpoint_group_binding_crd()
+    version = crd["spec"]["versions"][0]
+    schema = version["schema"]["openAPIV3Schema"]
+    spec_props = schema["properties"]["spec"]
+    assert spec_props["required"] == ["endpointGroupArn"]
+    assert spec_props["properties"]["weight"]["nullable"] is True
+    assert version["subresources"] == {"status": {}}
+    cols = [c["name"] for c in version["additionalPrinterColumns"]]
+    assert cols == ["EndpointGroupArn", "EndpointIds", "Age"]
+
+
+def test_sample_manifests_parse_and_bind():
+    """Samples must parse into our API types with the right annotations."""
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (
+        EndpointGroupBinding,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        Ingress,
+        Service,
+    )
+
+    with open(os.path.join(CONFIG, "samples/nlb-public-service.yaml")) as f:
+        svc = Service.from_dict(yaml.safe_load(f))
+    assert svc.spec.type == "LoadBalancer"
+    assert AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION in svc.annotations
+
+    with open(os.path.join(CONFIG, "samples/alb-public-ingress.yaml")) as f:
+        ing = Ingress.from_dict(yaml.safe_load(f))
+    assert ing.spec.ingress_class_name == "alb"
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.helpers import (
+        listener_for_ingress,
+    )
+    ports, protocol = listener_for_ingress(ing)
+    assert ports == [80, 443] and protocol == "TCP"
+
+    with open(os.path.join(CONFIG, "samples/endpointgroupbinding.yaml")) as f:
+        egb = EndpointGroupBinding.from_dict(yaml.safe_load(f))
+    assert egb.spec.service_ref.name == "demo-app"
+    assert egb.spec.weight == 100
+
+
+def test_rbac_covers_controller_needs():
+    role = codegen.rbac_role()
+    by_resource = {}
+    for rule in role["rules"]:
+        for r in rule["resources"]:
+            by_resource.setdefault(r, set()).update(rule["verbs"])
+    assert {"get", "list", "watch"} <= by_resource["services"]
+    assert {"get", "list", "watch"} <= by_resource["ingresses"]
+    assert {"create", "update"} <= by_resource["leases"]
+    assert {"create", "patch"} <= by_resource["events"]
+    assert {"update", "patch"} <= by_resource["endpointgroupbindings/status"]
