@@ -24,6 +24,7 @@ from ..kube.apiserver import FakeAPIServer
 from ..kube.client import KubeClient, OperatorClient
 from ..leaderelection import LeaderElection
 from ..manager import ControllerConfig, Manager
+from ..metrics import HealthServer
 from ..signals import setup_signal_handler
 from ..webhook import WebhookServer
 
@@ -55,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
     controller.add_argument("--leader-elect", action="store_true",
                             default=True,
                             help="Run under Lease-based leader election.")
+    controller.add_argument("--health-port", type=int, default=8081,
+                            help="Port for /healthz, /readyz and /metrics "
+                                 "(0 disables; the reference controller "
+                                 "binary has no such endpoint).")
 
     webhook = sub.add_parser("webhook", help="Start webhook server")
     webhook.add_argument("--tls-cert-file", default="",
@@ -97,16 +102,34 @@ def run_controller(args) -> int:
 
     namespace = os.environ.get("POD_NAMESPACE", "default")
 
-    def run_manager(leader_stop):
-        Manager().run(kube, operator, cloud_factory, config, leader_stop)
+    health = None
+    if args.health_port != 0:
+        health = HealthServer(port=args.health_port)
+        health.start_background()
 
-    if args.leader_elect:
-        le = LeaderElection("aws-global-accelerator-controller", namespace,
-                            kube)
-        le.run(stop, on_started_leading=run_manager,
-               on_stopped_leading=lambda: os._exit(0))
-    else:
-        run_manager(stop)
+    def run_manager(leader_stop):
+        factory = Manager().run(kube, operator, cloud_factory, config,
+                                leader_stop, block=False)
+        if health is not None:
+            health.add_ready_probe(
+                "informers",
+                lambda: all(inf.has_synced()
+                            for inf in factory._informers.values()))
+        leader_stop.wait()
+
+    try:
+        if args.leader_elect:
+            le = LeaderElection("aws-global-accelerator-controller",
+                                namespace, kube)
+            if health is not None:
+                health.add_ready_probe("leader", le.is_leader.is_set)
+            le.run(stop, on_started_leading=run_manager,
+                   on_stopped_leading=lambda: os._exit(0))
+        else:
+            run_manager(stop)
+    finally:
+        if health is not None:
+            health.shutdown()
     return 0
 
 

@@ -71,6 +71,9 @@ def run_controller(name: str, stop: threading.Event,
                    queues: List[RateLimitingQueue],
                    worker_sets: Callable[[], List[threading.Thread]]) -> None:
     """Common Run() tail: spawn workers, block on stop, shut queues down."""
+    from .. import metrics
+    for q in queues:
+        metrics.watch_queue_depth(q)
     threads = worker_sets()
     logger.info("started %s workers", name)
     stop.wait()

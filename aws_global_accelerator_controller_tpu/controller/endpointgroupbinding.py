@@ -100,6 +100,8 @@ class EndpointGroupBindingController:
                                    self.ingress_informer):
             raise RuntimeError("failed to wait for caches to sync")
 
+        from .. import metrics
+        metrics.watch_queue_depth(self.queue)
         threads = []
         for i in range(self.workers):
             t = threading.Thread(target=self._worker_loop, args=(stop,),
@@ -114,19 +116,21 @@ class EndpointGroupBindingController:
             t.join(timeout=2.0)
 
     def _worker_loop(self, stop: threading.Event) -> None:
+        from .. import metrics
         while not stop.is_set():
             key, shutdown = self.queue.get(timeout=WORKER_POLL)
             if shutdown:
                 return
             if key is None:
                 continue
-            try:
-                self._sync_handler(key)
-            except Exception:
-                logger.exception("error syncing %r", key)
-                self.queue.add_rate_limited(key)
-            finally:
-                self.queue.done(key)
+            with metrics.timed(self.queue.name):
+                try:
+                    self._sync_handler(key)
+                except Exception:
+                    logger.exception("error syncing %r", key)
+                    self.queue.add_rate_limited(key)
+                finally:
+                    self.queue.done(key)
 
     def _sync_handler(self, key: str) -> None:
         """(controller.go:148-180)"""

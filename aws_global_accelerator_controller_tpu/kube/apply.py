@@ -1,0 +1,64 @@
+"""Manifest application: YAML documents -> API server objects.
+
+The analogue of the reference e2e suite's hand-rolled server-side-apply
+engine over the dynamic client (e2e/pkg/util/manifests.go:34-79): map a
+manifest's kind to the typed store, create-or-update idempotently.  Used
+by tests and by operators seeding the fake control plane.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List
+
+import yaml
+
+from ..apis.endpointgroupbinding.v1alpha1 import EndpointGroupBinding
+from ..errors import NotFoundError
+from .apiserver import FakeAPIServer
+from .objects import Ingress, KubeObject, Service
+
+_KIND_TYPES = {
+    "Service": Service,
+    "Ingress": Ingress,
+    "EndpointGroupBinding": EndpointGroupBinding,
+}
+
+
+def parse_manifest(doc: Dict[str, Any]) -> KubeObject:
+    kind = doc.get("kind", "")
+    cls = _KIND_TYPES.get(kind)
+    if cls is None:
+        raise ValueError(f"unsupported kind for apply: {kind!r}")
+    return cls.from_dict(doc)
+
+
+def apply(api: FakeAPIServer, doc: Dict[str, Any]) -> KubeObject:
+    """Create-or-update one manifest (server-side-apply semantics-lite)."""
+    obj = parse_manifest(doc)
+    store = api.store(obj.kind)
+    try:
+        current = store.get(obj.metadata.namespace, obj.metadata.name)
+    except NotFoundError:
+        return store.create(obj)
+    obj.metadata.resource_version = current.metadata.resource_version
+    obj.metadata.finalizers = (obj.metadata.finalizers
+                               or current.metadata.finalizers)
+    return store.update(obj)
+
+
+def apply_yaml(api: FakeAPIServer, text: str) -> List[KubeObject]:
+    """Apply every supported document in a (possibly multi-doc) YAML
+    string; unsupported kinds (Deployment, CRD, ...) are skipped."""
+    applied = []
+    for doc in yaml.safe_load_all(text):
+        if not doc or doc.get("kind") not in _KIND_TYPES:
+            continue
+        applied.append(apply(api, doc))
+    return applied
+
+
+def apply_files(api: FakeAPIServer, paths: Iterable[str]) -> List[KubeObject]:
+    applied = []
+    for path in paths:
+        with open(path) as f:
+            applied.extend(apply_yaml(api, f.read()))
+    return applied

@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from . import metrics
 from .errors import is_no_retry, is_not_found
 from .kube.workqueue import RateLimitingQueue
 
@@ -93,19 +94,26 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
 
     if err is not None:
         if is_no_retry(err):
+            outcome = "no_retry_error"
             logger.error("error syncing %r: %s", key, err)
         else:
+            outcome = "error"
             queue.add_rate_limited(key)
             logger.error("error syncing %r, and requeued: %s", key, err)
     elif res.requeue_after > 0:
+        outcome = "requeue_after"
         queue.forget(key)
         queue.add_after(key, res.requeue_after)
         logger.info("successfully synced %r, but requeued after %.1fs",
                     key, res.requeue_after)
     elif res.requeue:
+        outcome = "requeue"
         queue.add_rate_limited(key)
         logger.info("successfully synced %r, but requeued", key)
     else:
+        outcome = "success"
         queue.forget(key)
         logger.debug("successfully synced %r (%.3fs)",
                      key, time.monotonic() - start)
+    metrics.record_sync(queue.name or "queue", outcome,
+                        time.monotonic() - start)

@@ -1,0 +1,121 @@
+"""Live-AWS e2e tier (the local_e2e/ analogue, reference
+local_e2e/e2e_test.go:46-58's env gating).
+
+Requires real AWS credentials, an existing load balancer, and a Route53
+zone; every test is skipped unless the gate below passes, so CI and the
+build environment (no boto3, zero egress) never run it.
+
+Env contract:
+- E2E_LB_HOSTNAME  -- DNS name of an existing ALB/NLB
+- E2E_HOSTNAME     -- DNS record to manage in a hosted zone you own
+- E2E_CLUSTER_NAME -- tag value (default: live-e2e)
+"""
+import os
+import time
+
+import pytest
+
+try:
+    import boto3  # noqa: F401
+    HAVE_BOTO = True
+except ImportError:
+    HAVE_BOTO = False
+
+REQUIRED_ENV = ("E2E_LB_HOSTNAME", "E2E_HOSTNAME")
+
+pytestmark = pytest.mark.skipif(
+    not HAVE_BOTO or any(not os.environ.get(v) for v in REQUIRED_ENV),
+    reason="live AWS e2e requires boto3 and E2E_LB_HOSTNAME/E2E_HOSTNAME")
+
+# Convergence budgets from the reference (local_e2e/e2e_test.go:264,355).
+CREATE_BUDGET = 600.0
+CLEANUP_BUDGET = 600.0
+POLL = 10.0
+
+
+@pytest.fixture(scope="module")
+def env():
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws import (
+        get_lb_name_from_hostname,
+    )
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
+        BotoCloudFactory,
+    )
+
+    lb_hostname = os.environ["E2E_LB_HOSTNAME"]
+    name, region = get_lb_name_from_hostname(lb_hostname)
+    factory = BotoCloudFactory()
+    return {
+        "factory": factory,
+        "provider": factory.provider_for(region),
+        "lb_hostname": lb_hostname,
+        "lb_name": name,
+        "region": region,
+        "hostname": os.environ["E2E_HOSTNAME"],
+        "cluster": os.environ.get("E2E_CLUSTER_NAME", "live-e2e"),
+    }
+
+
+def poll_until(pred, budget, message):
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(POLL)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+def test_accelerator_chain_and_route53_lifecycle(env):
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+
+    provider = env["provider"]
+    svc = Service(
+        metadata=ObjectMeta(name="live-e2e", namespace="default"),
+        spec=ServiceSpec(type="LoadBalancer", ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=env["lb_hostname"])])),
+    )
+    lb_ingress = svc.status.load_balancer.ingress[0]
+
+    arn, created, retry = provider.ensure_global_accelerator_for_service(
+        svc, lb_ingress, env["cluster"], env["lb_name"], env["region"])
+    try:
+        assert retry == 0 and arn
+        poll_until(
+            lambda: provider.list_global_accelerator_by_resource(
+                env["cluster"], "service", "default", "live-e2e"),
+            CREATE_BUDGET, "accelerator discoverable by tags")
+
+        created_dns, retry = provider.ensure_route53_for_service(
+            svc, lb_ingress, [env["hostname"]], env["cluster"])
+        assert retry == 0
+
+        zone = provider.get_hosted_zone(env["hostname"])
+        from aws_global_accelerator_controller_tpu.cloudprovider.aws.helpers import (
+            find_a_record,
+            route53_owner_value,
+        )
+        owner = route53_owner_value(env["cluster"], "service", "default",
+                                    "live-e2e")
+        poll_until(
+            lambda: find_a_record(
+                provider.find_owned_a_record_sets(zone, owner),
+                env["hostname"]) is not None,
+            CREATE_BUDGET, "owned A record")
+    finally:
+        provider.cleanup_record_set(env["cluster"], "service", "default",
+                                    "live-e2e")
+        if arn:
+            provider.cleanup_global_accelerator(arn)
+        poll_until(
+            lambda: not provider.list_global_accelerator_by_resource(
+                env["cluster"], "service", "default", "live-e2e"),
+            CLEANUP_BUDGET, "accelerator cleanup")
