@@ -108,21 +108,20 @@ def run_controller(args) -> int:
         health.start_background()
 
     def run_manager(leader_stop):
-        factory = Manager().run(kube, operator, cloud_factory, config,
-                                leader_stop, block=False)
+        handle = Manager().run(kube, operator, cloud_factory, config,
+                               leader_stop, block=False)
         if health is not None:
-            health.add_ready_probe(
-                "informers",
-                lambda: all(inf.has_synced()
-                            for inf in factory._informers.values()))
+            # readiness = informer caches synced; leadership is NOT a
+            # readiness concern (standby replicas must be Ready)
+            health.add_ready_probe("informers", handle.informers_synced)
         leader_stop.wait()
+        # graceful shutdown: let controllers drain queues + join workers
+        handle.join(timeout=10.0)
 
     try:
         if args.leader_elect:
             le = LeaderElection("aws-global-accelerator-controller",
                                 namespace, kube)
-            if health is not None:
-                health.add_ready_probe("leader", le.is_leader.is_set)
             le.run(stop, on_started_leading=run_manager,
                    on_stopped_leading=lambda: os._exit(0))
         else:

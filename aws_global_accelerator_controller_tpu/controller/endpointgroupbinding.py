@@ -116,6 +116,8 @@ class EndpointGroupBindingController:
             t.join(timeout=2.0)
 
     def _worker_loop(self, stop: threading.Event) -> None:
+        import time as time_mod
+
         from .. import metrics
         while not stop.is_set():
             key, shutdown = self.queue.get(timeout=WORKER_POLL)
@@ -123,14 +125,18 @@ class EndpointGroupBindingController:
                 return
             if key is None:
                 continue
-            with metrics.timed(self.queue.name):
-                try:
-                    self._sync_handler(key)
-                except Exception:
-                    logger.exception("error syncing %r", key)
-                    self.queue.add_rate_limited(key)
-                finally:
-                    self.queue.done(key)
+            start = time_mod.monotonic()
+            result = "success"
+            try:
+                self._sync_handler(key)
+            except Exception:
+                result = "error"
+                logger.exception("error syncing %r", key)
+                self.queue.add_rate_limited(key)
+            finally:
+                self.queue.done(key)
+                metrics.record_sync(self.queue.name, result,
+                                    time_mod.monotonic() - start)
 
     def _sync_handler(self, key: str) -> None:
         """(controller.go:148-180)"""

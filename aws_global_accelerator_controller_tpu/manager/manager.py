@@ -85,6 +85,27 @@ def new_controller_initializers() -> Dict[str, InitFunc]:
     }
 
 
+class ManagerHandle:
+    """Running manager: informer factory + controller threads.
+
+    ``join`` is the graceful-shutdown tail: after ``stop`` is set, waits
+    for each controller's run() to drain its queues and join its workers
+    (the wg.Wait() of reference manager.go:74).
+    """
+
+    def __init__(self, informer_factory: SharedInformerFactory, threads):
+        self.informer_factory = informer_factory
+        self.threads = threads
+
+    def informers_synced(self) -> bool:
+        return all(inf.has_synced()
+                   for inf in self.informer_factory._informers.values())
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        for t in self.threads:
+            t.join(timeout)
+
+
 class Manager:
     def __init__(self, resync_period: float = RESYNC_PERIOD):
         self.resync_period = resync_period
@@ -93,7 +114,7 @@ class Manager:
             cloud_factory: CloudFactory, config: ControllerConfig,
             stop: threading.Event,
             initializers: Optional[Dict[str, InitFunc]] = None,
-            block: bool = True) -> SharedInformerFactory:
+            block: bool = True) -> ManagerHandle:
         """(reference manager.go:42-77)"""
         informer_factory = SharedInformerFactory(
             kube_client.api, resync_period=self.resync_period)
@@ -109,7 +130,7 @@ class Manager:
 
         informer_factory.start(stop)
 
+        handle = ManagerHandle(informer_factory, threads)
         if block:
-            for t in threads:
-                t.join()
-        return informer_factory
+            handle.join()
+        return handle

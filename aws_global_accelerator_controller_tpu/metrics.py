@@ -51,9 +51,14 @@ class Registry:
 
     def register_gauge(self, name: str, labels: Dict[str, str],
                        fn: Callable[[], float]) -> None:
+        """Re-registering the same (name, labels) replaces the callback --
+        a restarted controller must not duplicate series or keep dead
+        queues alive."""
+        key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            self._gauge_fns.append(
-                (name, tuple(sorted(labels.items())), fn))
+            self._gauge_fns = [g for g in self._gauge_fns
+                               if (g[0], g[1]) != key]
+            self._gauge_fns.append((key[0], key[1], fn))
 
     @staticmethod
     def _fmt_labels(labels: Tuple) -> str:
@@ -185,20 +190,3 @@ def _safe(probe: Callable[[], bool]) -> bool:
         return bool(probe())
     except Exception:
         return False
-
-
-def timed(queue_name: str):
-    """Context manager recording a sync duration + outcome."""
-    class _Timer:
-        def __enter__(self):
-            self.start = time.monotonic()
-            self.result = "success"
-            return self
-
-        def __exit__(self, exc_type, exc, tb):
-            record_sync(queue_name,
-                        "error" if exc_type is not None else self.result,
-                        time.monotonic() - self.start)
-            return False
-
-    return _Timer()

@@ -66,7 +66,7 @@ class Cluster:
         self.stop.set()
 
 
-def wait_until(pred, timeout: float = 8.0, interval: float = 0.02,
+def wait_until(pred, timeout: float = 20.0, interval: float = 0.02,
                message: str = "condition"):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
