@@ -55,6 +55,16 @@ class ConflictError(Exception):
     """Optimistic-concurrency conflict on update (resourceVersion mismatch)."""
 
 
+class AdmissionDeniedError(Exception):
+    """A validating admission webhook rejected the request."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"admission webhook denied the request "
+                         f"({code}): {message}")
+        self.code = code
+        self.reason = message
+
+
 class AWSAPIError(Exception):
     """Base for simulated/real AWS API errors, carrying an error code the
     way smithy.APIError does (reference

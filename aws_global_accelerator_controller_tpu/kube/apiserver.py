@@ -24,12 +24,57 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
-from ..errors import ConflictError, NotFoundError
+from ..errors import AdmissionDeniedError, ConflictError, NotFoundError
 from .objects import KubeObject
 
 WATCH_ADDED = "ADDED"
 WATCH_MODIFIED = "MODIFIED"
 WATCH_DELETED = "DELETED"
+
+
+@dataclass
+class ValidatingWebhook:
+    """A registered ValidatingWebhookConfiguration entry: the API server
+    POSTs AdmissionReview v1 to ``url`` before persisting, with
+    failurePolicy: Fail semantics (reference config/webhook/manifests.yaml)."""
+    kind: str
+    url: str
+    operations: tuple = ("CREATE", "UPDATE")
+
+    def review(self, operation: str, old_obj, new_obj) -> None:
+        import json
+        import urllib.request
+
+        request: dict = {
+            "uid": str(uuid.uuid4()),
+            "kind": {"kind": self.kind},
+            "operation": operation,
+        }
+        if new_obj is not None:
+            request["object"] = new_obj.to_dict()
+        if old_obj is not None:
+            request["oldObject"] = old_obj.to_dict()
+        body = json.dumps({
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": request,
+        }).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                review = json.loads(resp.read())
+        except AdmissionDeniedError:
+            raise
+        except Exception as e:
+            # failurePolicy: Fail -- an unreachable webhook blocks writes
+            raise AdmissionDeniedError(500, f"webhook call failed: {e}")
+        response = review.get("response") or {}
+        if not response.get("allowed", False):
+            status = response.get("status") or {}
+            raise AdmissionDeniedError(status.get("code", 403),
+                                       status.get("message", "denied"))
 
 
 @dataclass
@@ -67,12 +112,15 @@ class Broadcaster:
 class ResourceStore:
     """One kind's store: CRUD + watch. Keys are 'namespace/name'."""
 
-    def __init__(self, kind: str, rv_source: Callable[[], int]):
+    def __init__(self, kind: str, rv_source: Callable[[], int],
+                 admission: Optional[Callable] = None):
         self.kind = kind
         self._next_rv = rv_source
         self._objects: Dict[str, KubeObject] = {}
         self._lock = threading.RLock()
         self._broadcaster = Broadcaster()
+        # admission(operation, old_obj, new_obj) raises AdmissionDeniedError
+        self._admission = admission
 
     # -- helpers --------------------------------------------------------
 
@@ -88,6 +136,8 @@ class ResourceStore:
     # -- CRUD -----------------------------------------------------------
 
     def create(self, obj: KubeObject) -> KubeObject:
+        if self._admission is not None:
+            self._admission("CREATE", None, obj)
         with self._lock:
             obj = obj.deep_copy()
             key = obj.key()
@@ -124,6 +174,11 @@ class ResourceStore:
         ``bump_generation`` defaults to spec updates bumping generation and
         status updates (``status_only``) leaving it, like the apiserver.
         """
+        if self._admission is not None and not status_only:
+            with self._lock:
+                prior = self._objects.get(obj.key())
+                prior = prior.deep_copy() if prior is not None else None
+            self._admission("UPDATE", prior, obj)
         with self._lock:
             obj = obj.deep_copy()
             key = obj.key()
@@ -202,8 +257,11 @@ class FakeAPIServer:
     def __init__(self):
         self._rv = itertools.count(1)
         self._rv_lock = threading.Lock()
+        self._webhooks: list = []
         self.stores: Dict[str, ResourceStore] = {
-            kind: ResourceStore(kind, self._next_rv) for kind in self.KINDS
+            kind: ResourceStore(kind, self._next_rv,
+                                admission=self._make_admission(kind))
+            for kind in self.KINDS
         }
 
     def _next_rv(self) -> int:
@@ -212,3 +270,17 @@ class FakeAPIServer:
 
     def store(self, kind: str) -> ResourceStore:
         return self.stores[kind]
+
+    def register_validating_webhook(self, kind: str, url: str,
+                                    operations=("CREATE", "UPDATE")) -> None:
+        """The ValidatingWebhookConfiguration-apply analogue (reference
+        config/webhook/manifests.yaml, applied by e2e/pkg/util)."""
+        self._webhooks.append(ValidatingWebhook(kind, url,
+                                                tuple(operations)))
+
+    def _make_admission(self, kind: str):
+        def admit(operation, old_obj, new_obj):
+            for wh in self._webhooks:
+                if wh.kind == kind and operation in wh.operations:
+                    wh.review(operation, old_obj, new_obj)
+        return admit

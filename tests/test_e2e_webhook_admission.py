@@ -1,0 +1,76 @@
+"""End-to-end admission: real webhook server enforced by the API server.
+
+The analogue of the reference's kind-cluster e2e tier (e2e/e2e_test.go:
+60-98): apply the webhook configuration (register_validating_webhook),
+then assert the EndpointGroupArn immutability rule through the API --
+exactly the assertions of e2e_test.go:78-98 (ARN change rejected, weight
+change allowed) -- over real HTTP to the running webhook server.
+"""
+import pytest
+
+from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (
+    KIND,
+)
+from aws_global_accelerator_controller_tpu.errors import AdmissionDeniedError
+from aws_global_accelerator_controller_tpu.fixture import endpoint_group_binding
+from aws_global_accelerator_controller_tpu.kube.apiserver import FakeAPIServer
+from aws_global_accelerator_controller_tpu.kube.client import OperatorClient
+from aws_global_accelerator_controller_tpu.webhook import WebhookServer
+
+ARN = ("arn:aws:globalaccelerator::123456789012:accelerator/x/listener/y/"
+       "endpoint-group/z")
+
+
+@pytest.fixture
+def cluster_with_webhook():
+    server = WebhookServer(port=0)
+    server.start_background()
+    api = FakeAPIServer()
+    api.register_validating_webhook(
+        KIND,
+        f"http://127.0.0.1:{server.port}/validate-endpointgroupbinding")
+    yield api, OperatorClient(api)
+    server.shutdown()
+
+
+def test_arn_change_rejected_through_api(cluster_with_webhook):
+    api, operator = cluster_with_webhook
+    egb = operator.endpoint_group_bindings.create(
+        endpoint_group_binding(False, "svc", 10, ARN))
+    egb.spec.endpoint_group_arn = ARN + "-other"
+    with pytest.raises(AdmissionDeniedError, match="immutable"):
+        operator.endpoint_group_bindings.update(egb)
+    # object unchanged
+    got = operator.endpoint_group_bindings.get("default",
+                                               egb.metadata.name)
+    assert got.spec.endpoint_group_arn == ARN
+
+
+def test_weight_change_allowed_through_api(cluster_with_webhook):
+    api, operator = cluster_with_webhook
+    egb = operator.endpoint_group_bindings.create(
+        endpoint_group_binding(False, "svc", 10, ARN))
+    egb.spec.weight = 200
+    updated = operator.endpoint_group_bindings.update(egb)
+    assert updated.spec.weight == 200
+
+
+def test_status_updates_bypass_admission(cluster_with_webhook):
+    """UpdateStatus must not round-trip the webhook (the webhook rule
+    covers the main resource, not the status subresource)."""
+    api, operator = cluster_with_webhook
+    egb = operator.endpoint_group_bindings.create(
+        endpoint_group_binding(False, "svc", None, ARN))
+    egb.status.endpoint_ids = ["arn:lb"]
+    updated = operator.endpoint_group_bindings.update_status(egb)
+    assert updated.status.endpoint_ids == ["arn:lb"]
+
+
+def test_unreachable_webhook_fails_closed():
+    api = FakeAPIServer()
+    api.register_validating_webhook(
+        KIND, "http://127.0.0.1:1/validate-endpointgroupbinding")
+    operator = OperatorClient(api)
+    with pytest.raises(AdmissionDeniedError, match="webhook call failed"):
+        operator.endpoint_group_bindings.create(
+            endpoint_group_binding(False, "svc", None, ARN))
