@@ -25,8 +25,12 @@ parity: a batched, jittable endpoint-weight planner used by the
 EndpointGroupBinding controller's weight-sync path and by ``bench.py``.
 """
 
+import os as _os
+
 __version__ = "0.1.0"
 
-VERSION = __version__
-REVISION = "dev"
-BUILD = "source"
+# Build metadata injection (the -ldflags analogue, reference Makefile:18-24):
+# image builds set these env vars instead of link-time symbols.
+VERSION = _os.environ.get("AGAC_VERSION", __version__)
+REVISION = _os.environ.get("AGAC_REVISION", "dev")
+BUILD = _os.environ.get("AGAC_BUILD", "source")

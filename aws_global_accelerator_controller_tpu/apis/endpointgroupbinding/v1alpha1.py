@@ -109,3 +109,21 @@ class EndpointGroupBinding(KubeObject):
             spec=EndpointGroupBindingSpec.from_dict(d.get("spec") or {}),
             status=EndpointGroupBindingStatus.from_dict(d.get("status") or {}),
         )
+
+
+@dataclass
+class EndpointGroupBindingList:
+    """List kind (reference types.go:62-70)."""
+    items: List[EndpointGroupBinding] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": f"{KIND}List",
+            "items": [i.to_dict() for i in self.items],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EndpointGroupBindingList":
+        return cls(items=[EndpointGroupBinding.from_dict(i)
+                          for i in d.get("items") or []])

@@ -26,11 +26,13 @@ class CloudFactory:
     """Base factory: subclasses provide ``_make_apis(region)``."""
 
     def __init__(self, delete_poll_interval: float = 10.0,
-                 delete_poll_timeout: float = 180.0):
+                 delete_poll_timeout: float = 180.0,
+                 accelerator_not_found_retry: float = 60.0):
         self._providers: Dict[str, AWSProvider] = {}
         self._lock = threading.Lock()
         self._poll_interval = delete_poll_interval
         self._poll_timeout = delete_poll_timeout
+        self._not_found_retry = accelerator_not_found_retry
 
     def provider_for(self, region: str) -> AWSProvider:
         with self._lock:
@@ -39,7 +41,8 @@ class CloudFactory:
                 provider = AWSProvider(
                     self._make_apis(region),
                     delete_poll_interval=self._poll_interval,
-                    delete_poll_timeout=self._poll_timeout)
+                    delete_poll_timeout=self._poll_timeout,
+                    accelerator_not_found_retry=self._not_found_retry)
                 self._providers[region] = provider
             return provider
 
@@ -57,8 +60,10 @@ class FakeCloudFactory(CloudFactory):
 
     def __init__(self, settle_seconds: float = 0.0,
                  delete_poll_interval: float = 0.01,
-                 delete_poll_timeout: float = 5.0):
-        super().__init__(delete_poll_interval, delete_poll_timeout)
+                 delete_poll_timeout: float = 5.0,
+                 accelerator_not_found_retry: float = 0.2):
+        super().__init__(delete_poll_interval, delete_poll_timeout,
+                         accelerator_not_found_retry)
         self.cloud = FakeAWSCloud(settle_seconds=settle_seconds)
 
     def _make_apis(self, region: str) -> AWSAPIs:

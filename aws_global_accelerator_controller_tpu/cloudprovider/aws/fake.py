@@ -194,8 +194,7 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
         self.faults.check("list_listeners")
         with self._lock:
             self._get_state(accelerator_arn)
-            import copy as _copy
-            return [_copy.deepcopy(l) for a, l in self._listeners.values()
+            return [l.copy() for a, l in self._listeners.values()
                     if a == accelerator_arn]
 
     def create_listener(self, accelerator_arn: str, port_ranges,
@@ -213,8 +212,7 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
             )
             self._listeners[arn] = (accelerator_arn, listener)
             self._mark_in_progress(st)
-            import copy as _copy
-            return _copy.deepcopy(listener)
+            return listener.copy()
 
     def update_listener(self, listener_arn: str, port_ranges,
                         protocol: str, client_affinity: str) -> Listener:
@@ -229,8 +227,7 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
             listener.protocol = protocol
             listener.client_affinity = client_affinity
             self._mark_in_progress(self._get_state(acc_arn))
-            import copy as _copy
-            return _copy.deepcopy(listener)
+            return listener.copy()
 
     def delete_listener(self, listener_arn: str) -> None:
         self.faults.check("delete_listener")
@@ -250,8 +247,7 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
     def list_endpoint_groups(self, listener_arn: str) -> List[EndpointGroup]:
         self.faults.check("list_endpoint_groups")
         with self._lock:
-            import copy as _copy
-            return [_copy.deepcopy(eg)
+            return [eg.copy()
                     for l_arn, eg in self._endpoint_groups.values()
                     if l_arn == listener_arn]
 
@@ -261,8 +257,7 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
             entry = self._endpoint_groups.get(arn)
             if entry is None:
                 raise EndpointGroupNotFoundError()
-            import copy as _copy
-            return _copy.deepcopy(entry[1])
+            return entry[1].copy()
 
     def create_endpoint_group(self, listener_arn: str, region: str,
                               endpoint_id: str,
@@ -282,8 +277,7 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
             self._endpoint_groups[arn] = (listener_arn, eg)
             acc_arn = self._listeners[listener_arn][0]
             self._mark_in_progress(self._get_state(acc_arn))
-            import copy as _copy
-            return _copy.deepcopy(eg)
+            return eg.copy()
 
     def update_endpoint_group(self, arn: str,
                               endpoint_configurations) -> EndpointGroup:
@@ -304,8 +298,7 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
                 )
                 for c in endpoint_configurations
             ]
-            import copy as _copy
-            return _copy.deepcopy(eg)
+            return eg.copy()
 
     def add_endpoints(self, endpoint_group_arn: str, endpoint_id: str,
                       client_ip_preservation: bool,
@@ -378,8 +371,8 @@ class FakeELBv2(ELBv2API):
             if not found:
                 raise AWSAPIError("LoadBalancerNotFoundException",
                                   f"Load balancers '{names}' not found")
-            import copy as _copy
-            return [_copy.deepcopy(lb) for lb in found]
+            from dataclasses import replace
+            return [replace(lb) for lb in found]
 
 
 def _normalize_record_name(name: str) -> str:
@@ -429,8 +422,7 @@ class FakeRoute53(Route53API):
         with self._lock:
             if hosted_zone_id not in self._records:
                 raise AWSAPIError("NoSuchHostedZone", hosted_zone_id)
-            import copy as _copy
-            return [_copy.deepcopy(r) for r in self._records[hosted_zone_id]]
+            return [r.copy() for r in self._records[hosted_zone_id]]
 
     def change_resource_record_sets(self, hosted_zone_id: str, action: str,
                                     record_set: ResourceRecordSet) -> None:
@@ -438,8 +430,7 @@ class FakeRoute53(Route53API):
         with self._lock:
             if hosted_zone_id not in self._records:
                 raise AWSAPIError("NoSuchHostedZone", hosted_zone_id)
-            import copy as _copy
-            rs = _copy.deepcopy(record_set)
+            rs = record_set.copy()
             rs.name = _normalize_record_name(rs.name)
             records = self._records[hosted_zone_id]
             existing = [r for r in records

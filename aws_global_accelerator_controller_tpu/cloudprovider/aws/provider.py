@@ -91,10 +91,12 @@ class AWSProvider:
 
     def __init__(self, apis: AWSAPIs,
                  delete_poll_interval: float = DELETE_POLL_INTERVAL,
-                 delete_poll_timeout: float = DELETE_POLL_TIMEOUT):
+                 delete_poll_timeout: float = DELETE_POLL_TIMEOUT,
+                 accelerator_not_found_retry: float = ACCELERATOR_NOT_FOUND_RETRY):
         self.apis = apis
         self.delete_poll_interval = delete_poll_interval
         self.delete_poll_timeout = delete_poll_timeout
+        self.accelerator_not_found_retry = accelerator_not_found_retry
 
     # ------------------------------------------------------------------
     # ELB
@@ -536,11 +538,11 @@ class AWSProvider:
         if len(accelerators) > 1:
             logger.error("Too many Global Accelerators for %s",
                          lb_ingress.hostname)
-            return False, ACCELERATOR_NOT_FOUND_RETRY
+            return False, self.accelerator_not_found_retry
         if not accelerators:
             logger.error("Could not find Global Accelerator for %s",
                          lb_ingress.hostname)
-            return False, ACCELERATOR_NOT_FOUND_RETRY
+            return False, self.accelerator_not_found_retry
         accelerator = accelerators[0]
 
         owner_value = route53_owner_value(cluster_name, resource, ns, name)

@@ -8,8 +8,7 @@ Mirrors the aws-sdk-go-v2 types the reference reads:
 """
 from __future__ import annotations
 
-import copy
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional
 
 # Accelerator status (gatypes.AcceleratorStatus*)
@@ -50,6 +49,10 @@ class Listener:
     protocol: str = PROTOCOL_TCP
     client_affinity: str = "NONE"
 
+    def copy(self) -> "Listener":
+        return replace(self, port_ranges=[replace(p)
+                                          for p in self.port_ranges])
+
 
 @dataclass
 class EndpointDescription:
@@ -64,6 +67,10 @@ class EndpointGroup:
     endpoint_group_region: str = ""
     endpoint_descriptions: List[EndpointDescription] = field(default_factory=list)
 
+    def copy(self) -> "EndpointGroup":
+        return replace(self, endpoint_descriptions=[
+            replace(d) for d in self.endpoint_descriptions])
+
 
 @dataclass
 class Accelerator:
@@ -75,7 +82,10 @@ class Accelerator:
     ip_address_type: str = IP_ADDRESS_TYPE_DUAL_STACK
 
     def deep_copy(self) -> "Accelerator":
-        return copy.deepcopy(self)
+        # direct constructor: this is the hottest copy in the tag-scan
+        # discovery path (O(accelerators) per ensure)
+        return Accelerator(self.accelerator_arn, self.name, self.dns_name,
+                           self.status, self.enabled, self.ip_address_type)
 
 
 @dataclass
@@ -112,5 +122,12 @@ class ResourceRecordSet:
     ttl: Optional[int] = None
     resource_records: List[ResourceRecord] = field(default_factory=list)
     alias_target: Optional[AliasTarget] = None
+
+    def copy(self) -> "ResourceRecordSet":
+        return replace(
+            self,
+            resource_records=[replace(r) for r in self.resource_records],
+            alias_target=(replace(self.alias_target)
+                          if self.alias_target else None))
 
 Tags = Dict[str, str]

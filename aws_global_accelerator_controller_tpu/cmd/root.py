@@ -78,8 +78,26 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def resolve_kubeconfig(flag_value: str) -> str:
+    """--kubeconfig flag > KUBECONFIG env > ~/.kube/config if it exists
+    (the viper/env merge of reference cmd/controller/controller.go:84-98)."""
+    if flag_value:
+        return flag_value
+    env = os.environ.get("KUBECONFIG", "")
+    if env:
+        return env
+    default = os.path.expanduser("~/.kube/config")
+    return default if os.path.exists(default) else ""
+
+
 def run_controller(args) -> int:
     stop = setup_signal_handler()
+
+    kubeconfig = resolve_kubeconfig(args.kubeconfig)
+    if kubeconfig:
+        logger.info("using kubeconfig: %s", kubeconfig)
+    else:
+        logger.info("using in-cluster config")
 
     if args.fake:
         api = FakeAPIServer()

@@ -16,7 +16,7 @@ for manifests and admission payloads.
 from __future__ import annotations
 
 import copy
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional
 
 
@@ -66,6 +66,13 @@ class ObjectMeta:
             uid=d.get("uid", ""),
             creation_timestamp=d.get("creationTimestamp"),
         )
+
+    def copy(self) -> "ObjectMeta":
+        return ObjectMeta(self.name, self.namespace, dict(self.annotations),
+                          dict(self.labels), list(self.finalizers),
+                          self.deletion_timestamp, self.generation,
+                          self.resource_version, self.uid,
+                          self.creation_timestamp)
 
 
 class KubeObject:
@@ -199,6 +206,21 @@ class Service(KubeObject):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ServiceSpec = field(default_factory=ServiceSpec)
     status: ServiceStatus = field(default_factory=ServiceStatus)
+
+    def deep_copy(self) -> "Service":
+        # hand-rolled: Services dominate informer/reconcile traffic and
+        # copy.deepcopy shows up hot in the bench profile
+        return Service(
+            metadata=self.metadata.copy(),
+            spec=ServiceSpec(
+                type=self.spec.type,
+                ports=[ServicePort(p.port, p.protocol, p.name)
+                       for p in self.spec.ports],
+                load_balancer_class=self.spec.load_balancer_class),
+            status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(i.hostname, i.ip)
+                         for i in self.status.load_balancer.ingress])),
+        )
 
     def to_dict(self):
         return {
