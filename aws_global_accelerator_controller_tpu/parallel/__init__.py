@@ -6,5 +6,6 @@ the TPU-native scale-out path for the compute track: jax.sharding Meshes
 with data x model axes, NamedSharding-annotated pjit programs, and XLA
 collectives over ICI inserted by the compiler.
 """
+from .fleet import FleetPlanner  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
 from .plan import ShardedTrafficPlanner  # noqa: F401

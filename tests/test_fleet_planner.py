@@ -1,0 +1,60 @@
+"""Fleet planner tests: sharded batch diff + weight planning over the mesh."""
+import numpy as np
+
+from aws_global_accelerator_controller_tpu.parallel.fleet import FleetPlanner
+from aws_global_accelerator_controller_tpu.parallel.mesh import make_mesh
+
+
+def arn(i):
+    return (f"arn:aws:elasticloadbalancing:us-east-1:1:loadbalancer/net/"
+            f"lb{i}/x")
+
+
+def test_fleet_plan_matches_set_semantics():
+    mesh = make_mesh(8)
+    planner = FleetPlanner(mesh, endpoints_cap=8)
+    desired = [[arn(1), arn(2)], [arn(3)], [], [arn(4), arn(5), arn(6)]]
+    current = [[arn(2), arn(9)], [arn(3)], [arn(7)], []]
+    scores = [[0.0, 0.0], [1.0], [], [0.0, 0.0, 0.0]]
+
+    plans, stats = planner.plan(desired, current, scores)
+    assert plans[0].to_add == [arn(1)]
+    assert plans[0].to_remove == [arn(9)]
+    assert plans[1].to_add == [] and plans[1].to_remove == []
+    assert plans[2].to_add == [] and plans[2].to_remove == [arn(7)]
+    assert sorted(plans[3].to_add) == sorted([arn(4), arn(5), arn(6)])
+
+    # uniform scores -> near-uniform weight split of 255
+    w0 = plans[0].weights
+    assert set(w0) == {arn(1), arn(2)}
+    assert abs(w0[arn(1)] - w0[arn(2)]) <= 1
+    w3 = plans[3].weights
+    assert sum(w3.values()) in (254, 255, 256)
+
+    assert stats["adds"] == 4.0  # 1 + 0 + 0 + 3
+    assert stats["removes"] == 2.0
+    assert stats["live_endpoints"] == 6.0
+
+
+def test_fleet_plan_scales_past_data_axis():
+    mesh = make_mesh(8)
+    planner = FleetPlanner(mesh, endpoints_cap=4)
+    F = 37  # not a multiple of the data axis -> padded internally
+    desired = [[arn(i)] for i in range(F)]
+    current = [[] for _ in range(F)]
+    scores = [[1.0] for _ in range(F)]
+    plans, stats = planner.plan(desired, current, scores)
+    assert len(plans) == F
+    assert all(p.to_add == [arn(i)] for i, p in enumerate(plans))
+    assert stats["adds"] == float(F)
+    # single endpoint gets the full weight
+    assert all(p.weights[arn(i)] == 255 for i, p in enumerate(plans))
+
+
+def test_fleet_plan_compiled_program_reuse():
+    mesh = make_mesh(8)
+    planner = FleetPlanner(mesh, endpoints_cap=4)
+    for round_i in range(3):  # same shapes -> no recompilation churn
+        desired = [[arn(round_i)], [arn(round_i + 1)]]
+        plans, _ = planner.plan(desired, [[], []], [[1.0], [1.0]])
+        assert plans[0].to_add == [arn(round_i)]

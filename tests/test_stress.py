@@ -1,0 +1,180 @@
+"""Concurrency stress tests.
+
+The reference never runs its tests with -race (SURVEY.md §5); these tests
+hammer the shared machinery from many threads to surface ordering and
+lost-update bugs, and drive the controllers through rapid create/mutate/
+delete churn asserting eventual convergence (level-triggered semantics).
+"""
+import threading
+
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.errors import ConflictError
+from aws_global_accelerator_controller_tpu.kube.apiserver import FakeAPIServer
+from aws_global_accelerator_controller_tpu.kube.client import KubeClient
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+from aws_global_accelerator_controller_tpu.kube.workqueue import (
+    ItemExponentialFailureRateLimiter,
+    RateLimitingQueue,
+)
+
+from harness import Cluster, wait_until
+
+REGION = "ap-northeast-1"
+
+
+def test_workqueue_no_lost_or_duplicated_processing():
+    """N producers x M consumers: every item processed, never concurrently
+    for the same key (the dirty/processing invariant)."""
+    q = RateLimitingQueue(
+        rate_limiter=ItemExponentialFailureRateLimiter(0.0001, 0.01))
+    n_items = 300
+    in_flight = set()
+    processed = []
+    violations = []
+    lock = threading.Lock()
+
+    def producer(offset):
+        for i in range(n_items):
+            q.add(f"item-{i}")  # same key space from all producers
+
+    def consumer():
+        import time
+        while True:
+            item, shutdown = q.get(timeout=2.0)
+            if shutdown or item is None:
+                return
+            with lock:
+                if item in in_flight:
+                    violations.append(item)
+                in_flight.add(item)
+            time.sleep(0.0005)  # widen the race window while "processing"
+            with lock:
+                in_flight.discard(item)
+                processed.append(item)
+            q.done(item)
+
+    producers = [threading.Thread(target=producer, args=(i,))
+                 for i in range(4)]
+    consumers = [threading.Thread(target=consumer) for _ in range(8)]
+    for t in producers + consumers:
+        t.start()
+    for t in producers:
+        t.join()
+
+    deadline = threading.Event()
+    assert_wait(lambda: len(set(processed)) == n_items, 10,
+                "all items processed")
+    q.shutdown()
+    for t in consumers:
+        t.join(timeout=3)
+    assert not violations, f"concurrent processing of {violations[:3]}"
+    assert len(set(processed)) == n_items
+
+
+def assert_wait(pred, timeout, message):
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(message)
+
+
+def test_concurrent_conflicting_updates_converge():
+    """Optimistic concurrency: racing writers must either succeed or get
+    ConflictError; total applied updates == successful updates."""
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    kube.services.create(Service(metadata=ObjectMeta(name="s"),
+                                 spec=ServiceSpec(type="LoadBalancer")))
+    successes = []
+
+    def writer(wid):
+        for i in range(30):
+            while True:
+                svc = kube.services.get("default", "s")
+                svc.metadata.annotations[f"w{wid}"] = str(i)
+                try:
+                    kube.services.update(svc)
+                    successes.append((wid, i))
+                    break
+                except ConflictError:
+                    continue  # re-read and retry
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    final = kube.services.get("default", "s")
+    # every writer's final value landed
+    for w in range(6):
+        assert final.metadata.annotations[f"w{w}"] == "29"
+    assert len(successes) == 180
+
+
+def test_churn_converges_to_final_state():
+    """Rapid create/annotate/deannotate/delete churn across many services;
+    the level-triggered controllers must converge to exactly the surviving
+    set."""
+    cluster = Cluster(workers=2, queue_qps=10000.0,
+                      queue_burst=10000).start()
+    try:
+        n = 30
+        for i in range(n):
+            hostname = (f"churn{i:02d}-0123456789abcdef.elb.{REGION}"
+                        ".amazonaws.com")
+            cluster.cloud.elb.register_load_balancer(f"churn{i:02d}",
+                                                     hostname, REGION)
+
+        def make(i, managed=True):
+            ann = {AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external"}
+            if managed:
+                ann[AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION] = "true"
+            hostname = (f"churn{i:02d}-0123456789abcdef.elb.{REGION}"
+                        ".amazonaws.com")
+            return Service(
+                metadata=ObjectMeta(name=f"churn{i:02d}", namespace="default",
+                                    annotations=ann),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                    ingress=[LoadBalancerIngress(hostname=hostname)])))
+
+        for i in range(n):
+            cluster.kube.services.create(make(i))
+        # churn: delete a third, de-annotate a third
+        for i in range(0, n, 3):
+            cluster.kube.services.delete("default", f"churn{i:02d}")
+        for i in range(1, n, 3):
+            svc = cluster.kube.services.get("default", f"churn{i:02d}")
+            del svc.metadata.annotations[
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION]
+            cluster.kube.services.update(svc)
+
+        survivors = {f"service/default/churn{i:02d}" for i in range(2, n, 3)}
+
+        def converged():
+            owners = set()
+            for acc in cluster.cloud.ga.list_accelerators():
+                tags = cluster.cloud.ga.list_tags_for_resource(
+                    acc.accelerator_arn)
+                owners.add(tags.get("aws-global-accelerator-owner"))
+            return owners == survivors
+
+        wait_until(converged, timeout=30,
+                   message="churn converged to surviving set")
+    finally:
+        cluster.shutdown()
