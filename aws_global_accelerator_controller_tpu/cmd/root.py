@@ -60,6 +60,13 @@ def build_parser() -> argparse.ArgumentParser:
                             help="Port for /healthz, /readyz and /metrics "
                                  "(0 disables; the reference controller "
                                  "binary has no such endpoint).")
+    controller.add_argument("--seed", action="append", default=[],
+                            metavar="FILE",
+                            help="Apply YAML manifests into the fake API "
+                                 "server at startup (repeatable).")
+    controller.add_argument("--demo", action="store_true",
+                            help="Seed a demo fleet (fake LB + hosted zone "
+                                 "+ annotated Service) and log convergence.")
 
     webhook = sub.add_parser("webhook", help="Start webhook server")
     webhook.add_argument("--tls-cert-file", default="",
@@ -120,6 +127,13 @@ def run_controller(args) -> int:
 
     namespace = os.environ.get("POD_NAMESPACE", "default")
 
+    if args.demo:
+        _seed_demo(kube, cloud_factory)
+    if args.seed:
+        from ..kube.apply import apply_files
+        applied = apply_files(kube.api, args.seed)
+        logger.info("seeded %d objects from %s", len(applied), args.seed)
+
     health = None
     if args.health_port != 0:
         health = HealthServer(port=args.health_port)
@@ -148,6 +162,44 @@ def run_controller(args) -> int:
         if health is not None:
             health.shutdown()
     return 0
+
+
+def _seed_demo(kube, cloud_factory) -> None:
+    """Demo fleet: a fake active NLB, a hosted zone, and an annotated
+    LoadBalancer Service -- the controllers then converge the accelerator
+    chain and DNS records, observable via logs and /metrics."""
+    from ..apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+        ROUTE53_HOSTNAME_ANNOTATION,
+    )
+    from ..kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+
+    region = "ap-northeast-1"
+    hostname = f"demo-0123456789abcdef.elb.{region}.amazonaws.com"
+    cloud_factory.cloud.elb.register_load_balancer("demo", hostname, region)
+    cloud_factory.cloud.route53.create_hosted_zone("demo.example.com")
+    kube.services.create(Service(
+        metadata=ObjectMeta(
+            name="demo", namespace="default",
+            annotations={
+                AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                ROUTE53_HOSTNAME_ANNOTATION: "www.demo.example.com",
+            }),
+        spec=ServiceSpec(type="LoadBalancer", ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=hostname)])),
+    ))
+    logger.info("demo seeded: Service default/demo behind %s", hostname)
 
 
 def run_webhook(args) -> int:

@@ -34,3 +34,27 @@ def test_webhook_requires_tls_files_with_ssl():
 def test_no_subcommand_errors():
     res = run_cli()
     assert res.returncode != 0
+
+
+def test_controller_demo_converges(tmp_path):
+    """Drive the full binary: demo seed -> convergence in the logs, then
+    SIGTERM for a clean shutdown."""
+    import os
+    import signal
+    import time
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "aws_global_accelerator_controller_tpu",
+         "controller", "--demo", "--health-port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd="/root/repo")
+    try:
+        time.sleep(3.0)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=15)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert "Global Accelerator created" in out
+    assert "Route53 record set is created" in out
+    assert "shutting down" in out
