@@ -69,10 +69,15 @@ def test_listener_for_service_udp_wins_when_last():
 
 
 @pytest.mark.parametrize("listener_ports,svc_ports,changed", [
+    # reference global_accelerator_test.go:157-345 table
+    ([80], [80], False),                        # single port unchanged
+    ([80, 443, 8080], [443, 8080, 80], False),  # multi, order-independent
+    ([80], [443], True),                        # single port changed
+    ([80, 8080], [443, 8080], True),            # multiple changed
+    ([80, 8080], [443, 8080, 8081], True),      # increased
+    ([80, 443, 8080], [443], True),             # decreased
     ([80, 443], [80, 443], False),
     ([80], [80, 443], True),
-    ([80, 443], [80], True),
-    ([80, 443], [80, 8443], True),
     ([], [80], True),
 ])
 def test_listener_port_changed_from_service(listener_ports, svc_ports, changed):
@@ -81,10 +86,21 @@ def test_listener_port_changed_from_service(listener_ports, svc_ports, changed):
     assert listener_port_changed_from_service(listener, svc) is changed
 
 
-def test_listener_protocol_changed_from_service():
-    svc = make_service([(53, "UDP")])
-    assert listener_protocol_changed_from_service(make_listener([53], "TCP"), svc)
-    assert not listener_protocol_changed_from_service(make_listener([53], "UDP"), svc)
+@pytest.mark.parametrize("listener_proto,svc_ports,changed", [
+    # reference global_accelerator_test.go:15-155 table, including the
+    # last-port-wins quirk for mixed-protocol Services
+    ("UDP", [(53, "UDP")], False),
+    ("TCP", [(80, "TCP"), (443, "TCP")], False),
+    ("TCP", [(53, "UDP"), (80, "TCP")], False),  # mixed, TCP last -> TCP
+    ("TCP", [(53, "UDP")], True),
+    ("TCP", [(53, "UDP"), (54, "UDP")], True),
+    ("TCP", [(80, "TCP"), (53, "UDP")], True),   # mixed, UDP last -> UDP
+])
+def test_listener_protocol_changed_from_service(listener_proto, svc_ports,
+                                                changed):
+    listener = make_listener([p for p, _ in svc_ports], listener_proto)
+    svc = make_service(svc_ports)
+    assert listener_protocol_changed_from_service(listener, svc) is changed
 
 
 # -- listener_for_ingress ---------------------------------------------------
