@@ -113,7 +113,8 @@ class ResourceStore:
     """One kind's store: CRUD + watch. Keys are 'namespace/name'."""
 
     def __init__(self, kind: str, rv_source: Callable[[], int],
-                 admission: Optional[Callable] = None):
+                 admission: Optional[Callable] = None,
+                 schema_validator: Optional[Callable] = None):
         self.kind = kind
         self._next_rv = rv_source
         self._objects: Dict[str, KubeObject] = {}
@@ -121,6 +122,9 @@ class ResourceStore:
         self._broadcaster = Broadcaster()
         # admission(operation, old_obj, new_obj) raises AdmissionDeniedError
         self._admission = admission
+        # schema_validator(obj) raises InvalidObjectError (CRD structural
+        # schema enforcement, like the real apiserver)
+        self._schema_validator = schema_validator
 
     # -- helpers --------------------------------------------------------
 
@@ -136,6 +140,8 @@ class ResourceStore:
     # -- CRUD -----------------------------------------------------------
 
     def create(self, obj: KubeObject) -> KubeObject:
+        if self._schema_validator is not None:
+            self._schema_validator(obj)
         if self._admission is not None:
             self._admission("CREATE", None, obj)
         with self._lock:
@@ -174,6 +180,8 @@ class ResourceStore:
         ``bump_generation`` defaults to spec updates bumping generation and
         status updates (``status_only``) leaving it, like the apiserver.
         """
+        if self._schema_validator is not None and not status_only:
+            self._schema_validator(obj)
         if self._admission is not None and not status_only:
             with self._lock:
                 prior = self._objects.get(obj.key())
@@ -258,9 +266,12 @@ class FakeAPIServer:
         self._rv = itertools.count(1)
         self._rv_lock = threading.Lock()
         self._webhooks: list = []
+        from .validation import endpoint_group_binding_validator
+        validators = {"EndpointGroupBinding": endpoint_group_binding_validator()}
         self.stores: Dict[str, ResourceStore] = {
             kind: ResourceStore(kind, self._next_rv,
-                                admission=self._make_admission(kind))
+                                admission=self._make_admission(kind),
+                                schema_validator=validators.get(kind))
             for kind in self.KINDS
         }
 

@@ -217,3 +217,40 @@ def test_service_lb_change_rediffs_endpoints(cluster):
                message="old endpoint removed")
     wait_until(lambda: get_binding(cluster).status.endpoint_ids
                == [lb2.load_balancer_arn], message="status updated")
+
+
+def test_binding_via_ingress_ref(cluster):
+    """ingressRef resolution path (reconcile.go:236-248 analogue)."""
+    from aws_global_accelerator_controller_tpu.apis import (
+        INGRESS_CLASS_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        Ingress,
+        IngressSpec,
+        IngressStatus,
+        LoadBalancerStatus,
+    )
+
+    alb_hostname = ("k8s-default-app-f1f41628db-201899272.ap-northeast-1"
+                    ".elb.amazonaws.com")
+    eg = make_endpoint_group(cluster)
+    lb = cluster.cloud.elb.register_load_balancer(
+        "k8s-default-app-f1f41628db", alb_hostname, REGION,
+        lb_type="application")
+    cluster.kube.ingresses.create(Ingress(
+        metadata=ObjectMeta(name="web", namespace="default",
+                            annotations={INGRESS_CLASS_ANNOTATION: "alb"}),
+        spec=IngressSpec(ingress_class_name="alb"),
+        status=IngressStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=alb_hostname)])),
+    ))
+    binding = EndpointGroupBinding(
+        metadata=ObjectMeta(name="binding", namespace="default"),
+        spec=EndpointGroupBindingSpec(
+            endpoint_group_arn=eg.endpoint_group_arn,
+            weight=40,
+            ingress_ref=IngressReference(name="web")))
+    cluster.operator.endpoint_group_bindings.create(binding)
+    wait_until(lambda: lb.load_balancer_arn in eg_endpoints(cluster, eg),
+               message="ingress-ref endpoint added")
+    assert eg_endpoints(cluster, eg)[lb.load_balancer_arn].weight == 40

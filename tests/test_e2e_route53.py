@@ -104,3 +104,45 @@ def test_service_delete_cleans_all_zones(cluster):
     wait_until(lambda: not records(cluster, zone1.id)
                and not records(cluster, zone2.id),
                message="all owned records deleted")
+
+
+ALB_HOSTNAME = ("k8s-default-web-f1f41628db-201899272.ap-northeast-1"
+                ".elb.amazonaws.com")
+
+
+def test_ingress_records_follow_accelerator(cluster):
+    """Ingress path: GA controller creates the accelerator for the ALB;
+    Route53 controller keys off the same hostname annotation."""
+    from aws_global_accelerator_controller_tpu.apis import (
+        INGRESS_CLASS_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        Ingress,
+        IngressSpec,
+        IngressStatus,
+    )
+
+    cluster.cloud.elb.register_load_balancer(
+        "k8s-default-web-f1f41628db", ALB_HOSTNAME, REGION,
+        lb_type="application")
+    zone = cluster.cloud.route53.create_hosted_zone("example.com")
+    cluster.kube.ingresses.create(Ingress(
+        metadata=ObjectMeta(
+            name="web", namespace="default",
+            annotations={
+                INGRESS_CLASS_ANNOTATION: "alb",
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                ROUTE53_HOSTNAME_ANNOTATION: "web.example.com",
+                "alb.ingress.kubernetes.io/listen-ports": '[{"HTTP": 80}]',
+            }),
+        spec=IngressSpec(ingress_class_name="alb"),
+        status=IngressStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=ALB_HOSTNAME)])),
+    ))
+    wait_until(lambda: ("web.example.com.", "A") in records(cluster, zone.id),
+               message="ingress A record created")
+    assert ("web.example.com.", "TXT") in records(cluster, zone.id)
+    cluster.kube.ingresses.delete("default", "web")
+    wait_until(lambda: ("web.example.com.", "A") not in records(cluster,
+                                                                zone.id),
+               message="ingress records cleaned up")

@@ -1,0 +1,55 @@
+"""CRD structural-schema enforcement tests (the apiserver 422 analogue)."""
+import pytest
+
+from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from aws_global_accelerator_controller_tpu.kube.apiserver import FakeAPIServer
+from aws_global_accelerator_controller_tpu.kube.client import OperatorClient
+from aws_global_accelerator_controller_tpu.kube.objects import ObjectMeta
+from aws_global_accelerator_controller_tpu.kube.validation import (
+    InvalidObjectError,
+    validate_against_schema,
+)
+
+
+def make_binding(arn="arn:aws:globalaccelerator::1:x", weight=None):
+    return EndpointGroupBinding(
+        metadata=ObjectMeta(name="b"),
+        spec=EndpointGroupBindingSpec(endpoint_group_arn=arn, weight=weight,
+                                      service_ref=ServiceReference("svc")))
+
+
+def test_missing_required_arn_rejected():
+    api = FakeAPIServer()
+    op = OperatorClient(api)
+    with pytest.raises(InvalidObjectError, match="endpointGroupArn"):
+        op.endpoint_group_bindings.create(make_binding(arn=""))
+
+
+def test_valid_binding_accepted_nullable_weight():
+    api = FakeAPIServer()
+    op = OperatorClient(api)
+    created = op.endpoint_group_bindings.create(make_binding(weight=None))
+    assert created.spec.weight is None
+    created2 = op.endpoint_group_bindings.get("default", "b")
+    created2.spec.weight = 12
+    op.endpoint_group_bindings.update(created2)
+
+
+def test_schema_type_errors():
+    schema = {"type": "object",
+              "properties": {"weight": {"type": "integer",
+                                        "nullable": True},
+                             "ids": {"type": "array",
+                                     "items": {"type": "string"}}}}
+    validate_against_schema({"weight": None, "ids": ["a"]}, schema)
+    validate_against_schema({"weight": 3}, schema)
+    with pytest.raises(InvalidObjectError, match="expected integer"):
+        validate_against_schema({"weight": "high"}, schema)
+    with pytest.raises(InvalidObjectError, match=r"ids\[0\]"):
+        validate_against_schema({"ids": [1]}, schema)
+    with pytest.raises(InvalidObjectError, match="expected integer"):
+        validate_against_schema({"weight": True}, schema)  # bool is not int

@@ -1,0 +1,79 @@
+"""Webhook TLS path: serve over HTTPS with a generated self-signed cert
+(the reference's production mode, cmd/webhook/webhook.go --ssl default
+true; cert-manager supplies certs in-cluster)."""
+import datetime
+import http.client
+import json
+import ssl
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.fixture import endpoint_group_binding
+from aws_global_accelerator_controller_tpu.webhook import WebhookServer
+
+ARN = "arn:aws:globalaccelerator::123456789012:accelerator/x"
+
+
+@pytest.fixture(scope="module")
+def tls_files(tmp_path_factory):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    tmp = tmp_path_factory.mktemp("tls")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName("localhost")]), critical=False)
+            .sign(key, hashes.SHA256()))
+    cert_file = tmp / "tls.crt"
+    key_file = tmp / "tls.key"
+    cert_file.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_file.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption()))
+    return str(cert_file), str(key_file)
+
+
+def test_webhook_over_https(tls_files):
+    cert_file, key_file = tls_files
+    server = WebhookServer(port=0, tls_cert_file=cert_file,
+                           tls_key_file=key_file)
+    assert server.ssl
+    server.start_background()
+    try:
+        ctx = ssl.create_default_context(cafile=cert_file)
+        conn = http.client.HTTPSConnection("localhost", server.port,
+                                           context=ctx, timeout=5)
+        old = endpoint_group_binding(False, "svc", None, ARN)
+        new = endpoint_group_binding(False, "svc", None, ARN + "-changed")
+        body = json.dumps({
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "u1",
+                "kind": {"kind": "EndpointGroupBinding"},
+                "operation": "UPDATE",
+                "oldObject": old.to_dict(),
+                "object": new.to_dict(),
+            },
+        })
+        conn.request("POST", "/validate-endpointgroupbinding", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        review = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert review["response"]["allowed"] is False
+        assert "immutable" in review["response"]["status"]["message"]
+    finally:
+        server.shutdown()
