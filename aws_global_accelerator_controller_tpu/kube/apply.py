@@ -28,6 +28,12 @@ def parse_manifest(doc: Dict[str, Any]) -> KubeObject:
     cls = _KIND_TYPES.get(kind)
     if cls is None:
         raise ValueError(f"unsupported kind for apply: {kind!r}")
+    if kind == "EndpointGroupBinding":
+        # validate the RAW document: the typed round-trip would default
+        # missing fields, hiding schema violations present in the YAML
+        from .validation import endpoint_group_binding_raw_validator
+
+        endpoint_group_binding_raw_validator()(doc)
     return cls.from_dict(doc)
 
 

@@ -45,11 +45,19 @@ def _validate(value: Any, schema: Dict[str, Any], path: str,
     if expected == "object":
         props = schema.get("properties", {})
         for req in schema.get("required", []):
-            if value.get(req) in (None, "",):
+            # OpenAPI/Kubernetes `required` is key PRESENCE only -- an
+            # empty string satisfies it (rejecting that needs minLength)
+            if req not in value or value.get(req) is None:
                 errors.append(f"{path}.{req}: required")
         for key, sub in props.items():
             if key in value:
                 _validate(value[key], sub, f"{path}.{key}", errors)
+        for key, sub in props.items():
+            if key in value and sub.get("minLength") is not None:
+                if isinstance(value[key], str) and (
+                        len(value[key]) < sub["minLength"]):
+                    errors.append(f"{path}.{key}: shorter than minLength "
+                                  f"{sub['minLength']}")
     elif expected == "array":
         item_schema = schema.get("items")
         if item_schema:
@@ -66,14 +74,29 @@ def validate_against_schema(obj_dict: Dict[str, Any],
         raise InvalidObjectError(errors)
 
 
-def endpoint_group_binding_validator():
-    """Schema validator bound to the generated CRD schema."""
+def _egb_schema() -> Dict[str, Any]:
     from ..codegen import endpoint_group_binding_crd
 
     crd = endpoint_group_binding_crd()
-    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    return crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+
+
+def endpoint_group_binding_validator():
+    """Schema validator for typed objects (store-level enforcement)."""
+    schema = _egb_schema()
 
     def validate(obj) -> None:
         validate_against_schema(obj.to_dict(), schema)
+
+    return validate
+
+
+def endpoint_group_binding_raw_validator():
+    """Schema validator for raw manifest dicts (apply-path enforcement --
+    the typed round-trip would default missing fields away)."""
+    schema = _egb_schema()
+
+    def validate(doc: Dict[str, Any]) -> None:
+        validate_against_schema(doc, schema)
 
     return validate

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.diff import EMPTY, hash_ids, membership_diff
+from ..ops.diff import EMPTY, membership_diff
 from ..ops.weights import plan_weights
 
 # ---------------------------------------------------------------------------
@@ -100,18 +100,24 @@ class FleetPlanner:
 
     def _encode(self, per_binding_ids: Sequence[Sequence[str]],
                 fill=int(EMPTY)) -> Tuple[jnp.ndarray, List[List[str]]]:
+        import zlib
+
         F = len(per_binding_ids)
         Fp = -(-max(F, 1) // self.data_axis) * self.data_axis
-        out = jnp.full((Fp, self.endpoints_cap), fill, dtype=jnp.int32)
+        host = [[fill] * self.endpoints_cap for _ in range(Fp)]
         rows: List[List[str]] = []
-        host = out.tolist()
         for i, ids in enumerate(per_binding_ids):
-            ids = list(ids)[: self.endpoints_cap]
+            ids = list(ids)
+            if len(ids) > self.endpoints_cap:
+                raise ValueError(
+                    f"binding {i} has {len(ids)} endpoints, exceeding "
+                    f"endpoints_cap={self.endpoints_cap}; raise the cap "
+                    "(silent truncation would strand endpoints)")
             rows.append(ids)
-            if ids:
-                hashed = hash_ids(ids).tolist()
-                for j, h in enumerate(hashed):
-                    host[i][j] = h
+            for j, s in enumerate(ids):
+                # inline 31-bit CRC (ops.diff.hash_ids semantics) without
+                # per-row device round trips
+                host[i][j] = zlib.crc32(s.encode()) & 0x7FFFFFFF
         return jnp.asarray(host, dtype=jnp.int32), rows
 
     def plan(self, desired: Sequence[Sequence[str]],
@@ -133,6 +139,10 @@ class FleetPlanner:
         s_arr = jnp.asarray(s_host, dtype=jnp.float32)
         m_arr = jnp.asarray(m_host)
 
+        for i, row in enumerate(desired):
+            if len(list(row)) != len(list(scores[i])):
+                raise ValueError(
+                    f"binding {i}: scores must align with desired ids")
         shard = NamedSharding(self.mesh, P("data", None))
         d_arr = jax.device_put(d_arr, shard)
         c_arr = jax.device_put(c_arr, shard)

@@ -38,23 +38,32 @@ def test_no_subcommand_errors():
 
 def test_controller_demo_converges(tmp_path):
     """Drive the full binary: demo seed -> convergence in the logs, then
-    SIGTERM for a clean shutdown."""
-    import os
+    SIGTERM for a clean shutdown.  Polls the log file for the convergence
+    markers instead of sleeping a fixed interval."""
     import signal
     import time
 
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "aws_global_accelerator_controller_tpu",
-         "controller", "--demo", "--health-port", "0"],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        cwd="/root/repo")
-    try:
-        time.sleep(3.0)
-        proc.send_signal(signal.SIGTERM)
-        out, _ = proc.communicate(timeout=15)
-    finally:
-        if proc.poll() is None:
-            proc.kill()
-    assert "Global Accelerator created" in out
-    assert "Route53 record set is created" in out
+    log_path = tmp_path / "demo.log"
+    markers = ("Global Accelerator created", "Route53 record set is created")
+    with open(log_path, "w") as log_file:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "aws_global_accelerator_controller_tpu",
+             "controller", "--demo", "--health-port", "0"],
+            stdout=log_file, stderr=subprocess.STDOUT, text=True,
+            cwd="/root/repo")
+        try:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                out = log_path.read_text()
+                if all(m in out for m in markers):
+                    break
+                time.sleep(0.2)
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=15)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+    out = log_path.read_text()
+    for m in markers:
+        assert m in out
     assert "shutting down" in out

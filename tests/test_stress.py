@@ -44,7 +44,7 @@ def test_workqueue_no_lost_or_duplicated_processing():
     violations = []
     lock = threading.Lock()
 
-    def producer(offset):
+    def producer():
         for i in range(n_items):
             q.add(f"item-{i}")  # same key space from all producers
 
@@ -64,15 +64,13 @@ def test_workqueue_no_lost_or_duplicated_processing():
                 processed.append(item)
             q.done(item)
 
-    producers = [threading.Thread(target=producer, args=(i,))
-                 for i in range(4)]
+    producers = [threading.Thread(target=producer) for _ in range(4)]
     consumers = [threading.Thread(target=consumer) for _ in range(8)]
     for t in producers + consumers:
         t.start()
     for t in producers:
         t.join()
 
-    deadline = threading.Event()
     assert_wait(lambda: len(set(processed)) == n_items, 10,
                 "all items processed")
     q.shutdown()

@@ -22,11 +22,25 @@ def make_binding(arn="arn:aws:globalaccelerator::1:x", weight=None):
                                       service_ref=ServiceReference("svc")))
 
 
-def test_missing_required_arn_rejected():
+def test_missing_required_arn_rejected_in_raw_manifest():
+    """`required` is key presence (OpenAPI semantics): a manifest missing
+    spec.endpointGroupArn is rejected at apply time, while an explicit
+    empty string passes -- matching the real apiserver (rejecting empty
+    would need minLength)."""
+    from aws_global_accelerator_controller_tpu.kube.apply import apply_yaml
+
     api = FakeAPIServer()
-    op = OperatorClient(api)
     with pytest.raises(InvalidObjectError, match="endpointGroupArn"):
-        op.endpoint_group_bindings.create(make_binding(arn=""))
+        apply_yaml(api, """
+apiVersion: operator.h3poteto.dev/v1alpha1
+kind: EndpointGroupBinding
+metadata:
+  name: b
+spec:
+  weight: 3
+""")
+    op = OperatorClient(api)
+    op.endpoint_group_bindings.create(make_binding(arn=""))  # accepted
 
 
 def test_valid_binding_accepted_nullable_weight():
