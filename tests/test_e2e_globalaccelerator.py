@@ -191,3 +191,38 @@ def test_ingress_create_and_delete_converges(cluster):
     cluster.kube.ingresses.delete("default", "web")
     wait_until(lambda: cluster.cloud.ga.list_accelerators() == [],
                message="ingress accelerator cleaned up")
+
+
+def test_transient_cloud_failure_retried_until_converged(cluster):
+    """Fault injection: the create chain fails twice mid-flight; the
+    rate-limited requeue path (reconcile.py dispatch) must converge anyway
+    -- the level-triggered recovery story of SURVEY.md §5."""
+    from aws_global_accelerator_controller_tpu.errors import AWSAPIError
+
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION)
+    cluster.cloud.faults.fail_on(
+        "create_accelerator", AWSAPIError("InternalError", "throttled"),
+        times=2)
+    cluster.kube.services.create(nlb_service())
+    wait_until(lambda: len(owned_accelerators(cluster)) == 1,
+               message="converged despite injected failures")
+    assert len(cluster.cloud.ga.list_accelerators()) == 1
+
+
+def test_partial_create_rolled_back_then_converges(cluster):
+    """Endpoint-group creation fails once: the partial accelerator must be
+    rolled back, then the retry builds the full chain."""
+    from aws_global_accelerator_controller_tpu.errors import AWSAPIError
+
+    cluster.cloud.elb.register_load_balancer("applb", NLB_HOSTNAME, REGION)
+    cluster.cloud.faults.fail_on(
+        "create_endpoint_group", AWSAPIError("InternalError", "boom"))
+    cluster.kube.services.create(nlb_service())
+    wait_until(lambda: len(owned_accelerators(cluster)) == 1,
+               message="converged after rollback + retry")
+    provider = cluster.factory.global_provider()
+    acc = owned_accelerators(cluster)[0]
+    listener = provider.get_listener(acc.accelerator_arn)
+    assert provider.get_endpoint_group(listener.listener_arn)
+    assert len(cluster.cloud.ga.list_accelerators()) == 1, \
+        "rolled-back partial accelerator must not linger"
