@@ -84,3 +84,25 @@ def test_hash_ids_stable_and_distinct():
     np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
     assert len(set(np.asarray(h1).tolist())) == 100
     assert np.all(np.asarray(h1) >= 0)
+
+
+def test_pallas_fused_mlp_matches_model():
+    from aws_global_accelerator_controller_tpu.models.traffic import (
+        TrafficPolicyModel,
+        synthetic_batch,
+    )
+    from aws_global_accelerator_controller_tpu.ops.pallas_mlp import (
+        forward_pallas,
+    )
+
+    model = TrafficPolicyModel(feature_dim=8, hidden_dim=64)
+    params = model.init_params(jax.random.PRNGKey(0))
+    batch = synthetic_batch(jax.random.PRNGKey(1), groups=5, endpoints=11,
+                            feature_dim=8)
+    ref = np.asarray(model.forward(params, batch.features, batch.mask))
+    fused = np.asarray(forward_pallas(params, batch.features, batch.mask))
+    # the reference path computes matmuls in bf16, the fused kernel in
+    # f32 -- integer weights may differ by a rounding step
+    np.testing.assert_allclose(ref, fused, atol=2)
+    assert np.all(fused[~np.asarray(batch.mask)] == 0)
+    assert fused.dtype == np.int32
