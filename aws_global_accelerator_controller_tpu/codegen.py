@@ -126,13 +126,55 @@ def rbac_role() -> Dict[str, Any]:
     }
 
 
+def rbac_bindings() -> Dict[str, Any]:
+    """ServiceAccount + ClusterRoleBinding for the controller Deployment
+    (config/rbac/controller-deployment.yaml runs as this identity; without
+    the binding every informer watch and Lease write would be 403)."""
+    return {
+        "items": [
+            {
+                "apiVersion": "v1",
+                "kind": "ServiceAccount",
+                "metadata": {"name": "gacc-controller",
+                             "namespace": "system"},
+            },
+            {
+                "apiVersion": "rbac.authorization.k8s.io/v1",
+                "kind": "ClusterRoleBinding",
+                "metadata": {"name": "global-accelerator-manager-rolebinding"},
+                "roleRef": {
+                    "apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole",
+                    "name": "global-accelerator-manager-role",
+                },
+                "subjects": [{
+                    "kind": "ServiceAccount",
+                    "name": "gacc-controller",
+                    "namespace": "system",
+                }],
+            },
+        ],
+        "apiVersion": "v1",
+        "kind": "List",
+    }
+
+
 def webhook_configuration() -> Dict[str, Any]:
     """(mirrors config/webhook/manifests.yaml; marker at
-    cmd/webhook/webhook.go:17)"""
+    cmd/webhook/webhook.go:17).  The cert-manager annotation makes
+    cert-manager inject the serving cert's CA bundle so the apiserver can
+    verify the webhook's TLS (pairs with config/webhook/deployment.yaml's
+    Certificate, namespace/name = system/webhook-serving-cert)."""
     return {
         "apiVersion": "admissionregistration.k8s.io/v1",
         "kind": "ValidatingWebhookConfiguration",
-        "metadata": {"name": "validating-webhook-configuration"},
+        "metadata": {
+            "name": "validating-webhook-configuration",
+            "annotations": {
+                "cert-manager.io/inject-ca-from":
+                    "system/webhook-serving-cert",
+            },
+        },
         "webhooks": [{
             "admissionReviewVersions": ["v1"],
             "clientConfig": {"service": {
@@ -157,6 +199,7 @@ MANIFESTS = {
     "crd/operator.h3poteto.dev_endpointgroupbindings.yaml":
         endpoint_group_binding_crd,
     "rbac/role.yaml": rbac_role,
+    "rbac/role_binding.yaml": rbac_bindings,
     "webhook/manifests.yaml": webhook_configuration,
 }
 

@@ -25,9 +25,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .weights import MAX_WEIGHT
-
-_BLOCK_G = 8
+from .pallas_weights import _BLOCK_G, plan_block
 
 
 def _kernel(x_ref, mask_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref,
@@ -44,17 +42,7 @@ def _kernel(x_ref, mask_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref,
          + b3_ref[:])
     # w3 is padded [H, 128] with only column 0 live
     scores = s[:, 0].reshape(gb, e)
-
-    mask = mask_ref[:] > 0
-    neg = jnp.finfo(jnp.float32).min
-    masked = jnp.where(mask, scores, neg)
-    m = jnp.max(masked, axis=-1, keepdims=True)
-    m = jnp.where(m > neg * 0.5, m, 0.0)
-    ex = jnp.where(mask, jnp.exp(masked - m), 0.0)
-    denom = jnp.sum(ex, axis=-1, keepdims=True)
-    p = jnp.where(denom > 0, ex / jnp.maximum(denom, 1e-30), 0.0)
-    out_ref[:] = jnp.where(mask, jnp.round(p * MAX_WEIGHT),
-                           0.0).astype(jnp.int32)
+    out_ref[:] = plan_block(scores, mask_ref[:] > 0)
 
 
 def _pad_axis(x, axis, to):
@@ -82,30 +70,28 @@ def _forward(params, features, mask, interpret):
     w3 = _pad_axis(_pad_axis(params["w3"].astype(jnp.float32), 0, Hp), 1, 128)
     b3 = _pad_axis(params["b3"].astype(jnp.float32), 0, 128)
 
-    block = lambda *shape: shape  # noqa: E731 readability
-
     out = pl.pallas_call(
         _kernel,
         grid=(Gp // _BLOCK_G,),
         in_specs=[
-            pl.BlockSpec(block(_BLOCK_G, Ep, Fp), lambda i: (i, 0, 0),
+            pl.BlockSpec((_BLOCK_G, Ep, Fp), lambda i: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(block(_BLOCK_G, Ep), lambda i: (i, 0),
+            pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(block(Fp, Hp), lambda i: (0, 0),
+            pl.BlockSpec((Fp, Hp), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(block(Hp,), lambda i: (0,),
+            pl.BlockSpec((Hp,), lambda i: (0,),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(block(Hp, Hp), lambda i: (0, 0),
+            pl.BlockSpec((Hp, Hp), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(block(Hp,), lambda i: (0,),
+            pl.BlockSpec((Hp,), lambda i: (0,),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(block(Hp, 128), lambda i: (0, 0),
+            pl.BlockSpec((Hp, 128), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(block(128,), lambda i: (0,),
+            pl.BlockSpec((128,), lambda i: (0,),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(block(_BLOCK_G, Ep), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((Gp, Ep), jnp.int32),
         interpret=interpret,

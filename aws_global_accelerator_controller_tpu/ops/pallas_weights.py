@@ -23,9 +23,14 @@ from .weights import MAX_WEIGHT
 _BLOCK_G = 8  # float32 sublane tile
 
 
-def _kernel(scores_ref, mask_ref, out_ref):
-    scores = scores_ref[:]
-    mask = mask_ref[:] > 0
+def plan_block(scores, mask):
+    """Masked-softmax + scale-to-255 + round on one [G_B, E] block.
+
+    Shared by both Pallas kernels (this one and pallas_mlp's fused
+    forward).  The ``m > neg * 0.5`` guard zeroes the max for all-masked
+    rows (max == finfo.min) so ``exp`` does not overflow, and the 1e-30
+    denom clamp keeps the division finite when every endpoint is masked.
+    """
     neg = jnp.finfo(jnp.float32).min
     masked = jnp.where(mask, scores, neg)
     m = jnp.max(masked, axis=-1, keepdims=True)
@@ -33,8 +38,11 @@ def _kernel(scores_ref, mask_ref, out_ref):
     e = jnp.where(mask, jnp.exp(masked - m), 0.0)
     denom = jnp.sum(e, axis=-1, keepdims=True)
     p = jnp.where(denom > 0, e / jnp.maximum(denom, 1e-30), 0.0)
-    out_ref[:] = jnp.where(mask, jnp.round(p * MAX_WEIGHT),
-                           0.0).astype(jnp.int32)
+    return jnp.where(mask, jnp.round(p * MAX_WEIGHT), 0.0).astype(jnp.int32)
+
+
+def _kernel(scores_ref, mask_ref, out_ref):
+    out_ref[:] = plan_block(scores_ref[:], mask_ref[:] > 0)
 
 
 def _pad_to(x, g, e, fill):

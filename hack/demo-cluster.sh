@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Analogue of the reference's hack/kind-with-registry.sh: instead of a kind
+# cluster + local registry, spin up the in-process fake API server + fake AWS
+# cloud, seed a demo fleet (annotated NLB Service + hosted zone), and run the
+# controller until the accelerator chain and DNS records converge.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m aws_global_accelerator_controller_tpu -v 4 controller \
+  --fake --demo --cluster-name demo "$@"

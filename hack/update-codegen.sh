@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Analogue of the reference's hack/update-codegen.sh: regenerate all derived
+# artifacts (CRD manifest, RBAC role, webhook configuration) from the Python
+# type definitions. CI gates on a clean diff (`make check-manifests`).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m aws_global_accelerator_controller_tpu.codegen
+echo "generated manifests are up to date under config/"

@@ -127,4 +127,5 @@ def test_apply_sample_files():
         os.path.join(samples, f) for f in sorted(os.listdir(samples))])
     kinds = sorted(o.kind for o in applied)
     # Deployment is skipped (unsupported kind); the rest land
-    assert kinds == ["EndpointGroupBinding", "Ingress", "Service", "Service"]
+    assert kinds == ["EndpointGroupBinding", "Ingress", "Ingress",
+                     "Service", "Service", "Service", "Service"]
