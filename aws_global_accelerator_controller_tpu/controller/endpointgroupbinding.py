@@ -31,8 +31,7 @@ from ..kube.client import KubeClient, OperatorClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
 from ..kube.objects import split_meta_namespace_key
 from ..kube.workqueue import (
-    RateLimitingQueue,
-    default_controller_rate_limiter,
+    new_rate_limiting_queue,
 )
 from ..reconcile import Result
 from .base import WORKER_POLL
@@ -66,10 +65,9 @@ class EndpointGroupBindingController:
         self.cloud_factory = cloud_factory
         self.recorder = kube_client.event_recorder(CONTROLLER_AGENT_NAME)
 
-        self.queue = RateLimitingQueue(
-            rate_limiter=default_controller_rate_limiter(
-                config.queue_qps, config.queue_burst),
-            name="EndpointGroupBinding")
+        self.queue = new_rate_limiting_queue(
+            name="EndpointGroupBinding",
+            qps=config.queue_qps, burst=config.queue_burst)
 
         self.service_informer = informer_factory.services()
         self.ingress_informer = informer_factory.ingresses()

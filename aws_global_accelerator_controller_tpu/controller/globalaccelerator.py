@@ -31,8 +31,7 @@ from ..kube.client import KubeClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
 from ..kube.objects import Ingress, Service, split_meta_namespace_key
 from ..kube.workqueue import (
-    RateLimitingQueue,
-    default_controller_rate_limiter,
+    new_rate_limiting_queue,
 )
 from ..reconcile import Result
 from .base import (
@@ -67,14 +66,12 @@ class GlobalAcceleratorController:
         self.cloud_factory = cloud_factory
         self.recorder = kube_client.event_recorder(CONTROLLER_AGENT_NAME)
 
-        limiter = lambda: default_controller_rate_limiter(
-            config.queue_qps, config.queue_burst)
-        self.service_queue = RateLimitingQueue(
-            rate_limiter=limiter(),
-            name=f"{CONTROLLER_AGENT_NAME}-service")
-        self.ingress_queue = RateLimitingQueue(
-            rate_limiter=limiter(),
-            name=f"{CONTROLLER_AGENT_NAME}-ingress")
+        self.service_queue = new_rate_limiting_queue(
+            name=f"{CONTROLLER_AGENT_NAME}-service",
+            qps=config.queue_qps, burst=config.queue_burst)
+        self.ingress_queue = new_rate_limiting_queue(
+            name=f"{CONTROLLER_AGENT_NAME}-ingress",
+            qps=config.queue_qps, burst=config.queue_burst)
 
         self.service_informer = informer_factory.services()
         self.service_informer.add_event_handler(

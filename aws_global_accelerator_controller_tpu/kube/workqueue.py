@@ -107,6 +107,37 @@ def default_controller_rate_limiter(qps: float = 10.0,
     )
 
 
+def new_rate_limiting_queue(name: str = "", qps: float = 10.0,
+                            burst: int = 100):
+    """Build the best available queue with default-controller-limiter
+    semantics.
+
+    Prefers the native C++ implementation (kube/native_workqueue.py —
+    blocking get() parks worker threads outside the GIL) and falls back to
+    the pure-Python :class:`RateLimitingQueue`.  ``AGAC_NATIVE_WORKQUEUE``
+    overrides: ``0`` forces Python, ``1`` requires native (raises if the
+    toolchain is missing), unset/``auto`` picks automatically.
+    """
+    import os
+    pref = os.environ.get("AGAC_NATIVE_WORKQUEUE", "auto").lower()
+    if pref not in ("0", "false", "off"):
+        try:
+            from .native_workqueue import NativeRateLimitingQueue, \
+                native_available
+            if native_available():
+                return NativeRateLimitingQueue(name=name, qps=qps,
+                                               burst=burst)
+            if pref in ("1", "true", "on"):
+                raise RuntimeError(
+                    "AGAC_NATIVE_WORKQUEUE=1 but the native library could "
+                    "not be built (is g++ installed?)")
+        except ImportError:
+            if pref in ("1", "true", "on"):
+                raise
+    return RateLimitingQueue(
+        rate_limiter=default_controller_rate_limiter(qps, burst), name=name)
+
+
 class RateLimitingQueue:
     """client-go RateLimitingInterface semantics.
 
