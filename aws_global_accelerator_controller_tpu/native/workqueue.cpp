@@ -96,6 +96,10 @@ struct Queue {
 
   // Move every due waiting entry onto the live queue.  Callers hold mu.
   void promote_ready_locked(Clock::time_point now) {
+    // Match the Python queue: after shutdown() the waker exits and waiting
+    // items are never delivered — promoting here would hand a worker an
+    // item mid-teardown.
+    if (shutting_down) return;
     while (!waiting.empty() && waiting.top().ready_at <= now) {
       std::string item = waiting.top().item;
       waiting.pop();

@@ -6,6 +6,17 @@ the TPU-native scale-out path for the compute track: jax.sharding Meshes
 with data x model axes, NamedSharding-annotated pjit programs, and XLA
 collectives over ICI inserted by the compiler.
 """
+from .experts import (  # noqa: F401
+    expert_scores_reference,
+    init_expert_params,
+    make_expert_planner,
+)
 from .fleet import FleetPlanner  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
+from .pipeline import (  # noqa: F401
+    init_pipeline_params,
+    make_pipeline,
+    pipeline_reference,
+)
 from .plan import ShardedTrafficPlanner  # noqa: F401
+from .ring import ewma_reference, make_mesh_1d, make_ring_ewma  # noqa: F401

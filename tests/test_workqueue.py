@@ -92,6 +92,17 @@ def test_drain_before_shutdown_signal(q):
     assert shutdown
 
 
+def test_no_delayed_delivery_after_shutdown(q):
+    """Items still in backoff when shutdown() fires are never delivered
+    (the waker exits in the Python queue; the native queue gates promotion
+    on the shutdown flag)."""
+    q.add_after("late", 0.02)
+    q.shutdown()
+    time.sleep(0.05)  # let the backoff elapse
+    item, shutdown = q.get()
+    assert item is None and shutdown
+
+
 def test_rate_limited_requeues_and_forget(q):
     for _ in range(3):
         q.add_rate_limited("k")
