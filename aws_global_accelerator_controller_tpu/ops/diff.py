@@ -15,7 +15,11 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-EMPTY = jnp.int32(-1)  # padding slot (ids are non-negative hashes)
+# Padding slot (ids are non-negative hashes).  A plain Python int, NOT
+# jnp.int32(-1): materialising a device array at import time would
+# initialise the JAX backend as a side effect of `import ops`, which
+# blocks module import whenever the tunneled TPU backend is unreachable.
+EMPTY = -1
 
 
 def _row_membership(row: jax.Array, table: jax.Array) -> jax.Array:

@@ -20,3 +20,7 @@ from .pipeline import (  # noqa: F401
 )
 from .plan import ShardedTrafficPlanner  # noqa: F401
 from .ring import ewma_reference, make_mesh_1d, make_ring_ewma  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    attention_reference,
+    make_ring_attention,
+)
