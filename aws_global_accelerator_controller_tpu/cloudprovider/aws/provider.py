@@ -76,6 +76,8 @@ from .types import (
     STATUS_DEPLOYED,
 )
 
+from ...tracing import traced
+
 logger = logging.getLogger(__name__)
 
 # Behavior constants (BASELINE.md "Functional baseline").
@@ -149,6 +151,7 @@ class AWSProvider:
     # Ensure (create-or-update) for Service / Ingress
     # ------------------------------------------------------------------
 
+    @traced("provider.ensure_global_accelerator_for_service")
     def ensure_global_accelerator_for_service(
             self, svc: Service, lb_ingress: LoadBalancerIngress,
             cluster_name: str, lb_name: str, region: str,
@@ -166,6 +169,7 @@ class AWSProvider:
                 or listener_port_changed_from_service(listener, svc)),
         )
 
+    @traced("provider.ensure_global_accelerator_for_ingress")
     def ensure_global_accelerator_for_ingress(
             self, ingress: Ingress, lb_ingress: LoadBalancerIngress,
             cluster_name: str, lb_name: str, region: str,
@@ -308,6 +312,7 @@ class AWSProvider:
     # Cleanup
     # ------------------------------------------------------------------
 
+    @traced("provider.cleanup_global_accelerator")
     def cleanup_global_accelerator(self, arn: str) -> None:
         """endpoint group -> listener -> accelerator
         (reference global_accelerator.go:254-272)."""
@@ -447,6 +452,7 @@ class AWSProvider:
 
     # -- endpoint membership for the binding controller ----------------
 
+    @traced("provider.add_lb_to_endpoint_group")
     def add_lb_to_endpoint_group(self, endpoint_group: EndpointGroup,
                                  lb_name: str, ip_preserve: bool,
                                  weight: Optional[int],
@@ -466,6 +472,7 @@ class AWSProvider:
         logger.info("endpoint added: %s", descriptions[0].endpoint_id)
         return descriptions[0].endpoint_id, 0.0
 
+    @traced("provider.remove_lb_from_endpoint_group")
     def remove_lb_from_endpoint_group(self, endpoint_group: EndpointGroup,
                                       endpoint_id: str) -> None:
         """(reference global_accelerator.go:592-599; the reference
@@ -474,6 +481,7 @@ class AWSProvider:
             endpoint_group.endpoint_group_arn, [endpoint_id])
         logger.info("endpoint removed: %s", endpoint_id)
 
+    @traced("provider.update_endpoint_weight")
     def update_endpoint_weight(self, endpoint_group: EndpointGroup,
                                endpoint_id: str,
                                weight: Optional[int]) -> None:
@@ -507,6 +515,7 @@ class AWSProvider:
     # Route53
     # ------------------------------------------------------------------
 
+    @traced("provider.ensure_route53_for_service")
     def ensure_route53_for_service(self, svc: Service,
                                    lb_ingress: LoadBalancerIngress,
                                    hostnames: List[str],
@@ -516,6 +525,7 @@ class AWSProvider:
                                     "service", svc.metadata.namespace,
                                     svc.metadata.name)
 
+    @traced("provider.ensure_route53_for_ingress")
     def ensure_route53_for_ingress(self, ingress: Ingress,
                                    lb_ingress: LoadBalancerIngress,
                                    hostnames: List[str],
@@ -569,6 +579,7 @@ class AWSProvider:
         logger.info("all records synced for %s %s/%s", resource, ns, name)
         return created, 0.0
 
+    @traced("provider.cleanup_record_set")
     def cleanup_record_set(self, cluster_name: str, resource: str, ns: str,
                            name: str) -> None:
         """Scan ALL zones, delete owned A + TXT records

@@ -16,12 +16,14 @@ registered probes), /metrics.
 """
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
 from collections import defaultdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
 
 logger = logging.getLogger(__name__)
 
@@ -158,6 +160,18 @@ class HealthServer:
                 elif self.path == "/metrics":
                     self._respond(200, outer.registry.render(),
                                   "text/plain; version=0.0.4")
+                elif urlparse(self.path).path == "/traces":
+                    from .tracing import default_tracer
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = int(q.get("limit", ["100"])[0])
+                    except ValueError:
+                        self._respond(400, "limit must be an integer")
+                        return
+                    spans = default_tracer.recent(
+                        limit=limit, name=q.get("name", [None])[0])
+                    self._respond(200, json.dumps({"spans": spans}),
+                                  "application/json")
                 else:
                     self._respond(404, "not found")
 

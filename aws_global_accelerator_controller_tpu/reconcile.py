@@ -21,6 +21,7 @@ from typing import Callable, Optional
 from . import metrics
 from .errors import is_no_retry, is_not_found
 from .kube.workqueue import RateLimitingQueue
+from .tracing import default_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -75,45 +76,50 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
     start = time.monotonic()
     res = Result()
     err: Optional[Exception] = None
-    try:
-        obj = key_to_obj(key)
-    except Exception as e:
-        if is_not_found(e):
-            try:
-                res = process_delete(key) or Result()
-            except Exception as de:
-                err = de
-        else:
-            logger.error("unable to retrieve %r from store: %s", key, e)
-            return
-    else:
+    with default_tracer.span("reconcile", queue=queue.name or "queue",
+                             key=key) as span:
         try:
-            res = process_create_or_update(obj.deep_copy()) or Result()
-        except Exception as ce:
-            err = ce
-
-    if err is not None:
-        if is_no_retry(err):
-            outcome = "no_retry_error"
-            logger.error("error syncing %r: %s", key, err)
+            obj = key_to_obj(key)
+        except Exception as e:
+            if is_not_found(e):
+                try:
+                    res = process_delete(key) or Result()
+                except Exception as de:
+                    err = de
+            else:
+                span.attributes["outcome"] = "store_error"
+                logger.error("unable to retrieve %r from store: %s", key, e)
+                return
         else:
-            outcome = "error"
+            try:
+                res = process_create_or_update(obj.deep_copy()) or Result()
+            except Exception as ce:
+                err = ce
+
+        if err is not None:
+            if is_no_retry(err):
+                outcome = "no_retry_error"
+                logger.error("error syncing %r: %s", key, err)
+            else:
+                outcome = "error"
+                queue.add_rate_limited(key)
+                logger.error("error syncing %r, and requeued: %s", key, err)
+            span.error = f"{type(err).__name__}: {err}"
+        elif res.requeue_after > 0:
+            outcome = "requeue_after"
+            queue.forget(key)
+            queue.add_after(key, res.requeue_after)
+            logger.info("successfully synced %r, but requeued after %.1fs",
+                        key, res.requeue_after)
+        elif res.requeue:
+            outcome = "requeue"
             queue.add_rate_limited(key)
-            logger.error("error syncing %r, and requeued: %s", key, err)
-    elif res.requeue_after > 0:
-        outcome = "requeue_after"
-        queue.forget(key)
-        queue.add_after(key, res.requeue_after)
-        logger.info("successfully synced %r, but requeued after %.1fs",
-                    key, res.requeue_after)
-    elif res.requeue:
-        outcome = "requeue"
-        queue.add_rate_limited(key)
-        logger.info("successfully synced %r, but requeued", key)
-    else:
-        outcome = "success"
-        queue.forget(key)
-        logger.debug("successfully synced %r (%.3fs)",
-                     key, time.monotonic() - start)
+            logger.info("successfully synced %r, but requeued", key)
+        else:
+            outcome = "success"
+            queue.forget(key)
+            logger.debug("successfully synced %r (%.3fs)",
+                         key, time.monotonic() - start)
+        span.attributes["outcome"] = outcome
     metrics.record_sync(queue.name or "queue", outcome,
                         time.monotonic() - start)

@@ -1,0 +1,169 @@
+"""Tracing subsystem: span nesting, ring buffer, reconcile-path spans,
+and the /traces endpoint."""
+import json
+import sys
+import urllib.request
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.metrics import HealthServer
+from aws_global_accelerator_controller_tpu.tracing import (
+    Tracer,
+    default_tracer,
+    traced,
+)
+
+sys.path.insert(0, "tests")
+from harness import Cluster, wait_until  # noqa: E402
+
+from aws_global_accelerator_controller_tpu.apis import (  # noqa: E402
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (  # noqa: E402
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+
+
+def test_span_nesting_and_trace_ids():
+    tr = Tracer()
+    with tr.span("outer", queue="q") as outer:
+        with tr.span("inner") as inner:
+            assert tr.current() is inner
+        assert tr.current() is outer
+    spans = tr.recent()
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    inner_d, outer_d = spans
+    assert inner_d["parent_id"] == outer_d["span_id"]
+    assert inner_d["trace_id"] == outer_d["trace_id"] == outer_d["span_id"]
+    assert outer_d["attributes"] == {"queue": "q"}
+
+
+def test_span_error_recorded_and_propagated():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("nope")
+    (s,) = tr.recent()
+    assert s["error"] == "ValueError: nope"
+
+
+def test_ring_buffer_bounds_memory():
+    tr = Tracer(capacity=4)
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    names = [s["name"] for s in tr.recent()]
+    assert names == ["s6", "s7", "s8", "s9"]
+
+
+def test_traced_decorator_nests_under_caller():
+    tr = Tracer()
+
+    @traced("child", tracer=tr)
+    def work():
+        return 42
+
+    with tr.span("parent"):
+        assert work() == 42
+    child, parent = tr.recent()
+    assert child["name"] == "child"
+    assert child["parent_id"] == parent["span_id"]
+
+
+def test_threads_do_not_share_span_stacks():
+    import threading
+
+    tr = Tracer()
+    errs = []
+
+    def worker(n):
+        try:
+            with tr.span(f"w{n}"):
+                assert tr.current().name == f"w{n}"
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs
+    assert all(s["parent_id"] is None for s in tr.recent())
+
+
+def test_reconcile_emits_spans_with_provider_children():
+    """An end-to-end converge drives reconcile spans into the default
+    tracer with provider.ensure_* children nested beneath them."""
+    default_tracer.clear()
+    cluster = Cluster(workers=1).start()
+    try:
+        region = "us-east-1"
+        hostname = f"trc-0123456789abcdef.elb.{region}.amazonaws.com"
+        cluster.cloud.elb.register_load_balancer("trc", hostname, region)
+        cluster.kube.services.create(Service(
+            metadata=ObjectMeta(
+                name="trc", namespace="default",
+                annotations={
+                    AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                }),
+            spec=ServiceSpec(type="LoadBalancer",
+                             ports=[ServicePort(port=80)]),
+            status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(hostname=hostname)])),
+        ))
+        wait_until(lambda: len(cluster.cloud.ga.list_accelerators()) == 1,
+                   timeout=30.0, message="accelerator created")
+    finally:
+        cluster.shutdown()
+
+    spans = default_tracer.recent()
+    rec = [s for s in spans if s["name"] == "reconcile"
+           and s["attributes"].get("key") == "default/trc"]
+    assert rec, "no reconcile span for the service"
+    ensure = [s for s in spans
+              if s["name"] == "provider.ensure_global_accelerator_for_service"]
+    assert ensure, "no provider child span"
+    rec_ids = {s["span_id"] for s in rec}
+    assert any(s["parent_id"] in rec_ids for s in ensure)
+    ok = [s for s in rec if s["attributes"].get("outcome") == "success"]
+    assert ok and all(s["duration_s"] >= 0 for s in spans)
+
+
+def test_traces_endpoint_serves_recent_spans():
+    default_tracer.clear()
+    with default_tracer.span("endpoint-probe", kind="test"):
+        pass
+    server = HealthServer(port=0)
+    server.start_background()
+    try:
+        url = (f"http://127.0.0.1:{server.port}/traces"
+               "?name=endpoint-probe&limit=5")
+        body = json.loads(urllib.request.urlopen(url).read())
+    finally:
+        server.shutdown()
+    assert [s["name"] for s in body["spans"]] == ["endpoint-probe"]
+    assert body["spans"][0]["attributes"] == {"kind": "test"}
+
+
+def test_traces_endpoint_rejects_bad_limit_and_unknown_paths():
+    import urllib.error
+
+    server = HealthServer(port=0)
+    server.start_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/traces?limit=abc")
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/tracesfoo")
+        assert e.value.code == 404
+    finally:
+        server.shutdown()
