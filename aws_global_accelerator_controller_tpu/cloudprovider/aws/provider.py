@@ -87,6 +87,18 @@ DELETE_POLL_INTERVAL = 10.0         # global_accelerator.go:756
 DELETE_POLL_TIMEOUT = 180.0         # global_accelerator.go:756
 TXT_RECORD_TTL = 300                # route53.go:276
 
+# Ownership-discovery cache TTL.  The reference re-discovers its
+# accelerators with a full ListAccelerators + per-ARN ListTags scan on
+# EVERY sync (global_accelerator.go:87-110) -- O(fleet) API calls per
+# reconcile.  We keep those semantics as the slow path but remember the
+# unique match per tag-set and serve steady-state syncs with a single
+# verified DescribeAccelerator+ListTags pair.  Entries are re-verified on
+# every hit (tag drift or deletion falls back to the scan immediately);
+# the TTL bounds how long an out-of-band DUPLICATE accelerator (a second
+# rogue match the verified hit cannot see) can go unnoticed -- 30s, the
+# same cadence as the informer resync backstop the reference relies on.
+DISCOVERY_CACHE_TTL = 30.0
+
 
 class AWSProvider:
     """Per-region provider over the three AWS service APIs."""
@@ -94,11 +106,18 @@ class AWSProvider:
     def __init__(self, apis: AWSAPIs,
                  delete_poll_interval: float = DELETE_POLL_INTERVAL,
                  delete_poll_timeout: float = DELETE_POLL_TIMEOUT,
-                 accelerator_not_found_retry: float = ACCELERATOR_NOT_FOUND_RETRY):
+                 accelerator_not_found_retry: float = ACCELERATOR_NOT_FOUND_RETRY,
+                 discovery_cache_ttl: float = DISCOVERY_CACHE_TTL):
         self.apis = apis
         self.delete_poll_interval = delete_poll_interval
         self.delete_poll_timeout = delete_poll_timeout
         self.accelerator_not_found_retry = accelerator_not_found_retry
+        self.discovery_cache_ttl = discovery_cache_ttl
+        # frozenset(target tag items) -> (arn, cached_at monotonic)
+        self._discovery_cache: dict = {}
+        # arn -> (tags, cached_at): spares the N+1 ListTags inside full
+        # scans; all tag writes in this provider invalidate write-through
+        self._tags_cache: dict = {}
 
     # ------------------------------------------------------------------
     # ELB
@@ -116,36 +135,92 @@ class AWSProvider:
     # Discovery by ownership tags
     # ------------------------------------------------------------------
 
-    def list_global_accelerator_by_hostname(
-            self, hostname: str, cluster_name: str) -> List[Accelerator]:
-        """(reference global_accelerator.go:62-85)"""
-        return self._list_by_tags({
+    @staticmethod
+    def _hostname_target(cluster_name: str, hostname: str) -> dict:
+        return {
             MANAGED_TAG_KEY: "true",
             TARGET_HOSTNAME_TAG_KEY: hostname,
             CLUSTER_TAG_KEY: cluster_name,
-        })
+        }
+
+    @staticmethod
+    def _owner_target(cluster_name: str, resource: str, ns: str,
+                      name: str) -> dict:
+        return {
+            MANAGED_TAG_KEY: "true",
+            OWNER_TAG_KEY: accelerator_owner_tag_value(resource, ns, name),
+            CLUSTER_TAG_KEY: cluster_name,
+        }
+
+    def list_global_accelerator_by_hostname(
+            self, hostname: str, cluster_name: str) -> List[Accelerator]:
+        """(reference global_accelerator.go:62-85)"""
+        return self._list_by_tags(
+            self._hostname_target(cluster_name, hostname))
 
     def list_global_accelerator_by_resource(
             self, cluster_name: str, resource: str, ns: str,
             name: str) -> List[Accelerator]:
         """(reference global_accelerator.go:87-110)"""
-        return self._list_by_tags({
-            MANAGED_TAG_KEY: "true",
-            OWNER_TAG_KEY: accelerator_owner_tag_value(resource, ns, name),
-            CLUSTER_TAG_KEY: cluster_name,
-        })
+        return self._list_by_tags(
+            self._owner_target(cluster_name, resource, ns, name))
 
     def _list_by_tags(self, target) -> List[Accelerator]:
+        key = frozenset(target.items())
+        hit = self._discovery_cache.get(key)
+        if hit is not None:
+            arn, cached_at = hit
+            if time.monotonic() - cached_at < self.discovery_cache_ttl:
+                try:
+                    accelerator = self.apis.ga.describe_accelerator(arn)
+                    tags = self.apis.ga.list_tags_for_resource(arn)
+                    # write the fresh tags through so a failed match's
+                    # fallback scan below can't re-match stale tags
+                    self._tags_cache[arn] = (tags, time.monotonic())
+                    if tags_contains_all_values(tags, target):
+                        return [accelerator]
+                except AWSAPIError:
+                    self._tags_cache.pop(arn, None)  # deleted out-of-band
+            self._discovery_cache.pop(key, None)
+
         result = []
         for accelerator in self.apis.ga.list_accelerators():
-            tags = self.apis.ga.list_tags_for_resource(
-                accelerator.accelerator_arn)
+            tags = self._tags_for(accelerator.accelerator_arn)
             if tags_contains_all_values(tags, target):
                 result.append(accelerator)
             else:
                 logger.debug("accelerator %s does not match tags",
                              accelerator.accelerator_arn)
+        if len(result) == 1:
+            self._discovery_cache[key] = (result[0].accelerator_arn,
+                                          time.monotonic())
         return result
+
+    def _prime_discovery_cache(self, arn: str, *targets: dict) -> None:
+        """Record a just-created accelerator so the next syncs skip the
+        full tag scan (they still verify the entry by direct describe)."""
+        now = time.monotonic()
+        for target in targets:
+            self._discovery_cache[frozenset(target.items())] = (arn, now)
+
+    def _invalidate_discovery_cache(self, arn: str) -> None:
+        for key in [k for k, (a, _) in list(self._discovery_cache.items())
+                    if a == arn]:
+            self._discovery_cache.pop(key, None)
+        self._tags_cache.pop(arn, None)
+
+    def _tags_for(self, arn: str):
+        """ListTags with a TTL cache, for scan loops only — verification
+        paths call the API directly so a cache hit is never trusted to
+        confirm itself.  Out-of-band tag edits surface within the TTL,
+        the same drift window the informer-resync backstop already has."""
+        hit = self._tags_cache.get(arn)
+        now = time.monotonic()
+        if hit is not None and now - hit[1] < self.discovery_cache_ttl:
+            return hit[0]
+        tags = self.apis.ga.list_tags_for_resource(arn)
+        self._tags_cache[arn] = (tags, now)
+        return tags
 
     # ------------------------------------------------------------------
     # Ensure (create-or-update) for Service / Ingress
@@ -228,6 +303,11 @@ class AWSProvider:
             specified_tags=accelerator_tags_from_annotations(obj),
         )
         arn = accelerator.accelerator_arn
+        self._prime_discovery_cache(
+            arn,
+            self._owner_target(cluster_name, resource,
+                               obj.metadata.namespace, obj.metadata.name),
+            self._hostname_target(cluster_name, lb.dns_name))
         try:
             ports, protocol = listener_spec()
             listener = self._create_listener(arn, ports, protocol)
@@ -316,6 +396,7 @@ class AWSProvider:
     def cleanup_global_accelerator(self, arn: str) -> None:
         """endpoint group -> listener -> accelerator
         (reference global_accelerator.go:254-272)."""
+        self._invalidate_discovery_cache(arn)
         accelerator, listener, endpoint_group = self._list_related(arn)
         if endpoint_group is not None:
             self.apis.ga.delete_endpoint_group(
@@ -391,6 +472,7 @@ class AWSProvider:
                     ip_address_type)
         accelerator = self.apis.ga.create_accelerator(
             name=name, ip_address_type=addr_type, enabled=True, tags=tags)
+        self._tags_cache.pop(accelerator.accelerator_arn, None)
         logger.info("Global Accelerator created: %s",
                     accelerator.accelerator_arn)
         return accelerator
@@ -407,6 +489,7 @@ class AWSProvider:
         }
         tags.update(specified_tags)
         self.apis.ga.tag_resource(arn, tags)
+        self._tags_cache.pop(arn, None)
         return updated
 
     def get_listener(self, accelerator_arn: str) -> Listener:

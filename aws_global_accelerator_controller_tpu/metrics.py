@@ -165,8 +165,11 @@ class HealthServer:
                     q = parse_qs(urlparse(self.path).query)
                     try:
                         limit = int(q.get("limit", ["100"])[0])
+                        if limit < 0:
+                            raise ValueError
                     except ValueError:
-                        self._respond(400, "limit must be an integer")
+                        self._respond(
+                            400, "limit must be a non-negative integer")
                         return
                     spans = default_tracer.recent(
                         limit=limit, name=q.get("name", [None])[0])

@@ -1,0 +1,155 @@
+"""Ownership-discovery cache: steady-state syncs skip the full tag scan
+but every hit is verified, and out-of-band drift falls back to the scan.
+
+The reference rescans the whole fleet (ListAccelerators + per-ARN
+ListTags) on EVERY sync (global_accelerator.go:87-110); this rebuild
+keeps that as the slow path and serves repeats from a verified,
+TTL-bounded cache (provider.py DISCOVERY_CACHE_TTL).
+"""
+import pytest
+
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
+    FakeCloudFactory,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.helpers import (
+    CLUSTER_TAG_KEY,
+    MANAGED_TAG_KEY,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+
+HOSTNAME = "mylb-0123456789abcdef.elb.ap-northeast-1.amazonaws.com"
+REGION = "ap-northeast-1"
+CLUSTER = "test-cluster"
+
+
+class CountingGA:
+    """Delegating proxy that counts fake GA API calls."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls = {}
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            return attr
+
+        def counted(*args, **kwargs):
+            self.calls[name] = self.calls.get(name, 0) + 1
+            return attr(*args, **kwargs)
+        return counted
+
+
+@pytest.fixture
+def env():
+    factory = FakeCloudFactory(settle_seconds=0.0)
+    provider = factory.provider_for(REGION)
+    counting = CountingGA(provider.apis.ga)
+    provider.apis.ga = counting
+    factory.cloud.elb.register_load_balancer("mylb", HOSTNAME, REGION)
+    return factory, provider, counting
+
+
+def _service():
+    return Service(
+        metadata=ObjectMeta(name="app", namespace="default"),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+    )
+
+
+def _ensure(provider):
+    return provider.ensure_global_accelerator_for_service(
+        _service(), LoadBalancerIngress(hostname=HOSTNAME), CLUSTER,
+        "mylb", REGION)
+
+
+def test_steady_state_syncs_skip_full_scan(env):
+    _, provider, ga = env
+    arn, created, _ = _ensure(provider)
+    assert created
+    scans_after_create = ga.calls.get("list_accelerators", 0)
+    for _ in range(5):
+        arn2, created2, _ = _ensure(provider)
+        assert arn2 == arn and not created2
+    # the 5 re-syncs were served by the primed cache: no new full scans
+    assert ga.calls["list_accelerators"] == scans_after_create
+    # ...but each hit was verified against the live API
+    assert ga.calls["describe_accelerator"] >= 5
+
+
+def test_out_of_band_delete_falls_back_to_scan_and_recreates(env):
+    factory, provider, ga = env
+    arn, _, _ = _ensure(provider)
+    with factory.cloud.ga._lock:  # out-of-band: yank fake state directly
+        del factory.cloud.ga._accelerators[arn]
+    before = ga.calls.get("list_accelerators", 0)
+    arn2, created, _ = _ensure(provider)
+    assert created and arn2 != arn
+    assert ga.calls["list_accelerators"] > before
+
+
+def test_out_of_band_tag_strip_invalidates_hit(env):
+    factory, provider, ga = env
+    arn, _, _ = _ensure(provider)
+    # strip the owner tag behind the controller's back
+    with factory.cloud.ga._lock:
+        factory.cloud.ga._accelerators[arn].tags = {
+            MANAGED_TAG_KEY: "true", CLUSTER_TAG_KEY: CLUSTER}
+    accs = provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", "app")
+    # verified hit fails the tag match -> full rescan finds nothing
+    assert accs == []
+
+
+def test_tag_strip_not_masked_by_warm_tag_cache(env):
+    """Even when a prior full scan populated the per-ARN tag cache, a
+    verified-hit mismatch must not let the fallback scan re-match the
+    accelerator through 30s-stale cached tags: the verify path writes
+    the fresh tags through before falling back."""
+    factory, provider, ga = env
+    arn, _, _ = _ensure(provider)
+    # a full scan for an unrelated hostname warms _tags_cache with the
+    # CURRENT (owned) tags of our accelerator
+    assert provider.list_global_accelerator_by_hostname(
+        "other.elb.amazonaws.com", CLUSTER) == []
+    with factory.cloud.ga._lock:  # out-of-band ownership release
+        factory.cloud.ga._accelerators[arn].tags = {
+            MANAGED_TAG_KEY: "true", CLUSTER_TAG_KEY: CLUSTER}
+    accs = provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", "app")
+    assert accs == []
+
+
+def test_duplicate_detected_after_ttl_expiry(env):
+    factory, provider, ga = env
+    provider.discovery_cache_ttl = 0.0  # force immediate expiry
+    arn, _, _ = _ensure(provider)
+    owner_tags = factory.cloud.ga.list_tags_for_resource(arn)
+    rogue = factory.cloud.ga.create_accelerator(
+        name="rogue", ip_address_type="DUAL_STACK", enabled=True,
+        tags=dict(owner_tags))
+    accs = provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", "app")
+    assert len(accs) == 2
+    assert {a.accelerator_arn for a in accs} == {
+        arn, rogue.accelerator_arn}
+
+
+def test_tag_update_visible_immediately_via_writethrough(env):
+    """A tag change made through the provider invalidates the tag cache,
+    so discovery under the NEW owner works without waiting for the TTL."""
+    _, provider, ga = env
+    arn, _, _ = _ensure(provider)
+    provider._update_accelerator(
+        arn, name="renamed", owner="service/other/name",
+        hostname=HOSTNAME, specified_tags={})
+    accs = provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "other", "name")
+    assert [a.accelerator_arn for a in accs] == [arn]

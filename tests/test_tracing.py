@@ -163,6 +163,9 @@ def test_traces_endpoint_rejects_bad_limit_and_unknown_paths():
             urllib.request.urlopen(base + "/traces?limit=abc")
         assert e.value.code == 400
         with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/traces?limit=-5")
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(base + "/tracesfoo")
         assert e.value.code == 404
     finally:
