@@ -1,0 +1,250 @@
+"""Pallas flash attention: the single-chip hot kernel under ring attention.
+
+Blockwise softmax attention with the flash online recurrence, tiled for
+the MXU: the [T, T] score matrix is never materialised — each grid step
+computes one [Bq, Bk] score tile, rescales the running (max, denom,
+output) accumulators held in VMEM scratch, and only the final K step
+writes the normalised [Bq, D] output block to HBM.  Combined with
+``parallel.ring_attention`` (which rotates K/V blocks across chips) this
+gives the two-level long-context story: ring over ICI, flash within the
+chip.
+
+Layout: grid (heads, q_blocks, k_blocks), K innermost so the scratch
+accumulators persist across the K sweep for a fixed (head, q block).
+Causal masking uses global positions; K blocks strictly in the future of
+a Q block are skipped entirely (``pl.when``), saving ~half the FLOPs.
+Sequence and head dims pad to tile multiples outside the kernel; padded
+key positions are masked to -inf, padded query rows are sliced off.
+
+Runs in interpret mode off-TPU (tests compare against the dense oracle
+``parallel.ring_attention.attention_reference``), compiled on TPU
+(/opt/skills/guides/pallas_guide.md; float32 accumulation via
+preferred_element_type).  Forward-only: the compute track uses it for
+telemetry aggregation at planning time, not under a gradient.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANE = 128  # last-dim tile width; also the m/l scratch lane padding
+
+
+def _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, *,
+                 causal: bool, scale: float, t: int, block_q: int,
+                 block_k: int):
+    """Shared online-softmax step: fold K block j into the (m, l, acc)
+    scratch for Q block i.  Callers add init/finalize around it."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: skip K blocks strictly in the future of this Q block
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32)          # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)          # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [Bq, Bk]
+
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        keep = k_pos < t  # padded key positions contribute nothing
+        if causal:
+            keep &= q_pos >= k_pos
+        s = jnp.where(keep, s, _NEG_INF)
+
+        m_prev = m_ref[:, 0]                      # [Bq]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])           # [Bq, Bk]
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(
+            (l_prev * alpha + p.sum(axis=1))[:, None], l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+            causal: bool, scale: float, t: int, block_q: int,
+            block_k: int, num_k: int):
+    _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                 causal=causal, scale=scale, t=t, block_q=block_q,
+                 block_k=block_k)
+
+    @pl.when(pl.program_id(2) == num_k - 1)
+    def _finalize():
+        # every live query row attended >=1 unmasked key, so l > 0
+        o_ref[0] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(o_ref.dtype)
+
+
+def _stats_kernel(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
+                  m_ref, l_ref, acc_ref, *, causal: bool, scale: float,
+                  t: int, block_q: int, block_k: int, num_k: int):
+    """Like _kernel but emits UNNORMALISED output plus the (m, l) softmax
+    stats, so a caller (ring attention) can merge blocks computed
+    elsewhere with the standard two-level flash recurrence."""
+    _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                 causal=causal, scale=scale, t=t, block_q=block_q,
+                 block_k=block_k)
+
+    @pl.when(pl.program_id(2) == num_k - 1)
+    def _finalize():
+        o_ref[0] = acc_ref[:]
+        m_out_ref[0] = m_ref[:]
+        l_out_ref[0] = l_ref[:]
+
+
+def _pad_axis(x, axis, to):
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, to - x.shape[axis])
+    return jnp.pad(x, pad)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    t, h, d = q.shape
+    scale = d ** -0.5
+    tp_q = -(-t // block_q) * block_q
+    tp_k = -(-t // block_k) * block_k
+    dp = -(-d // _LANE) * _LANE
+
+    # [T, H, D] -> [H, T, D], padded to tile multiples
+    def prep(x, tp):
+        x = jnp.transpose(x, (1, 0, 2))
+        return _pad_axis(_pad_axis(x, 1, tp), 2, dp)
+
+    qp, kp, vp = prep(q, tp_q), prep(k, tp_k), prep(v, tp_k)
+    num_k = tp_k // block_k
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, causal=causal, scale=scale, t=t,
+                          block_q=block_q, block_k=block_k, num_k=num_k),
+        grid=(h, tp_q // block_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda hh, i, j: (hh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda hh, i, j: (hh, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda hh, i, j: (hh, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp),
+                               lambda hh, i, j: (hh, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((h, tp_q, dp), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # running max
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, dp), jnp.float32),      # running output
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return jnp.transpose(out[:, :t, :d], (1, 0, 2))
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, block_q: int = 128,
+                    block_k: int = 128) -> jax.Array:
+    """q, k, v: [T, H, D] -> [T, H, D]; exact softmax attention.
+
+    Drop-in for ``parallel.ring_attention.attention_reference`` on one
+    chip; float32 accumulation regardless of input dtype.
+    """
+    interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def _flash_stats(q, k, v, causal, block_q, block_k, interpret):
+    h, t, d = q.shape
+    t_k = k.shape[1]
+    scale = d ** -0.5
+    tp_q = -(-t // block_q) * block_q
+    tp_k = -(-t_k // block_k) * block_k
+    dp = -(-d // _LANE) * _LANE
+    qp = _pad_axis(_pad_axis(q, 1, tp_q), 2, dp)
+    kp = _pad_axis(_pad_axis(k, 1, tp_k), 2, dp)
+    vp = _pad_axis(_pad_axis(v, 1, tp_k), 2, dp)
+    num_k = tp_k // block_k
+
+    o, m, l = pl.pallas_call(
+        functools.partial(_stats_kernel, causal=causal, scale=scale,
+                          t=t_k, block_q=block_q, block_k=block_k,
+                          num_k=num_k),
+        grid=(h, tp_q // block_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda hh, i, j: (hh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda hh, i, j: (hh, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda hh, i, j: (hh, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda hh, i, j: (hh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, tp_q, dp), jnp.float32),
+            jax.ShapeDtypeStruct((h, tp_q, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((h, tp_q, _LANE), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :t, :d], m[:, :t, 0], l[:, :t, 0]
+
+
+def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
+                          causal: bool = False, block_q: int = 128,
+                          block_k: int = 128):
+    """Head-major flash attention returning merge-ready softmax stats.
+
+    q: [H, Tq, D], k/v: [H, Tk, D] -> (o_unnorm [H, Tq, D] f32,
+    m [H, Tq] f32, l [H, Tq] f32) where the normalised output would be
+    ``o_unnorm / l[..., None]``.  Two partial results over disjoint key
+    sets merge exactly with the flash recurrence:
+
+        m12 = max(m1, m2); a = exp(m1-m12); b = exp(m2-m12)
+        o12 = o1*a + o2*b;  l12 = l1*a + l2*b
+
+    which is how ``parallel.ring_attention`` (local='flash') folds the
+    K/V blocks arriving over the device ring.  ``causal`` here means
+    *relative* positions (q index >= k index) — the diagonal-block case.
+    """
+    interpret = jax.default_backend() != "tpu"
+    return _flash_stats(q, k, v, causal, block_q, block_k, interpret)

@@ -53,7 +53,7 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def make_ring_attention(mesh: Mesh, axis: str = "seq",
-                        causal: bool = False):
+                        causal: bool = False, local: str = "einsum"):
     """Compile fn(q, k, v: [T, H, D], time-sharded over ``axis``) ->
     [T, H, D] time-sharded, equal to :func:`attention_reference`.
 
@@ -61,7 +61,17 @@ def make_ring_attention(mesh: Mesh, axis: str = "seq",
     currently-held K/V block, folds the partial scores into the online
     softmax state, then rotates K/V one hop; the final step skips the
     (wasted) rotation.
+
+    ``local`` selects the per-block attend implementation:
+    - ``"einsum"``: XLA einsums over the whole [H, T_b, S_b] score block;
+    - ``"flash"``: the Pallas MXU kernel (ops.pallas_attention), which
+      tiles the block and never materialises its scores — the two-level
+      long-context path, ring over ICI outside, flash in VMEM inside.
+      Block stats (unnormalised o, m, l) merge with the same flash
+      recurrence the einsum path applies tile-by-tile.
     """
+    if local not in ("einsum", "flash"):
+        raise ValueError(f"unknown local attend {local!r}")
     n = mesh.shape[axis]
 
     @partial(jax.shard_map, mesh=mesh,
@@ -76,7 +86,7 @@ def make_ring_attention(mesh: Mesh, axis: str = "seq",
         perm = [(i, (i + 1) % n) for i in range(n)]
         q_pos = my * t_b + jnp.arange(t_b)  # global query positions
 
-        def attend(carry, step):
+        def attend_einsum(carry, step):
             o, m, l, kb, vb = carry
             # [H, T_b, S_b] partial scores vs the block currently held
             s = jnp.einsum("thd,shd->hts", qf,
@@ -93,6 +103,37 @@ def make_ring_attention(mesh: Mesh, axis: str = "seq",
             o = o * alpha[..., None] + jnp.einsum(
                 "hts,shd->htd", p, vb.astype(jnp.float32))
             return o, m_new, l, kb, vb
+
+        def attend_flash(carry, step):
+            from ..ops.pallas_attention import flash_attention_stats
+
+            o, m, l, kb, vb = carry
+            qh = jnp.transpose(qf, (1, 0, 2))              # [H, T_b, D]
+            kh = jnp.transpose(kb, (1, 0, 2))
+            vh = jnp.transpose(vb, (1, 0, 2))
+
+            def block_stats(diag_causal):
+                return lambda: flash_attention_stats(
+                    qh, kh, vh, causal=diag_causal)
+
+            if causal:
+                # the only causal-masked block is the diagonal (src ==
+                # my: same global offset, so relative == global mask);
+                # strictly-past blocks attend in full
+                src = jnp.mod(my - step, n)
+                o_b, m_b, l_b = jax.lax.cond(
+                    src == my, block_stats(True), block_stats(False))
+            else:
+                o_b, m_b, l_b = block_stats(False)()
+            # two-level flash merge of disjoint-key partials
+            m_new = jnp.maximum(m, m_b)
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(m_b - m_new)
+            l = l * alpha + l_b * beta
+            o = o * alpha[..., None] + o_b * beta[..., None]
+            return o, m_new, l, kb, vb
+
+        attend = attend_einsum if local == "einsum" else attend_flash
 
         def fold(step, carry):
             if not causal:

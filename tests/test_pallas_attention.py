@@ -1,0 +1,85 @@
+"""Pallas flash attention vs the dense oracle (interpret mode on CPU)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aws_global_accelerator_controller_tpu.ops.pallas_attention import (
+    flash_attention,
+)
+from aws_global_accelerator_controller_tpu.parallel.ring_attention import (
+    attention_reference,
+)
+
+
+def _qkv(t, h, d, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (t, h, d), dtype=dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t,h,d", [
+    (64, 2, 16),     # single block, padded everywhere
+    (128, 1, 128),   # exact tile fit
+    (200, 2, 40),    # ragged T: padded query rows + masked padded keys
+    (384, 1, 64),    # multiple q and k blocks
+])
+def test_matches_dense_oracle(t, h, d, causal):
+    q, k, v = _qkv(t, h, d, seed=t + int(causal))
+    got = flash_attention(q, k, v, causal=causal)
+    want = attention_reference(q, k, v, causal=causal)
+    assert got.shape == (t, h, d)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_small_blocks_multi_block_sweep():
+    q, k, v = _qkv(96, 2, 8, seed=9)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bfloat16_accumulates_in_float32():
+    q, k, v = _qkv(64, 2, 32, seed=3)
+    got = flash_attention(*(x.astype(jnp.bfloat16) for x in (q, k, v)))
+    assert got.dtype == jnp.bfloat16
+    want = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                               np.asarray(want), rtol=5e-2, atol=5e-2)
+
+
+def test_stats_merge_equals_full_attention():
+    """Two stats calls over disjoint key halves, merged with the flash
+    recurrence, must equal attention over the concatenated keys."""
+    from aws_global_accelerator_controller_tpu.ops.pallas_attention import (
+        flash_attention_stats,
+    )
+
+    q, k, v = _qkv(64, 2, 16, seed=21)
+    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    o1, m1, l1 = flash_attention_stats(qh, kh[:, :32], vh[:, :32])
+    o2, m2, l2 = flash_attention_stats(qh, kh[:, 32:], vh[:, 32:])
+    m12 = jnp.maximum(m1, m2)
+    a, b = jnp.exp(m1 - m12), jnp.exp(m2 - m12)
+    merged = ((o1 * a[..., None] + o2 * b[..., None])
+              / (l1 * a + l2 * b)[..., None])
+    want = attention_reference(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(merged, (1, 0, 2))), np.asarray(want),
+        rtol=2e-5, atol=2e-5)
+
+
+def test_causal_prefix_invariance():
+    """Causal output at position p must not change when the suffix after
+    p changes — the block-skip logic must not leak future blocks."""
+    q, k, v = _qkv(160, 1, 16, seed=5)
+    base = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    k2 = k.at[150:].add(3.0)
+    v2 = v.at[150:].add(3.0)
+    out = flash_attention(q, k2, v2, causal=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out[:150]),
+                               np.asarray(base[:150]),
+                               rtol=2e-5, atol=2e-5)
+    assert not np.allclose(np.asarray(out[159]), np.asarray(base[159]))

@@ -32,6 +32,26 @@ def test_matches_dense_oracle(n_dev, causal):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("n_dev", [2, 4])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_local_matches_dense_oracle(n_dev, causal):
+    """Ring over devices with the Pallas flash kernel as the per-block
+    attend (the two-level long-context path)."""
+    mesh = make_mesh_1d(n_dev, "seq")
+    q, k, v = _qkv(t=8 * n_dev, h=2, d=16, seed=10 + n_dev)
+    got = make_ring_attention(mesh, "seq", causal=causal,
+                              local="flash")(q, k, v)
+    want = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_unknown_local_attend_rejected():
+    mesh = make_mesh_1d(2, "seq")
+    with pytest.raises(ValueError):
+        make_ring_attention(mesh, "seq", local="nope")
+
+
 def test_causal_first_position_attends_only_itself():
     mesh = make_mesh_1d(4, "seq")
     q, k, v = _qkv(t=8, h=1, d=4, seed=7)
