@@ -20,6 +20,7 @@ SURVEY.md §7 "Deliberate improvements"):
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -113,6 +114,16 @@ class AWSProvider:
         self.delete_poll_timeout = delete_poll_timeout
         self.accelerator_not_found_retry = accelerator_not_found_retry
         self.discovery_cache_ttl = discovery_cache_ttl
+        # Caches shared by the worker threads that share this provider
+        # (factory caches one provider per region).  _cache_lock guards
+        # every read-modify below; _cache_gen is a single global
+        # generation counter bumped by every invalidation, so an
+        # in-flight ListTags started before ANY invalidation cannot
+        # re-insert pre-invalidation tags afterwards (conservative --
+        # unrelated invalidations just skip an insert -- and O(1) memory
+        # where a per-ARN counter would grow with accelerator churn).
+        self._cache_lock = threading.Lock()
+        self._cache_gen = 0
         # frozenset(target tag items) -> (arn, cached_at monotonic)
         self._discovery_cache: dict = {}
         # arn -> (tags, cached_at): spares the N+1 ListTags inside full
@@ -167,21 +178,26 @@ class AWSProvider:
 
     def _list_by_tags(self, target) -> List[Accelerator]:
         key = frozenset(target.items())
-        hit = self._discovery_cache.get(key)
+        with self._cache_lock:
+            hit = self._discovery_cache.get(key)
         if hit is not None:
             arn, cached_at = hit
             if time.monotonic() - cached_at < self.discovery_cache_ttl:
+                with self._cache_lock:
+                    gen = self._cache_gen
                 try:
                     accelerator = self.apis.ga.describe_accelerator(arn)
                     tags = self.apis.ga.list_tags_for_resource(arn)
                     # write the fresh tags through so a failed match's
                     # fallback scan below can't re-match stale tags
-                    self._tags_cache[arn] = (tags, time.monotonic())
+                    self._store_tags(arn, tags, gen)
                     if tags_contains_all_values(tags, target):
                         return [accelerator]
                 except AWSAPIError:
-                    self._tags_cache.pop(arn, None)  # deleted out-of-band
-            self._discovery_cache.pop(key, None)
+                    with self._cache_lock:  # deleted out-of-band
+                        self._drop_tags_locked(arn)
+            with self._cache_lock:
+                self._discovery_cache.pop(key, None)
 
         result = []
         for accelerator in self.apis.ga.list_accelerators():
@@ -192,34 +208,51 @@ class AWSProvider:
                 logger.debug("accelerator %s does not match tags",
                              accelerator.accelerator_arn)
         if len(result) == 1:
-            self._discovery_cache[key] = (result[0].accelerator_arn,
-                                          time.monotonic())
+            with self._cache_lock:
+                self._discovery_cache[key] = (result[0].accelerator_arn,
+                                              time.monotonic())
         return result
 
     def _prime_discovery_cache(self, arn: str, *targets: dict) -> None:
         """Record a just-created accelerator so the next syncs skip the
         full tag scan (they still verify the entry by direct describe)."""
         now = time.monotonic()
-        for target in targets:
-            self._discovery_cache[frozenset(target.items())] = (arn, now)
+        with self._cache_lock:
+            for target in targets:
+                self._discovery_cache[frozenset(target.items())] = (arn, now)
 
     def _invalidate_discovery_cache(self, arn: str) -> None:
-        for key in [k for k, (a, _) in list(self._discovery_cache.items())
-                    if a == arn]:
-            self._discovery_cache.pop(key, None)
+        with self._cache_lock:
+            stale = [k for k, (a, _) in self._discovery_cache.items()
+                     if a == arn]
+            for key in stale:
+                self._discovery_cache.pop(key, None)
+            self._drop_tags_locked(arn)
+
+    def _drop_tags_locked(self, arn: str) -> None:
+        """Invalidate cached tags; bumping the generation fences out any
+        in-flight ListTags read started before this point."""
         self._tags_cache.pop(arn, None)
+        self._cache_gen += 1
+
+    def _store_tags(self, arn: str, tags, gen: int) -> None:
+        with self._cache_lock:
+            if self._cache_gen == gen:
+                self._tags_cache[arn] = (tags, time.monotonic())
 
     def _tags_for(self, arn: str):
         """ListTags with a TTL cache, for scan loops only — verification
         paths call the API directly so a cache hit is never trusted to
         confirm itself.  Out-of-band tag edits surface within the TTL,
         the same drift window the informer-resync backstop already has."""
-        hit = self._tags_cache.get(arn)
-        now = time.monotonic()
-        if hit is not None and now - hit[1] < self.discovery_cache_ttl:
-            return hit[0]
+        with self._cache_lock:
+            hit = self._tags_cache.get(arn)
+            now = time.monotonic()
+            if hit is not None and now - hit[1] < self.discovery_cache_ttl:
+                return hit[0]
+            gen = self._cache_gen
         tags = self.apis.ga.list_tags_for_resource(arn)
-        self._tags_cache[arn] = (tags, now)
+        self._store_tags(arn, tags, gen)
         return tags
 
     # ------------------------------------------------------------------
@@ -472,7 +505,8 @@ class AWSProvider:
                     ip_address_type)
         accelerator = self.apis.ga.create_accelerator(
             name=name, ip_address_type=addr_type, enabled=True, tags=tags)
-        self._tags_cache.pop(accelerator.accelerator_arn, None)
+        with self._cache_lock:
+            self._drop_tags_locked(accelerator.accelerator_arn)
         logger.info("Global Accelerator created: %s",
                     accelerator.accelerator_arn)
         return accelerator
@@ -489,7 +523,8 @@ class AWSProvider:
         }
         tags.update(specified_tags)
         self.apis.ga.tag_resource(arn, tags)
-        self._tags_cache.pop(arn, None)
+        with self._cache_lock:
+            self._drop_tags_locked(arn)
         return updated
 
     def get_listener(self, accelerator_arn: str) -> Listener:

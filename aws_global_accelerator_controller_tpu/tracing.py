@@ -91,16 +91,17 @@ class Tracer:
         stack = self._stack()
         return stack[-1] if stack else None
 
-    def recent(self, limit: int = 0,
+    def recent(self, limit: Optional[int] = None,
                name: Optional[str] = None) -> List[dict]:
         """Most-recent-last completed spans; optionally filtered by name
-        prefix and truncated to the last ``limit``."""
+        prefix and truncated to the last ``limit`` (``limit=0`` means
+        zero spans; ``None`` means all)."""
         with self._lock:
             spans = list(self._spans)
         if name is not None:
             spans = [s for s in spans if s.name.startswith(name)]
-        if limit:
-            spans = spans[-limit:]
+        if limit is not None:
+            spans = spans[-limit:] if limit > 0 else []
         return [s.to_dict() for s in spans]
 
     def clear(self) -> None:

@@ -165,6 +165,14 @@ def test_traces_endpoint_rejects_bad_limit_and_unknown_paths():
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(base + "/traces?limit=-5")
         assert e.value.code == 400
+        # limit=0 is the "dump everything buffered" contract
+        default_tracer.clear()
+        for i in range(3):
+            with default_tracer.span(f"dump{i}"):
+                pass
+        body = json.loads(urllib.request.urlopen(
+            base + "/traces?limit=0&name=dump").read())
+        assert len(body["spans"]) == 3
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(base + "/tracesfoo")
         assert e.value.code == 404
