@@ -180,11 +180,10 @@ class AWSProvider:
         key = frozenset(target.items())
         with self._cache_lock:
             hit = self._discovery_cache.get(key)
+            gen = self._cache_gen
         if hit is not None:
             arn, cached_at = hit
             if time.monotonic() - cached_at < self.discovery_cache_ttl:
-                with self._cache_lock:
-                    gen = self._cache_gen
                 try:
                     accelerator = self.apis.ga.describe_accelerator(arn)
                     tags = self.apis.ga.list_tags_for_resource(arn)

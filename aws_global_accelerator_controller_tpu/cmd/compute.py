@@ -1,0 +1,130 @@
+"""CLI subcommands for the TPU compute track: train | plan.
+
+The reference CLI has only {controller|webhook|version} (cmd/root.go:
+13-30) because the reference has no compute.  These commands make the
+compute track user-facing: ``train`` fits the traffic policy model on
+synthetic fleet telemetry with orbax checkpointing (resumable), ``plan``
+loads a checkpoint (or a fresh init) and emits Global Accelerator
+endpoint weights for a fleet as JSON.
+
+JAX is imported lazily inside the run functions so `controller`/
+`webhook`/`version` never pay for (or hang on) accelerator backend
+initialisation.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def register(sub) -> None:
+    train = sub.add_parser(
+        "train", help="Train the traffic policy model (TPU compute track)")
+    train.add_argument("--steps", type=int, default=100,
+                       help="Optimisation steps to run this invocation.")
+    train.add_argument("--ckpt", default="",
+                       help="Checkpoint directory (enables save/resume).")
+    train.add_argument("--save-every", type=int, default=50,
+                       help="Checkpoint cadence in steps.")
+    train.add_argument("--groups", type=int, default=256,
+                       help="Endpoint groups per synthetic batch.")
+    train.add_argument("--endpoints", type=int, default=32,
+                       help="Endpoints per group.")
+    train.add_argument("--hidden", type=int, default=128,
+                       help="Model hidden width.")
+    train.add_argument("--lr", type=float, default=1e-3,
+                       help="Adam learning rate.")
+    train.add_argument("--seed", type=int, default=0,
+                       help="PRNG seed for init and batches.")
+
+    plan = sub.add_parser(
+        "plan", help="Plan GA endpoint weights for a fleet (JSON out)")
+    plan.add_argument("--ckpt", default="",
+                      help="Checkpoint directory to load params from "
+                           "(default: fresh init).")
+    plan.add_argument("--groups", type=int, default=8,
+                      help="Endpoint groups in the synthetic fleet.")
+    plan.add_argument("--endpoints", type=int, default=16,
+                      help="Endpoints per group.")
+    plan.add_argument("--hidden", type=int, default=128,
+                      help="Model hidden width (must match the ckpt).")
+    plan.add_argument("--seed", type=int, default=0,
+                      help="PRNG seed for the synthetic telemetry.")
+
+
+def run_train(args) -> int:
+    import jax
+
+    from ..models.checkpoint import TrainCheckpointer
+    from ..models.traffic import TrafficPolicyModel, synthetic_batch
+
+    model = TrafficPolicyModel(hidden_dim=args.hidden,
+                               learning_rate=args.lr)
+    start_step = 0
+    key = jax.random.PRNGKey(args.seed)
+    params = model.init_params(key)
+    opt_state = model.init_opt_state(params)
+
+    ckpt = TrainCheckpointer(args.ckpt) if args.ckpt else None
+    if ckpt is not None and ckpt.latest_step() is not None:
+        start_step, params, opt_state = ckpt.restore(model)
+        logger.info("resumed from step %d (%s)", start_step, args.ckpt)
+
+    step_fn = jax.jit(model.train_step)
+    loss = None
+    for step in range(start_step, start_step + args.steps):
+        batch = synthetic_batch(jax.random.fold_in(key, step),
+                                groups=args.groups,
+                                endpoints=args.endpoints)
+        params, opt_state, loss = step_fn(params, opt_state, batch)
+        if (ckpt is not None and args.save_every > 0
+                and (step + 1) % args.save_every == 0):
+            ckpt.save(step + 1, params, opt_state)
+        if (step + 1) % max(1, args.steps // 10) == 0:
+            logger.info("step %d loss %.5f", step + 1, float(loss))
+
+    final_step = start_step + args.steps
+    if ckpt is not None:
+        # the periodic save may already hold this exact step (orbax
+        # raises StepAlreadyExistsError on a duplicate save)
+        if ckpt.latest_step() != final_step:
+            ckpt.save(final_step, params, opt_state, wait=True)
+        ckpt.close()
+    print(json.dumps({"step": final_step,
+                      "loss": float(loss) if loss is not None else None,
+                      "backend": jax.default_backend()}))
+    return 0
+
+
+def run_plan(args) -> int:
+    import jax
+
+    from ..models.traffic import TrafficPolicyModel, synthetic_batch
+
+    model = TrafficPolicyModel(hidden_dim=args.hidden)
+    if args.ckpt:
+        from ..models.checkpoint import TrainCheckpointer
+        with TrainCheckpointer(args.ckpt) as ckpt:
+            step, params, _ = ckpt.restore(model)
+        logger.info("planning with step-%d params from %s", step,
+                    args.ckpt)
+    else:
+        params = model.init_params(jax.random.PRNGKey(args.seed))
+
+    batch = synthetic_batch(jax.random.PRNGKey(args.seed + 1),
+                            groups=args.groups,
+                            endpoints=args.endpoints)
+    weights = jax.jit(model.forward)(params, batch.features, batch.mask)
+    out = {
+        "groups": args.groups,
+        "endpoints": args.endpoints,
+        # int weights in [0, 255], 0 on padded slots -- the values
+        # UpdateEndpointWeight would apply per endpoint
+        "weights": [[int(w) for w in row] for row in weights],
+    }
+    json.dump(out, sys.stdout)
+    print()
+    return 0

@@ -15,6 +15,7 @@ import logging
 import os
 import sys
 
+from . import compute
 from .. import BUILD, REVISION, VERSION
 from ..cloudprovider.aws.factory import BotoCloudFactory, FakeCloudFactory
 from ..controller.endpointgroupbinding import EndpointGroupBindingConfig
@@ -82,6 +83,7 @@ def build_parser() -> argparse.ArgumentParser:
                            help="Serve plain HTTP.")
 
     sub.add_parser("version", help="Print the version number")
+    compute.register(sub)
     return parser
 
 
@@ -236,4 +238,8 @@ def main(argv=None) -> int:
         return run_webhook(args)
     if args.command == "version":
         return run_version(args)
+    if args.command == "train":
+        return compute.run_train(args)
+    if args.command == "plan":
+        return compute.run_plan(args)
     return 2

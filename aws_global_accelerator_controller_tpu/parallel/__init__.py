@@ -6,6 +6,10 @@ the TPU-native scale-out path for the compute track: jax.sharding Meshes
 with data x model axes, NamedSharding-annotated pjit programs, and XLA
 collectives over ICI inserted by the compiler.
 """
+from .distributed import (  # noqa: F401
+    initialize_multihost,
+    make_hybrid_mesh,
+)
 from .experts import (  # noqa: F401
     expert_scores_reference,
     init_expert_params,

@@ -1,0 +1,67 @@
+"""CLI compute track: train (with resume) and plan subcommands."""
+import json
+
+from aws_global_accelerator_controller_tpu.cmd.root import main
+
+
+def test_train_checkpoints_and_resumes(tmp_path, capsys):
+    ckpt = str(tmp_path / "ckpt")
+    assert main(["train", "--steps", "3", "--ckpt", ckpt,
+                 "--groups", "8", "--endpoints", "8",
+                 "--hidden", "16", "--save-every", "2"]) == 0
+    first = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert first["step"] == 3
+    assert first["loss"] is not None
+
+    # second invocation resumes from step 3
+    assert main(["train", "--steps", "2", "--ckpt", ckpt,
+                 "--groups", "8", "--endpoints", "8",
+                 "--hidden", "16"]) == 0
+    second = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert second["step"] == 5
+
+
+def test_train_steps_multiple_of_save_every_does_not_crash(tmp_path,
+                                                           capsys):
+    """Periodic save at the final step + unconditional final save must
+    not collide (orbax raises StepAlreadyExistsError on duplicates)."""
+    ckpt = str(tmp_path / "ckpt")
+    assert main(["train", "--steps", "4", "--ckpt", ckpt,
+                 "--groups", "8", "--endpoints", "8",
+                 "--hidden", "16", "--save-every", "2"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["step"] == 4
+
+
+def test_plan_emits_valid_weight_allocations(tmp_path, capsys):
+    assert main(["plan", "--groups", "4", "--endpoints", "6",
+                 "--hidden", "16"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["groups"] == 4 and out["endpoints"] == 6
+    assert len(out["weights"]) == 4
+    for row in out["weights"]:
+        assert len(row) == 6
+        assert all(0 <= w <= 255 for w in row)
+        # valid (unmasked) endpoints share ~255 total; padded rows are 0
+        assert sum(row) <= 255 + 3  # rounding slack
+
+
+def test_plan_uses_trained_checkpoint(tmp_path, capsys):
+    ckpt = str(tmp_path / "c")
+    assert main(["train", "--steps", "2", "--ckpt", ckpt,
+                 "--groups", "8", "--endpoints", "8",
+                 "--hidden", "16"]) == 0
+    capsys.readouterr()
+    assert main(["plan", "--ckpt", ckpt, "--groups", "3",
+                 "--endpoints", "5", "--hidden", "16"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(out["weights"]) == 3
+
+
+def test_help_lists_compute_subcommands(capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["--help"])
+    help_text = capsys.readouterr().out
+    assert "train" in help_text and "plan" in help_text

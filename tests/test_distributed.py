@@ -1,0 +1,75 @@
+"""Multi-host helpers on the single-process 8-device CPU mesh (the
+degenerate case every multi-host program must also run in)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aws_global_accelerator_controller_tpu.parallel.distributed import (
+    _factor_into,
+    initialize_multihost,
+    make_hybrid_mesh,
+)
+
+
+def test_single_process_needs_no_init(caplog):
+    assert initialize_multihost() is False  # no coordinator configured
+
+
+def test_hybrid_mesh_degenerates_cleanly():
+    mesh = make_hybrid_mesh(dcn_axes=("replica",),
+                            ici_axes=("data", "model"))
+    assert mesh.shape["replica"] == 1          # single process
+    assert mesh.shape["data"] * mesh.shape["model"] == 8
+    assert mesh.shape["data"] >= mesh.shape["model"]
+
+
+def test_hybrid_mesh_explicit_ici_shape():
+    mesh = make_hybrid_mesh(dcn_axes=("replica",),
+                            ici_axes=("data", "model"),
+                            ici_shape=(2, 4))
+    assert mesh.shape["data"] == 2 and mesh.shape["model"] == 4
+    with pytest.raises(ValueError):
+        make_hybrid_mesh(ici_axes=("data",), ici_shape=(3,))
+
+
+def test_hybrid_mesh_explicit_dcn_shape_validated():
+    # single process: only the all-ones split is valid
+    mesh = make_hybrid_mesh(dcn_axes=("pipe", "data"),
+                            ici_axes=("model",), dcn_shape=(1, 1))
+    assert mesh.shape["pipe"] == 1 and mesh.shape["data"] == 1
+    with pytest.raises(ValueError):
+        make_hybrid_mesh(dcn_axes=("pipe", "data"), ici_axes=("model",),
+                         dcn_shape=(2, 1))  # != process count
+    with pytest.raises(ValueError):
+        make_hybrid_mesh(dcn_axes=("pipe", "data"), ici_axes=("model",),
+                         dcn_shape=(1,))    # wrong arity
+
+
+def test_collectives_run_over_hybrid_mesh():
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_hybrid_mesh(dcn_axes=("replica",),
+                            ici_axes=("data", "model"))
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=P("data", "model"), out_specs=P(),
+             check_vma=False)
+    def global_sum(x):
+        return jax.lax.psum(jax.lax.psum(
+            jnp.sum(x), "model"), ("replica", "data"))
+
+    x = jnp.arange(8 * 16, dtype=jnp.float32).reshape(8, 16)
+    got = global_sum(x)
+    np.testing.assert_allclose(float(got), float(x.sum()), rtol=1e-6)
+
+
+@pytest.mark.parametrize("n,parts,want_prod", [
+    (8, 2, 8), (8, 1, 8), (6, 2, 6), (7, 2, 7), (12, 3, 12), (1, 2, 1),
+])
+def test_factor_into_products(n, parts, want_prod):
+    shape = _factor_into(n, parts)
+    assert len(shape) == parts
+    assert int(np.prod(shape)) == want_prod
