@@ -23,6 +23,12 @@ logger = logging.getLogger(__name__)
 def register(sub) -> None:
     train = sub.add_parser(
         "train", help="Train the traffic policy model (TPU compute track)")
+    train.add_argument("--model", choices=("mlp", "temporal"),
+                       default="mlp",
+                       help="mlp: snapshot MLP; temporal: causal "
+                            "attention over a telemetry window.")
+    train.add_argument("--window", type=int, default=8,
+                       help="Telemetry window length (temporal model).")
     train.add_argument("--steps", type=int, default=100,
                        help="Optimisation steps to run this invocation.")
     train.add_argument("--ckpt", default="",
@@ -42,6 +48,12 @@ def register(sub) -> None:
 
     plan = sub.add_parser(
         "plan", help="Plan GA endpoint weights for a fleet (JSON out)")
+    plan.add_argument("--model", choices=("mlp", "temporal"),
+                      default="mlp",
+                      help="Must match the model the ckpt was trained "
+                           "with.")
+    plan.add_argument("--window", type=int, default=8,
+                      help="Telemetry window length (temporal model).")
     plan.add_argument("--ckpt", default="",
                       help="Checkpoint directory to load params from "
                            "(default: fresh init).")
@@ -55,14 +67,62 @@ def register(sub) -> None:
                       help="PRNG seed for the synthetic telemetry.")
 
 
+def _build_model(args):
+    """The single model-family dispatch point.
+
+    Returns (model, run_step, run_plan_fwd): ``run_step(params, opt,
+    key)`` performs one training step on a fresh synthetic batch;
+    ``run_plan_fwd(params, key)`` plans weights for a synthetic fleet.
+    """
+    import jax
+
+    lr = getattr(args, "lr", 1e-3)
+    if args.model == "temporal":
+        from ..models.temporal import TemporalTrafficModel, synthetic_window
+
+        model = TemporalTrafficModel(hidden_dim=args.hidden,
+                                     learning_rate=lr)
+        step_fn = jax.jit(model.train_step)
+        fwd = jax.jit(model.forward)
+
+        def make_data(key):
+            return synthetic_window(key, steps=args.window,
+                                    groups=args.groups,
+                                    endpoints=args.endpoints)
+
+        def run_step(params, opt_state, key):
+            window, batch = make_data(key)
+            return step_fn(params, opt_state, window, batch)
+
+        def run_plan_fwd(params, key):
+            window, batch = make_data(key)
+            return fwd(params, window, batch.mask)
+    else:
+        from ..models.traffic import TrafficPolicyModel, synthetic_batch
+
+        model = TrafficPolicyModel(hidden_dim=args.hidden,
+                                   learning_rate=lr)
+        step_fn = jax.jit(model.train_step)
+        fwd = jax.jit(model.forward)
+
+        def run_step(params, opt_state, key):
+            batch = synthetic_batch(key, groups=args.groups,
+                                    endpoints=args.endpoints)
+            return step_fn(params, opt_state, batch)
+
+        def run_plan_fwd(params, key):
+            batch = synthetic_batch(key, groups=args.groups,
+                                    endpoints=args.endpoints)
+            return fwd(params, batch.features, batch.mask)
+    return model, run_step, run_plan_fwd
+
+
 def run_train(args) -> int:
     import jax
 
     from ..models.checkpoint import TrainCheckpointer
-    from ..models.traffic import TrafficPolicyModel, synthetic_batch
 
-    model = TrafficPolicyModel(hidden_dim=args.hidden,
-                               learning_rate=args.lr)
+    model, run_step = _build_model(args)
     start_step = 0
     key = jax.random.PRNGKey(args.seed)
     params = model.init_params(key)
@@ -73,13 +133,10 @@ def run_train(args) -> int:
         start_step, params, opt_state = ckpt.restore(model)
         logger.info("resumed from step %d (%s)", start_step, args.ckpt)
 
-    step_fn = jax.jit(model.train_step)
     loss = None
     for step in range(start_step, start_step + args.steps):
-        batch = synthetic_batch(jax.random.fold_in(key, step),
-                                groups=args.groups,
-                                endpoints=args.endpoints)
-        params, opt_state, loss = step_fn(params, opt_state, batch)
+        params, opt_state, loss = run_step(
+            params, opt_state, jax.random.fold_in(key, step))
         if (ckpt is not None and args.save_every > 0
                 and (step + 1) % args.save_every == 0):
             ckpt.save(step + 1, params, opt_state)
@@ -93,7 +150,7 @@ def run_train(args) -> int:
         if ckpt.latest_step() != final_step:
             ckpt.save(final_step, params, opt_state, wait=True)
         ckpt.close()
-    print(json.dumps({"step": final_step,
+    print(json.dumps({"step": final_step, "model": args.model,
                       "loss": float(loss) if loss is not None else None,
                       "backend": jax.default_backend()}))
     return 0
@@ -102,9 +159,14 @@ def run_train(args) -> int:
 def run_plan(args) -> int:
     import jax
 
-    from ..models.traffic import TrafficPolicyModel, synthetic_batch
+    if args.model == "temporal":
+        from ..models.temporal import TemporalTrafficModel, synthetic_window
 
-    model = TrafficPolicyModel(hidden_dim=args.hidden)
+        model = TemporalTrafficModel(hidden_dim=args.hidden)
+    else:
+        from ..models.traffic import TrafficPolicyModel, synthetic_batch
+
+        model = TrafficPolicyModel(hidden_dim=args.hidden)
     if args.ckpt:
         from ..models.checkpoint import TrainCheckpointer
         with TrainCheckpointer(args.ckpt) as ckpt:
@@ -114,10 +176,17 @@ def run_plan(args) -> int:
     else:
         params = model.init_params(jax.random.PRNGKey(args.seed))
 
-    batch = synthetic_batch(jax.random.PRNGKey(args.seed + 1),
-                            groups=args.groups,
-                            endpoints=args.endpoints)
-    weights = jax.jit(model.forward)(params, batch.features, batch.mask)
+    if args.model == "temporal":
+        window, batch = synthetic_window(
+            jax.random.PRNGKey(args.seed + 1), steps=args.window,
+            groups=args.groups, endpoints=args.endpoints)
+        weights = jax.jit(model.forward)(params, window, batch.mask)
+    else:
+        batch = synthetic_batch(jax.random.PRNGKey(args.seed + 1),
+                                groups=args.groups,
+                                endpoints=args.endpoints)
+        weights = jax.jit(model.forward)(params, batch.features,
+                                         batch.mask)
     out = {
         "groups": args.groups,
         "endpoints": args.endpoints,

@@ -1,0 +1,135 @@
+"""Temporal traffic model: attention over telemetry history -> weights.
+
+Second model family of the compute track (the first, ``traffic.py``, is
+a stateless MLP over the latest telemetry snapshot).  This one consumes
+a telemetry *window* ``[T, G, E, F]`` and lets every endpoint attend
+causally over its own history before scoring, so slow-moving signals
+(capacity trends, flapping health) inform the weight plan.
+
+The attention mapping is TPU-exact: endpoints are independent of each
+other along the time axis, so the (G*E) endpoint streams ARE the
+attention heads — q = k = v = [T, G*E, D] feeds the same kernels the
+long-context stack provides, with zero reshuffling:
+
+- single chip: ``ops.pallas_attention.flash_attention`` (MXU-tiled);
+- sequence-sharded: ``parallel.make_ring_attention`` over a mesh axis
+  (ring over ICI; pass ``local="flash"`` for flash-in-VMEM inside).
+
+Everything is jittable with static shapes; bfloat16 on the matmuls,
+float32 accumulation (the kernels pin preferred_element_type).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops.weights import plan_weights
+from .common import TrainableModel, masked_ce_loss
+from .traffic import Batch
+
+Params = Dict[str, jax.Array]
+
+# Below this window length the dense reference out-runs the kernel: the
+# flash tiles are 128-wide, so a short window pads ~T/128 of the work
+# into real FLOPs (and off-TPU the kernel runs in slow interpret mode).
+FLASH_MIN_WINDOW = 64
+
+
+class TemporalTrafficModel(TrainableModel):
+    """Causal self-attention per endpoint stream + MLP head.
+
+    feature_dim F -> embed_dim D per timestep, one causal attention pass
+    over the T axis, last-step representation -> score.
+    """
+
+    def __init__(self, feature_dim: int = 8, embed_dim: int = 32,
+                 hidden_dim: int = 64, learning_rate: float = 1e-3,
+                 attention: str = "flash"):
+        if attention not in ("flash", "reference"):
+            raise ValueError(f"unknown attention impl {attention!r}")
+        self.feature_dim = feature_dim
+        self.embed_dim = embed_dim
+        self.hidden_dim = hidden_dim
+        self.attention = attention
+        self.optimizer = optax.adam(learning_rate)
+
+    def init_params(self, key: jax.Array) -> Params:
+        ks = jax.random.split(key, 6)
+        f, d, h = self.feature_dim, self.embed_dim, self.hidden_dim
+        s = lambda fan_in: 1.0 / jnp.sqrt(fan_in)
+        init = lambda k, shape, fan: (
+            jax.random.normal(k, shape) * s(fan)).astype(jnp.bfloat16)
+        return {
+            "embed": init(ks[0], (f, d), f),
+            "wq": init(ks[1], (d, d), d),
+            "wk": init(ks[2], (d, d), d),
+            "wv": init(ks[3], (d, d), d),
+            "w1": init(ks[4], (d, h), d),
+            "b1": jnp.zeros((h,), jnp.bfloat16),
+            "w2": init(ks[5], (h, 1), h),
+            "b2": jnp.zeros((1,), jnp.bfloat16),
+        }
+
+    # -- forward --------------------------------------------------------
+
+    def _attend(self, q, k, v, differentiable: bool):
+        """q/k/v: [T, S, D] (S = G*E endpoint streams as heads).
+
+        The Pallas kernel is forward-only (no custom VJP), so gradient
+        paths always take the differentiable dense reference — the two
+        are numerically equal (test_temporal_model.py asserts it), so
+        training with one and serving with the other is sound.  Short
+        windows (< FLASH_MIN_WINDOW) also take the dense path: padding
+        them to 128-wide flash tiles costs more than it saves.
+        """
+        if (self.attention == "flash" and not differentiable
+                and q.shape[0] >= FLASH_MIN_WINDOW):
+            from ..ops.pallas_attention import flash_attention
+            return flash_attention(q, k, v, causal=True)
+        from ..parallel.ring_attention import attention_reference
+        return attention_reference(q, k, v, causal=True)
+
+    def scores(self, params: Params, window: jax.Array,
+               differentiable: bool = False) -> jax.Array:
+        """[T, G, E, F] telemetry window -> [G, E] float32 scores."""
+        t, g, e, f = window.shape
+        x = window.astype(jnp.bfloat16).reshape(t, g * e, f)
+        emb = x @ params["embed"]                      # [T, S, D]
+        q, k, v = (emb @ params[w] for w in ("wq", "wk", "wv"))
+        attended = self._attend(q, k, v, differentiable)   # [T, S, D]
+        last = attended[-1].astype(jnp.bfloat16)       # [S, D]
+        hdn = jnp.maximum(last @ params["w1"] + params["b1"], 0)
+        out = hdn @ params["w2"] + params["b2"]
+        return out[:, 0].reshape(g, e).astype(jnp.float32)
+
+    def forward(self, params: Params, window: jax.Array,
+                mask: jax.Array) -> jax.Array:
+        """[T, G, E, F] + [G, E] mask -> int32 GA weights [G, E]."""
+        return plan_weights(self.scores(params, window), mask)
+
+    # -- training -------------------------------------------------------
+
+    def loss(self, params: Params, window: jax.Array,
+             batch: Batch) -> jax.Array:
+        return masked_ce_loss(
+            self.scores(params, window, differentiable=True),
+            batch.mask, batch.target)
+
+
+def synthetic_window(key: jax.Array, steps: int = 8, groups: int = 16,
+                     endpoints: int = 8, feature_dim: int = 8):
+    """Random telemetry window + a target favouring endpoints whose
+    capacity signal trends up over the window."""
+    k1, k2 = jax.random.split(key)
+    window = jax.random.normal(
+        k1, (steps, groups, endpoints, feature_dim), dtype=jnp.float32)
+    mask = jax.random.bernoulli(k2, 0.85, (groups, endpoints))
+    trend = window[-1, ..., 0] - window[0, ..., 0]
+    raw = jnp.where(mask, jnp.exp(trend), 0.0)
+    denom = jnp.sum(raw, axis=-1, keepdims=True)
+    target = jnp.where(denom > 0, raw / jnp.maximum(denom, 1e-9), 0.0)
+    return window, Batch(features=window[-1].astype(jnp.bfloat16),
+                         mask=mask, target=target)

@@ -16,13 +16,14 @@ Design notes (TPU-first):
 """
 from __future__ import annotations
 
-from typing import Dict, NamedTuple, Tuple
+from typing import Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from ..ops.weights import masked_softmax, plan_weights
+from ..ops.weights import plan_weights
+from .common import TrainableModel, masked_ce_loss
 
 Params = Dict[str, jax.Array]
 
@@ -36,7 +37,7 @@ class Batch(NamedTuple):
     target: jax.Array    # [G, E] float32 target weight distribution (sums to 1)
 
 
-class TrafficPolicyModel:
+class TrafficPolicyModel(TrainableModel):
     def __init__(self, feature_dim: int = FEATURE_DIM,
                  hidden_dim: int = HIDDEN_DIM,
                  learning_rate: float = 1e-3):
@@ -76,25 +77,9 @@ class TrafficPolicyModel:
 
     def loss(self, params: Params, batch: Batch) -> jax.Array:
         """Masked cross-entropy between the planned distribution and the
-        target weight distribution."""
-        p = masked_softmax(self.scores(params, batch.features), batch.mask)
-        eps = 1e-9
-        ce = -jnp.sum(
-            jnp.where(batch.mask, batch.target * jnp.log(p + eps), 0.0),
-            axis=-1)
-        valid_groups = jnp.any(batch.mask, axis=-1)
-        return jnp.sum(jnp.where(valid_groups, ce, 0.0)) / jnp.maximum(
-            jnp.sum(valid_groups), 1)
-
-    def init_opt_state(self, params: Params):
-        return self.optimizer.init(params)
-
-    def train_step(self, params: Params, opt_state,
-                   batch: Batch) -> Tuple[Params, object, jax.Array]:
-        loss, grads = jax.value_and_grad(self.loss)(params, batch)
-        updates, opt_state = self.optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        target weight distribution (shared impl: models/common.py)."""
+        return masked_ce_loss(self.scores(params, batch.features),
+                              batch.mask, batch.target)
 
 
 def synthetic_batch(key: jax.Array, groups: int = 64, endpoints: int = 32,

@@ -58,6 +58,21 @@ def test_plan_uses_trained_checkpoint(tmp_path, capsys):
     assert len(out["weights"]) == 3
 
 
+def test_temporal_model_trains_and_plans(tmp_path, capsys):
+    ckpt = str(tmp_path / "tck")
+    assert main(["train", "--model", "temporal", "--steps", "2",
+                 "--ckpt", ckpt, "--groups", "4", "--endpoints", "6",
+                 "--hidden", "16", "--window", "4"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "temporal" and out["step"] == 2
+    assert main(["plan", "--model", "temporal", "--ckpt", ckpt,
+                 "--groups", "4", "--endpoints", "6", "--hidden", "16",
+                 "--window", "4"]) == 0
+    plan = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(plan["weights"]) == 4
+    assert all(0 <= w <= 255 for row in plan["weights"] for w in row)
+
+
 def test_help_lists_compute_subcommands(capsys):
     import pytest
 
