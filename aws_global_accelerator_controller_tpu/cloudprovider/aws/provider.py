@@ -178,6 +178,8 @@ class AWSProvider:
 
     def _list_by_tags(self, target) -> List[Accelerator]:
         key = frozenset(target.items())
+        fresh_scan = False
+        verified_tags = {}  # arn -> tags fetched during verify, reusable
         with self._cache_lock:
             hit = self._discovery_cache.get(key)
             gen = self._cache_gen
@@ -192,20 +194,33 @@ class AWSProvider:
                     self._store_tags(arn, tags, gen)
                     if tags_contains_all_values(tags, target):
                         return [accelerator]
+                    verified_tags[arn] = tags
                 except AWSAPIError:
                     with self._cache_lock:  # deleted out-of-band
                         self._drop_tags_locked(arn)
+                # the cached entry lied: tags moved out from under us.
+                # The rescue scan must not consult the tags cache
+                # (entries may themselves be up to TTL old, compounding
+                # the stale window to ~2x TTL) — re-read every
+                # accelerator's tags from the API.  A plain TTL expiry
+                # (no failed verify) keeps the cached scan: nothing
+                # contradicted the cache, so the normal single-TTL
+                # drift window applies.
+                fresh_scan = True
             with self._cache_lock:
                 self._discovery_cache.pop(key, None)
 
         result = []
         for accelerator in self.apis.ga.list_accelerators():
-            tags = self._tags_for(accelerator.accelerator_arn)
+            arn = accelerator.accelerator_arn
+            if arn in verified_tags:  # just fetched during verify
+                tags = verified_tags[arn]
+            else:
+                tags = self._tags_for(arn, fresh=fresh_scan)
             if tags_contains_all_values(tags, target):
                 result.append(accelerator)
             else:
-                logger.debug("accelerator %s does not match tags",
-                             accelerator.accelerator_arn)
+                logger.debug("accelerator %s does not match tags", arn)
         if len(result) == 1:
             with self._cache_lock:
                 self._discovery_cache[key] = (result[0].accelerator_arn,
@@ -239,15 +254,18 @@ class AWSProvider:
             if self._cache_gen == gen:
                 self._tags_cache[arn] = (tags, time.monotonic())
 
-    def _tags_for(self, arn: str):
+    def _tags_for(self, arn: str, fresh: bool = False):
         """ListTags with a TTL cache, for scan loops only — verification
         paths call the API directly so a cache hit is never trusted to
         confirm itself.  Out-of-band tag edits surface within the TTL,
-        the same drift window the informer-resync backstop already has."""
+        the same drift window the informer-resync backstop already has.
+        ``fresh=True`` skips the cache read (still writes through,
+        generation-fenced) for rescans after a failed verify."""
         with self._cache_lock:
             hit = self._tags_cache.get(arn)
             now = time.monotonic()
-            if hit is not None and now - hit[1] < self.discovery_cache_ttl:
+            if (not fresh and hit is not None
+                    and now - hit[1] < self.discovery_cache_ttl):
                 return hit[0]
             gen = self._cache_gen
         tags = self.apis.ga.list_tags_for_resource(arn)

@@ -74,7 +74,8 @@ def _build_model(args):
     key)`` performs one training step on a fresh synthetic batch;
     ``run_plan_fwd(params, key)`` plans weights for a synthetic fleet.
     """
-    import jax
+    from ..jaxenv import import_jax
+    jax = import_jax()
 
     lr = getattr(args, "lr", 1e-3)
     if args.model == "temporal":
@@ -118,11 +119,12 @@ def _build_model(args):
 
 
 def run_train(args) -> int:
-    import jax
+    from ..jaxenv import import_jax
+    jax = import_jax()
 
     from ..models.checkpoint import TrainCheckpointer
 
-    model, run_step = _build_model(args)
+    model, run_step, _ = _build_model(args)
     start_step = 0
     key = jax.random.PRNGKey(args.seed)
     params = model.init_params(key)
@@ -157,36 +159,20 @@ def run_train(args) -> int:
 
 
 def run_plan(args) -> int:
-    import jax
+    from ..jaxenv import import_jax
+    jax = import_jax()
 
-    if args.model == "temporal":
-        from ..models.temporal import TemporalTrafficModel, synthetic_window
-
-        model = TemporalTrafficModel(hidden_dim=args.hidden)
-    else:
-        from ..models.traffic import TrafficPolicyModel, synthetic_batch
-
-        model = TrafficPolicyModel(hidden_dim=args.hidden)
+    model, _, run_plan_fwd = _build_model(args)
     if args.ckpt:
         from ..models.checkpoint import TrainCheckpointer
         with TrainCheckpointer(args.ckpt) as ckpt:
-            step, params, _ = ckpt.restore(model)
+            step, params, _unused = ckpt.restore(model)
         logger.info("planning with step-%d params from %s", step,
                     args.ckpt)
     else:
         params = model.init_params(jax.random.PRNGKey(args.seed))
 
-    if args.model == "temporal":
-        window, batch = synthetic_window(
-            jax.random.PRNGKey(args.seed + 1), steps=args.window,
-            groups=args.groups, endpoints=args.endpoints)
-        weights = jax.jit(model.forward)(params, window, batch.mask)
-    else:
-        batch = synthetic_batch(jax.random.PRNGKey(args.seed + 1),
-                                groups=args.groups,
-                                endpoints=args.endpoints)
-        weights = jax.jit(model.forward)(params, batch.features,
-                                         batch.mask)
+    weights = run_plan_fwd(params, jax.random.PRNGKey(args.seed + 1))
     out = {
         "groups": args.groups,
         "endpoints": args.endpoints,

@@ -172,9 +172,9 @@ class HealthServer:
                             400, "limit must be a non-negative integer")
                         return
                     spans = default_tracer.recent(
-                        # limit=0 means "everything buffered"
-                        limit=limit if limit > 0 else None,
-                        name=q.get("name", [None])[0])
+                        # limit=0 means "everything buffered", same as
+                        # Tracer.recent's own contract
+                        limit=limit, name=q.get("name", [None])[0])
                     self._respond(200, json.dumps({"spans": spans}),
                                   "application/json")
                 else:

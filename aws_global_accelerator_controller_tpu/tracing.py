@@ -94,13 +94,15 @@ class Tracer:
     def recent(self, limit: Optional[int] = None,
                name: Optional[str] = None) -> List[dict]:
         """Most-recent-last completed spans; optionally filtered by name
-        prefix and truncated to the last ``limit`` (``limit=0`` means
-        zero spans; ``None`` means all)."""
+        prefix and truncated to the last ``limit``.  ``limit=0`` and
+        ``limit=None`` both mean "everything buffered" — the same
+        contract the ``/traces`` endpoint exposes for ``?limit=0``.
+        Negative limits yield no spans."""
         with self._lock:
             spans = list(self._spans)
         if name is not None:
             spans = [s for s in spans if s.name.startswith(name)]
-        if limit is not None:
+        if limit:
             spans = spans[-limit:] if limit > 0 else []
         return [s.to_dict() for s in spans]
 

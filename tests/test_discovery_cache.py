@@ -127,6 +127,32 @@ def test_tag_strip_not_masked_by_warm_tag_cache(env):
     assert accs == []
 
 
+def test_failed_verify_rescans_other_accelerators_with_fresh_tags(env):
+    """After a verified-hit mismatch, the rescue scan must re-read EVERY
+    accelerator's tags from the API, not serve them from the warm tag
+    cache — otherwise ownership that moved to another accelerator
+    out-of-band stays invisible for up to 2x TTL (ADVICE r1)."""
+    factory, provider, ga = env
+    arn, _, _ = _ensure(provider)
+    owner_tags = dict(factory.cloud.ga.list_tags_for_resource(arn))
+    rogue = factory.cloud.ga.create_accelerator(
+        name="rogue", ip_address_type="IPV4", enabled=True,
+        tags={MANAGED_TAG_KEY: "true", CLUSTER_TAG_KEY: CLUSTER})
+    # warm _tags_cache for BOTH accelerators via an unrelated full scan
+    assert provider.list_global_accelerator_by_hostname(
+        "other.elb.amazonaws.com", CLUSTER) == []
+    # out-of-band: ownership moves from arn to rogue
+    with factory.cloud.ga._lock:
+        factory.cloud.ga._accelerators[arn].tags = {
+            MANAGED_TAG_KEY: "true", CLUSTER_TAG_KEY: CLUSTER}
+        factory.cloud.ga._accelerators[
+            rogue.accelerator_arn].tags = owner_tags
+    accs = provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", "app")
+    # the fresh rescan sees the move immediately (no 2x-TTL blind spot)
+    assert [a.accelerator_arn for a in accs] == [rogue.accelerator_arn]
+
+
 def test_duplicate_detected_after_ttl_expiry(env):
     factory, provider, ga = env
     provider.discovery_cache_ttl = 0.0  # force immediate expiry
