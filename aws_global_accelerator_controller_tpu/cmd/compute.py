@@ -27,8 +27,10 @@ def register(sub) -> None:
                        default="mlp",
                        help="mlp: snapshot MLP; temporal: causal "
                             "attention over a telemetry window.")
-    train.add_argument("--window", type=int, default=8,
-                       help="Telemetry window length (temporal model).")
+    train.add_argument("--window", type=int, default=64,
+                       help="Telemetry window length (temporal model); "
+                            "the default reaches the Pallas flash "
+                            "kernel (FLASH_MIN_WINDOW).")
     train.add_argument("--steps", type=int, default=100,
                        help="Optimisation steps to run this invocation.")
     train.add_argument("--ckpt", default="",
@@ -52,8 +54,10 @@ def register(sub) -> None:
                       default="mlp",
                       help="Must match the model the ckpt was trained "
                            "with.")
-    plan.add_argument("--window", type=int, default=8,
-                      help="Telemetry window length (temporal model).")
+    plan.add_argument("--window", type=int, default=64,
+                      help="Telemetry window length (temporal model); "
+                           "the default reaches the Pallas flash "
+                           "kernel (FLASH_MIN_WINDOW).")
     plan.add_argument("--ckpt", default="",
                       help="Checkpoint directory to load params from "
                            "(default: fresh init).")

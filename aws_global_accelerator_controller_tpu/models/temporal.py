@@ -32,9 +32,10 @@ from .traffic import Batch
 
 Params = Dict[str, jax.Array]
 
-# Below this window length the dense reference out-runs the kernel: the
-# flash tiles are 128-wide, so a short window pads ~T/128 of the work
-# into real FLOPs (and off-TPU the kernel runs in slow interpret mode).
+# Below this window length the dense reference out-runs the kernel:
+# even with auto-sized flash blocks (pallas_attention._auto_block) the
+# per-call dispatch and tiling overhead beats XLA's fused dense matmuls
+# for tiny T.  At/above it the kernel wins and the CLI defaults reach it.
 FLASH_MIN_WINDOW = 64
 
 
@@ -48,7 +49,7 @@ class TemporalTrafficModel(TrainableModel):
     def __init__(self, feature_dim: int = 8, embed_dim: int = 32,
                  hidden_dim: int = 64, learning_rate: float = 1e-3,
                  attention: str = "flash"):
-        if attention not in ("flash", "reference"):
+        if attention not in ("flash", "flash_always", "reference"):
             raise ValueError(f"unknown attention impl {attention!r}")
         self.feature_dim = feature_dim
         self.embed_dim = embed_dim
@@ -75,31 +76,40 @@ class TemporalTrafficModel(TrainableModel):
 
     # -- forward --------------------------------------------------------
 
-    def _attend(self, q, k, v, differentiable: bool):
+    def _attend(self, q, k, v):
         """q/k/v: [T, S, D] (S = G*E endpoint streams as heads).
 
-        The Pallas kernel is forward-only (no custom VJP), so gradient
-        paths always take the differentiable dense reference — the two
-        are numerically equal (test_temporal_model.py asserts it), so
-        training with one and serving with the other is sound.  Short
-        windows (< FLASH_MIN_WINDOW) also take the dense path: padding
-        them to 128-wide flash tiles costs more than it saves.
+        The Pallas kernel carries a custom flash VJP, so BOTH the
+        serving forward and the training gradient run it — long-window
+        training gets the O(T) memory benefit the kernel exists for.
+        Dispatch:
+
+        - ``flash``: the kernel when T >= FLASH_MIN_WINDOW and running
+          on TPU.  Off-TPU the kernel only exists in interpret mode,
+          which serialises over the S heads — the dense reference is
+          orders of magnitude faster there.
+        - ``flash_always``: the kernel whenever T >= FLASH_MIN_WINDOW,
+          any backend — for tests proving the kernel path (forward AND
+          backward) end-to-end on the CPU mesh.
+        - ``reference``: always dense.
         """
-        if (self.attention == "flash" and not differentiable
-                and q.shape[0] >= FLASH_MIN_WINDOW):
-            from ..ops.pallas_attention import flash_attention
-            return flash_attention(q, k, v, causal=True)
+        use_kernel = (q.shape[0] >= FLASH_MIN_WINDOW
+                      and (self.attention == "flash_always"
+                           or (self.attention == "flash"
+                               and jax.default_backend() == "tpu")))
+        if use_kernel:
+            from ..ops import pallas_attention
+            return pallas_attention.flash_attention(q, k, v, causal=True)
         from ..parallel.ring_attention import attention_reference
         return attention_reference(q, k, v, causal=True)
 
-    def scores(self, params: Params, window: jax.Array,
-               differentiable: bool = False) -> jax.Array:
+    def scores(self, params: Params, window: jax.Array) -> jax.Array:
         """[T, G, E, F] telemetry window -> [G, E] float32 scores."""
         t, g, e, f = window.shape
         x = window.astype(jnp.bfloat16).reshape(t, g * e, f)
         emb = x @ params["embed"]                      # [T, S, D]
         q, k, v = (emb @ params[w] for w in ("wq", "wk", "wv"))
-        attended = self._attend(q, k, v, differentiable)   # [T, S, D]
+        attended = self._attend(q, k, v)               # [T, S, D]
         last = attended[-1].astype(jnp.bfloat16)       # [S, D]
         hdn = jnp.maximum(last @ params["w1"] + params["b1"], 0)
         out = hdn @ params["w2"] + params["b2"]
@@ -115,8 +125,7 @@ class TemporalTrafficModel(TrainableModel):
     def loss(self, params: Params, window: jax.Array,
              batch: Batch) -> jax.Array:
         return masked_ce_loss(
-            self.scores(params, window, differentiable=True),
-            batch.mask, batch.target)
+            self.scores(params, window), batch.mask, batch.target)
 
 
 def synthetic_window(key: jax.Array, steps: int = 8, groups: int = 16,

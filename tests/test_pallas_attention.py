@@ -71,6 +71,85 @@ def test_stats_merge_equals_full_attention():
         rtol=2e-5, atol=2e-5)
 
 
+def _oracle_grads(q, k, v, causal, cot):
+    """Gradients of <attention_reference(q,k,v), cot> wrt q, k, v."""
+    def f(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) * cot)
+    return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t,h,d", [
+    (64, 2, 16),     # single block
+    (200, 2, 40),    # ragged T: padded query rows + masked padded keys
+    (384, 1, 64),    # multiple q and k blocks
+])
+def test_grads_match_dense_oracle(t, h, d, causal):
+    q, k, v = _qkv(t, h, d, seed=100 + t + int(causal))
+    cot = jax.random.normal(jax.random.PRNGKey(7), (t, h, d))
+
+    def f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) * cot)
+
+    got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    want = _oracle_grads(q, k, v, causal, cot)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch (t={t}, h={h}, d={d}, "
+                    f"causal={causal})")
+
+
+def test_grads_small_blocks():
+    q, k, v = _qkv(96, 2, 8, seed=31)
+    cot = jnp.ones((96, 2, 8))
+
+    def f(impl, *args):
+        return jnp.sum(impl(*args) * cot)
+
+    got = jax.grad(
+        lambda q, k, v: f(lambda *a: flash_attention(
+            *a, causal=True, block_q=32, block_k=32), q, k, v),
+        argnums=(0, 1, 2))(q, k, v)
+    want = _oracle_grads(q, k, v, True, cot)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_grads_bfloat16():
+    """bf16 training path: grads come back bf16 and close to the f32
+    oracle at bf16 tolerance."""
+    q, k, v = _qkv(128, 2, 32, seed=13)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32))
+
+    got = jax.grad(f, argnums=(0, 1, 2))(qb, kb, vb)
+    want = _oracle_grads(q, k, v, True, jnp.ones_like(q))
+    for g, w in zip(got, want):
+        assert g.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(g, dtype=np.float32),
+                                   np.asarray(w), rtol=1e-1, atol=5e-2)
+
+
+def test_value_and_grad_jits_end_to_end():
+    """The custom VJP must compose with jit+grad the way train_step
+    uses it (no tracer leaks, stable output)."""
+    q, k, v = _qkv(64, 1, 16, seed=44)
+
+    @jax.jit
+    def loss(q, k, v):
+        return jnp.mean(flash_attention(q, k, v, causal=True) ** 2)
+
+    val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+    val2, _ = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert np.isfinite(float(val)) and float(val) == float(val2)
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in grads)
+
+
 def test_causal_prefix_invariance():
     """Causal output at position p must not change when the suffix after
     p changes — the block-skip logic must not leak future blocks."""

@@ -29,29 +29,112 @@ def test_flash_and_reference_scores_agree():
     )
 
     model = TemporalTrafficModel(feature_dim=8, embed_dim=16,
-                                 hidden_dim=32, attention="flash")
+                                 hidden_dim=32, attention="flash_always")
+    ref_model = TemporalTrafficModel(feature_dim=8, embed_dim=16,
+                                     hidden_dim=32, attention="reference")
     params = model.init_params(jax.random.PRNGKey(0))
     window, _ = synthetic_window(jax.random.PRNGKey(1),
                                  steps=FLASH_MIN_WINDOW, groups=2,
                                  endpoints=4)
     flash = model.scores(params, window)
-    ref = model.scores(params, window, differentiable=True)
+    ref = ref_model.scores(params, window)
     np.testing.assert_allclose(np.asarray(flash), np.asarray(ref),
                                rtol=2e-2, atol=2e-2)  # bf16 matmuls
 
 
 def test_short_windows_route_to_dense_reference(monkeypatch):
     """Below FLASH_MIN_WINDOW the serving path must not invoke the
-    Pallas kernel at all (padding waste)."""
+    Pallas kernel at all (dispatch overhead beats it)."""
     import aws_global_accelerator_controller_tpu.ops.pallas_attention as pa
 
     def boom(*a, **k):  # pragma: no cover - would fail the test
         raise AssertionError("flash kernel called for a short window")
 
     monkeypatch.setattr(pa, "flash_attention", boom)
-    model, params, window, batch = _setup()  # steps=8 < 64
-    weights = model.forward(params, window, batch.mask)
+    model, params, window, batch = _setup(attention="flash_always")
+    weights = model.forward(params, window, batch.mask)  # steps=8 < 64
     assert weights.shape == (4, 8)
+
+
+def test_flash_auto_gates_on_backend(monkeypatch):
+    """attention='flash' must not run interpret-mode pallas off-TPU —
+    the dense reference is the off-TPU serving path."""
+    import aws_global_accelerator_controller_tpu.ops.pallas_attention as pa
+    from aws_global_accelerator_controller_tpu.models.temporal import (
+        FLASH_MIN_WINDOW,
+    )
+
+    def boom(*a, **k):  # pragma: no cover - would fail the test
+        raise AssertionError("flash kernel called off-TPU")
+
+    monkeypatch.setattr(pa, "flash_attention", boom)
+    model = TemporalTrafficModel(feature_dim=8, embed_dim=16,
+                                 hidden_dim=32, attention="flash")
+    params = model.init_params(jax.random.PRNGKey(0))
+    window, batch = synthetic_window(jax.random.PRNGKey(1),
+                                     steps=FLASH_MIN_WINDOW, groups=2,
+                                     endpoints=4)
+    assert jax.default_backend() != "tpu"  # conftest pins cpu
+    model.forward(params, window, batch.mask)
+
+
+def test_train_step_executes_flash_kernel_under_gradient(monkeypatch):
+    """VERDICT r1 item 4: for windows >= FLASH_MIN_WINDOW the training
+    step must run the Pallas kernel (via its custom VJP), not the dense
+    fallback — and still learn."""
+    import aws_global_accelerator_controller_tpu.ops.pallas_attention as pa
+    from aws_global_accelerator_controller_tpu.models.temporal import (
+        FLASH_MIN_WINDOW,
+    )
+
+    calls = {"n": 0}
+    real = pa.flash_attention
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(pa, "flash_attention", spy)
+    model = TemporalTrafficModel(feature_dim=8, embed_dim=16,
+                                 hidden_dim=32, attention="flash_always")
+    params = model.init_params(jax.random.PRNGKey(2))
+    window, batch = synthetic_window(jax.random.PRNGKey(3),
+                                     steps=FLASH_MIN_WINDOW, groups=2,
+                                     endpoints=4)
+    opt = model.init_opt_state(params)
+    params2, opt, loss = model.train_step(params, opt, window, batch)
+    assert calls["n"] >= 1, "train_step never reached the flash kernel"
+    assert np.isfinite(float(loss))
+    # the kernel's VJP produced real gradients: params moved
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.abs(a.astype(jnp.float32)
+                                   - b.astype(jnp.float32)).max()),
+        params, params2)
+    assert max(jax.tree_util.tree_leaves(moved)) > 0
+
+
+def test_flash_and_reference_gradients_agree():
+    """The flash VJP and the dense autodiff path must produce the same
+    parameter gradients (bf16 tolerance) — otherwise training with the
+    kernel silently optimises a different function."""
+    from aws_global_accelerator_controller_tpu.models.temporal import (
+        FLASH_MIN_WINDOW,
+    )
+
+    kwargs = dict(feature_dim=8, embed_dim=16, hidden_dim=32)
+    flash_model = TemporalTrafficModel(attention="flash_always", **kwargs)
+    ref_model = TemporalTrafficModel(attention="reference", **kwargs)
+    params = flash_model.init_params(jax.random.PRNGKey(4))
+    window, batch = synthetic_window(jax.random.PRNGKey(5),
+                                     steps=FLASH_MIN_WINDOW, groups=2,
+                                     endpoints=4)
+    g_flash = jax.grad(flash_model.loss)(params, window, batch)
+    g_ref = jax.grad(ref_model.loss)(params, window, batch)
+    for name in params:
+        np.testing.assert_allclose(
+            np.asarray(g_flash[name], dtype=np.float32),
+            np.asarray(g_ref[name], dtype=np.float32),
+            rtol=5e-2, atol=5e-3, err_msg=f"grad[{name}]")
 
 
 def test_forward_emits_valid_weights():
