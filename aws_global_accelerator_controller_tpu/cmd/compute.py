@@ -47,6 +47,11 @@ def register(sub) -> None:
                        help="Adam learning rate.")
     train.add_argument("--seed", type=int, default=0,
                        help="PRNG seed for init and batches.")
+    train.add_argument("--sharded", action="store_true",
+                       help="Shard over all visible devices: temporal "
+                            "-> data x seq mesh with ring attention "
+                            "over the window; mlp -> data x model "
+                            "mesh (dp x tp).")
 
     plan = sub.add_parser(
         "plan", help="Plan GA endpoint weights for a fleet (JSON out)")
@@ -69,6 +74,9 @@ def register(sub) -> None:
                       help="Model hidden width (must match the ckpt).")
     plan.add_argument("--seed", type=int, default=0,
                       help="PRNG seed for the synthetic telemetry.")
+    plan.add_argument("--sharded", action="store_true",
+                      help="Shard planning over all visible devices "
+                           "(see train --sharded).")
 
 
 def _build_model(args):
@@ -82,44 +90,107 @@ def _build_model(args):
     jax = import_jax()
 
     lr = getattr(args, "lr", 1e-3)
+    sharded = getattr(args, "sharded", False)
     if args.model == "temporal":
         from ..models.temporal import TemporalTrafficModel, synthetic_window
 
         model = TemporalTrafficModel(hidden_dim=args.hidden,
                                      learning_rate=lr)
-        step_fn = jax.jit(model.train_step)
-        fwd = jax.jit(model.forward)
 
         def make_data(key):
             return synthetic_window(key, steps=args.window,
                                     groups=args.groups,
                                     endpoints=args.endpoints)
 
-        def run_step(params, opt_state, key):
-            window, batch = make_data(key)
-            return step_fn(params, opt_state, window, batch)
+        if sharded:
+            planner = _temporal_planner(args, model)
 
-        def run_plan_fwd(params, key):
-            window, batch = make_data(key)
-            return fwd(params, window, batch.mask)
+            def run_step(params, opt_state, key):
+                window, batch = make_data(key)
+                return planner.train_step(
+                    params, opt_state, planner.shard_window(window),
+                    planner.shard_batch(batch))
+
+            def run_plan_fwd(params, key):
+                window, batch = make_data(key)
+                return planner.forward(
+                    params, planner.shard_window(window), batch.mask)
+        else:
+            step_fn = jax.jit(model.train_step)
+            fwd = jax.jit(model.forward)
+
+            def run_step(params, opt_state, key):
+                window, batch = make_data(key)
+                return step_fn(params, opt_state, window, batch)
+
+            def run_plan_fwd(params, key):
+                window, batch = make_data(key)
+                return fwd(params, window, batch.mask)
     else:
         from ..models.traffic import TrafficPolicyModel, synthetic_batch
 
         model = TrafficPolicyModel(hidden_dim=args.hidden,
                                    learning_rate=lr)
-        step_fn = jax.jit(model.train_step)
-        fwd = jax.jit(model.forward)
 
-        def run_step(params, opt_state, key):
-            batch = synthetic_batch(key, groups=args.groups,
-                                    endpoints=args.endpoints)
-            return step_fn(params, opt_state, batch)
+        def make_batch(key):
+            return synthetic_batch(key, groups=args.groups,
+                                   endpoints=args.endpoints)
 
-        def run_plan_fwd(params, key):
-            batch = synthetic_batch(key, groups=args.groups,
-                                    endpoints=args.endpoints)
-            return fwd(params, batch.features, batch.mask)
+        if sharded:
+            planner = _mlp_planner(args, model)
+
+            def run_step(params, opt_state, key):
+                batch = planner.shard_batch(make_batch(key))
+                return planner.train_step(params, opt_state, batch)
+
+            def run_plan_fwd(params, key):
+                batch = planner.shard_batch(make_batch(key))
+                return planner.forward(params, batch.features,
+                                       batch.mask)
+        else:
+            step_fn = jax.jit(model.train_step)
+            fwd = jax.jit(model.forward)
+
+            def run_step(params, opt_state, key):
+                batch = make_batch(key)
+                return step_fn(params, opt_state, batch)
+
+            def run_plan_fwd(params, key):
+                batch = make_batch(key)
+                return fwd(params, batch.features, batch.mask)
     return model, run_step, run_plan_fwd
+
+
+def _temporal_planner(args, model):
+    """data x seq mesh over all visible devices; validates divisibility
+    so shard_map sees even blocks."""
+    from ..parallel import ShardedTemporalPlanner
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh(axis_names=("data", "seq"))
+    n_seq, n_data = mesh.shape["seq"], mesh.shape["data"]
+    if args.window % n_seq or args.groups % n_data:
+        raise SystemExit(
+            f"--sharded needs --window divisible by the seq axis "
+            f"({n_seq}) and --groups by the data axis ({n_data}); got "
+            f"window={args.window} groups={args.groups}")
+    logger.info("temporal mesh: data=%d seq=%d", n_data, n_seq)
+    return ShardedTemporalPlanner(model, mesh, window=args.window)
+
+
+def _mlp_planner(args, model):
+    from ..parallel import ShardedTrafficPlanner
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh(axis_names=("data", "model"))
+    n_data, n_model = mesh.shape["data"], mesh.shape["model"]
+    if args.groups % n_data or args.hidden % n_model:
+        raise SystemExit(
+            f"--sharded needs --groups divisible by the data axis "
+            f"({n_data}) and --hidden by the model axis ({n_model}); "
+            f"got groups={args.groups} hidden={args.hidden}")
+    logger.info("mlp mesh: data=%d model=%d", n_data, n_model)
+    return ShardedTrafficPlanner(model, mesh)
 
 
 def run_train(args) -> int:

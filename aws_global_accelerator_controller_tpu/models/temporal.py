@@ -103,29 +103,38 @@ class TemporalTrafficModel(TrainableModel):
         from ..parallel.ring_attention import attention_reference
         return attention_reference(q, k, v, causal=True)
 
-    def scores(self, params: Params, window: jax.Array) -> jax.Array:
-        """[T, G, E, F] telemetry window -> [G, E] float32 scores."""
+    def scores(self, params: Params, window: jax.Array,
+               attend=None) -> jax.Array:
+        """[T, G, E, F] telemetry window -> [G, E] float32 scores.
+
+        ``attend`` overrides the attention impl with a fn(q, k, v:
+        [T, S, D]) -> [T, S, D] — the seam `parallel.plan.
+        ShardedTemporalPlanner` uses to swap in ring attention over a
+        sequence-sharded mesh.
+        """
+        attend = attend or self._attend
         t, g, e, f = window.shape
         x = window.astype(jnp.bfloat16).reshape(t, g * e, f)
         emb = x @ params["embed"]                      # [T, S, D]
         q, k, v = (emb @ params[w] for w in ("wq", "wk", "wv"))
-        attended = self._attend(q, k, v)               # [T, S, D]
+        attended = attend(q, k, v)                     # [T, S, D]
         last = attended[-1].astype(jnp.bfloat16)       # [S, D]
         hdn = jnp.maximum(last @ params["w1"] + params["b1"], 0)
         out = hdn @ params["w2"] + params["b2"]
         return out[:, 0].reshape(g, e).astype(jnp.float32)
 
     def forward(self, params: Params, window: jax.Array,
-                mask: jax.Array) -> jax.Array:
+                mask: jax.Array, attend=None) -> jax.Array:
         """[T, G, E, F] + [G, E] mask -> int32 GA weights [G, E]."""
-        return plan_weights(self.scores(params, window), mask)
+        return plan_weights(self.scores(params, window, attend), mask)
 
     # -- training -------------------------------------------------------
 
-    def loss(self, params: Params, window: jax.Array,
-             batch: Batch) -> jax.Array:
+    def loss(self, params: Params, window: jax.Array, batch: Batch,
+             attend=None) -> jax.Array:
         return masked_ce_loss(
-            self.scores(params, window), batch.mask, batch.target)
+            self.scores(params, window, attend), batch.mask,
+            batch.target)
 
 
 def synthetic_window(key: jax.Array, steps: int = 8, groups: int = 16,

@@ -22,7 +22,10 @@ from .pipeline import (  # noqa: F401
     make_pipeline,
     pipeline_reference,
 )
-from .plan import ShardedTrafficPlanner  # noqa: F401
+from .plan import (  # noqa: F401
+    ShardedTemporalPlanner,
+    ShardedTrafficPlanner,
+)
 from .ring import ewma_reference, make_mesh_1d, make_ring_ewma  # noqa: F401
 from .ring_attention import (  # noqa: F401
     attention_reference,

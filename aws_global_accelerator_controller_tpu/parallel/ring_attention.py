@@ -53,7 +53,8 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def make_ring_attention(mesh: Mesh, axis: str = "seq",
-                        causal: bool = False, local: str = "einsum"):
+                        causal: bool = False, local: str = "einsum",
+                        head_axis: "str | None" = None):
     """Compile fn(q, k, v: [T, H, D], time-sharded over ``axis``) ->
     [T, H, D] time-sharded, equal to :func:`attention_reference`.
 
@@ -69,21 +70,33 @@ def make_ring_attention(mesh: Mesh, axis: str = "seq",
       long-context path, ring over ICI outside, flash in VMEM inside.
       Block stats (unnormalised o, m, l) merge with the same flash
       recurrence the einsum path applies tile-by-tile.
+
+    ``head_axis`` optionally shards the head dim H over a second mesh
+    axis (e.g. the data axis when the G*E endpoint streams of the
+    temporal model are the heads) — heads are embarrassingly parallel in
+    attention, so the ring collectives stay on ``axis`` only.
+
+    Differentiable: the returned fn carries a custom VJP implementing
+    the ring backward — a second ring pass in which each device keeps
+    (q, dO, lse, D) resident and the (k, v, dK, dV) quadruple rotates,
+    so dK/dV partials accumulate hop by hop and land on their owner
+    after n hops.  Per-device memory stays O(T/n); no [T, T] score
+    matrix exists in either direction.
     """
     if local not in ("einsum", "flash"):
         raise ValueError(f"unknown local attend {local!r}")
     n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
 
-    @partial(jax.shard_map, mesh=mesh,
-             in_specs=(P(axis), P(axis), P(axis)), out_specs=P(axis),
-             check_vma=False)
-    def ring(q_local, k_local, v_local):
+    def _fwd_local(q_local, k_local, v_local):
+        """Per-shard forward.  Returns (o_local [T_b, H_l, D], lse_local
+        [H_l, T_b]) — lse is the softmax log-normaliser the backward
+        needs to re-materialise probability blocks."""
         t_b = q_local.shape[0]
         h, d = q_local.shape[1], q_local.shape[2]
         scale = d ** -0.5
         qf = q_local.astype(jnp.float32)
         my = jax.lax.axis_index(axis)
-        perm = [(i, (i + 1) % n) for i in range(n)]
         q_pos = my * t_b + jnp.arange(t_b)  # global query positions
 
         def attend_einsum(carry, step):
@@ -156,9 +169,90 @@ def make_ring_attention(mesh: Mesh, axis: str = "seq",
                  jnp.zeros((h, t_b), jnp.float32),
                  k_local, v_local)
         carry = jax.lax.fori_loop(0, n - 1, body, carry)
-        o, _, l, _, _ = fold(n - 1, carry)
+        o, m, l, _, _ = fold(n - 1, carry)
         # causal first block: every query attends at least itself, so l>0
-        return jnp.transpose(o / l[..., None], (1, 0, 2)).astype(
+        o_norm = jnp.transpose(o / l[..., None], (1, 0, 2)).astype(
             q_local.dtype)
+        return o_norm, m + jnp.log(l)
+
+    @jax.custom_vjp
+    def ring_local(q_local, k_local, v_local):
+        return _fwd_local(q_local, k_local, v_local)[0]
+
+    def ring_fwd(q_local, k_local, v_local):
+        o, lse = _fwd_local(q_local, k_local, v_local)
+        return o, (q_local, k_local, v_local, o, lse)
+
+    def ring_bwd(res, do):
+        """Ring backward: q/dO/lse/D stay resident; (k, v, dK, dV)
+        rotate.  After the n-th hop each dK/dV block has collected every
+        device's contribution and is back on its owner."""
+        q_local, k_local, v_local, o, lse = res
+        t_b = q_local.shape[0]
+        d = q_local.shape[2]
+        scale = d ** -0.5
+        qf = jnp.transpose(q_local.astype(jnp.float32), (1, 0, 2))
+        dof = jnp.transpose(do.astype(jnp.float32), (1, 0, 2))
+        of = jnp.transpose(o.astype(jnp.float32), (1, 0, 2))
+        dvec = jnp.sum(dof * of, axis=-1)                  # [H, T_b]
+        my = jax.lax.axis_index(axis)
+        q_pos = my * t_b + jnp.arange(t_b)
+
+        def contribute(carry, step):
+            dq, kb, vb, dkb, dvb = carry
+            kf = jnp.transpose(kb.astype(jnp.float32), (1, 0, 2))
+            vf = jnp.transpose(vb.astype(jnp.float32), (1, 0, 2))
+            s = jnp.einsum("htd,hsd->hts", qf, kf) * scale
+            if causal:
+                src = jnp.mod(my - step, n)
+                k_pos = src * t_b + jnp.arange(t_b)
+                keep = q_pos[:, None] >= k_pos[None, :]
+                s = jnp.where(keep[None], s, _NEG_INF)
+            p = jnp.exp(s - lse[..., None])                # [H, T_b, S_b]
+            dp = jnp.einsum("htd,hsd->hts", dof, vf)
+            ds = p * (dp - dvec[..., None]) * scale
+            dq = dq + jnp.einsum("hts,hsd->htd", ds, kf)
+            dkb = dkb + jnp.einsum("hts,htd->hsd", ds, qf)
+            dvb = dvb + jnp.einsum("hts,htd->hsd", p, dof)
+            return dq, kb, vb, dkb, dvb
+
+        def fold(step, carry):
+            if not causal:
+                return contribute(carry, step)
+            src = jnp.mod(my - step, n)
+            return jax.lax.cond(src <= my, contribute,
+                                lambda c, _: c, carry, step)
+
+        def body(step, carry):
+            dq, kb, vb, dkb, dvb = fold(step, carry)
+            # dK/dV ride the same ring as K/V so the partials stay
+            # aligned with the block they belong to
+            kb, vb, dkb, dvb = (jax.lax.ppermute(x, axis, perm)
+                                for x in (kb, vb, dkb, dvb))
+            return dq, kb, vb, dkb, dvb
+
+        h, t_loc, dd = qf.shape[0], qf.shape[1], qf.shape[2]
+        carry = (jnp.zeros((h, t_loc, dd), jnp.float32),
+                 k_local, v_local,
+                 jnp.zeros((h, t_b, d), jnp.float32),
+                 jnp.zeros((h, t_b, d), jnp.float32))
+        carry = jax.lax.fori_loop(0, n - 1, body, carry)
+        dq, _, _, dkb, dvb = fold(n - 1, carry)
+        # final hop: only dK/dV need to travel home — K/V are done
+        # (mirrors the forward's skipped last rotation)
+        dk = jax.lax.ppermute(dkb, axis, perm)
+        dv = jax.lax.ppermute(dvb, axis, perm)
+        back = lambda g, x: jnp.transpose(g, (1, 0, 2)).astype(x.dtype)
+        return (back(dq, q_local), back(dk, k_local), back(dv, v_local))
+
+    ring_local.defvjp(ring_fwd, ring_bwd)
+
+    spec = P(axis, head_axis)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(spec, spec, spec), out_specs=spec,
+             check_vma=False)
+    def ring(q_local, k_local, v_local):
+        return ring_local(q_local, k_local, v_local)
 
     return jax.jit(ring)

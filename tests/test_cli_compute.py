@@ -73,6 +73,40 @@ def test_temporal_model_trains_and_plans(tmp_path, capsys):
     assert all(0 <= w <= 255 for row in plan["weights"] for w in row)
 
 
+def test_sharded_temporal_trains_and_plans(tmp_path, capsys):
+    """--sharded builds a data x seq mesh over the 8 virtual CPU
+    devices and trains through ring attention."""
+    ckpt = str(tmp_path / "sck")
+    assert main(["train", "--model", "temporal", "--sharded",
+                 "--steps", "2", "--ckpt", ckpt, "--groups", "4",
+                 "--endpoints", "4", "--hidden", "16",
+                 "--window", "8"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "temporal" and out["step"] == 2
+    assert main(["plan", "--model", "temporal", "--sharded",
+                 "--ckpt", ckpt, "--groups", "4", "--endpoints", "4",
+                 "--hidden", "16", "--window", "8"]) == 0
+    plan = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(plan["weights"]) == 4
+    assert all(0 <= w <= 255 for row in plan["weights"] for w in row)
+
+
+def test_sharded_rejects_indivisible_shapes(capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["train", "--model", "temporal", "--sharded", "--steps",
+              "1", "--groups", "3", "--endpoints", "4", "--hidden",
+              "16", "--window", "7"])
+
+
+def test_sharded_mlp_trains(capsys):
+    assert main(["train", "--sharded", "--steps", "2", "--groups", "8",
+                 "--endpoints", "8", "--hidden", "16"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["step"] == 2 and out["loss"] is not None
+
+
 def test_help_lists_compute_subcommands(capsys):
     import pytest
 
