@@ -50,10 +50,20 @@ def build_parser() -> argparse.ArgumentParser:
                             help="Path to a kubeconfig (out-of-cluster).")
     controller.add_argument("--master", default="",
                             help="Kubernetes API server address override.")
-    controller.add_argument("--fake", action="store_true", default=True,
-                            help="Run against the in-process fake API "
-                                 "server and fake AWS cloud (default: the "
-                                 "kubernetes package is unavailable here).")
+    backend = controller.add_mutually_exclusive_group()
+    backend.add_argument("--fake", dest="fake", action="store_true",
+                         default=True,
+                         help="Run against the in-process fake API "
+                              "server and fake AWS cloud (default).")
+    backend.add_argument("--real", dest="fake", action="store_false",
+                         help="Connect to a real cluster over HTTP "
+                              "(kubeconfig / in-cluster service "
+                              "account; stdlib client, no kubernetes "
+                              "package needed).")
+    controller.add_argument("--fake-cloud", action="store_true",
+                            help="With --real: keep the in-memory fake "
+                                 "AWS cloud (stub-apiserver tests, "
+                                 "dev).")
     controller.add_argument("--leader-elect", action="store_true",
                             default=True,
                             help="Run under Lease-based leader election.")
@@ -113,10 +123,23 @@ def run_controller(args) -> int:
         kube = KubeClient(api)
         operator = OperatorClient(api)
         cloud_factory = FakeCloudFactory()
-    else:  # pragma: no cover - needs the kubernetes package + a cluster
-        raise SystemExit(
-            "real-cluster mode requires the kubernetes package, which is "
-            "not available in this environment")
+    else:
+        from ..kube.http_store import HTTPAPIServer
+        from ..kube.kubeconfig import KubeConfigError, build_config
+
+        try:
+            # build_config owns the full resolution order (flag >
+            # $KUBECONFIG > in-cluster > ~/.kube/config); passing the
+            # raw flag keeps the in-cluster branch reachable
+            rest_config = build_config(args.kubeconfig, args.master)
+        except KubeConfigError as e:
+            raise SystemExit(str(e))
+        logger.info("connecting to apiserver %s", rest_config.server)
+        api = HTTPAPIServer(rest_config)
+        kube = KubeClient(api)
+        operator = OperatorClient(api)
+        cloud_factory = (FakeCloudFactory() if args.fake_cloud
+                         else BotoCloudFactory())
 
     config = ControllerConfig(
         global_accelerator=GlobalAcceleratorConfig(
@@ -130,6 +153,9 @@ def run_controller(args) -> int:
     namespace = os.environ.get("POD_NAMESPACE", "default")
 
     if args.demo:
+        if not hasattr(cloud_factory, "cloud"):
+            raise SystemExit(
+                "--demo needs the fake AWS cloud (--fake or --fake-cloud)")
         _seed_demo(kube, cloud_factory)
     if args.seed:
         from ..kube.apply import apply_files

@@ -79,9 +79,13 @@ class EventRecorder:
         self.component = component
 
     def event(self, obj, type_: str, reason: str, message: str) -> None:
+        import uuid
+
+        # unique suffix, like client-go's timestamp-suffixed event names;
+        # must not rely on store internals (the HTTP backend has none)
         ev = Event(
             metadata=ObjectMeta(
-                name=f"{obj.metadata.name}.{reason}.{self._store._next_rv()}",
+                name=f"{obj.metadata.name}.{reason}.{uuid.uuid4().hex[:10]}",
                 namespace=obj.metadata.namespace or "default"),
             involved_object_kind=obj.kind,
             involved_object_key=obj.key(),
