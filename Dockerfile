@@ -1,15 +1,25 @@
-# Controller image (analogue of the reference's distroless static Go image).
+# Controller image (analogue of the reference's distroless static Go
+# image, Dockerfile + Makefile:16-24; built and smoke-tested in CI,
+# .github/workflows/e2e.yml).
+#
+# The package installs from pyproject.toml so the image runs the same
+# artifact `pip install` users get.  The controllers need only the
+# stdlib + pyyaml; pass --build-arg EXTRAS="[tpu]" for an image that
+# also carries the TPU compute track (jax/optax/orbax), or
+# EXTRAS="[cluster]" for the live-AWS boto3 provider.
 FROM python:3.12-slim
 
+ARG EXTRAS=""
+
 WORKDIR /app
+COPY pyproject.toml ./
 COPY aws_global_accelerator_controller_tpu/ aws_global_accelerator_controller_tpu/
 COPY config/ config/
 
-# Runtime deps beyond the stdlib: pyyaml for manifests; jax/optax only if
-# the TPU compute track is used in-cluster (not required for the
-# controllers themselves).
-RUN pip install --no-cache-dir pyyaml
+RUN pip install --no-cache-dir ".${EXTRAS}"
 
 ENV PYTHONUNBUFFERED=1
-ENTRYPOINT ["python", "-m", "aws_global_accelerator_controller_tpu"]
+ENTRYPOINT ["aws-global-accelerator-controller-tpu"]
+# fake-backend demo mode works with zero cluster/cloud credentials; a
+# real deployment overrides with: controller --real [--kubeconfig ...]
 CMD ["controller"]

@@ -1,0 +1,36 @@
+"""aws-global-accelerator-controller-tpu.
+
+A from-scratch rebuild of the capabilities of
+h3poteto/aws-global-accelerator-controller (reference mounted at
+/root/reference): a Kubernetes operator that reconciles Service/Ingress
+objects and the EndpointGroupBinding CRD into AWS Global Accelerator and
+Route53 resources.
+
+Layer map (mirrors SURVEY.md §1):
+
+- ``cmd``            -- CLI process entry (controller | webhook | version)
+- ``leaderelection`` -- Lease-based active/standby replica coordination
+- ``manager``        -- controller registry + lifecycle
+- ``controller``     -- the three controllers (globalaccelerator, route53,
+                        endpointgroupbinding)
+- ``reconcile``      -- generic worker loop with Result/requeue semantics
+- ``cloudprovider``  -- provider detection + AWS resource state machines
+- ``apis`` / ``kube``-- API types, fake API server, informers, workqueue
+- ``webhook``        -- validating admission webhook server
+
+The reference contains no numeric compute (SURVEY.md §2: "Languages: 100%
+Go", parallelism table all ABSENT).  The ``ops``/``parallel``/``models``
+packages host the TPU-native compute track added on top of capability
+parity: a batched, jittable endpoint-weight planner used by the
+EndpointGroupBinding controller's weight-sync path and by ``bench.py``.
+"""
+
+import os as _os
+
+__version__ = "0.1.0"
+
+# Build metadata injection (the -ldflags analogue, reference Makefile:18-24):
+# image builds set these env vars instead of link-time symbols.
+VERSION = _os.environ.get("AGAC_VERSION", __version__)
+REVISION = _os.environ.get("AGAC_REVISION", "dev")
+BUILD = _os.environ.get("AGAC_BUILD", "source")

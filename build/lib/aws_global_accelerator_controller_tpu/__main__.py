@@ -1,0 +1,7 @@
+"""python -m aws_global_accelerator_controller_tpu (reference main.go:10-15)."""
+import sys
+
+from .cmd import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+"""User-facing annotation API surface.
+
+Mirrors the reference's annotation constants (pkg/apis/type.go:3-13) --
+these annotations on Service/Ingress objects *are* the controller's
+configuration system (SURVEY.md §5 "Config / flag system").
+"""
+
+# Annotations owned by this controller (reference pkg/apis/type.go:4-9).
+AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION = (
+    "aws-global-accelerator-controller.h3poteto.dev/global-accelerator-managed"
+)
+ROUTE53_HOSTNAME_ANNOTATION = (
+    "aws-global-accelerator-controller.h3poteto.dev/route53-hostname"
+)
+CLIENT_IP_PRESERVATION_ANNOTATION = (
+    "aws-global-accelerator-controller.h3poteto.dev/client-ip-preservation"
+)
+AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION = (
+    "aws-global-accelerator-controller.h3poteto.dev/global-accelerator-name"
+)
+AWS_GLOBAL_ACCELERATOR_TAGS_ANNOTATION = (
+    "aws-global-accelerator-controller.h3poteto.dev/global-accelerator-tags"
+)
+AWS_GLOBAL_ACCELERATOR_IP_ADDRESS_TYPE_ANNOTATION = (
+    "aws-global-accelerator-controller.h3poteto.dev/ip-address-type"
+)
+
+# Foreign annotations this controller reads (reference pkg/apis/type.go:11-12).
+AWS_LOAD_BALANCER_TYPE_ANNOTATION = "service.beta.kubernetes.io/aws-load-balancer-type"
+INGRESS_CLASS_ANNOTATION = "kubernetes.io/ingress.class"
+
+# ALB listen-ports annotation honored by the listener diff
+# (reference pkg/cloudprovider/aws/global_accelerator.go:526).
+ALB_LISTEN_PORTS_ANNOTATION = "alb.ingress.kubernetes.io/listen-ports"

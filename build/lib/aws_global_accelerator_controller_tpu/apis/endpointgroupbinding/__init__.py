@@ -1,0 +1,3 @@
+"""EndpointGroupBinding CRD API group (operator.h3poteto.dev)."""
+
+GROUP = "operator.h3poteto.dev"

@@ -1,0 +1,129 @@
+"""EndpointGroupBinding v1alpha1 types.
+
+Mirrors reference pkg/apis/endpointgroupbinding/v1alpha1/types.go:16-70:
+spec{endpointGroupArn required, clientIPPreservation default false,
+weight nullable, serviceRef/ingressRef} and
+status{endpointIds[], observedGeneration}.  Dict round-tripping uses the
+same camelCase JSON shape as the Go types so admission payloads and
+manifests interoperate.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ...kube.objects import KubeObject, ObjectMeta
+
+GROUP = "operator.h3poteto.dev"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "EndpointGroupBinding"
+PLURAL = "endpointgroupbindings"
+
+
+@dataclass
+class ServiceReference:
+    name: str = ""
+
+
+@dataclass
+class IngressReference:
+    name: str = ""
+
+
+@dataclass
+class EndpointGroupBindingSpec:
+    endpoint_group_arn: str = ""
+    client_ip_preservation: bool = False
+    weight: Optional[int] = None
+    service_ref: Optional[ServiceReference] = None
+    ingress_ref: Optional[IngressReference] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "endpointGroupArn": self.endpoint_group_arn,
+            "clientIPPreservation": self.client_ip_preservation,
+        }
+        if self.weight is not None:
+            d["weight"] = self.weight
+        if self.service_ref is not None:
+            d["serviceRef"] = {"name": self.service_ref.name}
+        if self.ingress_ref is not None:
+            d["ingressRef"] = {"name": self.ingress_ref.name}
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EndpointGroupBindingSpec":
+        svc = d.get("serviceRef")
+        ing = d.get("ingressRef")
+        weight = d.get("weight")
+        return cls(
+            endpoint_group_arn=d.get("endpointGroupArn", ""),
+            client_ip_preservation=bool(d.get("clientIPPreservation", False)),
+            weight=int(weight) if weight is not None else None,
+            service_ref=ServiceReference(name=svc.get("name", "")) if svc else None,
+            ingress_ref=IngressReference(name=ing.get("name", "")) if ing else None,
+        )
+
+
+@dataclass
+class EndpointGroupBindingStatus:
+    endpoint_ids: List[str] = field(default_factory=list)
+    observed_generation: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "endpointIds": list(self.endpoint_ids),
+            "observedGeneration": self.observed_generation,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EndpointGroupBindingStatus":
+        return cls(
+            endpoint_ids=list(d.get("endpointIds") or []),
+            observed_generation=int(d.get("observedGeneration", 0)),
+        )
+
+
+@dataclass
+class EndpointGroupBinding(KubeObject):
+    kind = KIND
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: EndpointGroupBindingSpec = field(default_factory=EndpointGroupBindingSpec)
+    status: EndpointGroupBindingStatus = field(
+        default_factory=EndpointGroupBindingStatus)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": KIND,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EndpointGroupBinding":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=EndpointGroupBindingSpec.from_dict(d.get("spec") or {}),
+            status=EndpointGroupBindingStatus.from_dict(d.get("status") or {}),
+        )
+
+
+@dataclass
+class EndpointGroupBindingList:
+    """List kind (reference types.go:62-70)."""
+    items: List[EndpointGroupBinding] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": f"{KIND}List",
+            "items": [i.to_dict() for i in self.items],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EndpointGroupBindingList":
+        return cls(items=[EndpointGroupBinding.from_dict(i)
+                          for i in d.get("items") or []])

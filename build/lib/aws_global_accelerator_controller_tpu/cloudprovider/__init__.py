@@ -1,0 +1,26 @@
+"""Cloud provider detection.
+
+Mirrors reference pkg/cloudprovider/provider.go:8-17: maps a load-balancer
+hostname's registrable-domain suffix to a provider name.  Controllers
+``switch`` on the returned provider and log "Not implemented" for unknown
+ones (reference pkg/controller/globalaccelerator/service.go:93-122), which
+is the extension point for other clouds.
+"""
+from __future__ import annotations
+
+PROVIDER_AWS = "aws"
+
+
+def detect_cloud_provider(hostname: str) -> str:
+    """Return the provider owning ``hostname`` ('aws' for *.amazonaws.com).
+
+    Raises ValueError for unknown domains (callers log and skip the
+    ingress entry, reference globalaccelerator/service.go:88-91).
+    """
+    parts = hostname.split(".")
+    if len(parts) < 2:
+        raise ValueError(f"Unknown cloud provider: {hostname}")
+    domain = parts[-2] + "." + parts[-1]
+    if domain == "amazonaws.com":
+        return PROVIDER_AWS
+    raise ValueError(f"Unknown cloud provider: {domain}")

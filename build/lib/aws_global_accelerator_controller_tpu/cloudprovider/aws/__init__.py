@@ -1,0 +1,11 @@
+"""AWS cloud-provider layer.
+
+Structured as SURVEY.md §7 recommends: an explicit API interface
+(``api.AWSAPIs``) with a fake in-memory implementation (``fake``) for
+tests and a boto3-backed one (``real``, import-gated) for live clusters,
+plus the resource-management logic (``provider.AWSProvider``) that the
+controllers drive.  The reference instead holds concrete SDK clients in a
+struct (pkg/cloudprovider/aws/aws.go:12-38), which makes its AWS logic
+untestable without live AWS -- the interface + fake closes that gap.
+"""
+from .hostname import get_lb_name_from_hostname, get_region_from_arn  # noqa: F401

@@ -1,0 +1,79 @@
+"""Cloud factory: region -> AWSProvider.
+
+The reference constructs ``NewAWS(region)`` fresh inside every process
+function (e.g. pkg/controller/globalaccelerator/service.go:101, noted in
+SURVEY.md §5 as "constructed fresh on every sync, no client cache") and
+hardcodes "us-west-2" at delete-path call sites (service.go:35).  The
+factory fixes both: providers are cached per region, and the controllers
+receive the factory instead of instantiating clients -- which is also what
+makes the controller logic testable against the fake cloud.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from .api import AWSAPIs
+from .fake import FakeAWSCloud
+from .provider import AWSProvider
+
+# Global Accelerator is a global service homed in us-west-2
+# (reference pkg/cloudprovider/aws/aws.go:26-28).
+GLOBAL_REGION = "us-west-2"
+
+
+class CloudFactory:
+    """Base factory: subclasses provide ``_make_apis(region)``."""
+
+    def __init__(self, delete_poll_interval: float = 10.0,
+                 delete_poll_timeout: float = 180.0,
+                 accelerator_not_found_retry: float = 60.0):
+        self._providers: Dict[str, AWSProvider] = {}
+        self._lock = threading.Lock()
+        self._poll_interval = delete_poll_interval
+        self._poll_timeout = delete_poll_timeout
+        self._not_found_retry = accelerator_not_found_retry
+
+    def provider_for(self, region: str) -> AWSProvider:
+        with self._lock:
+            provider = self._providers.get(region)
+            if provider is None:
+                provider = AWSProvider(
+                    self._make_apis(region),
+                    delete_poll_interval=self._poll_interval,
+                    delete_poll_timeout=self._poll_timeout,
+                    accelerator_not_found_retry=self._not_found_retry)
+                self._providers[region] = provider
+            return provider
+
+    def global_provider(self) -> AWSProvider:
+        """Provider for the global (GA/Route53) control plane."""
+        return self.provider_for(GLOBAL_REGION)
+
+    def _make_apis(self, region: str) -> AWSAPIs:
+        raise NotImplementedError
+
+
+class FakeCloudFactory(CloudFactory):
+    """One shared in-memory cloud across all regions (GA and Route53 are
+    global services; the fake ELB holds all regions' LBs)."""
+
+    def __init__(self, settle_seconds: float = 0.0,
+                 delete_poll_interval: float = 0.01,
+                 delete_poll_timeout: float = 5.0,
+                 accelerator_not_found_retry: float = 0.2):
+        super().__init__(delete_poll_interval, delete_poll_timeout,
+                         accelerator_not_found_retry)
+        self.cloud = FakeAWSCloud(settle_seconds=settle_seconds)
+
+    def _make_apis(self, region: str) -> AWSAPIs:
+        return self.cloud
+
+
+class BotoCloudFactory(CloudFactory):
+    """boto3-backed factory for live clusters (import-gated: boto3 is not
+    available in this build environment)."""
+
+    def _make_apis(self, region: str) -> AWSAPIs:
+        from .real import BotoAWSAPIs  # deferred: needs boto3
+        return BotoAWSAPIs(region)

@@ -1,0 +1,468 @@
+"""In-memory AWS cloud (Global Accelerator + ELBv2 + Route53 state machines).
+
+The missing piece the reference never built (SURVEY.md §4: "no mocked AWS
+client anywhere ... a deliberate gap worth closing in the rebuild").
+Emulates the behaviors the provider logic depends on:
+
+- accelerator status lifecycle: create/update/disable put the accelerator
+  IN_PROGRESS; it settles to DEPLOYED after ``settle_seconds`` (the
+  disable->poll->delete dance in the reference,
+  global_accelerator.go:743-784, needs this to be observable);
+- delete_accelerator refuses enabled or still-deploying accelerators;
+- listener/endpoint-group exceptions: ListenerNotFound /
+  EndpointGroupNotFound on empty list results (global_accelerator.go:806,
+  900);
+- Route53 name normalization: trailing dots, wildcard '*' stored as the
+  octal escape ``\\052`` exactly as the real API returns it
+  (route53.go:369-371);
+- one-shot fault injection (``fail_on``) for partial-failure tests.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ...errors import (
+    AWSAPIError,
+    EndpointGroupNotFoundError,
+    ListenerNotFoundError,
+)
+from .api import AWSAPIs, ELBv2API, GlobalAcceleratorAPI, Route53API
+from .types import (
+    Accelerator,
+    AliasTarget,
+    EndpointDescription,
+    EndpointGroup,
+    HostedZone,
+    LB_STATE_ACTIVE,
+    Listener,
+    LoadBalancer,
+    PortRange,
+    ResourceRecord,
+    ResourceRecordSet,
+    STATUS_DEPLOYED,
+    STATUS_IN_PROGRESS,
+    Tags,
+)
+
+
+class FaultInjector:
+    def __init__(self):
+        self._faults: Dict[str, List[Exception]] = {}
+        self._lock = threading.Lock()
+
+    def fail_on(self, method: str, exc: Exception, times: int = 1) -> None:
+        with self._lock:
+            self._faults.setdefault(method, []).extend([exc] * times)
+
+    def check(self, method: str) -> None:
+        with self._lock:
+            pending = self._faults.get(method)
+            if pending:
+                raise pending.pop(0)
+
+
+@dataclass
+class _AccelState:
+    accelerator: Accelerator
+    tags: Tags = field(default_factory=dict)
+    settled_at: float = 0.0  # monotonic time when status becomes DEPLOYED
+
+
+class FakeGlobalAccelerator(GlobalAcceleratorAPI):
+    def __init__(self, settle_seconds: float = 0.0,
+                 faults: Optional[FaultInjector] = None):
+        self.settle_seconds = settle_seconds
+        self.faults = faults or FaultInjector()
+        self._lock = threading.RLock()
+        self._seq = itertools.count(1)
+        self._accelerators: Dict[str, _AccelState] = {}
+        # listener arn -> (accelerator arn, Listener)
+        self._listeners: Dict[str, Tuple[str, Listener]] = {}
+        # endpoint group arn -> (listener arn, EndpointGroup)
+        self._endpoint_groups: Dict[str, Tuple[str, EndpointGroup]] = {}
+
+    # -- helpers --------------------------------------------------------
+
+    def _arn(self, kind: str) -> str:
+        n = next(self._seq)
+        if kind == "accelerator":
+            return f"arn:aws:globalaccelerator::123456789012:accelerator/ga-{n:04d}"
+        raise ValueError(kind)
+
+    def _refresh_status(self, st: _AccelState) -> None:
+        if (st.accelerator.status == STATUS_IN_PROGRESS
+                and time.monotonic() >= st.settled_at):
+            st.accelerator.status = STATUS_DEPLOYED
+
+    def _mark_in_progress(self, st: _AccelState) -> None:
+        st.accelerator.status = STATUS_IN_PROGRESS
+        st.settled_at = time.monotonic() + self.settle_seconds
+        self._refresh_status(st)
+
+    def _get_state(self, arn: str) -> _AccelState:
+        st = self._accelerators.get(arn)
+        if st is None:
+            raise AWSAPIError("AcceleratorNotFoundException",
+                              f"accelerator {arn} not found")
+        self._refresh_status(st)
+        return st
+
+    # -- accelerators ---------------------------------------------------
+
+    def list_accelerators(self) -> List[Accelerator]:
+        self.faults.check("list_accelerators")
+        with self._lock:
+            out = []
+            for st in self._accelerators.values():
+                self._refresh_status(st)
+                out.append(st.accelerator.deep_copy())
+            return out
+
+    def describe_accelerator(self, arn: str) -> Accelerator:
+        self.faults.check("describe_accelerator")
+        with self._lock:
+            return self._get_state(arn).accelerator.deep_copy()
+
+    def list_tags_for_resource(self, arn: str) -> Tags:
+        self.faults.check("list_tags_for_resource")
+        with self._lock:
+            return dict(self._get_state(arn).tags)
+
+    def create_accelerator(self, name: str, ip_address_type: str,
+                           enabled: bool, tags: Tags) -> Accelerator:
+        self.faults.check("create_accelerator")
+        with self._lock:
+            arn = self._arn("accelerator")
+            acc = Accelerator(
+                accelerator_arn=arn,
+                name=name,
+                dns_name=f"{arn.rsplit('/', 1)[1]}.awsglobalaccelerator.com",
+                status=STATUS_IN_PROGRESS,
+                enabled=enabled,
+                ip_address_type=ip_address_type,
+            )
+            st = _AccelState(accelerator=acc, tags=dict(tags))
+            self._mark_in_progress(st)
+            self._accelerators[arn] = st
+            return acc.deep_copy()
+
+    def update_accelerator(self, arn: str, name: Optional[str] = None,
+                           enabled: Optional[bool] = None) -> Accelerator:
+        self.faults.check("update_accelerator")
+        with self._lock:
+            st = self._get_state(arn)
+            if name is not None:
+                st.accelerator.name = name
+            if enabled is not None:
+                st.accelerator.enabled = enabled
+            self._mark_in_progress(st)
+            return st.accelerator.deep_copy()
+
+    def tag_resource(self, arn: str, tags: Tags) -> None:
+        self.faults.check("tag_resource")
+        with self._lock:
+            st = self._get_state(arn)
+            st.tags.update(tags)
+
+    def delete_accelerator(self, arn: str) -> None:
+        self.faults.check("delete_accelerator")
+        with self._lock:
+            st = self._get_state(arn)
+            if st.accelerator.enabled:
+                raise AWSAPIError(
+                    "AcceleratorNotDisabledException",
+                    "The accelerator must be disabled before deletion")
+            if st.accelerator.status != STATUS_DEPLOYED:
+                raise AWSAPIError(
+                    "InvalidArgumentException",
+                    "The accelerator is being deployed; retry later")
+            remaining = [arn2 for arn2, (acc_arn, _) in self._listeners.items()
+                         if acc_arn == arn]
+            if remaining:
+                raise AWSAPIError(
+                    "AssociatedListenerFoundException",
+                    "The accelerator still has listeners")
+            del self._accelerators[arn]
+
+    # -- listeners ------------------------------------------------------
+
+    def list_listeners(self, accelerator_arn: str) -> List[Listener]:
+        self.faults.check("list_listeners")
+        with self._lock:
+            self._get_state(accelerator_arn)
+            return [l.copy() for a, l in self._listeners.values()
+                    if a == accelerator_arn]
+
+    def create_listener(self, accelerator_arn: str, port_ranges,
+                        protocol: str, client_affinity: str) -> Listener:
+        self.faults.check("create_listener")
+        with self._lock:
+            st = self._get_state(accelerator_arn)
+            arn = f"{accelerator_arn}/listener/l-{next(self._seq):04d}"
+            listener = Listener(
+                listener_arn=arn,
+                port_ranges=[PortRange(p.from_port, p.to_port)
+                             for p in port_ranges],
+                protocol=protocol,
+                client_affinity=client_affinity,
+            )
+            self._listeners[arn] = (accelerator_arn, listener)
+            self._mark_in_progress(st)
+            return listener.copy()
+
+    def update_listener(self, listener_arn: str, port_ranges,
+                        protocol: str, client_affinity: str) -> Listener:
+        self.faults.check("update_listener")
+        with self._lock:
+            entry = self._listeners.get(listener_arn)
+            if entry is None:
+                raise ListenerNotFoundError()
+            acc_arn, listener = entry
+            listener.port_ranges = [PortRange(p.from_port, p.to_port)
+                                    for p in port_ranges]
+            listener.protocol = protocol
+            listener.client_affinity = client_affinity
+            self._mark_in_progress(self._get_state(acc_arn))
+            return listener.copy()
+
+    def delete_listener(self, listener_arn: str) -> None:
+        self.faults.check("delete_listener")
+        with self._lock:
+            if listener_arn not in self._listeners:
+                raise ListenerNotFoundError()
+            remaining = [arn for arn, (l_arn, _) in self._endpoint_groups.items()
+                         if l_arn == listener_arn]
+            if remaining:
+                raise AWSAPIError(
+                    "AssociatedEndpointGroupFoundException",
+                    "The listener still has endpoint groups")
+            del self._listeners[listener_arn]
+
+    # -- endpoint groups ------------------------------------------------
+
+    def list_endpoint_groups(self, listener_arn: str) -> List[EndpointGroup]:
+        self.faults.check("list_endpoint_groups")
+        with self._lock:
+            return [eg.copy()
+                    for l_arn, eg in self._endpoint_groups.values()
+                    if l_arn == listener_arn]
+
+    def describe_endpoint_group(self, arn: str) -> EndpointGroup:
+        self.faults.check("describe_endpoint_group")
+        with self._lock:
+            entry = self._endpoint_groups.get(arn)
+            if entry is None:
+                raise EndpointGroupNotFoundError()
+            return entry[1].copy()
+
+    def create_endpoint_group(self, listener_arn: str, region: str,
+                              endpoint_id: str,
+                              client_ip_preservation: bool) -> EndpointGroup:
+        self.faults.check("create_endpoint_group")
+        with self._lock:
+            if listener_arn not in self._listeners:
+                raise ListenerNotFoundError()
+            arn = f"{listener_arn}/endpoint-group/eg-{next(self._seq):04d}"
+            eg = EndpointGroup(
+                endpoint_group_arn=arn,
+                endpoint_group_region=region,
+                endpoint_descriptions=[EndpointDescription(
+                    endpoint_id=endpoint_id,
+                    client_ip_preservation_enabled=client_ip_preservation)],
+            )
+            self._endpoint_groups[arn] = (listener_arn, eg)
+            acc_arn = self._listeners[listener_arn][0]
+            self._mark_in_progress(self._get_state(acc_arn))
+            return eg.copy()
+
+    def update_endpoint_group(self, arn: str,
+                              endpoint_configurations) -> EndpointGroup:
+        """UpdateEndpointGroup REPLACES the endpoint set with the given
+        configurations, as the real API does."""
+        self.faults.check("update_endpoint_group")
+        with self._lock:
+            entry = self._endpoint_groups.get(arn)
+            if entry is None:
+                raise EndpointGroupNotFoundError()
+            _, eg = entry
+            eg.endpoint_descriptions = [
+                EndpointDescription(
+                    endpoint_id=c.endpoint_id,
+                    weight=c.weight,
+                    client_ip_preservation_enabled=bool(
+                        c.client_ip_preservation_enabled),
+                )
+                for c in endpoint_configurations
+            ]
+            return eg.copy()
+
+    def add_endpoints(self, endpoint_group_arn: str, endpoint_id: str,
+                      client_ip_preservation: bool,
+                      weight: Optional[int]) -> List[EndpointDescription]:
+        self.faults.check("add_endpoints")
+        with self._lock:
+            entry = self._endpoint_groups.get(endpoint_group_arn)
+            if entry is None:
+                raise EndpointGroupNotFoundError()
+            _, eg = entry
+            for d in eg.endpoint_descriptions:
+                if d.endpoint_id == endpoint_id:
+                    d.weight = weight
+                    d.client_ip_preservation_enabled = client_ip_preservation
+                    return [EndpointDescription(endpoint_id, weight,
+                                                client_ip_preservation)]
+            desc = EndpointDescription(
+                endpoint_id=endpoint_id, weight=weight,
+                client_ip_preservation_enabled=client_ip_preservation)
+            eg.endpoint_descriptions.append(desc)
+            return [EndpointDescription(endpoint_id, weight,
+                                        client_ip_preservation)]
+
+    def remove_endpoints(self, endpoint_group_arn: str,
+                         endpoint_ids: List[str]) -> None:
+        self.faults.check("remove_endpoints")
+        with self._lock:
+            entry = self._endpoint_groups.get(endpoint_group_arn)
+            if entry is None:
+                raise EndpointGroupNotFoundError()
+            _, eg = entry
+            eg.endpoint_descriptions = [
+                d for d in eg.endpoint_descriptions
+                if d.endpoint_id not in set(endpoint_ids)]
+
+    def delete_endpoint_group(self, arn: str) -> None:
+        self.faults.check("delete_endpoint_group")
+        with self._lock:
+            if arn not in self._endpoint_groups:
+                raise EndpointGroupNotFoundError()
+            del self._endpoint_groups[arn]
+
+
+class FakeELBv2(ELBv2API):
+    def __init__(self, faults: Optional[FaultInjector] = None):
+        self.faults = faults or FaultInjector()
+        self._lock = threading.RLock()
+        self._lbs: Dict[str, LoadBalancer] = {}
+
+    def register_load_balancer(self, name: str, dns_name: str, region: str,
+                               state: str = LB_STATE_ACTIVE,
+                               lb_type: str = "network") -> LoadBalancer:
+        with self._lock:
+            arn = (f"arn:aws:elasticloadbalancing:{region}:123456789012:"
+                   f"loadbalancer/net/{name}/{abs(hash(name)) % 10**16:016x}")
+            lb = LoadBalancer(load_balancer_arn=arn, load_balancer_name=name,
+                              dns_name=dns_name, state_code=state,
+                              type=lb_type)
+            self._lbs[name] = lb
+            return lb
+
+    def set_state(self, name: str, state: str) -> None:
+        with self._lock:
+            self._lbs[name].state_code = state
+
+    def describe_load_balancers(self, names: List[str]) -> List[LoadBalancer]:
+        self.faults.check("describe_load_balancers")
+        with self._lock:
+            found = [self._lbs[n] for n in names if n in self._lbs]
+            if not found:
+                raise AWSAPIError("LoadBalancerNotFoundException",
+                                  f"Load balancers '{names}' not found")
+            from dataclasses import replace
+            return [replace(lb) for lb in found]
+
+
+def _normalize_record_name(name: str) -> str:
+    """Trailing dot + wildcard octal escape, as the real API stores names."""
+    if not name.endswith("."):
+        name += "."
+    return name.replace("*", "\\052", 1)
+
+
+class FakeRoute53(Route53API):
+    def __init__(self, faults: Optional[FaultInjector] = None):
+        self.faults = faults or FaultInjector()
+        self._lock = threading.RLock()
+        self._seq = itertools.count(1)
+        self._zones: Dict[str, HostedZone] = {}
+        self._records: Dict[str, List[ResourceRecordSet]] = {}
+
+    def create_hosted_zone(self, name: str) -> HostedZone:
+        with self._lock:
+            if not name.endswith("."):
+                name += "."
+            zone_id = f"Z{next(self._seq):08d}"
+            zone = HostedZone(id=zone_id, name=name)
+            self._zones[zone_id] = zone
+            self._records[zone_id] = []
+            return zone
+
+    def list_hosted_zones(self) -> List[HostedZone]:
+        self.faults.check("list_hosted_zones")
+        with self._lock:
+            return list(self._zones.values())
+
+    def list_hosted_zones_by_name(self, dns_name: str,
+                                  max_items: int) -> List[HostedZone]:
+        """DNS-name ordering starting at dns_name, like the real API."""
+        self.faults.check("list_hosted_zones_by_name")
+        with self._lock:
+            def dns_order(name: str) -> str:
+                return ".".join(reversed(name.rstrip(".").split(".")))
+            zones = sorted(self._zones.values(), key=lambda z: dns_order(z.name))
+            start = dns_order(dns_name.rstrip("."))
+            after = [z for z in zones if dns_order(z.name) >= start]
+            return after[:max_items]
+
+    def list_resource_record_sets(self, hosted_zone_id: str) -> List[ResourceRecordSet]:
+        self.faults.check("list_resource_record_sets")
+        with self._lock:
+            if hosted_zone_id not in self._records:
+                raise AWSAPIError("NoSuchHostedZone", hosted_zone_id)
+            return [r.copy() for r in self._records[hosted_zone_id]]
+
+    def change_resource_record_sets(self, hosted_zone_id: str, action: str,
+                                    record_set: ResourceRecordSet) -> None:
+        self.faults.check("change_resource_record_sets")
+        with self._lock:
+            if hosted_zone_id not in self._records:
+                raise AWSAPIError("NoSuchHostedZone", hosted_zone_id)
+            rs = record_set.copy()
+            rs.name = _normalize_record_name(rs.name)
+            records = self._records[hosted_zone_id]
+            existing = [r for r in records
+                        if r.name == rs.name and r.type == rs.type]
+            if action == "CREATE":
+                if existing:
+                    raise AWSAPIError(
+                        "InvalidChangeBatch",
+                        f"{rs.name} {rs.type} already exists")
+                records.append(rs)
+            elif action == "UPSERT":
+                for r in existing:
+                    records.remove(r)
+                records.append(rs)
+            elif action == "DELETE":
+                if not existing:
+                    raise AWSAPIError(
+                        "InvalidChangeBatch",
+                        f"{rs.name} {rs.type} not found")
+                for r in existing:
+                    records.remove(r)
+            else:
+                raise AWSAPIError("InvalidInput", f"bad action {action}")
+
+
+class FakeAWSCloud(AWSAPIs):
+    """Complete fake cloud bundle with shared fault injector."""
+
+    def __init__(self, settle_seconds: float = 0.0):
+        self.faults = FaultInjector()
+        super().__init__(
+            elb=FakeELBv2(self.faults),
+            ga=FakeGlobalAccelerator(settle_seconds, self.faults),
+            route53=FakeRoute53(self.faults),
+        )

@@ -1,0 +1,224 @@
+"""Pure helpers for the AWS provider: naming, tags, listener/record diffs.
+
+These are the functions the reference unit-tests (SURVEY.md §4 tier 1):
+listener/port/protocol diff logic (global_accelerator_test.go), Route53
+record matching / wildcard / parent-domain walk (route53_test.go).  Kept
+pure and module-level so they stay unit-testable without any cloud.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ...apis import (
+    ALB_LISTEN_PORTS_ANNOTATION,
+    AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION,
+    AWS_GLOBAL_ACCELERATOR_TAGS_ANNOTATION,
+)
+from ...kube.objects import Ingress, KubeObject, Service
+from .types import (
+    Accelerator,
+    EndpointGroup,
+    Listener,
+    LoadBalancer,
+    PROTOCOL_TCP,
+    PROTOCOL_UDP,
+    ResourceRecordSet,
+    RR_TYPE_A,
+    Tags,
+)
+
+logger = logging.getLogger(__name__)
+
+# Ownership tag schema -- the on-cloud "checkpoint" that makes the
+# controller restart-safe (reference global_accelerator.go:24-28;
+# SURVEY.md §5 "Checkpoint / resume").  Keys must match the reference so
+# the rebuild can adopt resources the reference created.
+MANAGED_TAG_KEY = "aws-global-accelerator-controller-managed"
+OWNER_TAG_KEY = "aws-global-accelerator-owner"
+TARGET_HOSTNAME_TAG_KEY = "aws-global-accelerator-target-hostname"
+CLUSTER_TAG_KEY = "aws-global-accelerator-cluster"
+
+
+def accelerator_owner_tag_value(resource: str, ns: str, name: str) -> str:
+    """'service/ns/name' (reference global_accelerator.go:31-33)."""
+    return f"{resource}/{ns}/{name}"
+
+
+def accelerator_tags_from_annotations(obj: KubeObject) -> Tags:
+    """Parse 'k1=v1,k2=v2' from the tags annotation; malformed entries are
+    skipped (reference global_accelerator.go:35-51)."""
+    raw = obj.annotations.get(AWS_GLOBAL_ACCELERATOR_TAGS_ANNOTATION, "")
+    tags: Tags = {}
+    for part in raw.split(","):
+        kv = part.split("=")
+        if len(kv) != 2:
+            continue
+        tags[kv[0]] = kv[1]
+    return tags
+
+
+def accelerator_name(resource: str, obj: KubeObject) -> str:
+    """Name annotation wins, else 'resource-ns-name'
+    (reference global_accelerator.go:53-60)."""
+    name = obj.annotations.get(AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION, "")
+    if name:
+        return name
+    return f"{resource}-{obj.metadata.namespace}-{obj.metadata.name}"
+
+
+def tags_contains_all_values(tags: Tags, target: Tags) -> bool:
+    """All target k/v present (reference global_accelerator.go:559-570)."""
+    return all(tags.get(k) == v for k, v in target.items())
+
+
+def listener_for_service(svc: Service) -> Tuple[List[int], str]:
+    """Service ports -> (ports, protocol).
+
+    Mirrors the reference's quirk that the LAST recognized port protocol
+    wins when ports mix TCP/UDP (global_accelerator.go:503-515) -- GA
+    listeners carry a single protocol.
+    """
+    ports: List[int] = []
+    protocol = PROTOCOL_TCP
+    for p in svc.spec.ports:
+        ports.append(int(p.port))
+        if p.protocol.lower() == "udp":
+            protocol = PROTOCOL_UDP
+        elif p.protocol.lower() == "tcp":
+            protocol = PROTOCOL_TCP
+    return ports, protocol
+
+
+def listener_for_ingress(ingress: Ingress) -> Tuple[List[int], str]:
+    """Ingress -> (ports, TCP).
+
+    The alb.ingress.kubernetes.io/listen-ports JSON annotation wins when
+    present; otherwise defaultBackend + rule backend ports
+    (reference global_accelerator.go:522-557).
+    """
+    ports: List[int] = []
+    protocol = PROTOCOL_TCP
+    raw = ingress.annotations.get(ALB_LISTEN_PORTS_ANNOTATION)
+    if raw is not None:
+        try:
+            entries = json.loads(raw)
+        except (ValueError, TypeError) as e:
+            logger.error("bad %s annotation: %s", ALB_LISTEN_PORTS_ANNOTATION, e)
+            return ports, protocol
+        for entry in entries:
+            http = entry.get("HTTP", 0)
+            https = entry.get("HTTPS", 0)
+            if http:
+                ports.append(int(http))
+            if https:
+                ports.append(int(https))
+        return ports, protocol
+
+    if ingress.spec.default_backend and ingress.spec.default_backend.service:
+        ports.append(int(ingress.spec.default_backend.service.port.number))
+    for rule in ingress.spec.rules:
+        if rule.http:
+            for path in rule.http.paths:
+                if path.backend.service:
+                    ports.append(int(path.backend.service.port.number))
+    return ports, protocol
+
+
+def _ports_symmetric_diff(listener: Listener, desired_ports: List[int]) -> bool:
+    """True when listener FromPorts and desired ports differ as multisets
+    -- the count-map symmetric diff (reference global_accelerator.go:458-474)."""
+    counts: Dict[int, int] = {}
+    for pr in listener.port_ranges:
+        counts[int(pr.from_port)] = counts.get(int(pr.from_port), 0) + 1
+    for p in desired_ports:
+        counts[int(p)] = counts.get(int(p), 0) + 1
+    return any(v <= 1 for v in counts.values())
+
+
+def listener_port_changed_from_service(listener: Listener, svc: Service) -> bool:
+    ports, _ = listener_for_service(svc)
+    return _ports_symmetric_diff(listener, ports)
+
+
+def listener_port_changed_from_ingress(listener: Listener,
+                                       ingress: Ingress) -> bool:
+    ports, _ = listener_for_ingress(ingress)
+    return _ports_symmetric_diff(listener, ports)
+
+
+def listener_protocol_changed_from_service(listener: Listener,
+                                           svc: Service) -> bool:
+    _, protocol = listener_for_service(svc)
+    return listener.protocol != protocol
+
+
+def listener_protocol_changed_from_ingress(listener: Listener,
+                                           ingress: Ingress) -> bool:
+    # ALB is HTTP(S)-only => the GA listener must be TCP
+    # (reference global_accelerator.go:452-456).
+    return listener.protocol != PROTOCOL_TCP
+
+
+def endpoint_contains_lb(endpoint_group: EndpointGroup,
+                         lb: LoadBalancer) -> bool:
+    """(reference global_accelerator.go:494-501)"""
+    return any(d.endpoint_id == lb.load_balancer_arn
+               for d in endpoint_group.endpoint_descriptions)
+
+
+def accelerator_target_tags(resource: str, obj: KubeObject,
+                            hostname: str) -> Tags:
+    """The tag set acceleratorChanged checks for drift
+    (reference global_accelerator.go:426-434; cluster tag deliberately not
+    included there)."""
+    target = {
+        MANAGED_TAG_KEY: "true",
+        OWNER_TAG_KEY: accelerator_owner_tag_value(
+            resource, obj.metadata.namespace, obj.metadata.name),
+        TARGET_HOSTNAME_TAG_KEY: hostname,
+    }
+    target.update(accelerator_tags_from_annotations(obj))
+    return target
+
+
+# ---------------------------------------------------------------------------
+# Route53 helpers
+# ---------------------------------------------------------------------------
+
+def route53_owner_value(cluster_name: str, resource: str, ns: str,
+                        name: str) -> str:
+    """TXT ownership value, external-dns style (reference route53.go:18-20).
+    The surrounding quotes are part of the record value."""
+    return (f'"heritage=aws-global-accelerator-controller,'
+            f'cluster={cluster_name},{resource}/{ns}/{name}"')
+
+
+def replace_wildcards(s: str) -> str:
+    """Route53 returns '*' as the octal escape \\052
+    (reference route53.go:369-371)."""
+    return s.replace("\\052", "*", 1)
+
+
+def find_a_record(records: List[ResourceRecordSet],
+                  hostname: str) -> Optional[ResourceRecordSet]:
+    """(reference route53.go:360-367)"""
+    for record in records:
+        if (record.type == RR_TYPE_A
+                and replace_wildcards(record.name) == hostname + "."):
+            return record
+    return None
+
+
+def need_records_update(record: ResourceRecordSet,
+                        accelerator: Accelerator) -> bool:
+    """Alias drift check (reference route53.go:373-381)."""
+    if record.alias_target is None:
+        return True
+    return record.alias_target.dns_name != accelerator.dns_name + "."
+
+
+def parent_domain(hostname: str) -> str:
+    """Strip one leading label (reference route53.go:383-386)."""
+    return ".".join(hostname.split(".")[1:])

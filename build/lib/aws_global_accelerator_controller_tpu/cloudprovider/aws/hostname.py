@@ -1,0 +1,60 @@
+"""ELB hostname parsing.
+
+Mirrors reference pkg/cloudprovider/aws/load_balancer.go:32-98: regex-parse
+ALB/NLB hostnames into (lb_name, region).
+
+Hostname shapes:
+- public/internal ALB: ``[internal-]<name>-<hash>.<region>.elb.amazonaws.com``
+- NLB:                 ``<name>-<hash>.elb.<region>.amazonaws.com``
+"""
+from __future__ import annotations
+
+import re
+
+_ALB_SUFFIX = re.compile(r"\.elb\.amazonaws\.com$")
+_NLB_SUFFIX = re.compile(r"\.elb\..+\.amazonaws\.com$")
+_INTERNAL_PREFIX = re.compile(r"^internal-")
+_INTERNAL_ALB_NAME = re.compile(r"^internal\-([\w\-]+)\-[\w]+$")
+_LB_NAME = re.compile(r"^([\w\-]+)\-[\w]+$")
+
+
+def get_lb_name_from_hostname(hostname: str):
+    """Parse an ELB hostname into (name, region).
+
+    Raises ValueError when the hostname is not an Elastic Load Balancer or
+    its subdomain cannot be parsed (reference load_balancer.go:32-45).
+    """
+    if _ALB_SUFFIX.search(hostname):
+        return _match_alb(hostname)
+    if _NLB_SUFFIX.search(hostname):
+        return _match_nlb(hostname)
+    raise ValueError(f"{hostname} is not Elastic Load Balancer")
+
+
+def _match_alb(hostname: str):
+    parts = hostname.split(".")
+    subdomain, region = parts[0], parts[1]
+    if _INTERNAL_PREFIX.match(subdomain):
+        m = _INTERNAL_ALB_NAME.match(subdomain)
+        if not m:
+            raise ValueError(
+                f"Failed to parse subdomain for internal ALB: {subdomain}")
+        return m.group(1), region
+    m = _LB_NAME.match(subdomain)
+    if not m:
+        raise ValueError(f"Failed to parse subdomain for public ALB: {subdomain}")
+    return m.group(1), region
+
+
+def _match_nlb(hostname: str):
+    parts = hostname.split(".")
+    subdomain, region = parts[0], parts[2]
+    m = _LB_NAME.match(subdomain)
+    if not m:
+        raise ValueError(f"Failed to parse subdomain for NLB: {subdomain}")
+    return m.group(1), region
+
+
+def get_region_from_arn(arn: str) -> str:
+    """ARN field 4 is the region (reference load_balancer.go:95-98)."""
+    return arn.split(":")[3]

@@ -1,0 +1,354 @@
+"""boto3-backed implementations of the AWS API interfaces.
+
+The live analogue of the reference's SDK clients
+(pkg/cloudprovider/aws/aws.go:18-38): ELBv2 regional, Global Accelerator
+and Route53 pinned to us-west-2.  Paginates with the reference's page
+sizes (accelerators/zones 100, record sets 300).
+
+boto3 is NOT installed in this build environment; importing this module
+without it raises ImportError at construction, and nothing else in the
+framework imports it eagerly (see factory.BotoCloudFactory).  This code
+path is exercised only against live AWS (the local_e2e tier).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...errors import (
+    AWSAPIError,
+    EndpointGroupNotFoundError,
+    ListenerNotFoundError,
+)
+from .api import (
+    AWSAPIs,
+    ELBv2API,
+    GlobalAcceleratorAPI,
+    LIST_ACCELERATORS_PAGE_SIZE,
+    LIST_HOSTED_ZONES_PAGE_SIZE,
+    LIST_RECORD_SETS_PAGE_SIZE,
+    Route53API,
+)
+from .types import (
+    Accelerator,
+    AliasTarget,
+    EndpointDescription,
+    EndpointGroup,
+    HostedZone,
+    Listener,
+    LoadBalancer,
+    PortRange,
+    ResourceRecord,
+    ResourceRecordSet,
+    Tags,
+)
+
+GLOBAL_REGION = "us-west-2"
+
+
+def _wrap_client_error(e) -> Exception:
+    code = getattr(e, "response", {}).get("Error", {}).get("Code", "")
+    if code == "ListenerNotFoundException":
+        return ListenerNotFoundError(str(e))
+    if code == "EndpointGroupNotFoundException":
+        return EndpointGroupNotFoundError(str(e))
+    return AWSAPIError(code or "Unknown", str(e))
+
+
+class BotoGlobalAccelerator(GlobalAcceleratorAPI):
+    def __init__(self, session):
+        self._c = session.client("globalaccelerator",
+                                 region_name=GLOBAL_REGION)
+
+    def _call(self, fn, **kwargs):
+        try:
+            return fn(**kwargs)
+        except Exception as e:  # botocore.exceptions.ClientError
+            raise _wrap_client_error(e) from e
+
+    @staticmethod
+    def _to_accelerator(d) -> Accelerator:
+        return Accelerator(
+            accelerator_arn=d["AcceleratorArn"],
+            name=d.get("Name", ""),
+            dns_name=d.get("DnsName", ""),
+            status=d.get("Status", ""),
+            enabled=d.get("Enabled", False),
+            ip_address_type=d.get("IpAddressType", ""),
+        )
+
+    def list_accelerators(self) -> List[Accelerator]:
+        out, token = [], None
+        while True:
+            kwargs = {"MaxResults": LIST_ACCELERATORS_PAGE_SIZE}
+            if token:
+                kwargs["NextToken"] = token
+            page = self._call(self._c.list_accelerators, **kwargs)
+            out.extend(self._to_accelerator(a)
+                       for a in page.get("Accelerators", []))
+            token = page.get("NextToken")
+            if not token:
+                return out
+
+    def describe_accelerator(self, arn: str) -> Accelerator:
+        res = self._call(self._c.describe_accelerator, AcceleratorArn=arn)
+        return self._to_accelerator(res["Accelerator"])
+
+    def list_tags_for_resource(self, arn: str) -> Tags:
+        res = self._call(self._c.list_tags_for_resource, ResourceArn=arn)
+        return {t["Key"]: t["Value"] for t in res.get("Tags", [])}
+
+    def create_accelerator(self, name, ip_address_type, enabled,
+                           tags) -> Accelerator:
+        res = self._call(
+            self._c.create_accelerator, Name=name, Enabled=enabled,
+            IpAddressType=ip_address_type,
+            Tags=[{"Key": k, "Value": v} for k, v in tags.items()])
+        return self._to_accelerator(res["Accelerator"])
+
+    def update_accelerator(self, arn, name=None, enabled=None) -> Accelerator:
+        kwargs = {"AcceleratorArn": arn}
+        if name is not None:
+            kwargs["Name"] = name
+        if enabled is not None:
+            kwargs["Enabled"] = enabled
+        res = self._call(self._c.update_accelerator, **kwargs)
+        return self._to_accelerator(res["Accelerator"])
+
+    def tag_resource(self, arn, tags) -> None:
+        self._call(self._c.tag_resource, ResourceArn=arn,
+                   Tags=[{"Key": k, "Value": v} for k, v in tags.items()])
+
+    def delete_accelerator(self, arn) -> None:
+        self._call(self._c.delete_accelerator, AcceleratorArn=arn)
+
+    @staticmethod
+    def _to_listener(d) -> Listener:
+        return Listener(
+            listener_arn=d["ListenerArn"],
+            port_ranges=[PortRange(p["FromPort"], p["ToPort"])
+                         for p in d.get("PortRanges", [])],
+            protocol=d.get("Protocol", "TCP"),
+            client_affinity=d.get("ClientAffinity", "NONE"),
+        )
+
+    def list_listeners(self, accelerator_arn) -> List[Listener]:
+        out, token = [], None
+        while True:
+            kwargs = {"AcceleratorArn": accelerator_arn, "MaxResults": 100}
+            if token:
+                kwargs["NextToken"] = token
+            page = self._call(self._c.list_listeners, **kwargs)
+            out.extend(self._to_listener(l) for l in page.get("Listeners", []))
+            token = page.get("NextToken")
+            if not token:
+                return out
+
+    def create_listener(self, accelerator_arn, port_ranges, protocol,
+                        client_affinity) -> Listener:
+        res = self._call(
+            self._c.create_listener, AcceleratorArn=accelerator_arn,
+            PortRanges=[{"FromPort": p.from_port, "ToPort": p.to_port}
+                        for p in port_ranges],
+            Protocol=protocol, ClientAffinity=client_affinity)
+        return self._to_listener(res["Listener"])
+
+    def update_listener(self, listener_arn, port_ranges, protocol,
+                        client_affinity) -> Listener:
+        res = self._call(
+            self._c.update_listener, ListenerArn=listener_arn,
+            PortRanges=[{"FromPort": p.from_port, "ToPort": p.to_port}
+                        for p in port_ranges],
+            Protocol=protocol, ClientAffinity=client_affinity)
+        return self._to_listener(res["Listener"])
+
+    def delete_listener(self, listener_arn) -> None:
+        self._call(self._c.delete_listener, ListenerArn=listener_arn)
+
+    @staticmethod
+    def _to_endpoint_group(d) -> EndpointGroup:
+        return EndpointGroup(
+            endpoint_group_arn=d["EndpointGroupArn"],
+            endpoint_group_region=d.get("EndpointGroupRegion", ""),
+            endpoint_descriptions=[
+                EndpointDescription(
+                    endpoint_id=e.get("EndpointId", ""),
+                    weight=e.get("Weight"),
+                    client_ip_preservation_enabled=e.get(
+                        "ClientIPPreservationEnabled", False))
+                for e in d.get("EndpointDescriptions", [])],
+        )
+
+    def list_endpoint_groups(self, listener_arn) -> List[EndpointGroup]:
+        out, token = [], None
+        while True:
+            kwargs = {"ListenerArn": listener_arn, "MaxResults": 100}
+            if token:
+                kwargs["NextToken"] = token
+            page = self._call(self._c.list_endpoint_groups, **kwargs)
+            out.extend(self._to_endpoint_group(g)
+                       for g in page.get("EndpointGroups", []))
+            token = page.get("NextToken")
+            if not token:
+                return out
+
+    def describe_endpoint_group(self, arn) -> EndpointGroup:
+        res = self._call(self._c.describe_endpoint_group,
+                         EndpointGroupArn=arn)
+        return self._to_endpoint_group(res["EndpointGroup"])
+
+    def create_endpoint_group(self, listener_arn, region, endpoint_id,
+                              client_ip_preservation) -> EndpointGroup:
+        res = self._call(
+            self._c.create_endpoint_group, ListenerArn=listener_arn,
+            EndpointGroupRegion=region,
+            EndpointConfigurations=[{
+                "EndpointId": endpoint_id,
+                "ClientIPPreservationEnabled": client_ip_preservation}])
+        return self._to_endpoint_group(res["EndpointGroup"])
+
+    def update_endpoint_group(self, arn, endpoint_configurations) -> EndpointGroup:
+        configs = []
+        for c in endpoint_configurations:
+            entry = {"EndpointId": c.endpoint_id}
+            if c.weight is not None:
+                entry["Weight"] = c.weight
+            entry["ClientIPPreservationEnabled"] = bool(
+                c.client_ip_preservation_enabled)
+            configs.append(entry)
+        res = self._call(self._c.update_endpoint_group,
+                         EndpointGroupArn=arn,
+                         EndpointConfigurations=configs)
+        return self._to_endpoint_group(res["EndpointGroup"])
+
+    def add_endpoints(self, endpoint_group_arn, endpoint_id,
+                      client_ip_preservation, weight):
+        config = {"EndpointId": endpoint_id,
+                  "ClientIPPreservationEnabled": client_ip_preservation}
+        if weight is not None:
+            config["Weight"] = weight
+        res = self._call(self._c.add_endpoints,
+                         EndpointGroupArn=endpoint_group_arn,
+                         EndpointConfigurations=[config])
+        return [EndpointDescription(
+                    endpoint_id=e.get("EndpointId", ""),
+                    weight=e.get("Weight"),
+                    client_ip_preservation_enabled=e.get(
+                        "ClientIPPreservationEnabled", False))
+                for e in res.get("EndpointDescriptions", [])]
+
+    def remove_endpoints(self, endpoint_group_arn, endpoint_ids) -> None:
+        self._call(self._c.remove_endpoints,
+                   EndpointGroupArn=endpoint_group_arn,
+                   EndpointIdentifiers=[{"EndpointId": e}
+                                        for e in endpoint_ids])
+
+    def delete_endpoint_group(self, arn) -> None:
+        self._call(self._c.delete_endpoint_group, EndpointGroupArn=arn)
+
+
+class BotoELBv2(ELBv2API):
+    def __init__(self, session, region: str):
+        self._c = session.client("elbv2", region_name=region)
+
+    def describe_load_balancers(self, names) -> List[LoadBalancer]:
+        try:
+            res = self._c.describe_load_balancers(Names=names)
+        except Exception as e:
+            raise _wrap_client_error(e) from e
+        return [LoadBalancer(
+                    load_balancer_arn=lb["LoadBalancerArn"],
+                    load_balancer_name=lb["LoadBalancerName"],
+                    dns_name=lb.get("DNSName", ""),
+                    state_code=lb.get("State", {}).get("Code", ""),
+                    type=lb.get("Type", ""))
+                for lb in res.get("LoadBalancers", [])]
+
+
+class BotoRoute53(Route53API):
+    def __init__(self, session):
+        self._c = session.client("route53", region_name=GLOBAL_REGION)
+
+    def _call(self, fn, **kwargs):
+        try:
+            return fn(**kwargs)
+        except Exception as e:
+            raise _wrap_client_error(e) from e
+
+    def list_hosted_zones(self) -> List[HostedZone]:
+        out, marker = [], None
+        while True:
+            kwargs = {"MaxItems": str(LIST_HOSTED_ZONES_PAGE_SIZE)}
+            if marker:
+                kwargs["Marker"] = marker
+            page = self._call(self._c.list_hosted_zones, **kwargs)
+            out.extend(HostedZone(id=z["Id"], name=z["Name"])
+                       for z in page.get("HostedZones", []))
+            if not page.get("IsTruncated"):
+                return out
+            marker = page.get("NextMarker")
+
+    def list_hosted_zones_by_name(self, dns_name, max_items) -> List[HostedZone]:
+        res = self._call(self._c.list_hosted_zones_by_name,
+                         DNSName=dns_name, MaxItems=str(max_items))
+        return [HostedZone(id=z["Id"], name=z["Name"])
+                for z in res.get("HostedZones", [])]
+
+    @staticmethod
+    def _to_record_set(d) -> ResourceRecordSet:
+        alias = d.get("AliasTarget")
+        return ResourceRecordSet(
+            name=d["Name"], type=d["Type"], ttl=d.get("TTL"),
+            resource_records=[ResourceRecord(value=r["Value"])
+                              for r in d.get("ResourceRecords", [])],
+            alias_target=AliasTarget(
+                dns_name=alias["DNSName"],
+                hosted_zone_id=alias["HostedZoneId"],
+                evaluate_target_health=alias.get(
+                    "EvaluateTargetHealth", False)) if alias else None,
+        )
+
+    def list_resource_record_sets(self, hosted_zone_id) -> List[ResourceRecordSet]:
+        out = []
+        kwargs = {"HostedZoneId": hosted_zone_id,
+                  "MaxItems": str(LIST_RECORD_SETS_PAGE_SIZE)}
+        while True:
+            page = self._call(self._c.list_resource_record_sets, **kwargs)
+            out.extend(self._to_record_set(r)
+                       for r in page.get("ResourceRecordSets", []))
+            if not page.get("IsTruncated"):
+                return out
+            kwargs["StartRecordName"] = page.get("NextRecordName")
+            kwargs["StartRecordType"] = page.get("NextRecordType")
+
+    def change_resource_record_sets(self, hosted_zone_id, action,
+                                    record_set) -> None:
+        rs = {"Name": record_set.name, "Type": record_set.type}
+        if record_set.ttl is not None:
+            rs["TTL"] = record_set.ttl
+        if record_set.resource_records:
+            rs["ResourceRecords"] = [{"Value": r.value}
+                                     for r in record_set.resource_records]
+        if record_set.alias_target is not None:
+            rs["AliasTarget"] = {
+                "DNSName": record_set.alias_target.dns_name,
+                "HostedZoneId": record_set.alias_target.hosted_zone_id,
+                "EvaluateTargetHealth":
+                    record_set.alias_target.evaluate_target_health,
+            }
+        self._call(self._c.change_resource_record_sets,
+                   HostedZoneId=hosted_zone_id,
+                   ChangeBatch={"Changes": [
+                       {"Action": action, "ResourceRecordSet": rs}]})
+
+
+class BotoAWSAPIs(AWSAPIs):
+    """Live AWS client bundle for one ELB region."""
+
+    def __init__(self, region: str):
+        import boto3  # gated: not available in the build environment
+        session = boto3.session.Session()
+        super().__init__(
+            elb=BotoELBv2(session, region),
+            ga=BotoGlobalAccelerator(session),
+            route53=BotoRoute53(session),
+        )

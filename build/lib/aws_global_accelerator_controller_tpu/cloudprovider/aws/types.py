@@ -1,0 +1,133 @@
+"""AWS resource types (the SDK-shape subset the controllers consume).
+
+Mirrors the aws-sdk-go-v2 types the reference reads:
+- globalaccelerator: Accelerator/Listener/PortRange/EndpointGroup/
+  EndpointDescription/Tag (gatypes in pkg/cloudprovider/aws/*.go)
+- elasticloadbalancingv2: LoadBalancer with State.Code
+- route53: HostedZone/ResourceRecordSet/AliasTarget/ResourceRecord
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional
+
+# Accelerator status (gatypes.AcceleratorStatus*)
+STATUS_DEPLOYED = "DEPLOYED"
+STATUS_IN_PROGRESS = "IN_PROGRESS"
+
+# Protocols (gatypes.Protocol*)
+PROTOCOL_TCP = "TCP"
+PROTOCOL_UDP = "UDP"
+
+# IP address types (gatypes.IpAddressType*)
+IP_ADDRESS_TYPE_IPV4 = "IPV4"
+IP_ADDRESS_TYPE_DUAL_STACK = "DUAL_STACK"
+
+# LB states (elbv2types.LoadBalancerStateEnum*)
+LB_STATE_ACTIVE = "active"
+LB_STATE_PROVISIONING = "provisioning"
+
+# Record types (route53types.RRType*)
+RR_TYPE_A = "A"
+RR_TYPE_TXT = "TXT"
+
+# The fixed Route53 hosted zone that fronts every Global Accelerator
+# (reference pkg/cloudprovider/aws/route53.go:264-268, from the AWS docs).
+GLOBAL_ACCELERATOR_HOSTED_ZONE_ID = "Z2BJ6XQ5FK7U4H"
+
+
+@dataclass
+class PortRange:
+    from_port: int
+    to_port: int
+
+
+@dataclass
+class Listener:
+    listener_arn: str
+    port_ranges: List[PortRange] = field(default_factory=list)
+    protocol: str = PROTOCOL_TCP
+    client_affinity: str = "NONE"
+
+    def copy(self) -> "Listener":
+        return replace(self, port_ranges=[replace(p)
+                                          for p in self.port_ranges])
+
+
+@dataclass
+class EndpointDescription:
+    endpoint_id: str
+    weight: Optional[int] = None
+    client_ip_preservation_enabled: bool = False
+
+
+@dataclass
+class EndpointGroup:
+    endpoint_group_arn: str
+    endpoint_group_region: str = ""
+    endpoint_descriptions: List[EndpointDescription] = field(default_factory=list)
+
+    def copy(self) -> "EndpointGroup":
+        return replace(self, endpoint_descriptions=[
+            replace(d) for d in self.endpoint_descriptions])
+
+
+@dataclass
+class Accelerator:
+    accelerator_arn: str
+    name: str = ""
+    dns_name: str = ""
+    status: str = STATUS_DEPLOYED
+    enabled: bool = True
+    ip_address_type: str = IP_ADDRESS_TYPE_DUAL_STACK
+
+    def deep_copy(self) -> "Accelerator":
+        # direct constructor: this is the hottest copy in the tag-scan
+        # discovery path (O(accelerators) per ensure)
+        return Accelerator(self.accelerator_arn, self.name, self.dns_name,
+                           self.status, self.enabled, self.ip_address_type)
+
+
+@dataclass
+class LoadBalancer:
+    load_balancer_arn: str
+    load_balancer_name: str
+    dns_name: str
+    state_code: str = LB_STATE_ACTIVE
+    type: str = "network"
+
+
+@dataclass
+class HostedZone:
+    id: str
+    name: str  # always with trailing dot, as the Route53 API returns
+
+
+@dataclass
+class AliasTarget:
+    dns_name: str
+    hosted_zone_id: str
+    evaluate_target_health: bool = True
+
+
+@dataclass
+class ResourceRecord:
+    value: str
+
+
+@dataclass
+class ResourceRecordSet:
+    name: str  # trailing-dot form; wildcards octal-escaped (\052) as in the API
+    type: str
+    ttl: Optional[int] = None
+    resource_records: List[ResourceRecord] = field(default_factory=list)
+    alias_target: Optional[AliasTarget] = None
+
+    def copy(self) -> "ResourceRecordSet":
+        return replace(
+            self,
+            resource_records=[replace(r) for r in self.resource_records],
+            alias_target=(replace(self.alias_target)
+                          if self.alias_target else None))
+
+Tags = Dict[str, str]

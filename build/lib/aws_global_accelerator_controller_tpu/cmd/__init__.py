@@ -1,0 +1,2 @@
+"""CLI process entry (reference main.go + cmd/)."""
+from .root import main  # noqa: F401

@@ -1,0 +1,260 @@
+"""CLI subcommands for the TPU compute track: train | plan.
+
+The reference CLI has only {controller|webhook|version} (cmd/root.go:
+13-30) because the reference has no compute.  These commands make the
+compute track user-facing: ``train`` fits the traffic policy model on
+synthetic fleet telemetry with orbax checkpointing (resumable), ``plan``
+loads a checkpoint (or a fresh init) and emits Global Accelerator
+endpoint weights for a fleet as JSON.
+
+JAX is imported lazily inside the run functions so `controller`/
+`webhook`/`version` never pay for (or hang on) accelerator backend
+initialisation.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def register(sub) -> None:
+    train = sub.add_parser(
+        "train", help="Train the traffic policy model (TPU compute track)")
+    train.add_argument("--model", choices=("mlp", "temporal"),
+                       default="mlp",
+                       help="mlp: snapshot MLP; temporal: causal "
+                            "attention over a telemetry window.")
+    train.add_argument("--window", type=int, default=64,
+                       help="Telemetry window length (temporal model); "
+                            "the default reaches the Pallas flash "
+                            "kernel (FLASH_MIN_WINDOW).")
+    train.add_argument("--steps", type=int, default=100,
+                       help="Optimisation steps to run this invocation.")
+    train.add_argument("--ckpt", default="",
+                       help="Checkpoint directory (enables save/resume).")
+    train.add_argument("--save-every", type=int, default=50,
+                       help="Checkpoint cadence in steps.")
+    train.add_argument("--groups", type=int, default=256,
+                       help="Endpoint groups per synthetic batch.")
+    train.add_argument("--endpoints", type=int, default=32,
+                       help="Endpoints per group.")
+    train.add_argument("--hidden", type=int, default=128,
+                       help="Model hidden width.")
+    train.add_argument("--lr", type=float, default=1e-3,
+                       help="Adam learning rate.")
+    train.add_argument("--seed", type=int, default=0,
+                       help="PRNG seed for init and batches.")
+    train.add_argument("--sharded", action="store_true",
+                       help="Shard over all visible devices: temporal "
+                            "-> data x seq mesh with ring attention "
+                            "over the window; mlp -> data x model "
+                            "mesh (dp x tp).")
+
+    plan = sub.add_parser(
+        "plan", help="Plan GA endpoint weights for a fleet (JSON out)")
+    plan.add_argument("--model", choices=("mlp", "temporal"),
+                      default="mlp",
+                      help="Must match the model the ckpt was trained "
+                           "with.")
+    plan.add_argument("--window", type=int, default=64,
+                      help="Telemetry window length (temporal model); "
+                           "the default reaches the Pallas flash "
+                           "kernel (FLASH_MIN_WINDOW).")
+    plan.add_argument("--ckpt", default="",
+                      help="Checkpoint directory to load params from "
+                           "(default: fresh init).")
+    plan.add_argument("--groups", type=int, default=8,
+                      help="Endpoint groups in the synthetic fleet.")
+    plan.add_argument("--endpoints", type=int, default=16,
+                      help="Endpoints per group.")
+    plan.add_argument("--hidden", type=int, default=128,
+                      help="Model hidden width (must match the ckpt).")
+    plan.add_argument("--seed", type=int, default=0,
+                      help="PRNG seed for the synthetic telemetry.")
+    plan.add_argument("--sharded", action="store_true",
+                      help="Shard planning over all visible devices "
+                           "(see train --sharded).")
+
+
+def _build_model(args):
+    """The single model-family dispatch point.
+
+    Returns (model, run_step, run_plan_fwd): ``run_step(params, opt,
+    key)`` performs one training step on a fresh synthetic batch;
+    ``run_plan_fwd(params, key)`` plans weights for a synthetic fleet.
+    """
+    from ..jaxenv import import_jax
+    jax = import_jax()
+
+    lr = getattr(args, "lr", 1e-3)
+    sharded = getattr(args, "sharded", False)
+    if args.model == "temporal":
+        from ..models.temporal import TemporalTrafficModel, synthetic_window
+
+        model = TemporalTrafficModel(hidden_dim=args.hidden,
+                                     learning_rate=lr)
+
+        def make_data(key):
+            return synthetic_window(key, steps=args.window,
+                                    groups=args.groups,
+                                    endpoints=args.endpoints)
+
+        if sharded:
+            planner = _temporal_planner(args, model)
+
+            def run_step(params, opt_state, key):
+                window, batch = make_data(key)
+                return planner.train_step(
+                    params, opt_state, planner.shard_window(window),
+                    planner.shard_batch(batch))
+
+            def run_plan_fwd(params, key):
+                window, batch = make_data(key)
+                return planner.forward(
+                    params, planner.shard_window(window), batch.mask)
+        else:
+            step_fn = jax.jit(model.train_step)
+            fwd = jax.jit(model.forward)
+
+            def run_step(params, opt_state, key):
+                window, batch = make_data(key)
+                return step_fn(params, opt_state, window, batch)
+
+            def run_plan_fwd(params, key):
+                window, batch = make_data(key)
+                return fwd(params, window, batch.mask)
+    else:
+        from ..models.traffic import TrafficPolicyModel, synthetic_batch
+
+        model = TrafficPolicyModel(hidden_dim=args.hidden,
+                                   learning_rate=lr)
+
+        def make_batch(key):
+            return synthetic_batch(key, groups=args.groups,
+                                   endpoints=args.endpoints)
+
+        if sharded:
+            planner = _mlp_planner(args, model)
+
+            def run_step(params, opt_state, key):
+                batch = planner.shard_batch(make_batch(key))
+                return planner.train_step(params, opt_state, batch)
+
+            def run_plan_fwd(params, key):
+                batch = planner.shard_batch(make_batch(key))
+                return planner.forward(params, batch.features,
+                                       batch.mask)
+        else:
+            step_fn = jax.jit(model.train_step)
+            fwd = jax.jit(model.forward)
+
+            def run_step(params, opt_state, key):
+                batch = make_batch(key)
+                return step_fn(params, opt_state, batch)
+
+            def run_plan_fwd(params, key):
+                batch = make_batch(key)
+                return fwd(params, batch.features, batch.mask)
+    return model, run_step, run_plan_fwd
+
+
+def _temporal_planner(args, model):
+    """data x seq mesh over all visible devices; validates divisibility
+    so shard_map sees even blocks."""
+    from ..parallel import ShardedTemporalPlanner
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh(axis_names=("data", "seq"))
+    n_seq, n_data = mesh.shape["seq"], mesh.shape["data"]
+    if args.window % n_seq or args.groups % n_data:
+        raise SystemExit(
+            f"--sharded needs --window divisible by the seq axis "
+            f"({n_seq}) and --groups by the data axis ({n_data}); got "
+            f"window={args.window} groups={args.groups}")
+    logger.info("temporal mesh: data=%d seq=%d", n_data, n_seq)
+    return ShardedTemporalPlanner(model, mesh, window=args.window)
+
+
+def _mlp_planner(args, model):
+    from ..parallel import ShardedTrafficPlanner
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh(axis_names=("data", "model"))
+    n_data, n_model = mesh.shape["data"], mesh.shape["model"]
+    if args.groups % n_data or args.hidden % n_model:
+        raise SystemExit(
+            f"--sharded needs --groups divisible by the data axis "
+            f"({n_data}) and --hidden by the model axis ({n_model}); "
+            f"got groups={args.groups} hidden={args.hidden}")
+    logger.info("mlp mesh: data=%d model=%d", n_data, n_model)
+    return ShardedTrafficPlanner(model, mesh)
+
+
+def run_train(args) -> int:
+    from ..jaxenv import import_jax
+    jax = import_jax()
+
+    from ..models.checkpoint import TrainCheckpointer
+
+    model, run_step, _ = _build_model(args)
+    start_step = 0
+    key = jax.random.PRNGKey(args.seed)
+    params = model.init_params(key)
+    opt_state = model.init_opt_state(params)
+
+    ckpt = TrainCheckpointer(args.ckpt) if args.ckpt else None
+    if ckpt is not None and ckpt.latest_step() is not None:
+        start_step, params, opt_state = ckpt.restore(model)
+        logger.info("resumed from step %d (%s)", start_step, args.ckpt)
+
+    loss = None
+    for step in range(start_step, start_step + args.steps):
+        params, opt_state, loss = run_step(
+            params, opt_state, jax.random.fold_in(key, step))
+        if (ckpt is not None and args.save_every > 0
+                and (step + 1) % args.save_every == 0):
+            ckpt.save(step + 1, params, opt_state)
+        if (step + 1) % max(1, args.steps // 10) == 0:
+            logger.info("step %d loss %.5f", step + 1, float(loss))
+
+    final_step = start_step + args.steps
+    if ckpt is not None:
+        # the periodic save may already hold this exact step (orbax
+        # raises StepAlreadyExistsError on a duplicate save)
+        if ckpt.latest_step() != final_step:
+            ckpt.save(final_step, params, opt_state, wait=True)
+        ckpt.close()
+    print(json.dumps({"step": final_step, "model": args.model,
+                      "loss": float(loss) if loss is not None else None,
+                      "backend": jax.default_backend()}))
+    return 0
+
+
+def run_plan(args) -> int:
+    from ..jaxenv import import_jax
+    jax = import_jax()
+
+    model, _, run_plan_fwd = _build_model(args)
+    if args.ckpt:
+        from ..models.checkpoint import TrainCheckpointer
+        with TrainCheckpointer(args.ckpt) as ckpt:
+            step, params, _unused = ckpt.restore(model)
+        logger.info("planning with step-%d params from %s", step,
+                    args.ckpt)
+    else:
+        params = model.init_params(jax.random.PRNGKey(args.seed))
+
+    weights = run_plan_fwd(params, jax.random.PRNGKey(args.seed + 1))
+    out = {
+        "groups": args.groups,
+        "endpoints": args.endpoints,
+        # int weights in [0, 255], 0 on padded slots -- the values
+        # UpdateEndpointWeight would apply per endpoint
+        "weights": [[int(w) for w in row] for row in weights],
+    }
+    json.dump(out, sys.stdout)
+    print()
+    return 0

@@ -104,11 +104,28 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def resolve_kubeconfig(flag_value: str) -> str:
+    """--kubeconfig flag > KUBECONFIG env > ~/.kube/config if it exists
+    (the viper/env merge of reference cmd/controller/controller.go:84-98)."""
+    if flag_value:
+        return flag_value
+    env = os.environ.get("KUBECONFIG", "")
+    if env:
+        return env
+    default = os.path.expanduser("~/.kube/config")
+    return default if os.path.exists(default) else ""
+
+
 def run_controller(args) -> int:
     stop = setup_signal_handler()
 
+    kubeconfig = resolve_kubeconfig(args.kubeconfig)
+    if kubeconfig:
+        logger.info("using kubeconfig: %s", kubeconfig)
+    else:
+        logger.info("using in-cluster config")
+
     if args.fake:
-        logger.info("using the in-process fake API server")
         api = FakeAPIServer()
         kube = KubeClient(api)
         operator = OperatorClient(api)
@@ -153,10 +170,7 @@ def run_controller(args) -> int:
         _start_smoke_watchdog(args.smoke, cloud_factory, stop)
     if args.seed:
         from ..kube.apply import apply_files
-        # lenient: config kinds that can't be installed on this backend
-        # (webhook configs without a resolver, CRDs on a real cluster)
-        # are logged and skipped, like the pre-config-kind behavior
-        applied = apply_files(kube.api, args.seed, lenient=True)
+        applied = apply_files(kube.api, args.seed)
         logger.info("seeded %d objects from %s", len(applied), args.seed)
 
     health = None

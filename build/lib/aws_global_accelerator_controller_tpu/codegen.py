@@ -1,0 +1,223 @@
+"""Manifest code generation (the controller-gen / update-codegen analogue).
+
+The reference generates its CRD, RBAC role, and webhook configuration from
+kubebuilder markers (hack/update-codegen.sh, config/crd/, config/rbac/
+role.yaml generated from +kubebuilder:rbac markers e.g.
+pkg/controller/globalaccelerator/controller.go:50-52,
+pkg/leaderelection/leaderelection.go:25-27, and the +kubebuilder:webhook
+marker at cmd/webhook/webhook.go:17).  Here the API types and RBAC
+declarations below are the source of truth and this module renders the
+YAML; ``python -m aws_global_accelerator_controller_tpu.codegen`` writes
+config/, and tests/test_codegen.py asserts the committed files match (the
+make-manifests drift check of .github/workflows/manifests.yml).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import yaml
+
+from .apis.endpointgroupbinding import v1alpha1
+
+# RBAC rules, one block per kubebuilder marker in the reference.
+RBAC_RULES = [
+    # leader election (pkg/leaderelection/leaderelection.go:25-27)
+    {"apiGroups": [""], "resources": ["configmaps"],
+     "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"]},
+    {"apiGroups": [""], "resources": ["configmaps/status"],
+     "verbs": ["get", "patch", "update"]},
+    # events (pkg/controller/globalaccelerator/controller.go:52)
+    {"apiGroups": [""], "resources": ["events"], "verbs": ["create", "patch"]},
+    # services watch (globalaccelerator/controller.go:50)
+    {"apiGroups": [""], "resources": ["services"],
+     "verbs": ["get", "list", "watch"]},
+    # leases (leaderelection.go:27)
+    {"apiGroups": ["coordination.k8s.io"], "resources": ["leases"],
+     "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"]},
+    # ingress watch (globalaccelerator/controller.go:51)
+    {"apiGroups": ["networking.k8s.io"], "resources": ["ingresses"],
+     "verbs": ["get", "list", "watch"]},
+    # CRD (pkg/controller/endpointgroupbinding/controller.go:52-53)
+    {"apiGroups": [v1alpha1.GROUP], "resources": [v1alpha1.PLURAL],
+     "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"]},
+    {"apiGroups": [v1alpha1.GROUP], "resources": [f"{v1alpha1.PLURAL}/status"],
+     "verbs": ["get", "patch", "update"]},
+]
+
+
+def endpoint_group_binding_crd() -> Dict[str, Any]:
+    """openAPIV3Schema derived from the v1alpha1 types
+    (mirrors config/crd/operator.h3poteto.dev_endpointgroupbindings.yaml)."""
+    name_ref = {
+        "properties": {"name": {"type": "string"}},
+        "required": ["name"],
+        "type": "object",
+    }
+    spec_schema = {
+        "properties": {
+            "endpointGroupArn": {"type": "string"},
+            "clientIPPreservation": {"default": False, "type": "boolean"},
+            "weight": {"format": "int32", "nullable": True,
+                       "type": "integer"},
+            "serviceRef": name_ref,
+            "ingressRef": name_ref,
+        },
+        "required": ["endpointGroupArn"],
+        "type": "object",
+    }
+    status_schema = {
+        "properties": {
+            "endpointIds": {"items": {"type": "string"}, "type": "array"},
+            "observedGeneration": {"default": 0, "format": "int64",
+                                   "type": "integer"},
+        },
+        "required": ["observedGeneration"],
+        "type": "object",
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{v1alpha1.PLURAL}.{v1alpha1.GROUP}"},
+        "spec": {
+            "group": v1alpha1.GROUP,
+            "names": {
+                "kind": v1alpha1.KIND,
+                "listKind": f"{v1alpha1.KIND}List",
+                "plural": v1alpha1.PLURAL,
+                "singular": "endpointgroupbinding",
+            },
+            "scope": "Namespaced",
+            "versions": [{
+                "name": v1alpha1.VERSION,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "additionalPrinterColumns": [
+                    {"jsonPath": ".spec.endpointGroupArn",
+                     "name": "EndpointGroupArn", "type": "string"},
+                    {"jsonPath": ".status.endpointIds",
+                     "name": "EndpointIds", "type": "string"},
+                    {"jsonPath": ".metadata.creationTimestamp",
+                     "name": "Age", "type": "date"},
+                ],
+                "schema": {"openAPIV3Schema": {
+                    "description": v1alpha1.KIND,
+                    "properties": {
+                        "apiVersion": {"type": "string"},
+                        "kind": {"type": "string"},
+                        "metadata": {"type": "object"},
+                        "spec": spec_schema,
+                        "status": status_schema,
+                    },
+                    "type": "object",
+                }},
+            }],
+        },
+    }
+
+
+def rbac_role() -> Dict[str, Any]:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "global-accelerator-manager-role"},
+        "rules": RBAC_RULES,
+    }
+
+
+def rbac_bindings() -> Dict[str, Any]:
+    """ServiceAccount + ClusterRoleBinding for the controller Deployment
+    (config/rbac/controller-deployment.yaml runs as this identity; without
+    the binding every informer watch and Lease write would be 403)."""
+    return {
+        "items": [
+            {
+                "apiVersion": "v1",
+                "kind": "ServiceAccount",
+                "metadata": {"name": "gacc-controller",
+                             "namespace": "system"},
+            },
+            {
+                "apiVersion": "rbac.authorization.k8s.io/v1",
+                "kind": "ClusterRoleBinding",
+                "metadata": {"name": "global-accelerator-manager-rolebinding"},
+                "roleRef": {
+                    "apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole",
+                    "name": "global-accelerator-manager-role",
+                },
+                "subjects": [{
+                    "kind": "ServiceAccount",
+                    "name": "gacc-controller",
+                    "namespace": "system",
+                }],
+            },
+        ],
+        "apiVersion": "v1",
+        "kind": "List",
+    }
+
+
+def webhook_configuration() -> Dict[str, Any]:
+    """(mirrors config/webhook/manifests.yaml; marker at
+    cmd/webhook/webhook.go:17).  The cert-manager annotation makes
+    cert-manager inject the serving cert's CA bundle so the apiserver can
+    verify the webhook's TLS (pairs with config/webhook/deployment.yaml's
+    Certificate, namespace/name = system/webhook-serving-cert)."""
+    return {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": {
+            "name": "validating-webhook-configuration",
+            "annotations": {
+                "cert-manager.io/inject-ca-from":
+                    "system/webhook-serving-cert",
+            },
+        },
+        "webhooks": [{
+            "admissionReviewVersions": ["v1"],
+            "clientConfig": {"service": {
+                "name": "webhook-service",
+                "namespace": "system",
+                "path": "/validate-endpointgroupbinding",
+            }},
+            "failurePolicy": "Fail",
+            "name": "validate-endpointgroupbinding.h3poteto.dev",
+            "rules": [{
+                "apiGroups": [v1alpha1.GROUP],
+                "apiVersions": [v1alpha1.VERSION],
+                "operations": ["CREATE", "UPDATE"],
+                "resources": [v1alpha1.PLURAL],
+            }],
+            "sideEffects": "None",
+        }],
+    }
+
+
+MANIFESTS = {
+    "crd/operator.h3poteto.dev_endpointgroupbindings.yaml":
+        endpoint_group_binding_crd,
+    "rbac/role.yaml": rbac_role,
+    "rbac/role_binding.yaml": rbac_bindings,
+    "webhook/manifests.yaml": webhook_configuration,
+}
+
+
+def render(manifest: Dict[str, Any]) -> str:
+    return "---\n" + yaml.safe_dump(manifest, sort_keys=True,
+                                    default_flow_style=False)
+
+
+def write_all(config_dir: str) -> None:
+    for rel, fn in MANIFESTS.items():
+        path = os.path.join(config_dir, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(render(fn()))
+
+
+if __name__ == "__main__":
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    write_all(os.path.join(root, "config"))
+    print("wrote config/ manifests")

@@ -1,0 +1,2 @@
+"""The three controllers (SURVEY.md §2): GlobalAccelerator, Route53,
+EndpointGroupBinding."""

@@ -1,0 +1,84 @@
+"""Shared controller plumbing: filters and the worker-thread harness.
+
+The reference duplicates the Service/Ingress filter predicates and the
+worker spawn loop across its controllers
+(pkg/controller/globalaccelerator/controller.go:195-225 vs
+pkg/controller/route53/controller.go:188-218); here they are shared.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List
+
+from ..apis import AWS_LOAD_BALANCER_TYPE_ANNOTATION, INGRESS_CLASS_ANNOTATION
+from ..kube.objects import Ingress, KubeObject, Service
+from ..kube.workqueue import RateLimitingQueue
+from ..reconcile import process_next_work_item
+
+logger = logging.getLogger(__name__)
+
+WORKER_POLL = 0.2  # get() timeout so workers observe the stop event
+
+
+def was_load_balancer_service(svc: Service) -> bool:
+    """type: LoadBalancer AND (aws-load-balancer-type annotation OR
+    loadBalancerClass set) (reference globalaccelerator/service.go:18-26)."""
+    if svc.spec.type != "LoadBalancer":
+        return False
+    return (AWS_LOAD_BALANCER_TYPE_ANNOTATION in svc.annotations
+            or svc.spec.load_balancer_class is not None)
+
+
+def was_alb_ingress(ingress: Ingress) -> bool:
+    """ingressClassName == 'alb' OR legacy ingress.class annotation present
+    (reference globalaccelerator/ingress.go:19-27)."""
+    if ingress.spec.ingress_class_name == "alb":
+        return True
+    return INGRESS_CLASS_ANNOTATION in ingress.annotations
+
+
+def annotation_presence_changed(old: KubeObject, new: KubeObject,
+                                annotation: str) -> bool:
+    """(reference globalaccelerator/controller.go:250-259)"""
+    return (annotation in old.annotations) != (annotation in new.annotations)
+
+
+def spawn_workers(name: str, count: int, stop: threading.Event,
+                  queue: RateLimitingQueue, key_to_obj, process_delete,
+                  process_create_or_update) -> List[threading.Thread]:
+    """Start ``count`` reconcile worker threads over one queue
+    (the wait.Until(runWorker, 1s) analogue,
+    reference globalaccelerator/controller.go:208-213)."""
+
+    def loop():
+        while not stop.is_set():
+            if not process_next_work_item(
+                    queue, key_to_obj, process_delete,
+                    process_create_or_update, get_timeout=WORKER_POLL):
+                return
+
+    threads = []
+    for i in range(count):
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"{name}-worker-{i}")
+        t.start()
+        threads.append(t)
+    return threads
+
+
+def run_controller(name: str, stop: threading.Event,
+                   queues: List[RateLimitingQueue],
+                   worker_sets: Callable[[], List[threading.Thread]]) -> None:
+    """Common Run() tail: spawn workers, block on stop, shut queues down."""
+    from .. import metrics
+    for q in queues:
+        metrics.watch_queue_depth(q)
+    threads = worker_sets()
+    logger.info("started %s workers", name)
+    stop.wait()
+    logger.info("shutting down %s workers", name)
+    for q in queues:
+        q.shutdown()
+    for t in threads:
+        t.join(timeout=2.0)

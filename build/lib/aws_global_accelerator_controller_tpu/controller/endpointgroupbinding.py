@@ -1,0 +1,288 @@
+"""EndpointGroupBinding controller: CRD + finalizer lifecycle.
+
+Reconciles the EndpointGroupBinding CRD (reference
+pkg/controller/endpointgroupbinding/): resolves serviceRef/ingressRef to
+LB hostnames -> ELB ARNs, diffs against status.endpointIds, adds/removes
+endpoints in the bound Global Accelerator endpoint group, syncs weights,
+and maintains status + observedGeneration.
+
+Finalizer state machine (reconcile.go:18-34):
+- no finalizer          -> add it (reconcileCreate)
+- DeletionTimestamp set -> remove LBs from the endpoint group, then clear
+                           the finalizer (reconcileDelete)
+- otherwise             -> diff & sync (reconcileUpdate)
+
+Deliberate fix over the reference: its delete loop mutates endpointIds with
+index-shifting appends inside a forward loop
+(reconcile.go:71-85 -- flagged in SURVEY.md §7 as a latent bug, skipping
+every other element); we rebuild the remaining-ids list instead.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+from ..apis.endpointgroupbinding.v1alpha1 import EndpointGroupBinding
+from ..cloudprovider.aws import get_lb_name_from_hostname, get_region_from_arn
+from ..cloudprovider.aws.factory import CloudFactory
+from ..errors import AWSAPIError, ERR_ENDPOINT_GROUP_NOT_FOUND, NotFoundError
+from ..kube.client import KubeClient, OperatorClient
+from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
+from ..kube.objects import split_meta_namespace_key
+from ..kube.workqueue import (
+    new_rate_limiting_queue,
+)
+from ..reconcile import Result
+from .base import WORKER_POLL
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_AGENT_NAME = "endpoint-group-binding-controller"
+
+# Finalizer name (reference endpointgroupbinding/reconcile.go:18).
+FINALIZER = "operator.h3poteto.dev/endpointgroupbindings"
+
+DELETE_REQUEUE = 1.0  # reconcile.go:96
+
+
+@dataclass
+class EndpointGroupBindingConfig:
+    workers: int = 1
+    queue_qps: float = 10.0    # client-go default bucket
+    queue_burst: int = 100
+
+
+class EndpointGroupBindingController:
+    def __init__(self, kube_client: KubeClient,
+                 operator_client: OperatorClient,
+                 informer_factory: SharedInformerFactory,
+                 cloud_factory: CloudFactory,
+                 config: EndpointGroupBindingConfig):
+        self.workers = config.workers
+        self.kube_client = kube_client
+        self.client = operator_client
+        self.cloud_factory = cloud_factory
+        self.recorder = kube_client.event_recorder(CONTROLLER_AGENT_NAME)
+
+        self.queue = new_rate_limiting_queue(
+            name="EndpointGroupBinding",
+            qps=config.queue_qps, burst=config.queue_burst)
+
+        self.service_informer = informer_factory.services()
+        self.ingress_informer = informer_factory.ingresses()
+        self.binding_informer = informer_factory.endpoint_group_bindings()
+        self.binding_informer.add_event_handler(
+            add=self._enqueue, update=self._update_notification,
+            delete=None)
+
+    # -- event handlers (controller.go:85-98) ---------------------------
+
+    def _enqueue(self, obj) -> None:
+        self.queue.add_rate_limited(obj.key())
+
+    def _update_notification(self, old, new) -> None:
+        # ARN changes are blocked by the webhook; backstop here
+        # (controller.go:86-93).
+        if old.spec.endpoint_group_arn != new.spec.endpoint_group_arn:
+            logger.error("do not allow changing EndpointGroupArn field")
+            return
+        self._enqueue(new)
+
+    # -- run (controller.go:101-180) ------------------------------------
+
+    def run(self, stop: threading.Event) -> None:
+        logger.info("starting EndpointGroupBinding controller")
+        if not wait_for_cache_sync(stop, self.binding_informer,
+                                   self.service_informer,
+                                   self.ingress_informer):
+            raise RuntimeError("failed to wait for caches to sync")
+
+        from .. import metrics
+        metrics.watch_queue_depth(self.queue)
+        threads = []
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker_loop, args=(stop,),
+                                 daemon=True,
+                                 name=f"{CONTROLLER_AGENT_NAME}-{i}")
+            t.start()
+            threads.append(t)
+        logger.info("started %s workers", CONTROLLER_AGENT_NAME)
+        stop.wait()
+        self.queue.shutdown()
+        for t in threads:
+            t.join(timeout=2.0)
+
+    def _worker_loop(self, stop: threading.Event) -> None:
+        import time as time_mod
+
+        from .. import metrics
+        while not stop.is_set():
+            key, shutdown = self.queue.get(timeout=WORKER_POLL)
+            if shutdown:
+                return
+            if key is None:
+                continue
+            start = time_mod.monotonic()
+            result = "success"
+            try:
+                self._sync_handler(key)
+            except Exception:
+                result = "error"
+                logger.exception("error syncing %r", key)
+                self.queue.add_rate_limited(key)
+            finally:
+                self.queue.done(key)
+                metrics.record_sync(self.queue.name, result,
+                                    time_mod.monotonic() - start)
+
+    def _sync_handler(self, key: str) -> None:
+        """(controller.go:148-180)"""
+        ns, name = split_meta_namespace_key(key)
+        try:
+            binding = self.binding_informer.lister.get(ns, name)
+        except NotFoundError:
+            logger.info("EndpointGroupBinding %s has been deleted", key)
+            self.queue.forget(key)
+            return
+
+        res = self.reconcile(binding.deep_copy())
+        if res.requeue_after > 0:
+            self.queue.forget(key)
+            self.queue.add_after(key, res.requeue_after)
+        elif res.requeue:
+            self.queue.add_rate_limited(key)
+        else:
+            self.queue.forget(key)
+
+    # -- reconcile (reconcile.go:20-34) ---------------------------------
+
+    def reconcile(self, obj: EndpointGroupBinding) -> Result:
+        provider = self.cloud_factory.global_provider()
+        if obj.metadata.deletion_timestamp is not None:
+            return self._reconcile_delete(obj, provider)
+        if not obj.metadata.finalizers:
+            return self._reconcile_create(obj)
+        return self._reconcile_update(obj, provider)
+
+    def _reconcile_create(self, obj: EndpointGroupBinding) -> Result:
+        """Just claim the object with the finalizer (reconcile.go:99-110)."""
+        copied = obj.deep_copy()
+        copied.metadata.finalizers = [FINALIZER]
+        self.client.endpoint_group_bindings.update(copied)
+        return Result()
+
+    def _reconcile_delete(self, obj: EndpointGroupBinding,
+                          provider) -> Result:
+        """Drain endpoints then clear the finalizer (reconcile.go:36-97)."""
+        if not obj.status.endpoint_ids:
+            self._clear_finalizer(obj)
+            return Result()
+
+        try:
+            endpoint_group = provider.describe_endpoint_group(
+                obj.spec.endpoint_group_arn)
+        except AWSAPIError as e:
+            if e.code == ERR_ENDPOINT_GROUP_NOT_FOUND:
+                # the endpoint group is gone; nothing to drain
+                logger.info("EndpointGroup %s not found: %s",
+                            obj.spec.endpoint_group_arn, e.code)
+                self._clear_finalizer(obj)
+                return Result()
+            raise
+
+        remaining = list(obj.status.endpoint_ids)
+        for endpoint_id in obj.status.endpoint_ids:
+            region = get_region_from_arn(endpoint_id)
+            regional = self.cloud_factory.provider_for(region)
+            regional.remove_lb_from_endpoint_group(endpoint_group,
+                                                   endpoint_id)
+            remaining.remove(endpoint_id)
+
+        copied = obj.deep_copy()
+        copied.status.endpoint_ids = remaining
+        copied.status.observed_generation = obj.metadata.generation
+        self.client.endpoint_group_bindings.update_status(copied)
+        # requeue: next pass observes the drained status and clears the
+        # finalizer (reconcile.go:96)
+        return Result(requeue=True, requeue_after=DELETE_REQUEUE)
+
+    def _clear_finalizer(self, obj: EndpointGroupBinding) -> None:
+        copied = obj.deep_copy()
+        copied.metadata.finalizers = []
+        self.client.endpoint_group_bindings.update(copied)
+
+    def _reconcile_update(self, obj: EndpointGroupBinding,
+                          provider) -> Result:
+        """Diff desired LB ARNs vs status.endpointIds and converge
+        (reconcile.go:112-217)."""
+        hostnames = self._get_load_balancer_hostnames(obj)
+
+        arns = {}  # lb arn -> lb name
+        regional = None
+        for hostname in hostnames:
+            name, region = get_lb_name_from_hostname(hostname)
+            regional = self.cloud_factory.provider_for(region)
+            lb = regional.get_load_balancer(name)
+            arns[lb.load_balancer_arn] = name
+        logger.debug("desired LoadBalancer ARNs: %s", list(arns))
+
+        new_ids = [arn for arn in arns if arn not in obj.status.endpoint_ids]
+        removed_ids = [i for i in obj.status.endpoint_ids if i not in arns]
+        if (not new_ids and not removed_ids
+                and obj.status.observed_generation == obj.metadata.generation):
+            return Result()
+
+        endpoint_group = provider.describe_endpoint_group(
+            obj.spec.endpoint_group_arn)
+
+        results = list(obj.status.endpoint_ids)
+        for endpoint_id in removed_ids:
+            regional_for_id = self.cloud_factory.provider_for(
+                get_region_from_arn(endpoint_id))
+            regional_for_id.remove_lb_from_endpoint_group(endpoint_group,
+                                                          endpoint_id)
+            results = [r for r in results if r != endpoint_id]
+
+        for endpoint_id in new_ids:
+            endpoint, retry = regional.add_lb_to_endpoint_group(
+                endpoint_group, arns[endpoint_id],
+                obj.spec.client_ip_preservation, obj.spec.weight)
+            if retry > 0:
+                return Result(requeue=True, requeue_after=retry)
+            if endpoint is not None:
+                results.append(endpoint)
+
+        for endpoint_id in arns:
+            provider.update_endpoint_weight(endpoint_group, endpoint_id,
+                                            obj.spec.weight)
+
+        copied = obj.deep_copy()
+        copied.status.endpoint_ids = results
+        copied.status.observed_generation = obj.metadata.generation
+        self.client.endpoint_group_bindings.update_status(copied)
+        return Result()
+
+    def _get_load_balancer_hostnames(self, obj: EndpointGroupBinding):
+        """serviceRef|ingressRef -> LB hostnames (reconcile.go:219-252)."""
+        if obj.spec.service_ref is not None:
+            svc = self.service_informer.lister.get(
+                obj.metadata.namespace, obj.spec.service_ref.name)
+            ingress_list = svc.status.load_balancer.ingress
+            if not ingress_list:
+                logger.warning("%s does not have ingress LoadBalancer, skip",
+                               svc.key())
+                return []
+            return [i.hostname for i in ingress_list]
+        if obj.spec.ingress_ref is not None:
+            ingress = self.ingress_informer.lister.get(
+                obj.metadata.namespace, obj.spec.ingress_ref.name)
+            ingress_list = ingress.status.load_balancer.ingress
+            if not ingress_list:
+                logger.warning("%s does not have ingress LoadBalancer, skip",
+                               ingress.key())
+                return []
+            return [i.hostname for i in ingress_list]
+        logger.error("EndpointGroupBinding %s has neither serviceRef nor "
+                     "ingressRef", obj.metadata.name)
+        return []

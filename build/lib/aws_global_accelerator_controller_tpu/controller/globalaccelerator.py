@@ -1,0 +1,266 @@
+"""GlobalAccelerator controller.
+
+Watches Services and Ingresses carrying the global-accelerator-managed
+annotation and reconciles them into accelerator->listener->endpoint-group
+chains (reference pkg/controller/globalaccelerator/: controller.go,
+service.go, ingress.go).
+
+Watch/filter rules:
+- Service: type LoadBalancer + (aws-load-balancer-type annotation OR
+  loadBalancerClass) (service.go:18-26); enqueued on add when managed,
+  on update when managed or the managed annotation flipped, on delete
+  always (controller.go:96-135).
+- Ingress: ALB class (ingress.go:19-27); same enqueue rules.
+
+Two independent rate-limited queues (service/ingress, controller.go:64-65).
+Deletion discovers owned accelerators via tags and tears them down;
+annotation removal does the same and emits an Event (service.go:64-84).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+from .. import cloudprovider
+from ..apis import AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+from ..cloudprovider.aws import get_lb_name_from_hostname
+from ..cloudprovider.aws.factory import CloudFactory
+from ..errors import new_no_retry_errorf
+from ..kube.client import KubeClient
+from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
+from ..kube.objects import Ingress, Service, split_meta_namespace_key
+from ..kube.workqueue import (
+    new_rate_limiting_queue,
+)
+from ..reconcile import Result
+from .base import (
+    annotation_presence_changed,
+    run_controller,
+    spawn_workers,
+    was_alb_ingress,
+    was_load_balancer_service,
+)
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_AGENT_NAME = "global-accelerator-controller"
+
+
+@dataclass
+class GlobalAcceleratorConfig:
+    workers: int = 1
+    cluster_name: str = "default"
+    queue_qps: float = 10.0    # client-go default bucket
+    queue_burst: int = 100
+
+
+class GlobalAcceleratorController:
+    def __init__(self, kube_client: KubeClient,
+                 informer_factory: SharedInformerFactory,
+                 cloud_factory: CloudFactory,
+                 config: GlobalAcceleratorConfig):
+        self.cluster_name = config.cluster_name
+        self.workers = config.workers
+        self.kube_client = kube_client
+        self.cloud_factory = cloud_factory
+        self.recorder = kube_client.event_recorder(CONTROLLER_AGENT_NAME)
+
+        self.service_queue = new_rate_limiting_queue(
+            name=f"{CONTROLLER_AGENT_NAME}-service",
+            qps=config.queue_qps, burst=config.queue_burst)
+        self.ingress_queue = new_rate_limiting_queue(
+            name=f"{CONTROLLER_AGENT_NAME}-ingress",
+            qps=config.queue_qps, burst=config.queue_burst)
+
+        self.service_informer = informer_factory.services()
+        self.service_informer.add_event_handler(
+            add=self._add_service, update=self._update_service,
+            delete=self._delete_service)
+        self.ingress_informer = informer_factory.ingresses()
+        self.ingress_informer.add_event_handler(
+            add=self._add_ingress, update=self._update_ingress,
+            delete=self._delete_ingress)
+
+    # -- event handlers (controller.go:96-193) -------------------------
+
+    def _add_service(self, svc: Service) -> None:
+        if was_load_balancer_service(svc) and self._has_managed(svc):
+            self.service_queue.add_rate_limited(svc.key())
+
+    def _update_service(self, old: Service, new: Service) -> None:
+        if old == new:
+            return
+        if was_load_balancer_service(new):
+            if self._has_managed(new) or annotation_presence_changed(
+                    old, new, AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION):
+                self.service_queue.add_rate_limited(new.key())
+
+    def _delete_service(self, svc: Service) -> None:
+        if was_load_balancer_service(svc):
+            self.service_queue.add_rate_limited(svc.key())
+
+    def _add_ingress(self, ingress: Ingress) -> None:
+        if was_alb_ingress(ingress) and self._has_managed(ingress):
+            self.ingress_queue.add_rate_limited(ingress.key())
+
+    def _update_ingress(self, old: Ingress, new: Ingress) -> None:
+        if old == new:
+            return
+        if was_alb_ingress(new):
+            if self._has_managed(new) or annotation_presence_changed(
+                    old, new, AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION):
+                self.ingress_queue.add_rate_limited(new.key())
+
+    def _delete_ingress(self, ingress: Ingress) -> None:
+        # reference enqueues ingress deletes unconditionally (controller.go:185)
+        self.ingress_queue.add_rate_limited(ingress.key())
+
+    @staticmethod
+    def _has_managed(obj) -> bool:
+        return AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION in obj.annotations
+
+    # -- run ------------------------------------------------------------
+
+    def run(self, stop: threading.Event) -> None:
+        logger.info("starting GlobalAccelerator controller")
+        if not wait_for_cache_sync(stop, self.service_informer,
+                                   self.ingress_informer):
+            raise RuntimeError("failed to wait for caches to sync")
+
+        def workers():
+            return (spawn_workers(
+                        f"{CONTROLLER_AGENT_NAME}-service", self.workers,
+                        stop, self.service_queue, self._key_to_service,
+                        self.process_service_delete,
+                        self.process_service_create_or_update)
+                    + spawn_workers(
+                        f"{CONTROLLER_AGENT_NAME}-ingress", self.workers,
+                        stop, self.ingress_queue, self._key_to_ingress,
+                        self.process_ingress_delete,
+                        self.process_ingress_create_or_update))
+
+        run_controller(CONTROLLER_AGENT_NAME, stop,
+                       [self.service_queue, self.ingress_queue], workers)
+
+    def _key_to_service(self, key: str):
+        ns, name = split_meta_namespace_key(key)
+        return self.service_informer.lister.get(ns, name)
+
+    def _key_to_ingress(self, key: str):
+        ns, name = split_meta_namespace_key(key)
+        return self.ingress_informer.lister.get(ns, name)
+
+    # -- process funcs: Service (service.go:28-126) ---------------------
+
+    def process_service_delete(self, key: str) -> Result:
+        logger.info("%s has been deleted", key)
+        try:
+            ns, name = split_meta_namespace_key(key)
+        except ValueError as e:
+            raise new_no_retry_errorf("invalid resource key: %s", key) from e
+        self._cleanup_accelerators("service", ns, name)
+        return Result()
+
+    def process_service_create_or_update(self, obj) -> Result:
+        if not isinstance(obj, Service):
+            raise new_no_retry_errorf("object is not Service, it is %s",
+                                      type(obj).__name__)
+        svc = obj
+        if not svc.status.load_balancer.ingress:
+            logger.warning("%s does not have ingress LoadBalancer, skip",
+                           svc.key())
+            return Result()
+
+        if not self._has_managed(svc):
+            self._cleanup_accelerators("service", svc.metadata.namespace,
+                                       svc.metadata.name)
+            logger.info("deleted Global Accelerator for Service %s",
+                        svc.key())
+            self.recorder.event(svc, "Normal", "GlobalAcceleratorDeleted",
+                                "Global Accelerators are deleted")
+            return Result()
+
+        for lb_ingress in svc.status.load_balancer.ingress:
+            result = self._ensure_for_lb_ingress(
+                svc, lb_ingress,
+                lambda provider, name, region: (
+                    provider.ensure_global_accelerator_for_service(
+                        svc, lb_ingress, self.cluster_name, name, region)))
+            if result is not None:
+                return result
+        return Result()
+
+    # -- process funcs: Ingress (ingress.go:29-135) ---------------------
+
+    def process_ingress_delete(self, key: str) -> Result:
+        logger.info("%s has been deleted", key)
+        try:
+            ns, name = split_meta_namespace_key(key)
+        except ValueError as e:
+            raise new_no_retry_errorf("invalid resource key: %s", key) from e
+        self._cleanup_accelerators("ingress", ns, name)
+        return Result()
+
+    def process_ingress_create_or_update(self, obj) -> Result:
+        if not isinstance(obj, Ingress):
+            raise new_no_retry_errorf("object is not Ingress, it is %s",
+                                      type(obj).__name__)
+        ingress = obj
+        if not ingress.status.load_balancer.ingress:
+            logger.warning("%s does not have ingress LoadBalancer, skip",
+                           ingress.key())
+            return Result()
+
+        if not self._has_managed(ingress):
+            self._cleanup_accelerators("ingress", ingress.metadata.namespace,
+                                       ingress.metadata.name)
+            logger.info("deleted Global Accelerator for Ingress %s",
+                        ingress.key())
+            self.recorder.event(ingress, "Normal", "GlobalAcceleratorDeleted",
+                                "Global Accelerators are deleted")
+            return Result()
+
+        for lb_ingress in ingress.status.load_balancer.ingress:
+            result = self._ensure_for_lb_ingress(
+                ingress, lb_ingress,
+                lambda provider, name, region: (
+                    provider.ensure_global_accelerator_for_ingress(
+                        ingress, lb_ingress, self.cluster_name, name,
+                        region)))
+            if result is not None:
+                return result
+        return Result()
+
+    # -- shared helpers -------------------------------------------------
+
+    def _cleanup_accelerators(self, resource: str, ns: str,
+                              name: str) -> None:
+        provider = self.cloud_factory.global_provider()
+        accelerators = provider.list_global_accelerator_by_resource(
+            self.cluster_name, resource, ns, name)
+        for accelerator in accelerators:
+            provider.cleanup_global_accelerator(accelerator.accelerator_arn)
+
+    def _ensure_for_lb_ingress(self, obj, lb_ingress, ensure):
+        """Provider dispatch per LB ingress entry; returns a Result to
+        short-circuit (retry), or None to continue."""
+        try:
+            provider_name = cloudprovider.detect_cloud_provider(
+                lb_ingress.hostname)
+        except ValueError as e:
+            logger.error("%s", e)
+            return None
+        if provider_name != cloudprovider.PROVIDER_AWS:
+            logger.warning("not implemented for %s", provider_name)
+            return None
+        name, region = get_lb_name_from_hostname(lb_ingress.hostname)
+        provider = self.cloud_factory.provider_for(region)
+        arn, created, retry_after = ensure(provider, name, region)
+        if retry_after > 0:
+            return Result(requeue=True, requeue_after=retry_after)
+        if created:
+            self.recorder.eventf(
+                obj, "Normal", "GlobalAcceleratorCreated",
+                "Global Accelerator is created: %s", arn)
+        return None

@@ -1,0 +1,237 @@
+"""Route53 controller.
+
+Same watch/filter skeleton as the GlobalAccelerator controller but keyed on
+the route53-hostname annotation (reference pkg/controller/route53/).  The
+annotation value splits on ',' for multiple hostnames (service.go:71).
+Cross-controller coupling is implicit through AWS state: this controller
+discovers the accelerator the GA controller created via its
+target-hostname tag and retries on a 1m timer until it appears
+(SURVEY.md §3.3).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+from .. import cloudprovider
+from ..apis import ROUTE53_HOSTNAME_ANNOTATION
+from ..cloudprovider.aws import get_lb_name_from_hostname
+from ..cloudprovider.aws.factory import CloudFactory
+from ..errors import new_no_retry_errorf
+from ..kube.client import KubeClient
+from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
+from ..kube.objects import Ingress, Service, split_meta_namespace_key
+from ..kube.workqueue import (
+    new_rate_limiting_queue,
+)
+from ..reconcile import Result
+from .base import (
+    annotation_presence_changed,
+    run_controller,
+    spawn_workers,
+    was_load_balancer_service,
+)
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_AGENT_NAME = "route53-controller"
+
+
+@dataclass
+class Route53Config:
+    workers: int = 1
+    cluster_name: str = "default"
+    queue_qps: float = 10.0    # client-go default bucket
+    queue_burst: int = 100
+
+
+class Route53Controller:
+    def __init__(self, kube_client: KubeClient,
+                 informer_factory: SharedInformerFactory,
+                 cloud_factory: CloudFactory,
+                 config: Route53Config):
+        self.cluster_name = config.cluster_name
+        self.workers = config.workers
+        self.kube_client = kube_client
+        self.cloud_factory = cloud_factory
+        self.recorder = kube_client.event_recorder(CONTROLLER_AGENT_NAME)
+
+        self.service_queue = new_rate_limiting_queue(
+            name=f"{CONTROLLER_AGENT_NAME}-service",
+            qps=config.queue_qps, burst=config.queue_burst)
+        self.ingress_queue = new_rate_limiting_queue(
+            name=f"{CONTROLLER_AGENT_NAME}-ingress",
+            qps=config.queue_qps, burst=config.queue_burst)
+
+        self.service_informer = informer_factory.services()
+        self.service_informer.add_event_handler(
+            add=self._add_service, update=self._update_service,
+            delete=self._delete_service)
+        self.ingress_informer = informer_factory.ingresses()
+        self.ingress_informer.add_event_handler(
+            add=self._add_ingress, update=self._update_ingress,
+            delete=self._delete_ingress)
+
+    # -- event handlers (route53/controller.go:90-172) ------------------
+
+    @staticmethod
+    def _has_hostname(obj) -> bool:
+        return ROUTE53_HOSTNAME_ANNOTATION in obj.annotations
+
+    def _add_service(self, svc: Service) -> None:
+        if was_load_balancer_service(svc) and self._has_hostname(svc):
+            self.service_queue.add_rate_limited(svc.key())
+
+    def _update_service(self, old: Service, new: Service) -> None:
+        if old == new:
+            return
+        if was_load_balancer_service(new):
+            if self._has_hostname(new) or annotation_presence_changed(
+                    old, new, ROUTE53_HOSTNAME_ANNOTATION):
+                self.service_queue.add_rate_limited(new.key())
+
+    def _delete_service(self, svc: Service) -> None:
+        if was_load_balancer_service(svc):
+            self.service_queue.add_rate_limited(svc.key())
+
+    def _add_ingress(self, ingress: Ingress) -> None:
+        # the route53 controller watches ALL ingresses with the annotation
+        # (route53/controller.go:133-137; no ALB filter on add)
+        if self._has_hostname(ingress):
+            self.ingress_queue.add_rate_limited(ingress.key())
+
+    def _update_ingress(self, old: Ingress, new: Ingress) -> None:
+        if old == new:
+            return
+        if self._has_hostname(new) or annotation_presence_changed(
+                old, new, ROUTE53_HOSTNAME_ANNOTATION):
+            self.ingress_queue.add_rate_limited(new.key())
+
+    def _delete_ingress(self, ingress: Ingress) -> None:
+        self.ingress_queue.add_rate_limited(ingress.key())
+
+    # -- run ------------------------------------------------------------
+
+    def run(self, stop: threading.Event) -> None:
+        logger.info("starting Route53 controller")
+        if not wait_for_cache_sync(stop, self.service_informer,
+                                   self.ingress_informer):
+            raise RuntimeError("failed to wait for caches to sync")
+
+        def workers():
+            return (spawn_workers(
+                        f"{CONTROLLER_AGENT_NAME}-service", self.workers,
+                        stop, self.service_queue, self._key_to_service,
+                        self.process_service_delete,
+                        self.process_service_create_or_update)
+                    + spawn_workers(
+                        f"{CONTROLLER_AGENT_NAME}-ingress", self.workers,
+                        stop, self.ingress_queue, self._key_to_ingress,
+                        self.process_ingress_delete,
+                        self.process_ingress_create_or_update))
+
+        run_controller(CONTROLLER_AGENT_NAME, stop,
+                       [self.service_queue, self.ingress_queue], workers)
+
+    def _key_to_service(self, key: str):
+        ns, name = split_meta_namespace_key(key)
+        return self.service_informer.lister.get(ns, name)
+
+    def _key_to_ingress(self, key: str):
+        ns, name = split_meta_namespace_key(key)
+        return self.ingress_informer.lister.get(ns, name)
+
+    # -- process funcs (route53/service.go, route53/ingress.go) ---------
+
+    def process_service_delete(self, key: str) -> Result:
+        logger.info("%s has been deleted", key)
+        try:
+            ns, name = split_meta_namespace_key(key)
+        except ValueError as e:
+            raise new_no_retry_errorf("invalid resource key: %s", key) from e
+        self.cloud_factory.global_provider().cleanup_record_set(
+            self.cluster_name, "service", ns, name)
+        return Result()
+
+    def process_service_create_or_update(self, obj) -> Result:
+        if not isinstance(obj, Service):
+            raise new_no_retry_errorf("object is not Service, it is %s",
+                                      type(obj).__name__)
+        svc = obj
+        hostname = svc.annotations.get(ROUTE53_HOSTNAME_ANNOTATION)
+        if hostname is None:
+            self.cloud_factory.global_provider().cleanup_record_set(
+                self.cluster_name, "service", svc.metadata.namespace,
+                svc.metadata.name)
+            logger.info("deleted route53 records for Service %s", svc.key())
+            self.recorder.event(svc, "Normal", "Route53RecordDeleted",
+                                "Route53 record sets are deleted")
+            return Result()
+
+        hostnames = hostname.split(",")
+        for lb_ingress in svc.status.load_balancer.ingress:
+            result = self._ensure_for_lb_ingress(
+                svc, lb_ingress, hostnames,
+                lambda provider: provider.ensure_route53_for_service(
+                    svc, lb_ingress, hostnames, self.cluster_name))
+            if result is not None:
+                return result
+        return Result()
+
+    def process_ingress_delete(self, key: str) -> Result:
+        logger.info("%s has been deleted", key)
+        try:
+            ns, name = split_meta_namespace_key(key)
+        except ValueError as e:
+            raise new_no_retry_errorf("invalid resource key: %s", key) from e
+        self.cloud_factory.global_provider().cleanup_record_set(
+            self.cluster_name, "ingress", ns, name)
+        return Result()
+
+    def process_ingress_create_or_update(self, obj) -> Result:
+        if not isinstance(obj, Ingress):
+            raise new_no_retry_errorf("object is not Ingress, it is %s",
+                                      type(obj).__name__)
+        ingress = obj
+        hostname = ingress.annotations.get(ROUTE53_HOSTNAME_ANNOTATION)
+        if hostname is None:
+            self.cloud_factory.global_provider().cleanup_record_set(
+                self.cluster_name, "ingress", ingress.metadata.namespace,
+                ingress.metadata.name)
+            logger.info("deleted route53 records for Ingress %s",
+                        ingress.key())
+            self.recorder.event(ingress, "Normal", "Route53RecordDeleted",
+                                "Route53 record sets are deleted")
+            return Result()
+
+        hostnames = hostname.split(",")
+        for lb_ingress in ingress.status.load_balancer.ingress:
+            result = self._ensure_for_lb_ingress(
+                ingress, lb_ingress, hostnames,
+                lambda provider: provider.ensure_route53_for_ingress(
+                    ingress, lb_ingress, hostnames, self.cluster_name))
+            if result is not None:
+                return result
+        return Result()
+
+    def _ensure_for_lb_ingress(self, obj, lb_ingress, hostnames, ensure):
+        try:
+            provider_name = cloudprovider.detect_cloud_provider(
+                lb_ingress.hostname)
+        except ValueError as e:
+            logger.error("%s", e)
+            return None
+        if provider_name != cloudprovider.PROVIDER_AWS:
+            logger.warning("not implemented for %s", provider_name)
+            return None
+        _, region = get_lb_name_from_hostname(lb_ingress.hostname)
+        provider = self.cloud_factory.provider_for(region)
+        created, retry_after = ensure(provider)
+        if retry_after > 0:
+            return Result(requeue=True, requeue_after=retry_after)
+        if created:
+            self.recorder.eventf(
+                obj, "Normal", "Route53RecordCreated",
+                "Route53 record set is created: %s", hostnames)
+        return None

@@ -1,0 +1,90 @@
+"""Typed errors for the reconcile engine.
+
+Mirrors reference pkg/errors/errors.go:8-39 (NoRetryError + IsNoRetry with
+wrap support via errors.As) and the apimachinery NotFound predicate the
+reconcile loop dispatches on (pkg/reconcile/reconcile.go:59-66).
+"""
+from __future__ import annotations
+
+
+class NoRetryError(Exception):
+    """Error that must NOT be requeued by the reconcile loop.
+
+    Reference pkg/errors/errors.go:8-27; consumed at
+    pkg/reconcile/reconcile.go:71-73.
+    """
+
+
+def new_no_retry_errorf(fmt: str, *args) -> NoRetryError:
+    return NoRetryError(fmt % args if args else fmt)
+
+
+def is_no_retry(err: BaseException) -> bool:
+    """True if ``err`` is, or explicitly wraps (via ``raise ... from``), a
+    NoRetryError -- the errors.As-over-wrapped-errors analogue
+    (pkg/errors/errors.go:33-39).
+
+    Only the explicit ``__cause__`` chain is followed: Go's errors.As only
+    walks Unwrap(), and Python's implicit ``__context__`` would misclassify
+    unrelated errors raised while handling a NoRetryError.
+    """
+    seen = set()
+    cur: BaseException | None = err
+    while cur is not None and id(cur) not in seen:
+        if isinstance(cur, NoRetryError):
+            return True
+        seen.add(id(cur))
+        cur = cur.__cause__
+    return False
+
+
+class NotFoundError(Exception):
+    """API-object-not-found, the kerrors.IsNotFound analogue."""
+
+    def __init__(self, kind: str = "", key: str = ""):
+        super().__init__(f"{kind} {key!r} not found")
+        self.kind = kind
+        self.key = key
+
+
+def is_not_found(err: BaseException) -> bool:
+    return isinstance(err, NotFoundError)
+
+
+class ConflictError(Exception):
+    """Optimistic-concurrency conflict on update (resourceVersion mismatch)."""
+
+
+class AdmissionDeniedError(Exception):
+    """A validating admission webhook rejected the request."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"admission webhook denied the request "
+                         f"({code}): {message}")
+        self.code = code
+        self.reason = message
+
+
+class AWSAPIError(Exception):
+    """Base for simulated/real AWS API errors, carrying an error code the
+    way smithy.APIError does (reference
+    pkg/controller/endpointgroupbinding/reconcile.go:50-56)."""
+
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(message or code)
+        self.code = code
+
+
+class ListenerNotFoundError(AWSAPIError):
+    def __init__(self, message: str = "listener not found"):
+        super().__init__("ListenerNotFoundException", message)
+
+
+class EndpointGroupNotFoundError(AWSAPIError):
+    def __init__(self, message: str = "endpoint group not found"):
+        super().__init__("EndpointGroupNotFoundException", message)
+
+
+# Error-code constant used by the EndpointGroupBinding delete path
+# (reference pkg/cloudprovider/aws/global_accelerator.go:28).
+ERR_ENDPOINT_GROUP_NOT_FOUND = "EndpointGroupNotFoundException"

@@ -1,0 +1,23 @@
+"""Shared test-object builders (reference pkg/fixture/endpointgroupbinding.go:8-22)."""
+from typing import Optional
+
+from ..apis.endpointgroupbinding.v1alpha1 import (
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from ..kube.objects import ObjectMeta
+
+
+def endpoint_group_binding(client_ip_preservation: bool, service: str,
+                           weight: Optional[int],
+                           arn: str) -> EndpointGroupBinding:
+    return EndpointGroupBinding(
+        metadata=ObjectMeta(name="test-endpointgroupbinding"),
+        spec=EndpointGroupBindingSpec(
+            endpoint_group_arn=arn,
+            client_ip_preservation=client_ip_preservation,
+            weight=weight,
+            service_ref=ServiceReference(name=service),
+        ),
+    )

@@ -1,0 +1,27 @@
+"""JAX platform-selection shim for process entry points.
+
+The environment this framework targets may register an accelerator
+PJRT plugin at interpreter start (via sitecustomize) and pin
+``jax.config.jax_platforms`` before user code runs — at that point the
+``JAX_PLATFORMS`` env var alone is too late.  Every lazy ``import jax``
+on a CLI path goes through :func:`import_jax` so an explicit
+``JAX_PLATFORMS=cpu`` (tests, airgapped runs, a wedged TPU backend)
+is always honored.
+
+The reference CLI has no analogue (cmd/root.go:13-30 — no compute),
+so this shim is additive surface for the TPU compute track.
+"""
+from __future__ import annotations
+
+import os
+
+
+def import_jax():
+    """Import jax, forcing ``jax.config.jax_platforms`` to match the
+    ``JAX_PLATFORMS`` env var when one is set.  Returns the module."""
+    import jax
+
+    env_platforms = os.environ.get("JAX_PLATFORMS", "")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
+    return jax

@@ -1,0 +1,11 @@
+"""Minimal Kubernetes runtime: object model, fake API server, typed
+clients, shared informers, and a rate-limited workqueue.
+
+The reference relies on k8s.io/client-go and code-generated clients
+(SURVEY.md §2 "Generated client machinery", pkg/client/ ~1459 LoC).  The
+``kubernetes`` Python package is not available in this environment, so this
+package provides the equivalent machinery natively: a thread-safe in-memory
+API server with watch streams (the fake-clientset analogue, used by every
+test tier), typed clients over a pluggable backend, and client-go-style
+informer caches and workqueues.
+"""

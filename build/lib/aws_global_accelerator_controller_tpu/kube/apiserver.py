@@ -1,0 +1,297 @@
+"""In-memory Kubernetes API server (fake-clientset + watch analogue).
+
+Backs every test tier and the ``--fake`` CLI mode.  Provides what the
+reference gets from the real API server + generated fake clientset
+(pkg/client/clientset/versioned/fake/):
+
+- thread-safe typed stores with monotonically increasing resourceVersions;
+- optimistic concurrency on update (ConflictError on stale
+  resourceVersion);
+- finalizer-aware deletion: delete on an object with finalizers sets
+  deletionTimestamp and emits MODIFIED; the object is only removed once
+  its finalizers are cleared (matching apiserver behavior the
+  EndpointGroupBinding finalizer state machine depends on,
+  reference pkg/controller/endpointgroupbinding/reconcile.go:27-34);
+- list+watch with resumable event streams for informers.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as queue_mod
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from ..errors import AdmissionDeniedError, ConflictError, NotFoundError
+from .objects import KubeObject
+
+WATCH_ADDED = "ADDED"
+WATCH_MODIFIED = "MODIFIED"
+WATCH_DELETED = "DELETED"
+
+
+@dataclass
+class ValidatingWebhook:
+    """A registered ValidatingWebhookConfiguration entry: the API server
+    POSTs AdmissionReview v1 to ``url`` before persisting, with
+    failurePolicy: Fail semantics (reference config/webhook/manifests.yaml)."""
+    kind: str
+    url: str
+    operations: tuple = ("CREATE", "UPDATE")
+
+    def review(self, operation: str, old_obj, new_obj) -> None:
+        import json
+        import urllib.request
+
+        request: dict = {
+            "uid": str(uuid.uuid4()),
+            "kind": {"kind": self.kind},
+            "operation": operation,
+        }
+        if new_obj is not None:
+            request["object"] = new_obj.to_dict()
+        if old_obj is not None:
+            request["oldObject"] = old_obj.to_dict()
+        body = json.dumps({
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": request,
+        }).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                review = json.loads(resp.read())
+        except AdmissionDeniedError:
+            raise
+        except Exception as e:
+            # failurePolicy: Fail -- an unreachable webhook blocks writes
+            raise AdmissionDeniedError(500, f"webhook call failed: {e}")
+        response = review.get("response") or {}
+        if not response.get("allowed", False):
+            status = response.get("status") or {}
+            raise AdmissionDeniedError(status.get("code", 403),
+                                       status.get("message", "denied"))
+
+
+@dataclass
+class WatchEvent:
+    type: str
+    obj: KubeObject
+    resource_version: int
+
+
+class Broadcaster:
+    """Fan-out of watch events to subscriber queues."""
+
+    def __init__(self):
+        self._subs: List[queue_mod.Queue] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self) -> queue_mod.Queue:
+        q: queue_mod.Queue = queue_mod.Queue()
+        with self._lock:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q: queue_mod.Queue) -> None:
+        with self._lock:
+            if q in self._subs:
+                self._subs.remove(q)
+
+    def publish(self, event: WatchEvent) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for q in subs:
+            q.put(event)
+
+
+class ResourceStore:
+    """One kind's store: CRUD + watch. Keys are 'namespace/name'."""
+
+    def __init__(self, kind: str, rv_source: Callable[[], int],
+                 admission: Optional[Callable] = None,
+                 schema_validator: Optional[Callable] = None):
+        self.kind = kind
+        self._next_rv = rv_source
+        self._objects: Dict[str, KubeObject] = {}
+        self._lock = threading.RLock()
+        self._broadcaster = Broadcaster()
+        # admission(operation, old_obj, new_obj) raises AdmissionDeniedError
+        self._admission = admission
+        # schema_validator(obj) raises InvalidObjectError (CRD structural
+        # schema enforcement, like the real apiserver)
+        self._schema_validator = schema_validator
+
+    # -- helpers --------------------------------------------------------
+
+    def _stamp(self, obj: KubeObject) -> int:
+        rv = self._next_rv()
+        obj.metadata.resource_version = rv
+        return rv
+
+    def _publish(self, type_: str, obj: KubeObject) -> None:
+        self._broadcaster.publish(
+            WatchEvent(type_, obj.deep_copy(), obj.metadata.resource_version))
+
+    # -- CRUD -----------------------------------------------------------
+
+    def create(self, obj: KubeObject) -> KubeObject:
+        if self._schema_validator is not None:
+            self._schema_validator(obj)
+        if self._admission is not None:
+            self._admission("CREATE", None, obj)
+        with self._lock:
+            obj = obj.deep_copy()
+            key = obj.key()
+            if key in self._objects:
+                raise ConflictError(f"{self.kind} {key!r} already exists")
+            if not obj.metadata.uid:
+                obj.metadata.uid = str(uuid.uuid4())
+            if obj.metadata.creation_timestamp is None:
+                obj.metadata.creation_timestamp = time.time()
+            obj.metadata.generation = 1
+            self._stamp(obj)
+            self._objects[key] = obj
+            self._publish(WATCH_ADDED, obj)
+            return obj.deep_copy()
+
+    def get(self, namespace: str, name: str) -> KubeObject:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            obj = self._objects.get(key)
+            if obj is None:
+                raise NotFoundError(self.kind, key)
+            return obj.deep_copy()
+
+    def list(self, namespace: Optional[str] = None) -> List[KubeObject]:
+        with self._lock:
+            objs = [o.deep_copy() for o in self._objects.values()
+                    if namespace is None or o.metadata.namespace == namespace]
+            return sorted(objs, key=lambda o: o.key())
+
+    def update(self, obj: KubeObject, *, status_only: bool = False,
+               bump_generation: Optional[bool] = None) -> KubeObject:
+        """Update with optimistic concurrency.
+
+        ``bump_generation`` defaults to spec updates bumping generation and
+        status updates (``status_only``) leaving it, like the apiserver.
+        """
+        if self._schema_validator is not None and not status_only:
+            self._schema_validator(obj)
+        if self._admission is not None and not status_only:
+            with self._lock:
+                prior = self._objects.get(obj.key())
+                prior = prior.deep_copy() if prior is not None else None
+            self._admission("UPDATE", prior, obj)
+        with self._lock:
+            obj = obj.deep_copy()
+            key = obj.key()
+            current = self._objects.get(key)
+            if current is None:
+                raise NotFoundError(self.kind, key)
+            if (obj.metadata.resource_version
+                    and obj.metadata.resource_version
+                    != current.metadata.resource_version):
+                raise ConflictError(
+                    f"{self.kind} {key!r}: resourceVersion conflict "
+                    f"({obj.metadata.resource_version} != "
+                    f"{current.metadata.resource_version})")
+            if status_only:
+                # only .status moves; metadata/spec stay at current
+                merged = current.deep_copy()
+                if hasattr(obj, "status"):
+                    merged.status = obj.status
+            else:
+                merged = obj
+                merged.metadata.uid = current.metadata.uid
+                merged.metadata.creation_timestamp = (
+                    current.metadata.creation_timestamp)
+                merged.metadata.deletion_timestamp = (
+                    current.metadata.deletion_timestamp)
+                bump = (bump_generation if bump_generation is not None
+                        else self._spec_changed(current, merged))
+                merged.metadata.generation = (
+                    current.metadata.generation + (1 if bump else 0))
+            self._stamp(merged)
+            self._objects[key] = merged
+
+            if (merged.metadata.deletion_timestamp is not None
+                    and not merged.metadata.finalizers):
+                # finalizers cleared on a deleting object -> actually remove
+                del self._objects[key]
+                self._publish(WATCH_DELETED, merged)
+            else:
+                self._publish(WATCH_MODIFIED, merged)
+            return merged.deep_copy()
+
+    @staticmethod
+    def _spec_changed(old: KubeObject, new: KubeObject) -> bool:
+        return (getattr(old, "spec", None) != getattr(new, "spec", None))
+
+    def delete(self, namespace: str, name: str) -> None:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            obj = self._objects.get(key)
+            if obj is None:
+                raise NotFoundError(self.kind, key)
+            if obj.metadata.finalizers:
+                if obj.metadata.deletion_timestamp is None:
+                    obj.metadata.deletion_timestamp = time.time()
+                    self._stamp(obj)
+                    self._publish(WATCH_MODIFIED, obj)
+                return
+            del self._objects[key]
+            self._stamp(obj)
+            self._publish(WATCH_DELETED, obj)
+
+    # -- watch ----------------------------------------------------------
+
+    def watch(self) -> queue_mod.Queue:
+        return self._broadcaster.subscribe()
+
+    def stop_watch(self, q: queue_mod.Queue) -> None:
+        self._broadcaster.unsubscribe(q)
+
+
+class FakeAPIServer:
+    """The cluster: one ResourceStore per kind, shared resourceVersion."""
+
+    KINDS = ("Service", "Ingress", "EndpointGroupBinding", "Lease", "Event")
+
+    def __init__(self):
+        self._rv = itertools.count(1)
+        self._rv_lock = threading.Lock()
+        self._webhooks: list = []
+        from .validation import endpoint_group_binding_validator
+        validators = {"EndpointGroupBinding": endpoint_group_binding_validator()}
+        self.stores: Dict[str, ResourceStore] = {
+            kind: ResourceStore(kind, self._next_rv,
+                                admission=self._make_admission(kind),
+                                schema_validator=validators.get(kind))
+            for kind in self.KINDS
+        }
+
+    def _next_rv(self) -> int:
+        with self._rv_lock:
+            return next(self._rv)
+
+    def store(self, kind: str) -> ResourceStore:
+        return self.stores[kind]
+
+    def register_validating_webhook(self, kind: str, url: str,
+                                    operations=("CREATE", "UPDATE")) -> None:
+        """The ValidatingWebhookConfiguration-apply analogue (reference
+        config/webhook/manifests.yaml, applied by e2e/pkg/util)."""
+        self._webhooks.append(ValidatingWebhook(kind, url,
+                                                tuple(operations)))
+
+    def _make_admission(self, kind: str):
+        def admit(operation, old_obj, new_obj):
+            for wh in self._webhooks:
+                if wh.kind == kind and operation in wh.operations:
+                    wh.review(operation, old_obj, new_obj)
+        return admit

@@ -47,11 +47,6 @@ ServiceResolver = Callable[[str, str, str], str]
 
 def _apply_webhook_config(api: FakeAPIServer, doc: Dict[str, Any],
                           service_resolver: Optional[ServiceResolver]):
-    if not hasattr(api, "register_validating_webhook"):
-        raise ValueError(
-            "this API backend does not take webhook registrations "
-            "(a real cluster installs ValidatingWebhookConfiguration "
-            "natively — apply it with kubectl)")
     registered = []
     for wh in doc.get("webhooks") or []:
         client = wh.get("clientConfig") or {}
@@ -130,15 +125,7 @@ def apply(api: FakeAPIServer, doc: Dict[str, Any],
     if kind == "ValidatingWebhookConfiguration":
         return _apply_webhook_config(api, doc, service_resolver)
     if kind == "CustomResourceDefinition":
-        name = _apply_crd(doc)
-        if not hasattr(api, "register_validating_webhook"):
-            # schema checked, but a real cluster installs CRDs through
-            # apiextensions — this client doesn't speak that API
-            raise ValueError(
-                f"CRD {name!r} validated against the served schema but "
-                "cannot be installed through this backend — apply it "
-                "with kubectl")
-        return name
+        return _apply_crd(doc)
     obj = parse_manifest(doc)
     store = api.store(obj.kind)
     try:
@@ -157,38 +144,25 @@ _CONFIG_KINDS = ("ValidatingWebhookConfiguration",
 
 def apply_yaml(api: FakeAPIServer, text: str,
                service_resolver: Optional[ServiceResolver] = None,
-               lenient: bool = False) -> List[Any]:
+               ) -> List[Any]:
     """Apply every supported document in a (possibly multi-doc) YAML
-    string; unsupported kinds (Deployment, RBAC, ...) are skipped.
-
-    ``lenient`` downgrades configuration kinds this backend cannot
-    install (webhook configs without a resolver, CRDs against a real
-    cluster) from errors to logged skips — the CLI ``--seed`` mode."""
-    import logging
-
-    logger = logging.getLogger(__name__)
+    string; unsupported kinds (Deployment, RBAC, ...) are skipped."""
     applied = []
     for doc in yaml.safe_load_all(text):
         if not doc:
             continue
-        kind = doc.get("kind")
-        if kind not in _KIND_TYPES and kind not in _CONFIG_KINDS:
+        if (doc.get("kind") not in _KIND_TYPES
+                and doc.get("kind") not in _CONFIG_KINDS):
             continue
-        try:
-            applied.append(apply(api, doc, service_resolver))
-        except ValueError as e:
-            if not (lenient and kind in _CONFIG_KINDS):
-                raise
-            logger.warning("skipping %s: %s", kind, e)
+        applied.append(apply(api, doc, service_resolver))
     return applied
 
 
 def apply_files(api: FakeAPIServer, paths: Iterable[str],
                 service_resolver: Optional[ServiceResolver] = None,
-                lenient: bool = False) -> List[Any]:
+                ) -> List[Any]:
     applied = []
     for path in paths:
         with open(path) as f:
-            applied.extend(apply_yaml(api, f.read(), service_resolver,
-                                      lenient=lenient))
+            applied.extend(apply_yaml(api, f.read(), service_resolver))
     return applied

@@ -1,0 +1,129 @@
+"""Typed clients over a pluggable API backend.
+
+The analogue of the generated clientsets (reference pkg/client/clientset/
+versioned/clientset.go:32-35 for the CRD; k8s.io/client-go kubernetes for
+core types).  ``KubeClient`` covers Services/Ingresses/Events/Leases;
+``OperatorClient`` covers EndpointGroupBindings with an UpdateStatus
+subresource, mirroring ``versioned.Interface.OperatorV1alpha1()``.
+
+Both talk to a ``FakeAPIServer`` here; a real-cluster backend would
+implement the same ResourceStore surface over HTTP (import-gated, since
+the ``kubernetes`` package is absent in this environment).
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from ..apis.endpointgroupbinding.v1alpha1 import EndpointGroupBinding
+from .apiserver import FakeAPIServer
+from .objects import Event, Ingress, Lease, ObjectMeta, Service
+
+logger = logging.getLogger(__name__)
+
+
+class _TypedNamespacedClient:
+    def __init__(self, store):
+        self._store = store
+
+    def create(self, obj):
+        return self._store.create(obj)
+
+    def get(self, namespace: str, name: str):
+        return self._store.get(namespace, name)
+
+    def list(self, namespace: Optional[str] = None):
+        return self._store.list(namespace)
+
+    def update(self, obj):
+        return self._store.update(obj)
+
+    def delete(self, namespace: str, name: str):
+        return self._store.delete(namespace, name)
+
+    def watch(self):
+        return self._store.watch()
+
+    def stop_watch(self, q):
+        return self._store.stop_watch(q)
+
+
+class ServiceClient(_TypedNamespacedClient):
+    pass
+
+
+class IngressClient(_TypedNamespacedClient):
+    pass
+
+
+class LeaseClient(_TypedNamespacedClient):
+    pass
+
+
+class EndpointGroupBindingClient(_TypedNamespacedClient):
+    """OperatorV1alpha1().EndpointGroupBindings(ns) analogue."""
+
+    def update_status(self, obj: EndpointGroupBinding) -> EndpointGroupBinding:
+        return self._store.update(obj, status_only=True)
+
+
+class EventRecorder:
+    """record.EventRecorder analogue: writes Events to the API and logs.
+
+    Reference wires an EventBroadcaster sink per controller
+    (e.g. pkg/controller/globalaccelerator/controller.go:55-58).
+    """
+
+    def __init__(self, store, component: str):
+        self._store = store
+        self.component = component
+
+    def event(self, obj, type_: str, reason: str, message: str) -> None:
+        import uuid
+
+        # unique suffix, like client-go's timestamp-suffixed event names;
+        # must not rely on store internals (the HTTP backend has none)
+        ev = Event(
+            metadata=ObjectMeta(
+                name=f"{obj.metadata.name}.{reason}.{uuid.uuid4().hex[:10]}",
+                namespace=obj.metadata.namespace or "default"),
+            involved_object_kind=obj.kind,
+            involved_object_key=obj.key(),
+            type=type_,
+            reason=reason,
+            message=message,
+        )
+        try:
+            self._store.create(ev)
+        except Exception:  # events are best-effort
+            logger.debug("failed to record event %s", reason, exc_info=True)
+        logger.info("Event(%s %s): type=%s reason=%s %s",
+                    obj.kind, obj.key(), type_, reason, message)
+
+    def eventf(self, obj, type_: str, reason: str, fmt: str, *args) -> None:
+        self.event(obj, type_, reason, fmt % args if args else fmt)
+
+
+class KubeClient:
+    """kubernetes.Interface analogue (core + networking + coordination)."""
+
+    def __init__(self, api: FakeAPIServer):
+        self.api = api
+        self.services = ServiceClient(api.store("Service"))
+        self.ingresses = IngressClient(api.store("Ingress"))
+        self.leases = LeaseClient(api.store("Lease"))
+
+    def event_recorder(self, component: str) -> EventRecorder:
+        return EventRecorder(self.api.store("Event"), component)
+
+    def list_events(self) -> List[Event]:
+        return self.api.store("Event").list()
+
+
+class OperatorClient:
+    """Generated CRD clientset analogue."""
+
+    def __init__(self, api: FakeAPIServer):
+        self.api = api
+        self.endpoint_group_bindings = EndpointGroupBindingClient(
+            api.store("EndpointGroupBinding"))

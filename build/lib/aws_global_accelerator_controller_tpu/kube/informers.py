@@ -1,0 +1,226 @@
+"""Shared informers: list+watch cache with event handlers and resync.
+
+The analogue of client-go SharedInformerFactory (reference
+pkg/manager/manager.go:52-53 builds two factories with 30s resync;
+controllers register ResourceEventHandlerFuncs and read through Listers,
+e.g. pkg/controller/globalaccelerator/controller.go:69-87).
+
+Each informer runs one thread: initial list populates the cache and fires
+ADDED handlers, then the watch stream is consumed; a resync timer
+re-delivers the cache as update(obj, obj) pairs -- the level-triggered
+backstop the reconcile design relies on (SURVEY.md §5 "failure
+detection").
+"""
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..errors import NotFoundError
+from .apiserver import (
+    WATCH_ADDED,
+    WATCH_DELETED,
+    WATCH_MODIFIED,
+    ResourceStore,
+)
+from .objects import KubeObject
+
+logger = logging.getLogger(__name__)
+
+AddHandler = Callable[[KubeObject], None]
+UpdateHandler = Callable[[KubeObject, KubeObject], None]
+DeleteHandler = Callable[[KubeObject], None]
+
+
+class EventHandlers:
+    def __init__(self, add: Optional[AddHandler] = None,
+                 update: Optional[UpdateHandler] = None,
+                 delete: Optional[DeleteHandler] = None):
+        self.add = add
+        self.update = update
+        self.delete = delete
+
+
+class Lister:
+    """Read-only view of an informer cache (lister analogue)."""
+
+    def __init__(self, informer: "Informer"):
+        self._informer = informer
+
+    def get(self, namespace: str, name: str) -> KubeObject:
+        obj = self._informer.cache_get(f"{namespace}/{name}")
+        if obj is None:
+            raise NotFoundError(self._informer.kind, f"{namespace}/{name}")
+        return obj
+
+    def list(self, namespace: Optional[str] = None) -> List[KubeObject]:
+        return self._informer.cache_list(namespace)
+
+
+class Informer:
+    def __init__(self, store: ResourceStore, resync_period: float = 30.0):
+        self.kind = store.kind
+        self._store = store
+        self._resync_period = resync_period
+        self._cache: Dict[str, KubeObject] = {}
+        self._cache_lock = threading.RLock()
+        self._handlers: List[EventHandlers] = []
+        self._synced = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watch_q: Optional[queue_mod.Queue] = None
+        self.lister = Lister(self)
+
+    # -- registration ---------------------------------------------------
+
+    def add_event_handler(self, add=None, update=None, delete=None) -> None:
+        self._handlers.append(EventHandlers(add, update, delete))
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    # -- cache ----------------------------------------------------------
+
+    def cache_get(self, key: str) -> Optional[KubeObject]:
+        with self._cache_lock:
+            obj = self._cache.get(key)
+            return obj.deep_copy() if obj is not None else None
+
+    def cache_list(self, namespace: Optional[str] = None) -> List[KubeObject]:
+        with self._cache_lock:
+            return [o.deep_copy() for o in self._cache.values()
+                    if namespace is None or o.metadata.namespace == namespace]
+
+    # -- run loop -------------------------------------------------------
+
+    def run(self, stop: threading.Event) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, args=(stop,), daemon=True,
+            name=f"informer-{self.kind}")
+        self._thread.start()
+
+    def _dispatch(self, fn, *args) -> None:
+        if fn is None:
+            return
+        try:
+            fn(*args)
+        except Exception:
+            logger.exception("informer handler error (%s)", self.kind)
+
+    def _loop(self, stop: threading.Event) -> None:
+        # Subscribe BEFORE listing so no event between list and watch is lost.
+        self._watch_q = self._store.watch()
+        try:
+            listed = self._store.list()
+            with self._cache_lock:
+                for obj in listed:
+                    self._cache[obj.key()] = obj
+            for obj in listed:
+                for h in self._handlers:
+                    self._dispatch(h.add, obj.deep_copy())
+            self._synced.set()
+
+            next_resync = time.monotonic() + self._resync_period
+            while not stop.is_set():
+                timeout = min(0.2, max(0.0, next_resync - time.monotonic()))
+                try:
+                    event = self._watch_q.get(timeout=timeout)
+                except queue_mod.Empty:
+                    event = None
+                if event is not None:
+                    self._handle_event(event)
+                if time.monotonic() >= next_resync:
+                    self._resync()
+                    next_resync = time.monotonic() + self._resync_period
+        finally:
+            self._store.stop_watch(self._watch_q)
+
+    def _handle_event(self, event) -> None:
+        key = event.obj.key()
+        if event.type == WATCH_ADDED:
+            with self._cache_lock:
+                old = self._cache.get(key)
+                self._cache[key] = event.obj
+            for h in self._handlers:
+                if old is None:
+                    self._dispatch(h.add, event.obj.deep_copy())
+                else:
+                    self._dispatch(h.update, old.deep_copy(),
+                                   event.obj.deep_copy())
+        elif event.type == WATCH_MODIFIED:
+            with self._cache_lock:
+                old = self._cache.get(key)
+                self._cache[key] = event.obj
+            for h in self._handlers:
+                if old is None:
+                    self._dispatch(h.add, event.obj.deep_copy())
+                else:
+                    self._dispatch(h.update, old.deep_copy(),
+                                   event.obj.deep_copy())
+        elif event.type == WATCH_DELETED:
+            with self._cache_lock:
+                old = self._cache.pop(key, None)
+            tombstone = old if old is not None else event.obj
+            for h in self._handlers:
+                self._dispatch(h.delete, tombstone.deep_copy())
+
+    def _resync(self) -> None:
+        """Re-deliver the cache as no-op updates (level-trigger backstop)."""
+        with self._cache_lock:
+            objs = [o.deep_copy() for o in self._cache.values()]
+        for obj in objs:
+            for h in self._handlers:
+                self._dispatch(h.update, obj.deep_copy(), obj.deep_copy())
+
+
+class SharedInformerFactory:
+    """One informer per kind, shared across controllers
+    (informers.NewSharedInformerFactory analogue)."""
+
+    def __init__(self, api, resync_period: float = 30.0):
+        self._api = api
+        self._resync = resync_period
+        self._informers: Dict[str, Informer] = {}
+        self._lock = threading.Lock()
+        self._started_stop: Optional[threading.Event] = None
+
+    def informer_for(self, kind: str) -> Informer:
+        with self._lock:
+            inf = self._informers.get(kind)
+            if inf is None:
+                inf = Informer(self._api.store(kind), self._resync)
+                self._informers[kind] = inf
+                if self._started_stop is not None:
+                    inf.run(self._started_stop)
+            return inf
+
+    def services(self) -> Informer:
+        return self.informer_for("Service")
+
+    def ingresses(self) -> Informer:
+        return self.informer_for("Ingress")
+
+    def endpoint_group_bindings(self) -> Informer:
+        return self.informer_for("EndpointGroupBinding")
+
+    def start(self, stop: threading.Event) -> None:
+        with self._lock:
+            self._started_stop = stop
+            for inf in self._informers.values():
+                if inf._thread is None:
+                    inf.run(stop)
+
+
+def wait_for_cache_sync(stop: threading.Event, *informers: Informer,
+                        timeout: float = 10.0) -> bool:
+    """cache.WaitForCacheSync analogue."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if stop.is_set():
+            return False
+        if all(i.has_synced() for i in informers):
+            return True
+        time.sleep(0.01)
+    return False

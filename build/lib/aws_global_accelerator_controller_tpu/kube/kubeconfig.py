@@ -1,0 +1,162 @@
+"""Kubeconfig / in-cluster REST config resolution.
+
+The analogue of clientcmd.BuildConfigFromFlags + rest.InClusterConfig
+(reference cmd/controller/controller.go:50 builds the rest.Config from
+``--master``/``--kubeconfig``; in-cluster is client-go's fallback).
+
+Resolution order matches client-go:
+1. explicit kubeconfig path (flag, or $KUBECONFIG);
+2. in-cluster service account (KUBERNETES_SERVICE_HOST env + mounted
+   token/CA under /var/run/secrets/kubernetes.io/serviceaccount);
+3. default ~/.kube/config if present.
+
+``master`` overrides the server URL in all cases.
+"""
+from __future__ import annotations
+
+import base64
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeConfigError(Exception):
+    pass
+
+
+@dataclass
+class RestConfig:
+    """Connection parameters for an API server (rest.Config analogue)."""
+
+    server: str = ""
+    ca_file: Optional[str] = None
+    cert_file: Optional[str] = None       # client certificate (mTLS)
+    key_file: Optional[str] = None
+    token: Optional[str] = None           # bearer token
+    insecure_skip_tls_verify: bool = False
+    _tmpfiles: list = field(default_factory=list, repr=False)
+
+    def ssl_context(self):
+        """Build the ssl.SSLContext for this config (None for http://)."""
+        import ssl
+
+        if not self.server.startswith("https"):
+            return None
+        ctx = ssl.create_default_context(cafile=self.ca_file)
+        if self.insecure_skip_tls_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        if self.cert_file:
+            ctx.load_cert_chain(self.cert_file, self.key_file)
+        return ctx
+
+
+def _inline_to_file(data_b64: str, suffix: str, tmpfiles: list) -> str:
+    """kubeconfig *-data fields are base64-embedded PEM; the ssl module
+    wants file paths, so decode to a private temp file."""
+    f = tempfile.NamedTemporaryFile(
+        mode="wb", suffix=suffix, delete=False, prefix="kubecfg-")
+    f.write(base64.b64decode(data_b64))
+    f.close()
+    os.chmod(f.name, 0o600)
+    tmpfiles.append(f.name)
+    return f.name
+
+
+def load_kubeconfig(path: str, master: str = "") -> RestConfig:
+    """Parse a kubeconfig file's current-context into a RestConfig."""
+    import yaml
+
+    try:
+        with open(path) as fh:
+            doc = yaml.safe_load(fh) or {}
+    except OSError as e:
+        raise KubeConfigError(f"cannot read kubeconfig {path!r}: {e}")
+
+    def by_name(section, name):
+        for entry in doc.get(section) or []:
+            if entry.get("name") == name:
+                return entry.get(section.rstrip("s")) or {}
+        raise KubeConfigError(
+            f"kubeconfig {path!r}: no {section} entry named {name!r}")
+
+    current = doc.get("current-context", "")
+    if not current:
+        raise KubeConfigError(f"kubeconfig {path!r}: no current-context")
+    context = by_name("contexts", current)
+    cluster = by_name("clusters", context.get("cluster", ""))
+    user = by_name("users", context.get("user", "")) if context.get(
+        "user") else {}
+
+    cfg = RestConfig(server=master or cluster.get("server", ""))
+    if not cfg.server:
+        raise KubeConfigError(f"kubeconfig {path!r}: cluster has no server")
+    cfg.insecure_skip_tls_verify = bool(
+        cluster.get("insecure-skip-tls-verify", False))
+    if cluster.get("certificate-authority"):
+        cfg.ca_file = cluster["certificate-authority"]
+    elif cluster.get("certificate-authority-data"):
+        cfg.ca_file = _inline_to_file(
+            cluster["certificate-authority-data"], ".crt", cfg._tmpfiles)
+    if user.get("client-certificate"):
+        cfg.cert_file = user["client-certificate"]
+        cfg.key_file = user.get("client-key")
+    elif user.get("client-certificate-data"):
+        if not user.get("client-key-data"):
+            raise KubeConfigError(
+                f"kubeconfig {path!r}: client-certificate-data without "
+                "client-key-data")
+        cfg.cert_file = _inline_to_file(
+            user["client-certificate-data"], ".crt", cfg._tmpfiles)
+        cfg.key_file = _inline_to_file(
+            user["client-key-data"], ".key", cfg._tmpfiles)
+    if user.get("token"):
+        cfg.token = user["token"]
+    return cfg
+
+
+def in_cluster_config() -> RestConfig:
+    """rest.InClusterConfig analogue: service-account token + CA."""
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    if not host:
+        raise KubeConfigError(
+            "not running in-cluster (KUBERNETES_SERVICE_HOST unset)")
+    token_path = os.path.join(SERVICE_ACCOUNT_DIR, "token")
+    ca_path = os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+    try:
+        with open(token_path) as fh:
+            token = fh.read().strip()
+    except OSError as e:
+        raise KubeConfigError(f"cannot read service account token: {e}")
+    return RestConfig(
+        server=f"https://{host}:{port}",
+        ca_file=ca_path if os.path.exists(ca_path) else None,
+        token=token,
+    )
+
+
+def build_config(kubeconfig: str = "", master: str = "") -> RestConfig:
+    """clientcmd.BuildConfigFromFlags analogue (resolution order in the
+    module docstring)."""
+    path = kubeconfig or os.environ.get("KUBECONFIG", "")
+    if path:
+        return load_kubeconfig(path, master)
+    try:
+        cfg = in_cluster_config()
+        if master:
+            cfg.server = master
+        return cfg
+    except KubeConfigError:
+        pass
+    default = os.path.expanduser("~/.kube/config")
+    if os.path.exists(default):
+        return load_kubeconfig(default, master)
+    if master:
+        return RestConfig(server=master)
+    raise KubeConfigError(
+        "no kubeconfig: pass --kubeconfig/--master, set $KUBECONFIG, or "
+        "run in-cluster")

@@ -1,0 +1,156 @@
+"""ctypes binding for the native (C++) rate-limited workqueue.
+
+``NativeRateLimitingQueue`` is API-compatible with
+``kube.workqueue.RateLimitingQueue`` for string items (controller keys are
+always ``namespace/name`` strings — reconcile.py:72 enforces this), backed
+by ``native/workqueue.cpp``.  Blocking ``get`` releases the GIL for the
+whole wait, so N worker threads park in the kernel instead of contending on
+a Python condition variable — the same property the reference gets for free
+from Go's runtime (client-go workqueue parked goroutines).
+
+Use :func:`native_available` / :func:`load` rather than importing the
+library directly; everything degrades to the pure-Python queue when g++ is
+absent (see kube.workqueue.new_rate_limiting_queue).
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Any, Optional, Tuple
+
+from ..native import ensure_library
+
+_lib = None
+_lib_lock = threading.Lock()
+_lib_failed = False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if necessary) the native library, or None."""
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _lib_failed:
+            return None
+        path = ensure_library("workqueue")
+        if path is None:
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.aga_wq_new.restype = ctypes.c_void_p
+        lib.aga_wq_new.argtypes = [ctypes.c_double, ctypes.c_int,
+                                   ctypes.c_double, ctypes.c_double]
+        lib.aga_wq_free.argtypes = [ctypes.c_void_p]
+        lib.aga_wq_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.aga_wq_get.restype = ctypes.c_int
+        lib.aga_wq_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int, ctypes.c_double,
+                                   ctypes.POINTER(ctypes.c_int)]
+        lib.aga_wq_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.aga_wq_add_after.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_double]
+        lib.aga_wq_add_rate_limited.restype = ctypes.c_double
+        lib.aga_wq_add_rate_limited.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_char_p]
+        lib.aga_wq_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.aga_wq_num_requeues.restype = ctypes.c_int
+        lib.aga_wq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.aga_wq_len.restype = ctypes.c_int
+        lib.aga_wq_len.argtypes = [ctypes.c_void_p]
+        lib.aga_wq_waiting_len.restype = ctypes.c_int
+        lib.aga_wq_waiting_len.argtypes = [ctypes.c_void_p]
+        lib.aga_wq_shutdown.argtypes = [ctypes.c_void_p]
+        lib.aga_wq_shutting_down.restype = ctypes.c_int
+        lib.aga_wq_shutting_down.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load() is not None
+
+
+def _encode(item: Any) -> bytes:
+    if isinstance(item, bytes):
+        return item
+    return str(item).encode("utf-8")
+
+
+class NativeRateLimitingQueue:
+    """Drop-in replacement for RateLimitingQueue backed by C++.
+
+    Items are returned as ``str`` (decoded UTF-8), matching what the
+    controllers enqueue.
+    """
+
+    def __init__(self, name: str = "", qps: float = 10.0, burst: int = 100,
+                 base_delay: float = 0.005, max_delay: float = 1000.0):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native workqueue library unavailable")
+        self.name = name
+        self._lib = lib
+        self._h = lib.aga_wq_new(qps, burst, base_delay, max_delay)
+        self._tls = threading.local()
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.aga_wq_free(h)
+            self._h = None
+
+    def add(self, item: Any) -> None:
+        self._lib.aga_wq_add(self._h, _encode(item))
+
+    def get(self, timeout: Optional[float] = None
+            ) -> Tuple[Optional[str], bool]:
+        t = -1.0 if timeout is None else float(timeout)
+        need = ctypes.c_int(0)
+        # One buffer per worker thread: several workers block in get() on
+        # the same queue concurrently (controller/base.py runs `workers`
+        # threads per queue).  512 covers any k8s key (253+1+253).
+        buf = getattr(self._tls, "buf", None)
+        if buf is None:
+            buf = self._tls.buf = ctypes.create_string_buffer(512)
+        while True:
+            rc = self._lib.aga_wq_get(self._h, buf, len(buf), t,
+                                      ctypes.byref(need))
+            if rc == 0:
+                return buf.value.decode("utf-8"), False
+            if rc == 1:
+                return None, True
+            if rc == 2:
+                return None, False
+            # rc == 3: enlarge and retry immediately.
+            buf = self._tls.buf = ctypes.create_string_buffer(need.value + 1)
+            t = 0.0 if timeout is not None else -1.0
+
+    def done(self, item: Any) -> None:
+        self._lib.aga_wq_done(self._h, _encode(item))
+
+    def add_after(self, item: Any, delay: float) -> None:
+        self._lib.aga_wq_add_after(self._h, _encode(item), float(delay))
+
+    def add_rate_limited(self, item: Any) -> None:
+        self._lib.aga_wq_add_rate_limited(self._h, _encode(item))
+
+    def forget(self, item: Any) -> None:
+        self._lib.aga_wq_forget(self._h, _encode(item))
+
+    def num_requeues(self, item: Any) -> int:
+        return self._lib.aga_wq_num_requeues(self._h, _encode(item))
+
+    def shutdown(self) -> None:
+        self._lib.aga_wq_shutdown(self._h)
+
+    @property
+    def shutting_down(self) -> bool:
+        return bool(self._lib.aga_wq_shutting_down(self._h))
+
+    def __len__(self) -> int:
+        return self._lib.aga_wq_len(self._h)

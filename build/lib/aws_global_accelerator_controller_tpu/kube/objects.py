@@ -1,0 +1,409 @@
+"""Kubernetes object model (the subset the controllers consume).
+
+Mirrors the shapes the reference reads from k8s.io/api:
+- Service: spec.type / spec.ports / spec.loadBalancerClass /
+  status.loadBalancer.ingress (pkg/controller/globalaccelerator/service.go:18-26,
+  pkg/cloudprovider/aws/global_accelerator.go:503-515)
+- Ingress: spec.ingressClassName / spec.defaultBackend / spec.rules /
+  status.loadBalancer.ingress (pkg/controller/globalaccelerator/ingress.go:19-27,
+  pkg/cloudprovider/aws/global_accelerator.go:522-557)
+
+Objects are plain dataclasses with ``deep_copy()`` (the DeepCopyObject
+analogue -- the reconcile engine always hands process funcs a copy,
+reference pkg/reconcile/reconcile.go:67) and camelCase dict round-tripping
+for manifests and admission payloads.
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    annotations: Dict[str, str] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    deletion_timestamp: Optional[float] = None
+    generation: int = 1
+    resource_version: int = 0
+    uid: str = ""
+    creation_timestamp: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "namespace": self.namespace}
+        if self.annotations:
+            d["annotations"] = dict(self.annotations)
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        if self.finalizers:
+            d["finalizers"] = list(self.finalizers)
+        if self.deletion_timestamp is not None:
+            d["deletionTimestamp"] = self.deletion_timestamp
+        if self.creation_timestamp is not None:
+            d["creationTimestamp"] = self.creation_timestamp
+        d["generation"] = self.generation
+        d["resourceVersion"] = str(self.resource_version)
+        if self.uid:
+            d["uid"] = self.uid
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ObjectMeta":
+        rv = d.get("resourceVersion", 0)
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            annotations=dict(d.get("annotations") or {}),
+            labels=dict(d.get("labels") or {}),
+            finalizers=list(d.get("finalizers") or []),
+            deletion_timestamp=d.get("deletionTimestamp"),
+            generation=int(d.get("generation", 1)),
+            resource_version=int(rv) if str(rv).isdigit() else 0,
+            uid=d.get("uid", ""),
+            creation_timestamp=d.get("creationTimestamp"),
+        )
+
+    def copy(self) -> "ObjectMeta":
+        return ObjectMeta(self.name, self.namespace, dict(self.annotations),
+                          dict(self.labels), list(self.finalizers),
+                          self.deletion_timestamp, self.generation,
+                          self.resource_version, self.uid,
+                          self.creation_timestamp)
+
+
+class KubeObject:
+    """Base for all API objects: kind + metadata + deep copy."""
+
+    kind: str = ""
+    metadata: ObjectMeta
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def annotations(self) -> Dict[str, str]:
+        return self.metadata.annotations
+
+    def key(self) -> str:
+        """namespace/name key (cache.MetaNamespaceKeyFunc analogue)."""
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def deep_copy(self):
+        return copy.deepcopy(self)
+
+
+def split_meta_namespace_key(key: str):
+    """cache.SplitMetaNamespaceKey analogue: 'ns/name' -> (ns, name).
+
+    A bare 'name' maps to namespace '' as in client-go; more than one '/'
+    is invalid.
+    """
+    parts = key.split("/")
+    if len(parts) == 1:
+        return "", parts[0]
+    if len(parts) == 2:
+        return parts[0], parts[1]
+    raise ValueError(f"unexpected key format: {key!r}")
+
+
+# ---------------------------------------------------------------------------
+# core/v1 Service
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ServicePort:
+    port: int
+    protocol: str = "TCP"
+    name: str = ""
+
+    def to_dict(self):
+        return {"port": self.port, "protocol": self.protocol, "name": self.name}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(port=int(d["port"]), protocol=d.get("protocol", "TCP"),
+                   name=d.get("name", ""))
+
+
+@dataclass
+class LoadBalancerIngress:
+    hostname: str = ""
+    ip: str = ""
+
+    def to_dict(self):
+        d: Dict[str, Any] = {}
+        if self.hostname:
+            d["hostname"] = self.hostname
+        if self.ip:
+            d["ip"] = self.ip
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(hostname=d.get("hostname", ""), ip=d.get("ip", ""))
+
+
+@dataclass
+class ServiceSpec:
+    type: str = "ClusterIP"
+    ports: List[ServicePort] = field(default_factory=list)
+    load_balancer_class: Optional[str] = None
+
+    def to_dict(self):
+        d: Dict[str, Any] = {"type": self.type,
+                             "ports": [p.to_dict() for p in self.ports]}
+        if self.load_balancer_class is not None:
+            d["loadBalancerClass"] = self.load_balancer_class
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(
+            type=d.get("type", "ClusterIP"),
+            ports=[ServicePort.from_dict(p) for p in d.get("ports") or []],
+            load_balancer_class=d.get("loadBalancerClass"),
+        )
+
+
+@dataclass
+class LoadBalancerStatus:
+    ingress: List[LoadBalancerIngress] = field(default_factory=list)
+
+    def to_dict(self):
+        return {"ingress": [i.to_dict() for i in self.ingress]}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(ingress=[LoadBalancerIngress.from_dict(i)
+                            for i in d.get("ingress") or []])
+
+
+@dataclass
+class ServiceStatus:
+    load_balancer: LoadBalancerStatus = field(default_factory=LoadBalancerStatus)
+
+    def to_dict(self):
+        return {"loadBalancer": self.load_balancer.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(load_balancer=LoadBalancerStatus.from_dict(
+            d.get("loadBalancer") or {}))
+
+
+@dataclass
+class Service(KubeObject):
+    kind = "Service"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    status: ServiceStatus = field(default_factory=ServiceStatus)
+
+    def deep_copy(self) -> "Service":
+        # hand-rolled: Services dominate informer/reconcile traffic and
+        # copy.deepcopy shows up hot in the bench profile
+        return Service(
+            metadata=self.metadata.copy(),
+            spec=ServiceSpec(
+                type=self.spec.type,
+                ports=[ServicePort(p.port, p.protocol, p.name)
+                       for p in self.spec.ports],
+                load_balancer_class=self.spec.load_balancer_class),
+            status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(i.hostname, i.ip)
+                         for i in self.status.load_balancer.ingress])),
+        )
+
+    def to_dict(self):
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=ServiceSpec.from_dict(d.get("spec") or {}),
+            status=ServiceStatus.from_dict(d.get("status") or {}),
+        )
+
+
+# ---------------------------------------------------------------------------
+# networking/v1 Ingress
+# ---------------------------------------------------------------------------
+
+@dataclass
+class IngressServiceBackendPort:
+    number: int = 0
+    name: str = ""
+
+
+@dataclass
+class IngressServiceBackend:
+    name: str = ""
+    port: IngressServiceBackendPort = field(default_factory=IngressServiceBackendPort)
+
+
+@dataclass
+class IngressBackend:
+    service: Optional[IngressServiceBackend] = None
+
+
+@dataclass
+class HTTPIngressPath:
+    path: str = "/"
+    backend: IngressBackend = field(default_factory=IngressBackend)
+
+
+@dataclass
+class HTTPIngressRuleValue:
+    paths: List[HTTPIngressPath] = field(default_factory=list)
+
+
+@dataclass
+class IngressRule:
+    host: str = ""
+    http: Optional[HTTPIngressRuleValue] = None
+
+
+@dataclass
+class IngressSpec:
+    ingress_class_name: Optional[str] = None
+    default_backend: Optional[IngressBackend] = None
+    rules: List[IngressRule] = field(default_factory=list)
+
+
+def _backend_to_dict(backend: "IngressBackend") -> Dict[str, Any]:
+    if not backend or not backend.service:
+        return {}
+    return {
+        "service": {
+            "name": backend.service.name,
+            "port": {"number": backend.service.port.number},
+        }
+    }
+
+
+def _backend_from_dict(d: Optional[Dict[str, Any]]) -> Optional["IngressBackend"]:
+    svc = (d or {}).get("service")
+    if not svc:
+        return None
+    return IngressBackend(service=IngressServiceBackend(
+        name=svc.get("name", ""),
+        port=IngressServiceBackendPort(
+            number=int(svc.get("port", {}).get("number", 0)))))
+
+
+@dataclass
+class IngressStatus:
+    load_balancer: LoadBalancerStatus = field(default_factory=LoadBalancerStatus)
+
+
+@dataclass
+class Ingress(KubeObject):
+    kind = "Ingress"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: IngressSpec = field(default_factory=IngressSpec)
+    status: IngressStatus = field(default_factory=IngressStatus)
+
+    def to_dict(self):
+        spec: Dict[str, Any] = {}
+        if self.spec.ingress_class_name is not None:
+            spec["ingressClassName"] = self.spec.ingress_class_name
+        if self.spec.default_backend and self.spec.default_backend.service:
+            spec["defaultBackend"] = _backend_to_dict(self.spec.default_backend)
+        rules = []
+        for r in self.spec.rules:
+            rule: Dict[str, Any] = {}
+            if r.host:
+                rule["host"] = r.host
+            if r.http:
+                rule["http"] = {
+                    "paths": [
+                        {"path": p.path, "backend": _backend_to_dict(p.backend)}
+                        for p in r.http.paths
+                    ]
+                }
+            rules.append(rule)
+        if rules:
+            spec["rules"] = rules
+        return {
+            "apiVersion": "networking.k8s.io/v1",
+            "kind": "Ingress",
+            "metadata": self.metadata.to_dict(),
+            "spec": spec,
+            "status": {"loadBalancer": self.status.load_balancer.to_dict()},
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        spec_d = d.get("spec") or {}
+        default_backend = _backend_from_dict(spec_d.get("defaultBackend"))
+        rules = []
+        for r in spec_d.get("rules") or []:
+            http = None
+            if r.get("http"):
+                paths = [
+                    HTTPIngressPath(
+                        path=p.get("path", "/"),
+                        backend=_backend_from_dict(p.get("backend"))
+                        or IngressBackend())
+                    for p in r["http"].get("paths") or []
+                ]
+                http = HTTPIngressRuleValue(paths=paths)
+            rules.append(IngressRule(host=r.get("host", ""), http=http))
+        status = IngressStatus(load_balancer=LoadBalancerStatus.from_dict(
+            (d.get("status") or {}).get("loadBalancer") or {}))
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=IngressSpec(ingress_class_name=spec_d.get("ingressClassName"),
+                             default_backend=default_backend, rules=rules),
+            status=status,
+        )
+
+
+# ---------------------------------------------------------------------------
+# core/v1 Event (recorder sink)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Event(KubeObject):
+    kind = "Event"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object_kind: str = ""
+    involved_object_key: str = ""
+    type: str = "Normal"
+    reason: str = ""
+    message: str = ""
+
+
+# ---------------------------------------------------------------------------
+# coordination/v1 Lease (leader election lock)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LeaseSpec:
+    holder_identity: str = ""
+    lease_duration_seconds: int = 0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease(KubeObject):
+    kind = "Lease"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)

@@ -1,0 +1,313 @@
+"""Serve a FakeAPIServer over the Kubernetes REST wire protocol.
+
+Two jobs:
+
+1. **Test stub for the real-cluster backend** — ``HTTPAPIServer``
+   (kube/http_store.py) is exercised end-to-end against this server in
+   tests, proving the controller stack works over real HTTP with the
+   real wire formats (the reference gets this from kind clusters in CI,
+   e2e/.github/workflows/e2e.yml).
+2. **Dev apiserver** — a runnable miniature API server speaking enough
+   of the k8s REST protocol (typed CRUD, status subresource, streaming
+   watch with resourceVersion resume and 410 Gone) for local
+   development without a cluster.
+
+Watch semantics: the server keeps a bounded per-kind event history; a
+watch from a resourceVersion still inside the window replays missed
+events then streams live; older resumes get a 410 ERROR event, which
+the client answers by relisting — exactly the real apiserver contract.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..errors import AdmissionDeniedError, ConflictError, NotFoundError
+from .apiserver import FakeAPIServer
+from .http_store import Codec, default_codecs
+
+logger = logging.getLogger(__name__)
+
+_HISTORY = 1024  # watch replay window per kind
+
+
+class _KindState:
+    """Event history + change signal for one kind's watch streams."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.history: deque = deque(maxlen=_HISTORY)
+        self.cond = threading.Condition()
+        self.last_rv = 0
+
+    def append(self, etype: str, wire_obj: dict, rv: int) -> None:
+        with self.cond:
+            self.history.append((rv, etype, wire_obj))
+            self.last_rv = max(self.last_rv, rv)
+            self.cond.notify_all()
+
+    def oldest_rv(self) -> int:
+        with self.cond:
+            return self.history[0][0] if self.history else 0
+
+
+class KubeRestServer:
+    """ThreadingHTTPServer wrapping a FakeAPIServer with k8s routes."""
+
+    def __init__(self, api: Optional[FakeAPIServer] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.api = api if api is not None else FakeAPIServer()
+        self.codecs = default_codecs()
+        # route table: (prefix, plural) -> kind
+        self._routes: Dict[Tuple[str, str], str] = {
+            (c.prefix, c.plural): kind for kind, c in self.codecs.items()
+        }
+        self._states: Dict[str, _KindState] = {
+            kind: _KindState(kind) for kind in self.codecs
+        }
+        self._stop = threading.Event()
+        self._collectors = []
+        for kind in self.codecs:
+            t = threading.Thread(target=self._collect, args=(kind,),
+                                 daemon=True, name=f"rest-collect-{kind}")
+            self._collectors.append(t)
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet the test logs
+                logger.debug("rest: " + fmt, *args)
+
+            def do_GET(self):
+                server.handle(self, "GET")
+
+            def do_POST(self):
+                server.handle(self, "POST")
+
+            def do_PUT(self):
+                server.handle(self, "PUT")
+
+            def do_DELETE(self):
+                server.handle(self, "DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name="rest-apiserver")
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "KubeRestServer":
+        for t in self._collectors:
+            t.start()
+        self._serve_thread.start()
+        logger.info("rest apiserver listening on %s", self.url)
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        # wake any blocked watch streams so their threads exit
+        for state in self._states.values():
+            with state.cond:
+                state.cond.notify_all()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def _collect(self, kind: str) -> None:
+        """Mirror the store's broadcast stream into the replay history."""
+        store = self.api.store(kind)
+        codec = self.codecs[kind]
+        q = store.watch()
+        try:
+            while not self._stop.is_set():
+                try:
+                    event = q.get(timeout=0.2)
+                except Exception:
+                    continue
+                self._states[kind].append(
+                    event.type, codec.to_wire(event.obj),
+                    event.resource_version)
+        finally:
+            store.stop_watch(q)
+
+    # -- request handling ----------------------------------------------
+
+    def _resolve(self, path: str):
+        """Path -> (kind, codec, namespace, name, subresource)."""
+        for (prefix, plural), kind in self._routes.items():
+            if not path.startswith(prefix + "/"):
+                continue
+            rest = path[len(prefix):].strip("/").split("/")
+            # {plural} | namespaces/{ns}/{plural}[/{name}[/{sub}]]
+            if rest[0] == plural and len(rest) == 1:
+                return kind, self.codecs[kind], None, None, ""
+            if (len(rest) >= 3 and rest[0] == "namespaces"
+                    and rest[2] == plural):
+                ns = rest[1]
+                name = rest[3] if len(rest) > 3 else None
+                sub = rest[4] if len(rest) > 4 else ""
+                return kind, self.codecs[kind], ns, name, sub
+        return None
+
+    def handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
+        parsed = urlparse(req.path)
+        route = self._resolve(parsed.path)
+        if route is None:
+            self._respond(req, 404, {"message": f"no route {parsed.path}"})
+            return
+        kind, codec, ns, name, sub = route
+        query = parse_qs(parsed.query)
+        try:
+            if method == "GET" and name is None:
+                if query.get("watch", ["false"])[0] == "true":
+                    self._serve_watch(req, kind, codec, query)
+                else:
+                    self._serve_list(req, kind, codec, ns)
+            elif method == "GET":
+                obj = self.api.store(kind).get(ns, name)
+                self._respond(req, 200, codec.to_wire(obj))
+            elif method == "POST" and name is None:
+                body = self._read_body(req)
+                obj = codec.from_wire(body)
+                if ns is not None:
+                    obj.metadata.namespace = ns
+                created = self.api.store(kind).create(obj)
+                self._respond(req, 201, codec.to_wire(created))
+            elif method == "PUT" and name is not None:
+                body = self._read_body(req)
+                obj = codec.from_wire(body)
+                obj.metadata.namespace, obj.metadata.name = ns, name
+                updated = self.api.store(kind).update(
+                    obj, status_only=(sub == "status"))
+                self._respond(req, 200, codec.to_wire(updated))
+            elif method == "DELETE" and name is not None:
+                self.api.store(kind).delete(ns, name)
+                self._respond(req, 200, {"status": "Success"})
+            else:
+                self._respond(req, 405,
+                              {"message": f"{method} not allowed"})
+        except NotFoundError as e:
+            self._respond(req, 404, {"message": str(e)})
+        except ConflictError as e:
+            self._respond(req, 409, {"message": str(e)})
+        except AdmissionDeniedError as e:
+            self._respond(req, getattr(e, "code", 403),
+                          {"message": str(e)})
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-stream
+        except Exception as e:  # surface as 500 rather than killing the conn
+            logger.exception("rest handler error")
+            self._respond(req, 500, {"message": f"{type(e).__name__}: {e}"})
+
+    @staticmethod
+    def _read_body(req) -> dict:
+        length = int(req.headers.get("Content-Length", 0))
+        return json.loads(req.rfile.read(length) or b"{}")
+
+    def _respond(self, req, code: int, payload: dict) -> None:
+        try:
+            body = json.dumps(payload).encode()
+            req.send_response(code)
+            req.send_header("Content-Type", "application/json")
+            req.send_header("Content-Length", str(len(body)))
+            req.end_headers()
+            req.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _serve_list(self, req, kind: str, codec: Codec,
+                    ns: Optional[str]) -> None:
+        items = self.api.store(kind).list(ns)
+        rv = max([o.metadata.resource_version for o in items]
+                 + [self._states[kind].last_rv])
+        self._respond(req, 200, {
+            "apiVersion": "v1",
+            "kind": f"{kind}List",
+            "metadata": {"resourceVersion": str(rv)},
+            "items": [codec.to_wire(o) for o in items],
+        })
+
+    def _serve_watch(self, req, kind: str, codec: Codec, query) -> None:
+        state = self._states[kind]
+        try:
+            rv = int(query.get("resourceVersion", ["0"])[0])
+        except ValueError:
+            rv = 0
+        oldest = state.oldest_rv()
+        if rv and oldest and rv < oldest - 1:
+            # resume point fell out of the replay window
+            self._stream_headers(req)
+            self._write_line(req, {
+                "type": "ERROR",
+                "object": {"kind": "Status", "code": 410,
+                           "message": "too old resource version"},
+            })
+            return
+        self._stream_headers(req)
+        try:
+            while not self._stop.is_set():
+                with state.cond:
+                    pending = [(erv, etype, wire)
+                               for erv, etype, wire in state.history
+                               if erv > rv]
+                    if not pending:
+                        state.cond.wait(timeout=1.0)
+                        continue
+                for erv, etype, wire in pending:
+                    self._write_line(req, {"type": etype, "object": wire})
+                    rv = erv
+        except (BrokenPipeError, ConnectionResetError):
+            return
+
+    @staticmethod
+    def _stream_headers(req) -> None:
+        req.send_response(200)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Transfer-Encoding", "chunked")
+        req.end_headers()
+        req.wfile = _ChunkedWriter(req.wfile)
+
+    @staticmethod
+    def _write_line(req, payload: dict) -> None:
+        req.wfile.write(json.dumps(payload).encode() + b"\n")
+        req.wfile.flush()
+
+
+class _ChunkedWriter:
+    """Encode writes as HTTP/1.1 chunks (BaseHTTPRequestHandler does
+    not chunk automatically).  Implements enough of the file interface
+    for socketserver's handler teardown (closed/close/flush)."""
+
+    def __init__(self, raw):
+        self._raw = raw
+
+    def write(self, data: bytes) -> int:
+        self._raw.write(f"{len(data):x}\r\n".encode())
+        self._raw.write(data)
+        self._raw.write(b"\r\n")
+        return len(data)
+
+    def flush(self) -> None:
+        self._raw.flush()
+
+    @property
+    def closed(self) -> bool:
+        return self._raw.closed
+
+    def close(self) -> None:
+        try:
+            self._raw.write(b"0\r\n\r\n")  # terminating chunk
+            self._raw.flush()
+        except (OSError, ValueError):
+            pass
+        self._raw.close()

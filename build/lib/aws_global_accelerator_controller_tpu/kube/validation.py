@@ -1,0 +1,102 @@
+"""OpenAPI v3 schema validation (the CRD structural-schema analogue).
+
+The real API server validates custom resources against the CRD's
+openAPIV3Schema; the fake API server wires this validator for
+EndpointGroupBinding using the SAME schema codegen emits to config/crd/
+(single source of truth).  Supports the subset the CRD uses: type,
+required, properties, items, nullable.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+
+class InvalidObjectError(Exception):
+    """Schema-invalid object (the apiserver's 422 Invalid analogue)."""
+
+    def __init__(self, errors: List[str]):
+        super().__init__("; ".join(errors))
+        self.errors = errors
+
+
+_TYPE_CHECKS = {
+    "string": lambda v: isinstance(v, str),
+    "boolean": lambda v: isinstance(v, bool),
+    "integer": lambda v: isinstance(v, int) and not isinstance(v, bool),
+    "array": lambda v: isinstance(v, list),
+    "object": lambda v: isinstance(v, dict),
+}
+
+
+def _validate(value: Any, schema: Dict[str, Any], path: str,
+              errors: List[str]) -> None:
+    if value is None:
+        if schema.get("nullable"):
+            return
+        errors.append(f"{path}: null not allowed")
+        return
+    expected = schema.get("type")
+    if expected:
+        check = _TYPE_CHECKS.get(expected)
+        if check and not check(value):
+            errors.append(
+                f"{path}: expected {expected}, got {type(value).__name__}")
+            return
+    if expected == "object":
+        props = schema.get("properties", {})
+        for req in schema.get("required", []):
+            # OpenAPI/Kubernetes `required` is key PRESENCE only -- an
+            # empty string satisfies it (rejecting that needs minLength)
+            if req not in value or value.get(req) is None:
+                errors.append(f"{path}.{req}: required")
+        for key, sub in props.items():
+            if key in value:
+                _validate(value[key], sub, f"{path}.{key}", errors)
+        for key, sub in props.items():
+            if key in value and sub.get("minLength") is not None:
+                if isinstance(value[key], str) and (
+                        len(value[key]) < sub["minLength"]):
+                    errors.append(f"{path}.{key}: shorter than minLength "
+                                  f"{sub['minLength']}")
+    elif expected == "array":
+        item_schema = schema.get("items")
+        if item_schema:
+            for i, item in enumerate(value):
+                _validate(item, item_schema, f"{path}[{i}]", errors)
+
+
+def validate_against_schema(obj_dict: Dict[str, Any],
+                            schema: Dict[str, Any]) -> None:
+    """Raise InvalidObjectError when obj_dict violates the openAPIV3Schema."""
+    errors: List[str] = []
+    _validate(obj_dict, schema, "$", errors)
+    if errors:
+        raise InvalidObjectError(errors)
+
+
+def _egb_schema() -> Dict[str, Any]:
+    from ..codegen import endpoint_group_binding_crd
+
+    crd = endpoint_group_binding_crd()
+    return crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+
+
+def endpoint_group_binding_validator():
+    """Schema validator for typed objects (store-level enforcement)."""
+    schema = _egb_schema()
+
+    def validate(obj) -> None:
+        validate_against_schema(obj.to_dict(), schema)
+
+    return validate
+
+
+def endpoint_group_binding_raw_validator():
+    """Schema validator for raw manifest dicts (apply-path enforcement --
+    the typed round-trip would default missing fields away)."""
+    schema = _egb_schema()
+
+    def validate(doc: Dict[str, Any]) -> None:
+        validate_against_schema(doc, schema)
+
+    return validate

@@ -1,0 +1,263 @@
+"""Rate-limited delaying workqueue (client-go util/workqueue analogue).
+
+The reference uses ``workqueue.NewNamedRateLimitingQueue`` with the default
+controller rate limiter (per-item exponential backoff 5ms..1000s combined
+with an overall 10qps/100burst token bucket) -- e.g.
+pkg/controller/globalaccelerator/controller.go:64-65.  This module
+implements the same semantics natively:
+
+- client-go dedup invariants: an item is queued at most once; adds during
+  processing are deferred until ``done`` (dirty/processing sets);
+- ``add_after`` delaying adds via a heap + waker thread;
+- ``add_rate_limited`` with per-item exponential backoff and a global
+  token bucket, ``forget`` to reset an item's failure count;
+- ``shutdown`` drains waiters.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class ItemExponentialFailureRateLimiter:
+    """Per-item exponential backoff: base * 2^failures, capped.
+
+    client-go default: 5ms base, 1000s cap.
+    """
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Any) -> float:
+        with self._lock:
+            failures = self._failures.get(item, 0)
+            self._failures[item] = failures + 1
+        delay = self.base_delay * (2 ** failures)
+        return min(delay, self.max_delay)
+
+    def forget(self, item: Any) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Any) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class BucketRateLimiter:
+    """Global token bucket (client-go default: 10 qps, burst 100)."""
+
+    def __init__(self, qps: float = 10.0, burst: int = 100):
+        self.qps = qps
+        self.burst = burst
+        self._tokens = float(burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def when(self, item: Any) -> float:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return 0.0
+            deficit = 1.0 - self._tokens
+            self._tokens -= 1.0
+            return deficit / self.qps
+
+    def forget(self, item: Any) -> None:  # token buckets don't track items
+        pass
+
+    def num_requeues(self, item: Any) -> int:
+        return 0
+
+
+class MaxOfRateLimiter:
+    """Max of several limiters (client-go DefaultControllerRateLimiter)."""
+
+    def __init__(self, *limiters):
+        self.limiters = limiters
+
+    def when(self, item: Any) -> float:
+        return max(l.when(item) for l in self.limiters)
+
+    def forget(self, item: Any) -> None:
+        for l in self.limiters:
+            l.forget(item)
+
+    def num_requeues(self, item: Any) -> int:
+        return max(l.num_requeues(item) for l in self.limiters)
+
+
+def default_controller_rate_limiter(qps: float = 10.0,
+                                    burst: int = 100) -> MaxOfRateLimiter:
+    """client-go defaults (10 qps / 100 burst); tunable for large fleets
+    where the global bucket, not reconcile work, becomes the throughput
+    ceiling."""
+    return MaxOfRateLimiter(
+        ItemExponentialFailureRateLimiter(0.005, 1000.0),
+        BucketRateLimiter(qps, burst),
+    )
+
+
+def new_rate_limiting_queue(name: str = "", qps: float = 10.0,
+                            burst: int = 100):
+    """Build the best available queue with default-controller-limiter
+    semantics.
+
+    Prefers the native C++ implementation (kube/native_workqueue.py —
+    blocking get() parks worker threads outside the GIL) and falls back to
+    the pure-Python :class:`RateLimitingQueue`.  ``AGAC_NATIVE_WORKQUEUE``
+    overrides: ``0`` forces Python, ``1`` requires native (raises if the
+    toolchain is missing), unset/``auto`` picks automatically.
+    """
+    import os
+    pref = os.environ.get("AGAC_NATIVE_WORKQUEUE", "auto").lower()
+    if pref not in ("0", "false", "off"):
+        try:
+            from .native_workqueue import NativeRateLimitingQueue, \
+                native_available
+            if native_available():
+                return NativeRateLimitingQueue(name=name, qps=qps,
+                                               burst=burst)
+            if pref in ("1", "true", "on"):
+                raise RuntimeError(
+                    "AGAC_NATIVE_WORKQUEUE=1 but the native library could "
+                    "not be built (is g++ installed?)")
+        except ImportError:
+            if pref in ("1", "true", "on"):
+                raise
+    return RateLimitingQueue(
+        rate_limiter=default_controller_rate_limiter(qps, burst), name=name)
+
+
+class RateLimitingQueue:
+    """client-go RateLimitingInterface semantics.
+
+    Invariants (mirroring client-go's Type):
+    - ``dirty`` holds items that need processing; an item already dirty is
+      not re-added (dedup).
+    - ``processing`` holds items currently handed to a worker; re-adding a
+      processing item marks it dirty and it is re-queued on ``done``.
+    """
+
+    def __init__(self, rate_limiter=None, name: str = ""):
+        self.name = name
+        self._rate_limiter = rate_limiter or default_controller_rate_limiter()
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._shutting_down = False
+        # delaying queue state
+        self._waiting: List[Tuple[float, int, Any]] = []
+        self._waiting_seq = 0
+        self._waker = threading.Thread(target=self._wait_loop, daemon=True,
+                                       name=f"workqueue-waker-{name}")
+        self._waker.start()
+
+    # -- base queue -----------------------------------------------------
+
+    def add(self, item: Any) -> None:
+        with self._cond:
+            if self._shutting_down:
+                return
+            if item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None):
+        """Block until an item is available; returns (item, shutdown)."""
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._queue and not self._shutting_down:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None, False
+                self._cond.wait(remaining)
+            if not self._queue:
+                # shutting down and drained
+                return None, True
+            item = self._queue.popleft()
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item, False
+
+    def done(self, item: Any) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutting_down = True
+            self._cond.notify_all()
+
+    @property
+    def shutting_down(self) -> bool:
+        with self._cond:
+            return self._shutting_down
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # -- delaying -------------------------------------------------------
+
+    def add_after(self, item: Any, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutting_down:
+                return
+            self._waiting_seq += 1
+            heapq.heappush(self._waiting,
+                           (time.monotonic() + delay, self._waiting_seq, item))
+            self._cond.notify_all()
+
+    def _wait_loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._shutting_down and not self._waiting:
+                    return
+                now = time.monotonic()
+                while self._waiting and self._waiting[0][0] <= now:
+                    _, _, item = heapq.heappop(self._waiting)
+                    if item not in self._dirty:
+                        self._dirty.add(item)
+                        if item not in self._processing:
+                            self._queue.append(item)
+                            self._cond.notify()
+                if self._shutting_down:
+                    return
+                timeout = 0.2
+                if self._waiting:
+                    timeout = min(timeout, max(0.0, self._waiting[0][0] - now))
+                self._cond.wait(timeout if timeout > 0 else 0.01)
+
+    # -- rate limited ---------------------------------------------------
+
+    def add_rate_limited(self, item: Any) -> None:
+        self.add_after(item, self._rate_limiter.when(item))
+
+    def forget(self, item: Any) -> None:
+        self._rate_limiter.forget(item)
+
+    def num_requeues(self, item: Any) -> int:
+        return self._rate_limiter.num_requeues(item)

@@ -1,0 +1,13 @@
+"""Lease-based leader election (reference pkg/leaderelection/leaderelection.go).
+
+Active/standby replica coordination over a coordination/v1 Lease object:
+- 60s lease duration / 15s renew deadline / 5s retry period
+  (leaderelection.go:61-63), all injectable for tests;
+- uuid identity per candidate;
+- ReleaseOnCancel semantics: a clean stop clears holderIdentity so the
+  next candidate acquires immediately;
+- on lost leadership the ``on_stopped_leading`` callback fires (the
+  reference calls os.Exit(0) there -- the CLI wires that, the library
+  does not).
+"""
+from .elector import LeaderElection  # noqa: F401

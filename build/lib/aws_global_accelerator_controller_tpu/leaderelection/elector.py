@@ -1,0 +1,142 @@
+"""Lease lock + elector loop (client-go tools/leaderelection analogue)."""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from ..errors import ConflictError, NotFoundError
+from ..kube.client import KubeClient
+from ..kube.objects import Lease, LeaseSpec, ObjectMeta
+
+logger = logging.getLogger(__name__)
+
+# Reference timings (pkg/leaderelection/leaderelection.go:61-63).
+LEASE_DURATION = 60.0
+RENEW_DEADLINE = 15.0
+RETRY_PERIOD = 5.0
+
+
+class LeaderElection:
+    """One candidate for a named Lease in a namespace."""
+
+    def __init__(self, name: str, namespace: str, kube_client: KubeClient,
+                 lease_duration: float = LEASE_DURATION,
+                 renew_deadline: float = RENEW_DEADLINE,
+                 retry_period: float = RETRY_PERIOD,
+                 identity: Optional[str] = None):
+        self.name = name
+        self.namespace = namespace
+        self.kube = kube_client
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.identity = identity or str(uuid.uuid4())
+        self.is_leader = threading.Event()
+        self._observed_holder = ""
+
+    # -- lock primitives ------------------------------------------------
+
+    def _try_acquire_or_renew(self) -> bool:
+        """One CAS attempt against the Lease object."""
+        now = time.time()
+        try:
+            lease = self.kube.leases.get(self.namespace, self.name)
+        except NotFoundError:
+            lease = Lease(
+                metadata=ObjectMeta(name=self.name, namespace=self.namespace),
+                spec=LeaseSpec(
+                    holder_identity=self.identity,
+                    lease_duration_seconds=int(self.lease_duration),
+                    acquire_time=now, renew_time=now, lease_transitions=0))
+            try:
+                self.kube.leases.create(lease)
+                return True
+            except ConflictError:
+                return False
+
+        holder = lease.spec.holder_identity
+        if holder and holder != self.identity:
+            if now < lease.spec.renew_time + self.lease_duration:
+                if holder != self._observed_holder:
+                    logger.info("new leader elected: %s", holder)
+                    self._observed_holder = holder
+                return False
+            logger.info("lease expired (holder %s), taking over", holder)
+
+        taking_over = holder != self.identity
+        lease.spec.holder_identity = self.identity
+        lease.spec.renew_time = now
+        if taking_over:
+            lease.spec.acquire_time = now
+            lease.spec.lease_transitions += 1
+        try:
+            self.kube.leases.update(lease)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    def _release(self) -> None:
+        """ReleaseOnCancel (leaderelection.go:59)."""
+        try:
+            lease = self.kube.leases.get(self.namespace, self.name)
+            if lease.spec.holder_identity == self.identity:
+                lease.spec.holder_identity = ""
+                self.kube.leases.update(lease)
+        except Exception:
+            logger.debug("lease release failed", exc_info=True)
+
+    # -- elector loop ---------------------------------------------------
+
+    def run(self, stop: threading.Event,
+            on_started_leading: Callable[[threading.Event], None],
+            on_stopped_leading: Optional[Callable[[], None]] = None) -> None:
+        """Block until stop; while leading, renew the lease in the
+        background and run ``on_started_leading(stop)`` in a worker.
+
+        The run callback receives a *leader* stop event that is set when
+        either the process stops or leadership is lost
+        (leaderelection.go:58-82).
+        """
+        logger.info("leader election id: %s", self.identity)
+        try:
+            while not stop.is_set():
+                if self._try_acquire_or_renew():
+                    self._lead(stop, on_started_leading, on_stopped_leading)
+                    return
+                stop.wait(self.retry_period)
+        finally:
+            if self.is_leader.is_set():
+                self._release()
+
+    def _lead(self, stop, on_started_leading, on_stopped_leading) -> None:
+        logger.info("became leader: %s", self.identity)
+        self.is_leader.set()
+        leader_stop = threading.Event()
+
+        runner = threading.Thread(
+            target=on_started_leading, args=(leader_stop,), daemon=True,
+            name="leader-run")
+        runner.start()
+
+        last_renew = time.monotonic()
+        try:
+            while not stop.is_set():
+                if self._try_acquire_or_renew():
+                    last_renew = time.monotonic()
+                elif time.monotonic() - last_renew > self.renew_deadline:
+                    logger.warning("leader lost: %s", self.identity)
+                    self.is_leader.clear()
+                    leader_stop.set()
+                    if on_stopped_leading is not None:
+                        on_stopped_leading()
+                    return
+                stop.wait(self.retry_period)
+        finally:
+            leader_stop.set()
+            if self.is_leader.is_set():
+                self.is_leader.clear()
+                self._release()
+            runner.join(timeout=2.0)

@@ -1,0 +1,6 @@
+"""Manager: controller registry + lifecycle (reference pkg/manager/)."""
+from .manager import (  # noqa: F401
+    ControllerConfig,
+    Manager,
+    new_controller_initializers,
+)

@@ -1,0 +1,136 @@
+"""Controller registry and lifecycle.
+
+Mirrors reference pkg/manager/manager.go:28-77: builds the clients and two
+shared informer factories (30s resync, manager.go:52-53), starts each
+registered controller init func in its own thread, starts the informer
+factories, and waits for all controllers to finish.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from ..cloudprovider.aws.factory import CloudFactory
+from ..controller.endpointgroupbinding import (
+    EndpointGroupBindingConfig,
+    EndpointGroupBindingController,
+)
+from ..controller.globalaccelerator import (
+    GlobalAcceleratorConfig,
+    GlobalAcceleratorController,
+)
+from ..controller.route53 import Route53Config, Route53Controller
+from ..kube.client import KubeClient, OperatorClient
+from ..kube.informers import SharedInformerFactory
+
+logger = logging.getLogger(__name__)
+
+RESYNC_PERIOD = 30.0  # manager.go:52-53
+
+
+@dataclass
+class ControllerConfig:
+    global_accelerator: GlobalAcceleratorConfig = field(
+        default_factory=GlobalAcceleratorConfig)
+    route53: Route53Config = field(default_factory=Route53Config)
+    endpoint_group_binding: EndpointGroupBindingConfig = field(
+        default_factory=EndpointGroupBindingConfig)
+
+
+InitFunc = Callable[..., threading.Thread]
+
+
+def _start_global_accelerator(kube, operator, informer_factory,
+                              cloud_factory, config, stop):
+    """(reference pkg/manager/globalaccelerator.go:12-19)"""
+    controller = GlobalAcceleratorController(
+        kube, informer_factory, cloud_factory, config.global_accelerator)
+    t = threading.Thread(target=controller.run, args=(stop,), daemon=True,
+                         name="global-accelerator-controller")
+    t.start()
+    return t
+
+
+def _start_route53(kube, operator, informer_factory, cloud_factory, config,
+                   stop):
+    """(reference pkg/manager/route53.go:12-19)"""
+    controller = Route53Controller(
+        kube, informer_factory, cloud_factory, config.route53)
+    t = threading.Thread(target=controller.run, args=(stop,), daemon=True,
+                         name="route53-controller")
+    t.start()
+    return t
+
+
+def _start_endpoint_group_binding(kube, operator, informer_factory,
+                                  cloud_factory, config, stop):
+    """(reference pkg/manager/endpointgroupbinding_controller.go:11-18)"""
+    controller = EndpointGroupBindingController(
+        kube, operator, informer_factory, cloud_factory,
+        config.endpoint_group_binding)
+    t = threading.Thread(target=controller.run, args=(stop,), daemon=True,
+                         name="endpoint-group-binding-controller")
+    t.start()
+    return t
+
+
+def new_controller_initializers() -> Dict[str, InitFunc]:
+    """(reference manager.go:34-40)"""
+    return {
+        "global-accelerator-controller": _start_global_accelerator,
+        "route53-controller": _start_route53,
+        "endpoint-group-binding-controller": _start_endpoint_group_binding,
+    }
+
+
+class ManagerHandle:
+    """Running manager: informer factory + controller threads.
+
+    ``join`` is the graceful-shutdown tail: after ``stop`` is set, waits
+    for each controller's run() to drain its queues and join its workers
+    (the wg.Wait() of reference manager.go:74).
+    """
+
+    def __init__(self, informer_factory: SharedInformerFactory, threads):
+        self.informer_factory = informer_factory
+        self.threads = threads
+
+    def informers_synced(self) -> bool:
+        return all(inf.has_synced()
+                   for inf in self.informer_factory._informers.values())
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        for t in self.threads:
+            t.join(timeout)
+
+
+class Manager:
+    def __init__(self, resync_period: float = RESYNC_PERIOD):
+        self.resync_period = resync_period
+
+    def run(self, kube_client: KubeClient, operator_client: OperatorClient,
+            cloud_factory: CloudFactory, config: ControllerConfig,
+            stop: threading.Event,
+            initializers: Optional[Dict[str, InitFunc]] = None,
+            block: bool = True) -> ManagerHandle:
+        """(reference manager.go:42-77)"""
+        informer_factory = SharedInformerFactory(
+            kube_client.api, resync_period=self.resync_period)
+
+        threads = []
+        for name, init_fn in (initializers
+                              or new_controller_initializers()).items():
+            logger.info("starting %s", name)
+            threads.append(init_fn(kube_client, operator_client,
+                                   informer_factory, cloud_factory, config,
+                                   stop))
+            logger.info("started %s", name)
+
+        informer_factory.start(stop)
+
+        handle = ManagerHandle(informer_factory, threads)
+        if block:
+            handle.join()
+        return handle

@@ -1,0 +1,211 @@
+"""Observability: metrics registry + controller health/metrics HTTP server.
+
+The reference has NO metrics endpoint and no health endpoint on the
+controller binary (SURVEY.md §5: "No Prometheus metrics endpoint ...
+controller binary has no health/readiness endpoint") -- this module is the
+deliberate improvement SURVEY.md §7 calls for.
+
+Prometheus text exposition (no client library dependency):
+- ``controller_sync_total{queue,result}`` counter
+- ``controller_sync_duration_seconds{queue}`` summary (sum + count)
+- ``workqueue_depth{queue}`` gauge (sampled at scrape)
+- ``leader{name}`` gauge
+
+Endpoints: /healthz (liveness, always 200), /readyz (readiness via
+registered probes), /metrics.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import defaultdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger(__name__)
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple], float] = defaultdict(float)
+        self._summaries: Dict[Tuple[str, Tuple], Tuple[float, int]] = {}
+        self._gauge_fns: List[Tuple[str, Tuple, Callable[[], float]]] = []
+        self._help: Dict[str, str] = {}
+
+    def describe(self, name: str, help_text: str) -> None:
+        self._help[name] = help_text
+
+    def inc_counter(self, name: str, labels: Dict[str, str],
+                    value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[(name, tuple(sorted(labels.items())))] += value
+
+    def observe_summary(self, name: str, labels: Dict[str, str],
+                        value: float) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            s, c = self._summaries.get(key, (0.0, 0))
+            self._summaries[key] = (s + value, c + 1)
+
+    def register_gauge(self, name: str, labels: Dict[str, str],
+                       fn: Callable[[], float]) -> None:
+        """Re-registering the same (name, labels) replaces the callback --
+        a restarted controller must not duplicate series or keep dead
+        queues alive."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauge_fns = [g for g in self._gauge_fns
+                               if (g[0], g[1]) != key]
+            self._gauge_fns.append((key[0], key[1], fn))
+
+    @staticmethod
+    def _fmt_labels(labels: Tuple) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        return "{" + inner + "}"
+
+    def render(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            counters = dict(self._counters)
+            summaries = dict(self._summaries)
+            gauges = list(self._gauge_fns)
+            helps = dict(self._help)
+
+        seen_help = set()
+
+        def emit_help(name: str, mtype: str):
+            if name not in seen_help:
+                seen_help.add(name)
+                if name in helps:
+                    lines.append(f"# HELP {name} {helps[name]}")
+                lines.append(f"# TYPE {name} {mtype}")
+
+        for (name, labels), value in sorted(counters.items()):
+            emit_help(name, "counter")
+            lines.append(f"{name}{self._fmt_labels(labels)} {value}")
+        for (name, labels), (s, c) in sorted(summaries.items()):
+            emit_help(name, "summary")
+            lines.append(f"{name}_sum{self._fmt_labels(labels)} {s}")
+            lines.append(f"{name}_count{self._fmt_labels(labels)} {c}")
+        for name, labels, fn in gauges:
+            emit_help(name, "gauge")
+            try:
+                value = fn()
+            except Exception:
+                continue
+            lines.append(f"{name}{self._fmt_labels(labels)} {value}")
+        return "\n".join(lines) + "\n"
+
+
+default_registry = Registry()
+default_registry.describe("controller_sync_total",
+                          "Reconcile outcomes per queue.")
+default_registry.describe("controller_sync_duration_seconds",
+                          "Reconcile handler durations per queue.")
+default_registry.describe("workqueue_depth", "Current queue depths.")
+
+
+def record_sync(queue_name: str, result: str, duration: float,
+                registry: Optional[Registry] = None) -> None:
+    reg = registry or default_registry
+    reg.inc_counter("controller_sync_total",
+                    {"queue": queue_name, "result": result})
+    reg.observe_summary("controller_sync_duration_seconds",
+                        {"queue": queue_name}, duration)
+
+
+def watch_queue_depth(queue, registry: Optional[Registry] = None) -> None:
+    reg = registry or default_registry
+    reg.register_gauge("workqueue_depth", {"queue": queue.name},
+                       lambda: float(len(queue)))
+
+
+class HealthServer:
+    """Controller /healthz + /readyz + /metrics endpoint."""
+
+    def __init__(self, port: int = 8081, registry: Optional[Registry] = None,
+                 host: str = ""):
+        self.registry = registry or default_registry
+        self._ready_probes: List[Tuple[str, Callable[[], bool]]] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.debug("health: " + fmt, *args)
+
+            def _respond(self, code, body, ctype="text/plain"):
+                body = body.encode() if isinstance(body, str) else body
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._respond(200, "ok")
+                elif self.path == "/readyz":
+                    failing = [name for name, probe in outer._ready_probes
+                               if not _safe(probe)]
+                    if failing:
+                        self._respond(503, "not ready: " + ",".join(failing))
+                    else:
+                        self._respond(200, "ok")
+                elif self.path == "/metrics":
+                    self._respond(200, outer.registry.render(),
+                                  "text/plain; version=0.0.4")
+                elif urlparse(self.path).path == "/traces":
+                    from .tracing import default_tracer
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = int(q.get("limit", ["100"])[0])
+                        if limit < 0:
+                            raise ValueError
+                    except ValueError:
+                        self._respond(
+                            400, "limit must be a non-negative integer")
+                        return
+                    spans = default_tracer.recent(
+                        # limit=0 means "everything buffered", same as
+                        # Tracer.recent's own contract
+                        limit=limit, name=q.get("name", [None])[0])
+                    self._respond(200, json.dumps({"spans": spans}),
+                                  "application/json")
+                else:
+                    self._respond(404, "not found")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def add_ready_probe(self, name: str, probe: Callable[[], bool]) -> None:
+        self._ready_probes.append((name, probe))
+
+    def start_background(self) -> None:
+        self._thread = threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.2),
+            daemon=True, name="health-server")
+        self._thread.start()
+        logger.info("health/metrics listening on :%d", self.port)
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+def _safe(probe: Callable[[], bool]) -> bool:
+    try:
+        return bool(probe())
+    except Exception:
+        return False

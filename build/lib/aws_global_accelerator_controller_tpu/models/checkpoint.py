@@ -1,0 +1,85 @@
+"""Checkpoint / resume for the TPU compute track (orbax-backed).
+
+The reference's "checkpointing" story is external-state colocation: AWS
+tags and Route53 TXT records let a restarted controller re-discover
+everything it manages (SURVEY.md §5 "Checkpoint / resume"; reference
+pkg/cloudprovider/aws/global_accelerator.go:24-28, route53.go:18-20).
+The controller side of this rebuild reproduces that design; this module
+is its analogue for the compute track — the traffic policy model's
+training state (params + optimizer state + step) persists through orbax
+so a restarted trainer resumes the exact trajectory.
+
+Restore goes through a template tree (a freshly-initialised
+params/opt_state of the same model config) so dtypes, shapes, and the
+optax NamedTuple structure survive the round-trip bit-exactly.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+
+from .traffic import Params, TrafficPolicyModel
+
+
+class TrainCheckpointer:
+    """Orbax CheckpointManager wrapper for (params, opt_state) trees.
+
+    ``directory`` is created if missing; ``max_to_keep`` bounds retained
+    steps (oldest garbage-collected, like the manager's default policy).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._mngr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, params: Params, opt_state: Any,
+             wait: bool = False) -> None:
+        self._mngr.save(step, args=self._ocp.args.StandardSave(
+            {"params": params, "opt_state": opt_state}))
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, model: TrafficPolicyModel,
+                step: Optional[int] = None) -> Tuple[int, Params, Any]:
+        """Restore (step, params, opt_state); ``step=None`` means latest.
+
+        The model provides the template tree — restoring into abstract
+        shape/dtype structs keeps bf16 params bf16 and rebuilds the
+        optax state NamedTuples instead of plain dicts.
+        """
+        if step is None:
+            step = self._mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self._mngr.directory}")
+        def template():
+            params = model.init_params(jax.random.PRNGKey(0))
+            return {"params": params,
+                    "opt_state": model.init_opt_state(params)}
+
+        # eval_shape: the abstract template costs no compute or HBM
+        abstract = jax.eval_shape(template)
+        restored = self._mngr.restore(
+            step, args=self._ocp.args.StandardRestore(abstract))
+        return step, restored["params"], restored["opt_state"]
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+    def __enter__(self) -> "TrainCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,49 @@
+"""Shared training machinery for the compute-track model families.
+
+One implementation of the masked cross-entropy and the Adam update so
+the families cannot drift apart (a fix to the eps guard or the
+valid-group normalisation lands in both).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def masked_ce_loss(scores: jax.Array, mask: jax.Array,
+                   target: jax.Array) -> jax.Array:
+    """Cross-entropy between masked_softmax(scores) and the target
+    weight distribution, averaged over groups with >=1 valid endpoint."""
+    from ..ops.weights import masked_softmax
+
+    p = masked_softmax(scores, mask)
+    eps = 1e-9
+    ce = -jnp.sum(jnp.where(mask, target * jnp.log(p + eps), 0.0),
+                  axis=-1)
+    valid = jnp.any(mask, axis=-1)
+    return jnp.sum(jnp.where(valid, ce, 0.0)) / jnp.maximum(
+        jnp.sum(valid), 1)
+
+
+class TrainableModel:
+    """Mixin: optimizer plumbing over a subclass-provided ``loss``.
+
+    Subclasses set ``self.optimizer`` (an optax transformation) and
+    implement ``loss(params, *data)``; ``train_step`` keeps whatever
+    data arity the family uses (batch, or window + batch).
+    """
+
+    optimizer: optax.GradientTransformation
+
+    def loss(self, params, *data) -> jax.Array:
+        raise NotImplementedError
+
+    def init_opt_state(self, params):
+        return self.optimizer.init(params)
+
+    def train_step(self, params, opt_state, *data):
+        loss, grads = jax.value_and_grad(self.loss)(params, *data)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        return optax.apply_updates(params, updates), opt_state, loss

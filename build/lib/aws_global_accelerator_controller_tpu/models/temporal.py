@@ -1,0 +1,153 @@
+"""Temporal traffic model: attention over telemetry history -> weights.
+
+Second model family of the compute track (the first, ``traffic.py``, is
+a stateless MLP over the latest telemetry snapshot).  This one consumes
+a telemetry *window* ``[T, G, E, F]`` and lets every endpoint attend
+causally over its own history before scoring, so slow-moving signals
+(capacity trends, flapping health) inform the weight plan.
+
+The attention mapping is TPU-exact: endpoints are independent of each
+other along the time axis, so the (G*E) endpoint streams ARE the
+attention heads — q = k = v = [T, G*E, D] feeds the same kernels the
+long-context stack provides, with zero reshuffling:
+
+- single chip: ``ops.pallas_attention.flash_attention`` (MXU-tiled);
+- sequence-sharded: ``parallel.make_ring_attention`` over a mesh axis
+  (ring over ICI; pass ``local="flash"`` for flash-in-VMEM inside).
+
+Everything is jittable with static shapes; bfloat16 on the matmuls,
+float32 accumulation (the kernels pin preferred_element_type).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops.weights import plan_weights
+from .common import TrainableModel, masked_ce_loss
+from .traffic import Batch
+
+Params = Dict[str, jax.Array]
+
+# Below this window length the dense reference out-runs the kernel:
+# even with auto-sized flash blocks (pallas_attention._auto_block) the
+# per-call dispatch and tiling overhead beats XLA's fused dense matmuls
+# for tiny T.  At/above it the kernel wins and the CLI defaults reach it.
+FLASH_MIN_WINDOW = 64
+
+
+class TemporalTrafficModel(TrainableModel):
+    """Causal self-attention per endpoint stream + MLP head.
+
+    feature_dim F -> embed_dim D per timestep, one causal attention pass
+    over the T axis, last-step representation -> score.
+    """
+
+    def __init__(self, feature_dim: int = 8, embed_dim: int = 32,
+                 hidden_dim: int = 64, learning_rate: float = 1e-3,
+                 attention: str = "flash"):
+        if attention not in ("flash", "flash_always", "reference"):
+            raise ValueError(f"unknown attention impl {attention!r}")
+        self.feature_dim = feature_dim
+        self.embed_dim = embed_dim
+        self.hidden_dim = hidden_dim
+        self.attention = attention
+        self.optimizer = optax.adam(learning_rate)
+
+    def init_params(self, key: jax.Array) -> Params:
+        ks = jax.random.split(key, 6)
+        f, d, h = self.feature_dim, self.embed_dim, self.hidden_dim
+        s = lambda fan_in: 1.0 / jnp.sqrt(fan_in)
+        init = lambda k, shape, fan: (
+            jax.random.normal(k, shape) * s(fan)).astype(jnp.bfloat16)
+        return {
+            "embed": init(ks[0], (f, d), f),
+            "wq": init(ks[1], (d, d), d),
+            "wk": init(ks[2], (d, d), d),
+            "wv": init(ks[3], (d, d), d),
+            "w1": init(ks[4], (d, h), d),
+            "b1": jnp.zeros((h,), jnp.bfloat16),
+            "w2": init(ks[5], (h, 1), h),
+            "b2": jnp.zeros((1,), jnp.bfloat16),
+        }
+
+    # -- forward --------------------------------------------------------
+
+    def _attend(self, q, k, v):
+        """q/k/v: [T, S, D] (S = G*E endpoint streams as heads).
+
+        The Pallas kernel carries a custom flash VJP, so BOTH the
+        serving forward and the training gradient run it — long-window
+        training gets the O(T) memory benefit the kernel exists for.
+        Dispatch:
+
+        - ``flash``: the kernel when T >= FLASH_MIN_WINDOW and running
+          on TPU.  Off-TPU the kernel only exists in interpret mode,
+          which serialises over the S heads — the dense reference is
+          orders of magnitude faster there.
+        - ``flash_always``: the kernel whenever T >= FLASH_MIN_WINDOW,
+          any backend — for tests proving the kernel path (forward AND
+          backward) end-to-end on the CPU mesh.
+        - ``reference``: always dense.
+        """
+        use_kernel = (q.shape[0] >= FLASH_MIN_WINDOW
+                      and (self.attention == "flash_always"
+                           or (self.attention == "flash"
+                               and jax.default_backend() == "tpu")))
+        if use_kernel:
+            from ..ops import pallas_attention
+            return pallas_attention.flash_attention(q, k, v, causal=True)
+        from ..parallel.ring_attention import attention_reference
+        return attention_reference(q, k, v, causal=True)
+
+    def scores(self, params: Params, window: jax.Array,
+               attend=None) -> jax.Array:
+        """[T, G, E, F] telemetry window -> [G, E] float32 scores.
+
+        ``attend`` overrides the attention impl with a fn(q, k, v:
+        [T, S, D]) -> [T, S, D] — the seam `parallel.plan.
+        ShardedTemporalPlanner` uses to swap in ring attention over a
+        sequence-sharded mesh.
+        """
+        attend = attend or self._attend
+        t, g, e, f = window.shape
+        x = window.astype(jnp.bfloat16).reshape(t, g * e, f)
+        emb = x @ params["embed"]                      # [T, S, D]
+        q, k, v = (emb @ params[w] for w in ("wq", "wk", "wv"))
+        attended = attend(q, k, v)                     # [T, S, D]
+        last = attended[-1].astype(jnp.bfloat16)       # [S, D]
+        hdn = jnp.maximum(last @ params["w1"] + params["b1"], 0)
+        out = hdn @ params["w2"] + params["b2"]
+        return out[:, 0].reshape(g, e).astype(jnp.float32)
+
+    def forward(self, params: Params, window: jax.Array,
+                mask: jax.Array, attend=None) -> jax.Array:
+        """[T, G, E, F] + [G, E] mask -> int32 GA weights [G, E]."""
+        return plan_weights(self.scores(params, window, attend), mask)
+
+    # -- training -------------------------------------------------------
+
+    def loss(self, params: Params, window: jax.Array, batch: Batch,
+             attend=None) -> jax.Array:
+        return masked_ce_loss(
+            self.scores(params, window, attend), batch.mask,
+            batch.target)
+
+
+def synthetic_window(key: jax.Array, steps: int = 8, groups: int = 16,
+                     endpoints: int = 8, feature_dim: int = 8):
+    """Random telemetry window + a target favouring endpoints whose
+    capacity signal trends up over the window."""
+    k1, k2 = jax.random.split(key)
+    window = jax.random.normal(
+        k1, (steps, groups, endpoints, feature_dim), dtype=jnp.float32)
+    mask = jax.random.bernoulli(k2, 0.85, (groups, endpoints))
+    trend = window[-1, ..., 0] - window[0, ..., 0]
+    raw = jnp.where(mask, jnp.exp(trend), 0.0)
+    denom = jnp.sum(raw, axis=-1, keepdims=True)
+    target = jnp.where(denom > 0, raw / jnp.maximum(denom, 1e-9), 0.0)
+    return window, Batch(features=window[-1].astype(jnp.bfloat16),
+                         mask=mask, target=target)

@@ -1,0 +1,99 @@
+"""Traffic policy model: endpoint telemetry -> endpoint weights.
+
+The flagship (and only) model of this framework.  A small MLP scores each
+endpoint from its telemetry features (health, latency, capacity, ...);
+``ops.weights.plan_weights`` turns scores into Global Accelerator weight
+allocations.  Everything is jittable with static shapes: inputs are
+[G, E, F] (groups x endpoints x features) in bfloat16 with a [G, E]
+validity mask.
+
+Design notes (TPU-first):
+- the two matmuls are over the whole [G*E, F] batch so XLA tiles them
+  onto the MXU; activations stay bfloat16, reductions in float32;
+- no data-dependent control flow; padded groups ride along masked;
+- ``train_step`` is pure (params, opt_state, batch) -> (params,
+  opt_state, loss) and shards over a mesh (see parallel.plan).
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops.weights import plan_weights
+from .common import TrainableModel, masked_ce_loss
+
+Params = Dict[str, jax.Array]
+
+FEATURE_DIM = 8
+HIDDEN_DIM = 128
+
+
+class Batch(NamedTuple):
+    features: jax.Array  # [G, E, F] bfloat16
+    mask: jax.Array      # [G, E] bool
+    target: jax.Array    # [G, E] float32 target weight distribution (sums to 1)
+
+
+class TrafficPolicyModel(TrainableModel):
+    def __init__(self, feature_dim: int = FEATURE_DIM,
+                 hidden_dim: int = HIDDEN_DIM,
+                 learning_rate: float = 1e-3):
+        self.feature_dim = feature_dim
+        self.hidden_dim = hidden_dim
+        self.optimizer = optax.adam(learning_rate)
+
+    def init_params(self, key: jax.Array) -> Params:
+        k1, k2, k3 = jax.random.split(key, 3)
+        f, h = self.feature_dim, self.hidden_dim
+        scale = lambda fan_in: 1.0 / jnp.sqrt(fan_in)
+        return {
+            "w1": (jax.random.normal(k1, (f, h)) * scale(f)).astype(jnp.bfloat16),
+            "b1": jnp.zeros((h,), jnp.bfloat16),
+            "w2": (jax.random.normal(k2, (h, h)) * scale(h)).astype(jnp.bfloat16),
+            "b2": jnp.zeros((h,), jnp.bfloat16),
+            "w3": (jax.random.normal(k3, (h, 1)) * scale(h)).astype(jnp.bfloat16),
+            "b3": jnp.zeros((1,), jnp.bfloat16),
+        }
+
+    # -- forward --------------------------------------------------------
+
+    def scores(self, params: Params, features: jax.Array) -> jax.Array:
+        """[G, E, F] -> [G, E] float32 scores (two MXU matmuls)."""
+        x = features.astype(jnp.bfloat16)
+        h = jnp.maximum(x @ params["w1"] + params["b1"], 0)
+        h = jnp.maximum(h @ params["w2"] + params["b2"], 0)
+        s = h @ params["w3"] + params["b3"]
+        return s[..., 0].astype(jnp.float32)
+
+    def forward(self, params: Params, features: jax.Array,
+                mask: jax.Array) -> jax.Array:
+        """[G, E, F] + mask -> int32 GA weights [G, E]."""
+        return plan_weights(self.scores(params, features), mask)
+
+    # -- training -------------------------------------------------------
+
+    def loss(self, params: Params, batch: Batch) -> jax.Array:
+        """Masked cross-entropy between the planned distribution and the
+        target weight distribution (shared impl: models/common.py)."""
+        return masked_ce_loss(self.scores(params, batch.features),
+                              batch.mask, batch.target)
+
+
+def synthetic_batch(key: jax.Array, groups: int = 64, endpoints: int = 32,
+                    feature_dim: int = FEATURE_DIM) -> Batch:
+    """Random fleet telemetry with a plausible target: weight ~ capacity
+    among healthy endpoints."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    features = jax.random.normal(k1, (groups, endpoints, feature_dim),
+                                 dtype=jnp.float32)
+    healthy = jax.random.bernoulli(k2, 0.9, (groups, endpoints))
+    mask = jax.random.bernoulli(k3, 0.8, (groups, endpoints))
+    capacity = jnp.exp(features[..., 0])
+    raw = jnp.where(mask & healthy, capacity, 0.0)
+    denom = jnp.sum(raw, axis=-1, keepdims=True)
+    target = jnp.where(denom > 0, raw / jnp.maximum(denom, 1e-9), 0.0)
+    return Batch(features=features.astype(jnp.bfloat16), mask=mask,
+                 target=target)

@@ -1,0 +1,77 @@
+"""Native (C++) runtime components.
+
+The compute path of this framework is JAX/XLA (``ops/``, ``parallel/``);
+this package holds the native runtime around it.  Today that is the
+rate-limited workqueue at the heart of the reconcile scheduler — the
+analogue of client-go's Go-native ``util/workqueue`` used by the reference
+(pkg/controller/globalaccelerator/controller.go:64-65).
+
+Libraries are compiled lazily from the shipped sources with ``g++`` on
+first use and cached next to the source; everything degrades gracefully to
+the pure-Python implementations when no toolchain is available.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_build_lock = threading.Lock()
+_LIB_SUFFIX = ".dylib" if sys.platform == "darwin" else ".so"
+
+
+def _lib_path(stem: str) -> str:
+    return os.path.join(_NATIVE_DIR, f"_{stem}{_LIB_SUFFIX}")
+
+
+def ensure_library(stem: str) -> Optional[str]:
+    """Compile ``<stem>.cpp`` into ``_<stem>.so`` if needed.
+
+    Returns the library path, or None when it cannot be built (no g++, or
+    compilation failed).  Rebuilds when the source is newer than the cached
+    library.  Safe under concurrent callers (in-process lock + atomic
+    rename for other processes).
+    """
+    src = os.path.join(_NATIVE_DIR, f"{stem}.cpp")
+    lib = _lib_path(stem)
+    if not os.path.exists(src):
+        return None
+    with _build_lock:
+        try:
+            if (os.path.exists(lib)
+                    and os.path.getmtime(lib) >= os.path.getmtime(src)):
+                return lib
+        except OSError:
+            pass
+        tmp = None
+        try:
+            # mkstemp inside the guard: an unwritable package dir (read-only
+            # site-packages) must degrade to the Python queue, not raise.
+            fd, tmp = tempfile.mkstemp(suffix=_LIB_SUFFIX, dir=_NATIVE_DIR)
+            os.close(fd)
+            cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
+                   "-pthread", src, "-o", tmp]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+            if proc.returncode != 0:
+                logger.warning("native build of %s failed:\n%s", stem,
+                               proc.stderr[-2000:])
+                os.unlink(tmp)
+                return None
+            os.replace(tmp, lib)
+            return lib
+        except (OSError, subprocess.SubprocessError) as exc:
+            logger.warning("native build of %s unavailable: %s", stem, exc)
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            return None

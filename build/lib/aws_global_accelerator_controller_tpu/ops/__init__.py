@@ -1,0 +1,12 @@
+"""TPU-native compute ops.
+
+The reference has no numeric compute whatsoever (SURVEY.md §2: 100% Go,
+all parallelism rows ABSENT), so nothing here ports reference code.  These
+ops map the controller domain's only numeric problems -- endpoint traffic
+weight planning and endpoint-set membership diffs -- onto batched, jittable
+kernels so that fleets of endpoint groups can be planned in one XLA
+program (used by ``models.traffic``, ``parallel.plan``, ``bench.py``, and
+``__graft_entry__.py``).
+"""
+from .weights import plan_weights, masked_softmax  # noqa: F401
+from .diff import membership_diff  # noqa: F401

@@ -1,0 +1,59 @@
+"""Batched endpoint-set membership diff.
+
+The EndpointGroupBinding controller's core computation is a set diff:
+desired LB ARNs vs status.endpointIds (reference
+pkg/controller/endpointgroupbinding/reconcile.go:143-159 -- two
+O(n^2) slices.Contains loops).  This op vectorizes the diff for a whole
+fleet of groups at once: identifiers are pre-hashed to int32, rows padded
+with ``EMPTY``, membership is sorted-search (O(E log E)) on the VPU, and
+the whole thing vmaps over groups into one fused XLA program.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Padding slot (ids are non-negative hashes).  A plain Python int, NOT
+# jnp.int32(-1): materialising a device array at import time would
+# initialise the JAX backend as a side effect of `import ops`, which
+# blocks module import whenever the tunneled TPU backend is unreachable.
+EMPTY = -1
+
+
+def _row_membership(row: jax.Array, table: jax.Array) -> jax.Array:
+    """For each element of ``row``, is it present in ``table``?
+    Both are 1-D int32 with EMPTY padding."""
+    order = jnp.argsort(table)
+    sorted_table = table[order]
+    idx = jnp.searchsorted(sorted_table, row)
+    idx = jnp.clip(idx, 0, table.shape[0] - 1)
+    found = sorted_table[idx] == row
+    return found & (row != EMPTY)
+
+
+@jax.jit
+def membership_diff(desired: jax.Array,
+                    current: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """desired [G, E], current [G, E] int32 (EMPTY-padded) ->
+    (to_add [G, E] bool over desired slots,
+     to_remove [G, E] bool over current slots).
+
+    A desired id absent from current must be added; a current id absent
+    from desired must be removed -- exactly the controller's
+    newEndpointIds/removedEndpointIds split.
+    """
+    in_current = jax.vmap(_row_membership)(desired, current)
+    in_desired = jax.vmap(_row_membership)(current, desired)
+    to_add = (~in_current) & (desired != EMPTY)
+    to_remove = (~in_desired) & (current != EMPTY)
+    return to_add, to_remove
+
+
+def hash_ids(ids) -> jax.Array:
+    """Host-side helper: stable non-negative int32 hashes for ARN strings
+    (31-bit CRC; int64 would need jax_enable_x64)."""
+    import zlib
+    return jnp.asarray([zlib.crc32(s.encode()) & 0x7FFFFFFF for s in ids],
+                       dtype=jnp.int32)

@@ -1,0 +1,518 @@
+"""Pallas flash attention: the single-chip hot kernel under ring attention.
+
+Blockwise softmax attention with the flash online recurrence, tiled for
+the MXU: the [T, T] score matrix is never materialised — each grid step
+computes one [Bq, Bk] score tile, rescales the running (max, denom,
+output) accumulators held in VMEM scratch, and only the final K step
+writes the normalised [Bq, D] output block to HBM.  Combined with
+``parallel.ring_attention`` (which rotates K/V blocks across chips) this
+gives the two-level long-context story: ring over ICI, flash within the
+chip.
+
+Layout: grid (heads, q_blocks, k_blocks), K innermost so the scratch
+accumulators persist across the K sweep for a fixed (head, q block).
+Causal masking uses global positions; K blocks strictly in the future of
+a Q block are skipped entirely (``pl.when``), saving ~half the FLOPs.
+Sequence and head dims pad to tile multiples outside the kernel; padded
+key positions are masked to -inf, padded query rows are sliced off.
+
+Runs in interpret mode off-TPU (tests compare against the dense oracle
+``parallel.ring_attention.attention_reference``), compiled on TPU
+(/opt/skills/guides/pallas_guide.md; float32 accumulation via
+preferred_element_type).
+
+Differentiable: ``flash_attention`` carries a ``jax.custom_vjp`` with
+the standard recompute-based flash backward — the forward saves only
+the normalised output and the per-row (m, l) softmax stats, and the
+backward re-materialises each [Bq, Bk] probability tile from them
+(p = exp(s - m)/l) in two sweeps: a K-innermost sweep accumulating dQ
+and a Q-innermost sweep accumulating dK/dV.  Memory stays O(T) like
+the forward; no [T, T] matrix ever exists in HBM.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANE = 128  # last-dim tile width; also the m/l scratch lane padding
+_SUBLANE = 16  # second-minor tile granularity (bf16-safe; 8 for f32)
+
+
+def _auto_block(t: int, block) -> int:
+    """Resolve a block size: ``None`` auto-sizes to the sequence so
+    short windows stop paying 128-wide tile padding — the smallest
+    sublane multiple covering T, capped at the 128 default."""
+    if block is not None:
+        return block
+    return min(128, -(-t // _SUBLANE) * _SUBLANE)
+
+
+def _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, *,
+                 causal: bool, scale: float, t: int, block_q: int,
+                 block_k: int):
+    """Shared online-softmax step: fold K block j into the (m, l, acc)
+    scratch for Q block i.  Callers add init/finalize around it."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: skip K blocks strictly in the future of this Q block
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32)          # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)          # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [Bq, Bk]
+
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        keep = k_pos < t  # padded key positions contribute nothing
+        if causal:
+            keep &= q_pos >= k_pos
+        s = jnp.where(keep, s, _NEG_INF)
+
+        m_prev = m_ref[:, 0]                      # [Bq]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])           # [Bq, Bk]
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(
+            (l_prev * alpha + p.sum(axis=1))[:, None], l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+            causal: bool, scale: float, t: int, block_q: int,
+            block_k: int, num_k: int):
+    _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                 causal=causal, scale=scale, t=t, block_q=block_q,
+                 block_k=block_k)
+
+    @pl.when(pl.program_id(2) == num_k - 1)
+    def _finalize():
+        # every live query row attended >=1 unmasked key, so l > 0
+        o_ref[0] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(o_ref.dtype)
+
+
+def _stats_kernel(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
+                  m_ref, l_ref, acc_ref, *, causal: bool, scale: float,
+                  t: int, block_q: int, block_k: int, num_k: int):
+    """Like _kernel but emits UNNORMALISED output plus the (m, l) softmax
+    stats, so a caller (ring attention) can merge blocks computed
+    elsewhere with the standard two-level flash recurrence."""
+    _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+                 causal=causal, scale=scale, t=t, block_q=block_q,
+                 block_k=block_k)
+
+    @pl.when(pl.program_id(2) == num_k - 1)
+    def _finalize():
+        o_ref[0] = acc_ref[:]
+        m_out_ref[0] = m_ref[:]
+        l_out_ref[0] = l_ref[:]
+
+
+def _pad_axis(x, axis, to):
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, to - x.shape[axis])
+    return jnp.pad(x, pad)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    t, h, d = q.shape
+    scale = d ** -0.5
+    tp_q = -(-t // block_q) * block_q
+    tp_k = -(-t // block_k) * block_k
+    dp = -(-d // _LANE) * _LANE
+
+    # [T, H, D] -> [H, T, D], padded to tile multiples
+    def prep(x, tp):
+        x = jnp.transpose(x, (1, 0, 2))
+        return _pad_axis(_pad_axis(x, 1, tp), 2, dp)
+
+    qp, kp, vp = prep(q, tp_q), prep(k, tp_k), prep(v, tp_k)
+    num_k = tp_k // block_k
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, causal=causal, scale=scale, t=t,
+                          block_q=block_q, block_k=block_k, num_k=num_k),
+        grid=(h, tp_q // block_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda hh, i, j: (hh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda hh, i, j: (hh, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda hh, i, j: (hh, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp),
+                               lambda hh, i, j: (hh, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((h, tp_q, dp), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # running max
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, dp), jnp.float32),      # running output
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return jnp.transpose(out[:, :t, :d], (1, 0, 2))
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False,
+                    block_q: "int | None" = None,
+                    block_k: "int | None" = None) -> jax.Array:
+    """q, k, v: [T, H, D] -> [T, H, D]; exact softmax attention.
+
+    Drop-in for ``parallel.ring_attention.attention_reference`` on one
+    chip; float32 accumulation regardless of input dtype.  Differentiable
+    (custom flash VJP) — safe under ``jax.grad`` without falling back to
+    a dense [T, T] materialisation.  ``block_q``/``block_k`` default to
+    auto-sizing against T (min(128, T rounded up to the sublane tile)),
+    so short windows don't pad to full 128-wide tiles.
+    """
+    interpret = jax.default_backend() != "tpu"
+    block_q = _auto_block(q.shape[0], block_q)
+    block_k = _auto_block(k.shape[0], block_k)
+    return _flash_diff(q, k, v, causal, block_q, block_k, interpret)
+
+
+# -- backward (custom VJP) --------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref, dq_ref,
+               dq_acc, *, causal: bool, scale: float, t: int,
+               block_q: int, block_k: int, num_k: int):
+    """K-innermost sweep: dQ_i = sum_j (p_ij * (dP_ij - D_i)) * scale @ K_j
+    with p re-materialised from the saved (m, l) row stats."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)          # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)          # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)        # [Bq, D]
+        m = m_ref[0][:, 0]                        # [Bq]
+        l = l_ref[0][:, 0]
+        dvec = d_ref[0][:, 0]                     # [Bq] rowsum(do*o)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [Bq, Bk]
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        keep = k_pos < t
+        if causal:
+            keep &= q_pos >= k_pos
+        s = jnp.where(keep, s, _NEG_INF)
+        # p is exact: exp(s - m)/l matches the forward's normalisation
+        p = jnp.exp(s - m[:, None]) / jnp.maximum(l, 1.0)[:, None]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [Bq, Bk]
+        ds = p * (dp - dvec[:, None]) * scale
+        dq_acc[:] = dq_acc[:] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                scale: float, t: int, block_q: int, block_k: int,
+                num_q: int):
+    """Q-innermost sweep: dV_j = sum_i p_ij^T @ dO_i and
+    dK_j = sum_i (p_ij * (dP_ij - D_i))^T @ Q_i * scale."""
+    j = pl.program_id(1)                          # K block
+    i = pl.program_id(2)                          # Q block (innermost)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)          # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)          # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)        # [Bq, D]
+        m = m_ref[0][:, 0]                        # [Bq]
+        l = l_ref[0][:, 0]
+        dvec = d_ref[0][:, 0]
+
+        # transposed score tile: s_T[kk, qq] = k_kk . q_qq * scale
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [Bk, Bq]
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0)
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1)
+        keep = k_pos < t
+        if causal:
+            keep &= q_pos >= k_pos
+        s_t = jnp.where(keep, s_t, _NEG_INF)
+        p_t = jnp.exp(s_t - m[None, :]) / jnp.maximum(l, 1.0)[None, :]
+        dv_acc[:] = dv_acc[:] + jnp.dot(
+            p_t, do, preferred_element_type=jnp.float32)
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [Bk, Bq]
+        ds_t = p_t * (dp_t - dvec[None, :]) * scale
+        dk_acc[:] = dk_acc[:] + jnp.dot(
+            ds_t, q, preferred_element_type=jnp.float32)
+
+    @pl.when(i == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def _flash_fwd_padded(q, k, v, causal, block_q, block_k, interpret):
+    """Head-major forward keeping the PADDED per-row stats for the VJP.
+
+    q/k/v: [H, T, D] -> (o [H, T, D] normalised f32, m [H, Tp, LANE],
+    l [H, Tp, LANE]) where Tp is T rounded up to block_q."""
+    h, t, d = q.shape
+    o_un, m, l = _flash_stats_padded(q, k, v, causal, block_q, block_k,
+                                     interpret)
+    o = o_un[:, :t, :d] / jnp.maximum(l[:, :t, :1], 1.0)
+    return o, m, l
+
+
+def _flash_stats_padded(q, k, v, causal, block_q, block_k, interpret):
+    """The pallas_call shared by _flash_stats (public, slices) and the
+    VJP forward (keeps padding).  Head-major [H, T, D] inputs."""
+    h, t, d = q.shape
+    t_k = k.shape[1]
+    scale = d ** -0.5
+    tp_q = -(-t // block_q) * block_q
+    tp_k = -(-t_k // block_k) * block_k
+    dp = -(-d // _LANE) * _LANE
+    qp = _pad_axis(_pad_axis(q, 1, tp_q), 2, dp)
+    kp = _pad_axis(_pad_axis(k, 1, tp_k), 2, dp)
+    vp = _pad_axis(_pad_axis(v, 1, tp_k), 2, dp)
+    num_k = tp_k // block_k
+
+    return pl.pallas_call(
+        functools.partial(_stats_kernel, causal=causal, scale=scale,
+                          t=t_k, block_q=block_q, block_k=block_k,
+                          num_k=num_k),
+        grid=(h, tp_q // block_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda hh, i, j: (hh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda hh, i, j: (hh, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda hh, i, j: (hh, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda hh, i, j: (hh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, tp_q, dp), jnp.float32),
+            jax.ShapeDtypeStruct((h, tp_q, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((h, tp_q, _LANE), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
+                      interpret):
+    """Head-major backward.  q/k/v/o/do: [H, T, D] (o, do f32); m/l:
+    [H, Tp, 1] stats saved by the forward (re-broadcast to the lane
+    width here, like dvec — residuals stay 1-lane).  Returns
+    (dq, dk, dv) [H, T, D] f32."""
+    h, t, d = q.shape
+    scale = d ** -0.5
+    tp_q = -(-t // block_q) * block_q
+    tp_k = -(-t // block_k) * block_k
+    dp = -(-d // _LANE) * _LANE
+    qp = _pad_axis(_pad_axis(q, 1, tp_q), 2, dp)
+    kp = _pad_axis(_pad_axis(k, 1, tp_k), 2, dp)
+    vp = _pad_axis(_pad_axis(v, 1, tp_k), 2, dp)
+    m = jnp.broadcast_to(m, (h, tp_q, _LANE))
+    l = jnp.broadcast_to(l, (h, tp_q, _LANE))
+    # padded dO rows are zero, so padded-Q contributions to dK/dV vanish
+    dop = _pad_axis(_pad_axis(do, 1, tp_q), 2, dp)
+    # D_i = rowsum(dO_i * O_i), lane-broadcast like the (m, l) stats
+    dvec = jnp.sum(do * o, axis=2)                          # [H, T]
+    dvec = jnp.broadcast_to(
+        _pad_axis(dvec, 1, tp_q)[:, :, None], (h, tp_q, _LANE))
+
+    num_q = tp_q // block_q
+    num_k = tp_k // block_k
+    qkv_spec = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale, t=t,
+                          block_q=block_q, block_k=block_k, num_k=num_k),
+        grid=(h, num_q, num_k),
+        in_specs=[
+            qkv_spec((1, block_q, dp), lambda hh, i, j: (hh, i, 0)),
+            qkv_spec((1, block_k, dp), lambda hh, i, j: (hh, j, 0)),
+            qkv_spec((1, block_k, dp), lambda hh, i, j: (hh, j, 0)),
+            qkv_spec((1, block_q, dp), lambda hh, i, j: (hh, i, 0)),
+            qkv_spec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0)),
+            qkv_spec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0)),
+            qkv_spec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0)),
+        ],
+        out_specs=qkv_spec((1, block_q, dp), lambda hh, i, j: (hh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, tp_q, dp), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, m, l, dvec)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale, t=t,
+                          block_q=block_q, block_k=block_k, num_q=num_q),
+        grid=(h, num_k, num_q),
+        in_specs=[
+            qkv_spec((1, block_q, dp), lambda hh, j, i: (hh, i, 0)),
+            qkv_spec((1, block_k, dp), lambda hh, j, i: (hh, j, 0)),
+            qkv_spec((1, block_k, dp), lambda hh, j, i: (hh, j, 0)),
+            qkv_spec((1, block_q, dp), lambda hh, j, i: (hh, i, 0)),
+            qkv_spec((1, block_q, _LANE), lambda hh, j, i: (hh, i, 0)),
+            qkv_spec((1, block_q, _LANE), lambda hh, j, i: (hh, i, 0)),
+            qkv_spec((1, block_q, _LANE), lambda hh, j, i: (hh, i, 0)),
+        ],
+        out_specs=[
+            qkv_spec((1, block_k, dp), lambda hh, j, i: (hh, j, 0)),
+            qkv_spec((1, block_k, dp), lambda hh, j, i: (hh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, tp_k, dp), jnp.float32),
+            jax.ShapeDtypeStruct((h, tp_k, dp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dp), jnp.float32),
+            pltpu.VMEM((block_k, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, m, l, dvec)
+
+    return (dq[:, :t, :d], dk[:, :t, :d], dv[:, :t, :d])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff(q, k, v, causal, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_diff_fwd(q, k, v, causal, block_q, block_k, interpret):
+    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    oh, m, l = _flash_fwd_padded(qh, kh, vh, causal, block_q, block_k,
+                                 interpret)
+    o = jnp.transpose(oh, (1, 0, 2)).astype(q.dtype)
+    # keep only lane 0 of the stats: residual memory stays O(T), not
+    # O(T * LANE) — the backward re-broadcasts
+    return o, (q, k, v, oh, m[:, :, :1], l[:, :, :1])
+
+
+def _flash_diff_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, oh, m, l = res
+    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    doh = jnp.transpose(do, (1, 0, 2)).astype(jnp.float32)
+    dq, dk, dv = _flash_bwd_padded(qh, kh, vh, oh, doh, m, l, causal,
+                                   block_q, block_k, interpret)
+    back = lambda g, x: jnp.transpose(g, (1, 0, 2)).astype(x.dtype)
+    return back(dq, q), back(dk, k), back(dv, v)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def _flash_stats(q, k, v, causal, block_q, block_k, interpret):
+    t, d = q.shape[1], q.shape[2]
+    o, m, l = _flash_stats_padded(q, k, v, causal, block_q, block_k,
+                                  interpret)
+    return o[:, :t, :d], m[:, :t, 0], l[:, :t, 0]
+
+
+def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
+                          causal: bool = False,
+                          block_q: "int | None" = None,
+                          block_k: "int | None" = None):
+    """Head-major flash attention returning merge-ready softmax stats.
+
+    q: [H, Tq, D], k/v: [H, Tk, D] -> (o_unnorm [H, Tq, D] f32,
+    m [H, Tq] f32, l [H, Tq] f32) where the normalised output would be
+    ``o_unnorm / l[..., None]``.  Two partial results over disjoint key
+    sets merge exactly with the flash recurrence:
+
+        m12 = max(m1, m2); a = exp(m1-m12); b = exp(m2-m12)
+        o12 = o1*a + o2*b;  l12 = l1*a + l2*b
+
+    which is how ``parallel.ring_attention`` (local='flash') folds the
+    K/V blocks arriving over the device ring.  ``causal`` here means
+    *relative* positions (q index >= k index) — the diagonal-block case.
+    """
+    interpret = jax.default_backend() != "tpu"
+    block_q = _auto_block(q.shape[1], block_q)
+    block_k = _auto_block(k.shape[1], block_k)
+    return _flash_stats(q, k, v, causal, block_q, block_k, interpret)

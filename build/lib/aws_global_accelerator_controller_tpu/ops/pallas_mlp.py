@@ -1,0 +1,105 @@
+"""Fused Pallas kernel: MLP endpoint scoring + weight planning in VMEM.
+
+The whole flagship forward pass -- three matmuls (MXU), two ReLUs, masked
+softmax, scale-to-255, round (VPU) -- fused into one kernel, one HBM
+round-trip per block of endpoint groups.  Equivalent to
+``TrafficPolicyModel.forward`` followed by ``ops.weights.plan_weights``.
+
+Block layout per grid step: a block of G_B groups, each with E endpoints
+of F features.  Rows flatten to [G_B*E, F] for the MXU matmuls (weights
+stay resident in VMEM across the grid); the softmax reshapes back to
+[G_B, E].  F and H pad to lane multiples outside the kernel; zero-padded
+feature columns multiply zero-padded weight rows, so padding does not
+perturb results.
+
+Runs in interpret mode off-TPU (tests), compiled on TPU
+(/opt/skills/guides/pallas_guide.md patterns; preferred_element_type
+pinned to float32 for MXU precision).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_weights import _BLOCK_G, plan_block
+
+
+def _kernel(x_ref, mask_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref,
+            b3_ref, out_ref):
+    gb, e, f = x_ref.shape
+    x = x_ref[:].reshape(gb * e, f)
+    h = jnp.maximum(
+        jnp.dot(x, w1_ref[:], preferred_element_type=jnp.float32)
+        + b1_ref[:], 0.0)
+    h = jnp.maximum(
+        jnp.dot(h, w2_ref[:], preferred_element_type=jnp.float32)
+        + b2_ref[:], 0.0)
+    s = (jnp.dot(h, w3_ref[:], preferred_element_type=jnp.float32)
+         + b3_ref[:])
+    # w3 is padded [H, 128] with only column 0 live
+    scores = s[:, 0].reshape(gb, e)
+    out_ref[:] = plan_block(scores, mask_ref[:] > 0)
+
+
+def _pad_axis(x, axis, to):
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, to - x.shape[axis])
+    return jnp.pad(x, pad)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _forward(params, features, mask, interpret):
+    G, E, F = features.shape
+    H = params["w1"].shape[1]
+    Gp = -(-G // _BLOCK_G) * _BLOCK_G
+    Ep = -(-E // 128) * 128
+    Fp = -(-F // 128) * 128
+    Hp = -(-H // 128) * 128
+
+    x = _pad_axis(_pad_axis(_pad_axis(
+        features.astype(jnp.float32), 0, Gp), 1, Ep), 2, Fp)
+    m = _pad_axis(_pad_axis(mask.astype(jnp.float32), 0, Gp), 1, Ep)
+    w1 = _pad_axis(_pad_axis(params["w1"].astype(jnp.float32), 0, Fp), 1, Hp)
+    b1 = _pad_axis(params["b1"].astype(jnp.float32), 0, Hp)
+    w2 = _pad_axis(_pad_axis(params["w2"].astype(jnp.float32), 0, Hp), 1, Hp)
+    b2 = _pad_axis(params["b2"].astype(jnp.float32), 0, Hp)
+    w3 = _pad_axis(_pad_axis(params["w3"].astype(jnp.float32), 0, Hp), 1, 128)
+    b3 = _pad_axis(params["b3"].astype(jnp.float32), 0, 128)
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=(Gp // _BLOCK_G,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_G, Ep, Fp), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Fp, Hp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Hp,), lambda i: (0,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Hp, Hp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Hp,), lambda i: (0,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Hp, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((128,), lambda i: (0,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Gp, Ep), jnp.int32),
+        interpret=interpret,
+    )(x, m, w1, b1, w2, b2, w3, b3)
+    return out[:G, :E]
+
+
+def forward_pallas(params, features, mask) -> jax.Array:
+    """Drop-in for TrafficPolicyModel.forward (float32 accumulation)."""
+    interpret = jax.default_backend() != "tpu"
+    return _forward(params, features, mask, interpret)

@@ -1,0 +1,81 @@
+"""Pallas TPU kernel for the weight planner.
+
+Fuses masked-softmax + scale-to-255 + round for a block of endpoint
+groups in VMEM -- one HBM round-trip per block instead of XLA's default
+fusion boundaries.  Pure VPU work (no matmul): block shapes respect the
+float32 (8, 128) tile, the grid runs over group blocks.
+
+On non-TPU backends ``plan_weights_pallas`` runs the kernel in interpret
+mode so tests exercise the same code path on the CPU mesh (see
+/opt/skills/guides/pallas_guide.md).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .weights import MAX_WEIGHT
+
+_BLOCK_G = 8  # float32 sublane tile
+
+
+def plan_block(scores, mask):
+    """Masked-softmax + scale-to-255 + round on one [G_B, E] block.
+
+    Shared by both Pallas kernels (this one and pallas_mlp's fused
+    forward).  The ``m > neg * 0.5`` guard zeroes the max for all-masked
+    rows (max == finfo.min) so ``exp`` does not overflow, and the 1e-30
+    denom clamp keeps the division finite when every endpoint is masked.
+    """
+    neg = jnp.finfo(jnp.float32).min
+    masked = jnp.where(mask, scores, neg)
+    m = jnp.max(masked, axis=-1, keepdims=True)
+    m = jnp.where(m > neg * 0.5, m, 0.0)
+    e = jnp.where(mask, jnp.exp(masked - m), 0.0)
+    denom = jnp.sum(e, axis=-1, keepdims=True)
+    p = jnp.where(denom > 0, e / jnp.maximum(denom, 1e-30), 0.0)
+    return jnp.where(mask, jnp.round(p * MAX_WEIGHT), 0.0).astype(jnp.int32)
+
+
+def _kernel(scores_ref, mask_ref, out_ref):
+    out_ref[:] = plan_block(scores_ref[:], mask_ref[:] > 0)
+
+
+def _pad_to(x, g, e, fill):
+    return jnp.pad(x, ((0, g - x.shape[0]), (0, e - x.shape[1])),
+                   constant_values=fill)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _plan(scores, mask, interpret):
+    G, E = scores.shape
+    Gp = -(-G // _BLOCK_G) * _BLOCK_G
+    Ep = -(-E // 128) * 128
+    s = _pad_to(scores.astype(jnp.float32), Gp, Ep, 0.0)
+    m = _pad_to(mask.astype(jnp.float32), Gp, Ep, 0.0)
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=(Gp // _BLOCK_G,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Gp, Ep), jnp.int32),
+        interpret=interpret,
+    )(s, m)
+    return out[:G, :E]
+
+
+def plan_weights_pallas(scores: jax.Array, mask: jax.Array) -> jax.Array:
+    """Drop-in for ops.weights.plan_weights (temperature 1)."""
+    interpret = jax.default_backend() != "tpu"
+    return _plan(scores, mask, interpret)

@@ -1,0 +1,53 @@
+"""Batched endpoint traffic-weight planner (pure JAX).
+
+Global Accelerator endpoint weights are integers in [0, 255]
+(the reference passes them through opaquely:
+pkg/cloudprovider/aws/global_accelerator.go:909-947).  The planner turns
+per-endpoint scores into a weight allocation per endpoint group:
+
+    weights = round(255 * masked_softmax(scores / temperature))
+
+Shapes are [G, E] (groups x endpoints), padded with ``mask == False`` so
+arbitrary fleets batch into one static-shape XLA program -- no
+data-dependent shapes, everything fuses on the VPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MAX_WEIGHT = 255.0
+
+
+def masked_softmax(scores: jax.Array, mask: jax.Array,
+                   axis: int = -1) -> jax.Array:
+    """Numerically stable softmax over valid (mask=True) entries.
+
+    Invalid entries get probability 0; an all-invalid row returns zeros
+    (not NaN), which matters for padded groups.
+    """
+    neg = jnp.finfo(scores.dtype).min
+    masked = jnp.where(mask, scores, neg)
+    m = jnp.max(masked, axis=axis, keepdims=True)
+    # guard the all-masked row: max is `neg`, subtracting would overflow
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.where(mask, jnp.exp(masked - m), 0.0)
+    denom = jnp.sum(e, axis=axis, keepdims=True)
+    return jnp.where(denom > 0, e / jnp.maximum(denom, 1e-30), 0.0)
+
+
+def plan_weights(scores: jax.Array, mask: jax.Array,
+                 temperature: float = 1.0) -> jax.Array:
+    """scores [G, E] float, mask [G, E] bool -> int32 weights [G, E].
+
+    Valid endpoints share 255 proportionally to softmax(score/T); padded
+    slots get 0.  Scores may be bfloat16 -- the softmax runs in float32
+    for stable exponentials, the output is int32.
+    """
+    s = scores.astype(jnp.float32) / jnp.float32(temperature)
+    p = masked_softmax(s, mask)
+    w = jnp.round(p * MAX_WEIGHT).astype(jnp.int32)
+    return jnp.where(mask, w, 0)
+
+
+plan_weights_jit = jax.jit(plan_weights, static_argnames=("temperature",))

@@ -1,0 +1,33 @@
+"""Multi-chip parallelism: mesh construction + sharded planner/training.
+
+The reference has no distributed compute (SURVEY.md §2: DP/TP/PP/SP/EP all
+ABSENT; its only multi-replica story is leader election).  This package is
+the TPU-native scale-out path for the compute track: jax.sharding Meshes
+with data x model axes, NamedSharding-annotated pjit programs, and XLA
+collectives over ICI inserted by the compiler.
+"""
+from .distributed import (  # noqa: F401
+    initialize_multihost,
+    make_hybrid_mesh,
+)
+from .experts import (  # noqa: F401
+    expert_scores_reference,
+    init_expert_params,
+    make_expert_planner,
+)
+from .fleet import FleetPlanner  # noqa: F401
+from .mesh import make_mesh  # noqa: F401
+from .pipeline import (  # noqa: F401
+    init_pipeline_params,
+    make_pipeline,
+    pipeline_reference,
+)
+from .plan import (  # noqa: F401
+    ShardedTemporalPlanner,
+    ShardedTrafficPlanner,
+)
+from .ring import ewma_reference, make_mesh_1d, make_ring_ewma  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    attention_reference,
+    make_ring_attention,
+)

@@ -1,0 +1,126 @@
+"""Multi-host distributed runtime: process init + DCN x ICI meshes.
+
+The reference's only multi-replica story is leader election over a k8s
+Lease (SURVEY.md §2: distributed comm backend ABSENT).  The compute
+track's scale-out path is JAX's multi-controller runtime: one process
+per host, every process runs the same SPMD program, and XLA inserts the
+collectives — over ICI within a slice, over DCN between hosts.
+
+The mesh recipe (scaling-book): put the slow network on the OUTERMOST
+mesh axis and the fast one innermost, then shard so that the frequent
+collectives (psum of grads over 'data', all_gather of params over
+'model') ride ICI, and only infrequent/global reductions cross DCN.
+``make_hybrid_mesh`` encodes exactly that: axes listed first map to the
+DCN (inter-slice) dimension, the rest tile the slice's ICI devices.
+
+Single-process multi-device (tests, the driver's virtual CPU mesh) is
+the degenerate case: no init call needed, hybrid collapses to a plain
+mesh.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> bool:
+    """Join the multi-controller runtime (jax.distributed.initialize).
+
+    Arguments default to the standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID), the
+    same contract k8s manifests use to wire a multi-host job.  Returns
+    True when running multi-process, False when single-process (no env,
+    no args — nothing to initialise, which is the test/dev path).
+    """
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if coordinator_address is None:
+        logger.info("single-process runtime (no coordinator configured)")
+        return False
+    kwargs = {"coordinator_address": coordinator_address}
+    env_num = os.environ.get("JAX_NUM_PROCESSES")
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    if num_processes is not None or env_num:
+        kwargs["num_processes"] = (num_processes if num_processes
+                                   is not None else int(env_num))
+    if process_id is not None or env_pid:
+        kwargs["process_id"] = (process_id if process_id is not None
+                                else int(env_pid))
+    jax.distributed.initialize(**kwargs)
+    logger.info("joined distributed runtime: process %d/%d, %d/%d devices"
+                " local/global", jax.process_index(), jax.process_count(),
+                jax.local_device_count(), jax.device_count())
+    return True
+
+
+def make_hybrid_mesh(dcn_axes: Sequence[str] = ("data",),
+                     ici_axes: Sequence[str] = ("model",),
+                     ici_shape: Optional[Sequence[int]] = None,
+                     dcn_shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Mesh whose leading axes cross hosts (DCN) and trailing axes stay
+    within a host's devices (ICI).
+
+    ``dcn_axes`` split the process dimension — the first axis absorbs
+    the full process count unless an explicit ``dcn_shape`` distributes
+    it; ``ici_axes`` tile each process's local devices, optionally with
+    an explicit ``ici_shape``.  Single-process: the DCN axes are size 1
+    and the mesh degenerates to a local one — the same program runs
+    unchanged, which is what lets the CPU-mesh tests and the driver's
+    dryrun validate the multi-host layout.
+    """
+    procs = jax.process_count()
+    local = jax.local_device_count()
+    if ici_shape is None:
+        ici_shape = _factor_into(local, len(ici_axes))
+    else:
+        ici_shape = list(ici_shape)
+        if int(np.prod(ici_shape)) != local:
+            raise ValueError(
+                f"ici_shape {ici_shape} != {local} local devices")
+    if dcn_shape is None:
+        dcn_shape = [procs] + [1] * (len(dcn_axes) - 1)
+    else:
+        dcn_shape = list(dcn_shape)
+        if len(dcn_shape) != len(dcn_axes):
+            raise ValueError(
+                f"dcn_shape {dcn_shape} has {len(dcn_shape)} entries "
+                f"for {len(dcn_axes)} dcn_axes")
+        if int(np.prod(dcn_shape)) != procs:
+            raise ValueError(
+                f"dcn_shape {dcn_shape} != {procs} processes")
+
+    # jax.devices() orders all global devices; process-major order means
+    # reshaping (procs, local...) puts the host boundary on the leading
+    # (DCN) axes, exactly the slow-outside/fast-inside layout.
+    grid = np.asarray(jax.devices()).reshape(
+        tuple(dcn_shape) + tuple(ici_shape))
+    return Mesh(grid, axis_names=tuple(dcn_axes) + tuple(ici_axes))
+
+
+def _factor_into(n: int, parts: int) -> list:
+    """Split n into `parts` factors, largest first, most-square-ish."""
+    shape = [1] * parts
+    remaining = n
+    for i in range(parts - 1):
+        f = _largest_factor_leq(remaining, int(round(
+            remaining ** (1.0 / (parts - i)))))
+        shape[i] = remaining // f
+        remaining = remaining // shape[i]
+    shape[parts - 1] = remaining
+    return shape
+
+
+def _largest_factor_leq(n: int, k: int) -> int:
+    for f in range(max(1, k), 0, -1):
+        if n % f == 0:
+            return f
+    return 1

@@ -1,0 +1,97 @@
+"""Expert-parallel endpoint-group scoring via all_to_all dispatch.
+
+Global Accelerator endpoint groups are regional; give each device one
+region "expert" (its own scoring parameters — a per-region affine on the
+telemetry features) and route every group to its region's expert with the
+MoE dispatch pattern: bucket locally by destination, exchange buckets with
+one ``jax.lax.all_to_all``, apply the local expert, exchange back, and
+scatter into original order.  All shapes static (capacity = local group
+count, so no overflow is possible); the only cross-device traffic is the
+two all_to_alls over ICI.
+
+No reference analogue (SURVEY.md §2: expert parallelism ABSENT upstream).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+ExpertParams = Dict[str, jax.Array]
+
+
+def init_expert_params(key: jax.Array, n_experts: int,
+                       feature_dim: int) -> ExpertParams:
+    """Per-region affine scoring params: score = (x*scale + bias).sum(-1)."""
+    k1, k2 = jax.random.split(key)
+    return {
+        "scale": 1.0 + 0.1 * jax.random.normal(
+            k1, (n_experts, feature_dim), dtype=jnp.float32),
+        "bias": 0.1 * jax.random.normal(
+            k2, (n_experts, feature_dim), dtype=jnp.float32),
+    }
+
+
+def expert_scores_reference(params: ExpertParams, features: jax.Array,
+                            region: jax.Array) -> jax.Array:
+    """Unsharded oracle: apply each group's regional expert densely.
+
+    features [G, E, F] f32, region [G] int32 -> scores [G, E] f32.
+    """
+    scale = params["scale"][region]  # [G, F]
+    bias = params["bias"][region]
+    x = features * scale[:, None, :] + bias[:, None, :]
+    return jnp.sum(x, axis=-1)
+
+
+def make_expert_planner(mesh: Mesh, axis: str = "expert"):
+    """Compile fn(features [G, E, F], region [G] int32) -> scores [G, E].
+
+    ``G`` is sharded over ``axis``; expert params are sharded one region
+    per device along the same axis.  Equal to
+    :func:`expert_scores_reference` for region ids < mesh.shape[axis].
+    """
+    n = mesh.shape[axis]
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(P(axis, None), P(axis, None, None), P(axis)),
+             out_specs=P(axis, None),
+             check_vma=False)
+    def planner(expert_param_block, x_local, region_local):
+        # expert_param_block [1, 2F]: this device's (scale|bias)
+        G_l, E, F = x_local.shape
+        cap = G_l  # worst case: every local group routes to one expert
+
+        # --- local bucketing by destination expert -------------------
+        onehot = jax.nn.one_hot(region_local, n, dtype=jnp.int32)  # [G_l,n]
+        slot = jnp.cumsum(onehot, axis=0)[jnp.arange(G_l),
+                                          region_local] - 1  # [G_l]
+        send = jnp.zeros((n, cap, E, F), x_local.dtype)
+        send = send.at[region_local, slot].set(x_local)
+
+        # --- exchange: send[d] -> device d ---------------------------
+        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                                  tiled=False)
+        recv = recv.reshape(n, cap, E, F)  # [src, cap, E, F]
+
+        # --- local expert ------------------------------------------------
+        scale = expert_param_block[0, :F]
+        bias = expert_param_block[0, F:]
+        y = jnp.sum(recv * scale + bias, axis=-1)  # [src, cap, E]
+
+        # --- exchange back + scatter to original order ---------------
+        back = jax.lax.all_to_all(y[:, :, None], axis, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        back = back.reshape(n, cap, E)  # [dst, cap, E]
+        # no validity mask needed: capacity == G_l means every (dst, slot)
+        # pair read here was written by this device's own scatter above
+        return back[region_local, slot]  # [G_l, E]
+
+    def fn(params: ExpertParams, features, region):
+        packed = jnp.concatenate([params["scale"], params["bias"]], axis=-1)
+        return planner(packed, features, region)
+
+    return jax.jit(fn)

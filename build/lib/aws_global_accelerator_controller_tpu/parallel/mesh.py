@@ -1,0 +1,34 @@
+"""Device mesh construction helpers."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def _factor(n: int) -> Tuple[int, int]:
+    """Split n into the most square (a, b) with a*b == n, a <= b."""
+    best = (1, n)
+    for a in range(1, int(np.sqrt(n)) + 1):
+        if n % a == 0:
+            best = (a, n // a)
+    return best
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Sequence[str] = ("data", "model"),
+              devices=None) -> Mesh:
+    """Build a 2-D ('data', 'model') mesh over the first n devices.
+
+    The model axis gets the smaller factor (weights shard less than the
+    batch); a prime or single device degenerates to (n, 1) cleanly.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    model, data = _factor(n)
+    grid = np.asarray(devices).reshape(data, model)
+    return Mesh(grid, axis_names=tuple(axis_names))

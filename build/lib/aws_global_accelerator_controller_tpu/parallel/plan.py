@@ -1,0 +1,173 @@
+"""Sharded traffic planning and training over a device mesh.
+
+Sharding layout (dp x tp, the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA insert the collectives):
+- batch [G, E, F]: groups sharded over 'data'; E/F replicated
+- layer 1 weight [F, H]: H sharded over 'model' (column parallel)
+- layer 2 weight [H, H]: input dim sharded over 'model' (row parallel;
+  XLA inserts the psum when the activations contract)
+- layer 3 weight [H, 1]: input dim sharded over 'model'
+- outputs [G, E]: sharded over 'data'
+
+Gradients reduce over 'data' automatically (XLA all-reduce over ICI);
+optimizer state follows the parameter shardings.
+
+``ShardedTemporalPlanner`` composes the second model family with the
+long-context stack: the telemetry window [T, G, E, F] is sharded T over
+'seq' and G over 'data', ring attention (parallel.ring_attention, with
+its custom ring VJP) carries the time axis, and everything outside the
+attention island is plain jit — XLA propagates the shardings and
+inserts the data-axis gradient all-reduce.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.temporal import TemporalTrafficModel
+from ..models.traffic import Batch, Params, TrafficPolicyModel
+from .ring_attention import make_ring_attention
+
+
+def param_specs() -> dict:
+    return {
+        "w1": P(None, "model"),
+        "b1": P("model"),
+        "w2": P("model", None),
+        "b2": P(None),
+        "w3": P("model", None),
+        "b3": P(None),
+    }
+
+
+def batch_specs() -> Batch:
+    return Batch(features=P("data", None, None), mask=P("data", None),
+                 target=P("data", None))
+
+
+class ShardedTrafficPlanner:
+    """pjit-compiled forward + train step bound to a mesh."""
+
+    def __init__(self, model: TrafficPolicyModel, mesh: Mesh):
+        self.model = model
+        self.mesh = mesh
+        ps = {k: NamedSharding(mesh, s) for k, s in param_specs().items()}
+        bs = Batch(*[NamedSharding(mesh, s) for s in batch_specs()])
+        out_s = NamedSharding(mesh, P("data", None))
+
+        self._forward = jax.jit(
+            model.forward,
+            in_shardings=(ps, bs.features, bs.mask),
+            out_shardings=out_s)
+
+        def step(params, opt_state, batch):
+            return model.train_step(params, opt_state, batch)
+
+        self._step = jax.jit(
+            step,
+            in_shardings=(ps, None, bs),
+            out_shardings=(ps, None, None))
+        self.param_shardings = ps
+        self.batch_shardings = bs
+
+    def shard_params(self, params: Params) -> Params:
+        return {k: jax.device_put(v, self.param_shardings[k])
+                for k, v in params.items()}
+
+    def shard_batch(self, batch: Batch) -> Batch:
+        return Batch(*[jax.device_put(v, s)
+                       for v, s in zip(batch, self.batch_shardings)])
+
+    def forward(self, params: Params, features, mask):
+        return self._forward(params, features, mask)
+
+    def train_step(self, params: Params, opt_state,
+                   batch: Batch) -> Tuple[Params, object, jax.Array]:
+        return self._step(params, opt_state, batch)
+
+
+class ShardedTemporalPlanner:
+    """dp x sp training + planning for the temporal model.
+
+    Mesh axes: ``data`` shards the G endpoint groups (and with them the
+    G*E attention streams), ``seq`` shards the telemetry window's time
+    axis.  The ring-attention collectives (ppermute per hop, forward and
+    backward) are the only cross-``seq`` traffic; the loss/gradient
+    all-reduce is the only cross-``data`` traffic.
+
+    Requires T % mesh.shape[seq] == 0 and G % mesh.shape[data] == 0
+    (static shapes — XLA sees even blocks).
+
+    ``local`` picks the per-block attend inside the ring: default
+    follows the model's dispatch — flash only where the model itself
+    would use it (backend gate AND the per-device block length
+    T/n_seq >= FLASH_MIN_WINDOW; pass ``window`` so the planner can
+    apply that check — without it the default stays on einsum).  Pass
+    ``local`` explicitly to force.
+    """
+
+    def __init__(self, model: TemporalTrafficModel, mesh: Mesh,
+                 data_axis: str = "data", seq_axis: str = "seq",
+                 local: "str | None" = None,
+                 window: "int | None" = None):
+        from ..models.temporal import FLASH_MIN_WINDOW
+
+        self.model = model
+        self.mesh = mesh
+        if local is None:
+            on_tpu = jax.default_backend() == "tpu"
+            want_flash = (model.attention == "flash_always"
+                          or (model.attention == "flash" and on_tpu))
+            block_len = (window // mesh.shape[seq_axis]) if window else 0
+            local = ("flash"
+                     if want_flash and block_len >= FLASH_MIN_WINDOW
+                     else "einsum")
+        ring = make_ring_attention(mesh, seq_axis, causal=True,
+                                   local=local, head_axis=data_axis)
+        self._attend = ring
+
+        rep = NamedSharding(mesh, P())
+        win_s = NamedSharding(mesh, P(seq_axis, data_axis, None, None))
+        ge_s = NamedSharding(mesh, P(data_axis, None))
+        batch_s = Batch(features=NamedSharding(
+            mesh, P(data_axis, None, None)), mask=ge_s, target=ge_s)
+
+        self.window_sharding = win_s
+        self.batch_shardings = batch_s
+        self.param_sharding = rep
+
+        self._forward = jax.jit(
+            lambda params, window, mask: model.forward(
+                params, window, mask, attend=ring),
+            in_shardings=(rep, win_s, ge_s), out_shardings=ge_s)
+
+        def step(params, opt_state, window, batch):
+            # attend rides as trailing *data so the shared
+            # TrainableModel.train_step (common.py) stays the single
+            # optimizer-update implementation across families
+            return model.train_step(params, opt_state, window, batch,
+                                    ring)
+
+        self._step = jax.jit(
+            step,
+            in_shardings=(rep, None, win_s, batch_s),
+            out_shardings=(rep, None, None))
+
+    def shard_params(self, params):
+        return {k: jax.device_put(v, self.param_sharding)
+                for k, v in params.items()}
+
+    def shard_window(self, window):
+        return jax.device_put(window, self.window_sharding)
+
+    def shard_batch(self, batch: Batch) -> Batch:
+        return Batch(*[jax.device_put(v, s)
+                       for v, s in zip(batch, self.batch_shardings)])
+
+    def forward(self, params, window, mask):
+        return self._forward(params, window, mask)
+
+    def train_step(self, params, opt_state, window, batch):
+        return self._step(params, opt_state, window, batch)

@@ -1,0 +1,76 @@
+"""Sequence-parallel telemetry aggregation over a device ring.
+
+Long telemetry histories (endpoint health/latency time-series feeding the
+traffic policy model) can exceed one chip's HBM.  This module shards the
+time axis across the mesh and aggregates with the ring-attention
+communication pattern: each device reduces its local time block, then the
+block partials rotate around the ring via ``jax.lax.ppermute`` (one
+neighbour hop per step, riding ICI) while every device accumulates them
+with the position-dependent decay weight.  B-1 hops of an [G, E] partial
+instead of gathering the full [T, G, E] history anywhere.
+
+The aggregate is an exponentially-decayed weighted sum
+``agg = sum_t decay^(T-1-t) * x[t]`` — genuinely order-dependent, so a
+plain ``psum`` cannot replace the ring: each block's contribution is
+scaled by ``decay^((B-1-b) * T_block)`` according to its position in time.
+
+No reference analogue (SURVEY.md §2: sequence/context parallelism ABSENT
+upstream); this is the compute track's long-context story.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def ewma_reference(x: jax.Array, decay: float) -> jax.Array:
+    """Unsharded oracle: sum_t decay^(T-1-t) x[t] over axis 0."""
+    T = x.shape[0]
+    w = decay ** jnp.arange(T - 1, -1, -1, dtype=jnp.float32)
+    return jnp.tensordot(w, x.astype(jnp.float32), axes=(0, 0))
+
+
+def make_ring_ewma(mesh: Mesh, decay: float, axis: str = "seq"):
+    """Compile fn(x [T, ...] f32, time-sharded over ``axis``) -> [...] f32
+    replicated, equal to :func:`ewma_reference`."""
+    n = mesh.shape[axis]
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=P(axis), out_specs=P(),
+             check_vma=False)
+    def ring(x_local):
+        # local block reduction: [T_b, ...] -> [...]
+        t_block = x_local.shape[0]
+        w = decay ** jnp.arange(t_block - 1, -1, -1, dtype=jnp.float32)
+        partial_sum = jnp.tensordot(w, x_local.astype(jnp.float32),
+                                    axes=(0, 0))
+        my = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def scaled(k, blk):
+            # after k hops this device holds block (my - k) mod n
+            src = jnp.mod(my - k, n)
+            return decay ** ((n - 1 - src).astype(jnp.float32)
+                             * t_block) * blk
+
+        def body(k, carry):
+            acc, blk = carry
+            acc = acc + scaled(k, blk)
+            blk = jax.lax.ppermute(blk, axis, perm)
+            return acc, blk
+
+        # n-1 hops; the block held after the last hop is accumulated
+        # without a further (wasted) rotation
+        acc = jnp.zeros_like(partial_sum)
+        acc, blk = jax.lax.fori_loop(0, n - 1, body, (acc, partial_sum))
+        return acc + scaled(n - 1, blk)
+
+    return jax.jit(ring)
+
+
+def make_mesh_1d(n_devices: int, axis: str = "seq") -> Mesh:
+    import numpy as np
+    return Mesh(np.asarray(jax.devices()[:n_devices]), axis_names=(axis,))

@@ -1,0 +1,125 @@
+"""Generic reconcile worker loop.
+
+Mirrors reference pkg/reconcile/reconcile.go:17-91:
+
+- pop a key from the rate-limited queue;
+- resolve key -> object via the lister (``key_to_obj``); NotFound means the
+  object was deleted -> ``process_delete``; otherwise hand a deep copy to
+  ``process_create_or_update``;
+- dispatch on the outcome: NoRetryError -> drop (Forget is NOT called, as
+  in the reference, so the failure count survives); other error ->
+  AddRateLimited; Result.requeue_after -> Forget + AddAfter;
+  Result.requeue -> AddRateLimited; success -> Forget.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from . import metrics
+from .errors import is_no_retry, is_not_found
+from .kube.workqueue import RateLimitingQueue
+from .tracing import default_tracer
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Result:
+    """Reconcile outcome (reference pkg/reconcile/reconcile.go:17-20)."""
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+KeyToObjFunc = Callable[[str], object]
+ProcessDeleteFunc = Callable[[str], Result]
+ProcessCreateOrUpdateFunc = Callable[[object], Result]
+
+
+def process_next_work_item(
+    queue: RateLimitingQueue,
+    key_to_obj: KeyToObjFunc,
+    process_delete: ProcessDeleteFunc,
+    process_create_or_update: ProcessCreateOrUpdateFunc,
+    get_timeout: Optional[float] = None,
+) -> bool:
+    """One worker iteration; returns False only on queue shutdown.
+
+    ``get_timeout`` is an addition over the reference for clean thread
+    shutdown: a ``get`` timeout yields True without processing.
+    """
+    item, shutdown = queue.get(timeout=get_timeout)
+    if shutdown:
+        return False
+    if item is None:  # timed out waiting; let the caller re-check stop state
+        return True
+
+    try:
+        _reconcile_handler(item, queue, key_to_obj, process_delete,
+                           process_create_or_update)
+    except Exception:
+        logger.exception("unhandled error reconciling %r", item)
+    finally:
+        queue.done(item)
+    return True
+
+
+def _reconcile_handler(key, queue, key_to_obj, process_delete,
+                       process_create_or_update) -> None:
+    if not isinstance(key, str):
+        queue.forget(key)
+        logger.error("expected string in workqueue but got %r", key)
+        return
+
+    start = time.monotonic()
+    res = Result()
+    err: Optional[Exception] = None
+    with default_tracer.span("reconcile", queue=queue.name or "queue",
+                             key=key) as span:
+        try:
+            obj = key_to_obj(key)
+        except Exception as e:
+            if is_not_found(e):
+                try:
+                    res = process_delete(key) or Result()
+                except Exception as de:
+                    err = de
+            else:
+                span.attributes["outcome"] = "store_error"
+                logger.error("unable to retrieve %r from store: %s", key, e)
+                return
+        else:
+            try:
+                res = process_create_or_update(obj.deep_copy()) or Result()
+            except Exception as ce:
+                err = ce
+
+        if err is not None:
+            if is_no_retry(err):
+                outcome = "no_retry_error"
+                logger.error("error syncing %r: %s", key, err)
+            else:
+                outcome = "error"
+                queue.add_rate_limited(key)
+                logger.error("error syncing %r, and requeued: %s", key, err)
+            span.error = f"{type(err).__name__}: {err}"
+        elif res.requeue_after > 0:
+            outcome = "requeue_after"
+            queue.forget(key)
+            queue.add_after(key, res.requeue_after)
+            logger.info("successfully synced %r, but requeued after %.1fs",
+                        key, res.requeue_after)
+        elif res.requeue:
+            outcome = "requeue"
+            queue.add_rate_limited(key)
+            logger.info("successfully synced %r, but requeued", key)
+        else:
+            outcome = "success"
+            queue.forget(key)
+            logger.debug("successfully synced %r (%.3fs)",
+                         key, time.monotonic() - start)
+        span.attributes["outcome"] = outcome
+    metrics.record_sync(queue.name or "queue", outcome,
+                        time.monotonic() - start)

@@ -1,0 +1,31 @@
+"""Signal handling (reference pkg/signals/signals.go:16-30).
+
+SIGINT/SIGTERM set the returned stop event; a second signal exits with
+code 1.  Registering twice raises, mirroring the reference's
+close-of-closed-channel panic guard.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+_registered = False
+
+
+def setup_signal_handler() -> threading.Event:
+    global _registered
+    if _registered:
+        raise RuntimeError("setup_signal_handler called twice")
+    _registered = True
+
+    stop = threading.Event()
+
+    def handler(signum, frame):
+        if stop.is_set():
+            os._exit(1)  # second signal: exit directly
+        stop.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    return stop

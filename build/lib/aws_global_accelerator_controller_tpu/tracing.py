@@ -1,0 +1,129 @@
+"""Lightweight in-process tracing (spans) for the reconcile hot path.
+
+The reference has no tracing at all — only per-sync duration logging at
+verbosity 4 (SURVEY.md §5: "Tracing / profiling: ABSENT"; reference
+pkg/reconcile/reconcile.go:52-55).  This module is a deliberate
+improvement: every reconcile iteration records a span (queue, key,
+outcome, duration), provider calls nest child spans under it, and the
+controller's health server exposes the recent buffer at ``/traces`` as
+JSON for debugging convergence stalls.
+
+Design: no OpenTelemetry dependency.  A ``Tracer`` keeps a bounded deque
+of *completed* spans (a ring buffer — old spans fall off, memory is
+O(capacity)); span nesting rides a thread-local stack, so concurrent
+reconcile workers trace independently without cross-talk.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+_ids = itertools.count(1)
+
+
+@dataclass
+class Span:
+    name: str
+    span_id: int = field(default_factory=lambda: next(_ids))
+    parent_id: Optional[int] = None
+    trace_id: int = 0  # root span's id; shared by the whole tree
+    start_wall: float = 0.0
+    duration: float = 0.0
+    attributes: Dict[str, object] = field(default_factory=dict)
+    error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "trace_id": self.trace_id,
+            "start_wall": self.start_wall,
+            "duration_s": round(self.duration, 6),
+            "attributes": dict(self.attributes),
+            "error": self.error,
+        }
+
+
+class Tracer:
+    def __init__(self, capacity: int = 512):
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=capacity)
+        self._local = threading.local()
+
+    def _stack(self) -> List[Span]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextmanager
+    def span(self, name: str, **attributes) -> Iterator[Span]:
+        """Open a span; nests under the thread's current span, if any.
+        Exceptions mark the span errored and propagate."""
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        s = Span(name=name, attributes=dict(attributes),
+                 start_wall=time.time())
+        if parent is not None:
+            s.parent_id = parent.span_id
+            s.trace_id = parent.trace_id
+        else:
+            s.trace_id = s.span_id
+        stack.append(s)
+        start = time.monotonic()
+        try:
+            yield s
+        except Exception as e:
+            s.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            s.duration = time.monotonic() - start
+            stack.pop()
+            with self._lock:
+                self._spans.append(s)
+
+    def current(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def recent(self, limit: Optional[int] = None,
+               name: Optional[str] = None) -> List[dict]:
+        """Most-recent-last completed spans; optionally filtered by name
+        prefix and truncated to the last ``limit``.  ``limit=0`` and
+        ``limit=None`` both mean "everything buffered" — the same
+        contract the ``/traces`` endpoint exposes for ``?limit=0``.
+        Negative limits yield no spans."""
+        with self._lock:
+            spans = list(self._spans)
+        if name is not None:
+            spans = [s for s in spans if s.name.startswith(name)]
+        if limit:
+            spans = spans[-limit:] if limit > 0 else []
+        return [s.to_dict() for s in spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+default_tracer = Tracer()
+
+
+def traced(name: str, tracer: Optional[Tracer] = None):
+    """Decorator: run the function under a span named ``name`` (nests
+    under the caller's current span — provider calls show up as children
+    of the reconcile span)."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with (tracer or default_tracer).span(name):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco

@@ -1,0 +1,101 @@
+"""Webhook HTTP(S) server.
+
+Mirrors reference pkg/webhoook/webhook.go:14-85: a plain HTTP server (no
+framework) with
+- GET  /healthz                          -> 200
+- POST /validate-endpointgroupbinding    -> AdmissionReview v1 in/out
+
+Request validation before dispatch (webhook.go:61-85): Content-Type must
+be application/json, body non-empty, request field present; failures are
+400s.  TLS is enabled when cert+key files are given.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .validator import validate_endpoint_group_binding
+
+logger = logging.getLogger(__name__)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # route into logging, not stderr
+        logger.debug("webhook: " + fmt, *args)
+
+    def _respond(self, code: int, body: bytes,
+                 content_type: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._respond(200, b"ok", "text/plain")
+        else:
+            self._respond(404, b"not found", "text/plain")
+
+    def do_POST(self):
+        if self.path != "/validate-endpointgroupbinding":
+            self._respond(404, b"not found", "text/plain")
+            return
+        if self.headers.get("Content-Type") != "application/json":
+            self._respond(400, b"invalid Content-Type", "text/plain")
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        if not body:
+            self._respond(400, b"empty body", "text/plain")
+            return
+        try:
+            review = json.loads(body)
+        except ValueError as e:
+            self._respond(400, f"failed to unmarshal body: {e}".encode(),
+                          "text/plain")
+            return
+        if not review.get("request"):
+            self._respond(400, b"empty request", "text/plain")
+            return
+        response = validate_endpoint_group_binding(review)
+        self._respond(200, json.dumps(response).encode())
+
+
+class WebhookServer:
+    """ThreadingHTTPServer wrapper with optional TLS and clean shutdown."""
+
+    def __init__(self, port: int = 8443, tls_cert_file: str = "",
+                 tls_key_file: str = "", host: str = ""):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.ssl = bool(tls_cert_file and tls_key_file)
+        if self.ssl:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert_file, tls_key_file)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def serve_forever(self) -> None:
+        logger.info("webhook listening on :%d, SSL is %s", self.port,
+                    self.ssl)
+        self._httpd.serve_forever(poll_interval=0.2)
+
+    def start_background(self) -> None:
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True, name="webhook-server")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

@@ -1,0 +1,57 @@
+"""EndpointGroupBinding admission validator.
+
+Mirrors reference pkg/webhoook/endpointgroupbinding/validator.go:15-76:
+- kind != EndpointGroupBinding      -> deny 400
+- non-Update or missing OldObject   -> allow
+- Spec.EndpointGroupArn changed     -> deny 403 "immutable"
+- otherwise                         -> allow 200 "valid"
+
+Input/output are AdmissionReview v1 dicts, exactly the JSON the kube API
+server exchanges.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..apis.endpointgroupbinding.v1alpha1 import EndpointGroupBinding
+
+
+def _review_response(uid: str, allowed: bool, code: int,
+                     reason: str) -> Dict[str, Any]:
+    """(reference validator.go:61-76)"""
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": {
+            "uid": uid,
+            "allowed": allowed,
+            "status": {"code": code, "message": reason},
+        },
+    }
+
+
+def validate_endpoint_group_binding(review: Dict[str, Any]) -> Dict[str, Any]:
+    request = review.get("request") or {}
+    uid = request.get("uid", "")
+
+    kind = (request.get("kind") or {}).get("kind", "")
+    if kind != "EndpointGroupBinding":
+        return _review_response(uid, False, 400, f"{kind} is not supported")
+
+    if request.get("operation") != "UPDATE":
+        return _review_response(uid, True, 200, "")
+
+    old_raw = request.get("oldObject")
+    if not old_raw:
+        return _review_response(uid, True, 200, "")
+
+    try:
+        previous = EndpointGroupBinding.from_dict(old_raw)
+        new = EndpointGroupBinding.from_dict(request.get("object") or {})
+    except Exception as e:
+        return _review_response(uid, False, 500, str(e))
+
+    if previous.spec.endpoint_group_arn != new.spec.endpoint_group_arn:
+        return _review_response(uid, False, 403,
+                                "Spec.EndpointGroupArn is immutable")
+    return _review_response(uid, True, 200, "valid")

@@ -1,0 +1,65 @@
+"""Package-install smoke: the artifact the Dockerfile ships must work.
+
+No docker daemon exists in this environment, so this tier tests what
+the image build actually exercises: `pip install .` from pyproject into
+a clean venv (system-site-packages supplies pyyaml, like the base
+image's pip install does), then the console-script entrypoint converges
+the --demo fleet — the same gate .github/workflows/e2e.yml runs inside
+the container.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def installed_venv(tmp_path_factory):
+    prefix = tmp_path_factory.mktemp("pkg-prefix")
+    # offline environment: --no-build-isolation + --no-deps use the
+    # running interpreter's setuptools/pyyaml instead of an index; the
+    # console script lands in {prefix}/bin with this interpreter
+    proc = subprocess.run(
+        [sys.executable, "-m", "pip", "install",
+         "--no-build-isolation", "--no-deps", "--no-index",
+         "--prefix", str(prefix), REPO],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        pytest.fail(f"pip install . failed:\n{proc.stderr[-2000:]}")
+    return prefix
+
+
+def _env_with_prefix(prefix) -> dict:
+    import glob
+
+    env = dict(os.environ)
+    site = glob.glob(os.path.join(prefix, "lib", "python*",
+                                  "site-packages"))[0]
+    env["PYTHONPATH"] = site
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_console_script_version(installed_venv):
+    exe = os.path.join(installed_venv, "bin",
+                       "aws-global-accelerator-controller-tpu")
+    out = subprocess.run([exe, "version"], capture_output=True,
+                         text=True, timeout=60,
+                         env=_env_with_prefix(installed_venv))
+    assert out.returncode == 0
+    assert "Version" in out.stdout
+
+
+def test_installed_entrypoint_converges_demo_fleet(installed_venv):
+    """The Dockerfile's smoke gate, against the installed package."""
+    exe = os.path.join(installed_venv, "bin",
+                       "aws-global-accelerator-controller-tpu")
+    out = subprocess.run(
+        [exe, "controller", "--demo", "--smoke", "60",
+         "--health-port", "0"],
+        capture_output=True, text=True, timeout=120,
+        env=_env_with_prefix(installed_venv))
+    assert out.returncode == 0, out.stderr[-2000:]
