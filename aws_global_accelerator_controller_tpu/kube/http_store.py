@@ -350,12 +350,16 @@ class _Watcher:
     no subscriber is left with a phantom object."""
 
     def __init__(self, client: RestClient, codec: Codec,
-                 q: queue_mod.Queue, start_rv: int):
+                 q: queue_mod.Queue, start_rv: int,
+                 initial: Optional[Dict[str, Any]] = None):
         self._client = client
         self._codec = codec
         self._q = q
         self._rv = start_rv
-        self._objs: Dict[str, Any] = {}   # key -> last delivered object
+        # key -> last delivered object; seeded with the pre-watch list so
+        # 410 recovery can synthesize DELETED for objects that existed
+        # before the watch started and were never streamed
+        self._objs: Dict[str, Any] = dict(initial or {})
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
@@ -455,6 +459,15 @@ class HTTPAPIServer:
 
     def store(self, kind: str) -> HTTPResourceStore:
         return self.stores[kind]
+
+    def close(self) -> None:
+        """Stop every watch thread (all kinds, all subscribers)."""
+        for store in self.stores.values():
+            with store._lock:
+                watchers = list(store._watchers.values())
+                store._watchers.clear()
+            for w in watchers:
+                w.stop()
 
 
 _WATCH_TYPES = (WATCH_ADDED, WATCH_MODIFIED, WATCH_DELETED)

@@ -315,9 +315,19 @@ class HTTPResourceStore:
         # take the start RV SYNCHRONOUSLY: the informer contract is
         # subscribe-before-list (informers.py), so everything created
         # after this call returns must reach the queue — an async RV
-        # capture on the watcher thread would race the caller's list
-        start_rv = self._list_rv()
-        w = _Watcher(self._client, self._codec, q, start_rv)
+        # capture on the watcher thread would race the caller's list.
+        # The same GET seeds the watcher's object tracker, so a later
+        # 410 recovery can synthesize DELETED even for objects that
+        # existed before the watch and were never streamed.
+        got = self._client.request(
+            "GET", self._codec.collection_path(None))
+        rv = (got.get("metadata") or {}).get("resourceVersion", "0")
+        start_rv = int(rv) if str(rv).isdigit() else 0
+        initial = {}
+        for item in got.get("items") or []:
+            obj = self._codec.from_wire(item)
+            initial[obj.key()] = obj
+        w = _Watcher(self._client, self._codec, q, start_rv, initial)
         with self._lock:
             self._watchers[id(q)] = w
         w.start()
@@ -340,12 +350,16 @@ class _Watcher:
     no subscriber is left with a phantom object."""
 
     def __init__(self, client: RestClient, codec: Codec,
-                 q: queue_mod.Queue, start_rv: int):
+                 q: queue_mod.Queue, start_rv: int,
+                 initial: Optional[Dict[str, Any]] = None):
         self._client = client
         self._codec = codec
         self._q = q
         self._rv = start_rv
-        self._objs: Dict[str, Any] = {}   # key -> last delivered object
+        # key -> last delivered object; seeded with the pre-watch list so
+        # 410 recovery can synthesize DELETED for objects that existed
+        # before the watch started and were never streamed
+        self._objs: Dict[str, Any] = dict(initial or {})
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True,

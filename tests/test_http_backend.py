@@ -59,7 +59,9 @@ def rest():
 
 @pytest.fixture
 def http_api(rest):
-    return HTTPAPIServer(RestConfig(server=rest.url))
+    api = HTTPAPIServer(RestConfig(server=rest.url))
+    yield api
+    api.close()
 
 
 def _service(name="app", hostname=""):
