@@ -23,10 +23,15 @@ logger = logging.getLogger(__name__)
 def register(sub) -> None:
     train = sub.add_parser(
         "train", help="Train the traffic policy model (TPU compute track)")
-    train.add_argument("--model", choices=("mlp", "temporal"),
+    train.add_argument("--model", choices=("mlp", "temporal", "moe"),
                        default="mlp",
                        help="mlp: snapshot MLP; temporal: causal "
-                            "attention over a telemetry window.")
+                            "attention over a telemetry window; moe: "
+                            "per-region expert MLPs with a learned "
+                            "top-1 gate.")
+    train.add_argument("--experts", type=int, default=4,
+                       help="Expert count (moe model); with --sharded "
+                            "must equal the expert mesh axis size.")
     train.add_argument("--window", type=int, default=64,
                        help="Telemetry window length (temporal model); "
                             "the default reaches the Pallas flash "
@@ -55,10 +60,13 @@ def register(sub) -> None:
 
     plan = sub.add_parser(
         "plan", help="Plan GA endpoint weights for a fleet (JSON out)")
-    plan.add_argument("--model", choices=("mlp", "temporal"),
+    plan.add_argument("--model", choices=("mlp", "temporal", "moe"),
                       default="mlp",
                       help="Must match the model the ckpt was trained "
                            "with.")
+    plan.add_argument("--experts", type=int, default=4,
+                      help="Expert count (moe model; must match the "
+                           "ckpt).")
     plan.add_argument("--window", type=int, default=64,
                       help="Telemetry window length (temporal model); "
                            "the default reaches the Pallas flash "
@@ -126,39 +134,57 @@ def _build_model(args):
             def run_plan_fwd(params, key):
                 window, batch = make_data(key)
                 return fwd(params, window, batch.mask)
+    elif args.model == "moe":
+        from ..models.moe import MoETrafficModel, synthetic_moe_batch
+
+        model = MoETrafficModel(n_experts=args.experts,
+                                hidden_dim=args.hidden,
+                                learning_rate=lr)
+        run_step, run_plan_fwd = _snapshot_runners(
+            jax, model,
+            lambda key: synthetic_moe_batch(
+                key, groups=args.groups, endpoints=args.endpoints,
+                n_regions=args.experts),
+            lambda: _moe_planner(args, model), sharded)
     else:
         from ..models.traffic import TrafficPolicyModel, synthetic_batch
 
         model = TrafficPolicyModel(hidden_dim=args.hidden,
                                    learning_rate=lr)
-
-        def make_batch(key):
-            return synthetic_batch(key, groups=args.groups,
-                                   endpoints=args.endpoints)
-
-        if sharded:
-            planner = _mlp_planner(args, model)
-
-            def run_step(params, opt_state, key):
-                batch = planner.shard_batch(make_batch(key))
-                return planner.train_step(params, opt_state, batch)
-
-            def run_plan_fwd(params, key):
-                batch = planner.shard_batch(make_batch(key))
-                return planner.forward(params, batch.features,
-                                       batch.mask)
-        else:
-            step_fn = jax.jit(model.train_step)
-            fwd = jax.jit(model.forward)
-
-            def run_step(params, opt_state, key):
-                batch = make_batch(key)
-                return step_fn(params, opt_state, batch)
-
-            def run_plan_fwd(params, key):
-                batch = make_batch(key)
-                return fwd(params, batch.features, batch.mask)
+        run_step, run_plan_fwd = _snapshot_runners(
+            jax, model,
+            lambda key: synthetic_batch(
+                key, groups=args.groups, endpoints=args.endpoints),
+            lambda: _mlp_planner(args, model), sharded)
     return model, run_step, run_plan_fwd
+
+
+def _snapshot_runners(jax, model, make_batch, make_planner, sharded):
+    """run_step/run_plan_fwd wiring shared by the snapshot-batch
+    families (mlp, moe): one synthetic Batch per step, planner-sharded
+    when requested.  The temporal family keeps its own wiring (its data
+    is a (window, batch) pair)."""
+    if sharded:
+        planner = make_planner()
+
+        def run_step(params, opt_state, key):
+            batch = planner.shard_batch(make_batch(key))
+            return planner.train_step(params, opt_state, batch)
+
+        def run_plan_fwd(params, key):
+            batch = planner.shard_batch(make_batch(key))
+            return planner.forward(params, batch.features, batch.mask)
+    else:
+        step_fn = jax.jit(model.train_step)
+        fwd = jax.jit(model.forward)
+
+        def run_step(params, opt_state, key):
+            return step_fn(params, opt_state, make_batch(key))
+
+        def run_plan_fwd(params, key):
+            batch = make_batch(key)
+            return fwd(params, batch.features, batch.mask)
+    return run_step, run_plan_fwd
 
 
 def _temporal_planner(args, model):
@@ -176,6 +202,31 @@ def _temporal_planner(args, model):
             f"window={args.window} groups={args.groups}")
     logger.info("temporal mesh: data=%d seq=%d", n_data, n_seq)
     return ShardedTemporalPlanner(model, mesh, window=args.window)
+
+
+def _moe_planner(args, model):
+    """data x expert mesh: one expert per device along the expert axis,
+    batch sharded over both axes."""
+    from ..parallel import ShardedMoEPlanner
+    from ..parallel.mesh import make_mesh
+
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev % args.experts:
+        raise SystemExit(
+            f"--sharded moe needs --experts to divide the device count "
+            f"({n_dev}); got experts={args.experts}")
+    mesh = make_mesh(axis_shapes={"data": n_dev // args.experts,
+                                  "expert": args.experts})
+    n_total = mesh.shape["data"] * mesh.shape["expert"]
+    if args.groups % n_total:
+        raise SystemExit(
+            f"--sharded moe needs --groups divisible by the device "
+            f"count ({n_total}); got groups={args.groups}")
+    logger.info("moe mesh: data=%d expert=%d", mesh.shape["data"],
+                mesh.shape["expert"])
+    return ShardedMoEPlanner(model, mesh)
 
 
 def _mlp_planner(args, model):

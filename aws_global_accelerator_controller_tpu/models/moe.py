@@ -1,0 +1,168 @@
+"""Mixture-of-experts traffic model: per-region expert MLPs + learned gate.
+
+Third model family of the compute track.  Global Accelerator endpoint
+groups are regional, and regional fleets have regionally distinct
+telemetry statistics (different latency floors, capacity mixes) — a
+single shared MLP averages those regimes away.  This model routes each
+endpoint group to one of ``n_experts`` specialist MLPs with a learned
+top-1 (switch-style) gate, trained end-to-end with the standard
+load-balancing auxiliary loss so experts don't collapse.
+
+The reference repo has no compute path at all (SURVEY.md §2: expert
+parallelism ABSENT upstream); the closest structural analogue is its
+per-region AWS client bundle (pkg/cloudprovider/aws/aws.go:18-38 — one
+client set per region), which this family mirrors as one scoring expert
+per region.
+
+Design notes (TPU-first):
+- single-chip forward gathers the routed expert's weights per group
+  (``w1[route]``) and runs ONE batched einsum over [G, E, F] — a big
+  MXU matmul, no per-expert Python loop, no dynamic shapes;
+- routing is argmax (non-differentiable, as in Switch Transformers);
+  the gate learns through the selected-probability scaling of the
+  expert output and through the auxiliary loss;
+- expert-parallel training shards experts one-per-device over an
+  ``expert`` mesh axis with all_to_all dispatch: see
+  ``parallel.moe.ShardedMoEPlanner``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops.weights import plan_weights
+from .common import TrainableModel, masked_ce_loss
+from .traffic import Batch
+
+Params = Dict[str, jax.Array]
+
+N_EXPERTS = 4
+FEATURE_DIM = 8
+HIDDEN_DIM = 64
+
+
+class MoETrafficModel(TrainableModel):
+    def __init__(self, n_experts: int = N_EXPERTS,
+                 feature_dim: int = FEATURE_DIM,
+                 hidden_dim: int = HIDDEN_DIM,
+                 learning_rate: float = 1e-3,
+                 aux_weight: float = 1e-2):
+        self.n_experts = n_experts
+        self.feature_dim = feature_dim
+        self.hidden_dim = hidden_dim
+        self.aux_weight = aux_weight
+        self.optimizer = optax.adam(learning_rate)
+
+    def init_params(self, key: jax.Array) -> Params:
+        kg, k1, k2 = jax.random.split(key, 3)
+        n, f, h = self.n_experts, self.feature_dim, self.hidden_dim
+        scale = lambda fan_in: 1.0 / jnp.sqrt(fan_in)  # noqa: E731
+        return {
+            # the gate stays float32: it is tiny and its softmax drives
+            # discrete routing, where bf16 logit ties would flap routes
+            "wg": jax.random.normal(kg, (f, n)) * scale(f),
+            "w1": (jax.random.normal(k1, (n, f, h))
+                   * scale(f)).astype(jnp.bfloat16),
+            "b1": jnp.zeros((n, h), jnp.bfloat16),
+            "w2": (jax.random.normal(k2, (n, h, 1))
+                   * scale(h)).astype(jnp.bfloat16),
+            "b2": jnp.zeros((n, 1), jnp.bfloat16),
+        }
+
+    # -- gating ---------------------------------------------------------
+
+    def gate(self, params: Params, features: jax.Array,
+             mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Masked-mean group embedding -> (route [G] int32, probs
+        [G, n_experts] f32).  Top-1 routing on the softmax argmax."""
+        m = mask[..., None].astype(jnp.float32)
+        emb = (jnp.sum(features.astype(jnp.float32) * m, axis=1)
+               / jnp.maximum(jnp.sum(m, axis=1), 1.0))      # [G, F]
+        logits = emb @ params["wg"]                          # [G, n]
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), probs
+
+    # -- forward --------------------------------------------------------
+
+    def expert_scores(self, params: Params, features: jax.Array,
+                      route: jax.Array) -> jax.Array:
+        """Apply each group's routed expert: [G, E, F] + route [G] ->
+        raw scores [G, E] f32 (one batched MXU einsum per layer)."""
+        x = features.astype(jnp.bfloat16)
+        w1 = params["w1"][route]                             # [G, F, H]
+        b1 = params["b1"][route]                             # [G, H]
+        w2 = params["w2"][route]                             # [G, H, 1]
+        b2 = params["b2"][route]                             # [G, 1]
+        h = jnp.maximum(jnp.einsum("gef,gfh->geh", x, w1)
+                        + b1[:, None, :], 0)
+        s = jnp.einsum("geh,gho->geo", h, w2)[..., 0] + b2[:, None, 0]
+        return s.astype(jnp.float32)
+
+    def scored(self, params: Params, features: jax.Array,
+               mask: jax.Array) -> Tuple[jax.Array, jax.Array,
+                                         jax.Array]:
+        """The one switch-estimator implementation: (scores [G, E] f32,
+        route [G], probs [G, n]).  Scores are the routed expert's output
+        scaled by the selected gate probability — that product is the
+        gate's gradient path.  ``loss`` reuses route/probs for the aux
+        term; ``parallel.moe`` swaps ``expert_scores`` for the
+        all_to_all dispatch but keeps this same composition."""
+        route, probs = self.gate(params, features, mask)
+        s = self.expert_scores(params, features, route)
+        p_sel = jnp.take_along_axis(probs, route[:, None], axis=1)
+        return s * p_sel, route, probs
+
+    def scores(self, params: Params, features: jax.Array,
+               mask: jax.Array) -> jax.Array:
+        """[G, E, F] + mask -> [G, E] f32 switch-estimator scores."""
+        return self.scored(params, features, mask)[0]
+
+    def forward(self, params: Params, features: jax.Array,
+                mask: jax.Array) -> jax.Array:
+        """[G, E, F] + mask -> int32 GA weights [G, E]."""
+        return plan_weights(self.scores(params, features, mask), mask)
+
+    # -- training -------------------------------------------------------
+
+    def aux_loss(self, route: jax.Array, probs: jax.Array) -> jax.Array:
+        """Switch load-balancing loss: n * sum_e f_e * P_e, minimised at
+        uniform routing (f_e = fraction routed to e, P_e = mean gate
+        probability of e)."""
+        f = jnp.mean(
+            jax.nn.one_hot(route, self.n_experts, dtype=jnp.float32),
+            axis=0)
+        p = jnp.mean(probs, axis=0)
+        return self.n_experts * jnp.sum(f * p)
+
+    def loss(self, params: Params, batch: Batch) -> jax.Array:
+        s, route, probs = self.scored(params, batch.features,
+                                      batch.mask)
+        ce = masked_ce_loss(s, batch.mask, batch.target)
+        return ce + self.aux_weight * self.aux_loss(route, probs)
+
+
+def synthetic_moe_batch(key: jax.Array, groups: int = 64,
+                        endpoints: int = 32,
+                        feature_dim: int = FEATURE_DIM,
+                        n_regions: int = N_EXPERTS) -> Batch:
+    """Region-flavoured fleet telemetry: each group's features carry a
+    per-region offset (distinct telemetry regimes), so a well-trained
+    gate can separate regions and experts can specialise.  Target is
+    weight ~ capacity among healthy endpoints, as in
+    ``traffic.synthetic_batch``."""
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    region = jax.random.randint(k4, (groups,), 0, n_regions)
+    offset = 2.0 * jax.random.normal(k5, (n_regions, feature_dim))
+    features = (jax.random.normal(k1, (groups, endpoints, feature_dim))
+                + offset[region][:, None, :])
+    healthy = jax.random.bernoulli(k2, 0.9, (groups, endpoints))
+    mask = jax.random.bernoulli(k3, 0.8, (groups, endpoints))
+    capacity = jnp.exp(features[..., 0])
+    raw = jnp.where(mask & healthy, capacity, 0.0)
+    denom = jnp.sum(raw, axis=-1, keepdims=True)
+    target = jnp.where(denom > 0, raw / jnp.maximum(denom, 1e-9), 0.0)
+    return Batch(features=features.astype(jnp.bfloat16), mask=mask,
+                 target=target)

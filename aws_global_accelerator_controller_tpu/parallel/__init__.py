@@ -17,6 +17,7 @@ from .experts import (  # noqa: F401
 )
 from .fleet import FleetPlanner  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
+from .moe import ShardedMoEPlanner, moe_param_specs  # noqa: F401
 from .pipeline import (  # noqa: F401
     init_pipeline_params,
     make_pipeline,

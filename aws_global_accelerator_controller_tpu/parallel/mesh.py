@@ -19,13 +19,28 @@ def _factor(n: int) -> Tuple[int, int]:
 
 def make_mesh(n_devices: Optional[int] = None,
               axis_names: Sequence[str] = ("data", "model"),
-              devices=None) -> Mesh:
+              devices=None,
+              axis_shapes: Optional[dict] = None) -> Mesh:
     """Build a 2-D ('data', 'model') mesh over the first n devices.
 
     The model axis gets the smaller factor (weights shard less than the
     batch); a prime or single device degenerates to (n, 1) cleanly.
+
+    ``axis_shapes`` ({name: size, ...}, ordered) overrides both the
+    axis names and the factorisation — for layouts where an axis size
+    is semantic rather than a free split (e.g. one expert per device
+    along an 'expert' axis).
     """
     devices = list(devices if devices is not None else jax.devices())
+    if axis_shapes:
+        want = int(np.prod(list(axis_shapes.values())))
+        if len(devices) < want:
+            raise ValueError(
+                f"axis_shapes {axis_shapes} needs {want} devices, have "
+                f"{len(devices)}")
+        grid = np.asarray(devices[:want]).reshape(
+            tuple(axis_shapes.values()))
+        return Mesh(grid, axis_names=tuple(axis_shapes))
     if n_devices is not None:
         devices = devices[:n_devices]
     n = len(devices)

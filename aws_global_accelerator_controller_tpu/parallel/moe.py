@@ -1,0 +1,163 @@
+"""Expert-parallel training for the MoE traffic model (data x expert mesh).
+
+``parallel.experts`` proves the all_to_all dispatch pattern on a toy
+per-region affine; this module is the real thing: the full
+``models.moe.MoETrafficModel`` trained end-to-end with its experts
+sharded one-per-device along an ``expert`` mesh axis and the batch
+sharded over BOTH axes (every device holds groups AND one expert — the
+standard 2D MoE layout).
+
+Per training step, inside ``jax.shard_map``:
+
+1. gate (replicated f32 matmul, computed outside the shard_map);
+2. each device buckets its local groups by destination expert
+   (static capacity = local group count, so overflow is impossible);
+3. ONE ``jax.lax.all_to_all`` over the ``expert`` axis ships buckets to
+   their experts (ICI traffic only within each data-axis row);
+4. the local expert MLP runs as one [n*cap*E, F] MXU matmul stack;
+5. a second all_to_all ships scores back; scatter restores group order.
+
+Everything is differentiable: the all_to_alls transpose to all_to_alls,
+the scatters to gathers, and the expert-parameter gradients psum over
+the ``data`` axis automatically (shard_map inserts the reduction for
+inputs replicated along an axis).  The gate's gradient flows through
+the selected-probability scaling exactly as in the dense model, so
+sharded and unsharded training follow the same trajectory.
+
+No reference analogue (SURVEY.md §2: EP ABSENT upstream).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.common import masked_ce_loss
+from ..models.moe import MoETrafficModel, Params
+from ..models.traffic import Batch
+from ..ops.weights import plan_weights
+
+
+def moe_param_specs(expert_axis: str = "expert") -> dict:
+    """Experts shard dim 0 over the expert axis; the gate replicates."""
+    e = expert_axis
+    return {
+        "wg": P(),
+        "w1": P(e, None, None),
+        "b1": P(e, None),
+        "w2": P(e, None, None),
+        "b2": P(e, None),
+    }
+
+
+class ShardedMoEPlanner:
+    """pjit-compiled MoE forward + train step bound to a mesh.
+
+    Requires ``model.n_experts == mesh.shape[expert_axis]`` (one expert
+    per device along that axis) and G divisible by the full device
+    count (the batch shards over both axes).
+    """
+
+    def __init__(self, model: MoETrafficModel, mesh: Mesh,
+                 data_axis: str = "data", expert_axis: str = "expert"):
+        if model.n_experts != mesh.shape[expert_axis]:
+            raise ValueError(
+                f"model has {model.n_experts} experts but the "
+                f"'{expert_axis}' mesh axis has "
+                f"{mesh.shape[expert_axis]} devices — expert-parallel "
+                f"layout is one expert per device")
+        self.model = model
+        self.mesh = mesh
+        n = model.n_experts
+
+        both = (data_axis, expert_axis)
+        ps = {k: NamedSharding(mesh, s)
+              for k, s in moe_param_specs(expert_axis).items()}
+        bs = Batch(features=NamedSharding(mesh, P(both, None, None)),
+                   mask=NamedSharding(mesh, P(both, None)),
+                   target=NamedSharding(mesh, P(both, None)))
+        out_s = NamedSharding(mesh, P(both, None))
+
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(P(expert_axis, None, None),
+                           P(expert_axis, None),
+                           P(expert_axis, None, None),
+                           P(expert_axis, None),
+                           P(both, None, None),
+                           P(both)),
+                 out_specs=P(both, None),
+                 check_vma=False)
+        def dispatch(w1, b1, w2, b2, x_local, route_local):
+            # w1 [1, F, H], b1 [1, H], w2 [1, H, 1], b2 [1, 1]: this
+            # device's expert.  x_local [G_l, E, F], route_local [G_l].
+            g_l, e_dim, f_dim = x_local.shape
+            cap = g_l  # worst case: every local group -> one expert
+
+            onehot = jax.nn.one_hot(route_local, n, dtype=jnp.int32)
+            slot = jnp.cumsum(onehot, axis=0)[
+                jnp.arange(g_l), route_local] - 1          # [G_l]
+            send = jnp.zeros((n, cap, e_dim, f_dim), x_local.dtype)
+            send = send.at[route_local, slot].set(x_local)
+
+            recv = jax.lax.all_to_all(
+                send, expert_axis, split_axis=0, concat_axis=0,
+                tiled=False).reshape(n, cap, e_dim, f_dim)
+
+            flat = recv.reshape(n * cap * e_dim, f_dim)
+            h = jnp.maximum(flat @ w1[0] + b1[0], 0)
+            s = (h @ w2[0] + b2[0]).reshape(n, cap, e_dim)
+
+            back = jax.lax.all_to_all(
+                s, expert_axis, split_axis=0, concat_axis=0,
+                tiled=False).reshape(n, cap, e_dim)
+            # every (dst, slot) read below was written by this device's
+            # own scatter above, so no validity mask is needed
+            return back[route_local, slot]                 # [G_l, E]
+
+        def scores(params: Params, features, mask):
+            route, probs = model.gate(params, features, mask)
+            s = dispatch(params["w1"], params["b1"], params["w2"],
+                         params["b2"], features.astype(jnp.bfloat16),
+                         route)
+            p_sel = jnp.take_along_axis(probs, route[:, None], axis=1)
+            return s.astype(jnp.float32) * p_sel, route, probs
+
+        def loss_fn(params: Params, batch: Batch):
+            s, route, probs = scores(params, batch.features, batch.mask)
+            ce = masked_ce_loss(s, batch.mask, batch.target)
+            return ce + model.aux_weight * model.aux_loss(route, probs)
+
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = model.optimizer.update(
+                grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._forward = jax.jit(
+            lambda params, features, mask: plan_weights(
+                scores(params, features, mask)[0], mask),
+            in_shardings=(ps, bs.features, bs.mask),
+            out_shardings=out_s)
+        self._step = jax.jit(step, in_shardings=(ps, None, bs),
+                             out_shardings=(ps, None, None))
+        self.param_shardings = ps
+        self.batch_shardings = bs
+
+    def shard_params(self, params: Params) -> Params:
+        return {k: jax.device_put(v, self.param_shardings[k])
+                for k, v in params.items()}
+
+    def shard_batch(self, batch: Batch) -> Batch:
+        return Batch(*[jax.device_put(v, s)
+                       for v, s in zip(batch, self.batch_shardings)])
+
+    def forward(self, params: Params, features, mask):
+        return self._forward(params, features, mask)
+
+    def train_step(self, params: Params, opt_state,
+                   batch: Batch) -> Tuple[Params, object, jax.Array]:
+        return self._step(params, opt_state, batch)

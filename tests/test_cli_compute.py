@@ -114,3 +114,43 @@ def test_help_lists_compute_subcommands(capsys):
         main(["--help"])
     help_text = capsys.readouterr().out
     assert "train" in help_text and "plan" in help_text
+
+
+def test_moe_model_trains_and_plans(tmp_path, capsys):
+    ckpt = str(tmp_path / "mck")
+    assert main(["train", "--model", "moe", "--steps", "2",
+                 "--ckpt", ckpt, "--groups", "8", "--endpoints", "6",
+                 "--hidden", "16", "--experts", "2"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "moe" and out["step"] == 2
+    assert main(["plan", "--model", "moe", "--ckpt", ckpt,
+                 "--groups", "8", "--endpoints", "6", "--hidden", "16",
+                 "--experts", "2"]) == 0
+    plan = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(plan["weights"]) == 8
+    assert all(0 <= w <= 255 for row in plan["weights"] for w in row)
+
+
+def test_sharded_moe_trains_and_plans(tmp_path, capsys):
+    """--sharded --model moe builds a data x expert mesh over the 8
+    virtual CPU devices and trains through the all_to_all dispatch."""
+    ckpt = str(tmp_path / "smck")
+    assert main(["train", "--model", "moe", "--sharded", "--steps", "2",
+                 "--ckpt", ckpt, "--groups", "16", "--endpoints", "4",
+                 "--hidden", "16", "--experts", "4"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "moe" and out["step"] == 2
+    assert main(["plan", "--model", "moe", "--sharded", "--ckpt", ckpt,
+                 "--groups", "16", "--endpoints", "4", "--hidden", "16",
+                 "--experts", "4"]) == 0
+    plan = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(plan["weights"]) == 16
+
+
+def test_sharded_moe_rejects_bad_expert_count(capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["train", "--model", "moe", "--sharded", "--steps", "1",
+              "--groups", "16", "--endpoints", "4", "--hidden", "16",
+              "--experts", "3"])
