@@ -23,15 +23,23 @@ logger = logging.getLogger(__name__)
 def register(sub) -> None:
     train = sub.add_parser(
         "train", help="Train the traffic policy model (TPU compute track)")
-    train.add_argument("--model", choices=("mlp", "temporal", "moe"),
+    train.add_argument("--model",
+                       choices=("mlp", "temporal", "moe", "deep"),
                        default="mlp",
                        help="mlp: snapshot MLP; temporal: causal "
                             "attention over a telemetry window; moe: "
                             "per-region expert MLPs with a learned "
-                            "top-1 gate.")
+                            "top-1 gate; deep: residual stage stack "
+                            "(pipeline-parallel under --sharded).")
     train.add_argument("--experts", type=int, default=4,
                        help="Expert count (moe model); with --sharded "
                             "must equal the expert mesh axis size.")
+    train.add_argument("--stages", type=int, default=4,
+                       help="Residual stage count (deep model); with "
+                            "--sharded must equal the device count.")
+    train.add_argument("--microbatches", type=int, default=4,
+                       help="GPipe microbatches (deep --sharded); must "
+                            "divide --groups.")
     train.add_argument("--window", type=int, default=64,
                        help="Telemetry window length (temporal model); "
                             "the default reaches the Pallas flash "
@@ -56,17 +64,26 @@ def register(sub) -> None:
                        help="Shard over all visible devices: temporal "
                             "-> data x seq mesh with ring attention "
                             "over the window; mlp -> data x model "
-                            "mesh (dp x tp).")
+                            "mesh (dp x tp); moe -> data x expert "
+                            "mesh with all_to_all dispatch; deep -> "
+                            "stage pipeline (GPipe).")
 
     plan = sub.add_parser(
         "plan", help="Plan GA endpoint weights for a fleet (JSON out)")
-    plan.add_argument("--model", choices=("mlp", "temporal", "moe"),
+    plan.add_argument("--model",
+                      choices=("mlp", "temporal", "moe", "deep"),
                       default="mlp",
                       help="Must match the model the ckpt was trained "
                            "with.")
     plan.add_argument("--experts", type=int, default=4,
                       help="Expert count (moe model; must match the "
                            "ckpt).")
+    plan.add_argument("--stages", type=int, default=4,
+                      help="Residual stage count (deep model; must "
+                           "match the ckpt).")
+    plan.add_argument("--microbatches", type=int, default=4,
+                      help="GPipe microbatches (deep --sharded); must "
+                           "divide --groups.")
     plan.add_argument("--window", type=int, default=64,
                       help="Telemetry window length (temporal model); "
                            "the default reaches the Pallas flash "
@@ -146,6 +163,18 @@ def _build_model(args):
                 key, groups=args.groups, endpoints=args.endpoints,
                 n_regions=args.experts),
             lambda: _moe_planner(args, model), sharded)
+    elif args.model == "deep":
+        from ..models.deep import DeepTrafficModel
+        from ..models.traffic import synthetic_batch
+
+        model = DeepTrafficModel(n_stages=args.stages,
+                                 hidden_dim=args.hidden,
+                                 learning_rate=lr)
+        run_step, run_plan_fwd = _snapshot_runners(
+            jax, model,
+            lambda key: synthetic_batch(
+                key, groups=args.groups, endpoints=args.endpoints),
+            lambda: _pipeline_planner(args, model), sharded)
     else:
         from ..models.traffic import TrafficPolicyModel, synthetic_batch
 
@@ -161,9 +190,9 @@ def _build_model(args):
 
 def _snapshot_runners(jax, model, make_batch, make_planner, sharded):
     """run_step/run_plan_fwd wiring shared by the snapshot-batch
-    families (mlp, moe): one synthetic Batch per step, planner-sharded
-    when requested.  The temporal family keeps its own wiring (its data
-    is a (window, batch) pair)."""
+    families (mlp, moe, deep): one synthetic Batch per step,
+    planner-sharded when requested.  The temporal family keeps its own
+    wiring (its data is a (window, batch) pair)."""
     if sharded:
         planner = make_planner()
 
@@ -227,6 +256,29 @@ def _moe_planner(args, model):
     logger.info("moe mesh: data=%d expert=%d", mesh.shape["data"],
                 mesh.shape["expert"])
     return ShardedMoEPlanner(model, mesh)
+
+
+def _pipeline_planner(args, model):
+    """1-D stage mesh: one residual block per device, GPipe schedule."""
+    import jax
+
+    from ..parallel import ShardedPipelinePlanner
+    from ..parallel.ring import make_mesh_1d
+
+    n_dev = len(jax.devices())
+    if args.stages != n_dev:
+        raise SystemExit(
+            f"--sharded deep needs --stages equal to the device count "
+            f"({n_dev}); got stages={args.stages}")
+    if args.groups % args.microbatches:
+        raise SystemExit(
+            f"--sharded deep needs --groups divisible by "
+            f"--microbatches; got groups={args.groups} "
+            f"microbatches={args.microbatches}")
+    logger.info("pipeline mesh: stage=%d microbatches=%d", n_dev,
+                args.microbatches)
+    return ShardedPipelinePlanner(model, make_mesh_1d(n_dev, "stage"),
+                                  n_microbatches=args.microbatches)
 
 
 def _mlp_planner(args, model):

@@ -42,8 +42,15 @@ class TrainableModel:
     def init_opt_state(self, params):
         return self.optimizer.init(params)
 
-    def train_step(self, params, opt_state, *data):
-        loss, grads = jax.value_and_grad(self.loss)(params, *data)
+    def train_step_with(self, loss_fn, params, opt_state, *data):
+        """The single optimizer-update implementation.  Sharded
+        planners that swap in a distributed loss (moe dispatch, GPipe
+        scores) call this with their own ``loss_fn`` so the update
+        itself can never drift from the dense families'."""
+        loss, grads = jax.value_and_grad(loss_fn)(params, *data)
         updates, opt_state = self.optimizer.update(grads, opt_state,
                                                    params)
         return optax.apply_updates(params, updates), opt_state, loss
+
+    def train_step(self, params, opt_state, *data):
+        return self.train_step_with(self.loss, params, opt_state, *data)

@@ -1,0 +1,88 @@
+"""Deep residual traffic model: the pipeline-parallel model family.
+
+Fourth compute-track family.  A deep stack of residual [H, H] scoring
+blocks — deep enough that on real fleets a single chip's HBM cannot
+hold all stages' activations at once, which is exactly the regime
+pipeline parallelism exists for.  ``parallel.pipeline_train`` trains
+this model with the GPipe microbatch schedule over a 'stage' mesh axis;
+this module is the dense single-chip form and the numerical oracle.
+
+The reference repo has no compute path (SURVEY.md §2: pipeline
+parallelism ABSENT upstream).
+
+Design notes (TPU-first):
+- every stage is h + relu(h @ w + b): activations stay well-scaled
+  through arbitrarily many stages, and each stage is one MXU matmul;
+- the dense forward is a python loop over stages UNDER jit — unrolled
+  at trace time into a static chain, no dynamic control flow;
+- parameters are stored stage-major ([S, H, H]) so the pipelined
+  planner shards dim 0 over the stage axis without reshapes.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops.weights import plan_weights
+from .common import TrainableModel, masked_ce_loss
+from .traffic import Batch, synthetic_batch  # noqa: F401  (re-export)
+
+Params = Dict[str, jax.Array]
+
+N_STAGES = 4
+FEATURE_DIM = 8
+HIDDEN_DIM = 64
+
+
+def stage_fn(h: jax.Array, w: jax.Array, b: jax.Array) -> jax.Array:
+    """One pipeline stage: residual relu block (shared with the
+    pipelined planner so dense and sharded cannot drift)."""
+    return h + jnp.maximum(h @ w + b, 0.0)
+
+
+class DeepTrafficModel(TrainableModel):
+    def __init__(self, n_stages: int = N_STAGES,
+                 feature_dim: int = FEATURE_DIM,
+                 hidden_dim: int = HIDDEN_DIM,
+                 learning_rate: float = 1e-3):
+        self.n_stages = n_stages
+        self.feature_dim = feature_dim
+        self.hidden_dim = hidden_dim
+        self.optimizer = optax.adam(learning_rate)
+
+    def init_params(self, key: jax.Array) -> Params:
+        k1, k2, k3 = jax.random.split(key, 3)
+        s, f, h = self.n_stages, self.feature_dim, self.hidden_dim
+        scale = lambda fan_in: 1.0 / jnp.sqrt(fan_in)  # noqa: E731
+        # float32 end to end: stage blocks are residual and deep —
+        # bf16 drift compounds per stage, and the pipelined planner's
+        # parity contract with this oracle is exact
+        return {
+            "w_in": jax.random.normal(k1, (f, h)) * scale(f),
+            "stage_w": jax.random.normal(k2, (s, h, h)) * scale(h),
+            "stage_b": jnp.zeros((s, h)),
+            "w_out": jax.random.normal(k3, (h, 1)) * scale(h),
+        }
+
+    # -- forward --------------------------------------------------------
+
+    def scores(self, params: Params, features: jax.Array) -> jax.Array:
+        """[G, E, F] -> [G, E] f32 scores through all stages."""
+        h = features.astype(jnp.float32) @ params["w_in"]
+        for i in range(self.n_stages):
+            h = stage_fn(h, params["stage_w"][i], params["stage_b"][i])
+        return (h @ params["w_out"])[..., 0]
+
+    def forward(self, params: Params, features: jax.Array,
+                mask: jax.Array) -> jax.Array:
+        """[G, E, F] + mask -> int32 GA weights [G, E]."""
+        return plan_weights(self.scores(params, features), mask)
+
+    # -- training -------------------------------------------------------
+
+    def loss(self, params: Params, batch: Batch) -> jax.Array:
+        return masked_ce_loss(self.scores(params, batch.features),
+                              batch.mask, batch.target)

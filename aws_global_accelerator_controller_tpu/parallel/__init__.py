@@ -23,6 +23,10 @@ from .pipeline import (  # noqa: F401
     make_pipeline,
     pipeline_reference,
 )
+from .pipeline_train import (  # noqa: F401
+    ShardedPipelinePlanner,
+    deep_param_specs,
+)
 from .plan import (  # noqa: F401
     ShardedTemporalPlanner,
     ShardedTrafficPlanner,

@@ -1,0 +1,37 @@
+"""Shared surface for the snapshot-batch sharded planners.
+
+``SnapshotPlannerMixin`` carries the shard_params/shard_batch/forward/
+train_step plumbing that ``ShardedTrafficPlanner``, ``ShardedMoEPlanner``
+and ``ShardedPipelinePlanner`` would otherwise copy-paste; a subclass
+sets ``param_shardings`` (dict), ``batch_shardings`` (Batch of
+shardings), ``_forward`` and ``_step`` in its ``__init__``.  The
+temporal planner keeps its own methods (its data is a (window, batch)
+pair and its params share one replicated sharding).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+
+from ..models.traffic import Batch
+
+
+class SnapshotPlannerMixin:
+    param_shardings: dict
+    batch_shardings: Batch
+
+    def shard_params(self, params) -> dict:
+        return {k: jax.device_put(v, self.param_shardings[k])
+                for k, v in params.items()}
+
+    def shard_batch(self, batch: Batch) -> Batch:
+        return Batch(*[jax.device_put(v, s)
+                       for v, s in zip(batch, self.batch_shardings)])
+
+    def forward(self, params, features, mask):
+        return self._forward(params, features, mask)
+
+    def train_step(self, params, opt_state,
+                   batch: Batch) -> Tuple[dict, object, jax.Array]:
+        return self._step(params, opt_state, batch)

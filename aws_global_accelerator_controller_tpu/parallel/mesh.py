@@ -32,6 +32,8 @@ def make_mesh(n_devices: Optional[int] = None,
     along an 'expert' axis).
     """
     devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
     if axis_shapes:
         want = int(np.prod(list(axis_shapes.values())))
         if len(devices) < want:
@@ -41,8 +43,6 @@ def make_mesh(n_devices: Optional[int] = None,
         grid = np.asarray(devices[:want]).reshape(
             tuple(axis_shapes.values()))
         return Mesh(grid, axis_names=tuple(axis_shapes))
-    if n_devices is not None:
-        devices = devices[:n_devices]
     n = len(devices)
     model, data = _factor(n)
     grid = np.asarray(devices).reshape(data, model)

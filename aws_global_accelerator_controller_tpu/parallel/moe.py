@@ -29,17 +29,16 @@ No reference analogue (SURVEY.md §2: EP ABSENT upstream).
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.common import masked_ce_loss
 from ..models.moe import MoETrafficModel, Params
 from ..models.traffic import Batch
 from ..ops.weights import plan_weights
+from .base import SnapshotPlannerMixin
 
 
 def moe_param_specs(expert_axis: str = "expert") -> dict:
@@ -54,7 +53,7 @@ def moe_param_specs(expert_axis: str = "expert") -> dict:
     }
 
 
-class ShardedMoEPlanner:
+class ShardedMoEPlanner(SnapshotPlannerMixin):
     """pjit-compiled MoE forward + train step bound to a mesh.
 
     Requires ``model.n_experts == mesh.shape[expert_axis]`` (one expert
@@ -132,10 +131,10 @@ class ShardedMoEPlanner:
             return ce + model.aux_weight * model.aux_loss(route, probs)
 
         def step(params, opt_state, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-            updates, opt_state = model.optimizer.update(
-                grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
+            # models/common.py owns the optimizer update; only the loss
+            # (with its all_to_all dispatch) is planner-specific
+            return model.train_step_with(loss_fn, params, opt_state,
+                                         batch)
 
         self._forward = jax.jit(
             lambda params, features, mask: plan_weights(
@@ -146,18 +145,13 @@ class ShardedMoEPlanner:
                              out_shardings=(ps, None, None))
         self.param_shardings = ps
         self.batch_shardings = bs
-
-    def shard_params(self, params: Params) -> Params:
-        return {k: jax.device_put(v, self.param_shardings[k])
-                for k, v in params.items()}
+        self._n_total = mesh.shape[data_axis] * mesh.shape[expert_axis]
 
     def shard_batch(self, batch: Batch) -> Batch:
-        return Batch(*[jax.device_put(v, s)
-                       for v, s in zip(batch, self.batch_shardings)])
-
-    def forward(self, params: Params, features, mask):
-        return self._forward(params, features, mask)
-
-    def train_step(self, params: Params, opt_state,
-                   batch: Batch) -> Tuple[Params, object, jax.Array]:
-        return self._step(params, opt_state, batch)
+        g = batch.features.shape[0]
+        if g % self._n_total:
+            raise ValueError(
+                f"groups ({g}) must be divisible by the mesh device "
+                f"count ({self._n_total}) — the batch shards over both "
+                f"axes")
+        return SnapshotPlannerMixin.shard_batch(self, batch)

@@ -1,0 +1,161 @@
+"""GPipe training for the deep traffic model (stage-sharded pipeline).
+
+``parallel.pipeline`` proves the GPipe schedule on a toy scorer; this
+module trains the real ``models.deep.DeepTrafficModel`` end-to-end with
+its residual stages sharded one-per-device along a 'stage' mesh axis.
+
+The forward streams M microbatches through the stage ring: at schedule
+step t, stage s applies its block to microbatch t-s and hands the
+activations to stage s+1 with one ``jax.lax.ppermute`` neighbour hop
+(ICI traffic only).  M + S - 1 steps fill and drain the pipe.  The loop
+is a ``lax.scan`` with static trip count — which is what makes the
+BACKWARD pipeline free: reverse-mode AD through the scan replays the
+schedule in reverse, and each ppermute transposes to the opposite-
+direction ppermute, so gradients stream stage S-1 -> 0 exactly like
+activations streamed 0 -> S-1.  Nobody hand-writes a backward schedule;
+XLA compiles the one autodiff derives.
+
+Stage parameters live sharded (P('stage')) so each device's HBM holds
+only its own block — the property that lets total depth scale with the
+number of stages.  w_in/w_out are replicated (they are O(F*H), small);
+their gradients psum over the stage axis via the shard_map transpose.
+
+No reference analogue (SURVEY.md §2: PP ABSENT upstream).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.common import masked_ce_loss
+from ..models.deep import DeepTrafficModel, Params, stage_fn
+from ..models.traffic import Batch
+from ..ops.weights import plan_weights
+from .base import SnapshotPlannerMixin
+
+
+def deep_param_specs(stage_axis: str = "stage") -> dict:
+    return {
+        "w_in": P(),
+        "stage_w": P(stage_axis, None, None),
+        "stage_b": P(stage_axis, None),
+        "w_out": P(),
+    }
+
+
+class ShardedPipelinePlanner(SnapshotPlannerMixin):
+    """pjit-compiled GPipe forward + train step bound to a 1-D mesh.
+
+    Requires ``model.n_stages == mesh.shape[stage_axis]`` (one residual
+    block per device) and G divisible by ``n_microbatches``.
+    """
+
+    def __init__(self, model: DeepTrafficModel, mesh: Mesh,
+                 n_microbatches: int = 4, stage_axis: str = "stage"):
+        if model.n_stages != mesh.shape[stage_axis]:
+            raise ValueError(
+                f"model has {model.n_stages} stages but the "
+                f"'{stage_axis}' mesh axis has {mesh.shape[stage_axis]} "
+                f"devices — pipeline layout is one stage per device")
+        self.model = model
+        self.mesh = mesh
+        self.n_microbatches = n_microbatches
+        s = mesh.shape[stage_axis]
+        m = n_microbatches
+
+        ps = {k: NamedSharding(mesh, spec)
+              for k, spec in deep_param_specs(stage_axis).items()}
+        rep = NamedSharding(mesh, P())
+        bs = Batch(features=rep, mask=rep, target=rep)
+
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(P(), P(stage_axis, None, None),
+                           P(stage_axis, None), P(),
+                           P()),
+                 out_specs=P(),
+                 check_vma=False)
+        def pipe(w_in, stage_w, stage_b, w_out, x):
+            # x [M, B, F] microbatched input (replicated); stage_w
+            # [1, H, H] this device's block
+            idx = jax.lax.axis_index(stage_axis)
+            h_in = x @ w_in                      # [M, B, H]
+            b_dim, h_dim = h_in.shape[1], h_in.shape[2]
+            perm = [(i, (i + 1) % s) for i in range(s)]
+            last = s - 1
+
+            def compute(t, recv, out):
+                mb = t - idx                     # this stage's microbatch
+                valid = jnp.logical_and(mb >= 0, mb < m)
+                mc = jnp.clip(mb, 0, m - 1)
+                inp = jnp.where(
+                    idx == 0,
+                    jax.lax.dynamic_index_in_dim(h_in, mc, axis=0,
+                                                 keepdims=False),
+                    recv)
+                h = stage_fn(inp, stage_w[0], stage_b[0])
+                keep = jnp.logical_and(valid, idx == last)
+                prev = jax.lax.dynamic_index_in_dim(out, mc, axis=0,
+                                                    keepdims=False)
+                out = jax.lax.dynamic_update_index_in_dim(
+                    out, jnp.where(keep, h, prev), mc, axis=0)
+                return h, out
+
+            def body(carry, t):
+                recv, out = carry
+                h, out = compute(t, recv, out)
+                return (jax.lax.ppermute(h, stage_axis, perm), out), None
+
+            out0 = jnp.zeros((m, b_dim, h_dim), h_in.dtype)
+            recv0 = jnp.zeros((b_dim, h_dim), h_in.dtype)
+            total = m + s - 1
+            (recv, out), _ = jax.lax.scan(body, (recv0, out0),
+                                          jnp.arange(total - 1))
+            # drain: the last stage records its final microbatch
+            _, out = compute(total - 1, recv, out)
+            out = jax.lax.psum(
+                jnp.where(idx == last, out, jnp.zeros_like(out)),
+                stage_axis)
+            return (out @ w_out)[..., 0]         # [M, B]
+
+        def scores(params: Params, features):
+            g, e, f = features.shape
+            if g % m:
+                raise ValueError(
+                    f"groups ({g}) must be divisible by "
+                    f"n_microbatches ({m})")
+            x = features.astype(jnp.float32).reshape(
+                m, (g // m) * e, f)
+            out = pipe(params["w_in"], params["stage_w"],
+                       params["stage_b"], params["w_out"], x)
+            return out.reshape(g, e)
+
+        def loss_fn(params: Params, batch: Batch):
+            return masked_ce_loss(scores(params, batch.features),
+                                  batch.mask, batch.target)
+
+        def step(params, opt_state, batch):
+            # models/common.py owns the optimizer update; only the loss
+            # (with its GPipe scores) is planner-specific
+            return model.train_step_with(loss_fn, params, opt_state,
+                                         batch)
+
+        self._forward = jax.jit(
+            lambda params, features, mask: plan_weights(
+                scores(params, features), mask),
+            in_shardings=(ps, bs.features, bs.mask),
+            out_shardings=rep)
+        self._step = jax.jit(step, in_shardings=(ps, None, bs),
+                             out_shardings=(ps, None, None))
+        self.param_shardings = ps
+        self.batch_shardings = bs
+
+    def shard_batch(self, batch: Batch) -> Batch:
+        g = batch.features.shape[0]
+        if g % self.n_microbatches:
+            raise ValueError(
+                f"groups ({g}) must be divisible by n_microbatches "
+                f"({self.n_microbatches})")
+        return SnapshotPlannerMixin.shard_batch(self, batch)

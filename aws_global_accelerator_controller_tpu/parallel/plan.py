@@ -21,13 +21,12 @@ inserts the data-axis gradient all-reduce.
 """
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.temporal import TemporalTrafficModel
 from ..models.traffic import Batch, Params, TrafficPolicyModel
+from .base import SnapshotPlannerMixin
 from .ring_attention import make_ring_attention
 
 
@@ -47,7 +46,7 @@ def batch_specs() -> Batch:
                  target=P("data", None))
 
 
-class ShardedTrafficPlanner:
+class ShardedTrafficPlanner(SnapshotPlannerMixin):
     """pjit-compiled forward + train step bound to a mesh."""
 
     def __init__(self, model: TrafficPolicyModel, mesh: Mesh):
@@ -71,21 +70,6 @@ class ShardedTrafficPlanner:
             out_shardings=(ps, None, None))
         self.param_shardings = ps
         self.batch_shardings = bs
-
-    def shard_params(self, params: Params) -> Params:
-        return {k: jax.device_put(v, self.param_shardings[k])
-                for k, v in params.items()}
-
-    def shard_batch(self, batch: Batch) -> Batch:
-        return Batch(*[jax.device_put(v, s)
-                       for v, s in zip(batch, self.batch_shardings)])
-
-    def forward(self, params: Params, features, mask):
-        return self._forward(params, features, mask)
-
-    def train_step(self, params: Params, opt_state,
-                   batch: Batch) -> Tuple[Params, object, jax.Array]:
-        return self._step(params, opt_state, batch)
 
 
 class ShardedTemporalPlanner:

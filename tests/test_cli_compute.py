@@ -154,3 +154,43 @@ def test_sharded_moe_rejects_bad_expert_count(capsys):
         main(["train", "--model", "moe", "--sharded", "--steps", "1",
               "--groups", "16", "--endpoints", "4", "--hidden", "16",
               "--experts", "3"])
+
+
+def test_deep_model_trains_and_plans(tmp_path, capsys):
+    ckpt = str(tmp_path / "dck")
+    assert main(["train", "--model", "deep", "--steps", "2",
+                 "--ckpt", ckpt, "--groups", "8", "--endpoints", "6",
+                 "--hidden", "16", "--stages", "3"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "deep" and out["step"] == 2
+    assert main(["plan", "--model", "deep", "--ckpt", ckpt,
+                 "--groups", "8", "--endpoints", "6", "--hidden", "16",
+                 "--stages", "3"]) == 0
+    plan = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(plan["weights"]) == 8
+
+
+def test_sharded_deep_trains_and_plans(tmp_path, capsys):
+    """--sharded --model deep runs the GPipe schedule over the 8
+    virtual CPU devices (one stage per device)."""
+    ckpt = str(tmp_path / "sdck")
+    assert main(["train", "--model", "deep", "--sharded", "--steps", "2",
+                 "--ckpt", ckpt, "--groups", "8", "--endpoints", "4",
+                 "--hidden", "16", "--stages", "8",
+                 "--microbatches", "2"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "deep" and out["step"] == 2
+    assert main(["plan", "--model", "deep", "--sharded", "--ckpt", ckpt,
+                 "--groups", "8", "--endpoints", "4", "--hidden", "16",
+                 "--stages", "8", "--microbatches", "2"]) == 0
+    plan = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(plan["weights"]) == 8
+
+
+def test_sharded_deep_rejects_bad_stage_count(capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["train", "--model", "deep", "--sharded", "--steps", "1",
+              "--groups", "8", "--endpoints", "4", "--hidden", "16",
+              "--stages", "3"])

@@ -1,0 +1,119 @@
+"""Deep traffic model + GPipe pipeline-parallel training.
+
+The dense model is the oracle; the pipelined planner must match it
+exactly (both run float32), including through training — the backward
+pipeline is autodiff's transpose of the forward schedule, so trajectory
+parity is the proof it is correct.  No reference analogue (SURVEY.md
+§2: PP ABSENT upstream).
+"""
+import jax
+import numpy as np
+import pytest
+
+from aws_global_accelerator_controller_tpu.models.deep import (
+    DeepTrafficModel,
+    synthetic_batch,
+)
+from aws_global_accelerator_controller_tpu.parallel import (
+    ShardedPipelinePlanner,
+)
+from aws_global_accelerator_controller_tpu.parallel.ring import (
+    make_mesh_1d,
+)
+
+
+def _setup(n_stages=4, groups=16, endpoints=8, hidden=32, seed=0):
+    model = DeepTrafficModel(n_stages=n_stages, hidden_dim=hidden)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    batch = synthetic_batch(jax.random.PRNGKey(seed + 1), groups=groups,
+                            endpoints=endpoints)
+    return model, params, batch
+
+
+def test_dense_training_reduces_loss():
+    model, params, batch = _setup()
+    opt = model.init_opt_state(params)
+    first = float(model.loss(params, batch))
+    step = jax.jit(model.train_step)
+    for _ in range(40):
+        params, opt, loss = step(params, opt, batch)
+    assert float(loss) < first
+
+
+def test_depth_changes_scores():
+    """Every stage contributes: zeroing the last stage's block changes
+    the output (the residual path alone is not the whole model)."""
+    model, params, batch = _setup()
+    base = np.asarray(model.scores(params, batch.features))
+    cut = dict(params)
+    cut["stage_w"] = params["stage_w"].at[-1].set(0.0)
+    got = np.asarray(model.scores(cut, batch.features))
+    assert not np.allclose(base, got)
+
+
+@pytest.fixture
+def mesh():
+    return make_mesh_1d(4, "stage")
+
+
+def test_pipelined_scores_match_dense(mesh):
+    model, params, batch = _setup(n_stages=mesh.shape["stage"])
+    planner = ShardedPipelinePlanner(model, mesh, n_microbatches=4)
+    sp = planner.shard_params(params)
+    sb = planner.shard_batch(batch)
+    got = np.asarray(planner.forward(sp, sb.features, sb.mask))
+    want = np.asarray(model.forward(params, batch.features, batch.mask))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("microbatches", [1, 2, 8])
+def test_microbatch_count_is_schedule_only(mesh, microbatches):
+    """M changes the schedule, never the math."""
+    model, params, batch = _setup(n_stages=mesh.shape["stage"])
+    planner = ShardedPipelinePlanner(model, mesh,
+                                     n_microbatches=microbatches)
+    got = np.asarray(planner.forward(planner.shard_params(params),
+                                     batch.features, batch.mask))
+    want = np.asarray(model.forward(params, batch.features, batch.mask))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pipelined_training_matches_dense_trajectory(mesh):
+    """Five GPipe train steps track the dense oracle: the scan/ppermute
+    transpose IS the backward pipeline."""
+    model, params, batch = _setup(n_stages=mesh.shape["stage"])
+    planner = ShardedPipelinePlanner(model, mesh, n_microbatches=4)
+
+    d_params, d_opt = params, model.init_opt_state(params)
+    s_params = planner.shard_params(params)
+    s_opt = model.init_opt_state(s_params)
+    sb = planner.shard_batch(batch)
+    dense_step = jax.jit(model.train_step)
+
+    for i in range(5):
+        d_params, d_opt, d_loss = dense_step(d_params, d_opt, batch)
+        s_params, s_opt, s_loss = planner.train_step(s_params, s_opt, sb)
+        assert float(s_loss) == pytest.approx(float(d_loss),
+                                              rel=1e-5), i
+    for k in d_params:
+        np.testing.assert_allclose(np.asarray(s_params[k]),
+                                   np.asarray(d_params[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_stage_params_actually_sharded(mesh):
+    """Each device's HBM holds only its own stage block — the memory
+    property pipeline parallelism exists for."""
+    model, params, batch = _setup(n_stages=mesh.shape["stage"])
+    planner = ShardedPipelinePlanner(model, mesh)
+    sp = planner.shard_params(params)
+    shards = sp["stage_w"].addressable_shards
+    assert len(shards) == mesh.shape["stage"]
+    assert all(s.data.shape == (1,) + params["stage_w"].shape[1:]
+               for s in shards)
+
+
+def test_rejects_stage_count_mismatch(mesh):
+    model = DeepTrafficModel(n_stages=3)
+    with pytest.raises(ValueError, match="stage"):
+        ShardedPipelinePlanner(model, mesh)
