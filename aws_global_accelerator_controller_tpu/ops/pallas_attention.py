@@ -57,7 +57,7 @@ def _auto_block(t: int, block) -> int:
 
 
 def _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, *,
-                 causal: bool, scale: float, t: int, block_q: int,
+                 causal: bool, t: int, block_q: int,
                  block_k: int, num_k: int):
     """Shared online-softmax step: fold K block j into the (m, l, acc)
     scratch for Q block i.  Callers add init/finalize around it.
@@ -78,9 +78,11 @@ def _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, *,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def _scores():
+        # q arrives pre-scaled by 1/sqrt(D) (folded in by the caller:
+        # one [T, D] multiply instead of one per [Bq, Bk] tile)
         return jax.lax.dot_general(
             q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [Bq, Bk] f32
+            preferred_element_type=jnp.float32)           # [Bq, Bk] f32
 
     def _fold(s):
         m_prev = m_ref[:, 0]                      # [Bq]
@@ -130,10 +132,10 @@ def _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, *,
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-            causal: bool, scale: float, t: int, block_q: int,
+            causal: bool, t: int, block_q: int,
             block_k: int, num_k: int):
     _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
-                 causal=causal, scale=scale, t=t, block_q=block_q,
+                 causal=causal, t=t, block_q=block_q,
                  block_k=block_k, num_k=num_k)
 
     @pl.when(pl.program_id(2) == num_k - 1)
@@ -143,13 +145,13 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
 
 def _stats_kernel(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
-                  m_ref, l_ref, acc_ref, *, causal: bool, scale: float,
+                  m_ref, l_ref, acc_ref, *, causal: bool,
                   t: int, block_q: int, block_k: int, num_k: int):
     """Like _kernel but emits UNNORMALISED output plus the (m, l) softmax
     stats, so a caller (ring attention) can merge blocks computed
     elsewhere with the standard two-level flash recurrence."""
     _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
-                 causal=causal, scale=scale, t=t, block_q=block_q,
+                 causal=causal, t=t, block_q=block_q,
                  block_k=block_k, num_k=num_k)
 
     @pl.when(pl.program_id(2) == num_k - 1)
@@ -165,12 +167,21 @@ def _pad_axis(x, axis, to):
     return jnp.pad(x, pad)
 
 
+def _prescale(q):
+    """Fold 1/sqrt(D) into q: one [..., D] multiply replacing a
+    per-[Bq, Bk]-tile multiply inside the kernels (which are VPU-bound,
+    so per-tile elementwise work is the scarce resource).  Single
+    deterministic rounding step — the VJP saves THIS rounded q' as its
+    residual so the backward's score recompute matches the forward's
+    saved (m, l) stats bit-for-bit, bf16 included."""
+    return (q.astype(jnp.float32) * q.shape[-1] ** -0.5).astype(q.dtype)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret"))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
     t, h, d = q.shape
-    scale = d ** -0.5
     tp_q = -(-t // block_q) * block_q
     tp_k = -(-t // block_k) * block_k
     dp = -(-d // _LANE) * _LANE
@@ -180,11 +191,11 @@ def _flash(q, k, v, causal, block_q, block_k, interpret):
         x = jnp.transpose(x, (1, 0, 2))
         return _pad_axis(_pad_axis(x, 1, tp), 2, dp)
 
-    qp, kp, vp = prep(q, tp_q), prep(k, tp_k), prep(v, tp_k)
+    qp, kp, vp = prep(_prescale(q), tp_q), prep(k, tp_k), prep(v, tp_k)
     num_k = tp_k // block_k
 
     out = pl.pallas_call(
-        functools.partial(_kernel, causal=causal, scale=scale, t=t,
+        functools.partial(_kernel, causal=causal, t=t,
                           block_q=block_q, block_k=block_k, num_k=num_k),
         grid=(h, tp_q // block_q, num_k),
         in_specs=[
@@ -238,8 +249,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref, dq_ref,
                dq_acc, *, causal: bool, scale: float, t: int,
                block_q: int, block_k: int, num_k: int):
-    """K-innermost sweep: dQ_i = sum_j (p_ij * (dP_ij - D_i)) * scale @ K_j
-    with p re-materialised from the saved (m, l) row stats."""
+    """K-innermost sweep: dQ'_i = sum_j (p_ij * (dP_ij - D_i)) @ K_j,
+    with p re-materialised from the saved (m, l) row stats.  q arrives
+    PRE-SCALED — the SAME rounded q' the forward used, so s (and hence
+    p) matches the saved stats bit-for-bit even in bf16.  The chain
+    rule's 1/sqrt(D) (q' = q * scale) lands once on dq at finalize."""
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -248,7 +262,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref, dq_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def _accumulate(masked: bool):
-        q = q_ref[0]                              # [Bq, D] native dtype
+        q = q_ref[0]                              # [Bq, D] pre-scaled
         k = k_ref[0]                              # [Bk, D]
         v = v_ref[0]
         do = do_ref[0]                            # [Bq, D]
@@ -258,7 +272,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref, dq_ref,
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [Bq, Bk]
+            preferred_element_type=jnp.float32)           # [Bq, Bk]
         if masked:
             q_pos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -273,7 +287,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [Bq, Bk]
-        ds = p * (dp - dvec[:, None]) * scale
+        ds = p * (dp - dvec[:, None])
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -295,15 +309,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref, dq_ref,
 
     @pl.when(j == num_k - 1)
     def _finalize():
-        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
-                scale: float, t: int, block_q: int, block_k: int,
+                t: int, block_q: int, block_k: int,
                 num_q: int):
     """Q-innermost sweep: dV_j = sum_i p_ij^T @ dO_i and
-    dK_j = sum_i (p_ij * (dP_ij - D_i))^T @ Q_i * scale."""
+    dK_j = sum_i (p_ij * (dP_ij - D_i))^T @ Q'_i.  q arrives PRE-SCALED
+    (q' = q/sqrt(D)), which both makes p match the forward's saved
+    stats exactly and already carries the scale dK needs."""
     j = pl.program_id(1)                          # K block
     i = pl.program_id(2)                          # Q block (innermost)
 
@@ -321,10 +337,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
         l = l_ref[0][:, 0]
         dvec = d_ref[0][:, 0]
 
-        # transposed score tile: s_T[kk, qq] = k_kk . q_qq * scale
+        # transposed score tile: s_T[kk, qq] = k_kk . q'_qq
         s_t = jax.lax.dot_general(
             k, q, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [Bk, Bq]
+            preferred_element_type=jnp.float32)           # [Bk, Bq]
         if masked:
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_k, block_q), 0)
@@ -341,7 +357,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
         dp_t = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [Bk, Bq]
-        ds_t = p_t * (dp_t - dvec[None, :]) * scale
+        ds_t = p_t * (dp_t - dvec[None, :])
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -375,8 +391,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
 def _flash_fwd_padded(q, k, v, causal, block_q, block_k, interpret):
     """Head-major forward keeping the PADDED per-row stats for the VJP.
 
-    q/k/v: [H, T, D] -> (o [H, T, D] normalised f32, m [H, Tp, LANE],
-    l [H, Tp, LANE]) where Tp is T rounded up to block_q."""
+    q/k/v: [H, T, D], q PRE-SCALED by ``_prescale`` -> (o [H, T, D]
+    normalised f32, m [H, Tp, LANE], l [H, Tp, LANE]) where Tp is T
+    rounded up to block_q."""
     h, t, d = q.shape
     o_un, m, l = _flash_stats_padded(q, k, v, causal, block_q, block_k,
                                      interpret)
@@ -389,17 +406,20 @@ def _flash_stats_padded(q, k, v, causal, block_q, block_k, interpret):
     VJP forward (keeps padding).  Head-major [H, T, D] inputs."""
     h, t, d = q.shape
     t_k = k.shape[1]
-    scale = d ** -0.5
     tp_q = -(-t // block_q) * block_q
     tp_k = -(-t_k // block_k) * block_k
     dp = -(-d // _LANE) * _LANE
+    # q must arrive PRE-SCALED by 1/sqrt(D) (_prescale): the VJP
+    # forward saves that exact rounded q as its residual so the
+    # backward's score recompute matches the saved (m, l) stats
+    # bit-for-bit
     qp = _pad_axis(_pad_axis(q, 1, tp_q), 2, dp)
     kp = _pad_axis(_pad_axis(k, 1, tp_k), 2, dp)
     vp = _pad_axis(_pad_axis(v, 1, tp_k), 2, dp)
     num_k = tp_k // block_k
 
     return pl.pallas_call(
-        functools.partial(_stats_kernel, causal=causal, scale=scale,
+        functools.partial(_stats_kernel, causal=causal,
                           t=t_k, block_q=block_q, block_k=block_k,
                           num_k=num_k),
         grid=(h, tp_q // block_q, num_k),
@@ -441,12 +461,12 @@ def _flash_stats_padded(q, k, v, causal, block_q, block_k, interpret):
 def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
                       interpret):
     """Head-major backward.  q/k/v/o/do: [H, T, D] (o f32; q/k/v/do keep
-    their native dtype so the MXU runs bf16 passes); m/l:
-    [H, Tp, 1] stats saved by the forward (re-broadcast to the lane
-    width here, like dvec — residuals stay 1-lane).  Returns
-    (dq, dk, dv) [H, T, D] f32."""
+    their native dtype so the MXU runs bf16 passes; q is the PRE-SCALED
+    q' the forward saved as its residual); m/l: [H, Tp, 1] stats saved
+    by the forward (re-broadcast to the lane width here, like dvec —
+    residuals stay 1-lane).  Returns (dq, dk, dv) [H, T, D] f32."""
     h, t, d = q.shape
-    scale = d ** -0.5
+    scale = d ** -0.5  # applied once to dq at finalize (chain rule)
     tp_q = -(-t // block_q) * block_q
     tp_k = -(-t // block_k) * block_k
     dp = -(-d // _LANE) * _LANE
@@ -488,7 +508,7 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
     )(qp, kp, vp, dop, m, l, dvec)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, scale=scale, t=t,
+        functools.partial(_dkv_kernel, causal=causal, t=t,
                           block_q=block_q, block_k=block_k, num_q=num_q),
         grid=(h, num_k, num_q),
         in_specs=[
@@ -526,25 +546,28 @@ def _flash_diff(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _flash_diff_fwd(q, k, v, causal, block_q, block_k, interpret):
-    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    # save the PRE-SCALED head-major q' as the residual: the backward's
+    # score recompute then reproduces the forward's s (and p) exactly
+    qh = _prescale(jnp.transpose(q, (1, 0, 2)))
+    kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (k, v))
     oh, m, l = _flash_fwd_padded(qh, kh, vh, causal, block_q, block_k,
                                  interpret)
     o = jnp.transpose(oh, (1, 0, 2)).astype(q.dtype)
     # keep only lane 0 of the stats: residual memory stays O(T), not
     # O(T * LANE) — the backward re-broadcasts
-    return o, (q, k, v, oh, m[:, :, :1], l[:, :, :1])
+    return o, (qh, kh, vh, oh, m[:, :, :1], l[:, :, :1])
 
 
 def _flash_diff_bwd(causal, block_q, block_k, interpret, res, do):
-    q, k, v, oh, m, l = res
-    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    qh, kh, vh, oh, m, l = res
     # keep do in its native dtype: the dP and dV matmuls consume it
     # directly, and bf16 operands keep the MXU on its fast path
     doh = jnp.transpose(do, (1, 0, 2))
     dq, dk, dv = _flash_bwd_padded(qh, kh, vh, oh, doh, m, l, causal,
                                    block_q, block_k, interpret)
-    back = lambda g, x: jnp.transpose(g, (1, 0, 2)).astype(x.dtype)
-    return back(dq, q), back(dk, k), back(dv, v)
+    back = lambda g, x: (
+        jnp.transpose(g, (1, 0, 2)).astype(x.dtype))
+    return back(dq, qh), back(dk, kh), back(dv, vh)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -555,8 +578,8 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
                                     "interpret"))
 def _flash_stats(q, k, v, causal, block_q, block_k, interpret):
     t, d = q.shape[1], q.shape[2]
-    o, m, l = _flash_stats_padded(q, k, v, causal, block_q, block_k,
-                                  interpret)
+    o, m, l = _flash_stats_padded(_prescale(q), k, v, causal, block_q,
+                                  block_k, interpret)
     return o[:, :t, :d], m[:, :t, 0], l[:, :t, 0]
 
 
