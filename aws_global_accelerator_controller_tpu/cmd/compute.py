@@ -40,6 +40,13 @@ def register(sub) -> None:
     train.add_argument("--microbatches", type=int, default=4,
                        help="GPipe microbatches (deep --sharded); must "
                             "divide --groups.")
+    train.add_argument("--loader", choices=("synthetic", "native"),
+                       default="synthetic",
+                       help="Batch source (mlp/deep): synthetic = "
+                            "reproducible JAX batches; native = the "
+                            "C++ background pipeline "
+                            "(native/telemetry.cpp), higher input "
+                            "throughput, not bit-reproducible.")
     train.add_argument("--window", type=int, default=64,
                        help="Telemetry window length (temporal model); "
                             "the default reaches the Pallas flash "
@@ -116,6 +123,12 @@ def _build_model(args):
 
     lr = getattr(args, "lr", 1e-3)
     sharded = getattr(args, "sharded", False)
+    loader_kind = getattr(args, "loader", "synthetic")
+    if loader_kind != "synthetic" and args.model not in ("mlp", "deep"):
+        raise SystemExit(
+            f"--loader {loader_kind} supports the snapshot-telemetry "
+            f"families (mlp, deep); {args.model} generates its own "
+            f"batch law")
     if args.model == "temporal":
         from ..models.temporal import TemporalTrafficModel, synthetic_window
 
@@ -165,27 +178,51 @@ def _build_model(args):
             lambda: _moe_planner(args, model), sharded)
     elif args.model == "deep":
         from ..models.deep import DeepTrafficModel
-        from ..models.traffic import synthetic_batch
 
         model = DeepTrafficModel(n_stages=args.stages,
                                  hidden_dim=args.hidden,
                                  learning_rate=lr)
         run_step, run_plan_fwd = _snapshot_runners(
-            jax, model,
-            lambda key: synthetic_batch(
-                key, groups=args.groups, endpoints=args.endpoints),
+            jax, model, _batch_source(args, loader_kind),
             lambda: _pipeline_planner(args, model), sharded)
     else:
-        from ..models.traffic import TrafficPolicyModel, synthetic_batch
+        from ..models.traffic import TrafficPolicyModel
 
         model = TrafficPolicyModel(hidden_dim=args.hidden,
                                    learning_rate=lr)
         run_step, run_plan_fwd = _snapshot_runners(
-            jax, model,
-            lambda key: synthetic_batch(
-                key, groups=args.groups, endpoints=args.endpoints),
+            jax, model, _batch_source(args, loader_kind),
             lambda: _mlp_planner(args, model), sharded)
     return model, run_step, run_plan_fwd
+
+
+def _batch_source(args, loader_kind: str):
+    """make_batch(key) for the snapshot families.  synthetic keeps the
+    historical contract (batches keyed by fold_in(key, step), so resume
+    trajectories are unchanged); native streams from the C++ pipeline,
+    ignoring the per-step key (worker streams are seeded once).  Native
+    loaders register in _open_loaders; run_train/run_plan close them
+    when the command finishes so in-process callers (tests) don't leak
+    worker threads across invocations."""
+    if loader_kind == "synthetic":
+        from ..models.traffic import synthetic_batch
+
+        return lambda key: synthetic_batch(
+            key, groups=args.groups, endpoints=args.endpoints)
+    from ..models.loader import make_loader
+
+    loader = make_loader(loader_kind, args.groups, args.endpoints,
+                         seed=args.seed)
+    _open_loaders.append(loader)
+    return lambda key: loader.next_batch()
+
+
+_open_loaders: list = []
+
+
+def _close_loaders() -> None:
+    while _open_loaders:
+        _open_loaders.pop().close()
 
 
 def _snapshot_runners(jax, model, make_batch, make_planner, sharded):
@@ -297,6 +334,13 @@ def _mlp_planner(args, model):
 
 
 def run_train(args) -> int:
+    try:
+        return _run_train(args)
+    finally:
+        _close_loaders()
+
+
+def _run_train(args) -> int:
     from ..jaxenv import import_jax
     jax = import_jax()
 
@@ -337,6 +381,13 @@ def run_train(args) -> int:
 
 
 def run_plan(args) -> int:
+    try:
+        return _run_plan(args)
+    finally:
+        _close_loaders()
+
+
+def _run_plan(args) -> int:
     from ..jaxenv import import_jax
     jax = import_jax()
 

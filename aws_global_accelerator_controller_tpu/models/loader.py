@@ -1,0 +1,188 @@
+"""Telemetry batch loaders: the framework's input pipeline.
+
+Two implementations behind one surface:
+
+- :class:`NativeTelemetryLoader` — the C++ pipeline
+  (``native/telemetry.cpp``): a worker-thread pool fills a bounded ring
+  of ready batches; ``next_batch`` pops with the GIL released, so batch
+  N+1 is generated while the device runs step N.  Per-thread
+  deterministic streams, but ring ordering depends on scheduling — use
+  it for throughput, not bit-exact reproducibility.
+- :class:`SyntheticTelemetryLoader` — the JAX path
+  (``traffic.synthetic_batch`` keyed by ``fold_in(seed, step)``):
+  bit-exact reproducible, what checkpoint-resume tests rely on.
+
+``make_loader("native"|"synthetic", ...)`` picks one; "native" degrades
+to synthetic (with a warning) when no C++ toolchain is available, the
+same policy as ``kube.workqueue.new_rate_limiting_queue``.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .traffic import Batch, synthetic_batch
+
+logger = logging.getLogger(__name__)
+
+_lib = None
+_lib_lock = threading.Lock()
+_lib_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _lib_failed:
+            return None
+        from ..native import ensure_library
+
+        path = ensure_library("telemetry")
+        if path is None:
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.aga_tl_new.restype = ctypes.c_void_p
+        lib.aga_tl_new.argtypes = [ctypes.c_int, ctypes.c_int,
+                                   ctypes.c_int, ctypes.c_int,
+                                   ctypes.c_int, ctypes.c_uint64]
+        lib.aga_tl_next.restype = ctypes.c_int
+        lib.aga_tl_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.aga_tl_stats.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_uint64),
+                                     ctypes.POINTER(ctypes.c_int)]
+        lib.aga_tl_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class SyntheticTelemetryLoader:
+    """JAX-keyed reproducible batches (the CLI default)."""
+
+    def __init__(self, groups: int, endpoints: int,
+                 feature_dim: int = 8, seed: int = 0):
+        import jax
+
+        self._jax = jax
+        self.groups, self.endpoints = groups, endpoints
+        self.feature_dim = feature_dim
+        self._key = jax.random.PRNGKey(seed)
+        self._step = 0
+
+    def next_batch(self) -> Batch:
+        key = self._jax.random.fold_in(self._key, self._step)
+        self._step += 1
+        return synthetic_batch(key, groups=self.groups,
+                               endpoints=self.endpoints,
+                               feature_dim=self.feature_dim)
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NativeTelemetryLoader:
+    """C++ background pipeline; see module docstring for the contract."""
+
+    def __init__(self, groups: int, endpoints: int,
+                 feature_dim: int = 8, seed: int = 0,
+                 capacity: int = 4, n_threads: int = 2):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native telemetry loader unavailable (no g++ / build "
+                "failed); use make_loader which degrades gracefully")
+        self._lib = lib
+        self.groups, self.endpoints = groups, endpoints
+        self.feature_dim = feature_dim
+        self._h = lib.aga_tl_new(groups, endpoints, feature_dim,
+                                 capacity, n_threads,
+                                 ctypes.c_uint64(seed or 1))
+        if not self._h:
+            raise RuntimeError("native telemetry loader init failed")
+        self._closed = False
+
+    def next_batch(self) -> Batch:
+        import jax.numpy as jnp
+
+        if self._closed:
+            raise RuntimeError("telemetry loader is closed")
+        g, e, f = self.groups, self.endpoints, self.feature_dim
+        features = np.empty((g, e, f), np.float32)
+        mask = np.empty((g, e), np.uint8)
+        target = np.empty((g, e), np.float32)
+        ok = self._lib.aga_tl_next(
+            self._h,
+            features.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            target.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if not ok:
+            raise RuntimeError("telemetry loader stopped")
+        return Batch(features=jnp.asarray(features, jnp.bfloat16),
+                     mask=jnp.asarray(mask.astype(bool)),
+                     target=jnp.asarray(target))
+
+    def stats(self) -> dict:
+        if self._closed:
+            raise RuntimeError("telemetry loader is closed")
+        produced = ctypes.c_uint64()
+        depth = ctypes.c_int()
+        self._lib.aga_tl_stats(self._h, ctypes.byref(produced),
+                               ctypes.byref(depth))
+        return {"produced": produced.value, "ring_depth": depth.value}
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.aga_tl_free(self._h)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def make_loader(kind: str, groups: int, endpoints: int,
+                feature_dim: int = 8, seed: int = 0, **kw):
+    """"native" -> C++ pipeline (degrades to synthetic with a warning
+    when unbuildable); "synthetic" -> reproducible JAX batches."""
+    if kind == "native":
+        if native_available():
+            return NativeTelemetryLoader(groups, endpoints, feature_dim,
+                                         seed, **kw)
+        logger.warning("native telemetry loader unavailable; "
+                       "falling back to synthetic")
+    elif kind != "synthetic":
+        raise ValueError(f"unknown loader kind {kind!r}")
+    return SyntheticTelemetryLoader(groups, endpoints, feature_dim, seed)

@@ -1,0 +1,230 @@
+// Native telemetry data loader: background batch generation + ring buffer.
+//
+// The compute track trains on fleet-telemetry batches (models/traffic.py
+// synthetic_batch: features [G, E, F] ~ N(0, 1), health/validity Bernoulli
+// masks, target = capacity-proportional weights among healthy+valid
+// endpoints).  Generating those on the Python side serialises with the
+// training loop; this loader is the framework's native input pipeline: a
+// pool of C++ threads fills a bounded ring of ready batches, and the
+// consumer pops with the GIL released (ctypes releases it for the foreign
+// call), so batch N+1 is generated while the device runs step N.  The
+// reference repo has no data path at all (it is a Kubernetes controller,
+// SURVEY.md preamble); this is the data-loader role a training framework
+// needs, done native like the workqueue (native/workqueue.cpp).
+//
+// Exposed through a plain C ABI consumed via ctypes
+// (models/loader.py: TelemetryLoader), mirroring native_workqueue.py.
+//
+// Randomness: one splitmix64-seeded xoshiro256++ stream per worker thread
+// (seed, thread index) -> deterministic PER THREAD, but batch ordering in
+// the ring depends on thread scheduling; callers needing bit-exact
+// reproducibility use the JAX synthetic_batch path instead (the CLI
+// default).  Normals via Box-Muller on uniform doubles.
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<float> features;  // [G, E, F]
+  std::vector<uint8_t> mask;    // [G, E]
+  std::vector<float> target;    // [G, E]
+};
+
+// -- PRNG: splitmix64 seeding + xoshiro256++ --------------------------------
+
+static inline uint64_t splitmix64(uint64_t& x) {
+  uint64_t z = (x += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+struct Rng {
+  uint64_t s[4];
+  explicit Rng(uint64_t seed) {
+    for (int i = 0; i < 4; i++) s[i] = splitmix64(seed);
+  }
+  static inline uint64_t rotl(uint64_t x, int k) {
+    return (x << k) | (x >> (64 - k));
+  }
+  uint64_t next() {
+    const uint64_t result = rotl(s[0] + s[3], 23) + s[0];
+    const uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+  // uniform in [0, 1) with 53-bit resolution
+  double uniform() { return (next() >> 11) * 0x1.0p-53; }
+  // standard normal via Box-Muller (one value per call; cache the pair)
+  bool has_spare = false;
+  double spare = 0.0;
+  double normal() {
+    if (has_spare) {
+      has_spare = false;
+      return spare;
+    }
+    double u, v, s2;
+    do {
+      u = 2.0 * uniform() - 1.0;
+      v = 2.0 * uniform() - 1.0;
+      s2 = u * u + v * v;
+    } while (s2 >= 1.0 || s2 == 0.0);
+    const double f = std::sqrt(-2.0 * std::log(s2) / s2);
+    spare = v * f;
+    has_spare = true;
+    return u * f;
+  }
+};
+
+struct Loader {
+  int groups, endpoints, features, capacity;
+  std::mutex mu;
+  std::condition_variable cv_pop;   // consumers wait for a ready batch
+  std::condition_variable cv_push;  // producers wait for ring space
+  std::condition_variable cv_drain; // stop() waits for consumers to leave
+  std::deque<Batch> ring;
+  bool stopping = false;
+  int active_consumers = 0;         // threads inside aga_tl_next's wait
+  std::atomic<uint64_t> produced{0};
+  std::vector<std::thread> workers;
+
+  Loader(int g, int e, int f, int cap) :
+      groups(g), endpoints(e), features(f), capacity(cap) {}
+
+  Batch generate(Rng& rng) const {
+    Batch b;
+    const int G = groups, E = endpoints, F = features;
+    b.features.resize(size_t(G) * E * F);
+    b.mask.resize(size_t(G) * E);
+    b.target.resize(size_t(G) * E);
+    for (auto& x : b.features) x = float(rng.normal());
+    for (int g = 0; g < G; g++) {
+      double denom = 0.0;
+      std::vector<double> raw(E, 0.0);
+      for (int e = 0; e < E; e++) {
+        const bool healthy = rng.uniform() < 0.9;
+        const bool valid = rng.uniform() < 0.8;
+        b.mask[size_t(g) * E + e] = valid ? 1 : 0;
+        if (healthy && valid) {
+          // capacity proxy: exp of feature 0, as in synthetic_batch
+          raw[e] = std::exp(double(
+              b.features[(size_t(g) * E + e) * F]));
+          denom += raw[e];
+        }
+      }
+      for (int e = 0; e < E; e++)
+        b.target[size_t(g) * E + e] =
+            denom > 0.0 ? float(raw[e] / denom) : 0.0f;
+    }
+    return b;
+  }
+
+  void worker(uint64_t seed) {
+    Rng rng(seed);
+    for (;;) {
+      Batch b = generate(rng);  // outside the lock: the expensive part
+      std::unique_lock<std::mutex> lk(mu);
+      cv_push.wait(lk, [&] {
+        return stopping || int(ring.size()) < capacity;
+      });
+      if (stopping) return;
+      ring.push_back(std::move(b));
+      produced.fetch_add(1, std::memory_order_relaxed);
+      cv_pop.notify_one();
+    }
+  }
+
+  void start(int n_threads, uint64_t seed) {
+    for (int i = 0; i < n_threads; i++)
+      workers.emplace_back(&Loader::worker, this,
+                           seed * 0x9e3779b97f4a7c15ULL + i + 1);
+  }
+
+  void stop() {
+    // Deletion safety: a consumer may be blocked inside aga_tl_next
+    // with the GIL released.  Wake everyone, then WAIT for every
+    // consumer to leave the critical section before the caller frees
+    // this object (the workqueue keeps shutdown and free separate for
+    // the same reason; here free implies a drain).
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      stopping = true;
+      cv_pop.notify_all();
+      cv_push.notify_all();
+      cv_drain.wait(lk, [&] { return active_consumers == 0; });
+    }
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* aga_tl_new(int groups, int endpoints, int features, int capacity,
+                 int n_threads, uint64_t seed) {
+  if (groups <= 0 || endpoints <= 0 || features <= 0 || capacity <= 0 ||
+      n_threads <= 0)
+    return nullptr;
+  auto* l = new Loader(groups, endpoints, features, capacity);
+  l->start(n_threads, seed);
+  return l;
+}
+
+// Blocking pop into caller-provided buffers (sized [G*E*F], [G*E],
+// [G*E]).  Returns 1 on success, 0 when the loader was stopped.  Called
+// with the GIL released (ctypes), so Python threads park here natively.
+int aga_tl_next(void* h, float* features, uint8_t* mask, float* target) {
+  auto* l = static_cast<Loader*>(h);
+  Batch b;
+  {
+    std::unique_lock<std::mutex> lk(l->mu);
+    l->active_consumers++;
+    l->cv_pop.wait(lk, [&] { return l->stopping || !l->ring.empty(); });
+    const bool ok = !l->stopping && !l->ring.empty();
+    if (ok) {
+      b = std::move(l->ring.front());
+      l->ring.pop_front();
+      l->cv_push.notify_one();
+    }
+    l->active_consumers--;
+    if (l->active_consumers == 0) l->cv_drain.notify_all();
+    if (!ok) return 0;  // stopping: caller must not touch the loader
+  }
+  std::memcpy(features, b.features.data(),
+              b.features.size() * sizeof(float));
+  std::memcpy(mask, b.mask.data(), b.mask.size());
+  std::memcpy(target, b.target.data(), b.target.size() * sizeof(float));
+  return 1;
+}
+
+// (produced batch count, current ring depth) for observability.
+void aga_tl_stats(void* h, uint64_t* produced, int* depth) {
+  auto* l = static_cast<Loader*>(h);
+  *produced = l->produced.load(std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lk(l->mu);
+  *depth = int(l->ring.size());
+}
+
+void aga_tl_free(void* h) {
+  auto* l = static_cast<Loader*>(h);
+  l->stop();
+  delete l;
+}
+
+}  // extern "C"

@@ -294,12 +294,6 @@ def bench_temporal_train(t: int = 2048, g: int = 8, e: int = 16,
     }
 
 
-def _timed_call(np, f, *args) -> float:
-    start = time.perf_counter()
-    np.asarray(f(*args))
-    return time.perf_counter() - start
-
-
 def _json_bench_subprocess(fn_name: str, what: str,
                            timeout: float) -> dict:
     """Run bench.<fn_name>() in an isolated process (bounded init + one
@@ -316,22 +310,23 @@ def _json_bench_subprocess(fn_name: str, what: str,
         return {"skipped": f"unparseable output: {out[-200:]}"}
 
 
-def tpu_probe(timeout: float = 60.0) -> "str | None":
-    """Fast gate for the TPU benches: run one tiny op in a subprocess.
+def tpu_probe(timeout: float = 60.0) -> "tuple[str, str]":
+    """Fast gate for the accelerator benches: one tiny op, subprocess.
 
     The tunneled backend wedges intermittently at device init (observed
     both rounds); without this gate every TPU bench would burn its full
     subprocess timeout (plus retry) against a dead tunnel.  Returns
-    None when healthy, else the skip reason."""
+    (status, detail): status "tpu" (healthy TPU — run everything),
+    "other" (healthy non-TPU backend — run only the backend-agnostic
+    benches), or "dead" (backend init wedged — skip everything)."""
     code = ("import jax, jax.numpy as jnp; "
             "x = (jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum(); "
             "print(jax.default_backend(), float(x))")
     out, diag = _run_subprocess(code, timeout, "tpu probe", retries=0)
     if out is None:
-        return diag
-    if not out.startswith("tpu"):
-        return f"backend is {out.split()[0] if out else 'unknown'}"
-    return None
+        return "dead", diag
+    backend = out.split()[0] if out else "unknown"
+    return ("tpu", backend) if backend == "tpu" else ("other", backend)
 
 
 def bench_temporal_subprocess(timeout: float = 300.0) -> dict:
@@ -386,15 +381,20 @@ def main() -> None:
     print(f"reconcile: {reconcile['services']} services converged in "
           f"{reconcile['elapsed_s']:.2f}s "
           f"({reconcile['throughput']:.1f}/s)", file=sys.stderr)
-    probe_fail = tpu_probe()
-    if probe_fail is None:
-        flash = bench_flash_subprocess()
-        temporal = bench_temporal_subprocess()
-        planner_line = bench_planner_subprocess()
-    else:
-        skip = {"skipped": f"tpu probe failed: {probe_fail}"}
+    status, detail = tpu_probe()
+    if status == "dead":
+        skip = {"skipped": f"backend wedged: {detail}"}
         flash, temporal = skip, dict(skip)
-        planner_line = f"planner bench skipped: {probe_fail}"
+        planner_line = f"planner bench skipped: {detail}"
+    else:
+        # the planner bench is backend-agnostic: run it either way
+        planner_line = bench_planner_subprocess()
+        if status == "tpu":
+            flash = bench_flash_subprocess()
+            temporal = bench_temporal_subprocess()
+        else:
+            skip = {"skipped": f"non-tpu backend ({detail})"}
+            flash, temporal = skip, dict(skip)
     print(f"tpu flash: {flash}", file=sys.stderr)
     print(f"tpu temporal train: {temporal}", file=sys.stderr)
     print(planner_line, file=sys.stderr)

@@ -194,3 +194,22 @@ def test_sharded_deep_rejects_bad_stage_count(capsys):
         main(["train", "--model", "deep", "--sharded", "--steps", "1",
               "--groups", "8", "--endpoints", "4", "--hidden", "16",
               "--stages", "3"])
+
+
+def test_train_with_native_loader(capsys):
+    """--loader native feeds training from the C++ pipeline (degrades
+    to synthetic when no toolchain, so this passes either way)."""
+    assert main(["train", "--loader", "native", "--steps", "3",
+                 "--groups", "8", "--endpoints", "6",
+                 "--hidden", "16"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["step"] == 3 and out["loss"] is not None
+
+
+def test_native_loader_rejected_for_custom_batch_families(capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["train", "--model", "moe", "--loader", "native",
+              "--steps", "1", "--groups", "8", "--endpoints", "4",
+              "--hidden", "16"])
