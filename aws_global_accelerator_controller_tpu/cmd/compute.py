@@ -47,6 +47,17 @@ def register(sub) -> None:
                             "C++ background pipeline "
                             "(native/telemetry.cpp), higher input "
                             "throughput, not bit-reproducible.")
+    train.add_argument("--remat", action="store_true",
+                       help="Rematerialise pipeline stage activations "
+                            "(deep --sharded): jax.checkpoint around "
+                            "each stage block — recompute in the "
+                            "backward instead of saving every "
+                            "schedule step's activations.  Identical "
+                            "numerics, lower HBM.")
+    train.add_argument("--profile", default="", metavar="DIR",
+                       help="Capture a jax.profiler trace of the "
+                            "training loop into DIR (view with "
+                            "TensorBoard / xprof).")
     train.add_argument("--window", type=int, default=64,
                        help="Telemetry window length (temporal model); "
                             "the default reaches the Pallas flash "
@@ -312,10 +323,12 @@ def _pipeline_planner(args, model):
             f"--sharded deep needs --groups divisible by "
             f"--microbatches; got groups={args.groups} "
             f"microbatches={args.microbatches}")
-    logger.info("pipeline mesh: stage=%d microbatches=%d", n_dev,
-                args.microbatches)
+    logger.info("pipeline mesh: stage=%d microbatches=%d remat=%s",
+                n_dev, args.microbatches,
+                getattr(args, "remat", False))
     return ShardedPipelinePlanner(model, make_mesh_1d(n_dev, "stage"),
-                                  n_microbatches=args.microbatches)
+                                  n_microbatches=args.microbatches,
+                                  remat=getattr(args, "remat", False))
 
 
 def _mlp_planner(args, model):
@@ -357,15 +370,27 @@ def _run_train(args) -> int:
         start_step, params, opt_state = ckpt.restore(model)
         logger.info("resumed from step %d (%s)", start_step, args.ckpt)
 
+    profile_dir = getattr(args, "profile", "")
+    if profile_dir:
+        # device-level tracing (XLA ops, fusions, transfers) on top of
+        # the framework's own span tracing (tracing.py); view in
+        # TensorBoard / xprof
+        jax.profiler.start_trace(profile_dir)
     loss = None
-    for step in range(start_step, start_step + args.steps):
-        params, opt_state, loss = run_step(
-            params, opt_state, jax.random.fold_in(key, step))
-        if (ckpt is not None and args.save_every > 0
-                and (step + 1) % args.save_every == 0):
-            ckpt.save(step + 1, params, opt_state)
-        if (step + 1) % max(1, args.steps // 10) == 0:
-            logger.info("step %d loss %.5f", step + 1, float(loss))
+    try:
+        for step in range(start_step, start_step + args.steps):
+            params, opt_state, loss = run_step(
+                params, opt_state, jax.random.fold_in(key, step))
+            if (ckpt is not None and args.save_every > 0
+                    and (step + 1) % args.save_every == 0):
+                ckpt.save(step + 1, params, opt_state)
+            if (step + 1) % max(1, args.steps // 10) == 0:
+                logger.info("step %d loss %.5f", step + 1, float(loss))
+    finally:
+        if profile_dir:
+            jax.block_until_ready(loss)
+            jax.profiler.stop_trace()
+            logger.info("profiler trace written to %s", profile_dir)
 
     final_step = start_step + args.steps
     if ckpt is not None:

@@ -29,6 +29,7 @@ No reference analogue (SURVEY.md §2: EP ABSENT upstream).
 from __future__ import annotations
 
 from functools import partial
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
@@ -58,11 +59,15 @@ class ShardedMoEPlanner(SnapshotPlannerMixin):
 
     Requires ``model.n_experts == mesh.shape[expert_axis]`` (one expert
     per device along that axis) and G divisible by the full device
-    count (the batch shards over both axes).
+    count (the batch shards over every data axis plus the expert axis).
+    ``data_axis`` accepts a single axis name or a sequence of them —
+    e.g. ``("dcn_data", "data")`` to put a cross-host replica axis from
+    ``make_hybrid_mesh`` outside the local data tile.
     """
 
     def __init__(self, model: MoETrafficModel, mesh: Mesh,
-                 data_axis: str = "data", expert_axis: str = "expert"):
+                 data_axis: "str | Sequence[str]" = "data",
+                 expert_axis: str = "expert"):
         if model.n_experts != mesh.shape[expert_axis]:
             raise ValueError(
                 f"model has {model.n_experts} experts but the "
@@ -73,7 +78,15 @@ class ShardedMoEPlanner(SnapshotPlannerMixin):
         self.mesh = mesh
         n = model.n_experts
 
-        both = (data_axis, expert_axis)
+        # data_axis may name several mesh axes (e.g. a DCN-outer
+        # replica axis plus the local data tile from make_hybrid_mesh);
+        # the batch dim shards over all of them plus the expert axis,
+        # and the dispatch all_to_all stays on the expert axis only —
+        # so expert traffic rides ICI while DCN carries just the
+        # gradient all-reduce
+        data_axes = ((data_axis,) if isinstance(data_axis, str)
+                     else tuple(data_axis))
+        both = data_axes + (expert_axis,)
         ps = {k: NamedSharding(mesh, s)
               for k, s in moe_param_specs(expert_axis).items()}
         bs = Batch(features=NamedSharding(mesh, P(both, None, None)),
@@ -145,7 +158,9 @@ class ShardedMoEPlanner(SnapshotPlannerMixin):
                              out_shardings=(ps, None, None))
         self.param_shardings = ps
         self.batch_shardings = bs
-        self._n_total = mesh.shape[data_axis] * mesh.shape[expert_axis]
+        self._n_total = 1
+        for axis in both:
+            self._n_total *= mesh.shape[axis]
 
     def shard_batch(self, batch: Batch) -> Batch:
         g = batch.features.shape[0]

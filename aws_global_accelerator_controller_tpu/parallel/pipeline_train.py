@@ -54,7 +54,8 @@ class ShardedPipelinePlanner(SnapshotPlannerMixin):
     """
 
     def __init__(self, model: DeepTrafficModel, mesh: Mesh,
-                 n_microbatches: int = 4, stage_axis: str = "stage"):
+                 n_microbatches: int = 4, stage_axis: str = "stage",
+                 remat: bool = False):
         if model.n_stages != mesh.shape[stage_axis]:
             raise ValueError(
                 f"model has {model.n_stages} stages but the "
@@ -63,8 +64,16 @@ class ShardedPipelinePlanner(SnapshotPlannerMixin):
         self.model = model
         self.mesh = mesh
         self.n_microbatches = n_microbatches
+        self.remat = remat
         s = mesh.shape[stage_axis]
         m = n_microbatches
+        # remat trades FLOPs for activation memory: the scan's backward
+        # otherwise saves every schedule step's stage activations; with
+        # jax.checkpoint around the stage block only its INPUT survives
+        # to the backward, and the relu/matmul recompute on the fly —
+        # the standard long-pipe memory lever (numerically identical,
+        # same f32 ops replayed)
+        stage = jax.checkpoint(stage_fn) if remat else stage_fn
 
         ps = {k: NamedSharding(mesh, spec)
               for k, spec in deep_param_specs(stage_axis).items()}
@@ -95,7 +104,7 @@ class ShardedPipelinePlanner(SnapshotPlannerMixin):
                     jax.lax.dynamic_index_in_dim(h_in, mc, axis=0,
                                                  keepdims=False),
                     recv)
-                h = stage_fn(inp, stage_w[0], stage_b[0])
+                h = stage(inp, stage_w[0], stage_b[0])
                 keep = jnp.logical_and(valid, idx == last)
                 prev = jax.lax.dynamic_index_in_dim(out, mc, axis=0,
                                                     keepdims=False)

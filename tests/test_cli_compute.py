@@ -213,3 +213,24 @@ def test_native_loader_rejected_for_custom_batch_families(capsys):
         main(["train", "--model", "moe", "--loader", "native",
               "--steps", "1", "--groups", "8", "--endpoints", "4",
               "--hidden", "16"])
+
+
+def test_train_profile_writes_trace(tmp_path, capsys):
+    prof = str(tmp_path / "prof")
+    assert main(["train", "--steps", "2", "--groups", "4",
+                 "--endpoints", "4", "--hidden", "16",
+                 "--profile", prof]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["step"] == 2
+    import os
+    found = [os.path.join(r, f) for r, _, fs in os.walk(prof) for f in fs]
+    assert found, "profiler trace directory is empty"
+
+
+def test_sharded_deep_remat_trains(capsys):
+    assert main(["train", "--model", "deep", "--sharded", "--remat",
+                 "--steps", "2", "--groups", "8", "--endpoints", "4",
+                 "--hidden", "16", "--stages", "8",
+                 "--microbatches", "2"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "deep" and out["step"] == 2
