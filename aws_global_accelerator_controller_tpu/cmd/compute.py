@@ -58,6 +58,15 @@ def register(sub) -> None:
                        help="Capture a jax.profiler trace of the "
                             "training loop into DIR (view with "
                             "TensorBoard / xprof).")
+    train.add_argument("--guard", action="store_true",
+                       help="Divergence guard: check every loss for "
+                            "non-finite values (forces a per-step "
+                            "device sync); on NaN/inf restore the "
+                            "last checkpoint (or re-init without "
+                            "--ckpt), skip to the next batch, and "
+                            "abort after 5 restores.  The reported "
+                            "step counts APPLIED updates, so discarded "
+                            "batches don't inflate checkpoint labels.")
     train.add_argument("--window", type=int, default=64,
                        help="Telemetry window length (temporal model); "
                             "the default reaches the Pallas flash "
@@ -376,33 +385,69 @@ def _run_train(args) -> int:
         # the framework's own span tracing (tracing.py); view in
         # TensorBoard / xprof
         jax.profiler.start_trace(profile_dir)
-    loss = None
+    guard = getattr(args, "guard", False)
+    max_restores, restores = 5, 0
+    # step_label counts APPLIED optimizer updates: checkpoint labels
+    # and the reported step stay truthful under --guard rollbacks
+    # (a checkpoint at step N always holds exactly N applied updates);
+    # without --guard it advances every iteration, as before
+    step_label = start_step
+    loss = None  # last ACCEPTED step's loss (never non-finite)
     try:
-        for step in range(start_step, start_step + args.steps):
-            params, opt_state, loss = run_step(
-                params, opt_state, jax.random.fold_in(key, step))
+        for batch_idx in range(start_step, start_step + args.steps):
+            new_params, new_opt, new_loss = run_step(
+                params, opt_state, jax.random.fold_in(key, batch_idx))
+            if guard and not _finite(new_loss):
+                # divergence: discard this update, roll back to the
+                # last durable state (its true step label comes back
+                # with it), move on to the NEXT batch — the
+                # controller-side analogue is the rate-limited requeue
+                restores += 1
+                logger.warning(
+                    "non-finite loss on batch %d (restore %d/%d)",
+                    batch_idx + 1, restores, max_restores)
+                if restores > max_restores:
+                    raise SystemExit(
+                        f"training diverged: {max_restores} restores "
+                        f"exhausted at batch {batch_idx + 1}")
+                if ckpt is not None and ckpt.latest_step() is not None:
+                    step_label, params, opt_state = ckpt.restore(model)
+                else:
+                    step_label = 0
+                    params = model.init_params(key)
+                    opt_state = model.init_opt_state(params)
+                continue
+            params, opt_state, loss = new_params, new_opt, new_loss
+            step_label += 1
             if (ckpt is not None and args.save_every > 0
-                    and (step + 1) % args.save_every == 0):
-                ckpt.save(step + 1, params, opt_state)
-            if (step + 1) % max(1, args.steps // 10) == 0:
-                logger.info("step %d loss %.5f", step + 1, float(loss))
+                    and step_label % args.save_every == 0):
+                ckpt.save(step_label, params, opt_state)
+            if (batch_idx + 1 - start_step) % max(
+                    1, args.steps // 10) == 0:
+                logger.info("step %d loss %.5f", step_label,
+                            float(loss))
     finally:
         if profile_dir:
             jax.block_until_ready(loss)
             jax.profiler.stop_trace()
             logger.info("profiler trace written to %s", profile_dir)
 
-    final_step = start_step + args.steps
     if ckpt is not None:
         # the periodic save may already hold this exact step (orbax
         # raises StepAlreadyExistsError on a duplicate save)
-        if ckpt.latest_step() != final_step:
-            ckpt.save(final_step, params, opt_state, wait=True)
+        if ckpt.latest_step() != step_label:
+            ckpt.save(step_label, params, opt_state, wait=True)
         ckpt.close()
-    print(json.dumps({"step": final_step, "model": args.model,
+    print(json.dumps({"step": step_label, "model": args.model,
                       "loss": float(loss) if loss is not None else None,
                       "backend": jax.default_backend()}))
     return 0
+
+
+def _finite(loss) -> bool:
+    import math
+
+    return math.isfinite(float(loss))
 
 
 def run_plan(args) -> int:

@@ -234,3 +234,58 @@ def test_sharded_deep_remat_trains(capsys):
                  "--microbatches", "2"]) == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["model"] == "deep" and out["step"] == 2
+
+
+def test_guard_restores_after_transient_nan(tmp_path, capsys, monkeypatch):
+    """--guard rolls back to the last checkpoint on a non-finite loss
+    and continues with the next batch."""
+    import math
+
+    from aws_global_accelerator_controller_tpu.cmd import compute
+
+    real_build = compute._build_model
+    poisoned = {"fired": False}
+
+    def build(args):
+        model, run_step, run_plan_fwd = real_build(args)
+
+        def guarded_step(params, opt_state, key):
+            params, opt_state, loss = run_step(params, opt_state, key)
+            if not poisoned["fired"]:
+                poisoned["fired"] = True
+                return params, opt_state, loss * float("nan")
+            return params, opt_state, loss
+        return model, guarded_step, run_plan_fwd
+
+    monkeypatch.setattr(compute, "_build_model", build)
+    ckpt = str(tmp_path / "gck")
+    assert main(["train", "--guard", "--steps", "4", "--ckpt", ckpt,
+                 "--save-every", "1", "--groups", "4",
+                 "--endpoints", "4", "--hidden", "16"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # 4 batches, 1 discarded by the guard -> 3 APPLIED updates; the
+    # step label must not count the rolled-back batch
+    assert out["step"] == 3
+    assert math.isfinite(out["loss"])
+    assert poisoned["fired"]
+
+
+def test_guard_aborts_after_persistent_divergence(capsys, monkeypatch):
+    import pytest
+
+    from aws_global_accelerator_controller_tpu.cmd import compute
+
+    real_build = compute._build_model
+
+    def build(args):
+        model, run_step, run_plan_fwd = real_build(args)
+
+        def always_nan(params, opt_state, key):
+            params, opt_state, loss = run_step(params, opt_state, key)
+            return params, opt_state, loss * float("nan")
+        return model, always_nan, run_plan_fwd
+
+    monkeypatch.setattr(compute, "_build_model", build)
+    with pytest.raises(SystemExit, match="diverged"):
+        main(["train", "--guard", "--steps", "20", "--groups", "4",
+              "--endpoints", "4", "--hidden", "16"])
