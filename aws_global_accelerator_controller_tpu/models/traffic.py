@@ -38,11 +38,30 @@ class Batch(NamedTuple):
 
 
 class TrafficPolicyModel(TrainableModel):
+    """``serve`` picks the single-chip inference path:
+
+    - ``auto`` (default): the fused Pallas kernel
+      (``ops.pallas_mlp.forward_pallas`` — all three matmuls + masked
+      softmax + weight quantisation in one VMEM-resident kernel, one
+      HBM round trip per group block) when running on TPU, the plain
+      XLA path otherwise (off-TPU the kernel only exists in interpret
+      mode);
+    - ``dense``: always the plain XLA path (what the sharded planners
+      jit — pallas_call does not self-partition under pjit);
+    - ``fused``: always the kernel (tests prove the fused path off-TPU).
+
+    Training always uses the dense path (the kernel is inference-only:
+    integer weight outputs have no gradient)."""
+
     def __init__(self, feature_dim: int = FEATURE_DIM,
                  hidden_dim: int = HIDDEN_DIM,
-                 learning_rate: float = 1e-3):
+                 learning_rate: float = 1e-3,
+                 serve: str = "auto"):
+        if serve not in ("auto", "dense", "fused"):
+            raise ValueError(f"unknown serve impl {serve!r}")
         self.feature_dim = feature_dim
         self.hidden_dim = hidden_dim
+        self.serve = serve
         self.optimizer = optax.adam(learning_rate)
 
     def init_params(self, key: jax.Array) -> Params:
@@ -70,7 +89,19 @@ class TrafficPolicyModel(TrainableModel):
 
     def forward(self, params: Params, features: jax.Array,
                 mask: jax.Array) -> jax.Array:
-        """[G, E, F] + mask -> int32 GA weights [G, E]."""
+        """[G, E, F] + mask -> int32 GA weights [G, E] (see ``serve``)."""
+        use_fused = (self.serve == "fused"
+                     or (self.serve == "auto"
+                         and jax.default_backend() == "tpu"))
+        if use_fused:
+            from ..ops.pallas_mlp import forward_pallas
+
+            return forward_pallas(params, features, mask)
+        return self.forward_dense(params, features, mask)
+
+    def forward_dense(self, params: Params, features: jax.Array,
+                      mask: jax.Array) -> jax.Array:
+        """The plain XLA forward — what the sharded planners jit."""
         return plan_weights(self.scores(params, features), mask)
 
     # -- training -------------------------------------------------------

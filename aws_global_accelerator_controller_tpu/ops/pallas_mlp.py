@@ -13,8 +13,11 @@ feature columns multiply zero-padded weight rows, so padding does not
 perturb results.
 
 Runs in interpret mode off-TPU (tests), compiled on TPU
-(/opt/skills/guides/pallas_guide.md patterns; preferred_element_type
-pinned to float32 for MXU precision).
+(/opt/skills/guides/pallas_guide.md patterns).  Matmuls pin
+``preferred_element_type=bfloat16``: the MXU accumulates f32 internally
+and rounds the output to bf16 exactly like XLA's dense bf16 path, so
+the fused kernel is bit-equal to ``TrafficPolicyModel.forward_dense``
+(and bf16 operands keep the MXU on its fast path).
 """
 from __future__ import annotations
 
@@ -30,18 +33,22 @@ from .pallas_weights import _BLOCK_G, plan_block
 
 def _kernel(x_ref, mask_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref,
             b3_ref, out_ref):
+    # bf16 matmuls with bf16-rounded outputs: the MXU accumulates f32
+    # internally and rounds, exactly like XLA's dense bf16 path — so
+    # the fused kernel is BIT-EQUAL to TrafficPolicyModel.forward_dense
+    # (and bf16 operands keep the MXU on its fast path)
     gb, e, f = x_ref.shape
     x = x_ref[:].reshape(gb * e, f)
     h = jnp.maximum(
-        jnp.dot(x, w1_ref[:], preferred_element_type=jnp.float32)
-        + b1_ref[:], 0.0)
+        jnp.dot(x, w1_ref[:], preferred_element_type=jnp.bfloat16)
+        + b1_ref[:], 0)
     h = jnp.maximum(
-        jnp.dot(h, w2_ref[:], preferred_element_type=jnp.float32)
-        + b2_ref[:], 0.0)
-    s = (jnp.dot(h, w3_ref[:], preferred_element_type=jnp.float32)
+        jnp.dot(h, w2_ref[:], preferred_element_type=jnp.bfloat16)
+        + b2_ref[:], 0)
+    s = (jnp.dot(h, w3_ref[:], preferred_element_type=jnp.bfloat16)
          + b3_ref[:])
     # w3 is padded [H, 128] with only column 0 live
-    scores = s[:, 0].reshape(gb, e)
+    scores = s[:, 0].reshape(gb, e).astype(jnp.float32)
     out_ref[:] = plan_block(scores, mask_ref[:] > 0)
 
 
@@ -60,15 +67,16 @@ def _forward(params, features, mask, interpret):
     Fp = -(-F // 128) * 128
     Hp = -(-H // 128) * 128
 
+    bf = jnp.bfloat16
     x = _pad_axis(_pad_axis(_pad_axis(
-        features.astype(jnp.float32), 0, Gp), 1, Ep), 2, Fp)
+        features.astype(bf), 0, Gp), 1, Ep), 2, Fp)
     m = _pad_axis(_pad_axis(mask.astype(jnp.float32), 0, Gp), 1, Ep)
-    w1 = _pad_axis(_pad_axis(params["w1"].astype(jnp.float32), 0, Fp), 1, Hp)
-    b1 = _pad_axis(params["b1"].astype(jnp.float32), 0, Hp)
-    w2 = _pad_axis(_pad_axis(params["w2"].astype(jnp.float32), 0, Hp), 1, Hp)
-    b2 = _pad_axis(params["b2"].astype(jnp.float32), 0, Hp)
-    w3 = _pad_axis(_pad_axis(params["w3"].astype(jnp.float32), 0, Hp), 1, 128)
-    b3 = _pad_axis(params["b3"].astype(jnp.float32), 0, 128)
+    w1 = _pad_axis(_pad_axis(params["w1"].astype(bf), 0, Fp), 1, Hp)
+    b1 = _pad_axis(params["b1"].astype(bf), 0, Hp)
+    w2 = _pad_axis(_pad_axis(params["w2"].astype(bf), 0, Hp), 1, Hp)
+    b2 = _pad_axis(params["b2"].astype(bf), 0, Hp)
+    w3 = _pad_axis(_pad_axis(params["w3"].astype(bf), 0, Hp), 1, 128)
+    b3 = _pad_axis(params["b3"].astype(bf), 0, 128)
 
     out = pl.pallas_call(
         _kernel,
@@ -100,6 +108,7 @@ def _forward(params, features, mask, interpret):
 
 
 def forward_pallas(params, features, mask) -> jax.Array:
-    """Drop-in for TrafficPolicyModel.forward (float32 accumulation)."""
+    """Drop-in for TrafficPolicyModel.forward_dense — bit-equal bf16
+    numerics (see module docstring)."""
     interpret = jax.default_backend() != "tpu"
     return _forward(params, features, mask, interpret)

@@ -57,7 +57,9 @@ class ShardedTrafficPlanner(SnapshotPlannerMixin):
         out_s = NamedSharding(mesh, P("data", None))
 
         self._forward = jax.jit(
-            model.forward,
+            # dense explicitly: pallas_call does not self-partition
+            # under pjit, so the sharded path stays pure XLA
+            model.forward_dense,
             in_shardings=(ps, bs.features, bs.mask),
             out_shardings=out_s)
 
