@@ -118,7 +118,7 @@ def test_model_serve_dispatch():
     user-facing forward: serve='fused' must equal the dense path
     bit-for-bit (the kernel test above proves the kernel itself; this
     proves the MODEL dispatches to it)."""
-    import numpy as np
+    import pytest
 
     from aws_global_accelerator_controller_tpu.models.traffic import (
         TrafficPolicyModel,
@@ -135,7 +135,5 @@ def test_model_serve_dispatch():
     want = np.asarray(dense.forward(params, batch.features, batch.mask))
     got = np.asarray(fused.forward(params, batch.features, batch.mask))
     np.testing.assert_array_equal(got, want)
-    import pytest
-
     with pytest.raises(ValueError, match="serve"):
         TrafficPolicyModel(serve="gpu")

@@ -1,0 +1,162 @@
+"""Property-based tests (hypothesis) for the pure core.
+
+The reference's unit tier is table-driven (SURVEY.md §4) — fixed cases
+only.  These properties cover the input space the tables can't: random
+fleets through the weight planner, generated hostnames through the
+parser, random id sets through the membership diff.  Everything here is
+pure/CPU-fast; JAX runs on the CPU backend (conftest).
+"""
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.hostname import (
+    get_lb_name_from_hostname,
+)
+from aws_global_accelerator_controller_tpu.ops.diff import (
+    EMPTY,
+    hash_ids,
+    membership_diff,
+)
+from aws_global_accelerator_controller_tpu.ops.weights import (
+    masked_softmax,
+    plan_weights,
+)
+
+# keep per-case budgets small: every case traces/compiles nothing new
+# (jit cache) but hypothesis runs dozens of examples
+_SETTINGS = settings(max_examples=40, deadline=None)
+
+
+# -- weight planner ---------------------------------------------------------
+
+
+@st.composite
+def _fleet(draw):
+    g = draw(st.integers(1, 6))
+    e = draw(st.integers(1, 12))
+    scores = draw(st.lists(
+        st.floats(-50, 50, allow_nan=False, width=32),
+        min_size=g * e, max_size=g * e))
+    mask = draw(st.lists(st.booleans(), min_size=g * e, max_size=g * e))
+    return (np.asarray(scores, np.float32).reshape(g, e),
+            np.asarray(mask).reshape(g, e))
+
+
+@_SETTINGS
+@given(_fleet())
+def test_plan_weights_invariants(fleet):
+    scores, mask = fleet
+    w = np.asarray(plan_weights(scores, mask))
+    assert w.dtype == np.int32
+    assert (w >= 0).all() and (w <= 255).all()
+    # padded slots never get traffic
+    assert (w[~mask] == 0).all()
+    # a row with any valid endpoint allocates ~the full budget (integer
+    # rounding drifts by at most E/2 either way)
+    e = mask.shape[1]
+    for row_w, row_m in zip(w, mask):
+        if row_m.any():
+            assert abs(int(row_w.sum()) - 255) <= e
+        else:
+            assert int(row_w.sum()) == 0
+
+
+@_SETTINGS
+@given(_fleet())
+def test_masked_softmax_is_distribution(fleet):
+    scores, mask = fleet
+    p = np.asarray(masked_softmax(scores, mask))
+    assert (p >= 0).all()
+    assert (p[~mask] == 0).all()
+    sums = p.sum(axis=-1)
+    assert ((np.abs(sums - 1.0) < 1e-5) | (sums == 0.0)).all()
+    assert (sums[mask.any(axis=-1)] > 0.999).all()
+
+
+@_SETTINGS
+@given(_fleet(), st.floats(0.1, 10.0))
+def test_plan_weights_temperature_preserves_ranking(fleet, temp):
+    """Temperature sharpens or flattens but never reorders: a strictly
+    higher-scored valid endpoint never gets a strictly lower weight."""
+    scores, mask = fleet
+    w = np.asarray(plan_weights(scores, mask, temperature=temp))
+    for row_w, row_s, row_m in zip(w, scores, mask):
+        valid = np.where(row_m)[0]
+        for i in valid:
+            for j in valid:
+                if row_s[i] > row_s[j]:
+                    assert row_w[i] >= row_w[j]
+
+
+# -- hostname parsing -------------------------------------------------------
+
+_NAME = st.from_regex(r"[a-z][a-z0-9]{0,10}(-[a-z0-9]{1,8}){0,2}",
+                      fullmatch=True)
+_HASH = st.from_regex(r"[0-9a-f]{8,16}", fullmatch=True)
+_REGION = st.sampled_from(
+    ["us-east-1", "us-west-2", "eu-central-1", "ap-northeast-1"])
+
+
+@_SETTINGS
+@given(_NAME, _HASH, _REGION)
+def test_alb_hostname_round_trip(name, hash_, region):
+    host = f"{name}-{hash_}.{region}.elb.amazonaws.com"
+    got_name, got_region = get_lb_name_from_hostname(host)
+    assert got_name == name and got_region == region
+
+
+@_SETTINGS
+@given(_NAME, _HASH, _REGION)
+def test_internal_alb_hostname_round_trip(name, hash_, region):
+    host = f"internal-{name}-{hash_}.{region}.elb.amazonaws.com"
+    got_name, got_region = get_lb_name_from_hostname(host)
+    assert got_name == name and got_region == region
+
+
+@_SETTINGS
+@given(_NAME, _HASH, _REGION)
+def test_nlb_hostname_round_trip(name, hash_, region):
+    host = f"{name}-{hash_}.elb.{region}.amazonaws.com"
+    got_name, got_region = get_lb_name_from_hostname(host)
+    assert got_name == name and got_region == region
+
+
+@_SETTINGS
+@given(st.text(
+    alphabet=st.characters(whitelist_categories=("Ll", "Nd"),
+                           whitelist_characters=".-"),
+    max_size=40))
+def test_non_elb_hostnames_rejected_not_crashed(junk):
+    """Arbitrary non-ELB strings raise ValueError, never anything
+    else."""
+    host = junk + ".example.com"
+    with pytest.raises(ValueError):
+        get_lb_name_from_hostname(host)
+
+
+# -- membership diff --------------------------------------------------------
+
+
+@_SETTINGS
+@given(st.lists(st.text(st.characters(min_codepoint=97, max_codepoint=122),
+                        min_size=1, max_size=8),
+                min_size=0, max_size=8, unique=True),
+       st.lists(st.text(st.characters(min_codepoint=97, max_codepoint=122),
+                        min_size=1, max_size=8),
+                min_size=0, max_size=8, unique=True))
+def test_membership_diff_matches_set_semantics(desired_ids, current_ids):
+    """The vectorized diff equals Python set difference on the hashes
+    (the controller's newEndpointIds/removedEndpointIds split)."""
+    cap = 8
+    d = np.full((1, cap), EMPTY, np.int32)
+    c = np.full((1, cap), EMPTY, np.int32)
+    dh = np.asarray(hash_ids(desired_ids)) if desired_ids else []
+    ch = np.asarray(hash_ids(current_ids)) if current_ids else []
+    d[0, :len(dh)] = dh
+    c[0, :len(ch)] = ch
+    to_add, to_remove = membership_diff(d, c)
+    add = set(d[0][np.asarray(to_add)[0]].tolist())
+    rem = set(c[0][np.asarray(to_remove)[0]].tolist())
+    assert add == set(dh) - set(ch)
+    assert rem == set(ch) - set(dh)
