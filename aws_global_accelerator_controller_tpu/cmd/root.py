@@ -71,6 +71,15 @@ def build_parser() -> argparse.ArgumentParser:
                             help="Port for /healthz, /readyz and /metrics "
                                  "(0 disables; the reference controller "
                                  "binary has no such endpoint).")
+    controller.add_argument("--weight-policy",
+                            choices=("static", "model"),
+                            default="static",
+                            help="Endpoint weight assignment: static = "
+                                 "spec.weight everywhere (reference "
+                                 "parity); model = TPU-planned "
+                                 "per-endpoint weights for bindings "
+                                 "with spec.weight: null "
+                                 "(controller/weightpolicy.py).")
     controller.add_argument("--seed", action="append", default=[],
                             metavar="FILE",
                             help="Apply YAML manifests into the fake API "
@@ -137,7 +146,8 @@ def run_controller(args) -> int:
         route53=Route53Config(
             workers=args.workers, cluster_name=args.cluster_name),
         endpoint_group_binding=EndpointGroupBindingConfig(
-            workers=args.workers),
+            workers=args.workers,
+            weight_policy=getattr(args, "weight_policy", "static")),
     )
 
     namespace = os.environ.get("POD_NAMESPACE", "default")

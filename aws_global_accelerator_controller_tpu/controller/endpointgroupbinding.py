@@ -51,6 +51,9 @@ class EndpointGroupBindingConfig:
     workers: int = 1
     queue_qps: float = 10.0    # client-go default bucket
     queue_burst: int = 100
+    # "static" = reference parity (spec.weight everywhere); "model" =
+    # TPU-planned weights for spec.weight: null bindings (weightpolicy.py)
+    weight_policy: str = "static"
 
 
 class EndpointGroupBindingController:
@@ -59,10 +62,13 @@ class EndpointGroupBindingController:
                  informer_factory: SharedInformerFactory,
                  cloud_factory: CloudFactory,
                  config: EndpointGroupBindingConfig):
+        from .weightpolicy import make_weight_policy
+
         self.workers = config.workers
         self.kube_client = kube_client
         self.client = operator_client
         self.cloud_factory = cloud_factory
+        self.weight_policy = make_weight_policy(config.weight_policy)
         self.recorder = kube_client.event_recorder(CONTROLLER_AGENT_NAME)
 
         self.queue = new_rate_limiting_queue(
@@ -253,9 +259,15 @@ class EndpointGroupBindingController:
             if endpoint is not None:
                 results.append(endpoint)
 
+        # one plan for the whole group (reference loops spec.weight,
+        # reconcile.go:197-204; the policy seam lets the TPU planner
+        # allocate per-endpoint weights for spec.weight: null bindings)
+        planned = self.weight_policy.plan(obj, endpoint_group,
+                                          list(arns))
         for endpoint_id in arns:
-            provider.update_endpoint_weight(endpoint_group, endpoint_id,
-                                            obj.spec.weight)
+            provider.update_endpoint_weight(
+                endpoint_group, endpoint_id,
+                planned.get(endpoint_id, obj.spec.weight))
 
         copied = obj.deep_copy()
         copied.status.endpoint_ids = results

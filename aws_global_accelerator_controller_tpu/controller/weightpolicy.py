@@ -1,0 +1,114 @@
+"""Endpoint weight policies: how the binding controller assigns weights.
+
+The reference applies ``spec.weight`` uniformly to every endpoint in the
+group (pkg/controller/endpointgroupbinding/reconcile.go:197-204 →
+UpdateEndpointWeight) — that behaviour is :class:`StaticWeightPolicy`,
+the default.  :class:`ModelWeightPolicy` makes the TPU compute track
+load-bearing in the control plane: when a binding leaves ``spec.weight``
+null (the CRD's "nullable" case, types.go:51-59 — the reference then
+just passes nil through), the policy scores the group's endpoints with
+``models.traffic.TrafficPolicyModel`` and plans a full 255-budget
+allocation instead.
+
+Churn safety: the model features are a pure function of durable
+endpoint identity (ARN) and binding spec — NOT of current weights or
+other mutable cloud state — so repeated reconciles plan identical
+weights and the level-triggered loop stays quiescent (no
+update-feedback oscillation).  An explicit ``spec.weight`` always wins,
+preserving reference semantics exactly.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional
+
+from ..apis.endpointgroupbinding.v1alpha1 import EndpointGroupBinding
+from ..cloudprovider.aws.types import EndpointGroup
+
+FEATURE_DIM = 8
+
+
+class StaticWeightPolicy:
+    """Reference parity: every endpoint gets ``spec.weight`` (which may
+    be None — "leave the cloud default alone")."""
+
+    def plan(self, binding: EndpointGroupBinding,
+             endpoint_group: EndpointGroup,
+             endpoint_ids: List[str]) -> Dict[str, Optional[int]]:
+        return {eid: binding.spec.weight for eid in endpoint_ids}
+
+
+class ModelWeightPolicy:
+    """Model-planned weights for bindings with ``spec.weight: null``.
+
+    ``params`` defaults to a deterministic seed-0 initialisation; pass
+    a checkpoint's params (``models.checkpoint.TrainCheckpointer``) for
+    a trained policy.  The JAX program compiles once per (G=1, E) shape
+    and is reused across reconciles.
+    """
+
+    def __init__(self, model=None, params=None):
+        # CPU-pinned: planning a [1, E] fleet is microseconds of CPU
+        # work, and controller startup must never block on accelerator
+        # backend init (a wedged TPU tunnel would stall cache sync and
+        # every reconcile behind it)
+        from ..jaxenv import import_jax_cpu
+
+        jax = import_jax_cpu()
+
+        from ..models.traffic import TrafficPolicyModel
+
+        self._jax = jax
+        self.model = model or TrafficPolicyModel(
+            feature_dim=FEATURE_DIM)
+        self.params = (params if params is not None
+                       else self.model.init_params(
+                           jax.random.PRNGKey(0)))
+        self._fwd = jax.jit(self.model.forward_dense)
+        self._static = StaticWeightPolicy()
+
+    def plan(self, binding: EndpointGroupBinding,
+             endpoint_group: EndpointGroup,
+             endpoint_ids: List[str]) -> Dict[str, Optional[int]]:
+        if binding.spec.weight is not None or not endpoint_ids:
+            # explicit spec.weight wins: reference semantics untouched
+            return self._static.plan(binding, endpoint_group,
+                                     endpoint_ids)
+        import numpy as np
+
+        features = np.stack(
+            [self._featurize(eid, i, len(endpoint_ids), binding)
+             for i, eid in enumerate(endpoint_ids)])[None]  # [1, E, F]
+        mask = np.ones((1, len(endpoint_ids)), bool)
+        weights = np.asarray(self._fwd(self.params, features, mask))[0]
+        return {eid: int(w) for eid, w in zip(endpoint_ids, weights)}
+
+    @staticmethod
+    def _featurize(endpoint_id: str, index: int, size: int,
+                   binding: EndpointGroupBinding):
+        """[F] float32 from DURABLE identity only (see module docstring
+        for why mutable cloud state is excluded)."""
+        import numpy as np
+
+        f = np.zeros((FEATURE_DIM,), np.float32)
+        f[0] = 1.0                                   # bias / capacity slot
+        f[1] = index / max(size, 1)
+        f[2] = size / 32.0
+        f[3] = 1.0 if binding.spec.client_ip_preservation else 0.0
+        # stable pseudo-features from the ARN: deterministic diversity
+        # so equal-context endpoints still get distinguishable scores
+        h = zlib.crc32(endpoint_id.encode())
+        f[4] = ((h & 0xFF) / 127.5) - 1.0
+        f[5] = (((h >> 8) & 0xFF) / 127.5) - 1.0
+        f[6] = (((h >> 16) & 0xFF) / 127.5) - 1.0
+        f[7] = (((h >> 24) & 0xFF) / 127.5) - 1.0
+        return f
+
+
+def make_weight_policy(kind: str):
+    """"static" (reference parity, default) or "model"."""
+    if kind == "static":
+        return StaticWeightPolicy()
+    if kind == "model":
+        return ModelWeightPolicy()
+    raise ValueError(f"unknown weight policy {kind!r}")

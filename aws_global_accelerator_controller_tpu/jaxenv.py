@@ -25,3 +25,41 @@ def import_jax():
     if env_platforms and jax.config.jax_platforms != env_platforms:
         jax.config.update("jax_platforms", env_platforms)
     return jax
+
+
+def import_jax_cpu():
+    """Import jax pinned to the CPU backend for THIS process.
+
+    For consumers that must never touch an accelerator: the controller
+    binary's model weight policy plans [1, E] fleets — microseconds of
+    CPU work — and a registered accelerator plugin can hang backend
+    init indefinitely when its tunnel is wedged (observed in this
+    environment), which would block controller startup and every
+    reconcile behind it.  Must run before the first backend
+    initialisation in the process; afterwards the pin is a no-op if the
+    platform already matches, and raises otherwise (mixing a CPU-pinned
+    controller with same-process TPU compute is unsupported — run
+    ``train``/``plan`` as their own processes).
+    """
+    import jax
+
+    if jax.config.jax_platforms != "cpu":
+        # config.update on jax_platforms does NOT raise after backend
+        # init (no validation hook on that state var, jax 0.9) — it
+        # would silently no-op and the next op would dispatch to the
+        # already-initialised accelerator.  Detect that case explicitly.
+        if _backends_initialized():
+            raise RuntimeError(
+                "cannot pin jax to cpu: an accelerator backend is "
+                "already initialised in this process")
+        jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def _backends_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except (ImportError, AttributeError):  # private API moved: assume
+        return False                       # uninitialised (best effort)

@@ -37,7 +37,7 @@ class Cluster:
 
     def __init__(self, workers: int = 1, resync_period: float = 30.0,
                  settle_seconds: float = 0.0, queue_qps: float = 10.0,
-                 queue_burst: int = 100):
+                 queue_burst: int = 100, weight_policy: str = "static"):
         self.api = FakeAPIServer()
         self.kube = KubeClient(self.api)
         self.operator = OperatorClient(self.api)
@@ -54,7 +54,7 @@ class Cluster:
                                   queue_burst=queue_burst),
             endpoint_group_binding=EndpointGroupBindingConfig(
                 workers=workers, queue_qps=queue_qps,
-                queue_burst=queue_burst),
+                queue_burst=queue_burst, weight_policy=weight_policy),
         )
 
     def start(self):

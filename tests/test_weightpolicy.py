@@ -1,0 +1,158 @@
+"""Weight policies: the TPU planner wired into the binding controller.
+
+StaticWeightPolicy is reference parity (spec.weight everywhere,
+reconcile.go:197-204); ModelWeightPolicy plans a full 255-budget
+allocation for ``spec.weight: null`` bindings.  The churn-safety
+contract (features are a pure function of durable identity) is what
+keeps the level-triggered reconcile loop quiescent — tested both at the
+policy level and through a running control plane.
+"""
+
+from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (  # noqa: E501
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+    EndpointGroup,
+)
+from aws_global_accelerator_controller_tpu.controller.weightpolicy import (
+    ModelWeightPolicy,
+    StaticWeightPolicy,
+    make_weight_policy,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import ObjectMeta
+
+from harness import Cluster, wait_until
+
+EG_ARN = ("arn:aws:globalaccelerator::123456789012:accelerator/a"
+          "/listener/l/endpoint-group/eg1")
+LB = ("arn:aws:elasticloadbalancing:us-east-1:123456789012:"
+      "loadbalancer/net/one/aaa")
+LB2 = ("arn:aws:elasticloadbalancing:us-east-1:123456789012:"
+       "loadbalancer/net/two/bbb")
+
+
+def _binding(weight=None, eg_arn=EG_ARN):
+    return EndpointGroupBinding(
+        metadata=ObjectMeta(name="b", namespace="default"),
+        spec=EndpointGroupBindingSpec(
+            endpoint_group_arn=eg_arn, weight=weight,
+            service_ref=ServiceReference(name="app")))
+
+
+def _eg():
+    return EndpointGroup(endpoint_group_arn=EG_ARN)
+
+
+def test_static_policy_reference_parity():
+    policy = StaticWeightPolicy()
+    assert policy.plan(_binding(64), _eg(), [LB, LB2]) == {LB: 64,
+                                                          LB2: 64}
+    assert policy.plan(_binding(None), _eg(), [LB]) == {LB: None}
+
+
+def test_model_policy_defers_to_explicit_spec_weight():
+    policy = ModelWeightPolicy()
+    assert policy.plan(_binding(7), _eg(), [LB, LB2]) == {LB: 7, LB2: 7}
+
+
+def test_model_policy_plans_full_budget_deterministically():
+    policy = ModelWeightPolicy()
+    got = policy.plan(_binding(None), _eg(), [LB, LB2])
+    assert set(got) == {LB, LB2}
+    assert all(isinstance(w, int) and 0 <= w <= 255
+               for w in got.values())
+    # full-budget allocation (integer rounding slack <= E)
+    assert abs(sum(got.values()) - 255) <= 2
+    # churn safety: identical inputs -> identical plan, across
+    # instances (fresh params from the same deterministic seed)
+    assert policy.plan(_binding(None), _eg(), [LB, LB2]) == got
+    assert ModelWeightPolicy().plan(_binding(None), _eg(),
+                                    [LB, LB2]) == got
+
+
+def test_model_policy_empty_group():
+    assert ModelWeightPolicy().plan(_binding(None), _eg(), []) == {}
+
+
+def test_make_weight_policy():
+    import pytest
+
+    assert isinstance(make_weight_policy("static"), StaticWeightPolicy)
+    assert isinstance(make_weight_policy("model"), ModelWeightPolicy)
+    with pytest.raises(ValueError):
+        make_weight_policy("llm")
+
+
+def test_model_policy_through_running_control_plane():
+    """e2e: a spec.weight: null binding converges to model-planned
+    weights in the fake cloud and stays stable across reconciles."""
+    cluster = Cluster(weight_policy="model").start()
+    try:
+        region = "us-east-1"
+        host = f"app-0123456789abcdef.elb.{region}.amazonaws.com"
+        cluster.cloud.elb.register_load_balancer("app", host, region)
+        # accelerator chain made out-of-band, the binding controller's
+        # normal situation (same shape as test_e2e_endpointgroupbinding)
+        ga = cluster.cloud.ga
+        acc = ga.create_accelerator("ext", "IPV4", True, {})
+        from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (  # noqa: E501
+            PortRange,
+        )
+        listener = ga.create_listener(acc.accelerator_arn,
+                                      [PortRange(80, 80)], "TCP", "NONE")
+        seed_lb = cluster.cloud.elb.register_load_balancer(
+            "seed", f"seed-0123456789abcdef.elb.{region}.amazonaws.com",
+            region)
+        eg = ga.create_endpoint_group(listener.listener_arn, region,
+                                      seed_lb.load_balancer_arn, False)
+        eg_arn = eg.endpoint_group_arn
+
+        from aws_global_accelerator_controller_tpu.kube.objects import (
+            LoadBalancerIngress,
+            LoadBalancerStatus,
+            Service,
+            ServicePort,
+            ServiceSpec,
+            ServiceStatus,
+        )
+        cluster.kube.services.create(Service(
+            metadata=ObjectMeta(name="app", namespace="default"),
+            spec=ServiceSpec(type="LoadBalancer",
+                             ports=[ServicePort(port=80)]),
+            status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(hostname=host)]))))
+        cluster.operator.endpoint_group_bindings.create(
+            _binding(None, eg_arn))
+
+        def app_weight():
+            eps = cluster.cloud.ga.describe_endpoint_group(
+                eg_arn).endpoint_descriptions
+            for ep in eps:
+                if "loadbalancer/net/app/" in (ep.endpoint_id or ""):
+                    return ep.weight
+            return None
+
+        wait_until(lambda: app_weight() is not None, timeout=30.0,
+                   message="model-planned weight applied")
+        first = app_weight()
+        assert 0 <= first <= 255
+
+        # spec.weight round-trip: explicit weight wins (reference
+        # semantics), and returning to null REPLANS to the identical
+        # model weight — determinism through the running controller
+        binding = cluster.operator.endpoint_group_bindings.get(
+            "default", "b")
+        binding.spec.weight = 128
+        cluster.operator.endpoint_group_bindings.update(binding)
+        wait_until(lambda: app_weight() == 128, timeout=30.0,
+                   message="explicit spec.weight applied")
+        binding = cluster.operator.endpoint_group_bindings.get(
+            "default", "b")
+        binding.spec.weight = None
+        cluster.operator.endpoint_group_bindings.update(binding)
+        wait_until(lambda: app_weight() == first, timeout=30.0,
+                   message="model replanned to the identical weight")
+    finally:
+        cluster.shutdown()
