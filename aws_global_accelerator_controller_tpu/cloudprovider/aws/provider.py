@@ -34,7 +34,7 @@ from ...errors import (
     ListenerNotFoundError,
 )
 from ...kube.objects import Ingress, LoadBalancerIngress, Service
-from . import helpers
+
 from .api import AWSAPIs
 from .helpers import (
     CLUSTER_TAG_KEY,

@@ -12,7 +12,7 @@ path is exercised only against live AWS (the local_e2e tier).
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 from ...errors import (
     AWSAPIError,

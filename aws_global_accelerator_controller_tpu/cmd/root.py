@@ -182,8 +182,11 @@ def run_controller(args) -> int:
             # readiness concern (standby replicas must be Ready)
             health.add_ready_probe("informers", handle.informers_synced)
         leader_stop.wait()
-        # graceful shutdown: let controllers drain queues + join workers
+        # graceful shutdown: let controllers drain queues + join workers,
+        # then flush async event recording (EventBroadcaster) so events
+        # from final reconciles reach the API before exit
         handle.join(timeout=10.0)
+        kube.flush_events(timeout=5.0)
 
     try:
         if args.leader_elect:

@@ -22,7 +22,7 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..errors import AdmissionDeniedError, ConflictError, NotFoundError
 from .objects import KubeObject

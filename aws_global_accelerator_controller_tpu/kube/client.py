@@ -67,15 +67,74 @@ class EndpointGroupBindingClient(_TypedNamespacedClient):
         return self._store.update(obj, status_only=True)
 
 
-class EventRecorder:
-    """record.EventRecorder analogue: writes Events to the API and logs.
+_STOP = object()
 
-    Reference wires an EventBroadcaster sink per controller
-    (e.g. pkg/controller/globalaccelerator/controller.go:55-58).
+
+class EventBroadcaster:
+    """record.EventBroadcaster analogue: recorders enqueue onto a
+    bounded buffer, one background thread writes to the API.
+
+    Event recording must never block a reconcile worker — client-go
+    gets this from StartRecordingToSink's buffered watch channel (the
+    reference wires one per controller,
+    pkg/controller/globalaccelerator/controller.go:55-58); measured
+    here, synchronous event writes cost as much as the provider work in
+    the reconcile hot loop.  Overflow drops the event with a debug log,
+    exactly client-go's full-channel behaviour; events are best-effort
+    by contract.
     """
 
-    def __init__(self, store, component: str):
+    def __init__(self, store, capacity: int = 1000):
+        import queue
+        import threading
+
         self._store = store
+        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="event-broadcaster")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            ev = self._q.get()
+            try:
+                if ev is _STOP:
+                    return
+                self._store.create(ev)
+            except Exception:  # events are best-effort
+                logger.debug("failed to record event", exc_info=True)
+            finally:
+                self._q.task_done()
+
+    def enqueue(self, ev: Event) -> None:
+        import queue
+
+        try:
+            self._q.put_nowait(ev)
+        except queue.Full:
+            logger.debug("event buffer full; dropping %s", ev.reason)
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Best-effort wait until enqueued events are written (tests)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._q.unfinished_tasks == 0:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def stop(self) -> None:
+        self._q.put(_STOP)
+
+
+class EventRecorder:
+    """record.EventRecorder analogue: logs and hands the Event to the
+    shared broadcaster (async write; see EventBroadcaster)."""
+
+    def __init__(self, broadcaster: EventBroadcaster, component: str):
+        self._broadcaster = broadcaster
         self.component = component
 
     def event(self, obj, type_: str, reason: str, message: str) -> None:
@@ -93,10 +152,7 @@ class EventRecorder:
             reason=reason,
             message=message,
         )
-        try:
-            self._store.create(ev)
-        except Exception:  # events are best-effort
-            logger.debug("failed to record event %s", reason, exc_info=True)
+        self._broadcaster.enqueue(ev)
         logger.info("Event(%s %s): type=%s reason=%s %s",
                     obj.kind, obj.key(), type_, reason, message)
 
@@ -108,13 +164,29 @@ class KubeClient:
     """kubernetes.Interface analogue (core + networking + coordination)."""
 
     def __init__(self, api: FakeAPIServer):
+        import threading
+
         self.api = api
         self.services = ServiceClient(api.store("Service"))
         self.ingresses = IngressClient(api.store("Ingress"))
         self.leases = LeaseClient(api.store("Lease"))
+        self._broadcaster: Optional[EventBroadcaster] = None
+        self._broadcaster_lock = threading.Lock()
 
     def event_recorder(self, component: str) -> EventRecorder:
-        return EventRecorder(self.api.store("Event"), component)
+        with self._broadcaster_lock:
+            # guarded: concurrent first calls must share ONE broadcaster
+            # (KubeClient is a multi-threaded surface)
+            if self._broadcaster is None:
+                self._broadcaster = EventBroadcaster(
+                    self.api.store("Event"))
+        return EventRecorder(self._broadcaster, component)
+
+    def flush_events(self, timeout: float = 5.0) -> bool:
+        """Wait for queued events to reach the API (tests/shutdown)."""
+        if self._broadcaster is None:
+            return True
+        return self._broadcaster.flush(timeout)
 
     def list_events(self) -> List[Event]:
         return self.api.store("Event").list()

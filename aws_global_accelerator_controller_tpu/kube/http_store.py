@@ -36,7 +36,7 @@ import time
 import urllib.error
 import urllib.request
 from datetime import datetime, timezone
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from ..apis.endpointgroupbinding.v1alpha1 import (
     GROUP,

@@ -19,7 +19,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
-import time
+
 from collections import defaultdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple

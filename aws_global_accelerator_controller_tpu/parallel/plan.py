@@ -25,7 +25,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.temporal import TemporalTrafficModel
-from ..models.traffic import Batch, Params, TrafficPolicyModel
+from ..models.traffic import Batch, TrafficPolicyModel
 from .base import SnapshotPlannerMixin
 from .ring_attention import make_ring_attention
 

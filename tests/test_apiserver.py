@@ -103,6 +103,8 @@ def test_event_recorder():
     svc = kube.services.create(make_service("a"))
     rec = kube.event_recorder("test-controller")
     rec.eventf(svc, "Normal", "Created", "created %s", "thing")
+    # recording is async (EventBroadcaster): flush before asserting
+    assert kube.flush_events()
     events = kube.list_events()
     assert len(events) == 1
     assert events[0].reason == "Created"
