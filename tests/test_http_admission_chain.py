@@ -1,0 +1,88 @@
+"""The FULL real-style admission chain over the wire.
+
+Client (HTTPAPIServer) -> REST apiserver (KubeRestServer) -> admission
+review POSTed to the REAL webhook server over HTTP -> typed 403 back
+through the REST layer to the client.  The webhook is registered by
+APPLYING the shipped ``config/webhook`` manifests (kube/apply.py), so
+this is the in-env equivalent of the reference's kind-cluster webhook
+e2e (e2e/e2e_test.go:60-98: apply manifests, assert the immutability
+rule end-to-end) with every hop crossing real HTTP.
+"""
+import os
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (  # noqa: E501
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+)
+from aws_global_accelerator_controller_tpu.errors import (
+    AdmissionDeniedError,
+)
+from aws_global_accelerator_controller_tpu.kube.apiserver import FakeAPIServer
+from aws_global_accelerator_controller_tpu.kube.apply import apply_files
+from aws_global_accelerator_controller_tpu.kube.http_store import HTTPAPIServer
+from aws_global_accelerator_controller_tpu.kube.kubeconfig import RestConfig
+from aws_global_accelerator_controller_tpu.kube.objects import ObjectMeta
+from aws_global_accelerator_controller_tpu.kube.rest_server import (
+    KubeRestServer,
+)
+from aws_global_accelerator_controller_tpu.webhook import WebhookServer
+
+CONFIG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "config")
+
+ARN1 = ("arn:aws:globalaccelerator::123456789012:accelerator/a"
+        "/listener/l/endpoint-group/e1")
+ARN2 = ("arn:aws:globalaccelerator::123456789012:accelerator/a"
+        "/listener/l/endpoint-group/e2")
+
+
+@pytest.fixture
+def chain():
+    webhook = WebhookServer(port=0)  # plain HTTP for the in-env tier
+    webhook.start_background()
+    api = FakeAPIServer()
+
+    def resolver(namespace, name, path):
+        # clientConfig.service -> the locally running webhook server
+        return f"http://127.0.0.1:{webhook.port}{path}"
+
+    # the SHIPPED manifests register the webhook against the apiserver
+    apply_files(api, [os.path.join(CONFIG, "webhook", "manifests.yaml")],
+                service_resolver=resolver)
+    rest = KubeRestServer(api).start()
+    client = HTTPAPIServer(RestConfig(server=rest.url))
+    yield client
+    client.close()
+    rest.shutdown()
+    webhook.shutdown()
+
+
+def _binding(arn=ARN1, weight=None):
+    return EndpointGroupBinding(
+        metadata=ObjectMeta(name="b", namespace="default"),
+        spec=EndpointGroupBindingSpec(endpoint_group_arn=arn,
+                                      weight=weight))
+
+
+def test_arn_change_denied_through_every_hop(chain):
+    store = chain.store("EndpointGroupBinding")
+    store.create(_binding())
+    obj = store.get("default", "b")
+    obj.spec.endpoint_group_arn = ARN2
+    with pytest.raises(AdmissionDeniedError) as exc:
+        store.update(obj)
+    assert "immutable" in str(exc.value)
+    # the denied write must not have landed
+    assert store.get("default",
+                     "b").spec.endpoint_group_arn == ARN1
+
+
+def test_weight_change_allowed_through_every_hop(chain):
+    store = chain.store("EndpointGroupBinding")
+    store.create(_binding(weight=3))
+    obj = store.get("default", "b")
+    obj.spec.weight = 200
+    updated = store.update(obj)
+    assert updated.spec.weight == 200
