@@ -1,0 +1,70 @@
+"""Scale-regression gate: a 1000-service fleet converges within budget.
+
+The reconcile path's discovery is an O(fleet) tag scan per create (the
+reference's shape, global_accelerator.go:87-110), so fleet convergence
+is inherently ~quadratic in the worst case — this test pins the
+constant factor.  A regression that makes syncs accidentally O(N^2) on
+top (e.g. cache-defeating churn, lock contention across workers) blows
+the generous budget and fails here instead of in production.
+"""
+import time
+
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+
+from harness import Cluster, wait_until
+
+N = 1000
+BUDGET_S = 90.0  # generous: ~1s of pure convergence at current speed
+
+
+def test_thousand_service_fleet_converges():
+    cluster = Cluster(workers=8, queue_qps=100000.0,
+                      queue_burst=100000).start()
+    region = "eu-west-1"
+    try:
+        for i in range(N):
+            name = f"svc{i:04d}"
+            host = f"{name}-0123456789abcdef.elb.{region}.amazonaws.com"
+            cluster.cloud.elb.register_load_balancer(name, host, region)
+        start = time.perf_counter()
+        for i in range(N):
+            name = f"svc{i:04d}"
+            host = f"{name}-0123456789abcdef.elb.{region}.amazonaws.com"
+            cluster.kube.services.create(Service(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                    }),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                    ingress=[LoadBalancerIngress(hostname=host)])),
+            ))
+        wait_until(
+            lambda: len(cluster.cloud.ga.list_accelerators()) == N,
+            timeout=BUDGET_S, interval=0.25,
+            message=f"{N} accelerators converged")
+        elapsed = time.perf_counter() - start
+        # every accelerator got its full chain (spot-check the edges)
+        for arn in (cluster.cloud.ga.list_accelerators()[0].accelerator_arn,
+                    cluster.cloud.ga.list_accelerators()[-1]
+                    .accelerator_arn):
+            assert len(cluster.cloud.ga.list_listeners(arn)) == 1
+        print(f"\n{N} services converged in {elapsed:.1f}s "
+              f"({N / elapsed:.0f}/s)")
+    finally:
+        cluster.shutdown()
