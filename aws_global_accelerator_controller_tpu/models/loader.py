@@ -55,7 +55,8 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.aga_tl_new.restype = ctypes.c_void_p
         lib.aga_tl_new.argtypes = [ctypes.c_int, ctypes.c_int,
                                    ctypes.c_int, ctypes.c_int,
-                                   ctypes.c_int, ctypes.c_uint64]
+                                   ctypes.c_int, ctypes.c_uint64,
+                                   ctypes.c_int]
         lib.aga_tl_next.restype = ctypes.c_int
         lib.aga_tl_next.argtypes = [
             ctypes.c_void_p,
@@ -76,24 +77,40 @@ def native_available() -> bool:
 
 
 class SyntheticTelemetryLoader:
-    """JAX-keyed reproducible batches (the CLI default)."""
+    """JAX-keyed reproducible batches (the CLI default).
+
+    ``steps=0``: snapshot batches (``synthetic_batch``); ``steps=T``:
+    ``next_window`` yields (window [T, G, E, F], Batch) via the
+    temporal family's ``synthetic_window`` law."""
 
     def __init__(self, groups: int, endpoints: int,
-                 feature_dim: int = 8, seed: int = 0):
+                 feature_dim: int = 8, seed: int = 0, steps: int = 0):
         import jax
 
         self._jax = jax
         self.groups, self.endpoints = groups, endpoints
         self.feature_dim = feature_dim
+        self.steps = steps
         self._key = jax.random.PRNGKey(seed)
         self._step = 0
 
-    def next_batch(self) -> Batch:
+    def _next_key(self):
         key = self._jax.random.fold_in(self._key, self._step)
         self._step += 1
-        return synthetic_batch(key, groups=self.groups,
+        return key
+
+    def next_batch(self) -> Batch:
+        return synthetic_batch(self._next_key(), groups=self.groups,
                                endpoints=self.endpoints,
                                feature_dim=self.feature_dim)
+
+    def next_window(self):
+        from .temporal import synthetic_window
+
+        return synthetic_window(self._next_key(), steps=self.steps,
+                                groups=self.groups,
+                                endpoints=self.endpoints,
+                                feature_dim=self.feature_dim)
 
     def close(self) -> None:
         pass

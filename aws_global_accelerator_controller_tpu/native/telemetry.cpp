@@ -34,7 +34,7 @@
 namespace {
 
 struct Batch {
-  std::vector<float> features;  // [G, E, F]
+  std::vector<float> features;  // snapshot [G, E, F] or window [T, G, E, F]
   std::vector<uint8_t> mask;    // [G, E]
   std::vector<float> target;    // [G, E]
 };
@@ -92,6 +92,7 @@ struct Rng {
 
 struct Loader {
   int groups, endpoints, features, capacity;
+  int steps = 0;  // 0 = snapshot mode; T >= 1 = window mode
   std::mutex mu;
   std::condition_variable cv_pop;   // consumers wait for a ready batch
   std::condition_variable cv_push;  // producers wait for ring space
@@ -106,6 +107,10 @@ struct Loader {
       groups(g), endpoints(e), features(f), capacity(cap) {}
 
   Batch generate(Rng& rng) const {
+    return steps > 0 ? generate_window(rng) : generate_snapshot(rng);
+  }
+
+  Batch generate_snapshot(Rng& rng) const {
     Batch b;
     const int G = groups, E = endpoints, F = features;
     b.features.resize(size_t(G) * E * F);
@@ -123,6 +128,39 @@ struct Loader {
           // capacity proxy: exp of feature 0, as in synthetic_batch
           raw[e] = std::exp(double(
               b.features[(size_t(g) * E + e) * F]));
+          denom += raw[e];
+        }
+      }
+      for (int e = 0; e < E; e++)
+        b.target[size_t(g) * E + e] =
+            denom > 0.0 ? float(raw[e] / denom) : 0.0f;
+    }
+    return b;
+  }
+
+  Batch generate_window(Rng& rng) const {
+    // temporal law, mirroring models/temporal.py synthetic_window:
+    // i.i.d. N(0,1) features per step, mask ~ Bernoulli(0.85), target
+    // ~ exp(capacity trend over the window) among valid endpoints
+    Batch b;
+    const int T = steps, G = groups, E = endpoints, F = features;
+    b.features.resize(size_t(T) * G * E * F);
+    b.mask.resize(size_t(G) * E);
+    b.target.resize(size_t(G) * E);
+    for (auto& x : b.features) x = float(rng.normal());
+    const size_t step_stride = size_t(G) * E * F;
+    for (int g = 0; g < G; g++) {
+      double denom = 0.0;
+      std::vector<double> raw(E, 0.0);
+      for (int e = 0; e < E; e++) {
+        const bool valid = rng.uniform() < 0.85;
+        b.mask[size_t(g) * E + e] = valid ? 1 : 0;
+        if (valid) {
+          const size_t f0 = (size_t(g) * E + e) * F;
+          const double trend =
+              double(b.features[(T - 1) * step_stride + f0])
+              - double(b.features[f0]);
+          raw[e] = std::exp(trend);
           denom += raw[e];
         }
       }
@@ -176,12 +214,15 @@ struct Loader {
 
 extern "C" {
 
+// steps == 0: snapshot mode ([G, E, F] batches); steps == T >= 1:
+// window mode ([T, G, E, F] batches with a trend-law target).
 void* aga_tl_new(int groups, int endpoints, int features, int capacity,
-                 int n_threads, uint64_t seed) {
+                 int n_threads, uint64_t seed, int steps) {
   if (groups <= 0 || endpoints <= 0 || features <= 0 || capacity <= 0 ||
-      n_threads <= 0)
+      n_threads <= 0 || steps < 0)
     return nullptr;
   auto* l = new Loader(groups, endpoints, features, capacity);
+  l->steps = steps;
   l->start(n_threads, seed);
   return l;
 }

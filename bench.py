@@ -310,6 +310,58 @@ def _json_bench_subprocess(fn_name: str, what: str,
         return {"skipped": f"unparseable output: {out[-200:]}"}
 
 
+def bench_flash_long(t: int = 8192, h: int = 8, d: int = 128) -> dict:
+    """Long-context point: flash forward at T=8192 (4x the headline T).
+
+    The dense oracle is deliberately NOT timed here — materialising the
+    [T, T] score tensor at this length costs 2 GB/head-group and XLA's
+    dense path falls over in HBM long before the kernel does, which is
+    the point of flash.  Informational; not part of bench.py's required
+    output line (kept bounded).
+    """
+    import numpy as np
+
+    from aws_global_accelerator_controller_tpu.jaxenv import import_jax
+
+    jax = import_jax()
+    import jax.numpy as jnp
+    from jax import lax
+
+    from aws_global_accelerator_controller_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": f"non-tpu backend ({jax.default_backend()})"}
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (t, h, d), jnp.bfloat16)
+               for kk in ks)
+
+    def chained(n):
+        def body(_, qq):
+            return flash_attention(qq, k, v, causal=True).astype(
+                qq.dtype)
+        return jax.jit(lambda q0: lax.fori_loop(0, n, body, q0)[0, 0]
+                       .astype(jnp.float32))
+
+    n = 256
+    f1, fn = chained(1), chained(n)
+    np.asarray(f1(q)), np.asarray(fn(q))
+    t1 = min(_timed_call(np, f1, q) for _ in range(3))
+    tn = min(_timed_call(np, fn, q) for _ in range(3))
+    fwd_s = max(tn - t1, 1e-9) / (n - 1)
+    flops = 2.0 * t * t * d * h
+    peak, kind = _tpu_peak(jax.devices()[0])
+    return {
+        "device_kind": kind,
+        "shape": {"t": t, "h": h, "d": d},
+        "fwd_us": round(fwd_s * 1e6, 1),
+        "fwd_tflops": round(flops / fwd_s / 1e12, 2),
+        "fwd_mfu_pct": round(100.0 * flops / fwd_s / peak, 2),
+    }
+
+
 def tpu_probe(timeout: float = 60.0) -> "tuple[str, str]":
     """Fast gate for the accelerator benches: one tiny op, subprocess.
 
