@@ -42,9 +42,9 @@ def register(sub) -> None:
                             "divide --groups.")
     train.add_argument("--loader", choices=("synthetic", "native"),
                        default="synthetic",
-                       help="Batch source (mlp/deep): synthetic = "
-                            "reproducible JAX batches; native = the "
-                            "C++ background pipeline "
+                       help="Batch source (mlp/deep/temporal): "
+                            "synthetic = reproducible JAX batches; "
+                            "native = the C++ background pipeline "
                             "(native/telemetry.cpp), higher input "
                             "throughput, not bit-reproducible.")
     train.add_argument("--remat", action="store_true",
@@ -144,21 +144,33 @@ def _build_model(args):
     lr = getattr(args, "lr", 1e-3)
     sharded = getattr(args, "sharded", False)
     loader_kind = getattr(args, "loader", "synthetic")
-    if loader_kind != "synthetic" and args.model not in ("mlp", "deep"):
+    if loader_kind != "synthetic" and args.model == "moe":
         raise SystemExit(
-            f"--loader {loader_kind} supports the snapshot-telemetry "
-            f"families (mlp, deep); {args.model} generates its own "
-            f"batch law")
+            f"--loader {loader_kind} supports the mlp, deep and "
+            f"temporal families; moe generates its own batch law")
     if args.model == "temporal":
         from ..models.temporal import TemporalTrafficModel, synthetic_window
 
         model = TemporalTrafficModel(hidden_dim=args.hidden,
                                      learning_rate=lr)
 
-        def make_data(key):
-            return synthetic_window(key, steps=args.window,
-                                    groups=args.groups,
-                                    endpoints=args.endpoints)
+        if loader_kind == "synthetic":
+            def make_data(key):
+                return synthetic_window(key, steps=args.window,
+                                        groups=args.groups,
+                                        endpoints=args.endpoints)
+        else:
+            # window-mode C++ pipeline (native/telemetry.cpp steps=T):
+            # batches stream from worker threads, key is ignored
+            from ..models.loader import make_loader
+
+            loader = make_loader(loader_kind, args.groups,
+                                 args.endpoints, seed=args.seed,
+                                 steps=args.window)
+            _open_loaders.append(loader)
+
+            def make_data(key):
+                return loader.next_window()
 
         if sharded:
             planner = _temporal_planner(args, model)

@@ -100,6 +100,9 @@ class SyntheticTelemetryLoader:
         return key
 
     def next_batch(self) -> Batch:
+        if self.steps:
+            raise RuntimeError(
+                "loader is in window mode (steps > 0); use next_window")
         return synthetic_batch(self._next_key(), groups=self.groups,
                                endpoints=self.endpoints,
                                feature_dim=self.feature_dim)
@@ -107,6 +110,10 @@ class SyntheticTelemetryLoader:
     def next_window(self):
         from .temporal import synthetic_window
 
+        if not self.steps:
+            raise RuntimeError(
+                "loader is in snapshot mode (steps == 0); use "
+                "next_batch")
         return synthetic_window(self._next_key(), steps=self.steps,
                                 groups=self.groups,
                                 endpoints=self.endpoints,
@@ -123,11 +130,16 @@ class SyntheticTelemetryLoader:
 
 
 class NativeTelemetryLoader:
-    """C++ background pipeline; see module docstring for the contract."""
+    """C++ background pipeline; see module docstring for the contract.
+
+    ``steps=0`` (default): ``next_batch`` pops snapshot batches.
+    ``steps=T``: ``next_window`` pops temporal windows — the C++
+    workers generate the window law of ``temporal.synthetic_window``
+    (trend-based targets) with [T, G, E, F] features."""
 
     def __init__(self, groups: int, endpoints: int,
                  feature_dim: int = 8, seed: int = 0,
-                 capacity: int = 4, n_threads: int = 2):
+                 capacity: int = 4, n_threads: int = 2, steps: int = 0):
         lib = _load()
         if lib is None:
             raise RuntimeError(
@@ -136,20 +148,16 @@ class NativeTelemetryLoader:
         self._lib = lib
         self.groups, self.endpoints = groups, endpoints
         self.feature_dim = feature_dim
+        self.steps = steps
         self._h = lib.aga_tl_new(groups, endpoints, feature_dim,
                                  capacity, n_threads,
-                                 ctypes.c_uint64(seed or 1))
+                                 ctypes.c_uint64(seed or 1), steps)
         if not self._h:
             raise RuntimeError("native telemetry loader init failed")
         self._closed = False
 
-    def next_batch(self) -> Batch:
-        import jax.numpy as jnp
-
-        if self._closed:
-            raise RuntimeError("telemetry loader is closed")
-        g, e, f = self.groups, self.endpoints, self.feature_dim
-        features = np.empty((g, e, f), np.float32)
+    def _pop(self, features: np.ndarray):
+        g, e = self.groups, self.endpoints
         mask = np.empty((g, e), np.uint8)
         target = np.empty((g, e), np.float32)
         ok = self._lib.aga_tl_next(
@@ -159,9 +167,42 @@ class NativeTelemetryLoader:
             target.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         if not ok:
             raise RuntimeError("telemetry loader stopped")
+        return mask, target
+
+    def next_batch(self) -> Batch:
+        import jax.numpy as jnp
+
+        if self._closed:
+            raise RuntimeError("telemetry loader is closed")
+        if self.steps:
+            raise RuntimeError(
+                "loader is in window mode (steps > 0); use next_window")
+        g, e, f = self.groups, self.endpoints, self.feature_dim
+        features = np.empty((g, e, f), np.float32)
+        mask, target = self._pop(features)
         return Batch(features=jnp.asarray(features, jnp.bfloat16),
                      mask=jnp.asarray(mask.astype(bool)),
                      target=jnp.asarray(target))
+
+    def next_window(self):
+        """(window [T, G, E, F] f32, Batch) — the temporal contract of
+        ``SyntheticTelemetryLoader.next_window``."""
+        import jax.numpy as jnp
+
+        if self._closed:
+            raise RuntimeError("telemetry loader is closed")
+        if not self.steps:
+            raise RuntimeError(
+                "loader is in snapshot mode (steps == 0); use "
+                "next_batch")
+        t, g, e, f = (self.steps, self.groups, self.endpoints,
+                      self.feature_dim)
+        features = np.empty((t, g, e, f), np.float32)
+        mask, target = self._pop(features)
+        window = jnp.asarray(features)
+        return window, Batch(features=window[-1].astype(jnp.bfloat16),
+                             mask=jnp.asarray(mask.astype(bool)),
+                             target=jnp.asarray(target))
 
     def stats(self) -> dict:
         if self._closed:
@@ -202,4 +243,5 @@ def make_loader(kind: str, groups: int, endpoints: int,
                        "falling back to synthetic")
     elif kind != "synthetic":
         raise ValueError(f"unknown loader kind {kind!r}")
-    return SyntheticTelemetryLoader(groups, endpoints, feature_dim, seed)
+    return SyntheticTelemetryLoader(groups, endpoints, feature_dim, seed,
+                                    steps=kw.get("steps", 0))

@@ -227,9 +227,11 @@ void* aga_tl_new(int groups, int endpoints, int features, int capacity,
   return l;
 }
 
-// Blocking pop into caller-provided buffers (sized [G*E*F], [G*E],
-// [G*E]).  Returns 1 on success, 0 when the loader was stopped.  Called
-// with the GIL released (ctypes), so Python threads park here natively.
+// Blocking pop into caller-provided buffers: features sized [G*E*F] in
+// snapshot mode (steps == 0) or [steps*G*E*F] in window mode; mask and
+// target always [G*E].  Returns 1 on success, 0 when the loader was
+// stopped.  Called with the GIL released (ctypes), so Python threads
+// park here natively.
 int aga_tl_next(void* h, float* features, uint8_t* mask, float* target) {
   auto* l = static_cast<Loader*>(h);
   Batch b;

@@ -13,11 +13,16 @@ feature columns multiply zero-padded weight rows, so padding does not
 perturb results.
 
 Runs in interpret mode off-TPU (tests), compiled on TPU
-(/opt/skills/guides/pallas_guide.md patterns).  Matmuls pin
-``preferred_element_type=bfloat16``: the MXU accumulates f32 internally
-and rounds the output to bf16 exactly like XLA's dense bf16 path, so
-the fused kernel is bit-equal to ``TrafficPolicyModel.forward_dense``
-(and bf16 operands keep the MXU on its fast path).
+(/opt/skills/guides/pallas_guide.md patterns).  Matmuls take bf16
+operands with an f32 accumulator (Mosaic requires 32-bit matmul accs)
+and round each result to bf16, mirroring XLA's dense bf16 path.
+Equivalence contract vs ``TrafficPolicyModel.forward_dense``: bit-equal
+in interpret mode; on compiled TPU, within ±1 of the final int32 weight
+on a small fraction of cells (~0.2% observed) because XLA's epilogue
+fusion may carry the f32 accumulator through bias+ReLU before rounding
+where the kernel rounds per matmul — last-ulp drift at the scale-to-255
+rounding boundary, inherent to comparing against an opaque fusion
+pipeline.
 """
 from __future__ import annotations
 
@@ -31,22 +36,22 @@ from jax.experimental.pallas import tpu as pltpu
 from .pallas_weights import _BLOCK_G, plan_block
 
 
+def _bf16_dot(x, w_ref):
+    # bf16 operands, f32 accumulator (Mosaic requires a 32-bit matmul
+    # acc on TPU), result rounded to bf16 per matmul; equivalence to
+    # forward_dense is per the module-docstring contract (bit-equal
+    # interpreted, ±1 weight unit compiled)
+    return jnp.dot(x, w_ref[:],
+                   preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+
 def _kernel(x_ref, mask_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref,
             b3_ref, out_ref):
-    # bf16 matmuls with bf16-rounded outputs: the MXU accumulates f32
-    # internally and rounds, exactly like XLA's dense bf16 path — so
-    # the fused kernel is BIT-EQUAL to TrafficPolicyModel.forward_dense
-    # (and bf16 operands keep the MXU on its fast path)
     gb, e, f = x_ref.shape
     x = x_ref[:].reshape(gb * e, f)
-    h = jnp.maximum(
-        jnp.dot(x, w1_ref[:], preferred_element_type=jnp.bfloat16)
-        + b1_ref[:], 0)
-    h = jnp.maximum(
-        jnp.dot(h, w2_ref[:], preferred_element_type=jnp.bfloat16)
-        + b2_ref[:], 0)
-    s = (jnp.dot(h, w3_ref[:], preferred_element_type=jnp.bfloat16)
-         + b3_ref[:])
+    h = jnp.maximum(_bf16_dot(x, w1_ref) + b1_ref[:], 0)
+    h = jnp.maximum(_bf16_dot(h, w2_ref) + b2_ref[:], 0)
+    s = _bf16_dot(h, w3_ref) + b3_ref[:]
     # w3 is padded [H, 128] with only column 0 live
     scores = s[:, 0].reshape(gb, e).astype(jnp.float32)
     out_ref[:] = plan_block(scores, mask_ref[:] > 0)
@@ -108,7 +113,7 @@ def _forward(params, features, mask, interpret):
 
 
 def forward_pallas(params, features, mask) -> jax.Array:
-    """Drop-in for TrafficPolicyModel.forward_dense — bit-equal bf16
-    numerics (see module docstring)."""
+    """Drop-in for TrafficPolicyModel.forward_dense — bit-equal in
+    interpret mode, ±1 weight unit compiled (see module docstring)."""
     interpret = jax.default_backend() != "tpu"
     return _forward(params, features, mask, interpret)

@@ -99,6 +99,43 @@ def _tpu_peak(device) -> "tuple[float, str]":
     return 197e12, kind or "unknown"
 
 
+def _flash_setup(t: int, h: int, d: int):
+    """Shared scaffolding for the flash benches: bf16 q/k/v at [t, h, d]
+    plus a ``marginal_s(step, n, reps)`` timer that chains ``step``
+    through a q -> q data dependence (see bench_flash's methodology
+    docstring).  Returns None off-TPU."""
+    import numpy as np
+
+    from aws_global_accelerator_controller_tpu.jaxenv import import_jax
+
+    jax = import_jax()
+    import jax.numpy as jnp
+    from jax import lax
+
+    if jax.default_backend() != "tpu":
+        return None
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (t, h, d), jnp.bfloat16)
+               for kk in ks)
+
+    def chained(step, n):
+        def body(_, qq):
+            return step(qq).astype(qq.dtype)
+        return jax.jit(
+            lambda q0: lax.fori_loop(0, n, body, q0)[0, 0]
+            .astype(jnp.float32))
+
+    def marginal_s(step, n, reps=4):
+        return _marginal_s(np, lambda s: chained(step, s), (q,), n,
+                           reps)
+
+    # causal attention matmul FLOPs: QK^T and PV are 2*T^2*D each per
+    # head full; causality halves the live work -> 2*T^2*D*H total
+    fwd_flops = 2.0 * t * t * d * h
+    return jax, jnp, q, k, v, marginal_s, fwd_flops
+
+
 def bench_flash(t: int = 2048, h: int = 8, d: int = 128) -> dict:
     """Flash-attention kernel at MXU-saturating shapes, causal bf16.
 
@@ -119,14 +156,6 @@ def bench_flash(t: int = 2048, h: int = 8, d: int = 128) -> dict:
     marginal timing for the speedup ratio.  Off-TPU the kernel runs
     interpret-mode and the numbers are meaningless.
     """
-    import numpy as np
-
-    from aws_global_accelerator_controller_tpu.jaxenv import import_jax
-
-    jax = import_jax()
-    import jax.numpy as jnp
-    from jax import lax
-
     from aws_global_accelerator_controller_tpu.ops.pallas_attention import (
         flash_attention,
     )
@@ -134,28 +163,14 @@ def bench_flash(t: int = 2048, h: int = 8, d: int = 128) -> dict:
         attention_reference,
     )
 
-    if jax.default_backend() != "tpu":
+    setup = _flash_setup(t, h, d)
+    if setup is None:
         # interpret-mode flash at these iteration counts would burn the
         # whole subprocess budget for meaningless numbers
-        return {"skipped": f"non-tpu backend ({jax.default_backend()})"}
-
-    ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (jax.random.normal(kk, (t, h, d), jnp.bfloat16)
-               for kk in ks)
-
-    def chained(step, n):
-        def body(_, qq):
-            return step(qq).astype(qq.dtype)
-        return jax.jit(
-            lambda q0: lax.fori_loop(0, n, body, q0)[0, 0]
-            .astype(jnp.float32))
-
-    def marginal_s(step, n, reps: int = 4):
-        f1, fn = chained(step, 1), chained(step, n)
-        np.asarray(f1(q)), np.asarray(fn(q))   # compile + warm
-        t1 = min(_timed_call(np, f1, q) for _ in range(reps))
-        tn = min(_timed_call(np, fn, q) for _ in range(reps))
-        return max(tn - t1, 1e-9) / (n - 1)
+        from aws_global_accelerator_controller_tpu.jaxenv import import_jax
+        return {"skipped":
+                f"non-tpu backend ({import_jax().default_backend()})"}
+    jax, jnp, q, k, v, marginal_s, fwd_flops = setup
 
     fwd_s = marginal_s(
         lambda qq: flash_attention(qq, k, v, causal=True), n=4096)
@@ -166,11 +181,8 @@ def bench_flash(t: int = 2048, h: int = 8, d: int = 128) -> dict:
     dense_s = marginal_s(
         lambda qq: attention_reference(qq, k, v, causal=True), n=512)
 
-    # causal attention matmul FLOPs: QK^T and PV are 2*T^2*D each per
-    # head full; causality halves the live work -> 2*T^2*D*H total.
     # Grad accounting uses the standard fwd+bwd model-FLOPs convention
     # (bwd = 2.5x fwd; recompute inside the VJP not counted as useful).
-    fwd_flops = 2.0 * t * t * d * h
     grad_flops = fwd_flops * 3.5
     peak, kind = _tpu_peak(jax.devices()[0])
     return {
@@ -193,6 +205,19 @@ def _timed_call(np, f, *args) -> float:
     start = time.perf_counter()
     np.asarray(f(*args))
     return time.perf_counter() - start
+
+
+def _marginal_s(np, chained, args, n: int, reps: int = 4) -> float:
+    """Chained-marginal timing: per-iteration seconds of the op inside
+    ``chained(steps)`` (a jitted fn running the op ``steps`` times with
+    a data dependence XLA cannot elide), measured as
+    (time(n) - time(1)) / (n - 1) over min-of-``reps`` runs — dispatch
+    and sync overhead cancel in the subtraction."""
+    f1, fn = chained(1), chained(n)
+    np.asarray(f1(*args)), np.asarray(fn(*args))   # compile + warm
+    t1 = min(_timed_call(np, f1, *args) for _ in range(reps))
+    tn = min(_timed_call(np, fn, *args) for _ in range(reps))
+    return max(tn - t1, 1e-9) / (n - 1)
 
 
 def _run_subprocess(code: str, timeout: float, what: str,
@@ -271,12 +296,7 @@ def bench_temporal_train(t: int = 2048, g: int = 8, e: int = 16,
         return jax.jit(lambda p, o: lax.scan(
             body, (p, o), None, length=steps)[1][-1])
 
-    f1, fn = chained(1), chained(n)
-    np.asarray(f1(params, opt_state))
-    np.asarray(fn(params, opt_state))          # compile + warm
-    t1 = min(_timed_call(np, f1, params, opt_state) for _ in range(4))
-    tn = min(_timed_call(np, fn, params, opt_state) for _ in range(4))
-    step_s = max(tn - t1, 1e-9) / (n - 1)
+    step_s = _marginal_s(np, chained, (params, opt_state), n)
 
     s = g * e
     dense_fwd = 2.0 * t * s * d * (f + 3 * d)
@@ -319,39 +339,20 @@ def bench_flash_long(t: int = 8192, h: int = 8, d: int = 128) -> dict:
     the point of flash.  Informational; not part of bench.py's required
     output line (kept bounded).
     """
-    import numpy as np
-
-    from aws_global_accelerator_controller_tpu.jaxenv import import_jax
-
-    jax = import_jax()
-    import jax.numpy as jnp
-    from jax import lax
-
     from aws_global_accelerator_controller_tpu.ops.pallas_attention import (
         flash_attention,
     )
 
-    if jax.default_backend() != "tpu":
-        return {"skipped": f"non-tpu backend ({jax.default_backend()})"}
+    setup = _flash_setup(t, h, d)
+    if setup is None:
+        from aws_global_accelerator_controller_tpu.jaxenv import import_jax
+        return {"skipped":
+                f"non-tpu backend ({import_jax().default_backend()})"}
+    jax, jnp, q, k, v, marginal_s, flops = setup
 
-    ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (jax.random.normal(kk, (t, h, d), jnp.bfloat16)
-               for kk in ks)
-
-    def chained(n):
-        def body(_, qq):
-            return flash_attention(qq, k, v, causal=True).astype(
-                qq.dtype)
-        return jax.jit(lambda q0: lax.fori_loop(0, n, body, q0)[0, 0]
-                       .astype(jnp.float32))
-
-    n = 256
-    f1, fn = chained(1), chained(n)
-    np.asarray(f1(q)), np.asarray(fn(q))
-    t1 = min(_timed_call(np, f1, q) for _ in range(3))
-    tn = min(_timed_call(np, fn, q) for _ in range(3))
-    fwd_s = max(tn - t1, 1e-9) / (n - 1)
-    flops = 2.0 * t * t * d * h
+    fwd_s = marginal_s(
+        lambda qq: flash_attention(qq, k, v, causal=True), n=256,
+        reps=3)
     peak, kind = _tpu_peak(jax.devices()[0])
     return {
         "device_kind": kind,
@@ -391,11 +392,31 @@ def bench_flash_subprocess(timeout: float = 300.0) -> dict:
                                   timeout)
 
 
+def bench_flash_long_subprocess(timeout: float = 300.0) -> dict:
+    return _json_bench_subprocess("bench_flash_long",
+                                  "tpu flash long-context bench",
+                                  timeout)
+
+
 def bench_planner(groups: int = 4096, endpoints: int = 128,
-                  iters: int = 50) -> dict:
+                  n: int = 64) -> dict:
+    """Fleet-planning throughput: endpoint-groups planned per second
+    through the flagship forward (fused Pallas kernel on TPU, dense
+    XLA elsewhere).
+
+    Chained-marginal timing like the other benches: iterations are
+    linked by a real data dependence (the next iteration's features
+    branch on the previous plan's sum), so neither async dispatch nor
+    the tunnel's transfer latency is mistaken for device throughput —
+    a naive dispatch loop over this tunnel reports rates above the
+    chip's peak FLOPs."""
+    import numpy as np
+
     from aws_global_accelerator_controller_tpu.jaxenv import import_jax
 
     jax = import_jax()
+    import jax.numpy as jnp
+    from jax import lax
 
     from aws_global_accelerator_controller_tpu.models.traffic import (
         TrafficPolicyModel,
@@ -406,18 +427,21 @@ def bench_planner(groups: int = 4096, endpoints: int = 128,
     params = model.init_params(jax.random.PRNGKey(0))
     batch = synthetic_batch(jax.random.PRNGKey(1), groups=groups,
                             endpoints=endpoints)
-    fwd = jax.jit(model.forward)
-    out = fwd(params, batch.features, batch.mask)
-    jax.block_until_ready(out)  # compile outside the timed loop
 
-    start = time.perf_counter()
-    for _ in range(iters):
-        out = fwd(params, batch.features, batch.mask)
-    jax.block_until_ready(out)
-    elapsed = time.perf_counter() - start
+    def chained(steps):
+        def body(_, feats):
+            out = model.forward(params, feats, batch.mask)
+            # plans are non-negative so the branch never fires, but XLA
+            # must compute out to know that — the dependence it cannot
+            # elide
+            return jnp.where(jnp.sum(out) < 0, feats + 1.0, feats)
+        return jax.jit(lambda f0: lax.fori_loop(0, steps, body, f0)
+                       [0, 0, 0].astype(jnp.float32))
+
+    step_s = _marginal_s(np, chained, (batch.features,), n)
     return {"backend": jax.default_backend(),
-            "groups_per_s": groups * iters / elapsed,
-            "elapsed_s": elapsed}
+            "groups_per_s": round(groups / step_s, 1),
+            "plan_ms": round(step_s * 1e3, 3)}
 
 
 def bench_planner_subprocess(timeout: float = 180.0) -> str:
@@ -436,18 +460,20 @@ def main() -> None:
     status, detail = tpu_probe()
     if status == "dead":
         skip = {"skipped": f"backend wedged: {detail}"}
-        flash, temporal = skip, dict(skip)
+        flash, flash_long, temporal = skip, dict(skip), dict(skip)
         planner_line = f"planner bench skipped: {detail}"
     else:
         # the planner bench is backend-agnostic: run it either way
         planner_line = bench_planner_subprocess()
         if status == "tpu":
             flash = bench_flash_subprocess()
+            flash_long = bench_flash_long_subprocess()
             temporal = bench_temporal_subprocess()
         else:
             skip = {"skipped": f"non-tpu backend ({detail})"}
-            flash, temporal = skip, dict(skip)
+            flash, flash_long, temporal = skip, dict(skip), dict(skip)
     print(f"tpu flash: {flash}", file=sys.stderr)
+    print(f"tpu flash long-context (T=8192): {flash_long}", file=sys.stderr)
     print(f"tpu temporal train: {temporal}", file=sys.stderr)
     print(planner_line, file=sys.stderr)
 
@@ -462,6 +488,7 @@ def main() -> None:
         # estimate (VERDICT r1 item 2), plus the model-level number --
         # a full temporal-family training step through the flash VJP
         "tpu_flash": flash,
+        "tpu_flash_long": flash_long,
         "tpu_temporal_train": temporal,
     }))
 

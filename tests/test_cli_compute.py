@@ -206,6 +206,16 @@ def test_train_with_native_loader(capsys):
     assert out["step"] == 3 and out["loss"] is not None
 
 
+def test_train_temporal_with_native_loader(capsys):
+    """The temporal family streams windows from the C++ pipeline
+    (window-mode loader; degrades to synthetic without a toolchain)."""
+    assert main(["train", "--model", "temporal", "--loader", "native",
+                 "--steps", "2", "--groups", "4", "--endpoints", "4",
+                 "--hidden", "16", "--window", "6"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["step"] == 2 and out["loss"] is not None
+
+
 def test_native_loader_rejected_for_custom_batch_families(capsys):
     import pytest
 

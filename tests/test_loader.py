@@ -123,6 +123,64 @@ def test_native_loader_trains_the_model():
     assert float(loss) < first
 
 
+@needs_native
+def test_native_loader_window_mode():
+    """steps=T pops (window [T,G,E,F], Batch) with the temporal law:
+    targets favour endpoints whose feature-0 trends up, zero off-mask."""
+    T = 6
+    with NativeTelemetryLoader(G, E, F, seed=13, steps=T) as loader:
+        for _ in range(3):
+            window, batch = loader.next_window()
+            assert window.shape == (T, G, E, F)
+            w = np.asarray(window, np.float32)
+            mask = np.asarray(batch.mask)
+            target = np.asarray(batch.target)
+            assert np.isfinite(w).all()
+            np.testing.assert_allclose(
+                np.asarray(batch.features, np.float32), w[-1],
+                atol=1e-2)
+            sums = target.sum(axis=-1)
+            assert ((np.abs(sums - 1.0) < 1e-3) | (sums == 0.0)).all()
+            assert (target[~mask] == 0).all()
+            # temporal law: among valid endpoints, target ordering
+            # follows the feature-0 trend ordering within each group
+            trend = w[-1, ..., 0] - w[0, ..., 0]
+            for g in range(G):
+                idx = np.nonzero(mask[g])[0]
+                if len(idx) < 2:
+                    continue
+                order_t = np.argsort(trend[g, idx])
+                order_y = np.argsort(target[g, idx])
+                np.testing.assert_array_equal(order_t, order_y)
+
+
+@needs_native
+def test_native_loader_mode_confusion_raises():
+    with NativeTelemetryLoader(G, E, F, seed=1, steps=4) as loader:
+        with pytest.raises(RuntimeError):
+            loader.next_batch()
+    with NativeTelemetryLoader(G, E, F, seed=1) as loader:
+        with pytest.raises(RuntimeError):
+            loader.next_window()
+
+
+def test_synthetic_loader_window_mode():
+    T = 5
+    a = SyntheticTelemetryLoader(G, E, F, seed=2, steps=T)
+    window, batch = a.next_window()
+    assert window.shape == (T, G, E, F)
+    assert batch.features.shape == (G, E, F)
+
+
+def test_make_loader_steps_forwarding(monkeypatch):
+    """make_loader forwards steps in both branches of the fallback."""
+    import aws_global_accelerator_controller_tpu.models.loader as mod
+    monkeypatch.setattr(mod, "native_available", lambda: False)
+    loader = make_loader("native", G, E, F, steps=7)
+    assert isinstance(loader, SyntheticTelemetryLoader)
+    assert loader.steps == 7
+
+
 def test_make_loader_dispatch_and_fallback(monkeypatch):
     assert isinstance(make_loader("synthetic", G, E, F),
                       SyntheticTelemetryLoader)

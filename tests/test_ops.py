@@ -105,10 +105,15 @@ def test_pallas_fused_mlp_matches_model():
     ref = np.asarray(model.forward_dense(params, batch.features,
                                          batch.mask))
     fused = np.asarray(forward_pallas(params, batch.features, batch.mask))
-    # both paths run bf16 matmuls with bf16-rounded outputs (the kernel
-    # pins preferred_element_type=bfloat16), so the integer weights are
-    # bit-equal, not merely close
-    np.testing.assert_array_equal(ref, fused)
+    # both paths run bf16 matmuls with f32 accumulation rounded to bf16,
+    # so in interpret mode (conftest pins cpu) the integer weights are
+    # bit-equal.  Compiled TPU (running this file unpinned) contracts
+    # ±1 weight unit: XLA's epilogue fusion moves the f32->bf16
+    # rounding points (pallas_mlp docstring).
+    if jax.default_backend() == "tpu":
+        np.testing.assert_allclose(ref, fused, atol=1)
+    else:
+        np.testing.assert_array_equal(ref, fused)
     assert np.all(fused[~np.asarray(batch.mask)] == 0)
     assert fused.dtype == np.int32
 
