@@ -4,9 +4,13 @@ Mirrors the reference's cobra command tree (cmd/root.go:13-30,
 cmd/controller/controller.go:24-98, cmd/webhook/webhook.go:17-41,
 cmd/version.go:15-26) with argparse.
 
-Because the ``kubernetes`` package is not available in this environment,
-``controller`` runs against the in-process fake API server (``--fake``,
-default) -- the real-cluster backend is the documented extension point.
+``controller`` has two interchangeable backends (proven by the contract
+suite, tests/test_store_contract.py): ``--fake`` (default here) runs
+against the in-process fake API server; ``--real`` speaks HTTP to a
+cluster API server resolved from ``--kubeconfig``/``--master`` or the
+in-cluster service env (kube/http_store.py, kube/kubeconfig.py) — the
+stdlib-only analogue of the reference's client-go wiring
+(cmd/controller/controller.go:50, pkg/manager/manager.go:43-50).
 """
 from __future__ import annotations
 
