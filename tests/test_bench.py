@@ -68,6 +68,75 @@ def test_bench_reconcile_converges_small_fleet():
     assert r["throughput"] > 0
 
 
+def test_tpu_probe_parses_subprocess_outcomes(monkeypatch):
+    monkeypatch.setattr(bench, "_run_subprocess",
+                        lambda *a, **k: ("tpu 64.0", "ok"))
+    assert bench.tpu_probe() == ("tpu", "tpu")
+    monkeypatch.setattr(bench, "_run_subprocess",
+                        lambda *a, **k: ("cpu 64.0", "ok"))
+    assert bench.tpu_probe() == ("other", "cpu")
+    monkeypatch.setattr(bench, "_run_subprocess",
+                        lambda *a, **k: (None, "wedged"))
+    assert bench.tpu_probe() == ("dead", "wedged")
+
+
+def _main_json(monkeypatch, capsys, status, detail):
+    """Drive bench.main() with every measurement stubbed; return the
+    parsed stdout contract line."""
+    import json
+
+    monkeypatch.setattr(
+        bench, "bench_reconcile_best",
+        lambda **kw: {"services": 10, "elapsed_s": 0.01,
+                      "throughput": 1000.0})
+    monkeypatch.setattr(bench, "tpu_probe", lambda *a, **k: (status,
+                                                            detail))
+    planner_calls = []
+    monkeypatch.setattr(
+        bench, "bench_planner_subprocess",
+        lambda **kw: (planner_calls.append(kw), "planner line")[1])
+    ran = {"flash": 0, "flash_long": 0, "temporal": 0,
+           "planner_calls": planner_calls}
+
+    def stub(name):
+        def run(**kw):
+            ran[name] += 1
+            return {"fwd_us": 1.0}
+        return run
+    monkeypatch.setattr(bench, "bench_flash_subprocess", stub("flash"))
+    monkeypatch.setattr(bench, "bench_flash_long_subprocess",
+                        stub("flash_long"))
+    monkeypatch.setattr(bench, "bench_temporal_subprocess",
+                        stub("temporal"))
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "main() must print exactly ONE stdout line"
+    return json.loads(out[0]), ran
+
+
+def test_main_contract_healthy_tpu(monkeypatch, capsys):
+    data, ran = _main_json(monkeypatch, capsys, "tpu", "tpu")
+    assert data["metric"] == "reconcile_convergence_throughput"
+    assert data["value"] == 1000.0
+    assert data["vs_baseline"] == 1.0
+    assert data["tpu_flash"] == {"fwd_us": 1.0}
+    assert data["tpu_flash_long"] == {"fwd_us": 1.0}
+    assert data["tpu_temporal_train"] == {"fwd_us": 1.0}
+    assert ran["flash"] == ran["flash_long"] == ran["temporal"] == 1
+    assert ran["planner_calls"] == [{}]  # no cpu pin on a healthy tpu
+
+
+def test_main_contract_dead_backend_still_one_line(monkeypatch, capsys):
+    data, ran = _main_json(monkeypatch, capsys, "dead", "unresponsive")
+    assert data["value"] == 1000.0
+    assert "skipped" in data["tpu_flash"]
+    assert "skipped" in data["tpu_flash_long"]
+    assert "skipped" in data["tpu_temporal_train"]
+    assert ran["flash"] == ran["flash_long"] == ran["temporal"] == 0
+    # the backend-agnostic planner must still run, pinned to cpu
+    assert ran["planner_calls"] == [{"force_cpu": True}]
+
+
 @pytest.mark.parametrize("kind,expected", [
     ("TPU v5 lite", 197e12),
     ("TPU v5p chip", 459e12),
