@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import logging
 import queue as queue_mod
+import socket
 import threading
 import time
 import urllib.error
@@ -361,6 +362,8 @@ class _Watcher:
         # before the watch started and were never streamed
         self._objs: Dict[str, Any] = dict(initial or {})
         self._stop = threading.Event()
+        self._resp = None  # in-flight stream, closed by stop()
+        self._resp_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"watch-{codec.kind}")
@@ -370,6 +373,25 @@ class _Watcher:
 
     def stop(self) -> None:
         self._stop.set()
+        # Unblock the thread NOW: without this it sits in the streaming
+        # read until the server sends an event or the 300s idle timeout.
+        # Shut the SOCKET down rather than close() the response — the
+        # reader thread is blocked inside the buffered reader holding
+        # its lock, and HTTPResponse.close() would deadlock on that
+        # same lock; after shutdown the read returns EOF and the
+        # thread's finally does the close.
+        with self._resp_lock:
+            resp = self._resp
+        if resp is not None:
+            try:
+                sock = resp.fp.raw._sock  # urllib/http.client internals
+                sock.shutdown(socket.SHUT_RDWR)
+            except Exception as exc:
+                # keep the degradation observable: without the shutdown
+                # the thread lingers in the idle read for up to 300s
+                logger.debug("watch %s: socket shutdown unavailable "
+                             "(%s); thread will exit on idle timeout",
+                             self._codec.kind, exc)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -409,7 +431,12 @@ class _Watcher:
         # long timeout: the server trickles events; reconnect on idle
         resp = self._client.request("GET", path, stream=True,
                                     timeout=300.0)
-        with resp:
+        with self._resp_lock:
+            if self._stop.is_set():   # stop() raced the connect
+                resp.close()
+                return
+            self._resp = resp
+        try:
             for line in resp:
                 if self._stop.is_set():
                     return
@@ -431,6 +458,14 @@ class _Watcher:
                 obj = self._codec.from_wire(evt.get("object") or {})
                 self._rv = max(self._rv, obj.metadata.resource_version)
                 self._deliver(etype, obj)
+        finally:
+            with self._resp_lock:
+                if self._resp is resp:
+                    self._resp = None
+            try:
+                resp.close()
+            except Exception:
+                pass
 
 
 class _WatchExpired(Exception):

@@ -101,6 +101,26 @@ def test_watch_streams_and_resumes(http_api):
     store.stop_watch(q)
 
 
+def test_stop_watch_unblocks_idle_stream_promptly(http_api):
+    """stop_watch must not wait out the 300s idle-read timeout: the
+    in-flight streaming response is closed so the watcher thread exits
+    within seconds even when no events are flowing."""
+    import time
+
+    store = http_api.store("Service")
+    q = store.watch()
+    with store._lock:
+        watcher = next(iter(store._watchers.values()))
+    # let the thread reach the blocking streamed read
+    wait_until(lambda: watcher._resp is not None, timeout=10,
+               message="watch stream established")
+    start = time.monotonic()
+    store.stop_watch(q)
+    watcher._thread.join(timeout=10)
+    assert not watcher._thread.is_alive()
+    assert time.monotonic() - start < 10
+
+
 def test_watch_410_relist_synthesizes_deletes(http_api):
     """A 410 Gone recovery must not leave subscribers with phantom
     objects: the relist delivers DELETED for objects that vanished in
