@@ -45,7 +45,7 @@ from ..apis.endpointgroupbinding.v1alpha1 import (
     EndpointGroupBinding,
 )
 from ..errors import AdmissionDeniedError, ConflictError, NotFoundError
-from .apiserver import WATCH_ADDED, WATCH_DELETED, WATCH_MODIFIED, WatchEvent
+from .apiserver import WATCH_ADDED, WATCH_DELETED, WatchEvent
 from .kubeconfig import RestConfig
 from .objects import Event, Ingress, Lease, LeaseSpec, ObjectMeta, Service
 
@@ -379,7 +379,12 @@ class _Watcher:
         # reader thread is blocked inside the buffered reader holding
         # its lock, and HTTPResponse.close() would deadlock on that
         # same lock; after shutdown the read returns EOF and the
-        # thread's finally does the close.
+        # thread's finally does the close.  Residual window: a stop()
+        # that lands while the thread is mid-RECONNECT (urlopen, no
+        # response published yet) has nothing to shut down — urllib has
+        # no separate connect timeout — so against an unresponsive
+        # server the daemon thread can linger up to 300s; _stream's
+        # post-connect stop check then closes the late stream.
         with self._resp_lock:
             resp = self._resp
         if resp is not None:
@@ -500,4 +505,3 @@ class HTTPAPIServer:
                 w.stop()
 
 
-_WATCH_TYPES = (WATCH_ADDED, WATCH_MODIFIED, WATCH_DELETED)

@@ -68,7 +68,12 @@ class TrainCheckpointer:
             return {"params": params,
                     "opt_state": model.init_opt_state(params)}
 
-        # eval_shape: the abstract template costs no compute or HBM
+        # eval_shape: the abstract template costs no compute or HBM.
+        # Deliberately NO sharding annotation: orbax then restores each
+        # array with the sharding recorded at save time (it warns about
+        # this path, but it is load-bearing — a sharded trainer's
+        # resume gets params AND opt_state back in the mesh layout it
+        # saved, tests/test_checkpoint.py sharded-roundtrip).
         abstract = jax.eval_shape(template)
         restored = self._mngr.restore(
             step, args=self._ocp.args.StandardRestore(abstract))
