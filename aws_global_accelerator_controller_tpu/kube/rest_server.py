@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import logging
+import socket
 import threading
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -71,6 +72,9 @@ class KubeRestServer:
             kind: _KindState(kind) for kind in self.codecs
         }
         self._stop = threading.Event()
+        # live watch-stream sockets, for chaos testing (drop_watches)
+        self._watch_conns: set = set()
+        self._watch_conns_lock = threading.Lock()
         self._collectors = []
         for kind in self.codecs:
             t = threading.Thread(target=self._collect, args=(kind,),
@@ -122,6 +126,23 @@ class KubeRestServer:
                 state.cond.notify_all()
         self.httpd.shutdown()
         self.httpd.server_close()
+
+    def drop_watches(self) -> int:
+        """Chaos knob: force-close every live watch stream (connection
+        reset from the client's perspective).  Clients must reconnect
+        and resume from their resourceVersion — the path a real
+        apiserver exercises on rolling restarts and LB idle resets.
+        Returns the number of streams dropped."""
+        with self._watch_conns_lock:
+            conns = list(self._watch_conns)
+        dropped = 0
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+                dropped += 1
+            except OSError:
+                pass
+        return dropped
 
     def _collect(self, kind: str) -> None:
         """Mirror the store's broadcast stream into the replay history."""
@@ -254,6 +275,8 @@ class KubeRestServer:
             })
             return
         self._stream_headers(req)
+        with self._watch_conns_lock:
+            self._watch_conns.add(req.connection)
         try:
             while not self._stop.is_set():
                 with state.cond:
@@ -262,12 +285,25 @@ class KubeRestServer:
                                if erv > rv]
                     if not pending:
                         state.cond.wait(timeout=1.0)
-                        continue
+                if not pending:
+                    # idle BOOKMARK (outside the cond lock): confirms
+                    # the client's resume point like the real apiserver
+                    # and doubles as a liveness probe — writing to a
+                    # dropped socket raises, reaping this thread
+                    self._write_line(req, {
+                        "type": "BOOKMARK",
+                        "object": {"metadata":
+                                   {"resourceVersion": str(rv)}},
+                    })
+                    continue
                 for erv, etype, wire in pending:
                     self._write_line(req, {"type": etype, "object": wire})
                     rv = erv
-        except (BrokenPipeError, ConnectionResetError):
+        except OSError:  # connection torn down (reset, pipe, shutdown)
             return
+        finally:
+            with self._watch_conns_lock:
+                self._watch_conns.discard(req.connection)
 
     @staticmethod
     def _stream_headers(req) -> None:

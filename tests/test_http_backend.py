@@ -220,6 +220,56 @@ def test_controllers_converge_over_http(rest, http_api):
         stop.set()
 
 
+def test_controllers_converge_through_watch_chaos(rest, http_api):
+    """Resilience: the control plane converges a fleet while the
+    apiserver keeps resetting watch streams (rolling restarts / LB idle
+    resets on a real cluster).  Every drop forces the watchers through
+    reconnect + resourceVersion resume mid-reconcile."""
+    import time
+
+    kube, factory, stop = _start_manager(http_api)
+    region = "ap-northeast-1"
+    n = 6
+    try:
+        # the manager's informer watches connect asynchronously; chaos
+        # only counts once there are live streams to kill
+        wait_until(lambda: len(rest._watch_conns) >= 3, timeout=10.0,
+                   message="informer watch streams established")
+        dropped = rest.drop_watches()  # sever the streams mid-list
+        for i in range(n):
+            name = f"chaos{i}"
+            hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                        ".amazonaws.com")
+            factory.cloud.elb.register_load_balancer(name, hostname,
+                                                     region)
+            kube.services.create(Service(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                    }),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                    ingress=[LoadBalancerIngress(hostname=hostname)])),
+            ))
+        # keep severing streams while the fleet converges: every drop
+        # forces reconnect + resourceVersion resume mid-reconcile
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if len(factory.cloud.ga.list_accelerators()) == n:
+                break
+            dropped += rest.drop_watches()
+            time.sleep(0.3)
+        assert len(factory.cloud.ga.list_accelerators()) == n, (
+            f"fleet did not converge under watch chaos "
+            f"({dropped} streams dropped)")
+        assert dropped > 0, "chaos never actually dropped a stream"
+    finally:
+        stop.set()
+
+
 def test_leader_election_over_http(rest, http_api):
     """Lease-based leader election through the HTTP Lease store."""
     from aws_global_accelerator_controller_tpu.leaderelection import (
