@@ -46,7 +46,7 @@ from ..apis.endpointgroupbinding.v1alpha1 import (
 )
 from ..errors import AdmissionDeniedError, ConflictError, NotFoundError
 from .apiserver import WATCH_ADDED, WATCH_DELETED, WatchEvent
-from .kubeconfig import RestConfig
+from .kubeconfig import RestConfig, rfc3339_to_epoch
 from .objects import Event, Ingress, Lease, LeaseSpec, ObjectMeta, Service
 
 logger = logging.getLogger(__name__)
@@ -63,15 +63,10 @@ def _epoch_to_rfc3339(ts: Optional[float]) -> Optional[str]:
 
 
 def _rfc3339_to_epoch(s) -> float:
-    if not s:
-        return 0.0
-    if isinstance(s, (int, float)):
-        return float(s)
-    s = s.rstrip("Z")
-    # tolerate second- and microsecond-precision (Time vs MicroTime)
-    fmt = "%Y-%m-%dT%H:%M:%S.%f" if "." in s else "%Y-%m-%dT%H:%M:%S"
-    return datetime.strptime(s, fmt).replace(
-        tzinfo=timezone.utc).timestamp()
+    # canonical parser lives in kubeconfig (shared with exec-credential
+    # expiry); metadata timestamps degrade to 0.0 when unparseable
+    epoch = rfc3339_to_epoch(s)
+    return 0.0 if epoch is None else epoch
 
 
 def _meta_to_wire(d: Dict[str, Any]) -> Dict[str, Any]:
@@ -224,22 +219,32 @@ class RestClient:
                 stream: bool = False, timeout: Optional[float] = None):
         url = self.config.server.rstrip("/") + path
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
-        if data is not None:
-            req.add_header("Content-Type", "application/json")
-        if self.config.token:
-            req.add_header("Authorization", f"Bearer {self.config.token}")
-        try:
-            resp = urllib.request.urlopen(
-                req, timeout=timeout or self.timeout, context=self._ctx)
-        except urllib.error.HTTPError as e:
-            raise self._typed_error(e)
-        if stream:
-            return resp
-        with resp:
-            payload = resp.read()
-        return json.loads(payload) if payload else {}
+        for attempt in (0, 1):
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Accept", "application/json")
+            if data is not None:
+                req.add_header("Content-Type", "application/json")
+            token = self.config.bearer_token()
+            if token:
+                req.add_header("Authorization", f"Bearer {token}")
+            try:
+                resp = urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout,
+                    context=self._ctx)
+            except urllib.error.HTTPError as e:
+                if (e.code == 401 and attempt == 0
+                        and self.config.exec_spec):
+                    # cached exec credential rejected (clock skew,
+                    # early revocation): re-run the plugin and retry
+                    # once — the 401-healing client-go implements
+                    self.config.invalidate_exec_token()
+                    continue
+                raise self._typed_error(e)
+            if stream:
+                return resp
+            with resp:
+                payload = resp.read()
+            return json.loads(payload) if payload else {}
 
     @staticmethod
     def _typed_error(e: urllib.error.HTTPError) -> Exception:
@@ -403,7 +408,18 @@ class _Watcher:
             try:
                 self._stream()
             except _WatchExpired:
-                self._relist()
+                # an exception inside an except clause would escape the
+                # sibling handler below and kill this thread for good —
+                # a relist failure (transient network, exec-credential
+                # hiccup) must loop back like any dropped stream
+                try:
+                    self._relist()
+                except Exception as e:
+                    if self._stop.is_set():
+                        return
+                    logger.warning("watch %s relist failed: %s; "
+                                   "retrying", self._codec.kind, e)
+                    time.sleep(1.0)
             except Exception as e:
                 if self._stop.is_set():
                     return

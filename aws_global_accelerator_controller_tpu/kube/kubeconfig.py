@@ -15,16 +15,52 @@ Resolution order matches client-go:
 from __future__ import annotations
 
 import base64
+import json
 import os
+import re
+import subprocess
 import tempfile
+import threading
+import time
 from dataclasses import dataclass, field
+from datetime import datetime, timezone
 from typing import Optional
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+# re-run the exec plugin this many seconds before the credential's
+# stated expiry (client-go uses a similar early-refresh margin)
+_EXEC_EXPIRY_SLACK = 60.0
+
 
 class KubeConfigError(Exception):
     pass
+
+
+def rfc3339_to_epoch(s) -> Optional[float]:
+    """Parse any RFC3339 form ('Z' or numeric offset, up to nanosecond
+    precision) to epoch seconds; int/float pass through; None/"" -> 0.0
+    (absent); unparseable -> None so callers pick their own fallback.
+    The one timestamp parser for this package (http_store imports it)."""
+    if not s:
+        return 0.0
+    if isinstance(s, (int, float)):
+        return float(s)
+    t = s.strip()
+    if t.endswith("Z"):
+        t = t[:-1] + "+00:00"
+    # normalize the fraction to exactly 6 digits: 3.10's fromisoformat
+    # only accepts 3- or 6-digit fractions, so pad short ones (Go's
+    # RFC3339Nano trims trailing zeros) and truncate nanoseconds
+    t = re.sub(r"\.(\d+)",
+               lambda m: "." + m.group(1)[:6].ljust(6, "0"), t, count=1)
+    try:
+        dt = datetime.fromisoformat(t)
+    except ValueError:
+        return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()
 
 
 @dataclass
@@ -35,9 +71,14 @@ class RestConfig:
     ca_file: Optional[str] = None
     cert_file: Optional[str] = None       # client certificate (mTLS)
     key_file: Optional[str] = None
-    token: Optional[str] = None           # bearer token
+    token: Optional[str] = None           # static bearer token
     insecure_skip_tls_verify: bool = False
+    exec_spec: Optional[dict] = None      # kubeconfig user.exec plugin
     _tmpfiles: list = field(default_factory=list, repr=False)
+    _exec_token: Optional[str] = field(default=None, repr=False)
+    _exec_expiry: float = field(default=0.0, repr=False)
+    _exec_lock: threading.Lock = field(default_factory=threading.Lock,
+                                       repr=False)
 
     def ssl_context(self):
         """Build the ssl.SSLContext for this config (None for http://)."""
@@ -52,6 +93,85 @@ class RestConfig:
         if self.cert_file:
             ctx.load_cert_chain(self.cert_file, self.key_file)
         return ctx
+
+    def bearer_token(self) -> Optional[str]:
+        """The token to send right now.
+
+        Static ``token`` wins; otherwise an ``exec`` credential plugin
+        (the EKS norm: ``aws eks get-token``) is run on first use and
+        re-run once its credential nears expiry — EKS tokens live ~15
+        minutes, far shorter than a controller process.
+        """
+        if self.token:
+            return self.token
+        if not self.exec_spec:
+            return None
+        with self._exec_lock:
+            if (self._exec_token is not None
+                    and (not self._exec_expiry
+                         or time.time()
+                         < self._exec_expiry - _EXEC_EXPIRY_SLACK)):
+                return self._exec_token
+            self._exec_token, self._exec_expiry = _run_exec_plugin(
+                self.exec_spec)
+            return self._exec_token
+
+    def invalidate_exec_token(self) -> None:
+        """Drop the cached exec credential so the next request re-runs
+        the plugin — the 401-healing path client-go implements (clock
+        skew, early revocation, or an expiry we could not parse)."""
+        with self._exec_lock:
+            self._exec_token = None
+            self._exec_expiry = 0.0
+
+
+def _run_exec_plugin(spec: dict) -> "tuple[str, float]":
+    """Run a kubeconfig exec credential plugin; return (token, expiry
+    epoch or 0).  Wire contract: client.authentication.k8s.io
+    ExecCredential JSON on the plugin's stdout."""
+    command = spec.get("command")
+    if not command:
+        raise KubeConfigError("exec credential plugin has no command")
+    argv = [command] + [str(a) for a in spec.get("args") or []]
+    env = dict(os.environ)
+    for item in spec.get("env") or []:
+        if item.get("name"):
+            env[item["name"]] = item.get("value", "")
+    api_version = spec.get(
+        "apiVersion", "client.authentication.k8s.io/v1beta1")
+    env["KUBERNETES_EXEC_INFO"] = json.dumps({
+        "apiVersion": api_version,
+        "kind": "ExecCredential",
+        "spec": {"interactive": False},
+    })
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              env=env, timeout=60)
+    except (OSError, subprocess.SubprocessError) as e:
+        raise KubeConfigError(
+            f"exec credential plugin {command!r} failed to run: {e}")
+    if proc.returncode != 0:
+        raise KubeConfigError(
+            f"exec credential plugin {command!r} exited "
+            f"{proc.returncode}: {proc.stderr.strip()[-300:]}")
+    try:
+        cred = json.loads(proc.stdout)
+    except ValueError:
+        raise KubeConfigError(
+            f"exec credential plugin {command!r} printed invalid JSON")
+    status = cred.get("status") or {}
+    token = status.get("token")
+    if not token:
+        raise KubeConfigError(
+            f"exec credential plugin {command!r} returned no token "
+            "(client certificates from exec plugins are not supported)")
+    ts = status.get("expirationTimestamp")
+    expiry = rfc3339_to_epoch(ts)
+    if expiry is None:
+        # a stated expiry we cannot parse: treating it as 'never'
+        # would cache a ~15-minute token forever; refresh soon instead
+        expiry = time.time() + 2 * _EXEC_EXPIRY_SLACK
+    return token, expiry
 
 
 def _inline_to_file(data_b64: str, suffix: str, tmpfiles: list) -> str:
@@ -115,6 +235,10 @@ def load_kubeconfig(path: str, master: str = "") -> RestConfig:
             user["client-key-data"], ".key", cfg._tmpfiles)
     if user.get("token"):
         cfg.token = user["token"]
+    elif user.get("exec"):
+        # credential plugin (the EKS norm); run lazily on first request
+        # and refreshed near expiry — see RestConfig.bearer_token
+        cfg.exec_spec = dict(user["exec"])
     return cfg
 
 

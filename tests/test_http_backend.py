@@ -121,6 +121,41 @@ def test_stop_watch_unblocks_idle_stream_promptly(http_api):
     assert time.monotonic() - start < 10
 
 
+def test_watch_loop_survives_failing_relist(monkeypatch):
+    """A relist that fails (transient network, exec-credential hiccup)
+    must not kill the watch thread: the exception is contained and the
+    loop retries (an exception raised inside an except clause would
+    otherwise escape the sibling handler)."""
+    import queue
+
+    import aws_global_accelerator_controller_tpu.kube.http_store as hs
+
+    class _C:
+        kind = "Test"
+
+    w = hs._Watcher(None, _C(), queue.Queue(), 0)
+    monkeypatch.setattr(hs.time, "sleep", lambda s: None)
+    relists = []
+
+    def flaky_relist():
+        relists.append(1)
+        if len(relists) == 1:
+            raise RuntimeError("transient relist failure")
+
+    streams = []
+
+    def stream():
+        streams.append(1)
+        if len(streams) <= 2:
+            raise hs._WatchExpired()
+        w._stop.set()
+
+    w._stream = stream
+    w._relist = flaky_relist
+    w._run()  # inline, no thread: must return, not raise
+    assert len(relists) == 2  # failed once, retried successfully
+
+
 def test_watch_410_relist_synthesizes_deletes(http_api):
     """A 410 Gone recovery must not leave subscribers with phantom
     objects: the relist delivers DELETED for objects that vanished in

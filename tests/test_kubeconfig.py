@@ -1,0 +1,288 @@
+"""Kubeconfig / in-cluster config resolution (kube/kubeconfig.py) —
+the clientcmd.BuildConfigFromFlags analogue (reference
+cmd/controller/controller.go:50), including exec credential plugins
+(the EKS norm: `aws eks get-token`) with expiry-aware refresh."""
+import base64
+import os
+import sys
+import textwrap
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.kube.kubeconfig import (
+    KubeConfigError,
+    RestConfig,
+    build_config,
+    in_cluster_config,
+    load_kubeconfig,
+)
+
+
+def _write_kubeconfig(tmp_path, user: dict, cluster: dict = None):
+    doc = {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx",
+                      "context": {"cluster": "c1", "user": "u1"}}],
+        "clusters": [{"name": "c1", "cluster": cluster or {
+            "server": "https://example:6443"}}],
+        "users": [{"name": "u1", "user": user}],
+    }
+    import yaml
+
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(doc))
+    return str(path)
+
+
+def test_token_user(tmp_path):
+    cfg = load_kubeconfig(_write_kubeconfig(tmp_path, {"token": "abc"}))
+    assert cfg.server == "https://example:6443"
+    assert cfg.bearer_token() == "abc"
+
+
+def test_master_overrides_server(tmp_path):
+    cfg = load_kubeconfig(_write_kubeconfig(tmp_path, {"token": "t"}),
+                          master="https://other:6443")
+    assert cfg.server == "https://other:6443"
+
+
+def test_inline_certs_decoded_to_files(tmp_path):
+    pem = b"-----BEGIN FAKE-----\nhello\n-----END FAKE-----\n"
+    b64 = base64.b64encode(pem).decode()
+    cfg = load_kubeconfig(_write_kubeconfig(
+        tmp_path,
+        {"client-certificate-data": b64, "client-key-data": b64},
+        cluster={"server": "https://example:6443",
+                 "certificate-authority-data": b64}))
+    for f in (cfg.ca_file, cfg.cert_file, cfg.key_file):
+        with open(f, "rb") as fh:
+            assert fh.read() == pem
+    assert (os.stat(cfg.cert_file).st_mode & 0o777) == 0o600
+
+
+def test_inline_cert_without_key_rejected(tmp_path):
+    b64 = base64.b64encode(b"x").decode()
+    with pytest.raises(KubeConfigError, match="client-key-data"):
+        load_kubeconfig(_write_kubeconfig(
+            tmp_path, {"client-certificate-data": b64}))
+
+
+def test_missing_context_errors(tmp_path):
+    import yaml
+
+    path = tmp_path / "bad"
+    path.write_text(yaml.safe_dump({"apiVersion": "v1"}))
+    with pytest.raises(KubeConfigError, match="current-context"):
+        load_kubeconfig(str(path))
+
+
+def _exec_plugin(tmp_path, body: str) -> dict:
+    """A python-script exec plugin; returns the kubeconfig exec spec."""
+    script = tmp_path / "plugin.py"
+    script.write_text(textwrap.dedent(body))
+    return {"apiVersion": "client.authentication.k8s.io/v1beta1",
+            "command": sys.executable, "args": [str(script)]}
+
+
+def test_exec_plugin_token(tmp_path):
+    spec = _exec_plugin(tmp_path, """
+        import json
+        print(json.dumps({"kind": "ExecCredential",
+                          "status": {"token": "exec-token-1"}}))
+    """)
+    cfg = load_kubeconfig(_write_kubeconfig(tmp_path, {"exec": spec}))
+    assert cfg.exec_spec is not None
+    assert cfg.bearer_token() == "exec-token-1"
+
+
+def test_exec_plugin_cached_until_expiry(tmp_path):
+    """Within the validity window the plugin runs ONCE; a credential
+    inside the refresh slack is re-fetched on the next request."""
+    counter = tmp_path / "count"
+    counter.write_text("0")
+    body = """
+        import json, datetime
+        p = COUNTER_PATH
+        n = int(open(p).read()) + 1
+        open(p, "w").write(str(n))
+        exp = (datetime.datetime.utcnow()
+               + datetime.timedelta(seconds=EXP_SECONDS)).strftime(
+                   "%Y-%m-%dT%H:%M:%SZ")
+        print(json.dumps({"kind": "ExecCredential",
+                          "status": {"token": "tok-%d" % n,
+                                     "expirationTimestamp": exp}}))
+    """.replace("COUNTER_PATH", repr(str(counter)))
+    # long-lived credential: cached
+    spec = _exec_plugin(tmp_path, body.replace("EXP_SECONDS", "3600"))
+    cfg = RestConfig(server="https://x", exec_spec=spec)
+    assert cfg.bearer_token() == "tok-1"
+    assert cfg.bearer_token() == "tok-1"
+    assert counter.read_text() == "1"
+
+    # credential expiring inside the 60s slack: refreshed every call
+    spec2 = _exec_plugin(tmp_path, body.replace("EXP_SECONDS", "5"))
+    cfg2 = RestConfig(server="https://x", exec_spec=spec2)
+    assert cfg2.bearer_token() == "tok-2"
+    assert cfg2.bearer_token() == "tok-3"
+
+
+def test_exec_plugin_failure_modes(tmp_path):
+    bad_exit = _exec_plugin(tmp_path, "import sys; sys.exit(3)")
+    with pytest.raises(KubeConfigError, match="exited 3"):
+        RestConfig(server="https://x", exec_spec=bad_exit).bearer_token()
+
+    bad_json = _exec_plugin(tmp_path, "print('not json')")
+    with pytest.raises(KubeConfigError, match="invalid JSON"):
+        RestConfig(server="https://x", exec_spec=bad_json).bearer_token()
+
+    no_token = _exec_plugin(
+        tmp_path, "import json; print(json.dumps({'status': {}}))")
+    with pytest.raises(KubeConfigError, match="no token"):
+        RestConfig(server="https://x", exec_spec=no_token).bearer_token()
+
+
+def test_exec_plugin_env_and_exec_info(tmp_path):
+    spec = _exec_plugin(tmp_path, """
+        import json, os
+        info = json.loads(os.environ["KUBERNETES_EXEC_INFO"])
+        assert info["kind"] == "ExecCredential"
+        token = os.environ.get("MY_REGION", "") + "!" + info["apiVersion"]
+        print(json.dumps({"status": {"token": token}}))
+    """)
+    spec["env"] = [{"name": "MY_REGION", "value": "eu-north-1"}]
+    cfg = RestConfig(server="https://x", exec_spec=spec)
+    assert cfg.bearer_token() == (
+        "eu-north-1!client.authentication.k8s.io/v1beta1")
+
+
+def test_static_token_beats_exec(tmp_path):
+    spec = _exec_plugin(tmp_path, "raise SystemExit(1)")
+    cfg = RestConfig(server="https://x", token="static",
+                     exec_spec=spec)
+    assert cfg.bearer_token() == "static"
+
+
+def test_rfc3339_to_epoch_forms():
+    from aws_global_accelerator_controller_tpu.kube.kubeconfig import (
+        rfc3339_to_epoch,
+    )
+
+    base = 1767225600.0  # 2026-01-01T00:00:00Z
+    assert rfc3339_to_epoch("2026-01-01T00:00:00Z") == base
+    assert rfc3339_to_epoch("2026-01-01T00:00:00+00:00") == base
+    assert rfc3339_to_epoch("2026-01-01T01:00:00+01:00") == base
+    assert rfc3339_to_epoch("2026-01-01T00:00:00.5Z") == base + 0.5
+    # nanosecond precision truncates, not crashes
+    assert abs(rfc3339_to_epoch("2026-01-01T00:00:00.123456789Z")
+               - (base + 0.123456)) < 1e-6
+    assert rfc3339_to_epoch("") == 0.0
+    assert rfc3339_to_epoch(None) == 0.0
+    assert rfc3339_to_epoch(1234.5) == 1234.5
+    assert rfc3339_to_epoch("not-a-time") is None
+
+
+def test_exec_unparseable_expiry_is_short_lived(tmp_path):
+    """A stated-but-unparseable expiry must NOT cache forever (the
+    token probably lives ~15 minutes); it gets a short refresh TTL."""
+    import time
+
+    spec = _exec_plugin(tmp_path, """
+        import json
+        print(json.dumps({"status": {
+            "token": "t", "expirationTimestamp": "garbage"}}))
+    """)
+    cfg = RestConfig(server="https://x", exec_spec=spec)
+    assert cfg.bearer_token() == "t"
+    assert 0 < cfg._exec_expiry < time.time() + 600
+
+
+def test_401_reruns_exec_plugin_and_retries(tmp_path):
+    """Server-side rejection of a cached exec credential re-runs the
+    plugin and retries once (client-go's 401 healing)."""
+    import json as json_mod
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from aws_global_accelerator_controller_tpu.kube.http_store import (
+        RestClient,
+    )
+
+    counter = tmp_path / "count"
+    counter.write_text("0")
+    body = """
+        import json
+        p = COUNTER_PATH
+        n = int(open(p).read()) + 1
+        open(p, "w").write(str(n))
+        print(json.dumps({"status": {"token": "tok-%d" % n}}))
+    """.replace("COUNTER_PATH", repr(str(counter)))
+    spec = _exec_plugin(tmp_path, body)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            # reject the first credential; accept refreshed ones
+            ok = self.headers.get("Authorization") != "Bearer tok-1"
+            payload = json_mod.dumps(
+                {"ok": True} if ok
+                else {"message": "Unauthorized"}).encode()
+            self.send_response(200 if ok else 401)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        cfg = RestConfig(
+            server=f"http://127.0.0.1:{httpd.server_address[1]}",
+            exec_spec=spec)
+        client = RestClient(cfg)
+        assert client.request("GET", "/api/v1/things") == {"ok": True}
+        assert counter.read_text() == "2"  # initial + post-401 re-run
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_build_config_resolution(tmp_path, monkeypatch):
+    path = _write_kubeconfig(tmp_path, {"token": "t"})
+    # explicit flag
+    assert build_config(kubeconfig=path).token == "t"
+    # $KUBECONFIG fallback
+    monkeypatch.setenv("KUBECONFIG", path)
+    assert build_config().token == "t"
+    monkeypatch.delenv("KUBECONFIG")
+    # no config anywhere: --master alone still works
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    monkeypatch.setattr(os.path, "expanduser",
+                        lambda p: str(tmp_path / "nope"))
+    cfg = build_config(master="https://m:6443")
+    assert cfg.server == "https://m:6443"
+    with pytest.raises(KubeConfigError, match="no kubeconfig"):
+        build_config()
+
+
+def test_in_cluster_config(tmp_path, monkeypatch):
+    import aws_global_accelerator_controller_tpu.kube.kubeconfig as kc
+
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "token").write_text("sa-token\n")
+    (sa / "ca.crt").write_text("ca")
+    monkeypatch.setattr(kc, "SERVICE_ACCOUNT_DIR", str(sa))
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+    cfg = in_cluster_config()
+    assert cfg.server == "https://10.0.0.1:443"
+    assert cfg.token == "sa-token"
+    assert cfg.ca_file == str(sa / "ca.crt")
+
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST")
+    with pytest.raises(KubeConfigError, match="in-cluster"):
+        in_cluster_config()
