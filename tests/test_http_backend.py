@@ -305,6 +305,42 @@ def test_controllers_converge_through_watch_chaos(rest, http_api):
         stop.set()
 
 
+def test_fleet_scale_over_http(rest, http_api):
+    """The wire path at fleet size: 100 annotated Services converge to
+    accelerator chains THROUGH the REST apiserver (serialization, HTTP
+    round-trips, streaming watch fan-out — everything the in-process
+    fake skips).  Measured ~0.5s; the 60s budget is pure headroom for
+    slow CI."""
+    kube, factory, stop = _start_manager(http_api)
+    region = "ap-northeast-1"
+    n = 100
+    try:
+        for i in range(n):
+            name = f"fleet{i:03d}"
+            hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                        ".amazonaws.com")
+            factory.cloud.elb.register_load_balancer(name, hostname,
+                                                     region)
+            kube.services.create(Service(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                    }),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                    ingress=[LoadBalancerIngress(hostname=hostname)])),
+            ))
+        wait_until(
+            lambda: len(factory.cloud.ga.list_accelerators()) == n,
+            timeout=60.0, interval=0.2,
+            message=f"{n}-service fleet converged over HTTP")
+    finally:
+        stop.set()
+
+
 def test_leader_election_over_http(rest, http_api):
     """Lease-based leader election through the HTTP Lease store."""
     from aws_global_accelerator_controller_tpu.leaderelection import (
