@@ -121,6 +121,24 @@ def test_stop_watch_unblocks_idle_stream_promptly(http_api):
     assert time.monotonic() - start < 10
 
 
+def test_idle_bookmarks_are_invisible_to_subscribers(http_api):
+    """The server's idle BOOKMARK keepalives must be consumed by the
+    watcher (resume-point bookkeeping), never surfacing as events."""
+    import queue as queue_mod
+
+    store = http_api.store("Service")
+    q = store.watch()
+    store.create(_service("bm1"))
+    assert q.get(timeout=10).type == "ADDED"
+    # server emits a BOOKMARK after ~1s idle; give it two cycles
+    with pytest.raises(queue_mod.Empty):
+        q.get(timeout=2.5)
+    # the stream is still live: a new object arrives after the idle gap
+    store.create(_service("bm2"))
+    assert q.get(timeout=10).obj.name == "bm2"
+    store.stop_watch(q)
+
+
 def test_watch_loop_survives_failing_relist(monkeypatch):
     """A relist that fails (transient network, exec-credential hiccup)
     must not kill the watch thread: the exception is contained and the
