@@ -265,6 +265,7 @@ class FakeAPIServer:
     def __init__(self):
         self._rv = itertools.count(1)
         self._rv_lock = threading.Lock()
+        self._last_rv = 0
         self._webhooks: list = []
         from .validation import endpoint_group_binding_validator
         validators = {"EndpointGroupBinding": endpoint_group_binding_validator()}
@@ -277,7 +278,16 @@ class FakeAPIServer:
 
     def _next_rv(self) -> int:
         with self._rv_lock:
-            return next(self._rv)
+            self._last_rv = next(self._rv)
+            return self._last_rv
+
+    def current_rv(self) -> int:
+        """Highest resourceVersion issued so far (0 when fresh) — the
+        watch-cache seed for servers fronting this store: RVs at or
+        below it may reference events no new subscriber can replay
+        (including DELETEs of objects that no longer list)."""
+        with self._rv_lock:
+            return self._last_rv
 
     def store(self, kind: str) -> ResourceStore:
         return self.stores[kind]

@@ -45,6 +45,11 @@ class _KindState:
         self.history: deque = deque(maxlen=_HISTORY)
         self.cond = threading.Condition()
         self.last_rv = 0
+        # the RV snapshot when this server's watch cache started: a
+        # resume below it predates the cache and must 410 (the real
+        # apiserver's post-restart behavior) — events between that RV
+        # and the cache start are not in history and can never stream
+        self.window_start = 0
 
     def append(self, etype: str, wire_obj: dict, rv: int) -> None:
         with self.cond:
@@ -75,6 +80,7 @@ class KubeRestServer:
         # live watch-stream sockets, for chaos testing (drop_watches)
         self._watch_conns: set = set()
         self._watch_conns_lock = threading.Lock()
+        self._queues: Dict[str, object] = {}  # kind -> store watch queue
         self._collectors = []
         for kind in self.codecs:
             t = threading.Thread(target=self._collect, args=(kind,),
@@ -112,6 +118,23 @@ class KubeRestServer:
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> "KubeRestServer":
+        # Subscribe and seed every watch cache SYNCHRONOUSLY before the
+        # serve thread runs: the listening socket is bound in __init__,
+        # so a reconnect-hammering client may already sit in the
+        # backlog — it must not observe window_start=0 and bypass the
+        # post-restart 410.  The seed is the store's global RV counter,
+        # not max-of-listed-objects: a DELETE stamped just before a
+        # restart carries an RV above every surviving object, and a
+        # resume from before it must 410 into a relist or the deletion
+        # is lost forever.
+        for kind in self.codecs:
+            store = self.api.store(kind)
+            q = store.watch()           # subscribe-before-seed
+            state = self._states[kind]
+            with state.cond:
+                state.window_start = self.api.current_rv()
+                state.last_rv = max(state.last_rv, state.window_start)
+            self._queues[kind] = q
         for t in self._collectors:
             t.start()
         self._serve_thread.start()
@@ -145,10 +168,11 @@ class KubeRestServer:
         return dropped
 
     def _collect(self, kind: str) -> None:
-        """Mirror the store's broadcast stream into the replay history."""
+        """Mirror the store's broadcast stream into the replay history
+        (the subscription itself is made in start(), synchronously)."""
         store = self.api.store(kind)
         codec = self.codecs[kind]
-        q = store.watch()
+        q = self._queues[kind]
         try:
             while not self._stop.is_set():
                 try:
@@ -265,8 +289,13 @@ class KubeRestServer:
         except ValueError:
             rv = 0
         oldest = state.oldest_rv()
-        if rv and oldest and rv < oldest - 1:
-            # resume point fell out of the replay window
+        with state.cond:
+            window_start = state.window_start
+        if rv and ((oldest and rv < oldest - 1)
+                   or rv < window_start):
+            # resume point fell out of the replay window (history
+            # eviction), or predates this server's watch cache
+            # entirely (post-restart resume)
             self._stream_headers(req)
             self._write_line(req, {
                 "type": "ERROR",

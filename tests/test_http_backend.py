@@ -359,6 +359,67 @@ def test_fleet_scale_over_http(rest, http_api):
         stop.set()
 
 
+def test_convergence_resumes_after_apiserver_restart(rest, http_api):
+    """Full apiserver outage: the server process dies and comes back on
+    the same address with persisted state (etcd survives a real
+    apiserver restart).  Objects created DURING the outage must
+    converge once it returns — watchers reconnect, relist, and deliver
+    the missed events."""
+    import time
+
+    kube, factory, stop = _start_manager(http_api)
+    region = "ap-northeast-1"
+
+    def make_service(name):
+        hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                    ".amazonaws.com")
+        factory.cloud.elb.register_load_balancer(name, hostname, region)
+        return Service(
+            metadata=ObjectMeta(
+                name=name, namespace="default",
+                annotations={
+                    AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                }),
+            spec=ServiceSpec(type="LoadBalancer",
+                             ports=[ServicePort(port=80)]),
+            status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(hostname=hostname)])))
+
+    revived = None
+    try:
+        for i in range(3):
+            kube.services.create(make_service(f"pre{i}"))
+        wait_until(
+            lambda: len(factory.cloud.ga.list_accelerators()) == 3,
+            timeout=30.0, message="pre-outage fleet converged")
+
+        port = rest.port
+        rest.shutdown()                     # the outage
+        time.sleep(1.5)                     # let watchers hit reconnect
+        # mutations while the apiserver is down, straight into the
+        # persisted store (controllers cannot see them yet): creates
+        # AND a delete — the delete's event RV outlives the object, the
+        # case the watch-cache window seed must cover
+        for i in range(2):
+            rest.api.store("Service").create(make_service(f"mid{i}"))
+        rest.api.store("Service").delete("default", "pre0")
+
+        # same state, same address: etcd survived the restart
+        revived = KubeRestServer(api=rest.api, port=port).start()
+        wait_until(
+            lambda: sorted(
+                a.name for a in factory.cloud.ga.list_accelerators())
+            == ["service-default-mid0", "service-default-mid1",
+                "service-default-pre1", "service-default-pre2"],
+            timeout=60.0,
+            message="outage creates AND delete converged after restart")
+    finally:
+        stop.set()
+        if revived is not None:
+            revived.shutdown()
+
+
 def test_leader_election_over_http(rest, http_api):
     """Lease-based leader election through the HTTP Lease store."""
     from aws_global_accelerator_controller_tpu.leaderelection import (
