@@ -404,9 +404,16 @@ class _Watcher:
                              self._codec.kind, exc)
 
     def _run(self) -> None:
+        from ..metrics import record_watch_event
+
         while not self._stop.is_set():
             try:
                 self._stream()
+                if not self._stop.is_set():
+                    # clean EOF: the server ended the stream (its
+                    # timeoutSeconds, a restart, an LB reset) — the
+                    # most common drop form; reconnect immediately
+                    record_watch_event(self._codec.kind, "dropped")
             except _WatchExpired:
                 # an exception inside an except clause would escape the
                 # sibling handler below and kill this thread for good —
@@ -414,15 +421,19 @@ class _Watcher:
                 # hiccup) must loop back like any dropped stream
                 try:
                     self._relist()
+                    record_watch_event(self._codec.kind, "relist")
                 except Exception as e:
                     if self._stop.is_set():
                         return
+                    record_watch_event(self._codec.kind,
+                                       "relist_failed")
                     logger.warning("watch %s relist failed: %s; "
                                    "retrying", self._codec.kind, e)
                     time.sleep(1.0)
             except Exception as e:
                 if self._stop.is_set():
                     return
+                record_watch_event(self._codec.kind, "dropped")
                 logger.warning("watch %s dropped: %s; reconnecting",
                                self._codec.kind, e)
                 time.sleep(1.0)

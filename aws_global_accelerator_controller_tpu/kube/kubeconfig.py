@@ -129,6 +129,18 @@ def _run_exec_plugin(spec: dict) -> "tuple[str, float]":
     """Run a kubeconfig exec credential plugin; return (token, expiry
     epoch or 0).  Wire contract: client.authentication.k8s.io
     ExecCredential JSON on the plugin's stdout."""
+    from ..metrics import record_exec_credential_run
+
+    try:
+        result = _run_exec_plugin_inner(spec)
+    except KubeConfigError:
+        record_exec_credential_run("error")
+        raise
+    record_exec_credential_run("ok")
+    return result
+
+
+def _run_exec_plugin_inner(spec: dict) -> "tuple[str, float]":
     command = spec.get("command")
     if not command:
         raise KubeConfigError("exec credential plugin has no command")

@@ -10,6 +10,9 @@ Prometheus text exposition (no client library dependency):
 - ``controller_sync_duration_seconds{queue}`` summary (sum + count)
 - ``workqueue_depth{queue}`` gauge (sampled at scrape)
 - ``leader{name}`` gauge
+- ``watch_disruptions_total{kind,event}`` counter (HTTP backend:
+  dropped streams, 410 relists, relist failures)
+- ``exec_credential_runs_total{outcome}`` counter (EKS exec auth)
 
 Endpoints: /healthz (liveness, always 200), /readyz (readiness via
 registered probes), /metrics.
@@ -43,6 +46,19 @@ class Registry:
                     value: float = 1.0) -> None:
         with self._lock:
             self._counters[(name, tuple(sorted(labels.items())))] += value
+
+    def counter_value(self, name: str,
+                      labels: Optional[Dict[str, str]] = None) -> float:
+        """Current value of a counter: the exact (name, labels) series,
+        or the sum over all series of ``name`` when labels is None.
+        Public read accessor so tests and probes never reach into the
+        storage representation."""
+        with self._lock:
+            if labels is not None:
+                return self._counters.get(
+                    (name, tuple(sorted(labels.items()))), 0.0)
+            return sum(v for (n, _), v in self._counters.items()
+                       if n == name)
 
     def observe_summary(self, name: str, labels: Dict[str, str],
                         value: float) -> None:
@@ -109,6 +125,31 @@ default_registry.describe("controller_sync_total",
 default_registry.describe("controller_sync_duration_seconds",
                           "Reconcile handler durations per queue.")
 default_registry.describe("workqueue_depth", "Current queue depths.")
+
+
+default_registry.describe(
+    "watch_disruptions_total",
+    "Watch-stream lifecycle events per kind "
+    "(dropped / relist / relist_failed).")
+default_registry.describe(
+    "exec_credential_runs_total",
+    "Exec credential plugin invocations by outcome (ok / error).")
+
+
+def record_watch_event(kind: str, event: str,
+                       registry: Optional[Registry] = None) -> None:
+    """A watch stream was dropped, healed via relist, or failed to
+    relist — the disruption telemetry a real cluster's rolling
+    restarts and LB idle resets generate (kube/http_store.py)."""
+    reg = registry or default_registry
+    reg.inc_counter("watch_disruptions_total",
+                    {"kind": kind, "event": event})
+
+
+def record_exec_credential_run(outcome: str,
+                               registry: Optional[Registry] = None) -> None:
+    reg = registry or default_registry
+    reg.inc_counter("exec_credential_runs_total", {"outcome": outcome})
 
 
 def record_sync(queue_name: str, result: str, duration: float,

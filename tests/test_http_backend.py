@@ -309,6 +309,15 @@ def test_controllers_converge_through_watch_chaos(rest, http_api):
             ))
         # keep severing streams while the fleet converges: every drop
         # forces reconnect + resourceVersion resume mid-reconcile
+        from aws_global_accelerator_controller_tpu.metrics import (
+            default_registry,
+        )
+
+        def disruptions():
+            return default_registry.counter_value(
+                "watch_disruptions_total")
+
+        before = disruptions()
         deadline = time.monotonic() + 60.0
         while time.monotonic() < deadline:
             if len(factory.cloud.ga.list_accelerators()) == n:
@@ -319,6 +328,9 @@ def test_controllers_converge_through_watch_chaos(rest, http_api):
             f"fleet did not converge under watch chaos "
             f"({dropped} streams dropped)")
         assert dropped > 0, "chaos never actually dropped a stream"
+        # the disruptions surfaced in the metrics registry
+        wait_until(lambda: disruptions() > before, timeout=10.0,
+                   message="watch disruptions recorded in metrics")
     finally:
         stop.set()
 

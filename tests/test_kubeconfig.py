@@ -157,6 +157,29 @@ def test_exec_plugin_env_and_exec_info(tmp_path):
         "eu-north-1!client.authentication.k8s.io/v1beta1")
 
 
+def test_exec_runs_recorded_in_metrics(tmp_path):
+    from aws_global_accelerator_controller_tpu.metrics import (
+        default_registry,
+    )
+
+    def runs(outcome):
+        return default_registry.counter_value(
+            "exec_credential_runs_total", {"outcome": outcome})
+
+    ok0, err0 = runs("ok"), runs("error")
+    good = _exec_plugin(tmp_path, """
+        import json
+        print(json.dumps({"status": {"token": "t"}}))
+    """)
+    assert RestConfig(server="https://x",
+                      exec_spec=good).bearer_token() == "t"
+    bad = _exec_plugin(tmp_path, "import sys; sys.exit(1)")
+    with pytest.raises(KubeConfigError):
+        RestConfig(server="https://x", exec_spec=bad).bearer_token()
+    assert runs("ok") == ok0 + 1
+    assert runs("error") == err0 + 1
+
+
 def test_static_token_beats_exec(tmp_path):
     spec = _exec_plugin(tmp_path, "raise SystemExit(1)")
     cfg = RestConfig(server="https://x", token="static",
