@@ -9,6 +9,7 @@ from typing import Callable, Optional
 
 from ..errors import ConflictError, NotFoundError
 from ..kube.client import KubeClient
+from ..kube.kubeconfig import KubeConfigError
 from ..kube.objects import Lease, LeaseSpec, ObjectMeta
 
 logger = logging.getLogger(__name__)
@@ -38,6 +39,20 @@ class LeaderElection:
         self._observed_holder = ""
 
     # -- lock primitives ------------------------------------------------
+
+    def _attempt(self) -> bool:
+        """_try_acquire_or_renew with transient errors mapped to a
+        failed attempt (client-go semantics): an apiserver outage must
+        burn against the renew deadline, not crash the elector thread.
+        The catch covers the HTTP backend's failure surface — OSError
+        (connection refused/reset, timeouts, URLError), RuntimeError
+        (apiserver 5xx), KubeConfigError (credential plugin hiccups) —
+        but NOT programming errors, which must surface."""
+        try:
+            return self._try_acquire_or_renew()
+        except (OSError, RuntimeError, KubeConfigError) as e:
+            logger.warning("lease acquire/renew attempt failed: %s", e)
+            return False
 
     def _try_acquire_or_renew(self) -> bool:
         """One CAS attempt against the Lease object."""
@@ -103,7 +118,7 @@ class LeaderElection:
         logger.info("leader election id: %s", self.identity)
         try:
             while not stop.is_set():
-                if self._try_acquire_or_renew():
+                if self._attempt():
                     self._lead(stop, on_started_leading, on_stopped_leading)
                     return
                 stop.wait(self.retry_period)
@@ -124,7 +139,7 @@ class LeaderElection:
         last_renew = time.monotonic()
         try:
             while not stop.is_set():
-                if self._try_acquire_or_renew():
+                if self._attempt():
                     last_renew = time.monotonic()
                 elif time.monotonic() - last_renew > self.renew_deadline:
                     logger.warning("leader lost: %s", self.identity)

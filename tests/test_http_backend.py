@@ -457,6 +457,58 @@ def test_leader_election_over_http(rest, http_api):
         t.join(timeout=10.0)
 
 
+def test_leader_survives_apiserver_restart(rest, http_api):
+    """The leader must ride out an apiserver outage shorter than its
+    renew deadline: renew attempts fail while the server is down, then
+    succeed against the revived server (persisted Lease) — leadership
+    is retained, on_stopped_leading never fires."""
+    import time
+
+    from aws_global_accelerator_controller_tpu.leaderelection import (
+        LeaderElection,
+    )
+
+    kube = KubeClient(http_api)
+    stop = threading.Event()
+    became = threading.Event()
+    lost = threading.Event()
+    le = LeaderElection("restart-le", "default", kube,
+                        lease_duration=8.0, renew_deadline=6.0,
+                        retry_period=0.5)
+    t = threading.Thread(
+        target=lambda: le.run(
+            stop, on_started_leading=lambda s: became.set(),
+            on_stopped_leading=lost.set),
+        daemon=True)
+    t.start()
+    revived = None
+    try:
+        assert became.wait(15.0), "never became leader"
+        holder = kube.leases.get("default", "restart-le") \
+                     .spec.holder_identity
+
+        port = rest.port
+        rest.shutdown()                 # outage shorter than deadline
+        time.sleep(2.0)                 # a few failed renew attempts
+        assert not lost.is_set(), "lost leadership during short outage"
+        revived = KubeRestServer(api=rest.api, port=port).start()
+
+        # renewal resumes against the revived server: renew_time moves
+        def renewed():
+            lease = kube.leases.get("default", "restart-le")
+            return (lease.spec.holder_identity == holder
+                    and lease.spec.renew_time > time.time() - 2.0)
+
+        wait_until(renewed, timeout=10.0, interval=0.3,
+                   message="lease renewal resumed after restart")
+        assert not lost.is_set()
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+        if revived is not None:
+            revived.shutdown()
+
+
 def test_cli_controller_real_mode_against_stub(rest, tmp_path):
     """`controller --real --kubeconfig ...` end-to-end as a real process:
     kubeconfig resolution, HTTP backend, leader election via the Lease
