@@ -328,28 +328,39 @@ def _moe_planner(args, model):
 
 
 def _pipeline_planner(args, model):
-    """1-D stage mesh: one residual block per device, GPipe schedule."""
+    """Stage mesh (one residual block per device, GPipe schedule);
+    when --stages divides the device count with room left over, the
+    spare factor becomes a 'data' axis — dp x pp on a 2-D mesh."""
     import jax
 
     from ..parallel import ShardedPipelinePlanner
+    from ..parallel.mesh import make_mesh
     from ..parallel.ring import make_mesh_1d
 
     n_dev = len(jax.devices())
-    if args.stages != n_dev:
+    if args.stages < 1 or n_dev % args.stages:
         raise SystemExit(
-            f"--sharded deep needs --stages equal to the device count "
-            f"({n_dev}); got stages={args.stages}")
+            f"--sharded deep needs --stages (>= 1) to divide the "
+            f"device count ({n_dev}); got stages={args.stages}")
     if args.groups % args.microbatches:
         raise SystemExit(
             f"--sharded deep needs --groups divisible by "
             f"--microbatches; got groups={args.groups} "
             f"microbatches={args.microbatches}")
-    logger.info("pipeline mesh: stage=%d microbatches=%d remat=%s",
-                n_dev, args.microbatches,
+    n_data = n_dev // args.stages
+    if n_data > 1:
+        mesh = make_mesh(axis_shapes={"data": n_data,
+                                      "stage": args.stages})
+        data_axis = "data"
+    else:
+        mesh, data_axis = make_mesh_1d(n_dev, "stage"), None
+    logger.info("pipeline mesh: data=%d stage=%d microbatches=%d "
+                "remat=%s", n_data, args.stages, args.microbatches,
                 getattr(args, "remat", False))
-    return ShardedPipelinePlanner(model, make_mesh_1d(n_dev, "stage"),
+    return ShardedPipelinePlanner(model, mesh,
                                   n_microbatches=args.microbatches,
-                                  remat=getattr(args, "remat", False))
+                                  remat=getattr(args, "remat", False),
+                                  data_axis=data_axis)
 
 
 def _mlp_planner(args, model):

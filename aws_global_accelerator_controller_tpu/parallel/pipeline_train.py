@@ -47,24 +47,38 @@ def deep_param_specs(stage_axis: str = "stage") -> dict:
 
 
 class ShardedPipelinePlanner(SnapshotPlannerMixin):
-    """pjit-compiled GPipe forward + train step bound to a 1-D mesh.
+    """pjit-compiled GPipe forward + train step.
 
     Requires ``model.n_stages == mesh.shape[stage_axis]`` (one residual
     block per device) and G divisible by ``n_microbatches``.
+
+    ``data_axis`` composes data parallelism with the pipeline (dp x pp
+    over a 2-D mesh, e.g. ``make_hybrid_mesh(dcn_axes=("data",),
+    ici_axes=("stage",))`` — replicas across hosts, the stage ring on
+    ICI): each data shard streams ITS slice of every microbatch through
+    its own stage ring; stage params are replicated across ``data`` and
+    their gradients all-reduce over it via the shard_map transpose —
+    no hand-written cross-replica sync.
     """
 
     def __init__(self, model: DeepTrafficModel, mesh: Mesh,
                  n_microbatches: int = 4, stage_axis: str = "stage",
-                 remat: bool = False):
+                 remat: bool = False, data_axis: "str | None" = None):
         if model.n_stages != mesh.shape[stage_axis]:
             raise ValueError(
                 f"model has {model.n_stages} stages but the "
                 f"'{stage_axis}' mesh axis has {mesh.shape[stage_axis]} "
                 f"devices — pipeline layout is one stage per device")
+        if data_axis is not None and data_axis not in mesh.shape:
+            raise ValueError(
+                f"mesh has no '{data_axis}' axis (axes: "
+                f"{tuple(mesh.shape)})")
         self.model = model
         self.mesh = mesh
         self.n_microbatches = n_microbatches
         self.remat = remat
+        self.data_axis = data_axis
+        n_data = mesh.shape[data_axis] if data_axis else 1
         s = mesh.shape[stage_axis]
         m = n_microbatches
         # remat trades FLOPs for activation memory: the scan's backward
@@ -78,13 +92,22 @@ class ShardedPipelinePlanner(SnapshotPlannerMixin):
         ps = {k: NamedSharding(mesh, spec)
               for k, spec in deep_param_specs(stage_axis).items()}
         rep = NamedSharding(mesh, P())
-        bs = Batch(features=rep, mask=rep, target=rep)
+        # with a data axis, endpoint groups shard over it end-to-end:
+        # batch in HBM, microbatch rows inside the pipe, and the [M, B]
+        # result all carry the same 'data' placement (no resharding)
+        feat_spec = (NamedSharding(mesh, P(data_axis, None, None))
+                     if data_axis else rep)
+        gm_spec = (NamedSharding(mesh, P(data_axis, None))
+                   if data_axis else rep)
+        bs = Batch(features=feat_spec, mask=gm_spec, target=gm_spec)
+        x_spec = P(None, data_axis, None) if data_axis else P()
+        out_spec = P(None, data_axis) if data_axis else P()
 
         @partial(jax.shard_map, mesh=mesh,
                  in_specs=(P(), P(stage_axis, None, None),
                            P(stage_axis, None), P(),
-                           P()),
-                 out_specs=P(),
+                           x_spec),
+                 out_specs=out_spec,
                  check_vma=False)
         def pipe(w_in, stage_w, stage_b, w_out, x):
             # x [M, B, F] microbatched input (replicated); stage_w
@@ -135,11 +158,24 @@ class ShardedPipelinePlanner(SnapshotPlannerMixin):
                 raise ValueError(
                     f"groups ({g}) must be divisible by "
                     f"n_microbatches ({m})")
-            x = features.astype(jnp.float32).reshape(
-                m, (g // m) * e, f)
+            if ((g // m) * e) % n_data:
+                raise ValueError(
+                    f"microbatch rows ({(g // m) * e}) must be "
+                    f"divisible by the '{data_axis}' axis ({n_data})")
+            # interleaved microbatching: group g -> (microbatch g % m,
+            # row g // m).  A data shard's contiguous groups then form
+            # ITS OWN B-slice of EVERY microbatch, so the G-sharded
+            # batch maps onto pipe's P(None, data, None) spec with no
+            # cross-replica movement (contiguous g -> whole-microbatch
+            # assignment would force an all-to-all per step).  Which
+            # groups share a microbatch is schedule-only — results are
+            # bit-identical either way (the M-invariance test).
+            x = (features.astype(jnp.float32)
+                 .reshape(g // m, m, e, f).swapaxes(0, 1)
+                 .reshape(m, (g // m) * e, f))
             out = pipe(params["w_in"], params["stage_w"],
                        params["stage_b"], params["w_out"], x)
-            return out.reshape(g, e)
+            return out.reshape(m, g // m, e).swapaxes(0, 1).reshape(g, e)
 
         def loss_fn(params: Params, batch: Batch):
             return masked_ce_loss(scores(params, batch.features),

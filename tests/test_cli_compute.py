@@ -196,6 +196,25 @@ def test_sharded_deep_rejects_bad_stage_count(capsys):
               "--stages", "3"])
 
 
+def test_sharded_deep_rejects_nonpositive_stages(capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["train", "--model", "deep", "--sharded", "--steps", "1",
+              "--groups", "8", "--endpoints", "4", "--hidden", "16",
+              "--stages", "0"])
+
+
+def test_sharded_deep_dp_pp_composition(capsys):
+    """--stages 4 on 8 devices: the spare factor becomes a data axis
+    (dp x pp) instead of being rejected."""
+    assert main(["train", "--model", "deep", "--sharded", "--steps",
+                 "2", "--groups", "8", "--endpoints", "4", "--hidden",
+                 "16", "--stages", "4", "--microbatches", "2"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["step"] == 2 and out["loss"] is not None
+
+
 def test_train_with_native_loader(capsys):
     """--loader native feeds training from the C++ pipeline (degrades
     to synthetic when no toolchain, so this passes either way)."""
