@@ -160,3 +160,45 @@ def test_membership_diff_matches_set_semantics(desired_ids, current_ids):
     rem = set(c[0][np.asarray(to_remove)[0]].tolist())
     assert add == set(dh) - set(ch)
     assert rem == set(ch) - set(dh)
+
+
+# -- RFC3339 timestamp parser (shared by Lease codec + exec expiry) ---------
+
+
+@_SETTINGS
+@given(st.integers(0, 4102444800),           # epoch secs through 2100
+       st.integers(0, 999_999_999),          # nanoseconds
+       st.sampled_from(["Z", "+00:00", "+02:00", "-05:30"]))
+def test_rfc3339_round_trip_all_forms(secs, nanos, suffix):
+    """Any RFC3339 rendering — Z or offset, 0-9 fractional digits
+    (Go's RFC3339Nano trims trailing zeros) — parses back to the epoch
+    it encodes, to microsecond truncation."""
+    from datetime import datetime, timedelta, timezone
+
+    from aws_global_accelerator_controller_tpu.kube.kubeconfig import (
+        rfc3339_to_epoch,
+    )
+
+    offset = {"Z": 0, "+00:00": 0, "+02:00": 120, "-05:30": -330}[suffix]
+    base = datetime.fromtimestamp(secs, tz=timezone.utc)
+    local = base + timedelta(minutes=offset)
+    frac = f"{nanos:09d}".rstrip("0")
+    text = local.strftime("%Y-%m-%dT%H:%M:%S")
+    if frac:
+        text += "." + frac
+    text += suffix
+    want = secs + (nanos // 1000) / 1e6    # truncated to microseconds
+    got = rfc3339_to_epoch(text)
+    assert got is not None
+    assert abs(got - want) < 1e-6
+
+
+@_SETTINGS
+@given(st.text(max_size=30))
+def test_rfc3339_junk_never_crashes(junk):
+    from aws_global_accelerator_controller_tpu.kube.kubeconfig import (
+        rfc3339_to_epoch,
+    )
+
+    out = rfc3339_to_epoch(junk)
+    assert out is None or isinstance(out, float)
