@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import queue as queue_mod
+import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -110,10 +111,36 @@ class Informer:
             logger.exception("informer handler error (%s)", self.kind)
 
     def _loop(self, stop: threading.Event) -> None:
-        # Subscribe BEFORE listing so no event between list and watch is lost.
-        self._watch_q = self._store.watch()
+        # Subscribe BEFORE listing so no event between list and watch is
+        # lost.  Over the HTTP backend both calls hit the network; an
+        # apiserver that is down AT INFORMER STARTUP must mean retry,
+        # not a dead informer thread (the same failure class the
+        # elector's _attempt guards — see leaderelection/elector.py).
+        listed = None
+        delay = 1.0
+        while not stop.is_set():
+            try:
+                self._watch_q = self._store.watch()
+                try:
+                    listed = self._store.list()
+                except Exception:
+                    self._store.stop_watch(self._watch_q)
+                    self._watch_q = None
+                    raise
+                break
+            except Exception as e:
+                logger.warning(
+                    "informer %s list+watch failed: %s; retrying",
+                    self.kind, e)
+                # exponential backoff with jitter (reflector-style):
+                # each attempt costs the server full LISTs, and a fleet
+                # of informers waking in lockstep the moment it recovers
+                # would re-topple it
+                stop.wait(delay * random.uniform(0.8, 1.2))
+                delay = min(delay * 2, 30.0)
+        if listed is None:      # stopped before ever syncing
+            return
         try:
-            listed = self._store.list()
             with self._cache_lock:
                 for obj in listed:
                     self._cache[obj.key()] = obj
@@ -214,10 +241,17 @@ class SharedInformerFactory:
 
 
 def wait_for_cache_sync(stop: threading.Event, *informers: Informer,
-                        timeout: float = 10.0) -> bool:
-    """cache.WaitForCacheSync analogue."""
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
+                        timeout: Optional[float] = None) -> bool:
+    """cache.WaitForCacheSync analogue.
+
+    Like client-go, the default waits until the caches sync OR stop is
+    set — no deadline: with the informers now retrying list+watch
+    against an unreachable apiserver, a controller must wait out the
+    outage rather than crash at startup.  ``timeout`` bounds the wait
+    for tests."""
+    deadline = (time.monotonic() + timeout
+                if timeout is not None else None)
+    while deadline is None or time.monotonic() < deadline:
         if stop.is_set():
             return False
         if all(i.has_synced() for i in informers):

@@ -44,6 +44,17 @@ from aws_global_accelerator_controller_tpu.kube.rest_server import (
 from harness import wait_until
 
 
+def _free_port() -> int:
+    """Reserve an ephemeral port with nothing listening on it yet."""
+    import socket as socket_mod
+
+    probe = socket_mod.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    return port
+
+
 @pytest.fixture
 def rest():
     server = KubeRestServer().start()
@@ -455,6 +466,87 @@ def test_leader_election_over_http(rest, http_api):
     finally:
         stop.set()
         t.join(timeout=10.0)
+
+
+def test_informer_retries_startup_against_down_apiserver():
+    """An informer started while the apiserver is unreachable must
+    retry list+watch instead of dying — the controller then syncs as
+    soon as the server appears (same failure class as the elector's
+    renew loop)."""
+    import time
+
+    from aws_global_accelerator_controller_tpu.kube.informers import (
+        Informer,
+    )
+
+    port = _free_port()
+
+    api = HTTPAPIServer(RestConfig(server=f"http://127.0.0.1:{port}"))
+    informer = Informer(api.store("Service"), resync_period=30.0)
+    stop = threading.Event()
+    server = None
+    try:
+        informer.run(stop)
+        time.sleep(1.2)            # a failed attempt or two
+        assert not informer.has_synced()
+        assert informer._thread.is_alive(), (
+            "informer thread died instead of retrying")
+
+        server = KubeRestServer(port=port).start()
+        server.api.store("Service").create(_service("late"))
+        wait_until(informer.has_synced, timeout=15.0,
+                   message="informer synced once the apiserver came up")
+        wait_until(
+            lambda: informer.cache_get("default/late") is not None,
+            timeout=10.0, message="late object reached the cache")
+    finally:
+        stop.set()
+        api.close()
+        if server is not None:
+            server.shutdown()
+
+
+def test_manager_started_before_apiserver_converges():
+    """The whole control plane can start BEFORE the apiserver exists
+    (pod scheduling order on a real cluster is arbitrary): controllers
+    block at cache sync while informers retry, then converge normally
+    once the server appears."""
+    import time
+
+    port = _free_port()
+
+    api = HTTPAPIServer(RestConfig(server=f"http://127.0.0.1:{port}"))
+    kube, factory, stop = _start_manager(api)
+    server = None
+    try:
+        time.sleep(1.0)                 # manager blocked at cache sync
+        server = KubeRestServer(port=port).start()
+        region = "ap-northeast-1"
+        hostname = (f"early-0123456789abcdef.elb.{region}"
+                    ".amazonaws.com")
+        factory.cloud.elb.register_load_balancer("early", hostname,
+                                                 region)
+        server.api.store("Service").create(Service(
+            metadata=ObjectMeta(
+                name="early", namespace="default",
+                annotations={
+                    AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                }),
+            spec=ServiceSpec(type="LoadBalancer",
+                             ports=[ServicePort(port=80)]),
+            status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(hostname=hostname)])),
+        ))
+        wait_until(
+            lambda: len(factory.cloud.ga.list_accelerators()) == 1,
+            timeout=30.0,
+            message="manager converged after late apiserver start")
+    finally:
+        stop.set()
+        api.close()
+        if server is not None:
+            server.shutdown()
 
 
 def test_leader_survives_apiserver_restart(rest, http_api):

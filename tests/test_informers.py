@@ -42,7 +42,7 @@ def test_initial_list_fires_adds_and_syncs():
     stop = threading.Event()
     factory.start(stop)
     try:
-        assert wait_for_cache_sync(stop, informer)
+        assert wait_for_cache_sync(stop, informer, timeout=10.0)
         assert sorted(adds) == ["pre1", "pre2"]
         assert len(informer.lister.list()) == 2
     finally:
@@ -64,7 +64,7 @@ def test_watch_events_update_cache_and_handlers():
     stop = threading.Event()
     factory.start(stop)
     try:
-        assert wait_for_cache_sync(stop, informer)
+        assert wait_for_cache_sync(stop, informer, timeout=10.0)
         svc = kube.services.create(make_service("live"))
         assert wait_until(lambda: adds == ["live"])
         svc.metadata.annotations["k"] = "v"
@@ -90,7 +90,7 @@ def test_resync_redelivers_updates():
     stop = threading.Event()
     factory.start(stop)
     try:
-        assert wait_for_cache_sync(stop, informer)
+        assert wait_for_cache_sync(stop, informer, timeout=10.0)
         assert wait_until(lambda: len(updates) >= 2, timeout=3.0), \
             "resync should re-deliver cached objects as updates"
     finally:
