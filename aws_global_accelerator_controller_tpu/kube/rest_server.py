@@ -63,10 +63,15 @@ class _KindState:
 
 
 class KubeRestServer:
-    """ThreadingHTTPServer wrapping a FakeAPIServer with k8s routes."""
+    """ThreadingHTTPServer wrapping a FakeAPIServer with k8s routes.
+
+    ``tls_cert_file``/``tls_key_file`` serve HTTPS — the real
+    apiserver's only mode; clients then need the matching
+    ``RestConfig(ca_file=...)`` (or ``insecure_skip_tls_verify``)."""
 
     def __init__(self, api: Optional[FakeAPIServer] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 tls_cert_file: str = "", tls_key_file: str = ""):
         self.api = api if api is not None else FakeAPIServer()
         self.codecs = default_codecs()
         # route table: (prefix, plural) -> kind
@@ -107,10 +112,36 @@ class KubeRestServer:
             def do_DELETE(self):
                 server.handle(self, "DELETE")
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        if bool(tls_cert_file) != bool(tls_key_file):
+            raise ValueError(
+                "TLS needs both tls_cert_file and tls_key_file")
+
+        class Server(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # bad handshakes / resets from probing clients are
+                # routine; keep them out of stderr
+                logger.debug("rest server connection error from %s",
+                             client_address, exc_info=True)
+
+        self.httpd = Server((host, port), Handler)
         self.httpd.daemon_threads = True
+        scheme = "http"
+        if tls_cert_file:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert_file, tls_key_file)
+            # handshake lazily on first read IN THE HANDLER THREAD:
+            # with the default handshake-on-accept, one client that
+            # opens TCP and never sends a ClientHello parks the single
+            # accept loop and blocks every other connection — including
+            # the watch-stream reconnects this server exists to serve
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
+            scheme = "https"
         self.port = self.httpd.server_address[1]
-        self.url = f"http://{host}:{self.port}"
+        self.url = f"{scheme}://{host}:{self.port}"
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True,
             name="rest-apiserver")

@@ -83,6 +83,46 @@ def _service(name="app", hostname=""):
     )
 
 
+def test_https_backend_crud_and_watch(tls_files):
+    """The real apiserver speaks only HTTPS: CRUD and the streaming
+    watch must work over TLS with CA verification (RestConfig
+    ca_file), and a client that doesn't trust the CA must be
+    rejected."""
+    cert_file, key_file = tls_files
+    server = KubeRestServer(host="localhost",
+                            tls_cert_file=cert_file,
+                            tls_key_file=key_file).start()
+    api = None
+    try:
+        assert server.url.startswith("https://")
+        api = HTTPAPIServer(RestConfig(server=server.url,
+                                       ca_file=cert_file))
+        store = api.store("Service")
+        q = store.watch()
+        store.create(_service("tls1"))
+        assert store.get("default", "tls1").name == "tls1"
+        evt = q.get(timeout=10)
+        assert evt.type == "ADDED" and evt.obj.name == "tls1"
+        store.stop_watch(q)
+
+        # untrusted CA: the TLS handshake itself must fail
+        bad = HTTPAPIServer(RestConfig(server=server.url))
+        with pytest.raises(Exception) as exc_info:
+            bad.store("Service").list()
+        assert "CERTIFICATE_VERIFY_FAILED" in str(exc_info.value)
+        bad.close()
+
+        # explicit opt-out: insecure_skip_tls_verify
+        skip = HTTPAPIServer(RestConfig(server=server.url,
+                                        insecure_skip_tls_verify=True))
+        assert [s.name for s in skip.store("Service").list()] == ["tls1"]
+        skip.close()
+    finally:
+        if api is not None:
+            api.close()
+        server.shutdown()
+
+
 def test_lease_codec_round_trips_microtime(http_api):
     store = http_api.store("Lease")
     lease = Lease(metadata=ObjectMeta(name="lock", namespace="kube-system"),

@@ -1,7 +1,6 @@
 """Webhook TLS path: serve over HTTPS with a generated self-signed cert
 (the reference's production mode, cmd/webhook/webhook.go --ssl default
 true; cert-manager supplies certs in-cluster)."""
-import datetime
 import http.client
 import json
 import ssl
@@ -12,36 +11,6 @@ from aws_global_accelerator_controller_tpu.fixture import endpoint_group_binding
 from aws_global_accelerator_controller_tpu.webhook import WebhookServer
 
 ARN = "arn:aws:globalaccelerator::123456789012:accelerator/x"
-
-
-@pytest.fixture(scope="module")
-def tls_files(tmp_path_factory):
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import rsa
-    from cryptography.x509.oid import NameOID
-
-    tmp = tmp_path_factory.mktemp("tls")
-    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
-    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
-    now = datetime.datetime.now(datetime.timezone.utc)
-    cert = (x509.CertificateBuilder()
-            .subject_name(name).issuer_name(name)
-            .public_key(key.public_key())
-            .serial_number(x509.random_serial_number())
-            .not_valid_before(now)
-            .not_valid_after(now + datetime.timedelta(days=1))
-            .add_extension(x509.SubjectAlternativeName(
-                [x509.DNSName("localhost")]), critical=False)
-            .sign(key, hashes.SHA256()))
-    cert_file = tmp / "tls.crt"
-    key_file = tmp / "tls.key"
-    cert_file.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
-    key_file.write_bytes(key.private_bytes(
-        serialization.Encoding.PEM,
-        serialization.PrivateFormat.TraditionalOpenSSL,
-        serialization.NoEncryption()))
-    return str(cert_file), str(key_file)
 
 
 def test_webhook_over_https(tls_files):
