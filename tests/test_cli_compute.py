@@ -235,6 +235,17 @@ def test_train_temporal_with_native_loader(capsys):
     assert out["step"] == 2 and out["loss"] is not None
 
 
+def test_train_temporal_sharded_with_native_loader(capsys):
+    """All three long-context pieces compose from the CLI: the C++
+    window pipeline feeds the data x seq ring-attention planner."""
+    assert main(["train", "--model", "temporal", "--sharded",
+                 "--loader", "native", "--steps", "2", "--groups", "8",
+                 "--endpoints", "4", "--hidden", "16", "--window",
+                 "8"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["step"] == 2 and out["loss"] is not None
+
+
 def test_native_loader_rejected_for_custom_batch_families(capsys):
     import pytest
 
