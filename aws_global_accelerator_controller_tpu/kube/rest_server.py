@@ -96,6 +96,12 @@ class KubeRestServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # bound every socket op (incl. the deferred TLS handshake
+            # and watch-stream writes): a silent client must not pin a
+            # handler thread forever, and a dead watch consumer whose
+            # TCP buffer fills is reaped when the 1s BOOKMARK writes
+            # start blocking
+            timeout = 30
 
             def log_message(self, fmt, *args):  # quiet the test logs
                 logger.debug("rest: " + fmt, *args)

@@ -24,6 +24,11 @@ logger = logging.getLogger(__name__)
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # bound every socket op (incl. the deferred TLS handshake): a
+    # client that connects and never speaks must not pin a handler
+    # thread + fd forever
+    timeout = 30
+
     def log_message(self, fmt, *args):  # route into logging, not stderr
         logger.debug("webhook: " + fmt, *args)
 
@@ -71,13 +76,25 @@ class WebhookServer:
 
     def __init__(self, port: int = 8443, tls_cert_file: str = "",
                  tls_key_file: str = "", host: str = ""):
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        class _Server(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # bad handshakes / probes are routine on an exposed
+                # HTTPS port; keep them out of stderr
+                logger.debug("webhook connection error from %s",
+                             client_address, exc_info=True)
+
+        self._httpd = _Server((host, port), _Handler)
         self.ssl = bool(tls_cert_file and tls_key_file)
         if self.ssl:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(tls_cert_file, tls_key_file)
-            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
-                                                 server_side=True)
+            # defer the handshake to the handler thread: with
+            # handshake-on-accept a client that opens TCP and never
+            # sends a ClientHello parks the single accept loop, and
+            # the API server's admission calls behind it time out
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -123,6 +123,31 @@ def test_https_backend_crud_and_watch(tls_files):
         server.shutdown()
 
 
+def test_stalled_client_does_not_block_tls_accept_loop(tls_files):
+    """A client that opens TCP and never sends a ClientHello must not
+    park the accept loop: the handshake is deferred to the handler
+    thread, so other clients keep being served."""
+    import socket as socket_mod
+
+    cert_file, key_file = tls_files
+    server = KubeRestServer(host="localhost",
+                            tls_cert_file=cert_file,
+                            tls_key_file=key_file).start()
+    stall = socket_mod.create_connection(("localhost", server.port))
+    api = None
+    try:
+        api = HTTPAPIServer(RestConfig(server=server.url,
+                                       ca_file=cert_file))
+        api.store("Service").create(_service("unblocked"))
+        assert api.store("Service").get("default",
+                                        "unblocked").name == "unblocked"
+    finally:
+        stall.close()
+        if api is not None:
+            api.close()
+        server.shutdown()
+
+
 def test_lease_codec_round_trips_microtime(http_api):
     store = http_api.store("Lease")
     lease = Lease(metadata=ObjectMeta(name="lock", namespace="kube-system"),
