@@ -112,6 +112,20 @@ def build_parser() -> argparse.ArgumentParser:
     ssl_group.add_argument("--no-ssl", dest="ssl", action="store_false",
                            help="Serve plain HTTP.")
 
+    apiserver = sub.add_parser(
+        "apiserver",
+        help="Run the standalone dev apiserver (k8s REST wire protocol "
+             "over the in-memory store) — `controller --real --master "
+             "http://127.0.0.1:PORT` connects to it.")
+    apiserver.add_argument("--port", type=int, default=8001,
+                           help="Listen port (default 8001).")
+    apiserver.add_argument("--host", default="127.0.0.1",
+                           help="Bind address.")
+    apiserver.add_argument("--tls-cert-file", default="",
+                           help="Serve HTTPS with this certificate.")
+    apiserver.add_argument("--tls-private-key-file", default="",
+                           help="x509 private key for --tls-cert-file.")
+
     sub.add_parser("version", help="Print the version number")
     compute.register(sub)
     return parser
@@ -301,6 +315,28 @@ def run_webhook(args) -> int:
     return 0
 
 
+def run_apiserver(args) -> int:
+    """Standalone dev apiserver (rest_server.py's second job): a
+    miniature API server speaking the k8s REST wire protocol for local
+    development without a cluster."""
+    from ..kube.rest_server import KubeRestServer
+
+    if bool(args.tls_cert_file) != bool(args.tls_private_key_file):
+        print("You must set both --tls-cert-file and "
+              "--tls-private-key-file for TLS", file=sys.stderr)
+        return 2
+    server = KubeRestServer(
+        host=args.host, port=args.port,
+        tls_cert_file=args.tls_cert_file,
+        tls_key_file=args.tls_private_key_file).start()
+    logger.info("dev apiserver ready at %s (connect with: controller "
+                "--real --master %s)", server.url, server.url)
+    stop = setup_signal_handler()
+    stop.wait()
+    server.shutdown()
+    return 0
+
+
 def run_version(args) -> int:
     print(f"Version : {VERSION}")
     print(f"Revision: {REVISION}")
@@ -317,6 +353,8 @@ def main(argv=None) -> int:
         return run_controller(args)
     if args.command == "webhook":
         return run_webhook(args)
+    if args.command == "apiserver":
+        return run_apiserver(args)
     if args.command == "version":
         return run_version(args)
     if args.command == "train":

@@ -24,13 +24,14 @@ import logging
 import socket
 import threading
 from collections import deque
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..errors import AdmissionDeniedError, ConflictError, NotFoundError
 from .apiserver import FakeAPIServer
 from .http_store import Codec, default_codecs
+from .tlsutil import enable_tls, make_threading_http_server
 
 logger = logging.getLogger(__name__)
 
@@ -118,34 +119,10 @@ class KubeRestServer:
             def do_DELETE(self):
                 server.handle(self, "DELETE")
 
-        if bool(tls_cert_file) != bool(tls_key_file):
-            raise ValueError(
-                "TLS needs both tls_cert_file and tls_key_file")
-
-        class Server(ThreadingHTTPServer):
-            def handle_error(self, request, client_address):
-                # bad handshakes / resets from probing clients are
-                # routine; keep them out of stderr
-                logger.debug("rest server connection error from %s",
-                             client_address, exc_info=True)
-
-        self.httpd = Server((host, port), Handler)
-        self.httpd.daemon_threads = True
-        scheme = "http"
-        if tls_cert_file:
-            import ssl
-
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(tls_cert_file, tls_key_file)
-            # handshake lazily on first read IN THE HANDLER THREAD:
-            # with the default handshake-on-accept, one client that
-            # opens TCP and never sends a ClientHello parks the single
-            # accept loop and blocks every other connection — including
-            # the watch-stream reconnects this server exists to serve
-            self.httpd.socket = ctx.wrap_socket(
-                self.httpd.socket, server_side=True,
-                do_handshake_on_connect=False)
-            scheme = "https"
+        self.httpd = make_threading_http_server((host, port), Handler,
+                                                logger, "rest server")
+        scheme = ("https" if enable_tls(self.httpd, tls_cert_file,
+                                        tls_key_file) else "http")
         self.port = self.httpd.server_address[1]
         self.url = f"{scheme}://{host}:{self.port}"
         self._serve_thread = threading.Thread(

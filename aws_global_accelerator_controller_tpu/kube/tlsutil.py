@@ -1,0 +1,49 @@
+"""Shared HTTP(S)-server plumbing for the framework's two servers
+(webhook/server.py, kube/rest_server.py) so hardening tweaks land once.
+"""
+from __future__ import annotations
+
+import logging
+from http.server import ThreadingHTTPServer
+
+
+def make_threading_http_server(address, handler_cls,
+                               log: logging.Logger,
+                               label: str) -> ThreadingHTTPServer:
+    """ThreadingHTTPServer with daemon threads and connection errors
+    routed to debug logging — bad handshakes and resets from LB
+    probes / port scans are routine on an exposed port and must not
+    spam stderr with tracebacks."""
+
+    class _Server(ThreadingHTTPServer):
+        def handle_error(self, request, client_address):
+            log.debug("%s connection error from %s", label,
+                      client_address, exc_info=True)
+
+    srv = _Server(address, handler_cls)
+    srv.daemon_threads = True
+    return srv
+
+
+def enable_tls(httpd: ThreadingHTTPServer, cert_file: str,
+               key_file: str) -> bool:
+    """Wrap the listening socket for HTTPS; returns True when enabled.
+
+    The handshake is DEFERRED to the handler thread
+    (``do_handshake_on_connect=False``): with handshake-on-accept, one
+    client that opens TCP and never sends a ClientHello parks the
+    single accept loop and blocks every other connection.  Callers
+    bound the handler-thread handshake with a socket ``timeout`` on
+    their handler class.
+    """
+    if bool(cert_file) != bool(key_file):
+        raise ValueError("TLS needs both a certificate and a key file")
+    if not cert_file:
+        return False
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_file, key_file)
+    httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True,
+                                   do_handshake_on_connect=False)
+    return True

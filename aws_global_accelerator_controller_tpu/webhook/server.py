@@ -13,9 +13,8 @@ from __future__ import annotations
 
 import json
 import logging
-import ssl
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 
 from .validator import validate_endpoint_group_binding
@@ -76,25 +75,13 @@ class WebhookServer:
 
     def __init__(self, port: int = 8443, tls_cert_file: str = "",
                  tls_key_file: str = "", host: str = ""):
-        class _Server(ThreadingHTTPServer):
-            def handle_error(self, request, client_address):
-                # bad handshakes / probes are routine on an exposed
-                # HTTPS port; keep them out of stderr
-                logger.debug("webhook connection error from %s",
-                             client_address, exc_info=True)
+        from ..kube.tlsutil import enable_tls, make_threading_http_server
 
-        self._httpd = _Server((host, port), _Handler)
-        self.ssl = bool(tls_cert_file and tls_key_file)
-        if self.ssl:
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(tls_cert_file, tls_key_file)
-            # defer the handshake to the handler thread: with
-            # handshake-on-accept a client that opens TCP and never
-            # sends a ClientHello parks the single accept loop, and
-            # the API server's admission calls behind it time out
-            self._httpd.socket = ctx.wrap_socket(
-                self._httpd.socket, server_side=True,
-                do_handshake_on_connect=False)
+        self._httpd = make_threading_http_server((host, port), _Handler,
+                                                 logger, "webhook")
+        self.ssl = enable_tls(self._httpd,
+                              tls_cert_file if tls_key_file else "",
+                              tls_key_file if tls_cert_file else "")
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -666,6 +666,54 @@ def test_leader_survives_apiserver_restart(rest, http_api):
             revived.shutdown()
 
 
+def test_cli_apiserver_and_controller_two_process_dev_story():
+    """The documented local-dev loop as two real processes:
+    `apiserver` serves the k8s wire protocol, `controller --real
+    --master <url>` converges its demo fleet against it."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import urllib.request
+
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    apiserver = subprocess.Popen(
+        [sys.executable, "-m", "aws_global_accelerator_controller_tpu",
+         "apiserver", "--port", str(port)],
+        env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    controller = None
+    try:
+        url = f"http://127.0.0.1:{port}"
+        wait_until(
+            lambda: urllib.request.urlopen(
+                f"{url}/api/v1/services", timeout=2).status == 200,
+            timeout=20.0, message="dev apiserver serving")
+        controller = subprocess.Popen(
+            [sys.executable, "-m",
+             "aws_global_accelerator_controller_tpu",
+             "controller", "--real", "--fake-cloud", "--demo",
+             "--master", url, "--smoke", "60", "--health-port", "0"],
+            env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        # communicate drains stdout while waiting: wait() alone can
+        # deadlock once the child fills the ~64KB pipe buffer
+        out, _ = controller.communicate(timeout=90)
+        assert controller.returncode == 0, out[-2000:]
+    finally:
+        if controller is not None and controller.poll() is None:
+            controller.kill()
+        apiserver.send_signal(signal.SIGINT)
+        try:
+            apiserver.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            apiserver.kill()
+
+
 def test_cli_controller_real_mode_against_stub(rest, tmp_path):
     """`controller --real --kubeconfig ...` end-to-end as a real process:
     kubeconfig resolution, HTTP backend, leader election via the Lease
@@ -715,6 +763,6 @@ users:
     finally:
         proc.send_signal(signal.SIGINT)
         try:
-            proc.wait(timeout=15)
-        except subprocess.TimeoutExpired:
+            proc.communicate(timeout=15)   # drain: wait() can deadlock
+        except subprocess.TimeoutExpired:  # on a full pipe buffer
             proc.kill()
