@@ -66,5 +66,18 @@ def test_thousand_service_fleet_converges():
             assert len(cluster.cloud.ga.list_listeners(arn)) == 1
         print(f"\n{N} services converged in {elapsed:.1f}s "
               f"({N / elapsed:.0f}/s)")
+
+        # deletion storm: the full disable->delete chain at fleet
+        # scale (delete-by-ownership-tags discovery per service)
+        start = time.perf_counter()
+        for i in range(N):
+            cluster.kube.services.delete("default", f"svc{i:04d}")
+        wait_until(
+            lambda: len(cluster.cloud.ga.list_accelerators()) == 0,
+            timeout=BUDGET_S, interval=0.25,
+            message=f"{N} accelerators cleaned up")
+        elapsed = time.perf_counter() - start
+        print(f"{N} services cleaned up in {elapsed:.1f}s "
+              f"({N / elapsed:.0f}/s)")
     finally:
         cluster.shutdown()
