@@ -30,6 +30,7 @@ def tls_files(tmp_path_factory):
     (webhook HTTPS, https apiserver backend)."""
     import datetime
 
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
