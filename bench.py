@@ -578,5 +578,32 @@ def main() -> None:
     }))
 
 
+# Named single benches for humans/tooling; bare `python bench.py`
+# stays the driver's full-line contract.  Everything that initialises
+# an accelerator backend goes through the bounded-subprocess wrappers —
+# an in-process run against the wedged tunnel would hang forever
+# (tpu_probe docstring); reconcile is pure CPU control-plane code.
+_NAMED = {
+    "reconcile": bench_reconcile_best,
+    "planner": lambda: _json_bench_subprocess(
+        "bench_planner", "planner bench", 300.0),
+    "flash": bench_flash_subprocess,
+    "flash-long": bench_flash_long_subprocess,
+    "temporal": bench_temporal_subprocess,
+    "autotune": lambda: _json_bench_subprocess(
+        "autotune_flash_blocks", "flash block autotune", 1200.0),
+}
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1:
+        name = sys.argv[1]
+        if name not in _NAMED or len(sys.argv) > 2:
+            # benches take no CLI parameters: silently ignoring extras
+            # would report default-shape numbers as if they were custom
+            print(f"usage: python bench.py [{'|'.join(sorted(_NAMED))}]"
+                  " (no further arguments)", file=sys.stderr)
+            sys.exit(2)
+        print(json.dumps(_NAMED[name]()))
+    else:
+        main()

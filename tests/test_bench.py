@@ -137,6 +137,19 @@ def test_main_contract_dead_backend_still_one_line(monkeypatch, capsys):
     assert ran["planner_calls"] == [{"force_cpu": True}]
 
 
+def test_named_bench_table_complete():
+    """Every public bench is reachable by name; callables take no
+    required args (the CLI invokes them bare)."""
+    import inspect
+
+    for name, fn in bench._NAMED.items():
+        sig = inspect.signature(fn)
+        required = [p for p in sig.parameters.values()
+                    if p.default is inspect.Parameter.empty
+                    and p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD)]
+        assert not required, f"{name} needs args: {required}"
+
+
 @pytest.mark.parametrize("kind,expected", [
     ("TPU v5 lite", 197e12),
     ("TPU v5p chip", 459e12),
