@@ -27,7 +27,7 @@ EndpointGroupBinding controller's weight-sync path and by ``bench.py``.
 
 import os as _os
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 # Build metadata injection (the -ldflags analogue, reference Makefile:18-24):
 # image builds set these env vars instead of link-time symbols.
