@@ -3,6 +3,7 @@ its measurement helpers must not regress silently.  The TPU benches
 themselves are exercised on hardware by the driver; here we pin the
 backend-agnostic pieces (marginal timing, best-of-N, the planner bench
 shape) on CPU."""
+import json
 import os
 import sys
 import time
@@ -161,3 +162,62 @@ def test_tpu_peak_table(kind, expected):
         device_kind = kind
     peak, _ = bench._tpu_peak(D())
     assert peak == expected
+
+
+def test_attach_last_live_decorates_skips(monkeypatch, tmp_path):
+    live = tmp_path / "BENCH_LIVE.json"
+    live.write_text(json.dumps({
+        "measured_at": "2026-07-30T15:00:00Z",
+        "transcript": "transcript_x.log",
+        "results": {"flash": {"fwd_mfu_pct": 42.0},
+                    "temporal": {"skipped": "wedged mid-capture"}},
+    }))
+    monkeypatch.setattr(bench, "_LIVE_PATH", str(live))
+
+    out = bench._attach_last_live({"skipped": "backend wedged"}, "flash")
+    assert out["skipped"] == "backend wedged"
+    assert out["last_live"]["live"] is False
+    assert out["last_live"]["measured_at"] == "2026-07-30T15:00:00Z"
+    assert out["last_live"]["fwd_mfu_pct"] == 42.0
+    assert "transcript_x.log" in out["last_live"]["transcript"]
+
+    # a capture that itself skipped is not evidence
+    out = bench._attach_last_live({"skipped": "wedged"}, "temporal")
+    assert "last_live" not in out
+    # unknown bench name: bare skip unchanged
+    out = bench._attach_last_live({"skipped": "wedged"}, "flash-long")
+    assert "last_live" not in out
+
+
+def test_attach_last_live_passthrough(monkeypatch, tmp_path):
+    # live (non-skip) results pass through untouched
+    live = {"fwd_mfu_pct": 50.0}
+    assert bench._attach_last_live(dict(live), "flash") == live
+    # no capture file: bare skip unchanged, no crash
+    monkeypatch.setattr(bench, "_LIVE_PATH",
+                        str(tmp_path / "missing.json"))
+    out = bench._attach_last_live({"skipped": "wedged"}, "flash")
+    assert out == {"skipped": "wedged"}
+
+
+def test_bench_smoke_skips_off_tpu():
+    out = bench.bench_smoke()
+    assert "skipped" in out and "non-tpu" in out["skipped"]
+
+
+def test_smoke_legs_compile_interpret_mode():
+    """Every smoke leg must at least build + compile on the CPU
+    interpret path -- so an API drift in the kernels or planners breaks
+    here, in the unit suite, not on-chip during a live-capture window
+    (which may be hours away).  Mosaic-only failures remain on-chip
+    territory by design (bench.bench_smoke)."""
+    import jax
+    import jax.numpy as jnp
+
+    legs = bench.smoke_legs(jax, jnp)
+    assert [n for n, _ in legs] == [
+        "fwd_causal", "fwd_full", "fwd_padded", "vjp_causal",
+        "vjp_padded", "stats_causal", "stats_full",
+        "sharded_train_step"]
+    for name, thunk in legs:
+        thunk()  # raises on any build/compile drift
