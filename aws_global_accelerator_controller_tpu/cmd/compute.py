@@ -349,6 +349,11 @@ def _pipeline_planner(args, model):
             f"microbatches={args.microbatches}")
     n_data = n_dev // args.stages
     if n_data > 1:
+        if args.groups % n_data:
+            raise SystemExit(
+                f"--sharded deep with {n_data} data replicas needs "
+                f"--groups divisible by {n_data}; got "
+                f"groups={args.groups}")
         mesh = make_mesh(axis_shapes={"data": n_data,
                                       "stage": args.stages})
         data_axis = "data"

@@ -396,12 +396,25 @@ class _Watcher:
             try:
                 sock = resp.fp.raw._sock  # urllib/http.client internals
                 sock.shutdown(socket.SHUT_RDWR)
-            except Exception as exc:
-                # keep the degradation observable: without the shutdown
-                # the thread lingers in the idle read for up to 300s
-                logger.debug("watch %s: socket shutdown unavailable "
-                             "(%s); thread will exit on idle timeout",
-                             self._codec.kind, exc)
+            except Exception:
+                # the internals moved (CPython version drift): fall
+                # back to the portable fileno() route — fromfd dups the
+                # descriptor but shutdown() acts on the underlying
+                # socket, which is the one the reader is blocked on
+                try:
+                    dup = socket.fromfd(resp.fileno(), socket.AF_INET,
+                                        socket.SOCK_STREAM)
+                    try:
+                        dup.shutdown(socket.SHUT_RDWR)
+                    finally:
+                        dup.close()
+                except Exception as exc:
+                    # keep the degradation visible: without a shutdown
+                    # the thread lingers in the idle read up to 300s
+                    logger.warning(
+                        "watch %s: socket shutdown unavailable (%s); "
+                        "stranded watcher thread will exit on idle "
+                        "timeout", self._codec.kind, exc)
 
     def _run(self) -> None:
         from ..metrics import record_watch_event

@@ -171,6 +171,15 @@ def _run_exec_plugin_inner(spec: dict) -> "tuple[str, float]":
     except ValueError:
         raise KubeConfigError(
             f"exec credential plugin {command!r} printed invalid JSON")
+    # client-go rejects an ExecCredential whose apiVersion differs from
+    # the kubeconfig spec's (exec auth contract); trusting a plugin that
+    # speaks a different auth API version would mask real skew.  An
+    # absent apiVersion is tolerated (unspecified, not different).
+    got_version = cred.get("apiVersion")
+    if got_version is not None and got_version != api_version:
+        raise KubeConfigError(
+            f"exec credential plugin {command!r} returned apiVersion "
+            f"{got_version!r}, kubeconfig expects {api_version!r}")
     status = cred.get("status") or {}
     token = status.get("token")
     if not token:

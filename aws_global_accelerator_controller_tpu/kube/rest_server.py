@@ -121,8 +121,14 @@ class KubeRestServer:
 
         self.httpd = make_threading_http_server((host, port), Handler,
                                                 logger, "rest server")
-        scheme = ("https" if enable_tls(self.httpd, tls_cert_file,
-                                        tls_key_file) else "http")
+        try:
+            tls_on = enable_tls(self.httpd, tls_cert_file, tls_key_file)
+        except Exception:
+            # the listener is already bound: release the port before
+            # surfacing the config error or a retry gets EADDRINUSE
+            self.httpd.server_close()
+            raise
+        scheme = "https" if tls_on else "http"
         self.port = self.httpd.server_address[1]
         self.url = f"{scheme}://{host}:{self.port}"
         self._serve_thread = threading.Thread(

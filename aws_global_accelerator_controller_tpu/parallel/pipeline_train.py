@@ -197,10 +197,30 @@ class ShardedPipelinePlanner(SnapshotPlannerMixin):
         self.param_shardings = ps
         self.batch_shardings = bs
 
-    def shard_batch(self, batch: Batch) -> Batch:
-        g = batch.features.shape[0]
+    def _check_groups(self, g: int) -> None:
+        """Pre-jit divisibility checks: pjit's own in_shardings
+        validation fires before the traced checks and reports an opaque
+        sharding error — say what the constraint is directly."""
         if g % self.n_microbatches:
             raise ValueError(
                 f"groups ({g}) must be divisible by n_microbatches "
                 f"({self.n_microbatches})")
+        n_data = self.mesh.shape[self.data_axis] if self.data_axis else 1
+        if g % n_data:
+            raise ValueError(
+                f"groups ({g}) must be divisible by the "
+                f"'{self.data_axis}' axis ({n_data})")
+
+    def shard_batch(self, batch: Batch) -> Batch:
+        self._check_groups(batch.features.shape[0])
         return SnapshotPlannerMixin.shard_batch(self, batch)
+
+    def forward(self, params, features, mask):
+        self._check_groups(features.shape[0])
+        return SnapshotPlannerMixin.forward(self, params, features,
+                                            mask)
+
+    def train_step(self, params, opt_state, batch: Batch):
+        self._check_groups(batch.features.shape[0])
+        return SnapshotPlannerMixin.train_step(self, params, opt_state,
+                                               batch)

@@ -79,9 +79,17 @@ class WebhookServer:
 
         self._httpd = make_threading_http_server((host, port), _Handler,
                                                  logger, "webhook")
-        self.ssl = enable_tls(self._httpd,
-                              tls_cert_file if tls_key_file else "",
-                              tls_key_file if tls_cert_file else "")
+        # pass the flags through unchanged: half a TLS config (cert
+        # without key or vice versa) is a misconfiguration enable_tls
+        # rejects, not a cue to silently downgrade to plain HTTP
+        try:
+            self.ssl = enable_tls(self._httpd, tls_cert_file,
+                                  tls_key_file)
+        except Exception:
+            # the listener is already bound: release the port before
+            # surfacing the config error or a retry gets EADDRINUSE
+            self._httpd.server_close()
+            raise
         self._thread: Optional[threading.Thread] = None
 
     @property

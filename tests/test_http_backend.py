@@ -766,3 +766,40 @@ users:
             proc.communicate(timeout=15)   # drain: wait() can deadlock
         except subprocess.TimeoutExpired:  # on a full pipe buffer
             proc.kill()
+
+
+def test_watcher_stop_socket_fallback_without_urllib_internals():
+    """stop() reaches into urllib internals (resp.fp.raw._sock) to
+    shut the stream down promptly; if that chain moves across CPython
+    versions it must fall back to the portable fileno() route instead
+    of silently degrading to the 300s idle-read linger (ADVICE r2)."""
+    import socket as socket_mod
+
+    from aws_global_accelerator_controller_tpu.kube.http_store import (
+        _Watcher,
+    )
+
+    a, b = socket_mod.socketpair()
+    try:
+        class FakeResp:  # no .fp — the internals chain AttributeErrors
+            def fileno(self):
+                return a.fileno()
+
+        w = _Watcher.__new__(_Watcher)
+        w._stop = threading.Event()
+        w._resp = FakeResp()
+        w._resp_lock = threading.Lock()
+
+        class FakeCodec:
+            kind = "Test"
+
+        w._codec = FakeCodec()
+        w.stop()
+
+        # the underlying socket was shut down: the peer sees EOF and
+        # a local read returns immediately instead of blocking
+        b.settimeout(2.0)
+        assert b.recv(1) == b""
+    finally:
+        a.close()
+        b.close()

@@ -309,3 +309,27 @@ def test_in_cluster_config(tmp_path, monkeypatch):
     monkeypatch.delenv("KUBERNETES_SERVICE_HOST")
     with pytest.raises(KubeConfigError, match="in-cluster"):
         in_cluster_config()
+
+
+def test_exec_plugin_api_version_mismatch_rejected(tmp_path):
+    """A plugin answering with a different auth API version than the
+    kubeconfig spec declares is rejected, matching client-go; an absent
+    apiVersion stays tolerated (unspecified, not different)."""
+    wrong = _exec_plugin(tmp_path, """
+        import json
+        print(json.dumps({"kind": "ExecCredential",
+                          "apiVersion": "client.authentication.k8s.io/v1",
+                          "status": {"token": "t"}}))
+    """)
+    with pytest.raises(KubeConfigError, match="apiVersion"):
+        RestConfig(server="https://x", exec_spec=wrong).bearer_token()
+
+    matching = _exec_plugin(tmp_path, """
+        import json
+        print(json.dumps({"kind": "ExecCredential",
+                          "apiVersion":
+                              "client.authentication.k8s.io/v1beta1",
+                          "status": {"token": "ok"}}))
+    """)
+    cfg = RestConfig(server="https://x", exec_spec=matching)
+    assert cfg.bearer_token() == "ok"

@@ -203,3 +203,18 @@ def test_remat_training_identical_trajectory(mesh):
     for k in p1:
         np.testing.assert_array_equal(np.asarray(p1[k]),
                                       np.asarray(p2[k]), err_msg=k)
+
+
+def test_dp_pp_rejects_groups_not_divisible_by_data_axis(mesh2d):
+    """g=9, m=3 passes the microbatch checks (9%3==0, (3*8)%2==0) but
+    cannot shard 9 groups over 2 data replicas — the planner must say
+    so directly instead of failing later inside device_put with an
+    opaque sharding error (ADVICE r2)."""
+    model, params, _ = _setup(n_stages=mesh2d.shape["stage"])
+    bad = synthetic_batch(jax.random.PRNGKey(7), groups=9, endpoints=8)
+    planner = ShardedPipelinePlanner(model, mesh2d, n_microbatches=3,
+                                     data_axis="data")
+    sp = planner.shard_params(params)
+    with pytest.raises(ValueError,
+                       match=r"groups \(9\) must be divisible"):
+        planner.forward(sp, bad.features, bad.mask)

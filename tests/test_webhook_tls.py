@@ -46,3 +46,13 @@ def test_webhook_over_https(tls_files):
         assert "immutable" in review["response"]["status"]["message"]
     finally:
         server.shutdown()
+
+
+def test_webhook_rejects_half_tls_config():
+    """Cert without key (or vice versa) is a misconfiguration, not a cue
+    to silently downgrade to plain HTTP (ADVICE r2): the flags reach
+    enable_tls unchanged and its ValueError fires."""
+    with pytest.raises(ValueError, match="both a certificate and a key"):
+        WebhookServer(port=0, tls_cert_file="/tmp/only-cert.pem")
+    with pytest.raises(ValueError, match="both a certificate and a key"):
+        WebhookServer(port=0, tls_key_file="/tmp/only-key.pem")
