@@ -17,5 +17,9 @@ bench:
 graft-dryrun:
 	python __graft_entry__.py
 
+# hack/lint.py is a stdlib ast-based pyflakes-class linter (no linter
+# package is installable in the build environment); compileall stays as
+# the pure syntax gate for files lint.py does not cover
 lint:
 	python -m compileall -q aws_global_accelerator_controller_tpu tests
+	python hack/lint.py

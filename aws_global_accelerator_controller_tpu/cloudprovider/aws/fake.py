@@ -33,7 +33,6 @@ from ...errors import (
 from .api import AWSAPIs, ELBv2API, GlobalAcceleratorAPI, Route53API
 from .types import (
     Accelerator,
-    AliasTarget,
     EndpointDescription,
     EndpointGroup,
     HostedZone,
@@ -41,7 +40,6 @@ from .types import (
     Listener,
     LoadBalancer,
     PortRange,
-    ResourceRecord,
     ResourceRecordSet,
     STATUS_DEPLOYED,
     STATUS_IN_PROGRESS,

@@ -78,7 +78,7 @@ def main() -> int:
     any_live = False
     with transcript.open("w") as log:
         log.write(f"# live TPU bench capture started {_utc()}\n")
-        log.write(f"# host cmd: python bench.py <name> (see bench.py)\n")
+        log.write("# host cmd: python bench.py <name> (see bench.py)\n")
         for name, budget in BENCHES:
             start = _utc()
             log.write(f"\n===== bench.py {name} (start {start}, "

@@ -71,7 +71,7 @@ def test_spec_update_bumps_generation_status_does_not():
 def test_finalizer_gated_delete():
     api = FakeAPIServer()
     op = OperatorClient(api)
-    egb = op.endpoint_group_bindings.create(EndpointGroupBinding(
+    op.endpoint_group_bindings.create(EndpointGroupBinding(
         metadata=ObjectMeta(name="b", finalizers=["op/f"]),
         spec=EndpointGroupBindingSpec(endpoint_group_arn="arn:x")))
     op.endpoint_group_bindings.delete("default", "b")

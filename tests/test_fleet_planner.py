@@ -1,6 +1,4 @@
 """Fleet planner tests: sharded batch diff + weight planning over the mesh."""
-import numpy as np
-
 from aws_global_accelerator_controller_tpu.parallel.fleet import FleetPlanner
 from aws_global_accelerator_controller_tpu.parallel.mesh import make_mesh
 

@@ -5,8 +5,6 @@ pkg/reconcile/reconcile.go:70-89 against a real queue -- the reference has
 no such tests (SURVEY.md §4 notes the gap); SURVEY.md §7 step 2 calls for
 them.
 """
-import time
-
 from aws_global_accelerator_controller_tpu.errors import (
     NotFoundError,
     new_no_retry_errorf,

@@ -6,7 +6,6 @@ trajectory (up to float tolerance), or the multi-chip path silently
 trains a different function than the single-chip one.
 """
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh
