@@ -84,6 +84,14 @@ def build_parser() -> argparse.ArgumentParser:
                                  "per-endpoint weights for bindings "
                                  "with spec.weight: null "
                                  "(controller/weightpolicy.py).")
+    controller.add_argument("--policy-checkpoint", default="",
+                            metavar="DIR",
+                            help="Orbax checkpoint directory (the "
+                                 "train CLI's --ckpt output): the "
+                                 "model weight policy plans with the "
+                                 "trained params instead of the "
+                                 "seed-0 init.  Requires "
+                                 "--weight-policy model.")
     controller.add_argument("--seed", action="append", default=[],
                             metavar="FILE",
                             help="Apply YAML manifests into the fake API "
@@ -132,6 +140,22 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def run_controller(args) -> int:
+    policy_instance = None
+    if getattr(args, "policy_checkpoint", ""):
+        if getattr(args, "weight_policy", "static") != "model":
+            raise SystemExit(
+                "--policy-checkpoint requires --weight-policy model "
+                "(static ignores model params)")
+        # load EAGERLY: a bad checkpoint must abort startup here, not
+        # crash the leader-run thread after election (where the process
+        # would keep serving health checks while reconciling nothing)
+        from ..controller.weightpolicy import ModelWeightPolicy
+
+        try:
+            policy_instance = ModelWeightPolicy.from_checkpoint(
+                args.policy_checkpoint)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"--policy-checkpoint: {e}")
     stop = setup_signal_handler()
 
     if args.fake:
@@ -165,7 +189,8 @@ def run_controller(args) -> int:
             workers=args.workers, cluster_name=args.cluster_name),
         endpoint_group_binding=EndpointGroupBindingConfig(
             workers=args.workers,
-            weight_policy=getattr(args, "weight_policy", "static")),
+            weight_policy=getattr(args, "weight_policy", "static"),
+            weight_policy_instance=policy_instance),
     )
 
     namespace = os.environ.get("POD_NAMESPACE", "default")
@@ -212,6 +237,10 @@ def run_controller(args) -> int:
                                 namespace, kube)
             le.run(stop, on_started_leading=run_manager,
                    on_stopped_leading=lambda: os._exit(0))
+            if le.run_failed:
+                # the manager crashed while leading (elector already
+                # logged the traceback and released the lease)
+                return 1
         else:
             run_manager(stop)
     finally:

@@ -54,6 +54,13 @@ class EndpointGroupBindingConfig:
     # "static" = reference parity (spec.weight everywhere); "model" =
     # TPU-planned weights for spec.weight: null bindings (weightpolicy.py)
     weight_policy: str = "static"
+    # orbax checkpoint dir (the train CLI's --ckpt output): restores
+    # trained params into the model policy; "" = seed-0 init
+    policy_checkpoint: str = ""
+    # a pre-constructed policy object wins over both fields above — the
+    # CLI loads the checkpoint eagerly (fail-fast before election) and
+    # hands the instance through here
+    weight_policy_instance: object = None
 
 
 class EndpointGroupBindingController:
@@ -68,7 +75,11 @@ class EndpointGroupBindingController:
         self.kube_client = kube_client
         self.client = operator_client
         self.cloud_factory = cloud_factory
-        self.weight_policy = make_weight_policy(config.weight_policy)
+        self.weight_policy = (
+            config.weight_policy_instance
+            if config.weight_policy_instance is not None
+            else make_weight_policy(config.weight_policy,
+                                    config.policy_checkpoint))
         self.recorder = kube_client.event_recorder(CONTROLLER_AGENT_NAME)
 
         self.queue = new_rate_limiting_queue(

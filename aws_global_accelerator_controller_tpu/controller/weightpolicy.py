@@ -19,11 +19,14 @@ preserving reference semantics exactly.
 """
 from __future__ import annotations
 
+import logging
 import zlib
 from typing import Dict, List, Optional
 
 from ..apis.endpointgroupbinding.v1alpha1 import EndpointGroupBinding
 from ..cloudprovider.aws.types import EndpointGroup
+
+logger = logging.getLogger(__name__)
 
 FEATURE_DIM = 8
 
@@ -67,6 +70,74 @@ class ModelWeightPolicy:
         self._fwd = jax.jit(self.model.forward_dense)
         self._static = StaticWeightPolicy()
 
+    @classmethod
+    def from_checkpoint(cls, directory: str,
+                        hidden_dim: "int | None" = None
+                        ) -> "ModelWeightPolicy":
+        """Policy with params restored from a ``train`` CLI orbax
+        checkpoint — the bridge that lets trained weights reach the
+        control plane (without it the controller can only ever plan
+        with the deterministic seed-0 initialisation).
+
+        Fails loudly: a configured checkpoint that cannot load must
+        not silently degrade to untrained params, so a missing
+        directory raises FileNotFoundError and a config mismatch
+        (different hidden_dim than the checkpoint was trained with)
+        raises ValueError naming both configs.
+        """
+        # same CPU pinning rationale as __init__
+        from ..jaxenv import import_jax_cpu
+
+        jax = import_jax_cpu()
+
+        from ..models.checkpoint import TrainCheckpointer
+        from ..models.traffic import TrafficPolicyModel
+
+        kw = {"feature_dim": FEATURE_DIM}
+        if hidden_dim is not None:
+            kw["hidden_dim"] = hidden_dim
+        import os
+
+        model = TrafficPolicyModel(**kw)
+        if not os.path.isdir(directory):
+            # checked before the orbax manager opens so a typo'd path
+            # reports cleanly instead of littering an empty tree
+            raise FileNotFoundError(
+                f"no checkpoint found under {directory}")
+        with TrainCheckpointer(directory, create=False) as ckpt:
+            try:
+                step, params, _ = ckpt.restore(model)
+            except FileNotFoundError:
+                raise
+            except Exception as exc:
+                # corrupt artifact, permissions, orbax format drift —
+                # NOT necessarily a config mismatch, so no --hidden
+                # advice here (the shape check below owns that case)
+                raise ValueError(
+                    f"policy checkpoint at {directory!r} failed to "
+                    f"restore: {exc}") from exc
+        # orbax restores whatever shapes were saved even when the
+        # template disagrees (it only warns) — a wrong-width
+        # checkpoint must not silently drive production weights
+        template = jax.eval_shape(
+            lambda: model.init_params(jax.random.PRNGKey(0)))
+        for key, ref in template.items():
+            got = params.get(key)
+            if got is None or tuple(got.shape) != tuple(ref.shape):
+                raise ValueError(
+                    f"policy checkpoint at {directory!r} does not "
+                    f"match the policy model config (feature_dim="
+                    f"{model.feature_dim}, hidden_dim="
+                    f"{model.hidden_dim}): param {key!r} has shape "
+                    f"{None if got is None else tuple(got.shape)}, "
+                    f"model expects {tuple(ref.shape)}; train with "
+                    f"matching --hidden")
+        logger.info("model weight policy restored from %s at step %d",
+                    directory, step)
+        policy = cls(model=model, params=params)
+        policy.restored_step = step
+        return policy
+
     def plan(self, binding: EndpointGroupBinding,
              endpoint_group: EndpointGroup,
              endpoint_ids: List[str]) -> Dict[str, Optional[int]]:
@@ -105,10 +176,19 @@ class ModelWeightPolicy:
         return f
 
 
-def make_weight_policy(kind: str):
-    """"static" (reference parity, default) or "model"."""
+def make_weight_policy(kind: str, checkpoint_dir: str = ""):
+    """"static" (reference parity, default) or "model";
+    ``checkpoint_dir`` restores trained params into the model policy
+    (meaningless with static, so that combination is rejected rather
+    than ignored)."""
     if kind == "static":
+        if checkpoint_dir:
+            raise ValueError(
+                "a policy checkpoint requires the 'model' weight "
+                "policy (static ignores model params)")
         return StaticWeightPolicy()
     if kind == "model":
+        if checkpoint_dir:
+            return ModelWeightPolicy.from_checkpoint(checkpoint_dir)
         return ModelWeightPolicy()
     raise ValueError(f"unknown weight policy {kind!r}")

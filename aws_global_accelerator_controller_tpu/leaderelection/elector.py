@@ -36,6 +36,9 @@ class LeaderElection:
         self.retry_period = retry_period
         self.identity = identity or str(uuid.uuid4())
         self.is_leader = threading.Event()
+        # set when the on_started_leading callback raised: the process
+        # should exit non-zero instead of reporting a clean shutdown
+        self.run_failed = False
         self._observed_holder = ""
 
     # -- lock primitives ------------------------------------------------
@@ -131,9 +134,23 @@ class LeaderElection:
         self.is_leader.set()
         leader_stop = threading.Event()
 
+        def _run_leading():
+            # a crashed run callback must take the process down, not
+            # leave it leading (holding the lease, serving health
+            # checks) while reconciling nothing — the silent-zombie
+            # mode controller-runtime also refuses
+            try:
+                on_started_leading(leader_stop)
+            except BaseException:
+                logger.error(
+                    "leader run callback crashed; stopping process",
+                    exc_info=True)
+                self.run_failed = True
+                leader_stop.set()
+                stop.set()
+
         runner = threading.Thread(
-            target=on_started_leading, args=(leader_stop,), daemon=True,
-            name="leader-run")
+            target=_run_leading, daemon=True, name="leader-run")
         runner.start()
 
         last_renew = time.monotonic()

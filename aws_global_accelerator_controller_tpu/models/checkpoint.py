@@ -30,14 +30,19 @@ class TrainCheckpointer:
     steps (oldest garbage-collected, like the manager's default policy).
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 create: bool = True):
+        """``create=False`` opens for restore-only: no mkdir side
+        effects (a typo'd --policy-checkpoint path must not litter an
+        empty orbax tree, and a read-only parent must not crash on
+        mkdir instead of reporting 'no checkpoint')."""
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self._mngr = ocp.CheckpointManager(
             os.path.abspath(directory),
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True),
+                max_to_keep=max_to_keep, create=create),
         )
 
     def save(self, step: int, params: Params, opt_state: Any,

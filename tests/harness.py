@@ -37,7 +37,8 @@ class Cluster:
 
     def __init__(self, workers: int = 1, resync_period: float = 30.0,
                  settle_seconds: float = 0.0, queue_qps: float = 10.0,
-                 queue_burst: int = 100, weight_policy: str = "static"):
+                 queue_burst: int = 100, weight_policy: str = "static",
+                 policy_checkpoint: str = ""):
         self.api = FakeAPIServer()
         self.kube = KubeClient(self.api)
         self.operator = OperatorClient(self.api)
@@ -54,7 +55,8 @@ class Cluster:
                                   queue_burst=queue_burst),
             endpoint_group_binding=EndpointGroupBindingConfig(
                 workers=workers, queue_qps=queue_qps,
-                queue_burst=queue_burst, weight_policy=weight_policy),
+                queue_burst=queue_burst, weight_policy=weight_policy,
+                policy_checkpoint=policy_checkpoint),
         )
 
     def start(self):

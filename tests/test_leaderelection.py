@@ -91,3 +91,33 @@ def test_expired_lease_is_taken_over():
         "candidate must take over an expired lease"
     stop2.set()
     t2.join(timeout=3)
+
+
+def test_crashing_run_callback_stops_the_process_loudly():
+    """A manager that raises while leading must not leave the replica
+    holding the lease and serving health checks while reconciling
+    nothing: the elector marks run_failed, sets the process stop event
+    (so the CLI exits non-zero) and releases the lease so a standby
+    can take over."""
+    kube = KubeClient(FakeAPIServer())
+    le = LeaderElection("test-lock", "default", kube, identity="a",
+                        lease_duration=0.5, renew_deadline=0.3,
+                        retry_period=0.05)
+    stop = threading.Event()
+
+    def boom(leader_stop):
+        raise RuntimeError("manager died on startup")
+
+    t = threading.Thread(target=le.run, args=(stop, boom), daemon=True)
+    t.start()
+    assert wait_until(lambda: stop.is_set()), (
+        "crash did not propagate to the process stop event")
+    assert le.run_failed
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    # lease released on the way out: a second candidate acquires fast
+    started = []
+    le2, stop2, t2 = make_candidate(kube, "b", started)
+    assert wait_until(lambda: started == ["b"])
+    stop2.set()
+    t2.join(timeout=5.0)
