@@ -34,6 +34,18 @@ def register(sub) -> None:
     train.add_argument("--experts", type=int, default=4,
                        help="Expert count (moe model); with --sharded "
                             "must equal the expert mesh axis size.")
+    train.add_argument("--top-k", type=int, default=1, dest="top_k",
+                       help="Experts per group (moe): 1 = switch "
+                            "routing, 2 = GShard-style top-2 (gate-"
+                            "probability-weighted sum).")
+    train.add_argument("--capacity-factor", type=float, default=None,
+                       dest="capacity_factor",
+                       help="Per-expert assignment budget multiplier "
+                            "(moe): assignments past "
+                            "ceil(cf*groups*k/experts) per dispatch "
+                            "block are dropped (standard MoE "
+                            "load-imbalance regime).  Default: "
+                            "unbounded.")
     train.add_argument("--stages", type=int, default=4,
                        help="Residual stage count (deep model); with "
                             "--sharded must equal the device count.")
@@ -105,6 +117,15 @@ def register(sub) -> None:
     plan.add_argument("--experts", type=int, default=4,
                       help="Expert count (moe model; must match the "
                            "ckpt).")
+    plan.add_argument("--top-k", type=int, default=1, dest="top_k",
+                      help="Experts per group (moe; must match the "
+                           "ckpt's training config or the planned "
+                           "weights come from a different routing "
+                           "function).")
+    plan.add_argument("--capacity-factor", type=float, default=None,
+                      dest="capacity_factor",
+                      help="Per-expert assignment budget (moe; must "
+                           "match the ckpt's training config).")
     plan.add_argument("--stages", type=int, default=4,
                       help="Residual stage count (deep model; must "
                            "match the ckpt).")
@@ -199,9 +220,19 @@ def _build_model(args):
     elif args.model == "moe":
         from ..models.moe import MoETrafficModel, synthetic_moe_batch
 
+        cf = getattr(args, "capacity_factor", None)
+        blocks = 1
+        if cf is not None and sharded:
+            # capacity is enforced per dispatch block: the model's
+            # block granularity must match the batch shard count
+            # (ShardedMoEPlanner validates the same law)
+            blocks = len(jax.devices())
         model = MoETrafficModel(n_experts=args.experts,
                                 hidden_dim=args.hidden,
-                                learning_rate=lr)
+                                learning_rate=lr,
+                                top_k=getattr(args, "top_k", 1),
+                                capacity_factor=cf,
+                                capacity_blocks=blocks)
         run_step, run_plan_fwd = _snapshot_runners(
             jax, model,
             lambda key: synthetic_moe_batch(

@@ -44,16 +44,54 @@ FEATURE_DIM = 8
 HIDDEN_DIM = 64
 
 
+def expert_capacity(block_groups: int, top_k: int, n_experts: int,
+                    capacity_factor: "float | None") -> int:
+    """Per-(block, expert) assignment budget, the GShard/Switch formula:
+    ``ceil(capacity_factor * block_groups * top_k / n_experts)``.
+    ``None`` means unbounded (every assignment kept — the pre-capacity
+    behavior, and the only sane default for a weight planner where
+    "dropping" a group means leaving its weights unplanned)."""
+    if capacity_factor is None:
+        return block_groups * top_k
+    import math
+
+    return max(1, math.ceil(
+        capacity_factor * block_groups * top_k / n_experts))
+
+
 class MoETrafficModel(TrainableModel):
     def __init__(self, n_experts: int = N_EXPERTS,
                  feature_dim: int = FEATURE_DIM,
                  hidden_dim: int = HIDDEN_DIM,
                  learning_rate: float = 1e-3,
-                 aux_weight: float = 1e-2):
+                 aux_weight: float = 1e-2,
+                 top_k: int = 1,
+                 capacity_factor: "float | None" = None,
+                 capacity_blocks: int = 1):
+        """``top_k`` routes each group to its best k experts (gate-
+        probability-weighted sum of their outputs); ``capacity_factor``
+        bounds per-expert load — assignments past the budget are
+        DROPPED (contribute zero, gradient included), the standard
+        load-imbalance regime of large-scale MoE.  ``capacity_blocks``
+        partitions the G groups into contiguous blocks with the budget
+        enforced per block: block = dispatch granularity, so a sharded
+        planner over ``capacity_blocks`` batch shards computes the
+        bit-identical function (see ShardedMoEPlanner)."""
+        if not 1 <= top_k <= n_experts:
+            raise ValueError(
+                f"top_k ({top_k}) must be in [1, n_experts="
+                f"{n_experts}]")
+        if capacity_factor is not None and capacity_factor <= 0:
+            raise ValueError(
+                f"capacity_factor ({capacity_factor}) must be > 0 "
+                f"(use None for unbounded)")
         self.n_experts = n_experts
         self.feature_dim = feature_dim
         self.hidden_dim = hidden_dim
         self.aux_weight = aux_weight
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.capacity_blocks = capacity_blocks
         self.optimizer = optax.adam(learning_rate)
 
     def init_params(self, key: jax.Array) -> Params:
@@ -85,6 +123,48 @@ class MoETrafficModel(TrainableModel):
         probs = jax.nn.softmax(logits, axis=-1)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), probs
 
+    def gate_topk(self, params: Params, features: jax.Array,
+                  mask: jax.Array) -> Tuple[jax.Array, jax.Array,
+                                            jax.Array]:
+        """(routes [G, K] int32 best-first, gate_p [G, K] f32 — the
+        softmax probabilities of the selected experts, NOT renormalised
+        so K=1 reproduces the switch estimator exactly — and the full
+        probs [G, n]).  ``lax.top_k`` breaks ties first-index like the
+        argmax in ``gate``, so routes[:, 0] == gate()'s route."""
+        _, probs = self.gate(params, features, mask)
+        gate_p, routes = jax.lax.top_k(probs, self.top_k)
+        return routes.astype(jnp.int32), gate_p, probs
+
+    def keep_mask(self, routes: jax.Array) -> jax.Array:
+        """bool [G, K]: which routed assignments fit the capacity
+        budget.  Priority is k-major within each capacity block (every
+        group's primary choice beats any group's secondary, ties by
+        group order) — the Switch top-2 convention where second
+        choices drop first.  All-True when capacity_factor is None."""
+        g, k = routes.shape
+        nb = self.capacity_blocks
+        if g % nb:
+            raise ValueError(
+                f"groups ({g}) must be divisible by capacity_blocks "
+                f"({nb})")
+        bs = g // nb
+        # top_k routes are DISTINCT experts per group, so per-expert
+        # load within a block can never exceed bs — cap beyond that is
+        # equivalent to unbounded
+        cap = min(expert_capacity(bs, k, self.n_experts,
+                                  self.capacity_factor), bs)
+        if cap >= bs:
+            return jnp.ones((g, k), bool)
+        # [nb, bs, K] -> k-major flat order per block [nb, K*bs]
+        r = (routes.reshape(nb, bs, k).transpose(0, 2, 1)
+             .reshape(nb, k * bs))
+        onehot = jax.nn.one_hot(r, self.n_experts, dtype=jnp.int32)
+        pos = jnp.cumsum(onehot, axis=1) - onehot
+        mypos = jnp.take_along_axis(pos, r[..., None], axis=2)[..., 0]
+        keep = mypos < cap
+        return (keep.reshape(nb, k, bs).transpose(0, 2, 1)
+                .reshape(g, k))
+
     # -- forward --------------------------------------------------------
 
     def expert_scores(self, params: Params, features: jax.Array,
@@ -104,16 +184,23 @@ class MoETrafficModel(TrainableModel):
     def scored(self, params: Params, features: jax.Array,
                mask: jax.Array) -> Tuple[jax.Array, jax.Array,
                                          jax.Array]:
-        """The one switch-estimator implementation: (scores [G, E] f32,
-        route [G], probs [G, n]).  Scores are the routed expert's output
-        scaled by the selected gate probability — that product is the
-        gate's gradient path.  ``loss`` reuses route/probs for the aux
-        term; ``parallel.moe`` swaps ``expert_scores`` for the
-        all_to_all dispatch but keeps this same composition."""
-        route, probs = self.gate(params, features, mask)
-        s = self.expert_scores(params, features, route)
-        p_sel = jnp.take_along_axis(probs, route[:, None], axis=1)
-        return s * p_sel, route, probs
+        """The one top-k estimator implementation: (scores [G, E] f32,
+        route [G] — the primary choice, probs [G, n]).  Scores are the
+        gate-probability-weighted sum of the kept routed experts'
+        outputs (K=1, unbounded capacity = the switch estimator
+        exactly); a dropped assignment contributes zero, so its
+        gradient path vanishes too — tokens degrade, they don't
+        corrupt.  ``loss`` reuses route/probs for the aux term;
+        ``parallel.moe`` swaps ``expert_scores`` for the all_to_all
+        dispatch but keeps this same composition."""
+        routes, gate_p, probs = self.gate_topk(params, features, mask)
+        keep = self.keep_mask(routes)
+        s = jnp.zeros(features.shape[:2], jnp.float32)
+        for k in range(self.top_k):  # K is tiny and static: unrolled
+            sk = self.expert_scores(params, features, routes[:, k])
+            s = s + jnp.where(keep[:, k, None],
+                              sk * gate_p[:, k, None], 0.0)
+        return s, routes[:, 0], probs
 
     def scores(self, params: Params, features: jax.Array,
                mask: jax.Array) -> jax.Array:
@@ -124,6 +211,22 @@ class MoETrafficModel(TrainableModel):
                 mask: jax.Array) -> jax.Array:
         """[G, E, F] + mask -> int32 GA weights [G, E]."""
         return plan_weights(self.scores(params, features, mask), mask)
+
+    def dispatch_stats(self, params: Params, features: jax.Array,
+                       mask: jax.Array) -> Dict[str, jax.Array]:
+        """Dropped-assignment accounting (observability for the
+        capacity regime): kept fraction, dropped count, and per-expert
+        primary-route load fractions."""
+        routes, _, _ = self.gate_topk(params, features, mask)
+        keep = self.keep_mask(routes)
+        load = jnp.mean(
+            jax.nn.one_hot(routes[:, 0], self.n_experts,
+                           dtype=jnp.float32), axis=0)
+        return {
+            "kept_fraction": jnp.mean(keep.astype(jnp.float32)),
+            "dropped": jnp.sum(~keep),
+            "expert_load": load,
+        }
 
     # -- training -------------------------------------------------------
 

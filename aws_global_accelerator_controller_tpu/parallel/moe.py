@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.common import masked_ce_loss
-from ..models.moe import MoETrafficModel, Params
+from ..models.moe import MoETrafficModel, Params, expert_capacity
 from ..models.traffic import Batch
 from ..ops.weights import plan_weights
 from .base import SnapshotPlannerMixin
@@ -87,6 +87,20 @@ class ShardedMoEPlanner(SnapshotPlannerMixin):
         data_axes = ((data_axis,) if isinstance(data_axis, str)
                      else tuple(data_axis))
         both = data_axes + (expert_axis,)
+        n_total = self._n_total = 1
+        for axis in both:
+            n_total = self._n_total = n_total * mesh.shape[axis]
+        if (model.capacity_factor is not None
+                and model.capacity_blocks != n_total):
+            # capacity is enforced per dispatch block; the dense oracle
+            # only computes the same function when its blocks match the
+            # batch-shard granularity
+            raise ValueError(
+                f"capacity_factor needs model.capacity_blocks "
+                f"({model.capacity_blocks}) == the batch shard count "
+                f"({n_total}) so the sharded dispatch and the dense "
+                f"model drop the same assignments")
+        top_k = model.top_k
         ps = {k: NamedSharding(mesh, s)
               for k, s in moe_param_specs(expert_axis).items()}
         bs = Batch(features=NamedSharding(mesh, P(both, None, None)),
@@ -100,20 +114,40 @@ class ShardedMoEPlanner(SnapshotPlannerMixin):
                            P(expert_axis, None, None),
                            P(expert_axis, None),
                            P(both, None, None),
-                           P(both)),
-                 out_specs=P(both, None),
+                           P(both, None)),
+                 out_specs=P(None, both, None),
                  check_vma=False)
-        def dispatch(w1, b1, w2, b2, x_local, route_local):
+        def dispatch(w1, b1, w2, b2, x_local, routes_local):
             # w1 [1, F, H], b1 [1, H], w2 [1, H, 1], b2 [1, 1]: this
-            # device's expert.  x_local [G_l, E, F], route_local [G_l].
+            # device's expert.  x_local [G_l, E, F], routes_local
+            # [G_l, K] best-first.  Returns per-slot expert outputs
+            # [K, G_l, E] with capacity-dropped slots exactly zero —
+            # the zero IS the degradation semantics (and its gradient).
             g_l, e_dim, f_dim = x_local.shape
-            cap = g_l  # worst case: every local group -> one expert
+            # per-expert load is bounded by g_l (top_k routes are
+            # distinct experts per group), so clamp the buffers there —
+            # an unbounded top-2 budget must not double ICI traffic
+            cap = min(expert_capacity(g_l, top_k, n,
+                                      model.capacity_factor), g_l)
 
-            onehot = jax.nn.one_hot(route_local, n, dtype=jnp.int32)
-            slot = jnp.cumsum(onehot, axis=0)[
-                jnp.arange(g_l), route_local] - 1          # [G_l]
-            send = jnp.zeros((n, cap, e_dim, f_dim), x_local.dtype)
-            send = send.at[route_local, slot].set(x_local)
+            # k-major flat priority (primary choices beat secondary
+            # ones, ties by group order) — must match the dense
+            # model's keep_mask ordering exactly
+            rf = routes_local.transpose(1, 0).reshape(top_k * g_l)
+            onehot = jax.nn.one_hot(rf, n, dtype=jnp.int32)
+            pos = jnp.cumsum(onehot, axis=0) - onehot
+            mypos = pos[jnp.arange(top_k * g_l), rf]       # [K*G_l]
+            keep = mypos < cap
+            # overflow writes land in a dump row sliced off before the
+            # collective; overflow reads hit the zero row appended to
+            # the return buffer
+            slot = jnp.where(keep, mypos, cap)
+
+            x_rep = jnp.broadcast_to(
+                x_local[None], (top_k,) + x_local.shape
+            ).reshape(top_k * g_l, e_dim, f_dim)
+            send = jnp.zeros((n, cap + 1, e_dim, f_dim), x_local.dtype)
+            send = send.at[rf, slot].set(x_rep)[:, :cap]
 
             recv = jax.lax.all_to_all(
                 send, expert_axis, split_axis=0, concat_axis=0,
@@ -126,17 +160,21 @@ class ShardedMoEPlanner(SnapshotPlannerMixin):
             back = jax.lax.all_to_all(
                 s, expert_axis, split_axis=0, concat_axis=0,
                 tiled=False).reshape(n, cap, e_dim)
-            # every (dst, slot) read below was written by this device's
-            # own scatter above, so no validity mask is needed
-            return back[route_local, slot]                 # [G_l, E]
+            back = jnp.concatenate(
+                [back, jnp.zeros((n, 1, e_dim), back.dtype)], axis=1)
+            return back[rf, slot].reshape(top_k, g_l, e_dim)
 
         def scores(params: Params, features, mask):
-            route, probs = model.gate(params, features, mask)
-            s = dispatch(params["w1"], params["b1"], params["w2"],
-                         params["b2"], features.astype(jnp.bfloat16),
-                         route)
-            p_sel = jnp.take_along_axis(probs, route[:, None], axis=1)
-            return s.astype(jnp.float32) * p_sel, route, probs
+            routes, gate_p, probs = model.gate_topk(params, features,
+                                                    mask)
+            outs = dispatch(params["w1"], params["b1"], params["w2"],
+                            params["b2"],
+                            features.astype(jnp.bfloat16), routes)
+            s = jnp.zeros(features.shape[:2], jnp.float32)
+            for k in range(top_k):  # K is tiny and static: unrolled
+                # dropped slots are already exactly zero from dispatch
+                s = s + outs[k].astype(jnp.float32) * gate_p[:, k, None]
+            return s, routes[:, 0], probs
 
         def loss_fn(params: Params, batch: Batch):
             s, route, probs = scores(params, batch.features, batch.mask)
@@ -158,9 +196,6 @@ class ShardedMoEPlanner(SnapshotPlannerMixin):
                              out_shardings=(ps, None, None))
         self.param_shardings = ps
         self.batch_shardings = bs
-        self._n_total = 1
-        for axis in both:
-            self._n_total *= mesh.shape[axis]
 
     def shard_batch(self, batch: Batch) -> Batch:
         g = batch.features.shape[0]
