@@ -470,6 +470,29 @@ class _Watcher:
             self._objs[obj.key()] = obj
         self._q.put(WatchEvent(etype, obj, obj.metadata.resource_version))
 
+    def handle_event(self, evt: Dict[str, Any]) -> None:
+        """One decoded watch-stream event, exactly as the apiserver
+        frames it: ADDED/MODIFIED/DELETED deliver, BOOKMARK advances
+        the resume point, ERROR(410) raises _WatchExpired for the
+        relist path.  Factored from the stream loop so the golden
+        wire-fixture suite (tests/test_wire_fixtures.py) can drive it
+        with real-apiserver event shapes."""
+        etype = evt.get("type", "")
+        if etype == "ERROR":
+            status = evt.get("object") or {}
+            if status.get("code") == 410:
+                raise _WatchExpired()
+            raise RuntimeError(f"watch error: {status}")
+        if etype == "BOOKMARK":
+            obj_rv = ((evt.get("object") or {}).get("metadata")
+                      or {}).get("resourceVersion", self._rv)
+            if str(obj_rv).isdigit():
+                self._rv = int(obj_rv)
+            return
+        obj = self._codec.from_wire(evt.get("object") or {})
+        self._rv = max(self._rv, obj.metadata.resource_version)
+        self._deliver(etype, obj)
+
     def _stream(self) -> None:
         path = (f"{self._codec.collection_path(None)}"
                 f"?watch=true&resourceVersion={self._rv}")
@@ -487,22 +510,7 @@ class _Watcher:
                     return
                 if not line.strip():
                     continue
-                evt = json.loads(line)
-                etype = evt.get("type", "")
-                if etype == "ERROR":
-                    status = evt.get("object") or {}
-                    if status.get("code") == 410:
-                        raise _WatchExpired()
-                    raise RuntimeError(f"watch error: {status}")
-                if etype == "BOOKMARK":
-                    obj_rv = ((evt.get("object") or {}).get("metadata")
-                              or {}).get("resourceVersion", self._rv)
-                    if str(obj_rv).isdigit():
-                        self._rv = int(obj_rv)
-                    continue
-                obj = self._codec.from_wire(evt.get("object") or {})
-                self._rv = max(self._rv, obj.metadata.resource_version)
-                self._deliver(etype, obj)
+                self.handle_event(json.loads(line))
         finally:
             with self._resp_lock:
                 if self._resp is resp:
