@@ -32,15 +32,64 @@ the forward; no [T, T] matrix ever exists in HBM.
 from __future__ import annotations
 
 import functools
+import json
+import logging
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+logger = logging.getLogger(__name__)
+
 _NEG_INF = -1e30
 _LANE = 128  # last-dim tile width; also the m/l scratch lane padding
 _SUBLANE = 16  # second-minor tile granularity (bf16-safe; 8 for f32)
+
+# measured-best (block_q, block_k) per sequence-length band, written
+# from committed `bench.py autotune` sweeps (the proposal artifact is
+# bench_artifacts/flash_blocks_proposed.json); absent file = heuristic
+# only.  Schema: {"bands": [{"t_max": N, "block_q": B, "block_k": B}]}
+_TUNED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "flash_blocks.json")
+_tuned_bands = None  # lazy; tests reset via _reset_tuned_cache()
+
+
+def _reset_tuned_cache() -> None:
+    global _tuned_bands
+    _tuned_bands = None
+
+
+def _tuned_blocks(t: int):
+    """(block_q, block_k) from the committed sweep table for sequence
+    length t, or None (no table / no band covers t)."""
+    global _tuned_bands
+    if _tuned_bands is None:
+        try:
+            with open(_TUNED_PATH) as f:
+                _tuned_bands = sorted(
+                    json.load(f).get("bands", []),
+                    key=lambda b: b.get("t_max", 0))
+        except FileNotFoundError:
+            _tuned_bands = []   # no table committed: heuristic only
+        except (OSError, ValueError) as exc:
+            # a COMMITTED table that cannot load means the measured
+            # tuning is silently lost — say so once, loudly
+            logger.warning(
+                "flash block table %s unreadable (%s); falling back "
+                "to heuristic blocks", _TUNED_PATH, exc)
+            _tuned_bands = []
+    for band in _tuned_bands:
+        if t <= band.get("t_max", 0):
+            try:
+                return int(band["block_q"]), int(band["block_k"])
+            except (KeyError, TypeError, ValueError):
+                logger.warning(
+                    "flash block table band %r malformed; using "
+                    "heuristic blocks for t=%d", band, t)
+                return None
+    return None
 
 
 def _auto_block(t: int, block) -> int:
@@ -54,6 +103,20 @@ def _auto_block(t: int, block) -> int:
     if block is not None:
         return block
     return min(1024, -(-t // _SUBLANE) * _SUBLANE)
+
+
+def _resolve_blocks(tq: int, tk: int, block_q, block_k):
+    """Resolve the (block_q, block_k) pair: explicit args win;
+    otherwise the measured sweep table (square tq == tk case only —
+    that is what autotune measures), each side clamped by the
+    heuristic cap so a table tuned at T=2048 never inflates tiny
+    windows; heuristic fallback."""
+    if block_q is None and block_k is None and tq == tk:
+        tuned = _tuned_blocks(tq)
+        if tuned is not None:
+            return (min(tuned[0], _auto_block(tq, None)),
+                    min(tuned[1], _auto_block(tk, None)))
+    return _auto_block(tq, block_q), _auto_block(tk, block_k)
 
 
 def _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, *,
@@ -238,8 +301,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (see ``_auto_block``).
     """
     interpret = jax.default_backend() != "tpu"
-    block_q = _auto_block(q.shape[0], block_q)
-    block_k = _auto_block(k.shape[0], block_k)
+    block_q, block_k = _resolve_blocks(q.shape[0], k.shape[0],
+                                       block_q, block_k)
     return _flash_diff(q, k, v, causal, block_q, block_k, interpret)
 
 
@@ -602,6 +665,6 @@ def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
     *relative* positions (q index >= k index) — the diagonal-block case.
     """
     interpret = jax.default_backend() != "tpu"
-    block_q = _auto_block(q.shape[1], block_q)
-    block_k = _auto_block(k.shape[1], block_k)
+    block_q, block_k = _resolve_blocks(q.shape[1], k.shape[1],
+                                       block_q, block_k)
     return _flash_stats(q, k, v, causal, block_q, block_k, interpret)

@@ -33,6 +33,7 @@ BENCHES = [
     ("flash-long", 660.0),
     ("temporal", 660.0),
     ("smoke", 660.0),
+    ("temporal-breakdown", 1300.0),
     ("planner", 660.0),
     ("autotune", 2500.0),
 ]
@@ -113,6 +114,24 @@ def main() -> int:
                 any_live = True
             print(f"[capture] {name}: "
                   f"{json.dumps(parsed)[:200]}", flush=True)
+
+    autotune = results.get("autotune") or {}
+    if autotune.get("ranked"):
+        # proposal only — a human reviews the sweep (noise, failed
+        # configs) before promoting it to ops/flash_blocks.json, where
+        # pallas_attention._resolve_blocks starts honoring it
+        best = autotune["ranked"][0]
+        (ART / "flash_blocks_proposed.json").write_text(json.dumps({
+            "generated_at": _utc(),
+            "device_kind": autotune.get("device_kind"),
+            "swept_shape": autotune.get("shape"),
+            "bands": [{
+                "t_max": (autotune.get("shape") or {}).get("t", 0),
+                "block_q": best["block_q"] or 1024,
+                "block_k": best["block_k"] or 1024,
+            }],
+            "ranked": autotune["ranked"],
+        }, indent=2) + "\n")
 
     payload = {
         "measured_at": _utc(),

@@ -221,3 +221,24 @@ def test_smoke_legs_compile_interpret_mode():
         "sharded_train_step"]
     for name, thunk in legs:
         thunk()  # raises on any build/compile drift
+
+
+def test_temporal_breakdown_skips_off_tpu():
+    out = bench.bench_temporal_breakdown()
+    assert "skipped" in out and "non-tpu" in out["skipped"]
+
+
+def test_temporal_breakdown_legs_run_interpret_mode():
+    """Every breakdown leg builds AND executes on the CPU backend
+    (flash interpret-mode) -- an optax/flash/train_step API drift
+    breaks here in CI, not mid live-capture window on the TPU.  These
+    are the exact builders bench_temporal_breakdown times."""
+    import jax
+    import numpy as np
+
+    legs = bench.temporal_breakdown_legs(jax, t=8, g=2, e=4, d=16,
+                                         h=32)
+    assert set(legs) == {"full", "dense", "attention", "optimizer"}
+    for name, (chained, args) in legs.items():
+        out = np.asarray(chained(2)(*args))
+        assert np.isfinite(out).all(), name

@@ -1,4 +1,6 @@
 """Pallas flash attention vs the dense oracle (interpret mode on CPU)."""
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -162,3 +164,125 @@ def test_causal_prefix_invariance():
                                np.asarray(base[:150]),
                                rtol=2e-5, atol=2e-5)
     assert not np.allclose(np.asarray(out[159]), np.asarray(base[159]))
+
+
+# -- measured block table (VERDICT r2 weak #2 plumbing) ---------------------
+
+
+def test_tuned_block_table_drives_auto_blocks(tmp_path, monkeypatch):
+    """A committed sweep table overrides the heuristic for covered
+    sequence lengths, clamped so a T=2048 tuning never inflates tiny
+    windows; explicit args and uncovered lengths keep today's
+    behavior."""
+    from aws_global_accelerator_controller_tpu.ops import (
+        pallas_attention as pa,
+    )
+
+    table = tmp_path / "flash_blocks.json"
+    table.write_text(json.dumps({"bands": [
+        {"t_max": 4096, "block_q": 512, "block_k": 1024},
+    ]}))
+    monkeypatch.setattr(pa, "_TUNED_PATH", str(table))
+    pa._reset_tuned_cache()
+    try:
+        # covered band, square: table wins
+        assert pa._resolve_blocks(2048, 2048, None, None) == (512, 1024)
+        # clamped: tuned 512/1024 never exceeds the heuristic for T=128
+        assert pa._resolve_blocks(128, 128, None, None) == (128, 128)
+        # uncovered band: heuristic
+        assert pa._resolve_blocks(8192, 8192, None, None) == (1024, 1024)
+        # explicit args always win
+        assert pa._resolve_blocks(2048, 2048, 256, None) == (256, 1024)
+        # non-square (ring attention partials): heuristic per side
+        assert pa._resolve_blocks(2048, 256, None, None) == (1024, 256)
+    finally:
+        pa._reset_tuned_cache()
+
+
+def test_no_table_means_heuristic(monkeypatch, tmp_path):
+    from aws_global_accelerator_controller_tpu.ops import (
+        pallas_attention as pa,
+    )
+
+    monkeypatch.setattr(pa, "_TUNED_PATH",
+                        str(tmp_path / "missing.json"))
+    pa._reset_tuned_cache()
+    try:
+        assert pa._resolve_blocks(2048, 2048, None, None) == (1024, 1024)
+        assert pa._resolve_blocks(100, 100, None, None) == (112, 112)
+    finally:
+        pa._reset_tuned_cache()
+
+
+def test_tuned_table_numerics_equivalent(tmp_path, monkeypatch):
+    """Block sizes are a scheduling choice: a tuned table changes only
+    the rescale boundaries of the online softmax, so outputs agree to
+    bf16 rounding."""
+    from aws_global_accelerator_controller_tpu.ops import (
+        pallas_attention as pa,
+    )
+
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (256, 2, 64), jnp.bfloat16)
+               for kk in keys)
+    base = np.asarray(pa.flash_attention(q, k, v, causal=True))
+
+    table = tmp_path / "flash_blocks.json"
+    table.write_text(json.dumps({"bands": [
+        {"t_max": 512, "block_q": 128, "block_k": 64},
+    ]}))
+    monkeypatch.setattr(pa, "_TUNED_PATH", str(table))
+    pa._reset_tuned_cache()
+    try:
+        tuned = np.asarray(pa.flash_attention(q, k, v, causal=True))
+    finally:
+        pa._reset_tuned_cache()
+    np.testing.assert_allclose(
+        base.astype(np.float32), tuned.astype(np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_corrupt_tuned_table_warns_and_falls_back(tmp_path, monkeypatch,
+                                                  caplog):
+    """A committed-but-unreadable table silently dropping the measured
+    tuning would be invisible; it must log a warning and fall back."""
+    import logging
+
+    from aws_global_accelerator_controller_tpu.ops import (
+        pallas_attention as pa,
+    )
+
+    bad = tmp_path / "flash_blocks.json"
+    bad.write_text("{not json")
+    monkeypatch.setattr(pa, "_TUNED_PATH", str(bad))
+    pa._reset_tuned_cache()
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger=pa.logger.name):
+            assert pa._resolve_blocks(2048, 2048, None, None) \
+                == (1024, 1024)
+        assert any("unreadable" in r.message for r in caplog.records)
+    finally:
+        pa._reset_tuned_cache()
+
+
+def test_committed_tuned_table_is_valid_if_present():
+    """If ops/flash_blocks.json is ever committed, it must parse and
+    carry well-formed bands — a typo must fail CI, not silently
+    disable the tuning in production."""
+    import json as json_mod
+    import os
+
+    from aws_global_accelerator_controller_tpu.ops import (
+        pallas_attention as pa,
+    )
+
+    if not os.path.exists(pa._TUNED_PATH):
+        pytest.skip("no tuned table committed yet")
+    with open(pa._TUNED_PATH) as f:
+        table = json_mod.load(f)
+    assert table.get("bands"), "committed table must carry bands"
+    for band in table["bands"]:
+        assert int(band["t_max"]) > 0
+        assert int(band["block_q"]) > 0
+        assert int(band["block_k"]) > 0
