@@ -34,6 +34,13 @@ def register(sub) -> None:
     train.add_argument("--experts", type=int, default=4,
                        help="Expert count (moe model); with --sharded "
                             "must equal the expert mesh axis size.")
+    train.add_argument("--supervision", choices=("last", "sequence"),
+                       default="last",
+                       help="Temporal objective: last = final-step "
+                            "scores only (O(T) last-query attention); "
+                            "sequence = every step supervised (full "
+                            "causal flash/ring attention, richer "
+                            "signal, synthetic loader only).")
     train.add_argument("--top-k", type=int, default=1, dest="top_k",
                        help="Experts per group (moe): 1 = switch "
                             "routing, 2 = GShard-style top-2 (gate-"
@@ -172,14 +179,22 @@ def _build_model(args):
     if args.model == "temporal":
         from ..models.temporal import TemporalTrafficModel, synthetic_window
 
+        supervision = getattr(args, "supervision", "last")
+        if supervision == "sequence" and loader_kind != "synthetic":
+            raise SystemExit(
+                "--supervision sequence needs per-step targets, which "
+                "only the synthetic loader produces; drop --loader "
+                f"{loader_kind}")
         model = TemporalTrafficModel(hidden_dim=args.hidden,
-                                     learning_rate=lr)
+                                     learning_rate=lr,
+                                     supervision=supervision)
 
         if loader_kind == "synthetic":
             def make_data(key):
-                return synthetic_window(key, steps=args.window,
-                                        groups=args.groups,
-                                        endpoints=args.endpoints)
+                return synthetic_window(
+                    key, steps=args.window, groups=args.groups,
+                    endpoints=args.endpoints,
+                    per_step=supervision == "sequence")
         else:
             # window-mode C++ pipeline (native/telemetry.cpp steps=T):
             # batches stream from worker threads, key is ignored

@@ -48,13 +48,31 @@ class TemporalTrafficModel(TrainableModel):
 
     def __init__(self, feature_dim: int = 8, embed_dim: int = 32,
                  hidden_dim: int = 64, learning_rate: float = 1e-3,
-                 attention: str = "flash"):
+                 attention: str = "flash", supervision: str = "last"):
+        """``supervision`` picks the training objective:
+
+        - ``"last"`` (default): only the final step's scores are
+          supervised — the original objective.  Training then routes
+          through the O(T) last-query attention (``scores_last``):
+          the full [T, T] attention computes T-1 output rows whose
+          gradient is exactly zero under this loss, so the switch is
+          a pure algorithmic win (same math, ~T-fold less attention
+          compute at the benchmark shape).
+        - ``"sequence"``: every step is supervised against the
+          per-step target (``synthetic_window(per_step=True)``) — the
+          regime where the full causal attention (flash kernel, ring
+          sharding) is genuinely load-bearing, and the better
+          training signal (T targets per window instead of 1).
+        """
         if attention not in ("flash", "flash_always", "reference"):
             raise ValueError(f"unknown attention impl {attention!r}")
+        if supervision not in ("last", "sequence"):
+            raise ValueError(f"unknown supervision {supervision!r}")
         self.feature_dim = feature_dim
         self.embed_dim = embed_dim
         self.hidden_dim = hidden_dim
         self.attention = attention
+        self.supervision = supervision
         self.optimizer = optax.adam(learning_rate)
 
     def init_params(self, key: jax.Array) -> Params:
@@ -103,51 +121,146 @@ class TemporalTrafficModel(TrainableModel):
         from ..parallel.ring_attention import attention_reference
         return attention_reference(q, k, v, causal=True)
 
+    def _embed_kv(self, params: Params, window: jax.Array):
+        """[T, G, E, F] -> (emb [T, S, D], k, v) shared by every path."""
+        t, g, e, f = window.shape
+        x = window.astype(jnp.bfloat16).reshape(t, g * e, f)
+        emb = x @ params["embed"]                      # [T, S, D]
+        k = emb @ params["wk"]
+        v = emb @ params["wv"]
+        return emb, k, v
+
+    def _head(self, params: Params, rep: jax.Array) -> jax.Array:
+        """[..., D] attended representation -> [...] float32 score."""
+        h = jnp.maximum(rep.astype(jnp.bfloat16) @ params["w1"]
+                        + params["b1"], 0)
+        return (h @ params["w2"] + params["b2"])[..., 0].astype(
+            jnp.float32)
+
     def scores(self, params: Params, window: jax.Array,
                attend=None) -> jax.Array:
-        """[T, G, E, F] telemetry window -> [G, E] float32 scores.
+        """[T, G, E, F] telemetry window -> [G, E] float32 scores via
+        the FULL causal attention (last output row through the head).
 
         ``attend`` overrides the attention impl with a fn(q, k, v:
         [T, S, D]) -> [T, S, D] — the seam `parallel.plan.
         ShardedTemporalPlanner` uses to swap in ring attention over a
-        sequence-sharded mesh.
+        sequence-sharded mesh.  ``scores_last`` computes the same
+        quantity in O(T) and is what serving uses; this full form is
+        the oracle and the sequence-supervision building block.
         """
         attend = attend or self._attend
         t, g, e, f = window.shape
-        x = window.astype(jnp.bfloat16).reshape(t, g * e, f)
-        emb = x @ params["embed"]                      # [T, S, D]
-        q, k, v = (emb @ params[w] for w in ("wq", "wk", "wv"))
+        emb, k, v = self._embed_kv(params, window)
+        q = emb @ params["wq"]
         attended = attend(q, k, v)                     # [T, S, D]
-        last = attended[-1].astype(jnp.bfloat16)       # [S, D]
-        hdn = jnp.maximum(last @ params["w1"] + params["b1"], 0)
-        out = hdn @ params["w2"] + params["b2"]
-        return out[:, 0].reshape(g, e).astype(jnp.float32)
+        return self._head(params, attended[-1]).reshape(g, e)
+
+    def scores_last(self, params: Params, window: jax.Array,
+                    attend_last=None) -> jax.Array:
+        """[T, G, E, F] -> [G, E] scores in O(T*S*D) — same math as
+        ``scores`` but only the final query row is ever formed: the
+        last step attends its whole history (causality is vacuous for
+        the last row), softmax over T, one weighted sum.  No [T, T]
+        matrix, no flash kernel needed.  ``attend_last`` overrides
+        with a fn(q_last [S, D], k, v [T, S, D]) -> [S, D] (the
+        sharded planner's seam)."""
+        t, g, e, f = window.shape
+        emb, k, v = self._embed_kv(params, window)
+        q_last = emb[-1] @ params["wq"]                # [S, D]
+        attend_last = attend_last or attention_last_reference
+        rep = attend_last(q_last, k, v)                # [S, D]
+        return self._head(params, rep).reshape(g, e)
+
+    def scores_seq(self, params: Params, window: jax.Array,
+                   attend=None) -> jax.Array:
+        """[T, G, E, F] -> [T, G, E] per-step scores: every timestep's
+        causal-attended representation through the head — the
+        sequence-supervision objective where the full attention (flash
+        kernel / ring sharding) is genuinely load-bearing."""
+        attend = attend or self._attend
+        t, g, e, f = window.shape
+        emb, k, v = self._embed_kv(params, window)
+        q = emb @ params["wq"]
+        attended = attend(q, k, v)                     # [T, S, D]
+        return self._head(params, attended).reshape(t, g, e)
 
     def forward(self, params: Params, window: jax.Array,
                 mask: jax.Array, attend=None) -> jax.Array:
-        """[T, G, E, F] + [G, E] mask -> int32 GA weights [G, E]."""
-        return plan_weights(self.scores(params, window, attend), mask)
+        """[T, G, E, F] + [G, E] mask -> int32 GA weights [G, E].
+
+        Serving plans from the latest telemetry only, so it takes the
+        O(T) last-query path; pass ``attend`` to force the full
+        attention (the oracle tests do)."""
+        if attend is not None:
+            return plan_weights(self.scores(params, window, attend),
+                                mask)
+        return plan_weights(self.scores_last(params, window), mask)
 
     # -- training -------------------------------------------------------
 
     def loss(self, params: Params, window: jax.Array, batch: Batch,
              attend=None) -> jax.Array:
+        """``supervision="last"``: CE on the final step's scores via
+        the O(T) path (an ``attend`` override forces the full
+        attention — sharded planners training through ring attention
+        pass it).  ``supervision="sequence"``: masked CE per step
+        against ``batch.target`` [T, G, E], averaged over steps."""
+        if self.supervision == "sequence":
+            seq = self.scores_seq(params, window, attend)  # [T, G, E]
+            per_step = jax.vmap(masked_ce_loss,
+                                in_axes=(0, None, 0))(
+                seq, batch.mask, batch.target)
+            return jnp.mean(per_step)
+        if attend is not None:
+            return masked_ce_loss(
+                self.scores(params, window, attend), batch.mask,
+                batch.target)
         return masked_ce_loss(
-            self.scores(params, window, attend), batch.mask,
-            batch.target)
+            self.scores_last(params, window), batch.mask, batch.target)
+
+
+def attention_last_reference(q_last: jax.Array, k: jax.Array,
+                             v: jax.Array) -> jax.Array:
+    """Last-query attention: q_last [S, D], k/v [T, S, D] -> [S, D].
+
+    The final row of causal softmax attention — equal to
+    ``attention_reference(q, k, v, causal=True)[-1]`` whenever
+    ``q[-1] == q_last`` — computed without ever forming the other
+    T-1 rows (float32 accumulation like the oracle)."""
+    qf = q_last.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    scale = qf.shape[-1] ** -0.5
+    s = jnp.einsum("sd,tsd->st", qf, kf) * scale       # [S, T]
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("st,tsd->sd", p, vf)
 
 
 def synthetic_window(key: jax.Array, steps: int = 8, groups: int = 16,
-                     endpoints: int = 8, feature_dim: int = 8):
+                     endpoints: int = 8, feature_dim: int = 8,
+                     per_step: bool = False):
     """Random telemetry window + a target favouring endpoints whose
-    capacity signal trends up over the window."""
+    capacity signal trends up over the window.
+
+    ``per_step=True`` emits the sequence-supervision batch: target
+    [T, G, E] where step t's target follows the trend accumulated up
+    to t (step 0's trend is zero — a uniform target over the mask)."""
     k1, k2 = jax.random.split(key)
     window = jax.random.normal(
         k1, (steps, groups, endpoints, feature_dim), dtype=jnp.float32)
     mask = jax.random.bernoulli(k2, 0.85, (groups, endpoints))
-    trend = window[-1, ..., 0] - window[0, ..., 0]
-    raw = jnp.where(mask, jnp.exp(trend), 0.0)
-    denom = jnp.sum(raw, axis=-1, keepdims=True)
-    target = jnp.where(denom > 0, raw / jnp.maximum(denom, 1e-9), 0.0)
+
+    def target_for(trend):
+        raw = jnp.where(mask, jnp.exp(trend), 0.0)
+        denom = jnp.sum(raw, axis=-1, keepdims=True)
+        return jnp.where(denom > 0, raw / jnp.maximum(denom, 1e-9),
+                         0.0)
+
+    if per_step:
+        target = jax.vmap(target_for)(
+            window[..., 0] - window[0, ..., 0])        # [T, G, E]
+    else:
+        target = target_for(window[-1, ..., 0] - window[0, ..., 0])
     return window, Batch(features=window[-1].astype(jnp.bfloat16),
                          mask=mask, target=target)

@@ -34,5 +34,6 @@ from .plan import (  # noqa: F401
 from .ring import ewma_reference, make_mesh_1d, make_ring_ewma  # noqa: F401
 from .ring_attention import (  # noqa: F401
     attention_reference,
+    make_last_attention,
     make_ring_attention,
 )

@@ -25,9 +25,10 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.temporal import TemporalTrafficModel
+from ..ops.weights import plan_weights
 from ..models.traffic import Batch, TrafficPolicyModel
 from .base import SnapshotPlannerMixin
-from .ring_attention import make_ring_attention
+from .ring_attention import make_last_attention, make_ring_attention
 
 
 def param_specs() -> dict:
@@ -117,24 +118,55 @@ class ShardedTemporalPlanner:
         rep = NamedSharding(mesh, P())
         win_s = NamedSharding(mesh, P(seq_axis, data_axis, None, None))
         ge_s = NamedSharding(mesh, P(data_axis, None))
+        # sequence supervision carries per-step targets [T, G, E],
+        # sharded like the window's leading axes
+        target_s = (NamedSharding(mesh, P(seq_axis, data_axis, None))
+                    if model.supervision == "sequence" else ge_s)
         batch_s = Batch(features=NamedSharding(
-            mesh, P(data_axis, None, None)), mask=ge_s, target=ge_s)
+            mesh, P(data_axis, None, None)), mask=ge_s,
+            target=target_s)
 
         self.window_sharding = win_s
         self.batch_shardings = batch_s
         self.param_sharding = rep
 
+        # serving: the O(T) last-query path with the softmax merged
+        # across the seq shards by the flash recurrence (shard_map
+        # all_gather of per-block (o, m, l) — tiny: one [S, D] row set
+        # per shard), regardless of supervision mode
+        last_attend = self._last_attend = make_last_attention(
+            mesh, seq_axis, data_axis)
         self._forward = jax.jit(
-            lambda params, window, mask: model.forward(
-                params, window, mask, attend=ring),
+            lambda params, window, mask: plan_weights(
+                model.scores_last(params, window,
+                                  attend_last=last_attend), mask),
             in_shardings=(rep, win_s, ge_s), out_shardings=ge_s)
 
-        def step(params, opt_state, window, batch):
-            # attend rides as trailing *data so the shared
-            # TrainableModel.train_step (common.py) stays the single
-            # optimizer-update implementation across families
-            return model.train_step(params, opt_state, window, batch,
-                                    ring)
+        if model.supervision == "sequence":
+            def step(params, opt_state, window, batch):
+                # attend rides as trailing *data so the shared
+                # TrainableModel.train_step (common.py) stays the
+                # single optimizer-update implementation across
+                # families; the full causal attention is load-bearing
+                # here (every step supervised) — ring over seq
+                return model.train_step(params, opt_state, window,
+                                        batch, ring)
+        else:
+            def last_loss(params, window, batch):
+                from ..models.common import masked_ce_loss
+
+                return masked_ce_loss(
+                    model.scores_last(params, window,
+                                      attend_last=last_attend),
+                    batch.mask, batch.target)
+
+            def step(params, opt_state, window, batch):
+                # last supervision trains through the same O(T) path
+                # it serves with (the dense model does too) — the ring
+                # machinery stays out of a loss whose attention rows
+                # would have zero gradient
+                return model.train_step_with(last_loss, params,
+                                             opt_state, window, batch)
 
         self._step = jax.jit(
             step,

@@ -256,3 +256,46 @@ def make_ring_attention(mesh: Mesh, axis: str = "seq",
         return ring_local(q_local, k_local, v_local)
 
     return jax.jit(ring)
+
+
+def make_last_attention(mesh: Mesh, axis: str = "seq",
+                        head_axis: "str | None" = None):
+    """fn(q_last [S, D], k, v [T, S, D] time-sharded over ``axis``) ->
+    [S, D]: the final row of causal attention, in O(T/n) per device.
+
+    The serving counterpart of :func:`make_ring_attention`: planning
+    weights needs only the last step's attended representation, so
+    instead of ring-rotating full K/V blocks this computes each
+    shard's partial softmax stats (o, m, l) for the single query row
+    and merges them with the flash recurrence after one all_gather of
+    [S_l, D]-sized rows — no ppermute loop, no [T, T] anything.
+    Differentiable through the all_gather's transpose; equal to
+    ``models.temporal.attention_last_reference`` up to float
+    association."""
+    kv_spec = P(axis, head_axis, None)
+    q_spec = P(head_axis, None)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
+             check_vma=False)
+    def last(q_l, k_l, v_l):
+        qf = q_l.astype(jnp.float32)
+        kf = k_l.astype(jnp.float32)
+        vf = v_l.astype(jnp.float32)
+        scale = qf.shape[-1] ** -0.5
+        s = jnp.einsum("sd,tsd->st", qf, kf) * scale   # [S_l, T_b]
+        m = jnp.max(s, axis=-1)                        # [S_l]
+        p = jnp.exp(s - m[:, None])
+        el = jnp.sum(p, axis=-1)                       # [S_l]
+        o = jnp.einsum("st,tsd->sd", p, vf)            # [S_l, D]
+
+        os_ = jax.lax.all_gather(o, axis)              # [n, S_l, D]
+        ms = jax.lax.all_gather(m, axis)               # [n, S_l]
+        ls = jax.lax.all_gather(el, axis)
+        mm = jnp.max(ms, axis=0)                       # [S_l]
+        w = jnp.exp(ms - mm[None])
+        denom = jnp.sum(ls * w, axis=0)                # [S_l]
+        num = jnp.sum(os_ * w[..., None], axis=0)      # [S_l, D]
+        return num / denom[:, None]
+
+    return jax.jit(last)

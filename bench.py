@@ -263,9 +263,13 @@ def bench_temporal_train(t: int = 2048, g: int = 8, e: int = 16,
     kernel-level one): one optimizer step of the temporal family —
     embed + QKV projections + causal flash attention over T (custom
     VJP on the backward) + head + Adam — with S = G*E endpoint streams
-    as attention heads.  Timing uses the same chained-marginal method
-    as bench_flash (params thread through a lax.scan of train steps, a
-    data dependence XLA cannot elide).
+    as attention heads, under sequence supervision (every step's
+    scores supervised — the regime where the full attention is useful
+    work).  The default last-supervised step (O(T) last-query
+    attention, same dense matmuls) is timed alongside with its own
+    FLOP model and the measured speedup.  Timing uses the same
+    chained-marginal method as bench_flash (params thread through a
+    lax.scan of train steps, a data dependence XLA cannot elide).
 
     FLOP accounting matches bench_flash's conventions so the two MFU
     numbers are comparable: dense matmuls (embed 2*T*S*F*D + QKV
@@ -289,27 +293,48 @@ def bench_temporal_train(t: int = 2048, g: int = 8, e: int = 16,
         return {"skipped": f"non-tpu backend ({jax.default_backend()})"}
 
     f = 8
+    # sequence supervision: every step supervised, so the full causal
+    # flash attention (and its VJP) is load-bearing and the T^2 FLOP
+    # model below counts useful work.  The last-supervised step is
+    # timed alongside: same shapes, O(T) last-query attention — the
+    # algorithmic speedup serving and default training take.
     model = TemporalTrafficModel(feature_dim=f, embed_dim=d,
-                                 hidden_dim=h, attention="flash")
+                                 hidden_dim=h, attention="flash",
+                                 supervision="sequence")
     params = model.init_params(jax.random.PRNGKey(0))
     opt_state = model.init_opt_state(params)
     window, batch = synthetic_window(jax.random.PRNGKey(1), steps=t,
+                                     groups=g, endpoints=e,
+                                     per_step=True)
+    model_last = TemporalTrafficModel(feature_dim=f, embed_dim=d,
+                                      hidden_dim=h, attention="flash")
+    _, batch_last = synthetic_window(jax.random.PRNGKey(1), steps=t,
                                      groups=g, endpoints=e)
 
-    def chained(steps):
-        def body(carry, _):
-            p, o = carry
-            p, o, loss = model.train_step(p, o, window, batch)
-            return (p, o), loss
-        return jax.jit(lambda p, o: lax.scan(
-            body, (p, o), None, length=steps)[1][-1])
+    def chained_for(m, b):
+        def chained(steps):
+            def body(carry, _):
+                p, o = carry
+                p, o, loss = m.train_step(p, o, window, b)
+                return (p, o), loss
+            return jax.jit(lambda p, o: lax.scan(
+                body, (p, o), None, length=steps)[1][-1])
+        return chained
 
-    step_s = _marginal_s(np, chained, (params, opt_state), n)
+    step_s = _marginal_s(np, chained_for(model, batch),
+                         (params, opt_state), n)
+    last_s = _marginal_s(np, chained_for(model_last, batch_last),
+                         (params, opt_state), n)
 
     s = g * e
     dense_fwd = 2.0 * t * s * d * (f + 3 * d)
     attn_fwd = 2.0 * t * t * d * s
     train_flops = 3.0 * dense_fwd + 3.5 * attn_fwd
+    # the last-supervised step's useful FLOPs: embed + K/V projections
+    # over all T but the q projection only for the final row, and
+    # one-row attention (2*T*D*S for QK^T and again for PV)
+    last_dense_fwd = 2.0 * t * s * d * (f + 2 * d) + 2.0 * s * d * d
+    last_flops = 3.0 * last_dense_fwd + 3.0 * (4.0 * t * d * s)
     peak, kind = _tpu_peak(jax.devices()[0])
     return {
         "backend": "tpu",
@@ -319,6 +344,10 @@ def bench_temporal_train(t: int = 2048, g: int = 8, e: int = 16,
         "steps_per_s": round(1.0 / step_s, 1),
         "train_tflops": round(train_flops / step_s / 1e12, 2),
         "train_mfu_pct": round(100.0 * train_flops / step_s / peak, 2),
+        "last_step_ms": round(last_s * 1e3, 3),
+        "last_steps_per_s": round(1.0 / last_s, 1),
+        "last_mfu_pct": round(100.0 * last_flops / last_s / peak, 2),
+        "last_vs_sequence_speedup": round(step_s / last_s, 2),
     }
 
 
@@ -331,11 +360,13 @@ def temporal_breakdown_legs(jax, t: int, g: int, e: int, d: int,
     builds and runs every leg (API drift breaks in CI, not mid
     live-capture window):
 
-    - ``full``: the real train step (same graph family as
-      ``bench_temporal_train``);
+    - ``full``: the real sequence-supervised train step (same graph
+      family as ``bench_temporal_train``'s headline number);
+    - ``last``: the default last-supervised step — O(T) last-query
+      attention, same dense matmuls (the algorithmic speedup);
     - ``attention``: flash fwd + custom-VJP grad alone at the step's
       [T, S, D] — the term the MFU model says should dominate;
-    - ``dense``: the same train step with attention stubbed to
+    - ``dense``: the sequence step with attention stubbed to
       identity — embed/QKV/head matmuls + loss + optimizer, no
       attention;
     - ``optimizer``: the Adam update alone on the same param tree.
@@ -354,20 +385,25 @@ def temporal_breakdown_legs(jax, t: int, g: int, e: int, d: int,
     )
 
     model = TemporalTrafficModel(feature_dim=8, embed_dim=d,
-                                 hidden_dim=h, attention="flash")
+                                 hidden_dim=h, attention="flash",
+                                 supervision="sequence")
     params = model.init_params(jax.random.PRNGKey(0))
     opt_state = model.init_opt_state(params)
     window, batch = synthetic_window(jax.random.PRNGKey(1), steps=t,
+                                     groups=g, endpoints=e,
+                                     per_step=True)
+    model_last = TemporalTrafficModel(feature_dim=8, embed_dim=d,
+                                      hidden_dim=h, attention="flash")
+    _, batch_last = synthetic_window(jax.random.PRNGKey(1), steps=t,
                                      groups=g, endpoints=e)
 
-    def chained_step(attend):
+    def chained_step(m, b, attend):
         # attend=None rides through train_step's *data into loss(),
         # whose `attend or self._attend` picks the model default
         def make(steps):
             def body(carry, _):
                 p, o = carry
-                p, o, loss = model.train_step(p, o, window, batch,
-                                              attend)
+                p, o, loss = m.train_step(p, o, window, b, attend)
                 return (p, o), loss
             return jax.jit(lambda p, o: lax.scan(
                 body, (p, o), None, length=steps)[1][-1])
@@ -399,8 +435,11 @@ def temporal_breakdown_legs(jax, t: int, g: int, e: int, d: int,
             .astype(jnp.float32))
 
     return {
-        "full": (chained_step(None), (params, opt_state)),
-        "dense": (chained_step(lambda q_, k_, v_: v_),
+        "full": (chained_step(model, batch, None),
+                 (params, opt_state)),
+        "last": (chained_step(model_last, batch_last, None),
+                 (params, opt_state)),
+        "dense": (chained_step(model, batch, lambda q_, k_, v_: v_),
                   (params, opt_state)),
         "attention": (chained_attn, (q,)),
         "optimizer": (chained_opt, (params, opt_state)),
@@ -607,15 +646,18 @@ def smoke_legs(jax, jnp) -> list:
     def sharded_train_step():
         # production shardings on a 1-device mesh (the multi-axis
         # layouts are dryrun-verified on the virtual CPU mesh; this leg
-        # verifies the flash ring local passes Mosaic)
+        # verifies the flash ring local passes Mosaic).  Sequence
+        # supervision: the mode whose training actually runs the ring
+        # + flash VJP
         model = TemporalTrafficModel(feature_dim=8, embed_dim=128,
                                      hidden_dim=128,
-                                     attention="flash_always")
+                                     attention="flash_always",
+                                     supervision="sequence")
         params = model.init_params(jax.random.PRNGKey(0))
         opt_state = model.init_opt_state(params)
         window, batch = synthetic_window(jax.random.PRNGKey(1),
                                          steps=256, groups=2,
-                                         endpoints=8)
+                                         endpoints=8, per_step=True)
         mesh = make_mesh(1, axis_shapes={"data": 1, "seq": 1})
         planner = ShardedTemporalPlanner(model, mesh, local="flash")
         planner._step.lower(params, opt_state, window, batch).compile()

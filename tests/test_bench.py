@@ -238,7 +238,8 @@ def test_temporal_breakdown_legs_run_interpret_mode():
 
     legs = bench.temporal_breakdown_legs(jax, t=8, g=2, e=4, d=16,
                                          h=32)
-    assert set(legs) == {"full", "dense", "attention", "optimizer"}
+    assert set(legs) == {"full", "last", "dense", "attention",
+                         "optimizer"}
     for name, (chained, args) in legs.items():
         out = np.asarray(chained(2)(*args))
         assert np.isfinite(out).all(), name
