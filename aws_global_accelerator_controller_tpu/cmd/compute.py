@@ -38,9 +38,10 @@ def register(sub) -> None:
                        default="last",
                        help="Temporal objective: last = final-step "
                             "scores only (O(T) last-query attention); "
-                            "sequence = every step supervised (full "
-                            "causal flash/ring attention, richer "
-                            "signal, synthetic loader only).")
+                            "sequence = every step supervised against "
+                            "per-step targets (full causal flash/ring "
+                            "attention, richer signal; both loaders "
+                            "produce the per-step law).")
     train.add_argument("--top-k", type=int, default=1, dest="top_k",
                        help="Experts per group (moe): 1 = switch "
                             "routing, 2 = GShard-style top-2 (gate-"
@@ -180,11 +181,6 @@ def _build_model(args):
         from ..models.temporal import TemporalTrafficModel, synthetic_window
 
         supervision = getattr(args, "supervision", "last")
-        if supervision == "sequence" and loader_kind != "synthetic":
-            raise SystemExit(
-                "--supervision sequence needs per-step targets, which "
-                "only the synthetic loader produces; drop --loader "
-                f"{loader_kind}")
         model = TemporalTrafficModel(hidden_dim=args.hidden,
                                      learning_rate=lr,
                                      supervision=supervision)
@@ -202,7 +198,8 @@ def _build_model(args):
 
             loader = make_loader(loader_kind, args.groups,
                                  args.endpoints, seed=args.seed,
-                                 steps=args.window)
+                                 steps=args.window,
+                                 per_step=supervision == "sequence")
             _open_loaders.append(loader)
 
             def make_data(key):

@@ -56,7 +56,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.aga_tl_new.argtypes = [ctypes.c_int, ctypes.c_int,
                                    ctypes.c_int, ctypes.c_int,
                                    ctypes.c_int, ctypes.c_uint64,
-                                   ctypes.c_int]
+                                   ctypes.c_int, ctypes.c_int]
         lib.aga_tl_next.restype = ctypes.c_int
         lib.aga_tl_next.argtypes = [
             ctypes.c_void_p,
@@ -84,13 +84,21 @@ class SyntheticTelemetryLoader:
     temporal family's ``synthetic_window`` law."""
 
     def __init__(self, groups: int, endpoints: int,
-                 feature_dim: int = 8, seed: int = 0, steps: int = 0):
+                 feature_dim: int = 8, seed: int = 0, steps: int = 0,
+                 per_step: bool = False):
         import jax
 
+        if per_step and not steps:
+            # same contract as the native loader: a per-step request
+            # silently downgraded to snapshot targets would train a
+            # different objective than asked
+            raise ValueError("per_step targets need window mode "
+                             "(steps > 0)")
         self._jax = jax
         self.groups, self.endpoints = groups, endpoints
         self.feature_dim = feature_dim
         self.steps = steps
+        self.per_step = per_step
         self._key = jax.random.PRNGKey(seed)
         self._step = 0
 
@@ -117,7 +125,8 @@ class SyntheticTelemetryLoader:
         return synthetic_window(self._next_key(), steps=self.steps,
                                 groups=self.groups,
                                 endpoints=self.endpoints,
-                                feature_dim=self.feature_dim)
+                                feature_dim=self.feature_dim,
+                                per_step=self.per_step)
 
     def close(self) -> None:
         pass
@@ -139,19 +148,25 @@ class NativeTelemetryLoader:
 
     def __init__(self, groups: int, endpoints: int,
                  feature_dim: int = 8, seed: int = 0,
-                 capacity: int = 4, n_threads: int = 2, steps: int = 0):
+                 capacity: int = 4, n_threads: int = 2, steps: int = 0,
+                 per_step: bool = False):
         lib = _load()
         if lib is None:
             raise RuntimeError(
                 "native telemetry loader unavailable (no g++ / build "
                 "failed); use make_loader which degrades gracefully")
+        if per_step and not steps:
+            raise ValueError("per_step targets need window mode "
+                             "(steps > 0)")
         self._lib = lib
         self.groups, self.endpoints = groups, endpoints
         self.feature_dim = feature_dim
         self.steps = steps
+        self.per_step = per_step
         self._h = lib.aga_tl_new(groups, endpoints, feature_dim,
                                  capacity, n_threads,
-                                 ctypes.c_uint64(seed or 1), steps)
+                                 ctypes.c_uint64(seed or 1), steps,
+                                 int(per_step))
         if not self._h:
             raise RuntimeError("native telemetry loader init failed")
         self._closed = False
@@ -159,7 +174,8 @@ class NativeTelemetryLoader:
     def _pop(self, features: np.ndarray):
         g, e = self.groups, self.endpoints
         mask = np.empty((g, e), np.uint8)
-        target = np.empty((g, e), np.float32)
+        target = np.empty((self.steps, g, e) if self.per_step
+                          else (g, e), np.float32)
         ok = self._lib.aga_tl_next(
             self._h,
             features.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
@@ -244,4 +260,5 @@ def make_loader(kind: str, groups: int, endpoints: int,
     elif kind != "synthetic":
         raise ValueError(f"unknown loader kind {kind!r}")
     return SyntheticTelemetryLoader(groups, endpoints, feature_dim, seed,
-                                    steps=kw.get("steps", 0))
+                                    steps=kw.get("steps", 0),
+                                    per_step=kw.get("per_step", False))

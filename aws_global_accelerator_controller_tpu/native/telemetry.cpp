@@ -36,7 +36,7 @@ namespace {
 struct Batch {
   std::vector<float> features;  // snapshot [G, E, F] or window [T, G, E, F]
   std::vector<uint8_t> mask;    // [G, E]
-  std::vector<float> target;    // [G, E]
+  std::vector<float> target;    // [G, E]; per_step window: [T, G, E]
 };
 
 // -- PRNG: splitmix64 seeding + xoshiro256++ --------------------------------
@@ -93,6 +93,9 @@ struct Rng {
 struct Loader {
   int groups, endpoints, features, capacity;
   int steps = 0;  // 0 = snapshot mode; T >= 1 = window mode
+  // window mode only: per-step targets [T, G, E] (the temporal
+  // family's sequence-supervision law) instead of final-trend [G, E]
+  bool per_step = false;
   std::mutex mu;
   std::condition_variable cv_pop;   // consumers wait for a ready batch
   std::condition_variable cv_push;  // producers wait for ring space
@@ -141,32 +144,40 @@ struct Loader {
   Batch generate_window(Rng& rng) const {
     // temporal law, mirroring models/temporal.py synthetic_window:
     // i.i.d. N(0,1) features per step, mask ~ Bernoulli(0.85), target
-    // ~ exp(capacity trend over the window) among valid endpoints
+    // ~ exp(capacity trend) among valid endpoints — trend over the
+    // whole window ([G, E] target), or per step t relative to step 0
+    // ([T, G, E] target, synthetic_window(per_step=True)'s law) when
+    // per_step is set
     Batch b;
     const int T = steps, G = groups, E = endpoints, F = features;
     b.features.resize(size_t(T) * G * E * F);
     b.mask.resize(size_t(G) * E);
-    b.target.resize(size_t(G) * E);
+    b.target.resize(per_step ? size_t(T) * G * E : size_t(G) * E);
     for (auto& x : b.features) x = float(rng.normal());
     const size_t step_stride = size_t(G) * E * F;
+    std::vector<double> raw(E);  // hoisted: T*G refills, one alloc
     for (int g = 0; g < G; g++) {
-      double denom = 0.0;
-      std::vector<double> raw(E, 0.0);
-      for (int e = 0; e < E; e++) {
-        const bool valid = rng.uniform() < 0.85;
-        b.mask[size_t(g) * E + e] = valid ? 1 : 0;
-        if (valid) {
+      for (int e = 0; e < E; e++)
+        b.mask[size_t(g) * E + e] = rng.uniform() < 0.85 ? 1 : 0;
+      const int t_begin = per_step ? 0 : T - 1;
+      for (int t = t_begin; t < T; t++) {
+        double denom = 0.0;
+        std::fill(raw.begin(), raw.end(), 0.0);
+        for (int e = 0; e < E; e++) {
+          if (!b.mask[size_t(g) * E + e]) continue;
           const size_t f0 = (size_t(g) * E + e) * F;
           const double trend =
-              double(b.features[(T - 1) * step_stride + f0])
+              double(b.features[size_t(t) * step_stride + f0])
               - double(b.features[f0]);
           raw[e] = std::exp(trend);
           denom += raw[e];
         }
+        float* out = per_step
+            ? &b.target[(size_t(t) * G + g) * E]
+            : &b.target[size_t(g) * E];
+        for (int e = 0; e < E; e++)
+          out[e] = denom > 0.0 ? float(raw[e] / denom) : 0.0f;
       }
-      for (int e = 0; e < E; e++)
-        b.target[size_t(g) * E + e] =
-            denom > 0.0 ? float(raw[e] / denom) : 0.0f;
     }
     return b;
   }
@@ -216,22 +227,27 @@ extern "C" {
 
 // steps == 0: snapshot mode ([G, E, F] batches); steps == T >= 1:
 // window mode ([T, G, E, F] batches with a trend-law target).
+// per_step != 0 (window mode only): the target is [T, G, E], one
+// normalized trend-so-far distribution per step — the temporal
+// family's sequence-supervision law.
 void* aga_tl_new(int groups, int endpoints, int features, int capacity,
-                 int n_threads, uint64_t seed, int steps) {
+                 int n_threads, uint64_t seed, int steps, int per_step) {
   if (groups <= 0 || endpoints <= 0 || features <= 0 || capacity <= 0 ||
-      n_threads <= 0 || steps < 0)
+      n_threads <= 0 || steps < 0 || (per_step && steps == 0))
     return nullptr;
   auto* l = new Loader(groups, endpoints, features, capacity);
   l->steps = steps;
+  l->per_step = per_step != 0;
   l->start(n_threads, seed);
   return l;
 }
 
 // Blocking pop into caller-provided buffers: features sized [G*E*F] in
-// snapshot mode (steps == 0) or [steps*G*E*F] in window mode; mask and
-// target always [G*E].  Returns 1 on success, 0 when the loader was
-// stopped.  Called with the GIL released (ctypes), so Python threads
-// park here natively.
+// snapshot mode (steps == 0) or [steps*G*E*F] in window mode; mask
+// always [G*E]; target [G*E], EXCEPT per_step window mode where it is
+// [steps*G*E] — size accordingly or the memcpy overruns the buffer.
+// Returns 1 on success, 0 when the loader was stopped.  Called with
+// the GIL released (ctypes), so Python threads park here natively.
 int aga_tl_next(void* h, float* features, uint8_t* mask, float* target) {
   auto* l = static_cast<Loader*>(h);
   Batch b;
