@@ -524,6 +524,12 @@ def bench_flash_long(t: int = 8192, h: int = 8, d: int = 128) -> dict:
     fwd_s = marginal_s(
         lambda qq: flash_attention(qq, k, v, causal=True), n=256,
         reps=3)
+    # long-context TRAINING headline: the recompute-based custom VJP at
+    # T=8192 — the regime the O(T)-memory backward exists for
+    grad_s = marginal_s(jax.grad(
+        lambda qq: jnp.sum(flash_attention(qq, k, v, causal=True)
+                           .astype(jnp.float32))), n=64, reps=3)
+    grad_flops = flops * 3.5
     peak, kind = _tpu_peak(jax.devices()[0])
     return {
         "device_kind": kind,
@@ -531,6 +537,9 @@ def bench_flash_long(t: int = 8192, h: int = 8, d: int = 128) -> dict:
         "fwd_us": round(fwd_s * 1e6, 1),
         "fwd_tflops": round(flops / fwd_s / 1e12, 2),
         "fwd_mfu_pct": round(100.0 * flops / fwd_s / peak, 2),
+        "grad_us": round(grad_s * 1e6, 1),
+        "grad_tflops": round(grad_flops / grad_s / 1e12, 2),
+        "grad_mfu_pct": round(100.0 * grad_flops / grad_s / peak, 2),
     }
 
 
