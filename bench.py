@@ -765,6 +765,11 @@ def bench_flash_long_subprocess(timeout: float = 300.0) -> dict:
                                   timeout)
 
 
+def bench_smoke_subprocess(timeout: float = 300.0) -> dict:
+    return _json_bench_subprocess("bench_smoke", "tpu compile smoke",
+                                  timeout)
+
+
 def bench_planner(groups: int = 4096, endpoints: int = 128,
                   n: int = 64) -> dict:
     """Fleet-planning throughput: endpoint-groups planned per second
@@ -875,15 +880,22 @@ def main() -> None:
         # the planner bench is backend-agnostic: run it either way
         planner_line = bench_planner_subprocess()
         if status == "tpu":
+            # smoke first: if the tunnel dies mid-run, the compile
+            # gate's verdict is the most valuable single artifact
+            smoke = bench_smoke_subprocess()
             flash = bench_flash_subprocess()
             flash_long = bench_flash_long_subprocess()
             temporal = bench_temporal_subprocess()
         else:
             skip = {"skipped": f"non-tpu backend ({detail})"}
             flash, flash_long, temporal = skip, dict(skip), dict(skip)
+    if status != "tpu":
+        smoke = {"skipped": flash.get("skipped", "")}
+    smoke = _attach_last_live(smoke, "smoke")
     flash = _attach_last_live(flash, "flash")
     flash_long = _attach_last_live(flash_long, "flash-long")
     temporal = _attach_last_live(temporal, "temporal")
+    print(f"tpu compile smoke: {smoke}", file=sys.stderr)
     print(f"tpu flash: {flash}", file=sys.stderr)
     print(f"tpu flash long-context (T=8192): {flash_long}", file=sys.stderr)
     print(f"tpu temporal train: {temporal}", file=sys.stderr)
@@ -899,6 +911,7 @@ def main() -> None:
         # TPU compute track: flash kernel at MXU shapes with an MFU
         # estimate (VERDICT r1 item 2), plus the model-level number --
         # a full temporal-family training step through the flash VJP
+        "tpu_smoke": smoke,
         "tpu_flash": flash,
         "tpu_flash_long": flash_long,
         "tpu_temporal_train": temporal,
@@ -919,8 +932,7 @@ _NAMED = {
     "temporal": bench_temporal_subprocess,
     "autotune": lambda: _json_bench_subprocess(
         "autotune_flash_blocks", "flash block autotune", 1200.0),
-    "smoke": lambda: _json_bench_subprocess(
-        "bench_smoke", "tpu compile smoke", 300.0),
+    "smoke": bench_smoke_subprocess,
     "temporal-breakdown": lambda: _json_bench_subprocess(
         "bench_temporal_breakdown", "tpu temporal cost breakdown",
         600.0),

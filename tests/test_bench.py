@@ -96,7 +96,7 @@ def _main_json(monkeypatch, capsys, status, detail):
     monkeypatch.setattr(
         bench, "bench_planner_subprocess",
         lambda **kw: (planner_calls.append(kw), "planner line")[1])
-    ran = {"flash": 0, "flash_long": 0, "temporal": 0,
+    ran = {"flash": 0, "flash_long": 0, "temporal": 0, "smoke": 0,
            "planner_calls": planner_calls}
 
     def stub(name):
@@ -109,6 +109,7 @@ def _main_json(monkeypatch, capsys, status, detail):
                         stub("flash_long"))
     monkeypatch.setattr(bench, "bench_temporal_subprocess",
                         stub("temporal"))
+    monkeypatch.setattr(bench, "bench_smoke_subprocess", stub("smoke"))
     bench.main()
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1, "main() must print exactly ONE stdout line"
@@ -123,7 +124,9 @@ def test_main_contract_healthy_tpu(monkeypatch, capsys):
     assert data["tpu_flash"] == {"fwd_us": 1.0}
     assert data["tpu_flash_long"] == {"fwd_us": 1.0}
     assert data["tpu_temporal_train"] == {"fwd_us": 1.0}
+    assert data["tpu_smoke"] == {"fwd_us": 1.0}
     assert ran["flash"] == ran["flash_long"] == ran["temporal"] == 1
+    assert ran["smoke"] == 1
     assert ran["planner_calls"] == [{}]  # no cpu pin on a healthy tpu
 
 
@@ -133,7 +136,9 @@ def test_main_contract_dead_backend_still_one_line(monkeypatch, capsys):
     assert "skipped" in data["tpu_flash"]
     assert "skipped" in data["tpu_flash_long"]
     assert "skipped" in data["tpu_temporal_train"]
+    assert "skipped" in data["tpu_smoke"]
     assert ran["flash"] == ran["flash_long"] == ran["temporal"] == 0
+    assert ran["smoke"] == 0
     # the backend-agnostic planner must still run, pinned to cpu
     assert ran["planner_calls"] == [{"force_cpu": True}]
 
