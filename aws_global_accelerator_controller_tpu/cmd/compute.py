@@ -68,12 +68,14 @@ def register(sub) -> None:
                             "(native/telemetry.cpp), higher input "
                             "throughput, not bit-reproducible.")
     train.add_argument("--remat", action="store_true",
-                       help="Rematerialise pipeline stage activations "
-                            "(deep --sharded): jax.checkpoint around "
-                            "each stage block — recompute in the "
-                            "backward instead of saving every "
-                            "schedule step's activations.  Identical "
-                            "numerics, lower HBM.")
+                       help="Rematerialise activations with "
+                            "jax.checkpoint: deep --sharded wraps "
+                            "each pipeline stage block; temporal "
+                            "--supervision sequence wraps the "
+                            "per-step head (the [T, S, H] hidden "
+                            "activations dominate HBM at long "
+                            "windows).  Identical numerics, lower "
+                            "HBM.")
     train.add_argument("--profile", default="", metavar="DIR",
                        help="Capture a jax.profiler trace of the "
                             "training loop into DIR (view with "
@@ -183,7 +185,9 @@ def _build_model(args):
         supervision = getattr(args, "supervision", "last")
         model = TemporalTrafficModel(hidden_dim=args.hidden,
                                      learning_rate=lr,
-                                     supervision=supervision)
+                                     supervision=supervision,
+                                     remat=getattr(args, "remat",
+                                                   False))
 
         if loader_kind == "synthetic":
             def make_data(key):

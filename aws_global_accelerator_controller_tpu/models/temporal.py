@@ -48,7 +48,8 @@ class TemporalTrafficModel(TrainableModel):
 
     def __init__(self, feature_dim: int = 8, embed_dim: int = 32,
                  hidden_dim: int = 64, learning_rate: float = 1e-3,
-                 attention: str = "flash", supervision: str = "last"):
+                 attention: str = "flash", supervision: str = "last",
+                 remat: bool = False):
         """``supervision`` picks the training objective:
 
         - ``"last"`` (default): only the final step's scores are
@@ -63,11 +64,19 @@ class TemporalTrafficModel(TrainableModel):
           regime where the full causal attention (flash kernel, ring
           sharding) is genuinely load-bearing, and the better
           training signal (T targets per window instead of 1).
+
+        ``remat`` wraps the per-step head in ``jax.checkpoint``:
+        under sequence supervision the [T, S, H] hidden activations
+        otherwise sit in HBM for the backward — at long windows they
+        dwarf the flash VJP's O(T) residuals.  Recompute is one relu
+        matmul per step; numerics identical (same f32 ops replayed),
+        the same lever ``deep --remat`` applies to pipeline stages.
         """
         if attention not in ("flash", "flash_always", "reference"):
             raise ValueError(f"unknown attention impl {attention!r}")
         if supervision not in ("last", "sequence"):
             raise ValueError(f"unknown supervision {supervision!r}")
+        self.remat = remat
         self.feature_dim = feature_dim
         self.embed_dim = embed_dim
         self.hidden_dim = hidden_dim
@@ -183,7 +192,9 @@ class TemporalTrafficModel(TrainableModel):
         emb, k, v = self._embed_kv(params, window)
         q = emb @ params["wq"]
         attended = attend(q, k, v)                     # [T, S, D]
-        return self._head(params, attended).reshape(t, g, e)
+        head = (jax.checkpoint(self._head) if self.remat
+                else self._head)
+        return head(params, attended).reshape(t, g, e)
 
     def forward(self, params: Params, window: jax.Array,
                 mask: jax.Array, attend=None) -> jax.Array:

@@ -271,3 +271,28 @@ def test_unknown_supervision_rejected():
 
     with pytest.raises(ValueError, match="supervision"):
         TemporalTrafficModel(supervision="middle")
+
+
+def test_sequence_remat_identical_trajectory():
+    """jax.checkpoint around the per-step head replays the same f32
+    ops, so remat training is numerically identical — only cheaper in
+    activation memory (the deep family's remat law)."""
+    kw = dict(feature_dim=8, embed_dim=16, hidden_dim=32,
+              attention="reference", supervision="sequence")
+    plain = TemporalTrafficModel(**kw)
+    remat = TemporalTrafficModel(remat=True, **kw)
+    params = plain.init_params(jax.random.PRNGKey(0))
+    window, batch = synthetic_window(jax.random.PRNGKey(1), steps=16,
+                                     groups=4, endpoints=8,
+                                     per_step=True)
+    p1, o1 = dict(params), plain.init_opt_state(params)
+    p2, o2 = dict(params), remat.init_opt_state(params)
+    s1 = jax.jit(plain.train_step)
+    s2 = jax.jit(remat.train_step)
+    for _ in range(3):
+        p1, o1, l1 = s1(p1, o1, window, batch)
+        p2, o2, l2 = s2(p2, o2, window, batch)
+        assert float(l1) == float(l2)
+    for k in p1:
+        np.testing.assert_array_equal(np.asarray(p1[k]),
+                                      np.asarray(p2[k]), err_msg=k)
