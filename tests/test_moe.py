@@ -305,3 +305,34 @@ def test_top_k_validation():
         MoETrafficModel(n_experts=4, top_k=5)
     with pytest.raises(ValueError, match="top_k"):
         MoETrafficModel(n_experts=4, top_k=0)
+
+
+def test_top_k_equals_n_experts_with_capacity():
+    """k == n edge: every group routes to EVERY expert; capacity then
+    bounds per-expert load at bs and the k-major priority decides who
+    drops.  Dense math must stay finite and valid."""
+    m = MoETrafficModel(n_experts=2, hidden_dim=16, top_k=2,
+                        capacity_factor=0.5)
+    params = m.init_params(jax.random.PRNGKey(0))
+    batch = synthetic_moe_batch(jax.random.PRNGKey(1), groups=8,
+                                endpoints=4, n_regions=2)
+    # cap = ceil(0.5 * 8 * 2 / 2) = 4 < bs=8: both experts overflow
+    stats = m.dispatch_stats(params, batch.features, batch.mask)
+    assert int(stats["dropped"]) > 0
+    s = np.asarray(m.scores(params, batch.features, batch.mask))
+    assert np.isfinite(s).all()
+    w = np.asarray(m.forward(params, batch.features, batch.mask))
+    assert (w >= 0).all() and (w <= 255).all()
+
+
+def test_keep_mask_multi_block_independence():
+    """capacity_blocks partitions groups: each block gets its own
+    budget, so a hot expert in block 0 cannot starve block 1."""
+    m = MoETrafficModel(n_experts=2, top_k=1, capacity_factor=1.0,
+                        capacity_blocks=2)
+    # block 0: both groups -> expert 0 (cap=ceil(1*2*1/2)=1: one drops)
+    # block 1: split routing (no drops)
+    routes = jnp.array([[0], [0], [0], [1]], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(m.keep_mask(routes)),
+        [[True], [False], [True], [True]])

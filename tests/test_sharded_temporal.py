@@ -186,3 +186,49 @@ def test_make_last_attention_matches_reference():
     got = np.asarray(fn(q[-1], k, v))
     want = np.asarray(attention_last_reference(q[-1], k, v))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_sequence_remat_matches_plain():
+    """remat composes with the sharded sequence step (the model's
+    scores_seq carries the checkpoint; the planner inherits it):
+    bitwise-identical losses."""
+    kw = dict(feature_dim=8, embed_dim=16, hidden_dim=32,
+              attention="reference", supervision="sequence")
+    plain = TemporalTrafficModel(**kw)
+    remat = TemporalTrafficModel(remat=True, **kw)
+    params = plain.init_params(jax.random.PRNGKey(41))
+    window, batch = synthetic_window(jax.random.PRNGKey(42), steps=8,
+                                     groups=4, endpoints=4,
+                                     per_step=True)
+    mesh = _mesh(4, 2)
+    pl_p = ShardedTemporalPlanner(plain, mesh)
+    pl_r = ShardedTemporalPlanner(remat, mesh)
+    p1, o1 = pl_p.shard_params(params), plain.init_opt_state(params)
+    p2, o2 = pl_r.shard_params(params), remat.init_opt_state(params)
+    sw1, sb1 = pl_p.shard_window(window), pl_p.shard_batch(batch)
+    sw2, sb2 = pl_r.shard_window(window), pl_r.shard_batch(batch)
+    for _ in range(3):
+        p1, o1, l1 = pl_p.train_step(p1, o1, sw1, sb1)
+        p2, o2, l2 = pl_r.train_step(p2, o2, sw2, sb2)
+        assert float(l1) == float(l2)
+
+
+def test_make_last_attention_without_head_axis():
+    """head_axis=None (heads replicated): the 1-D seq-only mesh path."""
+    from aws_global_accelerator_controller_tpu.models.temporal import (
+        attention_last_reference,
+    )
+    from aws_global_accelerator_controller_tpu.parallel import (
+        make_last_attention,
+    )
+    from aws_global_accelerator_controller_tpu.parallel.ring import (
+        make_mesh_1d,
+    )
+
+    mesh = make_mesh_1d(8, "seq")
+    ks = jax.random.split(jax.random.PRNGKey(51), 3)
+    q, k, v = (jax.random.normal(kk, (16, 4, 8)) for kk in ks)
+    fn = make_last_attention(mesh, "seq")
+    got = np.asarray(fn(q[-1], k, v))
+    want = np.asarray(attention_last_reference(q[-1], k, v))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
