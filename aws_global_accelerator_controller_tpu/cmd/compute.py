@@ -441,6 +441,18 @@ def _run_train(args) -> int:
     from ..jaxenv import import_jax
     jax = import_jax()
 
+    from ..signals import ScopedStopSignal
+
+    with ScopedStopSignal() as stop:
+        return _run_train_loop(args, jax, stop)
+
+
+def _run_train_loop(args, jax, stop) -> int:
+    # preemption safety (ScopedStopSignal in _run_train): SIGTERM/
+    # SIGINT (k8s eviction, TPU-pod maintenance) breaks the loop
+    # cleanly so the final checkpoint save below runs — training
+    # resumes from the exact step instead of losing everything since
+    # the last --save-every; a second signal still hard-exits
     from ..models.checkpoint import TrainCheckpointer
 
     model, run_step, _ = _build_model(args)
@@ -468,8 +480,15 @@ def _run_train(args) -> int:
     # without --guard it advances every iteration, as before
     step_label = start_step
     loss = None  # last ACCEPTED step's loss (never non-finite)
+    preempted = False
     try:
         for batch_idx in range(start_step, start_step + args.steps):
+            if stop.is_set():
+                preempted = True
+                logger.info(
+                    "stop signal: checkpointing at step %d and "
+                    "exiting cleanly", step_label)
+                break
             new_params, new_opt, new_loss = run_step(
                 params, opt_state, jax.random.fold_in(key, batch_idx))
             if guard and not _finite(new_loss):
@@ -515,7 +534,8 @@ def _run_train(args) -> int:
         ckpt.close()
     print(json.dumps({"step": step_label, "model": args.model,
                       "loss": float(loss) if loss is not None else None,
-                      "backend": jax.default_backend()}))
+                      "backend": jax.default_backend(),
+                      **({"preempted": True} if preempted else {})}))
     return 0
 
 

@@ -29,3 +29,36 @@ def setup_signal_handler() -> threading.Event:
     signal.signal(signal.SIGINT, handler)
     signal.signal(signal.SIGTERM, handler)
     return stop
+
+
+class ScopedStopSignal:
+    """Context-managed SIGINT/SIGTERM -> stop-event translation that
+    RESTORES the previous handlers on exit — for bounded entry points
+    (the train CLI) that may run several times in one process and must
+    not permanently hijack the host's handlers (pytest's
+    KeyboardInterrupt, an embedding application's own shutdown).  A
+    second signal while stopping still hard-exits, like
+    ``setup_signal_handler``.  Off the main thread (where signal
+    registration is illegal) it degrades to a never-set event."""
+
+    def __init__(self):
+        self.stop = threading.Event()
+        self._prev: "dict | None" = {}
+
+    def __enter__(self) -> threading.Event:
+        def handler(signum, frame):
+            if self.stop.is_set():
+                os._exit(1)
+            self.stop.set()
+
+        try:
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                self._prev[sig] = signal.signal(sig, handler)
+        except ValueError:  # not the main thread
+            self._prev = None
+        return self.stop
+
+    def __exit__(self, *exc) -> None:
+        if self._prev:
+            for sig, prev in self._prev.items():
+                signal.signal(sig, prev)
