@@ -117,6 +117,47 @@ def register(sub) -> None:
                             "mesh with all_to_all dispatch; deep -> "
                             "stage pipeline (GPipe).")
 
+    ev = sub.add_parser(
+        "eval", help="Evaluate a checkpoint on held-out synthetic "
+                     "fleets (JSON out)")
+    ev.add_argument("--model", choices=("mlp", "temporal", "moe",
+                                        "deep"),
+                    default="mlp",
+                    help="Must match the model the ckpt was trained "
+                         "with.")
+    ev.add_argument("--ckpt", default="",
+                    help="Checkpoint directory (default: fresh init — "
+                         "the untrained baseline).")
+    ev.add_argument("--batches", type=int, default=16,
+                    help="Held-out batches to average over.")
+    ev.add_argument("--groups", type=int, default=64,
+                    help="Endpoint groups per eval batch.")
+    ev.add_argument("--endpoints", type=int, default=16,
+                    help="Endpoints per group.")
+    ev.add_argument("--hidden", type=int, default=128,
+                    help="Model hidden width (must match the ckpt).")
+    ev.add_argument("--window", type=int, default=64,
+                    help="Telemetry window length (temporal).")
+    ev.add_argument("--experts", type=int, default=4,
+                    help="Expert count (moe; must match the ckpt).")
+    ev.add_argument("--top-k", type=int, default=1, dest="top_k",
+                    help="Experts per group (moe; must match the "
+                         "ckpt's training config).")
+    ev.add_argument("--capacity-factor", type=float, default=None,
+                    dest="capacity_factor",
+                    help="Per-expert budget (moe; must match the "
+                         "ckpt's training config).")
+    ev.add_argument("--stages", type=int, default=4,
+                    help="Stage count (deep; must match the ckpt).")
+    ev.add_argument("--microbatches", type=int, default=4,
+                    help="GPipe microbatches (deep).")
+    ev.add_argument("--supervision", choices=("last", "sequence"),
+                    default="last",
+                    help="Temporal objective to evaluate under.")
+    ev.add_argument("--seed", type=int, default=0,
+                    help="PRNG seed; eval batches use an offset "
+                         "stream disjoint from training's.")
+
     plan = sub.add_parser(
         "plan", help="Plan GA endpoint weights for a fleet (JSON out)")
     plan.add_argument("--model",
@@ -543,6 +584,117 @@ def _finite(loss) -> bool:
     import math
 
     return math.isfinite(float(loss))
+
+
+def run_eval(args) -> int:
+    """Held-out evaluation: mean loss + plan quality on fresh
+    synthetic fleets drawn from a key stream disjoint from training's.
+
+    Plan quality is the masked L1 distance between the NORMALIZED
+    integer weight plan and the target weight distribution, with the
+    uniform-over-valid plan as the baseline a trained model must beat
+    — the number an operator checks before pointing
+    ``controller --policy-checkpoint`` at a checkpoint."""
+    import numpy as np
+
+    from ..jaxenv import import_jax
+
+    jax = import_jax()
+    import jax.numpy as jnp
+
+    model, _, _ = _build_model(args)
+    step = 0
+    if args.ckpt:
+        from ..models.checkpoint import TrainCheckpointer
+
+        with TrainCheckpointer(args.ckpt, create=False) as ckpt:
+            step, params, _unused = ckpt.restore(model)
+        logger.info("evaluating step-%d params from %s", step,
+                    args.ckpt)
+    else:
+        params = model.init_params(jax.random.PRNGKey(args.seed))
+
+    temporal = args.model == "temporal"
+    if temporal:
+        from ..models.temporal import synthetic_window
+
+        def make(key):
+            return synthetic_window(
+                key, steps=args.window, groups=args.groups,
+                endpoints=args.endpoints,
+                per_step=model.supervision == "sequence")
+
+        loss_fn = jax.jit(model.loss)
+        fwd = jax.jit(model.forward)
+    else:
+        if args.model == "moe":
+            from ..models.moe import synthetic_moe_batch
+
+            def make(key):
+                return synthetic_moe_batch(
+                    key, groups=args.groups,
+                    endpoints=args.endpoints,
+                    n_regions=args.experts)
+        else:
+            from ..models.traffic import synthetic_batch
+
+            def make(key):
+                return synthetic_batch(key, groups=args.groups,
+                                       endpoints=args.endpoints)
+
+        loss_fn = jax.jit(model.loss)
+        fwd = jax.jit(model.forward)
+
+    @jax.jit
+    def plan_l1(weights, mask, target):
+        w = weights.astype(jnp.float32)
+        denom = jnp.sum(jnp.where(mask, w, 0.0), axis=-1,
+                        keepdims=True)
+        p = jnp.where(mask & (denom > 0), w / jnp.maximum(denom, 1.0),
+                      0.0)
+        valid = jnp.sum(mask, axis=-1, keepdims=True)
+        uniform = jnp.where(mask, 1.0 / jnp.maximum(valid, 1), 0.0)
+        l1 = jnp.sum(jnp.abs(p - target) * mask, axis=-1)
+        u1 = jnp.sum(jnp.abs(uniform - target) * mask, axis=-1)
+        any_valid = jnp.any(mask, axis=-1)
+        n = jnp.maximum(jnp.sum(any_valid), 1)
+        return (jnp.sum(jnp.where(any_valid, l1, 0.0)) / n,
+                jnp.sum(jnp.where(any_valid, u1, 0.0)) / n)
+
+    losses, l1s, u1s = [], [], []
+    base = jax.random.fold_in(jax.random.PRNGKey(args.seed), 10_000)
+    for i in range(args.batches):
+        key = jax.random.fold_in(base, i)
+        if temporal:
+            window, batch = make(key)
+            losses.append(float(loss_fn(params, window, batch)))
+            weights = fwd(params, window, batch.mask)
+            # plan quality is a LAST-step notion; under sequence
+            # supervision compare against the final step's target
+            target = (batch.target[-1]
+                      if model.supervision == "sequence"
+                      else batch.target)
+        else:
+            batch = make(key)
+            losses.append(float(loss_fn(params, batch)))
+            weights = fwd(params, batch.features, batch.mask)
+            target = batch.target
+        l1, u1 = plan_l1(weights, batch.mask, target)
+        l1s.append(float(l1))
+        u1s.append(float(u1))
+
+    out = {
+        "model": args.model,
+        "step": step,
+        "batches": args.batches,
+        "mean_loss": round(float(np.mean(losses)), 6),
+        "plan_l1": round(float(np.mean(l1s)), 6),
+        "uniform_l1": round(float(np.mean(u1s)), 6),
+        "beats_uniform": bool(np.mean(l1s) < np.mean(u1s)),
+    }
+    json.dump(out, sys.stdout)
+    print()
+    return 0
 
 
 def run_plan(args) -> int:

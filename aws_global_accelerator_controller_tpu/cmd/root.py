@@ -390,4 +390,6 @@ def main(argv=None) -> int:
         return compute.run_train(args)
     if args.command == "plan":
         return compute.run_plan(args)
+    if args.command == "eval":
+        return compute.run_eval(args)
     return 2

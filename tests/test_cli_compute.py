@@ -415,3 +415,43 @@ def test_scoped_stop_signal_sets_event_and_restores_handlers():
         assert stop.is_set()
     assert signal_mod.getsignal(signal_mod.SIGINT) is before_int
     assert signal_mod.getsignal(signal_mod.SIGTERM) is before_term
+
+
+def test_eval_reports_plan_quality(tmp_path, capsys):
+    """eval: a trained checkpoint beats the uniform-plan baseline on
+    held-out fleets; the fresh init does not — the go/no-go an
+    operator runs before pointing --policy-checkpoint at it."""
+    ckpt = str(tmp_path / "ck")
+    assert main(["train", "--steps", "200", "--ckpt", ckpt,
+                 "--groups", "32", "--endpoints", "8",
+                 "--hidden", "32"]) == 0
+    capsys.readouterr()
+    assert main(["eval", "--ckpt", ckpt, "--groups", "32",
+                 "--endpoints", "8", "--hidden", "32",
+                 "--batches", "8"]) == 0
+    trained = json.loads(capsys.readouterr().out.strip()
+                         .splitlines()[-1])
+    assert trained["step"] == 200
+    assert trained["beats_uniform"] is True
+    assert trained["plan_l1"] < trained["uniform_l1"]
+
+    assert main(["eval", "--groups", "32", "--endpoints", "8",
+                 "--hidden", "32", "--batches", "8"]) == 0
+    fresh = json.loads(capsys.readouterr().out.strip()
+                       .splitlines()[-1])
+    assert fresh["step"] == 0
+    assert fresh["plan_l1"] > trained["plan_l1"]
+
+
+def test_eval_covers_other_families(capsys):
+    for extra in (["--model", "temporal", "--window", "8"],
+                  ["--model", "moe", "--experts", "2"],
+                  ["--model", "deep", "--stages", "2"]):
+        assert main(["eval", *extra, "--groups", "8",
+                     "--endpoints", "4", "--hidden", "16",
+                     "--batches", "2"]) == 0
+        out = json.loads(capsys.readouterr().out.strip()
+                         .splitlines()[-1])
+        assert out["batches"] == 2
+        import math
+        assert math.isfinite(out["mean_loss"])
