@@ -1,11 +1,13 @@
-"""CLI subcommands for the TPU compute track: train | plan.
+"""CLI subcommands for the TPU compute track: train | eval | plan.
 
 The reference CLI has only {controller|webhook|version} (cmd/root.go:
 13-30) because the reference has no compute.  These commands make the
 compute track user-facing: ``train`` fits the traffic policy model on
-synthetic fleet telemetry with orbax checkpointing (resumable), ``plan``
-loads a checkpoint (or a fresh init) and emits Global Accelerator
-endpoint weights for a fleet as JSON.
+synthetic fleet telemetry with orbax checkpointing (resumable,
+preemption-safe), ``eval`` scores a checkpoint on held-out fleets
+(mean loss + plan-quality L1 vs the uniform baseline), ``plan`` loads
+a checkpoint (or a fresh init) and emits Global Accelerator endpoint
+weights for a fleet as JSON.
 
 JAX is imported lazily inside the run functions so `controller`/
 `webhook`/`version` never pay for (or hang on) accelerator backend
@@ -599,6 +601,8 @@ def run_eval(args) -> int:
 
     from ..jaxenv import import_jax
 
+    if args.batches < 1:
+        raise SystemExit("--batches must be >= 1")
     jax = import_jax()
     import jax.numpy as jnp
 
@@ -623,9 +627,6 @@ def run_eval(args) -> int:
                 key, steps=args.window, groups=args.groups,
                 endpoints=args.endpoints,
                 per_step=model.supervision == "sequence")
-
-        loss_fn = jax.jit(model.loss)
-        fwd = jax.jit(model.forward)
     else:
         if args.model == "moe":
             from ..models.moe import synthetic_moe_batch
@@ -642,8 +643,8 @@ def run_eval(args) -> int:
                 return synthetic_batch(key, groups=args.groups,
                                        endpoints=args.endpoints)
 
-        loss_fn = jax.jit(model.loss)
-        fwd = jax.jit(model.forward)
+    loss_fn = jax.jit(model.loss)
+    fwd = jax.jit(model.forward)
 
     @jax.jit
     def plan_l1(weights, mask, target):
