@@ -101,6 +101,11 @@ def register(sub) -> None:
                        help="Checkpoint directory (enables save/resume).")
     train.add_argument("--save-every", type=int, default=50,
                        help="Checkpoint cadence in steps.")
+    train.add_argument("--eval-every", type=int, default=0,
+                       dest="eval_every",
+                       help="Log held-out loss every N applied steps "
+                            "(a fixed eval batch from a key stream "
+                            "disjoint from training's; 0 disables).")
     train.add_argument("--groups", type=int, default=256,
                        help="Endpoint groups per synthetic batch.")
     train.add_argument("--endpoints", type=int, default=32,
@@ -516,6 +521,15 @@ def _run_train_loop(args, jax, stop) -> int:
         # TensorBoard / xprof
         jax.profiler.start_trace(profile_dir)
     guard = getattr(args, "guard", False)
+    eval_every = max(getattr(args, "eval_every", 0) or 0, 0)
+    eval_data, eval_loss = None, None
+    if eval_every:
+        make, eval_loss, _fwd = _eval_fns(args, model, jax)
+        # double fold: the training stream is fold_in(key, batch_idx),
+        # so a single fold_in(key, 10_000) would COLLIDE with training
+        # batch 10_000 (run_eval uses the same double-folded stream)
+        eval_data = make(jax.random.fold_in(jax.random.fold_in(
+            jax.random.PRNGKey(args.seed), 10_000), 0))
     max_restores, restores = 5, 0
     # step_label counts APPLIED optimizer updates: checkpoint labels
     # and the reported step stay truthful under --guard rollbacks
@@ -559,6 +573,10 @@ def _run_train_loop(args, jax, stop) -> int:
             if (ckpt is not None and args.save_every > 0
                     and step_label % args.save_every == 0):
                 ckpt.save(step_label, params, opt_state)
+            if eval_every and step_label % eval_every == 0:
+                logger.info(
+                    "step %d eval_loss %.5f", step_label,
+                    float(eval_loss(params, *eval_data)))
             if (batch_idx + 1 - start_step) % max(
                     1, args.steps // 10) == 0:
                 logger.info("step %d loss %.5f", step_label,
@@ -586,6 +604,38 @@ def _finite(loss) -> bool:
     import math
 
     return math.isfinite(float(loss))
+
+
+def _eval_fns(args, model, jax):
+    """(make(key) -> loss-argument tuple, jitted loss, jitted forward)
+    for the family ``args`` selects — the single place the held-out
+    batch law lives, shared by ``eval`` and ``train --eval-every``.
+    ``make`` always returns the tuple ``loss(params, *data)`` expects
+    (temporal: (window, batch); snapshot families: (batch,)), so
+    callers never re-dispatch per family."""
+    if args.model == "temporal":
+        from ..models.temporal import synthetic_window
+
+        def make(key):
+            return synthetic_window(
+                key, steps=args.window, groups=args.groups,
+                endpoints=args.endpoints,
+                per_step=model.supervision == "sequence")
+    elif args.model == "moe":
+        from ..models.moe import synthetic_moe_batch
+
+        def make(key):
+            return (synthetic_moe_batch(
+                key, groups=args.groups, endpoints=args.endpoints,
+                n_regions=args.experts),)
+    else:
+        from ..models.traffic import synthetic_batch
+
+        def make(key):
+            return (synthetic_batch(key, groups=args.groups,
+                                    endpoints=args.endpoints),)
+
+    return make, jax.jit(model.loss), jax.jit(model.forward)
 
 
 def run_eval(args) -> int:
@@ -619,32 +669,7 @@ def run_eval(args) -> int:
         params = model.init_params(jax.random.PRNGKey(args.seed))
 
     temporal = args.model == "temporal"
-    if temporal:
-        from ..models.temporal import synthetic_window
-
-        def make(key):
-            return synthetic_window(
-                key, steps=args.window, groups=args.groups,
-                endpoints=args.endpoints,
-                per_step=model.supervision == "sequence")
-    else:
-        if args.model == "moe":
-            from ..models.moe import synthetic_moe_batch
-
-            def make(key):
-                return synthetic_moe_batch(
-                    key, groups=args.groups,
-                    endpoints=args.endpoints,
-                    n_regions=args.experts)
-        else:
-            from ..models.traffic import synthetic_batch
-
-            def make(key):
-                return synthetic_batch(key, groups=args.groups,
-                                       endpoints=args.endpoints)
-
-    loss_fn = jax.jit(model.loss)
-    fwd = jax.jit(model.forward)
+    make, loss_fn, fwd = _eval_fns(args, model, jax)
 
     @jax.jit
     def plan_l1(weights, mask, target):
@@ -665,19 +690,17 @@ def run_eval(args) -> int:
     losses, l1s, u1s = [], [], []
     base = jax.random.fold_in(jax.random.PRNGKey(args.seed), 10_000)
     for i in range(args.batches):
-        key = jax.random.fold_in(base, i)
+        data = make(jax.random.fold_in(base, i))
+        batch = data[-1]
+        losses.append(float(loss_fn(params, *data)))
         if temporal:
-            window, batch = make(key)
-            losses.append(float(loss_fn(params, window, batch)))
-            weights = fwd(params, window, batch.mask)
+            weights = fwd(params, data[0], batch.mask)
             # plan quality is a LAST-step notion; under sequence
             # supervision compare against the final step's target
             target = (batch.target[-1]
                       if model.supervision == "sequence"
                       else batch.target)
         else:
-            batch = make(key)
-            losses.append(float(loss_fn(params, batch)))
             weights = fwd(params, batch.features, batch.mask)
             target = batch.target
         l1, u1 = plan_l1(weights, batch.mask, target)

@@ -455,3 +455,17 @@ def test_eval_covers_other_families(capsys):
         assert out["batches"] == 2
         import math
         assert math.isfinite(out["mean_loss"])
+
+
+def test_train_eval_every_logs_heldout_loss(tmp_path, capsys, caplog):
+    import logging
+
+    with caplog.at_level(
+            logging.INFO,
+            logger="aws_global_accelerator_controller_tpu.cmd.compute"):
+        assert main(["train", "--steps", "4", "--groups", "8",
+                     "--endpoints", "4", "--hidden", "16",
+                     "--eval-every", "2"]) == 0
+    capsys.readouterr()
+    evals = [r for r in caplog.records if "eval_loss" in r.getMessage()]
+    assert len(evals) == 2  # steps 2 and 4
