@@ -106,6 +106,15 @@ def register(sub) -> None:
                        help="Log held-out loss every N applied steps "
                             "(a fixed eval batch from a key stream "
                             "disjoint from training's; 0 disables).")
+    train.add_argument("--preempt-exit", type=int, default=0,
+                       dest="preempt_exit",
+                       help="Exit code after a SIGTERM-triggered "
+                            "clean checkpoint (default 0).  Under a "
+                            "k8s Job with restartPolicy OnFailure, "
+                            "pass a nonzero code (75 = EX_TEMPFAIL) "
+                            "so an interrupted run restarts and "
+                            "resumes instead of being recorded as "
+                            "complete.")
     train.add_argument("--groups", type=int, default=256,
                        help="Endpoint groups per synthetic batch.")
     train.add_argument("--endpoints", type=int, default=32,
@@ -154,6 +163,14 @@ def register(sub) -> None:
                     dest="capacity_factor",
                     help="Per-expert budget (moe; must match the "
                          "ckpt's training config).")
+    ev.add_argument("--capacity-blocks", type=int, default=None,
+                    dest="capacity_blocks",
+                    help="Capacity enforcement granularity (moe): the "
+                         "device count the ckpt trained --sharded on "
+                         "(capacity is per dispatch block, so eval "
+                         "must match it to score the same routing "
+                         "function).  Default: 1 (unsharded "
+                         "training).")
     ev.add_argument("--stages", type=int, default=4,
                     help="Stage count (deep; must match the ckpt).")
     ev.add_argument("--microbatches", type=int, default=4,
@@ -285,12 +302,15 @@ def _build_model(args):
         from ..models.moe import MoETrafficModel, synthetic_moe_batch
 
         cf = getattr(args, "capacity_factor", None)
-        blocks = 1
-        if cf is not None and sharded:
-            # capacity is enforced per dispatch block: the model's
-            # block granularity must match the batch shard count
-            # (ShardedMoEPlanner validates the same law)
-            blocks = len(jax.devices())
+        # capacity is enforced per dispatch block: the model's block
+        # granularity must match the batch shard count
+        # (ShardedMoEPlanner validates the same law); eval passes
+        # --capacity-blocks explicitly to score a sharded-trained
+        # checkpoint's exact routing function
+        blocks = getattr(args, "capacity_blocks", None)
+        if blocks is None:
+            blocks = (len(jax.devices())
+                      if cf is not None and sharded else 1)
         model = MoETrafficModel(n_experts=args.experts,
                                 hidden_dim=args.hidden,
                                 learning_rate=lr,
@@ -597,6 +617,14 @@ def _run_train_loop(args, jax, stop) -> int:
                       "loss": float(loss) if loss is not None else None,
                       "backend": jax.default_backend(),
                       **({"preempted": True} if preempted else {})}))
+    # --preempt-exit lets a k8s Job distinguish "cut short" from
+    # "complete": with restartPolicy OnFailure an exit-0 preemption
+    # would mark the Job Succeeded at step 100 of 5000 and training
+    # would never resume (config/samples/train-job.yaml passes 75,
+    # EX_TEMPFAIL, so the kubelet restarts the container and the run
+    # resumes from the checkpoint); the interactive default stays 0
+    if preempted:
+        return getattr(args, "preempt_exit", 0)
     return 0
 
 
@@ -659,10 +687,21 @@ def run_eval(args) -> int:
     model, _, _ = _build_model(args)
     step = 0
     if args.ckpt:
+        import os
+
         from ..models.checkpoint import TrainCheckpointer
 
-        with TrainCheckpointer(args.ckpt, create=False) as ckpt:
-            step, params, _unused = ckpt.restore(model)
+        if not os.path.isdir(args.ckpt):
+            raise SystemExit(
+                f"--ckpt: no checkpoint found under {args.ckpt}")
+        try:
+            with TrainCheckpointer(args.ckpt, create=False) as ckpt:
+                step, params, _unused = ckpt.restore(model)
+        except (OSError, ValueError) as e:
+            # same posture as --policy-checkpoint: a bad artifact gets
+            # a named CLI error, not a raw orbax traceback
+            raise SystemExit(f"--ckpt: failed to restore from "
+                             f"{args.ckpt}: {e}")
         logger.info("evaluating step-%d params from %s", step,
                     args.ckpt)
     else:

@@ -327,13 +327,17 @@ def bench_temporal_train(t: int = 2048, g: int = 8, e: int = 16,
                          (params, opt_state), n)
 
     s = g * e
-    dense_fwd = 2.0 * t * s * d * (f + 3 * d)
+    # sequence supervision runs the head over ALL T rows (2*S*(D*H+H)
+    # per row) — counted, since those rows are supervised useful work
+    head_fwd = 2.0 * s * (d * h + h)
+    dense_fwd = 2.0 * t * s * d * (f + 3 * d) + t * head_fwd
     attn_fwd = 2.0 * t * t * d * s
     train_flops = 3.0 * dense_fwd + 3.5 * attn_fwd
     # the last-supervised step's useful FLOPs: embed + K/V projections
-    # over all T but the q projection only for the final row, and
-    # one-row attention (2*T*D*S for QK^T and again for PV)
-    last_dense_fwd = 2.0 * t * s * d * (f + 2 * d) + 2.0 * s * d * d
+    # over all T but the q projection and head only for the final row,
+    # and one-row attention (2*T*D*S for QK^T and again for PV)
+    last_dense_fwd = (2.0 * t * s * d * (f + 2 * d)
+                      + 2.0 * s * d * d + head_fwd)
     last_flops = 3.0 * last_dense_fwd + 3.0 * (4.0 * t * d * s)
     peak, kind = _tpu_peak(jax.devices()[0])
     return {

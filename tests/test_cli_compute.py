@@ -469,3 +469,50 @@ def test_train_eval_every_logs_heldout_loss(tmp_path, capsys, caplog):
     capsys.readouterr()
     evals = [r for r in caplog.records if "eval_loss" in r.getMessage()]
     assert len(evals) == 2  # steps 2 and 4
+
+
+def test_preempt_exit_code_flag(tmp_path):
+    """--preempt-exit: a SIGTERM-interrupted run exits with the
+    configured code (the k8s Job restart contract) while still
+    checkpointing; default stays 0 (tested above)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ckpt = tmp_path / "ck"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "aws_global_accelerator_controller_tpu",
+         "train", "--model", "mlp", "--steps", "100000",
+         "--groups", "16", "--endpoints", "4", "--hidden", "16",
+         "--ckpt", str(ckpt), "--save-every", "50",
+         "--preempt-exit", "75"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=repo)
+    try:
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            if ckpt.exists() and any(ckpt.iterdir()):
+                break
+            time.sleep(0.25)
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 75, (proc.returncode, err[-1000:])
+    line = json.loads(out.strip().splitlines()[-1])
+    assert line["preempted"] is True and line["step"] > 0
+
+
+def test_eval_bad_ckpt_is_a_clean_cli_error(tmp_path, capsys):
+    import pytest
+
+    with pytest.raises(SystemExit, match="no checkpoint found"):
+        main(["eval", "--ckpt", str(tmp_path / "polcy"),
+              "--groups", "8", "--endpoints", "4", "--hidden", "16"])
