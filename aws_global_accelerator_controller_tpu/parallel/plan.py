@@ -21,6 +21,8 @@ inserts the data-axis gradient all-reduce.
 """
 from __future__ import annotations
 
+from typing import Sequence
+
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -96,13 +98,23 @@ class ShardedTemporalPlanner:
     """
 
     def __init__(self, model: TemporalTrafficModel, mesh: Mesh,
-                 data_axis: str = "data", seq_axis: str = "seq",
+                 data_axis: "str | Sequence[str]" = "data",
+                 seq_axis: str = "seq",
                  local: "str | None" = None,
                  window: "int | None" = None):
         from ..models.temporal import FLASH_MIN_WINDOW
 
         self.model = model
         self.mesh = mesh
+        # data_axis may name several mesh axes (a DCN-outer replica
+        # axis plus the local data tile from make_hybrid_mesh, like
+        # ShardedMoEPlanner) — groups shard over all of them while the
+        # ring/all_gather collectives stay on the seq axis, so
+        # cross-host traffic is only the gradient all-reduce
+        data_axes = ((data_axis,) if isinstance(data_axis, str)
+                     else tuple(data_axis))
+        data_axis = (data_axes if len(data_axes) > 1
+                     else data_axes[0])
         if local is None:
             on_tpu = jax.default_backend() == "tpu"
             want_flash = (model.attention == "flash_always"

@@ -109,3 +109,50 @@ def test_moe_planner_over_hybrid_mesh():
     assert float(loss) == pytest.approx(dense_loss, rel=1e-3)
     got = np.asarray(planner.forward(sp, sb.features, sb.mask))
     assert got.shape == (32, 8)
+
+
+def test_temporal_planner_over_hybrid_mesh():
+    """The temporal planner composes with the multi-host mesh helper:
+    DCN-outer replica axis (size 1 single-process — the same program
+    scales out unchanged) plus an ICI data x seq tile; both
+    supervision modes train, and serving's last-query merge stays on
+    the seq axis."""
+    from aws_global_accelerator_controller_tpu.models.temporal import (
+        TemporalTrafficModel,
+        synthetic_window,
+    )
+    from aws_global_accelerator_controller_tpu.parallel import (
+        ShardedTemporalPlanner,
+        make_hybrid_mesh,
+    )
+
+    mesh = make_hybrid_mesh(dcn_axes=("dcn_data",),
+                            ici_axes=("data", "seq"),
+                            ici_shape=(2, 4))
+    for supervision in ("last", "sequence"):
+        model = TemporalTrafficModel(feature_dim=8, embed_dim=16,
+                                     hidden_dim=32,
+                                     attention="reference",
+                                     supervision=supervision)
+        planner = ShardedTemporalPlanner(
+            model, mesh, data_axis=("dcn_data", "data"))
+        params = model.init_params(jax.random.PRNGKey(0))
+        window, batch = synthetic_window(
+            jax.random.PRNGKey(1), steps=8, groups=4, endpoints=4,
+            per_step=supervision == "sequence")
+        sp = planner.shard_params(params)
+        so = model.init_opt_state(sp)
+        sw = planner.shard_window(window)
+        sb = planner.shard_batch(batch)
+        sp, so, loss = planner.train_step(sp, so, sw, sb)
+        dense_step = jax.jit(model.train_step)
+        _, _, dense_loss = dense_step(params,
+                                      model.init_opt_state(params),
+                                      window, batch)
+        np.testing.assert_allclose(float(loss), float(dense_loss),
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=supervision)
+        weights = planner.forward(sp, sw, batch.mask)
+        w = np.asarray(weights)
+        assert w.shape == (4, 4)
+        assert (w >= 0).all() and (w <= 255).all()
