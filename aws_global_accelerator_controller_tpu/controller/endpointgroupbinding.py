@@ -275,6 +275,14 @@ class EndpointGroupBindingController:
         # allocate per-endpoint weights for spec.weight: null bindings)
         planned = self.weight_policy.plan(obj, endpoint_group,
                                           list(arns))
+        if arns:
+            from ..metrics import record_weight_plan
+
+            record_weight_plan(
+                type(self.weight_policy).__name__,
+                "spec" if obj.spec.weight is not None else "model"
+                if planned.get(next(iter(arns))) is not None else
+                "default")
         for endpoint_id in arns:
             provider.update_endpoint_weight(
                 endpoint_group, endpoint_id,

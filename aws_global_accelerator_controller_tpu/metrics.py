@@ -134,6 +134,10 @@ default_registry.describe(
 default_registry.describe(
     "exec_credential_runs_total",
     "Exec credential plugin invocations by outcome (ok / error).")
+default_registry.describe(
+    "weight_plans_total",
+    "Endpoint-group weight plans applied, by policy implementation "
+    "and value source (spec / model).")
 
 
 def record_watch_event(kind: str, event: str,
@@ -150,6 +154,18 @@ def record_exec_credential_run(outcome: str,
                                registry: Optional[Registry] = None) -> None:
     reg = registry or default_registry
     reg.inc_counter("exec_credential_runs_total", {"outcome": outcome})
+
+
+def record_weight_plan(policy: str, source: str,
+                       registry: Optional[Registry] = None) -> None:
+    """One endpoint-group weight plan applied: ``policy`` names the
+    implementation class, ``source`` whether the values came from the
+    explicit spec.weight or the model (the compute track being
+    load-bearing in production is worth a counter an operator can
+    watch move)."""
+    reg = registry or default_registry
+    reg.inc_counter("weight_plans_total",
+                    {"policy": policy, "source": source})
 
 
 def record_sync(queue_name: str, result: str, duration: float,
