@@ -697,9 +697,11 @@ def run_eval(args) -> int:
         try:
             with TrainCheckpointer(args.ckpt, create=False) as ckpt:
                 step, params, _unused = ckpt.restore(model)
-        except (OSError, ValueError) as e:
+        except Exception as e:
             # same posture as --policy-checkpoint: a bad artifact gets
-            # a named CLI error, not a raw orbax traceback
+            # a named CLI error, not a raw orbax traceback (orbax can
+            # raise KeyError/TypeError on tree mismatch, not just
+            # OSError/ValueError)
             raise SystemExit(f"--ckpt: failed to restore from "
                              f"{args.ckpt}: {e}")
         logger.info("evaluating step-%d params from %s", step,

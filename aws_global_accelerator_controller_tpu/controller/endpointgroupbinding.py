@@ -275,18 +275,23 @@ class EndpointGroupBindingController:
         # allocate per-endpoint weights for spec.weight: null bindings)
         planned = self.weight_policy.plan(obj, endpoint_group,
                                           list(arns))
-        if arns:
-            from ..metrics import record_weight_plan
-
-            record_weight_plan(
-                type(self.weight_policy).__name__,
-                "spec" if obj.spec.weight is not None else "model"
-                if planned.get(next(iter(arns))) is not None else
-                "default")
         for endpoint_id in arns:
             provider.update_endpoint_weight(
                 endpoint_group, endpoint_id,
                 planned.get(endpoint_id, obj.spec.weight))
+        if arns:
+            # recorded only once every update succeeded — a provider
+            # failure mid-loop must not count as an applied plan; the
+            # source comes from the policy type + spec, not from
+            # sampling one planned value
+            from ..metrics import record_weight_plan
+            from .weightpolicy import ModelWeightPolicy
+
+            record_weight_plan(
+                type(self.weight_policy).__name__,
+                "spec" if obj.spec.weight is not None else "model"
+                if isinstance(self.weight_policy, ModelWeightPolicy)
+                else "default")
 
         copied = obj.deep_copy()
         copied.status.endpoint_ids = results

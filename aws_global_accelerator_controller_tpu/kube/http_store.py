@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue as queue_mod
 import socket
 import threading
@@ -402,8 +403,11 @@ class _Watcher:
                 # descriptor but shutdown() acts on the underlying
                 # socket, which is the one the reader is blocked on
                 try:
-                    dup = socket.fromfd(resp.fileno(), socket.AF_INET,
-                                        socket.SOCK_STREAM)
+                    # socket.socket(fileno=os.dup(..)) auto-detects the
+                    # address family from the descriptor (fromfd with a
+                    # hardcoded AF_INET mislabels IPv6 endpoints), and
+                    # the dup keeps close() off the reader's own fd
+                    dup = socket.socket(fileno=os.dup(resp.fileno()))
                     try:
                         dup.shutdown(socket.SHUT_RDWR)
                     finally:

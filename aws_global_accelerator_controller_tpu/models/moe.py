@@ -4,9 +4,11 @@ Third model family of the compute track.  Global Accelerator endpoint
 groups are regional, and regional fleets have regionally distinct
 telemetry statistics (different latency floors, capacity mixes) — a
 single shared MLP averages those regimes away.  This model routes each
-endpoint group to one of ``n_experts`` specialist MLPs with a learned
-top-1 (switch-style) gate, trained end-to-end with the standard
-load-balancing auxiliary loss so experts don't collapse.
+endpoint group to its best ``top_k`` of ``n_experts`` specialist MLPs
+(top-1 switch-style by default; top-2 with a ``capacity_factor``
+budget is the large-scale configuration — over-capacity assignments
+are dropped, as in GShard/Switch), trained end-to-end with the
+standard load-balancing auxiliary loss so experts don't collapse.
 
 The reference repo has no compute path at all (SURVEY.md §2: expert
 parallelism ABSENT upstream); the closest structural analogue is its

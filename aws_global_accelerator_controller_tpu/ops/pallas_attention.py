@@ -83,12 +83,21 @@ def _tuned_blocks(t: int):
     for band in _tuned_bands:
         if t <= band.get("t_max", 0):
             try:
-                return int(band["block_q"]), int(band["block_k"])
+                bq, bk = int(band["block_q"]), int(band["block_k"])
             except (KeyError, TypeError, ValueError):
                 logger.warning(
                     "flash block table band %r malformed; using "
                     "heuristic blocks for t=%d", band, t)
                 return None
+            if bq <= 0 or bk <= 0 or bq % _SUBLANE or bk % _SUBLANE:
+                # blocks must be positive sublane multiples or Mosaic
+                # rejects the grid at first compile — fall back cleanly
+                logger.warning(
+                    "flash block table band %r has non-tileable blocks "
+                    "(need positive multiples of %d); using heuristic "
+                    "blocks for t=%d", band, _SUBLANE, t)
+                return None
+            return bq, bk
     return None
 
 
