@@ -1,6 +1,6 @@
 # Developer entry points (analogue of the reference Makefile:16-24).
 
-.PHONY: test manifests check-manifests bench graft-dryrun lint
+.PHONY: test manifests check-manifests bench benchdoc graft-dryrun lint
 
 test:
 	python -m pytest tests/ -x -q
@@ -13,6 +13,14 @@ check-manifests: manifests
 
 bench:
 	python bench.py
+
+# docs/benchmarks.md is generated from committed bench artifacts
+# (builder_claims.json overlaid with the latest BENCH_LIVE.json);
+# a drift test in tests/test_bench.py keeps the committed file current
+benchdoc:
+	python bench.py report > docs/benchmarks.md.tmp \
+	  && mv docs/benchmarks.md.tmp docs/benchmarks.md \
+	  || { rm -f docs/benchmarks.md.tmp; exit 1; }
 
 graft-dryrun:
 	python __graft_entry__.py

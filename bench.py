@@ -868,6 +868,49 @@ def _attach_last_live(result: dict, name: str) -> dict:
     return {**result, "last_live": last}
 
 
+def _label_evidence(result: dict) -> dict:
+    """Per-leg evidence class (VERDICT r3 item 8): a reader of the JSON
+    line must be able to distinguish measured-from-testimony without
+    reading git history.
+
+    - ``measured-this-run``: the leg executed on the device in THIS
+      invocation — when the driver runs ``bench.py``, that is a
+      driver-verified number;
+    - ``builder-claimed``: the leg skipped (wedged tunnel) and carries a
+      ``last_live`` block — a dated, transcript-backed builder capture
+      the caller has not reproduced;
+    - ``none``: skipped with no live capture ever recorded."""
+    out = dict(result)
+    if "skipped" not in out:
+        out["evidence"] = "measured-this-run"
+    elif "last_live" in out:
+        out["evidence"] = "builder-claimed"
+    else:
+        out["evidence"] = "none"
+    return out
+
+
+_HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_artifacts",
+                             "reconcile_history.jsonl")
+
+
+def _record_reconcile_history(reconcile: dict) -> None:
+    """Append the control-plane number to a committed round-over-round
+    record (VERDICT r3 item 2) so a real hot-path decay is visible as a
+    trend instead of vanishing into single-round host noise."""
+    try:
+        os.makedirs(os.path.dirname(_HISTORY_PATH), exist_ok=True)
+        with open(_HISTORY_PATH, "a") as f:
+            f.write(json.dumps({
+                "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "services": reconcile["services"],
+                "throughput": round(reconcile["throughput"], 1),
+            }) + "\n")
+    except OSError:
+        pass  # read-only checkout: the number still goes to stdout
+
+
 def main() -> None:
     reconcile = bench_reconcile_best()
     print(f"reconcile: {reconcile['services']} services converged in "
@@ -895,10 +938,12 @@ def main() -> None:
             flash, flash_long, temporal = skip, dict(skip), dict(skip)
     if status != "tpu":
         smoke = {"skipped": flash.get("skipped", "")}
-    smoke = _attach_last_live(smoke, "smoke")
-    flash = _attach_last_live(flash, "flash")
-    flash_long = _attach_last_live(flash_long, "flash-long")
-    temporal = _attach_last_live(temporal, "temporal")
+    smoke = _label_evidence(_attach_last_live(smoke, "smoke"))
+    flash = _label_evidence(_attach_last_live(flash, "flash"))
+    flash_long = _label_evidence(
+        _attach_last_live(flash_long, "flash-long"))
+    temporal = _label_evidence(_attach_last_live(temporal, "temporal"))
+    _record_reconcile_history(reconcile)
     print(f"tpu compile smoke: {smoke}", file=sys.stderr)
     print(f"tpu flash: {flash}", file=sys.stderr)
     print(f"tpu flash long-context (T=8192): {flash_long}", file=sys.stderr)
@@ -920,6 +965,124 @@ def main() -> None:
         "tpu_flash_long": flash_long,
         "tpu_temporal_train": temporal,
     }))
+
+
+_CLAIMS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_artifacts", "builder_claims.json")
+
+# static prose around the generated table; the table rows come from
+# bench_artifacts/builder_claims.json overlaid with the most recent
+# live capture (BENCH_LIVE.json), so docs/benchmarks.md can never
+# claim a number no committed artifact backs (VERDICT r3 item 8)
+_REPORT_HEADER = """\
+# Measured performance (TPU v5e, single chip)
+
+GENERATED by `python bench.py report > docs/benchmarks.md`
+(`make benchdoc`); edit `bench_artifacts/builder_claims.json` or
+capture a live run, not this file.  A drift test keeps it current.
+
+Methodology for every device number: chained-marginal timing — jit ONE
+program chaining the op n times through a data dependence,
+cost = (T(n) − T(1)) / (n − 1), min over reps (`bench._marginal_s`;
+cancels dispatch + tunnel latency, which otherwise dominates: a naive
+dispatch loop over this tunnel reports rates above the chip's peak
+FLOPs).  The tunneled backend wedges intermittently; `bench.py` probes
+first and records `{"skipped": ...}` rather than hanging, and labels
+every leg's evidence class (`measured-this-run` / `builder-claimed` /
+`none`) in its JSON line.
+
+Evidence key: **builder-claimed** = measured by the builder on the
+dated device, never reproduced by the driver; **live capture** = raw
+transcript committed under `bench_artifacts/` by
+`hack/capture_live.py` the moment the tunnel came alive.
+"""
+
+_REPORT_FOOTER = """\
+FLOP accounting: causal attention = 2·T²·D·H (QK^T + PV, halved for
+causality); grad = 2.5× fwd model FLOPs (VJP-internal recompute not
+counted); temporal step counts dense matmuls 3× (fwd+bwd) and the
+attention term 3.5×.  MFU = achieved / 197e12.
+
+Reference baseline: the reference publishes **no** performance numbers
+(BASELINE.md), so `vs_baseline` in `bench.py` output is 1.0 by
+definition; the numbers above are this framework's own headline set.
+
+Live-capture machinery (armed every round by `hack/tpu_watch.sh`; on
+first tunnel life `hack/capture_live.py` runs smoke → flash →
+flash-long → temporal → temporal-breakdown → planner → autotune,
+committing raw transcripts + a dated `BENCH_LIVE.json`):
+
+- the temporal model's default (`supervision="last"`) training step
+  takes an O(T·S·D) last-query attention path — the [T, T] attention's
+  other rows had exactly zero gradient under the final-step loss — so
+  `bench.py temporal` reports both steps and the measured speedup;
+- `bench.py temporal-breakdown` decomposes the sequence-supervised
+  step into full / last / attention / dense / optimizer legs to name
+  the dominant term behind the 25% MFU;
+- `bench.py smoke` compiles every Pallas kernel variant + a sharded
+  train step on the real backend (Mosaic regression gate);
+- `bench.py autotune` sweeps flash (block_q, block_k); the reviewed
+  winner lands in `ops/flash_blocks.json`, which
+  `pallas_attention._resolve_blocks` honors per sequence-length band.
+
+Reproduce: `python bench.py` (full line), or one bench by name —
+`python bench.py flash | flash-long | temporal | temporal-breakdown |
+smoke | planner | reconcile | autotune`.
+"""
+
+
+def bench_report() -> str:
+    """Render docs/benchmarks.md from committed artifacts: the
+    builder-claims table overlaid with the latest live capture, each
+    row labeled with its evidence class."""
+    with open(_CLAIMS_PATH) as f:
+        claims = json.load(f)
+    live: dict = {}
+    live_date = None
+    live_transcript = None
+    try:
+        with open(_LIVE_PATH) as f:
+            payload = json.load(f)
+        live = payload.get("results", {}) or {}
+        live_date = payload.get("measured_at")
+        live_transcript = payload.get("transcript")
+    except (OSError, ValueError):
+        pass
+
+    lines = [_REPORT_HEADER]
+    lines.append(f"Builder-claimed numbers measured "
+                 f"{claims['measured_at']} on {claims['device']}.\n")
+    lines.append("| Bench | Shape | Result | Evidence |")
+    lines.append("|---|---|---|---|")
+    # capture_live.py wraps each leg's payload with bookkeeping
+    # timestamps; only the measurement keys belong in the doc
+    wrapper_keys = ("started_at", "finished_at")
+    for row in claims["rows"]:
+        if "evidence" in row:
+            # a row with static evidence (e.g. reconcile: reproduced
+            # by every `python bench.py` run) renders it verbatim
+            evidence = row["evidence"]
+        else:
+            # live_key: which capture leg carries this row's evidence
+            # (the flash-grad row is measured by the same live "flash"
+            # leg that measures the forward)
+            entry = live.get(row.get("live_key", row["bench"]))
+            if isinstance(entry, dict) and "skipped" not in entry:
+                detail = ", ".join(
+                    f"{k}={v}" for k, v in entry.items()
+                    if k not in wrapper_keys).replace("|", "\\|")
+                evidence = (f"**live capture {live_date}** ({detail}; "
+                            f"transcript `bench_artifacts/"
+                            f"{live_transcript}`)" if live_transcript
+                            else f"**live capture {live_date}** "
+                            f"({detail})")
+            else:
+                evidence = f"builder-claimed ({claims['measured_at']})"
+        lines.append(f"| {row['label']} | {row['shape']} | "
+                     f"{row['result']} | {evidence} |")
+    lines.append("")
+    lines.append(_REPORT_FOOTER)
+    return "\n".join(lines)
 
 
 # Named single benches for humans/tooling; bare `python bench.py`
@@ -946,10 +1109,15 @@ _NAMED = {
 if __name__ == "__main__":
     if len(sys.argv) > 1:
         name = sys.argv[1]
+        if name == "report" and len(sys.argv) == 2:
+            # not a bench: renders docs/benchmarks.md from artifacts
+            print(bench_report(), end="")
+            sys.exit(0)
         if name not in _NAMED or len(sys.argv) > 2:
             # benches take no CLI parameters: silently ignoring extras
             # would report default-shape numbers as if they were custom
-            print(f"usage: python bench.py [{'|'.join(sorted(_NAMED))}]"
+            names = "|".join(sorted([*_NAMED, "report"]))
+            print(f"usage: python bench.py [{names}]"
                   " (no further arguments)", file=sys.stderr)
             sys.exit(2)
         print(json.dumps(_NAMED[name]()))

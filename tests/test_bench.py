@@ -81,11 +81,13 @@ def test_tpu_probe_parses_subprocess_outcomes(monkeypatch):
     assert bench.tpu_probe() == ("dead", "wedged")
 
 
-def _main_json(monkeypatch, capsys, status, detail):
+def _main_json(monkeypatch, capsys, tmp_path, status, detail):
     """Drive bench.main() with every measurement stubbed; return the
     parsed stdout contract line."""
     import json
 
+    monkeypatch.setattr(
+        bench, "_HISTORY_PATH", str(tmp_path / "history.jsonl"))
     monkeypatch.setattr(
         bench, "bench_reconcile_best",
         lambda **kw: {"services": 10, "elapsed_s": 0.01,
@@ -116,27 +118,32 @@ def _main_json(monkeypatch, capsys, status, detail):
     return json.loads(out[0]), ran
 
 
-def test_main_contract_healthy_tpu(monkeypatch, capsys):
-    data, ran = _main_json(monkeypatch, capsys, "tpu", "tpu")
+def test_main_contract_healthy_tpu(monkeypatch, capsys, tmp_path):
+    data, ran = _main_json(monkeypatch, capsys, tmp_path, "tpu", "tpu")
     assert data["metric"] == "reconcile_convergence_throughput"
     assert data["value"] == 1000.0
     assert data["vs_baseline"] == 1.0
-    assert data["tpu_flash"] == {"fwd_us": 1.0}
-    assert data["tpu_flash_long"] == {"fwd_us": 1.0}
-    assert data["tpu_temporal_train"] == {"fwd_us": 1.0}
-    assert data["tpu_smoke"] == {"fwd_us": 1.0}
+    live = {"fwd_us": 1.0, "evidence": "measured-this-run"}
+    assert data["tpu_flash"] == live
+    assert data["tpu_flash_long"] == live
+    assert data["tpu_temporal_train"] == live
+    assert data["tpu_smoke"] == live
     assert ran["flash"] == ran["flash_long"] == ran["temporal"] == 1
     assert ran["smoke"] == 1
     assert ran["planner_calls"] == [{}]  # no cpu pin on a healthy tpu
 
 
-def test_main_contract_dead_backend_still_one_line(monkeypatch, capsys):
-    data, ran = _main_json(monkeypatch, capsys, "dead", "unresponsive")
+def test_main_contract_dead_backend_still_one_line(monkeypatch, capsys,
+                                                   tmp_path):
+    data, ran = _main_json(monkeypatch, capsys, tmp_path, "dead",
+                           "unresponsive")
     assert data["value"] == 1000.0
-    assert "skipped" in data["tpu_flash"]
-    assert "skipped" in data["tpu_flash_long"]
-    assert "skipped" in data["tpu_temporal_train"]
-    assert "skipped" in data["tpu_smoke"]
+    for leg in ("tpu_flash", "tpu_flash_long", "tpu_temporal_train",
+                "tpu_smoke"):
+        assert "skipped" in data[leg]
+        # a skipped leg must declare its evidence class so the reader
+        # can tell testimony from measurement (VERDICT r3 item 8)
+        assert data[leg]["evidence"] in ("builder-claimed", "none")
     assert ran["flash"] == ran["flash_long"] == ran["temporal"] == 0
     assert ran["smoke"] == 0
     # the backend-agnostic planner must still run, pinned to cpu
@@ -248,3 +255,97 @@ def test_temporal_breakdown_legs_run_interpret_mode():
     for name, (chained, args) in legs.items():
         out = np.asarray(chained(2)(*args))
         assert np.isfinite(out).all(), name
+
+
+def test_label_evidence_classes():
+    assert bench._label_evidence(
+        {"fwd_us": 3.0})["evidence"] == "measured-this-run"
+    assert bench._label_evidence(
+        {"skipped": "wedged",
+         "last_live": {"live": False}})["evidence"] == "builder-claimed"
+    assert bench._label_evidence(
+        {"skipped": "wedged"})["evidence"] == "none"
+
+
+def test_record_reconcile_history_appends(monkeypatch, tmp_path):
+    path = tmp_path / "history.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(path))
+    bench._record_reconcile_history(
+        {"services": 200, "throughput": 1500.4, "elapsed_s": 0.13})
+    bench._record_reconcile_history(
+        {"services": 200, "throughput": 1602.9, "elapsed_s": 0.12})
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["throughput"] for r in rows] == [1500.4, 1602.9]
+    assert all(r["services"] == 200 and "ts" in r for r in rows)
+
+
+def test_reconcile_throughput_floor():
+    """Round-over-round floor on the control-plane hot path (VERDICT
+    r3 item 2).  The r2->r3 driver drift (1754 -> 1623 services/s,
+    -7.5%) was investigated in round 4 with an interleaved A/B of the
+    r2 tree (8625da9) vs HEAD on one host: best 1674 vs 1726, median
+    1542 vs 1445 -- the drift is host noise, not code (single-run
+    spread on a quiet host is +/-20%, far above the drift).  The floor
+    must hold on a BUSY host too (the suite runs under pytest -x, so a
+    flake here aborts everything): measured best-of-3 under two
+    concurrent full-suite runs was ~600/s, single runs as low as
+    ~390/s, vs ~1700/s quiet.  400 keeps headroom below the worst
+    observed loaded best-of-3 while still tripping on any >4x real
+    regression; override with RECONCILE_FLOOR_SVC_S to tighten on
+    dedicated hardware."""
+    floor = float(os.environ.get("RECONCILE_FLOOR_SVC_S", "400"))
+    best = max(bench.bench_reconcile()["throughput"]
+               for _ in range(3))
+    assert best >= floor, (
+        f"reconcile best-of-3 {best:.0f}/s under the {floor:.0f}/s "
+        f"floor -- profile bench_reconcile before shipping "
+        f"(bench_artifacts/reconcile_history.jsonl has the trend)")
+
+
+def test_benchmarks_doc_is_generated_and_current():
+    """docs/benchmarks.md is generated (`make benchdoc`); hand edits
+    or a stale regeneration fail here, the codegen-drift pattern
+    (VERDICT r3 item 8: the doc must follow the artifacts)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "docs", "benchmarks.md")) as f:
+        committed = f.read()
+    assert committed == bench.bench_report()
+
+
+def test_bench_report_live_overlay(monkeypatch, tmp_path):
+    """A live capture flips the Evidence cell for its row AND for rows
+    that share its capture leg (live_key: the grad number comes from
+    the same live 'flash' leg); capture bookkeeping keys stay out of
+    the doc and pipes cannot break the table."""
+    claims = tmp_path / "claims.json"
+    claims.write_text(json.dumps({
+        "measured_at": "2026-07-30", "device": "v5e",
+        "rows": [
+            {"bench": "flash", "label": "fwd", "shape": "s",
+             "result": "r"},
+            {"bench": "flash-grad", "label": "grad", "shape": "s",
+             "result": "r", "live_key": "flash"},
+            {"bench": "temporal", "label": "temp", "shape": "s",
+             "result": "r"},
+            {"bench": "reconcile", "label": "rec", "shape": "s",
+             "result": "r", "evidence": "driver-verified every run"},
+        ]}))
+    live = tmp_path / "live.json"
+    live.write_text(json.dumps({
+        "measured_at": "2026-07-31T01:00:00Z",
+        "transcript": "transcript_y.log",
+        "results": {"flash": {"started_at": "x", "finished_at": "y",
+                              "fwd_us": 99.0, "note": "a|b"}},
+    }))
+    monkeypatch.setattr(bench, "_CLAIMS_PATH", str(claims))
+    monkeypatch.setattr(bench, "_LIVE_PATH", str(live))
+    doc = bench.bench_report()
+    rows = {l.split(" | ")[0].strip("| "): l for l in doc.splitlines()
+            if l.startswith("| ")}
+    assert "live capture 2026-07-31" in rows["fwd"]
+    assert "live capture 2026-07-31" in rows["grad"]      # via live_key
+    assert "builder-claimed (2026-07-30)" in rows["temp"]
+    assert "driver-verified every run" in rows["rec"]
+    assert "started_at" not in doc and "finished_at" not in doc
+    assert "a\\|b" in rows["fwd"]  # pipe escaped, table intact
+    assert "transcript_y.log" in rows["fwd"]
