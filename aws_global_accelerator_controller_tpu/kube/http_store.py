@@ -36,6 +36,7 @@ import socket
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from datetime import datetime, timezone
 from typing import Any, Dict, Optional
@@ -241,10 +242,34 @@ class RestClient:
                     self.config.invalidate_exec_token()
                     continue
                 raise self._typed_error(e)
+            ctype = resp.headers.get("Content-Type", "")
             if stream:
+                if ctype and "json" not in ctype:
+                    # same misconfigured-proxy check as below, caught
+                    # BEFORE the watch loop: letting protobuf frames
+                    # reach json.loads would log an anonymous
+                    # 'watch dropped' and reconnect forever
+                    resp.close()
+                    raise RuntimeError(
+                        f"apiserver answered watch with Content-Type "
+                        f"{ctype!r}; this client speaks "
+                        f"application/json only — check the "
+                        f"aggregator/proxy between client and "
+                        f"apiserver")
                 return resp
             with resp:
                 payload = resp.read()
+            if payload and ctype and "json" not in ctype:
+                # the Accept: application/json header was sent, so a
+                # non-JSON body means a misconfigured aggregator/proxy
+                # (e.g. application/vnd.kubernetes.protobuf).  Name the
+                # problem instead of dying in json.loads on bytes that
+                # may not even decode as text.
+                raise RuntimeError(
+                    f"apiserver answered Content-Type {ctype!r}; this "
+                    f"client speaks application/json only (and asked "
+                    f"for it via Accept) — check the aggregator/proxy "
+                    f"between client and apiserver")
             return json.loads(payload) if payload else {}
 
     @staticmethod
@@ -261,14 +286,63 @@ class RestClient:
         if e.code in (400, 403, 422):
             # includes admission-webhook denials surfaced by the server
             return AdmissionDeniedError(e.code, message)
+        if e.code == 410:
+            # an expired LIST continue token (or stale watch RV on the
+            # raw request path); pagination falls back to a full list
+            return GoneError(message)
         return RuntimeError(f"apiserver HTTP {e.code}: {message}")
 
 
+class GoneError(RuntimeError):
+    """HTTP 410 outside a watch stream — in practice an expired LIST
+    ``continue`` token (etcd compacted the snapshot the token pinned)."""
+
+
+# client-go's ListPager default page size; every collection GET in this
+# client goes through _paged_get, so a real apiserver (which caps
+# unpaginated lists and expects chunking from informers) sees the same
+# limit/continue traffic client-go would send
+_LIST_CHUNK = 500
+
+
+def _paged_get(client: "RestClient", path: str,
+               chunk: "int | None" = None) -> dict:
+    """GET a collection with apiserver chunking: request ``limit=N``
+    pages and follow ``metadata.continue`` tokens, concatenating
+    items.  An expired token (410 Gone mid-pagination) falls back to
+    one unchunked full list — client-go ListPager's
+    ``FullListIfExpired`` behavior — because the chunk sequence no
+    longer forms a consistent snapshot.  Returns the last page's
+    metadata (its resourceVersion is the freshest)."""
+    chunk = _LIST_CHUNK if chunk is None else chunk
+    if not chunk:
+        return client.request("GET", path)
+    sep = "&" if "?" in path else "?"
+    got = client.request("GET", f"{path}{sep}limit={chunk}")
+    items = list(got.get("items") or [])
+    cont = (got.get("metadata") or {}).get("continue")
+    while cont:
+        try:
+            got = client.request(
+                "GET", f"{path}{sep}limit={chunk}"
+                f"&continue={urllib.parse.quote(cont)}")
+        except GoneError:
+            logger.info("list %s: continue token expired; falling "
+                        "back to a full unchunked list", path)
+            return client.request("GET", path)
+        items.extend(got.get("items") or [])
+        cont = (got.get("metadata") or {}).get("continue")
+    merged = dict(got)
+    merged["items"] = items
+    return merged
+
+
 def _list_with_rv(client: "RestClient", codec: Codec):
-    """GET the full collection; returns ({key: obj}, list resourceVersion
-    as int, 0 when absent/non-numeric) — the one place the list+RV wire
-    idiom lives (watch start and 410 relist recovery both use it)."""
-    got = client.request("GET", codec.collection_path(None))
+    """GET the full collection (paginated); returns ({key: obj}, list
+    resourceVersion as int, 0 when absent/non-numeric) — the one place
+    the list+RV wire idiom lives (watch start and 410 relist recovery
+    both use it)."""
+    got = _paged_get(client, codec.collection_path(None))
     rv = (got.get("metadata") or {}).get("resourceVersion", "0")
     objs = {}
     for item in got.get("items") or []:
@@ -303,8 +377,8 @@ class HTTPResourceStore:
         return self._codec.from_wire(got)
 
     def list(self, namespace: Optional[str] = None):
-        got = self._client.request(
-            "GET", self._codec.collection_path(namespace))
+        got = _paged_get(self._client,
+                         self._codec.collection_path(namespace))
         return sorted((self._codec.from_wire(i)
                        for i in got.get("items") or []),
                       key=lambda o: o.key())

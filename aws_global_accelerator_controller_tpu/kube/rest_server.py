@@ -19,6 +19,7 @@ the client answers by relisting — exactly the real apiserver contract.
 """
 from __future__ import annotations
 
+import base64
 import json
 import logging
 import socket
@@ -83,6 +84,20 @@ class KubeRestServer:
             kind: _KindState(kind) for kind in self.codecs
         }
         self._stop = threading.Event()
+        # chaos knob: answer every continue token with 410 Expired (the
+        # etcd-compaction path) so clients prove their full-relist
+        # fallback
+        self.expire_continues = False
+        # chunked-LIST snapshots: a continue token pins the listing
+        # taken at the first page (real apiserver semantics — chunks
+        # of one list are one consistent etcd snapshot; serving later
+        # pages live would let a mid-pagination create vanish: its key
+        # sorts before `after` AND its event RV is at or below the
+        # list RV the watch resumes from).  Bounded LRU; an evicted
+        # token answers 410 Expired, exactly what compaction does.
+        self._list_snapshots: "dict[str, tuple[int, list]]" = {}
+        self._list_snapshot_seq = 0
+        self._list_snapshots_lock = threading.Lock()
         # live watch-stream sockets, for chaos testing (drop_watches)
         self._watch_conns: set = set()
         self._watch_conns_lock = threading.Lock()
@@ -237,7 +252,7 @@ class KubeRestServer:
                 if query.get("watch", ["false"])[0] == "true":
                     self._serve_watch(req, kind, codec, query)
                 else:
-                    self._serve_list(req, kind, codec, ns)
+                    self._serve_list(req, kind, codec, ns, query)
             elif method == "GET":
                 obj = self.api.store(kind).get(ns, name)
                 self._respond(req, 200, codec.to_wire(obj))
@@ -291,16 +306,107 @@ class KubeRestServer:
             pass
 
     def _serve_list(self, req, kind: str, codec: Codec,
-                    ns: Optional[str]) -> None:
-        items = self.api.store(kind).list(ns)
-        rv = max([o.metadata.resource_version for o in items]
-                 + [self._states[kind].last_rv])
+                    ns: Optional[str], query) -> None:
+        """LIST with apiserver chunking: ``limit`` caps the page and a
+        ``continue`` token resumes after the last returned key
+        (client-go's informer pager sends limit=500 by default, so a
+        wire-faithful stub must speak this or the pagination path in
+        the client is self-certified against nothing).  Real continue
+        tokens expire on etcd compaction with 410 Expired; the
+        ``expire_continues`` chaos knob forces that path so clients
+        prove their full-relist fallback."""
+        try:
+            limit = int(query.get("limit", ["0"])[0])
+        except ValueError:
+            limit = 0
+        if limit < 0:
+            self._respond(req, 400, {
+                "kind": "Status", "apiVersion": "v1",
+                "metadata": {}, "status": "Failure",
+                "message": "limit must be a positive integer",
+                "reason": "BadRequest", "code": 400})
+            return
+        cont = query.get("continue", [""])[0]
+        if cont:
+            if self.expire_continues:
+                self._respond(req, 410, self._expired_status())
+                return
+            try:
+                tok = json.loads(
+                    base64.urlsafe_b64decode(cont.encode()).decode())
+                after, snap_id = tok["after"], tok["snap"]
+                if not isinstance(after, str) \
+                        or not isinstance(snap_id, str):
+                    raise TypeError("token fields")
+            except (ValueError, KeyError, TypeError):
+                self._respond(req, 400, {
+                    "kind": "Status", "apiVersion": "v1",
+                    "metadata": {}, "status": "Failure",
+                    "message": "The provided continue parameter is "
+                               "not valid: malformed token",
+                    "reason": "BadRequest", "code": 400})
+                return
+            with self._list_snapshots_lock:
+                snap = self._list_snapshots.get(snap_id)
+            if snap is None:
+                # snapshot evicted — same answer as etcd compaction
+                self._respond(req, 410, self._expired_status())
+                return
+            rv, snapshot = snap
+            items = [o for o in snapshot if o.key() > after]
+        else:
+            # chunks of one list serve one consistent snapshot; later
+            # pages must NOT see live mutations (a create that sorts
+            # before `after` would otherwise be invisible to both the
+            # pager and the watch that resumes from the list RV)
+            items = sorted(self.api.store(kind).list(ns),
+                           key=lambda o: o.key())
+            rv = max([o.metadata.resource_version for o in items]
+                     + [self._states[kind].last_rv])
+        meta = {"resourceVersion": str(rv)}
+        if limit and len(items) > limit:
+            remaining = len(items) - limit
+            tail = items[limit:]
+            items = items[:limit]
+            if not cont:
+                snap_id = self._remember_snapshot(rv, tail)
+            # else: later pages reuse the token's snapshot — the
+            # stored list is immutable, only `after` advances
+            meta["continue"] = base64.urlsafe_b64encode(json.dumps(
+                {"after": items[-1].key(), "rv": rv, "snap": snap_id}
+            ).encode()).decode()
+            meta["remainingItemCount"] = remaining
         self._respond(req, 200, {
             "apiVersion": "v1",
             "kind": f"{kind}List",
-            "metadata": {"resourceVersion": str(rv)},
+            "metadata": meta,
             "items": [codec.to_wire(o) for o in items],
         })
+
+    @staticmethod
+    def _expired_status() -> dict:
+        """Genuine apiserver Status shape for an expired continue."""
+        return {
+            "kind": "Status", "apiVersion": "v1",
+            "metadata": {}, "status": "Failure",
+            "message": "The provided continue parameter is too old "
+                       "to display a consistent list result. You can "
+                       "start a new list without the continue "
+                       "parameter.",
+            "reason": "Expired", "code": 410}
+
+    def _remember_snapshot(self, rv: int, rest_items: list) -> str:
+        """Pin the un-served remainder of a chunked list under a fresh
+        snapshot id (bounded: oldest evicted — an evicted token then
+        410s like a compacted one)."""
+        with self._list_snapshots_lock:
+            self._list_snapshot_seq += 1
+            snap_id = str(self._list_snapshot_seq)
+            self._list_snapshots[snap_id] = (rv, rest_items)
+            while len(self._list_snapshots) > 32:
+                oldest = next(iter(self._list_snapshots))
+                del self._list_snapshots[oldest]
+        return snap_id
 
     def _serve_watch(self, req, kind: str, codec: Codec, query) -> None:
         state = self._states[kind]

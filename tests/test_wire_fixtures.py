@@ -24,8 +24,10 @@ from aws_global_accelerator_controller_tpu.errors import (
     NotFoundError,
 )
 from aws_global_accelerator_controller_tpu.kube.http_store import (
+    GoneError,
     RestClient,
     _list_with_rv,
+    _paged_get,
     _Watcher,
     _WatchExpired,
     default_codecs,
@@ -186,14 +188,14 @@ def test_status_403_webhook_denial_maps_to_admission_denied():
     assert "Spec.EndpointGroupArn is immutable" in str(err)
 
 
-def test_status_410_surfaces_as_runtime_error_with_reason():
-    """A LIST at an expired RV returns HTTP 410; it is not one of the
-    typed control-flow errors, but the Expired reason must survive into
-    the raised message for the operator."""
+def test_status_410_maps_to_gone_error_with_reason():
+    """HTTP 410 outside a watch is typed (GoneError) so the list pager
+    can catch an expired continue token and fall back to a full list;
+    the Expired reason must survive into the message for the
+    operator."""
     err = RestClient._typed_error(_http_error(
         410, "status_410_gone.json"))
-    assert isinstance(err, RuntimeError)
-    assert "410" in str(err)
+    assert isinstance(err, GoneError)
     assert "too old" in str(err)
 
 
@@ -233,3 +235,134 @@ def test_egb_status_subresource_parses():
     assert egb.status.observed_generation == 2
     assert egb.status.endpoint_ids[0].startswith(
         "arn:aws:elasticloadbalancing")
+
+
+# -- LIST pagination (limit/continue chunking) ------------------------------
+
+
+class _PagedStub:
+    """Wire-faithful pager peer: serves page fixtures keyed on whether
+    the request carries a continue token, recording each path."""
+
+    def __init__(self, pages):
+        self.pages = pages          # {None: first, "token": next, ...}
+        self.paths = []
+
+    def request(self, method, path, body=None, stream=False,
+                timeout=None):
+        assert method == "GET"
+        self.paths.append(path)
+        import urllib.parse as up
+        q = up.parse_qs(up.urlparse(path).query)
+        cont = q.get("continue", [None])[0]
+        return self.pages[cont]
+
+
+def test_paged_list_follows_continue_tokens():
+    """client-go's informer pager sends limit=500 and follows
+    metadata.continue; the client must do the same, concatenating
+    chunks and URL-quoting the opaque token."""
+    page1 = _load("service_list_page1.json")
+    token = page1["metadata"]["continue"]
+    stub = _PagedStub({None: page1,
+                       token: _load("service_list_page2.json")})
+    objs, rv = _list_with_rv(stub, default_codecs()["Service"])
+    assert set(objs) == {"default/app-a", "default/app-b",
+                         "default/app-c"}
+    assert rv == 812400
+    assert len(stub.paths) == 2
+    assert "limit=500" in stub.paths[0] and "continue=" not in \
+        stub.paths[0]
+    import urllib.parse as up
+    assert up.quote(token) in stub.paths[1]
+    # remainingItemCount is advisory; parsing must not choke on it
+    assert page1["metadata"]["remainingItemCount"] == 1
+
+
+def test_paged_list_expired_continue_falls_back_to_full_list():
+    """An expired continue token 410s mid-pagination (etcd compaction);
+    the pager must restart with ONE unchunked full list — client-go
+    ListPager's FullListIfExpired — not crash, not serve a torn
+    half-list."""
+    full = _load("service_list.json")
+
+    class _ExpiringStub:
+        def __init__(self):
+            self.paths = []
+
+        def request(self, method, path, body=None, stream=False,
+                    timeout=None):
+            self.paths.append(path)
+            if "continue=" in path:
+                raise RestClient._typed_error(_http_error(
+                    410, "status_410_expired_continue.json"))
+            if "limit=" in path:
+                return _load("service_list_page1.json")
+            return full  # the unchunked fallback request
+
+    stub = _ExpiringStub()
+    got = _paged_get(stub, "/api/v1/services")
+    assert [i["metadata"]["name"] for i in got["items"]] == \
+        [i["metadata"]["name"] for i in full["items"]]
+    assert len(stub.paths) == 3  # chunk 1, expired chunk 2, full list
+    assert "?" not in stub.paths[-1]
+
+
+def test_unchunked_server_terminates_after_one_page():
+    """A server that ignores limit (this repo's pre-r4 stub, some
+    aggregators) returns everything with no continue token: the pager
+    must make exactly one request."""
+    stub = _PagedStub({None: _load("service_list.json")})
+    objs, rv = _list_with_rv(stub, default_codecs()["Service"])
+    assert set(objs) == {"default/app", "kube-public/plain"}
+    assert len(stub.paths) == 1
+
+
+# -- server-side apply conflict (409 + FieldManagerConflict) ----------------
+
+
+def test_ssa_conflict_maps_to_conflict_error_with_manager_detail():
+    """A server-side-apply 409 carries the conflicting fieldManager in
+    the Status message; it must surface as the typed ConflictError with
+    the manager and field intact (the operator's only clue WHO owns
+    the contested field)."""
+    err = RestClient._typed_error(_http_error(
+        409, "status_409_ssa_conflict.json"))
+    assert isinstance(err, ConflictError)
+    assert 'conflict with "kubectl-client-side-apply"' in str(err)
+    assert ".spec.weight" in str(err)
+
+
+# -- protobuf content-type rejection ----------------------------------------
+
+
+def test_protobuf_content_type_rejected_loudly(monkeypatch):
+    """The client sends Accept: application/json; an aggregator that
+    answers application/vnd.kubernetes.protobuf anyway must produce a
+    named error pointing at the proxy — not a UnicodeDecodeError from
+    json.loads over protobuf bytes."""
+    import urllib.request as ur
+
+    from aws_global_accelerator_controller_tpu.kube.http_store import (
+        RestConfig,
+    )
+
+    class _ProtoResp:
+        headers = {"Content-Type": "application/vnd.kubernetes.protobuf"}
+
+        def read(self):
+            return b"k8s\x00\n\x0c\n\x02v1\x12\x06Service"  # not JSON
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    monkeypatch.setattr(ur, "urlopen", lambda *a, **k: _ProtoResp())
+    client = RestClient(RestConfig(server="http://apiserver"))
+    with pytest.raises(RuntimeError) as ei:
+        client.request("GET", "/api/v1/services")
+    msg = str(ei.value)
+    assert "vnd.kubernetes.protobuf" in msg
+    assert "application/json" in msg
