@@ -288,7 +288,9 @@ def _build_model(args):
                 return planner.forward(
                     params, planner.shard_window(window), batch.mask)
         else:
-            step_fn = jax.jit(model.train_step)
+            # donation: params/Adam state update in place on device
+            # (the guard's restore path never reuses pre-step buffers)
+            step_fn = jax.jit(model.train_step, donate_argnums=(0, 1))
             fwd = jax.jit(model.forward)
 
             def run_step(params, opt_state, key):
@@ -388,7 +390,9 @@ def _snapshot_runners(jax, model, make_batch, make_planner, sharded):
             batch = planner.shard_batch(make_batch(key))
             return planner.forward(params, batch.features, batch.mask)
     else:
-        step_fn = jax.jit(model.train_step)
+        # donation: params/Adam state update in place on device (the
+        # guard's restore path never reuses pre-step buffers)
+        step_fn = jax.jit(model.train_step, donate_argnums=(0, 1))
         fwd = jax.jit(model.forward)
 
         def run_step(params, opt_state, key):

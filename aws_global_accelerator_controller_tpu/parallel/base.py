@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Tuple
 
 import jax
+import jax.numpy as jnp
 
 from ..models.traffic import Batch
 
@@ -22,7 +23,15 @@ class SnapshotPlannerMixin:
     batch_shardings: Batch
 
     def shard_params(self, params) -> dict:
-        return {k: jax.device_put(v, self.param_shardings[k])
+        # jnp.array(copy=True) forces distinct storage: device_put can
+        # alias the source buffer, and train_step DONATES params —
+        # without the copy, donating the sharded handle would delete
+        # the caller's original too.  device_put(..., may_alias=False)
+        # is NOT sufficient: on the host-platform mesh the donated
+        # output still deletes the source (verified empirically), so
+        # the copy must happen before placement.
+        return {k: jax.device_put(jnp.array(v, copy=True),
+                                  self.param_shardings[k])
                 for k, v in params.items()}
 
     def shard_batch(self, batch: Batch) -> Batch:

@@ -193,7 +193,8 @@ class ShardedMoEPlanner(SnapshotPlannerMixin):
             in_shardings=(ps, bs.features, bs.mask),
             out_shardings=out_s)
         self._step = jax.jit(step, in_shardings=(ps, None, bs),
-                             out_shardings=(ps, None, None))
+                             out_shardings=(ps, None, None),
+                             donate_argnums=(0, 1))
         self.param_shardings = ps
         self.batch_shardings = bs
 

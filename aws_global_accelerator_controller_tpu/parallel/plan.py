@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Sequence
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.temporal import TemporalTrafficModel
@@ -72,7 +73,11 @@ class ShardedTrafficPlanner(SnapshotPlannerMixin):
         self._step = jax.jit(
             step,
             in_shardings=(ps, None, bs),
-            out_shardings=(ps, None, None))
+            out_shardings=(ps, None, None),
+            # params/opt_state are consumed and replaced every step:
+            # donation lets XLA update Adam state in place instead of
+            # allocating + copying 3x param bytes of HBM per step
+            donate_argnums=(0, 1))
         self.param_shardings = ps
         self.batch_shardings = bs
 
@@ -183,10 +188,16 @@ class ShardedTemporalPlanner:
         self._step = jax.jit(
             step,
             in_shardings=(rep, None, win_s, batch_s),
-            out_shardings=(rep, None, None))
+            out_shardings=(rep, None, None),
+            donate_argnums=(0, 1))  # in-place param/Adam-state update
 
     def shard_params(self, params):
-        return {k: jax.device_put(v, self.param_sharding)
+        # jnp.array(copy=True): same aliasing hazard as
+        # base.shard_params — the donated sharded handle must never
+        # share storage with the caller's params (may_alias=False is
+        # not sufficient; see base.shard_params)
+        return {k: jax.device_put(jnp.array(v, copy=True),
+                                  self.param_sharding)
                 for k, v in params.items()}
 
     def shard_window(self, window):

@@ -101,14 +101,18 @@ def test_sharded_training_survives_checkpoint_roundtrip(tmp_path):
     mesh = make_mesh(8)
     planner = ShardedTrafficPlanner(model, mesh)
     batches = [planner.shard_batch(b) for b in _batches(4)]
-    params = planner.shard_params(model.init_params(jax.random.PRNGKey(0)))
-    opt = model.init_opt_state(params)
+    params0 = model.init_params(jax.random.PRNGKey(0))
 
-    want_p, want_o = params, opt
+    # each trajectory gets its OWN sharded start: train_step donates
+    # params/opt_state (in-place update on device), so a shared handle
+    # would be deleted by the first trajectory's first step
+    want_p = planner.shard_params(params0)
+    want_o = model.init_opt_state(want_p)
     for b in batches:
         want_p, want_o, want_loss = planner.train_step(want_p, want_o, b)
 
-    p, o = params, opt
+    p = planner.shard_params(params0)
+    o = model.init_opt_state(p)
     for b in batches[:2]:
         p, o, _ = planner.train_step(p, o, b)
     with TrainCheckpointer(str(tmp_path / "c")) as ckpt:

@@ -87,3 +87,31 @@ def test_sharded_matches_single_device():
     # sharded matmuls reduce in a different order; rounding to int weights
     # may flip by 1
     np.testing.assert_allclose(expected, got, atol=1)
+
+
+def test_train_step_donates_inputs_but_not_caller_params():
+    """train_step donates params/opt_state (in-place Adam update on
+    device — no 3x-param-bytes HBM copy per step); shard_params must
+    therefore COPY, so the caller's unsharded params survive the
+    donation.  Pins both halves: a regression that drops donation or
+    one that lets device_put alias the source both fail here."""
+    import pytest
+
+    model = TrafficPolicyModel(feature_dim=8, hidden_dim=16)
+    raw = model.init_params(jax.random.PRNGKey(0))
+    planner = ShardedTrafficPlanner(model, make_mesh(8))
+    sp = planner.shard_params(raw)
+    so = model.init_opt_state(sp)
+    sb = planner.shard_batch(
+        synthetic_batch(jax.random.PRNGKey(1), groups=8, endpoints=16))
+    new_p, _, _ = planner.train_step(sp, so, sb)
+
+    # the donated sharded handles are consumed...
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(sp["w1"])
+    # ...but the caller's original params are untouched (copy-on-shard)
+    assert np.isfinite(np.asarray(raw["w1"]).astype(np.float32)).all()
+    # and the returned params are live and advanced
+    assert not np.array_equal(
+        np.asarray(new_p["w1"]).astype(np.float32),
+        np.asarray(raw["w1"]).astype(np.float32))
