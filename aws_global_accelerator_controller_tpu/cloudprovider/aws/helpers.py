@@ -69,8 +69,12 @@ def accelerator_name(resource: str, obj: KubeObject) -> str:
 
 
 def tags_contains_all_values(tags: Tags, target: Tags) -> bool:
-    """All target k/v present (reference global_accelerator.go:559-570)."""
-    return all(tags.get(k) == v for k, v in target.items())
+    """All target k/v present (reference global_accelerator.go:559-570).
+
+    Implemented as dict-items-view containment: C-level, ~10x the
+    genexpr form — this predicate runs O(fleet) times per discovery
+    scan, the control plane's hottest loop (bench_reconcile)."""
+    return target.items() <= tags.items()
 
 
 def listener_for_service(svc: Service) -> Tuple[List[int], str]:

@@ -210,17 +210,48 @@ class AWSProvider:
             with self._cache_lock:
                 self._discovery_cache.pop(key, None)
 
+        # ONE lock acquisition + clock read for the whole O(fleet)
+        # scan: per-arn _tags_for calls dominated the reconcile hot
+        # path (a lock + monotonic() per accelerator per sync)
+        with self._cache_lock:
+            now = time.monotonic()
+            gen = self._cache_gen
+            cached = ({} if fresh_scan else
+                      {arn: tags for arn, (tags, at)
+                       in self._tags_cache.items()
+                       if now - at < self.discovery_cache_ttl})
         result = []
         for accelerator in self.apis.ga.list_accelerators():
             arn = accelerator.accelerator_arn
             if arn in verified_tags:  # just fetched during verify
                 tags = verified_tags[arn]
             else:
-                tags = self._tags_for(arn, fresh=fresh_scan)
+                tags = cached.get(arn)
+                if tags is None:
+                    tags = self.apis.ga.list_tags_for_resource(arn)
+                    self._store_tags(arn, tags, gen)
             if tags_contains_all_values(tags, target):
                 result.append(accelerator)
-            else:
-                logger.debug("accelerator %s does not match tags", arn)
+        with self._cache_lock:
+            gen_moved = self._cache_gen != gen
+        if gen_moved and result:
+            # an invalidation landed mid-scan (concurrent delete or
+            # re-tag): the snapshot may have matched stale tags.  The
+            # pre-snapshot code re-read the live cache per arn and saw
+            # invalidations immediately; restore that guarantee for
+            # what we RETURN by re-verifying each match against the
+            # API directly.  (A stale miss only delays discovery one
+            # sync — the resync backstop's existing drift window.)
+            confirmed = []
+            for accelerator in result:
+                try:
+                    tags = self.apis.ga.list_tags_for_resource(
+                        accelerator.accelerator_arn)
+                except AWSAPIError:
+                    continue  # deleted out from under the scan
+                if tags_contains_all_values(tags, target):
+                    confirmed.append(accelerator)
+            result = confirmed
         if len(result) == 1:
             with self._cache_lock:
                 self._discovery_cache[key] = (result[0].accelerator_arn,
@@ -253,24 +284,6 @@ class AWSProvider:
         with self._cache_lock:
             if self._cache_gen == gen:
                 self._tags_cache[arn] = (tags, time.monotonic())
-
-    def _tags_for(self, arn: str, fresh: bool = False):
-        """ListTags with a TTL cache, for scan loops only — verification
-        paths call the API directly so a cache hit is never trusted to
-        confirm itself.  Out-of-band tag edits surface within the TTL,
-        the same drift window the informer-resync backstop already has.
-        ``fresh=True`` skips the cache read (still writes through,
-        generation-fenced) for rescans after a failed verify."""
-        with self._cache_lock:
-            hit = self._tags_cache.get(arn)
-            now = time.monotonic()
-            if (not fresh and hit is not None
-                    and now - hit[1] < self.discovery_cache_ttl):
-                return hit[0]
-            gen = self._cache_gen
-        tags = self.apis.ga.list_tags_for_resource(arn)
-        self._store_tags(arn, tags, gen)
-        return tags
 
     # ------------------------------------------------------------------
     # Ensure (create-or-update) for Service / Ingress

@@ -12,7 +12,9 @@ the ``kubernetes`` package is absent in this environment).
 """
 from __future__ import annotations
 
+import itertools
 import logging
+import os
 from typing import List, Optional
 
 from ..apis.endpointgroupbinding.v1alpha1 import EndpointGroupBinding
@@ -20,6 +22,11 @@ from .apiserver import FakeAPIServer
 from .objects import Event, Ingress, Lease, ObjectMeta, Service
 
 logger = logging.getLogger(__name__)
+
+# per-process uniqueness for Event names: one urandom draw at import,
+# then a counter (itertools.count is atomic under the GIL)
+_EVENT_PREFIX = os.urandom(5).hex()
+_event_seq = itertools.count()
 
 
 class _TypedNamespacedClient:
@@ -138,13 +145,14 @@ class EventRecorder:
         self.component = component
 
     def event(self, obj, type_: str, reason: str, message: str) -> None:
-        import uuid
-
-        # unique suffix, like client-go's timestamp-suffixed event names;
-        # must not rely on store internals (the HTTP backend has none)
+        # unique suffix, like client-go's timestamp-suffixed event
+        # names; must not rely on store internals (the HTTP backend has
+        # none).  A per-process random prefix + counter: uuid4 here
+        # cost one urandom syscall per Event on the reconcile hot path
         ev = Event(
             metadata=ObjectMeta(
-                name=f"{obj.metadata.name}.{reason}.{uuid.uuid4().hex[:10]}",
+                name=(f"{obj.metadata.name}.{reason}."
+                      f"{_EVENT_PREFIX}{next(_event_seq)}"),
                 namespace=obj.metadata.namespace or "default"),
             involved_object_kind=obj.kind,
             involved_object_key=obj.key(),
