@@ -572,11 +572,18 @@ class _Watcher:
         self._deliver(etype, obj)
 
     def _stream(self) -> None:
+        # client-go parity: request BOOKMARKs explicitly (a real
+        # apiserver sends them ONLY when asked — without this the
+        # resume point only advances on real events, growing the relist
+        # window) and bound the stream server-side with timeoutSeconds
+        # (the apiserver ends it with a clean EOF; _run reconnects)
         path = (f"{self._codec.collection_path(None)}"
-                f"?watch=true&resourceVersion={self._rv}")
-        # long timeout: the server trickles events; reconnect on idle
+                f"?watch=true&resourceVersion={self._rv}"
+                f"&allowWatchBookmarks=true"
+                f"&timeoutSeconds={WATCH_TIMEOUT_S}")
+        # socket timeout just above the server's stream bound
         resp = self._client.request("GET", path, stream=True,
-                                    timeout=300.0)
+                                    timeout=WATCH_TIMEOUT_S + 30.0)
         with self._resp_lock:
             if self._stop.is_set():   # stop() raced the connect
                 resp.close()
@@ -601,6 +608,12 @@ class _Watcher:
 
 class _WatchExpired(Exception):
     pass
+
+
+# server-side watch stream bound requested by the client (client-go
+# picks a random 5-10 min value; the apiserver closes the stream with a
+# clean EOF when it elapses and the watcher reconnects from its RV)
+WATCH_TIMEOUT_S = 300
 
 
 class HTTPAPIServer:

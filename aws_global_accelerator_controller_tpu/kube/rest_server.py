@@ -24,6 +24,7 @@ import json
 import logging
 import socket
 import threading
+import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler
 from typing import Dict, Optional, Tuple
@@ -35,6 +36,11 @@ from .http_store import Codec, default_codecs
 from .tlsutil import enable_tls, make_threading_http_server
 
 logger = logging.getLogger(__name__)
+
+# server-side bound on watch streams when the client omits
+# timeoutSeconds (the real apiserver's --min-request-timeout analogue);
+# also the reaping backstop for dead no-bookmark connections
+DEFAULT_WATCH_TIMEOUT_S = 1800.0
 
 _HISTORY = 1024  # watch replay window per kind
 
@@ -414,6 +420,24 @@ class KubeRestServer:
             rv = int(query.get("resourceVersion", ["0"])[0])
         except ValueError:
             rv = 0
+        # real-apiserver semantics: BOOKMARK frames only when the
+        # client opts in (allowWatchBookmarks=true), and the stream is
+        # bounded by the client's timeoutSeconds — it ends with a clean
+        # EOF and the client reconnects from its resume RV
+        bookmarks = query.get("allowWatchBookmarks",
+                              ["false"])[0] == "true"
+        try:
+            timeout_s = float(query.get("timeoutSeconds", ["0"])[0])
+        except ValueError:
+            timeout_s = 0.0
+        if timeout_s <= 0:
+            # the real apiserver imposes a server-side bound even when
+            # the client omits timeoutSeconds (--min-request-timeout);
+            # without one, an idle no-bookmark watch whose socket died
+            # would hold its handler thread forever (nothing is ever
+            # written, so the death is never observed)
+            timeout_s = DEFAULT_WATCH_TIMEOUT_S
+        deadline = time.monotonic() + timeout_s
         oldest = state.oldest_rv()
         with state.cond:
             window_start = state.window_start
@@ -434,6 +458,8 @@ class KubeRestServer:
             self._watch_conns.add(req.connection)
         try:
             while not self._stop.is_set():
+                if deadline is not None and time.monotonic() > deadline:
+                    return  # timeoutSeconds elapsed: clean EOF
                 with state.cond:
                     pending = [(erv, etype, wire)
                                for erv, etype, wire in state.history
@@ -441,6 +467,12 @@ class KubeRestServer:
                     if not pending:
                         state.cond.wait(timeout=1.0)
                 if not pending:
+                    if not bookmarks:
+                        # the real apiserver sends nothing on an idle
+                        # stream unless bookmarks were requested; a
+                        # dead socket is then only noticed at the next
+                        # event write or the timeoutSeconds bound
+                        continue
                     # idle BOOKMARK (outside the cond lock): confirms
                     # the client's resume point like the real apiserver
                     # and doubles as a liveness probe — writing to a

@@ -1005,3 +1005,81 @@ def test_watch_stream_protobuf_content_type_named_error(monkeypatch):
         client.request("GET", "/api/v1/services?watch=true",
                        stream=True)
     assert stream.closed  # no leaked connection behind the error
+
+
+def test_watch_bookmarks_are_opt_in_and_timeout_bounds_stream(rest,
+                                                              http_api):
+    """Real-apiserver watch semantics: BOOKMARK frames only when
+    allowWatchBookmarks=true is requested (a silent idle stream
+    otherwise), and timeoutSeconds ends the stream with a clean EOF.
+    The repo's own client requests both (client-go parity)."""
+    import json as json_mod
+    import socket as socket_mod
+    import time as time_mod
+    import urllib.request
+
+    http_api.store("Service").create(Service(
+        metadata=ObjectMeta(name="bk", namespace="default"),
+        spec=ServiceSpec(type="ClusterIP")))
+
+    def read_stream(params, seconds):
+        req = urllib.request.urlopen(
+            rest.url + "/api/v1/services?watch=true&resourceVersion=0"
+            + params, timeout=seconds + 5)
+        lines, t0 = [], time_mod.monotonic()
+        try:
+            for line in req:
+                if line.strip():
+                    lines.append(json_mod.loads(line))
+                if time_mod.monotonic() - t0 > seconds:
+                    break
+        except (TimeoutError, socket_mod.timeout):
+            pass
+        finally:
+            req.close()
+        return lines, time_mod.monotonic() - t0
+
+    # without the opt-in: the replayed ADDED, then silence (>1s covers
+    # the stub's 1s idle tick that would otherwise write a BOOKMARK)
+    lines, _ = read_stream("", 2.5)
+    assert [l["type"] for l in lines] == ["ADDED"]
+
+    # with the opt-in: bookmarks arrive on the idle stream
+    lines, _ = read_stream("&allowWatchBookmarks=true", 2.5)
+    assert lines[0]["type"] == "ADDED"
+    assert any(l["type"] == "BOOKMARK" for l in lines[1:])
+
+    # timeoutSeconds: clean EOF (loop exits by itself) near the bound
+    lines, took = read_stream("&timeoutSeconds=2", 10)
+    assert [l["type"] for l in lines] == ["ADDED"]
+    assert took < 5, f"stream not bounded by timeoutSeconds ({took})"
+
+
+def test_client_watch_requests_bookmarks_and_timeout(monkeypatch):
+    """The informer-facing watcher must ask for what it relies on:
+    allowWatchBookmarks (resume-point advance on idle streams) and
+    timeoutSeconds (server-bounded streams -> prompt reconnect)."""
+    from aws_global_accelerator_controller_tpu.kube.http_store import (
+        _Watcher,
+        default_codecs,
+    )
+
+    paths = []
+
+    class _Client:
+        def request(self, method, path, body=None, stream=False,
+                    timeout=None):
+            paths.append(path)
+            raise OSError("stop here: only the path matters")
+
+    w = _Watcher(client=_Client(), codec=default_codecs()["Service"],
+                 q=__import__("queue").Queue(), start_rv=7)
+    try:
+        w._stream()
+    except OSError:
+        pass
+    assert len(paths) == 1
+    assert "watch=true" in paths[0]
+    assert "resourceVersion=7" in paths[0]
+    assert "allowWatchBookmarks=true" in paths[0]
+    assert "timeoutSeconds=300" in paths[0]
