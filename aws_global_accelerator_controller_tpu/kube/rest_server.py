@@ -437,7 +437,15 @@ class KubeRestServer:
             # would hold its handler thread forever (nothing is ever
             # written, so the death is never observed)
             timeout_s = DEFAULT_WATCH_TIMEOUT_S
+        # cap: an arbitrarily large client value would defeat that
+        # same dead-connection backstop (the apiserver clamps too)
+        timeout_s = min(timeout_s, DEFAULT_WATCH_TIMEOUT_S)
         deadline = time.monotonic() + timeout_s
+        # a watch stream is the connection's last exchange: ending it
+        # (timeoutSeconds, shutdown) must close the connection so the
+        # chunked terminator reaches keep-alive clients immediately
+        # instead of stalling them in handle_one_request
+        req.close_connection = True
         oldest = state.oldest_rv()
         with state.cond:
             window_start = state.window_start
@@ -458,7 +466,7 @@ class KubeRestServer:
             self._watch_conns.add(req.connection)
         try:
             while not self._stop.is_set():
-                if deadline is not None and time.monotonic() > deadline:
+                if time.monotonic() > deadline:
                     return  # timeoutSeconds elapsed: clean EOF
                 with state.cond:
                     pending = [(erv, etype, wire)
