@@ -44,6 +44,16 @@ def register(sub) -> None:
                             "per-step targets (full causal flash/ring "
                             "attention, richer signal; both loaders "
                             "produce the per-step law).")
+    train.add_argument("--layout", choices=("contiguous", "zigzag"),
+                       default="contiguous",
+                       help="Time-axis placement for --sharded "
+                            "temporal with --supervision sequence: "
+                            "zigzag pairs chunk i with chunk 2n-1-i "
+                            "per shard, balancing the causal ring so "
+                            "every step costs half a block on every "
+                            "device (~2x attention wall time at "
+                            "scale); the planner handles window/"
+                            "target placement and serving.")
     train.add_argument("--top-k", type=int, default=1, dest="top_k",
                        help="Experts per group (moe): 1 = switch "
                             "routing, 2 = GShard-style top-2 (gate-"
@@ -288,6 +298,13 @@ def _build_model(args):
                 return planner.forward(
                     params, planner.shard_window(window), batch.mask)
         else:
+            if getattr(args, "layout", "contiguous") == "zigzag":
+                # silently training the plain dense path would let the
+                # user believe they exercised the balanced ring
+                raise SystemExit(
+                    "--layout zigzag only applies to --sharded "
+                    "temporal training (it balances the ring across "
+                    "sequence shards; a single device has no ring)")
             # donation: params/Adam state update in place on device
             # (the guard's restore path never reuses pre-step buffers)
             step_fn = jax.jit(model.train_step, donate_argnums=(0, 1))
@@ -417,8 +434,20 @@ def _temporal_planner(args, model):
             f"--sharded needs --window divisible by the seq axis "
             f"({n_seq}) and --groups by the data axis ({n_data}); got "
             f"window={args.window} groups={args.groups}")
-    logger.info("temporal mesh: data=%d seq=%d", n_data, n_seq)
-    return ShardedTemporalPlanner(model, mesh, window=args.window)
+    layout = getattr(args, "layout", "contiguous")
+    if layout == "zigzag":
+        if args.supervision != "sequence":
+            raise SystemExit(
+                "--layout zigzag requires --supervision sequence "
+                "(last supervision never runs the ring it balances)")
+        if args.window % (2 * n_seq):
+            raise SystemExit(
+                f"--layout zigzag needs --window divisible by "
+                f"2x the seq axis ({2 * n_seq}); got {args.window}")
+    logger.info("temporal mesh: data=%d seq=%d layout=%s", n_data,
+                n_seq, layout)
+    return ShardedTemporalPlanner(model, mesh, window=args.window,
+                                  layout=layout)
 
 
 def _moe_planner(args, model):

@@ -166,17 +166,22 @@ class TemporalTrafficModel(TrainableModel):
         return self._head(params, attended[-1]).reshape(g, e)
 
     def scores_last(self, params: Params, window: jax.Array,
-                    attend_last=None) -> jax.Array:
+                    attend_last=None, last_index: int = -1
+                    ) -> jax.Array:
         """[T, G, E, F] -> [G, E] scores in O(T*S*D) — same math as
         ``scores`` but only the final query row is ever formed: the
         last step attends its whole history (causality is vacuous for
         the last row), softmax over T, one weighted sum.  No [T, T]
         matrix, no flash kernel needed.  ``attend_last`` overrides
         with a fn(q_last [S, D], k, v [T, S, D]) -> [S, D] (the
-        sharded planner's seam)."""
+        sharded planner's seam).  ``last_index`` names which row is
+        the temporally-last one — under the zigzag ring layout the
+        final timestep lives at the end of shard 0's block, not at
+        row -1 (the attended key set is order-free, so only the query
+        row needs the index)."""
         t, g, e, f = window.shape
         emb, k, v = self._embed_kv(params, window)
-        q_last = emb[-1] @ params["wq"]                # [S, D]
+        q_last = emb[last_index] @ params["wq"]        # [S, D]
         attend_last = attend_last or attention_last_reference
         rep = attend_last(q_last, k, v)                # [S, D]
         return self._head(params, rep).reshape(g, e)

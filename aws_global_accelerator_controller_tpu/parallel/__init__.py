@@ -34,6 +34,8 @@ from .plan import (  # noqa: F401
 from .ring import ewma_reference, make_mesh_1d, make_ring_ewma  # noqa: F401
 from .ring_attention import (  # noqa: F401
     attention_reference,
+    inverse_zigzag_indices,
     make_last_attention,
     make_ring_attention,
+    zigzag_indices,
 )

@@ -106,9 +106,21 @@ class ShardedTemporalPlanner:
                  data_axis: "str | Sequence[str]" = "data",
                  seq_axis: str = "seq",
                  local: "str | None" = None,
-                 window: "int | None" = None):
+                 window: "int | None" = None,
+                 layout: str = "contiguous"):
         from ..models.temporal import FLASH_MIN_WINDOW
 
+        if layout not in ("contiguous", "zigzag"):
+            raise ValueError(f"unknown layout {layout!r}")
+        if layout == "zigzag" and model.supervision != "sequence":
+            # last supervision never runs the ring (O(T) last-query
+            # path both for training and serving), so zigzag placement
+            # would cost a permutation and buy nothing
+            raise ValueError(
+                "layout='zigzag' requires supervision='sequence' — "
+                "the balanced ring only pays off when the full causal "
+                "attention is load-bearing")
+        self.layout = layout
         self.model = model
         self.mesh = mesh
         # data_axis may name several mesh axes (a DCN-outer replica
@@ -129,8 +141,10 @@ class ShardedTemporalPlanner:
                      if want_flash and block_len >= FLASH_MIN_WINDOW
                      else "einsum")
         ring = make_ring_attention(mesh, seq_axis, causal=True,
-                                   local=local, head_axis=data_axis)
+                                   local=local, head_axis=data_axis,
+                                   layout=layout)
         self._attend = ring
+        self._n_seq = mesh.shape[seq_axis]
 
         rep = NamedSharding(mesh, P())
         win_s = NamedSharding(mesh, P(seq_axis, data_axis, None, None))
@@ -153,11 +167,22 @@ class ShardedTemporalPlanner:
         # per shard), regardless of supervision mode
         last_attend = self._last_attend = make_last_attention(
             mesh, seq_axis, data_axis)
-        self._forward = jax.jit(
-            lambda params, window, mask: plan_weights(
+        n_seq = self._n_seq
+
+        def _fwd(params, window, mask):
+            # zigzag places the final timestep at the end of shard 0's
+            # block — global row T/n - 1 of the permuted array; the
+            # attended key set is order-free so only the query row
+            # moves.  Contiguous keeps the plain -1.
+            last_index = (window.shape[0] // n_seq - 1
+                          if layout == "zigzag" else -1)
+            return plan_weights(
                 model.scores_last(params, window,
-                                  attend_last=last_attend), mask),
-            in_shardings=(rep, win_s, ge_s), out_shardings=ge_s)
+                                  attend_last=last_attend,
+                                  last_index=last_index), mask)
+
+        self._forward = jax.jit(
+            _fwd, in_shardings=(rep, win_s, ge_s), out_shardings=ge_s)
 
         if model.supervision == "sequence":
             def step(params, opt_state, window, batch):
@@ -201,9 +226,25 @@ class ShardedTemporalPlanner:
                 for k, v in params.items()}
 
     def shard_window(self, window):
+        if self.layout == "zigzag":
+            from .ring_attention import zigzag_indices
+
+            window = jnp.take(window, zigzag_indices(
+                window.shape[0], self._n_seq), axis=0)
         return jax.device_put(window, self.window_sharding)
 
     def shard_batch(self, batch: Batch) -> Batch:
+        if (self.layout == "zigzag"
+                and self.model.supervision == "sequence"):
+            # per-step targets ride the window's time axis: permute
+            # them identically so step t's scores still meet step t's
+            # targets (the mean-over-steps loss is order-free)
+            from .ring_attention import zigzag_indices
+
+            batch = Batch(
+                features=batch.features, mask=batch.mask,
+                target=jnp.take(batch.target, zigzag_indices(
+                    batch.target.shape[0], self._n_seq), axis=0))
         return Batch(*[jax.device_put(v, s)
                        for v, s in zip(batch, self.batch_shardings)])
 

@@ -91,6 +91,34 @@ def test_sharded_temporal_trains_and_plans(tmp_path, capsys):
     assert all(0 <= w <= 255 for row in plan["weights"] for w in row)
 
 
+def test_zigzag_temporal_trains_and_rejects_misuse(tmp_path, capsys):
+    """--layout zigzag: sequence-supervised sharded training runs the
+    balanced causal ring end-to-end from the CLI; misconfigurations
+    (last supervision, window not divisible by 2x the seq axis) get
+    direct messages instead of shard_map shape errors."""
+    import pytest
+
+    ckpt = str(tmp_path / "zck")
+    assert main(["train", "--model", "temporal", "--sharded",
+                 "--supervision", "sequence", "--layout", "zigzag",
+                 "--steps", "2", "--ckpt", ckpt, "--groups", "4",
+                 "--endpoints", "4", "--hidden", "16",
+                 "--window", "16"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "temporal" and out["step"] == 2
+    with pytest.raises(SystemExit, match="supervision sequence"):
+        main(["train", "--model", "temporal", "--sharded",
+              "--layout", "zigzag", "--steps", "1", "--groups", "4",
+              "--endpoints", "4", "--hidden", "16", "--window", "16"])
+    # window=6 divides the seq axis (2) but not 2x it — only the
+    # zigzag check can catch this
+    with pytest.raises(SystemExit, match="divisible by"):
+        main(["train", "--model", "temporal", "--sharded",
+              "--supervision", "sequence", "--layout", "zigzag",
+              "--steps", "1", "--groups", "4", "--endpoints", "4",
+              "--hidden", "16", "--window", "6"])
+
+
 def test_sharded_rejects_indivisible_shapes(capsys):
     import pytest
 
