@@ -254,6 +254,14 @@ def _build_model(args):
         raise SystemExit(
             f"--loader {loader_kind} supports the mlp, deep and "
             f"temporal families; moe generates its own batch law")
+    if (getattr(args, "layout", "contiguous") == "zigzag"
+            and not (args.model == "temporal" and sharded)):
+        # silently training a non-ring path would let the user believe
+        # they exercised the balanced ring — reject for EVERY branch,
+        # not just single-chip temporal
+        raise SystemExit(
+            "--layout zigzag only applies to --sharded temporal "
+            "training (it balances the ring across sequence shards)")
     if args.model == "temporal":
         from ..models.temporal import TemporalTrafficModel, synthetic_window
 
@@ -298,13 +306,8 @@ def _build_model(args):
                 return planner.forward(
                     params, planner.shard_window(window), batch.mask)
         else:
-            if getattr(args, "layout", "contiguous") == "zigzag":
-                # silently training the plain dense path would let the
-                # user believe they exercised the balanced ring
-                raise SystemExit(
-                    "--layout zigzag only applies to --sharded "
-                    "temporal training (it balances the ring across "
-                    "sequence shards; a single device has no ring)")
+            # (--layout zigzag already rejected by the top-of-dispatch
+            # guard: a single device has no ring)
             # donation: params/Adam state update in place on device
             # (the guard's restore path never reuses pre-step buffers)
             step_fn = jax.jit(model.train_step, donate_argnums=(0, 1))

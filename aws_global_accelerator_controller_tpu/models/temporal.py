@@ -131,13 +131,30 @@ class TemporalTrafficModel(TrainableModel):
         return attention_reference(q, k, v, causal=True)
 
     def _embed_kv(self, params: Params, window: jax.Array):
-        """[T, G, E, F] -> (emb [T, S, D], k, v) shared by every path."""
+        """[T, G, E, F] -> (emb [T, S, D], k, v) for the last-query
+        path: K/V projected in ONE packed [D, 2D] matmul (emb read
+        once), q formed later from a single row."""
         t, g, e, f = window.shape
         x = window.astype(jnp.bfloat16).reshape(t, g * e, f)
         emb = x @ params["embed"]                      # [T, S, D]
-        k = emb @ params["wk"]
-        v = emb @ params["wv"]
-        return emb, k, v
+        d = emb.shape[-1]
+        kv = emb @ jnp.concatenate((params["wk"], params["wv"]),
+                                   axis=1)             # [T, S, 2D]
+        return emb, kv[..., :d], kv[..., d:]
+
+    def _embed_qkv(self, params: Params, window: jax.Array):
+        """[T, G, E, F] -> (q, k, v [T, S, D]) for the full-attention
+        paths: one packed [D, 3D] projection — the MXU sees a single
+        wide matmul and emb crosses HBM once instead of three times
+        (same contraction per output column, so numerics match the
+        separate per-weight matmuls)."""
+        t, g, e, f = window.shape
+        x = window.astype(jnp.bfloat16).reshape(t, g * e, f)
+        emb = x @ params["embed"]                      # [T, S, D]
+        d = emb.shape[-1]
+        qkv = emb @ jnp.concatenate(
+            (params["wq"], params["wk"], params["wv"]), axis=1)
+        return qkv[..., :d], qkv[..., d:2 * d], qkv[..., 2 * d:]
 
     def _head(self, params: Params, rep: jax.Array) -> jax.Array:
         """[..., D] attended representation -> [...] float32 score."""
@@ -160,8 +177,7 @@ class TemporalTrafficModel(TrainableModel):
         """
         attend = attend or self._attend
         t, g, e, f = window.shape
-        emb, k, v = self._embed_kv(params, window)
-        q = emb @ params["wq"]
+        q, k, v = self._embed_qkv(params, window)
         attended = attend(q, k, v)                     # [T, S, D]
         return self._head(params, attended[-1]).reshape(g, e)
 
@@ -194,8 +210,7 @@ class TemporalTrafficModel(TrainableModel):
         kernel / ring sharding) is genuinely load-bearing."""
         attend = attend or self._attend
         t, g, e, f = window.shape
-        emb, k, v = self._embed_kv(params, window)
-        q = emb @ params["wq"]
+        q, k, v = self._embed_qkv(params, window)
         attended = attend(q, k, v)                     # [T, S, D]
         head = (jax.checkpoint(self._head) if self.remat
                 else self._head)

@@ -218,19 +218,34 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
 def _stats_kernel(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
                   m_ref, l_ref, acc_ref, *, causal: bool,
-                  t: int, block_q: int, block_k: int, num_k: int):
-    """Like _kernel but emits UNNORMALISED output plus the (m, l) softmax
-    stats, so a caller (ring attention) can merge blocks computed
-    elsewhere with the standard two-level flash recurrence."""
+                  t: int, block_q: int, block_k: int, num_k: int,
+                  normalize: bool = False):
+    """Like _kernel but also emits the (m, l) softmax stats, so a
+    caller can either merge blocks computed elsewhere with the
+    standard two-level flash recurrence (ring attention;
+    ``normalize=False`` keeps o UNNORMALISED f32) or save softmax
+    state for a flash VJP (``normalize=True`` divides at finalize and
+    writes o in the output ref's dtype — no XLA normalisation pass
+    re-reading the f32 accumulator from HBM).  Stats outputs are
+    width-1 ([Bq, 1]): the scratch is lane-padded VMEM but only lane 0
+    carries data, and writing all 128 lanes to HBM made the stats cost
+    as much traffic as the output itself."""
     _attend_step(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
                  causal=causal, t=t, block_q=block_q,
                  block_k=block_k, num_k=num_k)
 
     @pl.when(pl.program_id(2) == num_k - 1)
     def _finalize():
-        o_ref[0] = acc_ref[:]
-        m_out_ref[0] = m_ref[:]
-        l_out_ref[0] = l_ref[:]
+        if normalize:
+            # padded query rows never attend (l == 0): the guard keeps
+            # them finite; their dO is zero in the backward anyway
+            o_ref[0] = (acc_ref[:]
+                        / jnp.maximum(l_ref[:, 0], 1.0)[:, None]
+                        ).astype(o_ref.dtype)
+        else:
+            o_ref[0] = acc_ref[:]
+        m_out_ref[0] = m_ref[:, :1]
+        l_out_ref[0] = l_ref[:, :1]
 
 
 def _pad_axis(x, axis, to):
@@ -464,18 +479,27 @@ def _flash_fwd_padded(q, k, v, causal, block_q, block_k, interpret):
     """Head-major forward keeping the PADDED per-row stats for the VJP.
 
     q/k/v: [H, T, D], q PRE-SCALED by ``_prescale`` -> (o [H, T, D]
-    normalised f32, m [H, Tp, LANE], l [H, Tp, LANE]) where Tp is T
-    rounded up to block_q."""
+    normalised, in q's dtype, m [H, Tp, 1], l [H, Tp, 1]) where Tp is
+    T rounded up to block_q.  o is normalised INSIDE the kernel and
+    stored at input precision: the backward only needs it for
+    dvec = rowsum(dO * O), and a separate f32 copy doubled the
+    residual's HBM bill for one rounding step of precision."""
     h, t, d = q.shape
-    o_un, m, l = _flash_stats_padded(q, k, v, causal, block_q, block_k,
-                                     interpret)
-    o = o_un[:, :t, :d] / jnp.maximum(l[:, :t, :1], 1.0)
-    return o, m, l
+    o, m, l = _flash_stats_padded(q, k, v, causal, block_q, block_k,
+                                  interpret, normalize=True,
+                                  out_dtype=q.dtype)
+    return o[:, :t, :d], m, l
 
 
-def _flash_stats_padded(q, k, v, causal, block_q, block_k, interpret):
+def _flash_stats_padded(q, k, v, causal, block_q, block_k, interpret,
+                        normalize=False, out_dtype=None):
     """The pallas_call shared by _flash_stats (public, slices) and the
-    VJP forward (keeps padding).  Head-major [H, T, D] inputs."""
+    VJP forward (keeps padding).  Head-major [H, T, D] inputs.
+    ``normalize`` + ``out_dtype`` select the VJP flavor: o divided by l
+    at kernel finalize and stored in ``out_dtype`` (the residual the
+    backward's dvec needs — saving it f32 doubled its HBM bill);
+    default is the ring-merge flavor (UNNORMALISED f32 o).  m/l come
+    back width-1 ([H, Tp, 1] f32) either way."""
     h, t, d = q.shape
     t_k = k.shape[1]
     tp_q = -(-t // block_q) * block_q
@@ -493,7 +517,7 @@ def _flash_stats_padded(q, k, v, causal, block_q, block_k, interpret):
     return pl.pallas_call(
         functools.partial(_stats_kernel, causal=causal,
                           t=t_k, block_q=block_q, block_k=block_k,
-                          num_k=num_k),
+                          num_k=num_k, normalize=normalize),
         grid=(h, tp_q // block_q, num_k),
         in_specs=[
             pl.BlockSpec((1, block_q, dp), lambda hh, i, j: (hh, i, 0),
@@ -506,15 +530,16 @@ def _flash_stats_padded(q, k, v, causal, block_q, block_k, interpret):
         out_specs=[
             pl.BlockSpec((1, block_q, dp), lambda hh, i, j: (hh, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0),
+            pl.BlockSpec((1, block_q, 1), lambda hh, i, j: (hh, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0),
+            pl.BlockSpec((1, block_q, 1), lambda hh, i, j: (hh, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((h, tp_q, dp), jnp.float32),
-            jax.ShapeDtypeStruct((h, tp_q, _LANE), jnp.float32),
-            jax.ShapeDtypeStruct((h, tp_q, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((h, tp_q, dp),
+                                 out_dtype or jnp.float32),
+            jax.ShapeDtypeStruct((h, tp_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((h, tp_q, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANE), jnp.float32),
@@ -532,11 +557,14 @@ def _flash_stats_padded(q, k, v, causal, block_q, block_k, interpret):
                                     "interpret"))
 def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
                       interpret):
-    """Head-major backward.  q/k/v/o/do: [H, T, D] (o f32; q/k/v/do keep
-    their native dtype so the MXU runs bf16 passes; q is the PRE-SCALED
-    q' the forward saved as its residual); m/l: [H, Tp, 1] stats saved
-    by the forward (re-broadcast to the lane width here, like dvec —
-    residuals stay 1-lane).  Returns (dq, dk, dv) [H, T, D] f32."""
+    """Head-major backward.  q/k/v/o/do: [H, T, D] (all native dtype —
+    the MXU runs bf16 passes, and o only feeds dvec; q is the
+    PRE-SCALED q' the forward saved as its residual); m/l: [H, Tp, 1]
+    f32 stats saved by the forward, fed to the kernels at width 1 — no
+    lane broadcast is ever materialised in HBM.  Returns (dq, dk, dv)
+    [H, T, D] in the inputs' dtypes (cast at kernel finalize from the
+    f32 accumulators — same single rounding the old f32-out + XLA-cast
+    route paid, minus its extra HBM round-trip)."""
     h, t, d = q.shape
     scale = d ** -0.5  # applied once to dq at finalize (chain rule)
     tp_q = -(-t // block_q) * block_q
@@ -545,14 +573,13 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
     qp = _pad_axis(_pad_axis(q, 1, tp_q), 2, dp)
     kp = _pad_axis(_pad_axis(k, 1, tp_k), 2, dp)
     vp = _pad_axis(_pad_axis(v, 1, tp_k), 2, dp)
-    m = jnp.broadcast_to(m, (h, tp_q, _LANE))
-    l = jnp.broadcast_to(l, (h, tp_q, _LANE))
     # padded dO rows are zero, so padded-Q contributions to dK/dV vanish
     dop = _pad_axis(_pad_axis(do, 1, tp_q), 2, dp)
-    # D_i = rowsum(dO_i * O_i), lane-broadcast like the (m, l) stats
-    dvec = jnp.sum(do * o, axis=2)                          # [H, T]
-    dvec = jnp.broadcast_to(
-        _pad_axis(dvec, 1, tp_q)[:, :, None], (h, tp_q, _LANE))
+    # D_i = rowsum(dO_i * O_i), f32 accumulation (XLA fuses the cast
+    # into the reduce — no f32 [H, T, D] temp is materialised)
+    dvec = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                   axis=2)                                  # [H, T]
+    dvec = _pad_axis(dvec, 1, tp_q)[:, :, None]             # [H, Tp, 1]
 
     num_q = tp_q // block_q
     num_k = tp_k // block_k
@@ -567,12 +594,12 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
             qkv_spec((1, block_k, dp), lambda hh, i, j: (hh, j, 0)),
             qkv_spec((1, block_k, dp), lambda hh, i, j: (hh, j, 0)),
             qkv_spec((1, block_q, dp), lambda hh, i, j: (hh, i, 0)),
-            qkv_spec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0)),
-            qkv_spec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0)),
-            qkv_spec((1, block_q, _LANE), lambda hh, i, j: (hh, i, 0)),
+            qkv_spec((1, block_q, 1), lambda hh, i, j: (hh, i, 0)),
+            qkv_spec((1, block_q, 1), lambda hh, i, j: (hh, i, 0)),
+            qkv_spec((1, block_q, 1), lambda hh, i, j: (hh, i, 0)),
         ],
         out_specs=qkv_spec((1, block_q, dp), lambda hh, i, j: (hh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((h, tp_q, dp), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((h, tp_q, dp), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -588,17 +615,17 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
             qkv_spec((1, block_k, dp), lambda hh, j, i: (hh, j, 0)),
             qkv_spec((1, block_k, dp), lambda hh, j, i: (hh, j, 0)),
             qkv_spec((1, block_q, dp), lambda hh, j, i: (hh, i, 0)),
-            qkv_spec((1, block_q, _LANE), lambda hh, j, i: (hh, i, 0)),
-            qkv_spec((1, block_q, _LANE), lambda hh, j, i: (hh, i, 0)),
-            qkv_spec((1, block_q, _LANE), lambda hh, j, i: (hh, i, 0)),
+            qkv_spec((1, block_q, 1), lambda hh, j, i: (hh, i, 0)),
+            qkv_spec((1, block_q, 1), lambda hh, j, i: (hh, i, 0)),
+            qkv_spec((1, block_q, 1), lambda hh, j, i: (hh, i, 0)),
         ],
         out_specs=[
             qkv_spec((1, block_k, dp), lambda hh, j, i: (hh, j, 0)),
             qkv_spec((1, block_k, dp), lambda hh, j, i: (hh, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((h, tp_k, dp), jnp.float32),
-            jax.ShapeDtypeStruct((h, tp_k, dp), jnp.float32),
+            jax.ShapeDtypeStruct((h, tp_k, dp), k.dtype),
+            jax.ShapeDtypeStruct((h, tp_k, dp), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, dp), jnp.float32),
@@ -622,12 +649,12 @@ def _flash_diff_fwd(q, k, v, causal, block_q, block_k, interpret):
     # score recompute then reproduces the forward's s (and p) exactly
     qh = _prescale(jnp.transpose(q, (1, 0, 2)))
     kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (k, v))
+    # oh arrives normalised, input-dtype, already width-1 stats: the
+    # residual tuple is O(T) per row and carries no f32 output copy
     oh, m, l = _flash_fwd_padded(qh, kh, vh, causal, block_q, block_k,
                                  interpret)
     o = jnp.transpose(oh, (1, 0, 2)).astype(q.dtype)
-    # keep only lane 0 of the stats: residual memory stays O(T), not
-    # O(T * LANE) — the backward re-broadcasts
-    return o, (qh, kh, vh, oh, m[:, :, :1], l[:, :, :1])
+    return o, (qh, kh, vh, oh, m, l)
 
 
 def _flash_diff_bwd(causal, block_q, block_k, interpret, res, do):

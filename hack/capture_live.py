@@ -10,6 +10,13 @@ evidence survives even if the session dies right after the tunnel does.
 Meant to be invoked by ``hack/tpu_watch.sh`` the moment a probe sees the
 tunnel alive, but safe to run by hand.  Exit 0 iff at least one TPU
 bench produced a non-skipped result.
+
+Optional argv: leg names (see ``BENCHES``) to run only those — for a
+second window after a partial capture (the tunnel tends to give one
+healthy early window, then wedge mid-list).  A partial run MERGES into
+the existing ``BENCH_LIVE.json`` instead of overwriting it, so the legs
+already captured live keep their evidence; success then means "every
+requested leg produced a non-skipped result".
 """
 from __future__ import annotations
 
@@ -33,7 +40,7 @@ BENCHES = [
     ("flash-long", 660.0),
     ("temporal", 660.0),
     ("smoke", 660.0),
-    ("temporal-breakdown", 1300.0),
+    ("temporal-breakdown", 2400.0),
     ("planner", 660.0),
     ("autotune", 2500.0),
 ]
@@ -71,16 +78,29 @@ def _utc() -> str:
         "%Y-%m-%dT%H:%M:%SZ")
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    known = {name for name, _ in BENCHES}
+    unknown = [a for a in argv if a not in known]
+    if unknown:
+        print(f"unknown legs {unknown}; known: {sorted(known)}",
+              file=sys.stderr)
+        return 2
+    selected = [(n, b) for n, b in BENCHES if not argv or n in argv]
+    partial = bool(argv)
+
     ART.mkdir(exist_ok=True)
     stamp = _utc().replace(":", "")
     transcript = ART / f"transcript_{stamp}.log"
     results: dict = {}
     any_live = False
+    ok_legs: list = []
     with transcript.open("w") as log:
         log.write(f"# live TPU bench capture started {_utc()}\n")
         log.write("# host cmd: python bench.py <name> (see bench.py)\n")
-        for name, budget in BENCHES:
+        if partial:
+            log.write(f"# partial capture: {[n for n, _ in selected]}\n")
+        for name, budget in selected:
             start = _utc()
             log.write(f"\n===== bench.py {name} (start {start}, "
                       f"budget {budget:.0f}s) =====\n")
@@ -109,9 +129,10 @@ def main() -> int:
             results[name] = {"started_at": start, "finished_at": end,
                              **(parsed if isinstance(parsed, dict)
                                 else {"value": parsed})}
-            if isinstance(parsed, dict) and "skipped" not in parsed \
-                    and name in _PERF:
+            leg_ok = isinstance(parsed, dict) and "skipped" not in parsed
+            if leg_ok and name in _PERF:
                 any_live = True
+            ok_legs.append(leg_ok)
             print(f"[capture] {name}: "
                   f"{json.dumps(parsed)[:200]}", flush=True)
 
@@ -133,22 +154,43 @@ def main() -> int:
             "ranked": autotune["ranked"],
         }, indent=2) + "\n")
 
+    live_path = ART / "BENCH_LIVE.json"
+    merged_results, live_flag = results, any_live
+    transcripts = [transcript.name]
+    if partial and live_path.exists():
+        try:
+            prior = json.loads(live_path.read_text())
+        except ValueError:
+            prior = {}
+        merged_results = {**(prior.get("results") or {}), **results}
+        live_flag = any_live or bool(prior.get("live"))
+        # keep the evidence chain: carried-over legs live in the PRIOR
+        # capture's transcript(s), not this partial run's
+        transcripts = [t for t in (prior.get("transcripts")
+                                   or ([prior["transcript"]]
+                                       if prior.get("transcript")
+                                       else []))
+                       if t != transcript.name] + transcripts
     payload = {
         "measured_at": _utc(),
         "transcript": transcript.name,
-        "live": any_live,
-        "results": results,
+        "transcripts": transcripts,
+        "live": live_flag,
+        "results": merged_results,
     }
-    (ART / "BENCH_LIVE.json").write_text(json.dumps(payload, indent=2)
-                                         + "\n")
+    live_path.write_text(json.dumps(payload, indent=2) + "\n")
     # commit ONLY the artifact paths: the watcher may fire while the
     # working tree holds unrelated in-progress edits
     subprocess.run(["git", "add", "bench_artifacts"], cwd=REPO)
     subprocess.run(
         ["git", "commit",
          "-m", f"bench: live TPU capture {payload['measured_at']} "
-               f"(live={any_live})",
+               f"(live={live_flag}"
+               + (f", legs={'+'.join(n for n, _ in selected)}"
+                  if partial else "") + ")",
          "--", "bench_artifacts"], cwd=REPO)
+    if partial:
+        return 0 if ok_legs and all(ok_legs) else 1
     return 0 if any_live else 1
 
 

@@ -9,6 +9,10 @@ set -u
 cd "$(dirname "$0")/.."
 
 DEADLINE_H="${1:-11}"
+shift 2>/dev/null || true
+# remaining args: leg names forwarded to capture_live.py (partial
+# second-window capture; empty = the full list)
+LEGS=("$@")
 SLEEP_S=240
 export PROBE_TIMEOUT=75
 end=$(( $(date +%s) + DEADLINE_H * 3600 ))
@@ -23,7 +27,7 @@ EOF
     echo "$(date -u +%FT%TZ) probe: ${status}"
     if [ "$status" = "tpu" ]; then
         echo "$(date -u +%FT%TZ) tunnel ALIVE - capturing"
-        if python hack/capture_live.py; then
+        if python hack/capture_live.py ${LEGS[@]+"${LEGS[@]}"}; then
             echo "$(date -u +%FT%TZ) capture complete"
             exit 0
         fi
