@@ -35,11 +35,14 @@ ART = REPO / "bench_artifacts"
 # timeout x2 for the built-in retry, plus interpreter startup) so the
 # wrapper never kills a bench that was about to finish or skip
 # gracefully.
+# smoke first: it is the Mosaic compile gate — if a kernel-layout
+# change broke TPU lowering, every later leg would fail anyway and
+# smoke's per-variant compile report is the diagnostic we want
 BENCHES = [
+    ("smoke", 660.0),
     ("flash", 660.0),
     ("flash-long", 660.0),
     ("temporal", 660.0),
-    ("smoke", 660.0),
     ("temporal-breakdown", 2400.0),
     ("planner", 660.0),
     ("autotune", 2500.0),
