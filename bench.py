@@ -235,16 +235,29 @@ def _run_subprocess(code: str, timeout: float, what: str,
     The tunneled TPU backend can hang indefinitely at device init
     (observed in this environment); a wedged attempt must neither block
     the primary metric nor kill the whole bench, and one retry covers
-    transient tunnel hiccups.  Returns (stdout or None, diagnostic)."""
+    transient tunnel hiccups.  Returns (stdout or None, diagnostic).
+
+    Every child gets JAX's persistent compilation cache pointed at a
+    repo-local dir (unless the caller already set one): compiles over
+    the tunnel run 20-40s each and dominate a live window's budget, so
+    re-compiling graphs the previous window already built is the
+    difference between a leg finishing and "backend unresponsive"."""
     import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    if "JAX_COMPILATION_CACHE_DIR" not in env:
+        cache = os.path.join(repo, "bench_artifacts", "jax_cache")
+        os.makedirs(cache, exist_ok=True)
+        env["JAX_COMPILATION_CACHE_DIR"] = cache
 
     last = ""
     for attempt in range(retries + 1):
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
-                text=True, timeout=timeout,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
+                text=True, timeout=timeout, env=env,
+                cwd=repo)
             if proc.returncode == 0:
                 return proc.stdout.strip(), f"{what} ok"
             last = f"{what} failed: {proc.stderr.strip()[-300:]}"
