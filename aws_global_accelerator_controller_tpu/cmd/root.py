@@ -92,6 +92,14 @@ def build_parser() -> argparse.ArgumentParser:
                                  "trained params instead of the "
                                  "seed-0 init.  Requires "
                                  "--weight-policy model.")
+    controller.add_argument("--policy-reload-seconds", type=float,
+                            default=0.0, metavar="SECONDS",
+                            help="With --policy-checkpoint: poll the "
+                                 "checkpoint directory every SECONDS "
+                                 "and hot-swap retrained weights into "
+                                 "the running controller (a failed "
+                                 "reload keeps the current weights). "
+                                 "0 disables (default).")
     controller.add_argument("--seed", action="append", default=[],
                             metavar="FILE",
                             help="Apply YAML manifests into the fake API "
@@ -141,6 +149,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run_controller(args) -> int:
     policy_instance = None
+    reload_s = getattr(args, "policy_reload_seconds", 0.0)
+    if reload_s < 0:
+        raise SystemExit(
+            "--policy-reload-seconds must be >= 0 (0 disables)")
+    if reload_s and not getattr(args, "policy_checkpoint", ""):
+        raise SystemExit(
+            "--policy-reload-seconds needs --policy-checkpoint "
+            "(a checkpoint directory to follow)")
     if getattr(args, "policy_checkpoint", ""):
         if getattr(args, "weight_policy", "static") != "model":
             raise SystemExit(
@@ -148,12 +164,21 @@ def run_controller(args) -> int:
                 "(static ignores model params)")
         # load EAGERLY: a bad checkpoint must abort startup here, not
         # crash the leader-run thread after election (where the process
-        # would keep serving health checks while reconciling nothing)
-        from ..controller.weightpolicy import ModelWeightPolicy
+        # would keep serving health checks while reconciling nothing).
+        # With --policy-reload-seconds the SAME eager contract applies
+        # to the first load; only subsequent reloads degrade softly.
+        from ..controller.weightpolicy import (
+            ModelWeightPolicy,
+            ReloadingModelWeightPolicy,
+        )
 
         try:
-            policy_instance = ModelWeightPolicy.from_checkpoint(
-                args.policy_checkpoint)
+            if reload_s:
+                policy_instance = ReloadingModelWeightPolicy(
+                    args.policy_checkpoint, reload_s)
+            else:
+                policy_instance = ModelWeightPolicy.from_checkpoint(
+                    args.policy_checkpoint)
         except (OSError, ValueError) as e:
             raise SystemExit(f"--policy-checkpoint: {e}")
     stop = setup_signal_handler()
@@ -246,6 +271,9 @@ def run_controller(args) -> int:
     finally:
         if health is not None:
             health.shutdown()
+        if policy_instance is not None and hasattr(policy_instance,
+                                                  "close"):
+            policy_instance.close()
     return 0
 
 

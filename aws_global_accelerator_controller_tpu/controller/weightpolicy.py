@@ -176,11 +176,100 @@ class ModelWeightPolicy:
         return f
 
 
+class ReloadingModelWeightPolicy:
+    """A :class:`ModelWeightPolicy` that follows its checkpoint.
+
+    Closes the train→serve loop operationally: a retraining Job keeps
+    writing steps to the shared checkpoint PVC
+    (``config/samples/train-job.yaml``) and the RUNNING controller
+    picks the new weights up — no rollout, no restart.  A background
+    thread polls ``latest_step()`` (an orbax directory listing, no
+    restore) every ``interval_s``; on a new step it restores through
+    the same validated ``from_checkpoint`` path the CLI uses at
+    startup and swaps the inner policy in one reference assignment
+    (readers either see the old policy or the new one, never a
+    half-initialised mix).
+
+    Failure posture is asymmetric by design: the FIRST load fails
+    loudly (same startup contract as ``--policy-checkpoint``), but a
+    bad RELOAD — half-written step, config mismatch, corrupt artifact
+    — logs, counts (``policy_reloads_total{outcome="error"}``), and
+    keeps serving the weights that were already good.  A training bug
+    must never take down a healthy control plane.
+    """
+
+    def __init__(self, directory: str, interval_s: float,
+                 hidden_dim: "int | None" = None):
+        import threading
+
+        if interval_s <= 0:
+            raise ValueError("reload interval must be > 0 seconds")
+        self._directory = directory
+        self._hidden_dim = hidden_dim
+        self._inner = ModelWeightPolicy.from_checkpoint(
+            directory, hidden_dim=hidden_dim)
+        self._interval = float(interval_s)
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="policy-reload", daemon=True)
+        self._thread.start()
+
+    @property
+    def restored_step(self) -> int:
+        return self._inner.restored_step
+
+    def plan(self, binding: EndpointGroupBinding,
+             endpoint_group: EndpointGroup,
+             endpoint_ids: List[str]) -> Dict[str, Optional[int]]:
+        # local ref: the swap can land mid-plan without mixing params
+        return self._inner.plan(binding, endpoint_group, endpoint_ids)
+
+    def poll_once(self) -> bool:
+        """One reload check (the thread's body; public so tests drive
+        it deterministically).  True iff new weights were swapped in."""
+        from ..metrics import record_policy_reload
+        from ..models.checkpoint import TrainCheckpointer
+
+        try:
+            with TrainCheckpointer(self._directory,
+                                   create=False) as ckpt:
+                latest = ckpt.latest_step()
+            if latest is None or latest == self._inner.restored_step:
+                return False
+            fresh = ModelWeightPolicy.from_checkpoint(
+                self._directory, hidden_dim=self._hidden_dim)
+        except Exception as exc:  # noqa: BLE001 — serve-old-on-error
+            logger.warning(
+                "policy reload from %s failed (serving step %d "
+                "weights unchanged): %s", self._directory,
+                self._inner.restored_step, exc)
+            record_policy_reload("error")
+            return False
+        previous = self._inner.restored_step
+        self._inner = fresh
+        logger.info("policy reloaded from %s: step %d -> %d",
+                    self._directory, previous, fresh.restored_step)
+        record_policy_reload("ok")
+        return True
+
+    def _run(self) -> None:
+        while not self._wake.wait(self._interval):
+            self.poll_once()
+
+    def close(self) -> None:
+        self._wake.set()
+        self._thread.join(timeout=5.0)
+
+
 def make_weight_policy(kind: str, checkpoint_dir: str = ""):
     """"static" (reference parity, default) or "model";
     ``checkpoint_dir`` restores trained params into the model policy
     (meaningless with static, so that combination is rejected rather
-    than ignored)."""
+    than ignored).  Hot reload is NOT a factory concern: a
+    :class:`ReloadingModelWeightPolicy` owns a background thread whose
+    ``close()`` is the constructor's caller's responsibility, so the
+    CLI (the one production owner, ``cmd/root.py:run_controller``)
+    constructs it directly and closes it on shutdown."""
     if kind == "static":
         if checkpoint_dir:
             raise ValueError(

@@ -138,6 +138,10 @@ default_registry.describe(
     "weight_plans_total",
     "Endpoint-group weight plans applied, by policy implementation "
     "and value source (spec / model).")
+default_registry.describe(
+    "policy_reloads_total",
+    "Hot reloads of the trained weight-policy checkpoint, by outcome "
+    "(ok / error — error keeps serving the previous weights).")
 
 
 def record_watch_event(kind: str, event: str,
@@ -166,6 +170,14 @@ def record_weight_plan(policy: str, source: str,
     reg = registry or default_registry
     reg.inc_counter("weight_plans_total",
                     {"policy": policy, "source": source})
+
+
+def record_policy_reload(outcome: str,
+                         registry: Optional[Registry] = None) -> None:
+    """One hot-reload attempt of the policy checkpoint resolved:
+    ``ok`` (new weights serving) or ``error`` (kept the old ones)."""
+    reg = registry or default_registry
+    reg.inc_counter("policy_reloads_total", {"outcome": outcome})
 
 
 def record_sync(queue_name: str, result: str, duration: float,

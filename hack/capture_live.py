@@ -183,15 +183,20 @@ def main(argv=None) -> int:
     }
     live_path.write_text(json.dumps(payload, indent=2) + "\n")
     # commit ONLY the artifact paths: the watcher may fire while the
-    # working tree holds unrelated in-progress edits
-    subprocess.run(["git", "add", "bench_artifacts"], cwd=REPO)
+    # working tree holds unrelated in-progress edits.  git's stdout is
+    # swallowed — when this script runs under nohup redirected into
+    # bench_artifacts/, commit chatter would append itself to an
+    # already-staged capture log
+    subprocess.run(["git", "add", "bench_artifacts"], cwd=REPO,
+                   stdout=subprocess.DEVNULL)
     subprocess.run(
         ["git", "commit",
          "-m", f"bench: live TPU capture {payload['measured_at']} "
                f"(live={live_flag}"
                + (f", legs={'+'.join(n for n, _ in selected)}"
                   if partial else "") + ")",
-         "--", "bench_artifacts"], cwd=REPO)
+         "--", "bench_artifacts"], cwd=REPO,
+        stdout=subprocess.DEVNULL)
     if partial:
         return 0 if ok_legs and all(ok_legs) else 1
     return 0 if any_live else 1

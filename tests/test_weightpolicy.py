@@ -325,3 +325,164 @@ def test_from_checkpoint_missing_dir_leaves_no_litter(tmp_path):
     with pytest.raises(FileNotFoundError):
         ModelWeightPolicy.from_checkpoint(str(target))
     assert not target.exists()
+
+
+# -- checkpoint hot reload (round 4: the train->serve loop closes) ----------
+
+
+def _save_policy_step(directory, step, scale=1.0):
+    """Write one orbax step the way a retraining Job would, with
+    ``scale`` perturbing the params so successive steps plan
+    observably different weights."""
+    import jax
+
+    from aws_global_accelerator_controller_tpu.controller.weightpolicy import (  # noqa: E501
+        FEATURE_DIM,
+    )
+    from aws_global_accelerator_controller_tpu.models.checkpoint import (
+        TrainCheckpointer,
+    )
+    from aws_global_accelerator_controller_tpu.models.traffic import (
+        TrafficPolicyModel,
+    )
+
+    model = TrafficPolicyModel(feature_dim=FEATURE_DIM)
+    params = model.init_params(jax.random.PRNGKey(1))
+    params = jax.tree_util.tree_map(lambda x: x * scale, params)
+    with TrainCheckpointer(str(directory)) as ckpt:
+        ckpt.save(step, params, model.init_opt_state(params), wait=True)
+
+
+def test_reloading_policy_swaps_on_new_step(tmp_path):
+    """A new checkpoint step written while the controller runs swaps
+    into the serving policy (poll driven deterministically via
+    poll_once); plans change accordingly and the step is visible."""
+    from aws_global_accelerator_controller_tpu.controller.weightpolicy import (  # noqa: E501
+        ReloadingModelWeightPolicy,
+    )
+
+    d = tmp_path / "ckpt"
+    _save_policy_step(d, 1, scale=1.0)
+    policy = ReloadingModelWeightPolicy(str(d), interval_s=3600.0)
+    try:
+        assert policy.restored_step == 1
+        before = policy.plan(_binding(None), _eg(), [LB, LB2])
+        # no new step yet: poll is a no-op
+        assert policy.poll_once() is False
+        assert policy.restored_step == 1
+
+        _save_policy_step(d, 2, scale=4.0)
+        assert policy.poll_once() is True
+        assert policy.restored_step == 2
+        after = policy.plan(_binding(None), _eg(), [LB, LB2])
+        assert after != before, (
+            "retrained params did not reach the serving plan")
+        # explicit spec.weight still wins after a reload
+        assert policy.plan(_binding(7), _eg(), [LB, LB2]) == {
+            LB: 7, LB2: 7}
+    finally:
+        policy.close()
+
+
+def test_reloading_policy_keeps_serving_on_bad_reload(tmp_path):
+    """A reload failure (config-mismatched retrain) must keep the
+    good weights serving and count an error — a training bug must
+    never take down a healthy control plane."""
+    from aws_global_accelerator_controller_tpu.controller.weightpolicy import (  # noqa: E501
+        ReloadingModelWeightPolicy,
+    )
+
+    d = tmp_path / "ckpt"
+    _save_policy_step(d, 1)
+    policy = ReloadingModelWeightPolicy(str(d), interval_s=3600.0)
+    try:
+        before = policy.plan(_binding(None), _eg(), [LB, LB2])
+        # a wrong-width retrain lands as step 2 (hidden_dim != default)
+        import jax
+
+        from aws_global_accelerator_controller_tpu.controller.weightpolicy import (  # noqa: E501
+            FEATURE_DIM,
+        )
+        from aws_global_accelerator_controller_tpu.models.checkpoint import (  # noqa: E501
+            TrainCheckpointer,
+        )
+        from aws_global_accelerator_controller_tpu.models.traffic import (
+            TrafficPolicyModel,
+        )
+        wrong = TrafficPolicyModel(feature_dim=FEATURE_DIM,
+                                   hidden_dim=64)
+        params = wrong.init_params(jax.random.PRNGKey(2))
+        with TrainCheckpointer(str(d)) as ckpt:
+            ckpt.save(2, params, wrong.init_opt_state(params),
+                      wait=True)
+
+        import aws_global_accelerator_controller_tpu.metrics as metrics
+
+        counted = []
+        orig = metrics.record_policy_reload
+        metrics.record_policy_reload = (
+            lambda outcome, registry=None: counted.append(outcome))
+        try:
+            assert policy.poll_once() is False
+        finally:
+            metrics.record_policy_reload = orig
+        assert counted == ["error"]
+        assert policy.restored_step == 1
+        assert policy.plan(_binding(None), _eg(), [LB, LB2]) == before
+    finally:
+        policy.close()
+
+
+def test_reloading_policy_background_thread_reloads(tmp_path):
+    """The real thread path: a short interval picks up a new step
+    without any explicit poll, and close() joins the thread."""
+    from aws_global_accelerator_controller_tpu.controller.weightpolicy import (  # noqa: E501
+        ReloadingModelWeightPolicy,
+    )
+
+    d = tmp_path / "ckpt"
+    _save_policy_step(d, 1)
+    policy = ReloadingModelWeightPolicy(str(d), interval_s=0.2)
+    try:
+        _save_policy_step(d, 5, scale=3.0)
+        wait_until(lambda: policy.restored_step == 5, timeout=30.0,
+                   message="background reload picked up step 5")
+    finally:
+        policy.close()
+    assert not policy._thread.is_alive()
+
+
+def test_reloading_policy_rejects_bad_interval(tmp_path):
+    """Non-positive intervals fail at construction (the CLI maps this
+    to its own --policy-reload-seconds message before reaching here)."""
+    from aws_global_accelerator_controller_tpu.controller.weightpolicy import (  # noqa: E501
+        ReloadingModelWeightPolicy,
+    )
+
+    d = tmp_path / "ckpt"
+    _save_policy_step(d, 1)
+    with pytest.raises(ValueError, match="interval"):
+        ReloadingModelWeightPolicy(str(d), interval_s=0.0)
+
+
+def _controller_cli(*extra):
+    return subprocess.run(
+        [sys.executable, "-m",
+         "aws_global_accelerator_controller_tpu", "controller",
+         "--fake", "--weight-policy", "model", *extra],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_controller_cli_rejects_reload_without_checkpoint():
+    proc = _controller_cli("--policy-reload-seconds", "30")
+    assert proc.returncode != 0
+    assert "--policy-checkpoint" in proc.stderr
+
+
+def test_controller_cli_rejects_negative_reload_interval():
+    """The error blames the interval flag, not --policy-checkpoint."""
+    proc = _controller_cli("--policy-checkpoint", "/nonexistent",
+                           "--policy-reload-seconds", "-5")
+    assert proc.returncode != 0
+    assert "--policy-reload-seconds" in proc.stderr
