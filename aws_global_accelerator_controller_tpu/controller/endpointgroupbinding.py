@@ -285,13 +285,11 @@ class EndpointGroupBindingController:
             # source comes from the policy type + spec, not from
             # sampling one planned value
             from ..metrics import record_weight_plan
-            from .weightpolicy import ModelWeightPolicy
+            from .weightpolicy import plan_source
 
             record_weight_plan(
                 type(self.weight_policy).__name__,
-                "spec" if obj.spec.weight is not None else "model"
-                if isinstance(self.weight_policy, ModelWeightPolicy)
-                else "default")
+                plan_source(self.weight_policy, obj.spec.weight))
 
         copied = obj.deep_copy()
         copied.status.endpoint_ids = results

@@ -261,6 +261,19 @@ class ReloadingModelWeightPolicy:
         self._thread.join(timeout=5.0)
 
 
+def plan_source(policy, spec_weight) -> str:
+    """Value-source label for ``weight_plans_total``: an explicit
+    spec.weight is "spec"; otherwise any model-backed policy (direct
+    or hot-reloading) planned the values — "model"; static with a
+    null weight leaves the cloud default — "default"."""
+    if spec_weight is not None:
+        return "spec"
+    if isinstance(policy, (ModelWeightPolicy,
+                           ReloadingModelWeightPolicy)):
+        return "model"
+    return "default"
+
+
 def make_weight_policy(kind: str, checkpoint_dir: str = ""):
     """"static" (reference parity, default) or "model";
     ``checkpoint_dir`` restores trained params into the model policy

@@ -286,3 +286,53 @@ def test_committed_tuned_table_is_valid_if_present():
         assert int(band["t_max"]) > 0
         assert int(band["block_q"]) > 0
         assert int(band["block_k"]) > 0
+
+
+def test_triangular_grid_padded_t():
+    """Square causal multi-block tilings take the scalar-prefetched
+    triangular grid (dead upper-triangle blocks never iterated); a T
+    that does not divide the block exercises the padded final K block
+    inside the triangle, forward and backward."""
+    q, k, v = _qkv(100, 2, 8, seed=41)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    cot = jnp.ones((100, 2, 8))
+    got_g = jax.grad(
+        lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=32, block_k=32) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    want_g = _oracle_grads(q, k, v, True, cot)
+    for g, w in zip(got_g, want_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_triangular_grid_uneven_blocks_stay_rectangular():
+    """block_q != block_k is outside the triangle's preconditions —
+    the rectangular predicated grid must still produce the oracle."""
+    q, k, v = _qkv(128, 2, 8, seed=43)
+    got = flash_attention(q, k, v, causal=True, block_q=32, block_k=64)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_triangular_stats_path():
+    """flash_attention_stats over a square causal multi-block tiling
+    (the ring local leg) rides the triangular grid too: unnormalised
+    o / l recover the oracle."""
+    from aws_global_accelerator_controller_tpu.ops.pallas_attention import (
+        flash_attention_stats,
+    )
+
+    q, k, v = _qkv(96, 2, 8, seed=47)
+    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    o_un, m, l = flash_attention_stats(qh, kh, vh, causal=True,
+                                       block_q=32, block_k=32)
+    got = jnp.transpose(o_un / l[:, :, None], (1, 0, 2))
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

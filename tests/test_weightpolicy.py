@@ -486,3 +486,29 @@ def test_controller_cli_rejects_negative_reload_interval():
                            "--policy-reload-seconds", "-5")
     assert proc.returncode != 0
     assert "--policy-reload-seconds" in proc.stderr
+
+
+def test_plan_source_classification(tmp_path):
+    """weight_plans_total's source label: a hot-reloading policy is a
+    model source exactly like the direct one (dashboards keyed on
+    source="model" must not read zero when reload is enabled)."""
+    from aws_global_accelerator_controller_tpu.controller.weightpolicy import (  # noqa: E501
+        ReloadingModelWeightPolicy,
+        plan_source,
+    )
+
+    static = StaticWeightPolicy()
+    model = ModelWeightPolicy()
+    assert plan_source(static, 7) == "spec"
+    assert plan_source(model, 7) == "spec"
+    assert plan_source(static, None) == "default"
+    assert plan_source(model, None) == "model"
+
+    d = tmp_path / "ckpt"
+    _save_policy_step(d, 1)
+    reloading = ReloadingModelWeightPolicy(str(d), interval_s=3600.0)
+    try:
+        assert plan_source(reloading, None) == "model"
+        assert plan_source(reloading, 3) == "spec"
+    finally:
+        reloading.close()
