@@ -824,6 +824,43 @@ def bench_temporal_subprocess(timeout: float = 300.0) -> dict:
                                   "tpu temporal bench", timeout)
 
 
+def bench_flash_xl(t: int = 32768, h: int = 4, d: int = 128) -> dict:
+    """Extreme-long-context point: T=32768, the regime where dense
+    attention is structurally impossible on one chip (the [T, T] f32
+    score tensor alone is 4 GB per head) and the kernel's O(T) memory
+    plus the triangular block grid carry the whole load — at 1024-wide
+    tiles the triangle iterates 528 of the rectangular grid's 1024
+    blocks per head.  H=4 keeps a chained measurement inside the
+    subprocess budget (fwd ~= 1.1 TFLOP per step)."""
+    from aws_global_accelerator_controller_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+
+    setup = _flash_setup(t, h, d)
+    if isinstance(setup, dict):
+        return setup
+    jax, jnp, q, k, v, marginal_s, flops = setup
+
+    fwd_s = marginal_s(
+        lambda qq: flash_attention(qq, k, v, causal=True), n=16,
+        reps=3)
+    grad_s = marginal_s(jax.grad(
+        lambda qq: jnp.sum(flash_attention(qq, k, v, causal=True)
+                           .astype(jnp.float32))), n=8, reps=3)
+    grad_flops = flops * 3.5
+    peak, kind = _tpu_peak(jax.devices()[0])
+    return {
+        "device_kind": kind,
+        "shape": {"t": t, "h": h, "d": d},
+        "fwd_us": round(fwd_s * 1e6, 1),
+        "fwd_tflops": round(flops / fwd_s / 1e12, 2),
+        "fwd_mfu_pct": round(100.0 * flops / fwd_s / peak, 2),
+        "grad_us": round(grad_s * 1e6, 1),
+        "grad_tflops": round(grad_flops / grad_s / 1e12, 2),
+        "grad_mfu_pct": round(100.0 * grad_flops / grad_s / peak, 2),
+    }
+
+
 def bench_flash_subprocess(timeout: float = 300.0) -> dict:
     return _json_bench_subprocess("bench_flash", "tpu flash bench",
                                   timeout)
@@ -1142,6 +1179,10 @@ def bench_report() -> str:
                             f"{live_transcript}`)" if live_transcript
                             else f"**live capture {live_date}** "
                             f"({detail})")
+            elif row.get("pending"):
+                # a leg added before any measurement exists must not
+                # masquerade as builder-claimed
+                evidence = "none yet — awaiting first live window"
             else:
                 evidence = f"builder-claimed ({claims['measured_at']})"
         lines.append(f"| {row['label']} | {row['shape']} | "
@@ -1162,6 +1203,9 @@ _NAMED = {
         "bench_planner", "planner bench", 300.0),
     "flash": bench_flash_subprocess,
     "flash-long": bench_flash_long_subprocess,
+    "flash-xl": lambda: _json_bench_subprocess(
+        "bench_flash_xl", "tpu flash extreme-long-context bench",
+        480.0),
     "temporal": bench_temporal_subprocess,
     "autotune": lambda: _json_bench_subprocess(
         "autotune_flash_blocks", "flash block autotune", 1200.0),

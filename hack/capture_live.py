@@ -42,6 +42,7 @@ BENCHES = [
     ("smoke", 660.0),
     ("flash", 660.0),
     ("flash-long", 660.0),
+    ("flash-xl", 1100.0),
     ("temporal", 660.0),
     ("temporal-breakdown", 2400.0),
     ("planner", 660.0),
@@ -49,7 +50,7 @@ BENCHES = [
 ]
 # the benches whose success means "we captured a live perf number";
 # smoke passing is necessary but not sufficient (it only compiles)
-_PERF = ("flash", "flash-long", "temporal")
+_PERF = ("flash", "flash-long", "flash-xl", "temporal")
 
 
 def _run_group(cmd, budget: float):
