@@ -1022,7 +1022,8 @@ def main() -> None:
     status, detail = tpu_probe()
     if status == "dead":
         skip = {"skipped": f"backend wedged: {detail}"}
-        flash, flash_long, temporal = skip, dict(skip), dict(skip)
+        flash, flash_long, flash_xl, temporal = (
+            skip, dict(skip), dict(skip), dict(skip))
         # device init wedges, but the backend-agnostic planner bench
         # still produces a number with the platform pinned to cpu
         planner_line = bench_planner_subprocess(force_cpu=True)
@@ -1035,21 +1036,29 @@ def main() -> None:
             smoke = bench_smoke_subprocess()
             flash = bench_flash_subprocess()
             flash_long = bench_flash_long_subprocess()
+            flash_xl = _json_bench_subprocess(
+                "bench_flash_xl",
+                "tpu flash extreme-long-context bench", 480.0)
             temporal = bench_temporal_subprocess()
         else:
             skip = {"skipped": f"non-tpu backend ({detail})"}
-            flash, flash_long, temporal = skip, dict(skip), dict(skip)
+            flash, flash_long, flash_xl, temporal = (
+                skip, dict(skip), dict(skip), dict(skip))
     if status != "tpu":
         smoke = {"skipped": flash.get("skipped", "")}
     smoke = _label_evidence(_attach_last_live(smoke, "smoke"))
     flash = _label_evidence(_attach_last_live(flash, "flash"))
     flash_long = _label_evidence(
         _attach_last_live(flash_long, "flash-long"))
+    flash_xl = _label_evidence(
+        _attach_last_live(flash_xl, "flash-xl"))
     temporal = _label_evidence(_attach_last_live(temporal, "temporal"))
     _record_reconcile_history(reconcile)
     print(f"tpu compile smoke: {smoke}", file=sys.stderr)
     print(f"tpu flash: {flash}", file=sys.stderr)
     print(f"tpu flash long-context (T=8192): {flash_long}", file=sys.stderr)
+    print(f"tpu flash extreme long-context (T=32768): {flash_xl}",
+          file=sys.stderr)
     print(f"tpu temporal train: {temporal}", file=sys.stderr)
     print(planner_line, file=sys.stderr)
 
@@ -1066,6 +1075,7 @@ def main() -> None:
         "tpu_smoke": smoke,
         "tpu_flash": flash,
         "tpu_flash_long": flash_long,
+        "tpu_flash_xl": flash_xl,
         "tpu_temporal_train": temporal,
     }))
 
