@@ -1168,8 +1168,9 @@ def bench_report() -> str:
     lines.append("| Bench | Shape | Result | Evidence |")
     lines.append("|---|---|---|---|")
     # capture_live.py wraps each leg's payload with bookkeeping
-    # timestamps; only the measurement keys belong in the doc
-    wrapper_keys = ("started_at", "finished_at")
+    # timestamps + transcript provenance; only the measurement keys
+    # belong in the doc
+    wrapper_keys = ("started_at", "finished_at", "transcript")
     for row in claims["rows"]:
         if "evidence" in row:
             # a row with static evidence (e.g. reconcile: reproduced
@@ -1184,10 +1185,18 @@ def bench_report() -> str:
                 detail = ", ".join(
                     f"{k}={v}" for k, v in entry.items()
                     if k not in wrapper_keys).replace("|", "\\|")
-                evidence = (f"**live capture {live_date}** ({detail}; "
+                # cite the transcript + window that actually measured
+                # THIS leg: merged partial captures carry legs from
+                # earlier windows whose evidence lives in earlier
+                # transcripts (top-level transcript is the fallback
+                # for pre-provenance captures)
+                leg_transcript = (entry.get("transcript")
+                                  or live_transcript)
+                leg_date = entry.get("finished_at") or live_date
+                evidence = (f"**live capture {leg_date}** ({detail}; "
                             f"transcript `bench_artifacts/"
-                            f"{live_transcript}`)" if live_transcript
-                            else f"**live capture {live_date}** "
+                            f"{leg_transcript}`)" if leg_transcript
+                            else f"**live capture {leg_date}** "
                             f"({detail})")
             elif row.get("pending"):
                 # a leg added before any measurement exists must not

@@ -130,7 +130,12 @@ def main(argv=None) -> int:
             end = _utc()
             log.write(f"===== bench.py {name} done {end} =====\n")
             log.flush()
+            # per-leg transcript provenance: a partial second-window
+            # capture merges into BENCH_LIVE.json, so carried-over
+            # legs cite a DIFFERENT transcript than this run's —
+            # bench.py report reads this field per row
             results[name] = {"started_at": start, "finished_at": end,
+                             "transcript": transcript.name,
                              **(parsed if isinstance(parsed, dict)
                                 else {"value": parsed})}
             leg_ok = isinstance(parsed, dict) and "skipped" not in parsed

@@ -334,7 +334,8 @@ def test_bench_report_live_overlay(monkeypatch, tmp_path):
     live.write_text(json.dumps({
         "measured_at": "2026-07-31T01:00:00Z",
         "transcript": "transcript_y.log",
-        "results": {"flash": {"started_at": "x", "finished_at": "y",
+        "results": {"flash": {"started_at": "2026-07-31T00:40:00Z",
+                              "finished_at": "2026-07-31T00:41:00Z",
                               "fwd_us": 99.0, "note": "a|b"}},
     }))
     monkeypatch.setattr(bench, "_CLAIMS_PATH", str(claims))
@@ -349,3 +350,48 @@ def test_bench_report_live_overlay(monkeypatch, tmp_path):
     assert "started_at" not in doc and "finished_at" not in doc
     assert "a\\|b" in rows["fwd"]  # pipe escaped, table intact
     assert "transcript_y.log" in rows["fwd"]
+    # the leg's own window is the cited date, not the capture's
+    assert "live capture 2026-07-31T00:41:00Z" in rows["fwd"]
+
+
+def test_bench_report_per_leg_transcripts(monkeypatch, tmp_path):
+    """A merged partial capture carries legs measured in DIFFERENT
+    windows; each row must cite the transcript that actually recorded
+    it, not the newest capture's (r4 review finding)."""
+    claims = tmp_path / "claims.json"
+    claims.write_text(json.dumps({
+        "measured_at": "2026-07-30", "device": "v5e",
+        "rows": [
+            {"bench": "flash", "label": "fwd", "shape": "s",
+             "result": "r"},
+            {"bench": "planner", "label": "plan", "shape": "s",
+             "result": "r"},
+        ]}))
+    live = tmp_path / "live.json"
+    live.write_text(json.dumps({
+        "measured_at": "2026-07-31T04:49:18Z",
+        "transcript": "transcript_new.log",
+        "transcripts": ["transcript_old.log", "transcript_new.log"],
+        "results": {
+            "flash": {"started_at": "2026-07-31T00:42:03Z",
+                      "finished_at": "2026-07-31T00:42:54Z",
+                      "transcript": "transcript_old.log",
+                      "fwd_us": 99.0},
+            "planner": {"started_at": "2026-07-31T04:44:47Z",
+                        "finished_at": "2026-07-31T04:45:26Z",
+                        "transcript": "transcript_new.log",
+                        "plan_ms": 1.3},
+        },
+    }))
+    monkeypatch.setattr(bench, "_CLAIMS_PATH", str(claims))
+    monkeypatch.setattr(bench, "_LIVE_PATH", str(live))
+    doc = bench.bench_report()
+    rows = {l.split(" | ")[0].strip("| "): l for l in doc.splitlines()
+            if l.startswith("| ")}
+    assert "transcript_old.log" in rows["fwd"]
+    assert "transcript_new.log" not in rows["fwd"]
+    assert "live capture 2026-07-31T00:42:54Z" in rows["fwd"]
+    assert "transcript_new.log" in rows["plan"]
+    assert "live capture 2026-07-31T04:45:26Z" in rows["plan"]
+    # the provenance key itself stays out of the rendered detail
+    assert "transcript=transcript" not in doc
