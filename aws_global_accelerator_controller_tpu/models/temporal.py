@@ -49,7 +49,7 @@ class TemporalTrafficModel(TrainableModel):
     def __init__(self, feature_dim: int = 8, embed_dim: int = 32,
                  hidden_dim: int = 64, learning_rate: float = 1e-3,
                  attention: str = "flash", supervision: str = "last",
-                 remat: bool = False):
+                 remat: bool = False, head: str = "reference"):
         """``supervision`` picks the training objective:
 
         - ``"last"`` (default): only the final step's scores are
@@ -71,12 +71,32 @@ class TemporalTrafficModel(TrainableModel):
         dwarf the flash VJP's O(T) residuals.  Recompute is one relu
         matmul per step; numerics identical (same f32 ops replayed),
         the same lever ``deep --remat`` applies to pipeline stages.
+
+        ``head`` picks the sequence-supervision scoring-head impl
+        (the [T, S, D] -> [T, S] relu-MLP; the 2-D last-row paths are
+        always dense — they are too small to dispatch a kernel for):
+
+        - ``"reference"`` (default): dense XLA.  Measured FASTER than
+          the kernel at the benchmark shape (0.23 vs 0.52 ms fwd+grad
+          on v5e, interleaved A/B) — XLA's epilogue fusion already
+          handles this op; the kernel is kept as a tested negative
+          result (``ops.pallas_head`` docstring).
+        - ``"fused"``: the Pallas fused head
+          (``ops.pallas_head.score_head``) on TPU — one HBM pass in
+          each direction, no [T, S, H] hidden ever materialised, its
+          own recompute VJP (so ``remat`` has nothing left to save
+          and is skipped for the head).  Off-TPU: dense.
+        - ``"fused_always"``: the kernel on any backend (interpret
+          mode off-TPU) — tests prove the fused path end-to-end.
         """
         if attention not in ("flash", "flash_always", "reference"):
             raise ValueError(f"unknown attention impl {attention!r}")
         if supervision not in ("last", "sequence"):
             raise ValueError(f"unknown supervision {supervision!r}")
+        if head not in ("fused", "fused_always", "reference"):
+            raise ValueError(f"unknown head impl {head!r}")
         self.remat = remat
+        self.head = head
         self.feature_dim = feature_dim
         self.embed_dim = embed_dim
         self.hidden_dim = hidden_dim
@@ -156,8 +176,29 @@ class TemporalTrafficModel(TrainableModel):
             (params["wq"], params["wk"], params["wv"]), axis=1)
         return qkv[..., :d], qkv[..., d:2 * d], qkv[..., 2 * d:]
 
+    def _use_fused_head(self, ndim: int = 3) -> bool:
+        """One predicate for BOTH the head dispatch and scores_seq's
+        remat decision — split copies would silently desync (a remat
+        that replays the kernel forward, or a dense head that lost
+        its checkpoint)."""
+        return (ndim == 3
+                and (self.head == "fused_always"
+                     or (self.head == "fused"
+                         and jax.default_backend() == "tpu")))
+
     def _head(self, params: Params, rep: jax.Array) -> jax.Array:
-        """[..., D] attended representation -> [...] float32 score."""
+        """[..., D] attended representation -> [...] float32 score.
+
+        3-D [T, S, D] inputs (the sequence-supervision batch) dispatch
+        to the fused Pallas head per the ``head`` mode (a measured
+        negative result at the benchmark shape — ``ops.pallas_head``
+        docstring — so the default mode is the dense path); 2-D
+        last-row inputs stay dense always.
+        """
+        if self._use_fused_head(rep.ndim):
+            from ..ops.pallas_head import score_head
+            return score_head(rep, params["w1"], params["b1"],
+                              params["w2"], params["b2"])
         h = jnp.maximum(rep.astype(jnp.bfloat16) @ params["w1"]
                         + params["b1"], 0)
         return (h @ params["w2"] + params["b2"])[..., 0].astype(
@@ -212,7 +253,11 @@ class TemporalTrafficModel(TrainableModel):
         t, g, e, f = window.shape
         q, k, v = self._embed_qkv(params, window)
         attended = attend(q, k, v)                     # [T, S, D]
-        head = (jax.checkpoint(self._head) if self.remat
+        # the fused head's VJP recomputes its hidden internally, so
+        # wrapping it in jax.checkpoint would only replay the kernel
+        # forward for nothing — remat applies to the dense head alone
+        head = (jax.checkpoint(self._head)
+                if self.remat and not self._use_fused_head()
                 else self._head)
         return head(params, attended).reshape(t, g, e)
 

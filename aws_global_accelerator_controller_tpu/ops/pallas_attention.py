@@ -482,6 +482,144 @@ def _dq_kernel(*refs, causal: bool, tri: bool, scale: float, t: int,
         dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
 
+# A head's f32 dq accumulator lives whole in VMEM during the fused
+# one-sweep backward; above this byte budget (tp_q * dp * 4) the
+# backward falls back to the two-sweep kernels, whose footprint is
+# O(block) not O(T).  2 MB = T=4096 at D=128 — T=8192 would make the
+# accumulator alone 4 MB on top of the score/dp tiles, untested
+# against the scoped-vmem ceiling, so long-context stays two-sweep.
+_FUSED_BWD_DQ_BYTES = 2 * 2 ** 20
+# Mosaic's scoped-vmem budget shrinks with the surrounding program's
+# VMEM pressure; at the temporal shape (128 streams-as-heads inside a
+# scan training loop) the fused kernel hits kernel-vmem-stack OOM at
+# every block size tried, while h <= 8 compiles and measures faster
+# (341.5 -> 301.0 us at T=2048).  Empirical ceiling with margin; the
+# two-sweep fallback is always correct.
+_FUSED_BWD_MAX_HEADS = 32
+
+
+def _dqkv_kernel(*refs, causal: bool, tri: bool, scale: float,
+                 t: int, block_q: int, block_k: int, num_q: int):
+    """Fused one-sweep backward: dQ, dK, dV from ONE score recompute
+    per live block pair (the two-sweep route recomputes s/p twice —
+    once per kernel — and pays the exp, the VPU ceiling-setter, twice).
+
+    Iteration is the dKV ordering (K block j outer, Q block i inner),
+    so dk/dv accumulate per-column in block scratch exactly as
+    ``_dkv_kernel`` does; dq's visits to a given row i are scattered
+    across columns, so the whole head's dq rides a persistent
+    [Tp_q, D] f32 scratch — init at the head's first step, accumulated
+    at ``pl.ds(i*block_q)``, scaled + cast once at the head's last
+    step (the VMEM budget gate is ``_FUSED_BWD_DQ_BYTES``)."""
+    if tri:
+        tri_ref, *data = refs
+        g = pl.program_id(1)
+        j, i = tri_ref[0, g], tri_ref[1, g]
+        first_q = i == j
+        head_first = g == 0
+        head_last = g == pl.num_programs(1) - 1
+        last_q = i == num_q - 1
+    else:
+        data = list(refs)
+        j = pl.program_id(1)                      # K block (outer)
+        i = pl.program_id(2)                      # Q block (inner)
+        first_q = i == 0
+        head_first = jnp.logical_and(j == 0, i == 0)
+        head_last = jnp.logical_and(j == pl.num_programs(1) - 1,
+                                    i == num_q - 1)
+        last_q = i == num_q - 1
+    (q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
+     dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc) = data
+
+    @pl.when(head_first)
+    def _init_dq():
+        # block-sized stores: whole-scratch assignments materialise
+        # multi-MB stack temporaries that blow the scoped-vmem budget
+        # once XLA's surrounding program (e.g. a lax.scan training
+        # loop) has claimed its share — observed as kernel-vmem-stack
+        # OOM at the temporal bench shape
+        for qb in range(num_q):
+            rows = pl.ds(qb * block_q, block_q)
+            dq_acc[rows, :] = jnp.zeros((block_q, dq_acc.shape[1]),
+                                        dq_acc.dtype)
+
+    @pl.when(first_q)
+    def _init_kv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _accumulate(masked: bool):
+        q = q_ref[0]                              # [Bq, D] pre-scaled
+        k = k_ref[0]                              # [Bk, D]
+        v = v_ref[0]
+        do = do_ref[0]                            # [Bq, D]
+        m = m_ref[0][:, 0]                        # [Bq]
+        l = l_ref[0][:, 0]
+        dvec = d_ref[0][:, 0]                     # [Bq] rowsum(do*o)
+
+        # ONE transposed score tile serves dv, dk AND dq
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [Bk, Bq]
+        if masked:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            keep = k_pos < t
+            if causal:
+                keep &= q_pos >= k_pos
+            s_t = jnp.where(keep, s_t, _NEG_INF)
+        p_t = jnp.exp(s_t - m[None, :]) / jnp.maximum(l, 1.0)[None, :]
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [Bk, Bq]
+        ds_t = (p_t * (dp_t - dvec[None, :])).astype(q.dtype)
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds_t, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dq_i += ds_ij @ K_j — contract the shared Bk dim of the
+        # SAME ds tile (the matmul the two-sweep route re-derived
+        # from a second recompute)
+        rows = pl.ds(i * block_q, block_q)
+        dq_acc[rows, :] += jax.lax.dot_general(
+            ds_t, k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = (jnp.bool_(True) if tri
+            else (i * block_q + block_q - 1 >= j * block_k
+                  ) if causal else jnp.bool_(True))
+    needs_mask = (j * block_k + block_k - 1 > i * block_q
+                  ) if causal else jnp.bool_(False)
+    if (t % block_k) != 0:
+        last_kblock = (num_q - 1 if tri
+                       else pl.num_programs(1) - 1)
+        needs_mask = jnp.logical_or(needs_mask, j == last_kblock)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(needs_mask)))
+    def _fast():
+        _accumulate(masked=False)
+
+    @pl.when(jnp.logical_and(live, needs_mask))
+    def _masked():
+        _accumulate(masked=True)
+
+    @pl.when(last_q)
+    def _finalize_kv():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+    @pl.when(head_last)
+    def _finalize_q():
+        for qb in range(num_q):               # block-sized (see init)
+            rows = pl.ds(qb * block_q, block_q)
+            dq_ref[0, rows, :] = (dq_acc[rows, :] * scale).astype(
+                dq_ref.dtype)
+
+
 def _dkv_kernel(*refs, causal: bool, tri: bool,
                 t: int, block_q: int, block_k: int,
                 num_q: int):
@@ -690,6 +828,60 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
     num_k = tp_k // block_k
     qkv_spec = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     tri = _use_tri(causal, block_q, block_k, tp_q, tp_k)
+
+    # fused one-sweep backward: one score recompute (and one exp pass)
+    # per live pair instead of two — eligible while a whole head's f32
+    # dq accumulator fits the VMEM budget
+    if (tp_q * dp * 4 <= _FUSED_BWD_DQ_BYTES and tp_q == tp_k
+            and h <= _FUSED_BWD_MAX_HEADS):
+        kern = functools.partial(_dqkv_kernel, causal=causal, tri=tri,
+                                 scale=scale, t=t, block_q=block_q,
+                                 block_k=block_k, num_q=num_q)
+        k_map, q_map, grid, npf, extra, dims = _grid_plan(
+            tri, h, num_k, num_q, table_fn=_tri_blocks_kv)
+        if not tri:
+            # _grid_plan's rectangular default marks the K axis
+            # parallel (right for _dkv_kernel, which accumulates only
+            # along the innermost axis) — but dq_acc carries state
+            # across ALL of axis 1 here, so both block axes must stay
+            # sequential or Mosaic may reorder/split them and the
+            # init/finalize no longer bracket the accumulation
+            dims = ("parallel", "arbitrary", "arbitrary")
+        dq_map = ((lambda hh, g, tab: (hh, 0, 0)) if tri
+                  else (lambda hh, j, i: (hh, 0, 0)))
+        dq, dk, dv = pl.pallas_call(
+            kern,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=npf, grid=grid,
+                in_specs=[
+                    qkv_spec((1, block_q, dp), q_map),
+                    qkv_spec((1, block_k, dp), k_map),
+                    qkv_spec((1, block_k, dp), k_map),
+                    qkv_spec((1, block_q, dp), q_map),
+                    qkv_spec((1, block_q, 1), q_map),
+                    qkv_spec((1, block_q, 1), q_map),
+                    qkv_spec((1, block_q, 1), q_map),
+                ],
+                out_specs=[
+                    qkv_spec((1, tp_q, dp), dq_map),
+                    qkv_spec((1, block_k, dp), k_map),
+                    qkv_spec((1, block_k, dp), k_map),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((tp_q, dp), jnp.float32),
+                    pltpu.VMEM((block_k, dp), jnp.float32),
+                    pltpu.VMEM((block_k, dp), jnp.float32),
+                ]),
+            out_shape=[
+                jax.ShapeDtypeStruct((h, tp_q, dp), q.dtype),
+                jax.ShapeDtypeStruct((h, tp_k, dp), k.dtype),
+                jax.ShapeDtypeStruct((h, tp_k, dp), v.dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=dims),
+            interpret=interpret,
+        )(*extra, qp, kp, vp, dop, m, l, dvec)
+        return (dq[:, :t, :d], dk[:, :t, :d], dv[:, :t, :d])
 
     dq_kern = functools.partial(_dq_kernel, causal=causal, tri=tri,
                                 scale=scale, t=t, block_q=block_q,

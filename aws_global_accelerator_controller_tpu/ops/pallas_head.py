@@ -1,0 +1,268 @@
+"""Fused Pallas score head — a tested NEGATIVE result, not the default.
+
+``TemporalTrafficModel._head`` is ``relu(x @ w1 + b1) @ w2 + b2`` over
+[T, S, D] attended representations (S = G*E endpoint streams).  This
+kernel keeps h/dh in VMEM per block — forward reads x once and writes
+[T, S] scores; the custom VJP recomputes h per block (the flash VJP's
+recompute-over-residency trade) and accumulates weight grads in VMEM
+across the sequential grid, so HBM sees only x, dx and the O(D*H)
+weight grads.
+
+Why it is NOT the default: interleaved A/B on v5e (2026-07-31,
+T=2048 S=128 D=128 H=256, n=256 chains — single-shot timings through
+the tunnel drift 4x and first suggested the dense head cost ~1.6 ms)
+measured the dense XLA head at 0.23 ms fwd+grad vs 0.52 ms for this
+kernel: XLA's epilogue fusion already keeps the [T*S, H] hidden cheap
+at this shape, and the kernel's serialized weight-grad accumulation
+loses to XLA's scheduling.  Kept, tested and wired behind
+``TemporalTrafficModel(head="fused")`` as the honest record (and for
+the Mosaic lessons in the kernel comments: no bf16 comparisons on
+v5e, no lane->sublane relayout casts inside a kernel).
+
+Numerics mirror the dense head: matmuls take bf16 operands with an f32
+accumulator rounded back to bf16 (Mosaic requires 32-bit matmul accs),
+bias adds and relu in bf16, scores cast to f32 — interpret mode is
+bit-comparable to the dense bf16 path modulo XLA epilogue-fusion
+rounding (the pallas_mlp contract).  The backward rounds ``dh`` to bf16
+for the dx/dw1 matmuls (standard mixed-precision; XLA's dense path
+carries dh in f32 — per-element difference is last-ulp at bf16 scale,
+covered by tolerance tests).
+
+Shape contract: S and D pad to lane multiples, H to a lane multiple, T
+to the row-block; zero-padding is grad-exact (padded ds rows are zero,
+so no padded row or column perturbs any accumulated gradient).
+Reference behavior: the scoring head of the reference's weight policy
+(pkg/apis EndpointGroupBinding weight semantics) — this kernel is the
+TPU serving/training hot path for it.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+_SUBLANE = 8          # f32 second-minor tile granularity
+_TARGET_ROWS = 4096   # flattened [Bt*S] rows per grid step (VMEM budget)
+
+
+def _bf16_dot(a, b):
+    return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
+        jnp.bfloat16)
+
+
+def _pad_axis(x, axis, to):
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, to - x.shape[axis])
+    return jnp.pad(x, pad)
+
+
+def _row_block(t: int, s_pad: int) -> int:
+    """T-rows per grid step: ~_TARGET_ROWS flattened rows, at least the
+    f32 sublane tile, never more than (padded) T."""
+    bt = max(_SUBLANE, _TARGET_ROWS // s_pad)
+    tp = -(-t // _SUBLANE) * _SUBLANE
+    return min(bt, tp)
+
+
+def _fwd_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, out_ref):
+    bt, s, d = x_ref.shape
+    x = x_ref[:].reshape(bt * s, d)
+    h = jnp.maximum(_bf16_dot(x, w1_ref[:]) + b1_ref[:], 0)
+    sc = _bf16_dot(h, w2_ref[:]) + b2_ref[:]
+    # w2 is padded [H, _LANE] with only column 0 live
+    out_ref[:] = sc[:, 0].reshape(bt, s).astype(jnp.float32)
+
+
+def _dotT(a, b, contract):
+    """dot_general contracting ``a`` dim contract[0] with ``b`` dim
+    contract[1] — the transposed-matmul forms (aᵀ@b, a@bᵀ) without
+    materialising a transpose in VMEM."""
+    return jax.lax.dot_general(
+        a, b, (((contract[0],), (contract[1],)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _bwd_kernel(x_ref, ds_ref, w1_ref, b1_ref, w2t_ref,
+                dx_ref, dw1_ref, db1_ref, dw2_ref, db2_ref):
+    """One T-block: recompute h, fold this block's contribution into
+    the weight-grad accumulators (the (0, 0)-mapped outputs stay VMEM
+    resident across the sequential grid), write dx.
+
+    Layout notes: the cotangent arrives pre-flattened [rows, 1] (the
+    [T, S] -> [T*S] relayout moves S out of the lane dim — legal in
+    XLA, an unsupported shape cast inside Mosaic) and broadcasts over
+    lanes like the flash kernels' width-1 m/l stats; w2 arrives
+    transposed [1, H] (sublane-padded) for the same reason.  The db
+    accumulators broadcast each block's total across their sublane
+    rows — row 0 is read outside."""
+    bt, s, d = x_ref.shape
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw1_ref[:] = jnp.zeros_like(dw1_ref)
+        db1_ref[:] = jnp.zeros_like(db1_ref)
+        dw2_ref[:] = jnp.zeros_like(dw2_ref)
+        db2_ref[:] = jnp.zeros_like(db2_ref)
+
+    x = x_ref[:].reshape(bt * s, d)
+    ds = ds_ref[:]                                 # [rows, 1] f32
+    h = jnp.maximum(_bf16_dot(x, w1_ref[:]) + b1_ref[:], 0)
+    # dw2[j] = Σ_rows h[r, j]·ds[r]  ->  hᵀ @ ds (width-1 matvec)
+    dw2_ref[:] += _dotT(h, ds.astype(jnp.bfloat16), (0, 0))
+    db2_ref[:] += jnp.sum(ds)
+    # dh = ds ⊗ w2 (lane-broadcast x sublane-broadcast), relu-gated.
+    # The compare and select run in f32: v5e Mosaic rejects bf16
+    # comparisons outright ("Target does not support this
+    # comparison"), and an f32 select under a bf16-tiled mask is an
+    # unsupported sublane relayout — so the mask source is cast up
+    # first (a select changes no arithmetic)
+    dh = ds * w2t_ref[0:1, :].astype(jnp.float32)
+    dh = jnp.where(h.astype(jnp.float32) > 0, dh,
+                   0.0).astype(jnp.bfloat16)
+    db1_ref[:] += jnp.sum(dh.astype(jnp.float32), axis=0,
+                          keepdims=True)
+    dw1_ref[:] += _dotT(x, dh, (0, 0))             # xᵀ @ dh
+    dx = _dotT(dh, w1_ref[:], (1, 1))              # dh @ w1ᵀ
+    dx_ref[:] = dx.reshape(bt, s, d).astype(dx_ref.dtype)
+
+
+def _prep(x, w1, b1, w2, b2):
+    """Pad everything to TPU tiles; returns the padded operands plus
+    the (bt, grid) plan.  Zero-padding is exact (module docstring)."""
+    t, s, d = x.shape
+    h = w1.shape[1]
+    sp = -(-s // _LANE) * _LANE
+    dp = -(-d // _LANE) * _LANE
+    hp = -(-h // _LANE) * _LANE
+    bt = _row_block(t, sp)
+    tp = -(-t // bt) * bt
+
+    bf = jnp.bfloat16
+    xp = _pad_axis(_pad_axis(_pad_axis(x.astype(bf), 0, tp), 1, sp),
+                   2, dp)
+    w1p = _pad_axis(_pad_axis(w1.astype(bf), 0, dp), 1, hp)
+    b1p = _pad_axis(b1.astype(bf), 0, hp)
+    w2p = _pad_axis(_pad_axis(w2.astype(bf), 0, hp), 1, _LANE)
+    b2p = _pad_axis(b2.astype(bf), 0, _LANE)
+    return xp, w1p, b1p, w2p, b2p, bt, tp // bt, (sp, dp, hp, tp)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fwd(x, w1, b1, w2, b2, interpret):
+    t, s, d = x.shape
+    xp, w1p, b1p, w2p, b2p, bt, grid, (sp, dp, hp, tp) = _prep(
+        x, w1, b1, w2, b2)
+    out = pl.pallas_call(
+        _fwd_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((bt, sp, dp), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((dp, hp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hp,), lambda i: (0,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hp, _LANE), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_LANE,), lambda i: (0,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bt, sp), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((tp, sp), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(xp, w1p, b1p, w2p, b2p)
+    return out[:t, :s]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _bwd(x, w1, b1, w2, b2, ds, interpret):
+    t, s, d = x.shape
+    h = w1.shape[1]
+    xp, w1p, b1p, w2p, b2p, bt, grid, (sp, dp, hp, tp) = _prep(
+        x, w1, b1, w2, b2)
+    # padded cotangent rows/streams are zero => no padded contribution
+    # reaches any accumulated gradient.  Flattened to [T*S, 1] and w2
+    # transposed to a sublane-padded row vector HERE: both relayouts
+    # are unsupported shape casts inside Mosaic (kernel docstring)
+    dsp = _pad_axis(_pad_axis(ds.astype(jnp.float32), 0, tp), 1, sp)
+    ds_flat = dsp.reshape(tp * sp, 1)
+    w2t = _pad_axis(w2p[:, :1].T, 0, _SUBLANE)     # [_SUBLANE, hp]
+    dx, dw1, db1, dw2, db2 = pl.pallas_call(
+        _bwd_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((bt, sp, dp), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bt * sp, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((dp, hp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hp,), lambda i: (0,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANE, hp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bt, sp, dp), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((dp, hp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANE, hp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hp, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANE, _LANE), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((tp, sp, dp), x.dtype),
+            jax.ShapeDtypeStruct((dp, hp), jnp.float32),
+            jax.ShapeDtypeStruct((_SUBLANE, hp), jnp.float32),
+            jax.ShapeDtypeStruct((hp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((_SUBLANE, _LANE), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(xp, ds_flat, w1p, b1p, w2t)
+    return (dx[:t, :s, :d],
+            dw1[:d, :h].astype(w1.dtype),
+            db1[0, :h].astype(b1.dtype),
+            dw2[:h, :1].astype(w2.dtype),
+            db2[0, :1].astype(b2.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _head_diff(x, w1, b1, w2, b2, interpret):
+    return _fwd(x, w1, b1, w2, b2, interpret)
+
+
+def _head_diff_fwd(x, w1, b1, w2, b2, interpret):
+    return _fwd(x, w1, b1, w2, b2, interpret), (x, w1, b1, w2, b2)
+
+
+def _head_diff_bwd(interpret, res, ds):
+    x, w1, b1, w2, b2 = res
+    return _bwd(x, w1, b1, w2, b2, ds, interpret)
+
+
+_head_diff.defvjp(_head_diff_fwd, _head_diff_bwd)
+
+
+def score_head(x: jax.Array, w1: jax.Array, b1: jax.Array,
+               w2: jax.Array, b2: jax.Array) -> jax.Array:
+    """x: [T, S, D] -> [T, S] f32 scores; fused relu(x@w1+b1)@w2+b2.
+
+    Drop-in for the dense temporal head under sequence supervision;
+    differentiable (custom VJP, h recomputed per block — no [T, S, H]
+    ever reaches HBM in either direction).
+    """
+    interpret = jax.default_backend() != "tpu"
+    return _head_diff(x, w1, b1, w2, b2, interpret)

@@ -741,6 +741,22 @@ def smoke_legs(jax, jnp) -> list:
         planner = ShardedTemporalPlanner(model, mesh, local="flash")
         planner._step.lower(params, opt_state, window, batch).compile()
 
+    def vjp_two_sweep():
+        # the two-sweep backward only engages past the fused gates
+        # (long T / many heads) — force it at a small shape so the
+        # fallback stays Mosaic-gated without a long-T compile
+        from aws_global_accelerator_controller_tpu.ops import (
+            pallas_attention as pa,
+        )
+        saved = pa._FUSED_BWD_DQ_BYTES
+        pa._FUSED_BWD_DQ_BYTES = 0
+        try:
+            qt, kt, vt = qkv(448)   # distinct shape: no jit-cache hit
+            jax.jit(lambda: grad_fn(qt, kt, vt, True, None,
+                                    None)).lower().compile()
+        finally:
+            pa._FUSED_BWD_DQ_BYTES = saved
+
     return [
         ("fwd_causal", compile_(
             lambda: flash_attention(q, k, v, causal=True))),
@@ -752,6 +768,7 @@ def smoke_legs(jax, jnp) -> list:
             lambda: grad_fn(q, k, v, True, None, None))),
         ("vjp_padded", compile_(
             lambda: grad_fn(qp, kp, vp, True, 256, 256))),
+        ("vjp_two_sweep", vjp_two_sweep),
         ("stats_causal", compile_(lambda: flash_attention_stats(
             qs, ks_, vs, causal=True))),
         ("stats_full", compile_(lambda: flash_attention_stats(

@@ -229,7 +229,7 @@ def test_smoke_legs_compile_interpret_mode():
     legs = bench.smoke_legs(jax, jnp)
     assert [n for n, _ in legs] == [
         "fwd_causal", "fwd_full", "fwd_padded", "vjp_causal",
-        "vjp_padded", "stats_causal", "stats_full",
+        "vjp_padded", "vjp_two_sweep", "stats_causal", "stats_full",
         "sharded_train_step"]
     for name, thunk in legs:
         thunk()  # raises on any build/compile drift

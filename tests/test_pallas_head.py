@@ -1,0 +1,168 @@
+"""Fused Pallas score head vs the dense temporal head.
+
+Interpret-mode (CPU) equivalence for the forward and every gradient,
+padding-exactness on tile-hostile shapes, and the model-level
+``head="fused_always"`` path end-to-end through training — the same
+contract style as tests/test_pallas_attention.py.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aws_global_accelerator_controller_tpu.models.temporal import (
+    TemporalTrafficModel,
+    synthetic_window,
+)
+from aws_global_accelerator_controller_tpu.ops.pallas_head import score_head
+
+
+def _params(key, d, h, dtype=jnp.bfloat16):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (d, h), dtype) * 0.1,
+        "b1": jnp.linspace(-0.1, 0.1, h).astype(dtype),
+        "w2": jax.random.normal(k2, (h, 1), dtype) * 0.1,
+        "b2": jnp.ones((1,), dtype) * 0.05,
+    }
+
+
+def _dense(p, x):
+    h = jnp.maximum(x.astype(jnp.bfloat16) @ p["w1"] + p["b1"], 0)
+    return (h @ p["w2"] + p["b2"])[..., 0].astype(jnp.float32)
+
+
+SHAPES = [
+    (16, 128, 128, 256),   # lane-aligned, one row block
+    (64, 128, 128, 256),   # multiple row blocks
+    (19, 48, 96, 200),     # everything tile-hostile
+    (8, 1, 8, 16),         # tiny S=1 stream
+]
+
+
+@pytest.mark.parametrize("t,s,d,h", SHAPES)
+def test_forward_matches_dense(t, s, d, h):
+    p = _params(jax.random.PRNGKey(0), d, h)
+    x = jax.random.normal(jax.random.PRNGKey(1), (t, s, d),
+                          jnp.bfloat16)
+    got = score_head(x, p["w1"], p["b1"], p["w2"], p["b2"])
+    want = _dense(p, x)
+    assert got.shape == (t, s) and got.dtype == jnp.float32
+    assert jnp.allclose(got, want, rtol=2e-2, atol=2e-2), (
+        float(jnp.max(jnp.abs(got - want))))
+
+
+@pytest.mark.parametrize("t,s,d,h", SHAPES)
+def test_grads_match_dense(t, s, d, h):
+    p = _params(jax.random.PRNGKey(2), d, h)
+    x = jax.random.normal(jax.random.PRNGKey(3), (t, s, d),
+                          jnp.bfloat16)
+    # random cotangent so no grad term constant-folds away (a sum
+    # loss turns the dh chain into a broadcast of w2)
+    r = jax.random.normal(jax.random.PRNGKey(4), (t, s), jnp.float32)
+
+    def loss(fn, xx, pp):
+        return jnp.sum(fn(pp, xx) * r)
+
+    gx_k, gp_k = jax.grad(
+        lambda xx, pp: loss(
+            lambda p_, x_: score_head(x_, p_["w1"], p_["b1"],
+                                      p_["w2"], p_["b2"]),
+            xx, pp), argnums=(0, 1))(x, p)
+    gx_d, gp_d = jax.grad(
+        lambda xx, pp: loss(_dense, xx, pp), argnums=(0, 1))(x, p)
+
+    def close(a, b, what, atol):
+        a32, b32 = a.astype(jnp.float32), b.astype(jnp.float32)
+        assert jnp.allclose(a32, b32, rtol=5e-2, atol=atol), (
+            what, float(jnp.max(jnp.abs(a32 - b32))), atol)
+
+    # bias grads are cancellation-heavy reductions of ~T*S bf16-scale
+    # terms (the dense VJP rounds the cotangent to bf16 before
+    # summing; the kernel keeps it f32) — tolerance must scale with
+    # the magnitude summed, not the magnitude that survives
+    sum_scale = 0.02 * float(jnp.sum(jnp.abs(r)))
+    close(gx_k, gx_d, "dx",
+          5e-2 * (float(jnp.max(jnp.abs(gx_d.astype(jnp.float32))))
+                  + 1e-3))
+    for name in ("w1", "w2"):
+        scale = float(jnp.max(jnp.abs(
+            gp_d[name].astype(jnp.float32)))) + 1e-3
+        close(gp_k[name], gp_d[name], f"d{name}", 5e-2 * scale)
+    for name in ("b1", "b2"):
+        close(gp_k[name], gp_d[name], f"d{name}",
+              max(sum_scale * 0.2, 1e-3))
+
+
+def test_grad_dtypes_follow_params():
+    p = _params(jax.random.PRNGKey(5), 128, 256)
+    x = jax.random.normal(jax.random.PRNGKey(6), (16, 128, 128),
+                          jnp.bfloat16)
+    gx, gp = jax.grad(lambda xx, pp: jnp.sum(
+        score_head(xx, pp["w1"], pp["b1"], pp["w2"], pp["b2"])
+        * xx.astype(jnp.float32)[..., 0]), argnums=(0, 1))(x, p)
+    assert gx.dtype == x.dtype
+    for name in ("w1", "b1", "w2", "b2"):
+        assert gp[name].shape == p[name].shape
+        assert gp[name].dtype == p[name].dtype
+
+
+def test_model_head_mode_validation():
+    with pytest.raises(ValueError):
+        TemporalTrafficModel(head="nope")
+
+
+def test_model_2d_paths_stay_dense():
+    """scores / scores_last take [S, D] reps — the fused head must not
+    engage there (it is a [T, S, D] kernel)."""
+    m = TemporalTrafficModel(feature_dim=8, embed_dim=32,
+                             hidden_dim=64, head="fused_always")
+    window, batch = synthetic_window(jax.random.PRNGKey(0), steps=16,
+                                     groups=4, endpoints=4)
+    params = m.init_params(jax.random.PRNGKey(1))
+    got = m.scores_last(params, window)
+    ref = TemporalTrafficModel(feature_dim=8, embed_dim=32,
+                               hidden_dim=64, head="reference")
+    want = ref.scores_last(params, window)
+    assert jnp.allclose(got, want)
+
+
+def test_model_sequence_training_through_fused_head():
+    """Sequence-supervised training with head="fused_always" tracks
+    the dense-head model: same loss trajectory within bf16 tolerance,
+    and the loss actually decreases."""
+    kwargs = dict(feature_dim=8, embed_dim=32, hidden_dim=64,
+                  attention="reference", supervision="sequence")
+    fused = TemporalTrafficModel(head="fused_always", **kwargs)
+    dense = TemporalTrafficModel(head="reference", **kwargs)
+    window, batch = synthetic_window(jax.random.PRNGKey(7), steps=32,
+                                     groups=4, endpoints=4,
+                                     per_step=True)
+    pf = fused.init_params(jax.random.PRNGKey(8))
+    pd = jax.tree_util.tree_map(lambda a: a, pf)
+    of, od = fused.init_opt_state(pf), dense.init_opt_state(pd)
+    losses_f, losses_d = [], []
+    for _ in range(5):
+        pf, of, lf = fused.train_step(pf, of, window, batch)
+        pd, od, ld = dense.train_step(pd, od, window, batch)
+        losses_f.append(float(lf))
+        losses_d.append(float(ld))
+    assert losses_f[-1] < losses_f[0]
+    for lf, ld in zip(losses_f, losses_d):
+        assert abs(lf - ld) < 5e-2, (losses_f, losses_d)
+
+
+def test_remat_skipped_for_fused_head():
+    """remat=True with the fused head must still train (the checkpoint
+    wrap is skipped, the kernel VJP recomputes internally)."""
+    m = TemporalTrafficModel(feature_dim=8, embed_dim=32,
+                             hidden_dim=64, attention="reference",
+                             supervision="sequence", remat=True,
+                             head="fused_always")
+    window, batch = synthetic_window(jax.random.PRNGKey(9), steps=16,
+                                     groups=2, endpoints=4,
+                                     per_step=True)
+    p = m.init_params(jax.random.PRNGKey(10))
+    o = m.init_opt_state(p)
+    p, o, l0 = m.train_step(p, o, window, batch)
+    p, o, l1 = m.train_step(p, o, window, batch)
+    assert jnp.isfinite(l0) and jnp.isfinite(l1)
