@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import optax
 
 from ..ops.weights import plan_weights
-from .common import TrainableModel, masked_ce_loss
+from .common import TrainableModel, flat_adam, masked_ce_loss
 from .traffic import Batch
 
 Params = Dict[str, jax.Array]
@@ -49,7 +49,8 @@ class TemporalTrafficModel(TrainableModel):
     def __init__(self, feature_dim: int = 8, embed_dim: int = 32,
                  hidden_dim: int = 64, learning_rate: float = 1e-3,
                  attention: str = "flash", supervision: str = "last",
-                 remat: bool = False, head: str = "reference"):
+                 remat: bool = False, head: str = "reference",
+                 attention_chunk: int = 0, optimizer: str = "adam"):
         """``supervision`` picks the training objective:
 
         - ``"last"`` (default): only the final step's scores are
@@ -95,6 +96,9 @@ class TemporalTrafficModel(TrainableModel):
             raise ValueError(f"unknown supervision {supervision!r}")
         if head not in ("fused", "fused_always", "reference"):
             raise ValueError(f"unknown head impl {head!r}")
+        if attention_chunk < 0:
+            raise ValueError("attention_chunk must be >= 0")
+        self.attention_chunk = attention_chunk
         self.remat = remat
         self.head = head
         self.feature_dim = feature_dim
@@ -102,7 +106,19 @@ class TemporalTrafficModel(TrainableModel):
         self.hidden_dim = hidden_dim
         self.attention = attention
         self.supervision = supervision
-        self.optimizer = optax.adam(learning_rate)
+        # "flat_adam": Adam over one raveled vector — kills the
+        # per-leaf tiny-op tax on the unsharded train step
+        # (models.common.flat_adam docstring).  Sharded planners run
+        # the model's optimizer through train_step, so a flat state
+        # rides replicated there (their opt in_sharding is
+        # unconstrained) and each ravel gathers the sharded grads —
+        # correct but anti-scaling; keep "adam" for sharded training.
+        if optimizer == "flat_adam":
+            self.optimizer = flat_adam(learning_rate)
+        elif optimizer == "adam":
+            self.optimizer = optax.adam(learning_rate)
+        else:
+            raise ValueError(f"unknown optimizer {optimizer!r}")
 
     def init_params(self, key: jax.Array) -> Params:
         ks = jax.random.split(key, 6)
@@ -139,6 +155,15 @@ class TemporalTrafficModel(TrainableModel):
           any backend — for tests proving the kernel path (forward AND
           backward) end-to-end on the CPU mesh.
         - ``reference``: always dense.
+
+        ``attention_chunk`` (constructor knob, 0 = off) splits the S
+        streams axis into chunks of at most that many heads, one
+        kernel call each — attention is per-head independent, so the
+        split is exact.  Purpose: chunks of <= 32 heads fall inside
+        the fused one-sweep backward's head gate
+        (``pallas_attention._FUSED_BWD_MAX_HEADS``), which the
+        benchmark shape's S = 128 otherwise exceeds.  Opt-in until
+        its compile + win are confirmed on-chip.
         """
         use_kernel = (q.shape[0] >= FLASH_MIN_WINDOW
                       and (self.attention == "flash_always"
@@ -146,6 +171,15 @@ class TemporalTrafficModel(TrainableModel):
                                and jax.default_backend() == "tpu")))
         if use_kernel:
             from ..ops import pallas_attention
+            s = q.shape[1]
+            chunk = self.attention_chunk
+            if chunk and s > chunk:
+                parts = [
+                    pallas_attention.flash_attention(
+                        q[:, c:c + chunk], k[:, c:c + chunk],
+                        v[:, c:c + chunk], causal=True)
+                    for c in range(0, s, chunk)]
+                return jnp.concatenate(parts, axis=1)
             return pallas_attention.flash_attention(q, k, v, causal=True)
         from ..parallel.ring_attention import attention_reference
         return attention_reference(q, k, v, causal=True)

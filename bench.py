@@ -338,6 +338,26 @@ def bench_temporal_train(t: int = 2048, g: int = 8, e: int = 16,
                          (params, opt_state), n)
     last_s = _marginal_s(np, chained_for(model_last, batch_last),
                          (params, opt_state), n)
+    # heads-chunked variant: S split into <=32-head groups so each
+    # flash call clears the fused one-sweep backward's head gate
+    # (pallas_attention._FUSED_BWD_MAX_HEADS — the full S=128 call
+    # exceeds it and takes the two-sweep route).  Error-isolated: a
+    # Mosaic rejection here must not sink the headline number.
+    chunked_ms = None
+    chunked_err = None
+    try:
+        # ALSO flat_adam (models.common): the two single-chip
+        # levers measured together as the candidate tuned default
+        model_chunked = TemporalTrafficModel(
+            feature_dim=f, embed_dim=d, hidden_dim=h,
+            attention="flash", supervision="sequence",
+            attention_chunk=32, optimizer="flat_adam")
+        opt_flat = model_chunked.init_opt_state(params)
+        chunked_ms = round(_marginal_s(
+            np, chained_for(model_chunked, batch),
+            (params, opt_flat), n) * 1e3, 3)
+    except Exception as exc:  # noqa: BLE001 — report, keep the leg
+        chunked_err = f"{type(exc).__name__}: {str(exc)[:160]}"
 
     s = g * e
     # sequence supervision runs the head over ALL T rows (2*S*(D*H+H)
@@ -365,6 +385,11 @@ def bench_temporal_train(t: int = 2048, g: int = 8, e: int = 16,
         "last_steps_per_s": round(1.0 / last_s, 1),
         "last_mfu_pct": round(100.0 * last_flops / last_s / peak, 2),
         "last_vs_sequence_speedup": round(step_s / last_s, 2),
+        **({"chunked_step_ms": chunked_ms,
+            "chunked_mfu_pct": round(
+                100.0 * train_flops / (chunked_ms / 1e3) / peak, 2)}
+           if chunked_ms else {}),
+        **({"chunked_error": chunked_err} if chunked_err else {}),
     }
 
 

@@ -1,0 +1,99 @@
+"""flat_adam (one raveled Adam update) vs optax.adam.
+
+The flat state is f32 while optax's moments inherit the params' bf16,
+so trajectories agree to bf16 tolerance, not bitwise; the f32 math
+itself is checked exactly against a NumPy reference.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from aws_global_accelerator_controller_tpu.models.common import (
+    FlatAdamState,
+    flat_adam,
+)
+from aws_global_accelerator_controller_tpu.models.temporal import (
+    TemporalTrafficModel,
+    synthetic_window,
+)
+
+
+def _tree(seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return {
+        "a": jax.random.normal(ks[0], (4, 8), dtype),
+        "b": jax.random.normal(ks[1], (8,), dtype),
+        "c": jax.random.normal(ks[2], (3, 2, 5), dtype),
+    }
+
+
+def test_matches_numpy_reference_exactly_f32():
+    lr, b1, b2, eps = 1e-2, 0.9, 0.999, 1e-8
+    params = _tree(0)
+    grads = _tree(1)
+    opt = flat_adam(lr, b1, b2, eps)
+    state = opt.init(params)
+    upd, state = opt.update(grads, state, params)
+
+    flat_g = np.concatenate([np.asarray(grads[k]).ravel()
+                             for k in ("a", "b", "c")])
+    mu = (1 - b1) * flat_g
+    nu = (1 - b2) * flat_g ** 2
+    step = -lr * (mu / (1 - b1)) / (np.sqrt(nu / (1 - b2)) + eps)
+    flat_u = np.concatenate([np.asarray(upd[k]).ravel()
+                             for k in ("a", "b", "c")])
+    np.testing.assert_allclose(flat_u, step, rtol=1e-6, atol=1e-7)
+    assert state.mu.dtype == jnp.float32
+    assert int(state.count) == 1
+
+
+def test_tracks_optax_adam_f32_params():
+    """With f32 params (so optax's moments are f32 too) the two
+    implementations walk the same trajectory to float tolerance."""
+    lr = 1e-2
+    params_a = _tree(2)
+    params_b = jax.tree_util.tree_map(lambda x: x, params_a)
+    flat, ref = flat_adam(lr), optax.adam(lr)
+    sa, sb = flat.init(params_a), ref.init(params_b)
+    for i in range(5):
+        grads = _tree(10 + i)
+        ua, sa = flat.update(grads, sa, params_a)
+        ub, sb = ref.update(grads, sb, params_b)
+        params_a = optax.apply_updates(params_a, ua)
+        params_b = optax.apply_updates(params_b, ub)
+    for k in params_a:
+        np.testing.assert_allclose(np.asarray(params_a[k]),
+                                   np.asarray(params_b[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_temporal_model_trains_with_flat_adam():
+    """End-to-end: the temporal family trains (loss decreases) with
+    optimizer="flat_adam", tracking the adam model loosely (bf16
+    moments vs f32 moments diverge slowly, same direction)."""
+    kwargs = dict(feature_dim=8, embed_dim=32, hidden_dim=64,
+                  attention="reference", supervision="sequence")
+    m_flat = TemporalTrafficModel(optimizer="flat_adam", **kwargs)
+    m_ref = TemporalTrafficModel(**kwargs)
+    window, batch = synthetic_window(jax.random.PRNGKey(3), steps=32,
+                                     groups=4, endpoints=4,
+                                     per_step=True)
+    pf = m_flat.init_params(jax.random.PRNGKey(4))
+    pr = jax.tree_util.tree_map(lambda x: x, pf)
+    of, orr = m_flat.init_opt_state(pf), m_ref.init_opt_state(pr)
+    assert isinstance(of, FlatAdamState)
+    lf, lr_ = [], []
+    for _ in range(6):
+        pf, of, a = m_flat.train_step(pf, of, window, batch)
+        pr, orr, b = m_ref.train_step(pr, orr, window, batch)
+        lf.append(float(a))
+        lr_.append(float(b))
+    assert lf[-1] < lf[0]
+    assert all(abs(a - b) < 5e-2 for a, b in zip(lf, lr_)), (lf, lr_)
+
+
+def test_unknown_optimizer_rejected():
+    with pytest.raises(ValueError):
+        TemporalTrafficModel(optimizer="sgd")

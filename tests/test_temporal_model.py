@@ -296,3 +296,49 @@ def test_sequence_remat_identical_trajectory():
     for k in p1:
         np.testing.assert_array_equal(np.asarray(p1[k]),
                                       np.asarray(p2[k]), err_msg=k)
+
+
+def test_attention_chunk_exact():
+    """Splitting the streams axis into head chunks is exact: attention
+    is per-head independent, so chunked == unchunked (forward AND the
+    training gradient), and a chunk >= S is a no-op split."""
+    import jax
+    import jax.numpy as jnp
+
+    from aws_global_accelerator_controller_tpu.models.temporal import (
+        TemporalTrafficModel,
+        synthetic_window,
+    )
+
+    kwargs = dict(feature_dim=8, embed_dim=32, hidden_dim=64,
+                  attention="flash_always", supervision="sequence")
+    whole = TemporalTrafficModel(**kwargs)
+    chunked = TemporalTrafficModel(attention_chunk=3, **kwargs)  # ragged
+    wide = TemporalTrafficModel(attention_chunk=64, **kwargs)    # no-op
+    window, batch = synthetic_window(jax.random.PRNGKey(0), steps=64,
+                                     groups=2, endpoints=4,
+                                     per_step=True)
+    params = whole.init_params(jax.random.PRNGKey(1))
+    sw = whole.scores_seq(params, window)
+    sc = chunked.scores_seq(params, window)
+    sn = wide.scores_seq(params, window)
+    assert jnp.allclose(sw, sc, rtol=1e-5, atol=1e-5)
+    assert jnp.allclose(sw, sn, rtol=1e-5, atol=1e-5)
+
+    gw = jax.grad(lambda p: whole.loss(p, window, batch))(params)
+    gc = jax.grad(lambda p: chunked.loss(p, window, batch))(params)
+    for name in gw:
+        a = gw[name].astype(jnp.float32)
+        b = gc[name].astype(jnp.float32)
+        assert jnp.allclose(a, b, rtol=2e-2, atol=2e-2), name
+
+
+def test_attention_chunk_validation():
+    import pytest
+
+    from aws_global_accelerator_controller_tpu.models.temporal import (
+        TemporalTrafficModel,
+    )
+
+    with pytest.raises(ValueError):
+        TemporalTrafficModel(attention_chunk=-1)
