@@ -411,7 +411,10 @@ def temporal_breakdown_legs(jax, t: int, g: int, e: int, d: int,
     - ``dense``: the sequence step with attention stubbed to
       identity — embed/QKV/head matmuls + loss + optimizer, no
       attention;
-    - ``optimizer``: the Adam update alone on the same param tree.
+    - ``optimizer``: the Adam update alone on the same param tree;
+    - ``optimizer_flat``: the same update math through
+      ``models.common.flat_adam`` (one raveled vector) — the A/B that
+      prices the per-leaf tiny-op tax the tree update pays.
     """
     import optax
 
@@ -467,14 +470,22 @@ def temporal_breakdown_legs(jax, t: int, g: int, e: int, d: int,
 
     grads = jax.tree_util.tree_map(jnp.ones_like, params)
 
-    def chained_opt(steps):
-        def body(carry, _):
-            p, o = carry
-            upd, o = model.optimizer.update(grads, o, p)
-            return (optax.apply_updates(p, upd), o), 0.0
-        return jax.jit(lambda p, o: lax.scan(
-            body, (p, o), None, length=steps)[0][0]["embed"][0, 0]
-            .astype(jnp.float32))
+    def chained_opt_for(optimizer):
+        def chained(steps):
+            def body(carry, _):
+                p, o = carry
+                upd, o = optimizer.update(grads, o, p)
+                return (optax.apply_updates(p, upd), o), 0.0
+            return jax.jit(lambda p, o: lax.scan(
+                body, (p, o), None, length=steps)[0][0]["embed"][0, 0]
+                .astype(jnp.float32))
+        return chained
+
+    from aws_global_accelerator_controller_tpu.models.common import (
+        flat_adam,
+    )
+
+    flat = flat_adam(1e-3)
 
     return {
         "full": (chained_step(model, batch, None),
@@ -484,7 +495,12 @@ def temporal_breakdown_legs(jax, t: int, g: int, e: int, d: int,
         "dense": (chained_step(model, batch, lambda q_, k_, v_: v_),
                   (params, opt_state)),
         "attention": (chained_attn, (q,)),
-        "optimizer": (chained_opt, (params, opt_state)),
+        "optimizer": (chained_opt_for(model.optimizer),
+                      (params, opt_state)),
+        # flat_adam A/B: same update math over ONE raveled vector —
+        # quantifies the per-leaf tiny-op tax the tree update pays
+        "optimizer_flat": (chained_opt_for(flat),
+                           (params, flat.init(params))),
     }
 
 
@@ -1006,10 +1022,18 @@ def _attach_last_live(result: dict, name: str) -> dict:
         return result
     if not isinstance(entry, dict) or "skipped" in entry:
         return result
-    last = {"live": False, "measured_at": payload.get("measured_at"),
+    # per-leg provenance first: merged partial captures carry legs
+    # measured in EARLIER windows, so the date and transcript must
+    # both come from the leg's own window (top-level fields are the
+    # pre-provenance fallback) — a date its transcript can't back is
+    # exactly the mismatch this block exists to avoid
+    last = {"live": False,
+            "measured_at": (entry.get("finished_at")
+                            or payload.get("measured_at")),
             **entry}
-    if payload.get("transcript"):
-        last["transcript"] = "bench_artifacts/" + payload["transcript"]
+    transcript = entry.get("transcript") or payload.get("transcript")
+    if transcript:
+        last["transcript"] = "bench_artifacts/" + transcript
     return {**result, "last_live": last}
 
 

@@ -251,7 +251,7 @@ def test_temporal_breakdown_legs_run_interpret_mode():
     legs = bench.temporal_breakdown_legs(jax, t=8, g=2, e=4, d=16,
                                          h=32)
     assert set(legs) == {"full", "last", "dense", "attention",
-                         "optimizer"}
+                         "optimizer", "optimizer_flat"}
     for name, (chained, args) in legs.items():
         out = np.asarray(chained(2)(*args))
         assert np.isfinite(out).all(), name
@@ -395,3 +395,29 @@ def test_bench_report_per_leg_transcripts(monkeypatch, tmp_path):
     assert "live capture 2026-07-31T04:45:26Z" in rows["plan"]
     # the provenance key itself stays out of the rendered detail
     assert "transcript=transcript" not in doc
+
+
+def test_attach_last_live_prefers_leg_transcript(monkeypatch, tmp_path):
+    """A merged capture's carried-over leg must cite its OWN window's
+    transcript in the skip-path last_live block too, not the newest
+    capture's (same provenance rule as the report rows)."""
+    live = tmp_path / "live.json"
+    live.write_text(json.dumps({
+        "measured_at": "2026-07-31T04:49:18Z",
+        "transcript": "transcript_new.log",
+        "results": {
+            "flash": {"finished_at": "2026-07-31T00:42:54Z",
+                      "transcript": "transcript_old.log",
+                      "fwd_us": 99.0},
+            "planner": {"finished_at": "2026-07-31T04:45:26Z",
+                        "plan_ms": 1.3},
+        },
+    }))
+    monkeypatch.setattr(bench, "_LIVE_PATH", str(live))
+    flash = bench._attach_last_live({"skipped": "wedged"}, "flash")
+    assert flash["last_live"]["transcript"].endswith(
+        "transcript_old.log")
+    # pre-provenance entry (no per-leg field): top-level fallback
+    planner = bench._attach_last_live({"skipped": "wedged"}, "planner")
+    assert planner["last_live"]["transcript"].endswith(
+        "transcript_new.log")
