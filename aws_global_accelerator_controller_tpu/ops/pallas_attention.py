@@ -496,6 +496,13 @@ _FUSED_BWD_DQ_BYTES = 2 * 2 ** 20
 # (341.5 -> 301.0 us at T=2048).  Empirical ceiling with margin; the
 # two-sweep fallback is always correct.
 _FUSED_BWD_MAX_HEADS = 32
+# Experiment knob (hack/tpu_experiments.py): explicit Mosaic VMEM
+# allotment for the fused backward's pallas_call — None keeps the
+# compiler default.  Raising it is the candidate fix for the
+# scoped-vmem OOM above; promote a measured-working value into a
+# default (with the gates relaxed) only after an on-chip window
+# confirms compile + win.
+_FUSED_BWD_VMEM_LIMIT = None
 
 
 def _dqkv_kernel(*refs, causal: bool, tri: bool, scale: float,
@@ -878,7 +885,9 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
                 jax.ShapeDtypeStruct((h, tp_k, dp), v.dtype),
             ],
             compiler_params=pltpu.CompilerParams(
-                dimension_semantics=dims),
+                dimension_semantics=dims,
+                **({"vmem_limit_bytes": _FUSED_BWD_VMEM_LIMIT}
+                   if _FUSED_BWD_VMEM_LIMIT else {})),
             interpret=interpret,
         )(*extra, qp, kp, vp, dop, m, l, dvec)
         return (dq[:, :t, :d], dk[:, :t, :d], dv[:, :t, :d])

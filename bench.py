@@ -1295,13 +1295,13 @@ _NAMED = {
     "autotune": lambda: _json_bench_subprocess(
         "autotune_flash_blocks", "flash block autotune", 1200.0),
     "smoke": bench_smoke_subprocess,
-    # breakdown compiles ~10 scan-wrapped programs (5 legs x marginal
+    # breakdown compiles ~12 scan-wrapped programs (6 legs x marginal
     # T(n)/T(1)) at 20-40s each over the tunnel, so 600s can starve a
     # HEALTHY backend — indistinguishable from a wedge from out here;
     # budget for the full compile bill before calling it unresponsive
     "temporal-breakdown": lambda: _json_bench_subprocess(
         "bench_temporal_breakdown", "tpu temporal cost breakdown",
-        1100.0),
+        1300.0),
 }
 
 

@@ -44,7 +44,7 @@ BENCHES = [
     ("flash-long", 660.0),
     ("flash-xl", 1100.0),
     ("temporal", 660.0),
-    ("temporal-breakdown", 2400.0),
+    ("temporal-breakdown", 2900.0),
     ("planner", 660.0),
     ("autotune", 2500.0),
 ]
