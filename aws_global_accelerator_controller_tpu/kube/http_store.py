@@ -217,11 +217,22 @@ class RestClient:
         self.timeout = timeout
         self._ctx = config.ssl_context()
 
+    # apiserver rate limiting (API Priority & Fairness): how many
+    # Retry-After waits one request will honor before surfacing the
+    # 429, and the per-wait ceiling — a hostile/huge Retry-After must
+    # not park a controller thread for minutes (client-go's default
+    # retry behavior, rest/request.go retry semantics: a 429 means the
+    # request was NOT processed, so every verb is safe to retry)
+    _RATE_LIMIT_RETRIES = 3
+    _RATE_LIMIT_MAX_WAIT_S = 10.0
+
     def request(self, method: str, path: str, body: Optional[dict] = None,
                 stream: bool = False, timeout: Optional[float] = None):
         url = self.config.server.rstrip("/") + path
         data = json.dumps(body).encode() if body is not None else None
-        for attempt in (0, 1):
+        exec_retried = False
+        rate_limited = 0
+        while True:
             req = urllib.request.Request(url, data=data, method=method)
             req.add_header("Accept", "application/json")
             if data is not None:
@@ -234,12 +245,23 @@ class RestClient:
                     req, timeout=timeout or self.timeout,
                     context=self._ctx)
             except urllib.error.HTTPError as e:
-                if (e.code == 401 and attempt == 0
+                if (e.code == 401 and not exec_retried
                         and self.config.exec_spec):
                     # cached exec credential rejected (clock skew,
                     # early revocation): re-run the plugin and retry
                     # once — the 401-healing client-go implements
                     self.config.invalidate_exec_token()
+                    exec_retried = True
+                    continue
+                if (e.code == 429
+                        and rate_limited < self._RATE_LIMIT_RETRIES):
+                    # honor Retry-After the way client-go does: the
+                    # request was not processed, wait what the server
+                    # asked (capped) and go again; only a persistent
+                    # storm surfaces as the typed error
+                    rate_limited += 1
+                    e.read()
+                    time.sleep(self._retry_after_s(e))
                     continue
                 raise self._typed_error(e)
             ctype = resp.headers.get("Content-Type", "")
@@ -272,6 +294,17 @@ class RestClient:
                     f"between client and apiserver")
             return json.loads(payload) if payload else {}
 
+    @classmethod
+    def _retry_after_s(cls, e: urllib.error.HTTPError) -> float:
+        """Seconds to wait per the 429's Retry-After header — absent or
+        malformed falls back to 1s (client-go's floor), always capped."""
+        raw = e.headers.get("Retry-After", "") if e.headers else ""
+        try:
+            wait = float(raw)
+        except (TypeError, ValueError):
+            wait = 1.0
+        return max(0.0, min(wait, cls._RATE_LIMIT_MAX_WAIT_S))
+
     @staticmethod
     def _typed_error(e: urllib.error.HTTPError) -> Exception:
         try:
@@ -290,12 +323,20 @@ class RestClient:
             # an expired LIST continue token (or stale watch RV on the
             # raw request path); pagination falls back to a full list
             return GoneError(message)
+        if e.code == 429:
+            return TooManyRequestsError(message)
         return RuntimeError(f"apiserver HTTP {e.code}: {message}")
 
 
 class GoneError(RuntimeError):
     """HTTP 410 outside a watch stream — in practice an expired LIST
     ``continue`` token (etcd compacted the snapshot the token pinned)."""
+
+
+class TooManyRequestsError(RuntimeError):
+    """HTTP 429 that persisted through every honored Retry-After wait —
+    the apiserver's priority-and-fairness layer is shedding this client
+    (client-go surfaces the same after its retries)."""
 
 
 # client-go's ListPager default page size; every collection GET in this

@@ -94,6 +94,13 @@ class KubeRestServer:
         # etcd-compaction path) so clients prove their full-relist
         # fallback
         self.expire_continues = False
+        # chaos knob: shed the next N requests with 429 + Retry-After
+        # (the API Priority & Fairness path) so clients prove they
+        # honor the wait and retry instead of surfacing every load
+        # spike as an error
+        self.rate_limit_next = 0
+        self.rate_limit_retry_after = "1"
+        self._rate_limit_lock = threading.Lock()
         # chunked-LIST snapshots: a continue token pins the listing
         # taken at the first page (real apiserver semantics — chunks
         # of one list are one consistent etcd snapshot; serving later
@@ -246,6 +253,28 @@ class KubeRestServer:
         return None
 
     def handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
+        with self._rate_limit_lock:
+            shed = self.rate_limit_next > 0
+            if shed:
+                self.rate_limit_next -= 1
+        if shed:
+            # drain the request body first: on a keep-alive connection
+            # (protocol_version HTTP/1.1) unread Content-Length bytes
+            # would be parsed as the NEXT request line
+            length = int(req.headers.get("Content-Length") or 0)
+            if length:
+                req.rfile.read(length)
+            # wire shape per the real apiserver's priority-and-fairness
+            # rejection (Status reason=TooManyRequests + Retry-After)
+            self._respond(
+                req, 429,
+                {"kind": "Status", "apiVersion": "v1", "metadata": {},
+                 "status": "Failure",
+                 "message": "too many requests, please try again "
+                            "later",
+                 "reason": "TooManyRequests", "code": 429},
+                headers={"Retry-After": self.rate_limit_retry_after})
+            return
         parsed = urlparse(req.path)
         route = self._resolve(parsed.path)
         if route is None:
@@ -300,12 +329,15 @@ class KubeRestServer:
         length = int(req.headers.get("Content-Length", 0))
         return json.loads(req.rfile.read(length) or b"{}")
 
-    def _respond(self, req, code: int, payload: dict) -> None:
+    def _respond(self, req, code: int, payload: dict,
+                 headers: Optional[dict] = None) -> None:
         try:
             body = json.dumps(payload).encode()
             req.send_response(code)
             req.send_header("Content-Type", "application/json")
             req.send_header("Content-Length", str(len(body)))
+            for key, value in (headers or {}).items():
+                req.send_header(key, value)
             req.end_headers()
             req.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):

@@ -10,6 +10,7 @@ backends (the canonical interchangeability check).  The reference gets
 the equivalent from a kind cluster in CI (e2e/.github/workflows).
 """
 import threading
+import time
 
 import pytest
 
@@ -1083,3 +1084,37 @@ def test_client_watch_requests_bookmarks_and_timeout(monkeypatch):
     assert "resourceVersion=7" in paths[0]
     assert "allowWatchBookmarks=true" in paths[0]
     assert "timeoutSeconds=300" in paths[0]
+
+
+# -- 429 rate limiting over the wire ----------------------------------------
+
+
+def test_rate_limited_request_honors_retry_after(rest, http_api):
+    """A 429 + Retry-After burst is absorbed transparently: the client
+    waits what the server asked and retries (a 429 means the request
+    was NOT processed, so every verb is safe) — the caller sees only
+    the eventual success, as with client-go."""
+    store = http_api.store("Service")
+    store.create(_service("ratelimited"))
+    rest.rate_limit_retry_after = "0"     # keep the test fast
+    rest.rate_limit_next = 2
+    start = time.monotonic()
+    got = store.get("default", "ratelimited")
+    assert got.name == "ratelimited"
+    assert rest.rate_limit_next == 0      # both sheds were consumed
+    assert time.monotonic() - start < 5.0
+
+
+def test_rate_limit_storm_surfaces_typed_error(rest, http_api):
+    """Past the honored retries the typed error surfaces — a
+    persistent storm must be visible, not an infinite silent stall."""
+    from aws_global_accelerator_controller_tpu.kube.http_store import (
+        TooManyRequestsError,
+    )
+
+    store = http_api.store("Service")
+    rest.rate_limit_retry_after = "0"
+    rest.rate_limit_next = 10 ** 6
+    with pytest.raises(TooManyRequestsError):
+        store.get("default", "whatever")
+    rest.rate_limit_next = 0

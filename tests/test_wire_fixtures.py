@@ -366,3 +366,40 @@ def test_protobuf_content_type_rejected_loudly(monkeypatch):
     msg = str(ei.value)
     assert "vnd.kubernetes.protobuf" in msg
     assert "application/json" in msg
+
+
+# -- 429 rate limiting (API Priority & Fairness) ----------------------------
+
+
+def test_status_429_maps_to_too_many_requests():
+    from aws_global_accelerator_controller_tpu.kube.http_store import (
+        TooManyRequestsError,
+    )
+
+    err = RestClient._typed_error(_http_error(
+        429, "status_429_too_many_requests.json"))
+    assert isinstance(err, TooManyRequestsError)
+    assert "too many requests" in str(err)
+
+
+def test_retry_after_header_parsed_and_capped():
+    import email.message
+
+    def hdr(value):
+        e = _http_error(429, "status_429_too_many_requests.json")
+        msg = email.message.Message()
+        if value is not None:
+            msg["Retry-After"] = value
+        e.headers = msg
+        return e
+
+    assert RestClient._retry_after_s(hdr("3")) == 3.0
+    assert RestClient._retry_after_s(hdr("0.25")) == 0.25
+    # a hostile/huge wait is capped so a controller thread cannot be
+    # parked for minutes
+    assert (RestClient._retry_after_s(hdr("86400"))
+            == RestClient._RATE_LIMIT_MAX_WAIT_S)
+    # absent or malformed (HTTP-date form unsupported): 1s floor
+    assert RestClient._retry_after_s(hdr(None)) == 1.0
+    assert RestClient._retry_after_s(hdr("Tue, 29 Jul")) == 1.0
+    assert RestClient._retry_after_s(hdr("-5")) == 0.0
