@@ -88,6 +88,22 @@ def register(sub) -> None:
                             "activations dominate HBM at long "
                             "windows).  Identical numerics, lower "
                             "HBM.")
+    train.add_argument("--attention-chunk", type=int, default=0,
+                       dest="attention_chunk", metavar="HEADS",
+                       help="Temporal: split the G*E streams axis "
+                            "into chunks of at most HEADS per flash "
+                            "call (exact — attention is per-head "
+                            "independent).  Chunks of <=32 ride the "
+                            "fused one-sweep flash backward, which "
+                            "wide stream counts otherwise exceed.  "
+                            "0 = one call (default).")
+    train.add_argument("--optimizer", choices=("adam", "flat_adam"),
+                       default="adam",
+                       help="Temporal: adam = optax per-leaf tree "
+                            "(required for sharded optimizer-state "
+                            "layouts); flat_adam = one raveled-vector "
+                            "update (f32 moments, fewer tiny kernels "
+                            "— the single-chip fast path).")
     train.add_argument("--profile", default="", metavar="DIR",
                        help="Capture a jax.profiler trace of the "
                             "training loop into DIR (view with "
@@ -266,11 +282,29 @@ def _build_model(args):
         from ..models.temporal import TemporalTrafficModel, synthetic_window
 
         supervision = getattr(args, "supervision", "last")
-        model = TemporalTrafficModel(hidden_dim=args.hidden,
-                                     learning_rate=lr,
-                                     supervision=supervision,
-                                     remat=getattr(args, "remat",
-                                                   False))
+        optimizer = getattr(args, "optimizer", "adam")
+        chunk = getattr(args, "attention_chunk", 0)
+        if sharded and optimizer != "adam":
+            # the raveled state has no axes for the planner's
+            # NamedShardings to map (models.common.flat_adam)
+            raise SystemExit(
+                "--optimizer flat_adam is the single-chip fast path; "
+                "--sharded training needs the per-leaf adam state")
+        if sharded and chunk:
+            # the sharded planner attends through the ring (its own
+            # _attend seam) — chunking would be silently inert, and a
+            # user benchmarking the fused-backward head gate must not
+            # conclude from a configuration that never ran
+            raise SystemExit(
+                "--attention-chunk applies to single-chip temporal "
+                "training only; --sharded attends through the ring")
+        if chunk < 0:
+            raise SystemExit("--attention-chunk must be >= 0")
+        model = TemporalTrafficModel(
+            hidden_dim=args.hidden, learning_rate=lr,
+            supervision=supervision,
+            remat=getattr(args, "remat", False),
+            attention_chunk=chunk, optimizer=optimizer)
 
         if loader_kind == "synthetic":
             def make_data(key):

@@ -68,9 +68,12 @@ def flat_adam(learning_rate: float, b1: float = 0.9,
 
     def init(params):
         flat, _ = ravel_pytree(params)
-        zeros = jnp.zeros(flat.shape, jnp.float32)
+        # mu and nu must be DISTINCT arrays: sharing one zeros buffer
+        # makes a donating train step (donate_argnums on opt_state)
+        # hand XLA the same buffer twice — runtime error on execute
         return FlatAdamState(count=jnp.zeros((), jnp.int32),
-                             mu=zeros, nu=zeros)
+                             mu=jnp.zeros(flat.shape, jnp.float32),
+                             nu=jnp.zeros(flat.shape, jnp.float32))
 
     def update(grads, state, params=None):
         del params

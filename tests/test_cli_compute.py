@@ -1,6 +1,8 @@
 """CLI compute track: train (with resume) and plan subcommands."""
 import json
 
+import pytest
+
 from aws_global_accelerator_controller_tpu.cmd.root import main
 
 
@@ -96,7 +98,6 @@ def test_zigzag_temporal_trains_and_rejects_misuse(tmp_path, capsys):
     balanced causal ring end-to-end from the CLI; misconfigurations
     (last supervision, window not divisible by 2x the seq axis) get
     direct messages instead of shard_map shape errors."""
-    import pytest
 
     ckpt = str(tmp_path / "zck")
     assert main(["train", "--model", "temporal", "--sharded",
@@ -120,7 +121,6 @@ def test_zigzag_temporal_trains_and_rejects_misuse(tmp_path, capsys):
 
 
 def test_sharded_rejects_indivisible_shapes(capsys):
-    import pytest
 
     with pytest.raises(SystemExit):
         main(["train", "--model", "temporal", "--sharded", "--steps",
@@ -136,7 +136,6 @@ def test_sharded_mlp_trains(capsys):
 
 
 def test_help_lists_compute_subcommands(capsys):
-    import pytest
 
     with pytest.raises(SystemExit):
         main(["--help"])
@@ -176,7 +175,6 @@ def test_sharded_moe_trains_and_plans(tmp_path, capsys):
 
 
 def test_sharded_moe_rejects_bad_expert_count(capsys):
-    import pytest
 
     with pytest.raises(SystemExit):
         main(["train", "--model", "moe", "--sharded", "--steps", "1",
@@ -216,7 +214,6 @@ def test_sharded_deep_trains_and_plans(tmp_path, capsys):
 
 
 def test_sharded_deep_rejects_bad_stage_count(capsys):
-    import pytest
 
     with pytest.raises(SystemExit):
         main(["train", "--model", "deep", "--sharded", "--steps", "1",
@@ -225,7 +222,6 @@ def test_sharded_deep_rejects_bad_stage_count(capsys):
 
 
 def test_sharded_deep_rejects_nonpositive_stages(capsys):
-    import pytest
 
     with pytest.raises(SystemExit):
         main(["train", "--model", "deep", "--sharded", "--steps", "1",
@@ -275,7 +271,6 @@ def test_train_temporal_sharded_with_native_loader(capsys):
 
 
 def test_native_loader_rejected_for_custom_batch_families(capsys):
-    import pytest
 
     with pytest.raises(SystemExit):
         main(["train", "--model", "moe", "--loader", "native",
@@ -339,7 +334,6 @@ def test_guard_restores_after_transient_nan(tmp_path, capsys, monkeypatch):
 
 
 def test_guard_aborts_after_persistent_divergence(capsys, monkeypatch):
-    import pytest
 
     from aws_global_accelerator_controller_tpu.cmd import compute
 
@@ -539,8 +533,48 @@ def test_preempt_exit_code_flag(tmp_path):
 
 
 def test_eval_bad_ckpt_is_a_clean_cli_error(tmp_path, capsys):
-    import pytest
 
     with pytest.raises(SystemExit, match="no checkpoint found"):
         main(["eval", "--ckpt", str(tmp_path / "polcy"),
               "--groups", "8", "--endpoints", "4", "--hidden", "16"])
+
+
+def test_temporal_train_knobs_chunk_and_flat_adam(capsys):
+    """The staged single-chip levers are drivable from the CLI: a
+    chunked-attention + flat-adam temporal run trains to a finite
+    loss (chunk > S degenerates to one call; kernel path itself is
+    pinned by tests/test_temporal_model.py)."""
+    assert main(["train", "--model", "temporal", "--steps", "2",
+                 "--groups", "2", "--endpoints", "4", "--window",
+                 "16", "--hidden", "16", "--supervision", "sequence",
+                 "--attention-chunk", "4", "--optimizer",
+                 "flat_adam"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "temporal" and out["step"] == 2
+    assert out["loss"] is not None
+
+
+def test_sharded_rejects_flat_adam():
+    """The raveled optimizer state has no axes for the planner's
+    NamedShardings — the CLI must reject the pair loudly, not shard
+    garbage."""
+    with pytest.raises(SystemExit) as exc:
+        main(["train", "--model", "temporal", "--sharded",
+              "--steps", "1", "--groups", "4", "--endpoints", "4",
+              "--window", "16", "--hidden", "16",
+              "--optimizer", "flat_adam"])
+    assert "flat_adam" in str(exc.value)
+
+
+def test_attention_chunk_cli_validation():
+    with pytest.raises(SystemExit) as exc:
+        main(["train", "--model", "temporal", "--steps", "1",
+              "--groups", "2", "--endpoints", "4", "--window", "16",
+              "--hidden", "16", "--attention-chunk", "-4"])
+    assert "attention-chunk" in str(exc.value)
+    with pytest.raises(SystemExit) as exc:
+        main(["train", "--model", "temporal", "--sharded",
+              "--steps", "1", "--groups", "4", "--endpoints", "4",
+              "--window", "16", "--hidden", "16",
+              "--attention-chunk", "32"])
+    assert "ring" in str(exc.value)

@@ -97,3 +97,13 @@ def test_temporal_model_trains_with_flat_adam():
 def test_unknown_optimizer_rejected():
     with pytest.raises(ValueError):
         TemporalTrafficModel(optimizer="sgd")
+
+
+def test_moment_buffers_are_distinct():
+    """mu and nu must not alias one zeros array: a donating train step
+    (donate_argnums on opt_state) would hand XLA the same buffer twice
+    — 'Attempt to donate the same buffer twice' at execute time."""
+    opt = flat_adam(1e-3)
+    state = opt.init(_tree(0))
+    assert state.mu.unsafe_buffer_pointer() != \
+        state.nu.unsafe_buffer_pointer()
