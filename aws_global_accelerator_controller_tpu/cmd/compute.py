@@ -278,6 +278,15 @@ def _build_model(args):
         raise SystemExit(
             "--layout zigzag only applies to --sharded temporal "
             "training (it balances the ring across sequence shards)")
+    if (args.model != "temporal"
+            and (getattr(args, "optimizer", "adam") != "adam"
+                 or getattr(args, "attention_chunk", 0))):
+        # inert elsewhere — a user benchmarking these levers must not
+        # conclude from a configuration that never ran (same posture
+        # as the zigzag and sharded guards)
+        raise SystemExit(
+            "--optimizer/--attention-chunk apply to the temporal "
+            f"family only (got --model {args.model})")
     if args.model == "temporal":
         from ..models.temporal import TemporalTrafficModel, synthetic_window
 

@@ -578,3 +578,11 @@ def test_attention_chunk_cli_validation():
               "--window", "16", "--hidden", "16",
               "--attention-chunk", "32"])
     assert "ring" in str(exc.value)
+
+
+def test_knobs_rejected_for_non_temporal_families():
+    with pytest.raises(SystemExit) as exc:
+        main(["train", "--model", "mlp", "--steps", "1",
+              "--groups", "4", "--endpoints", "4", "--hidden", "16",
+              "--optimizer", "flat_adam"])
+    assert "temporal" in str(exc.value)

@@ -1118,3 +1118,60 @@ def test_rate_limit_storm_surfaces_typed_error(rest, http_api):
     with pytest.raises(TooManyRequestsError):
         store.get("default", "whatever")
     rest.rate_limit_next = 0
+
+
+def test_controllers_converge_through_rate_limit_storms(rest, http_api):
+    """Full control-plane convergence while the apiserver periodically
+    sheds request bursts with 429 + Retry-After: the retry path is
+    load-bearing under the real manager (informers, workqueues, status
+    writes), not just for one GET."""
+    kube, factory, stop = _start_manager(http_api)
+    rest.rate_limit_retry_after = "0"
+    region = "ap-northeast-1"
+    n = 6
+    storm = threading.Event()
+
+    def shed_periodically():
+        # bursts of 2 stay under the client's 3-retry budget, so no
+        # single request can exhaust it even when a burst re-arms
+        # mid-sequence; the manager's informer backoff + workqueue
+        # requeues absorb anything beyond that regardless
+        while not storm.is_set():
+            rest.rate_limit_next = 2
+            storm.wait(0.15)
+
+    shedder = threading.Thread(target=shed_periodically, daemon=True)
+    try:
+        for i in range(n):
+            name = f"storm{i:02d}"
+            hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                        ".amazonaws.com")
+            factory.cloud.elb.register_load_balancer(name, hostname,
+                                                     region)
+            kube.services.create(Service(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION:
+                            "true",
+                    }),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                    ingress=[LoadBalancerIngress(hostname=hostname)])),
+            ))
+        # storms start only once the test's own unguarded creates are
+        # done — from here every request is the manager's, where
+        # retries/requeues make the path self-healing by design
+        shedder.start()
+        wait_until(
+            lambda: len(factory.cloud.ga.list_accelerators()) == n,
+            timeout=60.0, interval=0.2,
+            message="fleet converged through 429 storms")
+    finally:
+        storm.set()
+        if shedder.ident is not None:   # started
+            shedder.join(timeout=2)
+        rest.rate_limit_next = 0
+        stop.set()
