@@ -202,3 +202,36 @@ def test_rfc3339_junk_never_crashes(junk):
 
     out = rfc3339_to_epoch(junk)
     assert out is None or isinstance(out, float)
+
+
+# -- chunked attention exactness (any chunk size) ---------------------------
+
+
+@given(st.integers(1, 9), st.integers(0, 2 ** 31 - 1))
+@settings(max_examples=10, deadline=None)
+def test_attention_chunk_any_size_matches_whole(chunk, seed):
+    """Splitting the streams axis is exact for EVERY chunk size —
+    ragged tails, chunk=1, chunk >= S — not just the benched 32
+    (attention is per-head independent; the property the CLI knob
+    rides on).  The fleet shape stays FIXED (S=8 streams) so only the
+    chunking structure varies: each chunk size compiles once and
+    fresh windows ride the jit cache."""
+    import jax
+    import jax.numpy as jnp
+
+    from aws_global_accelerator_controller_tpu.models.temporal import (
+        TemporalTrafficModel,
+        synthetic_window,
+    )
+
+    kwargs = dict(feature_dim=4, embed_dim=8, hidden_dim=8,
+                  attention="flash_always", supervision="sequence")
+    whole = TemporalTrafficModel(**kwargs)
+    split = TemporalTrafficModel(attention_chunk=chunk, **kwargs)
+    window, _ = synthetic_window(
+        jax.random.PRNGKey(seed), steps=64, groups=2, endpoints=4,
+        feature_dim=4, per_step=True)
+    params = whole.init_params(jax.random.PRNGKey(0))
+    a = whole.scores_seq(params, window)
+    b = split.scores_seq(params, window)
+    assert jnp.allclose(a, b, rtol=1e-5, atol=1e-5)
