@@ -207,16 +207,28 @@ class TemporalTrafficModel(TrainableModel):
 
     def _embed_qkv(self, params: Params, window: jax.Array):
         """[T, G, E, F] -> (q, k, v [T, S, D]) for the full-attention
-        paths: one packed [D, 3D] projection — the MXU sees a single
-        wide matmul and emb crosses HBM once instead of three times
-        (same contraction per output column, so numerics match the
-        separate per-weight matmuls)."""
+        paths, projected through ONE composed [F, 3D] matrix.
+
+        With no bias or nonlinearity between the embedding and the
+        Q/K/V projections, ``(x@We) @ [Wq|Wk|Wv] == x @ (We@[Wq|Wk|
+        Wv])`` — exact in real arithmetic.  The composition deletes
+        the [T, S, D] embedding from this path entirely (it crossed
+        HBM twice) and contracts the tiny feature dim instead of D;
+        in the backward, the two [T*S]-row matmuls the chained form
+        needs (dW_qkv = embᵀ@dqkv and demb = dqkv@Wᵀ) collapse to one
+        xᵀ@dqkv with an [F, 3D] output, the weight chain riding tiny
+        [F, D]-class products.  Same bf16-association caveat as
+        ``_embed_kv`` (one rounding moved); every consumer — flash,
+        ring, reference attention, both supervision modes — shifts
+        together, and the last-query path's composed K/V are now the
+        SAME matrices this path slices."""
         t, g, e, f = window.shape
         x = window.astype(jnp.bfloat16).reshape(t, g * e, f)
-        emb = x @ params["embed"]                      # [T, S, D]
-        d = emb.shape[-1]
-        qkv = emb @ jnp.concatenate(
-            (params["wq"], params["wk"], params["wv"]), axis=1)
+        d = params["embed"].shape[-1]
+        wqkv = params["embed"] @ jnp.concatenate(
+            (params["wq"], params["wk"], params["wv"]),
+            axis=1)                                    # [F, 3D]
+        qkv = x @ wqkv                                 # [T, S, 3D]
         return qkv[..., :d], qkv[..., d:2 * d], qkv[..., 2 * d:]
 
     def _use_fused_head(self, ndim: int = 3) -> bool:
@@ -283,7 +295,11 @@ class TemporalTrafficModel(TrainableModel):
         k, v = self._embed_kv(params, window)
         x_last = window[last_index].astype(
             jnp.bfloat16).reshape(g * e, f)
-        q_last = (x_last @ params["embed"]) @ params["wq"]  # [S, D]
+        # composed like K/V (_embed_kv): q is then a slice of the same
+        # projection algebra the full path runs — per-column bitwise
+        # agreement, so last-vs-full parity is attention association
+        # alone
+        q_last = x_last @ (params["embed"] @ params["wq"])  # [S, D]
         attend_last = attend_last or attention_last_reference
         rep = attend_last(q_last, k, v)                # [S, D]
         return self._head(params, rep).reshape(g, e)

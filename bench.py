@@ -285,10 +285,12 @@ def bench_temporal_train(t: int = 2048, g: int = 8, e: int = 16,
     lax.scan of train steps, a data dependence XLA cannot elide).
 
     FLOP accounting matches bench_flash's conventions so the two MFU
-    numbers are comparable: dense matmuls (embed 2*T*S*F*D + QKV
-    6*T*S*D^2) count 3x for fwd+bwd, the causal attention term
-    (2*T^2*D*S) counts 3.5x — the same fwd + 2.5x-bwd model the kernel
-    bench uses (VJP-internal recompute not counted as useful).
+    numbers are comparable: dense matmuls count 3x for fwd+bwd at the
+    COMPOSED projection cost the model executes (QKV = 6*T*S*F*D via
+    x @ (We@Wqkv) — the round-4 composition lowered the required
+    math, so the counted FLOPs dropped with it), the causal attention
+    term (2*T^2*D*S) counts 3.5x — the same fwd + 2.5x-bwd model the
+    kernel bench uses (VJP-internal recompute not counted as useful).
     """
     import numpy as np
 
@@ -361,16 +363,20 @@ def bench_temporal_train(t: int = 2048, g: int = 8, e: int = 16,
 
     s = g * e
     # sequence supervision runs the head over ALL T rows (2*S*(D*H+H)
-    # per row) — counted, since those rows are supervised useful work
+    # per row) — counted, since those rows are supervised useful work.
+    # Projections count the COMPOSED form the model executes
+    # (x @ (We@Wqkv), contraction F not D — models/temporal.py
+    # _embed_qkv): the FLOP model prices the architecture's required
+    # math, and the round-4 composition lowered what is required
     head_fwd = 2.0 * s * (d * h + h)
-    dense_fwd = 2.0 * t * s * d * (f + 3 * d) + t * head_fwd
+    dense_fwd = 2.0 * t * s * f * 3 * d + t * head_fwd
     attn_fwd = 2.0 * t * t * d * s
     train_flops = 3.0 * dense_fwd + 3.5 * attn_fwd
-    # the last-supervised step's useful FLOPs: embed + K/V projections
-    # over all T but the q projection and head only for the final row,
-    # and one-row attention (2*T*D*S for QK^T and again for PV)
-    last_dense_fwd = (2.0 * t * s * d * (f + 2 * d)
-                      + 2.0 * s * d * d + head_fwd)
+    # the last-supervised step's useful FLOPs: composed K/V projection
+    # over all T, last-row embedding + q projection, one-row attention
+    # (2*T*D*S for QK^T and again for PV), one-row head
+    last_dense_fwd = (2.0 * t * s * f * 2 * d
+                      + 2.0 * s * f * d + head_fwd)
     last_flops = 3.0 * last_dense_fwd + 3.0 * (4.0 * t * d * s)
     peak, kind = _tpu_peak(jax.devices()[0])
     return {
@@ -1179,8 +1185,12 @@ transcript committed under `bench_artifacts/` by
 _REPORT_FOOTER = """\
 FLOP accounting: causal attention = 2·T²·D·H (QK^T + PV, halved for
 causality); grad = 2.5× fwd model FLOPs (VJP-internal recompute not
-counted); temporal step counts dense matmuls 3× (fwd+bwd) and the
-attention term 3.5×.  MFU = achieved / 197e12.
+counted); temporal step counts dense matmuls 3× (fwd+bwd) at the
+composed-projection cost the model executes (x @ (We@Wqkv), F-dim
+contraction) and the attention term 3.5×.  MFU = achieved / 197e12 —
+note the round-4 projection composition LOWERED the counted dense
+FLOPs along with the time, so cross-round MFU deltas understate the
+step-time win; compare step_ms.
 
 Reference baseline: the reference publishes **no** performance numbers
 (BASELINE.md), so `vs_baseline` in `bench.py` output is 1.0 by

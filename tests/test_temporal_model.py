@@ -185,15 +185,12 @@ def test_unknown_attention_impl_rejected():
 
 def test_scores_last_matches_full_attention():
     """The O(T) last-query path computes the same scores as the full
-    causal attention's final row, to bf16 association tolerance: the
-    last path projects K/V through the COMPOSED [F, 2D] matrix
-    (x @ (We@Wkv) vs the full path's (x@We) @ Wkv — exact in real
-    arithmetic, one bf16 rounding apart; _embed_kv docstring), so
-    parity is absolute at bf16-ulp scale on these O(1) logits, not
-    relative (scores near zero make rel ratios meaningless).  Bound:
-    observed gap at this seed is 7.8e-3 (two bf16 ulps at logit
-    scale); 1e-2 keeps a margin without letting a real regression
-    (wrong scale, dropped softmax term) slip through."""
+    causal attention's final row (float-association tolerance).  Both
+    paths project q/k/v through the SAME composed [F, *] matrices
+    (x @ (We@W..) — _embed_qkv/_embed_kv docstrings), so their
+    projections agree bitwise per column and the only daylight is the
+    attention reduction order; observed gap at this seed is exactly
+    0.0."""
     model = TemporalTrafficModel(feature_dim=8, embed_dim=16,
                                  hidden_dim=32, attention="reference")
     params = model.init_params(jax.random.PRNGKey(0))
@@ -201,7 +198,7 @@ def test_scores_last_matches_full_attention():
                                  groups=4, endpoints=8)
     full = np.asarray(model.scores(params, window))
     fast = np.asarray(model.scores_last(params, window))
-    np.testing.assert_allclose(fast, full, atol=1e-2)
+    np.testing.assert_allclose(fast, full, rtol=1e-4, atol=1e-5)
 
 
 def test_attention_last_reference_equals_oracle_last_row():
