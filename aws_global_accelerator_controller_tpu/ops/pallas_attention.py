@@ -314,8 +314,13 @@ def _stats_kernel(*refs, causal: bool, tri: bool,
     @pl.when(last_k)
     def _finalize():
         if normalize:
-            # padded query rows never attend (l == 0): the guard keeps
-            # them finite; their dO is zero in the backward anyway
+            # belt-and-braces guard for a fully-masked row (l == 0).
+            # NOTE current shapes never produce one: padded query rows
+            # DO attend — causally their q_pos >= t exceeds every live
+            # k_pos, non-causally rows see all live keys — so l >= 1
+            # always; do not use l == 0 as a padded-row detector.
+            # Padded rows' garbage outputs are sliced off by callers
+            # and their dO is zero in the backward
             o_ref[0] = (acc_ref[:]
                         / jnp.maximum(l_ref[:, 0], 1.0)[:, None]
                         ).astype(o_ref.dtype)

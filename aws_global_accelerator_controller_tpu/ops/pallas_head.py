@@ -44,20 +44,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_LANE = 128
-_SUBLANE = 8          # f32 second-minor tile granularity
+from .pallas_attention import _LANE, _pad_axis
+
+_SUBLANE = 8          # f32 second-minor tile granularity (the
+#                       attention module's is the bf16-safe 16)
 _TARGET_ROWS = 4096   # flattened [Bt*S] rows per grid step (VMEM budget)
 
 
 def _bf16_dot(a, b):
     return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(
         jnp.bfloat16)
-
-
-def _pad_axis(x, axis, to):
-    pad = [(0, 0)] * x.ndim
-    pad[axis] = (0, to - x.shape[axis])
-    return jnp.pad(x, pad)
 
 
 def _row_block(t: int, s_pad: int) -> int:

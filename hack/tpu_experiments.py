@@ -184,6 +184,12 @@ print(json.dumps(result))
 
 def main(argv=None) -> int:
     names = (argv or sys.argv[1:]) or list(_BODIES)
+    unknown = [n for n in names if n not in _BODIES]
+    if unknown:
+        # a typo must not burn the live window on a traceback
+        print(f"unknown experiment(s) {unknown}; "
+              f"valid: {', '.join(_BODIES)}", file=sys.stderr)
+        return 2
     ok = True
     for name in names:
         code = _PROLOG.format(repo=str(REPO)) + _BODIES[name]

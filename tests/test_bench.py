@@ -98,8 +98,8 @@ def _main_json(monkeypatch, capsys, tmp_path, status, detail):
     monkeypatch.setattr(
         bench, "bench_planner_subprocess",
         lambda **kw: (planner_calls.append(kw), "planner line")[1])
-    ran = {"flash": 0, "flash_long": 0, "temporal": 0, "smoke": 0,
-           "planner_calls": planner_calls}
+    ran = {"flash": 0, "flash_long": 0, "flash_xl": 0, "temporal": 0,
+           "smoke": 0, "planner_calls": planner_calls}
 
     def stub(name):
         def run(**kw):
@@ -112,6 +112,16 @@ def _main_json(monkeypatch, capsys, tmp_path, status, detail):
     monkeypatch.setattr(bench, "bench_temporal_subprocess",
                         stub("temporal"))
     monkeypatch.setattr(bench, "bench_smoke_subprocess", stub("smoke"))
+    # flash-xl rides the generic subprocess runner — stub it too, or
+    # the healthy-TPU contract test spawns a REAL jax subprocess (and
+    # the leg's main() wiring goes unasserted)
+    xl = stub("flash_xl")
+
+    def fake_subprocess(fn_name, what, timeout):
+        assert fn_name == "bench_flash_xl", fn_name
+        return xl()
+    monkeypatch.setattr(bench, "_json_bench_subprocess",
+                        fake_subprocess)
     bench.main()
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1, "main() must print exactly ONE stdout line"
@@ -126,10 +136,11 @@ def test_main_contract_healthy_tpu(monkeypatch, capsys, tmp_path):
     live = {"fwd_us": 1.0, "evidence": "measured-this-run"}
     assert data["tpu_flash"] == live
     assert data["tpu_flash_long"] == live
+    assert data["tpu_flash_xl"] == live
     assert data["tpu_temporal_train"] == live
     assert data["tpu_smoke"] == live
     assert ran["flash"] == ran["flash_long"] == ran["temporal"] == 1
-    assert ran["smoke"] == 1
+    assert ran["flash_xl"] == ran["smoke"] == 1
     assert ran["planner_calls"] == [{}]  # no cpu pin on a healthy tpu
 
 
@@ -138,14 +149,14 @@ def test_main_contract_dead_backend_still_one_line(monkeypatch, capsys,
     data, ran = _main_json(monkeypatch, capsys, tmp_path, "dead",
                            "unresponsive")
     assert data["value"] == 1000.0
-    for leg in ("tpu_flash", "tpu_flash_long", "tpu_temporal_train",
-                "tpu_smoke"):
+    for leg in ("tpu_flash", "tpu_flash_long", "tpu_flash_xl",
+                "tpu_temporal_train", "tpu_smoke"):
         assert "skipped" in data[leg]
         # a skipped leg must declare its evidence class so the reader
         # can tell testimony from measurement (VERDICT r3 item 8)
         assert data[leg]["evidence"] in ("builder-claimed", "none")
     assert ran["flash"] == ran["flash_long"] == ran["temporal"] == 0
-    assert ran["smoke"] == 0
+    assert ran["flash_xl"] == ran["smoke"] == 0
     # the backend-agnostic planner must still run, pinned to cpu
     assert ran["planner_calls"] == [{"force_cpu": True}]
 
