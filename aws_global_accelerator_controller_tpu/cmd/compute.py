@@ -99,7 +99,7 @@ def register(sub) -> None:
                             "0 = one call (default).")
     train.add_argument("--optimizer", choices=("adam", "flat_adam"),
                        default="adam",
-                       help="Temporal: adam = optax per-leaf tree "
+                       help="All families: adam = optax per-leaf tree "
                             "(required for sharded optimizer-state "
                             "layouts); flat_adam = one raveled-vector "
                             "update (f32 moments, fewer tiny kernels "
@@ -278,27 +278,26 @@ def _build_model(args):
         raise SystemExit(
             "--layout zigzag only applies to --sharded temporal "
             "training (it balances the ring across sequence shards)")
-    if (args.model != "temporal"
-            and (getattr(args, "optimizer", "adam") != "adam"
-                 or getattr(args, "attention_chunk", 0))):
-        # inert elsewhere — a user benchmarking these levers must not
+    optimizer = getattr(args, "optimizer", "adam")
+    if sharded and optimizer != "adam":
+        # the raveled state has no axes for the planners'
+        # NamedShardings to map (models.common.flat_adam) — every
+        # family's sharded path needs the per-leaf adam tree
+        raise SystemExit(
+            "--optimizer flat_adam is the single-chip fast path; "
+            "--sharded training needs the per-leaf adam state")
+    if args.model != "temporal" and getattr(args, "attention_chunk", 0):
+        # inert elsewhere — a user benchmarking this lever must not
         # conclude from a configuration that never ran (same posture
         # as the zigzag and sharded guards)
         raise SystemExit(
-            "--optimizer/--attention-chunk apply to the temporal "
-            f"family only (got --model {args.model})")
+            "--attention-chunk applies to the temporal family only "
+            f"(got --model {args.model})")
     if args.model == "temporal":
         from ..models.temporal import TemporalTrafficModel, synthetic_window
 
         supervision = getattr(args, "supervision", "last")
-        optimizer = getattr(args, "optimizer", "adam")
         chunk = getattr(args, "attention_chunk", 0)
-        if sharded and optimizer != "adam":
-            # the raveled state has no axes for the planner's
-            # NamedShardings to map (models.common.flat_adam)
-            raise SystemExit(
-                "--optimizer flat_adam is the single-chip fast path; "
-                "--sharded training needs the per-leaf adam state")
         if sharded and chunk:
             # the sharded planner attends through the ring (its own
             # _attend seam) — chunking would be silently inert, and a
@@ -381,7 +380,8 @@ def _build_model(args):
                                 learning_rate=lr,
                                 top_k=getattr(args, "top_k", 1),
                                 capacity_factor=cf,
-                                capacity_blocks=blocks)
+                                capacity_blocks=blocks,
+                                optimizer=optimizer)
         run_step, run_plan_fwd = _snapshot_runners(
             jax, model,
             lambda key: synthetic_moe_batch(
@@ -393,7 +393,8 @@ def _build_model(args):
 
         model = DeepTrafficModel(n_stages=args.stages,
                                  hidden_dim=args.hidden,
-                                 learning_rate=lr)
+                                 learning_rate=lr,
+                                 optimizer=optimizer)
         run_step, run_plan_fwd = _snapshot_runners(
             jax, model, _batch_source(args, loader_kind),
             lambda: _pipeline_planner(args, model), sharded)
@@ -401,7 +402,8 @@ def _build_model(args):
         from ..models.traffic import TrafficPolicyModel
 
         model = TrafficPolicyModel(hidden_dim=args.hidden,
-                                   learning_rate=lr)
+                                   learning_rate=lr,
+                                   optimizer=optimizer)
         run_step, run_plan_fwd = _snapshot_runners(
             jax, model, _batch_source(args, loader_kind),
             lambda: _mlp_planner(args, model), sharded)
@@ -610,7 +612,20 @@ def _run_train_loop(args, jax, stop) -> int:
 
     ckpt = TrainCheckpointer(args.ckpt) if args.ckpt else None
     if ckpt is not None and ckpt.latest_step() is not None:
-        start_step, params, opt_state = ckpt.restore(model)
+        try:
+            start_step, params, opt_state = ckpt.restore(model)
+        except (SystemExit, KeyboardInterrupt):
+            raise
+        except Exception as e:
+            # the common trip is resuming with a different --optimizer
+            # than the checkpoint was trained with: the opt_state tree
+            # structures disagree (FlatAdamState vs optax per-leaf)
+            # and orbax raises a structure mismatch — name it instead
+            # of dying in a raw traceback
+            raise SystemExit(
+                f"--ckpt: failed to resume from {args.ckpt}: {e} "
+                f"(if the checkpoint was trained with a different "
+                f"--optimizer, resume with the one that trained it)")
         logger.info("resumed from step %d (%s)", start_step, args.ckpt)
 
     profile_dir = getattr(args, "profile", "")
@@ -775,7 +790,10 @@ def run_eval(args) -> int:
                 f"--ckpt: no checkpoint found under {args.ckpt}")
         try:
             with TrainCheckpointer(args.ckpt, create=False) as ckpt:
-                step, params, _unused = ckpt.restore(model)
+                # params-only: eval must not care which optimizer
+                # trained the checkpoint (flat_adam vs adam states
+                # have different tree structures)
+                step, params = ckpt.restore_params(model)
         except Exception as e:
             # same posture as --policy-checkpoint: a bad artifact gets
             # a named CLI error, not a raw orbax traceback (orbax can
@@ -854,9 +872,24 @@ def _run_plan(args) -> int:
 
     model, _, run_plan_fwd = _build_model(args)
     if args.ckpt:
+        import os
+
         from ..models.checkpoint import TrainCheckpointer
-        with TrainCheckpointer(args.ckpt) as ckpt:
-            step, params, _unused = ckpt.restore(model)
+        if not os.path.isdir(args.ckpt):
+            # create=False + pre-check: a typo'd path must neither
+            # litter an empty orbax tree nor die in a raw traceback
+            # (the run_eval posture)
+            raise SystemExit(
+                f"--ckpt: no checkpoint found under {args.ckpt}")
+        try:
+            with TrainCheckpointer(args.ckpt, create=False) as ckpt:
+                # params-only (optimizer-structure agnostic)
+                step, params = ckpt.restore_params(model)
+        except (SystemExit, KeyboardInterrupt):
+            raise
+        except Exception as e:
+            raise SystemExit(
+                f"--ckpt: failed to restore from {args.ckpt}: {e}")
         logger.info("planning with step-%d params from %s", step,
                     args.ckpt)
     else:

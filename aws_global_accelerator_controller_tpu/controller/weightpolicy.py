@@ -106,7 +106,11 @@ class ModelWeightPolicy:
                 f"no checkpoint found under {directory}")
         with TrainCheckpointer(directory, create=False) as ckpt:
             try:
-                step, params, _ = ckpt.restore(model)
+                # params-only (optimizer-structure agnostic);
+                # validate=False: the shape check below owns mismatch
+                # diagnostics (it names the config AND the fix)
+                step, params = ckpt.restore_params(model,
+                                                   validate=False)
             except FileNotFoundError:
                 raise
             except Exception as exc:

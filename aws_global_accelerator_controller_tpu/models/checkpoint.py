@@ -19,6 +19,7 @@ import os
 from typing import Any, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 
 from .traffic import Params, TrafficPolicyModel
 
@@ -83,6 +84,51 @@ class TrainCheckpointer:
         restored = self._mngr.restore(
             step, args=self._ocp.args.StandardRestore(abstract))
         return step, restored["params"], restored["opt_state"]
+
+    def restore_params(self, model: TrafficPolicyModel,
+                       step: Optional[int] = None,
+                       validate: bool = True) -> Tuple[int, Params]:
+        """Restore (step, params) IGNORING the optimizer state.
+
+        The params-only consumers — eval, plan, the controller's
+        weight policy — must not depend on which optimizer trained
+        the checkpoint (a ``flat_adam`` trainer saves a
+        FlatAdamState where the full-template restore expects optax's
+        per-leaf tree and fails on the structure mismatch).  Restores
+        the raw saved tree with no template, then validates + casts
+        the params against the model's own init shapes, which is the
+        shape-fidelity the full restore provided.  ``validate=False``
+        skips the key/shape check (still casts known keys) for
+        callers with their own richer diagnostics — the controller's
+        weight policy names the config AND the fix."""
+        if step is None:
+            step = self._mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self._mngr.directory}")
+        restored = self._mngr.restore(step)
+        raw = restored["params"]
+        # abstract template: shapes/dtypes only, no RNG compute or a
+        # second params copy in device memory (restore()'s rationale)
+        template = jax.eval_shape(
+            lambda: model.init_params(jax.random.PRNGKey(0)))
+        if validate and set(raw) != set(template):
+            raise ValueError(
+                f"checkpoint params keys {sorted(raw)} do not match "
+                f"the model's {sorted(template)}")
+        params = {}
+        for name, got in raw.items():
+            got = jnp.asarray(got)
+            ref = template.get(name)
+            if ref is not None and got.shape == ref.shape:
+                got = got.astype(ref.dtype)
+            elif validate:
+                raise ValueError(
+                    f"checkpoint param {name!r} has shape {got.shape}, "
+                    f"model expects "
+                    f"{None if ref is None else ref.shape}")
+            params[name] = got
+        return step, params
 
     def close(self) -> None:
         self._mngr.wait_until_finished()

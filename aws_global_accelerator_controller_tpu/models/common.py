@@ -92,6 +92,19 @@ def flat_adam(learning_rate: float, b1: float = 0.9,
     return optax.GradientTransformation(init, update)
 
 
+def make_optimizer(name: str,
+                   learning_rate: float) -> optax.GradientTransformation:
+    """The one optimizer dispatch every family shares: ``"adam"`` =
+    optax per-leaf tree (required for sharded optimizer-state
+    layouts); ``"flat_adam"`` = the raveled single-vector update
+    above (single-chip fast path)."""
+    if name == "flat_adam":
+        return flat_adam(learning_rate)
+    if name == "adam":
+        return optax.adam(learning_rate)
+    raise ValueError(f"unknown optimizer {name!r}")
+
+
 class TrainableModel:
     """Mixin: optimizer plumbing over a subclass-provided ``loss``.
 

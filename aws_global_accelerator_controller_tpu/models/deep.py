@@ -24,10 +24,9 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from ..ops.weights import plan_weights
-from .common import TrainableModel, masked_ce_loss
+from .common import TrainableModel, make_optimizer, masked_ce_loss
 from .traffic import Batch, synthetic_batch  # noqa: F401  (re-export)
 
 Params = Dict[str, jax.Array]
@@ -47,11 +46,12 @@ class DeepTrafficModel(TrainableModel):
     def __init__(self, n_stages: int = N_STAGES,
                  feature_dim: int = FEATURE_DIM,
                  hidden_dim: int = HIDDEN_DIM,
-                 learning_rate: float = 1e-3):
+                 learning_rate: float = 1e-3,
+                 optimizer: str = "adam"):
         self.n_stages = n_stages
         self.feature_dim = feature_dim
         self.hidden_dim = hidden_dim
-        self.optimizer = optax.adam(learning_rate)
+        self.optimizer = make_optimizer(optimizer, learning_rate)
 
     def init_params(self, key: jax.Array) -> Params:
         k1, k2, k3 = jax.random.split(key, 3)

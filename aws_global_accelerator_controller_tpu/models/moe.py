@@ -33,10 +33,9 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from ..ops.weights import plan_weights
-from .common import TrainableModel, masked_ce_loss
+from .common import TrainableModel, make_optimizer, masked_ce_loss
 from .traffic import Batch
 
 Params = Dict[str, jax.Array]
@@ -69,7 +68,8 @@ class MoETrafficModel(TrainableModel):
                  aux_weight: float = 1e-2,
                  top_k: int = 1,
                  capacity_factor: "float | None" = None,
-                 capacity_blocks: int = 1):
+                 capacity_blocks: int = 1,
+                 optimizer: str = "adam"):
         """``top_k`` routes each group to its best k experts (gate-
         probability-weighted sum of their outputs); ``capacity_factor``
         bounds per-expert load — assignments past the budget are
@@ -94,7 +94,7 @@ class MoETrafficModel(TrainableModel):
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.capacity_blocks = capacity_blocks
-        self.optimizer = optax.adam(learning_rate)
+        self.optimizer = make_optimizer(optimizer, learning_rate)
 
     def init_params(self, key: jax.Array) -> Params:
         kg, k1, k2 = jax.random.split(key, 3)

@@ -24,10 +24,9 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from ..ops.weights import plan_weights
-from .common import TrainableModel, flat_adam, masked_ce_loss
+from .common import TrainableModel, make_optimizer, masked_ce_loss
 from .traffic import Batch
 
 Params = Dict[str, jax.Array]
@@ -113,12 +112,7 @@ class TemporalTrafficModel(TrainableModel):
         # rides replicated there (their opt in_sharding is
         # unconstrained) and each ravel gathers the sharded grads —
         # correct but anti-scaling; keep "adam" for sharded training.
-        if optimizer == "flat_adam":
-            self.optimizer = flat_adam(learning_rate)
-        elif optimizer == "adam":
-            self.optimizer = optax.adam(learning_rate)
-        else:
-            raise ValueError(f"unknown optimizer {optimizer!r}")
+        self.optimizer = make_optimizer(optimizer, learning_rate)
 
     def init_params(self, key: jax.Array) -> Params:
         ks = jax.random.split(key, 6)

@@ -20,10 +20,9 @@ from typing import Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from ..ops.weights import plan_weights
-from .common import TrainableModel, masked_ce_loss
+from .common import TrainableModel, make_optimizer, masked_ce_loss
 
 Params = Dict[str, jax.Array]
 
@@ -56,13 +55,13 @@ class TrafficPolicyModel(TrainableModel):
     def __init__(self, feature_dim: int = FEATURE_DIM,
                  hidden_dim: int = HIDDEN_DIM,
                  learning_rate: float = 1e-3,
-                 serve: str = "auto"):
+                 serve: str = "auto", optimizer: str = "adam"):
         if serve not in ("auto", "dense", "fused"):
             raise ValueError(f"unknown serve impl {serve!r}")
         self.feature_dim = feature_dim
         self.hidden_dim = hidden_dim
         self.serve = serve
-        self.optimizer = optax.adam(learning_rate)
+        self.optimizer = make_optimizer(optimizer, learning_rate)
 
     def init_params(self, key: jax.Array) -> Params:
         k1, k2, k3 = jax.random.split(key, 3)

@@ -580,9 +580,69 @@ def test_attention_chunk_cli_validation():
     assert "ring" in str(exc.value)
 
 
-def test_knobs_rejected_for_non_temporal_families():
+def test_attention_chunk_rejected_for_non_temporal_families():
     with pytest.raises(SystemExit) as exc:
         main(["train", "--model", "mlp", "--steps", "1",
               "--groups", "4", "--endpoints", "4", "--hidden", "16",
-              "--optimizer", "flat_adam"])
+              "--attention-chunk", "8"])
     assert "temporal" in str(exc.value)
+
+
+def test_flat_adam_works_across_families(capsys):
+    """The optimizer knob is family-agnostic single-chip: every family
+    trains a step with the raveled update."""
+    for model in ("mlp", "deep", "moe"):
+        assert main(["train", "--model", model, "--steps", "1",
+                     "--groups", "4", "--endpoints", "4", "--hidden",
+                     "16", "--optimizer", "flat_adam"]) == 0
+        out = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["model"] == model and out["loss"] is not None
+
+
+def test_flat_adam_checkpoint_restores_in_eval_and_plan(tmp_path,
+                                                        capsys):
+    """eval/plan are params-only consumers: a checkpoint trained with
+    --optimizer flat_adam (FlatAdamState, not optax's per-leaf tree)
+    must restore cleanly there (restore_params is optimizer-structure
+    agnostic)."""
+    ckpt = str(tmp_path / "flatck")
+    assert main(["train", "--steps", "2", "--ckpt", ckpt,
+                 "--groups", "4", "--endpoints", "4", "--hidden",
+                 "16", "--save-every", "2", "--optimizer",
+                 "flat_adam"]) == 0
+    capsys.readouterr()
+    assert main(["eval", "--ckpt", ckpt, "--groups", "4",
+                 "--endpoints", "4", "--hidden", "16",
+                 "--batches", "2"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["mean_loss"] is not None
+    assert main(["plan", "--ckpt", ckpt, "--groups", "4",
+                 "--endpoints", "4", "--hidden", "16"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["weights"]
+
+
+def test_resume_with_different_optimizer_is_a_clean_cli_error(
+        tmp_path, capsys):
+    """Resuming an adam checkpoint with --optimizer flat_adam (or vice
+    versa) has mismatched opt_state tree structures — that must be a
+    named CLI error with the fix, not a raw orbax traceback."""
+    ckpt = str(tmp_path / "adamck")
+    assert main(["train", "--steps", "1", "--ckpt", ckpt,
+                 "--groups", "4", "--endpoints", "4", "--hidden",
+                 "16", "--save-every", "1"]) == 0
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as exc:
+        main(["train", "--steps", "1", "--ckpt", ckpt,
+              "--groups", "4", "--endpoints", "4", "--hidden", "16",
+              "--optimizer", "flat_adam"])
+    assert "--optimizer" in str(exc.value)
+
+
+def test_plan_bad_ckpt_is_a_clean_cli_error(tmp_path):
+    with pytest.raises(SystemExit) as exc:
+        main(["plan", "--ckpt", str(tmp_path / "nope"),
+              "--groups", "4", "--endpoints", "4", "--hidden", "16"])
+    assert "no checkpoint" in str(exc.value)
+    assert not (tmp_path / "nope").exists()  # no orbax littering
