@@ -414,3 +414,26 @@ def test_two_sweep_fallback_above_budget(monkeypatch):
                         atol=5e-2), float(
         jnp.max(jnp.abs(got.astype(jnp.float32)
                         - want.astype(jnp.float32))))
+
+
+def test_causal_grads_respect_prefix_locality():
+    """With a cotangent restricted to output rows < p, causal dk/dv at
+    key positions > p must be EXACTLY zero (those keys are invisible
+    to every supervised row) — a mask slip in the fused one-sweep
+    backward would leak gradient across the causal boundary."""
+    t, heads, d, p = 128, 2, 32, 48
+    ks = jax.random.split(jax.random.PRNGKey(11), 4)
+    q, k, v = (jax.random.normal(kk, (t, heads, d), jnp.bfloat16)
+               for kk in ks[:3])
+    r = jax.random.normal(ks[3], (t, heads, d), jnp.float32)
+    r = r.at[p:].set(0.0)                     # supervise rows < p only
+
+    def loss(kk, vv):
+        return jnp.sum(flash_attention(q, kk, vv, causal=True)
+                       .astype(jnp.float32) * r)
+
+    dk, dv = jax.grad(loss, argnums=(0, 1))(k, v)
+    assert jnp.all(dk.astype(jnp.float32)[p:] == 0.0)
+    assert jnp.all(dv.astype(jnp.float32)[p:] == 0.0)
+    # and the visible prefix does carry gradient
+    assert float(jnp.max(jnp.abs(dv.astype(jnp.float32)[:p]))) > 0
