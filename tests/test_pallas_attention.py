@@ -416,20 +416,26 @@ def test_two_sweep_fallback_above_budget(monkeypatch):
                         - want.astype(jnp.float32))))
 
 
-def test_causal_grads_respect_prefix_locality():
+@pytest.mark.parametrize("bq,bk", [
+    (None, None),   # single 128-block: intra-block mask
+    (32, 32),       # p=48 crosses block boundaries: inter-block skip
+])
+def test_causal_grads_respect_prefix_locality(bq, bk):
     """With a cotangent restricted to output rows < p, causal dk/dv at
     key positions > p must be EXACTLY zero (those keys are invisible
     to every supervised row) — a mask slip in the fused one-sweep
-    backward would leak gradient across the causal boundary."""
+    backward would leak gradient across the causal boundary.  Run at
+    both one-block and multi-block tilings: the inter-block dead-skip
+    logic only exists in the latter."""
     t, heads, d, p = 128, 2, 32, 48
-    ks = jax.random.split(jax.random.PRNGKey(11), 4)
-    q, k, v = (jax.random.normal(kk, (t, heads, d), jnp.bfloat16)
-               for kk in ks[:3])
-    r = jax.random.normal(ks[3], (t, heads, d), jnp.float32)
+    q, k, v = _qkv(t, heads, d, seed=11, dtype=jnp.bfloat16)
+    r = jax.random.normal(jax.random.PRNGKey(12), (t, heads, d),
+                          jnp.float32)
     r = r.at[p:].set(0.0)                     # supervise rows < p only
 
     def loss(kk, vv):
-        return jnp.sum(flash_attention(q, kk, vv, causal=True)
+        return jnp.sum(flash_attention(q, kk, vv, causal=True,
+                                       block_q=bq, block_k=bk)
                        .astype(jnp.float32) * r)
 
     dk, dv = jax.grad(loss, argnums=(0, 1))(k, v)
