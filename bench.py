@@ -883,7 +883,9 @@ def tpu_probe(timeout: float = 60.0) -> "tuple[str, str]":
     return ("tpu", backend) if backend == "tpu" else ("other", backend)
 
 
-def bench_temporal_subprocess(timeout: float = 300.0) -> dict:
+def bench_temporal_subprocess(timeout: float = 480.0) -> dict:
+    # budget covers the round-4 chunked+flat variant's extra compiles
+    # (T(1)+T(n) of a 4-call chunked step over the tunnel)
     return _json_bench_subprocess("bench_temporal_train",
                                   "tpu temporal bench", timeout)
 

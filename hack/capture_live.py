@@ -43,7 +43,7 @@ BENCHES = [
     ("flash", 660.0),
     ("flash-long", 660.0),
     ("flash-xl", 1100.0),
-    ("temporal", 660.0),
+    ("temporal", 1100.0),
     ("temporal-breakdown", 2900.0),
     ("planner", 660.0),
     ("autotune", 2500.0),
