@@ -497,9 +497,14 @@ _FUSED_BWD_DQ_BYTES = 2 * 2 ** 20
 # Mosaic's scoped-vmem budget shrinks with the surrounding program's
 # VMEM pressure; at the temporal shape (128 streams-as-heads inside a
 # scan training loop) the fused kernel hits kernel-vmem-stack OOM at
-# every block size tried, while h <= 8 compiles and measures faster
-# (341.5 -> 301.0 us at T=2048).  Empirical ceiling with margin; the
-# two-sweep fallback is always correct.
+# every block size tried, while h <= 8 compiles on-chip.  32 is an
+# empirical ceiling with margin — h = 32 itself (the CLI's
+# --attention-chunk 32 path) is compile-verified by the h32_gate
+# experiment (hack/tpu_experiments.py) on a live window; any claimed
+# fused-vs-two-sweep speedup must come from that harness's interleaved
+# full-backward A/B, not single-shot timings (the r4 -12% claim was
+# retracted for lacking exactly that).  The two-sweep fallback is
+# always correct.
 _FUSED_BWD_MAX_HEADS = 32
 # Experiment knob (hack/tpu_experiments.py): explicit Mosaic VMEM
 # allotment for the fused backward's pallas_call — None keeps the
@@ -508,6 +513,40 @@ _FUSED_BWD_MAX_HEADS = 32
 # default (with the gates relaxed) only after an on-chip window
 # confirms compile + win.
 _FUSED_BWD_VMEM_LIMIT = None
+
+
+def _fused_bwd_eligible(tp_q: int, tp_k: int, dp: int, h: int) -> bool:
+    """THE fused one-sweep backward gate — the single predicate both
+    ``_flash_bwd_padded`` (route selection) and
+    ``backward_hw_matmul_factor`` (bench FLOP accounting) consult, so
+    the counted hardware factor can never drift from the route actually
+    taken."""
+    return (tp_q * dp * 4 <= _FUSED_BWD_DQ_BYTES and tp_q == tp_k
+            and h <= _FUSED_BWD_MAX_HEADS)
+
+
+def backward_hw_matmul_factor(t: int, h: int, d: int,
+                              block_q: "int | None" = None,
+                              block_k: "int | None" = None) -> float:
+    """Hardware matmul volume of ``jax.grad(flash_attention)`` relative
+    to the forward's model FLOPs, for the backward route these shapes
+    select.  Forward = 2 matmul passes (QK^T, PV) = 1.0x.  The fused
+    one-sweep backward adds 5 passes (s_t, dV, dP, dK, dQ) -> 3.5x
+    total; the two-sweep route recomputes scores and dP once per sweep
+    (dQ sweep: s, dP, dQ; dKV sweep: s_t, dP_t, dV, dK) -> 4.5x total.
+
+    Benchmarks use this to assert that an achieved-FLOP/s claim is
+    physically possible (counted model FLOPs / time must imply hardware
+    FLOP/s <= chip peak once multiplied by factor/3.5): the r4 flash-xl
+    "82.91% grad MFU" would have needed ~210 TFLOP/s of hardware work
+    on a 197 TFLOP/s chip — the measured program had dK/dV dead-code
+    eliminated.  Shares ``_fused_bwd_eligible`` with
+    ``_flash_bwd_padded``, so it reports the route actually taken."""
+    block_q, block_k = _resolve_blocks(t, t, block_q, block_k)
+    tp_q = -(-t // block_q) * block_q
+    tp_k = -(-t // block_k) * block_k
+    dp = -(-d // _LANE) * _LANE
+    return 3.5 if _fused_bwd_eligible(tp_q, tp_k, dp, h) else 4.5
 
 
 def _dqkv_kernel(*refs, causal: bool, tri: bool, scale: float,
@@ -843,9 +882,9 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
 
     # fused one-sweep backward: one score recompute (and one exp pass)
     # per live pair instead of two — eligible while a whole head's f32
-    # dq accumulator fits the VMEM budget
-    if (tp_q * dp * 4 <= _FUSED_BWD_DQ_BYTES and tp_q == tp_k
-            and h <= _FUSED_BWD_MAX_HEADS):
+    # dq accumulator fits the VMEM budget (_fused_bwd_eligible is the
+    # single shared gate; the bench FLOP accounting reads it too)
+    if _fused_bwd_eligible(tp_q, tp_k, dp, h):
         kern = functools.partial(_dqkv_kernel, causal=causal, tri=tri,
                                  scale=scale, t=t, block_q=block_q,
                                  block_k=block_k, num_q=num_q)

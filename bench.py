@@ -146,6 +146,66 @@ def _flash_setup(t: int, h: int, d: int):
     return jax, jnp, q, k, v, marginal_s, fwd_flops
 
 
+def _full_grad_step(jax, jnp, k, v, **kw):
+    """Gradient step differentiating w.r.t. ALL of (q, k, v), chained
+    through dq + dk + dv so every backward output feeds the next
+    iteration's query.  Differentiating w.r.t. q alone (the pre-r5
+    methodology) left the dK/dV pallas_call with no used outputs — JAX
+    dead-code-eliminated the whole equation while the FLOP model still
+    charged the full 3.5x backward, inflating every committed grad MFU
+    (r4 VERDICT weak #1: flash-xl claimed 82.91%, physically impossible
+    for the full program on a 197 TFLOP/s chip)."""
+    from aws_global_accelerator_controller_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+
+    grad = jax.grad(
+        lambda qq, kk, vv: jnp.sum(
+            flash_attention(qq, kk, vv, causal=True, **kw)
+            .astype(jnp.float32)),
+        argnums=(0, 1, 2))
+
+    def step(qq):
+        dq, dk, dv = grad(qq, k, v)
+        return dq + dk + dv
+    return step
+
+
+def _grad_fields(grad_s: float, fwd_flops: float, peak: float,
+                 t: int, h: int, d: int) -> dict:
+    """Grad-leg result fields with the physical-peak sanity gate.
+
+    Counted FLOPs stay on the standard fwd+bwd model convention
+    (3.5x fwd; VJP-internal recompute not counted as useful).  The
+    HARDWARE matmul volume is larger on the two-sweep route (4.5x —
+    ``ops.pallas_attention.backward_hw_matmul_factor``), and achieved
+    hardware FLOP/s above the chip's peak is proof the measured
+    program did not run the work being charged (exactly the r4 DCE
+    bug) — fail loudly instead of publishing it."""
+    from aws_global_accelerator_controller_tpu.ops.pallas_attention import (
+        backward_hw_matmul_factor,
+    )
+
+    grad_flops = fwd_flops * 3.5
+    hw_factor = backward_hw_matmul_factor(t, h, d)
+    hw_flops = fwd_flops * hw_factor
+    hw_tflops = hw_flops / grad_s / 1e12
+    if hw_tflops > 1.02 * peak / 1e12:
+        raise RuntimeError(
+            f"implied hardware {hw_tflops:.1f} TFLOP/s exceeds the "
+            f"chip peak {peak / 1e12:.0f} — the measured program "
+            f"cannot have run the charged backward (DCE or a wrong "
+            f"FLOP model); refusing to publish")
+    return {
+        "grad_us": round(grad_s * 1e6, 1),
+        "grad_tflops": round(grad_flops / grad_s / 1e12, 2),
+        "grad_mfu_pct": round(100.0 * grad_flops / grad_s / peak, 2),
+        "grad_wrt": "qkv",
+        "bwd_path": "fused" if hw_factor == 3.5 else "two_sweep",
+        "grad_hw_tflops": round(hw_tflops, 2),
+    }
+
+
 def bench_flash(t: int = 2048, h: int = 8, d: int = 128) -> dict:
     """Flash-attention kernel at MXU-saturating shapes, causal bf16.
 
@@ -182,16 +242,10 @@ def bench_flash(t: int = 2048, h: int = 8, d: int = 128) -> dict:
 
     fwd_s = marginal_s(
         lambda qq: flash_attention(qq, k, v, causal=True), n=4096)
-    grad_s = marginal_s(jax.grad(
-        lambda qq: jnp.sum(
-            flash_attention(qq, k, v, causal=True)
-            .astype(jnp.float32))), n=1024)
+    grad_s = marginal_s(_full_grad_step(jax, jnp, k, v), n=1024)
     dense_s = marginal_s(
         lambda qq: attention_reference(qq, k, v, causal=True), n=512)
 
-    # Grad accounting uses the standard fwd+bwd model-FLOPs convention
-    # (bwd = 2.5x fwd; recompute inside the VJP not counted as useful).
-    grad_flops = fwd_flops * 3.5
     peak, kind = _tpu_peak(jax.devices()[0])
     return {
         "backend": jax.default_backend(),
@@ -201,9 +255,7 @@ def bench_flash(t: int = 2048, h: int = 8, d: int = 128) -> dict:
         "fwd_us": round(fwd_s * 1e6, 1),
         "fwd_tflops": round(fwd_flops / fwd_s / 1e12, 2),
         "fwd_mfu_pct": round(100.0 * fwd_flops / fwd_s / peak, 2),
-        "grad_us": round(grad_s * 1e6, 1),
-        "grad_tflops": round(grad_flops / grad_s / 1e12, 2),
-        "grad_mfu_pct": round(100.0 * grad_flops / grad_s / peak, 2),
+        **_grad_fields(grad_s, fwd_flops, peak, t, h, d),
         "dense_us": round(dense_s * 1e6, 1),
         "speedup_vs_dense": round(dense_s / fwd_s, 2),
     }
@@ -431,9 +483,6 @@ def temporal_breakdown_legs(jax, t: int, g: int, e: int, d: int,
         TemporalTrafficModel,
         synthetic_window,
     )
-    from aws_global_accelerator_controller_tpu.ops.pallas_attention import (
-        flash_attention,
-    )
 
     model = TemporalTrafficModel(feature_dim=8, embed_dim=d,
                                  hidden_dim=h, attention="flash",
@@ -465,12 +514,12 @@ def temporal_breakdown_legs(jax, t: int, g: int, e: int, d: int,
                for kk in ks)
 
     def chained_attn(steps):
-        grad = jax.grad(lambda qq: jnp.sum(
-            flash_attention(qq, k, v, causal=True)
-            .astype(jnp.float32)))
+        # full backward (q, k AND v) — see _full_grad_step; the real
+        # train step this leg decomposes differentiates all three
+        step = _full_grad_step(jax, jnp, k, v)
 
         def body(_, qq):
-            return grad(qq).astype(qq.dtype)
+            return step(qq).astype(qq.dtype)
         return jax.jit(lambda q0: lax.fori_loop(0, steps, body, q0)
                        [0, 0].astype(jnp.float32))
 
@@ -590,10 +639,7 @@ def bench_flash_long(t: int = 8192, h: int = 8, d: int = 128) -> dict:
         reps=3)
     # long-context TRAINING headline: the recompute-based custom VJP at
     # T=8192 — the regime the O(T)-memory backward exists for
-    grad_s = marginal_s(jax.grad(
-        lambda qq: jnp.sum(flash_attention(qq, k, v, causal=True)
-                           .astype(jnp.float32))), n=64, reps=3)
-    grad_flops = flops * 3.5
+    grad_s = marginal_s(_full_grad_step(jax, jnp, k, v), n=64, reps=3)
     peak, kind = _tpu_peak(jax.devices()[0])
     return {
         "device_kind": kind,
@@ -601,9 +647,7 @@ def bench_flash_long(t: int = 8192, h: int = 8, d: int = 128) -> dict:
         "fwd_us": round(fwd_s * 1e6, 1),
         "fwd_tflops": round(flops / fwd_s / 1e12, 2),
         "fwd_mfu_pct": round(100.0 * flops / fwd_s / peak, 2),
-        "grad_us": round(grad_s * 1e6, 1),
-        "grad_tflops": round(grad_flops / grad_s / 1e12, 2),
-        "grad_mfu_pct": round(100.0 * grad_flops / grad_s / peak, 2),
+        **_grad_fields(grad_s, flops, peak, t, h, d),
     }
 
 
@@ -677,12 +721,13 @@ def autotune_flash_blocks(t: int = 2048, h: int = 8, d: int = 128,
 
     def chained_grad(c, steps):
         bq, bk = c
-        grad = jax.grad(lambda qq: jnp.sum(
-            flash_attention(qq, k, v, causal=True, block_q=bq,
-                            block_k=bk).astype(jnp.float32)))
+        # FULL backward (grad w.r.t. q, k AND v — _full_grad_step's
+        # rationale): the r4 sweep ranked configs on a program whose
+        # dK/dV equation was DCE'd away
+        step = _full_grad_step(jax, jnp, k, v, block_q=bq, block_k=bk)
 
         def body(_, qq):
-            return grad(qq).astype(qq.dtype)
+            return step(qq).astype(qq.dtype)
         return jax.jit(lambda q0: lax.fori_loop(0, steps, body, q0)
                        [0, 0].astype(jnp.float32))
 
@@ -760,9 +805,15 @@ def smoke_legs(jax, jnp) -> list:
     qp, kp, vp = qkv(384)       # with block 256: padded final-K path
 
     def grad_fn(qq, kk_, vv, causal, bq, bk):
-        return jax.grad(lambda g: jnp.sum(flash_attention(
-            g, kk_, vv, causal=causal, block_q=bq, block_k=bk)
-            .astype(jnp.float32)))(qq)
+        # differentiate w.r.t. ALL inputs and use every cotangent:
+        # grad w.r.t. q alone lets JAX DCE the two-sweep route's
+        # separate dK/dV pallas_call, so this gate would never have
+        # Mosaic-compiled _dkv_kernel at all (r4 VERDICT weak #1)
+        dq, dk, dv = jax.grad(
+            lambda a, b, c: jnp.sum(flash_attention(
+                a, b, c, causal=causal, block_q=bq, block_k=bk)
+                .astype(jnp.float32)), argnums=(0, 1, 2))(qq, kk_, vv)
+        return dq + dk + dv
 
     qs, ks_, vs = tuple(x.transpose(1, 0, 2) for x in (q, k, v))
 
@@ -910,10 +961,7 @@ def bench_flash_xl(t: int = 32768, h: int = 4, d: int = 128) -> dict:
     fwd_s = marginal_s(
         lambda qq: flash_attention(qq, k, v, causal=True), n=16,
         reps=3)
-    grad_s = marginal_s(jax.grad(
-        lambda qq: jnp.sum(flash_attention(qq, k, v, causal=True)
-                           .astype(jnp.float32))), n=8, reps=3)
-    grad_flops = flops * 3.5
+    grad_s = marginal_s(_full_grad_step(jax, jnp, k, v), n=8, reps=3)
     peak, kind = _tpu_peak(jax.devices()[0])
     return {
         "device_kind": kind,
@@ -921,9 +969,7 @@ def bench_flash_xl(t: int = 32768, h: int = 4, d: int = 128) -> dict:
         "fwd_us": round(fwd_s * 1e6, 1),
         "fwd_tflops": round(flops / fwd_s / 1e12, 2),
         "fwd_mfu_pct": round(100.0 * flops / fwd_s / peak, 2),
-        "grad_us": round(grad_s * 1e6, 1),
-        "grad_tflops": round(grad_flops / grad_s / 1e12, 2),
-        "grad_mfu_pct": round(100.0 * grad_flops / grad_s / peak, 2),
+        **_grad_fields(grad_s, flops, peak, t, h, d),
     }
 
 
@@ -1019,7 +1065,16 @@ def _attach_last_live(result: dict, name: str) -> dict:
     BENCH_LIVE.json, written by hack/capture_live.py) marked
     ``live: false`` with its ``measured_at`` date and transcript file —
     so a driver run during a wedge carries dated, transcript-backed
-    evidence instead of a bare skip (VERDICT r2 item 1)."""
+    evidence instead of a bare skip (VERDICT r2 item 1).
+
+    Only the KEY figures survive into the block (``_LAST_LIVE_KEYS``):
+    the r4 driver artifact lost its parse because full last_live blobs
+    pushed the one stdout line past the driver's 2,000-char tail
+    (VERDICT r4 weak #4) — everything else stays in BENCH_LIVE.json,
+    which the transcript field points the reader at.  A leg with grad
+    figures but no ``grad_wrt`` predates the r5 methodology fix and is
+    stamped ``grad_wrt: "q"``: differentiated w.r.t. q only, dK/dV
+    DCE'd, the MFU inflated (r4 VERDICT weak #1)."""
     if "skipped" not in result:
         return result
     try:
@@ -1035,14 +1090,39 @@ def _attach_last_live(result: dict, name: str) -> dict:
     # both come from the leg's own window (top-level fields are the
     # pre-provenance fallback) — a date its transcript can't back is
     # exactly the mismatch this block exists to avoid
+    keep = _LAST_LIVE_KEYS.get(name, ()) + ("tree",)
     last = {"live": False,
             "measured_at": (entry.get("finished_at")
                             or payload.get("measured_at")),
-            **entry}
+            **{k: v for k, v in entry.items() if k in keep}}
+    if "grad_mfu_pct" in entry and "grad_wrt" not in entry:
+        last["grad_wrt"] = "q"   # pre-r5 capture: backward partly DCE'd
     transcript = entry.get("transcript") or payload.get("transcript")
     if transcript:
         last["transcript"] = "bench_artifacts/" + transcript
     return {**result, "last_live": last}
+
+
+# per-leg key figures a skip-path last_live block carries on the ONE
+# stdout line ("tree" provenance always rides along); the full leg
+# payload stays in BENCH_LIVE.json, reachable via the transcript field
+_LAST_LIVE_KEYS = {
+    "smoke": ("ok", "total_s"),
+    "flash": ("fwd_mfu_pct", "grad_mfu_pct", "grad_wrt"),
+    "flash-long": ("fwd_mfu_pct", "grad_mfu_pct", "grad_wrt"),
+    "flash-xl": ("fwd_mfu_pct", "grad_mfu_pct", "grad_wrt"),
+    "temporal": ("step_ms", "train_mfu_pct", "chunked_step_ms"),
+}
+
+
+def _bound_skip_reason(result: dict, limit: int = 40) -> dict:
+    """Truncate a leg's skip diagnostic for the stdout line — the full
+    reason is in stderr and the transcript; five untruncated tunnel
+    diagnostics were part of what overflowed the r4 driver tail."""
+    if len(result.get("skipped", "")) > limit:
+        result = {**result,
+                  "skipped": result["skipped"][:limit - 1] + "…"}
+    return result
 
 
 def _label_evidence(result: dict) -> dict:
@@ -1128,6 +1208,8 @@ def main() -> None:
         _attach_last_live(flash_xl, "flash-xl"))
     temporal = _label_evidence(_attach_last_live(temporal, "temporal"))
     _record_reconcile_history(reconcile)
+    # stderr carries the FULL diagnostics; only the stdout contract
+    # line gets the skip reasons truncated (driver tail budget)
     print(f"tpu compile smoke: {smoke}", file=sys.stderr)
     print(f"tpu flash: {flash}", file=sys.stderr)
     print(f"tpu flash long-context (T=8192): {flash_long}", file=sys.stderr)
@@ -1146,11 +1228,11 @@ def main() -> None:
         # TPU compute track: flash kernel at MXU shapes with an MFU
         # estimate (VERDICT r1 item 2), plus the model-level number --
         # a full temporal-family training step through the flash VJP
-        "tpu_smoke": smoke,
-        "tpu_flash": flash,
-        "tpu_flash_long": flash_long,
-        "tpu_flash_xl": flash_xl,
-        "tpu_temporal_train": temporal,
+        "tpu_smoke": _bound_skip_reason(smoke),
+        "tpu_flash": _bound_skip_reason(flash),
+        "tpu_flash_long": _bound_skip_reason(flash_long),
+        "tpu_flash_xl": _bound_skip_reason(flash_xl),
+        "tpu_temporal_train": _bound_skip_reason(temporal),
     }))
 
 
@@ -1187,7 +1269,15 @@ transcript committed under `bench_artifacts/` by
 _REPORT_FOOTER = """\
 FLOP accounting: causal attention = 2·T²·D·H (QK^T + PV, halved for
 causality); grad = 2.5× fwd model FLOPs (VJP-internal recompute not
-counted); temporal step counts dense matmuls 3× (fwd+bwd) at the
+counted).  Grad methodology (r5): differentiate w.r.t. (q, k, v) with
+every cotangent feeding the chained data dependence (`grad_wrt: qkv`)
+and assert the implied HARDWARE FLOP/s (model FLOPs scaled by the
+engaged backward route's matmul factor —
+`ops.pallas_attention.backward_hw_matmul_factor`) stays ≤ chip peak;
+rows flagged **grad INFLATED** were measured pre-r5 with grad w.r.t.
+q only, which let JAX dead-code-eliminate the dK/dV computation while
+the FLOP model still charged it.  Temporal step counts dense matmuls
+3× (fwd+bwd) at the
 composed-projection cost the model executes (x @ (We@Wqkv), F-dim
 contraction) and the attention term 3.5×.  MFU = achieved / 197e12 —
 note the round-4 projection composition LOWERED the counted dense
@@ -1222,6 +1312,45 @@ smoke | planner | reconcile | autotune`.
 """
 
 
+# the sources whose change invalidates a captured kernel/model number
+# (the control-plane benches re-measure on every run and never go
+# stale this way)
+_PERF_SOURCES = (
+    "aws_global_accelerator_controller_tpu/ops",
+    "aws_global_accelerator_controller_tpu/models",
+    "aws_global_accelerator_controller_tpu/parallel",
+    "bench.py",
+)
+
+
+def _tree_note(tree) -> str:
+    """Render a leg's captured tree SHA, marking the row STALE when the
+    perf-relevant sources differ from the current working tree (r4
+    VERDICT weak #5: docs presented numbers for code that no longer
+    existed, with nothing machine-recording that).  The verdict is as
+    of the last `make benchdoc`; the docs drift test re-renders and
+    compares, so any change to these sources forces a regeneration —
+    and with it a fresh staleness verdict — before CI goes green."""
+    import subprocess
+
+    if not tree:
+        return ""
+    note = f"; tree `{tree}`"
+    if tree.endswith("+dirty"):
+        return note + " — **measured on a dirty tree**"
+    try:
+        rc = subprocess.run(
+            ["git", "diff", "--quiet", tree, "--", *_PERF_SOURCES],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stderr=subprocess.DEVNULL).returncode
+    except OSError:
+        return note
+    if rc == 1:
+        return (note + " — **STALE: kernel/model/bench sources have "
+                "changed since this capture**")
+    return note  # rc 0: current; rc >= 2: sha unverifiable here
+
+
 def bench_report() -> str:
     """Render docs/benchmarks.md from committed artifacts: the
     builder-claims table overlaid with the latest live capture, each
@@ -1246,9 +1375,9 @@ def bench_report() -> str:
     lines.append("| Bench | Shape | Result | Evidence |")
     lines.append("|---|---|---|---|")
     # capture_live.py wraps each leg's payload with bookkeeping
-    # timestamps + transcript provenance; only the measurement keys
-    # belong in the doc
-    wrapper_keys = ("started_at", "finished_at", "transcript")
+    # timestamps + transcript/tree provenance; only the measurement
+    # keys belong in the doc's detail cell (tree renders separately)
+    wrapper_keys = ("started_at", "finished_at", "transcript", "tree")
     for row in claims["rows"]:
         if "evidence" in row:
             # a row with static evidence (e.g. reconcile: reproduced
@@ -1263,6 +1392,12 @@ def bench_report() -> str:
                 detail = ", ".join(
                     f"{k}={v}" for k, v in entry.items()
                     if k not in wrapper_keys).replace("|", "\\|")
+                if ("grad_mfu_pct" in entry
+                        and "grad_wrt" not in entry):
+                    # pre-r5 capture: grad w.r.t. q only, dK/dV DCE'd
+                    detail += (", **grad INFLATED (pre-r5 "
+                               "methodology: dK/dV dead-code-"
+                               "eliminated)**")
                 # cite the transcript + window that actually measured
                 # THIS leg: merged partial captures carry legs from
                 # earlier windows whose evidence lives in earlier
@@ -1271,11 +1406,13 @@ def bench_report() -> str:
                 leg_transcript = (entry.get("transcript")
                                   or live_transcript)
                 leg_date = entry.get("finished_at") or live_date
+                tree_note = _tree_note(entry.get("tree"))
                 evidence = (f"**live capture {leg_date}** ({detail}; "
                             f"transcript `bench_artifacts/"
-                            f"{leg_transcript}`)" if leg_transcript
+                            f"{leg_transcript}`{tree_note})"
+                            if leg_transcript
                             else f"**live capture {leg_date}** "
-                            f"({detail})")
+                            f"({detail}{tree_note})")
             elif row.get("pending"):
                 # a leg added before any measurement exists must not
                 # masquerade as builder-claimed

@@ -82,6 +82,24 @@ def _utc() -> str:
         "%Y-%m-%dT%H:%M:%SZ")
 
 
+def _tree() -> str:
+    """Short SHA of the tree being measured, '+dirty' when the working
+    tree differs from it — stamped into the transcript header and every
+    leg so each number traces to the code that produced it (r4 VERDICT
+    weak #5: every committed kernel number described a tree 20 commits
+    behind HEAD with nothing recording that)."""
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True).stdout.strip() or "unknown"
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain", "-uno"], cwd=REPO,
+            capture_output=True, text=True).stdout.strip()
+        return sha + ("+dirty" if dirty else "")
+    except OSError:
+        return "unknown"
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     known = {name for name, _ in BENCHES}
@@ -95,12 +113,14 @@ def main(argv=None) -> int:
 
     ART.mkdir(exist_ok=True)
     stamp = _utc().replace(":", "")
+    tree = _tree()
     transcript = ART / f"transcript_{stamp}.log"
     results: dict = {}
     any_live = False
     ok_legs: list = []
     with transcript.open("w") as log:
         log.write(f"# live TPU bench capture started {_utc()}\n")
+        log.write(f"# tree: {tree}\n")
         log.write("# host cmd: python bench.py <name> (see bench.py)\n")
         if partial:
             log.write(f"# partial capture: {[n for n, _ in selected]}\n")
@@ -136,6 +156,7 @@ def main(argv=None) -> int:
             # bench.py report reads this field per row
             results[name] = {"started_at": start, "finished_at": end,
                              "transcript": transcript.name,
+                             "tree": tree,
                              **(parsed if isinstance(parsed, dict)
                                 else {"value": parsed})}
             leg_ok = isinstance(parsed, dict) and "skipped" not in parsed
@@ -184,6 +205,7 @@ def main(argv=None) -> int:
         "measured_at": _utc(),
         "transcript": transcript.name,
         "transcripts": transcripts,
+        "tree": tree,
         "live": live_flag,
         "results": merged_results,
     }

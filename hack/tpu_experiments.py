@@ -7,7 +7,7 @@ gated to Tp*D*4 <= 2 MB and H <= 32 because the temporal shape
 OOM and T=8192 was untested.  Each experiment here answers one
 promotion question, in its OWN subprocess (a Mosaic failure or wedge
 must not kill the batch), appending JSON lines to
-``bench_artifacts/experiments_r4.jsonl``:
+``bench_artifacts/experiments_r5.jsonl``:
 
 - ``s128_vmem``: does an explicit ``vmem_limit_bytes`` let the fused
   kernel compile at S=128 under a scan — and is it faster than the
@@ -33,7 +33,7 @@ import subprocess
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
-OUT = REPO / "bench_artifacts" / "experiments_r4.jsonl"
+OUT = REPO / "bench_artifacts" / "experiments_r5.jsonl"
 
 _PROLOG = """
 import json, sys
@@ -50,10 +50,16 @@ from aws_global_accelerator_controller_tpu.ops import pallas_attention as pa
 
 
 def chain_grad(q, k, v, n):
-    g = jax.grad(lambda qq: jnp.sum(
-        pa.flash_attention(qq, k, v, causal=True).astype(jnp.float32)))
+    # FULL backward: grad w.r.t. (q, k, v) with every cotangent feeding
+    # the chain — grad w.r.t. q alone lets JAX DCE the two-sweep dK/dV
+    # pallas_call, making fused-vs-two-sweep A/Bs apples-to-oranges
+    # (r4 VERDICT weak #1/#2)
+    g = jax.grad(lambda qq, kk, vv: jnp.sum(
+        pa.flash_attention(qq, kk, vv, causal=True)
+        .astype(jnp.float32)), argnums=(0, 1, 2))
     def body(_, qq):
-        return g(qq).astype(qq.dtype)
+        dq, dk, dv = g(qq, k, v)
+        return (dq + dk + dv).astype(qq.dtype)
     return jax.jit(lambda q0: lax.fori_loop(0, n, body, q0)[0, 0]
                    .astype(jnp.float32))
 
@@ -141,6 +147,36 @@ for limit_mb in (None, 128):
 result["us_per_iter"] = ab(progs, q, n)
 print(json.dumps(result))
 """,
+    # h=32 at the chunked-attention shape: the CLI's --attention-chunk
+    # 32 path lands exactly on _FUSED_BWD_MAX_HEADS=32, whose comment
+    # admits only h <= 8 was confirmed to compile on-chip (r4 ADVICE).
+    # Verifies the fused compile at the gate edge and A/Bs it against
+    # the two-sweep it would otherwise take.
+    "h32_gate": """
+t, h, d, n = 2048, 32, 128, 64
+ks = jax.random.split(jax.random.PRNGKey(0), 3)
+q, k, v = (jax.random.normal(kk, (t, h, d), jnp.bfloat16) for kk in ks)
+result = {"exp": "h32_gate", "t": t, "h": h,
+          "gates": gates_snapshot()}
+progs = {}
+shipped_dq_bytes = pa._FUSED_BWD_DQ_BYTES   # the default under test
+pa._FUSED_BWD_DQ_BYTES = 0            # two-sweep baseline
+jax.clear_caches()
+f1, fn = chain_grad(q, k, v, 1), chain_grad(q, k, v, n)
+np.asarray(f1(q)); np.asarray(fn(q))
+progs["two_sweep"] = (f1, fn, gates_snapshot())
+pa._FUSED_BWD_DQ_BYTES = shipped_dq_bytes   # fused at h=32 (shipped)
+jax.clear_caches()
+try:
+    f1, fn = chain_grad(q, k, v, 1), chain_grad(q, k, v, n)
+    np.asarray(f1(q)); np.asarray(fn(q))
+    progs["fused_h32"] = (f1, fn, gates_snapshot())
+except Exception as exc:
+    result["fused_h32_error"] = (
+        f"{type(exc).__name__}: {str(exc)[-160:]}")
+result["us_per_iter"] = ab(progs, q, n)
+print(json.dumps(result))
+""",
     # staged levers end-to-end on the real train step
     "temporal_tuned": """
 from aws_global_accelerator_controller_tpu.models.temporal import (
@@ -190,6 +226,11 @@ def main(argv=None) -> int:
         print(f"unknown experiment(s) {unknown}; "
               f"valid: {', '.join(_BODIES)}", file=sys.stderr)
         return 2
+    # tree provenance on every result line (r4 VERDICT weak #5) —
+    # same stamp as the bench transcripts
+    sys.path.insert(0, str(REPO / "hack"))
+    from capture_live import _tree
+    tree = _tree()
     ok = True
     for name in names:
         code = _PROLOG.format(repo=str(REPO)) + _BODIES[name]
@@ -207,6 +248,7 @@ def main(argv=None) -> int:
             parsed = {"exp": name,
                       "skipped": f"{type(exc).__name__}: {exc}"}
         parsed["started_at"] = started
+        parsed["tree"] = tree
         with open(OUT, "a") as f:
             f.write(json.dumps(parsed) + "\n")
         print(f"[experiment] {name}: {json.dumps(parsed)[:300]}",
