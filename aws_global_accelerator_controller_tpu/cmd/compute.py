@@ -660,8 +660,37 @@ def _run_train_loop(args, jax, stop) -> int:
                     "stop signal: checkpointing at step %d and "
                     "exiting cleanly", step_label)
                 break
-            new_params, new_opt, new_loss = run_step(
-                params, opt_state, jax.random.fold_in(key, batch_idx))
+            try:
+                new_params, new_opt, new_loss = run_step(
+                    params, opt_state,
+                    jax.random.fold_in(key, batch_idx))
+            except (SystemExit, KeyboardInterrupt):
+                raise
+            except Exception as e:
+                compile_like = any(
+                    sig in str(e).lower() for sig in
+                    ("mosaic", "vmem", "pallas", "resource_exhausted",
+                     "scoped"))
+                if (batch_idx == start_step and compile_like
+                        and getattr(args, "attention_chunk", 0)):
+                    # first step = compile.  --attention-chunk 32
+                    # lands exactly on the fused backward's head-gate
+                    # edge (_FUSED_BWD_MAX_HEADS); an on-chip Mosaic
+                    # scoped-vmem rejection must surface as a named
+                    # CLI error like the other temporal knobs, not a
+                    # raw compiler traceback (r4 ADVICE #2).  Gated on
+                    # the failure text so an unrelated first-step
+                    # error is not misattributed to the knob
+                    raise SystemExit(
+                        f"--attention-chunk "
+                        f"{args.attention_chunk}: the chunked "
+                        f"attention program failed to compile on "
+                        f"this backend: {e} (a Mosaic scoped-vmem "
+                        f"rejection at the fused-backward head gate "
+                        f"is the known trip — drop --attention-chunk "
+                        f"to take the always-correct two-sweep "
+                        f"route)")
+                raise
             if guard and not _finite(new_loss):
                 # divergence: discard this update, roll back to the
                 # last durable state (its true step label comes back

@@ -297,12 +297,26 @@ class RestClient:
     @classmethod
     def _retry_after_s(cls, e: urllib.error.HTTPError) -> float:
         """Seconds to wait per the 429's Retry-After header — absent or
-        malformed falls back to 1s (client-go's floor), always capped."""
+        malformed falls back to 1s (client-go's floor), always capped.
+
+        The apiserver emits integer seconds, but RFC 7231 also permits
+        an HTTP-date and a proxy between client and apiserver may
+        rewrite the header to that form — parse it second rather than
+        silently under-waiting at the 1s floor (r4 ADVICE #3)."""
         raw = e.headers.get("Retry-After", "") if e.headers else ""
         try:
             wait = float(raw)
         except (TypeError, ValueError):
-            wait = 1.0
+            try:
+                from email.utils import parsedate_to_datetime
+
+                import datetime
+
+                when = parsedate_to_datetime(raw)
+                wait = (when - datetime.datetime.now(
+                    datetime.timezone.utc)).total_seconds()
+            except (TypeError, ValueError):
+                wait = 1.0
         return max(0.0, min(wait, cls._RATE_LIMIT_MAX_WAIT_S))
 
     @staticmethod

@@ -100,7 +100,18 @@ class TrainCheckpointer:
         shape-fidelity the full restore provided.  ``validate=False``
         skips the key/shape check (still casts known keys) for
         callers with their own richer diagnostics — the controller's
-        weight policy names the config AND the fix."""
+        weight policy names the config AND the fix.
+
+        Transient cost (r4 ADVICE #4): the whole checkpoint — params
+        AND optimizer state (for flat_adam, moments ~2x the params in
+        f32) — is materialised in HOST memory before the opt_state is
+        dropped.  orbax 0.11's Standard handler offers no partial
+        restore of a StandardSave'd tree (verified: StandardRestore
+        with a params-only template raises a structure mismatch, and
+        PyTreeRestore/PLACEHOLDER don't match the registered
+        handler), so the eager no-template restore is the available
+        minimum; the discarded moments never reach device memory and
+        are freed on return."""
         if step is None:
             step = self._mngr.latest_step()
         if step is None:

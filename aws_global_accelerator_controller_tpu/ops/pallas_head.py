@@ -58,8 +58,12 @@ def _bf16_dot(a, b):
 
 def _row_block(t: int, s_pad: int) -> int:
     """T-rows per grid step: ~_TARGET_ROWS flattened rows, at least the
-    f32 sublane tile, never more than (padded) T."""
-    bt = max(_SUBLANE, _TARGET_ROWS // s_pad)
+    f32 sublane tile, never more than (padded) T.  Rounded DOWN to a
+    sublane multiple: a raw _TARGET_ROWS // s_pad (e.g. 10 at
+    s_pad=384) would make the [bt, s_pad] output block 8-row
+    misaligned against the padded T — a Mosaic compile risk on TPU
+    (r4 ADVICE #1; the benchmarked s_pad=128 gives 32 and was fine)."""
+    bt = max(_SUBLANE, (_TARGET_ROWS // s_pad) // _SUBLANE * _SUBLANE)
     tp = -(-t // _SUBLANE) * _SUBLANE
     return min(bt, tp)
 

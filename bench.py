@@ -1152,6 +1152,54 @@ _HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "reconcile_history.jsonl")
 
 
+def reconcile_floor(default: float = 400.0, trailing: int = 8,
+                    history_path: "str | None" = None) -> float:
+    """Regression floor (services/s) for the reconcile hot path,
+    derived from the committed measurement history (VERDICT r4 #5:
+    the static 400 floor sat 5.7x under the measured median, so a 5x
+    hot-path regression would have passed CI).
+
+    Floor = max(default, min(0.5 * median, 0.9 * min) of the trailing
+    committed best-of-3 runs) — but ONLY on a quiet host (1-minute
+    loadavg under half the cores).  Convergence time is
+    thread-scheduling bound; measured best-of-3 under two concurrent
+    full-suite runs was ~600/s vs 1700-3500/s quiet, so a derived
+    floor enforced on a loaded host would flake the whole -x suite.
+    The 0.9*min cap keeps the bar below every committed legitimate
+    measurement (the trailing window's own spread is ~2x, so a bar
+    above its minimum would predict its own flakes); as
+    post-optimization rounds accumulate, min rises and the floor
+    tightens automatically.  The derivation assumes the history was
+    measured on this host class — on foreign/slower hardware set
+    RECONCILE_FLOOR_SVC_S explicitly (it overrides everything)."""
+    env = os.environ.get("RECONCILE_FLOOR_SVC_S")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            raise ValueError(
+                f"RECONCILE_FLOOR_SVC_S must be a number in "
+                f"services/s, got {env!r}") from None
+    try:
+        if os.getloadavg()[0] > 0.5 * (os.cpu_count() or 1):
+            return default          # loaded host: conservative floor
+    except OSError:
+        return default
+    try:
+        with open(history_path or _HISTORY_PATH) as f:
+            vals = [json.loads(line)["throughput"]
+                    for line in f if line.strip()]
+    except (OSError, ValueError, KeyError):
+        return default
+    if len(vals) < 3:
+        return default              # not enough history to trust
+    import statistics
+
+    window = vals[-trailing:]
+    return max(default, min(0.5 * statistics.median(window),
+                            0.9 * min(window)))
+
+
 def _record_reconcile_history(reconcile: dict) -> None:
     """Append the control-plane number to a committed round-over-round
     record (VERDICT r3 item 2) so a real hot-path decay is visible as a
@@ -1330,7 +1378,10 @@ def _tree_note(tree) -> str:
     existed, with nothing machine-recording that).  The verdict is as
     of the last `make benchdoc`; the docs drift test re-renders and
     compares, so any change to these sources forces a regeneration —
-    and with it a fresh staleness verdict — before CI goes green."""
+    and with it a fresh staleness verdict — before CI goes green.
+    Requires full git history: on a shallow clone the capture sha is
+    unresolvable (rc >= 2) and the plain note renders instead — run
+    `make benchdoc` on a full clone."""
     import subprocess
 
     if not tree:

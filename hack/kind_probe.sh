@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Probe whether the kind e2e (hack/kind-e2e.sh, mirroring the
+# reference's CI workflow) can execute in this environment, and record
+# the evidence in a committed artifact (VERDICT r4 next #6: the
+# partial webhook-e2e row must carry proof of impossibility, not
+# silence).  Usage: hack/kind_probe.sh [out-file]
+set -u
+OUT="${1:-bench_artifacts/kind_probe_r5.txt}"
+cd "$(dirname "$0")/.."
+
+{
+    echo "# kind e2e environment probe"
+    echo "date: $(date -u +%FT%TZ)"
+    echo "tree: $(git rev-parse --short HEAD 2>/dev/null)$(git status --porcelain -uno 2>/dev/null | grep -q . && echo '+dirty')"
+    echo
+    for tool in kind kubectl docker podman; do
+        if command -v "$tool" >/dev/null 2>&1; then
+            echo "$tool: $(command -v "$tool") ($("$tool" --version 2>&1 | head -1))"
+        else
+            echo "$tool: ABSENT"
+        fi
+    done
+    echo
+    echo "# network egress (kind needs to pull node images)"
+    if command -v getent >/dev/null 2>&1; then
+        if timeout 5 getent hosts registry.k8s.io >/dev/null 2>&1; then
+            echo "dns registry.k8s.io: resolves"
+        else
+            echo "dns registry.k8s.io: FAILS (no egress)"
+        fi
+    else
+        echo "getent: ABSENT"
+    fi
+    # a raw TCP attempt, independent of DNS
+    if timeout 5 bash -c 'exec 3<>/dev/tcp/1.1.1.1/443' 2>/dev/null; then
+        echo "tcp 1.1.1.1:443: connects"
+    else
+        echo "tcp 1.1.1.1:443: FAILS (no egress)"
+    fi
+    echo
+    echo "# verdict"
+    if command -v kind >/dev/null 2>&1 && command -v kubectl >/dev/null 2>&1; then
+        echo "kind+kubectl present: hack/kind-e2e.sh is runnable; run it."
+    else
+        echo "kind e2e NOT runnable here: container tooling absent (and"
+        echo "no egress to install it).  The suite's 19+ golden real-"
+        echo "apiserver wire fixtures + kube/rest_server.py stub remain"
+        echo "the strongest available evidence; .github/workflows/"
+        echo "kind-e2e.yml runs the real thing where CI exists."
+    fi
+} | tee "$OUT"

@@ -28,7 +28,19 @@ EOF
     if [ "$status" = "tpu" ]; then
         echo "$(date -u +%FT%TZ) tunnel ALIVE - capturing"
         if python hack/capture_live.py ${LEGS[@]+"${LEGS[@]}"}; then
-            echo "$(date -u +%FT%TZ) capture complete"
+            echo "$(date -u +%FT%TZ) capture complete - running gate experiments"
+            # burn the rest of the window on the staged-promotion
+            # experiments (fused-backward gates, temporal levers);
+            # capture_live already committed its own artifacts
+            if python hack/tpu_experiments.py; then
+                echo "$(date -u +%FT%TZ) experiments complete"
+            else
+                echo "$(date -u +%FT%TZ) experiments incomplete (see bench_artifacts/experiments_r5.jsonl)"
+            fi
+            git add bench_artifacts 2>/dev/null
+            if ! git commit -m "bench: on-chip gate experiments $(date -u +%FT%TZ)" -- bench_artifacts >/dev/null 2>&1; then
+                echo "$(date -u +%FT%TZ) WARNING: experiment-artifact commit failed - bench_artifacts left uncommitted (commit by hand)"
+            fi
             exit 0
         fi
         echo "$(date -u +%FT%TZ) capture produced no live result; continuing watch"

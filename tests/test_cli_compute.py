@@ -580,6 +580,61 @@ def test_attention_chunk_cli_validation():
     assert "ring" in str(exc.value)
 
 
+def test_attention_chunk_compile_failure_is_named(monkeypatch):
+    """An on-chip Mosaic rejection of the chunked program (the
+    --attention-chunk 32 path sits on the fused backward's head-gate
+    edge) must surface as a named CLI error, not a raw compiler
+    traceback (r4 ADVICE #2).  Other failures — and later-step
+    failures — stay raw."""
+    from aws_global_accelerator_controller_tpu.cmd import compute
+
+    real_build = compute._build_model
+
+    def build(args):
+        model, run_step, run_plan_fwd = real_build(args)
+
+        def broken_step(params, opt_state, key):
+            raise ValueError("Mosaic failed: scoped vmem exceeded")
+        return model, broken_step, run_plan_fwd
+
+    monkeypatch.setattr(compute, "_build_model", build)
+    argv = ["train", "--model", "temporal", "--steps", "2",
+            "--groups", "2", "--endpoints", "4", "--window", "16",
+            "--hidden", "16", "--supervision", "sequence"]
+    with pytest.raises(SystemExit) as exc:
+        main(argv + ["--attention-chunk", "32"])
+    msg = str(exc.value)
+    assert "--attention-chunk 32" in msg and "two-sweep" in msg
+    assert "scoped vmem" in msg          # original cause preserved
+    # without the knob the same failure propagates raw
+    with pytest.raises(ValueError):
+        main(argv)
+
+
+def test_attention_chunk_unrelated_failure_stays_raw(monkeypatch):
+    """A first-step failure WITHOUT a compiler signature must not be
+    misattributed to --attention-chunk (review finding: an HBM OOM or
+    optimizer error would otherwise point the user at the wrong
+    knob)."""
+    from aws_global_accelerator_controller_tpu.cmd import compute
+
+    real_build = compute._build_model
+
+    def build(args):
+        model, run_step, run_plan_fwd = real_build(args)
+
+        def broken_step(params, opt_state, key):
+            raise ValueError("optimizer state mismatch")
+        return model, broken_step, run_plan_fwd
+
+    monkeypatch.setattr(compute, "_build_model", build)
+    with pytest.raises(ValueError, match="optimizer state mismatch"):
+        main(["train", "--model", "temporal", "--steps", "2",
+              "--groups", "2", "--endpoints", "4", "--window", "16",
+              "--hidden", "16", "--supervision", "sequence",
+              "--attention-chunk", "32"])
+
+
 def test_attention_chunk_rejected_for_non_temporal_families():
     with pytest.raises(SystemExit) as exc:
         main(["train", "--model", "mlp", "--steps", "1",

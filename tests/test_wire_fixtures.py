@@ -399,7 +399,26 @@ def test_retry_after_header_parsed_and_capped():
     # parked for minutes
     assert (RestClient._retry_after_s(hdr("86400"))
             == RestClient._RATE_LIMIT_MAX_WAIT_S)
-    # absent or malformed (HTTP-date form unsupported): 1s floor
+    # absent or malformed: 1s floor
     assert RestClient._retry_after_s(hdr(None)) == 1.0
     assert RestClient._retry_after_s(hdr("Tue, 29 Jul")) == 1.0
     assert RestClient._retry_after_s(hdr("-5")) == 0.0
+    # RFC 7231 HTTP-date form (a proxy may rewrite the apiserver's
+    # integer seconds): parsed relative to now, capped, floored at 0
+    import datetime
+    import email.utils
+
+    future = email.utils.format_datetime(
+        datetime.datetime.now(datetime.timezone.utc)
+        + datetime.timedelta(seconds=5))
+    got = RestClient._retry_after_s(hdr(future))
+    assert 3.0 < got <= 5.0
+    past = email.utils.format_datetime(
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(seconds=30))
+    assert RestClient._retry_after_s(hdr(past)) == 0.0
+    far = email.utils.format_datetime(
+        datetime.datetime.now(datetime.timezone.utc)
+        + datetime.timedelta(days=2))
+    assert (RestClient._retry_after_s(hdr(far))
+            == RestClient._RATE_LIMIT_MAX_WAIT_S)
