@@ -129,6 +129,33 @@ class AWSProvider:
         # arn -> (tags, cached_at): spares the N+1 ListTags inside full
         # scans; all tag writes in this provider invalidate write-through
         self._tags_cache: dict = {}
+        # Fleet index: a COMPLETE map of every derivable target key ->
+        # arns as of the last full scan, kept complete in place by our
+        # own creates (_prime_discovery_cache).  While fresh (TTL) it
+        # answers definitely-absent in O(1) — previously every first
+        # ensure of a new resource paid a full O(fleet) scan, the
+        # dominant term of the reconcile hot path (and O(fleet) real
+        # AWS calls per new Service in production).  Positive hits are
+        # verified against the API exactly like discovery-cache hits;
+        # only the NEGATIVE answer trusts the index.  Staleness
+        # contract: leader election makes this controller the single
+        # writer of its tagged accelerators, so the only unseen
+        # mutation is an out-of-band actor tagging/creating one — it
+        # is adopted at most discovery_cache_ttl later, the same drift
+        # window the per-key TTL cache already accepts (and the resync
+        # backstop's cadence).  _fleet_epoch fences scans against
+        # concurrent invalidations; _prime_epoch fences them against
+        # concurrent creates (a scan must not install a snapshot that
+        # misses either).
+        self._fleet_index: dict = {}
+        self._fleet_at = None
+        self._fleet_epoch = 0
+        self._prime_epoch = 0
+
+    # A/B + escape hatch: class-level so a deployment (or the perf
+    # harness) can disable the O(1)-negative path and fall back to
+    # always-scan without touching call sites
+    FLEET_INDEX_ENABLED = True
 
     # ------------------------------------------------------------------
     # ELB
@@ -209,6 +236,53 @@ class AWSProvider:
                 fresh_scan = True
             with self._cache_lock:
                 self._discovery_cache.pop(key, None)
+                if fresh_scan:
+                    # the per-key entry lied (out-of-band retag or
+                    # delete): the fleet index may carry the same lie
+                    self._invalidate_fleet_locked()
+
+        # Fleet-index fast path: while the index is fresh, a key with
+        # no entry is DEFINITELY absent (O(1) — previously a full
+        # O(fleet) scan per first ensure of every new resource), and a
+        # key with entries is verified against the API exactly like a
+        # discovery-cache hit.  See __init__ for the staleness
+        # contract; any verification failure invalidates the index and
+        # falls through to a fresh full scan.
+        if self.FLEET_INDEX_ENABLED and not fresh_scan:
+            with self._cache_lock:
+                fleet_fresh = (
+                    self._fleet_at is not None
+                    and time.monotonic() - self._fleet_at
+                    < self.discovery_cache_ttl)
+                arns = (self._fleet_index.get(key, ())
+                        if fleet_fresh else None)
+            if arns is not None:
+                confirmed: "list | None" = []
+                for arn in arns:
+                    try:
+                        accelerator = self.apis.ga.describe_accelerator(
+                            arn)
+                        tags = self.apis.ga.list_tags_for_resource(arn)
+                    except AWSAPIError:
+                        confirmed = None     # deleted out-of-band
+                        break
+                    self._store_tags(arn, tags, gen)
+                    if tags_contains_all_values(tags, target):
+                        confirmed.append(accelerator)
+                    else:
+                        confirmed = None     # re-tagged out-of-band
+                        break
+                if confirmed is None:
+                    with self._cache_lock:
+                        self._invalidate_fleet_locked()
+                    fresh_scan = True        # index lied: scan fresh
+                else:
+                    if len(confirmed) == 1:
+                        with self._cache_lock:
+                            self._discovery_cache[key] = (
+                                confirmed[0].accelerator_arn,
+                                time.monotonic())
+                    return confirmed
 
         # ONE lock acquisition + clock read for the whole O(fleet)
         # scan: per-arn _tags_for calls dominated the reconcile hot
@@ -216,11 +290,14 @@ class AWSProvider:
         with self._cache_lock:
             now = time.monotonic()
             gen = self._cache_gen
+            fleet_epoch = self._fleet_epoch
+            prime_epoch = self._prime_epoch
             cached = ({} if fresh_scan else
                       {arn: tags for arn, (tags, at)
                        in self._tags_cache.items()
                        if now - at < self.discovery_cache_ttl})
         result = []
+        new_index: dict = {}
         for accelerator in self.apis.ga.list_accelerators():
             arn = accelerator.accelerator_arn
             if arn in verified_tags:  # just fetched during verify
@@ -230,10 +307,20 @@ class AWSProvider:
                 if tags is None:
                     tags = self.apis.ga.list_tags_for_resource(arn)
                     self._store_tags(arn, tags, gen)
+            for derived in self._derived_keys(tags):
+                new_index.setdefault(derived, []).append(arn)
             if tags_contains_all_values(tags, target):
                 result.append(accelerator)
         with self._cache_lock:
             gen_moved = self._cache_gen != gen
+            if (self.FLEET_INDEX_ENABLED and not gen_moved
+                    and self._fleet_epoch == fleet_epoch
+                    and self._prime_epoch == prime_epoch):
+                # nothing was invalidated or created mid-scan: this
+                # snapshot is the complete fleet — install it
+                self._fleet_index = {k: tuple(v)
+                                     for k, v in new_index.items()}
+                self._fleet_at = time.monotonic()
         if gen_moved and result:
             # an invalidation landed mid-scan (concurrent delete or
             # re-tag): the snapshot may have matched stale tags.  The
@@ -258,13 +345,50 @@ class AWSProvider:
                                               time.monotonic())
         return result
 
+    @staticmethod
+    def _derived_keys(tags):
+        """The exact target keys ``_owner_target``/``_hostname_target``
+        would build for an accelerator carrying these tags — what the
+        fleet index stores, so lookups hit byte-for-byte."""
+        managed = tags.get(MANAGED_TAG_KEY)
+        cluster = tags.get(CLUSTER_TAG_KEY)
+        if managed is None or cluster is None:
+            return
+        owner = tags.get(OWNER_TAG_KEY)
+        if owner is not None:
+            yield frozenset({(MANAGED_TAG_KEY, managed),
+                             (OWNER_TAG_KEY, owner),
+                             (CLUSTER_TAG_KEY, cluster)})
+        hostname = tags.get(TARGET_HOSTNAME_TAG_KEY)
+        if hostname is not None:
+            yield frozenset({(MANAGED_TAG_KEY, managed),
+                             (TARGET_HOSTNAME_TAG_KEY, hostname),
+                             (CLUSTER_TAG_KEY, cluster)})
+
+    def _invalidate_fleet_locked(self) -> None:
+        """The fleet index can no longer claim completeness (a delete,
+        re-tag, or verify-failure happened); the epoch bump also stops
+        any in-flight scan from installing its now-partial snapshot.
+        Caller holds ``_cache_lock``."""
+        self._fleet_at = None
+        self._fleet_epoch += 1
+
     def _prime_discovery_cache(self, arn: str, *targets: dict) -> None:
         """Record a just-created accelerator so the next syncs skip the
-        full tag scan (they still verify the entry by direct describe)."""
+        full tag scan (they still verify the entry by direct describe).
+        Also inserted into the fleet index, which KEEPS the index
+        complete across our own creates — the epoch bump only stops a
+        concurrent scan from installing a snapshot that predates this
+        accelerator."""
         now = time.monotonic()
         with self._cache_lock:
             for target in targets:
-                self._discovery_cache[frozenset(target.items())] = (arn, now)
+                tkey = frozenset(target.items())
+                self._discovery_cache[tkey] = (arn, now)
+                have = self._fleet_index.get(tkey, ())
+                if arn not in have:
+                    self._fleet_index[tkey] = have + (arn,)
+            self._prime_epoch += 1
 
     def _invalidate_discovery_cache(self, arn: str) -> None:
         with self._cache_lock:
