@@ -15,7 +15,7 @@ from typing import Dict
 
 from .api import AWSAPIs
 from .fake import FakeAWSCloud
-from .provider import AWSProvider
+from .provider import AWSProvider, FleetDiscoveryState
 
 # Global Accelerator is a global service homed in us-west-2
 # (reference pkg/cloudprovider/aws/aws.go:26-28).
@@ -33,6 +33,12 @@ class CloudFactory:
         self._poll_interval = delete_poll_interval
         self._poll_timeout = delete_poll_timeout
         self._not_found_retry = accelerator_not_found_retry
+        # ONE discovery state across every region: Global Accelerator
+        # is a global service, so all this factory's providers observe
+        # the same fleet — a create through any of them must be visible
+        # to the others' discovery immediately, not after a TTL
+        # (provider.FleetDiscoveryState docstring)
+        self._discovery_state = FleetDiscoveryState()
 
     def provider_for(self, region: str) -> AWSProvider:
         with self._lock:
@@ -42,7 +48,8 @@ class CloudFactory:
                     self._make_apis(region),
                     delete_poll_interval=self._poll_interval,
                     delete_poll_timeout=self._poll_timeout,
-                    accelerator_not_found_retry=self._not_found_retry)
+                    accelerator_not_found_retry=self._not_found_retry,
+                    discovery_state=self._discovery_state)
                 self._providers[region] = provider
             return provider
 

@@ -35,7 +35,9 @@ from ...errors import (
 )
 from ...kube.objects import Ingress, LoadBalancerIngress, Service
 
+from ...metrics import record_coalesced_read, record_fleet_scan
 from .api import AWSAPIs
+from .singleflight import Singleflight
 from .helpers import (
     CLUSTER_TAG_KEY,
     MANAGED_TAG_KEY,
@@ -101,6 +103,71 @@ TXT_RECORD_TTL = 300                # route53.go:276
 DISCOVERY_CACHE_TTL = 30.0
 
 
+class FleetDiscoveryState:
+    """Ownership-discovery caches for ONE logical accelerator fleet.
+
+    Global Accelerator is a global service (the reference homes every
+    GA call in us-west-2, aws.go:26-28), so every regional provider a
+    factory hands out observes the SAME fleet — and the factory shares
+    ONE of these across all of them.  Per-provider copies of this state
+    broke the single-writer contract inside a single process: a create
+    through the ap-northeast-1 provider was "out of band" to the
+    us-west-2 provider, whose fresh-but-empty fleet index then reported
+    the new accelerator definitely-absent for a full TTL.
+
+    ``lock`` guards every read-modify below; ``gen`` is a single global
+    generation counter bumped by every invalidation, so an in-flight
+    ListTags started before ANY invalidation cannot re-insert
+    pre-invalidation tags afterwards (conservative -- unrelated
+    invalidations just skip an insert -- and O(1) memory where a
+    per-ARN counter would grow with accelerator churn).
+
+    The fleet index is a COMPLETE map of every derivable target key ->
+    arns as of the last full scan, kept complete in place by our own
+    creates (_prime_discovery_cache).  While fresh (TTL) it answers
+    definitely-absent in O(1) — previously every first ensure of a new
+    resource paid a full O(fleet) scan, the dominant term of the
+    reconcile hot path (and O(fleet) real AWS calls per new Service in
+    production).  Positive hits are verified against the API exactly
+    like discovery-cache hits; only the NEGATIVE answer trusts the
+    index.  Staleness contract: leader election makes this controller
+    the single writer of its tagged accelerators, so the only unseen
+    mutation is an out-of-band actor tagging/creating one — it is
+    adopted at most discovery_cache_ttl later, the same drift window
+    the per-key TTL cache already accepts (and the resync backstop's
+    cadence).  ``fleet_epoch`` fences scans against concurrent
+    invalidations; creates that land DURING a scan are logged in
+    ``prime_log`` and merged into the installing snapshot, so the index
+    stays installable (and the O(1) definitely-absent answer stays
+    available) even under a sustained creation storm -- previously
+    every create fenced out the in-flight scan and a storm degenerated
+    to one full O(fleet) scan per new resource.
+
+    ``reads`` coalesces identical in-flight reads: N workers sharing a
+    provider frequently need the SAME read at the same moment (the
+    verify pair of a hot discovery key, or the full fleet sweep right
+    after an invalidation).  Keys carry ``gen``, so a read begun before
+    an invalidation is never joined by a caller arriving after it --
+    the single-writer staleness contract above is unchanged.
+    """
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.gen = 0
+        # frozenset(target tag items) -> (arn, cached_at monotonic)
+        self.discovery: dict = {}
+        # arn -> (tags, cached_at): spares the N+1 ListTags inside full
+        # scans; all tag writes in the provider invalidate write-through
+        self.tags: dict = {}
+        self.fleet_index: dict = {}
+        self.fleet_at = None
+        self.fleet_epoch = 0
+        self.scans_inflight = 0
+        self.prime_log: list = []  # (target key, arn) primed mid-scan
+        self.reads = Singleflight(
+            on_coalesce=lambda key: record_coalesced_read(key[0]))
+
+
 class AWSProvider:
     """Per-region provider over the three AWS service APIs."""
 
@@ -108,49 +175,16 @@ class AWSProvider:
                  delete_poll_interval: float = DELETE_POLL_INTERVAL,
                  delete_poll_timeout: float = DELETE_POLL_TIMEOUT,
                  accelerator_not_found_retry: float = ACCELERATOR_NOT_FOUND_RETRY,
-                 discovery_cache_ttl: float = DISCOVERY_CACHE_TTL):
+                 discovery_cache_ttl: float = DISCOVERY_CACHE_TTL,
+                 discovery_state: "FleetDiscoveryState | None" = None):
         self.apis = apis
         self.delete_poll_interval = delete_poll_interval
         self.delete_poll_timeout = delete_poll_timeout
         self.accelerator_not_found_retry = accelerator_not_found_retry
         self.discovery_cache_ttl = discovery_cache_ttl
-        # Caches shared by the worker threads that share this provider
-        # (factory caches one provider per region).  _cache_lock guards
-        # every read-modify below; _cache_gen is a single global
-        # generation counter bumped by every invalidation, so an
-        # in-flight ListTags started before ANY invalidation cannot
-        # re-insert pre-invalidation tags afterwards (conservative --
-        # unrelated invalidations just skip an insert -- and O(1) memory
-        # where a per-ARN counter would grow with accelerator churn).
-        self._cache_lock = threading.Lock()
-        self._cache_gen = 0
-        # frozenset(target tag items) -> (arn, cached_at monotonic)
-        self._discovery_cache: dict = {}
-        # arn -> (tags, cached_at): spares the N+1 ListTags inside full
-        # scans; all tag writes in this provider invalidate write-through
-        self._tags_cache: dict = {}
-        # Fleet index: a COMPLETE map of every derivable target key ->
-        # arns as of the last full scan, kept complete in place by our
-        # own creates (_prime_discovery_cache).  While fresh (TTL) it
-        # answers definitely-absent in O(1) — previously every first
-        # ensure of a new resource paid a full O(fleet) scan, the
-        # dominant term of the reconcile hot path (and O(fleet) real
-        # AWS calls per new Service in production).  Positive hits are
-        # verified against the API exactly like discovery-cache hits;
-        # only the NEGATIVE answer trusts the index.  Staleness
-        # contract: leader election makes this controller the single
-        # writer of its tagged accelerators, so the only unseen
-        # mutation is an out-of-band actor tagging/creating one — it
-        # is adopted at most discovery_cache_ttl later, the same drift
-        # window the per-key TTL cache already accepts (and the resync
-        # backstop's cadence).  _fleet_epoch fences scans against
-        # concurrent invalidations; _prime_epoch fences them against
-        # concurrent creates (a scan must not install a snapshot that
-        # misses either).
-        self._fleet_index: dict = {}
-        self._fleet_at = None
-        self._fleet_epoch = 0
-        self._prime_epoch = 0
+        # the factory passes its one shared state (GA is global); a
+        # bare provider gets a private fleet view
+        self._s = discovery_state or FleetDiscoveryState()
 
     # A/B + escape hatch: class-level so a deployment (or the perf
     # harness) can disable the O(1)-negative path and fall back to
@@ -203,27 +237,42 @@ class AWSProvider:
         return self._list_by_tags(
             self._owner_target(cluster_name, resource, ns, name))
 
+    def _verified_read(self, arn: str):
+        """The verify pair (DescribeAccelerator + ListTags) for one ARN,
+        coalesced across workers: the hottest identical read the shared
+        provider sees (every steady-state sync of every resource bound
+        to ``arn`` issues exactly this pair).  Keyed by _cache_gen so a
+        caller arriving after an invalidation never shares a
+        pre-invalidation read.  Raises AWSAPIError like the direct
+        calls; the fresh tags are written through (gen-fenced)."""
+        with self._s.lock:
+            gen = self._s.gen
+
+        def read():
+            accelerator = self.apis.ga.describe_accelerator(arn)
+            tags = self.apis.ga.list_tags_for_resource(arn)
+            return accelerator, tags
+
+        accelerator, tags = self._s.reads.do(("verify", arn, gen), read)
+        # write the fresh tags through so a failed match's fallback
+        # scan can't re-match stale tags
+        self._store_tags(arn, tags, gen)
+        return accelerator, tags
+
     def _list_by_tags(self, target) -> List[Accelerator]:
         key = frozenset(target.items())
         fresh_scan = False
-        verified_tags = {}  # arn -> tags fetched during verify, reusable
-        with self._cache_lock:
-            hit = self._discovery_cache.get(key)
-            gen = self._cache_gen
+        with self._s.lock:
+            hit = self._s.discovery.get(key)
         if hit is not None:
             arn, cached_at = hit
             if time.monotonic() - cached_at < self.discovery_cache_ttl:
                 try:
-                    accelerator = self.apis.ga.describe_accelerator(arn)
-                    tags = self.apis.ga.list_tags_for_resource(arn)
-                    # write the fresh tags through so a failed match's
-                    # fallback scan below can't re-match stale tags
-                    self._store_tags(arn, tags, gen)
+                    accelerator, tags = self._verified_read(arn)
                     if tags_contains_all_values(tags, target):
                         return [accelerator]
-                    verified_tags[arn] = tags
                 except AWSAPIError:
-                    with self._cache_lock:  # deleted out-of-band
+                    with self._s.lock:  # deleted out-of-band
                         self._drop_tags_locked(arn)
                 # the cached entry lied: tags moved out from under us.
                 # The rescue scan must not consult the tags cache
@@ -234,8 +283,8 @@ class AWSProvider:
                 # contradicted the cache, so the normal single-TTL
                 # drift window applies.
                 fresh_scan = True
-            with self._cache_lock:
-                self._discovery_cache.pop(key, None)
+            with self._s.lock:
+                self._s.discovery.pop(key, None)
                 if fresh_scan:
                     # the per-key entry lied (out-of-band retag or
                     # delete): the fleet index may carry the same lie
@@ -249,78 +298,43 @@ class AWSProvider:
         # contract; any verification failure invalidates the index and
         # falls through to a fresh full scan.
         if self.FLEET_INDEX_ENABLED and not fresh_scan:
-            with self._cache_lock:
+            with self._s.lock:
                 fleet_fresh = (
-                    self._fleet_at is not None
-                    and time.monotonic() - self._fleet_at
+                    self._s.fleet_at is not None
+                    and time.monotonic() - self._s.fleet_at
                     < self.discovery_cache_ttl)
-                arns = (self._fleet_index.get(key, ())
+                arns = (self._s.fleet_index.get(key, ())
                         if fleet_fresh else None)
             if arns is not None:
                 confirmed: "list | None" = []
                 for arn in arns:
                     try:
-                        accelerator = self.apis.ga.describe_accelerator(
-                            arn)
-                        tags = self.apis.ga.list_tags_for_resource(arn)
+                        accelerator, tags = self._verified_read(arn)
                     except AWSAPIError:
                         confirmed = None     # deleted out-of-band
                         break
-                    self._store_tags(arn, tags, gen)
                     if tags_contains_all_values(tags, target):
                         confirmed.append(accelerator)
                     else:
                         confirmed = None     # re-tagged out-of-band
                         break
                 if confirmed is None:
-                    with self._cache_lock:
+                    with self._s.lock:
                         self._invalidate_fleet_locked()
                     fresh_scan = True        # index lied: scan fresh
                 else:
                     if len(confirmed) == 1:
-                        with self._cache_lock:
-                            self._discovery_cache[key] = (
+                        with self._s.lock:
+                            self._s.discovery[key] = (
                                 confirmed[0].accelerator_arn,
                                 time.monotonic())
                     return confirmed
 
-        # ONE lock acquisition + clock read for the whole O(fleet)
-        # scan: per-arn _tags_for calls dominated the reconcile hot
-        # path (a lock + monotonic() per accelerator per sync)
-        with self._cache_lock:
-            now = time.monotonic()
-            gen = self._cache_gen
-            fleet_epoch = self._fleet_epoch
-            prime_epoch = self._prime_epoch
-            cached = ({} if fresh_scan else
-                      {arn: tags for arn, (tags, at)
-                       in self._tags_cache.items()
-                       if now - at < self.discovery_cache_ttl})
-        result = []
-        new_index: dict = {}
-        for accelerator in self.apis.ga.list_accelerators():
-            arn = accelerator.accelerator_arn
-            if arn in verified_tags:  # just fetched during verify
-                tags = verified_tags[arn]
-            else:
-                tags = cached.get(arn)
-                if tags is None:
-                    tags = self.apis.ga.list_tags_for_resource(arn)
-                    self._store_tags(arn, tags, gen)
-            for derived in self._derived_keys(tags):
-                new_index.setdefault(derived, []).append(arn)
-            if tags_contains_all_values(tags, target):
-                result.append(accelerator)
-        with self._cache_lock:
-            gen_moved = self._cache_gen != gen
-            if (self.FLEET_INDEX_ENABLED and not gen_moved
-                    and self._fleet_epoch == fleet_epoch
-                    and self._prime_epoch == prime_epoch):
-                # nothing was invalidated or created mid-scan: this
-                # snapshot is the complete fleet — install it
-                self._fleet_index = {k: tuple(v)
-                                     for k, v in new_index.items()}
-                self._fleet_at = time.monotonic()
+        fleet, scan_gen = self._scan_fleet(fresh_scan)
+        result = [accelerator for accelerator, tags in fleet
+                  if tags_contains_all_values(tags, target)]
+        with self._s.lock:
+            gen_moved = self._s.gen != scan_gen
         if gen_moved and result:
             # an invalidation landed mid-scan (concurrent delete or
             # re-tag): the snapshot may have matched stale tags.  The
@@ -340,10 +354,75 @@ class AWSProvider:
                     confirmed.append(accelerator)
             result = confirmed
         if len(result) == 1:
-            with self._cache_lock:
-                self._discovery_cache[key] = (result[0].accelerator_arn,
+            with self._s.lock:
+                self._s.discovery[key] = (result[0].accelerator_arn,
                                               time.monotonic())
         return result
+
+    def _scan_fleet(self, fresh: bool):
+        """One full ListAccelerators + per-ARN tags sweep, singleflighted:
+        the sweep is target-independent, so N workers scanning for N
+        different resources at the same moment (the post-invalidation
+        thundering herd) share ONE upstream sweep and filter locally.
+        Returns ``(fleet, scan_gen)`` where fleet is
+        ``[(accelerator, tags), ...]`` and scan_gen is the cache
+        generation the sweep ran under (callers re-verify their matches
+        when it moved mid-scan).  ``fresh`` bypasses the tags cache
+        (the rescue-scan discipline above) and only coalesces with
+        other fresh sweeps of the same generation."""
+        with self._s.lock:
+            gen = self._s.gen
+        mode = "scan-fresh" if fresh else "scan"
+        return self._s.reads.do((mode, gen),
+                              lambda: self._scan_fleet_once(fresh, gen))
+
+    def _scan_fleet_once(self, fresh: bool, gen: int):
+        record_fleet_scan()
+        # ONE lock acquisition + clock read for the whole O(fleet)
+        # scan: per-arn _tags_for calls dominated the reconcile hot
+        # path (a lock + monotonic() per accelerator per sync)
+        with self._s.lock:
+            now = time.monotonic()
+            fleet_epoch = self._s.fleet_epoch
+            prime_mark = len(self._s.prime_log)
+            self._s.scans_inflight += 1
+            cached = ({} if fresh else
+                      {arn: tags for arn, (tags, at)
+                       in self._s.tags.items()
+                       if now - at < self.discovery_cache_ttl})
+        try:
+            fleet = []
+            new_index: dict = {}
+            for accelerator in self.apis.ga.list_accelerators():
+                arn = accelerator.accelerator_arn
+                tags = cached.get(arn)
+                if tags is None:
+                    tags = self.apis.ga.list_tags_for_resource(arn)
+                    self._store_tags(arn, tags, gen)
+                for derived in self._derived_keys(tags):
+                    new_index.setdefault(derived, []).append(arn)
+                fleet.append((accelerator, tags))
+            with self._s.lock:
+                if (self.FLEET_INDEX_ENABLED and self._s.gen == gen
+                        and self._s.fleet_epoch == fleet_epoch):
+                    # no invalidation landed mid-scan; our own creates
+                    # that did land are in the prime log — merge them
+                    # so the installed snapshot is still the complete
+                    # fleet (out-of-band creates stay on the TTL drift
+                    # contract, as ever)
+                    for tkey, arn in self._s.prime_log[prime_mark:]:
+                        have = new_index.setdefault(tkey, [])
+                        if arn not in have:
+                            have.append(arn)
+                    self._s.fleet_index = {k: tuple(v)
+                                         for k, v in new_index.items()}
+                    self._s.fleet_at = time.monotonic()
+            return fleet, gen
+        finally:
+            with self._s.lock:
+                self._s.scans_inflight -= 1
+                if self._s.scans_inflight == 0:
+                    del self._s.prime_log[:]
 
     @staticmethod
     def _derived_keys(tags):
@@ -370,44 +449,46 @@ class AWSProvider:
         re-tag, or verify-failure happened); the epoch bump also stops
         any in-flight scan from installing its now-partial snapshot.
         Caller holds ``_cache_lock``."""
-        self._fleet_at = None
-        self._fleet_epoch += 1
+        self._s.fleet_at = None
+        self._s.fleet_epoch += 1
 
     def _prime_discovery_cache(self, arn: str, *targets: dict) -> None:
         """Record a just-created accelerator so the next syncs skip the
         full tag scan (they still verify the entry by direct describe).
         Also inserted into the fleet index, which KEEPS the index
-        complete across our own creates — the epoch bump only stops a
-        concurrent scan from installing a snapshot that predates this
-        accelerator."""
+        complete across our own creates; while a scan is in flight the
+        prime is additionally logged so the scan can merge it into the
+        snapshot it installs (a snapshot listed before this create
+        would otherwise report the new keys definitely-absent)."""
         now = time.monotonic()
-        with self._cache_lock:
+        with self._s.lock:
             for target in targets:
                 tkey = frozenset(target.items())
-                self._discovery_cache[tkey] = (arn, now)
-                have = self._fleet_index.get(tkey, ())
+                self._s.discovery[tkey] = (arn, now)
+                have = self._s.fleet_index.get(tkey, ())
                 if arn not in have:
-                    self._fleet_index[tkey] = have + (arn,)
-            self._prime_epoch += 1
+                    self._s.fleet_index[tkey] = have + (arn,)
+                if self._s.scans_inflight:
+                    self._s.prime_log.append((tkey, arn))
 
     def _invalidate_discovery_cache(self, arn: str) -> None:
-        with self._cache_lock:
-            stale = [k for k, (a, _) in self._discovery_cache.items()
+        with self._s.lock:
+            stale = [k for k, (a, _) in self._s.discovery.items()
                      if a == arn]
             for key in stale:
-                self._discovery_cache.pop(key, None)
+                self._s.discovery.pop(key, None)
             self._drop_tags_locked(arn)
 
     def _drop_tags_locked(self, arn: str) -> None:
         """Invalidate cached tags; bumping the generation fences out any
         in-flight ListTags read started before this point."""
-        self._tags_cache.pop(arn, None)
-        self._cache_gen += 1
+        self._s.tags.pop(arn, None)
+        self._s.gen += 1
 
     def _store_tags(self, arn: str, tags, gen: int) -> None:
-        with self._cache_lock:
-            if self._cache_gen == gen:
-                self._tags_cache[arn] = (tags, time.monotonic())
+        with self._s.lock:
+            if self._s.gen == gen:
+                self._s.tags[arn] = (tags, time.monotonic())
 
     # ------------------------------------------------------------------
     # Ensure (create-or-update) for Service / Ingress
@@ -659,8 +740,13 @@ class AWSProvider:
                     ip_address_type)
         accelerator = self.apis.ga.create_accelerator(
             name=name, ip_address_type=addr_type, enabled=True, tags=tags)
-        with self._cache_lock:
-            self._drop_tags_locked(accelerator.accelerator_arn)
+        # No generation bump here (unlike every other tag write): the
+        # ARN is brand new, so no in-flight read of it can exist to
+        # fence out — and a bump would needlessly stop every concurrent
+        # fleet scan from installing its snapshot, re-creating the
+        # one-scan-per-create storm the prime log exists to end.
+        with self._s.lock:
+            self._s.tags.pop(accelerator.accelerator_arn, None)
         logger.info("Global Accelerator created: %s",
                     accelerator.accelerator_arn)
         return accelerator
@@ -677,8 +763,15 @@ class AWSProvider:
         }
         tags.update(specified_tags)
         self.apis.ga.tag_resource(arn, tags)
-        with self._cache_lock:
+        with self._s.lock:
             self._drop_tags_locked(arn)
+            # the re-tag may have MOVED this accelerator to new
+            # owner/hostname discovery keys the fleet index has never
+            # seen; a still-fresh index would report those keys
+            # definitely-absent for up to TTL (ADVICE r5) — it can no
+            # longer claim completeness, so invalidate it here, inside
+            # the same critical section as the tag drop
+            self._invalidate_fleet_locked()
         return updated
 
     def get_listener(self, accelerator_arn: str) -> Listener:

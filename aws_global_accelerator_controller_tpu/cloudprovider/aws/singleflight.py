@@ -1,0 +1,73 @@
+"""Singleflight: duplicate-suppression for identical in-flight reads.
+
+The analogue of golang.org/x/sync/singleflight, which client-go-adjacent
+controllers use to stop N workers sharing one client from issuing N
+identical expensive reads at once.  The first caller of a key becomes
+the leader and runs the function; callers arriving while it is in
+flight block and share the leader's result (or its exception).  Nothing
+is cached: the moment the leader finishes, the key is forgotten and the
+next caller runs fresh -- staleness policy stays entirely with the
+caller (the provider keys its reads by cache generation, so a read
+begun before an invalidation is never joined by a caller that starts
+after it; see provider.py).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Hashable, Optional
+
+
+class _Call:
+    __slots__ = ("done", "result", "exc")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.result = None
+        self.exc: Optional[BaseException] = None
+
+
+class Singleflight:
+    """``do(key, fn)`` runs ``fn`` once per key at a time; concurrent
+    callers of the same key share the one result.
+
+    ``on_coalesce(key)`` (optional) fires for every caller that joined
+    an in-flight call instead of running its own -- the metrics hook.
+    """
+
+    def __init__(self,
+                 on_coalesce: Optional[Callable[[Hashable], None]] = None):
+        self._lock = threading.Lock()
+        self._calls: Dict[Hashable, _Call] = {}
+        self._on_coalesce = on_coalesce
+
+    def do(self, key: Hashable, fn: Callable[[], object]):
+        with self._lock:
+            call = self._calls.get(key)
+            if call is None:
+                call = _Call()
+                self._calls[key] = call
+                leader = True
+            else:
+                leader = False
+
+        if not leader:
+            if self._on_coalesce is not None:
+                self._on_coalesce(key)
+            call.done.wait()
+            if call.exc is not None:
+                raise call.exc
+            return call.result
+
+        try:
+            call.result = fn()
+        except BaseException as e:
+            call.exc = e
+            raise
+        finally:
+            # forget BEFORE waking waiters: a caller arriving after the
+            # result exists must run fresh (no result caching), while
+            # everyone already parked on this call still shares it
+            with self._lock:
+                self._calls.pop(key, None)
+            call.done.set()
+        return call.result

@@ -667,10 +667,14 @@ def _run_train_loop(args, jax, stop) -> int:
             except (SystemExit, KeyboardInterrupt):
                 raise
             except Exception as e:
+                # Mosaic/Pallas-specific signatures ONLY: a plain HBM
+                # RESOURCE_EXHAUSTED (model simply too big for the
+                # chip) must surface as itself, not be misattributed
+                # to --attention-chunk (r5 ADVICE low) — so no bare
+                # "resource_exhausted"/"scoped" matches here
                 compile_like = any(
                     sig in str(e).lower() for sig in
-                    ("mosaic", "vmem", "pallas", "resource_exhausted",
-                     "scoped"))
+                    ("mosaic", "vmem", "pallas"))
                 if (batch_idx == start_step and compile_like
                         and getattr(args, "attention_chunk", 0)):
                     # first step = compile.  --attention-chunk 32

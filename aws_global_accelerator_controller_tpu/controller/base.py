@@ -11,7 +11,11 @@ import logging
 import threading
 from typing import Callable, List
 
-from ..apis import AWS_LOAD_BALANCER_TYPE_ANNOTATION, INGRESS_CLASS_ANNOTATION
+from ..apis import (
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    INGRESS_CLASS_ANNOTATION,
+    ROUTE53_HOSTNAME_ANNOTATION,
+)
 from ..kube.objects import Ingress, KubeObject, Service
 from ..kube.workqueue import RateLimitingQueue
 from ..reconcile import process_next_work_item
@@ -19,6 +23,29 @@ from ..reconcile import process_next_work_item
 logger = logging.getLogger(__name__)
 
 WORKER_POLL = 0.2  # get() timeout so workers observe the stop event
+
+# Shared informer indexes (kube/informers.py Indexer).  Registered by
+# the controllers that consume them; names are shared so two
+# controllers indexing the same informer the same way reuse one index.
+LB_DNS_INDEX = "lb-dns"
+ROUTE53_HOSTNAME_INDEX = "route53-hostname"
+
+
+def index_by_lb_dns(obj) -> List[str]:
+    """Service/Ingress -> the LB DNS names in its status: the key both
+    the GA and Route53 paths reason about (one accelerator per LB
+    hostname), so 'who else claims this LB' is an O(1) bucket read."""
+    return [i.hostname for i in obj.status.load_balancer.ingress
+            if i.hostname]
+
+
+def index_by_route53_hostname(obj) -> List[str]:
+    """Service/Ingress -> the hostnames its route53-hostname annotation
+    claims (comma-separated, route53/service.go:71)."""
+    value = obj.annotations.get(ROUTE53_HOSTNAME_ANNOTATION)
+    if not value:
+        return []
+    return [h for h in value.split(",") if h]
 
 
 def was_load_balancer_service(svc: Service) -> bool:

@@ -45,6 +45,33 @@ FINALIZER = "operator.h3poteto.dev/endpointgroupbindings"
 
 DELETE_REQUEUE = 1.0  # reconcile.go:96
 
+# Binding-informer indexes: spec.endpointGroupArn (one binding per
+# endpoint group is the supported shape — siblings sharing an ARN would
+# clobber each other's read-modify-write weight updates), and the
+# serviceRef/ingressRef back-references that let a Service/Ingress
+# event requeue exactly the bindings that resolve through it in O(1)
+# instead of waiting for the 30s resync (or scanning every binding).
+BINDING_ARN_INDEX = "binding-arn"
+BINDING_SERVICE_REF_INDEX = "binding-service-ref"
+BINDING_INGRESS_REF_INDEX = "binding-ingress-ref"
+
+
+def index_binding_by_arn(obj) -> "list[str]":
+    arn = obj.spec.endpoint_group_arn
+    return [arn] if arn else []
+
+
+def index_binding_by_service_ref(obj) -> "list[str]":
+    if obj.spec.service_ref is None or not obj.spec.service_ref.name:
+        return []
+    return [f"{obj.metadata.namespace}/{obj.spec.service_ref.name}"]
+
+
+def index_binding_by_ingress_ref(obj) -> "list[str]":
+    if obj.spec.ingress_ref is None or not obj.spec.ingress_ref.name:
+        return []
+    return [f"{obj.metadata.namespace}/{obj.spec.ingress_ref.name}"]
+
 
 @dataclass
 class EndpointGroupBindingConfig:
@@ -92,6 +119,23 @@ class EndpointGroupBindingController:
         self.binding_informer.add_event_handler(
             add=self._enqueue, update=self._update_notification,
             delete=None)
+        self.binding_informer.add_index(BINDING_ARN_INDEX,
+                                        index_binding_by_arn)
+        self.binding_informer.add_index(BINDING_SERVICE_REF_INDEX,
+                                        index_binding_by_service_ref)
+        self.binding_informer.add_index(BINDING_INGRESS_REF_INDEX,
+                                        index_binding_by_ingress_ref)
+        # Requeue bindings when the object their serviceRef/ingressRef
+        # resolves through changes (the LB hostname appearing in a
+        # Service's status is what unblocks a binding's first sync —
+        # previously that waited for the next resync).  The ref indexes
+        # make the reverse lookup O(1) per event.
+        self.service_informer.add_event_handler(
+            add=self._notify_referent(BINDING_SERVICE_REF_INDEX),
+            update=self._notify_referent_update(BINDING_SERVICE_REF_INDEX))
+        self.ingress_informer.add_event_handler(
+            add=self._notify_referent(BINDING_INGRESS_REF_INDEX),
+            update=self._notify_referent_update(BINDING_INGRESS_REF_INDEX))
 
     # -- event handlers (controller.go:85-98) ---------------------------
 
@@ -105,6 +149,23 @@ class EndpointGroupBindingController:
             logger.error("do not allow changing EndpointGroupArn field")
             return
         self._enqueue(new)
+
+    def _notify_referent(self, index: str):
+        def handler(obj) -> None:
+            for binding in self.binding_informer.by_index(index, obj.key()):
+                self.queue.add_rate_limited(binding.key())
+        return handler
+
+    def _notify_referent_update(self, index: str):
+        added = self._notify_referent(index)
+
+        def handler(old, new) -> None:
+            # resync redelivers (obj, obj); the binding informer's own
+            # resync already re-enqueues every binding, so only real
+            # changes fan out here
+            if old != new:
+                added(new)
+        return handler
 
     # -- run (controller.go:101-180) ------------------------------------
 
@@ -240,6 +301,18 @@ class EndpointGroupBindingController:
                           provider) -> Result:
         """Diff desired LB ARNs vs status.endpointIds and converge
         (reconcile.go:112-217)."""
+        siblings = [
+            b.key() for b in self.binding_informer.by_index(
+                BINDING_ARN_INDEX, obj.spec.endpoint_group_arn)
+            if b.key() != obj.key()]
+        if siblings:
+            # two bindings driving one endpoint group clobber each
+            # other's read-modify-write weight sync; surface it every
+            # sync so the operator sees which objects collide
+            logger.warning(
+                "EndpointGroupBinding %s shares endpoint group %s "
+                "with %s — their weight updates will fight",
+                obj.key(), obj.spec.endpoint_group_arn, siblings)
         hostnames = self._get_load_balancer_hostnames(obj)
 
         arns = {}  # lb arn -> lb name

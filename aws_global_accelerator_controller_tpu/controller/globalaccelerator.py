@@ -35,7 +35,9 @@ from ..kube.workqueue import (
 )
 from ..reconcile import Result
 from .base import (
+    LB_DNS_INDEX,
     annotation_presence_changed,
+    index_by_lb_dns,
     run_controller,
     spawn_workers,
     was_alb_ingress,
@@ -77,10 +79,12 @@ class GlobalAcceleratorController:
         self.service_informer.add_event_handler(
             add=self._add_service, update=self._update_service,
             delete=self._delete_service)
+        self.service_informer.add_index(LB_DNS_INDEX, index_by_lb_dns)
         self.ingress_informer = informer_factory.ingresses()
         self.ingress_informer.add_event_handler(
             add=self._add_ingress, update=self._update_ingress,
             delete=self._delete_ingress)
+        self.ingress_informer.add_index(LB_DNS_INDEX, index_by_lb_dns)
 
     # -- event handlers (controller.go:96-193) -------------------------
 
@@ -247,9 +251,32 @@ class GlobalAcceleratorController:
         for accelerator in accelerators:
             provider.cleanup_global_accelerator(accelerator.accelerator_arn)
 
+    def _warn_shared_lb(self, obj, hostname: str) -> None:
+        """Indexed duplicate-claim check: two managed objects whose
+        status carries the SAME LB hostname would each drive an
+        accelerator at that LB DNS, and the Route53 controller then
+        fails its sync with 'Too many Global Accelerators' forever.
+        The lb-dns index makes 'who else claims this LB' an O(1)
+        bucket read instead of a full lister scan per sync.  Both
+        watched kinds are checked: a Service and an Ingress contesting
+        one LB hostname collide just as hard as two Services."""
+        others = [
+            o.key()
+            for informer in (self.service_informer, self.ingress_informer)
+            for o in informer.by_index(LB_DNS_INDEX, hostname)
+            if (o.key() != obj.key() or type(o) is not type(obj))
+            and self._has_managed(o)]
+        if others:
+            logger.warning(
+                "%s %s shares LB hostname %s with %s — one accelerator "
+                "per LB DNS name is expected; Route53 sync for this "
+                "hostname will not converge", type(obj).__name__,
+                obj.key(), hostname, others)
+
     def _ensure_for_lb_ingress(self, obj, lb_ingress, ensure):
         """Provider dispatch per LB ingress entry; returns a Result to
         short-circuit (retry), or None to continue."""
+        self._warn_shared_lb(obj, lb_ingress.hostname)
         try:
             provider_name = cloudprovider.detect_cloud_provider(
                 lb_ingress.hostname)

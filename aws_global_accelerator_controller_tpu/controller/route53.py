@@ -27,7 +27,9 @@ from ..kube.workqueue import (
 )
 from ..reconcile import Result
 from .base import (
+    ROUTE53_HOSTNAME_INDEX,
     annotation_presence_changed,
+    index_by_route53_hostname,
     run_controller,
     spawn_workers,
     was_load_balancer_service,
@@ -68,10 +70,14 @@ class Route53Controller:
         self.service_informer.add_event_handler(
             add=self._add_service, update=self._update_service,
             delete=self._delete_service)
+        self.service_informer.add_index(ROUTE53_HOSTNAME_INDEX,
+                                        index_by_route53_hostname)
         self.ingress_informer = informer_factory.ingresses()
         self.ingress_informer.add_event_handler(
             add=self._add_ingress, update=self._update_ingress,
             delete=self._delete_ingress)
+        self.ingress_informer.add_index(ROUTE53_HOSTNAME_INDEX,
+                                        index_by_route53_hostname)
 
     # -- event handlers (route53/controller.go:90-172) ------------------
 
@@ -174,6 +180,7 @@ class Route53Controller:
             return Result()
 
         hostnames = hostname.split(",")
+        self._warn_contested_hostnames(svc, hostnames)
         for lb_ingress in svc.status.load_balancer.ingress:
             result = self._ensure_for_lb_ingress(
                 svc, lb_ingress, hostnames,
@@ -210,6 +217,7 @@ class Route53Controller:
             return Result()
 
         hostnames = hostname.split(",")
+        self._warn_contested_hostnames(ingress, hostnames)
         for lb_ingress in ingress.status.load_balancer.ingress:
             result = self._ensure_for_lb_ingress(
                 ingress, lb_ingress, hostnames,
@@ -218,6 +226,26 @@ class Route53Controller:
             if result is not None:
                 return result
         return Result()
+
+    def _warn_contested_hostnames(self, obj, hostnames) -> None:
+        """Indexed duplicate-claim check: two objects annotating the
+        SAME route53 hostname would fight over one record set (last
+        writer wins, ownership TXT flapping).  The hostname index
+        answers 'who else claims this name' in O(1) across both
+        watched kinds instead of a lister scan per sync."""
+        for hostname in hostnames:
+            others = [
+                o.key()
+                for informer in (self.service_informer,
+                                 self.ingress_informer)
+                for o in informer.by_index(ROUTE53_HOSTNAME_INDEX,
+                                           hostname)
+                if o.key() != obj.key() or type(o) is not type(obj)]
+            if others:
+                logger.error(
+                    "%s %s contests route53 hostname %s with %s — the "
+                    "record set will flap between owners",
+                    type(obj).__name__, obj.key(), hostname, others)
 
     def _ensure_for_lb_ingress(self, obj, lb_ingress, hostnames, ensure):
         try:

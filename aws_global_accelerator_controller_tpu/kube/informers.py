@@ -1,4 +1,4 @@
-"""Shared informers: list+watch cache with event handlers and resync.
+"""Shared informers: list+watch cache with indexes, handlers and resync.
 
 The analogue of client-go SharedInformerFactory (reference
 pkg/manager/manager.go:52-53 builds two factories with 30s resync;
@@ -10,6 +10,25 @@ ADDED handlers, then the watch stream is consumed; a resync timer
 re-delivers the cache as update(obj, obj) pairs -- the level-triggered
 backstop the reconcile design relies on (SURVEY.md §5 "failure
 detection").
+
+Read contract (client-go's, adopted here for the reconcile hot path):
+objects handed to event handlers and returned by ``Lister.get`` /
+``Lister.list`` / ``by_index`` are SHARED, READ-ONLY views of the cache
+-- never mutate one; ``deep_copy()`` first.  The watch layer already
+deep-copies once per event (apiserver.py ``_publish``), and the
+reconcile engine hands process funcs their own copy (reconcile.py), so
+that single defensive copy is the only one left on the hot path.  The
+previous per-read deepcopy of every cached object (and of the FULL list
+per ``cache_list``) was the dominant O(fleet) term of reconcile
+convergence at production fleet sizes.
+
+Indexes (cache.Indexer analogue): ``add_index(name, fn)`` registers an
+index function mapping an object to the values it should be findable
+under; ``by_index(name, value)`` is then an O(1) bucket lookup instead
+of a linear scan over the cache.  The "namespace" index is built in and
+backs namespaced ``Lister.list`` calls.  Listers serve copy-on-write
+snapshots: a snapshot list is built at most once per cache mutation and
+shared by every reader until the next event invalidates it.
 """
 from __future__ import annotations
 
@@ -18,9 +37,10 @@ import queue as queue_mod
 import random
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional
 
 from ..errors import NotFoundError
+from ..metrics import record_index_lookup
 from .apiserver import (
     WATCH_ADDED,
     WATCH_DELETED,
@@ -34,6 +54,12 @@ logger = logging.getLogger(__name__)
 AddHandler = Callable[[KubeObject], None]
 UpdateHandler = Callable[[KubeObject, KubeObject], None]
 DeleteHandler = Callable[[KubeObject], None]
+# An index function maps one object to every value it is findable
+# under (cache.IndexFunc analogue; may yield zero values).
+IndexFunc = Callable[[KubeObject], Iterable[str]]
+
+# Built-in index backing namespaced Lister.list calls.
+NAMESPACE_INDEX = "namespace"
 
 
 class EventHandlers:
@@ -46,7 +72,9 @@ class EventHandlers:
 
 
 class Lister:
-    """Read-only view of an informer cache (lister analogue)."""
+    """Read-only view of an informer cache (lister analogue).
+
+    Returned objects are shared views -- deep_copy before mutating."""
 
     def __init__(self, informer: "Informer"):
         self._informer = informer
@@ -68,6 +96,19 @@ class Informer:
         self._resync_period = resync_period
         self._cache: Dict[str, KubeObject] = {}
         self._cache_lock = threading.RLock()
+        # index name -> index fn; index name -> value -> {key: obj}.
+        # Buckets hold the cached objects themselves so by_index never
+        # re-walks the cache; all mutation happens under _cache_lock.
+        self._index_funcs: Dict[str, IndexFunc] = {
+            NAMESPACE_INDEX: lambda o: (o.metadata.namespace,)}
+        self._indices: Dict[str, Dict[str, Dict[str, KubeObject]]] = {
+            NAMESPACE_INDEX: {}}
+        # Copy-on-write list snapshots: built lazily on first read,
+        # shared by every reader, dropped on any cache mutation.  None
+        # marks "stale"; per-namespace snapshots piggyback on the
+        # namespace index.
+        self._snapshot: Optional[List[KubeObject]] = None
+        self._ns_snapshots: Dict[str, List[KubeObject]] = {}
         self._handlers: List[EventHandlers] = []
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -79,6 +120,21 @@ class Informer:
     def add_event_handler(self, add=None, update=None, delete=None) -> None:
         self._handlers.append(EventHandlers(add, update, delete))
 
+    def add_index(self, name: str, fn: IndexFunc) -> None:
+        """Register (or re-register) an index function.
+
+        Safe at any point in the informer's life: the index is rebuilt
+        over the current cache under the lock, so controllers sharing
+        one informer can each register their indexes in __init__
+        regardless of start order."""
+        with self._cache_lock:
+            self._index_funcs[name] = fn
+            index: Dict[str, Dict[str, KubeObject]] = {}
+            for key, obj in self._cache.items():
+                for value in fn(obj):
+                    index.setdefault(value, {})[key] = obj
+            self._indices[name] = index
+
     def has_synced(self) -> bool:
         return self._synced.is_set()
 
@@ -86,13 +142,57 @@ class Informer:
 
     def cache_get(self, key: str) -> Optional[KubeObject]:
         with self._cache_lock:
-            obj = self._cache.get(key)
-            return obj.deep_copy() if obj is not None else None
+            return self._cache.get(key)
 
     def cache_list(self, namespace: Optional[str] = None) -> List[KubeObject]:
+        # the snapshot is rebuilt at most once per cache mutation;
+        # callers get a shallow copy (pointers to the shared objects)
+        # so sorting/filtering the RESULT can't corrupt other readers,
+        # while the old per-call deepcopy of every object stays gone
         with self._cache_lock:
-            return [o.deep_copy() for o in self._cache.values()
-                    if namespace is None or o.metadata.namespace == namespace]
+            if namespace is None:
+                if self._snapshot is None:
+                    self._snapshot = list(self._cache.values())
+                return list(self._snapshot)
+            snap = self._ns_snapshots.get(namespace)
+            if snap is None:
+                bucket = self._indices[NAMESPACE_INDEX].get(namespace, {})
+                snap = self._ns_snapshots[namespace] = list(bucket.values())
+            return list(snap)
+
+    def by_index(self, name: str, value: str) -> List[KubeObject]:
+        """All cached objects the ``name`` index maps to ``value`` --
+        an O(result) bucket read, never a cache walk.  Raises KeyError
+        for an unregistered index (a programming error, as in
+        client-go)."""
+        with self._cache_lock:
+            bucket = self._indices[name].get(value)
+            objs = list(bucket.values()) if bucket else []
+        record_index_lookup(self.kind, name, hit=bool(objs))
+        return objs
+
+    def _apply_locked(self, key: str, obj: Optional[KubeObject]) -> None:
+        """Install (or, with obj=None, remove) one cache entry and keep
+        every index and snapshot coherent.  Caller holds _cache_lock."""
+        old = self._cache.get(key)
+        if obj is None:
+            self._cache.pop(key, None)
+        else:
+            self._cache[key] = obj
+        for name, fn in self._index_funcs.items():
+            index = self._indices[name]
+            if old is not None:
+                for value in fn(old):
+                    bucket = index.get(value)
+                    if bucket is not None:
+                        bucket.pop(key, None)
+                        if not bucket:
+                            index.pop(value, None)
+            if obj is not None:
+                for value in fn(obj):
+                    index.setdefault(value, {})[key] = obj
+        self._snapshot = None
+        self._ns_snapshots.clear()
 
     # -- run loop -------------------------------------------------------
 
@@ -143,10 +243,10 @@ class Informer:
         try:
             with self._cache_lock:
                 for obj in listed:
-                    self._cache[obj.key()] = obj
+                    self._apply_locked(obj.key(), obj)
             for obj in listed:
                 for h in self._handlers:
-                    self._dispatch(h.add, obj.deep_copy())
+                    self._dispatch(h.add, obj)
             self._synced.set()
 
             next_resync = time.monotonic() + self._resync_period
@@ -166,40 +266,28 @@ class Informer:
 
     def _handle_event(self, event) -> None:
         key = event.obj.key()
-        if event.type == WATCH_ADDED:
+        if event.type in (WATCH_ADDED, WATCH_MODIFIED):
             with self._cache_lock:
                 old = self._cache.get(key)
-                self._cache[key] = event.obj
+                self._apply_locked(key, event.obj)
             for h in self._handlers:
                 if old is None:
-                    self._dispatch(h.add, event.obj.deep_copy())
+                    self._dispatch(h.add, event.obj)
                 else:
-                    self._dispatch(h.update, old.deep_copy(),
-                                   event.obj.deep_copy())
-        elif event.type == WATCH_MODIFIED:
-            with self._cache_lock:
-                old = self._cache.get(key)
-                self._cache[key] = event.obj
-            for h in self._handlers:
-                if old is None:
-                    self._dispatch(h.add, event.obj.deep_copy())
-                else:
-                    self._dispatch(h.update, old.deep_copy(),
-                                   event.obj.deep_copy())
+                    self._dispatch(h.update, old, event.obj)
         elif event.type == WATCH_DELETED:
             with self._cache_lock:
-                old = self._cache.pop(key, None)
+                old = self._cache.get(key)
+                self._apply_locked(key, None)
             tombstone = old if old is not None else event.obj
             for h in self._handlers:
-                self._dispatch(h.delete, tombstone.deep_copy())
+                self._dispatch(h.delete, tombstone)
 
     def _resync(self) -> None:
         """Re-deliver the cache as no-op updates (level-trigger backstop)."""
-        with self._cache_lock:
-            objs = [o.deep_copy() for o in self._cache.values()]
-        for obj in objs:
+        for obj in self.cache_list():
             for h in self._handlers:
-                self._dispatch(h.update, obj.deep_copy(), obj.deep_copy())
+                self._dispatch(h.update, obj, obj)
 
 
 class SharedInformerFactory:

@@ -21,13 +21,14 @@ from typing import Any, Optional, Tuple
 from ..native import ensure_library
 
 _lib = None
+_fast_lib = None
 _lib_lock = threading.Lock()
 _lib_failed = False
 
 
 def load() -> Optional[ctypes.CDLL]:
     """Load (building if necessary) the native library, or None."""
-    global _lib, _lib_failed
+    global _lib, _fast_lib, _lib_failed
     with _lib_lock:
         if _lib is not None:
             return _lib
@@ -39,6 +40,18 @@ def load() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(path)
+            # Second handle via PyDLL: calls through it KEEP the GIL.
+            # The O(1) bookkeeping entry points (add / done / forget /
+            # add_rate_limited / len) finish in well under a
+            # microsecond, but a CDLL call drops and re-acquires the
+            # GIL around each one — and under reconcile-storm
+            # contention every re-acquisition parks the worker behind
+            # the switch interval, costing ~1000x the call itself.
+            # Only the blocking get() needs (and keeps) the
+            # GIL-releasing route; the native mutex is never held
+            # across a wait (the cv releases it), so holding the GIL
+            # through these short calls cannot deadlock.
+            fast = ctypes.PyDLL(path)
         except OSError:
             _lib_failed = True
             return None
@@ -67,6 +80,18 @@ def load() -> Optional[ctypes.CDLL]:
         lib.aga_wq_shutdown.argtypes = [ctypes.c_void_p]
         lib.aga_wq_shutting_down.restype = ctypes.c_int
         lib.aga_wq_shutting_down.argtypes = [ctypes.c_void_p]
+        fast.aga_wq_add.argtypes = lib.aga_wq_add.argtypes
+        fast.aga_wq_done.argtypes = lib.aga_wq_done.argtypes
+        fast.aga_wq_forget.argtypes = lib.aga_wq_forget.argtypes
+        fast.aga_wq_add_after.argtypes = lib.aga_wq_add_after.argtypes
+        fast.aga_wq_add_rate_limited.restype = ctypes.c_double
+        fast.aga_wq_add_rate_limited.argtypes = (
+            lib.aga_wq_add_rate_limited.argtypes)
+        fast.aga_wq_num_requeues.restype = ctypes.c_int
+        fast.aga_wq_num_requeues.argtypes = lib.aga_wq_num_requeues.argtypes
+        fast.aga_wq_len.restype = ctypes.c_int
+        fast.aga_wq_len.argtypes = lib.aga_wq_len.argtypes
+        _fast_lib = fast
         _lib = lib
         return _lib
 
@@ -95,6 +120,9 @@ class NativeRateLimitingQueue:
             raise RuntimeError("native workqueue library unavailable")
         self.name = name
         self._lib = lib
+        # GIL-keeping handle for the O(1) ops (see load()); the
+        # blocking get() stays on the GIL-releasing handle
+        self._fast = _fast_lib
         self._h = lib.aga_wq_new(qps, burst, base_delay, max_delay)
         self._tls = threading.local()
 
@@ -105,7 +133,7 @@ class NativeRateLimitingQueue:
             self._h = None
 
     def add(self, item: Any) -> None:
-        self._lib.aga_wq_add(self._h, _encode(item))
+        self._fast.aga_wq_add(self._h, _encode(item))
 
     def get(self, timeout: Optional[float] = None
             ) -> Tuple[Optional[str], bool]:
@@ -131,19 +159,19 @@ class NativeRateLimitingQueue:
             t = 0.0 if timeout is not None else -1.0
 
     def done(self, item: Any) -> None:
-        self._lib.aga_wq_done(self._h, _encode(item))
+        self._fast.aga_wq_done(self._h, _encode(item))
 
     def add_after(self, item: Any, delay: float) -> None:
-        self._lib.aga_wq_add_after(self._h, _encode(item), float(delay))
+        self._fast.aga_wq_add_after(self._h, _encode(item), float(delay))
 
     def add_rate_limited(self, item: Any) -> None:
-        self._lib.aga_wq_add_rate_limited(self._h, _encode(item))
+        self._fast.aga_wq_add_rate_limited(self._h, _encode(item))
 
     def forget(self, item: Any) -> None:
-        self._lib.aga_wq_forget(self._h, _encode(item))
+        self._fast.aga_wq_forget(self._h, _encode(item))
 
     def num_requeues(self, item: Any) -> int:
-        return self._lib.aga_wq_num_requeues(self._h, _encode(item))
+        return self._fast.aga_wq_num_requeues(self._h, _encode(item))
 
     def shutdown(self) -> None:
         self._lib.aga_wq_shutdown(self._h)
@@ -153,4 +181,4 @@ class NativeRateLimitingQueue:
         return bool(self._lib.aga_wq_shutting_down(self._h))
 
     def __len__(self) -> int:
-        return self._lib.aga_wq_len(self._h)
+        return self._fast.aga_wq_len(self._h)

@@ -76,7 +76,14 @@ class ObjectMeta:
 
 
 class KubeObject:
-    """Base for all API objects: kind + metadata + deep copy."""
+    """Base for all API objects: kind + metadata + deep copy.
+
+    Ownership contract (matches client-go): objects read from an
+    informer cache — lister get/list, ``by_index``, event-handler
+    arguments — are SHARED views; call ``deep_copy()`` before mutating
+    one.  The reconcile engine does exactly that before invoking
+    process funcs (reconcile.py), which is the single defensive copy
+    on the hot path."""
 
     kind: str = ""
     metadata: ObjectMeta
@@ -296,6 +303,17 @@ def _backend_to_dict(backend: "IngressBackend") -> Dict[str, Any]:
     }
 
 
+def _copy_backend(backend: Optional["IngressBackend"]
+                  ) -> Optional["IngressBackend"]:
+    if backend is None or backend.service is None:
+        return IngressBackend() if backend is not None else None
+    svc = backend.service
+    return IngressBackend(service=IngressServiceBackend(
+        name=svc.name,
+        port=IngressServiceBackendPort(number=svc.port.number,
+                                       name=svc.port.name)))
+
+
 def _backend_from_dict(d: Optional[Dict[str, Any]]) -> Optional["IngressBackend"]:
     svc = (d or {}).get("service")
     if not svc:
@@ -317,6 +335,28 @@ class Ingress(KubeObject):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: IngressSpec = field(default_factory=IngressSpec)
     status: IngressStatus = field(default_factory=IngressStatus)
+
+    def deep_copy(self) -> "Ingress":
+        # hand-rolled like Service.deep_copy: Ingresses ride the same
+        # watch/reconcile hot path and copy.deepcopy's reflective walk
+        # costs ~10x the explicit constructors
+        return Ingress(
+            metadata=self.metadata.copy(),
+            spec=IngressSpec(
+                ingress_class_name=self.spec.ingress_class_name,
+                default_backend=_copy_backend(self.spec.default_backend),
+                rules=[IngressRule(
+                    host=r.host,
+                    http=HTTPIngressRuleValue(paths=[
+                        HTTPIngressPath(path=p.path,
+                                        backend=_copy_backend(p.backend)
+                                        or IngressBackend())
+                        for p in r.http.paths]) if r.http else None)
+                    for r in self.spec.rules]),
+            status=IngressStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(i.hostname, i.ip)
+                         for i in self.status.load_balancer.ingress])),
+        )
 
     def to_dict(self):
         spec: Dict[str, Any] = {}
@@ -387,6 +427,17 @@ class Event(KubeObject):
     type: str = "Normal"
     reason: str = ""
     message: str = ""
+
+    def deep_copy(self) -> "Event":
+        # hand-rolled like Service/Ingress: every reconcile that emits
+        # an Event pays three copies in the apiserver create path, and
+        # the generic copy.deepcopy walk was the single largest CPU
+        # term of the event pipeline
+        return Event(metadata=self.metadata.copy(),
+                     involved_object_kind=self.involved_object_kind,
+                     involved_object_key=self.involved_object_key,
+                     type=self.type, reason=self.reason,
+                     message=self.message)
 
 
 # ---------------------------------------------------------------------------

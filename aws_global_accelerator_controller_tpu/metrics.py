@@ -135,6 +135,18 @@ default_registry.describe(
     "exec_credential_runs_total",
     "Exec credential plugin invocations by outcome (ok / error).")
 default_registry.describe(
+    "informer_index_lookups_total",
+    "by_index lookups per informer kind and index name, split "
+    "hit (non-empty bucket) / miss.")
+default_registry.describe(
+    "provider_coalesced_reads_total",
+    "AWS read calls answered by joining another worker's identical "
+    "in-flight call (singleflight), by operation.")
+default_registry.describe(
+    "provider_fleet_scans_total",
+    "Full ListAccelerators + per-ARN tag sweeps executed (the "
+    "O(fleet) discovery slow path the caches exist to avoid).")
+default_registry.describe(
     "weight_plans_total",
     "Endpoint-group weight plans applied, by policy implementation "
     "and value source (spec / model).")
@@ -152,6 +164,30 @@ def record_watch_event(kind: str, event: str,
     reg = registry or default_registry
     reg.inc_counter("watch_disruptions_total",
                     {"kind": kind, "event": event})
+
+
+def record_index_lookup(kind: str, index: str, hit: bool,
+                        registry: Optional[Registry] = None) -> None:
+    """One informer ``by_index`` lookup resolved: ``hit`` means the
+    bucket was non-empty.  These counters are how the bench (and an
+    operator) see the indexed read path actually carrying the load."""
+    reg = registry or default_registry
+    reg.inc_counter("informer_index_lookups_total",
+                    {"kind": kind, "index": index,
+                     "result": "hit" if hit else "miss"})
+
+
+def record_coalesced_read(op: str,
+                          registry: Optional[Registry] = None) -> None:
+    """One provider read served by joining an identical in-flight call
+    instead of issuing its own upstream API request."""
+    reg = registry or default_registry
+    reg.inc_counter("provider_coalesced_reads_total", {"op": op})
+
+
+def record_fleet_scan(registry: Optional[Registry] = None) -> None:
+    reg = registry or default_registry
+    reg.inc_counter("provider_fleet_scans_total", {})
 
 
 def record_exec_credential_run(outcome: str,

@@ -499,8 +499,9 @@ _FUSED_BWD_DQ_BYTES = 2 * 2 ** 20
 # scan training loop) the fused kernel hits kernel-vmem-stack OOM at
 # every block size tried, while h <= 8 compiles on-chip.  32 is an
 # empirical ceiling with margin — h = 32 itself (the CLI's
-# --attention-chunk 32 path) is compile-verified by the h32_gate
-# experiment (hack/tpu_experiments.py) on a live window; any claimed
+# --attention-chunk 32 path) is PENDING compile-verification: the
+# h32_gate experiment (hack/tpu_experiments.py) exists to verify it
+# on a live window and has not yet run on-chip; any claimed
 # fused-vs-two-sweep speedup must come from that harness's interleaved
 # full-backward A/B, not single-shot timings (the r4 -12% claim was
 # retracted for lacking exactly that).  The two-sweep fallback is

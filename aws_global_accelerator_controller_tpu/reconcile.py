@@ -5,7 +5,9 @@ Mirrors reference pkg/reconcile/reconcile.go:17-91:
 - pop a key from the rate-limited queue;
 - resolve key -> object via the lister (``key_to_obj``); NotFound means the
   object was deleted -> ``process_delete``; otherwise hand a deep copy to
-  ``process_create_or_update``;
+  ``process_create_or_update`` — listers return SHARED views of the
+  informer cache (kube/informers.py), so this copy is the ONE defensive
+  copy between the watch stream and the process func;
 - dispatch on the outcome: NoRetryError -> drop (Forget is NOT called, as
   in the reference, so the failure count survives); other error ->
   AddRateLimited; Result.requeue_after -> Forget + AddAfter;

@@ -23,6 +23,7 @@ def bench_reconcile(n_services: int = 200, workers: int = 4) -> dict:
     sys.path.insert(0, "tests")
     from harness import Cluster, wait_until
 
+    from aws_global_accelerator_controller_tpu import metrics
     from aws_global_accelerator_controller_tpu.apis import (
         AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
         AWS_LOAD_BALANCER_TYPE_ANNOTATION,
@@ -36,6 +37,14 @@ def bench_reconcile(n_services: int = 200, workers: int = 4) -> dict:
         ServiceSpec,
         ServiceStatus,
     )
+
+    # per-stage counters (index hits, coalesced reads, full fleet
+    # scans): the default registry is cumulative, so snapshot deltas
+    reg = metrics.default_registry
+    before = {name: reg.counter_value(name) for name in (
+        "informer_index_lookups_total",
+        "provider_coalesced_reads_total",
+        "provider_fleet_scans_total")}
 
     # lift the client-go default 10qps queue bucket so the bench measures
     # framework reconcile work, not the (configurable) admission throttle
@@ -76,7 +85,16 @@ def bench_reconcile(n_services: int = 200, workers: int = 4) -> dict:
         cluster.shutdown()
 
     return {"services": n_services, "elapsed_s": elapsed,
-            "throughput": n_services / elapsed}
+            "throughput": n_services / elapsed,
+            "index_lookups": round(
+                reg.counter_value("informer_index_lookups_total")
+                - before["informer_index_lookups_total"]),
+            "coalesced_reads": round(
+                reg.counter_value("provider_coalesced_reads_total")
+                - before["provider_coalesced_reads_total"]),
+            "fleet_scans": round(
+                reg.counter_value("provider_fleet_scans_total")
+                - before["provider_fleet_scans_total"])}
 
 
 def bench_reconcile_best(reps: int = 3, **kw) -> dict:
@@ -86,6 +104,29 @@ def bench_reconcile_best(reps: int = 3, **kw) -> dict:
     measure of what the framework itself costs."""
     runs = [bench_reconcile(**kw) for _ in range(reps)]
     return min(runs, key=lambda r: r["elapsed_s"])
+
+
+def bench_reconcile_scaling(sizes=(200, 1000), workers: int = 4,
+                            record: bool = False) -> dict:
+    """Scaling leg of the primary metric: one reconcile-convergence run
+    per fleet size, plus the throughput ratio of the largest to the
+    smallest leg.  ``scaling`` ~= 1.0 is linear convergence (per-service
+    cost flat in fleet size); the pre-index/singleflight code decayed
+    super-linearly because every first ensure paid an O(fleet) tag
+    scan and every lister read deep-copied.  ``record=True`` appends
+    each leg to reconcile_history.jsonl (the committed record the
+    derived regression floor is computed from)."""
+    legs = [bench_reconcile(n_services=n, workers=workers)
+            for n in sizes]
+    if record:
+        for leg in legs:
+            _record_reconcile_history(leg)
+    return {
+        "workers": workers,
+        "legs": legs,
+        "scaling": round(legs[-1]["throughput"] / legs[0]["throughput"],
+                         3),
+    }
 
 
 # peak dense bf16 matmul throughput per chip, matched against
@@ -1221,6 +1262,18 @@ def main() -> None:
     print(f"reconcile: {reconcile['services']} services converged in "
           f"{reconcile['elapsed_s']:.2f}s "
           f"({reconcile['throughput']:.1f}/s)", file=sys.stderr)
+    # scaling leg: the 200-service number above is the jitter-stable
+    # headline; the 1000-service point shows whether per-service cost
+    # stays flat as the fleet grows (index + singleflight hot path)
+    big = bench_reconcile(n_services=1000)
+    scaling = big["throughput"] / reconcile["throughput"]
+    print(f"reconcile scaling: {big['services']} services in "
+          f"{big['elapsed_s']:.2f}s ({big['throughput']:.1f}/s, "
+          f"{scaling:.2f}x the 200-service rate; "
+          f"{big['index_lookups']} index lookups, "
+          f"{big['coalesced_reads']} coalesced reads, "
+          f"{big['fleet_scans']} fleet scans)", file=sys.stderr)
+    _record_reconcile_history(big)
     status, detail = tpu_probe()
     if status == "dead":
         skip = {"skipped": f"backend wedged: {detail}"}
@@ -1270,6 +1323,9 @@ def main() -> None:
         "metric": "reconcile_convergence_throughput",
         "value": round(reconcile["throughput"], 2),
         "unit": "services/sec",
+        # 1000-service leg relative to the 200-service headline:
+        # ~1.0 = linear convergence scaling (see bench_reconcile_scaling)
+        "scaling_1000": round(scaling, 3),
         # the reference publishes no benchmarks (BASELINE.md) -- parity
         # against an empty baseline is reported as 1.0
         "vs_baseline": 1.0,
@@ -1484,6 +1540,7 @@ def bench_report() -> str:
 # (tpu_probe docstring); reconcile is pure CPU control-plane code.
 _NAMED = {
     "reconcile": bench_reconcile_best,
+    "reconcile-scaling": lambda: bench_reconcile_scaling(record=True),
     "planner": lambda: _json_bench_subprocess(
         "bench_planner", "planner bench", 300.0),
     "flash": bench_flash_subprocess,

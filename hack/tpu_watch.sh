@@ -37,9 +37,15 @@ EOF
             else
                 echo "$(date -u +%FT%TZ) experiments incomplete (see bench_artifacts/experiments_r5.jsonl)"
             fi
-            git add bench_artifacts 2>/dev/null
-            if ! git commit -m "bench: on-chip gate experiments $(date -u +%FT%TZ)" -- bench_artifacts >/dev/null 2>&1; then
-                echo "$(date -u +%FT%TZ) WARNING: experiment-artifact commit failed - bench_artifacts left uncommitted (commit by hand)"
+            # stage ONLY the file this run produced (tpu_experiments.py
+            # appends to experiments_r5.jsonl; capture_live committed its
+            # own artifacts above) — a bare `git add bench_artifacts`
+            # would sweep up unrelated scratch files (half-written
+            # captures, jax_cache debris) into the experiment commit
+            EXPERIMENTS_OUT=bench_artifacts/experiments_r5.jsonl
+            git add -- "$EXPERIMENTS_OUT" 2>/dev/null
+            if ! git commit -m "bench: on-chip gate experiments $(date -u +%FT%TZ)" -- "$EXPERIMENTS_OUT" >/dev/null 2>&1; then
+                echo "$(date -u +%FT%TZ) WARNING: experiment-artifact commit failed - $EXPERIMENTS_OUT left uncommitted (commit by hand)"
             fi
             exit 0
         fi

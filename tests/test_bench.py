@@ -69,6 +69,24 @@ def test_bench_reconcile_converges_small_fleet():
     assert r["throughput"] > 0
 
 
+def test_bench_reconcile_scaling_smoke():
+    """Small-N run of the scaling leg so it can't silently rot between
+    the real 200→1000 invocations: both legs converge, the ratio is
+    computed, and the per-stage counters (index lookups, fleet scans)
+    prove the indexed discovery path actually carried the load."""
+    r = bench.bench_reconcile_scaling(sizes=(3, 6), workers=2)
+    assert [leg["services"] for leg in r["legs"]] == [3, 6]
+    assert all(leg["throughput"] > 0 for leg in r["legs"])
+    assert r["scaling"] > 0
+    for leg in r["legs"]:
+        # every service sync consults the lb-dns index at least once
+        assert leg["index_lookups"] > 0
+        # the slow path ran at most a handful of times — the indexed
+        # fast path, not O(fleet) rescans, served the storm
+        assert 1 <= leg["fleet_scans"] <= leg["services"]
+        assert leg["coalesced_reads"] >= 0
+
+
 def test_tpu_probe_parses_subprocess_outcomes(monkeypatch):
     monkeypatch.setattr(bench, "_run_subprocess",
                         lambda *a, **k: ("tpu 64.0", "ok"))
@@ -92,6 +110,12 @@ def _main_json(monkeypatch, capsys, tmp_path, status, detail):
         bench, "bench_reconcile_best",
         lambda **kw: {"services": 10, "elapsed_s": 0.01,
                       "throughput": 1000.0})
+    monkeypatch.setattr(
+        bench, "bench_reconcile",
+        lambda **kw: {"services": kw.get("n_services", 10),
+                      "elapsed_s": 0.01, "throughput": 2000.0,
+                      "index_lookups": 4, "coalesced_reads": 0,
+                      "fleet_scans": 1})
     monkeypatch.setattr(bench, "tpu_probe", lambda *a, **k: (status,
                                                             detail))
     planner_calls = []

@@ -635,6 +635,32 @@ def test_attention_chunk_unrelated_failure_stays_raw(monkeypatch):
               "--attention-chunk", "32"])
 
 
+def test_attention_chunk_hbm_oom_stays_raw(monkeypatch):
+    """A plain HBM RESOURCE_EXHAUSTED (model too big for the chip, no
+    Mosaic/Pallas involvement) must NOT be misattributed to
+    --attention-chunk: the signature gate matches compiler-specific
+    markers only (r5 ADVICE low)."""
+    from aws_global_accelerator_controller_tpu.cmd import compute
+
+    real_build = compute._build_model
+
+    def build(args):
+        model, run_step, run_plan_fwd = real_build(args)
+
+        def broken_step(params, opt_state, key):
+            raise ValueError(
+                "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                "allocate 17179869184 bytes in HBM")
+        return model, broken_step, run_plan_fwd
+
+    monkeypatch.setattr(compute, "_build_model", build)
+    with pytest.raises(ValueError, match="RESOURCE_EXHAUSTED"):
+        main(["train", "--model", "temporal", "--steps", "2",
+              "--groups", "2", "--endpoints", "4", "--window", "16",
+              "--hidden", "16", "--supervision", "sequence",
+              "--attention-chunk", "32"])
+
+
 def test_attention_chunk_rejected_for_non_temporal_families():
     with pytest.raises(SystemExit) as exc:
         main(["train", "--model", "mlp", "--steps", "1",

@@ -168,6 +168,27 @@ def test_duplicate_detected_after_ttl_expiry(env):
         arn, rogue.accelerator_arn}
 
 
+def test_retag_not_masked_by_fresh_fleet_index(env):
+    """Regression (ADVICE r5 medium): ``_update_accelerator`` re-tags an
+    accelerator onto NEW owner/hostname discovery keys.  A fleet index
+    installed before the re-tag has never seen those keys, and — being
+    fresh — would report them definitely-absent for up to TTL + 1m.
+    The update must invalidate the index inside the same _cache_lock
+    block as its tag-cache drop."""
+    factory, provider, ga = env
+    arn, _, _ = _ensure(provider)
+    # install a fresh fleet index via an unrelated full scan
+    assert provider.list_global_accelerator_by_hostname(
+        "other.elb.amazonaws.com", CLUSTER) == []
+    provider._update_accelerator(
+        arn, name="renamed", owner="service/other/name",
+        hostname=HOSTNAME, specified_tags={})
+    # the NEW owner key must be discoverable immediately, not after TTL
+    accs = provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "other", "name")
+    assert [a.accelerator_arn for a in accs] == [arn]
+
+
 def test_tag_update_visible_immediately_via_writethrough(env):
     """A tag change made through the provider invalidates the tag cache,
     so discovery under the NEW owner works without waiting for the TTL."""

@@ -111,6 +111,36 @@ def test_binding_lifecycle(cluster):
                message="observedGeneration current")
 
 
+def test_service_status_change_requeues_binding_without_resync():
+    """A binding whose Service has no LB hostname yet must converge as
+    soon as the hostname appears in the Service's status — via the
+    serviceRef index requeue, NOT the resync backstop (the 300s resync
+    here would time the wait_untils out if the index path were
+    missing)."""
+    c = Cluster(resync_period=300.0).start()
+    try:
+        eg = make_endpoint_group(c)
+        lb1 = c.cloud.elb.register_load_balancer("one", NLB1, REGION)
+        c.kube.services.create(lb_service(hostnames=()))
+        c.operator.endpoint_group_bindings.create(make_binding(eg))
+        wait_until(lambda: get_binding(c).metadata.finalizers == [FINALIZER],
+                   message="finalizer added")
+        wait_until(lambda: get_binding(c).status.observed_generation
+                   == get_binding(c).metadata.generation,
+                   message="binding settled with no hostnames")
+        assert lb1.load_balancer_arn not in eg_endpoints(c, eg)
+
+        svc = c.kube.services.get("default", "app")
+        svc.status.load_balancer = LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=NLB1)])
+        c.kube.services.update(svc)
+        wait_until(lambda: lb1.load_balancer_arn in eg_endpoints(c, eg),
+                   message="endpoint added after status appeared "
+                           "(serviceRef index requeue)")
+    finally:
+        c.shutdown()
+
+
 def test_weight_update_propagates(cluster):
     eg = make_endpoint_group(cluster)
     lb1 = cluster.cloud.elb.register_load_balancer("one", NLB1, REGION)

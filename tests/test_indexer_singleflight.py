@@ -1,0 +1,308 @@
+"""Informer Indexer + provider singleflight contracts.
+
+The two halves of the indexed-reconcile hot path (ARCHITECTURE.md
+"Informer indexes & listers" / "Provider read coalescing"):
+
+- the informer cache is a client-go-style Indexer: registerable index
+  functions, O(1) ``by_index`` bucket reads, copy-on-write snapshot
+  listers, and a shared-read-only-view ownership contract;
+- the AWS provider coalesces identical in-flight reads (singleflight),
+  so N workers needing the same verify pair issue ONE upstream call.
+"""
+import threading
+import time
+
+import pytest
+
+from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
+    FakeCloudFactory,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.singleflight import (
+    Singleflight,
+)
+from aws_global_accelerator_controller_tpu.kube.apiserver import FakeAPIServer
+from aws_global_accelerator_controller_tpu.kube.client import KubeClient
+from aws_global_accelerator_controller_tpu.kube.informers import (
+    SharedInformerFactory,
+    wait_for_cache_sync,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+
+HOSTNAME = "mylb-0123456789abcdef.elb.ap-northeast-1.amazonaws.com"
+REGION = "ap-northeast-1"
+CLUSTER = "test-cluster"
+
+
+def make_service(name, ns="default", team=None):
+    ann = {"team": team} if team else {}
+    return Service(metadata=ObjectMeta(name=name, namespace=ns,
+                                       annotations=ann),
+                   spec=ServiceSpec(type="LoadBalancer"))
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+@pytest.fixture
+def informer_env():
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    factory = SharedInformerFactory(api, resync_period=300)
+    informer = factory.services()
+    informer.add_index("team", lambda o: (
+        [o.metadata.annotations["team"]]
+        if "team" in o.metadata.annotations else []))
+    stop = threading.Event()
+    factory.start(stop)
+    assert wait_for_cache_sync(stop, informer, timeout=10.0)
+    yield api, kube, informer
+    stop.set()
+
+
+# ---------------------------------------------------------------------------
+# Indexer
+# ---------------------------------------------------------------------------
+
+def test_by_index_tracks_adds_updates_deletes(informer_env):
+    api, kube, informer = informer_env
+    kube.services.create(make_service("a", team="red"))
+    kube.services.create(make_service("b", team="red"))
+    kube.services.create(make_service("c", team="blue"))
+    assert wait_until(lambda: len(informer.by_index("team", "red")) == 2)
+    assert [o.metadata.name for o in informer.by_index("team", "blue")] == ["c"]
+    assert informer.by_index("team", "green") == []
+
+    svc = kube.services.get("default", "b")
+    svc.metadata.annotations["team"] = "blue"
+    kube.services.update(svc)
+    assert wait_until(lambda: len(informer.by_index("team", "blue")) == 2)
+    assert [o.metadata.name for o in informer.by_index("team", "red")] == ["a"]
+
+    kube.services.delete("default", "c")
+    assert wait_until(lambda: [o.metadata.name
+                               for o in informer.by_index("team", "blue")]
+                      == ["b"])
+
+
+def test_add_index_after_sync_rebuilds_over_cache(informer_env):
+    api, kube, informer = informer_env
+    kube.services.create(make_service("x", team="late"))
+    assert wait_until(lambda: informer.cache_get("default/x") is not None)
+    # register AFTER the object is cached: index must include it
+    informer.add_index("team2", lambda o: (
+        [o.metadata.annotations["team"]]
+        if "team" in o.metadata.annotations else []))
+    assert [o.metadata.name for o in informer.by_index("team2", "late")] == ["x"]
+
+
+def test_unregistered_index_is_a_programming_error(informer_env):
+    _, _, informer = informer_env
+    with pytest.raises(KeyError):
+        informer.by_index("nope", "value")
+
+
+def test_namespace_index_backs_namespaced_list(informer_env):
+    api, kube, informer = informer_env
+    kube.services.create(make_service("n1", ns="alpha"))
+    kube.services.create(make_service("n2", ns="beta"))
+    assert wait_until(lambda: len(informer.lister.list()) == 2)
+    assert [o.metadata.name for o in informer.lister.list("alpha")] == ["n1"]
+    assert informer.lister.list("gamma") == []
+
+
+def test_cow_snapshot_shared_until_mutation(informer_env):
+    api, kube, informer = informer_env
+    kube.services.create(make_service("s1"))
+    assert wait_until(lambda: len(informer.lister.list()) == 1)
+    first = informer.lister.list()
+    second = informer.lister.list()
+    # no mutation between reads: the same cached OBJECTS are served
+    # (no per-call deepcopy — the old cache_list cost), but each call
+    # gets its own list so callers may sort/mutate the result safely
+    assert first[0] is second[0]
+    assert first is not second
+    second.append(None)      # caller-side mutation stays caller-side
+    assert len(informer.lister.list()) == 1
+
+
+def test_lister_returns_shared_views(informer_env):
+    api, kube, informer = informer_env
+    kube.services.create(make_service("shared"))
+    assert wait_until(lambda: informer.cache_get("default/shared") is not None)
+    # get() hands back the cached object itself (read-only contract);
+    # the defensive copy belongs to the reconcile engine
+    assert (informer.lister.get("default", "shared")
+            is informer.lister.get("default", "shared"))
+
+
+def test_index_lookup_counters_move(informer_env):
+    api, kube, informer = informer_env
+    kube.services.create(make_service("m", team="metrics"))
+    assert wait_until(lambda: len(informer.by_index("team", "metrics")) == 1)
+    reg = metrics.default_registry
+    hit0 = reg.counter_value("informer_index_lookups_total",
+                             {"kind": "Service", "index": "team",
+                              "result": "hit"})
+    miss0 = reg.counter_value("informer_index_lookups_total",
+                              {"kind": "Service", "index": "team",
+                               "result": "miss"})
+    informer.by_index("team", "metrics")
+    informer.by_index("team", "absent")
+    assert reg.counter_value("informer_index_lookups_total",
+                             {"kind": "Service", "index": "team",
+                              "result": "hit"}) == hit0 + 1
+    assert reg.counter_value("informer_index_lookups_total",
+                             {"kind": "Service", "index": "team",
+                              "result": "miss"}) == miss0 + 1
+
+
+# ---------------------------------------------------------------------------
+# Singleflight
+# ---------------------------------------------------------------------------
+
+def test_singleflight_n_threads_one_upstream_call():
+    coalesced = []
+    sf = Singleflight(on_coalesce=coalesced.append)
+    calls = []
+    barrier = threading.Barrier(8)
+    results = []
+
+    def fn():
+        calls.append(1)
+        time.sleep(0.2)     # hold the call open so every thread joins
+        return "value"
+
+    def worker():
+        barrier.wait()
+        results.append(sf.do("key", fn))
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(calls) == 1                 # exactly one upstream call
+    assert results == ["value"] * 8        # every caller observed it
+    assert len(coalesced) == 7             # the other 7 joined
+
+
+def test_singleflight_exception_shared_by_joiners():
+    sf = Singleflight()
+    barrier = threading.Barrier(4)
+    errors = []
+
+    def fn():
+        time.sleep(0.2)
+        raise ValueError("boom")
+
+    def worker():
+        barrier.wait()
+        try:
+            sf.do("key", fn)
+        except ValueError as e:
+            errors.append(str(e))
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert errors == ["boom"] * 4
+
+
+def test_singleflight_does_not_cache_results():
+    sf = Singleflight()
+    calls = []
+    for _ in range(3):
+        sf.do("key", lambda: calls.append(1))
+    assert len(calls) == 3     # sequential callers each run fresh
+
+
+# ---------------------------------------------------------------------------
+# Provider read coalescing
+# ---------------------------------------------------------------------------
+
+def _ensure(provider):
+    return provider.ensure_global_accelerator_for_service(
+        Service(metadata=ObjectMeta(name="app", namespace="default"),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)])),
+        LoadBalancerIngress(hostname=HOSTNAME), CLUSTER, "mylb", REGION)
+
+
+def test_concurrent_verifies_coalesce_to_one_api_call():
+    factory = FakeCloudFactory(settle_seconds=0.0)
+    provider = factory.provider_for(REGION)
+    factory.cloud.elb.register_load_balancer("mylb", HOSTNAME, REGION)
+    arn, created, _ = _ensure(provider)
+    assert created
+
+    describe_calls = []
+    inner = provider.apis.ga.describe_accelerator
+
+    def slow_describe(a):
+        describe_calls.append(a)
+        time.sleep(0.2)
+        return inner(a)
+
+    provider.apis.ga.describe_accelerator = slow_describe
+    reg = metrics.default_registry
+    co0 = reg.counter_value("provider_coalesced_reads_total",
+                            {"op": "verify"})
+
+    barrier = threading.Barrier(8)
+    results = []
+
+    def worker():
+        barrier.wait()
+        results.append(provider.list_global_accelerator_by_resource(
+            CLUSTER, "service", "default", "app"))
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+
+    # every worker hit the hot discovery key at once: ONE
+    # DescribeAccelerator upstream, everyone shares the verified result
+    assert len(describe_calls) == 1
+    assert all([a.accelerator_arn for a in r] == [arn] for r in results)
+    assert reg.counter_value("provider_coalesced_reads_total",
+                             {"op": "verify"}) == co0 + 7
+
+
+def test_discovery_state_shared_across_factory_providers():
+    """GA is a global service: a create through one region's provider
+    must be visible to every other provider of the same factory
+    IMMEDIATELY (not after a TTL) — the regression behind the pre-PR
+    e2e timeouts, where the us-west-2 provider's fresh-but-empty fleet
+    index answered definitely-absent while ap-northeast-1 created."""
+    factory = FakeCloudFactory(settle_seconds=0.0)
+    observer = factory.global_provider()
+    actor = factory.provider_for(REGION)
+    assert observer is not actor
+    factory.cloud.elb.register_load_balancer("mylb", HOSTNAME, REGION)
+
+    # the observer polls first: installs a fresh EMPTY fleet index
+    assert observer.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", "app") == []
+    arn, created, _ = _ensure(actor)
+    assert created
+    # no TTL wait: the shared discovery state makes the create visible
+    accs = observer.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", "app")
+    assert [a.accelerator_arn for a in accs] == [arn]
