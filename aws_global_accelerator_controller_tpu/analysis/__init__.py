@@ -1,0 +1,28 @@
+"""Concurrency contract checking (static lints + runtime detectors).
+
+PR 1 replaced defensive deepcopies on the reconcile hot path with
+convention-only contracts (shared read-only lister views, fleet-index
+writes under the discovery lock, generation-keyed singleflight reads).
+This package makes those conventions machine-checked — the Python
+analogue of running the Go reference under ``-race`` plus client-go's
+cache object-mutation detector:
+
+- ``concurrency_lint``: AST-based static pass (rules L101-L104) run by
+  ``hack/lint.py --concurrency`` over the whole tree.  Pure stdlib, no
+  runtime dependencies — importable by the lint gate without pulling in
+  the controller stack.
+- ``locks``: test-time lockset tracker.  ``make_lock``/``make_rlock``
+  return plain threading primitives in production and instrumented ones
+  when detection is enabled; the tracker records acquisition order per
+  thread and raises :class:`locks.LockOrderViolation` on an ordering
+  inversion, with the stacks of both acquisition sites.
+- ``freezeproxy``: freeze-proxy mode for informer-cache views.  When
+  enabled, listers hand out proxies that raise
+  :class:`freezeproxy.SharedViewMutationError` on any in-place
+  mutation, reporting both the mutation site and the lister call that
+  produced the view.
+
+Submodules are imported directly (``from ..analysis import locks``); the
+package root stays import-light so the lint gate can load
+``concurrency_lint`` without the metrics/threading machinery.
+"""

@@ -1,0 +1,569 @@
+"""AST-based concurrency contract lints (rules L101-L104).
+
+The static half of the concurrency checker: a whole-program pass over
+the tree that enforces the synchronization contracts PR 1 introduced as
+conventions.  Pure stdlib ``ast`` — run by ``hack/lint.py
+--concurrency`` inside the existing lint gate.
+
+Rules (each over-approximates "safe", matching the base linter's
+zero-findings gate philosophy):
+
+  L101 lock ordering     Build the lock graph from every ``with <lock>``
+                         nesting (plus one level of same-class method
+                         calls); flag re-acquisition of a non-reentrant
+                         lock and global A->B vs B->A ordering
+                         inversions.
+  L102 blocking under lock
+                         ``time.sleep``, ``subprocess``/``socket``/
+                         HTTP calls, provider API calls (``*.apis.*``),
+                         ``Thread.join`` and foreign ``.wait()`` made
+                         while a ``with <lock>`` block is open (waiting
+                         on the held condition itself is the legal
+                         cv pattern and exempt).
+  L103 shared-view mutation
+                         In-place mutation of an object obtained from a
+                         lister ``get``/``list``, ``by_index``,
+                         ``cache_get``/``cache_list`` call without an
+                         intervening ``deep_copy()`` in the same
+                         function (the informer read contract,
+                         kube/informers.py).
+  L104 cache discipline  (a) calls to ``*_locked`` methods outside a
+                         ``with <lock>`` block; (b) writes to the
+                         fleet-discovery state (``_s.fleet_index``,
+                         ``_s.discovery``, ``_s.gen``, ...) outside a
+                         lock; (c) gen-keyed singleflight reads
+                         (``*.reads.do``) whose key tuple carries no
+                         generation component.
+
+Waivers: ``# race: <reason>`` on the flagged line (the explicit,
+greppable spelling — use for contracts that are upheld non-lexically),
+or ``# noqa: L10x``.  Lock-ordering findings check both edge sites.
+
+A lock expression is any ``with`` context manager whose final name
+segment looks lock-ish (``lock``/``_lock``/``*_lock``/``cond``/
+``mutex``).  Identity is class-qualified for ``self.X`` (two classes'
+``self._lock`` never alias) and suffix-chained for shared-state locks
+(``self._s.lock`` is the same ``_s.lock`` node from any class).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_LOCKISH = re.compile(r"(?:^|_)(lock|cond|mutex|rlock)$")
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Fields of cloudprovider.aws.provider.FleetDiscoveryState whose every
+# read-modify-write must happen under the discovery lock (rule L104b).
+FLEET_FIELDS = {"fleet_index", "fleet_at", "fleet_epoch", "discovery",
+                "tags", "prime_log", "gen", "scans_inflight"}
+
+_MUTATING_METHODS = {"append", "extend", "insert", "remove", "pop",
+                     "popitem", "clear", "update", "setdefault", "sort",
+                     "reverse", "add", "discard"}
+
+# Calls that park the thread (or hit the network) — forbidden while any
+# lock is held (rule L102).
+_BLOCKING_ROOTS = {"subprocess", "socket", "requests"}
+
+# Informer read API: objects returned by these are shared views (L103).
+_VIEW_METHODS = {"by_index", "cache_get", "cache_list"}
+_LISTER_METHODS = {"get", "list"}
+
+
+class Finding:
+    def __init__(self, path, line: int, code: str, msg: str):
+        self.path, self.line, self.code, self.msg = path, line, code, msg
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.code} {self.msg}"
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """``self._s.reads.do`` -> ['self', '_s', 'reads', 'do']."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Base Name of an attribute/subscript chain (``svc.meta.x`` -> svc)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _FileInfo:
+    def __init__(self, path: Path, tree: ast.Module, source: str):
+        self.path = path
+        self.tree = tree
+        self.module = path.stem
+        self.waived = _waived_lines(source)
+        # (class or None, method name) -> set of lock ids the body
+        # acquires via ``with`` — the one-level call expansion for L101.
+        self.fn_acquires: Dict[Tuple[Optional[str], str], Set[str]] = {}
+
+
+def _waived_lines(source: str) -> Dict[int, Set[str]]:
+    """line -> waived codes; '' means every concurrency rule (the
+    ``# race: reason`` spelling), specific codes via ``# noqa: L10x``."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        if re.search(r"#\s*race:\s*\S", line):
+            out.setdefault(i, set()).add("")
+        m = re.search(r"#\s*noqa(?::\s*([A-Z0-9, ]+))?", line)
+        if m:
+            codes = m.group(1)
+            out.setdefault(i, set()).update(
+                {c.strip() for c in codes.split(",")} if codes else {""})
+    return out
+
+
+def _is_waived(info: _FileInfo, line: int, code: str) -> bool:
+    codes = info.waived.get(line)
+    return codes is not None and ("" in codes or code in codes)
+
+
+class _LockId:
+    """Stable cross-file identity for a lock expression."""
+
+    @staticmethod
+    def of(chain: List[str], classname: Optional[str],
+           module: str) -> str:
+        if chain[0] in ("self", "cls"):
+            if len(chain) == 2 and classname:
+                # self._cache_lock inside Informer -> Informer._cache_lock
+                return f"{classname}.{chain[1]}"
+            # self._s.lock -> _s.lock: the shared-state object's type is
+            # the identity, whatever class reaches through it
+            return ".".join(chain[1:])
+        # bare / module-level locks are file-scoped: two modules' `lock`
+        # must not alias into one graph node
+        return f"{module}:{'.'.join(chain)}"
+
+
+def _lock_exprs(item: ast.withitem, classname: Optional[str],
+                module: str) -> Optional[Tuple[str, List[str]]]:
+    chain = _attr_chain(item.context_expr)
+    if chain is None or not _LOCKISH.search(chain[-1]):
+        return None
+    return _LockId.of(chain, classname, module), chain
+
+
+class Engine:
+    """Two-phase whole-program pass: collect lock definitions and
+    per-method acquisition sets, then walk every function tracking the
+    lexically-held lockset, then check the global ordering graph."""
+
+    def __init__(self):
+        self.files: List[_FileInfo] = []
+        self.rlocks: Set[str] = set()
+        # (outer id, inner id) -> (info, line) of first occurrence
+        self.edges: Dict[Tuple[str, str], Tuple[_FileInfo, int]] = {}
+        self.findings: List[Finding] = []
+
+    # -- phase 1: definitions ------------------------------------------
+
+    def add_file(self, path: Path, source: str) -> None:
+        try:
+            tree = ast.parse(source, filename=str(path))
+        except SyntaxError as e:
+            self.findings.append(Finding(path, e.lineno or 0, "L100",
+                                         f"syntax error: {e.msg}"))
+            return
+        info = _FileInfo(path, tree, source)
+        self.files.append(info)
+        self._collect_defs(info)
+
+    def _collect_defs(self, info: _FileInfo) -> None:
+        for classname, fn in self._functions(info.tree):
+            acquires: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        got = _lock_exprs(item, classname, info.module)
+                        if got:
+                            acquires.add(got[0])
+            info.fn_acquires[(classname, fn.name)] = acquires
+        # RLock definitions: `<target> = threading.RLock()` (or the
+        # tracked factory `make_rlock(...)`) — re-acquiring these nested
+        # is legal, so L101's same-lock check skips them.
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            fchain = _attr_chain(call.func)
+            if fchain and fchain[-1] in ("RLock", "make_rlock"):
+                tchain = _attr_chain(node.targets[0])
+                if tchain:
+                    classname = self._enclosing_class(info.tree, node)
+                    self.rlocks.add(
+                        _LockId.of(tchain, classname, info.module))
+
+    @staticmethod
+    def _enclosing_class(tree: ast.Module, target: ast.AST
+                         ) -> Optional[str]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if sub is target:
+                        return node.name
+        return None
+
+    @staticmethod
+    def _functions(tree: ast.Module
+                   ) -> Iterable[Tuple[Optional[str], ast.AST]]:
+        """(enclosing class name, function) for every def in the file;
+        nested defs report the class of their outermost method."""
+        def visit(node, classname):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    yield from visit(child, child.name)
+                elif isinstance(child, _FUNCS):
+                    yield classname, child
+                    yield from visit(child, classname)
+                else:
+                    yield from visit(child, classname)
+        yield from visit(tree, None)
+
+    # -- phase 2: per-function walks -----------------------------------
+
+    def run(self) -> List[Finding]:
+        for info in self.files:
+            for classname, fn in self._functions(info.tree):
+                self._walk_held(info, classname, fn, fn.body, [])
+                self._check_shared_views(info, fn)
+        self._check_ordering_graph()
+        suppressed = [f for f in self.findings
+                      if not self._finding_waived(f)]
+        return suppressed
+
+    def raw_findings(self) -> List[Finding]:
+        """Findings before waiver filtering (the useless-noqa probe)."""
+        return list(self.findings)
+
+    def _finding_waived(self, f: Finding) -> bool:
+        for info in self.files:
+            if info.path == f.path:
+                return _is_waived(info, f.line, f.code)
+        return False
+
+    # .. held-lockset walk (L101, L102, L104) ..........................
+
+    def _walk_held(self, info, classname, fn, nodes, held) -> None:
+        """Recursive node-list walk carrying the lexically-held lockset
+        as (lock id, chain, line) triples.  Nested function bodies run
+        with a FRESH (empty) set — a closure defined under a lock does
+        not execute under it."""
+        for child in nodes:
+            if isinstance(child, _FUNCS + (ast.Lambda, ast.ClassDef)):
+                continue  # separate execution context, walked on its own
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in child.items:
+                    got = _lock_exprs(item, classname, info.module)
+                    if got is None:
+                        continue
+                    lock_id, chain = got
+                    self._note_acquire(info, fn, lock_id, held,
+                                       child.lineno)
+                    acquired.append((lock_id, chain, child.lineno))
+                self._walk_held(info, classname, fn, child.body,
+                                held + acquired)
+                continue
+            self._check_node(info, classname, fn, child, held)
+            self._walk_held(info, classname, fn,
+                            ast.iter_child_nodes(child), held)
+
+    def _note_acquire(self, info, fn, lock_id, held, line) -> None:
+        for held_id, _, held_line in held:
+            if held_id == lock_id:
+                if lock_id not in self.rlocks:
+                    self.findings.append(Finding(
+                        info.path, line, "L101",
+                        f"nested acquisition of non-reentrant lock "
+                        f"'{lock_id}' (already held since line "
+                        f"{held_line}) deadlocks"))
+                continue
+            key = (held_id, lock_id)
+            if key not in self.edges:
+                self.edges[key] = (info, line)
+
+    def _check_ordering_graph(self) -> None:
+        seen: Set[Tuple[str, str]] = set()
+        for (a, b), (info, line) in sorted(
+                self.edges.items(),
+                key=lambda kv: (str(kv[1][0].path), kv[1][1])):
+            if (b, a) not in self.edges or (b, a) in seen:
+                continue
+            seen.add((a, b))
+            rinfo, rline = self.edges[(b, a)]
+            if _is_waived(info, line, "L101") \
+                    or _is_waived(rinfo, rline, "L101"):
+                continue
+            self.findings.append(Finding(
+                info.path, line, "L101",
+                f"lock ordering inversion: '{a}' -> '{b}' here but "
+                f"'{b}' -> '{a}' at {rinfo.path}:{rline} — concurrent "
+                f"paths deadlock"))
+
+    def _check_node(self, info, classname, fn, node, held) -> None:
+        if isinstance(node, ast.Call):
+            self._check_call(info, classname, fn, node, held)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                self._check_fleet_write(info, fn, tgt, held)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                self._check_fleet_write(info, fn, tgt, held)
+
+    def _check_call(self, info, classname, fn, call, held) -> None:
+        chain = _attr_chain(call.func)
+        if chain is None:
+            return
+        line = call.lineno
+        # L104a: *_locked callees document "caller holds the lock";
+        # calling one with no lock lexically open is exactly the
+        # _update_accelerator stale-index bug shape from PR 1.
+        if chain[-1].endswith("_locked") and len(chain) > 1:
+            if not held and not fn.name.endswith("_locked"):
+                self.findings.append(Finding(
+                    info.path, line, "L104",
+                    f"'{chain[-1]}()' requires the caller to hold the "
+                    f"cache lock but no 'with <lock>:' block is open "
+                    f"here"))
+        # L104b: mutating-method writes through the fleet state
+        # (self._s.discovery.pop(...), self._s.prime_log.append(...)).
+        if (chain[-1] in _MUTATING_METHODS and len(chain) >= 3
+                and chain[-2] in FLEET_FIELDS and chain[-3] == "_s"):
+            self._require_lock(info, fn, line, held,
+                               f"_s.{chain[-2]}.{chain[-1]}()")
+        # L104c: gen-keyed singleflight reads.
+        if chain[-1] == "do" and len(chain) >= 2 and chain[-2] == "reads":
+            self._check_singleflight_key(info, call)
+        # L102: blocking while any lock is held.
+        if held and self._is_blocking(chain, held):
+            self.findings.append(Finding(
+                info.path, line, "L102",
+                f"blocking call '{'.'.join(chain)}' while holding "
+                f"'{held[-1][0]}' (held since line {held[-1][2]}) "
+                f"stalls every other thread needing the lock"))
+        # L101 one-level call expansion: self.method() whose body
+        # acquires locks counts as acquiring them here.
+        if (held and len(chain) == 2 and chain[0] in ("self", "cls")):
+            for lock_id in info.fn_acquires.get(
+                    (classname, chain[1]), ()):
+                self._note_acquire(info, fn, lock_id, held, line)
+
+    def _is_blocking(self, chain: List[str],
+                     held: List[Tuple[str, List[str], int]]) -> bool:
+        if chain == ["time", "sleep"]:
+            return True
+        if chain[0] in _BLOCKING_ROOTS:
+            return True
+        if chain[-1] == "urlopen":
+            return True
+        if "apis" in chain[:-1]:   # self.apis.ga.describe_accelerator(...)
+            return True
+        if chain[-1] in ("wait", "join") and len(chain) > 1:
+            # cv.wait() on the HELD condition releases it while parked —
+            # the one legal wait under a lock; anything else
+            # (Event.wait, Thread.join, a different lock) parks the
+            # thread with the lock still held.
+            target = chain[:-1]
+            return not any(target == hc for _, hc, _ in held)
+        return False
+
+    def _require_lock(self, info, fn, line, held, what) -> None:
+        if held or fn.name.endswith("_locked") or fn.name == "__init__":
+            return
+        self.findings.append(Finding(
+            info.path, line, "L104",
+            f"fleet-state write '{what}' outside a 'with <lock>:' "
+            f"block (the discovery cache's single-writer contract, "
+            f"provider.FleetDiscoveryState)"))
+
+    def _check_fleet_write(self, info, fn, tgt, held) -> None:
+        # self._s.<field> = ... / self._s.<field>[k] = ... / del ...
+        node = tgt
+        sub = ""
+        if isinstance(node, ast.Subscript):
+            sub = "[...]"
+            node = node.value
+        chain = _attr_chain(node)
+        if (chain and len(chain) >= 3 and chain[-2] == "_s"
+                and chain[-1] in FLEET_FIELDS):
+            self._require_lock(info, fn, tgt.lineno,
+                               held, f"_s.{chain[-1]}{sub}")
+
+    def _check_singleflight_key(self, info, call: ast.Call) -> None:
+        line = call.lineno
+        if not call.args:
+            return
+        key = call.args[0]
+        if not isinstance(key, ast.Tuple):
+            self.findings.append(Finding(
+                info.path, line, "L104",
+                "gen-keyed singleflight read: the key of a "
+                "'reads.do(...)' call must be a tuple carrying the "
+                "cache generation"))
+            return
+        for elt in key.elts:
+            chain = _attr_chain(elt)
+            if chain and "gen" in chain[-1]:
+                return
+        self.findings.append(Finding(
+            info.path, line, "L104",
+            "singleflight key lacks a generation component: a read "
+            "begun before an invalidation could be joined by a caller "
+            "arriving after it (key by the cache gen, see "
+            "provider._verified_read)"))
+
+    # .. shared-view taint (L103) ......................................
+
+    def _check_shared_views(self, info, fn) -> None:
+        if not isinstance(fn, _FUNCS):
+            return
+        # var -> (taint line, kind).  'view' = one shared object;
+        # 'viewlist' = a lister-returned LIST: the list container is
+        # caller-owned (informers hand out a fresh shallow list per
+        # call — sorting/filtering/appending it is legal), only the
+        # ELEMENTS are shared views.
+        tainted: Dict[str, Tuple[int, str]] = {}
+
+        def view_call_kind(node) -> Optional[str]:
+            if not isinstance(node, ast.Call):
+                return None
+            chain = _attr_chain(node.func)
+            if chain is None:
+                return None
+            if chain[-1] in ("by_index", "cache_list"):
+                return "viewlist"
+            if chain[-1] == "cache_get":
+                return "view"
+            if chain[-1] in _LISTER_METHODS \
+                    and any("lister" in seg for seg in chain[:-1]):
+                return "viewlist" if chain[-1] == "list" else "view"
+            return None
+
+        flagged: Set[Tuple[int, str]] = set()
+
+        def flag(node, var):
+            # compound statements are scanned once per nesting level;
+            # report each (line, var) once
+            if (node.lineno, var) in flagged:
+                return
+            flagged.add((node.lineno, var))
+            self.findings.append(Finding(
+                info.path, node.lineno, "L103",
+                f"in-place mutation of '{var}' (a shared informer-cache "
+                f"view from line {tainted[var][0]}): call .deep_copy() "
+                f"before mutating (kube/informers.py read contract)"))
+
+        def check_mutations(node):
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for tgt in targets:
+                        check_store_target(sub, tgt)
+                elif isinstance(sub, ast.Delete):
+                    for tgt in sub.targets:
+                        check_store_target(sub, tgt)
+                elif isinstance(sub, ast.Call):
+                    chain = _attr_chain(sub.func)
+                    if (chain and len(chain) > 1
+                            and chain[-1] in _MUTATING_METHODS
+                            and chain[0] in tainted):
+                        if (tainted[chain[0]][1] == "viewlist"
+                                and len(chain) == 2):
+                            continue   # xs.sort(): caller-owned list
+                        flag(sub, chain[0])
+
+        def check_store_target(stmt, tgt):
+            if not isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                return
+            root = _root_name(tgt)
+            if root not in tainted:
+                return
+            if (tainted[root][1] == "viewlist"
+                    and isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)):
+                return   # xs[0] = y: replacing an own-list slot
+            flag(stmt, root)
+
+        def process(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, _FUNCS + (ast.ClassDef, ast.Lambda)):
+                    continue   # separate scope, walked on its own
+                check_mutations(stmt)
+                # taint / untaint AFTER checking: `svc.x = 1` then
+                # `svc = svc.deep_copy()` still flags line 1
+                if isinstance(stmt, ast.Assign) \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    name = stmt.targets[0].id
+                    value = stmt.value
+                    kind = view_call_kind(value)
+                    if kind:
+                        tainted[name] = (stmt.lineno, kind)
+                    elif isinstance(value, ast.Call) and (
+                            chain := _attr_chain(value.func)) \
+                            and chain[-1] in ("deep_copy", "deepcopy"):
+                        tainted.pop(name, None)
+                    elif isinstance(value, (ast.Attribute, ast.Subscript)):
+                        root = _root_name(value)
+                        if root in tainted:
+                            # aliasing an element/field of a shared
+                            # view shares the view
+                            tainted[name] = (tainted[root][0], "view")
+                        else:
+                            tainted.pop(name, None)
+                    else:
+                        tainted.pop(name, None)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    it = stmt.iter
+                    iter_is_view = (
+                        view_call_kind(it) is not None
+                        or (isinstance(it, ast.Name) and it.id in tainted
+                            and tainted[it.id][1] == "viewlist"))
+                    if iter_is_view and isinstance(stmt.target, ast.Name):
+                        tainted[stmt.target.id] = (stmt.lineno, "view")
+                # recurse into compound statements in source order
+                for field in ("body", "orelse", "finalbody"):
+                    process(getattr(stmt, field, []) or [])
+                for handler in getattr(stmt, "handlers", []) or []:
+                    process(handler.body)
+
+        # comprehension variables over view calls (`for o in
+        # informer.by_index(...)`) are shared elements: seed them
+        # before the ordered pass so the mutation check sees them
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.ListComp, ast.SetComp,
+                                 ast.GeneratorExp, ast.DictComp)):
+                for gen in node.generators:
+                    if view_call_kind(gen.iter) \
+                            and isinstance(gen.target, ast.Name):
+                        tainted[gen.target.id] = (node.lineno, "view")
+        process(fn.body)
+
+
+def lint_files(files: Sequence[Path]) -> List[Finding]:
+    """Run the L1xx suite over a file set; returns waiver-filtered
+    findings sorted by (path, line)."""
+    engine = Engine()
+    for path in files:
+        engine.add_file(path, path.read_text())
+    findings = engine.run()
+    return sorted(findings, key=lambda f: (str(f.path), f.line, f.code))

@@ -1,0 +1,207 @@
+"""Freeze-proxy mode for informer-cache views (the runtime half of L103).
+
+The informer read contract (kube/informers.py): objects returned by
+``Lister.get`` / ``Lister.list`` / ``by_index`` are SHARED, READ-ONLY
+views of the cache — ``deep_copy()`` before mutating.  A violation
+corrupts every other reader silently and only surfaces as impossible
+reconcile behavior minutes later; this module makes it fail loudly at
+the mutation site, like client-go's cache mutation detector
+(``KUBE_CACHE_MUTATION_DETECTOR``).
+
+When enabled (test fixture ``enable()`` or ``AGAC_FREEZE_VIEWS=1``),
+listers wrap returned objects in :class:`FrozenView`: reads delegate
+(including ``isinstance`` via ``__class__``), ``deep_copy()`` thaws to
+a private mutable copy, and ANY in-place mutation — attribute store,
+``annotations['k'] = v``, ``finalizers.append(...)`` — raises
+:class:`SharedViewMutationError` reporting both the mutation site and
+the lister call that produced the view.  Each catch also counts into
+the ``shared_view_mutations_blocked`` metric.
+
+The origin is captured as raw frame triples at wrap time (micro-seconds,
+not a formatted traceback) so the proxies stay cheap enough to keep on
+for the whole e2e/stress/soak tier.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+from typing import Any, List, Tuple
+
+from ..metrics import record_shared_view_mutation_blocked
+
+_enabled = bool(os.environ.get("AGAC_FREEZE_VIEWS"))
+
+
+class SharedViewMutationError(RuntimeError):
+    """In-place mutation of a shared informer-cache view."""
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def view(obj: Any):
+    """Wrap one lister-returned object (identity when disabled)."""
+    if not _enabled or obj is None:
+        return obj
+    return FrozenView(obj, _origin())
+
+
+def view_list(objs: List[Any]) -> List[Any]:
+    """Wrap a lister-returned list; the list itself stays a plain
+    (caller-owned) list — only the shared elements are frozen."""
+    if not _enabled:
+        return objs
+    origin = _origin()
+    return [FrozenView(o, origin) if o is not None else o for o in objs]
+
+
+def _origin() -> Tuple[Tuple[str, int, str], ...]:
+    frames = []
+    f = sys._getframe(2)
+    while f is not None and len(frames) < 10:
+        frames.append((f.f_code.co_filename, f.f_lineno,
+                       f.f_code.co_name))
+        f = f.f_back
+    return tuple(frames)
+
+
+def _format_origin(origin) -> str:
+    return "".join(f"  File \"{fn}\", line {ln}, in {name}\n"
+                   for fn, ln, name in origin)
+
+
+def _blocked(origin, what: str):
+    record_shared_view_mutation_blocked()
+    raise SharedViewMutationError(
+        f"in-place mutation ({what}) of a shared informer-cache view — "
+        f"deep_copy() before mutating (kube/informers.py read "
+        f"contract)\n"
+        f"--- mutation site ---\n"
+        f"{''.join(traceback.format_stack(limit=12)[:-2])}"
+        f"--- view obtained from lister call ---\n"
+        f"{_format_origin(origin)}")
+
+
+def _wrap_value(value: Any, origin):
+    if isinstance(value, FrozenDict) or isinstance(value, FrozenList) \
+            or type(value) is FrozenView:
+        return value
+    if isinstance(value, dict):
+        return FrozenDict(value, origin)
+    if isinstance(value, list):
+        return FrozenList(value, origin)
+    if isinstance(value, tuple):
+        return tuple(_wrap_value(v, origin) for v in value)
+    if hasattr(value, "__dict__") and hasattr(value, "deep_copy") \
+            or hasattr(value, "__dataclass_fields__"):
+        return FrozenView(value, origin)
+    return value
+
+
+class FrozenView:
+    """Read-only proxy over one shared object.
+
+    ``isinstance`` sees the wrapped class (``__class__`` property),
+    reads return frozen sub-views, ``deep_copy()``/``to_dict()`` thaw
+    to private data, writes raise with both stacks."""
+
+    __slots__ = ("_fv_obj", "_fv_origin")
+
+    def __init__(self, obj: Any, origin):
+        object.__setattr__(self, "_fv_obj", obj)
+        object.__setattr__(self, "_fv_origin", origin)
+
+    @property  # type: ignore[misc]
+    def __class__(self):
+        return type(object.__getattribute__(self, "_fv_obj"))
+
+    def __getattr__(self, name: str):
+        obj = object.__getattribute__(self, "_fv_obj")
+        value = getattr(obj, name)
+        if callable(value) and not hasattr(value, "__dataclass_fields__"):
+            # bound methods of the real object: deep_copy/to_dict/key
+            # return fresh data, so handing them out unwrapped is the
+            # thaw path.  (A hypothetical self-mutating method would
+            # bypass the proxy; the static L103 pass covers that shape.)
+            return value
+        return _wrap_value(value,
+                           object.__getattribute__(self, "_fv_origin"))
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        _blocked(object.__getattribute__(self, "_fv_origin"),
+                 f"setattr .{name}")
+
+    def __delattr__(self, name: str) -> None:
+        _blocked(object.__getattribute__(self, "_fv_origin"),
+                 f"delattr .{name}")
+
+    def __repr__(self) -> str:
+        return repr(object.__getattribute__(self, "_fv_obj"))
+
+    def __eq__(self, other: Any) -> bool:
+        if type(other) is FrozenView:
+            other = object.__getattribute__(other, "_fv_obj")
+        return object.__getattribute__(self, "_fv_obj") == other
+
+    def __ne__(self, other: Any) -> bool:
+        return not self.__eq__(other)
+
+    def __hash__(self) -> int:
+        return hash(object.__getattribute__(self, "_fv_obj"))
+
+
+def _freeze_mutator(what: str):
+    def mutator(self, *args, **kwargs):
+        _blocked(self._origin, what)
+    return mutator
+
+
+class FrozenDict(dict):
+    """Frozen snapshot of a shared dict: still a ``dict`` for
+    isinstance/iteration/lookups, raises on every mutator."""
+
+    def __init__(self, data: dict, origin):
+        super().__init__({k: _wrap_value(v, origin)
+                          for k, v in data.items()})
+        self._origin = origin
+
+    __setitem__ = _freeze_mutator("dict setitem")
+    __delitem__ = _freeze_mutator("dict delitem")
+    update = _freeze_mutator("dict update")
+    pop = _freeze_mutator("dict pop")
+    popitem = _freeze_mutator("dict popitem")
+    clear = _freeze_mutator("dict clear")
+    setdefault = _freeze_mutator("dict setdefault")
+
+
+class FrozenList(list):
+    """Frozen snapshot of a shared list (see FrozenDict)."""
+
+    def __init__(self, data: list, origin):
+        super().__init__(_wrap_value(v, origin) for v in data)
+        self._origin = origin
+
+    __setitem__ = _freeze_mutator("list setitem")
+    __delitem__ = _freeze_mutator("list delitem")
+    __iadd__ = _freeze_mutator("list +=")
+    __imul__ = _freeze_mutator("list *=")
+    append = _freeze_mutator("list append")
+    extend = _freeze_mutator("list extend")
+    insert = _freeze_mutator("list insert")
+    pop = _freeze_mutator("list pop")
+    remove = _freeze_mutator("list remove")
+    clear = _freeze_mutator("list clear")
+    sort = _freeze_mutator("list sort")
+    reverse = _freeze_mutator("list reverse")

@@ -1,0 +1,176 @@
+"""Test-time lockset tracker (the runtime half of L101).
+
+Production code creates its locks through :func:`make_lock` /
+:func:`make_rlock`, which return plain ``threading`` primitives unless
+detection is enabled (``enable()`` from the test fixture, or the
+``AGAC_RACE_DETECT=1`` env flag at import).  When enabled, every
+acquisition is recorded against the thread's currently-held lockset and
+an edge ``held -> acquiring`` is added to a process-global lock-order
+graph; acquiring in the inverse order of a recorded edge raises
+:class:`LockOrderViolation` carrying BOTH acquisition stacks — the
+Go ``-race``-style "potential deadlock" report, surfaced on the first
+inverted acquisition rather than the eventual deadlock.
+
+Every acquisition also counts one lockset check, published through
+``metrics.record_lockset_checks`` in batches (the tracker must never
+take the metrics registry lock per acquisition — that lock would join
+the graph it is measuring).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+from ..metrics import record_lockset_checks
+
+_enabled = bool(os.environ.get("AGAC_RACE_DETECT"))
+_tls = threading.local()
+
+# (outer name, inner name) -> (thread id, formatted stack) of the first
+# acquisition that recorded the edge.
+_edges: dict = {}
+_graph_lock = threading.Lock()
+
+_pending = 0
+_FLUSH_EVERY = 1024
+
+
+class LockOrderViolation(RuntimeError):
+    """Two locks were acquired in both orders (a deadlock waiting for
+    the right interleaving).  Carries the stacks of both sites."""
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    flush_counters()
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    """Drop the recorded ordering graph (test isolation)."""
+    with _graph_lock:
+        _edges.clear()
+
+
+def make_lock(name: str):
+    """A named lock: plain ``threading.Lock`` in production, tracked
+    when race detection is on (decided at creation time)."""
+    return TrackedLock(name) if _enabled else threading.Lock()
+
+
+def make_rlock(name: str):
+    return TrackedLock(name, reentrant=True) if _enabled \
+        else threading.RLock()
+
+
+def flush_counters(registry=None) -> None:
+    """Publish any batched lockset-check counts to ``registry`` (the
+    default metrics registry when None)."""
+    global _pending
+    n, _pending = _pending, 0
+    if n:
+        record_lockset_checks(n, registry=registry)
+
+
+def _held() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _stack() -> str:
+    return "".join(traceback.format_stack(limit=16)[:-2])
+
+
+class TrackedLock:
+    """Lock wrapper recording per-thread acquisition order.
+
+    Also usable as the lock of a ``threading.Condition``: the
+    condition's wait() releases and re-acquires through ``release`` /
+    ``acquire``, so the held-set bookkeeping stays correct while a
+    worker is parked."""
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = name
+        self._reentrant = reentrant
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            try:
+                self._note_acquired()
+            except BaseException:
+                self._inner.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+        self._inner.release()
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def _note_acquired(self) -> None:
+        global _pending
+        held = _held()
+        _pending += 1
+        if _pending >= _FLUSH_EVERY:
+            flush_counters()
+        if self._reentrant and any(h is self for h in held):
+            held.append(self)   # re-entry: no new ordering information
+            return
+        tid = threading.get_ident()
+        for h in held:
+            if h is self or h.name == self.name:
+                continue
+            key = (h.name, self.name)
+            with _graph_lock:
+                if key not in _edges:
+                    inverse = _edges.get((self.name, h.name))
+                    if inverse is not None:
+                        # acquire() releases the inner lock on raise and
+                        # the entry was never appended, so the held set
+                        # stays consistent
+                        other_tid, other_stack = inverse
+                        raise LockOrderViolation(
+                            f"lock ordering inversion: thread {tid} "
+                            f"acquired '{self.name}' while holding "
+                            f"'{h.name}', but thread {other_tid} "
+                            f"acquired '{h.name}' while holding "
+                            f"'{self.name}'\n"
+                            f"--- this acquisition ---\n{_stack()}"
+                            f"--- prior inverse acquisition ---\n"
+                            f"{other_stack}")
+                    _edges[key] = (tid, _stack())
+        held.append(self)
+
+    # Condition-lock protocol: threading.Condition prefers these over
+    # its acquire/release fallbacks when present.
+    def _is_owned(self) -> bool:
+        if self._reentrant:
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True

@@ -8,4 +8,4 @@ controllers drive.  The reference instead holds concrete SDK clients in a
 struct (pkg/cloudprovider/aws/aws.go:12-38), which makes its AWS logic
 untestable without live AWS -- the interface + fake closes that gap.
 """
-from .hostname import get_lb_name_from_hostname, get_region_from_arn  # noqa: F401
+from .hostname import get_lb_name_from_hostname, get_region_from_arn

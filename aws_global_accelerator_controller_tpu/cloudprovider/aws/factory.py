@@ -10,9 +10,9 @@ makes the controller logic testable against the fake cloud.
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict
 
+from ...analysis import locks
 from .api import AWSAPIs
 from .fake import FakeAWSCloud
 from .provider import AWSProvider, FleetDiscoveryState
@@ -29,7 +29,7 @@ class CloudFactory:
                  delete_poll_timeout: float = 180.0,
                  accelerator_not_found_retry: float = 60.0):
         self._providers: Dict[str, AWSProvider] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("cloud-factory")
         self._poll_interval = delete_poll_interval
         self._poll_timeout = delete_poll_timeout
         self._not_found_retry = accelerator_not_found_retry

@@ -20,7 +20,6 @@ SURVEY.md §7 "Deliberate improvements"):
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -35,6 +34,7 @@ from ...errors import (
 )
 from ...kube.objects import Ingress, LoadBalancerIngress, Service
 
+from ...analysis import locks
 from ...metrics import record_coalesced_read, record_fleet_scan
 from .api import AWSAPIs
 from .singleflight import Singleflight
@@ -152,7 +152,7 @@ class FleetDiscoveryState:
     """
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = locks.make_lock("fleet-discovery")
         self.gen = 0
         # frozenset(target tag items) -> (arn, cached_at monotonic)
         self.discovery: dict = {}
@@ -448,9 +448,17 @@ class AWSProvider:
         """The fleet index can no longer claim completeness (a delete,
         re-tag, or verify-failure happened); the epoch bump also stops
         any in-flight scan from installing its now-partial snapshot.
-        Caller holds ``_cache_lock``."""
+        Caller holds ``_cache_lock``.
+
+        The gen bump keeps the class docstring's contract ("bumped by
+        every invalidation") literal: a rescue scan requested AFTER the
+        lie was observed must not singleflight-join a fresh sweep that
+        began BEFORE it (same gen key) and be handed pre-invalidation
+        tag data — that join would re-match the disproved accelerator
+        and re-prime the evicted discovery entry for another TTL."""
         self._s.fleet_at = None
         self._s.fleet_epoch += 1
+        self._s.gen += 1
 
     def _prime_discovery_cache(self, arn: str, *targets: dict) -> None:
         """Record a just-created accelerator so the next syncs skip the

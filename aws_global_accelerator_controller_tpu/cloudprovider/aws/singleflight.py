@@ -16,6 +16,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Hashable, Optional
 
+from ...analysis import locks
+
 
 class _Call:
     __slots__ = ("done", "result", "exc")
@@ -36,7 +38,7 @@ class Singleflight:
 
     def __init__(self,
                  on_coalesce: Optional[Callable[[Hashable], None]] = None):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("singleflight-group")
         self._calls: Dict[Hashable, _Call] = {}
         self._on_coalesce = on_coalesce
 

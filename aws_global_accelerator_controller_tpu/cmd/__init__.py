@@ -1,2 +1,2 @@
 """CLI process entry (reference main.go + cmd/)."""
-from .root import main  # noqa: F401
+from .root import main

@@ -264,7 +264,7 @@ class GlobalAcceleratorController:
             o.key()
             for informer in (self.service_informer, self.ingress_informer)
             for o in informer.by_index(LB_DNS_INDEX, hostname)
-            if (o.key() != obj.key() or type(o) is not type(obj))
+            if (o.key() != obj.key() or o.kind != obj.kind)
             and self._has_managed(o)]
         if others:
             logger.warning(

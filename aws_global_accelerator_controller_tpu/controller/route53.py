@@ -240,7 +240,7 @@ class Route53Controller:
                                  self.ingress_informer)
                 for o in informer.by_index(ROUTE53_HOSTNAME_INDEX,
                                            hostname)
-                if o.key() != obj.key() or type(o) is not type(obj)]
+                if o.key() != obj.key() or o.kind != obj.kind]
             if others:
                 logger.error(
                     "%s %s contests route53 hostname %s with %s — the "

@@ -242,7 +242,7 @@ class ReloadingModelWeightPolicy:
                 return False
             fresh = ModelWeightPolicy.from_checkpoint(
                 self._directory, hidden_dim=self._hidden_dim)
-        except Exception as exc:  # noqa: BLE001 — serve-old-on-error
+        except Exception as exc:  # serve-old-on-error
             logger.warning(
                 "policy reload from %s failed (serving step %d "
                 "weights unchanged): %s", self._directory,

@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional
 
+from ..analysis import freezeproxy, locks
 from ..errors import NotFoundError
 from ..metrics import record_index_lookup
 from .apiserver import (
@@ -83,10 +84,10 @@ class Lister:
         obj = self._informer.cache_get(f"{namespace}/{name}")
         if obj is None:
             raise NotFoundError(self._informer.kind, f"{namespace}/{name}")
-        return obj
+        return freezeproxy.view(obj)
 
     def list(self, namespace: Optional[str] = None) -> List[KubeObject]:
-        return self._informer.cache_list(namespace)
+        return freezeproxy.view_list(self._informer.cache_list(namespace))
 
 
 class Informer:
@@ -95,7 +96,7 @@ class Informer:
         self._store = store
         self._resync_period = resync_period
         self._cache: Dict[str, KubeObject] = {}
-        self._cache_lock = threading.RLock()
+        self._cache_lock = locks.make_rlock(f"informer-cache[{self.kind}]")
         # index name -> index fn; index name -> value -> {key: obj}.
         # Buckets hold the cached objects themselves so by_index never
         # re-walks the cache; all mutation happens under _cache_lock.
@@ -169,7 +170,7 @@ class Informer:
             bucket = self._indices[name].get(value)
             objs = list(bucket.values()) if bucket else []
         record_index_lookup(self.kind, name, hit=bool(objs))
-        return objs
+        return freezeproxy.view_list(objs)
 
     def _apply_locked(self, key: str, obj: Optional[KubeObject]) -> None:
         """Install (or, with obj=None, remove) one cache entry and keep
@@ -298,7 +299,7 @@ class SharedInformerFactory:
         self._api = api
         self._resync = resync_period
         self._informers: Dict[str, Informer] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("informer-factory")
         self._started_stop: Optional[threading.Event] = None
 
     def informer_for(self, kind: str) -> Informer:

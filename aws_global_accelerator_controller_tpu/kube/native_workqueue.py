@@ -18,11 +18,16 @@ import ctypes
 import threading
 from typing import Any, Optional, Tuple
 
+from ..analysis import locks
 from ..native import ensure_library
 
 _lib = None
 _fast_lib = None
-_lib_lock = threading.Lock()
+# the build/bind critical section; created through the tracked-lock
+# factory so a race-detecting test session sees it in the lock graph
+# (the native queue's own mutex lives in C++ and is never held across
+# a wait — see the PyDLL rationale in load())
+_lib_lock = locks.make_lock("native-workqueue-lib")
 _lib_failed = False
 
 

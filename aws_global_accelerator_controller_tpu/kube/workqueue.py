@@ -21,6 +21,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..analysis import locks
+
 
 class ItemExponentialFailureRateLimiter:
     """Per-item exponential backoff: base * 2^failures, capped.
@@ -32,7 +34,7 @@ class ItemExponentialFailureRateLimiter:
         self.base_delay = base_delay
         self.max_delay = max_delay
         self._failures: Dict[Any, int] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ratelimiter-item")
 
     def when(self, item: Any) -> float:
         with self._lock:
@@ -58,7 +60,7 @@ class BucketRateLimiter:
         self.burst = burst
         self._tokens = float(burst)
         self._last = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ratelimiter-bucket")
 
     def when(self, item: Any) -> float:
         with self._lock:
@@ -151,7 +153,8 @@ class RateLimitingQueue:
     def __init__(self, rate_limiter=None, name: str = ""):
         self.name = name
         self._rate_limiter = rate_limiter or default_controller_rate_limiter()
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(
+            locks.make_lock(f"workqueue[{name}]"))
         self._queue: deque = deque()
         self._dirty: set = set()
         self._processing: set = set()

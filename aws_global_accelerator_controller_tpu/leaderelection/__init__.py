@@ -10,4 +10,4 @@ Active/standby replica coordination over a coordination/v1 Lease object:
   reference calls os.Exit(0) there -- the CLI wires that, the library
   does not).
 """
-from .elector import LeaderElection  # noqa: F401
+from .elector import LeaderElection

@@ -1,5 +1,5 @@
 """Manager: controller registry + lifecycle (reference pkg/manager/)."""
-from .manager import (  # noqa: F401
+from .manager import (
     ControllerConfig,
     Manager,
     new_controller_initializers,

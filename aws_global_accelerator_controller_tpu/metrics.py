@@ -154,6 +154,16 @@ default_registry.describe(
     "policy_reloads_total",
     "Hot reloads of the trained weight-policy checkpoint, by outcome "
     "(ok / error — error keeps serving the previous weights).")
+default_registry.describe(
+    "race_lockset_checks",
+    "Lock acquisitions screened by the runtime lockset tracker "
+    "(analysis/locks.py) — nonzero proves the detector was armed.")
+default_registry.describe(
+    "shared_view_mutations_blocked",
+    "In-place mutations of shared informer-cache views caught by the "
+    "freeze proxy (analysis/freezeproxy.py); each one is a "
+    "deep_copy-before-mutate contract violation that would otherwise "
+    "corrupt every reader of the cache.")
 
 
 def record_watch_event(kind: str, event: str,
@@ -188,6 +198,23 @@ def record_coalesced_read(op: str,
 def record_fleet_scan(registry: Optional[Registry] = None) -> None:
     reg = registry or default_registry
     reg.inc_counter("provider_fleet_scans_total", {})
+
+
+def record_lockset_checks(n: int = 1,
+                          registry: Optional[Registry] = None) -> None:
+    """``n`` lock acquisitions passed through the lockset tracker
+    (batched by the tracker — it must not take the registry lock per
+    acquisition)."""
+    reg = registry or default_registry
+    reg.inc_counter("race_lockset_checks", {}, float(n))
+
+
+def record_shared_view_mutation_blocked(
+        registry: Optional[Registry] = None) -> None:
+    """The freeze proxy caught an in-place mutation of a shared
+    informer-cache view."""
+    reg = registry or default_registry
+    reg.inc_counter("shared_view_mutations_blocked", {})
 
 
 def record_exec_credential_run(outcome: str,
@@ -263,6 +290,13 @@ class HealthServer:
                     else:
                         self._respond(200, "ok")
                 elif self.path == "/metrics":
+                    # the lockset tracker batches its check counter
+                    # (it must not take the registry lock per lock
+                    # acquisition); flush INTO THE SERVED REGISTRY at
+                    # scrape so the series is current.  Lazy import:
+                    # analysis.locks imports this module at load time.
+                    from .analysis import locks
+                    locks.flush_counters(outer.registry)
                     self._respond(200, outer.registry.render(),
                                   "text/plain; version=0.0.4")
                 elif urlparse(self.path).path == "/traces":

@@ -1,7 +1,7 @@
 """Models: the TPU-native traffic-policy track (no reference analogue --
 SURVEY.md §2 records the reference as 100% Go with zero ML components)."""
-from .checkpoint import TrainCheckpointer  # noqa: F401
-from .deep import DeepTrafficModel  # noqa: F401
-from .moe import MoETrafficModel  # noqa: F401
-from .temporal import TemporalTrafficModel  # noqa: F401
-from .traffic import TrafficPolicyModel  # noqa: F401
+from .checkpoint import TrainCheckpointer
+from .deep import DeepTrafficModel
+from .moe import MoETrafficModel
+from .temporal import TemporalTrafficModel
+from .traffic import TrafficPolicyModel

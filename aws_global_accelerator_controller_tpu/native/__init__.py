@@ -58,8 +58,11 @@ def ensure_library(stem: str) -> Optional[str]:
             os.close(fd)
             cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
                    "-pthread", src, "-o", tmp]
-            proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=120)
+            # the whole point of _build_lock is to serialize the
+            # (rare, startup-only) g++ build; every other caller
+            # SHOULD block here rather than race the compiler
+            proc = subprocess.run(  # race: build-once
+                cmd, capture_output=True, text=True, timeout=120)
             if proc.returncode != 0:
                 logger.warning("native build of %s failed:\n%s", stem,
                                proc.stderr[-2000:])

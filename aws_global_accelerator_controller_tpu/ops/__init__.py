@@ -8,5 +8,5 @@ kernels so that fleets of endpoint groups can be planned in one XLA
 program (used by ``models.traffic``, ``parallel.plan``, ``bench.py``, and
 ``__graft_entry__.py``).
 """
-from .weights import plan_weights, masked_softmax  # noqa: F401
-from .diff import membership_diff  # noqa: F401
+from .weights import plan_weights, masked_softmax
+from .diff import membership_diff

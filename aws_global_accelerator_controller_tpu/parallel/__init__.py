@@ -6,33 +6,33 @@ the TPU-native scale-out path for the compute track: jax.sharding Meshes
 with data x model axes, NamedSharding-annotated pjit programs, and XLA
 collectives over ICI inserted by the compiler.
 """
-from .distributed import (  # noqa: F401
+from .distributed import (
     initialize_multihost,
     make_hybrid_mesh,
 )
-from .experts import (  # noqa: F401
+from .experts import (
     expert_scores_reference,
     init_expert_params,
     make_expert_planner,
 )
-from .fleet import FleetPlanner  # noqa: F401
-from .mesh import make_mesh  # noqa: F401
-from .moe import ShardedMoEPlanner, moe_param_specs  # noqa: F401
-from .pipeline import (  # noqa: F401
+from .fleet import FleetPlanner
+from .mesh import make_mesh
+from .moe import ShardedMoEPlanner, moe_param_specs
+from .pipeline import (
     init_pipeline_params,
     make_pipeline,
     pipeline_reference,
 )
-from .pipeline_train import (  # noqa: F401
+from .pipeline_train import (
     ShardedPipelinePlanner,
     deep_param_specs,
 )
-from .plan import (  # noqa: F401
+from .plan import (
     ShardedTemporalPlanner,
     ShardedTrafficPlanner,
 )
-from .ring import ewma_reference, make_mesh_1d, make_ring_ewma  # noqa: F401
-from .ring_attention import (  # noqa: F401
+from .ring import ewma_reference, make_mesh_1d, make_ring_ewma
+from .ring_attention import (
     attention_reference,
     inverse_zigzag_indices,
     make_last_attention,

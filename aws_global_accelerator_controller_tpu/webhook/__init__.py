@@ -1,3 +1,3 @@
 """Validating admission webhook (reference pkg/webhoook/ -- sic)."""
-from .server import WebhookServer  # noqa: F401
-from .validator import validate_endpoint_group_binding  # noqa: F401
+from .server import WebhookServer
+from .validator import validate_endpoint_group_binding

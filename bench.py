@@ -451,7 +451,7 @@ def bench_temporal_train(t: int = 2048, g: int = 8, e: int = 16,
         chunked_ms = round(_marginal_s(
             np, chained_for(model_chunked, batch),
             (params, opt_flat), n) * 1e3, 3)
-    except Exception as exc:  # noqa: BLE001 — report, keep the leg
+    except Exception as exc:  # report, keep the leg
         chunked_err = f"{type(exc).__name__}: {str(exc)[:160]}"
 
     s = g * e
@@ -741,7 +741,7 @@ def autotune_flash_blocks(t: int = 2048, h: int = 8, d: int = 128,
             f1, fn = chained(c, 1), chained(c, n)
             np.asarray(f1(q)), np.asarray(fn(q))    # compile + warm
             compiled[c] = (f1, fn)
-        except Exception as exc:  # noqa: BLE001 — record, keep sweeping
+        except Exception as exc:  # record, keep sweeping
             failed[c] = str(exc)[-200:]
     best = {c: float("inf") for c in compiled}
     for _ in range(rounds):
@@ -778,7 +778,7 @@ def autotune_flash_blocks(t: int = 2048, h: int = 8, d: int = 128,
             g1, gn = chained_grad(c, 1), chained_grad(c, n_grad)
             np.asarray(g1(q)), np.asarray(gn(q))    # compile + warm
             grad_compiled[c] = (g1, gn)
-        except Exception as exc:  # noqa: BLE001 — record, keep going
+        except Exception as exc:  # record, keep going
             failed[c] = f"grad: {str(exc)[-200:]}"
     grad_best = {c: float("inf") for c in grad_compiled}
     for _ in range(rounds):
@@ -942,7 +942,7 @@ def bench_smoke() -> dict:
         try:
             thunk()
             compiled[name] = round(time.perf_counter() - start, 2)
-        except Exception as exc:  # noqa: BLE001 — report, don't abort
+        except Exception as exc:  # report, don't abort
             failures[name] = f"{type(exc).__name__}: {str(exc)[:300]}"
 
     return {

@@ -20,10 +20,21 @@ legitimate code):
   L005 f-string without placeholders (format-spec f-strings exempt)
   L006 redefined name       (decorator-less def/class defined twice in
                              one scope — property pairs stay legal)
+  L007 useless noqa         (a ``# noqa: <code>`` naming a code this
+                             suite knows — L00x or a pyflakes-era alias
+                             — on a line where that rule does not fire;
+                             codes the suite does not implement, e.g.
+                             E402/E501, are left alone)
 
 Suppress a line with ``# noqa`` or ``# noqa: L00X``.
 
-Usage: python hack/lint.py [paths...]   (default: the repo's source)
+The concurrency contract rules (L101-L104, see
+aws_global_accelerator_controller_tpu/analysis/concurrency_lint.py) run
+with ``--concurrency`` (only them) or ``--all`` (both passes — what
+``make lint`` runs).  ``tests/lint_fixtures/`` holds deliberately
+violating rule fixtures and is excluded from tree runs.
+
+Usage: python hack/lint.py [--concurrency|--all] [paths...]
 Exit 0 clean, 1 findings, 2 crashed-on-file.
 """
 from __future__ import annotations
@@ -106,7 +117,7 @@ def _loads_and_strings(tree: ast.AST) -> set:
     return used
 
 
-def _unused_imports(tree, path, noqa, findings, is_init):
+def _unused_imports(tree, path, findings, is_init):
     if is_init:
         # __init__.py imports are the package's public re-export
         # surface; "unused" is their job
@@ -131,13 +142,12 @@ def _unused_imports(tree, path, noqa, findings, is_init):
                 # import-cycle/lazy-init reasons and the subtree scan
                 # above already counted module-wide loads
                 continue
-            if not _suppressed(noqa, node.lineno, "L001"):
-                findings.append(_Finding(
-                    path, node.lineno, "L001",
-                    f"'{target}' imported but unused"))
+            findings.append(_Finding(
+                path, node.lineno, "L001",
+                f"'{target}' imported but unused"))
 
 
-def _unused_locals(tree, path, noqa, findings):
+def _unused_locals(tree, path, findings):
     for fn in ast.walk(tree):
         if not isinstance(fn, _FUNCS):
             continue
@@ -165,11 +175,9 @@ def _unused_locals(tree, path, noqa, findings):
                 continue
             if tgt.id in used:
                 continue
-            if not _suppressed(noqa, node.lineno, "L002"):
-                findings.append(_Finding(
-                    path, node.lineno, "L002",
-                    f"local variable '{tgt.id}' assigned but never "
-                    f"used"))
+            findings.append(_Finding(
+                path, node.lineno, "L002",
+                f"local variable '{tgt.id}' assigned but never used"))
 
 
 def _format_spec_ids(tree) -> set:
@@ -184,15 +192,14 @@ def _format_spec_ids(tree) -> set:
     return specs
 
 
-def _ast_findings(tree, path, noqa, findings):
+def _ast_findings(tree, path, findings):
     specs = _format_spec_ids(tree)
     for node in ast.walk(tree):
         if isinstance(node, ast.ExceptHandler) and node.type is None:
-            if not _suppressed(noqa, node.lineno, "L003"):
-                findings.append(_Finding(
-                    path, node.lineno, "L003",
-                    "bare 'except:' (catches SystemExit/"
-                    "KeyboardInterrupt; use 'except Exception:')"))
+            findings.append(_Finding(
+                path, node.lineno, "L003",
+                "bare 'except:' (catches SystemExit/"
+                "KeyboardInterrupt; use 'except Exception:')"))
         elif isinstance(node, _SCOPES):
             for default in (node.args.defaults
                             + [d for d in node.args.kw_defaults if d]):
@@ -202,7 +209,7 @@ def _ast_findings(tree, path, noqa, findings):
                            and default.func.id in _BUILTIN_MUTABLES
                            and not default.args
                            and not default.keywords))
-                if bad and not _suppressed(noqa, default.lineno, "L004"):
+                if bad:
                     name = getattr(node, "name", "<lambda>")
                     findings.append(_Finding(
                         path, default.lineno, "L004",
@@ -210,23 +217,67 @@ def _ast_findings(tree, path, noqa, findings):
         elif isinstance(node, ast.JoinedStr) and id(node) not in specs:
             if not any(isinstance(v, ast.FormattedValue)
                        for v in node.values):
-                if not _suppressed(noqa, node.lineno, "L005"):
-                    findings.append(_Finding(
-                        path, node.lineno, "L005",
-                        "f-string without placeholders"))
+                findings.append(_Finding(
+                    path, node.lineno, "L005",
+                    "f-string without placeholders"))
         if isinstance(node, (ast.Module, ast.ClassDef) + _FUNCS):
             seen: dict = {}
             for stmt in getattr(node, "body", []):
                 if isinstance(stmt, _FUNCS + (ast.ClassDef,)) \
                         and not stmt.decorator_list:
-                    if stmt.name in seen \
-                            and not _suppressed(noqa, stmt.lineno,
-                                                "L006"):
+                    if stmt.name in seen:
                         findings.append(_Finding(
                             path, stmt.lineno, "L006",
                             f"'{stmt.name}' redefined (first defined "
                             f"line {seen[stmt.name]})"))
                     seen.setdefault(stmt.name, stmt.lineno)
+
+
+# code -> the rule it suppresses (the L007 probe direction)
+_REVERSE_ALIASES: dict = {}
+for _rule, _codes in _CODE_ALIASES.items():
+    for _c in _codes:
+        _REVERSE_ALIASES[_c] = _rule
+for _rule in ("L001", "L002", "L003", "L004", "L005", "L006"):
+    _REVERSE_ALIASES.setdefault(_rule, _rule)
+
+
+def _string_noqa_lines(tree) -> set:
+    """Lines where a '# noqa' match is (or may be) inside a string
+    constant — docstrings quoting noqa syntax, lint-test fixture
+    snippets.  L007 must not demand deletion of text that is data."""
+    lines: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            end = getattr(node, "end_lineno", node.lineno)
+            if end > node.lineno:
+                lines.update(range(node.lineno, end + 1))
+            elif "noqa" in node.value:
+                lines.add(node.lineno)
+    return lines
+
+
+def _useless_noqa(path, noqa, raw, string_lines) -> list:
+    """L007: every EXPLICIT noqa code this suite implements must still
+    be earning its keep — a ``# noqa: F401`` on a line rule L001 no
+    longer fires on is stale pyflakes-era residue that would silently
+    mask a future real finding.  Blanket ``# noqa`` and codes of
+    linters this suite does not implement (E402, E501, ...) are left
+    alone."""
+    fired = {(f.line, f.code) for f in raw}
+    out = []
+    for line, codes in sorted(noqa.items()):
+        if "" in codes or line in string_lines:
+            continue
+        for code in sorted(codes):
+            rule = _REVERSE_ALIASES.get(code)
+            if rule is None or (line, rule) in fired:
+                continue
+            out.append(_Finding(
+                path, line, "L007",
+                f"useless noqa: rule {rule} ('{code}') does not fire "
+                f"on this line — delete the suppression"))
+    return out
 
 
 def lint_file(path: Path) -> list:
@@ -237,16 +288,45 @@ def lint_file(path: Path) -> list:
         return [_Finding(path, e.lineno or 0, "L000",
                          f"syntax error: {e.msg}")]
     noqa = _noqa_lines(source)
-    findings: list = []
-    _unused_imports(tree, path, noqa, findings,
+    raw: list = []
+    _unused_imports(tree, path, raw,
                     is_init=path.name == "__init__.py")
-    _unused_locals(tree, path, noqa, findings)
-    _ast_findings(tree, path, noqa, findings)
+    _unused_locals(tree, path, raw)
+    _ast_findings(tree, path, raw)
+    findings = [f for f in raw
+                if not _suppressed(noqa, f.line, f.code)]
+    findings.extend(
+        f for f in _useless_noqa(path, noqa, raw,
+                                 _string_noqa_lines(tree))
+        if not _suppressed(noqa, f.line, "L007"))
     return findings
 
 
+def _concurrency_findings(files) -> list:
+    # the engine lives inside the package so the runtime detectors and
+    # tests share it; keep hack/ import-light by pathing to the repo
+    sys.path.insert(0, str(REPO))
+    from aws_global_accelerator_controller_tpu.analysis import (
+        concurrency_lint,
+    )
+    return concurrency_lint.lint_files(files)
+
+
 def main(argv) -> int:
-    paths = argv[1:] or [str(REPO / p) for p in DEFAULT_PATHS]
+    args = list(argv[1:])
+    concurrency_only = "--concurrency" in args
+    run_all = "--all" in args
+    unknown = [a for a in args if a.startswith("--")
+               and a not in ("--concurrency", "--all")]
+    if unknown:
+        # a typo'd flag silently running only the base pass would
+        # green-light unchecked code (same failure class as the
+        # mistyped-path guard below)
+        print(f"lint: unknown option(s): {' '.join(unknown)}",
+              file=sys.stderr)
+        return 2
+    paths = [a for a in args if not a.startswith("--")] \
+        or [str(REPO / p) for p in DEFAULT_PATHS]
     files: list = []
     for p in paths:
         pth = Path(p)
@@ -260,14 +340,24 @@ def main(argv) -> int:
             print(f"lint: no such file or directory: {p}",
                   file=sys.stderr)
             return 2
+    # __pycache__ is noise; lint_fixtures are DELIBERATE violations
+    # (the rule test corpus, tests/test_lint.py)
+    files = [f for f in files
+             if "__pycache__" not in f.parts
+             and "lint_fixtures" not in f.parts]
     findings: list = []
-    for f in files:
-        if "__pycache__" in f.parts:
-            continue
+    if not concurrency_only:
+        for f in files:
+            try:
+                findings.extend(lint_file(f))
+            except Exception as exc:
+                print(f"{f}: linter crashed: {exc!r}", file=sys.stderr)
+                return 2
+    if concurrency_only or run_all:
         try:
-            findings.extend(lint_file(f))
+            findings.extend(_concurrency_findings(files))
         except Exception as exc:
-            print(f"{f}: linter crashed: {exc!r}", file=sys.stderr)
+            print(f"concurrency lint crashed: {exc!r}", file=sys.stderr)
             return 2
     for finding in sorted(findings, key=lambda x: (str(x.path), x.line)):
         print(finding)

@@ -24,6 +24,50 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture
+def race_detectors():
+    """Arm BOTH runtime concurrency detectors for one test: the lockset
+    tracker (locks created via analysis.locks.make_lock/make_rlock are
+    instrumented; inverted acquisition order raises with both stacks)
+    and the freeze proxy (lister-returned views raise on in-place
+    mutation).  Soak/stress opt in explicitly; e2e suites get it
+    automatically below — so the races those suites used to surface as
+    flakes fail loudly at the violation site instead."""
+    from aws_global_accelerator_controller_tpu.analysis import (
+        freezeproxy,
+        locks,
+    )
+    locks.reset()
+    was_locks, was_views = locks.enabled(), freezeproxy.enabled()
+    locks.enable()
+    freezeproxy.enable()
+    yield
+    # restore (not force-off): AGAC_RACE_DETECT=1 / AGAC_FREEZE_VIEWS=1
+    # arm the detectors for the WHOLE process — the first fixture
+    # teardown must not silently disarm the rest of the session
+    locks.flush_counters()
+    if not was_locks:
+        locks.disable()
+    if not was_views:
+        freezeproxy.disable()
+
+
+@pytest.fixture(autouse=True)
+def _race_detectors_for_e2e(request):
+    """Every e2e module runs under the runtime detectors (the tier-1
+    wiring the static pass cannot replace: it proves the contracts hold
+    on the real interleavings, not just lexically).  Delegates to the
+    race_detectors fixture so arm/reset/restore stay in one place —
+    the per-test reset matters because lock-order edges are keyed by
+    lock NAME and would otherwise accumulate across unrelated tests'
+    object graphs."""
+    module = getattr(request.node, "module", None)
+    name = getattr(module, "__name__", "")
+    if name.startswith("test_e2e_"):
+        request.getfixturevalue("race_detectors")
+    yield
+
+
 @pytest.fixture(scope="session")
 def tls_files(tmp_path_factory):
     """Self-signed localhost cert + key, shared by every TLS tier

@@ -1,0 +1,27 @@
+"""L101 non-firing: consistent ordering + legal RLock re-entry."""
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+class Store:
+    def __init__(self):
+        self._cache_lock = threading.RLock()
+
+    def outer(self, items):
+        with self._cache_lock:
+            with self._cache_lock:   # RLock: re-entry is legal
+                items.append(0)
+
+
+def worker_one(items):
+    with a_lock:
+        with b_lock:
+            items.append(1)
+
+
+def worker_two(items):
+    with a_lock:
+        with b_lock:
+            items.append(2)

@@ -1,0 +1,17 @@
+"""L101 firing: the two locks are taken in both orders."""
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def worker_one(items):
+    with a_lock:
+        with b_lock:
+            items.append(1)
+
+
+def worker_two(items):
+    with b_lock:
+        with a_lock:
+            items.append(2)

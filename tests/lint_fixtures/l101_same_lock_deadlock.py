@@ -1,0 +1,12 @@
+"""L101 firing: nested acquisition of a non-reentrant lock."""
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def refresh(self, items):
+        with self._lock:
+            with self._lock:   # threading.Lock deadlocks on re-entry
+                items.clear()

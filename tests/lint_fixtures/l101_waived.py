@@ -1,0 +1,17 @@
+"""L101 non-firing: the inversion carries an explicit waiver."""
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def worker_one(items):
+    with a_lock:
+        with b_lock:
+            items.append(1)
+
+
+def worker_two(items):
+    with b_lock:
+        with a_lock:  # race: ordered — never concurrent with worker_one
+            items.append(2)

@@ -1,0 +1,23 @@
+"""L102 firing: blocking calls made while a lock is open."""
+import subprocess
+import threading
+import time
+
+state_lock = threading.Lock()
+
+
+class Provider:
+    def __init__(self, apis):
+        self.apis = apis
+        self._lock = threading.Lock()
+
+    def slow_refresh(self):
+        with self._lock:
+            time.sleep(1.0)                       # parks with lock held
+            return self.apis.ga.list_accelerators()  # network under lock
+
+
+def run_build(cmd, done):
+    with state_lock:
+        subprocess.run(cmd)
+        done.wait()   # Event.wait with a foreign lock held

@@ -1,0 +1,28 @@
+"""L102 non-firing: blocking work outside the lock; cv-wait on the
+held condition is the legal parked-worker pattern."""
+import threading
+import time
+
+
+class Queue:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+
+    def get(self):
+        with self._cond:
+            while not self._items:
+                self._cond.wait(0.2)   # releases the held cond: legal
+            return self._items.pop()
+
+
+class Provider:
+    def __init__(self, apis):
+        self.apis = apis
+        self._lock = threading.Lock()
+
+    def refresh(self):
+        fleet = self.apis.ga.list_accelerators()   # network first
+        time.sleep(0.0)                            # then sleep, no lock
+        with self._lock:
+            self._fleet = fleet                    # short critical section

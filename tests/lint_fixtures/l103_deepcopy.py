@@ -1,0 +1,23 @@
+"""L103 non-firing: deep_copy before mutating; list containers from
+listers are caller-owned (only their elements are shared)."""
+
+
+class Controller:
+    def __init__(self, informer):
+        self.informer = informer
+
+    def stamp_service(self, ns, name):
+        svc = self.informer.lister.get(ns, name)
+        svc = svc.deep_copy()
+        svc.metadata.annotations["touched"] = "true"   # own copy
+        return svc
+
+    def read_only(self, hostname):
+        return [o.key()
+                for o in self.informer.by_index("lb-dns", hostname)]
+
+    def sort_own_list(self, ns):
+        objs = self.informer.lister.list(ns)
+        objs.sort(key=lambda o: o.key())   # the LIST is caller-owned
+        objs.append(None)
+        return objs

@@ -1,0 +1,20 @@
+"""L103 firing: in-place mutation of shared informer-cache views."""
+
+
+class Controller:
+    def __init__(self, informer):
+        self.informer = informer
+
+    def stamp_service(self, ns, name):
+        svc = self.informer.lister.get(ns, name)
+        svc.metadata.annotations["touched"] = "true"   # shared view!
+        return svc
+
+    def clear_finalizers(self, hostname):
+        for obj in self.informer.by_index("lb-dns", hostname):
+            obj.metadata.finalizers.clear()            # shared element!
+
+    def alias_mutation(self, ns, name):
+        svc = self.informer.lister.get(ns, name)
+        meta = svc.metadata
+        meta.labels = {}                               # alias, still shared

@@ -1,0 +1,31 @@
+"""L104 non-firing: every fleet-state write under the discovery lock,
+``*_locked`` helpers called with the lock open (or from another
+``*_locked`` function), gen-keyed singleflight reads."""
+
+
+class Provider:
+    def __init__(self, state):
+        self._s = state
+
+    def _drop_tags_locked(self, arn):
+        self._s.tags.pop(arn, None)
+        self._s.gen += 1
+
+    def _invalidate_fleet_locked(self):
+        self._s.fleet_at = None
+        self._s.fleet_epoch += 1
+
+    def _rebuild_locked(self, arn):
+        self._drop_tags_locked(arn)   # lock contract propagates
+
+    def update_accelerator(self, arn, tags):
+        self.apis.ga.tag_resource(arn, tags)
+        with self._s.lock:
+            self._drop_tags_locked(arn)
+            self._invalidate_fleet_locked()
+
+    def verified_read(self, arn):
+        with self._s.lock:
+            gen = self._s.gen
+        return self._s.reads.do(("verify", arn, gen),
+                                lambda: self.apis.ga.describe(arn))

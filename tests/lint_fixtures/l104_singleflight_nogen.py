@@ -1,0 +1,16 @@
+"""L104 firing: singleflight keys without the generation component —
+a read begun before an invalidation can be joined by a caller arriving
+after it, resurrecting pre-invalidation state."""
+
+
+class Provider:
+    def __init__(self, state):
+        self._s = state
+
+    def verified_read(self, arn):
+        return self._s.reads.do(("verify", arn),
+                                lambda: self.apis.ga.describe(arn))
+
+    def scan(self):
+        return self._s.reads.do("scan",
+                                lambda: self.apis.ga.list_accelerators())

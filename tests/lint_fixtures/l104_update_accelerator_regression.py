@@ -1,0 +1,26 @@
+"""L104 firing: the PR-1 ``_update_accelerator`` bug shape — the re-tag
+invalidates the tags cache and fleet index WITHOUT holding the
+discovery lock, so a concurrent scan can install a snapshot carrying
+the pre-retag keys and serve definitely-absent for a full TTL."""
+
+
+class Provider:
+    def __init__(self, state):
+        self._s = state
+
+    def _drop_tags_locked(self, arn):
+        self._s.tags.pop(arn, None)
+        self._s.gen += 1
+
+    def _invalidate_fleet_locked(self):
+        self._s.fleet_at = None
+        self._s.fleet_epoch += 1
+
+    def update_accelerator(self, arn, tags):
+        self.apis.ga.tag_resource(arn, tags)
+        self._drop_tags_locked(arn)        # no lock held!
+        self._invalidate_fleet_locked()    # no lock held!
+
+    def forget_everything(self):
+        self._s.fleet_at = None            # bare fleet-state write
+        self._s.discovery.clear()          # bare fleet-state mutation

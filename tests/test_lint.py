@@ -12,6 +12,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
 
 import lint  # noqa: E402
 
+ROOT_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def _findings(tmp_path, source):
     f = tmp_path / "case.py"
@@ -131,10 +133,11 @@ def test_noqa_suppression_both_spellings(tmp_path):
 
 
 def test_tree_is_lint_clean():
-    """The gate itself: the shipped tree carries zero findings (CI runs
-    make lint; this keeps local pytest equivalent)."""
+    """The gate itself: the shipped tree carries zero findings across
+    BOTH passes — base rules and the L1xx concurrency contracts (CI
+    runs make lint; this keeps local pytest equivalent)."""
     proc = subprocess.run([sys.executable,
-                           os.path.join("hack", "lint.py")],
+                           os.path.join("hack", "lint.py"), "--all"],
                           capture_output=True, text=True,
                           cwd=os.path.dirname(os.path.dirname(
                               os.path.abspath(__file__))))
@@ -168,3 +171,135 @@ def test_cli_rejects_missing_path(tmp_path):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert proc.returncode == 2
     assert "no such file" in proc.stderr
+
+
+# -- L007: useless noqa ------------------------------------------------
+
+def test_useless_noqa_flagged(tmp_path):
+    got = _findings(tmp_path, """\
+        import os  # noqa: F401
+
+        print(os.path)
+        """)
+    assert got == [("L007", 1)]
+
+
+def test_useful_noqa_not_flagged(tmp_path):
+    got = _findings(tmp_path, """\
+        import os  # noqa: F401
+        """)
+    assert got == []
+
+
+def test_unknown_linter_codes_left_alone(tmp_path):
+    """E402/E501-class codes belong to linters this suite does not
+    implement — L007 must not demand their deletion."""
+    got = _findings(tmp_path, """\
+        import os  # noqa: E402
+
+        print(os.path)
+        """)
+    assert got == []
+
+
+def test_noqa_inside_string_constant_ignored(tmp_path):
+    got = _findings(tmp_path, """\
+        SNIPPET = '''
+        import sys  # noqa: L001
+        '''
+        print(SNIPPET)
+        """)
+    assert got == []
+
+
+# -- concurrency rules (L101-L104) -------------------------------------
+
+import pathlib  # noqa: E402
+
+from aws_global_accelerator_controller_tpu.analysis import (  # noqa: E402
+    concurrency_lint,
+)
+
+FIXTURES = pathlib.Path(os.path.dirname(os.path.abspath(__file__))) \
+    / "lint_fixtures"
+
+
+def _cfindings(name):
+    findings = concurrency_lint.lint_files([FIXTURES / name])
+    return [(f.code, f.line) for f in findings]
+
+
+def test_l101_ordering_inversion_fires():
+    assert _cfindings("l101_inversion.py") == [("L101", 10)]
+
+
+def test_l101_same_lock_nested_fires():
+    assert _cfindings("l101_same_lock_deadlock.py") == [("L101", 11)]
+
+
+def test_l101_consistent_order_and_rlock_clean():
+    assert _cfindings("l101_consistent.py") == []
+
+
+def test_l101_race_waiver_suppresses():
+    assert _cfindings("l101_waived.py") == []
+
+
+def test_l102_blocking_under_lock_fires():
+    assert _cfindings("l102_blocking.py") == [
+        ("L102", 16), ("L102", 17), ("L102", 22), ("L102", 23)]
+
+
+def test_l102_cv_wait_and_unlocked_blocking_clean():
+    assert _cfindings("l102_clean.py") == []
+
+
+def test_l103_shared_view_mutation_fires():
+    assert _cfindings("l103_mutate.py") == [
+        ("L103", 10), ("L103", 15), ("L103", 20)]
+
+
+def test_l103_deepcopy_and_own_list_clean():
+    assert _cfindings("l103_deepcopy.py") == []
+
+
+def test_l104_update_accelerator_regression_shape_fires():
+    """The PR-1 bug: fleet-index invalidation outside the discovery
+    lock let a concurrent scan install a stale snapshot (DNS
+    convergence stalled for a TTL)."""
+    assert _cfindings("l104_update_accelerator_regression.py") == [
+        ("L104", 21), ("L104", 22), ("L104", 25), ("L104", 26)]
+
+
+def test_l104_locked_discipline_clean():
+    assert _cfindings("l104_locked.py") == []
+
+
+def test_l104_singleflight_key_without_gen_fires():
+    assert _cfindings("l104_singleflight_nogen.py") == [
+        ("L104", 11), ("L104", 15)]
+
+
+def test_seeded_mutation_of_update_accelerator_is_caught(tmp_path):
+    """Acceptance probe: drop the ``with self._s.lock:`` block from the
+    REAL provider's ``_update_accelerator`` and the gate must fire —
+    the lint is tied to the shipped code shape, not just fixtures."""
+    provider_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/cloudprovider/aws/"
+        "provider.py")
+    src = provider_py.read_text()
+    start = src.index("def _update_accelerator")
+    end = src.index("def get_listener")
+    body = src[start:end]
+    assert body.count("with self._s.lock:") == 1
+    mutated = src[:start] \
+        + body.replace("with self._s.lock:", "if True:") + src[end:]
+    f = tmp_path / "provider_mutated.py"
+    f.write_text(mutated)
+    codes = [c for c, _ in
+             [(x.code, x.line)
+              for x in concurrency_lint.lint_files([f])]]
+    assert codes.count("L104") >= 2, codes  # both *_locked calls bare
+
+    # sanity: the unmutated file is clean (the tree gate's per-file view)
+    assert concurrency_lint.lint_files([provider_py]) == []

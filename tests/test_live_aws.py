@@ -16,7 +16,7 @@ import time
 import pytest
 
 try:
-    import boto3  # noqa: F401
+    import boto3
     HAVE_BOTO = True
 except ImportError:
     HAVE_BOTO = False

@@ -129,3 +129,46 @@ def test_apply_sample_files():
     # Deployment is skipped (unsupported kind); the rest land
     assert kinds == ["EndpointGroupBinding", "Ingress", "Ingress",
                      "Service", "Service", "Service", "Service"]
+
+
+def test_race_detector_counters_exposed():
+    """The runtime concurrency detectors publish their activity:
+    race_lockset_checks counts screened lock acquisitions (batched),
+    shared_view_mutations_blocked counts freeze-proxy catches."""
+    import pytest
+
+    from aws_global_accelerator_controller_tpu.analysis import (
+        freezeproxy,
+        locks,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        ObjectMeta,
+        Service,
+    )
+    from aws_global_accelerator_controller_tpu.metrics import (
+        default_registry,
+    )
+
+    before = default_registry.counter_value("race_lockset_checks")
+    tracked = locks.TrackedLock("metrics-probe")
+    for _ in range(5):
+        with tracked:
+            pass
+    locks.flush_counters()
+    after = default_registry.counter_value("race_lockset_checks")
+    assert after >= before + 5
+
+    blocked_before = default_registry.counter_value(
+        "shared_view_mutations_blocked")
+    view = freezeproxy.FrozenView(
+        Service(metadata=ObjectMeta(name="m", namespace="default")),
+        (("test_metrics_apply.py", 1, "test"),))
+    with pytest.raises(freezeproxy.SharedViewMutationError):
+        view.metadata.annotations["k"] = "v"
+    assert default_registry.counter_value(
+        "shared_view_mutations_blocked") == blocked_before + 1
+
+    # both series render for the scrape endpoint
+    text = default_registry.render()
+    assert "race_lockset_checks" in text
+    assert "shared_view_mutations_blocked" in text

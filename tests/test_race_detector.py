@@ -1,0 +1,171 @@
+"""Runtime concurrency detectors (analysis/locks.py, freezeproxy.py).
+
+The dynamic half of the concurrency checker: the lockset tracker must
+catch an inverted two-lock acquisition (reporting both sites' stacks)
+and the freeze proxy must catch an in-place mutation of a
+lister-returned shared view (reporting the mutation site AND the
+lister call that produced the view)."""
+import threading
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.analysis import (
+    freezeproxy,
+    locks,
+)
+from aws_global_accelerator_controller_tpu.analysis.locks import (
+    LockOrderViolation,
+    TrackedLock,
+)
+from aws_global_accelerator_controller_tpu.kube.apiserver import (
+    FakeAPIServer,
+)
+from aws_global_accelerator_controller_tpu.kube.informers import Informer
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    ObjectMeta,
+    Service,
+)
+
+
+# -- lockset tracker ---------------------------------------------------
+
+def test_lockset_catches_cross_thread_inversion():
+    locks.reset()
+    a, b = TrackedLock("order-a"), TrackedLock("order-b")
+
+    def one_way():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=one_way)
+    t.start()
+    t.join()
+
+    with b:
+        with pytest.raises(LockOrderViolation) as excinfo:
+            a.acquire()
+    msg = str(excinfo.value)
+    assert "order-a" in msg and "order-b" in msg
+    assert "this acquisition" in msg
+    assert "prior inverse acquisition" in msg
+    assert "one_way" in msg   # the other site's stack names its function
+    # the failed acquire released the inner lock: a is still usable
+    with a:
+        pass
+    locks.reset()
+
+
+def test_lockset_consistent_order_is_silent():
+    locks.reset()
+    a, b = TrackedLock("cons-a"), TrackedLock("cons-b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    locks.reset()
+
+
+def test_lockset_rlock_reentry_is_legal():
+    locks.reset()
+    r = TrackedLock("reent", reentrant=True)
+    with r:
+        with r:
+            assert r._is_owned()
+    locks.reset()
+
+
+def test_tracked_lock_drives_workqueue_condition():
+    """make_lock feeds the workqueue's Condition when detection is on;
+    blocking get/done must work unchanged through the wrapper."""
+    from aws_global_accelerator_controller_tpu.kube.workqueue import (
+        ItemExponentialFailureRateLimiter,
+        RateLimitingQueue,
+    )
+    locks.reset()
+    locks.enable()
+    try:
+        q = RateLimitingQueue(
+            rate_limiter=ItemExponentialFailureRateLimiter(0.001, 0.01),
+            name="race-detect")
+        q.add("k1")
+        item, shutdown = q.get(timeout=2.0)
+        assert item == "k1" and not shutdown
+        q.done("k1")
+        q.add_after("k2", 0.01)
+        item, shutdown = q.get(timeout=2.0)
+        assert item == "k2" and not shutdown
+        q.done("k2")
+        q.shutdown()
+    finally:
+        locks.disable()
+        locks.reset()
+
+
+# -- freeze proxy ------------------------------------------------------
+
+def _cached_informer():
+    api = FakeAPIServer()
+    informer = Informer(api.store("Service"))
+    svc = Service(metadata=ObjectMeta(name="shared", namespace="default"))
+    with informer._cache_lock:
+        informer._apply_locked(svc.key(), svc)
+    return informer, svc
+
+
+def test_freeze_proxy_catches_view_mutation_with_both_stacks():
+    informer, cached = _cached_informer()
+    freezeproxy.enable()
+    try:
+        view = informer.lister.get("default", "shared")
+        assert isinstance(view, Service)      # proxies keep isinstance
+        assert view.key() == "default/shared"
+        with pytest.raises(freezeproxy.SharedViewMutationError) as exc:
+            view.metadata.annotations["touched"] = "true"  # noqa: L103
+        msg = str(exc.value)
+        assert "mutation site" in msg
+        assert "lister call" in msg
+        # both stacks point back into this test file
+        assert msg.count("test_race_detector.py") >= 2
+        # the cached object was protected
+        assert cached.metadata.annotations == {}
+    finally:
+        freezeproxy.disable()
+
+
+def test_freeze_proxy_blocks_every_mutation_shape():
+    informer, _ = _cached_informer()
+    freezeproxy.enable()
+    try:
+        view = informer.lister.get("default", "shared")
+        with pytest.raises(freezeproxy.SharedViewMutationError):
+            view.spec = None                  # noqa: L103 — the point
+        with pytest.raises(freezeproxy.SharedViewMutationError):
+            view.metadata.finalizers.append("f")      # noqa: L103
+        with pytest.raises(freezeproxy.SharedViewMutationError):
+            view.metadata.labels.update(a="b")        # noqa: L103
+        views = informer.lister.list("default")
+        views.sort(key=lambda o: o.key())             # own list: legal
+        with pytest.raises(freezeproxy.SharedViewMutationError):
+            views[0].metadata.annotations.clear()     # noqa: L103
+    finally:
+        freezeproxy.disable()
+
+
+def test_freeze_proxy_deep_copy_thaws():
+    informer, cached = _cached_informer()
+    freezeproxy.enable()
+    try:
+        view = informer.lister.get("default", "shared")
+        own = view.deep_copy()
+        own.metadata.annotations["touched"] = "true"   # fine: private
+        assert cached.metadata.annotations == {}
+        assert type(own) is Service                    # fully thawed
+    finally:
+        freezeproxy.disable()
+
+
+def test_freeze_proxy_disabled_is_identity():
+    informer, cached = _cached_informer()
+    assert freezeproxy.view(cached) is cached
+    assert informer.lister.get("default", "shared") is cached

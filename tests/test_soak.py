@@ -39,8 +39,8 @@ from aws_global_accelerator_controller_tpu.kube.objects import (
 from harness import wait_until
 from test_http_backend import (  # reuse the proven fixtures/manager
     _start_manager,
-    http_api,  # noqa: F401  (pytest fixture)
-    rest,      # noqa: F401  (pytest fixture)
+    http_api,  # (pytest fixture)
+    rest,  # (pytest fixture)
 )
 
 SOAK_SECONDS = float(os.environ.get("SOAK_SECONDS", "45"))
@@ -79,7 +79,8 @@ def _service(name: str, hostname: str) -> Service:
     )
 
 
-def test_sustained_churn_stays_flat(rest, http_api):  # noqa: F811
+def test_sustained_churn_stays_flat(rest, http_api,  # noqa: F811
+                                    race_detectors):
     """Continuous create/update/delete churn through the full stack
     (REST wire, informers, workqueues, controllers, fake cloud) for
     SOAK_SECONDS.  After warmup: thread count, watcher registrations,

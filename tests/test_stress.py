@@ -33,7 +33,7 @@ from harness import Cluster, wait_until
 REGION = "ap-northeast-1"
 
 
-def test_workqueue_no_lost_or_duplicated_processing():
+def test_workqueue_no_lost_or_duplicated_processing(race_detectors):
     """N producers x M consumers: every item processed, never concurrently
     for the same key (the dirty/processing invariant)."""
     q = RateLimitingQueue(
@@ -90,7 +90,7 @@ def assert_wait(pred, timeout, message):
     raise AssertionError(message)
 
 
-def test_concurrent_conflicting_updates_converge():
+def test_concurrent_conflicting_updates_converge(race_detectors):
     """Optimistic concurrency: racing writers must either succeed or get
     ConflictError; total applied updates == successful updates."""
     api = FakeAPIServer()
@@ -123,7 +123,7 @@ def test_concurrent_conflicting_updates_converge():
     assert len(successes) == 180
 
 
-def test_churn_converges_to_final_state():
+def test_churn_converges_to_final_state(race_detectors):
     """Rapid create/annotate/deannotate/delete churn across many services;
     the level-triggered controllers must converge to exactly the surviving
     set."""
