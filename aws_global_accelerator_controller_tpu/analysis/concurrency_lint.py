@@ -34,6 +34,15 @@ zero-findings gate philosophy):
                          lock; (c) gen-keyed singleflight reads
                          (``*.reads.do``) whose key tuple carries no
                          generation component.
+  L105 resilient calls   Direct AWS service method calls
+                         (``<x>.ga.describe_accelerator(...)``, any
+                         method of the three API interfaces) whose
+                         receiver chain does not go through ``apis`` —
+                         the factory's ResilientAPIs injection point —
+                         bypass the retry/breaker/deadline policy
+                         (resilience/wrapper.py).  Package files only:
+                         tests and tools observe the fake cloud
+                         directly by design.
 
 Waivers: ``# race: <reason>`` on the flagged line (the explicit,
 greppable spelling — use for contracts that are upheld non-lexically),
@@ -71,6 +80,38 @@ _BLOCKING_ROOTS = {"subprocess", "socket", "requests"}
 # Informer read API: objects returned by these are shared views (L103).
 _VIEW_METHODS = {"by_index", "cache_get", "cache_list"}
 _LISTER_METHODS = {"get", "list"}
+
+# The AWS API call surface (the abstract methods of
+# cloudprovider.aws.api's three interfaces) and the attribute names the
+# bundle exposes them under — rule L105 flags reaching one without
+# going through ``apis`` (the ResilientAPIs injection point).
+_AWS_SERVICES = {"ga", "elb", "route53"}
+_AWS_API_METHODS = {
+    # GlobalAcceleratorAPI
+    "list_accelerators", "describe_accelerator",
+    "list_tags_for_resource", "create_accelerator",
+    "update_accelerator", "tag_resource", "delete_accelerator",
+    "list_listeners", "create_listener", "update_listener",
+    "delete_listener", "list_endpoint_groups",
+    "describe_endpoint_group", "create_endpoint_group",
+    "update_endpoint_group", "add_endpoints", "remove_endpoints",
+    "delete_endpoint_group",
+    # ELBv2API
+    "describe_load_balancers",
+    # Route53API
+    "list_hosted_zones", "list_hosted_zones_by_name",
+    "list_resource_record_sets", "change_resource_record_sets",
+}
+
+
+def _l105_in_scope(path: Path) -> bool:
+    """L105 covers the shipped package (where every AWS call must ride
+    the resilient wrapper) and the lint fixtures (the rule's own test
+    corpus); tests/tools observing the fake cloud directly are the
+    supported escape hatch, not a violation."""
+    parts = path.parts
+    return ("aws_global_accelerator_controller_tpu" in parts
+            or "lint_fixtures" in parts)
 
 
 class Finding:
@@ -352,6 +393,21 @@ class Engine:
         # L104c: gen-keyed singleflight reads.
         if chain[-1] == "do" and len(chain) >= 2 and chain[-2] == "reads":
             self._check_singleflight_key(info, call)
+        # L105: an AWS service method reached without going through
+        # ``apis`` (the wrapper injection point) runs bare — no retry,
+        # no breaker, no deadline.
+        if (len(chain) >= 2 and chain[-1] in _AWS_API_METHODS
+                and chain[-2] in _AWS_SERVICES
+                and "apis" not in chain[:-2]
+                and _l105_in_scope(info.path)):
+            self.findings.append(Finding(
+                info.path, line, "L105",
+                f"direct AWS API call "
+                f"'{'.'.join(chain)}()' bypasses the ResilientAPIs "
+                f"wrapper (no retry/breaker/deadline policy) — reach "
+                f"it via '...apis.{chain[-2]}.{chain[-1]}' or waive "
+                f"with '# race: <reason>' if this is a deliberate "
+                f"bare call"))
         # L102: blocking while any lock is held.
         if held and self._is_blocking(chain, held):
             self.findings.append(Finding(

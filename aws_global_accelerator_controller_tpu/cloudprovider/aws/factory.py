@@ -10,9 +10,11 @@ makes the controller logic testable against the fake cloud.
 """
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 from ...analysis import locks
+from ...resilience import ResilienceConfig, ResilientAPIs
+from ...resilience.wrapper import FAKE_CLOUD_CONFIG
 from .api import AWSAPIs
 from .fake import FakeAWSCloud
 from .provider import AWSProvider, FleetDiscoveryState
@@ -27,12 +29,18 @@ class CloudFactory:
 
     def __init__(self, delete_poll_interval: float = 10.0,
                  delete_poll_timeout: float = 180.0,
-                 accelerator_not_found_retry: float = 60.0):
+                 accelerator_not_found_retry: float = 60.0,
+                 resilience: Optional[ResilienceConfig] = None):
         self._providers: Dict[str, AWSProvider] = {}
         self._lock = locks.make_lock("cloud-factory")
         self._poll_interval = delete_poll_interval
         self._poll_timeout = delete_poll_timeout
         self._not_found_retry = accelerator_not_found_retry
+        # every provider's apis go through the resilient call layer
+        # (classify/retry/backoff, per-region circuit breaker,
+        # adaptive throttle pacing — resilience/); None means the
+        # production defaults, ResilienceConfig(enabled=False) opts out
+        self._resilience = resilience or ResilienceConfig()
         # ONE discovery state across every region: Global Accelerator
         # is a global service, so all this factory's providers observe
         # the same fleet — a create through any of them must be visible
@@ -44,8 +52,12 @@ class CloudFactory:
         with self._lock:
             provider = self._providers.get(region)
             if provider is None:
+                apis = self._make_apis(region)
+                if self._resilience.enabled:
+                    apis = ResilientAPIs(apis, region=region,
+                                         config=self._resilience)
                 provider = AWSProvider(
-                    self._make_apis(region),
+                    apis,
                     delete_poll_interval=self._poll_interval,
                     delete_poll_timeout=self._poll_timeout,
                     accelerator_not_found_retry=self._not_found_retry,
@@ -68,10 +80,17 @@ class FakeCloudFactory(CloudFactory):
     def __init__(self, settle_seconds: float = 0.0,
                  delete_poll_interval: float = 0.01,
                  delete_poll_timeout: float = 5.0,
-                 accelerator_not_found_retry: float = 0.2):
+                 accelerator_not_found_retry: float = 0.2,
+                 resilience: Optional[ResilienceConfig] = None,
+                 fault_seed: Optional[int] = None):
+        # fast resilience profile by default: real backoff shapes at
+        # 100x speed, breaker thresholds the ordinary one-shot fault
+        # tests never trip (chaos tests pass tighter configs)
         super().__init__(delete_poll_interval, delete_poll_timeout,
-                         accelerator_not_found_retry)
-        self.cloud = FakeAWSCloud(settle_seconds=settle_seconds)
+                         accelerator_not_found_retry,
+                         resilience=resilience or FAKE_CLOUD_CONFIG)
+        self.cloud = FakeAWSCloud(settle_seconds=settle_seconds,
+                                  fault_seed=fault_seed)
 
     def _make_apis(self, region: str) -> AWSAPIs:
         return self.cloud

@@ -15,15 +15,20 @@ Emulates the behaviors the provider logic depends on:
 - Route53 name normalization: trailing dots, wildcard '*' stored as the
   octal escape ``\\052`` exactly as the real API returns it
   (route53.go:369-371);
-- one-shot fault injection (``fail_on``) for partial-failure tests.
+- fault injection: one-shot (``fail_on``, the original API) plus the
+  chaos engine — seeded probabilistic error rates, latency injection,
+  throttle bursts and service blackout windows (docs/resilience.md
+  "Chaos schedules").
 """
 from __future__ import annotations
 
 import itertools
+import random
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ...errors import (
     AWSAPIError,
@@ -47,20 +52,206 @@ from .types import (
 )
 
 
+# method name -> owning fake service, for service-scoped chaos windows
+# ("regional blackout" = the regional service, elb, going dark; ga and
+# route53 are the global control plane).
+_METHOD_SERVICE: Dict[str, str] = {
+    "describe_load_balancers": "elb",
+    "list_hosted_zones": "route53",
+    "list_hosted_zones_by_name": "route53",
+    "list_resource_record_sets": "route53",
+    "change_resource_record_sets": "route53",
+}
+
+
+def _service_of(method: str) -> str:
+    return _METHOD_SERVICE.get(method, "ga")
+
+
+@dataclass
+class _Window:
+    """A scheduled fault interval: between ``start`` and ``end`` every
+    matching call fails with ``make_exc()`` at probability ``rate``."""
+    kind: str                      # "throttle" | "blackout"
+    service: str                   # "ga" | "elb" | "route53" | "*"
+    start: float
+    end: float
+    rate: float
+    make_exc: Callable[[], Exception]
+
+    def matches(self, service: str, now: float) -> bool:
+        return (self.start <= now < self.end
+                and self.service in ("*", service))
+
+
 class FaultInjector:
-    def __init__(self):
+    """Fault scheduling for the fake cloud.
+
+    The original one-shot ``fail_on`` queue is kept verbatim (and takes
+    precedence) for the existing partial-failure tests; around it sits
+    a chaos engine:
+
+    - ``set_error_rate``: per-method (or ``'*'``) probabilistic
+      failures.  The decision for call #k of method m is a pure
+      function of ``(seed, m, k)``, so the same seed injects the same
+      faults for the same per-method call sequence regardless of
+      thread interleaving ACROSS methods — the determinism contract
+      tests/chaos/ asserts.
+    - ``set_latency``: fixed added latency per method (slept outside
+      the injector lock).
+    - ``add_throttle_burst`` / ``add_blackout``: wall-clock windows
+      (relative to the moment they are scheduled) during which a
+      service answers ThrottlingException / ServiceUnavailable.
+
+    Every injected fault is counted per method (``injected_counts``),
+    one-shot faults included; ``call_counts`` sees every call.
+    """
+
+    def __init__(self, seed: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
         self._faults: Dict[str, List[Exception]] = {}
         self._lock = threading.Lock()
+        self._clock = clock
+        self._seed = seed
+        self._calls: Dict[str, int] = {}
+        self._injected: Dict[str, int] = {}
+        self._error_rates: Dict[str, Tuple[float,
+                                           Callable[[], Exception]]] = {}
+        self._latency: Dict[str, float] = {}
+        self._windows: List[_Window] = []
+
+    # -- original one-shot API (unchanged surface) ----------------------
 
     def fail_on(self, method: str, exc: Exception, times: int = 1) -> None:
         with self._lock:
             self._faults.setdefault(method, []).extend([exc] * times)
 
-    def check(self, method: str) -> None:
+    # -- chaos schedule -------------------------------------------------
+
+    def reseed(self, seed: int) -> None:
+        """Fix the probabilistic-decision seed (determinism: same seed
+        + same per-method call sequence -> same injected faults)."""
         with self._lock:
+            self._seed = seed
+
+    def set_error_rate(self, method: str, rate: float,
+                       code: str = "InternalError",
+                       message: str = "chaos: injected transient error",
+                       ) -> None:
+        """Fail ``method`` (or every method via ``'*'``) with
+        probability ``rate``; 0 clears."""
+        with self._lock:
+            if rate <= 0.0:
+                self._error_rates.pop(method, None)
+            else:
+                self._error_rates[method] = (
+                    rate, lambda: AWSAPIError(code, message))
+
+    def set_latency(self, method: str, seconds: float) -> None:
+        """Add fixed latency to ``method`` (or ``'*'``); 0 clears."""
+        with self._lock:
+            if seconds <= 0.0:
+                self._latency.pop(method, None)
+            else:
+                self._latency[method] = seconds
+
+    def add_throttle_burst(self, start_in: float, duration: float,
+                           service: str = "*", rate: float = 1.0) -> None:
+        """Schedule a throttling storm ``start_in`` seconds from now."""
+        now = self._clock()
+        with self._lock:
+            self._windows.append(_Window(
+                "throttle", service, now + start_in,
+                now + start_in + duration, rate,
+                lambda: AWSAPIError("ThrottlingException",
+                                    "chaos: throttle burst",
+                                    retryable=True)))
+
+    def add_blackout(self, start_in: float, duration: float,
+                     service: str = "*") -> None:
+        """Schedule a full service outage ``start_in`` seconds from
+        now: every matching call fails until the window closes."""
+        now = self._clock()
+        with self._lock:
+            self._windows.append(_Window(
+                "blackout", service, now + start_in,
+                now + start_in + duration, 1.0,
+                lambda: AWSAPIError("ServiceUnavailable",
+                                    "chaos: service blackout",
+                                    retryable=True)))
+
+    # -- observability --------------------------------------------------
+
+    def injected_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._injected)
+
+    def call_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._calls)
+
+    # -- the per-call hook ----------------------------------------------
+
+    def _decide(self, method: str, index: int, rate: float,
+                salt: str = "") -> bool:
+        """Deterministic per-(seed, salt, method, call-index) coin
+        flip.  crc32 rather than hash(): str hashes are randomized per
+        process, and the determinism contract is cross-process.
+        ``salt`` names the decision source (a window vs the background
+        error rate) so concurrent fault sources draw INDEPENDENTLY —
+        sharing one draw would make a partial-rate window swallow the
+        background rate entirely (every draw below the background
+        threshold is already below the window's)."""
+        if rate >= 1.0:
+            return True
+        if self._seed is None:
+            return random.random() < rate
+        draw = zlib.crc32(
+            f"{self._seed}:{salt}:{method}:{index}".encode())
+        return draw / 2**32 < rate
+
+    def check(self, method: str) -> None:
+        """Called by every fake API method before it touches state (an
+        injected fault means the call never happened).  Decisions and
+        counting happen under the injector lock; the latency sleep and
+        the raise happen outside it."""
+        with self._lock:
+            index = self._calls.get(method, 0)
+            self._calls[method] = index + 1
+            delay = self._latency.get(method,
+                                      self._latency.get("*", 0.0))
+            exc: Optional[Exception] = None
             pending = self._faults.get(method)
             if pending:
-                raise pending.pop(0)
+                exc = pending.pop(0)
+            if exc is None and self._windows:
+                now = self._clock()
+                self._windows = [w for w in self._windows
+                                 if now < w.end]
+                service = _service_of(method)
+                for w in self._windows:
+                    # salt by the window's identity, not its list
+                    # position: pruning an expired window must not
+                    # reshuffle the draws of the ones still running
+                    if w.matches(service, now) and self._decide(
+                            method, index, w.rate,
+                            salt=f"{w.kind}:{w.start}"):
+                        exc = w.make_exc()
+                        break
+            if exc is None:
+                hit = self._error_rates.get(method) \
+                    or self._error_rates.get("*")
+                if hit is not None and \
+                        self._decide(method, index, hit[0],
+                                     salt="rate"):
+                    exc = hit[1]()
+            if exc is not None:
+                self._injected[method] = \
+                    self._injected.get(method, 0) + 1
+        if delay > 0.0:
+            time.sleep(delay)
+        if exc is not None:
+            raise exc
 
 
 @dataclass
@@ -457,8 +648,9 @@ class FakeRoute53(Route53API):
 class FakeAWSCloud(AWSAPIs):
     """Complete fake cloud bundle with shared fault injector."""
 
-    def __init__(self, settle_seconds: float = 0.0):
-        self.faults = FaultInjector()
+    def __init__(self, settle_seconds: float = 0.0,
+                 fault_seed: Optional[int] = None):
+        self.faults = FaultInjector(seed=fault_seed)
         super().__init__(
             elb=FakeELBv2(self.faults),
             ga=FakeGlobalAccelerator(settle_seconds, self.faults),

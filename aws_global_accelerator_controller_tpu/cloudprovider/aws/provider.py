@@ -31,6 +31,7 @@ from ...errors import (
     AWSAPIError,
     EndpointGroupNotFoundError,
     ListenerNotFoundError,
+    retry_after_hint,
 )
 from ...kube.objects import Ingress, LoadBalancerIngress, Service
 
@@ -271,7 +272,16 @@ class AWSProvider:
                     accelerator, tags = self._verified_read(arn)
                     if tags_contains_all_values(tags, target):
                         return [accelerator]
-                except AWSAPIError:
+                except AWSAPIError as e:
+                    # a resilience-layer failure (retry budget,
+                    # deadline, open circuit — all carry a retry_after
+                    # hint) is NOT an answer about this accelerator:
+                    # treating a brownout as "deleted out-of-band"
+                    # would drop the cache, force a fresh O(fleet)
+                    # scan mid-storm, and can end in a duplicate
+                    # create.  Propagate; the reconcile loop parks.
+                    if retry_after_hint(e) > 0:
+                        raise
                     with self._s.lock:  # deleted out-of-band
                         self._drop_tags_locked(arn)
                 # the cached entry lied: tags moved out from under us.
@@ -310,7 +320,9 @@ class AWSProvider:
                 for arn in arns:
                     try:
                         accelerator, tags = self._verified_read(arn)
-                    except AWSAPIError:
+                    except AWSAPIError as e:
+                        if retry_after_hint(e) > 0:
+                            raise        # brownout, not an answer
                         confirmed = None     # deleted out-of-band
                         break
                     if tags_contains_all_values(tags, target):
@@ -348,7 +360,9 @@ class AWSProvider:
                 try:
                     tags = self.apis.ga.list_tags_for_resource(
                         accelerator.accelerator_arn)
-                except AWSAPIError:
+                except AWSAPIError as e:
+                    if retry_after_hint(e) > 0:
+                        raise            # brownout, not an answer
                     continue  # deleted out from under the scan
                 if tags_contains_all_values(tags, target):
                     confirmed.append(accelerator)

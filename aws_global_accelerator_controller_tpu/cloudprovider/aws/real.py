@@ -18,6 +18,8 @@ from ...errors import (
     AWSAPIError,
     EndpointGroupNotFoundError,
     ListenerNotFoundError,
+    THROTTLE_CODES,
+    TRANSIENT_CODES,
 )
 from .api import (
     AWSAPIs,
@@ -46,12 +48,30 @@ GLOBAL_REGION = "us-west-2"
 
 
 def _wrap_client_error(e) -> Exception:
-    code = getattr(e, "response", {}).get("Error", {}).get("Code", "")
+    """boto ClientError -> typed AWSAPIError with the resilience
+    layer's taxonomy applied (errors.py code tables,
+    resilience/classify.py):
+
+    - TooManyRequestsException / ThrottlingException / the rest of
+      THROTTLE_CODES keep their code (classify() reads it as throttle);
+    - HTTP 5xx with an unknown code is marked ``retryable=True`` so it
+      classifies transient even when the service invents a code the
+      tables have never seen;
+    - *NotFoundException codes keep their dedicated exception types.
+    """
+    response = getattr(e, "response", {}) or {}
+    code = response.get("Error", {}).get("Code", "")
     if code == "ListenerNotFoundException":
         return ListenerNotFoundError(str(e))
     if code == "EndpointGroupNotFoundException":
         return EndpointGroupNotFoundError(str(e))
-    return AWSAPIError(code or "Unknown", str(e))
+    retryable = None
+    status = response.get("ResponseMetadata", {}).get("HTTPStatusCode")
+    if isinstance(status, int) and status >= 500:
+        retryable = True
+    if code in THROTTLE_CODES or code in TRANSIENT_CODES:
+        retryable = True
+    return AWSAPIError(code or "Unknown", str(e), retryable=retryable)
 
 
 class BotoGlobalAccelerator(GlobalAcceleratorAPI):

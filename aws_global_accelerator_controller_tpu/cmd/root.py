@@ -287,14 +287,18 @@ def _start_smoke_watchdog(budget_s: int, cloud_factory, stop) -> None:
     cloud = cloud_factory.cloud
 
     def converged() -> bool:
-        accs = cloud.ga.list_accelerators()
+        # bare fake-cloud reads below: this watchdog OBSERVES the demo
+        # fleet's desired state, it is not a control-path AWS caller
+        accs = cloud.ga.list_accelerators()  # race: fake observation
         if len(accs) != 1:
             return False
-        listeners = cloud.ga.list_listeners(accs[0].accelerator_arn)
+        listeners = cloud.ga.list_listeners(  # race: fake observation
+            accs[0].accelerator_arn)
         if len(listeners) != 1:
             return False
-        for zone in cloud.route53.list_hosted_zones():
-            for rec in cloud.route53.list_resource_record_sets(zone.id):
+        for zone in cloud.route53.list_hosted_zones():  # race: fake observation
+            for rec in cloud.route53.list_resource_record_sets(  # race: fake observation
+                    zone.id):
                 if rec.type == "A":
                     return True
         return False

@@ -65,14 +65,92 @@ class AdmissionDeniedError(Exception):
         self.reason = message
 
 
+# -- AWS error-code taxonomy (resilience/classify.py dispatches on
+# these; real.py maps boto ClientError codes into them) ----------------
+
+# The service asked the caller to slow down: retry helps, but only
+# after backing off AND shrinking the client-side send rate
+# (resilience.AdaptiveTokenBucket).
+THROTTLE_CODES = frozenset({
+    "Throttling", "ThrottlingException", "ThrottledException",
+    "TooManyRequestsException", "RequestLimitExceeded",
+    "RequestThrottled", "RequestThrottledException", "SlowDown",
+    "PriorRequestNotComplete", "TransactionInProgressException",
+    "LimitExceededException",
+})
+
+# The service (or the path to it) hiccuped: a plain capped-backoff
+# retry is the right response.  5xx HTTP statuses map here too
+# (real.py _wrap_client_error).
+TRANSIENT_CODES = frozenset({
+    "InternalError", "InternalFailure", "InternalServiceError",
+    "InternalServiceErrorException", "ServiceUnavailable",
+    "ServiceUnavailableException", "ServiceFailure",
+    "RequestTimeout", "RequestTimeoutException", "RequestExpired",
+    "IDPCommunicationError", "ConnectionError", "HTTPClientError",
+})
+
+# Codes that spell "the referenced thing does not exist" without the
+# conventional *NotFoundException suffix.
+NOT_FOUND_CODES = frozenset({
+    "NoSuchHostedZone", "NoSuchEntity", "NotFound", "ResourceNotFound",
+})
+
+
 class AWSAPIError(Exception):
     """Base for simulated/real AWS API errors, carrying an error code the
     way smithy.APIError does (reference
-    pkg/controller/endpointgroupbinding/reconcile.go:50-56)."""
+    pkg/controller/endpointgroupbinding/reconcile.go:50-56).
 
-    def __init__(self, code: str, message: str = ""):
+    ``retryable`` is the transport layer's verdict when it has one
+    (boto marks 5xx/connection errors retryable); ``None`` means
+    "classify by code" (resilience/classify.py).
+    """
+
+    def __init__(self, code: str, message: str = "",
+                 retryable: "bool | None" = None):
         super().__init__(message or code)
         self.code = code
+        self.retryable = retryable
+
+    def is_throttle(self) -> bool:
+        return self.code in THROTTLE_CODES
+
+
+def _walk_causes(err: BaseException):
+    """Explicit ``raise ... from`` chain, cycle-safe — the same walk
+    discipline as :func:`is_no_retry` (Go errors.As over Unwrap)."""
+    seen = set()
+    cur: "BaseException | None" = err
+    while cur is not None and id(cur) not in seen:
+        yield cur
+        seen.add(id(cur))
+        cur = cur.__cause__
+
+
+def is_throttle(err: BaseException) -> bool:
+    """True if ``err`` is, or explicitly wraps, an AWS throttle
+    response — the rate-limit analogue of :func:`is_no_retry`, walking
+    the same ``__cause__`` chain so a throttle wrapped by a retry-layer
+    error (resilience.RetryBudgetExceededError) still reads as one."""
+    return any(isinstance(cur, AWSAPIError) and cur.is_throttle()
+               for cur in _walk_causes(err))
+
+
+def retry_after_hint(err: BaseException) -> float:
+    """Largest ``retry_after`` seconds carried by ``err`` or its
+    explicit cause chain; 0.0 when none.  The resilience layer's
+    budget/deadline/circuit errors carry this hint so the reconcile
+    loop can park the key (``Forget`` + ``AddAfter``) instead of
+    hammering the rate limiter (reconcile.py error dispatch)."""
+    best = 0.0
+    for cur in _walk_causes(err):
+        try:
+            hint = float(getattr(cur, "retry_after", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            continue
+        best = max(best, hint)
+    return best
 
 
 class ListenerNotFoundError(AWSAPIError):

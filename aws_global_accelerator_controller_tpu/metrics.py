@@ -155,6 +155,27 @@ default_registry.describe(
     "Hot reloads of the trained weight-policy checkpoint, by outcome "
     "(ok / error — error keeps serving the previous weights).")
 default_registry.describe(
+    "aws_call_retries_total",
+    "In-call retries of AWS API calls by operation (the resilient "
+    "call layer absorbed a throttle/transient failure and tried "
+    "again; resilience/wrapper.py).")
+default_registry.describe(
+    "aws_call_deadline_exceeded_total",
+    "AWS API calls abandoned because retrying (or throttle pacing) "
+    "would cross the per-call deadline, by operation.")
+default_registry.describe(
+    "circuit_state",
+    "Per-region circuit breaker state: 0 closed, 1 half-open, 2 open "
+    "(resilience/breaker.py state machine).")
+default_registry.describe(
+    "circuit_transitions_total",
+    "Circuit breaker state transitions per region and target state.")
+default_registry.describe(
+    "throttle_tokens",
+    "Adaptive token-bucket level per region (negative = callers "
+    "queued on debt); capacity halves on throttle responses and "
+    "recovers on success.")
+default_registry.describe(
     "race_lockset_checks",
     "Lock acquisitions screened by the runtime lockset tracker "
     "(analysis/locks.py) — nonzero proves the detector was armed.")
@@ -198,6 +219,46 @@ def record_coalesced_read(op: str,
 def record_fleet_scan(registry: Optional[Registry] = None) -> None:
     reg = registry or default_registry
     reg.inc_counter("provider_fleet_scans_total", {})
+
+
+def record_aws_call_retry(op: str,
+                          registry: Optional[Registry] = None) -> None:
+    """The resilient call layer retried one AWS call in-flight after a
+    throttle/transient failure (resilience/wrapper.py)."""
+    reg = registry or default_registry
+    reg.inc_counter("aws_call_retries_total", {"op": op})
+
+
+def record_aws_call_deadline_exceeded(
+        op: str, registry: Optional[Registry] = None) -> None:
+    """One AWS call was abandoned at its wall-clock deadline instead
+    of retrying (or pacing) past it."""
+    reg = registry or default_registry
+    reg.inc_counter("aws_call_deadline_exceeded_total", {"op": op})
+
+
+def record_circuit_transition(region: str, to: str,
+                              registry: Optional[Registry] = None) -> None:
+    """The region's circuit breaker changed state (to closed /
+    half_open / open)."""
+    reg = registry or default_registry
+    reg.inc_counter("circuit_transitions_total",
+                    {"region": region, "to": to})
+
+
+def watch_circuit_state(region: str, fn: Callable[[], float],
+                        registry: Optional[Registry] = None) -> None:
+    """Register the circuit_state{region} gauge (re-registration
+    replaces: a rebuilt factory must not duplicate the series)."""
+    reg = registry or default_registry
+    reg.register_gauge("circuit_state", {"region": region}, fn)
+
+
+def watch_throttle_tokens(region: str, fn: Callable[[], float],
+                          registry: Optional[Registry] = None) -> None:
+    """Register the throttle_tokens{region} gauge."""
+    reg = registry or default_registry
+    reg.register_gauge("throttle_tokens", {"region": region}, fn)
 
 
 def record_lockset_checks(n: int = 1,

@@ -9,8 +9,10 @@ Mirrors reference pkg/reconcile/reconcile.go:17-91:
   informer cache (kube/informers.py), so this copy is the ONE defensive
   copy between the watch stream and the process func;
 - dispatch on the outcome: NoRetryError -> drop (Forget is NOT called, as
-  in the reference, so the failure count survives); other error ->
-  AddRateLimited; Result.requeue_after -> Forget + AddAfter;
+  in the reference, so the failure count survives); an error carrying a
+  ``retry_after`` hint (the resilience layer's budget/deadline/circuit
+  errors, errors.retry_after_hint) -> Forget + AddAfter(hint); other
+  error -> AddRateLimited; Result.requeue_after -> Forget + AddAfter;
   Result.requeue -> AddRateLimited; success -> Forget.
 """
 from __future__ import annotations
@@ -21,7 +23,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from . import metrics
-from .errors import is_no_retry, is_not_found
+from .errors import is_no_retry, is_not_found, retry_after_hint
 from .kube.workqueue import RateLimitingQueue
 from .tracing import default_tracer
 
@@ -102,6 +104,20 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
             if is_no_retry(err):
                 outcome = "no_retry_error"
                 logger.error("error syncing %r: %s", key, err)
+            elif (hint := retry_after_hint(err)) > 0:
+                # the resilient call layer already burned an in-call
+                # retry budget (or found the circuit open) and knows
+                # when trying again is worthwhile: park the key for
+                # that long instead of hot-requeuing into the same
+                # brownout (Forget resets the failure count — the
+                # in-call budget IS the backoff; the park bounds the
+                # requeue rate)
+                outcome = "retry_exhausted"
+                queue.forget(key)
+                queue.add_after(key, hint)
+                logger.warning("error syncing %r, retry budget "
+                               "exhausted; parked %.2fs: %s",
+                               key, hint, err)
             else:
                 outcome = "error"
                 queue.add_rate_limited(key)

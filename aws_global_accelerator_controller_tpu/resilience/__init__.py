@@ -1,0 +1,59 @@
+"""Resilient AWS call layer (classify / retry / breaker / deadlines).
+
+The reference controller leans entirely on workqueue requeue for fault
+handling: every SDK call is a bare invocation and the only error
+distinction is NoRetryError.  This package is the production-scale
+answer (ROADMAP north star; the same transient-vs-terminal,
+deadline-bounded taxonomy the fault-tolerant collective libraries in
+PAPERS.md build for training jobs):
+
+- ``classify``: AWSAPIError codes -> throttle / transient / terminal /
+  not-found (errors.py holds the code tables; real.py maps boto codes
+  into them).
+- ``retry``: capped exponential backoff with decorrelated jitter, an
+  overall attempt budget and a per-call wall-clock deadline.
+- ``breaker``: per-region circuit breaker (closed -> open on failure
+  rate -> half-open probe) plus an AIMD token bucket that shrinks on
+  throttle responses and recovers on success.
+- ``wrapper``: ``ResilientAPIs``, a transparent decorator around the
+  ``AWSAPIs`` bundle — the factory wraps every provider's apis in one,
+  so provider.py, singleflight and fleet sweeps all go through the
+  policy without a call-site change (lint rule L105 keeps it that way).
+
+Every retry, deadline miss, breaker transition and token level flows
+into metrics.py (``aws_call_retries_total``,
+``aws_call_deadline_exceeded_total``, ``circuit_state{region}``,
+``throttle_tokens{region}``).  docs/resilience.md has the taxonomy
+table and the breaker state machine.
+"""
+from .classify import ErrorClass, classify
+from .retry import (
+    DeadlineExceededError,
+    RetryBudgetExceededError,
+    RetryPolicy,
+)
+from .breaker import (
+    AdaptiveTokenBucket,
+    CircuitBreaker,
+    CircuitOpenError,
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+)
+from .wrapper import ResilienceConfig, ResilientAPIs
+
+__all__ = [
+    "AdaptiveTokenBucket",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DeadlineExceededError",
+    "ErrorClass",
+    "ResilienceConfig",
+    "ResilientAPIs",
+    "RetryBudgetExceededError",
+    "RetryPolicy",
+    "STATE_CLOSED",
+    "STATE_HALF_OPEN",
+    "STATE_OPEN",
+    "classify",
+]

@@ -1,0 +1,259 @@
+"""Per-region circuit breaker + adaptive (AIMD) throttle token bucket.
+
+Circuit breaker state machine (docs/resilience.md has the diagram):
+
+    CLOSED --(failure rate >= threshold over window,
+              with >= min_calls volume)--> OPEN
+    OPEN   --(open_seconds elapsed)-----> HALF_OPEN
+    HALF_OPEN --(probe succeeds)--------> CLOSED
+    HALF_OPEN --(probe fails)-----------> OPEN (timer restarts)
+
+While OPEN, ``allow()`` raises :class:`CircuitOpenError` carrying the
+remaining open time as ``retry_after`` — callers fail fast instead of
+queueing onto a region that is actively browning out, and the
+reconcile loop parks the key for exactly that long.  Only throttle and
+transient outcomes count as failures: a NotFound or a validation error
+is the service answering correctly, so the wrapper records it as a
+success (breaker health is about the REGION, not the request).
+
+``AdaptiveTokenBucket`` is the client-side send-rate governor: calls
+take a token (going into bounded debt = queueing delay when empty),
+the refill rate scales with an adaptive capacity that HALVES on every
+throttle response and recovers by a fixed step per success — AIMD, the
+same control law TCP uses for the same reason (many independent
+clients must converge on a fair share of an unknown limit without
+coordinating).
+
+Both classes compute under a tracked lock and NEVER sleep or call out
+while holding it (lint rule L102); waiting happens in the wrapper,
+outside every lock.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+from .. import metrics
+from ..analysis import locks
+from ..errors import AWSAPIError
+
+STATE_CLOSED = "closed"
+STATE_HALF_OPEN = "half_open"
+STATE_OPEN = "open"
+
+# Gauge encoding for circuit_state{region}: closed < half-open < open,
+# so an operator's max() over time shows the worst state reached.
+STATE_VALUES = {STATE_CLOSED: 0.0, STATE_HALF_OPEN: 1.0, STATE_OPEN: 2.0}
+
+
+class CircuitOpenError(AWSAPIError):
+    """The region's circuit is open: fail fast, retry after the probe
+    window."""
+
+    def __init__(self, region: str, retry_after: float):
+        super().__init__(
+            "CircuitOpen",
+            f"circuit for region {region!r} is open; "
+            f"retry after {retry_after:.2f}s")
+        self.region = region
+        self.retry_after = retry_after
+
+
+class CircuitBreaker:
+    def __init__(self, region: str = "global", window: float = 30.0,
+                 min_calls: int = 10, failure_threshold: float = 0.5,
+                 open_seconds: float = 5.0, half_open_probes: int = 1,
+                 registry: "Optional[metrics.Registry]" = None,
+                 clock=time.monotonic):
+        self.region = region
+        self._clock = clock
+        self.window = window
+        self.min_calls = min_calls
+        self.failure_threshold = failure_threshold
+        self.open_seconds = open_seconds
+        self.half_open_probes = half_open_probes
+        self._registry = registry
+        self._lock = locks.make_lock(f"circuit-breaker-{region}")
+        self._events: "deque[tuple[float, bool]]" = deque()
+        self._state = STATE_CLOSED
+        self._opened_until = 0.0
+        self._probes_inflight = 0
+
+    # -- state ----------------------------------------------------------
+
+    def state(self, now: Optional[float] = None) -> str:
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._refresh_locked(now)
+            return self._state
+
+    def state_value(self) -> float:
+        """Numeric encoding for the circuit_state gauge."""
+        return STATE_VALUES[self.state()]
+
+    def _refresh_locked(self, now: float) -> None:
+        if self._state == STATE_OPEN and now >= self._opened_until:
+            self._transition_locked(STATE_HALF_OPEN)
+            self._probes_inflight = 0
+
+    def _transition_locked(self, to: str) -> None:
+        if self._state == to:
+            return
+        self._state = to
+        metrics.record_circuit_transition(self.region, to,
+                                          registry=self._registry)
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - self.window
+        events = self._events
+        while events and events[0][0] < horizon:
+            events.popleft()
+
+    # -- call gating ----------------------------------------------------
+
+    def check_open(self, now: Optional[float] = None) -> None:
+        """Fail fast without claiming a half-open probe slot — the
+        cheap pre-gate callers run BEFORE paying any per-call cost
+        (token reserve, pacing sleep).  Fully OPEN raises; HALF_OPEN
+        with every probe slot already taken raises too (those callers
+        would only lose at ``allow()`` after paying the pacing debt);
+        CLOSED — and HALF_OPEN with a free slot — pass, and ``allow()``
+        still decides actual probe admission."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._refresh_locked(now)
+            if self._state == STATE_OPEN:
+                raise CircuitOpenError(self.region,
+                                       max(0.05, self._opened_until - now))
+            if (self._state == STATE_HALF_OPEN
+                    and self._probes_inflight >= self.half_open_probes):
+                raise CircuitOpenError(self.region,
+                                       max(0.05, self.open_seconds / 4))
+
+    def allow(self, now: Optional[float] = None) -> None:
+        """Admit one call or raise CircuitOpenError."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._refresh_locked(now)
+            if self._state == STATE_CLOSED:
+                return
+            if self._state == STATE_HALF_OPEN:
+                if self._probes_inflight < self.half_open_probes:
+                    self._probes_inflight += 1
+                    return
+                # probe slots taken: everyone else keeps failing fast
+                # for a fraction of the window while the probe decides
+                raise CircuitOpenError(self.region,
+                                       max(0.05, self.open_seconds / 4))
+            raise CircuitOpenError(self.region,
+                                   max(0.05, self._opened_until - now))
+
+    def record_success(self, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._refresh_locked(now)
+            if self._state == STATE_HALF_OPEN:
+                # the probe came back: the region recovered
+                self._transition_locked(STATE_CLOSED)
+                self._events.clear()
+                return
+            if self._state == STATE_CLOSED:
+                self._events.append((now, True))
+                self._prune_locked(now)
+
+    def record_failure(self, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._refresh_locked(now)
+            if self._state == STATE_HALF_OPEN:
+                self._open_locked(now)   # the probe failed: back to open
+                return
+            if self._state != STATE_CLOSED:
+                return
+            self._events.append((now, False))
+            self._prune_locked(now)
+            total = len(self._events)
+            if total < self.min_calls:
+                return
+            failures = sum(1 for _, ok in self._events if not ok)
+            if failures / total >= self.failure_threshold:
+                self._open_locked(now)
+
+    def _open_locked(self, now: float) -> None:
+        self._transition_locked(STATE_OPEN)
+        self._opened_until = now + self.open_seconds
+        self._events.clear()
+
+
+class AdaptiveTokenBucket:
+    """Token bucket whose capacity adapts to throttle feedback (AIMD:
+    multiplicative decrease on throttle, additive increase on
+    success).  ``reserve()`` always claims a token — when the bucket is
+    in debt the caller is told how long to sleep first, which paces
+    admission at the effective refill rate instead of erroring."""
+
+    def __init__(self, capacity: float = 500.0,
+                 refill_rate: float = 1000.0, min_capacity: float = 5.0,
+                 shrink_factor: float = 0.5, recover_step: float = 1.0,
+                 region: str = "global", clock=time.monotonic):
+        self._clock = clock
+        self.max_capacity = float(capacity)
+        self.refill_rate = float(refill_rate)
+        self.min_capacity = float(min_capacity)
+        self.shrink_factor = float(shrink_factor)
+        self.recover_step = float(recover_step)
+        self.region = region
+        self._lock = locks.make_lock(f"throttle-bucket-{region}")
+        self._capacity = self.max_capacity
+        self._tokens = self.max_capacity
+        self._at = self._clock()
+
+    def _effective_rate_locked(self) -> float:
+        # a shrunken bucket refills proportionally slower: capacity is
+        # the adaptive estimate of what the service will bear
+        return max(1e-9,
+                   self.refill_rate * (self._capacity / self.max_capacity))
+
+    def _refill_locked(self, now: float) -> None:
+        dt = max(0.0, now - self._at)
+        self._at = now
+        self._tokens = min(self._capacity,
+                           self._tokens + dt * self._effective_rate_locked())
+
+    def reserve(self, now: Optional[float] = None) -> float:
+        """Claim one token; returns seconds the caller must sleep
+        before issuing the call (0.0 when a token was available)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._refill_locked(now)
+            self._tokens -= 1.0
+            if self._tokens >= 0.0:
+                return 0.0
+            return -self._tokens / self._effective_rate_locked()
+
+    def on_throttle(self, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._refill_locked(now)
+            self._capacity = max(self.min_capacity,
+                                 self._capacity * self.shrink_factor)
+            self._tokens = min(self._tokens, self._capacity)
+
+    def on_success(self, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._refill_locked(now)
+            self._capacity = min(self.max_capacity,
+                                 self._capacity + self.recover_step)
+
+    def level(self) -> float:
+        """Current token count (the throttle_tokens gauge); may be
+        negative while callers are queued on debt."""
+        with self._lock:
+            self._refill_locked(self._clock())
+            return self._tokens
+
+    def capacity(self) -> float:
+        with self._lock:
+            return self._capacity

@@ -1,0 +1,68 @@
+"""Error classification: which failures deserve which response.
+
+One function, one table: ``classify(err)`` maps any exception an AWS
+call can raise into the four-way taxonomy the retry loop and circuit
+breaker dispatch on.  The code tables live in errors.py (so real.py's
+boto mapping and the fake's chaos engine share them); this module owns
+the precedence rules:
+
+1. ``NoRetryError`` anywhere in the explicit cause chain is TERMINAL —
+   the reconcile engine's drop contract outranks everything.
+2. An ``AWSAPIError`` classifies by its code: throttle codes ->
+   THROTTLE, transient codes -> TRANSIENT, ``*NotFoundException`` (or
+   the known suffix-less codes) -> NOT_FOUND, anything else TERMINAL.
+   An explicit ``retryable`` verdict from the transport (boto marks
+   5xx and connection resets retryable) overrides an unknown code.
+3. OS-level transport errors (``ConnectionError``, ``TimeoutError``,
+   ``socket``-class ``OSError``) are TRANSIENT: the request may never
+   have reached the service.
+4. Everything else — TypeError, KeyError, assertion failures — is
+   TERMINAL: retrying a programming error just multiplies it.
+"""
+from __future__ import annotations
+
+import enum
+
+from ..errors import (
+    AWSAPIError,
+    NOT_FOUND_CODES,
+    THROTTLE_CODES,
+    TRANSIENT_CODES,
+    is_no_retry,
+)
+
+
+class ErrorClass(enum.Enum):
+    THROTTLE = "throttle"      # back off AND shrink the send rate
+    TRANSIENT = "transient"    # back off and retry in-call
+    TERMINAL = "terminal"      # raise now; requeue policy decides
+    NOT_FOUND = "not_found"    # absence is an answer, not a fault
+
+
+def _classify_code(err: AWSAPIError) -> ErrorClass:
+    code = err.code or ""
+    if code in THROTTLE_CODES:
+        return ErrorClass.THROTTLE
+    if code.endswith("NotFoundException") or code in NOT_FOUND_CODES:
+        return ErrorClass.NOT_FOUND
+    if code in TRANSIENT_CODES:
+        return ErrorClass.TRANSIENT
+    # unknown code: trust an explicit transport verdict, else terminal
+    # (AWS 4xx client errors are not retryable; the reconcile loop's
+    # rate-limited requeue still gets its level-triggered second look)
+    if err.retryable:
+        return ErrorClass.TRANSIENT
+    return ErrorClass.TERMINAL
+
+
+def classify(err: BaseException) -> ErrorClass:
+    if is_no_retry(err):
+        return ErrorClass.TERMINAL
+    if isinstance(err, AWSAPIError):
+        return _classify_code(err)
+    if isinstance(err, (ConnectionError, TimeoutError)):
+        return ErrorClass.TRANSIENT
+    if isinstance(err, OSError):
+        # socket/DNS-layer trouble reaching the endpoint
+        return ErrorClass.TRANSIENT
+    return ErrorClass.TERMINAL

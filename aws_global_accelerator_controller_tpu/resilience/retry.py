@@ -1,0 +1,75 @@
+"""Retry policy: capped decorrelated-jitter backoff under a deadline.
+
+The backoff schedule is the "decorrelated jitter" variant (each sleep
+drawn uniformly from [base, 3 * previous sleep], capped) — under a
+throttling storm N clients on plain exponential backoff re-collide on
+every retry tier; decorrelation spreads the herd across the whole
+window.  Two independent budgets bound every wrapped call:
+
+- ``max_attempts``: total tries (first call included).  Exhaustion
+  raises :class:`RetryBudgetExceededError`.
+- ``deadline``: wall-clock seconds for the whole call including
+  backoff sleeps.  A sleep that would cross it raises
+  :class:`DeadlineExceededError` instead of parking the worker past
+  its useful life (NCCL-style bounded-timeout semantics, PAPERS.md).
+
+Both errors carry ``retry_after`` — the reconcile loop parks the key
+with ``Forget`` + ``AddAfter(retry_after)`` instead of hot-requeuing
+(reconcile.py error dispatch via ``errors.retry_after_hint``).
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from ..errors import AWSAPIError
+
+
+class RetryBudgetExceededError(AWSAPIError):
+    """All in-call attempts failed on retryable errors; the caller
+    should requeue after ``retry_after`` rather than retry inline."""
+
+    def __init__(self, op: str, attempts: int, retry_after: float):
+        super().__init__(
+            "RetryBudgetExceeded",
+            f"{op}: {attempts} attempts exhausted; "
+            f"retry after {retry_after:.2f}s")
+        self.op = op
+        self.attempts = attempts
+        self.retry_after = retry_after
+
+
+class DeadlineExceededError(AWSAPIError):
+    """The call (including backoff) would outlive its deadline."""
+
+    def __init__(self, op: str, deadline: float, retry_after: float):
+        super().__init__(
+            "DeadlineExceeded",
+            f"{op}: deadline of {deadline:.2f}s exceeded; "
+            f"retry after {retry_after:.2f}s")
+        self.op = op
+        self.deadline = deadline
+        self.retry_after = retry_after
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Per-call retry parameters (wrapper.ResilienceConfig carries the
+    deployment-level knobs; the fake factory substitutes fast ones)."""
+
+    max_attempts: int = 4
+    base_delay: float = 0.2
+    max_delay: float = 5.0
+    deadline: float = 30.0
+
+    def next_delay(self, rng: random.Random, prev: float) -> float:
+        """Decorrelated jitter: uniform in [base, 3*prev], capped."""
+        lo = self.base_delay
+        hi = max(lo, min(self.max_delay, 3.0 * max(prev, lo)))
+        return rng.uniform(lo, hi)
+
+    def requeue_hint(self, prev: float) -> float:
+        """Suggested park time after a budget/deadline failure: one
+        more (capped) backoff step — long enough to let a brownout
+        clear, short enough that convergence resumes promptly."""
+        return min(self.max_delay, max(self.base_delay, 2.0 * prev))

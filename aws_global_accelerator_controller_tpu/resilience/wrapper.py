@@ -1,0 +1,251 @@
+"""ResilientAPIs: the transparent policy wrapper around an AWSAPIs
+bundle.
+
+One per region (the factory builds it in ``provider_for``), composing
+the whole subsystem around every service call:
+
+    breaker.allow -> bucket.reserve (pace) -> inner call
+        -> classify -> {success | throttle | transient | terminal}
+        -> breaker/bucket feedback -> backoff-retry or raise
+
+Only the method names of the three API interfaces are wrapped; any
+other attribute (the fakes' ``register_load_balancer``/
+``create_hosted_zone`` seeding helpers) passes straight through, so a
+wrapped fake is drop-in for tests.  All waiting happens here, outside
+every lock (L102): the breaker and bucket only compute.
+
+Failure surface to callers:
+
+- terminal / not-found errors raise unchanged on the first attempt;
+- throttle / transient errors retry in-call under the policy, then
+  raise :class:`RetryBudgetExceededError` (attempt budget) or
+  :class:`DeadlineExceededError` (wall clock) with the original error
+  as ``__cause__`` and a ``retry_after`` park hint;
+- an open circuit raises :class:`CircuitOpenError` immediately.
+
+All three hint errors are AWSAPIError subclasses so typed provider
+call sites still catch them, but they are NOT answers about the
+resource: ``except AWSAPIError`` handlers that infer state from a
+failure (the provider's deleted-out-of-band rescue paths) must
+re-raise when ``errors.retry_after_hint(e) > 0`` — a brownout says
+nothing about whether the accelerator exists.  The same hint is how
+the reconcile loop parks the key instead of hot-requeuing.
+"""
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import metrics
+from .breaker import AdaptiveTokenBucket, CircuitBreaker
+from .classify import ErrorClass, classify
+from .retry import DeadlineExceededError, RetryBudgetExceededError, RetryPolicy
+
+# The wrapped call surface per service attribute (the abstract methods
+# of api.GlobalAcceleratorAPI / ELBv2API / Route53API — kept as literal
+# name sets so this package never imports the cloudprovider layer,
+# which imports it back through the factory).
+GA_METHODS = frozenset({
+    "list_accelerators", "describe_accelerator", "list_tags_for_resource",
+    "create_accelerator", "update_accelerator", "tag_resource",
+    "delete_accelerator", "list_listeners", "create_listener",
+    "update_listener", "delete_listener", "list_endpoint_groups",
+    "describe_endpoint_group", "create_endpoint_group",
+    "update_endpoint_group", "add_endpoints", "remove_endpoints",
+    "delete_endpoint_group",
+})
+ELB_METHODS = frozenset({"describe_load_balancers"})
+ROUTE53_METHODS = frozenset({
+    "list_hosted_zones", "list_hosted_zones_by_name",
+    "list_resource_record_sets", "change_resource_record_sets",
+})
+
+
+@dataclass(frozen=True)
+class ResilienceConfig:
+    """Deployment-level knobs for one region's resilient call layer.
+    Defaults are production-scale; FakeCloudFactory substitutes a fast
+    permissive profile so tests and benches stay sub-second."""
+
+    enabled: bool = True
+    # retry
+    max_attempts: int = 4
+    base_delay: float = 0.2
+    max_delay: float = 5.0
+    deadline: float = 30.0
+    # circuit breaker
+    breaker_window: float = 30.0
+    breaker_min_calls: int = 10
+    breaker_failure_threshold: float = 0.5
+    breaker_open_seconds: float = 5.0
+    half_open_probes: int = 1
+    # adaptive token bucket
+    bucket_capacity: float = 500.0
+    bucket_refill: float = 1000.0
+    bucket_min_capacity: float = 5.0
+    bucket_shrink: float = 0.5
+    bucket_recover: float = 1.0
+    # deterministic jitter for tests; None seeds from the OS
+    seed: Optional[int] = None
+
+    def retry_policy(self) -> RetryPolicy:
+        return RetryPolicy(max_attempts=self.max_attempts,
+                           base_delay=self.base_delay,
+                           max_delay=self.max_delay,
+                           deadline=self.deadline)
+
+
+# the fast profile the fake factory uses: real backoff shapes at
+# 100x speed, breaker thresholds high enough that the one-shot fault
+# injections of the ordinary e2e suites never trip it
+FAKE_CLOUD_CONFIG = ResilienceConfig(
+    max_attempts=4, base_delay=0.002, max_delay=0.05, deadline=5.0,
+    breaker_window=5.0, breaker_min_calls=50,
+    breaker_failure_threshold=0.9, breaker_open_seconds=0.25,
+    bucket_capacity=1e6, bucket_refill=1e6, bucket_min_capacity=100.0,
+    bucket_recover=100.0)
+
+
+class _ResilientService:
+    """Per-service proxy: wrapped methods go through the shared policy
+    engine, everything else passes through to the inner service."""
+
+    def __init__(self, inner, method_names, engine: "ResilientAPIs"):
+        self._inner = inner
+        self._methods = method_names
+        self._engine = engine
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name not in self._methods or not callable(attr):
+            return attr
+
+        def call(*args, **kwargs):
+            return self._engine.invoke(name, attr, args, kwargs)
+
+        call.__name__ = name
+        # cache the bound wrapper: __getattr__ only fires on misses
+        object.__setattr__(self, name, call)
+        return call
+
+
+class ResilientAPIs:
+    """Drop-in AWSAPIs bundle enforcing the resilience policy.
+
+    Shares ONE breaker + token bucket across the region's three
+    services: a regional brownout rarely respects service boundaries,
+    and the throttle budget the bucket estimates is per-principal, not
+    per-API.
+    """
+
+    def __init__(self, inner, region: str = "global",
+                 config: Optional[ResilienceConfig] = None,
+                 registry: "Optional[metrics.Registry]" = None,
+                 clock=time.monotonic, sleep=time.sleep):
+        cfg = config or ResilienceConfig()
+        self.inner = inner
+        self.region = region
+        self.config = cfg
+        self.policy = cfg.retry_policy()
+        self._registry = registry
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = random.Random(cfg.seed)
+        # the breaker/bucket share this wrapper's clock: their gauge
+        # callbacks (state_value/level) run on the metrics scrape
+        # thread with no explicit `now`, and a real-clock default
+        # there would corrupt fake-clock state in tests
+        self.breaker = CircuitBreaker(
+            region=region, window=cfg.breaker_window,
+            min_calls=cfg.breaker_min_calls,
+            failure_threshold=cfg.breaker_failure_threshold,
+            open_seconds=cfg.breaker_open_seconds,
+            half_open_probes=cfg.half_open_probes, registry=registry,
+            clock=clock)
+        self.bucket = AdaptiveTokenBucket(
+            capacity=cfg.bucket_capacity, refill_rate=cfg.bucket_refill,
+            min_capacity=cfg.bucket_min_capacity,
+            shrink_factor=cfg.bucket_shrink,
+            recover_step=cfg.bucket_recover, region=region, clock=clock)
+        self.elb = _ResilientService(inner.elb, ELB_METHODS, self)
+        self.ga = _ResilientService(inner.ga, GA_METHODS, self)
+        self.route53 = _ResilientService(inner.route53, ROUTE53_METHODS,
+                                         self)
+        metrics.watch_circuit_state(region, self.breaker.state_value,
+                                    registry=registry)
+        metrics.watch_throttle_tokens(region, self.bucket.level,
+                                      registry=registry)
+
+    # ------------------------------------------------------------------
+
+    def invoke(self, op: str, fn, args, kwargs):
+        """One policy-governed call: breaker gate, bucket pacing,
+        classify-and-retry under the attempt budget and deadline."""
+        policy = self.policy
+        deadline = self._clock() + policy.deadline
+        prev_delay = policy.base_delay
+        attempt = 1
+        while True:
+            # cheap open-circuit pre-gate first (claims nothing), so a
+            # fully open circuit costs no token and no pacing sleep —
+            # otherwise failing-fast workers would drain the bucket
+            # into debt with zero traffic reaching the service.  Then
+            # pace BEFORE the probe-claiming allow(): a half-open
+            # probe slot claimed by allow() must always reach the
+            # inner call, so nothing that can raise may sit between
+            # allow() and the try block.
+            self.breaker.check_open(self._clock())
+            self._pace(op, deadline)
+            self.breaker.allow(self._clock())
+            try:
+                result = fn(*args, **kwargs)
+            except Exception as e:
+                cls = classify(e)
+                if cls is ErrorClass.THROTTLE:
+                    now = self._clock()
+                    self.bucket.on_throttle(now)
+                    self.breaker.record_failure(now)
+                elif cls is ErrorClass.TRANSIENT:
+                    self.breaker.record_failure(self._clock())
+                else:
+                    # the service answered (not-found / validation):
+                    # the region is healthy, the request is just wrong
+                    self.breaker.record_success(self._clock())
+                    raise
+                if attempt >= policy.max_attempts:
+                    raise RetryBudgetExceededError(
+                        op, attempt,
+                        policy.requeue_hint(prev_delay)) from e
+                delay = policy.next_delay(self._rng, prev_delay)
+                prev_delay = delay
+                if self._clock() + delay > deadline:
+                    metrics.record_aws_call_deadline_exceeded(
+                        op, registry=self._registry)
+                    raise DeadlineExceededError(
+                        op, policy.deadline,
+                        policy.requeue_hint(prev_delay)) from e
+                metrics.record_aws_call_retry(op,
+                                              registry=self._registry)
+                attempt += 1
+                self._sleep(delay)
+            else:
+                now = self._clock()
+                self.breaker.record_success(now)
+                self.bucket.on_success(now)
+                return result
+
+    def _pace(self, op: str, deadline: float) -> None:
+        """Client-side throttle pacing: sleep off the token debt, but
+        never past the call deadline."""
+        wait = self.bucket.reserve(self._clock())
+        if wait <= 0.0:
+            return
+        if self._clock() + wait > deadline:
+            metrics.record_aws_call_deadline_exceeded(
+                op, registry=self._registry)
+            raise DeadlineExceededError(
+                op, self.policy.deadline,
+                self.policy.requeue_hint(wait))
+        self._sleep(wait)

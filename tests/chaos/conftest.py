@@ -1,0 +1,10 @@
+"""Chaos-suite conftest: make the shared e2e harness importable.
+
+pytest's rootdir-relative sys.path insertion covers each test file's
+own directory only; the chaos scenarios reuse ``tests/harness.py``.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))

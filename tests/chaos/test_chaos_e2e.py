@@ -1,0 +1,257 @@
+"""Chaos e2e: all three controllers converge through a seeded fault
+schedule — 20% transient errors everywhere, a Global Accelerator
+throttle burst, and a 5s regional (ELB) blackout — with the resilient
+call layer absorbing the storm: retries visible in metrics, circuits
+opening during the blackout and returning to closed, requeue volume
+bounded (parked keys, not hot loops).
+
+The schedule is seeded: the injector's probabilistic decisions are a
+pure function of (seed, method, call index), so the same seed injects
+the same faults for the same call sequence (the determinism contract
+tests/chaos/test_chaos_engine.py asserts exactly).
+"""
+import time
+
+import pytest
+
+from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    ROUTE53_HOSTNAME_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+    PortRange,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+from aws_global_accelerator_controller_tpu.resilience import (
+    ResilienceConfig,
+    STATE_CLOSED,
+)
+
+from harness import CLUSTER, Cluster, wait_until
+
+SEED = 20260804
+REGION = "ap-northeast-1"
+
+# real policy shapes at test speed: in-call budgets of a few ms,
+# breaker that opens on ~8 failures over a 2s window and probes every
+# 300ms, an adaptive bucket small enough that a throttle burst visibly
+# shrinks it
+CHAOS_CONFIG = ResilienceConfig(
+    max_attempts=4, base_delay=0.002, max_delay=0.05, deadline=3.0,
+    breaker_window=2.0, breaker_min_calls=8,
+    breaker_failure_threshold=0.5, breaker_open_seconds=0.3,
+    bucket_capacity=200.0, bucket_refill=2000.0,
+    bucket_min_capacity=5.0, bucket_recover=5.0, seed=SEED)
+
+
+def nlb_hostname(name):
+    return f"{name}-0123456789abcdef.elb.{REGION}.amazonaws.com"
+
+
+def managed_service(name, dns_hostname=None):
+    ann = {AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+           AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true"}
+    if dns_hostname:
+        ann[ROUTE53_HOSTNAME_ANNOTATION] = dns_hostname
+    return Service(
+        metadata=ObjectMeta(name=name, namespace="default",
+                            annotations=ann),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(
+                hostname=nlb_hostname(name))])),
+    )
+
+
+def owned(cluster, name):
+    provider = cluster.factory.global_provider()
+    return provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", name)
+
+
+def _open_transitions(reg):
+    total = 0.0
+    for line in reg.render().splitlines():
+        if line.startswith("circuit_transitions_total") \
+                and 'to="open"' in line:
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(workers=2, queue_qps=1000.0, queue_burst=1000,
+                resilience=CHAOS_CONFIG, fault_seed=SEED).start()
+    yield c
+    c.shutdown()
+
+
+def test_all_controllers_converge_through_seeded_chaos(cluster):
+    reg = metrics.default_registry
+    retries_before = reg.counter_value("aws_call_retries_total")
+    syncs_before = reg.counter_value("controller_sync_total")
+    opens_before = _open_transitions(reg)
+    faults = cluster.cloud.faults
+
+    # -- seed the healthy world BEFORE arming the schedule ------------
+    lbs = {}
+    for name in ("svc-a", "svc-b", "svc-c", "svc-late"):
+        lbs[name] = cluster.cloud.elb.register_load_balancer(
+            name, nlb_hostname(name), REGION)
+    cluster.cloud.route53.create_hosted_zone("example.com")
+    ga = cluster.cloud.ga
+    ext_acc = ga.create_accelerator("ext", "IPV4", True, {})
+    ext_listener = ga.create_listener(
+        ext_acc.accelerator_arn, [PortRange(80, 80)], "TCP", "NONE")
+    seed_lb = cluster.cloud.elb.register_load_balancer(
+        "seed", "seed-0123456789abcdef.elb.eu-west-1.amazonaws.com",
+        "eu-west-1")
+    ext_eg = ga.create_endpoint_group(
+        ext_listener.listener_arn, "eu-west-1",
+        seed_lb.load_balancer_arn, False)
+
+    # -- the schedule: 20% transient errors + latency everywhere, a
+    # GA throttle burst, one 5s ELB ("regional") blackout ------------
+    faults.set_error_rate("*", 0.20)
+    faults.set_latency("*", 0.001)
+    faults.add_throttle_burst(start_in=0.3, duration=1.0, service="ga")
+    faults.add_blackout(start_in=0.5, duration=5.0, service="elb")
+
+    # -- drive all three controllers ----------------------------------
+    cluster.kube.services.create(
+        managed_service("svc-a", "www.example.com"))
+    cluster.kube.services.create(
+        managed_service("svc-b", "api.example.com"))
+    cluster.kube.services.create(managed_service("svc-c"))
+    cluster.operator.endpoint_group_bindings.create(EndpointGroupBinding(
+        metadata=ObjectMeta(name="binding", namespace="default"),
+        spec=EndpointGroupBindingSpec(
+            endpoint_group_arn=ext_eg.endpoint_group_arn,
+            weight=32, service_ref=ServiceReference(name="svc-c"))))
+    # one service lands mid-blackout: its whole ensure chain must ride
+    # the outage out and still converge
+    time.sleep(1.0)
+    cluster.kube.services.create(managed_service("svc-late"))
+
+    # -- convergence to the desired cloud state -----------------------
+    for name in ("svc-a", "svc-b", "svc-c", "svc-late"):
+        wait_until(lambda n=name: len(owned(cluster, n)) == 1,
+                   timeout=30.0, message=f"accelerator for {name}")
+
+    def a_records():
+        try:
+            zone = next(z for z in
+                        cluster.cloud.route53.list_hosted_zones())
+            return {(r.name, r.type) for r in
+                    cluster.cloud.route53.list_resource_record_sets(
+                        zone.id)}
+        except Exception:
+            return set()
+
+    wait_until(lambda: {("www.example.com.", "A"),
+                        ("www.example.com.", "TXT"),
+                        ("api.example.com.", "A"),
+                        ("api.example.com.", "TXT")} <= a_records(),
+               timeout=30.0, message="Route53 records for both hostnames")
+
+    def binding_endpoint():
+        try:
+            got = cluster.cloud.ga.describe_endpoint_group(
+                ext_eg.endpoint_group_arn)
+            return {d.endpoint_id: d for d in got.endpoint_descriptions}
+        except Exception:
+            return {}
+
+    wait_until(lambda: lbs["svc-c"].load_balancer_arn
+               in binding_endpoint(),
+               timeout=30.0, message="binding endpoint added")
+
+    # -- the storm was real and the layer absorbed it -----------------
+    counts = faults.injected_counts()
+    assert sum(counts.values()) > 0, "chaos schedule injected nothing"
+    assert counts.get("describe_load_balancers", 0) > 0, \
+        "the ELB blackout never bit"
+    assert reg.counter_value("aws_call_retries_total") > retries_before, \
+        "retries must be visible in metrics"
+    assert _open_transitions(reg) > opens_before, \
+        "the 5s blackout must trip at least one circuit open"
+
+    # -- recovery: lights on, every circuit must return to closed -----
+    faults.set_error_rate("*", 0.0)
+    faults.set_latency("*", 0.0)
+
+    def all_closed():
+        for provider in cluster.factory._providers.values():
+            apis = provider.apis
+            try:
+                # a real read drives the half-open probe; state alone
+                # would sit in half_open forever on an idle system
+                apis.ga.list_accelerators()
+            except Exception:
+                return False
+            if apis.breaker.state() != STATE_CLOSED:
+                return False
+        return True
+
+    wait_until(all_closed, timeout=10.0,
+               message="all circuits back to closed")
+
+    # -- bounded requeues: parked keys, not hot loops -----------------
+    sync_delta = reg.counter_value("controller_sync_total") - syncs_before
+    assert sync_delta < 3000, \
+        f"requeue volume unbounded under chaos: {sync_delta} syncs"
+
+    # weight survived the storm too
+    assert binding_endpoint()[lbs["svc-c"].load_balancer_arn].weight == 32
+
+
+def test_throttle_burst_shrinks_bucket_and_recovers(cluster):
+    """AIMD visibility: a 100% GA throttle burst drags the adaptive
+    capacity down; post-burst successes recover it."""
+    cluster.cloud.elb.register_load_balancer(
+        "svc-t", nlb_hostname("svc-t"), REGION)
+    provider = cluster.factory.global_provider()
+    bucket = provider.apis.bucket
+    start_capacity = bucket.capacity()
+
+    cluster.cloud.faults.add_throttle_burst(start_in=0.0, duration=0.4,
+                                            service="ga")
+    deadline = time.monotonic() + 2.0
+    shrunk = start_capacity
+    while time.monotonic() < deadline:
+        try:
+            provider.apis.ga.list_accelerators()
+        except Exception:
+            pass
+        shrunk = min(shrunk, bucket.capacity())
+        if shrunk < start_capacity:
+            break
+    assert shrunk < start_capacity, "throttle feedback never shrank " \
+                                    "the bucket"
+    assert reg_level_positive(bucket)
+
+    # burst over: successes recover capacity additively
+    wait_until(lambda: provider.apis.ga.list_accelerators() is not None
+               and bucket.capacity() > shrunk,
+               timeout=5.0, message="bucket capacity recovery")
+
+
+def reg_level_positive(bucket):
+    # the throttle_tokens gauge stays finite/observable
+    return isinstance(bucket.level(), float)

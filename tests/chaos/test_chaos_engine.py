@@ -1,0 +1,159 @@
+"""Chaos engine unit tests: determinism, scheduling, back-compat.
+
+The FaultInjector's probabilistic decisions are pure functions of
+(seed, method, per-method call index) — crc32-hashed, so they hold
+across processes and thread interleavings.  Windows are tested with an
+injected clock; nothing here sleeps beyond a single latency-injection
+probe.
+"""
+import time
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.fake import (
+    FakeAWSCloud,
+    FaultInjector,
+)
+from aws_global_accelerator_controller_tpu.errors import AWSAPIError
+
+
+def drive(injector, schedule):
+    """Replay a scripted call sequence; returns per-method injected
+    counts."""
+    for method in schedule:
+        try:
+            injector.check(method)
+        except Exception:
+            pass
+    return injector.injected_counts()
+
+
+SCRIPT = (["list_accelerators"] * 40 + ["describe_accelerator"] * 40
+          + ["describe_load_balancers"] * 20) * 3
+
+
+def test_same_seed_same_injected_faults():
+    a = FaultInjector(seed=1337)
+    b = FaultInjector(seed=1337)
+    for inj in (a, b):
+        inj.set_error_rate("*", 0.2)
+    counts_a = drive(a, SCRIPT)
+    counts_b = drive(b, SCRIPT)
+    assert counts_a == counts_b
+    assert sum(counts_a.values()) > 0
+    # ~20% of 300 calls, binomially: the seed fixes the exact number
+    assert 30 <= sum(counts_a.values()) <= 90
+
+
+def test_different_seed_different_schedule():
+    a = FaultInjector(seed=1)
+    b = FaultInjector(seed=2)
+    for inj in (a, b):
+        inj.set_error_rate("*", 0.2)
+    assert drive(a, SCRIPT) != drive(b, SCRIPT)
+
+
+def test_per_method_rate_overrides_wildcard():
+    inj = FaultInjector(seed=7)
+    inj.set_error_rate("*", 0.0)            # clears, not zero-rate-all
+    inj.set_error_rate("list_accelerators", 1.0)
+    with pytest.raises(AWSAPIError):
+        inj.check("list_accelerators")
+    inj.check("describe_accelerator")       # untouched method is clean
+    assert inj.injected_counts() == {"list_accelerators": 1}
+    assert inj.call_counts() == {"list_accelerators": 1,
+                                 "describe_accelerator": 1}
+
+
+def test_one_shot_fail_on_takes_precedence_and_is_counted():
+    inj = FaultInjector(seed=7)
+    inj.set_error_rate("list_accelerators", 0.0)
+    inj.fail_on("list_accelerators", AWSAPIError("InternalError"), times=2)
+    for _ in range(2):
+        with pytest.raises(AWSAPIError):
+            inj.check("list_accelerators")
+    inj.check("list_accelerators")          # queue drained
+    assert inj.injected_counts()["list_accelerators"] == 2
+
+
+def test_throttle_burst_window_scopes_by_service_and_time():
+    clock = {"t": 100.0}
+    inj = FaultInjector(seed=7, clock=lambda: clock["t"])
+    inj.add_throttle_burst(start_in=1.0, duration=2.0, service="ga")
+    inj.check("list_accelerators")          # before the window
+    clock["t"] = 101.5                      # inside the window
+    with pytest.raises(AWSAPIError) as ei:
+        inj.check("list_accelerators")
+    assert ei.value.code == "ThrottlingException"
+    inj.check("describe_load_balancers")    # elb: out of scope
+    clock["t"] = 103.5                      # window over
+    inj.check("list_accelerators")
+    assert inj.injected_counts() == {"list_accelerators": 1}
+
+
+def test_blackout_window_kills_every_matching_call():
+    clock = {"t": 100.0}
+    inj = FaultInjector(seed=7, clock=lambda: clock["t"])
+    inj.add_blackout(start_in=0.0, duration=5.0, service="elb")
+    for _ in range(10):
+        with pytest.raises(AWSAPIError) as ei:
+            inj.check("describe_load_balancers")
+        assert ei.value.code == "ServiceUnavailable"
+    inj.check("list_accelerators")          # ga unaffected
+    clock["t"] = 106.0
+    inj.check("describe_load_balancers")    # lights back on
+    assert inj.injected_counts()["describe_load_balancers"] == 10
+
+
+def test_window_and_background_rate_draw_independently():
+    """A partial-rate window and the background error rate are
+    separate salted draws: with a shared draw, every index below the
+    background threshold would already be consumed by the (larger)
+    window rate and the background fault would NEVER fire inside the
+    window."""
+    clock = {"t": 100.0}
+    inj = FaultInjector(seed=7, clock=lambda: clock["t"])
+    inj.add_throttle_burst(start_in=0.0, duration=1e9, service="ga",
+                           rate=0.5)
+    inj.set_error_rate("list_accelerators", 0.2)
+    codes = []
+    for _ in range(400):
+        try:
+            inj.check("list_accelerators")
+        except AWSAPIError as e:
+            codes.append(e.code)
+    assert "ThrottlingException" in codes
+    assert "InternalError" in codes, \
+        "background rate starved by the window's draw"
+    # composite rate ~ 1 - 0.5*0.8 = 0.6, not the window's 0.5
+    assert len(codes) > 400 * 0.5
+
+
+def test_expired_windows_are_pruned():
+    clock = {"t": 100.0}
+    inj = FaultInjector(seed=7, clock=lambda: clock["t"])
+    inj.add_blackout(start_in=0.0, duration=1.0)
+    clock["t"] = 102.0
+    inj.check("list_accelerators")
+    assert inj._windows == []               # bookkeeping stays bounded
+
+
+def test_latency_injection_delays_the_call():
+    inj = FaultInjector(seed=7)
+    inj.set_latency("list_accelerators", 0.03)
+    t0 = time.monotonic()
+    inj.check("list_accelerators")
+    assert time.monotonic() - t0 >= 0.025
+    inj.set_latency("list_accelerators", 0.0)
+    t0 = time.monotonic()
+    inj.check("list_accelerators")
+    assert time.monotonic() - t0 < 0.02
+
+
+def test_fake_cloud_threads_seed_through():
+    cloud = FakeAWSCloud(fault_seed=42)
+    cloud.faults.set_error_rate("create_accelerator", 1.0)
+    with pytest.raises(AWSAPIError):
+        cloud.ga.create_accelerator("n", "IPV4", True, {})
+    assert cloud.ga.list_accelerators() == []   # the create never landed
+    assert cloud.faults.injected_counts() == {"create_accelerator": 1}

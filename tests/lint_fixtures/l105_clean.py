@@ -1,0 +1,17 @@
+"""Rule L105 clean fixture: every service call rides ``apis`` (the
+wrapped bundle), and same-named methods on non-service receivers are
+not service calls."""
+
+
+class Provider:
+    def __init__(self, apis):
+        self.apis = apis
+
+    def sync(self, arn, factory):
+        self.apis.ga.describe_accelerator(arn)
+        self.apis.elb.describe_load_balancers(["x"])
+        factory.provider.apis.route53.list_hosted_zones()
+        return self.describe_accelerator(arn)
+
+    def describe_accelerator(self, arn):
+        return self.apis.ga.describe_accelerator(arn)

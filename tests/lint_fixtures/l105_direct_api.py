@@ -1,0 +1,16 @@
+"""Rule L105 fixture: AWS service methods reached without going
+through ``apis`` (the ResilientAPIs injection point) run bare — no
+retry, breaker, or deadline policy."""
+
+
+class Controller:
+    def __init__(self, cloud):
+        self.cloud = cloud
+        self.ga = cloud.ga
+
+    def sync(self, arn):
+        self.cloud.ga.describe_accelerator(arn)
+        self.ga.list_accelerators()
+        lbs = self.cloud.elb.describe_load_balancers(["x"])
+        self.cloud.route53.list_hosted_zones()  # race: deliberate bare read
+        return lbs

@@ -69,6 +69,24 @@ def test_bench_reconcile_converges_small_fleet():
     assert r["throughput"] > 0
 
 
+def test_bench_resilience_overhead_smoke(monkeypatch, tmp_path):
+    """Small-N run of the resilience-overhead leg: the create-storm
+    rides the (always-on) ResilientAPIs wrapper, the microbench
+    produces finite per-call numbers, and the history record lands."""
+    monkeypatch.setattr(bench, "_HISTORY_PATH",
+                        str(tmp_path / "hist.jsonl"))
+    r = bench.bench_resilience_overhead(n_services=6, micro_iters=200)
+    assert r["services"] == 6
+    assert r["throughput"] > 0
+    assert r["bare_us_per_call"] > 0
+    assert r["wrapped_us_per_call"] > 0
+    # the wrapper's zero-fault fast path is a breaker gate + bucket
+    # reserve + bookkeeping: if it ever costs more than 200us/call it
+    # stopped being a fast path (typical measured: ~5us)
+    assert r["overhead_us_per_call"] < 200.0
+    assert (tmp_path / "hist.jsonl").exists()
+
+
 def test_bench_reconcile_scaling_smoke():
     """Small-N run of the scaling leg so it can't silently rot between
     the real 200→1000 invocations: both legs converge, the ratio is

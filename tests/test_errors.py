@@ -27,3 +27,70 @@ def test_plain_error_is_retryable():
 def test_message_formatting():
     err = new_no_retry_errorf("invalid resource key: %s", "x/y/z")
     assert str(err) == "invalid resource key: x/y/z"
+
+
+def test_aws_api_error_carries_code_and_retryable():
+    from aws_global_accelerator_controller_tpu.errors import AWSAPIError
+
+    err = AWSAPIError("ThrottlingException", "slow down")
+    assert err.code == "ThrottlingException"
+    assert err.retryable is None
+    assert err.is_throttle()
+    marked = AWSAPIError("Weird", retryable=True)
+    assert marked.retryable is True and not marked.is_throttle()
+
+
+def test_is_throttle_wrapped_cause_walk_mirrors_is_no_retry():
+    from aws_global_accelerator_controller_tpu.errors import (
+        AWSAPIError,
+        is_throttle,
+    )
+
+    try:
+        try:
+            raise AWSAPIError("TooManyRequestsException")
+        except AWSAPIError as inner:
+            raise RuntimeError("outer") from inner
+    except RuntimeError as outer:
+        assert is_throttle(outer)
+    assert not is_throttle(RuntimeError("plain"))
+    assert not is_throttle(AWSAPIError("InternalError"))
+
+
+def test_boto_client_error_mapping():
+    """real.py maps boto ClientError shapes into the taxonomy:
+    throttle codes keep their code, unknown 5xx marks retryable, the
+    NotFound pair keeps its dedicated types."""
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.real import (
+        _wrap_client_error,
+    )
+    from aws_global_accelerator_controller_tpu.errors import (
+        AWSAPIError,
+        ListenerNotFoundError,
+        is_throttle,
+    )
+
+    class FakeClientError(Exception):
+        def __init__(self, code, status=400):
+            super().__init__(code)
+            self.response = {
+                "Error": {"Code": code},
+                "ResponseMetadata": {"HTTPStatusCode": status},
+            }
+
+    wrapped = _wrap_client_error(FakeClientError("ThrottlingException",
+                                                 400))
+    assert isinstance(wrapped, AWSAPIError)
+    assert is_throttle(wrapped) and wrapped.retryable is True
+
+    five_xx = _wrap_client_error(FakeClientError("SomeNewCode", 503))
+    assert five_xx.retryable is True   # unknown code, 5xx -> transient
+
+    four_xx = _wrap_client_error(FakeClientError("AccessDenied", 403))
+    assert four_xx.retryable is None   # classify() decides: terminal
+
+    nf = _wrap_client_error(FakeClientError("ListenerNotFoundException"))
+    assert isinstance(nf, ListenerNotFoundError)
+
+    bare = _wrap_client_error(ValueError("no response attr"))
+    assert isinstance(bare, AWSAPIError) and bare.code == "Unknown"

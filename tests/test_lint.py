@@ -280,6 +280,27 @@ def test_l104_singleflight_key_without_gen_fires():
         ("L104", 11), ("L104", 15)]
 
 
+def test_l105_direct_api_call_fires_and_waiver_suppresses():
+    """Bare service calls (no ``apis`` in the receiver chain) fire;
+    the ``# race:`` waiver spelling suppresses line 15's deliberate
+    bare read."""
+    assert _cfindings("l105_direct_api.py") == [
+        ("L105", 12), ("L105", 13), ("L105", 14)]
+
+
+def test_l105_wrapped_calls_clean():
+    assert _cfindings("l105_clean.py") == []
+
+
+def test_l105_out_of_scope_paths_exempt(tmp_path):
+    """Tests and tools observe the fake cloud directly by design —
+    the rule only polices the shipped package (and its fixtures)."""
+    f = tmp_path / "observer.py"
+    f.write_text("def peek(cloud):\n"
+                 "    return cloud.ga.list_accelerators()\n")
+    assert concurrency_lint.lint_files([f]) == []
+
+
 def test_seeded_mutation_of_update_accelerator_is_caught(tmp_path):
     """Acceptance probe: drop the ``with self._s.lock:`` block from the
     REAL provider's ``_update_accelerator`` and the gate must fire —

@@ -129,3 +129,100 @@ def test_process_delete_error_requeues():
     run_one(q, key_to_obj, delete=delete)
     item, _ = q.get(timeout=1.0)
     assert item == "ns/gone", "failed delete must be retried"
+
+
+def test_nested_cause_no_retry_error_drops():
+    """A NoRetryError buried under ``raise ... from`` layers still
+    takes the drop path — the errors.As-over-Unwrap walk, end to end
+    through the dispatch table."""
+    q = make_queue()
+    q.add("ns/nested")
+
+    def upsert(obj):
+        try:
+            try:
+                raise new_no_retry_errorf("invalid key shape")
+            except Exception as inner:
+                raise RuntimeError("ensure failed") from inner
+        except Exception as mid:
+            raise RuntimeError("sync failed") from mid
+
+    run_one(q, lambda k: FakeObj(k), upsert=upsert)
+    item, _ = q.get(timeout=0.2)
+    assert item is None, "nested NoRetryError must not requeue"
+    assert q.num_requeues("ns/nested") == 0
+
+
+def test_retry_budget_exhaustion_parks_with_add_after():
+    """An error carrying a retry_after hint (the resilience layer's
+    budget/deadline/circuit errors) takes Forget + AddAfter, not the
+    rate limiter: the failure count resets and the key reappears only
+    after the hinted delay."""
+    from aws_global_accelerator_controller_tpu.resilience import (
+        RetryBudgetExceededError,
+    )
+
+    q = make_queue()
+    q.add("ns/browned-out")
+
+    def upsert(obj):
+        raise RetryBudgetExceededError("describe_accelerator", 4, 0.05)
+
+    run_one(q, lambda k: FakeObj(k), upsert=upsert)
+    assert q.num_requeues("ns/browned-out") == 0, \
+        "park path must Forget (the in-call budget was the backoff)"
+    item, _ = q.get(timeout=1.0)
+    assert item == "ns/browned-out", "parked key must come back"
+
+
+def test_retry_after_hint_beats_rate_limited_requeue():
+    """Precedence: a hint-carrying error wrapped in a plain error still
+    parks (hint wins over add_rate_limited); a plain error without a
+    hint takes the rate limiter."""
+    from aws_global_accelerator_controller_tpu.resilience import (
+        CircuitOpenError,
+    )
+
+    q = make_queue()
+    q.add("ns/mixed")
+
+    def upsert(obj):
+        try:
+            raise CircuitOpenError("us-west-2", 0.04)
+        except Exception as inner:
+            raise RuntimeError("ensure failed") from inner
+
+    run_one(q, lambda k: FakeObj(k), upsert=upsert)
+    assert q.num_requeues("ns/mixed") == 0          # parked, not limited
+
+    def plain(obj):
+        raise RuntimeError("no hint")
+
+    # the parked key reappears after the hint delay; failing it with a
+    # hint-less error takes the rate limiter
+    run_one(q, lambda k: FakeObj(k), upsert=plain)
+    assert q.num_requeues("ns/mixed") == 1          # rate-limited path
+
+
+def test_requeue_count_bounds_under_permanent_failure():
+    """A permanently failing key keeps cycling through the rate
+    limiter: the failure count grows one per sync (no hot loop — each
+    cycle waits out the limiter delay) and the per-item delay is
+    capped at the limiter's max."""
+    limiter = ItemExponentialFailureRateLimiter(0.001, 0.01)
+    q = RateLimitingQueue(rate_limiter=limiter)
+    q.add("ns/doomed")
+
+    def upsert(obj):
+        raise RuntimeError("permanently broken")
+
+    for expected in range(1, 7):
+        # run_one pops the (delayed) key, fails it, re-adds it
+        # rate-limited: exactly one failure-count step per cycle
+        run_one(q, lambda k: FakeObj(k), upsert=upsert)
+        assert q.num_requeues("ns/doomed") == expected
+    # the NEXT delay (failures=6: base * 2^6 = 64ms uncapped) must cap
+    # at the limiter max — the bound that keeps a permanent failure
+    # from backing off into oblivion or hot-looping
+    assert limiter.when("ns/doomed") <= 0.01 + 1e-9, \
+        "backoff must cap at the limiter max, not grow unboundedly"

@@ -1,0 +1,558 @@
+"""Unit tests for the resilient AWS call layer (resilience/).
+
+Classification table, backoff/deadline budgets, circuit breaker state
+machine, AIMD token bucket, and the ResilientAPIs wrapper composing
+them — all against stub services with injected clocks, so nothing here
+sleeps for real.
+"""
+import random
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.errors import (
+    AWSAPIError,
+    NoRetryError,
+    is_throttle,
+    retry_after_hint,
+)
+from aws_global_accelerator_controller_tpu.metrics import Registry
+from aws_global_accelerator_controller_tpu.resilience import (
+    AdaptiveTokenBucket,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    ErrorClass,
+    ResilienceConfig,
+    ResilientAPIs,
+    RetryBudgetExceededError,
+    RetryPolicy,
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+    classify,
+)
+
+
+# ---------------------------------------------------------------------
+# classify
+# ---------------------------------------------------------------------
+
+def test_classify_throttle_codes():
+    for code in ("ThrottlingException", "TooManyRequestsException",
+                 "RequestLimitExceeded", "SlowDown"):
+        assert classify(AWSAPIError(code)) is ErrorClass.THROTTLE
+
+
+def test_classify_transient_codes_and_retryable_override():
+    assert classify(AWSAPIError("InternalError")) is ErrorClass.TRANSIENT
+    assert classify(AWSAPIError("ServiceUnavailable")) is ErrorClass.TRANSIENT
+    # unknown code, but the transport said 5xx/retryable
+    assert classify(AWSAPIError("WeirdNewCode", retryable=True)) \
+        is ErrorClass.TRANSIENT
+
+
+def test_classify_not_found():
+    assert classify(AWSAPIError("AcceleratorNotFoundException")) \
+        is ErrorClass.NOT_FOUND
+    assert classify(AWSAPIError("NoSuchHostedZone")) is ErrorClass.NOT_FOUND
+
+
+def test_classify_terminal_default_and_no_retry_precedence():
+    assert classify(AWSAPIError("AccessDenied")) is ErrorClass.TERMINAL
+    assert classify(TypeError("bug")) is ErrorClass.TERMINAL
+    # NoRetryError in the cause chain outranks a transient code
+    err = AWSAPIError("InternalError")
+    err.__cause__ = NoRetryError("drop me")
+    assert classify(err) is ErrorClass.TERMINAL
+
+
+def test_classify_transport_errors_transient():
+    assert classify(ConnectionResetError("rst")) is ErrorClass.TRANSIENT
+    assert classify(TimeoutError("t/o")) is ErrorClass.TRANSIENT
+    assert classify(OSError(113, "no route")) is ErrorClass.TRANSIENT
+
+
+def test_is_throttle_walks_cause_chain():
+    inner = AWSAPIError("ThrottlingException")
+    outer = RetryBudgetExceededError("list_accelerators", 4, 0.5)
+    outer.__cause__ = inner
+    assert is_throttle(outer)
+    assert not is_throttle(AWSAPIError("InternalError"))
+
+
+def test_retry_after_hint_walks_chain_and_takes_max():
+    inner = CircuitOpenError("us-west-2", 4.0)
+    outer = RuntimeError("wrapped")
+    outer.__cause__ = inner
+    assert retry_after_hint(outer) == pytest.approx(4.0)
+    assert retry_after_hint(RuntimeError("plain")) == 0.0
+
+
+# ---------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------
+
+def test_decorrelated_jitter_bounds_and_determinism():
+    policy = RetryPolicy(base_delay=0.1, max_delay=2.0)
+    rng = random.Random(7)
+    prev = policy.base_delay
+    delays = []
+    for _ in range(50):
+        d = policy.next_delay(rng, prev)
+        assert policy.base_delay <= d <= policy.max_delay
+        assert d <= max(policy.base_delay, 3.0 * prev) + 1e-9
+        delays.append(d)
+        prev = d
+    # same seed, same schedule
+    rng2 = random.Random(7)
+    prev = policy.base_delay
+    replay = []
+    for _ in range(50):
+        d = policy.next_delay(rng2, prev)
+        replay.append(d)
+        prev = d
+    assert delays == replay
+
+
+def test_requeue_hint_capped():
+    policy = RetryPolicy(base_delay=0.1, max_delay=2.0)
+    assert policy.requeue_hint(0.05) == pytest.approx(0.1)
+    assert policy.requeue_hint(10.0) == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------
+
+def make_breaker(**kw):
+    kw.setdefault("region", "test")
+    kw.setdefault("window", 10.0)
+    kw.setdefault("min_calls", 4)
+    kw.setdefault("failure_threshold", 0.5)
+    kw.setdefault("open_seconds", 5.0)
+    kw.setdefault("registry", Registry())
+    return CircuitBreaker(**kw)
+
+
+def test_breaker_opens_on_failure_rate_with_min_volume():
+    b = make_breaker()
+    t = 100.0
+    b.record_failure(t)
+    b.record_failure(t + 0.1)
+    b.record_failure(t + 0.2)          # 3 failures < min_calls: closed
+    assert b.state(t + 0.3) == STATE_CLOSED
+    b.record_failure(t + 0.3)          # volume reached, rate 100%
+    assert b.state(t + 0.4) == STATE_OPEN
+    with pytest.raises(CircuitOpenError) as ei:
+        b.allow(t + 1.0)
+    assert 0.0 < ei.value.retry_after <= 5.0
+
+
+def test_breaker_successes_keep_rate_below_threshold():
+    b = make_breaker()
+    t = 100.0
+    for i in range(6):
+        b.record_success(t + i * 0.01)
+    b.record_failure(t + 0.1)
+    b.record_failure(t + 0.2)          # 2/8 = 25% < 50%
+    assert b.state(t + 0.3) == STATE_CLOSED
+
+
+def test_breaker_half_open_probe_success_closes():
+    b = make_breaker()
+    t = 100.0
+    for i in range(4):
+        b.record_failure(t + i * 0.01)
+    assert b.state(t + 1.0) == STATE_OPEN
+    # open_seconds later: half-open, one probe admitted
+    assert b.state(t + 5.1) == STATE_HALF_OPEN
+    b.allow(t + 5.1)                   # the probe slot
+    with pytest.raises(CircuitOpenError):
+        b.allow(t + 5.1)               # second caller fails fast
+    b.record_success(t + 5.2)
+    assert b.state(t + 5.3) == STATE_CLOSED
+    b.allow(t + 5.3)                   # closed admits freely
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    b = make_breaker()
+    t = 100.0
+    for i in range(4):
+        b.record_failure(t + i * 0.01)
+    assert b.state(t + 5.1) == STATE_HALF_OPEN
+    b.allow(t + 5.1)
+    b.record_failure(t + 5.2)
+    assert b.state(t + 5.3) == STATE_OPEN
+    # and the fresh open period runs from the probe failure
+    assert b.state(t + 5.2 + 5.1) == STATE_HALF_OPEN
+
+
+def test_breaker_transitions_flow_into_metrics():
+    reg = Registry()
+    b = make_breaker(registry=reg)
+    t = 100.0
+    for i in range(4):
+        b.record_failure(t + i * 0.01)
+    b.state(t + 5.1)                   # -> half_open
+    b.allow(t + 5.1)
+    b.record_success(t + 5.2)          # -> closed
+    assert reg.counter_value("circuit_transitions_total",
+                             {"region": "test", "to": "open"}) == 1.0
+    assert reg.counter_value("circuit_transitions_total",
+                             {"region": "test", "to": "half_open"}) == 1.0
+    assert reg.counter_value("circuit_transitions_total",
+                             {"region": "test", "to": "closed"}) == 1.0
+
+
+def test_breaker_window_prunes_stale_outcomes():
+    b = make_breaker(window=10.0)
+    t = 100.0
+    b.record_failure(t)
+    b.record_failure(t + 0.1)
+    b.record_failure(t + 0.2)
+    # 30s later those fall out of the window: one more failure is 1/1
+    # of a sub-min_calls sample, not 4/4
+    b.record_failure(t + 30.0)
+    assert b.state(t + 30.1) == STATE_CLOSED
+
+
+# ---------------------------------------------------------------------
+# AdaptiveTokenBucket
+# ---------------------------------------------------------------------
+
+def test_bucket_admits_until_empty_then_paces():
+    bk = AdaptiveTokenBucket(capacity=3.0, refill_rate=10.0,
+                             min_capacity=1.0)
+    t = 50.0
+    assert bk.reserve(t) == 0.0
+    assert bk.reserve(t) == 0.0
+    assert bk.reserve(t) == 0.0
+    wait = bk.reserve(t)               # in debt: pace at refill rate
+    assert wait == pytest.approx(0.1)
+    # after the debt refills, admission resumes
+    assert bk.reserve(t + 1.0) == 0.0
+
+
+def test_bucket_aimd_shrink_and_recover():
+    bk = AdaptiveTokenBucket(capacity=100.0, refill_rate=100.0,
+                             min_capacity=10.0, shrink_factor=0.5,
+                             recover_step=5.0)
+    t = 50.0
+    bk.on_throttle(t)
+    assert bk.capacity() == pytest.approx(50.0)
+    bk.on_throttle(t)
+    bk.on_throttle(t)
+    bk.on_throttle(t)
+    assert bk.capacity() == pytest.approx(10.0)    # floor
+    bk.on_throttle(t)
+    assert bk.capacity() == pytest.approx(10.0)
+    for i in range(4):
+        bk.on_success(t + i * 0.01)
+    assert bk.capacity() == pytest.approx(30.0)
+    for _ in range(100):
+        bk.on_success(t + 1.0)
+    assert bk.capacity() == pytest.approx(100.0)   # ceiling
+
+
+def test_bucket_level_gauge_respects_injected_clock():
+    """level() (the throttle_tokens gauge callback) refills with the
+    INJECTED clock: with a real-monotonic default a single metrics
+    scrape would fast-forward a fake-clock bucket back to capacity,
+    silently un-draining it mid-test."""
+    t = {"now": 1000.0}
+    bk = AdaptiveTokenBucket(capacity=10.0, refill_rate=1.0,
+                             min_capacity=1.0, clock=lambda: t["now"])
+    for _ in range(8):
+        bk.reserve(t["now"])
+    level_before = bk.level()           # gauge read, same frozen clock
+    assert level_before == pytest.approx(2.0)
+    assert bk.level() == pytest.approx(level_before)
+
+
+def test_breaker_state_gauge_respects_injected_clock():
+    t = {"now": 1000.0}
+    b = make_breaker(clock=lambda: t["now"])
+    for i in range(4):
+        b.record_failure(t["now"] + i * 0.01)
+    # gauge read with no explicit now: must NOT see real uptime and
+    # flip the fake-clock OPEN state to half-open
+    assert b.state_value() == 2.0
+    t["now"] += 6.0
+    assert b.state_value() == 1.0       # and follows the fake clock
+
+
+def test_bucket_tokens_capped_at_adaptive_capacity():
+    bk = AdaptiveTokenBucket(capacity=100.0, refill_rate=100.0,
+                             min_capacity=10.0)
+    t = 50.0
+    bk.on_throttle(t)                  # capacity 50, tokens clipped
+    assert bk.level() <= 50.0 + 1e-6
+
+
+# ---------------------------------------------------------------------
+# ResilientAPIs wrapper
+# ---------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        assert s >= 0.0
+        self.t += s
+
+
+class StubGA:
+    """Scripted ga service: each list_accelerators() pops the next
+    entry — an exception to raise, anything else to return."""
+
+    def __init__(self, script=()):
+        self.script = list(script)
+        self.calls = 0
+
+    def list_accelerators(self):
+        self.calls += 1
+        if self.script:
+            step = self.script.pop(0)
+            if isinstance(step, Exception):
+                raise step
+            return step
+        return []
+
+    def helper(self):
+        return "passthrough"
+
+
+class StubBundle:
+    def __init__(self, ga=None):
+        self.ga = ga or StubGA()
+        self.elb = StubGA()
+        self.route53 = StubGA()
+
+
+def make_wrapped(script=(), **cfg_kw):
+    cfg_kw.setdefault("base_delay", 0.01)
+    cfg_kw.setdefault("max_delay", 0.1)
+    cfg_kw.setdefault("deadline", 30.0)
+    cfg_kw.setdefault("breaker_min_calls", 4)
+    cfg_kw.setdefault("breaker_open_seconds", 5.0)
+    cfg_kw.setdefault("seed", 7)
+    clock = _Clock()
+    reg = Registry()
+    inner = StubBundle(StubGA(script))
+    wrapped = ResilientAPIs(inner, region="test",
+                            config=ResilienceConfig(**cfg_kw),
+                            registry=reg, clock=clock,
+                            sleep=clock.sleep)
+    return wrapped, inner, clock, reg
+
+
+def test_wrapper_passes_success_through():
+    wrapped, inner, _, _ = make_wrapped([["a"]])
+    assert wrapped.ga.list_accelerators() == ["a"]
+    assert inner.ga.calls == 1
+
+
+def test_wrapper_retries_transient_then_succeeds():
+    wrapped, inner, clock, reg = make_wrapped(
+        [AWSAPIError("InternalError"), AWSAPIError("ServiceUnavailable"),
+         ["ok"]])
+    t0 = clock.t
+    assert wrapped.ga.list_accelerators() == ["ok"]
+    assert inner.ga.calls == 3
+    assert clock.t > t0                # backoff actually slept
+    assert reg.counter_value("aws_call_retries_total",
+                             {"op": "list_accelerators"}) == 2.0
+
+
+def test_wrapper_terminal_raises_immediately():
+    wrapped, inner, _, _ = make_wrapped([AWSAPIError("AccessDenied")])
+    with pytest.raises(AWSAPIError) as ei:
+        wrapped.ga.list_accelerators()
+    assert ei.value.code == "AccessDenied"
+    assert inner.ga.calls == 1
+
+
+def test_wrapper_not_found_is_not_a_breaker_failure():
+    script = [AWSAPIError("AcceleratorNotFoundException")] * 10
+    wrapped, inner, _, _ = make_wrapped(script)
+    for _ in range(10):
+        with pytest.raises(AWSAPIError):
+            wrapped.ga.list_accelerators()
+    assert wrapped.breaker.state() == STATE_CLOSED
+    assert inner.ga.calls == 10        # never retried either
+
+
+def test_wrapper_budget_exhaustion_carries_retry_after():
+    wrapped, inner, _, _ = make_wrapped(
+        [AWSAPIError("InternalError")] * 10, max_attempts=3)
+    with pytest.raises(RetryBudgetExceededError) as ei:
+        wrapped.ga.list_accelerators()
+    assert inner.ga.calls == 3
+    assert ei.value.retry_after > 0.0
+    assert isinstance(ei.value.__cause__, AWSAPIError)
+    assert retry_after_hint(ei.value) == ei.value.retry_after
+
+
+def test_wrapper_deadline_bounds_retry_time():
+    wrapped, inner, clock, reg = make_wrapped(
+        [AWSAPIError("InternalError")] * 1000,
+        max_attempts=1000, base_delay=0.5, max_delay=2.0, deadline=5.0,
+        breaker_min_calls=10_000)   # isolate the deadline budget
+    t0 = clock.t
+    with pytest.raises(DeadlineExceededError) as ei:
+        wrapped.ga.list_accelerators()
+    assert clock.t - t0 <= 5.0 + 1e-6
+    assert ei.value.retry_after > 0.0
+    assert reg.counter_value("aws_call_deadline_exceeded_total",
+                             {"op": "list_accelerators"}) == 1.0
+    assert inner.ga.calls < 1000
+
+
+def test_wrapper_throttle_shrinks_bucket_and_counts_as_failure():
+    wrapped, _, _, _ = make_wrapped(
+        [AWSAPIError("ThrottlingException"), ["ok"]],
+        bucket_capacity=100.0, bucket_refill=100.0)
+    before = wrapped.bucket.capacity()
+    assert wrapped.ga.list_accelerators() == ["ok"]
+    assert wrapped.bucket.capacity() < before
+
+
+def test_wrapper_open_circuit_fails_fast():
+    wrapped, inner, clock, _ = make_wrapped(
+        [AWSAPIError("InternalError")] * 100,
+        max_attempts=2, breaker_min_calls=4,
+        breaker_failure_threshold=0.5)
+    for _ in range(3):
+        with pytest.raises(AWSAPIError):
+            wrapped.ga.list_accelerators()
+    assert wrapped.breaker.state(clock.t) == STATE_OPEN
+    calls_when_open = inner.ga.calls
+    with pytest.raises(CircuitOpenError) as ei:
+        wrapped.ga.list_accelerators()
+    assert inner.ga.calls == calls_when_open   # nothing reached the API
+    assert ei.value.retry_after > 0.0
+
+
+def test_wrapper_circuit_recovers_through_half_open():
+    # exactly 4 scripted failures: calls 1-2 burn them (opening the
+    # circuit at the 4th), calls 3-4 fail fast WITHOUT consuming
+    # script, so the half-open probe finds the healthy response
+    wrapped, inner, clock, _ = make_wrapped(
+        [AWSAPIError("InternalError")] * 4 + [["ok"]],
+        max_attempts=2, breaker_min_calls=4, breaker_open_seconds=5.0)
+    for _ in range(4):
+        try:
+            wrapped.ga.list_accelerators()
+        except AWSAPIError:
+            pass
+    assert inner.ga.calls == 4
+    assert wrapped.breaker.state(clock.t) == STATE_OPEN
+    clock.t += 6.0                     # past the open window
+    assert wrapped.ga.list_accelerators() == ["ok"]   # the probe
+    assert wrapped.breaker.state(clock.t) == STATE_CLOSED
+
+
+def test_wrapped_method_surface_matches_api_interfaces():
+    """The wrapped-method sets are hand-written in three places
+    (wrapper.py, concurrency_lint L105, fake.py's service map) because
+    resilience/ must not import the cloudprovider layer; this is the
+    cross-check that keeps them from diverging — a method added to
+    api.py but missed in wrapper.py would silently bypass the whole
+    policy."""
+    from aws_global_accelerator_controller_tpu.analysis import (
+        concurrency_lint,
+    )
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws import (
+        api,
+        fake,
+    )
+    from aws_global_accelerator_controller_tpu.resilience import wrapper
+
+    assert wrapper.GA_METHODS == \
+        frozenset(api.GlobalAcceleratorAPI.__abstractmethods__)
+    assert wrapper.ELB_METHODS == frozenset(api.ELBv2API.__abstractmethods__)
+    assert wrapper.ROUTE53_METHODS == \
+        frozenset(api.Route53API.__abstractmethods__)
+    surface = (wrapper.GA_METHODS | wrapper.ELB_METHODS
+               | wrapper.ROUTE53_METHODS)
+    assert set(concurrency_lint._AWS_API_METHODS) == surface
+    # the chaos engine's service map must name every non-GA method
+    # (GA is its default) for service-scoped blackouts to aim right
+    assert set(fake._METHOD_SERVICE) == \
+        wrapper.ELB_METHODS | wrapper.ROUTE53_METHODS
+    assert all(fake._METHOD_SERVICE[m] == "elb"
+               for m in wrapper.ELB_METHODS)
+    assert all(fake._METHOD_SERVICE[m] == "route53"
+               for m in wrapper.ROUTE53_METHODS)
+
+
+def test_breaker_check_open_claims_no_probe_slot():
+    b = make_breaker()
+    t = 100.0
+    for i in range(4):
+        b.record_failure(t + i * 0.01)
+    with pytest.raises(CircuitOpenError):
+        b.check_open(t + 1.0)          # fully open: fail fast
+    # past the open window: check_open passes WITHOUT taking the
+    # half-open probe slot, so allow() can still admit the probe
+    b.check_open(t + 5.1)
+    b.allow(t + 5.1)
+    b.record_success(t + 5.2)
+    assert b.state(t + 5.3) == STATE_CLOSED
+
+
+def test_wrapper_open_circuit_consumes_no_tokens():
+    """Failing fast on an open circuit must not drain the token
+    bucket: otherwise recovery inherits a pacing debt the service
+    never caused."""
+    wrapped, _, clock, _ = make_wrapped(
+        [AWSAPIError("InternalError")] * 100,
+        max_attempts=2, breaker_min_calls=4,
+        bucket_capacity=50.0, bucket_refill=50.0)
+    for _ in range(3):
+        with pytest.raises(AWSAPIError):
+            wrapped.ga.list_accelerators()
+    assert wrapped.breaker.state(clock.t) == STATE_OPEN
+    level = wrapped.bucket.level()
+    for _ in range(30):
+        with pytest.raises(CircuitOpenError):
+            wrapped.ga.list_accelerators()
+    assert wrapped.bucket.level() >= level - 1e-6
+
+
+def test_wrapper_half_open_waiters_fail_fast_without_tokens():
+    """With the single half-open probe slot taken, other callers must
+    fail fast at the pre-gate — not claim a token and sleep off
+    pacing debt only to lose at allow()."""
+    wrapped, _, clock, _ = make_wrapped(
+        [AWSAPIError("InternalError")] * 100,
+        max_attempts=2, breaker_min_calls=4,
+        bucket_capacity=50.0, bucket_refill=50.0)
+    for _ in range(3):
+        with pytest.raises(AWSAPIError):
+            wrapped.ga.list_accelerators()
+    assert wrapped.breaker.state(clock.t) == STATE_OPEN
+    clock.t += 6.0                      # half-open now
+    wrapped.breaker.allow(clock.t)      # someone holds the probe slot
+    level = wrapped.bucket.level()
+    for _ in range(20):
+        with pytest.raises(CircuitOpenError):
+            wrapped.ga.list_accelerators()
+    assert wrapped.bucket.level() >= level - 1e-6
+
+
+def test_wrapper_passthrough_of_non_api_attributes():
+    wrapped, _, _, _ = make_wrapped()
+    assert wrapped.ga.helper() == "passthrough"
+
+
+def test_wrapper_gauges_registered():
+    wrapped, _, _, reg = make_wrapped()
+    text = reg.render()
+    assert 'circuit_state{region="test"} 0.0' in text
+    assert 'throttle_tokens{region="test"}' in text
+    del wrapped
