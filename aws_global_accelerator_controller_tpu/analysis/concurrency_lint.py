@@ -43,6 +43,16 @@ zero-findings gate philosophy):
                          (resilience/wrapper.py).  Package files only:
                          tests and tools observe the fake cloud
                          directly by design.
+  L106 coalesced writes  Direct calls to the batched mutation surface
+                         (``<x>.route53.change_resource_record_sets``
+                         / ``..._batch``, ``<x>.ga.
+                         update_endpoint_group``) — even through
+                         ``apis`` — bypass the write coalescer
+                         (cloudprovider/aws/batcher.py): no folding,
+                         no bisect-on-rejection, no per-waiter error
+                         demux.  Package-scoped like L105;
+                         ``batcher.py`` itself (the one legitimate
+                         flush issuer) is exempt.
 
 Waivers: ``# race: <reason>`` on the flagged line (the explicit,
 greppable spelling — use for contracts that are upheld non-lexically),
@@ -101,6 +111,18 @@ _AWS_API_METHODS = {
     # Route53API
     "list_hosted_zones", "list_hosted_zones_by_name",
     "list_resource_record_sets", "change_resource_record_sets",
+    "change_resource_record_sets_batch",
+}
+
+# The write-coalescing surface: the MutationCoalescer
+# (cloudprovider/aws/batcher.py) is the ONLY legitimate issuer of
+# these mutations — a direct call, even through ``apis``, bypasses
+# folding, flush-level bisect and per-waiter error demultiplexing
+# (rule L106).
+_COALESCED_WRITES = {
+    ("route53", "change_resource_record_sets"),
+    ("route53", "change_resource_record_sets_batch"),
+    ("ga", "update_endpoint_group"),
 }
 
 
@@ -408,6 +430,21 @@ class Engine:
                 f"it via '...apis.{chain[-2]}.{chain[-1]}' or waive "
                 f"with '# race: <reason>' if this is a deliberate "
                 f"bare call"))
+        # L106: a mutation on the write-coalescing surface issued
+        # directly — even through ``apis`` — bypasses the
+        # MutationCoalescer.  batcher.py (the flush issuer) is the one
+        # exempt module.
+        if (len(chain) >= 2 and (chain[-2], chain[-1]) in _COALESCED_WRITES
+                and _l105_in_scope(info.path)
+                and info.path.name != "batcher.py"):
+            self.findings.append(Finding(
+                info.path, line, "L106",
+                f"direct write-path mutation '{'.'.join(chain)}()' "
+                f"bypasses the MutationCoalescer (no folding, no "
+                f"bisect-on-rejection, no per-waiter error demux — "
+                f"cloudprovider/aws/batcher.py): submit an intent via "
+                f"the provider's coalescer, or waive with "
+                f"'# race: <reason>' for a deliberate direct call"))
         # L102: blocking while any lock is held.
         if held and self._is_blocking(chain, held):
             self.findings.append(Finding(

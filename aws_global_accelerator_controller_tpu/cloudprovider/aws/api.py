@@ -122,6 +122,21 @@ class Route53API(ABC):
     def change_resource_record_sets(self, hosted_zone_id: str, action: str,
                                     record_set: ResourceRecordSet) -> None: ...
 
+    @abstractmethod
+    def change_resource_record_sets_batch(
+            self, hosted_zone_id: str,
+            changes: List[tuple]) -> None:
+        """Submit ``[(action, record_set), ...]`` as ONE ChangeBatch.
+
+        Real Route53 applies a ChangeBatch ATOMICALLY (all-or-nothing:
+        one invalid change rejects the whole batch, nothing applies)
+        and throttles per hosted zone per CALL — which is why the write
+        coalescer (batcher.py) batches: N changes cost one unit of the
+        zone's budget instead of N.  Implementations must keep the
+        all-or-nothing contract; the coalescer's bisect-on-rejection
+        relies on a rejected batch leaving the zone untouched."""
+        ...
+
 
 class AWSAPIs:
     """Bundle of the three service clients (pkg/cloudprovider/aws/aws.go:12-16).

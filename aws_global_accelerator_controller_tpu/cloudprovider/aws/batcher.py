@@ -1,0 +1,537 @@
+"""Write-path mutation coalescing: atomic Route53 ChangeBatches and
+merged endpoint-group updates behind a leader-flush pipeline.
+
+PR 1 made the READ path scale (indexed informer cache, gen-keyed
+singleflight); this module is the write-side counterpart.  Every
+reconcile key used to pay one AWS mutation call per record set (the
+real Route53 API accepts an atomic ChangeBatch and throttles per
+hosted zone, per CALL) and one full read-modify-write per endpoint
+tweak — the amortize-per-message-overhead play collective libraries
+make for small sends (PAPERS.md: HiCCL, NCCL protocol analysis)
+applied to the one hot path the read work left untouched.
+
+Lifecycle of an intent:
+
+1. **Enqueue.** A worker submits one or more intents — ``(action,
+   ResourceRecordSet)`` changes for a hosted zone, :class:`EndpointOp`
+   mutations for an endpoint group — into the per-(zone / endpoint
+   group) group queue and blocks on a per-intent future.
+2. **Fold.** A later intent on the same fold key supersedes the
+   earlier one in place: UPSERT then DELETE of one record collapses to
+   the DELETE; re-weights are last-writer-wins per endpoint; a
+   ``replace`` absorbs every pending op for its group.  The superseded
+   intent's waiters ride the surviving intent — folding never drops a
+   waiter.
+3. **Flush.** The first enqueuer into an idle group becomes the flush
+   LEADER: it lingers (size-or-deadline — ``max_batch`` intents or
+   ``linger`` seconds, whichever first), drains the group, and issues
+   ONE wrapped call for the whole cohort: an atomic
+   ``change_resource_record_sets_batch`` per zone, or one merged
+   describe + ``update_endpoint_group`` read-modify-write per endpoint
+   group.  The call rides the region's ResilientAPIs
+   retry/breaker/token-bucket stack like every other call.  Intents
+   arriving mid-flush elect the NEXT leader (the pipeline overlaps
+   batch formation with the in-flight flush); flushes are serialized
+   per group, so the endpoint-group read-modify-write never
+   interleaves with itself.
+4. **Demux.** A flush failure carrying a ``retry_after`` hint (retry
+   budget, deadline, open circuit) is a statement about the REGION,
+   not any one change: the whole cohort fails with that hint and every
+   waiter's key parks via reconcile.py's unchanged dispatch.  A
+   terminal rejection of a multi-change batch (InvalidChangeBatch)
+   BISECTS: halves retry independently, so one poisoned change fails
+   alone with its own error and cannot wedge its cohort — per-key
+   error attribution survives batching.
+
+The coalescer is shared across a factory's regional providers exactly
+like ``FleetDiscoveryState``: Global Accelerator and Route53 are
+GLOBAL services (the reference homes both in us-west-2), so two
+regional coalescers read-modify-writing the same endpoint group would
+lose updates.  Lint rule L106 (analysis/concurrency_lint.py) keeps
+every other module off the direct mutation surface; this module is the
+one legitimate issuer.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...analysis import locks
+from ...errors import retry_after_hint
+from ...resilience import ErrorClass, classify
+from ...metrics import (
+    record_flush_bisect,
+    record_mutation_enqueued,
+    record_mutation_flush,
+    record_mutation_fold,
+)
+from .types import EndpointDescription
+
+logger = logging.getLogger(__name__)
+
+KIND_RECORD_SET = "record_set"
+KIND_ENDPOINT_GROUP = "endpoint_group"
+
+# the real ChangeResourceRecordSets bound: 1000 changes per batch
+ROUTE53_MAX_CHANGES = 1000
+
+
+@dataclass(frozen=True)
+class CoalesceConfig:
+    """Flush-trigger knobs.  ``enabled=False`` is the A/B escape hatch:
+    every intent replays the pre-coalescing per-call pattern (what
+    ``bench.py batch-efficiency`` measures the win against)."""
+
+    enabled: bool = True
+    # size trigger: drain as soon as this many intents wait
+    max_batch: int = 64
+    # deadline trigger: seconds the leader lingers for cohort intents
+    linger: float = 0.005
+
+
+# the fake factory's profile: a shorter linger keeps single-writer unit
+# tests sub-millisecond-ish while storms still coalesce across workers
+FAKE_COALESCE_CONFIG = CoalesceConfig(linger=0.002)
+
+
+@dataclass(frozen=True)
+class EndpointOp:
+    """One endpoint-group mutation intent.
+
+    Kinds (build via the module helpers, not directly):
+
+    - ``set``     ensure ``endpoint_id`` is a member with this weight +
+                  client-IP-preservation (the AddEndpoints analogue)
+    - ``weight``  re-weight an existing member, preserving its other
+                  fields; absent members are appended weight-only (the
+                  old ``update_endpoint_weight`` read-modify-write)
+    - ``remove``  drop the member
+    - ``replace`` replace the WHOLE endpoint set with ``configs`` (the
+                  GA controller's converge-to-exactly-this-LB update)
+    """
+
+    kind: str
+    endpoint_id: str = ""
+    weight: Optional[int] = None
+    client_ip_preservation: bool = False
+    configs: Tuple[EndpointDescription, ...] = ()
+
+
+def op_set(endpoint_id: str, weight: Optional[int] = None,
+           client_ip_preservation: bool = False) -> EndpointOp:
+    return EndpointOp("set", endpoint_id, weight, client_ip_preservation)
+
+
+def op_weight(endpoint_id: str, weight: Optional[int]) -> EndpointOp:
+    return EndpointOp("weight", endpoint_id, weight)
+
+
+def op_remove(endpoint_id: str) -> EndpointOp:
+    return EndpointOp("remove", endpoint_id)
+
+
+def op_replace(configs) -> EndpointOp:
+    return EndpointOp("replace", configs=tuple(configs))
+
+
+class _Future:
+    """One waiter's slot: completed (or failed) exactly once by the
+    flush that carried its intent.  ``payload`` is the waiter's OWN
+    submitted intent — the success result is derived from it, so a
+    waiter whose op was folded into another's (even a ``replace``
+    absorbing a ``set``) still gets its own answer (the endpoint id it
+    submitted), not the absorber's."""
+
+    __slots__ = ("event", "result", "exc", "payload")
+
+    def __init__(self, payload=None):
+        self.event = threading.Event()
+        self.result = None
+        self.exc: Optional[BaseException] = None
+        self.payload = payload
+
+    def complete(self) -> None:
+        self.result = _op_result(self.payload)
+        self.event.set()
+
+    def fail(self, exc: BaseException) -> None:
+        self.exc = exc
+        self.event.set()
+
+
+class _Intent:
+    __slots__ = ("payload", "futures")
+
+    def __init__(self, payload, future: _Future):
+        self.payload = payload
+        self.futures = [future]
+
+
+def _fold_record(group: "_Group", action, record_set,
+                 future: _Future) -> int:
+    """Last-writer-wins per (name, type): the new change supersedes a
+    pending one in place and absorbs its waiters (an UPSERT followed by
+    a DELETE of the same record collapses to the DELETE; both waiters
+    share the surviving change's outcome).  O(1) via the group's fold
+    index.  Returns folds counted."""
+    key = (record_set.name, record_set.type)
+    it = group.index.get(key)
+    if it is not None:
+        it.payload = (action, record_set)
+        it.futures.append(future)
+        return 1
+    it = _Intent((action, record_set), future)
+    group.pending.append(it)
+    group.index[key] = it
+    return 0
+
+
+def _fold_endpoint_op(group: "_Group", op: EndpointOp,
+                      future: _Future) -> int:
+    """Endpoint-op folding: last-writer-wins per endpoint, O(1) via
+    the group's fold index (keyed by endpoint id, cleared at every
+    ``replace`` boundary — nothing composes through a full-set
+    clobber).  A ``replace`` absorbs everything pending (their effects
+    are clobbered, exactly as sequential application would; their
+    waiters ride it but keep their own results).  A ``weight`` over a
+    pending ``set`` edits the set's weight in place; a ``weight`` over
+    a ``remove`` does NOT fold (apply order matters —
+    remove-then-append-weight-only)."""
+    if op.kind == "replace":
+        folded = len(group.pending)
+        intent = _Intent(op, future)
+        intent.futures = [f for it in group.pending
+                          for f in it.futures] + intent.futures
+        del group.pending[:]
+        group.pending.append(intent)
+        group.index.clear()
+        return folded
+    it = group.index.get(op.endpoint_id)
+    if it is not None:
+        p = it.payload
+        if op.kind in ("set", "remove") or p.kind == op.kind:
+            it.payload = op
+            it.futures.append(future)
+            return 1
+        if op.kind == "weight" and p.kind == "set":
+            it.payload = replace(p, weight=op.weight)
+            it.futures.append(future)
+            return 1
+        # weight after remove: no fold — append in order; later ops on
+        # this endpoint target the NEWEST intent
+    intent = _Intent(op, future)
+    group.pending.append(intent)
+    group.index[op.endpoint_id] = intent
+    return 0
+
+
+def _apply_ops(current_descriptions, ops) -> List[EndpointDescription]:
+    """Fold the drained op sequence over the freshly described endpoint
+    set — the merged read-modify-write one ``update_endpoint_group``
+    submits for the whole cohort."""
+    out: "Dict[str, EndpointDescription]" = {
+        d.endpoint_id: replace(d) for d in current_descriptions}
+    for op in ops:
+        if op.kind == "replace":
+            out = {c.endpoint_id: replace(c) for c in op.configs}
+        elif op.kind == "remove":
+            out.pop(op.endpoint_id, None)
+        elif op.kind == "set":
+            out[op.endpoint_id] = EndpointDescription(
+                endpoint_id=op.endpoint_id, weight=op.weight,
+                client_ip_preservation_enabled=op.client_ip_preservation)
+        else:  # weight
+            d = out.get(op.endpoint_id)
+            if d is None:
+                out[op.endpoint_id] = EndpointDescription(
+                    endpoint_id=op.endpoint_id, weight=op.weight)
+            else:
+                d.weight = op.weight
+    return list(out.values())
+
+
+def _op_result(op) -> Optional[str]:
+    if isinstance(op, EndpointOp):
+        return op.endpoint_id or None
+    return None
+
+
+class _Group:
+    """One coalescing queue: a hosted zone or an endpoint group."""
+
+    __slots__ = ("kind", "key", "cond", "pending", "index", "leader",
+                 "flushing", "dead")
+
+    def __init__(self, kind: str, key: str):
+        self.kind = kind
+        self.key = key
+        self.cond = threading.Condition(
+            locks.make_lock(f"coalescer-group[{kind}]"))
+        self.pending: List[_Intent] = []
+        # fold key -> the pending intent a later submit supersedes:
+        # (name, type) for records, endpoint id for EG ops (cleared at
+        # replace boundaries) — keeps folding O(1) when pending grows
+        # behind a slow flush
+        self.index: Dict = {}
+        self.leader = False     # a leader is lingering / about to drain
+        self.flushing = False   # a drained batch is on the wire
+        self.dead = False       # pruned from the coalescer's map
+
+
+class MutationCoalescer:
+    """Per-(hosted-zone / endpoint-group) write coalescing over one
+    (resilience-wrapped) ``AWSAPIs`` bundle — see the module docstring
+    for the intent lifecycle and the error-demux contract."""
+
+    def __init__(self, apis, config: Optional[CoalesceConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.apis = apis
+        self.config = config or CoalesceConfig()
+        self._clock = clock
+        self._lock = locks.make_lock("coalescer-groups")
+        self._groups: Dict[Tuple[str, str], _Group] = {}
+
+    # ------------------------------------------------------------------
+    # submit surface (what provider.py calls)
+    # ------------------------------------------------------------------
+
+    def change_record_sets(self, hosted_zone_id: str, changes) -> None:
+        """Submit ``[(action, ResourceRecordSet), ...]`` for one zone
+        and block until every change committed.  Raises the first
+        failed change's error (per-change attribution: a cohort
+        member's poison does not fail this caller's changes)."""
+        futures = self._submit(KIND_RECORD_SET, hosted_zone_id,
+                               list(changes))
+        self._await(futures)
+
+    def update_endpoints(self, endpoint_group_arn: str, ops) -> List:
+        """Submit :class:`EndpointOp` intents for one endpoint group;
+        returns each op's result (the endpoint id for membership ops)
+        once the merged update committed."""
+        futures = self._submit(KIND_ENDPOINT_GROUP, endpoint_group_arn,
+                               list(ops))
+        return self._await(futures)
+
+    # ------------------------------------------------------------------
+
+    def _group(self, kind: str, key: str) -> _Group:
+        with self._lock:
+            group = self._groups.get((kind, key))
+            if group is None:
+                group = _Group(kind, key)
+                self._groups[(kind, key)] = group
+            return group
+
+    def _submit(self, kind: str, key: str, payloads) -> List[_Future]:
+        if not payloads:
+            return []
+        futures = [_Future(payload) for payload in payloads]
+        record_mutation_enqueued(kind, len(payloads))
+        if not self.config.enabled:
+            group = self._group(kind, key)
+            for future in futures:
+                self._direct(group, future)
+            return futures
+        folds = 0
+        while True:
+            group = self._group(kind, key)
+            with group.cond:
+                if group.dead:
+                    continue   # pruned between lookup and lock: retry
+                for future in futures:
+                    if kind == KIND_RECORD_SET:
+                        folds += _fold_record(group, *future.payload,
+                                              future)
+                    else:
+                        folds += _fold_endpoint_op(group,
+                                                   future.payload,
+                                                   future)
+                lead = not group.leader
+                if lead:
+                    group.leader = True
+                elif len(group.pending) >= self.config.max_batch:
+                    group.cond.notify_all()  # wake the lingering leader
+                break
+        if folds:
+            record_mutation_fold(kind, folds)
+        if lead:
+            self._lead(group)
+        return futures
+
+    @staticmethod
+    def _await(futures: List[_Future]) -> List:
+        for future in futures:
+            future.event.wait()
+        for future in futures:
+            if future.exc is not None:
+                raise future.exc
+        return [future.result for future in futures]
+
+    def _lead(self, group: _Group) -> None:
+        """The flush pipeline's drain step: linger size-or-deadline,
+        hand leadership to the next epoch, then flush outside every
+        lock.  Every drained intent's futures complete exactly once —
+        even if the flush path itself blows up unexpectedly."""
+        with group.cond:
+            deadline = self._clock() + self.config.linger
+            while len(group.pending) < self.config.max_batch:
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    break
+                group.cond.wait(remaining)
+            # serialize flushes per group: the endpoint-group
+            # read-modify-write must never interleave with itself
+            while group.flushing:
+                group.cond.wait(0.05)
+            intents = list(group.pending)
+            del group.pending[:]
+            group.index.clear()
+            group.leader = False   # mid-flush arrivals elect the next one
+            group.flushing = True
+        try:
+            self._flush(group, intents)
+        except BaseException as e:  # belt: _flush demuxes its own errors
+            for it in intents:
+                for future in it.futures:
+                    if not future.event.is_set():
+                        future.fail(e)
+            raise
+        finally:
+            with group.cond:
+                group.flushing = False
+                group.cond.notify_all()
+                # prune an idle group: no pending intents, no leader,
+                # no flush — accelerator/EG churn must not grow the
+                # group map (and its tracked locks) forever.  ``dead``
+                # makes a racing enqueuer that already holds a
+                # reference re-resolve a fresh group instead of
+                # writing into the orphan (which would break the
+                # one-flush-per-group serialization).
+                if not group.pending and not group.leader:
+                    group.dead = True
+            if group.dead:
+                with self._lock:
+                    if self._groups.get((group.kind, group.key)) \
+                            is group:
+                        del self._groups[(group.kind, group.key)]
+
+    # ------------------------------------------------------------------
+    # flush + error demultiplexing
+    # ------------------------------------------------------------------
+
+    def _flush(self, group: _Group, intents: List[_Intent]) -> None:
+        if not intents:
+            return
+        if group.kind == KIND_RECORD_SET:
+            # hard-chunk at the real API's batch bound
+            for start in range(0, len(intents), ROUTE53_MAX_CHANGES):
+                self._flush_record_chunk(
+                    group.key, intents[start:start + ROUTE53_MAX_CHANGES])
+        else:
+            self._flush_endpoint_group(group.key, intents)
+
+    def _flush_record_chunk(self, zone_id: str,
+                            intents: List[_Intent]) -> None:
+        changes = [it.payload for it in intents]
+        try:
+            record_mutation_flush(KIND_RECORD_SET)
+            self.apis.route53.change_resource_record_sets_batch(
+                zone_id, changes)
+        except Exception as e:
+            self._demux_failure(
+                KIND_RECORD_SET, intents, e,
+                lambda half: self._flush_record_chunk(zone_id, half))
+            return
+        for it in intents:
+            for future in it.futures:
+                future.complete()
+
+    def _flush_endpoint_group(self, arn: str,
+                              intents: List[_Intent]) -> None:
+        try:
+            current = self.apis.ga.describe_endpoint_group(arn)
+        except Exception as e:
+            # the READ failed: nothing is attributable to one intent —
+            # every waiter gets the describe's own verdict (a hint
+            # parks it, a NotFound is a real answer for all)
+            for it in intents:
+                for future in it.futures:
+                    future.fail(e)
+            return
+        configs = _apply_ops(current.endpoint_descriptions,
+                             [it.payload for it in intents])
+        try:
+            record_mutation_flush(KIND_ENDPOINT_GROUP)
+            self.apis.ga.update_endpoint_group(arn, configs)
+        except Exception as e:
+            self._demux_failure(
+                KIND_ENDPOINT_GROUP, intents, e,
+                lambda half: self._flush_endpoint_group(arn, half))
+            return
+        for it in intents:
+            for future in it.futures:
+                future.complete()
+
+    def _demux_failure(self, kind: str, intents: List[_Intent],
+                       exc: Exception, retry_half) -> None:
+        """Per-waiter error attribution for a failed flush.  A
+        hint-carrying failure (retry budget, deadline, open circuit) is
+        about the region, not any one change: the whole cohort parks on
+        the hint.  A not-found failure (NoSuchHostedZone, the endpoint
+        group gone) is about the CONTAINER — every waiter's real
+        answer, so bisecting it would only issue ~2N more calls doomed
+        to the same verdict.  Any other terminal rejection of a
+        multi-change batch bisects so one poisoned change fails alone —
+        its waiters get the real error, everyone else's half commits."""
+        if (len(intents) == 1 or retry_after_hint(exc) > 0
+                or classify(exc) is ErrorClass.NOT_FOUND):
+            for it in intents:
+                for future in it.futures:
+                    future.fail(exc)
+            return
+        logger.warning("flush of %d %s intents rejected (%s); "
+                       "bisecting to isolate the poisoned change",
+                       len(intents), kind, exc)
+        record_flush_bisect(kind)
+        mid = len(intents) // 2
+        retry_half(intents[:mid])
+        retry_half(intents[mid:])
+
+    # ------------------------------------------------------------------
+    # coalescing-disabled path (the A/B baseline)
+    # ------------------------------------------------------------------
+
+    def _direct(self, group: _Group, future: _Future) -> None:
+        """Replay the pre-coalescing per-intent call pattern: one
+        ``change_resource_record_sets`` per record change, AddEndpoints
+        / RemoveEndpoints / per-op read-modify-write for endpoint
+        groups.  Only reachable with ``enabled=False``."""
+        try:
+            if group.kind == KIND_RECORD_SET:
+                action, record_set = future.payload
+                record_mutation_flush(KIND_RECORD_SET)
+                self.apis.route53.change_resource_record_sets(
+                    group.key, action, record_set)
+            else:
+                self._direct_endpoint(group.key, future.payload)
+            future.complete()
+        except Exception as e:
+            future.fail(e)
+
+    def _direct_endpoint(self, arn: str, op: EndpointOp) -> None:
+        record_mutation_flush(KIND_ENDPOINT_GROUP)
+        if op.kind == "set":
+            self.apis.ga.add_endpoints(arn, op.endpoint_id,
+                                       op.client_ip_preservation,
+                                       op.weight)
+        elif op.kind == "remove":
+            self.apis.ga.remove_endpoints(arn, [op.endpoint_id])
+        elif op.kind == "replace":
+            self.apis.ga.update_endpoint_group(arn, list(op.configs))
+        else:  # weight: the old per-endpoint read-modify-write
+            current = self.apis.ga.describe_endpoint_group(arn)
+            self.apis.ga.update_endpoint_group(
+                arn, _apply_ops(current.endpoint_descriptions, [op]))

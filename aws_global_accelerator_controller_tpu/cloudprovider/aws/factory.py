@@ -16,6 +16,11 @@ from ...analysis import locks
 from ...resilience import ResilienceConfig, ResilientAPIs
 from ...resilience.wrapper import FAKE_CLOUD_CONFIG
 from .api import AWSAPIs
+from .batcher import (
+    CoalesceConfig,
+    FAKE_COALESCE_CONFIG,
+    MutationCoalescer,
+)
 from .fake import FakeAWSCloud
 from .provider import AWSProvider, FleetDiscoveryState
 
@@ -30,7 +35,8 @@ class CloudFactory:
     def __init__(self, delete_poll_interval: float = 10.0,
                  delete_poll_timeout: float = 180.0,
                  accelerator_not_found_retry: float = 60.0,
-                 resilience: Optional[ResilienceConfig] = None):
+                 resilience: Optional[ResilienceConfig] = None,
+                 coalesce: Optional[CoalesceConfig] = None):
         self._providers: Dict[str, AWSProvider] = {}
         self._lock = locks.make_lock("cloud-factory")
         self._poll_interval = delete_poll_interval
@@ -47,6 +53,15 @@ class CloudFactory:
         # to the others' discovery immediately, not after a TTL
         # (provider.FleetDiscoveryState docstring)
         self._discovery_state = FleetDiscoveryState()
+        # ...and for the same reason, ONE write coalescer: GA and
+        # Route53 are global services (real.py pins both to us-west-2
+        # whatever the ELB region), so per-region coalescers
+        # read-modify-writing the same endpoint group would lose
+        # updates.  Built lazily over the first provider's wrapped
+        # bundle — its ga/route53 handles reach the same global
+        # control plane as every other region's.
+        self._coalesce = coalesce or CoalesceConfig()
+        self._coalescer: "MutationCoalescer | None" = None
 
     def provider_for(self, region: str) -> AWSProvider:
         with self._lock:
@@ -56,12 +71,16 @@ class CloudFactory:
                 if self._resilience.enabled:
                     apis = ResilientAPIs(apis, region=region,
                                          config=self._resilience)
+                if self._coalescer is None:
+                    self._coalescer = MutationCoalescer(
+                        apis, config=self._coalesce)
                 provider = AWSProvider(
                     apis,
                     delete_poll_interval=self._poll_interval,
                     delete_poll_timeout=self._poll_timeout,
                     accelerator_not_found_retry=self._not_found_retry,
-                    discovery_state=self._discovery_state)
+                    discovery_state=self._discovery_state,
+                    coalescer=self._coalescer)
                 self._providers[region] = provider
             return provider
 
@@ -82,13 +101,16 @@ class FakeCloudFactory(CloudFactory):
                  delete_poll_timeout: float = 5.0,
                  accelerator_not_found_retry: float = 0.2,
                  resilience: Optional[ResilienceConfig] = None,
-                 fault_seed: Optional[int] = None):
+                 fault_seed: Optional[int] = None,
+                 coalesce: Optional[CoalesceConfig] = None):
         # fast resilience profile by default: real backoff shapes at
         # 100x speed, breaker thresholds the ordinary one-shot fault
-        # tests never trip (chaos tests pass tighter configs)
+        # tests never trip (chaos tests pass tighter configs); same
+        # idea for the write coalescer's shorter flush linger
         super().__init__(delete_poll_interval, delete_poll_timeout,
                          accelerator_not_found_retry,
-                         resilience=resilience or FAKE_CLOUD_CONFIG)
+                         resilience=resilience or FAKE_CLOUD_CONFIG,
+                         coalesce=coalesce or FAKE_COALESCE_CONFIG)
         self.cloud = FakeAWSCloud(settle_seconds=settle_seconds,
                                   fault_seed=fault_seed)
 

@@ -61,6 +61,7 @@ _METHOD_SERVICE: Dict[str, str] = {
     "list_hosted_zones_by_name": "route53",
     "list_resource_record_sets": "route53",
     "change_resource_record_sets": "route53",
+    "change_resource_record_sets_batch": "route53",
 }
 
 
@@ -119,6 +120,10 @@ class FaultInjector:
                                            Callable[[], Exception]]] = {}
         self._latency: Dict[str, float] = {}
         self._windows: List[_Window] = []
+        # per-hosted-zone token buckets (set_zone_throttle):
+        # zone id -> (tokens, last refill timestamp)
+        self._zone_rate: Optional[Tuple[float, float]] = None
+        self._zone_buckets: Dict[str, Tuple[float, float]] = {}
 
     # -- original one-shot API (unchanged surface) ----------------------
 
@@ -180,6 +185,29 @@ class FaultInjector:
                                     "chaos: service blackout",
                                     retryable=True)))
 
+    def set_zone_throttle(self, rate_per_s: float,
+                          burst: Optional[float] = None) -> None:
+        """Model Route53's per-hosted-zone request limit (~5 req/s per
+        zone, counted per CALL regardless of how many changes the call
+        carries — which is exactly why the write coalescer's batching
+        wins): a token bucket per zone on the
+        ``change_resource_record_sets[_batch]`` methods; an empty
+        bucket answers ThrottlingException (retryable).
+
+        Deterministic given the call sequence and the injector clock —
+        no random draws are consumed, so it composes with the seeded
+        schedule without perturbing its per-method decision indexes.
+        ``rate_per_s <= 0`` clears; ``burst`` defaults to
+        ``max(1, rate_per_s)``."""
+        with self._lock:
+            if rate_per_s <= 0:
+                self._zone_rate = None
+                self._zone_buckets.clear()
+            else:
+                self._zone_rate = (
+                    rate_per_s,
+                    burst if burst is not None else max(1.0, rate_per_s))
+
     # -- observability --------------------------------------------------
 
     def injected_counts(self) -> Dict[str, int]:
@@ -210,11 +238,13 @@ class FaultInjector:
             f"{self._seed}:{salt}:{method}:{index}".encode())
         return draw / 2**32 < rate
 
-    def check(self, method: str) -> None:
+    def check(self, method: str, zone: Optional[str] = None) -> None:
         """Called by every fake API method before it touches state (an
         injected fault means the call never happened).  Decisions and
         counting happen under the injector lock; the latency sleep and
-        the raise happen outside it."""
+        the raise happen outside it.  ``zone`` is the hosted-zone id of
+        a Route53 mutation call, consulted by the per-zone throttle
+        (``set_zone_throttle``) after the one-shot queue."""
         with self._lock:
             index = self._calls.get(method, 0)
             self._calls[method] = index + 1
@@ -224,6 +254,20 @@ class FaultInjector:
             pending = self._faults.get(method)
             if pending:
                 exc = pending.pop(0)
+            if exc is None and zone is not None \
+                    and self._zone_rate is not None:
+                rate, burst = self._zone_rate
+                now = self._clock()
+                tokens, last = self._zone_buckets.get(zone, (burst, now))
+                tokens = min(burst, tokens + (now - last) * rate)
+                if tokens >= 1.0:
+                    tokens -= 1.0
+                else:
+                    exc = AWSAPIError(
+                        "ThrottlingException",
+                        f"chaos: per-zone rate limit on {zone}",
+                        retryable=True)
+                self._zone_buckets[zone] = (tokens, now)
             if exc is None and self._windows:
                 now = self._clock()
                 self._windows = [w for w in self._windows
@@ -615,34 +659,62 @@ class FakeRoute53(Route53API):
 
     def change_resource_record_sets(self, hosted_zone_id: str, action: str,
                                     record_set: ResourceRecordSet) -> None:
-        self.faults.check("change_resource_record_sets")
+        self.faults.check("change_resource_record_sets",
+                          zone=hosted_zone_id)
         with self._lock:
-            if hosted_zone_id not in self._records:
-                raise AWSAPIError("NoSuchHostedZone", hosted_zone_id)
-            rs = record_set.copy()
-            rs.name = _normalize_record_name(rs.name)
-            records = self._records[hosted_zone_id]
-            existing = [r for r in records
-                        if r.name == rs.name and r.type == rs.type]
-            if action == "CREATE":
-                if existing:
-                    raise AWSAPIError(
-                        "InvalidChangeBatch",
-                        f"{rs.name} {rs.type} already exists")
-                records.append(rs)
-            elif action == "UPSERT":
-                for r in existing:
-                    records.remove(r)
-                records.append(rs)
-            elif action == "DELETE":
-                if not existing:
-                    raise AWSAPIError(
-                        "InvalidChangeBatch",
-                        f"{rs.name} {rs.type} not found")
-                for r in existing:
-                    records.remove(r)
-            else:
-                raise AWSAPIError("InvalidInput", f"bad action {action}")
+            self._apply_change(self._require_zone_locked(hosted_zone_id),
+                               action, record_set)
+
+    def change_resource_record_sets_batch(self, hosted_zone_id: str,
+                                          changes) -> None:
+        """Atomic all-or-nothing ChangeBatch, as the real API applies
+        it: every change validates AND applies against a working copy
+        of the zone; any invalid change rejects the whole batch with
+        InvalidChangeBatch naming the offender and the zone is left
+        untouched — the semantics the write coalescer's
+        bisect-on-rejection relies on (batcher.py)."""
+        self.faults.check("change_resource_record_sets_batch",
+                          zone=hosted_zone_id)
+        with self._lock:
+            working = list(self._require_zone_locked(hosted_zone_id))
+            for action, record_set in changes:
+                self._apply_change(working, action, record_set)
+            self._records[hosted_zone_id] = working
+
+    def _require_zone_locked(self, hosted_zone_id: str):
+        if hosted_zone_id not in self._records:
+            raise AWSAPIError("NoSuchHostedZone", hosted_zone_id)
+        return self._records[hosted_zone_id]
+
+    @staticmethod
+    def _apply_change(records, action: str,
+                      record_set: ResourceRecordSet) -> None:
+        """Validate + apply ONE change against ``records`` in place
+        (the shared half of the single-change and atomic-batch
+        entry points)."""
+        rs = record_set.copy()
+        rs.name = _normalize_record_name(rs.name)
+        existing = [r for r in records
+                    if r.name == rs.name and r.type == rs.type]
+        if action == "CREATE":
+            if existing:
+                raise AWSAPIError(
+                    "InvalidChangeBatch",
+                    f"{rs.name} {rs.type} already exists")
+            records.append(rs)
+        elif action == "UPSERT":
+            for r in existing:
+                records.remove(r)
+            records.append(rs)
+        elif action == "DELETE":
+            if not existing:
+                raise AWSAPIError(
+                    "InvalidChangeBatch",
+                    f"{rs.name} {rs.type} not found")
+            for r in existing:
+                records.remove(r)
+        else:
+            raise AWSAPIError("InvalidInput", f"bad action {action}")
 
 
 class FakeAWSCloud(AWSAPIs):

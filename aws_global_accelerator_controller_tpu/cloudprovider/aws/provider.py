@@ -38,6 +38,13 @@ from ...kube.objects import Ingress, LoadBalancerIngress, Service
 from ...analysis import locks
 from ...metrics import record_coalesced_read, record_fleet_scan
 from .api import AWSAPIs
+from .batcher import (
+    MutationCoalescer,
+    op_remove,
+    op_replace,
+    op_set,
+    op_weight,
+)
 from .singleflight import Singleflight
 from .helpers import (
     CLUSTER_TAG_KEY,
@@ -177,7 +184,8 @@ class AWSProvider:
                  delete_poll_timeout: float = DELETE_POLL_TIMEOUT,
                  accelerator_not_found_retry: float = ACCELERATOR_NOT_FOUND_RETRY,
                  discovery_cache_ttl: float = DISCOVERY_CACHE_TTL,
-                 discovery_state: "FleetDiscoveryState | None" = None):
+                 discovery_state: "FleetDiscoveryState | None" = None,
+                 coalescer: "MutationCoalescer | None" = None):
         self.apis = apis
         self.delete_poll_interval = delete_poll_interval
         self.delete_poll_timeout = delete_poll_timeout
@@ -186,6 +194,13 @@ class AWSProvider:
         # the factory passes its one shared state (GA is global); a
         # bare provider gets a private fleet view
         self._s = discovery_state or FleetDiscoveryState()
+        # write-path coalescing (batcher.py): record-set and
+        # endpoint-group mutations are submitted as intents and flushed
+        # in batches.  The factory shares ONE coalescer across its
+        # regional providers (GA/Route53 are global services — two
+        # coalescers read-modify-writing one endpoint group would lose
+        # updates); a bare provider gets a private one
+        self.coalescer = coalescer or MutationCoalescer(apis)
 
     # A/B + escape hatch: class-level so a deployment (or the perf
     # harness) can disable the O(1)-negative path and fall back to
@@ -654,11 +669,11 @@ class AWSProvider:
             logger.info("endpoint group changed, updating: %s",
                         endpoint_group.endpoint_group_arn)
             from .types import EndpointDescription
-            self.apis.ga.update_endpoint_group(
+            self.coalescer.update_endpoints(
                 endpoint_group.endpoint_group_arn,
-                [EndpointDescription(
+                [op_replace([EndpointDescription(
                     endpoint_id=lb.load_balancer_arn,
-                    client_ip_preservation_enabled=ip_preserve)])
+                    client_ip_preservation_enabled=ip_preserve)])])
         logger.info("all resources are synced: %s",
                     accelerator.accelerator_arn)
 
@@ -851,52 +866,56 @@ class AWSProvider:
             logger.warning("LoadBalancer %s is not Active: %s",
                            lb.load_balancer_arn, lb.state_code)
             return None, LB_NOT_ACTIVE_RETRY
-        descriptions = self.apis.ga.add_endpoints(
-            endpoint_group.endpoint_group_arn, lb.load_balancer_arn,
-            ip_preserve, weight)
-        if not descriptions:
-            raise AWSAPIError("NoEndpointAdded", "No endpoint is added")
-        logger.info("endpoint added: %s", descriptions[0].endpoint_id)
-        return descriptions[0].endpoint_id, 0.0
+        [endpoint_id] = self.coalescer.update_endpoints(
+            endpoint_group.endpoint_group_arn,
+            [op_set(lb.load_balancer_arn, weight=weight,
+                    client_ip_preservation=ip_preserve)])
+        logger.info("endpoint added: %s", endpoint_id)
+        return endpoint_id, 0.0
 
     @traced("provider.remove_lb_from_endpoint_group")
     def remove_lb_from_endpoint_group(self, endpoint_group: EndpointGroup,
                                       endpoint_id: str) -> None:
         """(reference global_accelerator.go:592-599; the reference
         misspells this RemoveLBFromEdnpointGroup)"""
-        self.apis.ga.remove_endpoints(
-            endpoint_group.endpoint_group_arn, [endpoint_id])
+        self.coalescer.update_endpoints(
+            endpoint_group.endpoint_group_arn, [op_remove(endpoint_id)])
         logger.info("endpoint removed: %s", endpoint_id)
 
     @traced("provider.update_endpoint_weight")
     def update_endpoint_weight(self, endpoint_group: EndpointGroup,
                                endpoint_id: str,
                                weight: Optional[int]) -> None:
-        """Read-modify-write weight update.
+        """Coalesced read-modify-write weight update.
 
         The reference submits a single-endpoint UpdateEndpointGroup
         (global_accelerator.go:931-947), but the real API REPLACES the
         endpoint set with the given configurations -- clobbering sibling
-        endpoints in multi-LB bindings.  We resubmit the full set with only
-        the target's weight changed (deliberate fix, SURVEY.md §7).
+        endpoints in multi-LB bindings.  The coalescer resubmits the
+        full set with only the target's weight changed (deliberate fix,
+        SURVEY.md §7), folding concurrent re-weights of the same group
+        into one describe + update (last writer wins per endpoint).
         """
-        from .types import EndpointDescription
-        current = self.apis.ga.describe_endpoint_group(
-            endpoint_group.endpoint_group_arn)
-        configs = [
-            EndpointDescription(
-                endpoint_id=d.endpoint_id,
-                weight=weight if d.endpoint_id == endpoint_id else d.weight,
-                client_ip_preservation_enabled=d.client_ip_preservation_enabled)
-            for d in current.endpoint_descriptions
-        ]
-        if not any(d.endpoint_id == endpoint_id
-                   for d in current.endpoint_descriptions):
-            configs.append(EndpointDescription(endpoint_id=endpoint_id,
-                                               weight=weight))
-        self.apis.ga.update_endpoint_group(
-            endpoint_group.endpoint_group_arn, configs)
+        self.coalescer.update_endpoints(
+            endpoint_group.endpoint_group_arn,
+            [op_weight(endpoint_id, weight)])
         logger.info("endpoint weight updated: %s", endpoint_id)
+
+    @traced("provider.update_endpoint_weights")
+    def update_endpoint_weights(self, endpoint_group: EndpointGroup,
+                                weights: "dict[str, Optional[int]]",
+                                ) -> None:
+        """One merged re-weight for a whole endpoint group: every
+        (endpoint, weight) intent rides ONE coalesced flush — one
+        read-modify-write per convergence wave instead of one per
+        endpoint (and concurrent submitters' intents fold in too)."""
+        if not weights:
+            return
+        self.coalescer.update_endpoints(
+            endpoint_group.endpoint_group_arn,
+            [op_weight(endpoint_id, weight)
+             for endpoint_id, weight in weights.items()])
+        logger.info("endpoint weights updated: %s", sorted(weights))
 
     # ------------------------------------------------------------------
     # Route53
@@ -944,44 +963,57 @@ class AWSProvider:
 
         owner_value = route53_owner_value(cluster_name, resource, ns, name)
         created = False
+        # gather every hostname's change intents per zone, then submit
+        # each zone's set as ONE coalescer batch: a multi-hostname
+        # resource converges in one ChangeBatch, and concurrent
+        # resources targeting the same zone fold into the same flush
+        pending: "dict[str, list]" = {}
         for hostname in hostnames:
             hosted_zone = self.get_hosted_zone(hostname)
             logger.info("hosted zone is %s", hosted_zone.id)
             records = self.find_owned_a_record_sets(hosted_zone, owner_value)
             record = find_a_record(records, hostname)
+            changes = pending.setdefault(hosted_zone.id, [])
             if record is None:
                 logger.info("creating record for %s with %s", hostname,
                             accelerator.accelerator_arn)
-                self._create_metadata_record_set(hosted_zone, hostname,
-                                                 owner_value)
-                self._create_record_set(hosted_zone, hostname, accelerator)
+                changes.append(self._txt_record_change(
+                    "CREATE", hostname, owner_value))
+                changes.append(self._alias_record_change(
+                    "CREATE", hostname, accelerator))
                 created = True
             else:
                 if not need_records_update(record, accelerator):
                     logger.info("no update needed for %s, skipping",
                                 record.name)
                     continue
-                self._upsert_record_set(hosted_zone, hostname, accelerator)
-                logger.info("record set %s updated", record.name)
+                changes.append(self._alias_record_change(
+                    "UPSERT", hostname, accelerator))
+                logger.info("record set %s queued for update", record.name)
+        for zone_id, changes in pending.items():
+            if changes:
+                self.coalescer.change_record_sets(zone_id, changes)
         logger.info("all records synced for %s %s/%s", resource, ns, name)
         return created, 0.0
 
     @traced("provider.cleanup_record_set")
     def cleanup_record_set(self, cluster_name: str, resource: str, ns: str,
                            name: str) -> None:
-        """Scan ALL zones, delete owned A + TXT records
-        (reference route53.go:132-165)."""
+        """Scan ALL zones, delete owned A + TXT records — every zone's
+        deletes ride ONE coalescer batch (reference route53.go:132-165
+        issued one call per record)."""
         owner_value = route53_owner_value(cluster_name, resource, ns, name)
         for zone in self.apis.route53.list_hosted_zones():
-            for record in self.find_owned_a_record_sets(zone, owner_value):
-                self.apis.route53.change_resource_record_sets(
-                    zone.id, "DELETE", record)
-                logger.info("record set %s: %s deleted", record.name,
-                            record.type)
-            for record in self._find_owned_metadata_record_sets(
-                    zone, owner_value):
-                self.apis.route53.change_resource_record_sets(
-                    zone.id, "DELETE", record)
+            deletes = [
+                ("DELETE", record)
+                for record in (
+                    *self.find_owned_a_record_sets(zone, owner_value),
+                    *self._find_owned_metadata_record_sets(
+                        zone, owner_value))]
+            if not deletes:
+                continue
+            self.coalescer.change_record_sets(zone.id, deletes)
+            for _, record in deletes:
                 logger.info("record set %s: %s deleted", record.name,
                             record.type)
 
@@ -1004,37 +1036,28 @@ class AWSProvider:
                     hosted_zone.id)
                 if any(r.value == owner_value for r in rs.resource_records)]
 
-    def _create_record_set(self, hosted_zone, hostname, accelerator) -> None:
+    # The change-intent builders the coalescer consumes: ONE definition
+    # of each record shape (the pre-coalescing code carried three
+    # near-identical writer methods — create-A, upsert-A, create-TXT —
+    # differing only in action and record body).
+
+    @staticmethod
+    def _alias_record_change(action: str, hostname: str, accelerator):
         """ALIAS A -> accelerator DNS in the fixed GA hosted zone
-        (reference route53.go:240-269)."""
-        self.apis.route53.change_resource_record_sets(
-            hosted_zone.id, "CREATE",
-            ResourceRecordSet(
-                name=hostname, type=RR_TYPE_A,
-                alias_target=AliasTarget(
-                    dns_name=accelerator.dns_name,
-                    hosted_zone_id=GLOBAL_ACCELERATOR_HOSTED_ZONE_ID,
-                    evaluate_target_health=True)))
+        (reference route53.go:240-269 create, 296-320 upsert)."""
+        return (action, ResourceRecordSet(
+            name=hostname, type=RR_TYPE_A,
+            alias_target=AliasTarget(
+                dns_name=accelerator.dns_name,
+                hosted_zone_id=GLOBAL_ACCELERATOR_HOSTED_ZONE_ID,
+                evaluate_target_health=True)))
 
-    def _create_metadata_record_set(self, hosted_zone, hostname,
-                                    owner_value) -> None:
+    @staticmethod
+    def _txt_record_change(action: str, hostname: str, owner_value: str):
         """Paired ownership TXT, TTL 300 (reference route53.go:271-294)."""
-        self.apis.route53.change_resource_record_sets(
-            hosted_zone.id, "CREATE",
-            ResourceRecordSet(
-                name=hostname, type=RR_TYPE_TXT, ttl=TXT_RECORD_TTL,
-                resource_records=[ResourceRecord(value=owner_value)]))
-
-    def _upsert_record_set(self, hosted_zone, hostname, accelerator) -> None:
-        """(reference route53.go:296-320)"""
-        self.apis.route53.change_resource_record_sets(
-            hosted_zone.id, "UPSERT",
-            ResourceRecordSet(
-                name=hostname, type=RR_TYPE_A,
-                alias_target=AliasTarget(
-                    dns_name=accelerator.dns_name,
-                    hosted_zone_id=GLOBAL_ACCELERATOR_HOSTED_ZONE_ID,
-                    evaluate_target_health=True)))
+        return (action, ResourceRecordSet(
+            name=hostname, type=RR_TYPE_TXT, ttl=TXT_RECORD_TTL,
+            resource_records=[ResourceRecord(value=owner_value)]))
 
     def get_hosted_zone(self, original_hostname: str) -> HostedZone:
         """Walk parent domains until a zone matches

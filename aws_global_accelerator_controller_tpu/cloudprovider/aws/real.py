@@ -340,8 +340,8 @@ class BotoRoute53(Route53API):
             kwargs["StartRecordName"] = page.get("NextRecordName")
             kwargs["StartRecordType"] = page.get("NextRecordType")
 
-    def change_resource_record_sets(self, hosted_zone_id, action,
-                                    record_set) -> None:
+    @staticmethod
+    def _to_change(action, record_set) -> dict:
         rs = {"Name": record_set.name, "Type": record_set.type}
         if record_set.ttl is not None:
             rs["TTL"] = record_set.ttl
@@ -355,10 +355,25 @@ class BotoRoute53(Route53API):
                 "EvaluateTargetHealth":
                     record_set.alias_target.evaluate_target_health,
             }
+        return {"Action": action, "ResourceRecordSet": rs}
+
+    def change_resource_record_sets(self, hosted_zone_id, action,
+                                    record_set) -> None:
         self._call(self._c.change_resource_record_sets,
                    HostedZoneId=hosted_zone_id,
                    ChangeBatch={"Changes": [
-                       {"Action": action, "ResourceRecordSet": rs}]})
+                       self._to_change(action, record_set)]})
+
+    def change_resource_record_sets_batch(self, hosted_zone_id,
+                                          changes) -> None:
+        """One ChangeResourceRecordSets call carrying the whole batch —
+        the real API applies it atomically and charges the hosted
+        zone's throttle budget once for the call, not per change."""
+        self._call(self._c.change_resource_record_sets,
+                   HostedZoneId=hosted_zone_id,
+                   ChangeBatch={"Changes": [
+                       self._to_change(action, record_set)
+                       for action, record_set in changes]})
 
 
 class BotoAWSAPIs(AWSAPIs):

@@ -26,7 +26,12 @@ from dataclasses import dataclass
 from ..apis.endpointgroupbinding.v1alpha1 import EndpointGroupBinding
 from ..cloudprovider.aws import get_lb_name_from_hostname, get_region_from_arn
 from ..cloudprovider.aws.factory import CloudFactory
-from ..errors import AWSAPIError, ERR_ENDPOINT_GROUP_NOT_FOUND, NotFoundError
+from ..errors import (
+    AWSAPIError,
+    ConflictError,
+    ERR_ENDPOINT_GROUP_NOT_FOUND,
+    NotFoundError,
+)
 from ..kube.client import KubeClient, OperatorClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
 from ..kube.objects import split_meta_namespace_key
@@ -284,10 +289,7 @@ class EndpointGroupBindingController:
                                                    endpoint_id)
             remaining.remove(endpoint_id)
 
-        copied = obj.deep_copy()
-        copied.status.endpoint_ids = remaining
-        copied.status.observed_generation = obj.metadata.generation
-        self.client.endpoint_group_bindings.update_status(copied)
+        self._update_status(obj, remaining)
         # requeue: next pass observes the drained status and clears the
         # finalizer (reconcile.go:96)
         return Result(requeue=True, requeue_after=DELETE_REQUEUE)
@@ -296,6 +298,36 @@ class EndpointGroupBindingController:
         copied = obj.deep_copy()
         copied.metadata.finalizers = []
         self.client.endpoint_group_bindings.update(copied)
+
+    def _update_status(self, obj: EndpointGroupBinding,
+                       endpoint_ids) -> None:
+        """Record the converged endpoint set on status, retrying a
+        resourceVersion conflict against the FRESH object.
+
+        ``status.endpointIds`` is the delete path's ONLY record of what
+        this controller added to the endpoint group: losing the write
+        to a concurrent metadata update — most often the deletion
+        timestamp landing between this sync's informer read and its
+        status write — would orphan those endpoints forever
+        (``_reconcile_delete`` drains exactly the recorded ids).  The
+        window is real since endpoint mutations ride coalesced flushes
+        (batcher.py linger) between the read and the write.
+        """
+        copied = obj.deep_copy()
+        last: "ConflictError | None" = None
+        for _ in range(5):
+            copied.status.endpoint_ids = list(endpoint_ids)
+            # the generation whose spec this sync actually converged
+            copied.status.observed_generation = obj.metadata.generation
+            try:
+                self.client.endpoint_group_bindings.update_status(copied)
+                return
+            except ConflictError as e:
+                last = e
+                fresh = self.client.endpoint_group_bindings.get(
+                    obj.metadata.namespace, obj.metadata.name)
+                copied = fresh.deep_copy()
+        raise last  # persistent conflict: let the requeue path retry
 
     def _reconcile_update(self, obj: EndpointGroupBinding,
                           provider) -> Result:
@@ -353,12 +385,15 @@ class EndpointGroupBindingController:
         # one plan for the whole group (reference loops spec.weight,
         # reconcile.go:197-204; the policy seam lets the TPU planner
         # allocate per-endpoint weights for spec.weight: null bindings)
+        # applied as ONE merged re-weight: every endpoint's intent
+        # rides a single coalesced read-modify-write instead of one
+        # full describe+update cycle per endpoint
         planned = self.weight_policy.plan(obj, endpoint_group,
                                           list(arns))
-        for endpoint_id in arns:
-            provider.update_endpoint_weight(
-                endpoint_group, endpoint_id,
-                planned.get(endpoint_id, obj.spec.weight))
+        provider.update_endpoint_weights(
+            endpoint_group,
+            {endpoint_id: planned.get(endpoint_id, obj.spec.weight)
+             for endpoint_id in arns})
         if arns:
             # recorded only once every update succeeded — a provider
             # failure mid-loop must not count as an applied plan; the
@@ -371,10 +406,7 @@ class EndpointGroupBindingController:
                 type(self.weight_policy).__name__,
                 plan_source(self.weight_policy, obj.spec.weight))
 
-        copied = obj.deep_copy()
-        copied.status.endpoint_ids = results
-        copied.status.observed_generation = obj.metadata.generation
-        self.client.endpoint_group_bindings.update_status(copied)
+        self._update_status(obj, results)
         return Result()
 
     def _get_load_balancer_hostnames(self, obj: EndpointGroupBinding):

@@ -176,6 +176,26 @@ default_registry.describe(
     "queued on debt); capacity halves on throttle responses and "
     "recovers on success.")
 default_registry.describe(
+    "provider_mutations_enqueued_total",
+    "Write intents submitted to the mutation coalescer "
+    "(cloudprovider/aws/batcher.py), by kind (record_set / "
+    "endpoint_group).")
+default_registry.describe(
+    "provider_mutation_flushes_total",
+    "AWS mutation calls issued by the write path, by kind — one per "
+    "coalesced flush (bisect halves and the coalescing-disabled "
+    "per-intent calls each count); enqueued/flushes is the fold "
+    "ratio bench.py batch-efficiency reports.")
+default_registry.describe(
+    "provider_mutation_folds_total",
+    "Write intents superseded in the coalescer queue before flushing "
+    "(UPSERT+DELETE collapse, last-writer-wins re-weights) — work "
+    "that never reached the wire.")
+default_registry.describe(
+    "provider_flush_bisects_total",
+    "Coalesced flushes split in half after a terminal batch "
+    "rejection, isolating a poisoned change to its own waiters.")
+default_registry.describe(
     "race_lockset_checks",
     "Lock acquisitions screened by the runtime lockset tracker "
     "(analysis/locks.py) — nonzero proves the detector was armed.")
@@ -219,6 +239,40 @@ def record_coalesced_read(op: str,
 def record_fleet_scan(registry: Optional[Registry] = None) -> None:
     reg = registry or default_registry
     reg.inc_counter("provider_fleet_scans_total", {})
+
+
+def record_mutation_enqueued(kind: str, n: int = 1,
+                             registry: Optional[Registry] = None) -> None:
+    """``n`` write intents entered a coalescer queue
+    (cloudprovider/aws/batcher.py submit surface)."""
+    reg = registry or default_registry
+    reg.inc_counter("provider_mutations_enqueued_total", {"kind": kind},
+                    float(n))
+
+
+def record_mutation_flush(kind: str,
+                          registry: Optional[Registry] = None) -> None:
+    """One AWS mutation call issued by the write path (a coalesced
+    flush, a bisect half, or a coalescing-disabled direct call)."""
+    reg = registry or default_registry
+    reg.inc_counter("provider_mutation_flushes_total", {"kind": kind})
+
+
+def record_mutation_fold(kind: str, n: int = 1,
+                         registry: Optional[Registry] = None) -> None:
+    """``n`` intents were superseded in-queue (folded) instead of
+    reaching the wire."""
+    reg = registry or default_registry
+    reg.inc_counter("provider_mutation_folds_total", {"kind": kind},
+                    float(n))
+
+
+def record_flush_bisect(kind: str,
+                        registry: Optional[Registry] = None) -> None:
+    """A rejected coalesced flush was bisected to isolate a poisoned
+    change."""
+    reg = registry or default_registry
+    reg.inc_counter("provider_flush_bisects_total", {"kind": kind})
 
 
 def record_aws_call_retry(op: str,

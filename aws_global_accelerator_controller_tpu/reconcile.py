@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -114,10 +115,17 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                 # requeue rate)
                 outcome = "retry_exhausted"
                 queue.forget(key)
-                queue.add_after(key, hint)
+                # a coalesced flush failure (cloudprovider/aws/batcher)
+                # hands the SAME hint to every key whose intent rode
+                # the batch; identical parks would re-converge the
+                # whole cohort into one thundering requeue wave, so a
+                # key-stable jitter in [1.0, 1.25) decorrelates them
+                # (deterministic per key — no park-time flapping)
+                jitter = 1.0 + 0.25 * (zlib.crc32(key.encode()) / 2**32)
+                queue.add_after(key, hint * jitter)
                 logger.warning("error syncing %r, retry budget "
                                "exhausted; parked %.2fs: %s",
-                               key, hint, err)
+                               key, hint * jitter, err)
             else:
                 outcome = "error"
                 queue.add_rate_limited(key)

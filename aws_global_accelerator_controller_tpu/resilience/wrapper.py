@@ -60,6 +60,11 @@ ELB_METHODS = frozenset({"describe_load_balancers"})
 ROUTE53_METHODS = frozenset({
     "list_hosted_zones", "list_hosted_zones_by_name",
     "list_resource_record_sets", "change_resource_record_sets",
+    # the write coalescer's flush (batcher.py): ONE wrapped call per
+    # drained batch, so a whole cohort shares one retry budget /
+    # breaker verdict — per-waiter attribution happens above this
+    # layer (flush-level classify, waiter-level demux)
+    "change_resource_record_sets_batch",
 })
 
 

@@ -138,6 +138,49 @@ def test_expired_windows_are_pruned():
     assert inj._windows == []               # bookkeeping stays bounded
 
 
+def test_zone_throttle_buckets_are_per_zone_and_refill():
+    """set_zone_throttle models Route53's per-hosted-zone limit: a
+    deterministic token bucket per zone (no seeded draws consumed),
+    charged per CALL — the property that makes ChangeBatch batching
+    a real win under throttling."""
+    clock = {"t": 100.0}
+    inj = FaultInjector(seed=7, clock=lambda: clock["t"])
+    inj.set_zone_throttle(rate_per_s=1.0, burst=2.0)
+
+    method = "change_resource_record_sets_batch"
+    inj.check(method, zone="Z1")            # burst token 1
+    inj.check(method, zone="Z1")            # burst token 2
+    with pytest.raises(AWSAPIError) as ei:
+        inj.check(method, zone="Z1")        # bucket empty
+    assert ei.value.code == "ThrottlingException"
+    assert ei.value.retryable
+    inj.check(method, zone="Z2")            # other zone: own bucket
+    clock["t"] = 101.5                      # 1.5 tokens refilled
+    inj.check(method, zone="Z1")
+    with pytest.raises(AWSAPIError):
+        inj.check(method, zone="Z1")
+    assert inj.injected_counts()[method] == 2
+    inj.check("list_accelerators")          # zone-less calls untouched
+
+    inj.set_zone_throttle(0.0)              # clears
+    for _ in range(5):
+        inj.check(method, zone="Z1")
+
+
+def test_zone_throttle_does_not_perturb_seeded_schedule():
+    """The zone buckets draw no randomness: the seeded error-rate
+    decisions are byte-identical with and without a zone throttle
+    configured (per-method call indexes advance the same)."""
+    plain = FaultInjector(seed=1337)
+    plain.set_error_rate("*", 0.2)
+    throttled = FaultInjector(seed=1337)
+    throttled.set_error_rate("*", 0.2)
+    throttled.set_zone_throttle(rate_per_s=1e9)   # never actually bites
+    counts_a = drive(plain, SCRIPT)
+    counts_b = drive(throttled, SCRIPT)
+    assert counts_a == counts_b
+
+
 def test_latency_injection_delays_the_call():
     inj = FaultInjector(seed=7)
     inj.set_latency("list_accelerators", 0.03)

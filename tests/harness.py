@@ -39,13 +39,14 @@ class Cluster:
                  settle_seconds: float = 0.0, queue_qps: float = 10.0,
                  queue_burst: int = 100, weight_policy: str = "static",
                  policy_checkpoint: str = "", resilience=None,
-                 fault_seed=None):
+                 fault_seed=None, coalesce=None):
         self.api = FakeAPIServer()
         self.kube = KubeClient(self.api)
         self.operator = OperatorClient(self.api)
         self.factory = FakeCloudFactory(settle_seconds=settle_seconds,
                                         resilience=resilience,
-                                        fault_seed=fault_seed)
+                                        fault_seed=fault_seed,
+                                        coalesce=coalesce)
         self.cloud = self.factory.cloud
         self.stop = threading.Event()
         self._manager = Manager(resync_period=resync_period)

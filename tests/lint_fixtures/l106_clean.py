@@ -1,0 +1,17 @@
+"""Rule L106 clean fixture: record and endpoint-group mutations go
+through the coalescer's submit surface; reads (describe, list) and
+non-coalesced mutations (create/delete chains) stay on ``apis``."""
+
+
+class Provider:
+    def __init__(self, apis, coalescer):
+        self.apis = apis
+        self.coalescer = coalescer
+
+    def sync(self, zone_id, arn, changes, ops):
+        self.coalescer.change_record_sets(zone_id, changes)
+        self.coalescer.update_endpoints(arn, ops)
+        self.apis.ga.describe_endpoint_group(arn)
+        self.apis.route53.list_resource_record_sets(zone_id)
+        return self.apis.ga.create_endpoint_group(arn, "region", "lb",
+                                                  False)

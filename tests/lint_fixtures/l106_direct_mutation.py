@@ -1,0 +1,17 @@
+"""Rule L106 fixture: mutations on the write-coalescing surface issued
+directly — even through the resilience-wrapped ``apis`` bundle (so
+L105 stays silent) — bypass the MutationCoalescer's folding, bisect
+and per-waiter error demux."""
+
+
+class Controller:
+    def __init__(self, apis):
+        self.apis = apis
+
+    def sync(self, zone_id, arn, record_set, configs):
+        self.apis.route53.change_resource_record_sets(
+            zone_id, "UPSERT", record_set)
+        self.apis.route53.change_resource_record_sets_batch(
+            zone_id, [("UPSERT", record_set)])
+        self.apis.ga.update_endpoint_group(arn, configs)
+        self.apis.ga.update_endpoint_group(arn, configs)  # race: deliberate direct replace

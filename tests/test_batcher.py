@@ -1,0 +1,428 @@
+"""MutationCoalescer folding / flush / error-demux semantics
+(cloudprovider/aws/batcher.py) against the fake cloud.
+
+The contracts the write-coalescing layer must keep while changing the
+unit of work on the wire from one-call-per-record to
+one-call-per-convergence-wave:
+
+- folding never drops a waiter (superseded intents ride the survivor);
+- a terminal batch rejection bisects so one poisoned change fails
+  alone — per-key error attribution survives batching;
+- a hint-carrying flush failure (open circuit, retry budget) parks the
+  WHOLE cohort with the hint, reconcile dispatch unchanged per key.
+"""
+import threading
+import time
+
+import pytest
+
+from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.batcher import (
+    CoalesceConfig,
+    MutationCoalescer,
+    op_remove,
+    op_replace,
+    op_set,
+    op_weight,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
+    FakeCloudFactory,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.fake import (
+    FakeAWSCloud,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+    EndpointDescription,
+    PortRange,
+    ResourceRecord,
+    ResourceRecordSet,
+)
+from aws_global_accelerator_controller_tpu.errors import (
+    AWSAPIError,
+    retry_after_hint,
+)
+from aws_global_accelerator_controller_tpu.resilience import (
+    ResilienceConfig,
+    ResilientAPIs,
+    STATE_OPEN,
+)
+
+LINGER = 0.15  # long enough that a second thread reliably joins the batch
+
+
+def txt(name, value="owner"):
+    return ResourceRecordSet(name=name, type="TXT", ttl=300,
+                             resource_records=[ResourceRecord(value=value)])
+
+
+def make_coalescer(cloud, **kw):
+    kw.setdefault("linger", LINGER)
+    return MutationCoalescer(cloud, config=CoalesceConfig(**kw))
+
+
+def make_zone(cloud, name="example.com"):
+    return cloud.route53.create_hosted_zone(name)
+
+
+def make_endpoint_group(cloud):
+    acc = cloud.ga.create_accelerator("a", "IPV4", True, {})
+    listener = cloud.ga.create_listener(
+        acc.accelerator_arn, [PortRange(80, 80)], "TCP", "NONE")
+    return cloud.ga.create_endpoint_group(
+        listener.listener_arn, "us-east-1", "arn:lb/seed", False)
+
+
+def record_names(cloud, zone_id):
+    return {(r.name, r.type)
+            for r in cloud.route53.list_resource_record_sets(zone_id)}
+
+
+def counter_delta(name, kind=None):
+    labels = {"kind": kind} if kind else None
+    return metrics.default_registry.counter_value(name, labels)
+
+
+def run_threads(*fns):
+    """Run each fn in its own thread; returns {index: exception}."""
+    errs = {}
+
+    def wrap(i, fn):
+        def target():
+            try:
+                fn()
+            except Exception as e:  # captured for assertions
+                errs[i] = e
+        return target
+
+    threads = [threading.Thread(target=wrap(i, fn))
+               for i, fn in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not any(t.is_alive() for t in threads), "coalescer hung"
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# folding
+# ---------------------------------------------------------------------------
+
+def test_upsert_then_delete_folds_to_one_call():
+    """UPSERT superseded by DELETE of the same record collapses to ONE
+    change in ONE batch call; BOTH waiters succeed (folding never drops
+    a waiter)."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    cloud.route53.change_resource_record_sets(zone.id, "CREATE", txt("x.example.com"))
+    co = make_coalescer(cloud)
+    calls_before = cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0)
+    folds_before = counter_delta("provider_mutation_folds_total",
+                                 "record_set")
+
+    started = threading.Event()
+
+    def leader():
+        started.set()
+        co.change_record_sets(zone.id, [("UPSERT", txt("x.example.com"))])
+
+    def follower():
+        started.wait()
+        time.sleep(LINGER / 4)
+        co.change_record_sets(zone.id, [("DELETE", txt("x.example.com"))])
+
+    errs = run_threads(leader, follower)
+    assert errs == {}, f"folded waiters must both succeed: {errs}"
+    assert ("x.example.com.", "TXT") not in record_names(cloud, zone.id), \
+        "the DELETE (last writer) must win"
+    assert cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0) == calls_before + 1, \
+        "both intents must ride ONE ChangeBatch"
+    assert counter_delta("provider_mutation_folds_total",
+                         "record_set") == folds_before + 1
+
+
+def test_reweight_last_writer_wins_single_rmw():
+    """Two re-weights of one endpoint in a submit fold last-writer-wins
+    and the whole cohort costs ONE describe + ONE update."""
+    cloud = FakeAWSCloud()
+    eg = make_endpoint_group(cloud)
+    co = make_coalescer(cloud, linger=0.0)
+    before = dict(cloud.faults.call_counts())
+
+    co.update_endpoints(eg.endpoint_group_arn,
+                        [op_weight("arn:lb/seed", 5),
+                         op_weight("arn:lb/seed", 9)])
+
+    counts = cloud.faults.call_counts()   # before the assertion reads
+    got = cloud.ga.describe_endpoint_group(eg.endpoint_group_arn)
+    assert [(d.endpoint_id, d.weight) for d in got.endpoint_descriptions] \
+        == [("arn:lb/seed", 9)]
+    assert counts.get("update_endpoint_group", 0) \
+        == before.get("update_endpoint_group", 0) + 1
+    assert counts.get("describe_endpoint_group", 0) \
+        == before.get("describe_endpoint_group", 0) + 1
+
+
+def test_endpoint_ops_compose_in_one_update():
+    """set + remove + weight-for-absent in one submit merge into a
+    single read-modify-write with the old per-op semantics."""
+    cloud = FakeAWSCloud()
+    eg = make_endpoint_group(cloud)
+    co = make_coalescer(cloud, linger=0.0)
+
+    results = co.update_endpoints(
+        eg.endpoint_group_arn,
+        [op_set("arn:lb/a", weight=10, client_ip_preservation=True),
+         op_remove("arn:lb/seed"),
+         op_weight("arn:lb/b", 7)])   # absent: appended weight-only
+
+    assert results[0] == "arn:lb/a"
+    got = cloud.ga.describe_endpoint_group(eg.endpoint_group_arn)
+    by_id = {d.endpoint_id: d for d in got.endpoint_descriptions}
+    assert set(by_id) == {"arn:lb/a", "arn:lb/b"}
+    assert by_id["arn:lb/a"].weight == 10
+    assert by_id["arn:lb/a"].client_ip_preservation_enabled
+    assert by_id["arn:lb/b"].weight == 7
+    assert cloud.faults.call_counts().get("update_endpoint_group", 0) == 1
+
+
+def test_replace_absorbs_pending_ops():
+    """A replace op supersedes every pending op for its group; the
+    absorbed waiters still succeed."""
+    cloud = FakeAWSCloud()
+    eg = make_endpoint_group(cloud)
+    co = make_coalescer(cloud, linger=0.0)
+
+    co.update_endpoints(
+        eg.endpoint_group_arn,
+        [op_weight("arn:lb/seed", 3),
+         op_replace([EndpointDescription(endpoint_id="arn:lb/final",
+                                         weight=1)])])
+
+    got = cloud.ga.describe_endpoint_group(eg.endpoint_group_arn)
+    assert [d.endpoint_id for d in got.endpoint_descriptions] \
+        == ["arn:lb/final"]
+
+
+def test_replace_absorbed_set_keeps_its_own_result():
+    """A set op folded into a later replace still answers with ITS
+    endpoint id: the result identifies the submitted intent (the EGB
+    controller records it as the drain list), it is not the absorber's
+    empty id — a None here would silently drop the endpoint from
+    status.endpointIds and orphan it on binding deletion."""
+    cloud = FakeAWSCloud()
+    eg = make_endpoint_group(cloud)
+    co = make_coalescer(cloud, linger=0.0)
+    results = co.update_endpoints(
+        eg.endpoint_group_arn,
+        [op_set("arn:lb/mine", weight=3),
+         op_replace([EndpointDescription(endpoint_id="arn:lb/other")])])
+    assert results[0] == "arn:lb/mine"
+    assert results[1] is None
+
+
+def test_container_not_found_fails_cohort_without_bisect():
+    """A batch-wide not-found (the hosted zone deleted out-of-band) is
+    every waiter's answer: no bisect, ONE call, the cohort shares the
+    verdict instead of ~2N more calls doomed to the same error."""
+    cloud = FakeAWSCloud()
+    co = make_coalescer(cloud, linger=0.0)
+    bisects_before = counter_delta("provider_flush_bisects_total",
+                                   "record_set")
+    with pytest.raises(AWSAPIError) as ei:
+        co.change_record_sets("Z-GONE", [
+            ("CREATE", txt("a.example.com")),
+            ("CREATE", txt("b.example.com")),
+            ("CREATE", txt("c.example.com"))])
+    assert ei.value.code == "NoSuchHostedZone"
+    assert cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0) == 1
+    assert counter_delta("provider_flush_bisects_total",
+                         "record_set") == bisects_before
+
+
+def test_idle_groups_are_pruned():
+    """Per-zone/EG groups (each carrying a tracked condition) are
+    dropped once drained and idle — accelerator/EG churn must not grow
+    the group map for the process lifetime."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    co = make_coalescer(cloud, linger=0.0)
+    for i in range(3):
+        co.change_record_sets(zone.id,
+                              [("CREATE", txt(f"p{i}.example.com"))])
+    assert co._groups == {}, "drained idle groups must be pruned"
+    assert {(f"p{i}.example.com.", "TXT") for i in range(3)} \
+        <= record_names(cloud, zone.id)
+
+
+# ---------------------------------------------------------------------------
+# error demultiplexing
+# ---------------------------------------------------------------------------
+
+def test_bisect_isolates_poisoned_change():
+    """A batch carrying one invalid change bisects: the three good
+    CREATEs commit, only the poisoned DELETE's waiter sees the error."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    co = make_coalescer(cloud, linger=0.0)
+    bisects_before = counter_delta("provider_flush_bisects_total",
+                                   "record_set")
+
+    with pytest.raises(AWSAPIError, match="not found"):
+        co.change_record_sets(zone.id, [
+            ("DELETE", txt("missing.example.com")),   # poisoned
+            ("CREATE", txt("a.example.com")),
+            ("CREATE", txt("b.example.com")),
+            ("CREATE", txt("c.example.com")),
+        ])
+
+    names = record_names(cloud, zone.id)
+    assert {("a.example.com.", "TXT"), ("b.example.com.", "TXT"),
+            ("c.example.com.", "TXT")} <= names, \
+        "the poisoned change must not wedge its cohort"
+    assert ("missing.example.com.", "TXT") not in names
+    assert counter_delta("provider_flush_bisects_total",
+                         "record_set") >= bisects_before + 1
+
+
+def test_poisoned_cohort_waiter_keeps_others_healthy():
+    """Cross-thread demux: one waiter's terminal error (the reconcile
+    NoRetry/dropped shape) is raised to that waiter ONLY — the cohort
+    waiter whose change committed returns success."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    co = make_coalescer(cloud)
+    started = threading.Event()
+
+    def poisoned():
+        started.set()
+        co.change_record_sets(
+            zone.id, [("DELETE", txt("missing.example.com"))])
+
+    def healthy():
+        started.wait()
+        time.sleep(LINGER / 4)
+        co.change_record_sets(
+            zone.id, [("CREATE", txt("good.example.com"))])
+
+    errs = run_threads(poisoned, healthy)
+    assert set(errs) == {0}, f"only the poisoned waiter may fail: {errs}"
+    assert isinstance(errs[0], AWSAPIError)
+    assert errs[0].code == "InvalidChangeBatch"
+    assert ("good.example.com.", "TXT") in record_names(cloud, zone.id)
+
+
+def test_flush_under_open_circuit_parks_every_waiter():
+    """A flush attempted against an open circuit fails the WHOLE
+    cohort with the hint-carrying error: every waiter's key parks via
+    reconcile.py's unchanged dispatch, and nothing reaches the API."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    apis = ResilientAPIs(cloud, region="test", config=ResilienceConfig(
+        max_attempts=1, base_delay=0.001, max_delay=0.002, deadline=1.0,
+        breaker_window=30.0, breaker_min_calls=2,
+        breaker_failure_threshold=0.5, breaker_open_seconds=30.0,
+        bucket_capacity=1e6, bucket_refill=1e6, seed=7))
+    # trip the breaker with two transient failures
+    cloud.faults.fail_on("list_hosted_zones",
+                         AWSAPIError("InternalError", "boom"), times=2)
+    for _ in range(2):
+        with pytest.raises(AWSAPIError):
+            apis.route53.list_hosted_zones()
+    assert apis.breaker.state() == STATE_OPEN
+
+    co = MutationCoalescer(apis, config=CoalesceConfig(linger=0.05))
+    batch_calls_before = cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0)
+
+    def submit(i):
+        def fn():
+            co.change_record_sets(
+                zone.id, [("CREATE", txt(f"h{i}.example.com"))])
+        return fn
+
+    errs = run_threads(submit(0), submit(1), submit(2))
+    assert set(errs) == {0, 1, 2}, "every cohort waiter must fail"
+    for e in errs.values():
+        assert retry_after_hint(e) > 0, \
+            f"waiters must carry the park hint: {e!r}"
+    assert cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0) == batch_calls_before, \
+        "an open circuit must fail fast without reaching the API"
+
+
+def test_endpoint_group_not_found_is_every_waiters_answer():
+    """A failed flush READ (describe) is not attributable to one
+    intent: every waiter gets the describe's verdict."""
+    cloud = FakeAWSCloud()
+    co = make_coalescer(cloud, linger=0.0)
+    with pytest.raises(AWSAPIError):
+        co.update_endpoints("arn:nope", [op_weight("arn:lb/a", 1),
+                                         op_weight("arn:lb/b", 2)])
+
+
+# ---------------------------------------------------------------------------
+# atomic fake semantics + disabled mode + provider integration
+# ---------------------------------------------------------------------------
+
+def test_fake_batch_is_all_or_nothing():
+    """The fake's ChangeBatch is atomic: a batch with one invalid
+    change applies NOTHING (the contract bisection relies on)."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    with pytest.raises(AWSAPIError, match="InvalidChangeBatch|not found"):
+        cloud.route53.change_resource_record_sets_batch(zone.id, [
+            ("CREATE", txt("ok.example.com")),
+            ("DELETE", txt("missing.example.com")),
+        ])
+    assert record_names(cloud, zone.id) == set(), \
+        "a rejected batch must leave the zone untouched"
+
+
+def test_disabled_mode_replays_per_call_pattern():
+    """The A/B escape hatch: coalescing off issues one call per record
+    change (what bench.py batch-efficiency measures the win against)."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    co = MutationCoalescer(cloud, config=CoalesceConfig(enabled=False))
+    co.change_record_sets(zone.id, [("CREATE", txt("a.example.com")),
+                                    ("CREATE", txt("b.example.com"))])
+    counts = cloud.faults.call_counts()
+    assert counts.get("change_resource_record_sets", 0) == 2
+    assert counts.get("change_resource_record_sets_batch", 0) == 0
+    assert {("a.example.com.", "TXT"),
+            ("b.example.com.", "TXT")} <= record_names(cloud, zone.id)
+
+
+def test_provider_update_endpoint_weights_is_one_flush():
+    """The EGB controller's whole-group re-weight costs one
+    describe + one update regardless of endpoint count."""
+    factory = FakeCloudFactory()
+    provider = factory.provider_for("us-east-1")
+    cloud = factory.cloud
+    eg = make_endpoint_group(cloud)
+    cloud.ga.add_endpoints(eg.endpoint_group_arn, "arn:lb/two", False, 1)
+    before = dict(cloud.faults.call_counts())
+
+    provider.update_endpoint_weights(
+        eg, {"arn:lb/seed": 40, "arn:lb/two": 60})
+
+    got = cloud.ga.describe_endpoint_group(eg.endpoint_group_arn)
+    weights = {d.endpoint_id: d.weight for d in got.endpoint_descriptions}
+    assert weights == {"arn:lb/seed": 40, "arn:lb/two": 60}
+    counts = cloud.faults.call_counts()
+    assert counts.get("update_endpoint_group", 0) \
+        == before.get("update_endpoint_group", 0) + 1
+
+
+def test_factory_shares_one_coalescer_across_regions():
+    """GA/Route53 are global services: regional providers must share
+    ONE coalescer (two coalescers read-modify-writing the same endpoint
+    group would lose updates) — the FleetDiscoveryState precedent."""
+    factory = FakeCloudFactory()
+    a = factory.provider_for("us-west-2")
+    b = factory.provider_for("ap-northeast-1")
+    assert a.coalescer is b.coalescer

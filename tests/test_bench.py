@@ -87,6 +87,48 @@ def test_bench_resilience_overhead_smoke(monkeypatch, tmp_path):
     assert (tmp_path / "hist.jsonl").exists()
 
 
+def test_bench_batch_efficiency_smoke(monkeypatch, tmp_path):
+    """Small-N run of the write-coalescing A/B leg: both modes
+    converge, the uncoalesced baseline replays one call per record
+    change (2/service), the coalesced leg never costs more, and the
+    tagged history record lands."""
+    path = tmp_path / "hist.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(path))
+    out = bench.bench_batch_efficiency(sizes=(8,), workers=2,
+                                       record=True)
+    [leg] = out["legs"]
+    un, co = leg["uncoalesced"], leg["coalesced"]
+    assert un["mutation_calls_per_service"] == pytest.approx(2.0), \
+        "uncoalesced baseline must be the per-record-call pattern"
+    assert co["mutation_calls"] <= un["mutation_calls"]
+    assert co["fold_ratio"] >= 1.0
+    assert leg["reduction"] >= 1.0
+    assert un["throughput"] > 0 and co["throughput"] > 0
+    # the history entry is tagged so reconcile_floor skips it
+    entries = [json.loads(line) for line in path.read_text().splitlines()]
+    assert entries[-1]["bench"] == "batch-efficiency"
+    assert "mutation_calls_per_service" in entries[-1]
+    assert "fold_ratio" in entries[-1]
+
+
+def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
+    """batch-efficiency legs measure a route53-heavy workload, not the
+    floor's pure create storm: their (lower) throughputs must not drag
+    the derived floor down."""
+    hist = tmp_path / "history.jsonl"
+    hist.write_text("".join(
+        json.dumps(e) + "\n" for e in (
+            {"throughput": 3400.0}, {"throughput": 3500.0},
+            {"throughput": 3450.0},
+            {"throughput": 150.0, "bench": "batch-efficiency"},
+            {"throughput": 160.0, "bench": "batch-efficiency"})))
+    monkeypatch.delenv("RECONCILE_FLOOR_SVC_S", raising=False)
+    monkeypatch.setattr(bench.os, "getloadavg", lambda: (0.0, 0, 0))
+    got = bench.reconcile_floor(history_path=str(hist))
+    assert got == pytest.approx(min(0.5 * 3450.0, 0.9 * 3400.0)), \
+        "tagged entries leaked into the floor derivation"
+
+
 def test_bench_reconcile_scaling_smoke():
     """Small-N run of the scaling leg so it can't silently rot between
     the real 200→1000 invocations: both legs converge, the ratio is
@@ -134,6 +176,14 @@ def _main_json(monkeypatch, capsys, tmp_path, status, detail):
                       "elapsed_s": 0.01, "throughput": 2000.0,
                       "index_lookups": 4, "coalesced_reads": 0,
                       "fleet_scans": 1})
+    monkeypatch.setattr(
+        bench, "bench_batch_efficiency",
+        lambda **kw: {"workers": 4, "legs": [
+            {"services": 10, "reduction": 5.0,
+             "uncoalesced": {"mutation_calls_per_service": 2.0,
+                             "fold_ratio": 1.0, "throughput": 900.0},
+             "coalesced": {"mutation_calls_per_service": 0.4,
+                           "fold_ratio": 5.0, "throughput": 950.0}}]})
     monkeypatch.setattr(bench, "tpu_probe", lambda *a, **k: (status,
                                                             detail))
     planner_calls = []
@@ -175,6 +225,7 @@ def test_main_contract_healthy_tpu(monkeypatch, capsys, tmp_path):
     assert data["metric"] == "reconcile_convergence_throughput"
     assert data["value"] == 1000.0
     assert data["vs_baseline"] == 1.0
+    assert data["batch_efficiency"] == {"10": [2.0, 0.4, 5.0]}
     live = {"fwd_us": 1.0, "evidence": "measured-this-run"}
     assert data["tpu_flash"] == live
     assert data["tpu_flash_long"] == live
@@ -631,6 +682,15 @@ def test_stdout_line_fits_driver_tail(monkeypatch, capsys, tmp_path):
         bench, "bench_reconcile_best",
         lambda **kw: {"services": 200, "elapsed_s": 0.087,
                       "throughput": 2297.37})
+    monkeypatch.setattr(
+        bench, "bench_batch_efficiency",
+        lambda **kw: {"workers": 4, "legs": [
+            {"services": n, "reduction": 7.55,
+             "uncoalesced": {"mutation_calls_per_service": 2.0,
+                             "fold_ratio": 1.0, "throughput": 652.6},
+             "coalesced": {"mutation_calls_per_service": 0.265,
+                           "fold_ratio": 7.55, "throughput": 602.1}}
+            for n in (200, 1000)]})
     monkeypatch.setattr(
         bench, "tpu_probe",
         lambda *a, **k: ("dead", "tpu probe skipped: backend "

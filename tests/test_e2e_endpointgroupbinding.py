@@ -284,3 +284,55 @@ def test_binding_via_ingress_ref(cluster):
     wait_until(lambda: lb.load_balancer_arn in eg_endpoints(cluster, eg),
                message="ingress-ref endpoint added")
     assert eg_endpoints(cluster, eg)[lb.load_balancer_arn].weight == 40
+
+
+def test_status_update_retries_resourceversion_conflict():
+    """The delete-vs-update race the write coalescer's flush linger
+    widened: a deletion timestamp landing between a sync's informer
+    read and its status write must NOT lose the endpoint record —
+    status.endpointIds is the delete path's only drain list, so a
+    dropped write orphans live endpoints.  The controller retries the
+    status write against the fresh object."""
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
+        FakeCloudFactory,
+    )
+    from aws_global_accelerator_controller_tpu.controller.endpointgroupbinding import (
+        EndpointGroupBindingConfig,
+        EndpointGroupBindingController,
+    )
+    from aws_global_accelerator_controller_tpu.kube.apiserver import (
+        FakeAPIServer,
+    )
+    from aws_global_accelerator_controller_tpu.kube.client import (
+        KubeClient,
+        OperatorClient,
+    )
+    from aws_global_accelerator_controller_tpu.kube.informers import (
+        SharedInformerFactory,
+    )
+
+    api = FakeAPIServer()
+    operator = OperatorClient(api)
+    controller = EndpointGroupBindingController(
+        KubeClient(api), operator, SharedInformerFactory(api),
+        FakeCloudFactory(), EndpointGroupBindingConfig())
+
+    operator.endpoint_group_bindings.create(EndpointGroupBinding(
+        metadata=ObjectMeta(name="binding", namespace="default",
+                            finalizers=[FINALIZER]),
+        spec=EndpointGroupBindingSpec(endpoint_group_arn="arn:eg")))
+    stale = operator.endpoint_group_bindings.get(
+        "default", "binding").deep_copy()
+    # a concurrent writer moves the resourceVersion out from under the
+    # in-flight sync — the deletion-timestamp shape of the race
+    operator.endpoint_group_bindings.delete("default", "binding")
+    live = operator.endpoint_group_bindings.get("default", "binding")
+    assert live.metadata.deletion_timestamp is not None
+    assert live.metadata.resource_version != stale.metadata.resource_version
+
+    controller._update_status(stale, ["arn:lb/x"])
+
+    after = operator.endpoint_group_bindings.get("default", "binding")
+    assert after.status.endpoint_ids == ["arn:lb/x"], \
+        "the drain record must survive the conflict"
+    assert after.metadata.deletion_timestamp is not None

@@ -292,6 +292,28 @@ def test_l105_wrapped_calls_clean():
     assert _cfindings("l105_clean.py") == []
 
 
+def test_l106_direct_mutation_fires_and_waiver_suppresses():
+    """Mutations on the write-coalescing surface fire even through
+    ``apis`` (where L105 is silent); the ``# race:`` waiver suppresses
+    line 17's deliberate direct replace."""
+    assert _cfindings("l106_direct_mutation.py") == [
+        ("L106", 12), ("L106", 14), ("L106", 16)]
+
+
+def test_l106_coalescer_submits_clean():
+    assert _cfindings("l106_clean.py") == []
+
+
+def test_l106_batcher_module_exempt():
+    """The coalescer itself is the one legitimate issuer of the
+    batched mutation calls — the shipped batcher.py must stay clean
+    under its own rule."""
+    batcher_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/cloudprovider/aws/"
+        "batcher.py")
+    assert concurrency_lint.lint_files([batcher_py]) == []
+
+
 def test_l105_out_of_scope_paths_exempt(tmp_path):
     """Tests and tools observe the fake cloud directly by design —
     the rule only polices the shipped package (and its fixtures)."""
