@@ -53,6 +53,19 @@ zero-findings gate philosophy):
                          demux.  Package-scoped like L105;
                          ``batcher.py`` itself (the one legitimate
                          flush issuer) is exempt.
+  L107 provider-free fast path
+                         Code on the fingerprint fast path — the
+                         ``reconcile`` package's dispatch/skip branch
+                         and every fingerprint builder (any function
+                         whose name contains ``fingerprint``) — must
+                         not reach the provider: no call through
+                         ``apis`` and no AWS service method at all.
+                         The steady-state contract is that a
+                         fingerprint answer costs ZERO provider calls
+                         (reconcile/fingerprint.py); a builder that
+                         consults AWS would silently turn the skip
+                         path back into the O(N)-per-resync cost it
+                         exists to remove.  Package-scoped like L105.
 
 Waivers: ``# race: <reason>`` on the flagged line (the explicit,
 greppable spelling — use for contracts that are upheld non-lexically),
@@ -134,6 +147,20 @@ def _l105_in_scope(path: Path) -> bool:
     parts = path.parts
     return ("aws_global_accelerator_controller_tpu" in parts
             or "lint_fixtures" in parts)
+
+
+def _l107_fastpath(path: Path, fn_name: str) -> bool:
+    """Is this function on the fingerprint fast path (rule L107)?
+    The reconcile package's own modules (the dispatch + the
+    fingerprint cache) and every fingerprint builder — by the naming
+    convention the controllers follow: the builder's name contains
+    ``fingerprint``."""
+    if "fingerprint" in fn_name:
+        return True
+    parts = path.parts
+    return ("reconcile" in parts
+            and ("aws_global_accelerator_controller_tpu" in parts
+                 or "lint_fixtures" in parts))
 
 
 class Finding:
@@ -445,6 +472,22 @@ class Engine:
                 f"cloudprovider/aws/batcher.py): submit an intent via "
                 f"the provider's coalescer, or waive with "
                 f"'# race: <reason>' for a deliberate direct call"))
+        # L107: the fingerprint fast path must stay provider-free —
+        # no reach through ``apis`` and no AWS service method at all
+        # (the skip's whole contract is zero provider calls).
+        if (_l105_in_scope(info.path)
+                and _l107_fastpath(info.path, fn.name)
+                and ("apis" in chain[:-1]
+                     or (len(chain) >= 2
+                         and chain[-1] in _AWS_API_METHODS
+                         and chain[-2] in _AWS_SERVICES))):
+            self.findings.append(Finding(
+                info.path, line, "L107",
+                f"provider call '{'.'.join(chain)}()' on the "
+                f"fingerprint fast path (reconcile/fingerprint.py "
+                f"contract: a skip costs ZERO provider calls) — move "
+                f"the read into the sync/sweep path, or waive with "
+                f"'# race: <reason>' if this is deliberate"))
         # L102: blocking while any lock is held.
         if held and self._is_blocking(chain, held):
             self.findings.append(Finding(

@@ -68,6 +68,7 @@ from ...metrics import (
     record_mutation_flush,
     record_mutation_fold,
 )
+from ...reconcile.fingerprint import note_provider_mutation
 from .types import EndpointDescription
 
 logger = logging.getLogger(__name__)
@@ -306,6 +307,10 @@ class MutationCoalescer:
         futures = self._submit(KIND_RECORD_SET, hosted_zone_id,
                                list(changes))
         self._await(futures)
+        # only COMMITTED changes can be drift repairs — counted here,
+        # after the await, on the submitter's own (sweep-marked)
+        # thread; a rejected or parked cohort raised above
+        note_provider_mutation(len(futures))
 
     def update_endpoints(self, endpoint_group_arn: str, ops) -> List:
         """Submit :class:`EndpointOp` intents for one endpoint group;
@@ -313,7 +318,9 @@ class MutationCoalescer:
         once the merged update committed."""
         futures = self._submit(KIND_ENDPOINT_GROUP, endpoint_group_arn,
                                list(ops))
-        return self._await(futures)
+        results = self._await(futures)
+        note_provider_mutation(len(futures))
+        return results
 
     # ------------------------------------------------------------------
 

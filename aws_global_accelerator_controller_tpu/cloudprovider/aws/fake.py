@@ -124,6 +124,9 @@ class FaultInjector:
         # zone id -> (tokens, last refill timestamp)
         self._zone_rate: Optional[Tuple[float, float]] = None
         self._zone_buckets: Dict[str, Tuple[float, float]] = {}
+        # the GA fake registers itself here so chaos scenarios can
+        # edit accelerator-side state OUT OF BAND (edit_endpoint_group)
+        self._ga: Optional["FakeGlobalAccelerator"] = None
 
     # -- original one-shot API (unchanged surface) ----------------------
 
@@ -207,6 +210,24 @@ class FaultInjector:
                 self._zone_rate = (
                     rate_per_s,
                     burst if burst is not None else max(1.0, rate_per_s))
+
+    # -- out-of-band state edits ---------------------------------------
+
+    def edit_endpoint_group(self, endpoint_group_arn: str,
+                            endpoint_id: str,
+                            weight: Optional[int]) -> None:
+        """Chaos: mutate one endpoint's weight DIRECTLY in the fake
+        cloud — no API call is counted, no watch event fires, no
+        cache or fingerprint is invalidated.  Models an operator (or a
+        second controller) editing the endpoint group behind this
+        controller's back: exactly the drift the fingerprint layer's
+        tiered sweep exists to detect and repair
+        (reconcile/fingerprint.py)."""
+        if self._ga is None:
+            raise RuntimeError("no FakeGlobalAccelerator attached to "
+                               "this injector")
+        self._ga.edit_endpoint_out_of_band(endpoint_group_arn,
+                                           endpoint_id, weight)
 
     # -- observability --------------------------------------------------
 
@@ -310,6 +331,7 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
                  faults: Optional[FaultInjector] = None):
         self.settle_seconds = settle_seconds
         self.faults = faults or FaultInjector()
+        self.faults._ga = self   # out-of-band edit hook (chaos)
         self._lock = threading.RLock()
         self._seq = itertools.count(1)
         self._accelerators: Dict[str, _AccelState] = {}
@@ -567,6 +589,24 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
                 d for d in eg.endpoint_descriptions
                 if d.endpoint_id not in set(endpoint_ids)]
 
+    def edit_endpoint_out_of_band(self, endpoint_group_arn: str,
+                                  endpoint_id: str,
+                                  weight: Optional[int]) -> None:
+        """Direct state edit for chaos scenarios (no fault check, no
+        call counting — the point is that NOTHING observes it happen);
+        reach it via ``FaultInjector.edit_endpoint_group``."""
+        with self._lock:
+            entry = self._endpoint_groups.get(endpoint_group_arn)
+            if entry is None:
+                raise EndpointGroupNotFoundError()
+            for d in entry[1].endpoint_descriptions:
+                if d.endpoint_id == endpoint_id:
+                    d.weight = weight
+                    return
+            raise AWSAPIError(
+                "EndpointNotFound",
+                f"endpoint {endpoint_id} not in {endpoint_group_arn}")
+
     def delete_endpoint_group(self, arn: str) -> None:
         self.faults.check("delete_endpoint_group")
         with self._lock:
@@ -694,6 +734,15 @@ class FakeRoute53(Route53API):
         entry points)."""
         rs = record_set.copy()
         rs.name = _normalize_record_name(rs.name)
+        if rs.alias_target is not None \
+                and not rs.alias_target.dns_name.endswith("."):
+            # the real API stores/returns alias DNSNames dot-suffixed
+            # like record names — the reference's drift check compares
+            # against ``accelerator.dns_name + "."`` (route53.go:
+            # 373-381), so a fake that kept the bare name made every
+            # steady-state re-sync see perpetual alias drift and
+            # re-UPSERT a converged record forever
+            rs.alias_target.dns_name += "."
         existing = [r for r in records
                     if r.name == rs.name and r.type == rs.type]
         if action == "CREATE":

@@ -100,6 +100,19 @@ def build_parser() -> argparse.ArgumentParser:
                                  "the running controller (a failed "
                                  "reload keeps the current weights). "
                                  "0 disables (default).")
+    controller.add_argument("--no-fingerprints", action="store_true",
+                            help="Disable the steady-state fingerprint "
+                                 "fast path: every informer resync "
+                                 "re-delivery takes a full provider-"
+                                 "verifying sync (the pre-gate "
+                                 "behavior; A/B escape hatch).")
+    controller.add_argument("--drift-sweep-every", type=int, default=10,
+                            metavar="WAVES",
+                            help="Deep-verify each object against AWS "
+                                 "once per this many resync periods "
+                                 "(the tiered drift sweep that "
+                                 "catches out-of-band mutation; "
+                                 "default 10). 0 disables the sweep.")
     controller.add_argument("--seed", action="append", default=[],
                             metavar="FILE",
                             help="Apply YAML manifests into the fake API "
@@ -207,15 +220,22 @@ def run_controller(args) -> int:
         cloud_factory = (FakeCloudFactory() if args.fake_cloud
                          else BotoCloudFactory())
 
+    from ..reconcile.fingerprint import FingerprintConfig
+    fingerprints = FingerprintConfig(
+        enabled=not getattr(args, "no_fingerprints", False),
+        sweep_every=max(0, getattr(args, "drift_sweep_every", 10)))
     config = ControllerConfig(
         global_accelerator=GlobalAcceleratorConfig(
-            workers=args.workers, cluster_name=args.cluster_name),
+            workers=args.workers, cluster_name=args.cluster_name,
+            fingerprints=fingerprints),
         route53=Route53Config(
-            workers=args.workers, cluster_name=args.cluster_name),
+            workers=args.workers, cluster_name=args.cluster_name,
+            fingerprints=fingerprints),
         endpoint_group_binding=EndpointGroupBindingConfig(
             workers=args.workers,
             weight_policy=getattr(args, "weight_policy", "static"),
-            weight_policy_instance=policy_instance),
+            weight_policy_instance=policy_instance,
+            fingerprints=fingerprints),
     )
 
     namespace = os.environ.get("POD_NAMESPACE", "default")

@@ -71,18 +71,47 @@ def annotation_presence_changed(old: KubeObject, new: KubeObject,
     return (annotation in old.annotations) != (annotation in new.annotations)
 
 
+def resync_enqueue(fingerprints, queue, obj, wave: int) -> None:
+    """The enqueue-time half of the steady-state fast path, shared by
+    every controller's tagged resync handler.
+
+    An unchanged object (recorded fingerprint matches, not due for a
+    sweep) is answered HERE — one counter bump, zero queue churn: the
+    truly-idle fleet costs nothing at rest, not even workqueue ops.
+    Everything else (changed objects, keys whose record was dropped by
+    an error, sweep-due keys) takes ``add_rate_limited``, so a key
+    failing its backstop syncs keeps the per-key exponential backoff
+    and a parked key is never converted into an immediate retry by the
+    next resync wave (the plain-``add`` shortcut would bypass exactly
+    the hot-retry protection the resilience layer's park provides)."""
+    from .. import metrics
+    from ..reconcile.fingerprint import ORIGIN_RESYNC
+
+    key = obj.key()
+    origin = fingerprints.note_resync(key, wave)
+    if origin == ORIGIN_RESYNC and fingerprints.matches(key, obj):
+        fingerprints.claim_origin(key)
+        metrics.record_fastpath_skip(fingerprints.controller)
+        return
+    queue.add_rate_limited(key)
+
+
 def spawn_workers(name: str, count: int, stop: threading.Event,
                   queue: RateLimitingQueue, key_to_obj, process_delete,
-                  process_create_or_update) -> List[threading.Thread]:
+                  process_create_or_update,
+                  fingerprints=None) -> List[threading.Thread]:
     """Start ``count`` reconcile worker threads over one queue
     (the wait.Until(runWorker, 1s) analogue,
-    reference globalaccelerator/controller.go:208-213)."""
+    reference globalaccelerator/controller.go:208-213).
+    ``fingerprints`` (reconcile/fingerprint.py FingerprintCache) arms
+    the steady-state fast path for this queue's dispatch."""
 
     def loop():
         while not stop.is_set():
             if not process_next_work_item(
                     queue, key_to_obj, process_delete,
-                    process_create_or_update, get_timeout=WORKER_POLL):
+                    process_create_or_update, get_timeout=WORKER_POLL,
+                    fingerprints=fingerprints):
                 return
 
     threads = []

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from ..apis.endpointgroupbinding.v1alpha1 import EndpointGroupBinding
 from ..cloudprovider.aws import get_lb_name_from_hostname, get_region_from_arn
@@ -39,7 +39,14 @@ from ..kube.workqueue import (
     new_rate_limiting_queue,
 )
 from ..reconcile import Result
-from .base import WORKER_POLL
+from ..reconcile.fingerprint import (
+    ORIGIN_RESYNC,
+    ORIGIN_SWEEP,
+    FingerprintCache,
+    FingerprintConfig,
+    in_sweep,
+)
+from .base import WORKER_POLL, resync_enqueue
 
 logger = logging.getLogger(__name__)
 
@@ -93,6 +100,9 @@ class EndpointGroupBindingConfig:
     # CLI loads the checkpoint eagerly (fail-fast before election) and
     # hands the instance through here
     weight_policy_instance: object = None
+    # steady-state fast path (reconcile/fingerprint.py)
+    fingerprints: FingerprintConfig = field(
+        default_factory=FingerprintConfig)
 
 
 class EndpointGroupBindingController:
@@ -118,12 +128,19 @@ class EndpointGroupBindingController:
             name="EndpointGroupBinding",
             qps=config.queue_qps, burst=config.queue_burst)
 
+        # steady-state fast path: the binding fingerprint covers the
+        # binding's spec/status/meta AND the referent's LB hostnames
+        # (everything _reconcile_update reads from informer state)
+        self.fingerprints = FingerprintCache(
+            "EndpointGroupBinding", self._binding_fingerprint,
+            config.fingerprints)
+
         self.service_informer = informer_factory.services()
         self.ingress_informer = informer_factory.ingresses()
         self.binding_informer = informer_factory.endpoint_group_bindings()
         self.binding_informer.add_event_handler(
             add=self._enqueue, update=self._update_notification,
-            delete=None)
+            delete=None, resync=self._resync_binding)
         self.binding_informer.add_index(BINDING_ARN_INDEX,
                                         index_binding_by_arn)
         self.binding_informer.add_index(BINDING_SERVICE_REF_INDEX,
@@ -145,6 +162,7 @@ class EndpointGroupBindingController:
     # -- event handlers (controller.go:85-98) ---------------------------
 
     def _enqueue(self, obj) -> None:
+        self.fingerprints.note_event(obj.key())
         self.queue.add_rate_limited(obj.key())
 
     def _update_notification(self, old, new) -> None:
@@ -155,9 +173,58 @@ class EndpointGroupBindingController:
             return
         self._enqueue(new)
 
+    def _resync_binding(self, obj, wave: int) -> None:
+        """Tagged resync backstop — previously every binding re-ran a
+        full provider-verifying sync per period through
+        _update_notification; now unchanged bindings are answered at
+        enqueue time and only changed/failing/sweep-due keys reach
+        the queue (base.resync_enqueue), the sweep wave deep-verifying
+        against the live endpoint group."""
+        resync_enqueue(self.fingerprints, self.queue, obj, wave)
+
+    def _binding_fingerprint(self, obj) -> tuple:
+        """Exactly what the sync reads from informer state: binding
+        meta (finalizer state machine), spec, status, the weight
+        policy in force, and the referent Service/Ingress LB hostnames
+        resolved through the listers.  Pure over cache state — never
+        ``apis.*`` (lint rule L107); AWS-side drift is the sweep
+        tier's job."""
+        referent: tuple = ("none",)
+        try:
+            if obj.spec.service_ref is not None \
+                    and obj.spec.service_ref.name:
+                svc = self.service_informer.lister.get(
+                    obj.metadata.namespace, obj.spec.service_ref.name)
+                referent = ("service", obj.spec.service_ref.name,
+                            tuple(i.hostname for i in
+                                  svc.status.load_balancer.ingress))
+            elif obj.spec.ingress_ref is not None \
+                    and obj.spec.ingress_ref.name:
+                ingress = self.ingress_informer.lister.get(
+                    obj.metadata.namespace, obj.spec.ingress_ref.name)
+                referent = ("ingress", obj.spec.ingress_ref.name,
+                            tuple(i.hostname for i in
+                                  ingress.status.load_balancer.ingress))
+        except NotFoundError:
+            referent = ("missing",)
+        return (
+            "egb",
+            obj.metadata.generation,
+            obj.metadata.deletion_timestamp is not None,
+            tuple(obj.metadata.finalizers),
+            obj.spec.endpoint_group_arn,
+            obj.spec.weight,
+            obj.spec.client_ip_preservation,
+            tuple(obj.status.endpoint_ids),
+            obj.status.observed_generation,
+            type(self.weight_policy).__name__,
+            referent,
+        )
+
     def _notify_referent(self, index: str):
         def handler(obj) -> None:
             for binding in self.binding_informer.by_index(index, obj.key()):
+                self.fingerprints.note_event(binding.key())
                 self.queue.add_rate_limited(binding.key())
         return handler
 
@@ -219,6 +286,9 @@ class EndpointGroupBindingController:
                 self._sync_handler(key)
             except Exception:
                 result = "error"
+                # a failed sync's recorded fingerprint no longer
+                # proves a converged state
+                self.fingerprints.invalidate(key)
                 logger.exception("error syncing %r", key)
                 self.queue.add_rate_limited(key)
             finally:
@@ -229,14 +299,37 @@ class EndpointGroupBindingController:
     def _sync_handler(self, key: str) -> None:
         """(controller.go:148-180)"""
         ns, name = split_meta_namespace_key(key)
+        origin = self.fingerprints.claim_origin(key)
         try:
             binding = self.binding_informer.lister.get(ns, name)
         except NotFoundError:
             logger.info("EndpointGroupBinding %s has been deleted", key)
+            self.fingerprints.invalidate(key)
             self.queue.forget(key)
             return
 
-        res = self.reconcile(binding.deep_copy())
+        # steady-state fast path: a resync-originated key whose
+        # binding (and referent hostnames) still match the recorded
+        # fingerprint needs no provider verification (L107: no apis.*
+        # on this branch)
+        if origin == ORIGIN_RESYNC \
+                and self.fingerprints.matches(key, binding):
+            from .. import metrics
+            metrics.record_fastpath_skip(self.queue.name)
+            self.queue.forget(key)
+            return
+
+        if origin == ORIGIN_SWEEP \
+                and self.fingerprints.matches(key, binding):
+            # deep verify (only meaningful over a provably unchanged
+            # binding): reconcile() consults in_sweep() to bypass its
+            # no-change short-circuit, so out-of-band endpoint-group
+            # drift is re-read and repaired on this tier — and any
+            # mutation submitted is honestly a drift repair
+            with self.fingerprints.sweep_verify():
+                res = self.reconcile(binding.deep_copy())
+        else:
+            res = self.reconcile(binding.deep_copy())
         if res.requeue_after > 0:
             self.queue.forget(key)
             self.queue.add_after(key, res.requeue_after)
@@ -244,6 +337,7 @@ class EndpointGroupBindingController:
             self.queue.add_rate_limited(key)
         else:
             self.queue.forget(key)
+            self.fingerprints.record(key, binding)
 
     # -- reconcile (reconcile.go:20-34) ---------------------------------
 
@@ -359,7 +453,12 @@ class EndpointGroupBindingController:
         new_ids = [arn for arn in arns if arn not in obj.status.endpoint_ids]
         removed_ids = [i for i in obj.status.endpoint_ids if i not in arns]
         if (not new_ids and not removed_ids
-                and obj.status.observed_generation == obj.metadata.generation):
+                and obj.status.observed_generation == obj.metadata.generation
+                and not in_sweep()):
+            # no-change short-circuit — EXCEPT on the drift sweep's
+            # deep-verify tier, which exists precisely to re-read the
+            # live endpoint group and repair out-of-band mutation this
+            # early return would otherwise hide forever
             return Result()
 
         endpoint_group = provider.describe_endpoint_group(
@@ -387,13 +486,19 @@ class EndpointGroupBindingController:
         # allocate per-endpoint weights for spec.weight: null bindings)
         # applied as ONE merged re-weight: every endpoint's intent
         # rides a single coalesced read-modify-write instead of one
-        # full describe+update cycle per endpoint
+        # full describe+update cycle per endpoint.  Skipped entirely
+        # when the described group already carries the planned weights
+        # — which is what makes a drift-sweep pass over a converged
+        # group read-only, and drift_repairs_total an honest count.
         planned = self.weight_policy.plan(obj, endpoint_group,
                                           list(arns))
-        provider.update_endpoint_weights(
-            endpoint_group,
-            {endpoint_id: planned.get(endpoint_id, obj.spec.weight)
-             for endpoint_id in arns})
+        desired = {endpoint_id: planned.get(endpoint_id, obj.spec.weight)
+                   for endpoint_id in arns}
+        current = {d.endpoint_id: d.weight
+                   for d in endpoint_group.endpoint_descriptions}
+        if any(current.get(endpoint_id, "absent") != weight
+               for endpoint_id, weight in desired.items()):
+            provider.update_endpoint_weights(endpoint_group, desired)
         if arns:
             # recorded only once every update succeeded — a provider
             # failure mid-loop must not count as an applied plan; the
@@ -406,7 +511,14 @@ class EndpointGroupBindingController:
                 type(self.weight_policy).__name__,
                 plan_source(self.weight_policy, obj.spec.weight))
 
-        self._update_status(obj, results)
+        if (results != list(obj.status.endpoint_ids)
+                or obj.status.observed_generation
+                != obj.metadata.generation):
+            # unchanged status is not rewritten: a drift-sweep pass
+            # over a converged group must be read-only on the
+            # Kubernetes side too (a no-op status write would echo a
+            # watch event back at the queue every sweep)
+            self._update_status(obj, results)
         return Result()
 
     def _get_load_balancer_hostnames(self, obj: EndpointGroupBinding):

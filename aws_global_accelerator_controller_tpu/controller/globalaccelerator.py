@@ -20,12 +20,24 @@ from __future__ import annotations
 
 import logging
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from .. import cloudprovider
-from ..apis import AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION
+from ..apis import (
+    AWS_GLOBAL_ACCELERATOR_IP_ADDRESS_TYPE_ANNOTATION,
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    CLIENT_IP_PRESERVATION_ANNOTATION,
+    INGRESS_CLASS_ANNOTATION,
+)
 from ..cloudprovider.aws import get_lb_name_from_hostname
 from ..cloudprovider.aws.factory import CloudFactory
+from ..cloudprovider.aws.helpers import (
+    accelerator_tags_from_annotations,
+    listener_for_ingress,
+    listener_for_service,
+)
 from ..errors import new_no_retry_errorf
 from ..kube.client import KubeClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
@@ -34,10 +46,12 @@ from ..kube.workqueue import (
     new_rate_limiting_queue,
 )
 from ..reconcile import Result
+from ..reconcile.fingerprint import FingerprintCache, FingerprintConfig
 from .base import (
     LB_DNS_INDEX,
     annotation_presence_changed,
     index_by_lb_dns,
+    resync_enqueue,
     run_controller,
     spawn_workers,
     was_alb_ingress,
@@ -49,12 +63,57 @@ logger = logging.getLogger(__name__)
 CONTROLLER_AGENT_NAME = "global-accelerator-controller"
 
 
+def ga_service_fingerprint(svc) -> tuple:
+    """Exactly the Service fields the GA sync reads (filter predicate,
+    LB hostnames, accelerator name/tags/ip-type/ip-preservation
+    annotations, listener spec) — a pure function over informer state;
+    never calls ``apis.*`` (lint rule L107)."""
+    ports, protocol = listener_for_service(svc)
+    return (
+        "ga", "Service", svc.spec.type, svc.spec.load_balancer_class,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION in svc.annotations,
+        svc.annotations.get(AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION),
+        svc.annotations.get(AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION),
+        svc.annotations.get(
+            AWS_GLOBAL_ACCELERATOR_IP_ADDRESS_TYPE_ANNOTATION),
+        svc.annotations.get(CLIENT_IP_PRESERVATION_ANNOTATION),
+        tuple(sorted(accelerator_tags_from_annotations(svc).items())),
+        tuple(i.hostname for i in svc.status.load_balancer.ingress),
+        (tuple(ports), protocol),
+    )
+
+
+def ga_ingress_fingerprint(ingress) -> tuple:
+    """The Ingress-side twin of :func:`ga_service_fingerprint`
+    (ALB-class predicate + listen-ports/backends instead of
+    spec.ports) — pure over informer state, no ``apis.*`` (L107)."""
+    ports, protocol = listener_for_ingress(ingress)
+    return (
+        "ga", "Ingress", ingress.spec.ingress_class_name,
+        INGRESS_CLASS_ANNOTATION in ingress.annotations,
+        ingress.annotations.get(
+            AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION),
+        ingress.annotations.get(AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION),
+        ingress.annotations.get(
+            AWS_GLOBAL_ACCELERATOR_IP_ADDRESS_TYPE_ANNOTATION),
+        ingress.annotations.get(CLIENT_IP_PRESERVATION_ANNOTATION),
+        tuple(sorted(
+            accelerator_tags_from_annotations(ingress).items())),
+        tuple(i.hostname for i in ingress.status.load_balancer.ingress),
+        (tuple(ports), protocol),
+    )
+
+
 @dataclass
 class GlobalAcceleratorConfig:
     workers: int = 1
     cluster_name: str = "default"
     queue_qps: float = 10.0    # client-go default bucket
     queue_burst: int = 100
+    # steady-state fast path (reconcile/fingerprint.py): resync
+    # re-deliveries of unchanged objects skip before any provider call
+    fingerprints: FingerprintConfig = field(
+        default_factory=FingerprintConfig)
 
 
 class GlobalAcceleratorController:
@@ -75,21 +134,31 @@ class GlobalAcceleratorController:
             name=f"{CONTROLLER_AGENT_NAME}-ingress",
             qps=config.queue_qps, burst=config.queue_burst)
 
+        # steady-state fast path: one fingerprint gate per queue
+        # (reconcile/fingerprint.py; see _resync_service below)
+        self.service_fingerprints = FingerprintCache(
+            f"{CONTROLLER_AGENT_NAME}-service", ga_service_fingerprint,
+            config.fingerprints)
+        self.ingress_fingerprints = FingerprintCache(
+            f"{CONTROLLER_AGENT_NAME}-ingress", ga_ingress_fingerprint,
+            config.fingerprints)
+
         self.service_informer = informer_factory.services()
         self.service_informer.add_event_handler(
             add=self._add_service, update=self._update_service,
-            delete=self._delete_service)
+            delete=self._delete_service, resync=self._resync_service)
         self.service_informer.add_index(LB_DNS_INDEX, index_by_lb_dns)
         self.ingress_informer = informer_factory.ingresses()
         self.ingress_informer.add_event_handler(
             add=self._add_ingress, update=self._update_ingress,
-            delete=self._delete_ingress)
+            delete=self._delete_ingress, resync=self._resync_ingress)
         self.ingress_informer.add_index(LB_DNS_INDEX, index_by_lb_dns)
 
     # -- event handlers (controller.go:96-193) -------------------------
 
     def _add_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc) and self._has_managed(svc):
+            self.service_fingerprints.note_event(svc.key())
             self.service_queue.add_rate_limited(svc.key())
 
     def _update_service(self, old: Service, new: Service) -> None:
@@ -98,14 +167,28 @@ class GlobalAcceleratorController:
         if was_load_balancer_service(new):
             if self._has_managed(new) or annotation_presence_changed(
                     old, new, AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION):
+                self.service_fingerprints.note_event(new.key())
                 self.service_queue.add_rate_limited(new.key())
 
     def _delete_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc):
+            self.service_fingerprints.note_event(svc.key())
             self.service_queue.add_rate_limited(svc.key())
+
+    def _resync_service(self, svc: Service, wave: int) -> None:
+        """Tagged resync re-delivery: the level-trigger backstop now
+        reaches the GA queue for every managed Service (previously the
+        ``old == new`` update check dropped resyncs entirely), gated
+        at enqueue time — unchanged objects cost one counter bump,
+        changed/failing/sweep-due keys ride the rate-limited path
+        (base.resync_enqueue)."""
+        if was_load_balancer_service(svc) and self._has_managed(svc):
+            resync_enqueue(self.service_fingerprints,
+                           self.service_queue, svc, wave)
 
     def _add_ingress(self, ingress: Ingress) -> None:
         if was_alb_ingress(ingress) and self._has_managed(ingress):
+            self.ingress_fingerprints.note_event(ingress.key())
             self.ingress_queue.add_rate_limited(ingress.key())
 
     def _update_ingress(self, old: Ingress, new: Ingress) -> None:
@@ -114,11 +197,18 @@ class GlobalAcceleratorController:
         if was_alb_ingress(new):
             if self._has_managed(new) or annotation_presence_changed(
                     old, new, AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION):
+                self.ingress_fingerprints.note_event(new.key())
                 self.ingress_queue.add_rate_limited(new.key())
 
     def _delete_ingress(self, ingress: Ingress) -> None:
         # reference enqueues ingress deletes unconditionally (controller.go:185)
+        self.ingress_fingerprints.note_event(ingress.key())
         self.ingress_queue.add_rate_limited(ingress.key())
+
+    def _resync_ingress(self, ingress: Ingress, wave: int) -> None:
+        if was_alb_ingress(ingress) and self._has_managed(ingress):
+            resync_enqueue(self.ingress_fingerprints,
+                           self.ingress_queue, ingress, wave)
 
     @staticmethod
     def _has_managed(obj) -> bool:
@@ -142,12 +232,14 @@ class GlobalAcceleratorController:
                         f"{CONTROLLER_AGENT_NAME}-service", self.workers,
                         stop, self.service_queue, self._key_to_service,
                         self.process_service_delete,
-                        self.process_service_create_or_update)
+                        self.process_service_create_or_update,
+                        fingerprints=self.service_fingerprints)
                     + spawn_workers(
                         f"{CONTROLLER_AGENT_NAME}-ingress", self.workers,
                         stop, self.ingress_queue, self._key_to_ingress,
                         self.process_ingress_delete,
-                        self.process_ingress_create_or_update))
+                        self.process_ingress_create_or_update,
+                        fingerprints=self.ingress_fingerprints))
 
         run_controller(CONTROLLER_AGENT_NAME, stop,
                        [self.service_queue, self.ingress_queue], workers)

@@ -12,10 +12,13 @@ from __future__ import annotations
 
 import logging
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from .. import cloudprovider
-from ..apis import ROUTE53_HOSTNAME_ANNOTATION
+from ..apis import (
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    ROUTE53_HOSTNAME_ANNOTATION,
+)
 from ..cloudprovider.aws import get_lb_name_from_hostname
 from ..cloudprovider.aws.factory import CloudFactory
 from ..errors import new_no_retry_errorf
@@ -26,10 +29,12 @@ from ..kube.workqueue import (
     new_rate_limiting_queue,
 )
 from ..reconcile import Result
+from ..reconcile.fingerprint import FingerprintCache, FingerprintConfig
 from .base import (
     ROUTE53_HOSTNAME_INDEX,
     annotation_presence_changed,
     index_by_route53_hostname,
+    resync_enqueue,
     run_controller,
     spawn_workers,
     was_load_balancer_service,
@@ -40,12 +45,39 @@ logger = logging.getLogger(__name__)
 CONTROLLER_AGENT_NAME = "route53-controller"
 
 
+def route53_service_fingerprint(svc) -> tuple:
+    """Exactly the Service fields the Route53 sync reads (filter
+    predicate, hostname annotation, LB hostnames) — pure over informer
+    state, never ``apis.*`` (lint rule L107)."""
+    return (
+        "route53", "Service", svc.spec.type,
+        svc.spec.load_balancer_class,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION in svc.annotations,
+        svc.annotations.get(ROUTE53_HOSTNAME_ANNOTATION),
+        tuple(i.hostname for i in svc.status.load_balancer.ingress),
+    )
+
+
+def route53_ingress_fingerprint(ingress) -> tuple:
+    """The Ingress twin (no LB-service predicate: the route53
+    controller watches ALL annotated ingresses) — pure, no ``apis.*``
+    (L107)."""
+    return (
+        "route53", "Ingress",
+        ingress.annotations.get(ROUTE53_HOSTNAME_ANNOTATION),
+        tuple(i.hostname for i in ingress.status.load_balancer.ingress),
+    )
+
+
 @dataclass
 class Route53Config:
     workers: int = 1
     cluster_name: str = "default"
     queue_qps: float = 10.0    # client-go default bucket
     queue_burst: int = 100
+    # steady-state fast path (reconcile/fingerprint.py)
+    fingerprints: FingerprintConfig = field(
+        default_factory=FingerprintConfig)
 
 
 class Route53Controller:
@@ -66,16 +98,24 @@ class Route53Controller:
             name=f"{CONTROLLER_AGENT_NAME}-ingress",
             qps=config.queue_qps, burst=config.queue_burst)
 
+        # steady-state fast path: one fingerprint gate per queue
+        self.service_fingerprints = FingerprintCache(
+            f"{CONTROLLER_AGENT_NAME}-service",
+            route53_service_fingerprint, config.fingerprints)
+        self.ingress_fingerprints = FingerprintCache(
+            f"{CONTROLLER_AGENT_NAME}-ingress",
+            route53_ingress_fingerprint, config.fingerprints)
+
         self.service_informer = informer_factory.services()
         self.service_informer.add_event_handler(
             add=self._add_service, update=self._update_service,
-            delete=self._delete_service)
+            delete=self._delete_service, resync=self._resync_service)
         self.service_informer.add_index(ROUTE53_HOSTNAME_INDEX,
                                         index_by_route53_hostname)
         self.ingress_informer = informer_factory.ingresses()
         self.ingress_informer.add_event_handler(
             add=self._add_ingress, update=self._update_ingress,
-            delete=self._delete_ingress)
+            delete=self._delete_ingress, resync=self._resync_ingress)
         self.ingress_informer.add_index(ROUTE53_HOSTNAME_INDEX,
                                         index_by_route53_hostname)
 
@@ -87,6 +127,7 @@ class Route53Controller:
 
     def _add_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc) and self._has_hostname(svc):
+            self.service_fingerprints.note_event(svc.key())
             self.service_queue.add_rate_limited(svc.key())
 
     def _update_service(self, old: Service, new: Service) -> None:
@@ -95,16 +136,26 @@ class Route53Controller:
         if was_load_balancer_service(new):
             if self._has_hostname(new) or annotation_presence_changed(
                     old, new, ROUTE53_HOSTNAME_ANNOTATION):
+                self.service_fingerprints.note_event(new.key())
                 self.service_queue.add_rate_limited(new.key())
 
     def _delete_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc):
+            self.service_fingerprints.note_event(svc.key())
             self.service_queue.add_rate_limited(svc.key())
+
+    def _resync_service(self, svc: Service, wave: int) -> None:
+        """Tagged resync backstop for annotated Services — gated at
+        enqueue time (base.resync_enqueue)."""
+        if was_load_balancer_service(svc) and self._has_hostname(svc):
+            resync_enqueue(self.service_fingerprints,
+                           self.service_queue, svc, wave)
 
     def _add_ingress(self, ingress: Ingress) -> None:
         # the route53 controller watches ALL ingresses with the annotation
         # (route53/controller.go:133-137; no ALB filter on add)
         if self._has_hostname(ingress):
+            self.ingress_fingerprints.note_event(ingress.key())
             self.ingress_queue.add_rate_limited(ingress.key())
 
     def _update_ingress(self, old: Ingress, new: Ingress) -> None:
@@ -112,10 +163,17 @@ class Route53Controller:
             return
         if self._has_hostname(new) or annotation_presence_changed(
                 old, new, ROUTE53_HOSTNAME_ANNOTATION):
+            self.ingress_fingerprints.note_event(new.key())
             self.ingress_queue.add_rate_limited(new.key())
 
     def _delete_ingress(self, ingress: Ingress) -> None:
+        self.ingress_fingerprints.note_event(ingress.key())
         self.ingress_queue.add_rate_limited(ingress.key())
+
+    def _resync_ingress(self, ingress: Ingress, wave: int) -> None:
+        if self._has_hostname(ingress):
+            resync_enqueue(self.ingress_fingerprints,
+                           self.ingress_queue, ingress, wave)
 
     # -- run ------------------------------------------------------------
 
@@ -134,12 +192,14 @@ class Route53Controller:
                         f"{CONTROLLER_AGENT_NAME}-service", self.workers,
                         stop, self.service_queue, self._key_to_service,
                         self.process_service_delete,
-                        self.process_service_create_or_update)
+                        self.process_service_create_or_update,
+                        fingerprints=self.service_fingerprints)
                     + spawn_workers(
                         f"{CONTROLLER_AGENT_NAME}-ingress", self.workers,
                         stop, self.ingress_queue, self._key_to_ingress,
                         self.process_ingress_delete,
-                        self.process_ingress_create_or_update))
+                        self.process_ingress_create_or_update,
+                        fingerprints=self.ingress_fingerprints))
 
         run_controller(CONTROLLER_AGENT_NAME, stop,
                        [self.service_queue, self.ingress_queue], workers)

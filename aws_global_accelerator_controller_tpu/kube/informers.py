@@ -7,9 +7,17 @@ e.g. pkg/controller/globalaccelerator/controller.go:69-87).
 
 Each informer runs one thread: initial list populates the cache and fires
 ADDED handlers, then the watch stream is consumed; a resync timer
-re-delivers the cache as update(obj, obj) pairs -- the level-triggered
-backstop the reconcile design relies on (SURVEY.md §5 "failure
-detection").
+re-delivers the cache -- the level-triggered backstop the reconcile
+design relies on (SURVEY.md §5 "failure detection").  Re-deliveries are
+SPREAD across the period with key-stable jitter (``_ResyncSpread``):
+the old behavior re-delivered the whole cache in one burst at the
+timer edge, so a fleet of N objects hit the workqueues (and, without
+the fingerprint gate, the provider) as one thundering wave per period.
+Handlers that register a ``resync`` callback receive resync
+re-deliveries explicitly tagged -- ``resync(obj, wave)`` with the
+monotonically increasing wave number (what the fingerprint layer's
+sweep tiering is keyed on, reconcile/fingerprint.py); handlers without
+one keep the classic ``update(obj, obj)`` shape.
 
 Read contract (client-go's, adopted here for the reconcile hot path):
 objects handed to event handlers and returned by ``Lister.get`` /
@@ -32,12 +40,14 @@ shared by every reader until the next event invalidates it.
 """
 from __future__ import annotations
 
+import heapq
 import logging
 import queue as queue_mod
 import random
 import threading
 import time
-from typing import Callable, Dict, Iterable, List, Optional
+import zlib
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..analysis import freezeproxy, locks
 from ..errors import NotFoundError
@@ -55,6 +65,8 @@ logger = logging.getLogger(__name__)
 AddHandler = Callable[[KubeObject], None]
 UpdateHandler = Callable[[KubeObject, KubeObject], None]
 DeleteHandler = Callable[[KubeObject], None]
+# Explicitly tagged resync re-delivery: (cached obj, wave number).
+ResyncHandler = Callable[[KubeObject, int], None]
 # An index function maps one object to every value it is findable
 # under (cache.IndexFunc analogue; may yield zero values).
 IndexFunc = Callable[[KubeObject], Iterable[str]]
@@ -66,10 +78,105 @@ NAMESPACE_INDEX = "namespace"
 class EventHandlers:
     def __init__(self, add: Optional[AddHandler] = None,
                  update: Optional[UpdateHandler] = None,
-                 delete: Optional[DeleteHandler] = None):
+                 delete: Optional[DeleteHandler] = None,
+                 resync: Optional[ResyncHandler] = None):
         self.add = add
         self.update = update
         self.delete = delete
+        self.resync = resync
+
+
+class _ResyncSpread:
+    """Key-stable spread of resync re-deliveries across the period.
+
+    Each key owns a fixed slot at ``crc32(key)/2^32 * period`` into
+    every period — deterministic per key, so a key's backstop cadence
+    stays exactly one delivery per period while the fleet's deliveries
+    are uniformly spread instead of bursting at the timer edge (the
+    thundering-herd fix; same jitter family as reconcile.py's park
+    decorrelation).
+
+    Incremental on purpose: the schedule is a heap fed by watch
+    events (``add_key``/``remove_key``), so the informer loop pays
+    O(due-this-tick) per iteration, NOT O(fleet) — a per-iteration
+    full-cache scan would put an O(n²) term back into exactly the
+    creation-storm hot path PR 1 linearized.  Pure scheduling:
+    callers pass ``now``, so the fake-clock test drives it without
+    threads."""
+
+    def __init__(self, period: float, start: float,
+                 keys: Iterable[str] = ()):
+        self.period = period
+        self.wave = 0
+        self._start = start
+        self._offsets: Dict[str, float] = {}
+        self._known: Set[str] = set()
+        self._delivered: Set[str] = set()
+        self._heap: List[Tuple[float, str]] = []
+        for key in keys:
+            self.add_key(key)
+
+    def offset(self, key: str) -> float:
+        off = self._offsets.get(key)
+        if off is None:
+            off = (zlib.crc32(key.encode()) / 2**32) * self.period
+            self._offsets[key] = off
+        return off
+
+    def add_key(self, key: str) -> None:
+        """Schedule a (possibly new) key.  A key whose slot for the
+        current period already passed is delivered on the next tick —
+        a freshly added object just got its real ADD event, so the
+        early backstop touch is at worst a fingerprint skip."""
+        if key in self._known:
+            return
+        self._known.add(key)
+        heapq.heappush(self._heap, (self._start + self.offset(key), key))
+
+    def remove_key(self, key: str) -> None:
+        """Lazy removal: the heap entry stays until popped; delivery
+        checks membership."""
+        self._known.discard(key)
+        self._offsets.pop(key, None)
+        self._delivered.discard(key)
+
+    def due(self, now: float) -> Tuple[List[str], int]:
+        """Keys whose slot has been crossed and that were not yet
+        delivered this period, with the wave number those deliveries
+        belong to.  Crossing the period boundary rolls the wave,
+        clears the delivered set and rebuilds the schedule — every
+        key is delivered exactly once per period regardless of tick
+        granularity."""
+        out = []
+        while self._heap and self._heap[0][0] <= now:
+            _, key = heapq.heappop(self._heap)
+            if key in self._known and key not in self._delivered:
+                self._delivered.add(key)
+                out.append(key)
+        wave = self.wave
+        if now >= self._start + self.period:
+            self._start += self.period
+            self.wave += 1
+            # fell behind by whole periods (a stalled loop): jump to
+            # the current one rather than replaying empty waves
+            while now >= self._start + self.period:
+                self._start += self.period
+                self.wave += 1
+            self._delivered.clear()
+            self._heap = [(self._start + self.offset(k), k)
+                          for k in self._known]
+            heapq.heapify(self._heap)
+        return out, wave
+
+    def next_due(self, now: float) -> float:
+        """Earliest upcoming slot (or the period boundary) — what
+        bounds the informer loop's poll timeout so sub-second resync
+        periods keep their cadence."""
+        while self._heap and self._heap[0][1] not in self._known:
+            heapq.heappop(self._heap)     # lazily purge removed keys
+        if self._heap:
+            return min(self._heap[0][0], self._start + self.period)
+        return self._start + self.period
 
 
 class Lister:
@@ -118,8 +225,12 @@ class Informer:
 
     # -- registration ---------------------------------------------------
 
-    def add_event_handler(self, add=None, update=None, delete=None) -> None:
-        self._handlers.append(EventHandlers(add, update, delete))
+    def add_event_handler(self, add=None, update=None, delete=None,
+                          resync=None) -> None:
+        """``resync`` receives tagged resync re-deliveries as
+        ``resync(obj, wave)``; without one the handler gets the classic
+        ``update(obj, obj)`` pair (same-identity arguments)."""
+        self._handlers.append(EventHandlers(add, update, delete, resync))
 
     def add_index(self, name: str, fn: IndexFunc) -> None:
         """Register (or re-register) an index function.
@@ -250,18 +361,26 @@ class Informer:
                     self._dispatch(h.add, obj)
             self._synced.set()
 
-            next_resync = time.monotonic() + self._resync_period
+            spread = _ResyncSpread(self._resync_period, time.monotonic(),
+                                   keys=[obj.key() for obj in listed])
             while not stop.is_set():
-                timeout = min(0.2, max(0.0, next_resync - time.monotonic()))
+                now = time.monotonic()
+                timeout = min(0.2, max(0.0, spread.next_due(now) - now))
                 try:
                     event = self._watch_q.get(timeout=timeout)
                 except queue_mod.Empty:
                     event = None
                 if event is not None:
+                    key = event.obj.key()
                     self._handle_event(event)
-                if time.monotonic() >= next_resync:
-                    self._resync()
-                    next_resync = time.monotonic() + self._resync_period
+                    # keep the spread's schedule in step with the
+                    # cache (O(log n) here, O(1) per idle tick — never
+                    # a full-cache scan on the event hot path)
+                    if event.type == WATCH_DELETED:
+                        spread.remove_key(key)
+                    else:
+                        spread.add_key(key)
+                self._resync_due(spread)
         finally:
             self._store.stop_watch(self._watch_q)
 
@@ -284,11 +403,21 @@ class Informer:
             for h in self._handlers:
                 self._dispatch(h.delete, tombstone)
 
-    def _resync(self) -> None:
-        """Re-deliver the cache as no-op updates (level-trigger backstop)."""
-        for obj in self.cache_list():
+    def _resync_due(self, spread: _ResyncSpread) -> None:
+        """Re-deliver the keys whose spread slot has been crossed
+        (level-trigger backstop, one delivery per key per period).
+        Tagged ``resync`` handlers get (obj, wave); others get the
+        classic update(obj, obj) no-op pair."""
+        due, wave = spread.due(time.monotonic())
+        for key in due:
+            obj = self.cache_get(key)
+            if obj is None:      # deleted since the keys snapshot
+                continue
             for h in self._handlers:
-                self._dispatch(h.update, obj, obj)
+                if h.resync is not None:
+                    self._dispatch(h.resync, obj, wave)
+                else:
+                    self._dispatch(h.update, obj, obj)
 
 
 class SharedInformerFactory:

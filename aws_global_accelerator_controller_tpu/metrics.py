@@ -196,6 +196,24 @@ default_registry.describe(
     "Coalesced flushes split in half after a terminal batch "
     "rejection, isolating a poisoned change to its own waiters.")
 default_registry.describe(
+    "reconcile_fastpath_skips_total",
+    "Resync-originated dispatches skipped by the desired-state "
+    "fingerprint gate before any provider call, per controller queue "
+    "(reconcile/fingerprint.py — the steady-state fast path).")
+default_registry.describe(
+    "drift_sweep_verifies_total",
+    "Gate-bypassing deep-verify syncs run by the tiered drift sweep "
+    "(one per key per sweep period, key-stably spread across resync "
+    "waves).")
+default_registry.describe(
+    "drift_repairs_total",
+    "Provider mutations committed from inside a sweep-origin sync — "
+    "the Kubernetes side was unchanged (fingerprints warm), so these "
+    "repair out-of-band AWS drift.  Coalesced payloads (record sets, "
+    "endpoint ops) count per change at the coalescer's submit-await; "
+    "non-coalesced accelerator/listener lifecycle calls count at the "
+    "resilient wrapper on success.")
+default_registry.describe(
     "race_lockset_checks",
     "Lock acquisitions screened by the runtime lockset tracker "
     "(analysis/locks.py) — nonzero proves the detector was armed.")
@@ -313,6 +331,28 @@ def watch_throttle_tokens(region: str, fn: Callable[[], float],
     """Register the throttle_tokens{region} gauge."""
     reg = registry or default_registry
     reg.register_gauge("throttle_tokens", {"region": region}, fn)
+
+
+def record_fastpath_skip(controller: str,
+                         registry: Optional[Registry] = None) -> None:
+    """One resync-originated dispatch answered by the fingerprint gate
+    (no provider call, no process func)."""
+    reg = registry or default_registry
+    reg.inc_counter("reconcile_fastpath_skips_total",
+                    {"controller": controller})
+
+
+def record_drift_sweep_verify(registry: Optional[Registry] = None) -> None:
+    """One deep-verify (gate-bypassing) sweep sync started."""
+    reg = registry or default_registry
+    reg.inc_counter("drift_sweep_verifies_total", {})
+
+
+def record_drift_repair(registry: Optional[Registry] = None) -> None:
+    """One provider mutation attributed to out-of-band drift repair
+    (submitted while a sweep-origin sync was on the stack)."""
+    reg = registry or default_registry
+    reg.inc_counter("drift_repairs_total", {})
 
 
 def record_lockset_checks(n: int = 1,

@@ -14,6 +14,15 @@ Mirrors reference pkg/reconcile/reconcile.go:17-91:
   errors, errors.retry_after_hint) -> Forget + AddAfter(hint); other
   error -> AddRateLimited; Result.requeue_after -> Forget + AddAfter;
   Result.requeue -> AddRateLimited; success -> Forget.
+
+Steady-state fast path (``fingerprints``, reconcile/fingerprint.py):
+when the dispatched key's pending enqueue originated from an informer
+RESYNC and the live object still matches the fingerprint recorded at
+its last successful sync, the key is skipped — Forget, one counter
+bump, no ``apis.*`` call (lint rule L107 enforces that lexically).
+Sweep-origin dispatches bypass the gate and run inside the cache's
+sweep context so out-of-band AWS drift is re-verified and repaired on
+a slow tier; event-origin dispatches always take the full path.
 """
 from __future__ import annotations
 
@@ -23,10 +32,15 @@ import zlib
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from . import metrics
-from .errors import is_no_retry, is_not_found, retry_after_hint
-from .kube.workqueue import RateLimitingQueue
-from .tracing import default_tracer
+from .. import metrics
+from ..errors import is_no_retry, is_not_found, retry_after_hint
+from ..kube.workqueue import RateLimitingQueue
+from ..tracing import default_tracer
+from .fingerprint import (
+    ORIGIN_RESYNC,
+    ORIGIN_SWEEP,
+    FingerprintCache,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -49,11 +63,14 @@ def process_next_work_item(
     process_delete: ProcessDeleteFunc,
     process_create_or_update: ProcessCreateOrUpdateFunc,
     get_timeout: Optional[float] = None,
+    fingerprints: Optional[FingerprintCache] = None,
 ) -> bool:
     """One worker iteration; returns False only on queue shutdown.
 
     ``get_timeout`` is an addition over the reference for clean thread
     shutdown: a ``get`` timeout yields True without processing.
+    ``fingerprints`` arms the steady-state fast path (module
+    docstring); None keeps the reference dispatch exactly.
     """
     item, shutdown = queue.get(timeout=get_timeout)
     if shutdown:
@@ -63,7 +80,7 @@ def process_next_work_item(
 
     try:
         _reconcile_handler(item, queue, key_to_obj, process_delete,
-                           process_create_or_update)
+                           process_create_or_update, fingerprints)
     except Exception:
         logger.exception("unhandled error reconciling %r", item)
     finally:
@@ -72,7 +89,9 @@ def process_next_work_item(
 
 
 def _reconcile_handler(key, queue, key_to_obj, process_delete,
-                       process_create_or_update) -> None:
+                       process_create_or_update,
+                       fingerprints: Optional[FingerprintCache] = None,
+                       ) -> None:
     if not isinstance(key, str):
         queue.forget(key)
         logger.error("expected string in workqueue but got %r", key)
@@ -81,12 +100,17 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
     start = time.monotonic()
     res = Result()
     err: Optional[Exception] = None
+    obj = None
+    origin = (fingerprints.claim_origin(key)
+              if fingerprints is not None else None)
     with default_tracer.span("reconcile", queue=queue.name or "queue",
                              key=key) as span:
         try:
             obj = key_to_obj(key)
         except Exception as e:
             if is_not_found(e):
+                if fingerprints is not None:
+                    fingerprints.invalidate(key)
                 try:
                     res = process_delete(key) or Result()
                 except Exception as de:
@@ -96,12 +120,45 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                 logger.error("unable to retrieve %r from store: %s", key, e)
                 return
         else:
+            # steady-state fast path: a resync-originated key whose
+            # live object still matches its recorded fingerprint needs
+            # no provider verification — skip before any apis.* call
+            # (L107).  Event and sweep origins never match here:
+            # note_event dropped the record, and sweep bypasses the
+            # gate by design.
+            if (fingerprints is not None and origin == ORIGIN_RESYNC
+                    and fingerprints.matches(key, obj)):
+                queue.forget(key)
+                metrics.record_fastpath_skip(fingerprints.controller)
+                span.attributes["outcome"] = "fastpath_skip"
+                logger.debug("fingerprint unchanged for %r, skipped "
+                             "(%.6fs)", key, time.monotonic() - start)
+                return
+            # a sweep delivery is a DEEP VERIFY only when the recorded
+            # fingerprint still matches (the Kubernetes side is
+            # provably unchanged, so any provider mutation it submits
+            # repairs out-of-band drift).  A sweep hitting a changed
+            # or never-synced object is just an ordinary sync —
+            # counting its real convergence work as "drift repair"
+            # would make the counter lie at cold start.
+            sweep = (fingerprints is not None and origin == ORIGIN_SWEEP
+                     and fingerprints.matches(key, obj))
             try:
-                res = process_create_or_update(obj.deep_copy()) or Result()
+                if sweep:
+                    with fingerprints.sweep_verify():
+                        res = (process_create_or_update(obj.deep_copy())
+                               or Result())
+                else:
+                    res = (process_create_or_update(obj.deep_copy())
+                           or Result())
             except Exception as ce:
                 err = ce
 
         if err is not None:
+            if fingerprints is not None:
+                # any provider error means the recorded fingerprint no
+                # longer proves a converged state
+                fingerprints.invalidate(key)
             if is_no_retry(err):
                 outcome = "no_retry_error"
                 logger.error("error syncing %r: %s", key, err)
@@ -144,6 +201,10 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
         else:
             outcome = "success"
             queue.forget(key)
+            if fingerprints is not None and obj is not None:
+                # the state this sync verified/converged is what the
+                # next resync re-delivery will be compared against
+                fingerprints.record(key, obj)
             logger.debug("successfully synced %r (%.3fs)",
                          key, time.monotonic() - start)
         span.attributes["outcome"] = outcome

@@ -1,0 +1,254 @@
+"""Desired-state fingerprints: the steady-state fast path's gate.
+
+The informer resync is the level-triggered backstop the reconcile
+design relies on — but re-running a full provider-verifying sync for
+every object every period makes an IDLE fleet of N services cost O(N)
+reconciles (and a burst of AWS reads) per period.  The fingerprint
+layer removes that cost the same way the read path removed O(fleet)
+scans: do the cheap local check always, the expensive global one
+rarely.
+
+Each controller computes a canonical fingerprint of exactly the
+spec/annotation/status fields its sync READS (the builder is a pure
+function over informer-cache state — lint rule L107 keeps ``apis.*``
+out of it).  On a successful sync the fingerprint is recorded here,
+keyed by object key + generation.  A later RESYNC-originated delivery
+of the same key whose live object still matches is skipped by the
+reconcile dispatch before any provider call; everything else — real
+watch events, provider errors, circuit-breaker opens — invalidates the
+record and the next dispatch takes the full path.
+
+Because a fingerprint only proves the KUBERNETES side is unchanged,
+it can go stale against out-of-band AWS mutation.  The tiered
+drift-verification sweep covers that: every ``sweep_every`` resync
+waves each key gets ONE delivery tagged ``ORIGIN_SWEEP`` which
+bypasses the gate entirely (key-stable spread, so ~1/sweep_every of
+the fleet deep-verifies per wave).  The sweep sync is an ordinary
+full sync — it rides the provider's singleflight verify pairs and
+fleet sweeps, repairs whatever drifted, and re-records the
+fingerprint on success.  Provider mutations submitted while a sweep
+sync is on the stack are counted as drift repairs
+(``drift_repairs_total``; the write coalescer calls
+:func:`note_provider_mutation` on every submit).
+
+Origins (per pending enqueue, event wins over sweep wins over resync):
+
+- ``ORIGIN_EVENT``   a real watch event enqueued the key: never skip
+- ``ORIGIN_SWEEP``   this key's deep-verify wave: never skip; when
+                     the recorded fingerprint still MATCHES the live
+                     object the sync runs inside the sweep context
+                     (verify counted, mutations attributed to drift
+                     repair — the Kubernetes side is provably
+                     unchanged), otherwise it is an ordinary sync
+- ``ORIGIN_RESYNC``  plain resync re-delivery: skip iff the live
+                     object matches the recorded fingerprint
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import weakref
+import zlib
+from collections import OrderedDict
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .. import metrics
+from ..analysis import locks
+
+ORIGIN_EVENT = "event"
+ORIGIN_SWEEP = "sweep"
+ORIGIN_RESYNC = "resync"
+
+# event > sweep > resync: a pending enqueue's origin is only ever
+# upgraded (a resync re-delivery must not demote a real event's claim
+# while the key waits in the queue)
+_PRECEDENCE = {ORIGIN_RESYNC: 0, ORIGIN_SWEEP: 1, ORIGIN_EVENT: 2}
+
+
+@dataclass(frozen=True)
+class FingerprintConfig:
+    """Steady-state fast-path knobs.  ``enabled=False`` is the A/B
+    escape hatch: every resync re-delivery takes the full
+    provider-verifying sync (what ``bench.py steady-state`` measures
+    the win against)."""
+
+    enabled: bool = True
+    # tiered drift verification: each key gets one gate-bypassing deep
+    # verify every this-many resync waves (~10 periods ≈ 5 minutes at
+    # the default 30s resync); 0 disables the sweep entirely (resync
+    # re-deliveries then never reach the provider while unchanged —
+    # out-of-band AWS drift goes undetected until a real event)
+    sweep_every: int = 10
+    # bound on recorded fingerprints; oldest-recorded evicted first
+    # (an evicted key just takes one full sync on its next resync)
+    max_entries: int = 100_000
+
+
+# live caches, so resilience-layer signals (a circuit opening) can
+# drop every recorded fingerprint at once: an open circuit means the
+# provider's answers were failing regionally — nothing recorded
+# through that window deserves trust
+_caches: "weakref.WeakSet[FingerprintCache]" = weakref.WeakSet()
+_caches_lock = threading.Lock()
+
+# thread-local sweep context: set by the reconcile dispatch around a
+# sweep-origin sync so provider mutations submitted on this stack are
+# attributed to drift repair
+_sweep_tls = threading.local()
+
+
+def invalidate_all_caches(reason: str = "") -> None:
+    """Drop every recorded fingerprint in every live cache (the
+    circuit/chaos invalidation hook — resilience/breaker.py calls this
+    on a transition to open)."""
+    with _caches_lock:
+        caches = list(_caches)
+    for cache in caches:
+        cache.invalidate_all(reason)
+
+
+def in_sweep() -> bool:
+    """True while a sweep-origin (deep-verify) sync runs on this
+    thread — controllers consult this to bypass their own no-change
+    short-circuits (the EndpointGroupBinding controller's early
+    return would otherwise hide out-of-band endpoint-group drift from
+    the sweep)."""
+    return getattr(_sweep_tls, "depth", 0) > 0
+
+
+def note_provider_mutation(n: int = 1) -> None:
+    """``n`` provider mutation intents COMMITTED (the write
+    coalescer's submit surface calls this after the flush carrying
+    them succeeded — a rejected or parked flush counts nothing).
+    Attributed as drift repairs when a sweep-origin sync is on this
+    thread's stack: the Kubernetes side was provably unchanged
+    (fingerprints warm), so the mutations can only be repairing
+    AWS-side drift."""
+    if n > 0 and in_sweep():
+        for _ in range(n):
+            metrics.record_drift_repair()
+
+
+class FingerprintCache:
+    """One controller queue's fingerprint gate.
+
+    ``fingerprint_fn(obj)`` returns the canonical tuple of fields the
+    controller's sync reads (pure over informer state; never
+    ``apis.*`` — L107).  The digest is recorded on successful sync
+    and consulted only for resync-originated dispatches.
+    """
+
+    def __init__(self, controller: str,
+                 fingerprint_fn: Callable[[object], object],
+                 config: Optional[FingerprintConfig] = None):
+        self.controller = controller
+        self.config = config or FingerprintConfig()
+        self._fn = fingerprint_fn
+        self._lock = locks.make_lock(f"fingerprint[{controller}]")
+        # key -> (generation, digest), insertion-ordered for eviction
+        self._fp: "OrderedDict[str, tuple]" = OrderedDict()
+        # key -> pending enqueue origin (claimed at dispatch)
+        self._origin: dict = {}
+        with _caches_lock:
+            _caches.add(self)
+
+    # -- fingerprinting -------------------------------------------------
+
+    def fingerprint(self, obj) -> "tuple[int, str]":
+        """(generation, digest) of the live object.  The digest
+        canonicalizes whatever the builder returns via ``repr`` — the
+        builders return tuples of primitives, so the representation is
+        deterministic across processes."""
+        fields = self._fn(obj)
+        digest = hashlib.sha1(repr(fields).encode()).hexdigest()
+        return obj.metadata.generation, digest
+
+    # -- enqueue-origin bookkeeping ------------------------------------
+
+    def note_event(self, key: str) -> None:
+        """A real watch event enqueued ``key``: the recorded
+        fingerprint no longer describes a successfully synced state,
+        and the pending dispatch must take the full path."""
+        with self._lock:
+            self._fp.pop(key, None)
+            self._origin[key] = ORIGIN_EVENT
+
+    def note_resync(self, key: str, wave: int) -> str:
+        """A resync wave re-delivered ``key``; returns the origin the
+        pending dispatch will carry.  Key-stable sweep tiering: the
+        key deep-verifies on the waves where ``crc32(key) ≡ wave (mod
+        sweep_every)`` — one gate bypass per key per sweep period,
+        spread evenly across the period's waves.  ``sweep_every <= 0``
+        disables the sweep (no delivery is ever sweep-tagged)."""
+        every = self.config.sweep_every
+        due = (every > 0
+               and (zlib.crc32(key.encode()) % every) == (wave % every))
+        origin = ORIGIN_SWEEP if due else ORIGIN_RESYNC
+        with self._lock:
+            have = self._origin.get(key)
+            if have is None or _PRECEDENCE[origin] > _PRECEDENCE[have]:
+                self._origin[key] = origin
+            return self._origin[key]
+
+    def claim_origin(self, key: str) -> Optional[str]:
+        """Consume the pending origin for ``key`` at dispatch.  None
+        (no recorded origin — e.g. a directly ``add``-ed key) is
+        treated like an event by callers: full sync."""
+        with self._lock:
+            return self._origin.pop(key, None)
+
+    # -- the gate -------------------------------------------------------
+
+    def matches(self, key: str, obj) -> bool:
+        """True iff the live object's fingerprint equals the one
+        recorded at the last successful sync (same generation AND same
+        digest).  Never consults the provider (L107)."""
+        if not self.config.enabled:
+            return False
+        with self._lock:
+            have = self._fp.get(key)
+        if have is None:
+            return False
+        return have == self.fingerprint(obj)
+
+    def record(self, key: str, obj) -> None:
+        """Record a successful sync of ``obj``.  A real event that
+        landed mid-sync keeps its claim: the pending event-origin
+        dispatch re-syncs regardless of what is recorded here."""
+        if not self.config.enabled:
+            return
+        fp = self.fingerprint(obj)
+        with self._lock:
+            self._fp.pop(key, None)
+            self._fp[key] = fp
+            while len(self._fp) > self.config.max_entries:
+                self._fp.popitem(last=False)
+
+    def invalidate(self, key: str) -> None:
+        """Drop one key's record (provider error, deletion)."""
+        with self._lock:
+            self._fp.pop(key, None)
+
+    def invalidate_all(self, reason: str = "") -> None:
+        with self._lock:
+            self._fp.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._fp)
+
+    # -- sweep context --------------------------------------------------
+
+    @contextmanager
+    def sweep_verify(self):
+        """Wraps a sweep-origin sync: counts the deep verify and marks
+        the thread so provider mutations submitted inside are
+        attributed to drift repair."""
+        metrics.record_drift_sweep_verify()
+        _sweep_tls.depth = getattr(_sweep_tls, "depth", 0) + 1
+        try:
+            yield
+        finally:
+            _sweep_tls.depth -= 1

@@ -103,6 +103,14 @@ class CircuitBreaker:
         self._state = to
         metrics.record_circuit_transition(self.region, to,
                                           registry=self._registry)
+        if to == STATE_OPEN:
+            # the region was failing hard enough to trip the breaker:
+            # fingerprints recorded through that window proved nothing
+            # — drop them all so the next resync re-verifies (lazy
+            # import: the reconcile package is a consumer of this
+            # layer, not a dependency)
+            from ..reconcile.fingerprint import invalidate_all_caches
+            invalidate_all_caches(f"circuit_open:{self.region}")
 
     def _prune_locked(self, now: float) -> None:
         horizon = now - self.window

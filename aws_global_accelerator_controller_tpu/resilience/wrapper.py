@@ -57,6 +57,20 @@ GA_METHODS = frozenset({
     "delete_endpoint_group",
 })
 ELB_METHODS = frozenset({"describe_load_balancers"})
+
+# GA mutations NOT on the coalesced write surface (accelerator /
+# listener / endpoint-group lifecycle — issued directly through
+# ``apis``, one call each).  On success the wrapper attributes them to
+# drift repair when a sweep-origin sync is on the calling thread
+# (reconcile/fingerprint.py); the coalesced surface is deliberately
+# EXCLUDED here — its payloads are counted per change at the
+# coalescer's submit-await, on the submitter's own thread, so a flush
+# led by the sweep thread is never double-counted.
+UNCOALESCED_MUTATIONS = frozenset({
+    "create_accelerator", "update_accelerator", "tag_resource",
+    "delete_accelerator", "create_listener", "update_listener",
+    "delete_listener", "create_endpoint_group", "delete_endpoint_group",
+})
 ROUTE53_METHODS = frozenset({
     "list_hosted_zones", "list_hosted_zones_by_name",
     "list_resource_record_sets", "change_resource_record_sets",
@@ -239,6 +253,13 @@ class ResilientAPIs:
                 now = self._clock()
                 self.breaker.record_success(now)
                 self.bucket.on_success(now)
+                if op in UNCOALESCED_MUTATIONS:
+                    # lazy import: the reconcile package is a consumer
+                    # of this layer, not a dependency
+                    from ..reconcile.fingerprint import (
+                        note_provider_mutation,
+                    )
+                    note_provider_mutation()
                 return result
 
     def _pace(self, op: str, deadline: float) -> None:

@@ -306,6 +306,175 @@ def bench_batch_efficiency(sizes=(200, 1000), workers: int = 4,
     return {"workers": workers, "legs": legs}
 
 
+# the provider READ surface a steady-state verify sync touches — the
+# calls the fingerprint gate (reconcile/fingerprint.py) removes from
+# idle resync waves.  Mutations are tracked separately (a converged
+# steady state should issue none).
+_PROVIDER_READ_METHODS = (
+    "list_accelerators", "describe_accelerator",
+    "list_tags_for_resource", "list_listeners", "list_endpoint_groups",
+    "describe_endpoint_group", "describe_load_balancers",
+    "list_hosted_zones", "list_hosted_zones_by_name",
+    "list_resource_record_sets")
+
+
+def _steady_state_leg(n_services: int, workers: int, enabled: bool,
+                      resync: float, waves: int,
+                      sweep_every: int) -> dict:
+    """Converge ``n_services`` managed Services, then idle through
+    ``waves`` resync periods and count what the fleet costs AT REST:
+    provider read calls and reconciles per wave.  ``enabled`` toggles
+    the fingerprint gate — off replays the naive level-trigger
+    backstop (every object takes a full provider-verifying sync every
+    period), on skips unchanged objects and deep-verifies each key
+    once per ``sweep_every`` waves."""
+    sys.path.insert(0, "tests")
+    from harness import Cluster, wait_until
+
+    from aws_global_accelerator_controller_tpu import metrics
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintConfig,
+    )
+
+    reg = metrics.default_registry
+    cluster = Cluster(workers=workers, queue_qps=10000.0,
+                      queue_burst=10000, resync_period=resync,
+                      fingerprints=FingerprintConfig(
+                          enabled=enabled,
+                          sweep_every=sweep_every)).start()
+    region = "ap-northeast-1"
+    try:
+        for i in range(n_services):
+            name = f"svc{i:04d}"
+            hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                        ".amazonaws.com")
+            cluster.cloud.elb.register_load_balancer(name, hostname,
+                                                     region)
+        start = time.perf_counter()
+        for i in range(n_services):
+            name = f"svc{i:04d}"
+            hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                        ".amazonaws.com")
+            cluster.kube.services.create(Service(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION:
+                            "true",
+                    }),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                    ingress=[LoadBalancerIngress(hostname=hostname)])),
+            ))
+        wait_until(
+            lambda: len(cluster.cloud.ga.list_accelerators())
+            == n_services,
+            timeout=600.0, interval=0.05,
+            message=f"{n_services} accelerators converged")
+        elapsed = time.perf_counter() - start
+
+        # let the convergence tail drain (and the first resync waves'
+        # fingerprints record) before opening the measurement window
+        time.sleep(2 * resync)
+
+        before_calls = cluster.cloud.faults.call_counts()
+        before = {
+            "syncs": reg.counter_value("controller_sync_total"),
+            "skips": reg.counter_value(
+                "reconcile_fastpath_skips_total"),
+            "sweeps": reg.counter_value("drift_sweep_verifies_total"),
+        }
+        time.sleep(waves * resync)
+        after_calls = cluster.cloud.faults.call_counts()
+        reads = sum(after_calls.get(m, 0) - before_calls.get(m, 0)
+                    for m in _PROVIDER_READ_METHODS)
+        syncs = reg.counter_value("controller_sync_total") \
+            - before["syncs"]
+        skips = reg.counter_value("reconcile_fastpath_skips_total") \
+            - before["skips"]
+        sweeps = reg.counter_value("drift_sweep_verifies_total") \
+            - before["sweeps"]
+    finally:
+        cluster.shutdown()
+
+    return {
+        "services": n_services,
+        "elapsed_s": round(elapsed, 3),
+        "throughput": round(n_services / elapsed, 1),
+        "waves": waves,
+        "resync_s": resync,
+        "reads_per_wave": round(reads / waves, 1),
+        "reads_per_service_per_wave": round(
+            reads / waves / n_services, 4),
+        "reconciles_per_wave": round(syncs / waves, 1),
+        "fastpath_skips_per_wave": round(skips / waves, 1),
+        "sweep_verifies_per_wave": round(sweeps / waves, 1),
+    }
+
+
+def bench_steady_state(sizes=(1000,), workers: int = 4,
+                       resync: float = 1.0, waves: int = 6,
+                       sweep_every: int = 20,
+                       record: bool = False) -> dict:
+    """A/B of the steady-state fast path (reconcile/fingerprint.py) on
+    an idle converged fleet: fingerprinting off replays one full
+    provider-verifying sync per object per resync period; on, resync
+    re-deliveries are answered by the fingerprint gate in O(1) and
+    only the tiered drift sweep (one deep verify per key per
+    ``sweep_every`` waves) still reaches the provider.
+    ``read_reduction`` is the provider-read-calls-per-wave factor.
+    ``record=True`` appends the fingerprinted legs to
+    reconcile_history.jsonl tagged ``bench: "steady-state"`` (the
+    derived reconcile floor skips tagged entries — this leg's
+    convergence number includes resync interference, not the floor's
+    pure create storm)."""
+    legs = []
+    for n in sizes:
+        off = _steady_state_leg(n, workers, enabled=False,
+                                resync=resync, waves=waves,
+                                sweep_every=sweep_every)
+        on = _steady_state_leg(n, workers, enabled=True,
+                               resync=resync, waves=waves,
+                               sweep_every=sweep_every)
+        leg = {
+            "services": n,
+            "off": off,
+            "on": on,
+            "read_reduction": round(
+                off["reads_per_wave"]
+                / max(on["reads_per_wave"], 1e-9), 1),
+            "reconcile_reduction": round(
+                off["reconciles_per_wave"]
+                / max(on["reconciles_per_wave"], 1e-9), 1),
+        }
+        legs.append(leg)
+        if record:
+            _record_reconcile_history(
+                on, bench="steady-state",
+                extra={"reads_per_wave": on["reads_per_wave"],
+                       "off_reads_per_wave": off["reads_per_wave"],
+                       "read_reduction": leg["read_reduction"],
+                       "fastpath_skips_per_wave":
+                           on["fastpath_skips_per_wave"]})
+    return {"workers": workers, "sweep_every": sweep_every,
+            "legs": legs}
+
+
 def bench_reconcile_best(reps: int = 3, **kw) -> dict:
     """Best-of-``reps`` reconcile runs.  Convergence time is gated by
     thread scheduling (informer fan-out, queue wakeups), which jitters
@@ -1790,6 +1959,7 @@ _NAMED = {
     "reconcile-scaling": lambda: bench_reconcile_scaling(record=True),
     "resilience-overhead": bench_resilience_overhead,
     "batch-efficiency": lambda: bench_batch_efficiency(record=True),
+    "steady-state": lambda: bench_steady_state(record=True),
     "planner": lambda: _json_bench_subprocess(
         "bench_planner", "planner bench", 300.0),
     "flash": bench_flash_subprocess,

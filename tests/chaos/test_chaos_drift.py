@@ -1,0 +1,168 @@
+"""Drift chaos e2e: out-of-band AWS mutation vs the tiered sweep.
+
+The steady-state fast path's one blind spot is AWS state changing
+behind the controller's back: fingerprints only prove the KUBERNETES
+side is unchanged, so a warm gate would skip the very syncs that
+would notice.  This scenario mutates an endpoint group directly in
+the fake cloud (FaultInjector.edit_endpoint_group — no API call, no
+watch event, no invalidation) while fingerprints are warm and skips
+are flowing, then asserts the drift-verification sweep detects and
+repairs it within its sweep period — under the runtime race
+detectors, like every e2e.
+"""
+import time
+
+import pytest
+
+from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (  # noqa: E501
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+    PortRange,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (
+    FingerprintConfig,
+)
+
+from harness import Cluster, wait_until
+
+REGION = "ap-northeast-1"
+RESYNC = 0.3
+SWEEP_EVERY = 5
+SWEEP_PERIOD = RESYNC * SWEEP_EVERY
+
+
+def nlb_hostname(name):
+    return f"{name}-0123456789abcdef.elb.{REGION}.amazonaws.com"
+
+
+def lb_service(name):
+    return Service(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            annotations={AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external"}),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=nlb_hostname(name))])),
+    )
+
+
+@pytest.fixture
+def cluster(race_detectors):
+    c = Cluster(workers=2, queue_qps=1000.0, queue_burst=1000,
+                resync_period=RESYNC,
+                fingerprints=FingerprintConfig(
+                    sweep_every=SWEEP_EVERY)).start()
+    yield c
+    c.shutdown()
+
+
+def test_out_of_band_endpoint_drift_repaired_by_sweep(cluster):
+    reg = metrics.default_registry
+
+    # -- a converged binding: service LB in an external endpoint group
+    lb = cluster.cloud.elb.register_load_balancer(
+        "drift-svc", nlb_hostname("drift-svc"), REGION)
+    ga = cluster.cloud.ga
+    acc = ga.create_accelerator("ext", "IPV4", True, {})
+    listener = ga.create_listener(
+        acc.accelerator_arn, [PortRange(80, 80)], "TCP", "NONE")
+    seed_lb = cluster.cloud.elb.register_load_balancer(
+        "seed", "seed-0123456789abcdef.elb.eu-west-1.amazonaws.com",
+        "eu-west-1")
+    eg = ga.create_endpoint_group(
+        listener.listener_arn, "eu-west-1",
+        seed_lb.load_balancer_arn, False)
+
+    cluster.kube.services.create(lb_service("drift-svc"))
+    cluster.operator.endpoint_group_bindings.create(EndpointGroupBinding(
+        metadata=ObjectMeta(name="drift-binding", namespace="default"),
+        spec=EndpointGroupBindingSpec(
+            endpoint_group_arn=eg.endpoint_group_arn,
+            weight=32, service_ref=ServiceReference(name="drift-svc"))))
+
+    def endpoint_weight():
+        got = ga.describe_endpoint_group(eg.endpoint_group_arn)
+        weights = {d.endpoint_id: d.weight
+                   for d in got.endpoint_descriptions}
+        return weights.get(lb.load_balancer_arn, "absent")
+
+    wait_until(lambda: endpoint_weight() == 32, timeout=20.0,
+               message="binding converged at weight 32")
+
+    # -- fingerprints warm: resync re-deliveries are being skipped
+    skips_before = reg.counter_value(
+        "reconcile_fastpath_skips_total",
+        {"controller": "EndpointGroupBinding"})
+    wait_until(
+        lambda: reg.counter_value(
+            "reconcile_fastpath_skips_total",
+            {"controller": "EndpointGroupBinding"}) > skips_before,
+        timeout=10.0,
+        message="fingerprint gate warm (binding resyncs skipping)")
+
+    # -- the drift: an operator edits the endpoint group behind the
+    # controller's back — no watch event, no call count, nothing that
+    # invalidates the warm fingerprint
+    repairs_before = reg.counter_value("drift_repairs_total")
+    verifies_before = reg.counter_value("drift_sweep_verifies_total")
+    binding_before = cluster.operator.endpoint_group_bindings.get(
+        "default", "drift-binding")
+    cluster.cloud.faults.edit_endpoint_group(
+        eg.endpoint_group_arn, lb.load_balancer_arn, weight=1)
+    assert endpoint_weight() == 1, "the out-of-band edit must land"
+    drifted_at = time.monotonic()
+
+    # -- the sweep tier detects and repairs it (each key deep-verifies
+    # once per sweep period; generous wall-clock bound for loaded CI
+    # hosts, tightness asserted separately below)
+    wait_until(lambda: endpoint_weight() == 32,
+               timeout=10 * SWEEP_PERIOD,
+               message="drift repaired by the sweep")
+    repaired_in = time.monotonic() - drifted_at
+    assert repaired_in <= 2 * SWEEP_PERIOD + RESYNC, \
+        f"repair took {repaired_in:.2f}s (sweep period {SWEEP_PERIOD}s)"
+
+    # -- and the repair is attributed: sweep verifies ran, at least
+    # one mutation was counted as a drift repair
+    assert reg.counter_value(
+        "drift_sweep_verifies_total") > verifies_before, \
+        "no sweep verify ran"
+    wait_until(
+        lambda: reg.counter_value("drift_repairs_total") > repairs_before,
+        timeout=2.0, message="drift repair counted")
+
+    # -- the repair came from the sweep, not from a Kubernetes-side
+    # change: the binding object itself never moved
+    binding_after = cluster.operator.endpoint_group_bindings.get(
+        "default", "drift-binding")
+    assert (binding_after.metadata.generation
+            == binding_before.metadata.generation)
+
+    # -- steady state after repair: gate warms back up and the weight
+    # holds (the sweep re-fingerprinted the repaired state)
+    skips_mid = reg.counter_value(
+        "reconcile_fastpath_skips_total",
+        {"controller": "EndpointGroupBinding"})
+    wait_until(
+        lambda: reg.counter_value(
+            "reconcile_fastpath_skips_total",
+            {"controller": "EndpointGroupBinding"}) > skips_mid,
+        timeout=10.0, message="gate warm again after the repair")
+    assert endpoint_weight() == 32

@@ -39,7 +39,11 @@ class Cluster:
                  settle_seconds: float = 0.0, queue_qps: float = 10.0,
                  queue_burst: int = 100, weight_policy: str = "static",
                  policy_checkpoint: str = "", resilience=None,
-                 fault_seed=None, coalesce=None):
+                 fault_seed=None, coalesce=None, fingerprints=None):
+        from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+            FingerprintConfig,
+        )
+        fingerprints = fingerprints or FingerprintConfig()
         self.api = FakeAPIServer()
         self.kube = KubeClient(self.api)
         self.operator = OperatorClient(self.api)
@@ -53,14 +57,17 @@ class Cluster:
         self._config = ControllerConfig(
             global_accelerator=GlobalAcceleratorConfig(
                 workers=workers, cluster_name=CLUSTER,
-                queue_qps=queue_qps, queue_burst=queue_burst),
+                queue_qps=queue_qps, queue_burst=queue_burst,
+                fingerprints=fingerprints),
             route53=Route53Config(workers=workers, cluster_name=CLUSTER,
                                   queue_qps=queue_qps,
-                                  queue_burst=queue_burst),
+                                  queue_burst=queue_burst,
+                                  fingerprints=fingerprints),
             endpoint_group_binding=EndpointGroupBindingConfig(
                 workers=workers, queue_qps=queue_qps,
                 queue_burst=queue_burst, weight_policy=weight_policy,
-                policy_checkpoint=policy_checkpoint),
+                policy_checkpoint=policy_checkpoint,
+                fingerprints=fingerprints),
         )
 
     def start(self):

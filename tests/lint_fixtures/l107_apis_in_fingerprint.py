@@ -1,0 +1,23 @@
+"""Rule L107 fixture: fingerprint builders (and anything else on the
+fast path, named by the ``*fingerprint*`` convention) reaching the
+provider — even through the resilience-wrapped ``apis`` bundle, where
+L105 stays silent — break the zero-provider-calls skip contract."""
+
+
+class Controller:
+    def __init__(self, apis, informer):
+        self.apis = apis
+        self.informer = informer
+
+    def binding_fingerprint(self, obj):
+        accelerator = self.apis.ga.describe_accelerator(obj.arn)
+        tags = self.apis.ga.list_tags_for_resource(obj.arn)
+        zones = self.apis.route53.list_hosted_zones()  # race: deliberate probe
+        return (accelerator.name, tuple(tags), len(zones))
+
+
+def service_fingerprint(cloud, svc):
+    # a bare service-method call on the fast path fires BOTH L105
+    # (not through apis) and L107 (provider call in a builder)
+    lbs = cloud.elb.describe_load_balancers([svc.name])
+    return (svc.name, len(lbs))

@@ -1,0 +1,23 @@
+"""Rule L107 clean fixture: fingerprint builders read informer state
+(listers, object fields) only — the provider is consulted by the sync
+and sweep paths, never by the gate."""
+
+
+class Controller:
+    def __init__(self, apis, service_informer):
+        self.apis = apis
+        self.service_informer = service_informer
+
+    def binding_fingerprint(self, obj):
+        svc = self.service_informer.lister.get(obj.namespace,
+                                               obj.ref_name)
+        return (
+            obj.metadata.generation,
+            tuple(obj.status.endpoint_ids),
+            tuple(i.hostname for i in svc.status.load_balancer.ingress),
+        )
+
+    def sync(self, arn):
+        # the SYNC path talks to the provider through apis — L107 only
+        # polices the fast path
+        return self.apis.ga.describe_accelerator(arn)

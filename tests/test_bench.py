@@ -111,17 +111,50 @@ def test_bench_batch_efficiency_smoke(monkeypatch, tmp_path):
     assert "fold_ratio" in entries[-1]
 
 
+def test_bench_steady_state_smoke(monkeypatch, tmp_path):
+    """Small-N run of the steady-state A/B leg: with fingerprinting
+    off every idle resync wave pays provider reads for the whole
+    fleet; on, the gate answers resyncs (skips flow) and reads drop —
+    the tagged history record lands with the reduction figures."""
+    path = tmp_path / "hist.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(path))
+    out = bench.bench_steady_state(sizes=(10,), workers=2,
+                                   resync=0.25, waves=4,
+                                   sweep_every=40, record=True)
+    [leg] = out["legs"]
+    off, on = leg["off"], leg["on"]
+    assert off["services"] == on["services"] == 10
+    assert off["throughput"] > 0 and on["throughput"] > 0
+    # off: the naive backstop re-verifies the fleet every wave
+    assert off["reads_per_wave"] > 0, \
+        "the ungated backstop issued no provider reads — the leg " \
+        "measured nothing"
+    # on: the gate is carrying the load (skips flowing), and the
+    # provider read volume drops hard (small-N bound is loose; the
+    # real 1000-service run asserts the 10x headline)
+    assert on["fastpath_skips_per_wave"] > 0, \
+        "no fastpath skips — the fingerprint gate never engaged"
+    assert on["reads_per_wave"] < off["reads_per_wave"]
+    assert leg["read_reduction"] >= 2.0
+    # the history entry is tagged so reconcile_floor skips it
+    entries = [json.loads(line) for line in path.read_text().splitlines()]
+    assert entries[-1]["bench"] == "steady-state"
+    assert "read_reduction" in entries[-1]
+    assert "fastpath_skips_per_wave" in entries[-1]
+
+
 def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
-    """batch-efficiency legs measure a route53-heavy workload, not the
-    floor's pure create storm: their (lower) throughputs must not drag
-    the derived floor down."""
+    """batch-efficiency and steady-state legs measure other workloads,
+    not the floor's pure create storm: their (lower) throughputs must
+    not drag the derived floor down."""
     hist = tmp_path / "history.jsonl"
     hist.write_text("".join(
         json.dumps(e) + "\n" for e in (
             {"throughput": 3400.0}, {"throughput": 3500.0},
             {"throughput": 3450.0},
             {"throughput": 150.0, "bench": "batch-efficiency"},
-            {"throughput": 160.0, "bench": "batch-efficiency"})))
+            {"throughput": 160.0, "bench": "batch-efficiency"},
+            {"throughput": 140.0, "bench": "steady-state"})))
     monkeypatch.delenv("RECONCILE_FLOOR_SVC_S", raising=False)
     monkeypatch.setattr(bench.os, "getloadavg", lambda: (0.0, 0, 0))
     got = bench.reconcile_floor(history_path=str(hist))

@@ -1,0 +1,422 @@
+"""Steady-state fast-path unit tests: the fingerprint cache's
+origin/record/invalidate lifecycle and the reconcile dispatch's
+skip/sweep behavior (reconcile/fingerprint.py + reconcile/__init__.py).
+"""
+import zlib
+
+import pytest
+
+from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.kube.workqueue import (
+    ItemExponentialFailureRateLimiter,
+    RateLimitingQueue,
+)
+from aws_global_accelerator_controller_tpu.reconcile import (
+    Result,
+    process_next_work_item,
+)
+from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (
+    ORIGIN_EVENT,
+    ORIGIN_RESYNC,
+    ORIGIN_SWEEP,
+    FingerprintCache,
+    FingerprintConfig,
+    in_sweep,
+    invalidate_all_caches,
+    note_provider_mutation,
+)
+
+
+class FakeMeta:
+    def __init__(self, generation=1):
+        self.generation = generation
+
+
+class FakeObj:
+    def __init__(self, key, value="v", generation=1):
+        self.k = key
+        self.value = value
+        self.metadata = FakeMeta(generation)
+
+    def key(self):
+        return self.k
+
+    def deep_copy(self):
+        return FakeObj(self.k, self.value, self.metadata.generation)
+
+
+def fp_fn(obj):
+    return (obj.k, obj.value)
+
+
+def make_cache(**kw):
+    return FingerprintCache("test-queue", fp_fn,
+                            FingerprintConfig(**kw))
+
+
+def make_queue():
+    return RateLimitingQueue(
+        rate_limiter=ItemExponentialFailureRateLimiter(0.001, 0.05))
+
+
+def run_one(queue, obj_by_key, cache, upsert=None, delete=None):
+    return process_next_work_item(
+        queue, lambda k: obj_by_key[k],
+        delete or (lambda key: Result()),
+        upsert or (lambda obj: Result()),
+        get_timeout=1.0, fingerprints=cache)
+
+
+def sweep_wave_for(key, every):
+    """The wave on which ``key`` is due for its deep verify."""
+    return zlib.crc32(key.encode()) % every
+
+
+# ---------------------------------------------------------------------------
+# cache lifecycle
+# ---------------------------------------------------------------------------
+
+def test_record_then_match_requires_same_generation_and_fields():
+    cache = make_cache()
+    obj = FakeObj("ns/a", "v1", generation=3)
+    cache.record("ns/a", obj)
+    assert cache.matches("ns/a", obj)
+    assert not cache.matches("ns/a", FakeObj("ns/a", "v2", generation=3))
+    assert not cache.matches("ns/a", FakeObj("ns/a", "v1", generation=4))
+
+
+def test_event_invalidates_and_claims_origin():
+    cache = make_cache()
+    obj = FakeObj("ns/a")
+    cache.record("ns/a", obj)
+    cache.note_event("ns/a")
+    assert not cache.matches("ns/a", obj), \
+        "a real watch event must drop the record"
+    assert cache.claim_origin("ns/a") == ORIGIN_EVENT
+    assert cache.claim_origin("ns/a") is None, "claim consumes"
+
+
+def test_event_origin_not_demoted_by_resync():
+    cache = make_cache(sweep_every=1000)
+    cache.note_event("ns/a")
+    assert cache.note_resync("ns/a", wave=0) == ORIGIN_EVENT
+    assert cache.claim_origin("ns/a") == ORIGIN_EVENT
+
+
+def test_sweep_tier_key_stable_and_spread():
+    every = 10
+    cache = make_cache(sweep_every=every)
+    keys = [f"ns/svc{i:03d}" for i in range(200)]
+    # each key is due exactly on its own wave, every ``every`` waves
+    for key in keys:
+        due_wave = sweep_wave_for(key, every)
+        assert cache.note_resync(key, due_wave) == ORIGIN_SWEEP
+        cache.claim_origin(key)
+        assert cache.note_resync(key, due_wave + 1) == ORIGIN_RESYNC
+        cache.claim_origin(key)
+        assert cache.note_resync(key, due_wave + every) == ORIGIN_SWEEP
+        cache.claim_origin(key)
+    # the fleet's sweeps are spread: each wave carries roughly 1/every
+    per_wave = [sum(1 for k in keys if sweep_wave_for(k, every) == w)
+                for w in range(every)]
+    assert all(p < len(keys) / 2 for p in per_wave), \
+        f"sweep bunched: {per_wave}"
+    assert sum(per_wave) == len(keys)
+
+
+def test_disabled_config_never_matches_or_records():
+    cache = make_cache(enabled=False)
+    obj = FakeObj("ns/a")
+    cache.record("ns/a", obj)
+    assert len(cache) == 0
+    assert not cache.matches("ns/a", obj)
+
+
+def test_bounded_entries_evict_oldest():
+    cache = make_cache(max_entries=3)
+    for i in range(5):
+        cache.record(f"ns/{i}", FakeObj(f"ns/{i}"))
+    assert len(cache) == 3
+    assert not cache.matches("ns/0", FakeObj("ns/0"))
+    assert cache.matches("ns/4", FakeObj("ns/4"))
+
+
+def test_invalidate_all_caches_global_hook():
+    cache = make_cache()
+    cache.record("ns/a", FakeObj("ns/a"))
+    invalidate_all_caches("circuit_open:test")
+    assert len(cache) == 0
+
+
+def test_circuit_open_transition_drops_fingerprints():
+    """The resilience-layer signal: a breaker transitioning to open
+    invalidates every recorded fingerprint."""
+    from aws_global_accelerator_controller_tpu.resilience.breaker import (
+        CircuitBreaker,
+    )
+
+    cache = make_cache()
+    cache.record("ns/a", FakeObj("ns/a"))
+    breaker = CircuitBreaker(region="fp-test", window=10.0, min_calls=2,
+                             failure_threshold=0.5, open_seconds=5.0)
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state() == "open"
+    assert len(cache) == 0, \
+        "circuit open must invalidate recorded fingerprints"
+
+
+def test_sweep_context_attributes_mutations_to_drift_repair():
+    cache = make_cache()
+    reg = metrics.default_registry
+    repairs = reg.counter_value("drift_repairs_total")
+    verifies = reg.counter_value("drift_sweep_verifies_total")
+    assert not in_sweep()
+    note_provider_mutation()   # outside a sweep: not a repair
+    assert reg.counter_value("drift_repairs_total") == repairs
+    with cache.sweep_verify():
+        assert in_sweep()
+        note_provider_mutation()
+    assert not in_sweep()
+    assert reg.counter_value("drift_repairs_total") == repairs + 1
+    assert reg.counter_value("drift_sweep_verifies_total") == verifies + 1
+
+
+def test_sweep_every_zero_disables_the_sweep():
+    """CLI convention: 0 disables — no delivery is ever sweep-tagged,
+    so unchanged objects never reach the provider (and drift goes
+    undetected, as documented)."""
+    cache = make_cache(sweep_every=0)
+    for wave in range(25):
+        assert cache.note_resync("ns/a", wave) == ORIGIN_RESYNC
+        cache.claim_origin("ns/a")
+
+
+def test_uncoalesced_mutation_in_sweep_counts_as_repair():
+    """Sweep repairs made through the NON-coalesced mutation surface
+    (accelerator/listener lifecycle — e.g. re-enabling an accelerator
+    disabled out-of-band) are attributed too: the resilient wrapper
+    counts them on success when the calling thread is in a sweep."""
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.fake import (  # noqa: E501
+        FakeAWSCloud,
+    )
+    from aws_global_accelerator_controller_tpu.resilience import (
+        ResilientAPIs,
+    )
+    from aws_global_accelerator_controller_tpu.resilience.wrapper import (
+        FAKE_CLOUD_CONFIG,
+    )
+
+    cloud = FakeAWSCloud()
+    apis = ResilientAPIs(cloud, region="fp-repair",
+                         config=FAKE_CLOUD_CONFIG)
+    acc = apis.ga.create_accelerator("a", "IPV4", True, {})
+    reg = metrics.default_registry
+    repairs = reg.counter_value("drift_repairs_total")
+
+    # outside a sweep: a mutation is ordinary convergence work
+    apis.ga.update_accelerator(acc.accelerator_arn, enabled=True)
+    assert reg.counter_value("drift_repairs_total") == repairs
+
+    cache = make_cache()
+    with cache.sweep_verify():
+        apis.ga.update_accelerator(acc.accelerator_arn, enabled=True)
+        apis.ga.describe_accelerator(acc.accelerator_arn)  # read: free
+    assert reg.counter_value("drift_repairs_total") == repairs + 1
+
+
+def test_resync_enqueue_answers_unchanged_without_queue_churn():
+    """The enqueue-time gate (controller.base.resync_enqueue): an
+    unchanged object never touches the workqueue — so a parked or
+    backing-off key is never converted into an immediate retry by the
+    next resync wave — while changed keys ride add_rate_limited (the
+    per-key failure backoff stays in force)."""
+    from aws_global_accelerator_controller_tpu.controller.base import (
+        resync_enqueue,
+    )
+
+    cache = make_cache(sweep_every=1000)
+    q = make_queue()
+    obj = FakeObj("ns/a")
+    cache.record("ns/a", obj)
+    reg = metrics.default_registry
+    skips = reg.counter_value("reconcile_fastpath_skips_total",
+                              {"controller": "test-queue"})
+    wave = sweep_wave_for("ns/a", 1000) + 1
+
+    resync_enqueue(cache, q, obj, wave)
+    assert len(q) == 0, "unchanged object must not be enqueued"
+    assert reg.counter_value("reconcile_fastpath_skips_total",
+                             {"controller": "test-queue"}) == skips + 1
+    assert cache.claim_origin("ns/a") is None, \
+        "the pending origin must be consumed with the skip"
+
+    # changed object (stale record): rate-limited path, failure
+    # accounting armed
+    resync_enqueue(cache, q, FakeObj("ns/a", "v2"), wave)
+    item, _ = q.get(timeout=1.0)
+    assert item == "ns/a"
+    assert q.num_requeues("ns/a") == 1, \
+        "the backstop enqueue must ride the rate limiter"
+
+    # sweep-due wave: enqueued even though the record matches
+    q2 = make_queue()
+    cache2 = make_cache(sweep_every=7)
+    cache2.record("ns/a", obj)
+    resync_enqueue(cache2, q2, obj, sweep_wave_for("ns/a", 7))
+    item, _ = q2.get(timeout=1.0)
+    assert item == "ns/a", "sweep-due keys must reach the queue"
+
+
+# ---------------------------------------------------------------------------
+# reconcile dispatch
+# ---------------------------------------------------------------------------
+
+def test_resync_origin_with_matching_fingerprint_skips():
+    cache = make_cache(sweep_every=1000)
+    q = make_queue()
+    obj = FakeObj("ns/a")
+    objs = {"ns/a": obj}
+    synced = []
+
+    # first pass: event origin, full sync, fingerprint recorded
+    cache.note_event("ns/a")
+    q.add("ns/a")
+    run_one(q, objs, cache, upsert=lambda o: synced.append(o) or Result())
+    assert len(synced) == 1
+
+    # resync re-delivery of the unchanged object: skipped before the
+    # process func (no provider calls, no sync)
+    reg = metrics.default_registry
+    skips = reg.counter_value("reconcile_fastpath_skips_total",
+                              {"controller": "test-queue"})
+    origin = cache.note_resync("ns/a", wave=sweep_wave_for("ns/a", 1000) + 1)
+    assert origin == ORIGIN_RESYNC
+    q.add("ns/a")
+    run_one(q, objs, cache, upsert=lambda o: synced.append(o) or Result())
+    assert len(synced) == 1, "matching fingerprint must skip the sync"
+    assert reg.counter_value("reconcile_fastpath_skips_total",
+                             {"controller": "test-queue"}) == skips + 1
+    assert len(q) == 0 and q.num_requeues("ns/a") == 0
+
+
+def test_resync_origin_with_changed_object_syncs():
+    cache = make_cache(sweep_every=1000)
+    q = make_queue()
+    objs = {"ns/a": FakeObj("ns/a", "v1")}
+    synced = []
+    cache.note_event("ns/a")
+    q.add("ns/a")
+    run_one(q, objs, cache, upsert=lambda o: synced.append(o) or Result())
+
+    objs["ns/a"] = FakeObj("ns/a", "v2")   # drifted desired state
+    cache.note_resync("ns/a", wave=sweep_wave_for("ns/a", 1000) + 1)
+    q.add("ns/a")
+    run_one(q, objs, cache, upsert=lambda o: synced.append(o) or Result())
+    assert len(synced) == 2, "changed object must take the full sync"
+
+
+def test_sweep_origin_bypasses_gate_and_marks_context():
+    cache = make_cache(sweep_every=7)
+    q = make_queue()
+    obj = FakeObj("ns/a")
+    objs = {"ns/a": obj}
+    cache.record("ns/a", obj)   # warm fingerprint — would skip
+    seen = []
+
+    origin = cache.note_resync("ns/a", wave=sweep_wave_for("ns/a", 7))
+    assert origin == ORIGIN_SWEEP
+    q.add("ns/a")
+    run_one(q, objs, cache,
+            upsert=lambda o: seen.append(in_sweep()) or Result())
+    assert seen == [True], \
+        "sweep must run the full sync inside the sweep context"
+
+
+def test_sweep_with_stale_fingerprint_is_a_plain_sync():
+    """A sweep delivery of a changed (or never-synced) object is an
+    ordinary sync: no sweep context, no deep-verify counting — its
+    real convergence work must not masquerade as drift repair."""
+    cache = make_cache(sweep_every=7)
+    q = make_queue()
+    objs = {"ns/a": FakeObj("ns/a", "changed")}
+    reg = metrics.default_registry
+    verifies = reg.counter_value("drift_sweep_verifies_total")
+    seen = []
+
+    origin = cache.note_resync("ns/a", wave=sweep_wave_for("ns/a", 7))
+    assert origin == ORIGIN_SWEEP
+    q.add("ns/a")
+    run_one(q, objs, cache,
+            upsert=lambda o: seen.append(in_sweep()) or Result())
+    assert seen == [False], "stale fingerprint: plain sync, no context"
+    assert reg.counter_value("drift_sweep_verifies_total") == verifies
+
+
+def test_error_invalidates_fingerprint():
+    cache = make_cache(sweep_every=1000)
+    q = make_queue()
+    obj = FakeObj("ns/a")
+    objs = {"ns/a": obj}
+    cache.note_event("ns/a")
+    q.add("ns/a")
+    run_one(q, objs, cache)          # success: recorded
+    assert cache.matches("ns/a", obj)
+
+    def boom(o):
+        raise RuntimeError("provider brownout")
+
+    cache.note_event("ns/a")
+    q.add("ns/a")
+    run_one(q, objs, cache, upsert=boom)
+    assert not cache.matches("ns/a", obj), \
+        "a failed sync must invalidate the record"
+
+
+def test_unknown_origin_takes_full_path():
+    """A key added without any origin note (direct add) must sync —
+    the gate only answers resync-originated dispatches."""
+    cache = make_cache()
+    q = make_queue()
+    obj = FakeObj("ns/a")
+    objs = {"ns/a": obj}
+    cache.record("ns/a", obj)       # warm record
+    synced = []
+    q.add("ns/a")
+    run_one(q, objs, cache, upsert=lambda o: synced.append(o) or Result())
+    assert len(synced) == 1
+
+
+def test_delete_invalidates_record():
+    from aws_global_accelerator_controller_tpu.errors import NotFoundError
+
+    cache = make_cache()
+    q = make_queue()
+    obj = FakeObj("ns/a")
+    cache.record("ns/a", obj)
+
+    def gone(key):
+        raise NotFoundError("Service", key)
+
+    deleted = []
+    q.add("ns/a")
+    process_next_work_item(
+        q, gone, lambda key: deleted.append(key) or Result(),
+        lambda o: Result(), get_timeout=1.0, fingerprints=cache)
+    assert deleted == ["ns/a"]
+    assert not cache.matches("ns/a", obj)
+
+
+@pytest.mark.parametrize("outcome", ["requeue", "requeue_after"])
+def test_incomplete_sync_does_not_record(outcome):
+    cache = make_cache()
+    q = make_queue()
+    obj = FakeObj("ns/a")
+    objs = {"ns/a": obj}
+    res = (Result(requeue=True) if outcome == "requeue"
+           else Result(requeue_after=0.01))
+    cache.note_event("ns/a")
+    q.add("ns/a")
+    run_one(q, objs, cache, upsert=lambda o: res)
+    assert not cache.matches("ns/a", obj), \
+        "an unconverged sync must not record a fingerprint"

@@ -102,3 +102,96 @@ def test_shared_informer_is_shared():
     factory = SharedInformerFactory(api)
     assert factory.services() is factory.services()
     assert factory.ingresses() is not factory.services()
+
+
+def test_resync_spread_jitters_across_period_fake_clock():
+    """Thundering-herd fix: resync re-deliveries are spread across the
+    period at key-stable offsets, not released as one burst at the
+    timer edge.  Driven with an explicit fake clock — _ResyncSpread is
+    pure scheduling."""
+    from aws_global_accelerator_controller_tpu.kube.informers import (
+        _ResyncSpread,
+    )
+
+    period = 30.0
+    keys = [f"default/svc{i:03d}" for i in range(50)]
+    spread = _ResyncSpread(period, start=1000.0, keys=keys)
+
+    # nothing due at the period start: the old code would have
+    # delivered ALL keys at the edge of the previous period
+    due0, wave0 = spread.due(1000.0)
+    assert wave0 == 0
+    assert len(due0) < len(keys) / 5, \
+        f"burst at period start: {len(due0)} keys due immediately"
+
+    # step the clock in 1s ticks: deliveries trickle out, each key
+    # exactly once, at its own crc32 slot
+    delivered_at = {}
+    for tick in range(1, 31):
+        due, wave = spread.due(1000.0 + tick)
+        assert wave == 0
+        for k in due:
+            assert k not in delivered_at, f"{k} delivered twice"
+            delivered_at[k] = tick
+    assert set(delivered_at) | set(due0) == set(keys), \
+        "every key must be delivered exactly once per period"
+    # the spread is real: deliveries land in many distinct ticks and
+    # no single tick carries the bulk of the fleet
+    ticks = sorted(set(delivered_at.values()))
+    assert len(ticks) >= 10, f"deliveries bunched into {len(ticks)} ticks"
+    bulk = max(list(delivered_at.values()).count(t) for t in ticks)
+    assert bulk < len(keys) / 2, f"{bulk} keys released in one tick"
+
+    # offsets are key-stable: the next wave replays the same schedule
+    _, wave1 = spread.due(1000.0 + period + 0.5)
+    assert wave1 == 1
+    redelivered = {}
+    for tick in range(1, 31):
+        due, _ = spread.due(1000.0 + period + tick)
+        for k in due:
+            redelivered[k] = tick
+    for k, tick in delivered_at.items():
+        if k in redelivered:
+            assert abs(redelivered[k] - tick) <= 1, \
+                "per-key slot must be stable across waves"
+
+    # removed keys stop being scheduled; added keys join the spread
+    gone, fresh = keys[0], "default/added"
+    spread.remove_key(gone)
+    spread.add_key(fresh)
+    third = {}
+    for tick in range(0, 31):
+        due, _ = spread.due(1000.0 + 2 * period + tick)
+        for k in due:
+            third[k] = tick
+    assert gone not in third
+    assert fresh in third
+
+
+def test_resync_spread_tagged_handler_receives_wave():
+    """Handlers registering ``resync=`` get tagged (obj, wave)
+    re-deliveries; plain handlers keep update(obj, obj)."""
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    kube.services.create(make_service("tagged"))
+    factory = SharedInformerFactory(api, resync_period=0.15)
+    informer = factory.services()
+    tagged, updates = [], []
+    informer.add_event_handler(
+        resync=lambda obj, wave: tagged.append((obj.metadata.name, wave)))
+    informer.add_event_handler(
+        update=lambda old, new: updates.append(new.metadata.name))
+    stop = threading.Event()
+    factory.start(stop)
+    try:
+        assert wait_for_cache_sync(stop, informer, timeout=10.0)
+        assert wait_until(lambda: len(tagged) >= 2 and len(updates) >= 2,
+                          timeout=5.0), \
+            "both handler shapes must receive resync re-deliveries"
+        names = {n for n, _ in tagged}
+        assert names == {"tagged"}
+        waves = [w for _, w in tagged]
+        assert waves == sorted(waves), "wave numbers must be monotone"
+        assert waves[-1] > waves[0], "wave must advance across periods"
+    finally:
+        stop.set()

@@ -314,6 +314,54 @@ def test_l106_batcher_module_exempt():
     assert concurrency_lint.lint_files([batcher_py]) == []
 
 
+def test_l107_apis_in_fingerprint_fires_and_waiver_suppresses():
+    """Provider calls in fingerprint builders fire — through ``apis``
+    (L105 silent) at 13/14, bare at 22 (both rules); line 15's
+    deliberate probe is waived."""
+    assert _cfindings("l107_apis_in_fingerprint.py") == [
+        ("L107", 13), ("L107", 14), ("L105", 22), ("L107", 22)]
+
+
+def test_l107_informer_only_builders_clean():
+    assert _cfindings("l107_clean.py") == []
+
+
+def test_l107_reconcile_package_clean():
+    """The shipped fast path itself (the reconcile package: dispatch +
+    fingerprint cache) must stay provider-free under its own rule."""
+    pkg = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/reconcile")
+    files = sorted(pkg.glob("*.py"))
+    assert files, "reconcile package files not found"
+    assert concurrency_lint.lint_files(files) == []
+
+
+def test_l107_seeded_apis_call_in_shipped_builder_caught(tmp_path):
+    """Acceptance probe tied to the shipped code shape: graft an
+    ``apis`` read into the REAL GA service fingerprint builder and the
+    gate must fire."""
+    ga_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/controller/"
+        "globalaccelerator.py")
+    src = ga_py.read_text()
+    needle = "    ports, protocol = listener_for_service(svc)\n"
+    assert src.count(needle) == 1, \
+        "ga_service_fingerprint shape changed; update this probe"
+    mutated = src.replace(
+        needle,
+        needle + "    svc.apis.ga.describe_accelerator(svc.key())\n")
+    # keep the package-scope marker in the path so the rule applies
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "controller")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "globalaccelerator.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L107"]
+    assert findings, "seeded apis call in a fingerprint builder " \
+                     "was not caught"
+
+
 def test_l105_out_of_scope_paths_exempt(tmp_path):
     """Tests and tools observe the fake cloud directly by design —
     the rule only polices the shipped package (and its fixtures)."""

@@ -131,6 +131,90 @@ def test_apply_sample_files():
                      "Service", "Service", "Service", "Service"]
 
 
+def test_fastpath_and_drift_counters_exposed():
+    """The steady-state fast path's counters: fastpath skips are
+    per-controller, sweep verifies and drift repairs are global —
+    and all three render for the scrape endpoint."""
+    from aws_global_accelerator_controller_tpu.metrics import (
+        default_registry,
+        record_drift_repair,
+        record_drift_sweep_verify,
+        record_fastpath_skip,
+    )
+
+    skips = default_registry.counter_value(
+        "reconcile_fastpath_skips_total", {"controller": "m-test"})
+    verifies = default_registry.counter_value(
+        "drift_sweep_verifies_total")
+    repairs = default_registry.counter_value("drift_repairs_total")
+
+    record_fastpath_skip("m-test")
+    record_fastpath_skip("m-test")
+    record_drift_sweep_verify()
+    record_drift_repair()
+
+    assert default_registry.counter_value(
+        "reconcile_fastpath_skips_total",
+        {"controller": "m-test"}) == skips + 2
+    assert default_registry.counter_value(
+        "drift_sweep_verifies_total") == verifies + 1
+    assert default_registry.counter_value(
+        "drift_repairs_total") == repairs + 1
+
+    text = default_registry.render()
+    assert 'reconcile_fastpath_skips_total{controller="m-test"}' in text
+    assert "drift_sweep_verifies_total" in text
+    assert "drift_repairs_total" in text
+
+
+def test_fastpath_skips_accumulate_from_running_cluster():
+    """End-to-end: a short-resync cluster at steady state accumulates
+    fingerprint skips in the default registry (the counter the bench
+    and an operator watch)."""
+    from aws_global_accelerator_controller_tpu import metrics as m
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintConfig,
+    )
+
+    before = m.default_registry.counter_value(
+        "reconcile_fastpath_skips_total")
+    cluster = Cluster(resync_period=0.2,
+                      fingerprints=FingerprintConfig(
+                          sweep_every=1000)).start()
+    try:
+        hostname = "mfp-0123456789abcdef.elb.ap-northeast-1.amazonaws.com"
+        cluster.cloud.elb.register_load_balancer("mfp", hostname,
+                                                 "ap-northeast-1")
+        apply_yaml(cluster.api, f"""
+apiVersion: v1
+kind: Service
+metadata:
+  name: mfp
+  namespace: default
+  annotations:
+    {AWS_LOAD_BALANCER_TYPE_ANNOTATION}: external
+    {AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION}: "true"
+spec:
+  type: LoadBalancer
+  ports:
+    - port: 80
+      protocol: TCP
+status:
+  loadBalancer:
+    ingress:
+      - hostname: {hostname}
+""")
+        wait_until(lambda: len(cluster.cloud.ga.list_accelerators()) == 1,
+                   message="accelerator converged")
+        wait_until(
+            lambda: m.default_registry.counter_value(
+                "reconcile_fastpath_skips_total") > before,
+            message="resync re-deliveries answered by the "
+                    "fingerprint gate")
+    finally:
+        cluster.shutdown()
+
+
 def test_race_detector_counters_exposed():
     """The runtime concurrency detectors publish their activity:
     race_lockset_checks counts screened lock acquisitions (batched),

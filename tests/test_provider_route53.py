@@ -64,7 +64,9 @@ def test_ensure_creates_alias_and_txt(factory, provider):
     a = records[("www.example.com.", "A")]
     assert a.alias_target.hosted_zone_id == GLOBAL_ACCELERATOR_HOSTED_ZONE_ID
     acc = factory.cloud.ga.describe_accelerator(arn)
-    assert a.alias_target.dns_name == acc.dns_name
+    # dot-suffixed like the real API returns it (what the reference's
+    # drift check expects — a bare name would re-UPSERT forever)
+    assert a.alias_target.dns_name == acc.dns_name + "."
     txt = records[("www.example.com.", "TXT")]
     assert txt.ttl == 300
     assert txt.resource_records[0].value == route53_owner_value(
@@ -91,10 +93,22 @@ def test_ensure_multiple_hostnames_and_idempotency(factory, provider):
         make_service(), LoadBalancerIngress(hostname=HOSTNAME),
         hostnames, CLUSTER)
     assert created
+    mutations_before = sum(
+        factory.cloud.faults.call_counts().get(m, 0)
+        for m in ("change_resource_record_sets",
+                  "change_resource_record_sets_batch"))
     created2, _ = provider.ensure_route53_for_service(
         make_service(), LoadBalancerIngress(hostname=HOSTNAME),
         hostnames, CLUSTER)
     assert not created2, "second ensure must be a no-op"
+    mutations_after = sum(
+        factory.cloud.faults.call_counts().get(m, 0)
+        for m in ("change_resource_record_sets",
+                  "change_resource_record_sets_batch"))
+    assert mutations_after == mutations_before, (
+        "a converged re-ensure must issue ZERO record mutations "
+        "(the perpetual-UPSERT alias-dot bug the steady-state fast "
+        "path exposed)")
     records = record_map(factory, zone.id)
     assert ("a.example.com.", "A") in records
     assert ("b.example.com.", "A") in records
@@ -117,7 +131,7 @@ def test_ensure_repairs_alias_drift(factory, provider):
         ["www.example.com"], CLUSTER)
     acc = factory.cloud.ga.describe_accelerator(arn)
     a = record_map(factory, zone.id)[("www.example.com.", "A")]
-    assert a.alias_target.dns_name == acc.dns_name
+    assert a.alias_target.dns_name == acc.dns_name + "."
 
 
 def test_hosted_zone_parent_walk(factory, provider):
