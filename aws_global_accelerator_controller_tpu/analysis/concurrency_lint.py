@@ -66,6 +66,19 @@ zero-findings gate philosophy):
                          consults AWS would silently turn the skip
                          path back into the O(N)-per-resync cost it
                          exists to remove.  Package-scoped like L105.
+  L108 fenced mutations  Mutation-issuing paths must consult the
+                         lifecycle fence (resilience/fence.py): no
+                         AWS WRITE method may be reachable after
+                         stop/lease-loss without a fence check.  A
+                         write issued through ``apis`` is gated at
+                         runtime by ``ResilientAPIs.invoke`` — so the
+                         rule (a) requires any BARE service write to
+                         consult the fence lexically in its enclosing
+                         function, and (b) requires ``wrapper.py``'s
+                         ``invoke`` itself to carry the fence consult
+                         whenever that file is in the linted set (the
+                         seeded-mutation probe strips it and asserts
+                         the gate fires).  Package-scoped like L105.
 
 Waivers: ``# race: <reason>`` on the flagged line (the explicit,
 greppable spelling — use for contracts that are upheld non-lexically),
@@ -137,6 +150,33 @@ _COALESCED_WRITES = {
     ("route53", "change_resource_record_sets_batch"),
     ("ga", "update_endpoint_group"),
 }
+
+# Every AWS WRITE method (mutates cloud state) — the surface rule L108
+# requires a lifecycle-fence consult for.  Imported from the runtime
+# gate's own set so the lint can never silently drift from the surface
+# it polices (a write method fenced at the wrapper is exactly a write
+# method L108 checks).
+from ..resilience.wrapper import MUTATION_METHODS as _AWS_WRITE_METHODS
+
+
+def _consults_fence(fn: ast.AST) -> bool:
+    """Does this function lexically consult the lifecycle fence?  A
+    call whose receiver chain names a ``*fence*`` attribute and ends
+    in ``check``/``flush_pass`` (``self._fence.check(...)``,
+    ``fence.check(op)``, ``with self._fence.flush_pass():``), or a
+    helper whose own name contains ``fence`` (``check_fence()``)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain is None:
+            continue
+        if chain[-1] in ("check", "flush_pass") \
+                and any("fence" in seg for seg in chain[:-1]):
+            return True
+        if "fence" in chain[-1]:
+            return True
+    return False
 
 
 def _l105_in_scope(path: Path) -> bool:
@@ -333,6 +373,7 @@ class Engine:
                 self._walk_held(info, classname, fn, fn.body, [])
                 self._check_shared_views(info, fn)
         self._check_ordering_graph()
+        self._check_wrapper_fence_gate()
         suppressed = [f for f in self.findings
                       if not self._finding_waived(f)]
         return suppressed
@@ -387,6 +428,31 @@ class Engine:
             key = (held_id, lock_id)
             if key not in self.edges:
                 self.edges[key] = (info, line)
+
+    def _check_wrapper_fence_gate(self) -> None:
+        """L108's other half: every ``apis.*`` write in the tree relies
+        on ``ResilientAPIs.invoke`` consulting the fence at runtime —
+        so whenever the resilience wrapper module is part of the linted
+        set, its ``invoke`` must lexically carry the consult (the
+        seeded-mutation probe strips it and asserts this fires).  A
+        fixture subset without wrapper.py trusts the shipped one."""
+        for info in self.files:
+            if info.path.name != "wrapper.py" \
+                    or not _l105_in_scope(info.path):
+                continue
+            invokes = [fn for _, fn in self._functions(info.tree)
+                       if fn.name == "invoke"]
+            if not invokes:
+                continue
+            for fn in invokes:
+                if not _consults_fence(fn):
+                    self.findings.append(Finding(
+                        info.path, fn.lineno, "L108",
+                        "ResilientAPIs.invoke no longer consults the "
+                        "lifecycle fence: every apis.* write in the "
+                        "tree relies on this gate to reject mutations "
+                        "after stop/lease-loss "
+                        "(resilience/fence.py)"))
 
     def _check_ordering_graph(self) -> None:
         seen: Set[Tuple[str, str]] = set()
@@ -488,6 +554,23 @@ class Engine:
                 f"contract: a skip costs ZERO provider calls) — move "
                 f"the read into the sync/sweep path, or waive with "
                 f"'# race: <reason>' if this is deliberate"))
+        # L108: an AWS WRITE must be fence-gated.  Through ``apis`` the
+        # ResilientAPIs.invoke runtime gate covers it (verified by
+        # _check_wrapper_fence_gate when wrapper.py is in the set); a
+        # BARE service write needs a lexical fence consult right here.
+        if (len(chain) >= 2 and chain[-1] in _AWS_WRITE_METHODS
+                and chain[-2] in _AWS_SERVICES
+                and "apis" not in chain[:-2]
+                and _l105_in_scope(info.path)
+                and not _consults_fence(fn)):
+            self.findings.append(Finding(
+                info.path, line, "L108",
+                f"unfenced mutation '{'.'.join(chain)}()': a bare "
+                f"AWS write reachable after stop/lease-loss must "
+                f"consult the lifecycle fence (resilience/fence.py — "
+                f"call '...fence.check(...)' in this function, route "
+                f"the write through 'apis' so ResilientAPIs gates it, "
+                f"or waive with '# race: <reason>')"))
         # L102: blocking while any lock is held.
         if held and self._is_blocking(chain, held):
             self.findings.append(Finding(

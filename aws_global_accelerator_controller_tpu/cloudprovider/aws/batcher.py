@@ -59,9 +59,11 @@ import time
 from dataclasses import dataclass, replace
 from typing import Callable, Dict, List, Optional, Tuple
 
+from contextlib import nullcontext
+
 from ...analysis import locks
 from ...errors import retry_after_hint
-from ...resilience import ErrorClass, classify
+from ...resilience import ErrorClass, FencedError, classify
 from ...metrics import (
     record_flush_bisect,
     record_mutation_enqueued,
@@ -288,12 +290,20 @@ class MutationCoalescer:
     for the intent lifecycle and the error-demux contract."""
 
     def __init__(self, apis, config: Optional[CoalesceConfig] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 fence=None):
         self.apis = apis
         self.config = config or CoalesceConfig()
         self._clock = clock
         self._lock = locks.make_lock("coalescer-groups")
         self._groups: Dict[Tuple[str, str], _Group] = {}
+        # lifecycle fence (resilience/fence.py): tripped = new intents
+        # rejected at submit; lingering leaders flush immediately (the
+        # drain); sealed = flushes rejected too (fail-fast)
+        self._fence = fence
+
+    def set_fence(self, fence) -> None:
+        self._fence = fence
 
     # ------------------------------------------------------------------
     # submit surface (what provider.py calls)
@@ -335,6 +345,11 @@ class MutationCoalescer:
     def _submit(self, kind: str, key: str, payloads) -> List[_Future]:
         if not payloads:
             return []
+        # the fence gates NEW intents (L108): a stopping or deposed
+        # process enqueues nothing — rejected here, before any waiter
+        # exists, so "every waiter completes exactly once" stays true
+        if self._fence is not None:
+            self._fence.check("coalescer")
         futures = [_Future(payload) for payload in payloads]
         record_mutation_enqueued(kind, len(payloads))
         if not self.config.enabled:
@@ -385,6 +400,11 @@ class MutationCoalescer:
         with group.cond:
             deadline = self._clock() + self.config.linger
             while len(group.pending) < self.config.max_batch:
+                # a tripped fence ends the linger NOW: no new intents
+                # can arrive (submit rejects them), so waiting out the
+                # deadline would only delay the drain
+                if self._fence is not None and self._fence.is_tripped():
+                    break
                 remaining = deadline - self._clock()
                 if remaining <= 0:
                     break
@@ -398,8 +418,14 @@ class MutationCoalescer:
             group.index.clear()
             group.leader = False   # mid-flush arrivals elect the next one
             group.flushing = True
+        # the flush-pass permit lets this cohort complete through a
+        # TRIPPED (draining) fence; a SEALED fence still rejects at
+        # the wrapper and the cohort fails fast with FencedError
+        fence_pass = (self._fence.flush_pass()
+                      if self._fence is not None else nullcontext())
         try:
-            self._flush(group, intents)
+            with fence_pass:
+                self._flush(group, intents)
         except BaseException as e:  # belt: _flush demuxes its own errors
             for it in intents:
                 for future in it.futures:
@@ -424,6 +450,57 @@ class MutationCoalescer:
                     if self._groups.get((group.kind, group.key)) \
                             is group:
                         del self._groups[(group.kind, group.key)]
+
+    # ------------------------------------------------------------------
+    # ordered-stop drain
+    # ------------------------------------------------------------------
+
+    def drain(self, timeout: float) -> bool:
+        """Shutdown phase 2 (manager/manager.py ``ManagerHandle.stop``):
+        with the fence already TRIPPED (no new intents), wake every
+        lingering leader so pending cohorts flush immediately, and wait
+        until every group is idle — pending empty, no leader, nothing
+        on the wire.  Past ``timeout``, fail-fast whatever remains:
+        each leftover intent's waiters get :class:`FencedError`, so no
+        future is ever left hanging (completed exactly once either
+        way).  Returns True when everything flushed cleanly.
+
+        Deliberately on the REAL clock (not the injectable
+        ``self._clock``): this loop sleeps real time between polls, so
+        a fake-clock coalescer draining against a wedged flush would
+        otherwise never reach its deadline."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                groups = list(self._groups.values())
+            busy = False
+            for group in groups:
+                with group.cond:
+                    if group.pending or group.leader or group.flushing:
+                        busy = True
+                        group.cond.notify_all()   # cut the linger short
+            if not busy:
+                return True
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.002)
+        failed = 0
+        exc = FencedError("shutdown drain deadline exceeded",
+                          self._fence.token if self._fence else 0,
+                          sealed=False)
+        for group in groups:
+            with group.cond:
+                intents = list(group.pending)
+                del group.pending[:]
+                group.index.clear()
+                for it in intents:
+                    for future in it.futures:
+                        if not future.event.is_set():
+                            future.fail(exc)
+                            failed += 1
+        logger.warning("coalescer drain deadline: failed %d pending "
+                       "waiter(s) fast", failed)
+        return False
 
     # ------------------------------------------------------------------
     # flush + error demultiplexing
@@ -494,6 +571,7 @@ class MutationCoalescer:
         multi-change batch bisects so one poisoned change fails alone —
         its waiters get the real error, everyone else's half commits."""
         if (len(intents) == 1 or retry_after_hint(exc) > 0
+                or isinstance(exc, FencedError)
                 or classify(exc) is ErrorClass.NOT_FOUND):
             for it in intents:
                 for future in it.futures:

@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from ...analysis import locks
-from ...resilience import ResilienceConfig, ResilientAPIs
+from ...resilience import MutationFence, ResilienceConfig, ResilientAPIs
 from ...resilience.wrapper import FAKE_CLOUD_CONFIG
 from .api import AWSAPIs
 from .batcher import (
@@ -62,6 +62,22 @@ class CloudFactory:
         # control plane as every other region's.
         self._coalesce = coalesce or CoalesceConfig()
         self._coalescer: "MutationCoalescer | None" = None
+        # ONE lifecycle fence for the whole factory (resilience/fence.py)
+        # — wired into the coalescer and every region's wrapper as they
+        # are built below.  The ordered stop and the elector's
+        # lease-loss path trip/seal it; the elector RE-ARMS this same
+        # object per leadership term (fence.arm, token = the lease's
+        # transitions count).  Starts armed at token 0 for
+        # non-leader-elect runs.
+        self.fence = MutationFence()
+
+    def drain_mutations(self, timeout: float) -> bool:
+        """Flush (or, past ``timeout``, fail-fast) every pending
+        coalescer cohort — shutdown phase 2; True = drained cleanly.
+        A factory that never built a provider has nothing to drain."""
+        with self._lock:
+            coalescer = self._coalescer
+        return coalescer.drain(timeout) if coalescer is not None else True
 
     def provider_for(self, region: str) -> AWSProvider:
         with self._lock:
@@ -71,9 +87,11 @@ class CloudFactory:
                 if self._resilience.enabled:
                     apis = ResilientAPIs(apis, region=region,
                                          config=self._resilience)
+                    apis.fence = self.fence
                 if self._coalescer is None:
                     self._coalescer = MutationCoalescer(
-                        apis, config=self._coalesce)
+                        apis, config=self._coalesce,
+                        fence=self.fence)
                 provider = AWSProvider(
                     apis,
                     delete_poll_interval=self._poll_interval,
@@ -102,7 +120,8 @@ class FakeCloudFactory(CloudFactory):
                  accelerator_not_found_retry: float = 0.2,
                  resilience: Optional[ResilienceConfig] = None,
                  fault_seed: Optional[int] = None,
-                 coalesce: Optional[CoalesceConfig] = None):
+                 coalesce: Optional[CoalesceConfig] = None,
+                 cloud: Optional[AWSAPIs] = None):
         # fast resilience profile by default: real backoff shapes at
         # 100x speed, breaker thresholds the ordinary one-shot fault
         # tests never trip (chaos tests pass tighter configs); same
@@ -111,8 +130,11 @@ class FakeCloudFactory(CloudFactory):
                          accelerator_not_found_retry,
                          resilience=resilience or FAKE_CLOUD_CONFIG,
                          coalesce=coalesce or FAKE_COALESCE_CONFIG)
-        self.cloud = FakeAWSCloud(settle_seconds=settle_seconds,
-                                  fault_seed=fault_seed)
+        # ``cloud`` lets a FRESH factory adopt an EXISTING fake cloud —
+        # the crash-restart shape: new process state (empty discovery
+        # caches, cold fingerprints, new fence) over the same AWS world
+        self.cloud = cloud if cloud is not None else FakeAWSCloud(
+            settle_seconds=settle_seconds, fault_seed=fault_seed)
 
     def _make_apis(self, region: str) -> AWSAPIs:
         return self.cloud

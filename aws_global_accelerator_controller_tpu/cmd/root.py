@@ -270,16 +270,22 @@ def run_controller(args) -> int:
             # readiness concern (standby replicas must be Ready)
             health.add_ready_probe("informers", handle.informers_synced)
         leader_stop.wait()
-        # graceful shutdown: let controllers drain queues + join workers,
-        # then flush async event recording (EventBroadcaster) so events
-        # from final reconciles reach the API before exit
-        handle.join(timeout=10.0)
-        kube.flush_events(timeout=5.0)
+        # ordered, fenced shutdown: fence new mutation intents, drain
+        # the write coalescer, seal, drain workqueues + join workers,
+        # flush events — all under one deadline (manager/manager.py).
+        # The lease is released LAST, by the elector's own finally.
+        handle.stop(deadline=10.0)
 
     try:
         if args.leader_elect:
+            # the elector arms the factory's mutation fence per
+            # leadership term (token = lease_transitions) and seals it
+            # on loss BEFORE the callback below exits the process — a
+            # deposed replica's queued mutations are rejected, never
+            # issued concurrently with the successor's
             le = LeaderElection("aws-global-accelerator-controller",
-                                namespace, kube)
+                                namespace, kube,
+                                fence=cloud_factory.fence)
             le.run(stop, on_started_leading=run_manager,
                    on_stopped_leading=lambda: os._exit(0))
             if le.run_failed:

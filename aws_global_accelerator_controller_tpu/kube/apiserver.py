@@ -25,11 +25,17 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from ..errors import AdmissionDeniedError, ConflictError, NotFoundError
+from .chaos import NULL_CHAOS, KubeChaos
 from .objects import KubeObject
 
 WATCH_ADDED = "ADDED"
 WATCH_MODIFIED = "MODIFIED"
 WATCH_DELETED = "DELETED"
+# Stream-death marker (the fake broadcaster's 410-Gone analogue): a
+# subscriber receiving one has been detached — events after it are
+# MISSED until the consumer relists (kube/informers.py heals these by
+# diffing its cache against a fresh list; kube/chaos.py injects them).
+WATCH_ERROR = "ERROR"
 
 # uid source: one random prefix per process + a counter.  uuid4() costs
 # an os.urandom syscall per object, measurably hot in the create storm
@@ -119,13 +125,23 @@ class Broadcaster:
         for q in subs:
             q.put(event)
 
+    def detach_all(self) -> List[queue_mod.Queue]:
+        """Unsubscribe every current subscriber and return their
+        queues (the chaos watch-drop / partition primitive: events
+        published after this are missed by all of them)."""
+        with self._lock:
+            subs = list(self._subs)
+            self._subs.clear()
+        return subs
+
 
 class ResourceStore:
     """One kind's store: CRUD + watch. Keys are 'namespace/name'."""
 
     def __init__(self, kind: str, rv_source: Callable[[], int],
                  admission: Optional[Callable] = None,
-                 schema_validator: Optional[Callable] = None):
+                 schema_validator: Optional[Callable] = None,
+                 chaos=NULL_CHAOS):
         self.kind = kind
         self._next_rv = rv_source
         self._objects: Dict[str, KubeObject] = {}
@@ -136,6 +152,11 @@ class ResourceStore:
         # schema_validator(obj) raises InvalidObjectError (CRD structural
         # schema enforcement, like the real apiserver)
         self._schema_validator = schema_validator
+        # kube-plane fault injection (kube/chaos.py); NULL_CHAOS is the
+        # zero-overhead default, FakeAPIServer.arm_chaos swaps it live
+        self._chaos = chaos
+        # watch streams detached by partition_watch, pending heal
+        self._partitioned: List[queue_mod.Queue] = []
 
     # -- helpers --------------------------------------------------------
 
@@ -147,10 +168,42 @@ class ResourceStore:
     def _publish(self, type_: str, obj: KubeObject) -> None:
         self._broadcaster.publish(
             WatchEvent(type_, obj.deep_copy(), obj.metadata.resource_version))
+        if self._chaos.decide_drop(self.kind):
+            self._drop_all_watches()
+
+    def _error_event(self) -> WatchEvent:
+        return WatchEvent(WATCH_ERROR, None, 0)
+
+    def _drop_all_watches(self) -> None:
+        """Kill every current watch stream: each subscriber gets one
+        ERROR marker (its signal to relist) and is detached, so events
+        published before it reconnects are genuinely missed."""
+        for q in self._broadcaster.detach_all():
+            q.put(self._error_event())
+
+    def partition_watch(self) -> int:
+        """Deterministic chaos: silently detach every subscriber (no
+        ERROR marker yet — events simply stop arriving, like a dead
+        TCP stream nobody has noticed).  Returns how many streams were
+        cut; ``heal_watch`` later delivers the markers."""
+        with self._lock:
+            cut = self._broadcaster.detach_all()
+            self._partitioned.extend(cut)
+            return len(cut)
+
+    def heal_watch(self) -> None:
+        """End a partition: every detached subscriber receives its
+        ERROR marker now, triggering the consumer-side relist that
+        must surface whatever changed during the partition."""
+        with self._lock:
+            cut, self._partitioned = self._partitioned, []
+        for q in cut:
+            q.put(self._error_event())
 
     # -- CRUD -----------------------------------------------------------
 
     def create(self, obj: KubeObject) -> KubeObject:
+        self._chaos.check("create", self.kind)
         if self._schema_validator is not None:
             self._schema_validator(obj)
         if self._admission is not None:
@@ -171,6 +224,7 @@ class ResourceStore:
             return obj.deep_copy()
 
     def get(self, namespace: str, name: str) -> KubeObject:
+        self._chaos.check("get", self.kind)
         with self._lock:
             key = f"{namespace}/{name}"
             obj = self._objects.get(key)
@@ -179,6 +233,7 @@ class ResourceStore:
             return obj.deep_copy()
 
     def list(self, namespace: Optional[str] = None) -> List[KubeObject]:
+        self._chaos.check("list", self.kind)
         with self._lock:
             objs = [o.deep_copy() for o in self._objects.values()
                     if namespace is None or o.metadata.namespace == namespace]
@@ -191,6 +246,7 @@ class ResourceStore:
         ``bump_generation`` defaults to spec updates bumping generation and
         status updates (``status_only``) leaving it, like the apiserver.
         """
+        self._chaos.check("update", self.kind)
         if self._schema_validator is not None and not status_only:
             self._schema_validator(obj)
         if self._admission is not None and not status_only:
@@ -244,6 +300,7 @@ class ResourceStore:
         return (getattr(old, "spec", None) != getattr(new, "spec", None))
 
     def delete(self, namespace: str, name: str) -> None:
+        self._chaos.check("delete", self.kind)
         with self._lock:
             key = f"{namespace}/{name}"
             obj = self._objects.get(key)
@@ -302,6 +359,17 @@ class FakeAPIServer:
 
     def store(self, kind: str) -> ResourceStore:
         return self.stores[kind]
+
+    def arm_chaos(self, seed: Optional[int] = None) -> KubeChaos:
+        """Swap the zero-overhead null injector for a live seeded
+        :class:`~.chaos.KubeChaos` across every store (idempotent:
+        re-arming replaces the schedule).  Explicit on purpose — the
+        hot create-storm path must not pay injector bookkeeping when
+        no chaos suite armed it."""
+        self.chaos = KubeChaos(seed)
+        for store in self.stores.values():
+            store._chaos = self.chaos
+        return self.chaos
 
     def register_validating_webhook(self, kind: str, url: str,
                                     operations=("CREATE", "UPDATE")) -> None:

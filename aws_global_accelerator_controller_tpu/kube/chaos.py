@@ -1,0 +1,226 @@
+"""Kube-plane chaos: seeded fault injection for the fake API server.
+
+PR 3's chaos engine (cloudprovider/aws/fake.py ``FaultInjector``)
+proved the controllers converge through an AWS-side storm — but the
+kube plane itself was still assumed perfect: list/update never fail,
+watch streams never drop, resourceVersion conflicts never storm.  This
+module is the same seeded, deterministic model pointed at the OTHER
+side of the controller: the :class:`KubeChaos` injector hooks every
+``ResourceStore`` CRUD call and the watch broadcaster
+(kube/apiserver.py), so the informers' relist recovery
+(kube/informers.py), the elector's renew-failure handling
+(leaderelection/elector.py) and the controllers' conflict retries run
+against the failure modes a real apiserver actually produces:
+
+- ``set_error_rate``: per-op (``list``/``get``/``create``/``update``/
+  ``delete`` or ``'*'``) probabilistic failures, optionally per kind.
+  The decision for call #k of ``kind:op`` is a pure function of
+  ``(seed, salt, kind:op, k)`` — same seed, same per-op call sequence,
+  same injected faults, across processes (the cloud injector's
+  determinism contract, kept verbatim).
+- ``set_conflict_rate``: resourceVersion conflict storms — ``update``
+  calls answer :class:`~..errors.ConflictError` before touching state,
+  the shape an optimistic-concurrency race produces (the elector's CAS
+  and the controllers' status writes must absorb these).
+- ``set_latency``: fixed added latency per op (slept outside the lock).
+- ``set_watch_drop_rate`` / ``drop_watches``: watch-stream death.  A
+  dropped subscriber receives one ``ERROR``-typed event (the 410-Gone
+  analogue — the fake broadcaster has no resumable history, so every
+  drop implies a relist) and is unsubscribed: everything published
+  while the informer runs its relist is MISSED, exactly the gap the
+  relist's cache-vs-fresh-list diff must close.
+- ``partition_watches`` / ``heal_watches``: the deterministic form for
+  tests — partition silently detaches every subscriber (events flow
+  into the void), heal delivers the ERROR marker so the informers
+  relist; whatever changed in between is the missed-while-disconnected
+  delta the regression tests assert on.
+
+Injected faults never mutate store state (a failed call "never
+happened"); counts are observable via ``call_counts`` /
+``injected_counts`` like the cloud injector's.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Callable, Dict, Optional, Tuple
+
+from ..errors import ConflictError
+
+# Store operations the injector screens (ResourceStore CRUD surface).
+OPS = ("list", "get", "create", "update", "delete")
+
+
+class KubeChaos:
+    """Seeded fault schedule for the fake apiserver's stores + watches.
+
+    One injector per :class:`~.apiserver.FakeAPIServer`; every store
+    calls ``check(op, kind)`` before touching state and
+    ``decide_drop(kind)`` after publishing a watch event.
+    """
+
+    def __init__(self, seed: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._seed = seed
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._calls: Dict[str, int] = {}
+        self._injected: Dict[str, int] = {}
+        # "kind:op" (kind may be '*') -> (rate, exc factory)
+        self._error_rates: Dict[str, Tuple[float,
+                                           Callable[[], Exception]]] = {}
+        self._conflict_rates: Dict[str, float] = {}
+        self._latency: Dict[str, float] = {}
+        self._drop_rates: Dict[str, float] = {}
+
+    # -- schedule -------------------------------------------------------
+
+    def reseed(self, seed: int) -> None:
+        with self._lock:
+            self._seed = seed
+
+    def set_error_rate(self, op: str, rate: float, kind: str = "*",
+                       exc: Optional[Callable[[], Exception]] = None,
+                       ) -> None:
+        """Fail ``op`` (or ``'*'``) on ``kind`` (or ``'*'``) with
+        probability ``rate``; 0 clears.  The default exception is a
+        ``RuntimeError`` — what the HTTP backend surfaces for an
+        apiserver 5xx, and what the informers' list+watch retry and
+        the elector's ``_attempt`` already classify as transient."""
+        key = f"{kind}:{op}"
+        with self._lock:
+            if rate <= 0.0:
+                self._error_rates.pop(key, None)
+            else:
+                self._error_rates[key] = (
+                    rate, exc or (lambda: RuntimeError(
+                        "chaos: apiserver 5xx (injected)")))
+
+    def set_conflict_rate(self, rate: float, kind: str = "*") -> None:
+        """resourceVersion conflict storm: ``update`` calls raise
+        :class:`ConflictError` with probability ``rate`` before any
+        state is touched; 0 clears."""
+        with self._lock:
+            if rate <= 0.0:
+                self._conflict_rates.pop(kind, None)
+            else:
+                self._conflict_rates[kind] = rate
+
+    def set_latency(self, op: str, seconds: float,
+                    kind: str = "*") -> None:
+        """Add fixed latency to ``op`` (or ``'*'``); 0 clears."""
+        key = f"{kind}:{op}"
+        with self._lock:
+            if seconds <= 0.0:
+                self._latency.pop(key, None)
+            else:
+                self._latency[key] = seconds
+
+    def set_watch_drop_rate(self, rate: float, kind: str = "*") -> None:
+        """After each published watch event of ``kind``, drop EVERY
+        subscriber with probability ``rate`` (seeded per publish
+        index): each receives one ERROR event and is detached, so the
+        events between the drop and its relist are genuinely missed."""
+        with self._lock:
+            if rate <= 0.0:
+                self._drop_rates.pop(kind, None)
+            else:
+                self._drop_rates[kind] = rate
+
+    # -- observability --------------------------------------------------
+
+    def call_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._calls)
+
+    def injected_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._injected)
+
+    # -- the hooks (called by apiserver.ResourceStore) ------------------
+
+    def _decide(self, salt: str, key: str, index: int,
+                rate: float) -> bool:
+        """Deterministic per-(seed, salt, key, index) coin flip — the
+        cloud injector's contract (crc32, not hash(): str hashes are
+        randomized per process and the determinism contract is
+        cross-process)."""
+        if rate >= 1.0:
+            return True
+        if self._seed is None:
+            import random
+            return random.random() < rate
+        draw = zlib.crc32(
+            f"{self._seed}:{salt}:{key}:{index}".encode())
+        return draw / 2**32 < rate
+
+    def check(self, op: str, kind: str) -> None:
+        """Screen one store call; an injected fault means the call
+        never happened.  Decision + counting under the lock; the
+        latency sleep and the raise outside it."""
+        key = f"{kind}:{op}"
+        with self._lock:
+            index = self._calls.get(key, 0)
+            self._calls[key] = index + 1
+            delay = self._latency.get(key,
+                                      self._latency.get(f"*:{op}", 0.0))
+            exc: Optional[Exception] = None
+            if op == "update":
+                rate = self._conflict_rates.get(
+                    kind, self._conflict_rates.get("*", 0.0))
+                if rate > 0.0 and self._decide("conflict", key, index,
+                                               rate):
+                    exc = ConflictError(
+                        f"chaos: injected resourceVersion conflict "
+                        f"on {kind}")
+            if exc is None:
+                hit = self._error_rates.get(key) \
+                    or self._error_rates.get(f"*:{op}") \
+                    or self._error_rates.get(f"{kind}:*") \
+                    or self._error_rates.get("*:*")
+                if hit is not None and self._decide("rate", key, index,
+                                                    hit[0]):
+                    exc = hit[1]()
+            if exc is not None:
+                self._injected[key] = self._injected.get(key, 0) + 1
+        if delay > 0.0:
+            time.sleep(delay)
+        if exc is not None:
+            raise exc
+
+    def decide_drop(self, kind: str) -> bool:
+        """Called by the store after publishing one watch event:
+        True means every current subscriber's stream dies now (they
+        receive the ERROR marker and are detached)."""
+        with self._lock:
+            rate = self._drop_rates.get(
+                kind, self._drop_rates.get("*", 0.0))
+            if rate <= 0.0:
+                return False
+            key = f"{kind}:watch"
+            index = self._calls.get(key, 0)
+            self._calls[key] = index + 1
+            if self._decide("drop", key, index, rate):
+                self._injected[key] = self._injected.get(key, 0) + 1
+                return True
+            return False
+
+
+class _NullChaos:
+    """Zero-overhead default: the fake apiserver carries one of these
+    when no chaos schedule is armed (no lock, no counting)."""
+
+    def check(self, op: str, kind: str) -> None:
+        pass
+
+    def decide_drop(self, kind: str) -> bool:
+        return False
+
+
+NULL_CHAOS = _NullChaos()
+
+
+# the deterministic partition/heal pair lives on the store (it needs
+# the broadcaster's subscriber list), re-exported here for discovery:
+__all__ = ["KubeChaos", "NULL_CHAOS", "OPS"]

@@ -47,7 +47,12 @@ from ..apis.endpointgroupbinding.v1alpha1 import (
     EndpointGroupBinding,
 )
 from ..errors import AdmissionDeniedError, ConflictError, NotFoundError
-from .apiserver import WATCH_ADDED, WATCH_DELETED, WatchEvent
+from .apiserver import (
+    WATCH_ADDED,
+    WATCH_DELETED,
+    WATCH_MODIFIED,
+    WatchEvent,
+)
 from .kubeconfig import RestConfig, rfc3339_to_epoch
 from .objects import Event, Ingress, Lease, LeaseSpec, ObjectMeta, Service
 
@@ -586,15 +591,28 @@ class _Watcher:
 
     def _relist(self) -> None:
         """Replace-semantics recovery after a 410: deliver the gap as
-        synthetic events computed against what subscribers last saw."""
+        synthetic events DIFFED against what subscribers last saw —
+        DELETED for vanished objects, ADDED for new ones, MODIFIED
+        where the resourceVersion moved.  Objects unchanged through
+        the gap deliver nothing: re-announcing the whole fleet would
+        invalidate every subscriber's fingerprint gate and turn each
+        410 into a spurious full-fleet reconcile burst."""
+        from ..metrics import record_watch_relist
+
         current, rv = _list_with_rv(self._client, self._codec)
         for key, old in list(self._objs.items()):
             if key not in current:
                 self._deliver(WATCH_DELETED, old)
-        for obj in current.values():
-            self._deliver(WATCH_ADDED, obj)
+        for key, obj in current.items():
+            prev = self._objs.get(key)
+            if prev is None:
+                self._deliver(WATCH_ADDED, obj)
+            elif (prev.metadata.resource_version
+                    != obj.metadata.resource_version):
+                self._deliver(WATCH_MODIFIED, obj)
         if rv:
             self._rv = rv
+        record_watch_relist(self._codec.kind)
 
     def _deliver(self, etype: str, obj) -> None:
         if etype == WATCH_DELETED:

@@ -51,10 +51,11 @@ from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..analysis import freezeproxy, locks
 from ..errors import NotFoundError
-from ..metrics import record_index_lookup
+from ..metrics import record_index_lookup, record_watch_relist
 from .apiserver import (
     WATCH_ADDED,
     WATCH_DELETED,
+    WATCH_ERROR,
     WATCH_MODIFIED,
     ResourceStore,
 )
@@ -322,24 +323,25 @@ class Informer:
         except Exception:
             logger.exception("informer handler error (%s)", self.kind)
 
-    def _loop(self, stop: threading.Event) -> None:
-        # Subscribe BEFORE listing so no event between list and watch is
-        # lost.  Over the HTTP backend both calls hit the network; an
-        # apiserver that is down AT INFORMER STARTUP must mean retry,
-        # not a dead informer thread (the same failure class the
-        # elector's _attempt guards — see leaderelection/elector.py).
-        listed = None
+    def _list_and_watch(self, stop: threading.Event):
+        """Subscribe BEFORE listing so no event between list and watch
+        is lost, retrying until it works or stop fires.  Over the HTTP
+        backend both calls hit the network; an apiserver that is down
+        (at startup OR at a mid-life relist) must mean retry, not a
+        dead informer thread (the same failure class the elector's
+        _attempt guards — see leaderelection/elector.py).  Returns the
+        fresh list, or None when stopped first; ``self._watch_q`` is
+        the matching fresh subscription."""
         delay = 1.0
         while not stop.is_set():
             try:
                 self._watch_q = self._store.watch()
                 try:
-                    listed = self._store.list()
+                    return self._store.list()
                 except Exception:
                     self._store.stop_watch(self._watch_q)
                     self._watch_q = None
                     raise
-                break
             except Exception as e:
                 logger.warning(
                     "informer %s list+watch failed: %s; retrying",
@@ -350,6 +352,10 @@ class Informer:
                 # would re-topple it
                 stop.wait(delay * random.uniform(0.8, 1.2))
                 delay = min(delay * 2, 30.0)
+        return None
+
+    def _loop(self, stop: threading.Event) -> None:
+        listed = self._list_and_watch(stop)
         if listed is None:      # stopped before ever syncing
             return
         try:
@@ -371,6 +377,14 @@ class Informer:
                 except queue_mod.Empty:
                     event = None
                 if event is not None:
+                    if event.type == WATCH_ERROR:
+                        # the stream died (kube chaos drop / partition
+                        # heal — the fake plane's 410 Gone): everything
+                        # published while detached was missed, so heal
+                        # by diffing the cache against a fresh list
+                        if not self._relist(stop, spread):
+                            return          # stopped mid-recovery
+                        continue
                     key = event.obj.key()
                     self._handle_event(event)
                     # keep the spread's schedule in step with the
@@ -383,6 +397,66 @@ class Informer:
                 self._resync_due(spread)
         finally:
             self._store.stop_watch(self._watch_q)
+
+    def _relist(self, stop: threading.Event,
+                spread: _ResyncSpread) -> bool:
+        """Heal a dropped watch stream: resubscribe + full list, then
+        diff the old cache against the fresh list into synthetic
+        ADD/UPDATE/DELETE deltas.
+
+        The deltas go through the ordinary handler dispatch, so a
+        change missed while disconnected invalidates its fingerprint
+        gate exactly like a live watch event would (the controllers'
+        update/delete handlers call ``note_event`` — a stale skip
+        cannot survive a relist); objects whose resourceVersion is
+        unchanged dispatch NOTHING, so a relist over an idle fleet
+        costs no spurious invalidation and no reconcile burst.
+        Returns False when stop fired before recovery completed."""
+        old_q = self._watch_q
+        listed = self._list_and_watch(stop)
+        if old_q is not None:
+            self._store.stop_watch(old_q)   # detached already; tidy up
+        if listed is None:
+            return False
+        fresh = {obj.key(): obj for obj in listed}
+        with self._cache_lock:
+            old_objs = dict(self._cache)
+            for key, obj in fresh.items():
+                self._apply_locked(key, obj)
+            for key in old_objs:
+                if key not in fresh:
+                    self._apply_locked(key, None)
+        adds, updates, deletes = [], [], []
+        for key, obj in fresh.items():
+            old = old_objs.get(key)
+            if old is None:
+                adds.append(obj)
+            elif (old.metadata.resource_version
+                    != obj.metadata.resource_version):
+                updates.append((old, obj))
+        for key, old in old_objs.items():
+            if key not in fresh:
+                deletes.append(old)
+        # dispatch outside the cache lock, in delete -> add -> update
+        # order (a deleted-and-recreated name surfaces as its delete
+        # first, like a replayed watch stream would order it)
+        for old in deletes:
+            spread.remove_key(old.key())
+            for h in self._handlers:
+                self._dispatch(h.delete, old)
+        for obj in adds:
+            spread.add_key(obj.key())
+            for h in self._handlers:
+                self._dispatch(h.add, obj)
+        for old, obj in updates:
+            for h in self._handlers:
+                self._dispatch(h.update, old, obj)
+        record_watch_relist(self.kind)
+        logger.info(
+            "informer %s relisted after watch drop: +%d ~%d -%d "
+            "(unchanged %d)", self.kind, len(adds), len(updates),
+            len(deletes), len(fresh) - len(adds) - len(updates))
+        return True
 
     def _handle_event(self, event) -> None:
         key = event.obj.key()

@@ -227,6 +227,15 @@ class KubeRestServer:
                     event = q.get(timeout=0.2)
                 except Exception:
                     continue
+                if event.obj is None:
+                    # kube-chaos stream drop (apiserver.WATCH_ERROR):
+                    # this mirror was detached — resubscribe so the
+                    # replay history keeps following the store.  HTTP
+                    # watchers resuming across the gap heal via their
+                    # own 410/relist path (http_store._Watcher).
+                    store.stop_watch(q)
+                    q = self._queues[kind] = store.watch()
+                    continue
                 self._states[kind].append(
                     event.type, codec.to_wire(event.obj),
                     event.resource_version)

@@ -18,16 +18,31 @@ logger = logging.getLogger(__name__)
 LEASE_DURATION = 60.0
 RENEW_DEADLINE = 15.0
 RETRY_PERIOD = 5.0
+# how long a stopping elector waits for the leader run callback (the
+# manager's ordered drain) before releasing the lease anyway — must
+# comfortably cover ManagerHandle.stop's 10s default deadline
+RELEASE_JOIN_TIMEOUT = 30.0
 
 
 class LeaderElection:
-    """One candidate for a named Lease in a namespace."""
+    """One candidate for a named Lease in a namespace.
+
+    ``fence`` (resilience/fence.py :class:`MutationFence`) is the
+    lease-fenced-writes contract: becoming leader ARMS it with the
+    lease's ``lease_transitions`` as the fencing token (monotone per
+    term — a cross-process observer can order terms by it), and losing
+    the lease — renewals failing past the renew deadline, or the CAS
+    lost to a takeover — SEALS it before the lost-leadership callback
+    fires, so a deposed leader's queued mutations are rejected at the
+    write chokepoints instead of landing concurrently with the new
+    leader's."""
 
     def __init__(self, name: str, namespace: str, kube_client: KubeClient,
                  lease_duration: float = LEASE_DURATION,
                  renew_deadline: float = RENEW_DEADLINE,
                  retry_period: float = RETRY_PERIOD,
-                 identity: Optional[str] = None):
+                 identity: Optional[str] = None,
+                 fence=None):
         self.name = name
         self.namespace = namespace
         self.kube = kube_client
@@ -35,11 +50,21 @@ class LeaderElection:
         self.renew_deadline = renew_deadline
         self.retry_period = retry_period
         self.identity = identity or str(uuid.uuid4())
+        self.fence = fence
         self.is_leader = threading.Event()
         # set when the on_started_leading callback raised: the process
         # should exit non-zero instead of reporting a clean shutdown
         self.run_failed = False
         self._observed_holder = ""
+        # the transitions count observed when we last held the lease
+        # (the fencing token of the current term)
+        self._observed_transitions = 0
+        # another candidate's CAS took the lease while we were leading
+        self._deposed = False
+        # we stepped down mid-life: the next acquisition is a NEW term
+        # (bump lease_transitions even when the holder field still
+        # names us, so the fencing token stays monotone)
+        self._stepped_down = False
 
     # -- lock primitives ------------------------------------------------
 
@@ -63,14 +88,27 @@ class LeaderElection:
         try:
             lease = self.kube.leases.get(self.namespace, self.name)
         except NotFoundError:
+            # re-creating a lease is a NEW CAS generation whenever we
+            # have any history — a step-down gap, an active term whose
+            # lease an operator deleted, or a previously observed
+            # count — so the fencing token stays monotone across the
+            # gap; only a genuinely fresh candidate starts at 0
+            transitions = (self._observed_transitions + 1
+                           if (self._stepped_down
+                               or self.is_leader.is_set()
+                               or self._observed_transitions)
+                           else 0)
             lease = Lease(
                 metadata=ObjectMeta(name=self.name, namespace=self.namespace),
                 spec=LeaseSpec(
                     holder_identity=self.identity,
                     lease_duration_seconds=int(self.lease_duration),
-                    acquire_time=now, renew_time=now, lease_transitions=0))
+                    acquire_time=now, renew_time=now,
+                    lease_transitions=transitions))
             try:
                 self.kube.leases.create(lease)
+                self._stepped_down = False
+                self._observed_transitions = transitions
                 return True
             except ConflictError:
                 return False
@@ -78,13 +116,19 @@ class LeaderElection:
         holder = lease.spec.holder_identity
         if holder and holder != self.identity:
             if now < lease.spec.renew_time + self.lease_duration:
+                if self.is_leader.is_set():
+                    # we believed we were leading but another
+                    # candidate's CAS holds an unexpired claim: we were
+                    # deposed — the lead loop must step down NOW, not
+                    # after burning the rest of the renew deadline
+                    self._deposed = True
                 if holder != self._observed_holder:
                     logger.info("new leader elected: %s", holder)
                     self._observed_holder = holder
                 return False
             logger.info("lease expired (holder %s), taking over", holder)
 
-        taking_over = holder != self.identity
+        taking_over = holder != self.identity or self._stepped_down
         lease.spec.holder_identity = self.identity
         lease.spec.renew_time = now
         if taking_over:
@@ -92,6 +136,8 @@ class LeaderElection:
             lease.spec.lease_transitions += 1
         try:
             self.kube.leases.update(lease)
+            self._stepped_down = False
+            self._observed_transitions = lease.spec.lease_transitions
             return True
         except (ConflictError, NotFoundError):
             return False
@@ -116,21 +162,51 @@ class LeaderElection:
 
         The run callback receives a *leader* stop event that is set when
         either the process stops or leadership is lost
-        (leaderelection.go:58-82).
+        (leaderelection.go:58-82).  A candidate that LOSES leadership
+        (renewals failing past the renew deadline, or the CAS lost to
+        a takeover) steps down — fence sealed, lost-leadership callback
+        fired — and re-enters this acquire loop as a standby; only the
+        process stop event ends the run.
         """
         logger.info("leader election id: %s", self.identity)
         try:
             while not stop.is_set():
                 if self._attempt():
-                    self._lead(stop, on_started_leading, on_stopped_leading)
-                    return
+                    lost = self._lead(stop, on_started_leading,
+                                      on_stopped_leading)
+                    if not lost:
+                        return          # process stop: run() is done
+                    logger.info("standby after leadership loss: %s",
+                                self.identity)
                 stop.wait(self.retry_period)
         finally:
             if self.is_leader.is_set():
                 self._release()
 
-    def _lead(self, stop, on_started_leading, on_stopped_leading) -> None:
-        logger.info("became leader: %s", self.identity)
+    def _step_down(self, leader_stop: threading.Event,
+                   on_stopped_leading, why: str) -> None:
+        """Ordered loss-of-leadership: seal the fence FIRST (no queued
+        mutation may land after this instant — the successor's writes
+        must never interleave with ours), then withdraw the leader
+        claim and fire the callback."""
+        logger.warning("leader lost (%s): %s", why, self.identity)
+        if self.fence is not None:
+            self.fence.seal(f"lease lost: {why}")
+        self._stepped_down = True
+        self.is_leader.clear()
+        leader_stop.set()
+        if on_stopped_leading is not None:
+            on_stopped_leading()
+
+    def _lead(self, stop, on_started_leading, on_stopped_leading) -> bool:
+        """Lead until the process stops (returns False) or leadership
+        is lost (steps down, returns True so ``run`` re-enters the
+        acquire loop)."""
+        logger.info("became leader: %s (term %d)", self.identity,
+                    self._observed_transitions)
+        self._deposed = False
+        if self.fence is not None:
+            self.fence.arm(self._observed_transitions)
         self.is_leader.set()
         leader_stop = threading.Event()
 
@@ -156,19 +232,37 @@ class LeaderElection:
         last_renew = time.monotonic()
         try:
             while not stop.is_set():
-                if self._attempt():
+                if self._attempt() and not self._deposed:
                     last_renew = time.monotonic()
-                elif time.monotonic() - last_renew > self.renew_deadline:
-                    logger.warning("leader lost: %s", self.identity)
-                    self.is_leader.clear()
-                    leader_stop.set()
-                    if on_stopped_leading is not None:
-                        on_stopped_leading()
-                    return
+                elif self._deposed:
+                    self._step_down(leader_stop, on_stopped_leading,
+                                    "lease taken over by another "
+                                    "candidate")
+                    return True
+                elif (time.monotonic() - last_renew
+                        > self.renew_deadline):
+                    self._step_down(leader_stop, on_stopped_leading,
+                                    "renewals failed past the renew "
+                                    "deadline")
+                    return True
                 stop.wait(self.retry_period)
+            return False
         finally:
             leader_stop.set()
+            # the run callback owns the ordered drain (cmd/root.py's
+            # run_manager calls ManagerHandle.stop under its own
+            # deadline): the lease must OUTLIVE it — releasing first
+            # would let a standby take over and write while this
+            # process's drain flushes are still on the wire, the exact
+            # cross-term interleaving the fence exists to prevent.
+            # Bounded: a wedged callback delays the release, it does
+            # not pin the lease forever.
+            runner.join(timeout=RELEASE_JOIN_TIMEOUT)
+            if runner.is_alive():
+                logger.warning(
+                    "leader run callback still draining %.0fs after "
+                    "stop; releasing the lease anyway",
+                    RELEASE_JOIN_TIMEOUT)
             if self.is_leader.is_set():
                 self.is_leader.clear()
                 self._release()
-            runner.join(timeout=2.0)

@@ -4,14 +4,32 @@ Mirrors reference pkg/manager/manager.go:28-77: builds the clients and two
 shared informer factories (30s resync, manager.go:52-53), starts each
 registered controller init func in its own thread, starts the informer
 factories, and waits for all controllers to finish.
+
+Shutdown is ORDERED (``ManagerHandle.stop``; ARCHITECTURE.md
+"Lifecycle & fencing"), replacing the old best-effort ``join``:
+
+1. trip the factory's mutation fence — no NEW mutation intents;
+2. drain the write coalescer under a deadline — in-flight cohorts
+   flush (or, past the deadline, fail fast), every waiter completed
+   exactly once;
+3. seal the fence — nothing mutates after this instant;
+4. set the stop event: workers drain their queues and exit, informer
+   threads end, queues shut down (controller/base.run_controller);
+5. flush buffered events to the API.
+
+The lease is NOT touched here — releasing it last is the elector's
+job (its run() finally), so a standby can only take over after this
+process has provably stopped writing.
 """
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
+from .. import metrics
 from ..cloudprovider.aws.factory import CloudFactory
 from ..controller.endpointgroupbinding import (
     EndpointGroupBindingConfig,
@@ -88,14 +106,20 @@ def new_controller_initializers() -> Dict[str, InitFunc]:
 class ManagerHandle:
     """Running manager: informer factory + controller threads.
 
-    ``join`` is the graceful-shutdown tail: after ``stop`` is set, waits
-    for each controller's run() to drain its queues and join its workers
-    (the wg.Wait() of reference manager.go:74).
+    ``join`` is the bare wait (the wg.Wait() of reference
+    manager.go:74); ``stop`` is the ordered, fenced shutdown — see the
+    module docstring for the phase contract.
     """
 
-    def __init__(self, informer_factory: SharedInformerFactory, threads):
+    def __init__(self, informer_factory: SharedInformerFactory, threads,
+                 stop: Optional[threading.Event] = None,
+                 cloud_factory: Optional[CloudFactory] = None,
+                 kube_client: Optional[KubeClient] = None):
         self.informer_factory = informer_factory
         self.threads = threads
+        self.stop_event = stop
+        self.cloud_factory = cloud_factory
+        self.kube_client = kube_client
 
     def informers_synced(self) -> bool:
         return all(inf.has_synced()
@@ -104,6 +128,53 @@ class ManagerHandle:
     def join(self, timeout: Optional[float] = None) -> None:
         for t in self.threads:
             t.join(timeout)
+
+    def stop(self, deadline: float = 10.0) -> dict:
+        """Ordered, fenced shutdown under one wall-clock budget;
+        returns a phase report ``{drained, joined, duration_s}``.
+        Safe to call more than once (later calls find the fence
+        already tripped and the threads already gone)."""
+        start = time.monotonic()
+        fence = (self.cloud_factory.fence
+                 if self.cloud_factory is not None else None)
+        # 1. fence new mutation intents
+        if fence is not None:
+            fence.trip("shutdown")
+        # 2. flush or fail-fast in-flight cohorts (half the budget:
+        # queue/worker draining below needs the rest)
+        drained = True
+        if self.cloud_factory is not None:
+            drained = self.cloud_factory.drain_mutations(deadline / 2)
+        # 3. nothing mutates past this point
+        if fence is not None:
+            fence.seal("shutdown")
+        # 4. stop workers/queues/informers, bounded by the remainder
+        if self.stop_event is not None:
+            self.stop_event.set()
+        remaining = max(0.5, deadline - (time.monotonic() - start))
+        per_thread = remaining / max(1, len(self.threads))
+        for t in self.threads:
+            t.join(per_thread)
+        joined = not any(t.is_alive() for t in self.threads)
+        # 5. flush async event recording so final reconciles' events
+        # reach the API before exit — re-budgeted AFTER the joins so
+        # the whole stop stays inside the one wall-clock deadline
+        # (a small floor keeps the flush from degenerating to a no-op)
+        if self.kube_client is not None:
+            left = max(0.2, deadline - (time.monotonic() - start))
+            try:
+                self.kube_client.flush_events(timeout=min(5.0, left))
+            except Exception:
+                logger.debug("event flush at shutdown failed",
+                             exc_info=True)
+        duration = time.monotonic() - start
+        metrics.record_shutdown_duration(duration)
+        if not drained or not joined:
+            logger.warning("ordered stop incomplete: drained=%s "
+                           "joined=%s (%.2fs)", drained, joined,
+                           duration)
+        return {"drained": drained, "joined": joined,
+                "duration_s": duration}
 
 
 class Manager:
@@ -130,7 +201,9 @@ class Manager:
 
         informer_factory.start(stop)
 
-        handle = ManagerHandle(informer_factory, threads)
+        handle = ManagerHandle(informer_factory, threads, stop=stop,
+                               cloud_factory=cloud_factory,
+                               kube_client=kube_client)
         if block:
             handle.join()
         return handle

@@ -214,6 +214,23 @@ default_registry.describe(
     "non-coalesced accelerator/listener lifecycle calls count at the "
     "resilient wrapper on success.")
 default_registry.describe(
+    "watch_relists_total",
+    "Informer relists after a dropped/expired watch stream, per kind "
+    "— each one diffed the cache against a fresh list into synthetic "
+    "ADD/UPDATE/DELETE deltas (kube/informers.py; the HTTP backend's "
+    "410-Gone recovery counts here too).")
+default_registry.describe(
+    "fenced_mutations_total",
+    "Provider mutations rejected by the lifecycle fence "
+    "(resilience/fence.py), by surface (coalescer intent / wrapper "
+    "call) — work a stopping or deposed-leader process was NOT "
+    "allowed to issue.")
+default_registry.describe(
+    "shutdown_duration_seconds",
+    "Wall-clock of ordered manager shutdowns (fence -> coalescer "
+    "drain -> seal -> workqueue drain -> worker join), observed once "
+    "per stop (manager/manager.py ManagerHandle.stop).")
+default_registry.describe(
     "race_lockset_checks",
     "Lock acquisitions screened by the runtime lockset tracker "
     "(analysis/locks.py) — nonzero proves the detector was armed.")
@@ -233,6 +250,31 @@ def record_watch_event(kind: str, event: str,
     reg = registry or default_registry
     reg.inc_counter("watch_disruptions_total",
                     {"kind": kind, "event": event})
+
+
+def record_watch_relist(kind: str,
+                        registry: Optional[Registry] = None) -> None:
+    """One informer healed a dropped watch stream by relisting and
+    diffing (kube/informers.py ``_relist``; the HTTP watcher's 410
+    recovery bumps the same series)."""
+    reg = registry or default_registry
+    reg.inc_counter("watch_relists_total", {"kind": kind})
+
+
+def record_fenced_mutation(surface: str,
+                           registry: Optional[Registry] = None) -> None:
+    """The lifecycle fence rejected one mutation (``surface`` names
+    where: the coalescer's intent submit or the resilient wrapper's
+    call gate)."""
+    reg = registry or default_registry
+    reg.inc_counter("fenced_mutations_total", {"surface": surface})
+
+
+def record_shutdown_duration(seconds: float,
+                             registry: Optional[Registry] = None) -> None:
+    """One ordered manager shutdown completed in ``seconds``."""
+    reg = registry or default_registry
+    reg.observe_summary("shutdown_duration_seconds", {}, seconds)
 
 
 def record_index_lookup(kind: str, index: str, hit: bool,

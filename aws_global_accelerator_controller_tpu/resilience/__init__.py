@@ -19,6 +19,10 @@ PAPERS.md build for training jobs):
   ``AWSAPIs`` bundle — the factory wraps every provider's apis in one,
   so provider.py, singleflight and fleet sweeps all go through the
   policy without a call-site change (lint rule L105 keeps it that way).
+- ``fence``: ``MutationFence``, the process-lifecycle write gate —
+  ordered shutdown and lease loss trip it so a stopping or deposed
+  process cannot issue mutations concurrently with its successor
+  (lint rule L108 keeps the wrapper's fence consult in place).
 
 Every retry, deadline miss, breaker transition and token level flows
 into metrics.py (``aws_call_retries_total``,
@@ -40,6 +44,7 @@ from .breaker import (
     STATE_HALF_OPEN,
     STATE_OPEN,
 )
+from .fence import FencedError, MutationFence
 from .wrapper import ResilienceConfig, ResilientAPIs
 
 __all__ = [
@@ -48,6 +53,8 @@ __all__ = [
     "CircuitOpenError",
     "DeadlineExceededError",
     "ErrorClass",
+    "FencedError",
+    "MutationFence",
     "ResilienceConfig",
     "ResilientAPIs",
     "RetryBudgetExceededError",

@@ -1,0 +1,174 @@
+"""Lifecycle mutation fence: the write-side gate for ordered shutdown
+and lease-fenced leadership.
+
+A controller process may stop issuing mutations for two reasons — it
+is shutting down, or it lost the leadership lease — and in both cases
+the danger is the same: a mutation QUEUED while the process had
+authority landing AFTER it no longer does, concurrently with a
+successor's writes (the split-brain double-create ROADMAP item 1's
+shard handoff forbids).  The fence is the single object both paths
+trip, consulted at the two write chokepoints:
+
+- the :class:`~..cloudprovider.aws.batcher.MutationCoalescer`'s
+  submit surface — a tripped fence rejects NEW mutation intents;
+- the :class:`~.wrapper.ResilientAPIs` call gate — a SEALED fence
+  rejects every mutation call, including a coalesced flush.
+
+Two stages, matching the ordered-stop contract (ARCHITECTURE.md
+"Lifecycle & fencing"):
+
+``trip(reason)``
+    No new intents.  In-flight cohorts may still FLUSH — the
+    coalescer's drain wraps its flushes in :meth:`flush_pass`, the
+    thread-scoped permit that lets already-accepted work complete so
+    every waiter is answered exactly once.
+``seal(reason)``
+    Nothing mutates, flushes included.  Shutdown seals after the drain
+    deadline; lease loss seals IMMEDIATELY (a deposed leader has no
+    authority left to flush under — its cohorts fail fast with
+    :class:`FencedError` and the new leader reconverges them).
+
+The fencing token (``token``) is the leadership epoch: the elector
+arms the fence with the lease's ``lease_transitions`` at acquire time,
+so re-acquiring after a loss re-arms with a strictly larger token —
+the monotone ordering a cross-process observer (or the leader-handoff
+e2e) uses to prove writes from two terms never interleave.
+
+:class:`FencedError` is a :class:`~..errors.NoRetryError`: a fenced
+sync must be dropped, not requeued — the successor (or the next
+leadership term) owns the key now.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from contextlib import contextmanager
+
+from .. import metrics
+from ..errors import NoRetryError
+
+logger = logging.getLogger(__name__)
+
+
+class FencedError(NoRetryError):
+    """A mutation was rejected by the lifecycle fence.  No-retry by
+    type: requeueing would just re-reject (this process's authority is
+    gone) while the successor converges the key."""
+
+    def __init__(self, reason: str, token: int, sealed: bool):
+        stage = "sealed" if sealed else "fenced"
+        super().__init__(
+            f"mutation rejected: fence {stage} ({reason}; token {token})")
+        self.reason = reason
+        self.token = token
+        self.sealed = sealed
+
+
+# thread-scoped flush permit (see MutationFence.flush_pass)
+_pass_tls = threading.local()
+
+
+class MutationFence:
+    """One process-lifecycle fence per CloudFactory, wired into the
+    factory's coalescer and every region's resilient wrapper at build
+    time (factory.provider_for) and re-armed IN PLACE by the elector
+    at each leadership term (arm)."""
+
+    def __init__(self, token: int = 0, name: str = "process"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._token = token
+        self._tripped = False
+        self._sealed = False
+        self._reason = ""
+
+    # -- state ----------------------------------------------------------
+
+    @property
+    def token(self) -> int:
+        with self._lock:
+            return self._token
+
+    @property
+    def reason(self) -> str:
+        with self._lock:
+            return self._reason
+
+    def is_tripped(self) -> bool:
+        with self._lock:
+            return self._tripped
+
+    def is_sealed(self) -> bool:
+        with self._lock:
+            return self._sealed
+
+    # -- transitions ----------------------------------------------------
+
+    def arm(self, token: int) -> None:
+        """(Re-)arm for a new leadership term with a strictly larger
+        fencing token.  Only a fresh or tripped fence re-arms — the
+        token must be monotone, or a stale term could masquerade as a
+        new one."""
+        with self._lock:
+            if token <= self._token and (self._tripped or self._sealed):
+                raise ValueError(
+                    f"fence token must be monotone: have {self._token}, "
+                    f"got {token}")
+            self._token = max(self._token, token)
+            self._tripped = False
+            self._sealed = False
+            self._reason = ""
+        logger.info("fence %s armed (token %d)", self.name, token)
+
+    def trip(self, reason: str) -> bool:
+        """Reject new mutation intents from now on; returns True when
+        THIS call tripped it (idempotent)."""
+        with self._lock:
+            if self._tripped:
+                return False
+            self._tripped = True
+            self._reason = reason
+        logger.info("fence %s tripped: %s", self.name, reason)
+        return True
+
+    def seal(self, reason: str) -> bool:
+        """Reject every mutation, flushes included (implies trip)."""
+        with self._lock:
+            if self._sealed:
+                return False
+            self._tripped = True
+            self._sealed = True
+            if not self._reason:
+                self._reason = reason
+        logger.info("fence %s sealed: %s", self.name, reason)
+        return True
+
+    # -- the gates ------------------------------------------------------
+
+    def check(self, surface: str) -> None:
+        """Raise :class:`FencedError` when mutations from ``surface``
+        are no longer allowed.  Called on the write hot path: one
+        uncontended lock acquisition when the fence is open."""
+        with self._lock:
+            sealed = self._sealed
+            tripped = self._tripped
+            token = self._token
+            reason = self._reason
+        if not tripped:
+            return
+        if not sealed and getattr(_pass_tls, "depth", 0) > 0:
+            return      # drain window: an in-flight cohort flushing
+        metrics.record_fenced_mutation(surface)
+        raise FencedError(reason or "fence tripped", token, sealed)
+
+    @contextmanager
+    def flush_pass(self):
+        """Thread-scoped permit for the drain window: a flush carrying
+        already-accepted intents may pass a TRIPPED (but not sealed)
+        fence, so every waiter that got in before the trip is answered
+        exactly once."""
+        _pass_tls.depth = getattr(_pass_tls, "depth", 0) + 1
+        try:
+            yield
+        finally:
+            _pass_tls.depth -= 1

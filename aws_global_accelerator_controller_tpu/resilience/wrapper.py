@@ -81,6 +81,17 @@ ROUTE53_METHODS = frozenset({
     "change_resource_record_sets_batch",
 })
 
+# Every method that mutates cloud state — the lifecycle fence
+# (resilience/fence.py) is consulted for these before each attempt, so
+# a stopping or deposed-leader process cannot land a queued mutation
+# concurrently with its successor's writes (lint rule L108 keeps this
+# gate in place).  Reads stay unfenced: a draining process may still
+# observe the world.
+MUTATION_METHODS = UNCOALESCED_MUTATIONS | frozenset({
+    "update_endpoint_group", "add_endpoints", "remove_endpoints",
+    "change_resource_record_sets", "change_resource_record_sets_batch",
+})
+
 
 @dataclass(frozen=True)
 class ResilienceConfig:
@@ -172,6 +183,9 @@ class ResilientAPIs:
         self._clock = clock
         self._sleep = sleep
         self._rng = random.Random(cfg.seed)
+        # lifecycle fence (resilience/fence.py), installed by
+        # CloudFactory.set_fence; None = unfenced (bare test bundles)
+        self.fence = None
         # the breaker/bucket share this wrapper's clock: their gauge
         # callbacks (state_value/level) run on the metrics scrape
         # thread with no explicit `now`, and a real-clock default
@@ -207,6 +221,12 @@ class ResilientAPIs:
         prev_delay = policy.base_delay
         attempt = 1
         while True:
+            # lifecycle fence first (L108): a mutation from a stopping
+            # or deposed process must not reach the wire — checked per
+            # attempt, so a retry sleeping across a lease loss is
+            # rejected when it wakes, not issued with dead authority
+            if self.fence is not None and op in MUTATION_METHODS:
+                self.fence.check("wrapper")
             # cheap open-circuit pre-gate first (claims nothing), so a
             # fully open circuit costs no token and no pacing sleep —
             # otherwise failing-fast workers would drain the bucket

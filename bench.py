@@ -475,6 +475,141 @@ def bench_steady_state(sizes=(1000,), workers: int = 4,
             "legs": legs}
 
 
+def bench_restart_recovery(n_services: int = 1000, workers: int = 4,
+                           resync: float = 1.0,
+                           sweep_every: int = 50,
+                           record: bool = False) -> dict:
+    """Crash-restart re-adoption cost over a converged fleet (ISSUE 6):
+    converge ``n_services``, kill the manager abruptly (no drain, no
+    fence — the crash shape), then start a FRESH manager — cold
+    FleetDiscoveryState, cold fingerprint caches — over the same fake
+    apiserver + cloud and measure the warm re-adoption path:
+
+    - ``readopt_s``: wall-clock from the restart until the first clean
+      fingerprint-gated resync wave (cumulative fastpath skips since
+      restart >= fleet size: every key re-verified, re-recorded, and
+      answered by the gate);
+    - ``mutations_during_readopt``: AWS mutation calls issued while
+      re-adopting — MUST be zero against a converged world (re-adoption
+      is reads + fingerprint rebuild, never writes);
+    - ``reads_during_readopt``: what the re-verify sweep cost.
+
+    ``record=True`` appends to reconcile_history.jsonl tagged
+    ``bench: "restart-recovery"`` (the derived reconcile floor skips
+    tagged entries — this leg's throughput is re-adoption keys/s, not
+    the create storm's)."""
+    sys.path.insert(0, "tests")
+    from harness import Cluster, wait_until
+
+    from aws_global_accelerator_controller_tpu import metrics
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.kube.apiserver import (
+        FakeAPIServer,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintConfig,
+    )
+
+    _MUTATION_PREFIXES = ("create_", "update_", "delete_", "change_",
+                          "add_", "remove_", "tag_")
+
+    def mutation_calls(cloud):
+        return sum(v for m, v in cloud.faults.call_counts().items()
+                   if m.startswith(_MUTATION_PREFIXES))
+
+    reg = metrics.default_registry
+    region = "ap-northeast-1"
+    api = FakeAPIServer()
+    fingerprints = FingerprintConfig(sweep_every=sweep_every)
+    first = Cluster(workers=workers, queue_qps=10000.0,
+                    queue_burst=10000, resync_period=resync,
+                    api=api, fingerprints=fingerprints)
+    for i in range(n_services):
+        name = f"svc{i:04d}"
+        hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                    ".amazonaws.com")
+        first.cloud.elb.register_load_balancer(name, hostname, region)
+    first.start()
+    for i in range(n_services):
+        name = f"svc{i:04d}"
+        hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                    ".amazonaws.com")
+        first.kube.services.create(Service(
+            metadata=ObjectMeta(
+                name=name, namespace="default",
+                annotations={
+                    AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                }),
+            spec=ServiceSpec(type="LoadBalancer",
+                             ports=[ServicePort(port=80)]),
+            status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(hostname=hostname)])),
+        ))
+    wait_until(
+        lambda: len(first.cloud.ga.list_accelerators()) == n_services,
+        timeout=600.0, interval=0.05,
+        message=f"{n_services} accelerators converged")
+    # the crash: abrupt stop, workqueues abandoned, nothing drained
+    first.shutdown()
+    first.handle.join(timeout=30.0)
+
+    mutations_before = mutation_calls(first.cloud)
+    reads_before = sum(first.cloud.faults.call_counts().get(m, 0)
+                       for m in _PROVIDER_READ_METHODS)
+    skips_before = reg.counter_value("reconcile_fastpath_skips_total")
+
+    second = Cluster(workers=workers, queue_qps=10000.0,
+                     queue_burst=10000, resync_period=resync,
+                     api=api, cloud=first.cloud,
+                     fingerprints=fingerprints)
+    start = time.perf_counter()
+    second.start()
+    try:
+        wait_until(
+            lambda: reg.counter_value("reconcile_fastpath_skips_total")
+            - skips_before >= n_services,
+            timeout=600.0, interval=0.05,
+            message="first clean fingerprint-gated resync wave after "
+                    "restart")
+        readopt_s = time.perf_counter() - start
+        mutations = mutation_calls(second.cloud) - mutations_before
+        reads = sum(second.cloud.faults.call_counts().get(m, 0)
+                    for m in _PROVIDER_READ_METHODS) - reads_before
+    finally:
+        second.shutdown(ordered=True, deadline=10.0)
+
+    out = {
+        "services": n_services,
+        "elapsed_s": round(readopt_s, 3),
+        "readopt_s": round(readopt_s, 3),
+        "throughput": round(n_services / readopt_s, 1),
+        "mutations_during_readopt": mutations,
+        "reads_during_readopt": reads,
+        "resync_s": resync,
+        "sweep_every": sweep_every,
+    }
+    if record:
+        _record_reconcile_history(
+            out, bench="restart-recovery",
+            extra={"readopt_s": out["readopt_s"],
+                   "mutations_during_readopt": mutations,
+                   "reads_during_readopt": reads})
+    return out
+
+
 def bench_reconcile_best(reps: int = 3, **kw) -> dict:
     """Best-of-``reps`` reconcile runs.  Convergence time is gated by
     thread scheduling (informer fan-out, queue wakeups), which jitters
@@ -1960,6 +2095,7 @@ _NAMED = {
     "resilience-overhead": bench_resilience_overhead,
     "batch-efficiency": lambda: bench_batch_efficiency(record=True),
     "steady-state": lambda: bench_steady_state(record=True),
+    "restart-recovery": lambda: bench_restart_recovery(record=True),
     "planner": lambda: _json_bench_subprocess(
         "bench_planner", "planner bench", 300.0),
     "flash": bench_flash_subprocess,

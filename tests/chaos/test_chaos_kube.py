@@ -1,0 +1,131 @@
+"""Kube-plane chaos engine contracts (kube/chaos.py KubeChaos).
+
+The same determinism discipline as the cloud-side engine
+(tests/chaos/test_chaos_engine.py): every probabilistic decision is a
+pure function of (seed, salt, kind:op, call index), so a seeded
+schedule replays identically for the same per-op call sequence —
+across processes and thread interleavings.
+"""
+import pytest
+
+from aws_global_accelerator_controller_tpu.errors import ConflictError
+from aws_global_accelerator_controller_tpu.kube.apiserver import (
+    FakeAPIServer,
+    WATCH_ERROR,
+)
+from aws_global_accelerator_controller_tpu.kube.chaos import KubeChaos
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    ObjectMeta,
+    Service,
+    ServiceSpec,
+)
+
+SEED = 20260804
+
+
+def make_service(name):
+    return Service(metadata=ObjectMeta(name=name, namespace="default"),
+                   spec=ServiceSpec(type="LoadBalancer"))
+
+
+def drive(chaos, op="update", kind="Service", n=200):
+    outcomes = []
+    for _ in range(n):
+        try:
+            chaos.check(op, kind)
+            outcomes.append("ok")
+        except Exception as e:
+            outcomes.append(type(e).__name__)
+    return outcomes
+
+
+def test_seeded_error_rate_is_deterministic_across_engines():
+    a = KubeChaos(seed=SEED)
+    b = KubeChaos(seed=SEED)
+    for engine in (a, b):
+        engine.set_error_rate("update", 0.25, kind="Service")
+    got_a, got_b = drive(a), drive(b)
+    assert got_a == got_b, "same seed + same call sequence must " \
+                           "inject the same faults"
+    injected = got_a.count("RuntimeError")
+    assert 0 < injected < 200, "a 25% rate must fire sometimes, " \
+                               "not always"
+    assert a.injected_counts()["Service:update"] == injected
+    assert a.call_counts()["Service:update"] == 200
+
+
+def test_different_seeds_diverge():
+    a = KubeChaos(seed=1)
+    b = KubeChaos(seed=2)
+    for engine in (a, b):
+        engine.set_error_rate("update", 0.25, kind="Service")
+    assert drive(a) != drive(b)
+
+
+def test_conflict_storm_raises_typed_conflicts():
+    chaos = KubeChaos(seed=SEED)
+    chaos.set_conflict_rate(0.5, kind="Lease")
+    got = drive(chaos, op="update", kind="Lease")
+    assert "ConflictError" in got and "ok" in got
+    # conflicts are op-scoped: reads never conflict
+    assert all(o == "ok" for o in drive(chaos, op="get", kind="Lease"))
+
+
+def test_rate_scoping_kind_and_star():
+    chaos = KubeChaos(seed=SEED)
+    chaos.set_error_rate("list", 1.0, kind="Service")
+    with pytest.raises(RuntimeError):
+        chaos.check("list", "Service")
+    chaos.check("list", "Ingress")          # other kinds untouched
+    chaos.check("get", "Service")           # other ops untouched
+    chaos.set_error_rate("list", 0.0, kind="Service")
+    chaos.check("list", "Service")          # 0 clears
+
+
+def test_store_chaos_faults_do_not_mutate_state():
+    api = FakeAPIServer()
+    chaos = api.arm_chaos(seed=SEED)
+    store = api.store("Service")
+    chaos.set_error_rate("create", 1.0, kind="Service")
+    with pytest.raises(RuntimeError):
+        store.create(make_service("doomed"))
+    chaos.set_error_rate("create", 0.0, kind="Service")
+    assert store.list() == [], "an injected create fault must not " \
+                               "leave the object behind"
+    created = store.create(make_service("ok"))
+    chaos.set_conflict_rate(1.0, kind="Service")
+    with pytest.raises(ConflictError):
+        store.update(created)
+    chaos.set_conflict_rate(0.0, kind="Service")
+    got = store.get("default", "ok")
+    assert got.metadata.resource_version \
+        == created.metadata.resource_version, \
+        "an injected conflict must not have applied the update"
+
+
+def test_watch_drop_detaches_subscribers_with_error_marker():
+    api = FakeAPIServer()
+    chaos = api.arm_chaos(seed=SEED)
+    store = api.store("Service")
+    q = store.watch()
+    chaos.set_watch_drop_rate(1.0, kind="Service")
+    store.create(make_service("one"))
+    assert q.get(timeout=2).type == "ADDED"
+    assert q.get(timeout=2).type == WATCH_ERROR
+    # detached: the next event is missed entirely
+    store.create(make_service("two"))
+    assert q.empty(), "a dropped subscriber must miss later events"
+    # every publish at rate 1.0 decides a drop (the second one finds
+    # nobody left to detach)
+    assert chaos.injected_counts().get("Service:watch", 0) >= 1
+
+
+def test_partition_and_heal_round_trip():
+    api = FakeAPIServer()
+    store = api.store("Service")
+    q = store.watch()
+    assert store.partition_watch() == 1
+    store.create(make_service("missed"))
+    assert q.empty(), "a partitioned stream must go silent"
+    store.heal_watch()
+    assert q.get(timeout=2).type == WATCH_ERROR

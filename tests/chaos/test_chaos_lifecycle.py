@@ -1,0 +1,390 @@
+"""Lifecycle chaos e2e (ISSUE 6 tentpole d): crash-restart re-adoption
+without duplicate creates, and leader handoff under kube-plane chaos
+without interleaved writes from two identities.
+
+Both run seeded and under the runtime race detectors.  These are the
+N=1→2 cases of ROADMAP item 1's shard-handoff invariant: a controller
+whose authority ends (kill, lease loss) must leave a world a successor
+converges WITHOUT double-creating accelerators or orphaning records.
+"""
+import threading
+import time
+
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    ROUTE53_HOSTNAME_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.api import (
+    AWSAPIs,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
+    FakeCloudFactory,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.fake import (
+    FakeAWSCloud,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.helpers import (
+    CLUSTER_TAG_KEY,
+    MANAGED_TAG_KEY,
+    OWNER_TAG_KEY,
+    TARGET_HOSTNAME_TAG_KEY,
+)
+from aws_global_accelerator_controller_tpu.kube.apiserver import FakeAPIServer
+from aws_global_accelerator_controller_tpu.kube.client import (
+    KubeClient,
+    OperatorClient,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+from aws_global_accelerator_controller_tpu.leaderelection import (
+    LeaderElection,
+)
+from aws_global_accelerator_controller_tpu.manager import (
+    ControllerConfig,
+    Manager,
+)
+from aws_global_accelerator_controller_tpu.controller.endpointgroupbinding import (  # noqa: E501
+    EndpointGroupBindingConfig,
+)
+from aws_global_accelerator_controller_tpu.controller.globalaccelerator import (  # noqa: E501
+    GlobalAcceleratorConfig,
+)
+from aws_global_accelerator_controller_tpu.controller.route53 import (
+    Route53Config,
+)
+
+from harness import CLUSTER, Cluster, wait_until
+
+SEED = 20260804
+REGION = "ap-northeast-1"
+
+
+def nlb_hostname(name):
+    return f"{name}-0123456789abcdef.elb.{REGION}.amazonaws.com"
+
+
+def managed_service(name, dns_hostname=None):
+    ann = {AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+           AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true"}
+    if dns_hostname:
+        ann[ROUTE53_HOSTNAME_ANNOTATION] = dns_hostname
+    return Service(
+        metadata=ObjectMeta(name=name, namespace="default",
+                            annotations=ann),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(
+                hostname=nlb_hostname(name))])),
+    )
+
+
+def owned(factory, name):
+    provider = factory.global_provider()
+    return provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", name)
+
+
+# ---------------------------------------------------------------------------
+# crash-restart re-adoption
+# ---------------------------------------------------------------------------
+
+def test_crash_restart_readopts_without_duplicate_creates(race_detectors):
+    """Kill the manager mid-create-storm (abrupt stop: no drain, no
+    fence, workqueues abandoned with pending keys), then build a FRESH
+    manager — cold FleetDiscoveryState, cold fingerprint caches, new
+    fence — against the SAME fake apiserver and cloud.  Convergence
+    must be exact: one accelerator chain per service (zero duplicate
+    creates: re-adoption finds the survivors by ownership tags), one
+    A+TXT record pair per hostname (zero orphans).  One service is
+    seeded at the WORST kill point — an accelerator created and
+    tagged, killed before its listener existed — which the restart
+    must adopt and finish, not re-create."""
+    n = 12
+    api = FakeAPIServer()
+    a = Cluster(workers=4, queue_qps=10000.0, queue_burst=10000,
+                api=api, fault_seed=SEED)
+    zone = a.cloud.route53.create_hosted_zone("example.com")
+    for i in range(n):
+        name = f"svc-r{i:02d}"
+        a.cloud.elb.register_load_balancer(name, nlb_hostname(name),
+                                           REGION)
+    a.start()
+    for i in range(n):
+        name = f"svc-r{i:02d}"
+        a.kube.services.create(
+            managed_service(name, f"r{i}.example.com"))
+
+    # the seeded kill point: tear down as soon as a third of the fleet
+    # has accelerators — a mid-storm mixture of converged, partial and
+    # untouched services
+    wait_until(lambda: len(a.cloud.ga.list_accelerators()) >= n // 3,
+               timeout=30.0, message="storm reached the kill point")
+    a.shutdown()                      # abrupt: no graceful drain
+    a.handle.join(timeout=10.0)       # wait for the corpse, not drain
+    assert not any(t.is_alive() for t in a.handle.threads)
+
+    mid_accels = a.cloud.ga.list_accelerators()
+    assert 0 < len(mid_accels), "kill point missed the storm entirely"
+
+    # worst-case partial chain: an accelerator the dead manager
+    # created and tagged but never got a listener onto (the window
+    # between create_accelerator and create_listener)
+    partial_name = "svc-rpartial"
+    a.cloud.elb.register_load_balancer(partial_name,
+                                       nlb_hostname(partial_name),
+                                       REGION)
+    a.cloud.ga.create_accelerator(
+        partial_name, "IPV4", True,
+        {MANAGED_TAG_KEY: "true",
+         OWNER_TAG_KEY: f"service/default/{partial_name}",
+         TARGET_HOSTNAME_TAG_KEY: nlb_hostname(partial_name),
+         CLUSTER_TAG_KEY: CLUSTER})
+    a.kube.services.create(
+        managed_service(partial_name, "rpartial.example.com"))
+    total = n + 1
+
+    # the fresh manager: same world, cold process state
+    b = Cluster(workers=4, queue_qps=10000.0, queue_burst=10000,
+                api=api, cloud=a.cloud).start()
+    try:
+        wait_until(
+            lambda: len(b.cloud.ga.list_accelerators()) == total
+            and all(len(ga_listeners(b.cloud, acc)) == 1
+                    for acc in b.cloud.ga.list_accelerators()),
+            timeout=60.0,
+            message="restart converged every chain exactly once")
+
+        # zero duplicates: exactly one accelerator per service, total
+        # count exact (re-adoption never re-created a survivor)
+        accels = b.cloud.ga.list_accelerators()
+        assert len(accels) == total, \
+            f"expected {total} accelerators, found {len(accels)}"
+        for i in range(n):
+            assert len(owned(b.factory, f"svc-r{i:02d}")) == 1
+        assert len(owned(b.factory, partial_name)) == 1, \
+            "the partial chain must be adopted, not duplicated"
+
+        # zero orphaned records: exactly one A + one TXT per hostname,
+        # nothing else in the zone
+        def records():
+            return sorted(
+                (r.name, r.type) for r in
+                b.cloud.route53.list_resource_record_sets(zone.id))
+
+        expected = sorted(
+            [(f"r{i}.example.com.", t)
+             for i in range(n) for t in ("A", "TXT")]
+            + [("rpartial.example.com.", t) for t in ("A", "TXT")])
+        wait_until(lambda: records() == expected, timeout=30.0,
+                   message="record set exact (no dupes, no orphans)")
+        assert records() == expected
+    finally:
+        b.shutdown(ordered=True)
+
+    # steady after the dust settles: a second sweep finds nothing new
+    assert len(b.cloud.ga.list_accelerators()) == total
+
+
+def ga_listeners(cloud, acc):
+    return cloud.ga.list_listeners(acc.accelerator_arn)
+
+
+# ---------------------------------------------------------------------------
+# leader handoff under kube-plane chaos
+# ---------------------------------------------------------------------------
+
+_MUTATOR_PREFIXES = ("create_", "update_", "delete_", "change_",
+                     "add_", "remove_", "tag_")
+
+
+class _RecordingService:
+    """Wraps one fake service; successful state-changing calls append
+    (monotonic time, identity, method) to the shared log."""
+
+    def __init__(self, inner, identity, log, lock):
+        self._inner = inner
+        self._identity = identity
+        self._log = log
+        self._loglock = lock
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if not callable(attr) or not name.startswith(_MUTATOR_PREFIXES):
+            return attr
+
+        def call(*args, **kwargs):
+            result = attr(*args, **kwargs)
+            with self._loglock:
+                self._log.append((time.monotonic(), self._identity,
+                                  name))
+            return result
+
+        return call
+
+
+def _replica(name, api, cloud, log, loglock, stop):
+    """One controller replica, assembled the way cmd/root.py does:
+    elector owning the factory fence, ordered stop on leadership end."""
+    kube = KubeClient(api)
+    operator = OperatorClient(api)
+    bundle = AWSAPIs(
+        elb=_RecordingService(cloud.elb, name, log, loglock),
+        ga=_RecordingService(cloud.ga, name, log, loglock),
+        route53=_RecordingService(cloud.route53, name, log, loglock))
+    factory = FakeCloudFactory(cloud=bundle)
+    config = ControllerConfig(
+        global_accelerator=GlobalAcceleratorConfig(
+            workers=2, cluster_name=CLUSTER, queue_qps=10000.0,
+            queue_burst=10000),
+        route53=Route53Config(workers=2, cluster_name=CLUSTER,
+                              queue_qps=10000.0, queue_burst=10000),
+        endpoint_group_binding=EndpointGroupBindingConfig(
+            workers=2, queue_qps=10000.0, queue_burst=10000))
+    elector = LeaderElection(
+        "agac-handoff", "default", KubeClient(api),
+        lease_duration=1.0, renew_deadline=0.4, retry_period=0.05,
+        identity=name, fence=factory.fence)
+    state = {"elector": elector, "factory": factory,
+             "led": threading.Event(), "lost_at": []}
+
+    def run_manager(leader_stop):
+        handle = Manager().run(kube, operator, factory, config,
+                               leader_stop, block=False)
+        state["led"].set()
+        leader_stop.wait()
+        handle.stop(deadline=5.0)
+
+    def on_loss():
+        state["lost_at"].append(time.monotonic())
+
+    t = threading.Thread(
+        target=elector.run, args=(stop, run_manager),
+        kwargs={"on_stopped_leading": on_loss}, daemon=True,
+        name=f"replica-{name}")
+    t.start()
+    state["thread"] = t
+    return state
+
+
+def test_leader_handoff_under_kube_chaos_no_interleaved_writes(
+        race_detectors):
+    """Two replicas over one fake apiserver under 20% kube-plane chaos
+    (store error rates, conflict storms on the lease, watch drops):
+    replica A leads and converges part of the fleet, its apiserver
+    path to the lease is cut, B takes over after the lease expires —
+    and the shared write log proves the handoff was FENCED: every one
+    of A's cloud writes strictly precedes every one of B's (the
+    deposed leader's sealed fence rejected whatever its workers still
+    had queued), A's fence sealed before B's first write, and the
+    fleet still converges exactly once per service."""
+    n = 10
+    api = FakeAPIServer()
+    chaos = api.arm_chaos(seed=SEED)
+    cloud = FakeAWSCloud()
+    for i in range(n):
+        name = f"svc-h{i:02d}"
+        cloud.elb.register_load_balancer(name, nlb_hostname(name),
+                                         REGION)
+    kube = KubeClient(api)
+
+    log, loglock = [], threading.Lock()
+    stop_a, stop_b = threading.Event(), threading.Event()
+    a = _replica("A", api, cloud, log, loglock, stop_a)
+    b = _replica("B", api, cloud, log, loglock, stop_b)
+    try:
+        wait_until(lambda: a["led"].is_set() or b["led"].is_set(),
+                   timeout=20.0, message="first leader elected")
+        # make A the leader deterministically: if B won the toss, swap
+        if b["led"].is_set() and not a["led"].is_set():
+            a, b = b, a
+            stop_a, stop_b = stop_b, stop_a
+
+        # 20% kube-plane chaos while the leader works
+        chaos.set_error_rate("update", 0.2)
+        chaos.set_error_rate("list", 0.2)
+        chaos.set_error_rate("create", 0.2, kind="Event")
+        chaos.set_conflict_rate(0.2, kind="Lease")
+        chaos.set_watch_drop_rate(0.02)
+
+        for i in range(n):
+            kube.services.create(managed_service(f"svc-h{i:02d}"))
+        wait_until(lambda: len(cloud.ga.list_accelerators()) >= 3,
+                   timeout=30.0, message="leader A mid-work")
+
+        # cut A's path to the lease (its manager keeps reconciling)
+        class _Dead:
+            def __getattr__(self, _):
+                raise OSError("chaos: apiserver unreachable")
+
+        class _DeadKube:
+            leases = _Dead()
+
+        a["elector"].kube = _DeadKube()
+        wait_until(lambda: b["led"].is_set(), timeout=30.0,
+                   message="standby B took over")
+        a_sealed_at = None
+        wait_until(lambda: a["lost_at"], timeout=10.0,
+                   message="A observed its loss")
+        a_sealed_at = a["lost_at"][0]
+        assert a["factory"].fence.is_sealed()
+
+        # work only the SUCCESSOR can do: a second batch landing after
+        # the handoff (A may have converged the first batch entirely
+        # before it was deposed — B must still write something for the
+        # interleaving assertion to bite)
+        extra = 4
+        for i in range(n, n + extra):
+            name = f"svc-h{i:02d}"
+            cloud.elb.register_load_balancer(name, nlb_hostname(name),
+                                             REGION)
+            kube.services.create(managed_service(name))
+        total = n + extra
+
+        wait_until(
+            lambda: len(cloud.ga.list_accelerators()) == total
+            and all(len(cloud.ga.list_listeners(acc.accelerator_arn))
+                    == 1 for acc in cloud.ga.list_accelerators()),
+            timeout=60.0, message="B converged the full fleet")
+        # quiesce, then lift the chaos for the final assertions
+        chaos.set_error_rate("update", 0.0)
+        chaos.set_error_rate("list", 0.0)
+        chaos.set_error_rate("create", 0.0, kind="Event")
+        chaos.set_conflict_rate(0.0, kind="Lease")
+        chaos.set_watch_drop_rate(0.0)
+        time.sleep(0.5)
+
+        # exactly-once convergence across the handoff
+        accels = cloud.ga.list_accelerators()
+        assert len(accels) == total, \
+            f"duplicate creates across the handoff: {len(accels)}"
+        for i in range(total):
+            factory = b["factory"]
+            assert len(owned(factory, f"svc-h{i:02d}")) == 1
+
+        # the write log: A strictly before B, fence seal in between
+        with loglock:
+            entries = list(log)
+        a_writes = [t for t, who, _ in entries if who == "A"]
+        b_writes = [t for t, who, _ in entries if who == "B"]
+        assert a_writes, "A never wrote — the handoff proved nothing"
+        assert b_writes, "B never wrote — the handoff proved nothing"
+        assert max(a_writes) < min(b_writes), \
+            "writes from two identities interleaved across the handoff"
+        assert a_sealed_at is not None and a_sealed_at < min(b_writes), \
+            "A's fence sealed only after B had already written"
+        # fencing tokens are ordered across terms
+        assert b["factory"].fence.token > a["factory"].fence.token
+    finally:
+        stop_a.set()
+        stop_b.set()
+        a["thread"].join(timeout=10.0)
+        b["thread"].join(timeout=10.0)

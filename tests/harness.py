@@ -39,18 +39,23 @@ class Cluster:
                  settle_seconds: float = 0.0, queue_qps: float = 10.0,
                  queue_burst: int = 100, weight_policy: str = "static",
                  policy_checkpoint: str = "", resilience=None,
-                 fault_seed=None, coalesce=None, fingerprints=None):
+                 fault_seed=None, coalesce=None, fingerprints=None,
+                 api=None, cloud=None):
         from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
             FingerprintConfig,
         )
         fingerprints = fingerprints or FingerprintConfig()
-        self.api = FakeAPIServer()
+        # ``api``/``cloud`` adopt an EXISTING fake apiserver / AWS
+        # world — the crash-restart shape: a fresh control plane
+        # (cold caches, new fence) over the same persistent state
+        self.api = api if api is not None else FakeAPIServer()
         self.kube = KubeClient(self.api)
         self.operator = OperatorClient(self.api)
         self.factory = FakeCloudFactory(settle_seconds=settle_seconds,
                                         resilience=resilience,
                                         fault_seed=fault_seed,
-                                        coalesce=coalesce)
+                                        coalesce=coalesce,
+                                        cloud=cloud)
         self.cloud = self.factory.cloud
         self.stop = threading.Event()
         self._manager = Manager(resync_period=resync_period)
@@ -71,12 +76,20 @@ class Cluster:
         )
 
     def start(self):
-        self._manager.run(self.kube, self.operator, self.factory,
-                          self._config, self.stop, block=False)
+        self.handle = self._manager.run(self.kube, self.operator,
+                                        self.factory, self._config,
+                                        self.stop, block=False)
         return self
 
-    def shutdown(self):
+    def shutdown(self, ordered: bool = False, deadline: float = 5.0):
+        """Default: the historical abrupt stop (set the event, return
+        immediately — also what the crash e2e relies on).  ``ordered``
+        runs the fenced phase sequence (manager.ManagerHandle.stop)
+        and returns its phase report."""
+        if ordered and getattr(self, "handle", None) is not None:
+            return self.handle.stop(deadline=deadline)
         self.stop.set()
+        return None
 
 
 def wait_until(pred, timeout: float = 20.0, interval: float = 0.02,

@@ -1,0 +1,24 @@
+"""L108 fixture: mutation paths that DO consult the lifecycle fence —
+a lexical ``fence.check`` before a bare write, a ``flush_pass`` drain
+window, and a write routed through ``apis`` (runtime-gated by
+ResilientAPIs.invoke) — all clean under L108.  The bare writes waive
+L105 explicitly: this fixture isolates the fence rule."""
+
+
+class Flusher:
+    def __init__(self, apis, inner, fence):
+        self.apis = apis
+        self.inner = inner
+        self.fence = fence
+
+    def flush_direct(self):
+        self.fence.check("flusher")
+        self.inner.ga.delete_accelerator("arn")  # noqa: L105
+
+    def flush_drain(self):
+        with self.fence.flush_pass():
+            self.inner.ga.update_accelerator("arn")  # noqa: L105
+
+    def flush_wrapped(self):
+        # through apis: the wrapper's invoke carries the fence consult
+        self.apis.ga.delete_accelerator("arn")

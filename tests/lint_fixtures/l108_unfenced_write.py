@@ -1,0 +1,16 @@
+"""L108 fixture: bare AWS writes with no lifecycle-fence consult in
+the enclosing function — each must fire (they also fire L105: a bare
+write is doubly wrong); line 14's deliberate bare call is waived."""
+
+
+def issue_writes(cloud):
+    cloud.ga.update_accelerator("arn", enabled=False)
+    cloud.ga.add_endpoints("arn", "lb", False, 10)
+
+
+def teardown(cloud):
+    cloud.ga.delete_accelerator("arn")
+
+
+def deliberate(cloud):
+    cloud.ga.delete_accelerator("arn")  # race: teardown helper, process exiting

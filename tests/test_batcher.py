@@ -426,3 +426,141 @@ def test_factory_shares_one_coalescer_across_regions():
     a = factory.provider_for("us-west-2")
     b = factory.provider_for("ap-northeast-1")
     assert a.coalescer is b.coalescer
+
+
+# ---------------------------------------------------------------------------
+# lifecycle fence (resilience/fence.py) on the write surface
+# ---------------------------------------------------------------------------
+
+from aws_global_accelerator_controller_tpu.resilience import (  # noqa: E402
+    FencedError,
+    MutationFence,
+)
+
+
+def test_tripped_fence_rejects_new_intents_before_enqueue():
+    """A tripped fence rejects NEW mutation intents at submit: no
+    waiter is created, nothing reaches the wire, and the rejection is
+    visible in fenced_mutations_total{surface="coalescer"}."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    fence = MutationFence()
+    co = make_coalescer(cloud, linger=0.001)
+    co.set_fence(fence)
+    fence.trip("shutdown")
+    before = counter_delta("fenced_mutations_total")
+    with pytest.raises(FencedError):
+        co.change_record_sets(zone.id, [("UPSERT", txt("x.example.com"))])
+    assert metrics.default_registry.counter_value(
+        "fenced_mutations_total", {"surface": "coalescer"}) >= 1
+    assert counter_delta("fenced_mutations_total") == before + 1
+    assert cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0) == 0
+    assert record_names(cloud, zone.id) == set()
+
+
+def test_drain_flushes_lingering_cohort_and_completes_waiter_once():
+    """Ordered-stop phase 2: a cohort accepted BEFORE the trip flushes
+    immediately when drain() cuts the linger short — the waiter gets
+    its success exactly once and the record lands."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    fence = MutationFence()
+    co = make_coalescer(cloud, linger=5.0)   # would linger 5s untripped
+    co.set_fence(fence)
+    results = {}
+
+    def submit():
+        co.change_record_sets(zone.id, [("UPSERT", txt("d.example.com"))])
+        results["ok"] = results.get("ok", 0) + 1
+
+    t = threading.Thread(target=submit)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:   # wait for the leader to linger
+        with co._lock:
+            groups = list(co._groups.values())
+        if any(g.pending for g in groups):
+            break
+        time.sleep(0.002)
+    fence.trip("shutdown")
+    start = time.monotonic()
+    assert co.drain(timeout=5.0) is True
+    assert time.monotonic() - start < 2.0, "drain waited out the linger"
+    t.join(timeout=5.0)
+    assert results == {"ok": 1}
+    assert ("d.example.com.", "TXT") in record_names(cloud, zone.id)
+
+
+def test_sealed_fence_fails_inflight_cohort_fast_without_bisect():
+    """Lease loss seals immediately: the lingering cohort's flush is
+    rejected at the wrapper (flush-pass does not beat a seal), every
+    waiter gets FencedError exactly once, no bisect halves are issued
+    (a fenced flush is about the PROCESS, not any one change)."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    fence = MutationFence()
+    apis = ResilientAPIs(cloud, region="test", config=ResilienceConfig(
+        max_attempts=2, base_delay=0.001, max_delay=0.01, deadline=1.0,
+        breaker_min_calls=1000, bucket_capacity=1e6, bucket_refill=1e6))
+    apis.fence = fence
+    co = MutationCoalescer(apis, config=CoalesceConfig(linger=5.0),
+                           fence=fence)
+    bisects_before = counter_delta("provider_flush_bisects_total")
+    errs = run_threads(
+        lambda: co.change_record_sets(
+            zone.id, [("UPSERT", txt("a.example.com"))]),
+        lambda: (time.sleep(0.05), fence.seal("lease lost"),
+                 co.drain(timeout=5.0)))
+    assert isinstance(errs.get(0), FencedError), errs
+    assert counter_delta("provider_flush_bisects_total") == bisects_before
+    assert cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0) == 0
+    assert record_names(cloud, zone.id) == set()
+
+
+def test_drain_deadline_reports_slow_flush_without_double_completion():
+    """A flush already ON THE WIRE past the drain deadline: drain
+    returns False (incomplete) but never touches the in-flight
+    cohort's futures — they complete exactly once when the slow call
+    lands."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    cloud.faults.set_latency("change_resource_record_sets_batch", 0.3)
+    fence = MutationFence()
+    co = make_coalescer(cloud, linger=0.001)
+    co.set_fence(fence)
+    results = {}
+
+    def submit():
+        co.change_record_sets(zone.id, [("UPSERT", txt("s.example.com"))])
+        results["ok"] = results.get("ok", 0) + 1
+
+    t = threading.Thread(target=submit)
+    t.start()
+    time.sleep(0.05)     # the flush is now sleeping in the fake call
+    fence.trip("shutdown")
+    assert co.drain(timeout=0.05) is False
+    t.join(timeout=5.0)
+    assert results == {"ok": 1}
+    assert ("s.example.com.", "TXT") in record_names(cloud, zone.id)
+
+
+def test_wrapper_fences_uncoalesced_mutations_but_not_reads():
+    """The resilient wrapper's fence gate (lint rule L108's runtime
+    half): accelerator/listener lifecycle mutations are rejected once
+    tripped, while reads keep flowing — a draining process may still
+    observe the world."""
+    cloud = FakeAWSCloud()
+    fence = MutationFence()
+    apis = ResilientAPIs(cloud, region="test", config=ResilienceConfig())
+    apis.fence = fence
+    acc = apis.ga.create_accelerator("pre", "IPV4", True, {})
+    fence.trip("shutdown")
+    with pytest.raises(FencedError):
+        apis.ga.create_accelerator("post", "IPV4", True, {})
+    assert metrics.default_registry.counter_value(
+        "fenced_mutations_total", {"surface": "wrapper"}) >= 1
+    # reads are not fenced
+    assert [a.accelerator_arn for a in apis.ga.list_accelerators()] \
+        == [acc.accelerator_arn]

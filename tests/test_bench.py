@@ -143,10 +143,34 @@ def test_bench_steady_state_smoke(monkeypatch, tmp_path):
     assert "fastpath_skips_per_wave" in entries[-1]
 
 
+def test_bench_restart_recovery_smoke(monkeypatch, tmp_path):
+    """Small-N run of the crash-restart re-adoption leg: the fresh
+    manager converges to its first clean fingerprint-gated resync
+    wave, issues ZERO mutations against the converged world (warm
+    re-adoption is reads + fingerprint rebuild, never writes), and
+    the tagged history record lands."""
+    path = tmp_path / "hist.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(path))
+    out = bench.bench_restart_recovery(n_services=8, workers=2,
+                                       resync=0.25, record=True)
+    assert out["services"] == 8
+    assert out["readopt_s"] > 0 and out["throughput"] > 0
+    assert out["mutations_during_readopt"] == 0, \
+        "re-adoption issued mutations against a converged fleet — " \
+        "the duplicate-create risk the restart e2e forbids"
+    assert out["reads_during_readopt"] > 0, \
+        "zero reads means the re-verify pass never ran — the leg " \
+        "measured nothing"
+    entries = [json.loads(line) for line in path.read_text().splitlines()]
+    assert entries[-1]["bench"] == "restart-recovery"
+    assert entries[-1]["mutations_during_readopt"] == 0
+    assert "readopt_s" in entries[-1]
+
+
 def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
-    """batch-efficiency and steady-state legs measure other workloads,
-    not the floor's pure create storm: their (lower) throughputs must
-    not drag the derived floor down."""
+    """batch-efficiency, steady-state and restart-recovery legs
+    measure other workloads, not the floor's pure create storm: their
+    (lower) throughputs must not drag the derived floor down."""
     hist = tmp_path / "history.jsonl"
     hist.write_text("".join(
         json.dumps(e) + "\n" for e in (
@@ -154,7 +178,8 @@ def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
             {"throughput": 3450.0},
             {"throughput": 150.0, "bench": "batch-efficiency"},
             {"throughput": 160.0, "bench": "batch-efficiency"},
-            {"throughput": 140.0, "bench": "steady-state"})))
+            {"throughput": 140.0, "bench": "steady-state"},
+            {"throughput": 45.0, "bench": "restart-recovery"})))
     monkeypatch.delenv("RECONCILE_FLOOR_SVC_S", raising=False)
     monkeypatch.setattr(bench.os, "getloadavg", lambda: (0.0, 0, 0))
     got = bench.reconcile_floor(history_path=str(hist))

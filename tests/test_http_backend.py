@@ -254,22 +254,34 @@ def test_watch_loop_survives_failing_relist(monkeypatch):
 def test_watch_410_relist_synthesizes_deletes(http_api):
     """A 410 Gone recovery must not leave subscribers with phantom
     objects: the relist delivers DELETED for objects that vanished in
-    the gap (reflector replace semantics)."""
+    the gap, MODIFIED where the resourceVersion moved, and — the other
+    half of the contract — NOTHING for objects unchanged through the
+    gap (re-announcing the fleet would invalidate every fingerprint
+    gate and turn each 410 into a spurious reconcile burst)."""
     store = http_api.store("Service")
     q = store.watch()
     store.create(_service("stays"))
     store.create(_service("goes"))
-    # drain the live stream until both objects were delivered
+    changed = store.create(_service("changed"))
+    # drain the live stream until all three objects were delivered
     seen = set()
-    while len(seen) < 2:
+    while len(seen) < 3:
         seen.add(q.get(timeout=10).obj.name)
-    # simulate the gap: object deleted while the watch is expired
+    # simulate the gap: one delete + one update while the watch is
+    # expired (the watcher's tracker still holds the stale versions)
     with store._lock:
         watcher = next(iter(store._watchers.values()))
+    stale_changed = watcher._objs["default/changed"]
     store.delete("default", "goes")
     q.get(timeout=10)  # consume the live DELETED
-    # force the reflector recovery path directly
-    watcher._objs["default/goes"] = _service("goes")  # as if DELETED was missed
+    changed.metadata.annotations["k"] = "v"
+    changed.metadata.resource_version = 0   # server assigns
+    store.update(changed)
+    q.get(timeout=10)  # consume the live MODIFIED
+    # force the reflector recovery path directly, with the tracker
+    # rewound to the pre-gap state (as if those events were missed)
+    watcher._objs["default/goes"] = _service("goes")
+    watcher._objs["default/changed"] = stale_changed
     watcher._relist()
     events = []
     while True:
@@ -278,9 +290,11 @@ def test_watch_410_relist_synthesizes_deletes(http_api):
         except Exception:
             break
     deleted = [e.obj.name for e in events if e.type == "DELETED"]
-    added = [e.obj.name for e in events if e.type == "ADDED"]
+    modified = [e.obj.name for e in events if e.type == "MODIFIED"]
     assert "goes" in deleted
-    assert "stays" in added
+    assert "changed" in modified
+    assert not any(e.obj.name == "stays" for e in events), \
+        "an unchanged object must not be re-announced by a relist"
 
 
 def _start_manager(http_api):

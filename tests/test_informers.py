@@ -168,6 +168,113 @@ def test_resync_spread_jitters_across_period_fake_clock():
     assert fresh in third
 
 
+def test_watch_drop_relist_diffs_missed_changes():
+    """Kube-plane chaos regression (ISSUE 6 satellite): after a
+    simulated watch-stream death (the fake plane's 410 Gone), objects
+    deleted while disconnected must surface as DELETE deltas, objects
+    created as ADDs, changed ones as UPDATEs — and unchanged objects
+    must dispatch NOTHING (a relist over an idle fleet costs no
+    spurious invalidation)."""
+    from aws_global_accelerator_controller_tpu import metrics
+
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    kube.services.create(make_service("stays"))
+    changed = kube.services.create(make_service("changes"))
+    kube.services.create(make_service("goes"))
+
+    factory = SharedInformerFactory(api, resync_period=30)
+    informer = factory.services()
+    adds, updates, deletes = [], [], []
+    informer.add_event_handler(
+        add=lambda o: adds.append(o.metadata.name),
+        update=lambda old, new: updates.append(new.metadata.name),
+        delete=lambda o: deletes.append(o.metadata.name),
+        # tagged resync handler so backstop re-deliveries stay out of
+        # the update stream (the controllers' wiring shape)
+        resync=lambda o, wave: None)
+    stop = threading.Event()
+    factory.start(stop)
+    try:
+        assert wait_for_cache_sync(stop, informer, timeout=10.0)
+        relists_before = metrics.default_registry.counter_value(
+            "watch_relists_total", {"kind": "Service"})
+        adds.clear()
+
+        # the gap: stream dies silently, then the world changes
+        assert api.store("Service").partition_watch() >= 1
+        changed.metadata.annotations["k"] = "v"
+        kube.services.update(changed)
+        kube.services.delete("default", "goes")
+        kube.services.create(make_service("arrives"))
+        api.store("Service").heal_watch()
+
+        assert wait_until(lambda: deletes == ["goes"]
+                          and adds == ["arrives"]
+                          and updates == ["changes"]), \
+            (adds, updates, deletes)
+        # unchanged object: no delta of any kind
+        time.sleep(0.1)
+        assert "stays" not in adds + updates + deletes
+        # cache converged to the fresh world
+        names = sorted(o.metadata.name for o in informer.lister.list())
+        assert names == ["arrives", "changes", "stays"]
+        assert metrics.default_registry.counter_value(
+            "watch_relists_total", {"kind": "Service"}) \
+            == relists_before + 1
+    finally:
+        stop.set()
+
+
+def test_relist_invalidates_fingerprint_of_missed_change():
+    """A stale fingerprint skip cannot survive a relist: the synthetic
+    UPDATE delta for an object changed while disconnected reaches the
+    controller's note_event exactly like a live watch event, dropping
+    the recorded fingerprint — while an unchanged object's gate stays
+    warm (no spurious full resync for the idle fleet)."""
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintCache,
+    )
+
+    api = FakeAPIServer()
+    kube = KubeClient(api)
+    idle = kube.services.create(make_service("idle"))
+    drifts = kube.services.create(make_service("drifts"))
+
+    fp = FingerprintCache(
+        "relist-test", lambda o: (o.metadata.annotations.get("k"),))
+    factory = SharedInformerFactory(api, resync_period=30)
+    informer = factory.services()
+    # the controllers' wiring shape: real watch deltas invalidate,
+    # resync re-deliveries do not
+    informer.add_event_handler(
+        update=lambda old, new: fp.note_event(new.key()),
+        delete=lambda o: fp.note_event(o.key()),
+        resync=lambda o, wave: None)
+    stop = threading.Event()
+    factory.start(stop)
+    try:
+        assert wait_for_cache_sync(stop, informer, timeout=10.0)
+        fp.record(idle.key(), idle)
+        fp.record(drifts.key(), drifts)
+        assert fp.matches(idle.key(), idle)
+        assert fp.matches(drifts.key(), drifts)
+
+        assert api.store("Service").partition_watch() >= 1
+        drifts.metadata.annotations["k"] = "v"
+        updated = kube.services.update(drifts)
+        api.store("Service").heal_watch()
+
+        # the missed change's gate entry is gone (the record itself is
+        # dropped, so even the OLD object no longer matches)...
+        assert wait_until(lambda: not fp.matches(drifts.key(), drifts))
+        assert not fp.matches(drifts.key(), updated)
+        # ...while the unchanged object's gate stays warm
+        assert fp.matches(idle.key(), idle)
+    finally:
+        stop.set()
+
+
 def test_resync_spread_tagged_handler_receives_wave():
     """Handlers registering ``resync=`` get tagged (obj, wave)
     re-deliveries; plain handlers keep update(obj, obj)."""

@@ -121,3 +121,264 @@ def test_crashing_run_callback_stops_the_process_loudly():
     assert wait_until(lambda: started == ["b"])
     stop2.set()
     t2.join(timeout=5.0)
+
+
+# -- lifecycle resilience: step-down, fencing, handoff (ISSUE 6) --------
+
+from aws_global_accelerator_controller_tpu.resilience import MutationFence
+
+
+class _BrokenLeases:
+    """A kube client whose Lease surface is unreachable (apiserver
+    partition as seen from ONE candidate)."""
+
+    class _Leases:
+        def get(self, *a, **k):
+            raise OSError("chaos: apiserver unreachable")
+
+        def create(self, *a, **k):
+            raise OSError("chaos: apiserver unreachable")
+
+        def update(self, *a, **k):
+            raise OSError("chaos: apiserver unreachable")
+
+    def __init__(self):
+        self.leases = self._Leases()
+
+
+def test_leader_steps_down_past_renew_deadline_and_rejoins():
+    """ISSUE 6 satellite (elector bugfix): a leading candidate whose
+    renewals keep failing past the renew deadline must STEP DOWN —
+    seal its fence, fire the lost-leadership callback, clear
+    is_leader — and re-enter the acquire loop instead of returning
+    from run(); once the apiserver heals it must lead again under a
+    strictly larger fencing token."""
+    kube = KubeClient(FakeAPIServer())
+    fence = MutationFence()
+    le = LeaderElection("test-lock", "default", kube, identity="a",
+                        lease_duration=0.5, renew_deadline=0.2,
+                        retry_period=0.03, fence=fence)
+    stop = threading.Event()
+    starts, losses = [], []
+
+    def on_start(leader_stop):
+        starts.append(time.monotonic())
+        leader_stop.wait()
+
+    t = threading.Thread(target=le.run, args=(stop, on_start),
+                         kwargs={"on_stopped_leading":
+                                 lambda: losses.append(time.monotonic())},
+                         daemon=True)
+    t.start()
+    assert wait_until(lambda: len(starts) == 1)
+    token_first = fence.token
+    assert not fence.is_tripped()
+
+    healthy_kube, le.kube = le.kube, _BrokenLeases()   # partition
+    assert wait_until(lambda: len(losses) == 1, timeout=5.0), \
+        "renewals failing past the renew deadline must step down"
+    assert not le.is_leader.is_set()
+    assert fence.is_sealed(), \
+        "lost leadership must seal the fence before the callback"
+    assert t.is_alive(), "the elector must stay in the acquire loop"
+
+    le.kube = healthy_kube                             # heal
+    assert wait_until(lambda: len(starts) == 2, timeout=5.0), \
+        "a healed standby must re-acquire"
+    assert le.is_leader.is_set()
+    assert not fence.is_sealed(), "new term must re-arm the fence"
+    assert fence.token > token_first, \
+        "the fencing token must be strictly monotone across terms"
+    stop.set()
+    t.join(timeout=5.0)
+
+
+def test_handoff_under_conflict_storm_single_leader_fenced():
+    """ISSUE 6 satellite (leader-handoff coverage): two electors on
+    one fake lease through a seeded resourceVersion conflict storm —
+    exactly one leader at any instant, lease_transitions monotone,
+    and the deposed leader's fence observed sealed before the
+    successor's first act as leader."""
+    api = FakeAPIServer()
+    api.arm_chaos(seed=20260804).set_conflict_rate(0.3, kind="Lease")
+    kube = KubeClient(api)
+    fences = {"a": MutationFence(), "b": MutationFence()}
+    electors, stops, threads = {}, {}, {}
+    events = []     # ("start"|"loss", name, other fence sealed?)
+    lock = threading.Lock()
+
+    def make(name):
+        le = LeaderElection("test-lock", "default", kube, identity=name,
+                            lease_duration=0.6, renew_deadline=0.25,
+                            retry_period=0.03, fence=fences[name])
+        stop = threading.Event()
+        other = "b" if name == "a" else "a"
+
+        def on_start(leader_stop):
+            with lock:
+                # the successor's first mutation would happen after
+                # this point; the deposed predecessor's fence must
+                # already be sealed (or never have led)
+                events.append(("start", name,
+                               fences[other].is_sealed()
+                               or fences[other].token == 0))
+            leader_stop.wait()
+
+        def on_loss():
+            with lock:
+                events.append(("loss", name, fences[name].is_sealed()))
+
+        t = threading.Thread(target=le.run, args=(stop, on_start),
+                             kwargs={"on_stopped_leading": on_loss},
+                             daemon=True)
+        t.start()
+        electors[name], stops[name], threads[name] = le, stop, t
+
+    make("a")
+    make("b")
+    assert wait_until(lambda: any(le.is_leader.is_set()
+                                  for le in electors.values()),
+                      timeout=10.0)
+
+    # continuous invariant sampling while the storm runs
+    violations = []
+    transitions_seen = []
+    sample_stop = threading.Event()
+
+    def sample():
+        while not sample_stop.is_set():
+            if all(le.is_leader.is_set() for le in electors.values()):
+                violations.append(time.monotonic())
+            try:
+                lease = kube.leases.get("default", "test-lock")
+                transitions_seen.append(lease.spec.lease_transitions)
+            except Exception:
+                pass
+            time.sleep(0.005)
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+
+    # force a handoff: partition whichever candidate leads first
+    leader = "a" if electors["a"].is_leader.is_set() else "b"
+    standby = "b" if leader == "a" else "a"
+    healthy, electors[leader].kube = electors[leader].kube, \
+        _BrokenLeases()
+    assert wait_until(
+        lambda: electors[standby].is_leader.is_set(), timeout=10.0), \
+        "the standby must take over the expired lease"
+    electors[leader].kube = healthy
+    time.sleep(0.3)
+    sample_stop.set()
+    sampler.join(timeout=2.0)
+
+    assert not violations, \
+        f"both candidates led at once at {violations}"
+    assert fences[leader].is_sealed() or electors[leader].is_leader.is_set()
+    with lock:
+        got = list(events)
+    starts = [e for e in got if e[0] == "start"]
+    assert len(starts) >= 2, got
+    assert all(ok for _, _, ok in starts), \
+        f"a successor started before its predecessor's fence sealed: {got}"
+    losses = [e for e in got if e[0] == "loss"]
+    assert losses and all(ok for _, _, ok in losses), \
+        f"a loss callback ran before its own fence sealed: {got}"
+    # lease_transitions monotone non-decreasing, and the handoff bumped it
+    assert transitions_seen == sorted(transitions_seen), \
+        "lease_transitions went backwards"
+    assert transitions_seen[-1] > transitions_seen[0] or \
+        max(transitions_seen) >= 1
+
+    for name in stops:
+        stops[name].set()
+    for name in threads:
+        threads[name].join(timeout=5.0)
+
+
+def test_release_waits_for_run_callback_drain():
+    """Review regression: on process stop the lease must be released
+    only AFTER the leader run callback (which owns the ordered drain)
+    has returned — releasing first would let a standby take over and
+    write concurrently with this process's still-draining flushes."""
+    kube = KubeClient(FakeAPIServer())
+    le = LeaderElection("test-lock", "default", kube, identity="a",
+                        lease_duration=0.5, renew_deadline=0.3,
+                        retry_period=0.05)
+    stop = threading.Event()
+    times = {}
+    real_release = le._release
+
+    def tracked_release():
+        times["released"] = time.monotonic()
+        real_release()
+
+    le._release = tracked_release
+
+    def on_start(leader_stop):
+        leader_stop.wait()
+        time.sleep(0.3)               # the ordered drain
+        times["drained"] = time.monotonic()
+
+    t = threading.Thread(target=le.run, args=(stop, on_start),
+                         daemon=True)
+    t.start()
+    assert wait_until(lambda: le.is_leader.is_set())
+    stop.set()
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert "drained" in times and "released" in times
+    assert times["released"] >= times["drained"], \
+        "lease released while the run callback was still draining"
+    # and the lease really is free afterwards
+    lease = kube.leases.get("default", "test-lock")
+    assert lease.spec.holder_identity == ""
+
+
+def test_lease_deleted_mid_term_keeps_token_monotone():
+    """Review regression: an operator deleting the Lease mid-term must
+    not reset the fencing token — the re-created lease carries the
+    transitions count forward, so a later loss + re-acquire still arms
+    a strictly larger token instead of crashing the elector."""
+    kube = KubeClient(FakeAPIServer())
+    fence = MutationFence()
+    le = LeaderElection("test-lock", "default", kube, identity="a",
+                        lease_duration=0.5, renew_deadline=0.25,
+                        retry_period=0.03, fence=fence)
+    stop = threading.Event()
+    starts = []
+
+    def on_start(leader_stop):
+        starts.append(time.monotonic())
+        leader_stop.wait()
+
+    t = threading.Thread(target=le.run, args=(stop, on_start),
+                         daemon=True)
+    t.start()
+    assert wait_until(lambda: len(starts) == 1)
+    token_first = fence.token
+
+    # operator deletes the lease mid-term; the next renewal recreates
+    kube.leases.delete("default", "test-lock")
+    assert wait_until(
+        lambda: _lease_transitions(kube) > token_first, timeout=5.0), \
+        "re-created lease must carry the transitions count forward"
+
+    # force a loss + re-acquire: the new term's arm must not raise
+    healthy, le.kube = le.kube, _BrokenLeases()
+    assert wait_until(lambda: fence.is_sealed(), timeout=5.0)
+    le.kube = healthy
+    assert wait_until(lambda: len(starts) == 2, timeout=5.0), \
+        "elector must re-lead after the heal (arm must not crash)"
+    assert fence.token > token_first
+    assert not fence.is_sealed()
+    stop.set()
+    t.join(timeout=10.0)
+
+
+def _lease_transitions(kube):
+    try:
+        return kube.leases.get("default",
+                               "test-lock").spec.lease_transitions
+    except Exception:
+        return -1

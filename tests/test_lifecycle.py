@@ -1,0 +1,189 @@
+"""Fenced shutdown + lifecycle fence unit tests (ISSUE 6 tentpole b).
+
+The ordered-stop contract (manager/manager.py ``ManagerHandle.stop``):
+fence new mutation intents, drain the write coalescer under a
+deadline with every waiter completed exactly once, seal, drain
+workqueues, join workers — and the lease released LAST (by the
+elector, not the manager; tests/test_leaderelection.py covers that
+side)."""
+import time
+
+import pytest
+
+from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+from aws_global_accelerator_controller_tpu.resilience import (
+    FencedError,
+    MutationFence,
+)
+
+from harness import Cluster, wait_until
+
+REGION = "ap-northeast-1"
+
+
+def managed_service(name):
+    hostname = f"{name}-0123456789abcdef.elb.{REGION}.amazonaws.com"
+    return Service(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            annotations={AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                         AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION:
+                             "true"}),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=hostname)])),
+    )
+
+
+# -- MutationFence unit contracts ---------------------------------------
+
+def test_fence_stages_and_flush_pass():
+    fence = MutationFence()
+    fence.check("coalescer")        # open: no-op
+    assert fence.trip("shutdown") is True
+    assert fence.trip("shutdown") is False     # idempotent
+    with pytest.raises(FencedError) as exc:
+        fence.check("coalescer")
+    assert not exc.value.sealed
+    # the drain window's permit: a flush thread passes a TRIPPED fence
+    with fence.flush_pass():
+        fence.check("wrapper")
+    # ...but never a SEALED one
+    fence.seal("shutdown")
+    with fence.flush_pass():
+        with pytest.raises(FencedError) as exc:
+            fence.check("wrapper")
+    assert exc.value.sealed
+
+
+def test_fence_token_monotone_across_arms():
+    fence = MutationFence()
+    fence.arm(3)
+    assert fence.token == 3
+    fence.seal("lease lost")
+    with pytest.raises(ValueError):
+        fence.arm(3)        # a stale term may not masquerade as new
+    fence.arm(4)
+    assert fence.token == 4 and not fence.is_sealed()
+
+
+def test_fenced_error_is_no_retry():
+    from aws_global_accelerator_controller_tpu.errors import is_no_retry
+    assert is_no_retry(FencedError("shutdown", 1, sealed=True))
+
+
+# -- ordered manager stop ----------------------------------------------
+
+def test_ordered_stop_fences_drains_and_joins():
+    """The full phase sequence over a live converged cluster: the
+    report says drained+joined, the shutdown_duration metric is
+    observed, and afterwards BOTH write chokepoints (coalescer intent
+    submit, wrapper mutation call) reject with FencedError."""
+    reg = metrics.default_registry
+    durations_before = reg.render().count("shutdown_duration_seconds_count")
+    cluster = Cluster(workers=2, queue_qps=1000.0,
+                      queue_burst=1000).start()
+    try:
+        for i in range(4):
+            name = f"ls{i}"
+            cluster.cloud.elb.register_load_balancer(
+                name, f"{name}-0123456789abcdef.elb.{REGION}"
+                      ".amazonaws.com", REGION)
+            cluster.kube.services.create(managed_service(name))
+        wait_until(lambda: len(cluster.cloud.ga.list_accelerators()) == 4,
+                   message="fleet converged before the stop")
+
+        report = cluster.shutdown(ordered=True, deadline=8.0)
+        assert report["drained"] is True
+        assert report["joined"] is True, \
+            "controller threads still alive after the ordered stop"
+        assert report["duration_s"] < 8.0
+
+        fence = cluster.factory.fence
+        assert fence.is_sealed()
+        # post-fence mutations: rejected at both chokepoints
+        provider = cluster.factory.global_provider()
+        with pytest.raises(FencedError):
+            provider.apis.ga.create_accelerator("late", "IPV4", True, {})
+        with pytest.raises(FencedError):
+            provider.coalescer.change_record_sets(
+                "Z1", [("UPSERT", None)])
+        assert "shutdown_duration_seconds_count" in reg.render()
+        assert reg.render().count("shutdown_duration_seconds_count") \
+            >= durations_before
+    finally:
+        cluster.stop.set()      # idempotent safety
+
+
+def test_ordered_stop_mid_storm_completes_every_waiter():
+    """Stop fired while a create storm is mid-flight: every in-flight
+    coalescer waiter completes exactly once (flushed or FencedError —
+    never hung), the stop meets its deadline, and no mutation lands
+    after the seal."""
+    cluster = Cluster(workers=4, queue_qps=10000.0,
+                      queue_burst=10000).start()
+    n = 30
+    try:
+        for i in range(n):
+            name = f"ms{i:03d}"
+            cluster.cloud.elb.register_load_balancer(
+                name, f"{name}-0123456789abcdef.elb.{REGION}"
+                      ".amazonaws.com", REGION)
+        for i in range(n):
+            cluster.kube.services.create(managed_service(f"ms{i:03d}"))
+        wait_until(
+            lambda: len(cluster.cloud.ga.list_accelerators()) >= n // 4,
+            message="storm under way")
+
+        start = time.monotonic()
+        report = cluster.shutdown(ordered=True, deadline=8.0)
+        elapsed = time.monotonic() - start
+        assert elapsed < 8.5, f"stop blew its deadline ({elapsed:.1f}s)"
+        assert report["joined"] is True
+
+        # the seal is the cut: nothing mutates afterwards
+        calls_at_stop = dict(cluster.cloud.faults.call_counts())
+        time.sleep(0.5)
+        calls_later = cluster.cloud.faults.call_counts()
+        mutations = [m for m in calls_later
+                     if m.startswith(("create_", "update_", "delete_",
+                                      "change_", "add_", "remove_",
+                                      "tag_"))]
+        for m in mutations:
+            assert calls_later[m] == calls_at_stop.get(m, 0), \
+                f"{m} issued after the ordered stop sealed the fence"
+
+        # no hung coalescer futures: every group idle
+        coalescer = cluster.factory._coalescer
+        if coalescer is not None:
+            with coalescer._lock:
+                groups = list(coalescer._groups.values())
+            for g in groups:
+                assert not g.pending and not g.flushing, \
+                    "a cohort was left pending after the drain"
+    finally:
+        cluster.stop.set()
+
+
+def test_stop_event_alone_still_works():
+    """The historical abrupt path (tests and the crash e2e rely on
+    it): setting the stop event without the ordered sequence must not
+    deadlock or fence anything."""
+    cluster = Cluster().start()
+    cluster.shutdown()          # abrupt
+    time.sleep(0.1)
+    assert not cluster.factory.fence.is_tripped()

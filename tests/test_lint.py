@@ -394,3 +394,46 @@ def test_seeded_mutation_of_update_accelerator_is_caught(tmp_path):
 
     # sanity: the unmutated file is clean (the tree gate's per-file view)
     assert concurrency_lint.lint_files([provider_py]) == []
+
+
+def test_l108_unfenced_bare_write_fires_and_waiver_suppresses():
+    """Bare AWS writes with no lexical fence consult fire L108 (and
+    L105 — a bare write is doubly wrong); the ``# race:`` waiver
+    suppresses line 17's deliberate teardown call."""
+    got = _cfindings("l108_unfenced_write.py")
+    assert [(c, l) for c, l in got if c == "L108"] == [
+        ("L108", 7), ("L108", 8), ("L108", 12)]
+
+
+def test_l108_fenced_and_apis_routed_writes_clean():
+    """A lexical fence.check, a flush_pass drain window, and a write
+    routed through ``apis`` (runtime-gated by ResilientAPIs.invoke)
+    are all clean under L108."""
+    assert _cfindings("l108_fenced_write.py") == []
+
+
+def test_l108_seeded_fence_strip_from_wrapper_caught(tmp_path):
+    """Acceptance probe tied to the shipped code shape: strip the
+    fence consult from the REAL ResilientAPIs.invoke and the gate must
+    fire — every apis.* write in the tree relies on that one line."""
+    wrapper_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/resilience/wrapper.py")
+    src = wrapper_py.read_text()
+    needle = ("            if self.fence is not None "
+              "and op in MUTATION_METHODS:\n"
+              "                self.fence.check(\"wrapper\")\n")
+    assert src.count(needle) == 1, \
+        "ResilientAPIs.invoke fence-gate shape changed; update this probe"
+    mutated = src.replace(needle, "            pass\n")
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "resilience")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "wrapper.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L108"]
+    assert findings, "a fence-less ResilientAPIs.invoke was not caught"
+
+    # sanity: the unmutated wrapper is clean under its own rule
+    assert [x for x in concurrency_lint.lint_files([wrapper_py])
+            if x.code == "L108"] == []

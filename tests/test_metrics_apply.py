@@ -256,3 +256,74 @@ def test_race_detector_counters_exposed():
     text = default_registry.render()
     assert "race_lockset_checks" in text
     assert "shared_view_mutations_blocked" in text
+
+
+def test_lifecycle_and_relist_counters_exposed():
+    """ISSUE 6's lifecycle telemetry: fenced_mutations_total{surface},
+    watch_relists_total{kind} and the shutdown_duration_seconds
+    summary all register, accumulate and render for the scrape
+    endpoint."""
+    from aws_global_accelerator_controller_tpu.metrics import (
+        default_registry,
+        record_fenced_mutation,
+        record_shutdown_duration,
+        record_watch_relist,
+    )
+
+    fenced = default_registry.counter_value(
+        "fenced_mutations_total", {"surface": "m-probe"})
+    relists = default_registry.counter_value(
+        "watch_relists_total", {"kind": "MProbe"})
+
+    record_fenced_mutation("m-probe")
+    record_fenced_mutation("m-probe")
+    record_watch_relist("MProbe")
+    record_shutdown_duration(0.25)
+
+    assert default_registry.counter_value(
+        "fenced_mutations_total", {"surface": "m-probe"}) == fenced + 2
+    assert default_registry.counter_value(
+        "watch_relists_total", {"kind": "MProbe"}) == relists + 1
+
+    text = default_registry.render()
+    assert 'fenced_mutations_total{surface="m-probe"}' in text
+    assert 'watch_relists_total{kind="MProbe"}' in text
+    assert "shutdown_duration_seconds_sum" in text
+    assert "shutdown_duration_seconds_count" in text
+
+
+def test_ordered_stop_observes_shutdown_duration_and_fence_counters():
+    """End-to-end: a live cluster's ordered stop lands one
+    shutdown_duration observation, and a post-stop mutation attempt
+    shows up in fenced_mutations_total — the series an operator pages
+    on when a replica wedges during rollout."""
+    import re
+
+    import pytest
+
+    from aws_global_accelerator_controller_tpu import metrics as m
+    from aws_global_accelerator_controller_tpu.resilience import (
+        FencedError,
+    )
+
+    def shutdown_count():
+        got = re.search(r"^shutdown_duration_seconds_count (\d+)",
+                        m.default_registry.render(), re.M)
+        return int(got.group(1)) if got else 0
+
+    before = shutdown_count()
+    fenced_before = m.default_registry.counter_value(
+        "fenced_mutations_total", {"surface": "wrapper"})
+    cluster = Cluster().start()
+    try:
+        report = cluster.shutdown(ordered=True, deadline=5.0)
+        assert report["joined"] is True
+        assert shutdown_count() == before + 1
+        provider = cluster.factory.global_provider()
+        with pytest.raises(FencedError):
+            provider.apis.ga.create_accelerator("late", "IPV4", True, {})
+        assert m.default_registry.counter_value(
+            "fenced_mutations_total", {"surface": "wrapper"}) \
+            == fenced_before + 1
+    finally:
+        cluster.stop.set()
