@@ -1,4 +1,4 @@
-"""AST-based concurrency contract lints (rules L101-L104).
+"""AST-based concurrency contract lints (rules L101-L109).
 
 The static half of the concurrency checker: a whole-program pass over
 the tree that enforces the synchronization contracts PR 1 introduced as
@@ -66,6 +66,18 @@ zero-findings gate philosophy):
                          consults AWS would silently turn the skip
                          path back into the O(N)-per-resync cost it
                          exists to remove.  Package-scoped like L105.
+  L109 class-tagged enqueues
+                         Workqueue enqueues from the controller /
+                         reconcile packages (``<x>queue.add`` /
+                         ``add_rate_limited`` / ``add_after``) must
+                         pass an explicit ``klass=`` — a raw enqueue
+                         silently defaults the key's traffic class,
+                         so an interactive change could ride the
+                         background tier (or a resync wave the
+                         interactive one) and the overload scheduler's
+                         latency/shed contract breaks
+                         (kube/workqueue.py tiers).  Package-scoped
+                         to controller/ and reconcile/ like L105.
   L108 fenced mutations  Mutation-issuing paths must consult the
                          lifecycle fence (resilience/fence.py): no
                          AWS WRITE method may be reachable after
@@ -187,6 +199,23 @@ def _l105_in_scope(path: Path) -> bool:
     parts = path.parts
     return ("aws_global_accelerator_controller_tpu" in parts
             or "lint_fixtures" in parts)
+
+
+def _l109_in_scope(path: Path) -> bool:
+    """L109 polices the packages that enqueue reconcile keys — the
+    controller and reconcile packages — plus the fixture corpus.
+    Everything else (the queue implementation itself, tests driving
+    queues directly, tools) enqueues on its own terms."""
+    parts = path.parts
+    if "lint_fixtures" in parts:
+        return True
+    return ("aws_global_accelerator_controller_tpu" in parts
+            and ("controller" in parts or "reconcile" in parts))
+
+
+# The enqueue surface rule L109 requires a ``klass=`` keyword on, when
+# the receiver chain names a queue.
+_ENQUEUE_METHODS = {"add", "add_rate_limited", "add_after"}
 
 
 def _l107_fastpath(path: Path, fn_name: str) -> bool:
@@ -571,6 +600,23 @@ class Engine:
                 f"call '...fence.check(...)' in this function, route "
                 f"the write through 'apis' so ResilientAPIs gates it, "
                 f"or waive with '# race: <reason>')"))
+        # L109: an enqueue that names no traffic class silently
+        # defaults the key's tier — the controller/reconcile packages
+        # must say whether a key is interactive, background, or a
+        # requeue keeping its class (CLASS_KEEP).
+        if (len(chain) >= 2 and chain[-1] in _ENQUEUE_METHODS
+                and any("queue" in seg for seg in chain[:-1])
+                and _l109_in_scope(info.path)
+                and not any(kw.arg == "klass" for kw in call.keywords)):
+            self.findings.append(Finding(
+                info.path, line, "L109",
+                f"class-less enqueue '{'.'.join(chain)}()': pass "
+                f"klass= (CLASS_INTERACTIVE for watch events / "
+                f"user-visible changes, CLASS_BACKGROUND for "
+                f"resync/sweep re-deliveries, CLASS_KEEP for "
+                f"requeues) so the key rides the right workqueue "
+                f"tier (kube/workqueue.py), or waive with "
+                f"'# race: <reason>'"))
         # L102: blocking while any lock is held.
         if held and self._is_blocking(chain, held):
             self.findings.append(Finding(

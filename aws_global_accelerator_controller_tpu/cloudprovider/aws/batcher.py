@@ -25,7 +25,15 @@ Lifecycle of an intent:
 3. **Flush.** The first enqueuer into an idle group becomes the flush
    LEADER: it lingers (size-or-deadline — ``max_batch`` intents or
    ``linger`` seconds, whichever first), drains the group, and issues
-   ONE wrapped call for the whole cohort: an atomic
+   ONE wrapped call for the whole cohort.  The linger is
+   DEADLINE-AWARE: a cohort with an INTERACTIVE waiter (the
+   submitting sync's traffic class, reconcile/traffic.py) flushes
+   immediately unless the group is warm — intents arriving within
+   ``warm_gap`` of each other are a bulk wave whose batching the
+   linger exists to capture, so size-or-deadline stays in force.  An
+   urgent single change never pays the batching tax tuned for
+   cohorts; a storm never loses its fold ratio to urgency.  The
+   wrapped call is an atomic
    ``change_resource_record_sets_batch`` per zone, or one merged
    describe + ``update_endpoint_group`` read-modify-write per endpoint
    group.  The call rides the region's ResilientAPIs
@@ -56,6 +64,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, replace
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -71,6 +80,7 @@ from ...metrics import (
     record_mutation_fold,
 )
 from ...reconcile.fingerprint import note_provider_mutation
+from ...reconcile.traffic import CLASS_INTERACTIVE, current_class
 from .types import EndpointDescription
 
 logger = logging.getLogger(__name__)
@@ -93,6 +103,17 @@ class CoalesceConfig:
     max_batch: int = 64
     # deadline trigger: seconds the leader lingers for cohort intents
     linger: float = 0.005
+    # deadline-aware linger: a cohort with an INTERACTIVE waiter skips
+    # the linger UNLESS the group is "warm" — intents arriving within
+    # ``warm_gap`` of each other mean a bulk wave is in flight and
+    # batching pays (size-or-deadline stays in force); None defaults
+    # to ``linger``.  The NCCL shape: low-latency protocol for small
+    # messages, bandwidth protocol for bulk (PAPERS.md).
+    warm_gap: Optional[float] = None
+
+    @property
+    def effective_warm_gap(self) -> float:
+        return self.linger if self.warm_gap is None else self.warm_gap
 
 
 # the fake factory's profile: a shorter linger keeps single-writer unit
@@ -266,7 +287,8 @@ class _Group:
     """One coalescing queue: a hosted zone or an endpoint group."""
 
     __slots__ = ("kind", "key", "cond", "pending", "index", "leader",
-                 "flushing", "dead")
+                 "flushing", "dead", "urgent", "last_submit", "last_gap",
+                 "last_drain", "last_drain_size")
 
     def __init__(self, kind: str, key: str):
         self.kind = kind
@@ -282,6 +304,22 @@ class _Group:
         self.leader = False     # a leader is lingering / about to drain
         self.flushing = False   # a drained batch is on the wire
         self.dead = False       # pruned from the coalescer's map
+        # an INTERACTIVE waiter is in the pending cohort: the leader
+        # cuts its linger short UNLESS the group is warm (a bulk wave
+        # is arriving back-to-back) — an urgent single change must not
+        # pay the batching deadline tuned for cohorts, and a storm
+        # must not lose its batching to urgency (the deadline-aware
+        # linger, reconcile/traffic.py)
+        self.urgent = False
+        # warmth tracking: time of the last submit into this group and
+        # the gap it observed, plus when the group last drained and how
+        # big that cohort was — a group that just flushed a multi-intent
+        # cohort is mid-wave even when scheduler jitter opens a single
+        # inter-arrival gap past warm_gap
+        self.last_submit = float("-inf")
+        self.last_gap = float("inf")
+        self.last_drain = float("-inf")
+        self.last_drain_size = 0
 
 
 class MutationCoalescer:
@@ -297,6 +335,13 @@ class MutationCoalescer:
         self._clock = clock
         self._lock = locks.make_lock("coalescer-groups")
         self._groups: Dict[Tuple[str, str], _Group] = {}
+        # warmth survives group pruning: idle groups are deleted after
+        # every drain (the map must not grow with zone/EG churn), but
+        # the NEXT submit moments later must still read as mid-wave or
+        # the urgent cut fires inside every storm (a fresh group knows
+        # no history).  Bounded LRU; (last_submit, last_gap,
+        # last_drain, last_drain_size) per group key.
+        self._warmth: "OrderedDict[Tuple[str, str], tuple]" = OrderedDict()
         # lifecycle fence (resilience/fence.py): tripped = new intents
         # rejected at submit; lingering leaders flush immediately (the
         # drain); sealed = flushes rejected too (fail-fast)
@@ -334,11 +379,19 @@ class MutationCoalescer:
 
     # ------------------------------------------------------------------
 
+    # pruned-group warmth entries kept (LRU); far above any live zone/EG
+    # count, far below leaking per churned resource forever
+    _WARMTH_MAX = 8192
+
     def _group(self, kind: str, key: str) -> _Group:
         with self._lock:
             group = self._groups.get((kind, key))
             if group is None:
                 group = _Group(kind, key)
+                warm = self._warmth.get((kind, key))
+                if warm is not None:
+                    (group.last_submit, group.last_gap,
+                     group.last_drain, group.last_drain_size) = warm
                 self._groups[(kind, key)] = group
             return group
 
@@ -357,6 +410,10 @@ class MutationCoalescer:
             for future in futures:
                 self._direct(group, future)
             return futures
+        # a submitter running an interactive-class sync marks the
+        # cohort urgent: its waiter is a user-visible change, so the
+        # flush must not linger for cohort-mates that may never come
+        urgent = current_class() == CLASS_INTERACTIVE
         folds = 0
         while True:
             group = self._group(kind, key)
@@ -371,10 +428,16 @@ class MutationCoalescer:
                         folds += _fold_endpoint_op(group,
                                                    future.payload,
                                                    future)
+                now = self._clock()
+                group.last_gap = now - group.last_submit
+                group.last_submit = now
+                if urgent:
+                    group.urgent = True
                 lead = not group.leader
                 if lead:
                     group.leader = True
-                elif len(group.pending) >= self.config.max_batch:
+                elif (urgent
+                      or len(group.pending) >= self.config.max_batch):
                     group.cond.notify_all()  # wake the lingering leader
                 break
         if folds:
@@ -400,6 +463,20 @@ class MutationCoalescer:
         with group.cond:
             deadline = self._clock() + self.config.linger
             while len(group.pending) < self.config.max_batch:
+                # an urgent (interactive-waiter) cohort flushes NOW —
+                # unless the group is WARM: intents arriving within
+                # warm_gap of each other, or a multi-intent cohort
+                # drained within a few warm_gaps (mid-wave, even when
+                # scheduler jitter opens one larger gap).  A bulk wave
+                # keeps size-or-deadline; an idle group's single
+                # urgent change flushes immediately.
+                warm_gap = self.config.effective_warm_gap
+                warm = (group.last_gap <= warm_gap
+                        or (group.last_drain_size > 1
+                            and self._clock() - group.last_drain
+                            <= 8 * warm_gap))
+                if group.urgent and not warm:
+                    break
                 # a tripped fence ends the linger NOW: no new intents
                 # can arrive (submit rejects them), so waiting out the
                 # deadline would only delay the drain
@@ -416,6 +493,9 @@ class MutationCoalescer:
             intents = list(group.pending)
             del group.pending[:]
             group.index.clear()
+            group.urgent = False   # the urgent waiters drain with us
+            group.last_drain = self._clock()
+            group.last_drain_size = len(intents)
             group.leader = False   # mid-flush arrivals elect the next one
             group.flushing = True
         # the flush-pass permit lets this cohort complete through a
@@ -445,11 +525,19 @@ class MutationCoalescer:
                 # one-flush-per-group serialization).
                 if not group.pending and not group.leader:
                     group.dead = True
+                warmth = (group.last_submit, group.last_gap,
+                          group.last_drain, group.last_drain_size)
             if group.dead:
                 with self._lock:
-                    if self._groups.get((group.kind, group.key)) \
-                            is group:
-                        del self._groups[(group.kind, group.key)]
+                    # the warmth outlives the pruned group (see
+                    # __init__) so the next submit reads mid-wave
+                    wkey = (group.kind, group.key)
+                    self._warmth.pop(wkey, None)
+                    self._warmth[wkey] = warmth
+                    while len(self._warmth) > self._WARMTH_MAX:
+                        self._warmth.popitem(last=False)
+                    if self._groups.get(wkey) is group:
+                        del self._groups[wkey]
 
     # ------------------------------------------------------------------
     # ordered-stop drain

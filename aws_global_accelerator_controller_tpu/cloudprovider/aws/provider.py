@@ -20,6 +20,7 @@ SURVEY.md §7 "Deliberate improvements"):
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -36,6 +37,7 @@ from ...errors import (
 from ...kube.objects import Ingress, LoadBalancerIngress, Service
 
 from ...analysis import locks
+from ...resilience import ErrorClass, classify
 from ...metrics import record_coalesced_read, record_fleet_scan
 from .api import AWSAPIs
 from .batcher import (
@@ -144,12 +146,18 @@ class FleetDiscoveryState:
     adopted at most discovery_cache_ttl later, the same drift window
     the per-key TTL cache already accepts (and the resync backstop's
     cadence).  ``fleet_epoch`` fences scans against concurrent
-    invalidations; creates that land DURING a scan are logged in
-    ``prime_log`` and merged into the installing snapshot, so the index
-    stays installable (and the O(1) definitely-absent answer stays
-    available) even under a sustained creation storm -- previously
-    every create fenced out the in-flight scan and a storm degenerated
-    to one full O(fleet) scan per new resource.
+    invalidations; creates/deletes/re-tags that land DURING a scan are
+    recorded in the ordered ``prime_log`` and replayed over the
+    installing snapshot, so the index stays installable (and the O(1)
+    definitely-absent answer stays available) even under sustained
+    mixed churn -- previously every create fenced out the in-flight
+    scan and a storm degenerated to one full O(fleet) scan per new
+    resource.  The install condition is the epoch alone, NOT the tag
+    ``gen`` (every delete bumps gen via its tag drop, so under
+    sustained mixed churn a gen-keyed install would never land, the
+    index would expire, and every new key's ensure would degenerate
+    back to a full rescan serialized behind the singleflight:
+    whole-second event->converged tails on unrelated keys).
 
     ``reads`` coalesces identical in-flight reads: N workers sharing a
     provider frequently need the SAME read at the same moment (the
@@ -171,7 +179,15 @@ class FleetDiscoveryState:
         self.fleet_at = None
         self.fleet_epoch = 0
         self.scans_inflight = 0
-        self.prime_log: list = []  # (target key, arn) primed mid-scan
+        # ONE ordered log of our own index mutations landing mid-scan:
+        # ("prime", target key, arn) inserts and ("death", arn)
+        # evictions, replayed IN ORDER over the installing snapshot —
+        # a create-then-delete (or re-tag-then-delete) within one scan
+        # window must not re-install the dead arn, which separate
+        # prime/death sets could not express (arns are never recycled,
+        # so replaying the whole log is idempotent and order-correct)
+        self.prime_log: list = []
+        self.refresh_inflight = False  # one background refresh at a time
         self.reads = Singleflight(
             on_coalesce=lambda key: record_coalesced_read(key[0]))
 
@@ -330,6 +346,16 @@ class AWSProvider:
                     < self.discovery_cache_ttl)
                 arns = (self._s.fleet_index.get(key, ())
                         if fleet_fresh else None)
+            if fleet_fresh:
+                # stale-while-revalidate: approaching the TTL, rebuild
+                # the index on a background thread so no reconcile
+                # worker ever BLOCKS on the O(fleet) tag sweep — at
+                # production fleet sizes that sweep takes whole
+                # seconds, and every ensure that rode it (singleflight)
+                # inherited the stall straight into its
+                # event->converged latency (the mixed-soak's original
+                # 1s p99 tail)
+                self._maybe_refresh_fleet_async()
             if arns is not None:
                 confirmed: "list | None" = []
                 for arn in arns:
@@ -388,6 +414,39 @@ class AWSProvider:
                                               time.monotonic())
         return result
 
+    # refresh the index once it has aged past this fraction of the TTL
+    # (early enough that the refresh completes before hard expiry even
+    # when the O(fleet) sweep itself takes seconds)
+    FLEET_REFRESH_FRACTION = 0.75
+
+    def _maybe_refresh_fleet_async(self) -> None:
+        """Kick ONE background fleet rescan when the index is aging
+        (past ``FLEET_REFRESH_FRACTION`` of the TTL).  Callers keep
+        serving the current index — still inside the documented
+        single-TTL drift window — instead of the first post-expiry
+        ensure paying the whole sweep synchronously."""
+        with self._s.lock:
+            if self._s.refresh_inflight or self._s.fleet_at is None:
+                return
+            age = time.monotonic() - self._s.fleet_at
+            if age < self.discovery_cache_ttl * self.FLEET_REFRESH_FRACTION:
+                return
+            self._s.refresh_inflight = True
+
+        def refresh():
+            try:
+                self._scan_fleet(False)
+            except Exception:
+                logger.exception("background fleet refresh failed "
+                                 "(the synchronous expiry path remains "
+                                 "the backstop)")
+            finally:
+                with self._s.lock:
+                    self._s.refresh_inflight = False
+
+        threading.Thread(target=refresh, daemon=True,
+                         name="fleet-index-refresh").start()
+
     def _scan_fleet(self, fresh: bool):
         """One full ListAccelerators + per-ARN tags sweep, singleflighted:
         the sweep is target-independent, so N workers scanning for N
@@ -413,7 +472,6 @@ class AWSProvider:
         with self._s.lock:
             now = time.monotonic()
             fleet_epoch = self._s.fleet_epoch
-            prime_mark = len(self._s.prime_log)
             self._s.scans_inflight += 1
             cached = ({} if fresh else
                       {arn: tags for arn, (tags, at)
@@ -426,25 +484,64 @@ class AWSProvider:
                 arn = accelerator.accelerator_arn
                 tags = cached.get(arn)
                 if tags is None:
-                    tags = self.apis.ga.list_tags_for_resource(arn)
+                    try:
+                        tags = self.apis.ga.list_tags_for_resource(arn)
+                    except AWSAPIError as e:
+                        # TOCTOU with a concurrent delete: an ARN the
+                        # list returned can be gone by its tag read —
+                        # under continuous delete churn that is a
+                        # steady-state event, and failing the WHOLE
+                        # scan poisons every rider of this singleflight
+                        # sweep (their syncs error + requeue for an
+                        # accelerator they never cared about).  The
+                        # committed delete is a real answer for THIS
+                        # arn only: skip it.  A resilience-layer
+                        # failure (hint-carrying) is NOT an answer —
+                        # propagate, exactly like _list_by_tags'
+                        # verify path.
+                        if retry_after_hint(e) > 0 \
+                                or classify(e) is not ErrorClass.NOT_FOUND:
+                            raise
+                        with self._s.lock:
+                            self._drop_tags_locked(arn)
+                        continue
                     self._store_tags(arn, tags, gen)
                 for derived in self._derived_keys(tags):
                     new_index.setdefault(derived, []).append(arn)
                 fleet.append((accelerator, tags))
             with self._s.lock:
-                if (self.FLEET_INDEX_ENABLED and self._s.gen == gen
+                if (self.FLEET_INDEX_ENABLED
                         and self._s.fleet_epoch == fleet_epoch):
-                    # no invalidation landed mid-scan; our own creates
-                    # that did land are in the prime log — merge them
-                    # so the installed snapshot is still the complete
-                    # fleet (out-of-band creates stay on the TTL drift
-                    # contract, as ever)
-                    for tkey, arn in self._s.prime_log[prime_mark:]:
-                        have = new_index.setdefault(tkey, [])
-                        if arn not in have:
-                            have.append(arn)
+                    # no index-lie invalidation landed mid-scan (the
+                    # epoch is the fence; the tag gen is NOT — every
+                    # delete bumps gen, and churn would then starve
+                    # the install forever, see FleetDiscoveryState).
+                    # Our own mid-scan mutations — creates, deletes,
+                    # re-tags — are replayed over the snapshot IN
+                    # ORDER, so a create-then-delete within this scan
+                    # window installs as deleted, not resurrected
+                    # (out-of-band changes stay on the TTL drift
+                    # contract, as ever; replaying the whole log is
+                    # idempotent because arns never recycle).
+                    merged = {k: list(v) for k, v in new_index.items()}
+                    for entry in self._s.prime_log:
+                        if entry[0] == "death":
+                            dead = entry[1]
+                            for k in [k for k, v in merged.items()
+                                      if dead in v]:
+                                rest = [a for a in merged[k]
+                                        if a != dead]
+                                if rest:
+                                    merged[k] = rest
+                                else:
+                                    del merged[k]
+                        else:
+                            _, tkey, arn = entry
+                            have = merged.setdefault(tkey, [])
+                            if arn not in have:
+                                have.append(arn)
                     self._s.fleet_index = {k: tuple(v)
-                                         for k, v in new_index.items()}
+                                           for k, v in merged.items()}
                     self._s.fleet_at = time.monotonic()
             return fleet, gen
         finally:
@@ -506,7 +603,7 @@ class AWSProvider:
                 if arn not in have:
                     self._s.fleet_index[tkey] = have + (arn,)
                 if self._s.scans_inflight:
-                    self._s.prime_log.append((tkey, arn))
+                    self._s.prime_log.append(("prime", tkey, arn))
 
     def _invalidate_discovery_cache(self, arn: str) -> None:
         with self._s.lock:
@@ -515,6 +612,43 @@ class AWSProvider:
             for key in stale:
                 self._s.discovery.pop(key, None)
             self._drop_tags_locked(arn)
+
+    def _evict_arn_locked(self, arn: str) -> None:
+        """Remove ``arn`` from every fleet-index bucket (dropping
+        emptied keys) and every discovery entry that maps to it — the
+        shared surgical-eviction step of the delete and re-tag paths.
+        Caller holds ``_s.lock``."""
+        for tkey, arns in list(self._s.fleet_index.items()):
+            if arn in arns:
+                rest = tuple(a for a in arns if a != arn)
+                if rest:
+                    self._s.fleet_index[tkey] = rest
+                else:
+                    self._s.fleet_index.pop(tkey)
+        stale = [k for k, (a, _) in self._s.discovery.items()
+                 if a == arn]
+        for key in stale:
+            self._s.discovery.pop(key, None)
+
+    def _note_accelerator_deleted(self, arn: str) -> None:
+        """AFTER our ``delete_accelerator`` committed: keep the fleet
+        index COMPLETE by surgical eviction — the mirror of
+        ``_prime_discovery_cache`` keeping it complete across our own
+        creates.  The index minus this arn is still the whole truth,
+        so leaving the dead entry in place — whose next verify-failure
+        would torch the index (``_invalidate_fleet_locked``) — makes
+        every sibling's next ensure pay a fresh O(fleet) tag rescan
+        PER DELETE; under sustained mixed churn those rescans
+        serialize behind the singleflight and put whole-second tails
+        into unrelated keys' event->converged latency.  Runs only on
+        a committed delete (a failed delete keeps the entry, so the
+        accelerator can never go index-invisible while alive); a scan
+        in flight gets the eviction via the ordered mutation log
+        instead of being fenced out (see FleetDiscoveryState)."""
+        with self._s.lock:
+            self._evict_arn_locked(arn)
+            if self._s.scans_inflight:
+                self._s.prime_log.append(("death", arn))
 
     def _drop_tags_locked(self, arn: str) -> None:
         """Invalidate cached tags; bumping the generation fences out any
@@ -749,6 +883,7 @@ class AWSProvider:
                         accelerator.status)
             time.sleep(self.delete_poll_interval)
         self.apis.ga.delete_accelerator(arn)
+        self._note_accelerator_deleted(arn)
         logger.info("Global Accelerator deleted: %s", arn)
 
     # ------------------------------------------------------------------
@@ -800,15 +935,56 @@ class AWSProvider:
         }
         tags.update(specified_tags)
         self.apis.ga.tag_resource(arn, tags)
+        # the re-tag may have MOVED this accelerator to new
+        # owner/hostname discovery keys; the index must not report
+        # those keys definitely-absent for up to TTL (ADVICE r5).
+        # Previously that meant torching the whole index per re-tag —
+        # which under sustained update churn kept it permanently
+        # uninstallable, so every new key's ensure degenerated to a
+        # synchronous O(fleet) rescan (whole-second interactive tails
+        # in the mixed soak).  Instead, read the authoritative MERGED
+        # tag set back (TagResource merges; the create-time cluster
+        # tag survives and our local dict cannot prove it) and
+        # re-index the arn surgically: one extra read per re-tag
+        # instead of one full fleet sweep.
+        try:
+            merged = self.apis.ga.list_tags_for_resource(arn)
+        except AWSAPIError as e:
+            if retry_after_hint(e) > 0:
+                # a brownout (retry budget / deadline / open circuit)
+                # proves nothing about the tags — propagate and let
+                # the sync park, like every other read on this path;
+                # torching the index per re-tag during a brownout
+                # would re-create exactly the rescan collapse the
+                # surgical path exists to avoid
+                raise
+            merged = None   # terminal: can't prove the new keys
         with self._s.lock:
             self._drop_tags_locked(arn)
-            # the re-tag may have MOVED this accelerator to new
-            # owner/hostname discovery keys the fleet index has never
-            # seen; a still-fresh index would report those keys
-            # definitely-absent for up to TTL (ADVICE r5) — it can no
-            # longer claim completeness, so invalidate it here, inside
-            # the same critical section as the tag drop
-            self._invalidate_fleet_locked()
+            # the OLD keys' index buckets and discovery entries now
+            # lie about this arn; left in place, their next verify
+            # would read our own re-tag as out-of-band drift and
+            # torch the fleet index (the rescue path) — evict
+            # surgically like the delete path, then insert + prime
+            # the new keys (verified on use, as ever)
+            now = time.monotonic()
+            self._evict_arn_locked(arn)
+            if merged is None:
+                self._invalidate_fleet_locked()
+            else:
+                for tkey in self._derived_keys(merged):
+                    have = self._s.fleet_index.get(tkey, ())
+                    if arn not in have:
+                        self._s.fleet_index[tkey] = have + (arn,)
+                    self._s.discovery[tkey] = (arn, now)
+                if self._s.scans_inflight:
+                    # an in-flight scan listed this arn's OLD tags:
+                    # log the eviction then the new-key inserts so
+                    # its installed snapshot replays the re-tag in
+                    # order (_scan_fleet_once)
+                    self._s.prime_log.append(("death", arn))
+                    for tkey in self._derived_keys(merged):
+                        self._s.prime_log.append(("prime", tkey, arn))
         return updated
 
     def get_listener(self, accelerator_arn: str) -> Listener:

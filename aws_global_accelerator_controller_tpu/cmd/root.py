@@ -113,6 +113,30 @@ def build_parser() -> argparse.ArgumentParser:
                                  "(the tiered drift sweep that "
                                  "catches out-of-band mutation; "
                                  "default 10). 0 disables the sweep.")
+    controller.add_argument("--queue-aging-horizon", type=float,
+                            default=2.0, metavar="SECONDS",
+                            help="Anti-starvation horizon of the "
+                                 "priority-tiered workqueues: a "
+                                 "background (resync/sweep) item's "
+                                 "effective priority reaches a fresh "
+                                 "interactive item's after waiting "
+                                 "this long (default 2.0; <=0 = "
+                                 "strict interactive-first).")
+    controller.add_argument("--queue-depth-watermark", type=int,
+                            default=512, metavar="N",
+                            help="Overload shed trigger: with more "
+                                 "than N items backlogged on a queue, "
+                                 "background resync/sweep enqueues "
+                                 "are dropped (re-delivered by the "
+                                 "next wave; sheds_total counts "
+                                 "them). 0 disables (default 512).")
+    controller.add_argument("--queue-age-watermark", type=float,
+                            default=1.0, metavar="SECONDS",
+                            help="Overload shed trigger: when the "
+                                 "oldest INTERACTIVE item has waited "
+                                 "this long, background enqueues are "
+                                 "shed first. 0 disables (default "
+                                 "1.0).")
     controller.add_argument("--seed", action="append", default=[],
                             metavar="FILE",
                             help="Apply YAML manifests into the fake API "
@@ -224,18 +248,26 @@ def run_controller(args) -> int:
     fingerprints = FingerprintConfig(
         enabled=not getattr(args, "no_fingerprints", False),
         sweep_every=max(0, getattr(args, "drift_sweep_every", 10)))
+    # overload scheduler knobs, shared by every controller queue
+    # (kube/workqueue.py priority tiers; docs/operations.md runbook)
+    scheduler = dict(
+        aging_horizon=getattr(args, "queue_aging_horizon", 2.0),
+        depth_watermark=max(0, getattr(args, "queue_depth_watermark",
+                                       512)),
+        age_watermark=max(0.0, getattr(args, "queue_age_watermark",
+                                       1.0)))
     config = ControllerConfig(
         global_accelerator=GlobalAcceleratorConfig(
             workers=args.workers, cluster_name=args.cluster_name,
-            fingerprints=fingerprints),
+            fingerprints=fingerprints, **scheduler),
         route53=Route53Config(
             workers=args.workers, cluster_name=args.cluster_name,
-            fingerprints=fingerprints),
+            fingerprints=fingerprints, **scheduler),
         endpoint_group_binding=EndpointGroupBindingConfig(
             workers=args.workers,
             weight_policy=getattr(args, "weight_policy", "static"),
             weight_policy_instance=policy_instance,
-            fingerprints=fingerprints),
+            fingerprints=fingerprints, **scheduler),
     )
 
     namespace = os.environ.get("POD_NAMESPACE", "default")

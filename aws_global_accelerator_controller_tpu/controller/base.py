@@ -17,7 +17,10 @@ from ..apis import (
     ROUTE53_HOSTNAME_ANNOTATION,
 )
 from ..kube.objects import Ingress, KubeObject, Service
-from ..kube.workqueue import RateLimitingQueue
+from ..kube.workqueue import (
+    CLASS_BACKGROUND,
+    RateLimitingQueue,
+)
 from ..reconcile import process_next_work_item
 
 logger = logging.getLogger(__name__)
@@ -83,9 +86,18 @@ def resync_enqueue(fingerprints, queue, obj, wave: int) -> None:
     failing its backstop syncs keeps the per-key exponential backoff
     and a parked key is never converted into an immediate retry by the
     next resync wave (the plain-``add`` shortcut would bypass exactly
-    the hot-retry protection the resilience layer's park provides)."""
+    the hot-retry protection the resilience layer's park provides).
+
+    Overload shedding: with the queue past a watermark (depth, or the
+    oldest interactive item's age — kube/workqueue.py ``overloaded``),
+    background re-deliveries are DROPPED here instead of enqueued —
+    the correctness-free shed: nothing about the key's fingerprint
+    state changed, so the next resync wave re-delivers it exactly as
+    this one would have.  Interactive work never sheds, and a key a
+    real watch event claimed (pending EVENT origin) rides through
+    untouched."""
     from .. import metrics
-    from ..reconcile.fingerprint import ORIGIN_RESYNC
+    from ..reconcile.fingerprint import ORIGIN_RESYNC, ORIGIN_SWEEP
 
     key = obj.key()
     origin = fingerprints.note_resync(key, wave)
@@ -93,7 +105,17 @@ def resync_enqueue(fingerprints, queue, obj, wave: int) -> None:
         fingerprints.claim_origin(key)
         metrics.record_fastpath_skip(fingerprints.controller)
         return
-    queue.add_rate_limited(key)
+    if origin in (ORIGIN_RESYNC, ORIGIN_SWEEP):
+        reason = queue.overloaded() if hasattr(queue, "overloaded") \
+            else None
+        if reason is not None:
+            # shed background work first — never interactive, never
+            # correctness (the un-popped origin claim is harmless: the
+            # next delivery upgrades or re-claims it)
+            fingerprints.claim_origin(key)
+            metrics.record_shed(fingerprints.controller, reason)
+            return
+    queue.add_rate_limited(key, klass=CLASS_BACKGROUND)
 
 
 def spawn_workers(name: str, count: int, stop: threading.Event,

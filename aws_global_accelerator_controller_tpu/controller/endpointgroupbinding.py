@@ -36,6 +36,11 @@ from ..kube.client import KubeClient, OperatorClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
 from ..kube.objects import split_meta_namespace_key
 from ..kube.workqueue import (
+    CLASS_INTERACTIVE,
+    CLASS_KEEP,
+    DEFAULT_AGE_WATERMARK,
+    DEFAULT_AGING_HORIZON,
+    DEFAULT_DEPTH_WATERMARK,
     new_rate_limiting_queue,
 )
 from ..reconcile import Result
@@ -90,6 +95,10 @@ class EndpointGroupBindingConfig:
     workers: int = 1
     queue_qps: float = 10.0    # client-go default bucket
     queue_burst: int = 100
+    # overload scheduler knobs (kube/workqueue.py priority tiers)
+    aging_horizon: float = DEFAULT_AGING_HORIZON
+    depth_watermark: int = DEFAULT_DEPTH_WATERMARK
+    age_watermark: float = DEFAULT_AGE_WATERMARK
     # "static" = reference parity (spec.weight everywhere); "model" =
     # TPU-planned weights for spec.weight: null bindings (weightpolicy.py)
     weight_policy: str = "static"
@@ -126,7 +135,10 @@ class EndpointGroupBindingController:
 
         self.queue = new_rate_limiting_queue(
             name="EndpointGroupBinding",
-            qps=config.queue_qps, burst=config.queue_burst)
+            qps=config.queue_qps, burst=config.queue_burst,
+            aging_horizon=config.aging_horizon,
+            depth_watermark=config.depth_watermark,
+            age_watermark=config.age_watermark)
 
         # steady-state fast path: the binding fingerprint covers the
         # binding's spec/status/meta AND the referent's LB hostnames
@@ -163,7 +175,7 @@ class EndpointGroupBindingController:
 
     def _enqueue(self, obj) -> None:
         self.fingerprints.note_event(obj.key())
-        self.queue.add_rate_limited(obj.key())
+        self.queue.add_rate_limited(obj.key(), klass=CLASS_INTERACTIVE)
 
     def _update_notification(self, old, new) -> None:
         # ARN changes are blocked by the webhook; backstop here
@@ -225,7 +237,8 @@ class EndpointGroupBindingController:
         def handler(obj) -> None:
             for binding in self.binding_informer.by_index(index, obj.key()):
                 self.fingerprints.note_event(binding.key())
-                self.queue.add_rate_limited(binding.key())
+                self.queue.add_rate_limited(binding.key(),
+                                            klass=CLASS_INTERACTIVE)
         return handler
 
     def _notify_referent_update(self, index: str):
@@ -290,7 +303,7 @@ class EndpointGroupBindingController:
                 # proves a converged state
                 self.fingerprints.invalidate(key)
                 logger.exception("error syncing %r", key)
-                self.queue.add_rate_limited(key)
+                self.queue.add_rate_limited(key, klass=CLASS_KEEP)
             finally:
                 self.queue.done(key)
                 metrics.record_sync(self.queue.name, result,
@@ -298,13 +311,26 @@ class EndpointGroupBindingController:
 
     def _sync_handler(self, key: str) -> None:
         """(controller.go:148-180)"""
+        import time as time_mod
+
+        from .. import metrics
+        from ..reconcile.traffic import dispatch_class
+
         ns, name = split_meta_namespace_key(key)
         origin = self.fingerprints.claim_origin(key)
+        # the delivery's tier + first-enqueue stamp (spanning requeues)
+        # — the event->converged latency a success records below
+        meta = self.queue.claimed_meta(key) \
+            if hasattr(self.queue, "claimed_meta") else None
+        klass, enqueued_at = meta if meta is not None \
+            else (CLASS_INTERACTIVE, time_mod.monotonic())
+        first_enqueued = self.fingerprints.pending_since(key, enqueued_at)
         try:
             binding = self.binding_informer.lister.get(ns, name)
         except NotFoundError:
             logger.info("EndpointGroupBinding %s has been deleted", key)
             self.fingerprints.invalidate(key)
+            self.fingerprints.clear_pending(key)
             self.queue.forget(key)
             return
 
@@ -314,8 +340,8 @@ class EndpointGroupBindingController:
         # on this branch)
         if origin == ORIGIN_RESYNC \
                 and self.fingerprints.matches(key, binding):
-            from .. import metrics
             metrics.record_fastpath_skip(self.queue.name)
+            self.fingerprints.clear_pending(key)
             self.queue.forget(key)
             return
 
@@ -326,18 +352,23 @@ class EndpointGroupBindingController:
             # no-change short-circuit, so out-of-band endpoint-group
             # drift is re-read and repaired on this tier — and any
             # mutation submitted is honestly a drift repair
-            with self.fingerprints.sweep_verify():
+            with self.fingerprints.sweep_verify(), dispatch_class(klass):
                 res = self.reconcile(binding.deep_copy())
         else:
-            res = self.reconcile(binding.deep_copy())
+            with dispatch_class(klass):
+                res = self.reconcile(binding.deep_copy())
         if res.requeue_after > 0:
             self.queue.forget(key)
-            self.queue.add_after(key, res.requeue_after)
+            self.queue.add_after(key, res.requeue_after, klass=CLASS_KEEP)
         elif res.requeue:
-            self.queue.add_rate_limited(key)
+            self.queue.add_rate_limited(key, klass=CLASS_KEEP)
         else:
             self.queue.forget(key)
             self.fingerprints.record(key, binding)
+            self.fingerprints.clear_pending(key)
+            metrics.record_reconcile_latency(
+                self.queue.name, klass,
+                time_mod.monotonic() - first_enqueued)
 
     # -- reconcile (reconcile.go:20-34) ---------------------------------
 

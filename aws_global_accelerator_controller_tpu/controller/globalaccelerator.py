@@ -43,6 +43,10 @@ from ..kube.client import KubeClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
 from ..kube.objects import Ingress, Service, split_meta_namespace_key
 from ..kube.workqueue import (
+    CLASS_INTERACTIVE,
+    DEFAULT_AGE_WATERMARK,
+    DEFAULT_AGING_HORIZON,
+    DEFAULT_DEPTH_WATERMARK,
     new_rate_limiting_queue,
 )
 from ..reconcile import Result
@@ -110,6 +114,11 @@ class GlobalAcceleratorConfig:
     cluster_name: str = "default"
     queue_qps: float = 10.0    # client-go default bucket
     queue_burst: int = 100
+    # overload scheduler knobs (kube/workqueue.py priority tiers):
+    # anti-starvation aging horizon + the shed watermarks
+    aging_horizon: float = DEFAULT_AGING_HORIZON
+    depth_watermark: int = DEFAULT_DEPTH_WATERMARK
+    age_watermark: float = DEFAULT_AGE_WATERMARK
     # steady-state fast path (reconcile/fingerprint.py): resync
     # re-deliveries of unchanged objects skip before any provider call
     fingerprints: FingerprintConfig = field(
@@ -129,10 +138,16 @@ class GlobalAcceleratorController:
 
         self.service_queue = new_rate_limiting_queue(
             name=f"{CONTROLLER_AGENT_NAME}-service",
-            qps=config.queue_qps, burst=config.queue_burst)
+            qps=config.queue_qps, burst=config.queue_burst,
+            aging_horizon=config.aging_horizon,
+            depth_watermark=config.depth_watermark,
+            age_watermark=config.age_watermark)
         self.ingress_queue = new_rate_limiting_queue(
             name=f"{CONTROLLER_AGENT_NAME}-ingress",
-            qps=config.queue_qps, burst=config.queue_burst)
+            qps=config.queue_qps, burst=config.queue_burst,
+            aging_horizon=config.aging_horizon,
+            depth_watermark=config.depth_watermark,
+            age_watermark=config.age_watermark)
 
         # steady-state fast path: one fingerprint gate per queue
         # (reconcile/fingerprint.py; see _resync_service below)
@@ -159,7 +174,8 @@ class GlobalAcceleratorController:
     def _add_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc) and self._has_managed(svc):
             self.service_fingerprints.note_event(svc.key())
-            self.service_queue.add_rate_limited(svc.key())
+            self.service_queue.add_rate_limited(
+                svc.key(), klass=CLASS_INTERACTIVE)
 
     def _update_service(self, old: Service, new: Service) -> None:
         if old == new:
@@ -168,12 +184,14 @@ class GlobalAcceleratorController:
             if self._has_managed(new) or annotation_presence_changed(
                     old, new, AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION):
                 self.service_fingerprints.note_event(new.key())
-                self.service_queue.add_rate_limited(new.key())
+                self.service_queue.add_rate_limited(
+                    new.key(), klass=CLASS_INTERACTIVE)
 
     def _delete_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc):
             self.service_fingerprints.note_event(svc.key())
-            self.service_queue.add_rate_limited(svc.key())
+            self.service_queue.add_rate_limited(
+                svc.key(), klass=CLASS_INTERACTIVE)
 
     def _resync_service(self, svc: Service, wave: int) -> None:
         """Tagged resync re-delivery: the level-trigger backstop now
@@ -189,7 +207,8 @@ class GlobalAcceleratorController:
     def _add_ingress(self, ingress: Ingress) -> None:
         if was_alb_ingress(ingress) and self._has_managed(ingress):
             self.ingress_fingerprints.note_event(ingress.key())
-            self.ingress_queue.add_rate_limited(ingress.key())
+            self.ingress_queue.add_rate_limited(
+                ingress.key(), klass=CLASS_INTERACTIVE)
 
     def _update_ingress(self, old: Ingress, new: Ingress) -> None:
         if old == new:
@@ -198,12 +217,14 @@ class GlobalAcceleratorController:
             if self._has_managed(new) or annotation_presence_changed(
                     old, new, AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION):
                 self.ingress_fingerprints.note_event(new.key())
-                self.ingress_queue.add_rate_limited(new.key())
+                self.ingress_queue.add_rate_limited(
+                    new.key(), klass=CLASS_INTERACTIVE)
 
     def _delete_ingress(self, ingress: Ingress) -> None:
         # reference enqueues ingress deletes unconditionally (controller.go:185)
         self.ingress_fingerprints.note_event(ingress.key())
-        self.ingress_queue.add_rate_limited(ingress.key())
+        self.ingress_queue.add_rate_limited(
+            ingress.key(), klass=CLASS_INTERACTIVE)
 
     def _resync_ingress(self, ingress: Ingress, wave: int) -> None:
         if was_alb_ingress(ingress) and self._has_managed(ingress):

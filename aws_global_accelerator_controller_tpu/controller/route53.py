@@ -26,6 +26,10 @@ from ..kube.client import KubeClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
 from ..kube.objects import Ingress, Service, split_meta_namespace_key
 from ..kube.workqueue import (
+    CLASS_INTERACTIVE,
+    DEFAULT_AGE_WATERMARK,
+    DEFAULT_AGING_HORIZON,
+    DEFAULT_DEPTH_WATERMARK,
     new_rate_limiting_queue,
 )
 from ..reconcile import Result
@@ -75,6 +79,10 @@ class Route53Config:
     cluster_name: str = "default"
     queue_qps: float = 10.0    # client-go default bucket
     queue_burst: int = 100
+    # overload scheduler knobs (kube/workqueue.py priority tiers)
+    aging_horizon: float = DEFAULT_AGING_HORIZON
+    depth_watermark: int = DEFAULT_DEPTH_WATERMARK
+    age_watermark: float = DEFAULT_AGE_WATERMARK
     # steady-state fast path (reconcile/fingerprint.py)
     fingerprints: FingerprintConfig = field(
         default_factory=FingerprintConfig)
@@ -93,10 +101,16 @@ class Route53Controller:
 
         self.service_queue = new_rate_limiting_queue(
             name=f"{CONTROLLER_AGENT_NAME}-service",
-            qps=config.queue_qps, burst=config.queue_burst)
+            qps=config.queue_qps, burst=config.queue_burst,
+            aging_horizon=config.aging_horizon,
+            depth_watermark=config.depth_watermark,
+            age_watermark=config.age_watermark)
         self.ingress_queue = new_rate_limiting_queue(
             name=f"{CONTROLLER_AGENT_NAME}-ingress",
-            qps=config.queue_qps, burst=config.queue_burst)
+            qps=config.queue_qps, burst=config.queue_burst,
+            aging_horizon=config.aging_horizon,
+            depth_watermark=config.depth_watermark,
+            age_watermark=config.age_watermark)
 
         # steady-state fast path: one fingerprint gate per queue
         self.service_fingerprints = FingerprintCache(
@@ -128,7 +142,8 @@ class Route53Controller:
     def _add_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc) and self._has_hostname(svc):
             self.service_fingerprints.note_event(svc.key())
-            self.service_queue.add_rate_limited(svc.key())
+            self.service_queue.add_rate_limited(
+                svc.key(), klass=CLASS_INTERACTIVE)
 
     def _update_service(self, old: Service, new: Service) -> None:
         if old == new:
@@ -137,12 +152,14 @@ class Route53Controller:
             if self._has_hostname(new) or annotation_presence_changed(
                     old, new, ROUTE53_HOSTNAME_ANNOTATION):
                 self.service_fingerprints.note_event(new.key())
-                self.service_queue.add_rate_limited(new.key())
+                self.service_queue.add_rate_limited(
+                    new.key(), klass=CLASS_INTERACTIVE)
 
     def _delete_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc):
             self.service_fingerprints.note_event(svc.key())
-            self.service_queue.add_rate_limited(svc.key())
+            self.service_queue.add_rate_limited(
+                svc.key(), klass=CLASS_INTERACTIVE)
 
     def _resync_service(self, svc: Service, wave: int) -> None:
         """Tagged resync backstop for annotated Services — gated at
@@ -156,7 +173,8 @@ class Route53Controller:
         # (route53/controller.go:133-137; no ALB filter on add)
         if self._has_hostname(ingress):
             self.ingress_fingerprints.note_event(ingress.key())
-            self.ingress_queue.add_rate_limited(ingress.key())
+            self.ingress_queue.add_rate_limited(
+                ingress.key(), klass=CLASS_INTERACTIVE)
 
     def _update_ingress(self, old: Ingress, new: Ingress) -> None:
         if old == new:
@@ -164,11 +182,13 @@ class Route53Controller:
         if self._has_hostname(new) or annotation_presence_changed(
                 old, new, ROUTE53_HOSTNAME_ANNOTATION):
             self.ingress_fingerprints.note_event(new.key())
-            self.ingress_queue.add_rate_limited(new.key())
+            self.ingress_queue.add_rate_limited(
+                new.key(), klass=CLASS_INTERACTIVE)
 
     def _delete_ingress(self, ingress: Ingress) -> None:
         self.ingress_fingerprints.note_event(ingress.key())
-        self.ingress_queue.add_rate_limited(ingress.key())
+        self.ingress_queue.add_rate_limited(
+            ingress.key(), klass=CLASS_INTERACTIVE)
 
     def _resync_ingress(self, ingress: Ingress, wave: int) -> None:
         if self._has_hostname(ingress):

@@ -8,6 +8,13 @@ whole wait, so N worker threads park in the kernel instead of contending on
 a Python condition variable — the same property the reference gets for free
 from Go's runtime (client-go workqueue parked goroutines).
 
+The priority-tier surface (traffic classes, aged-priority draw, per-tier
+depth/oldest-age, the overload watermarks — kube/workqueue.py module
+docstring) is implemented IN the C++ queue; this wrapper threads the
+class through the ``*2`` entry points and keeps the per-worker claimed
+metadata (class + enqueue time) on the Python side, where the reconcile
+dispatch reads it via ``claimed_meta``.
+
 Use :func:`native_available` / :func:`load` rather than importing the
 library directly; everything degrades to the pure-Python queue when g++ is
 absent (see kube.workqueue.new_rate_limiting_queue).
@@ -16,6 +23,7 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import time
 from typing import Any, Optional, Tuple
 
 from ..analysis import locks
@@ -29,6 +37,29 @@ _fast_lib = None
 # a wait — see the PyDLL rationale in load())
 _lib_lock = locks.make_lock("native-workqueue-lib")
 _lib_failed = False
+
+# C-side traffic-class encoding (workqueue.cpp): keep mirrors the
+# Python queue's CLASS_KEEP sentinel.
+_C_BACKGROUND = 0
+_C_INTERACTIVE = 1
+_C_KEEP = -1
+
+
+def _c_class(klass: str) -> int:
+    # local import avoids a cycle: workqueue.py imports this module
+    from .workqueue import CLASS_BACKGROUND, CLASS_INTERACTIVE, CLASS_KEEP
+    if klass == CLASS_KEEP:
+        return _C_KEEP
+    if klass == CLASS_BACKGROUND:
+        return _C_BACKGROUND
+    if klass == CLASS_INTERACTIVE:
+        return _C_INTERACTIVE
+    raise ValueError(f"unknown traffic class {klass!r}")
+
+
+def _py_class(c_klass: int) -> str:
+    from .workqueue import CLASS_BACKGROUND, CLASS_INTERACTIVE
+    return CLASS_INTERACTIVE if c_klass else CLASS_BACKGROUND
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -60,42 +91,56 @@ def load() -> Optional[ctypes.CDLL]:
         except OSError:
             _lib_failed = True
             return None
-        lib.aga_wq_new.restype = ctypes.c_void_p
-        lib.aga_wq_new.argtypes = [ctypes.c_double, ctypes.c_int,
-                                   ctypes.c_double, ctypes.c_double]
+        lib.aga_wq_new2.restype = ctypes.c_void_p
+        lib.aga_wq_new2.argtypes = [ctypes.c_double, ctypes.c_int,
+                                    ctypes.c_double, ctypes.c_double,
+                                    ctypes.c_double]
         lib.aga_wq_free.argtypes = [ctypes.c_void_p]
-        lib.aga_wq_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.aga_wq_get.restype = ctypes.c_int
-        lib.aga_wq_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                   ctypes.c_int, ctypes.c_double,
-                                   ctypes.POINTER(ctypes.c_int)]
+        lib.aga_wq_add2.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int]
+        lib.aga_wq_get2.restype = ctypes.c_int
+        lib.aga_wq_get2.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int, ctypes.c_double,
+                                    ctypes.POINTER(ctypes.c_int),
+                                    ctypes.POINTER(ctypes.c_int),
+                                    ctypes.POINTER(ctypes.c_double)]
         lib.aga_wq_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.aga_wq_add_after.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                         ctypes.c_double]
-        lib.aga_wq_add_rate_limited.restype = ctypes.c_double
-        lib.aga_wq_add_rate_limited.argtypes = [ctypes.c_void_p,
-                                                ctypes.c_char_p]
+        lib.aga_wq_add_after2.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_double, ctypes.c_int]
+        lib.aga_wq_add_rate_limited2.restype = ctypes.c_double
+        lib.aga_wq_add_rate_limited2.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_char_p,
+                                                 ctypes.c_int]
         lib.aga_wq_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.aga_wq_num_requeues.restype = ctypes.c_int
         lib.aga_wq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.aga_wq_len.restype = ctypes.c_int
         lib.aga_wq_len.argtypes = [ctypes.c_void_p]
+        lib.aga_wq_tier_len.restype = ctypes.c_int
+        lib.aga_wq_tier_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.aga_wq_tier_oldest_age.restype = ctypes.c_double
+        lib.aga_wq_tier_oldest_age.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.aga_wq_waiting_len.restype = ctypes.c_int
         lib.aga_wq_waiting_len.argtypes = [ctypes.c_void_p]
         lib.aga_wq_shutdown.argtypes = [ctypes.c_void_p]
         lib.aga_wq_shutting_down.restype = ctypes.c_int
         lib.aga_wq_shutting_down.argtypes = [ctypes.c_void_p]
-        fast.aga_wq_add.argtypes = lib.aga_wq_add.argtypes
+        fast.aga_wq_add2.argtypes = lib.aga_wq_add2.argtypes
         fast.aga_wq_done.argtypes = lib.aga_wq_done.argtypes
         fast.aga_wq_forget.argtypes = lib.aga_wq_forget.argtypes
-        fast.aga_wq_add_after.argtypes = lib.aga_wq_add_after.argtypes
-        fast.aga_wq_add_rate_limited.restype = ctypes.c_double
-        fast.aga_wq_add_rate_limited.argtypes = (
-            lib.aga_wq_add_rate_limited.argtypes)
+        fast.aga_wq_add_after2.argtypes = lib.aga_wq_add_after2.argtypes
+        fast.aga_wq_add_rate_limited2.restype = ctypes.c_double
+        fast.aga_wq_add_rate_limited2.argtypes = (
+            lib.aga_wq_add_rate_limited2.argtypes)
         fast.aga_wq_num_requeues.restype = ctypes.c_int
         fast.aga_wq_num_requeues.argtypes = lib.aga_wq_num_requeues.argtypes
         fast.aga_wq_len.restype = ctypes.c_int
         fast.aga_wq_len.argtypes = lib.aga_wq_len.argtypes
+        fast.aga_wq_tier_len.restype = ctypes.c_int
+        fast.aga_wq_tier_len.argtypes = lib.aga_wq_tier_len.argtypes
+        fast.aga_wq_tier_oldest_age.restype = ctypes.c_double
+        fast.aga_wq_tier_oldest_age.argtypes = (
+            lib.aga_wq_tier_oldest_age.argtypes)
         _fast_lib = fast
         _lib = lib
         return _lib
@@ -119,17 +164,29 @@ class NativeRateLimitingQueue:
     """
 
     def __init__(self, name: str = "", qps: float = 10.0, burst: int = 100,
-                 base_delay: float = 0.005, max_delay: float = 1000.0):
+                 base_delay: float = 0.005, max_delay: float = 1000.0,
+                 aging_horizon: float = 2.0,
+                 depth_watermark: int = 512,
+                 age_watermark: float = 1.0):
         lib = load()
         if lib is None:
             raise RuntimeError("native workqueue library unavailable")
         self.name = name
+        self.aging_horizon = aging_horizon
+        self.depth_watermark = depth_watermark
+        self.age_watermark = age_watermark
         self._lib = lib
         # GIL-keeping handle for the O(1) ops (see load()); the
         # blocking get() stays on the GIL-releasing handle
         self._fast = _fast_lib
-        self._h = lib.aga_wq_new(qps, burst, base_delay, max_delay)
+        self._h = lib.aga_wq_new2(qps, burst, base_delay, max_delay,
+                                  aging_horizon)
         self._tls = threading.local()
+        # item -> (class, enqueue monotonic time) of the delivery a
+        # worker holds; written by the claiming worker at get(), read
+        # via claimed_meta, cleared at done().  Guarded by the GIL
+        # (single dict ops) like the rest of the wrapper's state.
+        self._claimed: dict = {}
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -137,13 +194,15 @@ class NativeRateLimitingQueue:
             self._lib.aga_wq_free(h)
             self._h = None
 
-    def add(self, item: Any) -> None:
-        self._fast.aga_wq_add(self._h, _encode(item))
+    def add(self, item: Any, klass: str = "keep") -> None:
+        self._fast.aga_wq_add2(self._h, _encode(item), _c_class(klass))
 
     def get(self, timeout: Optional[float] = None
             ) -> Tuple[Optional[str], bool]:
         t = -1.0 if timeout is None else float(timeout)
         need = ctypes.c_int(0)
+        out_klass = ctypes.c_int(_C_INTERACTIVE)
+        out_wait = ctypes.c_double(0.0)
         # One buffer per worker thread: several workers block in get() on
         # the same queue concurrently (controller/base.py runs `workers`
         # threads per queue).  512 covers any k8s key (253+1+253).
@@ -151,10 +210,15 @@ class NativeRateLimitingQueue:
         if buf is None:
             buf = self._tls.buf = ctypes.create_string_buffer(512)
         while True:
-            rc = self._lib.aga_wq_get(self._h, buf, len(buf), t,
-                                      ctypes.byref(need))
+            rc = self._lib.aga_wq_get2(self._h, buf, len(buf), t,
+                                       ctypes.byref(need),
+                                       ctypes.byref(out_klass),
+                                       ctypes.byref(out_wait))
             if rc == 0:
-                return buf.value.decode("utf-8"), False
+                item = buf.value.decode("utf-8")
+                self._claimed[item] = (_py_class(out_klass.value),
+                                       time.monotonic() - out_wait.value)
+                return item, False
             if rc == 1:
                 return None, True
             if rc == 2:
@@ -164,13 +228,23 @@ class NativeRateLimitingQueue:
             t = 0.0 if timeout is not None else -1.0
 
     def done(self, item: Any) -> None:
+        self._claimed.pop(item, None)
         self._fast.aga_wq_done(self._h, _encode(item))
 
-    def add_after(self, item: Any, delay: float) -> None:
-        self._fast.aga_wq_add_after(self._h, _encode(item), float(delay))
+    def claimed_meta(self, item: Any) -> Optional[Tuple[str, float]]:
+        """(traffic class, monotonic enqueue time) of the delivery the
+        calling worker holds (None when not claimed) — parity with
+        RateLimitingQueue.claimed_meta."""
+        return self._claimed.get(item)
 
-    def add_rate_limited(self, item: Any) -> None:
-        self._fast.aga_wq_add_rate_limited(self._h, _encode(item))
+    def add_after(self, item: Any, delay: float,
+                  klass: str = "keep") -> None:
+        self._fast.aga_wq_add_after2(self._h, _encode(item), float(delay),
+                                     _c_class(klass))
+
+    def add_rate_limited(self, item: Any, klass: str = "keep") -> None:
+        self._fast.aga_wq_add_rate_limited2(self._h, _encode(item),
+                                            _c_class(klass))
 
     def forget(self, item: Any) -> None:
         self._fast.aga_wq_forget(self._h, _encode(item))
@@ -187,3 +261,24 @@ class NativeRateLimitingQueue:
 
     def __len__(self) -> int:
         return self._fast.aga_wq_len(self._h)
+
+    # -- tier observability (parity with RateLimitingQueue) ------------
+
+    def tier_len(self, klass: str) -> int:
+        return self._fast.aga_wq_tier_len(self._h, _c_class(klass))
+
+    def tier_oldest_age(self, klass: str) -> float:
+        return self._fast.aga_wq_tier_oldest_age(self._h, _c_class(klass))
+
+    def overloaded(self) -> Optional[str]:
+        """The shed signal (RateLimitingQueue.overloaded contract):
+        "depth" past the backlog watermark, "age" past the oldest
+        interactive item's age watermark, else None."""
+        if self.depth_watermark > 0 \
+                and self._fast.aga_wq_len(self._h) > self.depth_watermark:
+            return "depth"
+        if self.age_watermark > 0 \
+                and self._fast.aga_wq_tier_oldest_age(
+                    self._h, _C_INTERACTIVE) > self.age_watermark:
+            return "age"
+        return None

@@ -12,6 +12,32 @@ implements the same semantics natively:
 - ``add_rate_limited`` with per-item exponential backoff and a global
   token bucket, ``forget`` to reset an item's failure count;
 - ``shutdown`` drains waiters.
+
+Priority tiers (the overload-resilience layer, ISSUE 7): every item
+carries a TRAFFIC CLASS — ``interactive`` (watch-event deliveries,
+user-visible spec changes) or ``background`` (resync waves, drift
+sweeps).  Relist deltas after a watch-drop heal are real missed
+changes and ride the ordinary (interactive) handlers.  ``get()``
+draws from the two tiers by AGED
+priority: an item's effective priority is its class base (interactive
+= 1, background = 0) plus ``wait / aging_horizon``, so a fresh
+interactive change never pays the backlog tax of a resync wave, while
+a background item's priority rises with queue wait and can never be
+starved indefinitely — under a saturating interactive storm (whose
+head wait stays ~0) a background item is served within roughly one
+aging horizon of enqueue.  The class is a property of the KEY while it
+is anywhere in the queue machinery: ``done`` re-queues a dirty item in
+its recorded class, and ``add_rate_limited``/``add_after`` called with
+``klass=CLASS_KEEP`` preserve it, so a background key's retry stays
+background (and a parked interactive key's retry stays interactive)
+across requeues.  Lint rule L109 keeps every controller/reconcile
+enqueue site explicit about its class.
+
+Overload signal: ``overloaded()`` reports (as a reason string) when
+the backlog crosses the depth watermark or the oldest INTERACTIVE
+item's age crosses the age watermark — the shed trigger the resync
+enqueue path consults so background work is dropped first, never
+interactive work (controller/base.py ``resync_enqueue``).
 """
 from __future__ import annotations
 
@@ -22,6 +48,24 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..analysis import locks
+
+# Traffic classes (the queue's two tiers).  CLASS_KEEP is the requeue
+# sentinel: preserve the item's recorded class (unknown items default
+# to interactive — the safe direction for latency).
+CLASS_INTERACTIVE = "interactive"
+CLASS_BACKGROUND = "background"
+CLASS_KEEP = "keep"
+TIERS = (CLASS_INTERACTIVE, CLASS_BACKGROUND)
+
+# A background item's effective priority reaches a fresh interactive
+# item's after this many seconds of queue wait (the anti-starvation
+# bound under a saturating interactive storm).
+DEFAULT_AGING_HORIZON = 2.0
+
+# Overload watermarks (0 disables that signal): total backlog depth,
+# and the oldest interactive item's age in seconds.
+DEFAULT_DEPTH_WATERMARK = 512
+DEFAULT_AGE_WATERMARK = 1.0
 
 
 class ItemExponentialFailureRateLimiter:
@@ -43,6 +87,15 @@ class ItemExponentialFailureRateLimiter:
         delay = self.base_delay * (2 ** failures)
         return min(delay, self.max_delay)
 
+    def peek(self, item: Any) -> float:
+        """The delay ``when`` would return WITHOUT charging a failure —
+        what a deduplicated add (the item already has a scheduled
+        delivery) consults: it may pull the wake earlier within the
+        item's current backoff, but it is not a new failure."""
+        with self._lock:
+            failures = self._failures.get(item, 0)
+        return min(self.base_delay * (2 ** failures), self.max_delay)
+
     def forget(self, item: Any) -> None:
         with self._lock:
             self._failures.pop(item, None)
@@ -53,7 +106,14 @@ class ItemExponentialFailureRateLimiter:
 
 
 class BucketRateLimiter:
-    """Global token bucket (client-go default: 10 qps, burst 100)."""
+    """Global token bucket (client-go default: 10 qps, burst 100).
+
+    Tokens may go negative (reservation semantics, like
+    golang.org/x/time/rate) but the DEFICIT is bounded at 2x burst: an
+    unbounded deficit means one sustained overrun punishes the next
+    lone event with a delay measured in minutes — fiction, since the
+    level-triggered resync re-delivers on its own cadence anyway.  The
+    clamp caps the worst admission delay at ~(2*burst+1)/qps."""
 
     def __init__(self, qps: float = 10.0, burst: int = 100):
         self.qps = qps
@@ -71,8 +131,11 @@ class BucketRateLimiter:
                 self._tokens -= 1.0
                 return 0.0
             deficit = 1.0 - self._tokens
-            self._tokens -= 1.0
+            self._tokens = max(self._tokens - 1.0, -2.0 * self.burst)
             return deficit / self.qps
+
+    def peek(self, item: Any) -> float:
+        return 0.0  # an uncharged add consumes no token: no pacing
 
     def forget(self, item: Any) -> None:  # token buckets don't track items
         pass
@@ -89,6 +152,9 @@ class MaxOfRateLimiter:
 
     def when(self, item: Any) -> float:
         return max(l.when(item) for l in self.limiters)
+
+    def peek(self, item: Any) -> float:
+        return max(l.peek(item) for l in self.limiters)
 
     def forget(self, item: Any) -> None:
         for l in self.limiters:
@@ -110,7 +176,10 @@ def default_controller_rate_limiter(qps: float = 10.0,
 
 
 def new_rate_limiting_queue(name: str = "", qps: float = 10.0,
-                            burst: int = 100):
+                            burst: int = 100,
+                            aging_horizon: float = DEFAULT_AGING_HORIZON,
+                            depth_watermark: int = DEFAULT_DEPTH_WATERMARK,
+                            age_watermark: float = DEFAULT_AGE_WATERMARK):
     """Build the best available queue with default-controller-limiter
     semantics.
 
@@ -127,8 +196,11 @@ def new_rate_limiting_queue(name: str = "", qps: float = 10.0,
             from .native_workqueue import NativeRateLimitingQueue, \
                 native_available
             if native_available():
-                return NativeRateLimitingQueue(name=name, qps=qps,
-                                               burst=burst)
+                return NativeRateLimitingQueue(
+                    name=name, qps=qps, burst=burst,
+                    aging_horizon=aging_horizon,
+                    depth_watermark=depth_watermark,
+                    age_watermark=age_watermark)
             if pref in ("1", "true", "on"):
                 raise RuntimeError(
                     "AGAC_NATIVE_WORKQUEUE=1 but the native library could "
@@ -137,74 +209,203 @@ def new_rate_limiting_queue(name: str = "", qps: float = 10.0,
             if pref in ("1", "true", "on"):
                 raise
     return RateLimitingQueue(
-        rate_limiter=default_controller_rate_limiter(qps, burst), name=name)
+        rate_limiter=default_controller_rate_limiter(qps, burst), name=name,
+        aging_horizon=aging_horizon, depth_watermark=depth_watermark,
+        age_watermark=age_watermark)
 
 
 class RateLimitingQueue:
-    """client-go RateLimitingInterface semantics.
+    """client-go RateLimitingInterface semantics + priority tiers.
 
     Invariants (mirroring client-go's Type):
     - ``dirty`` holds items that need processing; an item already dirty is
       not re-added (dedup).
     - ``processing`` holds items currently handed to a worker; re-adding a
       processing item marks it dirty and it is re-queued on ``done``.
+
+    Tier invariants (module docstring): every dirty item sits in exactly
+    one tier deque; its class survives requeues (``CLASS_KEEP``); an
+    interactive add PROMOTES an item waiting in the background tier.
     """
 
-    def __init__(self, rate_limiter=None, name: str = ""):
+    def __init__(self, rate_limiter=None, name: str = "",
+                 aging_horizon: float = DEFAULT_AGING_HORIZON,
+                 depth_watermark: int = DEFAULT_DEPTH_WATERMARK,
+                 age_watermark: float = DEFAULT_AGE_WATERMARK):
         self.name = name
+        self.aging_horizon = aging_horizon
+        self.depth_watermark = depth_watermark
+        self.age_watermark = age_watermark
         self._rate_limiter = rate_limiter or default_controller_rate_limiter()
         self._cond = threading.Condition(
             locks.make_lock(f"workqueue[{name}]"))
-        self._queue: deque = deque()
+        self._tiers: Dict[str, deque] = {
+            CLASS_INTERACTIVE: deque(), CLASS_BACKGROUND: deque()}
         self._dirty: set = set()
         self._processing: set = set()
+        # item -> traffic class while the key is anywhere in the queue
+        # machinery (pending, processing, or parked in the delay heap)
+        self._class: Dict[Any, str] = {}
+        # item -> monotonic REQUEST time of the pending delivery (set
+        # at add/add_after, backoff included — the latency stamp,
+        # consumed by get into _claimed)
+        self._enqueued_at: Dict[Any, float] = {}
+        # item -> monotonic time the item became RUNNABLE (entered its
+        # tier deque) — what aging, tier_oldest_age and the overload
+        # age watermark measure: a parked retry's deliberate backoff
+        # is latency, not queue wait, and must not trip the shedder
+        self._runnable_at: Dict[Any, float] = {}
+        # item -> (class, enqueued_at) of the delivery a worker holds
+        self._claimed: Dict[Any, Tuple[str, float]] = {}
         self._shutting_down = False
-        # delaying queue state
+        # delaying queue state; _waiting_index dedupes by item keeping
+        # the EARLIEST deadline (two parks — e.g. a breaker hint then a
+        # shorter retry hint — must keep the earliest wake time); heap
+        # entries not matching the index are stale and skipped on pop
         self._waiting: List[Tuple[float, int, Any]] = []
+        self._waiting_index: Dict[Any, Tuple[float, int]] = {}
         self._waiting_seq = 0
         self._waker = threading.Thread(target=self._wait_loop, daemon=True,
                                        name=f"workqueue-waker-{name}")
         self._waker.start()
 
+    # -- class bookkeeping (callers hold _cond) -------------------------
+
+    def _resolve_class_locked(self, item: Any, klass: str) -> str:
+        if klass == CLASS_KEEP:
+            return self._class.get(item, CLASS_INTERACTIVE)
+        if klass not in TIERS:
+            raise ValueError(f"unknown traffic class {klass!r}")
+        # upgrade-only while tracked: a background re-tag (a resync
+        # wave landing on a key whose interactive delivery/retry is
+        # still in flight) must not demote pending interactive work
+        if (klass == CLASS_BACKGROUND
+                and self._class.get(item) == CLASS_INTERACTIVE):
+            return CLASS_INTERACTIVE
+        return klass
+
+    def _enter_dirty_locked(self, item: Any, klass: str,
+                            front: bool = False) -> None:
+        """Mark ``item`` dirty in ``klass`` and queue it unless a worker
+        holds it.  An item already dirty is deduped; an interactive
+        (re-)add of an item waiting in the background tier promotes it
+        without resetting its enqueue time (the oldest pending event
+        is what latency is measured from).  ``front`` (delay-heap
+        promotions) enters at the HEAD of the tier: a parked retry's
+        request predates everything enqueued while it was parked, so
+        joining at the tail would make its wait grow with storm depth
+        — the anti-starvation bound must not depend on the backlog."""
+        prior = self._class.get(item)
+        self._class[item] = klass
+        if item in self._dirty:
+            if (klass == CLASS_INTERACTIVE and prior == CLASS_BACKGROUND
+                    and item not in self._processing):
+                try:
+                    self._tiers[CLASS_BACKGROUND].remove(item)
+                except ValueError:
+                    pass
+                else:
+                    self._tiers[CLASS_INTERACTIVE].append(item)
+                    self._cond.notify()
+            return
+        self._dirty.add(item)
+        now = time.monotonic()
+        self._enqueued_at.setdefault(item, now)
+        if item in self._processing:
+            return
+        self._runnable_at[item] = now
+        q = self._tiers[klass]
+        # only ahead of strictly-younger work (by REQUEST time):
+        # same-batch promotions stay FIFO
+        if front and q and (self._enqueued_at[item]
+                            < self._enqueued_at.get(q[0], now)):
+            q.appendleft(item)
+        else:
+            q.append(item)
+        self._cond.notify()
+
+    def _maybe_drop_class_locked(self, item: Any) -> None:
+        """Forget an item's class once it has fully left the machinery
+        (not dirty, not processing, not parked in the delay heap) so
+        the class map cannot grow with deleted keys forever."""
+        if (item not in self._dirty and item not in self._processing
+                and item not in self._waiting_index):
+            self._class.pop(item, None)
+            self._enqueued_at.pop(item, None)
+            self._runnable_at.pop(item, None)
+
     # -- base queue -----------------------------------------------------
 
-    def add(self, item: Any) -> None:
+    def add(self, item: Any, klass: str = CLASS_KEEP) -> None:
         with self._cond:
             if self._shutting_down:
                 return
-            if item in self._dirty:
-                return
-            self._dirty.add(item)
-            if item in self._processing:
-                return
-            self._queue.append(item)
-            self._cond.notify()
+            self._enter_dirty_locked(
+                item, self._resolve_class_locked(item, klass))
+
+    def _pick_tier_locked(self, now: float) -> Optional[str]:
+        """The aged-priority draw: effective priority = class base
+        (interactive 1, background 0) + head wait / aging_horizon; the
+        higher head wins, interactive on ties.  ``aging_horizon <= 0``
+        disables aging (strict priority)."""
+        iq = self._tiers[CLASS_INTERACTIVE]
+        bq = self._tiers[CLASS_BACKGROUND]
+        if not iq:
+            return CLASS_BACKGROUND if bq else None
+        if not bq:
+            return CLASS_INTERACTIVE
+        if self.aging_horizon <= 0:
+            return CLASS_INTERACTIVE
+        i_wait = now - self._runnable_at.get(iq[0], now)
+        b_wait = now - self._runnable_at.get(bq[0], now)
+        if b_wait > self.aging_horizon + i_wait:
+            return CLASS_BACKGROUND
+        return CLASS_INTERACTIVE
 
     def get(self, timeout: Optional[float] = None):
         """Block until an item is available; returns (item, shutdown)."""
         with self._cond:
             deadline = None if timeout is None else time.monotonic() + timeout
-            while not self._queue and not self._shutting_down:
+            while not any(self._tiers.values()) and not self._shutting_down:
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return None, False
                 self._cond.wait(remaining)
-            if not self._queue:
+            now = time.monotonic()
+            tier = self._pick_tier_locked(now)
+            if tier is None:
                 # shutting down and drained
                 return None, True
-            item = self._queue.popleft()
+            item = self._tiers[tier].popleft()
             self._processing.add(item)
             self._dirty.discard(item)
+            self._runnable_at.pop(item, None)
+            self._claimed[item] = (
+                self._class.get(item, CLASS_INTERACTIVE),
+                self._enqueued_at.pop(item, now))
             return item, False
 
     def done(self, item: Any) -> None:
         with self._cond:
             self._processing.discard(item)
+            self._claimed.pop(item, None)
             if item in self._dirty:
-                self._queue.append(item)
+                self._runnable_at[item] = time.monotonic()
+                self._tiers[self._class.get(item, CLASS_INTERACTIVE)] \
+                    .append(item)
                 self._cond.notify()
+            else:
+                self._maybe_drop_class_locked(item)
+
+    def claimed_meta(self, item: Any) -> Optional[Tuple[str, float]]:
+        """(traffic class, monotonic enqueue time) of the delivery the
+        calling worker holds — what the reconcile dispatch stamps
+        event→converged latency from.  None if ``item`` is not
+        currently claimed."""
+        with self._cond:
+            return self._claimed.get(item)
 
     def shutdown(self) -> None:
         with self._cond:
@@ -218,21 +419,74 @@ class RateLimitingQueue:
 
     def __len__(self) -> int:
         with self._cond:
-            return len(self._queue)
+            return sum(len(q) for q in self._tiers.values())
+
+    # -- tier observability --------------------------------------------
+
+    def tier_len(self, klass: str) -> int:
+        with self._cond:
+            return len(self._tiers[klass])
+
+    def tier_oldest_age(self, klass: str) -> float:
+        """Seconds the tier's head item has been RUNNABLE (0.0 when
+        empty) — the workqueue_oldest_age_seconds{queue,tier} gauge
+        and the age-watermark signal.  Deliberately not the request
+        stamp: a promoted retry's backoff was a scheduling decision,
+        not queue congestion."""
+        with self._cond:
+            q = self._tiers[klass]
+            if not q:
+                return 0.0
+            now = time.monotonic()
+            return max(0.0, now - self._runnable_at.get(q[0], now))
+
+    def overloaded(self) -> Optional[str]:
+        """The shed signal: "depth" when the total backlog crosses the
+        depth watermark, "age" when the oldest interactive item has
+        waited past the age watermark, else None.  Consulted by the
+        resync enqueue path — background work is shed FIRST and
+        re-delivered by the next wave; interactive work never sheds."""
+        with self._cond:
+            depth = sum(len(q) for q in self._tiers.values())
+            if self.depth_watermark > 0 and depth > self.depth_watermark:
+                return "depth"
+            iq = self._tiers[CLASS_INTERACTIVE]
+            if self.age_watermark > 0 and iq:
+                now = time.monotonic()
+                if now - self._runnable_at.get(iq[0], now) \
+                        > self.age_watermark:
+                    return "age"
+        return None
 
     # -- delaying -------------------------------------------------------
 
-    def add_after(self, item: Any, delay: float) -> None:
-        if delay <= 0:
-            self.add(item)
-            return
+    def add_after(self, item: Any, delay: float,
+                  klass: str = CLASS_KEEP) -> None:
         with self._cond:
-            if self._shutting_down:
-                return
-            self._waiting_seq += 1
-            heapq.heappush(self._waiting,
-                           (time.monotonic() + delay, self._waiting_seq, item))
-            self._cond.notify_all()
+            self._add_after_locked(item, delay, klass)
+
+    def _add_after_locked(self, item: Any, delay: float,
+                          klass: str) -> None:
+        if self._shutting_down:
+            return
+        if delay <= 0:
+            self._enter_dirty_locked(
+                item, self._resolve_class_locked(item, klass))
+            return
+        self._class[item] = self._resolve_class_locked(item, klass)
+        # the latency stamp starts at the REQUEST, not at promotion
+        # from the delay heap: the rate limiter's backoff is part
+        # of the system's event->converged response time
+        self._enqueued_at.setdefault(item, time.monotonic())
+        deadline = time.monotonic() + delay
+        have = self._waiting_index.get(item)
+        if have is not None and have[0] <= deadline:
+            return  # an earlier wake is already scheduled
+        self._waiting_seq += 1
+        entry = (deadline, self._waiting_seq)
+        self._waiting_index[item] = entry
+        heapq.heappush(self._waiting, (deadline, entry[1], item))
+        self._cond.notify_all()
 
     def _wait_loop(self) -> None:
         while True:
@@ -241,12 +495,13 @@ class RateLimitingQueue:
                     return
                 now = time.monotonic()
                 while self._waiting and self._waiting[0][0] <= now:
-                    _, _, item = heapq.heappop(self._waiting)
-                    if item not in self._dirty:
-                        self._dirty.add(item)
-                        if item not in self._processing:
-                            self._queue.append(item)
-                            self._cond.notify()
+                    deadline, seq, item = heapq.heappop(self._waiting)
+                    if self._waiting_index.get(item) != (deadline, seq):
+                        continue  # superseded by an earlier deadline
+                    del self._waiting_index[item]
+                    self._enter_dirty_locked(
+                        item, self._class.get(item, CLASS_INTERACTIVE),
+                        front=True)
                 if self._shutting_down:
                     return
                 timeout = 0.2
@@ -256,8 +511,30 @@ class RateLimitingQueue:
 
     # -- rate limited ---------------------------------------------------
 
-    def add_rate_limited(self, item: Any) -> None:
-        self.add_after(item, self._rate_limiter.when(item))
+    def add_rate_limited(self, item: Any, klass: str = CLASS_KEEP) -> None:
+        """Schedule the item through the rate limiter.  The limiter is
+        charged ONCE PER SCHEDULED DELIVERY: an add that dedups into
+        an already-runnable item is a plain class-upgrade no-op, and
+        an add for an item already parked in the delay heap only peeks
+        (it may pull the wake earlier within the current backoff).
+        Charging every call — the previous behavior — let sustained
+        healthy event traffic inflate per-item failure counts and run
+        the admission bucket into an unbounded deficit, which parked
+        the next delivery of every key for minutes (the overload-soak
+        starvation shape); the duplicate delay-heap entries that used
+        to mask it were themselves the min-deadline-dedupe bug.
+        Decision and scheduling happen under ONE lock hold: deciding,
+        releasing, and re-locking would let a promotion+completion in
+        the gap turn the uncharged peek into a fresh (spurious)
+        delivery."""
+        with self._cond:
+            if item in self._dirty:
+                delay = 0.0          # already runnable: no new delivery
+            elif item in self._waiting_index:
+                delay = self._rate_limiter.peek(item)
+            else:
+                delay = self._rate_limiter.when(item)
+            self._add_after_locked(item, delay, klass)
 
     def forget(self, item: Any) -> None:
         self._rate_limiter.forget(item)

@@ -31,11 +31,20 @@ from urllib.parse import parse_qs, urlparse
 logger = logging.getLogger(__name__)
 
 
+# Prometheus-convention histogram buckets for reconcile latency:
+# sub-10ms fast path through multi-second chaos parks.
+LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0)
+
+
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple], float] = defaultdict(float)
         self._summaries: Dict[Tuple[str, Tuple], Tuple[float, int]] = {}
+        # (name, labels) -> (buckets, bucket counts, sum, count)
+        self._histograms: Dict[Tuple[str, Tuple],
+                               Tuple[Tuple, List[int], float, int]] = {}
         self._gauge_fns: List[Tuple[str, Tuple, Callable[[], float]]] = []
         self._help: Dict[str, str] = {}
 
@@ -67,6 +76,38 @@ class Registry:
             s, c = self._summaries.get(key, (0.0, 0))
             self._summaries[key] = (s + value, c + 1)
 
+    def observe_histogram(self, name: str, labels: Dict[str, str],
+                          value: float,
+                          buckets: Tuple = LATENCY_BUCKETS) -> None:
+        """Prometheus histogram observe: cumulative ``_bucket{le=}``
+        series plus ``_sum``/``_count`` (rendered that way too), so
+        p50/p99 are derivable by any scraper."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            got = self._histograms.get(key)
+            if got is None or got[0] != buckets:
+                got = (buckets, [0] * (len(buckets) + 1), 0.0, 0)
+            bounds, counts, s, c = got
+            for i, le in enumerate(bounds):
+                if value <= le:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1  # +Inf
+            self._histograms[key] = (bounds, counts, s + value, c + 1)
+
+    def histogram_count(self, name: str,
+                        labels: Optional[Dict[str, str]] = None) -> int:
+        """Total observations of a histogram: the exact series, or the
+        sum over all series of ``name`` when labels is None."""
+        with self._lock:
+            if labels is not None:
+                got = self._histograms.get(
+                    (name, tuple(sorted(labels.items()))))
+                return got[3] if got else 0
+            return sum(v[3] for (n, _), v in self._histograms.items()
+                       if n == name)
+
     def register_gauge(self, name: str, labels: Dict[str, str],
                        fn: Callable[[], float]) -> None:
         """Re-registering the same (name, labels) replaces the callback --
@@ -90,6 +131,8 @@ class Registry:
         with self._lock:
             counters = dict(self._counters)
             summaries = dict(self._summaries)
+            histograms = {k: (v[0], list(v[1]), v[2], v[3])
+                          for k, v in self._histograms.items()}
             gauges = list(self._gauge_fns)
             helps = dict(self._help)
 
@@ -107,6 +150,20 @@ class Registry:
             lines.append(f"{name}{self._fmt_labels(labels)} {value}")
         for (name, labels), (s, c) in sorted(summaries.items()):
             emit_help(name, "summary")
+            lines.append(f"{name}_sum{self._fmt_labels(labels)} {s}")
+            lines.append(f"{name}_count{self._fmt_labels(labels)} {c}")
+        for (name, labels), (bounds, counts, s, c) in sorted(
+                histograms.items()):
+            emit_help(name, "histogram")
+            cumulative = 0
+            for le, n in zip(bounds, counts):
+                cumulative += n
+                le_labels = labels + (("le", repr(le)),)
+                lines.append(f"{name}_bucket"
+                             f"{self._fmt_labels(le_labels)} {cumulative}")
+            lines.append(f"{name}_bucket"
+                         f"{self._fmt_labels(labels + (('le', '+Inf'),))}"
+                         f" {c}")
             lines.append(f"{name}_sum{self._fmt_labels(labels)} {s}")
             lines.append(f"{name}_count{self._fmt_labels(labels)} {c}")
         for name, labels, fn in gauges:
@@ -230,6 +287,24 @@ default_registry.describe(
     "Wall-clock of ordered manager shutdowns (fence -> coalescer "
     "drain -> seal -> workqueue drain -> worker join), observed once "
     "per stop (manager/manager.py ManagerHandle.stop).")
+default_registry.describe(
+    "reconcile_latency_seconds",
+    "Event->converged latency per controller queue and traffic class "
+    "(interactive = watch events / user-visible changes, background = "
+    "resync/sweep re-deliveries): first enqueue of the pending change "
+    "to the successful sync that converged it, SPANNING requeues and "
+    "parks (reconcile/ dispatch; the mixed-soak SLO's source).")
+default_registry.describe(
+    "workqueue_oldest_age_seconds",
+    "Age of the oldest item per queue tier — the age-watermark "
+    "overload signal's raw material (kube/workqueue.py).")
+default_registry.describe(
+    "sheds_total",
+    "Background (resync/sweep) enqueues dropped by the overload "
+    "shedder, per controller queue and reason (depth / age "
+    "watermark).  Shedding is correctness-free: the key's fingerprint "
+    "state is untouched and the next resync wave re-delivers it "
+    "(controller/base.py resync_enqueue).")
 default_registry.describe(
     "race_lockset_checks",
     "Lock acquisitions screened by the runtime lockset tracker "
@@ -449,10 +524,64 @@ def record_sync(queue_name: str, result: str, duration: float,
                         {"queue": queue_name}, duration)
 
 
+# Optional in-process sample sink for reconcile latency: the mixed-soak
+# bench arms it to compute exact per-class p50/p99 (histogram buckets
+# are too coarse for a 2x-ratio SLO assertion).  Append-only under the
+# GIL; None when disarmed (the steady-state default — zero overhead
+# beyond one attribute read).
+_latency_sink: Optional[List[Tuple[str, str, float]]] = None
+
+
+def arm_latency_sampler() -> List[Tuple[str, str, float]]:
+    """Start collecting raw (controller, class, seconds) latency
+    samples; returns the live list the caller reads."""
+    global _latency_sink
+    _latency_sink = []
+    return _latency_sink
+
+
+def disarm_latency_sampler() -> None:
+    global _latency_sink
+    _latency_sink = None
+
+
+def record_reconcile_latency(controller: str, klass: str, seconds: float,
+                             registry: Optional[Registry] = None) -> None:
+    """One key converged ``seconds`` after the first enqueue of its
+    pending change (event->converged, spanning requeues/parks)."""
+    reg = registry or default_registry
+    reg.observe_histogram("reconcile_latency_seconds",
+                          {"controller": controller, "class": klass},
+                          seconds)
+    sink = _latency_sink
+    if sink is not None:
+        sink.append((controller, klass, seconds))
+
+
+def record_shed(controller: str, reason: str,
+                registry: Optional[Registry] = None) -> None:
+    """One background (resync/sweep) enqueue dropped by the overload
+    shedder (``reason``: depth / age watermark)."""
+    reg = registry or default_registry
+    reg.inc_counter("sheds_total",
+                    {"controller": controller, "reason": reason})
+
+
 def watch_queue_depth(queue, registry: Optional[Registry] = None) -> None:
     reg = registry or default_registry
     reg.register_gauge("workqueue_depth", {"queue": queue.name},
                        lambda: float(len(queue)))
+    if not hasattr(queue, "tier_len"):
+        return  # a non-tiered queue (tests' stand-ins)
+    from .kube.workqueue import TIERS
+    for tier in TIERS:
+        reg.register_gauge(
+            "workqueue_depth", {"queue": queue.name, "tier": tier},
+            lambda q=queue, t=tier: float(q.tier_len(t)))
+        reg.register_gauge(
+            "workqueue_oldest_age_seconds",
+            {"queue": queue.name, "tier": tier},
+            lambda q=queue, t=tier: float(q.tier_oldest_age(t)))
 
 
 class HealthServer:

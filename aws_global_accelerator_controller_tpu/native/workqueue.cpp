@@ -13,12 +13,24 @@
 //    while a worker holds the item (processing set) are deferred to done();
 //  - delaying adds via a min-heap, promoted inside get() (no waker thread:
 //    the waiting consumer computes its own wakeup deadline and add_after
-//    notifies, so the earliest-deadline sleeper re-evaluates);
+//    notifies, so the earliest-deadline sleeper re-evaluates); pending
+//    entries are deduped per item keeping the EARLIEST deadline (two parks
+//    must keep the earliest wake time — the Python queue's _waiting_index);
 //  - per-item exponential backoff (base*2^failures, capped) maxed with a
 //    global token bucket whose token count may go negative, matching
 //    client-go's rate.Limiter reservation behaviour and the Python port;
 //  - shutdown() wakes all waiters; get() on a drained shut-down queue
 //    reports shutdown.
+//
+// Priority tiers (kube/workqueue.py module docstring): items carry a
+// traffic class — interactive (1) or background (0) — each with its own
+// FIFO deque.  get() draws by AGED priority: effective priority = class
+// base + head wait / aging_horizon, higher head wins, interactive on
+// ties; so interactive changes bypass resync backlogs while a background
+// item is served within ~one aging horizon even under a saturating
+// interactive storm.  The class is a property of the item across requeues
+// (klass = -1 on the *2 entry points means "keep"); an interactive add of
+// an item waiting in the background deque promotes it in place.
 //
 // Thread-safety: one mutex per queue; get() blocks with the GIL released
 // (ctypes releases it for the duration of the foreign call), so Python
@@ -40,6 +52,10 @@ namespace {
 
 using Clock = std::chrono::steady_clock;
 
+constexpr int kBackground = 0;
+constexpr int kInteractive = 1;
+constexpr int kKeepClass = -1;
+
 struct WaitingEntry {
   Clock::time_point ready_at;
   uint64_t seq;
@@ -54,20 +70,37 @@ struct Queue {
   std::mutex mu;
   std::condition_variable cv;
 
-  std::deque<std::string> queue;
+  // one FIFO per tier: [kBackground], [kInteractive]
+  std::deque<std::string> tiers[2];
   std::unordered_set<std::string> dirty;
   std::unordered_set<std::string> processing;
+  // item -> traffic class while anywhere in the queue machinery
+  std::unordered_map<std::string, int> klass;
+  // item -> REQUEST time of the pending delivery (backoff included —
+  // the latency stamp)
+  std::unordered_map<std::string, Clock::time_point> enqueued_at;
+  // item -> time the item became RUNNABLE (entered its tier deque) —
+  // what aging, tier_oldest_age and the age watermark measure: a
+  // parked retry's deliberate backoff is latency, not queue wait
+  std::unordered_map<std::string, Clock::time_point> runnable_at;
   bool shutting_down = false;
 
   std::priority_queue<WaitingEntry, std::vector<WaitingEntry>,
                       std::greater<WaitingEntry>>
       waiting;
+  // item -> (deadline, seq) of the LIVE heap entry: dedupe keeping the
+  // earliest wake; heap entries not matching are stale and skipped
+  std::unordered_map<std::string, std::pair<Clock::time_point, uint64_t>>
+      waiting_index;
   uint64_t waiting_seq = 0;
 
   // ItemExponentialFailureRateLimiter state.
   std::unordered_map<std::string, int> failures;
   double base_delay;
   double max_delay;
+
+  // aged-priority horizon (seconds); <= 0 disables aging
+  double aging_horizon;
 
   // BucketRateLimiter state (tokens may go negative, like golang.org/x/time
   // reservations and the Python port).
@@ -76,21 +109,83 @@ struct Queue {
   double tokens;
   Clock::time_point last_refill;
 
-  Queue(double qps_, int burst_, double base_delay_, double max_delay_)
+  Queue(double qps_, int burst_, double base_delay_, double max_delay_,
+        double aging_horizon_)
       : base_delay(base_delay_),
         max_delay(max_delay_),
+        aging_horizon(aging_horizon_),
         qps(qps_),
         burst(static_cast<double>(burst_)),
         tokens(static_cast<double>(burst_)),
         last_refill(Clock::now()) {}
 
-  // Callers hold mu.
-  void add_locked(const std::string& item) {
+  int resolve_class_locked(const std::string& item, int k) {
+    auto it = klass.find(item);
+    int have = it == klass.end() ? kKeepClass : it->second;
+    if (k == kKeepClass) return have == kKeepClass ? kInteractive : have;
+    int want = k ? kInteractive : kBackground;
+    // upgrade-only while tracked: a background re-tag must not demote
+    // pending interactive work (kube/workqueue.py twin)
+    if (want == kBackground && have == kInteractive) return kInteractive;
+    return want;
+  }
+
+  void drop_if_gone_locked(const std::string& item) {
+    if (!dirty.count(item) && !processing.count(item) &&
+        !waiting_index.count(item)) {
+      klass.erase(item);
+      enqueued_at.erase(item);
+      runnable_at.erase(item);
+    }
+  }
+
+  // Callers hold mu.  `front` (delay-heap promotions) enters at the
+  // HEAD of the tier: a parked retry's request predates everything
+  // enqueued while it was parked, so joining the tail would make its
+  // wait grow with storm depth (kube/workqueue.py twin).
+  void add_locked(const std::string& item, int k, bool front = false) {
     if (shutting_down) return;
-    if (dirty.count(item)) return;
+    k = resolve_class_locked(item, k);
+    auto prior = klass.find(item);
+    int prior_k = prior == klass.end() ? kKeepClass : prior->second;
+    klass[item] = k;
+    if (dirty.count(item)) {
+      // interactive re-add of an item waiting in the background tier:
+      // promote it in place, keeping its enqueue time (latency is
+      // measured from the oldest pending event)
+      if (k == kInteractive && prior_k == kBackground &&
+          !processing.count(item)) {
+        auto& bq = tiers[kBackground];
+        for (auto it = bq.begin(); it != bq.end(); ++it) {
+          if (*it == item) {
+            bq.erase(it);
+            tiers[kInteractive].push_back(item);
+            cv.notify_one();
+            break;
+          }
+        }
+      }
+      return;
+    }
     dirty.insert(item);
+    Clock::time_point now = Clock::now();
+    enqueued_at.emplace(item, now);
     if (processing.count(item)) return;
-    queue.push_back(item);
+    runnable_at[item] = now;
+    auto& tq = tiers[k];
+    // only ahead of strictly-younger work: same-batch promotions stay
+    // FIFO (kube/workqueue.py twin)
+    bool ahead = false;
+    if (front && !tq.empty()) {
+      auto mine = enqueued_at.find(item);
+      auto head = enqueued_at.find(tq.front());
+      ahead = mine != enqueued_at.end() &&
+              (head == enqueued_at.end() || mine->second < head->second);
+    }
+    if (ahead)
+      tq.push_front(item);
+    else
+      tq.push_back(item);
     cv.notify_one();
   }
 
@@ -101,23 +196,72 @@ struct Queue {
     // item mid-teardown.
     if (shutting_down) return;
     while (!waiting.empty() && waiting.top().ready_at <= now) {
-      std::string item = waiting.top().item;
+      WaitingEntry top = waiting.top();
       waiting.pop();
-      if (dirty.count(item)) continue;
-      dirty.insert(item);
-      if (processing.count(item)) continue;
-      queue.push_back(item);
-      cv.notify_one();
+      auto idx = waiting_index.find(top.item);
+      if (idx == waiting_index.end() || idx->second.first != top.ready_at ||
+          idx->second.second != top.seq)
+        continue;  // superseded by an earlier deadline
+      waiting_index.erase(idx);
+      add_locked(top.item, kKeepClass, /*front=*/true);
     }
   }
 
-  // Combined limiter delay in seconds (max of exponential + bucket).
-  // Callers hold mu.
-  double rate_limit_when_locked(const std::string& item) {
-    int f = failures[item]++;
+  // The aged-priority draw (kube/workqueue.py _pick_tier_locked):
+  // returns the tier to pop from, or -1 when both are empty.
+  int pick_tier_locked(Clock::time_point now) {
+    bool have_i = !tiers[kInteractive].empty();
+    bool have_b = !tiers[kBackground].empty();
+    if (!have_i) return have_b ? kBackground : -1;
+    if (!have_b) return kInteractive;
+    if (aging_horizon <= 0) return kInteractive;
+    auto wait_of = [&](const std::string& item) {
+      auto it = runnable_at.find(item);
+      if (it == runnable_at.end()) return 0.0;
+      return std::chrono::duration<double>(now - it->second).count();
+    };
+    double i_wait = wait_of(tiers[kInteractive].front());
+    double b_wait = wait_of(tiers[kBackground].front());
+    if (b_wait > aging_horizon + i_wait) return kBackground;
+    return kInteractive;
+  }
+
+  void schedule_after_locked(const std::string& item, double delay_s,
+                             int k) {
+    if (shutting_down) return;
+    if (delay_s <= 0) {
+      add_locked(item, k);
+      return;
+    }
+    klass[item] = resolve_class_locked(item, k);
+    // latency stamps start at the REQUEST: the backoff a delayed add
+    // waits out is part of event->converged (kube/workqueue.py twin)
+    enqueued_at.emplace(item, Clock::now());
+    Clock::time_point deadline =
+        Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                           std::chrono::duration<double>(delay_s));
+    auto idx = waiting_index.find(item);
+    if (idx != waiting_index.end() && idx->second.first <= deadline)
+      return;  // an earlier wake is already scheduled
+    uint64_t seq = ++waiting_seq;
+    waiting_index[item] = {deadline, seq};
+    waiting.push(WaitingEntry{deadline, seq, item});
+    cv.notify_all();
+  }
+
+  double exp_delay_for(int f) const {
     double exp_delay = base_delay;
     for (int i = 0; i < f && exp_delay < max_delay; ++i) exp_delay *= 2.0;
-    if (exp_delay > max_delay) exp_delay = max_delay;
+    return exp_delay > max_delay ? max_delay : exp_delay;
+  }
+
+  // Combined limiter delay in seconds (max of exponential + bucket),
+  // charging one failure + one token.  The bucket's deficit is bounded
+  // at 2x burst (kube/workqueue.py BucketRateLimiter: an unbounded
+  // reservation backlog would park the next lone event for minutes).
+  // Callers hold mu.
+  double rate_limit_when_locked(const std::string& item) {
+    double exp_delay = exp_delay_for(failures[item]++);
 
     Clock::time_point now = Clock::now();
     double elapsed = std::chrono::duration<double>(now - last_refill).count();
@@ -129,9 +273,17 @@ struct Queue {
     } else {
       double deficit = 1.0 - tokens;
       tokens -= 1.0;
+      if (tokens < -2.0 * burst) tokens = -2.0 * burst;
       bucket_delay = deficit / qps;
     }
     return exp_delay > bucket_delay ? exp_delay : bucket_delay;
+  }
+
+  // The delay a DEDUPLICATED add consults: no failure charged, no
+  // token consumed (kube/workqueue.py ItemExponential...peek).
+  double rate_limit_peek_locked(const std::string& item) {
+    auto it = failures.find(item);
+    return exp_delay_for(it == failures.end() ? 0 : it->second);
   }
 };
 
@@ -139,22 +291,32 @@ struct Queue {
 
 extern "C" {
 
+void* aga_wq_new2(double qps, int burst, double base_delay, double max_delay,
+                  double aging_horizon) {
+  return new Queue(qps, burst, base_delay, max_delay, aging_horizon);
+}
+
 void* aga_wq_new(double qps, int burst, double base_delay, double max_delay) {
-  return new Queue(qps, burst, base_delay, max_delay);
+  return aga_wq_new2(qps, burst, base_delay, max_delay, 2.0);
 }
 
 void aga_wq_free(void* h) { delete static_cast<Queue*>(h); }
 
-void aga_wq_add(void* h, const char* item) {
+void aga_wq_add2(void* h, const char* item, int klass) {
   Queue* q = static_cast<Queue*>(h);
   std::lock_guard<std::mutex> lk(q->mu);
-  q->add_locked(item);
+  q->add_locked(item, klass);
 }
+
+void aga_wq_add(void* h, const char* item) { aga_wq_add2(h, item, kKeepClass); }
 
 // Returns 0 = item copied into buf, 1 = shutdown-and-drained, 2 = timeout,
 // 3 = buf too small (len written to *need).  timeout_s < 0 means block
-// until an item arrives or shutdown.
-int aga_wq_get(void* h, char* buf, int buflen, double timeout_s, int* need) {
+// until an item arrives or shutdown.  out_klass (nullable) receives the
+// claimed item's traffic class; out_wait_s (nullable) its queue wait in
+// seconds (enqueue -> this get) — the latency stamp's raw material.
+int aga_wq_get2(void* h, char* buf, int buflen, double timeout_s, int* need,
+                int* out_klass, double* out_wait_s) {
   Queue* q = static_cast<Queue*>(h);
   std::unique_lock<std::mutex> lk(q->mu);
   Clock::time_point deadline{};
@@ -165,7 +327,7 @@ int aga_wq_get(void* h, char* buf, int buflen, double timeout_s, int* need) {
   for (;;) {
     Clock::time_point now = Clock::now();
     q->promote_ready_locked(now);
-    if (!q->queue.empty()) break;
+    if (!q->tiers[0].empty() || !q->tiers[1].empty()) break;
     if (q->shutting_down) return 1;
     if (bounded && now >= deadline) return 2;
     // Sleep until the caller deadline or the next delayed item, whichever
@@ -186,8 +348,10 @@ int aga_wq_get(void* h, char* buf, int buflen, double timeout_s, int* need) {
     else
       q->cv.wait(lk);
   }
-  std::string item = q->queue.front();
-  q->queue.pop_front();
+  Clock::time_point now = Clock::now();
+  int tier = q->pick_tier_locked(now);
+  std::string item = q->tiers[tier].front();
+  q->tiers[tier].pop_front();
   q->processing.insert(item);
   q->dirty.erase(item);
   int n = static_cast<int>(item.size());
@@ -196,12 +360,29 @@ int aga_wq_get(void* h, char* buf, int buflen, double timeout_s, int* need) {
     // Undo so the caller can retry with a bigger buffer.
     q->processing.erase(item);
     q->dirty.insert(item);
-    q->queue.push_front(item);
+    q->tiers[tier].push_front(item);
     return 3;
   }
+  if (out_klass) {
+    auto it = q->klass.find(item);
+    *out_klass = it == q->klass.end() ? kInteractive : it->second;
+  }
+  if (out_wait_s) {
+    auto it = q->enqueued_at.find(item);
+    *out_wait_s =
+        it == q->enqueued_at.end()
+            ? 0.0
+            : std::chrono::duration<double>(now - it->second).count();
+  }
+  q->enqueued_at.erase(item);
+  q->runnable_at.erase(item);
   std::memcpy(buf, item.data(), n);
   buf[n] = '\0';
   return 0;
+}
+
+int aga_wq_get(void* h, char* buf, int buflen, double timeout_s, int* need) {
+  return aga_wq_get2(h, buf, buflen, timeout_s, need, nullptr, nullptr);
 }
 
 void aga_wq_done(void* h, const char* item) {
@@ -209,45 +390,48 @@ void aga_wq_done(void* h, const char* item) {
   std::lock_guard<std::mutex> lk(q->mu);
   q->processing.erase(item);
   if (q->dirty.count(item)) {
-    q->queue.push_back(item);
+    q->runnable_at[item] = Clock::now();
+    q->tiers[q->resolve_class_locked(item, kKeepClass)].push_back(item);
     q->cv.notify_one();
+  } else {
+    q->drop_if_gone_locked(item);
   }
+}
+
+void aga_wq_add_after2(void* h, const char* item, double delay_s, int klass) {
+  Queue* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->schedule_after_locked(item, delay_s, klass);
 }
 
 void aga_wq_add_after(void* h, const char* item, double delay_s) {
-  Queue* q = static_cast<Queue*>(h);
-  std::lock_guard<std::mutex> lk(q->mu);
-  if (q->shutting_down) return;
-  if (delay_s <= 0) {
-    q->add_locked(item);
-    return;
-  }
-  q->waiting.push(WaitingEntry{
-      Clock::now() + std::chrono::duration_cast<Clock::duration>(
-                         std::chrono::duration<double>(delay_s)),
-      ++q->waiting_seq, item});
-  q->cv.notify_all();
+  aga_wq_add_after2(h, item, delay_s, kKeepClass);
 }
 
 // Returns the delay applied, so callers/metrics can observe backoff.
-double aga_wq_add_rate_limited(void* h, const char* item) {
+// The limiter is charged once per SCHEDULED delivery: an add deduped
+// into an already-runnable item is a plain class-upgrade no-op, one
+// for an item parked in the delay heap only peeks (it may pull the
+// wake earlier within the current backoff) — kube/workqueue.py
+// add_rate_limited, where the rationale lives.
+double aga_wq_add_rate_limited2(void* h, const char* item, int klass) {
   Queue* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  if (q->shutting_down) return 0.0;
   double delay;
-  {
-    std::lock_guard<std::mutex> lk(q->mu);
-    if (q->shutting_down) return 0.0;
+  if (q->dirty.count(item)) {
+    delay = 0.0;
+  } else if (q->waiting_index.count(item)) {
+    delay = q->rate_limit_peek_locked(item);
+  } else {
     delay = q->rate_limit_when_locked(item);
-    if (delay <= 0) {
-      q->add_locked(item);
-      return 0.0;
-    }
-    q->waiting.push(WaitingEntry{
-        Clock::now() + std::chrono::duration_cast<Clock::duration>(
-                           std::chrono::duration<double>(delay)),
-        ++q->waiting_seq, item});
-    q->cv.notify_all();
   }
+  q->schedule_after_locked(item, delay, klass);
   return delay;
+}
+
+double aga_wq_add_rate_limited(void* h, const char* item) {
+  return aga_wq_add_rate_limited2(h, item, kKeepClass);
 }
 
 void aga_wq_forget(void* h, const char* item) {
@@ -267,13 +451,35 @@ int aga_wq_len(void* h) {
   Queue* q = static_cast<Queue*>(h);
   std::lock_guard<std::mutex> lk(q->mu);
   q->promote_ready_locked(Clock::now());
-  return static_cast<int>(q->queue.size());
+  return static_cast<int>(q->tiers[0].size() + q->tiers[1].size());
+}
+
+int aga_wq_tier_len(void* h, int klass) {
+  Queue* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return static_cast<int>(q->tiers[klass ? kInteractive : kBackground].size());
+}
+
+// Seconds the tier's head item has been RUNNABLE (0.0 when empty) —
+// backs the workqueue_oldest_age_seconds{queue,tier} gauge and the
+// age-watermark overload signal.  Deliberately not the request stamp:
+// a promoted retry's backoff was a scheduling decision, not queue
+// congestion (kube/workqueue.py twin).
+double aga_wq_tier_oldest_age(void* h, int klass) {
+  Queue* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  auto& tier = q->tiers[klass ? kInteractive : kBackground];
+  if (tier.empty()) return 0.0;
+  auto it = q->runnable_at.find(tier.front());
+  if (it == q->runnable_at.end()) return 0.0;
+  double age = std::chrono::duration<double>(Clock::now() - it->second).count();
+  return age > 0.0 ? age : 0.0;
 }
 
 int aga_wq_waiting_len(void* h) {
   Queue* q = static_cast<Queue*>(h);
   std::lock_guard<std::mutex> lk(q->mu);
-  return static_cast<int>(q->waiting.size());
+  return static_cast<int>(q->waiting_index.size());
 }
 
 void aga_wq_shutdown(void* h) {

@@ -34,13 +34,14 @@ from typing import Callable, Optional
 
 from .. import metrics
 from ..errors import is_no_retry, is_not_found, retry_after_hint
-from ..kube.workqueue import RateLimitingQueue
+from ..kube.workqueue import CLASS_INTERACTIVE, CLASS_KEEP, RateLimitingQueue
 from ..tracing import default_tracer
 from .fingerprint import (
     ORIGIN_RESYNC,
     ORIGIN_SWEEP,
     FingerprintCache,
 )
+from .traffic import dispatch_class
 
 logger = logging.getLogger(__name__)
 
@@ -103,6 +104,16 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
     obj = None
     origin = (fingerprints.claim_origin(key)
               if fingerprints is not None else None)
+    # the tier this delivery rode (kube/workqueue.py): the class labels
+    # the latency sample and marks the sync's thread for downstream
+    # scheduling decisions (the coalescer's deadline-aware linger);
+    # first_enqueued spans requeues so latency is honest event->converged
+    meta = queue.claimed_meta(key) if hasattr(queue, "claimed_meta") \
+        else None
+    klass, enqueued_at = meta if meta is not None \
+        else (CLASS_INTERACTIVE, start)
+    first_enqueued = (fingerprints.pending_since(key, enqueued_at)
+                      if fingerprints is not None else enqueued_at)
     with default_tracer.span("reconcile", queue=queue.name or "queue",
                              key=key) as span:
         try:
@@ -112,7 +123,8 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                 if fingerprints is not None:
                     fingerprints.invalidate(key)
                 try:
-                    res = process_delete(key) or Result()
+                    with dispatch_class(klass):
+                        res = process_delete(key) or Result()
                 except Exception as de:
                     err = de
             else:
@@ -129,6 +141,7 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
             if (fingerprints is not None and origin == ORIGIN_RESYNC
                     and fingerprints.matches(key, obj)):
                 queue.forget(key)
+                fingerprints.clear_pending(key)
                 metrics.record_fastpath_skip(fingerprints.controller)
                 span.attributes["outcome"] = "fastpath_skip"
                 logger.debug("fingerprint unchanged for %r, skipped "
@@ -145,12 +158,14 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                      and fingerprints.matches(key, obj))
             try:
                 if sweep:
-                    with fingerprints.sweep_verify():
+                    with fingerprints.sweep_verify(), \
+                            dispatch_class(klass):
                         res = (process_create_or_update(obj.deep_copy())
                                or Result())
                 else:
-                    res = (process_create_or_update(obj.deep_copy())
-                           or Result())
+                    with dispatch_class(klass):
+                        res = (process_create_or_update(obj.deep_copy())
+                               or Result())
             except Exception as ce:
                 err = ce
 
@@ -161,6 +176,10 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                 fingerprints.invalidate(key)
             if is_no_retry(err):
                 outcome = "no_retry_error"
+                if fingerprints is not None:
+                    # terminally dropped: the pending change will never
+                    # converge via retries — close its latency window
+                    fingerprints.clear_pending(key)
                 logger.error("error syncing %r: %s", key, err)
             elif (hint := retry_after_hint(err)) > 0:
                 # the resilient call layer already burned an in-call
@@ -179,24 +198,24 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                 # key-stable jitter in [1.0, 1.25) decorrelates them
                 # (deterministic per key — no park-time flapping)
                 jitter = 1.0 + 0.25 * (zlib.crc32(key.encode()) / 2**32)
-                queue.add_after(key, hint * jitter)
+                queue.add_after(key, hint * jitter, klass=CLASS_KEEP)
                 logger.warning("error syncing %r, retry budget "
                                "exhausted; parked %.2fs: %s",
                                key, hint * jitter, err)
             else:
                 outcome = "error"
-                queue.add_rate_limited(key)
+                queue.add_rate_limited(key, klass=CLASS_KEEP)
                 logger.error("error syncing %r, and requeued: %s", key, err)
             span.error = f"{type(err).__name__}: {err}"
         elif res.requeue_after > 0:
             outcome = "requeue_after"
             queue.forget(key)
-            queue.add_after(key, res.requeue_after)
+            queue.add_after(key, res.requeue_after, klass=CLASS_KEEP)
             logger.info("successfully synced %r, but requeued after %.1fs",
                         key, res.requeue_after)
         elif res.requeue:
             outcome = "requeue"
-            queue.add_rate_limited(key)
+            queue.add_rate_limited(key, klass=CLASS_KEEP)
             logger.info("successfully synced %r, but requeued", key)
         else:
             outcome = "success"
@@ -205,6 +224,13 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                 # the state this sync verified/converged is what the
                 # next resync re-delivery will be compared against
                 fingerprints.record(key, obj)
+            if fingerprints is not None:
+                fingerprints.clear_pending(key)
+            # event->converged: first enqueue of the pending change to
+            # this success, spanning any requeues/parks in between
+            metrics.record_reconcile_latency(
+                queue.name or "queue", klass,
+                time.monotonic() - first_enqueued)
             logger.debug("successfully synced %r (%.3fs)",
                          key, time.monotonic() - start)
         span.attributes["outcome"] = outcome

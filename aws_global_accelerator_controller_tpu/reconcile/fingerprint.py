@@ -151,6 +151,11 @@ class FingerprintCache:
         self._fp: "OrderedDict[str, tuple]" = OrderedDict()
         # key -> pending enqueue origin (claimed at dispatch)
         self._origin: dict = {}
+        # key -> first-enqueue monotonic time of the change currently
+        # converging: event->converged latency must span requeues and
+        # parks, so the first dispatch records it and retries reuse it
+        # until the key converges (or is dropped) — reconcile dispatch
+        self._pending_since: dict = {}
         with _caches_lock:
             _caches.add(self)
 
@@ -198,6 +203,22 @@ class FingerprintCache:
         treated like an event by callers: full sync."""
         with self._lock:
             return self._origin.pop(key, None)
+
+    # -- event->converged latency bookkeeping --------------------------
+
+    def pending_since(self, key: str, enqueued_at: float) -> float:
+        """First-enqueue time of the change ``key`` is converging:
+        records ``enqueued_at`` (the queue's claimed-delivery stamp)
+        on the first dispatch, returns the recorded one on retries —
+        so the latency a success records spans requeues and parks."""
+        with self._lock:
+            return self._pending_since.setdefault(key, enqueued_at)
+
+    def clear_pending(self, key: str) -> None:
+        """The change converged (or was terminally dropped): the next
+        dispatch of ``key`` starts a fresh latency window."""
+        with self._lock:
+            self._pending_since.pop(key, None)
 
     # -- the gate -------------------------------------------------------
 

@@ -1,0 +1,49 @@
+"""Traffic-class dispatch context: which tier's work this thread runs.
+
+The reconcile dispatch wraps every sync in :func:`dispatch_class` with
+the traffic class the workqueue delivered the key under (interactive =
+watch events / user-visible changes, background = resync waves, drift
+sweeps — kube/workqueue.py).  Downstream layers consult
+:func:`current_class` instead of threading a parameter through every
+provider signature — the same thread-local pattern the sweep context
+uses (reconcile/fingerprint.py ``in_sweep``).
+
+The one consumer today is the write coalescer's deadline-aware linger
+(cloudprovider/aws/batcher.py): a cohort with an interactive waiter
+flushes immediately instead of paying the batching linger tuned for
+bulk cohorts — the NCCL move of picking the low-latency protocol for
+small messages and the bandwidth protocol for bulk (PAPERS.md),
+applied to flush scheduling.
+
+Unset (no dispatch on the stack — tests, CLI seeding tools, provider
+internals) reads as BACKGROUND: the linger/batching contract predates
+traffic classes, so anything not explicitly delivered as interactive
+by the workqueue keeps the bulk size-or-deadline semantics.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from ..kube.workqueue import CLASS_BACKGROUND, CLASS_INTERACTIVE  # noqa: F401
+
+_tls = threading.local()
+
+
+@contextmanager
+def dispatch_class(klass: str):
+    """Mark this thread as running a sync delivered under ``klass``
+    for the duration of the block (re-entrant: restores the prior
+    value on exit)."""
+    prior = getattr(_tls, "klass", None)
+    _tls.klass = klass
+    try:
+        yield
+    finally:
+        _tls.klass = prior
+
+
+def current_class() -> str:
+    """The traffic class of the sync on this thread's stack
+    (CLASS_BACKGROUND when none is marked)."""
+    return getattr(_tls, "klass", None) or CLASS_BACKGROUND

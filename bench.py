@@ -610,6 +610,217 @@ def bench_restart_recovery(n_services: int = 1000, workers: int = 4,
     return out
 
 
+def bench_mixed_soak(n_services: int = 1000, workers: int = 6,
+                     resync: float = 1.0, sweep_every: int = 50,
+                     churn_seconds: float = 10.0,
+                     churn_interval: float = 0.05,
+                     chaos_rate: float = 0.2, seed: int = 20260804,
+                     settle_seconds: float = 4.0,
+                     record: bool = False) -> dict:
+    """Mixed-load latency soak (ISSUE 7 / ROADMAP item 4): continuous
+    create/update/delete churn over a CONVERGED ``n_services`` fleet
+    with chaos armed, measuring per-key event->converged latency per
+    traffic class instead of aggregate storm throughput.
+
+    Phases: converge the fleet; settle (fingerprints warm, resync
+    waves answered at enqueue); arm ``chaos_rate`` transient errors on
+    every provider method + the latency sampler; churn one op every
+    ``churn_interval`` (rotating create / annotation-update / delete)
+    for ``churn_seconds`` while resync+sweep background traffic keeps
+    flowing; let the tail drain; read the sampler.
+
+    The SLO the scheduler must deserve: interactive p99 < 2x p50 —
+    interactive work rides its own workqueue tier ahead of the
+    resync/sweep backlog, a parked retry keeps its class, and the
+    coalescer's deadline-aware linger spares urgent singles the
+    batching tax.  The soak's resilience profile carries a deeper
+    in-call retry budget than the burst-chaos suite (max_attempts=6):
+    at a steady 20% transient rate, parks are for real brownouts, not
+    per-call bad luck — exactly how a production profile is tuned.
+
+    ``record=True`` appends to reconcile_history.jsonl tagged
+    ``bench: "mixed-soak"`` (the derived reconcile floor skips tagged
+    entries — ``throughput`` here is churn ops/s, not the create
+    storm's converge rate)."""
+    sys.path.insert(0, "tests")
+    from harness import Cluster, wait_until
+
+    from aws_global_accelerator_controller_tpu import metrics
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintConfig,
+    )
+    from aws_global_accelerator_controller_tpu.resilience import (
+        ResilienceConfig,
+    )
+
+    region = "ap-northeast-1"
+
+    def hostname_of(name):
+        return f"{name}-0123456789abcdef.elb.{region}.amazonaws.com"
+
+    def managed_service(name):
+        return Service(
+            metadata=ObjectMeta(
+                name=name, namespace="default",
+                annotations={
+                    AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                }),
+            spec=ServiceSpec(type="LoadBalancer",
+                             ports=[ServicePort(port=80)]),
+            status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(
+                    hostname=hostname_of(name))])))
+
+    # the soak resilience profile: deep attempt budget, SHORT capped
+    # backoff — at a sustained 20% transient rate the right tuning
+    # retries fast calls quickly (a 50ms decorrelated-jitter cap would
+    # put one unlucky call's sleep straight into p99) and reserves
+    # parks for real brownouts; the breaker needs a wide window so a
+    # steady blip rate under its threshold never trips it
+    soak_resilience = ResilienceConfig(
+        max_attempts=6, base_delay=0.0005, max_delay=0.002, deadline=5.0,
+        breaker_window=2.0, breaker_min_calls=50,
+        breaker_failure_threshold=0.6, breaker_open_seconds=0.3,
+        bucket_capacity=1e6, bucket_refill=1e6, seed=seed)
+    reg = metrics.default_registry
+    cluster = Cluster(workers=workers, queue_qps=10000.0,
+                      queue_burst=10000, resync_period=resync,
+                      resilience=soak_resilience, fault_seed=seed,
+                      fingerprints=FingerprintConfig(
+                          sweep_every=sweep_every)).start()
+    try:
+        for i in range(n_services):
+            name = f"svc{i:04d}"
+            cluster.cloud.elb.register_load_balancer(
+                name, hostname_of(name), region)
+        for i in range(n_services):
+            cluster.kube.services.create(managed_service(f"svc{i:04d}"))
+        wait_until(
+            lambda: len(cluster.cloud.ga.list_accelerators())
+            == n_services,
+            timeout=600.0, interval=0.05,
+            message=f"{n_services} accelerators converged")
+        # settle: fingerprints warm, resync waves answered at enqueue
+        time.sleep(2 * resync)
+
+        sheds_before = reg.counter_value("sheds_total")
+        sweeps_before = reg.counter_value("drift_sweep_verifies_total")
+        skips_before = reg.counter_value("reconcile_fastpath_skips_total")
+        samples = metrics.arm_latency_sampler()
+        cluster.cloud.faults.set_error_rate("*", chaos_rate)
+        try:
+            created: list = []
+            ops = {"create": 0, "update": 0, "delete": 0}
+            i = 0
+            deadline = time.monotonic() + churn_seconds
+            # deletes target the OLDEST churn-created service, and only
+            # once a buffer has built up: deleting a seconds-old service
+            # whose create chain may still be in flight measures a
+            # self-inflicted race, not the scheduler (the stale-view
+            # retry it causes is handled, but it is churn-harness noise)
+            delete_buffer = 30
+            while time.monotonic() < deadline:
+                kind = ("create", "update", "delete")[i % 3]
+                if kind == "delete" and len(created) < delete_buffer:
+                    kind = "update"   # not enough aged churn yet
+                if kind == "create":
+                    name = f"churn{i:05d}"
+                    cluster.cloud.elb.register_load_balancer(
+                        name, hostname_of(name), region)
+                    cluster.kube.services.create(managed_service(name))
+                    created.append(name)
+                elif kind == "update":
+                    name = f"svc{(i // 3) % n_services:04d}"
+                    svc = cluster.kube.services.get("default", name)
+                    svc = svc.deep_copy()
+                    svc.metadata.annotations[
+                        AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION] = \
+                        f"soak-{i}"
+                    cluster.kube.services.update(svc)
+                else:
+                    cluster.kube.services.delete("default",
+                                                 created.pop(0))
+                ops[kind] += 1
+                i += 1
+                time.sleep(churn_interval)
+            churned = sum(ops.values())
+            # drain the tail: chaos stays armed — the tail IS part of
+            # the measured distribution
+            time.sleep(settle_seconds)
+        finally:
+            cluster.cloud.faults.set_error_rate("*", 0.0)
+            metrics.disarm_latency_sampler()
+        sheds = reg.counter_value("sheds_total") - sheds_before
+        sweeps = reg.counter_value("drift_sweep_verifies_total") \
+            - sweeps_before
+        skips = reg.counter_value("reconcile_fastpath_skips_total") \
+            - skips_before
+    finally:
+        cluster.shutdown()
+
+    def pct(xs, p):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, round(p / 100 * (len(xs) - 1)))]
+
+    def klass_stats(klass):
+        lat = [s for _, k, s in samples if k == klass]
+        p50, p99 = pct(lat, 50), pct(lat, 99)
+        return {
+            "samples": len(lat),
+            "p50_ms": round(p50 * 1e3, 2),
+            "p99_ms": round(p99 * 1e3, 2),
+            "p99_over_p50": round(p99 / p50, 2) if p50 else 0.0,
+        }
+
+    interactive = klass_stats("interactive")
+    background = klass_stats("background")
+    out = {
+        "services": n_services,
+        "churn_ops": {**ops, "total": churned},
+        "churn_seconds": churn_seconds,
+        "chaos_rate": chaos_rate,
+        "throughput": round(churned / churn_seconds, 1),
+        "interactive": interactive,
+        "background": background,
+        # the acceptance SLO: interactive tail bounded by the median
+        "slo_ok": (interactive["samples"] > 0
+                   and interactive["p99_ms"]
+                   < 2 * interactive["p50_ms"]),
+        "sheds": round(sheds),
+        "sweep_verifies": round(sweeps),
+        "fastpath_skips": round(skips),
+    }
+    if record:
+        _record_reconcile_history(
+            out, bench="mixed-soak",
+            extra={"chaos_rate": chaos_rate,
+                   "interactive_p50_ms": interactive["p50_ms"],
+                   "interactive_p99_ms": interactive["p99_ms"],
+                   "p99_over_p50": interactive["p99_over_p50"],
+                   "background_p50_ms": background["p50_ms"],
+                   "background_p99_ms": background["p99_ms"],
+                   "slo_ok": out["slo_ok"],
+                   "sheds": out["sheds"]})
+    return out
+
+
 def bench_reconcile_best(reps: int = 3, **kw) -> dict:
     """Best-of-``reps`` reconcile runs.  Convergence time is gated by
     thread scheduling (informer fan-out, queue wakeups), which jitters
@@ -2096,6 +2307,7 @@ _NAMED = {
     "batch-efficiency": lambda: bench_batch_efficiency(record=True),
     "steady-state": lambda: bench_steady_state(record=True),
     "restart-recovery": lambda: bench_restart_recovery(record=True),
+    "mixed-soak": lambda: bench_mixed_soak(record=True),
     "planner": lambda: _json_bench_subprocess(
         "bench_planner", "planner bench", 300.0),
     "flash": bench_flash_subprocess,

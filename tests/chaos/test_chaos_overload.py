@@ -1,0 +1,149 @@
+"""Overload chaos e2e: a parked key's retry vs a 10x create storm.
+
+The anti-starvation contract (ISSUE 7): a key whose sync exhausted its
+in-call retry budget is PARKED with a hint; when the park elapses, its
+retry must land within its backoff bound even while a create storm 10x
+the converged fleet floods the interactive tier — the delay-heap
+promotion enters ahead of strictly-younger backlog
+(kube/workqueue.py), so the wait is bounded by the backoff, not by
+storm depth.  Runs under the runtime race detectors like every e2e.
+"""
+import time
+
+import pytest
+
+from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+from aws_global_accelerator_controller_tpu.resilience import (
+    ResilienceConfig,
+)
+
+from harness import Cluster, wait_until
+
+SEED = 20260804
+REGION = "ap-northeast-1"
+FLEET = 30          # converged baseline fleet
+STORM = 10 * FLEET  # the 10x create storm
+
+# fast in-call budgets so the park happens in milliseconds; the park
+# hint a budget exhaustion carries is on the order of the capped
+# backoff (max_delay), and reconcile jitters it into [1.0, 1.25)
+CHAOS_CONFIG = ResilienceConfig(
+    max_attempts=3, base_delay=0.002, max_delay=0.05, deadline=2.0,
+    breaker_min_calls=10_000,   # the breaker is not this scenario
+    bucket_capacity=1e6, bucket_refill=1e6, seed=SEED)
+
+# generous wall-clock bound for the parked retry: hint (< ~1s with
+# this profile) * 1.25 jitter + queue/aging slack + sync time.  The
+# REAL assertion teeth: the bound must hold WHILE the storm is still
+# converging, which is also asserted.
+PARK_RETRY_BOUND = 3.0
+
+
+def nlb_hostname(name):
+    return f"{name}-0123456789abcdef.elb.{REGION}.amazonaws.com"
+
+
+def managed_service(name):
+    return Service(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            annotations={
+                AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+            }),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=nlb_hostname(name))])),
+    )
+
+
+@pytest.fixture
+def cluster(race_detectors):
+    # ONE worker per queue keeps the 10x storm genuinely in flight for
+    # seconds — the window the parked retry must cut through
+    c = Cluster(workers=1, queue_qps=100000.0, queue_burst=100000,
+                resync_period=5.0, resilience=CHAOS_CONFIG,
+                fault_seed=SEED).start()
+    yield c
+    c.shutdown()
+
+
+def test_parked_key_retry_lands_within_bound_under_10x_storm(cluster):
+    reg = metrics.default_registry
+    faults = cluster.cloud.faults
+    ga = cluster.cloud.ga
+
+    # -- a converged baseline fleet -----------------------------------
+    for i in range(FLEET):
+        name = f"base{i:03d}"
+        cluster.cloud.elb.register_load_balancer(
+            name, nlb_hostname(name), REGION)
+        cluster.kube.services.create(managed_service(name))
+    wait_until(lambda: len(ga.list_accelerators()) == FLEET,
+               timeout=60.0, message="baseline fleet converged")
+    for i in range(STORM):
+        cluster.cloud.elb.register_load_balancer(
+            f"storm{i:04d}", nlb_hostname(f"storm{i:04d}"), REGION)
+
+    # -- park one key: its rename sync exhausts the in-call budget ----
+    parked_before = reg.counter_value(
+        "controller_sync_total",
+        {"queue": "global-accelerator-controller-service",
+         "result": "retry_exhausted"})
+    faults.set_error_rate("update_accelerator", 1.0)
+    svc = cluster.kube.services.get("default", "base000").deep_copy()
+    svc.metadata.annotations[
+        AWS_GLOBAL_ACCELERATOR_NAME_ANNOTATION] = "renamed-by-test"
+    cluster.kube.services.update(svc)
+    wait_until(
+        lambda: reg.counter_value(
+            "controller_sync_total",
+            {"queue": "global-accelerator-controller-service",
+             "result": "retry_exhausted"}) > parked_before,
+        timeout=20.0, message="rename sync parked (budget exhausted)")
+    parked_at = time.monotonic()
+    # heal the fault: the PARK is what should now gate the retry
+    faults.set_error_rate("update_accelerator", 0.0)
+
+    # -- the 10x storm, while the key is parked -----------------------
+    for i in range(STORM):
+        cluster.kube.services.create(managed_service(f"storm{i:04d}"))
+
+    def renamed():
+        for a in ga.list_accelerators():
+            if a.name == "renamed-by-test":
+                return True
+        return False
+
+    wait_until(renamed, timeout=30.0,
+               message="parked key's retry converged the rename")
+    retry_landed = time.monotonic() - parked_at
+    storm_now = len(ga.list_accelerators()) - FLEET
+
+    assert retry_landed <= PARK_RETRY_BOUND, \
+        f"parked retry took {retry_landed:.2f}s " \
+        f"(bound {PARK_RETRY_BOUND}s) — starved by the storm"
+    assert storm_now < STORM, \
+        "storm already fully converged before the retry landed — " \
+        "the scenario never exercised retry-vs-storm contention " \
+        "(shrink workers or grow STORM)"
+
+    # -- and the storm itself still completes (shedding/tiering must
+    # never cost correctness) ----------------------------------------
+    wait_until(lambda: len(ga.list_accelerators()) == FLEET + STORM,
+               timeout=120.0, message="storm fleet converged")

@@ -1,0 +1,23 @@
+"""L109 fixture (clean): class-tagged enqueues, requeues keeping
+their class, and non-queue ``.add`` calls (a set) that must not
+false-positive."""
+
+CLASS_INTERACTIVE = "interactive"
+CLASS_KEEP = "keep"
+
+
+def event_handlers(queue, key):
+    queue.add(key, klass=CLASS_INTERACTIVE)
+    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE)
+
+
+def requeue(service_queue, key, hint):
+    service_queue.add_after(key, hint, klass=CLASS_KEEP)
+    service_queue.add_rate_limited(key, klass=CLASS_KEEP)
+
+
+def bookkeeping(seen, key):
+    seen.add(key)          # a set, not a queue: no finding
+    pending = [key]
+    pending.append(key)    # not an enqueue method at all
+    return pending

@@ -1,0 +1,17 @@
+"""L109 fixture: enqueues that lose the traffic class — a raw
+``queue.add`` / ``add_rate_limited`` / ``add_after`` from
+controller/reconcile code drops the key's tier (kube/workqueue.py);
+the deliberate raw add at the bottom is waived."""
+
+
+def event_handlers(queue, key):
+    queue.add(key)
+    queue.add_rate_limited(key)
+
+
+def parked(service_queue, key, hint):
+    service_queue.add_after(key, hint)
+
+
+def deliberate(queue, key):
+    queue.add(key)  # race: test-only replay helper, tier irrelevant

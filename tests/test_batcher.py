@@ -564,3 +564,147 @@ def test_wrapper_fences_uncoalesced_mutations_but_not_reads():
     # reads are not fenced
     assert [a.accelerator_arn for a in apis.ga.list_accelerators()] \
         == [acc.accelerator_arn]
+
+
+# -- deadline-aware linger (ISSUE 7: the interactive fast flush) ---------
+
+
+def test_interactive_submit_skips_linger_on_cold_group():
+    """A cohort whose only waiter is interactive flushes immediately:
+    an urgent single change (a user-visible spec edit dispatched on
+    the interactive tier) must not pay the 150ms linger tuned for
+    bulk cohorts."""
+    from aws_global_accelerator_controller_tpu.reconcile.traffic import (
+        CLASS_INTERACTIVE,
+        dispatch_class,
+    )
+
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    co = make_coalescer(cloud)
+    t0 = time.monotonic()
+    with dispatch_class(CLASS_INTERACTIVE):
+        co.change_record_sets(zone.id, [("CREATE", txt("a.example.com"))])
+    elapsed = time.monotonic() - t0
+    assert elapsed < LINGER / 2, \
+        f"interactive submit lingered {elapsed:.3f}s (linger {LINGER}s)"
+    assert ("a.example.com.", "TXT") in record_names(cloud, zone.id)
+
+
+def test_interactive_joiner_cuts_a_lingering_bulk_leader_short():
+    """An interactive intent joining a cold group's lingering cohort
+    wakes the leader and the whole cohort flushes at once — the
+    urgent waiter is not held hostage by the bulk deadline, and the
+    earlier bulk waiter rides the same (single) flush."""
+    from aws_global_accelerator_controller_tpu.reconcile.traffic import (
+        CLASS_INTERACTIVE,
+        dispatch_class,
+    )
+
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    co = make_coalescer(cloud)
+    calls_before = cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0)
+    started = threading.Event()
+    done = {}
+
+    def bulk_leader():
+        started.set()
+        t0 = time.monotonic()
+        co.change_record_sets(zone.id, [("CREATE", txt("b.example.com"))])
+        done["bulk_s"] = time.monotonic() - t0
+
+    def interactive_joiner():
+        started.wait()
+        # join mid-linger WITHIN the warm gap (default = linger): the
+        # group reads as a bulk wave, so size-or-deadline stays
+        time.sleep(LINGER / 5)
+        with dispatch_class(CLASS_INTERACTIVE):
+            co.change_record_sets(zone.id,
+                                  [("CREATE", txt("c.example.com"))])
+
+    t = threading.Thread(target=bulk_leader)
+    t2 = threading.Thread(target=interactive_joiner)
+    t.start(); t2.start()
+    t.join(timeout=5); t2.join(timeout=5)
+    assert not t.is_alive() and not t2.is_alive()
+    # default warm_gap == linger, and the joiner arrived within it, so
+    # the group was WARM: size-or-deadline stays in force (the bulk
+    # semantics) — the leader still flushed ONE batch for both
+    assert cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0) == calls_before + 1
+    assert {("b.example.com.", "TXT"), ("c.example.com.", "TXT")} \
+        <= record_names(cloud, zone.id)
+
+
+def test_interactive_joiner_flushes_cold_group_immediately():
+    """With a SMALL warm gap, an interactive intent joining a
+    lingering cohort whose arrivals are NOT back-to-back cuts the
+    linger: both waiters complete well before the bulk deadline."""
+    from aws_global_accelerator_controller_tpu.reconcile.traffic import (
+        CLASS_INTERACTIVE,
+        dispatch_class,
+    )
+
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    co = make_coalescer(cloud, warm_gap=0.005)
+    started = threading.Event()
+    timings = {}
+
+    def bulk_leader():
+        started.set()
+        t0 = time.monotonic()
+        co.change_record_sets(zone.id, [("CREATE", txt("d.example.com"))])
+        timings["bulk_s"] = time.monotonic() - t0
+
+    def interactive_joiner():
+        started.wait()
+        time.sleep(LINGER / 3)   # well past warm_gap: the group is cold
+        t0 = time.monotonic()
+        with dispatch_class(CLASS_INTERACTIVE):
+            co.change_record_sets(zone.id,
+                                  [("CREATE", txt("e.example.com"))])
+        timings["urgent_s"] = time.monotonic() - t0
+
+    t = threading.Thread(target=bulk_leader)
+    t2 = threading.Thread(target=interactive_joiner)
+    t.start(); t2.start()
+    t.join(timeout=5); t2.join(timeout=5)
+    assert not t.is_alive() and not t2.is_alive()
+    assert timings["urgent_s"] < LINGER / 3, \
+        f"urgent joiner waited {timings['urgent_s']:.3f}s"
+    assert timings["bulk_s"] < LINGER, \
+        "the urgent cut must flush the whole cohort, not queue-jump it"
+    assert {("d.example.com.", "TXT"), ("e.example.com.", "TXT")} \
+        <= record_names(cloud, zone.id)
+
+
+def test_background_submit_keeps_bulk_linger_semantics():
+    """A background-class submitter (resync/sweep work, or any bare
+    caller) keeps the size-or-deadline contract: two submits within
+    the linger share ONE batch — the batch-efficiency win is not
+    sacrificed to urgency."""
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    co = make_coalescer(cloud)
+    calls_before = cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0)
+    started = threading.Event()
+
+    def leader():
+        started.set()
+        co.change_record_sets(zone.id, [("CREATE", txt("f.example.com"))])
+
+    def follower():
+        started.wait()
+        time.sleep(LINGER / 4)
+        co.change_record_sets(zone.id, [("CREATE", txt("g.example.com"))])
+
+    t = threading.Thread(target=leader)
+    t2 = threading.Thread(target=follower)
+    t.start(); t2.start()
+    t.join(timeout=5); t2.join(timeout=5)
+    assert cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0) == calls_before + 1

@@ -167,10 +167,50 @@ def test_bench_restart_recovery_smoke(monkeypatch, tmp_path):
     assert "readopt_s" in entries[-1]
 
 
+def test_bench_mixed_soak_smoke(monkeypatch, tmp_path):
+    """Short tier-1 variant of the mixed-load latency soak (ISSUE 7):
+    chaos armed, churn flows, per-class percentiles computed, the
+    tagged history record lands.  Small-N percentile assertions are
+    deliberately loose (the 1000-service leg asserts the p99 < 2x p50
+    SLO); this keeps the soak PATH exercised on every run in <=15s."""
+    path = tmp_path / "hist.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(path))
+    r = bench.bench_mixed_soak(n_services=20, workers=2, resync=0.4,
+                               sweep_every=10, churn_seconds=2.0,
+                               churn_interval=0.02,
+                               settle_seconds=1.5, record=True)
+    assert r["services"] == 20
+    assert r["churn_ops"]["total"] > 0
+    assert r["churn_ops"]["create"] > 0
+    assert r["interactive"]["samples"] > 0, \
+        "no interactive latency samples — the soak measured nothing"
+    assert r["interactive"]["p50_ms"] > 0
+    assert r["interactive"]["p99_ms"] >= r["interactive"]["p50_ms"]
+    assert r["background"]["samples"] >= 0
+    assert r["chaos_rate"] == 0.2
+    entries = [json.loads(line) for line in path.read_text().splitlines()]
+    assert entries[-1]["bench"] == "mixed-soak"
+    assert "interactive_p99_ms" in entries[-1]
+    assert "p99_over_p50" in entries[-1]
+    assert "slo_ok" in entries[-1]
+
+
+@pytest.mark.slow
+def test_bench_mixed_soak_full_slo():
+    """The full soak leg (marked slow; the acceptance gate): 1000
+    converged services, 20% chaos, continuous churn — interactive
+    p99 event->converged < 2x p50."""
+    r = bench.bench_mixed_soak(n_services=1000, churn_seconds=10.0)
+    assert r["interactive"]["samples"] >= 100
+    assert r["slo_ok"], (
+        f"interactive p99 {r['interactive']['p99_ms']}ms >= 2x p50 "
+        f"{r['interactive']['p50_ms']}ms under 20% chaos")
+
+
 def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
-    """batch-efficiency, steady-state and restart-recovery legs
-    measure other workloads, not the floor's pure create storm: their
-    (lower) throughputs must not drag the derived floor down."""
+    """batch-efficiency, steady-state, restart-recovery and mixed-soak
+    legs measure other workloads, not the floor's pure create storm:
+    their (lower) throughputs must not drag the derived floor down."""
     hist = tmp_path / "history.jsonl"
     hist.write_text("".join(
         json.dumps(e) + "\n" for e in (
@@ -179,7 +219,9 @@ def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
             {"throughput": 150.0, "bench": "batch-efficiency"},
             {"throughput": 160.0, "bench": "batch-efficiency"},
             {"throughput": 140.0, "bench": "steady-state"},
-            {"throughput": 45.0, "bench": "restart-recovery"})))
+            {"throughput": 45.0, "bench": "restart-recovery"},
+            {"throughput": 25.0, "bench": "mixed-soak"},
+            {"throughput": 24.0, "bench": "mixed-soak"})))
     monkeypatch.delenv("RECONCILE_FLOOR_SVC_S", raising=False)
     monkeypatch.setattr(bench.os, "getloadavg", lambda: (0.0, 0, 0))
     got = bench.reconcile_floor(history_path=str(hist))

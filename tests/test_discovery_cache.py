@@ -200,3 +200,180 @@ def test_tag_update_visible_immediately_via_writethrough(env):
     accs = provider.list_global_accelerator_by_resource(
         CLUSTER, "service", "other", "name")
     assert [a.accelerator_arn for a in accs] == [arn]
+
+
+# -- churn-proof index maintenance (ISSUE 7: overload resilience) --------
+
+
+def test_own_delete_keeps_fleet_index_serving(env):
+    """Our own committed delete evicts the arn from the fleet index
+    surgically (the prime path's mirror): the index stays COMPLETE and
+    installed, so neither a re-lookup of the deleted key nor a brand
+    new key's ensure pays a fresh O(fleet) rescan.  Previously the
+    stale entry's next verify-failure torched the whole index, and
+    under sustained churn every sibling's ensure degenerated to a
+    full scan serialized behind the singleflight."""
+    factory, provider, ga = env
+    arn, created, _ = _ensure(provider)
+    assert created
+    # install a fresh fleet index via an unrelated miss (full scan)
+    assert provider.list_global_accelerator_by_hostname(
+        "other.elb.amazonaws.com", CLUSTER) == []
+    provider.cleanup_global_accelerator(arn)
+    scans_before = ga.calls.get("list_accelerators", 0)
+    # the deleted key answers definitely-absent from the maintained
+    # index — no rescan, no verify of a dead arn
+    assert provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", "app") == []
+    # and a never-seen key is still an O(1) negative
+    assert provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", "brand-new") == []
+    assert ga.calls.get("list_accelerators", 0) == scans_before, \
+        "a committed own-delete forced an O(fleet) rescan"
+
+
+def test_mid_scan_vanished_arn_skipped_not_fatal(env):
+    """TOCTOU inside the fleet scan: an accelerator the list returned
+    can be deleted (by a concurrent worker) before its per-ARN tag
+    read.  The scan must skip that arn — failing the WHOLE sweep would
+    error every rider's sync with an accelerator they never cared
+    about (under delete churn that poisons a steady stream of
+    unrelated keys)."""
+    from aws_global_accelerator_controller_tpu.errors import AWSAPIError
+
+    factory, provider, ga = env
+    arn, _, _ = _ensure(provider)
+    factory.cloud.elb.register_load_balancer(
+        "otherlb",
+        "otherlb-0123456789abcdef.elb.ap-northeast-1.amazonaws.com",
+        REGION)
+    other = Service(
+        metadata=ObjectMeta(name="other", namespace="default"),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]))
+    arn2, created2, _ = provider.ensure_global_accelerator_for_service(
+        other, LoadBalancerIngress(
+            hostname="otherlb-0123456789abcdef.elb.ap-northeast-1"
+                     ".amazonaws.com"),
+        CLUSTER, "otherlb", REGION)
+    assert created2
+
+    real_ga = ga._inner
+
+    class VanishingTags:
+        def __getattr__(self, name):
+            attr = getattr(real_ga, name)
+            if name != "list_tags_for_resource":
+                return attr
+
+            def tags(a):
+                if a == arn:
+                    raise AWSAPIError(
+                        "AcceleratorNotFoundException",
+                        f"accelerator {a} not found")
+                return attr(a)
+            return tags
+
+    provider.apis.ga = VanishingTags()
+    # force the rescue-scan shape: drop every cache layer, then look
+    # up the OTHER accelerator — the sweep crosses the poisoned arn
+    with provider._s.lock:
+        provider._s.discovery.clear()
+        provider._s.tags.clear()
+        provider._invalidate_fleet_locked()
+    accs = provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", "other")
+    assert [a.accelerator_arn for a in accs] == [arn2], \
+        "the scan must survive a mid-scan-vanished arn and still " \
+        "answer for everyone else"
+
+
+def test_own_delete_mid_scan_rides_mutation_log(env):
+    """A delete landing while a scan is in flight is recorded in the
+    ordered mutation log (after any prime for the same arn, so a
+    create-then-delete replays as deleted) — the scan is NOT fenced
+    out (starving installs under churn) and does NOT re-install the
+    dead arn."""
+    factory, provider, ga = env
+    with provider._s.lock:
+        provider._s.scans_inflight += 1   # a sweep is on the wire
+    try:
+        arn, _, _ = _ensure(provider)    # primes mid-scan
+        provider.cleanup_global_accelerator(arn)
+        with provider._s.lock:
+            log = list(provider._s.prime_log)
+            assert ("death", arn) in log, \
+                "mid-scan delete must be logged for the install replay"
+            primes = [i for i, e in enumerate(log)
+                      if e[0] == "prime" and e[2] == arn]
+            death = log.index(("death", arn))
+            assert all(i < death for i in primes), \
+                "the death must replay AFTER the create's primes"
+            assert not any(
+                arn in arns
+                for arns in provider._s.fleet_index.values()), \
+                "dead arn still indexed"
+    finally:
+        with provider._s.lock:
+            provider._s.scans_inflight -= 1
+            del provider._s.prime_log[:]
+
+
+def test_own_retag_keeps_index_installed(env):
+    """A re-tag re-indexes the arn surgically (old keys evicted, new
+    keys inserted from the merged tag set read back) instead of
+    torching the index — under sustained update churn the torch kept
+    the index permanently uninstallable and every new key's ensure
+    paid a synchronous full rescan."""
+    factory, provider, ga = env
+    arn, _, _ = _ensure(provider)
+    # install a fresh index
+    assert provider.list_global_accelerator_by_hostname(
+        "other.elb.amazonaws.com", CLUSTER) == []
+    with provider._s.lock:
+        installed_at = provider._s.fleet_at
+    assert installed_at is not None
+    provider._update_accelerator(
+        arn, name="renamed", owner="service/other/name",
+        hostname=HOSTNAME, specified_tags={})
+    with provider._s.lock:
+        assert provider._s.fleet_at == installed_at, \
+            "the re-tag invalidated the index instead of re-indexing"
+    scans_before = ga.calls.get("list_accelerators", 0)
+    # new owner key served by the index (verified hit), no rescan
+    accs = provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "other", "name")
+    assert [a.accelerator_arn for a in accs] == [arn]
+    # the OLD owner key answers definitely-absent without a rescan
+    assert provider.list_global_accelerator_by_resource(
+        CLUSTER, "service", "default", "app") == []
+    assert ga.calls.get("list_accelerators", 0) == scans_before
+
+
+def test_aging_index_refreshes_in_background(env):
+    """Stale-while-revalidate: past ~75% of the TTL, a lookup serving
+    from the still-fresh index kicks ONE background rescan so no
+    reconcile worker ever blocks on the O(fleet) sweep at hard
+    expiry (the mixed-soak's original whole-second p99 tail)."""
+    import time
+
+    from harness import wait_until
+
+    factory, provider, ga = env
+    provider.discovery_cache_ttl = 0.4
+    arn, _, _ = _ensure(provider)
+    assert provider.list_global_accelerator_by_hostname(
+        "other.elb.amazonaws.com", CLUSTER) == []
+    with provider._s.lock:
+        first_install = provider._s.fleet_at
+    time.sleep(0.32)   # past the refresh fraction, inside the TTL
+    # a fresh-index lookup triggers the async refresh
+    assert provider.list_global_accelerator_by_hostname(
+        "other.elb.amazonaws.com", CLUSTER) == []
+
+    def rewarmed():
+        with provider._s.lock:
+            return (provider._s.fleet_at is not None
+                    and provider._s.fleet_at > first_install)
+    wait_until(rewarmed, timeout=5.0,
+               message="background refresh re-installed the index")

@@ -412,6 +412,58 @@ def test_l108_fenced_and_apis_routed_writes_clean():
     assert _cfindings("l108_fenced_write.py") == []
 
 
+def test_l109_raw_enqueue_fires_and_waiver_suppresses():
+    """Class-less workqueue enqueues from controller/reconcile-scoped
+    code fire L109; the ``# race:`` waiver suppresses the deliberate
+    raw add at the bottom of the fixture."""
+    got = _cfindings("l109_raw_enqueue.py")
+    assert [(c, l) for c, l in got if c == "L109"] == [
+        ("L109", 8), ("L109", 9), ("L109", 13)]
+
+
+def test_l109_class_tagged_enqueues_clean():
+    """klass= tags, CLASS_KEEP requeues, and non-queue ``.add`` calls
+    (sets, lists) are all clean under L109."""
+    assert _cfindings("l109_clean.py") == []
+
+
+def test_l109_controller_packages_clean_under_own_rule():
+    """The shipped enqueue sites themselves (controller/ + reconcile/)
+    must stay class-tagged under their own rule."""
+    for rel in ("aws_global_accelerator_controller_tpu/controller",
+                "aws_global_accelerator_controller_tpu/reconcile"):
+        pkg = pathlib.Path(ROOT_DIR) / rel
+        files = sorted(pkg.glob("*.py"))
+        assert files, f"{rel} files not found"
+        assert [x for x in concurrency_lint.lint_files(files)
+                if x.code == "L109"] == []
+
+
+def test_l109_seeded_raw_enqueue_in_shipped_controller_caught(tmp_path):
+    """Acceptance probe tied to the shipped code shape: strip the
+    klass= tag from the REAL GA service add-handler's enqueue and the
+    gate must fire."""
+    ga_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/controller/"
+        "globalaccelerator.py")
+    src = ga_py.read_text()
+    needle = ("            self.service_queue.add_rate_limited(\n"
+              "                svc.key(), klass=CLASS_INTERACTIVE)")
+    assert src.count(needle) >= 1, \
+        "GA service enqueue shape changed; update this probe"
+    mutated = src.replace(
+        needle, "            self.service_queue.add_rate_limited("
+                "svc.key())")
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "controller")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "globalaccelerator.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L109"]
+    assert findings, "a class-less shipped enqueue was not caught"
+
+
 def test_l108_seeded_fence_strip_from_wrapper_caught(tmp_path):
     """Acceptance probe tied to the shipped code shape: strip the
     fence consult from the REAL ResilientAPIs.invoke and the gate must

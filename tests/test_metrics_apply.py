@@ -215,6 +215,127 @@ status:
         cluster.shutdown()
 
 
+def test_histogram_observe_and_render():
+    """Registry histograms render the Prometheus shape: cumulative
+    le-buckets, +Inf, _sum and _count — what reconcile_latency_seconds
+    rides (ISSUE 7)."""
+    reg = Registry()
+    reg.observe_histogram("h", {"class": "interactive"}, 0.003,
+                          buckets=(0.005, 0.05, 0.5))
+    reg.observe_histogram("h", {"class": "interactive"}, 0.04,
+                          buckets=(0.005, 0.05, 0.5))
+    reg.observe_histogram("h", {"class": "interactive"}, 9.0,
+                          buckets=(0.005, 0.05, 0.5))
+    assert reg.histogram_count("h", {"class": "interactive"}) == 3
+    text = reg.render()
+    assert 'h_bucket{class="interactive",le="0.005"} 1' in text
+    assert 'h_bucket{class="interactive",le="0.05"} 2' in text
+    assert 'h_bucket{class="interactive",le="0.5"} 2' in text
+    assert 'h_bucket{class="interactive",le="+Inf"} 3' in text
+    assert 'h_count{class="interactive"} 3' in text
+    assert "# TYPE h histogram" in text
+
+
+def test_reconcile_latency_shed_and_tier_series_exposed():
+    """ISSUE 7's overload telemetry: the per-class latency histogram,
+    sheds_total{controller,reason}, and the per-tier queue gauges all
+    register, accumulate and render."""
+    from aws_global_accelerator_controller_tpu.kube.workqueue import (
+        RateLimitingQueue,
+    )
+    from aws_global_accelerator_controller_tpu.metrics import (
+        default_registry,
+        record_reconcile_latency,
+        record_shed,
+        watch_queue_depth,
+    )
+
+    lat_before = default_registry.histogram_count(
+        "reconcile_latency_seconds",
+        {"controller": "m-tier", "class": "interactive"})
+    sheds_before = default_registry.counter_value(
+        "sheds_total", {"controller": "m-tier", "reason": "depth"})
+
+    record_reconcile_latency("m-tier", "interactive", 0.02)
+    record_reconcile_latency("m-tier", "background", 1.7)
+    record_shed("m-tier", "depth")
+
+    assert default_registry.histogram_count(
+        "reconcile_latency_seconds",
+        {"controller": "m-tier", "class": "interactive"}) \
+        == lat_before + 1
+    assert default_registry.counter_value(
+        "sheds_total",
+        {"controller": "m-tier", "reason": "depth"}) == sheds_before + 1
+
+    q = RateLimitingQueue(name="m-tier-q")
+    q.add("default/a", klass="interactive")
+    q.add("default/b", klass="background")
+    watch_queue_depth(q)
+    text = default_registry.render()
+    assert ('reconcile_latency_seconds_bucket{class="interactive",'
+            'controller="m-tier"') in text
+    assert 'sheds_total{controller="m-tier",reason="depth"}' in text
+    assert 'workqueue_depth{queue="m-tier-q",tier="interactive"} 1.0' \
+        in text
+    assert 'workqueue_depth{queue="m-tier-q",tier="background"} 1.0' \
+        in text
+    assert ('workqueue_oldest_age_seconds{queue="m-tier-q",'
+            'tier="interactive"}') in text
+    q.shutdown()
+
+
+def test_tier_depth_and_latency_accumulate_from_running_cluster():
+    """End-to-end: a live cluster registers per-tier depth gauges for
+    every controller queue and, once a create converges, the
+    interactive reconcile_latency_seconds histogram has observations —
+    the series the mixed-soak SLO (and an operator dashboard) reads."""
+    from aws_global_accelerator_controller_tpu import metrics as m
+
+    lat_before = m.default_registry.histogram_count(
+        "reconcile_latency_seconds")
+    cluster = Cluster().start()
+    try:
+        hostname = "mtd-0123456789abcdef.elb.ap-northeast-1.amazonaws.com"
+        cluster.cloud.elb.register_load_balancer("mtd", hostname,
+                                                 "ap-northeast-1")
+        apply_yaml(cluster.api, f"""
+apiVersion: v1
+kind: Service
+metadata:
+  name: mtd
+  namespace: default
+  annotations:
+    {AWS_LOAD_BALANCER_TYPE_ANNOTATION}: external
+    {AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION}: "true"
+spec:
+  type: LoadBalancer
+  ports:
+    - port: 80
+      protocol: TCP
+status:
+  loadBalancer:
+    ingress:
+      - hostname: {hostname}
+""")
+        wait_until(lambda: len(cluster.cloud.ga.list_accelerators()) == 1,
+                   message="accelerator converged")
+        wait_until(
+            lambda: m.default_registry.histogram_count(
+                "reconcile_latency_seconds") > lat_before,
+            message="event->converged latency observed")
+        text = m.default_registry.render()
+        assert ('workqueue_depth{queue="global-accelerator-controller-'
+                'service",tier="interactive"}') in text
+        assert ('workqueue_depth{queue="global-accelerator-controller-'
+                'service",tier="background"}') in text
+        assert ('reconcile_latency_seconds_bucket{class="interactive",'
+                'controller="global-accelerator-controller-service"'
+                in text)
+    finally:
+        cluster.shutdown()
+
+
 def test_race_detector_counters_exposed():
     """The runtime concurrency detectors publish their activity:
     race_lockset_checks counts screened lock acquisitions (batched),

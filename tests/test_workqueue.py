@@ -104,11 +104,33 @@ def test_no_delayed_delivery_after_shutdown(q):
 
 
 def test_rate_limited_requeues_and_forget(q):
+    """One failure charge per scheduled delivery: requeues across
+    dispatch cycles count; forget resets."""
     for _ in range(3):
         q.add_rate_limited("k")
+        item, _ = q.get(timeout=1.0)
+        assert item == "k"
+        q.done("k")
     assert q.num_requeues("k") == 3
     q.forget("k")
     assert q.num_requeues("k") == 0
+
+
+def test_rate_limited_deduped_adds_do_not_charge(q):
+    """Adds that dedup into an existing pending delivery charge NO
+    failure: healthy event traffic landing while a key waits out its
+    backoff (or sits runnable) must not inflate the failure count —
+    previously a busy key's backoff doubled per EVENT, parking its
+    next delivery for minutes with zero real failures."""
+    for _ in range(5):
+        q.add_rate_limited("k")   # first schedules; rest dedup
+    assert q.num_requeues("k") == 1
+    item, _ = q.get(timeout=1.0)
+    assert item == "k"
+    q.done("k")
+    # the deduped adds scheduled exactly one delivery
+    item, shutdown = q.get(timeout=0.1)
+    assert item is None and not shutdown
 
 
 def test_rate_limited_item_delivered_after_backoff(q):
@@ -159,6 +181,241 @@ def test_concurrent_producers_consumers_no_loss_no_dup(q):
     assert len(seen) == n_keys
     # adds may legitimately coalesce, but nothing is lost
     assert all(c >= 1 for c in seen.values())
+
+
+# -- priority tiers (ISSUE 7: overload resilience) --------------------------
+
+
+@pytest.fixture(params=IMPLS)
+def tq(request):
+    """A tiered queue with a short aging horizon so starvation-bound
+    tests run in milliseconds."""
+    if request.param == "native":
+        if not native_available():
+            pytest.skip("native workqueue unavailable (no g++?)")
+        return NativeRateLimitingQueue(name="tiers", base_delay=0.001,
+                                       max_delay=0.05,
+                                       aging_horizon=0.15)
+    return RateLimitingQueue(
+        rate_limiter=ItemExponentialFailureRateLimiter(0.001, 0.05),
+        name="tiers", aging_horizon=0.15)
+
+
+def drain_one(q, timeout=1.0):
+    item, shutdown = q.get(timeout=timeout)
+    assert item is not None and not shutdown
+    meta = q.claimed_meta(item)
+    q.done(item)
+    return item, meta
+
+
+def test_interactive_scheduled_ahead_of_background(tq):
+    """A fresh interactive item beats earlier-enqueued background
+    items (the resync wave must not delay a user-visible change)."""
+    tq.add("ns/bg1", klass="background")
+    tq.add("ns/bg2", klass="background")
+    tq.add("ns/hot", klass="interactive")
+    assert drain_one(tq)[0] == "ns/hot"
+    assert drain_one(tq)[0] == "ns/bg1"
+    assert drain_one(tq)[0] == "ns/bg2"
+
+
+def test_aging_promotes_waiting_background_item(tq):
+    """Aging promotion order: once a background item has waited past
+    the horizon (plus the fresh interactive head's wait), it is served
+    BEFORE further interactive items — the anti-starvation rule."""
+    tq.add("ns/old-bg", klass="background")
+    time.sleep(0.25)   # > aging_horizon
+    tq.add("ns/fresh-i", klass="interactive")
+    assert drain_one(tq)[0] == "ns/old-bg"
+    assert drain_one(tq)[0] == "ns/fresh-i"
+
+
+def test_class_preserved_across_done_and_rate_limited_requeue(tq):
+    """done() -> add_rate_limited (a failed sync's requeue path) keeps
+    the key's class: a background sweep retry stays background, an
+    interactive retry stays interactive (CLASS_KEEP)."""
+    tq.add("ns/bg", klass="background")
+    item, shutdown = tq.get(timeout=1.0)
+    assert item == "ns/bg"
+    assert tq.claimed_meta("ns/bg")[0] == "background"
+    tq.add_rate_limited("ns/bg")   # the reconcile requeue: keep class
+    tq.done("ns/bg")
+    item, _ = tq.get(timeout=1.0)
+    assert item == "ns/bg"
+    assert tq.claimed_meta("ns/bg")[0] == "background"
+    tq.done("ns/bg")
+
+    tq.add("ns/hot", klass="interactive")
+    item, _ = tq.get(timeout=1.0)
+    tq.add_rate_limited("ns/hot", klass="keep")
+    tq.done("ns/hot")
+    item, _ = tq.get(timeout=1.0)
+    assert tq.claimed_meta("ns/hot")[0] == "interactive"
+    tq.done("ns/hot")
+
+
+def test_background_retag_does_not_demote_pending_interactive(tq):
+    """Upgrade-only classing: a resync wave re-tagging a key whose
+    interactive delivery is still pending must not demote it."""
+    tq.add("ns/k", klass="interactive")
+    tq.add("ns/k", klass="background")   # the wave's re-add (deduped)
+    item, _ = tq.get(timeout=1.0)
+    assert tq.claimed_meta("ns/k")[0] == "interactive"
+    tq.done("ns/k")
+
+
+def test_interactive_add_promotes_background_pending(tq):
+    """An event landing on a key already waiting in the background
+    tier promotes it: the user-visible change does not wait out the
+    backlog it was enqueued behind."""
+    tq.add("ns/bg1", klass="background")
+    tq.add("ns/bg2", klass="background")
+    tq.add("ns/bg2", klass="interactive")   # the watch event
+    assert drain_one(tq)[0] == "ns/bg2"
+    assert drain_one(tq)[0] == "ns/bg1"
+
+
+def test_starvation_bound_under_saturating_interactive_storm(tq):
+    """The anti-starvation acceptance bound: under a saturating
+    interactive storm (fresh interactive items always pending), a
+    background item is served within ~the aging horizon of enqueue,
+    never parked indefinitely."""
+    stop = threading.Event()
+    served_bg = threading.Event()
+    bg_enqueued = time.monotonic()
+    tq.add("ns/parked", klass="background")
+
+    def storm():
+        i = 0
+        while not stop.is_set():
+            tq.add(f"ns/storm-{i}", klass="interactive")
+            i += 1
+            time.sleep(0.001)
+
+    def consumer():
+        while not stop.is_set():
+            item, shutdown = tq.get(timeout=0.2)
+            if shutdown or item is None:
+                continue
+            if item == "ns/parked":
+                served_bg.set()
+            tq.done(item)
+
+    threads = [threading.Thread(target=storm),
+               threading.Thread(target=consumer)]
+    for t in threads:
+        t.start()
+    try:
+        assert served_bg.wait(timeout=5.0), \
+            "background item starved by the interactive storm"
+        waited = time.monotonic() - bg_enqueued
+        # horizon 0.15s + generous scheduling slack for loaded CI
+        assert waited <= 1.5, \
+            f"background item waited {waited:.2f}s (aging horizon 0.15s)"
+    finally:
+        stop.set()
+        tq.shutdown()
+        for t in threads:
+            t.join(timeout=5)
+
+
+def test_parked_retry_promotes_ahead_of_storm_backlog(tq):
+    """A parked key's retry (delay-heap promotion) whose request
+    predates the backlog enters at the HEAD of its tier: its wait is
+    bounded by its backoff, not by how deep the storm behind it is."""
+    tq.add_after("ns/parked", 0.05, klass="interactive")
+    time.sleep(0.01)
+    for i in range(50):
+        tq.add(f"ns/storm-{i}", klass="interactive")
+    time.sleep(0.08)   # the park elapses behind the backlog
+    item, _ = tq.get(timeout=1.0)
+    assert item == "ns/parked", \
+        f"parked retry buried behind the storm (got {item})"
+    tq.done(item)
+    # and same-batch ordering stays FIFO for the storm itself
+    item, _ = tq.get(timeout=1.0)
+    assert item == "ns/storm-0"
+    tq.done(item)
+
+
+def test_shutdown_drains_all_tiers_exactly_once(tq):
+    """Items pending in BOTH tiers at shutdown() are each delivered
+    exactly once before get() reports shutdown."""
+    tq.add("ns/i1", klass="interactive")
+    tq.add("ns/b1", klass="background")
+    tq.add("ns/i2", klass="interactive")
+    tq.shutdown()
+    seen = []
+    while True:
+        item, shutdown = tq.get(timeout=1.0)
+        if shutdown:
+            break
+        seen.append(item)
+        tq.done(item)
+    assert sorted(seen) == ["ns/b1", "ns/i1", "ns/i2"]
+
+
+def test_add_after_keeps_earliest_deadline(q):
+    """Regression (ISSUE 7 satellite): two pending parks for one item
+    — a long breaker hint then a shorter retry hint — must wake at the
+    EARLIEST deadline, and the superseded later entry must not
+    re-deliver the item afterwards."""
+    q.add_after("ns/parked", 5.0)    # the breaker's long park
+    q.add_after("ns/parked", 0.03)   # the shorter retry hint
+    t0 = time.monotonic()
+    item, shutdown = q.get(timeout=2.0)
+    elapsed = time.monotonic() - t0
+    assert item == "ns/parked" and not shutdown
+    assert elapsed < 2.0, "item must wake on the earliest deadline"
+    q.done("ns/parked")
+    # the superseded 5s entry is dead: nothing re-delivers
+    item, shutdown = q.get(timeout=0.1)
+    assert item is None and not shutdown
+
+
+def test_add_after_later_deadline_ignored_for_pending_item(q):
+    """The mirror case: a LATER park for an already-pending item must
+    not push the wake time out."""
+    q.add_after("ns/parked", 0.03)
+    q.add_after("ns/parked", 5.0)
+    item, shutdown = q.get(timeout=2.0)
+    assert item == "ns/parked" and not shutdown
+    q.done("ns/parked")
+
+
+def test_overload_signal_depth_and_age(tq):
+    """overloaded() trips on the depth watermark, and on the oldest
+    interactive item's age watermark."""
+    if isinstance(tq, NativeRateLimitingQueue):
+        tq.depth_watermark, tq.age_watermark = 3, 0.1
+    else:
+        tq.depth_watermark, tq.age_watermark = 3, 0.1
+    assert tq.overloaded() is None
+    for i in range(4):
+        tq.add(f"ns/d{i}", klass="background")
+    assert tq.overloaded() == "depth"
+    for _ in range(4):
+        item, _ = tq.get(timeout=1.0)
+        tq.done(item)
+    assert tq.overloaded() is None
+    tq.add("ns/slow", klass="interactive")
+    time.sleep(0.2)
+    assert tq.overloaded() == "age"
+
+
+def test_tier_len_and_oldest_age_observability(tq):
+    """The per-tier depth/age accessors the gauges read."""
+    assert tq.tier_len("interactive") == 0
+    assert tq.tier_oldest_age("background") == 0.0
+    tq.add("ns/a", klass="interactive")
+    tq.add("ns/b", klass="background")
+    tq.add("ns/c", klass="background")
+    assert tq.tier_len("interactive") == 1
+    assert tq.tier_len("background") == 2
+    time.sleep(0.05)
+    assert tq.tier_oldest_age("background") >= 0.04
+    assert len(tq) == 3
 
 
 # -- limiter unit tables (Python objects; native equivalents asserted via
