@@ -1,4 +1,4 @@
-"""AST-based concurrency contract lints (rules L101-L109).
+"""AST-based concurrency contract lints (rules L101-L110).
 
 The static half of the concurrency checker: a whole-program pass over
 the tree that enforces the synchronization contracts PR 1 introduced as
@@ -187,6 +187,27 @@ def _consults_fence(fn: ast.AST) -> bool:
                 and any("fence" in seg for seg in chain[:-1]):
             return True
         if "fence" in chain[-1]:
+            return True
+    return False
+
+
+def _consults_shard(fn: ast.AST) -> bool:
+    """Does this function lexically consult the shard-ownership
+    assertion?  A call whose receiver chain names a ``*shard*``
+    attribute and ends in ``check``/``owns_key``/``guard``
+    (``self._shards.check(key)``, ``shards.owns_key(k)``,
+    ``with self.shards.guard(route):``), or a helper whose own name
+    contains ``shard`` (``check_shard()``)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain is None:
+            continue
+        if chain[-1] in ("check", "owns_key", "guard") \
+                and any("shard" in seg for seg in chain[:-1]):
+            return True
+        if "shard" in chain[-1]:
             return True
     return False
 
@@ -403,6 +424,7 @@ class Engine:
                 self._check_shared_views(info, fn)
         self._check_ordering_graph()
         self._check_wrapper_fence_gate()
+        self._check_sharded_submit_gate()
         suppressed = [f for f in self.findings
                       if not self._finding_waived(f)]
         return suppressed
@@ -482,6 +504,32 @@ class Engine:
                         "tree relies on this gate to reject mutations "
                         "after stop/lease-loss "
                         "(resilience/fence.py)"))
+
+    def _check_sharded_submit_gate(self) -> None:
+        """L110's other half: every coalesced mutation in the tree is
+        shard-gated at runtime by the ShardedCoalescer's routing
+        method carrying ``self._shards.check(container_key)`` — so
+        whenever batcher.py is part of the linted set, that consult
+        must be lexically present on the submit path (the
+        seeded-mutation probe strips it and asserts this fires)."""
+        for info in self.files:
+            if info.path.name != "batcher.py" \
+                    or not _l105_in_scope(info.path):
+                continue
+            submits = [fn for cls, fn in self._functions(info.tree)
+                       if cls == "ShardedCoalescer"
+                       and fn.name in ("_cohort", "change_record_sets",
+                                       "update_endpoints")]
+            if not submits:
+                continue
+            if not any(_consults_shard(fn) for fn in submits):
+                self.findings.append(Finding(
+                    info.path, submits[0].lineno, "L110",
+                    "ShardedCoalescer's submit path no longer asserts "
+                    "shard ownership: every coalesced mutation in the "
+                    "tree relies on this gate to keep one writer per "
+                    "endpoint group / hosted zone "
+                    "(sharding/shardset.py ShardSet.check)"))
 
     def _check_ordering_graph(self) -> None:
         seen: Set[Tuple[str, str]] = set()
@@ -600,6 +648,24 @@ class Engine:
                 f"call '...fence.check(...)' in this function, route "
                 f"the write through 'apis' so ResilientAPIs gates it, "
                 f"or waive with '# race: <reason>')"))
+        # L110a: a BARE AWS write must also assert shard ownership
+        # (sharding/shardset.py ShardSet.check) — through ``apis`` the
+        # routed dispatch's guard + the ShardedCoalescer submit gate
+        # cover it at runtime (verified by _check_sharded_submit_gate
+        # when batcher.py is in the set).
+        if (len(chain) >= 2 and chain[-1] in _AWS_WRITE_METHODS
+                and chain[-2] in _AWS_SERVICES
+                and "apis" not in chain[:-2]
+                and _l105_in_scope(info.path)
+                and not _consults_shard(fn)):
+            self.findings.append(Finding(
+                info.path, line, "L110",
+                f"shard-unchecked mutation '{'.'.join(chain)}()': a "
+                f"bare AWS write must pass through the shard-ownership "
+                f"assertion (sharding/shardset.py — call "
+                f"'...shards.check(container_key)' in this function, "
+                f"route the write through the sharded coalescer, or "
+                f"waive with '# race: <reason>')"))
         # L109: an enqueue that names no traffic class silently
         # defaults the key's tier — the controller/reconcile packages
         # must say whether a key is interactive, background, or a

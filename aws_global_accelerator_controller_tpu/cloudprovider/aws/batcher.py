@@ -72,7 +72,12 @@ from contextlib import nullcontext
 
 from ...analysis import locks
 from ...errors import retry_after_hint
-from ...resilience import ErrorClass, FencedError, classify
+from ...resilience import (
+    ErrorClass,
+    FencedError,
+    classify,
+    push_write_fence,
+)
 from ...metrics import (
     record_flush_bisect,
     record_mutation_enqueued,
@@ -500,11 +505,15 @@ class MutationCoalescer:
             group.flushing = True
         # the flush-pass permit lets this cohort complete through a
         # TRIPPED (draining) fence; a SEALED fence still rejects at
-        # the wrapper and the cohort fails fast with FencedError
+        # the wrapper and the cohort fails fast with FencedError.  The
+        # fence also rides the wrapper's per-attempt write gate for
+        # the flush's duration (push_write_fence), so a per-shard
+        # cohort whose shard lease is lost mid-flush is rejected on
+        # the next attempt, not landed with dead authority.
         fence_pass = (self._fence.flush_pass()
                       if self._fence is not None else nullcontext())
         try:
-            with fence_pass:
+            with fence_pass, push_write_fence(self._fence):
                 self._flush(group, intents)
         except BaseException as e:  # belt: _flush demuxes its own errors
             for it in intents:
@@ -708,3 +717,83 @@ class MutationCoalescer:
             current = self.apis.ga.describe_endpoint_group(arn)
             self.apis.ga.update_endpoint_group(
                 arn, _apply_ops(current.endpoint_descriptions, [op]))
+
+
+class ShardedCoalescer:
+    """Shard-routed front of the write path: one
+    :class:`MutationCoalescer` COHORT per owned shard, every intent
+    routed by the hash of its AWS-side container (the group key — a
+    hosted zone id or endpoint-group ARN; a routed dispatch's shard
+    context wins, sharding/shardset.py ``ShardSet.resolve``), so one
+    container always has exactly one writer fleet-wide: the container
+    maps to one shard, the shard to one replica, the replica to one
+    cohort.  The PR-4 "ONE coalescer per factory" precedent becomes
+    per-factory-PER-SHARD with a shared read plane (the
+    FleetDiscoveryState and singleflight are untouched).
+
+    Each cohort's fence is ``CompositeFence(process fence, shard
+    fence)``: the ordered shutdown stops every cohort, a single shard's
+    lease loss stops exactly that shard's (trip → :meth:`drain_shard`
+    under the handoff deadline → seal → release, the PR-6
+    seal-before-successor ordering now per shard).
+
+    The submit surface carries the shard-ownership assertion
+    (``self._shards.check(container_key)``) — lint rule L110 keeps it
+    here the way L108 keeps the fence consult in the wrapper; the
+    seeded-mutation probe strips it and asserts the rule fires.
+    """
+
+    def __init__(self, shards, make_cohort):
+        self._shards = shards
+        self._make = make_cohort        # make_cohort(shard_id) -> MutationCoalescer
+        self._lock = locks.make_lock("sharded-coalescer")
+        self._cohorts: Dict[int, MutationCoalescer] = {}
+
+    # -- routing --------------------------------------------------------
+
+    def _cohort(self, container_key: str) -> MutationCoalescer:
+        """Assert ownership (L110) and route by the shard the
+        assertion admitted, building the cohort lazily."""
+        sid = self._shards.check(container_key, surface="coalescer")
+        with self._lock:
+            cohort = self._cohorts.get(sid)
+            if cohort is None:
+                cohort = self._cohorts[sid] = self._make(sid)
+            return cohort
+
+    def cohorts(self) -> "Dict[int, MutationCoalescer]":
+        with self._lock:
+            return dict(self._cohorts)
+
+    # -- submit surface (what provider.py calls) ------------------------
+
+    def change_record_sets(self, hosted_zone_id: str, changes) -> None:
+        self._cohort(hosted_zone_id).change_record_sets(
+            hosted_zone_id, changes)
+
+    def update_endpoints(self, endpoint_group_arn: str, ops) -> List:
+        return self._cohort(endpoint_group_arn).update_endpoints(
+            endpoint_group_arn, ops)
+
+    # -- drains ---------------------------------------------------------
+
+    def drain(self, timeout: float) -> bool:
+        """Shutdown phase 2 over every cohort under ONE wall-clock
+        budget (each cohort drains against the same deadline — they
+        flush concurrently with their own leaders, so sequential
+        deadline-splitting would only starve the last)."""
+        deadline = time.monotonic() + timeout
+        ok = True
+        for cohort in self.cohorts().values():
+            ok = cohort.drain(max(0.0, deadline - time.monotonic())) \
+                and ok
+        return ok
+
+    def drain_shard(self, shard_id: int, timeout: float) -> bool:
+        """The graceful-handoff drain: flush (or fail-fast) exactly one
+        shard's pending cohorts — called by the shard-lease manager
+        between tripping and sealing that shard's fence.  A shard
+        whose cohort was never built has nothing to drain."""
+        with self._lock:
+            cohort = self._cohorts.get(shard_id)
+        return cohort.drain(timeout) if cohort is not None else True

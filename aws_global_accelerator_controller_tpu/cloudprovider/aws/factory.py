@@ -13,13 +13,20 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from ...analysis import locks
-from ...resilience import MutationFence, ResilienceConfig, ResilientAPIs
+from ...resilience import (
+    CompositeFence,
+    MutationFence,
+    ResilienceConfig,
+    ResilientAPIs,
+)
 from ...resilience.wrapper import FAKE_CLOUD_CONFIG
+from ...sharding import ShardSet
 from .api import AWSAPIs
 from .batcher import (
     CoalesceConfig,
     FAKE_COALESCE_CONFIG,
     MutationCoalescer,
+    ShardedCoalescer,
 )
 from .fake import FakeAWSCloud
 from .provider import AWSProvider, FleetDiscoveryState
@@ -36,7 +43,8 @@ class CloudFactory:
                  delete_poll_timeout: float = 180.0,
                  accelerator_not_found_retry: float = 60.0,
                  resilience: Optional[ResilienceConfig] = None,
-                 coalesce: Optional[CoalesceConfig] = None):
+                 coalesce: Optional[CoalesceConfig] = None,
+                 num_shards: int = 1):
         self._providers: Dict[str, AWSProvider] = {}
         self._lock = locks.make_lock("cloud-factory")
         self._poll_interval = delete_poll_interval
@@ -61,7 +69,7 @@ class CloudFactory:
         # bundle — its ga/route53 handles reach the same global
         # control plane as every other region's.
         self._coalesce = coalesce or CoalesceConfig()
-        self._coalescer: "MutationCoalescer | None" = None
+        self._coalescer: "ShardedCoalescer | None" = None
         # ONE lifecycle fence for the whole factory (resilience/fence.py)
         # — wired into the coalescer and every region's wrapper as they
         # are built below.  The ordered stop and the elector's
@@ -70,6 +78,21 @@ class CloudFactory:
         # transitions count).  Starts armed at token 0 for
         # non-leader-elect runs.
         self.fence = MutationFence()
+        # the shard partition (sharding/): per-shard fences + the owned
+        # set.  num_shards=1 unmanaged is the degenerate single-shard
+        # deployment — everything owned, behavior identical to the
+        # pre-sharding tree; the shard-lease manager
+        # (leaderelection/shards.py) flips it to managed mode.
+        self.shards = ShardSet(num_shards, process_fence=self.fence)
+        # acquiring a shard COLD-STARTS discovery: until moments ago
+        # the shard's containers were another replica's to create, so
+        # every cached definitely-absent answer may be a lie — the
+        # duplicate-create window (FleetDiscoveryState.cold_start)
+        self.shards.add_listener(self._on_shard_transition)
+
+    def _on_shard_transition(self, event: str, shard_id: int) -> None:
+        if event == "acquired":
+            self._discovery_state.cold_start()
 
     def drain_mutations(self, timeout: float) -> bool:
         """Flush (or, past ``timeout``, fail-fast) every pending
@@ -78,6 +101,15 @@ class CloudFactory:
         with self._lock:
             coalescer = self._coalescer
         return coalescer.drain(timeout) if coalescer is not None else True
+
+    def drain_shard(self, shard_id: int, timeout: float) -> bool:
+        """Flush exactly one shard's pending cohorts — the graceful
+        shard handoff's drain step (leaderelection/shards.py: trip →
+        THIS → seal → release)."""
+        with self._lock:
+            coalescer = self._coalescer
+        return (coalescer.drain_shard(shard_id, timeout)
+                if coalescer is not None else True)
 
     def provider_for(self, region: str) -> AWSProvider:
         with self._lock:
@@ -89,16 +121,25 @@ class CloudFactory:
                                          config=self._resilience)
                     apis.fence = self.fence
                 if self._coalescer is None:
-                    self._coalescer = MutationCoalescer(
-                        apis, config=self._coalesce,
-                        fence=self.fence)
+                    # per-factory-PER-SHARD cohorts behind one shard
+                    # router: each cohort's fence composes the process
+                    # fence (ordered stop) with its shard's (lease
+                    # handoff) — batcher.ShardedCoalescer docstring
+                    first_apis = apis
+                    self._coalescer = ShardedCoalescer(
+                        self.shards,
+                        lambda sid: MutationCoalescer(
+                            first_apis, config=self._coalesce,
+                            fence=CompositeFence(
+                                self.fence, self.shards.fence(sid))))
                 provider = AWSProvider(
                     apis,
                     delete_poll_interval=self._poll_interval,
                     delete_poll_timeout=self._poll_timeout,
                     accelerator_not_found_retry=self._not_found_retry,
                     discovery_state=self._discovery_state,
-                    coalescer=self._coalescer)
+                    coalescer=self._coalescer,
+                    shards=self.shards)
                 self._providers[region] = provider
             return provider
 
@@ -121,7 +162,8 @@ class FakeCloudFactory(CloudFactory):
                  resilience: Optional[ResilienceConfig] = None,
                  fault_seed: Optional[int] = None,
                  coalesce: Optional[CoalesceConfig] = None,
-                 cloud: Optional[AWSAPIs] = None):
+                 cloud: Optional[AWSAPIs] = None,
+                 num_shards: int = 1):
         # fast resilience profile by default: real backoff shapes at
         # 100x speed, breaker thresholds the ordinary one-shot fault
         # tests never trip (chaos tests pass tighter configs); same
@@ -129,7 +171,8 @@ class FakeCloudFactory(CloudFactory):
         super().__init__(delete_poll_interval, delete_poll_timeout,
                          accelerator_not_found_retry,
                          resilience=resilience or FAKE_CLOUD_CONFIG,
-                         coalesce=coalesce or FAKE_COALESCE_CONFIG)
+                         coalesce=coalesce or FAKE_COALESCE_CONFIG,
+                         num_shards=num_shards)
         # ``cloud`` lets a FRESH factory adopt an EXISTING fake cloud —
         # the crash-restart shape: new process state (empty discovery
         # caches, cold fingerprints, new fence) over the same AWS world

@@ -191,6 +191,28 @@ class FleetDiscoveryState:
         self.reads = Singleflight(
             on_coalesce=lambda key: record_coalesced_read(key[0]))
 
+    def cold_start(self) -> None:
+        """Forget every cached discovery answer.  Shard-acquire hook
+        (CloudFactory wires this to the ShardSet's ``acquired``
+        listener): the staleness contract above leans on single-writer
+        — but a shard this replica just ACQUIRED was, until moments
+        ago, another replica's to write, so everything cached here
+        (definitely-absent fleet answers above all) may predate the
+        previous owner's creates.  A warm cache across a handoff is
+        exactly the duplicate-create window the PR-6 crash-restart
+        path never had (a fresh process starts cold); rebalances are
+        rare, so one full re-scan is the right price.  The epoch bump
+        also fences any in-flight scan from installing its
+        pre-acquire snapshot."""
+        with self.lock:
+            self.gen += 1
+            self.fleet_epoch += 1
+            self.fleet_at = None
+            self.discovery.clear()
+            self.tags.clear()
+            self.fleet_index.clear()
+            del self.prime_log[:]
+
 
 class AWSProvider:
     """Per-region provider over the three AWS service APIs."""
@@ -201,7 +223,10 @@ class AWSProvider:
                  accelerator_not_found_retry: float = ACCELERATOR_NOT_FOUND_RETRY,
                  discovery_cache_ttl: float = DISCOVERY_CACHE_TTL,
                  discovery_state: "FleetDiscoveryState | None" = None,
-                 coalescer: "MutationCoalescer | None" = None):
+                 coalescer: "MutationCoalescer | None" = None,
+                 shards=None):
+        from ...sharding import ShardSet
+
         self.apis = apis
         self.delete_poll_interval = delete_poll_interval
         self.delete_poll_timeout = delete_poll_timeout
@@ -212,11 +237,16 @@ class AWSProvider:
         self._s = discovery_state or FleetDiscoveryState()
         # write-path coalescing (batcher.py): record-set and
         # endpoint-group mutations are submitted as intents and flushed
-        # in batches.  The factory shares ONE coalescer across its
-        # regional providers (GA/Route53 are global services — two
+        # in batches.  The factory shares ONE coalescer ROUTER across
+        # its regional providers (GA/Route53 are global services — two
         # coalescers read-modify-writing one endpoint group would lose
-        # updates); a bare provider gets a private one
+        # updates), with one cohort per owned shard; a bare provider
+        # gets a private single cohort
         self.coalescer = coalescer or MutationCoalescer(apis)
+        # shard ownership (sharding/): bare AWS writes assert the
+        # container's shard is owned here (lint rule L110); a bare
+        # provider gets the degenerate single-shard set (owns all)
+        self.shards = shards or ShardSet(1)
 
     # A/B + escape hatch: class-level so a deployment (or the perf
     # harness) can disable the O(1)-negative path and fall back to

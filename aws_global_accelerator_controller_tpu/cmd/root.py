@@ -71,6 +71,26 @@ def build_parser() -> argparse.ArgumentParser:
     controller.add_argument("--leader-elect", action="store_true",
                             default=True,
                             help="Run under Lease-based leader election.")
+    controller.add_argument("--shards", type=int, default=1,
+                            metavar="S",
+                            help="Partition the reconcile key space "
+                                 "into S shards (consistent hash of "
+                                 "each resource's AWS-side container; "
+                                 "sharding/).  1 (default) is the "
+                                 "classic single-writer deployment; "
+                                 "S>1 lets N replicas split the fleet "
+                                 "under per-shard leases "
+                                 "(leaderelection/shards.py).")
+    controller.add_argument("--shard-id", default="auto",
+                            metavar="K|auto",
+                            help="With --shards S: 'auto' (default) "
+                                 "runs the shard-lease manager — this "
+                                 "replica acquires whatever shards "
+                                 "the rendezvous map assigns it and "
+                                 "rebalances on membership change; an "
+                                 "integer K statically owns exactly "
+                                 "shard K with no leases (bench "
+                                 "workers, operator pinning).")
     controller.add_argument("--health-port", type=int, default=8081,
                             help="Port for /healthz, /readyz and /metrics "
                                  "(0 disables; the reference controller "
@@ -218,6 +238,19 @@ def run_controller(args) -> int:
                     args.policy_checkpoint)
         except (OSError, ValueError) as e:
             raise SystemExit(f"--policy-checkpoint: {e}")
+    num_shards = getattr(args, "shards", 1)
+    if num_shards < 1:
+        raise SystemExit("--shards must be >= 1")
+    shard_id = str(getattr(args, "shard_id", "auto"))
+    if shard_id != "auto":
+        try:
+            static_shard = int(shard_id)
+        except ValueError:
+            raise SystemExit("--shard-id must be an integer or 'auto'")
+        if not 0 <= static_shard < num_shards:
+            raise SystemExit(
+                f"--shard-id {static_shard} out of range "
+                f"[0, {num_shards})")
     stop = setup_signal_handler()
 
     if args.fake:
@@ -225,7 +258,7 @@ def run_controller(args) -> int:
         api = FakeAPIServer()
         kube = KubeClient(api)
         operator = OperatorClient(api)
-        cloud_factory = FakeCloudFactory()
+        cloud_factory = FakeCloudFactory(num_shards=num_shards)
     else:
         from ..kube.http_store import HTTPAPIServer
         from ..kube.kubeconfig import KubeConfigError, build_config
@@ -241,8 +274,9 @@ def run_controller(args) -> int:
         api = HTTPAPIServer(rest_config)
         kube = KubeClient(api)
         operator = OperatorClient(api)
-        cloud_factory = (FakeCloudFactory() if args.fake_cloud
-                         else BotoCloudFactory())
+        cloud_factory = (FakeCloudFactory(num_shards=num_shards)
+                         if args.fake_cloud
+                         else BotoCloudFactory(num_shards=num_shards))
 
     from ..reconcile.fingerprint import FingerprintConfig
     fingerprints = FingerprintConfig(
@@ -309,7 +343,40 @@ def run_controller(args) -> int:
         handle.stop(deadline=10.0)
 
     try:
-        if args.leader_elect:
+        if shard_id != "auto":
+            # statically pinned: own exactly shard K, no leases — the
+            # bench-worker / operator-pinned replica shape
+            cloud_factory.shards.set_static_owner(static_shard)
+            logger.info("statically owning shard %d of %d",
+                        static_shard, num_shards)
+            run_manager(stop)
+        elif num_shards > 1 and args.leader_elect:
+            # sharded fleet: every replica runs its manager (the read
+            # plane is shared); WRITE authority is per shard, governed
+            # by the shard-lease manager's rendezvous rebalance —
+            # there is no process-wide leader to elect
+            from ..leaderelection.shards import ShardLeaseManager
+
+            import uuid as uuid_mod
+            # flip to managed mode SYNCHRONOUSLY, before any informer
+            # or worker starts: the ShardSet is born standalone
+            # (owning every shard), and leaving the flip to the lease
+            # loop's thread would give this replica a window where it
+            # writes every key with no lease held — on N replicas at
+            # once, the exact split-brain the leases forbid
+            cloud_factory.shards.set_managed()
+            slm = ShardLeaseManager(
+                "aws-global-accelerator-controller", namespace, kube,
+                cloud_factory.shards,
+                identity=os.environ.get("POD_NAME",
+                                        str(uuid_mod.uuid4())),
+                drain=cloud_factory.drain_shard)
+            slm_thread = slm.start_background(stop)
+            run_manager(stop)
+            # let the lease loop finish its graceful handoffs (seal
+            # before release, per shard) before the process exits
+            slm_thread.join(timeout=10.0)
+        elif args.leader_elect:
             # the elector arms the factory's mutation fence per
             # leadership term (token = lease_transitions) and seals it
             # on loss BEFORE the callback below exits the process — a

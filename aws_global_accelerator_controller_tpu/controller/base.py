@@ -11,6 +11,7 @@ import logging
 import threading
 from typing import Callable, List
 
+from ..analysis import locks
 from ..apis import (
     AWS_LOAD_BALANCER_TYPE_ANNOTATION,
     INGRESS_CLASS_ANNOTATION,
@@ -19,6 +20,7 @@ from ..apis import (
 from ..kube.objects import Ingress, KubeObject, Service
 from ..kube.workqueue import (
     CLASS_BACKGROUND,
+    CLASS_INTERACTIVE,
     RateLimitingQueue,
 )
 from ..reconcile import process_next_work_item
@@ -118,22 +120,156 @@ def resync_enqueue(fingerprints, queue, obj, wave: int) -> None:
     queue.add_rate_limited(key, klass=CLASS_BACKGROUND)
 
 
+class ShardGate:
+    """One queue's shard-ownership event gate WITH deferred replay.
+
+    Gating an informer EVENT on ownership has a hole the cache-scan
+    re-delivery cannot close: deletes and demotions (the managed /
+    hostname annotation removed) are exactly the events the informer
+    cache cannot reconstruct at acquire time — the object is gone
+    from the cache, or no longer matches the controller's predicate,
+    yet its AWS-side teardown has not run.  Dropping such an event
+    while the shard is unowned (a crash gap, a handoff window) would
+    orphan the accelerator chain / records forever.
+
+    So a gated event is never dropped: :meth:`admit` records the key
+    under the route's shard, and when THIS replica later acquires
+    that shard the listener replays every deferred key as an
+    interactive event — the dispatch already handles not-found as
+    delete and no-longer-managed as cleanup.  Every live replica
+    defers independently, so whichever of them wins the shard replays
+    what it saw; the residual hole (no replica alive to observe the
+    event) is the pre-existing full-restart gap, unchanged by
+    sharding.  Memory is bounded by distinct gated keys per shard
+    (cleared on replay), the informer cache's own magnitude."""
+
+    def __init__(self, shards, queue, fingerprints, route_key):
+        self.shards = shards
+        self.queue = queue
+        self.fingerprints = fingerprints
+        self.route_key = route_key
+        self._lock = locks.make_lock("shard-gate")
+        self._deferred: dict = {}       # shard id -> set of object keys
+
+    def admit(self, obj) -> bool:
+        """True when this replica owns the object's route; otherwise
+        the key is deferred for replay-on-acquire and the handler must
+        return without enqueueing."""
+        try:
+            rkey = self.route_key(obj)
+        except Exception:
+            rkey = obj.key()
+        sid = self.shards.shard_of(rkey)
+        if self.shards.owns(sid):
+            return True
+        with self._lock:
+            self._deferred.setdefault(sid, set()).add(obj.key())
+        return False
+
+    def replay(self, sid: int, skip=()) -> int:
+        """Re-deliver the events deferred for ``sid`` (the acquire
+        listener calls this alongside its cache scan), interactive
+        class — these are real user-visible changes the gap
+        swallowed.  ``skip`` is the set of keys the cache scan is
+        already re-delivering (live, predicate-passing objects): only
+        the events the cache CANNOT reconstruct — deletes (object
+        gone) and demotions (predicate now false) — replay here, so a
+        rebalance after days of churn does not flood the interactive
+        tier with already-converged keys."""
+        with self._lock:
+            keys = self._deferred.pop(sid, set())
+        replayed = 0
+        for key in keys:
+            if key in skip:
+                continue
+            if self.fingerprints is not None:
+                self.fingerprints.note_event(key)
+            self.queue.add_rate_limited(key, klass=CLASS_INTERACTIVE)
+            replayed += 1
+        return replayed
+
+
+def wire_shard_listener(shards, informer, queue, fingerprints,
+                        route_key, predicate, gate=None) -> None:
+    """Register one (informer, queue) pair's shard ownership hooks
+    (sharding/shardset.py ``ShardSet.add_listener``):
+
+    - **acquired**: re-deliver the shard's keys as BACKGROUND work —
+      the successor's re-adoption.  Fingerprints for these keys are
+      cold (never recorded here, or dropped on a previous loss), so
+      each rides a full provider-verifying sync exactly like the PR-6
+      restart-recovery path: reads + fingerprint rebuild, zero
+      mutations against a converged world.
+    - **lost**: drop the shard's fingerprint records (the next owner's
+      writes make them unprovable — FingerprintCache.invalidate_shard)
+      and purge its pending backlog from the queue (the syncs would
+      all be dropped by the dispatch's ownership check anyway; purging
+      saves the churn).
+
+    ``route_key(obj)`` is the controller's routing-key extractor (the
+    AWS-side container: an EndpointGroupBinding's ARN; the owning
+    object key where the container is created 1:1 by the object);
+    ``predicate(obj)`` is the controller's watch filter.  Standalone
+    (unmanaged) shard sets never fire listeners, so the single-process
+    deployment pays nothing."""
+
+    def on_change(event: str, sid: int) -> None:
+        keys = []
+        for obj in informer.cache_list():
+            try:
+                rkey = route_key(obj)
+            except Exception:
+                rkey = obj.key()
+            if shards.shard_of(rkey) == sid:
+                keys.append((obj.key(), obj))
+        if event == "acquired":
+            scanned = set()
+            for key, obj in keys:
+                if predicate(obj):
+                    scanned.add(key)
+                    queue.add_rate_limited(key, klass=CLASS_BACKGROUND)
+            if gate is not None:
+                # replay the events the cache scan above cannot
+                # reconstruct — deletes and demotions the ownership
+                # gap swallowed (ShardGate docstring)
+                gate.replay(sid, skip=scanned)
+            return
+        # lost: this replica's records for the shard prove nothing
+        # once a successor writes — and its backlog is dead weight
+        if fingerprints is not None:
+            # route-mapped keys exactly; records whose object already
+            # left the informer cache fall back to the key hash
+            # (over-invalidation is always safe — one extra full sync)
+            lost = {key for key, _ in keys}
+            fingerprints.invalidate_shard(
+                sid, lambda key: sid if key in lost
+                else shards.shard_of(key))
+        remove = getattr(queue, "remove", None)
+        if remove is not None:
+            for key, _ in keys:
+                remove(key)
+
+    shards.add_listener(on_change)
+
+
 def spawn_workers(name: str, count: int, stop: threading.Event,
                   queue: RateLimitingQueue, key_to_obj, process_delete,
                   process_create_or_update,
-                  fingerprints=None) -> List[threading.Thread]:
+                  fingerprints=None, shards=None) -> List[threading.Thread]:
     """Start ``count`` reconcile worker threads over one queue
     (the wait.Until(runWorker, 1s) analogue,
     reference globalaccelerator/controller.go:208-213).
     ``fingerprints`` (reconcile/fingerprint.py FingerprintCache) arms
-    the steady-state fast path for this queue's dispatch."""
+    the steady-state fast path for this queue's dispatch; ``shards``
+    (sharding/) arms shard-routed dispatch — unowned keys drop, owned
+    syncs run under their shard's route guard."""
 
     def loop():
         while not stop.is_set():
             if not process_next_work_item(
                     queue, key_to_obj, process_delete,
                     process_create_or_update, get_timeout=WORKER_POLL,
-                    fingerprints=fingerprints):
+                    fingerprints=fingerprints, shards=shards):
                 return
 
     threads = []

@@ -31,6 +31,7 @@ from ..errors import (
     ConflictError,
     ERR_ENDPOINT_GROUP_NOT_FOUND,
     NotFoundError,
+    is_no_retry,
 )
 from ..kube.client import KubeClient, OperatorClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
@@ -51,7 +52,12 @@ from ..reconcile.fingerprint import (
     FingerprintConfig,
     in_sweep,
 )
-from .base import WORKER_POLL, resync_enqueue
+from .base import (
+    WORKER_POLL,
+    ShardGate,
+    resync_enqueue,
+    wire_shard_listener,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -171,9 +177,31 @@ class EndpointGroupBindingController:
             add=self._notify_referent(BINDING_INGRESS_REF_INDEX),
             update=self._notify_referent_update(BINDING_INGRESS_REF_INDEX))
 
+        # shard ownership (sharding/): a binding's container is the
+        # endpoint group its SPEC names — routing by the ARN hash puts
+        # every binding sharing one group on the same shard, so the
+        # group's read-modify-write weight sync has exactly one writer
+        # fleet-wide (the ISSUE 8 container-hash contract)
+        self.shards = cloud_factory.shards
+        self.gate = ShardGate(self.shards, self.queue,
+                              self.fingerprints, self._route)
+        wire_shard_listener(
+            self.shards, self.binding_informer, self.queue,
+            self.fingerprints, self._route, lambda o: True,
+            gate=self.gate)
+
     # -- event handlers (controller.go:85-98) ---------------------------
 
+    @staticmethod
+    def _route(obj) -> str:
+        """The binding's routing key: the AWS-side container (its
+        endpoint-group ARN), falling back to the object key for a
+        binding whose spec names none yet."""
+        return obj.spec.endpoint_group_arn or obj.key()
+
     def _enqueue(self, obj) -> None:
+        if not self.gate.admit(obj):
+            return
         self.fingerprints.note_event(obj.key())
         self.queue.add_rate_limited(obj.key(), klass=CLASS_INTERACTIVE)
 
@@ -192,6 +220,8 @@ class EndpointGroupBindingController:
         enqueue time and only changed/failing/sweep-due keys reach
         the queue (base.resync_enqueue), the sweep wave deep-verifying
         against the live endpoint group."""
+        if not self.shards.owns_key(self._route(obj)):
+            return
         resync_enqueue(self.fingerprints, self.queue, obj, wave)
 
     def _binding_fingerprint(self, obj) -> tuple:
@@ -236,6 +266,8 @@ class EndpointGroupBindingController:
     def _notify_referent(self, index: str):
         def handler(obj) -> None:
             for binding in self.binding_informer.by_index(index, obj.key()):
+                if not self.gate.admit(binding):
+                    continue
                 self.fingerprints.note_event(binding.key())
                 self.queue.add_rate_limited(binding.key(),
                                             klass=CLASS_INTERACTIVE)
@@ -297,13 +329,22 @@ class EndpointGroupBindingController:
             result = "success"
             try:
                 self._sync_handler(key)
-            except Exception:
-                result = "error"
+            except Exception as e:
                 # a failed sync's recorded fingerprint no longer
                 # proves a converged state
                 self.fingerprints.invalidate(key)
-                logger.exception("error syncing %r", key)
-                self.queue.add_rate_limited(key, klass=CLASS_KEEP)
+                if is_no_retry(e):
+                    # parity with reconcile._reconcile_handler: a
+                    # NoRetryError (a fenced sync, a shard rebalanced
+                    # away mid-dispatch) DROPS — requeueing would just
+                    # re-reject while the successor converges the key
+                    result = "no_retry_error"
+                    self.fingerprints.clear_pending(key)
+                    logger.error("error syncing %r: %s", key, e)
+                else:
+                    result = "error"
+                    logger.exception("error syncing %r", key)
+                    self.queue.add_rate_limited(key, klass=CLASS_KEEP)
             finally:
                 self.queue.done(key)
                 metrics.record_sync(self.queue.name, result,
@@ -334,6 +375,14 @@ class EndpointGroupBindingController:
             self.queue.forget(key)
             return
 
+        route = self._route(binding)
+        if not self.shards.owns_key(route):
+            # rebalanced away between enqueue and this dispatch: the
+            # owning replica converges the binding
+            self.fingerprints.clear_pending(key)
+            self.queue.forget(key)
+            return
+
         # steady-state fast path: a resync-originated key whose
         # binding (and referent hostnames) still match the recorded
         # fingerprint needs no provider verification (L107: no apis.*
@@ -352,10 +401,12 @@ class EndpointGroupBindingController:
             # no-change short-circuit, so out-of-band endpoint-group
             # drift is re-read and repaired on this tier — and any
             # mutation submitted is honestly a drift repair
-            with self.fingerprints.sweep_verify(), dispatch_class(klass):
+            with self.shards.guard(route), \
+                    self.fingerprints.sweep_verify(), \
+                    dispatch_class(klass):
                 res = self.reconcile(binding.deep_copy())
         else:
-            with dispatch_class(klass):
+            with self.shards.guard(route), dispatch_class(klass):
                 res = self.reconcile(binding.deep_copy())
         if res.requeue_after > 0:
             self.queue.forget(key)

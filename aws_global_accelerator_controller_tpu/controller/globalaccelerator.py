@@ -53,6 +53,7 @@ from ..reconcile import Result
 from ..reconcile.fingerprint import FingerprintCache, FingerprintConfig
 from .base import (
     LB_DNS_INDEX,
+    ShardGate,
     annotation_presence_changed,
     index_by_lb_dns,
     resync_enqueue,
@@ -60,6 +61,7 @@ from .base import (
     spawn_workers,
     was_alb_ingress,
     was_load_balancer_service,
+    wire_shard_listener,
 )
 
 logger = logging.getLogger(__name__)
@@ -169,10 +171,40 @@ class GlobalAcceleratorController:
             delete=self._delete_ingress, resync=self._resync_ingress)
         self.ingress_informer.add_index(LB_DNS_INDEX, index_by_lb_dns)
 
+        # shard ownership (sharding/): this controller's containers
+        # (the accelerator chain) are created 1:1 by the watched
+        # object, so the routing key is the object key — the
+        # pre-creation fallback kept for the container's life.
+        # Unmanaged (single-process) shard sets own everything and the
+        # gates below are no-ops.
+        self.shards = cloud_factory.shards
+        # event gates with deferred replay: deletes/demotions gated
+        # off during an ownership gap are re-delivered on acquire —
+        # the informer cache cannot reconstruct them (base.ShardGate)
+        self.service_gate = ShardGate(
+            self.shards, self.service_queue, self.service_fingerprints,
+            lambda o: o.key())
+        self.ingress_gate = ShardGate(
+            self.shards, self.ingress_queue, self.ingress_fingerprints,
+            lambda o: o.key())
+        wire_shard_listener(
+            self.shards, self.service_informer, self.service_queue,
+            self.service_fingerprints, lambda o: o.key(),
+            lambda o: (was_load_balancer_service(o)
+                       and self._has_managed(o)),
+            gate=self.service_gate)
+        wire_shard_listener(
+            self.shards, self.ingress_informer, self.ingress_queue,
+            self.ingress_fingerprints, lambda o: o.key(),
+            lambda o: was_alb_ingress(o) and self._has_managed(o),
+            gate=self.ingress_gate)
+
     # -- event handlers (controller.go:96-193) -------------------------
 
     def _add_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc) and self._has_managed(svc):
+            if not self.service_gate.admit(svc):
+                return
             self.service_fingerprints.note_event(svc.key())
             self.service_queue.add_rate_limited(
                 svc.key(), klass=CLASS_INTERACTIVE)
@@ -183,12 +215,16 @@ class GlobalAcceleratorController:
         if was_load_balancer_service(new):
             if self._has_managed(new) or annotation_presence_changed(
                     old, new, AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION):
+                if not self.service_gate.admit(new):
+                    return
                 self.service_fingerprints.note_event(new.key())
                 self.service_queue.add_rate_limited(
                     new.key(), klass=CLASS_INTERACTIVE)
 
     def _delete_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc):
+            if not self.service_gate.admit(svc):
+                return
             self.service_fingerprints.note_event(svc.key())
             self.service_queue.add_rate_limited(
                 svc.key(), klass=CLASS_INTERACTIVE)
@@ -201,11 +237,15 @@ class GlobalAcceleratorController:
         changed/failing/sweep-due keys ride the rate-limited path
         (base.resync_enqueue)."""
         if was_load_balancer_service(svc) and self._has_managed(svc):
+            if not self.shards.owns_key(svc.key()):
+                return
             resync_enqueue(self.service_fingerprints,
                            self.service_queue, svc, wave)
 
     def _add_ingress(self, ingress: Ingress) -> None:
         if was_alb_ingress(ingress) and self._has_managed(ingress):
+            if not self.ingress_gate.admit(ingress):
+                return
             self.ingress_fingerprints.note_event(ingress.key())
             self.ingress_queue.add_rate_limited(
                 ingress.key(), klass=CLASS_INTERACTIVE)
@@ -216,18 +256,24 @@ class GlobalAcceleratorController:
         if was_alb_ingress(new):
             if self._has_managed(new) or annotation_presence_changed(
                     old, new, AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION):
+                if not self.ingress_gate.admit(new):
+                    return
                 self.ingress_fingerprints.note_event(new.key())
                 self.ingress_queue.add_rate_limited(
                     new.key(), klass=CLASS_INTERACTIVE)
 
     def _delete_ingress(self, ingress: Ingress) -> None:
         # reference enqueues ingress deletes unconditionally (controller.go:185)
+        if not self.ingress_gate.admit(ingress):
+            return
         self.ingress_fingerprints.note_event(ingress.key())
         self.ingress_queue.add_rate_limited(
             ingress.key(), klass=CLASS_INTERACTIVE)
 
     def _resync_ingress(self, ingress: Ingress, wave: int) -> None:
         if was_alb_ingress(ingress) and self._has_managed(ingress):
+            if not self.shards.owns_key(ingress.key()):
+                return
             resync_enqueue(self.ingress_fingerprints,
                            self.ingress_queue, ingress, wave)
 
@@ -254,13 +300,15 @@ class GlobalAcceleratorController:
                         stop, self.service_queue, self._key_to_service,
                         self.process_service_delete,
                         self.process_service_create_or_update,
-                        fingerprints=self.service_fingerprints)
+                        fingerprints=self.service_fingerprints,
+                        shards=self.shards)
                     + spawn_workers(
                         f"{CONTROLLER_AGENT_NAME}-ingress", self.workers,
                         stop, self.ingress_queue, self._key_to_ingress,
                         self.process_ingress_delete,
                         self.process_ingress_create_or_update,
-                        fingerprints=self.ingress_fingerprints))
+                        fingerprints=self.ingress_fingerprints,
+                        shards=self.shards))
 
         run_controller(CONTROLLER_AGENT_NAME, stop,
                        [self.service_queue, self.ingress_queue], workers)

@@ -38,7 +38,9 @@ from .base import (
     ROUTE53_HOSTNAME_INDEX,
     annotation_presence_changed,
     index_by_route53_hostname,
+    ShardGate,
     resync_enqueue,
+    wire_shard_listener,
     run_controller,
     spawn_workers,
     was_load_balancer_service,
@@ -133,6 +135,30 @@ class Route53Controller:
         self.ingress_informer.add_index(ROUTE53_HOSTNAME_INDEX,
                                         index_by_route53_hostname)
 
+        # shard ownership (sharding/): records are 1:1 with (object,
+        # hostname), so the routing key is the object key — all of one
+        # object's record intents ride its shard's coalescer cohort
+        self.shards = cloud_factory.shards
+        # event gates with deferred replay (base.ShardGate): a
+        # hostname-annotation removal or delete swallowed by an
+        # ownership gap is replayed on acquire
+        self.service_gate = ShardGate(
+            self.shards, self.service_queue, self.service_fingerprints,
+            lambda o: o.key())
+        self.ingress_gate = ShardGate(
+            self.shards, self.ingress_queue, self.ingress_fingerprints,
+            lambda o: o.key())
+        wire_shard_listener(
+            self.shards, self.service_informer, self.service_queue,
+            self.service_fingerprints, lambda o: o.key(),
+            lambda o: (was_load_balancer_service(o)
+                       and self._has_hostname(o)),
+            gate=self.service_gate)
+        wire_shard_listener(
+            self.shards, self.ingress_informer, self.ingress_queue,
+            self.ingress_fingerprints, lambda o: o.key(),
+            self._has_hostname, gate=self.ingress_gate)
+
     # -- event handlers (route53/controller.go:90-172) ------------------
 
     @staticmethod
@@ -141,6 +167,8 @@ class Route53Controller:
 
     def _add_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc) and self._has_hostname(svc):
+            if not self.service_gate.admit(svc):
+                return
             self.service_fingerprints.note_event(svc.key())
             self.service_queue.add_rate_limited(
                 svc.key(), klass=CLASS_INTERACTIVE)
@@ -151,12 +179,16 @@ class Route53Controller:
         if was_load_balancer_service(new):
             if self._has_hostname(new) or annotation_presence_changed(
                     old, new, ROUTE53_HOSTNAME_ANNOTATION):
+                if not self.service_gate.admit(new):
+                    return
                 self.service_fingerprints.note_event(new.key())
                 self.service_queue.add_rate_limited(
                     new.key(), klass=CLASS_INTERACTIVE)
 
     def _delete_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc):
+            if not self.service_gate.admit(svc):
+                return
             self.service_fingerprints.note_event(svc.key())
             self.service_queue.add_rate_limited(
                 svc.key(), klass=CLASS_INTERACTIVE)
@@ -165,6 +197,8 @@ class Route53Controller:
         """Tagged resync backstop for annotated Services — gated at
         enqueue time (base.resync_enqueue)."""
         if was_load_balancer_service(svc) and self._has_hostname(svc):
+            if not self.shards.owns_key(svc.key()):
+                return
             resync_enqueue(self.service_fingerprints,
                            self.service_queue, svc, wave)
 
@@ -172,6 +206,8 @@ class Route53Controller:
         # the route53 controller watches ALL ingresses with the annotation
         # (route53/controller.go:133-137; no ALB filter on add)
         if self._has_hostname(ingress):
+            if not self.ingress_gate.admit(ingress):
+                return
             self.ingress_fingerprints.note_event(ingress.key())
             self.ingress_queue.add_rate_limited(
                 ingress.key(), klass=CLASS_INTERACTIVE)
@@ -181,17 +217,23 @@ class Route53Controller:
             return
         if self._has_hostname(new) or annotation_presence_changed(
                 old, new, ROUTE53_HOSTNAME_ANNOTATION):
+            if not self.ingress_gate.admit(new):
+                return
             self.ingress_fingerprints.note_event(new.key())
             self.ingress_queue.add_rate_limited(
                 new.key(), klass=CLASS_INTERACTIVE)
 
     def _delete_ingress(self, ingress: Ingress) -> None:
+        if not self.ingress_gate.admit(ingress):
+            return
         self.ingress_fingerprints.note_event(ingress.key())
         self.ingress_queue.add_rate_limited(
             ingress.key(), klass=CLASS_INTERACTIVE)
 
     def _resync_ingress(self, ingress: Ingress, wave: int) -> None:
         if self._has_hostname(ingress):
+            if not self.shards.owns_key(ingress.key()):
+                return
             resync_enqueue(self.ingress_fingerprints,
                            self.ingress_queue, ingress, wave)
 
@@ -213,13 +255,15 @@ class Route53Controller:
                         stop, self.service_queue, self._key_to_service,
                         self.process_service_delete,
                         self.process_service_create_or_update,
-                        fingerprints=self.service_fingerprints)
+                        fingerprints=self.service_fingerprints,
+                        shards=self.shards)
                     + spawn_workers(
                         f"{CONTROLLER_AGENT_NAME}-ingress", self.workers,
                         stop, self.ingress_queue, self._key_to_ingress,
                         self.process_ingress_delete,
                         self.process_ingress_create_or_update,
-                        fingerprints=self.ingress_fingerprints))
+                        fingerprints=self.ingress_fingerprints,
+                        shards=self.shards))
 
         run_controller(CONTROLLER_AGENT_NAME, stop,
                        [self.service_queue, self.ingress_queue], workers)

@@ -203,7 +203,7 @@ class ResourceStore:
     # -- CRUD -----------------------------------------------------------
 
     def create(self, obj: KubeObject) -> KubeObject:
-        self._chaos.check("create", self.kind)
+        self._chaos.check("create", self.kind, obj.metadata.name)
         if self._schema_validator is not None:
             self._schema_validator(obj)
         if self._admission is not None:
@@ -224,7 +224,7 @@ class ResourceStore:
             return obj.deep_copy()
 
     def get(self, namespace: str, name: str) -> KubeObject:
-        self._chaos.check("get", self.kind)
+        self._chaos.check("get", self.kind, name)
         with self._lock:
             key = f"{namespace}/{name}"
             obj = self._objects.get(key)
@@ -246,7 +246,7 @@ class ResourceStore:
         ``bump_generation`` defaults to spec updates bumping generation and
         status updates (``status_only``) leaving it, like the apiserver.
         """
-        self._chaos.check("update", self.kind)
+        self._chaos.check("update", self.kind, obj.metadata.name)
         if self._schema_validator is not None and not status_only:
             self._schema_validator(obj)
         if self._admission is not None and not status_only:
@@ -300,7 +300,7 @@ class ResourceStore:
         return (getattr(old, "spec", None) != getattr(new, "spec", None))
 
     def delete(self, namespace: str, name: str) -> None:
-        self._chaos.check("delete", self.kind)
+        self._chaos.check("delete", self.kind, name)
         with self._lock:
             key = f"{namespace}/{name}"
             obj = self._objects.get(key)

@@ -82,13 +82,21 @@ class KubeChaos:
 
     def set_error_rate(self, op: str, rate: float, kind: str = "*",
                        exc: Optional[Callable[[], Exception]] = None,
+                       name: str = "",
                        ) -> None:
         """Fail ``op`` (or ``'*'``) on ``kind`` (or ``'*'``) with
         probability ``rate``; 0 clears.  The default exception is a
         ``RuntimeError`` — what the HTTP backend surfaces for an
         apiserver 5xx, and what the informers' list+watch retry and
-        the elector's ``_attempt`` already classify as transient."""
-        key = f"{kind}:{op}"
+        the elector's ``_attempt`` already classify as transient.
+
+        ``name`` narrows the schedule to ONE object (``kind`` must be
+        concrete): the sharding e2e storms a single shard's Lease
+        while its siblings stay healthy.  Named rules take precedence
+        over kind-wide ones and draw from their OWN deterministic
+        per-(seed, kind/name:op, index) decision stream, so arming a
+        second lease's storm never perturbs the first's schedule."""
+        key = self._key(op, kind, name)
         with self._lock:
             if rate <= 0.0:
                 self._error_rates.pop(key, None)
@@ -97,15 +105,29 @@ class KubeChaos:
                     rate, exc or (lambda: RuntimeError(
                         "chaos: apiserver 5xx (injected)")))
 
-    def set_conflict_rate(self, rate: float, kind: str = "*") -> None:
+    def set_conflict_rate(self, rate: float, kind: str = "*",
+                          name: str = "") -> None:
         """resourceVersion conflict storm: ``update`` calls raise
         :class:`ConflictError` with probability ``rate`` before any
-        state is touched; 0 clears."""
+        state is touched; 0 clears.  ``name`` targets one object
+        (see ``set_error_rate``) — e.g. one shard's lease."""
+        if name and kind == "*":
+            raise ValueError("name-targeted chaos needs a concrete kind")
+        key = f"{kind}/{name}" if name else kind
         with self._lock:
             if rate <= 0.0:
-                self._conflict_rates.pop(kind, None)
+                self._conflict_rates.pop(key, None)
             else:
-                self._conflict_rates[kind] = rate
+                self._conflict_rates[key] = rate
+
+    @staticmethod
+    def _key(op: str, kind: str, name: str = "") -> str:
+        if name:
+            if kind == "*":
+                raise ValueError(
+                    "name-targeted chaos needs a concrete kind")
+            return f"{kind}/{name}:{op}"
+        return f"{kind}:{op}"
 
     def set_latency(self, op: str, seconds: float,
                     kind: str = "*") -> None:
@@ -155,35 +177,60 @@ class KubeChaos:
             f"{self._seed}:{salt}:{key}:{index}".encode())
         return draw / 2**32 < rate
 
-    def check(self, op: str, kind: str) -> None:
+    def check(self, op: str, kind: str, name: str = "") -> None:
         """Screen one store call; an injected fault means the call
         never happened.  Decision + counting under the lock; the
-        latency sleep and the raise outside it."""
+        latency sleep and the raise outside it.  ``name`` (the target
+        object's name, passed by the store when it knows it) lets
+        name-targeted schedules match; a named rule draws from its own
+        per-(seed, kind/name:op, index) stream and never consumes (or
+        perturbs) the kind-wide stream's draws — the seeded-decision
+        determinism contract, per target."""
         key = f"{kind}:{op}"
+        named_key = f"{kind}/{name}:{op}" if name else ""
         with self._lock:
             index = self._calls.get(key, 0)
             self._calls[key] = index + 1
             delay = self._latency.get(key,
                                       self._latency.get(f"*:{op}", 0.0))
             exc: Optional[Exception] = None
+            injected_key = key
             if op == "update":
-                rate = self._conflict_rates.get(
-                    kind, self._conflict_rates.get("*", 0.0))
-                if rate > 0.0 and self._decide("conflict", key, index,
+                if name and f"{kind}/{name}" in self._conflict_rates:
+                    rate = self._conflict_rates[f"{kind}/{name}"]
+                    idx = self._calls.get(named_key, 0)
+                    self._calls[named_key] = idx + 1
+                    dkey = named_key
+                else:
+                    rate = self._conflict_rates.get(
+                        kind, self._conflict_rates.get("*", 0.0))
+                    idx, dkey = index, key
+                if rate > 0.0 and self._decide("conflict", dkey, idx,
                                                rate):
+                    target = f"{kind} {name}".strip() if name else kind
                     exc = ConflictError(
                         f"chaos: injected resourceVersion conflict "
-                        f"on {kind}")
+                        f"on {target}")
+                    injected_key = dkey
             if exc is None:
-                hit = self._error_rates.get(key) \
-                    or self._error_rates.get(f"*:{op}") \
-                    or self._error_rates.get(f"{kind}:*") \
-                    or self._error_rates.get("*:*")
-                if hit is not None and self._decide("rate", key, index,
+                if named_key and named_key in self._error_rates:
+                    hit = self._error_rates[named_key]
+                    idx = self._calls.get(named_key, 0)
+                    self._calls[named_key] = idx + 1
+                    dkey = named_key
+                else:
+                    hit = self._error_rates.get(key) \
+                        or self._error_rates.get(f"*:{op}") \
+                        or self._error_rates.get(f"{kind}:*") \
+                        or self._error_rates.get("*:*")
+                    idx, dkey = index, key
+                if hit is not None and self._decide("rate", dkey, idx,
                                                     hit[0]):
                     exc = hit[1]()
+                    injected_key = dkey
             if exc is not None:
-                self._injected[key] = self._injected.get(key, 0) + 1
+                self._injected[injected_key] = \
+                    self._injected.get(injected_key, 0) + 1
         if delay > 0.0:
             time.sleep(delay)
         if exc is not None:
@@ -211,7 +258,7 @@ class _NullChaos:
     """Zero-overhead default: the fake apiserver carries one of these
     when no chaos schedule is armed (no lock, no counting)."""
 
-    def check(self, op: str, kind: str) -> None:
+    def check(self, op: str, kind: str, name: str = "") -> None:
         pass
 
     def decide_drop(self, kind: str) -> bool:

@@ -112,6 +112,8 @@ def load() -> Optional[ctypes.CDLL]:
                                                  ctypes.c_char_p,
                                                  ctypes.c_int]
         lib.aga_wq_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.aga_wq_remove.restype = ctypes.c_int
+        lib.aga_wq_remove.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.aga_wq_num_requeues.restype = ctypes.c_int
         lib.aga_wq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.aga_wq_len.restype = ctypes.c_int
@@ -128,6 +130,8 @@ def load() -> Optional[ctypes.CDLL]:
         fast.aga_wq_add2.argtypes = lib.aga_wq_add2.argtypes
         fast.aga_wq_done.argtypes = lib.aga_wq_done.argtypes
         fast.aga_wq_forget.argtypes = lib.aga_wq_forget.argtypes
+        fast.aga_wq_remove.restype = ctypes.c_int
+        fast.aga_wq_remove.argtypes = lib.aga_wq_remove.argtypes
         fast.aga_wq_add_after2.argtypes = lib.aga_wq_add_after2.argtypes
         fast.aga_wq_add_rate_limited2.restype = ctypes.c_double
         fast.aga_wq_add_rate_limited2.argtypes = (
@@ -248,6 +252,11 @@ class NativeRateLimitingQueue:
 
     def forget(self, item: Any) -> None:
         self._fast.aga_wq_forget(self._h, _encode(item))
+
+    def remove(self, item: Any) -> bool:
+        """Purge a pending item (per-shard queue ownership hook) —
+        parity with RateLimitingQueue.remove."""
+        return bool(self._fast.aga_wq_remove(self._h, _encode(item)))
 
     def num_requeues(self, item: Any) -> int:
         return self._fast.aga_wq_num_requeues(self._h, _encode(item))

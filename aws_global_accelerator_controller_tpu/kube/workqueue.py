@@ -407,6 +407,35 @@ class RateLimitingQueue:
         with self._cond:
             return self._claimed.get(item)
 
+    def remove(self, item: Any) -> bool:
+        """Purge a PENDING item from the queue machinery: its tier
+        slot, dirty mark, delay-heap entry and limiter state — the
+        per-shard queue ownership hook (a shard lost to a rebalance
+        purges its backlog instead of burning workers on syncs the
+        dispatch would drop anyway).  An item a worker currently holds
+        is not interrupted — only its pending re-delivery is
+        cancelled.  Returns True when anything was removed."""
+        with self._cond:
+            removed = False
+            if item in self._dirty:
+                self._dirty.discard(item)
+                removed = True
+                if item not in self._processing:
+                    for q in self._tiers.values():
+                        try:
+                            q.remove(item)
+                        except ValueError:
+                            pass
+                        else:
+                            break
+            if item in self._waiting_index:
+                # the heap entry goes stale and is skipped on pop
+                del self._waiting_index[item]
+                removed = True
+            self._maybe_drop_class_locked(item)
+        self._rate_limiter.forget(item)
+        return removed
+
     def shutdown(self) -> None:
         with self._cond:
             self._shutting_down = True

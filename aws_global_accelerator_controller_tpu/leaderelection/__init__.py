@@ -11,3 +11,6 @@ Active/standby replica coordination over a coordination/v1 Lease object:
   does not).
 """
 from .elector import LeaderElection
+from .shards import ShardLeaseManager
+
+__all__ = ["LeaderElection", "ShardLeaseManager"]

@@ -1,10 +1,22 @@
-"""Lease lock + elector loop (client-go tools/leaderelection analogue)."""
+"""Lease lock + elector loop (client-go tools/leaderelection analogue).
+
+The CAS state machine over one Lease object lives in
+:class:`LeaseCandidate` so two coordinators can share it verbatim:
+
+- :class:`LeaderElection` — the classic single-lease active/standby
+  elector (one leader for the whole process);
+- the shard-lease manager (leaderelection/shards.py) — S independent
+  leases, one per shard of the reconcile key space, each with its own
+  fencing token and a replica holding many (ROADMAP item 1).
+"""
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 import uuid
+import zlib
 from typing import Callable, Optional
 
 from ..errors import ConflictError, NotFoundError
@@ -22,6 +34,171 @@ RETRY_PERIOD = 5.0
 # manager's ordered drain) before releasing the lease anyway — must
 # comfortably cover ManagerHandle.stop's 10s default deadline
 RELEASE_JOIN_TIMEOUT = 30.0
+
+
+class LeaseCandidate:
+    """One candidate's CAS state machine over one named Lease.
+
+    Tracks the fencing-token bookkeeping that makes handoffs provable:
+    ``observed_transitions`` is the lease's ``lease_transitions`` at
+    our last successful CAS (the current term's fencing token), kept
+    strictly monotone across step-downs, re-creations after an
+    operator deleted the Lease, and re-acquisitions of our own stale
+    lease.  ``deposed`` flips when another candidate's unexpired CAS
+    holds the lease while we believed we held it — the holder must
+    step down NOW, not after burning the rest of its renew deadline.
+
+    ``acquire_conflicts`` counts CAS losses (ConflictError on
+    create/update): the observable the standby-jitter test bounds — N
+    synchronized standbys hitting one expiry produce ~N-1 conflicts
+    per period, decorrelated ones mostly observe the winner's renewal
+    and never CAS at all.
+    """
+
+    def __init__(self, name: str, namespace: str, kube_client,
+                 identity: str, lease_duration: float):
+        self.name = name
+        self.namespace = namespace
+        self.kube = kube_client
+        self.identity = identity
+        self.lease_duration = lease_duration
+        # do we currently believe we hold the lease (the caller keeps
+        # this in sync with its own leading state)
+        self.held = False
+        self.deposed = False
+        self.acquire_conflicts = 0
+        self._observed_holder = ""
+        # the transitions count observed when we last held the lease
+        # (the fencing token of the current term)
+        self.observed_transitions = 0
+        # we stepped down mid-life: the next acquisition is a NEW term
+        # (bump lease_transitions even when the holder field still
+        # names us, so the fencing token stays monotone)
+        self._stepped_down = False
+
+    def mark_stepped_down(self) -> None:
+        self._stepped_down = True
+        self.held = False
+
+    def attempt(self) -> bool:
+        """try_acquire_or_renew with transient errors mapped to a
+        failed attempt (client-go semantics): an apiserver outage must
+        burn against the renew deadline, not crash the elector thread.
+        The catch covers the HTTP backend's failure surface — OSError
+        (connection refused/reset, timeouts, URLError), RuntimeError
+        (apiserver 5xx), KubeConfigError (credential plugin hiccups) —
+        but NOT programming errors, which must surface."""
+        try:
+            return self.try_acquire_or_renew()
+        except (OSError, RuntimeError, KubeConfigError) as e:
+            logger.warning("lease %s acquire/renew attempt failed: %s",
+                           self.name, e)
+            return False
+
+    def try_acquire_or_renew(self) -> bool:
+        """One CAS attempt against the Lease object."""
+        now = time.time()
+        try:
+            lease = self.kube.leases.get(self.namespace, self.name)
+        except NotFoundError:
+            # re-creating a lease is a NEW CAS generation whenever we
+            # have any history — a step-down gap, an active term whose
+            # lease an operator deleted, or a previously observed
+            # count — so the fencing token stays monotone across the
+            # gap; only a genuinely fresh candidate starts at 0
+            transitions = (self.observed_transitions + 1
+                           if (self._stepped_down
+                               or self.held
+                               or self.observed_transitions)
+                           else 0)
+            lease = Lease(
+                metadata=ObjectMeta(name=self.name,
+                                    namespace=self.namespace),
+                spec=LeaseSpec(
+                    holder_identity=self.identity,
+                    lease_duration_seconds=int(self.lease_duration),
+                    acquire_time=now, renew_time=now,
+                    lease_transitions=transitions))
+            try:
+                self.kube.leases.create(lease)
+                self._stepped_down = False
+                self.observed_transitions = transitions
+                return True
+            except ConflictError:
+                self.acquire_conflicts += 1
+                return False
+
+        holder = lease.spec.holder_identity
+        if holder and holder != self.identity:
+            if now < lease.spec.renew_time + self.lease_duration:
+                if self.held:
+                    # we believed we were leading but another
+                    # candidate's CAS holds an unexpired claim: we were
+                    # deposed — the lead loop must step down NOW, not
+                    # after burning the rest of the renew deadline
+                    self.deposed = True
+                if holder != self._observed_holder:
+                    logger.info("lease %s: new holder elected: %s",
+                                self.name, holder)
+                    self._observed_holder = holder
+                return False
+            logger.info("lease %s expired (holder %s), taking over",
+                        self.name, holder)
+
+        taking_over = holder != self.identity or self._stepped_down
+        lease.spec.holder_identity = self.identity
+        lease.spec.renew_time = now
+        if taking_over:
+            lease.spec.acquire_time = now
+            lease.spec.lease_transitions += 1
+        try:
+            self.kube.leases.update(lease)
+            self._stepped_down = False
+            self.observed_transitions = lease.spec.lease_transitions
+            return True
+        except ConflictError:
+            self.acquire_conflicts += 1
+            return False
+        except NotFoundError:
+            return False
+
+    def release(self) -> None:
+        """ReleaseOnCancel (leaderelection.go:59): clear the holder so
+        the successor acquires immediately instead of waiting out the
+        lease duration."""
+        try:
+            lease = self.kube.leases.get(self.namespace, self.name)
+            if lease.spec.holder_identity == self.identity:
+                lease.spec.holder_identity = ""
+                self.kube.leases.update(lease)
+        except Exception:
+            logger.debug("lease %s release failed", self.name,
+                         exc_info=True)
+
+
+def standby_jitter(identity: str, retry_period: float):
+    """Decorrelated-jitter sleep generator for the acquire retry loop.
+
+    N standbys polling one lease on the same fixed period wake
+    together at every expiry and fight one CAS — one wins, N-1 burn a
+    ConflictError, every period (the synchronized conflict storm).
+    The AWS decorrelated-jitter recurrence (``sleep = min(cap,
+    uniform(base, prev * 3))``, the resilience layer's retry shape)
+    spreads the wakes so the first poller takes the lease and the rest
+    observe an unexpired holder without ever CASing.  Seeded from the
+    identity (crc32 — deterministic across processes) so a replica's
+    schedule is reproducible under test."""
+    rng = random.Random(zlib.crc32(identity.encode()))
+    base = retry_period * 0.5
+    cap = retry_period * 2.0
+    prev = retry_period
+
+    def next_sleep() -> float:
+        nonlocal prev
+        prev = min(cap, rng.uniform(base, prev * 3.0))
+        return prev
+
+    return next_sleep
 
 
 class LeaderElection:
@@ -45,7 +222,6 @@ class LeaderElection:
                  fence=None):
         self.name = name
         self.namespace = namespace
-        self.kube = kube_client
         self.lease_duration = lease_duration
         self.renew_deadline = renew_deadline
         self.retry_period = retry_period
@@ -55,102 +231,39 @@ class LeaderElection:
         # set when the on_started_leading callback raised: the process
         # should exit non-zero instead of reporting a clean shutdown
         self.run_failed = False
-        self._observed_holder = ""
-        # the transitions count observed when we last held the lease
-        # (the fencing token of the current term)
-        self._observed_transitions = 0
-        # another candidate's CAS took the lease while we were leading
-        self._deposed = False
-        # we stepped down mid-life: the next acquisition is a NEW term
-        # (bump lease_transitions even when the holder field still
-        # names us, so the fencing token stays monotone)
-        self._stepped_down = False
+        self._candidate = LeaseCandidate(name, namespace, kube_client,
+                                         self.identity, lease_duration)
+        # standby acquire-retry jitter (standby_jitter docstring): the
+        # WHILE-LEADING renew loop stays on the fixed retry_period —
+        # renewals are solo, only contended acquires need decorrelating
+        self._standby_sleep = standby_jitter(self.identity, retry_period)
 
-    # -- lock primitives ------------------------------------------------
+    # -- compatibility surface (tests drive these) ----------------------
+
+    @property
+    def kube(self):
+        return self._candidate.kube
+
+    @kube.setter
+    def kube(self, kube_client) -> None:
+        self._candidate.kube = kube_client
+
+    @property
+    def acquire_conflicts(self) -> int:
+        return self._candidate.acquire_conflicts
+
+    @property
+    def _observed_transitions(self) -> int:
+        return self._candidate.observed_transitions
 
     def _attempt(self) -> bool:
-        """_try_acquire_or_renew with transient errors mapped to a
-        failed attempt (client-go semantics): an apiserver outage must
-        burn against the renew deadline, not crash the elector thread.
-        The catch covers the HTTP backend's failure surface — OSError
-        (connection refused/reset, timeouts, URLError), RuntimeError
-        (apiserver 5xx), KubeConfigError (credential plugin hiccups) —
-        but NOT programming errors, which must surface."""
-        try:
-            return self._try_acquire_or_renew()
-        except (OSError, RuntimeError, KubeConfigError) as e:
-            logger.warning("lease acquire/renew attempt failed: %s", e)
-            return False
+        return self._candidate.attempt()
 
     def _try_acquire_or_renew(self) -> bool:
-        """One CAS attempt against the Lease object."""
-        now = time.time()
-        try:
-            lease = self.kube.leases.get(self.namespace, self.name)
-        except NotFoundError:
-            # re-creating a lease is a NEW CAS generation whenever we
-            # have any history — a step-down gap, an active term whose
-            # lease an operator deleted, or a previously observed
-            # count — so the fencing token stays monotone across the
-            # gap; only a genuinely fresh candidate starts at 0
-            transitions = (self._observed_transitions + 1
-                           if (self._stepped_down
-                               or self.is_leader.is_set()
-                               or self._observed_transitions)
-                           else 0)
-            lease = Lease(
-                metadata=ObjectMeta(name=self.name, namespace=self.namespace),
-                spec=LeaseSpec(
-                    holder_identity=self.identity,
-                    lease_duration_seconds=int(self.lease_duration),
-                    acquire_time=now, renew_time=now,
-                    lease_transitions=transitions))
-            try:
-                self.kube.leases.create(lease)
-                self._stepped_down = False
-                self._observed_transitions = transitions
-                return True
-            except ConflictError:
-                return False
-
-        holder = lease.spec.holder_identity
-        if holder and holder != self.identity:
-            if now < lease.spec.renew_time + self.lease_duration:
-                if self.is_leader.is_set():
-                    # we believed we were leading but another
-                    # candidate's CAS holds an unexpired claim: we were
-                    # deposed — the lead loop must step down NOW, not
-                    # after burning the rest of the renew deadline
-                    self._deposed = True
-                if holder != self._observed_holder:
-                    logger.info("new leader elected: %s", holder)
-                    self._observed_holder = holder
-                return False
-            logger.info("lease expired (holder %s), taking over", holder)
-
-        taking_over = holder != self.identity or self._stepped_down
-        lease.spec.holder_identity = self.identity
-        lease.spec.renew_time = now
-        if taking_over:
-            lease.spec.acquire_time = now
-            lease.spec.lease_transitions += 1
-        try:
-            self.kube.leases.update(lease)
-            self._stepped_down = False
-            self._observed_transitions = lease.spec.lease_transitions
-            return True
-        except (ConflictError, NotFoundError):
-            return False
+        return self._candidate.try_acquire_or_renew()
 
     def _release(self) -> None:
-        """ReleaseOnCancel (leaderelection.go:59)."""
-        try:
-            lease = self.kube.leases.get(self.namespace, self.name)
-            if lease.spec.holder_identity == self.identity:
-                lease.spec.holder_identity = ""
-                self.kube.leases.update(lease)
-        except Exception:
-            logger.debug("lease release failed", exc_info=True)
+        self._candidate.release()
 
     # -- elector loop ---------------------------------------------------
 
@@ -178,7 +291,7 @@ class LeaderElection:
                         return          # process stop: run() is done
                     logger.info("standby after leadership loss: %s",
                                 self.identity)
-                stop.wait(self.retry_period)
+                stop.wait(self._standby_sleep())
         finally:
             if self.is_leader.is_set():
                 self._release()
@@ -192,7 +305,7 @@ class LeaderElection:
         logger.warning("leader lost (%s): %s", why, self.identity)
         if self.fence is not None:
             self.fence.seal(f"lease lost: {why}")
-        self._stepped_down = True
+        self._candidate.mark_stepped_down()
         self.is_leader.clear()
         leader_stop.set()
         if on_stopped_leading is not None:
@@ -203,10 +316,11 @@ class LeaderElection:
         is lost (steps down, returns True so ``run`` re-enters the
         acquire loop)."""
         logger.info("became leader: %s (term %d)", self.identity,
-                    self._observed_transitions)
-        self._deposed = False
+                    self._candidate.observed_transitions)
+        self._candidate.deposed = False
+        self._candidate.held = True
         if self.fence is not None:
-            self.fence.arm(self._observed_transitions)
+            self.fence.arm(self._candidate.observed_transitions)
         self.is_leader.set()
         leader_stop = threading.Event()
 
@@ -232,9 +346,9 @@ class LeaderElection:
         last_renew = time.monotonic()
         try:
             while not stop.is_set():
-                if self._attempt() and not self._deposed:
+                if self._attempt() and not self._candidate.deposed:
                     last_renew = time.monotonic()
-                elif self._deposed:
+                elif self._candidate.deposed:
                     self._step_down(leader_stop, on_stopped_leading,
                                     "lease taken over by another "
                                     "candidate")
@@ -249,6 +363,7 @@ class LeaderElection:
             return False
         finally:
             leader_stop.set()
+            self._candidate.held = False
             # the run callback owns the ordered drain (cmd/root.py's
             # run_manager calls ManagerHandle.stop under its own
             # deadline): the lease must OUTLIVE it — releasing first

@@ -1,0 +1,314 @@
+"""Shard-lease manager: N replicas splitting S shards of the key
+space, rebalancing on membership change without ever producing two
+writers for one shard (ROADMAP item 1; the tentpole of ISSUE 8).
+
+The single-lease elector generalized: instead of one Lease electing
+one process-wide leader, each SHARD is an independent Lease
+(``{name}-shard-{i}``) with its own fencing token (the lease's
+``lease_transitions``, armed into that shard's
+:class:`~..resilience.fence.MutationFence` per term), and a replica
+may hold many shards.  Membership is a heartbeat Lease per replica
+(``{name}-member-{identity}``); every replica lists the member leases,
+computes the SAME rendezvous map (sharding/hashmap.py — no
+coordination beyond agreeing on the member list), and converges its
+held set toward it:
+
+- a shard whose rendezvous owner is another live replica is handed
+  off GRACEFULLY: trip that shard's fence (no new intents) → drain
+  its coalescer cohorts under the handoff deadline (in-flight cohorts
+  flush under the thread-scoped permit) → SEAL → release the Lease
+  (holder cleared, so the successor acquires on its next poll instead
+  of waiting out the duration) → drop ownership.  Seal strictly
+  precedes release, so the successor's first write cannot interleave
+  with ours — the PR-6 seal-before-callback ordering, per shard.
+- a shard whose Lease another replica CAS-took while we held it
+  (deposal — we wedged past the lease duration) seals IMMEDIATELY, no
+  drain: a deposed holder has no authority left to flush under; its
+  in-flight cohorts fail fast with FencedError and the successor
+  reconverges the keys.
+- renewals failing past the renew deadline seal the same way: a
+  replica that cannot prove its claim must stop writing BEFORE the
+  lease can expire for everyone else (renew_deadline < lease_duration
+  is the safety inequality, exactly the elector's).
+
+The acquire side re-uses the elector's :class:`LeaseCandidate` CAS
+verbatim, so the fencing token stays strictly monotone per shard
+across step-downs, re-creations and re-acquisitions; acquire retries
+ride the same decorrelated standby jitter (elector.standby_jitter) so
+an expiry never triggers a synchronized CAS-conflict storm.
+
+The successor's re-adoption needs no special path: acquiring a shard
+notifies the ShardSet listeners (controllers re-deliver the shard's
+keys as background work) and the keys ride the fingerprint-gated cold
+resync from the PR-6 restart-recovery path — reads and fingerprint
+rebuilds, zero mutations against a converged world.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from .. import metrics
+from ..sharding import ShardSet, compute_assignment
+from .elector import LeaseCandidate, standby_jitter
+
+logger = logging.getLogger(__name__)
+
+# Shard-lease timings: shorter than the process elector's — a shard
+# handoff stalls 1/S of the fleet, so detection should be fast; the
+# safety inequality renew_deadline < lease_duration still holds.
+SHARD_LEASE_DURATION = 15.0
+SHARD_RENEW_DEADLINE = 10.0
+SHARD_RETRY_PERIOD = 2.0
+# graceful-handoff drain budget (trip -> drain -> seal -> release)
+HANDOFF_DRAIN_TIMEOUT = 2.0
+
+
+class ShardLeaseManager:
+    """One replica's membership + shard-lease loop (module docstring).
+
+    ``shards`` is the process's :class:`~..sharding.ShardSet` (the
+    cloud factory's); entering ``run`` flips it to managed mode —
+    nothing is owned until a lease is won.  ``drain(shard_id,
+    timeout)`` flushes that shard's pending write cohorts between trip
+    and seal on the graceful path (wire it to the factory coalescer's
+    ``drain_shard``); None skips the drain (fail-fast handoffs).
+    """
+
+    def __init__(self, name: str, namespace: str, kube_client,
+                 shards: ShardSet,
+                 identity: str,
+                 lease_duration: float = SHARD_LEASE_DURATION,
+                 renew_deadline: float = SHARD_RENEW_DEADLINE,
+                 retry_period: float = SHARD_RETRY_PERIOD,
+                 handoff_drain_timeout: float = HANDOFF_DRAIN_TIMEOUT,
+                 drain: Optional[Callable[[int, float], bool]] = None):
+        if renew_deadline >= lease_duration:
+            raise ValueError(
+                "renew_deadline must be < lease_duration (a holder "
+                "must seal before its lease can expire for others)")
+        self.name = name
+        self.namespace = namespace
+        self.kube = kube_client
+        self.shards = shards
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.handoff_drain_timeout = handoff_drain_timeout
+        self._drain = drain
+        self._member = LeaseCandidate(
+            f"{name}-member-{identity}", namespace, kube_client,
+            identity, lease_duration)
+        self._candidates: Dict[int, LeaseCandidate] = {
+            sid: LeaseCandidate(f"{name}-shard-{sid}", namespace,
+                                kube_client, identity, lease_duration)
+            for sid in range(shards.num_shards)}
+        # monotonic time of the last successful renew per HELD shard
+        self._last_renew: Dict[int, float] = {}
+        self._sleep = standby_jitter(identity, retry_period)
+        self.started = threading.Event()
+
+    # -- membership -----------------------------------------------------
+
+    def _heartbeat(self) -> None:
+        """Renew our member lease (create/renew via the same CAS; a
+        member lease is never contended, so failures here are
+        apiserver trouble and simply age us out of the map)."""
+        self._member.held = True   # always "held": it is ours alone
+        self._member.attempt()
+
+    def _alive_members(self) -> "list[str]":
+        """Identities whose member lease is live (renewed within the
+        lease duration).  Includes us — even when our own heartbeat
+        write is failing, we are certainly alive; the OTHER replicas
+        age us out on their side."""
+        prefix = f"{self.name}-member-"
+        now = time.time()
+        members = {self.identity}
+        dead: "list[str]" = []
+        try:
+            for lease in self.kube.leases.list(self.namespace):
+                lease_name = lease.metadata.name
+                if not lease_name.startswith(prefix):
+                    continue
+                holder = lease.spec.holder_identity
+                expired_for = now - (lease.spec.renew_time
+                                     + self.lease_duration)
+                if not holder or expired_for > 2 * self.lease_duration:
+                    # a departed replica's heartbeat: released (empty
+                    # holder) or long expired — GC it, or pod churn
+                    # grows the namespace (and every tick's list)
+                    # without bound
+                    dead.append(lease_name)
+                    continue
+                if expired_for < 0:
+                    members.add(holder)
+        except Exception as e:
+            logger.warning("member list failed: %s", e)
+        for lease_name in dead[:2]:     # bounded, best-effort GC
+            try:
+                self.kube.leases.delete(self.namespace, lease_name)
+            except Exception:
+                pass                    # a sibling won the race: fine
+        return sorted(members)
+
+    # -- shard transitions ----------------------------------------------
+
+    def _acquire(self, sid: int) -> None:
+        candidate = self._candidates[sid]
+        if candidate.attempt():
+            candidate.held = True
+            candidate.deposed = False
+            self._last_renew[sid] = time.monotonic()
+            self.shards.acquire(sid, candidate.observed_transitions)
+            metrics.record_shard_rebalance("acquired")
+            logger.info("shard %d acquired by %s (token %d)", sid,
+                        self.identity, candidate.observed_transitions)
+
+    def _handoff(self, sid: int, successor: "str | None") -> None:
+        """Graceful rebalance away: trip → drain → seal → release."""
+        start = time.monotonic()
+        candidate = self._candidates[sid]
+        fence = self.shards.fence(sid)
+        fence.trip(f"shard {sid} rebalanced to {successor}")
+        if self._drain is not None:
+            if not self._drain(sid, self.handoff_drain_timeout):
+                logger.warning(
+                    "shard %d handoff drain incomplete; leftover "
+                    "waiters failed fast", sid)
+        fence.seal(f"shard {sid} handed off to {successor}")
+        candidate.mark_stepped_down()
+        candidate.release()
+        self._last_renew.pop(sid, None)
+        self.shards.release(sid)
+        metrics.record_shard_rebalance("handoff")
+        metrics.record_shard_handoff_duration(time.monotonic() - start)
+        logger.info("shard %d handed off by %s (%.3fs)", sid,
+                    self.identity, time.monotonic() - start)
+
+    def _depose(self, sid: int, why: str) -> None:
+        """Involuntary loss: seal FIRST (no drain — a deposed holder
+        has no authority to flush under), then drop ownership."""
+        start = time.monotonic()
+        candidate = self._candidates[sid]
+        self.shards.fence(sid).seal(f"shard {sid} lease lost: {why}")
+        candidate.mark_stepped_down()
+        self._last_renew.pop(sid, None)
+        self.shards.release(sid)
+        metrics.record_shard_rebalance("deposed")
+        metrics.record_shard_handoff_duration(time.monotonic() - start)
+        logger.warning("shard %d lost by %s (%s)", sid, self.identity,
+                       why)
+
+    # -- the loop -------------------------------------------------------
+
+    def _renew_held(self) -> None:
+        """Renew every held shard; detect deposal, renew-deadline
+        loss, and the SILENT loss: a stall long enough for the lease
+        to expire, be held by an intervening owner, expire again, and
+        be re-taken by our own renew's takeover path.  The renew CAS
+        succeeds — but the lease's ``lease_transitions`` advanced past
+        the token our fence was armed with, proving another term
+        existed in between; resuming with the old armed state would
+        trust pre-stall discovery/fingerprint caches over the
+        intervening owner's writes (the duplicate-create window).  So
+        a transitions jump replays the FULL lost → acquired cycle:
+        seal, release (lost listeners: fingerprints dropped, backlog
+        purged), re-arm at the new token (acquired listeners:
+        discovery cold-start, keys re-delivered)."""
+        for sid in sorted(self.shards.owned_shards()):
+            candidate = self._candidates[sid]
+            armed = self.shards.token(sid)
+            if candidate.attempt() and not candidate.deposed:
+                self._last_renew[sid] = time.monotonic()
+                new_token = candidate.observed_transitions
+                if new_token > armed:
+                    logger.warning(
+                        "shard %d re-taken after a silent expiry "
+                        "(token %d -> %d): replaying lost->acquired "
+                        "so caches cold-start", sid, armed, new_token)
+                    self.shards.fence(sid).seal(
+                        f"shard {sid} lease re-taken after expiry")
+                    self.shards.release(sid)
+                    self.shards.acquire(sid, new_token)
+                    metrics.record_shard_rebalance("retaken")
+            elif candidate.deposed:
+                self._depose(sid, "taken over by another candidate")
+            elif (time.monotonic()
+                    - self._last_renew.get(sid, time.monotonic())
+                    > self.renew_deadline):
+                self._depose(sid, "renewals failed past the renew "
+                                  "deadline")
+
+    def tick(self) -> None:
+        """One rebalance pass: heartbeat, renew held shards (sealing
+        on deposal / renew-deadline overrun), then converge the held
+        set toward the rendezvous assignment over the live members."""
+        start = time.monotonic()
+        self._heartbeat()
+        self._renew_held()
+
+        members = self._alive_members()
+        assignment = compute_assignment(self.shards.num_shards, members)
+
+        # hand off what is no longer ours...
+        for sid in sorted(self.shards.owned_shards()):
+            want = assignment.get(sid)
+            if want != self.identity:
+                self._handoff(sid, want)
+        # ...and acquire what is (the CAS only succeeds once the
+        # previous holder released or its lease expired, so a slow
+        # handoff on the other side cannot yield two owners)
+        for sid, want in assignment.items():
+            if want == self.identity and not self.shards.owns(sid):
+                self._acquire(sid)
+
+        # transitions run ownership listeners synchronously (cohort
+        # drains, O(informer-cache) re-delivery/purge scans —
+        # controller/base.wire_shard_listener), so a multi-shard
+        # rebalance can stall this thread well past the retry period;
+        # renew the SURVIVING shards again before sleeping so a long
+        # stall never silently eats their renew budget (the hard line
+        # stays lease_duration: a replica stalled past that is
+        # genuinely unresponsive and deserves its deposal)
+        if time.monotonic() - start > self.retry_period:
+            self._renew_held()
+
+    def run(self, stop: threading.Event) -> None:
+        """Blocking loop until ``stop``; on the way out, gracefully
+        hand off every held shard (seal-before-release per shard) and
+        let our member lease age out."""
+        logger.info("shard lease manager: %s over %d shards",
+                    self.identity, self.shards.num_shards)
+        if not self.shards.is_managed():
+            # flip once: re-entering run() (or a caller that already
+            # flipped it) must NOT wipe the owned set — held leases
+            # would be orphaned until expiry
+            self.shards.set_managed()
+        self.started.set()
+        try:
+            while not stop.is_set():
+                self.tick()
+                stop.wait(self._sleep())
+        finally:
+            for sid in sorted(self.shards.owned_shards()):
+                self._handoff(sid, None)
+            self._member.release()
+            try:
+                # a graceful exit removes its heartbeat object too —
+                # identities are per-pod, so leaving released leases
+                # behind grows the namespace with every restart
+                self.kube.leases.delete(
+                    self.namespace, f"{self.name}-member-{self.identity}")
+            except Exception:
+                logger.debug("member lease delete failed",
+                             exc_info=True)
+
+    def start_background(self, stop: threading.Event) -> threading.Thread:
+        t = threading.Thread(target=self.run, args=(stop,), daemon=True,
+                             name=f"shard-leases-{self.identity}")
+        t.start()
+        return t

@@ -190,6 +190,10 @@ class Manager:
         informer_factory = SharedInformerFactory(
             kube_client.api, resync_period=self.resync_period)
 
+        # per-shard ownership gauges (sharding/; shard_owner{shard}) —
+        # registered per run so a restarted manager replaces stale fns
+        metrics.watch_shard_owner(cloud_factory.shards)
+
         threads = []
         for name, init_fn in (initializers
                               or new_controller_initializers()).items():

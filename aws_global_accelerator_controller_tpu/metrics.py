@@ -306,6 +306,23 @@ default_registry.describe(
     "state is untouched and the next resync wave re-delivers it "
     "(controller/base.py resync_enqueue).")
 default_registry.describe(
+    "shard_owner",
+    "Per-shard ownership of THIS replica: 1 while the shard's lease "
+    "is held (fence armed for the current term), 0 otherwise "
+    "(sharding/shardset.py; leaderelection/shards.py).")
+default_registry.describe(
+    "shard_rebalances_total",
+    "Shard ownership transitions by kind: acquired (lease won), "
+    "handoff (gracefully released to the rendezvous successor: trip "
+    "-> drain -> seal -> release), deposed (lost to a takeover or "
+    "renew-deadline overrun: seal immediately, no drain), retaken "
+    "(a stall-spanned silent expiry re-taken with a jumped fencing "
+    "token: lost->acquired replayed so caches cold-start).")
+default_registry.describe(
+    "shard_handoff_duration_seconds",
+    "Wall-clock of shard loss paths (graceful handoffs include the "
+    "coalescer cohort drain; deposals are seal-and-release).")
+default_registry.describe(
     "race_lockset_checks",
     "Lock acquisitions screened by the runtime lockset tracker "
     "(analysis/locks.py) — nonzero proves the detector was armed.")
@@ -350,6 +367,39 @@ def record_shutdown_duration(seconds: float,
     """One ordered manager shutdown completed in ``seconds``."""
     reg = registry or default_registry
     reg.observe_summary("shutdown_duration_seconds", {}, seconds)
+
+
+def record_shard_rebalance(kind: str,
+                           registry: Optional[Registry] = None) -> None:
+    """One shard ownership transition (``acquired`` — a lease won;
+    ``handoff`` — gracefully released to the rendezvous successor;
+    ``deposed`` — lost involuntarily to a takeover or renew-deadline
+    overrun; ``retaken`` — a silent expiry spanned by a stall was
+    re-taken with a jumped fencing token, replaying lost->acquired so
+    caches cold-start), leaderelection/shards.py."""
+    reg = registry or default_registry
+    reg.inc_counter("shard_rebalances_total", {"kind": kind})
+
+
+def record_shard_handoff_duration(seconds: float,
+                                  registry: Optional[Registry] = None,
+                                  ) -> None:
+    """Wall-clock of one shard loss path (graceful: trip → drain →
+    seal → release; deposal: seal → release)."""
+    reg = registry or default_registry
+    reg.observe_summary("shard_handoff_duration_seconds", {}, seconds)
+
+
+def watch_shard_owner(shards, registry: Optional[Registry] = None) -> None:
+    """Register the per-shard ownership gauge over a
+    :class:`~.sharding.ShardSet`: ``shard_owner{shard}`` is 1 while
+    this replica owns the shard, 0 otherwise (the operator's first
+    stop for "who has shard 3" — docs/operations.md)."""
+    reg = registry or default_registry
+    for sid in range(shards.num_shards):
+        reg.register_gauge(
+            "shard_owner", {"shard": str(sid)},
+            lambda s=sid: 1.0 if shards.owns(s) else 0.0)
 
 
 def record_index_lookup(kind: str, index: str, hit: bool,

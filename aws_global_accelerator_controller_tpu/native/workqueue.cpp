@@ -440,6 +440,37 @@ void aga_wq_forget(void* h, const char* item) {
   q->failures.erase(item);
 }
 
+// Purge a PENDING item: tier slot, dirty mark, live delay-heap entry
+// (the heap node goes stale and is skipped on pop) and limiter state.
+// An item a worker holds is not interrupted — only its pending
+// re-delivery is cancelled.  Returns 1 when anything was removed.
+// The per-shard queue ownership hook (kube/workqueue.py remove()):
+// a shard lost to a rebalance purges its backlog instead of burning
+// workers on syncs the dispatch would drop anyway.
+int aga_wq_remove(void* h, const char* item) {
+  Queue* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  std::string key(item);
+  int removed = 0;
+  if (q->dirty.erase(key)) {
+    removed = 1;
+    if (!q->processing.count(key)) {
+      for (auto& tier : q->tiers) {
+        for (auto it = tier.begin(); it != tier.end(); ++it) {
+          if (*it == key) {
+            tier.erase(it);
+            break;
+          }
+        }
+      }
+    }
+  }
+  if (q->waiting_index.erase(key)) removed = 1;
+  q->failures.erase(key);
+  q->drop_if_gone_locked(key);
+  return removed;
+}
+
 int aga_wq_num_requeues(void* h, const char* item) {
   Queue* q = static_cast<Queue*>(h);
   std::lock_guard<std::mutex> lk(q->mu);

@@ -29,6 +29,7 @@ from __future__ import annotations
 import logging
 import time
 import zlib
+from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -65,6 +66,7 @@ def process_next_work_item(
     process_create_or_update: ProcessCreateOrUpdateFunc,
     get_timeout: Optional[float] = None,
     fingerprints: Optional[FingerprintCache] = None,
+    shards=None,
 ) -> bool:
     """One worker iteration; returns False only on queue shutdown.
 
@@ -72,6 +74,11 @@ def process_next_work_item(
     shutdown: a ``get`` timeout yields True without processing.
     ``fingerprints`` arms the steady-state fast path (module
     docstring); None keeps the reference dispatch exactly.
+    ``shards`` (sharding/shardset.py :class:`~..sharding.ShardSet`)
+    arms shard-routed dispatch: keys whose shard this replica does not
+    own are dropped (the owner converges them), and owned syncs run
+    inside the shard's route guard — the thread is marked with the
+    governing shard and the shard's fence gates every write attempt.
     """
     item, shutdown = queue.get(timeout=get_timeout)
     if shutdown:
@@ -81,7 +88,8 @@ def process_next_work_item(
 
     try:
         _reconcile_handler(item, queue, key_to_obj, process_delete,
-                           process_create_or_update, fingerprints)
+                           process_create_or_update, fingerprints,
+                           shards)
     except Exception:
         logger.exception("unhandled error reconciling %r", item)
     finally:
@@ -92,10 +100,23 @@ def process_next_work_item(
 def _reconcile_handler(key, queue, key_to_obj, process_delete,
                        process_create_or_update,
                        fingerprints: Optional[FingerprintCache] = None,
+                       shards=None,
                        ) -> None:
     if not isinstance(key, str):
         queue.forget(key)
         logger.error("expected string in workqueue but got %r", key)
+        return
+
+    if shards is not None and not shards.owns_key(key):
+        # routed to another replica's shard (a rebalance landed
+        # between enqueue and this get): drop without error — the
+        # owning replica converges the key on its own re-delivery
+        queue.forget(key)
+        if fingerprints is not None:
+            fingerprints.claim_origin(key)
+            fingerprints.clear_pending(key)
+        logger.debug("key %r not owned by this replica's shards, "
+                     "dropped", key)
         return
 
     start = time.monotonic()
@@ -114,6 +135,12 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
         else (CLASS_INTERACTIVE, start)
     first_enqueued = (fingerprints.pending_since(key, enqueued_at)
                       if fingerprints is not None else enqueued_at)
+    # shard route guard (sharding/shardset.py): the sync runs marked
+    # with its governing shard, whose fence gates every write attempt;
+    # a rebalance racing this dispatch raises ShardNotOwnedError (a
+    # NoRetryError) out of the guard and the key is dropped below
+    route_guard = ((lambda: shards.guard(key)) if shards is not None
+                   else nullcontext)
     with default_tracer.span("reconcile", queue=queue.name or "queue",
                              key=key) as span:
         try:
@@ -123,7 +150,7 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                 if fingerprints is not None:
                     fingerprints.invalidate(key)
                 try:
-                    with dispatch_class(klass):
+                    with route_guard(), dispatch_class(klass):
                         res = process_delete(key) or Result()
                 except Exception as de:
                     err = de
@@ -158,12 +185,12 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                      and fingerprints.matches(key, obj))
             try:
                 if sweep:
-                    with fingerprints.sweep_verify(), \
+                    with route_guard(), fingerprints.sweep_verify(), \
                             dispatch_class(klass):
                         res = (process_create_or_update(obj.deep_copy())
                                or Result())
                 else:
-                    with dispatch_class(klass):
+                    with route_guard(), dispatch_class(klass):
                         res = (process_create_or_update(obj.deep_copy())
                                or Result())
             except Exception as ce:

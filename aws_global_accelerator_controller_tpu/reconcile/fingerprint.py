@@ -256,6 +256,30 @@ class FingerprintCache:
         with self._lock:
             self._fp.clear()
 
+    def invalidate_shard(self, shard_id: int, shard_of_key) -> int:
+        """Drop every record whose key maps to ``shard_id`` under
+        ``shard_of_key`` — the per-shard partition of this cache.
+        Called on shard-lease LOSS (sharding; controller shard
+        listeners): while another replica owns the shard its syncs
+        mutate AWS state this cache's records know nothing about, so a
+        later re-acquisition must re-verify cold (the PR-6
+        restart-recovery path per shard) instead of trusting a
+        pre-loss skip.  ``shard_of_key`` runs OUTSIDE the cache lock
+        (it may consult listers); returns how many records dropped."""
+        with self._lock:
+            keys = list(self._fp)
+        # route mapping runs UNLOCKED (it may consult listers), then
+        # every matched key drops in ONE locked pass — O(n) separate
+        # lock round-trips here would contend with reconcile workers
+        # from the shard-lease manager's handoff path
+        matched = [key for key in keys if shard_of_key(key) == shard_id]
+        dropped = 0
+        with self._lock:
+            for key in matched:
+                dropped += self._fp.pop(key, None) is not None
+                self._pending_since.pop(key, None)
+        return dropped
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._fp)

@@ -44,7 +44,13 @@ from .breaker import (
     STATE_HALF_OPEN,
     STATE_OPEN,
 )
-from .fence import FencedError, MutationFence
+from .fence import (
+    CompositeFence,
+    FencedError,
+    MutationFence,
+    active_write_fences,
+    push_write_fence,
+)
 from .wrapper import ResilienceConfig, ResilientAPIs
 
 __all__ = [
@@ -53,8 +59,11 @@ __all__ = [
     "CircuitOpenError",
     "DeadlineExceededError",
     "ErrorClass",
+    "CompositeFence",
     "FencedError",
     "MutationFence",
+    "active_write_fences",
+    "push_write_fence",
     "ResilienceConfig",
     "ResilientAPIs",
     "RetryBudgetExceededError",

@@ -67,6 +67,37 @@ class FencedError(NoRetryError):
 # thread-scoped flush permit (see MutationFence.flush_pass)
 _pass_tls = threading.local()
 
+# thread-scoped EXTRA write gates: fences pushed around a routed
+# dispatch (sharding/shardset.py ShardSet.guard) or a per-shard
+# coalescer flush, consulted by ResilientAPIs.invoke per attempt in
+# addition to its own process fence — so a shard lease lost while a
+# retry sleeps rejects the write on wake, exactly like the process
+# fence does, without the wrapper knowing anything about shards.
+_write_tls = threading.local()
+
+
+@contextmanager
+def push_write_fence(fence):
+    """Arm ``fence`` as an additional per-attempt write gate for code
+    running on this thread inside the block (re-entrant; None is a
+    no-op so callers need no conditional)."""
+    if fence is None:
+        yield
+        return
+    stack = getattr(_write_tls, "stack", None)
+    if stack is None:
+        stack = _write_tls.stack = []
+    stack.append(fence)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def active_write_fences():
+    """The fences pushed on this thread's stack (innermost last)."""
+    return tuple(getattr(_write_tls, "stack", ()) or ())
+
 
 class MutationFence:
     """One process-lifecycle fence per CloudFactory, wired into the
@@ -172,3 +203,43 @@ class MutationFence:
             yield
         finally:
             _pass_tls.depth -= 1
+
+
+class CompositeFence:
+    """Several fences consulted as one — the per-shard coalescer's
+    gate is CompositeFence(process fence, shard fence): the ordered
+    shutdown trips the process fence, a shard-lease loss trips/seals
+    that shard's, and either alone stops the cohort.  ``token`` is the
+    shard fence's (the LAST member's): the per-term fencing token the
+    handoff e2e orders writes by.  The flush-pass permit is
+    thread-scoped and shared across every fence instance, so wrapping
+    one member covers all."""
+
+    def __init__(self, *fences):
+        self._fences = tuple(f for f in fences if f is not None)
+        if not self._fences:
+            raise ValueError("CompositeFence needs at least one fence")
+
+    @property
+    def token(self) -> int:
+        return self._fences[-1].token
+
+    @property
+    def reason(self) -> str:
+        for fence in self._fences:
+            if fence.reason:
+                return fence.reason
+        return ""
+
+    def is_tripped(self) -> bool:
+        return any(f.is_tripped() for f in self._fences)
+
+    def is_sealed(self) -> bool:
+        return any(f.is_sealed() for f in self._fences)
+
+    def check(self, surface: str) -> None:
+        for fence in self._fences:
+            fence.check(surface)
+
+    def flush_pass(self):
+        return self._fences[0].flush_pass()

@@ -41,6 +41,7 @@ from typing import Optional
 from .. import metrics
 from .breaker import AdaptiveTokenBucket, CircuitBreaker
 from .classify import ErrorClass, classify
+from .fence import active_write_fences
 from .retry import DeadlineExceededError, RetryBudgetExceededError, RetryPolicy
 
 # The wrapped call surface per service attribute (the abstract methods
@@ -224,9 +225,16 @@ class ResilientAPIs:
             # lifecycle fence first (L108): a mutation from a stopping
             # or deposed process must not reach the wire — checked per
             # attempt, so a retry sleeping across a lease loss is
-            # rejected when it wakes, not issued with dead authority
-            if self.fence is not None and op in MUTATION_METHODS:
-                self.fence.check("wrapper")
+            # rejected when it wakes, not issued with dead authority.
+            # The thread's pushed write fences (a routed dispatch's
+            # shard fence, a per-shard flush — resilience/fence.py
+            # push_write_fence) gate at the same per-attempt point, so
+            # a SHARD lease lost mid-retry rejects identically.
+            if op in MUTATION_METHODS:
+                if self.fence is not None:
+                    self.fence.check("wrapper")
+                for extra_fence in active_write_fences():
+                    extra_fence.check("wrapper")
             # cheap open-circuit pre-gate first (claims nothing), so a
             # fully open circuit costs no token and no pacing sleep —
             # otherwise failing-fast workers would drain the bucket

@@ -1,0 +1,49 @@
+"""Sharded fleet ownership: the key-space partition behind scale-out.
+
+ROADMAP item 1: one process, one set of workqueues, one coalescer is
+the last single-process bottleneck.  This package is the *ownership*
+half of the fix — N replicas splitting the reconcile key space must
+never produce two writers for one endpoint group or hosted zone,
+across crashes, deposals and membership churn (the fault-tolerant
+dynamic-membership shape of Prime's collective library, PAPERS.md:
+peers join/leave mid-run, the group rebalances and continues).
+
+Two layers:
+
+- :mod:`.hashmap` — the pure math: a stable ``shard_of(key, S)``
+  partition of container keys into S shards, and a rendezvous
+  (highest-random-weight) ``shard → replica`` map over the live member
+  set, so membership churn moves only the affected shards (~1/N of
+  keys on a join; exactly the dead replica's shards on a leave).
+- :mod:`.shardset` — the runtime object: one
+  :class:`~..resilience.fence.MutationFence` per shard (armed per
+  lease term by the shard-lease manager,
+  leaderelection/shards.py), the owned-shard set, the dispatch route
+  context, and ``check(container_key)`` — the write-side ownership
+  assertion lint rule L110 keeps at every mutation chokepoint.
+
+Routing contract (ARCHITECTURE.md "Sharded ownership"): every
+mutation routes by the hash of its *AWS-side container* — the
+endpoint-group ARN a binding names in its spec, the hosted-zone /
+accelerator container falling back to the owning OBJECT key
+pre-creation (and staying there for the container's life, so a
+resource never migrates shards mid-operation).  Intents go to the
+owning shard's coalescer cohort (cloudprovider/aws/batcher.py
+``ShardedCoalescer``), the way Cloud Collectives (PAPERS.md) reorders
+ranks so traffic stays inside cheap domains.
+"""
+from .hashmap import compute_assignment, rendezvous_owner, shard_of
+from .shardset import (
+    ShardNotOwnedError,
+    ShardSet,
+    current_route_shard,
+)
+
+__all__ = [
+    "ShardNotOwnedError",
+    "ShardSet",
+    "compute_assignment",
+    "current_route_shard",
+    "rendezvous_owner",
+    "shard_of",
+]

@@ -1,0 +1,58 @@
+"""The shard map math: stable key partition + rendezvous ownership.
+
+Two independent mappings compose into "which replica owns this key":
+
+1. ``shard_of(key, S)`` — container key → shard id.  A pure crc32
+   partition (crc32, not ``hash()``: str hashes are salted per process
+   and the whole point is that every replica, and every restart,
+   computes the SAME shard for the same key).  S is a deployment
+   constant (``--shards``), so a plain modulo is the consistent hash:
+   keys never move between shards while the deployment shape holds.
+
+2. ``rendezvous_owner(shard, members)`` — shard id → replica identity
+   via highest-random-weight hashing over the live member set.  The
+   property that makes rebalancing safe AND cheap: when a member
+   joins, each shard independently re-evaluates and only the shards
+   whose max moved to the newcomer migrate (~S/N of them); when a
+   member dies, exactly the dead member's shards move (every other
+   shard's max is unchanged) — no global reshuffle, no coordination
+   beyond agreeing on the member list.
+
+Both are deterministic across processes — the chaos/e2e suites and the
+multi-process shard-scaling bench rely on replicas agreeing on the map
+without ever talking to each other about it.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Dict, Sequence
+
+
+def shard_of(key: str, num_shards: int) -> int:
+    """Stable shard id of a container key in ``[0, num_shards)``."""
+    if num_shards <= 1:
+        return 0
+    return zlib.crc32(key.encode()) % num_shards
+
+
+def rendezvous_owner(shard_id: int,
+                     members: Sequence[str]) -> "str | None":
+    """The member that owns ``shard_id`` under highest-random-weight
+    hashing, or None when the member set is empty.  Ties (crc32
+    collisions) break by identity so every replica agrees."""
+    best = None
+    best_weight = -1
+    for member in members:
+        weight = zlib.crc32(f"{member}\x00{shard_id}".encode())
+        if weight > best_weight or (weight == best_weight
+                                    and (best is None or member < best)):
+            best = member
+            best_weight = weight
+    return best
+
+
+def compute_assignment(num_shards: int,
+                       members: Sequence[str]) -> Dict[int, "str | None"]:
+    """shard id → owning member for the whole map (the rebalance
+    target the shard-lease manager converges toward)."""
+    return {s: rendezvous_owner(s, members) for s in range(num_shards)}

@@ -1,0 +1,228 @@
+"""Runtime shard ownership: per-shard fences, the owned set, and the
+write-side ownership assertion.
+
+One :class:`ShardSet` per process (built by the cloud factory, shared
+by the controllers, the sharded coalescer and the shard-lease
+manager).  Three ownership modes:
+
+- **standalone** (the default, ``num_shards=1`` or no manager): every
+  shard is owned from birth with its fence armed at token 0 — the
+  single-process deployment is the degenerate S=1 case and behaves
+  byte-for-byte like the pre-sharding tree.
+- **static** (``--shard-id K``): exactly shard K is owned, no leases —
+  the bench worker / operator-pinned shape.
+- **managed** (``--shard-id auto`` under ``--shards N > 1``): the
+  shard-lease manager (leaderelection/shards.py) acquires and releases
+  shards as membership changes; nothing is owned until a lease is won.
+
+The write-side contract (lint rule L110): every mutation chokepoint —
+the sharded coalescer's submit and every bare AWS write in the
+provider — passes through :meth:`ShardSet.check`, which resolves the
+container key to its shard, rejects it when this replica does not own
+that shard (:class:`ShardNotOwnedError`, a no-retry drop: the owner
+converges the key) and then consults the shard's
+:class:`~..resilience.fence.MutationFence` — so a shard whose lease
+was lost mid-flight rejects exactly like a deposed leader did in the
+single-lease world (PR 6), per shard.
+
+Route context: the reconcile dispatch wraps every sync in
+:meth:`ShardSet.guard` with the controller's routing key.  The guard
+(a) drops syncs for unowned keys before any provider call, (b) marks
+the thread with the governing shard so mutation intents planned inside
+resolve to the SAME shard their dispatch was routed by (the
+GlobalAccelerator controller's endpoint groups hash by their owning
+object's key — the pre-creation fallback kept for the container's
+life), and (c) pushes the shard's fence into the resilient wrapper's
+write-fence TLS so even a retry sleeping across a lease loss is
+rejected per attempt (resilience/wrapper.py).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from contextlib import contextmanager
+from typing import Callable, List, Optional, Set
+
+from ..analysis import locks
+from ..errors import NoRetryError
+from ..resilience.fence import MutationFence, push_write_fence
+from .hashmap import shard_of
+
+logger = logging.getLogger(__name__)
+
+_route_tls = threading.local()
+
+
+def current_route_shard() -> Optional[int]:
+    """The shard governing the sync on this thread's stack (set by
+    :meth:`ShardSet.guard`); None outside any routed dispatch."""
+    return getattr(_route_tls, "shard", None)
+
+
+class ShardNotOwnedError(NoRetryError):
+    """A mutation (or a dispatched sync) targets a shard this replica
+    does not own.  No-retry by type: requeueing would re-reject — the
+    owning replica converges the key on its own watch."""
+
+    def __init__(self, shard: int, key: str):
+        super().__init__(
+            f"shard {shard} not owned by this replica "
+            f"(container key {key!r})")
+        self.shard = shard
+        self.key = key
+
+
+class ShardSet:
+    """Per-process shard ownership state (module docstring)."""
+
+    def __init__(self, num_shards: int = 1,
+                 process_fence: Optional[MutationFence] = None):
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.num_shards = num_shards
+        # the process-lifecycle fence (ordered shutdown) — composed
+        # with each shard's own fence at the write chokepoints
+        self.process_fence = process_fence
+        self._lock = locks.make_lock("shardset")
+        self._fences: List[MutationFence] = [
+            MutationFence(name=f"shard-{i}") for i in range(num_shards)]
+        # standalone until a manager (or --shard-id) claims otherwise:
+        # everything owned, fences armed at token 0
+        self._owned: Set[int] = set(range(num_shards))
+        self._managed = False
+        # listeners: fn(event, shard_id) with event "acquired"/"lost";
+        # called OUTSIDE the lock, on the transitioning thread
+        self._listeners: List[Callable[[str, int], None]] = []
+
+    # -- mode -----------------------------------------------------------
+
+    def set_managed(self) -> None:
+        """Enter lease-managed mode: nothing is owned until the shard
+        lease manager acquires it."""
+        with self._lock:
+            self._managed = True
+            self._owned.clear()
+
+    def set_static_owner(self, shard_id: int) -> None:
+        """Own exactly ``shard_id`` statically (``--shard-id K``)."""
+        self._index(shard_id)
+        with self._lock:
+            self._managed = True
+            self._owned = {shard_id}
+
+    def is_managed(self) -> bool:
+        with self._lock:
+            return self._managed
+
+    # -- map ------------------------------------------------------------
+
+    def _index(self, shard_id: int) -> int:
+        if not 0 <= shard_id < self.num_shards:
+            raise ValueError(
+                f"shard {shard_id} out of range [0, {self.num_shards})")
+        return shard_id
+
+    def shard_of(self, container_key: str) -> int:
+        return shard_of(container_key, self.num_shards)
+
+    def resolve(self, container_key: str) -> int:
+        """The shard governing a mutation for ``container_key``: the
+        dispatch route context when a routed sync is on this thread's
+        stack (so a sync's writes ride the shard its dispatch was
+        admitted under), else the container hash."""
+        ctx = current_route_shard()
+        return ctx if ctx is not None else self.shard_of(container_key)
+
+    def fence(self, shard_id: int) -> MutationFence:
+        return self._fences[self._index(shard_id)]
+
+    def owns(self, shard_id: int) -> bool:
+        with self._lock:
+            return shard_id in self._owned
+
+    def owns_key(self, container_key: str) -> bool:
+        with self._lock:
+            return self.shard_of(container_key) in self._owned
+
+    def owned_shards(self) -> Set[int]:
+        with self._lock:
+            return set(self._owned)
+
+    def token(self, shard_id: int) -> int:
+        return self.fence(shard_id).token
+
+    # -- ownership transitions (the shard-lease manager's surface) ------
+
+    def add_listener(self, fn: Callable[[str, int], None]) -> None:
+        """Register an ownership-change listener (``fn(event, shard)``
+        with event ``"acquired"``/``"lost"``).  Controllers use this to
+        re-deliver a freshly acquired shard's keys and to drop a lost
+        shard's fingerprints/backlog."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _notify(self, event: str, shard_id: int) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(event, shard_id)
+            except Exception:
+                logger.exception("shard %s listener failed for shard %d",
+                                 event, shard_id)
+
+    def acquire(self, shard_id: int, token: int) -> None:
+        """Own ``shard_id`` for a new lease term: arm its fence with
+        the term's fencing token (monotone per shard — the lease's
+        ``lease_transitions``), then mark owned and notify."""
+        self.fence(shard_id).arm(token)
+        with self._lock:
+            already = shard_id in self._owned
+            self._owned.add(shard_id)
+        if not already:
+            self._notify("acquired", shard_id)
+
+    def release(self, shard_id: int) -> None:
+        """Stop owning ``shard_id``.  The caller (the shard-lease
+        manager) is responsible for the fence ordering — seal BEFORE
+        release on every loss path, so no write can land between
+        losing ownership and the successor's first."""
+        self._index(shard_id)
+        with self._lock:
+            had = shard_id in self._owned
+            self._owned.discard(shard_id)
+        if had:
+            self._notify("lost", shard_id)
+
+    # -- the write-side assertion (lint rule L110) ----------------------
+
+    def check(self, container_key: str, surface: str = "write") -> int:
+        """The shard-ownership assertion every mutation chokepoint
+        passes through: resolve the container's shard, reject when
+        unowned, then consult the shard fence (and the process fence)
+        — one lock acquisition each on the open path.  Returns the
+        resolved shard id so callers route by EXACTLY the shard the
+        assertion admitted (no second resolve to diverge from)."""
+        sid = self.resolve(container_key)
+        if not self.owns(sid):
+            raise ShardNotOwnedError(sid, container_key)
+        if self.process_fence is not None:
+            self.process_fence.check(surface)
+        self._fences[sid].check(surface)
+        return sid
+
+    @contextmanager
+    def guard(self, route_key: str):
+        """Wrap one routed dispatch: admit only owned keys, mark the
+        thread with the governing shard, and arm the wrapper's
+        per-attempt write gate with the shard's fence."""
+        sid = self.shard_of(route_key)
+        if not self.owns(sid):
+            raise ShardNotOwnedError(sid, route_key)
+        prior = getattr(_route_tls, "shard", None)
+        _route_tls.shard = sid
+        try:
+            with push_write_fence(self._fences[sid]):
+                yield sid
+        finally:
+            _route_tls.shard = prior

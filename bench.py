@@ -821,6 +821,226 @@ def bench_mixed_soak(n_services: int = 1000, workers: int = 6,
     return out
 
 
+def _shard_worker(spec: dict) -> dict:
+    """One shard-scaling bench replica: its OWN fake control plane and
+    cloud slice, statically owning exactly ``spec["shard"]`` of
+    ``spec["shards"]`` (the ``--shard-id K`` deployment shape).  The
+    shard partition is the REAL hash (sharding.shard_of over object
+    keys), so the worker converges precisely the services the sharded
+    fleet would route to it.  Waits for the parent's barrier line on
+    stdin so N workers storm concurrently (process startup cost never
+    pollutes the measured window), then reports both legs:
+
+    - create storm: wall-clock to converge its slice;
+    - steady state: wall-clock for ``steady_rounds`` deep-verify
+      passes over the converged slice (sweep_every=1: every resync
+      wave re-verifies every key against the provider).
+
+    The fake cloud injects ``call_latency`` per AWS call — the bench
+    models the I/O-bound production shape (real AWS RTTs dominate a
+    replica's capacity), which is exactly the regime where scale-out
+    buys throughput; a latency-free fake would measure Python
+    single-core scheduling instead of the sharding design."""
+    sys.path.insert(0, "tests")
+    from harness import Cluster, wait_until
+
+    from aws_global_accelerator_controller_tpu import metrics
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintConfig,
+    )
+    from aws_global_accelerator_controller_tpu.sharding import shard_of
+
+    region = "ap-northeast-1"
+    n_total, shards, k = (spec["services"], spec["shards"],
+                          spec["shard"])
+    mine = [f"svc{i:04d}" for i in range(n_total)
+            if shard_of(f"default/svc{i:04d}", shards) == k]
+    cluster = Cluster(workers=spec["workers"],
+                      resync_period=spec["resync"],
+                      queue_qps=10000.0, queue_burst=10000,
+                      num_shards=shards,
+                      fingerprints=FingerprintConfig(sweep_every=1))
+    cluster.factory.shards.set_static_owner(k)
+    for method in ("create_accelerator", "update_accelerator",
+                   "tag_resource", "create_listener",
+                   "create_endpoint_group", "update_endpoint_group",
+                   "describe_accelerator", "describe_endpoint_group",
+                   "list_accelerators", "list_tags_for_resource",
+                   "list_listeners", "list_endpoint_groups",
+                   "describe_load_balancers"):
+        cluster.cloud.faults.set_latency(method, spec["call_latency"])
+    for name in mine:
+        cluster.cloud.elb.register_load_balancer(
+            name, f"{name}-0123456789abcdef.elb.{region}.amazonaws.com",
+            region)
+    cluster.start()
+
+    print("READY", flush=True)
+    sys.stdin.readline()                    # the parent's start barrier
+
+    start = time.perf_counter()
+    for name in mine:
+        hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                    ".amazonaws.com")
+        cluster.kube.services.create(Service(
+            metadata=ObjectMeta(
+                name=name, namespace="default",
+                annotations={
+                    AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                }),
+            spec=ServiceSpec(type="LoadBalancer",
+                             ports=[ServicePort(port=80)]),
+            status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(hostname=hostname)])),
+        ))
+    wait_until(
+        lambda: len(cluster.cloud.ga.list_accelerators()) == len(mine),
+        timeout=600.0, interval=0.02,
+        message=f"shard {k}: {len(mine)} accelerators converged")
+    storm_s = time.perf_counter() - start
+
+    # steady state: deep-verify passes over the converged slice
+    reg = metrics.default_registry
+    rounds = spec["steady_rounds"]
+    base = reg.counter_value("drift_sweep_verifies_total")
+    target = rounds * len(mine)
+    steady_start = time.perf_counter()
+    wait_until(
+        lambda: reg.counter_value("drift_sweep_verifies_total") - base
+        >= target,
+        timeout=600.0, interval=0.02,
+        message=f"shard {k}: {rounds} deep-verify rounds")
+    steady_s = time.perf_counter() - steady_start
+    cluster.shutdown(ordered=True, deadline=10.0)
+    return {"shard": k, "services": len(mine),
+            "storm_s": round(storm_s, 3),
+            "steady_s": round(steady_s, 3),
+            "steady_verifies": target}
+
+
+def bench_shard_scaling(n_services: int = 320, shard_counts=(1, 4),
+                        workers: int = 2, call_latency: float = 0.004,
+                        resync: float = 0.25, steady_rounds: int = 2,
+                        record: bool = False,
+                        timeout: float = 420.0) -> dict:
+    """Shard scale-out A/B (ROADMAP item 1 acceptance): the same
+    ``n_services`` fleet converged by 1 replica process owning the one
+    shard vs S replica PROCESSES each statically owning its shard of
+    the real partition (``--shards S --shard-id k``), on the
+    create-storm and steady-state (deep-verify) legs.  Workers are
+    true OS processes started behind a barrier so import/setup cost never
+    counts; each leg's wall-clock is the SLOWEST worker's (the fleet
+    converges when the last shard does).
+
+    Scaled down from ROADMAP item 1's 100k services for wall-clock
+    (noted in the recorded entry); the fake cloud injects per-call
+    latency so the workload is I/O-bound like production AWS — the
+    regime sharding exists for.  Recorded to reconcile_history.jsonl
+    tagged ``bench: "shard-scaling"`` (the derived reconcile floor
+    skips tagged entries — these throughputs measure a
+    latency-injected cloud, not the floor's pure create storm)."""
+    import subprocess
+
+    legs = []
+    for shards in shard_counts:
+        specs = [{"shard": k, "shards": shards, "services": n_services,
+                  "workers": workers, "call_latency": call_latency,
+                  "resync": resync, "steady_rounds": steady_rounds}
+                 for k in range(shards)]
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "_shard-worker", json.dumps(spec)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            for spec in specs]
+        results = []
+        try:
+            deadline = time.monotonic() + timeout
+            for p in procs:             # barrier: all workers ready
+                while True:
+                    line = p.stdout.readline()
+                    if not line or time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"shard worker died before READY "
+                            f"(rc={p.poll()})")
+                    if line.strip() == "READY":
+                        break
+            for p in procs:             # ...then storm concurrently
+                p.stdin.write("go\n")
+                p.stdin.flush()
+            for p in procs:
+                while True:
+                    line = p.stdout.readline()
+                    if not line or time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"shard worker died before RESULT "
+                            f"(rc={p.poll()})")
+                    if line.startswith("RESULT "):
+                        results.append(json.loads(line[len("RESULT "):]))
+                        break
+                p.wait(timeout=30)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        storm_s = max(r["storm_s"] for r in results)
+        steady_s = max(r["steady_s"] for r in results)
+        verifies = sum(r["steady_verifies"] for r in results)
+        legs.append({
+            "shards": shards,
+            "services": n_services,
+            "per_shard": sorted((r["shard"], r["services"])
+                                for r in results),
+            "storm_s": round(storm_s, 3),
+            "storm_throughput": round(n_services / storm_s, 1),
+            "steady_s": round(steady_s, 3),
+            "steady_verifies_per_s": round(verifies / steady_s, 1),
+        })
+    out = {
+        "services": n_services,
+        "workers": workers,
+        "call_latency_s": call_latency,
+        "legs": legs,
+    }
+    if len(legs) >= 2:
+        base, top = legs[0], legs[-1]
+        out["storm_speedup"] = round(
+            top["storm_throughput"] / base["storm_throughput"], 2)
+        out["steady_speedup"] = round(
+            top["steady_verifies_per_s"]
+            / base["steady_verifies_per_s"], 2)
+    if record:
+        top = legs[-1]
+        _record_reconcile_history(
+            {"services": n_services,
+             "throughput": top["storm_throughput"]},
+            bench="shard-scaling",
+            extra={"shards": top["shards"],
+                   "storm_speedup": out.get("storm_speedup"),
+                   "steady_speedup": out.get("steady_speedup"),
+                   "call_latency_s": call_latency,
+                   "note": ("scaled down from ROADMAP item 1's 100k "
+                            "services for wall-clock; per-call fake "
+                            "latency models the I/O-bound real AWS "
+                            "API")})
+    return out
+
+
 def bench_reconcile_best(reps: int = 3, **kw) -> dict:
     """Best-of-``reps`` reconcile runs.  Convergence time is gated by
     thread scheduling (informer fan-out, queue wakeups), which jitters
@@ -2307,6 +2527,7 @@ _NAMED = {
     "batch-efficiency": lambda: bench_batch_efficiency(record=True),
     "steady-state": lambda: bench_steady_state(record=True),
     "restart-recovery": lambda: bench_restart_recovery(record=True),
+    "shard-scaling": lambda: bench_shard_scaling(record=True),
     "mixed-soak": lambda: bench_mixed_soak(record=True),
     "planner": lambda: _json_bench_subprocess(
         "bench_planner", "planner bench", 300.0),
@@ -2332,6 +2553,13 @@ _NAMED = {
 if __name__ == "__main__":
     if len(sys.argv) > 1:
         name = sys.argv[1]
+        if name == "_shard-worker" and len(sys.argv) == 3:
+            # internal: one shard-scaling bench replica (see
+            # bench_shard_scaling); speaks the READY/go/RESULT line
+            # protocol with the parent over stdio
+            result = _shard_worker(json.loads(sys.argv[2]))
+            print("RESULT " + json.dumps(result), flush=True)
+            sys.exit(0)
         if name == "report" and len(sys.argv) == 2:
             # not a bench: renders docs/benchmarks.md from artifacts
             print(bench_report(), end="")

@@ -129,3 +129,89 @@ def test_partition_and_heal_round_trip():
     assert q.empty(), "a partitioned stream must go silent"
     store.heal_watch()
     assert q.get(timeout=2).type == WATCH_ERROR
+
+
+# ---------------------------------------------------------------------------
+# per-lease-name targeting (ISSUE 8 satellite): storm ONE object's
+# lease while its siblings stay healthy, deterministically
+# ---------------------------------------------------------------------------
+
+def drive_named(chaos, name, op="update", kind="Lease", n=200):
+    outcomes = []
+    for _ in range(n):
+        try:
+            chaos.check(op, kind, name)
+            outcomes.append("ok")
+        except Exception as e:
+            outcomes.append(type(e).__name__)
+    return outcomes
+
+
+def test_named_conflict_storm_targets_one_lease_only():
+    chaos = KubeChaos(seed=SEED)
+    chaos.set_conflict_rate(0.5, kind="Lease", name="agac-shard-2")
+    stormed = drive_named(chaos, "agac-shard-2")
+    healthy = drive_named(chaos, "agac-shard-1")
+    assert "ConflictError" in stormed and "ok" in stormed
+    assert all(o == "ok" for o in healthy), \
+        "a named storm leaked onto a sibling lease"
+    assert chaos.injected_counts()[
+        "Lease/agac-shard-2:update"] == stormed.count("ConflictError")
+    # clearing by name clears only that target
+    chaos.set_conflict_rate(0.0, kind="Lease", name="agac-shard-2")
+    assert all(o == "ok" for o in drive_named(chaos, "agac-shard-2"))
+
+
+def test_named_error_rate_targets_and_overrides_kind_wide():
+    chaos = KubeChaos(seed=SEED)
+    chaos.set_error_rate("get", 1.0, kind="Lease", name="shard-3")
+    with pytest.raises(RuntimeError):
+        chaos.check("get", "Lease", "shard-3")
+    chaos.check("get", "Lease", "shard-4")      # sibling untouched
+    chaos.check("get", "Lease")                 # nameless untouched
+    # the named rule wins over a kind-wide one for its target
+    chaos.set_error_rate("get", 0.0, kind="Lease", name="shard-3")
+    chaos.set_error_rate("get", 1.0, kind="Lease")
+    chaos.set_error_rate("get", 0.0, kind="Lease", name="shard-3")
+    with pytest.raises(RuntimeError):
+        chaos.check("get", "Lease", "shard-4")  # kind-wide still on
+
+
+def test_named_schedules_are_deterministic_and_independent():
+    """The seeded-decision contract per target: a named rule draws
+    from its OWN per-(seed, kind/name:op, index) stream — the same
+    seed reproduces it exactly, and arming a second lease's storm
+    does not perturb the first's schedule."""
+    a = KubeChaos(seed=SEED)
+    a.set_conflict_rate(0.3, kind="Lease", name="shard-0")
+    solo = drive_named(a, "shard-0")
+
+    b = KubeChaos(seed=SEED)
+    b.set_conflict_rate(0.3, kind="Lease", name="shard-0")
+    b.set_conflict_rate(0.7, kind="Lease", name="shard-5")
+    interleaved = []
+    for i in range(200):
+        try:
+            b.check("update", "Lease", "shard-0")
+            interleaved.append("ok")
+        except ConflictError:
+            interleaved.append("ConflictError")
+        # a sibling's stormed call between every probe
+        try:
+            b.check("update", "Lease", "shard-5")
+        except ConflictError:
+            pass
+    assert interleaved == solo, \
+        "a sibling's named storm perturbed this lease's schedule"
+
+
+def test_name_targeting_requires_concrete_kind():
+    chaos = KubeChaos(seed=SEED)
+    with pytest.raises(ValueError):
+        chaos.set_error_rate("update", 0.5, kind="*", name="x")
+
+
+def test_name_targeted_conflict_rate_requires_concrete_kind():
+    chaos = KubeChaos(seed=SEED)
+    with pytest.raises(ValueError):
+        chaos.set_conflict_rate(0.5, name="x")

@@ -1,0 +1,394 @@
+"""Sharded-ownership chaos e2e (ISSUE 8 tentpole): N replicas split S
+shards under kube-plane chaos; a shard owner is KILLED mid-create-storm
+(its leases expire and the survivors absorb its shards) and another
+leaves GRACEFULLY (fenced handoff) — and the shared per-identity write
+log proves, per shard and per fencing token, that a deposed owner's
+last write strictly precedes its successor's first, with zero
+duplicate accelerators and zero lost/orphaned records after every
+rebalance.
+
+The write recorder stamps each successful AWS mutation with the
+dispatching thread's governing shard (sharding.current_route_shard —
+set by the reconcile dispatch's route guard, which also covers the
+coalescer's leader-flush threads) and that shard's CURRENT fencing
+token, so cross-term interleavings are visible as token inversions in
+the time-sorted log.
+"""
+import threading
+import time
+
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    ROUTE53_HOSTNAME_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.api import (
+    AWSAPIs,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
+    FakeCloudFactory,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.fake import (
+    FakeAWSCloud,
+)
+from aws_global_accelerator_controller_tpu.kube.apiserver import (
+    FakeAPIServer,
+)
+from aws_global_accelerator_controller_tpu.kube.client import (
+    KubeClient,
+    OperatorClient,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+from aws_global_accelerator_controller_tpu.leaderelection.shards import (
+    ShardLeaseManager,
+)
+from aws_global_accelerator_controller_tpu.manager import (
+    ControllerConfig,
+    Manager,
+)
+from aws_global_accelerator_controller_tpu.controller.endpointgroupbinding import (  # noqa: E501
+    EndpointGroupBindingConfig,
+)
+from aws_global_accelerator_controller_tpu.controller.globalaccelerator import (  # noqa: E501
+    GlobalAcceleratorConfig,
+)
+from aws_global_accelerator_controller_tpu.controller.route53 import (
+    Route53Config,
+)
+from aws_global_accelerator_controller_tpu.sharding import (
+    current_route_shard,
+    shard_of,
+)
+
+from harness import CLUSTER, wait_until
+
+SEED = 20260804
+REGION = "ap-northeast-1"
+S = 4
+LEASE_NAME = "agac-shards"
+
+_MUTATOR_PREFIXES = ("create_", "update_", "delete_", "change_",
+                     "add_", "remove_", "tag_")
+
+
+def nlb_hostname(name):
+    return f"{name}-0123456789abcdef.elb.{REGION}.amazonaws.com"
+
+
+def managed_service(name, dns_hostname):
+    return Service(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            annotations={
+                AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                ROUTE53_HOSTNAME_ANNOTATION: dns_hostname,
+            }),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=nlb_hostname(name))])),
+    )
+
+
+class _RecordingService:
+    """Wraps one fake service; each SUCCESSFUL state-changing call
+    appends (monotonic time, identity, shard, fencing token, method)
+    to the shared log.  Shard + token come from the calling thread's
+    route context — the same thread the write's authority (the shard
+    fence) belongs to."""
+
+    def __init__(self, inner, identity, holder, log, lock):
+        self._inner = inner
+        self._identity = identity
+        self._holder = holder        # {"shards": ShardSet} post-build
+        self._log = log
+        self._loglock = lock
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if not callable(attr) or not name.startswith(_MUTATOR_PREFIXES):
+            return attr
+
+        def call(*args, **kwargs):
+            result = attr(*args, **kwargs)
+            sid = current_route_shard()
+            token = -1
+            shards = self._holder.get("shards")
+            if sid is not None and shards is not None:
+                token = shards.fence(sid).token
+            with self._loglock:
+                self._log.append((time.monotonic(), self._identity,
+                                  sid, token, name))
+            return result
+
+        return call
+
+
+class _SwitchableKube:
+    """A KubeClient front that can be 'killed' (every lease call then
+    fails like a dead apiserver) — the crash lever for one replica's
+    lease loop."""
+
+    class _Dead:
+        def __getattr__(self, _):
+            raise OSError("chaos: apiserver unreachable (killed)")
+
+    def __init__(self, real):
+        self._real = real
+        self.dead = False
+
+    @property
+    def leases(self):
+        if self.dead:
+            return self._Dead()
+        return self._real.leases
+
+
+def _replica(name, api, cloud, log, loglock, stop):
+    """One sharded controller replica: manager running from birth (the
+    read plane is shared), write authority governed per shard by its
+    ShardLeaseManager."""
+    kube = KubeClient(api)
+    operator = OperatorClient(api)
+    holder = {}
+    bundle = AWSAPIs(
+        elb=_RecordingService(cloud.elb, name, holder, log, loglock),
+        ga=_RecordingService(cloud.ga, name, holder, log, loglock),
+        route53=_RecordingService(cloud.route53, name, holder, log,
+                                  loglock))
+    factory = FakeCloudFactory(cloud=bundle, num_shards=S)
+    holder["shards"] = factory.shards
+    factory.shards.set_managed()
+    config = ControllerConfig(
+        global_accelerator=GlobalAcceleratorConfig(
+            workers=2, cluster_name=CLUSTER, queue_qps=10000.0,
+            queue_burst=10000),
+        route53=Route53Config(workers=2, cluster_name=CLUSTER,
+                              queue_qps=10000.0, queue_burst=10000),
+        endpoint_group_binding=EndpointGroupBindingConfig(
+            workers=2, queue_qps=10000.0, queue_burst=10000))
+    mgr_stop = threading.Event()
+    handle = Manager().run(kube, operator, factory, config, mgr_stop,
+                           block=False)
+    switch = _SwitchableKube(KubeClient(api))
+    slm = ShardLeaseManager(
+        LEASE_NAME, "default", switch, factory.shards, identity=name,
+        lease_duration=1.0, renew_deadline=0.6, retry_period=0.05,
+        handoff_drain_timeout=1.0, drain=factory.drain_shard)
+    thread = slm.start_background(stop)
+    return {"name": name, "factory": factory, "handle": handle,
+            "mgr_stop": mgr_stop, "slm": slm, "slm_thread": thread,
+            "kube_switch": switch, "kube": kube}
+
+
+def _owned(replicas):
+    return {r["name"]: r["factory"].shards.owned_shards()
+            for r in replicas}
+
+
+def _partitioned(replicas, expect=S):
+    """Every shard owned exactly once — and every replica carrying at
+    least one (the rendezvous map over these identities assigns each
+    a non-empty slice; waiting for it means the rebalance actually
+    happened, not just the first ticker grabbing everything)."""
+    owned = list(_owned(replicas).values())
+    union = set().union(*owned) if owned else set()
+    total = sum(len(o) for o in owned)
+    return (len(union) == expect and total == expect
+            and all(o for o in owned))
+
+
+def test_shard_owner_kill_and_graceful_leave_under_kube_chaos(
+        race_detectors):
+    n = 24
+    extra = 8
+    api = FakeAPIServer()
+    chaos = api.arm_chaos(seed=SEED)
+    cloud = FakeAWSCloud()
+    zone = cloud.route53.create_hosted_zone("example.com")
+    kube = KubeClient(api)
+    for i in range(n + extra):
+        cloud.elb.register_load_balancer(f"svc-sh{i:02d}",
+                                         nlb_hostname(f"svc-sh{i:02d}"),
+                                         REGION)
+
+    log, loglock = [], threading.Lock()
+    stops = {name: threading.Event() for name in ("A", "B", "C")}
+    replicas = [_replica(name, api, cloud, log, loglock, stops[name])
+                for name in ("A", "B", "C")]
+    a, b, c = replicas
+    try:
+        wait_until(lambda: _partitioned(replicas), timeout=30.0,
+                   message="three replicas split the shard map")
+
+        # 20% kube-plane chaos + a targeted conflict storm on ONE
+        # shard's lease (kube/chaos.py per-lease-name targeting): that
+        # shard's renews/acquires fight injected CAS conflicts while
+        # its siblings stay healthy
+        chaos.set_error_rate("update", 0.2)
+        chaos.set_error_rate("list", 0.2)
+        chaos.set_error_rate("create", 0.2, kind="Event")
+        chaos.set_conflict_rate(0.2, kind="Lease")
+        chaos.set_conflict_rate(0.5, kind="Lease",
+                                name=f"{LEASE_NAME}-shard-1")
+        chaos.set_watch_drop_rate(0.02)
+
+        for i in range(n):
+            kube.services.create(
+                managed_service(f"svc-sh{i:02d}",
+                                f"sh{i}.example.com"))
+        wait_until(lambda: len(cloud.ga.list_accelerators()) >= n // 4,
+                   timeout=60.0, message="create storm under way")
+
+        def wrote(identity):
+            with loglock:
+                return any(who == identity
+                           for _, who, _, _, _ in log)
+
+        # the kill must catch C mid-work, or it proves nothing
+        wait_until(lambda: wrote("C"), timeout=60.0,
+                   message="the doomed replica wrote under its "
+                           "own terms")
+
+        # KILL replica C mid-storm: apiserver path cut (its leases
+        # expire; it must seal within its renew deadline) and its
+        # manager abruptly stopped — no drain, no graceful anything
+        c["kube_switch"].dead = True
+        c["mgr_stop"].set()
+        wait_until(lambda: _partitioned([a, b]), timeout=30.0,
+                   message="survivors absorbed the killed "
+                           "replica's shards")
+        for sid in range(S):
+            if not (a["factory"].shards.owns(sid)
+                    or b["factory"].shards.owns(sid)):
+                continue
+            if c["factory"].shards.fence(sid).token >= 0:
+                # every shard C lost is sealed on C — no straggler
+                # write can land under its dead authority
+                assert not c["factory"].shards.owns(sid)
+
+        # successor-only work: a second batch landing after the kill
+        for i in range(n, n + extra):
+            kube.services.create(
+                managed_service(f"svc-sh{i:02d}",
+                                f"sh{i}.example.com"))
+        total = n + extra
+        wait_until(
+            lambda: len(cloud.ga.list_accelerators()) == total
+            and all(len(cloud.ga.list_listeners(x.accelerator_arn)) == 1
+                    for x in cloud.ga.list_accelerators()),
+            timeout=120.0, message="survivors converged the fleet")
+
+        # GRACEFUL leave: B's lease loop stops — trip → drain → seal →
+        # release per held shard — and A absorbs everything
+        stops["B"].set()
+        b["slm_thread"].join(timeout=15.0)
+        wait_until(lambda: _partitioned([a]), timeout=30.0,
+                   message="A absorbed B's shards after the "
+                           "graceful leave")
+        b["mgr_stop"].set()
+
+        # quiesce, then lift the chaos for the final assertions
+        chaos.set_error_rate("update", 0.0)
+        chaos.set_error_rate("list", 0.0)
+        chaos.set_error_rate("create", 0.0, kind="Event")
+        chaos.set_conflict_rate(0.0, kind="Lease")
+        chaos.set_conflict_rate(0.0, kind="Lease",
+                                name=f"{LEASE_NAME}-shard-1")
+        chaos.set_watch_drop_rate(0.0)
+        wait_until(
+            lambda: len(cloud.ga.list_accelerators()) == total,
+            timeout=60.0, message="fleet stable after rebalances")
+        time.sleep(1.0)
+
+        # ------------------------------------------------------------
+        # zero duplicates: exactly one accelerator chain per service
+        # ------------------------------------------------------------
+        accels = cloud.ga.list_accelerators()
+        assert len(accels) == total, \
+            f"duplicate creates across rebalances: {len(accels)}"
+        provider = a["factory"].global_provider()
+        for i in range(total):
+            got = provider.list_global_accelerator_by_resource(
+                CLUSTER, "service", "default", f"svc-sh{i:02d}")
+            assert len(got) == 1, f"svc-sh{i:02d}: {len(got)} chains"
+
+        # zero lost/orphaned records: exactly one A + one TXT per
+        # hostname, nothing else in the zone
+        def records():
+            return sorted(
+                (r.name, r.type) for r in
+                cloud.route53.list_resource_record_sets(zone.id))
+
+        expected = sorted(
+            (f"sh{i}.example.com.", t)
+            for i in range(total) for t in ("A", "TXT"))
+        wait_until(lambda: records() == expected, timeout=60.0,
+                   message="record set exact (no dupes, no orphans)")
+
+        # ------------------------------------------------------------
+        # the write log: per shard, fencing tokens order the terms —
+        # a deposed owner's last write strictly precedes its
+        # successor's first (seal-before-successor, per shard)
+        # ------------------------------------------------------------
+        with loglock:
+            entries = sorted(log)
+        assert entries, "nobody wrote — the chaos proved nothing"
+        by_shard = {}
+        for t, who, sid, token, method in entries:
+            assert sid is not None and sid >= 0, \
+                f"unrouted write {method} by {who}"
+            by_shard.setdefault(sid, []).append((t, who, token))
+        # the storm's keys cover every shard, so every shard's
+        # ordering claim is actually exercised
+        key_shards = {shard_of(f"default/svc-sh{i:02d}", S)
+                      for i in range(total)}
+        assert set(by_shard) >= key_shards
+
+        c_wrote = any(who == "C" for _, who, _, _, _ in entries)
+        assert c_wrote, "the killed replica never wrote — the kill " \
+                        "proved nothing"
+        for sid, writes in by_shard.items():
+            tokens = [tok for _, _, tok in writes]   # time-sorted
+            assert tokens == sorted(tokens), (
+                f"shard {sid}: a lower-term write landed AFTER a "
+                f"higher term's — cross-term interleaving")
+            # one identity per term: a fencing token is one replica's
+            # authority, never shared
+            term_owner = {}
+            for _, who, tok in writes:
+                term_owner.setdefault(tok, who)
+                assert term_owner[tok] == who, (
+                    f"shard {sid} token {tok} written by both "
+                    f"{term_owner[tok]} and {who}")
+            # explicit deposed-before-successor: every earlier term's
+            # last write precedes every later term's first
+            by_token = {}
+            for t, who, tok in writes:
+                by_token.setdefault(tok, []).append(t)
+            toks = sorted(by_token)
+            for lo, hi in zip(toks, toks[1:]):
+                assert max(by_token[lo]) < min(by_token[hi]), (
+                    f"shard {sid}: term {lo}'s last write did not "
+                    f"precede term {hi}'s first")
+        # at least one shard actually changed hands with writes on
+        # both sides (the ordering assertions had teeth)
+        assert any(len({who for _, who, _ in writes}) >= 2
+                   for writes in by_shard.values()), \
+            "no shard had writes from two owners; rebalance untested"
+    finally:
+        for ev in stops.values():
+            ev.set()
+        for r in replicas:
+            r["mgr_stop"].set()
+        for r in replicas:
+            r["slm_thread"].join(timeout=10.0)

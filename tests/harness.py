@@ -40,7 +40,7 @@ class Cluster:
                  queue_burst: int = 100, weight_policy: str = "static",
                  policy_checkpoint: str = "", resilience=None,
                  fault_seed=None, coalesce=None, fingerprints=None,
-                 api=None, cloud=None):
+                 api=None, cloud=None, num_shards: int = 1):
         from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
             FingerprintConfig,
         )
@@ -55,7 +55,8 @@ class Cluster:
                                         resilience=resilience,
                                         fault_seed=fault_seed,
                                         coalesce=coalesce,
-                                        cloud=cloud)
+                                        cloud=cloud,
+                                        num_shards=num_shards)
         self.cloud = self.factory.cloud
         self.stop = threading.Event()
         self._manager = Manager(resync_period=resync_period)

@@ -1,0 +1,35 @@
+"""L110 fixture: mutation paths that DO pass through the
+shard-ownership assertion — a lexical ``shards.check`` before a bare
+write, an ownership pre-check, a routed-dispatch guard, and a write
+routed through ``apis`` (gated at the ShardedCoalescer submit /
+routed dispatch at runtime) — all clean under L110.  The bare writes
+waive L105/L108 explicitly: this fixture isolates the shard rule."""
+
+
+class Writer:
+    def __init__(self, apis, inner, shards, fence):
+        self.apis = apis
+        self.inner = inner
+        self.shards = shards
+        self.fence = fence
+
+    def write_checked(self, arn):
+        self.shards.check(arn, surface="provider")
+        self.fence.check("writer")
+        self.inner.ga.delete_accelerator(arn)  # noqa: L105
+
+    def write_owned(self, arn):
+        if not self.shards.owns_key(arn):
+            return
+        self.fence.check("writer")
+        self.inner.ga.update_accelerator(arn)  # noqa: L105
+
+    def write_guarded(self, arn):
+        with self.shards.guard(arn):
+            self.fence.check("writer")
+            self.inner.ga.delete_accelerator(arn)  # noqa: L105
+
+    def write_wrapped(self, arn):
+        # through apis: the routed dispatch's guard + the sharded
+        # coalescer's submit gate cover this at runtime
+        self.apis.ga.delete_accelerator(arn)

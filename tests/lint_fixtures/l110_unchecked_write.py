@@ -1,0 +1,19 @@
+"""L110 fixture: bare AWS writes with no shard-ownership assertion in
+the enclosing function — each must fire (they waive L105/L108
+explicitly: this fixture isolates the shard rule); the deliberate call
+at the bottom is waived."""
+
+
+def issue_writes(cloud, fence):
+    fence.check("fixture")
+    cloud.ga.update_accelerator("arn", enabled=False)  # noqa: L105, L108
+    cloud.ga.add_endpoints("arn", "lb", False, 10)  # noqa: L105, L108
+
+
+def teardown(cloud, fence):
+    fence.check("fixture")
+    cloud.ga.delete_accelerator("arn")  # noqa: L105, L108
+
+
+def deliberate(cloud):
+    cloud.ga.delete_accelerator("arn")  # race: teardown helper, process exiting

@@ -195,6 +195,37 @@ def test_bench_mixed_soak_smoke(monkeypatch, tmp_path):
     assert "slo_ok" in entries[-1]
 
 
+def test_bench_shard_scaling_smoke(monkeypatch, tmp_path):
+    """Small-N run of the shard scale-out A/B (ISSUE 8): 1 vs 2 real
+    worker processes over the real key partition — both legs converge
+    their slices, the speedups are computed, and the tagged history
+    record lands (with the scaled-down note).  The ≥3x acceptance
+    bar belongs to the full ``bench.py shard-scaling`` run at 4
+    shards; small-N asserts the machinery, loosely."""
+    path = tmp_path / "hist.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(path))
+    out = bench.bench_shard_scaling(n_services=24, shard_counts=(1, 2),
+                                    workers=2, call_latency=0.004,
+                                    steady_rounds=1, record=True)
+    one, two = out["legs"]
+    assert one["shards"] == 1 and two["shards"] == 2
+    assert one["per_shard"] == [(0, 24)] or one["per_shard"] == [[0, 24]]
+    assert sum(n for _, n in two["per_shard"]) == 24
+    assert one["storm_throughput"] > 0
+    assert two["storm_throughput"] > 0
+    assert one["steady_verifies_per_s"] > 0
+    # concurrent shard processes must not be SLOWER than one (the
+    # full-size run asserts the real >=3x at 4 shards)
+    assert out["storm_speedup"] > 1.0, out
+    assert out["steady_speedup"] > 1.0, out
+    entries = [json.loads(line) for line in path.read_text().splitlines()]
+    assert entries[-1]["bench"] == "shard-scaling"
+    assert entries[-1]["shards"] == 2
+    assert "storm_speedup" in entries[-1]
+    assert "note" in entries[-1], \
+        "the scaled-down-services note must ride the recorded entry"
+
+
 @pytest.mark.slow
 def test_bench_mixed_soak_full_slo():
     """The full soak leg (marked slow; the acceptance gate): 1000
@@ -208,9 +239,10 @@ def test_bench_mixed_soak_full_slo():
 
 
 def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
-    """batch-efficiency, steady-state, restart-recovery and mixed-soak
-    legs measure other workloads, not the floor's pure create storm:
-    their (lower) throughputs must not drag the derived floor down."""
+    """batch-efficiency, steady-state, restart-recovery, mixed-soak
+    and shard-scaling legs measure other workloads, not the floor's
+    pure create storm: their (lower) throughputs must not drag the
+    derived floor down."""
     hist = tmp_path / "history.jsonl"
     hist.write_text("".join(
         json.dumps(e) + "\n" for e in (
@@ -221,7 +253,9 @@ def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
             {"throughput": 140.0, "bench": "steady-state"},
             {"throughput": 45.0, "bench": "restart-recovery"},
             {"throughput": 25.0, "bench": "mixed-soak"},
-            {"throughput": 24.0, "bench": "mixed-soak"})))
+            {"throughput": 24.0, "bench": "mixed-soak"},
+            {"throughput": 420.0, "bench": "shard-scaling"},
+            {"throughput": 110.0, "bench": "shard-scaling"})))
     monkeypatch.delenv("RECONCILE_FLOOR_SVC_S", raising=False)
     monkeypatch.setattr(bench.os, "getloadavg", lambda: (0.0, 0, 0))
     got = bench.reconcile_floor(history_path=str(hist))

@@ -67,3 +67,48 @@ def test_controller_demo_converges(tmp_path):
     for m in markers:
         assert m in out
     assert "shutting down" in out
+
+
+def test_controller_shard_flags_validated():
+    """--shards / --shard-id (ISSUE 8): bad values abort before any
+    backend is built."""
+    res = run_cli("controller", "--shards", "0")
+    assert res.returncode != 0
+    assert "--shards" in (res.stderr + res.stdout)
+    res = run_cli("controller", "--shards", "4", "--shard-id", "7")
+    assert res.returncode != 0
+    assert "out of range" in (res.stderr + res.stdout)
+    res = run_cli("controller", "--shards", "4", "--shard-id", "x")
+    assert res.returncode != 0
+    assert "integer or 'auto'" in (res.stderr + res.stdout)
+
+
+def test_controller_demo_converges_sharded(tmp_path):
+    """The demo fleet converges under --shards 4 --shard-id auto: the
+    sharded path (shard-lease manager + per-shard cohorts) drives the
+    real binary end to end, one replica owning every shard."""
+    import signal
+    import time
+
+    log = tmp_path / "demo-sharded.log"
+    with open(log, "w") as out:
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "aws_global_accelerator_controller_tpu",
+             "controller", "--demo", "--smoke", "60",
+             "--shards", "4", "--health-port", "0"],
+            stdout=out, stderr=subprocess.STDOUT)
+        try:
+            deadline = time.monotonic() + 90
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.25)
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                proc.wait(timeout=15)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+    assert proc.returncode == 0, log.read_text()[-2000:]
+    text = log.read_text()
+    assert "smoke: demo fleet converged" in text
+    assert "shard lease manager" in text

@@ -167,14 +167,16 @@ def test_ordered_stop_mid_storm_completes_every_waiter():
             assert calls_later[m] == calls_at_stop.get(m, 0), \
                 f"{m} issued after the ordered stop sealed the fence"
 
-        # no hung coalescer futures: every group idle
+        # no hung coalescer futures: every group idle, across every
+        # shard cohort (batcher.ShardedCoalescer)
         coalescer = cluster.factory._coalescer
         if coalescer is not None:
-            with coalescer._lock:
-                groups = list(coalescer._groups.values())
-            for g in groups:
-                assert not g.pending and not g.flushing, \
-                    "a cohort was left pending after the drain"
+            for cohort in coalescer.cohorts().values():
+                with cohort._lock:
+                    groups = list(cohort._groups.values())
+                for g in groups:
+                    assert not g.pending and not g.flushing, \
+                        "a cohort was left pending after the drain"
     finally:
         cluster.stop.set()
 

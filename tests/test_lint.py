@@ -464,6 +464,52 @@ def test_l109_seeded_raw_enqueue_in_shipped_controller_caught(tmp_path):
     assert findings, "a class-less shipped enqueue was not caught"
 
 
+def test_l110_unchecked_bare_write_fires_and_waiver_suppresses():
+    """Bare AWS writes with no lexical shard-ownership consult fire
+    L110; the ``# race:`` waiver suppresses the deliberate teardown
+    call at the bottom of the fixture."""
+    got = _cfindings("l110_unchecked_write.py")
+    assert [(c, l) for c, l in got if c == "L110"] == [
+        ("L110", 9), ("L110", 10), ("L110", 15)]
+
+
+def test_l110_shard_checked_writes_clean():
+    """A lexical shards.check, an owns_key pre-check, a routed
+    dispatch guard, and a write through ``apis`` are all clean under
+    L110."""
+    assert [x for x in _cfindings("l110_checked_write.py")
+            if x[0] == "L110"] == []
+
+
+def test_l110_seeded_shard_check_strip_from_batcher_caught(tmp_path):
+    """Acceptance probe tied to the shipped code shape: strip the
+    shard-ownership assertion from the REAL ShardedCoalescer submit
+    path and the gate must fire — every coalesced mutation in the
+    tree relies on that one line to keep one writer per endpoint
+    group / hosted zone."""
+    batcher_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/cloudprovider/aws/"
+        "batcher.py")
+    src = batcher_py.read_text()
+    needle = ('        sid = self._shards.check(container_key, '
+              'surface="coalescer")\n')
+    assert src.count(needle) == 1, \
+        "ShardedCoalescer submit-gate shape changed; update this probe"
+    mutated = src.replace(needle, "        sid = 0\n")
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "cloudprovider" / "aws")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "batcher.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L110"]
+    assert findings, "a shard-check-less ShardedCoalescer was not caught"
+
+    # sanity: the unmutated batcher is clean under its own rule
+    assert [x for x in concurrency_lint.lint_files([batcher_py])
+            if x.code == "L110"] == []
+
+
 def test_l108_seeded_fence_strip_from_wrapper_caught(tmp_path):
     """Acceptance probe tied to the shipped code shape: strip the
     fence consult from the REAL ResilientAPIs.invoke and the gate must
@@ -471,9 +517,12 @@ def test_l108_seeded_fence_strip_from_wrapper_caught(tmp_path):
     wrapper_py = pathlib.Path(ROOT_DIR) / (
         "aws_global_accelerator_controller_tpu/resilience/wrapper.py")
     src = wrapper_py.read_text()
-    needle = ("            if self.fence is not None "
-              "and op in MUTATION_METHODS:\n"
-              "                self.fence.check(\"wrapper\")\n")
+    needle = ("            if op in MUTATION_METHODS:\n"
+              "                if self.fence is not None:\n"
+              "                    self.fence.check(\"wrapper\")\n"
+              "                for extra_fence in "
+              "active_write_fences():\n"
+              "                    extra_fence.check(\"wrapper\")\n")
     assert src.count(needle) == 1, \
         "ResilientAPIs.invoke fence-gate shape changed; update this probe"
     mutated = src.replace(needle, "            pass\n")

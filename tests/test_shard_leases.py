@@ -1,0 +1,308 @@
+"""Shard-lease manager (leaderelection/shards.py): membership-driven
+rebalance, fenced graceful handoff, deposal on takeover / renew
+failure, and monotone per-shard fencing tokens.
+
+Tick-driven where possible (no threads, no sleeps): each manager's
+``tick()`` is one full pass — heartbeat, renew, converge toward the
+rendezvous map — so interleavings are scripted, not raced."""
+import threading
+import time
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.kube.apiserver import (
+    FakeAPIServer,
+)
+from aws_global_accelerator_controller_tpu.kube.client import KubeClient
+from aws_global_accelerator_controller_tpu.leaderelection.shards import (
+    ShardLeaseManager,
+)
+from aws_global_accelerator_controller_tpu.resilience import FencedError
+from aws_global_accelerator_controller_tpu.sharding import (
+    ShardSet,
+    compute_assignment,
+)
+
+S = 8
+NAME = "agac-test"
+
+
+def make_manager(api, identity, shards=None, lease_duration=30.0,
+                 renew_deadline=20.0, drain=None, drained=None):
+    shards = shards or ShardSet(S)
+    if drain is None and drained is not None:
+        def drain(sid, timeout):
+            drained.append(sid)
+            return True
+    mgr = ShardLeaseManager(
+        NAME, "default", KubeClient(api), shards, identity=identity,
+        lease_duration=lease_duration, renew_deadline=renew_deadline,
+        retry_period=0.01, handoff_drain_timeout=0.2, drain=drain)
+    mgr.shards.set_managed()
+    return mgr
+
+
+def test_single_replica_acquires_every_shard():
+    api = FakeAPIServer()
+    a = make_manager(api, "replica-a")
+    a.tick()
+    assert a.shards.owned_shards() == set(range(S))
+    # every shard's fence armed for term 0 (fresh leases)
+    for sid in range(S):
+        a.shards.check(f"key-for-{sid}" * (sid + 1))
+
+
+def test_two_replicas_split_along_the_rendezvous_map():
+    api = FakeAPIServer()
+    a = make_manager(api, "replica-a")
+    b = make_manager(api, "replica-b")
+    a.tick()                    # A alone: owns everything
+    b.tick()                    # B heartbeats; A still holds leases
+    a.tick()                    # A sees B, hands off B's shards
+    b.tick()                    # B acquires the released leases
+    want = compute_assignment(S, ["replica-a", "replica-b"])
+    assert a.shards.owned_shards() == {
+        s for s, m in want.items() if m == "replica-a"}
+    assert b.shards.owned_shards() == {
+        s for s, m in want.items() if m == "replica-b"}
+    # disjoint and complete
+    assert a.shards.owned_shards() | b.shards.owned_shards() \
+        == set(range(S))
+    assert not (a.shards.owned_shards() & b.shards.owned_shards())
+
+
+def test_graceful_handoff_drains_and_seals_before_release():
+    api = FakeAPIServer()
+    drained = []
+    a = make_manager(api, "replica-a", drained=drained)
+    b = make_manager(api, "replica-b")
+    a.tick()
+    b.tick()
+    a.tick()
+    moved = set(range(S)) - a.shards.owned_shards()
+    assert moved, "the rendezvous map moved nothing for a join"
+    # the handoff drained exactly the moved shards' cohorts...
+    assert sorted(drained) == sorted(moved)
+    for sid in moved:
+        # ...and sealed their fences: a straggler write on A fails
+        assert a.shards.fence(sid).is_sealed()
+        with pytest.raises((FencedError, Exception)):
+            a.shards.fence(sid).check("straggler")
+        # the lease itself was RELEASED (holder cleared), so B's very
+        # next poll acquires without waiting out the lease duration
+        lease = api.store("Lease").get("default",
+                                       f"{NAME}-shard-{sid}")
+        assert lease.spec.holder_identity in ("", "replica-b")
+
+
+def test_fencing_token_monotone_across_handoff_and_reacquire():
+    api = FakeAPIServer()
+    a = make_manager(api, "replica-a")
+    b = make_manager(api, "replica-b")
+    a.tick()
+    tokens_a = {sid: a.shards.token(sid) for sid in range(S)}
+    b.tick()
+    a.tick()
+    b.tick()
+    for sid in b.shards.owned_shards():
+        # B's term strictly succeeds A's on every handed-off shard
+        assert b.shards.token(sid) > tokens_a[sid]
+    # B leaves; A re-acquires with a still-larger token
+    b_owned = set(b.shards.owned_shards())
+    stop = threading.Event()
+    stop.set()
+    b.run(stop)                 # runs the finally: graceful handoffs
+    # ...and B's graceful exit DELETED its heartbeat lease outright
+    # (member-lease GC contract), so A's very next pass sees only
+    # itself and absorbs everything
+    import pytest as _pytest
+    from aws_global_accelerator_controller_tpu.errors import (
+        NotFoundError,
+    )
+    with _pytest.raises(NotFoundError):
+        api.store("Lease").get("default", f"{NAME}-member-replica-b")
+    a.tick()
+    assert a.shards.owned_shards() == set(range(S))
+    for sid in b_owned:
+        assert a.shards.token(sid) > b.shards.token(sid)
+
+
+def test_deposal_seals_immediately_without_drain():
+    """A holder that wedges past the lease duration is CAS-taken by
+    the rendezvous successor; on its next renew it must seal NOW (no
+    drain — it has no authority to flush under)."""
+    api = FakeAPIServer()
+    drained = []
+    a = make_manager(api, "replica-a", lease_duration=0.2,
+                     renew_deadline=0.1, drained=drained)
+    a.tick()
+    assert a.shards.owned_shards() == set(range(S))
+    drained.clear()
+    time.sleep(0.25)            # every shard lease expires
+    b = make_manager(api, "replica-b", lease_duration=0.2,
+                     renew_deadline=0.1)
+    b.tick()                    # B takes over ITS rendezvous shards
+    taken = b.shards.owned_shards()
+    assert taken, "B took nothing over the expired leases"
+    a.tick()                    # A observes the takeovers
+    for sid in taken:
+        assert not a.shards.owns(sid)
+        assert a.shards.fence(sid).is_sealed()
+        assert b.shards.token(sid) > 0
+    assert not any(sid in drained for sid in taken), \
+        "a deposal must not drain (no authority left to flush under)"
+
+
+def test_renew_deadline_overrun_deposes_self():
+    """A replica whose apiserver path dies must seal its shards before
+    their leases can expire for everyone else."""
+    api = FakeAPIServer()
+    a = make_manager(api, "replica-a", lease_duration=0.4,
+                     renew_deadline=0.15)
+    a.tick()
+    assert a.shards.owned_shards() == set(range(S))
+
+    class _Dead:
+        def __getattr__(self, _):
+            raise OSError("chaos: apiserver unreachable")
+
+    class _DeadKube:
+        leases = _Dead()
+
+    dead = _DeadKube()
+    a.kube = dead
+    a._member.kube = dead
+    for cand in a._candidates.values():
+        cand.kube = dead
+    deadline = time.monotonic() + 5.0
+    while a.shards.owned_shards() and time.monotonic() < deadline:
+        a.tick()
+        time.sleep(0.02)
+    assert a.shards.owned_shards() == set(), \
+        "renew-deadline overrun did not depose"
+    for sid in range(S):
+        assert a.shards.fence(sid).is_sealed()
+
+
+def test_run_loop_background_and_graceful_stop():
+    api = FakeAPIServer()
+    a = make_manager(api, "replica-a")
+    stop = threading.Event()
+    t = a.start_background(stop)
+    deadline = time.monotonic() + 5.0
+    while (a.shards.owned_shards() != set(range(S))
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert a.shards.owned_shards() == set(range(S))
+    stop.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    # stopped gracefully: everything sealed + released
+    assert a.shards.owned_shards() == set()
+    for sid in range(S):
+        lease = api.store("Lease").get("default",
+                                       f"{NAME}-shard-{sid}")
+        assert lease.spec.holder_identity == ""
+
+
+def test_shard_metrics_recorded():
+    from aws_global_accelerator_controller_tpu import metrics
+
+    reg = metrics.default_registry
+    before_acq = reg.counter_value("shard_rebalances_total",
+                                   {"kind": "acquired"})
+    before_handoff = reg.counter_value("shard_rebalances_total",
+                                       {"kind": "handoff"})
+    api = FakeAPIServer()
+    a = make_manager(api, "replica-a")
+    b = make_manager(api, "replica-b")
+    metrics.watch_shard_owner(a.shards)
+    a.tick()
+    assert reg.counter_value("shard_rebalances_total",
+                             {"kind": "acquired"}) - before_acq == S
+    rendered = reg.render()
+    assert 'shard_owner{shard="0"} 1.0' in rendered
+    b.tick()
+    a.tick()
+    assert reg.counter_value("shard_rebalances_total",
+                             {"kind": "handoff"}) - before_handoff \
+        == S - len(a.shards.owned_shards())
+    rendered = reg.render()
+    gone = next(iter(set(range(S)) - a.shards.owned_shards()))
+    assert f'shard_owner{{shard="{gone}"}} 0.0' in rendered
+    assert "shard_handoff_duration_seconds_count" in rendered
+
+
+def test_silent_lease_retake_replays_lost_then_acquired():
+    """The stalled-replica hole (review finding): A stalls long enough
+    for its shard lease to expire, B holds a term and dies, the lease
+    expires again — A's next renew CAS silently re-TAKES it via the
+    expired-holder path.  The transitions jump past A's armed fence
+    token must replay the full lost -> acquired cycle (listeners fire,
+    caches cold-start) instead of resuming over B's writes with
+    pre-stall caches."""
+    api = FakeAPIServer()
+    a = make_manager(api, "replica-a", lease_duration=0.2,
+                     renew_deadline=0.1)
+    events = []
+    a.shards.add_listener(lambda ev, sid: events.append((ev, sid)))
+    a.tick()
+    sid = 0
+    tok_before = a.shards.token(sid)
+    # the stall: A does nothing while its lease expires and an
+    # intervening owner holds (and loses) a term
+    time.sleep(0.25)
+    b = make_manager(api, "replica-b", lease_duration=0.2,
+                     renew_deadline=0.1)
+    b.tick()
+    if not b.shards.owns(sid):
+        # rendezvous gave shard 0 to A even with B alive: take it via
+        # a direct candidate CAS to model "an intervening owner"
+        cand = b._candidates[sid]
+        assert cand.attempt()
+    time.sleep(0.25)            # ...and the intervening term expires
+    events.clear()
+    a.tick()                    # A's renew silently re-takes the lease
+    assert a.shards.owns(sid)
+    assert a.shards.token(sid) > tok_before + 0, \
+        "the re-taken term did not advance the fencing token"
+    assert ("lost", sid) in events and ("acquired", sid) in events, \
+        f"silent re-take skipped the lost->acquired replay: {events}"
+    assert events.index(("lost", sid)) \
+        < events.index(("acquired", sid))
+
+
+def test_member_lease_gc_and_graceful_delete():
+    """Departed replicas' heartbeat leases are cleaned up: a graceful
+    exit deletes its own, and long-expired strays are GC'd during the
+    member list (bounded per tick)."""
+    import pytest as _pytest
+
+    from aws_global_accelerator_controller_tpu.errors import (
+        NotFoundError,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        Lease,
+        LeaseSpec,
+        ObjectMeta,
+    )
+
+    api = FakeAPIServer()
+    # a long-dead stray from a previous pod generation
+    api.store("Lease").create(Lease(
+        metadata=ObjectMeta(name=f"{NAME}-member-ghost",
+                            namespace="default"),
+        spec=LeaseSpec(holder_identity="ghost",
+                       lease_duration_seconds=1,
+                       acquire_time=0.0, renew_time=0.0,
+                       lease_transitions=0)))
+    a = make_manager(api, "replica-a")
+    a.tick()
+    with _pytest.raises(NotFoundError):
+        api.store("Lease").get("default", f"{NAME}-member-ghost")
+    # graceful exit removes our own heartbeat object
+    stop = threading.Event()
+    stop.set()
+    a.run(stop)
+    with _pytest.raises(NotFoundError):
+        api.store("Lease").get("default", f"{NAME}-member-replica-a")

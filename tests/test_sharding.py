@@ -1,0 +1,394 @@
+"""Sharding core: the rendezvous/consistent-hash map math, the
+ShardSet ownership/fence runtime, and an S=2 smoke proving a sharded
+single process behaves like the classic deployment (with the PR-7
+per-class latency accounting intact)."""
+import subprocess
+import sys
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.metrics import default_registry
+from aws_global_accelerator_controller_tpu.resilience import FencedError
+from aws_global_accelerator_controller_tpu.sharding import (
+    ShardNotOwnedError,
+    ShardSet,
+    compute_assignment,
+    current_route_shard,
+    rendezvous_owner,
+    shard_of,
+)
+
+from harness import Cluster, wait_until
+
+
+# ---------------------------------------------------------------------------
+# hashmap math (satellite: rebalance-math test coverage)
+# ---------------------------------------------------------------------------
+
+def test_shard_of_stable_and_spread():
+    keys = [f"default/svc-{i:04d}" for i in range(2000)]
+    S = 8
+    first = [shard_of(k, S) for k in keys]
+    assert first == [shard_of(k, S) for k in keys], "not deterministic"
+    per_shard = [first.count(s) for s in range(S)]
+    assert all(0 <= s < S for s in first)
+    # crc32 is uniform enough that no shard is empty or hogs the fleet
+    assert min(per_shard) > len(keys) / S / 2
+    assert max(per_shard) < len(keys) / S * 2
+    # S=1 degenerates to shard 0 without hashing
+    assert {shard_of(k, 1) for k in keys} == {0}
+
+
+def test_rendezvous_join_moves_about_one_over_n():
+    """Adding a member moves ~1/N of the shards (each shard
+    re-evaluates independently; only those whose max lands on the
+    newcomer migrate) — the property that makes scale-out rebalances
+    cheap."""
+    S = 512
+    members = ["replica-a", "replica-b", "replica-c", "replica-d"]
+    before = compute_assignment(S, members)
+    after = compute_assignment(S, members + ["replica-e"])
+    moved = [s for s in range(S) if before[s] != after[s]]
+    # every moved shard moved TO the newcomer, never between veterans
+    assert all(after[s] == "replica-e" for s in moved)
+    # ~S/5 expected; generous statistical bounds
+    assert S / 5 * 0.5 < len(moved) < S / 5 * 2.0, len(moved)
+
+
+def test_rendezvous_remove_moves_only_dead_members_shards():
+    S = 512
+    members = ["replica-a", "replica-b", "replica-c", "replica-d"]
+    before = compute_assignment(S, members)
+    after = compute_assignment(S, [m for m in members
+                                   if m != "replica-c"])
+    for s in range(S):
+        if before[s] == "replica-c":
+            assert after[s] != "replica-c"
+        else:
+            # a surviving member's shards never move on a leave
+            assert after[s] == before[s]
+
+
+def test_rendezvous_deterministic_across_processes():
+    """Replicas never talk to each other about the map — they must
+    compute the SAME assignment from the same member list, in any
+    process (crc32, not salted hash())."""
+    S, members = 64, ["id-1", "id-2", "id-3"]
+    mine = compute_assignment(S, members)
+    script = (
+        "from aws_global_accelerator_controller_tpu.sharding import "
+        "compute_assignment; "
+        f"print(sorted(compute_assignment({S}, {members!r}).items()))")
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, check=True,
+                         env={"PYTHONPATH": ".", "PATH": "/usr/bin:/bin",
+                              "JAX_PLATFORMS": "cpu"})
+    assert out.stdout.strip() == str(sorted(mine.items()))
+
+
+def test_rendezvous_empty_and_single_member():
+    assert rendezvous_owner(3, []) is None
+    assert rendezvous_owner(3, ["only"]) == "only"
+    assert compute_assignment(4, ["only"]) == {i: "only"
+                                              for i in range(4)}
+
+
+# ---------------------------------------------------------------------------
+# ShardSet runtime
+# ---------------------------------------------------------------------------
+
+def test_standalone_owns_everything():
+    shards = ShardSet(4)
+    assert shards.owned_shards() == {0, 1, 2, 3}
+    # every check passes with fences armed at token 0
+    for key in ("a", "b", "zone-1", "arn:x"):
+        shards.check(key)
+
+
+def test_managed_mode_owns_nothing_until_acquired():
+    shards = ShardSet(4)
+    shards.set_managed()
+    assert shards.owned_shards() == set()
+    with pytest.raises(ShardNotOwnedError):
+        shards.check("some-container")
+    sid = shards.shard_of("some-container")
+    shards.acquire(sid, token=1)
+    shards.check("some-container")          # now owned + armed
+    # other shards still rejected
+    other = next(k for k in ("k0", "k1", "k2", "k3", "k4", "k5")
+                 if shards.shard_of(k) != sid)
+    with pytest.raises(ShardNotOwnedError):
+        shards.check(other)
+
+
+def test_sealed_shard_fence_rejects_even_when_owned():
+    shards = ShardSet(2)
+    sid = shards.shard_of("zone-1")
+    shards.fence(sid).seal("lease lost")
+    with pytest.raises(FencedError):
+        shards.check("zone-1")
+
+
+def test_static_owner_mode():
+    shards = ShardSet(4)
+    shards.set_static_owner(2)
+    assert shards.owned_shards() == {2}
+    assert shards.is_managed()
+
+
+def test_listeners_fire_on_transitions_outside_lock():
+    shards = ShardSet(3)
+    shards.set_managed()
+    events = []
+    shards.add_listener(lambda ev, sid: events.append((ev, sid)))
+    shards.acquire(1, token=1)
+    shards.acquire(1, token=2)   # re-arm while owned: no second event
+    shards.release(1)
+    shards.release(1)            # idempotent: no second event
+    assert events == [("acquired", 1), ("lost", 1)]
+
+
+def test_guard_routes_and_gates():
+    shards = ShardSet(4)
+    shards.set_managed()
+    key = "default/svc-route"
+    sid = shards.shard_of(key)
+    with pytest.raises(ShardNotOwnedError):
+        with shards.guard(key):
+            pass
+    shards.acquire(sid, token=1)
+    assert current_route_shard() is None
+    with shards.guard(key) as got:
+        assert got == sid
+        assert current_route_shard() == sid
+        # a mutation planned inside resolves to the DISPATCH's shard
+        # even when its container key hashes elsewhere
+        assert shards.resolve("arn:some-endpoint-group") == sid
+        shards.check("arn:some-endpoint-group")
+    assert current_route_shard() is None
+
+
+def test_guarded_write_rejected_per_attempt_after_seal():
+    """The wrapper-level contract: a fence pushed by the route guard
+    is consulted per attempt (resilience/fence.py write TLS), so a
+    shard sealed mid-retry rejects the wake-up attempt."""
+    from aws_global_accelerator_controller_tpu.resilience.fence import (
+        active_write_fences,
+    )
+    shards = ShardSet(2)
+    key = "default/svc-x"
+    sid = shards.shard_of(key)
+    with shards.guard(key):
+        (fence,) = active_write_fences()
+        fence.check("wrapper")          # open: passes
+        shards.fence(sid).seal("lease lost mid-retry")
+        with pytest.raises(FencedError):
+            fence.check("wrapper")
+    assert active_write_fences() == ()
+
+
+def test_fence_token_must_stay_monotone_per_shard():
+    shards = ShardSet(2)
+    shards.set_managed()
+    shards.acquire(0, token=3)
+    shards.fence(0).seal("handoff")
+    shards.release(0)
+    with pytest.raises(ValueError):
+        shards.acquire(0, token=3)      # a stale term cannot re-arm
+    shards.acquire(0, token=4)
+    assert shards.token(0) == 4
+
+
+# ---------------------------------------------------------------------------
+# S=2 smoke: the sharded single process behaves like the classic one
+# (satellite: mixed smoke proving PR-7 latency accounting per class)
+# ---------------------------------------------------------------------------
+
+def test_s2_single_process_converges_with_per_class_latency():
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+        ROUTE53_HOSTNAME_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintConfig,
+    )
+
+    reg = default_registry
+    name_hist = "reconcile_latency_seconds"
+
+    def count(klass):
+        return sum(
+            reg.histogram_count(name_hist,
+                                {"controller": c, "class": klass})
+            for c in ("global-accelerator-controller-service",
+                      "route53-controller-service"))
+
+    before = {k: count(k) for k in ("interactive", "background")}
+
+    n = 12
+    region = "ap-northeast-1"
+    # sweep_every=1: every resync wave deep-verifies, so BACKGROUND
+    # syncs succeed (and stamp latency) instead of gate-skipping
+    cluster = Cluster(workers=2, resync_period=0.3,
+                      queue_qps=10000.0, queue_burst=10000,
+                      num_shards=2,
+                      fingerprints=FingerprintConfig(sweep_every=1))
+    try:
+        cluster.cloud.route53.create_hosted_zone("example.com")
+        cluster.start()
+        for i in range(n):
+            svc = f"svc-s2-{i:02d}"
+            hostname = (f"{svc}-0123456789abcdef.elb.{region}"
+                        ".amazonaws.com")
+            cluster.cloud.elb.register_load_balancer(svc, hostname,
+                                                     region)
+            cluster.kube.services.create(Service(
+                metadata=ObjectMeta(
+                    name=svc, namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION:
+                            "true",
+                        ROUTE53_HOSTNAME_ANNOTATION:
+                            f"s2-{i}.example.com",
+                    }),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(
+                    load_balancer=LoadBalancerStatus(ingress=[
+                        LoadBalancerIngress(hostname=hostname)])),
+            ))
+        zone = cluster.cloud.route53.list_hosted_zones()[0]
+        wait_until(
+            lambda: len(cluster.cloud.ga.list_accelerators()) == n
+            and sum(r.type == "A" for r in
+                    cluster.cloud.route53.list_resource_record_sets(
+                        zone.id)) == n,
+            timeout=60.0, message="S=2 fleet converged (chains + DNS)")
+        # both shards actually carried work: keys spread across the
+        # partition and each owned shard built its own write cohort
+        keys = [f"default/svc-s2-{i:02d}" for i in range(n)]
+        assert {cluster.factory.shards.shard_of(k) for k in keys} \
+            == {0, 1}
+        # let a couple of sweep-tier resync waves land (background)
+        wait_until(lambda: count("background")
+                   > before["background"], timeout=30.0,
+                   message="background sweep syncs recorded latency")
+    finally:
+        cluster.shutdown(ordered=True)
+
+    assert count("interactive") > before["interactive"], \
+        "no interactive event->converged latency samples at S=2"
+    assert count("background") > before["background"], \
+        "no background latency samples at S=2 (PR-7 accounting broke)"
+    # exactly-once convergence under the partition
+    accels = cluster.cloud.ga.list_accelerators()
+    assert len(accels) == n
+    # record intents rode per-shard cohorts: one per owned shard
+    cohorts = cluster.factory._coalescer.cohorts()
+    assert set(cohorts) == {0, 1}, \
+        f"expected a cohort per shard, got {set(cohorts)}"
+
+
+def test_unowned_key_dropped_at_dispatch(monkeypatch):
+    """A key whose shard this replica does not own is dropped by the
+    reconcile dispatch without touching the provider (the owner
+    converges it)."""
+    from aws_global_accelerator_controller_tpu.reconcile import (
+        process_next_work_item,
+    )
+    from aws_global_accelerator_controller_tpu.kube.workqueue import (
+        CLASS_INTERACTIVE,
+        RateLimitingQueue,
+    )
+
+    shards = ShardSet(2)
+    shards.set_managed()            # owns nothing
+    q = RateLimitingQueue(name="t")
+    q.add("default/orphan", klass=CLASS_INTERACTIVE)
+    calls = []
+    assert process_next_work_item(
+        q, key_to_obj=lambda k: calls.append(("get", k)),
+        process_delete=lambda k: calls.append(("del", k)),
+        process_create_or_update=lambda o: calls.append(("sync", o)),
+        get_timeout=0.5, shards=shards)
+    assert calls == [], "an unowned key reached the sync path"
+    assert len(q) == 0
+
+
+def test_delete_during_ownership_gap_replayed_on_acquire():
+    """The orphan-teardown hole (review finding): a managed Service
+    DELETED while its shard is unowned is gone from the informer cache
+    by the time a successor acquires, so the acquire cache-scan cannot
+    re-deliver the teardown — the deferred-event gate must."""
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+
+    region = "ap-northeast-1"
+    name = "svc-gap"
+    hostname = f"{name}-0123456789abcdef.elb.{region}.amazonaws.com"
+    cluster = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                      num_shards=2)
+    try:
+        cluster.cloud.elb.register_load_balancer(name, hostname, region)
+        cluster.start()
+        cluster.kube.services.create(Service(
+            metadata=ObjectMeta(
+                name=name, namespace="default",
+                annotations={
+                    AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                }),
+            spec=ServiceSpec(type="LoadBalancer",
+                             ports=[ServicePort(port=80)]),
+            status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(hostname=hostname)])),
+        ))
+        wait_until(
+            lambda: len(cluster.cloud.ga.list_accelerators()) == 1,
+            timeout=30.0, message="service converged")
+
+        # the ownership gap: this replica loses the service's shard
+        shards = cluster.factory.shards
+        sid = shards.shard_of(f"default/{name}")
+        shards.set_managed()        # managed mode: owns nothing now
+        # the DELETE lands during the gap: every handler defers it
+        cluster.kube.services.delete("default", name)
+        wait_until(
+            lambda: cluster.kube.api.store("Service").list() == [],
+            timeout=10.0, message="service gone from the store")
+        import time as time_mod
+        time_mod.sleep(0.3)         # the event propagated and gated
+        assert len(cluster.cloud.ga.list_accelerators()) == 1, \
+            "an unowned replica tore down the accelerator"
+
+        # the successor acquires: the deferred delete replays and the
+        # orphaned accelerator chain is torn down
+        shards.acquire(sid, token=1)
+        wait_until(
+            lambda: len(cluster.cloud.ga.list_accelerators()) == 0,
+            timeout=30.0,
+            message="deferred delete replayed: accelerator torn down")
+    finally:
+        cluster.shutdown(ordered=True)

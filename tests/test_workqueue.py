@@ -472,3 +472,51 @@ def test_native_backoff_sequence_matches_python():
         # slack); no tight upper bound — wall-clock stalls on loaded CI
         # runners would make it flaky
         assert elapsed >= expected - 0.002
+
+
+# ---------------------------------------------------------------------------
+# remove(): the per-shard queue-ownership purge (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+def test_remove_pending_item_never_delivered(q):
+    q.add("keep")
+    q.add("purged")
+    assert q.remove("purged") is True
+    got = set()
+    for _ in range(2):
+        item, _ = q.get(timeout=0.2)
+        if item is None:
+            break
+        got.add(item)
+        q.done(item)
+    assert got == {"keep"}
+    assert q.remove("unknown") is False
+
+
+def test_remove_parked_item_cancels_the_wake(q):
+    q.add_after("parked", 0.05)
+    assert q.remove("parked") is True
+    time.sleep(0.15)
+    item, _ = q.get(timeout=0.05)
+    assert item is None, "a removed parked item was still delivered"
+
+
+def test_remove_processing_item_cancels_requeue_only(q):
+    q.add("held")
+    item, _ = q.get(timeout=1.0)
+    assert item == "held"
+    q.add("held")                   # dirty while processing
+    assert q.remove("held") is True  # cancels the pending re-delivery
+    q.done(item)
+    got, _ = q.get(timeout=0.1)
+    assert got is None, "done() re-queued a removed item"
+
+
+def test_remove_resets_limiter_state(q):
+    for _ in range(6):
+        q.add_rate_limited("flappy")
+        item, _ = q.get(timeout=2.0)
+        q.done(item)
+    assert q.num_requeues("flappy") >= 1
+    q.remove("flappy")
+    assert q.num_requeues("flappy") == 0
