@@ -1,4 +1,4 @@
-"""AST-based concurrency contract lints (rules L101-L110).
+"""AST-based concurrency contract lints (rules L101-L111).
 
 The static half of the concurrency checker: a whole-program pass over
 the tree that enforces the synchronization contracts PR 1 introduced as
@@ -78,6 +78,21 @@ zero-findings gate philosophy):
                          latency/shed contract breaks
                          (kube/workqueue.py tiers).  Package-scoped
                          to controller/ and reconcile/ like L105.
+  L111 compat-shimmed accelerator symbols
+                         Accelerator code (every shipped package
+                         except ``compat/`` itself) must not touch
+                         the version-sensitive ``pltpu.*`` /
+                         ``orbax.*`` surfaces directly — no import of
+                         ``jax.experimental.pallas.tpu`` or
+                         ``orbax``, no attribute access rooted at
+                         ``pltpu``/``orbax``.  Those symbols drift
+                         between releases (``CompilerParams`` vs
+                         ``TPUCompilerParams``, handler names) and a
+                         direct consumer fails as an opaque
+                         AttributeError at trace time; the compat
+                         shim (compat/jaxshim.py, compat/orbaxshim.py)
+                         resolves each symbol once with recorded
+                         provenance and degrades with evidence.
   L108 fenced mutations  Mutation-issuing paths must consult the
                          lifecycle fence (resilience/fence.py): no
                          AWS WRITE method may be reachable after
@@ -237,6 +252,47 @@ def _l109_in_scope(path: Path) -> bool:
 # The enqueue surface rule L109 requires a ``klass=`` keyword on, when
 # the receiver chain names a queue.
 _ENQUEUE_METHODS = {"add", "add_rate_limited", "add_after"}
+
+
+def _l111_in_scope(path: Path) -> bool:
+    """L111 covers every shipped package file EXCEPT the compat shim
+    itself (the one legitimate home of raw ``pltpu.*``/``orbax.*``
+    access), plus the fixture corpus.  Tests and tools may poke the
+    raw modules — probing drift is their job."""
+    parts = path.parts
+    if "lint_fixtures" in parts:
+        return True
+    if "aws_global_accelerator_controller_tpu" not in parts:
+        return False
+    # only the TOP-LEVEL compat/ package is exempt — a nested dir that
+    # happens to be named "compat" (vendored code, a future
+    # kube/compat/) gets no free pass at raw accelerator symbols
+    pkg_idx = parts.index("aws_global_accelerator_controller_tpu")
+    return not (len(parts) > pkg_idx + 1 and parts[pkg_idx + 1] == "compat")
+
+
+# module prefixes whose direct import rule L111 flags outside compat/
+_L111_MODULES = ("jax.experimental.pallas.tpu", "orbax")
+# attribute-chain roots that reach the version-sensitive surface even
+# without a visible import (the seeded-graft shape)
+_L111_ROOTS = {"pltpu", "orbax"}
+# ...and the submodule-through-the-alias shape: `pl.tpu.X` /
+# `pallas.tpu.X` reaches the same drifting surface through the pallas
+# alias every kernel file already imports (the tpu submodule binds
+# onto the package as soon as ANYTHING — e.g. the shim — imports it)
+_L111_ALIAS_ROOTS = {"pl", "pallas"}
+
+
+def _l111_chain(chain: List[str]) -> bool:
+    if len(chain) > 1 and chain[0] in _L111_ROOTS:
+        return True
+    return (len(chain) > 2 and chain[0] in _L111_ALIAS_ROOTS
+            and chain[1] == "tpu")
+
+
+def _l111_module(name: str) -> bool:
+    return any(name == m or name.startswith(m + ".")
+               for m in _L111_MODULES)
 
 
 def _l107_fastpath(path: Path, fn_name: str) -> bool:
@@ -422,6 +478,7 @@ class Engine:
             for classname, fn in self._functions(info.tree):
                 self._walk_held(info, classname, fn, fn.body, [])
                 self._check_shared_views(info, fn)
+            self._check_compat_shim(info)
         self._check_ordering_graph()
         self._check_wrapper_fence_gate()
         self._check_sharded_submit_gate()
@@ -530,6 +587,51 @@ class Engine:
                     "tree relies on this gate to keep one writer per "
                     "endpoint group / hosted zone "
                     "(sharding/shardset.py ShardSet.check)"))
+
+    def _check_compat_shim(self, info: _FileInfo) -> None:
+        """Rule L111: version-sensitive ``pltpu.*``/``orbax.*`` access
+        outside ``compat/``.  Whole-file pass (imports are module
+        statements the per-function walk never visits): flags (a) any
+        import of the drifting modules, and (b) any attribute chain
+        rooted at ``pltpu``/``orbax`` — the grafted-call shape that
+        reaches the raw surface without a visible import."""
+        if not _l111_in_scope(info.path):
+            return
+
+        def flag(line: int, what: str) -> None:
+            self.findings.append(Finding(
+                info.path, line, "L111",
+                f"{what} reaches a version-sensitive accelerator "
+                f"surface directly — these symbols drift between "
+                f"jax/orbax releases and fail as opaque "
+                f"AttributeErrors at trace time; import the resolved "
+                f"name from compat/jaxshim.py / compat/orbaxshim.py "
+                f"(or waive with '# race: <reason>')"))
+
+        flagged_lines: Set[int] = set()
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if _l111_module(alias.name):
+                        flag(node.lineno,
+                             f"import of '{alias.name}'")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level:  # relative import: package-internal
+                    continue
+                if _l111_module(mod):
+                    flag(node.lineno, f"import from '{mod}'")
+                elif mod == "jax.experimental.pallas" and any(
+                        alias.name == "tpu" for alias in node.names):
+                    flag(node.lineno,
+                         "import of 'jax.experimental.pallas.tpu'")
+            elif isinstance(node, ast.Attribute):
+                chain = _attr_chain(node)
+                if (chain and _l111_chain(chain)
+                        and node.lineno not in flagged_lines):
+                    flagged_lines.add(node.lineno)
+                    flag(node.lineno,
+                         f"attribute access '{'.'.join(chain)}'")
 
     def _check_ordering_graph(self) -> None:
         seen: Set[Tuple[str, str]] = set()

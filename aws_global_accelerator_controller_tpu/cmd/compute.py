@@ -253,6 +253,27 @@ def register(sub) -> None:
                            "(see train --sharded).")
 
 
+def _compat_rung() -> str:
+    """Resolve the accelerator degradation rung for this process, as
+    a NAMED CLI error when no rung works.
+
+    Every compute entry point (train/eval/plan) calls this before
+    building a model: an unusable backend surfaces as the capability
+    registry's structured verdict (which probe failed, with the
+    underlying exception) instead of an AttributeError at trace time
+    minutes into a run."""
+    from ..compat import BackendCapabilityError, registry
+
+    try:
+        rung = registry.attention_rung()
+    except BackendCapabilityError as e:
+        raise SystemExit(
+            f"accelerator backend unusable — no degradation rung "
+            f"available (compat/capability.py):\n{e}")
+    logger.info("accelerator compat rung: %s", rung)
+    return rung
+
+
 def _build_model(args):
     """The single model-family dispatch point.
 
@@ -262,6 +283,7 @@ def _build_model(args):
     """
     from ..jaxenv import import_jax
     jax = import_jax()
+    _compat_rung()
 
     lr = getattr(args, "lr", 1e-3)
     sharded = getattr(args, "sharded", False)
@@ -740,9 +762,13 @@ def _run_train_loop(args, jax, stop) -> int:
         if ckpt.latest_step() != step_label:
             ckpt.save(step_label, params, opt_state, wait=True)
         ckpt.close()
+    from ..compat import registry as _compat_registry
     print(json.dumps({"step": step_label, "model": args.model,
                       "loss": float(loss) if loss is not None else None,
                       "backend": jax.default_backend(),
+                      # which degradation rung the kernels actually ran
+                      # on (compat/capability.py ladder)
+                      "rung": _compat_registry.attention_rung(),
                       **({"preempted": True} if preempted else {})}))
     # --preempt-exit lets a k8s Job distinguish "cut short" from
     # "complete": with restartPolicy OnFailure an exit-0 preemption
@@ -878,6 +904,7 @@ def run_eval(args) -> int:
         l1s.append(float(l1))
         u1s.append(float(u1))
 
+    from ..compat import registry as _compat_registry
     out = {
         "model": args.model,
         "step": step,
@@ -886,6 +913,7 @@ def run_eval(args) -> int:
         "plan_l1": round(float(np.mean(l1s)), 6),
         "uniform_l1": round(float(np.mean(u1s)), 6),
         "beats_uniform": bool(np.mean(l1s) < np.mean(u1s)),
+        "rung": _compat_registry.attention_rung(),
     }
     json.dump(out, sys.stdout)
     print()
@@ -929,9 +957,11 @@ def _run_plan(args) -> int:
         params = model.init_params(jax.random.PRNGKey(args.seed))
 
     weights = run_plan_fwd(params, jax.random.PRNGKey(args.seed + 1))
+    from ..compat import registry as _compat_registry
     out = {
         "groups": args.groups,
         "endpoints": args.endpoints,
+        "rung": _compat_registry.attention_rung(),
         # int weights in [0, 255], 0 on padded slots -- the values
         # UpdateEndpointWeight would apply per endpoint
         "weights": [[int(w) for w in row] for row in weights],

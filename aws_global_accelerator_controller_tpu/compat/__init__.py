@@ -1,0 +1,37 @@
+"""Accelerator API-drift compatibility layer (ROADMAP item 3).
+
+The TPU compute track targets jax/pallas/orbax surfaces that drift
+between releases: ``pltpu.CompilerParams`` vs ``TPUCompilerParams``,
+``jax.shard_map`` vs ``jax.experimental.shard_map.shard_map``, orbax's
+no-template restore contract, memory-space enum homes.  Before this
+package, each drift surfaced as an opaque ``AttributeError`` at trace
+time — 150 standing tier-1 failures and every live bench probe
+reporting "backend wedged" since July.
+
+This package gives the accelerator stack the same robustness shape the
+``resilience/`` layer gave AWS calls in PR 3: classify, degrade
+gracefully, never wedge.
+
+- :mod:`.jaxshim` — resolves every version-sensitive jax/pallas symbol
+  ONCE at import and exposes one stable surface.  ``ops/``, ``models/``
+  and ``parallel/`` import from here; no direct ``pltpu.*`` attribute
+  access exists outside this package (lint rule L111).
+- :mod:`.orbaxshim` — the same for orbax checkpoint handler names and
+  restore-call shapes.
+- :mod:`.capability` — probes at first use what the installed backend
+  can actually DO (pallas-TPU lowering, interpret mode, shard_map,
+  async remote copy, orbax save/restore), records structured verdicts,
+  and resolves the degradation ladder pallas-tpu → pallas-interpret →
+  jnp-reference.  :class:`BackendCapabilityError` (with the probe
+  evidence attached) is raised only when no rung works.
+"""
+from __future__ import annotations
+
+from .capability import (
+    RUNG_INTERPRET,
+    RUNG_REFERENCE,
+    RUNG_TPU,
+    BackendCapabilityError,
+    registry,
+)
+from .jaxshim import MissingSymbolError

@@ -1,0 +1,378 @@
+"""Backend capability registry + the accelerator degradation ladder.
+
+The shim (:mod:`.jaxshim`) answers "where does the symbol live"; this
+module answers "does the installed backend actually WORK" — by running
+one tiny probe per capability at first use and caching a structured
+:class:`Verdict` (supported / detail / evidence / provenance).  The
+probes:
+
+==================  =====================================================
+capability          probe
+==================  =====================================================
+``jnp_reference``   a 2x2 jnp matmul executes on the default backend
+``pallas_tpu``      default backend is TPU AND a trivial kernel compiles
+                    through the Mosaic path
+``pallas_interpret``a trivial kernel runs under ``interpret=True``
+``shard_map``       the resolved shard_map executes over a 1-device mesh
+``async_remote_copy`` the RDMA helper resolved in the installed pallas
+                    (execution needs a multi-chip TPU; resolution is the
+                    probe off-chip)
+``orbax``           a save/restore roundtrip through the orbax shim in a
+                    temp dir returns the tree bit-exactly
+==================  =====================================================
+
+Degradation ladder (the accelerator entry points consult it instead of
+``jax.default_backend()``): ``pallas-tpu`` → ``pallas-interpret`` →
+``jnp-reference``.  :func:`CapabilityRegistry.attention_rung` returns
+the first supported rung; when every rung is unsupported (or
+force-disabled) it raises :class:`BackendCapabilityError` carrying the
+verdicts — a classified failure with evidence, never an opaque
+AttributeError at trace time.
+
+Force-disabling for tests / operators: ``AGAC_COMPAT_DISABLE`` (comma
+list of capability names) or :meth:`CapabilityRegistry.disable`.
+``reset()`` restores the probe-everything state.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# ladder rungs, best-first
+RUNG_TPU = "pallas-tpu"
+RUNG_INTERPRET = "pallas-interpret"
+RUNG_REFERENCE = "jnp-reference"
+LADDER: Tuple[str, ...] = (RUNG_TPU, RUNG_INTERPRET, RUNG_REFERENCE)
+
+# rung -> capability that must probe supported for the rung to carry
+_RUNG_NEEDS = {
+    RUNG_TPU: "pallas_tpu",
+    RUNG_INTERPRET: "pallas_interpret",
+    RUNG_REFERENCE: "jnp_reference",
+}
+
+_DISABLE_ENV = "AGAC_COMPAT_DISABLE"
+
+
+@dataclass
+class Verdict:
+    """One capability probe's structured outcome."""
+
+    capability: str
+    supported: bool
+    detail: str
+    #: the failure (type + message) when unsupported, else None
+    evidence: Optional[str] = None
+    #: jaxshim provenance of the symbols the probe exercised
+    resolved_via: Dict[str, Optional[str]] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        out = {"capability": self.capability,
+               "supported": self.supported,
+               "detail": self.detail}
+        if self.evidence:
+            out["evidence"] = self.evidence
+        if self.resolved_via:
+            out["resolved_via"] = self.resolved_via
+        return out
+
+
+class BackendCapabilityError(RuntimeError):
+    """No rung of the degradation ladder works on this backend.
+
+    Carries the per-capability verdicts (``.verdicts``) so the caller
+    — CLI, bench preflight, a test — can report WHICH probe failed and
+    with what underlying exception, instead of an opaque trace-time
+    AttributeError.
+    """
+
+    def __init__(self, msg: str, verdicts: List[Verdict]):
+        self.verdicts = list(verdicts)
+        lines = [msg]
+        for v in self.verdicts:
+            lines.append(f"  - {v.capability}: "
+                         f"{'ok' if v.supported else 'UNSUPPORTED'} "
+                         f"({v.detail}"
+                         f"{'; ' + v.evidence if v.evidence else ''})")
+        super().__init__("\n".join(lines))
+
+
+def _exc_evidence(exc: BaseException) -> str:
+    return f"{type(exc).__name__}: {str(exc)[:300]}"
+
+
+class CapabilityRegistry:
+    """Probe-once cache of backend capability verdicts.
+
+    Probes run lazily (first consult) and never at import: probing
+    initialises the jax backend, and the controller-only CLI paths
+    must never pay for (or hang on) that.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._verdicts: Dict[str, Verdict] = {}
+        self._disabled = self._env_disabled()
+
+    @staticmethod
+    def _env_disabled() -> set:
+        raw = os.environ.get(_DISABLE_ENV, "")
+        return {s.strip() for s in raw.split(",") if s.strip()}
+
+    # -- management ----------------------------------------------------
+
+    def disable(self, *capabilities: str) -> None:
+        """Force capabilities unsupported (ladder tests, operator
+        escape hatch).  Clears cached verdicts for them so the
+        disabled verdict is visible immediately."""
+        with self._lock:
+            for name in capabilities:
+                self._disabled.add(name)
+                self._verdicts.pop(name, None)
+
+    def reset(self) -> None:
+        """Drop every cached verdict and re-read the env disable list
+        (test hook)."""
+        with self._lock:
+            self._verdicts.clear()
+            self._disabled = self._env_disabled()
+
+    # -- probes --------------------------------------------------------
+
+    def verdict(self, capability: str) -> Verdict:
+        with self._lock:
+            got = self._verdicts.get(capability)
+            if got is not None:
+                return got
+        # probe OUTSIDE the lock: a probe compiles / touches disk, and
+        # a concurrent consult of a different capability must not wait
+        # on it.  A racing duplicate probe is idempotent; first write
+        # wins below.
+        if capability in self._disabled:
+            fresh = Verdict(capability, False,
+                            "force-disabled",
+                            evidence=f"disabled via {_DISABLE_ENV} "
+                                     f"or registry.disable()")
+        else:
+            probe = getattr(self, f"_probe_{capability}", None)
+            if probe is None:
+                raise ValueError(f"unknown capability {capability!r}")
+            fresh = self._run_probe(probe)
+        with self._lock:
+            return self._verdicts.setdefault(capability, fresh)
+
+    def supports(self, capability: str) -> bool:
+        return self.verdict(capability).supported
+
+    def report(self) -> dict:
+        """Every capability's verdict (probing any not yet probed) as
+        a JSON-able dict — the bench preflight / CLI diagnostics
+        payload."""
+        names = ("jnp_reference", "pallas_tpu", "pallas_interpret",
+                 "shard_map", "async_remote_copy", "orbax")
+        return {name: self.verdict(name).as_dict() for name in names}
+
+    @staticmethod
+    def _run_probe(probe) -> Verdict:
+        """Execute a probe OUTSIDE any ambient jax trace.
+
+        First consult often happens mid-trace (the kernel dispatch
+        gates run while jit/shard_map is tracing the train step);
+        since omnistaging every jnp op there would stage into the
+        surrounding trace and the probe's ``float(...)`` readback
+        would die with a ConcretizationTypeError.
+        ``ensure_compile_time_eval`` evaluates the probe's tiny
+        programs eagerly regardless of context."""
+        try:
+            import jax
+
+            with jax.ensure_compile_time_eval():
+                return probe()
+        except ImportError:
+            return probe()
+
+    # .. individual probes .............................................
+
+    def _probe_jnp_reference(self) -> Verdict:
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            x = jnp.ones((2, 2))
+            float((x @ x).sum())
+            return Verdict("jnp_reference", True,
+                           f"backend={jax.default_backend()}")
+        except Exception as exc:
+            return Verdict("jnp_reference", False,
+                           "jnp matmul failed",
+                           evidence=_exc_evidence(exc))
+
+    def _tiny_kernel(self, interpret: bool) -> float:
+        import jax
+        import jax.numpy as jnp
+
+        from . import jaxshim
+
+        def k(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        out = jaxshim.pallas_call(
+            k,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            interpret=interpret,
+        )(jnp.ones((8, 128), jnp.float32))
+        return float(out.sum())
+
+    def _pallas_provenance(self) -> Dict[str, Optional[str]]:
+        from . import jaxshim
+
+        rep = jaxshim.resolution_report()
+        return {k: rep.get(k) for k in
+                ("pallas_call", "CompilerParams", "VMEM",
+                 "PrefetchScalarGridSpec")}
+
+    def _probe_pallas_tpu(self) -> Verdict:
+        prov = self._pallas_provenance()
+        try:
+            import jax
+
+            backend = jax.default_backend()
+            if backend != "tpu":
+                return Verdict(
+                    "pallas_tpu", False,
+                    f"default backend is {backend!r}, not tpu",
+                    resolved_via=prov)
+            got = self._tiny_kernel(interpret=False)
+            if got != 2.0 * 8 * 128:
+                return Verdict("pallas_tpu", False,
+                               f"kernel mis-answered ({got})",
+                               resolved_via=prov)
+            return Verdict("pallas_tpu", True,
+                           "mosaic compile + run ok",
+                           resolved_via=prov)
+        except Exception as exc:
+            return Verdict("pallas_tpu", False,
+                           "tpu pallas probe failed",
+                           evidence=_exc_evidence(exc),
+                           resolved_via=prov)
+
+    def _probe_pallas_interpret(self) -> Verdict:
+        prov = self._pallas_provenance()
+        try:
+            got = self._tiny_kernel(interpret=True)
+            if got != 2.0 * 8 * 128:
+                return Verdict("pallas_interpret", False,
+                               f"kernel mis-answered ({got})",
+                               resolved_via=prov)
+            return Verdict("pallas_interpret", True,
+                           "interpret-mode kernel ok",
+                           resolved_via=prov)
+        except Exception as exc:
+            return Verdict("pallas_interpret", False,
+                           "interpret-mode probe failed",
+                           evidence=_exc_evidence(exc),
+                           resolved_via=prov)
+
+    def _probe_shard_map(self) -> Verdict:
+        from . import jaxshim
+
+        prov = {"shard_map":
+                jaxshim.resolution_report().get("shard_map")}
+        try:
+            import numpy as np
+
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            mesh = Mesh(np.array(jax.devices()[:1]), ("_probe",))
+            f = jaxshim.shard_map(lambda a: a * 2, mesh=mesh,
+                                  in_specs=P(), out_specs=P())
+            got = float(f(jnp.ones(())))
+            if got != 2.0:
+                return Verdict("shard_map", False,
+                               f"shard_map mis-answered ({got})",
+                               resolved_via=prov)
+            return Verdict(
+                "shard_map", True,
+                f"resolved at {prov['shard_map']}, 1-device run ok",
+                resolved_via=prov)
+        except Exception as exc:
+            return Verdict("shard_map", False,
+                           "shard_map probe failed",
+                           evidence=_exc_evidence(exc),
+                           resolved_via=prov)
+
+    def _probe_async_remote_copy(self) -> Verdict:
+        from . import jaxshim
+
+        prov = {"make_async_remote_copy":
+                jaxshim.resolution_report().get(
+                    "make_async_remote_copy")}
+        if prov["make_async_remote_copy"] is None:
+            return Verdict(
+                "async_remote_copy", False,
+                "make_async_remote_copy unresolved in installed "
+                "pallas", resolved_via=prov)
+        # executing RDMA needs >= 2 TPU chips; off-chip, symbol
+        # resolution IS the probe (the ring collectives gate on the
+        # pallas_tpu verdict before using it)
+        return Verdict(
+            "async_remote_copy", True,
+            f"resolved at {prov['make_async_remote_copy']} "
+            f"(execution requires multi-chip TPU)",
+            resolved_via=prov)
+
+    def _probe_orbax(self) -> Verdict:
+        from . import orbaxshim
+
+        return orbaxshim.probe_roundtrip()
+
+    # -- the ladder ----------------------------------------------------
+
+    def attention_rung(self) -> str:
+        """First supported rung of pallas-tpu → pallas-interpret →
+        jnp-reference; :class:`BackendCapabilityError` with every
+        rung's verdict when none works."""
+        verdicts = []
+        for rung in LADDER:
+            v = self.verdict(_RUNG_NEEDS[rung])
+            if v.supported:
+                return rung
+            verdicts.append(v)
+        raise BackendCapabilityError(
+            "no accelerator rung available: pallas-tpu, "
+            "pallas-interpret and the jnp reference path all failed "
+            "their probes", verdicts)
+
+    def kernel_rung(self) -> str:
+        """Alias of :meth:`attention_rung` for non-attention kernels —
+        one ladder, one policy."""
+        return self.attention_rung()
+
+    def interpret_mode(self) -> bool:
+        """Should a pallas kernel run interpreted?  True on every rung
+        below pallas-tpu (raises when no rung at all works)."""
+        return self.attention_rung() != RUNG_TPU
+
+    def on_tpu_rung(self) -> bool:
+        """Is the compiled-TPU rung available?  The dispatch gates that
+        used to read ``jax.default_backend() == "tpu"`` consult this:
+        same answer on a healthy TPU, False (instead of a trace-time
+        AttributeError) when the TPU is present but its pallas surface
+        is broken."""
+        return self.supports("pallas_tpu")
+
+
+#: process-wide singleton; tests use ``registry.reset()`` /
+#: ``registry.disable()`` around their scenarios
+registry = CapabilityRegistry()
+
+
+def reset() -> None:
+    registry.reset()

@@ -12,6 +12,10 @@ so a restarted trainer resumes the exact trajectory.
 Restore goes through a template tree (a freshly-initialised
 params/opt_state of the same model config) so dtypes, shapes, and the
 optax NamedTuple structure survive the round-trip bit-exactly.
+
+All orbax access rides the version shim (compat/orbaxshim.py): handler
+names, the no-template restore spelling and restored-array placement
+drift across orbax releases, and the shim owns all three (L111).
 """
 from __future__ import annotations
 
@@ -21,6 +25,7 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..compat import orbaxshim
 from .traffic import Params, TrafficPolicyModel
 
 
@@ -37,18 +42,13 @@ class TrainCheckpointer:
         effects (a typo'd --policy-checkpoint path must not litter an
         empty orbax tree, and a read-only parent must not crash on
         mkdir instead of reporting 'no checkpoint')."""
-        import orbax.checkpoint as ocp
-
-        self._ocp = ocp
-        self._mngr = ocp.CheckpointManager(
-            os.path.abspath(directory),
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=create),
-        )
+        self._mngr = orbaxshim.make_manager(
+            os.path.abspath(directory), max_to_keep=max_to_keep,
+            create=create)
 
     def save(self, step: int, params: Params, opt_state: Any,
              wait: bool = False) -> None:
-        self._mngr.save(step, args=self._ocp.args.StandardSave(
+        self._mngr.save(step, args=orbaxshim.save_args(
             {"params": params, "opt_state": opt_state}))
         if wait:
             self._mngr.wait_until_finished()
@@ -79,10 +79,12 @@ class TrainCheckpointer:
         # array with the sharding recorded at save time (it warns about
         # this path, but it is load-bearing — a sharded trainer's
         # resume gets params AND opt_state back in the mesh layout it
-        # saved, tests/test_checkpoint.py sharded-roundtrip).
+        # saved, tests/test_checkpoint.py sharded-roundtrip).  The shim
+        # re-places host-memory-kind leaves on device (orbax 0.7
+        # restores unannotated templates to unpinned_host, which kills
+        # the donating train step inside XLA).
         abstract = jax.eval_shape(template)
-        restored = self._mngr.restore(
-            step, args=self._ocp.args.StandardRestore(abstract))
+        restored = orbaxshim.restore_tree(self._mngr, step, abstract)
         return step, restored["params"], restored["opt_state"]
 
     def restore_params(self, model: TrafficPolicyModel,
@@ -117,7 +119,7 @@ class TrainCheckpointer:
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {self._mngr.directory}")
-        restored = self._mngr.restore(step)
+        restored = orbaxshim.restore_raw(self._mngr, step)
         raw = restored["params"]
         # abstract template: shapes/dtypes only, no RNG compute or a
         # second params copy in device memory (restore()'s rationale)

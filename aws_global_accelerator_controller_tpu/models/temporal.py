@@ -159,10 +159,11 @@ class TemporalTrafficModel(TrainableModel):
         benchmark shape's S = 128 otherwise exceeds.  Opt-in until
         its compile + win are confirmed on-chip.
         """
+        from ..compat import registry
         use_kernel = (q.shape[0] >= FLASH_MIN_WINDOW
                       and (self.attention == "flash_always"
                            or (self.attention == "flash"
-                               and jax.default_backend() == "tpu")))
+                               and registry.on_tpu_rung())))
         if use_kernel:
             from ..ops import pallas_attention
             s = q.shape[1]
@@ -230,10 +231,11 @@ class TemporalTrafficModel(TrainableModel):
         remat decision — split copies would silently desync (a remat
         that replays the kernel forward, or a dense head that lost
         its checkpoint)."""
+        from ..compat import registry
         return (ndim == 3
                 and (self.head == "fused_always"
                      or (self.head == "fused"
-                         and jax.default_backend() == "tpu")))
+                         and registry.on_tpu_rung())))
 
     def _head(self, params: Params, rep: jax.Array) -> jax.Array:
         """[..., D] attended representation -> [...] float32 score.

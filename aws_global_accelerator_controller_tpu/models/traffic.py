@@ -89,9 +89,10 @@ class TrafficPolicyModel(TrainableModel):
     def forward(self, params: Params, features: jax.Array,
                 mask: jax.Array) -> jax.Array:
         """[G, E, F] + mask -> int32 GA weights [G, E] (see ``serve``)."""
+        from ..compat import registry
         use_fused = (self.serve == "fused"
                      or (self.serve == "auto"
-                         and jax.default_backend() == "tpu"))
+                         and registry.on_tpu_rung()))
         if use_fused:
             from ..ops.pallas_mlp import forward_pallas
 

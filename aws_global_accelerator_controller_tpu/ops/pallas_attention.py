@@ -44,7 +44,14 @@ import os
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from ..compat import RUNG_REFERENCE, RUNG_TPU, registry
+from ..compat.jaxshim import (
+    VMEM,
+    CompilerParams,
+    PrefetchScalarGridSpec,
+    block_spec,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -371,25 +378,25 @@ def _flash(q, k, v, causal, block_q, block_k, interpret):
         tri, h, tp_q // block_q, num_k)
     out = pl.pallas_call(
         kern,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
+        grid_spec=PrefetchScalarGridSpec(
             num_scalar_prefetch=npf, grid=grid,
             in_specs=[
-                pl.BlockSpec((1, block_q, dp), q_map,
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_k, dp), k_map,
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_k, dp), k_map,
-                             memory_space=pltpu.VMEM),
+                block_spec((1, block_q, dp), q_map,
+                           memory_space=VMEM),
+                block_spec((1, block_k, dp), k_map,
+                           memory_space=VMEM),
+                block_spec((1, block_k, dp), k_map,
+                           memory_space=VMEM),
             ],
-            out_specs=pl.BlockSpec((1, block_q, dp), q_map,
-                                   memory_space=pltpu.VMEM),
+            out_specs=block_spec((1, block_q, dp), q_map,
+                                 memory_space=VMEM),
             scratch_shapes=[
-                pltpu.VMEM((block_q, _LANE), jnp.float32),  # run max
-                pltpu.VMEM((block_q, _LANE), jnp.float32),  # run denom
-                pltpu.VMEM((block_q, dp), jnp.float32),     # run out
+                VMEM((block_q, _LANE), jnp.float32),  # run max
+                VMEM((block_q, _LANE), jnp.float32),  # run denom
+                VMEM((block_q, dp), jnp.float32),     # run out
             ]),
         out_shape=jax.ShapeDtypeStruct((h, tp_q, dp), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=dims),
         interpret=interpret,
     )(*extra, qp, kp, vp)
@@ -410,11 +417,36 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     short windows don't pad to full-width tiles, and long sequences get
     large tiles because per-grid-step overhead dominates small blocks
     (see ``_auto_block``).
+
+    Backend dispatch rides the compat degradation ladder: compiled
+    Mosaic on the pallas-tpu rung, interpret mode on pallas-interpret,
+    and the dense [T, T] reference on jnp-reference (no pallas at all
+    — correct, O(T^2) memory, the explicit bottom rung rather than an
+    AttributeError at trace time).
     """
-    interpret = jax.default_backend() != "tpu"
+    rung = registry.attention_rung()
+    if rung == RUNG_REFERENCE:
+        return _dense_reference(q, k, v, causal)
     block_q, block_k = _resolve_blocks(q.shape[0], k.shape[0],
                                        block_q, block_k)
-    return _flash_diff(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_diff(q, k, v, causal, block_q, block_k,
+                       rung != RUNG_TPU)
+
+
+@functools.partial(jax.jit, static_argnames=("causal",))
+def _dense_reference(q, k, v, causal):
+    """[T, H, D] dense softmax attention — the ladder's bottom rung
+    (matches ``parallel.ring_attention.attention_reference``, kept
+    local so ops never imports parallel)."""
+    qf = q.astype(jnp.float32) * q.shape[-1] ** -0.5
+    s = jnp.einsum("qhd,khd->hqk", qf, k.astype(jnp.float32))
+    if causal:
+        t = q.shape[0]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
 
 
 # -- backward (custom VJP) --------------------------------------------------
@@ -812,28 +844,28 @@ def _flash_stats_padded(q, k, v, causal, block_q, block_k, interpret,
         tri, h, tp_q // block_q, num_k)
     return pl.pallas_call(
         kern,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
+        grid_spec=PrefetchScalarGridSpec(
             num_scalar_prefetch=npf, grid=grid,
             in_specs=[
-                pl.BlockSpec((1, block_q, dp), q_map,
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_k, dp), k_map,
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_k, dp), k_map,
-                             memory_space=pltpu.VMEM),
+                block_spec((1, block_q, dp), q_map,
+                           memory_space=VMEM),
+                block_spec((1, block_k, dp), k_map,
+                           memory_space=VMEM),
+                block_spec((1, block_k, dp), k_map,
+                           memory_space=VMEM),
             ],
             out_specs=[
-                pl.BlockSpec((1, block_q, dp), q_map,
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_q, 1), q_map,
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_q, 1), q_map,
-                             memory_space=pltpu.VMEM),
+                block_spec((1, block_q, dp), q_map,
+                           memory_space=VMEM),
+                block_spec((1, block_q, 1), q_map,
+                           memory_space=VMEM),
+                block_spec((1, block_q, 1), q_map,
+                           memory_space=VMEM),
             ],
             scratch_shapes=[
-                pltpu.VMEM((block_q, _LANE), jnp.float32),
-                pltpu.VMEM((block_q, _LANE), jnp.float32),
-                pltpu.VMEM((block_q, dp), jnp.float32),
+                VMEM((block_q, _LANE), jnp.float32),
+                VMEM((block_q, _LANE), jnp.float32),
+                VMEM((block_q, dp), jnp.float32),
             ]),
         out_shape=[
             jax.ShapeDtypeStruct((h, tp_q, dp),
@@ -841,7 +873,7 @@ def _flash_stats_padded(q, k, v, causal, block_q, block_k, interpret,
             jax.ShapeDtypeStruct((h, tp_q, 1), jnp.float32),
             jax.ShapeDtypeStruct((h, tp_q, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=dims),
         interpret=interpret,
     )(*extra, qp, kp, vp)
@@ -878,7 +910,7 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
 
     num_q = tp_q // block_q
     num_k = tp_k // block_k
-    qkv_spec = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    qkv_spec = functools.partial(block_spec, memory_space=VMEM)
     tri = _use_tri(causal, block_q, block_k, tp_q, tp_k)
 
     # fused one-sweep backward: one score recompute (and one exp pass)
@@ -903,7 +935,7 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
                   else (lambda hh, j, i: (hh, 0, 0)))
         dq, dk, dv = pl.pallas_call(
             kern,
-            grid_spec=pltpu.PrefetchScalarGridSpec(
+            grid_spec=PrefetchScalarGridSpec(
                 num_scalar_prefetch=npf, grid=grid,
                 in_specs=[
                     qkv_spec((1, block_q, dp), q_map),
@@ -920,16 +952,16 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
                     qkv_spec((1, block_k, dp), k_map),
                 ],
                 scratch_shapes=[
-                    pltpu.VMEM((tp_q, dp), jnp.float32),
-                    pltpu.VMEM((block_k, dp), jnp.float32),
-                    pltpu.VMEM((block_k, dp), jnp.float32),
+                    VMEM((tp_q, dp), jnp.float32),
+                    VMEM((block_k, dp), jnp.float32),
+                    VMEM((block_k, dp), jnp.float32),
                 ]),
             out_shape=[
                 jax.ShapeDtypeStruct((h, tp_q, dp), q.dtype),
                 jax.ShapeDtypeStruct((h, tp_k, dp), k.dtype),
                 jax.ShapeDtypeStruct((h, tp_k, dp), v.dtype),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=CompilerParams(
                 dimension_semantics=dims,
                 **({"vmem_limit_bytes": _FUSED_BWD_VMEM_LIMIT}
                    if _FUSED_BWD_VMEM_LIMIT else {})),
@@ -944,7 +976,7 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
         tri, h, num_q, num_k)
     dq = pl.pallas_call(
         dq_kern,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
+        grid_spec=PrefetchScalarGridSpec(
             num_scalar_prefetch=npf, grid=grid,
             in_specs=[
                 qkv_spec((1, block_q, dp), q_map),
@@ -956,9 +988,9 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
                 qkv_spec((1, block_q, 1), q_map),
             ],
             out_specs=qkv_spec((1, block_q, dp), q_map),
-            scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)]),
+            scratch_shapes=[VMEM((block_q, dp), jnp.float32)]),
         out_shape=jax.ShapeDtypeStruct((h, tp_q, dp), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=dims),
         interpret=interpret,
     )(*extra, qp, kp, vp, dop, m, l, dvec)
@@ -972,7 +1004,7 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
         tri, h, num_k, num_q, table_fn=_tri_blocks_kv)
     dk, dv = pl.pallas_call(
         dkv_kern,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
+        grid_spec=PrefetchScalarGridSpec(
             num_scalar_prefetch=npf, grid=grid,
             in_specs=[
                 qkv_spec((1, block_q, dp), q_map),
@@ -988,14 +1020,14 @@ def _flash_bwd_padded(q, k, v, o, do, m, l, causal, block_q, block_k,
                 qkv_spec((1, block_k, dp), k_map),
             ],
             scratch_shapes=[
-                pltpu.VMEM((block_k, dp), jnp.float32),
-                pltpu.VMEM((block_k, dp), jnp.float32),
+                VMEM((block_k, dp), jnp.float32),
+                VMEM((block_k, dp), jnp.float32),
             ]),
         out_shape=[
             jax.ShapeDtypeStruct((h, tp_k, dp), k.dtype),
             jax.ShapeDtypeStruct((h, tp_k, dp), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=dims),
         interpret=interpret,
     )(*extra, qp, kp, vp, dop, m, l, dvec)
@@ -1063,8 +1095,32 @@ def flash_attention_stats(q: jax.Array, k: jax.Array, v: jax.Array,
     which is how ``parallel.ring_attention`` (local='flash') folds the
     K/V blocks arriving over the device ring.  ``causal`` here means
     *relative* positions (q index >= k index) — the diagonal-block case.
+
+    Same compat-ladder dispatch as ``flash_attention``; the dense rung
+    computes the identical (o_unnorm, m, l) stats without pallas.
     """
-    interpret = jax.default_backend() != "tpu"
+    rung = registry.attention_rung()
+    if rung == RUNG_REFERENCE:
+        return _dense_reference_stats(q, k, v, causal)
     block_q, block_k = _resolve_blocks(q.shape[1], k.shape[1],
                                        block_q, block_k)
-    return _flash_stats(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_stats(q, k, v, causal, block_q, block_k,
+                        rung != RUNG_TPU)
+
+
+@functools.partial(jax.jit, static_argnames=("causal",))
+def _dense_reference_stats(q, k, v, causal):
+    """Head-major dense attention with merge-ready stats — the
+    jnp-reference rung of ``flash_attention_stats`` (same (o_unnorm,
+    m, l) law the kernel returns)."""
+    qf = q.astype(jnp.float32) * q.shape[-1] ** -0.5
+    s = jnp.einsum("hqd,hkd->hqk", qf, k.astype(jnp.float32))
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])
+        s = jnp.where(mask[None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                            # [H, Tq]
+    e = jnp.exp(s - m[..., None])
+    l = jnp.sum(e, axis=-1)                            # [H, Tq]
+    o = jnp.einsum("hqk,hkd->hqd", e, v.astype(jnp.float32))
+    return o, m, l

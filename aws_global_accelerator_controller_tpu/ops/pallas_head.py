@@ -42,8 +42,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+from ..compat import RUNG_REFERENCE, RUNG_TPU, registry
+from ..compat.jaxshim import VMEM, CompilerParams, block_spec
 from .pallas_attention import _LANE, _pad_axis
 
 _SUBLANE = 8          # f32 second-minor tile granularity (the
@@ -160,21 +161,21 @@ def _fwd(x, w1, b1, w2, b2, interpret):
         _fwd_kernel,
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((bt, sp, dp), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((dp, hp), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((hp,), lambda i: (0,),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((hp, _LANE), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_LANE,), lambda i: (0,),
-                         memory_space=pltpu.VMEM),
+            block_spec((bt, sp, dp), lambda i: (i, 0, 0),
+                       memory_space=VMEM),
+            block_spec((dp, hp), lambda i: (0, 0),
+                       memory_space=VMEM),
+            block_spec((hp,), lambda i: (0,),
+                       memory_space=VMEM),
+            block_spec((hp, _LANE), lambda i: (0, 0),
+                       memory_space=VMEM),
+            block_spec((_LANE,), lambda i: (0,),
+                       memory_space=VMEM),
         ],
-        out_specs=pl.BlockSpec((bt, sp), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=block_spec((bt, sp), lambda i: (i, 0),
+                             memory_space=VMEM),
         out_shape=jax.ShapeDtypeStruct((tp, sp), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(xp, w1p, b1p, w2p, b2p)
@@ -198,28 +199,28 @@ def _bwd(x, w1, b1, w2, b2, ds, interpret):
         _bwd_kernel,
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((bt, sp, dp), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bt * sp, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((dp, hp), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((hp,), lambda i: (0,),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_SUBLANE, hp), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
+            block_spec((bt, sp, dp), lambda i: (i, 0, 0),
+                       memory_space=VMEM),
+            block_spec((bt * sp, 1), lambda i: (i, 0),
+                       memory_space=VMEM),
+            block_spec((dp, hp), lambda i: (0, 0),
+                       memory_space=VMEM),
+            block_spec((hp,), lambda i: (0,),
+                       memory_space=VMEM),
+            block_spec((_SUBLANE, hp), lambda i: (0, 0),
+                       memory_space=VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((bt, sp, dp), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((dp, hp), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_SUBLANE, hp), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((hp, 1), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_SUBLANE, _LANE), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
+            block_spec((bt, sp, dp), lambda i: (i, 0, 0),
+                       memory_space=VMEM),
+            block_spec((dp, hp), lambda i: (0, 0),
+                       memory_space=VMEM),
+            block_spec((_SUBLANE, hp), lambda i: (0, 0),
+                       memory_space=VMEM),
+            block_spec((hp, 1), lambda i: (0, 0),
+                       memory_space=VMEM),
+            block_spec((_SUBLANE, _LANE), lambda i: (0, 0),
+                       memory_space=VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((tp, sp, dp), x.dtype),
@@ -228,7 +229,7 @@ def _bwd(x, w1, b1, w2, b2, ds, interpret):
             jax.ShapeDtypeStruct((hp, 1), jnp.float32),
             jax.ShapeDtypeStruct((_SUBLANE, _LANE), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(xp, ds_flat, w1p, b1p, w2t)
@@ -262,7 +263,12 @@ def score_head(x: jax.Array, w1: jax.Array, b1: jax.Array,
 
     Drop-in for the dense temporal head under sequence supervision;
     differentiable (custom VJP, h recomputed per block — no [T, S, H]
-    ever reaches HBM in either direction).
+    ever reaches HBM in either direction).  Degrades down the compat
+    ladder; the jnp-reference rung is the dense head itself.
     """
-    interpret = jax.default_backend() != "tpu"
-    return _head_diff(x, w1, b1, w2, b2, interpret)
+    rung = registry.kernel_rung()
+    if rung == RUNG_REFERENCE:
+        h = jnp.maximum(x.astype(jnp.bfloat16) @ w1 + b1, 0)
+        return (h @ w2 + b2)[..., 0].astype(jnp.float32)
+    return _head_diff(x, w1, b1, w2, b2,
+                      interpret=rung != RUNG_TPU)

@@ -31,8 +31,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+from ..compat import RUNG_REFERENCE, RUNG_TPU, registry
+from ..compat.jaxshim import VMEM, block_spec
 from .pallas_weights import _BLOCK_G, plan_block
 
 
@@ -87,25 +88,25 @@ def _forward(params, features, mask, interpret):
         _kernel,
         grid=(Gp // _BLOCK_G,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_G, Ep, Fp), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((Fp, Hp), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((Hp,), lambda i: (0,),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((Hp, Hp), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((Hp,), lambda i: (0,),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((Hp, 128), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((128,), lambda i: (0,),
-                         memory_space=pltpu.VMEM),
+            block_spec((_BLOCK_G, Ep, Fp), lambda i: (i, 0, 0),
+                       memory_space=VMEM),
+            block_spec((_BLOCK_G, Ep), lambda i: (i, 0),
+                       memory_space=VMEM),
+            block_spec((Fp, Hp), lambda i: (0, 0),
+                       memory_space=VMEM),
+            block_spec((Hp,), lambda i: (0,),
+                       memory_space=VMEM),
+            block_spec((Hp, Hp), lambda i: (0, 0),
+                       memory_space=VMEM),
+            block_spec((Hp,), lambda i: (0,),
+                       memory_space=VMEM),
+            block_spec((Hp, 128), lambda i: (0, 0),
+                       memory_space=VMEM),
+            block_spec((128,), lambda i: (0,),
+                       memory_space=VMEM),
         ],
-        out_specs=pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=block_spec((_BLOCK_G, Ep), lambda i: (i, 0),
+                             memory_space=VMEM),
         out_shape=jax.ShapeDtypeStruct((Gp, Ep), jnp.int32),
         interpret=interpret,
     )(x, m, w1, b1, w2, b2, w3, b3)
@@ -114,6 +115,25 @@ def _forward(params, features, mask, interpret):
 
 def forward_pallas(params, features, mask) -> jax.Array:
     """Drop-in for TrafficPolicyModel.forward_dense — bit-equal in
-    interpret mode, ±1 weight unit compiled (see module docstring)."""
-    interpret = jax.default_backend() != "tpu"
-    return _forward(params, features, mask, interpret)
+    interpret mode, ±1 weight unit compiled (see module docstring).
+    Degrades down the compat ladder: on the jnp-reference rung the
+    same math runs as plain XLA (the forward_dense formulation)."""
+    rung = registry.kernel_rung()
+    if rung == RUNG_REFERENCE:
+        return _forward_reference(params, features, mask)
+    return _forward(params, features, mask,
+                    interpret=rung != RUNG_TPU)
+
+
+@jax.jit
+def _forward_reference(params, features, mask) -> jax.Array:
+    """The dense-XLA rung: TrafficPolicyModel.forward_dense's math,
+    kept here so the ladder bottoms out without importing models/
+    (ops must stay model-agnostic)."""
+    from .weights import plan_weights
+
+    x = features.astype(jnp.bfloat16)
+    h = jnp.maximum(x @ params["w1"] + params["b1"], 0)
+    h = jnp.maximum(h @ params["w2"] + params["b2"], 0)
+    s = h @ params["w3"] + params["b3"]
+    return plan_weights(s[..., 0].astype(jnp.float32), mask)

@@ -7,7 +7,9 @@ float32 (8, 128) tile, the grid runs over group blocks.
 
 On non-TPU backends ``plan_weights_pallas`` runs the kernel in interpret
 mode so tests exercise the same code path on the CPU mesh (see
-/opt/skills/guides/pallas_guide.md).
+/opt/skills/guides/pallas_guide.md).  Backend dispatch rides the compat
+degradation ladder (compat/capability.py): pallas-tpu → pallas-interpret
+→ the plain ``ops.weights.plan_weights`` reference.
 """
 from __future__ import annotations
 
@@ -16,8 +18,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+from ..compat import RUNG_REFERENCE, RUNG_TPU, registry
+from ..compat.jaxshim import VMEM, block_spec
 from .weights import MAX_WEIGHT
 
 _BLOCK_G = 8  # float32 sublane tile
@@ -62,13 +65,13 @@ def _plan(scores, mask, interpret):
         _kernel,
         grid=(Gp // _BLOCK_G,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
+            block_spec((_BLOCK_G, Ep), lambda i: (i, 0),
+                       memory_space=VMEM),
+            block_spec((_BLOCK_G, Ep), lambda i: (i, 0),
+                       memory_space=VMEM),
         ],
-        out_specs=pl.BlockSpec((_BLOCK_G, Ep), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=block_spec((_BLOCK_G, Ep), lambda i: (i, 0),
+                             memory_space=VMEM),
         out_shape=jax.ShapeDtypeStruct((Gp, Ep), jnp.int32),
         interpret=interpret,
     )(s, m)
@@ -77,5 +80,9 @@ def _plan(scores, mask, interpret):
 
 def plan_weights_pallas(scores: jax.Array, mask: jax.Array) -> jax.Array:
     """Drop-in for ops.weights.plan_weights (temperature 1)."""
-    interpret = jax.default_backend() != "tpu"
-    return _plan(scores, mask, interpret)
+    rung = registry.kernel_rung()
+    if rung == RUNG_REFERENCE:
+        from .weights import plan_weights
+
+        return plan_weights(scores, mask)
+    return _plan(scores, mask, interpret=rung != RUNG_TPU)

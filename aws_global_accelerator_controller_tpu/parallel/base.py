@@ -14,8 +14,37 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.traffic import Batch
+
+
+def opt_state_shardings(model, param_shardings: dict, mesh):
+    """Per-leaf NamedShardings for the model's optimizer state.
+
+    The donating train-step jits used to leave the opt_state's in/out
+    shardings unconstrained; the installed jax crashes inside XLA
+    (aliased input/output size mismatch) when GSPMD then picks an
+    output layout different from the donated input's.  Deriving the
+    shardings structurally pins both sides: adam's mu/nu mirror the
+    param dict, so a state leaf whose tree path ends at a param key
+    (and matches its shape) rides that param's sharding; everything
+    else — step counts, flat_adam's raveled vectors — replicates.
+    """
+    rep = NamedSharding(mesh, P())
+    p_abs = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+    opt_abs = jax.eval_shape(model.init_opt_state, p_abs)
+
+    def place(path, leaf):
+        for entry in reversed(path):
+            key = getattr(entry, "key", None)
+            if key in param_shardings:
+                if tuple(leaf.shape) == tuple(p_abs[key].shape):
+                    return param_shardings[key]
+                break
+        return rep
+
+    return jax.tree_util.tree_map_with_path(place, opt_abs)
 
 
 class SnapshotPlannerMixin:

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat.jaxshim import shard_map
+
 ExpertParams = Dict[str, jax.Array]
 
 
@@ -56,7 +58,7 @@ def make_expert_planner(mesh: Mesh, axis: str = "expert"):
     """
     n = mesh.shape[axis]
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(P(axis, None), P(axis, None, None), P(axis)),
              out_specs=P(axis, None),
              check_vma=False)

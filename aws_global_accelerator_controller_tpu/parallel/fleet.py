@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat.jaxshim import shard_map
+
 from ..ops.diff import EMPTY, membership_diff
 from ..ops.weights import plan_weights
 
@@ -57,7 +59,7 @@ def make_fleet_planner(mesh: Mesh):
     """
     axes = P("data", None)
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(axes, axes, axes, axes),
              out_specs=(axes, axes, axes, P()))
     def planner(desired, current, scores, mask):

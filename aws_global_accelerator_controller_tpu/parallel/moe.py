@@ -35,11 +35,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat.jaxshim import shard_map
+
 from ..models.common import masked_ce_loss
 from ..models.moe import MoETrafficModel, Params, expert_capacity
 from ..models.traffic import Batch
 from ..ops.weights import plan_weights
-from .base import SnapshotPlannerMixin
+from .base import SnapshotPlannerMixin, opt_state_shardings
 
 
 def moe_param_specs(expert_axis: str = "expert") -> dict:
@@ -108,7 +110,7 @@ class ShardedMoEPlanner(SnapshotPlannerMixin):
                    target=NamedSharding(mesh, P(both, None)))
         out_s = NamedSharding(mesh, P(both, None))
 
-        @partial(jax.shard_map, mesh=mesh,
+        @partial(shard_map, mesh=mesh,
                  in_specs=(P(expert_axis, None, None),
                            P(expert_axis, None),
                            P(expert_axis, None, None),
@@ -192,8 +194,9 @@ class ShardedMoEPlanner(SnapshotPlannerMixin):
                 scores(params, features, mask)[0], mask),
             in_shardings=(ps, bs.features, bs.mask),
             out_shardings=out_s)
-        self._step = jax.jit(step, in_shardings=(ps, None, bs),
-                             out_shardings=(ps, None, None),
+        opt_s = opt_state_shardings(model, ps, mesh)
+        self._step = jax.jit(step, in_shardings=(ps, opt_s, bs),
+                             out_shardings=(ps, opt_s, None),
                              donate_argnums=(0, 1))
         self.param_shardings = ps
         self.batch_shardings = bs

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat.jaxshim import shard_map
+
 PipeParams = Dict[str, jax.Array]
 
 
@@ -61,7 +63,7 @@ def make_pipeline(mesh: Mesh, n_microbatches: int, axis: str = "stage"):
     S = mesh.shape[axis]
     M = n_microbatches
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(P(), P(axis, None, None), P(axis, None), P(), P()),
              out_specs=P(),
              check_vma=False)

@@ -30,11 +30,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat.jaxshim import shard_map
+
 from ..models.common import masked_ce_loss
 from ..models.deep import DeepTrafficModel, Params, stage_fn
 from ..models.traffic import Batch
 from ..ops.weights import plan_weights
-from .base import SnapshotPlannerMixin
+from .base import SnapshotPlannerMixin, opt_state_shardings
 
 
 def deep_param_specs(stage_axis: str = "stage") -> dict:
@@ -103,7 +105,7 @@ class ShardedPipelinePlanner(SnapshotPlannerMixin):
         x_spec = P(None, data_axis, None) if data_axis else P()
         out_spec = P(None, data_axis) if data_axis else P()
 
-        @partial(jax.shard_map, mesh=mesh,
+        @partial(shard_map, mesh=mesh,
                  in_specs=(P(), P(stage_axis, None, None),
                            P(stage_axis, None), P(),
                            x_spec),
@@ -192,8 +194,9 @@ class ShardedPipelinePlanner(SnapshotPlannerMixin):
                 scores(params, features), mask),
             in_shardings=(ps, bs.features, bs.mask),
             out_shardings=rep)
-        self._step = jax.jit(step, in_shardings=(ps, None, bs),
-                             out_shardings=(ps, None, None),
+        opt_s = opt_state_shardings(model, ps, mesh)
+        self._step = jax.jit(step, in_shardings=(ps, opt_s, bs),
+                             out_shardings=(ps, opt_s, None),
                              donate_argnums=(0, 1))
         self.param_shardings = ps
         self.batch_shardings = bs

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.temporal import TemporalTrafficModel
 from ..ops.weights import plan_weights
 from ..models.traffic import Batch, TrafficPolicyModel
-from .base import SnapshotPlannerMixin
+from .base import SnapshotPlannerMixin, opt_state_shardings
 from .ring_attention import make_last_attention, make_ring_attention
 
 
@@ -70,13 +70,16 @@ class ShardedTrafficPlanner(SnapshotPlannerMixin):
         def step(params, opt_state, batch):
             return model.train_step(params, opt_state, batch)
 
+        opt_s = opt_state_shardings(model, ps, mesh)
         self._step = jax.jit(
             step,
-            in_shardings=(ps, None, bs),
-            out_shardings=(ps, None, None),
+            in_shardings=(ps, opt_s, bs),
+            out_shardings=(ps, opt_s, None),
             # params/opt_state are consumed and replaced every step:
             # donation lets XLA update Adam state in place instead of
             # allocating + copying 3x param bytes of HBM per step
+            # (opt shardings pinned on BOTH sides — see
+            # base.opt_state_shardings)
             donate_argnums=(0, 1))
         self.param_shardings = ps
         self.batch_shardings = bs
@@ -133,7 +136,8 @@ class ShardedTemporalPlanner:
         data_axis = (data_axes if len(data_axes) > 1
                      else data_axes[0])
         if local is None:
-            on_tpu = jax.default_backend() == "tpu"
+            from ..compat import registry
+            on_tpu = registry.on_tpu_rung()
             want_flash = (model.attention == "flash_always"
                           or (model.attention == "flash" and on_tpu))
             block_len = (window // mesh.shape[seq_axis]) if window else 0
@@ -212,8 +216,12 @@ class ShardedTemporalPlanner:
 
         self._step = jax.jit(
             step,
-            in_shardings=(rep, None, win_s, batch_s),
-            out_shardings=(rep, None, None),
+            # rep broadcasts over the whole opt subtree (params are
+            # replicated here, so adam's moments and count are too);
+            # pinned on both sides for the donation — see
+            # base.opt_state_shardings' rationale
+            in_shardings=(rep, rep, win_s, batch_s),
+            out_shardings=(rep, rep, None),
             donate_argnums=(0, 1))  # in-place param/Adam-state update
 
     def shard_params(self, params):

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat.jaxshim import shard_map
+
 
 def ewma_reference(x: jax.Array, decay: float) -> jax.Array:
     """Unsharded oracle: sum_t decay^(T-1-t) x[t] over axis 0."""
@@ -38,7 +40,7 @@ def make_ring_ewma(mesh: Mesh, decay: float, axis: str = "seq"):
     replicated, equal to :func:`ewma_reference`."""
     n = mesh.shape[axis]
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=P(axis), out_specs=P(),
              check_vma=False)
     def ring(x_local):

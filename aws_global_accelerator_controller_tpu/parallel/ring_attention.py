@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat.jaxshim import shard_map
+
 _NEG_INF = -1e30  # finite stand-in: exp(-1e30 - m) underflows to 0 cleanly
 
 
@@ -324,7 +326,7 @@ def make_ring_attention(mesh: Mesh, axis: str = "seq",
 
     spec = P(axis, head_axis)
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(spec, spec, spec), out_specs=spec,
              check_vma=False)
     def ring(q_local, k_local, v_local):
@@ -533,7 +535,7 @@ def _make_zigzag_ring(mesh: Mesh, axis: str, local: str,
 
     spec = P(axis, head_axis)
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(spec, spec, spec), out_specs=spec,
              check_vma=False)
     def ring(q_local, k_local, v_local):
@@ -559,7 +561,7 @@ def make_last_attention(mesh: Mesh, axis: str = "seq",
     kv_spec = P(axis, head_axis, None)
     q_spec = P(head_axis, None)
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
              check_vma=False)
     def last(q_l, k_l, v_l):

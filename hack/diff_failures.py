@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""Diff the FAILED sets of two pytest logs (the tier-1 workflow gate).
+
+Every PR since the accelerator drift has hand-rolled this comparison:
+run tier-1 on a stashed HEAD, run it on the working tree, and prove
+the failure set did not GROW (pre-existing failures are tolerated;
+new ones are regressions).  This tool is that ritual, scripted:
+
+    # baseline (stash or clean checkout)
+    pytest tests/ -q ... | tee /tmp/base.log
+    # candidate (working tree)
+    pytest tests/ -q ... | tee /tmp/head.log
+    python hack/diff_failures.py /tmp/base.log /tmp/head.log
+
+Parses ``FAILED <nodeid>`` / ``ERROR <nodeid>`` lines (the -q summary
+format; trailing ``- <message>`` stripped), prints the added and
+removed ids, and exits:
+
+    0  no newly-failing tests (fixes alone are fine)
+    1  at least one test fails in the candidate log but not the base
+    2  usage / unreadable or unparsable input
+
+A log with zero FAILED lines is legal (a fully green run); a log that
+does not look like pytest output at all (no summary markers) is
+refused rather than silently treated as green.
+
+Documented in docs/operations.md ("Tier-1 workflow").
+"""
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+from typing import Set, Tuple
+
+# the id is everything up to the " - <message>" separator, NOT \S+:
+# parametrized ids routinely contain spaces ("test[foo 1]") and a
+# \S+ cut would collapse distinct params into one id, letting a new
+# regression hide behind a pre-existing sibling
+_ID_LINE = re.compile(r"^(FAILED|ERROR)\s+(.+?)(?:\s+-\s+.*)?$")
+# evidence the file is a pytest log at all: the final summary line or
+# the short-test-summary header (either survives tee/truncation)
+_PYTEST_MARKERS = re.compile(
+    r"(=+ short test summary info =+"
+    r"|\d+ (?:passed|failed|error|deselected|skipped)"
+    r"|no tests ran)")
+
+
+def parse_failures(path: Path) -> Tuple[Set[str], Set[str]]:
+    """(failed ids, errored ids) from a pytest log."""
+    try:
+        text = path.read_text(errors="replace")
+    except OSError as exc:
+        print(f"diff_failures: cannot read {path}: {exc}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    if not _PYTEST_MARKERS.search(text):
+        print(f"diff_failures: {path} does not look like a pytest "
+              f"log (no summary markers) — refusing to treat it as a "
+              f"green run", file=sys.stderr)
+        raise SystemExit(2)
+    failed: Set[str] = set()
+    errored: Set[str] = set()
+    for line in text.splitlines():
+        m = _ID_LINE.match(line.strip())
+        if not m:
+            continue
+        kind, nodeid = m.groups()
+        # "FAILED tests/x.py::t - AssertionError: ..." -> the id alone
+        (failed if kind == "FAILED" else errored).add(nodeid)
+    return failed, errored
+
+
+def main(argv) -> int:
+    args = [a for a in argv[1:] if not a.startswith("-")]
+    if "--help" in argv or "-h" in argv or len(args) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    base_path, head_path = Path(args[0]), Path(args[1])
+    base_failed, base_err = parse_failures(base_path)
+    head_failed, head_err = parse_failures(head_path)
+    base_all = base_failed | base_err
+    head_all = head_failed | head_err
+
+    added = sorted(head_all - base_all)
+    removed = sorted(base_all - head_all)
+
+    print(f"base: {len(base_failed)} failed + {len(base_err)} errors "
+          f"({base_path})")
+    print(f"head: {len(head_failed)} failed + {len(head_err)} errors "
+          f"({head_path})")
+    if removed:
+        print(f"\nfixed ({len(removed)}):")
+        for nodeid in removed:
+            print(f"  - {nodeid}")
+    if added:
+        print(f"\nNEWLY FAILING ({len(added)}) — regressions:")
+        for nodeid in added:
+            print(f"  + {nodeid}")
+        return 1
+    print("\nno newly-failing tests")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

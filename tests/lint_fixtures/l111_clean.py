@@ -1,0 +1,29 @@
+"""Rule L111 clean fixture: accelerator symbols ride the compat shim
+(resolved once, provenance recorded), orbax rides orbaxshim; relative
+package imports and same-named local variables are not violations."""
+from aws_global_accelerator_controller_tpu.compat import orbaxshim
+from aws_global_accelerator_controller_tpu.compat.jaxshim import (
+    VMEM,
+    CompilerParams,
+    shard_map,
+)
+
+
+def kernel_call(pl, jax, jnp, kern):
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        compiler_params=CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        scratch_shapes=[VMEM((8, 128), jnp.float32)],
+    )
+
+
+def save(tree, path, mesh, spec):
+    mngr = orbaxshim.make_manager(path)
+    mngr.save(0, args=orbaxshim.save_args(tree))
+    fn = shard_map(lambda x: x, mesh=mesh, in_specs=spec,
+                   out_specs=spec)
+    # a LOCAL name that happens to be called orbax is not the module
+    orbax = {"steps": [0]}
+    return mngr, fn, orbax["steps"]

@@ -1,0 +1,33 @@
+"""Rule L111 fixture: version-sensitive accelerator surfaces touched
+directly — the drift shape that produced 150 standing tier-1 failures
+(``pltpu.CompilerParams`` vs ``TPUCompilerParams``)."""
+import orbax.checkpoint as ocp
+from jax.experimental.pallas import tpu as pltpu
+
+
+def kernel_call(pl, jax, jnp, kern):
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+    )
+
+
+def save(tree, path):
+    mngr = ocp.CheckpointManager(path)
+    mngr.save(0, args=ocp.args.StandardSave(tree))
+    probed = pltpu.TPUMemorySpace.ANY  # race: deliberate drift probe
+    return mngr, probed
+
+
+def alias_bypass(pl, jax, jnp, kern):
+    # the through-the-alias shape: pl.tpu binds onto the package the
+    # moment anything imports the submodule — same drifting surface
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        compiler_params=pl.tpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )

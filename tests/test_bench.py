@@ -320,6 +320,13 @@ def _main_json(monkeypatch, capsys, tmp_path, status, detail):
                            "fold_ratio": 5.0, "throughput": 950.0}}]})
     monkeypatch.setattr(bench, "tpu_probe", lambda *a, **k: (status,
                                                             detail))
+    # the structured preflight rides its own bounded subprocess; stub
+    # it so contract tests never spawn a real jax process
+    monkeypatch.setattr(
+        bench, "bench_compat_preflight_subprocess",
+        lambda **kw: {"backend": "cpu", "rung": "pallas-interpret",
+                      "capabilities": {}, "shim_missing": [],
+                      "failed_probes": ["pallas_tpu"]})
     planner_calls = []
     monkeypatch.setattr(
         bench, "bench_planner_subprocess",
@@ -386,6 +393,50 @@ def test_main_contract_dead_backend_still_one_line(monkeypatch, capsys,
     assert ran["flash_xl"] == ran["smoke"] == 0
     # the backend-agnostic planner must still run, pinned to cpu
     assert ran["planner_calls"] == [{"force_cpu": True}]
+
+
+def test_main_contract_healthy_cpu_runs_live_degraded_legs(
+        monkeypatch, capsys, tmp_path):
+    """A healthy non-TPU backend no longer reports five skips: the
+    flash / long-context / temporal legs run LIVE on the degraded
+    rung (the subprocess legs self-scale and stamp the rung); only
+    the on-chip compile smoke skips, carrying the preflight rung."""
+    data, ran = _main_json(monkeypatch, capsys, tmp_path, "other",
+                           "cpu")
+    live = {"fwd_us": 1.0, "evidence": "measured-this-run"}
+    assert data["tpu_flash"] == live
+    assert data["tpu_flash_long"] == live
+    assert data["tpu_flash_xl"] == live
+    assert data["tpu_temporal_train"] == live
+    assert ran["flash"] == ran["flash_long"] == ran["temporal"] == 1
+    assert ran["flash_xl"] == 1
+    assert ran["smoke"] == 0
+    assert "non-tpu backend" in data["tpu_smoke"]["skipped"]
+    assert data["tpu_smoke"]["rung"] == "pallas-interpret"
+    assert ran["planner_calls"] == [{}]
+
+
+def test_preflight_recorded_to_history(monkeypatch, tmp_path):
+    """The structured verdict lands in reconcile_history.jsonl tagged
+    accel-preflight (reconcile_floor's tag filter skips it)."""
+    path = tmp_path / "history.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(path))
+    bench._record_preflight_history(
+        {"backend": "cpu", "rung": "pallas-interpret",
+         "failed_probes": ["pallas_tpu"],
+         "capabilities": {"pallas_tpu": {
+             "supported": False,
+             "detail": "default backend is 'cpu', not tpu"}}},
+        "other", "cpu")
+    entry = json.loads(path.read_text().strip())
+    assert entry["bench"] == "accel-preflight"
+    assert entry["rung"] == "pallas-interpret"
+    assert entry["probe_status"] == "other"
+    assert entry["capabilities"]["pallas_tpu"]["supported"] is False
+    # a floor derivation over a file holding only tagged entries must
+    # fall back to the default, not crash on the missing throughput
+    assert bench.reconcile_floor(
+        default=123.0, history_path=str(path)) == 123.0
 
 
 def test_named_bench_table_complete():
@@ -474,8 +525,11 @@ def test_smoke_legs_compile_interpret_mode():
 
 
 def test_temporal_breakdown_skips_off_tpu():
+    """The cost decomposition only attributes ON-CHIP time; on a
+    degraded rung it skips, naming the rung it resolved."""
     out = bench.bench_temporal_breakdown()
-    assert "skipped" in out and "non-tpu" in out["skipped"]
+    assert "skipped" in out and "pallas-tpu rung" in out["skipped"]
+    assert out["rung"] in ("pallas-interpret", "jnp-reference")
 
 
 def test_temporal_breakdown_legs_run_interpret_mode():
@@ -812,6 +866,10 @@ def test_stdout_line_fits_driver_tail(monkeypatch, capsys, tmp_path):
     monkeypatch.setattr(bench, "_LIVE_PATH", str(live))
     monkeypatch.setattr(
         bench, "_HISTORY_PATH", str(tmp_path / "history.jsonl"))
+    monkeypatch.setattr(
+        bench, "bench_compat_preflight_subprocess",
+        lambda **kw: {"skipped": "accelerator compat preflight "
+                                 "skipped: backend unresponsive"})
     monkeypatch.setattr(
         bench, "bench_reconcile_best",
         lambda **kw: {"services": 200, "elapsed_s": 0.087,

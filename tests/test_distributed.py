@@ -54,7 +54,11 @@ def test_collectives_run_over_hybrid_mesh():
     mesh = make_hybrid_mesh(dcn_axes=("replica",),
                             ici_axes=("data", "model"))
 
-    @partial(jax.shard_map, mesh=mesh,
+    from aws_global_accelerator_controller_tpu.compat.jaxshim import (
+        shard_map,
+    )
+
+    @partial(shard_map, mesh=mesh,
              in_specs=P("data", "model"), out_specs=P(),
              check_vma=False)
     def global_sum(x):

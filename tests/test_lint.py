@@ -538,3 +538,71 @@ def test_l108_seeded_fence_strip_from_wrapper_caught(tmp_path):
     # sanity: the unmutated wrapper is clean under its own rule
     assert [x for x in concurrency_lint.lint_files([wrapper_py])
             if x.code == "L108"] == []
+
+
+def test_l111_direct_pltpu_and_orbax_fire_and_waiver_suppresses():
+    """Direct imports of the drifting modules fire (lines 4/5), as do
+    bare ``pltpu.*`` attribute chains without an import in sight
+    (12/14 — the grafted-call shape) and the through-the-alias
+    ``pl.tpu.X`` shape (31); the ``# race:`` waiver suppresses line
+    22's deliberate drift probe."""
+    assert _cfindings("l111_direct_pltpu.py") == [
+        ("L111", 4), ("L111", 5), ("L111", 12), ("L111", 14),
+        ("L111", 31)]
+
+
+def test_l111_shimmed_access_clean():
+    assert _cfindings("l111_clean.py") == []
+
+
+def test_l111_accelerator_packages_clean():
+    """The shipped accelerator stack must stay clean under its own
+    rule: no direct pltpu/orbax access outside compat/."""
+    for pkg in ("ops", "models", "parallel", "cmd"):
+        d = pathlib.Path(ROOT_DIR) / (
+            "aws_global_accelerator_controller_tpu/" + pkg)
+        files = sorted(d.glob("*.py"))
+        assert files, f"{pkg} package files not found"
+        found = [x for x in concurrency_lint.lint_files(files)
+                 if x.code == "L111"]
+        assert found == [], found
+
+
+def test_l111_compat_package_exempt():
+    """compat/ IS the legitimate home of raw pltpu/orbax access —
+    the shim must never fire its own rule."""
+    d = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/compat")
+    files = sorted(d.glob("*.py"))
+    assert files, "compat package files not found"
+    assert [x for x in concurrency_lint.lint_files(files)
+            if x.code == "L111"] == []
+
+
+def test_l111_seeded_pltpu_graft_into_shipped_ops_caught(tmp_path):
+    """Acceptance probe tied to the shipped code shape: graft a bare
+    ``pltpu.CompilerParams`` back into the REAL flash-attention kernel
+    (the exact drift that wedged the track for 150 tier-1 failures)
+    and the gate must fire."""
+    ops_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/ops/"
+        "pallas_attention.py")
+    src = ops_py.read_text()
+    needle = "        compiler_params=CompilerParams(\n"
+    assert src.count(needle) >= 1, \
+        "flash kernel compiler_params shape changed; update this probe"
+    mutated = src.replace(
+        needle, "        compiler_params=pltpu.CompilerParams(\n", 1)
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "ops")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "pallas_attention.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L111"]
+    assert findings, "a grafted bare pltpu.CompilerParams in shipped " \
+                     "ops code was not caught"
+
+    # sanity: the unmutated kernel is clean under its own rule
+    assert [x for x in concurrency_lint.lint_files([ops_py])
+            if x.code == "L111"] == []

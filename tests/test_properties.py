@@ -5,10 +5,20 @@ only.  These properties cover the input space the tables can't: random
 fleets through the weight planner, generated hostnames through the
 parser, random id sets through the membership diff.  Everything here is
 pure/CPU-fast; JAX runs on the CPU backend (conftest).
+
+``hypothesis`` is an OPTIONAL dependency: some build containers don't
+ship it, and this module must then SKIP with a named reason instead of
+erroring the whole collection (the standing tier-1 collection error
+every PR since the drift had to tiptoe around).
 """
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+pytest.importorskip(
+    "hypothesis",
+    reason="hypothesis not installed in this container — the "
+           "property tier is optional (fixed-case tiers still run)")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from aws_global_accelerator_controller_tpu.cloudprovider.aws.hostname import (
     get_lb_name_from_hostname,
