@@ -1,9 +1,17 @@
 # Developer entry points (analogue of the reference Makefile:16-24).
 
-.PHONY: test manifests check-manifests bench benchdoc graft-dryrun lint
+.PHONY: test manifests check-manifests bench benchdoc graft-dryrun lint \
+	tier1-diff
 
 test:
 	python -m pytest tests/ -x -q
+
+# the per-PR "failure set no worse" gate as one command: tier-1 on a
+# clean baseline worktree (TIER1_BASE, default HEAD) AND the working
+# tree, FAILED/ERROR sets diffed by hack/diff_failures.py — exits 1 on
+# any newly-failing test (docs/operations.md "Tier-1 workflow")
+tier1-diff:
+	bash hack/tier1_diff.sh
 
 manifests:
 	python -m aws_global_accelerator_controller_tpu.codegen
@@ -29,7 +37,7 @@ graft-dryrun:
 # package is installable in the build environment); compileall stays as
 # the pure syntax gate for files lint.py does not cover.  --all runs
 # BOTH passes: base rules L001-L007 and the concurrency contract rules
-# L101-L111 (docs/static-analysis.md)
+# L101-L112 (docs/static-analysis.md)
 lint:
 	python -m compileall -q aws_global_accelerator_controller_tpu tests
 	python hack/lint.py --all

@@ -1,4 +1,4 @@
-"""AST-based concurrency contract lints (rules L101-L111).
+"""AST-based concurrency contract lints (rules L101-L112).
 
 The static half of the concurrency checker: a whole-program pass over
 the tree that enforces the synchronization contracts PR 1 introduced as
@@ -93,6 +93,22 @@ zero-findings gate philosophy):
                          shim (compat/jaxshim.py, compat/orbaxshim.py)
                          resolves each symbol once with recorded
                          provenance and degrades with evidence.
+  L112 rollout-gated weight mutations
+                         Endpoint-weight mutations
+                         (``update_endpoint_weights`` /
+                         ``update_endpoint_weight``) outside the
+                         ``rollout/`` package must consult the rollout
+                         gate lexically in the enclosing function
+                         (``self.rollout.decide(...)``, a helper whose
+                         name contains ``rollout``): an unconsulted
+                         weight write can SNAP a mid-ramp object to
+                         its final target, destroying the monotone
+                         blue-green ramp the durable state machine
+                         guarantees (rollout/machine.py).  The two
+                         weight-bearing controllers' shipped consults
+                         are verified whenever their files are linted
+                         (the seeded probe strips one and asserts the
+                         rule fires).  Package-scoped like L105.
   L108 fenced mutations  Mutation-issuing paths must consult the
                          lifecycle fence (resilience/fence.py): no
                          AWS WRITE method may be reachable after
@@ -206,6 +222,26 @@ def _consults_fence(fn: ast.AST) -> bool:
     return False
 
 
+def _consults_rollout(fn: ast.AST) -> bool:
+    """Does this function lexically consult the rollout gate?  A call
+    whose receiver chain names a ``*rollout*`` attribute and ends in
+    ``decide``/``active`` (``self.rollout.decide(...)``), or a helper
+    whose own name contains ``rollout`` (``_record_rollout()``,
+    ``rollout_active(...)``)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain is None:
+            continue
+        if chain[-1] in ("decide", "active") \
+                and any("rollout" in seg for seg in chain[:-1]):
+            return True
+        if "rollout" in chain[-1]:
+            return True
+    return False
+
+
 def _consults_shard(fn: ast.AST) -> bool:
     """Does this function lexically consult the shard-ownership
     assertion?  A call whose receiver chain names a ``*shard*``
@@ -252,6 +288,27 @@ def _l109_in_scope(path: Path) -> bool:
 # The enqueue surface rule L109 requires a ``klass=`` keyword on, when
 # the receiver chain names a queue.
 _ENQUEUE_METHODS = {"add", "add_rate_limited", "add_after"}
+
+
+# The endpoint-weight mutation surface rule L112 requires a rollout
+# gate consult around: a direct call to either snaps weights, which is
+# exactly what a mid-ramp object must never experience.
+_WEIGHT_MUTATIONS = {"update_endpoint_weights", "update_endpoint_weight"}
+
+
+def _l112_in_scope(path: Path) -> bool:
+    """L112 covers every shipped package file EXCEPT the rollout
+    package itself (the gate's one legitimate home — its machine
+    plans the very weights everyone else must gate on), plus the
+    fixture corpus."""
+    parts = path.parts
+    if "lint_fixtures" in parts:
+        return True
+    if "aws_global_accelerator_controller_tpu" not in parts:
+        return False
+    pkg_idx = parts.index("aws_global_accelerator_controller_tpu")
+    return not (len(parts) > pkg_idx + 1
+                and parts[pkg_idx + 1] == "rollout")
 
 
 def _l111_in_scope(path: Path) -> bool:
@@ -482,6 +539,7 @@ class Engine:
         self._check_ordering_graph()
         self._check_wrapper_fence_gate()
         self._check_sharded_submit_gate()
+        self._check_rollout_gate()
         suppressed = [f for f in self.findings
                       if not self._finding_waived(f)]
         return suppressed
@@ -587,6 +645,34 @@ class Engine:
                     "tree relies on this gate to keep one writer per "
                     "endpoint group / hosted zone "
                     "(sharding/shardset.py ShardSet.check)"))
+
+    def _check_rollout_gate(self) -> None:
+        """L112's other half: the two weight-bearing controllers'
+        shipped rollout consults are load-bearing for every ramp in
+        the fleet — whenever their files are part of the linted set,
+        the consult must be lexically present (the seeded-mutation
+        probe strips one and asserts this fires).  A fixture subset
+        without the controllers trusts the shipped ones."""
+        surfaces = {
+            "endpointgroupbinding.py": ("_reconcile_update",),
+            "route53.py": ("process_service_create_or_update",
+                           "process_ingress_create_or_update"),
+        }
+        for info in self.files:
+            names = surfaces.get(info.path.name)
+            if names is None or not _l105_in_scope(info.path) \
+                    or "controller" not in info.path.parts:
+                continue
+            for classname, fn in self._functions(info.tree):
+                if fn.name in names and not _consults_rollout(fn):
+                    self.findings.append(Finding(
+                        info.path, fn.lineno, "L112",
+                        f"'{fn.name}' no longer consults the rollout "
+                        f"gate: every weight this controller writes "
+                        f"relies on rollout/engine.py deciding the "
+                        f"in-force mid-ramp values — an unconsulted "
+                        f"path snaps ramping objects to their final "
+                        f"target"))
 
     def _check_compat_shim(self, info: _FileInfo) -> None:
         """Rule L111: version-sensitive ``pltpu.*``/``orbax.*`` access
@@ -768,6 +854,21 @@ class Engine:
                 f"'...shards.check(container_key)' in this function, "
                 f"route the write through the sharded coalescer, or "
                 f"waive with '# race: <reason>')"))
+        # L112: an endpoint-weight mutation outside rollout/ must be
+        # gated on the rollout engine — an unconsulted write snaps a
+        # mid-ramp object straight to its final target.
+        if (len(chain) >= 2 and chain[-1] in _WEIGHT_MUTATIONS
+                and _l112_in_scope(info.path)
+                and not _consults_rollout(fn)):
+            self.findings.append(Finding(
+                info.path, line, "L112",
+                f"ungated weight mutation '{'.'.join(chain)}()': an "
+                f"endpoint-weight write outside rollout/ must consult "
+                f"the rollout gate in this function "
+                f"(rollout/engine.py — 'self.rollout.decide(...)' "
+                f"decides the weights IN FORCE mid-ramp; an ungated "
+                f"write snaps a ramping object to its target), or "
+                f"waive with '# race: <reason>'"))
         # L109: an enqueue that names no traffic class silently
         # defaults the key's tier — the controller/reconcile packages
         # must say whether a key is interactive, background, or a

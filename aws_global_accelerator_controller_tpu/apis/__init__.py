@@ -25,6 +25,37 @@ AWS_GLOBAL_ACCELERATOR_IP_ADDRESS_TYPE_ANNOTATION = (
     "aws-global-accelerator-controller.h3poteto.dev/ip-address-type"
 )
 
+# Weighted Route53 routing (ROADMAP item 5 traffic engineering): an
+# annotated object's alias/TXT records become a WEIGHTED record set —
+# SetIdentifier names this object's side of the pair, weight is the
+# served share.  Two objects claiming the same hostname with DISTINCT
+# set identifiers are a legitimate blue-green pair, not a contested
+# claim.
+ROUTE53_SET_IDENTIFIER_ANNOTATION = (
+    "aws-global-accelerator-controller.h3poteto.dev/route53-set-identifier"
+)
+ROUTE53_WEIGHT_ANNOTATION = (
+    "aws-global-accelerator-controller.h3poteto.dev/route53-weight"
+)
+
+# Safe-rollout annotations (rollout/): a declared weight ramp instead
+# of an atomic snap.  Spelling per the rollout engine's contract —
+# rollout.agac/steps: "5,25,50,100" (percent of target per step),
+# rollout.agac/interval: seconds a step must hold healthy before
+# advancing, rollout.agac/health: "gated" (default: breaker + observed
+# convergence + error window) or "none", rollout.agac/rollback:
+# "immediate" (default), rollout.agac/abort: any value = a terminal
+# health verdict (external probers / operators flip this to force the
+# auto-rollback).  State lives in object STATUS (EndpointGroupBinding)
+# or the controller-owned rollout.agac/state annotation (core kinds).
+ROLLOUT_PREFIX = "rollout.agac/"
+ROLLOUT_STEPS_ANNOTATION = ROLLOUT_PREFIX + "steps"
+ROLLOUT_INTERVAL_ANNOTATION = ROLLOUT_PREFIX + "interval"
+ROLLOUT_HEALTH_ANNOTATION = ROLLOUT_PREFIX + "health"
+ROLLOUT_ROLLBACK_ANNOTATION = ROLLOUT_PREFIX + "rollback"
+ROLLOUT_ABORT_ANNOTATION = ROLLOUT_PREFIX + "abort"
+ROLLOUT_STATE_ANNOTATION = ROLLOUT_PREFIX + "state"
+
 # Foreign annotations this controller reads (reference pkg/apis/type.go:11-12).
 AWS_LOAD_BALANCER_TYPE_ANNOTATION = "service.beta.kubernetes.io/aws-load-balancer-type"
 INGRESS_CLASS_ANNOTATION = "kubernetes.io/ingress.class"

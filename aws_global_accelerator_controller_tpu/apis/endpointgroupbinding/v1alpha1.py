@@ -70,18 +70,31 @@ class EndpointGroupBindingSpec:
 class EndpointGroupBindingStatus:
     endpoint_ids: List[str] = field(default_factory=list)
     observed_generation: int = 0
+    # durable safe-rollout state (rollout/machine.py RolloutState
+    # serialized dict: phase, step, stepStartedAt, fencing token, from/
+    # to weight vectors, rollback reason).  Lives in STATUS — never
+    # process memory — so a crash, leader handoff or shard rebalance
+    # mid-ramp resumes from the persisted step instead of re-snapping.
+    # Kept as the raw camelCase dict so round-tripping matches the
+    # wire shape byte-for-byte; rollout/ owns the typed view.
+    rollout: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d: Dict[str, Any] = {
             "endpointIds": list(self.endpoint_ids),
             "observedGeneration": self.observed_generation,
         }
+        if self.rollout is not None:
+            d["rollout"] = dict(self.rollout)
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "EndpointGroupBindingStatus":
+        rollout = d.get("rollout")
         return cls(
             endpoint_ids=list(d.get("endpointIds") or []),
             observed_generation=int(d.get("observedGeneration", 0)),
+            rollout=dict(rollout) if rollout else None,
         )
 
 

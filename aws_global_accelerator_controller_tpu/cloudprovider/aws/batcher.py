@@ -201,12 +201,14 @@ class _Intent:
 
 def _fold_record(group: "_Group", action, record_set,
                  future: _Future) -> int:
-    """Last-writer-wins per (name, type): the new change supersedes a
+    """Last-writer-wins per record identity — (name, type) plus the
+    weighted-routing SetIdentifier, so the two sides of a weighted
+    pair never fold into each other: the new change supersedes a
     pending one in place and absorbs its waiters (an UPSERT followed by
     a DELETE of the same record collapses to the DELETE; both waiters
     share the surviving change's outcome).  O(1) via the group's fold
     index.  Returns folds counted."""
-    key = (record_set.name, record_set.type)
+    key = record_set.identity()
     it = group.index.get(key)
     if it is not None:
         it.payload = (action, record_set)

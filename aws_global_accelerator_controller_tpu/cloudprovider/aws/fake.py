@@ -124,9 +124,11 @@ class FaultInjector:
         # zone id -> (tokens, last refill timestamp)
         self._zone_rate: Optional[Tuple[float, float]] = None
         self._zone_buckets: Dict[str, Tuple[float, float]] = {}
-        # the GA fake registers itself here so chaos scenarios can
-        # edit accelerator-side state OUT OF BAND (edit_endpoint_group)
+        # the GA / Route53 fakes register themselves here so chaos
+        # scenarios can edit cloud state OUT OF BAND
+        # (edit_endpoint_group / edit_record_set)
         self._ga: Optional["FakeGlobalAccelerator"] = None
+        self._route53: Optional["FakeRoute53"] = None
 
     # -- original one-shot API (unchanged surface) ----------------------
 
@@ -228,6 +230,25 @@ class FaultInjector:
                                "this injector")
         self._ga.edit_endpoint_out_of_band(endpoint_group_arn,
                                            endpoint_id, weight)
+
+    def edit_record_set(self, hosted_zone_id: str, name: str,
+                        rtype: str,
+                        set_identifier: Optional[str] = None,
+                        weight: Optional[int] = None,
+                        alias_dns_name: Optional[str] = None) -> None:
+        """Chaos: mutate one record set DIRECTLY in the fake Route53
+        zone — no API call counted, no watch event, no cache or
+        fingerprint invalidation (the edit_endpoint_group parallel for
+        the record plane).  Models an operator (or another tool)
+        re-weighting / re-pointing a record behind this controller's
+        back: exactly the drift the tiered sweep's record read-back
+        exists to detect and repair."""
+        if self._route53 is None:
+            raise RuntimeError("no FakeRoute53 attached to this "
+                               "injector")
+        self._route53.edit_record_out_of_band(
+            hosted_zone_id, name, rtype, set_identifier=set_identifier,
+            weight=weight, alias_dns_name=alias_dns_name)
 
     # -- observability --------------------------------------------------
 
@@ -658,6 +679,7 @@ def _normalize_record_name(name: str) -> str:
 class FakeRoute53(Route53API):
     def __init__(self, faults: Optional[FaultInjector] = None):
         self.faults = faults or FaultInjector()
+        self.faults._route53 = self   # out-of-band edit hook (chaos)
         self._lock = threading.RLock()
         self._seq = itertools.count(1)
         self._zones: Dict[str, HostedZone] = {}
@@ -743,8 +765,27 @@ class FakeRoute53(Route53API):
             # steady-state re-sync see perpetual alias drift and
             # re-UPSERT a converged record forever
             rs.alias_target.dns_name += "."
-        existing = [r for r in records
-                    if r.name == rs.name and r.type == rs.type]
+        # weighted routing (WRR) validation, as the real API enforces:
+        # SetIdentifier and Weight come together, and a (name, type)
+        # set is either entirely simple or entirely weighted — mixing
+        # rejects the change (InvalidChangeBatch)
+        if (rs.set_identifier is None) != (rs.weight is None):
+            raise AWSAPIError(
+                "InvalidChangeBatch",
+                f"{rs.name} {rs.type}: SetIdentifier and Weight must "
+                f"be specified together")
+        same_name_type = [r for r in records
+                          if r.name == rs.name and r.type == rs.type]
+        if action in ("CREATE", "UPSERT") and any(
+                (r.set_identifier is None) != (rs.set_identifier is None)
+                for r in same_name_type
+                if r.identity() != rs.identity()):
+            raise AWSAPIError(
+                "InvalidChangeBatch",
+                f"{rs.name} {rs.type}: cannot mix simple and weighted "
+                f"resource record sets")
+        existing = [r for r in same_name_type
+                    if r.identity() == rs.identity()]
         if action == "CREATE":
             if existing:
                 raise AWSAPIError(
@@ -764,6 +805,32 @@ class FakeRoute53(Route53API):
                 records.remove(r)
         else:
             raise AWSAPIError("InvalidInput", f"bad action {action}")
+
+    def edit_record_out_of_band(self, hosted_zone_id: str, name: str,
+                                rtype: str,
+                                set_identifier: Optional[str] = None,
+                                weight: Optional[int] = None,
+                                alias_dns_name: Optional[str] = None,
+                                ) -> None:
+        """Direct state edit for chaos scenarios (no fault check, no
+        call counting — the point is that NOTHING observes it happen);
+        reach it via ``FaultInjector.edit_record_set``.  Edits the
+        matched record's weight and/or alias target in place."""
+        with self._lock:
+            if hosted_zone_id not in self._records:
+                raise AWSAPIError("NoSuchHostedZone", hosted_zone_id)
+            ident = (_normalize_record_name(name), rtype, set_identifier)
+            for r in self._records[hosted_zone_id]:
+                if r.identity() == ident:
+                    if weight is not None:
+                        r.weight = weight
+                    if alias_dns_name is not None \
+                            and r.alias_target is not None:
+                        r.alias_target.dns_name = alias_dns_name
+                    return
+            raise AWSAPIError(
+                "RecordNotFound",
+                f"record {ident} not in {hosted_zone_id}")
 
 
 class FakeAWSCloud(AWSAPIs):

@@ -205,24 +205,98 @@ def replace_wildcards(s: str) -> str:
     return s.replace("\\052", "*", 1)
 
 
-def find_a_record(records: List[ResourceRecordSet],
-                  hostname: str) -> Optional[ResourceRecordSet]:
-    """(reference route53.go:360-367)"""
+def find_a_record(records: List[ResourceRecordSet], hostname: str,
+                  set_identifier: Optional[str] = None,
+                  ) -> Optional[ResourceRecordSet]:
+    """(reference route53.go:360-367) — extended with the weighted
+    pair's SetIdentifier: a blue-green record PAIR shares (name, type),
+    so the match must key on the identifier too or one side's sync
+    would read (and repair against) its sibling's record."""
     for record in records:
         if (record.type == RR_TYPE_A
-                and replace_wildcards(record.name) == hostname + "."):
+                and replace_wildcards(record.name) == hostname + "."
+                and record.set_identifier == set_identifier):
             return record
     return None
 
 
 def need_records_update(record: ResourceRecordSet,
-                        accelerator: Accelerator) -> bool:
-    """Alias drift check (reference route53.go:373-381)."""
+                        accelerator: Accelerator,
+                        weight: Optional[int] = None) -> bool:
+    """Alias drift check (reference route53.go:373-381), extended with
+    weighted-routing drift: a weighted record whose served Weight no
+    longer matches the desired one needs an UPSERT (this is what lets
+    the drift sweep detect an out-of-band re-weight — the
+    ``edit_record_set`` chaos hook's repair path)."""
     if record.alias_target is None:
         return True
-    return record.alias_target.dns_name != accelerator.dns_name + "."
+    if record.alias_target.dns_name != accelerator.dns_name + ".":
+        return True
+    return record.weight != weight
 
 
 def parent_domain(hostname: str) -> str:
     """Strip one leading label (reference route53.go:383-386)."""
     return ".".join(hostname.split(".")[1:])
+
+
+class RecordPolicy:
+    """Routing policy for one object's Route53 records: simple
+    (reference parity, the default) or weighted (SetIdentifier +
+    Weight on both the alias A record and its ownership TXT — route53
+    forbids mixing simple and weighted records under one (name,
+    type), so the TXT pair must be weighted too)."""
+
+    __slots__ = ("set_identifier", "weight")
+
+    SIMPLE: "RecordPolicy"
+
+    def __init__(self, set_identifier: Optional[str] = None,
+                 weight: Optional[int] = None):
+        self.set_identifier = set_identifier
+        self.weight = weight
+
+    @property
+    def weighted(self) -> bool:
+        return self.set_identifier is not None
+
+    def with_weight(self, weight: int) -> "RecordPolicy":
+        return RecordPolicy(self.set_identifier, weight)
+
+    @classmethod
+    def from_annotations(cls, annotations: Dict[str, str]
+                         ) -> "RecordPolicy":
+        """Parse the weighted-routing annotations; both must be
+        present and well-formed or the policy is SIMPLE (a half-set
+        pair is logged and ignored rather than writing an invalid
+        change the API would reject whole-batch)."""
+        from ...apis import (
+            ROUTE53_SET_IDENTIFIER_ANNOTATION,
+            ROUTE53_WEIGHT_ANNOTATION,
+        )
+        set_id = annotations.get(ROUTE53_SET_IDENTIFIER_ANNOTATION)
+        raw_weight = annotations.get(ROUTE53_WEIGHT_ANNOTATION)
+        if set_id is None and raw_weight is None:
+            return cls.SIMPLE
+        if set_id is None or raw_weight is None:
+            logger.error(
+                "weighted route53 routing needs BOTH %s and %s; "
+                "falling back to a simple record",
+                ROUTE53_SET_IDENTIFIER_ANNOTATION,
+                ROUTE53_WEIGHT_ANNOTATION)
+            return cls.SIMPLE
+        try:
+            weight = int(raw_weight)
+        except ValueError:
+            logger.error("bad %s value %r (not an integer); falling "
+                         "back to a simple record",
+                         ROUTE53_WEIGHT_ANNOTATION, raw_weight)
+            return cls.SIMPLE
+        if not 0 <= weight <= 255:
+            logger.error("route53 weight %d out of [0, 255]; falling "
+                         "back to a simple record", weight)
+            return cls.SIMPLE
+        return cls(set_id, weight)
+
+
+RecordPolicy.SIMPLE = RecordPolicy()

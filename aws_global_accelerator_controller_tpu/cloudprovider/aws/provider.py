@@ -52,6 +52,7 @@ from .helpers import (
     CLUSTER_TAG_KEY,
     MANAGED_TAG_KEY,
     OWNER_TAG_KEY,
+    RecordPolicy,
     TARGET_HOSTNAME_TAG_KEY,
     accelerator_name,
     accelerator_owner_tag_value,
@@ -1131,30 +1132,49 @@ class AWSProvider:
     def ensure_route53_for_service(self, svc: Service,
                                    lb_ingress: LoadBalancerIngress,
                                    hostnames: List[str],
-                                   cluster_name: str) -> Tuple[bool, float]:
+                                   cluster_name: str,
+                                   policy: Optional[RecordPolicy] = None,
+                                   weights: "Optional[dict]" = None,
+                                   ) -> Tuple[bool, float]:
         """(reference route53.go:22-29)"""
         return self._ensure_route53(lb_ingress, hostnames, cluster_name,
                                     "service", svc.metadata.namespace,
-                                    svc.metadata.name)
+                                    svc.metadata.name, policy=policy,
+                                    weights=weights)
 
     @traced("provider.ensure_route53_for_ingress")
     def ensure_route53_for_ingress(self, ingress: Ingress,
                                    lb_ingress: LoadBalancerIngress,
                                    hostnames: List[str],
-                                   cluster_name: str) -> Tuple[bool, float]:
+                                   cluster_name: str,
+                                   policy: Optional[RecordPolicy] = None,
+                                   weights: "Optional[dict]" = None,
+                                   ) -> Tuple[bool, float]:
         """(reference route53.go:31-54)"""
         return self._ensure_route53(lb_ingress, hostnames, cluster_name,
                                     "ingress", ingress.metadata.namespace,
-                                    ingress.metadata.name)
+                                    ingress.metadata.name, policy=policy,
+                                    weights=weights)
 
     def _ensure_route53(self, lb_ingress, hostnames, cluster_name, resource,
-                        ns, name) -> Tuple[bool, float]:
+                        ns, name,
+                        policy: Optional[RecordPolicy] = None,
+                        weights: "Optional[dict]" = None,
+                        ) -> Tuple[bool, float]:
         """Find the accelerator by target-hostname tag, then converge every
         hostname's TXT + ALIAS-A pair (reference route53.go:56-130).
+
+        ``policy`` (helpers.RecordPolicy) selects simple (default,
+        reference parity) vs WEIGHTED records: the alias A and its
+        ownership TXT both carry the policy's SetIdentifier + Weight so
+        two objects can legitimately share one hostname as a blue-green
+        pair.  ``weights`` optionally overrides the served weight per
+        hostname (the rollout engine's mid-ramp values).
 
         Returns (created, retry_after): 0 or >1 accelerators mean the GA
         controller hasn't converged yet -> retry in 1m.
         """
+        policy = policy or RecordPolicy.SIMPLE
         accelerators = self.list_global_accelerator_by_hostname(
             lb_ingress.hostname, cluster_name)
         if len(accelerators) > 1:
@@ -1177,30 +1197,59 @@ class AWSProvider:
         for hostname in hostnames:
             hosted_zone = self.get_hosted_zone(hostname)
             logger.info("hosted zone is %s", hosted_zone.id)
+            hostname_policy = policy
+            if policy.weighted and weights is not None \
+                    and hostname in weights:
+                hostname_policy = policy.with_weight(weights[hostname])
             records = self.find_owned_a_record_sets(hosted_zone, owner_value)
-            record = find_a_record(records, hostname)
+            record = find_a_record(records, hostname,
+                                   policy.set_identifier)
             changes = pending.setdefault(hosted_zone.id, [])
             if record is None:
                 logger.info("creating record for %s with %s", hostname,
                             accelerator.accelerator_arn)
                 changes.append(self._txt_record_change(
-                    "CREATE", hostname, owner_value))
+                    "CREATE", hostname, owner_value,
+                    policy=hostname_policy))
                 changes.append(self._alias_record_change(
-                    "CREATE", hostname, accelerator))
+                    "CREATE", hostname, accelerator,
+                    policy=hostname_policy))
                 created = True
             else:
-                if not need_records_update(record, accelerator):
+                if not need_records_update(record, accelerator,
+                                           hostname_policy.weight):
                     logger.info("no update needed for %s, skipping",
                                 record.name)
                     continue
                 changes.append(self._alias_record_change(
-                    "UPSERT", hostname, accelerator))
+                    "UPSERT", hostname, accelerator,
+                    policy=hostname_policy))
                 logger.info("record set %s queued for update", record.name)
         for zone_id, changes in pending.items():
             if changes:
                 self.coalescer.change_record_sets(zone_id, changes)
         logger.info("all records synced for %s %s/%s", resource, ns, name)
         return created, 0.0
+
+    @traced("provider.get_record_weights")
+    def get_record_weights(self, hostnames: List[str], cluster_name: str,
+                           resource: str, ns: str, name: str,
+                           set_identifier: str) -> "dict[str, object]":
+        """Observed served weight per hostname for THIS owner's side of
+        a weighted record pair — the rollout engine's read-back: a step
+        only advances once the previous step's weight is confirmed on
+        the live record set, not merely written.  Hostnames whose
+        record does not exist (yet) are absent from the result."""
+        owner_value = route53_owner_value(cluster_name, resource, ns, name)
+        observed: "dict[str, object]" = {}
+        for hostname in hostnames:
+            hosted_zone = self.get_hosted_zone(hostname)
+            records = self.find_owned_a_record_sets(hosted_zone,
+                                                    owner_value)
+            record = find_a_record(records, hostname, set_identifier)
+            if record is not None:
+                observed[hostname] = record.weight
+        return observed
 
     @traced("provider.cleanup_record_set")
     def cleanup_record_set(self, cluster_name: str, resource: str, ns: str,
@@ -1226,15 +1275,22 @@ class AWSProvider:
     def find_owned_a_record_sets(self, hosted_zone: HostedZone,
                                  owner_value: str) -> List[ResourceRecordSet]:
         """TXT-ownership scan: names whose TXT value matches the owner,
-        then their alias record sets (reference route53.go:216-238)."""
+        then their alias record sets (reference route53.go:216-238).
+
+        Ownership pairs by (name, SetIdentifier), not name alone: a
+        weighted blue-green pair shares the NAME, and each side's TXT
+        (carrying its own SetIdentifier) must claim only its own alias
+        record — name-level matching would hand one owner its
+        sibling's record to "repair" or delete."""
         record_sets = self.apis.route53.list_resource_record_sets(
             hosted_zone.id)
-        owned_names = {
-            rs.name for rs in record_sets
+        owned_pairs = {
+            (rs.name, rs.set_identifier) for rs in record_sets
             if any(r.value == owner_value for r in rs.resource_records)
         }
         return [rs for rs in record_sets
-                if rs.name in owned_names and rs.alias_target is not None]
+                if (rs.name, rs.set_identifier) in owned_pairs
+                and rs.alias_target is not None]
 
     def _find_owned_metadata_record_sets(self, hosted_zone, owner_value):
         """(reference route53.go:167-182)"""
@@ -1248,22 +1304,32 @@ class AWSProvider:
     # differing only in action and record body).
 
     @staticmethod
-    def _alias_record_change(action: str, hostname: str, accelerator):
+    def _alias_record_change(action: str, hostname: str, accelerator,
+                             policy: RecordPolicy = RecordPolicy.SIMPLE):
         """ALIAS A -> accelerator DNS in the fixed GA hosted zone
-        (reference route53.go:240-269 create, 296-320 upsert)."""
+        (reference route53.go:240-269 create, 296-320 upsert).  A
+        weighted policy stamps SetIdentifier + Weight."""
         return (action, ResourceRecordSet(
             name=hostname, type=RR_TYPE_A,
             alias_target=AliasTarget(
                 dns_name=accelerator.dns_name,
                 hosted_zone_id=GLOBAL_ACCELERATOR_HOSTED_ZONE_ID,
-                evaluate_target_health=True)))
+                evaluate_target_health=True),
+            set_identifier=policy.set_identifier,
+            weight=policy.weight if policy.weighted else None))
 
     @staticmethod
-    def _txt_record_change(action: str, hostname: str, owner_value: str):
-        """Paired ownership TXT, TTL 300 (reference route53.go:271-294)."""
+    def _txt_record_change(action: str, hostname: str, owner_value: str,
+                           policy: RecordPolicy = RecordPolicy.SIMPLE):
+        """Paired ownership TXT, TTL 300 (reference route53.go:271-294).
+        Weighted policies stamp the TXT too: route53 forbids mixing
+        simple and weighted records under one (name, type), and the
+        pair's TWO ownership TXTs must coexist under the hostname."""
         return (action, ResourceRecordSet(
             name=hostname, type=RR_TYPE_TXT, ttl=TXT_RECORD_TTL,
-            resource_records=[ResourceRecord(value=owner_value)]))
+            resource_records=[ResourceRecord(value=owner_value)],
+            set_identifier=policy.set_identifier,
+            weight=policy.weight if policy.weighted else None))
 
     def get_hosted_zone(self, original_hostname: str) -> HostedZone:
         """Walk parent domains until a zone matches

@@ -325,6 +325,8 @@ class BotoRoute53(Route53API):
                 hosted_zone_id=alias["HostedZoneId"],
                 evaluate_target_health=alias.get(
                     "EvaluateTargetHealth", False)) if alias else None,
+            set_identifier=d.get("SetIdentifier"),
+            weight=d.get("Weight"),
         )
 
     def list_resource_record_sets(self, hosted_zone_id) -> List[ResourceRecordSet]:
@@ -345,6 +347,10 @@ class BotoRoute53(Route53API):
         rs = {"Name": record_set.name, "Type": record_set.type}
         if record_set.ttl is not None:
             rs["TTL"] = record_set.ttl
+        if record_set.set_identifier is not None:
+            rs["SetIdentifier"] = record_set.set_identifier
+        if record_set.weight is not None:
+            rs["Weight"] = record_set.weight
         if record_set.resource_records:
             rs["ResourceRecords"] = [{"Value": r.value}
                                      for r in record_set.resource_records]

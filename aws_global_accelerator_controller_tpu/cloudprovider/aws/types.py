@@ -122,6 +122,18 @@ class ResourceRecordSet:
     ttl: Optional[int] = None
     resource_records: List[ResourceRecord] = field(default_factory=list)
     alias_target: Optional[AliasTarget] = None
+    # weighted routing policy (route53 WRR): records sharing (name,
+    # type) are distinguished by SetIdentifier and served in proportion
+    # to Weight.  The real API requires every record in a weighted set
+    # to carry BOTH; a simple (set_identifier=None) record cannot
+    # coexist with weighted siblings of the same (name, type).
+    set_identifier: Optional[str] = None
+    weight: Optional[int] = None
+
+    def identity(self) -> tuple:
+        """The key the API matches changes against: (name, type) for
+        simple records, plus SetIdentifier for weighted ones."""
+        return (self.name, self.type, self.set_identifier)
 
     def copy(self) -> "ResourceRecordSet":
         return replace(

@@ -190,7 +190,8 @@ class ShardGate:
 
 
 def wire_shard_listener(shards, informer, queue, fingerprints,
-                        route_key, predicate, gate=None) -> None:
+                        route_key, predicate, gate=None,
+                        interactive_pred=None) -> None:
     """Register one (informer, queue) pair's shard ownership hooks
     (sharding/shardset.py ``ShardSet.add_listener``):
 
@@ -199,7 +200,11 @@ def wire_shard_listener(shards, informer, queue, fingerprints,
       cold (never recorded here, or dropped on a previous loss), so
       each rides a full provider-verifying sync exactly like the PR-6
       restart-recovery path: reads + fingerprint rebuild, zero
-      mutations against a converged world.
+      mutations against a converged world.  Keys matching
+      ``interactive_pred`` (an object with a rollout ramp in flight —
+      the previous owner's persisted step is waiting to be resumed)
+      ride CLASS_INTERACTIVE instead: a mid-ramp binding must not
+      queue its resume behind the whole shard's background re-verify.
     - **lost**: drop the shard's fingerprint records (the next owner's
       writes make them unprovable — FingerprintCache.invalidate_shard)
       and purge its pending backlog from the queue (the syncs would
@@ -227,7 +232,11 @@ def wire_shard_listener(shards, informer, queue, fingerprints,
             for key, obj in keys:
                 if predicate(obj):
                     scanned.add(key)
-                    queue.add_rate_limited(key, klass=CLASS_BACKGROUND)
+                    klass = (CLASS_INTERACTIVE
+                             if interactive_pred is not None
+                             and interactive_pred(obj)
+                             else CLASS_BACKGROUND)
+                    queue.add_rate_limited(key, klass=klass)
             if gate is not None:
                 # replay the events the cache scan above cannot
                 # reconstruct — deletes and demotions the ownership

@@ -52,6 +52,12 @@ from ..reconcile.fingerprint import (
     FingerprintConfig,
     in_sweep,
 )
+from ..rollout import (
+    RolloutEngine,
+    breaker_region_health,
+    rollout_active,
+    rollout_annotation_items,
+)
 from .base import (
     WORKER_POLL,
     ShardGate,
@@ -146,12 +152,25 @@ class EndpointGroupBindingController:
             depth_watermark=config.depth_watermark,
             age_watermark=config.age_watermark)
 
+        # the safe-rollout gate (rollout/): annotation-declared weight
+        # ramps instead of atomic snaps, state durable in status,
+        # transitions fenced by the owning shard's lease token, health
+        # gated on the global region's breaker + this controller's
+        # own classified-error window (L112 keeps every weight
+        # mutation consulting it)
+        self.rollout = RolloutEngine(
+            "EndpointGroupBinding", shards=cloud_factory.shards,
+            region_health=breaker_region_health(cloud_factory))
+
         # steady-state fast path: the binding fingerprint covers the
         # binding's spec/status/meta AND the referent's LB hostnames
-        # (everything _reconcile_update reads from informer state)
+        # (everything _reconcile_update reads from informer state);
+        # a mid-ramp binding VETOES the skip — its convergence is
+        # driven by timed re-deliveries the gate must not answer
         self.fingerprints = FingerprintCache(
             "EndpointGroupBinding", self._binding_fingerprint,
-            config.fingerprints)
+            config.fingerprints,
+            skip_veto=lambda o: rollout_active(o.status.rollout))
 
         self.service_informer = informer_factory.services()
         self.ingress_informer = informer_factory.ingresses()
@@ -188,7 +207,12 @@ class EndpointGroupBindingController:
         wire_shard_listener(
             self.shards, self.binding_informer, self.queue,
             self.fingerprints, self._route, lambda o: True,
-            gate=self.gate)
+            gate=self.gate,
+            # resume-on-acquire: a binding whose persisted rollout
+            # state is mid-ramp replays INTERACTIVE — the successor
+            # resumes the ramp ahead of the shard's background
+            # re-verify sweep
+            interactive_pred=lambda o: rollout_active(o.status.rollout))
 
     # -- event handlers (controller.go:85-98) ---------------------------
 
@@ -260,6 +284,12 @@ class EndpointGroupBindingController:
             tuple(obj.status.endpoint_ids),
             obj.status.observed_generation,
             type(self.weight_policy).__name__,
+            # the rollout inputs the sync reads: the declared ramp
+            # (steps/interval/health/abort annotations) and the
+            # persisted state — an edit to either must invalidate the
+            # steady-state skip
+            rollout_annotation_items(obj.annotations),
+            repr(sorted((obj.status.rollout or {}).items())),
             referent,
         )
 
@@ -333,6 +363,10 @@ class EndpointGroupBindingController:
                 # a failed sync's recorded fingerprint no longer
                 # proves a converged state
                 self.fingerprints.invalidate(key)
+                # ...and the rollout health gate holds the key's ramp
+                # while errors are fresh (advancing a canary through a
+                # failing sync loop would gate on nothing)
+                self.rollout.note_error(key)
                 if is_no_retry(e):
                     # parity with reconcile._reconcile_handler: a
                     # NoRetryError (a fenced sync, a shard rebalanced
@@ -408,6 +442,9 @@ class EndpointGroupBindingController:
         else:
             with self.shards.guard(route), dispatch_class(klass):
                 res = self.reconcile(binding.deep_copy())
+        # the sync ran to completion (mid-ramp requeues included):
+        # clear the rollout health gate's error window for the key
+        self.rollout.note_ok(key)
         if res.requeue_after > 0:
             self.queue.forget(key)
             self.queue.add_after(key, res.requeue_after, klass=CLASS_KEEP)
@@ -476,7 +513,8 @@ class EndpointGroupBindingController:
         self.client.endpoint_group_bindings.update(copied)
 
     def _update_status(self, obj: EndpointGroupBinding,
-                       endpoint_ids) -> None:
+                       endpoint_ids, rollout: "dict | None" = None,
+                       ) -> None:
         """Record the converged endpoint set on status, retrying a
         resourceVersion conflict against the FRESH object.
 
@@ -488,13 +526,27 @@ class EndpointGroupBindingController:
         (``_reconcile_delete`` drains exactly the recorded ids).  The
         window is real since endpoint mutations ride coalesced flushes
         (batcher.py linger) between the read and the write.
+
+        ``rollout`` persists a safe-rollout transition — written (and
+        mirrored onto the caller's ``obj``) BEFORE the weights the
+        transition implies, the crash-resume ordering the rollout
+        machine's contract requires.  When None, the caller's current
+        ``obj.status.rollout`` is carried through so a membership
+        status write never clobbers a transition persisted earlier in
+        the same sync.
         """
+        if rollout is not None:
+            # mirror locally first: every later status write in this
+            # sync must carry the new state
+            obj.status.rollout = dict(rollout)
         copied = obj.deep_copy()
         last: "ConflictError | None" = None
         for _ in range(5):
             copied.status.endpoint_ids = list(endpoint_ids)
             # the generation whose spec this sync actually converged
             copied.status.observed_generation = obj.metadata.generation
+            copied.status.rollout = (dict(obj.status.rollout)
+                                     if obj.status.rollout else None)
             try:
                 self.client.endpoint_group_bindings.update_status(copied)
                 return
@@ -536,15 +588,49 @@ class EndpointGroupBindingController:
         removed_ids = [i for i in obj.status.endpoint_ids if i not in arns]
         if (not new_ids and not removed_ids
                 and obj.status.observed_generation == obj.metadata.generation
-                and not in_sweep()):
+                and not in_sweep()
+                and not self._rollout_declared(obj)):
             # no-change short-circuit — EXCEPT on the drift sweep's
-            # deep-verify tier, which exists precisely to re-read the
+            # deep-verify tier (which exists precisely to re-read the
             # live endpoint group and repair out-of-band mutation this
-            # early return would otherwise hide forever
+            # early return would otherwise hide forever) and for
+            # rollout-declared bindings, whose timed re-deliveries
+            # must reach the describe below or the ramp stalls at its
+            # persisted step
             return Result()
 
         endpoint_group = provider.describe_endpoint_group(
             obj.spec.endpoint_group_arn)
+
+        # one plan for the whole group (reference loops spec.weight,
+        # reconcile.go:197-204; the policy seam lets the TPU planner
+        # allocate per-endpoint weights for spec.weight: null bindings)
+        planned = self.weight_policy.plan(obj, endpoint_group,
+                                          list(arns))
+        desired = {endpoint_id: planned.get(endpoint_id, obj.spec.weight)
+                   for endpoint_id in arns}
+        current = {d.endpoint_id: d.weight
+                   for d in endpoint_group.endpoint_descriptions}
+
+        # the rollout gate (rollout/engine.py; lint rule L112): the
+        # weights IN FORCE right now are the persisted ramp step's,
+        # not the final target — a mid-ramp sync (or a brand-new
+        # endpoint joining mid-ramp) must never snap to 100%.  The
+        # outcome's state is persisted to status BEFORE any weight it
+        # implies is written (the crash-resume ordering contract).
+        outcome = self.rollout.decide(
+            key=obj.key(), route=self._route(obj),
+            annotations=obj.annotations,
+            state_dict=obj.status.rollout,
+            desired=desired,
+            observed={endpoint_id: current[endpoint_id]
+                      for endpoint_id in desired
+                      if endpoint_id in current},
+            generation=obj.metadata.generation)
+        if outcome.state is not None:
+            self._update_status(obj, obj.status.endpoint_ids,
+                                rollout=outcome.state.to_dict())
+        hold = outcome.hold if outcome.hold is not None else desired
 
         results = list(obj.status.endpoint_ids)
         for endpoint_id in removed_ids:
@@ -557,30 +643,29 @@ class EndpointGroupBindingController:
         for endpoint_id in new_ids:
             endpoint, retry = regional.add_lb_to_endpoint_group(
                 endpoint_group, arns[endpoint_id],
-                obj.spec.client_ip_preservation, obj.spec.weight)
+                obj.spec.client_ip_preservation,
+                hold.get(endpoint_id, obj.spec.weight))
             if retry > 0:
                 return Result(requeue=True, requeue_after=retry)
             if endpoint is not None:
                 results.append(endpoint)
 
-        # one plan for the whole group (reference loops spec.weight,
-        # reconcile.go:197-204; the policy seam lets the TPU planner
-        # allocate per-endpoint weights for spec.weight: null bindings)
-        # applied as ONE merged re-weight: every endpoint's intent
-        # rides a single coalesced read-modify-write instead of one
-        # full describe+update cycle per endpoint.  Skipped entirely
-        # when the described group already carries the planned weights
-        # — which is what makes a drift-sweep pass over a converged
-        # group read-only, and drift_repairs_total an honest count.
-        planned = self.weight_policy.plan(obj, endpoint_group,
-                                          list(arns))
-        desired = {endpoint_id: planned.get(endpoint_id, obj.spec.weight)
-                   for endpoint_id in arns}
-        current = {d.endpoint_id: d.weight
-                   for d in endpoint_group.endpoint_descriptions}
-        if any(current.get(endpoint_id, "absent") != weight
-               for endpoint_id, weight in desired.items()):
-            provider.update_endpoint_weights(endpoint_group, desired)
+        # apply the gate's write as ONE merged re-weight: every
+        # endpoint's intent rides a single coalesced read-modify-write
+        # instead of one full describe+update cycle per endpoint.
+        # Endpoints just added (already at the hold weight) are
+        # filtered, so a converged step re-sync issues ZERO mutations
+        # — what makes a drift-sweep pass over a converged group
+        # read-only, drift_repairs_total honest, and a crash-resumed
+        # ramp free of duplicate weight writes.
+        if outcome.write is not None:
+            write = {endpoint_id: weight
+                     for endpoint_id, weight in outcome.write.items()
+                     if (hold.get(endpoint_id) if endpoint_id in new_ids
+                         else current.get(endpoint_id, "absent"))
+                     != weight}
+            if write:
+                provider.update_endpoint_weights(endpoint_group, write)
         if arns:
             # recorded only once every update succeeded — a provider
             # failure mid-loop must not count as an applied plan; the
@@ -601,7 +686,21 @@ class EndpointGroupBindingController:
             # Kubernetes side too (a no-op status write would echo a
             # watch event back at the queue every sweep)
             self._update_status(obj, results)
+        if outcome.requeue_after > 0:
+            # the ramp's own clock: converge-recheck or step bake —
+            # requeue_after deliveries never record a fingerprint, so
+            # a mid-ramp binding is never fast-path-skipped
+            return Result(requeue_after=outcome.requeue_after)
         return Result()
+
+    def _rollout_declared(self, obj: EndpointGroupBinding) -> bool:
+        """Does this binding declare a ramp (annotations) or carry one
+        in flight (persisted status)?  Such bindings bypass the
+        no-change early return: their timed re-deliveries must reach
+        the provider describe that drives the state machine."""
+        from ..apis import ROLLOUT_STEPS_ANNOTATION
+        return (ROLLOUT_STEPS_ANNOTATION in obj.annotations
+                or rollout_active(obj.status.rollout))
 
     def _get_load_balancer_hostnames(self, obj: EndpointGroupBinding):
         """serviceRef|ingressRef -> LB hostnames (reconcile.go:219-252)."""

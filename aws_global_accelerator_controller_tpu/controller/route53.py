@@ -17,11 +17,22 @@ from dataclasses import dataclass, field
 from .. import cloudprovider
 from ..apis import (
     AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    ROLLOUT_STATE_ANNOTATION,
     ROUTE53_HOSTNAME_ANNOTATION,
+    ROUTE53_SET_IDENTIFIER_ANNOTATION,
+    ROUTE53_WEIGHT_ANNOTATION,
 )
 from ..cloudprovider.aws import get_lb_name_from_hostname
 from ..cloudprovider.aws.factory import CloudFactory
-from ..errors import new_no_retry_errorf
+from ..cloudprovider.aws.helpers import RecordPolicy
+from ..errors import ConflictError, new_no_retry_errorf
+from ..rollout import (
+    RolloutEngine,
+    RolloutState,
+    breaker_region_health,
+    parse_spec,
+    rollout_annotation_items,
+)
 from ..kube.client import KubeClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
 from ..kube.objects import Ingress, Service, split_meta_namespace_key
@@ -53,13 +64,17 @@ CONTROLLER_AGENT_NAME = "route53-controller"
 
 def route53_service_fingerprint(svc) -> tuple:
     """Exactly the Service fields the Route53 sync reads (filter
-    predicate, hostname annotation, LB hostnames) — pure over informer
-    state, never ``apis.*`` (lint rule L107)."""
+    predicate, hostname + weighted-routing + rollout annotations, LB
+    hostnames) — pure over informer state, never ``apis.*`` (lint
+    rule L107)."""
     return (
         "route53", "Service", svc.spec.type,
         svc.spec.load_balancer_class,
         AWS_LOAD_BALANCER_TYPE_ANNOTATION in svc.annotations,
         svc.annotations.get(ROUTE53_HOSTNAME_ANNOTATION),
+        svc.annotations.get(ROUTE53_SET_IDENTIFIER_ANNOTATION),
+        svc.annotations.get(ROUTE53_WEIGHT_ANNOTATION),
+        rollout_annotation_items(svc.annotations),
         tuple(i.hostname for i in svc.status.load_balancer.ingress),
     )
 
@@ -71,8 +86,19 @@ def route53_ingress_fingerprint(ingress) -> tuple:
     return (
         "route53", "Ingress",
         ingress.annotations.get(ROUTE53_HOSTNAME_ANNOTATION),
+        ingress.annotations.get(ROUTE53_SET_IDENTIFIER_ANNOTATION),
+        ingress.annotations.get(ROUTE53_WEIGHT_ANNOTATION),
+        rollout_annotation_items(ingress.annotations),
         tuple(i.hostname for i in ingress.status.load_balancer.ingress),
     )
+
+
+def record_ramp_active(obj) -> bool:
+    """Is a record-weight ramp in flight for this object?  Core kinds
+    have no free-form status, so the rollout state rides the
+    controller-owned ``rollout.agac/state`` annotation — pure (L107)."""
+    return RolloutState.from_json(
+        obj.annotations.get(ROLLOUT_STATE_ANNOTATION)).active()
 
 
 @dataclass
@@ -114,13 +140,25 @@ class Route53Controller:
             depth_watermark=config.depth_watermark,
             age_watermark=config.age_watermark)
 
-        # steady-state fast path: one fingerprint gate per queue
+        # the safe-rollout gate for WEIGHTED record pairs (rollout/):
+        # a weighted object declaring rollout.agac/* annotations ramps
+        # its record weight through the declared steps; state persists
+        # in the controller-owned rollout.agac/state annotation
+        self.rollout = RolloutEngine(
+            CONTROLLER_AGENT_NAME, shards=cloud_factory.shards,
+            region_health=breaker_region_health(cloud_factory))
+
+        # steady-state fast path: one fingerprint gate per queue; a
+        # mid-ramp object vetoes the skip (its convergence is driven
+        # by timed re-deliveries the gate must not answer)
         self.service_fingerprints = FingerprintCache(
             f"{CONTROLLER_AGENT_NAME}-service",
-            route53_service_fingerprint, config.fingerprints)
+            route53_service_fingerprint, config.fingerprints,
+            skip_veto=record_ramp_active)
         self.ingress_fingerprints = FingerprintCache(
             f"{CONTROLLER_AGENT_NAME}-ingress",
-            route53_ingress_fingerprint, config.fingerprints)
+            route53_ingress_fingerprint, config.fingerprints,
+            skip_veto=record_ramp_active)
 
         self.service_informer = informer_factory.services()
         self.service_informer.add_event_handler(
@@ -153,11 +191,16 @@ class Route53Controller:
             self.service_fingerprints, lambda o: o.key(),
             lambda o: (was_load_balancer_service(o)
                        and self._has_hostname(o)),
-            gate=self.service_gate)
+            gate=self.service_gate,
+            # resume-on-acquire: a mid-ramp weighted record replays
+            # interactive so the successor resumes the persisted step
+            # ahead of the background re-verify
+            interactive_pred=record_ramp_active)
         wire_shard_listener(
             self.shards, self.ingress_informer, self.ingress_queue,
             self.ingress_fingerprints, lambda o: o.key(),
-            self._has_hostname, gate=self.ingress_gate)
+            self._has_hostname, gate=self.ingress_gate,
+            interactive_pred=record_ramp_active)
 
     # -- event handlers (route53/controller.go:90-172) ------------------
 
@@ -253,20 +296,43 @@ class Route53Controller:
             return (spawn_workers(
                         f"{CONTROLLER_AGENT_NAME}-service", self.workers,
                         stop, self.service_queue, self._key_to_service,
-                        self.process_service_delete,
-                        self.process_service_create_or_update,
+                        self._rollout_health_tracked(
+                            self.process_service_delete),
+                        self._rollout_health_tracked(
+                            self.process_service_create_or_update),
                         fingerprints=self.service_fingerprints,
                         shards=self.shards)
                     + spawn_workers(
                         f"{CONTROLLER_AGENT_NAME}-ingress", self.workers,
                         stop, self.ingress_queue, self._key_to_ingress,
-                        self.process_ingress_delete,
-                        self.process_ingress_create_or_update,
+                        self._rollout_health_tracked(
+                            self.process_ingress_delete),
+                        self._rollout_health_tracked(
+                            self.process_ingress_create_or_update),
                         fingerprints=self.ingress_fingerprints,
                         shards=self.shards))
 
         run_controller(CONTROLLER_AGENT_NAME, stop,
                        [self.service_queue, self.ingress_queue], workers)
+
+    def _rollout_health_tracked(self, fn):
+        """EndpointGroupBinding-worker-loop parity for the rollout
+        health gate: any sync exception marks the key's ramp degraded
+        for one bake interval (``note_error`` — a record ramp must not
+        advance through a failing sync loop), and a sync that runs to
+        completion (mid-ramp requeues included) clears the window
+        (``note_ok``).  ``fn`` is a process func taking either the key
+        string (delete) or the object (create/update)."""
+        def wrapped(arg):
+            key = arg if isinstance(arg, str) else arg.key()
+            try:
+                res = fn(arg)
+            except Exception:
+                self.rollout.note_error(key)
+                raise
+            self.rollout.note_ok(key)
+            return res
+        return wrapped
 
     def _key_to_service(self, key: str):
         ns, name = split_meta_namespace_key(key)
@@ -305,13 +371,18 @@ class Route53Controller:
 
         hostnames = hostname.split(",")
         self._warn_contested_hostnames(svc, hostnames)
+        policy, ramp_weights, ramp_requeue = self._record_rollout(
+            svc, "service", hostnames, self.kube_client.services)
         for lb_ingress in svc.status.load_balancer.ingress:
             result = self._ensure_for_lb_ingress(
                 svc, lb_ingress, hostnames,
                 lambda provider: provider.ensure_route53_for_service(
-                    svc, lb_ingress, hostnames, self.cluster_name))
+                    svc, lb_ingress, hostnames, self.cluster_name,
+                    policy=policy, weights=ramp_weights))
             if result is not None:
                 return result
+        if ramp_requeue > 0:
+            return Result(requeue_after=ramp_requeue)
         return Result()
 
     def process_ingress_delete(self, key: str) -> Result:
@@ -342,29 +413,112 @@ class Route53Controller:
 
         hostnames = hostname.split(",")
         self._warn_contested_hostnames(ingress, hostnames)
+        policy, ramp_weights, ramp_requeue = self._record_rollout(
+            ingress, "ingress", hostnames, self.kube_client.ingresses)
         for lb_ingress in ingress.status.load_balancer.ingress:
             result = self._ensure_for_lb_ingress(
                 ingress, lb_ingress, hostnames,
                 lambda provider: provider.ensure_route53_for_ingress(
-                    ingress, lb_ingress, hostnames, self.cluster_name))
+                    ingress, lb_ingress, hostnames, self.cluster_name,
+                    policy=policy, weights=ramp_weights))
             if result is not None:
                 return result
+        if ramp_requeue > 0:
+            return Result(requeue_after=ramp_requeue)
         return Result()
+
+    def _record_rollout(self, obj, resource: str, hostnames,
+                        client) -> "tuple":
+        """The weighted-record ramp turn for one object: returns
+        (RecordPolicy, per-hostname weights override or None, requeue
+        seconds).  Simple (non-weighted) objects skip the engine
+        entirely — reference parity.  A weighted object with rollout
+        annotations ramps its record weight through the declared steps
+        with state persisted in the ``rollout.agac/state`` annotation
+        (written BEFORE the record weights it implies — the same
+        crash-resume ordering as the EndpointGroupBinding status
+        plane)."""
+        policy = RecordPolicy.from_annotations(obj.annotations)
+        if not policy.weighted:
+            return policy, None, 0.0
+        if (parse_spec(obj.annotations) is None
+                and not record_ramp_active(obj)):
+            # weighted but NOT ramping (no declared ramp, no active
+            # persisted state): pure reference snap — skip the
+            # per-hostname record read-back and the engine turn
+            # entirely; the ensure path's own need_records_update
+            # read-back covers drift for this shape
+            return policy, None, 0.0
+        provider = self.cloud_factory.global_provider()
+        desired = {h: policy.weight for h in hostnames}
+        observed = provider.get_record_weights(
+            hostnames, self.cluster_name, resource,
+            obj.metadata.namespace, obj.metadata.name,
+            policy.set_identifier)
+        outcome = self.rollout.decide(
+            key=obj.key(), route=obj.key(),
+            annotations=obj.annotations,
+            state_dict=RolloutState.from_json(
+                obj.annotations.get(ROLLOUT_STATE_ANNOTATION)).to_dict()
+            if obj.annotations.get(ROLLOUT_STATE_ANNOTATION) else None,
+            desired=desired, observed=observed,
+            generation=obj.metadata.generation)
+        if outcome.state is not None:
+            self._persist_ramp_state(obj, client, outcome.state)
+        # hold is the weight vector in force NOW: the ensure path
+        # upserts records at these values (a drifted record is
+        # repaired back to the STEP weight mid-ramp, the target only
+        # once the ramp completes)
+        return policy, outcome.hold, outcome.requeue_after
+
+    def _persist_ramp_state(self, obj, client, state) -> None:
+        """Write the ramp state annotation, retrying resourceVersion
+        conflicts against the fresh object (the metadata-plane twin of
+        the EndpointGroupBinding controller's ``_update_status``).
+        Mirrors onto the caller's ``obj`` so later reads in this sync
+        see the persisted step."""
+        raw = state.to_json()
+        obj.metadata.annotations[ROLLOUT_STATE_ANNOTATION] = raw
+        copied = obj.deep_copy()
+        last = None
+        for _ in range(5):
+            copied.metadata.annotations[ROLLOUT_STATE_ANNOTATION] = raw
+            try:
+                client.update(copied)
+                return
+            except ConflictError as e:
+                last = e
+                copied = client.get(obj.metadata.namespace,
+                                    obj.metadata.name).deep_copy()
+        raise last
 
     def _warn_contested_hostnames(self, obj, hostnames) -> None:
         """Indexed duplicate-claim check: two objects annotating the
         SAME route53 hostname would fight over one record set (last
         writer wins, ownership TXT flapping).  The hostname index
         answers 'who else claims this name' in O(1) across both
-        watched kinds instead of a lister scan per sync."""
+        watched kinds instead of a lister scan per sync.
+
+        Weighted pairs are the EXCEPTION: two objects claiming one
+        hostname with DISTINCT set identifiers are a legitimate
+        blue-green pair — each owns its own (name, SetIdentifier)
+        record — so only claimants whose identifier COLLIDES (both
+        simple, or both the same identifier) are contested."""
+        own_policy = RecordPolicy.from_annotations(obj.annotations)
         for hostname in hostnames:
-            others = [
-                o.key()
-                for informer in (self.service_informer,
-                                 self.ingress_informer)
+            others = []
+            for informer in (self.service_informer,
+                             self.ingress_informer):
                 for o in informer.by_index(ROUTE53_HOSTNAME_INDEX,
-                                           hostname)
-                if o.key() != obj.key() or o.kind != obj.kind]
+                                           hostname):
+                    if o.key() == obj.key() and o.kind == obj.kind:
+                        continue
+                    other_policy = RecordPolicy.from_annotations(
+                        o.annotations)
+                    if (other_policy.set_identifier
+                            != own_policy.set_identifier):
+                        continue   # distinct sides of a weighted pair
+                    others.append(o.key())
             if others:
                 logger.error(
                     "%s %s contests route53 hostname %s with %s — the "

@@ -323,6 +323,24 @@ default_registry.describe(
     "Wall-clock of shard loss paths (graceful handoffs include the "
     "coalescer cohort drain; deposals are seal-and-release).")
 default_registry.describe(
+    "rollout_transitions_total",
+    "Safe-rollout state machine edges taken, per controller and "
+    "transition (start / step / complete / rollback / rolled_back) — "
+    "every edge was PERSISTED to the object's durable rollout state "
+    "before the weights it implies were written (rollout/machine.py).")
+default_registry.describe(
+    "rollout_holds_total",
+    "Step advances withheld by the health gate, per controller and "
+    "reason (an open circuit, a fresh classified sync error, a sticky "
+    "rolled-back target) — the ramp holding its current step instead "
+    "of advancing into (or because of) a brownout.")
+default_registry.describe(
+    "rollout_rollbacks_total",
+    "Terminal health verdicts that triggered the automatic rollback "
+    "to the last good weights, per controller and reason.  The "
+    "Progressing->RollingBack edge fires EXACTLY once per failed "
+    "target (RolledBack is sticky until the target changes).")
+default_registry.describe(
     "race_lockset_checks",
     "Lock acquisitions screened by the runtime lockset tracker "
     "(analysis/locks.py) — nonzero proves the detector was armed.")
@@ -520,6 +538,34 @@ def record_drift_repair(registry: Optional[Registry] = None) -> None:
     (submitted while a sweep-origin sync was on the stack)."""
     reg = registry or default_registry
     reg.inc_counter("drift_repairs_total", {})
+
+
+def record_rollout_transition(controller: str, to: str,
+                              registry: Optional[Registry] = None) -> None:
+    """One rollout state-machine edge taken (start / step / complete /
+    rollback / rolled_back), persisted before its weights were
+    written."""
+    reg = registry or default_registry
+    reg.inc_counter("rollout_transitions_total",
+                    {"controller": controller, "to": to})
+
+
+def record_rollout_hold(controller: str, reason: str,
+                        registry: Optional[Registry] = None) -> None:
+    """One step advance withheld by the health gate (the ramp holds
+    its current step)."""
+    reg = registry or default_registry
+    reg.inc_counter("rollout_holds_total",
+                    {"controller": controller, "reason": reason})
+
+
+def record_rollout_rollback(controller: str, reason: str,
+                            registry: Optional[Registry] = None) -> None:
+    """One terminal health verdict triggered the auto-rollback (the
+    Progressing->RollingBack edge — exactly once per failed target)."""
+    reg = registry or default_registry
+    reg.inc_counter("rollout_rollbacks_total",
+                    {"controller": controller, "reason": reason})
 
 
 def record_lockset_checks(n: int = 1,

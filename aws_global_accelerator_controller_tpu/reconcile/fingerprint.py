@@ -142,10 +142,17 @@ class FingerprintCache:
 
     def __init__(self, controller: str,
                  fingerprint_fn: Callable[[object], object],
-                 config: Optional[FingerprintConfig] = None):
+                 config: Optional[FingerprintConfig] = None,
+                 skip_veto: Optional[Callable[[object], bool]] = None):
         self.controller = controller
         self.config = config or FingerprintConfig()
         self._fn = fingerprint_fn
+        # skip_veto(obj) -> True forces the full sync path regardless
+        # of a matching record: the safe-rollout interplay — a mid-ramp
+        # object's convergence is DRIVEN by timed re-deliveries, and a
+        # stale skip would stall the ramp at its current step forever.
+        # Pure over object state like the builder itself (L107).
+        self._skip_veto = skip_veto
         self._lock = locks.make_lock(f"fingerprint[{controller}]")
         # key -> (generation, digest), insertion-ordered for eviction
         self._fp: "OrderedDict[str, tuple]" = OrderedDict()
@@ -225,8 +232,12 @@ class FingerprintCache:
     def matches(self, key: str, obj) -> bool:
         """True iff the live object's fingerprint equals the one
         recorded at the last successful sync (same generation AND same
-        digest).  Never consults the provider (L107)."""
+        digest) and no skip veto is in force (a mid-ramp rollout pins
+        the key to the full path).  Never consults the provider
+        (L107)."""
         if not self.config.enabled:
+            return False
+        if self._skip_veto is not None and self._skip_veto(obj):
             return False
         with self._lock:
             have = self._fp.get(key)

@@ -1,0 +1,39 @@
+"""Safe rollouts: durable blue-green / canary weight ramps.
+
+- rollout/machine.py — the pure state machine (spec, persisted state,
+  desired/observed weights, health verdict -> outcome), with the
+  status-before-weights, fenced-transition, rollback-exactly-once and
+  drift-stays-a-snap contracts the chaos e2e asserts.
+- rollout/engine.py — the controller-facing gate: annotation parsing,
+  health composition (breaker / sync-error window / abort), fencing
+  tokens from the owning shard's lease, metrics.
+
+Lint rule L112 (analysis/concurrency_lint.py) keeps every
+endpoint-weight and weighted-record mutation outside this package
+consulting the gate.
+"""
+from .machine import (
+    HEALTH_DEGRADED,
+    HEALTH_FAILED,
+    HEALTH_OK,
+    HEALTHY,
+    Health,
+    Outcome,
+    PHASE_COMPLETED,
+    PHASE_PROGRESSING,
+    PHASE_ROLLED_BACK,
+    PHASE_ROLLING_BACK,
+    RolloutSpec,
+    RolloutState,
+    StaleRolloutTokenError,
+    advance,
+    planned_weights,
+    weights_digest,
+)
+from .engine import (
+    RolloutEngine,
+    breaker_region_health,
+    parse_spec,
+    rollout_active,
+    rollout_annotation_items,
+)

@@ -1,0 +1,293 @@
+"""The rollout engine: annotations + health signals -> machine turns.
+
+The impure half of the rollout subsystem (rollout/machine.py is the
+pure state machine).  One :class:`RolloutEngine` per controller:
+
+- parses the ``rollout.agac/*`` annotations into a
+  :class:`~.machine.RolloutSpec` (malformed ramps LOG and fall back to
+  the reference snap — a typo'd annotation must not wedge convergence);
+- composes the HEALTH verdict from signals the repo already produces:
+  the target region's circuit-breaker state (resilience/breaker.py —
+  open or probing = degraded: hold the step, a brownout is not the
+  release's fault), the controller's own recent classified sync errors
+  for the key (:meth:`note_error`, a rolling in-process window —
+  degraded), and the explicit ``rollout.agac/abort`` annotation (the
+  operator's / external prober's kill switch — FAILED, the terminal
+  verdict that triggers the auto-rollback);
+- resolves the FENCING TOKEN for every transition from the owning
+  shard's armed lease token (sharding/shardset.py) so a persisted step
+  always names the authority that wrote it, and a staler authority is
+  rejected (machine.StaleRolloutTokenError, a NoRetryError the
+  dispatch drops);
+- counts transitions/holds/rollbacks (metrics.py ``rollout_*``).
+
+The engine is consulted by BOTH weight planes — the
+EndpointGroupBinding controller's endpoint-group weights (state in
+object STATUS) and the Route53 controller's weighted record pairs
+(state in the controller-owned ``rollout.agac/state`` annotation,
+core kinds having no free status) — which is what lint rule L112
+polices: any endpoint-weight or weighted-record mutation outside
+``rollout/`` must consult this gate, or a code path could snap weights
+mid-ramp.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, Optional
+
+from .. import metrics
+from ..apis import (
+    ROLLOUT_ABORT_ANNOTATION,
+    ROLLOUT_HEALTH_ANNOTATION,
+    ROLLOUT_INTERVAL_ANNOTATION,
+    ROLLOUT_ROLLBACK_ANNOTATION,
+    ROLLOUT_STEPS_ANNOTATION,
+)
+from ..analysis import locks
+from .machine import (
+    HEALTH_DEGRADED,
+    HEALTH_FAILED,
+    HEALTHY,
+    Health,
+    Outcome,
+    PHASE_COMPLETED,
+    RolloutSpec,
+    RolloutState,
+    TRANSITION_ROLLBACK,
+    Weights,
+    advance,
+    weights_digest,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def parse_spec(annotations: Dict[str, str]) -> Optional[RolloutSpec]:
+    """``rollout.agac/*`` annotations -> RolloutSpec; None when the
+    object declares no ramp.  Malformed values log and return None
+    (snap semantics) rather than guessing at a ramp the operator did
+    not write."""
+    raw_steps = annotations.get(ROLLOUT_STEPS_ANNOTATION)
+    if raw_steps is None:
+        return None
+    try:
+        steps = tuple(int(s) for s in raw_steps.split(",") if s.strip())
+    except ValueError:
+        logger.error("bad %s value %r (want e.g. \"5,25,50,100\"); "
+                     "ramp disabled", ROLLOUT_STEPS_ANNOTATION,
+                     raw_steps)
+        return None
+    if (not steps or any(not 0 < s <= 100 for s in steps)
+            or any(b <= a for a, b in zip(steps, steps[1:]))):
+        logger.error("bad %s value %r: steps must be strictly "
+                     "increasing percentages in (0, 100]; ramp "
+                     "disabled", ROLLOUT_STEPS_ANNOTATION, raw_steps)
+        return None
+    if steps[-1] != 100:
+        # the ramp must END at the declared target — a ramp that stops
+        # short would leave the fleet permanently under-weighted
+        steps = steps + (100,)
+    interval = 30.0
+    raw_interval = annotations.get(ROLLOUT_INTERVAL_ANNOTATION)
+    if raw_interval is not None:
+        try:
+            interval = float(raw_interval)
+        except ValueError:
+            logger.error("bad %s value %r; ramp disabled",
+                         ROLLOUT_INTERVAL_ANNOTATION, raw_interval)
+            return None
+        if interval <= 0:
+            logger.error("%s must be > 0 seconds; ramp disabled",
+                         ROLLOUT_INTERVAL_ANNOTATION)
+            return None
+    health = annotations.get(ROLLOUT_HEALTH_ANNOTATION, "gated")
+    if health not in ("gated", "none"):
+        logger.error("bad %s value %r (want gated|none); using gated",
+                     ROLLOUT_HEALTH_ANNOTATION, health)
+        health = "gated"
+    rollback = annotations.get(ROLLOUT_ROLLBACK_ANNOTATION, "immediate")
+    return RolloutSpec(steps=steps, interval=interval, health=health,
+                      rollback=rollback)
+
+
+def rollout_annotation_items(annotations: Dict[str, str]) -> tuple:
+    """The sorted ``rollout.agac/*`` annotation items — what a
+    controller's fingerprint builder folds in so a ramp edit (steps,
+    interval, abort) always invalidates the steady-state skip.  Pure
+    (L107)."""
+    from ..apis import ROLLOUT_PREFIX
+    return tuple(sorted((k, v) for k, v in annotations.items()
+                        if k.startswith(ROLLOUT_PREFIX)))
+
+
+def rollout_active(state_dict: Optional[dict]) -> bool:
+    """Is a ramp (or its rollback) in flight per the persisted state?
+    Pure over the serialized dict — consulted by fingerprint skip
+    vetoes and the resume-on-acquire replay classification, so it must
+    never touch the provider (L107)."""
+    return RolloutState.from_dict(state_dict).active()
+
+
+class RolloutEngine:
+    """One controller's rollout gate (module docstring)."""
+
+    def __init__(self, controller: str, shards=None,
+                 region_health: Optional[Callable[[], "tuple"]] = None,
+                 clock: Callable[[], float] = time.time,
+                 monotonic: Callable[[], float] = time.monotonic,
+                 registry=None):
+        self.controller = controller
+        self.shards = shards
+        # region_health() -> (healthy: bool, reason: str) — the
+        # factory-built probe over the global region's circuit breaker
+        self.region_health = region_health
+        self._clock = clock
+        self._monotonic = monotonic
+        self._registry = registry
+        self._lock = locks.make_lock(f"rollout-engine[{controller}]")
+        # key -> monotonic stamp of the last classified sync error:
+        # the in-process half of the health window.  Process-local by
+        # design — a successor starts with a clean window and the
+        # persisted step's bake interval still gates its advance.
+        self._errors: Dict[str, float] = {}
+
+    # -- health signal feeds (the controller's sync loop) --------------
+
+    def note_error(self, key: str) -> None:
+        """The controller's sync for ``key`` failed with a classified
+        error: advancement is withheld while the error is fresher than
+        the ramp's bake interval."""
+        with self._lock:
+            self._errors[key] = self._monotonic()
+
+    def note_ok(self, key: str) -> None:
+        with self._lock:
+            self._errors.pop(key, None)
+
+    def _recent_error(self, key: str, window: float) -> bool:
+        with self._lock:
+            stamp = self._errors.get(key)
+        return stamp is not None and self._monotonic() - stamp < window
+
+    # -- verdict composition -------------------------------------------
+
+    def health_for(self, key: str, spec: RolloutSpec,
+                   annotations: Dict[str, str]) -> Health:
+        """Compose the verdict: the abort annotation is TERMINAL
+        whatever the policy (it is an explicit operator / external
+        prober action); with policy "gated", an unhealthy region
+        (breaker not closed) or a fresh classified sync error DEGRADES
+        (hold, never advance into or because of a brownout)."""
+        abort = annotations.get(ROLLOUT_ABORT_ANNOTATION)
+        if abort is not None:
+            return Health(HEALTH_FAILED, f"abort: {abort or 'set'}")
+        if spec.health == "none":
+            return HEALTHY
+        if self.region_health is not None:
+            healthy, reason = self.region_health()
+            if not healthy:
+                return Health(HEALTH_DEGRADED, reason)
+        if self._recent_error(key, spec.interval):
+            return Health(HEALTH_DEGRADED,
+                          "sync_errors: classified sync error within "
+                          "the bake interval")
+        return HEALTHY
+
+    # -- fencing -------------------------------------------------------
+
+    def token_for(self, route: str) -> int:
+        """The fencing token stamped on transitions: the owning
+        shard's armed lease token (monotone across handoffs/terms —
+        leaderelection/shards.py arms it per term)."""
+        if self.shards is None:
+            return 0
+        return self.shards.token(self.shards.shard_of(route))
+
+    # -- the gate (what lint rule L112 requires callers to consult) ----
+
+    def decide(self, *, key: str, route: str,
+               annotations: Dict[str, str],
+               state_dict: Optional[dict], desired: Weights,
+               observed: Weights, generation: int = 0) -> Outcome:
+        """One rollout turn for ``key``: the controller persists
+        ``Outcome.state`` BEFORE issuing ``Outcome.write`` and uses
+        ``Outcome.hold`` for every concurrent weight-bearing path (a
+        new endpoint's add weight, a record re-upsert).
+
+        No declared ramp — or a target containing None weights ("leave
+        the cloud default", which cannot be interpolated) — keeps the
+        reference snap semantics: write desired iff observed diverges.
+        A ramp whose annotations were REMOVED mid-flight completes
+        immediately at the target (the operator asked for the snap
+        back) and clears the active state so fingerprint vetoes and
+        acquire replays stop treating the key as mid-ramp."""
+        spec = parse_spec(annotations)
+        state = RolloutState.from_dict(state_dict)
+        now = self._clock()
+        token = self.token_for(route)
+        if spec is None or any(v is None for v in desired.values()):
+            write = None if _converged(observed, desired) else dict(desired)
+            outcome = Outcome(write=write, hold=dict(desired))
+            if state.active():
+                # annotations removed mid-ramp: snap to target and
+                # persist the terminal state (stamped with our token —
+                # a stale owner must not be the one to cancel a ramp)
+                if token < state.token:
+                    from .machine import StaleRolloutTokenError
+                    raise StaleRolloutTokenError(state.token, token)
+                import dataclasses
+                outcome.state = dataclasses.replace(
+                    state, phase=PHASE_COMPLETED,
+                    target_digest=weights_digest(desired),
+                    from_weights=dict(desired),
+                    to_weights=dict(desired), token=token,
+                    generation=generation, updated_at=now,
+                    reason="rollout annotations removed")
+            return outcome
+        health = self.health_for(key, spec, annotations)
+        outcome = advance(spec, state, desired, observed, now, token,
+                          health=health, generation=generation)
+        if outcome.transition is not None:
+            metrics.record_rollout_transition(
+                self.controller, outcome.transition,
+                registry=self._registry)
+            if outcome.transition == TRANSITION_ROLLBACK:
+                # label by the reason CLASS (the part before ':'), not
+                # the free-form detail — metric labels must stay
+                # bounded however creative abort messages get
+                reason = (outcome.state.reason
+                          if outcome.state is not None else "")
+                metrics.record_rollout_rollback(
+                    self.controller, reason.split(":", 1)[0] or "failed",
+                    registry=self._registry)
+        if outcome.hold_reason is not None:
+            metrics.record_rollout_hold(
+                self.controller,
+                outcome.hold_reason.split(":", 1)[0] or "held",
+                registry=self._registry)
+        return outcome
+
+
+def _converged(observed: Weights, desired: Weights) -> bool:
+    sentinel = object()
+    return all(observed.get(k, sentinel) == v
+               for k, v in desired.items())
+
+
+def breaker_region_health(factory) -> Callable[[], "tuple"]:
+    """The factory-built region-health probe: healthy iff the GLOBAL
+    control plane's circuit breaker (GA + Route53 are homed in
+    us-west-2) is fully closed.  An unwrapped bundle (resilience
+    disabled) has no breaker and reports healthy — there is no signal
+    to gate on."""
+    def probe() -> "tuple":
+        apis = factory.global_provider().apis
+        breaker = getattr(apis, "breaker", None)
+        if breaker is None:
+            return True, ""
+        state = breaker.state()
+        if state == "closed":
+            return True, ""
+        return False, f"circuit: {breaker.region} {state}"
+    return probe

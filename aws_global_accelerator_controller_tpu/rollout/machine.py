@@ -1,0 +1,362 @@
+"""The safe-rollout state machine: a pure, durable-state weight ramp.
+
+The reference (and every PR before this one) converges endpoint weights
+and record weights by SNAPPING them: one atomic write from whatever is
+observed to whatever the spec demands.  ROADMAP item 5's blue-green
+acceptance line ("ramp survives a throttle burst without snapping
+weights") needs the opposite shape — a declared multi-step ramp whose
+progress is DURABLE: every transition is persisted to the owning
+object's status (or, for core kinds, a controller-owned annotation)
+BEFORE the weights it implies are written, so a crash, a leader
+handoff, or a shard rebalance mid-ramp resumes from the persisted step
+instead of re-snapping to 100 or replaying a step that already landed.
+The Prime CCL shape (PAPERS.md): long-running distributed transitions
+survive member churn by making progress durable and fenced, never by
+trusting process memory.
+
+This module is the PURE half: :func:`advance` maps
+
+    (spec, persisted state, desired target weights, observed weights,
+     wall-clock now, the caller's fencing token, a health verdict)
+
+to an :class:`Outcome` — the state to persist (stamped with the
+caller's token), the weights to write NOW, the weights that should be
+IN FORCE now (``hold`` — what a concurrent convergence path must write
+instead of the final target), and when to come back.  No clocks, no
+providers, no Kubernetes: the resumability matrix in
+tests/test_rollout.py drives this function through kill/restart at
+every boundary without a cluster.
+
+Contracts the callers rely on (and the chaos e2e asserts):
+
+- **status before weights**: the caller persists ``Outcome.state``
+  before issuing ``Outcome.write``.  A crash between the two leaves
+  persisted-step >= written-step, and the resume branch (observed !=
+  planned -> write planned) converges forward — weights are MONOTONE
+  along the ramp, never revert-then-rejump.
+- **fenced transitions**: every persisted state stamps the caller's
+  fencing token (the owning shard's armed lease token).  ``advance``
+  raises :class:`StaleRolloutTokenError` (a NoRetryError — the dispatch
+  drops it) when the persisted token is NEWER than the caller's: a
+  deposed owner resumed from a stale lease must not move the ramp.
+- **rollback exactly once**: the ``rollback`` transition fires only on
+  the Progressing -> RollingBack edge; RollingBack converges to the
+  recorded ``from_weights`` idempotently (duplicate deliveries write
+  only while observed diverges) and RolledBack is STICKY for the
+  target digest that failed — only a new target (spec change) ramps
+  again.
+- **drift repair stays a snap**: a COMPLETED ramp whose observed
+  weights drift out-of-band is repaired by one immediate write of the
+  known-good target (the drift sweep's semantics), never by a new
+  ramp — ramps are for NEW targets, not for restoring old ones.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+from dataclasses import dataclass, field, replace
+from typing import Dict, Optional, Tuple
+
+from ..errors import NoRetryError
+
+logger = logging.getLogger(__name__)
+
+PHASE_PROGRESSING = "Progressing"
+PHASE_COMPLETED = "Completed"
+PHASE_ROLLING_BACK = "RollingBack"
+PHASE_ROLLED_BACK = "RolledBack"
+
+# health verdicts (rollout/engine.py composes them)
+HEALTH_OK = "healthy"
+HEALTH_DEGRADED = "degraded"     # hold the step, do not advance
+HEALTH_FAILED = "failed"         # terminal: auto-rollback
+
+# transitions an Outcome reports (the metric label set)
+TRANSITION_START = "start"
+TRANSITION_STEP = "step"
+TRANSITION_COMPLETE = "complete"
+TRANSITION_ROLLBACK = "rollback"
+TRANSITION_ROLLED_BACK = "rolled_back"
+
+Weights = Dict[str, Optional[int]]
+
+
+class StaleRolloutTokenError(NoRetryError):
+    """A transition was attempted with a fencing token OLDER than the
+    one stamped on the persisted rollout state: a newer owner has
+    already moved this ramp, so this caller's authority is dead.
+    No-retry by type — the owning replica converges the key."""
+
+    def __init__(self, persisted: int, presented: int):
+        super().__init__(
+            f"stale rollout fencing token: persisted state carries "
+            f"token {persisted}, this owner presented {presented}")
+        self.persisted = persisted
+        self.presented = presented
+
+
+@dataclass(frozen=True)
+class RolloutSpec:
+    """The declared ramp (parsed from the ``rollout.agac/*``
+    annotations — rollout/engine.py owns the parsing)."""
+
+    steps: Tuple[int, ...] = (5, 25, 50, 100)   # percent of target
+    interval: float = 30.0                      # step bake seconds
+    health: str = "gated"                       # "gated" | "none"
+    rollback: str = "immediate"                 # reserved: "immediate"
+
+    @property
+    def converge_retry(self) -> float:
+        """Requeue delay while converging/holding a step — a fraction
+        of the bake interval, bounded so fake-clock tests stay fast
+        and production ramps do not hot-spin."""
+        return min(1.0, max(0.05, self.interval / 5.0))
+
+
+def weights_digest(weights: Weights) -> str:
+    """Canonical identity of a target weight vector: the ramp restarts
+    exactly when this changes (a spec edit, a policy re-plan, an
+    endpoint joining or leaving the set)."""
+    canon = sorted((k, v) for k, v in weights.items())
+    return hashlib.sha1(repr(canon).encode()).hexdigest()[:16]
+
+
+@dataclass
+class RolloutState:
+    """The durable half: everything a successor needs to resume the
+    ramp lives HERE (object status / state annotation), never in
+    process memory."""
+
+    phase: str = ""
+    step: int = 0
+    step_started_at: float = 0.0     # wall clock (epoch): survives restart
+    target_digest: str = ""
+    from_weights: Weights = field(default_factory=dict)
+    to_weights: Weights = field(default_factory=dict)
+    token: int = 0                   # fencing token of the last transition
+    generation: int = 0              # object generation at the transition
+    reason: str = ""                 # rollback / hold reason, for humans
+    updated_at: float = 0.0
+
+    def active(self) -> bool:
+        return self.phase in (PHASE_PROGRESSING, PHASE_ROLLING_BACK)
+
+    # -- serialization (status dict / annotation JSON) -----------------
+
+    def to_dict(self) -> dict:
+        return {
+            "phase": self.phase,
+            "step": self.step,
+            "stepStartedAt": self.step_started_at,
+            "targetDigest": self.target_digest,
+            "fromWeights": dict(self.from_weights),
+            "toWeights": dict(self.to_weights),
+            "token": self.token,
+            "generation": self.generation,
+            "reason": self.reason,
+            "updatedAt": self.updated_at,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "RolloutState":
+        if not d:
+            return cls()
+        def _weights(raw) -> Weights:
+            return {str(k): (int(v) if v is not None else None)
+                    for k, v in (raw or {}).items()}
+        return cls(
+            phase=str(d.get("phase", "")),
+            step=int(d.get("step", 0)),
+            step_started_at=float(d.get("stepStartedAt", 0.0)),
+            target_digest=str(d.get("targetDigest", "")),
+            from_weights=_weights(d.get("fromWeights")),
+            to_weights=_weights(d.get("toWeights")),
+            token=int(d.get("token", 0)),
+            generation=int(d.get("generation", 0)),
+            reason=str(d.get("reason", "")),
+            updated_at=float(d.get("updatedAt", 0.0)),
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True,
+                          separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, raw: Optional[str]) -> "RolloutState":
+        if not raw:
+            return cls()
+        try:
+            return cls.from_dict(json.loads(raw))
+        except (ValueError, TypeError, AttributeError):
+            logger.error("unparsable rollout state %r — treating as "
+                         "no recorded ramp", raw[:120])
+            return cls()
+
+
+@dataclass(frozen=True)
+class Health:
+    verdict: str = HEALTH_OK
+    reason: str = ""
+
+
+HEALTHY = Health()
+
+
+@dataclass
+class Outcome:
+    """What one :func:`advance` call asks the caller to do.
+
+    Ordering contract: persist ``state`` FIRST (when not None), then
+    issue ``write`` (when not None), then schedule ``requeue_after``
+    (0 = the ramp needs no revisit — completed, rolled back, or idle).
+    ``hold`` is the weight vector that should be IN FORCE right now:
+    any concurrent convergence path (a new endpoint being added, an
+    ensure re-upserting a drifted record) must use it instead of the
+    final target, or the ramp snaps.  ``transition`` names the edge
+    taken (metrics); ``hold_reason`` names why an advance was withheld
+    (health degradation, bake interval)."""
+
+    state: Optional[RolloutState] = None
+    write: Optional[Weights] = None
+    hold: Optional[Weights] = None
+    requeue_after: float = 0.0
+    transition: Optional[str] = None
+    hold_reason: Optional[str] = None
+
+
+def planned_weights(state: RolloutState, spec: RolloutSpec,
+                    step: int) -> Weights:
+    """The weight vector step ``step`` serves: a per-key linear
+    interpolation from ``from_weights`` to ``to_weights`` at the
+    step's declared percentage.  Monotone per key along the declared
+    steps whenever the steps are (spec parsing enforces strictly
+    increasing), so observed weights can never legally regress
+    mid-ramp — the chaos e2e's monotonicity assertion."""
+    pct = spec.steps[min(step, len(spec.steps) - 1)]
+    out: Weights = {}
+    for key, to in state.to_weights.items():
+        frm = state.from_weights.get(key)
+        frm = frm if isinstance(frm, int) else 0
+        if to is None:
+            out[key] = None      # "leave the cloud default" never ramps
+        elif pct >= 100:
+            out[key] = to
+        else:
+            out[key] = int(round(frm + (to - frm) * pct / 100.0))
+    return out
+
+
+def _match(observed: Weights, target: Weights) -> bool:
+    """Converged iff every target key's observed weight equals the
+    target's (keys absent from ``observed`` — an endpoint not yet in
+    the group, a record not yet created — never match)."""
+    sentinel = object()
+    return all(observed.get(k, sentinel) == v for k, v in target.items())
+
+
+def advance(spec: RolloutSpec, state: RolloutState, desired: Weights,
+            observed: Weights, now: float, token: int,
+            health: Health = HEALTHY, generation: int = 0) -> Outcome:
+    """One turn of the rollout state machine (module docstring has the
+    caller contracts).  Pure: same inputs, same outcome."""
+    if token < state.token:
+        raise StaleRolloutTokenError(state.token, token)
+
+    digest = weights_digest(desired)
+    fresh_target = state.target_digest != digest
+
+    def stamped(st: RolloutState, **kw) -> RolloutState:
+        return replace(st, token=token, generation=generation,
+                       updated_at=now, **kw)
+
+    if state.phase == PHASE_ROLLED_BACK and not fresh_target:
+        # sticky: the target that failed its health gate must not be
+        # re-ramped by the next resync — only a NEW target (spec or
+        # plan change) re-arms the machine.  Hold the rolled-back
+        # weights so convergence paths keep them in force, and repair
+        # out-of-band drift against them with an immediate write (the
+        # Completed branch's drift semantics — the EGB plane mutates
+        # only from ``write``, so hold alone would leave a drifted
+        # rolled-back group wrong forever).
+        write = (None if _match(observed, state.from_weights)
+                 else dict(state.from_weights))
+        return Outcome(write=write, hold=dict(state.from_weights),
+                       hold_reason="rolled_back")
+
+    if state.phase == PHASE_ROLLING_BACK and not fresh_target:
+        if not _match(observed, state.from_weights):
+            # idempotent under duplicate delivery: writes happen only
+            # while observed still diverges from the last good weights
+            return Outcome(write=dict(state.from_weights),
+                           hold=dict(state.from_weights),
+                           requeue_after=spec.converge_retry)
+        ns = stamped(state, phase=PHASE_ROLLED_BACK)
+        return Outcome(state=ns, hold=dict(state.from_weights),
+                       transition=TRANSITION_ROLLED_BACK)
+
+    if state.phase != PHASE_PROGRESSING or fresh_target:
+        # idle (never ramped), completed, or the target moved (a
+        # mid-ramp target change restarts the ramp from observed)
+        if _match(observed, desired):
+            if state.phase == PHASE_COMPLETED and not fresh_target:
+                return Outcome(hold=dict(desired))   # steady state
+            ns = stamped(state, phase=PHASE_COMPLETED, step=0,
+                         target_digest=digest,
+                         from_weights=dict(desired),
+                         to_weights=dict(desired), reason="")
+            return Outcome(state=ns, hold=dict(desired),
+                           transition=TRANSITION_COMPLETE)
+        if state.phase == PHASE_COMPLETED and not fresh_target:
+            # out-of-band drift against a converged target: repair is
+            # an immediate snap back to known-good, never a new ramp
+            return Outcome(write=dict(desired), hold=dict(desired))
+        frm: Weights = {
+            k: (observed.get(k) if isinstance(observed.get(k), int)
+                else 0)
+            for k in desired}
+        ns = stamped(state, phase=PHASE_PROGRESSING, step=0,
+                     step_started_at=now, target_digest=digest,
+                     from_weights=frm, to_weights=dict(desired),
+                     reason="")
+        plan = planned_weights(ns, spec, 0)
+        return Outcome(state=ns, write=plan, hold=plan,
+                       requeue_after=spec.interval,
+                       transition=TRANSITION_START)
+
+    # PROGRESSING on the current target
+    plan = planned_weights(state, spec, state.step)
+    if health.verdict == HEALTH_FAILED:
+        ns = stamped(state, phase=PHASE_ROLLING_BACK,
+                     reason=health.reason or "health verdict failed")
+        write = (None if _match(observed, state.from_weights)
+                 else dict(state.from_weights))
+        return Outcome(state=ns, write=write,
+                       hold=dict(state.from_weights),
+                       requeue_after=spec.converge_retry,
+                       transition=TRANSITION_ROLLBACK)
+    if not _match(observed, plan):
+        # converge (or resume after a crash / repair mid-step drift):
+        # re-issue exactly the persisted step's weights — never the
+        # final target, never a guess
+        return Outcome(write=plan, hold=plan,
+                       requeue_after=spec.converge_retry)
+    remaining = state.step_started_at + spec.interval - now
+    if remaining > 0:
+        return Outcome(hold=plan,
+                       requeue_after=max(remaining, 0.01))
+    if health.verdict == HEALTH_DEGRADED:
+        # unhealthy-but-not-terminal (open circuit, fresh sync errors):
+        # hold the converged step — never advance INTO a brownout, and
+        # never mistake one for a bad release either
+        return Outcome(hold=plan, requeue_after=spec.converge_retry,
+                       hold_reason=health.reason or "degraded")
+    if state.step >= len(spec.steps) - 1:
+        ns = stamped(state, phase=PHASE_COMPLETED)
+        return Outcome(state=ns, hold=plan,
+                       transition=TRANSITION_COMPLETE)
+    ns = stamped(state, step=state.step + 1, step_started_at=now)
+    next_plan = planned_weights(ns, spec, ns.step)
+    return Outcome(state=ns, write=next_plan, hold=next_plan,
+                   requeue_after=spec.interval,
+                   transition=TRANSITION_STEP)

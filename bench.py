@@ -610,6 +610,177 @@ def bench_restart_recovery(n_services: int = 1000, workers: int = 4,
     return out
 
 
+def bench_rollout_ramp(n_bindings: int = 200, workers: int = 6,
+                       endpoints_per_binding: int = 3,
+                       steps: str = "25,50,100",
+                       interval: float = 0.25,
+                       record: bool = False) -> dict:
+    """Safe-rollout scale leg (ISSUE 10): ``n_bindings``
+    EndpointGroupBindings — each binding its own endpoint group with
+    ``endpoints_per_binding`` LB endpoints — ramping CONCURRENTLY
+    through the declared steps.  Measures (a) per-binding ramp
+    completion latency over the theoretical bake floor (p50/p99 of the
+    per-step advance overhead: how long after a step COULD advance the
+    fleet actually converged it), and (b) total ``update_endpoint_group``
+    mutation calls: every step is ONE coalesced RMW per binding however
+    many endpoints ride it, so calls stay ~steps*bindings while intents
+    run steps*bindings*endpoints (the fold the write path owes the
+    ramp).
+
+    ``record=True`` appends to reconcile_history.jsonl tagged
+    ``bench: "rollout-ramp"`` (the derived reconcile floor skips
+    tagged entries — ``throughput`` here is ramps completed/s, not the
+    create storm's converge rate)."""
+    sys.path.insert(0, "tests")
+    from harness import Cluster, wait_until
+
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+        ROLLOUT_INTERVAL_ANNOTATION,
+        ROLLOUT_STEPS_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (  # noqa: E501
+        EndpointGroupBinding,
+        EndpointGroupBindingSpec,
+        ServiceReference,
+    )
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (  # noqa: E501
+        PortRange,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+    from aws_global_accelerator_controller_tpu.rollout import (
+        PHASE_COMPLETED,
+    )
+
+    region = "ap-northeast-1"
+    step_list = [int(s) for s in steps.split(",")]
+    cluster = Cluster(workers=workers, queue_qps=100000.0,
+                      queue_burst=100000, resync_period=30.0).start()
+    try:
+        ga = cluster.cloud.ga
+        acc = ga.create_accelerator("ramp-bench", "IPV4", True, {})
+        listener = ga.create_listener(
+            acc.accelerator_arn, [PortRange(80, 80)], "TCP", "NONE")
+        for i in range(n_bindings):
+            hostnames = []
+            for j in range(endpoints_per_binding):
+                name = f"rb{i:04d}-{j}"
+                hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                            ".amazonaws.com")
+                cluster.cloud.elb.register_load_balancer(
+                    name, hostname, region)
+                hostnames.append(hostname)
+            seed = cluster.cloud.elb.register_load_balancer(
+                f"rbseed{i:04d}",
+                f"rbseed{i:04d}-0123456789abcdef.elb.eu-west-1"
+                f".amazonaws.com", "eu-west-1")
+            eg = ga.create_endpoint_group(
+                listener.listener_arn, "eu-west-1",
+                seed.load_balancer_arn, False)
+            cluster.kube.services.create(Service(
+                metadata=ObjectMeta(
+                    name=f"rbsvc{i:04d}", namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external"}),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(
+                    load_balancer=LoadBalancerStatus(
+                        ingress=[LoadBalancerIngress(hostname=h)
+                                 for h in hostnames]))))
+            cluster.operator.endpoint_group_bindings.create(
+                EndpointGroupBinding(
+                    metadata=ObjectMeta(
+                        name=f"rb{i:04d}", namespace="default",
+                        annotations={
+                            ROLLOUT_STEPS_ANNOTATION: steps,
+                            ROLLOUT_INTERVAL_ANNOTATION:
+                                str(interval)}),
+                    spec=EndpointGroupBindingSpec(
+                        endpoint_group_arn=eg.endpoint_group_arn,
+                        weight=200,
+                        service_ref=ServiceReference(
+                            name=f"rbsvc{i:04d}"))))
+
+        calls_before = cluster.cloud.faults.call_counts().get(
+            "update_endpoint_group", 0)
+        started = {f"rb{i:04d}": time.perf_counter()
+                   for i in range(n_bindings)}
+        completed: dict = {}
+
+        def poll_completed() -> int:
+            now = time.perf_counter()
+            for b in cluster.operator.endpoint_group_bindings.list():
+                name = b.metadata.name
+                if name in completed or not b.status.rollout:
+                    continue
+                if b.status.rollout.get("phase") == PHASE_COMPLETED:
+                    completed[name] = now
+            return len(completed)
+
+        start = time.perf_counter()
+        wait_until(lambda: poll_completed() == n_bindings,
+                   timeout=600.0, interval=0.05,
+                   message=f"{n_bindings} ramps completed")
+        elapsed = time.perf_counter() - start
+        calls = cluster.cloud.faults.call_counts().get(
+            "update_endpoint_group", 0) - calls_before
+    finally:
+        cluster.shutdown()
+
+    # each binding owes len(step_list) bake intervals before its
+    # completion can persist (step 0 starts the clock, each later
+    # step + the completion waits one bake) — per-step advance
+    # overhead is what the fleet adds on top of that floor
+    floor = len(step_list) * interval
+    durations = sorted(completed[k] - started[k] for k in completed)
+    overheads = [max(0.0, d - floor) / len(step_list)
+                 for d in durations]
+
+    def pct(xs, p):
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    intents = n_bindings * len(step_list) * endpoints_per_binding
+    run = {
+        "bindings": n_bindings,
+        "endpoints_per_binding": endpoints_per_binding,
+        "steps": step_list,
+        "interval_s": interval,
+        "workers": workers,
+        "elapsed_s": round(elapsed, 3),
+        "throughput": round(n_bindings / elapsed, 1),  # ramps/s
+        "ramp_p50_s": round(pct(durations, 0.50), 3),
+        "ramp_p99_s": round(pct(durations, 0.99), 3),
+        "step_advance_overhead_p50_s": round(
+            pct(sorted(overheads), 0.50), 4),
+        "step_advance_overhead_p99_s": round(
+            pct(sorted(overheads), 0.99), 4),
+        "mutation_calls": calls,
+        "calls_per_binding_step": round(
+            calls / (n_bindings * len(step_list)), 2),
+        "weight_intents": intents,
+        "fold_ratio": round(intents / max(calls, 1), 2),
+    }
+    if record:
+        # the helper's "services" column is the fleet size; here that
+        # is the binding count (throughput is ramps completed/s)
+        _record_reconcile_history(
+            {**run, "services": n_bindings}, bench="rollout-ramp",
+            extra={"mutation_calls": calls,
+                   "fold_ratio": run["fold_ratio"],
+                   "step_advance_overhead_p99_s":
+                       run["step_advance_overhead_p99_s"]})
+    return run
+
+
 def bench_mixed_soak(n_services: int = 1000, workers: int = 6,
                      resync: float = 1.0, sweep_every: int = 50,
                      churn_seconds: float = 10.0,
@@ -2768,6 +2939,7 @@ _NAMED = {
     "restart-recovery": lambda: bench_restart_recovery(record=True),
     "shard-scaling": lambda: bench_shard_scaling(record=True),
     "mixed-soak": lambda: bench_mixed_soak(record=True),
+    "rollout-ramp": lambda: bench_rollout_ramp(record=True),
     "planner": lambda: _json_bench_subprocess(
         "bench_planner", "planner bench", 300.0),
     "flash": bench_flash_subprocess,

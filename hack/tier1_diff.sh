@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# The per-PR "failure set no worse" gate as ONE command (`make
+# tier1-diff`): run tier-1 on a clean BASELINE checkout (a detached
+# git worktree of TIER1_BASE, default HEAD — the stashed-HEAD ritual
+# every PR since the accelerator drift has hand-rolled) and on the
+# working tree, then diff the FAILED/ERROR sets with
+# hack/diff_failures.py.  Exit status is diff_failures' own: 0 = no
+# newly-failing tests (fixes alone are fine), 1 = regressions, 2 =
+# unusable logs.
+#
+# The package resolves from the pytest cwd (it is not installed), so
+# the baseline worktree runs the baseline CODE — the two runs share
+# nothing but the interpreter.  Both logs are kept (TIER1_BASE_LOG /
+# TIER1_HEAD_LOG, defaults under /tmp) for post-mortems.
+#
+# Documented in docs/operations.md "Tier-1 workflow".
+set -uo pipefail
+
+BASE_REF="${TIER1_BASE:-HEAD}"
+BASE_LOG="${TIER1_BASE_LOG:-/tmp/tier1_base.log}"
+HEAD_LOG="${TIER1_HEAD_LOG:-/tmp/tier1_head.log}"
+REPO="$(git rev-parse --show-toplevel)" || exit 2
+WT="$(mktemp -d /tmp/tier1-base.XXXXXX)" || exit 2
+
+# ROADMAP.md's tier-1 verify line, minus the pass-count accounting
+run_tier1() {
+    timeout -k 10 870 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly
+}
+
+cleanup() {
+    git -C "$REPO" worktree remove --force "$WT" >/dev/null 2>&1 || true
+    rm -rf "$WT"
+}
+trap cleanup EXIT
+
+if ! git -C "$REPO" worktree add --detach "$WT" "$BASE_REF" >/dev/null; then
+    echo "tier1-diff: cannot create baseline worktree at $BASE_REF" >&2
+    exit 2
+fi
+
+echo "tier1-diff: baseline $BASE_REF -> $BASE_LOG"
+(cd "$WT" && run_tier1) >"$BASE_LOG" 2>&1
+echo "tier1-diff: working tree -> $HEAD_LOG"
+(cd "$REPO" && run_tier1) >"$HEAD_LOG" 2>&1
+
+python "$REPO/hack/diff_failures.py" "$BASE_LOG" "$HEAD_LOG"

@@ -166,3 +166,74 @@ def test_out_of_band_endpoint_drift_repaired_by_sweep(cluster):
             {"controller": "EndpointGroupBinding"}) > skips_mid,
         timeout=10.0, message="gate warm again after the repair")
     assert endpoint_weight() == 32
+
+
+def test_out_of_band_record_weight_drift_repaired_by_sweep(cluster):
+    """The record-plane twin of the endpoint drift scenario: a
+    converged WEIGHTED record is re-weighted directly in the fake zone
+    (FaultInjector.edit_record_set — no API call, no watch event, no
+    invalidation) while fingerprints are warm; the drift sweep's
+    record read-back (need_records_update now compares served weight)
+    must detect and repair it within the sweep period."""
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        ROUTE53_HOSTNAME_ANNOTATION,
+        ROUTE53_SET_IDENTIFIER_ANNOTATION,
+        ROUTE53_WEIGHT_ANNOTATION,
+    )
+
+    reg = metrics.default_registry
+    nlb = nlb_hostname("wrr-svc")
+    cluster.cloud.elb.register_load_balancer("wrr-svc", nlb, REGION)
+    zone = cluster.cloud.route53.create_hosted_zone("example.com")
+    cluster.kube.services.create(Service(
+        metadata=ObjectMeta(
+            name="wrr-svc", namespace="default",
+            annotations={
+                AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                ROUTE53_HOSTNAME_ANNOTATION: "wrr.example.com",
+                ROUTE53_SET_IDENTIFIER_ANNOTATION: "blue",
+                ROUTE53_WEIGHT_ANNOTATION: "80",
+            }),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=nlb)])),
+    ))
+
+    def record_weight():
+        for r in cluster.cloud.route53.list_resource_record_sets(zone.id):
+            if r.type == "A" and r.set_identifier == "blue":
+                return r.weight
+        return "absent"
+
+    wait_until(lambda: record_weight() == 80, timeout=20.0,
+               message="weighted record converged at 80")
+
+    # fingerprints warm on the service queue
+    controller = "route53-controller-service"
+    skips_before = reg.counter_value(
+        "reconcile_fastpath_skips_total", {"controller": controller})
+    wait_until(
+        lambda: reg.counter_value(
+            "reconcile_fastpath_skips_total",
+            {"controller": controller}) > skips_before,
+        timeout=10.0, message="route53 fingerprint gate warm")
+
+    repairs_before = reg.counter_value("drift_repairs_total")
+    cluster.cloud.faults.edit_record_set(
+        zone.id, "wrr.example.com", "A", set_identifier="blue",
+        weight=3)
+    assert record_weight() == 3, "the out-of-band edit must land"
+    drifted_at = time.monotonic()
+
+    wait_until(lambda: record_weight() == 80,
+               timeout=10 * SWEEP_PERIOD,
+               message="record drift repaired by the sweep")
+    repaired_in = time.monotonic() - drifted_at
+    assert repaired_in <= 2 * SWEEP_PERIOD + RESYNC, \
+        f"repair took {repaired_in:.2f}s (sweep period {SWEEP_PERIOD}s)"
+    wait_until(
+        lambda: reg.counter_value("drift_repairs_total") > repairs_before,
+        timeout=2.0, message="record drift repair counted")

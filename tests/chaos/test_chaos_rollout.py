@@ -1,0 +1,429 @@
+"""Safe-rollout chaos e2e: durable ramps under fire (ISSUE 10).
+
+Three scenarios over the EndpointGroupBinding weight plane plus the
+record-plane twin, all under the runtime race detectors:
+
+1. the flagship: a 4-step ramp completes through 20% AWS chaos + a GA
+   throttle burst + one mid-ramp ABRUPT leader handoff (kill the
+   manager, start a fresh one over the same apiserver + cloud), with
+   MONOTONE observed weights — every sampled value is one of the
+   declared step weights, in order, no snap to the target and no
+   revert-then-rejump across the handoff;
+2. an injected health failure at step 3 (the ``rollout.agac/abort``
+   annotation — the external-prober kill switch) rolls back to the
+   last good weights EXACTLY once, and the rolled-back target stays
+   dead until the spec changes;
+3. kill/restart mid-ramp resumes from the persisted step with ZERO
+   duplicate weight writes — the total ``update_endpoint_group`` call
+   count across both processes is exactly the per-step minimum;
+4. a weighted Route53 record pair ramps monotonically through 20%
+   chaos + a ZONE throttle burst (the per-zone token bucket on the
+   record-change methods — the one stressor that actually gates the
+   record plane).
+"""
+import time
+
+from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    ROLLOUT_ABORT_ANNOTATION,
+    ROLLOUT_INTERVAL_ANNOTATION,
+    ROLLOUT_STEPS_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (  # noqa: E501
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+    PortRange,
+)
+from aws_global_accelerator_controller_tpu.kube.apiserver import (
+    FakeAPIServer,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+from aws_global_accelerator_controller_tpu.rollout import (
+    PHASE_COMPLETED,
+    PHASE_ROLLED_BACK,
+    RolloutState,
+)
+
+from harness import Cluster, wait_until
+
+REGION = "ap-northeast-1"
+SEED = 20261001
+
+
+def nlb_hostname(name):
+    return f"{name}-0123456789abcdef.elb.{REGION}.amazonaws.com"
+
+
+def lb_service(name):
+    return Service(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            annotations={AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external"}),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=nlb_hostname(name))])),
+    )
+
+
+def external_endpoint_group(cloud, seed_region="eu-west-1"):
+    """An externally-owned accelerator chain + endpoint group with one
+    seed endpoint (the shape the EGB controller binds into)."""
+    ga = cloud.ga
+    acc = ga.create_accelerator("ext", "IPV4", True, {})
+    listener = ga.create_listener(
+        acc.accelerator_arn, [PortRange(80, 80)], "TCP", "NONE")
+    seed_lb = cloud.elb.register_load_balancer(
+        "seed", f"seed-0123456789abcdef.elb.{seed_region}.amazonaws.com",
+        seed_region)
+    return ga.create_endpoint_group(
+        listener.listener_arn, seed_region,
+        seed_lb.load_balancer_arn, False)
+
+
+def peek_weight(cloud, eg_arn, endpoint_id):
+    """Read the endpoint's weight DIRECTLY from fake state — no API
+    call, no fault draw consumed, so sampling never perturbs the
+    seeded chaos schedule it is observing."""
+    ga = cloud.ga
+    with ga._lock:
+        entry = ga._endpoint_groups.get(eg_arn)
+        if entry is None:
+            return "absent"
+        for d in entry[1].endpoint_descriptions:
+            if d.endpoint_id == endpoint_id:
+                return d.weight
+    return "absent"
+
+
+def ramp_binding(eg_arn, svc_name, weight, steps, interval,
+                 name="ramp"):
+    return EndpointGroupBinding(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            annotations={ROLLOUT_STEPS_ANNOTATION: steps,
+                         ROLLOUT_INTERVAL_ANNOTATION: str(interval)}),
+        spec=EndpointGroupBindingSpec(
+            endpoint_group_arn=eg_arn, weight=weight,
+            service_ref=ServiceReference(name=svc_name)))
+
+
+def rollout_status(cluster, name="ramp"):
+    b = cluster.operator.endpoint_group_bindings.get("default", name)
+    return RolloutState.from_dict(b.status.rollout)
+
+
+def test_ramp_completes_through_chaos_and_handoff_monotone(
+        race_detectors):
+    """The flagship: 4-step ramp (5/25/50/100% of 200 -> 10/50/100/200)
+    through 20% AWS chaos + a GA throttle burst + one abrupt mid-ramp
+    manager handoff.  A continuous sampler proves the observed weight
+    sequence is exactly the declared steps in order — no snap, no
+    revert-then-rejump across the handoff."""
+    api = FakeAPIServer()
+    a = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                api=api, fault_seed=SEED, resync_period=0.4)
+    lb = a.cloud.elb.register_load_balancer(
+        "ramp-svc", nlb_hostname("ramp-svc"), REGION)
+    eg = external_endpoint_group(a.cloud)
+    cloud = a.cloud
+
+    # 20% chaos on every AWS method + a GA throttle burst mid-ramp
+    cloud.faults.set_error_rate("*", 0.2)
+    cloud.faults.add_throttle_burst(0.8, 0.8, service="ga", rate=0.9)
+
+    samples = []
+    import threading
+    stop_sampling = threading.Event()
+
+    def sampler():
+        while not stop_sampling.is_set():
+            v = peek_weight(cloud, eg.endpoint_group_arn,
+                            lb.load_balancer_arn)
+            if not samples or samples[-1] != v:
+                samples.append(v)
+            time.sleep(0.005)
+
+    t = threading.Thread(target=sampler, daemon=True)
+    t.start()
+
+    a.start()
+    a.kube.services.create(lb_service("ramp-svc"))
+    a.operator.endpoint_group_bindings.create(ramp_binding(
+        eg.endpoint_group_arn, "ramp-svc", 200, "5,25,50,100", 0.6))
+
+    b = None
+    try:
+        # mid-ramp: wait for step >= 1 to be PERSISTED, then kill the
+        # manager abruptly (no drain, no fence courtesy)
+        wait_until(lambda: rollout_status(a).step >= 1, timeout=30.0,
+                   message="ramp reached a mid-ramp step")
+        a.shutdown()
+        a.handle.join(timeout=10.0)
+        assert not any(th.is_alive() for th in a.handle.threads)
+        killed_at_step = rollout_status(a).step
+        assert killed_at_step < 3, "kill point missed mid-ramp"
+
+        # the successor: fresh process state over the same world
+        b = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                    api=api, cloud=cloud, resync_period=0.4).start()
+        wait_until(
+            lambda: peek_weight(cloud, eg.endpoint_group_arn,
+                                lb.load_balancer_arn) == 200,
+            timeout=60.0, message="ramp completed after the handoff")
+        wait_until(lambda: rollout_status(b).phase == PHASE_COMPLETED,
+                   timeout=15.0, message="completion persisted")
+    finally:
+        stop_sampling.set()
+        t.join(timeout=2.0)
+        cloud.faults.set_error_rate("*", 0.0)
+        if b is not None:
+            b.shutdown()
+
+    observed = [s for s in samples if isinstance(s, int)]
+    assert observed, "sampler saw no weights"
+    assert observed == sorted(observed), \
+        f"weights regressed mid-ramp: {observed}"
+    assert observed == [10, 50, 100, 200], \
+        f"ramp snapped or skipped steps: {observed}"
+
+
+def test_injected_health_failure_at_step_3_rolls_back_exactly_once(
+        race_detectors):
+    """Converge at 100, ramp toward 200, then flip the abort
+    annotation once step 3 (index 2) is persisted: the machine rolls
+    back to the last good weights EXACTLY once (counter == 1, phase
+    RolledBack sticky), and the failed target never re-ramps."""
+    reg = metrics.default_registry
+    c = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                resync_period=0.3)
+    lb = c.cloud.elb.register_load_balancer(
+        "bg-svc", nlb_hostname("bg-svc"), REGION)
+    eg = external_endpoint_group(c.cloud)
+    c.start()
+    rollbacks_before = reg.counter_value(
+        "rollout_rollbacks_total",
+        {"controller": "EndpointGroupBinding", "reason": "abort"})
+    try:
+        c.kube.services.create(lb_service("bg-svc"))
+        c.operator.endpoint_group_bindings.create(ramp_binding(
+            eg.endpoint_group_arn, "bg-svc", 100, "25,50,100", 0.25))
+        wait_until(lambda: rollout_status(c).phase == PHASE_COMPLETED,
+                   timeout=30.0, message="baseline ramp completed")
+        assert peek_weight(c.cloud, eg.endpoint_group_arn,
+                           lb.load_balancer_arn) == 100
+
+        # the new release: 100 -> 200 over 4 steps
+        fresh = c.operator.endpoint_group_bindings.get("default", "ramp")
+        updated = fresh.deep_copy()
+        updated.spec.weight = 200
+        c.operator.endpoint_group_bindings.update(updated)
+        wait_until(lambda: rollout_status(c).step >= 2
+                   and rollout_status(c).phase == "Progressing",
+                   timeout=30.0, message="new ramp reached step 3")
+
+        # the external prober flips the kill switch
+        fresh = c.operator.endpoint_group_bindings.get("default", "ramp")
+        aborted = fresh.deep_copy()
+        aborted.metadata.annotations[ROLLOUT_ABORT_ANNOTATION] = \
+            "canary 500s"
+        c.operator.endpoint_group_bindings.update(aborted)
+
+        wait_until(lambda: rollout_status(c).phase == PHASE_ROLLED_BACK,
+                   timeout=30.0, message="rollback persisted")
+        wait_until(
+            lambda: peek_weight(c.cloud, eg.endpoint_group_arn,
+                                lb.load_balancer_arn) == 100,
+            timeout=10.0, message="weights restored to the baseline")
+        st = rollout_status(c)
+        assert st.reason.startswith("abort:")
+
+        # exactly once — and STICKY: resyncs keep arriving, the weight
+        # holds at the baseline, the counter never moves again
+        assert reg.counter_value(
+            "rollout_rollbacks_total",
+            {"controller": "EndpointGroupBinding", "reason": "abort"}) \
+            == rollbacks_before + 1
+        deadline = time.monotonic() + 1.5
+        while time.monotonic() < deadline:
+            assert peek_weight(c.cloud, eg.endpoint_group_arn,
+                               lb.load_balancer_arn) == 100
+            time.sleep(0.05)
+        assert rollout_status(c).phase == PHASE_ROLLED_BACK
+        assert reg.counter_value(
+            "rollout_rollbacks_total",
+            {"controller": "EndpointGroupBinding", "reason": "abort"}) \
+            == rollbacks_before + 1
+    finally:
+        c.shutdown()
+
+
+def test_kill_restart_mid_ramp_resumes_with_zero_duplicate_writes(
+        race_detectors):
+    """Kill the manager with step 1 persisted AND converged; the
+    successor must resume from the persisted step — the total
+    ``update_endpoint_group`` count across BOTH processes is exactly
+    one coalesced RMW per mutation: the endpoint ADD at the step-0
+    weight (the step-0 write folds into it), then one per step
+    advance.  A duplicate write anywhere — the successor re-snapping,
+    re-adding, or replaying a landed step — shows up as an extra
+    call."""
+    api = FakeAPIServer()
+    a = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                api=api, resync_period=0.4)
+    lb = a.cloud.elb.register_load_balancer(
+        "resume-svc", nlb_hostname("resume-svc"), REGION)
+    eg = external_endpoint_group(a.cloud)
+    cloud = a.cloud
+    a.start()
+    b = None
+    try:
+        a.kube.services.create(lb_service("resume-svc"))
+        a.operator.endpoint_group_bindings.create(ramp_binding(
+            eg.endpoint_group_arn, "resume-svc", 200, "5,25,50,100",
+            1.0))
+        # step 1 persisted and its weight (50) on the wire
+        wait_until(lambda: rollout_status(a).step == 1, timeout=30.0,
+                   message="step 1 persisted")
+        wait_until(
+            lambda: peek_weight(cloud, eg.endpoint_group_arn,
+                                lb.load_balancer_arn) == 50,
+            timeout=10.0, message="step 1 weight landed")
+        a.shutdown()
+        a.handle.join(timeout=10.0)
+        calls_at_kill = cloud.faults.call_counts().get(
+            "update_endpoint_group", 0)
+
+        b = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                    api=api, cloud=cloud, resync_period=0.4).start()
+        wait_until(
+            lambda: peek_weight(cloud, eg.endpoint_group_arn,
+                                lb.load_balancer_arn) == 200,
+            timeout=60.0, message="ramp completed after restart")
+        wait_until(lambda: rollout_status(b).phase == PHASE_COMPLETED,
+                   timeout=15.0, message="completion persisted")
+        total = cloud.faults.call_counts().get(
+            "update_endpoint_group", 0)
+        # A issued the add-at-step-0 RMW and the step-1 RMW; B owes
+        # exactly steps 2 and 3 — anything more is a duplicate write
+        assert calls_at_kill == 2, \
+            f"unexpected pre-kill writes: {calls_at_kill}"
+        assert total == 4, \
+            f"resume issued duplicate weight writes: {total} != 4"
+    finally:
+        if b is not None:
+            b.shutdown()
+
+
+def test_record_ramp_completes_through_zone_throttle_monotone(
+        race_detectors):
+    """The record-plane twin of the flagship: a WEIGHTED Route53
+    record (SetIdentifier pair) ramps 25/50/100% of weight 80 through
+    20% AWS chaos + a zone throttle burst — the one stressor that
+    actually gates the record plane (the per-zone token bucket charges
+    ``change_resource_record_sets[_batch]`` per CALL).  The observed
+    record weight must walk exactly the declared steps in order:
+    throttle parks and retries may STALL a step, but they must never
+    snap the record to its final weight or bounce it backwards."""
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        ROLLOUT_STATE_ANNOTATION,
+        ROUTE53_HOSTNAME_ANNOTATION,
+        ROUTE53_SET_IDENTIFIER_ANNOTATION,
+        ROUTE53_WEIGHT_ANNOTATION,
+    )
+
+    a = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                fault_seed=SEED, resync_period=0.4)
+    cloud = a.cloud
+    nlb = nlb_hostname("zr-svc")
+    cloud.elb.register_load_balancer("zr-svc", nlb, REGION)
+    zone = cloud.route53.create_hosted_zone("example.com")
+
+    # 20% chaos on every AWS method + the zone's token bucket nearly
+    # drained: every record write rides throttle classification,
+    # batcher parks and per-zone pacing
+    cloud.faults.set_error_rate("*", 0.2)
+    cloud.faults.set_zone_throttle(3.0, 3.0)
+
+    def peek_record_weight():
+        """Direct fake-state read (no API call, no fault draw, no
+        zone-bucket charge): sampling must not perturb the chaos
+        schedule or the throttle budget it observes."""
+        r53 = cloud.route53
+        with r53._lock:
+            for r in r53._records.get(zone.id, ()):
+                if r.type == "A" and r.set_identifier == "blue":
+                    return r.weight
+        return None
+
+    samples = []
+    import threading
+    stop_sampling = threading.Event()
+
+    def sampler():
+        while not stop_sampling.is_set():
+            v = peek_record_weight()
+            if v is not None and (not samples or samples[-1] != v):
+                samples.append(v)
+            time.sleep(0.005)
+
+    t = threading.Thread(target=sampler, daemon=True)
+    t.start()
+
+    a.start()
+    try:
+        a.kube.services.create(Service(
+            metadata=ObjectMeta(
+                name="zr-svc", namespace="default",
+                annotations={
+                    AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                    ROUTE53_HOSTNAME_ANNOTATION: "zr.example.com",
+                    ROUTE53_SET_IDENTIFIER_ANNOTATION: "blue",
+                    ROUTE53_WEIGHT_ANNOTATION: "80",
+                    ROLLOUT_STEPS_ANNOTATION: "25,50,100",
+                    ROLLOUT_INTERVAL_ANNOTATION: "0.4",
+                }),
+            spec=ServiceSpec(type="LoadBalancer",
+                             ports=[ServicePort(port=80)]),
+            status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                ingress=[LoadBalancerIngress(hostname=nlb)])),
+        ))
+
+        wait_until(lambda: peek_record_weight() == 80, timeout=90.0,
+                   message="record ramp completed through the "
+                           "throttled zone")
+
+        def record_state():
+            svc = a.kube.services.get("default", "zr-svc")
+            return RolloutState.from_json(
+                svc.annotations.get(ROLLOUT_STATE_ANNOTATION))
+
+        wait_until(lambda: record_state().phase == PHASE_COMPLETED,
+                   timeout=30.0,
+                   message="completion persisted to the state "
+                           "annotation")
+    finally:
+        stop_sampling.set()
+        t.join(timeout=2.0)
+        cloud.faults.set_error_rate("*", 0.0)
+        a.shutdown()
+
+    assert samples, "sampler saw no record weights"
+    assert samples == sorted(samples), \
+        f"record weight regressed mid-ramp: {samples}"
+    assert samples == [20, 40, 80], \
+        f"record ramp snapped or skipped steps: {samples}"

@@ -1,0 +1,31 @@
+"""L112 fixture: weight mutations correctly gated on the rollout
+engine (the consult shapes `_consults_rollout` recognizes), plus a
+deliberate waived snap."""
+
+
+class GatedController:
+    def __init__(self, provider, rollout):
+        self.provider = provider
+        self.rollout = rollout
+
+    def converge_weights(self, obj, endpoint_group, desired, observed):
+        # GOOD: the engine decides the in-force weights
+        outcome = self.rollout.decide(
+            key=obj.key(), route=obj.key(), annotations=obj.annotations,
+            state_dict=None, desired=desired, observed=observed)
+        if outcome.write is not None:
+            self.provider.update_endpoint_weights(endpoint_group,
+                                                  outcome.write)
+
+    def converge_via_helper(self, obj, endpoint_group, desired):
+        # GOOD: a helper whose name carries the consult
+        weights = self._record_rollout(obj, desired)
+        self.provider.update_endpoint_weights(endpoint_group, weights)
+
+    def _record_rollout(self, obj, desired):
+        return desired
+
+    def repair_drift(self, endpoint_group, known_good):
+        # deliberate ungated snap, explicitly waived
+        self.provider.update_endpoint_weights(  # race: drift repair restores the last rollout-approved weights, never mid-ramp values
+            endpoint_group, known_good)

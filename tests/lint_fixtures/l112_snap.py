@@ -1,0 +1,18 @@
+"""L112 fixture: an endpoint-weight mutation with NO rollout-gate
+consult in the enclosing function — the snap shape the rule exists to
+flag (a mid-ramp binding written like this jumps straight to its
+final target)."""
+
+
+class SnappyController:
+    def __init__(self, provider):
+        self.provider = provider
+
+    def converge_weights(self, endpoint_group, desired):
+        # BAD: no rollout consult — flags L112
+        self.provider.update_endpoint_weights(endpoint_group, desired)
+
+    def converge_one(self, endpoint_group, endpoint_id, weight):
+        # BAD: the single-endpoint spelling is the same surface
+        self.provider.update_endpoint_weight(endpoint_group,
+                                             endpoint_id, weight)

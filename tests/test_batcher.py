@@ -708,3 +708,38 @@ def test_background_submit_keeps_bulk_linger_semantics():
     t.join(timeout=5); t2.join(timeout=5)
     assert cloud.faults.call_counts().get(
         "change_resource_record_sets_batch", 0) == calls_before + 1
+
+
+def test_weighted_pair_sides_never_fold_into_each_other():
+    """Record fold identity includes the SetIdentifier (ISSUE 10):
+    concurrent changes to the two sides of a weighted pair share one
+    flush but stay TWO changes — folding them would erase one side of
+    the blue-green split."""
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (  # noqa: E501
+        AliasTarget,
+        ResourceRecordSet,
+    )
+
+    def weighted(set_id, weight):
+        return ResourceRecordSet(
+            name="www.example.com", type="A",
+            alias_target=AliasTarget("t.example.com", "Z1"),
+            set_identifier=set_id, weight=weight)
+
+    cloud = FakeAWSCloud()
+    zone = make_zone(cloud)
+    co = make_coalescer(cloud)
+    folds_before = counter_delta("provider_mutation_folds_total",
+                                 "record_set")
+    co.change_record_sets(zone.id, [
+        ("UPSERT", weighted("blue", 200)),
+        ("UPSERT", weighted("green", 55)),
+        # the SAME side folds last-writer-wins as ever
+        ("UPSERT", weighted("green", 60)),
+    ])
+    got = {r.set_identifier: r.weight
+           for r in cloud.route53.list_resource_record_sets(zone.id)
+           if r.type == "A"}
+    assert got == {"blue": 200, "green": 60}
+    assert counter_delta("provider_mutation_folds_total",
+                         "record_set") == folds_before + 1

@@ -195,6 +195,34 @@ def test_bench_mixed_soak_smoke(monkeypatch, tmp_path):
     assert "slo_ok" in entries[-1]
 
 
+def test_bench_rollout_ramp_smoke(monkeypatch, tmp_path):
+    """Short tier-1 variant of the rollout-ramp leg (ISSUE 10): a
+    handful of bindings ramp concurrently through a 2-step schedule,
+    completion latencies and mutation-call accounting land, and the
+    tagged history record is written.  The 200-binding leg asserts the
+    fold keeps calls ~steps*bindings; small-N just proves the PATH —
+    every ramp completes and calls stay well under the unfolded
+    steps*bindings*endpoints intent count."""
+    path = tmp_path / "hist.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(path))
+    r = bench.bench_rollout_ramp(n_bindings=6, workers=2,
+                                 endpoints_per_binding=2,
+                                 steps="50,100", interval=0.1,
+                                 record=True)
+    assert r["bindings"] == 6
+    assert r["steps"] == [50, 100]
+    assert r["ramp_p99_s"] >= r["ramp_p50_s"] >= 2 * 0.1, \
+        "a ramp completed faster than its bake floor — weights snapped"
+    assert r["mutation_calls"] >= 1
+    assert r["mutation_calls"] < r["weight_intents"], \
+        "no folding: every weight intent became its own RMW call"
+    assert r["fold_ratio"] >= 1.0
+    entries = [json.loads(line) for line in path.read_text().splitlines()]
+    assert entries[-1]["bench"] == "rollout-ramp"
+    assert "fold_ratio" in entries[-1]
+    assert "step_advance_overhead_p99_s" in entries[-1]
+
+
 def test_bench_shard_scaling_smoke(monkeypatch, tmp_path):
     """Small-N run of the shard scale-out A/B (ISSUE 8): 1 vs 2 real
     worker processes over the real key partition — both legs converge
@@ -239,10 +267,10 @@ def test_bench_mixed_soak_full_slo():
 
 
 def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
-    """batch-efficiency, steady-state, restart-recovery, mixed-soak
-    and shard-scaling legs measure other workloads, not the floor's
-    pure create storm: their (lower) throughputs must not drag the
-    derived floor down."""
+    """batch-efficiency, steady-state, restart-recovery, mixed-soak,
+    shard-scaling and rollout-ramp legs measure other workloads, not
+    the floor's pure create storm: their (lower) throughputs must not
+    drag the derived floor down."""
     hist = tmp_path / "history.jsonl"
     hist.write_text("".join(
         json.dumps(e) + "\n" for e in (
@@ -255,7 +283,9 @@ def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
             {"throughput": 25.0, "bench": "mixed-soak"},
             {"throughput": 24.0, "bench": "mixed-soak"},
             {"throughput": 420.0, "bench": "shard-scaling"},
-            {"throughput": 110.0, "bench": "shard-scaling"})))
+            {"throughput": 110.0, "bench": "shard-scaling"},
+            {"throughput": 55.0, "bench": "rollout-ramp"},
+            {"throughput": 60.0, "bench": "rollout-ramp"})))
     monkeypatch.delenv("RECONCILE_FLOOR_SVC_S", raising=False)
     monkeypatch.setattr(bench.os, "getloadavg", lambda: (0.0, 0, 0))
     got = bench.reconcile_floor(history_path=str(hist))

@@ -146,3 +146,106 @@ def test_ingress_records_follow_accelerator(cluster):
     wait_until(lambda: ("web.example.com.", "A") not in records(cluster,
                                                                 zone.id),
                message="ingress records cleaned up")
+
+
+# ---------------------------------------------------------------------------
+# weighted record pairs (ISSUE 10: blue-green DNS via SetIdentifier)
+# ---------------------------------------------------------------------------
+
+BLUE_NLB = "bluelb-0123456789abcdef.elb.ap-northeast-1.amazonaws.com"
+GREEN_NLB = "greenlb-0123456789abcdef.elb.ap-northeast-1.amazonaws.com"
+
+
+def weighted_service(name, lb_hostname, set_id, weight,
+                     hostname="www.example.com", extra=None):
+    from aws_global_accelerator_controller_tpu.apis import (
+        ROUTE53_SET_IDENTIFIER_ANNOTATION,
+        ROUTE53_WEIGHT_ANNOTATION,
+    )
+    annotations = {
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+        ROUTE53_HOSTNAME_ANNOTATION: hostname,
+        ROUTE53_SET_IDENTIFIER_ANNOTATION: set_id,
+        ROUTE53_WEIGHT_ANNOTATION: str(weight),
+    }
+    annotations.update(extra or {})
+    return Service(
+        metadata=ObjectMeta(name=name, namespace="default",
+                            annotations=annotations),
+        spec=ServiceSpec(type="LoadBalancer", ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=lb_hostname)])),
+    )
+
+
+def weighted_records(cluster, zone_id, rtype="A"):
+    return {r.set_identifier: r.weight
+            for r in cluster.cloud.route53.list_resource_record_sets(zone_id)
+            if r.type == rtype and r.set_identifier is not None}
+
+
+def test_weighted_pair_coexists_and_cleans_up_own_side(cluster):
+    """Two services claim ONE hostname as a weighted pair (distinct
+    SetIdentifiers): both A records (and both ownership TXTs) coexist,
+    and deleting one side removes exactly its own records."""
+    cluster.cloud.elb.register_load_balancer("bluelb", BLUE_NLB, REGION)
+    cluster.cloud.elb.register_load_balancer("greenlb", GREEN_NLB, REGION)
+    zone = cluster.cloud.route53.create_hosted_zone("example.com")
+    cluster.kube.services.create(
+        weighted_service("blue", BLUE_NLB, "blue", 200))
+    cluster.kube.services.create(
+        weighted_service("green", GREEN_NLB, "green", 55))
+    wait_until(lambda: weighted_records(cluster, zone.id)
+               == {"blue": 200, "green": 55},
+               message="both sides of the weighted pair")
+    assert weighted_records(cluster, zone.id, "TXT").keys() \
+        == {"blue", "green"}
+
+    cluster.kube.services.delete("default", "green")
+    wait_until(lambda: weighted_records(cluster, zone.id)
+               == {"blue": 200},
+               message="green side cleaned up alone")
+    assert weighted_records(cluster, zone.id, "TXT").keys() == {"blue"}
+
+
+def test_weighted_record_ramp_walks_steps_and_persists_state(cluster):
+    """A weighted service declaring rollout annotations ramps its
+    record weight through the declared steps (never snapping to the
+    target), with the machine state persisted in the controller-owned
+    rollout.agac/state annotation."""
+    from aws_global_accelerator_controller_tpu.apis import (
+        ROLLOUT_INTERVAL_ANNOTATION,
+        ROLLOUT_STATE_ANNOTATION,
+        ROLLOUT_STEPS_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.rollout import (
+        PHASE_COMPLETED,
+        RolloutState,
+    )
+
+    cluster.cloud.elb.register_load_balancer("greenlb", GREEN_NLB, REGION)
+    zone = cluster.cloud.route53.create_hosted_zone("example.com")
+    seen = []
+
+    def green_weight():
+        w = weighted_records(cluster, zone.id).get("green")
+        if w is not None and (not seen or seen[-1] != w):
+            seen.append(w)
+        return w
+
+    cluster.kube.services.create(
+        weighted_service("green", GREEN_NLB, "green", 200,
+                         extra={ROLLOUT_STEPS_ANNOTATION: "25,50,100",
+                                ROLLOUT_INTERVAL_ANNOTATION: "0.25"}))
+    wait_until(lambda: green_weight() == 200, timeout=30.0,
+               message="record ramp completed")
+    assert seen == [50, 100, 200], f"record ramp snapped: {seen}"
+    assert seen == sorted(seen)
+
+    def persisted():
+        svc = cluster.kube.services.get("default", "green")
+        return RolloutState.from_json(
+            svc.metadata.annotations.get(ROLLOUT_STATE_ANNOTATION))
+    wait_until(lambda: persisted().phase == PHASE_COMPLETED,
+               timeout=10.0, message="completion persisted")

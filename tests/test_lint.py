@@ -606,3 +606,93 @@ def test_l111_seeded_pltpu_graft_into_shipped_ops_caught(tmp_path):
     # sanity: the unmutated kernel is clean under its own rule
     assert [x for x in concurrency_lint.lint_files([ops_py])
             if x.code == "L111"] == []
+
+
+def test_l112_ungated_weight_mutation_fires():
+    """A weight mutation with no rollout consult in the enclosing
+    function snaps mid-ramp objects to their target — both spellings
+    of the surface fire."""
+    assert _cfindings("l112_snap.py") == [("L112", 13), ("L112", 17)]
+
+
+def test_l112_gated_and_waived_clean():
+    """The consult shapes `_consults_rollout` recognizes — the engine
+    call, a `*rollout*` helper — and a `# race:` waived deliberate
+    snap are all clean."""
+    assert _cfindings("l112_gated.py") == []
+
+
+def test_l112_rollout_package_exempt():
+    """rollout/ itself (the machine that plans the weights everyone
+    else gates on) is exempt from its own rule."""
+    pkg = pathlib.Path(ROOT_DIR) / "aws_global_accelerator_controller_tpu"
+    files = sorted((pkg / "rollout").glob("*.py"))
+    assert files, "rollout package missing?"
+    assert [x for x in concurrency_lint.lint_files(files)
+            if x.code == "L112"] == []
+
+
+def test_l112_shipped_controllers_clean():
+    """The real weight-bearing controllers carry their consults."""
+    pkg = pathlib.Path(ROOT_DIR) / "aws_global_accelerator_controller_tpu"
+    files = [pkg / "controller" / "endpointgroupbinding.py",
+             pkg / "controller" / "route53.py"]
+    assert [x for x in concurrency_lint.lint_files(files)
+            if x.code == "L112"] == []
+
+
+def test_l112_seeded_rollout_strip_from_egb_controller_caught(tmp_path):
+    """Acceptance probe tied to the shipped code shape: strip the
+    rollout consult from the REAL EndpointGroupBinding weight-apply
+    path and the gate must fire — every EG-weight ramp in the fleet
+    relies on that consult to keep mid-ramp weights in force."""
+    egb_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/controller/"
+        "endpointgroupbinding.py")
+    src = egb_py.read_text()
+    needle = "        outcome = self.rollout.decide(\n"
+    assert src.count(needle) == 1, \
+        "EGB weight-apply rollout-gate shape changed; update this probe"
+    # replace the consult with a passthrough outcome of the same name
+    mutated = src.replace(
+        needle, "        outcome = _Passthrough(\n")
+    # _rollout_declared still mentions rollout; strip it too so the
+    # probe proves the RULE fires, not a coincidental helper name
+    mutated = mutated.replace("not self._rollout_declared(obj)",
+                              "True")
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "controller")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "endpointgroupbinding.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L112"]
+    assert findings, "a rollout-gate-less EGB weight apply was not caught"
+
+
+def test_l112_seeded_rollout_strip_from_route53_controller_caught(
+        tmp_path):
+    """The route53 twin: strip `_record_rollout` from the service
+    process func and the shipped-consult check must fire."""
+    r53_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/controller/route53.py")
+    src = r53_py.read_text()
+    needle = ("        policy, ramp_weights, ramp_requeue = "
+              "self._record_rollout(\n"
+              "            svc, \"service\", hostnames, "
+              "self.kube_client.services)\n")
+    assert src.count(needle) == 1, \
+        "route53 service rollout-gate shape changed; update this probe"
+    mutated = src.replace(
+        needle,
+        "        policy, ramp_weights, ramp_requeue = None, None, 0.0\n")
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "controller")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "route53.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L112"
+                and "process_service_create_or_update" in x.msg]
+    assert findings, "a rollout-gate-less route53 service process " \
+                     "func was not caught"

@@ -448,3 +448,49 @@ def test_ordered_stop_observes_shutdown_duration_and_fence_counters():
             == fenced_before + 1
     finally:
         cluster.stop.set()
+
+
+def test_rollout_counters_exposed():
+    """ISSUE 10's safe-rollout telemetry: transitions, health-gate
+    holds and rollbacks all register, accumulate and render with
+    bounded labels."""
+    from aws_global_accelerator_controller_tpu.metrics import (
+        default_registry,
+        record_rollout_hold,
+        record_rollout_rollback,
+        record_rollout_transition,
+    )
+
+    trans_before = default_registry.counter_value(
+        "rollout_transitions_total",
+        {"controller": "m-roll", "to": "step"})
+    holds_before = default_registry.counter_value(
+        "rollout_holds_total",
+        {"controller": "m-roll", "reason": "circuit"})
+    rb_before = default_registry.counter_value(
+        "rollout_rollbacks_total",
+        {"controller": "m-roll", "reason": "abort"})
+
+    record_rollout_transition("m-roll", "start")
+    record_rollout_transition("m-roll", "step")
+    record_rollout_hold("m-roll", "circuit")
+    record_rollout_rollback("m-roll", "abort")
+
+    assert default_registry.counter_value(
+        "rollout_transitions_total",
+        {"controller": "m-roll", "to": "step"}) == trans_before + 1
+    assert default_registry.counter_value(
+        "rollout_holds_total",
+        {"controller": "m-roll", "reason": "circuit"}) \
+        == holds_before + 1
+    assert default_registry.counter_value(
+        "rollout_rollbacks_total",
+        {"controller": "m-roll", "reason": "abort"}) == rb_before + 1
+
+    text = default_registry.render()
+    assert ('rollout_transitions_total{controller="m-roll",'
+            'to="step"}') in text
+    assert ('rollout_holds_total{controller="m-roll",'
+            'reason="circuit"}') in text
+    assert ('rollout_rollbacks_total{controller="m-roll",'
+            'reason="abort"}') in text

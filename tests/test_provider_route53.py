@@ -198,3 +198,129 @@ def test_cleanup_removes_only_owned_records(factory, provider):
     assert ("www.example.com.", "A") not in records
     assert ("www.example.com.", "TXT") not in records
     assert ("other.example.com.", "A") in records
+
+
+# ---------------------------------------------------------------------------
+# weighted records (ISSUE 10: SetIdentifier pairs)
+# ---------------------------------------------------------------------------
+
+def _weighted_setup(factory, provider):
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.helpers import (  # noqa: E501
+        RecordPolicy,
+    )
+    setup_accelerator(factory, provider)
+    zone = factory.cloud.route53.create_hosted_zone("example.com")
+    return zone, RecordPolicy
+
+
+def _record(factory, zone_id, rtype, set_id):
+    for r in factory.cloud.route53.list_resource_record_sets(zone_id):
+        if r.type == rtype and r.set_identifier == set_id:
+            return r
+    return None
+
+
+def test_weighted_ensure_creates_pairable_records(factory, provider):
+    zone, RecordPolicy = _weighted_setup(factory, provider)
+    created, retry = provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER,
+        policy=RecordPolicy("blue", 200))
+    assert created and retry == 0
+    a = _record(factory, zone.id, "A", "blue")
+    assert a is not None and a.weight == 200
+    txt = _record(factory, zone.id, "TXT", "blue")
+    assert txt is not None and txt.weight is not None
+
+    # the other side of the pair coexists under the SAME hostname
+    other = make_service()
+    other.metadata.name = "app2"
+    created2, _ = provider.ensure_route53_for_service(
+        other, LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER,
+        policy=RecordPolicy("green", 55))
+    assert created2
+    assert _record(factory, zone.id, "A", "green").weight == 55
+    assert _record(factory, zone.id, "A", "blue").weight == 200
+
+
+def test_weighted_ensure_repairs_weight_drift_only_own_side(
+        factory, provider):
+    """need_records_update compares served weight: a drifted weight is
+    re-UPSERTed; the SIBLING's record (same hostname, other set
+    identifier) is untouched — ownership pairs by (name,
+    SetIdentifier)."""
+    zone, RecordPolicy = _weighted_setup(factory, provider)
+    provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER, policy=RecordPolicy("blue", 200))
+    other = make_service()
+    other.metadata.name = "app2"
+    provider.ensure_route53_for_service(
+        other, LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER, policy=RecordPolicy("green", 55))
+
+    factory.cloud.faults.edit_record_set(
+        zone.id, "www.example.com", "A", set_identifier="blue",
+        weight=1)
+    calls_before = factory.cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0)
+    provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER, policy=RecordPolicy("blue", 200))
+    assert _record(factory, zone.id, "A", "blue").weight == 200
+    assert _record(factory, zone.id, "A", "green").weight == 55
+    assert factory.cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0) == calls_before + 1
+
+    # ...and a converged re-ensure is read-only
+    provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER, policy=RecordPolicy("blue", 200))
+    assert factory.cloud.faults.call_counts().get(
+        "change_resource_record_sets_batch", 0) == calls_before + 1
+
+
+def test_weighted_cleanup_removes_only_own_side(factory, provider):
+    zone, RecordPolicy = _weighted_setup(factory, provider)
+    provider.ensure_route53_for_service(
+        make_service(), LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER, policy=RecordPolicy("blue", 200))
+    other = make_service()
+    other.metadata.name = "app2"
+    provider.ensure_route53_for_service(
+        other, LoadBalancerIngress(hostname=HOSTNAME),
+        ["www.example.com"], CLUSTER, policy=RecordPolicy("green", 55))
+
+    provider.cleanup_record_set(CLUSTER, "service", "default", "app2")
+    assert _record(factory, zone.id, "A", "green") is None
+    assert _record(factory, zone.id, "TXT", "green") is None
+    assert _record(factory, zone.id, "A", "blue").weight == 200
+    assert _record(factory, zone.id, "TXT", "blue") is not None
+
+
+def test_fake_rejects_mixed_simple_and_weighted(factory):
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (  # noqa: E501
+        AliasTarget,
+        ResourceRecordSet,
+    )
+    zone = factory.cloud.route53.create_hosted_zone("example.com")
+    r53 = factory.cloud.route53
+    simple = ResourceRecordSet(
+        name="x.example.com", type="A",
+        alias_target=AliasTarget("t.example.com", "Z1"))
+    weighted = ResourceRecordSet(
+        name="x.example.com", type="A",
+        alias_target=AliasTarget("t.example.com", "Z1"),
+        set_identifier="blue", weight=10)
+    half = ResourceRecordSet(
+        name="y.example.com", type="A",
+        alias_target=AliasTarget("t.example.com", "Z1"),
+        set_identifier="blue")
+    r53.change_resource_record_sets(zone.id, "CREATE", simple)
+    with pytest.raises(AWSAPIError) as e:
+        r53.change_resource_record_sets(zone.id, "CREATE", weighted)
+    assert "mix" in str(e.value)
+    with pytest.raises(AWSAPIError) as e2:
+        r53.change_resource_record_sets(zone.id, "CREATE", half)
+    assert "together" in str(e2.value)

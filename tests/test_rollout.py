@@ -1,0 +1,497 @@
+"""Safe-rollout state machine + engine units (rollout/).
+
+The resumability matrix: because all ramp state is durable (status /
+state annotation) and :func:`rollout.machine.advance` is pure, a crash
+is exactly "serialize the state, forget everything else, re-advance" —
+so these tests kill/restart the machine at EVERY boundary (after a
+transition persisted, before its weights landed; after the weights
+landed, before the next turn; mid-step with partial convergence) by
+round-tripping the state through its wire encoding between turns, and
+assert the weight WRITES stay monotone with zero duplicates.
+"""
+import json
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.metrics import Registry
+from aws_global_accelerator_controller_tpu.rollout import (
+    HEALTH_DEGRADED,
+    HEALTH_FAILED,
+    HEALTHY,
+    Health,
+    PHASE_COMPLETED,
+    PHASE_PROGRESSING,
+    PHASE_ROLLED_BACK,
+    PHASE_ROLLING_BACK,
+    RolloutEngine,
+    RolloutSpec,
+    RolloutState,
+    StaleRolloutTokenError,
+    advance,
+    parse_spec,
+    planned_weights,
+    rollout_active,
+)
+from aws_global_accelerator_controller_tpu.apis import (
+    ROLLOUT_ABORT_ANNOTATION,
+    ROLLOUT_INTERVAL_ANNOTATION,
+    ROLLOUT_STEPS_ANNOTATION,
+)
+
+SPEC = RolloutSpec(steps=(5, 25, 50, 100), interval=10.0)
+E1 = "arn:aws:elb:eu-west-1:1:loadbalancer/net/one/aaaa"
+E2 = "arn:aws:elb:eu-west-1:1:loadbalancer/net/two/bbbb"
+
+
+def crash(state):
+    """A crash is: keep only the durable encoding."""
+    if state is None:
+        return None
+    return RolloutState.from_dict(
+        json.loads(json.dumps(state.to_dict())))
+
+
+class World:
+    """A tiny cloud: applies writes, tracks every write issued so the
+    monotonicity / zero-duplicate assertions have a full history."""
+
+    def __init__(self, observed=None):
+        self.observed = dict(observed or {})
+        self.writes = []
+
+    def apply(self, outcome):
+        if outcome.write is not None:
+            self.writes.append(dict(outcome.write))
+            self.observed.update(outcome.write)
+
+
+def drive(spec, desired, world, state=None, now=0.0, token=0,
+          health=HEALTHY, crash_every_turn=False, max_turns=64):
+    """Run turns until the machine settles (requeue 0 and no state
+    change); returns (final state, now).  ``crash_every_turn`` round
+    trips the state through its wire encoding between turns."""
+    for _ in range(max_turns):
+        out = advance(spec, state, desired, dict(world.observed), now,
+                      token, health=health)
+        if out.state is not None:
+            state = out.state         # persisted FIRST...
+            if crash_every_turn:
+                state = crash(state)
+        world.apply(out)              # ...then the weights land
+        if out.requeue_after <= 0:
+            return state, now
+        now += out.requeue_after
+    raise AssertionError("machine never settled")
+
+
+# ---------------------------------------------------------------------------
+# the happy ramp
+# ---------------------------------------------------------------------------
+
+def test_ramp_walks_declared_steps_monotone():
+    world = World()
+    desired = {E1: 200}
+    state, _ = drive(SPEC, desired, world, state=RolloutState())
+    assert state.phase == PHASE_COMPLETED
+    assert world.observed[E1] == 200
+    seq = [w[E1] for w in world.writes]
+    assert seq == [10, 50, 100, 200]          # 5/25/50/100% of 200
+    assert seq == sorted(seq), "weights must be monotone"
+
+
+def test_ramp_interpolates_from_observed_baseline():
+    """A re-weight 100 -> 200 ramps BETWEEN the two, never through 0."""
+    world = World({E1: 100})
+    state, _ = drive(SPEC, {E1: 200}, world, state=RolloutState())
+    seq = [w[E1] for w in world.writes]
+    assert seq == [105, 125, 150, 200]
+    assert min(seq) >= 100
+
+
+def test_multi_endpoint_vector_ramps_together():
+    world = World({E1: 0})
+    state, _ = drive(SPEC, {E1: 100, E2: 60}, world,
+                     state=RolloutState())
+    assert world.observed == {E1: 100, E2: 60}
+    for w in world.writes:
+        assert set(w) == {E1, E2}
+
+
+def test_already_converged_completes_without_writes():
+    world = World({E1: 200})
+    state, _ = drive(SPEC, {E1: 200}, world, state=RolloutState())
+    assert state.phase == PHASE_COMPLETED
+    assert world.writes == []
+
+
+def test_completed_target_drift_snaps_not_ramps():
+    """Out-of-band drift against a COMPLETED target is repaired by one
+    immediate write of the known-good weights — never a new ramp."""
+    world = World({E1: 200})
+    state, now = drive(SPEC, {E1: 200}, world, state=RolloutState())
+    world.observed[E1] = 7                      # the drift
+    out = advance(SPEC, state, {E1: 200}, dict(world.observed), now,
+                  0)
+    assert out.state is None and out.write == {E1: 200}
+
+
+def test_new_target_after_completion_ramps_again():
+    world = World()
+    state, now = drive(SPEC, {E1: 200}, world, state=RolloutState())
+    state2, _ = drive(SPEC, {E1: 400}, world, state=state, now=now)
+    assert state2.phase == PHASE_COMPLETED
+    seq = [w[E1] for w in world.writes]
+    assert seq == sorted(seq)
+    assert world.observed[E1] == 400
+
+
+# ---------------------------------------------------------------------------
+# the resumability matrix
+# ---------------------------------------------------------------------------
+
+def test_kill_restart_at_every_boundary_stays_monotone():
+    """Crash (= state serialization round-trip, everything else
+    forgotten) between every pair of turns: the write sequence is
+    IDENTICAL to the crash-free run — monotone, no re-snap to the
+    target, no duplicate writes."""
+    clean = World()
+    drive(SPEC, {E1: 200}, clean, state=RolloutState())
+    crashy = World()
+    drive(SPEC, {E1: 200}, crashy, state=RolloutState(),
+          crash_every_turn=True)
+    assert crashy.writes == clean.writes
+
+
+@pytest.mark.parametrize("kill_after_writes", [1, 2, 3])
+def test_crash_after_status_before_weights_resumes_forward(
+        kill_after_writes):
+    """The worst kill point: a step transition PERSISTED but its
+    weights never written.  The successor must write the persisted
+    step's weights (forward), never the final target and never the
+    previous step (no revert-then-rejump)."""
+    world = World()
+    state = RolloutState()
+    now = 0.0
+    writes_seen = 0
+    pending_write = None
+    while writes_seen < kill_after_writes:
+        out = advance(SPEC, state, {E1: 200}, dict(world.observed),
+                      now, 0)
+        if out.state is not None:
+            state = out.state
+        if out.write is not None:
+            writes_seen += 1
+            if writes_seen == kill_after_writes:
+                pending_write = dict(out.write)
+                break               # CRASH: status persisted, write lost
+            world.apply(out)
+        now += max(out.requeue_after, 0.01)
+    state = crash(state)
+    out = advance(SPEC, state, {E1: 200}, dict(world.observed), now, 0)
+    assert out.write == pending_write, \
+        "resume must re-issue exactly the persisted step's weights"
+    assert out.state is None, "resume is a converge, not a transition"
+
+
+def test_resume_on_converged_step_issues_zero_writes():
+    """Crash AFTER a step's weights landed: the successor observes
+    converged weights and writes NOTHING until the bake elapses."""
+    world = World()
+    state = RolloutState()
+    out = advance(SPEC, state, {E1: 200}, {}, 0.0, 0)
+    state = crash(out.state)
+    world.apply(out)                              # step 0 landed (10)
+    # successor wakes mid-bake
+    out2 = advance(SPEC, state, {E1: 200}, dict(world.observed), 3.0, 0)
+    assert out2.write is None and out2.state is None
+    assert out2.requeue_after == pytest.approx(7.0)
+    # ...and after the bake it advances to step 1, not to 100%
+    out3 = advance(SPEC, state, {E1: 200}, dict(world.observed), 11.0, 0)
+    assert out3.state.step == 1
+    assert out3.write == {E1: 50}
+
+
+def test_shard_handoff_resume_new_token_continues_and_stamps():
+    """A successor presenting a HIGHER fencing token resumes the
+    persisted step and stamps its own token on the next transition."""
+    world = World()
+    state = RolloutState()
+    out = advance(SPEC, state, {E1: 200}, {}, 0.0, token=3)
+    state = crash(out.state)
+    world.apply(out)
+    assert state.token == 3
+    out2 = advance(SPEC, state, {E1: 200}, dict(world.observed), 11.0,
+                   token=7)
+    assert out2.state.step == 1 and out2.state.token == 7
+
+
+def test_stale_fencing_token_transition_rejected():
+    out = advance(SPEC, RolloutState(), {E1: 200}, {}, 0.0, token=5)
+    state = crash(out.state)
+    with pytest.raises(StaleRolloutTokenError):
+        advance(SPEC, state, {E1: 200}, {E1: 10}, 11.0, token=4)
+
+
+# ---------------------------------------------------------------------------
+# health gate + rollback
+# ---------------------------------------------------------------------------
+
+def test_degraded_health_holds_step_never_advances():
+    world = World()
+    out = advance(SPEC, RolloutState(), {E1: 200}, {}, 0.0, 0)
+    state = out.state
+    world.apply(out)
+    out2 = advance(SPEC, state, {E1: 200}, dict(world.observed), 20.0,
+                   0, health=Health(HEALTH_DEGRADED, "circuit: open"))
+    assert out2.state is None and out2.write is None
+    assert out2.hold_reason == "circuit: open"
+    assert out2.requeue_after > 0
+
+
+def test_failed_health_rolls_back_exactly_once_and_sticks():
+    world = World({E1: 100})
+    # ramp two steps up from 100 toward 200
+    state = RolloutState()
+    now = 0.0
+    for _ in range(2):
+        out = advance(SPEC, state, {E1: 200}, dict(world.observed),
+                      now, 0)
+        if out.state is not None:
+            state = out.state
+        world.apply(out)
+        now += max(out.requeue_after, 0.01)
+    assert state.phase == PHASE_PROGRESSING
+    failed = Health(HEALTH_FAILED, "abort: canary 500s")
+    out = advance(SPEC, state, {E1: 200}, dict(world.observed), now, 0,
+                  health=failed)
+    assert out.transition == "rollback"
+    assert out.state.phase == PHASE_ROLLING_BACK
+    assert out.state.reason == "abort: canary 500s"
+    state = crash(out.state)
+    world.apply(out)
+    assert world.observed[E1] == 100, "rollback restores the baseline"
+    # duplicate deliveries: converge to RolledBack, NO second rollback
+    # transition, no further writes
+    writes_before = len(world.writes)
+    out2 = advance(SPEC, state, {E1: 200}, dict(world.observed), now,
+                   0, health=failed)
+    assert out2.transition == "rolled_back"
+    state = crash(out2.state)
+    for _ in range(3):
+        out3 = advance(SPEC, state, {E1: 200}, dict(world.observed),
+                       now, 0, health=failed)
+        assert out3.state is None and out3.write is None
+        assert out3.transition is None
+    assert len(world.writes) == writes_before
+    assert state.phase == PHASE_ROLLED_BACK
+    # sticky: even with health back to OK the failed target is dead...
+    out4 = advance(SPEC, state, {E1: 200}, dict(world.observed), now,
+                   0)
+    assert out4.write is None and out4.hold == {E1: 100}
+    # ...until a NEW target re-arms the machine
+    state5, _ = drive(SPEC, {E1: 150}, world, state=state, now=now)
+    assert state5.phase == PHASE_COMPLETED
+    assert world.observed[E1] == 150
+
+
+def test_rolled_back_drift_repaired_by_immediate_write():
+    """RolledBack is sticky for the failed target, but NOT inert: an
+    out-of-band edit that drifts the observed weights away from the
+    rolled-back baseline is repaired by one immediate write of the
+    last good weights (the Completed branch's drift semantics — the
+    EGB plane mutates only from ``write``, so a hold-only outcome
+    would leave the drifted group wrong forever)."""
+    world = World({E1: 100})
+    state = RolloutState()
+    now = 0.0
+    for _ in range(2):      # mid-ramp: Progressing past step 0
+        out = advance(SPEC, state, {E1: 200}, dict(world.observed),
+                      now, 0)
+        if out.state is not None:
+            state = out.state
+        world.apply(out)
+        now += max(out.requeue_after, 0.01)
+    out = advance(SPEC, state, {E1: 200}, dict(world.observed), now,
+                  0, health=Health(HEALTH_FAILED, "abort: x"))
+    state = crash(out.state)
+    world.apply(out)
+    out2 = advance(SPEC, state, {E1: 200}, dict(world.observed),
+                   now + 1.0, 0)
+    state = crash(out2.state)
+    assert state.phase == PHASE_ROLLED_BACK
+    # the out-of-band edit
+    world.observed[E1] = 7
+    out3 = advance(SPEC, state, {E1: 200}, dict(world.observed), 102.0,
+                   0)
+    assert out3.write == {E1: 100}, \
+        "rolled-back drift must be repaired, not held forever"
+    assert out3.state is None, "no new transition for a drift repair"
+    world.apply(out3)
+    # converged again: back to hold-only, still sticky
+    out4 = advance(SPEC, state, {E1: 200}, dict(world.observed), 103.0,
+                   0)
+    assert out4.write is None and out4.hold == {E1: 100}
+
+
+def test_rollback_write_idempotent_when_already_at_baseline():
+    """A rollback whose observed weights already equal the baseline
+    (the step-0 failure shape) writes nothing."""
+    world = World({E1: 100})
+    out = advance(SPEC, RolloutState(), {E1: 200},
+                  dict(world.observed), 0.0, 0)
+    state = out.state                   # step 0 persisted (write 105)
+    # CRASH before the write: observed still 100 == baseline
+    out2 = advance(SPEC, crash(state), {E1: 200}, dict(world.observed),
+                   1.0, 0, health=Health(HEALTH_FAILED, "abort: x"))
+    assert out2.transition == "rollback"
+    assert out2.write is None
+
+
+# ---------------------------------------------------------------------------
+# spec / state parsing + engine composition
+# ---------------------------------------------------------------------------
+
+def test_parse_spec_shapes():
+    assert parse_spec({}) is None
+    ok = parse_spec({ROLLOUT_STEPS_ANNOTATION: "5,25,50,100",
+                     ROLLOUT_INTERVAL_ANNOTATION: "12"})
+    assert ok.steps == (5, 25, 50, 100) and ok.interval == 12.0
+    # a ramp that stops short is completed to 100
+    assert parse_spec(
+        {ROLLOUT_STEPS_ANNOTATION: "10,50"}).steps == (10, 50, 100)
+    # malformed -> None (snap semantics), never a guess
+    for bad in ("abc", "50,25", "0,100", "10,10", "5,120", ""):
+        assert parse_spec({ROLLOUT_STEPS_ANNOTATION: bad}) is None
+    assert parse_spec({ROLLOUT_STEPS_ANNOTATION: "50,100",
+                       ROLLOUT_INTERVAL_ANNOTATION: "nope"}) is None
+    assert parse_spec({ROLLOUT_STEPS_ANNOTATION: "50,100",
+                       ROLLOUT_INTERVAL_ANNOTATION: "-1"}) is None
+
+
+def test_state_json_roundtrip_and_garbage():
+    st = RolloutState(phase=PHASE_PROGRESSING, step=2,
+                      step_started_at=123.5, target_digest="abc",
+                      from_weights={E1: 0}, to_weights={E1: 200},
+                      token=9, generation=4, reason="r",
+                      updated_at=124.0)
+    assert RolloutState.from_json(st.to_json()) == st
+    assert RolloutState.from_json(None) == RolloutState()
+    assert RolloutState.from_json("{not json") == RolloutState()
+    assert rollout_active(st.to_dict())
+    assert not rollout_active(None)
+
+
+def test_planned_weights_none_target_never_ramps():
+    st = RolloutState(from_weights={E1: 0}, to_weights={E1: None})
+    assert planned_weights(st, SPEC, 0) == {E1: None}
+
+
+def _engine(**kw):
+    return RolloutEngine("test-controller", registry=Registry(), **kw)
+
+
+def test_engine_abort_annotation_is_terminal_even_health_none():
+    eng = _engine()
+    spec = RolloutSpec(health="none")
+    h = eng.health_for("k", spec, {ROLLOUT_ABORT_ANNOTATION: "bad"})
+    assert h.verdict == HEALTH_FAILED and "bad" in h.reason
+
+
+def test_engine_breaker_and_error_window_degrade_gated_only():
+    eng = _engine(region_health=lambda: (False, "circuit: r open"))
+    gated = RolloutSpec(health="gated", interval=10.0)
+    assert eng.health_for("k", gated, {}).verdict == HEALTH_DEGRADED
+    assert eng.health_for(
+        "k", RolloutSpec(health="none"), {}).verdict == "healthy"
+    ok = _engine(region_health=lambda: (True, ""))
+    assert ok.health_for("k", gated, {}).verdict == "healthy"
+    ok.note_error("k")
+    assert ok.health_for("k", gated, {}).verdict == HEALTH_DEGRADED
+    ok.note_ok("k")
+    assert ok.health_for("k", gated, {}).verdict == "healthy"
+
+
+def test_engine_decide_passthrough_without_annotations():
+    eng = _engine()
+    out = eng.decide(key="k", route="k", annotations={},
+                     state_dict=None, desired={E1: 7}, observed={})
+    assert out.write == {E1: 7} and out.state is None
+    out2 = eng.decide(key="k", route="k", annotations={},
+                      state_dict=None, desired={E1: 7},
+                      observed={E1: 7})
+    assert out2.write is None
+
+
+def test_engine_decide_none_weights_passthrough():
+    """spec.weight: null ("leave the cloud default") cannot be
+    interpolated — snap semantics even with a declared ramp."""
+    eng = _engine()
+    out = eng.decide(key="k", route="k",
+                     annotations={ROLLOUT_STEPS_ANNOTATION: "50,100"},
+                     state_dict=None, desired={E1: None}, observed={})
+    assert out.write == {E1: None} and out.state is None
+
+
+def test_engine_annotations_removed_mid_ramp_snaps_and_clears():
+    eng = _engine()
+    mid = RolloutState(phase=PHASE_PROGRESSING, step=1,
+                       target_digest="x", from_weights={E1: 0},
+                       to_weights={E1: 200})
+    out = eng.decide(key="k", route="k", annotations={},
+                     state_dict=mid.to_dict(), desired={E1: 200},
+                     observed={E1: 50})
+    assert out.write == {E1: 200}
+    assert out.state is not None
+    assert out.state.phase == PHASE_COMPLETED
+    assert "removed" in out.state.reason
+    assert not rollout_active(out.state.to_dict())
+
+
+def test_engine_counts_transitions_holds_rollbacks():
+    reg = Registry()
+    eng = RolloutEngine("ctl", registry=reg)
+    ann = {ROLLOUT_STEPS_ANNOTATION: "50,100",
+           ROLLOUT_INTERVAL_ANNOTATION: "0.01"}
+    out = eng.decide(key="k", route="k", annotations=ann,
+                     state_dict=None, desired={E1: 100}, observed={})
+    assert reg.counter_value("rollout_transitions_total",
+                             {"controller": "ctl", "to": "start"}) == 1
+    aborted = dict(ann)
+    aborted[ROLLOUT_ABORT_ANNOTATION] = "canary 500s"
+    out2 = eng.decide(key="k", route="k", annotations=aborted,
+                      state_dict=out.state.to_dict(),
+                      desired={E1: 100}, observed={E1: 50})
+    assert out2.transition == "rollback"
+    assert reg.counter_value("rollout_rollbacks_total",
+                             {"controller": "ctl",
+                              "reason": "abort"}) == 1
+
+
+def test_route53_worker_wrapper_feeds_rollout_health_gate():
+    """The Route53 worker loop's process-func wrapper is the
+    controller's only feed into the engine's sync-error window: an
+    exception marks the key degraded, a completed sync clears it —
+    without it the 'sync_errors' half of the record-plane health gate
+    would be inert."""
+    from aws_global_accelerator_controller_tpu.controller.route53 import (
+        Route53Controller,
+    )
+
+    c = Route53Controller.__new__(Route53Controller)
+    c.rollout = RolloutEngine("r53-test")
+
+    class Obj:
+        def key(self):
+            return "default/x"
+
+    def boom(arg):
+        raise RuntimeError("sync failed")
+
+    with pytest.raises(RuntimeError):
+        c._rollout_health_tracked(boom)("default/x")
+    assert c.rollout._recent_error("default/x", 60.0), \
+        "a failed sync must open the health window"
+
+    c._rollout_health_tracked(lambda arg: None)(Obj())
+    assert not c.rollout._recent_error("default/x", 60.0), \
+        "a completed sync must clear the health window"
