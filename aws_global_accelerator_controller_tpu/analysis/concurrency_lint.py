@@ -1,4 +1,4 @@
-"""AST-based concurrency contract lints (rules L101-L112).
+"""AST-based concurrency contract lints (rules L101-L113).
 
 The static half of the concurrency checker: a whole-program pass over
 the tree that enforces the synchronization contracts PR 1 introduced as
@@ -109,6 +109,25 @@ zero-findings gate philosophy):
                          are verified whenever their files are linted
                          (the seeded probe strips one and asserts the
                          rule fires).  Package-scoped like L105.
+  L113 columnar planner purity
+                         The whole-fleet planner modules
+                         (``parallel/fleet_plan.py``,
+                         ``reconcile/columnar.py``) must stay pure
+                         over packed arrays: (a) no call through
+                         ``apis`` anywhere in either module — packing
+                         is host-side preparation over informer/
+                         describe state the CALLER collected, the
+                         planner itself never reaches the provider;
+                         (b) no Python ``for``/``while`` in a device
+                         program (any function named ``_device_*`` or
+                         decorated with ``jit``/``shard_map``) — a
+                         per-object Python loop over fleet keys inside
+                         the jit path silently reverts the planner to
+                         the object-at-a-time cost the columnar pass
+                         exists to delete (it also recompiles per
+                         fleet size).  Host-side pack/decode loops are
+                         legal; ring-hop unrolls live in undecorated
+                         helpers by convention.
   L108 fenced mutations  Mutation-issuing paths must consult the
                          lifecycle fence (resilience/fence.py): no
                          AWS WRITE method may be reachable after
@@ -352,6 +371,36 @@ def _l111_module(name: str) -> bool:
                for m in _L111_MODULES)
 
 
+def _l113_in_scope(path: Path) -> bool:
+    """L113 covers the two columnar planner modules (the fleet pass
+    and its packing layer) plus the fixture corpus (``l113_*.py``)."""
+    if path.name.startswith("l113_"):
+        return True
+    parts = path.parts
+    if "aws_global_accelerator_controller_tpu" not in parts:
+        return False
+    return (path.name == "fleet_plan.py" and "parallel" in parts) \
+        or (path.name == "columnar.py" and "reconcile" in parts)
+
+
+def _l113_device_fn(fn: ast.AST) -> bool:
+    """Is this function a device program?  By the planner's naming
+    convention (``_device_*``) or by carrying a ``jit``/``shard_map``
+    decoration (bare, attribute-qualified, or through
+    ``partial(...)``)."""
+    if fn.name.startswith("_device_"):
+        return True
+    for dec in fn.decorator_list:
+        for node in ast.walk(dec):
+            if isinstance(node, ast.Name) \
+                    and node.id in ("jit", "shard_map"):
+                return True
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in ("jit", "shard_map"):
+                return True
+    return False
+
+
 def _l107_fastpath(path: Path, fn_name: str) -> bool:
     """Is this function on the fingerprint fast path (rule L107)?
     The reconcile package's own modules (the dispatch + the
@@ -536,6 +585,7 @@ class Engine:
                 self._walk_held(info, classname, fn, fn.body, [])
                 self._check_shared_views(info, fn)
             self._check_compat_shim(info)
+            self._check_columnar_purity(info)
         self._check_ordering_graph()
         self._check_wrapper_fence_gate()
         self._check_sharded_submit_gate()
@@ -718,6 +768,43 @@ class Engine:
                     flagged_lines.add(node.lineno)
                     flag(node.lineno,
                          f"attribute access '{'.'.join(chain)}'")
+
+    def _check_columnar_purity(self, info: _FileInfo) -> None:
+        """Rule L113: the columnar planner modules stay pure over
+        packed arrays — no reach through ``apis`` anywhere in the
+        module, no Python loops over fleet keys inside a device
+        program (module docstring).  Whole-file pass like L111: the
+        ``apis`` half must also catch module-level statements the
+        per-function walk never visits."""
+        if not _l113_in_scope(info.path):
+            return
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain and "apis" in chain[:-1]:
+                self.findings.append(Finding(
+                    info.path, node.lineno, "L113",
+                    f"provider call '{'.'.join(chain)}()' inside the "
+                    f"columnar planner: the whole-fleet pass is pure "
+                    f"over packed arrays — collect provider state in "
+                    f"the caller (controller/fleetsweep.py) and pack "
+                    f"it, or waive with '# race: <reason>'"))
+        for classname, fn in self._functions(info.tree):
+            if not _l113_device_fn(fn):
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.For, ast.AsyncFor,
+                                     ast.While)):
+                    self.findings.append(Finding(
+                        info.path, node.lineno, "L113",
+                        f"Python loop in device program "
+                        f"'{fn.name}': a per-object loop over fleet "
+                        f"keys in the jit path reverts the planner "
+                        f"to object-at-a-time cost (and recompiles "
+                        f"per fleet size) — express it as array ops "
+                        f"over the packed [G, E] grids, or move the "
+                        f"loop to host-side pack/decode"))
 
     def _check_ordering_graph(self) -> None:
         seen: Set[Tuple[str, str]] = set()

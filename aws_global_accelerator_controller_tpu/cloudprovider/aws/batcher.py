@@ -777,6 +777,32 @@ class ShardedCoalescer:
         return self._cohort(endpoint_group_arn).update_endpoints(
             endpoint_group_arn, ops)
 
+    def submit_plan(self, intents) -> "Tuple[List[str], Dict[str, Exception]]":
+        """Consume whole-fleet planner intents (parallel/fleet_plan.py
+        decode): each group's ``EndpointOp`` list rides the normal
+        fenced, shard-checked submit path above.  Per-group rejection
+        is REPORTED, not raised — a shard deposed between the columnar
+        plan and this flush rejects exactly its own groups
+        (ShardNotOwnedError / FencedError: stale fenced intents never
+        reach the wire) while the rest of the plan lands; the caller
+        hands rejected groups to the successor owner to replan.
+        Returns ``(applied group ARNs, {group ARN: rejection})``.
+        """
+        from ...sharding.shardset import ShardNotOwnedError
+
+        applied: List[str] = []
+        rejected: Dict[str, Exception] = {}
+        for intent in intents:
+            ops = list(intent.ops)
+            if not ops:
+                continue                 # converged group: no writes
+            try:
+                self.update_endpoints(intent.group_arn, ops)
+                applied.append(intent.group_arn)
+            except (ShardNotOwnedError, FencedError) as exc:
+                rejected[intent.group_arn] = exc
+        return applied, rejected
+
     # -- drains ---------------------------------------------------------
 
     def drain(self, timeout: float) -> bool:

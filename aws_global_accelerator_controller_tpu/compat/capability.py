@@ -355,6 +355,13 @@ class CapabilityRegistry:
         one ladder, one policy."""
         return self.attention_rung()
 
+    def plan_rung(self) -> str:
+        """Alias of :meth:`attention_rung` for the whole-fleet planner
+        (parallel/fleet_plan.py) — the columnar pass dispatches its
+        layout and quantiser per rung but climbs the SAME ladder as
+        every other accelerator entry point."""
+        return self.attention_rung()
+
     def interpret_mode(self) -> bool:
         """Should a pallas kernel run interpreted?  True on every rung
         below pallas-tpu (raises when no rung at all works)."""

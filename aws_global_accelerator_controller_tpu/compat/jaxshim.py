@@ -170,6 +170,12 @@ make_async_remote_copy = _resolve("make_async_remote_copy", [
 SemaphoreType = _resolve("SemaphoreType", [
     "jax.experimental.pallas.tpu.SemaphoreType",
 ])
+# RDMA device addressing for make_async_remote_copy (the fleet
+# planner's TPU-rung cross-shard stats ring names neighbours by mesh
+# coordinates)
+DeviceIdType = _resolve("DeviceIdType", [
+    "jax.experimental.pallas.tpu.DeviceIdType",
+])
 
 # -- jax top-level drift ---------------------------------------------------
 

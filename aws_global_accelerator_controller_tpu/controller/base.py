@@ -76,7 +76,7 @@ def annotation_presence_changed(old: KubeObject, new: KubeObject,
     return (annotation in old.annotations) != (annotation in new.annotations)
 
 
-def resync_enqueue(fingerprints, queue, obj, wave: int) -> None:
+def resync_enqueue(fingerprints, queue, obj, wave: int) -> "str | None":
     """The enqueue-time half of the steady-state fast path, shared by
     every controller's tagged resync handler.
 
@@ -106,7 +106,7 @@ def resync_enqueue(fingerprints, queue, obj, wave: int) -> None:
     if origin == ORIGIN_RESYNC and fingerprints.matches(key, obj):
         fingerprints.claim_origin(key)
         metrics.record_fastpath_skip(fingerprints.controller)
-        return
+        return None
     if origin in (ORIGIN_RESYNC, ORIGIN_SWEEP):
         reason = queue.overloaded() if hasattr(queue, "overloaded") \
             else None
@@ -116,8 +116,12 @@ def resync_enqueue(fingerprints, queue, obj, wave: int) -> None:
             # next delivery upgrades or re-claims it)
             fingerprints.claim_origin(key)
             metrics.record_shed(fingerprints.controller, reason)
-            return
+            return None
     queue.add_rate_limited(key, klass=CLASS_BACKGROUND)
+    # the origin that was actually ENQUEUED (None = answered/shed
+    # above): callers batching sweep-tier work — the fleet-sweep
+    # planner stages ORIGIN_SWEEP keys — key off this return
+    return origin
 
 
 class ShardGate:

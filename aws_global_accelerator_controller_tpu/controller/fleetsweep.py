@@ -1,0 +1,412 @@
+"""Sweep-tier consumer of the whole-fleet planner.
+
+The EndpointGroupBinding drift sweep used to recompute every due
+binding per-object: one ``[1, E]`` model forward + two Python set
+loops + a describe each.  This module batches a sweep wave's due keys
+into ONE columnar plan (parallel/fleet_plan.py) and lets the sweep
+dispatch consume the planner's per-key intents:
+
+- **converged** (empty intent set): the read-only answer — the sweep
+  sync records its pass without re-running the per-object plan.
+- **weight-drift** on a spec-weighted binding: the intents ARE the
+  repair — one coalesced re-weight submitted through the provider's
+  fenced, shard-checked write path, no per-object recomputation.
+- anything else (**diverged** membership, model-planned weight drift,
+  **unplanned** keys): fall back to the existing per-object deep
+  verify, which owns status writes and referent re-resolution.
+
+Wave mechanics: ``stage()`` collects the keys the resync handler
+promoted to the sweep tier; the first sweep dispatch plans the whole
+staged batch (one describe per group — the same provider read count
+the per-object tier paid, just batched ahead) and publishes per-key
+entries; later dispatches in the wave consume their entry if the
+binding's fingerprint still matches the one planned against.
+
+Honesty bounds, because the fleet plans against ``status.endpointIds``
+order while the per-object path plans against referent-resolution
+order (the two agree for any binding that converged and hasn't been
+reordered — reorders move the fingerprint and eject the key here):
+
+- model-planned weight drift is never repaired directly (the index
+  feature makes model weights order-sensitive; the per-object path is
+  the order authority), and
+- every ``verify_every``-th sweep of a key falls through to the
+  per-object deep verify regardless of verdict, so a pathological
+  order skew can never hide drift indefinitely.
+
+Mid-ramp bindings (rollout annotations or persisted state) are vetoed
+at plan time: their convergence belongs to the rollout machine's timed
+re-deliveries, and their weights are NOT the full-target values this
+planner computes.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..analysis import locks
+from ..rollout import rollout_active
+
+logger = logging.getLogger(__name__)
+
+VERDICT_CONVERGED = "converged"
+VERDICT_WEIGHT_DRIFT = "weight-drift"
+VERDICT_DIVERGED = "diverged"
+VERDICT_UNPLANNED = "unplanned"
+
+#: stale-entry horizon: an entry no dispatch consumed within this many
+#: seconds is dropped (the wave it belonged to is long over)
+ENTRY_TTL = 60.0
+
+
+@dataclass
+class _Entry:
+    verdict: str
+    fingerprint: tuple
+    ops: List[object]
+    weights: Dict[str, int]
+    observed: object                  # the EndpointGroup described
+    planned_at: float = field(default_factory=time.monotonic)
+
+
+class FleetSweepPlanner:
+    """Per-wave columnar planning + per-key intent consumption.
+
+    Collaborators come in as callables so the planner stays decoupled
+    from informer/provider wiring (and trivially testable): they are
+    only invoked from :meth:`plan_staged` — never on the fingerprint
+    fast path.
+    """
+
+    def __init__(self, controller: str, shards,
+                 get_binding: Callable[[str], object],
+                 describe: Callable[[str], object],
+                 fingerprint: Callable[[object], tuple],
+                 route: Callable[[object], str],
+                 weight_policy=None,
+                 endpoints_cap: int = 32,
+                 verify_every: int = 4,
+                 wave_cap: int = 256,
+                 cache_max: int = 131072,
+                 enabled: bool = True):
+        from collections import OrderedDict
+
+        self.controller = controller
+        self.enabled = enabled
+        self.endpoints_cap = endpoints_cap
+        self.verify_every = max(1, verify_every)
+        #: at most this many staged keys plan per plan_staged call —
+        #: bounds the describe stall one worker absorbs on a huge
+        #: wave (the rest stay staged; the next sweep dispatch plans
+        #: the next chunk)
+        self.wave_cap = max(1, wave_cap)
+        self._shards = shards
+        self._get_binding = get_binding
+        self._describe = describe
+        self._fingerprint = fingerprint
+        self._route = route
+        self._weight_policy = weight_policy
+        self._lock = locks.make_lock("fleet-sweep")
+        self._staged: Set[str] = set()
+        self._entries: Dict[str, _Entry] = {}
+        #: key -> (fingerprint, planned weights): the incremental feed
+        #: (cache hit = no score rows packed for the group next wave).
+        #: LRU-bounded at ``cache_max`` — binding churn over a
+        #: controller's months-long life must never grow this without
+        #: bound; an evicted key just rescores on its next wave
+        self._cache_max = max(1, cache_max)
+        self._weight_cache: "OrderedDict[str, Tuple[tuple, Dict[str, int]]]" = OrderedDict()  # noqa: E501
+        #: key -> consecutive fleet-answered sweeps (the verify_every
+        #: escape valve); evicted alongside the weight cache
+        self._streak: Dict[str, int] = {}
+        self._planner = None
+
+    # -- staging (resync handler, wave enqueue time) -------------------
+
+    def stage(self, key: str) -> None:
+        """A key the resync handler promoted to the sweep tier; the
+        wave's first dispatch plans every staged key at once."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._staged.add(key)
+
+    # -- the wave plan -------------------------------------------------
+
+    def _model_ctx(self):
+        """(model, params) when the weight policy is model-backed —
+        resolved per wave so a hot-reloaded policy's fresh params are
+        picked up; (None, None) for static policies."""
+        policy = self._weight_policy
+        inner = getattr(policy, "_inner", None)
+        if inner is not None:          # ReloadingModelWeightPolicy
+            policy = inner
+        model = getattr(policy, "model", None)
+        params = getattr(policy, "params", None)
+        if model is None or params is None:
+            return None, None
+        return model, params
+
+    def _get_planner(self, model, params):
+        from ..parallel.fleet_plan import WholeFleetPlanner
+
+        with self._lock:
+            planner = self._planner
+            prior_params = None if planner is None else planner.params
+        if planner is None:
+            if model is None:
+                # spec/static fleets never pack score rows, but the
+                # pass still needs A model; CPU-pinned like the weight
+                # policy (controller startup must never block on
+                # accelerator backend init)
+                from ..jaxenv import import_jax_cpu
+
+                import_jax_cpu()
+            # constructed OUTSIDE the lock (model init runs jax
+            # compute); a racing duplicate is idempotent, first
+            # publication wins
+            fresh = WholeFleetPlanner(model=model, params=params)
+            with self._lock:
+                if self._planner is None:
+                    self._planner = fresh
+                planner = self._planner
+        elif params is not None and params is not prior_params:
+            # hot-reload follow — and the incremental feed holds
+            # OLD-model weights now: flush it, or pre-reload bindings
+            # would keep 'converging' against stale plans (and then
+            # ping-pong between cached-stale and per-object-fresh)
+            with self._lock:
+                planner.params = params
+                self._weight_cache.clear()
+                self._streak.clear()
+        return planner
+
+    def _cached_weights(self, key: str):
+        """Locked read of the incremental feed (publication and LRU
+        eviction mutate it under the same lock)."""
+        with self._lock:
+            return self._weight_cache.get(key)
+
+    def _eligible(self, binding) -> bool:
+        from ..apis import ROLLOUT_STEPS_ANNOTATION
+
+        return (binding is not None
+                and binding.metadata.deletion_timestamp is None
+                and bool(binding.metadata.finalizers)
+                and binding.spec.endpoint_group_arn
+                and binding.status.observed_generation
+                == binding.metadata.generation
+                and len(binding.status.endpoint_ids)
+                <= self.endpoints_cap
+                and ROLLOUT_STEPS_ANNOTATION not in binding.annotations
+                and not rollout_active(binding.status.rollout))
+
+    def plan_staged(self) -> int:
+        """Plan every staged key in one columnar pass; returns the
+        number of groups planned.  Provider describes happen OUTSIDE
+        the lock (one per group — the read bill the per-object tier
+        paid anyway), only entry publication takes it."""
+        with self._lock:
+            if len(self._staged) <= self.wave_cap:
+                staged, self._staged = self._staged, set()
+            else:
+                # huge wave: plan a bounded chunk now (bounding the
+                # describe stall this one worker absorbs); the next
+                # sweep dispatch plans the next chunk
+                staged = set(sorted(self._staged)[:self.wave_cap])
+                self._staged -= staged
+        if not staged:
+            return 0
+        from ..reconcile.columnar import GroupState
+        from ..sharding.hashmap import shard_of
+
+        model, params = self._model_ctx()
+        planner = self._get_planner(model, params)
+        num_shards = getattr(self._shards, "num_shards", 1)
+        states: List[GroupState] = []
+        metas: List[Tuple[str, tuple, object]] = []
+        for key in sorted(staged):
+            binding = self._get_binding(key)
+            if not self._eligible(binding) \
+                    or not self._shards.owns_key(self._route(binding)):
+                continue
+            fp = self._fingerprint(binding)
+            try:
+                group = self._describe(binding.spec.endpoint_group_arn)
+            except Exception as exc:
+                # unreachable group: the per-object path owns the
+                # error-classification story for this key
+                logger.debug("fleet sweep: describe %s failed: %s",
+                             binding.spec.endpoint_group_arn, exc)
+                continue
+            state = self._group_state(key, binding, group, fp, model,
+                                      num_shards, shard_of)
+            if state is None:
+                continue
+            states.append(state)
+            metas.append((key, fp, group,
+                          binding.spec.weight is not None))
+        if not states:
+            return 0
+        result = planner.plan_groups(
+            states, endpoints_cap=self.endpoints_cap,
+            shards=num_shards)
+        # pack_fleet lays groups out shard-major, so intents come back
+        # reordered — join on the key, never on input position
+        by_key = {intent.key: intent for intent in result.intents()}
+        now = time.monotonic()
+        with self._lock:
+            for key, fp, group, spec_weighted in metas:
+                intent = by_key[key]
+                self._weight_cache[key] = (fp, dict(intent.weights))
+                self._weight_cache.move_to_end(key)
+                self._entries[key] = _Entry(
+                    verdict=self._verdict(intent, spec_weighted),
+                    fingerprint=fp, ops=list(intent.ops),
+                    weights=dict(intent.weights), observed=group,
+                    planned_at=now)
+            # LRU bound on the incremental feed (binding churn must
+            # never grow it unbounded); streaks die with their cache
+            # entry so neither dict outlives the fleet
+            while len(self._weight_cache) > self._cache_max:
+                evicted, _ = self._weight_cache.popitem(last=False)
+                self._streak.pop(evicted, None)
+            # TTL sweep of entries no dispatch ever consumed
+            dead = [k for k, e in self._entries.items()
+                    if now - e.planned_at > ENTRY_TTL]
+            for k in dead:
+                del self._entries[k]
+        logger.debug("fleet sweep: planned %d groups on rung %s (%s)",
+                     len(states), result.rung, result.stats)
+        return len(states)
+
+    def _group_state(self, key, binding, group, fp, model, num_shards,
+                     shard_of):
+        from ..reconcile.columnar import GroupState
+
+        desired = list(binding.status.endpoint_ids)
+        observed = [d.endpoint_id for d in group.endpoint_descriptions]
+        observed_w = [d.weight for d in group.endpoint_descriptions]
+        if len(observed) > self.endpoints_cap:
+            return None
+        spec_weight = binding.spec.weight
+        model_planned = spec_weight is None and model is not None
+        features = None
+        cached: Optional[Sequence[int]] = None
+        if model_planned:
+            hit = self._cached_weights(key)
+            if hit is not None and hit[0] == fp \
+                    and all(arn in hit[1] for arn in desired):
+                cached = [hit[1][arn] for arn in desired]
+            else:
+                import numpy as np
+
+                from .weightpolicy import ModelWeightPolicy
+
+                features = np.stack(
+                    [ModelWeightPolicy._featurize(
+                        arn, i, len(desired), binding)
+                     for i, arn in enumerate(desired)]) \
+                    if desired else np.zeros((0, model.feature_dim),
+                                             np.float32)
+        return GroupState(
+            key=key, group_arn=binding.spec.endpoint_group_arn,
+            desired=desired, observed=observed,
+            observed_weights=observed_w, features=features,
+            spec_weight=spec_weight, model_planned=model_planned,
+            client_ip_preservation=binding.spec.client_ip_preservation,
+            fingerprint=0,
+            shard=shard_of(self._route(binding), num_shards),
+            cached_weights=cached)
+
+    @staticmethod
+    def _verdict(intent, spec_weighted: bool) -> str:
+        """Per-object-parity verdict over the planner's intents.
+
+        ``remove`` intents are endpoints live in the group but absent
+        from ``status.endpointIds`` — endpoints this binding never
+        added.  The per-object path NEVER prunes those (reference
+        semantics: the controller only drains what its status
+        records), so they are not this binding's drift; the fleet
+        stats still surface them.  A desired endpoint missing live
+        (``set``) gets exactly what the per-object sweep would issue:
+        a weight write — so for spec-weighted groups both ``set`` and
+        ``weight`` intents repair directly.  Model-planned groups
+        never repair here: model weights are order-sensitive and the
+        per-object path is the order authority (module docstring).
+        """
+        ops = [op for op in intent.ops
+               if getattr(op, "kind", None) != "remove"]
+        if not ops:
+            return VERDICT_CONVERGED
+        if spec_weighted and all(op.kind in ("weight", "set")
+                                 for op in ops):
+            return VERDICT_WEIGHT_DRIFT
+        return VERDICT_DIVERGED
+
+    # -- consumption (sweep dispatch) ----------------------------------
+
+    def sweep_verdict(self, key: str, binding) -> Tuple[str,
+                                                        Optional[_Entry]]:
+        """The sweep dispatch's question: what did the fleet plan say
+        about this key?  Plans the staged wave lazily on first ask;
+        ``unplanned`` (key missing, fingerprint moved since planning,
+        or the verify_every valve firing) sends the caller down the
+        per-object deep-verify path."""
+        if not self.enabled:
+            return VERDICT_UNPLANNED, None
+        with self._lock:
+            has_staged = bool(self._staged)
+        if has_staged:
+            self.plan_staged()
+        # fingerprint reads ride informer listers (their own locks) —
+        # computed before taking ours so lock scopes never nest
+        fp_now = self._fingerprint(binding)
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                self._streak.pop(key, None)
+                return VERDICT_UNPLANNED, None
+            streak = self._streak.get(key, 0) + 1
+            if streak >= self.verify_every:
+                # the escape valve: force a per-object verify so an
+                # order-skewed model plan can never hide drift forever
+                self._streak[key] = 0
+                return VERDICT_UNPLANNED, None
+            if entry.fingerprint != fp_now:
+                self._streak.pop(key, None)
+                return VERDICT_UNPLANNED, None
+            if entry.verdict in (VERDICT_CONVERGED,
+                                 VERDICT_WEIGHT_DRIFT):
+                # both are fleet ANSWERS — the valve counts them both,
+                # so a continuously re-drifting binding still reaches
+                # its per-object verify every Nth sweep (the
+                # "regardless of verdict" contract)
+                self._streak[key] = streak
+            else:
+                self._streak.pop(key, None)
+            return entry.verdict, entry
+
+    def repair_weights(self, binding, entry: _Entry, provider) -> bool:
+        """Apply a spec-weight drift repair straight from the planner's
+        intents: ONE coalesced re-weight through the provider's fenced,
+        shard-checked write path.  Model-planned groups never land here
+        (their verdict falls back per-object); a ramp that appeared
+        since planning re-vetoes — ``rollout_active`` is consulted so
+        a mid-ramp object is never snapped to its full target."""
+        if binding.spec.weight is None:
+            return False
+        if rollout_active(binding.status.rollout):
+            return False
+        # ``weight`` = present-but-drifted; ``set`` = recorded in
+        # status but missing live — the per-object path writes BOTH
+        # through the same merged re-weight (its write dict filters on
+        # current.get(id, "absent") != weight), so mirror it exactly
+        weights = {op.endpoint_id: op.weight for op in entry.ops
+                   if getattr(op, "kind", None) in ("weight", "set")}
+        if not weights:
+            return False
+        provider.update_endpoint_weights(entry.observed, weights)
+        return True

@@ -533,6 +533,18 @@ def record_drift_sweep_verify(registry: Optional[Registry] = None) -> None:
     reg.inc_counter("drift_sweep_verifies_total", {})
 
 
+def record_fleet_sweep(controller: str, verdict: str,
+                       registry: Optional[Registry] = None) -> None:
+    """One sweep-origin dispatch answered by the whole-fleet planner
+    (controller/fleetsweep.py): ``converged`` = read-only pass,
+    ``repaired`` = weight drift fixed straight from planner intents,
+    ``diverged``/``unplanned`` = fell back to the per-object deep
+    verify."""
+    reg = registry or default_registry
+    reg.inc_counter("fleet_sweep_verdicts_total",
+                    {"controller": controller, "verdict": verdict})
+
+
 def record_drift_repair(registry: Optional[Registry] = None) -> None:
     """One provider mutation attributed to out-of-band drift repair
     (submitted while a sweep-origin sync was on the stack)."""

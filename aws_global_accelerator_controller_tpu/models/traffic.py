@@ -86,6 +86,21 @@ class TrafficPolicyModel(TrainableModel):
         s = h @ params["w3"] + params["b3"]
         return s[..., 0].astype(jnp.float32)
 
+    def score_rows(self, params: Params, rows: jax.Array) -> jax.Array:
+        """[N, F] packed endpoint rows -> [N] float32 scores.
+
+        The columnar fleet planner's scoring entry
+        (parallel/fleet_plan.py): one row per VALID endpoint, no
+        padding lanes.  ``scores`` already batches over arbitrary
+        leading dims and the per-row dot over F is shape-independent,
+        so a packed row scores bit-identically to the same endpoint's
+        lane in the per-object ``[1, E, F]`` forward — the property
+        the jnp-reference oracle tests pin.  This alias makes that
+        contract explicit instead of leaving fleet_plan.py to lean on
+        an incidental broadcasting behaviour.
+        """
+        return self.scores(params, rows)
+
     def forward(self, params: Params, features: jax.Array,
                 mask: jax.Array) -> jax.Array:
         """[G, E, F] + mask -> int32 GA weights [G, E] (see ``serve``)."""

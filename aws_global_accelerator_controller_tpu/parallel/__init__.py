@@ -16,6 +16,11 @@ from .experts import (
     make_expert_planner,
 )
 from .fleet import FleetPlanner
+from .fleet_plan import (
+    FleetPlanResult,
+    WholeFleetPlanner,
+    make_fleet_pass,
+)
 from .mesh import make_mesh
 from .moe import ShardedMoEPlanner, moe_param_specs
 from .pipeline import (

@@ -1,0 +1,331 @@
+"""Accelerator-resident whole-fleet planner.
+
+The hot planning loop, moved off per-object Python: one XLA program
+scores every rescored endpoint in the fleet (packed CSR rows, no
+padding-lane matmuls), quantises scores into Global Accelerator weight
+allocations, and diffs plan-vs-observed for EVERY group — memberships
+and weights — in vectorized jnp ops whose nonzero rows decode straight
+into ``EndpointOp`` mutation intents (reconcile/columnar.py) for the
+sharded coalescer.
+
+Rung dispatch (compat/capability.py, one ladder fleet-wide):
+
+- ``jnp-reference`` — a single-device jit of the dense program; the
+  ORACLE rung, bit-matching the per-object scalar path
+  (``TrafficPolicyModel.forward_dense`` + ``ops.weights.plan_weights``
+  + set diff) — tests/test_fleet_plan.py pins that equality.
+- ``pallas-interpret`` — the sharded program (shimmed ``shard_map``
+  over the mesh's 'data' axis, shard-major fleet slices resident per
+  device) with the dense quantiser: the interpret probe proves the
+  kernel path works, but interpreting a fleet-sized kernel would be
+  slower than the reference math, so only the LAYOUT upgrades on this
+  rung (same dispatch rule as models/traffic ``serve="auto"``).
+- ``pallas-tpu`` — the sharded program with the fused Pallas weight
+  kernel (ops/pallas_weights.py, one VMEM round-trip per group block)
+  and, when the installed pallas resolves
+  ``make_async_remote_copy``, the cross-shard stats reduce rides an
+  explicit neighbour RDMA ring instead of a flat ``psum`` — the
+  SNIPPETS.md shard_map + async-remote-copy pattern.
+
+Cross-shard reduction is hierarchical either way (HiCCL's compose,
+PAPERS.md): per-shard partial stats first collapse across the mesh's
+'model' axis replicas (``pmean`` — intra-group, the cheap domain),
+then reduce across shards ('data' axis) — never a flat all-to-all of
+per-group state; only the [5]-vector of fleet totals crosses shards.
+
+Purity contract (lint rule L113): no ``apis.*`` reach anywhere in this
+module, and no Python loops over fleet keys in the device programs
+(``_device_*`` / jitted / shard_mapped functions) — the fleet is
+arrays end to end between pack and decode.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..compat import RUNG_REFERENCE, RUNG_TPU, registry
+from ..compat.jaxshim import shard_map
+from ..ops.diff import EMPTY, plan_observed_diff
+from ..ops.weights import plan_weights
+from ..reconcile.columnar import (
+    MODE_NONE,
+    MODE_SPEC,
+    ColumnarFleet,
+    GroupIntent,
+    GroupState,
+    decode_intents,
+    pack_fleet,
+)
+
+#: stats vector layout (float32, psum-reduced across shards)
+STAT_ADDS, STAT_REMOVES, STAT_REWEIGHTS, STAT_LIVE, STAT_RESCORED = \
+    range(5)
+
+
+def _device_plan_block(score_rows, quantize, params, rows, seg, slot,
+                       desired, observed, observed_w, cached_w,
+                       rescored, mode, spec_w):
+    """One block's whole plan: scores -> weights -> diff -> stats.
+
+    ``rows [N, F]`` packed features with scatter coords ``seg``/``slot``
+    (out-of-bounds seg = pad row, dropped); grids ``[G, E]``.  Runs as
+    the entire fleet (reference rung) or one shard's slice (sharded
+    rungs) — same math, so the layouts agree exactly.
+    """
+    import jax.numpy as jnp
+
+    G, E = desired.shape
+    s = score_rows(params, rows)                       # [N] float32
+    grid = jnp.zeros((G, E), jnp.float32)
+    grid = grid.at[seg, slot].set(s, mode="drop")
+    mask = desired != EMPTY
+    planned = quantize(grid, mask)                     # [G, E] int32
+    fresh = jnp.where(rescored[:, None], planned, cached_w)
+    spec_col = jnp.where(mask, jnp.maximum(spec_w, 0)[:, None], 0)
+    desired_w = jnp.where((mode == MODE_SPEC)[:, None], spec_col, fresh)
+    to_add, to_remove, in_both, obs_w = plan_observed_diff(
+        desired, observed, observed_w)
+    has_target = (mode != MODE_NONE)[:, None]
+    to_reweight = in_both & has_target & (desired_w != obs_w)
+    stats = jnp.stack([
+        jnp.sum(to_add), jnp.sum(to_remove), jnp.sum(to_reweight),
+        jnp.sum(mask), jnp.sum(rescored),
+    ]).astype(jnp.float32)
+    return desired_w, to_add, to_remove, to_reweight, stats
+
+
+def _make_stats_ring(n: int, axis: str):
+    """TPU-rung cross-shard stats all-reduce as a neighbour RDMA ring.
+
+    Each hop is one shimmed ``make_async_remote_copy``: every device
+    sends its block to the right neighbour (recv-semaphore wait = the
+    hop barrier), accumulating what arrives — n-1 hops of an (8, 128)
+    tile instead of a flat collective, the SNIPPETS.md pattern.  Only
+    traced on the pallas-tpu rung with ``async_remote_copy`` resolved;
+    execution requires a multi-chip TPU (the capability probe's
+    documented limit), everything else reduces with pmean/psum.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..compat import jaxshim
+
+    def _hop(x):
+        def kernel(in_ref, out_ref, send_sem, recv_sem):
+            my = jax.lax.axis_index(axis)
+            right = jax.lax.rem(my + 1, n)
+            op = jaxshim.make_async_remote_copy(
+                src_ref=in_ref, dst_ref=out_ref,
+                send_sem=send_sem, recv_sem=recv_sem,
+                device_id=(right,),
+                device_id_type=jaxshim.DeviceIdType.MESH)
+            op.start()
+            op.wait()
+
+        return jaxshim.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            in_specs=[jaxshim.block_spec(memory_space=jaxshim.ANY)],
+            out_specs=jaxshim.block_spec(memory_space=jaxshim.ANY),
+            scratch_shapes=[jaxshim.SemaphoreType.DMA] * 2,
+        )(x)
+
+    def reduce(stats):
+        k = stats.shape[0]
+        tile = jnp.zeros((8, 128), jnp.float32).at[0, :k].set(stats)
+        acc = tile
+        blk = tile
+        for _ in range(n - 1):   # static unroll over ring hops (not
+            blk = _hop(blk)      # fleet keys — L113's loop rule is
+            acc = acc + blk      # about per-object planning)
+        return acc[0, :k]
+
+    return reduce
+
+
+def make_fleet_pass(model, rung: str, mesh=None):
+    """Compile the whole-fleet pass for a rung.
+
+    Without a mesh: the single-device reference program over flat
+    ``[G, E]`` grids + global-seg rows.  With a mesh: the shard_mapped
+    program over flat ``[S*Gs, E]`` grids + local-seg ``[S*Ns]`` rows,
+    one shard slice per 'data'-axis device, hierarchical stats reduce.
+    """
+    import jax
+
+    if rung == RUNG_TPU:
+        from ..ops.pallas_weights import plan_weights_pallas as quantize
+    else:
+        quantize = plan_weights
+    block = partial(_device_plan_block, model.score_rows, quantize)
+
+    if mesh is None:
+        return jax.jit(block)
+
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape["data"]
+    use_ring = (rung == RUNG_TPU
+                and registry.supports("async_remote_copy"))
+    ring = _make_stats_ring(n, "data") if use_ring else None
+    row = P("data")
+    grid = P("data", None)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(), grid, row, row, grid, grid, grid, grid,
+                       row, row, row),
+             out_specs=(grid, grid, grid, grid, P()))
+    def _device_fleet_shard(params, rows, seg, slot, desired, observed,
+                            observed_w, cached_w, rescored, mode,
+                            spec_w):
+        desired_w, to_add, to_remove, to_reweight, stats = block(
+            params, rows, seg, slot, desired, observed, observed_w,
+            cached_w, rescored, mode, spec_w)
+        # hierarchical compose (HiCCL): collapse the 'model' axis
+        # replica group first (cheap domain), then cross-shard
+        if "model" in mesh.axis_names:
+            stats = jax.lax.pmean(stats, "model")
+        if ring is not None:
+            stats = ring(stats)
+        else:
+            stats = jax.lax.psum(stats, "data")
+        return desired_w, to_add, to_remove, to_reweight, stats
+
+    return jax.jit(_device_fleet_shard)
+
+
+@dataclass
+class FleetPlanResult:
+    """Whole-fleet plan outputs (numpy, shard-major ``[S, Gs, E]``)."""
+
+    fleet: ColumnarFleet
+    rung: str
+    layout: str                       # "sharded" | "flat"
+    desired_w: np.ndarray
+    to_add: np.ndarray
+    to_remove: np.ndarray
+    to_reweight: np.ndarray
+    stats: Dict[str, float]
+
+    def intents(self) -> List[GroupIntent]:
+        return decode_intents(self.fleet, self.desired_w, self.to_add,
+                              self.to_remove, self.to_reweight)
+
+
+class WholeFleetPlanner:
+    """Host wrapper: packed fleets in, decoded mutation intents out.
+
+    Owns the per-(rung, layout) compiled programs and the mesh; pure
+    over its inputs — the fingerprint/weight caches that make waves
+    incremental live with the caller (controller/fleetsweep.py), the
+    planner itself never reaches the provider (rule L113).
+    """
+
+    def __init__(self, model=None, params=None, seed: int = 0):
+        import jax
+
+        from ..models.traffic import TrafficPolicyModel
+
+        self.model = model or TrafficPolicyModel()
+        self.params = (params if params is not None
+                       else self.model.init_params(
+                           jax.random.PRNGKey(seed)))
+        self._fns: Dict[Tuple[str, Optional[int]], object] = {}
+        self._meshes: Dict[int, object] = {}
+
+    # -- dispatch ------------------------------------------------------
+
+    def plan_rung(self) -> str:
+        return registry.plan_rung()
+
+    def _mesh_for(self, shards: int):
+        """A ('data' = shards, 'model' = 1) mesh when the backend has
+        the devices for it; None -> flat single-device layout."""
+        import jax
+
+        if shards <= 1 or shards > len(jax.devices()):
+            return None
+        mesh = self._meshes.get(shards)
+        if mesh is None:
+            from .mesh import make_mesh
+
+            mesh = make_mesh(axis_shapes={"data": shards, "model": 1})
+            self._meshes[shards] = mesh
+        return mesh
+
+    def _fn(self, rung: str, shards: Optional[int]):
+        key = (rung, shards)
+        fn = self._fns.get(key)
+        if fn is None:
+            mesh = self._mesh_for(shards) if shards else None
+            fn = make_fleet_pass(self.model, rung, mesh=mesh)
+            self._fns[key] = fn
+        return fn
+
+    # -- planning ------------------------------------------------------
+
+    def prepare(self, fleet: ColumnarFleet):
+        """Resolve the rung/layout and build the device program + its
+        argument arrays for ``fleet``.  Returns
+        ``(rung, layout, fn, rows, rest)`` with the pass invoked as
+        ``fn(params, rows, *rest)`` — shared by :meth:`plan` and the
+        bench leg so the program the bench times IS the one the
+        controller runs (never a drifting re-implementation)."""
+        import jax.numpy as jnp
+
+        rung = self.plan_rung()
+        sharded = (rung != RUNG_REFERENCE
+                   and self._mesh_for(fleet.shards) is not None)
+        if sharded:
+            rows = fleet.feat_rows.reshape(-1, fleet.feat_rows.shape[-1])
+            seg = fleet.row_seg.reshape(-1)
+            slot = fleet.row_slot.reshape(-1)
+        else:
+            rows, seg, slot = fleet.flat_rows()
+        desired, observed, observed_w, cached_w, mode, spec_w = \
+            fleet.flat_grids()
+        fn = self._fn(rung, fleet.shards if sharded else None)
+        rest = tuple(jnp.asarray(a) for a in (
+            seg, slot, desired, observed, observed_w, cached_w,
+            fleet.rescored.reshape(-1), mode, spec_w))
+        return (rung, "sharded" if sharded else "flat", fn,
+                jnp.asarray(rows), rest)
+
+    def plan(self, fleet: ColumnarFleet) -> FleetPlanResult:
+        """One whole-fleet pass on the best live rung."""
+        import jax
+
+        rung, layout, fn, rows, rest = self.prepare(fleet)
+        S, Gs, E = fleet.desired.shape
+        desired_w, to_add, to_remove, to_reweight, stats = fn(
+            self.params, rows, *rest)
+        (desired_w, to_add, to_remove, to_reweight, stats) = \
+            jax.device_get(
+                (desired_w, to_add, to_remove, to_reweight, stats))
+        shape = (S, Gs, E)
+        return FleetPlanResult(
+            fleet=fleet, rung=rung, layout=layout,
+            desired_w=np.asarray(desired_w).reshape(shape),
+            to_add=np.asarray(to_add).reshape(shape),
+            to_remove=np.asarray(to_remove).reshape(shape),
+            to_reweight=np.asarray(to_reweight).reshape(shape),
+            stats={
+                "adds": float(stats[STAT_ADDS]),
+                "removes": float(stats[STAT_REMOVES]),
+                "reweights": float(stats[STAT_REWEIGHTS]),
+                "live_endpoints": float(stats[STAT_LIVE]),
+                "rescored_groups": float(stats[STAT_RESCORED]),
+                "groups": float(fleet.total_groups),
+            })
+
+    def plan_groups(self, groups: Sequence[GroupState],
+                    endpoints_cap: int = 16,
+                    shards: int = 1) -> FleetPlanResult:
+        """Convenience: pack + plan in one call."""
+        fleet = pack_fleet(groups, endpoints_cap=endpoints_cap,
+                           shards=shards,
+                           feature_dim=self.model.feature_dim)
+        return self.plan(fleet)

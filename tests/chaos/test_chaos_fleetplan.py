@@ -1,0 +1,166 @@
+"""Chaos e2e: mid-plan shard handoff vs the whole-fleet planner.
+
+The window under test: a replica computes a columnar whole-fleet plan
+(parallel/fleet_plan.py), and BETWEEN the plan and the intent flush its
+shard lease is deposed (seal-before-release, the PR-8 handoff
+ordering).  The deposed owner's decoded intents are stale the moment
+the fence seals — flushing them through the sharded coalescer's submit
+surface must reject exactly the deposed shard's groups (zero stale
+writes reach the fake cloud) while surviving shards' intents land, and
+the successor owner replans the rejected groups and converges them
+EXACTLY ONCE (no duplicate group mutations across the handoff).
+"""
+import numpy as np
+import pytest
+
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
+    FakeCloudFactory,
+)
+from aws_global_accelerator_controller_tpu.parallel.fleet_plan import (
+    WholeFleetPlanner,
+)
+from aws_global_accelerator_controller_tpu.reconcile.columnar import (
+    GroupState,
+)
+from aws_global_accelerator_controller_tpu.sharding.hashmap import shard_of
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+    PortRange,
+)
+
+SHARDS = 4
+GROUPS = 12
+SEED = 1711
+
+
+def lb_arn(i):
+    return (f"arn:aws:elasticloadbalancing:us-east-1:1:loadbalancer/"
+            f"net/lb{i}/x")
+
+
+@pytest.fixture
+def world():
+    factory = FakeCloudFactory(num_shards=SHARDS)
+    provider = factory.global_provider()    # builds the coalescer
+    ga = factory.cloud.ga
+    acc = ga.create_accelerator("chaos", "IPV4", True, {})
+    listener = ga.create_listener(acc.accelerator_arn,
+                                  [PortRange(80, 80)], "TCP", "NONE")
+    groups = []
+    for i in range(GROUPS):
+        seed_lb = factory.cloud.elb.register_load_balancer(
+            f"seed{i}",
+            f"seed{i}-0123456789abcdef.elb.eu-west-1.amazonaws.com",
+            "eu-west-1")
+        eg = ga.create_endpoint_group(listener.listener_arn, "eu-west-1",
+                                      seed_lb.load_balancer_arn, False)
+        groups.append(eg.endpoint_group_arn)
+    factory.shards.set_managed()
+    for sid in range(SHARDS):
+        factory.shards.acquire(sid, token=1)
+    return factory, provider, groups
+
+
+def plan_intents(rng, indexed_arns):
+    """One columnar plan over (fleet index, group arn) pairs: every
+    group wants one new spec-weighted endpoint (a membership + weight
+    intent)."""
+    states = [
+        GroupState(
+            key=f"default/b{i}", group_arn=arn, desired=[lb_arn(i)],
+            observed=[], spec_weight=int(rng.integers(1, 256)),
+            model_planned=False, shard=shard_of(arn, SHARDS))
+        for i, arn in indexed_arns]
+    planner = WholeFleetPlanner()
+    result = planner.plan_groups(states, endpoints_cap=8,
+                                 shards=SHARDS)
+    return [i for i in result.intents() if i.ops]
+
+
+def test_mid_plan_handoff_rejects_stale_intents_exactly_once(world):
+    factory, provider, group_arns = world
+    rng = np.random.default_rng(SEED)
+    ga = factory.cloud.ga
+
+    mutations = {}          # group arn -> update_endpoint_group calls
+    real_update = ga.update_endpoint_group
+
+    def counting_update(arn, *a, **kw):
+        mutations[arn] = mutations.get(arn, 0) + 1
+        return real_update(arn, *a, **kw)
+
+    ga.update_endpoint_group = counting_update
+
+    intents = plan_intents(rng, list(enumerate(group_arns)))
+    assert len(intents) == GROUPS
+
+    # -- the chaos window: depose one shard between plan and flush,
+    # seal strictly before release (the handoff ordering)
+    deposed = shard_of(group_arns[0], SHARDS)
+    factory.shards.fence(deposed).seal("lease lost mid-plan")
+    factory.shards.release(deposed)
+    deposed_groups = {i.group_arn for i in intents
+                      if shard_of(i.group_arn, SHARDS) == deposed}
+    assert deposed_groups, "chaos must actually hit a planned group"
+
+    applied, rejected = provider.coalescer.submit_plan(intents)
+
+    # every deposed-shard group rejected, everything else landed
+    assert set(rejected) == deposed_groups
+    assert set(applied) == set(group_arns) - deposed_groups
+    # ZERO stale writes: no deposed group saw a mutation call, and its
+    # live state still shows only the seed endpoint
+    for arn in deposed_groups:
+        assert arn not in mutations, "stale fenced intent reached AWS"
+        descs = ga.describe_endpoint_group(arn).endpoint_descriptions
+        assert len(descs) == 1 and "seed" in descs[0].endpoint_id
+    # survivors converged exactly once
+    for arn in set(applied):
+        assert mutations[arn] == 1
+
+    # -- successor: re-acquire with the next fencing token, REPLAN the
+    # rejected groups (a deposed plan is never replayed), flush
+    factory.shards.acquire(deposed, token=2)
+    replay = plan_intents(rng, [(i, arn) for i, arn in enumerate(group_arns)
+                            if arn in deposed_groups])
+    applied2, rejected2 = provider.coalescer.submit_plan(replay)
+    assert rejected2 == {}
+    assert set(applied2) == deposed_groups
+
+    # exactly-once fleet-wide: every group mutated once, all converged
+    assert mutations == {arn: 1 for arn in group_arns}
+    for i, arn in enumerate(group_arns):
+        ids = {d.endpoint_id for d in
+               ga.describe_endpoint_group(arn).endpoint_descriptions}
+        assert lb_arn(i) in ids
+
+
+def test_replanned_intents_reflect_successor_view(world):
+    """The successor's replan is a FRESH columnar pass over live
+    state: groups the first flush already converged plan to empty
+    intent sets (read-only), so a replay-happy successor cannot
+    double-write them."""
+    factory, provider, group_arns = world
+    rng = np.random.default_rng(SEED + 1)
+    intents = plan_intents(rng, list(enumerate(group_arns)))
+    applied, rejected = provider.coalescer.submit_plan(intents)
+    assert rejected == {} and len(applied) == GROUPS
+
+    # successor replans the SAME fleet: desired now matches observed
+    # (membership authority; weights have no target in this pass)
+    ga = factory.cloud.ga
+    states = []
+    for i, arn in enumerate(group_arns):
+        group = ga.describe_endpoint_group(arn)
+        observed = [d.endpoint_id for d in group.endpoint_descriptions]
+        observed_w = [d.weight for d in group.endpoint_descriptions]
+        states.append(GroupState(
+            key=f"default/b{i}", group_arn=arn,
+            desired=observed, observed=observed,
+            observed_weights=observed_w,
+            model_planned=False, shard=shard_of(arn, SHARDS)))
+    planner = WholeFleetPlanner()
+    result = planner.plan_groups(states, endpoints_cap=8,
+                                 shards=SHARDS)
+    assert all(not i.ops for i in result.intents())
+    assert result.stats["adds"] == 0.0
+    assert result.stats["reweights"] == 0.0

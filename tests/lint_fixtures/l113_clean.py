@@ -1,0 +1,26 @@
+"""Fixture: L113-clean planner shapes — host-side pack/decode loops
+are legal, device programs are pure array ops, no apis reach."""
+
+
+def pack_fleet(groups, cap):
+    # host-side packing loop: NOT a device program, loops are its job
+    rows = []
+    for g in groups:
+        for j, endpoint in enumerate(g):
+            rows.append((j, endpoint))
+    return rows
+
+
+def _device_plan_block(score_rows, desired, observed):
+    s = score_rows(desired)
+    grid = s + desired
+    mask = desired != -1
+    return grid, mask, observed
+
+
+def decode_intents(fleet, to_add):
+    out = []
+    for g in fleet:
+        if to_add[g]:
+            out.append(g)
+    return out

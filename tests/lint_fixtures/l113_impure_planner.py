@@ -1,0 +1,39 @@
+"""Fixture: rule L113 violations — the columnar planner reaching the
+provider and looping Python over fleet keys inside device programs."""
+
+
+def pack_and_peek(self, keys):
+    # module is planner-scoped (l113_*): ANY apis reach fires, even
+    # from a host-side helper — provider state is the caller's job
+    for key in keys:
+        self.apis.ga.describe_endpoint_group(key)          # line 9: L113
+
+
+def _device_plan_block(desired, observed):
+    out = []
+    for row in desired:                                    # line 14: L113
+        out.append(row)
+    while observed:                                        # line 16: L113
+        observed = observed[:-1]
+    return out
+
+
+def jitted_pass(desired):
+    import functools
+
+    def deco(f):
+        return f
+
+    jit = deco
+
+    @jit
+    def inner(grid):
+        for row in grid:                                   # line 31: L113
+            _ = row
+        return grid
+
+    return inner(desired)
+
+
+def waived_probe(self, key):
+    self.apis.ga.describe_endpoint_group(key)  # race: drift probe fixture

@@ -63,6 +63,56 @@ def test_bench_planner_cpu_smoke():
     assert r["plan_ms"] > 0
 
 
+def test_bench_fleet_plan_cpu_smoke(monkeypatch, tmp_path):
+    """Small-shape fleet-plan leg: runs on the live rung, reports the
+    fleet shape honestly, and the tagged history entry lands with
+    rung + backend + EG/s stamped."""
+    hist = tmp_path / "history.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(hist))
+    r = bench.bench_fleet_plan(groups=48, endpoints_cap=8, shards=2,
+                               n=2, record=True)
+    assert r["backend"] == "cpu"
+    assert r["rung"] in ("pallas-tpu", "pallas-interpret",
+                         "jnp-reference")
+    assert r["egs_per_s"] > 0
+    assert r["scalar_egs_per_s"] > 0
+    assert 1.0 <= r["mean_occupancy"] <= r["endpoints_cap"]
+    entry = json.loads(hist.read_text().strip())
+    assert entry["bench"] == "fleet-plan"
+    assert entry["rung"] == r["rung"]
+    assert entry["backend"] == "cpu"
+    assert entry["egs_per_s"] == r["egs_per_s"]
+    # a floor derivation never reads fleet-plan entries (tag skip)
+    monkeypatch.delenv("RECONCILE_FLOOR_SVC_S", raising=False)
+    monkeypatch.setattr(bench.os, "getloadavg", lambda: (0.0, 0, 0))
+    assert bench.reconcile_floor(history_path=str(hist)) == 400.0
+
+
+def test_planner_subprocess_failure_names_rung(monkeypatch):
+    """A wedged planner bench must come back through the
+    compat-preflight verdict path naming the resolved rung and the
+    failed probes — not as the bare diag string (the PR-9 contract
+    this leg previously bypassed)."""
+    monkeypatch.setattr(bench, "_run_subprocess",
+                        lambda *a, **kw: (None, "planner bench "
+                                          "skipped: backend "
+                                          "unresponsive (> 1s)"))
+    monkeypatch.setattr(
+        bench, "bench_compat_preflight_subprocess",
+        lambda timeout=180.0: {"rung": "pallas-interpret",
+                               "failed_probes": ["pallas_tpu"]})
+    line = bench.bench_planner_subprocess()
+    assert "rung=pallas-interpret" in line
+    assert "failed probes: pallas_tpu" in line
+    fleet_line = bench.bench_fleet_plan_subprocess()
+    assert "rung=pallas-interpret" in fleet_line
+    # preflight ALSO wedged: the diag says so instead of pretending
+    monkeypatch.setattr(
+        bench, "bench_compat_preflight_subprocess",
+        lambda timeout=180.0: {"skipped": "unresponsive too"})
+    assert "preflight also wedged" in bench.bench_planner_subprocess()
+
+
 def test_bench_reconcile_converges_small_fleet():
     r = bench.bench_reconcile(n_services=8, workers=2)
     assert r["services"] == 8
@@ -285,7 +335,12 @@ def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
             {"throughput": 420.0, "bench": "shard-scaling"},
             {"throughput": 110.0, "bench": "shard-scaling"},
             {"throughput": 55.0, "bench": "rollout-ramp"},
-            {"throughput": 60.0, "bench": "rollout-ramp"})))
+            {"throughput": 60.0, "bench": "rollout-ramp"},
+            # the fleet-plan leg has no "throughput" at all (EG/s, a
+            # different unit entirely) — the tag skip must drop it
+            # before the floor derivation ever reads fields
+            {"egs_per_s": 190000.0, "rung": "pallas-interpret",
+             "bench": "fleet-plan"})))
     monkeypatch.delenv("RECONCILE_FLOOR_SVC_S", raising=False)
     monkeypatch.setattr(bench.os, "getloadavg", lambda: (0.0, 0, 0))
     got = bench.reconcile_floor(history_path=str(hist))
@@ -361,8 +416,13 @@ def _main_json(monkeypatch, capsys, tmp_path, status, detail):
     monkeypatch.setattr(
         bench, "bench_planner_subprocess",
         lambda **kw: (planner_calls.append(kw), "planner line")[1])
+    fleet_plan_calls = []
+    monkeypatch.setattr(
+        bench, "bench_fleet_plan_subprocess",
+        lambda **kw: (fleet_plan_calls.append(kw), "fleet line")[1])
     ran = {"flash": 0, "flash_long": 0, "flash_xl": 0, "temporal": 0,
-           "smoke": 0, "planner_calls": planner_calls}
+           "smoke": 0, "planner_calls": planner_calls,
+           "fleet_plan_calls": fleet_plan_calls}
 
     def stub(name):
         def run(**kw):
@@ -406,6 +466,7 @@ def test_main_contract_healthy_tpu(monkeypatch, capsys, tmp_path):
     assert ran["flash"] == ran["flash_long"] == ran["temporal"] == 1
     assert ran["flash_xl"] == ran["smoke"] == 1
     assert ran["planner_calls"] == [{}]  # no cpu pin on a healthy tpu
+    assert ran["fleet_plan_calls"] == [{}]
 
 
 def test_main_contract_dead_backend_still_one_line(monkeypatch, capsys,
@@ -421,8 +482,9 @@ def test_main_contract_dead_backend_still_one_line(monkeypatch, capsys,
         assert data[leg]["evidence"] in ("builder-claimed", "none")
     assert ran["flash"] == ran["flash_long"] == ran["temporal"] == 0
     assert ran["flash_xl"] == ran["smoke"] == 0
-    # the backend-agnostic planner must still run, pinned to cpu
+    # the backend-agnostic planner legs must still run, pinned to cpu
     assert ran["planner_calls"] == [{"force_cpu": True}]
+    assert ran["fleet_plan_calls"] == [{"force_cpu": True}]
 
 
 def test_main_contract_healthy_cpu_runs_live_degraded_legs(

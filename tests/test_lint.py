@@ -696,3 +696,75 @@ def test_l112_seeded_rollout_strip_from_route53_controller_caught(
                 and "process_service_create_or_update" in x.msg]
     assert findings, "a rollout-gate-less route53 service process " \
                      "func was not caught"
+
+
+def test_l113_impure_planner_fires_and_waiver_suppresses():
+    """Provider reach (line 9) and device-program Python loops
+    (14/16 in the ``_device_*`` shape, 31 through a ``jit``
+    decoration) fire; the host-side pack loop (line 8) does not, and
+    the ``# race:`` waiver suppresses line 39's deliberate probe."""
+    assert _cfindings("l113_impure_planner.py") == [
+        ("L113", 9), ("L113", 14), ("L113", 16), ("L113", 31)]
+
+
+def test_l113_clean_planner_shapes_pass():
+    """Host-side pack/decode loops and pure-array device programs are
+    the supported shapes — zero findings."""
+    assert _cfindings("l113_clean.py") == []
+
+
+def test_l113_shipped_planner_modules_clean():
+    """The shipped columnar planner stays clean under its own rule."""
+    files = [pathlib.Path(ROOT_DIR) / p for p in (
+        "aws_global_accelerator_controller_tpu/parallel/fleet_plan.py",
+        "aws_global_accelerator_controller_tpu/reconcile/columnar.py")]
+    assert [x for x in concurrency_lint.lint_files(files)
+            if x.code == "L113"] == []
+
+
+def test_l113_seeded_loop_graft_into_shipped_planner_caught(tmp_path):
+    """Acceptance probe tied to the shipped code shape: graft a
+    per-row Python loop back into the REAL device program
+    (``_device_plan_block``) and the gate must fire — that loop is
+    exactly the object-at-a-time planning the columnar pass deleted."""
+    plan_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/parallel/fleet_plan.py")
+    src = plan_py.read_text()
+    needle = "    s = score_rows(params, rows)"
+    assert src.count(needle) == 1, \
+        "device program scoring shape changed; update this probe"
+    mutated = src.replace(
+        needle,
+        "    for _row in rows:\n        pass\n" + needle, 1)
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "parallel")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "fleet_plan.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L113" and "loop" in x.msg]
+    assert findings, "a grafted Python loop in the shipped device " \
+                     "program was not caught"
+
+
+def test_l113_seeded_apis_graft_into_packing_caught(tmp_path):
+    """The other half: graft a provider describe into the REAL packing
+    layer (``pack_fleet``) and the purity gate must fire."""
+    col_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/reconcile/columnar.py")
+    src = col_py.read_text()
+    needle = "    table = InternTable()\n"
+    assert src.count(needle) == 1, \
+        "pack_fleet intern-table shape changed; update this probe"
+    mutated = src.replace(
+        needle,
+        needle + "    apis.ga.describe_endpoint_group(groups[0])\n", 1)
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "reconcile")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "columnar.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L113" and "provider call" in x.msg]
+    assert findings, "a grafted apis reach in the shipped packing " \
+                     "layer was not caught"
