@@ -282,6 +282,17 @@ def _consults_shard(fn: ast.AST) -> bool:
     return False
 
 
+def _consults_trace(fn: ast.AST) -> bool:
+    """Does this function capture the ambient trace context (the
+    coalescer submit's L114 runtime gate)?"""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] == "ambient_context":
+                return True
+    return False
+
+
 def _l105_in_scope(path: Path) -> bool:
     """L105 covers the shipped package (where every AWS call must ride
     the resilient wrapper) and the lint fixtures (the rule's own test
@@ -305,7 +316,11 @@ def _l109_in_scope(path: Path) -> bool:
 
 
 # The enqueue surface rule L109 requires a ``klass=`` keyword on, when
-# the receiver chain names a queue.
+# the receiver chain names a queue.  Rule L114 requires a ``ctx=`` on
+# the same surface: a workqueue item constructed without its
+# TraceContext severs the event→converged trace at the hand-off
+# (tracing.py; an explicit ``ctx=None`` is the supported spelling for
+# a genuinely untraced path — the explicitness is the contract).
 _ENQUEUE_METHODS = {"add", "add_rate_limited", "add_after"}
 
 
@@ -589,6 +604,7 @@ class Engine:
         self._check_ordering_graph()
         self._check_wrapper_fence_gate()
         self._check_sharded_submit_gate()
+        self._check_coalescer_trace_gate()
         self._check_rollout_gate()
         suppressed = [f for f in self.findings
                       if not self._finding_waived(f)]
@@ -695,6 +711,32 @@ class Engine:
                     "tree relies on this gate to keep one writer per "
                     "endpoint group / hosted zone "
                     "(sharding/shardset.py ShardSet.check)"))
+
+    def _check_coalescer_trace_gate(self) -> None:
+        """L114's other half: coalescer intents get their trace from
+        the AMBIENT attach (tracing.ambient_context) captured on the
+        submit path, not from per-call plumbing — so whenever
+        batcher.py is part of the linted set, ``MutationCoalescer's``
+        submit must lexically carry that capture (the seeded-mutation
+        probe strips it and asserts this fires).  A fixture subset
+        without batcher.py trusts the shipped one."""
+        for info in self.files:
+            if info.path.name != "batcher.py" \
+                    or not _l105_in_scope(info.path):
+                continue
+            submits = [fn for cls, fn in self._functions(info.tree)
+                       if cls == "MutationCoalescer"
+                       and fn.name == "_submit"]
+            if not submits:
+                continue
+            if not any(_consults_trace(fn) for fn in submits):
+                self.findings.append(Finding(
+                    info.path, submits[0].lineno, "L114",
+                    "MutationCoalescer._submit no longer captures the "
+                    "ambient trace context: every coalesced mutation "
+                    "in the tree relies on this capture to carry its "
+                    "submitter's trace across the flush boundary "
+                    "(tracing.ambient_context)"))
 
     def _check_rollout_gate(self) -> None:
         """L112's other half: the two weight-bearing controllers'
@@ -972,6 +1014,23 @@ class Engine:
                 f"resync/sweep re-deliveries, CLASS_KEEP for "
                 f"requeues) so the key rides the right workqueue "
                 f"tier (kube/workqueue.py), or waive with "
+                f"'# race: <reason>'"))
+        # L114: an enqueue that names no trace context silently severs
+        # the event's trace at the queue boundary — the same
+        # controller/reconcile surface L109 polices must say whose
+        # trace the item carries (or explicitly ctx=None).
+        if (len(chain) >= 2 and chain[-1] in _ENQUEUE_METHODS
+                and any("queue" in seg for seg in chain[:-1])
+                and _l109_in_scope(info.path)
+                and not any(kw.arg == "ctx" for kw in call.keywords)):
+            self.findings.append(Finding(
+                info.path, line, "L114",
+                f"trace-dropping enqueue '{'.'.join(chain)}()': pass "
+                f"ctx= (the event's TraceContext from "
+                f"tracing.new_context / the dispatch's claimed_trace, "
+                f"or an explicit ctx=None for a genuinely untraced "
+                f"path) so the item carries its trace across the "
+                f"queue/thread boundary (tracing.py), or waive with "
                 f"'# race: <reason>'"))
         # L102: blocking while any lock is held.
         if held and self._is_blocking(chain, held):

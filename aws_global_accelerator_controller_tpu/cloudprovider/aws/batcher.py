@@ -86,6 +86,7 @@ from ...metrics import (
 )
 from ...reconcile.fingerprint import note_provider_mutation
 from ...reconcile.traffic import CLASS_INTERACTIVE, current_class
+from ...tracing import ambient_context, default_tracer, fold_link
 from .types import EndpointDescription
 
 logger = logging.getLogger(__name__)
@@ -172,15 +173,19 @@ class _Future:
     submitted intent — the success result is derived from it, so a
     waiter whose op was folded into another's (even a ``replace``
     absorbing a ``set``) still gets its own answer (the endpoint id it
-    submitted), not the absorber's."""
+    submitted), not the absorber's.  ``ctx`` is the submitting sync's
+    trace context (tracing.py, captured from the ambient attach at
+    submit): the intent carries its trace across the flush-thread
+    boundary, and the flush stamps its span id + stage hops back."""
 
-    __slots__ = ("event", "result", "exc", "payload")
+    __slots__ = ("event", "result", "exc", "payload", "ctx")
 
-    def __init__(self, payload=None):
+    def __init__(self, payload=None, ctx=None):
         self.event = threading.Event()
         self.result = None
         self.exc: Optional[BaseException] = None
         self.payload = payload
+        self.ctx = ctx
 
     def complete(self) -> None:
         self.result = _op_result(self.payload)
@@ -199,6 +204,18 @@ class _Intent:
         self.futures = [future]
 
 
+def _note_fold(it: "_Intent", future: _Future) -> None:
+    """A fold superseded a pending intent with ``future``'s: emit the
+    ``fold`` link span (tracing.py) so the surviving trace names every
+    contributing trace id.  The intent's FIRST future's context stands
+    for the absorbed cohort (later waiters already linked through it
+    when they folded in — links are transitive through the survivor).
+    O(1) per fold."""
+    if future.ctx is None or not it.futures:
+        return
+    fold_link(future.ctx, it.futures[0].ctx)
+
+
 def _fold_record(group: "_Group", action, record_set,
                  future: _Future) -> int:
     """Last-writer-wins per record identity — (name, type) plus the
@@ -211,6 +228,7 @@ def _fold_record(group: "_Group", action, record_set,
     key = record_set.identity()
     it = group.index.get(key)
     if it is not None:
+        _note_fold(it, future)
         it.payload = (action, record_set)
         it.futures.append(future)
         return 1
@@ -233,6 +251,8 @@ def _fold_endpoint_op(group: "_Group", op: EndpointOp,
     remove-then-append-weight-only)."""
     if op.kind == "replace":
         folded = len(group.pending)
+        for absorbed in group.pending:
+            _note_fold(absorbed, future)
         intent = _Intent(op, future)
         intent.futures = [f for it in group.pending
                           for f in it.futures] + intent.futures
@@ -244,10 +264,12 @@ def _fold_endpoint_op(group: "_Group", op: EndpointOp,
     if it is not None:
         p = it.payload
         if op.kind in ("set", "remove") or p.kind == op.kind:
+            _note_fold(it, future)
             it.payload = op
             it.futures.append(future)
             return 1
         if op.kind == "weight" and p.kind == "set":
+            _note_fold(it, future)
             it.payload = replace(p, weight=op.weight)
             it.futures.append(future)
             return 1
@@ -288,6 +310,20 @@ def _op_result(op) -> Optional[str]:
     if isinstance(op, EndpointOp):
         return op.endpoint_id or None
     return None
+
+
+def _intent_ctxs(intents) -> list:
+    """Distinct trace contexts riding a cohort (order-stable: the
+    first is the flush span's attach anchor, the rest ride as span
+    links)."""
+    out = []
+    seen = set()
+    for it in intents:
+        for f in it.futures:
+            if f.ctx is not None and id(f.ctx) not in seen:
+                seen.add(id(f.ctx))
+                out.append(f.ctx)
+    return out
 
 
 class _Group:
@@ -410,7 +446,14 @@ class MutationCoalescer:
         # exists, so "every waiter completes exactly once" stays true
         if self._fence is not None:
             self._fence.check("coalescer")
-        futures = [_Future(payload) for payload in payloads]
+        # the submitting sync's trace context (tracing.py, L114's
+        # runtime gate): every intent carries it across the flush
+        # boundary; "planned" marks the sync's planning work done —
+        # time from here to the flush drain is the coalescer's linger
+        ctx = ambient_context()
+        if ctx is not None:
+            ctx.hop("planned")
+        futures = [_Future(payload, ctx) for payload in payloads]
         record_mutation_enqueued(kind, len(payloads))
         if not self.config.enabled:
             group = self._group(kind, key)
@@ -505,6 +548,10 @@ class MutationCoalescer:
             group.last_drain_size = len(intents)
             group.leader = False   # mid-flush arrivals elect the next one
             group.flushing = True
+        # the drain ends every member trace's "coalesced" stage: from
+        # here the cohort is on the wire (tracing.py ledger)
+        for c in _intent_ctxs(intents):
+            c.hop("inflight")
         # the flush-pass permit lets this cohort complete through a
         # TRIPPED (draining) fence; a SEALED fence still rejects at
         # the wrapper and the cohort fails fast with FencedError.  The
@@ -619,41 +666,67 @@ class MutationCoalescer:
     def _flush_record_chunk(self, zone_id: str,
                             intents: List[_Intent]) -> None:
         changes = [it.payload for it in intents]
-        try:
-            record_mutation_flush(KIND_RECORD_SET)
-            self.apis.route53.change_resource_record_sets_batch(
-                zone_id, changes)
-        except Exception as e:
-            self._demux_failure(
-                KIND_RECORD_SET, intents, e,
-                lambda half: self._flush_record_chunk(zone_id, half))
-            return
+        ctxs = _intent_ctxs(intents)
+        # the flush span joins the first member's trace and LINKS the
+        # rest (a cohort serves many traces; one span cannot have many
+        # trace ids, so links carry the cross-trace membership —
+        # tracing.py module docstring)
+        with default_tracer.attach(ctxs[0] if ctxs else None), \
+                default_tracer.span("flush", kind=KIND_RECORD_SET,
+                                    group=zone_id,
+                                    cohort=len(intents)) as fs:
+            fs.links = tuple(sorted({c.trace_id for c in ctxs}))
+            try:
+                record_mutation_flush(KIND_RECORD_SET)
+                self.apis.route53.change_resource_record_sets_batch(
+                    zone_id, changes)
+            except Exception as e:
+                fs.error = f"{type(e).__name__}: {e}"
+                self._demux_failure(
+                    KIND_RECORD_SET, intents, e,
+                    lambda half: self._flush_record_chunk(zone_id, half))
+                return
+            for c in ctxs:
+                c.mark(fs.span_id, "flush")
+                c.hop("flushed")
         for it in intents:
             for future in it.futures:
                 future.complete()
 
     def _flush_endpoint_group(self, arn: str,
                               intents: List[_Intent]) -> None:
-        try:
-            current = self.apis.ga.describe_endpoint_group(arn)
-        except Exception as e:
-            # the READ failed: nothing is attributable to one intent —
-            # every waiter gets the describe's own verdict (a hint
-            # parks it, a NotFound is a real answer for all)
-            for it in intents:
-                for future in it.futures:
-                    future.fail(e)
-            return
-        configs = _apply_ops(current.endpoint_descriptions,
-                             [it.payload for it in intents])
-        try:
-            record_mutation_flush(KIND_ENDPOINT_GROUP)
-            self.apis.ga.update_endpoint_group(arn, configs)
-        except Exception as e:
-            self._demux_failure(
-                KIND_ENDPOINT_GROUP, intents, e,
-                lambda half: self._flush_endpoint_group(arn, half))
-            return
+        ctxs = _intent_ctxs(intents)
+        with default_tracer.attach(ctxs[0] if ctxs else None), \
+                default_tracer.span("flush", kind=KIND_ENDPOINT_GROUP,
+                                    group=arn,
+                                    cohort=len(intents)) as fs:
+            fs.links = tuple(sorted({c.trace_id for c in ctxs}))
+            try:
+                current = self.apis.ga.describe_endpoint_group(arn)
+            except Exception as e:
+                # the READ failed: nothing is attributable to one
+                # intent — every waiter gets the describe's own
+                # verdict (a hint parks it, a NotFound is a real
+                # answer for all)
+                fs.error = f"{type(e).__name__}: {e}"
+                for it in intents:
+                    for future in it.futures:
+                        future.fail(e)
+                return
+            configs = _apply_ops(current.endpoint_descriptions,
+                                 [it.payload for it in intents])
+            try:
+                record_mutation_flush(KIND_ENDPOINT_GROUP)
+                self.apis.ga.update_endpoint_group(arn, configs)
+            except Exception as e:
+                fs.error = f"{type(e).__name__}: {e}"
+                self._demux_failure(
+                    KIND_ENDPOINT_GROUP, intents, e,
+                    lambda half: self._flush_endpoint_group(arn, half))
+                return
+            for c in ctxs:
+                c.mark(fs.span_id, "flush")
+                c.hop("flushed")
         for it in intents:
             for future in it.futures:
                 future.complete()
@@ -693,6 +766,9 @@ class MutationCoalescer:
         ``change_resource_record_sets`` per record change, AddEndpoints
         / RemoveEndpoints / per-op read-modify-write for endpoint
         groups.  Only reachable with ``enabled=False``."""
+        ctx = future.ctx
+        if ctx is not None:
+            ctx.hop("inflight")
         try:
             if group.kind == KIND_RECORD_SET:
                 action, record_set = future.payload
@@ -701,6 +777,8 @@ class MutationCoalescer:
                     group.key, action, record_set)
             else:
                 self._direct_endpoint(group.key, future.payload)
+            if ctx is not None:
+                ctx.hop("flushed")
             future.complete()
         except Exception as e:
             future.fail(e)

@@ -27,6 +27,7 @@ import random
 import threading
 import time
 import zlib
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -129,6 +130,11 @@ class FaultInjector:
         # (edit_endpoint_group / edit_record_set)
         self._ga: Optional["FakeGlobalAccelerator"] = None
         self._route53: Optional["FakeRoute53"] = None
+        # bounded decision log: every injected fault, in order — the
+        # flight recorder (flight.py) freezes this next to the span
+        # ring so a dump correlates "what went wrong" with "what the
+        # chaos engine did" (deque append is O(1), memory bounded)
+        self._decisions: deque = deque(maxlen=4096)
 
     # -- original one-shot API (unchanged surface) ----------------------
 
@@ -260,6 +266,14 @@ class FaultInjector:
         with self._lock:
             return dict(self._calls)
 
+    def decision_log(self) -> List[dict]:
+        """The bounded, ordered log of every injected fault (method,
+        per-method call index, fault source, error code, injector
+        clock) — what the flight recorder freezes alongside the span
+        ring (flight.py add_chaos_source)."""
+        with self._lock:
+            return list(self._decisions)
+
     # -- the per-call hook ----------------------------------------------
 
     def _decide(self, method: str, index: int, rate: float,
@@ -293,9 +307,11 @@ class FaultInjector:
             delay = self._latency.get(method,
                                       self._latency.get("*", 0.0))
             exc: Optional[Exception] = None
+            source = ""
             pending = self._faults.get(method)
             if pending:
                 exc = pending.pop(0)
+                source = "one_shot"
             if exc is None and zone is not None \
                     and self._zone_rate is not None:
                 rate, burst = self._zone_rate
@@ -309,6 +325,7 @@ class FaultInjector:
                         "ThrottlingException",
                         f"chaos: per-zone rate limit on {zone}",
                         retryable=True)
+                    source = "zone_throttle"
                 self._zone_buckets[zone] = (tokens, now)
             if exc is None and self._windows:
                 now = self._clock()
@@ -323,6 +340,7 @@ class FaultInjector:
                             method, index, w.rate,
                             salt=f"{w.kind}:{w.start}"):
                         exc = w.make_exc()
+                        source = w.kind
                         break
             if exc is None:
                 hit = self._error_rates.get(method) \
@@ -331,12 +349,27 @@ class FaultInjector:
                         self._decide(method, index, hit[0],
                                      salt="rate"):
                     exc = hit[1]()
+                    source = "rate"
             if exc is not None:
                 self._injected[method] = \
                     self._injected.get(method, 0) + 1
+                self._decisions.append({
+                    "t": round(self._clock(), 6),
+                    "method": method,
+                    "index": index,
+                    "source": source,
+                    "code": getattr(exc, "code", type(exc).__name__),
+                })
         if delay > 0.0:
             time.sleep(delay)
         if exc is not None:
+            # stamp the injection into the current span / attached
+            # trace context (tracing.py): the trace that rode this
+            # call records exactly which chaos decision hit it
+            from ...tracing import note_chaos
+
+            note_chaos(method, getattr(exc, "code",
+                                       type(exc).__name__))
             raise exc
 
 

@@ -328,6 +328,13 @@ def run_controller(args) -> int:
         health = HealthServer(port=args.health_port)
         health.start_background()
 
+    # arm the chaos flight recorder for the process's life (flight.py):
+    # baselines the metrics delta and enables the runtime triggers
+    # (circuit open, rollout rollback, overload shed) — the operator's
+    # black box for "what led up to this" (docs/operations.md)
+    from .. import flight
+    flight.default_recorder.arm()
+
     def run_manager(leader_stop):
         handle = Manager().run(kube, operator, cloud_factory, config,
                                leader_stop, block=False)

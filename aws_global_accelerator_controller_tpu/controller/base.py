@@ -98,7 +98,7 @@ def resync_enqueue(fingerprints, queue, obj, wave: int) -> "str | None":
     this one would have.  Interactive work never sheds, and a key a
     real watch event claimed (pending EVENT origin) rides through
     untouched."""
-    from .. import metrics
+    from .. import metrics, tracing
     from ..reconcile.fingerprint import ORIGIN_RESYNC, ORIGIN_SWEEP
 
     key = obj.key()
@@ -117,11 +117,39 @@ def resync_enqueue(fingerprints, queue, obj, wave: int) -> "str | None":
             fingerprints.claim_origin(key)
             metrics.record_shed(fingerprints.controller, reason)
             return None
-    queue.add_rate_limited(key, klass=CLASS_BACKGROUND)
+    # a re-delivery that reaches the queue starts (or merges into) a
+    # trace at its origin stage — sweep waves are exactly the traffic
+    # whose stage attribution the convergence ledger explains.  No
+    # ring span for bulk origins: a fleet-wide wave must not evict
+    # the diagnostic span history (tracing.new_context docstring)
+    ctx = tracing.new_context(origin or "resync", key=key,
+                              controller=fingerprints.controller,
+                              record_span=False)
+    queue.add_rate_limited(key, klass=CLASS_BACKGROUND, ctx=ctx)
     # the origin that was actually ENQUEUED (None = answered/shed
     # above): callers batching sweep-tier work — the fleet-sweep
     # planner stages ORIGIN_SWEEP keys — key off this return
     return origin
+
+
+def event_enqueue(gate, fingerprints, queue, obj,
+                  origin: str = "event") -> None:
+    """One watch event's enqueue, shared by every controller handler:
+    mint the trace context at the event boundary (tracing.py — the
+    root of the event→converged trace), route it through the shard
+    gate (a deferred event keeps its trace for replay-on-acquire),
+    note the event for the fingerprint layer and enqueue interactive.
+    """
+    from .. import tracing
+
+    key = obj.key()
+    ctx = tracing.new_context(origin, key=key,
+                              queue=queue.name or "queue")
+    if gate is not None and not gate.admit(obj, ctx=ctx):
+        return
+    if fingerprints is not None:
+        fingerprints.note_event(key)
+    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE, ctx=ctx)
 
 
 class ShardGate:
@@ -153,12 +181,16 @@ class ShardGate:
         self.fingerprints = fingerprints
         self.route_key = route_key
         self._lock = locks.make_lock("shard-gate")
-        self._deferred: dict = {}       # shard id -> set of object keys
+        # shard id -> {object key: TraceContext or None}: a deferred
+        # event keeps its trace so the replay-on-acquire CONTINUES the
+        # original event's trace across the ownership gap (tracing.py)
+        self._deferred: dict = {}
 
-    def admit(self, obj) -> bool:
+    def admit(self, obj, ctx=None) -> bool:
         """True when this replica owns the object's route; otherwise
-        the key is deferred for replay-on-acquire and the handler must
-        return without enqueueing."""
+        the key (with its event's trace context) is deferred for
+        replay-on-acquire and the handler must return without
+        enqueueing."""
         try:
             rkey = self.route_key(obj)
         except Exception:
@@ -167,28 +199,53 @@ class ShardGate:
         if self.shards.owns(sid):
             return True
         with self._lock:
-            self._deferred.setdefault(sid, set()).add(obj.key())
+            pending = self._deferred.setdefault(sid, {})
+            have = pending.get(obj.key())
+            if have is not None and ctx is not None and have is not ctx:
+                # a later event superseding a deferred one: the
+                # survivor links the earlier trace (queue-dedup merge
+                # semantics, controller/base + kube/workqueue)
+                ctx.link(have.trace_id)
+                have.link(ctx.trace_id)
+            if ctx is not None or have is None:
+                pending[obj.key()] = ctx
         return False
 
-    def replay(self, sid: int, skip=()) -> int:
-        """Re-deliver the events deferred for ``sid`` (the acquire
-        listener calls this alongside its cache scan), interactive
-        class — these are real user-visible changes the gap
-        swallowed.  ``skip`` is the set of keys the cache scan is
-        already re-delivering (live, predicate-passing objects): only
-        the events the cache CANNOT reconstruct — deletes (object
-        gone) and demotions (predicate now false) — replay here, so a
-        rebalance after days of churn does not flood the interactive
-        tier with already-converged keys."""
+    def claim(self, sid: int) -> dict:
+        """Take (and clear) the events deferred for ``sid`` as
+        ``{object key: TraceContext-or-None}``.  The acquire listener
+        claims them BEFORE its cache scan so a live deferred key's
+        re-delivery CONTINUES the original event's trace instead of
+        minting a fresh one, then hands the remainder (deletes,
+        demotions — the events the cache cannot reconstruct) to
+        :meth:`replay`."""
         with self._lock:
-            keys = self._deferred.pop(sid, set())
+            return self._deferred.pop(sid, {})
+
+    def replay(self, sid: int, skip=(), entries=None) -> int:
+        """Re-deliver deferred events (the acquire listener calls this
+        alongside its cache scan), interactive class — these are real
+        user-visible changes the gap swallowed.  ``skip`` is the set
+        of keys the cache scan is already re-delivering (live,
+        predicate-passing objects): only the events the cache CANNOT
+        reconstruct — deletes (object gone) and demotions (predicate
+        now false) — replay here, so a rebalance after days of churn
+        does not flood the interactive tier with already-converged
+        keys.  ``entries`` replays an already-:meth:`claim`-ed dict
+        instead of claiming now."""
+        keys = self.claim(sid) if entries is None else entries
         replayed = 0
-        for key in keys:
+        for key, ctx in keys.items():
             if key in skip:
                 continue
             if self.fingerprints is not None:
                 self.fingerprints.note_event(key)
-            self.queue.add_rate_limited(key, klass=CLASS_INTERACTIVE)
+            if ctx is not None:
+                # the original event's trace survives the handoff: the
+                # hop names the boundary it just crossed
+                ctx.hop("shard-replay")
+            self.queue.add_rate_limited(key, klass=CLASS_INTERACTIVE,
+                                        ctx=ctx)
             replayed += 1
         return replayed
 
@@ -232,20 +289,42 @@ def wire_shard_listener(shards, informer, queue, fingerprints,
             if shards.shard_of(rkey) == sid:
                 keys.append((obj.key(), obj))
         if event == "acquired":
+            from .. import tracing
+
+            deferred = gate.claim(sid) if gate is not None else {}
             scanned = set()
             for key, obj in keys:
                 if predicate(obj):
                     scanned.add(key)
-                    klass = (CLASS_INTERACTIVE
-                             if interactive_pred is not None
-                             and interactive_pred(obj)
-                             else CLASS_BACKGROUND)
-                    queue.add_rate_limited(key, klass=klass)
+                    if key in deferred:
+                        # a real event arrived during the ownership
+                        # gap: its re-delivery rides interactive (it
+                        # is user-visible work, not re-adoption) and
+                        # CONTINUES the deferred trace when one rode
+                        # the event — membership in the deferred map,
+                        # NOT the context, decides the semantics, so
+                        # disabling tracing changes nothing about
+                        # scheduling (the set_enabled contract)
+                        ctx = deferred[key]
+                        if ctx is not None:
+                            ctx.hop("shard-replay")
+                        if fingerprints is not None:
+                            fingerprints.note_event(key)
+                        klass = CLASS_INTERACTIVE
+                    else:
+                        ctx = tracing.new_context("shard-acquire",
+                                                  key=key, shard=sid,
+                                                  record_span=False)
+                        klass = (CLASS_INTERACTIVE
+                                 if interactive_pred is not None
+                                 and interactive_pred(obj)
+                                 else CLASS_BACKGROUND)
+                    queue.add_rate_limited(key, klass=klass, ctx=ctx)
             if gate is not None:
                 # replay the events the cache scan above cannot
                 # reconstruct — deletes and demotions the ownership
                 # gap swallowed (ShardGate docstring)
-                gate.replay(sid, skip=scanned)
+                gate.replay(sid, skip=scanned, entries=deferred)
             return
         # lost: this replica's records for the shard prove nothing
         # once a successor writes — and its backlog is dead weight

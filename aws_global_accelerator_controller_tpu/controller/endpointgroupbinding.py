@@ -61,6 +61,7 @@ from ..rollout import (
 from .base import (
     WORKER_POLL,
     ShardGate,
+    event_enqueue,
     resync_enqueue,
     wire_shard_listener,
 )
@@ -220,7 +221,8 @@ class EndpointGroupBindingController:
             route=self._route,
             weight_policy=self.weight_policy,
             verify_every=config.fleet_sweep_verify_every,
-            enabled=config.fleet_sweep)
+            enabled=config.fleet_sweep,
+            queue=self.queue)
 
         # shard ownership (sharding/): a binding's container is the
         # endpoint group its SPEC names — routing by the ARN hash puts
@@ -250,10 +252,7 @@ class EndpointGroupBindingController:
         return obj.spec.endpoint_group_arn or obj.key()
 
     def _enqueue(self, obj) -> None:
-        if not self.gate.admit(obj):
-            return
-        self.fingerprints.note_event(obj.key())
-        self.queue.add_rate_limited(obj.key(), klass=CLASS_INTERACTIVE)
+        event_enqueue(self.gate, self.fingerprints, self.queue, obj)
 
     def _update_notification(self, old, new) -> None:
         # ARN changes are blocked by the webhook; backstop here
@@ -336,11 +335,8 @@ class EndpointGroupBindingController:
     def _notify_referent(self, index: str):
         def handler(obj) -> None:
             for binding in self.binding_informer.by_index(index, obj.key()):
-                if not self.gate.admit(binding):
-                    continue
-                self.fingerprints.note_event(binding.key())
-                self.queue.add_rate_limited(binding.key(),
-                                            klass=CLASS_INTERACTIVE)
+                event_enqueue(self.gate, self.fingerprints, self.queue,
+                              binding, origin="referent-event")
         return handler
 
     def _notify_referent_update(self, index: str):
@@ -418,14 +414,34 @@ class EndpointGroupBindingController:
                 else:
                     result = "error"
                     logger.exception("error syncing %r", key)
-                    self.queue.add_rate_limited(key, klass=CLASS_KEEP)
+                    ctx = self.queue.claimed_trace(key) \
+                        if hasattr(self.queue, "claimed_trace") else None
+                    if ctx is not None:
+                        ctx.hop("requeue")
+                    self.queue.add_rate_limited(key, klass=CLASS_KEEP,
+                                                ctx=ctx)
             finally:
                 self.queue.done(key)
                 metrics.record_sync(self.queue.name, result,
                                     time_mod.monotonic() - start)
 
     def _sync_handler(self, key: str) -> None:
-        """(controller.go:148-180)"""
+        """(controller.go:148-180): attach the delivery's trace
+        context (tracing.py — the coalescer submits, provider spans
+        and chaos marks beneath this sync join the event's trace) and
+        run the sync under a reconcile span."""
+        from ..tracing import default_tracer
+
+        ctx = self.queue.claimed_trace(key) \
+            if hasattr(self.queue, "claimed_trace") else None
+        if ctx is not None:
+            ctx.hop("claimed")
+        with default_tracer.attach(ctx), \
+                default_tracer.span("reconcile", queue=self.queue.name,
+                                    key=key):
+            self._sync_traced(key, ctx)
+
+    def _sync_traced(self, key: str, ctx) -> None:
         import time as time_mod
 
         from .. import metrics
@@ -480,12 +496,23 @@ class EndpointGroupBindingController:
                 VERDICT_DIVERGED,
                 VERDICT_WEIGHT_DRIFT,
             )
+            def close_trace():
+                # a fleet-answered sweep is a COMPLETED journey: the
+                # wave planned it, this dispatch converged it — the
+                # ledger gets its stage attribution like any sync
+                if ctx is not None:
+                    from ..tracing import default_ledger
+
+                    ctx.hop("converged")
+                    default_ledger.record(self.queue.name, key, ctx)
+
             verdict, entry = self.fleet_sweep.sweep_verdict(key,
                                                             binding)
             if verdict == VERDICT_CONVERGED:
                 metrics.record_fleet_sweep(self.queue.name, verdict)
                 self.fingerprints.clear_pending(key)
                 self.queue.forget(key)
+                close_trace()
                 return
             if verdict == VERDICT_WEIGHT_DRIFT:
                 with self.shards.guard(route), \
@@ -501,6 +528,7 @@ class EndpointGroupBindingController:
                     self.queue.forget(key)
                     self.fingerprints.record(key, binding)
                     self.fingerprints.clear_pending(key)
+                    close_trace()
                     return
                 # repair declined (a ramp appeared since planning /
                 # nothing left to write): this dispatch is a
@@ -525,9 +553,16 @@ class EndpointGroupBindingController:
         self.rollout.note_ok(key)
         if res.requeue_after > 0:
             self.queue.forget(key)
-            self.queue.add_after(key, res.requeue_after, klass=CLASS_KEEP)
+            # a rollout step wait keeps its trace: the whole ramp's
+            # multi-requeue journey reads as one trace id
+            if ctx is not None:
+                ctx.hop("requeue")
+            self.queue.add_after(key, res.requeue_after,
+                                 klass=CLASS_KEEP, ctx=ctx)
         elif res.requeue:
-            self.queue.add_rate_limited(key, klass=CLASS_KEEP)
+            if ctx is not None:
+                ctx.hop("requeue")
+            self.queue.add_rate_limited(key, klass=CLASS_KEEP, ctx=ctx)
         else:
             self.queue.forget(key)
             self.fingerprints.record(key, binding)
@@ -535,6 +570,11 @@ class EndpointGroupBindingController:
             metrics.record_reconcile_latency(
                 self.queue.name, klass,
                 time_mod.monotonic() - first_enqueued)
+            if ctx is not None:
+                from ..tracing import default_ledger
+
+                ctx.hop("converged")
+                default_ledger.record(self.queue.name, key, ctx)
 
     # -- reconcile (reconcile.go:20-34) ---------------------------------
 

@@ -90,7 +90,8 @@ class FleetSweepPlanner:
                  verify_every: int = 4,
                  wave_cap: int = 256,
                  cache_max: int = 131072,
-                 enabled: bool = True):
+                 enabled: bool = True,
+                 queue=None):
         from collections import OrderedDict
 
         self.controller = controller
@@ -108,6 +109,11 @@ class FleetSweepPlanner:
         self._fingerprint = fingerprint
         self._route = route
         self._weight_policy = weight_policy
+        #: the controller's workqueue (optional): lets the wave span
+        #: link the staged keys' pending trace contexts (tracing.py)
+        #: and stamp their "planned" hop when the columnar pass covers
+        #: them — fleet-plan wave membership carries the trace
+        self._queue = queue
         self._lock = locks.make_lock("fleet-sweep")
         self._staged: Set[str] = set()
         self._entries: Dict[str, _Entry] = {}
@@ -250,9 +256,31 @@ class FleetSweepPlanner:
                           binding.spec.weight is not None))
         if not states:
             return 0
-        result = planner.plan_groups(
-            states, endpoints_cap=self.endpoints_cap,
-            shards=num_shards)
+        # the wave span: one columnar pass serving many keys' traces —
+        # links carry the membership (tracing.py), each member context
+        # gets the span id marked.  No hop() here: a pending key may
+        # be claimed by a worker mid-pass and hop concurrently, and
+        # TraceContext.hop's monotone clamp is single-writer; the
+        # sweep dispatch's own claim→converged segment already
+        # attributes the planning work (mark append is a bounded
+        # single list.append, safe under the GIL)
+        from ..tracing import default_tracer
+
+        ctxs = []
+        if self._queue is not None \
+                and hasattr(self._queue, "pending_trace"):
+            ctxs = [c for c in (self._queue.pending_trace(key)
+                                for key, _, _, _ in metas)
+                    if c is not None]
+        with default_tracer.span("fleet_plan.wave",
+                                 controller=self.controller,
+                                 groups=len(states)) as ws:
+            ws.links = tuple(sorted({c.trace_id for c in ctxs}))
+            result = planner.plan_groups(
+                states, endpoints_cap=self.endpoints_cap,
+                shards=num_shards)
+        for c in ctxs:
+            c.mark(ws.span_id, "fleet_plan")
         # pack_fleet lays groups out shard-major, so intents come back
         # reordered — join on the key, never on input position
         by_key = {intent.key: intent for intent in result.intents()}

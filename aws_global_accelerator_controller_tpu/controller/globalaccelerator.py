@@ -43,7 +43,6 @@ from ..kube.client import KubeClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
 from ..kube.objects import Ingress, Service, split_meta_namespace_key
 from ..kube.workqueue import (
-    CLASS_INTERACTIVE,
     DEFAULT_AGE_WATERMARK,
     DEFAULT_AGING_HORIZON,
     DEFAULT_DEPTH_WATERMARK,
@@ -55,6 +54,7 @@ from .base import (
     LB_DNS_INDEX,
     ShardGate,
     annotation_presence_changed,
+    event_enqueue,
     index_by_lb_dns,
     resync_enqueue,
     run_controller,
@@ -203,11 +203,8 @@ class GlobalAcceleratorController:
 
     def _add_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc) and self._has_managed(svc):
-            if not self.service_gate.admit(svc):
-                return
-            self.service_fingerprints.note_event(svc.key())
-            self.service_queue.add_rate_limited(
-                svc.key(), klass=CLASS_INTERACTIVE)
+            event_enqueue(self.service_gate, self.service_fingerprints,
+                          self.service_queue, svc)
 
     def _update_service(self, old: Service, new: Service) -> None:
         if old == new:
@@ -215,19 +212,14 @@ class GlobalAcceleratorController:
         if was_load_balancer_service(new):
             if self._has_managed(new) or annotation_presence_changed(
                     old, new, AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION):
-                if not self.service_gate.admit(new):
-                    return
-                self.service_fingerprints.note_event(new.key())
-                self.service_queue.add_rate_limited(
-                    new.key(), klass=CLASS_INTERACTIVE)
+                event_enqueue(self.service_gate,
+                              self.service_fingerprints,
+                              self.service_queue, new)
 
     def _delete_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc):
-            if not self.service_gate.admit(svc):
-                return
-            self.service_fingerprints.note_event(svc.key())
-            self.service_queue.add_rate_limited(
-                svc.key(), klass=CLASS_INTERACTIVE)
+            event_enqueue(self.service_gate, self.service_fingerprints,
+                          self.service_queue, svc)
 
     def _resync_service(self, svc: Service, wave: int) -> None:
         """Tagged resync re-delivery: the level-trigger backstop now
@@ -244,11 +236,8 @@ class GlobalAcceleratorController:
 
     def _add_ingress(self, ingress: Ingress) -> None:
         if was_alb_ingress(ingress) and self._has_managed(ingress):
-            if not self.ingress_gate.admit(ingress):
-                return
-            self.ingress_fingerprints.note_event(ingress.key())
-            self.ingress_queue.add_rate_limited(
-                ingress.key(), klass=CLASS_INTERACTIVE)
+            event_enqueue(self.ingress_gate, self.ingress_fingerprints,
+                          self.ingress_queue, ingress)
 
     def _update_ingress(self, old: Ingress, new: Ingress) -> None:
         if old == new:
@@ -256,19 +245,14 @@ class GlobalAcceleratorController:
         if was_alb_ingress(new):
             if self._has_managed(new) or annotation_presence_changed(
                     old, new, AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION):
-                if not self.ingress_gate.admit(new):
-                    return
-                self.ingress_fingerprints.note_event(new.key())
-                self.ingress_queue.add_rate_limited(
-                    new.key(), klass=CLASS_INTERACTIVE)
+                event_enqueue(self.ingress_gate,
+                              self.ingress_fingerprints,
+                              self.ingress_queue, new)
 
     def _delete_ingress(self, ingress: Ingress) -> None:
         # reference enqueues ingress deletes unconditionally (controller.go:185)
-        if not self.ingress_gate.admit(ingress):
-            return
-        self.ingress_fingerprints.note_event(ingress.key())
-        self.ingress_queue.add_rate_limited(
-            ingress.key(), klass=CLASS_INTERACTIVE)
+        event_enqueue(self.ingress_gate, self.ingress_fingerprints,
+                      self.ingress_queue, ingress)
 
     def _resync_ingress(self, ingress: Ingress, wave: int) -> None:
         if was_alb_ingress(ingress) and self._has_managed(ingress):

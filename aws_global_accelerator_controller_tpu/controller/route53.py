@@ -37,7 +37,6 @@ from ..kube.client import KubeClient
 from ..kube.informers import SharedInformerFactory, wait_for_cache_sync
 from ..kube.objects import Ingress, Service, split_meta_namespace_key
 from ..kube.workqueue import (
-    CLASS_INTERACTIVE,
     DEFAULT_AGE_WATERMARK,
     DEFAULT_AGING_HORIZON,
     DEFAULT_DEPTH_WATERMARK,
@@ -48,6 +47,7 @@ from ..reconcile.fingerprint import FingerprintCache, FingerprintConfig
 from .base import (
     ROUTE53_HOSTNAME_INDEX,
     annotation_presence_changed,
+    event_enqueue,
     index_by_route53_hostname,
     ShardGate,
     resync_enqueue,
@@ -210,11 +210,8 @@ class Route53Controller:
 
     def _add_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc) and self._has_hostname(svc):
-            if not self.service_gate.admit(svc):
-                return
-            self.service_fingerprints.note_event(svc.key())
-            self.service_queue.add_rate_limited(
-                svc.key(), klass=CLASS_INTERACTIVE)
+            event_enqueue(self.service_gate, self.service_fingerprints,
+                          self.service_queue, svc)
 
     def _update_service(self, old: Service, new: Service) -> None:
         if old == new:
@@ -222,19 +219,14 @@ class Route53Controller:
         if was_load_balancer_service(new):
             if self._has_hostname(new) or annotation_presence_changed(
                     old, new, ROUTE53_HOSTNAME_ANNOTATION):
-                if not self.service_gate.admit(new):
-                    return
-                self.service_fingerprints.note_event(new.key())
-                self.service_queue.add_rate_limited(
-                    new.key(), klass=CLASS_INTERACTIVE)
+                event_enqueue(self.service_gate,
+                              self.service_fingerprints,
+                              self.service_queue, new)
 
     def _delete_service(self, svc: Service) -> None:
         if was_load_balancer_service(svc):
-            if not self.service_gate.admit(svc):
-                return
-            self.service_fingerprints.note_event(svc.key())
-            self.service_queue.add_rate_limited(
-                svc.key(), klass=CLASS_INTERACTIVE)
+            event_enqueue(self.service_gate, self.service_fingerprints,
+                          self.service_queue, svc)
 
     def _resync_service(self, svc: Service, wave: int) -> None:
         """Tagged resync backstop for annotated Services — gated at
@@ -249,29 +241,20 @@ class Route53Controller:
         # the route53 controller watches ALL ingresses with the annotation
         # (route53/controller.go:133-137; no ALB filter on add)
         if self._has_hostname(ingress):
-            if not self.ingress_gate.admit(ingress):
-                return
-            self.ingress_fingerprints.note_event(ingress.key())
-            self.ingress_queue.add_rate_limited(
-                ingress.key(), klass=CLASS_INTERACTIVE)
+            event_enqueue(self.ingress_gate, self.ingress_fingerprints,
+                          self.ingress_queue, ingress)
 
     def _update_ingress(self, old: Ingress, new: Ingress) -> None:
         if old == new:
             return
         if self._has_hostname(new) or annotation_presence_changed(
                 old, new, ROUTE53_HOSTNAME_ANNOTATION):
-            if not self.ingress_gate.admit(new):
-                return
-            self.ingress_fingerprints.note_event(new.key())
-            self.ingress_queue.add_rate_limited(
-                new.key(), klass=CLASS_INTERACTIVE)
+            event_enqueue(self.ingress_gate, self.ingress_fingerprints,
+                          self.ingress_queue, new)
 
     def _delete_ingress(self, ingress: Ingress) -> None:
-        if not self.ingress_gate.admit(ingress):
-            return
-        self.ingress_fingerprints.note_event(ingress.key())
-        self.ingress_queue.add_rate_limited(
-            ingress.key(), klass=CLASS_INTERACTIVE)
+        event_enqueue(self.ingress_gate, self.ingress_fingerprints,
+                      self.ingress_queue, ingress)
 
     def _resync_ingress(self, ingress: Ingress, wave: int) -> None:
         if self._has_hostname(ingress):

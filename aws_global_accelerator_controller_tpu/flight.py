@@ -1,0 +1,196 @@
+"""Chaos flight recorder: a bounded black-box for convergence stalls.
+
+When something goes visibly wrong — a circuit breaker opens, a rollout
+rolls back, the overload shedder fires, a bench leg breaches its SLO,
+or a test asks explicitly — the recorder freezes the recent span ring,
+the convergence ledger, a metrics-registry counter delta since arming,
+and every registered seeded-chaos decision log into ONE correlated
+JSON dump under ``bench_artifacts/``.  ``hack/flight_replay.py``
+renders a dump as a per-key timeline and as Chrome trace-event format
+(viewable in chrome://tracing / Perfetto).
+
+Contracts:
+
+- **Bounded**: the dump reads bounded rings only (span ring, ledger
+  ring, chaos decision deques) and snapshots counters — never gauge
+  callbacks (a gauge callback may take the very lock the triggering
+  subsystem holds: the breaker's state gauge vs a trigger fired from
+  inside the breaker transition).
+- **Debounced**: one dump per trigger reason per ``cooldown`` seconds;
+  a brownout tripping breakers across regions writes one black box,
+  not one per failure.
+- **Fail-open**: a dump that cannot be written logs and returns None —
+  the recorder must never add a failure mode to the failure path it
+  observes.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .tracing import default_ledger, default_tracer
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_DIR = os.path.join("bench_artifacts", "flight")
+
+#: dumps kept per directory: arm() prunes the oldest beyond this so a
+#: long-lived process (or a chaos suite re-arming per scenario) never
+#: grows the black box without bound
+KEEP_DUMPS = 20
+
+#: trigger reasons wired into the runtime (tests may use any string)
+TRIGGER_CIRCUIT_OPEN = "circuit_open"
+TRIGGER_ROLLOUT_ROLLBACK = "rollout_rollback"
+TRIGGER_OVERLOAD_SHED = "overload_shed"
+TRIGGER_SLO_BREACH = "slo_breach"
+
+
+class FlightRecorder:
+    def __init__(self, directory: str = DEFAULT_DIR,
+                 cooldown: float = 30.0,
+                 tracer=None, ledger=None, registry=None):
+        self.directory = directory
+        self.cooldown = cooldown
+        self._tracer = tracer or default_tracer
+        self._ledger = ledger or default_ledger
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._armed = False
+        self._baseline: Dict[str, float] = {}
+        self._last_dump: Dict[str, float] = {}
+        self._seq = 0
+        # name -> fn() -> list of decision dicts (the seeded chaos
+        # engines' decision logs; fake cloud, kube plane)
+        self._chaos_sources: Dict[str, Callable[[], List[dict]]] = {}
+        self._dumps: List[str] = []
+
+    # -- wiring ---------------------------------------------------------
+
+    def _resolve_registry(self):
+        if self._registry is not None:
+            return self._registry
+        from . import metrics
+        return metrics.default_registry
+
+    def arm(self, registry=None) -> None:
+        """Start recording: snapshot the metrics baseline the next
+        dump's delta is computed against.  Re-arming re-baselines."""
+        if registry is not None:
+            self._registry = registry
+        reg = self._resolve_registry()
+        with self._lock:
+            self._armed = True
+            self._baseline = reg.counters_snapshot()
+            self._last_dump.clear()
+        self._prune()
+
+    def _prune(self, keep: Optional[int] = None) -> None:
+        """Retention: drop the oldest dumps beyond ``keep`` (bounded
+        black box on disk, like the rings in memory).  ``None`` reads
+        the module's ``KEEP_DUMPS`` at call time (testable knob)."""
+        if keep is None:
+            keep = KEEP_DUMPS
+        try:
+            if not os.path.isdir(self.directory):
+                return
+            dumps = sorted(
+                (os.path.join(self.directory, f)
+                 for f in os.listdir(self.directory)
+                 if f.startswith("flight_") and f.endswith(".json")),
+                key=os.path.getmtime)
+            for path in dumps[:-keep] if keep else dumps:
+                os.unlink(path)
+        except OSError:
+            logger.debug("flight recorder: prune failed", exc_info=True)
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed = False
+
+    def armed(self) -> bool:
+        with self._lock:
+            return self._armed
+
+    def add_chaos_source(self, name: str,
+                         fn: Callable[[], List[dict]]) -> None:
+        """Register a seeded chaos engine's decision log (its bounded
+        ``decision_log()``) under ``name`` in every future dump."""
+        with self._lock:
+            self._chaos_sources[name] = fn
+
+    def dumps(self) -> List[str]:
+        with self._lock:
+            return list(self._dumps)
+
+    # -- the trigger ----------------------------------------------------
+
+    def trigger(self, reason: str, detail: str = "") -> Optional[str]:
+        """Freeze the black box NOW (debounced per reason).  Returns
+        the dump path, or None when disarmed / cooling down / the
+        write failed."""
+        now = time.monotonic()
+        with self._lock:
+            if not self._armed:
+                return None
+            last = self._last_dump.get(reason)
+            if last is not None and now - last < self.cooldown:
+                return None
+            self._last_dump[reason] = now
+            self._seq += 1
+            seq = self._seq
+            sources = dict(self._chaos_sources)
+            baseline = dict(self._baseline)
+        try:
+            reg = self._resolve_registry()
+            current = reg.counters_snapshot()
+            delta = {k: round(v - baseline.get(k, 0.0), 6)
+                     for k, v in sorted(current.items())
+                     if v != baseline.get(k, 0.0)}
+            chaos = {}
+            for name, fn in sources.items():
+                try:
+                    chaos[name] = list(fn())
+                except Exception as e:
+                    chaos[name] = [{"error": str(e)}]
+            dump = {
+                "reason": reason,
+                "detail": detail,
+                "wall": time.time(),
+                "pid": os.getpid(),
+                "spans": self._tracer.recent(limit=0),
+                "ledger": self._ledger.snapshot(limit=0),
+                "metrics_delta": delta,
+                "chaos": chaos,
+            }
+            os.makedirs(self.directory, exist_ok=True)
+            path = os.path.join(
+                self.directory,
+                f"flight_{reason}_{os.getpid()}_{seq}.json")
+            with open(path, "w") as f:
+                json.dump(dump, f, indent=1, default=str)
+            with self._lock:
+                self._dumps.append(path)
+            from . import metrics
+            metrics.record_flight_dump(reason)
+            logger.warning("flight recorder: dumped %s (%s) to %s",
+                           reason, detail, path)
+            return path
+        except Exception:
+            logger.exception("flight recorder: dump for %r failed "
+                             "(fail-open)", reason)
+            return None
+
+
+default_recorder = FlightRecorder()
+
+
+def trigger(reason: str, detail: str = "") -> Optional[str]:
+    """Module-level trigger against the default recorder — what the
+    runtime hook points (breaker open, rollout rollback, overload
+    shed) call; a no-op until someone arms the recorder."""
+    return default_recorder.trigger(reason, detail)

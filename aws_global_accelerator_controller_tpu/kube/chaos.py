@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 import zlib
 from typing import Callable, Dict, Optional, Tuple
 
@@ -73,6 +74,9 @@ class KubeChaos:
         self._conflict_rates: Dict[str, float] = {}
         self._latency: Dict[str, float] = {}
         self._drop_rates: Dict[str, float] = {}
+        # bounded, ordered log of every injected fault — the flight
+        # recorder's kube-plane chaos source (flight.py)
+        self._decisions: deque = deque(maxlen=4096)
 
     # -- schedule -------------------------------------------------------
 
@@ -160,6 +164,22 @@ class KubeChaos:
         with self._lock:
             return dict(self._injected)
 
+    def decision_log(self) -> "List[dict]":
+        """The bounded, ordered log of every injected kube-plane
+        fault (key, index, fault source, injector clock) — parity
+        with the cloud injector's decision_log (flight.py)."""
+        with self._lock:
+            return list(self._decisions)
+
+    def _log_decision_locked(self, key: str, index: int,
+                             source: str) -> None:
+        self._decisions.append({
+            "t": round(self._clock(), 6),
+            "key": key,
+            "index": index,
+            "source": source,
+        })
+
     # -- the hooks (called by apiserver.ResourceStore) ------------------
 
     def _decide(self, salt: str, key: str, index: int,
@@ -231,6 +251,10 @@ class KubeChaos:
             if exc is not None:
                 self._injected[injected_key] = \
                     self._injected.get(injected_key, 0) + 1
+                self._log_decision_locked(
+                    injected_key, index,
+                    "conflict" if isinstance(exc, ConflictError)
+                    else "rate")
         if delay > 0.0:
             time.sleep(delay)
         if exc is not None:
@@ -250,6 +274,7 @@ class KubeChaos:
             self._calls[key] = index + 1
             if self._decide("drop", key, index, rate):
                 self._injected[key] = self._injected.get(key, 0) + 1
+                self._log_decision_locked(key, index, "watch_drop")
                 return True
             return False
 

@@ -191,6 +191,25 @@ class NativeRateLimitingQueue:
         # via claimed_meta, cleared at done().  Guarded by the GIL
         # (single dict ops) like the rest of the wrapper's state.
         self._claimed: dict = {}
+        # trace-context sidecars (tracing.py) — kept on the Python
+        # side (the C++ queue stores keys only): pending delivery's
+        # context + the claimed one, parity with RateLimitingQueue.
+        # The C++ dedup is invisible here, so merge policy is applied
+        # unconditionally: a second context for a pending item links
+        # into the first.  Guarded by the GIL (single dict ops).
+        self._trace: dict = {}
+        self._claimed_trace: dict = {}
+
+    def _note_trace(self, item: Any, ctx) -> None:
+        if ctx is None:
+            return
+        have = self._trace.get(item)
+        if have is None:
+            self._trace[item] = ctx
+            ctx.hop("queued")
+        elif have is not ctx:
+            have.link(ctx.trace_id)
+            ctx.link(have.trace_id)
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -198,7 +217,8 @@ class NativeRateLimitingQueue:
             self._lib.aga_wq_free(h)
             self._h = None
 
-    def add(self, item: Any, klass: str = "keep") -> None:
+    def add(self, item: Any, klass: str = "keep", ctx=None) -> None:
+        self._note_trace(item, ctx)
         self._fast.aga_wq_add2(self._h, _encode(item), _c_class(klass))
 
     def get(self, timeout: Optional[float] = None
@@ -222,6 +242,9 @@ class NativeRateLimitingQueue:
                 item = buf.value.decode("utf-8")
                 self._claimed[item] = (_py_class(out_klass.value),
                                        time.monotonic() - out_wait.value)
+                ctx = self._trace.pop(item, None)
+                if ctx is not None:
+                    self._claimed_trace[item] = ctx
                 return item, False
             if rc == 1:
                 return None, True
@@ -233,6 +256,7 @@ class NativeRateLimitingQueue:
 
     def done(self, item: Any) -> None:
         self._claimed.pop(item, None)
+        self._claimed_trace.pop(item, None)
         self._fast.aga_wq_done(self._h, _encode(item))
 
     def claimed_meta(self, item: Any) -> Optional[Tuple[str, float]]:
@@ -241,12 +265,25 @@ class NativeRateLimitingQueue:
         RateLimitingQueue.claimed_meta."""
         return self._claimed.get(item)
 
+    def claimed_trace(self, item: Any):
+        """TraceContext of the held delivery — parity with
+        RateLimitingQueue.claimed_trace."""
+        return self._claimed_trace.get(item)
+
+    def pending_trace(self, item: Any):
+        """TraceContext of the pending delivery — parity with
+        RateLimitingQueue.pending_trace."""
+        return self._trace.get(item)
+
     def add_after(self, item: Any, delay: float,
-                  klass: str = "keep") -> None:
+                  klass: str = "keep", ctx=None) -> None:
+        self._note_trace(item, ctx)
         self._fast.aga_wq_add_after2(self._h, _encode(item), float(delay),
                                      _c_class(klass))
 
-    def add_rate_limited(self, item: Any, klass: str = "keep") -> None:
+    def add_rate_limited(self, item: Any, klass: str = "keep",
+                         ctx=None) -> None:
+        self._note_trace(item, ctx)
         self._fast.aga_wq_add_rate_limited2(self._h, _encode(item),
                                             _c_class(klass))
 
@@ -256,6 +293,7 @@ class NativeRateLimitingQueue:
     def remove(self, item: Any) -> bool:
         """Purge a pending item (per-shard queue ownership hook) —
         parity with RateLimitingQueue.remove."""
+        self._trace.pop(item, None)
         return bool(self._fast.aga_wq_remove(self._h, _encode(item)))
 
     def num_requeues(self, item: Any) -> int:

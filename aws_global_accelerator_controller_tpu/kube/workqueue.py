@@ -38,6 +38,16 @@ the backlog crosses the depth watermark or the oldest INTERACTIVE
 item's age crosses the age watermark — the shed trigger the resync
 enqueue path consults so background work is dropped first, never
 interactive work (controller/base.py ``resync_enqueue``).
+
+Causal tracing (tracing.py): every enqueue may carry the originating
+event's :class:`~..tracing.TraceContext` (``ctx=``, lint rule L114
+keeps controller/reconcile call sites explicit about it).  The queue
+keeps it in a sidecar map beside the item's class — an item dedups,
+its contexts MERGE (the later trace is recorded as a link on the
+pending one, so no trace is silently dropped by client-go dedup) —
+and hands it to the claiming worker via ``claimed_trace``, which
+attaches it so the reconcile span tree continues the event's trace
+across the queue boundary.
 """
 from __future__ import annotations
 
@@ -257,6 +267,11 @@ class RateLimitingQueue:
         self._runnable_at: Dict[Any, float] = {}
         # item -> (class, enqueued_at) of the delivery a worker holds
         self._claimed: Dict[Any, Tuple[str, float]] = {}
+        # trace-context sidecars (tracing.py TraceContext): the
+        # context riding the PENDING delivery, and the one the
+        # claiming worker holds (moved at get, dropped at done)
+        self._trace: Dict[Any, Any] = {}
+        self._claimed_trace: Dict[Any, Any] = {}
         self._shutting_down = False
         # delaying queue state; _waiting_index dedupes by item keeping
         # the EARLIEST deadline (two parks — e.g. a breaker hint then a
@@ -270,6 +285,22 @@ class RateLimitingQueue:
         self._waker.start()
 
     # -- class bookkeeping (callers hold _cond) -------------------------
+
+    def _note_trace_locked(self, item: Any, ctx) -> None:
+        """Install (or merge) the pending delivery's trace context.
+        Dedup merging: when the item already carries a context, the
+        new event's trace is recorded as a LINK on the pending one —
+        the surviving delivery answers for both, exactly like a
+        coalescer fold."""
+        if ctx is None:
+            return
+        have = self._trace.get(item)
+        if have is None:
+            self._trace[item] = ctx
+            ctx.hop("queued")
+        elif have is not ctx:
+            have.link(ctx.trace_id)
+            ctx.link(have.trace_id)
 
     def _resolve_class_locked(self, item: Any, klass: str) -> str:
         if klass == CLASS_KEEP:
@@ -333,13 +364,15 @@ class RateLimitingQueue:
             self._class.pop(item, None)
             self._enqueued_at.pop(item, None)
             self._runnable_at.pop(item, None)
+            self._trace.pop(item, None)
 
     # -- base queue -----------------------------------------------------
 
-    def add(self, item: Any, klass: str = CLASS_KEEP) -> None:
+    def add(self, item: Any, klass: str = CLASS_KEEP, ctx=None) -> None:
         with self._cond:
             if self._shutting_down:
                 return
+            self._note_trace_locked(item, ctx)
             self._enter_dirty_locked(
                 item, self._resolve_class_locked(item, klass))
 
@@ -385,12 +418,16 @@ class RateLimitingQueue:
             self._claimed[item] = (
                 self._class.get(item, CLASS_INTERACTIVE),
                 self._enqueued_at.pop(item, now))
+            ctx = self._trace.pop(item, None)
+            if ctx is not None:
+                self._claimed_trace[item] = ctx
             return item, False
 
     def done(self, item: Any) -> None:
         with self._cond:
             self._processing.discard(item)
             self._claimed.pop(item, None)
+            self._claimed_trace.pop(item, None)
             if item in self._dirty:
                 self._runnable_at[item] = time.monotonic()
                 self._tiers[self._class.get(item, CLASS_INTERACTIVE)] \
@@ -406,6 +443,20 @@ class RateLimitingQueue:
         currently claimed."""
         with self._cond:
             return self._claimed.get(item)
+
+    def claimed_trace(self, item: Any):
+        """The TraceContext riding the delivery the calling worker
+        holds (None when the delivery was untraced) — the dispatch
+        attaches it so its span tree continues the event's trace."""
+        with self._cond:
+            return self._claimed_trace.get(item)
+
+    def pending_trace(self, item: Any):
+        """The TraceContext of the PENDING (not yet claimed) delivery,
+        if any — how the fleet-sweep planner links a wave span to the
+        staged keys' traces without claiming them."""
+        with self._cond:
+            return self._trace.get(item)
 
     def remove(self, item: Any) -> bool:
         """Purge a PENDING item from the queue machinery: its tier
@@ -432,6 +483,8 @@ class RateLimitingQueue:
                 # the heap entry goes stale and is skipped on pop
                 del self._waiting_index[item]
                 removed = True
+            if removed:
+                self._trace.pop(item, None)
             self._maybe_drop_class_locked(item)
         self._rate_limiter.forget(item)
         return removed
@@ -490,8 +543,9 @@ class RateLimitingQueue:
     # -- delaying -------------------------------------------------------
 
     def add_after(self, item: Any, delay: float,
-                  klass: str = CLASS_KEEP) -> None:
+                  klass: str = CLASS_KEEP, ctx=None) -> None:
         with self._cond:
+            self._note_trace_locked(item, ctx)
             self._add_after_locked(item, delay, klass)
 
     def _add_after_locked(self, item: Any, delay: float,
@@ -540,7 +594,8 @@ class RateLimitingQueue:
 
     # -- rate limited ---------------------------------------------------
 
-    def add_rate_limited(self, item: Any, klass: str = CLASS_KEEP) -> None:
+    def add_rate_limited(self, item: Any, klass: str = CLASS_KEEP,
+                         ctx=None) -> None:
         """Schedule the item through the rate limiter.  The limiter is
         charged ONCE PER SCHEDULED DELIVERY: an add that dedups into
         an already-runnable item is a plain class-upgrade no-op, and
@@ -557,6 +612,7 @@ class RateLimitingQueue:
         the gap turn the uncharged peek into a fresh (spurious)
         delivery."""
         with self._cond:
+            self._note_trace_locked(item, ctx)
             if item in self._dirty:
                 delay = 0.0          # already runnable: no new delivery
             elif item in self._waiting_index:

@@ -194,6 +194,25 @@ class Manager:
         # registered per run so a restarted manager replaces stale fns
         metrics.watch_shard_owner(cloud_factory.shards)
 
+        # register the seeded chaos decision logs as flight-recorder
+        # sources (flight.py): the fake cloud's FaultInjector and —
+        # when a chaos suite armed the fake apiserver — the kube-plane
+        # KubeChaos.  The recorder itself is armed by the CLI
+        # (cmd/root.py) or explicitly by tests/bench, so a unit-test
+        # manager never writes dumps by surprise
+        from .. import flight
+        faults = getattr(getattr(cloud_factory, "cloud", None),
+                         "faults", None)
+        if faults is not None and hasattr(faults, "decision_log"):
+            flight.default_recorder.add_chaos_source(
+                "aws", faults.decision_log)
+        kube_chaos = getattr(getattr(kube_client, "api", None),
+                             "chaos", None)
+        if kube_chaos is not None \
+                and hasattr(kube_chaos, "decision_log"):
+            flight.default_recorder.add_chaos_source(
+                "kube", kube_chaos.decision_log)
+
         threads = []
         for name, init_fn in (initializers
                               or new_controller_initializers()).items():

@@ -15,7 +15,11 @@ Prometheus text exposition (no client library dependency):
 - ``exec_credential_runs_total{outcome}`` counter (EKS exec auth)
 
 Endpoints: /healthz (liveness, always 200), /readyz (readiness via
-registered probes), /metrics.
+registered probes), /metrics, /traces (span ring with
+key/queue/min_duration filters + Chrome trace-event export) and
+/traces/ledger (per-key stage-attributed event->converged records,
+tracing.py ConvergenceLedger) — docs/operations.md "Debugging a
+convergence stall".
 """
 from __future__ import annotations
 
@@ -36,6 +40,12 @@ logger = logging.getLogger(__name__)
 LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0)
 
+# Finer low-end buckets for per-stage attribution (tracing.py ledger):
+# queue waits and coalescer lingers live in the sub-millisecond range
+# the reconcile-latency buckets cannot resolve.
+STAGE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
 
 class Registry:
     def __init__(self):
@@ -47,13 +57,42 @@ class Registry:
                                Tuple[Tuple, List[int], float, int]] = {}
         self._gauge_fns: List[Tuple[str, Tuple, Callable[[], float]]] = []
         self._help: Dict[str, str] = {}
+        # every metric name ever recorded through this registry — the
+        # metrics-hygiene contract's evidence (each must have a
+        # describe() HELP entry; tests/test_metrics_apply.py)
+        self._recorded: set = set()
+        # (name, labels) -> last exemplar dict for a histogram series
+        # (trace ids from the convergence ledger); rendered as comment
+        # lines so classic Prometheus text parsers stay happy
+        self._exemplars: Dict[Tuple[str, Tuple], Dict[str, str]] = {}
 
     def describe(self, name: str, help_text: str) -> None:
         self._help[name] = help_text
 
+    def recorded_names(self) -> set:
+        """Every metric family name ever recorded through this
+        registry's write surface (counters, summaries, histograms,
+        gauges)."""
+        with self._lock:
+            return set(self._recorded)
+
+    def help_names(self) -> set:
+        with self._lock:
+            return set(self._help)
+
+    def counters_snapshot(self) -> Dict[str, float]:
+        """A flat, label-stringified counter snapshot — what the
+        flight recorder diffs against its armed baseline.  Counters
+        only, by design: gauge callbacks may take locks held by the
+        triggering subsystem."""
+        with self._lock:
+            return {f"{name}{self._fmt_labels(labels)}": value
+                    for (name, labels), value in self._counters.items()}
+
     def inc_counter(self, name: str, labels: Dict[str, str],
                     value: float = 1.0) -> None:
         with self._lock:
+            self._recorded.add(name)
             self._counters[(name, tuple(sorted(labels.items())))] += value
 
     def counter_value(self, name: str,
@@ -73,17 +112,26 @@ class Registry:
                         value: float) -> None:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
+            self._recorded.add(name)
             s, c = self._summaries.get(key, (0.0, 0))
             self._summaries[key] = (s + value, c + 1)
 
     def observe_histogram(self, name: str, labels: Dict[str, str],
                           value: float,
-                          buckets: Tuple = LATENCY_BUCKETS) -> None:
+                          buckets: Tuple = LATENCY_BUCKETS,
+                          exemplar: Optional[Dict[str, str]] = None,
+                          ) -> None:
         """Prometheus histogram observe: cumulative ``_bucket{le=}``
         series plus ``_sum``/``_count`` (rendered that way too), so
-        p50/p99 are derivable by any scraper."""
+        p50/p99 are derivable by any scraper.  ``exemplar`` (e.g.
+        ``{"trace_id": "123"}``) keeps the LAST exemplar per series,
+        rendered as a ``# EXEMPLAR`` comment line — a scraper-visible
+        pointer from a latency bucket to one concrete trace."""
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
+            self._recorded.add(name)
+            if exemplar:
+                self._exemplars[key] = dict(exemplar)
             got = self._histograms.get(key)
             if got is None or got[0] != buckets:
                 got = (buckets, [0] * (len(buckets) + 1), 0.0, 0)
@@ -115,6 +163,7 @@ class Registry:
         queues alive."""
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
+            self._recorded.add(name)
             self._gauge_fns = [g for g in self._gauge_fns
                                if (g[0], g[1]) != key]
             self._gauge_fns.append((key[0], key[1], fn))
@@ -135,6 +184,7 @@ class Registry:
                           for k, v in self._histograms.items()}
             gauges = list(self._gauge_fns)
             helps = dict(self._help)
+            exemplars = {k: dict(v) for k, v in self._exemplars.items()}
 
         seen_help = set()
 
@@ -166,6 +216,13 @@ class Registry:
                          f" {c}")
             lines.append(f"{name}_sum{self._fmt_labels(labels)} {s}")
             lines.append(f"{name}_count{self._fmt_labels(labels)} {c}")
+            ex = exemplars.get((name, labels))
+            if ex:
+                # comment line, not OpenMetrics inline syntax: the
+                # classic text format stays parseable for every scraper
+                pairs = ",".join(f"{k}={v}" for k, v in sorted(ex.items()))
+                lines.append(f"# EXEMPLAR {name}"
+                             f"{self._fmt_labels(labels)} {pairs}")
         for name, labels, fn in gauges:
             emit_help(name, "gauge")
             try:
@@ -340,6 +397,27 @@ default_registry.describe(
     "to the last good weights, per controller and reason.  The "
     "Progressing->RollingBack edge fires EXACTLY once per failed "
     "target (RolledBack is sticky until the target changes).")
+default_registry.describe(
+    "fleet_sweep_verdicts_total",
+    "Sweep-origin dispatches answered by the whole-fleet planner "
+    "(controller/fleetsweep.py), per controller queue and verdict: "
+    "converged = read-only pass, repaired = weight drift fixed "
+    "straight from planner intents, diverged/unplanned = per-object "
+    "deep-verify fallback.")
+default_registry.describe(
+    "stage_seconds",
+    "Per-stage event->converged attribution from the convergence "
+    "ledger (tracing.py): seconds one key spent in each pipeline "
+    "stage (queued / planned / coalesced / inflight / baked), per "
+    "controller queue, with exemplar trace ids — the p99 is "
+    "attributable to a stage instead of being one opaque number.")
+default_registry.describe(
+    "flight_recorder_dumps_total",
+    "Flight-recorder black-box dumps written, by trigger reason "
+    "(circuit_open / rollout_rollback / overload_shed / slo_breach / "
+    "explicit test hooks) — each one froze the span ring, the "
+    "convergence ledger, a metrics delta and the seeded chaos "
+    "decision logs into one correlated JSON file (flight.py).")
 default_registry.describe(
     "race_lockset_checks",
     "Lock acquisitions screened by the runtime lockset tracker "
@@ -669,10 +747,36 @@ def record_reconcile_latency(controller: str, klass: str, seconds: float,
 def record_shed(controller: str, reason: str,
                 registry: Optional[Registry] = None) -> None:
     """One background (resync/sweep) enqueue dropped by the overload
-    shedder (``reason``: depth / age watermark)."""
+    shedder (``reason``: depth / age watermark).  Also a flight
+    recorder trigger (flight.py; debounced there, no-op unarmed):
+    the first shed of an overload episode freezes the black box while
+    the queues that caused it are still hot."""
     reg = registry or default_registry
     reg.inc_counter("sheds_total",
                     {"controller": controller, "reason": reason})
+    from . import flight
+    flight.trigger(flight.TRIGGER_OVERLOAD_SHED,
+                   f"{controller}:{reason}")
+
+
+def record_stage_seconds(stage: str, controller: str, seconds: float,
+                         trace_id: Optional[int] = None,
+                         registry: Optional[Registry] = None) -> None:
+    """One key's time in one pipeline stage (the convergence ledger's
+    histogram feed, tracing.py), with the trace id as exemplar."""
+    reg = registry or default_registry
+    reg.observe_histogram(
+        "stage_seconds", {"stage": stage, "controller": controller},
+        seconds, buckets=STAGE_BUCKETS,
+        exemplar={"trace_id": str(trace_id)}
+        if trace_id is not None else None)
+
+
+def record_flight_dump(reason: str,
+                       registry: Optional[Registry] = None) -> None:
+    """The flight recorder wrote one black-box dump (flight.py)."""
+    reg = registry or default_registry
+    reg.inc_counter("flight_recorder_dumps_total", {"reason": reason})
 
 
 def watch_queue_depth(queue, registry: Optional[Registry] = None) -> None:
@@ -733,8 +837,29 @@ class HealthServer:
                     locks.flush_counters(outer.registry)
                     self._respond(200, outer.registry.render(),
                                   "text/plain; version=0.0.4")
+                elif urlparse(self.path).path == "/traces/ledger":
+                    from .tracing import default_ledger
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = int(q.get("limit", ["200"])[0])
+                        if limit < 0:
+                            raise ValueError
+                    except ValueError:
+                        self._respond(
+                            400, "limit must be a non-negative integer")
+                        return
+                    records = default_ledger.snapshot(
+                        key=q.get("key", [None])[0],
+                        controller=q.get("controller", [None])[0],
+                        limit=limit)
+                    self._respond(
+                        200,
+                        json.dumps({"records": records,
+                                    "percentiles":
+                                        default_ledger.percentiles()}),
+                        "application/json")
                 elif urlparse(self.path).path == "/traces":
-                    from .tracing import default_tracer
+                    from .tracing import default_tracer, to_chrome_events
                     q = parse_qs(urlparse(self.path).query)
                     try:
                         limit = int(q.get("limit", ["100"])[0])
@@ -744,12 +869,50 @@ class HealthServer:
                         self._respond(
                             400, "limit must be a non-negative integer")
                         return
+                    try:
+                        min_duration = float(
+                            q.get("min_duration", ["0"])[0])
+                    except ValueError:
+                        self._respond(
+                            400, "min_duration must be a number")
+                        return
+                    fmt = q.get("format", ["json"])[0]
+                    if fmt not in ("json", "chrome"):
+                        self._respond(
+                            400, "format must be json or chrome")
+                        return
+                    # filter BEFORE the limit cut so ?key= digs past
+                    # unrelated recent spans; limit=0 means everything
+                    # buffered, same as Tracer.recent's own contract
                     spans = default_tracer.recent(
-                        # limit=0 means "everything buffered", same as
-                        # Tracer.recent's own contract
-                        limit=limit, name=q.get("name", [None])[0])
-                    self._respond(200, json.dumps({"spans": spans}),
-                                  "application/json")
+                        limit=0, name=q.get("name", [None])[0])
+                    key = q.get("key", [None])[0]
+                    if key is not None:
+                        spans = [s for s in spans
+                                 if s["attributes"].get("key") == key]
+                    queue = q.get("queue", [None])[0]
+                    if queue is not None:
+                        spans = [s for s in spans
+                                 if s["attributes"].get("queue")
+                                 == queue]
+                    if min_duration > 0:
+                        spans = [s for s in spans
+                                 if s["duration_s"] >= min_duration]
+                    if limit > 0:
+                        spans = spans[-limit:]
+                    if fmt == "chrome":
+                        # the same trace-event serializer the flight
+                        # recorder's replay tool uses — paste into
+                        # chrome://tracing / Perfetto
+                        self._respond(
+                            200,
+                            json.dumps(
+                                {"traceEvents":
+                                 to_chrome_events(spans)}),
+                            "application/json")
+                    else:
+                        self._respond(200, json.dumps({"spans": spans}),
+                                      "application/json")
                 else:
                     self._respond(404, "not found")
 

@@ -295,16 +295,24 @@ class WholeFleetPlanner:
                 jnp.asarray(rows), rest)
 
     def plan(self, fleet: ColumnarFleet) -> FleetPlanResult:
-        """One whole-fleet pass on the best live rung."""
+        """One whole-fleet pass on the best live rung, under a
+        ``fleet_plan.device`` span (nests under the fleet-sweep wave
+        span when the sweep dispatch drives it — tracing.py) naming
+        the rung/layout the pass actually ran on."""
         import jax
+
+        from ..tracing import default_tracer
 
         rung, layout, fn, rows, rest = self.prepare(fleet)
         S, Gs, E = fleet.desired.shape
-        desired_w, to_add, to_remove, to_reweight, stats = fn(
-            self.params, rows, *rest)
-        (desired_w, to_add, to_remove, to_reweight, stats) = \
-            jax.device_get(
-                (desired_w, to_add, to_remove, to_reweight, stats))
+        with default_tracer.span("fleet_plan.device", rung=rung,
+                                 layout=layout,
+                                 groups=fleet.total_groups):
+            desired_w, to_add, to_remove, to_reweight, stats = fn(
+                self.params, rows, *rest)
+            (desired_w, to_add, to_remove, to_reweight, stats) = \
+                jax.device_get(
+                    (desired_w, to_add, to_remove, to_reweight, stats))
         shape = (S, Gs, E)
         return FleetPlanResult(
             fleet=fleet, rung=rung, layout=layout,

@@ -36,7 +36,7 @@ from typing import Callable, Optional
 from .. import metrics
 from ..errors import is_no_retry, is_not_found, retry_after_hint
 from ..kube.workqueue import CLASS_INTERACTIVE, CLASS_KEEP, RateLimitingQueue
-from ..tracing import default_tracer
+from ..tracing import default_ledger, default_tracer
 from .fingerprint import (
     ORIGIN_RESYNC,
     ORIGIN_SWEEP,
@@ -141,8 +141,17 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
     # NoRetryError) out of the guard and the key is dropped below
     route_guard = ((lambda: shards.guard(key)) if shards is not None
                    else nullcontext)
-    with default_tracer.span("reconcile", queue=queue.name or "queue",
-                             key=key) as span:
+    # causal continuation (tracing.py): the event's trace context rode
+    # the queue item — attach it so the reconcile span (and every
+    # provider child, coalescer intent, chaos mark beneath it) joins
+    # the event's trace across the queue/thread boundary
+    ctx = queue.claimed_trace(key) if hasattr(queue, "claimed_trace") \
+        else None
+    if ctx is not None:
+        ctx.hop("claimed")
+    with default_tracer.attach(ctx), \
+            default_tracer.span("reconcile", queue=queue.name or "queue",
+                                key=key) as span:
         try:
             obj = key_to_obj(key)
         except Exception as e:
@@ -225,24 +234,37 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                 # key-stable jitter in [1.0, 1.25) decorrelates them
                 # (deterministic per key — no park-time flapping)
                 jitter = 1.0 + 0.25 * (zlib.crc32(key.encode()) / 2**32)
-                queue.add_after(key, hint * jitter, klass=CLASS_KEEP)
+                if ctx is not None:
+                    ctx.hop("requeue")
+                queue.add_after(key, hint * jitter, klass=CLASS_KEEP,
+                                ctx=ctx)
                 logger.warning("error syncing %r, retry budget "
                                "exhausted; parked %.2fs: %s",
                                key, hint * jitter, err)
             else:
                 outcome = "error"
-                queue.add_rate_limited(key, klass=CLASS_KEEP)
+                if ctx is not None:
+                    ctx.hop("requeue")
+                queue.add_rate_limited(key, klass=CLASS_KEEP, ctx=ctx)
                 logger.error("error syncing %r, and requeued: %s", key, err)
             span.error = f"{type(err).__name__}: {err}"
         elif res.requeue_after > 0:
             outcome = "requeue_after"
             queue.forget(key)
-            queue.add_after(key, res.requeue_after, klass=CLASS_KEEP)
+            # rollout step waits and other timed re-deliveries carry
+            # the trace forward: a ramp's whole multi-requeue journey
+            # stays one trace id
+            if ctx is not None:
+                ctx.hop("requeue")
+            queue.add_after(key, res.requeue_after, klass=CLASS_KEEP,
+                            ctx=ctx)
             logger.info("successfully synced %r, but requeued after %.1fs",
                         key, res.requeue_after)
         elif res.requeue:
             outcome = "requeue"
-            queue.add_rate_limited(key, klass=CLASS_KEEP)
+            if ctx is not None:
+                ctx.hop("requeue")
+            queue.add_rate_limited(key, klass=CLASS_KEEP, ctx=ctx)
             logger.info("successfully synced %r, but requeued", key)
         else:
             outcome = "success"
@@ -258,6 +280,12 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
             metrics.record_reconcile_latency(
                 queue.name or "queue", klass,
                 time.monotonic() - first_enqueued)
+            if ctx is not None:
+                # close the trace and assemble the per-stage ledger
+                # record (queued/planned/coalesced/inflight/baked) —
+                # the stage-attributable event->converged story
+                ctx.hop("converged")
+                default_ledger.record(queue.name or "queue", key, ctx)
             logger.debug("successfully synced %r (%.3fs)",
                          key, time.monotonic() - start)
         span.attributes["outcome"] = outcome

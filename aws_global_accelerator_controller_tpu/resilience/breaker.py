@@ -111,6 +111,21 @@ class CircuitBreaker:
             # layer, not a dependency)
             from ..reconcile.fingerprint import invalidate_all_caches
             invalidate_all_caches(f"circuit_open:{self.region}")
+            # ...and freeze the flight recorder's black box while the
+            # spans/chaos decisions that tripped it are still in the
+            # rings.  On a DETACHED thread: this method runs under the
+            # breaker lock that every call in the region serializes
+            # through, and the dump does disk I/O — blocking here
+            # would stall all workers at exactly the failing moment
+            # (the recorder is debounced + no-op unarmed, so thread
+            # churn is bounded by the cooldown)
+            import threading as _threading
+
+            from .. import flight
+            _threading.Thread(
+                target=flight.trigger,
+                args=(flight.TRIGGER_CIRCUIT_OPEN, self.region),
+                daemon=True, name="flight-dump").start()
 
     def _prune_locked(self, now: float) -> None:
         horizon = now - self.window

@@ -39,6 +39,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from .. import metrics
+from ..tracing import default_tracer, stamp_ambient
 from .breaker import AdaptiveTokenBucket, CircuitBreaker
 from .classify import ErrorClass, classify
 from .fence import active_write_fences
@@ -216,79 +217,86 @@ class ResilientAPIs:
 
     def invoke(self, op: str, fn, args, kwargs):
         """One policy-governed call: breaker gate, bucket pacing,
-        classify-and-retry under the attempt budget and deadline."""
-        policy = self.policy
-        deadline = self._clock() + policy.deadline
-        prev_delay = policy.base_delay
-        attempt = 1
-        while True:
-            # lifecycle fence first (L108): a mutation from a stopping
-            # or deposed process must not reach the wire — checked per
-            # attempt, so a retry sleeping across a lease loss is
-            # rejected when it wakes, not issued with dead authority.
-            # The thread's pushed write fences (a routed dispatch's
-            # shard fence, a per-shard flush — resilience/fence.py
-            # push_write_fence) gate at the same per-attempt point, so
-            # a SHARD lease lost mid-retry rejects identically.
-            if op in MUTATION_METHODS:
-                if self.fence is not None:
-                    self.fence.check("wrapper")
-                for extra_fence in active_write_fences():
-                    extra_fence.check("wrapper")
-            # cheap open-circuit pre-gate first (claims nothing), so a
-            # fully open circuit costs no token and no pacing sleep —
-            # otherwise failing-fast workers would drain the bucket
-            # into debt with zero traffic reaching the service.  Then
-            # pace BEFORE the probe-claiming allow(): a half-open
-            # probe slot claimed by allow() must always reach the
-            # inner call, so nothing that can raise may sit between
-            # allow() and the try block.
-            self.breaker.check_open(self._clock())
-            self._pace(op, deadline)
-            self.breaker.allow(self._clock())
-            try:
-                result = fn(*args, **kwargs)
-            except Exception as e:
-                cls = classify(e)
-                if cls is ErrorClass.THROTTLE:
-                    now = self._clock()
-                    self.bucket.on_throttle(now)
-                    self.breaker.record_failure(now)
-                elif cls is ErrorClass.TRANSIENT:
-                    self.breaker.record_failure(self._clock())
+        classify-and-retry under the attempt budget and deadline —
+        under an ``aws.<op>`` span covering every attempt, whose id is
+        stamped into the ambient trace context (tracing.py): the trace
+        an artifact carries names the exact provider calls that served
+        it, and chaos injections inside the call annotate this span."""
+        with default_tracer.span(f"aws.{op}", region=self.region) as sp:
+            stamp_ambient(sp.span_id, "provider")
+            policy = self.policy
+            deadline = self._clock() + policy.deadline
+            prev_delay = policy.base_delay
+            attempt = 1
+            while True:
+                # lifecycle fence first (L108): a mutation from a stopping
+                # or deposed process must not reach the wire — checked per
+                # attempt, so a retry sleeping across a lease loss is
+                # rejected when it wakes, not issued with dead authority.
+                # The thread's pushed write fences (a routed dispatch's
+                # shard fence, a per-shard flush — resilience/fence.py
+                # push_write_fence) gate at the same per-attempt point, so
+                # a SHARD lease lost mid-retry rejects identically.
+                if op in MUTATION_METHODS:
+                    if self.fence is not None:
+                        self.fence.check("wrapper")
+                    for extra_fence in active_write_fences():
+                        extra_fence.check("wrapper")
+                # cheap open-circuit pre-gate first (claims nothing), so a
+                # fully open circuit costs no token and no pacing sleep —
+                # otherwise failing-fast workers would drain the bucket
+                # into debt with zero traffic reaching the service.  Then
+                # pace BEFORE the probe-claiming allow(): a half-open
+                # probe slot claimed by allow() must always reach the
+                # inner call, so nothing that can raise may sit between
+                # allow() and the try block.
+                self.breaker.check_open(self._clock())
+                self._pace(op, deadline)
+                self.breaker.allow(self._clock())
+                try:
+                    result = fn(*args, **kwargs)
+                except Exception as e:
+                    cls = classify(e)
+                    if cls is ErrorClass.THROTTLE:
+                        now = self._clock()
+                        self.bucket.on_throttle(now)
+                        self.breaker.record_failure(now)
+                    elif cls is ErrorClass.TRANSIENT:
+                        self.breaker.record_failure(self._clock())
+                    else:
+                        # the service answered (not-found / validation):
+                        # the region is healthy, the request is just wrong
+                        self.breaker.record_success(self._clock())
+                        raise
+                    if attempt >= policy.max_attempts:
+                        raise RetryBudgetExceededError(
+                            op, attempt,
+                            policy.requeue_hint(prev_delay)) from e
+                    delay = policy.next_delay(self._rng, prev_delay)
+                    prev_delay = delay
+                    if self._clock() + delay > deadline:
+                        metrics.record_aws_call_deadline_exceeded(
+                            op, registry=self._registry)
+                        raise DeadlineExceededError(
+                            op, policy.deadline,
+                            policy.requeue_hint(prev_delay)) from e
+                    metrics.record_aws_call_retry(op,
+                                                  registry=self._registry)
+                    attempt += 1
+                    self._sleep(delay)
                 else:
-                    # the service answered (not-found / validation):
-                    # the region is healthy, the request is just wrong
-                    self.breaker.record_success(self._clock())
-                    raise
-                if attempt >= policy.max_attempts:
-                    raise RetryBudgetExceededError(
-                        op, attempt,
-                        policy.requeue_hint(prev_delay)) from e
-                delay = policy.next_delay(self._rng, prev_delay)
-                prev_delay = delay
-                if self._clock() + delay > deadline:
-                    metrics.record_aws_call_deadline_exceeded(
-                        op, registry=self._registry)
-                    raise DeadlineExceededError(
-                        op, policy.deadline,
-                        policy.requeue_hint(prev_delay)) from e
-                metrics.record_aws_call_retry(op,
-                                              registry=self._registry)
-                attempt += 1
-                self._sleep(delay)
-            else:
-                now = self._clock()
-                self.breaker.record_success(now)
-                self.bucket.on_success(now)
-                if op in UNCOALESCED_MUTATIONS:
-                    # lazy import: the reconcile package is a consumer
-                    # of this layer, not a dependency
-                    from ..reconcile.fingerprint import (
-                        note_provider_mutation,
-                    )
-                    note_provider_mutation()
-                return result
+                    now = self._clock()
+                    self.breaker.record_success(now)
+                    self.bucket.on_success(now)
+                    if op in UNCOALESCED_MUTATIONS:
+                        # lazy import: the reconcile package is a consumer
+                        # of this layer, not a dependency
+                        from ..reconcile.fingerprint import (
+                            note_provider_mutation,
+                        )
+                        note_provider_mutation()
+                    sp.attributes["attempts"] = attempt
+                    return result
 
     def _pace(self, op: str, deadline: float) -> None:
         """Client-side throttle pacing: sleep off the token debt, but

@@ -261,6 +261,12 @@ class RolloutEngine:
                 metrics.record_rollout_rollback(
                     self.controller, reason.split(":", 1)[0] or "failed",
                     registry=self._registry)
+                # a rollback is exactly the moment the flight
+                # recorder exists for: freeze the spans/chaos log
+                # that led here (flight.py; debounced, no-op unarmed)
+                from .. import flight
+                flight.trigger(flight.TRIGGER_ROLLOUT_ROLLBACK,
+                               f"{self.controller}:{key}")
         if outcome.hold_reason is not None:
             metrics.record_rollout_hold(
                 self.controller,

@@ -215,12 +215,21 @@ class ShardSet:
     def guard(self, route_key: str):
         """Wrap one routed dispatch: admit only owned keys, mark the
         thread with the governing shard, and arm the wrapper's
-        per-attempt write gate with the shard's fence."""
+        per-attempt write gate with the shard's fence.  The governing
+        shard and its armed fencing token are stamped onto the
+        current span (tracing.py) so a trace names the ownership term
+        each sync ran under — the shard-handoff debugging signal."""
         sid = self.shard_of(route_key)
         if not self.owns(sid):
             raise ShardNotOwnedError(sid, route_key)
         prior = getattr(_route_tls, "shard", None)
         _route_tls.shard = sid
+        from ..tracing import default_tracer
+
+        span = default_tracer.current()
+        if span is not None:
+            span.attributes["shard"] = sid
+            span.attributes["fence_token"] = self._fences[sid].token
         try:
             with push_write_fence(self._fences[sid]):
                 yield sid

@@ -1,17 +1,49 @@
-"""Lightweight in-process tracing (spans) for the reconcile hot path.
+"""Causal tracing: spans, cross-thread trace continuation, and the
+convergence ledger.
 
 The reference has no tracing at all — only per-sync duration logging at
 verbosity 4 (SURVEY.md §5: "Tracing / profiling: ABSENT"; reference
-pkg/reconcile/reconcile.go:52-55).  This module is a deliberate
-improvement: every reconcile iteration records a span (queue, key,
-outcome, duration), provider calls nest child spans under it, and the
-controller's health server exposes the recent buffer at ``/traces`` as
-JSON for debugging convergence stalls.
+pkg/reconcile/reconcile.go:52-55).  Early PRs improved on that within a
+single reconcile iteration (spans on a thread-local stack), but every
+hand-off the system has grown since — workqueue re-enqueue, coalescer
+linger/flush on another thread, sharded ownership gaps, fleet-plan
+waves, rollout requeues — severed the trace exactly where convergence
+stalls actually happen.  This module makes one trace id follow a key
+from watch-event to converged across every thread, queue and shard
+boundary:
 
-Design: no OpenTelemetry dependency.  A ``Tracer`` keeps a bounded deque
-of *completed* spans (a ring buffer — old spans fall off, memory is
-O(capacity)); span nesting rides a thread-local stack, so concurrent
-reconcile workers trace independently without cross-talk.
+- A :class:`TraceContext` (trace id + origin stage + monotone hop
+  list) is *carried by the artifacts themselves*: workqueue items
+  (kube/workqueue.py sidecar), coalescer intents (each ``_Future``
+  holds its submitter's context; a fold emits a ``fold`` link span
+  recording every contributing trace id), fleet-plan wave membership
+  and rollout requeues.  Contexts are mutable, append-only records —
+  ``hop()`` stamps stage boundaries, ``mark()`` stamps provider-call
+  and chaos-injection span ids, ``link()`` records sibling traces
+  folded into this one.
+- :meth:`Tracer.attach` / the implicit detach on exit are the explicit
+  continuation API: a worker thread attaches the context it popped off
+  a queue and every span it opens joins that trace (correct parent,
+  correct trace id) WITHOUT the thread-local stack ever crossing
+  threads.  ``ambient_context()`` is how deep layers (the coalescer
+  submit, the resilient wrapper, chaos injection) reach the attached
+  context without plumbing it through every signature.
+- The :class:`ConvergenceLedger` assembles per-key event→converged
+  records from a completed context's hop list (stage breakdown:
+  queued / planned / coalesced / inflight / baked), feeds the
+  ``stage_seconds{stage,controller}`` histograms (with exemplar trace
+  ids) and serves ``/traces/ledger`` — the stage-attributable p99 the
+  self-tuning control loops (ROADMAP item 5) need as input.
+
+Design: no OpenTelemetry dependency.  A ``Tracer`` keeps a bounded
+deque of *completed* spans (a ring buffer — old spans fall off, memory
+is O(capacity)); span nesting rides a thread-local stack, so
+concurrent reconcile workers trace independently without cross-talk.
+Span ``links`` carry cross-trace membership (a flush span serving a
+whole cohort lists every member trace id), the OpenTelemetry span-link
+shape.  ``set_enabled(False)`` is the kill switch the trace-overhead
+bench measures against: spans become no-ops and ``new_context``
+returns None (every consumer treats a None context as "untraced").
 """
 from __future__ import annotations
 
@@ -21,9 +53,23 @@ import time
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 _ids = itertools.count(1)
+
+# Global kill switch (bench.py trace-overhead measures span machinery
+# against this): disabled tracers record nothing, open no-op spans and
+# mint no contexts.
+_enabled = True
+
+
+def set_enabled(flag: bool) -> None:
+    global _enabled
+    _enabled = bool(flag)
+
+
+def enabled() -> bool:
+    return _enabled
 
 
 @dataclass
@@ -36,6 +82,14 @@ class Span:
     duration: float = 0.0
     attributes: Dict[str, object] = field(default_factory=dict)
     error: Optional[str] = None
+    # cross-trace membership (OpenTelemetry span links): a flush span
+    # serving a coalesced cohort lists every member trace id here, a
+    # fold span lists the absorbed traces — the span-tree walk follows
+    # links exactly like parent edges
+    links: Tuple[int, ...] = ()
+    # OS thread the span ran on — the cross-thread continuation proof
+    # (a trace whose spans carry several tids crossed threads)
+    tid: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -47,11 +101,121 @@ class Span:
             "duration_s": round(self.duration, 6),
             "attributes": dict(self.attributes),
             "error": self.error,
+            "links": list(self.links),
+            "tid": self.tid,
         }
 
 
+# hop stages with a canonical ledger meaning: the segment ENDING at
+# this hop is attributed to the named stage
+STAGE_OF_HOP = {
+    "queued": "queued",       # handler → enqueue (≈0; keeps hops total)
+    "claimed": "queued",      # enqueue → worker claim: queue wait
+    "planned": "planned",     # claim → first mutation intent: sync work
+    "inflight": "coalesced",  # intent submit → flush drain: linger/fold
+    "flushed": "inflight",    # drain → provider call returned: the wire
+    "converged": "baked",     # last flush → success: status/verify tail
+}
+STAGES = ("queued", "planned", "coalesced", "inflight", "baked")
+
+
+class TraceContext:
+    """The continuation record an artifact carries across a hand-off.
+
+    Mutable and append-only; single writers per phase plus the GIL
+    make plain list appends safe (hops are stamped by whichever thread
+    holds the artifact at that boundary — never two at once).  All
+    three lists are BOUNDED: a key that requeues forever (a perpetual
+    park, an endless ramp) truncates its tail instead of growing its
+    context without bound — the ledger still attributes everything
+    recorded up to the cap."""
+
+    __slots__ = ("trace_id", "origin", "parent_span_id", "hops",
+                 "links", "marks")
+
+    #: caps on hops / links / marks per context (memory bound for
+    #: perpetually-retrying keys; ~100 requeue cycles of headroom)
+    MAX_HOPS = 512
+    MAX_LINKS = 128
+    MAX_MARKS = 256
+
+    def __init__(self, trace_id: int, origin: str,
+                 parent_span_id: Optional[int] = None):
+        self.trace_id = trace_id
+        self.origin = origin
+        self.parent_span_id = parent_span_id
+        # monotone hop list: (stage, monotonic, wall)
+        self.hops: List[Tuple[str, float, float]] = []
+        # trace ids of sibling contexts folded into this one's artifact
+        self.links: List[int] = []
+        # (span_id, kind) stamped by provider calls / chaos injections
+        self.marks: List[Tuple[int, str]] = []
+
+    def hop(self, stage: str, now: Optional[float] = None,
+            wall: Optional[float] = None) -> None:
+        """Stamp a stage boundary.  Monotone by construction: a hop
+        timed before the previous one (clock skew across threads is
+        sub-µs but real) is clamped to it."""
+        t = time.monotonic() if now is None else now
+        if self.hops and t < self.hops[-1][1]:
+            t = self.hops[-1][1]
+        if len(self.hops) < self.MAX_HOPS:
+            self.hops.append((stage, t,
+                              time.time() if wall is None else wall))
+
+    def link(self, trace_id: int) -> None:
+        if trace_id != self.trace_id and trace_id not in self.links \
+                and len(self.links) < self.MAX_LINKS:
+            self.links.append(trace_id)
+
+    def mark(self, span_id: int, kind: str) -> None:
+        if len(self.marks) < self.MAX_MARKS:
+            self.marks.append((span_id, kind))
+
+    def stage_breakdown(self) -> Dict[str, float]:
+        """Per-stage seconds from the hop list: each segment between
+        consecutive hops is attributed to the ENDING hop's canonical
+        stage (STAGE_OF_HOP), unmapped hops to their own name.  A
+        context that rode several flushes (requeues, folds) sums its
+        repeated segments per stage."""
+        out: Dict[str, float] = {}
+        for prev, cur in zip(self.hops, self.hops[1:]):
+            if cur[0] == "converged" and prev[0] != "flushed":
+                # a read-only sync (no mutation flushed): the
+                # claim→converged segment is sync work, not a
+                # post-write bake tail
+                stage = "planned"
+            else:
+                stage = STAGE_OF_HOP.get(cur[0], cur[0])
+            out[stage] = out.get(stage, 0.0) + (cur[1] - prev[1])
+        return out
+
+    def total_seconds(self) -> float:
+        if len(self.hops) < 2:
+            return 0.0
+        return self.hops[-1][1] - self.hops[0][1]
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "origin": self.origin,
+            "parent_span_id": self.parent_span_id,
+            "hops": [{"stage": s, "t": round(t, 6), "wall": w}
+                     for s, t, w in self.hops],
+            "links": list(self.links),
+            "marks": [{"span_id": sid, "kind": k}
+                      for sid, k in self.marks],
+        }
+
+
+# a shared write-sink for disabled tracing: spans yielded from a
+# disabled tracer still accept attribute/error writes, they just go
+# nowhere (and may interleave across threads — the object is a dummy)
+_NULL_SPAN = Span(name="<disabled>")
+
+
 class Tracer:
-    def __init__(self, capacity: int = 512):
+    def __init__(self, capacity: int = 4096):
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=capacity)
         self._local = threading.local()
@@ -61,14 +225,27 @@ class Tracer:
             self._local.stack = []
         return self._local.stack
 
+    def _ctx_stack(self) -> List[TraceContext]:
+        if not hasattr(self._local, "ctxs"):
+            self._local.ctxs = []
+        return self._local.ctxs
+
     @contextmanager
     def span(self, name: str, **attributes) -> Iterator[Span]:
-        """Open a span; nests under the thread's current span, if any.
-        Exceptions mark the span errored and propagate."""
+        """Open a span; nests under the thread's current span (or the
+        attached continuation anchor), if any.  Exceptions mark the
+        span errored and propagate.  ANY exit — ``Exception``,
+        ``BaseException`` (a worker being killed, KeyboardInterrupt),
+        generator teardown — pops the span from the thread-local stack
+        and records it, so a raise inside a provider-call child can
+        never leak a stale parent for the spans that follow."""
+        if not _enabled:
+            yield _NULL_SPAN
+            return
         stack = self._stack()
         parent = stack[-1] if stack else None
         s = Span(name=name, attributes=dict(attributes),
-                 start_wall=time.time())
+                 start_wall=time.time(), tid=threading.get_ident())
         if parent is not None:
             s.parent_id = parent.span_id
             s.trace_id = parent.trace_id
@@ -78,14 +255,87 @@ class Tracer:
         start = time.monotonic()
         try:
             yield s
-        except Exception as e:
-            s.error = f"{type(e).__name__}: {e}"
+        except BaseException as e:
+            # BaseException too: a span whose body was torn down by
+            # thread death or ^C still records WITH its error set —
+            # the flight recorder's last spans before a crash are
+            # exactly the ones that matter
+            if s.error is None:
+                s.error = f"{type(e).__name__}: {e}"
             raise
         finally:
             s.duration = time.monotonic() - start
-            stack.pop()
+            # pop OUR frame even if a buggy child leaked frames above
+            # us (defense in depth; the leak satellite's regression
+            # tests pin both layers)
+            try:
+                stack.remove(s)
+            except ValueError:
+                pass
             with self._lock:
                 self._spans.append(s)
+
+    # -- cross-thread continuation (the attach/detach API) --------------
+
+    @contextmanager
+    def attach(self, ctx: Optional[TraceContext]) -> Iterator[None]:
+        """Continue ``ctx``'s trace on THIS thread: spans opened while
+        attached join ``ctx.trace_id`` with ``ctx.parent_span_id`` as
+        their parent — the span tree spans threads without the
+        thread-local stack ever crossing one.  Exit detaches exactly
+        this attachment (nesting is supported; unrelated frames are
+        never popped).  ``None`` attaches nothing (untraced
+        artifact)."""
+        if ctx is None or not _enabled:
+            yield
+            return
+        anchor = Span(name="<attach>",
+                      span_id=ctx.parent_span_id or ctx.trace_id,
+                      trace_id=ctx.trace_id)
+        stack = self._stack()
+        ctxs = self._ctx_stack()
+        stack.append(anchor)
+        ctxs.append(ctx)
+        try:
+            yield
+        finally:
+            # detach OUR anchor/context wherever they sit: a child
+            # that leaked frames must not make detach pop the wrong one
+            try:
+                stack.remove(anchor)
+            except ValueError:
+                pass
+            for i in range(len(ctxs) - 1, -1, -1):
+                if ctxs[i] is ctx:
+                    del ctxs[i]
+                    break
+
+    def ambient(self) -> Optional[TraceContext]:
+        """The innermost context attached on this thread (None outside
+        any attach) — how deep layers reach the continuation without
+        threading it through every signature."""
+        ctxs = self._ctx_stack()
+        return ctxs[-1] if ctxs else None
+
+    def current_context(self, stage: str) -> Optional[TraceContext]:
+        """A continuation of the CURRENT span's trace, for handing an
+        artifact to another thread: trace id and parent come from the
+        innermost open span (falling back to the attached context);
+        ``stage`` names the hand-off and stamps the first hop."""
+        if not _enabled:
+            return None
+        cur = self.current()
+        if cur is not None and cur.name != "<attach>":
+            ctx = TraceContext(cur.trace_id, stage,
+                               parent_span_id=cur.span_id)
+        else:
+            amb = self.ambient()
+            if amb is None:
+                return None
+            ctx = TraceContext(amb.trace_id, stage,
+                               parent_span_id=amb.parent_span_id)
+        ctx.hop(stage)
+        return ctx
 
     def current(self) -> Optional[Span]:
         stack = self._stack()
@@ -114,6 +364,85 @@ class Tracer:
 default_tracer = Tracer()
 
 
+def new_context(origin: str, tracer: Optional[Tracer] = None,
+                record_span: bool = True,
+                **attributes) -> Optional[TraceContext]:
+    """Mint a fresh trace at an origin boundary (a watch event, a
+    resync/sweep wave, a shard acquire): records a zero-duration root
+    span naming the origin and returns the context the artifact will
+    carry.  None when tracing is disabled.
+
+    ``record_span=False`` mints the context WITHOUT a ring span — the
+    bulk-origin spelling (resync/sweep waves, acquire re-adoption
+    scans): a 10k-object wave must not evict the whole diagnostic
+    span history with zero-duration origin markers.  The context (and
+    therefore the ledger) is identical either way."""
+    if not _enabled:
+        return None
+    tr = tracer or default_tracer
+    if record_span:
+        with tr.span(f"origin.{origin}", **attributes) as s:
+            pass
+        ctx = TraceContext(s.trace_id, origin,
+                           parent_span_id=s.span_id)
+    else:
+        tid = next(_ids)
+        ctx = TraceContext(tid, origin, parent_span_id=tid)
+    ctx.hop(origin, now=None)
+    return ctx
+
+
+def ambient_context(tracer: Optional[Tracer] = None
+                    ) -> Optional[TraceContext]:
+    return (tracer or default_tracer).ambient()
+
+
+def stamp_ambient(span_id: int, kind: str,
+                  tracer: Optional[Tracer] = None) -> None:
+    """Stamp a span id into the thread's attached context (no-op when
+    none): how provider-call spans and chaos injections leave their
+    mark on the trace the artifact carries."""
+    ctx = (tracer or default_tracer).ambient()
+    if ctx is not None:
+        ctx.mark(span_id, kind)
+
+
+def note_chaos(method: str, code: str,
+               tracer: Optional[Tracer] = None) -> None:
+    """A seeded chaos engine injected a fault under the current span:
+    annotate the span (``chaos`` attribute accumulates codes) and stamp
+    its id into the attached context as a ``chaos`` mark."""
+    if not _enabled:
+        return
+    tr = tracer or default_tracer
+    cur = tr.current()
+    if cur is not None and cur is not _NULL_SPAN \
+            and cur.name != "<attach>":
+        cur.attributes.setdefault("chaos", []).append(
+            f"{method}:{code}")
+        stamp_ambient(cur.span_id, "chaos", tracer=tr)
+
+
+def fold_link(into: Optional[TraceContext],
+              absorbed: Optional[TraceContext],
+              tracer: Optional[Tracer] = None, **attributes) -> None:
+    """A coalescer fold superseded one trace's intent with another's:
+    emit a ``fold`` link span on the SURVIVING trace whose links name
+    the absorbed trace, and cross-record the link on both contexts so
+    a folded intent records all contributing trace ids."""
+    if into is None or absorbed is None or not _enabled:
+        return
+    if into.trace_id == absorbed.trace_id:
+        return
+    tr = tracer or default_tracer
+    with tr.span("fold", **attributes) as s:
+        s.trace_id = into.trace_id
+        s.parent_id = into.parent_span_id
+        s.links = (absorbed.trace_id,)
+    into.link(absorbed.trace_id)
+    absorbed.link(into.trace_id)
+
+
 def traced(name: str, tracer: Optional[Tracer] = None):
     """Decorator: run the function under a span named ``name`` (nests
     under the caller's current span — provider calls show up as children
@@ -127,3 +456,131 @@ def traced(name: str, tracer: Optional[Tracer] = None):
                 return fn(*args, **kwargs)
         return wrapper
     return deco
+
+
+# ----------------------------------------------------------------------
+# Chrome trace-event export (chrome://tracing / Perfetto)
+# ----------------------------------------------------------------------
+
+def to_chrome_events(spans: List[dict]) -> List[dict]:
+    """Serialize span dicts (``Span.to_dict`` shape) as Chrome
+    trace-event format complete events — one row (tid) per trace, so a
+    key's whole journey reads as one horizontal lane in Perfetto.
+    Shared by the ``/traces?format=chrome`` endpoint and the flight
+    recorder's replay tool (hack/flight_replay.py)."""
+    events = []
+    for s in spans:
+        args = {str(k): v for k, v in s.get("attributes", {}).items()}
+        if s.get("error"):
+            args["error"] = s["error"]
+        if s.get("links"):
+            args["links"] = s["links"]
+        args["span_id"] = s.get("span_id")
+        events.append({
+            "name": s["name"],
+            "ph": "X",
+            "ts": round(s.get("start_wall", 0.0) * 1e6, 3),
+            "dur": max(1.0, round(s.get("duration_s", 0.0) * 1e6, 3)),
+            "pid": 1,
+            "tid": s.get("trace_id", 0),
+            "args": args,
+        })
+    return events
+
+
+# ----------------------------------------------------------------------
+# Convergence ledger
+# ----------------------------------------------------------------------
+
+class ConvergenceLedger:
+    """Per-key event→converged records assembled from completed trace
+    contexts: the stage-attributable latency story (/traces/ledger and
+    the ``stage_seconds{stage,controller}`` histograms with exemplar
+    trace ids).  Bounded ring; O(capacity) memory."""
+
+    def __init__(self, capacity: int = 2048):
+        self._lock = threading.Lock()
+        self._records: deque = deque(maxlen=capacity)
+
+    def record(self, controller: str, key: str,
+               ctx: Optional[TraceContext],
+               registry=None) -> Optional[dict]:
+        """One key converged: derive the stage breakdown from the
+        context's hop list, append the ledger record and feed the
+        stage histograms (exemplar = the trace id)."""
+        if ctx is None or len(ctx.hops) < 2:
+            return None
+        stages = ctx.stage_breakdown()
+        rec = {
+            "key": key,
+            "controller": controller,
+            "trace_id": ctx.trace_id,
+            "origin": ctx.origin,
+            "total_s": round(ctx.total_seconds(), 6),
+            "stages": {k: round(v, 6) for k, v in stages.items()},
+            "links": list(ctx.links),
+            "wall": ctx.hops[-1][2],
+        }
+        with self._lock:
+            self._records.append(rec)
+        from . import metrics
+        for stage in STAGES:
+            if stage in stages:
+                metrics.record_stage_seconds(
+                    stage, controller, stages[stage],
+                    trace_id=ctx.trace_id, registry=registry)
+        return rec
+
+    def snapshot(self, key: Optional[str] = None,
+                 controller: Optional[str] = None,
+                 limit: int = 200) -> List[dict]:
+        with self._lock:
+            records = list(self._records)
+        if key is not None:
+            records = [r for r in records if r["key"] == key]
+        if controller is not None:
+            records = [r for r in records
+                       if r["controller"] == controller]
+        if limit and limit > 0:
+            records = records[-limit:]
+        return records
+
+    def percentiles(self, controller: Optional[str] = None
+                    ) -> Dict[str, dict]:
+        """Per-stage p50/p99 over the buffered records — what the
+        bench legs report into reconcile_history.jsonl (stage
+        attribution instead of one opaque event→converged number)."""
+        with self._lock:
+            records = list(self._records)
+        if controller is not None:
+            records = [r for r in records
+                       if r["controller"] == controller]
+        by_stage: Dict[str, List[float]] = {}
+        totals: List[float] = []
+        for r in records:
+            totals.append(r["total_s"])
+            for stage, v in r["stages"].items():
+                by_stage.setdefault(stage, []).append(v)
+
+        def pct(xs: List[float], p: float) -> float:
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1,
+                          int(p / 100.0 * (len(xs) - 1) + 0.5))]
+
+        out: Dict[str, dict] = {}
+        for stage, xs in sorted(by_stage.items()):
+            out[stage] = {"count": len(xs),
+                          "p50_s": round(pct(xs, 50), 6),
+                          "p99_s": round(pct(xs, 99), 6)}
+        if totals:
+            out["total"] = {"count": len(totals),
+                            "p50_s": round(pct(totals, 50), 6),
+                            "p99_s": round(pct(totals, 99), 6)}
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+
+
+default_ledger = ConvergenceLedger()

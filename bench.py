@@ -392,6 +392,15 @@ def _steady_state_leg(n_services: int, workers: int, enabled: bool,
         # fingerprints record) before opening the measurement window
         time.sleep(2 * resync)
 
+        # per-stage attribution window (tracing.py convergence
+        # ledger): the waves below are the measured traffic, so clear
+        # the ring first — what converges during the window is what
+        # gets attributed
+        from aws_global_accelerator_controller_tpu.tracing import (
+            default_ledger,
+        )
+        default_ledger.clear()
+
         before_calls = cluster.cloud.faults.call_counts()
         before = {
             "syncs": reg.counter_value("controller_sync_total"),
@@ -409,6 +418,7 @@ def _steady_state_leg(n_services: int, workers: int, enabled: bool,
             - before["skips"]
         sweeps = reg.counter_value("drift_sweep_verifies_total") \
             - before["sweeps"]
+        stage_attribution = default_ledger.percentiles()
     finally:
         cluster.shutdown()
 
@@ -424,6 +434,9 @@ def _steady_state_leg(n_services: int, workers: int, enabled: bool,
         "reconciles_per_wave": round(syncs / waves, 1),
         "fastpath_skips_per_wave": round(skips / waves, 1),
         "sweep_verifies_per_wave": round(sweeps / waves, 1),
+        # per-stage p50/p99 of everything that converged inside the
+        # window (the sweep tier, here) — the ledger's attribution
+        "stage_attribution": stage_attribution,
     }
 
 
@@ -470,9 +483,53 @@ def bench_steady_state(sizes=(1000,), workers: int = 4,
                        "off_reads_per_wave": off["reads_per_wave"],
                        "read_reduction": leg["read_reduction"],
                        "fastpath_skips_per_wave":
-                           on["fastpath_skips_per_wave"]})
+                           on["fastpath_skips_per_wave"],
+                       "stage_attribution": on["stage_attribution"]})
     return {"workers": workers, "sweep_every": sweep_every,
             "legs": legs}
+
+
+def bench_trace_overhead(n_services: int = 1000, workers: int = 4,
+                         reps: int = 3, record: bool = False) -> dict:
+    """A/B of the causal-tracing layer on the create-storm hot path:
+    the same ``bench_reconcile`` storm with tracing enabled (spans,
+    TraceContext hops, the convergence ledger) vs ``set_enabled(False)``
+    (no-op spans, no contexts minted).  ``overhead_pct`` is the
+    acceptance number — the tracing ISSUE budgets <= 5% here.
+    Best-of-``reps`` per arm, interleaved would fight the scheduler;
+    sequential keeps each arm's cache behavior its own.  ``record=True``
+    appends the result tagged ``bench: "trace-overhead"`` (the derived
+    reconcile floor skips tagged entries)."""
+    from aws_global_accelerator_controller_tpu import tracing
+
+    def best(enabled: bool) -> dict:
+        tracing.set_enabled(enabled)
+        try:
+            runs = [bench_reconcile(n_services, workers)
+                    for _ in range(reps)]
+        finally:
+            tracing.set_enabled(True)
+        return max(runs, key=lambda r: r["throughput"])
+
+    on = best(True)
+    off = best(False)
+    overhead = (1.0 - on["throughput"] / off["throughput"]) * 100.0
+    out = {
+        "services": n_services,
+        "workers": workers,
+        "reps": reps,
+        "throughput_on": round(on["throughput"], 1),
+        "throughput_off": round(off["throughput"], 1),
+        # negative = tracing measured FASTER than disabled (pure
+        # scheduler noise; the honest reading is "within noise")
+        "overhead_pct": round(overhead, 2),
+    }
+    if record:
+        _record_reconcile_history(
+            on, bench="trace-overhead",
+            extra={"throughput_off": out["throughput_off"],
+                   "overhead_pct": out["overhead_pct"]})
+    return out
 
 
 def bench_restart_recovery(n_services: int = 1000, workers: int = 4,
@@ -978,6 +1035,16 @@ def bench_mixed_soak(n_services: int = 1000, workers: int = 6,
         "sweep_verifies": round(sweeps),
         "fastpath_skips": round(skips),
     }
+    if not out["slo_ok"]:
+        # a breached SLO is a flight-recorder trigger (flight.py):
+        # freeze the span ring / ledger / chaos decisions that
+        # produced the fat tail while they are still in the rings
+        # (no-op unless armed; the leg runs with the default recorder)
+        from aws_global_accelerator_controller_tpu import flight
+
+        flight.trigger(flight.TRIGGER_SLO_BREACH,
+                       f"mixed-soak p99/p50="
+                       f"{interactive['p99_over_p50']}")
     if record:
         _record_reconcile_history(
             out, bench="mixed-soak",
@@ -2452,8 +2519,139 @@ def bench_planner_subprocess(timeout: float = 180.0,
     return out if out is not None else _diag_with_rung(diag)
 
 
+def _fleet_live_sweep_leg(n_bindings: int = 64, workers: int = 4,
+                          resync: float = 0.4, sweep_every: int = 2,
+                          waves: int = 5) -> dict:
+    """A LIVE sweep-tier segment for the fleet-plan leg: converge
+    ``n_bindings`` (one endpoint group each), then idle through sweep
+    waves so the FleetSweepPlanner answers them in columnar passes —
+    and report the per-stage p50/p99 attribution the convergence
+    ledger (tracing.py) assembled for those sweep journeys, plus the
+    fleet-sweep verdict counts.  This is the stage-attribution story
+    for the planner IN the controller, next to the microbench's raw
+    EG/s."""
+    sys.path.insert(0, "tests")
+    from harness import Cluster, wait_until
+
+    from aws_global_accelerator_controller_tpu import metrics
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (  # noqa: E501
+        EndpointGroupBinding,
+        EndpointGroupBindingSpec,
+        ServiceReference,
+    )
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (  # noqa: E501
+        PortRange,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintConfig,
+    )
+    from aws_global_accelerator_controller_tpu.tracing import (
+        default_ledger,
+    )
+
+    reg = metrics.default_registry
+    region = "eu-west-1"
+    cluster = Cluster(workers=workers, queue_qps=10000.0,
+                      queue_burst=10000, resync_period=resync,
+                      fingerprints=FingerprintConfig(
+                          sweep_every=sweep_every)).start()
+    try:
+        ga = cluster.cloud.ga
+        lbs = []
+        arns = []
+        for i in range(n_bindings):
+            name = f"fp{i:04d}"
+            hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                        ".amazonaws.com")
+            lb = cluster.cloud.elb.register_load_balancer(
+                name, hostname, region)
+            lbs.append(lb)
+            acc = ga.create_accelerator(f"fp-ext{i}", "IPV4", True, {})
+            listener = ga.create_listener(
+                acc.accelerator_arn, [PortRange(80, 80)], "TCP",
+                "NONE")
+            eg = ga.create_endpoint_group(
+                listener.listener_arn, region,
+                lb.load_balancer_arn, False)
+            arns.append(eg.endpoint_group_arn)
+            cluster.kube.services.create(Service(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                    }),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                    ingress=[LoadBalancerIngress(hostname=hostname)])),
+            ))
+            cluster.operator.endpoint_group_bindings.create(
+                EndpointGroupBinding(
+                    metadata=ObjectMeta(name=name,
+                                        namespace="default"),
+                    spec=EndpointGroupBindingSpec(
+                        endpoint_group_arn=eg.endpoint_group_arn,
+                        weight=32,
+                        service_ref=ServiceReference(name=name))))
+
+        def weights_landed():
+            for i, lb in enumerate(lbs):
+                got = ga.describe_endpoint_group(arns[i])
+                weights = {d.endpoint_id: d.weight
+                           for d in got.endpoint_descriptions}
+                if weights.get(lb.load_balancer_arn) != 32:
+                    return False
+            return True
+
+        wait_until(weights_landed, timeout=300.0, interval=0.05,
+                   message=f"{n_bindings} bindings converged")
+        # the sweep tier only engages over WARM fingerprints: open the
+        # measurement window once resync re-deliveries are provably
+        # being answered by the gate (skips flowing), not mid-churn
+        skips_before = reg.counter_value(
+            "reconcile_fastpath_skips_total",
+            {"controller": "EndpointGroupBinding"})
+        wait_until(
+            lambda: reg.counter_value(
+                "reconcile_fastpath_skips_total",
+                {"controller": "EndpointGroupBinding"}) > skips_before,
+            timeout=60.0,
+            message="binding fingerprints warm (skips flowing)")
+
+        default_ledger.clear()
+        verdicts_before = reg.counter_value(
+            "fleet_sweep_verdicts_total")
+        time.sleep(waves * resync * sweep_every)
+        verdicts = reg.counter_value("fleet_sweep_verdicts_total") \
+            - verdicts_before
+        attribution = default_ledger.percentiles(
+            "EndpointGroupBinding")
+    finally:
+        cluster.shutdown()
+    return {
+        "bindings": n_bindings,
+        "waves": waves,
+        "sweep_every": sweep_every,
+        "fleet_sweep_verdicts": round(verdicts),
+        "stage_attribution": attribution,
+    }
+
+
 def bench_fleet_plan(groups: int = 16384, endpoints_cap: int = 16,
                      shards: int = 8, n: int = 8,
+                     live_sweep: bool = False,
                      record: bool = False) -> dict:
     """Whole-fleet columnar planner throughput: endpoint-groups planned
     per second through ONE accelerator pass — packed-row model scoring
@@ -2611,14 +2809,21 @@ def bench_fleet_plan(groups: int = 16384, endpoints_cap: int = 16,
         "scalar_egs_per_s": round(1.0 / scalar_s, 1),
         "speedup_vs_scalar": round(egs_per_s * scalar_s, 1),
     }
+    if live_sweep:
+        # the in-controller segment: sweep waves answered by the
+        # planner, with per-stage ledger attribution (tracing.py)
+        out["live_sweep"] = _fleet_live_sweep_leg()
+        out["stage_attribution"] = \
+            out["live_sweep"]["stage_attribution"]
     if record:
         _record_fleet_plan_history(out)
     return out
 
 
 def bench_fleet_plan_recorded() -> dict:
-    """The named-leg entry: run + append the tagged history record."""
-    return bench_fleet_plan(record=True)
+    """The named-leg entry: run + append the tagged history record
+    (with the live sweep segment's stage attribution)."""
+    return bench_fleet_plan(live_sweep=True, record=True)
 
 
 def _record_fleet_plan_history(result: dict) -> None:
@@ -2635,7 +2840,8 @@ def _record_fleet_plan_history(result: dict) -> None:
                ("rung", "backend", "layout", "groups",
                 "endpoints_cap", "mean_occupancy", "shards",
                 "egs_per_s", "plan_ms", "scalar_egs_per_s",
-                "speedup_vs_scalar") if result.get(k) is not None},
+                "speedup_vs_scalar", "stage_attribution")
+               if result.get(k) is not None},
         }
         with open(_HISTORY_PATH, "a") as f:
             f.write(json.dumps(entry) + "\n")
@@ -3164,6 +3370,7 @@ _NAMED = {
     "resilience-overhead": bench_resilience_overhead,
     "batch-efficiency": lambda: bench_batch_efficiency(record=True),
     "steady-state": lambda: bench_steady_state(record=True),
+    "trace-overhead": lambda: bench_trace_overhead(record=True),
     "restart-recovery": lambda: bench_restart_recovery(record=True),
     "shard-scaling": lambda: bench_shard_scaling(record=True),
     "mixed-soak": lambda: bench_mixed_soak(record=True),

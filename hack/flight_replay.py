@@ -1,0 +1,167 @@
+#!/usr/bin/env python3
+"""Render a flight-recorder dump (flight.py) as a per-key timeline
+and/or Chrome trace-event JSON.
+
+Usage:
+    python hack/flight_replay.py DUMP.json            # timeline to stdout
+    python hack/flight_replay.py DUMP.json --chrome OUT.json
+    python hack/flight_replay.py DUMP.json --key default/svc-1
+
+The timeline groups the frozen span ring by trace id, joins each trace
+to its convergence-ledger record (stage breakdown: queued / planned /
+coalesced / inflight / baked), and prints one indented tree per traced
+key — chaos injections and span errors annotated inline.  The
+``--chrome`` export uses the same trace-event serializer as the
+``/traces?format=chrome`` endpoint (tracing.to_chrome_events); load it
+in chrome://tracing or https://ui.perfetto.dev.
+
+Exit codes: 0 rendered, 2 unreadable/non-dump input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from aws_global_accelerator_controller_tpu.tracing import (  # noqa: E402
+    to_chrome_events,
+)
+
+
+def load_dump(path: str) -> dict:
+    try:
+        with open(path) as f:
+            dump = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot read dump {path!r}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    if not isinstance(dump, dict) or "spans" not in dump:
+        print(f"error: {path!r} is not a flight-recorder dump "
+              "(no 'spans')", file=sys.stderr)
+        raise SystemExit(2)
+    return dump
+
+
+def _span_line(span: dict, t0: float) -> str:
+    off = span.get("start_wall", t0) - t0
+    dur = span.get("duration_s", 0.0)
+    bits = [f"+{off:8.4f}s", f"{dur * 1000:8.3f}ms",
+            f"tid={span.get('tid', 0)}", span.get("name", "?")]
+    attrs = span.get("attributes", {})
+    for k in ("key", "queue", "kind", "group", "outcome", "rung",
+              "cohort"):
+        if k in attrs:
+            bits.append(f"{k}={attrs[k]}")
+    if attrs.get("chaos"):
+        bits.append(f"chaos={attrs['chaos']}")
+    if span.get("links"):
+        bits.append(f"links={span['links']}")
+    if span.get("error"):
+        bits.append(f"ERROR({span['error']})")
+    return "  ".join(str(b) for b in bits)
+
+
+def render_timeline(dump: dict, only_key: str | None = None) -> str:
+    spans = dump.get("spans", [])
+    ledger = dump.get("ledger", [])
+    by_trace: "defaultdict[int, list]" = defaultdict(list)
+    for s in spans:
+        by_trace[s.get("trace_id", 0)].append(s)
+        # a span linking other traces (flush cohorts, folds) appears
+        # in every linked trace's lane too: the walk follows links
+        for t in s.get("links", []):
+            if t != s.get("trace_id"):
+                by_trace[t].append(s)
+    records = [r for r in ledger
+               if only_key is None or r.get("key") == only_key]
+    out = [f"flight dump: reason={dump.get('reason')} "
+           f"detail={dump.get('detail')!r} pid={dump.get('pid')}"]
+    seen_traces = set()
+    for rec in records:
+        tid = rec.get("trace_id")
+        seen_traces.add(tid)
+        stages = rec.get("stages", {})
+        stage_bits = "  ".join(
+            f"{name}={stages[name] * 1000:.3f}ms"
+            for name in ("queued", "planned", "coalesced", "inflight",
+                         "baked") if name in stages)
+        extra = {k: v for k, v in stages.items()
+                 if k not in ("queued", "planned", "coalesced",
+                              "inflight", "baked")}
+        if extra:
+            stage_bits += "  " + "  ".join(
+                f"{k}={v * 1000:.3f}ms" for k, v in sorted(extra.items()))
+        out.append("")
+        out.append(f"key {rec.get('key')}  trace={tid} "
+                   f"origin={rec.get('origin')} "
+                   f"total={rec.get('total_s', 0) * 1000:.3f}ms")
+        out.append(f"  stages: {stage_bits or '(none)'}")
+        if rec.get("links"):
+            out.append(f"  folded traces: {rec['links']}")
+        trace_spans = sorted(by_trace.get(tid, []),
+                             key=lambda s: s.get("start_wall", 0.0))
+        if trace_spans:
+            t0 = trace_spans[0].get("start_wall", 0.0)
+            for s in trace_spans:
+                out.append("    " + _span_line(s, t0))
+    if only_key is None:
+        # traces with spans but no ledger record (still in flight when
+        # the box froze) — the stall you're probably looking for
+        leftovers = sorted(t for t in by_trace
+                           if t not in seen_traces and t)
+        if leftovers:
+            out.append("")
+            out.append(f"unconverged traces at freeze: "
+                       f"{len(leftovers)}")
+            for tid in leftovers[:10]:
+                trace_spans = sorted(by_trace[tid],
+                                     key=lambda s: s.get("start_wall",
+                                                         0.0))
+                names = [s.get("name") for s in trace_spans]
+                out.append(f"  trace={tid}: {len(trace_spans)} spans "
+                           f"({', '.join(names[:6])}"
+                           f"{'...' if len(names) > 6 else ''})")
+    chaos = dump.get("chaos", {})
+    for source, decisions in sorted(chaos.items()):
+        out.append("")
+        out.append(f"chaos[{source}]: {len(decisions)} injected "
+                   f"decisions")
+        for d in decisions[-8:]:
+            out.append(f"  {d}")
+    delta = dump.get("metrics_delta", {})
+    if delta:
+        out.append("")
+        out.append(f"metrics delta since arm ({len(delta)} series, "
+                   "top 15 by magnitude):")
+        top = sorted(delta.items(), key=lambda kv: -abs(kv[1]))[:15]
+        for name, v in top:
+            out.append(f"  {name} {v:+g}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dump", help="flight-recorder JSON dump")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="also write Chrome trace-event JSON here")
+    ap.add_argument("--key", help="restrict the timeline to one "
+                    "object key")
+    args = ap.parse_args(argv)
+    dump = load_dump(args.dump)
+    print(render_timeline(dump, only_key=args.key))
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump({"traceEvents": to_chrome_events(dump["spans"])},
+                      f)
+        print(f"\nchrome trace written to {args.chrome} "
+              "(open in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,291 @@
+"""Causal-tracing chaos e2e (ISSUE 12 acceptance): one trace id
+follows a key from watch-event to converged — across worker threads, a
+coalescer fold, the flush thread's provider write, and a mid-run shard
+handoff — under 20% AWS chaos; and a triggered flight-recorder dump
+from the same run replays into a per-key timeline naming every stage.
+
+Shape: three bindings share one endpoint group (and one referent
+service), so their weight intents target the SAME endpoint and FOLD in
+the group's coalescer queue whenever one sync's intent is pending
+behind another's slow flush — the one surface where same-identity
+intents from different reconcile keys genuinely collide.  The tracked
+event is fired DURING an ownership gap (its trace deferred by the
+ShardGate), the shard is handed off (seal → release → acquire with a
+bumped fencing token), and the acquire scan re-delivers the key
+CONTINUING the deferred trace.  Which sibling's intent ends up pending
+(and therefore folded onto) is a genuine thread race, so the
+gap/handoff round retries with a fresh tracked event until the fold
+lands on the tracked trace — every round is a full handoff, and the
+winning trace individually satisfies every contract.  All under the
+runtime race detectors, like every e2e.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from aws_global_accelerator_controller_tpu import flight
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.apis.endpointgroupbinding.v1alpha1 import (  # noqa: E501
+    EndpointGroupBinding,
+    EndpointGroupBindingSpec,
+    ServiceReference,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+    PortRange,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+from aws_global_accelerator_controller_tpu.resilience import (
+    ResilienceConfig,
+)
+from aws_global_accelerator_controller_tpu.tracing import (
+    default_ledger,
+    default_tracer,
+)
+
+from harness import Cluster, wait_until
+
+SEED = 9021
+REGION = "eu-central-1"
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# tolerant breaker: 20% injected errors must retry, not trip a 0.3s
+# open loop in the middle of the fold window
+CHAOS_CONFIG = ResilienceConfig(
+    max_attempts=5, base_delay=0.002, max_delay=0.05, deadline=8.0,
+    breaker_window=2.0, breaker_min_calls=80,
+    breaker_failure_threshold=0.9, breaker_open_seconds=0.2,
+    bucket_capacity=500.0, bucket_refill=5000.0,
+    bucket_min_capacity=5.0, bucket_recover=10.0, seed=SEED)
+
+BINDINGS = ("tr-a", "tr-b", "tr-c")
+TRACKED = "default/tr-a"
+
+
+def nlb_hostname(name):
+    return f"{name}-0123456789abcdef.elb.{REGION}.amazonaws.com"
+
+
+def lb_service(name):
+    return Service(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            annotations={AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external"}),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=nlb_hostname(name))])),
+    )
+
+
+@pytest.fixture
+def cluster(race_detectors):
+    c = Cluster(workers=3, queue_qps=1000.0, queue_burst=1000,
+                resync_period=2.0, num_shards=4,
+                resilience=CHAOS_CONFIG, fault_seed=SEED).start()
+    yield c
+    c.shutdown()
+
+
+def _trace_family(spans, trace_id):
+    """The span-tree walk: spans of the trace plus spans LINKING it
+    (flush cohorts, folds — the cross-trace membership edges)."""
+    return [s for s in spans
+            if s["trace_id"] == trace_id or trace_id in s["links"]]
+
+
+def test_one_trace_id_event_to_converged_across_threads_fold_and_handoff(
+        cluster, tmp_path):
+    faults = cluster.cloud.faults
+    ga = cluster.cloud.ga
+
+    # -- three bindings over ONE endpoint group + referent service -----
+    lb = cluster.cloud.elb.register_load_balancer(
+        "tr-svc", nlb_hostname("tr-svc"), REGION)
+    acc = ga.create_accelerator("tr-ext", "IPV4", True, {})
+    listener = ga.create_listener(
+        acc.accelerator_arn, [PortRange(80, 80)], "TCP", "NONE")
+    seed_lb = cluster.cloud.elb.register_load_balancer(
+        "tr-seed", nlb_hostname("tr-seed"), "eu-west-1")
+    eg = ga.create_endpoint_group(
+        listener.listener_arn, "eu-west-1",
+        seed_lb.load_balancer_arn, False)
+    arn = eg.endpoint_group_arn
+
+    cluster.kube.services.create(lb_service("tr-svc"))
+    for name in BINDINGS:
+        cluster.operator.endpoint_group_bindings.create(
+            EndpointGroupBinding(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                spec=EndpointGroupBindingSpec(
+                    endpoint_group_arn=arn, weight=32,
+                    service_ref=ServiceReference(name="tr-svc"))))
+
+    def endpoint_weight():
+        got = ga.describe_endpoint_group(arn)
+        weights = {d.endpoint_id: d.weight
+                   for d in got.endpoint_descriptions}
+        return weights.get(lb.load_balancer_arn, "absent")
+
+    wait_until(lambda: endpoint_weight() == 32, timeout=30.0,
+               message="bindings converged at weight 32")
+
+    def set_weight(name, w):
+        for _ in range(8):      # status writes race spec updates
+            try:
+                b = cluster.operator.endpoint_group_bindings.get(
+                    "default", name)
+                b.spec.weight = w
+                cluster.operator.endpoint_group_bindings.update(b)
+                return
+            except Exception:
+                time.sleep(0.01)
+        raise AssertionError(f"could not update {name} to weight {w}")
+
+    # -- arm the black box, the chaos and the flush-window latency -----
+    flight.default_recorder.directory = str(tmp_path)
+    flight.default_recorder.cooldown = 0.0
+    flight.default_recorder.arm()
+    # a slow endpoint-group WRITE keeps each flush on the wire: a
+    # sibling's intent submitted meanwhile is PENDING, and the next
+    # same-endpoint intent folds onto it
+    faults.set_latency("update_endpoint_group", 0.4)
+    faults.set_error_rate("*", 0.20)          # the 20% AWS chaos
+
+    shards = cluster.factory.shards
+    sid = shards.shard_of(arn)                # all three route here
+
+    def fold_linking(trace_id):
+        return [s for s in default_tracer.recent(limit=0)
+                if s["name"] == "fold"
+                and (s["trace_id"] == trace_id
+                     or trace_id in s["links"])]
+
+    # -- gap → handoff → fold rounds: which sibling's intent sits
+    # pending (and gets folded onto) is a real thread race, so each
+    # round stakes a fresh tracked event on it; every round is a full
+    # seal → release → acquire handoff
+    T = None
+    w = 32
+    try:
+        for _ in range(10):
+            w += 1
+            fence = shards.fence(sid)
+            fence.trip("handoff")
+            fence.seal("handoff")
+            shards.release(sid)           # gate defers events for sid
+
+            before = {s["span_id"]
+                      for s in default_tracer.recent(limit=0)
+                      if s["name"] == "origin.event"
+                      and s["attributes"].get("key") == TRACKED}
+            # both gap events defer; on acquire their syncs race to
+            # submit the same-endpoint weight op
+            set_weight("tr-b", w)
+            set_weight("tr-a", w)         # THE tracked event
+
+            def gap_origin():
+                return [s for s in default_tracer.recent(limit=0)
+                        if s["name"] == "origin.event"
+                        and s["attributes"].get("key") == TRACKED
+                        and s["span_id"] not in before]
+
+            # the informer dispatches the event (and mints the trace)
+            # asynchronously on its own thread
+            wait_until(lambda: gap_origin(), timeout=10.0,
+                       message="tracked event's origin span minted")
+            T = gap_origin()[0]["trace_id"]
+
+            shards.acquire(sid, token=shards.token(sid) + 1)
+
+            # churn the third sibling at the SAME weight: its submits
+            # fold onto whichever sibling's intent is pending
+            round_end = time.monotonic() + 4.0
+            while time.monotonic() < round_end and not fold_linking(T):
+                set_weight("tr-c", w)
+                time.sleep(0.12)
+            if fold_linking(T):
+                break
+        else:
+            pytest.fail("no fold ever linked a tracked trace "
+                        "(10 handoff rounds)")
+
+        faults.set_latency("update_endpoint_group", 0.0)
+        wait_until(lambda: endpoint_weight() == w, timeout=30.0,
+                   message="fleet reconverged at the final weight")
+        wait_until(
+            lambda: any(r["trace_id"] == T
+                        for r in default_ledger.snapshot(key=TRACKED,
+                                                         limit=0)),
+            timeout=30.0,
+            message="tracked trace reached the convergence ledger")
+    finally:
+        faults.set_error_rate("*", 0.0)
+        faults.set_latency("update_endpoint_group", 0.0)
+
+    # -- walk the span tree: one trace id covers the whole journey -----
+    spans = default_tracer.recent(limit=0)
+    family = _trace_family(spans, T)
+    names = {s["name"] for s in family}
+    assert "origin.event" in names           # event
+    assert "reconcile" in names              # claimed by a worker
+    assert "fold" in names                   # coalesce(fold)
+    flushes = [s for s in family if s["name"] == "flush"]
+    assert flushes, "no flush span served the tracked trace"
+    flush_ids = {s["span_id"] for s in flushes}
+    aws_children = [s for s in spans
+                    if s["name"] == "aws.update_endpoint_group"
+                    and s["parent_id"] in flush_ids]
+    assert aws_children, "no provider-write child under the flush span"
+
+    # ...across >= 2 OS threads (the informer handler thread minted
+    # the origin; a worker ran the reconcile; the flush leader wrote)
+    tids = {s["tid"] for s in family}
+    assert len(tids) >= 2, f"trace stayed on one thread: {tids}"
+
+    # ...and across the shard handoff: the deferred event's trace was
+    # re-delivered by the successor term, converging with stage
+    # attribution assembled from the SAME trace id
+    rec = [r for r in default_ledger.snapshot(key=TRACKED, limit=0)
+           if r["trace_id"] == T][0]
+    for stage in ("queued", "planned"):
+        assert stage in rec["stages"], \
+            f"stage {stage!r} missing from ledger record: {rec}"
+    assert "shard-replay" in rec["stages"], \
+        "the handoff hop is missing — the trace did not cross it"
+
+    # chaos stamped the spans it hit (20% over this many calls)
+    assert any(s["attributes"].get("chaos") for s in spans), \
+        "no chaos injection was stamped into any span"
+
+    # -- the flight recorder dump replays into a stage-named timeline --
+    dump_path = flight.default_recorder.trigger("test_hook", "chaos-e2e")
+    assert dump_path is not None
+    flight.default_recorder.disarm()
+    dump = json.load(open(dump_path))
+    assert dump["chaos"].get("aws"), \
+        "the seeded chaos decision log is missing from the dump"
+    proc = subprocess.run(
+        [sys.executable, os.path.join("hack", "flight_replay.py"),
+         dump_path, "--key", TRACKED],
+        capture_output=True, text=True, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr
+    assert TRACKED in proc.stdout
+    for stage in ("queued", "planned", "coalesced", "inflight",
+                  "baked"):
+        assert f"{stage}=" in proc.stdout, \
+            f"replay timeline does not name stage {stage!r}"

@@ -1,19 +1,20 @@
 """L109 fixture (clean): class-tagged enqueues, requeues keeping
 their class, and non-queue ``.add`` calls (a set) that must not
-false-positive."""
+false-positive.  Enqueues carry ``ctx=`` too, so the fixture stays
+clean under the trace-propagation rule L114 as well."""
 
 CLASS_INTERACTIVE = "interactive"
 CLASS_KEEP = "keep"
 
 
-def event_handlers(queue, key):
-    queue.add(key, klass=CLASS_INTERACTIVE)
-    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE)
+def event_handlers(queue, key, ctx):
+    queue.add(key, klass=CLASS_INTERACTIVE, ctx=ctx)
+    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE, ctx=ctx)
 
 
-def requeue(service_queue, key, hint):
-    service_queue.add_after(key, hint, klass=CLASS_KEEP)
-    service_queue.add_rate_limited(key, klass=CLASS_KEEP)
+def requeue(service_queue, key, hint, ctx):
+    service_queue.add_after(key, hint, klass=CLASS_KEEP, ctx=ctx)
+    service_queue.add_rate_limited(key, klass=CLASS_KEEP, ctx=ctx)
 
 
 def bookkeeping(seen, key):

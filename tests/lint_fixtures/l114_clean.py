@@ -1,0 +1,21 @@
+"""L114 fixture (clean): every enqueue propagates its TraceContext —
+minted at the event boundary, continued on requeues, explicit
+``ctx=None`` where a path is genuinely untraced (the explicitness IS
+the contract: no call site silently drops a trace)."""
+
+CLASS_INTERACTIVE = "interactive"
+CLASS_KEEP = "keep"
+
+
+def event_handler(queue, key, tracing):
+    ctx = tracing.new_context("event", key=key)
+    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE, ctx=ctx)
+
+
+def requeue(service_queue, key, hint, ctx):
+    ctx.hop("requeue")
+    service_queue.add_after(key, hint, klass=CLASS_KEEP, ctx=ctx)
+
+
+def untraced_on_purpose(queue, key):
+    queue.add(key, klass=CLASS_KEEP, ctx=None)

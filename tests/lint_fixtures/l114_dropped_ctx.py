@@ -1,0 +1,22 @@
+"""L114 fixture: enqueues that DROP the trace context — a workqueue
+item constructed without ``ctx=`` severs the event→converged trace at
+exactly the hand-off boundary the causal-tracing layer exists to
+cross (tracing.py; kube/workqueue.py sidecar).  The class tags are
+present, so these fire L114 alone; the deliberate untraced enqueue at
+the bottom is waived."""
+
+CLASS_INTERACTIVE = "interactive"
+CLASS_KEEP = "keep"
+
+
+def event_handler(queue, key):
+    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE)
+
+
+def requeue(service_queue, key, hint):
+    service_queue.add_after(key, hint, klass=CLASS_KEEP)
+    service_queue.add(key, klass=CLASS_KEEP)
+
+
+def deliberate(queue, key):
+    queue.add(key, klass=CLASS_KEEP)  # race: test-only drain helper, no trace

@@ -191,6 +191,45 @@ def test_bench_steady_state_smoke(monkeypatch, tmp_path):
     assert entries[-1]["bench"] == "steady-state"
     assert "read_reduction" in entries[-1]
     assert "fastpath_skips_per_wave" in entries[-1]
+    # per-stage attribution from the convergence ledger rides along
+    assert "stage_attribution" in entries[-1]
+
+
+def test_bench_trace_overhead_smoke(monkeypatch, tmp_path):
+    """Small-N A/B of the tracing layer on the create storm: both
+    arms run, the overhead number is computed, and the tagged history
+    record lands (reconcile_floor skips it)."""
+    path = tmp_path / "hist.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(path))
+    out = bench.bench_trace_overhead(n_services=20, workers=2, reps=1,
+                                     record=True)
+    assert out["throughput_on"] > 0 and out["throughput_off"] > 0
+    assert isinstance(out["overhead_pct"], float)
+    # tracing must be back ON after the disabled arm (the kill switch
+    # is scoped to the measurement, never leaked to the session)
+    from aws_global_accelerator_controller_tpu import tracing
+    assert tracing.enabled()
+    entries = [json.loads(line) for line in path.read_text().splitlines()]
+    assert entries[-1]["bench"] == "trace-overhead"
+    assert "overhead_pct" in entries[-1]
+
+
+def test_bench_fleet_live_sweep_smoke():
+    """Small-N live sweep segment of the fleet-plan leg: bindings
+    converge, sweep waves are answered by the whole-fleet planner, and
+    the convergence ledger attributes the sweep journeys per stage."""
+    # window must span several sweep slots: the per-key crc32 spread
+    # plus the first post-warm wave mean short windows see no sweeps
+    out = bench._fleet_live_sweep_leg(n_bindings=6, workers=2,
+                                      resync=0.25, sweep_every=2,
+                                      waves=8)
+    assert out["bindings"] == 6
+    assert out["fleet_sweep_verdicts"] > 0, \
+        "the fleet planner never answered a sweep"
+    att = out["stage_attribution"]
+    assert att.get("total", {}).get("count", 0) > 0, \
+        "no sweep journey reached the convergence ledger"
+    assert "queued" in att
 
 
 def test_bench_restart_recovery_smoke(monkeypatch, tmp_path):
@@ -336,6 +375,8 @@ def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
             {"throughput": 110.0, "bench": "shard-scaling"},
             {"throughput": 55.0, "bench": "rollout-ramp"},
             {"throughput": 60.0, "bench": "rollout-ramp"},
+            {"throughput": 180.0, "bench": "trace-overhead",
+             "overhead_pct": 1.2},
             # the fleet-plan leg has no "throughput" at all (EG/s, a
             # different unit entirely) — the tag skip must drop it
             # before the floor derivation ever reads fields

@@ -1,0 +1,131 @@
+"""Flight recorder (flight.py) + replay tool (hack/flight_replay.py):
+the black box freezes spans + ledger + metrics delta + chaos decision
+logs into one correlated dump, debounced, fail-open, bounded."""
+import json
+import os
+import subprocess
+import sys
+
+from aws_global_accelerator_controller_tpu.flight import FlightRecorder
+from aws_global_accelerator_controller_tpu.metrics import Registry
+from aws_global_accelerator_controller_tpu.tracing import (
+    ConvergenceLedger,
+    TraceContext,
+    Tracer,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _recorder(tmp_path):
+    tr = Tracer()
+    ledger = ConvergenceLedger()
+    reg = Registry()
+    rec = FlightRecorder(directory=str(tmp_path), cooldown=30.0,
+                         tracer=tr, ledger=ledger, registry=reg)
+    return rec, tr, ledger, reg
+
+
+def _converged_ctx(tr, key="default/svc"):
+    ctx = TraceContext(trace_id=123, origin="event", parent_span_id=123)
+    t = 50.0
+    for i, stage in enumerate(("event", "queued", "claimed", "planned",
+                               "inflight", "flushed", "converged")):
+        ctx.hop(stage, now=t + i * 0.002, wall=t + i * 0.002)
+    return ctx
+
+
+def test_trigger_dumps_correlated_black_box(tmp_path):
+    rec, tr, ledger, reg = _recorder(tmp_path)
+    reg.inc_counter("some_total", {"a": "b"}, 3.0)
+    rec.arm()
+    # activity after arming: the delta must show exactly this
+    reg.inc_counter("some_total", {"a": "b"}, 2.0)
+    with tr.span("reconcile", key="default/svc") as s:
+        s.attributes["outcome"] = "success"
+    ledger.record("q", "default/svc", _converged_ctx(tr), registry=reg)
+    rec.add_chaos_source("aws", lambda: [
+        {"method": "create_accelerator", "index": 4, "code": "Boom"}])
+    path = rec.trigger("test_hook", "unit")
+    assert path is not None and os.path.exists(path)
+    dump = json.load(open(path))
+    assert dump["reason"] == "test_hook"
+    assert any(sp["name"] == "reconcile" for sp in dump["spans"])
+    assert dump["ledger"][0]["key"] == "default/svc"
+    assert dump["metrics_delta"]['some_total{a="b"}'] == 2.0
+    assert dump["chaos"]["aws"][0]["code"] == "Boom"
+    # debounce: same reason inside the cooldown returns None
+    assert rec.trigger("test_hook", "again") is None
+    # ...but a different reason dumps
+    assert rec.trigger("other", "x") is not None
+
+
+def test_disarmed_recorder_is_a_noop(tmp_path):
+    rec, tr, ledger, reg = _recorder(tmp_path)
+    assert rec.trigger("anything") is None
+    assert os.listdir(tmp_path) == []
+
+
+def test_arm_prunes_old_dumps(tmp_path):
+    rec, tr, ledger, reg = _recorder(tmp_path)
+    rec.cooldown = 0.0
+    rec.arm()
+    for i in range(6):
+        assert rec.trigger(f"r{i}") is not None
+    from aws_global_accelerator_controller_tpu import flight
+
+    old_keep = flight.KEEP_DUMPS
+    flight.KEEP_DUMPS = 3
+    try:
+        rec.arm()
+    finally:
+        flight.KEEP_DUMPS = old_keep
+    left = [f for f in os.listdir(tmp_path) if f.startswith("flight_")]
+    assert len(left) == 3
+
+
+def test_flight_replay_renders_timeline_and_chrome(tmp_path):
+    """The dump replays via hack/flight_replay.py into a per-key
+    timeline naming every stage, and exports Chrome trace events."""
+    rec, tr, ledger, reg = _recorder(tmp_path)
+    rec.arm()
+    with tr.span("origin.event", key="default/svc"):
+        pass
+    with tr.span("reconcile", key="default/svc", queue="q") as s:
+        s.trace_id = 123
+        with tr.span("aws.create_accelerator") as child:
+            child.attributes["chaos"] = ["create_accelerator:Boom"]
+    ledger.record("q", "default/svc", _converged_ctx(tr), registry=reg)
+    rec.add_chaos_source("aws", lambda: [
+        {"method": "create_accelerator", "index": 1, "code": "Boom"}])
+    path = rec.trigger("slo_breach", "bench-leg")
+    chrome_out = str(tmp_path / "chrome.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join("hack", "flight_replay.py"),
+         path, "--chrome", chrome_out],
+        capture_output=True, text=True, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "default/svc" in out and "trace=123" in out
+    for stage in ("queued", "planned", "coalesced", "inflight",
+                  "baked"):
+        assert f"{stage}=" in out, f"stage {stage} missing in timeline"
+    assert "chaos[aws]" in out
+    events = json.load(open(chrome_out))["traceEvents"]
+    assert any(e["name"] == "aws.create_accelerator" for e in events)
+
+
+def test_flight_replay_rejects_non_dump_input(tmp_path):
+    bad = tmp_path / "not_a_dump.json"
+    bad.write_text("[1, 2, 3]")
+    proc = subprocess.run(
+        [sys.executable, os.path.join("hack", "flight_replay.py"),
+         str(bad)],
+        capture_output=True, text=True, cwd=ROOT)
+    assert proc.returncode == 2
+    missing = tmp_path / "missing.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join("hack", "flight_replay.py"),
+         str(missing)],
+        capture_output=True, text=True, cwd=ROOT)
+    assert proc.returncode == 2

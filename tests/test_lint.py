@@ -441,23 +441,22 @@ def test_l109_controller_packages_clean_under_own_rule():
 
 def test_l109_seeded_raw_enqueue_in_shipped_controller_caught(tmp_path):
     """Acceptance probe tied to the shipped code shape: strip the
-    klass= tag from the REAL GA service add-handler's enqueue and the
-    gate must fire."""
-    ga_py = pathlib.Path(ROOT_DIR) / (
-        "aws_global_accelerator_controller_tpu/controller/"
-        "globalaccelerator.py")
-    src = ga_py.read_text()
-    needle = ("            self.service_queue.add_rate_limited(\n"
-              "                svc.key(), klass=CLASS_INTERACTIVE)")
+    klass= tag from the REAL shared event-enqueue helper (base.py
+    ``event_enqueue`` — every controller handler routes through it)
+    and the gate must fire."""
+    base_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/controller/base.py")
+    src = base_py.read_text()
+    needle = ("    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE,"
+              " ctx=ctx)")
     assert src.count(needle) >= 1, \
-        "GA service enqueue shape changed; update this probe"
+        "shared event-enqueue shape changed; update this probe"
     mutated = src.replace(
-        needle, "            self.service_queue.add_rate_limited("
-                "svc.key())")
+        needle, "    queue.add_rate_limited(key, ctx=ctx)", 1)
     pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
                / "controller")
     pkg_dir.mkdir(parents=True)
-    f = pkg_dir / "globalaccelerator.py"
+    f = pkg_dir / "base.py"
     f.write_text(mutated)
     findings = [x for x in concurrency_lint.lint_files([f])
                 if x.code == "L109"]
@@ -517,15 +516,15 @@ def test_l108_seeded_fence_strip_from_wrapper_caught(tmp_path):
     wrapper_py = pathlib.Path(ROOT_DIR) / (
         "aws_global_accelerator_controller_tpu/resilience/wrapper.py")
     src = wrapper_py.read_text()
-    needle = ("            if op in MUTATION_METHODS:\n"
-              "                if self.fence is not None:\n"
-              "                    self.fence.check(\"wrapper\")\n"
-              "                for extra_fence in "
+    needle = ("                if op in MUTATION_METHODS:\n"
+              "                    if self.fence is not None:\n"
+              "                        self.fence.check(\"wrapper\")\n"
+              "                    for extra_fence in "
               "active_write_fences():\n"
-              "                    extra_fence.check(\"wrapper\")\n")
+              "                        extra_fence.check(\"wrapper\")\n")
     assert src.count(needle) == 1, \
         "ResilientAPIs.invoke fence-gate shape changed; update this probe"
-    mutated = src.replace(needle, "            pass\n")
+    mutated = src.replace(needle, "                pass\n")
     pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
                / "resilience")
     pkg_dir.mkdir(parents=True)
@@ -768,3 +767,89 @@ def test_l113_seeded_apis_graft_into_packing_caught(tmp_path):
                 if x.code == "L113" and "provider call" in x.msg]
     assert findings, "a grafted apis reach in the shipped packing " \
                      "layer was not caught"
+
+
+# -- L114: trace-context propagation on the enqueue surface ------------
+
+
+def test_l114_dropped_ctx_fires_and_waiver_suppresses():
+    """Enqueues without ctx= from controller/reconcile-scoped code
+    fire L114 (the class tags are present, so L114 fires ALONE); the
+    ``# race:`` waiver suppresses the deliberate untraced enqueue."""
+    got = _cfindings("l114_dropped_ctx.py")
+    assert [(c, l) for c, l in got if c == "L114"] == [
+        ("L114", 13), ("L114", 17), ("L114", 18)]
+    assert not [c for c, _ in got if c == "L109"], \
+        "fixture should be class-tagged (L114 must fire alone)"
+
+
+def test_l114_propagating_enqueues_clean():
+    """ctx= propagation — minted, continued, or an explicit
+    ctx=None — is clean under L114."""
+    assert _cfindings("l114_clean.py") == []
+
+
+def test_l114_controller_packages_clean_under_own_rule():
+    """Every shipped enqueue site (controller/ + reconcile/) must
+    propagate a TraceContext under its own rule."""
+    for rel in ("aws_global_accelerator_controller_tpu/controller",
+                "aws_global_accelerator_controller_tpu/reconcile"):
+        pkg = pathlib.Path(ROOT_DIR) / rel
+        files = sorted(pkg.glob("*.py"))
+        assert files, f"{rel} files not found"
+        assert [x for x in concurrency_lint.lint_files(files)
+                if x.code == "L114"] == []
+
+
+def test_l114_seeded_ctx_strip_in_shipped_enqueue_caught(tmp_path):
+    """Acceptance probe tied to the shipped code shape: strip the
+    ctx= propagation from the REAL shared event-enqueue helper
+    (base.py) and the gate must fire."""
+    base_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/controller/base.py")
+    src = base_py.read_text()
+    needle = ("    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE,"
+              " ctx=ctx)")
+    assert src.count(needle) >= 1, \
+        "shared event-enqueue shape changed; update this probe"
+    mutated = src.replace(
+        needle,
+        "    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE)", 1)
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "controller")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "base.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L114"]
+    assert findings, "a trace-dropping shipped enqueue was not caught"
+
+
+def test_l114_seeded_ambient_capture_strip_in_batcher_caught(tmp_path):
+    """The runtime-gate half: strip the ambient_context() capture from
+    the REAL coalescer submit path and the batcher gate must fire
+    whenever batcher.py is in the linted set."""
+    batcher_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/cloudprovider/aws/"
+        "batcher.py")
+    src = batcher_py.read_text()
+    needle = "        ctx = ambient_context()\n"
+    assert src.count(needle) == 1, \
+        "coalescer submit trace capture shape changed; update probe"
+    mutated = src.replace(needle, "        ctx = None\n", 1)
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "cloudprovider" / "aws")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "batcher.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L114"]
+    assert findings, "a stripped ambient-context capture was not caught"
+
+
+def test_l114_batcher_gate_trusts_shipped_when_absent(tmp_path):
+    """A fixture subset without batcher.py must not fire the
+    coalescer-trace gate (parity with the other module gates)."""
+    findings = [x for x in concurrency_lint.lint_files(
+        [FIXTURES / "l114_clean.py"]) if x.code == "L114"]
+    assert findings == []

@@ -494,3 +494,111 @@ def test_rollout_counters_exposed():
             'reason="circuit"}') in text
     assert ('rollout_rollbacks_total{controller="m-roll",'
             'reason="abort"}') in text
+
+
+# -- metrics hygiene (ISSUE 12 satellite): every recorded name has a
+# -- HELP entry, and render() stays parseable Prometheus text ----------
+
+
+def _fire_every_helper(reg):
+    """Drive EVERY record_*/watch_* helper in metrics.py against
+    ``reg`` with stub arguments derived from parameter names — new
+    helpers are covered automatically, so a metric added without a
+    describe() HELP entry fails the hygiene test below."""
+    import inspect
+
+    from aws_global_accelerator_controller_tpu import metrics as m
+
+    class _StubQueue:
+        name = "stub"
+
+        def __len__(self):
+            return 0
+
+    class _StubShards:
+        num_shards = 1
+
+        def owns(self, sid):
+            return True
+
+    def arg_for(pname):
+        if pname == "registry":
+            return reg
+        if pname == "queue":
+            return _StubQueue()
+        if pname == "shards":
+            return _StubShards()
+        if pname == "fn":
+            return lambda: 0.0
+        if pname in ("seconds", "duration", "value"):
+            return 0.01
+        if pname in ("n", "trace_id"):
+            return 1
+        if pname == "hit":
+            return True
+        return "x"
+
+    fired = []
+    for name, fn in sorted(vars(m).items()):
+        if not (name.startswith("record_") or name.startswith("watch_")):
+            continue
+        if not callable(fn):
+            continue
+        kwargs = {p: arg_for(p)
+                  for p in inspect.signature(fn).parameters}
+        fn(**kwargs)
+        fired.append(name)
+    assert len(fired) >= 30, "helper sweep lost most of metrics.py"
+    return fired
+
+
+def test_every_recorded_metric_has_help_entry():
+    """The hygiene contract: any metric name EVER recorded through a
+    metrics.py helper must carry a describe() HELP entry in the
+    default registry — an undescribed series is invisible to the
+    operator reading /metrics cold (nothing enforced this before;
+    fleet_sweep_verdicts_total shipped without one)."""
+    from aws_global_accelerator_controller_tpu import metrics as m
+
+    reg = Registry()
+    _fire_every_helper(reg)
+    recorded = reg.recorded_names()
+    helped = m.default_registry.help_names()
+    missing = sorted(recorded - helped)
+    assert not missing, (
+        f"metrics recorded without a describe() HELP entry: {missing}")
+
+
+def test_render_output_parses_as_prometheus_text():
+    """Strict line-level validation of the exposition format over a
+    registry carrying every helper's series (counters, summaries,
+    histograms with exemplar comments, gauges)."""
+    import re
+
+    from aws_global_accelerator_controller_tpu import metrics as m
+
+    reg = Registry()
+    _fire_every_helper(reg)
+    m.record_stage_seconds("inflight", "q", 0.01, trace_id=42,
+                           registry=reg)
+    text = reg.render()
+    assert text.endswith("\n")
+    name_re = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    label_re = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    sample = re.compile(
+        rf"^{name_re}(?:\{{{label_re}(?:,{label_re})*\}})?"
+        rf" [-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|inf|nan)$")
+    helped = re.compile(rf"^# (HELP|TYPE) {name_re}( .*)?$")
+    comment = re.compile(r"^# ")
+    seen_samples = 0
+    for line in text.splitlines():
+        if not line:
+            continue
+        if helped.match(line) or comment.match(line):
+            continue
+        assert sample.match(line), f"unparseable sample line: {line!r}"
+        seen_samples += 1
+    assert seen_samples >= 30
+    # the exemplar rides a comment line, never a sample line
+    assert '# EXEMPLAR stage_seconds' in text
+    assert 'trace_id=42' in text

@@ -178,3 +178,308 @@ def test_traces_endpoint_rejects_bad_limit_and_unknown_paths():
         assert e.value.code == 404
     finally:
         server.shutdown()
+
+
+# -- span-leak regression (a raising provider-call child) ---------------
+
+
+def test_child_span_raise_leaves_stack_clean_and_next_span_nests_right():
+    """A provider-call child span whose body raises must be popped and
+    recorded with ``error`` set — and the NEXT span opened on the same
+    thread must nest under the still-open parent, not under the dead
+    child (the nests-after-raise regression)."""
+    tr = Tracer()
+    with tr.span("parent") as parent:
+        with pytest.raises(RuntimeError):
+            with tr.span("provider.call"):
+                raise RuntimeError("api exploded")
+        assert tr.current() is parent, "stack leaked the dead child"
+        with tr.span("after") as after:
+            assert after.parent_id == parent.span_id
+    spans = {s["name"]: s for s in tr.recent()}
+    assert spans["provider.call"]["error"] == "RuntimeError: api exploded"
+    assert spans["after"]["parent_id"] == spans["parent"]["span_id"]
+    assert tr.current() is None
+
+
+def test_base_exception_still_pops_and_records_error():
+    """Worker teardown (BaseException, not Exception) must also pop
+    AND record the span with its error set — the flight recorder's
+    last spans before a crash are the ones that matter."""
+    tr = Tracer()
+
+    class Teardown(BaseException):
+        pass
+
+    with pytest.raises(Teardown):
+        with tr.span("dying"):
+            raise Teardown("killed")
+    (s,) = tr.recent()
+    assert s["error"] == "Teardown: killed"
+    assert tr.current() is None
+
+
+# -- cross-thread continuation (attach/detach) --------------------------
+
+
+def test_attach_continues_trace_on_another_thread():
+    import threading
+
+    from aws_global_accelerator_controller_tpu.tracing import (
+        new_context,
+    )
+
+    tr = Tracer()
+    ctx = new_context("event", tracer=tr, key="default/x")
+    assert ctx is not None
+    got = {}
+
+    def worker():
+        with tr.attach(ctx):
+            with tr.span("reconcile", key="default/x") as s:
+                got["span"] = s
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    s = got["span"]
+    assert s.trace_id == ctx.trace_id
+    assert s.parent_id == ctx.parent_span_id
+    origin = [x for x in tr.recent() if x["name"] == "origin.event"]
+    assert origin and origin[0]["span_id"] == ctx.parent_span_id
+    # the two spans ran on different OS threads: the continuation
+    # provably crossed a thread
+    tids = {x["tid"] for x in tr.recent()}
+    assert len(tids) == 2
+
+
+def test_attach_detach_concurrent_no_crosstalk(race_detectors):
+    """Two workers concurrently attach/detach the SAME shared context
+    interleaved with their own private traces: no span may end up with
+    another trace's id (the thread-local continuation contract), and
+    fold links must reference every contributing trace id."""
+    import threading
+
+    from aws_global_accelerator_controller_tpu.tracing import (
+        fold_link,
+        new_context,
+    )
+
+    tr = Tracer(capacity=8192)
+    shared = new_context("event", tracer=tr, key="shared")
+    errs = []
+
+    def worker(n):
+        try:
+            for i in range(200):
+                with tr.attach(shared):
+                    with tr.span(f"shared-w{n}") as s:
+                        assert s.trace_id == shared.trace_id
+                own = new_context("event", tracer=tr, key=f"own-{n}-{i}")
+                with tr.attach(own):
+                    with tr.span(f"own-w{n}") as s:
+                        assert s.trace_id == own.trace_id
+                        assert s.trace_id != shared.trace_id
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(2)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs
+    for s in tr.recent(limit=0):
+        if s["name"].startswith("shared-"):
+            assert s["trace_id"] == shared.trace_id
+        elif s["name"].startswith("own-"):
+            assert s["trace_id"] != shared.trace_id
+    # fold links: every contributing trace id is recorded on both
+    # contexts and the link span
+    a = new_context("event", tracer=tr, key="a")
+    b = new_context("event", tracer=tr, key="b")
+    fold_link(a, b, tracer=tr)
+    folds = [s for s in tr.recent(limit=0) if s["name"] == "fold"]
+    assert folds and folds[-1]["trace_id"] == a.trace_id
+    assert folds[-1]["links"] == [b.trace_id]
+    assert b.trace_id in a.links and a.trace_id in b.links
+
+
+def test_disabled_tracing_mints_no_contexts_and_records_nothing():
+    from aws_global_accelerator_controller_tpu import tracing
+
+    tr = Tracer()
+    tracing.set_enabled(False)
+    try:
+        assert tracing.new_context("event", tracer=tr) is None
+        with tr.span("ghost") as s:
+            s.attributes["x"] = 1  # dummy span accepts writes
+        assert tr.recent() == []
+    finally:
+        tracing.set_enabled(True)
+
+
+# -- workqueue trace sidecar -------------------------------------------
+
+
+def test_workqueue_carries_and_merges_trace_contexts():
+    from aws_global_accelerator_controller_tpu.kube.workqueue import (
+        RateLimitingQueue,
+    )
+    from aws_global_accelerator_controller_tpu.tracing import (
+        new_context,
+    )
+
+    tr = Tracer()
+    q = RateLimitingQueue(name="q")
+    try:
+        ctx1 = new_context("event", tracer=tr, key="k")
+        q.add("k", klass="interactive", ctx=ctx1)
+        # dedup merge: the second event's trace links into the pending
+        ctx2 = new_context("event", tracer=tr, key="k")
+        q.add("k", klass="interactive", ctx=ctx2)
+        assert ctx2.trace_id in ctx1.links
+        assert ctx1.trace_id in ctx2.links
+        item, _ = q.get()
+        assert item == "k"
+        assert q.claimed_trace("k") is ctx1
+        assert [h[0] for h in ctx1.hops][:2] == ["event", "queued"]
+        q.done("k")
+        assert q.claimed_trace("k") is None
+        # requeue re-installs the same context: a second queued hop
+        q.add_after("k", 0.0, klass="keep", ctx=ctx1)
+        assert q.pending_trace("k") is ctx1
+        assert [h[0] for h in ctx1.hops].count("queued") == 2
+    finally:
+        q.shutdown()
+
+
+# -- convergence ledger -------------------------------------------------
+
+
+def test_ledger_stage_breakdown_and_percentiles():
+    from aws_global_accelerator_controller_tpu.metrics import Registry
+    from aws_global_accelerator_controller_tpu.tracing import (
+        ConvergenceLedger,
+        TraceContext,
+    )
+
+    ctx = TraceContext(trace_id=7, origin="event", parent_span_id=7)
+    t = 100.0
+    for stage, dt in (("event", 0.0), ("queued", 0.001),
+                      ("claimed", 0.004), ("planned", 0.010),
+                      ("inflight", 0.003), ("flushed", 0.020),
+                      ("converged", 0.002)):
+        t += dt
+        ctx.hop(stage, now=t, wall=t)
+    ledger = ConvergenceLedger()
+    reg = Registry()
+    rec = ledger.record("ctrl", "default/x", ctx, registry=reg)
+    st = rec["stages"]
+    assert st["queued"] == pytest.approx(0.005)    # enqueue + wait
+    assert st["planned"] == pytest.approx(0.010)
+    assert st["coalesced"] == pytest.approx(0.003)
+    assert st["inflight"] == pytest.approx(0.020)
+    assert st["baked"] == pytest.approx(0.002)
+    assert rec["total_s"] == pytest.approx(0.040)
+    # stage histograms got fed, with the trace id as exemplar
+    assert reg.histogram_count("stage_seconds",
+                               {"stage": "inflight",
+                                "controller": "ctrl"}) == 1
+    assert 'trace_id=7' in reg.render()
+    pct = ledger.percentiles("ctrl")
+    assert pct["inflight"]["p50_s"] == pytest.approx(0.020)
+    assert pct["total"]["count"] == 1
+    # snapshot filters
+    assert ledger.snapshot(key="default/x")[0]["trace_id"] == 7
+    assert ledger.snapshot(key="nope") == []
+
+
+# -- chrome trace-event export ------------------------------------------
+
+
+def test_chrome_serializer_shapes():
+    from aws_global_accelerator_controller_tpu.tracing import (
+        to_chrome_events,
+    )
+
+    tr = Tracer()
+    with tr.span("outer", key="default/x"):
+        with tr.span("inner"):
+            pass
+    events = to_chrome_events(tr.recent())
+    assert {e["name"] for e in events} == {"outer", "inner"}
+    for e in events:
+        assert e["ph"] == "X"
+        assert e["dur"] >= 1.0
+        assert isinstance(e["ts"], float)
+        assert e["args"]["span_id"]
+    outer = [e for e in events if e["name"] == "outer"][0]
+    inner = [e for e in events if e["name"] == "inner"][0]
+    assert outer["tid"] == inner["tid"], "one lane per trace"
+
+
+def test_traces_endpoint_filters_and_chrome_format():
+    import urllib.error
+
+    default_tracer.clear()
+    with default_tracer.span("reconcile", queue="qa", key="default/a"):
+        pass
+    with default_tracer.span("reconcile", queue="qb", key="default/b"):
+        import time as _t
+        _t.sleep(0.02)
+    server = HealthServer(port=0)
+    server.start_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        got = json.loads(urllib.request.urlopen(
+            base + "/traces?key=default/a").read())
+        assert [s["attributes"]["key"] for s in got["spans"]] \
+            == ["default/a"]
+        got = json.loads(urllib.request.urlopen(
+            base + "/traces?queue=qb").read())
+        assert [s["attributes"]["queue"] for s in got["spans"]] == ["qb"]
+        got = json.loads(urllib.request.urlopen(
+            base + "/traces?min_duration=0.01").read())
+        assert [s["attributes"]["key"] for s in got["spans"]] \
+            == ["default/b"]
+        got = json.loads(urllib.request.urlopen(
+            base + "/traces?format=chrome&key=default/b").read())
+        assert got["traceEvents"] and \
+            got["traceEvents"][0]["ph"] == "X"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/traces?format=jaeger")
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/traces?min_duration=abc")
+        assert e.value.code == 400
+    finally:
+        server.shutdown()
+
+
+def test_traces_ledger_endpoint():
+    from aws_global_accelerator_controller_tpu.tracing import (
+        TraceContext,
+        default_ledger,
+    )
+
+    default_ledger.clear()
+    ctx = TraceContext(trace_id=99, origin="event", parent_span_id=99)
+    for i, stage in enumerate(("event", "queued", "claimed",
+                               "converged")):
+        ctx.hop(stage, now=10.0 + i * 0.01, wall=10.0 + i * 0.01)
+    default_ledger.record("qx", "default/led", ctx)
+    server = HealthServer(port=0)
+    server.start_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        got = json.loads(urllib.request.urlopen(
+            base + "/traces/ledger?key=default/led").read())
+        assert got["records"][0]["trace_id"] == 99
+        assert "queued" in got["records"][0]["stages"]
+        assert "total" in got["percentiles"]
+        got = json.loads(urllib.request.urlopen(
+            base + "/traces/ledger?controller=nope").read())
+        assert got["records"] == []
+    finally:
+        server.shutdown()
+        default_ledger.clear()
