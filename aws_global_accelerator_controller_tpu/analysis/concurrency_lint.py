@@ -1,4 +1,4 @@
-"""AST-based concurrency contract lints (rules L101-L113).
+"""AST-based concurrency contract lints (rules L101-L115).
 
 The static half of the concurrency checker: a whole-program pass over
 the tree that enforces the synchronization contracts PR 1 introduced as
@@ -151,6 +151,26 @@ segment looks lock-ish (``lock``/``_lock``/``*_lock``/``cond``/
 ``mutex``).  Identity is class-qualified for ``self.X`` (two classes'
 ``self._lock`` never alias) and suffix-chained for shared-state locks
 (``self._s.lock`` is the same ``_s.lock`` node from any class).
+
+  L115 wall-clock leaks (ISSUE 13)
+                         The clock-owned packages (kube/, resilience/,
+                         cloudprovider/, leaderelection/, reconcile/,
+                         rollout/, controller/, manager/, sharding/,
+                         tracing.py, flight.py, metrics.py) read time
+                         ONLY through simulation/clock.py: a direct
+                         ``time.monotonic()`` / ``time.time()`` /
+                         ``time.sleep()``, a raw ``threading.Event()``
+                         / ``threading.Condition()`` construction, or
+                         a ``.wait(<numeric literal>)`` silently
+                         breaks virtual-time determinism — under a
+                         VirtualClock the leaked wait parks in the OS
+                         where the scheduler cannot see it (a stalled
+                         sim) or burns real seconds the simulation
+                         thought were free.  The real-I/O shims
+                         (http_store/rest_server/kubeconfig/tlsutil/
+                         real.py) are the waiver-listed boundary;
+                         ``# race: <reason>`` waives a deliberate
+                         wall-clock read.
 """
 from __future__ import annotations
 
@@ -313,6 +333,38 @@ def _l109_in_scope(path: Path) -> bool:
         return True
     return ("aws_global_accelerator_controller_tpu" in parts
             and ("controller" in parts or "reconcile" in parts))
+
+
+# Rule L115's scope: the packages whose every timing surface the
+# virtual clock owns (simulation/clock.py).  The real-I/O shims inside
+# them are the simulation boundary and stay on the wall clock.
+_L115_DIRS = {"kube", "resilience", "cloudprovider", "leaderelection",
+              "reconcile", "rollout", "controller", "manager",
+              "sharding"}
+_L115_FILES = {"tracing.py", "flight.py", "metrics.py"}
+_L115_EXEMPT_FILES = {"http_store.py", "rest_server.py",
+                      "kubeconfig.py", "tlsutil.py", "real.py"}
+
+
+def _l115_in_scope(path: Path) -> bool:
+    """L115 covers the clock-owned packages (plus the fixture corpus);
+    the waiver-listed real-I/O shims and everything outside the listed
+    packages (cmd/, webhook/, compat/, accelerator code, tools, tests)
+    keep their own relationship with real time."""
+    parts = path.parts
+    if "lint_fixtures" in parts:
+        # only the rule's own corpus: the other rules' fixtures use
+        # time.sleep/raw events deliberately (the L102 shapes)
+        return path.name.startswith("l115_")
+    if "aws_global_accelerator_controller_tpu" not in parts:
+        return False
+    if path.name in _L115_EXEMPT_FILES:
+        return False
+    i = parts.index("aws_global_accelerator_controller_tpu")
+    rel = parts[i + 1:]
+    if len(rel) == 1:
+        return rel[0] in _L115_FILES
+    return rel[0] in _L115_DIRS
 
 
 # The enqueue surface rule L109 requires a ``klass=`` keyword on, when
@@ -1032,6 +1084,40 @@ class Engine:
                 f"path) so the item carries its trace across the "
                 f"queue/thread boundary (tracing.py), or waive with "
                 f"'# race: <reason>'"))
+        # L115: wall-clock leaks in the clock-owned packages — a
+        # direct time.* read/sleep or a raw threading primitive is
+        # invisible to the virtual clock (simulation/clock.py): under
+        # simulation the wait parks in the OS (a stalled sim) or reads
+        # real seconds the scenario thought were virtual.
+        if _l115_in_scope(info.path):
+            leak = None
+            if (len(chain) == 2 and chain[0] == "time"
+                    and chain[1] in ("monotonic", "time", "sleep")):
+                leak = (f"'{'.'.join(chain)}()' — use simclock."
+                        f"{'wall' if chain[1] == 'time' else chain[1]}"
+                        f"() (simulation/clock.py)")
+            elif (len(chain) == 2 and chain[0] == "threading"
+                    and chain[1] in ("Event", "Condition")):
+                leak = (f"'threading.{chain[1]}()' — use simclock."
+                        f"make_{chain[1].lower()}() so waits park in "
+                        f"the active clock")
+            elif (chain[-1] == "wait" and len(chain) > 1
+                    and any(isinstance(a, ast.Constant)
+                            and isinstance(a.value, (int, float))
+                            and not isinstance(a.value, bool)
+                            for a in list(call.args)
+                            + [kw.value for kw in call.keywords])):
+                leak = (f"'{'.'.join(chain)}(<literal timeout>)' — a "
+                        f"hard-coded real-seconds wait; name the "
+                        f"bound (module constant) or derive it from "
+                        f"the clock")
+            if leak is not None:
+                self.findings.append(Finding(
+                    info.path, line, "L115",
+                    f"wall-clock leak: {leak}.  Wall-clock reads "
+                    f"outside simulation/clock.py break virtual-time "
+                    f"determinism (ISSUE 13); waive a deliberate one "
+                    f"with '# race: <reason>'"))
         # L102: blocking while any lock is held.
         if held and self._is_blocking(chain, held):
             self.findings.append(Finding(
@@ -1048,8 +1134,8 @@ class Engine:
 
     def _is_blocking(self, chain: List[str],
                      held: List[Tuple[str, List[str], int]]) -> bool:
-        if chain == ["time", "sleep"]:
-            return True
+        if chain[-1] == "sleep" and len(chain) > 1:
+            return True   # time.sleep AND simclock.sleep both park
         if chain[0] in _BLOCKING_ROOTS:
             return True
         if chain[-1] == "urlopen":

@@ -21,17 +21,17 @@ KIND = "EndpointGroupBinding"
 PLURAL = "endpointgroupbindings"
 
 
-@dataclass
+@dataclass(slots=True)
 class ServiceReference:
     name: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class IngressReference:
     name: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class EndpointGroupBindingSpec:
     endpoint_group_arn: str = ""
     client_ip_preservation: bool = False
@@ -66,7 +66,7 @@ class EndpointGroupBindingSpec:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class EndpointGroupBindingStatus:
     endpoint_ids: List[str] = field(default_factory=list)
     observed_generation: int = 0
@@ -98,7 +98,7 @@ class EndpointGroupBindingStatus:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class EndpointGroupBinding(KubeObject):
     kind = KIND
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
@@ -124,7 +124,7 @@ class EndpointGroupBinding(KubeObject):
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class EndpointGroupBindingList:
     """List kind (reference types.go:62-70)."""
     items: List[EndpointGroupBinding] = field(default_factory=list)

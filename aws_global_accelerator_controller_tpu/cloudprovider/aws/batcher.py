@@ -62,8 +62,6 @@ one legitimate issuer.
 from __future__ import annotations
 
 import logging
-import threading
-import time
 from collections import OrderedDict
 from dataclasses import dataclass, replace
 from typing import Callable, Dict, List, Optional, Tuple
@@ -71,6 +69,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from contextlib import nullcontext
 
 from ...analysis import locks
+from ...simulation import clock as simclock
 from ...errors import retry_after_hint
 from ...resilience import (
     ErrorClass,
@@ -181,7 +180,7 @@ class _Future:
     __slots__ = ("event", "result", "exc", "payload", "ctx")
 
     def __init__(self, payload=None, ctx=None):
-        self.event = threading.Event()
+        self.event = simclock.make_event()
         self.result = None
         self.exc: Optional[BaseException] = None
         self.payload = payload
@@ -336,7 +335,7 @@ class _Group:
     def __init__(self, kind: str, key: str):
         self.kind = kind
         self.key = key
-        self.cond = threading.Condition(
+        self.cond = simclock.make_condition(
             locks.make_lock(f"coalescer-group[{kind}]"))
         self.pending: List[_Intent] = []
         # fold key -> the pending intent a later submit supersedes:
@@ -365,13 +364,18 @@ class _Group:
         self.last_drain_size = 0
 
 
+# bound on the wait-for-previous-flush poll (seconds, on the group
+# condition — clock-aware under virtual time)
+FLUSH_SERIALIZE_POLL = 0.05
+
+
 class MutationCoalescer:
     """Per-(hosted-zone / endpoint-group) write coalescing over one
     (resilience-wrapped) ``AWSAPIs`` bundle — see the module docstring
     for the intent lifecycle and the error-demux contract."""
 
     def __init__(self, apis, config: Optional[CoalesceConfig] = None,
-                 clock: Callable[[], float] = time.monotonic,
+                 clock: Callable[[], float] = simclock.monotonic,
                  fence=None):
         self.apis = apis
         self.config = config or CoalesceConfig()
@@ -538,8 +542,10 @@ class MutationCoalescer:
                 group.cond.wait(remaining)
             # serialize flushes per group: the endpoint-group
             # read-modify-write must never interleave with itself
+            # (poll bounded by FLUSH_SERIALIZE_POLL — the flush's end
+            # notifies, the timeout only covers a crashed notifier)
             while group.flushing:
-                group.cond.wait(0.05)
+                group.cond.wait(FLUSH_SERIALIZE_POLL)
             intents = list(group.pending)
             del group.pending[:]
             group.index.clear()
@@ -611,11 +617,13 @@ class MutationCoalescer:
         future is ever left hanging (completed exactly once either
         way).  Returns True when everything flushed cleanly.
 
-        Deliberately on the REAL clock (not the injectable
-        ``self._clock``): this loop sleeps real time between polls, so
-        a fake-clock coalescer draining against a wedged flush would
-        otherwise never reach its deadline."""
-        deadline = time.monotonic() + timeout
+        On the module clock (not the injectable ``self._clock``):
+        an INJECTED fake clock never advances while this loop sleeps,
+        so a wedged flush would pin it forever — whereas the module
+        clock is real time under production and, under a VirtualClock,
+        advances exactly when every sim thread (this one included) is
+        parked, so the deadline is always reachable."""
+        deadline = simclock.monotonic() + timeout
         while True:
             with self._lock:
                 groups = list(self._groups.values())
@@ -627,9 +635,9 @@ class MutationCoalescer:
                         group.cond.notify_all()   # cut the linger short
             if not busy:
                 return True
-            if time.monotonic() >= deadline:
+            if simclock.monotonic() >= deadline:
                 break
-            time.sleep(0.002)
+            simclock.sleep(0.002)
         failed = 0
         exc = FencedError("shutdown drain deadline exceeded",
                           self._fence.token if self._fence else 0,
@@ -888,10 +896,10 @@ class ShardedCoalescer:
         budget (each cohort drains against the same deadline — they
         flush concurrently with their own leaders, so sequential
         deadline-splitting would only starve the last)."""
-        deadline = time.monotonic() + timeout
+        deadline = simclock.monotonic() + timeout
         ok = True
         for cohort in self.cohorts().values():
-            ok = cohort.drain(max(0.0, deadline - time.monotonic())) \
+            ok = cohort.drain(max(0.0, deadline - simclock.monotonic())) \
                 and ok
         return ok
 

@@ -44,12 +44,19 @@ class CloudFactory:
                  accelerator_not_found_retry: float = 60.0,
                  resilience: Optional[ResilienceConfig] = None,
                  coalesce: Optional[CoalesceConfig] = None,
-                 num_shards: int = 1):
+                 num_shards: int = 1,
+                 discovery_cache_ttl: Optional[float] = None):
         self._providers: Dict[str, AWSProvider] = {}
         self._lock = locks.make_lock("cloud-factory")
         self._poll_interval = delete_poll_interval
         self._poll_timeout = delete_poll_timeout
         self._not_found_retry = accelerator_not_found_retry
+        # the fleet-index/tag-cache TTL (provider.DISCOVERY_CACHE_TTL
+        # default).  A SCALE knob: every expiry costs one O(fleet)
+        # rescan, so at 100k+ services the default 30s makes the TTL
+        # sweep the dominant steady-state cost — large fleets raise it
+        # and lean on the drift sweep for out-of-band detection
+        self._discovery_ttl = discovery_cache_ttl
         # every provider's apis go through the resilient call layer
         # (classify/retry/backoff, per-region circuit breaker,
         # adaptive throttle pacing — resilience/); None means the
@@ -132,6 +139,9 @@ class CloudFactory:
                             first_apis, config=self._coalesce,
                             fence=CompositeFence(
                                 self.fence, self.shards.fence(sid))))
+                kwargs = {}
+                if self._discovery_ttl is not None:
+                    kwargs["discovery_cache_ttl"] = self._discovery_ttl
                 provider = AWSProvider(
                     apis,
                     delete_poll_interval=self._poll_interval,
@@ -139,7 +149,7 @@ class CloudFactory:
                     accelerator_not_found_retry=self._not_found_retry,
                     discovery_state=self._discovery_state,
                     coalescer=self._coalescer,
-                    shards=self.shards)
+                    shards=self.shards, **kwargs)
                 self._providers[region] = provider
             return provider
 
@@ -163,7 +173,8 @@ class FakeCloudFactory(CloudFactory):
                  fault_seed: Optional[int] = None,
                  coalesce: Optional[CoalesceConfig] = None,
                  cloud: Optional[AWSAPIs] = None,
-                 num_shards: int = 1):
+                 num_shards: int = 1,
+                 discovery_cache_ttl: Optional[float] = None):
         # fast resilience profile by default: real backoff shapes at
         # 100x speed, breaker thresholds the ordinary one-shot fault
         # tests never trip (chaos tests pass tighter configs); same
@@ -172,7 +183,8 @@ class FakeCloudFactory(CloudFactory):
                          accelerator_not_found_retry,
                          resilience=resilience or FAKE_CLOUD_CONFIG,
                          coalesce=coalesce or FAKE_COALESCE_CONFIG,
-                         num_shards=num_shards)
+                         num_shards=num_shards,
+                         discovery_cache_ttl=discovery_cache_ttl)
         # ``cloud`` lets a FRESH factory adopt an EXISTING fake cloud —
         # the crash-restart shape: new process state (empty discovery
         # caches, cold fingerprints, new fence) over the same AWS world

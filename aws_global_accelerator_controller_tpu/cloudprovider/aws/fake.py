@@ -25,7 +25,6 @@ from __future__ import annotations
 import itertools
 import random
 import threading
-import time
 import zlib
 from collections import deque
 from dataclasses import dataclass, field
@@ -36,6 +35,7 @@ from ...errors import (
     EndpointGroupNotFoundError,
     ListenerNotFoundError,
 )
+from ...simulation import clock as simclock
 from .api import AWSAPIs, ELBv2API, GlobalAcceleratorAPI, Route53API
 from .types import (
     Accelerator,
@@ -110,7 +110,7 @@ class FaultInjector:
     """
 
     def __init__(self, seed: Optional[int] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = simclock.monotonic):
         self._faults: Dict[str, List[Exception]] = {}
         self._lock = threading.Lock()
         self._clock = clock
@@ -361,7 +361,7 @@ class FaultInjector:
                     "code": getattr(exc, "code", type(exc).__name__),
                 })
         if delay > 0.0:
-            time.sleep(delay)
+            simclock.sleep(delay)
         if exc is not None:
             # stamp the injection into the current span / attached
             # trace context (tracing.py): the trace that rode this
@@ -393,6 +393,13 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
         self._listeners: Dict[str, Tuple[str, Listener]] = {}
         # endpoint group arn -> (listener arn, EndpointGroup)
         self._endpoint_groups: Dict[str, Tuple[str, EndpointGroup]] = {}
+        # parent indexes (ISSUE 13 scale diet): list_listeners /
+        # list_endpoint_groups were O(total fleet) scans, which made
+        # every steady-state sync quadratic at 100k accelerators —
+        # the fake must stay O(result) for the virtual-time scale legs
+        # to measure the CONTROLLER, not the fake
+        self._listeners_of: Dict[str, Dict[str, Listener]] = {}
+        self._egs_of: Dict[str, Dict[str, EndpointGroup]] = {}
 
     # -- helpers --------------------------------------------------------
 
@@ -404,12 +411,12 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
 
     def _refresh_status(self, st: _AccelState) -> None:
         if (st.accelerator.status == STATUS_IN_PROGRESS
-                and time.monotonic() >= st.settled_at):
+                and simclock.monotonic() >= st.settled_at):
             st.accelerator.status = STATUS_DEPLOYED
 
     def _mark_in_progress(self, st: _AccelState) -> None:
         st.accelerator.status = STATUS_IN_PROGRESS
-        st.settled_at = time.monotonic() + self.settle_seconds
+        st.settled_at = simclock.monotonic() + self.settle_seconds
         self._refresh_status(st)
 
     def _get_state(self, arn: str) -> _AccelState:
@@ -489,9 +496,7 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
                 raise AWSAPIError(
                     "InvalidArgumentException",
                     "The accelerator is being deployed; retry later")
-            remaining = [arn2 for arn2, (acc_arn, _) in self._listeners.items()
-                         if acc_arn == arn]
-            if remaining:
+            if self._listeners_of.get(arn):
                 raise AWSAPIError(
                     "AssociatedListenerFoundException",
                     "The accelerator still has listeners")
@@ -503,8 +508,9 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
         self.faults.check("list_listeners")
         with self._lock:
             self._get_state(accelerator_arn)
-            return [l.copy() for a, l in self._listeners.values()
-                    if a == accelerator_arn]
+            return [l.copy() for l in
+                    self._listeners_of.get(accelerator_arn,
+                                           {}).values()]
 
     def create_listener(self, accelerator_arn: str, port_ranges,
                         protocol: str, client_affinity: str) -> Listener:
@@ -520,6 +526,8 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
                 client_affinity=client_affinity,
             )
             self._listeners[arn] = (accelerator_arn, listener)
+            self._listeners_of.setdefault(accelerator_arn,
+                                          {})[arn] = listener
             self._mark_in_progress(st)
             return listener.copy()
 
@@ -543,22 +551,24 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
         with self._lock:
             if listener_arn not in self._listeners:
                 raise ListenerNotFoundError()
-            remaining = [arn for arn, (l_arn, _) in self._endpoint_groups.items()
-                         if l_arn == listener_arn]
-            if remaining:
+            if self._egs_of.get(listener_arn):
                 raise AWSAPIError(
                     "AssociatedEndpointGroupFoundException",
                     "The listener still has endpoint groups")
-            del self._listeners[listener_arn]
+            acc_arn, _ = self._listeners.pop(listener_arn)
+            bucket = self._listeners_of.get(acc_arn)
+            if bucket is not None:
+                bucket.pop(listener_arn, None)
+                if not bucket:
+                    del self._listeners_of[acc_arn]
 
     # -- endpoint groups ------------------------------------------------
 
     def list_endpoint_groups(self, listener_arn: str) -> List[EndpointGroup]:
         self.faults.check("list_endpoint_groups")
         with self._lock:
-            return [eg.copy()
-                    for l_arn, eg in self._endpoint_groups.values()
-                    if l_arn == listener_arn]
+            return [eg.copy() for eg in
+                    self._egs_of.get(listener_arn, {}).values()]
 
     def describe_endpoint_group(self, arn: str) -> EndpointGroup:
         self.faults.check("describe_endpoint_group")
@@ -584,6 +594,7 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
                     client_ip_preservation_enabled=client_ip_preservation)],
             )
             self._endpoint_groups[arn] = (listener_arn, eg)
+            self._egs_of.setdefault(listener_arn, {})[arn] = eg
             acc_arn = self._listeners[listener_arn][0]
             self._mark_in_progress(self._get_state(acc_arn))
             return eg.copy()
@@ -666,7 +677,12 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
         with self._lock:
             if arn not in self._endpoint_groups:
                 raise EndpointGroupNotFoundError()
-            del self._endpoint_groups[arn]
+            l_arn, _ = self._endpoint_groups.pop(arn)
+            bucket = self._egs_of.get(l_arn)
+            if bucket is not None:
+                bucket.pop(arn, None)
+                if not bucket:
+                    del self._egs_of[l_arn]
 
 
 class FakeELBv2(ELBv2API):

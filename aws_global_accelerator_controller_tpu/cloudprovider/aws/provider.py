@@ -20,8 +20,6 @@ SURVEY.md §7 "Deliberate improvements"):
 from __future__ import annotations
 
 import logging
-import threading
-import time
 from typing import List, Optional, Tuple
 
 from ...apis import (
@@ -37,6 +35,8 @@ from ...errors import (
 from ...kube.objects import Ingress, LoadBalancerIngress, Service
 
 from ...analysis import locks
+from ...reconcile.interning import intern_str
+from ...simulation import clock as simclock
 from ...resilience import ErrorClass, classify
 from ...metrics import record_coalesced_read, record_fleet_scan
 from .api import AWSAPIs
@@ -329,7 +329,7 @@ class AWSProvider:
             hit = self._s.discovery.get(key)
         if hit is not None:
             arn, cached_at = hit
-            if time.monotonic() - cached_at < self.discovery_cache_ttl:
+            if simclock.monotonic() - cached_at < self.discovery_cache_ttl:
                 try:
                     accelerator, tags = self._verified_read(arn)
                     if tags_contains_all_values(tags, target):
@@ -373,7 +373,7 @@ class AWSProvider:
             with self._s.lock:
                 fleet_fresh = (
                     self._s.fleet_at is not None
-                    and time.monotonic() - self._s.fleet_at
+                    and simclock.monotonic() - self._s.fleet_at
                     < self.discovery_cache_ttl)
                 arns = (self._s.fleet_index.get(key, ())
                         if fleet_fresh else None)
@@ -411,7 +411,7 @@ class AWSProvider:
                         with self._s.lock:
                             self._s.discovery[key] = (
                                 confirmed[0].accelerator_arn,
-                                time.monotonic())
+                                simclock.monotonic())
                     return confirmed
 
         fleet, scan_gen = self._scan_fleet(fresh_scan)
@@ -442,7 +442,7 @@ class AWSProvider:
         if len(result) == 1:
             with self._s.lock:
                 self._s.discovery[key] = (result[0].accelerator_arn,
-                                              time.monotonic())
+                                              simclock.monotonic())
         return result
 
     # refresh the index once it has aged past this fraction of the TTL
@@ -459,7 +459,7 @@ class AWSProvider:
         with self._s.lock:
             if self._s.refresh_inflight or self._s.fleet_at is None:
                 return
-            age = time.monotonic() - self._s.fleet_at
+            age = simclock.monotonic() - self._s.fleet_at
             if age < self.discovery_cache_ttl * self.FLEET_REFRESH_FRACTION:
                 return
             self._s.refresh_inflight = True
@@ -475,8 +475,8 @@ class AWSProvider:
                 with self._s.lock:
                     self._s.refresh_inflight = False
 
-        threading.Thread(target=refresh, daemon=True,
-                         name="fleet-index-refresh").start()
+        simclock.start_thread(refresh, daemon=True,
+                              name="fleet-index-refresh")
 
     def _scan_fleet(self, fresh: bool):
         """One full ListAccelerators + per-ARN tags sweep, singleflighted:
@@ -501,7 +501,7 @@ class AWSProvider:
         # scan: per-arn _tags_for calls dominated the reconcile hot
         # path (a lock + monotonic() per accelerator per sync)
         with self._s.lock:
-            now = time.monotonic()
+            now = simclock.monotonic()
             fleet_epoch = self._s.fleet_epoch
             self._s.scans_inflight += 1
             cached = ({} if fresh else
@@ -512,7 +512,7 @@ class AWSProvider:
             fleet = []
             new_index: dict = {}
             for accelerator in self.apis.ga.list_accelerators():
-                arn = accelerator.accelerator_arn
+                arn = intern_str(accelerator.accelerator_arn)
                 tags = cached.get(arn)
                 if tags is None:
                     try:
@@ -573,7 +573,7 @@ class AWSProvider:
                                 have.append(arn)
                     self._s.fleet_index = {k: tuple(v)
                                            for k, v in merged.items()}
-                    self._s.fleet_at = time.monotonic()
+                    self._s.fleet_at = simclock.monotonic()
             return fleet, gen
         finally:
             with self._s.lock:
@@ -590,15 +590,21 @@ class AWSProvider:
         cluster = tags.get(CLUSTER_TAG_KEY)
         if managed is None or cluster is None:
             return
+        # intern the variable halves (reconcile/interning.py): at
+        # 100k-1M keys every index bucket / discovery entry sharing
+        # one canonical hostname/cluster string is the memory diet
+        managed = intern_str(managed)
+        cluster = intern_str(cluster)
         owner = tags.get(OWNER_TAG_KEY)
         if owner is not None:
             yield frozenset({(MANAGED_TAG_KEY, managed),
-                             (OWNER_TAG_KEY, owner),
+                             (OWNER_TAG_KEY, intern_str(owner)),
                              (CLUSTER_TAG_KEY, cluster)})
         hostname = tags.get(TARGET_HOSTNAME_TAG_KEY)
         if hostname is not None:
             yield frozenset({(MANAGED_TAG_KEY, managed),
-                             (TARGET_HOSTNAME_TAG_KEY, hostname),
+                             (TARGET_HOSTNAME_TAG_KEY,
+                              intern_str(hostname)),
                              (CLUSTER_TAG_KEY, cluster)})
 
     def _invalidate_fleet_locked(self) -> None:
@@ -625,10 +631,12 @@ class AWSProvider:
         prime is additionally logged so the scan can merge it into the
         snapshot it installs (a snapshot listed before this create
         would otherwise report the new keys definitely-absent)."""
-        now = time.monotonic()
+        now = simclock.monotonic()
+        arn = intern_str(arn)
         with self._s.lock:
             for target in targets:
-                tkey = frozenset(target.items())
+                tkey = frozenset((k, intern_str(v))
+                                 for k, v in target.items())
                 self._s.discovery[tkey] = (arn, now)
                 have = self._s.fleet_index.get(tkey, ())
                 if arn not in have:
@@ -690,7 +698,7 @@ class AWSProvider:
     def _store_tags(self, arn: str, tags, gen: int) -> None:
         with self._s.lock:
             if self._s.gen == gen:
-                self._s.tags[arn] = (tags, time.monotonic())
+                self._s.tags[arn] = (tags, simclock.monotonic())
 
     # ------------------------------------------------------------------
     # Ensure (create-or-update) for Service / Ingress
@@ -900,19 +908,19 @@ class AWSProvider:
         (reference global_accelerator.go:743-784)."""
         logger.info("disabling Global Accelerator %s", arn)
         self.apis.ga.update_accelerator(arn, enabled=False)
-        deadline = time.monotonic() + self.delete_poll_timeout
+        deadline = simclock.monotonic() + self.delete_poll_timeout
         while True:
             accelerator = self.apis.ga.describe_accelerator(arn)
             if accelerator.status == STATUS_DEPLOYED:
                 break
-            if time.monotonic() >= deadline:
+            if simclock.monotonic() >= deadline:
                 raise AWSAPIError(
                     "Timeout",
                     f"accelerator {arn} did not settle within "
                     f"{self.delete_poll_timeout}s")
             logger.info("accelerator %s is %s, waiting", arn,
                         accelerator.status)
-            time.sleep(self.delete_poll_interval)
+            simclock.sleep(self.delete_poll_interval)
         self.apis.ga.delete_accelerator(arn)
         self._note_accelerator_deleted(arn)
         logger.info("Global Accelerator deleted: %s", arn)
@@ -998,7 +1006,7 @@ class AWSProvider:
             # torch the fleet index (the rescue path) — evict
             # surgically like the delete path, then insert + prime
             # the new keys (verified on use, as ever)
-            now = time.monotonic()
+            now = simclock.monotonic()
             self._evict_arn_locked(arn)
             if merged is None:
                 self._invalidate_fleet_locked()

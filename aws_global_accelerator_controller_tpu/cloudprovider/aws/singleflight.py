@@ -13,17 +13,17 @@ after it; see provider.py).
 """
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, Hashable, Optional
 
 from ...analysis import locks
+from ...simulation import clock as simclock
 
 
 class _Call:
     __slots__ = ("done", "result", "exc")
 
     def __init__(self):
-        self.done = threading.Event()
+        self.done = simclock.make_event()
         self.result = None
         self.exc: Optional[BaseException] = None
 

@@ -12,6 +12,7 @@ import threading
 from typing import Callable, List
 
 from ..analysis import locks
+from ..simulation import clock as simclock
 from ..apis import (
     AWS_LOAD_BALANCER_TYPE_ANNOTATION,
     INGRESS_CLASS_ANNOTATION,
@@ -358,18 +359,23 @@ def spawn_workers(name: str, count: int, stop: threading.Event,
 
     def loop():
         while not stop.is_set():
+            # the 0.2s get-poll exists to observe ``stop`` on the
+            # system clock; under a virtual clock an idle worker
+            # waking every 0.2 VIRTUAL seconds is pure scheduler
+            # churn (a 100k-fleet steady window is hours of virtual
+            # time) — work and shutdown both notify the queue
+            # condition, so the long poll changes nothing else
+            poll = 60.0 if simclock.virtual_active() else WORKER_POLL
             if not process_next_work_item(
                     queue, key_to_obj, process_delete,
-                    process_create_or_update, get_timeout=WORKER_POLL,
+                    process_create_or_update, get_timeout=poll,
                     fingerprints=fingerprints, shards=shards):
                 return
 
     threads = []
     for i in range(count):
-        t = threading.Thread(target=loop, daemon=True,
-                             name=f"{name}-worker-{i}")
-        t.start()
-        threads.append(t)
+        threads.append(simclock.start_thread(
+            loop, daemon=True, name=f"{name}-worker-{i}"))
     return threads
 
 
@@ -387,4 +393,4 @@ def run_controller(name: str, stop: threading.Event,
     for q in queues:
         q.shutdown()
     for t in threads:
-        t.join(timeout=2.0)
+        simclock.join_thread(t, timeout=2.0)

@@ -45,6 +45,7 @@ from ..kube.workqueue import (
     new_rate_limiting_queue,
 )
 from ..reconcile import Result
+from ..simulation import clock as simclock
 from ..reconcile.fingerprint import (
     ORIGIN_RESYNC,
     ORIGIN_SWEEP,
@@ -370,28 +371,28 @@ class EndpointGroupBindingController:
         metrics.watch_queue_depth(self.queue)
         threads = []
         for i in range(self.workers):
-            t = threading.Thread(target=self._worker_loop, args=(stop,),
-                                 daemon=True,
-                                 name=f"{CONTROLLER_AGENT_NAME}-{i}")
-            t.start()
-            threads.append(t)
+            threads.append(simclock.start_thread(
+                self._worker_loop, args=(stop,), daemon=True,
+                name=f"{CONTROLLER_AGENT_NAME}-{i}"))
         logger.info("started %s workers", CONTROLLER_AGENT_NAME)
         stop.wait()
         self.queue.shutdown()
         for t in threads:
-            t.join(timeout=2.0)
+            simclock.join_thread(t, timeout=2.0)
 
     def _worker_loop(self, stop: threading.Event) -> None:
-        import time as time_mod
-
         from .. import metrics
         while not stop.is_set():
-            key, shutdown = self.queue.get(timeout=WORKER_POLL)
+            # long poll under virtual time (controller/base.py loop
+            # has the rationale); shutdown/notify wake the get
+            poll = (60.0 if simclock.virtual_active()
+                    else WORKER_POLL)
+            key, shutdown = self.queue.get(timeout=poll)
             if shutdown:
                 return
             if key is None:
                 continue
-            start = time_mod.monotonic()
+            start = simclock.monotonic()
             result = "success"
             try:
                 self._sync_handler(key)
@@ -423,7 +424,7 @@ class EndpointGroupBindingController:
             finally:
                 self.queue.done(key)
                 metrics.record_sync(self.queue.name, result,
-                                    time_mod.monotonic() - start)
+                                    simclock.monotonic() - start)
 
     def _sync_handler(self, key: str) -> None:
         """(controller.go:148-180): attach the delivery's trace
@@ -442,8 +443,6 @@ class EndpointGroupBindingController:
             self._sync_traced(key, ctx)
 
     def _sync_traced(self, key: str, ctx) -> None:
-        import time as time_mod
-
         from .. import metrics
         from ..reconcile.traffic import dispatch_class
 
@@ -454,7 +453,7 @@ class EndpointGroupBindingController:
         meta = self.queue.claimed_meta(key) \
             if hasattr(self.queue, "claimed_meta") else None
         klass, enqueued_at = meta if meta is not None \
-            else (CLASS_INTERACTIVE, time_mod.monotonic())
+            else (CLASS_INTERACTIVE, simclock.monotonic())
         first_enqueued = self.fingerprints.pending_since(key, enqueued_at)
         try:
             binding = self.binding_informer.lister.get(ns, name)
@@ -569,7 +568,7 @@ class EndpointGroupBindingController:
             self.fingerprints.clear_pending(key)
             metrics.record_reconcile_latency(
                 self.queue.name, klass,
-                time_mod.monotonic() - first_enqueued)
+                simclock.monotonic() - first_enqueued)
             if ctx is not None:
                 from ..tracing import default_ledger
 

@@ -42,12 +42,12 @@ planner computes.
 from __future__ import annotations
 
 import logging
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..analysis import locks
 from ..rollout import rollout_active
+from ..simulation import clock as simclock
 
 logger = logging.getLogger(__name__)
 
@@ -68,7 +68,7 @@ class _Entry:
     ops: List[object]
     weights: Dict[str, int]
     observed: object                  # the EndpointGroup described
-    planned_at: float = field(default_factory=time.monotonic)
+    planned_at: float = field(default_factory=simclock.monotonic)
 
 
 class FleetSweepPlanner:
@@ -284,7 +284,7 @@ class FleetSweepPlanner:
         # pack_fleet lays groups out shard-major, so intents come back
         # reordered — join on the key, never on input position
         by_key = {intent.key: intent for intent in result.intents()}
-        now = time.monotonic()
+        now = simclock.monotonic()
         with self._lock:
             for key, fp, group, spec_weighted in metas:
                 intent = by_key[key]

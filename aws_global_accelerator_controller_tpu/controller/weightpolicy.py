@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 
 from ..apis.endpointgroupbinding.v1alpha1 import EndpointGroupBinding
 from ..cloudprovider.aws.types import EndpointGroup
+from ..simulation import clock as simclock
 
 logger = logging.getLogger(__name__)
 
@@ -213,10 +214,9 @@ class ReloadingModelWeightPolicy:
         self._inner = ModelWeightPolicy.from_checkpoint(
             directory, hidden_dim=hidden_dim)
         self._interval = float(interval_s)
-        self._wake = threading.Event()
-        self._thread = threading.Thread(
-            target=self._run, name="policy-reload", daemon=True)
-        self._thread.start()
+        self._wake = simclock.make_event()
+        self._thread = simclock.start_thread(
+            self._run, name="policy-reload", daemon=True)
 
     @property
     def restored_step(self) -> int:
@@ -262,7 +262,7 @@ class ReloadingModelWeightPolicy:
 
     def close(self) -> None:
         self._wake.set()
-        self._thread.join(timeout=5.0)
+        simclock.join_thread(self._thread, timeout=5.0)
 
 
 def plan_source(policy, spec_weight) -> str:

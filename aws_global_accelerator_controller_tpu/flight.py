@@ -29,10 +29,10 @@ import json
 import logging
 import os
 import threading
-import time
 from typing import Callable, Dict, List, Optional
 
 from .tracing import default_ledger, default_tracer
+from .simulation import clock as simclock
 
 logger = logging.getLogger(__name__)
 
@@ -133,7 +133,7 @@ class FlightRecorder:
         """Freeze the black box NOW (debounced per reason).  Returns
         the dump path, or None when disarmed / cooling down / the
         write failed."""
-        now = time.monotonic()
+        now = simclock.monotonic()
         with self._lock:
             if not self._armed:
                 return None
@@ -160,7 +160,7 @@ class FlightRecorder:
             dump = {
                 "reason": reason,
                 "detail": detail,
-                "wall": time.time(),
+                "wall": simclock.wall(),
                 "pid": os.getpid(),
                 "spans": self._tracer.recent(limit=0),
                 "ledger": self._ledger.snapshot(limit=0),

@@ -19,13 +19,13 @@ from __future__ import annotations
 import itertools
 import queue as queue_mod
 import threading
-import time
 import uuid
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from ..errors import AdmissionDeniedError, ConflictError, NotFoundError
 from .chaos import NULL_CHAOS, KubeChaos
+from ..simulation import clock as simclock
 from .objects import KubeObject
 
 WATCH_ADDED = "ADDED"
@@ -109,7 +109,7 @@ class Broadcaster:
         self._lock = threading.Lock()
 
     def subscribe(self) -> queue_mod.Queue:
-        q: queue_mod.Queue = queue_mod.Queue()
+        q = simclock.make_queue()
         with self._lock:
             self._subs.append(q)
         return q
@@ -216,7 +216,7 @@ class ResourceStore:
             if not obj.metadata.uid:
                 obj.metadata.uid = _next_uid()
             if obj.metadata.creation_timestamp is None:
-                obj.metadata.creation_timestamp = time.time()
+                obj.metadata.creation_timestamp = simclock.wall()
             obj.metadata.generation = 1
             self._stamp(obj)
             self._objects[key] = obj
@@ -308,7 +308,7 @@ class ResourceStore:
                 raise NotFoundError(self.kind, key)
             if obj.metadata.finalizers:
                 if obj.metadata.deletion_timestamp is None:
-                    obj.metadata.deletion_timestamp = time.time()
+                    obj.metadata.deletion_timestamp = simclock.wall()
                     self._stamp(obj)
                     self._publish(WATCH_MODIFIED, obj)
                 return

@@ -42,11 +42,11 @@ happened"); counts are observable via ``call_counts`` /
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 import zlib
 from typing import Callable, Dict, Optional, Tuple
 
+from ..simulation import clock as simclock
 from ..errors import ConflictError
 
 # Store operations the injector screens (ResourceStore CRUD surface).
@@ -62,7 +62,7 @@ class KubeChaos:
     """
 
     def __init__(self, seed: Optional[int] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = simclock.monotonic):
         self._seed = seed
         self._clock = clock
         self._lock = threading.Lock()
@@ -256,7 +256,7 @@ class KubeChaos:
                     "conflict" if isinstance(exc, ConflictError)
                     else "rate")
         if delay > 0.0:
-            time.sleep(delay)
+            simclock.sleep(delay)
         if exc is not None:
             raise exc
 

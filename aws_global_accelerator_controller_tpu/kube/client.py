@@ -92,26 +92,42 @@ class EventBroadcaster:
     """
 
     def __init__(self, store, capacity: int = 1000):
-        import queue
-        import threading
+        from ..simulation import clock as simclock
 
         self._store = store
-        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
-        self._thread = threading.Thread(
-            target=self._run, daemon=True, name="event-broadcaster")
-        self._thread.start()
+        # clock-aware queue + spawned thread: under a virtual clock
+        # the broadcaster is a sim thread, so event writes land at
+        # deterministic points instead of racing the scheduler
+        self._q = simclock.make_queue(maxsize=capacity)
+        self._thread = simclock.start_thread(
+            self._run, daemon=True, name="event-broadcaster")
 
     def _run(self) -> None:
+        import queue as queue_mod
         while True:
-            ev = self._q.get()
+            batch = [self._q.get()]
+            # greedy drain: one wake flushes everything queued — at
+            # fleet scale the per-item wake round-trip (one park per
+            # event under a virtual clock) dominated the write itself
             try:
-                if ev is _STOP:
-                    return
-                self._store.create(ev)
-            except Exception:  # events are best-effort
-                logger.debug("failed to record event", exc_info=True)
-            finally:
-                self._q.task_done()
+                while True:
+                    batch.append(self._q.get_nowait())
+            except queue_mod.Empty:
+                pass
+            stop = False
+            for ev in batch:
+                try:
+                    if ev is _STOP:
+                        stop = True
+                        continue
+                    self._store.create(ev)
+                except Exception:  # events are best-effort
+                    logger.debug("failed to record event",
+                                 exc_info=True)
+                finally:
+                    self._q.task_done()
+            if stop:
+                return
 
     def enqueue(self, ev: Event) -> None:
         import queue
@@ -123,13 +139,13 @@ class EventBroadcaster:
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Best-effort wait until enqueued events are written (tests)."""
-        import time
+        from ..simulation import clock as simclock
 
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = simclock.monotonic() + timeout
+        while simclock.monotonic() < deadline:
             if self._q.unfinished_tasks == 0:
                 return True
-            time.sleep(0.01)
+            simclock.sleep(0.01)
         return False
 
     def stop(self) -> None:

@@ -45,12 +45,13 @@ import logging
 import queue as queue_mod
 import random
 import threading
-import time
 import zlib
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..analysis import freezeproxy, locks
 from ..errors import NotFoundError
+from ..reconcile.interning import intern_str
+from ..simulation import clock as simclock
 from ..metrics import record_index_lookup, record_watch_relist
 from .apiserver import (
     WATCH_ADDED,
@@ -219,7 +220,11 @@ class Informer:
         self._snapshot: Optional[List[KubeObject]] = None
         self._ns_snapshots: Dict[str, List[KubeObject]] = {}
         self._handlers: List[EventHandlers] = []
-        self._synced = threading.Event()
+        # relist/list backoff jitter: seeded per kind, so a chaos
+        # scenario's recovery schedule replays deterministically under
+        # virtual time (same decorrelation, reproducible draws)
+        self._jitter_rng = random.Random(zlib.crc32(self.kind.encode()))
+        self._synced = simclock.make_event()
         self._thread: Optional[threading.Thread] = None
         self._watch_q: Optional[queue_mod.Queue] = None
         self.lister = Lister(self)
@@ -286,7 +291,11 @@ class Informer:
 
     def _apply_locked(self, key: str, obj: Optional[KubeObject]) -> None:
         """Install (or, with obj=None, remove) one cache entry and keep
-        every index and snapshot coherent.  Caller holds _cache_lock."""
+        every index and snapshot coherent.  Caller holds _cache_lock.
+        Keys and index values are interned (reconcile/interning.py):
+        every map in this structure shares ONE canonical string per
+        distinct key/hostname — the memory diet at 100k-1M objects."""
+        key = intern_str(key)
         old = self._cache.get(key)
         if obj is None:
             self._cache.pop(key, None)
@@ -303,17 +312,16 @@ class Informer:
                             index.pop(value, None)
             if obj is not None:
                 for value in fn(obj):
-                    index.setdefault(value, {})[key] = obj
+                    index.setdefault(intern_str(value), {})[key] = obj
         self._snapshot = None
         self._ns_snapshots.clear()
 
     # -- run loop -------------------------------------------------------
 
     def run(self, stop: threading.Event) -> None:
-        self._thread = threading.Thread(
-            target=self._loop, args=(stop,), daemon=True,
+        self._thread = simclock.start_thread(
+            self._loop, args=(stop,), daemon=True,
             name=f"informer-{self.kind}")
-        self._thread.start()
 
     def _dispatch(self, fn, *args) -> None:
         if fn is None:
@@ -350,7 +358,7 @@ class Informer:
                 # each attempt costs the server full LISTs, and a fleet
                 # of informers waking in lockstep the moment it recovers
                 # would re-topple it
-                stop.wait(delay * random.uniform(0.8, 1.2))
+                stop.wait(delay * self._jitter_rng.uniform(0.8, 1.2))
                 delay = min(delay * 2, 30.0)
         return None
 
@@ -367,11 +375,27 @@ class Informer:
                     self._dispatch(h.add, obj)
             self._synced.set()
 
-            spread = _ResyncSpread(self._resync_period, time.monotonic(),
+            spread = _ResyncSpread(self._resync_period, simclock.monotonic(),
                                    keys=[obj.key() for obj in listed])
             while not stop.is_set():
-                now = time.monotonic()
-                timeout = min(0.2, max(0.0, spread.next_due(now) - now))
+                now = simclock.monotonic()
+                # same idle-hop contract as the workqueue waker: the
+                # 0.2s cap is for stop observation on the system
+                # clock; virtually, watch events wake the queue get
+                # directly and resync dues bound the park exactly.
+                # Virtual ticks are additionally QUANTIZED to 5s: at
+                # 100k keys spread across a period, per-key wakes
+                # would cost one scheduler round-trip each — a 5s
+                # batch delivers the window's dues in one wake (a
+                # re-delivery up to 5s late is noise against resync
+                # periods measured in minutes)
+                if simclock.virtual_active():
+                    timeout = min(
+                        60.0,
+                        max(5.0, spread.next_due(now) - now))
+                else:
+                    timeout = min(0.2,
+                                  max(0.0, spread.next_due(now) - now))
                 try:
                     event = self._watch_q.get(timeout=timeout)
                 except queue_mod.Empty:
@@ -482,7 +506,7 @@ class Informer:
         (level-trigger backstop, one delivery per key per period).
         Tagged ``resync`` handlers get (obj, wave); others get the
         classic update(obj, obj) no-op pair."""
-        due, wave = spread.due(time.monotonic())
+        due, wave = spread.due(simclock.monotonic())
         for key in due:
             obj = self.cache_get(key)
             if obj is None:      # deleted since the keys snapshot
@@ -541,12 +565,12 @@ def wait_for_cache_sync(stop: threading.Event, *informers: Informer,
     against an unreachable apiserver, a controller must wait out the
     outage rather than crash at startup.  ``timeout`` bounds the wait
     for tests."""
-    deadline = (time.monotonic() + timeout
+    deadline = (simclock.monotonic() + timeout
                 if timeout is not None else None)
-    while deadline is None or time.monotonic() < deadline:
+    while deadline is None or simclock.monotonic() < deadline:
         if stop.is_set():
             return False
         if all(i.has_synced() for i in informers):
             return True
-        time.sleep(0.01)
+        simclock.sleep(0.01)
     return False

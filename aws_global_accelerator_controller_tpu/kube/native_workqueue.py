@@ -23,9 +23,9 @@ from __future__ import annotations
 
 import ctypes
 import threading
-import time
 from typing import Any, Optional, Tuple
 
+from ..simulation import clock as simclock
 from ..analysis import locks
 from ..native import ensure_library
 
@@ -241,7 +241,7 @@ class NativeRateLimitingQueue:
             if rc == 0:
                 item = buf.value.decode("utf-8")
                 self._claimed[item] = (_py_class(out_klass.value),
-                                       time.monotonic() - out_wait.value)
+                                       simclock.monotonic() - out_wait.value)
                 ctx = self._trace.pop(item, None)
                 if ctx is not None:
                     self._claimed_trace[item] = ctx

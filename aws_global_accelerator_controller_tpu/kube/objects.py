@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 
-@dataclass
+@dataclass(slots=True)
 class ObjectMeta:
     name: str = ""
     namespace: str = "default"
@@ -78,6 +78,13 @@ class ObjectMeta:
 class KubeObject:
     """Base for all API objects: kind + metadata + deep copy.
 
+    ``__slots__`` all the way down (every subclass is a
+    ``@dataclass(slots=True)``): at production fleet sizes the
+    informer caches, apiserver store and watch pipeline hold millions
+    of these, and the per-instance ``__dict__`` was the single biggest
+    per-service memory term (the ISSUE-13 memory diet —
+    simulation/memory.py measures the result).
+
     Ownership contract (matches client-go): objects read from an
     informer cache — lister get/list, ``by_index``, event-handler
     arguments — are SHARED views; call ``deep_copy()`` before mutating
@@ -85,8 +92,9 @@ class KubeObject:
     process funcs (reconcile.py), which is the single defensive copy
     on the hot path."""
 
-    kind: str = ""
-    metadata: ObjectMeta
+    __slots__ = ()
+
+    kind = ""
 
     @property
     def name(self) -> str:
@@ -126,7 +134,7 @@ def split_meta_namespace_key(key: str):
 # core/v1 Service
 # ---------------------------------------------------------------------------
 
-@dataclass
+@dataclass(slots=True)
 class ServicePort:
     port: int
     protocol: str = "TCP"
@@ -141,7 +149,7 @@ class ServicePort:
                    name=d.get("name", ""))
 
 
-@dataclass
+@dataclass(slots=True)
 class LoadBalancerIngress:
     hostname: str = ""
     ip: str = ""
@@ -159,7 +167,7 @@ class LoadBalancerIngress:
         return cls(hostname=d.get("hostname", ""), ip=d.get("ip", ""))
 
 
-@dataclass
+@dataclass(slots=True)
 class ServiceSpec:
     type: str = "ClusterIP"
     ports: List[ServicePort] = field(default_factory=list)
@@ -181,7 +189,7 @@ class ServiceSpec:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class LoadBalancerStatus:
     ingress: List[LoadBalancerIngress] = field(default_factory=list)
 
@@ -194,7 +202,7 @@ class LoadBalancerStatus:
                             for i in d.get("ingress") or []])
 
 
-@dataclass
+@dataclass(slots=True)
 class ServiceStatus:
     load_balancer: LoadBalancerStatus = field(default_factory=LoadBalancerStatus)
 
@@ -207,7 +215,7 @@ class ServiceStatus:
             d.get("loadBalancer") or {}))
 
 
-@dataclass
+@dataclass(slots=True)
 class Service(KubeObject):
     kind = "Service"
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
@@ -251,41 +259,41 @@ class Service(KubeObject):
 # networking/v1 Ingress
 # ---------------------------------------------------------------------------
 
-@dataclass
+@dataclass(slots=True)
 class IngressServiceBackendPort:
     number: int = 0
     name: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class IngressServiceBackend:
     name: str = ""
     port: IngressServiceBackendPort = field(default_factory=IngressServiceBackendPort)
 
 
-@dataclass
+@dataclass(slots=True)
 class IngressBackend:
     service: Optional[IngressServiceBackend] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class HTTPIngressPath:
     path: str = "/"
     backend: IngressBackend = field(default_factory=IngressBackend)
 
 
-@dataclass
+@dataclass(slots=True)
 class HTTPIngressRuleValue:
     paths: List[HTTPIngressPath] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class IngressRule:
     host: str = ""
     http: Optional[HTTPIngressRuleValue] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class IngressSpec:
     ingress_class_name: Optional[str] = None
     default_backend: Optional[IngressBackend] = None
@@ -324,12 +332,12 @@ def _backend_from_dict(d: Optional[Dict[str, Any]]) -> Optional["IngressBackend"
             number=int(svc.get("port", {}).get("number", 0)))))
 
 
-@dataclass
+@dataclass(slots=True)
 class IngressStatus:
     load_balancer: LoadBalancerStatus = field(default_factory=LoadBalancerStatus)
 
 
-@dataclass
+@dataclass(slots=True)
 class Ingress(KubeObject):
     kind = "Ingress"
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
@@ -418,7 +426,7 @@ class Ingress(KubeObject):
 # core/v1 Event (recorder sink)
 # ---------------------------------------------------------------------------
 
-@dataclass
+@dataclass(slots=True)
 class Event(KubeObject):
     kind = "Event"
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
@@ -444,7 +452,7 @@ class Event(KubeObject):
 # coordination/v1 Lease (leader election lock)
 # ---------------------------------------------------------------------------
 
-@dataclass
+@dataclass(slots=True)
 class LeaseSpec:
     holder_identity: str = ""
     lease_duration_seconds: int = 0
@@ -453,7 +461,7 @@ class LeaseSpec:
     lease_transitions: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class Lease(KubeObject):
     kind = "Lease"
     metadata: ObjectMeta = field(default_factory=ObjectMeta)

@@ -52,12 +52,11 @@ across the queue boundary.
 from __future__ import annotations
 
 import heapq
-import threading
-import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..analysis import locks
+from ..simulation import clock as simclock
 
 # Traffic classes (the queue's two tiers).  CLASS_KEEP is the requeue
 # sentinel: preserve the item's recorded class (unknown items default
@@ -129,12 +128,12 @@ class BucketRateLimiter:
         self.qps = qps
         self.burst = burst
         self._tokens = float(burst)
-        self._last = time.monotonic()
+        self._last = simclock.monotonic()
         self._lock = locks.make_lock("ratelimiter-bucket")
 
     def when(self, item: Any) -> float:
         with self._lock:
-            now = time.monotonic()
+            now = simclock.monotonic()
             self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
             self._last = now
             if self._tokens >= 1.0:
@@ -201,6 +200,12 @@ def new_rate_limiting_queue(name: str = "", qps: float = 10.0,
     """
     import os
     pref = os.environ.get("AGAC_NATIVE_WORKQUEUE", "auto").lower()
+    if simclock.virtual_active():
+        # the native queue's blocking get() parks outside the GIL
+        # where the virtual clock cannot see it — under simulation the
+        # Python queue (whose waits ride the clock) is the only
+        # correct choice (simulation/clock.py "what stays wall-clock")
+        pref = "0"
     if pref not in ("0", "false", "off"):
         try:
             from .native_workqueue import NativeRateLimitingQueue, \
@@ -247,7 +252,7 @@ class RateLimitingQueue:
         self.depth_watermark = depth_watermark
         self.age_watermark = age_watermark
         self._rate_limiter = rate_limiter or default_controller_rate_limiter()
-        self._cond = threading.Condition(
+        self._cond = simclock.make_condition(
             locks.make_lock(f"workqueue[{name}]"))
         self._tiers: Dict[str, deque] = {
             CLASS_INTERACTIVE: deque(), CLASS_BACKGROUND: deque()}
@@ -280,9 +285,9 @@ class RateLimitingQueue:
         self._waiting: List[Tuple[float, int, Any]] = []
         self._waiting_index: Dict[Any, Tuple[float, int]] = {}
         self._waiting_seq = 0
-        self._waker = threading.Thread(target=self._wait_loop, daemon=True,
-                                       name=f"workqueue-waker-{name}")
-        self._waker.start()
+        self._waker = simclock.start_thread(
+            self._wait_loop, daemon=True,
+            name=f"workqueue-waker-{name}")
 
     # -- class bookkeeping (callers hold _cond) -------------------------
 
@@ -340,7 +345,7 @@ class RateLimitingQueue:
                     self._cond.notify()
             return
         self._dirty.add(item)
-        now = time.monotonic()
+        now = simclock.monotonic()
         self._enqueued_at.setdefault(item, now)
         if item in self._processing:
             return
@@ -398,15 +403,15 @@ class RateLimitingQueue:
     def get(self, timeout: Optional[float] = None):
         """Block until an item is available; returns (item, shutdown)."""
         with self._cond:
-            deadline = None if timeout is None else time.monotonic() + timeout
+            deadline = None if timeout is None else simclock.monotonic() + timeout
             while not any(self._tiers.values()) and not self._shutting_down:
                 remaining = None
                 if deadline is not None:
-                    remaining = deadline - time.monotonic()
+                    remaining = deadline - simclock.monotonic()
                     if remaining <= 0:
                         return None, False
                 self._cond.wait(remaining)
-            now = time.monotonic()
+            now = simclock.monotonic()
             tier = self._pick_tier_locked(now)
             if tier is None:
                 # shutting down and drained
@@ -429,7 +434,7 @@ class RateLimitingQueue:
             self._claimed.pop(item, None)
             self._claimed_trace.pop(item, None)
             if item in self._dirty:
-                self._runnable_at[item] = time.monotonic()
+                self._runnable_at[item] = simclock.monotonic()
                 self._tiers[self._class.get(item, CLASS_INTERACTIVE)] \
                     .append(item)
                 self._cond.notify()
@@ -519,7 +524,7 @@ class RateLimitingQueue:
             q = self._tiers[klass]
             if not q:
                 return 0.0
-            now = time.monotonic()
+            now = simclock.monotonic()
             return max(0.0, now - self._runnable_at.get(q[0], now))
 
     def overloaded(self) -> Optional[str]:
@@ -534,7 +539,7 @@ class RateLimitingQueue:
                 return "depth"
             iq = self._tiers[CLASS_INTERACTIVE]
             if self.age_watermark > 0 and iq:
-                now = time.monotonic()
+                now = simclock.monotonic()
                 if now - self._runnable_at.get(iq[0], now) \
                         > self.age_watermark:
                     return "age"
@@ -560,8 +565,8 @@ class RateLimitingQueue:
         # the latency stamp starts at the REQUEST, not at promotion
         # from the delay heap: the rate limiter's backoff is part
         # of the system's event->converged response time
-        self._enqueued_at.setdefault(item, time.monotonic())
-        deadline = time.monotonic() + delay
+        self._enqueued_at.setdefault(item, simclock.monotonic())
+        deadline = simclock.monotonic() + delay
         have = self._waiting_index.get(item)
         if have is not None and have[0] <= deadline:
             return  # an earlier wake is already scheduled
@@ -576,7 +581,7 @@ class RateLimitingQueue:
             with self._cond:
                 if self._shutting_down and not self._waiting:
                     return
-                now = time.monotonic()
+                now = simclock.monotonic()
                 while self._waiting and self._waiting[0][0] <= now:
                     deadline, seq, item = heapq.heappop(self._waiting)
                     if self._waiting_index.get(item) != (deadline, seq):
@@ -587,7 +592,12 @@ class RateLimitingQueue:
                         front=True)
                 if self._shutting_down:
                     return
-                timeout = 0.2
+                # the 0.2s poll bounds shutdown observation on the
+                # system clock; under a virtual clock idle wakes are
+                # pure scheduler churn (time advances only when every
+                # sim thread parks), so wait out the real next
+                # deadline — adds/shutdown notify this condition
+                timeout = 60.0 if simclock.virtual_active() else 0.2
                 if self._waiting:
                     timeout = min(timeout, max(0.0, self._waiting[0][0] - now))
                 self._cond.wait(timeout if timeout > 0 else 0.01)

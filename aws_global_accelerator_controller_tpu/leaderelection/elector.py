@@ -14,12 +14,12 @@ from __future__ import annotations
 import logging
 import random
 import threading
-import time
 import uuid
 import zlib
 from typing import Callable, Optional
 
 from ..errors import ConflictError, NotFoundError
+from ..simulation import clock as simclock
 from ..kube.client import KubeClient
 from ..kube.kubeconfig import KubeConfigError
 from ..kube.objects import Lease, LeaseSpec, ObjectMeta
@@ -97,7 +97,7 @@ class LeaseCandidate:
 
     def try_acquire_or_renew(self) -> bool:
         """One CAS attempt against the Lease object."""
-        now = time.time()
+        now = simclock.wall()
         try:
             lease = self.kube.leases.get(self.namespace, self.name)
         except NotFoundError:
@@ -227,7 +227,7 @@ class LeaderElection:
         self.retry_period = retry_period
         self.identity = identity or str(uuid.uuid4())
         self.fence = fence
-        self.is_leader = threading.Event()
+        self.is_leader = simclock.make_event()
         # set when the on_started_leading callback raised: the process
         # should exit non-zero instead of reporting a clean shutdown
         self.run_failed = False
@@ -322,7 +322,7 @@ class LeaderElection:
         if self.fence is not None:
             self.fence.arm(self._candidate.observed_transitions)
         self.is_leader.set()
-        leader_stop = threading.Event()
+        leader_stop = simclock.make_event()
 
         def _run_leading():
             # a crashed run callback must take the process down, not
@@ -339,21 +339,20 @@ class LeaderElection:
                 leader_stop.set()
                 stop.set()
 
-        runner = threading.Thread(
-            target=_run_leading, daemon=True, name="leader-run")
-        runner.start()
+        runner = simclock.start_thread(
+            _run_leading, daemon=True, name="leader-run")
 
-        last_renew = time.monotonic()
+        last_renew = simclock.monotonic()
         try:
             while not stop.is_set():
                 if self._attempt() and not self._candidate.deposed:
-                    last_renew = time.monotonic()
+                    last_renew = simclock.monotonic()
                 elif self._candidate.deposed:
                     self._step_down(leader_stop, on_stopped_leading,
                                     "lease taken over by another "
                                     "candidate")
                     return True
-                elif (time.monotonic() - last_renew
+                elif (simclock.monotonic() - last_renew
                         > self.renew_deadline):
                     self._step_down(leader_stop, on_stopped_leading,
                                     "renewals failed past the renew "
@@ -372,7 +371,7 @@ class LeaderElection:
             # cross-term interleaving the fence exists to prevent.
             # Bounded: a wedged callback delays the release, it does
             # not pin the lease forever.
-            runner.join(timeout=RELEASE_JOIN_TIMEOUT)
+            simclock.join_thread(runner, timeout=RELEASE_JOIN_TIMEOUT)
             if runner.is_alive():
                 logger.warning(
                     "leader run callback still draining %.0fs after "

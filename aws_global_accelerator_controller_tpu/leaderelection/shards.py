@@ -47,10 +47,10 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Callable, Dict, Optional
 
 from .. import metrics
+from ..simulation import clock as simclock
 from ..sharding import ShardSet, compute_assignment
 from .elector import LeaseCandidate, standby_jitter
 
@@ -109,7 +109,7 @@ class ShardLeaseManager:
         # monotonic time of the last successful renew per HELD shard
         self._last_renew: Dict[int, float] = {}
         self._sleep = standby_jitter(identity, retry_period)
-        self.started = threading.Event()
+        self.started = simclock.make_event()
 
     # -- membership -----------------------------------------------------
 
@@ -126,7 +126,7 @@ class ShardLeaseManager:
         write is failing, we are certainly alive; the OTHER replicas
         age us out on their side."""
         prefix = f"{self.name}-member-"
-        now = time.time()
+        now = simclock.wall()
         members = {self.identity}
         dead: "list[str]" = []
         try:
@@ -162,7 +162,7 @@ class ShardLeaseManager:
         if candidate.attempt():
             candidate.held = True
             candidate.deposed = False
-            self._last_renew[sid] = time.monotonic()
+            self._last_renew[sid] = simclock.monotonic()
             self.shards.acquire(sid, candidate.observed_transitions)
             metrics.record_shard_rebalance("acquired")
             logger.info("shard %d acquired by %s (token %d)", sid,
@@ -170,7 +170,7 @@ class ShardLeaseManager:
 
     def _handoff(self, sid: int, successor: "str | None") -> None:
         """Graceful rebalance away: trip → drain → seal → release."""
-        start = time.monotonic()
+        start = simclock.monotonic()
         candidate = self._candidates[sid]
         fence = self.shards.fence(sid)
         fence.trip(f"shard {sid} rebalanced to {successor}")
@@ -185,21 +185,21 @@ class ShardLeaseManager:
         self._last_renew.pop(sid, None)
         self.shards.release(sid)
         metrics.record_shard_rebalance("handoff")
-        metrics.record_shard_handoff_duration(time.monotonic() - start)
+        metrics.record_shard_handoff_duration(simclock.monotonic() - start)
         logger.info("shard %d handed off by %s (%.3fs)", sid,
-                    self.identity, time.monotonic() - start)
+                    self.identity, simclock.monotonic() - start)
 
     def _depose(self, sid: int, why: str) -> None:
         """Involuntary loss: seal FIRST (no drain — a deposed holder
         has no authority to flush under), then drop ownership."""
-        start = time.monotonic()
+        start = simclock.monotonic()
         candidate = self._candidates[sid]
         self.shards.fence(sid).seal(f"shard {sid} lease lost: {why}")
         candidate.mark_stepped_down()
         self._last_renew.pop(sid, None)
         self.shards.release(sid)
         metrics.record_shard_rebalance("deposed")
-        metrics.record_shard_handoff_duration(time.monotonic() - start)
+        metrics.record_shard_handoff_duration(simclock.monotonic() - start)
         logger.warning("shard %d lost by %s (%s)", sid, self.identity,
                        why)
 
@@ -223,7 +223,7 @@ class ShardLeaseManager:
             candidate = self._candidates[sid]
             armed = self.shards.token(sid)
             if candidate.attempt() and not candidate.deposed:
-                self._last_renew[sid] = time.monotonic()
+                self._last_renew[sid] = simclock.monotonic()
                 new_token = candidate.observed_transitions
                 if new_token > armed:
                     logger.warning(
@@ -237,8 +237,8 @@ class ShardLeaseManager:
                     metrics.record_shard_rebalance("retaken")
             elif candidate.deposed:
                 self._depose(sid, "taken over by another candidate")
-            elif (time.monotonic()
-                    - self._last_renew.get(sid, time.monotonic())
+            elif (simclock.monotonic()
+                    - self._last_renew.get(sid, simclock.monotonic())
                     > self.renew_deadline):
                 self._depose(sid, "renewals failed past the renew "
                                   "deadline")
@@ -247,7 +247,7 @@ class ShardLeaseManager:
         """One rebalance pass: heartbeat, renew held shards (sealing
         on deposal / renew-deadline overrun), then converge the held
         set toward the rendezvous assignment over the live members."""
-        start = time.monotonic()
+        start = simclock.monotonic()
         self._heartbeat()
         self._renew_held()
 
@@ -274,7 +274,7 @@ class ShardLeaseManager:
         # stall never silently eats their renew budget (the hard line
         # stays lease_duration: a replica stalled past that is
         # genuinely unresponsive and deserves its deposal)
-        if time.monotonic() - start > self.retry_period:
+        if simclock.monotonic() - start > self.retry_period:
             self._renew_held()
 
     def run(self, stop: threading.Event) -> None:
@@ -308,7 +308,6 @@ class ShardLeaseManager:
                              exc_info=True)
 
     def start_background(self, stop: threading.Event) -> threading.Thread:
-        t = threading.Thread(target=self.run, args=(stop,), daemon=True,
-                             name=f"shard-leases-{self.identity}")
-        t.start()
+        t = simclock.start_thread(self.run, args=(stop,), daemon=True,
+                                  name=f"shard-leases-{self.identity}")
         return t

@@ -25,11 +25,11 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
 from .. import metrics
+from ..simulation import clock as simclock
 from ..cloudprovider.aws.factory import CloudFactory
 from ..controller.endpointgroupbinding import (
     EndpointGroupBindingConfig,
@@ -65,9 +65,8 @@ def _start_global_accelerator(kube, operator, informer_factory,
     """(reference pkg/manager/globalaccelerator.go:12-19)"""
     controller = GlobalAcceleratorController(
         kube, informer_factory, cloud_factory, config.global_accelerator)
-    t = threading.Thread(target=controller.run, args=(stop,), daemon=True,
-                         name="global-accelerator-controller")
-    t.start()
+    t = simclock.start_thread(controller.run, args=(stop,), daemon=True,
+                              name="global-accelerator-controller")
     return t
 
 
@@ -76,9 +75,8 @@ def _start_route53(kube, operator, informer_factory, cloud_factory, config,
     """(reference pkg/manager/route53.go:12-19)"""
     controller = Route53Controller(
         kube, informer_factory, cloud_factory, config.route53)
-    t = threading.Thread(target=controller.run, args=(stop,), daemon=True,
-                         name="route53-controller")
-    t.start()
+    t = simclock.start_thread(controller.run, args=(stop,), daemon=True,
+                              name="route53-controller")
     return t
 
 
@@ -88,9 +86,8 @@ def _start_endpoint_group_binding(kube, operator, informer_factory,
     controller = EndpointGroupBindingController(
         kube, operator, informer_factory, cloud_factory,
         config.endpoint_group_binding)
-    t = threading.Thread(target=controller.run, args=(stop,), daemon=True,
-                         name="endpoint-group-binding-controller")
-    t.start()
+    t = simclock.start_thread(controller.run, args=(stop,), daemon=True,
+                              name="endpoint-group-binding-controller")
     return t
 
 
@@ -127,14 +124,14 @@ class ManagerHandle:
 
     def join(self, timeout: Optional[float] = None) -> None:
         for t in self.threads:
-            t.join(timeout)
+            simclock.join_thread(t, timeout)
 
     def stop(self, deadline: float = 10.0) -> dict:
         """Ordered, fenced shutdown under one wall-clock budget;
         returns a phase report ``{drained, joined, duration_s}``.
         Safe to call more than once (later calls find the fence
         already tripped and the threads already gone)."""
-        start = time.monotonic()
+        start = simclock.monotonic()
         fence = (self.cloud_factory.fence
                  if self.cloud_factory is not None else None)
         # 1. fence new mutation intents
@@ -151,23 +148,23 @@ class ManagerHandle:
         # 4. stop workers/queues/informers, bounded by the remainder
         if self.stop_event is not None:
             self.stop_event.set()
-        remaining = max(0.5, deadline - (time.monotonic() - start))
+        remaining = max(0.5, deadline - (simclock.monotonic() - start))
         per_thread = remaining / max(1, len(self.threads))
         for t in self.threads:
-            t.join(per_thread)
+            simclock.join_thread(t, per_thread)
         joined = not any(t.is_alive() for t in self.threads)
         # 5. flush async event recording so final reconciles' events
         # reach the API before exit — re-budgeted AFTER the joins so
         # the whole stop stays inside the one wall-clock deadline
         # (a small floor keeps the flush from degenerating to a no-op)
         if self.kube_client is not None:
-            left = max(0.2, deadline - (time.monotonic() - start))
+            left = max(0.2, deadline - (simclock.monotonic() - start))
             try:
                 self.kube_client.flush_events(timeout=min(5.0, left))
             except Exception:
                 logger.debug("event flush at shutdown failed",
                              exc_info=True)
-        duration = time.monotonic() - start
+        duration = simclock.monotonic() - start
         metrics.record_shutdown_duration(duration)
         if not drained or not joined:
             logger.warning("ordered stop incomplete: drained=%s "

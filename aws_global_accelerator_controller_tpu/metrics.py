@@ -56,6 +56,9 @@ class Registry:
         self._histograms: Dict[Tuple[str, Tuple],
                                Tuple[Tuple, List[int], float, int]] = {}
         self._gauge_fns: List[Tuple[str, Tuple, Callable[[], float]]] = []
+        # (name, labels) -> last set value (set_gauge — the push-style
+        # gauges: sim_time_ratio, per_service_bytes)
+        self._gauge_values: Dict[Tuple[str, Tuple], float] = {}
         self._help: Dict[str, str] = {}
         # every metric name ever recorded through this registry — the
         # metrics-hygiene contract's evidence (each must have a
@@ -106,6 +109,25 @@ class Registry:
                 return self._counters.get(
                     (name, tuple(sorted(labels.items()))), 0.0)
             return sum(v for (n, _), v in self._counters.items()
+                       if n == name)
+
+    def set_gauge(self, name: str, labels: Dict[str, str],
+                  value: float) -> None:
+        """Push-style gauge: record the latest value (rendered like a
+        callback gauge; the scale bench's sim_time_ratio /
+        per_service_bytes surface)."""
+        with self._lock:
+            self._recorded.add(name)
+            self._gauge_values[(name, tuple(sorted(labels.items())))] \
+                = value
+
+    def gauge_value(self, name: str,
+                    labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            if labels is not None:
+                return self._gauge_values.get(
+                    (name, tuple(sorted(labels.items()))), 0.0)
+            return sum(v for (n, _), v in self._gauge_values.items()
                        if n == name)
 
     def observe_summary(self, name: str, labels: Dict[str, str],
@@ -183,6 +205,7 @@ class Registry:
             histograms = {k: (v[0], list(v[1]), v[2], v[3])
                           for k, v in self._histograms.items()}
             gauges = list(self._gauge_fns)
+            gauge_values = dict(self._gauge_values)
             helps = dict(self._help)
             exemplars = {k: dict(v) for k, v in self._exemplars.items()}
 
@@ -223,6 +246,9 @@ class Registry:
                 pairs = ",".join(f"{k}={v}" for k, v in sorted(ex.items()))
                 lines.append(f"# EXEMPLAR {name}"
                              f"{self._fmt_labels(labels)} {pairs}")
+        for (name, labels), value in sorted(gauge_values.items()):
+            emit_help(name, "gauge")
+            lines.append(f"{name}{self._fmt_labels(labels)} {value}")
         for name, labels, fn in gauges:
             emit_help(name, "gauge")
             try:
@@ -344,6 +370,16 @@ default_registry.describe(
     "Wall-clock of ordered manager shutdowns (fence -> coalescer "
     "drain -> seal -> workqueue drain -> worker join), observed once "
     "per stop (manager/manager.py ManagerHandle.stop).")
+default_registry.describe(
+    "sim_time_ratio",
+    "Simulated seconds per wall second of the active virtual-time "
+    "run (simulation/clock.py VirtualClock; 1.0 under system time) — "
+    "the scale-storm bench's speed-up gauge.")
+default_registry.describe(
+    "per_service_bytes",
+    "Accounted controller-side bytes per service at the last memory "
+    "measurement (simulation/memory.py fleet_bytes: informer caches, "
+    "apiserver store, fleet index, fingerprint records — sampled).")
 default_registry.describe(
     "reconcile_latency_seconds",
     "Event->converged latency per controller queue and traffic class "
@@ -770,6 +806,26 @@ def record_stage_seconds(stage: str, controller: str, seconds: float,
         seconds, buckets=STAGE_BUCKETS,
         exemplar={"trace_id": str(trace_id)}
         if trace_id is not None else None)
+
+
+def record_sim_time_ratio(ratio: float,
+                          registry: Optional[Registry] = None) -> None:
+    """Simulated/wall seconds of the active virtual-time run
+    (simulation/clock.py ``VirtualClock.stats``): how much faster than
+    real time the scenario executed — the scale-storm bench's headline
+    gauge (1.0 under system time)."""
+    reg = registry or default_registry
+    reg.set_gauge("sim_time_ratio", {}, ratio)
+
+
+def record_per_service_bytes(value: float,
+                             registry: Optional[Registry] = None) -> None:
+    """Accounted controller-side bytes per service at the last memory
+    measurement (simulation/memory.py ``fleet_bytes``): informer
+    caches + apiserver store + fleet index + fingerprints, sampled —
+    the memory-diet acceptance gauge."""
+    reg = registry or default_registry
+    reg.set_gauge("per_service_bytes", {}, value)
 
 
 def record_flight_dump(reason: str,

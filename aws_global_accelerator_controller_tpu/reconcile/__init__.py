@@ -27,13 +27,13 @@ a slow tier; event-origin dispatches always take the full path.
 from __future__ import annotations
 
 import logging
-import time
 import zlib
 from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 from .. import metrics
+from ..simulation import clock as simclock
 from ..errors import is_no_retry, is_not_found, retry_after_hint
 from ..kube.workqueue import CLASS_INTERACTIVE, CLASS_KEEP, RateLimitingQueue
 from ..tracing import default_ledger, default_tracer
@@ -119,7 +119,7 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                      "dropped", key)
         return
 
-    start = time.monotonic()
+    start = simclock.monotonic()
     res = Result()
     err: Optional[Exception] = None
     obj = None
@@ -181,7 +181,7 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                 metrics.record_fastpath_skip(fingerprints.controller)
                 span.attributes["outcome"] = "fastpath_skip"
                 logger.debug("fingerprint unchanged for %r, skipped "
-                             "(%.6fs)", key, time.monotonic() - start)
+                             "(%.6fs)", key, simclock.monotonic() - start)
                 return
             # a sweep delivery is a DEEP VERIFY only when the recorded
             # fingerprint still matches (the Kubernetes side is
@@ -279,7 +279,7 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
             # this success, spanning any requeues/parks in between
             metrics.record_reconcile_latency(
                 queue.name or "queue", klass,
-                time.monotonic() - first_enqueued)
+                simclock.monotonic() - first_enqueued)
             if ctx is not None:
                 # close the trace and assemble the per-stage ledger
                 # record (queued/planned/coalesced/inflight/baked) —
@@ -287,7 +287,7 @@ def _reconcile_handler(key, queue, key_to_obj, process_delete,
                 ctx.hop("converged")
                 default_ledger.record(queue.name or "queue", key, ctx)
             logger.debug("successfully synced %r (%.3fs)",
-                         key, time.monotonic() - start)
+                         key, simclock.monotonic() - start)
         span.attributes["outcome"] = outcome
     metrics.record_sync(queue.name or "queue", outcome,
-                        time.monotonic() - start)
+                        simclock.monotonic() - start)

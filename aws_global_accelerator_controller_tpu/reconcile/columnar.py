@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..ops.diff import EMPTY
+from .interning import InternTable, intern_str  # noqa: F401  (PR-11 import site)
 
 # weight_mode column values: how a group's desired weights are decided
 MODE_MODEL = 0   # spec.weight null -> model-planned 255-budget split
@@ -86,34 +87,6 @@ class GroupState:
         if self.spec_weight is not None:
             return MODE_SPEC
         return MODE_MODEL if self.model_planned else MODE_NONE
-
-
-class InternTable:
-    """Dense string <-> int32 interning (append-only).
-
-    Dense ids — not hashes — are the device-side tokens: equality on
-    device is exact (no 31-bit CRC collisions silently merging two
-    ARNs into one endpoint) and decode is an O(1) list index.
-    """
-
-    def __init__(self):
-        self._ids: Dict[str, int] = {}
-        self._strings: List[str] = []
-
-    def intern(self, s: str) -> int:
-        got = self._ids.get(s)
-        if got is not None:
-            return got
-        i = len(self._strings)
-        self._ids[s] = i
-        self._strings.append(s)
-        return i
-
-    def string_of(self, i: int) -> str:
-        return self._strings[i]
-
-    def __len__(self) -> int:
-        return len(self._strings)
 
 
 @dataclass

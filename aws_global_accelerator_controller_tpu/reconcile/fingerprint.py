@@ -55,6 +55,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from .. import metrics
+from .interning import intern_str
 from ..analysis import locks
 
 ORIGIN_EVENT = "event"
@@ -168,13 +169,15 @@ class FingerprintCache:
 
     # -- fingerprinting -------------------------------------------------
 
-    def fingerprint(self, obj) -> "tuple[int, str]":
+    def fingerprint(self, obj) -> "tuple[int, bytes]":
         """(generation, digest) of the live object.  The digest
         canonicalizes whatever the builder returns via ``repr`` — the
         builders return tuples of primitives, so the representation is
-        deterministic across processes."""
+        deterministic across processes.  Raw 20-byte digest, not the
+        hex string: at the 100k-entry cache bound the hex spelling
+        alone cost ~4 MB (the ISSUE-13 memory diet)."""
         fields = self._fn(obj)
-        digest = hashlib.sha1(repr(fields).encode()).hexdigest()
+        digest = hashlib.sha1(repr(fields).encode()).digest()
         return obj.metadata.generation, digest
 
     # -- enqueue-origin bookkeeping ------------------------------------
@@ -252,6 +255,7 @@ class FingerprintCache:
         if not self.config.enabled:
             return
         fp = self.fingerprint(obj)
+        key = intern_str(key)  # one canonical key string per cache entry
         with self._lock:
             self._fp.pop(key, None)
             self._fp[key] = fp

@@ -1,0 +1,58 @@
+"""Dense string interning (PR-11's ``InternTable``) + the shared
+map-side canonicalizer (ISSUE 13's memory diet).
+
+Extracted from reconcile/columnar.py so the provider's fleet index and
+the informer caches can intern ARN/hostname strings WITHOUT importing
+the columnar planner (which pulls jax at module load — the controller
+import path must stay accelerator-free).  columnar re-exports both
+names, so planner call sites are unchanged.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+
+class InternTable:
+    """Dense string <-> int32 interning (append-only).
+
+    Dense ids — not hashes — are the device-side tokens: equality on
+    device is exact (no 31-bit CRC collisions silently merging two
+    ARNs into one endpoint) and decode is an O(1) list index.
+    """
+
+    def __init__(self):
+        self._ids: Dict[str, int] = {}
+        self._strings: List[str] = []
+
+    def intern(self, s: str) -> int:
+        got = self._ids.get(s)
+        if got is not None:
+            return got
+        i = len(self._strings)
+        self._ids[s] = i
+        self._strings.append(s)
+        return i
+
+    def string_of(self, i: int) -> str:
+        return self._strings[i]
+
+    def __len__(self) -> int:
+        return len(self._strings)
+
+    def canonical(self, s: str) -> str:
+        """The table's single shared instance of ``s`` (dense-id side;
+        map-side callers use :func:`intern_str`)."""
+        return self._strings[self.intern(s)]
+
+
+def intern_str(s: str) -> str:
+    """Canonicalize ``s`` so equal strings from different parses share
+    ONE allocation — the fleet index, discovery cache, fingerprint
+    keys and informer maps at 100k-1M keys pay for each distinct
+    ARN/hostname once.  Backed by ``sys.intern``: lock-free, and an
+    interned string is RELEASED when its last reference dies, so
+    delete churn cannot grow the table forever (the planner's
+    append-only :class:`InternTable` keeps its dense-id contract for
+    arrays; maps only need the canonical-instance half)."""
+    return sys.intern(s)

@@ -30,11 +30,11 @@ outside every lock.
 """
 from __future__ import annotations
 
-import time
 from collections import deque
 from typing import Optional
 
 from .. import metrics
+from ..simulation import clock as simclock
 from ..analysis import locks
 from ..errors import AWSAPIError
 
@@ -65,7 +65,7 @@ class CircuitBreaker:
                  min_calls: int = 10, failure_threshold: float = 0.5,
                  open_seconds: float = 5.0, half_open_probes: int = 1,
                  registry: "Optional[metrics.Registry]" = None,
-                 clock=time.monotonic):
+                 clock=simclock.monotonic):
         self.region = region
         self._clock = clock
         self.window = window
@@ -219,7 +219,7 @@ class AdaptiveTokenBucket:
     def __init__(self, capacity: float = 500.0,
                  refill_rate: float = 1000.0, min_capacity: float = 5.0,
                  shrink_factor: float = 0.5, recover_step: float = 1.0,
-                 region: str = "global", clock=time.monotonic):
+                 region: str = "global", clock=simclock.monotonic):
         self._clock = clock
         self.max_capacity = float(capacity)
         self.refill_rate = float(refill_rate)

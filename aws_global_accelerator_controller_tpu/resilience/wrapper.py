@@ -34,11 +34,11 @@ the reconcile loop parks the key instead of hot-requeuing.
 from __future__ import annotations
 
 import random
-import time
 from dataclasses import dataclass
 from typing import Optional
 
 from .. import metrics
+from ..simulation import clock as simclock
 from ..tracing import default_tracer, stamp_ambient
 from .breaker import AdaptiveTokenBucket, CircuitBreaker
 from .classify import ErrorClass, classify
@@ -175,7 +175,7 @@ class ResilientAPIs:
     def __init__(self, inner, region: str = "global",
                  config: Optional[ResilienceConfig] = None,
                  registry: "Optional[metrics.Registry]" = None,
-                 clock=time.monotonic, sleep=time.sleep):
+                 clock=simclock.monotonic, sleep=simclock.sleep):
         cfg = config or ResilienceConfig()
         self.inner = inner
         self.region = region

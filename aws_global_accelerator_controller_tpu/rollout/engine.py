@@ -33,7 +33,6 @@ mid-ramp.
 from __future__ import annotations
 
 import logging
-import time
 from typing import Callable, Dict, Optional
 
 from .. import metrics
@@ -45,6 +44,7 @@ from ..apis import (
     ROLLOUT_STEPS_ANNOTATION,
 )
 from ..analysis import locks
+from ..simulation import clock as simclock
 from .machine import (
     HEALTH_DEGRADED,
     HEALTH_FAILED,
@@ -134,8 +134,8 @@ class RolloutEngine:
 
     def __init__(self, controller: str, shards=None,
                  region_health: Optional[Callable[[], "tuple"]] = None,
-                 clock: Callable[[], float] = time.time,
-                 monotonic: Callable[[], float] = time.monotonic,
+                 clock: Callable[[], float] = simclock.wall,
+                 monotonic: Callable[[], float] = simclock.monotonic,
                  registry=None):
         self.controller = controller
         self.shards = shards

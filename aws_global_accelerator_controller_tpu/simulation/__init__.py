@@ -1,0 +1,22 @@
+"""Deterministic virtual-time simulation + per-service memory
+accounting (ISSUE 13; ROADMAP item 2).
+
+``simulation.clock`` is the process-wide time source every timing
+surface reads (lint rule L115 enforces it); installing a
+:class:`~.clock.VirtualClock` flips the process into discrete-event
+simulation.  ``simulation.memory`` is the million-key diet's
+measuring stick.
+"""
+from .clock import (
+    SimCondition,
+    SimEvent,
+    SimQueue,
+    SimStallError,
+    VirtualClock,
+)
+from .memory import deep_sizeof, fleet_bytes, peak_rss_bytes
+
+__all__ = [
+    "SimCondition", "SimEvent", "SimQueue", "SimStallError",
+    "VirtualClock", "deep_sizeof", "fleet_bytes", "peak_rss_bytes",
+]

@@ -1,0 +1,719 @@
+"""Deterministic virtual time: the process-wide clock every timing
+surface reads (ISSUE 13; ROADMAP item 2).
+
+Real-time tests and production run on the **system clock** — the
+module-level :func:`monotonic` / :func:`wall` / :func:`sleep` delegate
+straight to :mod:`time`, and the factory helpers
+(:func:`make_event` / :func:`make_condition` / :func:`make_queue`)
+return primitives that behave exactly like their :mod:`threading` /
+:mod:`queue` counterparts.  Installing a :class:`VirtualClock`
+(``with VirtualClock().activate():``) flips the whole process into
+**discrete-event simulation**, FoundationDB-style:
+
+- ``monotonic()`` returns *virtual* seconds; ``wall()`` a virtual
+  epoch offset by the same amount.
+- every blocking wait — ``sleep``, ``SimEvent.wait``,
+  ``SimCondition.wait``, ``SimQueue.get``, ``join_thread`` — PARKS the
+  calling thread in the clock instead of the OS.
+- the scheduler advances virtual time **to the next due waiter only
+  when every sim thread is parked**: no busy-waiting, no real-time
+  races, and a 5-minute lease expiry costs microseconds of wall time.
+- execution is SERIAL and cooperative: at most one sim thread runs at
+  a time, resumed in deterministic order (FIFO for notified waiters,
+  ``(deadline, park-sequence)`` for timers), so a seeded chaos
+  scenario replays with an identical interleaving — the determinism
+  proof test (tests/chaos/test_chaos_determinism.py) asserts the
+  decision logs byte-identical across runs.
+
+Park/advance rule (the contract ARCHITECTURE.md documents):
+
+1. A thread becomes a *sim thread* the first time it parks (or when
+   spawned via :func:`start_thread`, which parks the child until the
+   scheduler resumes it — a spawn never races its parent).
+2. Time NEVER advances while any sim thread runs.  When the last one
+   parks: first resume notified waiters FIFO; only when none are
+   runnable, pop the earliest timer, advance ``now`` to its deadline
+   and resume exactly that waiter.
+3. All parked, no runnable, no timer = the simulation is wedged —
+   :class:`SimStallError` is raised in the most recently parked
+   thread, naming every parked thread (a real deadlock surfaces
+   loudly instead of hanging the test).
+
+What stays wall-clock: the native C++ workqueue (its ``get`` parks
+outside the GIL where the clock cannot see it — ``kube/workqueue.py``
+``new_rate_limiting_queue`` falls back to the Python queue while a
+virtual clock is active), the HTTP backends (``kube/http_store.py``,
+``kube/rest_server.py``, ``kube/kubeconfig.py``), boto
+(``cloudprovider/aws/real.py``) and subprocess drivers — real I/O is
+the simulation boundary.  Lint rule L115 keeps every other timing
+surface on this module: a bare ``time.sleep`` in a clock-owned
+package is a wall-clock leak that silently breaks virtual-time
+determinism.
+
+Locks are deliberately NOT virtualized: the concurrency contracts
+(L102 — never block while holding a lock) guarantee no sim thread
+parks with a lock held, so real locks only ever see uncontended or
+momentary waits.
+"""
+from __future__ import annotations
+
+import heapq
+import queue as queue_mod
+import threading
+import time as _time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_real_monotonic = _time.monotonic
+_real_time = _time.time
+_real_sleep = _time.sleep
+
+# the installed virtual clock (None = system time).  Written only by
+# VirtualClock.activate/deactivate; read on every clock call.
+_installed: "Optional[VirtualClock]" = None
+_install_lock = threading.Lock()
+
+
+class SimStallError(RuntimeError):
+    """Every sim thread is parked, nothing is runnable and no timer is
+    pending: the simulated program deadlocked (or the driver forgot a
+    timed wait).  Raised in the most recently parked thread so the
+    wedge surfaces as a test failure instead of a hang."""
+
+
+# ---------------------------------------------------------------------------
+# module-level clock surface (what the plumbed call sites use)
+# ---------------------------------------------------------------------------
+
+
+def active() -> "Optional[VirtualClock]":
+    """The installed virtual clock, or None under system time."""
+    return _installed
+
+
+def virtual_active() -> bool:
+    return _installed is not None
+
+
+def monotonic() -> float:
+    """Monotonic now: virtual seconds under a VirtualClock, else
+    ``time.monotonic()``."""
+    clk = _installed
+    return clk.now() if clk is not None else _real_monotonic()
+
+
+def wall() -> float:
+    """Wall-clock now: the virtual epoch under a VirtualClock, else
+    ``time.time()``."""
+    clk = _installed
+    return clk.wall_now() if clk is not None else _real_time()
+
+
+def sleep(seconds: float) -> None:
+    """Park for ``seconds``: virtual (zero wall cost) under a
+    VirtualClock, else ``time.sleep``."""
+    clk = _installed
+    if clk is None:
+        _real_sleep(seconds)
+    else:
+        clk.sleep(seconds)
+
+
+def make_event() -> "SimEvent":
+    """A clock-aware :class:`threading.Event` — identical behavior
+    under system time, parks in the clock under virtual time."""
+    return SimEvent()
+
+
+def make_condition(lock=None) -> "SimCondition":
+    """A clock-aware :class:`threading.Condition` over ``lock``."""
+    return SimCondition(lock)
+
+
+def make_queue(maxsize: int = 0):
+    """A watch-subscription / event-buffer queue: stdlib
+    :class:`queue.Queue` under system time (its internal timed waits
+    use real monotonic arithmetic, which a virtual clock would
+    starve), a :class:`SimQueue` while a virtual clock is active."""
+    if _installed is not None:
+        return SimQueue(maxsize)
+    return queue_mod.Queue(maxsize)
+
+
+def start_thread(target: Callable, name: Optional[str] = None,
+                 daemon: bool = True, args: tuple = (),
+                 kwargs: Optional[dict] = None) -> threading.Thread:
+    """Spawn a thread that participates in the active clock.  Under
+    system time this is a plain started :class:`threading.Thread`;
+    under a virtual clock the child registers as a sim thread and
+    PARKS until the scheduler resumes it, so a spawn never races its
+    parent."""
+    clk = _installed
+    if clk is None:
+        t = threading.Thread(target=target, args=args,
+                             kwargs=kwargs or {}, daemon=daemon,
+                             name=name)
+        t.start()
+        return t
+    return clk.spawn(target, name=name, daemon=daemon, args=args,
+                     kwargs=kwargs or {})
+
+
+def join_thread(thread: threading.Thread,
+                timeout: Optional[float] = None) -> None:
+    """Join a thread without stalling the simulation: a sim-spawned
+    thread is awaited via its clock-aware done event (then reaped with
+    a short real join); anything else joins normally."""
+    done = getattr(thread, "_sim_done", None)
+    if _installed is None or done is None:
+        thread.join(timeout)
+        return
+    done.wait(timeout)
+    if done.is_set():
+        # past its target; only deregistration remains — a bounded
+        # REAL join reaps it so is_alive() reads False for callers
+        thread.join(1.0)
+
+
+def wait_until(predicate: Callable[[], bool], timeout: float,
+               poll: float = 0.01) -> bool:
+    """Poll ``predicate`` until true or ``timeout`` — on the active
+    clock, so a virtual-time driver parks between polls (letting the
+    machinery run) instead of burning wall time."""
+    deadline = monotonic() + timeout
+    while monotonic() < deadline:
+        if predicate():
+            return True
+        sleep(poll)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# the virtual clock
+# ---------------------------------------------------------------------------
+
+_RUNNING = "running"
+_PARKED = "parked"
+
+
+class _Waiter:
+    """One parked thread's resume token.  ``fired`` flips exactly once
+    (under the clock lock) when the waiter is claimed — by a notify
+    (``notified=True``), its timer, a stall, or a pre-park set — so a
+    racing set() and deadline can never double-resume."""
+
+    __slots__ = ("event", "clock", "tid", "fired", "parked",
+                 "notified", "stall")
+
+    def __init__(self, clock: "Optional[VirtualClock]"):
+        self.event = threading.Event()
+        self.clock = clock
+        self.tid: Optional[int] = None
+        self.fired = False
+        self.parked = False
+        self.notified = False
+        self.stall: Optional[str] = None
+
+
+class VirtualClock:
+    """Monotone event-driven time source with a waiter heap (module
+    docstring has the park/advance rule).  ``max_virtual`` bounds how
+    far ``now`` may advance — a runaway simulation (a loop that only
+    ever sleeps) stalls loudly instead of spinning forever."""
+
+    def __init__(self, start: float = 0.0,
+                 wall_epoch: float = 1_600_000_000.0,
+                 max_virtual: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._now = float(start)
+        self._wall_offset = wall_epoch - float(start)
+        self._max_virtual = max_virtual
+        # tid -> _RUNNING | _PARKED for every sim thread
+        self._threads: Dict[int, str] = {}
+        self._names: Dict[int, str] = {}
+        # tid -> threading.Thread, for liveness pruning: an
+        # AUTO-registered thread (a leftover worker from an earlier
+        # abruptly-stopped cluster that wandered into this clock) may
+        # exit without deregistering — counted RUNNING forever, it
+        # would freeze the scheduler, so the advance step prunes dead
+        # members before concluding someone is still running
+        self._members: Dict[int, threading.Thread] = {}
+        self._running = 0
+        self._runnable: "deque[_Waiter]" = deque()
+        self._timers: List[Tuple[float, int, _Waiter]] = []
+        self._parked_waiters: Dict[int, _Waiter] = {}
+        self._seq = 0
+        # stats (sim_time_ratio, the bench's simulated-vs-wall story)
+        self._started_real = _real_monotonic()
+        self._started_virtual = float(start)
+        self.parks = 0
+        self.advances = 0
+        # real-time watchdog (started by activate): a FOREIGN thread —
+        # auto-registered because it wandered into a clock wait — can
+        # die without deregistering, leaving the run count pinned > 0
+        # after the last sim park, which wedges the scheduler with no
+        # one left to kick it.  The watchdog prunes dead members on a
+        # coarse REAL cadence and re-runs the advance step; it never
+        # touches live state, so determinism is unaffected (it only
+        # acts on a condition that is already outside the
+        # deterministic model).
+        self._watchdog_stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+
+    # -- install ------------------------------------------------------
+
+    def activate(self, register: bool = True) -> "VirtualClock":
+        """Install this clock process-wide (``register=True`` also
+        makes the calling thread a sim thread, so the driver's waits
+        participate from the first call).  Returns self; use as a
+        context manager for scoped installs."""
+        global _installed
+        with _install_lock:
+            if _installed is not None and _installed is not self:
+                raise RuntimeError("another VirtualClock is active")
+            _installed = self
+        self._started_real = _real_monotonic()
+        self._started_virtual = self._now
+        if register:
+            self.register_current("driver")
+        if self._watchdog is None or not self._watchdog.is_alive():
+            # re-activation after deactivate(): the previous watchdog
+            # observed the stop flag and exited — clear it and start a
+            # fresh one, or dead-foreign-thread pruning is silently off
+            self._watchdog_stop.clear()
+            self._watchdog = threading.Thread(
+                target=self._watch_loop, daemon=True,
+                name="simclock-watchdog")
+            self._watchdog.start()
+        return self
+
+    def deactivate(self) -> None:
+        global _installed
+        with _install_lock:
+            if _installed is self:
+                _installed = None
+        self._watchdog_stop.set()
+        with self._lock:
+            self._threads.pop(threading.get_ident(), None)
+            # any thread still parked would hang forever with the
+            # clock gone: resume them all (their waits read as timed
+            # out and their loops re-check state on the system clock).
+            # The runnable queue too — a waiter already claimed for
+            # resume (fired=True) but not yet handed the turn has an
+            # unset event, and dropping it would strand its thread.
+            for w in self._runnable:
+                w.event.set()
+            self._runnable.clear()
+            for w in list(self._parked_waiters.values()):
+                if not w.fired:
+                    w.fired = True
+                w.event.set()
+            self._parked_waiters.clear()
+            self._threads.clear()
+            self._members.clear()
+            self._names.clear()
+            self._running = 0
+            self._runnable.clear()
+            self._timers = []
+
+    def __enter__(self) -> "VirtualClock":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.deactivate()
+
+    # -- reading time -------------------------------------------------
+
+    def now(self) -> float:
+        return self._now  # float read is atomic under the GIL
+
+    def wall_now(self) -> float:
+        return self._now + self._wall_offset
+
+    def stats(self) -> dict:
+        """Simulated-vs-wall accounting for the scale bench:
+        ``sim_seconds``, ``wall_seconds``, ``sim_time_ratio``,
+        ``parks``, ``advances``."""
+        wall_s = max(1e-9, _real_monotonic() - self._started_real)
+        sim_s = self._now - self._started_virtual
+        return {"sim_seconds": sim_s, "wall_seconds": wall_s,
+                "sim_time_ratio": sim_s / wall_s,
+                "parks": self.parks, "advances": self.advances}
+
+    # -- thread registry ----------------------------------------------
+
+    def register_current(self, name: str = "") -> None:
+        """Make the calling thread a sim thread NOW (before its first
+        park) so time cannot advance while it still runs."""
+        tid = threading.get_ident()
+        with self._lock:
+            if tid not in self._threads:
+                self._threads[tid] = _RUNNING
+                self._names[tid] = name or threading.current_thread().name
+                self._members[tid] = threading.current_thread()
+                self._running += 1
+
+    def unregister_current(self) -> None:
+        """Withdraw the calling thread from the simulation (a driver
+        handing off to real-time teardown)."""
+        tid = threading.get_ident()
+        with self._lock:
+            if self._threads.pop(tid, None) == _RUNNING:
+                self._running -= 1
+            self._names.pop(tid, None)
+            self._members.pop(tid, None)
+            self._parked_waiters.pop(tid, None)
+            self._maybe_advance_locked()
+
+    def _ensure_registered_locked(self, tid: int) -> None:
+        if tid not in self._threads:
+            self._threads[tid] = _RUNNING
+            self._names[tid] = threading.current_thread().name
+            self._members[tid] = threading.current_thread()
+            self._running += 1
+
+    def _prune_dead_locked(self) -> None:
+        """Drop members that exited while counted RUNNING (possible
+        only for auto-registered foreign threads; spawn()-ed threads
+        deregister in their finally) — without this, one dead
+        straggler freezes the scheduler forever."""
+        for tid, state in list(self._threads.items()):
+            if state != _RUNNING:
+                continue
+            member = self._members.get(tid)
+            if member is not None and not member.is_alive():
+                del self._threads[tid]
+                self._members.pop(tid, None)
+                self._names.pop(tid, None)
+                self._running -= 1
+
+    # -- park / wake / advance ----------------------------------------
+
+    def _watch_loop(self) -> None:
+        while not self._watchdog_stop.wait(0.25):
+            with self._lock:
+                if self._running > 0:
+                    self._prune_dead_locked()
+                    if self._running == 0:
+                        self._maybe_advance_locked()
+
+    def park(self, waiter: _Waiter, timeout: Optional[float] = None
+             ) -> bool:
+        """Block the calling thread until the waiter is notified or
+        ``timeout`` virtual seconds elapse; returns True iff notified.
+        The heart of every sim wait — callers must hold NO lock the
+        waker needs (the L102 contract)."""
+        tid = threading.get_ident()
+        waiter.tid = tid
+        with self._lock:
+            self._ensure_registered_locked(tid)
+            if waiter.fired:
+                return True  # set()/notify landed before the park
+            waiter.parked = True
+            self._threads[tid] = _PARKED
+            self._running -= 1
+            self._parked_waiters[tid] = waiter
+            self.parks += 1
+            if timeout is not None:
+                self._seq += 1
+                heapq.heappush(
+                    self._timers,
+                    (self._now + max(0.0, timeout), self._seq, waiter))
+            self._maybe_advance_locked(stall_waiter=waiter)
+        waiter.event.wait()
+        if waiter.stall is not None:
+            raise SimStallError(waiter.stall)
+        return waiter.notified
+
+    def wake(self, waiter: _Waiter) -> None:
+        """Mark a parked waiter notified-and-runnable (FIFO).  Called
+        by SimEvent.set / SimCondition.notify — from sim threads AND
+        from unregistered (external) threads, in which case the
+        scheduler may need a kick here."""
+        with self._lock:
+            if waiter.fired:
+                return
+            waiter.fired = True
+            waiter.notified = True
+            if not waiter.parked:
+                return  # pre-park: its park() will return immediately
+            self._runnable.append(waiter)
+            if self._running == 0:
+                self._maybe_advance_locked()
+
+    def sleep(self, seconds: float) -> None:
+        """Virtual sleep; ``sleep(0)`` is a cooperative yield (other
+        runnable threads get the turn first)."""
+        self.park(_Waiter(self), timeout=max(0.0, seconds))
+
+    def _resume_locked(self, waiter: _Waiter) -> None:
+        tid = waiter.tid
+        if tid is not None and self._threads.get(tid) == _PARKED:
+            self._threads[tid] = _RUNNING
+            self._running += 1
+            self._parked_waiters.pop(tid, None)
+        waiter.event.set()
+
+    def _maybe_advance_locked(
+            self, stall_waiter: Optional[_Waiter] = None) -> None:
+        """The scheduler step (caller holds the clock lock): resume
+        the next runnable, else advance time to the earliest live
+        timer, else stall."""
+        if self._running > 0:
+            # dead-member pruning is the WATCHDOG's job (real-time
+            # cadence): doing it here would put an O(threads)
+            # is_alive sweep on every park of a busy simulation
+            return
+        if self._runnable:
+            self._resume_locked(self._runnable.popleft())
+            return
+        while self._timers:
+            deadline, _, w = heapq.heappop(self._timers)
+            if w.fired:
+                continue  # notified (or stalled) before its deadline
+            if (self._max_virtual is not None
+                    and deadline > self._max_virtual):
+                heapq.heappush(self._timers, (deadline, 0, w))
+                break  # past the cap: treat as a stall below
+            w.fired = True
+            w.notified = False
+            if deadline > self._now:
+                self._now = deadline
+                self.advances += 1
+            self._resume_locked(w)
+            return
+        target = stall_waiter
+        if target is None or target.fired:
+            target = next((w for w in self._parked_waiters.values()
+                           if not w.fired), None)
+        if target is None:
+            return  # no sim thread left to inform — nothing to do
+        names = ", ".join(
+            f"{self._names.get(t, t)}" for t in self._parked_waiters)
+        target.fired = True
+        target.stall = (
+            f"virtual clock stalled at t={self._now:.3f}: every sim "
+            f"thread is parked with no runnable waiter and no pending "
+            f"timer (parked: {names or 'none'}"
+            + (f"; max_virtual={self._max_virtual}s reached"
+               if self._max_virtual is not None and self._timers
+               else "") + ")")
+        self._resume_locked(target)
+
+    # -- spawning -----------------------------------------------------
+
+    def spawn(self, target: Callable, name: Optional[str] = None,
+              daemon: bool = True, args: tuple = (),
+              kwargs: Optional[dict] = None) -> threading.Thread:
+        """start_thread's virtual half: the child registers parked and
+        joins the runnable queue — it first runs when the scheduler
+        hands it the turn, never concurrently with its parent."""
+        done = SimEvent()
+
+        def _run():
+            tid = threading.get_ident()
+            latch = _Waiter(self)
+            latch.tid = tid
+            latch.fired = True  # born runnable, resumed by the queue
+            with self._lock:
+                self._threads[tid] = _PARKED
+                self._names[tid] = name or threading.current_thread().name
+                self._members[tid] = threading.current_thread()
+                self._parked_waiters[tid] = latch
+                self._runnable.append(latch)
+                if self._running == 0:
+                    self._maybe_advance_locked()
+            latch.event.wait()
+            try:
+                target(*args, **(kwargs or {}))
+            finally:
+                done.set()  # joiners become runnable first...
+                with self._lock:  # ...then this thread leaves the sim
+                    t = threading.get_ident()
+                    if self._threads.pop(t, None) == _RUNNING:
+                        self._running -= 1
+                    self._names.pop(t, None)
+                    self._members.pop(t, None)
+                    self._parked_waiters.pop(t, None)
+                    self._maybe_advance_locked()
+
+        t = threading.Thread(target=_run, daemon=daemon, name=name)
+        t._sim_done = done  # type: ignore[attr-defined]
+        t.start()
+        return t
+
+
+# ---------------------------------------------------------------------------
+# clock-aware primitives
+# ---------------------------------------------------------------------------
+
+
+class SimEvent(threading.Event):
+    """threading.Event that parks in (and is woken through) the active
+    virtual clock.  Under system time it IS a threading.Event; built
+    before a clock is installed it still participates afterwards —
+    the wait path consults the installed clock per call."""
+
+    def __init__(self):
+        super().__init__()
+        self._sim_lock = threading.Lock()
+        self._sim_waiters: "deque[_Waiter]" = deque()
+
+    def set(self) -> None:
+        super().set()
+        with self._sim_lock:
+            waiters = list(self._sim_waiters)
+            self._sim_waiters.clear()
+        for w in waiters:
+            w.clock.wake(w)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        clk = _installed
+        if clk is None:
+            return super().wait(timeout)
+        if super().is_set():
+            return True
+        w = _Waiter(clk)
+        with self._sim_lock:
+            if super().is_set():
+                return True
+            self._sim_waiters.append(w)
+        notified = clk.park(w, timeout)
+        if not notified:
+            with self._sim_lock:
+                try:
+                    self._sim_waiters.remove(w)
+                except ValueError:
+                    pass
+        return super().is_set()
+
+
+class SimCondition(threading.Condition):
+    """threading.Condition that parks in the active virtual clock.
+    The sim waiter list is guarded by the condition's own lock (the
+    caller holds it across wait/notify, per the Condition contract)."""
+
+    def __init__(self, lock=None):
+        super().__init__(lock)
+        self._sim_waiters: "deque[_Waiter]" = deque()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        clk = _installed
+        if clk is None:
+            return super().wait(timeout)
+        w = _Waiter(clk)
+        self._sim_waiters.append(w)
+        state = self._release_save()
+        try:
+            notified = clk.park(w, timeout)
+        finally:
+            self._acquire_restore(state)
+            if not notified:
+                try:
+                    self._sim_waiters.remove(w)
+                except ValueError:
+                    pass
+        return notified
+
+    def wait_for(self, predicate: Callable[[], Any],
+                 timeout: Optional[float] = None):
+        clk = _installed
+        if clk is None:
+            return super().wait_for(predicate, timeout)
+        # stock wait_for computes its deadline on REAL monotonic,
+        # which never advances while the sim waits — redo it virtual
+        endtime = None if timeout is None else clk.now() + timeout
+        result = predicate()
+        while not result:
+            waittime = None
+            if endtime is not None:
+                waittime = endtime - clk.now()
+                if waittime <= 0:
+                    break
+            self.wait(waittime)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        woken = 0
+        while woken < n and self._sim_waiters:
+            w = self._sim_waiters.popleft()
+            w.clock.wake(w)
+            woken += 1
+        if woken < n:
+            super().notify(n - woken)
+
+    def notify_all(self) -> None:
+        while self._sim_waiters:
+            w = self._sim_waiters.popleft()
+            w.clock.wake(w)
+        super().notify_all()
+
+
+class SimQueue:
+    """Minimal queue.Queue stand-in whose blocking ``get`` parks in
+    the virtual clock (watch subscriptions under simulation — built by
+    :func:`make_queue`).  Deliberately NOT stdlib Queue: its timed get
+    re-arms from REAL monotonic, which a virtual clock starves."""
+
+    def __init__(self, maxsize: int = 0):
+        self.maxsize = maxsize
+        self._items: deque = deque()
+        self._cond = SimCondition(threading.Lock())
+        self.unfinished_tasks = 0
+
+    def put(self, item: Any) -> None:
+        with self._cond:
+            # a bounded queue blocks (virtually) when full, matching
+            # queue.Queue.put under the system clock — the consumer's
+            # task_done/get notifies this same condition
+            while self.maxsize > 0 and len(self._items) >= self.maxsize:
+                self._cond.wait()
+            self._items.append(item)
+            self.unfinished_tasks += 1
+            self._cond.notify()
+
+    def put_nowait(self, item: Any) -> None:
+        with self._cond:
+            if self.maxsize > 0 and len(self._items) >= self.maxsize:
+                raise queue_mod.Full
+            self._items.append(item)
+            self.unfinished_tasks += 1
+            self._cond.notify()
+
+    def task_done(self) -> None:
+        with self._cond:
+            if self.unfinished_tasks > 0:
+                self.unfinished_tasks -= 1
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        with self._cond:
+            if not block:
+                if not self._items:
+                    raise queue_mod.Empty
+                return self._items.popleft()
+            if timeout is None:
+                while not self._items:
+                    self._cond.wait()
+            else:
+                deadline = monotonic() + timeout
+                while not self._items:
+                    remaining = deadline - monotonic()
+                    if remaining <= 0:
+                        raise queue_mod.Empty
+                    self._cond.wait(remaining)
+            item = self._items.popleft()
+            if self.maxsize > 0:
+                self._cond.notify()   # a slot freed: wake a blocked put
+            return item
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def empty(self) -> bool:
+        with self._cond:
+            return not self._items
+
+    def qsize(self) -> int:
+        with self._cond:
+            return len(self._items)

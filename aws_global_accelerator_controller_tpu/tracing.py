@@ -49,7 +49,8 @@ from __future__ import annotations
 
 import itertools
 import threading
-import time
+
+from .simulation import clock as simclock
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -156,12 +157,12 @@ class TraceContext:
         """Stamp a stage boundary.  Monotone by construction: a hop
         timed before the previous one (clock skew across threads is
         sub-µs but real) is clamped to it."""
-        t = time.monotonic() if now is None else now
+        t = simclock.monotonic() if now is None else now
         if self.hops and t < self.hops[-1][1]:
             t = self.hops[-1][1]
         if len(self.hops) < self.MAX_HOPS:
             self.hops.append((stage, t,
-                              time.time() if wall is None else wall))
+                              simclock.wall() if wall is None else wall))
 
     def link(self, trace_id: int) -> None:
         if trace_id != self.trace_id and trace_id not in self.links \
@@ -245,14 +246,14 @@ class Tracer:
         stack = self._stack()
         parent = stack[-1] if stack else None
         s = Span(name=name, attributes=dict(attributes),
-                 start_wall=time.time(), tid=threading.get_ident())
+                 start_wall=simclock.wall(), tid=threading.get_ident())
         if parent is not None:
             s.parent_id = parent.span_id
             s.trace_id = parent.trace_id
         else:
             s.trace_id = s.span_id
         stack.append(s)
-        start = time.monotonic()
+        start = simclock.monotonic()
         try:
             yield s
         except BaseException as e:
@@ -264,7 +265,7 @@ class Tracer:
                 s.error = f"{type(e).__name__}: {e}"
             raise
         finally:
-            s.duration = time.monotonic() - start
+            s.duration = simclock.monotonic() - start
             # pop OUR frame even if a buggy child leaked frames above
             # us (defense in depth; the leak satellite's regression
             # tests pin both layers)

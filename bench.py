@@ -667,6 +667,251 @@ def bench_restart_recovery(n_services: int = 1000, workers: int = 4,
     return out
 
 
+def bench_scale_storm(n_services: int = 100_000, workers: int = 4,
+                      shards: int = 8, resync: float = 3600.0,
+                      sweep_every: int = 100,
+                      call_latency: float = 0.005,
+                      record: bool = False) -> dict:
+    """Virtual-time fleet-scale leg (ISSUE 13): a 100k-service
+    create-storm + one steady-state resync wave + one shard handoff
+    under the DETERMINISTIC virtual clock (simulation/clock.py), with
+    ``call_latency`` seconds of simulated per-call AWS latency — the
+    I/O-bound production regime where wall-clock benches could never
+    go past ~1k services.  Every park (latency, linger, backoff,
+    resync spread) elapses in virtual seconds, so the leg reports:
+
+    - ``storm_wall_s`` / ``storm_sim_s``: wall vs simulated seconds of
+      the create storm (``sim_time_ratio`` = how much faster than real
+      time the whole scenario executed);
+    - ``steady_wall_s`` + ``steady_skips``: one full resync wave over
+      the converged fleet (fingerprint-gated; the sweep tier deep-
+      verifies 1/``sweep_every``);
+    - ``handoff_wall_s`` + ``handoff_keys``: seal -> release -> re-
+      acquire of shard 0 (1/``shards`` of the fleet), its cold
+      background re-verify measured end-to-end, with ZERO mutation
+      calls (re-adoption of a converged world is reads only);
+    - ``per_service_bytes`` + ``peak_rss_bytes``: the memory-diet
+      accounting (simulation/memory.py fleet_bytes over the apiserver
+      store, informer caches, fake cloud, fingerprint records and the
+      fleet index), fed to the ``per_service_bytes`` gauge.
+
+    ``resync`` must exceed each phase's SIMULATED duration (storm at
+    100k x ~30ms of per-service latency is ~1100 virtual seconds):
+    mid-phase resync waves would re-deliver the whole half-converged
+    fleet per period and turn the storm quadratic — a real production
+    pathology worth its own leg, but not this one's measurement.
+
+    ``record=True`` appends to reconcile_history.jsonl tagged
+    ``bench: "scale-storm"`` (floor-skipped: throughput here is
+    wall svc/s under simulated I/O latency, not the pure storm)."""
+    sys.path.insert(0, "tests")
+    from harness import Cluster, wait_until
+
+    from aws_global_accelerator_controller_tpu import metrics, tracing
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (
+        FingerprintConfig,
+        _caches as _fp_caches,
+    )
+    from aws_global_accelerator_controller_tpu.sharding import shard_of
+    from aws_global_accelerator_controller_tpu.simulation import (
+        VirtualClock,
+        fleet_bytes,
+    )
+    from aws_global_accelerator_controller_tpu.simulation import (
+        clock as simclock,
+    )
+
+    reg = metrics.default_registry
+    region = "ap-northeast-1"
+    # bulk-origin contexts only (no ring spans) still cost allocs per
+    # re-delivery at 100k; the scale leg measures the control plane,
+    # not the tracer (trace-overhead is its own leg)
+    tracing.set_enabled(False)
+    cluster = None
+    clk = VirtualClock(max_virtual=24 * 3600.0).activate()
+    try:
+        # discovery TTL = the scenario horizon: every expiry costs an
+        # O(fleet) rescan, and this leg simulates HOURS — production
+        # fleets at this scale raise the TTL the same way and rely on
+        # the drift sweep (the factory's discovery_cache_ttl knob)
+        cluster = Cluster(workers=workers, queue_qps=1e9,
+                          queue_burst=10**9, resync_period=resync,
+                          num_shards=shards,
+                          discovery_cache_ttl=8 * 3600.0,
+                          fingerprints=FingerprintConfig(
+                              sweep_every=sweep_every,
+                              # the cache must HOLD the fleet: at the
+                              # default 100k cap a 100k fleet evicts
+                              # on every record and the steady wave
+                              # can never go quiet (the diet made the
+                              # per-entry cost small enough to raise)
+                              max_entries=max(200_000,
+                                              2 * n_services)))
+        cluster.start()
+        wait_until(lambda: cluster.handle.informers_synced(),
+                   timeout=60.0, message="informers synced")
+        cluster.cloud.faults.set_latency("*", call_latency)
+
+        # -- phase A: the create storm --------------------------------
+        t0 = time.perf_counter()
+        v0 = simclock.monotonic()
+        for i in range(n_services):
+            name = f"svc{i:06d}"
+            hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                        ".amazonaws.com")
+            cluster.cloud.elb.register_load_balancer(
+                name, hostname, region)
+            cluster.kube.services.create(Service(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION:
+                            "true",
+                    }),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(
+                    load_balancer=LoadBalancerStatus(
+                        ingress=[LoadBalancerIngress(
+                            hostname=hostname)])),
+            ))
+        ga = cluster.cloud.ga
+        wait_until(lambda: len(ga._accelerators) == n_services,
+                   timeout=24 * 3600.0, interval=0.5,
+                   message=f"{n_services} accelerators converged")
+        storm_wall = time.perf_counter() - t0
+        storm_sim = simclock.monotonic() - v0
+        print(f"scale-storm: storm {n_services} svc in "
+              f"{storm_wall:.1f}s wall / {storm_sim:.1f}s sim",
+              file=sys.stderr, flush=True)
+
+        # -- phase B: one steady-state resync wave --------------------
+        skips0 = reg.counter_value("reconcile_fastpath_skips_total")
+        t1 = time.perf_counter()
+        v1 = simclock.monotonic()
+        # ride past one full resync period: the spread delivers every
+        # key exactly once; unchanged keys are answered at enqueue
+        target = 0.9 * n_services
+        wait_until(lambda: reg.counter_value(
+            "reconcile_fastpath_skips_total") - skips0 >= target,
+            timeout=24 * 3600.0, interval=30.0,
+            message="steady-state wave of fingerprint skips")
+        steady_wall = time.perf_counter() - t1
+        steady_sim = simclock.monotonic() - v1
+        steady_skips = (reg.counter_value(
+            "reconcile_fastpath_skips_total") - skips0)
+        print(f"scale-storm: steady wave {steady_skips:.0f} skips in "
+              f"{steady_wall:.1f}s wall", file=sys.stderr, flush=True)
+
+        # -- phase C: one shard handoff -------------------------------
+        handoff_keys = sum(
+            1 for i in range(n_services)
+            if shard_of(f"default/svc{i:06d}", shards) == 0)
+        creates0 = cluster.cloud.faults.call_counts().get(
+            "create_accelerator", 0)
+        syncs0 = reg.counter_value("controller_sync_total")
+        sh = cluster.factory.shards
+        t2 = time.perf_counter()
+        tok = sh.token(0)
+        sh.fence(0).seal("scale-storm handoff")
+        sh.release(0)
+        sh.acquire(0, tok + 1)
+        wait_until(lambda: reg.counter_value("controller_sync_total")
+                   - syncs0 >= handoff_keys,
+                   timeout=24 * 3600.0, interval=5.0,
+                   message="shard 0 cold re-verify complete")
+        handoff_wall = time.perf_counter() - t2
+        creates_delta = cluster.cloud.faults.call_counts().get(
+            "create_accelerator", 0) - creates0
+        print(f"scale-storm: handoff {handoff_keys} keys in "
+              f"{handoff_wall:.1f}s wall", file=sys.stderr, flush=True)
+
+        # -- memory accounting ----------------------------------------
+        informer_caches = {}
+        for kind, inf in (cluster.handle.informer_factory
+                          ._informers.items()):
+            informer_caches[f"informer_{kind}"] = inf._cache
+        fp = {}
+        for i, cache in enumerate(list(_fp_caches)):
+            fp[f"fingerprints_{cache.controller}_{i}"] = cache._fp
+        state = cluster.factory._discovery_state
+        mem = fleet_bytes(n_services, {
+            "apiserver_services":
+                cluster.api.store("Service")._objects,
+            **informer_caches,
+            "cloud_accelerators": ga._accelerators,
+            "cloud_listeners": ga._listeners,
+            "cloud_endpoint_groups": ga._endpoint_groups,
+            **fp,
+            "fleet_index": state.fleet_index,
+            "discovery": state.discovery,
+            "tags_cache": state.tags,
+        })
+        stats = clk.stats()
+        metrics.record_sim_time_ratio(stats["sim_time_ratio"])
+        metrics.record_per_service_bytes(mem["per_service_bytes"])
+        cluster.shutdown(ordered=True, deadline=30.0)
+    finally:
+        # stop the cluster BEFORE releasing the clock: deactivate()
+        # frees every parked waiter, and a mid-phase failure must not
+        # leave a 100k-service cluster's workers free-running on the
+        # system clock for the rest of the process
+        if cluster is not None:
+            try:
+                cluster.cloud.faults.set_latency("*", 0.0)
+                cluster.shutdown()
+            except Exception:
+                pass
+        clk.deactivate()
+        tracing.set_enabled(True)
+
+    out = {
+        "services": n_services, "workers": workers, "shards": shards,
+        "call_latency_s": call_latency,
+        "storm_wall_s": round(storm_wall, 2),
+        "storm_sim_s": round(storm_sim, 2),
+        "storm_throughput_wall": round(n_services / storm_wall, 1),
+        "steady_wall_s": round(steady_wall, 2),
+        "steady_sim_s": round(steady_sim, 2),
+        "steady_skips": round(steady_skips),
+        "handoff_keys": handoff_keys,
+        "handoff_wall_s": round(handoff_wall, 2),
+        "mutations_during_handoff": round(creates_delta),
+        "sim_seconds": round(stats["sim_seconds"], 2),
+        "wall_seconds": round(stats["wall_seconds"], 2),
+        "sim_time_ratio": round(stats["sim_time_ratio"], 2),
+        "per_service_bytes": round(mem["per_service_bytes"], 1),
+        "accounted_bytes": mem["accounted_bytes"],
+        "peak_rss_bytes": mem["peak_rss_bytes"],
+    }
+    if record:
+        _record_reconcile_history(
+            {"services": n_services,
+             "throughput": out["storm_throughput_wall"]},
+            bench="scale-storm",
+            extra={k: out[k] for k in (
+                "storm_sim_s", "steady_wall_s", "handoff_wall_s",
+                "handoff_keys", "mutations_during_handoff",
+                "sim_time_ratio", "per_service_bytes",
+                "peak_rss_bytes", "call_latency_s", "shards")})
+    return out
+
+
+
 def bench_rollout_ramp(n_bindings: int = 200, workers: int = 6,
                        endpoints_per_binding: int = 3,
                        steps: str = "25,50,100",
@@ -3372,6 +3617,7 @@ _NAMED = {
     "steady-state": lambda: bench_steady_state(record=True),
     "trace-overhead": lambda: bench_trace_overhead(record=True),
     "restart-recovery": lambda: bench_restart_recovery(record=True),
+    "scale-storm": lambda: bench_scale_storm(record=True),
     "shard-scaling": lambda: bench_shard_scaling(record=True),
     "mixed-soak": lambda: bench_mixed_soak(record=True),
     "rollout-ramp": lambda: bench_rollout_ramp(record=True),

@@ -8,3 +8,25 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+import pytest  # noqa: E402
+
+from aws_global_accelerator_controller_tpu.simulation import (  # noqa: E402
+    clock as simclock,
+)
+
+
+@pytest.fixture
+def virtual_clock():
+    """Deterministic virtual time for a chaos scenario (ISSUE 13):
+    installs a VirtualClock BEFORE the cluster is built (every
+    primitive created under it parks in the clock) and tears it down
+    after.  Blackout windows, breaker opens, backoff parks and bake
+    intervals then cost virtual seconds, not wall time, and the
+    scheduler interleaving is deterministic (simulation/clock.py)."""
+    clk = simclock.VirtualClock(max_virtual=7200.0).activate()
+    try:
+        yield clk
+    finally:
+        clk.deactivate()

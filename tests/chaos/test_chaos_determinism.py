@@ -1,0 +1,213 @@
+"""Determinism proof (ISSUE 13): the same seeded chaos scenario — 20%
+AWS chaos + 20% kube-plane chaos + one abrupt manager handoff — run
+TWICE under the virtual clock produces byte-identical observable
+histories:
+
+- the FaultInjector (AWS) and KubeChaos decision_log() streams,
+  timestamps included (they are VIRTUAL seconds — under deterministic
+  simulation even *when* each fault fired replays exactly);
+- the convergence ledger's per-record stage story (key, controller,
+  origin, stage durations to the microsecond, in convergence order);
+- the final fake-cloud state (accelerator chains, endpoint weights,
+  record sets — serialized canonically).
+
+This is the property every decision the seeded engines made (PR 3/6)
+always had per call-index; the virtual clock (simulation/clock.py)
+extends it to TIME itself: serial cooperative scheduling + seeded
+jitter everywhere means the call SEQUENCES are identical too, so the
+whole run replays.  Any wall-clock leak (a bare time.sleep, an
+unseeded jitter draw on a scheduling path) breaks this test — which
+is exactly why lint rule L115 exists.
+"""
+import json
+
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    ROUTE53_HOSTNAME_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.kube.apiserver import (
+    FakeAPIServer,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+from aws_global_accelerator_controller_tpu.resilience import (
+    ResilienceConfig,
+)
+from aws_global_accelerator_controller_tpu.simulation import clock as simclock
+from aws_global_accelerator_controller_tpu.tracing import default_ledger
+
+from harness import Cluster, wait_until
+
+SEED = 20260813
+REGION = "ap-northeast-1"
+N_SERVICES = 8
+
+# seeded retry jitter: the ONE remaining random draw on the scheduling
+# path (decorrelated backoff) must replay for the call sequence to
+CHAOS_CONFIG = ResilienceConfig(
+    max_attempts=4, base_delay=0.002, max_delay=0.05, deadline=3.0,
+    breaker_window=2.0, breaker_min_calls=12,
+    breaker_failure_threshold=0.6, breaker_open_seconds=0.3,
+    bucket_capacity=200.0, bucket_refill=2000.0,
+    bucket_min_capacity=5.0, bucket_recover=5.0, seed=SEED)
+
+
+def _nlb(name):
+    return f"{name}-0123456789abcdef.elb.{REGION}.amazonaws.com"
+
+
+def _svc(name, hostname):
+    return Service(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            annotations={
+                AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                ROUTE53_HOSTNAME_ANNOTATION: hostname}),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=_nlb(name))])))
+
+
+def _cloud_state(cloud):
+    """Canonical serialization of the COMPLETE fake-cloud state, read
+    directly from fake internals (no API calls: reading the answer
+    must not consume fault-schedule draws)."""
+    ga = cloud.ga
+    r53 = cloud.route53
+    with ga._lock:
+        accs = {arn: {"acc": repr(st.accelerator),
+                      "tags": sorted(st.tags.items())}
+                for arn, st in sorted(ga._accelerators.items())}
+        listeners = {arn: (parent, repr(lst))
+                     for arn, (parent, lst)
+                     in sorted(ga._listeners.items())}
+        egs = {arn: (parent, repr(eg))
+               for arn, (parent, eg) in sorted(ga._endpoint_groups.items())}
+    with r53._lock:
+        zones = {z.id: sorted(repr(r) for r in records)
+                 for z, records in
+                 ((zone, recs) for zone, recs in
+                  ((z, r53._records.get(z.id, [])) for z in
+                   r53._zones.values()))}
+    return json.dumps({"accelerators": accs, "listeners": listeners,
+                       "endpoint_groups": egs, "zones": zones},
+                      sort_keys=True, default=repr)
+
+
+def _drain_stragglers():
+    """Wait (REAL time, clock inactive) until leftover daemon threads
+    from earlier abruptly-stopped clusters exit — a straggler wandering
+    into the next virtual clock would perturb scheduler sequence
+    numbers between the two runs."""
+    import threading
+    import time as _t
+
+    names = ("-worker-", "informer-", "workqueue-waker-",
+             "event-broadcaster", "-controller")
+    deadline = _t.monotonic() + 8.0
+    while _t.monotonic() < deadline:
+        alive = [t.name for t in threading.enumerate()
+                 if any(n in (t.name or "") for n in names)]
+        if not alive:
+            return
+        _t.sleep(0.05)
+
+
+def _run_scenario():
+    """One full scenario under a fresh virtual clock + fresh world:
+    converge half the fleet through 20% AWS + kube chaos, abrupt-kill
+    the manager, hand off to a successor over the same world, land the
+    other half, converge, ordered stop.  Returns the three observable
+    histories."""
+    _drain_stragglers()
+    ledger_before = len(default_ledger.snapshot(limit=100000))
+    clk = simclock.VirtualClock(max_virtual=7200.0).activate()
+    try:
+        api = FakeAPIServer()
+        a = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                    api=api, resilience=CHAOS_CONFIG, fault_seed=SEED,
+                    resync_period=2.0)
+        cloud = a.cloud
+        for i in range(N_SERVICES):
+            cloud.elb.register_load_balancer(
+                f"svc-{i}", _nlb(f"svc-{i}"), REGION)
+        cloud.route53.create_hosted_zone("example.com")
+        kchaos = api.arm_chaos(seed=SEED)
+        a.start()
+        wait_until(lambda: a.handle.informers_synced(), timeout=30.0,
+                   message="informers synced")
+
+        # the storm: 20% on both planes
+        cloud.faults.set_error_rate("*", 0.2)
+        cloud.faults.set_latency("*", 0.002)
+        kchaos.set_error_rate("update", 0.2)
+        kchaos.set_error_rate("list", 0.2)
+
+        for i in range(N_SERVICES // 2):
+            a.kube.services.create(_svc(f"svc-{i}",
+                                        f"s{i}.example.com"))
+        wait_until(
+            lambda: len(cloud.ga.list_accelerators()) == N_SERVICES // 2,
+            timeout=120.0, message="first half converged")
+
+        # one handoff: abrupt kill (no drain), successor over the
+        # same apiserver + cloud — the crash-restart shape
+        a.shutdown()
+        a.handle.join(timeout=30.0)
+        b = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                    api=api, cloud=cloud, resilience=CHAOS_CONFIG,
+                    resync_period=2.0)
+        b.start()
+        wait_until(lambda: b.handle.informers_synced(), timeout=30.0,
+                   message="successor synced")
+        for i in range(N_SERVICES // 2, N_SERVICES):
+            b.kube.services.create(_svc(f"svc-{i}",
+                                        f"s{i}.example.com"))
+        wait_until(
+            lambda: len(cloud.ga.list_accelerators()) == N_SERVICES,
+            timeout=120.0, message="full fleet converged")
+        # lights out + settle one resync wave so the ledger quiesces
+        cloud.faults.set_error_rate("*", 0.0)
+        kchaos.set_error_rate("update", 0.0)
+        kchaos.set_error_rate("list", 0.0)
+        simclock.sleep(4.0)
+        b.shutdown(ordered=True, deadline=10.0)
+
+        aws_log = json.dumps(cloud.faults.decision_log(),
+                             sort_keys=True)
+        kube_log = json.dumps(kchaos.decision_log(), sort_keys=True)
+        ledger = [
+            (r["key"], r["controller"], r["origin"],
+             tuple(sorted(r["stages"].items())), r["total_s"])
+            for r in default_ledger.snapshot(limit=100000)[ledger_before:]
+        ]
+        state = _cloud_state(cloud)
+        return aws_log, kube_log, ledger, state
+    finally:
+        clk.deactivate()
+
+
+def test_seeded_scenario_replays_byte_identical(race_detectors):
+    aws1, kube1, ledger1, state1 = _run_scenario()
+    aws2, kube2, ledger2, state2 = _run_scenario()
+
+    assert aws1 == aws2, "AWS FaultInjector decision streams diverged"
+    assert kube1 == kube2, "KubeChaos decision streams diverged"
+    assert json.loads(aws1), "scenario injected no AWS faults"
+    # the convergence ledger: same records, same stage durations (to
+    # the recorded microsecond), same convergence ORDER
+    assert ledger1 == ledger2, (
+        "convergence-ledger stage sequences diverged:\n"
+        f"run1={ledger1[:6]}...\nrun2={ledger2[:6]}...")
+    assert ledger1, "no ledger records — the scenario traced nothing"
+    assert state1 == state2, "final fake-cloud state diverged"

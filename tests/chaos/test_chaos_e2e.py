@@ -9,10 +9,17 @@ The schedule is seeded: the injector's probabilistic decisions are a
 pure function of (seed, method, call index), so the same seed injects
 the same faults for the same call sequence (the determinism contract
 tests/chaos/test_chaos_engine.py asserts exactly).
-"""
-import time
 
+Runs under the VIRTUAL clock (ISSUE 13; conftest ``virtual_clock``):
+the 5s blackout window, the breaker's open timer and every backoff
+park elapse in virtual seconds — the suite's slowest real-sleep e2e
+now costs ~0 wall per simulated second, assertions unchanged.
+"""
 import pytest
+
+from aws_global_accelerator_controller_tpu.simulation import (
+    clock as simclock,
+)
 
 from aws_global_accelerator_controller_tpu import metrics
 from aws_global_accelerator_controller_tpu.apis import (
@@ -95,7 +102,9 @@ def _open_transitions(reg):
 
 
 @pytest.fixture
-def cluster():
+def cluster(virtual_clock):
+    # the clock is installed first (fixture dependency): every queue,
+    # event and linger the cluster builds parks in it
     c = Cluster(workers=2, queue_qps=1000.0, queue_burst=1000,
                 resilience=CHAOS_CONFIG, fault_seed=SEED).start()
     yield c
@@ -145,8 +154,9 @@ def test_all_controllers_converge_through_seeded_chaos(cluster):
             endpoint_group_arn=ext_eg.endpoint_group_arn,
             weight=32, service_ref=ServiceReference(name="svc-c"))))
     # one service lands mid-blackout: its whole ensure chain must ride
-    # the outage out and still converge
-    time.sleep(1.0)
+    # the outage out and still converge (virtual sleep: the blackout
+    # window advances under us at zero wall cost)
+    simclock.sleep(1.0)
     cluster.kube.services.create(managed_service("svc-late"))
 
     # -- convergence to the desired cloud state -----------------------
@@ -285,9 +295,9 @@ def test_throttle_burst_shrinks_bucket_and_recovers(cluster):
 
     cluster.cloud.faults.add_throttle_burst(start_in=0.0, duration=0.4,
                                             service="ga")
-    deadline = time.monotonic() + 2.0
+    deadline = simclock.monotonic() + 2.0
     shrunk = start_capacity
-    while time.monotonic() < deadline:
+    while simclock.monotonic() < deadline:
         try:
             provider.apis.ga.list_accelerators()
         except Exception:

@@ -24,6 +24,9 @@ record-plane twin, all under the runtime race detectors:
 import time
 
 from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.simulation import (
+    clock as simclock,
+)
 from aws_global_accelerator_controller_tpu.apis import (
     AWS_LOAD_BALANCER_TYPE_ANNOTATION,
     ROLLOUT_ABORT_ANNOTATION,
@@ -201,8 +204,10 @@ def test_ramp_completes_through_chaos_and_handoff_monotone(
 
 
 def test_injected_health_failure_at_step_3_rolls_back_exactly_once(
-        race_detectors):
-    """Converge at 100, ramp toward 200, then flip the abort
+        virtual_clock, race_detectors):
+    """Under VIRTUAL time (ISSUE 13 — the bake intervals between ramp
+    steps cost simulated, not wall, seconds): converge at 100, ramp
+    toward 200, then flip the abort
     annotation once step 3 (index 2) is persisted: the machine rolls
     back to the last good weights EXACTLY once (counter == 1, phase
     RolledBack sticky), and the failed target never re-ramps."""
@@ -256,11 +261,11 @@ def test_injected_health_failure_at_step_3_rolls_back_exactly_once(
             "rollout_rollbacks_total",
             {"controller": "EndpointGroupBinding", "reason": "abort"}) \
             == rollbacks_before + 1
-        deadline = time.monotonic() + 1.5
-        while time.monotonic() < deadline:
+        deadline = simclock.monotonic() + 1.5
+        while simclock.monotonic() < deadline:
             assert peek_weight(c.cloud, eg.endpoint_group_arn,
                                lb.load_balancer_arn) == 100
-            time.sleep(0.05)
+            simclock.sleep(0.05)
         assert rollout_status(c).phase == PHASE_ROLLED_BACK
         assert reg.counter_value(
             "rollout_rollbacks_total",
@@ -271,8 +276,10 @@ def test_injected_health_failure_at_step_3_rolls_back_exactly_once(
 
 
 def test_kill_restart_mid_ramp_resumes_with_zero_duplicate_writes(
-        race_detectors):
-    """Kill the manager with step 1 persisted AND converged; the
+        virtual_clock, race_detectors):
+    """Under VIRTUAL time (ISSUE 13 — bake waits and the successor's
+    resume elapse in simulated seconds): kill the manager with step 1
+    persisted AND converged; the
     successor must resume from the persisted step — the total
     ``update_endpoint_group`` count across BOTH processes is exactly
     one coalesced RMW per mutation: the endpoint ADD at the step-0

@@ -6,8 +6,7 @@ minimum end-to-end slice prescribes.
 """
 from __future__ import annotations
 
-import threading
-import time
+from aws_global_accelerator_controller_tpu.simulation import clock as simclock
 
 from aws_global_accelerator_controller_tpu.cloudprovider.aws.factory import (
     FakeCloudFactory,
@@ -40,7 +39,8 @@ class Cluster:
                  queue_burst: int = 100, weight_policy: str = "static",
                  policy_checkpoint: str = "", resilience=None,
                  fault_seed=None, coalesce=None, fingerprints=None,
-                 api=None, cloud=None, num_shards: int = 1):
+                 api=None, cloud=None, num_shards: int = 1,
+                 discovery_cache_ttl=None):
         from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
             FingerprintConfig,
         )
@@ -51,14 +51,13 @@ class Cluster:
         self.api = api if api is not None else FakeAPIServer()
         self.kube = KubeClient(self.api)
         self.operator = OperatorClient(self.api)
-        self.factory = FakeCloudFactory(settle_seconds=settle_seconds,
-                                        resilience=resilience,
-                                        fault_seed=fault_seed,
-                                        coalesce=coalesce,
-                                        cloud=cloud,
-                                        num_shards=num_shards)
+        self.factory = FakeCloudFactory(
+            settle_seconds=settle_seconds, resilience=resilience,
+            fault_seed=fault_seed, coalesce=coalesce, cloud=cloud,
+            num_shards=num_shards,
+            discovery_cache_ttl=discovery_cache_ttl)
         self.cloud = self.factory.cloud
-        self.stop = threading.Event()
+        self.stop = simclock.make_event()
         self._manager = Manager(resync_period=resync_period)
         self._config = ControllerConfig(
             global_accelerator=GlobalAcceleratorConfig(
@@ -95,12 +94,15 @@ class Cluster:
 
 def wait_until(pred, timeout: float = 20.0, interval: float = 0.02,
                message: str = "condition"):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
+    # rides the active clock (simulation/clock.py): under a virtual
+    # clock the poll parks between checks — the machinery runs while
+    # the driver waits, and the timeout is VIRTUAL seconds
+    deadline = simclock.monotonic() + timeout
+    while simclock.monotonic() < deadline:
         try:
             if pred():
                 return
         except Exception:
             pass
-        time.sleep(interval)
+        simclock.sleep(interval)
     raise AssertionError(f"timed out waiting for {message}")

@@ -1,0 +1,14 @@
+"""Fixture: L115-clean shapes — everything reads the simulation
+clock; waits are clock-aware and bounds are named or derived."""
+POLL = 0.05
+
+
+def on_the_clock(simclock, cond, stop, deadline):
+    now = simclock.monotonic()
+    wall = simclock.wall()
+    simclock.sleep(POLL)
+    done = simclock.make_event()
+    cond.wait(POLL)                      # named bound, not a literal
+    cond.wait(deadline - now)            # derived from the clock
+    stop.wait()                          # untimed: woken by set()
+    return wall, done

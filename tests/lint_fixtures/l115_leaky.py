@@ -1,0 +1,25 @@
+"""Fixture: rule L115 violations — wall-clock leaks in clock-owned
+code (direct time reads/sleeps, raw threading primitives,
+literal-timeout waits)."""
+import threading
+import time
+
+
+def stamp_and_park(stop):
+    started = time.monotonic()                             # line 9: L115
+    wall = time.time()                                     # line 10: L115
+    time.sleep(0.5)                                        # line 11: L115
+    stop.wait(2.0)                                         # line 12: L115
+    return started, wall
+
+
+def raw_primitives():
+    done = threading.Event()                               # line 17: L115
+    cond = threading.Condition()                           # line 18: L115
+    done.wait(timeout=1.5)                                 # line 19: L115
+    return cond
+
+
+def deliberate_boundary():
+    # the blessed escape hatch for a real-world wait
+    time.sleep(0.01)  # race: real subprocess warm-up, not sim time

@@ -357,9 +357,9 @@ def test_bench_mixed_soak_full_slo():
 
 def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
     """batch-efficiency, steady-state, restart-recovery, mixed-soak,
-    shard-scaling and rollout-ramp legs measure other workloads, not
-    the floor's pure create storm: their (lower) throughputs must not
-    drag the derived floor down."""
+    shard-scaling, rollout-ramp and scale-storm legs measure other
+    workloads, not the floor's pure create storm: their (lower)
+    throughputs must not drag the derived floor down."""
     hist = tmp_path / "history.jsonl"
     hist.write_text("".join(
         json.dumps(e) + "\n" for e in (
@@ -375,6 +375,10 @@ def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
             {"throughput": 110.0, "bench": "shard-scaling"},
             {"throughput": 55.0, "bench": "rollout-ramp"},
             {"throughput": 60.0, "bench": "rollout-ramp"},
+            # scale-storm runs under simulated I/O latency: its wall
+            # svc/s is a different regime from the pure storm
+            {"throughput": 1500.0, "bench": "scale-storm",
+             "sim_time_ratio": 26.0, "per_service_bytes": 12000.0},
             {"throughput": 180.0, "bench": "trace-overhead",
              "overhead_pct": 1.2},
             # the fleet-plan leg has no "throughput" at all (EG/s, a
@@ -1095,3 +1099,35 @@ def test_attach_last_live_prefers_leg_transcript(monkeypatch, tmp_path):
     planner = bench._attach_last_live({"skipped": "wedged"}, "planner")
     assert planner["last_live"]["transcript"].endswith(
         "transcript_new.log")
+
+
+def test_bench_scale_storm_smoke(monkeypatch, tmp_path):
+    """Tier-1 smoke of the virtual-time scale leg (ISSUE 13) at 5k
+    services: storm + one steady wave + one shard handoff complete
+    under the VirtualClock, zero mutations during the handoff, the
+    memory accounting reports per-service bytes, and the history entry
+    is tagged ``scale-storm``."""
+    hist = tmp_path / "history.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(hist))
+    r = bench.bench_scale_storm(n_services=5000, resync=600.0,
+                                record=True)
+    assert r["services"] == 5000
+    assert r["storm_throughput_wall"] > 100
+    assert r["steady_skips"] >= 0.9 * 5000
+    assert r["mutations_during_handoff"] == 0
+    assert r["handoff_keys"] > 0
+    assert r["per_service_bytes"] > 0
+    assert r["peak_rss_bytes"] > 0
+    # the storm ran under simulated per-call latency: simulated time
+    # must outrun wall time by a wide margin
+    assert r["sim_time_ratio"] > 3.0
+    entries = [json.loads(line)
+               for line in hist.read_text().splitlines()]
+    assert entries and entries[-1]["bench"] == "scale-storm"
+    assert entries[-1]["per_service_bytes"] > 0
+    # the gauges reached the registry with HELP entries
+    from aws_global_accelerator_controller_tpu import metrics as m
+    assert m.default_registry.gauge_value("sim_time_ratio") > 3.0
+    assert m.default_registry.gauge_value("per_service_bytes") > 0
+    assert "sim_time_ratio" in m.default_registry.help_names()
+    assert "per_service_bytes" in m.default_registry.help_names()

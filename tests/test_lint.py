@@ -853,3 +853,72 @@ def test_l114_batcher_gate_trusts_shipped_when_absent(tmp_path):
     findings = [x for x in concurrency_lint.lint_files(
         [FIXTURES / "l114_clean.py"]) if x.code == "L114"]
     assert findings == []
+
+
+def test_l115_wall_clock_leaks_fire_and_waiver_suppresses():
+    """Direct time reads/sleeps (9-11), a literal-timeout wait (12),
+    raw threading primitives (17-18) and a literal kwarg timeout (19)
+    fire; the ``# race:`` waiver suppresses the deliberate boundary
+    sleep."""
+    got = [x for x in _cfindings("l115_leaky.py") if x[0] == "L115"]
+    assert got == [("L115", 9), ("L115", 10), ("L115", 11),
+                   ("L115", 12), ("L115", 17), ("L115", 18),
+                   ("L115", 19)]
+
+
+def test_l115_clock_aware_shapes_pass():
+    """simclock reads, make_event, named/derived wait bounds and
+    untimed waits are the supported shapes — zero findings."""
+    assert [x for x in _cfindings("l115_clean.py")
+            if x[0] == "L115"] == []
+
+
+def test_l115_clock_owned_packages_clean():
+    """Every shipped clock-owned package is L115-clean: the whole
+    point of the rule is that NO wall-clock read survives outside
+    simulation/clock.py and the waiver-listed real-I/O shims."""
+    roots = [
+        "aws_global_accelerator_controller_tpu/kube",
+        "aws_global_accelerator_controller_tpu/resilience",
+        "aws_global_accelerator_controller_tpu/cloudprovider",
+        "aws_global_accelerator_controller_tpu/leaderelection",
+        "aws_global_accelerator_controller_tpu/reconcile",
+        "aws_global_accelerator_controller_tpu/rollout",
+        "aws_global_accelerator_controller_tpu/controller",
+        "aws_global_accelerator_controller_tpu/manager",
+        "aws_global_accelerator_controller_tpu/sharding",
+        "aws_global_accelerator_controller_tpu/tracing.py",
+        "aws_global_accelerator_controller_tpu/flight.py",
+        "aws_global_accelerator_controller_tpu/metrics.py",
+    ]
+    files = []
+    for r in roots:
+        p = pathlib.Path(ROOT_DIR) / r
+        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    findings = [x for x in concurrency_lint.lint_files(files)
+                if x.code == "L115"]
+    assert findings == [], findings
+
+
+def test_l115_seeded_bare_sleep_in_shipped_informer_caught(tmp_path):
+    """Acceptance probe (ISSUE 13): graft a bare ``time.sleep`` back
+    into the REAL informer loop — the exact leak class that silently
+    breaks virtual-time determinism — and the rule must fire."""
+    inf_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/kube/informers.py")
+    src = inf_py.read_text()
+    needle = "                self._resync_due(spread)\n"
+    assert src.count(needle) == 1, \
+        "informer loop shape changed; update this probe"
+    mutated = src.replace(
+        needle,
+        "                import time\n"
+        "                time.sleep(0.001)\n" + needle, 1)
+    pkg_dir = tmp_path / "aws_global_accelerator_controller_tpu" / "kube"
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "informers.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L115" and "time.sleep" in x.msg]
+    assert findings, "a grafted bare time.sleep in the shipped " \
+                     "informer loop was not caught"

@@ -530,7 +530,7 @@ def _fire_every_helper(reg):
             return _StubShards()
         if pname == "fn":
             return lambda: 0.0
-        if pname in ("seconds", "duration", "value"):
+        if pname in ("seconds", "duration", "value", "ratio"):
             return 0.01
         if pname in ("n", "trace_id"):
             return 1

@@ -1,0 +1,254 @@
+"""Unit tests for the deterministic virtual clock
+(aws_global_accelerator_controller_tpu/simulation/ — ISSUE 13).
+
+The park/advance contract, the clock-aware primitives, stall
+detection, foreign-thread pruning, and the memory accounting helper.
+"""
+import threading
+import time
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.simulation import (
+    SimStallError,
+    VirtualClock,
+    deep_sizeof,
+    fleet_bytes,
+)
+from aws_global_accelerator_controller_tpu.simulation import clock as simclock
+
+
+@pytest.fixture
+def clk():
+    c = VirtualClock(max_virtual=100000.0).activate()
+    yield c
+    c.deactivate()
+
+
+def test_sleep_advances_virtual_not_wall(clk):
+    t0 = time.monotonic()
+    simclock.sleep(3600.0)
+    assert simclock.monotonic() == pytest.approx(3600.0)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_system_mode_delegates_to_real_time():
+    assert simclock.active() is None
+    assert abs(simclock.monotonic() - time.monotonic()) < 0.5
+    assert abs(simclock.wall() - time.time()) < 0.5
+    ev = simclock.make_event()
+    assert ev.wait(0.01) is False
+    ev.set()
+    assert ev.wait(0.01) is True
+
+
+def test_wall_tracks_virtual_epoch(clk):
+    w0 = simclock.wall()
+    simclock.sleep(100.0)
+    assert simclock.wall() - w0 == pytest.approx(100.0)
+
+
+def test_timers_fire_in_deadline_order(clk):
+    out = []
+
+    def sleeper(delay, tag):
+        simclock.sleep(delay)
+        out.append((tag, simclock.monotonic()))
+
+    base = simclock.monotonic()
+    for delay, tag in ((30.0, "c"), (10.0, "a"), (20.0, "b")):
+        simclock.start_thread(sleeper, args=(delay, tag))
+    simclock.sleep(50.0)
+    assert [t for t, _ in out] == ["a", "b", "c"]
+    assert [round(at - base) for _, at in out] == [10, 20, 30]
+
+
+def test_event_set_wakes_virtual_waiter(clk):
+    ev = simclock.make_event()
+
+    def setter():
+        simclock.sleep(25.0)
+        ev.set()
+
+    simclock.start_thread(setter)
+    assert ev.wait(100.0) is True
+    assert simclock.monotonic() == pytest.approx(25.0)
+
+
+def test_event_wait_timeout_is_virtual(clk):
+    ev = simclock.make_event()
+    t0 = time.monotonic()
+    assert ev.wait(500.0) is False
+    assert simclock.monotonic() == pytest.approx(500.0)
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_condition_notify_and_virtual_timeout(clk):
+    cond = simclock.make_condition(threading.Lock())
+    state = {"ready": False}
+
+    def producer():
+        simclock.sleep(40.0)
+        with cond:
+            state["ready"] = True
+            cond.notify_all()
+
+    simclock.start_thread(producer)
+    with cond:
+        assert cond.wait_for(lambda: state["ready"], timeout=200.0)
+    assert simclock.monotonic() == pytest.approx(40.0)
+    with cond:
+        assert cond.wait(10.0) is False  # timeout path, virtual
+    assert simclock.monotonic() == pytest.approx(50.0)
+
+
+def test_sim_queue_blocking_get(clk):
+    q = simclock.make_queue()
+
+    def producer():
+        simclock.sleep(15.0)
+        q.put("item")
+
+    simclock.start_thread(producer)
+    assert q.get(timeout=100.0) == "item"
+    assert simclock.monotonic() == pytest.approx(15.0)
+    import queue as queue_mod
+    with pytest.raises(queue_mod.Empty):
+        q.get(timeout=5.0)
+
+
+def test_spawned_thread_parks_until_scheduled_no_parent_race(clk):
+    order = []
+
+    def child():
+        order.append("child")
+
+    simclock.start_thread(child)
+    order.append("parent")   # runs before the child is ever resumed
+    simclock.sleep(0)        # cooperative yield hands the child a turn
+    assert order == ["parent", "child"]
+
+
+def test_join_thread_rides_the_clock(clk):
+    def worker():
+        simclock.sleep(120.0)
+
+    t = simclock.start_thread(worker)
+    t0 = time.monotonic()
+    simclock.join_thread(t, timeout=1000.0)
+    assert not t.is_alive()
+    assert time.monotonic() - t0 < 2.0
+    assert simclock.monotonic() >= 120.0
+
+
+def test_stall_raises_instead_of_hanging(clk):
+    with pytest.raises(SimStallError) as exc:
+        simclock.make_event().wait()   # untimed, nothing will set it
+    assert "parked" in str(exc.value)
+
+
+def test_max_virtual_cap_stalls_runaway_sim():
+    c = VirtualClock(max_virtual=50.0).activate()
+    try:
+        with pytest.raises(SimStallError):
+            simclock.sleep(1000.0)
+    finally:
+        c.deactivate()
+
+
+def test_dead_foreign_thread_is_pruned(clk):
+    """A thread that auto-registers (parks once) then exits without
+    deregistering must not wedge the scheduler (the watchdog/advance
+    prune — the fleet-index-refresh shape)."""
+    def foreign():
+        simclock.sleep(1.0)   # auto-registers, parks, resumes, dies
+
+    t = threading.Thread(target=foreign, daemon=True)
+    t.start()
+    # let it register+finish: drive virtual time forward
+    simclock.sleep(5.0)
+    t.join(5.0)
+    assert not t.is_alive()
+    # the scheduler must still advance for us afterwards
+    now = simclock.monotonic()
+    simclock.sleep(10.0)
+    assert simclock.monotonic() == pytest.approx(now + 10.0)
+
+
+def test_determinism_same_program_same_schedule():
+    """Two identical multi-threaded programs replay the same event
+    order and the same virtual timestamps."""
+    def run():
+        c = VirtualClock().activate()
+        log = []
+        try:
+            ev = simclock.make_event()
+
+            def a():
+                for i in range(3):
+                    simclock.sleep(7.0)
+                    log.append(("a", i, simclock.monotonic()))
+                ev.set()
+
+            def b():
+                for i in range(4):
+                    simclock.sleep(5.0)
+                    log.append(("b", i, simclock.monotonic()))
+
+            simclock.start_thread(a)
+            simclock.start_thread(b)
+            ev.wait(1000.0)
+            simclock.sleep(30.0)
+        finally:
+            c.deactivate()
+        return log
+
+    assert run() == run()
+
+
+def test_wait_until_parks_virtually(clk):
+    flag = {"v": False}
+
+    def setter():
+        simclock.sleep(333.0)
+        flag["v"] = True
+
+    simclock.start_thread(setter)
+    t0 = time.monotonic()
+    assert simclock.wait_until(lambda: flag["v"], timeout=1000.0,
+                               poll=1.0)
+    assert time.monotonic() - t0 < 3.0
+
+
+# -- memory accounting ----------------------------------------------------
+
+
+def test_deep_sizeof_counts_shared_strings_once():
+    s = "arn:aws:globalaccelerator::123456789012:accelerator/x" * 4
+    shared = [s, s, s]
+    unshared = [s, s + "a", s + "b"]
+    assert deep_sizeof(shared) < deep_sizeof(unshared)
+
+
+def test_deep_sizeof_handles_slots_and_cycles():
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        Service,
+    )
+    svc = Service()
+    assert not hasattr(svc, "__dict__")   # the slots diet
+    assert deep_sizeof(svc) > 200
+    a = {}
+    a["self"] = a   # cycle
+    assert deep_sizeof(a) > 0
+
+
+def test_fleet_bytes_accounting_shape():
+    store = {f"default/svc{i}": ("x" * 100, i) for i in range(500)}
+    out = fleet_bytes(500, {"store": store, "fixed": 1000})
+    assert out["fixed_bytes"] == 1000
+    assert out["store_bytes"] > 10000
+    assert out["accounted_bytes"] == (out["store_bytes"]
+                                      + out["fixed_bytes"])
+    assert out["per_service_bytes"] == pytest.approx(
+        out["accounted_bytes"] / 500)
+    assert out["peak_rss_bytes"] > 0
