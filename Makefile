@@ -37,7 +37,7 @@ graft-dryrun:
 # package is installable in the build environment); compileall stays as
 # the pure syntax gate for files lint.py does not cover.  --all runs
 # BOTH passes: base rules L001-L007 and the concurrency contract rules
-# L101-L115 (docs/static-analysis.md)
+# L101-L116 (docs/static-analysis.md)
 lint:
 	python -m compileall -q aws_global_accelerator_controller_tpu tests
 	python hack/lint.py --all

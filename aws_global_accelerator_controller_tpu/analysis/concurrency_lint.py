@@ -1,4 +1,4 @@
-"""AST-based concurrency contract lints (rules L101-L115).
+"""AST-based concurrency contract lints (rules L101-L116).
 
 The static half of the concurrency checker: a whole-program pass over
 the tree that enforces the synchronization contracts PR 1 introduced as
@@ -152,6 +152,24 @@ segment looks lock-ish (``lock``/``_lock``/``*_lock``/``cond``/
 ``self._lock`` never alias) and suffix-chained for shared-state locks
 (``self._s.lock`` is the same ``_s.lock`` node from any class).
 
+  L116 topology-routed cross-region mutations (ISSUE 14)
+                         The cross-region wire surface
+                         (``apply_region_batch`` — the regional
+                         aggregation point, api.RegionGatewayAPI) is
+                         issued ONLY by the per-region aggregators in
+                         ``topology/``: a direct call anywhere else
+                         re-creates flat fan-in with none of the
+                         aggregator's contracts (per-contribution
+                         fence checks, per-entry error demux, region
+                         batch accounting).  The
+                         ShardedCoalescer→aggregator handoff itself
+                         (batcher.py ``_wire_record_sets`` /
+                         ``_wire_endpoint_group`` consulting the
+                         aggregator) is re-verified whenever
+                         batcher.py is in the linted set — the
+                         seeded probe strips the shipped consult and
+                         asserts the rule fires.  Package-scoped like
+                         L105; ``topology/`` is the one exempt home.
   L115 wall-clock leaks (ISSUE 13)
                          The clock-owned packages (kube/, resilience/,
                          cloudprovider/, leaderelection/, reconcile/,
@@ -335,12 +353,51 @@ def _l109_in_scope(path: Path) -> bool:
             and ("controller" in parts or "reconcile" in parts))
 
 
+# The cross-region wire surface rule L116 confines to topology/ (the
+# per-region aggregators, the one legitimate issuer).
+_CROSS_REGION_METHODS = {"apply_region_batch"}
+
+
+def _l116_in_scope(path: Path) -> bool:
+    """L116 covers every shipped package file EXCEPT the topology
+    package itself, plus the fixture corpus.  Tests and tools may
+    drive the gateway directly — observing the fake region model is
+    their job."""
+    parts = path.parts
+    if "lint_fixtures" in parts:
+        return True
+    if "aws_global_accelerator_controller_tpu" not in parts:
+        return False
+    pkg_idx = parts.index("aws_global_accelerator_controller_tpu")
+    return not (len(parts) > pkg_idx + 1
+                and parts[pkg_idx + 1] == "topology")
+
+
+def _consults_aggregator(fn: ast.AST) -> bool:
+    """Does this function lexically consult the region aggregator (the
+    ShardedCoalescer→aggregator handoff, L116)?  A call whose
+    receiver chain names an ``*aggregator*`` attribute
+    (``self._aggregator.submit_record_sets(...)``), or a helper whose
+    own name contains ``aggregator``."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain is None:
+            continue
+        if any("aggregator" in seg for seg in chain[:-1]):
+            return True
+        if "aggregator" in chain[-1]:
+            return True
+    return False
+
+
 # Rule L115's scope: the packages whose every timing surface the
 # virtual clock owns (simulation/clock.py).  The real-I/O shims inside
 # them are the simulation boundary and stay on the wall clock.
 _L115_DIRS = {"kube", "resilience", "cloudprovider", "leaderelection",
               "reconcile", "rollout", "controller", "manager",
-              "sharding"}
+              "sharding", "topology"}
 _L115_FILES = {"tracing.py", "flight.py", "metrics.py"}
 _L115_EXEMPT_FILES = {"http_store.py", "rest_server.py",
                       "kubeconfig.py", "tlsutil.py", "real.py"}
@@ -658,6 +715,7 @@ class Engine:
         self._check_sharded_submit_gate()
         self._check_coalescer_trace_gate()
         self._check_rollout_gate()
+        self._check_region_handoff_gate()
         suppressed = [f for f in self.findings
                       if not self._finding_waived(f)]
         return suppressed
@@ -818,6 +876,40 @@ class Engine:
                         f"path snaps ramping objects to their final "
                         f"target"))
 
+    def _check_region_handoff_gate(self) -> None:
+        """L116's other half: with a topology configured, every
+        coalesced mutation reaches the wire through the
+        ShardedCoalescer→aggregator handoff — the ``_wire_*``
+        functions on ``MutationCoalescer`` consulting the region
+        aggregator.  Whenever batcher.py is part of the linted set,
+        that consult must be lexically present (the seeded-mutation
+        probe strips it and asserts this fires); a batcher.py with no
+        ``_wire_*`` functions at all has lost the handoff entirely
+        and fires too."""
+        for info in self.files:
+            if info.path.name != "batcher.py" \
+                    or not _l105_in_scope(info.path):
+                continue
+            wires = [fn for cls, fn in self._functions(info.tree)
+                     if cls == "MutationCoalescer"
+                     and fn.name.startswith("_wire_")]
+            coalescers = [fn for cls, fn in self._functions(info.tree)
+                          if cls == "MutationCoalescer"]
+            if not coalescers:
+                continue
+            if not wires or not all(_consults_aggregator(fn)
+                                    for fn in wires):
+                line = (wires[0].lineno if wires
+                        else coalescers[0].lineno)
+                self.findings.append(Finding(
+                    info.path, line, "L116",
+                    "MutationCoalescer's wire path no longer hands "
+                    "off to the region aggregator: with a topology "
+                    "configured every coalesced mutation relies on "
+                    "this consult to ride the per-region fan-in "
+                    "(topology/aggregator.py) instead of flat "
+                    "cross-region calls"))
+
     def _check_compat_shim(self, info: _FileInfo) -> None:
         """Rule L111: version-sensitive ``pltpu.*``/``orbax.*`` access
         outside ``compat/``.  Whole-file pass (imports are module
@@ -975,7 +1067,10 @@ class Engine:
         # exempt module.
         if (len(chain) >= 2 and (chain[-2], chain[-1]) in _COALESCED_WRITES
                 and _l105_in_scope(info.path)
-                and info.path.name != "batcher.py"):
+                and info.path.name != "batcher.py"
+                and "topology" not in info.path.parts):
+            # topology/aggregator.py's flat fallback is the one other
+            # legitimate flush issuer: it sits BELOW the coalescer
             self.findings.append(Finding(
                 info.path, line, "L106",
                 f"direct write-path mutation '{'.'.join(chain)}()' "
@@ -1083,6 +1178,21 @@ class Engine:
                 f"or an explicit ctx=None for a genuinely untraced "
                 f"path) so the item carries its trace across the "
                 f"queue/thread boundary (tracing.py), or waive with "
+                f"'# race: <reason>'"))
+        # L116: a cross-region wire call (the regional aggregation
+        # point) outside topology/ re-creates flat fan-in without the
+        # aggregator's fence/demux/accounting contracts.
+        if (chain[-1] in _CROSS_REGION_METHODS
+                and _l116_in_scope(info.path)):
+            self.findings.append(Finding(
+                info.path, line, "L116",
+                f"cross-region mutation '{'.'.join(chain)}()' outside "
+                f"topology/: the regional aggregation point is issued "
+                f"only by the per-region aggregators "
+                f"(topology/aggregator.py — per-contribution fence "
+                f"checks, per-entry error demux, region batch "
+                f"accounting); submit through the coalescer so the "
+                f"handoff routes it, or waive with "
                 f"'# race: <reason>'"))
         # L115: wall-clock leaks in the clock-owned packages — a
         # direct time.* read/sleep or a raw threading primitive is

@@ -138,15 +138,53 @@ class Route53API(ABC):
         ...
 
 
+class RegionGatewayAPI(ABC):
+    """The regional aggregation point of the multi-region topology
+    (ISSUE 14): one cross-region message per region carrying many
+    containers' mutations, fanned out locally at intra-region cost —
+    the HiCCL hierarchical-compose shape on the wire.  Simulation-
+    backed (the fake cloud implements it; a real deployment would
+    stand up a per-region forwarder); bundles without one (boto) leave
+    ``AWSAPIs.gateway`` as None and the topology layer degrades to
+    flat per-container calls."""
+
+    @abstractmethod
+    def apply_region_batch(self, region: str,
+                           entries: List[tuple]) -> List:
+        """Apply ``[(kind, container_key, payload), ...]`` inside
+        ``region`` — kind ``"record_sets"`` (payload = the zone's
+        ``[(action, record_set), ...]`` ChangeBatch) or
+        ``"endpoint_group"`` (payload = the EG's replacement config
+        list).  Each container entry applies ATOMICALLY on its own;
+        the batch is NOT atomic across containers — returns one
+        verdict per entry, None for success or the entry's exception
+        (per-entry attribution is what lets the coalescer's
+        bisect-on-rejection keep working through the aggregation
+        layer, topology/aggregator.py)."""
+        ...
+
+    @abstractmethod
+    def get_region_digest(self, region: str) -> str:
+        """Fingerprint rollup of the region's mutable container state
+        (topology/digest.py ``rollup_digest`` spelling) — the one-read
+        answer a steady-state sweep wave exchanges instead of N
+        cross-region verifying reads."""
+        ...
+
+
 class AWSAPIs:
     """Bundle of the three service clients (pkg/cloudprovider/aws/aws.go:12-16).
 
     ``ga``/``route53`` are global (pinned to us-west-2 in the reference,
-    aws.go:26-33); ``elb`` is regional.
+    aws.go:26-33); ``elb`` is regional.  ``gateway`` is the optional
+    region aggregation point (:class:`RegionGatewayAPI`) the
+    multi-region topology layer rides; None = no gateway (flat).
     """
 
     def __init__(self, elb: ELBv2API, ga: GlobalAcceleratorAPI,
-                 route53: Route53API):
+                 route53: Route53API,
+                 gateway: "RegionGatewayAPI | None" = None):
         self.elb = elb
         self.ga = ga
         self.route53 = route53
+        self.gateway = gateway

@@ -376,10 +376,19 @@ class MutationCoalescer:
 
     def __init__(self, apis, config: Optional[CoalesceConfig] = None,
                  clock: Callable[[], float] = simclock.monotonic,
-                 fence=None):
+                 fence=None, aggregator=None, shard_id=None):
         self.apis = apis
         self.config = config or CoalesceConfig()
         self._clock = clock
+        # the region aggregator (topology/aggregator.py): with a
+        # topology configured, drained cohorts hand their wire calls
+        # to the per-region fan-in layer instead of the service —
+        # lint rule L116 verifies the handoff consult stays on the
+        # wire functions below.  None = flat fan-in (the default).
+        self._aggregator = aggregator
+        # this cohort's shard (ShardedCoalescer routing), carried into
+        # the aggregator for the placement's mutation profile
+        self._shard_id = shard_id
         self._lock = locks.make_lock("coalescer-groups")
         self._groups: Dict[Tuple[str, str], _Group] = {}
         # warmth survives group pruning: idle groups are deleted after
@@ -686,8 +695,7 @@ class MutationCoalescer:
             fs.links = tuple(sorted({c.trace_id for c in ctxs}))
             try:
                 record_mutation_flush(KIND_RECORD_SET)
-                self.apis.route53.change_resource_record_sets_batch(
-                    zone_id, changes)
+                self._wire_record_sets(zone_id, changes, ctxs)
             except Exception as e:
                 fs.error = f"{type(e).__name__}: {e}"
                 self._demux_failure(
@@ -725,7 +733,7 @@ class MutationCoalescer:
                                  [it.payload for it in intents])
             try:
                 record_mutation_flush(KIND_ENDPOINT_GROUP)
-                self.apis.ga.update_endpoint_group(arn, configs)
+                self._wire_endpoint_group(arn, configs, ctxs)
             except Exception as e:
                 fs.error = f"{type(e).__name__}: {e}"
                 self._demux_failure(
@@ -738,6 +746,38 @@ class MutationCoalescer:
         for it in intents:
             for future in it.futures:
                 future.complete()
+
+    # -- the wire (the ShardedCoalescer→aggregator handoff, L116) -------
+
+    def _wire_record_sets(self, zone_id: str, changes, ctxs) -> None:
+        """One drained cohort's zone batch onto the wire.  With a
+        region topology configured the batch rides the per-region
+        aggregator (topology/aggregator.py) — a fleet-wide storm
+        becomes one cross-region call per region instead of one per
+        zone — carrying this cohort's fence (a sealed shard's
+        contribution is rejected per attempt, never silently dropped)
+        and its member traces.  Flat fan-in otherwise.  Lint rule
+        L116 verifies this handoff consult whenever batcher.py is
+        linted (the seeded probe strips it and asserts the fire)."""
+        if self._aggregator is not None:
+            self._aggregator.submit_record_sets(
+                zone_id, changes, fence=self._fence, ctxs=ctxs,
+                shard_id=self._shard_id)
+            return
+        self.apis.route53.change_resource_record_sets_batch(
+            zone_id, changes)
+
+    def _wire_endpoint_group(self, arn: str, configs, ctxs) -> None:
+        """The endpoint-group twin of :meth:`_wire_record_sets`: the
+        merged replacement set rides the region aggregator when a
+        topology is configured (L116), the direct service call
+        otherwise."""
+        if self._aggregator is not None:
+            self._aggregator.submit_endpoint_group(
+                arn, configs, fence=self._fence, ctxs=ctxs,
+                shard_id=self._shard_id)
+            return
+        self.apis.ga.update_endpoint_group(arn, configs)
 
     def _demux_failure(self, kind: str, intents: List[_Intent],
                        exc: Exception, retry_half) -> None:

@@ -45,7 +45,8 @@ class CloudFactory:
                  resilience: Optional[ResilienceConfig] = None,
                  coalesce: Optional[CoalesceConfig] = None,
                  num_shards: int = 1,
-                 discovery_cache_ttl: Optional[float] = None):
+                 discovery_cache_ttl: Optional[float] = None,
+                 topology=None):
         self._providers: Dict[str, AWSProvider] = {}
         self._lock = locks.make_lock("cloud-factory")
         self._poll_interval = delete_poll_interval
@@ -96,6 +97,32 @@ class CloudFactory:
         # every cached definitely-absent answer may be a lie — the
         # duplicate-create window (FleetDiscoveryState.cold_start)
         self.shards.add_listener(self._on_shard_transition)
+        # the multi-region topology (topology/): None (the default) is
+        # the flat pre-topology tree, byte-identical.  Configured, it
+        # arms (a) the per-region write aggregator — cohort flushes
+        # hand their wire calls to one fan-in group per region, each
+        # region riding its OWN wrapped bundle (own breaker/bucket) —
+        # and (b) the digest gate the controllers' fingerprint caches
+        # consult before sweep-tagging a key (topology/digest.py).
+        self.topology = topology
+        self._aggregator = None
+        self.digest_gate = None
+        if topology is not None:
+            from ...topology import RegionAggregator, RegionDigestGate
+
+            if topology.aggregate:
+                self._aggregator = RegionAggregator(
+                    lambda region: self.provider_for(region).apis,
+                    topology,
+                    linger=max(self._coalesce.linger,
+                               topology.aggregate_linger))
+            if topology.digest_reads:
+                # per-region resolution: a region's digest exchanges
+                # ride its OWN wrapper (own breaker — the per-region
+                # independence the partition chaos e2e asserts)
+                self.digest_gate = RegionDigestGate(
+                    lambda region: self.provider_for(region).apis,
+                    topology)
 
     def _on_shard_transition(self, event: str, shard_id: int) -> None:
         if event == "acquired":
@@ -138,7 +165,9 @@ class CloudFactory:
                         lambda sid: MutationCoalescer(
                             first_apis, config=self._coalesce,
                             fence=CompositeFence(
-                                self.fence, self.shards.fence(sid))))
+                                self.fence, self.shards.fence(sid)),
+                            aggregator=self._aggregator,
+                            shard_id=sid))
                 kwargs = {}
                 if self._discovery_ttl is not None:
                     kwargs["discovery_cache_ttl"] = self._discovery_ttl
@@ -149,7 +178,8 @@ class CloudFactory:
                     accelerator_not_found_retry=self._not_found_retry,
                     discovery_state=self._discovery_state,
                     coalescer=self._coalescer,
-                    shards=self.shards, **kwargs)
+                    shards=self.shards, topology=self.topology,
+                    **kwargs)
                 self._providers[region] = provider
             return provider
 
@@ -174,7 +204,8 @@ class FakeCloudFactory(CloudFactory):
                  coalesce: Optional[CoalesceConfig] = None,
                  cloud: Optional[AWSAPIs] = None,
                  num_shards: int = 1,
-                 discovery_cache_ttl: Optional[float] = None):
+                 discovery_cache_ttl: Optional[float] = None,
+                 topology=None):
         # fast resilience profile by default: real backoff shapes at
         # 100x speed, breaker thresholds the ordinary one-shot fault
         # tests never trip (chaos tests pass tighter configs); same
@@ -184,12 +215,17 @@ class FakeCloudFactory(CloudFactory):
                          resilience=resilience or FAKE_CLOUD_CONFIG,
                          coalesce=coalesce or FAKE_COALESCE_CONFIG,
                          num_shards=num_shards,
-                         discovery_cache_ttl=discovery_cache_ttl)
+                         discovery_cache_ttl=discovery_cache_ttl,
+                         topology=topology)
         # ``cloud`` lets a FRESH factory adopt an EXISTING fake cloud —
         # the crash-restart shape: new process state (empty discovery
         # caches, cold fingerprints, new fence) over the same AWS world
         self.cloud = cloud if cloud is not None else FakeAWSCloud(
             settle_seconds=settle_seconds, fault_seed=fault_seed)
+        if topology is not None and hasattr(self.cloud, "set_topology"):
+            # arm the latency/partition model on the shared injector
+            # (an adopted cloud keeps its own if this factory has none)
+            self.cloud.set_topology(topology)
 
     def _make_apis(self, region: str) -> AWSAPIs:
         return self.cloud

@@ -27,16 +27,24 @@ import random
 import threading
 import zlib
 from collections import deque
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ... import metrics
 from ...errors import (
     AWSAPIError,
     EndpointGroupNotFoundError,
     ListenerNotFoundError,
 )
 from ...simulation import clock as simclock
-from .api import AWSAPIs, ELBv2API, GlobalAcceleratorAPI, Route53API
+from .api import (
+    AWSAPIs,
+    ELBv2API,
+    GlobalAcceleratorAPI,
+    RegionGatewayAPI,
+    Route53API,
+)
 from .types import (
     Accelerator,
     EndpointDescription,
@@ -63,7 +71,42 @@ _METHOD_SERVICE: Dict[str, str] = {
     "list_resource_record_sets": "route53",
     "change_resource_record_sets": "route53",
     "change_resource_record_sets_batch": "route53",
+    # the regional aggregation point (ISSUE 14): its own service so a
+    # "ga" blackout window does not swallow gateway traffic; "*"
+    # windows still cover it
+    "apply_region_batch": "gateway",
+    "get_region_digest": "gateway",
 }
+
+# methods that mutate cloud state — what the topology layer counts as
+# cross-region MUTATIONS (reads cross too, but the fan-in metric is
+# about the write path)
+_MUTATION_METHODS = {
+    "create_accelerator", "update_accelerator", "tag_resource",
+    "delete_accelerator", "create_listener", "update_listener",
+    "delete_listener", "create_endpoint_group",
+    "update_endpoint_group", "add_endpoints", "remove_endpoints",
+    "delete_endpoint_group", "change_resource_record_sets",
+    "change_resource_record_sets_batch", "apply_region_batch",
+}
+
+# thread-local source-region context: the fake gateway applies its
+# entries "from inside" the destination region, so nested fault checks
+# see src == dst (intra-region cost, no partition — a partition severs
+# links, not the region's own control plane)
+_region_tls = threading.local()
+
+
+@contextmanager
+def _in_region(region: str):
+    """Mark this thread as executing inside ``region`` for the block
+    (the fake gateway's local fan-out)."""
+    prev = getattr(_region_tls, "region", None)
+    _region_tls.region = region
+    try:
+        yield
+    finally:
+        _region_tls.region = prev
 
 
 def _service_of(method: str) -> str:
@@ -130,6 +173,11 @@ class FaultInjector:
         # (edit_endpoint_group / edit_record_set)
         self._ga: Optional["FakeGlobalAccelerator"] = None
         self._route53: Optional["FakeRoute53"] = None
+        # the region topology (topology/model.py), installed by the
+        # factory: per-(region-pair) latency charged through simclock
+        # and partition failures per call — None (the default) is the
+        # flat pre-topology cloud, byte-identical
+        self.topology = None
         # bounded decision log: every injected fault, in order — the
         # flight recorder (flight.py) freezes this next to the span
         # ring so a dump correlates "what went wrong" with "what the
@@ -219,6 +267,53 @@ class FaultInjector:
                     rate_per_s,
                     burst if burst is not None else max(1.0, rate_per_s))
 
+    # -- region topology (ISSUE 14) -------------------------------------
+
+    def set_topology(self, topology) -> None:
+        """Arm the multi-region model: every call with a resolvable
+        destination region pays the topology's (src, dst) latency
+        (through simclock — virtual-time ready) and fails while the
+        destination is partitioned.  src is the controller's local
+        region, or the gateway's destination inside a
+        ``region_context`` block."""
+        with self._lock:
+            self.topology = topology
+
+    @staticmethod
+    def region_context(region: str):
+        """Mark this thread as executing INSIDE ``region`` (the fake
+        gateway's local fan-out): nested checks see src == dst."""
+        return _in_region(region)
+
+    def _topology_verdict(self, method: str, zone: Optional[str],
+                          region: Optional[str], units: int
+                          ) -> "Tuple[float, Optional[Exception]]":
+        """(added latency seconds, partition exception or None) for
+        one call.  Caller holds the injector lock; only pure
+        computation and the topology's own (seeded) draws happen
+        here — the sleep and the raise are the caller's, outside."""
+        top = self.topology
+        if top is None:
+            return 0.0, None
+        dst = region
+        if dst is None and zone is not None:
+            dst = top.region_of(zone)
+        if dst is None:
+            return 0.0, None
+        src = getattr(_region_tls, "region", None) or top.local_region
+        mutation = method in _MUTATION_METHODS
+        delay = top.channel_latency(src, dst, units=units,
+                                    mutation=mutation,
+                                    now=self._clock())
+        if src != dst and mutation:
+            metrics.record_cross_region_mutation(src, dst)
+        if top.partition_decision(src, dst, method, self._clock()):
+            return delay, AWSAPIError(
+                "ServiceUnavailable",
+                f"chaos: region {dst} partitioned from {src}",
+                retryable=True)
+        return delay, None
+
     # -- out-of-band state edits ---------------------------------------
 
     def edit_endpoint_group(self, endpoint_group_arn: str,
@@ -294,13 +389,18 @@ class FaultInjector:
             f"{self._seed}:{salt}:{method}:{index}".encode())
         return draw / 2**32 < rate
 
-    def check(self, method: str, zone: Optional[str] = None) -> None:
+    def check(self, method: str, zone: Optional[str] = None,
+              region: Optional[str] = None, units: int = 1) -> None:
         """Called by every fake API method before it touches state (an
         injected fault means the call never happened).  Decisions and
         counting happen under the injector lock; the latency sleep and
         the raise happen outside it.  ``zone`` is the hosted-zone id of
         a Route53 mutation call, consulted by the per-zone throttle
-        (``set_zone_throttle``) after the one-shot queue."""
+        (``set_zone_throttle``) after the one-shot queue.  ``region``
+        is the call's destination region (``zone`` resolves through
+        the topology's bindings when absent): with a topology armed
+        (``set_topology``) the call pays the (src, dst) latency for
+        ``units`` payload items and fails while dst is partitioned."""
         with self._lock:
             index = self._calls.get(method, 0)
             self._calls[method] = index + 1
@@ -308,8 +408,18 @@ class FaultInjector:
                                       self._latency.get("*", 0.0))
             exc: Optional[Exception] = None
             source = ""
+            # region topology first: a partitioned destination's call
+            # never arrives, so nothing else gets to answer it (the
+            # topology's draws ride their own per-pair streams — no
+            # other source's schedule shifts)
+            top_delay, top_exc = self._topology_verdict(
+                method, zone, region, units)
+            delay += top_delay
+            if top_exc is not None:
+                exc = top_exc
+                source = "partition"
             pending = self._faults.get(method)
-            if pending:
+            if exc is None and pending:
                 exc = pending.pop(0)
                 source = "one_shot"
             if exc is None and zone is not None \
@@ -426,6 +536,17 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
                               f"accelerator {arn} not found")
         self._refresh_status(st)
         return st
+
+    def _eg_region(self, arn: str) -> Optional[str]:
+        """Destination region of an endpoint-group call (the
+        topology's latency/partition model; None = no topology or
+        unknown EG — the not-found answer still comes from the usual
+        path, at local cost)."""
+        if self.faults.topology is None:
+            return None
+        with self._lock:
+            entry = self._endpoint_groups.get(arn)
+            return entry[1].endpoint_group_region if entry else None
 
     # -- accelerators ---------------------------------------------------
 
@@ -571,7 +692,8 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
                     self._egs_of.get(listener_arn, {}).values()]
 
     def describe_endpoint_group(self, arn: str) -> EndpointGroup:
-        self.faults.check("describe_endpoint_group")
+        self.faults.check("describe_endpoint_group",
+                          region=self._eg_region(arn))
         with self._lock:
             entry = self._endpoint_groups.get(arn)
             if entry is None:
@@ -581,7 +703,8 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
     def create_endpoint_group(self, listener_arn: str, region: str,
                               endpoint_id: str,
                               client_ip_preservation: bool) -> EndpointGroup:
-        self.faults.check("create_endpoint_group")
+        self.faults.check("create_endpoint_group", region=region)
+        top = self.faults.topology
         with self._lock:
             if listener_arn not in self._listeners:
                 raise ListenerNotFoundError()
@@ -597,13 +720,20 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
             self._egs_of.setdefault(listener_arn, {})[arn] = eg
             acc_arn = self._listeners[listener_arn][0]
             self._mark_in_progress(self._get_state(acc_arn))
+            if top is not None:
+                # the container's home region, for the topology's
+                # latency/partition model and the digest rollup
+                top.bind(arn, region)
             return eg.copy()
 
     def update_endpoint_group(self, arn: str,
                               endpoint_configurations) -> EndpointGroup:
         """UpdateEndpointGroup REPLACES the endpoint set with the given
         configurations, as the real API does."""
-        self.faults.check("update_endpoint_group")
+        endpoint_configurations = list(endpoint_configurations)
+        self.faults.check("update_endpoint_group",
+                          region=self._eg_region(arn),
+                          units=max(1, len(endpoint_configurations)))
         with self._lock:
             entry = self._endpoint_groups.get(arn)
             if entry is None:
@@ -623,7 +753,8 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
     def add_endpoints(self, endpoint_group_arn: str, endpoint_id: str,
                       client_ip_preservation: bool,
                       weight: Optional[int]) -> List[EndpointDescription]:
-        self.faults.check("add_endpoints")
+        self.faults.check("add_endpoints",
+                          region=self._eg_region(endpoint_group_arn))
         with self._lock:
             entry = self._endpoint_groups.get(endpoint_group_arn)
             if entry is None:
@@ -644,7 +775,8 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
 
     def remove_endpoints(self, endpoint_group_arn: str,
                          endpoint_ids: List[str]) -> None:
-        self.faults.check("remove_endpoints")
+        self.faults.check("remove_endpoints",
+                          region=self._eg_region(endpoint_group_arn))
         with self._lock:
             entry = self._endpoint_groups.get(endpoint_group_arn)
             if entry is None:
@@ -673,7 +805,8 @@ class FakeGlobalAccelerator(GlobalAcceleratorAPI):
                 f"endpoint {endpoint_id} not in {endpoint_group_arn}")
 
     def delete_endpoint_group(self, arn: str) -> None:
-        self.faults.check("delete_endpoint_group")
+        self.faults.check("delete_endpoint_group",
+                          region=self._eg_region(arn))
         with self._lock:
             if arn not in self._endpoint_groups:
                 raise EndpointGroupNotFoundError()
@@ -734,7 +867,12 @@ class FakeRoute53(Route53API):
         self._zones: Dict[str, HostedZone] = {}
         self._records: Dict[str, List[ResourceRecordSet]] = {}
 
-    def create_hosted_zone(self, name: str) -> HostedZone:
+    def create_hosted_zone(self, name: str,
+                           region: Optional[str] = None) -> HostedZone:
+        """Seeding helper.  ``region`` homes the zone's data plane for
+        the multi-region topology model (Route53 the SERVICE is
+        global; the topology models where a zone's writes must travel
+        to take effect) — ignored without a topology armed."""
         with self._lock:
             if not name.endswith("."):
                 name += "."
@@ -742,7 +880,16 @@ class FakeRoute53(Route53API):
             zone = HostedZone(id=zone_id, name=name)
             self._zones[zone_id] = zone
             self._records[zone_id] = []
-            return zone
+        top = self.faults.topology
+        if top is not None and region is not None:
+            top.bind(zone_id, region)
+        return zone
+
+    def _zone_region(self, zone_id: str) -> Optional[str]:
+        """Destination region of a zone READ (writes resolve via the
+        injector's own zone->region lookup)."""
+        top = self.faults.topology
+        return top.region_of(zone_id) if top is not None else None
 
     def list_hosted_zones(self) -> List[HostedZone]:
         self.faults.check("list_hosted_zones")
@@ -762,7 +909,8 @@ class FakeRoute53(Route53API):
             return after[:max_items]
 
     def list_resource_record_sets(self, hosted_zone_id: str) -> List[ResourceRecordSet]:
-        self.faults.check("list_resource_record_sets")
+        self.faults.check("list_resource_record_sets",
+                          region=self._zone_region(hosted_zone_id))
         with self._lock:
             if hosted_zone_id not in self._records:
                 raise AWSAPIError("NoSuchHostedZone", hosted_zone_id)
@@ -784,8 +932,10 @@ class FakeRoute53(Route53API):
         InvalidChangeBatch naming the offender and the zone is left
         untouched — the semantics the write coalescer's
         bisect-on-rejection relies on (batcher.py)."""
+        changes = list(changes)
         self.faults.check("change_resource_record_sets_batch",
-                          zone=hosted_zone_id)
+                          zone=hosted_zone_id,
+                          units=max(1, len(changes)))
         with self._lock:
             working = list(self._require_zone_locked(hosted_zone_id))
             for action, record_set in changes:
@@ -882,6 +1032,84 @@ class FakeRoute53(Route53API):
                 f"record {ident} not in {hosted_zone_id}")
 
 
+class FakeRegionGateway(RegionGatewayAPI):
+    """The fake regional aggregation point (ISSUE 14): one
+    cross-region call per batch, local fan-out at intra-region cost.
+
+    ``apply_region_batch`` pays the topology's cross-region latency
+    ONCE (its own ``check``, units = total payload) and then applies
+    each container entry through the ordinary fake service methods
+    inside a ``region_context`` — so per-method chaos schedules, zone
+    throttles and call counts all still see the traffic (the
+    hierarchical-vs-flat A/B consumes the same per-method decision
+    surfaces), while the entries' own checks resolve src == dst and
+    charge only intra-region latency.  Entries apply atomically per
+    container, verdicts reported per entry (api.RegionGatewayAPI)."""
+
+    def __init__(self, cloud: "FakeAWSCloud"):
+        self._cloud = cloud
+        self.faults = cloud.faults
+
+    def apply_region_batch(self, region: str, entries) -> List:
+        entries = list(entries)
+        units = sum(max(1, len(payload)) for _, _, payload in entries)
+        self.faults.check("apply_region_batch", region=region,
+                          units=max(1, units))
+        results: List[Optional[Exception]] = []
+        with self.faults.region_context(region):
+            # the gateway IS the region's server-side fan-out: the
+            # fake cloud's own state machines applying entries
+            # locally, not a controller-side bypass of the write path
+            # (hence the race: waivers on both apply calls)
+            for kind, key, payload in entries:
+                try:
+                    if kind == "record_sets":
+                        r53 = self._cloud.route53
+                        r53.change_resource_record_sets_batch(  # race: server-side fan-out
+                            key, payload)
+                    elif kind == "endpoint_group":
+                        self._cloud.ga.update_endpoint_group(  # race: server-side fan-out
+                            key, payload)
+                    else:
+                        raise AWSAPIError("InvalidInput",
+                                          f"bad entry kind {kind!r}")
+                except Exception as e:
+                    results.append(e)
+                else:
+                    results.append(None)
+        return results
+
+    def get_region_digest(self, region: str) -> str:
+        """Fingerprint rollup of the region's bound containers' mutable
+        state — read lock-direct from the fakes (a digest read must
+        not fan out into per-container API calls; that is the whole
+        point), canonicalized via topology/digest.rollup_digest."""
+        from ...topology.digest import rollup_digest
+
+        self.faults.check("get_region_digest", region=region)
+        top = self.faults.topology
+        if top is None:
+            return rollup_digest([])
+        parts = []
+        ga = self._cloud.ga
+        r53 = self._cloud.route53
+        for container in top.containers_in(region):
+            with ga._lock:
+                entry = ga._endpoint_groups.get(container)
+                if entry is not None:
+                    parts.append((container, repr(sorted(
+                        (d.endpoint_id, d.weight,
+                         d.client_ip_preservation_enabled)
+                        for d in entry[1].endpoint_descriptions))))
+                    continue
+            with r53._lock:
+                records = r53._records.get(container)
+                if records is not None:
+                    parts.append((container, repr(sorted(
+                        repr(r) for r in records))))
+        return rollup_digest(parts)
+
+
 class FakeAWSCloud(AWSAPIs):
     """Complete fake cloud bundle with shared fault injector."""
 
@@ -893,3 +1121,11 @@ class FakeAWSCloud(AWSAPIs):
             ga=FakeGlobalAccelerator(settle_seconds, self.faults),
             route53=FakeRoute53(self.faults),
         )
+        # the regional aggregation point rides the same injector; inert
+        # (never called) until a topology routes traffic through it
+        self.gateway = FakeRegionGateway(self)
+
+    def set_topology(self, topology) -> None:
+        """Arm the multi-region model (topology/model.py) on the shared
+        injector — the factory calls this when built with a topology."""
+        self.faults.set_topology(topology)

@@ -225,7 +225,7 @@ class AWSProvider:
                  discovery_cache_ttl: float = DISCOVERY_CACHE_TTL,
                  discovery_state: "FleetDiscoveryState | None" = None,
                  coalescer: "MutationCoalescer | None" = None,
-                 shards=None):
+                 shards=None, topology=None):
         from ...sharding import ShardSet
 
         self.apis = apis
@@ -248,6 +248,11 @@ class AWSProvider:
         # container's shard is owned here (lint rule L110); a bare
         # provider gets the degenerate single-shard set (owns all)
         self.shards = shards or ShardSet(1)
+        # the region topology (topology/model.py): the ensure paths
+        # bind each kube key to the regions its containers live in —
+        # what the digest gate scopes a key's sweep answer by.  None
+        # (the default) binds nothing: flat behavior
+        self._topology = topology
 
     # A/B + escape hatch: class-level so a deployment (or the perf
     # harness) can disable the O(1)-negative path and fall back to
@@ -740,6 +745,10 @@ class AWSProvider:
     def _ensure_global_accelerator(self, resource, obj, lb_ingress,
                                    cluster_name, lb_name, region,
                                    listener_spec, listener_changed):
+        if self._topology is not None:
+            # this object's endpoint group lives in the LB's region:
+            # the digest gate scopes its sweep answers by this binding
+            self._topology.bind_key(obj.key(), region)
         lb = self.get_load_balancer(lb_name)
         if lb.dns_name != lb_ingress.hostname:
             raise AWSAPIError(
@@ -1205,6 +1214,16 @@ class AWSProvider:
         for hostname in hostnames:
             hosted_zone = self.get_hosted_zone(hostname)
             logger.info("hosted zone is %s", hosted_zone.id)
+            if self._topology is not None:
+                # the record plane's home region for this object; an
+                # UNBOUND zone binds as None, which VETOES the key's
+                # digest answers — its records live outside every
+                # region digest, so another controller's binding
+                # (the GA endpoint group's) must not mask the zone's
+                # sweeps (topology/model.py bind_key)
+                self._topology.bind_key(
+                    f"{ns}/{name}",
+                    self._topology.bound_region(hosted_zone.id))
             hostname_policy = policy
             if policy.weighted and weights is not None \
                     and hostname in weights:

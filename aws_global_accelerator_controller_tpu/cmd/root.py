@@ -157,6 +157,20 @@ def build_parser() -> argparse.ArgumentParser:
                                  "this long, background enqueues are "
                                  "shed first. 0 disables (default "
                                  "1.0).")
+    controller.add_argument("--regions", default="",
+                            help="Comma-separated region list arming "
+                                 "the multi-region topology layer "
+                                 "(topology/): per-region write "
+                                 "aggregation, digest-based sweep "
+                                 "reads, and the fake cloud's "
+                                 "latency/partition model.  Empty "
+                                 "(default) = flat fan-in, the "
+                                 "pre-topology behavior.  "
+                                 "Fake-cloud backends only.")
+    controller.add_argument("--local-region", default="",
+                            help="With --regions: the region this "
+                                 "controller runs in (default: the "
+                                 "first listed region).")
     controller.add_argument("--seed", action="append", default=[],
                             metavar="FILE",
                             help="Apply YAML manifests into the fake API "
@@ -253,12 +267,26 @@ def run_controller(args) -> int:
                 f"[0, {num_shards})")
     stop = setup_signal_handler()
 
+    # multi-region topology (topology/): flat fan-in remains the
+    # default until --regions is configured; the simulated region
+    # model needs the fake cloud (the boto bundle has no gateway)
+    from ..topology import parse_regions
+    topology = parse_regions(
+        getattr(args, "regions", ""),
+        local_region=getattr(args, "local_region", "") or None)
+    if topology is not None and not args.fake \
+            and not args.fake_cloud:
+        raise SystemExit("--regions requires the fake cloud "
+                         "(--fake or --fake-cloud): the simulated "
+                         "region gateway backs the topology layer")
+
     if args.fake:
         logger.info("using the in-process fake API server")
         api = FakeAPIServer()
         kube = KubeClient(api)
         operator = OperatorClient(api)
-        cloud_factory = FakeCloudFactory(num_shards=num_shards)
+        cloud_factory = FakeCloudFactory(num_shards=num_shards,
+                                         topology=topology)
     else:
         from ..kube.http_store import HTTPAPIServer
         from ..kube.kubeconfig import KubeConfigError, build_config
@@ -274,7 +302,8 @@ def run_controller(args) -> int:
         api = HTTPAPIServer(rest_config)
         kube = KubeClient(api)
         operator = OperatorClient(api)
-        cloud_factory = (FakeCloudFactory(num_shards=num_shards)
+        cloud_factory = (FakeCloudFactory(num_shards=num_shards,
+                                          topology=topology)
                          if args.fake_cloud
                          else BotoCloudFactory(num_shards=num_shards))
 

@@ -178,10 +178,14 @@ class EndpointGroupBindingController:
         # (everything _reconcile_update reads from informer state);
         # a mid-ramp binding VETOES the skip — its convergence is
         # driven by timed re-deliveries the gate must not answer
+        sweep_gate = getattr(cloud_factory, "digest_gate", None)
+        if sweep_gate is not None:
+            sweep_gate.note_sweep_period(config.fingerprints.sweep_every)
         self.fingerprints = FingerprintCache(
             "EndpointGroupBinding", self._binding_fingerprint,
             config.fingerprints,
-            skip_veto=lambda o: rollout_active(o.status.rollout))
+            skip_veto=lambda o: rollout_active(o.status.rollout),
+            sweep_gate=sweep_gate.allow_skip if sweep_gate else None)
 
         self.service_informer = informer_factory.services()
         self.ingress_informer = informer_factory.ingresses()

@@ -153,12 +153,23 @@ class GlobalAcceleratorController:
 
         # steady-state fast path: one fingerprint gate per queue
         # (reconcile/fingerprint.py; see _resync_service below)
+        # the multi-region digest gate (topology/digest.py) answers a
+        # sweep-due key's deep verify with one per-region digest
+        # exchange when every bound region is verified-stable; None
+        # (no topology) leaves the sweep tier untouched
+        sweep_gate = getattr(cloud_factory, "digest_gate", None)
+        if sweep_gate is not None:
+            # CLEAN must span OUR sweep period, or never-deep-verified
+            # key residues could bake drift into the baseline
+            sweep_gate.note_sweep_period(config.fingerprints.sweep_every)
         self.service_fingerprints = FingerprintCache(
             f"{CONTROLLER_AGENT_NAME}-service", ga_service_fingerprint,
-            config.fingerprints)
+            config.fingerprints,
+            sweep_gate=sweep_gate.allow_skip if sweep_gate else None)
         self.ingress_fingerprints = FingerprintCache(
             f"{CONTROLLER_AGENT_NAME}-ingress", ga_ingress_fingerprint,
-            config.fingerprints)
+            config.fingerprints,
+            sweep_gate=sweep_gate.allow_skip if sweep_gate else None)
 
         self.service_informer = informer_factory.services()
         self.service_informer.add_event_handler(

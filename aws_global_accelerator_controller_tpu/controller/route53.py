@@ -151,14 +151,21 @@ class Route53Controller:
         # steady-state fast path: one fingerprint gate per queue; a
         # mid-ramp object vetoes the skip (its convergence is driven
         # by timed re-deliveries the gate must not answer)
+        # multi-region digest gate (topology/digest.py): see the GA
+        # controller's twin comment
+        sweep_gate = getattr(cloud_factory, "digest_gate", None)
+        if sweep_gate is not None:
+            sweep_gate.note_sweep_period(config.fingerprints.sweep_every)
         self.service_fingerprints = FingerprintCache(
             f"{CONTROLLER_AGENT_NAME}-service",
             route53_service_fingerprint, config.fingerprints,
-            skip_veto=record_ramp_active)
+            skip_veto=record_ramp_active,
+            sweep_gate=sweep_gate.allow_skip if sweep_gate else None)
         self.ingress_fingerprints = FingerprintCache(
             f"{CONTROLLER_AGENT_NAME}-ingress",
             route53_ingress_fingerprint, config.fingerprints,
-            skip_veto=record_ramp_active)
+            skip_veto=record_ramp_active,
+            sweep_gate=sweep_gate.allow_skip if sweep_gate else None)
 
         self.service_informer = informer_factory.services()
         self.service_informer.add_event_handler(

@@ -84,7 +84,8 @@ class ShardLeaseManager:
                  renew_deadline: float = SHARD_RENEW_DEADLINE,
                  retry_period: float = SHARD_RETRY_PERIOD,
                  handoff_drain_timeout: float = HANDOFF_DRAIN_TIMEOUT,
-                 drain: Optional[Callable[[int, float], bool]] = None):
+                 drain: Optional[Callable[[int, float], bool]] = None,
+                 placement=None):
         if renew_deadline >= lease_duration:
             raise ValueError(
                 "renew_deadline must be < lease_duration (a holder "
@@ -99,6 +100,13 @@ class ShardLeaseManager:
         self.retry_period = retry_period
         self.handoff_drain_timeout = handoff_drain_timeout
         self._drain = drain
+        # locality-driven placement (topology/placement.py): when set,
+        # the convergence target is the topology-weighted churn-
+        # bounded map instead of the plain rendezvous map.  Ownership
+        # safety is untouched — the leases still arbitrate; a replica
+        # acting on a divergent learned profile can flap a shard, not
+        # double-own it (ARCHITECTURE.md "Multi-region topology")
+        self._placement = placement
         self._member = LeaseCandidate(
             f"{name}-member-{identity}", namespace, kube_client,
             identity, lease_duration)
@@ -252,7 +260,12 @@ class ShardLeaseManager:
         self._renew_held()
 
         members = self._alive_members()
-        assignment = compute_assignment(self.shards.num_shards, members)
+        if self._placement is not None:
+            assignment = self._placement.assignment(
+                self.shards.num_shards, members)
+        else:
+            assignment = compute_assignment(self.shards.num_shards,
+                                            members)
 
         # hand off what is no longer ours...
         for sid in sorted(self.shards.owned_shards()):

@@ -455,6 +455,32 @@ default_registry.describe(
     "convergence ledger, a metrics delta and the seeded chaos "
     "decision logs into one correlated JSON file (flight.py).")
 default_registry.describe(
+    "region_batches_total",
+    "Hierarchical write fan-in: region batches issued by the "
+    "per-region intent aggregators (topology/aggregator.py), per "
+    "destination region — one cross-region call carrying many "
+    "containers' mutations, the compose shape flat fan-in pays per "
+    "container.")
+default_registry.describe(
+    "cross_region_mutations_total",
+    "Mutation calls that crossed a region boundary, by (src, dst) "
+    "pair — the traffic the topology layer exists to collapse "
+    "(counted at the wire by the fake cloud's region model; "
+    "hierarchical aggregation turns N per-container crossings into "
+    "one per region).")
+default_registry.describe(
+    "region_digest_exchanges_total",
+    "Per-region digest exchanges by the sweep tier's digest gate "
+    "(topology/digest.py): one gateway read per region per resync "
+    "wave answering every sweep-due key in a verified-stable region, "
+    "instead of N cross-region deep verifies.")
+default_registry.describe(
+    "shard_locality_score",
+    "Per-shard locality of the observed mutation traffic: the share "
+    "landing in the replica's LOCAL region (topology/model.py "
+    "mutation profiles; what locality-driven placement maximizes — "
+    "docs/operations.md placement-skew triage reads this).")
+default_registry.describe(
     "race_lockset_checks",
     "Lock acquisitions screened by the runtime lockset tracker "
     "(analysis/locks.py) — nonzero proves the detector was armed.")
@@ -657,6 +683,44 @@ def record_fleet_sweep(controller: str, verdict: str,
     reg = registry or default_registry
     reg.inc_counter("fleet_sweep_verdicts_total",
                     {"controller": controller, "verdict": verdict})
+
+
+def record_region_batch(region: str,
+                        registry: Optional[Registry] = None) -> None:
+    """One hierarchical region batch issued (topology/aggregator.py):
+    a whole cohort of container mutations crossed to ``region`` as ONE
+    wire call."""
+    reg = registry or default_registry
+    reg.inc_counter("region_batches_total", {"region": region})
+
+
+def record_cross_region_mutation(src: str, dst: str,
+                                 registry: Optional[Registry] = None
+                                 ) -> None:
+    """One mutation call crossed the ``src``→``dst`` region boundary
+    (the fake cloud's topology model counts these at the wire —
+    fake.FaultInjector; the fan-in bench's A/B evidence)."""
+    reg = registry or default_registry
+    reg.inc_counter("cross_region_mutations_total",
+                    {"src": src, "dst": dst})
+
+
+def record_region_digest_exchange(registry: Optional[Registry] = None
+                                  ) -> None:
+    """One per-region digest exchange by the sweep tier's gate
+    (topology/digest.py) — the read that answers a region's whole
+    sweep wave."""
+    reg = registry or default_registry
+    reg.inc_counter("region_digest_exchanges_total", {})
+
+
+def record_shard_locality(shard, value: float,
+                          registry: Optional[Registry] = None) -> None:
+    """Latest locality score of ``shard``'s observed mutation traffic
+    (share landing in the local region, topology/model.py)."""
+    reg = registry or default_registry
+    reg.set_gauge("shard_locality_score", {"shard": str(shard)},
+                  round(float(value), 4))
 
 
 def record_drift_repair(registry: Optional[Registry] = None) -> None:

@@ -46,6 +46,7 @@ Origins (per pending enqueue, event wins over sweep wins over resync):
 from __future__ import annotations
 
 import hashlib
+import logging
 import threading
 import weakref
 import zlib
@@ -57,6 +58,8 @@ from typing import Callable, Optional
 from .. import metrics
 from .interning import intern_str
 from ..analysis import locks
+
+logger = logging.getLogger(__name__)
 
 ORIGIN_EVENT = "event"
 ORIGIN_SWEEP = "sweep"
@@ -144,10 +147,24 @@ class FingerprintCache:
     def __init__(self, controller: str,
                  fingerprint_fn: Callable[[object], object],
                  config: Optional[FingerprintConfig] = None,
-                 skip_veto: Optional[Callable[[object], bool]] = None):
+                 skip_veto: Optional[Callable[[object], bool]] = None,
+                 sweep_gate: Optional[Callable[[str, int], bool]]
+                 = None):
         self.controller = controller
         self.config = config or FingerprintConfig()
         self._fn = fingerprint_fn
+        # sweep_gate(key, wave) -> True downgrades a sweep-due key to
+        # an ordinary resync delivery: its deep verify is already
+        # answered elsewhere — the multi-region digest exchange
+        # (topology/digest.py RegionDigestGate.allow_skip), one
+        # gateway read per region per wave instead of N cross-region
+        # verifying sweeps.  Fail-open: a gate error (or None, the
+        # default) leaves the sweep tier untouched.  Unlike the
+        # builder, the gate MAY reach the provider — it runs only for
+        # sweep-due keys, which were headed for a full provider
+        # verify anyway; the fast-path skip itself stays
+        # provider-free (L107).
+        self._sweep_gate = sweep_gate
         # skip_veto(obj) -> True forces the full sync path regardless
         # of a matching record: the safe-rollout interplay — a mid-ramp
         # object's convergence is DRIVEN by timed re-deliveries, and a
@@ -200,6 +217,15 @@ class FingerprintCache:
         every = self.config.sweep_every
         due = (every > 0
                and (zlib.crc32(key.encode()) % every) == (wave % every))
+        if due and self._sweep_gate is not None:
+            # outside the cache lock: the gate's digest exchange is a
+            # (once-per-region-per-wave) provider read
+            try:
+                if self._sweep_gate(key, wave):
+                    due = False
+            except Exception:
+                logger.debug("sweep gate failed for %r; sweeping",
+                             key, exc_info=True)
         origin = ORIGIN_SWEEP if due else ORIGIN_RESYNC
         with self._lock:
             have = self._origin.get(key)

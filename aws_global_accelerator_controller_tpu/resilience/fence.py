@@ -67,6 +67,23 @@ class FencedError(NoRetryError):
 # thread-scoped flush permit (see MutationFence.flush_pass)
 _pass_tls = threading.local()
 
+
+@contextmanager
+def flush_permit():
+    """The drain-window permit as a bare context manager: inside the
+    block, THIS thread's fence checks pass a TRIPPED (but not sealed)
+    fence.  The permit depth is module-global — one permit covers
+    every fence instance on the thread — which is what lets a layer
+    holding many callers' fences (the region aggregator,
+    topology/aggregator.py) check each under the same drain-window
+    semantics the coalescer's own :meth:`MutationFence.flush_pass`
+    grants."""
+    _pass_tls.depth = getattr(_pass_tls, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _pass_tls.depth -= 1
+
 # thread-scoped EXTRA write gates: fences pushed around a routed
 # dispatch (sharding/shardset.py ShardSet.guard) or a per-shard
 # coalescer flush, consulted by ResilientAPIs.invoke per attempt in
@@ -192,17 +209,14 @@ class MutationFence:
         metrics.record_fenced_mutation(surface)
         raise FencedError(reason or "fence tripped", token, sealed)
 
-    @contextmanager
     def flush_pass(self):
         """Thread-scoped permit for the drain window: a flush carrying
         already-accepted intents may pass a TRIPPED (but not sealed)
         fence, so every waiter that got in before the trip is answered
-        exactly once."""
-        _pass_tls.depth = getattr(_pass_tls, "depth", 0) + 1
-        try:
-            yield
-        finally:
-            _pass_tls.depth -= 1
+        exactly once.  (The permit itself is the module-level
+        :func:`flush_permit` — depth is shared across fence instances
+        on the thread.)"""
+        return flush_permit()
 
 
 class CompositeFence:

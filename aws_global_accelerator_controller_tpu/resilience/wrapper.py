@@ -83,15 +83,26 @@ ROUTE53_METHODS = frozenset({
     "change_resource_record_sets_batch",
 })
 
+# The regional aggregation point (topology/aggregator.py): one wrapped
+# call per region batch, so a whole region's cohort shares one
+# retry/breaker/bucket verdict — and each REGION'S wrapper carries its
+# own breaker, the per-region independence the partition chaos e2e
+# asserts.  The digest read is the sweep tier's one-exchange-per-wave.
+GATEWAY_METHODS = frozenset({"apply_region_batch", "get_region_digest"})
+
 # Every method that mutates cloud state — the lifecycle fence
 # (resilience/fence.py) is consulted for these before each attempt, so
 # a stopping or deposed-leader process cannot land a queued mutation
 # concurrently with its successor's writes (lint rule L108 keeps this
 # gate in place).  Reads stay unfenced: a draining process may still
-# observe the world.
+# observe the world.  ``apply_region_batch`` is fenced too — and the
+# aggregator pushes every contribution's shard fence into the
+# per-attempt write TLS, so a seal landing mid-retry rejects exactly
+# the sealed shard's share on the next attempt.
 MUTATION_METHODS = UNCOALESCED_MUTATIONS | frozenset({
     "update_endpoint_group", "add_endpoints", "remove_endpoints",
     "change_resource_record_sets", "change_resource_record_sets_batch",
+    "apply_region_batch",
 })
 
 
@@ -208,6 +219,11 @@ class ResilientAPIs:
         self.ga = _ResilientService(inner.ga, GA_METHODS, self)
         self.route53 = _ResilientService(inner.route53, ROUTE53_METHODS,
                                          self)
+        # the optional regional aggregation point (api.RegionGatewayAPI)
+        # rides the same policy engine; bundles without one stay flat
+        gateway = getattr(inner, "gateway", None)
+        self.gateway = (_ResilientService(gateway, GATEWAY_METHODS, self)
+                        if gateway is not None else None)
         metrics.watch_circuit_state(region, self.breaker.state_value,
                                     registry=registry)
         metrics.watch_throttle_tokens(region, self.bucket.level,

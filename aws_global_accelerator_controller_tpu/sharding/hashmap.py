@@ -21,11 +21,24 @@ Two independent mappings compose into "which replica owns this key":
 Both are deterministic across processes — the chaos/e2e suites and the
 multi-process shard-scaling bench rely on replicas agreeing on the map
 without ever talking to each other about it.
+
+Topology-weighted placement (ISSUE 14): ``rendezvous_owner`` takes an
+optional ``weights(shard_id, member)`` scoring term — WEIGHTED
+highest-random-weight hashing (the -w/ln(u) construction), so a member
+whose home region is near the regions a shard's keys mutate wins more
+hash mass ("reorder ranks so traffic stays inside cheap domains",
+Cloud Collectives via PAPERS.md; topology/placement.py computes the
+weights from observed mutation profiles).  ``weights=None`` is the
+EXACT pre-topology integer-compare path, byte-identical — the
+contract tests/test_topology.py pins.  ``compute_assignment`` bounds
+voluntary (affinity-driven) rebalance churn against a previous map;
+moves forced by membership change are never capped.
 """
 from __future__ import annotations
 
+import math
 import zlib
-from typing import Dict, Sequence
+from typing import Callable, Dict, Optional, Sequence
 
 
 def shard_of(key: str, num_shards: int) -> int:
@@ -35,24 +48,83 @@ def shard_of(key: str, num_shards: int) -> int:
     return zlib.crc32(key.encode()) % num_shards
 
 
-def rendezvous_owner(shard_id: int,
-                     members: Sequence[str]) -> "str | None":
+def rendezvous_owner(shard_id: int, members: Sequence[str],
+                     weights: Optional[Callable[[int, str], float]]
+                     = None) -> "str | None":
     """The member that owns ``shard_id`` under highest-random-weight
     hashing, or None when the member set is empty.  Ties (crc32
-    collisions) break by identity so every replica agrees."""
+    collisions) break by identity so every replica agrees.
+
+    With ``weights``, the hash draw u = crc32/2^32 is stretched to
+    score = -w / ln(u): monotone in both u and w, so the unweighted
+    ordering is preserved at equal weights while a 2x weight wins ~2x
+    the shards — and a weight change moves ONLY the shards whose max
+    flips (the rendezvous minimal-disruption property survives
+    weighting)."""
+    if weights is None:
+        best = None
+        best_weight = -1
+        for member in members:
+            weight = zlib.crc32(f"{member}\x00{shard_id}".encode())
+            if weight > best_weight or (weight == best_weight
+                                        and (best is None
+                                             or member < best)):
+                best = member
+                best_weight = weight
+        return best
     best = None
-    best_weight = -1
+    best_score = None
     for member in members:
-        weight = zlib.crc32(f"{member}\x00{shard_id}".encode())
-        if weight > best_weight or (weight == best_weight
-                                    and (best is None or member < best)):
+        draw = zlib.crc32(f"{member}\x00{shard_id}".encode())
+        # (draw + 0.5) / 2^32 is in (0, 1): ln never sees 0 or 1
+        u = (draw + 0.5) / 2**32
+        w = max(float(weights(shard_id, member)), 1e-9)
+        score = -w / math.log(u)
+        if best is None or score > best_score \
+                or (score == best_score and member < best):
             best = member
-            best_weight = weight
+            best_score = score
     return best
 
 
-def compute_assignment(num_shards: int,
-                       members: Sequence[str]) -> Dict[int, "str | None"]:
+def compute_assignment(num_shards: int, members: Sequence[str],
+                       weights: Optional[Callable[[int, str], float]]
+                       = None,
+                       prev: Optional[Dict[int, "str | None"]] = None,
+                       max_moves: Optional[int] = None,
+                       gain: Optional[Callable[[int, str], float]]
+                       = None) -> Dict[int, "str | None"]:
     """shard id → owning member for the whole map (the rebalance
-    target the shard-lease manager converges toward)."""
-    return {s: rendezvous_owner(s, members) for s in range(num_shards)}
+    target the shard-lease manager converges toward).
+
+    ``prev`` + ``max_moves`` bound VOLUNTARY churn: a shard whose
+    previous owner is still a live member only moves when it is among
+    the ``max_moves`` highest-gain moves this pass (``gain(shard,
+    member)`` scores the improvement; the affinity delta by default) —
+    a learned-profile shift migrates the fleet incrementally instead
+    of in one wave.  Shards whose previous owner left the member set
+    always move (that is failure recovery, not tuning)."""
+    want = {s: rendezvous_owner(s, members, weights)
+            for s in range(num_shards)}
+    if prev is None or max_moves is None:
+        return want
+    live = set(members)
+    voluntary = [s for s, owner in want.items()
+                 if prev.get(s) is not None and prev[s] != owner
+                 and prev[s] in live]
+    if len(voluntary) <= max_moves:
+        return want
+    score = gain if gain is not None else (
+        weights if weights is not None else (lambda s, m: 0.0))
+
+    def move_gain(s: int) -> float:
+        new_owner = want[s]
+        old_owner = prev[s]
+        if new_owner is None:
+            return 0.0
+        return score(s, new_owner) - score(s, old_owner)
+
+    voluntary.sort(key=lambda s: (-move_gain(s), s))
+    for s in voluntary[max_moves:]:
+        want[s] = prev[s]
+    return want

@@ -1,0 +1,31 @@
+"""Topology-aware multi-region control plane (ISSUE 14; ROADMAP
+item 4): region as a first-class locality domain.
+
+- :mod:`.model` — the region set, latency/bandwidth matrix,
+  partition/heal chaos hooks, container/key bindings and mutation
+  profiles (one :class:`RegionTopology` per deployment).
+- :mod:`.aggregator` — hierarchical write fan-in: per-region intent
+  aggregators between the sharded coalescer and the wire (one batch
+  per region instead of one per container).
+- :mod:`.digest` — digest-based cross-region reads: the sweep tier's
+  per-region fingerprint-rollup exchange.
+- :mod:`.placement` — locality-driven shard placement: topology-
+  weighted rendezvous rank reordering with bounded churn.
+
+Flat fan-in remains the default: nothing here activates until a
+factory is built with a topology (``--regions``).
+"""
+from .aggregator import RegionAggregator
+from .digest import RegionDigestGate, rollup_digest
+from .model import RegionTopology, parse_regions
+from .placement import LocalityPlacement, static_member_regions
+
+__all__ = [
+    "LocalityPlacement",
+    "RegionAggregator",
+    "RegionDigestGate",
+    "RegionTopology",
+    "parse_regions",
+    "rollup_digest",
+    "static_member_regions",
+]

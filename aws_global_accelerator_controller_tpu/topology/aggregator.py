@@ -1,0 +1,363 @@
+"""Per-region intent aggregators: hierarchical write fan-in
+(ISSUE 14's tentpole; the HiCCL compose shape applied to the write
+path).
+
+The write pipeline so far ends at the :class:`~..cloudprovider.aws
+.batcher.MutationCoalescer`: intents fold per CONTAINER (one hosted
+zone, one endpoint group) and each drained cohort issues one wire call
+for its container.  That is the right shape inside a region — but a
+fleet-wide storm touches many containers across many regions, and
+per-container calls each pay the full cross-region latency: S shard
+cohorts x C containers of flat fan-in across the expensive domain.
+
+This module adds the second aggregation level: between the coalescer
+and the wire sits one aggregator group PER REGION.  A cohort flush
+hands its container batch here (the ShardedCoalescer→aggregator
+handoff, lint rule L116) instead of calling the service directly; the
+aggregator lingers briefly, collects every contribution bound for the
+same region — across containers AND across shard cohorts — and issues
+ONE ``apply_region_batch`` per region (the regional gateway fans out
+locally at intra-region cost).  A fleet-wide change becomes one
+cross-region message per region instead of one per container.
+
+Contracts preserved end to end:
+
+- **PR-4 fold/bisect/error demux.**  Folding already happened above
+  (per container, in the cohort).  The region batch is NOT atomic
+  across containers: the gateway applies each container entry
+  atomically and reports per-entry verdicts, so one poisoned zone
+  batch fails alone — its cohort's flush receives exactly that entry's
+  error and runs its own bisect by resubmitting halves through this
+  same handoff.  A region-level failure (partition, retry budget, open
+  circuit — the wrapped call's verdict) fails every contribution with
+  the same hint and every cohort parks, the PR-4 cohort-level demux
+  one level up.
+- **PR-8 fence/ownership.**  Every contribution carries its cohort's
+  :class:`~..resilience.fence.CompositeFence` (process + owning
+  shard).  The flush pushes those fences into the wrapper's
+  per-attempt write-fence TLS and re-checks each contribution per
+  attempt under the drain permit: a TRIPPED fence (ordered shutdown /
+  handoff drain) still flushes, a SEALED shard's contribution is
+  rejected with :class:`FencedError` — per attempt, never silently
+  dropped — while its region-mates fly.  A seal landing mid-retry
+  surfaces as FencedError out of the wrapped call; the flush
+  re-partitions the cohort and re-issues with the survivors.
+- **PR-12 tracing.**  The region flush span joins the first
+  contribution's trace and LINKS the rest (the coalescer flush-span
+  shape one level down), and stamps a ``region`` mark into every
+  member context.
+
+The aggregator is also where the placement's mutation profile is fed:
+every contribution notes (shard, region) into the topology
+(topology/model.py ``note_mutation``), the observed-traffic counts
+locality placement reorders ranks by.
+"""
+from __future__ import annotations
+
+import logging
+from contextlib import ExitStack
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..analysis import locks
+from ..metrics import record_region_batch
+from ..resilience import ErrorClass, FencedError, classify
+from ..resilience.fence import flush_permit, push_write_fence
+from ..simulation import clock as simclock
+from ..tracing import default_tracer
+
+logger = logging.getLogger(__name__)
+
+# in-flush retries of a PER-ENTRY retryable verdict (a transient fault
+# inside the gateway's local fan-out): flat fan-in absorbed these in
+# the wrapper's per-call retry policy, so the aggregated path must
+# absorb them too — a transient entry blip must never surface to the
+# coalescer's demux as a terminal rejection (which would bisect a
+# healthy batch or park a whole cohort)
+ENTRY_RETRY_LIMIT = 4
+
+ENTRY_RECORD_SETS = "record_sets"
+ENTRY_ENDPOINT_GROUP = "endpoint_group"
+
+# bound on one region batch (far above any real cohort wave; the
+# gateway applies entries serially, so an unbounded batch could hold
+# the region flush for an unbounded intra-region span)
+MAX_REGION_BATCH = 4096
+
+
+class _Contribution:
+    """One cohort flush's handoff: a container batch bound for one
+    region, completed (or failed) exactly once by the region flush
+    that carried — or rejected — it."""
+
+    __slots__ = ("kind", "key", "payload", "fence", "ctxs", "shard_id",
+                 "event", "exc")
+
+    def __init__(self, kind, key, payload, fence, ctxs, shard_id):
+        self.kind = kind
+        self.key = key
+        self.payload = payload
+        self.fence = fence
+        self.ctxs = tuple(ctxs or ())
+        self.shard_id = shard_id
+        self.event = simclock.make_event()
+        self.exc: Optional[BaseException] = None
+
+    def complete(self) -> None:
+        self.event.set()
+
+    def fail(self, exc: BaseException) -> None:
+        self.exc = exc
+        self.event.set()
+
+
+class _RegionGroup:
+    """One region's aggregation queue (persistent: the group count is
+    the region count, never container churn)."""
+
+    __slots__ = ("region", "cond", "pending", "leader", "flushing")
+
+    def __init__(self, region: str):
+        self.region = region
+        self.cond = simclock.make_condition(
+            locks.make_lock(f"region-aggregator[{region}]"))
+        self.pending: List[_Contribution] = []
+        self.leader = False
+        self.flushing = False
+
+
+# bound on the wait-for-previous-flush poll, the coalescer's constant
+FLUSH_SERIALIZE_POLL = 0.05
+
+
+class RegionAggregator:
+    """The per-region fan-in layer (module docstring).  ``apis_for``
+    resolves a region to its RESILIENT bundle (the factory's
+    ``provider_for(region).apis``), so every region's wire call rides
+    its OWN retry/breaker/token-bucket stack — a partitioned region
+    opens its own circuit without tripping its siblings'."""
+
+    def __init__(self, apis_for: Callable[[str], object], topology,
+                 linger: float = 0.002,
+                 clock: Callable[[], float] = simclock.monotonic):
+        self._apis_for = apis_for
+        self._topology = topology
+        self._linger = linger
+        self._clock = clock
+        self._lock = locks.make_lock("region-aggregator-groups")
+        self._groups: Dict[str, _RegionGroup] = {}
+
+    # -- the handoff surface (what batcher._wire_* calls) ---------------
+
+    def submit_record_sets(self, hosted_zone_id: str, changes,
+                           fence=None, ctxs=(), shard_id=None) -> None:
+        """One cohort's drained zone batch; blocks until the region
+        flush carrying it lands (or rejects it) and raises that
+        verdict — the coalescer's flush demuxes it exactly as it would
+        a direct wire call's."""
+        region = self._topology.region_of(hosted_zone_id)
+        self._submit(region, _Contribution(
+            ENTRY_RECORD_SETS, hosted_zone_id, list(changes), fence,
+            ctxs, shard_id))
+
+    def submit_endpoint_group(self, endpoint_group_arn: str, configs,
+                              fence=None, ctxs=(),
+                              shard_id=None) -> None:
+        """One cohort's merged endpoint-group replacement set."""
+        region = self._topology.region_of(endpoint_group_arn)
+        self._submit(region, _Contribution(
+            ENTRY_ENDPOINT_GROUP, endpoint_group_arn, list(configs),
+            fence, ctxs, shard_id))
+
+    # -- internals ------------------------------------------------------
+
+    def _group(self, region: str) -> _RegionGroup:
+        with self._lock:
+            group = self._groups.get(region)
+            if group is None:
+                group = self._groups[region] = _RegionGroup(region)
+            return group
+
+    def _submit(self, region: str, c: _Contribution) -> None:
+        self._topology.note_mutation(c.shard_id, region,
+                                     max(1, len(c.payload)))
+        group = self._group(region)
+        with group.cond:
+            group.pending.append(c)
+            lead = not group.leader
+            if lead:
+                group.leader = True
+            elif len(group.pending) >= MAX_REGION_BATCH:
+                group.cond.notify_all()
+        if lead:
+            self._lead(group)
+        c.event.wait()
+        if c.exc is not None:
+            raise c.exc
+
+    def _lead(self, group: _RegionGroup) -> None:
+        """Linger-drain-flush, the coalescer's leader pipeline one
+        level up: the first contributor into an idle region group
+        lingers for cohort-mates (other containers, other shards),
+        hands leadership to the next epoch, and flushes outside every
+        lock.  A tripped fence among the pending contributions cuts
+        the linger short — the ordered-stop/handoff drain must not
+        wait out a batching deadline no new work can fill."""
+        with group.cond:
+            deadline = self._clock() + self._linger
+            while len(group.pending) < MAX_REGION_BATCH:
+                if any(c.fence is not None and c.fence.is_tripped()
+                       for c in group.pending):
+                    break
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    break
+                group.cond.wait(remaining)
+            while group.flushing:
+                group.cond.wait(FLUSH_SERIALIZE_POLL)
+            contributions = list(group.pending)
+            del group.pending[:]
+            group.leader = False
+            group.flushing = True
+        try:
+            self._flush(group.region, contributions)
+        except BaseException as e:  # belt: _flush answers its own
+            for c in contributions:
+                if not c.event.is_set():
+                    c.fail(e)
+            raise
+        finally:
+            with group.cond:
+                group.flushing = False
+                group.cond.notify_all()
+
+    def _check_fences(self, contributions: List[_Contribution]
+                      ) -> Tuple[List[_Contribution], int]:
+        """Partition the cohort by fence liveness under the drain
+        permit: a TRIPPED fence's already-accepted contribution still
+        flushes, a SEALED one is rejected NOW (its waiter gets the
+        FencedError; never silently dropped).  Returns the live set
+        and how many were rejected."""
+        live: List[_Contribution] = []
+        rejected = 0
+        for c in contributions:
+            if c.fence is not None:
+                try:
+                    with flush_permit():
+                        c.fence.check("aggregator")
+                except FencedError as fe:
+                    c.fail(fe)
+                    rejected += 1
+                    continue
+            live.append(c)
+        return live, rejected
+
+    def _flush(self, region: str, contributions: List[_Contribution]
+               ) -> None:
+        if not contributions:
+            return
+        ctxs = []
+        seen = set()
+        for c in contributions:
+            for ctx in c.ctxs:
+                if id(ctx) not in seen:
+                    seen.add(id(ctx))
+                    ctxs.append(ctx)
+        with default_tracer.attach(ctxs[0] if ctxs else None), \
+                default_tracer.span("region_flush", region=region,
+                                    cohort=len(contributions)) as fs:
+            fs.links = tuple(sorted({c.trace_id for c in ctxs}))
+            pending = contributions
+            fence_err: Optional[FencedError] = None
+            attempts: Dict[int, int] = {}
+            while pending:
+                live, rejected = self._check_fences(pending)
+                if not live:
+                    return
+                if fence_err is not None and rejected == 0:
+                    # the wrapper rejected the attempt but no
+                    # CONTRIBUTION's fence did (the process fence
+                    # sealed under fence-less contributions):
+                    # re-issuing would loop — the wrapper's verdict is
+                    # every remaining waiter's answer
+                    for c in live:
+                        c.fail(fence_err)
+                    return
+                apis = self._apis_for(region)
+                gateway = getattr(apis, "gateway", None)
+                if gateway is None:
+                    # a backend with no regional gateway (the real
+                    # boto bundle): fall back to flat per-container
+                    # calls through the region's wrapper — correct,
+                    # just without the fan-in win
+                    self._flush_flat(apis, live)
+                    return
+                entries = [(c.kind, c.key, c.payload) for c in live]
+                try:
+                    with ExitStack() as stack:
+                        stack.enter_context(flush_permit())
+                        for c in live:
+                            stack.enter_context(
+                                push_write_fence(c.fence))
+                        results = gateway.apply_region_batch(region,
+                                                             entries)
+                except FencedError as fe:
+                    # a fence sealed mid-retry: the wrapper rejected
+                    # the ATTEMPT.  Re-partition — the sealed
+                    # contributions fail individually above, the
+                    # survivors re-issue (rejected per attempt, never
+                    # silently dropped)
+                    pending = live
+                    fence_err = fe
+                    continue
+                except Exception as e:
+                    # region-level verdict (partition, retry budget,
+                    # open circuit): every contribution's cohort
+                    # parks on the same hint — the PR-4 demux shape
+                    fs.error = f"{type(e).__name__}: {e}"
+                    for c in live:
+                        c.fail(e)
+                    return
+                record_region_batch(region)
+                # the wire call landed: any earlier FencedError was a
+                # fence that has since been rejected out — it must not
+                # terminally answer a LATER retry round's survivors
+                fence_err = None
+                for ctx in ctxs:
+                    ctx.mark(fs.span_id, "region")
+                retry: List[_Contribution] = []
+                for c, verdict in zip(live, results):
+                    if verdict is None:
+                        c.complete()
+                        continue
+                    # a retryable per-entry verdict (transient chaos
+                    # inside the local fan-out) is absorbed HERE, the
+                    # way the wrapper's retry policy absorbed it on
+                    # the flat path — bounded, then it becomes the
+                    # waiter's real answer
+                    attempts[id(c)] = attempts.get(id(c), 0) + 1
+                    if (classify(verdict) in (ErrorClass.THROTTLE,
+                                              ErrorClass.TRANSIENT)
+                            and attempts[id(c)] < ENTRY_RETRY_LIMIT):
+                        retry.append(c)
+                    else:
+                        c.fail(verdict)
+                if retry:
+                    simclock.sleep(self._linger)
+                    pending = retry
+                    continue
+                return
+
+    def _flush_flat(self, apis, live: List[_Contribution]) -> None:
+        """Per-container fallback when the region has no gateway."""
+        for c in live:
+            try:
+                with flush_permit(), push_write_fence(c.fence):
+                    if c.kind == ENTRY_RECORD_SETS:
+                        apis.route53.change_resource_record_sets_batch(
+                            c.key, c.payload)
+                    else:
+                        apis.ga.update_endpoint_group(c.key, c.payload)
+            except Exception as e:
+                c.fail(e)
+            else:
+                c.complete()

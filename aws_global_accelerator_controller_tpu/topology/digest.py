@@ -1,0 +1,210 @@
+"""Digest-based cross-region reads: the sweep tier's gossip layer
+(ISSUE 14).
+
+The tiered drift sweep (reconcile/fingerprint.py) deep-verifies every
+key once per sweep period — which, multi-region, means a steady-state
+fleet pays N cross-region verifying reads per period for regions that
+almost never drift.  This gate collapses that to ONE digest exchange
+per region per resync wave: the regional gateway serves a fingerprint
+rollup of its mutable container state (``get_region_digest`` — region-
+level rollups over the same canonical state the PR-5 per-key
+fingerprints digest), and a sweep-due key whose every bound region is
+digest-CLEAN is downgraded to an ordinary resync delivery (which the
+per-key fingerprint gate then answers in O(1)).
+
+"Clean" is earned, never assumed — the state machine per region:
+
+``WARMING``
+    Sweeps run normally.  The gate tracks the region's digest across
+    its own REFRESH sequence (one refresh per wave advance per
+    region; several informers share the gate with independent wave
+    counters, so stability is counted in the gate's refreshes, never
+    by comparing callers' wave numbers); once the digest has been
+    STABLE for a full sweep period (``stability_waves``, raised to at
+    least the consumers' ``sweep_every`` via ``note_sweep_period``) —
+    a window in which every key deep-verified at least once against
+    exactly that digested state — the digest is promoted to the
+    region's VERIFIED baseline.  (Stability alone is not enough: a
+    region that drifted BEFORE the gate first looked would show a
+    stable-but-wrong digest; requiring a full verified period under
+    that digest is what makes the baseline trustworthy.)
+``CLEAN``
+    One digest exchange per wave.  Matching the baseline answers every
+    sweep in the region; ANY mismatch — out-of-band drift, our own
+    writes landing, a failed exchange, a partitioned region — drops
+    the baseline and the region re-earns it through a fresh WARMING
+    period (during which the ordinary sweeps detect and repair
+    whatever changed).
+
+Keys with no region binding (single-region deployments, objects whose
+containers the provider has not yet resolved) always sweep — the safe
+default, and what keeps the no-topology path byte-identical.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Callable, Dict, Optional, Tuple
+
+from ..analysis import locks
+from ..metrics import record_region_digest_exchange
+
+logger = logging.getLogger(__name__)
+
+
+class _RegionState:
+    __slots__ = ("baseline", "candidate", "stable_refreshes")
+
+    def __init__(self):
+        self.baseline: Optional[str] = None    # verified digest (CLEAN)
+        self.candidate: Optional[str] = None   # stable digest warming up
+        # consecutive wave-advancing refreshes that returned candidate
+        self.stable_refreshes = 0
+
+
+def rollup_digest(parts) -> str:
+    """Canonical region rollup: sha1 over the sorted (container,
+    canonical state) pairs — the shared spelling the fake gateway and
+    any future real aggregation point must both use."""
+    h = hashlib.sha1()
+    for container, state in sorted(parts):
+        h.update(container.encode())
+        h.update(b"\x00")
+        h.update(state.encode() if isinstance(state, str) else state)
+        h.update(b"\x01")
+    return h.hexdigest()
+
+
+class RegionDigestGate:
+    """The sweep gate (reconcile/fingerprint.py ``sweep_gate=``):
+    ``allow_skip(key, wave)`` is True when every region bound to
+    ``key`` is CLEAN this wave, meaning the sweep's deep verify is
+    already answered by the digest exchange.  One gateway read per
+    region per wave, whatever the fleet size.
+
+    ``apis_for(region)`` resolves the REGION's wrapped bundle lazily
+    (the factory's ``provider_for(region).apis``) so construction
+    never races provider build — and so each region's exchange rides
+    its own breaker: a partitioned region's failing digest reads open
+    exactly that region's circuit, never a sibling's.  A bundle
+    without a gateway disables the gate (every key sweeps)."""
+
+    def __init__(self, apis_for: Callable[[str], object], topology,
+                 stability_waves: Optional[int] = None):
+        self._apis = apis_for
+        self._topology = topology
+        self._stability = (stability_waves
+                           if stability_waves is not None
+                           else topology.digest_stability_waves)
+        self._lock = locks.make_lock("region-digest-gate")
+        self._state: Dict[str, _RegionState] = {}
+        # region -> (highest wave seen, digest or None): one exchange
+        # per wave ADVANCE — the gate is shared by several informers
+        # with independent (same-period, loosely skewed) wave
+        # counters, so only a strictly higher wave refreshes; lagging
+        # counters ride the cached answer instead of thrashing it
+        self._wave_cache: Dict[str, Tuple[int, Optional[str]]] = {}
+
+    def note_sweep_period(self, sweep_every: int) -> None:
+        """A consumer declares its sweep period: CLEAN must be earned
+        over at least that many waves, or keys in the residues that
+        never deep-verified during the warming window could have
+        pre-existing drift baked into the promoted baseline."""
+        if sweep_every > 0:
+            with self._lock:
+                self._stability = max(self._stability, sweep_every)
+
+    # -- the gate surface ----------------------------------------------
+
+    def allow_skip(self, key: str, wave: int) -> bool:
+        if self._topology.key_digest_vetoed(key):
+            # part of the key's state lives in a container no region
+            # digest covers: its sweeps always run
+            return False
+        regions = self._topology.key_regions(key)
+        if not regions:
+            return False
+        return all(self._region_clean(region, wave)
+                   for region in regions)
+
+    # -- per-region machinery ------------------------------------------
+
+    def _exchange(self, region: str, wave: int
+                  ) -> "Tuple[Optional[str], bool]":
+        """(digest, refreshed): the region's digest this wave, and
+        whether THIS call advanced the refresh sequence (a strictly
+        higher wave than any seen for the region).  The first due key
+        of a wave pays the exchange; the rest — and any consumer
+        whose counter lags — ride the cached answer.  digest None =
+        exchange failed (partition, no gateway): never clean."""
+        with self._lock:
+            cached = self._wave_cache.get(region)
+            if cached is not None and wave <= cached[0]:
+                return cached[1], False
+        digest: Optional[str] = None
+        try:
+            apis = self._apis(region)
+            gateway = getattr(apis, "gateway", None)
+            if gateway is not None:
+                record_region_digest_exchange()
+                digest = gateway.get_region_digest(region)
+        except Exception as e:
+            logger.debug("region digest exchange failed for %s: %s",
+                         region, e)
+            digest = None
+        with self._lock:
+            cached = self._wave_cache.get(region)
+            if cached is not None and wave <= cached[0]:
+                # a concurrent caller won the refresh race
+                return cached[1], False
+            self._wave_cache[region] = (wave, digest)
+        return digest, True
+
+    def _region_clean(self, region: str, wave: int) -> bool:
+        digest, refreshed = self._exchange(region, wave)
+        with self._lock:
+            st = self._state.get(region)
+            if st is None:
+                st = self._state[region] = _RegionState()
+            if digest is None:
+                # a failed exchange proves nothing: drop everything
+                # and re-earn (the partitioned-region shape)
+                st.baseline = None
+                st.candidate = None
+                st.stable_refreshes = 0
+                return False
+            if st.baseline is not None:
+                if digest == st.baseline:
+                    return True
+                # drift (or our own writes): re-earn through WARMING
+                logger.info("region %s digest diverged from verified "
+                            "baseline; sweeps re-enabled", region)
+                st.baseline = None
+                st.candidate = digest
+                st.stable_refreshes = 0
+                return False
+            if digest != st.candidate:
+                st.candidate = digest
+                st.stable_refreshes = 0
+                return False
+            if refreshed:
+                # stability is counted in the gate's OWN refreshes —
+                # one per wave advance — never by comparing different
+                # consumers' wave counters
+                st.stable_refreshes += 1
+            # stable candidate: promoted once a full sweep period has
+            # deep-verified every key against exactly this digest
+            if st.stable_refreshes >= self._stability:
+                st.baseline = digest
+                logger.info("region %s digest verified stable over %d "
+                            "refreshes; sweeps now digest-answered",
+                            region, self._stability)
+                return True
+            return False
+
+    # -- observability ---------------------------------------------------
+
+    def clean_regions(self) -> "list[str]":
+        with self._lock:
+            return sorted(r for r, st in self._state.items()
+                          if st.baseline is not None)

@@ -1,0 +1,385 @@
+"""Region topology: the locality-domain model the multi-region control
+plane reasons about (ISSUE 14; ROADMAP item 4).
+
+Every earlier layer treated "the wire" as flat: a mutation call costs
+the same whether its container lives next door or across an ocean.
+The collectives literature (PAPERS.md: HiCCL's hierarchical compose,
+Cloud Collectives' rank reordering) says flat fan-in is the slow shape
+— the win comes from making the expensive domain boundary EXPLICIT and
+aggregating inside it.  This module is that boundary made explicit:
+
+- **Regions and the latency/bandwidth matrix.**  A deployment declares
+  its regions and the per-(src, dst) cost of crossing between them
+  (fast intra-region, slow and possibly asymmetric cross-region).  The
+  fake cloud charges these costs through ``simclock`` per call
+  (fake.FaultInjector), so the hierarchical-vs-flat win is MEASURED in
+  (virtual or real) seconds, never asserted.
+- **Partitions.**  ``partition_region``/``heal_region`` are the chaos
+  pair: while a region is partitioned, calls crossing INTO it fail
+  with a retryable ServiceUnavailable.  Partial partitions (``rate <
+  1``) draw from their own per-(seed, src→dst pair) decision stream —
+  crc32 of (seed, salt, pair, per-pair call index), the PR-3/PR-6
+  seeded-decision model — so the same seeded scenario replays
+  byte-identically (tests/chaos/test_chaos_determinism.py) and arming
+  one pair's chaos never perturbs a sibling's draws.
+- **Container/key bindings.**  The sim-side registry mapping AWS
+  containers (hosted zone ids, endpoint-group ARNs) and kube object
+  keys to their home regions.  The fake binds containers at creation
+  (an EG knows its region; a zone is created with one); the provider
+  binds kube keys as its ensure paths learn which regions an object's
+  containers live in.  Unbound names resolve to the local region —
+  zero extra cost, which is what keeps the no-topology path
+  byte-identical to the pre-topology tree.
+- **Mutation profiles.**  Per-shard, per-region mutation counts fed by
+  the write path (topology/aggregator.py) — the observed traffic the
+  locality placement (topology/placement.py) reorders shard→replica
+  ranks by, and the source of the ``shard_locality_score`` gauge.
+
+Knobs ``aggregate`` / ``digest_reads`` gate the two derived layers
+(hierarchical write fan-in, digest-based sweep reads) independently so
+benches can A/B each against the flat shape under the SAME latency
+matrix.  A ``RegionTopology`` is inert until a factory is built with
+it: no topology configured means no aggregator, no digest gate, no
+latency model — the documented default (``--regions`` opts in).
+"""
+from __future__ import annotations
+
+import threading
+import zlib
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .. import metrics
+
+# latency defaults: sub-millisecond inside a region, tens of
+# milliseconds across — the asymmetry real inter-region links show
+DEFAULT_INTRA_LATENCY = 0.0005
+DEFAULT_CROSS_LATENCY = 0.04
+
+
+class RegionTopology:
+    """The region set + cost matrix + chaos/binding/profile state (one
+    per deployment; the factory, the fake cloud and the placement all
+    share this object).  Thread-safe; every decision that could vary
+    between runs draws from a per-(seed, region-pair) stream."""
+
+    def __init__(self, regions: Sequence[str],
+                 local_region: Optional[str] = None,
+                 intra_latency: float = DEFAULT_INTRA_LATENCY,
+                 cross_latency: float = DEFAULT_CROSS_LATENCY,
+                 matrix: Optional[Dict[Tuple[str, str], float]] = None,
+                 bandwidth: float = 0.0,
+                 mutation_latency_factor: float = 1.0,
+                 jitter: float = 0.0,
+                 seed: Optional[int] = None,
+                 aggregate: bool = True,
+                 aggregate_linger: Optional[float] = None,
+                 digest_reads: bool = True,
+                 digest_stability_waves: int = 10):
+        if not regions:
+            raise ValueError("a RegionTopology needs at least one region")
+        self.regions: Tuple[str, ...] = tuple(regions)
+        self.local_region = local_region or self.regions[0]
+        if self.local_region not in self.regions:
+            raise ValueError(
+                f"local region {self.local_region!r} not in {self.regions}")
+        self.intra_latency = intra_latency
+        self.cross_latency = cross_latency
+        # (src, dst) -> seconds overrides: the asymmetric matrix
+        self._matrix = dict(matrix or {})
+        # payload term: extra seconds PER UNIT (a record change, an
+        # endpoint config) crossing regions — the beta of the alpha +
+        # beta*n cost model collectives use; 0 disables
+        self.bandwidth = bandwidth
+        # cross-region MUTATIONS cost this multiple of the pair's read
+        # latency: a control-plane write crosses the service's commit/
+        # consensus path while reads are served from (edge) replicas —
+        # the real Route53/GA shape, and the asymmetry hierarchical
+        # fan-in amortizes (one commit round-trip per region batch)
+        self.mutation_latency_factor = mutation_latency_factor
+        # +/- fractional latency jitter, drawn per (seed, pair, index)
+        self.jitter = jitter
+        # cross-region MUTATIONS serialize per (src, dst) pair (the
+        # alpha-cost model collectives reason with): a region's writes
+        # funnel through its commit path one at a time — each occupies
+        # the channel for its latency, so flat fan-in pays N
+        # serialized crossings where one region batch pays one.
+        # Modeled as a virtual queueing clock per pair (no lock is
+        # held while sleeping).  READS are unserialized: they hit
+        # replicated/anycast endpoints (the real DNS/GA shape), and
+        # intra-region traffic rides the local fabric.
+        self.link_serialization = True
+        self._channel_free: Dict[Tuple[str, str], float] = {}
+        self.seed = seed
+        self.aggregate = aggregate
+        # how long a region aggregator's leader lingers for cohort
+        # mates: one cross-region latency by default — every extra
+        # entry captured saves at least one full crossing, so a
+        # one-crossing wait always amortizes on a storm and costs one
+        # RTT-equivalent when alone (the urgent path stays the
+        # coalescer's, one level up)
+        self.aggregate_linger = (aggregate_linger
+                                 if aggregate_linger is not None
+                                 else cross_latency)
+        self.digest_reads = digest_reads
+        self.digest_stability_waves = digest_stability_waves
+        self._lock = threading.Lock()
+        # region -> failure rate while partitioned (absent = healthy)
+        self._partitioned: Dict[str, float] = {}
+        # per-(salt, src, dst) draw indexes: each fault source on each
+        # pair owns its stream, the determinism contract
+        self._draws: Dict[Tuple[str, str, str], int] = {}
+        # container name (zone id / EG arn) -> region
+        self._containers: Dict[str, str] = {}
+        # kube object key -> regions its containers live in
+        self._key_regions: Dict[str, Set[str]] = {}
+        # keys with a container NO region digest covers (unbound zone,
+        # out-of-topology region): their sweeps always run
+        self._digest_veto: Set[str] = set()
+        # (shard id, region) -> observed mutation count (placement feed)
+        self._mutations: Dict[Tuple[int, str], int] = {}
+        # bounded, ordered log of partition-injected failures — frozen
+        # by the flight recorder next to the AWS/kube chaos logs, and
+        # the determinism test's third decision stream
+        self._decisions: deque = deque(maxlen=4096)
+
+    # -- cost model -----------------------------------------------------
+
+    def latency(self, src: Optional[str], dst: Optional[str],
+                units: int = 1, mutation: bool = False) -> float:
+        """Seconds one call from ``src`` to ``dst`` carrying ``units``
+        payload items costs (``mutation`` applies the write-commit
+        factor).  Unknown/unbound regions are local: no topology
+        opinion means no added cost."""
+        src = src or self.local_region
+        dst = dst or self.local_region
+        if src == dst or src not in self.regions \
+                or dst not in self.regions:
+            base = self.intra_latency
+        else:
+            base = self._matrix.get((src, dst), self.cross_latency)
+            if mutation:
+                base *= self.mutation_latency_factor
+            if self.bandwidth > 0.0:
+                base += max(0, units - 1) * self.bandwidth
+        if self.jitter > 0.0 and self.seed is not None and src != dst:
+            draw = self._draw("latency", src, dst)
+            base *= 1.0 + self.jitter * (2.0 * draw - 1.0)
+        return base
+
+    def channel_latency(self, src: Optional[str], dst: Optional[str],
+                        units: int = 1, mutation: bool = False,
+                        now: float = 0.0) -> float:
+        """Seconds the CALLER must wait for one call: the pair's
+        latency plus — for MUTATIONS — any queueing behind earlier
+        writes still occupying the pair's serial commit channel
+        (``link_serialization``).  The channel is a FIFO server: this
+        call is scheduled at ``max(now, channel_free)`` and holds the
+        channel for its latency; the return value is completion-time
+        minus ``now``.  Reads and intra-region calls pay the plain
+        latency."""
+        src = src or self.local_region
+        dst = dst or self.local_region
+        base = self.latency(src, dst, units=units, mutation=mutation)
+        if (src == dst or not mutation
+                or not self.link_serialization
+                or src not in self.regions
+                or dst not in self.regions):
+            return base
+        with self._lock:
+            free = self._channel_free.get((src, dst), 0.0)
+            start = max(now, free)
+            self._channel_free[(src, dst)] = start + base
+        return start + base - now
+
+    def proximity(self, a: str, b: str) -> float:
+        """Closeness of two regions in (0, 1]: 1 inside one region,
+        falling with the pair's BASE latency — the placement's rank-
+        reordering affinity term.  Deliberately un-jittered: a scoring
+        pass must neither wobble the map nor consume the latency
+        streams the wire's seeded draws replay from."""
+        if a == b:
+            return 1.0
+        if a not in self.regions or b not in self.regions:
+            return 1.0
+        lat = self._matrix.get((a, b), self.cross_latency)
+        if lat <= 0.0:
+            return 1.0
+        return min(1.0, max(self.intra_latency, 1e-6) / lat)
+
+    def _draw(self, salt: str, src: str, dst: str) -> float:
+        """One [0, 1) draw from the (salt, src→dst) stream — its OWN
+        per-pair index, so concurrent fault sources and pairs never
+        share (and never perturb) each other's sequences."""
+        with self._lock:
+            key = (salt, src, dst)
+            index = self._draws.get(key, 0)
+            self._draws[key] = index + 1
+        return zlib.crc32(
+            f"{self.seed}:{salt}:{src}>{dst}:{index}".encode()) / 2**32
+
+    # -- partitions (the chaos pair) ------------------------------------
+
+    def partition_region(self, region: str, rate: float = 1.0) -> None:
+        """Cut ``region`` off: calls crossing INTO it fail (retryable)
+        at ``rate`` — partial rates draw from the pair's own seeded
+        stream.  Intra-region traffic (the regional gateway fanning
+        out locally) is unaffected: a partition severs LINKS, not the
+        region's own control plane."""
+        if region not in self.regions:
+            raise ValueError(f"unknown region {region!r}")
+        with self._lock:
+            self._partitioned[region] = rate
+
+    def heal_region(self, region: str) -> None:
+        with self._lock:
+            self._partitioned.pop(region, None)
+
+    def partitioned_regions(self) -> "Set[str]":
+        with self._lock:
+            return set(self._partitioned)
+
+    def partition_decision(self, src: Optional[str],
+                           dst: Optional[str], method: str,
+                           now: float) -> bool:
+        """Should this ``src``→``dst`` call fail under the current
+        partition set?  Logged (bounded) when it does — the decision
+        stream the determinism proof replays."""
+        src = src or self.local_region
+        dst = dst or self.local_region
+        if src == dst:
+            return False
+        with self._lock:
+            rate = self._partitioned.get(dst)
+        if rate is None:
+            return False
+        if rate < 1.0:
+            if self.seed is None:
+                import random
+                hit = random.random() < rate
+            else:
+                hit = self._draw("partition", src, dst) < rate
+            if not hit:
+                return False
+        with self._lock:
+            self._decisions.append({
+                "t": round(now, 6), "src": src, "dst": dst,
+                "method": method, "source": "partition"})
+        return True
+
+    def decision_log(self) -> List[dict]:
+        with self._lock:
+            return list(self._decisions)
+
+    # -- container / key bindings ---------------------------------------
+
+    def bind(self, container: str, region: str) -> None:
+        """Record ``container`` (zone id / EG arn) as homed in
+        ``region`` (idempotent; unknown regions are ignored so a fake
+        seeded with out-of-topology regions stays cost-free)."""
+        if region not in self.regions:
+            return
+        with self._lock:
+            self._containers[container] = region
+
+    def region_of(self, container: str) -> str:
+        """Home region of a container; unbound -> local (cost-free)."""
+        with self._lock:
+            return self._containers.get(container, self.local_region)
+
+    def bound_region(self, container: str) -> Optional[str]:
+        """Like :meth:`region_of` but None for an unbound container —
+        callers that must not confuse "lives locally" with "nothing
+        known" (the digest gate's key bindings) use this spelling."""
+        with self._lock:
+            return self._containers.get(container)
+
+    def containers_in(self, region: str) -> List[str]:
+        with self._lock:
+            return sorted(c for c, r in self._containers.items()
+                          if r == region)
+
+    def bind_key(self, key: str, region: "Optional[str]") -> None:
+        """Accumulate ``region`` into the kube object ``key``'s
+        region set (an object may span regions: its zone in one, its
+        endpoint group in another) — the digest gate requires EVERY
+        bound region clean before a sweep may be answered by digests.
+
+        ``region`` None or outside the topology VETOES the key's
+        digest answers instead (sticky): part of the object's state
+        lives in a container no region digest covers, so its sweeps
+        must always run — a binding from one controller's container
+        must never mask another's uncovered one."""
+        with self._lock:
+            if region is None or region not in self.regions:
+                self._digest_veto.add(key)
+            else:
+                self._key_regions.setdefault(key, set()).add(region)
+
+    def key_regions(self, key: str) -> "Set[str]":
+        with self._lock:
+            return set(self._key_regions.get(key, ()))
+
+    def key_digest_vetoed(self, key: str) -> bool:
+        """True when some container of ``key`` is outside every
+        region digest's coverage — the gate never skips its sweeps."""
+        with self._lock:
+            return key in self._digest_veto
+
+    # -- mutation profiles (the placement feed) -------------------------
+
+    def note_mutation(self, shard_id: Optional[int], region: str,
+                      n: int = 1) -> None:
+        """``n`` mutations for ``shard_id``'s containers landed in
+        ``region`` — the observed-traffic profile locality placement
+        reorders ranks by.  Also refreshes the shard's locality gauge
+        (share of its traffic staying in the LOCAL region)."""
+        if shard_id is None or region not in self.regions:
+            return
+        with self._lock:
+            self._mutations[(shard_id, region)] = \
+                self._mutations.get((shard_id, region), 0) + n
+            total = 0
+            local = 0
+            for (sid, reg), count in self._mutations.items():
+                if sid == shard_id:
+                    total += count
+                    if reg == self.local_region:
+                        local += count
+        if total:
+            metrics.record_shard_locality(shard_id, local / total)
+
+    def mutation_profile(self, shard_id: int) -> Dict[str, int]:
+        with self._lock:
+            return {region: count
+                    for (sid, region), count in self._mutations.items()
+                    if sid == shard_id}
+
+    def seed_profile(self, profiles: Dict[int, Dict[str, int]]) -> None:
+        """Install learned profiles wholesale (ledger replay at
+        startup, tests) instead of accumulating via note_mutation."""
+        with self._lock:
+            self._mutations.clear()
+            for sid, counts in profiles.items():
+                for region, count in counts.items():
+                    self._mutations[(sid, region)] = count
+
+
+def parse_regions(spec: str,
+                  local_region: Optional[str] = None,
+                  seed: Optional[int] = None) -> Optional[RegionTopology]:
+    """CLI helper: ``--regions us-west-2,eu-west-1`` -> a topology
+    with default costs (empty spec -> None: the flat default)."""
+    names = [r.strip() for r in (spec or "").split(",") if r.strip()]
+    if not names:
+        return None
+    return RegionTopology(names, local_region=local_region, seed=seed)
+
+
+def iter_region_pairs(regions: Iterable[str]):
+    """Every ordered (src, dst) cross-region pair."""
+    rs = list(regions)
+    for src in rs:
+        for dst in rs:
+            if src != dst:
+                yield src, dst

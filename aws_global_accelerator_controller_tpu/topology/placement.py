@@ -1,0 +1,104 @@
+"""Locality-driven shard placement: rank reordering over the region
+topology (ISSUE 14; Cloud Collectives' "reorder ranks so traffic stays
+inside cheap domains", PAPERS.md).
+
+The rendezvous map (sharding/hashmap.py) places shards on replicas by
+pure hash — blind to WHERE a shard's traffic actually goes.  With a
+topology configured, each shard accumulates an observed mutation
+profile (per-region counts fed by the aggregator,
+topology/model.py ``note_mutation``), and this module turns that
+profile into a per-(shard, member) weight for WEIGHTED rendezvous
+hashing: a member whose home region is near the regions a shard's
+keys mutate scores higher, so the shard's writes stay inside the
+cheap domain.
+
+Safety and stability:
+
+- The weight only BIASES the hash — ownership is still decided by the
+  shard leases (leaderelection/shards.py), so a replica acting on a
+  stale or divergent profile can never create two writers.  Profiles
+  are learned locally per replica (no gossip in this PR — documented
+  in ARCHITECTURE.md); the churn bound below keeps any divergence
+  from thrashing the map.
+- Rebalance churn is BOUNDED: ``assignment`` takes the previous map
+  and caps voluntary moves per pass (``max_moves``), keeping only the
+  highest-affinity-gain moves — a profile shift migrates the fleet a
+  few shards at a time, never in one wave.  Moves forced by
+  membership change (a dead replica's shards) are never capped.
+- No topology, no profile, or an unknown member region all degrade to
+  weight 1.0 — and an all-1.0 weighted map is byte-identical to the
+  unweighted rendezvous map (tests/test_topology.py pins this), which
+  is what keeps the S=1/no-topology path identical to today.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from ..sharding.hashmap import compute_assignment
+
+# how strongly affinity biases the hash: weight = 1 + ALPHA * score,
+# score in [0, 1] — at 3.0 a fully-local member wins ~4x the hash mass
+# of a fully-remote one, enough to reorder ranks without drowning the
+# hash's balancing term
+DEFAULT_ALPHA = 3.0
+# voluntary (affinity-driven) moves allowed per rebalance pass
+DEFAULT_MAX_MOVES = 2
+
+
+class LocalityPlacement:
+    """Topology-weighted assignment for the shard-lease manager
+    (``ShardLeaseManager(placement=...)``).
+
+    ``member_region`` maps a replica identity to its home region
+    (None/unknown -> no bias for that member)."""
+
+    def __init__(self, topology,
+                 member_region: Callable[[str], Optional[str]],
+                 alpha: float = DEFAULT_ALPHA,
+                 max_moves: int = DEFAULT_MAX_MOVES):
+        self._topology = topology
+        self._member_region = member_region
+        self._alpha = alpha
+        self._max_moves = max_moves
+        self._prev: Optional[Dict[int, "str | None"]] = None
+
+    # -- scoring --------------------------------------------------------
+
+    def affinity(self, shard_id: int, member: str) -> float:
+        """[0, 1]: how much of the shard's observed mutation traffic
+        lands near ``member``'s home region (proximity-weighted
+        share).  No profile or no known region -> 0 (no opinion)."""
+        region = self._member_region(member)
+        if region is None:
+            return 0.0
+        profile = self._topology.mutation_profile(shard_id)
+        total = sum(profile.values())
+        if not total:
+            return 0.0
+        near = sum(count * self._topology.proximity(region, dst)
+                   for dst, count in profile.items())
+        return near / total
+
+    def weight(self, shard_id: int, member: str) -> float:
+        return 1.0 + self._alpha * self.affinity(shard_id, member)
+
+    # -- the assignment hook --------------------------------------------
+
+    def assignment(self, num_shards: int, members
+                   ) -> Dict[int, "str | None"]:
+        """The churn-bounded topology-weighted map (the shard-lease
+        manager's convergence target).  Remembers its own previous
+        answer so the voluntary-move cap applies pass over pass."""
+        want = compute_assignment(
+            num_shards, members, weights=self.weight,
+            prev=self._prev, max_moves=self._max_moves,
+            gain=self.affinity)
+        self._prev = dict(want)
+        return want
+
+
+def static_member_regions(mapping: Dict[str, str]
+                          ) -> Callable[[str], Optional[str]]:
+    """Convenience: identity -> region from a fixed dict (the CLI's
+    ``--shard-region identity=region`` spelling and the tests')."""
+    return mapping.get

@@ -912,6 +912,233 @@ def bench_scale_storm(n_services: int = 100_000, workers: int = 4,
 
 
 
+def _region_fanin_leg(n_services: int, regions, workers: int,
+                      hierarchical: bool, cross_latency: float,
+                      mutation_factor: float, seed: int) -> dict:
+    """One A/B arm of the region-fanin bench: converge ``n_services``
+    spread over ``regions`` (per-service hosted zones homed in each
+    service's region), then run a fleet-WIDE update storm — every A
+    record re-pointed out-of-band + every service touched, so each
+    key's event sync must re-UPSERT its alias — and measure the
+    storm's SIMULATED seconds (virtual clock: deterministic,
+    host-load-free) plus the cross-region mutation calls it cost.
+    ``hierarchical`` toggles the per-region aggregator
+    (topology/aggregator.py); False is flat fan-in: one cross-region
+    call per zone."""
+    sys.path.insert(0, "tests")
+    from harness import Cluster, wait_until
+
+    from aws_global_accelerator_controller_tpu import metrics
+    from aws_global_accelerator_controller_tpu.apis import (
+        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+        AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+        ROUTE53_HOSTNAME_ANNOTATION,
+    )
+    from aws_global_accelerator_controller_tpu.kube.objects import (
+        LoadBalancerIngress,
+        LoadBalancerStatus,
+        ObjectMeta,
+        Service,
+        ServicePort,
+        ServiceSpec,
+        ServiceStatus,
+    )
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintConfig,
+    )
+    from aws_global_accelerator_controller_tpu.simulation import (
+        VirtualClock,
+    )
+    from aws_global_accelerator_controller_tpu.simulation import (
+        clock as simclock,
+    )
+    from aws_global_accelerator_controller_tpu.topology import (
+        RegionTopology,
+    )
+
+    reg = metrics.default_registry
+    regions = list(regions)
+    # asymmetric matrix: each region pair gets its own cost around the
+    # base (deterministic spread), writes pay the commit factor
+    matrix = {}
+    for a_i, src in enumerate(regions):
+        for b_i, dst in enumerate(regions):
+            if src != dst:
+                matrix[(src, dst)] = cross_latency * (
+                    1.0 + 0.4 * ((a_i * len(regions) + b_i) %
+                                 len(regions)) / len(regions))
+    top = RegionTopology(
+        regions, seed=seed, intra_latency=0.0005,
+        cross_latency=cross_latency, matrix=matrix,
+        mutation_latency_factor=mutation_factor,
+        aggregate=hierarchical, digest_reads=False)
+    cluster = None
+    clk = VirtualClock(max_virtual=4 * 3600.0).activate()
+    try:
+        cluster = Cluster(workers=workers, queue_qps=1e9,
+                          queue_burst=10**9, resync_period=3600.0,
+                          topology=top,
+                          fingerprints=FingerprintConfig(
+                              sweep_every=0))
+        cluster.start()
+        wait_until(lambda: cluster.handle.informers_synced(),
+                   timeout=60.0, message="informers synced")
+        zones = []
+        for i in range(n_services):
+            region = regions[i % len(regions)]
+            name = f"svc{i:04d}"
+            hostname = (f"{name}-0123456789abcdef.elb.{region}"
+                        ".amazonaws.com")
+            zone = cluster.cloud.route53.create_hosted_zone(
+                f"{name}.example.com", region=region)
+            zones.append((zone.id, name, region))
+            cluster.cloud.elb.register_load_balancer(name, hostname,
+                                                     region)
+            cluster.kube.services.create(Service(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION:
+                            "true",
+                        ROUTE53_HOSTNAME_ANNOTATION:
+                            f"www.{name}.example.com"}),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(load_balancer=LoadBalancerStatus(
+                    ingress=[LoadBalancerIngress(hostname=hostname)])),
+            ))
+        t0 = time.perf_counter()
+        v0 = simclock.monotonic()
+
+        def converged():
+            r53 = cluster.cloud.route53
+            with r53._lock:
+                return all(len(r53._records.get(zid, ())) == 2
+                           for zid, _, _ in zones)
+
+        wait_until(converged, timeout=4 * 3600.0, interval=0.25,
+                   message=f"{n_services} services' records converged")
+        converge_sim = simclock.monotonic() - v0
+
+        # -- the fleet-wide update storm -------------------------------
+        def repaired():
+            r53 = cluster.cloud.route53
+            with r53._lock:
+                for zid, _, _ in zones:
+                    for r in r53._records.get(zid, ()):
+                        if r.alias_target is not None and \
+                                "drifted" in r.alias_target.dns_name:
+                            return False
+            return True
+
+        xr0 = reg.counter_value("cross_region_mutations_total")
+        batches0 = reg.counter_value("region_batches_total")
+        flushes0 = (cluster.cloud.faults.call_counts().get(
+            "change_resource_record_sets_batch", 0))
+        v1 = simclock.monotonic()
+        for zid, name, _ in zones:
+            cluster.cloud.faults.edit_record_set(
+                zid, f"www.{name}.example.com", "A",
+                alias_dns_name="drifted.example.com.")
+            svc = cluster.kube.services.get("default",
+                                            name).deep_copy()
+            svc.metadata.annotations["storm.example.com/round"] = "1"
+            cluster.kube.services.update(svc)
+        wait_until(repaired, timeout=4 * 3600.0, interval=0.1,
+                   message="update storm repaired fleet-wide")
+        storm_sim = simclock.monotonic() - v1
+        storm_wall = time.perf_counter() - t0
+        cross = (reg.counter_value("cross_region_mutations_total")
+                 - xr0)
+        batches = (reg.counter_value("region_batches_total")
+                   - batches0)
+        cluster.shutdown(ordered=True, deadline=30.0)
+    finally:
+        if cluster is not None:
+            try:
+                cluster.shutdown()
+            except Exception:
+                pass
+        clk.deactivate()
+    return {
+        "services": n_services,
+        "mode": "hierarchical" if hierarchical else "flat",
+        "converge_sim_s": round(converge_sim, 3),
+        "storm_sim_s": round(storm_sim, 3),
+        "storm_cross_region_mutations": round(cross),
+        "storm_region_batches": round(batches),
+        "zone_batch_calls": (cluster.cloud.faults.call_counts().get(
+            "change_resource_record_sets_batch", 0) - flushes0),
+        "wall_s": round(storm_wall, 2),
+    }
+
+
+def bench_region_fanin(n_services: int = 90, n_regions: int = 3,
+                       workers: int = 16, cross_latency: float = 0.03,
+                       mutation_factor: float = 3.0,
+                       seed: int = 20260805,
+                       record: bool = False) -> dict:
+    """A/B of hierarchical write fan-in (ISSUE 14's tentpole,
+    topology/aggregator.py) on a fleet-wide update storm across
+    ``n_regions`` simulated regions under an asymmetric latency matrix
+    (virtual time — the measured quantity is SIMULATED seconds, so
+    the number reflects the latency model, not host load).  Flat
+    fan-in pays one cross-region commit per zone; hierarchical pays
+    one region batch per region per flush wave.  ``speedup`` is
+    flat/hierarchical storm time (acceptance: >= 2x at 3+ regions);
+    the cross-region mutation-call reduction rides along.
+    ``record=True`` appends the hierarchical leg to
+    reconcile_history.jsonl tagged ``bench: "region-fanin"`` with the
+    regions and latency profile stamped (the reconcile floor skips
+    tagged entries)."""
+    regions = ["us-west-2", "eu-west-1", "ap-northeast-1",
+               "sa-east-1", "ap-south-1"][:max(2, n_regions)]
+    flat = _region_fanin_leg(n_services, regions, workers,
+                             hierarchical=False,
+                             cross_latency=cross_latency,
+                             mutation_factor=mutation_factor,
+                             seed=seed)
+    hier = _region_fanin_leg(n_services, regions, workers,
+                             hierarchical=True,
+                             cross_latency=cross_latency,
+                             mutation_factor=mutation_factor,
+                             seed=seed)
+    out = {
+        "workers": workers,
+        "regions": regions,
+        "latency_profile": {
+            "intra_s": 0.0005, "cross_s": cross_latency,
+            "mutation_factor": mutation_factor,
+            "matrix": "asymmetric (deterministic per-pair spread)"},
+        "flat": flat,
+        "hierarchical": hier,
+        "speedup": round(flat["storm_sim_s"]
+                         / max(hier["storm_sim_s"], 1e-9), 2),
+        "cross_region_mutation_reduction": round(
+            flat["storm_cross_region_mutations"]
+            / max(hier["storm_cross_region_mutations"], 1), 2),
+    }
+    if record:
+        _record_reconcile_history(
+            {"services": n_services,
+             "throughput": round(
+                 n_services / max(hier["storm_sim_s"], 1e-9), 1)},
+            bench="region-fanin",
+            extra={"regions": regions,
+                   "latency_profile": out["latency_profile"],
+                   "speedup": out["speedup"],
+                   "flat_storm_sim_s": flat["storm_sim_s"],
+                   "hier_storm_sim_s": hier["storm_sim_s"],
+                   "flat_cross_region_mutations":
+                       flat["storm_cross_region_mutations"],
+                   "hier_cross_region_mutations":
+                       hier["storm_cross_region_mutations"],
+                   "hier_region_batches":
+                       hier["storm_region_batches"]})
+    return out
+
+
 def bench_rollout_ramp(n_bindings: int = 200, workers: int = 6,
                        endpoints_per_binding: int = 3,
                        steps: str = "25,50,100",
@@ -3621,6 +3848,7 @@ _NAMED = {
     "shard-scaling": lambda: bench_shard_scaling(record=True),
     "mixed-soak": lambda: bench_mixed_soak(record=True),
     "rollout-ramp": lambda: bench_rollout_ramp(record=True),
+    "region-fanin": lambda: bench_region_fanin(record=True),
     "planner": lambda: _json_bench_subprocess(
         "bench_planner", "planner bench", 300.0),
     "fleet-plan": lambda: _json_bench_subprocess(

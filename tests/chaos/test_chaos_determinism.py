@@ -211,3 +211,114 @@ def test_seeded_scenario_replays_byte_identical(race_detectors):
         f"run1={ledger1[:6]}...\nrun2={ledger2[:6]}...")
     assert ledger1, "no ledger records — the scenario traced nothing"
     assert state1 == state2, "final fake-cloud state diverged"
+
+
+# ---------------------------------------------------------------------------
+# Multi-region determinism (ISSUE 14): partition/heal + the latency
+# matrix draw from their own per-(seed, region-pair) streams, so the
+# same seeded multi-region scenario replays byte-identically — AWS
+# fault decisions (partition entries included), the topology's own
+# partition log, the convergence ledger, and the final cloud state.
+# ---------------------------------------------------------------------------
+
+REGIONS = ["us-west-2", "eu-west-1", "ap-northeast-1"]
+
+
+def _region_svc(name, region, hostname):
+    from aws_global_accelerator_controller_tpu.apis import (
+        ROUTE53_HOSTNAME_ANNOTATION as _R53,
+    )
+
+    svc = _svc(name, hostname)
+    svc.metadata.annotations[_R53] = hostname
+    svc.status.load_balancer.ingress[0].hostname = \
+        f"{name}-0123456789abcdef.elb.{region}.amazonaws.com"
+    return svc
+
+
+def _run_region_scenario():
+    """One multi-region scenario under a fresh virtual clock: converge
+    6 services across 3 regions through the jittered latency matrix,
+    partial-partition one region mid-storm (seeded per-pair draws),
+    heal, converge, ordered stop."""
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintConfig,
+    )
+    from aws_global_accelerator_controller_tpu.topology import (
+        RegionTopology,
+    )
+
+    _drain_stragglers()
+    ledger_before = len(default_ledger.snapshot(limit=100000))
+    clk = simclock.VirtualClock(max_virtual=7200.0).activate()
+    try:
+        top = RegionTopology(
+            REGIONS, seed=SEED, intra_latency=0.0005,
+            cross_latency=0.02, jitter=0.2,
+            matrix={("us-west-2", "eu-west-1"): 0.05})
+        a = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                    resilience=CHAOS_CONFIG, fault_seed=SEED,
+                    resync_period=2.0, topology=top,
+                    fingerprints=FingerprintConfig(sweep_every=0))
+        cloud = a.cloud
+        zones = {}
+        for j, region in enumerate(REGIONS):
+            zones[region] = cloud.route53.create_hosted_zone(
+                f"r{j}.example.com", region=region)
+        for i in range(6):
+            region = REGIONS[i % 3]
+            name = f"svc-{i}"
+            cloud.elb.register_load_balancer(
+                name,
+                f"{name}-0123456789abcdef.elb.{region}.amazonaws.com",
+                region)
+        a.start()
+        wait_until(lambda: a.handle.informers_synced(), timeout=30.0,
+                   message="informers synced")
+        for i in range(6):
+            region = REGIONS[i % 3]
+            a.kube.services.create(_region_svc(
+                f"svc-{i}", region, f"s{i}.r{i % 3}.example.com"))
+        wait_until(lambda: len(cloud.ga.list_accelerators()) == 6,
+                   timeout=120.0, message="fleet converged")
+
+        # partial partition + fleet-wide touch storm: the partition
+        # draws come from the (seed, us-west-2→eu-west-1) stream
+        top.partition_region("eu-west-1", rate=0.7)
+        for i in range(6):
+            svc = a.kube.services.get("default",
+                                      f"svc-{i}").deep_copy()
+            svc.metadata.annotations["storm.example.com/round"] = "1"
+            a.kube.services.update(svc)
+        simclock.sleep(6.0)
+        top.heal_region("eu-west-1")
+        wait_until(lambda: len(cloud.ga.list_accelerators()) == 6,
+                   timeout=120.0, message="fleet still converged")
+        simclock.sleep(4.0)
+        a.shutdown(ordered=True, deadline=10.0)
+
+        aws_log = json.dumps(cloud.faults.decision_log(),
+                             sort_keys=True)
+        top_log = json.dumps(top.decision_log(), sort_keys=True)
+        ledger = [
+            (r["key"], r["controller"], r["origin"],
+             tuple(sorted(r["stages"].items())), r["total_s"])
+            for r in default_ledger.snapshot(
+                limit=100000)[ledger_before:]
+        ]
+        state = _cloud_state(cloud)
+        return aws_log, top_log, ledger, state
+    finally:
+        clk.deactivate()
+
+
+def test_multi_region_seeded_scenario_replays_byte_identical(
+        race_detectors):
+    aws1, top1, ledger1, state1 = _run_region_scenario()
+    aws2, top2, ledger2, state2 = _run_region_scenario()
+
+    assert aws1 == aws2, "AWS decision streams diverged across regions"
+    assert top1 == top2, "topology partition decision logs diverged"
+    assert json.loads(top1), "the partial partition injected nothing"
+    assert ledger1 == ledger2, "convergence ledgers diverged"
+    assert state1 == state2, "final fake-cloud state diverged"

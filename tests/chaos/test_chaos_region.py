@@ -1,0 +1,374 @@
+"""Region-partition chaos e2e (ISSUE 14): 3 simulated regions under
+the asymmetric latency matrix, hierarchical write fan-in armed, one
+region partitioned mid-update-storm, then healed — the fleet must
+converge EXACTLY ONCE (per-identity committed-write log: zero
+duplicate mutations, final record set exact).
+
+A second scenario drives the digest-read layer end to end: a steady
+converged fleet's sweep tier collapses to one digest exchange per
+region per wave once regions earn CLEAN; a partition opens exactly
+the dark region's breaker (its digest exchanges ride its OWN wrapper
+— sibling regions' breakers stay closed); and an out-of-band edit in
+a clean region flips its digest, re-enables its sweeps, and is
+repaired.
+
+Virtual clock + race detectors: latency and partition windows cost
+virtual seconds; the scheduler interleaving is deterministic.
+"""
+import threading
+
+from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.apis import (
+    AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+    AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+    ROUTE53_HOSTNAME_ANNOTATION,
+)
+from aws_global_accelerator_controller_tpu.kube.objects import (
+    LoadBalancerIngress,
+    LoadBalancerStatus,
+    ObjectMeta,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    ServiceStatus,
+)
+from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (
+    FingerprintConfig,
+)
+from aws_global_accelerator_controller_tpu.resilience import (
+    ResilienceConfig,
+)
+from aws_global_accelerator_controller_tpu.simulation import (
+    clock as simclock,
+)
+from aws_global_accelerator_controller_tpu.topology import RegionTopology
+
+from harness import Cluster, wait_until
+
+SEED = 20260805
+REGIONS = ["us-west-2", "eu-west-1", "ap-northeast-1"]
+PARTITIONED = "eu-west-1"
+N_PER_REGION = 3
+
+# partition-sensitive breaker profile: a regional wrapper's call mix
+# includes the global services' (home-region) successes — GA is
+# global, so a partitioned region's wrapper still lands its GA reads
+# — which dilutes the partition's failure rate well below the default
+# 50% threshold.  A low threshold + a window spanning several resync
+# waves (virtual-time resync ticks quantize to ~5s — simulation/
+# clock.py idle-hop quantization) makes the sustained cross-region
+# failure stream open the circuit while zero-failure siblings stay
+# closed (the independence assertion below).
+REGION_CHAOS_CONFIG = ResilienceConfig(
+    max_attempts=3, base_delay=0.01, max_delay=0.1, deadline=2.0,
+    breaker_window=60.0, breaker_min_calls=15,
+    breaker_failure_threshold=0.1, breaker_open_seconds=5.0,
+    bucket_capacity=10000.0, bucket_refill=10000.0,
+    bucket_min_capacity=100.0, bucket_recover=100.0, seed=SEED)
+
+
+def _topology():
+    # asymmetric matrix: the partitioned region is also the FARTHEST
+    # (the shape the fan-in exists for)
+    return RegionTopology(
+        REGIONS, seed=SEED, intra_latency=0.0005, cross_latency=0.02,
+        matrix={("us-west-2", "eu-west-1"): 0.05,
+                ("us-west-2", "ap-northeast-1"): 0.03},
+        digest_stability_waves=3)
+
+
+def _nlb(name, region):
+    return f"{name}-0123456789abcdef.elb.{region}.amazonaws.com"
+
+
+def _svc(name, region, hostname):
+    return Service(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            annotations={
+                AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION: "true",
+                ROUTE53_HOSTNAME_ANNOTATION: hostname}),
+        spec=ServiceSpec(type="LoadBalancer",
+                         ports=[ServicePort(port=80)]),
+        status=ServiceStatus(load_balancer=LoadBalancerStatus(
+            ingress=[LoadBalancerIngress(hostname=_nlb(name, region))])))
+
+
+def _record_committed_writes(cloud, log, lock):
+    """Per-identity committed-write recorder: wraps the fake's record
+    mutation surface (instance attributes, so both the flat path and
+    the gateway's local fan-out are seen) and logs each APPLIED change
+    — a call that raised (partition, chaos, validation) commits
+    nothing and logs nothing."""
+    orig_batch = cloud.route53.change_resource_record_sets_batch
+    orig_single = cloud.route53.change_resource_record_sets
+
+    def batch(zone_id, changes):
+        changes = list(changes)
+        orig_batch(zone_id, changes)
+        with lock:
+            for action, rs in changes:
+                name = rs.name if rs.name.endswith(".") \
+                    else rs.name + "."
+                log.append((zone_id, action, name, rs.type))
+
+    def single(zone_id, action, record_set):
+        orig_single(zone_id, action, record_set)
+        with lock:
+            name = record_set.name if record_set.name.endswith(".") \
+                else record_set.name + "."
+            log.append((zone_id, action, name, record_set.type))
+
+    cloud.route53.change_resource_record_sets_batch = batch
+    cloud.route53.change_resource_record_sets = single
+
+
+def _build_fleet(cluster, topology):
+    zones = {}
+    for j, region in enumerate(REGIONS):
+        zones[region] = cluster.cloud.route53.create_hosted_zone(
+            f"r{j}.example.com", region=region)
+    for j, region in enumerate(REGIONS):
+        for i in range(N_PER_REGION):
+            name = f"svc-{j}-{i}"
+            cluster.cloud.elb.register_load_balancer(
+                name, _nlb(name, region), region)
+    for j, region in enumerate(REGIONS):
+        for i in range(N_PER_REGION):
+            name = f"svc-{j}-{i}"
+            cluster.kube.services.create(
+                _svc(name, region, f"s{i}.r{j}.example.com"))
+    return zones
+
+
+def _zone_names(cluster, zone_id):
+    return sorted((r.name, r.type) for r in
+                  cluster.cloud.route53.list_resource_record_sets(
+                      zone_id))
+
+
+def _aliases_repaired(cluster, zone_id):
+    """Every A record's alias points back at an accelerator."""
+    return all(
+        r.alias_target is None
+        or "awsglobalaccelerator" in r.alias_target.dns_name
+        for r in cluster.cloud.route53.list_resource_record_sets(
+            zone_id))
+
+
+def _aliases_repaired_direct(cluster, zone_id):
+    """Lock-direct twin of :func:`_aliases_repaired` — the observer
+    path for a PARTITIONED zone (an API read would fail the topology
+    check; peeking must neither fail nor consume draws)."""
+    r53 = cluster.cloud.route53
+    with r53._lock:
+        return all(
+            r.alias_target is None
+            or "awsglobalaccelerator" in r.alias_target.dns_name
+            for r in r53._records.get(zone_id, []))
+
+
+def _zone_names_direct(cluster, zone_id):
+    """Lock-direct read of a zone's record identities — the observer
+    path for a PARTITIONED zone (an API read would fail the topology
+    check; peeking must neither fail nor consume draws)."""
+    r53 = cluster.cloud.route53
+    with r53._lock:
+        return sorted((r.name, r.type)
+                      for r in r53._records.get(zone_id, []))
+
+
+def test_region_partition_heals_and_converges_exactly_once(
+        race_detectors, virtual_clock):
+    top = _topology()
+    cluster = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                      resilience=REGION_CHAOS_CONFIG, fault_seed=SEED,
+                      resync_period=2.0, topology=top,
+                      fingerprints=FingerprintConfig(sweep_every=0),
+                      ).start()
+    log, loglock = [], threading.Lock()
+    try:
+        _record_committed_writes(cluster.cloud, log, loglock)
+        zones = _build_fleet(cluster, top)
+        total = len(REGIONS) * N_PER_REGION
+        wait_until(lambda: len(cluster.cloud.ga.list_accelerators())
+                   == total, timeout=120.0, message="fleet converged")
+        for j, region in enumerate(REGIONS):
+            wait_until(lambda j=j, region=region: len(_zone_names(
+                cluster, zones[region].id)) == 2 * N_PER_REGION,
+                timeout=120.0, message=f"records in r{j}")
+
+        # ---- fleet-WIDE update storm with one region dark: every A
+        # record is re-pointed out-of-band (the edit hook — no API
+        # call, no event), then every service is touched, so each
+        # key's event-origin sync must re-UPSERT its alias exactly
+        # once.  The partitioned region's repairs must wait out the
+        # partition without duplicating anyone's writes.
+        top.partition_region(PARTITIONED)
+        for j, region in enumerate(REGIONS):
+            for i in range(N_PER_REGION):
+                cluster.cloud.faults.edit_record_set(
+                    zones[region].id, f"s{i}.r{j}.example.com", "A",
+                    alias_dns_name="drifted.example.com.")
+                name = f"svc-{j}-{i}"
+                svc = cluster.kube.services.get(
+                    "default", name).deep_copy()
+                svc.metadata.annotations["storm.example.com/round"] \
+                    = "1"
+                cluster.kube.services.update(svc)
+
+        # healthy regions repair THROUGH the partition...
+        for j, region in enumerate(REGIONS):
+            if region == PARTITIONED:
+                continue
+            wait_until(lambda j=j, region=region: _aliases_repaired(
+                cluster, zones[region].id),
+                timeout=120.0,
+                message=f"healthy r{j} re-pointed")
+        # ...while the partitioned region's records are still drifted
+        # (no write crossed the cut)
+        assert not _aliases_repaired_direct(
+            cluster, zones[PARTITIONED].id), \
+            "a write crossed into the partitioned region"
+
+        # ---- heal: the dark region converges exactly once
+        top.heal_region(PARTITIONED)
+        for j, region in enumerate(REGIONS):
+            wait_until(lambda j=j, region=region: _aliases_repaired(
+                cluster, zones[region].id),
+                timeout=180.0,
+                message=f"r{j} repaired after heal")
+            # the record SET is exactly what converged initially:
+            # the storm re-pointed aliases, never grew or shrank it
+            assert len(_zone_names(cluster, zones[region].id)) \
+                == 2 * N_PER_REGION
+        # quiesce a couple of resync waves: nothing may re-mutate
+        simclock.sleep(8.0)
+    finally:
+        cluster.shutdown()
+
+    # ---- exactly-once: per identity, every committed CREATE landed
+    # exactly once (a duplicate would mean a retry re-applied work the
+    # partition supposedly swallowed) and the v1 DELETEs too
+    with loglock:
+        snapshot = list(log)
+    creates = {}
+    upserts = {}
+    deletes = {}
+    for zone_id, action, name, rtype in snapshot:
+        key = (zone_id, name, rtype)
+        if action == "CREATE":
+            creates[key] = creates.get(key, 0) + 1
+        elif action == "UPSERT":
+            upserts[key] = upserts.get(key, 0) + 1
+        elif action == "DELETE":
+            deletes[key] = deletes.get(key, 0) + 1
+    dup_creates = {k: n for k, n in creates.items() if n > 1}
+    dup_upserts = {k: n for k, n in upserts.items() if n > 1}
+    dup_deletes = {k: n for k, n in deletes.items() if n > 1}
+    assert not dup_creates, f"duplicate committed CREATEs: {dup_creates}"
+    assert not dup_upserts, f"duplicate committed UPSERTs: {dup_upserts}"
+    assert not dup_deletes, f"duplicate committed DELETEs: {dup_deletes}"
+    # the storm's repair landed EXACTLY once per A-record identity,
+    # fleet-wide — partitioned region included
+    assert len(upserts) == len(REGIONS) * N_PER_REGION, \
+        f"upsert set wrong: {sorted(upserts)}"
+    assert all(t == "A" for (_, _, t) in upserts), sorted(upserts)
+    # the region batches actually carried the storm (hierarchical
+    # fan-in was in force, not the flat fallback)
+    assert metrics.default_registry.counter_value(
+        "region_batches_total") > 0
+
+
+def test_digest_reads_gate_sweeps_and_detect_oob_drift(
+        race_detectors, virtual_clock):
+    """Steady state: once every region's digest is verified-stable,
+    sweep-due keys are answered by one digest exchange per region per
+    wave (drift_sweep_verifies stops growing; exchanges keep going) —
+    and an out-of-band edit in a CLEAN region flips its digest,
+    re-enables its sweeps, and gets repaired."""
+    top = _topology()
+    reg = metrics.default_registry
+    cluster = Cluster(workers=2, queue_qps=10000.0, queue_burst=10000,
+                      resync_period=1.0, topology=top,
+                      resilience=REGION_CHAOS_CONFIG, fault_seed=SEED,
+                      fingerprints=FingerprintConfig(sweep_every=2),
+                      ).start()
+    try:
+        zones = _build_fleet(cluster, top)
+        total = len(REGIONS) * N_PER_REGION
+        wait_until(lambda: len(cluster.cloud.ga.list_accelerators())
+                   == total, timeout=120.0, message="fleet converged")
+        for j, region in enumerate(REGIONS):
+            wait_until(lambda j=j, region=region: len(_zone_names(
+                cluster, zones[region].id)) == 2 * N_PER_REGION,
+                timeout=120.0, message=f"records in r{j}")
+
+        # let regions EARN clean: stability_waves=3 at sweep_every=2
+        # and resync 1.0s — a handful of waves suffices
+        gate = cluster.factory.digest_gate
+        wait_until(lambda: len(gate.clean_regions()) == len(REGIONS),
+                   timeout=60.0, message="all regions digest-clean")
+
+        sweeps_then = reg.counter_value("drift_sweep_verifies_total")
+        exchanges_then = reg.counter_value(
+            "region_digest_exchanges_total")
+        simclock.sleep(6.0)     # several full sweep periods at rest
+        sweeps_now = reg.counter_value("drift_sweep_verifies_total")
+        exchanges_now = reg.counter_value(
+            "region_digest_exchanges_total")
+        assert exchanges_now > exchanges_then, \
+            "clean regions must keep exchanging digests"
+        assert sweeps_now - sweeps_then <= 2, \
+            (f"digest-clean regions still deep-sweeping: "
+             f"{sweeps_now - sweeps_then} sweeps in the window")
+
+        # ---- per-region breaker independence: partition one region;
+        # its failing digest exchanges (its OWN wrapper) open exactly
+        # its circuit — a region's blackout must not trip siblings
+        open_before = {
+            r: reg.counter_value("circuit_transitions_total",
+                                 {"region": r, "to": "open"})
+            for r in REGIONS}
+        top.partition_region(PARTITIONED)
+        wait_until(lambda: reg.counter_value(
+            "circuit_transitions_total",
+            {"region": PARTITIONED, "to": "open"})
+            > open_before[PARTITIONED],
+            timeout=120.0, message="partitioned region's breaker open")
+        assert PARTITIONED not in cluster.factory.digest_gate \
+            .clean_regions(), "a dark region must not stay CLEAN"
+        for r in REGIONS:
+            if r == PARTITIONED:
+                continue
+            assert reg.counter_value(
+                "circuit_transitions_total",
+                {"region": r, "to": "open"}) == open_before[r], \
+                f"sibling region {r}'s breaker tripped"
+        top.heal_region(PARTITIONED)
+        wait_until(lambda: len(gate.clean_regions()) == len(REGIONS),
+                   timeout=120.0,
+                   message="all regions clean after heal")
+
+        # ---- out-of-band drift in a clean region: digest flips,
+        # sweeps resume, the record is repaired
+        j = REGIONS.index("ap-northeast-1")
+        victim = f"s0-x.r{j}.example.com"    # not a managed name
+        zone_id = zones["ap-northeast-1"].id
+        cluster.cloud.faults.edit_record_set(
+            zone_id, f"s0.r{j}.example.com", "A",
+            alias_dns_name="attacker.example.com.")
+        wait_until(lambda: "ap-northeast-1" not in
+                   gate.clean_regions(),
+                   timeout=60.0, message="drifted region left CLEAN")
+        # the sweep tier repairs the alias back to the accelerator
+        wait_until(lambda: all(
+            r.alias_target is None
+            or "awsglobalaccelerator" in r.alias_target.dns_name
+            for r in cluster.cloud.route53.list_resource_record_sets(
+                zone_id)),
+            timeout=120.0, message="out-of-band drift repaired")
+        del victim
+    finally:
+        cluster.shutdown()

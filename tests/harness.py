@@ -40,7 +40,7 @@ class Cluster:
                  policy_checkpoint: str = "", resilience=None,
                  fault_seed=None, coalesce=None, fingerprints=None,
                  api=None, cloud=None, num_shards: int = 1,
-                 discovery_cache_ttl=None):
+                 discovery_cache_ttl=None, topology=None):
         from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
             FingerprintConfig,
         )
@@ -55,7 +55,8 @@ class Cluster:
             settle_seconds=settle_seconds, resilience=resilience,
             fault_seed=fault_seed, coalesce=coalesce, cloud=cloud,
             num_shards=num_shards,
-            discovery_cache_ttl=discovery_cache_ttl)
+            discovery_cache_ttl=discovery_cache_ttl,
+            topology=topology)
         self.cloud = self.factory.cloud
         self.stop = simclock.make_event()
         self._manager = Manager(resync_period=resync_period)

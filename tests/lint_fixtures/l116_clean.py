@@ -1,0 +1,10 @@
+"""L116 fixture (clean): cross-region mutations ride the coalescer,
+whose wire path hands off to the per-region aggregator — no direct
+regional-gateway call anywhere."""
+
+
+def storm_hierarchical(coalescer, zone_batches):
+    for _, zone_id, changes in zone_batches:
+        # the coalescer's _wire_* handoff routes this through the
+        # region aggregator when a topology is configured
+        coalescer.change_record_sets(zone_id, changes)

@@ -1,0 +1,12 @@
+"""L116 fixture: a cross-region wire call issued directly — flat
+fan-in re-created outside topology/, bypassing the per-region
+aggregator's fence/demux/accounting contracts.  The rule must fire on
+the apply_region_batch call."""
+
+
+def storm_flat(apis, zone_batches):
+    for region, zone_id, changes in zone_batches:
+        # direct regional-gateway mutation: no per-contribution fence
+        # checks, no per-entry demux, no region batch accounting
+        apis.gateway.apply_region_batch(
+            region, [("record_sets", zone_id, changes)])

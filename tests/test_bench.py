@@ -357,9 +357,9 @@ def test_bench_mixed_soak_full_slo():
 
 def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
     """batch-efficiency, steady-state, restart-recovery, mixed-soak,
-    shard-scaling, rollout-ramp and scale-storm legs measure other
-    workloads, not the floor's pure create storm: their (lower)
-    throughputs must not drag the derived floor down."""
+    shard-scaling, rollout-ramp, region-fanin and scale-storm legs
+    measure other workloads, not the floor's pure create storm: their
+    (lower) throughputs must not drag the derived floor down."""
     hist = tmp_path / "history.jsonl"
     hist.write_text("".join(
         json.dumps(e) + "\n" for e in (
@@ -375,6 +375,10 @@ def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
             {"throughput": 110.0, "bench": "shard-scaling"},
             {"throughput": 55.0, "bench": "rollout-ramp"},
             {"throughput": 60.0, "bench": "rollout-ramp"},
+            # region-fanin reports services per SIMULATED second of
+            # the hierarchical storm — a different regime entirely
+            {"throughput": 100.0, "bench": "region-fanin",
+             "speedup": 3.9, "regions": ["us-west-2", "eu-west-1"]},
             # scale-storm runs under simulated I/O latency: its wall
             # svc/s is a different regime from the pure storm
             {"throughput": 1500.0, "bench": "scale-storm",
@@ -1131,3 +1135,30 @@ def test_bench_scale_storm_smoke(monkeypatch, tmp_path):
     assert m.default_registry.gauge_value("per_service_bytes") > 0
     assert "sim_time_ratio" in m.default_registry.help_names()
     assert "per_service_bytes" in m.default_registry.help_names()
+
+
+def test_bench_region_fanin_smoke(monkeypatch, tmp_path):
+    """Tier-1 smoke of the multi-region fan-in A/B (ISSUE 14) at a
+    small fleet: both legs converge under the VirtualClock, the
+    hierarchical leg issues region batches and FEWER cross-region
+    mutation calls than flat fan-in, the speedup is computed, and the
+    history entry lands tagged ``region-fanin`` with the regions and
+    latency profile stamped."""
+    hist = tmp_path / "history.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(hist))
+    r = bench.bench_region_fanin(n_services=24, n_regions=3,
+                                 workers=8, record=True)
+    assert len(r["regions"]) == 3
+    flat, hier = r["flat"], r["hierarchical"]
+    assert flat["storm_region_batches"] == 0
+    assert hier["storm_region_batches"] > 0
+    assert hier["storm_cross_region_mutations"] \
+        < flat["storm_cross_region_mutations"], \
+        "hierarchical fan-in did not reduce cross-region calls"
+    assert r["speedup"] > 1.0, (
+        f"hierarchical slower than flat at smoke size: {r}")
+    entries = [json.loads(line)
+               for line in hist.read_text().splitlines()]
+    assert entries and entries[-1]["bench"] == "region-fanin"
+    assert entries[-1]["regions"] == r["regions"]
+    assert entries[-1]["latency_profile"]["mutation_factor"] > 0

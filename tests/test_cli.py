@@ -112,3 +112,43 @@ def test_controller_demo_converges_sharded(tmp_path):
     text = log.read_text()
     assert "smoke: demo fleet converged" in text
     assert "shard lease manager" in text
+
+
+def test_controller_regions_requires_fake_cloud():
+    """--regions (ISSUE 14) aborts without a fake backend: the
+    simulated region gateway is what backs the topology layer."""
+    res = run_cli("controller", "--real", "--regions",
+                  "us-west-2,eu-west-1")
+    assert res.returncode != 0
+    assert "--regions requires the fake cloud" in (res.stderr
+                                                   + res.stdout)
+
+
+def test_controller_demo_converges_multi_region(tmp_path):
+    """The demo fleet converges with the multi-region topology armed
+    (--regions): the per-region aggregator and digest gate ride the
+    real binary end to end."""
+    import signal
+    import time
+
+    log = tmp_path / "demo-regions.log"
+    with open(log, "w") as out:
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "aws_global_accelerator_controller_tpu",
+             "controller", "--demo", "--smoke", "60",
+             "--regions", "us-west-2,eu-west-1,ap-northeast-1",
+             "--health-port", "0"],
+            stdout=out, stderr=subprocess.STDOUT)
+        try:
+            deadline = time.monotonic() + 90
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.25)
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                proc.wait(timeout=15)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+    assert proc.returncode == 0, log.read_text()[-2000:]
+    assert "smoke: demo fleet converged" in log.read_text()

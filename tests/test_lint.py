@@ -922,3 +922,64 @@ def test_l115_seeded_bare_sleep_in_shipped_informer_caught(tmp_path):
                 if x.code == "L115" and "time.sleep" in x.msg]
     assert findings, "a grafted bare time.sleep in the shipped " \
                      "informer loop was not caught"
+
+
+def test_l116_flat_fanin_fires():
+    """A direct cross-region wire call (apply_region_batch) outside
+    topology/ is flat fan-in without the aggregator's contracts."""
+    assert ("L116", 11) in _cfindings("l116_flat_fanin.py")
+
+
+def test_l116_clean_passes():
+    assert [x for x in _cfindings("l116_clean.py")
+            if x[0] == "L116"] == []
+
+
+def test_l116_topology_package_exempt():
+    """The aggregator's own module (the one legitimate issuer) is
+    exempt — and clean under every other rule."""
+    agg = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/topology/aggregator.py")
+    assert [x for x in concurrency_lint.lint_files([agg])
+            if x.code == "L116"] == []
+
+
+def test_l116_seeded_handoff_strip_from_batcher_caught(tmp_path):
+    """Acceptance probe tied to the shipped code shape: strip the
+    ShardedCoalescer→aggregator handoff consult from the REAL wire
+    path and the gate must fire whenever batcher.py is linted — with
+    a topology configured, every coalesced mutation relies on that
+    consult to ride the per-region fan-in instead of flat
+    cross-region calls."""
+    batcher_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/cloudprovider/aws/"
+        "batcher.py")
+    src = batcher_py.read_text()
+    needle = ("        if self._aggregator is not None:\n"
+              "            self._aggregator.submit_record_sets(\n"
+              "                zone_id, changes, fence=self._fence, "
+              "ctxs=ctxs,\n"
+              "                shard_id=self._shard_id)\n"
+              "            return\n")
+    assert src.count(needle) == 1, \
+        "coalescer wire-handoff shape changed; update this probe"
+    mutated = src.replace(needle, "", 1)
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "cloudprovider" / "aws")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "batcher.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L116"]
+    assert findings, "a stripped aggregator handoff was not caught"
+
+    # sanity: the unmutated batcher is clean under its own rule
+    assert [x for x in concurrency_lint.lint_files([batcher_py])
+            if x.code == "L116"] == []
+
+
+def test_l116_batcher_gate_trusts_shipped_when_absent():
+    """A fixture subset without batcher.py must not fire the handoff
+    gate (parity with the other module gates)."""
+    assert [x for x in _cfindings("l116_clean.py")
+            if x[0] == "L116"] == []

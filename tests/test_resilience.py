@@ -477,13 +477,18 @@ def test_wrapped_method_surface_matches_api_interfaces():
     assert wrapper.ELB_METHODS == frozenset(api.ELBv2API.__abstractmethods__)
     assert wrapper.ROUTE53_METHODS == \
         frozenset(api.Route53API.__abstractmethods__)
+    assert wrapper.GATEWAY_METHODS == \
+        frozenset(api.RegionGatewayAPI.__abstractmethods__)
     surface = (wrapper.GA_METHODS | wrapper.ELB_METHODS
                | wrapper.ROUTE53_METHODS)
     assert set(concurrency_lint._AWS_API_METHODS) == surface
     # the chaos engine's service map must name every non-GA method
     # (GA is its default) for service-scoped blackouts to aim right
     assert set(fake._METHOD_SERVICE) == \
-        wrapper.ELB_METHODS | wrapper.ROUTE53_METHODS
+        (wrapper.ELB_METHODS | wrapper.ROUTE53_METHODS
+         | wrapper.GATEWAY_METHODS)
+    assert all(fake._METHOD_SERVICE[m] == "gateway"
+               for m in wrapper.GATEWAY_METHODS)
     assert all(fake._METHOD_SERVICE[m] == "elb"
                for m in wrapper.ELB_METHODS)
     assert all(fake._METHOD_SERVICE[m] == "route53"

@@ -28,7 +28,8 @@ NAME = "agac-test"
 
 
 def make_manager(api, identity, shards=None, lease_duration=30.0,
-                 renew_deadline=20.0, drain=None, drained=None):
+                 renew_deadline=20.0, drain=None, drained=None,
+                 placement=None):
     shards = shards or ShardSet(S)
     if drain is None and drained is not None:
         def drain(sid, timeout):
@@ -37,7 +38,8 @@ def make_manager(api, identity, shards=None, lease_duration=30.0,
     mgr = ShardLeaseManager(
         NAME, "default", KubeClient(api), shards, identity=identity,
         lease_duration=lease_duration, renew_deadline=renew_deadline,
-        retry_period=0.01, handoff_drain_timeout=0.2, drain=drain)
+        retry_period=0.01, handoff_drain_timeout=0.2, drain=drain,
+        placement=placement)
     mgr.shards.set_managed()
     return mgr
 
@@ -306,3 +308,46 @@ def test_member_lease_gc_and_graceful_delete():
     a.run(stop)
     with _pytest.raises(NotFoundError):
         api.store("Lease").get("default", f"{NAME}-member-replica-a")
+
+
+def test_placement_drives_lease_convergence_toward_locality():
+    """ShardLeaseManager(placement=...) (ISSUE 14): with a locality
+    placement installed, the managers converge ownership toward the
+    topology-weighted map instead of the plain rendezvous map — and
+    the leases still arbitrate (one owner per shard throughout)."""
+    from aws_global_accelerator_controller_tpu.topology import (
+        LocalityPlacement,
+        RegionTopology,
+        static_member_regions,
+    )
+
+    top = RegionTopology(["us-west-2", "eu-west-1"], seed=3,
+                         intra_latency=0.001, cross_latency=0.1)
+    # every shard's observed traffic lands in eu: the eu replica
+    # should end up owning (nearly) everything
+    top.seed_profile({sid: {"eu-west-1": 50} for sid in range(S)})
+    member_region = static_member_regions({"replica-eu": "eu-west-1",
+                                           "replica-us": "us-west-2"})
+
+    api = FakeAPIServer()
+    managers = {}
+    for identity in ("replica-eu", "replica-us"):
+        shards = ShardSet(S)
+        placement = LocalityPlacement(top, member_region, alpha=8.0,
+                                      max_moves=2)
+        managers[identity] = make_manager(api, identity,
+                                          shards=shards,
+                                          placement=placement)
+    # several passes: the churn bound (max_moves=2) migrates the map
+    # incrementally, never in one wave
+    for _ in range(2 * S):
+        for mgr in managers.values():
+            mgr.tick()
+        owned = {sid: [i for i, m in managers.items()
+                       if sid in m.shards.owned_shards()]
+                 for sid in range(S)}
+        assert all(len(owners) <= 1 for owners in owned.values()), \
+            f"two owners for one shard: {owned}"
+    eu_owned = managers["replica-eu"].shards.owned_shards()
+    assert len(eu_owned) >= 6, \
+        f"locality placement left eu with only {sorted(eu_owned)}"

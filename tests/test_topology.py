@@ -1,0 +1,548 @@
+"""Unit tests for the multi-region topology layer (ISSUE 14):
+the region model's cost/partition/binding machinery, weighted
+rendezvous placement (byte-identical unweighted path pinned), the
+per-region aggregator's fan-in + fence/demux contracts, and the
+digest gate's earned-clean state machine."""
+import threading
+
+import pytest
+
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.fake import (
+    FakeAWSCloud,
+)
+from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (
+    ResourceRecordSet,
+)
+from aws_global_accelerator_controller_tpu.errors import AWSAPIError
+from aws_global_accelerator_controller_tpu.resilience import (
+    FencedError,
+    MutationFence,
+)
+from aws_global_accelerator_controller_tpu.sharding.hashmap import (
+    compute_assignment,
+    rendezvous_owner,
+)
+from aws_global_accelerator_controller_tpu.topology import (
+    LocalityPlacement,
+    RegionAggregator,
+    RegionDigestGate,
+    RegionTopology,
+    static_member_regions,
+)
+
+REGIONS = ["us-west-2", "eu-west-1", "ap-northeast-1"]
+
+
+def topo(**kw):
+    kw.setdefault("seed", 1234)
+    return RegionTopology(REGIONS, **kw)
+
+
+# ---------------------------------------------------------------------------
+# RegionTopology: cost model, partitions, bindings, profiles
+# ---------------------------------------------------------------------------
+
+def test_latency_intra_vs_cross_and_matrix_asymmetry():
+    t = topo(intra_latency=0.001, cross_latency=0.05,
+             matrix={("us-west-2", "eu-west-1"): 0.08,
+                     ("eu-west-1", "us-west-2"): 0.02})
+    assert t.latency("us-west-2", "us-west-2") == 0.001
+    assert t.latency("us-west-2", "eu-west-1") == 0.08
+    assert t.latency("eu-west-1", "us-west-2") == 0.02   # asymmetric
+    assert t.latency("us-west-2", "ap-northeast-1") == 0.05
+    # unknown regions are local: no topology opinion, no cost
+    assert t.latency("us-west-2", "mars-1") == 0.001
+    assert t.latency(None, None) == 0.001
+
+
+def test_latency_bandwidth_term_scales_with_units():
+    t = topo(cross_latency=0.05, bandwidth=0.001)
+    assert t.latency("us-west-2", "eu-west-1", units=1) == \
+        pytest.approx(0.05)
+    assert t.latency("us-west-2", "eu-west-1", units=11) == \
+        pytest.approx(0.06)
+    # intra-region pays no bandwidth term
+    assert t.latency("us-west-2", "us-west-2", units=100) == \
+        t.intra_latency
+
+
+def test_partition_full_rate_fails_cross_not_intra():
+    t = topo()
+    t.partition_region("eu-west-1")
+    assert t.partition_decision("us-west-2", "eu-west-1", "m", 1.0)
+    # intra-region traffic unaffected: a partition severs links
+    assert not t.partition_decision("eu-west-1", "eu-west-1", "m", 1.0)
+    # other regions unaffected
+    assert not t.partition_decision("us-west-2", "ap-northeast-1",
+                                    "m", 1.0)
+    t.heal_region("eu-west-1")
+    assert not t.partition_decision("us-west-2", "eu-west-1", "m", 1.0)
+    log = t.decision_log()
+    assert len(log) == 1 and log[0]["source"] == "partition"
+
+
+def test_partition_partial_rate_draws_replay_per_pair():
+    """The determinism contract: two topologies with the same seed
+    produce the same partial-partition decision sequence per pair,
+    and one pair's draws never perturb another's."""
+    a, b = topo(), topo()
+    for t in (a, b):
+        t.partition_region("eu-west-1", rate=0.5)
+    seq_a = [a.partition_decision("us-west-2", "eu-west-1", "m", 0.0)
+             for _ in range(32)]
+    # interleave a sibling pair's draws in b only: must not shift
+    seq_b = []
+    for _ in range(32):
+        b.partition_decision("ap-northeast-1", "eu-west-1", "m", 0.0)
+        seq_b.append(b.partition_decision("us-west-2", "eu-west-1",
+                                          "m", 0.0))
+    assert seq_a == seq_b
+    assert any(seq_a) and not all(seq_a), "rate=0.5 should mix"
+
+
+def test_bindings_and_key_regions():
+    t = topo()
+    t.bind("Z1", "eu-west-1")
+    assert t.region_of("Z1") == "eu-west-1"
+    assert t.region_of("Z-unbound") == t.local_region
+    assert t.bound_region("Z-unbound") is None
+    t.bind_key("default/svc0", "eu-west-1")
+    t.bind_key("default/svc0", "ap-northeast-1")
+    assert t.key_regions("default/svc0") == {"eu-west-1",
+                                             "ap-northeast-1"}
+    assert not t.key_digest_vetoed("default/svc0")
+    # a container outside the topology's coverage VETOES the key's
+    # digest answers (sticky) instead of silently widening the set
+    t.bind_key("default/svc0", "not-a-region")
+    t.bind_key("default/svc1", None)
+    assert t.key_digest_vetoed("default/svc0")
+    assert t.key_digest_vetoed("default/svc1")
+    assert t.key_regions("default/svc0") == {"eu-west-1",
+                                             "ap-northeast-1"}
+    assert t.key_regions("default/other") == set()
+    assert t.containers_in("eu-west-1") == ["Z1"]
+
+
+def test_mutation_profile_accumulates_and_seeds():
+    t = topo()
+    t.note_mutation(2, "eu-west-1", 5)
+    t.note_mutation(2, "us-west-2", 3)
+    t.note_mutation(None, "eu-west-1")      # unrouted: ignored
+    assert t.mutation_profile(2) == {"eu-west-1": 5, "us-west-2": 3}
+    assert t.mutation_profile(0) == {}
+    t.seed_profile({1: {"ap-northeast-1": 7}})
+    assert t.mutation_profile(1) == {"ap-northeast-1": 7}
+    assert t.mutation_profile(2) == {}
+
+
+# ---------------------------------------------------------------------------
+# Weighted rendezvous + churn-bounded assignment
+# ---------------------------------------------------------------------------
+
+def test_unit_weights_byte_identical_to_unweighted_map():
+    """The no-topology contract: an all-1.0 weighted map equals the
+    plain integer-compare rendezvous map for every shard — weighting
+    only ever REORDERS when weights actually differ."""
+    members = ["replica-a", "replica-b", "replica-c"]
+    for s in range(64):
+        assert rendezvous_owner(s, members) == \
+            rendezvous_owner(s, members, weights=lambda _s, _m: 1.0)
+
+
+def test_weighted_rendezvous_shifts_mass_and_stays_minimal():
+    members = ["near", "far"]
+    heavy = lambda s, m: 4.0 if m == "near" else 1.0  # noqa: E731
+    owned_plain = sum(rendezvous_owner(s, members) == "near"
+                      for s in range(200))
+    owned_heavy = sum(rendezvous_owner(s, members, weights=heavy) == "near"
+                      for s in range(200))
+    assert owned_heavy > owned_plain, "weight must attract shards"
+    assert owned_heavy >= 140, "4x weight should win ~4/5 of shards"
+    # minimal disruption survives weighting: every shard 'near' owned
+    # under plain hashing it still owns when its weight only grew
+    for s in range(200):
+        if rendezvous_owner(s, members) == "near":
+            assert rendezvous_owner(s, members, weights=heavy) == "near"
+
+
+def test_assignment_churn_bound_caps_voluntary_moves():
+    members = ["a", "b"]
+    prev = compute_assignment(16, members)
+    # a strong new bias toward b would move many shards at once...
+    bias = lambda s, m: 50.0 if m == "b" else 1.0  # noqa: E731
+    unbounded = compute_assignment(16, members, weights=bias)
+    moves = [s for s in range(16) if unbounded[s] != prev[s]]
+    assert len(moves) > 2, "test premise: the bias moves many shards"
+    # ...but the churn bound lets only max_moves through per pass
+    bounded = compute_assignment(16, members, weights=bias, prev=prev,
+                                 max_moves=2, gain=bias)
+    assert sum(bounded[s] != prev[s] for s in range(16)) == 2
+    # forced moves (dead member) are never capped
+    prev_dead = dict(prev)
+    after_death = compute_assignment(16, ["b"], weights=bias,
+                                     prev=prev_dead, max_moves=0)
+    assert all(owner == "b" for owner in after_death.values())
+
+
+def test_locality_placement_prefers_near_member():
+    t = topo(intra_latency=0.001, cross_latency=0.1)
+    t.seed_profile({s: {"eu-west-1": 100} for s in range(32)})
+    place = LocalityPlacement(
+        t, static_member_regions({"r-eu": "eu-west-1",
+                                  "r-us": "us-west-2"}),
+        alpha=8.0, max_moves=64)
+    assert place.affinity(0, "r-eu") == pytest.approx(1.0)
+    assert place.affinity(0, "r-us") < 0.05
+    assignment = place.assignment(32, ["r-eu", "r-us"])
+    near = sum(owner == "r-eu" for owner in assignment.values())
+    assert near >= 24, f"locality placement won only {near}/32"
+    # no profile -> no opinion -> plain rendezvous behavior
+    t.seed_profile({})
+    place2 = LocalityPlacement(
+        t, static_member_regions({"r-eu": "eu-west-1",
+                                  "r-us": "us-west-2"}))
+    assert place2.assignment(32, ["r-eu", "r-us"]) == \
+        compute_assignment(32, ["r-eu", "r-us"])
+
+
+# ---------------------------------------------------------------------------
+# RegionAggregator: fan-in, demux, fences
+# ---------------------------------------------------------------------------
+
+def _cloud_with_topology(t):
+    cloud = FakeAWSCloud()
+    cloud.set_topology(t)
+    return cloud
+
+
+def _rrs(name):
+    return ResourceRecordSet(name=name, type="A", ttl=300)
+
+
+def test_aggregator_one_wire_call_per_region_across_zones():
+    t = topo(intra_latency=0.0, cross_latency=0.0)
+    cloud = _cloud_with_topology(t)
+    zones = []
+    for i in range(6):
+        region = REGIONS[i % 3]
+        z = cloud.route53.create_hosted_zone(f"z{i}.example.com",
+                                             region=region)
+        zones.append((z.id, region))
+    agg = RegionAggregator(lambda r: cloud, t, linger=0.05)
+    threads = [
+        threading.Thread(target=agg.submit_record_sets, args=(
+            zid, [("CREATE", _rrs(f"a.z{i}.example.com"))]))
+        for i, (zid, _) in enumerate(zones)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=10)
+    calls = cloud.faults.call_counts()
+    # 6 zones in 3 regions -> exactly 3 cross-region wire calls
+    assert calls.get("apply_region_batch") == 3
+    for i, (zid, _) in enumerate(zones):
+        assert len(cloud.route53.list_resource_record_sets(zid)) == 1
+
+
+def test_aggregator_per_entry_demux_poisoned_zone_fails_alone():
+    t = topo(intra_latency=0.0, cross_latency=0.0)
+    cloud = _cloud_with_topology(t)
+    good = cloud.route53.create_hosted_zone("good.example.com",
+                                            region="eu-west-1")
+    agg = RegionAggregator(lambda r: cloud, t, linger=0.05)
+    outcome = {}
+
+    def submit(key, zid, changes):
+        try:
+            agg.submit_record_sets(zid, changes)
+            outcome[key] = None
+        except Exception as e:
+            outcome[key] = e
+
+    threads = [
+        threading.Thread(target=submit, args=(
+            "good", good.id, [("CREATE", _rrs("a.good.example.com"))])),
+        threading.Thread(target=submit, args=(
+            "bad", "Z-NOPE", [("CREATE", _rrs("a.bad.example.com"))])),
+    ]
+    # bind the bogus zone into the same region so both ride one batch
+    t.bind("Z-NOPE", "eu-west-1")
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=10)
+    assert outcome["good"] is None
+    assert isinstance(outcome["bad"], AWSAPIError)
+    assert len(cloud.route53.list_resource_record_sets(good.id)) == 1
+
+
+def test_aggregator_sealed_fence_rejected_tripped_passes():
+    """The PR-8 contract through the aggregation layer: a SEALED
+    shard's contribution gets FencedError (never silently dropped),
+    a TRIPPED (draining) one still flushes."""
+    t = topo(intra_latency=0.0, cross_latency=0.0)
+    cloud = _cloud_with_topology(t)
+    z = cloud.route53.create_hosted_zone("f.example.com",
+                                         region="eu-west-1")
+    agg = RegionAggregator(lambda r: cloud, t, linger=0.01)
+
+    sealed = MutationFence(name="sealed-shard")
+    sealed.seal("handoff")
+    with pytest.raises(FencedError):
+        agg.submit_record_sets(z.id, [("CREATE", _rrs("x.f.example.com"))],
+                               fence=sealed)
+    assert cloud.route53.list_resource_record_sets(z.id) == []
+
+    tripped = MutationFence(name="draining-shard")
+    tripped.trip("ordered stop")
+    agg.submit_record_sets(z.id, [("CREATE", _rrs("y.f.example.com"))],
+                           fence=tripped)
+    assert len(cloud.route53.list_resource_record_sets(z.id)) == 1
+
+
+def test_aggregator_partition_parks_whole_region_cohort():
+    """A region-level failure is every contribution's verdict (the
+    cohort-park demux) — and the partitioned region's own wrapper is
+    the one that saw it, not its siblings'."""
+    t = topo(intra_latency=0.0, cross_latency=0.0)
+    cloud = _cloud_with_topology(t)
+    z = cloud.route53.create_hosted_zone("p.example.com",
+                                         region="eu-west-1")
+    agg = RegionAggregator(lambda r: cloud, t, linger=0.01)
+    t.partition_region("eu-west-1")
+    with pytest.raises(AWSAPIError):
+        agg.submit_record_sets(z.id,
+                               [("CREATE", _rrs("a.p.example.com"))])
+    t.heal_region("eu-west-1")
+    agg.submit_record_sets(z.id, [("CREATE", _rrs("a.p.example.com"))])
+    assert len(cloud.route53.list_resource_record_sets(z.id)) == 1
+
+
+def test_aggregator_endpoint_group_entries_apply():
+    t = topo(intra_latency=0.0, cross_latency=0.0)
+    cloud = _cloud_with_topology(t)
+    acc = cloud.ga.create_accelerator("a", "IPV4", True, {})
+    lst = cloud.ga.create_listener(acc.accelerator_arn, [], "TCP",
+                                   "NONE")
+    eg = cloud.ga.create_endpoint_group(lst.listener_arn, "eu-west-1",
+                                        "arn:lb-1", False)
+    agg = RegionAggregator(lambda r: cloud, t, linger=0.01)
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.types import (  # noqa: E501
+        EndpointDescription,
+    )
+    agg.submit_endpoint_group(
+        eg.endpoint_group_arn,
+        [EndpointDescription(endpoint_id="arn:lb-1", weight=200)],
+        shard_id=3)
+    got = cloud.ga.describe_endpoint_group(eg.endpoint_group_arn)
+    assert [(d.endpoint_id, d.weight)
+            for d in got.endpoint_descriptions] == [("arn:lb-1", 200)]
+    # the placement feed saw the routed mutation
+    assert t.mutation_profile(3) == {"eu-west-1": 1}
+
+
+# ---------------------------------------------------------------------------
+# RegionDigestGate: the earned-clean state machine
+# ---------------------------------------------------------------------------
+
+class _StubGateway:
+    def __init__(self):
+        self.digests = {}
+        self.calls = 0
+
+    def get_region_digest(self, region):
+        self.calls += 1
+        d = self.digests.get(region)
+        if isinstance(d, Exception):
+            raise d
+        return d
+
+
+class _StubApis:
+    def __init__(self, gateway):
+        self.gateway = gateway
+
+
+def test_digest_gate_earns_clean_then_drops_on_drift():
+    t = topo()
+    t.bind_key("default/svc0", "eu-west-1")
+    gw = _StubGateway()
+    gw.digests["eu-west-1"] = "d1"
+    gate = RegionDigestGate(lambda region: _StubApis(gw), t,
+                            stability_waves=3)
+    # WARMING: stable digest, but clean must be EARNED over a full
+    # sweep period — no skips yet
+    assert not gate.allow_skip("default/svc0", 10)
+    assert not gate.allow_skip("default/svc0", 11)
+    assert not gate.allow_skip("default/svc0", 12)
+    # a full stability window has passed under one digest: CLEAN
+    assert gate.allow_skip("default/svc0", 13)
+    assert gate.clean_regions() == ["eu-west-1"]
+    # out-of-band drift flips the digest: baseline drops, sweeps back
+    gw.digests["eu-west-1"] = "d2-drifted"
+    assert not gate.allow_skip("default/svc0", 14)
+    assert gate.clean_regions() == []
+    # ...and must be re-earned over a fresh full period
+    assert not gate.allow_skip("default/svc0", 15)
+    assert not gate.allow_skip("default/svc0", 16)
+    assert gate.allow_skip("default/svc0", 17)
+
+
+def test_digest_gate_one_exchange_per_region_per_wave():
+    t = topo()
+    for i in range(50):
+        t.bind_key(f"default/svc{i}", "eu-west-1")
+    gw = _StubGateway()
+    gw.digests["eu-west-1"] = "d"
+    gate = RegionDigestGate(lambda region: _StubApis(gw), t,
+                            stability_waves=1)
+    for i in range(50):
+        gate.allow_skip(f"default/svc{i}", 7)
+    assert gw.calls == 1, "a wave's keys must share one exchange"
+
+
+def test_digest_gate_failed_exchange_and_unbound_key_always_sweep():
+    t = topo()
+    t.bind_key("default/svc0", "eu-west-1")
+    gw = _StubGateway()
+    gw.digests["eu-west-1"] = "d"
+    gate = RegionDigestGate(lambda region: _StubApis(gw), t,
+                            stability_waves=1)
+    assert not gate.allow_skip("default/svc0", 1)
+    assert gate.allow_skip("default/svc0", 2)
+    # a partitioned region's exchange fails: everything drops
+    gw.digests["eu-west-1"] = AWSAPIError("ServiceUnavailable", "cut",
+                                          retryable=True)
+    assert not gate.allow_skip("default/svc0", 3)
+    gw.digests["eu-west-1"] = "d"
+    assert not gate.allow_skip("default/svc0", 4)   # re-earning
+    assert gate.allow_skip("default/svc0", 5)
+    # an unbound key never skips its sweep
+    assert not gate.allow_skip("default/unknown", 5)
+    # a VETOED key (a container outside digest coverage — e.g. an
+    # unbound zone next to a bound endpoint group) never skips even
+    # while its bound regions are CLEAN
+    t.bind_key("default/svc0", None)
+    assert not gate.allow_skip("default/svc0", 6)
+
+
+def test_fake_gateway_digest_tracks_state():
+    """The fake's rollup changes exactly when region-bound container
+    state changes — including OUT-OF-BAND edits (what makes the gate
+    drift-safe)."""
+    t = topo()
+    cloud = _cloud_with_topology(t)
+    z = cloud.route53.create_hosted_zone("d.example.com",
+                                         region="eu-west-1")
+    d0 = cloud.gateway.get_region_digest("eu-west-1")
+    cloud.route53.change_resource_record_sets(
+        z.id, "CREATE", _rrs("a.d.example.com"))
+    d1 = cloud.gateway.get_region_digest("eu-west-1")
+    assert d0 != d1
+    # out-of-band edit: no API call, no event — but the digest moves
+    cloud.faults.edit_record_set(z.id, "a.d.example.com", "A",
+                                 weight=None, alias_dns_name=None)
+    assert cloud.gateway.get_region_digest("eu-west-1") == d1, \
+        "no-op edit must not move the digest"
+    # an unrelated region's digest is untouched by this zone
+    assert cloud.gateway.get_region_digest("ap-northeast-1") == \
+        cloud.gateway.get_region_digest("ap-northeast-1")
+
+
+def test_aggregator_flush_span_links_member_traces():
+    """The PR-12 contract one level up: a region flush's span joins
+    the first contribution's trace and LINKS every other member
+    (the coalescer flush-span shape), and stamps a region mark into
+    each member context."""
+    from aws_global_accelerator_controller_tpu.tracing import (
+        default_tracer,
+        new_context,
+    )
+
+    t = topo(intra_latency=0.0, cross_latency=0.0)
+    cloud = _cloud_with_topology(t)
+    z1 = cloud.route53.create_hosted_zone("t1.example.com",
+                                          region="eu-west-1")
+    z2 = cloud.route53.create_hosted_zone("t2.example.com",
+                                          region="eu-west-1")
+    agg = RegionAggregator(lambda r: cloud, t, linger=0.05)
+    ctx1 = new_context("event")
+    ctx2 = new_context("event")
+    threads = [
+        threading.Thread(target=agg.submit_record_sets, args=(
+            z1.id, [("CREATE", _rrs("a.t1.example.com"))]),
+            kwargs={"ctxs": (ctx1,)}),
+        threading.Thread(target=agg.submit_record_sets, args=(
+            z2.id, [("CREATE", _rrs("a.t2.example.com"))]),
+            kwargs={"ctxs": (ctx2,)}),
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=10)
+    flushes = [s for s in default_tracer.recent(limit=500)
+               if s["name"] == "region_flush"
+               and s.get("attributes", {}).get("region") == "eu-west-1"
+               and set(s.get("links", ())) >= {ctx1.trace_id,
+                                               ctx2.trace_id}]
+    assert flushes, "no region_flush span linking both member traces"
+    span_id = flushes[-1]["span_id"]
+    for ctx in (ctx1, ctx2):
+        assert any(kind == "region" and sid == span_id
+                   for sid, kind in ctx.marks), \
+            f"trace {ctx.trace_id} missing its region mark"
+
+
+def test_aggregator_sealed_process_fence_fails_fast_not_loops():
+    """A SEALED process fence on the region's wrapper with fence-less
+    contributions must answer every waiter with the FencedError — the
+    re-partition loop must not spin when no contribution fence can
+    absorb the rejection."""
+    from aws_global_accelerator_controller_tpu.resilience import (
+        ResilientAPIs,
+    )
+    from aws_global_accelerator_controller_tpu.resilience.wrapper import (
+        FAKE_CLOUD_CONFIG,
+    )
+
+    t = topo(intra_latency=0.0, cross_latency=0.0)
+    cloud = _cloud_with_topology(t)
+    z = cloud.route53.create_hosted_zone("pf.example.com",
+                                         region="eu-west-1")
+    wrapped = ResilientAPIs(cloud, region="eu-west-1",
+                            config=FAKE_CLOUD_CONFIG)
+    process = MutationFence()
+    process.seal("stopping")
+    wrapped.fence = process
+    agg = RegionAggregator(lambda r: wrapped, t, linger=0.01)
+    with pytest.raises(FencedError):
+        agg.submit_record_sets(
+            z.id, [("CREATE", _rrs("a.pf.example.com"))])
+    assert cloud.route53.list_resource_record_sets(z.id) == []
+
+
+def test_aggregator_per_entry_transient_retried_in_flush():
+    """A retryable fault hitting ONE entry inside the gateway's local
+    fan-out is absorbed by the aggregator's bounded in-flush retry —
+    the flat path absorbed it in the wrapper's retry policy, so the
+    aggregated path must not surface it to the coalescer's demux as a
+    terminal rejection."""
+    t = topo(intra_latency=0.0, cross_latency=0.0)
+    cloud = _cloud_with_topology(t)
+    z = cloud.route53.create_hosted_zone("rt.example.com",
+                                         region="eu-west-1")
+    agg = RegionAggregator(lambda r: cloud, t, linger=0.001)
+    cloud.faults.fail_on(
+        "change_resource_record_sets_batch",
+        AWSAPIError("InternalError", "chaos: transient",
+                    retryable=True))
+    agg.submit_record_sets(z.id, [("CREATE", _rrs("a.rt.example.com"))])
+    assert len(cloud.route53.list_resource_record_sets(z.id)) == 1
+    # the retry is BOUNDED: a persistent transient becomes the answer
+    cloud.faults.fail_on(
+        "change_resource_record_sets_batch",
+        AWSAPIError("InternalError", "chaos: persistent",
+                    retryable=True), times=20)
+    with pytest.raises(AWSAPIError):
+        agg.submit_record_sets(z.id,
+                               [("CREATE", _rrs("b.rt.example.com"))])
