@@ -1,7 +1,7 @@
 # Developer entry points (analogue of the reference Makefile:16-24).
 
 .PHONY: test manifests check-manifests bench benchdoc graft-dryrun lint \
-	tier1-diff
+	tier1-diff fuzz-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -12,6 +12,14 @@ test:
 # any newly-failing test (docs/operations.md "Tier-1 workflow")
 tier1-diff:
 	bash hack/tier1_diff.sh
+
+# fuzzed-scenario determinism smoke (ISSUE 15): record one seeded
+# adaptive scenario, then replay it from the seed alone in a FRESH
+# subprocess and diff the convergence ledgers — exit 1 on divergence
+# (hack/fuzz_replay.py also replays any recorded bench_artifacts/fuzz/
+# artifact directly)
+fuzz-smoke:
+	env JAX_PLATFORMS=cpu python hack/fuzz_replay.py --selftest
 
 manifests:
 	python -m aws_global_accelerator_controller_tpu.codegen
@@ -37,7 +45,7 @@ graft-dryrun:
 # package is installable in the build environment); compileall stays as
 # the pure syntax gate for files lint.py does not cover.  --all runs
 # BOTH passes: base rules L001-L007 and the concurrency contract rules
-# L101-L116 (docs/static-analysis.md)
+# L101-L117 (docs/static-analysis.md)
 lint:
 	python -m compileall -q aws_global_accelerator_controller_tpu tests
 	python hack/lint.py --all

@@ -1,4 +1,4 @@
-"""AST-based concurrency contract lints (rules L101-L116).
+"""AST-based concurrency contract lints (rules L101-L117).
 
 The static half of the concurrency checker: a whole-program pass over
 the tree that enforces the synchronization contracts PR 1 introduced as
@@ -189,6 +189,23 @@ segment looks lock-ish (``lock``/``_lock``/``*_lock``/``cond``/
                          real.py) are the waiver-listed boundary;
                          ``# race: <reason>`` waives a deliberate
                          wall-clock read.
+  L117 registry-owned knobs (ISSUE 15)
+                         Scheduling constants the TunableRegistry
+                         owns (autotune/knobs.py catalog: coalescer
+                         linger/warm_gap, sweep_every, the queue
+                         watermarks and aging horizon,
+                         breaker_window, digest exchange_every) must
+                         not be re-hardcoded as numeric literals in
+                         the clock-owned packages — a fresh literal
+                         forks "the default" away from the one the
+                         feedback controllers' snap-to-default freeze
+                         restores.  Flags keyword arguments,
+                         signature defaults and assignments whose
+                         target name is (or suffixes as) a catalog
+                         parameter name with a numeric literal value;
+                         the ``autotune/`` package (the owner) is
+                         exempt; ``# race: <reason>`` waives a
+                         deliberate divergence (test profiles).
 """
 from __future__ import annotations
 
@@ -397,10 +414,26 @@ def _consults_aggregator(fn: ast.AST) -> bool:
 # them are the simulation boundary and stay on the wall clock.
 _L115_DIRS = {"kube", "resilience", "cloudprovider", "leaderelection",
               "reconcile", "rollout", "controller", "manager",
-              "sharding", "topology"}
+              "sharding", "topology", "autotune"}
 _L115_FILES = {"tracing.py", "flight.py", "metrics.py"}
 _L115_EXEMPT_FILES = {"http_store.py", "rest_server.py",
                       "kubeconfig.py", "tlsutil.py", "real.py"}
+
+
+def _l117_in_scope(path: Path) -> bool:
+    """L117 covers the same clock-owned packages as L115 — the knob
+    CONSUMERS — while the autotune package itself (the catalog that
+    OWNS the numeric spellings, and the registry that moves them) is
+    exempt: re-hardcoding is only meaningful outside the owner."""
+    parts = path.parts
+    if "lint_fixtures" in parts:
+        return path.name.startswith("l117_")
+    if "aws_global_accelerator_controller_tpu" in parts:
+        i = parts.index("aws_global_accelerator_controller_tpu")
+        rel = parts[i + 1:]
+        if rel and rel[0] == "autotune":
+            return False
+    return _l115_in_scope(path)
 
 
 def _l115_in_scope(path: Path) -> bool:
@@ -710,6 +743,7 @@ class Engine:
                 self._check_shared_views(info, fn)
             self._check_compat_shim(info)
             self._check_columnar_purity(info)
+            self._check_knob_literals(info)
         self._check_ordering_graph()
         self._check_wrapper_fence_gate()
         self._check_sharded_submit_gate()
@@ -991,6 +1025,101 @@ class Engine:
                         f"per fleet size) — express it as array ops "
                         f"over the packed [G, E] grids, or move the "
                         f"loop to host-side pack/decode"))
+
+    def _check_knob_literals(self, info: _FileInfo) -> None:
+        """Rule L117: knobs owned by the TunableRegistry
+        (autotune/knobs.py catalog) must not be re-hardcoded as
+        numeric literals in the clock-owned packages.  The feedback
+        controllers steer the LIVE values and the snap-to-default
+        freeze restores the catalog's; a fresh literal spelling of a
+        registered parameter name forks "the default" away from the
+        registry's and silently escapes both.  Flagged shapes (for
+        any catalog parameter name — ``linger``, ``sweep_every``,
+        ``aging_horizon``, ``depth_watermark``, ``age_watermark``,
+        ``warm_gap``, ``breaker_window``, ``exchange_every``):
+
+        - keyword arguments: ``CoalesceConfig(linger=0.005)``;
+        - signature defaults: ``def __init__(self, linger=0.005)``
+          (dataclass field defaults parse as the next shape);
+        - assignments whose target NAME is, or suffixes as, a
+          parameter name: ``linger = 0.005``, ``self.linger = 0.005``,
+          ``DEFAULT_AGING_HORIZON = 2.0`` (annotated or not).
+
+        Import the catalog constant instead
+        (``knobs.COALESCER_LINGER``); a deliberate divergent literal
+        is waived with '# race: <reason>'."""
+        if not _l117_in_scope(info.path):
+            return
+        from ..autotune.knobs import PARAM_NAMES
+
+        def numeric(node) -> bool:
+            return (isinstance(node, ast.Constant)
+                    and isinstance(node.value, (int, float))
+                    and not isinstance(node.value, bool))
+
+        def matched_param(name: str):
+            low = name.lower()
+            for p in PARAM_NAMES:
+                if low == p or low.endswith("_" + p):
+                    return p
+            return None
+
+        def flag(line: int, what: str, param: str) -> None:
+            self.findings.append(Finding(
+                info.path, line, "L117",
+                f"re-hardcoded knob {what}: '{param}' is owned by "
+                f"the TunableRegistry (autotune/knobs.py) — import "
+                f"its catalog constant so the feedback controllers' "
+                f"snap-to-default provably restores it, or waive a "
+                f"deliberate divergence with '# race: <reason>'"))
+
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg and numeric(kw.value):
+                        p = matched_param(kw.arg)
+                        if p is not None:
+                            flag(kw.value.lineno,
+                                 f"keyword '{kw.arg}="
+                                 f"{kw.value.value}'", p)
+            elif isinstance(node, _FUNCS):
+                a = node.args
+                pos = a.posonlyargs + a.args
+                for arg, default in zip(pos[len(pos)
+                                            - len(a.defaults):],
+                                        a.defaults):
+                    if numeric(default):
+                        p = matched_param(arg.arg)
+                        if p is not None:
+                            flag(default.lineno,
+                                 f"signature default '{arg.arg}="
+                                 f"{default.value}'", p)
+                for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+                    if default is not None and numeric(default):
+                        p = matched_param(arg.arg)
+                        if p is not None:
+                            flag(default.lineno,
+                                 f"signature default '{arg.arg}="
+                                 f"{default.value}'", p)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                value = node.value
+                if value is None or not numeric(value):
+                    continue
+                for tgt in targets:
+                    name = (tgt.id if isinstance(tgt, ast.Name)
+                            else tgt.attr
+                            if isinstance(tgt, ast.Attribute)
+                            else None)
+                    if name is None:
+                        continue
+                    p = matched_param(name)
+                    if p is not None:
+                        flag(node.lineno,
+                             f"assignment '{name} = {value.value}'",
+                             p)
 
     def _check_ordering_graph(self) -> None:
         seen: Set[Tuple[str, str]] = set()

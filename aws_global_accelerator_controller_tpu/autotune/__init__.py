@@ -1,0 +1,28 @@
+"""Self-tuning control plane (ISSUE 15): feedback controllers that
+steer the live scheduling knobs from the signals the system already
+exports, owned by a :class:`TunableRegistry` whose snap-to-default
+freeze makes a lying signal's worst case the static plane.
+
+Layering: ``knobs`` (the catalog — canonical defaults + bounds, the
+one home of the numeric literals L117 polices) → ``targets`` (weak
+registries the knob-owning subsystems self-register into) →
+``registry`` (the clamped, freezable write path onto the targets) →
+``controllers`` (AIMD + bounded hill-climb laws) → ``engine`` (the
+per-manager tick loop wiring signals to policies).
+"""
+from . import knobs
+from .controllers import AIMDController, HillClimbController
+from .engine import AutotuneConfig, AutotuneEngine
+from .registry import TunableRegistry
+from .signals import SignalReader, SignalSnapshot
+
+__all__ = [
+    "AIMDController",
+    "AutotuneConfig",
+    "AutotuneEngine",
+    "HillClimbController",
+    "SignalReader",
+    "SignalSnapshot",
+    "TunableRegistry",
+    "knobs",
+]

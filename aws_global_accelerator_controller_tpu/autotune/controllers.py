@@ -1,0 +1,243 @@
+"""Per-knob feedback control laws: AIMD and bounded hill-climb
+(ISSUE 15).
+
+Both laws share the safety scaffolding the registry cannot provide on
+its own:
+
+- **hysteresis**: a deadband around "no pressure" where the controller
+  holds, so signal noise never saw-tooths a knob;
+- **cooldown**: a minimum interval between applied moves, so one tick's
+  transient cannot slew a knob across its whole range;
+- **decay**: with no pressure for long enough, the knob relaxes back
+  toward its default — an adaptation earned under a storm is not
+  carried into the quiet that follows it.
+
+``AIMDController`` is the AdaptiveTokenBucket law generalized (the
+in-tree precedent, resilience/breaker.py): multiplicative move on
+pressure in the knob's "responsive" direction, additive (or decaying)
+recovery.  TCP's argument applies unchanged — many independent signals
+steering one shared resource converge without coordinating when
+backoff is multiplicative.
+
+``HillClimbController`` is for knobs with a measurable OBJECTIVE
+rather than a directional pressure (the coalescer linger: fold
+efficiency rises with linger until cohorts saturate, then flattens
+while latency keeps paying): bounded steps, direction reversal when
+the objective worsens, and the same deadband/cooldown scaffolding.
+Every proposal goes through the registry, which clamps to the catalog
+bounds and refuses moves on pinned/frozen knobs.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from .registry import TunableRegistry
+from .signals import SignalSnapshot
+
+logger = logging.getLogger(__name__)
+
+# pressure verdicts a sense function may return
+RAISE = "raise"
+LOWER = "lower"
+HOLD = "hold"
+
+
+class AIMDController:
+    """Additive-increase/multiplicative-decrease (or the mirrored
+    shape) on one knob.
+
+    ``sense(snapshot) -> RAISE | LOWER | HOLD`` maps this tick's
+    signals to pressure.  RAISE multiplies by ``up_factor`` (the
+    responsive direction — for a knob like sweep.every whose
+    "responsive" move is DOWN, pass up_factor < 1 and the decay takes
+    it back up); LOWER multiplies by ``down_factor``; HOLD counts
+    toward the decay: after ``decay_after`` consecutive holds the
+    value relaxes halfway back to its default each cooldown.
+    """
+
+    def __init__(self, registry: TunableRegistry, knob: str,
+                 sense: Callable[[SignalSnapshot], str],
+                 up_factor: float = 1.5, down_factor: float = 0.5,
+                 cooldown: float = 2.0, decay_after: int = 10,
+                 decay_rate: float = 0.5):
+        self.registry = registry
+        self.knob = knob
+        self.sense = sense
+        self.up_factor = up_factor
+        self.down_factor = down_factor
+        self.cooldown = cooldown
+        self.decay_after = decay_after
+        self.decay_rate = decay_rate
+        self._last_move = float("-inf")
+        self._holds = 0
+
+    def update(self, snap: SignalSnapshot) -> Optional[str]:
+        """One tick; returns the applied direction ("up"/"down") or
+        None.  The registry clamps and may refuse (pin/freeze)."""
+        if snap.now - self._last_move < self.cooldown:
+            return None
+        verdict = self.sense(snap)
+        current = self.registry.current(self.knob)
+        if verdict == HOLD:
+            self._holds += 1
+            if self._holds >= self.decay_after:
+                default = self.registry.default(self.knob)
+                if current == default:
+                    return None
+                target = current + (default - current) * self.decay_rate
+                # close enough: land exactly on the default so the
+                # decay terminates instead of asymptoting forever
+                if abs(target - default) <= 0.05 * abs(default):
+                    target = default
+                applied = self.registry.set(
+                    self.knob, target,
+                    direction="down" if target < current else "up")
+                if applied != current:
+                    self._last_move = snap.now
+                    return "down" if applied < current else "up"
+            return None
+        self._holds = 0
+        factor = self.up_factor if verdict == RAISE else self.down_factor
+        target = current * factor
+        if factor > 1.0 and current == 0:
+            target = self.registry.default(self.knob)
+        applied = self.registry.set(
+            self.knob, target,
+            direction="up" if target > current else "down")
+        if applied != current:
+            self._last_move = snap.now
+            return "up" if applied > current else "down"
+        return None
+
+
+class HillClimbController:
+    """Bounded hill-climb maximizing a RATIO objective.
+
+    ``objective(snapshot)`` returns ``(numerator, denominator)`` for
+    this tick, or None when nothing flowed.  Samples ACCUMULATE
+    between moves and each decision uses the volume-weighted ratio
+    over its whole window — a single tick's phase noise (a cohort
+    enqueued this tick, flushed the next) must not steer the climb.
+
+    Keeps the last applied step's direction; a windowed worsening
+    beyond the deadband reverses, otherwise the climb keeps exploring
+    the same direction (a plateau is not a stop — the objective often
+    cannot move until the knob travels further).  Steps are
+    multiplicative (``step_factor``) and clamped by the registry, so
+    the climb is bounded by the catalog range at every move.
+    ``guard(snapshot) -> bool`` vetoes climbing entirely (retreat
+    toward the default); ``explore_up_at`` marks the response curve's
+    known-monotone region (see __init__).
+    """
+
+    def __init__(self, registry: TunableRegistry, knob: str,
+                 objective: Callable[[SignalSnapshot],
+                                     Optional[float]],
+                 step_factor: float = 1.5, cooldown: float = 2.0,
+                 deadband: float = 0.05,
+                 guard: Optional[Callable[[SignalSnapshot], bool]]
+                 = None,
+                 decay_after: int = 10, decay_rate: float = 0.5,
+                 explore_up_at: Optional[float] = None):
+        self.registry = registry
+        self.knob = knob
+        self.objective = objective
+        self.step_factor = step_factor
+        self.cooldown = cooldown
+        self.deadband = deadband
+        self.guard = guard
+        self.decay_after = decay_after
+        self.decay_rate = decay_rate
+        # response-curve floor hint: at or below this objective value
+        # the climb direction is KNOWN to be up (e.g. fold efficiency
+        # pinned at 1 means no folding at all — only a longer linger
+        # can start it; exploring down there is a random walk to the
+        # bound).  None disables the hint.
+        self.explore_up_at = explore_up_at
+        self._direction = 1          # +1 = raising, -1 = lowering
+        self._best: Optional[float] = None
+        self._idle = 0
+        self._last_move = float("-inf")
+        self._window_num = 0.0
+        self._window_den = 0.0
+
+    def _decay(self, now: float, current: float) -> Optional[str]:
+        default = self.registry.default(self.knob)
+        self._best = None
+        if current == default:
+            return None
+        target = current + (default - current) * self.decay_rate
+        if abs(target - default) <= 0.05 * abs(default):
+            target = default
+        applied = self.registry.set(
+            self.knob, target,
+            direction="down" if target < current else "up")
+        if applied != current:
+            self._last_move = now
+            return "down" if applied < current else "up"
+        return None
+
+    def update(self, snap: SignalSnapshot) -> Optional[str]:
+        sample = self.objective(snap)
+        if sample is not None:
+            self._window_num += sample[0]
+            self._window_den += sample[1]
+            self._idle = 0
+        else:
+            self._idle += 1
+        if snap.now - self._last_move < self.cooldown:
+            return None
+        current = self.registry.current(self.knob)
+        if self.guard is not None and not self.guard(snap):
+            # vetoed: retreat toward the default and restart the climb
+            self._window_num = self._window_den = 0.0
+            self._best = None
+            self._direction = 1
+            default = self.registry.default(self.knob)
+            if current == default:
+                return None
+            applied = self.registry.set(
+                self.knob, current + (default - current) * 0.5,
+                direction="down" if default < current else "up")
+            if applied != current:
+                self._last_move = snap.now
+                return "down" if applied < current else "up"
+            return None
+        if self._window_den <= 0.0:
+            # nothing flowed since the last move: after enough idle
+            # ticks the knob relaxes toward its default (decay leg)
+            if self._idle >= self.decay_after:
+                return self._decay(snap.now, current)
+            return None
+        measured = self._window_num / self._window_den
+        self._window_num = self._window_den = 0.0
+        if self._best is not None:
+            rel = (measured - self._best) / max(abs(self._best), 1e-9)
+            # hysteresis guards the REVERSAL only: a windowed
+            # worsening beyond the deadband turns the climb around,
+            # while a plateau keeps exploring in the same direction —
+            # holding on plateaus would wedge the climb exactly where
+            # the objective cannot improve until the knob moves
+            # further
+            if rel < -self.deadband:
+                self._direction = -self._direction   # worse: reverse
+        if (self.explore_up_at is not None
+                and measured <= self.explore_up_at):
+            # the known-monotone region: fold efficiency this far
+            # under target cannot be improved by a SHORTER linger —
+            # exploring down here is a random walk to the bound
+            self._direction = 1
+        self._best = measured
+        factor = (self.step_factor if self._direction > 0
+                  else 1.0 / self.step_factor)
+        applied = self.registry.set(
+            self.knob, current * factor,
+            direction="up" if factor > 1.0 else "down")
+        if applied != current:
+            self._last_move = snap.now
+            return "up" if applied > current else "down"
+        # clamped at a bound: flip so the next measured window probes
+        # back into the range instead of pushing the wall forever
+        self._direction = -self._direction
+        return None

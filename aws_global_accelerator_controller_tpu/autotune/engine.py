@@ -1,0 +1,281 @@
+"""The autotune engine: one background loop closing the
+observability→scheduling feedback circle (ISSUE 15).
+
+Each tick (clock-aware — virtual seconds under simulation) the engine
+samples the signal reader, and either:
+
+- **freezes**: any anomaly in the snapshot (non-finite value,
+  regressed counter, implausible delta, stalled stream) snaps EVERY
+  knob to its default and holds through the cooldown
+  (``autotune_frozen_total{knob,reason}``).  A lying signal's worst
+  case is the static plane — the chaos e2e's contract; or
+- **steers**: runs every knob policy against the snapshot.  The
+  policies map the signals the system already exports to the knob
+  catalog:
+
+  =====================  ==============================================
+  knob                   policy (controllers.py law)
+  =====================  ==============================================
+  coalescer.linger       hill-climb on fold efficiency
+                         (enqueued/flushes) while mutation traffic
+                         flows, vetoed (retreat to default) when
+                         interactive p99 breaches the budget — the
+                         NCCL shape: pick the bandwidth protocol only
+                         while the message flow justifies it
+  coalescer.warm_gap     follows linger (one wave-detection constant)
+  sweep.every            AIMD: observed drift repairs halve the period
+                         (detect faster while drift is live); quiet
+                         windows decay it back to the default
+  queue.depth_watermark  AIMD: sheds while interactive p99 is healthy
+                         raise the watermark (shedding was premature);
+                         p99 breach with a deep backlog lowers it
+  queue.age_watermark    same pressure pair, age-flavored
+  queue.aging_horizon    p99 breach raises it (protect interactive);
+                         starved background (p99 >> horizon) lowers it
+  breaker.window         AIMD: breaker flapping (many transitions per
+                         window) lengthens the window
+  digest.exchange_every  AIMD: drift snaps it to 1 (exchange every
+                         wave); sustained quiet stretches the cadence
+  =====================  ==============================================
+
+Every applied move is logged to a bounded decision log (virtual
+timestamps) — the determinism suite replays it byte-identically, and
+the adaptive-soak bench records the per-knob trajectory from the
+registry into reconcile_history.jsonl.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..simulation import clock as simclock
+from .controllers import (
+    AIMDController,
+    HOLD,
+    HillClimbController,
+    LOWER,
+    RAISE,
+)
+from .registry import TunableRegistry
+from .signals import SignalReader, SignalSnapshot
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class AutotuneConfig:
+    """Engine opt-in + envelope.  Disabled by default: a plane without
+    an engine is exactly the static plane (tests and benches that do
+    not opt in see byte-identical behavior)."""
+
+    enabled: bool = False
+    # seconds between signal samples (virtual under simulation)
+    interval: float = 1.0
+    # interactive p99 budget: the latency the tuner must not trade
+    # away for batching/fairness wins (the PR-7 SLO's order)
+    p99_budget: float = 0.5
+    # mutation intents per tick below which the write path reads idle
+    min_activity: float = 8.0
+    # seconds a freeze holds the knobs at default
+    freeze_cooldown: float = 30.0
+    # operator pins: knob name -> fixed value (never moved)
+    pins: Dict[str, float] = field(default_factory=dict)
+    # registry default overrides (the plane's actual static config —
+    # the assembling manager seeds these from the factory/controller
+    # configs so snap-to-default restores exactly the static plane)
+    defaults: Dict[str, float] = field(default_factory=dict)
+
+
+class AutotuneEngine:
+    """Builds the registry + policies and runs the tick loop."""
+
+    def __init__(self, config: AutotuneConfig,
+                 reader: Optional[SignalReader] = None,
+                 registry: Optional[TunableRegistry] = None):
+        self.config = config
+        self.reader = reader or SignalReader()
+        self.registry = registry or TunableRegistry(
+            defaults=config.defaults, pins=config.pins,
+            freeze_cooldown=config.freeze_cooldown)
+        self._decisions: deque = deque(maxlen=4096)
+        self._thread: Optional[threading.Thread] = None
+        self._policies = self._build_policies()
+
+    # -- policies --------------------------------------------------------
+
+    def _build_policies(self) -> List:
+        cfg = self.config
+        reg = self.registry
+
+        def fold_efficiency(s: SignalSnapshot):
+            # (intents, wire calls) this tick — the controller windows
+            # the volume-weighted ratio (intents per call = the
+            # batching win the linger buys); None while the write
+            # path is idle
+            if s.delta("enqueued") < cfg.min_activity:
+                return None
+            return (s.delta("enqueued"),
+                    max(1.0, s.delta("flushes")))
+
+        def p99_healthy(s: SignalSnapshot) -> bool:
+            return (s.interactive_p99 is None
+                    or s.interactive_p99 <= cfg.p99_budget)
+
+        def linger_earning(s: SignalSnapshot) -> bool:
+            # the climb's veto: breached interactive p99 while the
+            # write path is near-idle means the linger is taxing lone
+            # urgent changes without buying any batching — retreat.
+            # During a saturating storm (bulk intents flowing) the
+            # latency is the storm's, and SHRINKING the linger would
+            # only multiply wire calls and make it worse.
+            if p99_healthy(s):
+                return True
+            return s.delta("enqueued") >= cfg.min_activity
+
+
+        def sweep_pressure(s: SignalSnapshot) -> str:
+            return RAISE if s.delta("drift_repairs") > 0 else HOLD
+
+        def depth_pressure(s: SignalSnapshot) -> str:
+            if s.delta("sheds") > 0 and p99_healthy(s):
+                return RAISE      # shedding while latency is fine
+            if (not p99_healthy(s)
+                    and s.queue_depth
+                    > 0.5 * reg.current("queue.depth_watermark")):
+                return LOWER      # shed earlier: latency is drowning
+            return HOLD
+
+        def age_pressure(s: SignalSnapshot) -> str:
+            if s.delta("sheds") > 0 and p99_healthy(s):
+                return RAISE
+            if (not p99_healthy(s) and s.queue_oldest_age
+                    > 0.5 * reg.current("queue.age_watermark")):
+                return LOWER
+            return HOLD
+
+        def aging_pressure(s: SignalSnapshot) -> str:
+            if not p99_healthy(s):
+                return RAISE      # protect interactive: age slower
+            horizon = reg.current("queue.aging_horizon")
+            if (s.background_p99 is not None
+                    and s.background_p99 > 5.0 * horizon):
+                return LOWER      # background starved far past bound
+            return HOLD
+
+        def breaker_pressure(s: SignalSnapshot) -> str:
+            # >= 4 transitions per tick = open/close flapping: a
+            # longer window steadies the verdict
+            return (RAISE if s.delta("breaker_transitions") >= 4
+                    else HOLD)
+
+        def digest_pressure(s: SignalSnapshot) -> str:
+            if s.delta("drift_repairs") > 0:
+                return LOWER      # drift is live: exchange every wave
+            if s.delta("digest_exchanges") > 0:
+                return RAISE      # exchanges flowing, all quiet:
+            return HOLD           # stretch the cadence
+
+        return [
+            HillClimbController(
+                reg, "coalescer.linger", fold_efficiency,
+                step_factor=1.6, cooldown=2 * cfg.interval,
+                guard=linger_earning, explore_up_at=3.0),
+            # sweep.every's responsive direction is DOWN (sweep more
+            # often while drift flows); the decay drifts it back up.
+            # The decay horizon must EXCEED the sensing loop's own
+            # latency — repairs arrive at most once per sweep period,
+            # so a decay faster than the period un-tunes the knob
+            # between the very confirmations that keep it tuned
+            AIMDController(
+                reg, "sweep.every", sweep_pressure, up_factor=0.5,
+                cooldown=4 * cfg.interval, decay_after=60),
+            AIMDController(
+                reg, "queue.depth_watermark", depth_pressure,
+                up_factor=1.5, down_factor=0.66,
+                cooldown=2 * cfg.interval),
+            AIMDController(
+                reg, "queue.age_watermark", age_pressure,
+                up_factor=1.5, down_factor=0.66,
+                cooldown=2 * cfg.interval),
+            AIMDController(
+                reg, "queue.aging_horizon", aging_pressure,
+                up_factor=1.5, down_factor=0.66,
+                cooldown=2 * cfg.interval),
+            AIMDController(
+                reg, "breaker.window", breaker_pressure,
+                up_factor=1.5, cooldown=4 * cfg.interval,
+                decay_after=10),
+            AIMDController(
+                reg, "digest.exchange_every", digest_pressure,
+                up_factor=2.0, down_factor=0.0,   # LOWER = snap to lo
+                cooldown=4 * cfg.interval, decay_after=20),
+        ]
+
+    # -- the loop --------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> SignalSnapshot:
+        """One control step (public for tests and the replay tool)."""
+        now = simclock.monotonic() if now is None else now
+        snap = self.reader.sample(now)
+        if snap.anomalies:
+            reason = snap.anomalies[0].split(":", 1)[0]
+            self.registry.freeze_all(
+                reason, cooldown=self.config.freeze_cooldown)
+            self._decisions.append({
+                "t": round(now, 6), "action": "freeze",
+                "reason": sorted(set(snap.anomalies))})
+            return snap
+        for policy in self._policies:
+            applied = policy.update(snap)
+            if applied is not None:
+                self._decisions.append({
+                    "t": round(now, 6), "action": "adjust",
+                    "knob": policy.knob, "direction": applied,
+                    "value": self.registry.current(policy.knob)})
+        # warm_gap is COUPLED to linger, not independently steered:
+        # both encode "gaps this small mean a bulk wave", and a linger
+        # the warm-gap test keeps cutting short is a dead knob (the
+        # interactive urgency path flushes immediately unless the
+        # group reads warm — batcher.py deadline-aware linger)
+        linger = self.registry.current("coalescer.linger")
+        gap = self.registry.current("coalescer.warm_gap")
+        if gap != linger:
+            applied_gap = self.registry.set(
+                "coalescer.warm_gap", linger,
+                direction="up" if linger > gap else "down")
+            if applied_gap != gap:
+                self._decisions.append({
+                    "t": round(now, 6), "action": "adjust",
+                    "knob": "coalescer.warm_gap",
+                    "direction": "up" if applied_gap > gap
+                    else "down",
+                    "value": applied_gap})
+        return snap
+
+    def decision_log(self) -> List[dict]:
+        """Bounded, ordered move/freeze log (virtual timestamps) — the
+        determinism suite's evidence and a flight-recorder source."""
+        return list(self._decisions)
+
+    def start_background(self, stop: threading.Event) -> threading.Thread:
+        """Run the tick loop until ``stop``; knobs snap back to their
+        defaults on exit (a stopped engine leaves the static plane)."""
+
+        def loop():
+            while not stop.is_set():
+                simclock.sleep(self.config.interval)
+                if stop.is_set():
+                    break
+                try:
+                    self.tick()
+                except Exception:
+                    logger.exception("autotune tick failed; freezing")
+                    self.registry.freeze_all("tick-error")
+            self.registry.reset()
+
+        self._thread = simclock.start_thread(
+            loop, daemon=True, name="autotune-engine")
+        return self._thread

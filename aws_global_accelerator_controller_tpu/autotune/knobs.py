@@ -1,0 +1,143 @@
+"""The knob catalog: every feedback-tunable control-plane constant,
+with its canonical default, bounds and the parameter name it travels
+under (ISSUE 15).
+
+This module is the ONE place the control plane's scheduling constants
+are spelled as numeric literals.  Every consumer — the write
+coalescer's linger (cloudprovider/aws/batcher.py), the drift sweep
+period (reconcile/fingerprint.py), the workqueue watermarks and aging
+horizon (kube/workqueue.py), the circuit-breaker window
+(resilience/wrapper.py), the digest exchange cadence
+(topology/digest.py), the CLI flag defaults (cmd/root.py) — imports
+its default from here, so "the default" means the same number on every
+layer and the feedback controllers' snap-to-default freeze
+(autotune/registry.py) provably restores the exact static
+configuration.  Lint rule L117 (analysis/concurrency_lint.py) enforces
+the ownership: a numeric literal re-hardcoding one of these parameter
+names inside a clock-owned package is a finding.
+
+The catalog is data, not behavior: registries (autotune/registry.py)
+copy it, engines (autotune/engine.py) read bounds from it, and the
+lint rule reads :data:`PARAM_NAMES` from it.  Nothing here imports the
+subsystems that consume the knobs (no cycles).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# canonical defaults (the ONLY numeric spellings; everything imports these)
+# ---------------------------------------------------------------------------
+
+# write coalescer (cloudprovider/aws/batcher.py CoalesceConfig): the
+# leader's size-or-deadline linger and the warm-gap that shields
+# interactive urgency from killing a bulk wave's batching
+COALESCER_LINGER = 0.005
+COALESCER_WARM_GAP = COALESCER_LINGER  # warm_gap=None defaults to linger
+# the fake factory's profile: a shorter linger keeps single-writer unit
+# tests sub-millisecond-ish while storms still coalesce across workers
+FAKE_COALESCER_LINGER = 0.002
+
+# tiered drift sweep (reconcile/fingerprint.py FingerprintConfig): one
+# gate-bypassing deep verify per key per this many resync waves
+SWEEP_EVERY = 10
+
+# priority-tiered workqueue (kube/workqueue.py): anti-starvation aging
+# horizon + the overload-shed watermarks
+QUEUE_AGING_HORIZON = 2.0
+QUEUE_DEPTH_WATERMARK = 512
+QUEUE_AGE_WATERMARK = 1.0
+
+# per-region circuit breaker (resilience/wrapper.py ResilienceConfig):
+# the failure-rate observation window
+BREAKER_WINDOW = 30.0
+# the fake factory's 100x-speed profile window (wrapper.py
+# FAKE_CLOUD_CONFIG)
+FAKE_BREAKER_WINDOW = 5.0
+
+# multi-region digest gate (topology/digest.py RegionDigestGate): one
+# digest exchange per region per this many wave advances (1 = every
+# wave, the pre-knob behavior; higher trades drift-detection lag for
+# fewer cross-region reads)
+DIGEST_EXCHANGE_EVERY = 1
+
+
+@dataclass(frozen=True)
+class KnobSpec:
+    """One tunable's contract: the registry clamps every adjustment to
+    ``[lo, hi]``, snaps to ``default`` on freeze, and rounds to an int
+    when ``integer``.  ``param`` is the keyword/attribute name the knob
+    travels under in consumer signatures — what lint rule L117 matches
+    numeric re-hardcodings against."""
+
+    name: str
+    param: str
+    default: float
+    lo: float
+    hi: float
+    integer: bool = False
+    description: str = ""
+
+    def clamp(self, value: float) -> float:
+        value = min(self.hi, max(self.lo, value))
+        return float(round(value)) if self.integer else value
+
+
+KNOBS: Dict[str, KnobSpec] = {
+    spec.name: spec for spec in (
+        KnobSpec(
+            "coalescer.linger", "linger", COALESCER_LINGER,
+            lo=0.0005, hi=0.25,
+            description="write-coalescer flush linger seconds"),
+        KnobSpec(
+            "coalescer.warm_gap", "warm_gap", COALESCER_WARM_GAP,
+            lo=0.0005, hi=0.25,
+            description="inter-arrival gap that reads as a bulk wave"),
+        KnobSpec(
+            "sweep.every", "sweep_every", SWEEP_EVERY,
+            lo=2, hi=50, integer=True,
+            description="resync waves between per-key deep verifies"),
+        KnobSpec(
+            "queue.aging_horizon", "aging_horizon",
+            QUEUE_AGING_HORIZON, lo=0.25, hi=20.0,
+            description="background anti-starvation horizon seconds"),
+        KnobSpec(
+            "queue.depth_watermark", "depth_watermark",
+            QUEUE_DEPTH_WATERMARK, lo=64, hi=16384, integer=True,
+            description="backlog depth that sheds background work"),
+        KnobSpec(
+            "queue.age_watermark", "age_watermark",
+            QUEUE_AGE_WATERMARK, lo=0.1, hi=15.0,
+            description="oldest-interactive age that sheds background"),
+        KnobSpec(
+            "breaker.window", "breaker_window", BREAKER_WINDOW,
+            lo=1.0, hi=120.0,
+            description="circuit-breaker failure-rate window seconds"),
+        KnobSpec(
+            "digest.exchange_every", "exchange_every",
+            DIGEST_EXCHANGE_EVERY, lo=1, hi=10, integer=True,
+            description="wave advances between region digest exchanges"),
+    )
+}
+
+# the parameter names L117 polices: a numeric literal bound to one of
+# these (keyword argument, signature default, assignment target suffix)
+# inside a clock-owned package re-hardcodes a registry-owned knob
+PARAM_NAMES = frozenset(spec.param for spec in KNOBS.values())
+
+
+def spec_for_param(param: str) -> Optional[KnobSpec]:
+    for spec in KNOBS.values():
+        if spec.param == param:
+            return spec
+    return None
+
+
+def default_values() -> Dict[str, float]:
+    return {name: spec.default for name, spec in KNOBS.items()}
+
+
+def bounds(name: str) -> Tuple[float, float]:
+    spec = KNOBS[name]
+    return spec.lo, spec.hi

@@ -1,0 +1,246 @@
+"""TunableRegistry: the owner of every feedback-tunable knob's bounds,
+default, current value and pin/freeze state (ISSUE 15).
+
+The registry is the ONLY write path onto the live knobs: controllers
+(autotune/controllers.py) propose moves, the registry clamps them to
+the catalog bounds (autotune/knobs.py), quantizes integer knobs,
+rejects moves on pinned or frozen knobs, pushes the new value onto the
+live targets (autotune/targets.py appliers) and the
+``autotune_knob_value{knob}`` gauge, and counts every applied move in
+``autotune_adjustments_total{knob,direction}``.
+
+Freeze semantics (the lying-signal safety contract): ``freeze(name,
+reason)`` snaps the knob back to its DEFAULT — which the assembling
+manager seeds from the plane's actual static configuration (the fake
+profile's 2ms linger, a CLI override), so a frozen plane is provably
+the static plane — and holds it there for a cooldown during which
+every adjustment is rejected.  ``freeze_all`` is what the engine fires
+when the signal stream itself is anomalous: a corrupted, stalled or
+regressing signal can never wedge the plane, because the worst the
+tuner can then do is exactly nothing.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, replace as dc_replace
+from typing import Callable, Dict, List, Optional
+
+from .. import metrics
+from ..simulation import clock as simclock
+from . import knobs as knobcat
+from . import targets
+
+logger = logging.getLogger(__name__)
+
+# seconds a frozen knob refuses adjustments before the controller may
+# resume (virtual seconds under simulation)
+DEFAULT_FREEZE_COOLDOWN = 30.0
+
+
+@dataclass
+class Tunable:
+    """One knob's live state inside a registry."""
+
+    spec: knobcat.KnobSpec
+    default: float
+    value: float
+    pinned: bool = False
+    frozen_until: float = 0.0
+    freeze_reason: str = ""
+    adjustments: int = 0
+
+
+# ---------------------------------------------------------------------------
+# appliers: knob name -> push the value onto every live target
+# ---------------------------------------------------------------------------
+
+def _apply_linger(value: float) -> None:
+    for c in targets.coalescers():
+        c.config = dc_replace(c.config, linger=value)
+
+
+def _apply_warm_gap(value: float) -> None:
+    for c in targets.coalescers():
+        c.config = dc_replace(c.config, warm_gap=value)
+
+
+def _apply_sweep_every(value: float) -> None:
+    for cache in targets.fingerprint_caches():
+        cache.set_sweep_every(int(value))
+
+
+def _apply_queue_attr(attr: str, value: float) -> None:
+    for q in targets.queues():
+        setter = getattr(q, "set_scheduling", None)
+        if setter is not None:
+            setter(**{attr: value})
+        else:
+            setattr(q, attr, value)
+
+
+def _apply_breaker_window(value: float) -> None:
+    for b in targets.breakers():
+        b.set_window(value)
+
+
+def _apply_exchange_every(value: float) -> None:
+    for g in targets.digest_gates():
+        g.set_exchange_every(int(value))
+
+
+_APPLIERS: Dict[str, Callable[[float], None]] = {
+    "coalescer.linger": _apply_linger,
+    "coalescer.warm_gap": _apply_warm_gap,
+    "sweep.every": _apply_sweep_every,
+    "queue.aging_horizon":
+        lambda v: _apply_queue_attr("aging_horizon", v),
+    "queue.depth_watermark":
+        lambda v: _apply_queue_attr("depth_watermark", int(v)),
+    "queue.age_watermark":
+        lambda v: _apply_queue_attr("age_watermark", v),
+    "breaker.window": _apply_breaker_window,
+    "digest.exchange_every": _apply_exchange_every,
+}
+
+
+class TunableRegistry:
+    """Owns the knob states; see the module docstring for the write
+    contract.  ``defaults`` overrides catalog defaults per knob so the
+    registry mirrors the plane it governs (the fake profile's shorter
+    linger, CLI-overridden watermarks): snap-to-default then means
+    "exactly the static configuration", not "the catalog's idea of
+    it".  ``pins`` are operator-fixed values applied immediately and
+    never moved (the CLI's per-knob pin flags)."""
+
+    def __init__(self,
+                 defaults: Optional[Dict[str, float]] = None,
+                 pins: Optional[Dict[str, float]] = None,
+                 clock: Callable[[], float] = simclock.monotonic,
+                 freeze_cooldown: float = DEFAULT_FREEZE_COOLDOWN):
+        self._clock = clock
+        self._freeze_cooldown = freeze_cooldown
+        self._lock = threading.Lock()
+        self._knobs: Dict[str, Tunable] = {}
+        for name, spec in knobcat.KNOBS.items():
+            default = spec.clamp((defaults or {}).get(name,
+                                                      spec.default))
+            self._knobs[name] = Tunable(spec=spec, default=default,
+                                        value=default)
+        for name, value in (pins or {}).items():
+            self.pin(name, value)
+        self._publish_all()
+
+    # -- reads -----------------------------------------------------------
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._knobs)
+
+    def current(self, name: str) -> float:
+        with self._lock:
+            return self._knobs[name].value
+
+    def default(self, name: str) -> float:
+        with self._lock:
+            return self._knobs[name].default
+
+    def is_frozen(self, name: str) -> bool:
+        with self._lock:
+            t = self._knobs[name]
+            return t.pinned or self._clock() < t.frozen_until
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {name: t.value for name, t in self._knobs.items()}
+
+    def trajectory(self) -> Dict[str, dict]:
+        """Per-knob {initial, final, adjustments, frozen_reason} — what
+        the adaptive-soak bench records into reconcile_history.jsonl so
+        future readers see what the tuner actually did."""
+        with self._lock:
+            return {name: {"initial": t.default, "final": t.value,
+                           "adjustments": t.adjustments,
+                           **({"frozen": t.freeze_reason}
+                              if t.freeze_reason else {})}
+                    for name, t in self._knobs.items()}
+
+    # -- writes ----------------------------------------------------------
+
+    def set(self, name: str, value: float,
+            direction: Optional[str] = None) -> float:
+        """Move ``name`` to ``value`` (clamped, quantized); returns the
+        value in force afterwards.  A pinned or frozen knob refuses the
+        move (current value returned).  ``direction`` ("up"/"down")
+        labels the adjustment counter when the value actually moved."""
+        with self._lock:
+            t = self._knobs[name]
+            if t.pinned or self._clock() < t.frozen_until:
+                return t.value
+            new = t.spec.clamp(value)
+            if new == t.value:
+                return t.value
+            t.value = new
+            t.adjustments += 1
+        _APPLIERS[name](new)
+        metrics.record_knob_value(name, new)
+        if direction is not None:
+            metrics.record_knob_adjustment(name, direction)
+        return new
+
+    def pin(self, name: str, value: float) -> float:
+        """Operator override: fix ``name`` at ``value`` (clamped) and
+        refuse every controller move for the registry's lifetime."""
+        with self._lock:
+            t = self._knobs[name]
+            new = t.spec.clamp(value)
+            t.value = new
+            t.pinned = True
+        _APPLIERS[name](new)
+        metrics.record_knob_value(name, new)
+        return new
+
+    def freeze(self, name: str, reason: str,
+               cooldown: Optional[float] = None) -> None:
+        """Snap ``name`` back to its default and refuse adjustments for
+        the cooldown (pins are already stronger — left alone)."""
+        with self._lock:
+            t = self._knobs[name]
+            if t.pinned:
+                return
+            t.frozen_until = self._clock() + (
+                self._freeze_cooldown if cooldown is None else cooldown)
+            t.freeze_reason = reason
+            moved = t.value != t.default
+            t.value = t.default
+        if moved:
+            _APPLIERS[name](t.default)
+        metrics.record_knob_value(name, t.default)
+        metrics.record_knob_freeze(name, reason)
+
+    def freeze_all(self, reason: str,
+                   cooldown: Optional[float] = None) -> None:
+        """The anomalous-signal response: every knob snaps to default
+        and holds — the plane becomes exactly its static self."""
+        for name in self.names():
+            self.freeze(name, reason, cooldown=cooldown)
+        logger.warning("autotune: all knobs frozen to defaults (%s)",
+                       reason)
+
+    def reset(self) -> None:
+        """Re-apply every knob's default and clear freeze state (bench
+        A/B legs restore the plane between arms; pins survive)."""
+        for name in self.names():
+            with self._lock:
+                t = self._knobs[name]
+                if t.pinned:
+                    continue
+                t.value = t.default
+                t.frozen_until = 0.0
+                t.freeze_reason = ""
+            _APPLIERS[name](t.default)
+        self._publish_all()
+
+    def _publish_all(self) -> None:
+        for name, value in self.snapshot().items():
+            metrics.record_knob_value(name, value)

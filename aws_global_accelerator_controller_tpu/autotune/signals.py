@@ -1,0 +1,236 @@
+"""Signal sampling for the feedback controllers (ISSUE 15).
+
+The tuner reads ONLY signals the system already exports through the
+metrics registry — the same numbers an operator sees on /metrics:
+reconcile-latency histograms per class, the coalescer's
+enqueued/flush/fold counters, shed counters, drift-repair and
+sweep-verify counters, breaker transitions, queue depth/age gauges,
+and the convergence ledger's stage attribution (which names the
+dominant pipeline stage, i.e. which knob family is the bottleneck).
+Sampling is delta-based: each :meth:`SignalReader.sample` reports the
+movement since the previous tick.
+
+Trust boundary: a production signal pipeline can LIE — a scrape
+glitch, a wedged exporter, a clock step — and a feedback loop that
+believes garbage will drive the knobs somewhere pathological and stay
+there.  Every snapshot therefore carries an ``anomalies`` list, filled
+when a counter runs backwards, a value is NaN/inf/negative, a delta is
+physically implausible for one tick, or the stream has STALLED (no
+counter movement across several ticks while the queues demonstrably
+hold work).  The engine's response to any anomaly is the freeze
+(registry.freeze_all): snap to defaults, hold, re-sample — the chaos
+e2e proves a FaultInjector-corrupted stream leaves throughput within
+noise of the static plane.
+
+The ``corrupt`` hook is that chaos surface: the fake cloud's
+FaultInjector (cloudprovider/aws/fake.py ``set_signal_corruption``)
+deterministically garbles sampled values on their way into the
+snapshot, exactly like its API-call fault schedule — seeded, logged,
+replayable.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .. import metrics
+
+# one tick's counter delta above this is a lie, not a workload (the
+# busiest measured storms move thousands per second, not billions)
+IMPLAUSIBLE_DELTA = 1e9
+# latencies above this are a lie on any plane this code runs (an hour)
+IMPLAUSIBLE_SECONDS = 3600.0
+# ticks with zero movement anywhere while queues hold work = stalled
+STALL_TICKS = 5
+
+_COUNTERS = {
+    "enqueued": "provider_mutations_enqueued_total",
+    "flushes": "provider_mutation_flushes_total",
+    "folds": "provider_mutation_folds_total",
+    "sheds": "sheds_total",
+    "drift_repairs": "drift_repairs_total",
+    "sweep_verifies": "drift_sweep_verifies_total",
+    "fastpath_skips": "reconcile_fastpath_skips_total",
+    "breaker_transitions": "circuit_transitions_total",
+    "digest_exchanges": "region_digest_exchanges_total",
+    "syncs": "controller_sync_total",
+}
+
+
+@dataclass
+class SignalSnapshot:
+    """One tick's view of the plane.  Deltas are since the previous
+    sample; latencies are windowed p99 estimates from the histogram
+    bucket deltas (None = nothing converged this window)."""
+
+    now: float = 0.0
+    deltas: Dict[str, float] = field(default_factory=dict)
+    interactive_p99: Optional[float] = None
+    background_p99: Optional[float] = None
+    queue_depth: float = 0.0
+    queue_oldest_age: float = 0.0
+    dominant_stage: Optional[str] = None
+    anomalies: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.anomalies
+
+    def delta(self, name: str) -> float:
+        return self.deltas.get(name, 0.0)
+
+
+def _finite(value: float) -> bool:
+    return isinstance(value, (int, float)) and math.isfinite(value)
+
+
+def _p99_from_hist(series) -> Optional[float]:
+    """p99 estimate over summed bucket-count deltas: the upper bound of
+    the first bucket whose cumulative share crosses 0.99 (histogram
+    percentile the Prometheus way — coarse, monotone, good enough for
+    a controller that only needs direction)."""
+    total = sum(n for _, n in series)
+    if total <= 0:
+        return None
+    finite = [le for le, _ in series if math.isfinite(le)]
+    top = finite[-1] if finite else 0.0
+    rank = 0.99 * total
+    cum = 0
+    for le, n in series:
+        cum += n
+        if cum >= rank:
+            # a crossing in the overflow bucket reports the top finite
+            # bound: "at least this" is direction enough for control
+            return le if math.isfinite(le) else top
+    return top
+
+
+class SignalReader:
+    """Delta-sampling reader over a metrics registry.
+
+    ``corrupt(name, value) -> value`` is the chaos hook — identity when
+    unset; the engine treats whatever comes back as the observed
+    truth, which is exactly the point: the VALIDATION downstream, not
+    the sampling, is what keeps a lying stream from wedging the plane.
+    """
+
+    def __init__(self,
+                 registry: Optional[metrics.Registry] = None,
+                 corrupt: Optional[Callable[[str, float], float]]
+                 = None):
+        self._registry = registry or metrics.default_registry
+        self._corrupt = corrupt
+        self._prev_counters: Dict[str, float] = {}
+        self._prev_hist: Dict[str, List] = {}
+        self._stalled_ticks = 0
+        self._primed = False
+
+    def set_corrupt(self, corrupt) -> None:
+        self._corrupt = corrupt
+
+    # -- raw reads -------------------------------------------------------
+
+    def _read(self, name: str, value: float,
+              snap: SignalSnapshot) -> float:
+        if self._corrupt is not None:
+            value = self._corrupt(name, value)
+        if not _finite(value):
+            snap.anomalies.append(f"non-finite:{name}")
+            return 0.0
+        return value
+
+    def _latency_window(self, klass: str, snap: SignalSnapshot
+                        ) -> Optional[float]:
+        """p99 of this tick's reconcile_latency_seconds observations
+        for ``klass`` (bucket deltas summed over controllers)."""
+        buckets: Dict[float, int] = {}
+        for labels, series in self._registry.histogram_series(
+                "reconcile_latency_seconds").items():
+            if dict(labels).get("class") != klass:
+                continue
+            prev = dict(self._prev_hist.get(
+                ("reconcile_latency_seconds",) + labels, []))
+            for le, n in series:
+                d = n - prev.get(le, 0)
+                if d < 0:
+                    snap.anomalies.append(
+                        f"regressed:latency[{klass}]")
+                    d = 0
+                buckets[le] = buckets.get(le, 0) + d
+            self._prev_hist[("reconcile_latency_seconds",) + labels] \
+                = series
+        p99 = _p99_from_hist(sorted(buckets.items()))
+        if p99 is None:
+            return None
+        p99 = self._read(f"latency_p99.{klass}", p99, snap)
+        if p99 < 0 or p99 > IMPLAUSIBLE_SECONDS:
+            snap.anomalies.append(f"implausible:latency[{klass}]")
+            return None
+        return p99
+
+    # -- the sample ------------------------------------------------------
+
+    def sample(self, now: float) -> SignalSnapshot:
+        snap = SignalSnapshot(now=now)
+        reg = self._registry
+        for key, metric in _COUNTERS.items():
+            raw = self._read(key, reg.counter_value(metric), snap)
+            prev = self._prev_counters.get(key)
+            self._prev_counters[key] = raw
+            if prev is None:
+                continue
+            d = raw - prev
+            if d < 0:
+                snap.anomalies.append(f"regressed:{key}")
+                d = 0.0
+            if d > IMPLAUSIBLE_DELTA:
+                snap.anomalies.append(f"implausible:{key}")
+                d = 0.0
+            snap.deltas[key] = d
+        snap.interactive_p99 = self._latency_window("interactive", snap)
+        snap.background_p99 = self._latency_window("background", snap)
+        depth = self._read("queue_depth",
+                           reg.sample_gauges("workqueue_depth",
+                                             skip_label="tier"), snap)
+        age = self._read(
+            "queue_oldest_age",
+            reg.sample_gauges("workqueue_oldest_age_seconds",
+                              max_over=True), snap)
+        if depth < 0 or depth > IMPLAUSIBLE_DELTA:
+            snap.anomalies.append("implausible:queue_depth")
+            depth = 0.0
+        if age < 0 or age > IMPLAUSIBLE_SECONDS:
+            snap.anomalies.append("implausible:queue_oldest_age")
+            age = 0.0
+        snap.queue_depth = depth
+        snap.queue_oldest_age = age
+        snap.dominant_stage = self._dominant_stage()
+
+        # stall detection: queues hold work but no counter moves —
+        # the exporter (or the plane) is wedged; the tuner must not
+        # keep steering on a frozen photograph
+        if self._primed:
+            moving = any(d > 0 for d in snap.deltas.values())
+            if not moving and depth > 0:
+                self._stalled_ticks += 1
+                if self._stalled_ticks >= STALL_TICKS:
+                    snap.anomalies.append("stalled:signals")
+            else:
+                self._stalled_ticks = 0
+        self._primed = True
+        return snap
+
+    def _dominant_stage(self) -> Optional[str]:
+        """The pipeline stage carrying the most cumulative seconds in
+        stage_seconds (the PR-12 ledger attribution): names which knob
+        family bounds the p99 — 'coalesced' points at the linger,
+        'queued' at the scheduler knobs, 'inflight' at the wire."""
+        sums: Dict[str, float] = {}
+        for labels, (s, _c) in self._registry.histogram_sums(
+                "stage_seconds").items():
+            stage = dict(labels).get("stage", "")
+            sums[stage] = sums.get(stage, 0.0) + s
+        if not sums:
+            return None
+        return max(sums.items(), key=lambda kv: kv[1])[0]

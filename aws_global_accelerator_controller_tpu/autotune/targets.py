@@ -1,0 +1,92 @@
+"""Live tuning targets: weak registries the knob-owning subsystems
+self-register into at construction (ISSUE 15).
+
+The knobs live scattered across objects built at different times by
+different layers — workqueues inside controllers, coalescer cohorts
+inside the factory's sharded write path, breakers inside each region's
+resilient wrapper, the digest gate on the factory.  Rather than thread
+a registry handle through every constructor, each subsystem notes
+itself here (one line at its construction chokepoint) and the
+:class:`~.registry.TunableRegistry` appliers iterate whatever is LIVE
+when a knob moves.  WeakSets keep tuning from pinning dead clusters:
+a shut-down test cluster's queues vanish from the apply surface with
+their last strong reference.
+
+Scope note (documented in ARCHITECTURE.md): the apply surface is
+process-wide — every live object of a kind, whichever control plane
+built it.  One AutotuneEngine runs per manager and engines are
+opt-in, so planes without an engine never have their knobs moved; two
+ENGINES in one process would fight over shared targets and is
+unsupported (the multi-replica shape is separate OS processes, the
+bench-worker precedent).
+
+Import discipline: this module imports nothing from the knob-owning
+packages (they import it), so registration can never cycle.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import List
+
+_lock = threading.Lock()
+_queues: "weakref.WeakSet" = weakref.WeakSet()
+_coalescers: "weakref.WeakSet" = weakref.WeakSet()
+_breakers: "weakref.WeakSet" = weakref.WeakSet()
+_digest_gates: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def note_queue(queue) -> None:
+    """A rate-limiting workqueue was built (kube/workqueue.py
+    ``new_rate_limiting_queue`` — both implementations)."""
+    with _lock:
+        _queues.add(queue)
+
+
+def note_coalescer(coalescer) -> None:
+    """A write-coalescer cohort was built (cloudprovider/aws/batcher.py
+    ``MutationCoalescer``)."""
+    with _lock:
+        _coalescers.add(coalescer)
+
+
+def note_breaker(breaker) -> None:
+    """A per-region circuit breaker was built (resilience/breaker.py)."""
+    with _lock:
+        _breakers.add(breaker)
+
+
+def note_digest_gate(gate) -> None:
+    """A region digest gate was built (topology/digest.py)."""
+    with _lock:
+        _digest_gates.add(gate)
+
+
+def queues() -> List:
+    with _lock:
+        return list(_queues)
+
+
+def coalescers() -> List:
+    with _lock:
+        return list(_coalescers)
+
+
+def breakers() -> List:
+    with _lock:
+        return list(_breakers)
+
+
+def digest_gates() -> List:
+    with _lock:
+        return list(_digest_gates)
+
+
+def fingerprint_caches() -> List:
+    """The fingerprint gates' own live-cache registry
+    (reconcile/fingerprint.py keeps it for circuit invalidation) —
+    read lazily so importing this module never pulls reconcile/."""
+    from ..reconcile import fingerprint
+
+    with fingerprint._caches_lock:
+        return list(fingerprint._caches)

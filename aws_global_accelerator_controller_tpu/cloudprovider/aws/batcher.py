@@ -69,6 +69,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 from contextlib import nullcontext
 
 from ...analysis import locks
+from ...autotune import knobs as knobcat
+from ...autotune import targets as tune_targets
 from ...simulation import clock as simclock
 from ...errors import retry_after_hint
 from ...resilience import (
@@ -107,7 +109,8 @@ class CoalesceConfig:
     # size trigger: drain as soon as this many intents wait
     max_batch: int = 64
     # deadline trigger: seconds the leader lingers for cohort intents
-    linger: float = 0.005
+    # (default owned by the knob catalog — autotune/knobs.py, L117)
+    linger: float = knobcat.COALESCER_LINGER
     # deadline-aware linger: a cohort with an INTERACTIVE waiter skips
     # the linger UNLESS the group is "warm" — intents arriving within
     # ``warm_gap`` of each other mean a bulk wave is in flight and
@@ -123,7 +126,8 @@ class CoalesceConfig:
 
 # the fake factory's profile: a shorter linger keeps single-writer unit
 # tests sub-millisecond-ish while storms still coalesce across workers
-FAKE_COALESCE_CONFIG = CoalesceConfig(linger=0.002)
+FAKE_COALESCE_CONFIG = CoalesceConfig(
+    linger=knobcat.FAKE_COALESCER_LINGER)
 
 
 @dataclass(frozen=True)
@@ -402,6 +406,10 @@ class MutationCoalescer:
         # rejected at submit; lingering leaders flush immediately (the
         # drain); sealed = flushes rejected too (fail-fast)
         self._fence = fence
+        # feedback-tunable target: the autotune registry re-points
+        # self.config (a frozen dataclass, swapped atomically — every
+        # linger read below takes the config in force at that instant)
+        tune_targets.note_coalescer(self)
 
     def set_fence(self, fence) -> None:
         self._fence = fence

@@ -128,6 +128,18 @@ class CloudFactory:
         if event == "acquired":
             self._discovery_state.cold_start()
 
+    @property
+    def coalesce_config(self) -> CoalesceConfig:
+        """The plane's static write-coalescing profile — what the
+        autotune registry seeds its defaults (and so its freeze
+        target) from (manager/manager.py _start_autotune)."""
+        return self._coalesce
+
+    @property
+    def resilience_config(self) -> ResilienceConfig:
+        """The plane's static resilience profile (same consumer)."""
+        return self._resilience
+
     def drain_mutations(self, timeout: float) -> bool:
         """Flush (or, past ``timeout``, fail-fast) every pending
         coalescer cohort — shutdown phase 2; True = drained cleanly.

@@ -178,6 +178,13 @@ class FaultInjector:
         # and partition failures per call — None (the default) is the
         # flat pre-topology cloud, byte-identical
         self.topology = None
+        # signal-stream corruption (ISSUE 15): rate at which sampled
+        # autotune signals are garbled on their way into the engine's
+        # snapshot, with a per-signal-name call index riding its own
+        # seeded decision stream (salt "signal" — arming it never
+        # perturbs the API fault schedule)
+        self._signal_rate = 0.0
+        self._signal_calls: Dict[str, int] = {}
         # bounded decision log: every injected fault, in order — the
         # flight recorder (flight.py) freezes this next to the span
         # ring so a dump correlates "what went wrong" with "what the
@@ -266,6 +273,49 @@ class FaultInjector:
                 self._zone_rate = (
                     rate_per_s,
                     burst if burst is not None else max(1.0, rate_per_s))
+
+    # -- signal corruption (ISSUE 15) -----------------------------------
+
+    def set_signal_corruption(self, rate: float) -> None:
+        """Chaos: garble the autotune signal stream — each sampled
+        signal value is replaced with deterministic garbage (NaN, a
+        negative, an impossibly huge number) at probability ``rate``,
+        drawn from its own seeded per-(signal-name, sample-index)
+        stream.  Models a lying exporter / scrape glitch: the
+        feedback engine must FREEZE to defaults, never steer on it
+        (autotune/signals.py).  0 clears."""
+        with self._lock:
+            self._signal_rate = max(0.0, rate)
+
+    # the garbage menu: one non-finite, one negative, one implausibly
+    # huge — each trips a different validation rule in the reader
+    _SIGNAL_GARBAGE = (float("nan"), -1.0, 1e12)
+
+    def corrupt_signal(self, name: str, value: float) -> float:
+        """The autotune SignalReader's chaos hook (identity while
+        corruption is disarmed; indexes advance only while armed, so
+        an unarmed run consumes nothing)."""
+        with self._lock:
+            if self._signal_rate <= 0.0:
+                return value
+            index = self._signal_calls.get(name, 0)
+            self._signal_calls[name] = index + 1
+            if not self._decide(f"signal:{name}", index,
+                                self._signal_rate, salt="signal"):
+                return value
+            pick = self._SIGNAL_GARBAGE[
+                zlib.crc32(f"{self._seed}:signalpick:{name}:{index}"
+                           .encode()) % len(self._SIGNAL_GARBAGE)]
+            self._injected[f"signal:{name}"] = \
+                self._injected.get(f"signal:{name}", 0) + 1
+            self._decisions.append({
+                "t": round(self._clock(), 6),
+                "method": f"signal:{name}",
+                "index": index,
+                "source": "signal",
+                "code": repr(pick),
+            })
+        return pick
 
     # -- region topology (ISSUE 14) -------------------------------------
 

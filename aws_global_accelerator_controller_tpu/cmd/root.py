@@ -21,6 +21,7 @@ import sys
 
 from . import compute
 from .. import BUILD, REVISION, VERSION
+from ..autotune import knobs as knobcat
 from ..cloudprovider.aws.factory import BotoCloudFactory, FakeCloudFactory
 from ..controller.endpointgroupbinding import EndpointGroupBindingConfig
 from ..controller.globalaccelerator import GlobalAcceleratorConfig
@@ -126,37 +127,71 @@ def build_parser() -> argparse.ArgumentParser:
                                  "re-delivery takes a full provider-"
                                  "verifying sync (the pre-gate "
                                  "behavior; A/B escape hatch).")
-    controller.add_argument("--drift-sweep-every", type=int, default=10,
+    controller.add_argument("--drift-sweep-every", type=int,
+                            default=knobcat.SWEEP_EVERY,
                             metavar="WAVES",
                             help="Deep-verify each object against AWS "
                                  "once per this many resync periods "
                                  "(the tiered drift sweep that "
                                  "catches out-of-band mutation; "
-                                 "default 10). 0 disables the sweep.")
+                                 "default %(default)s). 0 disables "
+                                 "the sweep.")
     controller.add_argument("--queue-aging-horizon", type=float,
-                            default=2.0, metavar="SECONDS",
+                            default=knobcat.QUEUE_AGING_HORIZON,
+                            metavar="SECONDS",
                             help="Anti-starvation horizon of the "
                                  "priority-tiered workqueues: a "
                                  "background (resync/sweep) item's "
                                  "effective priority reaches a fresh "
                                  "interactive item's after waiting "
-                                 "this long (default 2.0; <=0 = "
-                                 "strict interactive-first).")
+                                 "this long (default %(default)s; "
+                                 "<=0 = strict interactive-first).")
     controller.add_argument("--queue-depth-watermark", type=int,
-                            default=512, metavar="N",
+                            default=knobcat.QUEUE_DEPTH_WATERMARK,
+                            metavar="N",
                             help="Overload shed trigger: with more "
                                  "than N items backlogged on a queue, "
                                  "background resync/sweep enqueues "
                                  "are dropped (re-delivered by the "
                                  "next wave; sheds_total counts "
-                                 "them). 0 disables (default 512).")
+                                 "them). 0 disables (default "
+                                 "%(default)s).")
     controller.add_argument("--queue-age-watermark", type=float,
-                            default=1.0, metavar="SECONDS",
+                            default=knobcat.QUEUE_AGE_WATERMARK,
+                            metavar="SECONDS",
                             help="Overload shed trigger: when the "
                                  "oldest INTERACTIVE item has waited "
                                  "this long, background enqueues are "
                                  "shed first. 0 disables (default "
-                                 "1.0).")
+                                 "%(default)s).")
+    autotune_group = controller.add_mutually_exclusive_group()
+    autotune_group.add_argument(
+        "--autotune", dest="autotune", action="store_true",
+        default=True,
+        help="Run the self-tuning control loops (default): feedback "
+             "controllers steer the scheduling knobs — coalescer "
+             "linger, drift-sweep period, queue watermarks, breaker "
+             "window, digest cadence — from the exported signals, "
+             "snapping to defaults on anomalous signals (autotune/).")
+    autotune_group.add_argument(
+        "--no-autotune", dest="autotune", action="store_false",
+        help="Freeze every knob at its configured default (the "
+             "static plane; the runbook's first move when a "
+             "controller misbehaves — docs/operations.md).")
+    controller.add_argument("--autotune-interval", type=float,
+                            default=1.0, metavar="SECONDS",
+                            help="Seconds between autotune signal "
+                                 "samples (default %(default)s).")
+    controller.add_argument("--autotune-pin", action="append",
+                            default=[], metavar="KNOB=VALUE",
+                            help="Pin one knob to a fixed value the "
+                                 "controllers never move (repeatable; "
+                                 "e.g. --autotune-pin "
+                                 "coalescer.linger=0.01).  Knob names "
+                                 "are the autotune catalog's "
+                                 "(autotune/knobs.py; "
+                                 "autotune_knob_value{knob} on "
+                                 "/metrics).")
     controller.add_argument("--regions", default="",
                             help="Comma-separated region list arming "
                                  "the multi-region topology layer "
@@ -310,16 +345,46 @@ def run_controller(args) -> int:
     from ..reconcile.fingerprint import FingerprintConfig
     fingerprints = FingerprintConfig(
         enabled=not getattr(args, "no_fingerprints", False),
-        sweep_every=max(0, getattr(args, "drift_sweep_every", 10)))
+        sweep_every=max(0, getattr(args, "drift_sweep_every",
+                                   knobcat.SWEEP_EVERY)))
     # overload scheduler knobs, shared by every controller queue
     # (kube/workqueue.py priority tiers; docs/operations.md runbook)
     scheduler = dict(
-        aging_horizon=getattr(args, "queue_aging_horizon", 2.0),
-        depth_watermark=max(0, getattr(args, "queue_depth_watermark",
-                                       512)),
-        age_watermark=max(0.0, getattr(args, "queue_age_watermark",
-                                       1.0)))
+        aging_horizon=getattr(args, "queue_aging_horizon",
+                              knobcat.QUEUE_AGING_HORIZON),
+        depth_watermark=max(0, getattr(
+            args, "queue_depth_watermark",
+            knobcat.QUEUE_DEPTH_WATERMARK)),
+        age_watermark=max(0.0, getattr(
+            args, "queue_age_watermark",
+            knobcat.QUEUE_AGE_WATERMARK)))
+    # self-tuning control loops (autotune/): on by default, frozen to
+    # the static plane with --no-autotune, per-knob pins parsed here
+    # so a typo'd knob name aborts startup instead of being ignored
+    from ..autotune import AutotuneConfig
+    pins = {}
+    for spec_arg in getattr(args, "autotune_pin", []):
+        knob, sep, raw = spec_arg.partition("=")
+        if not sep:
+            raise SystemExit(
+                f"--autotune-pin wants KNOB=VALUE, got {spec_arg!r}")
+        if knob not in knobcat.KNOBS:
+            raise SystemExit(
+                f"--autotune-pin: unknown knob {knob!r} "
+                f"(known: {', '.join(sorted(knobcat.KNOBS))})")
+        try:
+            pins[knob] = float(raw)
+        except ValueError:
+            raise SystemExit(
+                f"--autotune-pin {knob}: {raw!r} is not a number")
+    autotune_interval = getattr(args, "autotune_interval", 1.0)
+    if autotune_interval <= 0:
+        raise SystemExit("--autotune-interval must be > 0")
+    autotune_cfg = AutotuneConfig(
+        enabled=getattr(args, "autotune", True),
+        interval=autotune_interval, pins=pins)
     config = ControllerConfig(
+        autotune=autotune_cfg,
         global_accelerator=GlobalAcceleratorConfig(
             workers=args.workers, cluster_name=args.cluster_name,
             fingerprints=fingerprints, **scheduler),

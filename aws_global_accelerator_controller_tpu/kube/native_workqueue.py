@@ -25,6 +25,7 @@ import ctypes
 import threading
 from typing import Any, Optional, Tuple
 
+from ..autotune import knobs as knobcat
 from ..simulation import clock as simclock
 from ..analysis import locks
 from ..native import ensure_library
@@ -124,10 +125,13 @@ def load() -> Optional[ctypes.CDLL]:
         lib.aga_wq_tier_oldest_age.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.aga_wq_waiting_len.restype = ctypes.c_int
         lib.aga_wq_waiting_len.argtypes = [ctypes.c_void_p]
+        lib.aga_wq_set_aging.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_double]
         lib.aga_wq_shutdown.argtypes = [ctypes.c_void_p]
         lib.aga_wq_shutting_down.restype = ctypes.c_int
         lib.aga_wq_shutting_down.argtypes = [ctypes.c_void_p]
         fast.aga_wq_add2.argtypes = lib.aga_wq_add2.argtypes
+        fast.aga_wq_set_aging.argtypes = lib.aga_wq_set_aging.argtypes
         fast.aga_wq_done.argtypes = lib.aga_wq_done.argtypes
         fast.aga_wq_forget.argtypes = lib.aga_wq_forget.argtypes
         fast.aga_wq_remove.restype = ctypes.c_int
@@ -169,9 +173,9 @@ class NativeRateLimitingQueue:
 
     def __init__(self, name: str = "", qps: float = 10.0, burst: int = 100,
                  base_delay: float = 0.005, max_delay: float = 1000.0,
-                 aging_horizon: float = 2.0,
-                 depth_watermark: int = 512,
-                 age_watermark: float = 1.0):
+                 aging_horizon: float = knobcat.QUEUE_AGING_HORIZON,
+                 depth_watermark: int = knobcat.QUEUE_DEPTH_WATERMARK,
+                 age_watermark: float = knobcat.QUEUE_AGE_WATERMARK):
         lib = load()
         if lib is None:
             raise RuntimeError("native workqueue library unavailable")
@@ -316,6 +320,22 @@ class NativeRateLimitingQueue:
 
     def tier_oldest_age(self, klass: str) -> float:
         return self._fast.aga_wq_tier_oldest_age(self._h, _c_class(klass))
+
+    def set_scheduling(self, aging_horizon: Optional[float] = None,
+                       depth_watermark: Optional[int] = None,
+                       age_watermark: Optional[float] = None) -> None:
+        """Retune the scheduler knobs live (autotune/registry.py apply
+        surface; kube/workqueue.py twin).  The aging horizon lives in
+        the C++ queue, so it crosses via ``aga_wq_set_aging``; the
+        watermarks are consulted Python-side."""
+        if aging_horizon is not None:
+            self.aging_horizon = aging_horizon
+            self._fast.aga_wq_set_aging(self._h,
+                                        ctypes.c_double(aging_horizon))
+        if depth_watermark is not None:
+            self.depth_watermark = int(depth_watermark)
+        if age_watermark is not None:
+            self.age_watermark = age_watermark
 
     def overloaded(self) -> Optional[str]:
         """The shed signal (RateLimitingQueue.overloaded contract):

@@ -56,6 +56,8 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..analysis import locks
+from ..autotune import knobs as knobcat
+from ..autotune import targets as tune_targets
 from ..simulation import clock as simclock
 
 # Traffic classes (the queue's two tiers).  CLASS_KEEP is the requeue
@@ -68,13 +70,16 @@ TIERS = (CLASS_INTERACTIVE, CLASS_BACKGROUND)
 
 # A background item's effective priority reaches a fresh interactive
 # item's after this many seconds of queue wait (the anti-starvation
-# bound under a saturating interactive storm).
-DEFAULT_AGING_HORIZON = 2.0
+# bound under a saturating interactive storm).  The numeric defaults
+# are owned by the knob catalog (autotune/knobs.py, lint rule L117):
+# the feedback controllers tune the live values, and snap-to-default
+# must mean the same numbers spelled here.
+DEFAULT_AGING_HORIZON = knobcat.QUEUE_AGING_HORIZON
 
 # Overload watermarks (0 disables that signal): total backlog depth,
 # and the oldest interactive item's age in seconds.
-DEFAULT_DEPTH_WATERMARK = 512
-DEFAULT_AGE_WATERMARK = 1.0
+DEFAULT_DEPTH_WATERMARK = knobcat.QUEUE_DEPTH_WATERMARK
+DEFAULT_AGE_WATERMARK = knobcat.QUEUE_AGE_WATERMARK
 
 
 class ItemExponentialFailureRateLimiter:
@@ -211,11 +216,13 @@ def new_rate_limiting_queue(name: str = "", qps: float = 10.0,
             from .native_workqueue import NativeRateLimitingQueue, \
                 native_available
             if native_available():
-                return NativeRateLimitingQueue(
+                q = NativeRateLimitingQueue(
                     name=name, qps=qps, burst=burst,
                     aging_horizon=aging_horizon,
                     depth_watermark=depth_watermark,
                     age_watermark=age_watermark)
+                tune_targets.note_queue(q)
+                return q
             if pref in ("1", "true", "on"):
                 raise RuntimeError(
                     "AGAC_NATIVE_WORKQUEUE=1 but the native library could "
@@ -223,10 +230,12 @@ def new_rate_limiting_queue(name: str = "", qps: float = 10.0,
         except ImportError:
             if pref in ("1", "true", "on"):
                 raise
-    return RateLimitingQueue(
+    q = RateLimitingQueue(
         rate_limiter=default_controller_rate_limiter(qps, burst), name=name,
         aging_horizon=aging_horizon, depth_watermark=depth_watermark,
         age_watermark=age_watermark)
+    tune_targets.note_queue(q)
+    return q
 
 
 class RateLimitingQueue:
@@ -526,6 +535,21 @@ class RateLimitingQueue:
                 return 0.0
             now = simclock.monotonic()
             return max(0.0, now - self._runnable_at.get(q[0], now))
+
+    def set_scheduling(self, aging_horizon: Optional[float] = None,
+                       depth_watermark: Optional[int] = None,
+                       age_watermark: Optional[float] = None) -> None:
+        """Retune the scheduler knobs live (the autotune registry's
+        apply surface — autotune/registry.py).  Each takes effect on
+        the next get()/overloaded() consult; all are plain floats read
+        under the queue condition, so a swap is atomic enough."""
+        with self._cond:
+            if aging_horizon is not None:
+                self.aging_horizon = aging_horizon
+            if depth_watermark is not None:
+                self.depth_watermark = int(depth_watermark)
+            if age_watermark is not None:
+                self.age_watermark = age_watermark
 
     def overloaded(self) -> Optional[str]:
         """The shed signal: "depth" when the total backlog crosses the

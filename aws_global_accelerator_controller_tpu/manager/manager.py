@@ -55,6 +55,9 @@ class ControllerConfig:
     route53: Route53Config = field(default_factory=Route53Config)
     endpoint_group_binding: EndpointGroupBindingConfig = field(
         default_factory=EndpointGroupBindingConfig)
+    # self-tuning control loops (autotune/): None or enabled=False =
+    # the static plane, byte-identical to the pre-autotune behavior
+    autotune: "Optional[object]" = None
 
 
 InitFunc = Callable[..., threading.Thread]
@@ -111,12 +114,17 @@ class ManagerHandle:
     def __init__(self, informer_factory: SharedInformerFactory, threads,
                  stop: Optional[threading.Event] = None,
                  cloud_factory: Optional[CloudFactory] = None,
-                 kube_client: Optional[KubeClient] = None):
+                 kube_client: Optional[KubeClient] = None,
+                 autotune_engine=None):
         self.informer_factory = informer_factory
         self.threads = threads
         self.stop_event = stop
         self.cloud_factory = cloud_factory
         self.kube_client = kube_client
+        # the plane's AutotuneEngine (autotune/engine.py) when one was
+        # armed — benches read knob trajectories and decision logs off
+        # it; None on the static plane
+        self.autotune_engine = autotune_engine
 
     def informers_synced(self) -> bool:
         return all(inf.has_synced()
@@ -221,9 +229,61 @@ class Manager:
 
         informer_factory.start(stop)
 
+        engine = self._start_autotune(cloud_factory, config, stop)
         handle = ManagerHandle(informer_factory, threads, stop=stop,
                                cloud_factory=cloud_factory,
-                               kube_client=kube_client)
+                               kube_client=kube_client,
+                               autotune_engine=engine)
         if block:
             handle.join()
         return handle
+
+    @staticmethod
+    def _start_autotune(cloud_factory, config, stop):
+        """Arm the self-tuning engine when the config opts in
+        (autotune/engine.py).  The registry's DEFAULTS are seeded from
+        the plane's actual static configuration — the factory's
+        coalesce/resilience profiles, the controllers' fingerprint and
+        scheduler knobs — so the snap-to-default freeze provably
+        restores THIS plane's static behavior, not the catalog's idea
+        of it.  With a fake cloud, the signal reader rides the
+        FaultInjector's corruption hook so chaos suites can prove a
+        lying stream freezes instead of steering."""
+        at_cfg = getattr(config, "autotune", None)
+        if at_cfg is None or not at_cfg.enabled:
+            return None
+        from dataclasses import replace as dc_replace
+
+        from ..autotune import AutotuneEngine, SignalReader
+
+        defaults = dict(at_cfg.defaults)
+        co = getattr(cloud_factory, "coalesce_config", None)
+        if co is not None:
+            defaults.setdefault("coalescer.linger", co.linger)
+            defaults.setdefault("coalescer.warm_gap",
+                                co.effective_warm_gap)
+        res = getattr(cloud_factory, "resilience_config", None)
+        if res is not None:
+            defaults.setdefault("breaker.window", res.breaker_window)
+        ga = config.global_accelerator
+        if ga.fingerprints.sweep_every > 0:
+            defaults.setdefault("sweep.every",
+                                ga.fingerprints.sweep_every)
+        defaults.setdefault("queue.aging_horizon", ga.aging_horizon)
+        if ga.depth_watermark > 0:
+            defaults.setdefault("queue.depth_watermark",
+                                ga.depth_watermark)
+        if ga.age_watermark > 0:
+            defaults.setdefault("queue.age_watermark",
+                                ga.age_watermark)
+        faults = getattr(getattr(cloud_factory, "cloud", None),
+                         "faults", None)
+        reader = SignalReader(
+            corrupt=faults.corrupt_signal if faults is not None
+            else None)
+        engine = AutotuneEngine(dc_replace(at_cfg, defaults=defaults),
+                                reader=reader)
+        engine.start_background(stop)
+        logger.info("autotune engine armed (interval %.2fs, %d knobs)",
+                    at_cfg.interval, len(engine.registry.names()))
+        return engine

@@ -178,6 +178,56 @@ class Registry:
             return sum(v[3] for (n, _), v in self._histograms.items()
                        if n == name)
 
+    def histogram_series(self, name: str
+                         ) -> "Dict[Tuple, List[Tuple[float, int]]]":
+        """Per-labels NON-cumulative bucket counts of a histogram
+        family: ``{labels: [(le, count_in_bucket), ...]}`` with the
+        overflow bucket as ``(inf, n)`` — the delta-samplable shape
+        the autotune signal reader windows p99 estimates from
+        (autotune/signals.py)."""
+        import math
+        with self._lock:
+            out = {}
+            for (n, labels), (bounds, counts, _s, _c) \
+                    in self._histograms.items():
+                if n != name:
+                    continue
+                out[labels] = (list(zip(bounds, counts[:-1]))
+                               + [(math.inf, counts[-1])])
+            return out
+
+    def histogram_sums(self, name: str
+                       ) -> "Dict[Tuple, Tuple[float, int]]":
+        """Per-labels (sum, count) of a histogram family."""
+        with self._lock:
+            return {labels: (v[2], v[3])
+                    for (n, labels), v in self._histograms.items()
+                    if n == name}
+
+    def sample_gauges(self, name: str, skip_label: Optional[str] = None,
+                      max_over: bool = False) -> float:
+        """Evaluate the registered callback gauges of ``name`` now and
+        combine them (sum, or max with ``max_over``).  ``skip_label``
+        drops series carrying that label key — workqueue_depth
+        registers both whole-queue and per-tier series, and summing
+        both would double-count.  A failing callback contributes
+        nothing (same contract as render)."""
+        with self._lock:
+            fns = [(labels, fn) for n, labels, fn in self._gauge_fns
+                   if n == name]
+        values = []
+        for labels, fn in fns:
+            if skip_label is not None and any(k == skip_label
+                                              for k, _ in labels):
+                continue
+            try:
+                values.append(float(fn()))
+            except Exception:
+                continue
+        if not values:
+            return 0.0
+        return max(values) if max_over else sum(values)
+
     def register_gauge(self, name: str, labels: Dict[str, str],
                        fn: Callable[[], float]) -> None:
         """Re-registering the same (name, labels) replaces the callback --
@@ -481,6 +531,25 @@ default_registry.describe(
     "mutation profiles; what locality-driven placement maximizes — "
     "docs/operations.md placement-skew triage reads this).")
 default_registry.describe(
+    "autotune_knob_value",
+    "Current value of each feedback-tuned control-plane knob "
+    "(autotune/registry.py TunableRegistry; coalescer linger, sweep "
+    "period, queue watermarks, breaker window, digest cadence) — at "
+    "its default when no engine runs, the operator's first stop for "
+    "'what is the tuner doing'.")
+default_registry.describe(
+    "autotune_adjustments_total",
+    "Knob moves applied by the feedback controllers, per knob and "
+    "direction (up/down).  Clamped/deadband/frozen proposals that "
+    "changed nothing are not counted (autotune/engine.py).")
+default_registry.describe(
+    "autotune_frozen_total",
+    "Snap-to-default freezes per knob and reason (anomalous signal "
+    "stream: non-finite, regressed, implausible, stalled; or an "
+    "engine stop).  A frozen knob holds its default through the "
+    "cooldown — a lying signal's worst case is the static plane "
+    "(autotune/registry.py).")
+default_registry.describe(
     "race_lockset_checks",
     "Lock acquisitions screened by the runtime lockset tracker "
     "(analysis/locks.py) — nonzero proves the detector was armed.")
@@ -756,6 +825,32 @@ def record_rollout_rollback(controller: str, reason: str,
     reg = registry or default_registry
     reg.inc_counter("rollout_rollbacks_total",
                     {"controller": controller, "reason": reason})
+
+
+def record_knob_value(knob: str, value: float,
+                      registry: Optional[Registry] = None) -> None:
+    """The feedback-tuned knob ``knob`` is now at ``value`` (pushed by
+    the TunableRegistry on every applied move, pin and freeze)."""
+    reg = registry or default_registry
+    reg.set_gauge("autotune_knob_value", {"knob": knob}, value)
+
+
+def record_knob_adjustment(knob: str, direction: str,
+                           registry: Optional[Registry] = None) -> None:
+    """One applied feedback move of ``knob`` (``direction``:
+    up/down)."""
+    reg = registry or default_registry
+    reg.inc_counter("autotune_adjustments_total",
+                    {"knob": knob, "direction": direction})
+
+
+def record_knob_freeze(knob: str, reason: str,
+                       registry: Optional[Registry] = None) -> None:
+    """One snap-to-default freeze of ``knob`` (``reason`` names the
+    anomaly class or the explicit stop)."""
+    reg = registry or default_registry
+    reg.inc_counter("autotune_frozen_total",
+                    {"knob": knob, "reason": reason})
 
 
 def record_lockset_checks(n: int = 1,

@@ -513,6 +513,15 @@ int aga_wq_waiting_len(void* h) {
   return static_cast<int>(q->waiting_index.size());
 }
 
+// Retune the aged-priority horizon live (the autotune engine's apply
+// surface — kube/workqueue.py set_scheduling).  Takes effect on the
+// next get(); <= 0 disables aging, like the constructor value.
+void aga_wq_set_aging(void* h, double aging_horizon) {
+  Queue* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->aging_horizon = aging_horizon;
+}
+
 void aga_wq_shutdown(void* h) {
   Queue* q = static_cast<Queue*>(h);
   std::lock_guard<std::mutex> lk(q->mu);

@@ -52,12 +52,13 @@ import weakref
 import zlib
 from collections import OrderedDict
 from contextlib import contextmanager
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dc_replace
 from typing import Callable, Optional
 
 from .. import metrics
 from .interning import intern_str
 from ..analysis import locks
+from ..autotune import knobs as knobcat
 
 logger = logging.getLogger(__name__)
 
@@ -83,8 +84,9 @@ class FingerprintConfig:
     # verify every this-many resync waves (~10 periods ≈ 5 minutes at
     # the default 30s resync); 0 disables the sweep entirely (resync
     # re-deliveries then never reach the provider while unchanged —
-    # out-of-band AWS drift goes undetected until a real event)
-    sweep_every: int = 10
+    # out-of-band AWS drift goes undetected until a real event).
+    # Default owned by the knob catalog (autotune/knobs.py, L117).
+    sweep_every: int = knobcat.SWEEP_EVERY
     # bound on recorded fingerprints; oldest-recorded evicted first
     # (an evicted key just takes one full sync on its next resync)
     max_entries: int = 100_000
@@ -176,6 +178,9 @@ class FingerprintCache:
         self._fp: "OrderedDict[str, tuple]" = OrderedDict()
         # key -> pending enqueue origin (claimed at dispatch)
         self._origin: dict = {}
+        # key -> wave of the last deep verify (or digest answer): the
+        # stride-robust sweep schedule (note_resync docstring)
+        self._sweep_last: dict = {}
         # key -> first-enqueue monotonic time of the change currently
         # converging: event->converged latency must span requeues and
         # parks, so the first dispatch records it and retries reuse it
@@ -197,6 +202,14 @@ class FingerprintCache:
         digest = hashlib.sha1(repr(fields).encode()).digest()
         return obj.metadata.generation, digest
 
+    def set_sweep_every(self, sweep_every: int) -> None:
+        """Retune the drift-sweep period live (the autotune registry's
+        apply surface).  The config object is swapped, never mutated —
+        it may be shared by every controller's cache, and a tuned
+        period must not rewrite a sibling registry's defaults."""
+        self.config = dc_replace(self.config,
+                                 sweep_every=max(0, int(sweep_every)))
+
     # -- enqueue-origin bookkeeping ------------------------------------
 
     def note_event(self, key: str) -> None:
@@ -209,25 +222,47 @@ class FingerprintCache:
 
     def note_resync(self, key: str, wave: int) -> str:
         """A resync wave re-delivered ``key``; returns the origin the
-        pending dispatch will carry.  Key-stable sweep tiering: the
-        key deep-verifies on the waves where ``crc32(key) ≡ wave (mod
-        sweep_every)`` — one gate bypass per key per sweep period,
-        spread evenly across the period's waves.  ``sweep_every <= 0``
-        disables the sweep (no delivery is ever sweep-tagged)."""
+        pending dispatch will carry.  Key-stable sweep tiering: each
+        key deep-verifies once per ``sweep_every`` waves, phased at
+        ``crc32(key) mod sweep_every`` so the fleet's sweeps spread
+        evenly across the period's waves.  Dueness is tracked as
+        LAST-SWEPT WAVE (``wave - last >= sweep_every``), not as an
+        exact residue match: under the virtual clock resync ticks
+        quantize (simulation/clock.py) and wave numbers advance in
+        strides, so an exact-residue test silently starves every key
+        whose residue the stride sequence never lands on — with a 2s
+        period under the 5s quantum, ~60% of a fleet would NEVER deep
+        verify.  The stride-robust form also reacts correctly when
+        the autotune engine retunes ``sweep_every`` live.
+        ``sweep_every <= 0`` disables the sweep (no delivery is ever
+        sweep-tagged)."""
         every = self.config.sweep_every
-        due = (every > 0
-               and (zlib.crc32(key.encode()) % every) == (wave % every))
+        due = False
+        if every > 0:
+            with self._lock:
+                last = self._sweep_last.get(key)
+                if last is None:
+                    # phase the first due wave at the key's residue
+                    # slot (the spread), then once per period after
+                    r = zlib.crc32(key.encode()) % every
+                    last = wave + ((r - wave) % every) - every
+                    self._sweep_last[intern_str(key)] = last
+                due = (wave - last) >= every
+        answered = False
         if due and self._sweep_gate is not None:
             # outside the cache lock: the gate's digest exchange is a
             # (once-per-region-per-wave) provider read
             try:
                 if self._sweep_gate(key, wave):
                     due = False
+                    answered = True   # the exchange WAS the verify
             except Exception:
                 logger.debug("sweep gate failed for %r; sweeping",
                              key, exc_info=True)
         origin = ORIGIN_SWEEP if due else ORIGIN_RESYNC
         with self._lock:
+            if due or answered:
+                self._sweep_last[intern_str(key)] = wave
             have = self._origin.get(key)
             if have is None or _PRECEDENCE[origin] > _PRECEDENCE[have]:
                 self._origin[key] = origin
@@ -292,6 +327,7 @@ class FingerprintCache:
         """Drop one key's record (provider error, deletion)."""
         with self._lock:
             self._fp.pop(key, None)
+            self._sweep_last.pop(key, None)
 
     def invalidate_all(self, reason: str = "") -> None:
         with self._lock:
@@ -319,6 +355,7 @@ class FingerprintCache:
             for key in matched:
                 dropped += self._fp.pop(key, None) is not None
                 self._pending_since.pop(key, None)
+                self._sweep_last.pop(key, None)
         return dropped
 
     def __len__(self) -> int:

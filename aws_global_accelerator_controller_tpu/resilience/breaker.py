@@ -34,6 +34,8 @@ from collections import deque
 from typing import Optional
 
 from .. import metrics
+from ..autotune import knobs as knobcat
+from ..autotune import targets as tune_targets
 from ..simulation import clock as simclock
 from ..analysis import locks
 from ..errors import AWSAPIError
@@ -61,7 +63,8 @@ class CircuitOpenError(AWSAPIError):
 
 
 class CircuitBreaker:
-    def __init__(self, region: str = "global", window: float = 30.0,
+    def __init__(self, region: str = "global",
+                 window: float = knobcat.BREAKER_WINDOW,
                  min_calls: int = 10, failure_threshold: float = 0.5,
                  open_seconds: float = 5.0, half_open_probes: int = 1,
                  registry: "Optional[metrics.Registry]" = None,
@@ -79,6 +82,17 @@ class CircuitBreaker:
         self._state = STATE_CLOSED
         self._opened_until = 0.0
         self._probes_inflight = 0
+        # feedback-tunable target (autotune/): the engine lengthens a
+        # flapping breaker's window live via set_window
+        tune_targets.note_breaker(self)
+
+    def set_window(self, window: float) -> None:
+        """Retune the failure-rate observation window live (the
+        autotune registry's apply surface).  Takes effect at the next
+        record/allow consult; recorded events keep their stamps, so a
+        longer window immediately sees more history."""
+        with self._lock:
+            self.window = window
 
     # -- state ----------------------------------------------------------
 

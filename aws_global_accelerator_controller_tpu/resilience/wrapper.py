@@ -38,6 +38,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from .. import metrics
+from ..autotune import knobs as knobcat
 from ..simulation import clock as simclock
 from ..tracing import default_tracer, stamp_ambient
 from .breaker import AdaptiveTokenBucket, CircuitBreaker
@@ -118,8 +119,9 @@ class ResilienceConfig:
     base_delay: float = 0.2
     max_delay: float = 5.0
     deadline: float = 30.0
-    # circuit breaker
-    breaker_window: float = 30.0
+    # circuit breaker (window default owned by the knob catalog —
+    # autotune/knobs.py, lint rule L117)
+    breaker_window: float = knobcat.BREAKER_WINDOW
     breaker_min_calls: int = 10
     breaker_failure_threshold: float = 0.5
     breaker_open_seconds: float = 5.0
@@ -145,7 +147,7 @@ class ResilienceConfig:
 # injections of the ordinary e2e suites never trip it
 FAKE_CLOUD_CONFIG = ResilienceConfig(
     max_attempts=4, base_delay=0.002, max_delay=0.05, deadline=5.0,
-    breaker_window=5.0, breaker_min_calls=50,
+    breaker_window=knobcat.FAKE_BREAKER_WINDOW, breaker_min_calls=50,
     breaker_failure_threshold=0.9, breaker_open_seconds=0.25,
     bucket_capacity=1e6, bucket_refill=1e6, bucket_min_capacity=100.0,
     bucket_recover=100.0)

@@ -1,0 +1,684 @@
+"""Seeded scenario fuzzer: deterministic workload scripts under
+virtual time (ISSUE 15).
+
+Every hand-written bench leg measures ONE workload shape — the shape
+its knobs were tuned for.  The fuzzer closes that gap: a (family,
+seed) pair expands to a fully deterministic *workload script* — a
+time-ordered list of actions (creates, deletes, annotation flaps,
+out-of-band drift edits, region partitions) over virtual seconds —
+and the runner replays it against a fresh control plane under the
+PR-13 virtual clock.  Same seed ⇒ byte-identical script, and (by the
+determinism contract the virtual clock + seeded chaos engines carry)
+byte-identical decision logs and convergence ledger when replayed:
+``hack/fuzz_replay.py`` re-runs a recorded scenario from nothing but
+its seed and diffs the ledgers.
+
+Scenario families (the workload shapes ROADMAP item 5 names):
+
+- ``bursty-creates``    quiet line punctuated by dense create bursts
+- ``delete-waves``      a converged fleet hit by waves of deletions
+                        (with partial recreates)
+- ``flapping-updates``  annotation values flapping A→B→A in gusts
+- ``zone-skewed-churn`` churn concentrated 80/20 onto one hosted
+                        zone, under that zone's per-call rate limit
+- ``slow-drip-drift``   out-of-band record re-points trickling in —
+                        the workload the drift sweep's period is
+                        tuned against
+- ``mixed-region-storm``a 3-region fleet, fleet-wide touch storms,
+                        one partition/heal cycle mid-storm
+
+The script is pure data (``canonical_json``) generated from a
+``random.Random`` seeded by crc32(family:seed) — no wall clock, no
+ambient state — so generation itself is replayable cross-process.
+The runner measures what the adaptive-vs-static A/B needs: makespan
+to full convergence, p99 event→converged per class (the raw latency
+sink), wire mutation calls, and per-drift repair lag.
+"""
+from __future__ import annotations
+
+import json
+import random
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from . import clock as simclock
+
+FAMILIES = (
+    "bursty-creates",
+    "delete-waves",
+    "flapping-updates",
+    "zone-skewed-churn",
+    "slow-drip-drift",
+    "mixed-region-storm",
+)
+
+REGIONS = ("us-west-2", "eu-west-1", "ap-northeast-1")
+FLAP_ANNOTATION = "fuzz.agac/round"
+
+
+@dataclass(frozen=True)
+class Action:
+    """One scripted step: at virtual second ``t`` (from scenario
+    start), apply ``op`` to service ``name``.  ``params`` is the
+    op-specific payload as sorted (key, value) pairs — hashable,
+    canonically serializable."""
+
+    t: float
+    op: str       # create | delete | update | drift_record |
+    #               partition | heal
+    name: str = ""
+    params: Tuple = ()
+
+    def param(self, key: str, default=None):
+        return dict(self.params).get(key, default)
+
+
+@dataclass
+class ScenarioScript:
+    """A generated workload: pure data, replayable from (family,
+    seed) alone.  ``env`` carries the scenario's environment knobs
+    (per-call latency, a zone rate limit, regions) — part of the
+    script so a replay reconstructs the same world."""
+
+    family: str
+    seed: int
+    duration: float
+    n_services: int
+    env: Dict[str, object] = field(default_factory=dict)
+    actions: List[Action] = field(default_factory=list)
+
+    @property
+    def spec(self) -> str:
+        """The replay handle: everything needed to regenerate."""
+        return f"{self.family}:{self.seed}"
+
+    def canonical_json(self) -> str:
+        return json.dumps(
+            {"family": self.family, "seed": self.seed,
+             "duration": self.duration, "n_services": self.n_services,
+             "env": self.env,
+             "actions": [[round(a.t, 6), a.op, a.name,
+                          list(map(list, a.params))]
+                         for a in self.actions]},
+            sort_keys=True)
+
+
+def _hostname(name: str, region: str) -> str:
+    return f"{name}-0123456789abcdef.elb.{region}.amazonaws.com"
+
+
+def _rng(family: str, seed: int) -> random.Random:
+    # crc32 folding keeps the derivation cross-process deterministic
+    # and family-decorrelated (seed 7's bursty run shares nothing
+    # with seed 7's delete waves)
+    return random.Random(zlib.crc32(f"{family}:{seed}".encode()))
+
+
+def generate(family: str, seed: int, n_services: int = 24,
+             duration: float = 90.0) -> ScenarioScript:
+    """Expand (family, seed) into a deterministic workload script.
+    Pure: no clocks, no I/O, no ambient randomness."""
+    if family not in FAMILIES:
+        raise ValueError(f"unknown scenario family {family!r} "
+                         f"(known: {', '.join(FAMILIES)})")
+    rng = _rng(family, seed)
+    script = ScenarioScript(family=family, seed=seed,
+                            duration=duration, n_services=n_services)
+    build = globals()["_gen_" + family.replace("-", "_")]
+    build(script, rng)
+    # time-ordered with a deterministic tiebreak: the runner replays
+    # strictly by (t, sequence), so generation order never leaks into
+    # replay order
+    script.actions.sort(key=lambda a: (a.t, a.op, a.name, a.params))
+    return script
+
+
+# -- family generators ------------------------------------------------------
+
+
+def _spread_creates(script: ScenarioScript, rng: random.Random,
+                    t0: float, t1: float, zone_of=None,
+                    region_of=None) -> None:
+    for i in range(script.n_services):
+        name = f"fz{i:04d}"
+        region = region_of(i, rng) if region_of else REGIONS[0]
+        zone = zone_of(i, rng) if zone_of else 0
+        script.actions.append(Action(
+            round(rng.uniform(t0, t1), 3), "create", name,
+            (("hostname", _hostname(name, region)),
+             ("region", region), ("zone", zone))))
+
+
+def _gen_bursty_creates(script: ScenarioScript,
+                        rng: random.Random) -> None:
+    """Dense create bursts on a quiet line: the shape the coalescer's
+    linger trades latency against — a fixed short linger flushes each
+    burst as many tiny zone calls."""
+    script.env = {"call_latency": 0.004, "zone_rate": 2.0,
+                  "zones": 1}
+    bursts = 4 + rng.randrange(3)
+    per = max(1, script.n_services // bursts)
+    i = 0
+    for b in range(bursts):
+        t = round(rng.uniform(2.0, script.duration * 0.6), 3)
+        for _ in range(per):
+            if i >= script.n_services:
+                break
+            name = f"fz{i:04d}"
+            script.actions.append(Action(
+                round(t + rng.uniform(0.0, 0.4), 3), "create", name,
+                (("hostname", _hostname(name, REGIONS[0])),
+                 ("region", REGIONS[0]), ("zone", 0))))
+            i += 1
+    while i < script.n_services:
+        name = f"fz{i:04d}"
+        script.actions.append(Action(
+            round(rng.uniform(2.0, script.duration * 0.6), 3),
+            "create", name,
+            (("hostname", _hostname(name, REGIONS[0])),
+             ("region", REGIONS[0]), ("zone", 0))))
+        i += 1
+
+
+def _gen_delete_waves(script: ScenarioScript,
+                      rng: random.Random) -> None:
+    """Converge a fleet, then delete it in waves (some services
+    recreated between waves): record-set DELETE batches per zone."""
+    script.env = {"call_latency": 0.004, "zone_rate": 2.0,
+                  "zones": 1}
+    _spread_creates(script, rng, 1.0, 6.0)
+    waves = 3
+    names = [f"fz{i:04d}" for i in range(script.n_services)]
+    rng.shuffle(names)
+    per = max(1, len(names) // waves)
+    for w in range(waves):
+        t = round(20.0 + w * 18.0 + rng.uniform(0.0, 3.0), 3)
+        chunk = names[w * per:(w + 1) * per]
+        for name in chunk:
+            script.actions.append(Action(
+                round(t + rng.uniform(0.0, 0.5), 3), "delete", name))
+        # a few come back: churn, not a clean teardown
+        for name in rng.sample(chunk, max(1, len(chunk) // 4)):
+            script.actions.append(Action(
+                round(t + 6.0 + rng.uniform(0.0, 1.0), 3),
+                "create", name,
+                (("hostname", _hostname(name, REGIONS[0])),
+                 ("region", REGIONS[0]), ("zone", 0))))
+
+
+def _gen_flapping_updates(script: ScenarioScript,
+                          rng: random.Random) -> None:
+    """Annotation values flapping in gusts over a converged fleet:
+    most record re-ensures FOLD (last-writer-wins) when the linger
+    holds a gust's cohort together."""
+    script.env = {"call_latency": 0.004, "zone_rate": 2.0,
+                  "zones": 1}
+    _spread_creates(script, rng, 1.0, 6.0)
+    gusts = 6
+    for g in range(gusts):
+        t = round(18.0 + g * 9.0 + rng.uniform(0.0, 2.0), 3)
+        flappers = rng.sample(range(script.n_services),
+                              max(2, script.n_services // 3))
+        for i in flappers:
+            for r in range(2 + rng.randrange(2)):
+                script.actions.append(Action(
+                    round(t + r * 0.3 + rng.uniform(0.0, 0.2), 3),
+                    "update", f"fz{i:04d}",
+                    (("annotation", FLAP_ANNOTATION),
+                     ("value", f"g{g}r{r}"))))
+
+
+def _gen_zone_skewed_churn(script: ScenarioScript,
+                           rng: random.Random) -> None:
+    """Create/delete churn with 80% of services homed in ONE hosted
+    zone that enforces its per-call rate limit: the workload where
+    per-zone batching is the difference between converging and
+    thrashing."""
+    script.env = {"call_latency": 0.004, "zone_rate": 2.5,
+                  "zones": 3}
+
+    def zone_of(i, r):
+        return 0 if r.random() < 0.8 else 1 + r.randrange(2)
+
+    _spread_creates(script, rng, 1.0, 8.0, zone_of=zone_of)
+    for _ in range(script.n_services):
+        i = rng.randrange(script.n_services)
+        t = round(rng.uniform(20.0, script.duration * 0.75), 3)
+        name = f"fz{i:04d}"
+        script.actions.append(Action(t, "delete", name))
+        script.actions.append(Action(
+            round(t + 4.0 + rng.uniform(0.0, 2.0), 3), "create", name,
+            (("hostname", _hostname(name, REGIONS[0])),
+             ("region", REGIONS[0]), ("zone", zone_of(i, rng)))))
+
+
+def _gen_slow_drip_drift(script: ScenarioScript,
+                         rng: random.Random) -> None:
+    """A converged, quiet fleet whose records an outside hand keeps
+    re-pointing, one every few virtual seconds: repair latency is
+    bounded by the drift-sweep period — the knob this family
+    pressures."""
+    script.env = {"call_latency": 0.002, "zone_rate": 0.0,
+                  "zones": 1}
+    _spread_creates(script, rng, 1.0, 5.0)
+    t = 25.0
+    while t < script.duration * 0.85:
+        i = rng.randrange(script.n_services)
+        script.actions.append(Action(
+            round(t, 3), "drift_record", f"fz{i:04d}",
+            (("rogue", f"rogue-{int(t)}"),)))
+        t += rng.uniform(3.0, 7.0)
+
+
+def _gen_mixed_region_storm(script: ScenarioScript,
+                            rng: random.Random) -> None:
+    """Three regions, zone per region, fleet-wide annotation storms,
+    one partial partition/heal mid-storm."""
+    script.env = {"call_latency": 0.002, "zone_rate": 0.0,
+                  "zones": 3, "regions": list(REGIONS)}
+
+    def region_of(i, r):
+        return REGIONS[i % len(REGIONS)]
+
+    _spread_creates(script, rng, 1.0, 8.0,
+                    zone_of=lambda i, r: i % len(REGIONS),
+                    region_of=region_of)
+    for storm in range(2):
+        t = round(25.0 + storm * 25.0 + rng.uniform(0.0, 2.0), 3)
+        for i in range(script.n_services):
+            script.actions.append(Action(
+                round(t + rng.uniform(0.0, 1.5), 3), "update",
+                f"fz{i:04d}",
+                (("annotation", FLAP_ANNOTATION),
+                 ("value", f"storm{storm}"))))
+    dark = REGIONS[1 + rng.randrange(len(REGIONS) - 1)]
+    t_cut = round(30.0 + rng.uniform(0.0, 5.0), 3)
+    script.actions.append(Action(
+        t_cut, "partition", "", (("region", dark), ("rate", 0.8))))
+    script.actions.append(Action(
+        round(t_cut + 12.0, 3), "heal", "", (("region", dark),)))
+
+
+# -- the runner -------------------------------------------------------------
+
+
+def _record_alias(cloud, zone_id: str, rname: str):
+    """Current alias target DNS name of the A record ``rname`` in
+    ``zone_id`` — lock-direct fake read: observing the answer must not
+    consume fault-schedule draws (the determinism contract)."""
+    r53 = cloud.route53
+    with r53._lock:  # race: fuzz observation, lock-direct
+        for rec in r53._records.get(zone_id, []):
+            if rec.type == "A" \
+                    and rec.name.rstrip(".") == rname.rstrip("."):
+                alias = rec.alias_target
+                return alias.dns_name if alias is not None else None
+    return None
+
+
+class ScenarioRunner:
+    """Replay one script against a fresh control plane under an
+    ACTIVE virtual clock (the caller owns activation — the A/B bench
+    and the determinism suite both need to bracket several runs).
+
+    Builds the world the script's ``env`` names (zones, regions,
+    per-call latency, zone rate limit), registers load balancers up
+    front (LB registration is the cloud's state, not workload), then
+    applies actions at their virtual timestamps and waits for full
+    convergence.  Returns the measurement dict described in the
+    module docstring."""
+
+    def __init__(self, script: ScenarioScript, workers: int = 2,
+                 autotune=None, resync_period: float = 2.0,
+                 fault_seed: Optional[int] = None,
+                 fingerprints=None,
+                 signal_corruption: float = 0.0):
+        self.script = script
+        self.workers = workers
+        self.autotune = autotune
+        self.resync_period = resync_period
+        self.fault_seed = (script.seed if fault_seed is None
+                           else fault_seed)
+        self.fingerprints = fingerprints
+        # lying-signal chaos (ISSUE 15): garble the autotune signal
+        # stream at this rate (FaultInjector.set_signal_corruption) —
+        # the e2e proving a corrupted stream freezes, never steers
+        self.signal_corruption = signal_corruption
+
+    # the monitor's poll stride (virtual seconds): lock-direct cloud
+    # reads, no API draws consumed — cheap and determinism-neutral
+    MONITOR_POLL = 0.25
+
+    # REAL seconds to wait for a previous cluster's daemon threads to
+    # exit before activating this scenario's machinery: a straggler
+    # wandering into the fresh virtual clock perturbs scheduler
+    # sequence numbers and breaks replay-identity (the determinism
+    # suite's _drain_stragglers, owned here so every caller gets it)
+    STRAGGLER_DRAIN_S = 8.0
+
+    @classmethod
+    def _drain_stragglers(cls) -> None:
+        import threading
+        import time as _t
+
+        names = ("-worker-", "informer-", "workqueue-waker-",
+                 "event-broadcaster", "-controller",
+                 "autotune-engine", "fuzz-monitor")
+        deadline = _t.monotonic() + cls.STRAGGLER_DRAIN_S
+        while _t.monotonic() < deadline:
+            if not [t for t in threading.enumerate()
+                    if any(n in (t.name or "") for n in names)]:
+                return
+            _t.sleep(0.05)
+
+    def run(self) -> dict:
+        import sys
+        import time
+
+        sys.path.insert(0, "tests")
+        from harness import Cluster, wait_until
+
+        from .. import metrics
+        from ..apis import (
+            AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION,
+            AWS_LOAD_BALANCER_TYPE_ANNOTATION,
+            ROUTE53_HOSTNAME_ANNOTATION,
+        )
+        from ..kube.objects import (
+            LoadBalancerIngress,
+            LoadBalancerStatus,
+            ObjectMeta,
+            Service,
+            ServicePort,
+            ServiceSpec,
+            ServiceStatus,
+        )
+
+        from ..tracing import default_ledger
+
+        # convergence-ledger window: the records this scenario adds
+        # are the replay tool's diff surface (hack/fuzz_replay.py) —
+        # the same byte-identical contract the determinism suite
+        # asserts (tests/chaos/test_chaos_determinism.py)
+        ledger_before = len(default_ledger.snapshot(limit=100000))
+        self._drain_stragglers()
+        script = self.script
+        env = script.env
+        regions = env.get("regions")
+        topology = None
+        if regions:
+            from ..topology import RegionTopology
+
+            topology = RegionTopology(list(regions),
+                                      seed=self.fault_seed,
+                                      intra_latency=0.0005,
+                                      cross_latency=0.01)
+        cluster = Cluster(workers=self.workers, queue_qps=1e6,
+                          queue_burst=10**6,
+                          resync_period=self.resync_period,
+                          fault_seed=self.fault_seed,
+                          topology=topology,
+                          fingerprints=self.fingerprints,
+                          autotune=self.autotune)
+        cloud = cluster.cloud
+        n_zones = int(env.get("zones", 1))
+        zones = []
+        for z in range(n_zones):
+            region = (regions[z % len(regions)] if regions
+                      else None)
+            zones.append(cloud.route53.create_hosted_zone(
+                f"z{z}.fuzz.example.com",
+                **({"region": region} if region else {})))
+        # LB registration is world state: everything the script may
+        # ever create gets its NLB up front, so action replay is pure
+        # kube-plane traffic
+        for a in script.actions:
+            if a.op == "create":
+                cloud.elb.register_load_balancer(
+                    a.name, a.param("hostname"),
+                    a.param("region", REGIONS[0]))
+        if env.get("call_latency"):
+            cloud.faults.set_latency("*", float(env["call_latency"]))
+        if env.get("zone_rate"):
+            cloud.faults.set_zone_throttle(float(env["zone_rate"]))
+        if self.signal_corruption > 0.0:
+            cloud.faults.set_signal_corruption(self.signal_corruption)
+
+        def svc_for(a: Action) -> Service:
+            name = a.name
+            host = f"{name}.z{a.param('zone', 0)}.fuzz.example.com"
+            return Service(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    annotations={
+                        AWS_LOAD_BALANCER_TYPE_ANNOTATION: "external",
+                        AWS_GLOBAL_ACCELERATOR_MANAGED_ANNOTATION:
+                            "true",
+                        ROUTE53_HOSTNAME_ANNOTATION: host}),
+                spec=ServiceSpec(type="LoadBalancer",
+                                 ports=[ServicePort(port=80)]),
+                status=ServiceStatus(
+                    load_balancer=LoadBalancerStatus(ingress=[
+                        LoadBalancerIngress(
+                            hostname=a.param("hostname"))])))
+
+        # -- drift monitoring (slow-drip families) ----------------------
+        # target record -> (injected_at, expected alias); a monitor
+        # thread samples repair lag with lock-direct reads.
+        # good_aliases remembers each record's converged alias across
+        # injections: a record re-drifted BEFORE its repair landed
+        # must not have the rogue value read back as "good" (the
+        # monitor would then wait for the corruption forever)
+        pending_drift: Dict[Tuple[str, str], Tuple[float, str]] = {}
+        good_aliases: Dict[Tuple[str, str], str] = {}
+        drift_lags: List[float] = []
+        drift_lock = simclock.make_condition()
+        monitor_stop = simclock.make_event()
+
+        def record_alias(zone_id: str, rname: str) -> Optional[str]:
+            return _record_alias(cloud, zone_id, rname)
+
+        def monitor():
+            while not monitor_stop.is_set():
+                with drift_lock:
+                    items = list(pending_drift.items())
+                now = simclock.monotonic()
+                for (zone_id, rname), (t0, expected) in items:
+                    got = record_alias(zone_id, rname)
+                    if got is not None \
+                            and got.rstrip(".") == expected.rstrip("."):
+                        with drift_lock:
+                            pending_drift.pop((zone_id, rname), None)
+                        drift_lags.append(now - t0)
+                monitor_stop.wait(self.MONITOR_POLL)
+
+        live: Dict[str, Action] = {}
+        drift_count = 0
+        wall0 = time.perf_counter()
+        samples = metrics.arm_latency_sampler()
+        reg = metrics.default_registry
+        flushes0 = reg.counter_value("provider_mutation_flushes_total")
+        enq0 = reg.counter_value("provider_mutations_enqueued_total")
+        try:
+            cluster.start()
+            wait_until(lambda: cluster.handle.informers_synced(),
+                       timeout=60.0, message="informers synced")
+            mon = simclock.start_thread(monitor, daemon=True,
+                                        name="fuzz-monitor")
+            t_start = simclock.monotonic()
+            for a in script.actions:
+                dt = (t_start + a.t) - simclock.monotonic()
+                if dt > 0:
+                    simclock.sleep(dt)
+                self._apply(a, cluster, cloud, zones, topology,
+                            svc_for, live, pending_drift, drift_lock,
+                            good_aliases)
+                if a.op == "drift_record":
+                    drift_count += 1
+
+            # -- convergence: every live service's accelerator exists
+            # and every injected drift is repaired -------------------
+            ga = cloud.ga
+
+            def converged() -> bool:
+                with ga._lock:  # race: fuzz observation, lock-direct
+                    n_acc = len(ga._accelerators)
+                if n_acc != len(live):
+                    return False
+                with drift_lock:
+                    return not pending_drift
+
+            try:
+                wait_until(converged, timeout=script.duration * 40,
+                           interval=0.5,
+                           message=f"{script.family}:{script.seed} "
+                                   f"fleet converged")
+            except AssertionError as e:
+                with ga._lock:  # race: fuzz observation, lock-direct
+                    n_acc = len(ga._accelerators)
+                with drift_lock:
+                    stuck = list(pending_drift)
+                raise AssertionError(
+                    f"{e}: accelerators={n_acc} live={len(live)} "
+                    f"unrepaired_drift={stuck}") from None
+            makespan = simclock.monotonic() - t_start
+            monitor_stop.set()
+            simclock.join_thread(mon, timeout=5.0)
+            # the engine's story, captured BEFORE shutdown resets the
+            # knobs: what the tuner actually did this scenario (the
+            # bench records it into reconcile_history.jsonl)
+            engine = cluster.handle.autotune_engine
+            knob_trajectory = (engine.registry.trajectory()
+                               if engine is not None else None)
+            tuner_log = (engine.decision_log()
+                         if engine is not None else [])
+            chaos_log = cloud.faults.decision_log()
+            cluster.shutdown(ordered=True, deadline=15.0)
+        finally:
+            metrics.disarm_latency_sampler()
+            cloud.faults.set_latency("*", 0.0)
+            try:
+                cluster.shutdown()
+            except Exception:
+                pass
+
+        interactive = sorted(s for _, k, s in samples
+                             if k == "interactive")
+        background = sorted(s for _, k, s in samples
+                            if k == "background")
+
+        def p99(xs: List[float]) -> Optional[float]:
+            if not xs:
+                return None
+            return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+        events = sum(1 for a in script.actions
+                     if a.op in ("create", "delete", "update",
+                                 "drift_record"))
+        return {
+            "family": script.family,
+            "seed": script.seed,
+            "events": events,
+            "services": len(live),
+            "makespan_sim_s": round(makespan, 3),
+            "throughput_events_per_sim_s":
+                round(events / max(makespan, 1e-9), 2),
+            "p99_interactive_s": (round(p99(interactive), 4)
+                                  if interactive else None),
+            "p99_background_s": (round(p99(background), 4)
+                                 if background else None),
+            "mutation_calls": round(
+                reg.counter_value("provider_mutation_flushes_total")
+                - flushes0),
+            "mutation_intents": round(
+                reg.counter_value("provider_mutations_enqueued_total")
+                - enq0),
+            "drift_injected": drift_count,
+            "drift_repair_mean_s": (round(
+                sum(drift_lags) / len(drift_lags), 3)
+                if drift_lags else None),
+            "drift_repair_max_s": (round(max(drift_lags), 3)
+                                   if drift_lags else None),
+            "wall_s": round(time.perf_counter() - wall0, 2),
+            "knob_trajectory": knob_trajectory,
+            "tuner_log": tuner_log,
+            # the AWS fault engine's ordered decision stream (virtual
+            # timestamps): byte-identical across replays of one seed
+            "chaos_log": chaos_log,
+            # canonical, order-stable ledger slice: what a replay of
+            # the same (family, seed) must reproduce byte-identically
+            "ledger": [
+                [r["key"], r["controller"], r["origin"],
+                 sorted(r["stages"].items()), r["total_s"]]
+                for r in default_ledger.snapshot(
+                    limit=100000)[ledger_before:]],
+        }
+
+    def _apply(self, a: Action, cluster, cloud, zones, topology,
+               svc_for, live, pending_drift, drift_lock,
+               good_aliases) -> None:
+        if a.op == "create":
+            if a.name in live:
+                return   # overlapping churn picked the name twice
+            cluster.kube.services.create(svc_for(a))
+            live[a.name] = a
+        elif a.op == "delete":
+            if a.name in live:
+                try:
+                    cluster.kube.services.delete("default", a.name)
+                except Exception:
+                    pass
+                live.pop(a.name, None)
+        elif a.op == "update":
+            if a.name not in live:
+                return
+            try:
+                svc = cluster.kube.services.get(
+                    "default", a.name).deep_copy()
+                svc.metadata.annotations[a.param("annotation")] = \
+                    a.param("value")
+                cluster.kube.services.update(svc)
+            except Exception:
+                pass
+        elif a.op == "drift_record":
+            created = live.get(a.name)
+            if created is None:
+                return
+            zone = zones[int(created.param("zone", 0))]
+            rname = f"{a.name}.z{created.param('zone', 0)}" \
+                    f".fuzz.example.com"
+            # the GOOD state is whatever the controller converged the
+            # record to (an alias to the accelerator's DNS name, not
+            # the NLB's): read it before corrupting — but a record
+            # RE-drifted before its repair landed reuses the
+            # remembered good value, never the live rogue one.  A
+            # record not converged yet is skipped — nothing to drift.
+            with drift_lock:
+                good = good_aliases.get((zone.id, rname))
+            if good is None:
+                good = _record_alias(cloud, zone.id, rname)
+            if good is None:
+                return
+            rogue = f"{a.param('rogue')}.elb.{REGIONS[0]}" \
+                    f".amazonaws.com"
+            try:
+                cloud.faults.edit_record_set(
+                    zone.id, rname, "A", alias_dns_name=rogue)
+            except Exception:
+                return
+            with drift_lock:
+                good_aliases[(zone.id, rname)] = good
+                # a re-drift of a still-unrepaired record keeps the
+                # ORIGINAL injection time: the measured lag covers
+                # the whole corrupted window
+                if (zone.id, rname) not in pending_drift:
+                    pending_drift[(zone.id, rname)] = (
+                        simclock.monotonic(), good)
+        elif a.op == "partition":
+            if topology is not None:
+                topology.partition_region(a.param("region"),
+                                          rate=a.param("rate", 1.0))
+        elif a.op == "heal":
+            if topology is not None:
+                topology.heal_region(a.param("region"))

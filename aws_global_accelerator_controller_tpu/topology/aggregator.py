@@ -59,6 +59,7 @@ from contextlib import ExitStack
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..analysis import locks
+from ..autotune import knobs as knobcat
 from ..metrics import record_region_batch
 from ..resilience import ErrorClass, FencedError, classify
 from ..resilience.fence import flush_permit, push_write_fence
@@ -137,7 +138,7 @@ class RegionAggregator:
     opens its own circuit without tripping its siblings'."""
 
     def __init__(self, apis_for: Callable[[str], object], topology,
-                 linger: float = 0.002,
+                 linger: float = knobcat.FAKE_COALESCER_LINGER,
                  clock: Callable[[], float] = simclock.monotonic):
         self._apis_for = apis_for
         self._topology = topology

@@ -47,6 +47,8 @@ import logging
 from typing import Callable, Dict, Optional, Tuple
 
 from ..analysis import locks
+from ..autotune import knobs as knobcat
+from ..autotune import targets as tune_targets
 from ..metrics import record_region_digest_exchange
 
 logger = logging.getLogger(__name__)
@@ -90,12 +92,19 @@ class RegionDigestGate:
     without a gateway disables the gate (every key sweeps)."""
 
     def __init__(self, apis_for: Callable[[str], object], topology,
-                 stability_waves: Optional[int] = None):
+                 stability_waves: Optional[int] = None,
+                 exchange_every: int = knobcat.DIGEST_EXCHANGE_EVERY):
         self._apis = apis_for
         self._topology = topology
         self._stability = (stability_waves
                            if stability_waves is not None
                            else topology.digest_stability_waves)
+        # exchange cadence (feedback-tunable, autotune/): refresh the
+        # region digest only every this-many wave advances; between
+        # refreshes CLEAN verdicts ride the cached digest, trading
+        # drift-detection lag (bounded by cadence × resync period)
+        # for fewer cross-region reads.  1 = every wave.
+        self._exchange_every = max(1, int(exchange_every))
         self._lock = locks.make_lock("region-digest-gate")
         self._state: Dict[str, _RegionState] = {}
         # region -> (highest wave seen, digest or None): one exchange
@@ -104,6 +113,13 @@ class RegionDigestGate:
         # counters, so only a strictly higher wave refreshes; lagging
         # counters ride the cached answer instead of thrashing it
         self._wave_cache: Dict[str, Tuple[int, Optional[str]]] = {}
+        tune_targets.note_digest_gate(self)
+
+    def set_exchange_every(self, exchange_every: int) -> None:
+        """Retune the exchange cadence live (the autotune registry's
+        apply surface)."""
+        with self._lock:
+            self._exchange_every = max(1, int(exchange_every))
 
     def note_sweep_period(self, sweep_every: int) -> None:
         """A consumer declares its sweep period: CLEAN must be earned
@@ -139,7 +155,11 @@ class RegionDigestGate:
         exchange failed (partition, no gateway): never clean."""
         with self._lock:
             cached = self._wave_cache.get(region)
-            if cached is not None and wave <= cached[0]:
+            # cadence: a refresh happens only when the wave advanced
+            # past the last refresh by the exchange_every stride; the
+            # waves in between (and lagging consumers) ride the cache
+            if cached is not None \
+                    and wave < cached[0] + self._exchange_every:
                 return cached[1], False
         digest: Optional[str] = None
         try:
@@ -154,7 +174,8 @@ class RegionDigestGate:
             digest = None
         with self._lock:
             cached = self._wave_cache.get(region)
-            if cached is not None and wave <= cached[0]:
+            if cached is not None \
+                    and wave < cached[0] + self._exchange_every:
                 # a concurrent caller won the refresh race
                 return cached[1], False
             self._wave_cache[region] = (wave, digest)

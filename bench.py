@@ -13,6 +13,7 @@ endpoint-weight planning throughput on the available accelerator.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import sys
@@ -910,6 +911,156 @@ def bench_scale_storm(n_services: int = 100_000, workers: int = 4,
                 "peak_rss_bytes", "call_latency_s", "shards")})
     return out
 
+
+
+# the adaptive-soak fuzzed families and their per-family scenario
+# shapes: (n_services, duration, win metric) — the metric each family
+# pressures (drift families are measured on repair lag; storm families
+# on p99 event->converged).  seed 20260805 is the recorded baseline;
+# hack/fuzz_replay.py re-runs any recorded scenario from it.
+ADAPTIVE_SOAK_FAMILIES = {
+    "bursty-creates": (64, 90.0, "p99_interactive_s"),
+    "flapping-updates": (48, 90.0, "p99_interactive_s"),
+    "zone-skewed-churn": (48, 90.0, "p99_interactive_s"),
+    "delete-waves": (48, 90.0, "p99_interactive_s"),
+    "slow-drip-drift": (24, 120.0, "drift_repair_mean_s"),
+}
+
+FUZZ_ARTIFACT_DIR = os.path.join("bench_artifacts", "fuzz")
+
+
+def _adaptive_soak_leg(family: str, seed: int, adaptive: bool,
+                       n_services: int, duration: float,
+                       workers: int) -> dict:
+    """One A/B arm: replay the (family, seed) fuzzed scenario under a
+    fresh virtual clock against a fresh world, knobs frozen at their
+    defaults (static) or steered by the autotune engine (adaptive)."""
+    from aws_global_accelerator_controller_tpu.autotune import (
+        AutotuneConfig,
+    )
+    from aws_global_accelerator_controller_tpu.simulation import (
+        clock as simclock,
+    )
+    from aws_global_accelerator_controller_tpu.simulation.fuzzer import (
+        ScenarioRunner,
+        generate,
+    )
+
+    script = generate(family, seed, n_services=n_services,
+                      duration=duration)
+    clk = simclock.VirtualClock(max_virtual=24 * 3600.0).activate()
+    try:
+        autotune = (AutotuneConfig(enabled=True, interval=0.5)
+                    if adaptive else None)
+        out = ScenarioRunner(script, workers=workers,
+                             autotune=autotune).run()
+    finally:
+        clk.deactivate()
+    out["adaptive"] = adaptive
+    out["script_sha"] = hashlib.sha1(
+        script.canonical_json().encode()).hexdigest()
+    return out
+
+
+def bench_adaptive_soak(families=None, seed: int = 20260805,
+                        workers: int = 2,
+                        record: bool = False) -> dict:
+    """The adaptive-vs-static proof (ISSUE 15): for each fuzzed
+    scenario family, run the SAME seeded workload script twice under
+    virtual time — knobs frozen at their defaults vs steered live by
+    the autotune engine — and compare the family's pressure metric
+    (p99 event->converged for the storm shapes, mean drift-repair lag
+    for the drip shape) plus wire mutation calls.
+
+    Each adaptive arm's scenario is recorded to
+    ``bench_artifacts/fuzz/<family>-<seed>.json`` (script + config +
+    convergence-ledger slice + knob trajectory): the replay artifact
+    ``hack/fuzz_replay.py`` re-runs from the seed alone and diffs the
+    ledger, exit 1 on divergence — the determinism contract, enforced
+    as a CI smoke (``make fuzz-smoke``).
+
+    ``record=True`` appends ONE entry tagged ``bench: adaptive-soak``
+    with per-family speedups AND the per-knob trajectories
+    (initial->final, adjustment count) so future PRs can read what
+    the tuner actually did."""
+    chosen = dict(ADAPTIVE_SOAK_FAMILIES)
+    if families is not None:
+        chosen = {f: ADAPTIVE_SOAK_FAMILIES[f] for f in families}
+    legs = {}
+    wins = 0
+    for family, (n, duration, metric) in chosen.items():
+        static = _adaptive_soak_leg(family, seed, False, n, duration,
+                                    workers)
+        adaptive = _adaptive_soak_leg(family, seed, True, n, duration,
+                                      workers)
+        s_val, a_val = static.get(metric), adaptive.get(metric)
+        speedup = (round(s_val / a_val, 2)
+                   if s_val and a_val else None)
+        won = bool(speedup is not None and speedup > 1.0)
+        wins += won
+        legs[family] = {
+            "metric": metric,
+            "static": s_val,
+            "adaptive": a_val,
+            "speedup": speedup,
+            "adaptive_wins": won,
+            "static_calls": static["mutation_calls"],
+            "adaptive_calls": adaptive["mutation_calls"],
+            "call_reduction": round(
+                static["mutation_calls"]
+                / max(1, adaptive["mutation_calls"]), 2),
+            "knob_trajectory": adaptive["knob_trajectory"],
+            "tuner_moves": len([d for d in adaptive["tuner_log"]
+                                if d["action"] == "adjust"]),
+            "tuner_freezes": len([d for d in adaptive["tuner_log"]
+                                  if d["action"] == "freeze"]),
+        }
+        print(f"adaptive-soak {family}: {metric} {s_val} -> {a_val} "
+              f"({speedup}x), calls {static['mutation_calls']} -> "
+              f"{adaptive['mutation_calls']}",
+              file=sys.stderr, flush=True)
+        _write_fuzz_artifact(family, seed, n, duration, workers,
+                             adaptive)
+    out = {"seed": seed, "workers": workers, "families": legs,
+           "adaptive_wins": wins, "families_run": len(legs)}
+    if record:
+        _record_reconcile_history(
+            # throughput here is "families won / run" — a tag-skipped
+            # entry, never part of the floor derivation
+            {"services": sum(v[0] for v in chosen.values()),
+             "throughput": float(wins)},
+            bench="adaptive-soak",
+            extra={"seed": seed, "adaptive_wins": wins,
+                   "families_run": len(legs),
+                   "families": {
+                       f: {k: leg[k] for k in
+                           ("metric", "static", "adaptive", "speedup",
+                            "static_calls", "adaptive_calls",
+                            "knob_trajectory", "tuner_moves")}
+                       for f, leg in legs.items()}})
+    return out
+
+
+def _write_fuzz_artifact(family: str, seed: int, n_services: int,
+                         duration: float, workers: int,
+                         adaptive_leg: dict) -> None:
+    """Record one adaptive scenario for the replay tool: everything a
+    fresh process needs to re-run it from the seed and diff the
+    convergence ledger (hack/fuzz_replay.py)."""
+    try:
+        os.makedirs(FUZZ_ARTIFACT_DIR, exist_ok=True)
+        path = os.path.join(FUZZ_ARTIFACT_DIR, f"{family}-{seed}.json")
+        with open(path, "w") as f:
+            json.dump({
+                "family": family, "seed": seed,
+                "n_services": n_services, "duration": duration,
+                "workers": workers, "adaptive": True,
+                "script_sha": adaptive_leg["script_sha"],
+                "ledger": adaptive_leg["ledger"],
+                "knob_trajectory": adaptive_leg["knob_trajectory"],
+            }, f, sort_keys=True)
+    except OSError:
+        pass  # read-only checkout: the soak numbers still stand
 
 
 def _region_fanin_leg(n_services: int, regions, workers: int,
@@ -3489,6 +3640,27 @@ def reconcile_floor(default: float = 400.0, trailing: int = 8,
                             0.9 * min(window)))
 
 
+# Every tag a non-create-storm leg may stamp on a history entry.  The
+# floor derivation skips ANY tagged entry (reconcile_floor above), and
+# the smoke test introspects THIS set to prove that — so a new bench
+# leg registers its tag here and needs no test edit (the old ritual:
+# every PR hand-extended the test's tag list).
+BENCH_TAGS = frozenset({
+    "batch-efficiency",
+    "steady-state",
+    "trace-overhead",
+    "restart-recovery",
+    "mixed-soak",
+    "shard-scaling",
+    "rollout-ramp",
+    "region-fanin",
+    "scale-storm",
+    "fleet-plan",
+    "accel-preflight",
+    "adaptive-soak",
+})
+
+
 def _record_reconcile_history(reconcile: dict, bench: "str | None" = None,
                               extra: "dict | None" = None) -> None:
     """Append the control-plane number to a committed round-over-round
@@ -3497,7 +3669,13 @@ def _record_reconcile_history(reconcile: dict, bench: "str | None" = None,
     tags entries from other workloads (batch-efficiency) so
     ``reconcile_floor`` keeps deriving from the pure create storm;
     ``extra`` carries that bench's own figures (mutation calls per
-    service, fold ratio)."""
+    service, fold ratio).  A tag must be registered in ``BENCH_TAGS``
+    — an unregistered tag would silently escape the floor's skip-test
+    coverage."""
+    if bench is not None and bench not in BENCH_TAGS:
+        raise ValueError(
+            f"unregistered bench tag {bench!r}: add it to "
+            f"bench.BENCH_TAGS (the floor tag-skip contract)")
     try:
         os.makedirs(os.path.dirname(_HISTORY_PATH), exist_ok=True)
         entry = {
@@ -3845,6 +4023,7 @@ _NAMED = {
     "trace-overhead": lambda: bench_trace_overhead(record=True),
     "restart-recovery": lambda: bench_restart_recovery(record=True),
     "scale-storm": lambda: bench_scale_storm(record=True),
+    "adaptive-soak": lambda: bench_adaptive_soak(record=True),
     "shard-scaling": lambda: bench_shard_scaling(record=True),
     "mixed-soak": lambda: bench_mixed_soak(record=True),
     "rollout-ramp": lambda: bench_rollout_ramp(record=True),

@@ -1,0 +1,174 @@
+#!/usr/bin/env python3
+"""Replay a recorded adaptive-soak scenario from its seed and diff
+the convergence ledger against the recorded run (ISSUE 15).
+
+The adaptive-soak bench (``bench.py adaptive-soak``) records each
+adaptive arm to ``bench_artifacts/fuzz/<family>-<seed>.json``: the
+(family, seed) replay handle, the script's sha1 (generator-drift
+guard), the run config, and the convergence-ledger slice the run
+produced.  This tool regenerates the script from NOTHING but the
+seed, re-runs it under a fresh virtual clock with the same autotune
+config, and diffs the ledgers record-by-record — the cross-process
+half of the determinism contract tests/chaos/test_chaos_determinism
+proves in-process.
+
+Exit codes:
+  0  ledgers byte-identical (the scenario replays)
+  1  DIVERGENCE — a wall-clock leak, an unseeded draw, or a behavior
+     change landed since the artifact was recorded (bounded diff on
+     stderr)
+  2  not comparable: unreadable artifact, or the script generator
+     itself changed (script sha mismatch — re-record, don't diff)
+
+Usage:
+  python hack/fuzz_replay.py bench_artifacts/fuzz/<family>-<seed>.json
+  python hack/fuzz_replay.py --selftest   # record a small scenario,
+                                          # then replay it in a FRESH
+                                          # subprocess (make fuzz-smoke)
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+SELFTEST_FAMILY = "bursty-creates"
+SELFTEST_SEED = 20260805
+SELFTEST_N = 12
+SELFTEST_DURATION = 40.0
+
+
+def _run_scenario(family: str, seed: int, n_services: int,
+                  duration: float, workers: int,
+                  interval: float) -> dict:
+    from aws_global_accelerator_controller_tpu.autotune import (
+        AutotuneConfig,
+    )
+    from aws_global_accelerator_controller_tpu.simulation import (
+        clock as simclock,
+    )
+    from aws_global_accelerator_controller_tpu.simulation.fuzzer import (
+        ScenarioRunner,
+        generate,
+    )
+
+    script = generate(family, seed, n_services=n_services,
+                      duration=duration)
+    clk = simclock.VirtualClock(max_virtual=24 * 3600.0).activate()
+    try:
+        out = ScenarioRunner(
+            script, workers=workers,
+            autotune=AutotuneConfig(enabled=True,
+                                    interval=interval)).run()
+    finally:
+        clk.deactivate()
+    out["script_sha"] = hashlib.sha1(
+        script.canonical_json().encode()).hexdigest()
+    return out
+
+
+def _diff_ledgers(recorded, replayed) -> int:
+    """Bounded record-level diff; returns the divergence count."""
+    div = 0
+    for i, (a, b) in enumerate(zip(recorded, replayed)):
+        if a != b:
+            div += 1
+            if div <= 5:
+                print(f"  record {i}:\n    recorded: {a}\n"
+                      f"    replayed: {b}", file=sys.stderr)
+    if len(recorded) != len(replayed):
+        div += abs(len(recorded) - len(replayed))
+        print(f"  length: recorded {len(recorded)} vs replayed "
+              f"{len(replayed)}", file=sys.stderr)
+    return div
+
+
+def replay(path: str) -> int:
+    try:
+        with open(path) as f:
+            art = json.load(f)
+        family, seed = art["family"], int(art["seed"])
+        n, duration = int(art["n_services"]), float(art["duration"])
+        workers = int(art.get("workers", 2))
+        interval = float(art.get("interval", 0.5))
+        recorded_sha = art["script_sha"]
+        recorded_ledger = art["ledger"]
+    except (OSError, KeyError, ValueError, json.JSONDecodeError) as e:
+        print(f"fuzz_replay: unreadable artifact {path}: {e}",
+              file=sys.stderr)
+        return 2
+    print(f"fuzz_replay: re-running {family}:{seed} "
+          f"({n} services, {duration}s sim) from the seed alone...",
+          file=sys.stderr)
+    out = _run_scenario(family, seed, n, duration, workers, interval)
+    if out["script_sha"] != recorded_sha:
+        print("fuzz_replay: the script GENERATOR changed since this "
+              "artifact was recorded (sha mismatch) — ledgers are "
+              "not comparable; re-record with bench.py adaptive-soak",
+              file=sys.stderr)
+        return 2
+    # normalize through one JSON round-trip: the recorded side lived
+    # through json.dump (tuples become lists)
+    replayed = json.loads(json.dumps(out["ledger"]))
+    div = _diff_ledgers(recorded_ledger, replayed)
+    if div:
+        print(f"fuzz_replay: DIVERGED — {div} ledger record(s) "
+              f"differ: a wall-clock leak or unseeded draw broke "
+              f"replay-identity (lint L115 and the determinism suite "
+              f"are the usual suspects)", file=sys.stderr)
+        return 1
+    print(f"fuzz_replay: OK — {len(replayed)} ledger records "
+          f"byte-identical", file=sys.stderr)
+    return 0
+
+
+def selftest() -> int:
+    """Record a small scenario, then replay it in a FRESH subprocess:
+    the true cross-process determinism check (make fuzz-smoke)."""
+    print("fuzz_replay --selftest: recording "
+          f"{SELFTEST_FAMILY}:{SELFTEST_SEED}...", file=sys.stderr)
+    out = _run_scenario(SELFTEST_FAMILY, SELFTEST_SEED, SELFTEST_N,
+                        SELFTEST_DURATION, workers=2, interval=0.5)
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="fuzz-smoke-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump({
+                "family": SELFTEST_FAMILY, "seed": SELFTEST_SEED,
+                "n_services": SELFTEST_N,
+                "duration": SELFTEST_DURATION,
+                "workers": 2, "interval": 0.5, "adaptive": True,
+                "script_sha": out["script_sha"],
+                "ledger": out["ledger"],
+            }, f, sort_keys=True)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), path],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            timeout=600)
+        return proc.returncode
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def main(argv) -> int:
+    args = [a for a in argv[1:]]
+    if "--selftest" in args:
+        return selftest()
+    paths = [a for a in args if not a.startswith("--")]
+    if len(paths) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    return replay(paths[0])
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -28,7 +28,7 @@ legitimate code):
 
 Suppress a line with ``# noqa`` or ``# noqa: L00X``.
 
-The concurrency contract rules (L101-L116, see
+The concurrency contract rules (L101-L117, see
 aws_global_accelerator_controller_tpu/analysis/concurrency_lint.py) run
 with ``--concurrency`` (only them) or ``--all`` (both passes — what
 ``make lint`` runs).  ``tests/lint_fixtures/`` holds deliberately

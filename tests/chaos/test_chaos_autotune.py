@@ -1,0 +1,127 @@
+"""Lying-signal chaos e2e (ISSUE 15 acceptance): a
+FaultInjector-corrupted signal stream must leave the plane within
+noise of the static one — the tuner FREEZES to defaults instead of
+steering on garbage; no wedge, no oscillation.
+
+Three arms of the SAME fuzzed scenario under virtual time:
+
+- **static**: no engine — the baseline plane;
+- **adaptive**: healthy signals — the tuner steers (sanity: it
+  actually moves knobs on this workload);
+- **corrupted**: the engine runs but every other sampled signal is
+  deterministic garbage (NaN / negative / 1e12) — the freeze path.
+
+The corrupted arm must (a) freeze (autotune_frozen_total moves, the
+decision log ends frozen), (b) hold every knob at its default, and
+(c) converge the same fleet with p99 and makespan within noise of
+static — the engine's worst case is provably the static plane.
+"""
+import json
+
+from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.autotune import (
+    AutotuneConfig,
+)
+from aws_global_accelerator_controller_tpu.simulation import (
+    clock as simclock,
+)
+from aws_global_accelerator_controller_tpu.simulation.fuzzer import (
+    ScenarioRunner,
+    generate,
+)
+
+SEED = 20260815
+FAMILY = "bursty-creates"
+N_SERVICES = 32
+DURATION = 60.0
+
+
+def _drain_stragglers():
+    import threading
+    import time as _t
+
+    names = ("-worker-", "informer-", "workqueue-waker-",
+             "event-broadcaster", "-controller", "autotune-engine")
+    deadline = _t.monotonic() + 8.0
+    while _t.monotonic() < deadline:
+        if not [t.name for t in threading.enumerate()
+                if any(n in (t.name or "") for n in names)]:
+            return
+        _t.sleep(0.05)
+
+
+def _leg(adaptive: bool, signal_corruption: float = 0.0) -> dict:
+    _drain_stragglers()
+    script = generate(FAMILY, SEED, n_services=N_SERVICES,
+                      duration=DURATION)
+    clk = simclock.VirtualClock(max_virtual=14400.0).activate()
+    try:
+        autotune = (AutotuneConfig(enabled=True, interval=0.5)
+                    if adaptive else None)
+        return ScenarioRunner(
+            script, workers=2, autotune=autotune,
+            signal_corruption=signal_corruption).run()
+    finally:
+        clk.deactivate()
+
+
+def test_corrupted_signal_stream_freezes_within_noise_of_static(
+        race_detectors):
+    static = _leg(adaptive=False)
+    healthy = _leg(adaptive=True)
+    frozen_before = metrics.default_registry.counter_value(
+        "autotune_frozen_total")
+    corrupted = _leg(adaptive=True, signal_corruption=0.5)
+    frozen_delta = metrics.default_registry.counter_value(
+        "autotune_frozen_total") - frozen_before
+
+    # every arm converged the whole fleet — no wedge anywhere
+    assert static["services"] == N_SERVICES
+    assert corrupted["services"] == N_SERVICES
+
+    # sanity: on HEALTHY signals this workload makes the tuner move
+    # (otherwise "frozen looks like static" would be vacuous)
+    healthy_moves = [d for d in healthy["tuner_log"]
+                     if d["action"] == "adjust"]
+    assert healthy_moves, "the healthy arm tuned nothing — the " \
+                          "corrupted arm's stillness proves nothing"
+
+    # (a) the corrupted stream FROZE the tuner, loudly and repeatedly
+    assert frozen_delta > 0, "no autotune_frozen_total movement"
+    freezes = [d for d in corrupted["tuner_log"]
+               if d["action"] == "freeze"]
+    assert freezes, "no freeze decisions under a corrupted stream"
+    reasons = {r for d in freezes for r in d["reason"]}
+    assert reasons & {"non-finite:sheds", "implausible:sheds"} \
+        or any(r.startswith(("non-finite", "implausible",
+                             "regressed", "stalled"))
+               for r in reasons), reasons
+
+    # (b) every knob held its default: snap-to-default, no steering,
+    # no oscillation (a frozen plane IS the static plane)
+    for knob, traj in corrupted["knob_trajectory"].items():
+        assert traj["final"] == traj["initial"], \
+            f"{knob} moved under a corrupted signal stream: {traj}"
+    adjusts = [d for d in corrupted["tuner_log"]
+               if d["action"] == "adjust"]
+    assert len(adjusts) <= 2, \
+        f"tuner oscillated on garbage: {adjusts}"
+
+    # (c) throughput/latency within noise of static.  Virtual time
+    # makes both arms near-deterministic; the bound is generous only
+    # for scheduler-interleaving noise.
+    assert corrupted["makespan_sim_s"] \
+        <= 1.25 * static["makespan_sim_s"], (static, corrupted)
+    if static["p99_interactive_s"] and corrupted["p99_interactive_s"]:
+        assert corrupted["p99_interactive_s"] \
+            <= 1.5 * static["p99_interactive_s"], (static, corrupted)
+    # and the corrupted arm pays the static arm's wire bill, not a
+    # mistuned one
+    assert corrupted["mutation_calls"] \
+        <= 1.25 * static["mutation_calls"]
+
+    # the corruption itself was real and logged (seeded, replayable)
+    assert any(d["source"] == "signal"
+               for d in corrupted["chaos_log"]), \
+        "no signal corruption decisions logged"
+    json.dumps(corrupted["tuner_log"])   # plain serializable data

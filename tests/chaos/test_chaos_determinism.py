@@ -322,3 +322,63 @@ def test_multi_region_seeded_scenario_replays_byte_identical(
     assert json.loads(top1), "the partial partition injected nothing"
     assert ledger1 == ledger2, "convergence ledgers diverged"
     assert state1 == state2, "final fake-cloud state diverged"
+
+
+# ---------------------------------------------------------------------------
+# Fuzzer determinism (ISSUE 15): a (family, seed) pair expands to a
+# byte-identical workload script, and replaying it — with the autotune
+# engine STEERING and the zone throttle injecting — reproduces
+# byte-identical chaos decision logs, tuner decision logs and
+# convergence ledgers.  This is the contract hack/fuzz_replay.py (and
+# make fuzz-smoke) enforces across processes.
+# ---------------------------------------------------------------------------
+
+
+def _run_fuzzed_scenario():
+    from aws_global_accelerator_controller_tpu.autotune import (
+        AutotuneConfig,
+    )
+    from aws_global_accelerator_controller_tpu.simulation.fuzzer import (
+        ScenarioRunner,
+        generate,
+    )
+
+    _drain_stragglers()
+    script = generate("bursty-creates", SEED, n_services=10,
+                      duration=40.0)
+    clk = simclock.VirtualClock(max_virtual=7200.0).activate()
+    try:
+        out = ScenarioRunner(
+            script, workers=2,
+            autotune=AutotuneConfig(enabled=True,
+                                    interval=0.5)).run()
+    finally:
+        clk.deactivate()
+    return (script.canonical_json(),
+            json.dumps(out["chaos_log"], sort_keys=True),
+            json.dumps(out["tuner_log"], sort_keys=True),
+            json.dumps(out["ledger"], sort_keys=True))
+
+
+def test_fuzzed_scenario_replays_byte_identical(race_detectors):
+    """Same seed ⇒ same script, same injected faults, same tuner
+    moves, same per-key stage stories — twice, under virtual time."""
+    from aws_global_accelerator_controller_tpu.simulation import (
+        fuzzer,
+    )
+
+    # generation alone is pure: byte-identical scripts, every family
+    for family in fuzzer.FAMILIES:
+        s1 = fuzzer.generate(family, SEED).canonical_json()
+        s2 = fuzzer.generate(family, SEED).canonical_json()
+        assert s1 == s2, f"{family} script generation diverged"
+        assert s1 != fuzzer.generate(family, SEED + 1).canonical_json()
+
+    script1, chaos1, tuner1, ledger1 = _run_fuzzed_scenario()
+    script2, chaos2, tuner2, ledger2 = _run_fuzzed_scenario()
+    assert script1 == script2, "workload scripts diverged"
+    assert chaos1 == chaos2, "AWS chaos decision streams diverged"
+    assert tuner1 == tuner2, "autotune decision logs diverged"
+    assert ledger1 == ledger2, "convergence ledgers diverged"
+    assert json.loads(ledger1), "scenario converged nothing"
+    assert json.loads(tuner1), "the tuner made no decisions at all"

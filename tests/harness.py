@@ -40,7 +40,8 @@ class Cluster:
                  policy_checkpoint: str = "", resilience=None,
                  fault_seed=None, coalesce=None, fingerprints=None,
                  api=None, cloud=None, num_shards: int = 1,
-                 discovery_cache_ttl=None, topology=None):
+                 discovery_cache_ttl=None, topology=None,
+                 autotune=None):
         from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
             FingerprintConfig,
         )
@@ -74,6 +75,9 @@ class Cluster:
                 queue_burst=queue_burst, weight_policy=weight_policy,
                 policy_checkpoint=policy_checkpoint,
                 fingerprints=fingerprints),
+            # autotune (autotune/engine.py AutotuneConfig): None = the
+            # static plane, byte-identical pre-autotune behavior
+            autotune=autotune,
         )
 
     def start(self):

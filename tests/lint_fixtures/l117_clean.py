@@ -1,0 +1,22 @@
+"""L117 fixture: the clean spellings — knob values imported from the
+catalog, non-knob numerics untouched, waived deliberate divergence."""
+
+from aws_global_accelerator_controller_tpu.autotune import knobs
+
+
+class Config:
+    def __init__(self, linger=knobs.COALESCER_LINGER,
+                 sweep_every: int = knobs.SWEEP_EVERY):
+        self.linger = linger
+        self.sweep_every = sweep_every
+        self.max_batch = 64            # not a registered knob
+        self.timeout = 5.0             # not a registered knob
+
+
+DEFAULT_AGING_HORIZON = knobs.QUEUE_AGING_HORIZON
+TEST_PROFILE_LINGER = 0.5  # race: deliberate divergent test profile
+
+
+def build(linger=None):
+    return Config(linger=knobs.FAKE_COALESCER_LINGER
+                  if linger is None else linger)

@@ -1,0 +1,19 @@
+"""L117 fixture: registry-owned knobs re-hardcoded as numeric
+literals — every flagged shape (keyword argument, signature default,
+plain and attribute assignment)."""
+
+
+class Config:
+    def __init__(self, linger=0.005, sweep_every: int = 10):  # 2 findings
+        self.linger = linger
+        self.sweep_every = sweep_every
+
+
+DEFAULT_AGING_HORIZON = 2.0          # finding: suffix-matched assignment
+
+
+def build():
+    cfg = Config(linger=0.009)       # finding: keyword literal
+    cfg.age_watermark = 1.5          # finding: attribute assignment
+    depth_watermark = 512            # finding: plain assignment
+    return cfg, depth_watermark

@@ -1,0 +1,519 @@
+"""Unit tests for the self-tuning control plane (autotune/): the
+TunableRegistry's clamp/pin/freeze contract, the AIMD and hill-climb
+laws' hysteresis/cooldown/decay scaffolding, the signal reader's
+anomaly detection (the lying-signal trust boundary), and the engine's
+freeze-on-anomaly tick."""
+import math
+
+import pytest
+
+from aws_global_accelerator_controller_tpu import metrics
+from aws_global_accelerator_controller_tpu.autotune import (
+    AutotuneConfig,
+    AutotuneEngine,
+    SignalReader,
+    TunableRegistry,
+    knobs,
+)
+from aws_global_accelerator_controller_tpu.autotune.controllers import (
+    AIMDController,
+    HOLD,
+    HillClimbController,
+    LOWER,
+    RAISE,
+)
+from aws_global_accelerator_controller_tpu.autotune.signals import (
+    SignalSnapshot,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_registry(**kw):
+    return TunableRegistry(clock=kw.pop("clock", FakeClock()), **kw)
+
+
+# ---------------------------------------------------------------------------
+# the catalog
+# ---------------------------------------------------------------------------
+
+def test_catalog_params_cover_every_knob_layer():
+    """The catalog names every knob family the tentpole promises —
+    coalescer, sweep, queue scheduler, breaker, digest."""
+    params = {spec.param for spec in knobs.KNOBS.values()}
+    assert params == {"linger", "warm_gap", "sweep_every",
+                      "aging_horizon", "depth_watermark",
+                      "age_watermark", "breaker_window",
+                      "exchange_every"}
+    for spec in knobs.KNOBS.values():
+        assert spec.lo <= spec.default <= spec.hi, spec.name
+
+
+def test_catalog_defaults_match_consumer_spellings():
+    """The consumers' shipped defaults ARE the catalog's — freeze
+    restores exactly the static plane."""
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws import (
+        batcher,
+    )
+    from aws_global_accelerator_controller_tpu.kube import workqueue
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintConfig,
+    )
+    from aws_global_accelerator_controller_tpu.resilience.wrapper import (
+        ResilienceConfig,
+    )
+
+    assert batcher.CoalesceConfig().linger == knobs.COALESCER_LINGER
+    assert FingerprintConfig().sweep_every == knobs.SWEEP_EVERY
+    assert workqueue.DEFAULT_AGING_HORIZON == knobs.QUEUE_AGING_HORIZON
+    assert workqueue.DEFAULT_DEPTH_WATERMARK \
+        == knobs.QUEUE_DEPTH_WATERMARK
+    assert workqueue.DEFAULT_AGE_WATERMARK == knobs.QUEUE_AGE_WATERMARK
+    assert ResilienceConfig().breaker_window == knobs.BREAKER_WINDOW
+
+
+# ---------------------------------------------------------------------------
+# TunableRegistry
+# ---------------------------------------------------------------------------
+
+def test_registry_clamps_and_quantizes():
+    reg = make_registry()
+    assert reg.set("coalescer.linger", 10.0) == 0.25      # hi clamp
+    assert reg.set("coalescer.linger", 0.0) == 0.0005     # lo clamp
+    assert reg.set("sweep.every", 7.4) == 7.0             # integer knob
+
+
+def test_registry_adjustment_direction_counted():
+    reg = make_registry()
+    reg.set("coalescer.linger", 0.01, direction="up")
+    reg.set("coalescer.linger", 0.01, direction="up")  # no-op: uncounted
+    assert metrics.default_registry.counter_value(
+        "autotune_adjustments_total",
+        {"knob": "coalescer.linger", "direction": "up"}) >= 1
+    assert metrics.default_registry.gauge_value(
+        "autotune_knob_value", {"knob": "coalescer.linger"}) == 0.01
+
+
+def test_registry_pin_refuses_controller_moves():
+    reg = make_registry(pins={"sweep.every": 4})
+    assert reg.current("sweep.every") == 4
+    assert reg.set("sweep.every", 20) == 4
+    reg.freeze("sweep.every", "anomaly")        # pins outrank freezes
+    assert reg.current("sweep.every") == 4
+
+
+def test_registry_freeze_snaps_to_default_and_holds():
+    clock = FakeClock()
+    reg = make_registry(clock=clock, freeze_cooldown=30.0)
+    reg.set("coalescer.linger", 0.1)
+    freezes0 = metrics.default_registry.counter_value(
+        "autotune_frozen_total")
+    reg.freeze("coalescer.linger", "implausible")
+    assert reg.current("coalescer.linger") == knobs.COALESCER_LINGER
+    assert metrics.default_registry.counter_value(
+        "autotune_frozen_total",
+        {"knob": "coalescer.linger", "reason": "implausible"}) >= 1
+    assert metrics.default_registry.counter_value(
+        "autotune_frozen_total") > freezes0
+    # held through the cooldown...
+    clock.t = 10.0
+    assert reg.set("coalescer.linger", 0.1) == knobs.COALESCER_LINGER
+    # ...and adjustable after it
+    clock.t = 31.0
+    assert reg.set("coalescer.linger", 0.1) == 0.1
+
+
+def test_registry_defaults_override_mirrors_the_plane():
+    """A plane built on the fake profile freezes to the FAKE linger,
+    not the catalog's production one."""
+    reg = make_registry(
+        defaults={"coalescer.linger": knobs.FAKE_COALESCER_LINGER})
+    reg.set("coalescer.linger", 0.2)
+    reg.freeze("coalescer.linger", "stalled")
+    assert reg.current("coalescer.linger") \
+        == knobs.FAKE_COALESCER_LINGER
+
+
+def test_registry_trajectory_reports_what_the_tuner_did():
+    reg = make_registry()
+    reg.set("sweep.every", 5, direction="down")
+    traj = reg.trajectory()["sweep.every"]
+    assert traj["initial"] == knobs.SWEEP_EVERY
+    assert traj["final"] == 5
+    assert traj["adjustments"] == 1
+
+
+# ---------------------------------------------------------------------------
+# live-target application
+# ---------------------------------------------------------------------------
+
+def test_registry_applies_to_live_targets():
+    """One registry move reaches every live coalescer, queue, breaker,
+    fingerprint cache and digest-gate target."""
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.batcher import (  # noqa: E501
+        MutationCoalescer,
+    )
+    from aws_global_accelerator_controller_tpu.kube.workqueue import (
+        RateLimitingQueue,
+    )
+    from aws_global_accelerator_controller_tpu.kube import workqueue
+    from aws_global_accelerator_controller_tpu.autotune import targets
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintCache,
+    )
+    from aws_global_accelerator_controller_tpu.resilience.breaker import (
+        CircuitBreaker,
+    )
+
+    co = MutationCoalescer(apis=None)
+    q = workqueue.new_rate_limiting_queue(name="tune-t")
+    br = CircuitBreaker("tune-test")
+    fp = FingerprintCache("tune-test", lambda o: ())
+    assert co in targets.coalescers()
+    assert q in targets.queues()
+    assert br in targets.breakers()
+    assert fp in targets.fingerprint_caches()
+
+    reg = make_registry()
+    reg.set("coalescer.linger", 0.05)
+    reg.set("coalescer.warm_gap", 0.04)
+    reg.set("queue.aging_horizon", 6.0)
+    reg.set("queue.depth_watermark", 1024)
+    reg.set("breaker.window", 60.0)
+    reg.set("sweep.every", 3)
+    assert co.config.linger == 0.05
+    assert co.config.effective_warm_gap == 0.04
+    assert q.aging_horizon == 6.0
+    assert q.depth_watermark == 1024
+    assert br.window == 60.0
+    assert fp.config.sweep_every == 3
+    if isinstance(q, RateLimitingQueue):
+        q.shutdown()
+
+
+def test_set_sweep_every_swaps_not_mutates_shared_config():
+    """The three controllers may share ONE FingerprintConfig object:
+    retuning one cache must never rewrite a sibling's config."""
+    from aws_global_accelerator_controller_tpu.reconcile.fingerprint import (  # noqa: E501
+        FingerprintCache,
+        FingerprintConfig,
+    )
+
+    shared = FingerprintConfig()
+    a = FingerprintCache("tune-a", lambda o: (), shared)
+    b = FingerprintCache("tune-b", lambda o: (), shared)
+    a.set_sweep_every(3)
+    assert a.config.sweep_every == 3
+    assert shared.sweep_every == knobs.SWEEP_EVERY
+    assert b.config is shared
+
+
+# ---------------------------------------------------------------------------
+# control laws
+# ---------------------------------------------------------------------------
+
+def snap(now=0.0, **deltas):
+    s = SignalSnapshot(now=now)
+    s.deltas = deltas
+    return s
+
+
+def test_aimd_multiplicative_move_and_cooldown():
+    reg = make_registry()
+    ctl = AIMDController(reg, "breaker.window", lambda s: RAISE,
+                         up_factor=2.0, cooldown=5.0)
+    assert ctl.update(snap(now=0.0)) == "up"
+    assert reg.current("breaker.window") == 60.0
+    # cooldown: the next tick is refused
+    assert ctl.update(snap(now=2.0)) is None
+    assert ctl.update(snap(now=6.0)) == "up"
+    assert reg.current("breaker.window") == 120.0
+    # clamped at hi: a further RAISE applies nothing
+    assert ctl.update(snap(now=12.0)) is None
+
+
+def test_aimd_decay_relaxes_toward_default():
+    reg = make_registry()
+    ctl = AIMDController(reg, "breaker.window", lambda s: HOLD,
+                         up_factor=2.0, cooldown=1.0, decay_after=3,
+                         decay_rate=0.5)
+    reg.set("breaker.window", 120.0)
+    now = [0.0]
+
+    def tick():
+        now[0] += 2.0
+        return ctl.update(snap(now=now[0]))
+
+    assert tick() is None and tick() is None     # holds under count
+    assert tick() == "down"                      # decay engages
+    assert reg.current("breaker.window") == 75.0
+    for _ in range(20):
+        tick()
+    assert reg.current("breaker.window") == knobs.BREAKER_WINDOW, \
+        "decay must terminate ON the default, not asymptote"
+
+
+def test_aimd_lower_uses_down_factor():
+    reg = make_registry()
+    ctl = AIMDController(reg, "queue.age_watermark",
+                         lambda s: LOWER, down_factor=0.5,
+                         cooldown=1.0)
+    assert ctl.update(snap(now=0.0)) == "down"
+    assert reg.current("queue.age_watermark") == 0.5
+
+
+def test_hillclimb_windows_objective_and_climbs():
+    """A monotone-response objective (more linger, better ratio):
+    the climb rises move after move, windowing samples between."""
+    reg = make_registry()
+    ctl = HillClimbController(
+        reg, "coalescer.linger",
+        lambda s: (s.delta("num"), s.delta("den")),
+        step_factor=2.0, cooldown=2.0, explore_up_at=1.2)
+    v0 = reg.current("coalescer.linger")
+    # ratio proportional to current value: improving as it climbs
+    t = 0.0
+    for _ in range(6):
+        t += 1.0
+        ctl.update(snap(now=t, num=reg.current("coalescer.linger")
+                        * 1000, den=1.0))
+    assert reg.current("coalescer.linger") > v0 * 3
+
+
+def test_hillclimb_reverses_on_windowed_worsening():
+    reg = make_registry()
+    ctl = HillClimbController(
+        reg, "coalescer.linger",
+        lambda s: (s.delta("num"), s.delta("den")),
+        step_factor=2.0, cooldown=1.0, deadband=0.05)
+    assert ctl.update(snap(now=1.0, num=100.0, den=10.0)) == "up"
+    # the window after the up-move measures far WORSE: reverse
+    assert ctl.update(snap(now=3.0, num=10.0, den=10.0)) == "down"
+
+
+def test_hillclimb_floor_hint_forces_up():
+    """At the objective floor (no folding at all) the response curve
+    is known-monotone: the climb never explores down there."""
+    reg = make_registry()
+    ctl = HillClimbController(
+        reg, "coalescer.linger",
+        lambda s: (s.delta("num"), s.delta("den")),
+        step_factor=2.0, cooldown=1.0, deadband=0.05,
+        explore_up_at=1.2)
+    t = 0.0
+    for _ in range(8):
+        t += 2.0
+        ctl.update(snap(now=t, num=10.0, den=10.0))   # ratio pinned 1.0
+    assert reg.current("coalescer.linger") \
+        > knobs.COALESCER_LINGER, "the floor hint must keep climbing"
+
+
+def test_hillclimb_guard_retreats_toward_default():
+    reg = make_registry()
+    reg.set("coalescer.linger", 0.2)
+    ctl = HillClimbController(
+        reg, "coalescer.linger",
+        lambda s: (s.delta("num"), s.delta("den")),
+        cooldown=1.0, guard=lambda s: False)
+    assert ctl.update(snap(now=1.0)) == "down"
+    assert reg.current("coalescer.linger") < 0.2
+
+
+def test_hillclimb_idle_decay():
+    reg = make_registry()
+    reg.set("coalescer.linger", 0.2)
+    ctl = HillClimbController(
+        reg, "coalescer.linger", lambda s: None,
+        cooldown=1.0, decay_after=3, decay_rate=1.0)
+    t = 0.0
+    moved = []
+    for _ in range(4):
+        t += 1.1
+        moved.append(ctl.update(snap(now=t)))
+    assert "down" in moved
+    assert reg.current("coalescer.linger") == knobs.COALESCER_LINGER
+
+
+# ---------------------------------------------------------------------------
+# signal reader: the trust boundary
+# ---------------------------------------------------------------------------
+
+def _reader_with(reg):
+    return SignalReader(registry=reg)
+
+
+def test_reader_deltas_and_clean_snapshot():
+    reg = metrics.Registry()
+    r = _reader_with(reg)
+    r.sample(0.0)                                   # prime
+    reg.inc_counter("provider_mutations_enqueued_total",
+                    {"kind": "record_set"}, 40.0)
+    reg.inc_counter("provider_mutation_flushes_total",
+                    {"kind": "record_set"}, 10.0)
+    s = r.sample(1.0)
+    assert s.ok
+    assert s.delta("enqueued") == 40.0
+    assert s.delta("flushes") == 10.0
+
+
+def test_reader_flags_nan_and_implausible_and_regression():
+    reg = metrics.Registry()
+    r = SignalReader(registry=reg,
+                     corrupt=lambda name, v:
+                     float("nan") if name == "sheds" else v)
+    r.sample(0.0)
+    s = r.sample(1.0)
+    assert any(a.startswith("non-finite") for a in s.anomalies)
+
+    reg2 = metrics.Registry()
+    r2 = SignalReader(registry=reg2)
+    reg2.inc_counter("sheds_total", {"controller": "q"}, 100.0)
+    r2.sample(0.0)
+    reg2.inc_counter("sheds_total", {"controller": "q"}, 1e12)
+    s2 = r2.sample(1.0)
+    assert any(a.startswith("implausible") for a in s2.anomalies)
+
+    reg3 = metrics.Registry()
+    r3 = SignalReader(registry=reg3)
+    reg3.inc_counter("sheds_total", {"controller": "q"}, 100.0)
+    r3.sample(0.0)
+    reg3._counters.clear()        # the counter "runs backwards"
+    s3 = r3.sample(1.0)
+    assert any(a.startswith("regressed") for a in s3.anomalies)
+
+
+def test_reader_flags_stalled_stream():
+    reg = metrics.Registry()
+    reg.register_gauge("workqueue_depth", {"queue": "q"}, lambda: 50.0)
+    r = SignalReader(registry=reg)
+    anomalies = []
+    for i in range(8):
+        anomalies = r.sample(float(i)).anomalies
+    assert "stalled:signals" in anomalies
+
+
+def test_reader_p99_from_histogram_window():
+    reg = metrics.Registry()
+    r = SignalReader(registry=reg)
+    r.sample(0.0)
+    for _ in range(90):
+        metrics.record_reconcile_latency("q", "interactive", 0.004,
+                                         registry=reg)
+    for _ in range(10):
+        metrics.record_reconcile_latency("q", "interactive", 4.0,
+                                         registry=reg)
+    s = r.sample(1.0)
+    assert s.interactive_p99 == pytest.approx(5.0), \
+        "p99 = the bucket bound holding the 99th observation"
+    # next window: nothing converged
+    assert r.sample(2.0).interactive_p99 is None
+
+
+# ---------------------------------------------------------------------------
+# the engine tick
+# ---------------------------------------------------------------------------
+
+def test_engine_freezes_every_knob_on_anomaly():
+    reg = metrics.Registry()
+    reader = SignalReader(registry=reg,
+                          corrupt=lambda n, v: -5.0)
+    eng = AutotuneEngine(AutotuneConfig(enabled=True), reader=reader)
+    eng.registry.set("coalescer.linger", 0.1)
+    eng.tick(now=0.0)
+    s = eng.tick(now=1.0)
+    assert not s.ok
+    assert eng.registry.current("coalescer.linger") \
+        == knobs.COALESCER_LINGER
+    log = eng.decision_log()
+    assert log and log[-1]["action"] == "freeze"
+    # frozen: a storm-shaped snapshot cannot move anything now
+    eng.tick(now=2.0)
+    assert eng.registry.current("coalescer.linger") \
+        == knobs.COALESCER_LINGER
+
+
+def test_engine_steers_linger_up_under_unfolded_storm():
+    reg = metrics.Registry()
+    reader = SignalReader(registry=reg)
+    eng = AutotuneEngine(AutotuneConfig(enabled=True, interval=1.0),
+                         reader=reader)
+    v0 = eng.registry.current("coalescer.linger")
+    t = 0.0
+    for _ in range(10):
+        t += 1.0
+        # sustained storm, zero folding: intents == flushes
+        reg.inc_counter("provider_mutations_enqueued_total",
+                        {"kind": "record_set"}, 100.0)
+        reg.inc_counter("provider_mutation_flushes_total",
+                        {"kind": "record_set"}, 100.0)
+        eng.tick(now=t)
+    assert eng.registry.current("coalescer.linger") > v0
+    # warm_gap is coupled: it tracks the climbed linger
+    assert eng.registry.current("coalescer.warm_gap") == pytest.approx(
+        min(eng.registry.current("coalescer.linger"), 0.25))
+
+
+def test_engine_lowers_sweep_period_on_drift():
+    reg = metrics.Registry()
+    reader = SignalReader(registry=reg)
+    eng = AutotuneEngine(AutotuneConfig(enabled=True, interval=1.0),
+                         reader=reader)
+    eng.tick(now=0.0)
+    reg.inc_counter("drift_repairs_total", {}, 3.0)
+    eng.tick(now=5.0)
+    assert eng.registry.current("sweep.every") == knobs.SWEEP_EVERY / 2
+
+
+def test_engine_decision_log_is_deterministic_data():
+    """Every decision entry is JSON-serializable plain data with a
+    timestamp — the determinism suite diffs these byte-for-byte."""
+    import json
+
+    reg = metrics.Registry()
+    eng = AutotuneEngine(AutotuneConfig(enabled=True),
+                         reader=SignalReader(registry=reg))
+    eng.tick(now=0.0)
+    reg.inc_counter("drift_repairs_total", {}, 1.0)
+    eng.tick(now=5.0)
+    text = json.dumps(eng.decision_log(), sort_keys=True)
+    assert json.loads(text) == eng.decision_log()
+
+
+def test_registry_reset_restores_static_plane():
+    eng = AutotuneEngine(
+        AutotuneConfig(enabled=True),
+        reader=SignalReader(registry=metrics.Registry()))
+    eng.registry.set("queue.depth_watermark", 4096)
+    eng.registry.set("coalescer.linger", 0.1)
+    eng.registry.reset()
+    assert eng.registry.snapshot() == {
+        name: spec.default for name, spec in knobs.KNOBS.items()}
+
+
+def test_signal_corruption_hook_is_deterministic_and_logged():
+    """The FaultInjector's corrupt_signal: seeded per-(name, index)
+    decisions, garbage from a fixed menu, every injection logged —
+    and an unarmed injector is a pure identity."""
+    from aws_global_accelerator_controller_tpu.cloudprovider.aws.fake import (  # noqa: E501
+        FaultInjector,
+    )
+
+    fi = FaultInjector(seed=99)
+    assert fi.corrupt_signal("enqueued", 5.0) == 5.0   # disarmed
+    fi.set_signal_corruption(1.0)
+    a = [fi.corrupt_signal("enqueued", 5.0) for _ in range(6)]
+    fi2 = FaultInjector(seed=99)
+    fi2.set_signal_corruption(1.0)
+    b = [fi2.corrupt_signal("enqueued", 5.0) for _ in range(6)]
+    assert [repr(x) for x in a] == [repr(x) for x in b], \
+        "corruption stream must replay from the seed"
+    assert any(isinstance(x, float) and math.isnan(x) for x in a) \
+        or any(x in (-1.0, 1e12) for x in a)
+    log = fi.decision_log()
+    assert any(d["source"] == "signal" for d in log)

@@ -356,45 +356,47 @@ def test_bench_mixed_soak_full_slo():
 
 
 def test_reconcile_floor_skips_tagged_entries(monkeypatch, tmp_path):
-    """batch-efficiency, steady-state, restart-recovery, mixed-soak,
-    shard-scaling, rollout-ramp, region-fanin and scale-storm legs
-    measure other workloads, not the floor's pure create storm: their
-    (lower) throughputs must not drag the derived floor down."""
+    """EVERY registered bench tag's entries measure another workload,
+    not the floor's pure create storm: their (lower, or unit-less)
+    figures must not drag the derived floor down.  The tag corpus is
+    INTROSPECTED from ``bench.BENCH_TAGS`` — a new leg registers its
+    tag there and is covered here with no test edit (the old per-PR
+    ritual of hand-extending this list is retired)."""
+    assert len(bench.BENCH_TAGS) >= 12, \
+        "the registered-tags corpus shrank — tags must never be " \
+        "dropped while committed history still carries them"
+    entries = [{"throughput": 3400.0}, {"throughput": 3500.0},
+               {"throughput": 3450.0}]
+    for i, tag in enumerate(sorted(bench.BENCH_TAGS)):
+        # one low-throughput entry per tag (would crater the floor if
+        # it leaked) and one entry with NO throughput field at all
+        # (fleet-plan shape: the skip must drop it before the floor
+        # derivation ever reads fields)
+        entries.append({"throughput": 10.0 + i, "bench": tag})
+        entries.append({"other_metric": 1.0, "bench": tag})
     hist = tmp_path / "history.jsonl"
-    hist.write_text("".join(
-        json.dumps(e) + "\n" for e in (
-            {"throughput": 3400.0}, {"throughput": 3500.0},
-            {"throughput": 3450.0},
-            {"throughput": 150.0, "bench": "batch-efficiency"},
-            {"throughput": 160.0, "bench": "batch-efficiency"},
-            {"throughput": 140.0, "bench": "steady-state"},
-            {"throughput": 45.0, "bench": "restart-recovery"},
-            {"throughput": 25.0, "bench": "mixed-soak"},
-            {"throughput": 24.0, "bench": "mixed-soak"},
-            {"throughput": 420.0, "bench": "shard-scaling"},
-            {"throughput": 110.0, "bench": "shard-scaling"},
-            {"throughput": 55.0, "bench": "rollout-ramp"},
-            {"throughput": 60.0, "bench": "rollout-ramp"},
-            # region-fanin reports services per SIMULATED second of
-            # the hierarchical storm — a different regime entirely
-            {"throughput": 100.0, "bench": "region-fanin",
-             "speedup": 3.9, "regions": ["us-west-2", "eu-west-1"]},
-            # scale-storm runs under simulated I/O latency: its wall
-            # svc/s is a different regime from the pure storm
-            {"throughput": 1500.0, "bench": "scale-storm",
-             "sim_time_ratio": 26.0, "per_service_bytes": 12000.0},
-            {"throughput": 180.0, "bench": "trace-overhead",
-             "overhead_pct": 1.2},
-            # the fleet-plan leg has no "throughput" at all (EG/s, a
-            # different unit entirely) — the tag skip must drop it
-            # before the floor derivation ever reads fields
-            {"egs_per_s": 190000.0, "rung": "pallas-interpret",
-             "bench": "fleet-plan"})))
+    hist.write_text("".join(json.dumps(e) + "\n" for e in entries))
     monkeypatch.delenv("RECONCILE_FLOOR_SVC_S", raising=False)
     monkeypatch.setattr(bench.os, "getloadavg", lambda: (0.0, 0, 0))
     got = bench.reconcile_floor(history_path=str(hist))
     assert got == pytest.approx(min(0.5 * 3450.0, 0.9 * 3400.0)), \
         "tagged entries leaked into the floor derivation"
+
+
+def test_history_recorder_rejects_unregistered_tags(tmp_path,
+                                                    monkeypatch):
+    """The other half of the contract: a leg cannot stamp a tag the
+    registered corpus (and so the skip test above) does not cover."""
+    monkeypatch.setattr(bench, "_HISTORY_PATH",
+                        str(tmp_path / "h.jsonl"))
+    with pytest.raises(ValueError, match="unregistered bench tag"):
+        bench._record_reconcile_history(
+            {"services": 1, "throughput": 1.0}, bench="no-such-leg")
+    # a registered tag writes normally
+    bench._record_reconcile_history(
+        {"services": 1, "throughput": 1.0}, bench="adaptive-soak")
+    lines = (tmp_path / "h.jsonl").read_text().splitlines()
+    assert json.loads(lines[-1])["bench"] == "adaptive-soak"
 
 
 def test_bench_reconcile_scaling_smoke():
@@ -1162,3 +1164,37 @@ def test_bench_region_fanin_smoke(monkeypatch, tmp_path):
     assert entries and entries[-1]["bench"] == "region-fanin"
     assert entries[-1]["regions"] == r["regions"]
     assert entries[-1]["latency_profile"]["mutation_factor"] > 0
+
+
+def test_bench_adaptive_soak_smoke(monkeypatch, tmp_path):
+    """Tier-1 smoke of the adaptive-vs-static fuzzed A/B (ISSUE 15)
+    on the drip family: both arms replay the same seeded script under
+    the VirtualClock, the adaptive arm's tuner actually moves the
+    sweep knob and beats the frozen defaults on repair lag, the knob
+    trajectory rides the tagged history entry, and the replay
+    artifact lands for hack/fuzz_replay.py."""
+    hist = tmp_path / "history.jsonl"
+    monkeypatch.setattr(bench, "_HISTORY_PATH", str(hist))
+    monkeypatch.setattr(bench, "FUZZ_ARTIFACT_DIR",
+                        str(tmp_path / "fuzz"))
+    r = bench.bench_adaptive_soak(families=("slow-drip-drift",),
+                                  record=True)
+    leg = r["families"]["slow-drip-drift"]
+    assert leg["metric"] == "drift_repair_mean_s"
+    assert leg["adaptive_wins"], (
+        f"adaptive lost the drip family at smoke size: {leg}")
+    traj = leg["knob_trajectory"]["sweep.every"]
+    assert traj["final"] < traj["initial"], \
+        "the tuner never lowered the sweep period under live drift"
+    assert leg["tuner_moves"] > 0
+    entries = [json.loads(line)
+               for line in hist.read_text().splitlines()]
+    assert entries and entries[-1]["bench"] == "adaptive-soak"
+    recorded = entries[-1]["families"]["slow-drip-drift"]
+    assert recorded["knob_trajectory"]["sweep.every"]["final"] \
+        == traj["final"]
+    art = tmp_path / "fuzz" / f"slow-drip-drift-{r['seed']}.json"
+    assert art.exists(), "replay artifact not written"
+    payload = json.loads(art.read_text())
+    assert payload["ledger"], "artifact carries no ledger to diff"
+    assert payload["script_sha"]

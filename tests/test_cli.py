@@ -83,6 +83,24 @@ def test_controller_shard_flags_validated():
     assert "integer or 'auto'" in (res.stderr + res.stdout)
 
 
+def test_controller_autotune_flags_validated():
+    """--autotune-pin / --autotune-interval (ISSUE 15): a typo'd knob
+    name or malformed pin aborts before any backend is built."""
+    res = run_cli("controller", "--autotune-pin", "no.such.knob=1")
+    assert res.returncode != 0
+    assert "unknown knob" in (res.stderr + res.stdout)
+    res = run_cli("controller", "--autotune-pin", "coalescer.linger")
+    assert res.returncode != 0
+    assert "KNOB=VALUE" in (res.stderr + res.stdout)
+    res = run_cli("controller", "--autotune-pin",
+                  "coalescer.linger=abc")
+    assert res.returncode != 0
+    assert "not a number" in (res.stderr + res.stdout)
+    res = run_cli("controller", "--autotune-interval", "0")
+    assert res.returncode != 0
+    assert "--autotune-interval" in (res.stderr + res.stdout)
+
+
 def test_controller_demo_converges_sharded(tmp_path):
     """The demo fleet converges under --shards 4 --shard-id auto: the
     sharded path (shard-lease manager + per-shard cohorts) drives the

@@ -983,3 +983,88 @@ def test_l116_batcher_gate_trusts_shipped_when_absent():
     gate (parity with the other module gates)."""
     assert [x for x in _cfindings("l116_clean.py")
             if x[0] == "L116"] == []
+
+
+# ---------------------------------------------------------------------------
+# L117: registry-owned knobs must not be re-hardcoded (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def test_l117_hardcoded_knob_literals_fire():
+    """Every flagged shape: two signature defaults (line 7), a
+    suffix-matched module assignment (12), a keyword literal (16), an
+    attribute assignment (17) and a plain local assignment (18)."""
+    got = [x for x in _cfindings("l117_hardcoded.py") if x[0] == "L117"]
+    assert got == [("L117", 7), ("L117", 7), ("L117", 12),
+                   ("L117", 16), ("L117", 17), ("L117", 18)], got
+
+
+def test_l117_clean_spellings_pass():
+    """Catalog-constant defaults, non-knob numerics and the ``# race:``
+    waiver on a deliberate divergent test profile — zero findings."""
+    assert [x for x in _cfindings("l117_clean.py")
+            if x[0] == "L117"] == []
+
+
+def test_l117_autotune_package_exempt():
+    """The catalog itself is the one legitimate home of the numeric
+    spellings — knobs.py (and the rest of autotune/) never fires."""
+    auto_dir = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/autotune")
+    files = sorted(auto_dir.rglob("*.py"))
+    assert files, "autotune package missing"
+    assert [x for x in concurrency_lint.lint_files(files)
+            if x.code == "L117"] == []
+
+
+def test_l117_clock_owned_packages_clean():
+    """The retrofit proof: every clock-owned package spells its knob
+    defaults through the catalog — zero L117 findings tree-wide."""
+    roots = [
+        "aws_global_accelerator_controller_tpu/kube",
+        "aws_global_accelerator_controller_tpu/resilience",
+        "aws_global_accelerator_controller_tpu/cloudprovider",
+        "aws_global_accelerator_controller_tpu/leaderelection",
+        "aws_global_accelerator_controller_tpu/reconcile",
+        "aws_global_accelerator_controller_tpu/rollout",
+        "aws_global_accelerator_controller_tpu/controller",
+        "aws_global_accelerator_controller_tpu/manager",
+        "aws_global_accelerator_controller_tpu/sharding",
+        "aws_global_accelerator_controller_tpu/topology",
+        "aws_global_accelerator_controller_tpu/tracing.py",
+        "aws_global_accelerator_controller_tpu/flight.py",
+        "aws_global_accelerator_controller_tpu/metrics.py",
+    ]
+    files = []
+    for r in roots:
+        p = pathlib.Path(ROOT_DIR) / r
+        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    findings = [x for x in concurrency_lint.lint_files(files)
+                if x.code == "L117"]
+    assert findings == [], findings
+
+
+def test_l117_seeded_literal_linger_in_shipped_batcher_caught(tmp_path):
+    """Acceptance probe (ISSUE 15): graft the literal linger default
+    back into the REAL batcher.py — the exact re-hardcoding the rule
+    exists to block — and the rule must fire."""
+    batcher_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/cloudprovider/aws/"
+        "batcher.py")
+    src = batcher_py.read_text()
+    needle = "    linger: float = knobcat.COALESCER_LINGER\n"
+    assert src.count(needle) == 1, \
+        "CoalesceConfig linger spelling changed; update this probe"
+    mutated = src.replace(needle, "    linger: float = 0.005\n", 1)
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "cloudprovider" / "aws")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "batcher.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L117"]
+    assert findings, "a grafted literal linger default in the " \
+                     "shipped batcher was not caught"
+
+    # sanity: the unmutated batcher is clean under the rule
+    assert [x for x in concurrency_lint.lint_files([batcher_py])
+            if x.code == "L117"] == []
