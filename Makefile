@@ -1,7 +1,7 @@
 # Developer entry points (analogue of the reference Makefile:16-24).
 
 .PHONY: test manifests check-manifests bench benchdoc graft-dryrun lint \
-	tier1-diff fuzz-smoke
+	tier1-diff fuzz-smoke bench-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -30,6 +30,13 @@ check-manifests: manifests
 bench:
 	python bench.py
 
+# small-N incremental-planner leg on the cpu platform (ISSUE 16):
+# the same build -> full-repack A/B -> virtual steady-state ->
+# plan/flush overlap -> oracle-bit-match path as the 1M acceptance
+# run, in seconds — the tier-1-adjacent guard for the resident planner
+bench-smoke:
+	env JAX_PLATFORMS=cpu python bench.py incremental-smoke
+
 # docs/benchmarks.md is generated from committed bench artifacts
 # (builder_claims.json overlaid with the latest BENCH_LIVE.json);
 # a drift test in tests/test_bench.py keeps the committed file current
@@ -45,7 +52,7 @@ graft-dryrun:
 # package is installable in the build environment); compileall stays as
 # the pure syntax gate for files lint.py does not cover.  --all runs
 # BOTH passes: base rules L001-L007 and the concurrency contract rules
-# L101-L117 (docs/static-analysis.md)
+# L101-L118 (docs/static-analysis.md)
 lint:
 	python -m compileall -q aws_global_accelerator_controller_tpu tests
 	python hack/lint.py --all

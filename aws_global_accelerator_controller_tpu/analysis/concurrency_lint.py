@@ -1,4 +1,4 @@
-"""AST-based concurrency contract lints (rules L101-L117).
+"""AST-based concurrency contract lints (rules L101-L118).
 
 The static half of the concurrency checker: a whole-program pass over
 the tree that enforces the synchronization contracts PR 1 introduced as
@@ -206,6 +206,24 @@ segment looks lock-ish (``lock``/``_lock``/``*_lock``/``cond``/
                          the ``autotune/`` package (the owner) is
                          exempt; ``# race: <reason>`` waives a
                          deliberate divergence (test profiles).
+  L118 steady-state full-repack ban (ISSUE 16)
+                         The full-repack entry points (``pack_fleet``,
+                         ``WholeFleetPlanner.plan_groups``) are the
+                         ORACLE: on the steady-state wave path — the
+                         sweep controller (controller/fleetsweep.py)
+                         and the plan/flush pipeline
+                         (parallel/overlap.py) — every wave replans
+                         only dirty shards through the resident
+                         planner (``ResidentFleetPlanner.plan_wave``),
+                         so a full repack creeping back in silently
+                         reverts milliseconds-per-wave to O(fleet)
+                         per wave at million-EG scale.  Flags any
+                         ``pack_fleet`` / ``plan_groups`` call in
+                         those modules whose enclosing function is
+                         not an oracle/verification entry point (name
+                         contains ``oracle``/``verify``/
+                         ``full_repack``); ``# race: <reason>``
+                         waives a deliberate repack.
 """
 from __future__ import annotations
 
@@ -558,6 +576,25 @@ def _l113_device_fn(fn: ast.AST) -> bool:
     return False
 
 
+# The full-repack entry points (rule L118): legal on the steady-state
+# wave path only inside oracle / verification functions.
+_L118_REPACK_CALLS = {"pack_fleet", "plan_groups"}
+_L118_ORACLE_TAGS = ("oracle", "verify", "full_repack")
+
+
+def _l118_in_scope(path: Path) -> bool:
+    """L118 covers the steady-state wave path — the sweep controller
+    and the plan/flush pipeline — plus the fixture corpus
+    (``l118_*.py``)."""
+    if path.name.startswith("l118_"):
+        return True
+    parts = path.parts
+    if "aws_global_accelerator_controller_tpu" not in parts:
+        return False
+    return (path.name == "fleetsweep.py" and "controller" in parts) \
+        or (path.name == "overlap.py" and "parallel" in parts)
+
+
 def _l107_fastpath(path: Path, fn_name: str) -> bool:
     """Is this function on the fingerprint fast path (rule L107)?
     The reconcile package's own modules (the dispatch + the
@@ -743,6 +780,7 @@ class Engine:
                 self._check_shared_views(info, fn)
             self._check_compat_shim(info)
             self._check_columnar_purity(info)
+            self._check_wave_repack(info)
             self._check_knob_literals(info)
         self._check_ordering_graph()
         self._check_wrapper_fence_gate()
@@ -1025,6 +1063,39 @@ class Engine:
                         f"per fleet size) — express it as array ops "
                         f"over the packed [G, E] grids, or move the "
                         f"loop to host-side pack/decode"))
+
+    def _check_wave_repack(self, info: _FileInfo) -> None:
+        """Rule L118: the steady-state wave path never full-repacks.
+        The sweep controller and the plan/flush pipeline plan through
+        the resident planner's dirty-mask API; ``pack_fleet`` /
+        ``plan_groups`` stay behind oracle/verification entry points
+        (``verify_full_repack`` and friends).  Whole-file pass like
+        L113 so module-level calls are caught too; calls lexically
+        inside an oracle-tagged function (name contains ``oracle``/
+        ``verify``/``full_repack``, nested helpers included) are the
+        allowed shape."""
+        if not _l118_in_scope(info.path):
+            return
+        exempt: Set[int] = set()
+        for _classname, fn in self._functions(info.tree):
+            if any(tag in fn.name for tag in _L118_ORACLE_TAGS):
+                exempt.update(id(n) for n in ast.walk(fn)
+                              if isinstance(n, ast.Call))
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call) or id(node) in exempt:
+                continue
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] in _L118_REPACK_CALLS:
+                self.findings.append(Finding(
+                    info.path, node.lineno, "L118",
+                    f"full-repack call '{'.'.join(chain)}()' on the "
+                    f"steady-state wave path: waves replan only dirty "
+                    f"shards through the resident planner "
+                    f"(ResidentFleetPlanner.plan_wave) — a full "
+                    f"repack here reverts steady state to O(fleet) "
+                    f"per wave; keep pack_fleet/plan_groups behind "
+                    f"an oracle/verify entry point or waive with "
+                    f"'# race: <reason>'"))
 
     def _check_knob_literals(self, info: _FileInfo) -> None:
         """Rule L117: knobs owned by the TunableRegistry

@@ -192,7 +192,8 @@ class EndpointGroupBindingController:
         self.binding_informer = informer_factory.endpoint_group_bindings()
         self.binding_informer.add_event_handler(
             add=self._enqueue, update=self._update_notification,
-            delete=None, resync=self._resync_binding)
+            delete=self._delete_notification,
+            resync=self._resync_binding)
         self.binding_informer.add_index(BINDING_ARN_INDEX,
                                         index_binding_by_arn)
         self.binding_informer.add_index(BINDING_SERVICE_REF_INDEX,
@@ -265,7 +266,15 @@ class EndpointGroupBindingController:
         if old.spec.endpoint_group_arn != new.spec.endpoint_group_arn:
             logger.error("do not allow changing EndpointGroupArn field")
             return
+        # the watch event is the dirty-mask feed: the key's resident
+        # shard replans next wave even before the sweep describes it
+        self.fleet_sweep.note_event(new.key())
         self._enqueue(new)
+
+    def _delete_notification(self, obj) -> None:
+        """A deleted binding's resident slot must not keep shadow-
+        planning: drop it (and its sweep state) on the watch delete."""
+        self.fleet_sweep.forget(obj.key())
 
     def _resync_binding(self, obj, wave: int) -> None:
         """Tagged resync backstop — previously every binding re-ran a

@@ -22,6 +22,20 @@ the per-object tier paid, just batched ahead) and publishes per-key
 entries; later dispatches in the wave consume their entry if the
 binding's fingerprint still matches the one planned against.
 
+Resident planning (ISSUE 16): the wave's planning state lives in a
+:class:`~..reconcile.resident.ResidentFleet` — persistent columnar
+grids + per-shard dirty masks — planned by a
+:class:`~..parallel.fleet_plan.ResidentFleetPlanner` that replans
+ONLY the dirty shards and splices results into a resident plan.  A
+staged key whose describe shows nothing changed upserts as
+``unchanged`` (no dirt, no device work); informer watch events feed
+:meth:`note_event` so an update marks its shard dirty before the
+sweep's describe lands; deletes flow through :meth:`forget`.  The
+resident group count is LRU-bounded at ``cache_max`` (the old weight
+cache's bound, now bounding the whole resident state).  Full repacks
+(``pack_fleet`` / ``plan_groups``) are BANNED from this steady-state
+path outside oracle/verify entry points — lint rule L118.
+
 Honesty bounds, because the fleet plans against ``status.endpointIds``
 order while the per-object path plans against referent-resolution
 order (the two agree for any binding that converged and hasn't been
@@ -43,7 +57,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..analysis import locks
 from ..rollout import rollout_active
@@ -92,8 +106,6 @@ class FleetSweepPlanner:
                  cache_max: int = 131072,
                  enabled: bool = True,
                  queue=None):
-        from collections import OrderedDict
-
         self.controller = controller
         self.enabled = enabled
         self.endpoints_cap = endpoints_cap
@@ -115,19 +127,27 @@ class FleetSweepPlanner:
         #: them — fleet-plan wave membership carries the trace
         self._queue = queue
         self._lock = locks.make_lock("fleet-sweep")
+        #: serializes whole waves (resident upsert + dirty-shard plan
+        #: + decode) — the resident fleet is single-writer; the
+        #: sweep_verdict fast path never takes this one
+        self._wave_lock = locks.make_lock("fleet-sweep-wave")
         self._staged: Set[str] = set()
         self._entries: Dict[str, _Entry] = {}
-        #: key -> (fingerprint, planned weights): the incremental feed
-        #: (cache hit = no score rows packed for the group next wave).
-        #: LRU-bounded at ``cache_max`` — binding churn over a
-        #: controller's months-long life must never grow this without
-        #: bound; an evicted key just rescores on its next wave
+        #: bound on the RESIDENT group count (was: the weight cache's
+        #: LRU bound) — binding churn over a controller's months-long
+        #: life must never grow the resident arrays without bound; an
+        #: evicted key just re-inserts and rescores on its next wave
         self._cache_max = max(1, cache_max)
-        self._weight_cache: "OrderedDict[str, Tuple[tuple, Dict[str, int]]]" = OrderedDict()  # noqa: E501
+        #: key -> exact fingerprint tuple planned against: decides
+        #: featurize-vs-reuse before the resident upsert (the resident
+        #: grid only carries the int64 digest)
+        self._fps: Dict[str, tuple] = {}
         #: key -> consecutive fleet-answered sweeps (the verify_every
-        #: escape valve); evicted alongside the weight cache
+        #: escape valve); pruned alongside resident eviction
         self._streak: Dict[str, int] = {}
-        self._planner = None
+        self._planner = None          # ResidentFleetPlanner
+        self._fleet = None            # ResidentFleet
+        self._built_shards: Optional[int] = None
 
     # -- staging (resync handler, wave enqueue time) -------------------
 
@@ -155,10 +175,19 @@ class FleetSweepPlanner:
             return None, None
         return model, params
 
-    def _get_planner(self, model, params):
-        from ..parallel.fleet_plan import WholeFleetPlanner
+    def _get_planner(self, model, params, num_shards: int):
+        from ..parallel.fleet_plan import ResidentFleetPlanner
+        from ..reconcile.resident import ResidentFleet
 
         with self._lock:
+            if self._built_shards is not None \
+                    and self._built_shards != num_shards:
+                # shard-count change re-homes every group: resident
+                # placement is wholesale stale, rebuild from empty
+                self._planner = None
+                self._fleet = None
+                self._fps.clear()
+                self._streak.clear()
             planner = self._planner
             prior_params = None if planner is None else planner.params
         if planner is None:
@@ -173,27 +202,31 @@ class FleetSweepPlanner:
             # constructed OUTSIDE the lock (model init runs jax
             # compute); a racing duplicate is idempotent, first
             # publication wins
-            fresh = WholeFleetPlanner(model=model, params=params)
+            feature_dim = getattr(model, "feature_dim", None)
+            fleet = ResidentFleet(
+                shards=num_shards, endpoints_cap=self.endpoints_cap,
+                feature_dim=feature_dim if feature_dim else 8,
+                max_groups=self._cache_max)
+            fresh = ResidentFleetPlanner(fleet, model=model,
+                                         params=params)
             with self._lock:
                 if self._planner is None:
                     self._planner = fresh
+                    self._fleet = fleet
+                    self._built_shards = num_shards
                 planner = self._planner
         elif params is not None and params is not prior_params:
-            # hot-reload follow — and the incremental feed holds
-            # OLD-model weights now: flush it, or pre-reload bindings
-            # would keep 'converging' against stale plans (and then
-            # ping-pong between cached-stale and per-object-fresh)
+            # hot-reload follow — the resident weight caches hold
+            # OLD-model weights now: invalidate them (every model slot
+            # rescores next wave), or pre-reload bindings would keep
+            # 'converging' against stale plans (and then ping-pong
+            # between cached-stale and per-object-fresh)
             with self._lock:
                 planner.params = params
-                self._weight_cache.clear()
+                self._fleet.invalidate_scores()
+                self._fps.clear()
                 self._streak.clear()
         return planner
-
-    def _cached_weights(self, key: str):
-        """Locked read of the incremental feed (publication and LRU
-        eviction mutate it under the same lock)."""
-        with self._lock:
-            return self._weight_cache.get(key)
 
     def _eligible(self, binding) -> bool:
         from ..apis import ROLLOUT_STEPS_ANNOTATION
@@ -209,11 +242,37 @@ class FleetSweepPlanner:
                 and ROLLOUT_STEPS_ANNOTATION not in binding.annotations
                 and not rollout_active(binding.status.rollout))
 
+    def note_event(self, key: str) -> None:
+        """Informer watch-event feed: an update notification marks the
+        key's resident shard dirty so the next wave replans it even if
+        the fingerprint race resolves after staging."""
+        if not self.enabled:
+            return
+        with self._lock:
+            fleet = self._fleet
+        if fleet is not None:
+            fleet.note_dirty(key)
+
+    def forget(self, key: str) -> None:
+        """Informer delete feed: drop the key's resident slot (its
+        shard replans without it next wave) and its sweep state."""
+        with self._lock:
+            fleet = self._fleet
+            self._entries.pop(key, None)
+            self._streak.pop(key, None)
+            self._fps.pop(key, None)
+        if fleet is not None:
+            with self._wave_lock:    # resident state is single-writer
+                fleet.remove(key)
+
     def plan_staged(self) -> int:
-        """Plan every staged key in one columnar pass; returns the
-        number of groups planned.  Provider describes happen OUTSIDE
-        the lock (one per group — the read bill the per-object tier
-        paid anyway), only entry publication takes it."""
+        """Upsert every staged key into the resident fleet and replan
+        the dirty shards in one incremental pass; returns the number
+        of groups covered.  Provider describes happen OUTSIDE the lock
+        (one per group — the read bill the per-object tier paid
+        anyway); only entry publication takes it.  A wave whose
+        describes all come back unchanged is FREE: nothing dirties, so
+        the planner never touches the device."""
         with self._lock:
             if len(self._staged) <= self.wave_cap:
                 staged, self._staged = self._staged, set()
@@ -225,18 +284,21 @@ class FleetSweepPlanner:
                 self._staged -= staged
         if not staged:
             return 0
-        from ..reconcile.columnar import GroupState
         from ..sharding.hashmap import shard_of
 
         model, params = self._model_ctx()
-        planner = self._get_planner(model, params)
         num_shards = getattr(self._shards, "num_shards", 1)
-        states: List[GroupState] = []
-        metas: List[Tuple[str, tuple, object]] = []
+        planner = self._get_planner(model, params, num_shards)
+        fleet = self._fleet
+        described: List[Tuple[str, tuple, object, object]] = []
         for key in sorted(staged):
             binding = self._get_binding(key)
             if not self._eligible(binding) \
                     or not self._shards.owns_key(self._route(binding)):
+                # no longer plannable here: a resident copy would keep
+                # shadow-planning a group nobody consumes — drop it
+                if key in fleet:
+                    self.forget(key)
                 continue
             fp = self._fingerprint(binding)
             try:
@@ -247,71 +309,84 @@ class FleetSweepPlanner:
                 logger.debug("fleet sweep: describe %s failed: %s",
                              binding.spec.endpoint_group_arn, exc)
                 continue
-            state = self._group_state(key, binding, group, fp, model,
-                                      num_shards, shard_of)
-            if state is None:
-                continue
-            states.append(state)
-            metas.append((key, fp, group,
-                          binding.spec.weight is not None))
-        if not states:
+            described.append((key, fp, group, binding))
+        if not described:
             return 0
-        # the wave span: one columnar pass serving many keys' traces —
-        # links carry the membership (tracing.py), each member context
-        # gets the span id marked.  No hop() here: a pending key may
-        # be claimed by a worker mid-pass and hop concurrently, and
-        # TraceContext.hop's monotone clamp is single-writer; the
-        # sweep dispatch's own claim→converged segment already
-        # attributes the planning work (mark append is a bounded
-        # single list.append, safe under the GIL)
+
+        # the wave span: one incremental pass serving many keys'
+        # traces — links carry the membership (tracing.py), each
+        # member context gets the span id marked.  No hop() here: a
+        # pending key may be claimed by a worker mid-pass and hop
+        # concurrently, and TraceContext.hop's monotone clamp is
+        # single-writer; the sweep dispatch's own claim→converged
+        # segment already attributes the planning work (mark append is
+        # a bounded single list.append, safe under the GIL)
         from ..tracing import default_tracer
 
         ctxs = []
         if self._queue is not None \
                 and hasattr(self._queue, "pending_trace"):
             ctxs = [c for c in (self._queue.pending_trace(key)
-                                for key, _, _, _ in metas)
+                                for key, _, _, _ in described)
                     if c is not None]
+        metas: List[Tuple[str, tuple, object, bool]] = []
         with default_tracer.span("fleet_plan.wave",
                                  controller=self.controller,
-                                 groups=len(states)) as ws:
+                                 groups=len(described)) as ws:
             ws.links = tuple(sorted({c.trace_id for c in ctxs}))
-            result = planner.plan_groups(
-                states, endpoints_cap=self.endpoints_cap,
-                shards=num_shards)
+            # single-writer wave: upserts, the dirty-shard plan, and
+            # the resident-plan decode are serialized against other
+            # dispatches' waves (the sweep_verdict fast path never
+            # takes this lock)
+            with self._wave_lock:
+                for key, fp, group, binding in described:
+                    state = self._group_state(key, binding, group, fp,
+                                              model, num_shards,
+                                              shard_of, fleet)
+                    if state is None:      # observed overflows the cap
+                        continue
+                    fleet.upsert(state)
+                    self._fps[key] = fp
+                    metas.append((key, fp, group,
+                                  binding.spec.weight is not None))
+                wave = planner.plan_wave()
+                by_key = {i.key: i for i in planner.intents_for(
+                    [key for key, _, _, _ in metas])}
         for c in ctxs:
             c.mark(ws.span_id, "fleet_plan")
-        # pack_fleet lays groups out shard-major, so intents come back
-        # reordered — join on the key, never on input position
-        by_key = {intent.key: intent for intent in result.intents()}
         now = simclock.monotonic()
         with self._lock:
             for key, fp, group, spec_weighted in metas:
-                intent = by_key[key]
-                self._weight_cache[key] = (fp, dict(intent.weights))
-                self._weight_cache.move_to_end(key)
+                intent = by_key.get(key)
+                if intent is None:       # LRU-evicted mid-wave
+                    continue
                 self._entries[key] = _Entry(
                     verdict=self._verdict(intent, spec_weighted),
                     fingerprint=fp, ops=list(intent.ops),
                     weights=dict(intent.weights), observed=group,
                     planned_at=now)
-            # LRU bound on the incremental feed (binding churn must
-            # never grow it unbounded); streaks die with their cache
-            # entry so neither dict outlives the fleet
-            while len(self._weight_cache) > self._cache_max:
-                evicted, _ = self._weight_cache.popitem(last=False)
-                self._streak.pop(evicted, None)
+            # the resident fleet LRU-bounds itself at cache_max;
+            # shadow dicts follow it lazily so neither outlives the
+            # resident state
+            if len(self._fps) > 2 * self._cache_max:
+                self._fps = {k: v for k, v in self._fps.items()
+                             if k in fleet}
+                for k in [k for k in self._streak if k not in fleet]:
+                    self._streak.pop(k, None)
             # TTL sweep of entries no dispatch ever consumed
             dead = [k for k, e in self._entries.items()
                     if now - e.planned_at > ENTRY_TTL]
             for k in dead:
                 del self._entries[k]
-        logger.debug("fleet sweep: planned %d groups on rung %s (%s)",
-                     len(states), result.rung, result.stats)
-        return len(states)
+        logger.debug(
+            "fleet sweep: planned %d groups on rung %s "
+            "(%d dirty shards, %d dirty groups, device=%s)",
+            len(metas), wave.rung, wave.dirty_shards,
+            wave.dirty_groups, wave.device_call)
+        return len(metas)
 
     def _group_state(self, key, binding, group, fp, model, num_shards,
-                     shard_of):
+                     shard_of, fleet):
         from ..reconcile.columnar import GroupState
 
         desired = list(binding.status.endpoint_ids)
@@ -322,13 +397,13 @@ class FleetSweepPlanner:
         spec_weight = binding.spec.weight
         model_planned = spec_weight is None and model is not None
         features = None
-        cached: Optional[Sequence[int]] = None
         if model_planned:
-            hit = self._cached_weights(key)
-            if hit is not None and hit[0] == fp \
-                    and all(arn in hit[1] for arn in desired):
-                cached = [hit[1][arn] for arn in desired]
-            else:
+            # featurize only when the resident cache can't answer: new
+            # key, moved fingerprint, or an invalidated score cache —
+            # the resident fleet reuses its stored features otherwise
+            loc = fleet.location(key)
+            if (loc is None or self._fps.get(key) != fp
+                    or not bool(fleet.has_cache[loc[0], loc[1]])):
                 import numpy as np
 
                 from .weightpolicy import ModelWeightPolicy
@@ -345,9 +420,8 @@ class FleetSweepPlanner:
             observed_weights=observed_w, features=features,
             spec_weight=spec_weight, model_planned=model_planned,
             client_ip_preservation=binding.spec.client_ip_preservation,
-            fingerprint=0,
-            shard=shard_of(self._route(binding), num_shards),
-            cached_weights=cached)
+            fingerprint=hash(fp),
+            shard=shard_of(self._route(binding), num_shards))
 
     @staticmethod
     def _verdict(intent, spec_weighted: bool) -> str:

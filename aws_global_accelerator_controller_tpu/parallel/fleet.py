@@ -15,17 +15,27 @@ Host integration: ``FleetPlan.for_bindings`` hashes ARN strings to int32
 ids (ops.diff.hash_ids) and pads to the static [F, E] shape so the
 compiled program is reused across reconcile rounds (no data-dependent
 shapes, XLA-friendly).
+
+Resident-state plumbing (ISSUE 16): :class:`DeviceGridRing`
+double-buffers the device-resident fleet grids so the incremental
+planner (parallel/fleet_plan.py ``ResidentFleetPlanner``) can build
+wave N+1's refreshed state while wave N's intent flush is still
+reading the buffer it planned from, and :func:`make_row_splice` picks
+the row-splice mechanism per rung (jnp scatter everywhere; on the
+pallas-tpu rung a double-buffered async-copy DMA kernel streams the
+dirty rows into the resident grid — the SNIPPETS.md pattern).
 """
 from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import RUNG_TPU, registry
 from ..compat.jaxshim import shard_map
 
 from ..ops.diff import EMPTY, membership_diff
@@ -168,3 +178,142 @@ class FleetPlanner:
         fleet_stats = {"adds": float(stats[0]), "removes": float(stats[1]),
                        "live_endpoints": float(stats[2])}
         return plans, fleet_stats
+
+
+# ---------------------------------------------------------------------------
+# resident device state: double-buffer ring + rung-aware row splice
+# ---------------------------------------------------------------------------
+
+
+class DeviceGridRing:
+    """Double-buffered device residency for the fleet grids.
+
+    The incremental planner's overlap hinges on a hand-off rule: the
+    buffer wave N planned from must stay LIVE until wave N's intent
+    flush has drained through the coalescer — the flush decodes from
+    host copies, but the next wave's device pass reads/writes the
+    *other* buffer, so an in-flight ``device_get`` or a donated-buffer
+    reuse can never race the flush.  Concretely:
+
+    - :meth:`advance` installs the new front (wave N+1's arrays) and
+      parks the previous front as *retired* — still referenced, so XLA
+      cannot recycle its memory;
+    - :meth:`release_retired` is the flush-completion edge (the
+      pipeline calls it when wave N's flush closes), dropping the
+      retired buffer reference.
+
+    Steady-state memory is therefore two generations of the resident
+    grids (front + retired), the classic double buffer.
+    """
+
+    def __init__(self):
+        self._front: Optional[Tuple] = None
+        self._retired: Optional[Tuple] = None
+
+    @property
+    def front(self) -> Optional[Tuple]:
+        return self._front
+
+    def reset(self, arrays: Tuple) -> Tuple:
+        """Full (re-)upload: capacity growth or first wave.  Any
+        retired buffer keeps its reference — the previous flush may
+        still be open."""
+        self._front = tuple(jax.device_put(a) for a in arrays)
+        return self._front
+
+    def advance(self, arrays: Tuple) -> Tuple:
+        """Install wave N+1's refreshed grids; wave N's buffer retires
+        but stays referenced until :meth:`release_retired`."""
+        self._retired = self._front
+        self._front = tuple(arrays)
+        return self._front
+
+    def release_retired(self) -> None:
+        self._retired = None
+
+    def drop(self) -> None:
+        """Invalidate residency outright (shape change): both buffers
+        go; the next wave must :meth:`reset`."""
+        self._front = None
+        self._retired = None
+
+
+def _dma_row_splice(K: int, E: int, rows_total: int):
+    """Pallas double-buffered async-copy splice: stream ``K`` dirty
+    rows ``[K, E]`` into a resident ``[rows_total, E]`` grid at
+    per-row destinations ``lin [K]`` (SMEM scalars).
+
+    The guide's two-semaphore pipeline: start row k+1's DMA before
+    waiting on row k's, so every copy after the first overlaps the
+    previous wait.  Only traced on the pallas-tpu rung with
+    ``make_async_copy`` resolved (same documented limit as the stats
+    ring — everywhere else the jnp scatter path below is the splice).
+    """
+    from ..compat import jaxshim
+
+    def kernel(lin_ref, rows_ref, out_ref, sem):
+        def copy_op(k, slot):
+            return jaxshim.make_async_copy(
+                rows_ref.at[k], out_ref.at[lin_ref[k]], sem.at[slot])
+
+        copy_op(0, 0).start()
+
+        def body(k, carry):
+            jaxshim.when(k + 1 < K)(
+                lambda: copy_op(k + 1, (k + 1) % 2).start())
+            copy_op(k, k % 2).wait()
+            return carry
+
+        jax.lax.fori_loop(0, K, body, 0)
+
+    def splice(dst, lin, rows):
+        return jaxshim.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((rows_total, E), dst.dtype),
+            in_specs=[
+                jaxshim.block_spec(memory_space=jaxshim.SMEM),
+                jaxshim.block_spec(memory_space=jaxshim.ANY),
+            ],
+            out_specs=jaxshim.block_spec(memory_space=jaxshim.ANY),
+            scratch_shapes=[jaxshim.SemaphoreType.DMA((2,))],
+            input_output_aliases={2: 0},
+        )(lin, rows, dst)
+
+    return splice
+
+
+def make_row_splice(rung: str):
+    """Rung-dispatched splice ``(dst, ks, kg, rows) -> dst'`` writing
+    ``rows`` at positions ``(ks[i], kg[i])`` of a ``[S, cap, ...]``
+    resident grid.
+
+    jnp scatter is the universal path (and the oracle semantics).  On
+    the pallas-tpu rung with async-copy support, full endpoint rows go
+    through the DMA pipeline above — per-group scalar planes (2-D
+    dst) always scatter; a width-E DMA per scalar would be all
+    descriptor overhead.
+    """
+    from ..compat import jaxshim
+
+    # _Missing shims are falsy — an unresolved make_async_copy simply
+    # keeps the scatter path, same degrade rule as the stats ring
+    use_dma = (rung == RUNG_TPU and registry.supports("pallas_tpu")
+               and bool(jaxshim.make_async_copy))
+
+    def scatter(dst, ks, kg, rows):
+        return dst.at[ks, kg].set(rows)
+
+    if not use_dma:
+        return scatter
+
+    def splice(dst, ks, kg, rows):
+        if dst.ndim == 2:                      # per-group scalar plane
+            return scatter(dst, ks, kg, rows)
+        S, cap, E = dst.shape
+        K = rows.shape[0]
+        lin = (ks * cap + kg).astype(jnp.int32)
+        flat = _dma_row_splice(K, E, S * cap)(
+            dst.reshape(S * cap, E), lin, rows)
+        return flat.reshape(S, cap, E)
+
+    return splice

@@ -33,6 +33,16 @@ PAPERS.md): per-shard partial stats first collapse across the mesh's
 then reduce across shards ('data' axis) — never a flat all-to-all of
 per-group state; only the [5]-vector of fleet totals crosses shards.
 
+Incremental planning (ISSUE 16): :class:`ResidentFleetPlanner` keeps
+the packed grids RESIDENT on device between waves (a
+:class:`~.fleet.DeviceGridRing` double buffer) and replans only the
+shards a :class:`~..reconcile.resident.ResidentFleet`'s dirty masks
+name — row-granular splices in, whole-dirty-shard plan out, results
+spliced into a persistent host-side plan.  The full-repack
+:class:`WholeFleetPlanner` path stays the ORACLE: incremental output
+must bit-match it (lint rule L118 confines full repacks to
+oracle/verify entry points on the steady-state wave path).
+
 Purity contract (lint rule L113): no ``apis.*`` reach anywhere in this
 module, and no Python loops over fleet keys in the device programs
 (``_device_*`` / jitted / shard_mapped functions) — the fleet is
@@ -40,7 +50,7 @@ arrays end to end between pack and decode.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -51,11 +61,14 @@ from ..compat.jaxshim import shard_map
 from ..ops.diff import EMPTY, plan_observed_diff
 from ..ops.weights import plan_weights
 from ..reconcile.columnar import (
+    MODE_MODEL,
     MODE_NONE,
     MODE_SPEC,
     ColumnarFleet,
     GroupIntent,
     GroupState,
+    _pad_rows_bucket,
+    decode_group_intent,
     decode_intents,
     pack_fleet,
 )
@@ -219,9 +232,14 @@ class WholeFleetPlanner:
     """Host wrapper: packed fleets in, decoded mutation intents out.
 
     Owns the per-(rung, layout) compiled programs and the mesh; pure
-    over its inputs — the fingerprint/weight caches that make waves
-    incremental live with the caller (controller/fleetsweep.py), the
-    planner itself never reaches the provider (rule L113).
+    over its inputs and always a FULL repack+replan.  Steady-state
+    waves do NOT come here: controller/fleetsweep.py drives the
+    dirty-mask API (:class:`ResidentFleetPlanner` over a
+    ``ResidentFleet``), which replans only dirty shards.  This full
+    path is the ORACLE — the verification surface incremental output
+    must bit-match (``ResidentFleetPlanner.verify_full_repack``) —
+    and the one-shot path for callers without resident state.  Either
+    way the planner never reaches the provider (rule L113).
     """
 
     def __init__(self, model=None, params=None, seed: int = 0):
@@ -337,3 +355,362 @@ class WholeFleetPlanner:
                            shards=shards,
                            feature_dim=self.model.feature_dim)
         return self.plan(fleet)
+
+
+# ---------------------------------------------------------------------------
+# incremental resident planner (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def make_incremental_pass(model, rung: str, splice):
+    """Compile the dirty-shard pass: splice dirty rows into the
+    resident grids, replan the dirty shards, write back fresh weight
+    caches — one jit, device-resident end to end.
+
+    Shapes (all static per compiled specialization): resident grids
+    ``[S, cap, (E)]``; ``Kp`` spliced rows at ``(ks, kg)``; ``Dbp``
+    gathered dirty shards named by ``idx`` (pad entries carry
+    ``valid=False`` and scatter out of bounds on write-back); ``Np``
+    packed score rows with batch-global ``seg`` (``Dbp*cap`` = pad).
+    The planning math is :func:`_device_plan_block` — the SAME block
+    the oracle runs, so per-group-row independence makes incremental
+    == full bit-exact by construction.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if rung == RUNG_TPU:
+        from ..ops.pallas_weights import plan_weights_pallas as quantize
+    else:
+        quantize = plan_weights
+    block = partial(_device_plan_block, model.score_rows, quantize)
+
+    def incremental(params, res, ks, kg, rows6, idx, valid,
+                    srows, seg, slot, rescored):
+        res_d, res_o, res_ow, res_cw, res_m, res_sw = res
+        d_rows, o_rows, ow_rows, cw_rows, m_vals, sw_vals = rows6
+        # 1. splice the wave's dirty rows into the resident grids
+        res_d = splice(res_d, ks, kg, d_rows)
+        res_o = splice(res_o, ks, kg, o_rows)
+        res_ow = splice(res_ow, ks, kg, ow_rows)
+        res_cw = splice(res_cw, ks, kg, cw_rows)
+        res_m = res_m.at[ks, kg].set(m_vals)
+        res_sw = res_sw.at[ks, kg].set(sw_vals)
+        # 2. gather the dirty shards and replan them as one block
+        Dbp = idx.shape[0]
+        S, cap, E = res_d.shape
+        flat = lambda a: a[idx].reshape(Dbp * cap, *a.shape[2:])
+        desired_w, to_add, to_remove, to_reweight, _ = block(
+            params, srows, seg, slot, flat(res_d), flat(res_o),
+            flat(res_ow), flat(res_cw), rescored.reshape(-1),
+            flat(res_m), flat(res_sw))
+        # 3. write fresh caches back (rescored rows only); pad batches
+        #    route out of bounds — duplicate-index scatter order is
+        #    unspecified, so pads must never alias a real shard's write
+        new_cw = jnp.where(rescored.reshape(-1)[:, None], desired_w,
+                           flat(res_cw)).reshape(Dbp, cap, E)
+        idx_w = jnp.where(valid, idx, S)
+        res_cw = res_cw.at[idx_w].set(new_cw, mode="drop")
+        shape = (Dbp, cap, E)
+        return ((res_d, res_o, res_ow, res_cw, res_m, res_sw),
+                desired_w.reshape(shape), to_add.reshape(shape),
+                to_remove.reshape(shape), to_reweight.reshape(shape))
+
+    return jax.jit(incremental)
+
+
+@dataclass
+class WaveResult:
+    """One incremental wave's outcome."""
+
+    rung: str
+    dirty_shards: int
+    dirty_groups: int
+    device_call: bool                 # False = zero-dirty fast path
+    intents: List[GroupIntent]        # dirty positions only
+    stats: Dict[str, float] = field(default_factory=dict)
+
+
+class ResidentFleetPlanner:
+    """Incremental planner over a :class:`~..reconcile.resident.
+    ResidentFleet`: drains the dirty masks, replans ONLY the dirty
+    shards on device, and splices the results into a persistent
+    host-side plan (``planned_w`` / ``to_add`` / ``to_remove`` /
+    ``to_reweight``, ``[S, cap, E]``).
+
+    Device residency is a :class:`~.fleet.DeviceGridRing` double
+    buffer: each wave's pass returns NEW resident arrays
+    (functionally-updated), the ring advances, and the previous
+    buffer stays referenced until :meth:`flush_complete` — so the
+    next wave's splice+plan can start while the previous wave's
+    intents are still flushing.  A zero-dirty wave never touches the
+    device at all.
+
+    Correctness anchor: :meth:`verify_full_repack` repacks the
+    resident truth through the :class:`WholeFleetPlanner` ORACLE and
+    demands bit-equality — the only full-repack call site on the
+    steady-state path (lint rule L118).
+    """
+
+    def __init__(self, fleet, model=None, params=None, seed: int = 0):
+        import jax
+
+        from ..models.traffic import TrafficPolicyModel
+
+        from .fleet import DeviceGridRing, make_row_splice
+
+        self.fleet = fleet
+        self.model = model or TrafficPolicyModel()
+        self.params = (params if params is not None
+                       else self.model.init_params(
+                           jax.random.PRNGKey(seed)))
+        self.ring = DeviceGridRing()
+        self._make_splice = make_row_splice
+        self._fns: Dict[Tuple, object] = {}
+        self._gen = fleet.generation
+        self.device_calls = 0
+        self.waves = 0
+        S, cap, E = fleet.shards, fleet.cap, fleet.endpoints_cap
+        self.planned_w = np.zeros((S, cap, E), np.int32)
+        self.to_add = np.zeros((S, cap, E), bool)
+        self.to_remove = np.zeros((S, cap, E), bool)
+        self.to_reweight = np.zeros((S, cap, E), bool)
+
+    # -- residency maintenance -----------------------------------------
+
+    def plan_rung(self) -> str:
+        return registry.plan_rung()
+
+    def _sync_generation(self) -> None:
+        """Capacity growth invalidates device residency AND compiled
+        shapes; the host plan just pads (old positions kept)."""
+        if self._gen == self.fleet.generation:
+            return
+        cap = self.fleet.cap
+        grow = cap - self.planned_w.shape[1]
+        if grow > 0:
+            pad = ((0, 0), (0, grow), (0, 0))
+            self.planned_w = np.pad(self.planned_w, pad)
+            self.to_add = np.pad(self.to_add, pad)
+            self.to_remove = np.pad(self.to_remove, pad)
+            self.to_reweight = np.pad(self.to_reweight, pad)
+        self.ring.drop()
+        self._fns.clear()
+        self._gen = self.fleet.generation
+
+    def _resident_front(self):
+        """Current device-resident grids; first wave (or post-growth)
+        re-uploads the host truth wholesale."""
+        import jax.numpy as jnp
+
+        front = self.ring.front
+        if front is None:
+            f = self.fleet
+            front = self.ring.reset(tuple(jnp.asarray(a) for a in (
+                f.desired, f.observed, f.observed_w, f.cached_w,
+                f.weight_mode, f.spec_w)))
+        return front
+
+    def _fn(self, rung: str, Kp: int, Dbp: int, Np: int):
+        key = (rung, Kp, Dbp, Np, self.fleet.cap)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = make_incremental_pass(self.model, rung,
+                                       self._make_splice(rung))
+            self._fns[key] = fn
+        return fn
+
+    # -- the wave ------------------------------------------------------
+
+    def plan_wave(self) -> WaveResult:
+        """Drain the fleet's dirty masks and replan exactly those
+        shards, under a ``fleet_plan.incremental`` span.  Zero dirt =
+        zero device work (the steady-state invariant tests pin via
+        ``device_calls``)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..tracing import default_tracer
+
+        self._sync_generation()
+        f = self.fleet
+        dirty = f.take_dirty()
+        rung = self.plan_rung()
+        self.waves += 1
+        if not dirty:
+            return WaveResult(rung=rung, dirty_shards=0, dirty_groups=0,
+                              device_call=False, intents=[],
+                              stats={"adds": 0.0, "removes": 0.0,
+                                     "reweights": 0.0,
+                                     "rescored_groups": 0.0})
+
+        S, cap, E, F = f.shards, f.cap, f.endpoints_cap, f.feature_dim
+        ds = sorted(dirty)
+        Db = len(ds)
+        positions = [(s, gi) for s in ds for gi in dirty[s]]
+        K = len(positions)
+
+        # dirty-row splice batch (row-granular host->device traffic:
+        # K rows, not S*cap)
+        Kp = _pad_rows_bucket(K)
+        ks = np.zeros(Kp, np.int32)
+        kg = np.zeros(Kp, np.int32)
+        for i, (s, gi) in enumerate(positions):
+            ks[i], kg[i] = s, gi
+        ks[K:], kg[K:] = ks[0], kg[0]   # pad rows re-write row 0's
+        pos_idx = (ks[:K], kg[:K])      # value: scatter-order safe
+        rows6 = (f.desired[pos_idx], f.observed[pos_idx],
+                 f.observed_w[pos_idx], f.cached_w[pos_idx],
+                 f.weight_mode[pos_idx], f.spec_w[pos_idx])
+        rows6 = tuple(np.concatenate([r] + [r[:1]] * (Kp - K))
+                      if Kp > K else r for r in rows6)
+
+        # gathered dirty-shard batch + packed score rows for slots
+        # needing a rescore
+        Dbp = _pad_rows_bucket(Db, minimum=1)
+        idx = np.full(Dbp, ds[0], np.int32)
+        idx[:Db] = ds
+        valid = np.zeros(Dbp, bool)
+        valid[:Db] = True
+        batch_of = {s: b for b, s in enumerate(ds)}
+        rescored = np.zeros((Dbp, cap), bool)
+        srow_list: List[Tuple[np.ndarray, int, int]] = []
+        for s, gi in positions:
+            slot = f.slot(s, gi)
+            if (slot is None or slot.mode != MODE_MODEL
+                    or f.has_cache[s, gi]):
+                continue
+            if slot.features is None:
+                raise ValueError(
+                    f"resident slot {slot.key!r} needs a rescore but "
+                    f"holds no features")
+            b = batch_of[s]
+            rescored[b, gi] = True
+            for j in range(slot.nd):
+                srow_list.append((slot.features[j], b * cap + gi, j))
+        Np = _pad_rows_bucket(len(srow_list))
+        srows = np.zeros((Np, F), np.float32)
+        seg = np.full(Np, Dbp * cap, np.int32)   # out of bounds = drop
+        slot_col = np.zeros(Np, np.int32)
+        for i, (row, sg, j) in enumerate(srow_list):
+            srows[i], seg[i], slot_col[i] = row, sg, j
+
+        fn = self._fn(rung, Kp, Dbp, Np)
+        with default_tracer.span("fleet_plan.incremental", rung=rung,
+                                 layout="resident", dirty_shards=Db,
+                                 dirty_groups=K):
+            res = self._resident_front()
+            out = fn(self.params, res,
+                     jnp.asarray(ks), jnp.asarray(kg),
+                     tuple(jnp.asarray(r) for r in rows6),
+                     jnp.asarray(idx), jnp.asarray(valid),
+                     jnp.asarray(srows), jnp.asarray(seg),
+                     jnp.asarray(slot_col), jnp.asarray(rescored))
+            new_res, d_w, add, rm, rw = out
+            self.ring.advance(new_res)
+            d_w, add, rm, rw = jax.device_get((d_w, add, rm, rw))
+        self.device_calls += 1
+
+        # splice the replanned shards into the persistent host plan +
+        # refresh the host weight cache for rescored slots
+        d_w = np.asarray(d_w)
+        add, rm, rw = (np.asarray(a) for a in (add, rm, rw))
+        for b, s in enumerate(ds):
+            self.planned_w[s] = d_w[b]
+            self.to_add[s] = add[b]
+            self.to_remove[s] = rm[b]
+            self.to_reweight[s] = rw[b]
+            resc = rescored[b]
+            if resc.any():
+                f.cached_w[s][resc] = d_w[b][resc]
+        f.mark_scored([(s, gi) for s, gi in positions
+                       if rescored[batch_of[s], gi]])
+
+        live = int((f.desired[ds] != EMPTY).sum())
+        stats = {"adds": float(add[:Db].sum()),
+                 "removes": float(rm[:Db].sum()),
+                 "reweights": float(rw[:Db].sum()),
+                 "live_endpoints": float(live),
+                 "rescored_groups": float(rescored[:Db].sum())}
+        return WaveResult(
+            rung=rung, dirty_shards=Db, dirty_groups=K,
+            device_call=True,
+            intents=self._decode_positions(positions), stats=stats)
+
+    # -- decode / flush edges ------------------------------------------
+
+    def _decode_positions(self, positions) -> List[GroupIntent]:
+        out: List[GroupIntent] = []
+        for s, gi in positions:
+            slot = self.fleet.slot(s, gi)
+            if slot is None:          # removed this wave: no intent
+                continue
+            out.append(self._decode_one(slot, s, gi))
+        return out
+
+    def _decode_one(self, slot, s: int, gi: int) -> GroupIntent:
+        f = self.fleet
+        sof = f.arns.string_of
+        desired = [sof(int(i)) for i in f.desired[s, gi][:slot.nd]]
+        observed = [sof(int(i)) for i in f.observed[s, gi][:slot.no]]
+        return decode_group_intent(
+            slot.key, slot.group_arn, desired, observed,
+            slot.mode != MODE_NONE, slot.client_ip_preservation,
+            self.planned_w[s, gi], self.to_add[s, gi],
+            self.to_remove[s, gi], self.to_reweight[s, gi])
+
+    def intents_for(self, keys: Sequence[str]) -> List[GroupIntent]:
+        """Decode the RESIDENT plan for given keys — clean keys'
+        entries are as current as dirty ones (their shard's last
+        replan covered them)."""
+        out: List[GroupIntent] = []
+        for k in keys:
+            loc = self.fleet.location(k)
+            if loc is None:
+                continue
+            slot = self.fleet.slot(*loc)
+            if slot is not None:
+                out.append(self._decode_one(slot, *loc))
+        return out
+
+    def flush_complete(self) -> None:
+        """The previous wave's intent flush drained through the
+        coalescer: release the retired device buffer (the ring's
+        hand-off rule)."""
+        self.ring.release_retired()
+
+    # -- the oracle edge (the ONE sanctioned full repack: rule L118) ---
+
+    def verify_full_repack(self) -> Dict[str, object]:
+        """Repack the resident truth from scratch and replan it with
+        the :class:`WholeFleetPlanner` ORACLE; demand bit-equality
+        against the resident plan, position by position.  Call with
+        the dirty masks drained (an undrained wave is expected to
+        mismatch — it hasn't been planned yet)."""
+        f = self.fleet
+        oracle = WholeFleetPlanner(model=self.model,
+                                   params=self.params)
+        res = oracle.plan_groups(f.snapshot_groups(),
+                                 endpoints_cap=f.endpoints_cap,
+                                 shards=f.shards)
+        mismatches = 0
+        first: Optional[str] = None
+        pairs = zip(f.occupied_positions(), res.fleet.locations,
+                    res.fleet.groups)
+        for (s, gi), (s2, gp), g in pairs:
+            ok = (s == s2
+                  and np.array_equal(self.planned_w[s, gi],
+                                     res.desired_w[s2, gp])
+                  and np.array_equal(self.to_add[s, gi],
+                                     res.to_add[s2, gp])
+                  and np.array_equal(self.to_remove[s, gi],
+                                     res.to_remove[s2, gp])
+                  and np.array_equal(self.to_reweight[s, gi],
+                                     res.to_reweight[s2, gp]))
+            if not ok:
+                mismatches += 1
+                if first is None:
+                    first = g.key
+        return {"match": mismatches == 0, "groups": len(res.fleet.groups),
+                "mismatches": mismatches, "first_mismatch": first,
+                "oracle_rung": res.rung}

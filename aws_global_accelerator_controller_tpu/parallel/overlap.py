@@ -1,0 +1,179 @@
+"""Plan/flush overlap pipeline for the incremental resident planner.
+
+FlexLink's premise (PAPERS.md): use every resource concurrently.  On
+the steady-state wave path the resources are planner compute (device),
+host↔device row splices, and the provider wire (the coalescer flush) —
+PR 11's loop serialized them: plan wave N, flush wave N, plan wave
+N+1.  This module pipelines them: a dedicated flusher thread drains
+wave N's mutation intents through the coalescer while the main thread
+packs and plans wave N+1 against the OTHER device buffer of the
+:class:`~.fleet.DeviceGridRing` double buffer (the
+``ResidentFleetPlanner`` advanced the ring when wave N's pass
+returned; the retired buffer is released only at flush completion —
+the hand-off rule).
+
+Stage-ledger accounting makes the overlap observable rather than
+asserted: every mutated key carries a :class:`~..tracing.TraceContext`
+through the canonical hop sequence (``queued → claimed → planned →
+inflight → flushed → converged``), so wave N's coalesced/inflight
+window and wave N+1's queued/planned window come from the SAME
+monotonic hop stamps the PR-12 convergence ledger aggregates — the
+bench leg reports both the per-stage percentiles and the measured
+window intersection (:meth:`PlanFlushPipeline.overlap_seconds`).
+
+Thread model: ONE submitting thread (the wave driver) and ONE flusher;
+the depth-1 queue bounds pipelining at the double buffer's depth.  The
+queue/thread come from simulation/clock.py shims, so the pipeline runs
+identically under a VirtualClock (where flush latency is charged in
+virtual time) and the real clock (where the overlap windows are
+physically concurrent) — note that under a VirtualClock pure compute
+does not advance time, so overlap WINDOWS are only meaningful on the
+real clock.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..simulation import clock as simclock
+from ..tracing import default_ledger, new_context
+
+from .fleet_plan import ResidentFleetPlanner, WaveResult
+
+
+@dataclass
+class WaveWindows:
+    """One wave's measured stage windows (monotonic seconds)."""
+
+    wave: int
+    plan: Tuple[float, float]
+    flush: Optional[Tuple[float, float]] = None
+
+
+class PlanFlushPipeline:
+    """Overlap a wave's intent flush with the next wave's plan.
+
+    ``flush(wave)`` is the drain edge — whatever pushes the wave's
+    :class:`~.fleet_plan.WaveResult` intents through the coalescer to
+    the provider (or charges simulated wire latency in a bench).  It
+    runs on the flusher thread; exceptions are captured and re-raised
+    at the next submit/close (fail the driver, not the daemon).
+    """
+
+    def __init__(self, planner: ResidentFleetPlanner,
+                 flush: Callable[[WaveResult], None],
+                 controller: str = "fleet_sweep", ledger=None):
+        self.planner = planner
+        self._flush = flush
+        self._controller = controller
+        self._ledger = ledger if ledger is not None else default_ledger
+        self.windows: List[WaveWindows] = []
+        self._q = simclock.make_queue(maxsize=1)
+        self._err: Optional[BaseException] = None
+        self._closed = False
+        self._thread = simclock.start_thread(
+            self._drain, name="plan-flush-drain")
+
+    # -- driver edge ---------------------------------------------------
+
+    def submit_wave(self, mutated_keys: Sequence[str] = ()
+                    ) -> WaveResult:
+        """Plan the next wave and hand its intents to the flusher.
+
+        ``mutated_keys`` are the keys this wave's mutations touched
+        (already applied to the resident fleet by the caller); each
+        gets a ledger trace carried through the full hop sequence.
+        Blocks only when the flusher is a full wave behind — the
+        double buffer's depth.
+        """
+        self._reraise()
+        ctxs = []
+        for k in mutated_keys:
+            c = new_context("queued", record_span=False)
+            if c is not None:
+                ctxs.append((k, c))
+        for _, c in ctxs:
+            c.hop("claimed")
+        p0 = simclock.monotonic()
+        wave = self.planner.plan_wave()
+        p1 = simclock.monotonic()
+        for _, c in ctxs:
+            c.hop("planned", now=p1)
+            c.hop("inflight")
+        win = WaveWindows(wave=len(self.windows), plan=(p0, p1))
+        self.windows.append(win)
+        self._q.put((wave, ctxs, win))
+        return wave
+
+    def close(self) -> None:
+        """Drain outstanding flushes and stop the flusher."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(None)
+            simclock.join_thread(self._thread, timeout=60.0)
+        self._reraise()
+
+    def __enter__(self) -> "PlanFlushPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _reraise(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    # -- flusher edge --------------------------------------------------
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            wave, ctxs, win = item
+            f0 = simclock.monotonic()
+            try:
+                self._flush(wave)
+            except BaseException as e:  # surfaced at the driver's
+                self._err = e           # next submit/close
+            f1 = simclock.monotonic()
+            win.flush = (f0, f1)
+            for key, c in ctxs:
+                c.hop("flushed", now=f1)
+                c.hop("converged")
+                self._ledger.record(self._controller, key, c)
+            # the hand-off rule: wave N's retired device buffer is
+            # only released once its flush has drained
+            self.planner.flush_complete()
+
+    # -- the observable ------------------------------------------------
+
+    def overlap_seconds(self) -> float:
+        """Total measured intersection of wave N's flush window with
+        wave N+1's plan window — >0 means planning demonstrably ran
+        while the previous flush was on the wire."""
+        total = 0.0
+        for prev, cur in zip(self.windows, self.windows[1:]):
+            if prev.flush is None:
+                continue
+            lo = max(prev.flush[0], cur.plan[0])
+            hi = min(prev.flush[1], cur.plan[1])
+            total += max(0.0, hi - lo)
+        return total
+
+    def window_report(self) -> List[Dict[str, float]]:
+        """Per-wave window edges for the bench record (monotonic,
+        relative to the first wave's plan start)."""
+        if not self.windows:
+            return []
+        t0 = self.windows[0].plan[0]
+        out = []
+        for w in self.windows:
+            rec = {"wave": w.wave, "plan_start": w.plan[0] - t0,
+                   "plan_end": w.plan[1] - t0}
+            if w.flush is not None:
+                rec["flush_start"] = w.flush[0] - t0
+                rec["flush_end"] = w.flush[1] - t0
+            out.append(rec)
+        return out

@@ -281,37 +281,55 @@ class GroupIntent:
     weights: Dict[str, int]
 
 
+def decode_group_intent(key: str, group_arn: str,
+                        desired: Sequence[str],
+                        observed: Sequence[str],
+                        has_target: bool,
+                        client_ip_preservation: bool,
+                        desired_w_row: np.ndarray,
+                        add_row: np.ndarray, remove_row: np.ndarray,
+                        reweight_row: np.ndarray) -> GroupIntent:
+    """Decode ONE group's planner output rows into a
+    :class:`GroupIntent` — removes, then adds at the planned weight,
+    then re-weights, mirroring the per-object reconcile order.  Shared
+    by the full-repack decode below and the resident planner's
+    dirty-position decode (parallel/fleet_plan.py) so the two paths
+    cannot drift apart."""
+    from ..cloudprovider.aws.batcher import op_remove, op_set, op_weight
+
+    ops: List[object] = []
+    for j, arn in enumerate(observed):
+        if remove_row[j]:
+            ops.append(op_remove(arn))
+    weights: Dict[str, int] = {}
+    for j, arn in enumerate(desired):
+        w = int(desired_w_row[j])
+        if has_target:
+            weights[arn] = w
+        if add_row[j]:
+            ops.append(op_set(
+                arn, weight=w if has_target else None,
+                client_ip_preservation=client_ip_preservation))
+        elif has_target and reweight_row[j]:
+            ops.append(op_weight(arn, w))
+    return GroupIntent(key=key, group_arn=group_arn, ops=ops,
+                       weights=weights)
+
+
 def decode_intents(fleet: ColumnarFleet, desired_w: np.ndarray,
                    to_add: np.ndarray, to_remove: np.ndarray,
                    to_reweight: np.ndarray) -> List[GroupIntent]:
     """Nonzero diff rows -> EndpointOp intents, per real group.
 
     Inputs are the planner outputs reshaped ``[S, Gs, E]`` (numpy,
-    post device_get).  Decode order mirrors the per-object reconcile:
-    removes, then adds at the planned weight, then re-weights.  The
-    host loop here runs over DECODE output, not inside the jit path —
-    rule L113 polices the device side.
+    post device_get).  The host loop here runs over DECODE output, not
+    inside the jit path — rule L113 polices the device side.
     """
-    from ..cloudprovider.aws.batcher import op_remove, op_set, op_weight
-
     out: List[GroupIntent] = []
     for g, (s, gi) in zip(fleet.groups, fleet.locations):
-        ops: List[object] = []
-        has_target = g.mode() != MODE_NONE
-        for j, arn in enumerate(g.observed):
-            if to_remove[s, gi, j]:
-                ops.append(op_remove(arn))
-        weights: Dict[str, int] = {}
-        for j, arn in enumerate(g.desired):
-            w = int(desired_w[s, gi, j])
-            if has_target:
-                weights[arn] = w
-            if to_add[s, gi, j]:
-                ops.append(op_set(
-                    arn, weight=w if has_target else None,
-                    client_ip_preservation=g.client_ip_preservation))
-            elif has_target and to_reweight[s, gi, j]:
-                ops.append(op_weight(arn, w))
-        out.append(GroupIntent(key=g.key, group_arn=g.group_arn,
-                               ops=ops, weights=weights))
+        out.append(decode_group_intent(
+            g.key, g.group_arn, g.desired, g.observed,
+            g.mode() != MODE_NONE, g.client_ip_preservation,
+            desired_w[s, gi], to_add[s, gi], to_remove[s, gi],
+            to_reweight[s, gi]))
     return out

@@ -1,0 +1,24 @@
+"""Fixture: rule L118 violations — full-repack entry points called
+from the steady-state wave path outside an oracle/verify function."""
+
+
+class SweepLikeController:
+    def plan_staged(self, groups):
+        # the exact regression the rule exists for: a wave that
+        # repacks the whole fleet instead of replanning dirty shards
+        fleet = self.pack_fleet(groups)                  # line 9: L118
+        return self.oracle.plan_groups(groups)           # line 10: L118
+
+    def verify_full_repack(self):
+        # oracle entry point: the legal home for a full repack
+        return self.oracle.plan_groups(self.snapshot())
+
+    def _oracle_check(self, groups):
+        # "oracle" in the name is enough — helper spelling
+        return pack_fleet(groups)
+
+    def waved_through(self, groups):
+        return pack_fleet(groups)  # race: startup cold-build fixture
+
+
+MODULE_LEVEL = pack_fleet([])                            # line 24: L118

@@ -350,10 +350,10 @@ def test_sweep_valve_counts_repair_verdicts_too():
                         VERDICT_WEIGHT_DRIFT, VERDICT_UNPLANNED]
 
 
-def test_sweep_weight_cache_is_lru_bounded():
-    """Binding churn must never grow the incremental feed unbounded:
-    the cache holds at most cache_max keys, oldest evicted first (an
-    evicted key just rescores on its next wave)."""
+def test_sweep_resident_fleet_is_lru_bounded():
+    """Binding churn must never grow the resident fleet unbounded:
+    it holds at most cache_max groups, oldest evicted first (an
+    evicted key just re-inserts and rescores on its next wave)."""
     b = _binding(weight=128, endpoint_ids=[arn(1)])
     g = _group([(arn(1), 128)])
     fs = _sweeper(b, g, cache_max=3)
@@ -362,7 +362,7 @@ def test_sweep_weight_cache_is_lru_bounded():
         fs.stage(key)
         fs._get_binding = lambda k: b
         fs.plan_staged()
-    assert len(fs._weight_cache) <= 3
+    assert len(fs._fleet) <= 3
 
 
 def test_sweep_missing_live_endpoint_repairs_like_per_object():

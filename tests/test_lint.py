@@ -1068,3 +1068,54 @@ def test_l117_seeded_literal_linger_in_shipped_batcher_caught(tmp_path):
     # sanity: the unmutated batcher is clean under the rule
     assert [x for x in concurrency_lint.lint_files([batcher_py])
             if x.code == "L117"] == []
+
+
+def test_l118_wave_repack_fires_and_oracle_shapes_pass():
+    """Full repacks on the wave path (lines 9/10, plus the
+    module-level call at 24) fire; the oracle/verify functions and
+    the ``# race:`` waiver are the legal shapes."""
+    assert _cfindings("l118_wave_repack.py") == [
+        ("L118", 9), ("L118", 10), ("L118", 24)]
+
+
+def test_l118_clean_wave_path_passes():
+    """plan_wave-only waves and repacks behind oracle/verify entry
+    points (nested helpers included) — zero findings."""
+    assert _cfindings("l118_clean.py") == []
+
+
+def test_l118_shipped_wave_path_modules_clean():
+    """The shipped steady-state wave path stays clean under its own
+    rule."""
+    files = [pathlib.Path(ROOT_DIR) / p for p in (
+        "aws_global_accelerator_controller_tpu/controller/"
+        "fleetsweep.py",
+        "aws_global_accelerator_controller_tpu/parallel/overlap.py")]
+    assert [x for x in concurrency_lint.lint_files(files)
+            if x.code == "L118"] == []
+
+
+def test_l118_seeded_repack_graft_into_shipped_sweep_caught(tmp_path):
+    """Acceptance probe (ISSUE 16): graft a full repack back into the
+    REAL sweep wave (``plan_staged``) — the exact regression the rule
+    exists to block — and the gate must fire."""
+    sweep_py = pathlib.Path(ROOT_DIR) / (
+        "aws_global_accelerator_controller_tpu/controller/"
+        "fleetsweep.py")
+    src = sweep_py.read_text()
+    needle = "                wave = planner.plan_wave()\n"
+    assert src.count(needle) == 1, \
+        "sweep wave planning shape changed; update this probe"
+    mutated = src.replace(
+        needle,
+        "                packed = pack_fleet(\n"
+        "                    fleet.snapshot_groups())\n" + needle, 1)
+    pkg_dir = (tmp_path / "aws_global_accelerator_controller_tpu"
+               / "controller")
+    pkg_dir.mkdir(parents=True)
+    f = pkg_dir / "fleetsweep.py"
+    f.write_text(mutated)
+    findings = [x for x in concurrency_lint.lint_files([f])
+                if x.code == "L118"]
+    assert findings, "a grafted full repack in the shipped sweep " \
+                     "wave was not caught"

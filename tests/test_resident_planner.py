@@ -1,0 +1,269 @@
+"""Incremental resident planner: dirty-mask correctness vs the
+full-repack oracle (ISSUE 16).
+
+The load-bearing property: after ANY sequence of mutations —
+weight drift, membership churn, shard handoffs, removals, slot reuse,
+interning-table growth, capacity growth — the resident plan is
+BIT-IDENTICAL to repacking the whole fleet from scratch and planning
+it with the ``WholeFleetPlanner`` oracle.  No hypothesis in this
+container, so the property tests run seeded randomized sweeps (the
+same fuzzer-family convention as test_fleet_plan.py).
+"""
+import numpy as np
+import pytest
+
+from aws_global_accelerator_controller_tpu.parallel.fleet import (
+    DeviceGridRing,
+)
+from aws_global_accelerator_controller_tpu.parallel.fleet_plan import (
+    ResidentFleetPlanner,
+    WholeFleetPlanner,
+)
+from aws_global_accelerator_controller_tpu.reconcile.columnar import (
+    MODE_MODEL,
+    MODE_SPEC,
+    GroupState,
+)
+from aws_global_accelerator_controller_tpu.reconcile.resident import (
+    UPSERT_MOVED,
+    UPSERT_UNCHANGED,
+    ResidentFleet,
+)
+
+CAP = 6
+F = 8
+SHARDS = 4
+
+
+def arn(i):
+    return f"arn:aws:elasticloadbalancing:us-east-1:1:lb/net/lb{i}/x"
+
+
+def random_group(rng, i, pool_base=0, shard=None):
+    """Random GroupState over the interesting shapes; ``pool_base``
+    shifts the ARN pool so later waves grow the interning table."""
+    nd = int(rng.integers(0, CAP + 1))
+    no = int(rng.integers(0, CAP + 1))
+    pool = [arn(pool_base + i * 100 + j) for j in range(CAP * 2)]
+    desired = list(rng.choice(pool, size=nd, replace=False))
+    observed = list(rng.choice(pool, size=no, replace=False))
+    observed_w = [int(w) if rng.random() > 0.2 else None
+                  for w in rng.integers(0, 256, no)]
+    mode = int(rng.integers(0, 3))
+    features = (rng.standard_normal((nd, F)).astype(np.float32)
+                if mode == MODE_MODEL else None)
+    return GroupState(
+        key=f"default/b{i}", group_arn=f"eg-{i}", desired=desired,
+        observed=observed, observed_weights=observed_w,
+        features=features,
+        spec_weight=(int(rng.integers(0, 256))
+                     if mode == MODE_SPEC else None),
+        model_planned=(mode == MODE_MODEL),
+        client_ip_preservation=bool(rng.integers(0, 2)),
+        fingerprint=int(rng.integers(1, 2 ** 40)),
+        shard=(int(rng.integers(0, SHARDS)) if shard is None
+               else shard))
+
+
+def make_pair(seed=0, groups_per_shard=4, max_groups=None):
+    fleet = ResidentFleet(shards=SHARDS, endpoints_cap=CAP,
+                          feature_dim=F,
+                          groups_per_shard=groups_per_shard,
+                          max_groups=max_groups)
+    return fleet, ResidentFleetPlanner(fleet, seed=seed)
+
+
+def op_triples(intent):
+    return [(op.kind, op.endpoint_id, getattr(op, "weight", None))
+            for op in intent.ops]
+
+
+def assert_matches_full_repack(planner):
+    """Array-level bit-match via the planner's own oracle entry point
+    PLUS decoded-intent equality (ops in order, weights included) —
+    the contract both the sweep tier and the bench rely on."""
+    v = planner.verify_full_repack()
+    assert v["match"], v
+    fleet = planner.fleet
+    keys = [fleet.slot(s, gi).key
+            for s, gi in fleet.occupied_positions()]
+    oracle = WholeFleetPlanner(model=planner.model,
+                               params=planner.params)
+    res = oracle.plan_groups(fleet.snapshot_groups(),
+                             endpoints_cap=fleet.endpoints_cap,
+                             shards=fleet.shards)
+    want = {i.key: i for i in res.intents()}
+    got = {i.key: i for i in planner.intents_for(keys)}
+    assert set(got) == set(want)
+    for k in want:
+        assert op_triples(got[k]) == op_triples(want[k]), k
+        assert got[k].weights == want[k].weights, k
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_incremental_matches_oracle_across_mutation_sequences(seed):
+    """The fuzzer family: random insert / mutate / handoff / remove /
+    touch waves, each followed by one incremental plan — every wave's
+    resident plan must bit-match the full repack, and later waves keep
+    growing the interning table (fresh ARN pools) so id stability
+    under table growth is exercised throughout."""
+    rng = np.random.default_rng(seed)
+    fleet, planner = make_pair(seed=seed)
+    live = {}
+    for i in range(20):
+        live[i] = random_group(rng, i)
+        fleet.upsert(live[i])
+    planner.plan_wave()
+    assert_matches_full_repack(planner)
+
+    for wave in range(5):
+        pool_base = (wave + 1) * 10_000       # interning-table growth
+        for _ in range(4):
+            roll = rng.random()
+            if roll < 0.25 and live:
+                k = int(rng.choice(list(live)))
+                fleet.remove(f"default/b{k}")
+                del live[k]
+            elif roll < 0.5 and live:
+                # shard handoff: same key re-homed
+                k = int(rng.choice(list(live)))
+                old = live[k]
+                g = random_group(rng, k, pool_base=pool_base,
+                                 shard=(old.shard + 1) % SHARDS)
+                live[k] = g
+                fleet.upsert(g)
+            elif roll < 0.75:
+                k = int(rng.integers(1000, 2000))
+                live[k] = random_group(rng, k, pool_base=pool_base)
+                fleet.upsert(live[k])
+            elif live:
+                # watch-event touch: dirty without a content change
+                k = int(rng.choice(list(live)))
+                fleet.note_dirty(f"default/b{k}")
+        planner.plan_wave()
+        assert_matches_full_repack(planner)
+
+
+def test_zero_dirty_wave_never_touches_the_device():
+    rng = np.random.default_rng(7)
+    fleet, planner = make_pair(seed=7)
+    for i in range(12):
+        fleet.upsert(random_group(rng, i))
+    w1 = planner.plan_wave()
+    assert w1.device_call and planner.device_calls == 1
+    w2 = planner.plan_wave()
+    assert not w2.device_call
+    assert w2.dirty_shards == 0 and w2.dirty_groups == 0
+    assert w2.intents == []
+    assert planner.device_calls == 1          # no device work at all
+    assert_matches_full_repack(planner)
+
+
+def test_unchanged_upsert_stays_clean():
+    rng = np.random.default_rng(3)
+    fleet, planner = make_pair(seed=3)
+    g = random_group(rng, 0)
+    fleet.upsert(g)
+    planner.plan_wave()
+    # identical re-describe: no dirt, no replan
+    assert fleet.upsert(g) == UPSERT_UNCHANGED
+    assert fleet.dirty_group_count() == 0
+    w = planner.plan_wave()
+    assert not w.device_call
+
+
+def test_capacity_growth_bumps_generation_and_bitmatches():
+    """Overflowing a shard doubles slot capacity fleet-wide; device
+    residency re-uploads and the plan still bit-matches the oracle."""
+    rng = np.random.default_rng(11)
+    fleet, planner = make_pair(seed=11, groups_per_shard=2)
+    for i in range(4):
+        fleet.upsert(random_group(rng, i, shard=i % SHARDS))
+    planner.plan_wave()
+    gen0 = fleet.generation
+    for i in range(10, 22):                   # overflow shard 0
+        fleet.upsert(random_group(rng, i, shard=0))
+    assert fleet.generation > gen0
+    planner.plan_wave()
+    assert_matches_full_repack(planner)
+
+
+def test_slot_reuse_after_remove_clears_stale_cache():
+    """A removed model group's slot reused by a static group must not
+    leak the old cached weights into the new occupant's plan (the
+    resident cached_w row is cleared on remove and spliced on
+    insert)."""
+    rng = np.random.default_rng(5)
+    fleet, planner = make_pair(seed=5)
+    g = random_group(rng, 0, shard=1)
+    g.model_planned, g.spec_weight = True, None
+    g.features = rng.standard_normal((len(g.desired), F)).astype(
+        np.float32)
+    fleet.upsert(g)
+    planner.plan_wave()
+    fleet.remove(g.key)
+    g2 = random_group(rng, 99, shard=1)
+    g2.model_planned, g2.spec_weight, g2.features = False, None, None
+    fleet.upsert(g2)
+    assert fleet.location(g2.key) == (1, 0)   # the reused slot
+    planner.plan_wave()
+    assert_matches_full_repack(planner)
+
+
+def test_handoff_preserves_features_and_bitmatches():
+    """An input-preserving shard handoff (same desired/features, new
+    owner) re-homes the stored features — no caller re-featurize —
+    and both shards replan to oracle equality."""
+    rng = np.random.default_rng(9)
+    fleet, planner = make_pair(seed=9)
+    g = random_group(rng, 0, shard=0)
+    g.model_planned, g.spec_weight = True, None
+    g.features = rng.standard_normal((len(g.desired), F)).astype(
+        np.float32)
+    fleet.upsert(g)
+    planner.plan_wave()
+    moved = GroupState(
+        key=g.key, group_arn=g.group_arn, desired=g.desired,
+        observed=g.observed, observed_weights=g.observed_weights,
+        features=None, spec_weight=None, model_planned=True,
+        client_ip_preservation=g.client_ip_preservation,
+        fingerprint=g.fingerprint, shard=2)
+    assert fleet.upsert(moved) == UPSERT_MOVED
+    assert set(fleet.take_dirty()) == {0, 2}
+    fleet.note_dirty(g.key)                   # re-dirty after drain
+    planner.plan_wave()
+    assert_matches_full_repack(planner)
+
+
+def test_model_invalidate_rescores_everything():
+    """Param hot-reload: invalidate_scores dirties every model slot;
+    the next wave rescores them and still matches an oracle built on
+    the NEW params."""
+    rng = np.random.default_rng(13)
+    fleet, planner = make_pair(seed=13)
+    for i in range(10):
+        fleet.upsert(random_group(rng, i))
+    planner.plan_wave()
+    import jax
+
+    planner.params = planner.model.init_params(jax.random.PRNGKey(42))
+    n = fleet.invalidate_scores()
+    w = planner.plan_wave()
+    if n:
+        assert w.device_call and w.stats["rescored_groups"] >= n
+    assert_matches_full_repack(planner)
+
+
+def test_device_ring_handoff_rule():
+    """advance() retires the previous front and holds it until
+    release_retired() — the double-buffer hand-off rule."""
+    import jax.numpy as jnp
+
+    ring = DeviceGridRing()
+    a = ring.reset((jnp.zeros(3),))
+    b = ring.advance((jnp.ones(3),))
+    assert ring.front is b and ring._retired is a
+    ring.release_retired()
+    assert ring._retired is None
+    ring.drop()
+    assert ring.front is None
