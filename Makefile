@@ -1,7 +1,7 @@
 # Developer entry points (analogue of the reference Makefile:16-24).
 
 .PHONY: test manifests check-manifests bench benchdoc graft-dryrun lint \
-	tier1-diff fuzz-smoke bench-smoke
+	probes tier1-diff fuzz-smoke bench-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -52,7 +52,15 @@ graft-dryrun:
 # package is installable in the build environment); compileall stays as
 # the pure syntax gate for files lint.py does not cover.  --all runs
 # BOTH passes: base rules L001-L007 and the concurrency contract rules
-# L101-L118 (docs/static-analysis.md)
+# L101-L120 (docs/static-analysis.md)
 lint:
 	python -m compileall -q aws_global_accelerator_controller_tpu tests
 	python hack/lint.py --all
+
+# contract-mutation probes (docs/static-analysis.md): for every rule
+# L101-L120, strip or graft the guarded construct in a COPY of the
+# shipped source and assert the lint gate fires.  Proves each checker
+# still detects the real-tree shape it was written for; a probe whose
+# anchor drifted fails loudly instead of silently passing.
+probes:
+	python hack/probe.py

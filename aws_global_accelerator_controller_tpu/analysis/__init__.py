@@ -7,7 +7,7 @@ This package makes those conventions machine-checked — the Python
 analogue of running the Go reference under ``-race`` plus client-go's
 cache object-mutation detector:
 
-- ``concurrency_lint``: AST-based static pass (rules L101-L112) run by
+- ``concurrency_lint``: AST-based static pass (rules L101-L120) run by
   ``hack/lint.py --concurrency`` over the whole tree.  Pure stdlib, no
   runtime dependencies — importable by the lint gate without pulling in
   the controller stack.

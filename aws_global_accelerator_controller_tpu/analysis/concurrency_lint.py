@@ -1,4 +1,4 @@
-"""AST-based concurrency contract lints (rules L101-L118).
+"""AST-based concurrency contract lints (rules L101-L120).
 
 The static half of the concurrency checker: a whole-program pass over
 the tree that enforces the synchronization contracts PR 1 introduced as
@@ -641,6 +641,9 @@ class _FileInfo:
         self.path = path
         self.tree = tree
         self.module = path.stem
+        # raw source lines: the ownership pass (L119/L120) reads the
+        # guard-declaration comments the AST drops
+        self.lines = source.splitlines()
         self.waived = _waived_lines(source)
         # (class or None, method name) -> set of lock ids the body
         # acquires via ``with`` — the one-level call expansion for L101.
@@ -707,13 +710,15 @@ class Engine:
 
     # -- phase 1: definitions ------------------------------------------
 
-    def add_file(self, path: Path, source: str) -> None:
-        try:
-            tree = ast.parse(source, filename=str(path))
-        except SyntaxError as e:
-            self.findings.append(Finding(path, e.lineno or 0, "L100",
-                                         f"syntax error: {e.msg}"))
-            return
+    def add_file(self, path: Path, source: str,
+                 tree: Optional[ast.Module] = None) -> None:
+        if tree is None:
+            try:
+                tree = ast.parse(source, filename=str(path))
+            except SyntaxError as e:
+                self.findings.append(Finding(path, e.lineno or 0, "L100",
+                                             f"syntax error: {e.msg}"))
+                return
         info = _FileInfo(path, tree, source)
         self.files.append(info)
         self._collect_defs(info)
@@ -782,6 +787,10 @@ class Engine:
             self._check_columnar_purity(info)
             self._check_wave_repack(info)
             self._check_knob_literals(info)
+        # field-level lock ownership (L119/L120) — its own module, the
+        # local import keeps the dependency one-directional
+        from . import ownership
+        self.findings.extend(ownership.run_pass(self.files))
         self._check_ordering_graph()
         self._check_wrapper_fence_gate()
         self._check_sharded_submit_gate()

@@ -15,6 +15,16 @@ Every acquisition also counts one lockset check, published through
 ``metrics.record_lockset_checks`` in batches (the tracker must never
 take the metrics registry lock per acquisition — that lock would join
 the graph it is measuring).
+
+The tracker is also the runtime half of the L119 guard map
+(``analysis/ownership.py``): :func:`install_guard_checks` patches
+``__setattr__`` on classes carrying ``# guarded-by: self.<lock>``
+declarations so a post-init write without the declared lock held
+raises :class:`GuardMapViolation` and bumps
+``guard_map_violations_total``; ``AGAC_GUARD_PROFILE=<path>`` (or
+:func:`enable_profile`) additionally records every post-init write
+with the held lockset for ``hack/guard_infer.py`` to audit against
+the declared map.
 """
 from __future__ import annotations
 
@@ -174,3 +184,182 @@ class TrackedLock:
             self._inner.release()
             return False
         return True
+
+
+# -- field-level guard map (the runtime half of L119) -------------------
+#
+# install_guard_checks() patches ``__setattr__`` on every imported class
+# carrying ``# guarded-by: self.<lock>`` declarations (parsed by
+# analysis/ownership.py).  Post-__init__ writes to a declared attribute
+# are then cross-checked against the thread's live lockset: a write
+# with the owning lock NOT held raises :class:`GuardMapViolation` and
+# bumps ``guard_map_violations_total`` — the dynamic witness for the
+# interleavings the lexical pass cannot see (getattr chains, exec'd
+# code, callbacks).  With ``AGAC_GUARD_PROFILE=<path>`` the same hook
+# instead RECORDS (class, attr, locks-held) profiles; hack/guard_infer.py
+# renders the dump as reviewable ``# guarded-by:`` proposals for
+# not-yet-declared fields.
+
+_profile_path = os.environ.get("AGAC_GUARD_PROFILE")
+# (classname, attr) -> {held-names tuple -> count}
+_profiles: dict = {}
+_profile_lock = threading.Lock()
+_patched: set = set()
+
+
+class GuardMapViolation(RuntimeError):
+    """A declared-guarded attribute was written without its owning
+    lock held — the runtime cross-check of the static guard map."""
+
+
+def profile_enabled() -> bool:
+    return _profile_path is not None
+
+
+def enable_profile(path: str) -> None:
+    """Arm guard-profile recording (normally via AGAC_GUARD_PROFILE)."""
+    global _profile_path
+    _profile_path = path
+
+
+def _describe_held(obj) -> tuple:
+    """The thread's held locks as declaration-ready names:
+    ``self.<attr>`` when a held lock is an attribute of ``obj``
+    (directly or as a Condition's underlying lock), else the lock's
+    registered name in angle brackets."""
+    names = []
+    for h in _held():
+        label = None
+        try:
+            attrs = vars(obj)
+        except TypeError:          # __slots__
+            attrs = {}
+        for k, v in attrs.items():
+            if v is h or getattr(v, "_lock", None) is h:
+                label = "self." + k
+                break
+        names.append(label or f"<{h.name}>")
+    return tuple(sorted(set(names)))
+
+
+def _resolve_lock(obj, chain):
+    """``['self', '_cond']`` -> the lock object a held-set identity
+    check can use (Conditions are unwrapped to their inner lock)."""
+    target = obj
+    for part in chain[1:]:
+        target = getattr(target, part, None)
+        if target is None:
+            return None
+    return getattr(target, "_lock", target)
+
+
+def _patch_class(cls, lock_decls: dict) -> None:
+    orig = cls.__setattr__
+
+    def checked_setattr(self, attr, value):
+        # first writes are __init__ construction: the guard itself may
+        # not exist yet, and the creating thread owns the instance
+        if _enabled:
+            try:
+                seen = attr in object.__getattribute__(self, "__dict__")
+            except AttributeError:
+                seen = False
+            if seen:
+                # profile EVERY post-init write (inference proposes
+                # declarations for fields that lack one); cross-check
+                # only the declared ones.  Requires detection armed:
+                # with plain locks the held set is always empty and
+                # the profile would read as all-unguarded
+                if _profile_path is not None:
+                    key = (cls.__name__, attr)
+                    if attr in lock_decls and not isinstance(
+                            _resolve_lock(self, lock_decls[attr]),
+                            TrackedLock):
+                        # the declared lock is a plain primitive
+                        # (e.g. the virtual clock's own lock — the
+                        # substrate tracked locks park in): its
+                        # acquisitions are invisible, so record that
+                        # rather than a misleading empty lockset
+                        desc = ("<untracked>",)
+                    else:
+                        desc = _describe_held(self)
+                    with _profile_lock:
+                        counts = _profiles.setdefault(key, {})
+                        counts[desc] = counts.get(desc, 0) + 1
+            if seen and attr in lock_decls:
+                if _enabled:
+                    lock = _resolve_lock(self, lock_decls[attr])
+                    # only TrackedLock instances can be cross-checked:
+                    # a plain lock means the object predates arming
+                    # (make_lock decides at creation time) and its
+                    # acquisitions are invisible to the held set
+                    if isinstance(lock, TrackedLock) and \
+                            not any(h is lock for h in _held()):
+                        from ..metrics import record_guard_map_violation
+                        record_guard_map_violation(cls.__name__, attr)
+                        raise GuardMapViolation(
+                            f"write to {cls.__name__}.{attr} without "
+                            f"its declared guard "
+                            f"'{'.'.join(lock_decls[attr])}' held "
+                            f"(held: {_describe_held(self) or '()'})\n"
+                            f"{_stack()}")
+        orig(self, attr, value)
+
+    cls.__setattr__ = checked_setattr
+
+
+def install_guard_checks(root=None) -> int:
+    """Patch every currently-imported class that carries static
+    ``# guarded-by: self.<lock>`` declarations.  Idempotent; returns
+    the number of classes newly patched.  Patching is process-global,
+    but the hook is a passthrough unless detection or profiling is
+    armed, so suites that never opt in pay one dict lookup per
+    setattr on the handful of declared classes."""
+    import sys
+    from pathlib import Path
+    from .ownership import declared_runtime_guards
+
+    pkg_root = Path(root) if root is not None \
+        else Path(__file__).resolve().parents[1]
+    guards = declared_runtime_guards(pkg_root)
+    pkg = pkg_root.name
+    count = 0
+    for modname, mod in list(sys.modules.items()):
+        if mod is None or not modname.startswith(pkg):
+            continue
+        for classname, decls in guards.items():
+            cls = getattr(mod, classname, None)
+            if not isinstance(cls, type) or cls.__name__ != classname \
+                    or cls in _patched:
+                continue
+            lock_decls = {a: d.chain for a, d in decls.items()
+                          if d.kind == "lock" and d.chain}
+            if not lock_decls:
+                continue
+            _patch_class(cls, lock_decls)
+            _patched.add(cls)
+            count += 1
+    return count
+
+
+def dump_guard_profile(path=None) -> str:
+    """Write recorded access profiles as JSON for hack/guard_infer.py.
+    Schema: {"ClassName.attr": {"held": {"self._lock|...": n}}}."""
+    import json
+
+    out_path = path or _profile_path
+    if out_path is None:
+        raise RuntimeError("no profile path: set AGAC_GUARD_PROFILE "
+                           "or pass path=")
+    with _profile_lock:
+        doc = {
+            f"{cls}.{attr}": {
+                "held": {"|".join(held) if held else "<none>": n
+                         for held, n in counts.items()},
+            }
+            for (cls, attr), counts in sorted(_profiles.items())
+        }
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return out_path

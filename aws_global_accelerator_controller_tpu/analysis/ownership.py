@@ -1,0 +1,478 @@
+"""Field-level lock-ownership pass (rules L119-L120).
+
+The ordering tracker (locks.py) and the L101-L118 contracts prove the
+tree acquires locks consistently — but a no-GIL hot path needs the
+stronger RacerD-style invariant: each shared FIELD is consistently
+guarded by ONE lock.  This pass makes that invariant declarable and
+checkable:
+
+  declaration   a ``# guarded-by: <spec>`` comment on (or directly
+                above) an attribute's assignment inside a class binds
+                the attribute to its owner:
+
+                    self._cache = {}        # guarded-by: self._cache_lock
+                    self.gen = 0            # guarded-by: self.lock
+                    self.arns = InternTable()  # guarded-by: external: sweep owner
+                    self._clock = clock     # guarded-by: immutable
+                    self._stop = Event()    # guarded-by: internal
+
+                ``self.<lock>`` names an instance lock (checked
+                lexically, rule L119); ``immutable`` promises the
+                attribute is never written after ``__init__`` (L119
+                flags post-init rebinds AND container mutation);
+                ``internal`` marks an internally-synchronized object
+                (Event, Queue, Singleflight — method calls are safe
+                anywhere, only post-init REBINDS flag); ``external:
+                <why>`` documents ownership the checker cannot see
+                lexically (a caller's wave lock, pipeline
+                serialization) — it satisfies L120 and is exempt from
+                L119.
+
+  L119          reads/writes of a declared-guarded attribute without
+                the owning lock lexically held.  Class-qualified lock
+                identities and one-level same-class call expansion,
+                like L101: a method whose every same-class call site
+                holds the owning lock is exempt (callers carry the
+                lock), as are ``__init__``/``__post_init__`` and
+                ``*_locked`` methods (their call sites are policed by
+                L104).  One level of holder indirection is resolved
+                through constructor assignments: after
+                ``self._s = FleetDiscoveryState()``, accesses to
+                ``self._s.<attr>`` are checked against the held
+                class's declarations with the lock re-rooted at the
+                holder (``self._s.lock`` — the same ``_s.lock``
+                identity the ordering graph uses).  ``# race:``
+                waivers are honored.
+
+  L120          classes whose instances provably cross threads — any
+                method spawns a thread (``threading.Thread`` /
+                ``simclock.start_thread``), so state constructed on
+                one thread is touched from worker/flusher/elector
+                paths — with mutable attributes (written outside
+                ``__init__``, or container-mutated via
+                append/update/...) carrying neither a guard
+                declaration nor an immutability waiver.
+
+Unlike L101's closure rule (a nested def gets a FRESH lockset), L119
+walks nested functions with the lockset held at their DEFINITION site:
+a closure built under the lock and invoked later would over-report
+otherwise, and the zero-findings gate favors precision over recall.
+
+Pure stdlib ``ast``; invoked from concurrency_lint.Engine.run() so
+waiver filtering, fixture scoping and ``hack/lint.py --concurrency``
+wiring are shared with L101-L118.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .concurrency_lint import (Finding, _attr_chain, _FileInfo, _LockId,
+                               _lock_exprs, _LOCKISH, _MUTATING_METHODS)
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*(.+?)\s*$")
+
+# attribute names that ARE synchronization/lifecycle plumbing: the lock
+# itself, its condition, thread handles and stop events need no guard
+# declaration of their own (a lock does not guard itself)
+_SYNCISH = re.compile(
+    r"(?:^|_)(lock|cond|mutex|rlock|event|sem|thread|threads|waker)$")
+
+# thread-spawn call surface: the stdlib constructor and the virtual
+# clock's tracked spawner (simulation/clock.py)
+_SPAWN_CALLS = {"Thread", "start_thread"}
+
+
+class GuardDecl:
+    """One parsed ``# guarded-by:`` declaration."""
+
+    __slots__ = ("kind", "chain", "line", "spec")
+
+    def __init__(self, kind: str, chain: Optional[List[str]],
+                 line: int, spec: str):
+        self.kind = kind          # 'lock' | 'immutable' | 'external'
+        self.chain = chain        # ['self', '_cache_lock'] for 'lock'
+        self.line = line
+        self.spec = spec
+
+
+def _l119_in_scope(path: Path) -> bool:
+    """L119/L120 cover every shipped package file plus their own
+    fixture corpus (other rules' fixtures spawn threads and strip
+    locks deliberately — that is their test shape, not a finding)."""
+    parts = path.parts
+    if "lint_fixtures" in parts:
+        return path.name.startswith(("l119_", "l120_"))
+    return "aws_global_accelerator_controller_tpu" in parts
+
+
+def _decl_comment(info: _FileInfo, node: ast.AST) -> Optional[Tuple[str, int]]:
+    """The guarded-by spec attached to an assignment: on any source
+    line of the statement, or in the contiguous pure-comment block
+    directly above (an ``external:`` reason often wraps lines)."""
+    lines = info.lines
+    start = node.lineno
+    end = getattr(node, "end_lineno", None) or start
+    for ln in range(start, min(end, len(lines)) + 1):
+        m = _GUARD_RE.search(lines[ln - 1])
+        if m:
+            return m.group(1), ln
+    ln = start - 1
+    while ln >= 1 and lines[ln - 1].strip().startswith("#"):
+        m = _GUARD_RE.search(lines[ln - 1])
+        if m:
+            return m.group(1), ln
+        ln -= 1
+    return None
+
+
+def _parse_spec(spec: str) -> Optional[GuardDecl]:
+    if spec in ("immutable", "internal"):
+        return GuardDecl(spec, None, 0, spec)
+    if spec.split(":", 1)[0].strip() == "external":
+        return GuardDecl("external", None, 0, spec)
+    if spec.startswith("self."):
+        return GuardDecl("lock", spec.split("."), 0, spec)
+    return None
+
+
+class _ClassGuards:
+    """Declarations + derived facts for one class in one file."""
+
+    def __init__(self, info: _FileInfo, node: ast.ClassDef):
+        self.info = info
+        self.node = node
+        self.decls: Dict[str, GuardDecl] = {}
+        # attr -> classname of the guarded class it holds (one-level
+        # holder indirection, resolved after global collection)
+        self.holds: Dict[str, str] = {}
+        self.spawns_threads = False
+
+
+def _assign_targets(node: ast.AST) -> Iterable[ast.AST]:
+    if isinstance(node, ast.Assign):
+        yield from node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        yield node.target
+    elif isinstance(node, ast.Delete):
+        yield from node.targets
+
+
+def _self_attr(tgt: ast.AST) -> Optional[str]:
+    """``self.X`` (through one optional subscript) -> 'X'."""
+    node = tgt
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    chain = _attr_chain(node)
+    if chain and len(chain) == 2 and chain[0] == "self":
+        return chain[1]
+    return None
+
+
+class OwnershipPass:
+    def __init__(self, files: Sequence[_FileInfo]):
+        self.files = [f for f in files if _l119_in_scope(f.path)]
+        self.findings: List[Finding] = []
+        # classname -> _ClassGuards (first definition wins; the tree
+        # has no duplicate shared-structure class names)
+        self.classes: Dict[str, _ClassGuards] = {}
+
+    # -- phase 1: declarations + thread-crossing facts -----------------
+
+    def collect(self) -> None:
+        for info in self.files:
+            for node in ast.walk(info.tree):
+                if isinstance(node, ast.ClassDef):
+                    cg = _ClassGuards(info, node)
+                    self.classes.setdefault(node.name, cg)
+                    self._collect_class(cg)
+
+    def _collect_class(self, cg: _ClassGuards) -> None:
+        info = cg.info
+        for sub in ast.walk(cg.node):
+            if isinstance(sub, ast.Call):
+                fchain = _attr_chain(sub.func)
+                if fchain and fchain[-1] in _SPAWN_CALLS:
+                    cg.spawns_threads = True
+            for tgt in _assign_targets(sub):
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                got = _decl_comment(info, sub)
+                if got is None:
+                    continue
+                spec, line = got
+                decl = _parse_spec(spec)
+                if decl is None:
+                    self.findings.append(Finding(
+                        info.path, line, "L119",
+                        f"unparseable guard declaration "
+                        f"'# guarded-by: {spec}' — use 'self.<lock>', "
+                        f"'immutable', or 'external: <why>'"))
+                    continue
+                if decl.kind == "lock" \
+                        and not _LOCKISH.search(decl.chain[-1]):
+                    self.findings.append(Finding(
+                        info.path, line, "L119",
+                        f"guard declaration for '{attr}' names "
+                        f"'{'.'.join(decl.chain)}', which the lock "
+                        f"tracker will never see held (attribute "
+                        f"names a lock only when it ends in "
+                        f"lock/cond/mutex/rlock)"))
+                    continue
+                decl.line = line
+                prev = cg.decls.get(attr)
+                if prev is not None and prev.spec != decl.spec:
+                    self.findings.append(Finding(
+                        info.path, line, "L119",
+                        f"conflicting guard declarations for "
+                        f"'{attr}': '{prev.spec}' (line {prev.line}) "
+                        f"vs '{decl.spec}'"))
+                    continue
+                cg.decls[attr] = decl
+
+    def _collect_holders(self) -> None:
+        """``self.X = GuardedClass(...)`` in __init__ makes X a holder:
+        ``self.X.<attr>`` accesses check against GuardedClass's map.
+        ``self.X = injected or GuardedClass()`` counts too — the
+        dependency-injection default names the class either way."""
+        for cg in self.classes.values():
+            for sub in ast.walk(cg.node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                calls: List[ast.Call] = []
+                if isinstance(sub.value, ast.Call):
+                    calls.append(sub.value)
+                elif isinstance(sub.value, ast.BoolOp):
+                    calls.extend(v for v in sub.value.values
+                                 if isinstance(v, ast.Call))
+                for call in calls:
+                    fchain = _attr_chain(call.func)
+                    if fchain is None:
+                        continue
+                    held_cls = self.classes.get(fchain[-1])
+                    if held_cls is None or not held_cls.decls:
+                        continue
+                    for tgt in sub.targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            cg.holds[attr] = fchain[-1]
+
+    # -- phase 2: held-lockset access walk -----------------------------
+
+    def run(self) -> List[Finding]:
+        self.collect()
+        self._collect_holders()
+        for classname, cg in self.classes.items():
+            if cg.decls or cg.holds:
+                self._check_class_l119(classname, cg)
+            self._check_class_l120(classname, cg)
+        return self.findings
+
+    def _check_class_l119(self, classname: str, cg: _ClassGuards) -> None:
+        info = cg.info
+        # (method, attr, needed lock id) -> [(line, lock expr)]
+        unheld: Dict[Tuple[str, str, str], List[Tuple[int, str]]] = {}
+        # callee method -> [set of held lock ids at each same-class
+        # call site] — the one-level call expansion
+        callsites: Dict[str, List[Set[str]]] = {}
+
+        def resolve(chain: List[str]
+                    ) -> Optional[Tuple[str, GuardDecl, str, str]]:
+            """An access chain -> (attr label, decl, owning lock
+            id, lock expression to render in the finding)."""
+            if len(chain) == 2 and chain[0] == "self":
+                decl = cg.decls.get(chain[1])
+                if decl is None:
+                    return None
+                lock_id = ""
+                if decl.kind == "lock":
+                    lock_id = _LockId.of(decl.chain, classname,
+                                         info.module)
+                return (chain[1], decl, lock_id,
+                        ".".join(decl.chain or ()))
+            if len(chain) == 3 and chain[0] == "self" \
+                    and chain[1] in cg.holds:
+                held_cls = self.classes[cg.holds[chain[1]]]
+                decl = held_cls.decls.get(chain[2])
+                if decl is None:
+                    return None
+                lock_id = expr = ""
+                if decl.kind == "lock":
+                    # re-root at the holder: self._s + lock -> _s.lock,
+                    # the identity the ordering graph already uses
+                    rooted = ["self", chain[1]] + decl.chain[1:]
+                    lock_id = _LockId.of(rooted, classname, info.module)
+                    expr = ".".join(rooted)
+                return f"{chain[1]}.{chain[2]}", decl, lock_id, expr
+            return None
+
+        def note(method: str, node: ast.Attribute, held_ids: Set[str],
+                 rebinds: Set[int], mutations: Set[int]) -> None:
+            chain = _attr_chain(node)
+            if chain is None:
+                return
+            got = resolve(chain)
+            if got is None:
+                return
+            label, decl, lock_id, lock_expr = got
+            if decl.kind == "external":
+                return
+            if decl.kind in ("immutable", "internal"):
+                written = node.lineno in rebinds or (
+                    decl.kind == "immutable"
+                    and node.lineno in mutations)
+                if written and method not in (
+                        "__init__", "__post_init__"):
+                    self.findings.append(Finding(
+                        info.path, node.lineno, "L119",
+                        f"write to '{label}' declared "
+                        f"'# guarded-by: {decl.kind}' (line "
+                        f"{decl.line}) outside __init__ — drop the "
+                        f"waiver and declare its lock, or waive "
+                        f"with '# race: <reason>'"))
+                return
+            if lock_id in held_ids:
+                return
+            unheld.setdefault((method, label, lock_id), []).append(
+                (node.lineno, lock_expr))
+
+        def walk(method: str, nodes, held: Set[str],
+                 rebinds: Set[int], mutations: Set[int]) -> None:
+            for child in nodes:
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    got_ids = set(held)
+                    for item in child.items:
+                        got = _lock_exprs(item, classname, info.module)
+                        if got:
+                            got_ids.add(got[0])
+                    walk(method, child.body, got_ids, rebinds,
+                         mutations)
+                    continue
+                if isinstance(child, _FUNCS + (ast.Lambda,)):
+                    # closure: inherits the definition-site lockset
+                    # (precision over recall — see module docstring)
+                    body = child.body if isinstance(child.body, list) \
+                        else [child.body]
+                    walk(method, body, held, rebinds, mutations)
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    continue
+                for tgt in _assign_targets(child):
+                    node = tgt
+                    if isinstance(node, ast.Subscript):
+                        # container write through a subscript: not a
+                        # rebind of the attribute itself
+                        mutations.add(node.lineno)
+                        node = node.value
+                    elif isinstance(node, ast.Attribute):
+                        rebinds.add(node.lineno)
+                if isinstance(child, ast.Call):
+                    fchain = _attr_chain(child.func)
+                    if fchain and fchain[-1] in _MUTATING_METHODS \
+                            and len(fchain) >= 3:
+                        mutations.add(child.lineno)
+                    if fchain and len(fchain) == 2 \
+                            and fchain[0] == "self":
+                        callsites.setdefault(
+                            fchain[-1], []).append(set(held))
+                if isinstance(child, ast.Attribute):
+                    note(method, child, held, rebinds, mutations)
+                walk(method, ast.iter_child_nodes(child), held,
+                     rebinds, mutations)
+
+        for stmt in cg.node.body:
+            if not isinstance(stmt, _FUNCS):
+                continue
+            if stmt.name in ("__init__", "__post_init__") \
+                    or stmt.name.endswith("_locked"):
+                continue
+            walk(stmt.name, stmt.body, set(), set(), set())
+
+        for (method, label, lock_id), sites in sorted(unheld.items()):
+            calls = callsites.get(method, [])
+            if calls and all(lock_id in held for held in calls):
+                continue   # every same-class caller carries the lock
+            for line, lock_expr in sites:
+                self.findings.append(Finding(
+                    info.path, line, "L119",
+                    f"access to '{label}' (guarded by '{lock_id}') "
+                    f"without the owning lock held — wrap in "
+                    f"'with {lock_expr}:', rename the method "
+                    f"'*_locked' so L104 polices its callers, or "
+                    f"waive with '# race: <reason>'"))
+
+    # -- L120: thread-crossing classes need declarations ---------------
+
+    def _check_class_l120(self, classname: str, cg: _ClassGuards) -> None:
+        if not cg.spawns_threads:
+            return
+        info = cg.info
+        # attr -> first mutation line outside __init__
+        mutated: Dict[str, int] = {}
+        for stmt in cg.node.body:
+            if not isinstance(stmt, _FUNCS) \
+                    or stmt.name in ("__init__", "__post_init__"):
+                continue
+            for sub in ast.walk(stmt):
+                for tgt in _assign_targets(sub):
+                    attr = _self_attr(tgt)
+                    if attr is not None and attr not in mutated:
+                        mutated[attr] = tgt.lineno
+                if isinstance(sub, ast.Call):
+                    fchain = _attr_chain(sub.func)
+                    if fchain and len(fchain) == 3 \
+                            and fchain[0] == "self" \
+                            and fchain[-1] in _MUTATING_METHODS \
+                            and fchain[1] not in mutated:
+                        mutated[fchain[1]] = sub.lineno
+        for attr, line in sorted(mutated.items(), key=lambda kv: kv[1]):
+            if attr in cg.decls or _SYNCISH.search(attr):
+                continue
+            self.findings.append(Finding(
+                info.path, line, "L120",
+                f"'{classname}' spawns threads, so instances cross "
+                f"thread contexts — mutable attribute '{attr}' needs "
+                f"a guard declaration on its assignment "
+                f"('# guarded-by: self.<lock>', '# guarded-by: "
+                f"immutable', or '# guarded-by: external: <why>'), "
+                f"or a '# race: <reason>' waiver here"))
+
+
+def run_pass(files: Sequence[_FileInfo]) -> List[Finding]:
+    """Engine hook: L119/L120 findings for the linted file set (waiver
+    filtering happens in the caller, like every other rule)."""
+    return OwnershipPass(files).run()
+
+
+# ----------------------------------------------------------------------
+# runtime consumers: the declared guard map as data
+# ----------------------------------------------------------------------
+
+def declared_runtime_guards(
+        root: Path) -> Dict[str, Dict[str, GuardDecl]]:
+    """classname -> {attr -> GuardDecl} parsed from the tree under
+    ``root`` — the static guard map locks.py cross-checks at runtime
+    (AGAC_RACE_DETECT) and hack/guard_infer.py diffs proposals
+    against.  Parse errors are skipped: the lint gate owns syntax."""
+    out: Dict[str, Dict[str, GuardDecl]] = {}
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+        except (OSError, SyntaxError):
+            continue
+        info = _FileInfo(path, tree, source)
+        op = OwnershipPass([info])
+        op.collect()
+        for classname, cg in op.classes.items():
+            if cg.decls:
+                out.setdefault(classname, {}).update(cg.decls)
+    return out

@@ -100,7 +100,7 @@ class AutotuneEngine:
         self.registry = registry or TunableRegistry(
             defaults=config.defaults, pins=config.pins,
             freeze_cooldown=config.freeze_cooldown)
-        self._decisions: deque = deque(maxlen=4096)
+        self._decisions: deque = deque(maxlen=4096)  # guarded-by: internal
         self._thread: Optional[threading.Thread] = None
         self._policies = self._build_policies()
 

@@ -394,13 +394,14 @@ class MutationCoalescer:
         # the aggregator for the placement's mutation profile
         self._shard_id = shard_id
         self._lock = locks.make_lock("coalescer-groups")
-        self._groups: Dict[Tuple[str, str], _Group] = {}
+        self._groups: Dict[Tuple[str, str], _Group] = {}  # guarded-by: self._lock
         # warmth survives group pruning: idle groups are deleted after
         # every drain (the map must not grow with zone/EG churn), but
         # the NEXT submit moments later must still read as mid-wave or
         # the urgent cut fires inside every storm (a fresh group knows
         # no history).  Bounded LRU; (last_submit, last_gap,
         # last_drain, last_drain_size) per group key.
+        # guarded-by: self._lock
         self._warmth: "OrderedDict[Tuple[str, str], tuple]" = OrderedDict()
         # lifecycle fence (resilience/fence.py): tripped = new intents
         # rejected at submit; lingering leaders flush immediately (the

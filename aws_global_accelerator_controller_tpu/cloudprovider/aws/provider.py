@@ -170,16 +170,16 @@ class FleetDiscoveryState:
 
     def __init__(self):
         self.lock = locks.make_lock("fleet-discovery")
-        self.gen = 0
+        self.gen = 0  # guarded-by: self.lock
         # frozenset(target tag items) -> (arn, cached_at monotonic)
-        self.discovery: dict = {}
+        self.discovery: dict = {}  # guarded-by: self.lock
         # arn -> (tags, cached_at): spares the N+1 ListTags inside full
         # scans; all tag writes in the provider invalidate write-through
-        self.tags: dict = {}
-        self.fleet_index: dict = {}
-        self.fleet_at = None
-        self.fleet_epoch = 0
-        self.scans_inflight = 0
+        self.tags: dict = {}  # guarded-by: self.lock
+        self.fleet_index: dict = {}  # guarded-by: self.lock
+        self.fleet_at = None  # guarded-by: self.lock
+        self.fleet_epoch = 0  # guarded-by: self.lock
+        self.scans_inflight = 0  # guarded-by: self.lock
         # ONE ordered log of our own index mutations landing mid-scan:
         # ("prime", target key, arn) inserts and ("death", arn)
         # evictions, replayed IN ORDER over the installing snapshot —
@@ -187,8 +187,9 @@ class FleetDiscoveryState:
         # window must not re-install the dead arn, which separate
         # prime/death sets could not express (arns are never recycled,
         # so replaying the whole log is idempotent and order-correct)
-        self.prime_log: list = []
-        self.refresh_inflight = False  # one background refresh at a time
+        self.prime_log: list = []  # guarded-by: self.lock
+        # one background refresh at a time
+        self.refresh_inflight = False  # guarded-by: self.lock
         self.reads = Singleflight(
             on_coalesce=lambda key: record_coalesced_read(key[0]))
 

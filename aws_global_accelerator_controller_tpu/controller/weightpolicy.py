@@ -211,6 +211,8 @@ class ReloadingModelWeightPolicy:
             raise ValueError("reload interval must be > 0 seconds")
         self._directory = directory
         self._hidden_dim = hidden_dim
+        # guarded-by: external: the reload thread swaps the
+        # reference atomically; readers take the policy in force
         self._inner = ModelWeightPolicy.from_checkpoint(
             directory, hidden_dim=hidden_dim)
         self._interval = float(interval_s)

@@ -496,13 +496,18 @@ class _Watcher:
         self._client = client
         self._codec = codec
         self._q = q
+        # guarded-by: external: owned by the watcher's stream
+        # thread once start() spawns it
         self._rv = start_rv
         # key -> last delivered object; seeded with the pre-watch list so
         # 410 recovery can synthesize DELETED for objects that existed
         # before the watch started and were never streamed
+        # guarded-by: external: owned by the watcher's stream
+        # thread once start() spawns it
         self._objs: Dict[str, Any] = dict(initial or {})
         self._stop = threading.Event()
-        self._resp = None  # in-flight stream, closed by stop()
+        # in-flight stream, closed by stop()
+        self._resp = None  # guarded-by: self._resp_lock
         self._resp_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._run, daemon=True,

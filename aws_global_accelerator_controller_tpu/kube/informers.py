@@ -204,21 +204,25 @@ class Informer:
         self.kind = store.kind
         self._store = store
         self._resync_period = resync_period
-        self._cache: Dict[str, KubeObject] = {}
+        self._cache: Dict[str, KubeObject] = {}  # guarded-by: self._cache_lock
         self._cache_lock = locks.make_rlock(f"informer-cache[{self.kind}]")
         # index name -> index fn; index name -> value -> {key: obj}.
         # Buckets hold the cached objects themselves so by_index never
         # re-walks the cache; all mutation happens under _cache_lock.
+        # guarded-by: self._cache_lock
         self._index_funcs: Dict[str, IndexFunc] = {
             NAMESPACE_INDEX: lambda o: (o.metadata.namespace,)}
+        # guarded-by: self._cache_lock
         self._indices: Dict[str, Dict[str, Dict[str, KubeObject]]] = {
             NAMESPACE_INDEX: {}}
         # Copy-on-write list snapshots: built lazily on first read,
         # shared by every reader, dropped on any cache mutation.  None
         # marks "stale"; per-namespace snapshots piggyback on the
         # namespace index.
-        self._snapshot: Optional[List[KubeObject]] = None
-        self._ns_snapshots: Dict[str, List[KubeObject]] = {}
+        self._snapshot: Optional[List[KubeObject]] = None  # guarded-by: self._cache_lock
+        self._ns_snapshots: Dict[str, List[KubeObject]] = {}  # guarded-by: self._cache_lock
+        # guarded-by: external: handlers register before run(); the
+        # watch thread only iterates the list
         self._handlers: List[EventHandlers] = []
         # relist/list backoff jitter: seeded per kind, so a chaos
         # scenario's recovery schedule replays deterministically under
@@ -226,6 +230,8 @@ class Informer:
         self._jitter_rng = random.Random(zlib.crc32(self.kind.encode()))
         self._synced = simclock.make_event()
         self._thread: Optional[threading.Thread] = None
+        # guarded-by: external: only the informer loop thread touches
+        # the subscription once run() starts it
         self._watch_q: Optional[queue_mod.Queue] = None
         self.lister = Lister(self)
 

@@ -98,7 +98,7 @@ class KubeRestServer:
         # (the API Priority & Fairness path) so clients prove they
         # honor the wait and retry instead of surfacing every load
         # spike as an error
-        self.rate_limit_next = 0
+        self.rate_limit_next = 0  # guarded-by: self._rate_limit_lock
         self.rate_limit_retry_after = "1"
         self._rate_limit_lock = threading.Lock()
         # chunked-LIST snapshots: a continue token pins the listing
@@ -108,13 +108,17 @@ class KubeRestServer:
         # sorts before `after` AND its event RV is at or below the
         # list RV the watch resumes from).  Bounded LRU; an evicted
         # token answers 410 Expired, exactly what compaction does.
-        self._list_snapshots: "dict[str, tuple[int, list]]" = {}
-        self._list_snapshot_seq = 0
+        self._list_snapshots: "dict[str, tuple[int, list]]" = {}  # guarded-by: self._list_snapshots_lock
+        self._list_snapshot_seq = 0  # guarded-by: self._list_snapshots_lock
         self._list_snapshots_lock = threading.Lock()
         # live watch-stream sockets, for chaos testing (drop_watches)
-        self._watch_conns: set = set()
+        self._watch_conns: set = set()  # guarded-by: self._watch_conns_lock
         self._watch_conns_lock = threading.Lock()
-        self._queues: Dict[str, object] = {}  # kind -> store watch queue
+        # kind -> store watch queue: start() seeds every kind before
+        # the collectors spawn; afterwards each kind's slot is only
+        # re-subscribed by its OWN collector thread
+        # guarded-by: external: per-kind collector thread ownership
+        self._queues: Dict[str, object] = {}
         self._collectors = []
         for kind in self.codecs:
             t = threading.Thread(target=self._collect, args=(kind,),

@@ -257,43 +257,44 @@ class RateLimitingQueue:
                  depth_watermark: int = DEFAULT_DEPTH_WATERMARK,
                  age_watermark: float = DEFAULT_AGE_WATERMARK):
         self.name = name
-        self.aging_horizon = aging_horizon
-        self.depth_watermark = depth_watermark
-        self.age_watermark = age_watermark
+        self.aging_horizon = aging_horizon  # guarded-by: self._cond
+        self.depth_watermark = depth_watermark  # guarded-by: self._cond
+        self.age_watermark = age_watermark  # guarded-by: self._cond
         self._rate_limiter = rate_limiter or default_controller_rate_limiter()
         self._cond = simclock.make_condition(
             locks.make_lock(f"workqueue[{name}]"))
+        # guarded-by: self._cond
         self._tiers: Dict[str, deque] = {
             CLASS_INTERACTIVE: deque(), CLASS_BACKGROUND: deque()}
-        self._dirty: set = set()
-        self._processing: set = set()
+        self._dirty: set = set()  # guarded-by: self._cond
+        self._processing: set = set()  # guarded-by: self._cond
         # item -> traffic class while the key is anywhere in the queue
         # machinery (pending, processing, or parked in the delay heap)
-        self._class: Dict[Any, str] = {}
+        self._class: Dict[Any, str] = {}  # guarded-by: self._cond
         # item -> monotonic REQUEST time of the pending delivery (set
         # at add/add_after, backoff included — the latency stamp,
         # consumed by get into _claimed)
-        self._enqueued_at: Dict[Any, float] = {}
+        self._enqueued_at: Dict[Any, float] = {}  # guarded-by: self._cond
         # item -> monotonic time the item became RUNNABLE (entered its
         # tier deque) — what aging, tier_oldest_age and the overload
         # age watermark measure: a parked retry's deliberate backoff
         # is latency, not queue wait, and must not trip the shedder
-        self._runnable_at: Dict[Any, float] = {}
+        self._runnable_at: Dict[Any, float] = {}  # guarded-by: self._cond
         # item -> (class, enqueued_at) of the delivery a worker holds
-        self._claimed: Dict[Any, Tuple[str, float]] = {}
+        self._claimed: Dict[Any, Tuple[str, float]] = {}  # guarded-by: self._cond
         # trace-context sidecars (tracing.py TraceContext): the
         # context riding the PENDING delivery, and the one the
         # claiming worker holds (moved at get, dropped at done)
-        self._trace: Dict[Any, Any] = {}
-        self._claimed_trace: Dict[Any, Any] = {}
-        self._shutting_down = False
+        self._trace: Dict[Any, Any] = {}  # guarded-by: self._cond
+        self._claimed_trace: Dict[Any, Any] = {}  # guarded-by: self._cond
+        self._shutting_down = False  # guarded-by: self._cond
         # delaying queue state; _waiting_index dedupes by item keeping
         # the EARLIEST deadline (two parks — e.g. a breaker hint then a
         # shorter retry hint — must keep the earliest wake time); heap
         # entries not matching the index are stale and skipped on pop
-        self._waiting: List[Tuple[float, int, Any]] = []
-        self._waiting_index: Dict[Any, Tuple[float, int]] = {}
-        self._waiting_seq = 0
+        self._waiting: List[Tuple[float, int, Any]] = []  # guarded-by: self._cond
+        self._waiting_index: Dict[Any, Tuple[float, int]] = {}  # guarded-by: self._cond
+        self._waiting_seq = 0  # guarded-by: self._cond
         self._waker = simclock.start_thread(
             self._wait_loop, daemon=True,
             name=f"workqueue-waker-{name}")

@@ -227,9 +227,11 @@ class LeaderElection:
         self.retry_period = retry_period
         self.identity = identity or str(uuid.uuid4())
         self.fence = fence
-        self.is_leader = simclock.make_event()
+        self.is_leader = simclock.make_event()  # guarded-by: internal
         # set when the on_started_leading callback raised: the process
         # should exit non-zero instead of reporting a clean shutdown
+        # guarded-by: external: monotonic latch — the leader-run
+        # thread's single False->True transition, read by run()
         self.run_failed = False
         self._candidate = LeaseCandidate(name, namespace, kube_client,
                                          self.identity, lease_duration)

@@ -115,6 +115,8 @@ class ShardLeaseManager:
                                 kube_client, identity, lease_duration)
             for sid in range(shards.num_shards)}
         # monotonic time of the last successful renew per HELD shard
+        # guarded-by: external: owned by the lease-manager loop
+        # thread (run() is the only caller of the transitions)
         self._last_renew: Dict[int, float] = {}
         self._sleep = standby_jitter(identity, retry_period)
         self.started = simclock.make_event()

@@ -554,6 +554,14 @@ default_registry.describe(
     "Lock acquisitions screened by the runtime lockset tracker "
     "(analysis/locks.py) — nonzero proves the detector was armed.")
 default_registry.describe(
+    "guard_map_violations_total",
+    "Writes to a '# guarded-by: self.<lock>'-declared attribute "
+    "observed at runtime with the owning lock NOT held "
+    "(analysis/locks.py guard-map cross-check, armed with the race "
+    "detectors).  Each one is an interleaving the static L119 pass "
+    "could not see lexically — a real data race on a contracted "
+    "field, labeled by class and attribute.")
+default_registry.describe(
     "shared_view_mutations_blocked",
     "In-place mutations of shared informer-cache views caught by the "
     "freeze proxy (analysis/freezeproxy.py); each one is a "
@@ -862,6 +870,15 @@ def record_lockset_checks(n: int = 1,
     reg.inc_counter("race_lockset_checks", {}, float(n))
 
 
+def record_guard_map_violation(classname: str, attr: str,
+                               registry: Optional[Registry] = None) -> None:
+    """A declared-guarded attribute was written without its owning
+    lock held (analysis/locks.py runtime guard-map cross-check)."""
+    reg = registry or default_registry
+    reg.inc_counter("guard_map_violations_total",
+                    {"class": classname, "attr": attr})
+
+
 def record_shared_view_mutation_blocked(
         registry: Optional[Registry] = None) -> None:
     """The freeze proxy caught an in-place mutation of a shared
@@ -1017,6 +1034,8 @@ class HealthServer:
     def __init__(self, port: int = 8081, registry: Optional[Registry] = None,
                  host: str = ""):
         self.registry = registry or default_registry
+        # guarded-by: external: probes register before
+        # start_background(); the serve thread only iterates
         self._ready_probes: List[Tuple[str, Callable[[], bool]]] = []
         outer = self
 

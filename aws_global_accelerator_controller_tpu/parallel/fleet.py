@@ -207,7 +207,11 @@ class DeviceGridRing:
     """
 
     def __init__(self):
+        # guarded-by: external: pipeline-serialized — install/
+        # retire run on the submit edge, release on the flush-
+        # completion edge, never concurrently (see class docstring)
         self._front: Optional[Tuple] = None
+        # guarded-by: external: pipeline-serialized, as _front
         self._retired: Optional[Tuple] = None
 
     @property

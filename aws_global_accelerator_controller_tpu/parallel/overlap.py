@@ -67,10 +67,14 @@ class PlanFlushPipeline:
         self._flush = flush
         self._controller = controller
         self._ledger = ledger if ledger is not None else default_ledger
+        # guarded-by: external: the driver thread owns the list;
+        # the flusher only fills each window's flush tuple
         self.windows: List[WaveWindows] = []
         self._q = simclock.make_queue(maxsize=1)
+        # guarded-by: external: single-slot handoff — the flusher
+        # stores, the driver consumes at the next submit/close
         self._err: Optional[BaseException] = None
-        self._closed = False
+        self._closed = False  # guarded-by: external: driver thread only
         self._thread = simclock.start_thread(
             self._drain, name="plan-flush-drain")
 

@@ -111,12 +111,19 @@ class ResidentFleet:
         #: cached_w row valid (False = model group needs a rescore)
         self.has_cache = np.zeros((S, cap), bool)
 
+        # guarded-by: external: sweep-owner thread only — the fleet
+        # is single-writer by contract (see class docstring)
         self._slots: List[List[Optional[_Slot]]] = [
             [None] * cap for _ in range(S)]
+        # guarded-by: external: sweep-owner thread only
         self._free: List[List[int]] = [
             list(range(cap - 1, -1, -1)) for _ in range(S)]
+        # guarded-by: external: sweep-owner thread only
         self._index: Dict[str, Tuple[int, int]] = {}
+        # guarded-by: external: sweep-owner thread only
         self._lru: "OrderedDict[str, None]" = OrderedDict()
+        # guarded-by: external: sweep owner clears; note_dirty()'s
+        # cross-thread set.add is a single GIL-atomic op by design
         self._dirty: List[Set[int]] = [set() for _ in range(S)]
 
     # -- introspection --------------------------------------------------

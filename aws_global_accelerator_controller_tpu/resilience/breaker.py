@@ -71,17 +71,17 @@ class CircuitBreaker:
                  clock=simclock.monotonic):
         self.region = region
         self._clock = clock
-        self.window = window
+        self.window = window  # guarded-by: self._lock
         self.min_calls = min_calls
         self.failure_threshold = failure_threshold
         self.open_seconds = open_seconds
         self.half_open_probes = half_open_probes
         self._registry = registry
         self._lock = locks.make_lock(f"circuit-breaker-{region}")
-        self._events: "deque[tuple[float, bool]]" = deque()
-        self._state = STATE_CLOSED
-        self._opened_until = 0.0
-        self._probes_inflight = 0
+        self._events: "deque[tuple[float, bool]]" = deque()  # guarded-by: self._lock
+        self._state = STATE_CLOSED  # guarded-by: self._lock
+        self._opened_until = 0.0  # guarded-by: self._lock
+        self._probes_inflight = 0  # guarded-by: self._lock
         # feedback-tunable target (autotune/): the engine lengthens a
         # flapping breaker's window live via set_window
         tune_targets.note_breaker(self)

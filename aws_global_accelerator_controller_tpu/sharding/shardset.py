@@ -84,14 +84,17 @@ class ShardSet:
         # with each shard's own fence at the write chokepoints
         self.process_fence = process_fence
         self._lock = locks.make_lock("shardset")
+        # guarded-by: external: built once here; fences are
+        # internally synchronized, the list is never rebound
         self._fences: List[MutationFence] = [
             MutationFence(name=f"shard-{i}") for i in range(num_shards)]
         # standalone until a manager (or --shard-id) claims otherwise:
         # everything owned, fences armed at token 0
-        self._owned: Set[int] = set(range(num_shards))
-        self._managed = False
+        self._owned: Set[int] = set(range(num_shards))  # guarded-by: self._lock
+        self._managed = False  # guarded-by: self._lock
         # listeners: fn(event, shard_id) with event "acquired"/"lost";
         # called OUTSIDE the lock, on the transitioning thread
+        # guarded-by: self._lock
         self._listeners: List[Callable[[str, int], None]] = []
 
     # -- mode -----------------------------------------------------------

@@ -225,29 +225,33 @@ class VirtualClock:
                  wall_epoch: float = 1_600_000_000.0,
                  max_virtual: Optional[float] = None):
         self._lock = threading.Lock()
-        self._now = float(start)
+        self._now = float(start)  # guarded-by: self._lock
         self._wall_offset = wall_epoch - float(start)
         self._max_virtual = max_virtual
         # tid -> _RUNNING | _PARKED for every sim thread
         self._threads: Dict[int, str] = {}
-        self._names: Dict[int, str] = {}
+        self._names: Dict[int, str] = {}  # guarded-by: self._lock
         # tid -> threading.Thread, for liveness pruning: an
         # AUTO-registered thread (a leftover worker from an earlier
         # abruptly-stopped cluster that wandered into this clock) may
         # exit without deregistering — counted RUNNING forever, it
         # would freeze the scheduler, so the advance step prunes dead
         # members before concluding someone is still running
-        self._members: Dict[int, threading.Thread] = {}
-        self._running = 0
-        self._runnable: "deque[_Waiter]" = deque()
-        self._timers: List[Tuple[float, int, _Waiter]] = []
-        self._parked_waiters: Dict[int, _Waiter] = {}
-        self._seq = 0
+        self._members: Dict[int, threading.Thread] = {}  # guarded-by: self._lock
+        self._running = 0  # guarded-by: self._lock
+        self._runnable: "deque[_Waiter]" = deque()  # guarded-by: self._lock
+        self._timers: List[Tuple[float, int, _Waiter]] = []  # guarded-by: self._lock
+        self._parked_waiters: Dict[int, _Waiter] = {}  # guarded-by: self._lock
+        self._seq = 0  # guarded-by: self._lock
         # stats (sim_time_ratio, the bench's simulated-vs-wall story)
+        # guarded-by: external: stamped by activate() on the driver
+        # thread before any sim thread exists
         self._started_real = _real_monotonic()
+        # guarded-by: external: stamped by activate() on the driver
+        # thread before any sim thread exists
         self._started_virtual = float(start)
-        self.parks = 0
-        self.advances = 0
+        self.parks = 0  # guarded-by: self._lock
+        self.advances = 0  # guarded-by: self._lock
         # real-time watchdog (started by activate): a FOREIGN thread —
         # auto-registered because it wandered into a clock wait — can
         # die without deregistering, leaving the run count pinned > 0
@@ -257,7 +261,9 @@ class VirtualClock:
         # touches live state, so determinism is unaffected (it only
         # acts on a condition that is already outside the
         # deterministic model).
-        self._watchdog_stop = threading.Event()
+        self._watchdog_stop = threading.Event()  # guarded-by: internal
+        # guarded-by: external: activate()/deactivate() run on the
+        # driver thread (the install lock serializes them)
         self._watchdog: Optional[threading.Thread] = None
 
     # -- install ------------------------------------------------------
@@ -273,7 +279,7 @@ class VirtualClock:
                 raise RuntimeError("another VirtualClock is active")
             _installed = self
         self._started_real = _real_monotonic()
-        self._started_virtual = self._now
+        self._started_virtual = self._now  # race: driver-only setup read
         if register:
             self.register_current("driver")
         if self._watchdog is None or not self._watchdog.is_alive():
@@ -325,20 +331,20 @@ class VirtualClock:
     # -- reading time -------------------------------------------------
 
     def now(self) -> float:
-        return self._now  # float read is atomic under the GIL
+        return self._now  # race: lock-free hot read; float load is atomic under the GIL
 
     def wall_now(self) -> float:
-        return self._now + self._wall_offset
+        return self._now + self._wall_offset  # race: lock-free hot read, as now()
 
     def stats(self) -> dict:
         """Simulated-vs-wall accounting for the scale bench:
         ``sim_seconds``, ``wall_seconds``, ``sim_time_ratio``,
         ``parks``, ``advances``."""
         wall_s = max(1e-9, _real_monotonic() - self._started_real)
-        sim_s = self._now - self._started_virtual
+        sim_s = self._now - self._started_virtual  # race: stats snapshot; torn reads acceptable
         return {"sim_seconds": sim_s, "wall_seconds": wall_s,
                 "sim_time_ratio": sim_s / wall_s,
-                "parks": self.parks, "advances": self.advances}
+                "parks": self.parks, "advances": self.advances}  # race: stats snapshot
 
     # -- thread registry ----------------------------------------------
 

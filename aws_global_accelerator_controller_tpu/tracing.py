@@ -218,7 +218,7 @@ _NULL_SPAN = Span(name="<disabled>")
 class Tracer:
     def __init__(self, capacity: int = 4096):
         self._lock = threading.Lock()
-        self._spans: deque = deque(maxlen=capacity)
+        self._spans: deque = deque(maxlen=capacity)  # guarded-by: self._lock
         self._local = threading.local()
 
     def _stack(self) -> List[Span]:
@@ -501,7 +501,7 @@ class ConvergenceLedger:
 
     def __init__(self, capacity: int = 2048):
         self._lock = threading.Lock()
-        self._records: deque = deque(maxlen=capacity)
+        self._records: deque = deque(maxlen=capacity)  # guarded-by: self._lock
 
     def record(self, controller: str, key: str,
                ctx: Optional[TraceContext],

@@ -59,13 +59,28 @@ def parse_failures(path: Path) -> Tuple[Set[str], Set[str]]:
               f"log (no summary markers) — refusing to treat it as a "
               f"green run", file=sys.stderr)
         raise SystemExit(2)
+    # scope to the short-test-summary section when present: captured
+    # live-log output at ERROR level ("ERROR <logger>:<file>:<line>
+    # <msg>") matches the FAILED|ERROR shape, and the embedded source
+    # line number shifts whenever the module above it gains a line —
+    # every such noise line then diffs as a "new error"
+    lines = text.splitlines()
+    for i in range(len(lines) - 1, -1, -1):
+        if "short test summary info" in lines[i]:
+            lines = lines[i + 1:]
+            break
     failed: Set[str] = set()
     errored: Set[str] = set()
-    for line in text.splitlines():
+    for line in lines:
         m = _ID_LINE.match(line.strip())
         if not m:
             continue
         kind, nodeid = m.groups()
+        if re.search(r"\s", nodeid):
+            # node ids (tests/x.py::t, or a bare file for collection
+            # errors) never contain whitespace; a multi-word "id" is a
+            # log-noise line that slipped past the section scoping
+            continue
         # "FAILED tests/x.py::t - AssertionError: ..." -> the id alone
         (failed if kind == "FAILED" else errored).add(nodeid)
     return failed, errored

@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Render runtime guard-access profiles as reviewable ``# guarded-by:``
+declarations (the inference half of the L119/L120 ownership pass).
+
+Input: one or more JSON dumps produced by
+``analysis/locks.dump_guard_profile`` — run any suite with
+``AGAC_GUARD_PROFILE=/tmp/guard.json`` and the conftest session hook
+writes the dump at exit.  Each dump maps ``Class.attr`` to the
+multiset of locksets held across every post-``__init__`` write the
+patched ``__setattr__`` observed.
+
+Output, per observed field:
+
+  propose   not yet declared, and ONE lock was held at every observed
+            write -> a paste-ready ``# guarded-by: self.<lock>`` line
+  review    not yet declared, and the held locksets disagree (or were
+            empty): a human must decide between a lock, ``external:``
+            ownership, or a real race
+  declared  already declared; flags a MISMATCH when the dominant
+            observed lock is not the declared one (the static map and
+            the dynamic evidence disagree — one of them is wrong)
+
+The proposals are evidence, not truth: a field written under one lock
+in the exercised paths may still be read lock-free elsewhere.  Review
+before pasting; the static pass (``make lint``) then holds whatever
+you declare.
+
+Usage: python hack/guard_infer.py profile.json [more.json ...]
+       [--root aws_global_accelerator_controller_tpu]
+Exit 0 (informational; declared-map MISMATCH rows exit 1 so CI can
+object when dynamic evidence contradicts a declaration).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def load_profiles(paths):
+    """Merge dumps: 'Class.attr' -> {lockset-desc -> count}."""
+    merged = {}
+    for p in paths:
+        doc = json.loads(Path(p).read_text())
+        for key, entry in doc.items():
+            held = merged.setdefault(key, {})
+            for desc, n in entry.get("held", {}).items():
+                held[desc] = held.get(desc, 0) + int(n)
+    return merged
+
+
+def declared_map(root: Path):
+    """'Class.attr' -> GuardDecl from the tree's static declarations."""
+    from aws_global_accelerator_controller_tpu.analysis.ownership import (
+        declared_runtime_guards,
+    )
+    return {
+        f"{cls}.{attr}": decl
+        for cls, attrs in declared_runtime_guards(root).items()
+        for attr, decl in attrs.items()
+    }
+
+
+def dominant(held):
+    """(set of locks held at EVERY observed write, total writes)."""
+    total = sum(held.values())
+    always = None
+    for desc, _ in held.items():
+        locks = set() if desc == "<none>" else set(desc.split("|"))
+        always = locks if always is None else (always & locks)
+    return always or set(), total
+
+
+def pick(always):
+    """Paste-ready spelling: prefer a ``self.<attr>`` name."""
+    named = sorted(always, key=lambda s: (not s.startswith("self."), s))
+    return named[0] if named else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("profiles", nargs="+", help="dump_guard_profile JSONs")
+    ap.add_argument("--root", default=str(
+        REPO / "aws_global_accelerator_controller_tpu"))
+    args = ap.parse_args(argv)
+
+    merged = load_profiles(args.profiles)
+    declared = declared_map(Path(args.root))
+
+    mismatches = 0
+    for key in sorted(merged):
+        held = merged[key]
+        always, total = dominant(held)
+        decl = declared.get(key)
+        if decl is not None:
+            if decl.kind == "lock":
+                want = ".".join(decl.chain or ())
+                if "<untracked>" in held:
+                    print(f"declared {key}: '{want}' is a plain "
+                          f"primitive — invisible to the tracker "
+                          f"({total} writes unverifiable)")
+                elif want in always:
+                    print(f"declared {key}: '{want}' "
+                          f"({total} writes consistent)")
+                else:
+                    mismatches += 1
+                    seen = ", ".join(sorted(held)) or "<none>"
+                    print(f"MISMATCH {key}: declared '{want}' not "
+                          f"held at every observed write "
+                          f"({total} writes; locksets: {seen})")
+            else:
+                print(f"declared {key}: {decl.kind} ({total} writes)")
+        elif always:
+            print(f"propose  {key}: # guarded-by: {pick(always)} "
+                  f"(held at all {total} observed writes)")
+        else:
+            seen = ", ".join(sorted(held)) or "<none>"
+            print(f"review   {key}: no single lock held "
+                  f"({total} writes; locksets: {seen})")
+    if not merged:
+        print("no profiled writes (was AGAC_GUARD_PROFILE set and the "
+              "suite exercised?)")
+    return 1 if mismatches else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

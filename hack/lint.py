@@ -28,7 +28,7 @@ legitimate code):
 
 Suppress a line with ``# noqa`` or ``# noqa: L00X``.
 
-The concurrency contract rules (L101-L117, see
+The concurrency contract rules (L101-L120, see
 aws_global_accelerator_controller_tpu/analysis/concurrency_lint.py) run
 with ``--concurrency`` (only them) or ``--all`` (both passes — what
 ``make lint`` runs).  ``tests/lint_fixtures/`` holds deliberately
@@ -91,39 +91,79 @@ class _Finding:
         return f"{self.path}:{self.line}: {self.code} {self.msg}"
 
 
-def _loads_and_strings(tree: ast.AST) -> set:
-    """Every name read anywhere in the subtree, over-approximated:
-    Load/Del contexts, global/nonlocal declarations, and identifiers
-    inside ALL string constants (quoted forward-ref annotations,
-    __all__ entries, getattr strings) — a string mention is treated as
-    a use so the gate never flags a legitimate indirect reference."""
+def _collect_names(node, used: set) -> None:
+    """Name-use harvesting for ONE node (no recursion), over-
+    approximated: Load/Del contexts, `x += y` reads, global/nonlocal
+    declarations, and identifiers inside ALL string constants (quoted
+    forward-ref annotations, __all__ entries, getattr strings) — a
+    string mention is treated as a use so the gate never flags a
+    legitimate indirect reference."""
+    if isinstance(node, ast.Name) \
+            and isinstance(node.ctx, (ast.Load, ast.Del)):
+        used.add(node.id)
+    elif isinstance(node, ast.AugAssign) \
+            and isinstance(node.target, ast.Name):
+        # `x += y` reads x at runtime even though the target Name
+        # carries Store ctx
+        used.add(node.target.id)
+    elif isinstance(node, (ast.Global, ast.Nonlocal)):
+        used.update(node.names)
+    elif isinstance(node, ast.Constant) \
+            and isinstance(node.value, str) and len(node.value) < 4096:
+        used.update(_IDENT.findall(node.value))
+    elif isinstance(node, ast.ExceptHandler) and node.name:
+        used.add(node.name)   # binding, but keeps rule L002 scoped
+
+
+def _scan_scopes(scope, path, findings, is_function) -> set:
+    """One bottom-up traversal shared by L001 and L002: returns the
+    used-name set of `scope`'s whole subtree, merging child function
+    and class scopes' sets upward instead of re-walking each nested
+    subtree per enclosing function (the old per-function
+    `ast.walk` + exclusion-set shape was quadratic in nesting depth).
+    At each function scope the candidate single-name assignments are
+    checked against the subtree set — assignments inside a nested
+    ClassDef are class ATTRIBUTES (read via attribute access, not name
+    loads) and assignments inside a nested function belong to THAT
+    function's check, so both recurse as their own scope.  The
+    module-level return value is exactly the old whole-tree
+    `_loads_and_strings`, which L001 reuses for free."""
     used: set = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name) \
-                and isinstance(node.ctx, (ast.Load, ast.Del)):
-            used.add(node.id)
-        elif isinstance(node, ast.AugAssign) \
-                and isinstance(node.target, ast.Name):
-            # `x += y` reads x at runtime even though the target Name
-            # carries Store ctx
-            used.add(node.target.id)
-        elif isinstance(node, (ast.Global, ast.Nonlocal)):
-            used.update(node.names)
-        elif isinstance(node, ast.Constant) \
-                and isinstance(node.value, str) and len(node.value) < 4096:
-            used.update(_IDENT.findall(node.value))
-        elif isinstance(node, ast.ExceptHandler) and node.name:
-            used.add(node.name)   # binding, but keeps rule L002 scoped
+    candidates: list = []
+
+    def descend(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNCS + (ast.ClassDef,)):
+                used.update(_scan_scopes(child, path, findings,
+                                         isinstance(child, _FUNCS)))
+                continue
+            _collect_names(child, used)
+            if is_function and isinstance(child, ast.Assign) \
+                    and len(child.targets) == 1:
+                candidates.append(child)
+            descend(child)
+
+    descend(scope)
+    for node in candidates:
+        tgt = node.targets[0]
+        # single plain names only: tuple unpacking, attribute and
+        # subscript targets are exempt (pyflakes' F841 default)
+        if not isinstance(tgt, ast.Name) or tgt.id.startswith("_"):
+            continue
+        if tgt.id in used:
+            continue
+        findings.append(_Finding(
+            path, node.lineno, "L002",
+            f"local variable '{tgt.id}' assigned but never used"))
     return used
 
 
-def _unused_imports(tree, path, findings, is_init):
+def _unused_imports(nodes, path, findings, is_init, used):
     if is_init:
         # __init__.py imports are the package's public re-export
         # surface; "unused" is their job
         return
-    used = _loads_and_strings(tree)
-    for node in ast.walk(tree):
+    for node in nodes:
         names = []
         if isinstance(node, ast.Import):
             names = [(a.asname or a.name.split(".")[0], a.name)
@@ -147,54 +187,21 @@ def _unused_imports(tree, path, findings, is_init):
                 f"'{target}' imported but unused"))
 
 
-def _unused_locals(tree, path, findings):
-    for fn in ast.walk(tree):
-        if not isinstance(fn, _FUNCS):
-            continue
-        used = _loads_and_strings(fn)
-        # exempt two kinds of nested subtrees: assignments inside a
-        # nested ClassDef are class ATTRIBUTES (read via attribute
-        # access, not name loads), and assignments inside a nested
-        # function belong to THAT function's walk (reporting them here
-        # too would duplicate every finding once per enclosing scope)
-        nested: set = set()
-        for node in ast.walk(fn):
-            if isinstance(node, ast.ClassDef) \
-                    or (node is not fn and isinstance(node, _SCOPES)):
-                for sub in ast.walk(node):
-                    if sub is not node:
-                        nested.add(id(sub))
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
-                    or id(node) in nested:
-                continue
-            tgt = node.targets[0]
-            # single plain names only: tuple unpacking, attribute and
-            # subscript targets are exempt (pyflakes' F841 default)
-            if not isinstance(tgt, ast.Name) or tgt.id.startswith("_"):
-                continue
-            if tgt.id in used:
-                continue
-            findings.append(_Finding(
-                path, node.lineno, "L002",
-                f"local variable '{tgt.id}' assigned but never used"))
-
-
-def _format_spec_ids(tree) -> set:
+def _format_spec_ids(nodes) -> set:
     """id()s of JoinedStr nodes that are f-string format specs — the
     '{x:>8}' spec parses as its own JoinedStr and must not be linted
     as a placeholder-less f-string."""
     specs: set = set()
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.FormattedValue) \
                 and node.format_spec is not None:
             specs.add(id(node.format_spec))
     return specs
 
 
-def _ast_findings(tree, path, findings):
-    specs = _format_spec_ids(tree)
-    for node in ast.walk(tree):
+def _ast_findings(nodes, path, findings):
+    specs = _format_spec_ids(nodes)
+    for node in nodes:
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             findings.append(_Finding(
                 path, node.lineno, "L003",
@@ -287,12 +294,22 @@ def lint_file(path: Path) -> list:
     except SyntaxError as e:
         return [_Finding(path, e.lineno or 0, "L000",
                          f"syntax error: {e.msg}")]
+    return lint_tree(path, source, tree)
+
+
+def lint_tree(path: Path, source: str, tree) -> list:
+    """Base rules over an already-parsed module — `--all` parses each
+    file once and shares the tree with the concurrency engine."""
     noqa = _noqa_lines(source)
     raw: list = []
-    _unused_imports(tree, path, raw,
-                    is_init=path.name == "__init__.py")
-    _unused_locals(tree, path, raw)
-    _ast_findings(tree, path, raw)
+    # one scope pass emits L002 AND yields the module-wide used-name
+    # set L001 needs — the tree is traversed twice total (here and in
+    # _ast_findings), not once per rule per function
+    used = _scan_scopes(tree, path, raw, is_function=False)
+    nodes = list(ast.walk(tree))   # one walk, shared by L001/L003-L006
+    _unused_imports(nodes, path, raw,
+                    is_init=path.name == "__init__.py", used=used)
+    _ast_findings(nodes, path, raw)
     findings = [f for f in raw
                 if not _suppressed(noqa, f.line, f.code)]
     findings.extend(
@@ -302,14 +319,14 @@ def lint_file(path: Path) -> list:
     return findings
 
 
-def _concurrency_findings(files) -> list:
+def _concurrency_engine():
     # the engine lives inside the package so the runtime detectors and
     # tests share it; keep hack/ import-light by pathing to the repo
     sys.path.insert(0, str(REPO))
     from aws_global_accelerator_controller_tpu.analysis import (
         concurrency_lint,
     )
-    return concurrency_lint.lint_files(files)
+    return concurrency_lint.Engine()
 
 
 def main(argv) -> int:
@@ -346,16 +363,40 @@ def main(argv) -> int:
              if "__pycache__" not in f.parts
              and "lint_fixtures" not in f.parts]
     findings: list = []
-    if not concurrency_only:
-        for f in files:
+    engine = None
+    if concurrency_only or run_all:
+        try:
+            engine = _concurrency_engine()
+        except Exception as exc:
+            print(f"concurrency lint crashed: {exc!r}", file=sys.stderr)
+            return 2
+    # one parse per file: the base pass and the concurrency engine
+    # share the tree (Engine.add_file accepts a pre-parsed module)
+    for f in files:
+        source = f.read_text()
+        try:
+            tree = ast.parse(source, filename=str(f))
+        except SyntaxError as e:
+            if not concurrency_only:
+                findings.append(_Finding(f, e.lineno or 0, "L000",
+                                         f"syntax error: {e.msg}"))
+            if engine is not None:
+                # engine re-parses only this broken file, for its L100
+                engine.add_file(f, source)
+            continue
+        if not concurrency_only:
             try:
-                findings.extend(lint_file(f))
+                findings.extend(lint_tree(f, source, tree))
             except Exception as exc:
                 print(f"{f}: linter crashed: {exc!r}", file=sys.stderr)
                 return 2
-    if concurrency_only or run_all:
+        if engine is not None:
+            engine.add_file(f, source, tree)
+    if engine is not None:
         try:
-            findings.extend(_concurrency_findings(files))
+            findings.extend(sorted(
+                engine.run(),
+                key=lambda x: (str(x.path), x.line, x.code)))
         except Exception as exc:
             print(f"concurrency lint crashed: {exc!r}", file=sys.stderr)
             return 2

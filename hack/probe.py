@@ -1,0 +1,445 @@
+#!/usr/bin/env python3
+"""Catalog-driven contract-mutation probes (``make probes``).
+
+Every concurrency contract L101-L120 ships with at least one PROBE: a
+strip-the-contract mutation applied to the REAL tree in memory — drop
+a lock, remove a fence consult, sever a trace context, delete a guard
+declaration — after which the matching rule MUST fire.  "The lint
+fired once when we wrote it" becomes a CI-enforced property of every
+contract (FoundationdB-style: mutate the invariant to prove the
+checker is alive).  A probe that stops firing means the rule or the
+shipped code shape silently changed; a needle that stops matching
+means the anchor moved — both fail loudly here instead of rotting.
+
+Each catalog entry names the rule, the shipped file it mutates, and a
+transform over the file's source.  The engine writes the mutated file
+to a temp dir that MIRRORS the package-relative path (scope-sensitive
+rules key off ``aws_global_accelerator_controller_tpu`` in the path),
+lints it with the full concurrency engine, and asserts (a) the
+expected rule fires on the mutant and (b) the UNMUTATED file is clean
+under that rule (so the probe proves the mutation fired it, not a
+pre-existing finding).
+
+tests/test_lint.py runs the same catalog via ``probe.run_all`` and a
+meta-test asserts every documented rule L101-L120 is covered here.
+
+Usage: python hack/probe.py [--list] [name ...]
+Exit 0 all probes fired, 1 any failed/skipped-on-shape-drift.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import Callable, List, NamedTuple, Optional
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from aws_global_accelerator_controller_tpu.analysis import (  # noqa: E402
+    concurrency_lint,
+)
+
+PKG = "aws_global_accelerator_controller_tpu"
+
+
+class ShapeDrift(AssertionError):
+    """The shipped code no longer contains the probe's anchor."""
+
+
+class Probe(NamedTuple):
+    name: str            # unique, kebab-case
+    rule: str            # the code that must fire on the mutant
+    path: str            # repo-relative shipped file to mutate
+    mutate: Callable[[str], str]
+    # substring the firing finding's message must contain (None = any
+    # finding of the rule counts)
+    msg_needle: Optional[str] = None
+
+
+def _replace(src: str, needle: str, repl: str, probe: str) -> str:
+    if needle not in src:
+        raise ShapeDrift(
+            f"{probe}: anchor not found — shipped shape changed, "
+            f"update the probe (needle: {needle[:60]!r})")
+    return src.replace(needle, repl, 1)
+
+
+def _insert_after(src: str, needle: str, insertion: str,
+                  probe: str) -> str:
+    return _replace(src, needle, needle + insertion, probe)
+
+
+def _append(src: str, block: str) -> str:
+    return src.rstrip("\n") + "\n\n\n" + block.lstrip("\n")
+
+
+# -- mutations --------------------------------------------------------
+
+
+def _m_l101(src):
+    return _append(src, '''
+import threading as _probe_threading
+
+
+class _ProbeInversion:
+    def __init__(self):
+        self.a_lock = _probe_threading.Lock()
+        self.b_lock = _probe_threading.Lock()
+
+    def one(self):
+        with self.a_lock:
+            with self.b_lock:
+                pass
+
+    def two(self):
+        with self.b_lock:
+            with self.a_lock:
+                pass
+''')
+
+
+def _m_l102(src):
+    return _replace(
+        src,
+        "        with self._lock:\n            self._managed = True\n",
+        "        with self._lock:\n"
+        "            time.sleep(0.25)\n"
+        "            self._managed = True\n",
+        "blocking-call-under-lock")
+
+
+def _m_l103(src):
+    return _append(src, '''
+def _probe_touch(informer, ns, name):
+    svc = informer.lister.get(ns, name)
+    svc.metadata.annotations["touched"] = "true"
+    return svc
+''')
+
+
+def _m_l104(src):
+    start = src.find("def _update_accelerator")
+    end = src.find("def get_listener")
+    if start < 0 or end < 0 or start > end:
+        raise ShapeDrift("lock-strip-update-accelerator: "
+                         "_update_accelerator shape changed")
+    body = src[start:end]
+    if body.count("with self._s.lock:") != 1:
+        raise ShapeDrift("lock-strip-update-accelerator: "
+                         "lock block count changed")
+    return src[:start] \
+        + body.replace("with self._s.lock:", "if True:") + src[end:]
+
+
+def _m_l105(src):
+    return _append(src, '''
+def _probe_peek(cloud, arn):
+    return cloud.ga.describe_accelerator(arn)
+''')
+
+
+def _m_l106(src):
+    return _append(src, '''
+def _probe_flush(apis, zone_id, record_set):
+    apis.route53.change_resource_record_sets(
+        zone_id, "UPSERT", record_set)
+''')
+
+
+def _m_l107(src):
+    return _insert_after(
+        src,
+        "    ports, protocol = listener_for_service(svc)\n",
+        "    svc.apis.ga.describe_accelerator(svc.key())\n",
+        "apis-in-fingerprint")
+
+
+def _m_l108(src):
+    return _replace(
+        src,
+        "                if op in MUTATION_METHODS:\n"
+        "                    if self.fence is not None:\n"
+        "                        self.fence.check(\"wrapper\")\n"
+        "                    for extra_fence in active_write_fences():\n"
+        "                        extra_fence.check(\"wrapper\")\n",
+        "                pass\n",
+        "fence-strip-wrapper")
+
+
+def _m_l109(src):
+    return _replace(
+        src,
+        "    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE,"
+        " ctx=ctx)",
+        "    queue.add_rate_limited(key, ctx=ctx)",
+        "classless-enqueue")
+
+
+def _m_l110(src):
+    return _replace(
+        src,
+        '        sid = self._shards.check(container_key, '
+        'surface="coalescer")\n',
+        "        sid = 0\n",
+        "shard-check-strip")
+
+
+def _m_l111(src):
+    return _replace(
+        src,
+        "        compiler_params=CompilerParams(\n",
+        "        compiler_params=pltpu.CompilerParams(\n",
+        "bare-pltpu-graft")
+
+
+def _m_l112_egb(src):
+    out = _replace(src,
+                   "        outcome = self.rollout.decide(\n",
+                   "        outcome = _Passthrough(\n",
+                   "rollout-strip-egb")
+    return _replace(out, "not self._rollout_declared(obj)", "True",
+                    "rollout-strip-egb")
+
+
+def _m_l112_r53(src):
+    return _replace(
+        src,
+        "        policy, ramp_weights, ramp_requeue = "
+        "self._record_rollout(\n"
+        "            svc, \"service\", hostnames, "
+        "self.kube_client.services)\n",
+        "        policy, ramp_weights, ramp_requeue = "
+        "None, None, 0.0\n",
+        "rollout-strip-route53")
+
+
+def _m_l113_loop(src):
+    return _replace(
+        src,
+        "    s = score_rows(params, rows)",
+        "    for _row in rows:\n        pass\n"
+        "    s = score_rows(params, rows)",
+        "device-loop-graft")
+
+
+def _m_l113_apis(src):
+    return _insert_after(
+        src,
+        "    table = InternTable()\n",
+        "    apis.ga.describe_endpoint_group(groups[0])\n",
+        "apis-in-packing")
+
+
+def _m_l114_ctx(src):
+    return _replace(
+        src,
+        "    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE,"
+        " ctx=ctx)",
+        "    queue.add_rate_limited(key, klass=CLASS_INTERACTIVE)",
+        "ctx-strip-enqueue")
+
+
+def _m_l114_ambient(src):
+    return _replace(src,
+                    "        ctx = ambient_context()\n",
+                    "        ctx = None\n",
+                    "ambient-capture-strip")
+
+
+def _m_l115(src):
+    return _replace(
+        src,
+        "                self._resync_due(spread)\n",
+        "                import time\n"
+        "                time.sleep(0.001)\n"
+        "                self._resync_due(spread)\n",
+        "bare-sleep-informer")
+
+
+def _m_l116(src):
+    return _replace(
+        src,
+        "        if self._aggregator is not None:\n"
+        "            self._aggregator.submit_record_sets(\n"
+        "                zone_id, changes, fence=self._fence, "
+        "ctxs=ctxs,\n"
+        "                shard_id=self._shard_id)\n"
+        "            return\n",
+        "",
+        "aggregator-handoff-strip")
+
+
+def _m_l117(src):
+    return _replace(src,
+                    "    linger: float = knobcat.COALESCER_LINGER\n",
+                    "    linger: float = 0.005\n",
+                    "literal-linger")
+
+
+def _m_l118(src):
+    return _replace(
+        src,
+        "                wave = planner.plan_wave()\n",
+        "                packed = pack_fleet(\n"
+        "                    fleet.snapshot_groups())\n"
+        "                wave = planner.plan_wave()\n",
+        "wave-repack-graft")
+
+
+def _m_l119(src):
+    return _replace(
+        src,
+        "        with self._lock:\n            self._managed = True\n",
+        "        if True:\n            self._managed = True\n",
+        "guard-strip-shardset")
+
+
+def _m_l120(src):
+    return _replace(
+        src,
+        "  # guarded-by: self._cache_lock\n"
+        "        self._ns_snapshots",
+        "\n        self._ns_snapshots",
+        "declaration-strip-informer")
+
+
+PROBES: List[Probe] = [
+    Probe("inverted-lock-pair", "L101",
+          f"{PKG}/sharding/shardset.py", _m_l101),
+    Probe("blocking-call-under-lock", "L102",
+          f"{PKG}/sharding/shardset.py", _m_l102),
+    Probe("lister-view-mutation", "L103",
+          f"{PKG}/controller/globalaccelerator.py", _m_l103),
+    Probe("lock-strip-update-accelerator", "L104",
+          f"{PKG}/cloudprovider/aws/provider.py", _m_l104),
+    Probe("bare-service-call", "L105",
+          f"{PKG}/controller/globalaccelerator.py", _m_l105),
+    Probe("uncoalesced-mutation", "L106",
+          f"{PKG}/controller/globalaccelerator.py", _m_l106),
+    Probe("apis-in-fingerprint", "L107",
+          f"{PKG}/controller/globalaccelerator.py", _m_l107),
+    Probe("fence-strip-wrapper", "L108",
+          f"{PKG}/resilience/wrapper.py", _m_l108),
+    Probe("classless-enqueue", "L109",
+          f"{PKG}/controller/base.py", _m_l109),
+    Probe("shard-check-strip", "L110",
+          f"{PKG}/cloudprovider/aws/batcher.py", _m_l110),
+    Probe("bare-pltpu-graft", "L111",
+          f"{PKG}/ops/pallas_attention.py", _m_l111),
+    Probe("rollout-strip-egb", "L112",
+          f"{PKG}/controller/endpointgroupbinding.py", _m_l112_egb),
+    Probe("rollout-strip-route53", "L112",
+          f"{PKG}/controller/route53.py", _m_l112_r53,
+          msg_needle="process_service_create_or_update"),
+    Probe("device-loop-graft", "L113",
+          f"{PKG}/parallel/fleet_plan.py", _m_l113_loop,
+          msg_needle="loop"),
+    Probe("apis-in-packing", "L113",
+          f"{PKG}/reconcile/columnar.py", _m_l113_apis,
+          msg_needle="provider call"),
+    Probe("ctx-strip-enqueue", "L114",
+          f"{PKG}/controller/base.py", _m_l114_ctx),
+    Probe("ambient-capture-strip", "L114",
+          f"{PKG}/cloudprovider/aws/batcher.py", _m_l114_ambient),
+    Probe("bare-sleep-informer", "L115",
+          f"{PKG}/kube/informers.py", _m_l115,
+          msg_needle="time.sleep"),
+    Probe("aggregator-handoff-strip", "L116",
+          f"{PKG}/cloudprovider/aws/batcher.py", _m_l116),
+    Probe("literal-linger", "L117",
+          f"{PKG}/cloudprovider/aws/batcher.py", _m_l117),
+    Probe("wave-repack-graft", "L118",
+          f"{PKG}/controller/fleetsweep.py", _m_l118),
+    Probe("guard-strip-shardset", "L119",
+          f"{PKG}/sharding/shardset.py", _m_l119),
+    Probe("declaration-strip-informer", "L120",
+          f"{PKG}/kube/informers.py", _m_l120),
+]
+
+
+class ProbeResult(NamedTuple):
+    probe: Probe
+    ok: bool
+    detail: str
+
+
+# baseline-clean results cached per (path, rule) across the catalog run
+_BASELINE_CACHE: dict = {}
+
+
+def run_probe(probe: Probe, tmp_root: Path) -> ProbeResult:
+    real = REPO / probe.path
+    src = real.read_text()
+
+    # baseline: the unmutated file must be clean under the probe's
+    # rule, else "it fired" proves nothing (cached per path+rule)
+    bkey = (probe.path, probe.rule)
+    if bkey not in _BASELINE_CACHE:
+        _BASELINE_CACHE[bkey] = [
+            f for f in concurrency_lint.lint_files([real])
+            if f.code == probe.rule]
+    if _BASELINE_CACHE[bkey]:
+        return ProbeResult(probe, False,
+                           f"baseline not clean: {_BASELINE_CACHE[bkey][0]}")
+
+    try:
+        mutated = probe.mutate(src)
+    except ShapeDrift as e:
+        return ProbeResult(probe, False, str(e))
+    if mutated == src:
+        return ProbeResult(probe, False, "mutation was a no-op")
+
+    dst = tmp_root / probe.name / probe.path
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text(mutated)
+    fired = [f for f in concurrency_lint.lint_files([dst])
+             if f.code == probe.rule
+             and (probe.msg_needle is None
+                  or probe.msg_needle in f.msg)]
+    if not fired:
+        return ProbeResult(probe, False,
+                           f"{probe.rule} did not fire on the mutant")
+    return ProbeResult(probe, True,
+                       f"{probe.rule} fired at line {fired[0].line}")
+
+
+def run_all(names=None) -> List[ProbeResult]:
+    selected = [p for p in PROBES
+                if not names or p.name in names or p.rule in names]
+    results = []
+    with tempfile.TemporaryDirectory(prefix="agac-probes-") as tmp:
+        for probe in selected:
+            results.append(run_probe(probe, Path(tmp)))
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("names", nargs="*",
+                    help="probe names or rule codes (default: all)")
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for p in PROBES:
+            print(f"{p.rule}  {p.name:32s} {p.path}")
+        return 0
+
+    t0 = time.monotonic()
+    results = run_all(args.names)
+    failed = [r for r in results if not r.ok]
+    for r in results:
+        mark = "ok  " if r.ok else "FAIL"
+        print(f"{mark} {r.probe.rule} {r.probe.name:32s} {r.detail}")
+    rules = sorted({p.rule for p in PROBES})
+    print(f"probes: {len(results)} run, {len(failed)} failed, "
+          f"{len(rules)} rules ({rules[0]}-{rules[-1]}), "
+          f"{time.monotonic() - t0:.1f}s")
+    return 1 if failed or not results else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -111,6 +111,43 @@ def cluster(virtual_clock):
     c.shutdown()
 
 
+def test_guard_map_cross_check_fires_on_unguarded_write(
+        race_detectors, cluster):
+    """Runtime half of the L119 static pass (analysis/locks.py): with
+    the race detectors armed, install_guard_checks() cross-checks
+    every post-init write to a '# guarded-by: self.<lock>' declared
+    attribute against the thread's live lockset.  A real chaos
+    convergence must record ZERO violations (the declared map holds on
+    real interleavings, not just lexically) — and a deliberately
+    unguarded write to a real shared structure must raise and bump
+    guard_map_violations_total, proving the detector is live."""
+    from aws_global_accelerator_controller_tpu.analysis import locks
+
+    reg = metrics.default_registry
+    before = reg.counter_value("guard_map_violations_total")
+
+    cluster.cloud.elb.register_load_balancer(
+        "svc-g", nlb_hostname("svc-g"), REGION)
+    cluster.cloud.faults.set_error_rate("*", 0.20)
+    cluster.kube.services.create(managed_service("svc-g"))
+    wait_until(lambda: len(owned(cluster, "svc-g")) == 1,
+               timeout=30.0, message="accelerator for svc-g")
+    assert reg.counter_value("guard_map_violations_total") == before
+
+    # the provider's shared discovery state carries the declarations;
+    # its lock is tracked (created under the armed fixture)
+    state = cluster.factory.global_provider()._s
+    with state.lock:
+        state.refresh_inflight = False          # guarded write: clean
+    assert reg.counter_value("guard_map_violations_total") == before
+    with pytest.raises(locks.GuardMapViolation):
+        state.refresh_inflight = True           # disjoint lockset
+    assert reg.counter_value(
+        "guard_map_violations_total",
+        {"class": "FleetDiscoveryState",
+         "attr": "refresh_inflight"}) >= 1
+
+
 def test_all_controllers_converge_through_seeded_chaos(cluster):
     reg = metrics.default_registry
     retries_before = reg.counter_value("aws_call_retries_total")

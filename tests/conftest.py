@@ -41,6 +41,11 @@ def race_detectors():
     was_locks, was_views = locks.enabled(), freezeproxy.enabled()
     locks.enable()
     freezeproxy.enable()
+    # arm the field-level guard-map cross-check (runtime half of
+    # L119): post-init writes to '# guarded-by: self.<lock>' declared
+    # attributes raise unless the owning lock is held.  Idempotent,
+    # and a passthrough once the detectors are restored off.
+    locks.install_guard_checks()
     yield
     # restore (not force-off): AGAC_RACE_DETECT=1 / AGAC_FREEZE_VIEWS=1
     # arm the detectors for the WHOLE process — the first fixture
@@ -101,3 +106,13 @@ def tls_files(tmp_path_factory):
         serialization.PrivateFormat.TraditionalOpenSSL,
         serialization.NoEncryption()))
     return str(cert_file), str(key_file)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """With AGAC_GUARD_PROFILE=<path> set, write the observed
+    (class, attr, locks-held) access profiles at session exit —
+    hack/guard_infer.py renders the dump as reviewable
+    '# guarded-by:' proposals."""
+    from aws_global_accelerator_controller_tpu.analysis import locks
+    if locks.profile_enabled():
+        locks.dump_guard_profile()

@@ -1,0 +1,37 @@
+"""L119 clean: every access to a declared-guarded field holds the
+owning lock (or uses one of the legal exemptions)."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0                  # guarded-by: self._lock
+        self._names = []                 # guarded-by: self._lock
+        self._limit = 10                 # guarded-by: immutable
+        self._wake = threading.Event()   # guarded-by: internal
+
+    def bump(self, n):
+        with self._lock:
+            self._total += n
+            self._names.append(str(n))
+
+    def total(self):
+        with self._lock:
+            return self._total
+
+    def _drain_locked(self):
+        # *_locked: callers hold the lock (their sites are L104's job)
+        del self._names[:]
+
+    def capacity_left(self):
+        # immutable fields read lock-free anywhere
+        with self._lock:
+            return self._limit - self._total
+
+    def wake(self):
+        # internal: the Event synchronizes itself; calls are safe
+        self._wake.set()
+
+    def deliberate_peek(self):
+        return self._total  # race: monitoring snapshot, torn read ok

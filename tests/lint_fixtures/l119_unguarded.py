@@ -1,0 +1,19 @@
+"""L119 firing: declared-guarded fields touched without the owning
+lock lexically held."""
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0                  # guarded-by: self._lock
+        self._frozen = ()                # guarded-by: immutable
+
+    def bump(self, n):
+        self._total += n                 # lock not held
+
+    def read(self):
+        return self._total               # bare read
+
+    def refreeze(self, items):
+        self._frozen = tuple(items)      # immutable rebound post-init

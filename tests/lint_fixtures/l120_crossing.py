@@ -1,0 +1,18 @@
+"""L120 firing: instances provably cross threads (start() spawns a
+worker touching self) but the mutable fields carry no guard
+declaration and no immutability waiver."""
+import threading
+
+
+class Leaky:
+    def __init__(self):
+        self.results = []
+        self.finished = False
+
+    def start(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        self.results.append(1)
+        self.finished = True

@@ -1,0 +1,24 @@
+"""L120 clean: the thread-crossing class declares every mutable
+field (lock, external ownership, or immutability)."""
+import threading
+
+
+class Pump:
+    def __init__(self, sink):
+        self._lock = threading.Lock()
+        self._pending = []               # guarded-by: self._lock
+        self._seen = 0                   # guarded-by: self._lock
+        # guarded-by: external: wired before start(); the worker
+        # thread only calls it
+        self._sink = sink
+        self._thread = None              # sync plumbing: exempt by name
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        with self._lock:
+            self._seen += 1
+            self._pending.append(self._seen)
+        self._sink(self._seen)  # race: worker-owned callback reference

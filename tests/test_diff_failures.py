@@ -1,0 +1,73 @@
+"""hack/diff_failures.py log parsing — the tier1-diff gate's verdict.
+
+The regression these pin: captured live-log output at ERROR level
+("ERROR <logger>:<file>:<line> <msg>") matches the FAILED|ERROR line
+shape, and the embedded source line number shifts whenever the module
+above it gains a line — so every noise line diffed as a "new error"
+and a comment-only edit failed the gate.
+"""
+import importlib.util
+import os
+
+_HACK = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "hack")
+_spec = importlib.util.spec_from_file_location(
+    "diff_failures", os.path.join(_HACK, "diff_failures.py"))
+diff_failures = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(diff_failures)
+
+
+def _log(noise_line, *summary):
+    return "\n".join(
+        ["....F...",
+         noise_line,
+         "=========== short test summary info ============"]
+        + list(summary)
+        + ["1 failed, 10 passed in 1.00s", ""])
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return p
+
+
+def test_live_log_error_lines_are_not_failures(tmp_path):
+    p = _write(tmp_path, "head.log", _log(
+        "ERROR    pkg.provider:provider.py:1203 Could not find x",
+        "FAILED tests/test_a.py::test_x - AssertionError: boom"))
+    failed, errored = diff_failures.parse_failures(p)
+    assert failed == {"tests/test_a.py::test_x"}
+    assert errored == set()
+
+
+def test_collection_error_file_is_parsed(tmp_path):
+    p = _write(tmp_path, "head.log", _log(
+        "ERROR    pkg.provider:provider.py:1203 noise",
+        "ERROR tests/test_broken.py"))
+    _, errored = diff_failures.parse_failures(p)
+    assert errored == {"tests/test_broken.py"}
+
+
+def test_comment_shifted_noise_is_not_a_regression(tmp_path, capsys):
+    base = _write(tmp_path, "base.log", _log(
+        "ERROR    pkg.provider:provider.py:1202 Could not find x",
+        "FAILED tests/test_a.py::test_flaky - Timeout"))
+    head = _write(tmp_path, "head.log", _log(
+        "ERROR    pkg.provider:provider.py:1203 Could not find x",
+        "FAILED tests/test_a.py::test_flaky - Timeout"))
+    rc = diff_failures.main(["diff_failures", str(base), str(head)])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_real_new_failure_still_fails_the_gate(tmp_path, capsys):
+    base = _write(tmp_path, "base.log", _log(
+        "ERROR    pkg.provider:provider.py:1202 noise",
+        "FAILED tests/test_a.py::test_flaky - Timeout"))
+    head = _write(tmp_path, "head.log", _log(
+        "ERROR    pkg.provider:provider.py:1203 noise",
+        "FAILED tests/test_a.py::test_flaky - Timeout",
+        "FAILED tests/test_b.py::test_new - AssertionError"))
+    rc = diff_failures.main(["diff_failures", str(base), str(head)])
+    assert rc == 1
+    assert "tests/test_b.py::test_new" in capsys.readouterr().out

@@ -3,6 +3,7 @@ linter, not compileall) — its rules must fire on bad code and stay
 silent on the idioms this codebase actually uses, or the gate is
 either porous or noise."""
 import os
+import re
 import subprocess
 import sys
 import textwrap
@@ -11,6 +12,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "hack"))
 
 import lint  # noqa: E402
+import probe  # noqa: E402
 
 ROOT_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -1119,3 +1121,96 @@ def test_l118_seeded_repack_graft_into_shipped_sweep_caught(tmp_path):
                 if x.code == "L118"]
     assert findings, "a grafted full repack in the shipped sweep " \
                      "wave was not caught"
+
+
+# ---------------------------------------------------------------------------
+# L119/L120: field-level lock-ownership contracts (analysis/ownership.py)
+# ---------------------------------------------------------------------------
+
+def test_l119_guarded_accesses_clean():
+    """Lock-held accesses, *_locked methods, immutable reads, internal
+    method calls and the ``# race:`` waiver — zero findings."""
+    assert [x for x in _cfindings("l119_guarded.py")
+            if x[0] == "L119"] == []
+
+
+def test_l119_unguarded_accesses_fire():
+    """A lock-free write (13), a bare read (16) and a post-init rebind
+    of an ``immutable`` field (19) all fire."""
+    assert [x for x in _cfindings("l119_unguarded.py")
+            if x[0] == "L119"] == [
+        ("L119", 13), ("L119", 16), ("L119", 19)]
+
+
+def test_l120_declared_crossing_class_clean():
+    """A thread-spawning class whose mutable fields all carry
+    declarations (lock / external / waiver) never fires."""
+    assert [x for x in _cfindings("l120_owned.py")
+            if x[0] == "L120"] == []
+
+
+def test_l120_undeclared_crossing_class_fires():
+    """Instances cross threads and two mutable fields carry no
+    declaration: one finding per field at its first mutation."""
+    assert [x for x in _cfindings("l120_crossing.py")
+            if x[0] == "L120"] == [("L120", 17), ("L120", 18)]
+
+
+def test_l119_seeded_lock_strip_from_shipped_shardset_caught():
+    """Acceptance probe (via the hack/probe.py catalog): strip the
+    REAL ``with self._lock:`` from ShardSet.manage — a shipped
+    guarded-attribute access — and L119 must fire."""
+    results = probe.run_all(["guard-strip-shardset"])
+    assert results and all(r.ok for r in results), results
+
+
+def test_l120_seeded_declaration_strip_from_shipped_informer_caught():
+    """Strip a shipped ``# guarded-by:`` declaration from the informer
+    (a thread-spawning class) and L120 must fire."""
+    results = probe.run_all(["declaration-strip-informer"])
+    assert results and all(r.ok for r in results), results
+
+
+# ---------------------------------------------------------------------------
+# Probe catalog meta-tests: every contract stays probed (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _documented_rules():
+    """Rule codes documented in docs/static-analysis.md (L1xx rows)."""
+    doc = pathlib.Path(ROOT_DIR) / "docs" / "static-analysis.md"
+    return sorted(set(re.findall(r"^\| (L1\d\d) \|", doc.read_text(),
+                                 flags=re.MULTILINE)))
+
+
+def test_meta_every_documented_rule_has_fixture_pair():
+    """Every documented rule L101-L120 ships a firing AND a clean
+    fixture under tests/lint_fixtures/ — a future rule cannot land
+    without both."""
+    rules = _documented_rules()
+    assert rules, "no rules parsed from docs/static-analysis.md"
+    assert rules[0] == "L101" and rules[-1] == "L120", rules
+    for rule in rules:
+        prefix = rule.lower() + "_"
+        fixtures = sorted(FIXTURES.glob(prefix + "*.py"))
+        assert len(fixtures) >= 2, \
+            f"{rule}: needs a firing+clean fixture pair, " \
+            f"found {[f.name for f in fixtures]}"
+
+
+def test_meta_every_documented_rule_has_registered_probe():
+    """Every documented rule has a contract-mutation probe in the
+    hack/probe.py catalog — the lint gate cannot grow a rule whose
+    checker is never proven to fire."""
+    rules = _documented_rules()
+    probed = {p.rule for p in probe.PROBES}
+    missing = [r for r in rules if r not in probed]
+    assert not missing, f"rules without a registered probe: {missing}"
+
+
+def test_probe_catalog_all_fire():
+    """The full catalog run: every registered strip-the-contract
+    mutation fires its rule against the real tree (what ``make
+    probes`` enforces in CI)."""
+    results = probe.run_all()
+    failed = [r for r in results if not r.ok]
+    assert not failed, [(r.probe.name, r.detail) for r in failed]
